@@ -1,0 +1,27 @@
+"""ompi_tpu — a TPU-native MPI framework.
+
+A brand-new message-passing framework with the capability surface of Open
+MPI (reference: ``sadhananeo/ompi``), designed TPU-first: collectives
+dispatch to ``jax.lax`` collectives (``psum``, ``all_gather``,
+``psum_scatter``, ``ppermute``, ``all_to_all``) executed over a
+persistent ICI mesh, non-blocking operations map to async XLA dispatch,
+and component/tunable selection uses Open-MPI-compatible ``--mca``
+semantics (``OMPI_MCA_*`` env vars, mca-params.conf files, priorities).
+
+Layer map (≈ SURVEY.md §7):
+
+========  =====================================================  =========================
+package   role                                                   reference equivalent
+========  =====================================================  =========================
+core/     MCA var system + component registry + errors           opal/mca/base, opal/class
+boot/     rendezvous, launch (tpurun), KVS/fence                 PMIx + PRRTE subset
+mesh/     persistent device mesh, submeshes, HBM staging arena   opal/mca/accelerator
+ddt/      datatype engine: derived types, pack/unpack convertor  opal/datatype, ompi/datatype
+op/       reduction kernels (op × dtype), bit-exact ordered SUM  ompi/mca/op
+coll/     collective components: xla, base algorithms, nbc, han  ompi/mca/coll
+p2p/      point-to-point engine                                  ompi/mca/pml
+api/      communicators, groups, requests, MPI entry points      ompi/communicator, mpi/c
+========  =====================================================  =========================
+"""
+
+__version__ = "0.1.0"

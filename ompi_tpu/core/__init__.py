@@ -1,0 +1,18 @@
+"""Core layer: MCA var system, component registry, errors.
+
+≈ the reference's ``opal/mca/base`` + ``opal/class`` + ``opal/util``
+(SURVEY.md §2.1). The OO object system (``OBJ_NEW/RETAIN/RELEASE``) is
+replaced by Python object semantics; the var system and component
+architecture are reproduced faithfully (see var.py / registry.py).
+"""
+
+from .errors import MPIError  # noqa: F401
+from .registry import (  # noqa: F401
+    Component,
+    ComponentError,
+    Framework,
+    MCAContext,
+    SelectionError,
+    register_component,
+)
+from .var import VarStore  # noqa: F401

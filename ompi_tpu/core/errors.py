@@ -1,0 +1,117 @@
+"""MPI error classes and codes.
+
+Mirrors the error-class surface of the reference's ``ompi/errhandler/`` and
+the ``MPI_ERR_*`` constants from ``ompi/include/mpi.h.in`` [src]. The
+reference attaches an errhandler to every communicator/window/file object
+(MPI_ERRORS_ARE_FATAL default); here the Python-native design raises typed
+exceptions and lets per-communicator errhandlers translate them
+(``Comm.set_errhandler``).
+"""
+
+from __future__ import annotations
+
+# MPI error classes (values match the MPI standard / mpi.h ordering so a
+# future C shim can pass them through unchanged).
+MPI_SUCCESS = 0
+MPI_ERR_BUFFER = 1
+MPI_ERR_COUNT = 2
+MPI_ERR_TYPE = 3
+MPI_ERR_TAG = 4
+MPI_ERR_COMM = 5
+MPI_ERR_RANK = 6
+MPI_ERR_REQUEST = 7
+MPI_ERR_ROOT = 8
+MPI_ERR_GROUP = 9
+MPI_ERR_OP = 10
+MPI_ERR_TOPOLOGY = 11
+MPI_ERR_DIMS = 12
+MPI_ERR_ARG = 13
+MPI_ERR_UNKNOWN = 14
+MPI_ERR_TRUNCATE = 15
+MPI_ERR_OTHER = 16
+MPI_ERR_INTERN = 17
+MPI_ERR_IN_STATUS = 18
+MPI_ERR_PENDING = 19
+MPI_ERR_KEYVAL = 36
+MPI_ERR_NO_MEM = 34
+
+
+class MPIError(Exception):
+    """Base error carrying an MPI error class."""
+
+    error_class = MPI_ERR_OTHER
+
+    def __init__(self, message: str = "", error_class: int | None = None):
+        super().__init__(message)
+        if error_class is not None:
+            self.error_class = error_class
+
+
+class MPICommError(MPIError):
+    error_class = MPI_ERR_COMM
+
+
+class MPIRankError(MPIError):
+    error_class = MPI_ERR_RANK
+
+
+class MPIRootError(MPIError):
+    error_class = MPI_ERR_ROOT
+
+
+class MPITypeError(MPIError):
+    error_class = MPI_ERR_TYPE
+
+
+class MPICountError(MPIError):
+    error_class = MPI_ERR_COUNT
+
+
+class MPIOpError(MPIError):
+    error_class = MPI_ERR_OP
+
+
+class MPIArgError(MPIError):
+    error_class = MPI_ERR_ARG
+
+
+class MPIRequestError(MPIError):
+    error_class = MPI_ERR_REQUEST
+
+
+class MPITruncateError(MPIError):
+    error_class = MPI_ERR_TRUNCATE
+
+
+class MPIInternalError(MPIError):
+    error_class = MPI_ERR_INTERN
+
+
+class MPIBufferError(MPIError):
+    error_class = MPI_ERR_BUFFER
+
+
+class MPITopologyError(MPIError):
+    error_class = MPI_ERR_TOPOLOGY
+
+
+class MPIDimsError(MPIError):
+    error_class = MPI_ERR_DIMS
+
+
+class MPIKeyvalError(MPIError):
+    error_class = MPI_ERR_KEYVAL
+
+
+class MPIPendingError(MPIError):
+    error_class = MPI_ERR_PENDING
+
+
+class MPIInStatusError(MPIError):
+    error_class = MPI_ERR_IN_STATUS
+
+
+def error_string(error_class: int) -> str:
+    """MPI_Error_string equivalent."""
+    names = {v: k for k, v in globals().items() if k.startswith("MPI_ERR") or k == "MPI_SUCCESS"}
+    return names.get(error_class, f"MPI error class {error_class}")

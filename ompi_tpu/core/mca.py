@@ -1,0 +1,38 @@
+"""Process-global default MCA context.
+
+The reference keeps MCA state in process globals initialized by
+``opal_init`` (SURVEY.md §3.2).  Here the default context is created
+lazily and can be replaced by :func:`init` (called from
+``ompi_tpu.init`` with ``--mca`` params) — replacement is only allowed
+before components hand out live modules, enforced by the caller.
+"""
+
+from __future__ import annotations
+
+from .registry import MCAContext, load_external_components
+
+_default: MCAContext | None = None
+
+
+def default_context() -> MCAContext:
+    global _default
+    if _default is None:
+        load_external_components()
+        _default = MCAContext()
+    return _default
+
+
+def init(cmdline: dict[str, str] | None = None) -> MCAContext:
+    """(Re)create the default context with command-line ``--mca`` params."""
+    global _default
+    load_external_components()
+    _default = MCAContext(cmdline=cmdline)
+    return _default
+
+
+def reset() -> None:
+    """Drop the default context (tests only)."""
+    global _default
+    if _default is not None:
+        _default.close_all()
+    _default = None

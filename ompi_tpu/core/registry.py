@@ -1,0 +1,291 @@
+"""MCA component architecture — frameworks, components, selection.
+
+TPU-native re-design of ``opal/mca/base/mca_base_component_find.c`` /
+``mca_base_components_open.c`` / ``mca_base_components_select.c`` and the
+framework system (``mca_base_framework_open/register/close`` [bin]; see
+SURVEY.md §1).  Preserved semantics:
+
+* every behavioral unit is a **component** inside a **framework**
+  (``coll/xla``, ``coll/basic``, ``accelerator/tpu`` …);
+* the framework-level selection var (named exactly like the framework,
+  e.g. ``--mca coll xla,basic``) is an include list; a leading ``^``
+  (``--mca coll ^xla``) makes it an exclude list; mixing forms is an
+  error (matching mca_base_component_parse_requested);
+* each component registers a ``<fw>_<comp>_priority`` int var; selection
+  queries components and orders by priority (desc);
+* frameworks either pick ONE winner (pml-style, ``select_one``) or stack
+  many (coll-style, ``selectable``), the per-communicator stacking itself
+  living in ``ompi_tpu.coll.select``.
+
+Components register in-process via decorators instead of dlopen'd ``.so``
+plugins — the dynamic-loading half of MCA is replaced by Python import —
+but out-of-tree components still work: any module that defines a Component
+subclass and calls ``register_component`` participates identically
+(``OMPI_TPU_COMPONENT_MODULES`` env lists extra modules to import).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Any, Callable, Iterable, Type
+
+from .var import VarStore, full_var_name
+
+
+class ComponentError(Exception):
+    pass
+
+
+class SelectionError(ComponentError):
+    """Raised when an include-list names no usable component
+    (≈ the reference's "none of the requested components could be
+    selected" show_help abort)."""
+
+
+class Component:
+    """Base class for all MCA components.
+
+    Subclasses set ``FRAMEWORK`` and ``NAME`` and usually override
+    ``register_params`` / ``open`` / ``query``.
+    """
+
+    FRAMEWORK: str = ""
+    NAME: str = ""
+    #: Default selection priority; overridable via <fw>_<comp>_priority.
+    PRIORITY: int = 0
+    #: Version triple, surfaced by info dumps (≈ MCA_BASE_VERSION).
+    VERSION = (1, 0, 0)
+
+    def __init__(self) -> None:
+        self.priority: int = self.PRIORITY
+        self.opened: bool = False
+
+    # -- lifecycle (mirrors mca_base_component open/close/register) ----
+
+    def register_params(self, store: VarStore) -> None:
+        """Register this component's MCA vars. Called before open().
+        Always registers the common ``priority`` var."""
+        var = store.register(
+            self.FRAMEWORK,
+            self.NAME,
+            "priority",
+            self.PRIORITY,
+            type="int",
+            help=f"Selection priority of the {self.FRAMEWORK}/{self.NAME} component",
+        )
+        self.priority = var.value
+
+    def open(self, store: VarStore) -> bool:
+        """Return True if the component is usable in this process
+        (hardware present, deps importable …). False → silently skipped,
+        like a component whose open() returns OMPI_ERR_NOT_AVAILABLE."""
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<{self.FRAMEWORK}/{self.NAME} prio={self.priority}>"
+
+
+def parse_selection(value: str | None) -> tuple[bool, list[str]]:
+    """Parse a framework selection string.
+
+    Returns (is_exclude, names). ``None``/empty → include-all
+    (``(True, [])``: exclude nothing).
+    Raises ComponentError on mixed ``^`` usage, matching the reference's
+    refusal of e.g. ``--mca coll tuned,^sm``.
+    """
+    if not value:
+        return True, []
+    value = value.strip()
+    exclude = value.startswith("^")
+    if exclude:
+        value = value[1:]
+    names = [n.strip() for n in value.split(",") if n.strip()]
+    for n in names:
+        if n.startswith("^"):
+            raise ComponentError(
+                f"selection list may not mix include and exclude: {value!r}"
+            )
+    return exclude, names
+
+
+class Framework:
+    """One MCA framework: a named slot holding competing components."""
+
+    def __init__(self, name: str, store: VarStore, description: str = ""):
+        self.name = name
+        self.description = description
+        self.store = store
+        self._component_classes: dict[str, Type[Component]] = {}
+        self.components: dict[str, Component] = {}  # opened, post-selection
+        self._opened = False
+
+    def add_component_class(self, cls: Type[Component]) -> None:
+        if cls.FRAMEWORK != self.name:
+            raise ComponentError(
+                f"component {cls.NAME} declares framework {cls.FRAMEWORK!r}, "
+                f"registered into {self.name!r}"
+            )
+        self._component_classes[cls.NAME] = cls
+
+    @property
+    def known_component_names(self) -> list[str]:
+        return sorted(self._component_classes)
+
+    def open(self) -> None:
+        """Apply the selection var, register params, open survivors.
+
+        ≈ mca_base_framework_open: filter by include/exclude list, then
+        component register + open, dropping unusable ones.
+        """
+        if self._opened:
+            return
+        self._opened = True
+        raw = self.store.lookup_unregistered(self.name)
+        # Register the selection var itself so it shows up in info dumps.
+        self.store.register(
+            self.name,
+            "",
+            "",
+            raw if raw is not None else "",
+            type="string",
+            help=f"Component selection list for the {self.name} framework "
+            f'("a,b" include list, "^a,b" exclude list)',
+        )
+        exclude, names = parse_selection(raw)
+        requested: list[str] = []
+        for comp_name, cls in sorted(self._component_classes.items()):
+            if exclude:
+                if comp_name in names:
+                    continue
+            else:
+                if comp_name not in names:
+                    continue
+            requested.append(comp_name)
+        if not exclude and not requested and names:
+            raise SelectionError(
+                f"--mca {self.name} {','.join(names)}: no such component "
+                f"(known: {', '.join(self.known_component_names) or 'none'})"
+            )
+        for comp_name in requested:
+            comp = self._component_classes[comp_name]()
+            comp.register_params(self.store)
+            try:
+                usable = comp.open(self.store)
+            except Exception:
+                usable = False
+            if usable:
+                comp.opened = True
+                self.components[comp_name] = comp
+            else:
+                comp.close()
+        if not exclude and names and not self.components:
+            raise SelectionError(
+                f"--mca {self.name} {','.join(names)}: requested component(s) "
+                f"found but not usable in this process"
+            )
+
+    def selectable(self) -> list[Component]:
+        """Opened components ordered by priority desc, name asc (the order
+        coll-style stacking iterates; deterministic tie-break)."""
+        self.open()
+        return sorted(
+            self.components.values(), key=lambda c: (-c.priority, c.NAME)
+        )
+
+    def select_one(self) -> Component:
+        """pml-style exclusive selection: highest priority wins."""
+        mods = self.selectable()
+        if not mods:
+            raise SelectionError(
+                f"no usable component in framework {self.name!r}"
+            )
+        return mods[0]
+
+    def close(self) -> None:
+        for comp in self.components.values():
+            comp.close()
+        self.components.clear()
+        self._opened = False
+
+
+class MCAContext:
+    """Top-level MCA state: the var store plus all frameworks.
+
+    ≈ the process-global set of ``mca_base_framework_t`` singletons. A
+    default context is created at import; ``ompi_tpu.init`` re-creates it
+    with cmdline params; tests build private contexts.
+    """
+
+    def __init__(
+        self,
+        cmdline: dict[str, str] | None = None,
+        env: dict[str, str] | None = None,
+        param_files: Iterable[str] | None = None,
+    ):
+        self.store = VarStore(cmdline=cmdline, env=env, param_files=param_files)
+        self.frameworks: dict[str, Framework] = {}
+        self._register_builtin_components()
+
+    # Global class-level record of all known component classes, populated
+    # by the @register_component decorator at import time.
+    _global_component_classes: list[Type[Component]] = []
+
+    def framework(self, name: str, description: str = "") -> Framework:
+        fw = self.frameworks.get(name)
+        if fw is None:
+            fw = Framework(name, self.store, description)
+            self.frameworks[name] = fw
+        return fw
+
+    def _register_builtin_components(self) -> None:
+        for cls in MCAContext._global_component_classes:
+            self.framework(cls.FRAMEWORK).add_component_class(cls)
+
+    def refresh_components(self) -> None:
+        """Pick up component classes registered after this context was
+        built (import-order independence)."""
+        for cls in MCAContext._global_component_classes:
+            fw = self.framework(cls.FRAMEWORK)
+            if cls.NAME not in fw._component_classes:
+                fw.add_component_class(cls)
+
+    def open_all(self) -> None:
+        self.refresh_components()
+        for fw in self.frameworks.values():
+            fw.open()
+
+    def close_all(self) -> None:
+        for fw in self.frameworks.values():
+            fw.close()
+
+
+def register_component(cls: Type[Component]) -> Type[Component]:
+    """Class decorator: make a Component class known to every context.
+
+    ≈ the ``mca_<fw>_<comp>_component`` exported symbol that dlopen finds.
+    """
+    if not cls.FRAMEWORK or not cls.NAME:
+        raise ComponentError(f"{cls.__name__} must set FRAMEWORK and NAME")
+    existing = [
+        c
+        for c in MCAContext._global_component_classes
+        if c.FRAMEWORK == cls.FRAMEWORK and c.NAME == cls.NAME
+    ]
+    for c in existing:
+        MCAContext._global_component_classes.remove(c)
+    MCAContext._global_component_classes.append(cls)
+    return cls
+
+
+def load_external_components() -> None:
+    """Import extra component modules named in OMPI_TPU_COMPONENT_MODULES
+    (colon-separated) — the dlopen path for out-of-tree components."""
+    mods = os.environ.get("OMPI_TPU_COMPONENT_MODULES", "")
+    for mod in mods.split(":"):
+        mod = mod.strip()
+        if mod:
+            importlib.import_module(mod)

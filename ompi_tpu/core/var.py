@@ -1,0 +1,334 @@
+"""MCA variable system — the single config/flag system for the framework.
+
+TPU-native re-design of the reference's ``opal/mca/base/mca_base_var.c``
+(symbols ``mca_base_var_register``, ``mca_base_var_enum_create``,
+``mca_base_var_cache_files``, ``mca_base_var_build_env`` [bin]; see
+SURVEY.md §5-config).  Semantics preserved exactly:
+
+* every tunable is registered as ``<framework>_<component>_<name>``
+  (component/framework may be empty → names collapse, e.g. ``coll`` is the
+  framework-level selection var, ``coll_xla_priority`` a component var);
+* value resolution precedence (highest wins)::
+
+      cmdline ``--mca k v``  >  env ``OMPI_MCA_k``  >  param files
+      (user ``~/.ompi_tpu/mca-params.conf`` then system
+      ``$OMPI_TPU_SYSCONF/ompi_tpu-mca-params.conf``)  >  default
+
+* enums constrain string values and map to ints;
+* everything is introspectable (``ompi_tpu.info`` ≈ ``ompi_info --all``,
+  and the MPI_T cvar surface reads straight from this store).
+
+Unlike the reference (registration mutates global state at component dlopen
+time), registration here is idempotent and re-resolution is cheap, so tests
+can rebuild stores freely.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+ENV_PREFIXES = ("OMPI_MCA_", "OMPI_TPU_MCA_")
+
+# Where param files are looked up, in precedence order (user before system,
+# mirroring mca_base_var_cache_files' $HOME/.openmpi/mca-params.conf then
+# $sysconfdir/openmpi-mca-params.conf).
+def default_param_files() -> list[str]:
+    files = []
+    home = os.path.expanduser("~")
+    files.append(os.path.join(home, ".ompi_tpu", "mca-params.conf"))
+    sysconf = os.environ.get("OMPI_TPU_SYSCONF", "/etc/ompi_tpu")
+    files.append(os.path.join(sysconf, "ompi_tpu-mca-params.conf"))
+    return files
+
+
+# Value sources, low to high precedence. Matches mca_base_var_source_t
+# ordering in spirit: DEFAULT < FILE < ENV < COMMAND_LINE < SET(API).
+SOURCE_DEFAULT = "default"
+SOURCE_FILE = "file"
+SOURCE_ENV = "env"
+SOURCE_CMDLINE = "cmdline"
+SOURCE_SET = "api"
+
+_SOURCE_RANK = {
+    SOURCE_DEFAULT: 0,
+    SOURCE_FILE: 1,
+    SOURCE_ENV: 2,
+    SOURCE_CMDLINE: 3,
+    SOURCE_SET: 4,
+}
+
+_TRUE_STRINGS = {"1", "true", "yes", "on", "enabled", "t", "y"}
+_FALSE_STRINGS = {"0", "false", "no", "off", "disabled", "f", "n"}
+
+
+def full_var_name(framework: str, component: str, name: str) -> str:
+    """``<framework>_<component>_<name>`` with empty parts elided."""
+    parts = [p for p in (framework, component, name) if p]
+    return "_".join(parts)
+
+
+class VarConversionError(ValueError):
+    pass
+
+
+def _convert(raw: Any, typ: str, enum: dict[str, int] | None) -> Any:
+    """Convert a raw (usually string) value to the var's type."""
+    if typ == "string":
+        return str(raw)
+    if typ == "bool":
+        if isinstance(raw, bool):
+            return raw
+        s = str(raw).strip().lower()
+        if s in _TRUE_STRINGS:
+            return True
+        if s in _FALSE_STRINGS:
+            return False
+        raise VarConversionError(f"cannot parse {raw!r} as bool")
+    if typ == "int":
+        if isinstance(raw, bool):
+            return int(raw)
+        if isinstance(raw, int):
+            return raw
+        s = str(raw).strip()
+        if enum is not None and s in enum:
+            return enum[s]
+        try:
+            return int(s, 0)  # accepts 0x.., 0o.. like the C strtol path
+        except ValueError as e:
+            raise VarConversionError(f"cannot parse {raw!r} as int") from e
+    if typ == "float":
+        try:
+            return float(raw)
+        except (TypeError, ValueError) as e:
+            raise VarConversionError(f"cannot parse {raw!r} as float") from e
+    raise VarConversionError(f"unknown var type {typ!r}")
+
+
+@dataclass
+class Var:
+    """One registered MCA variable."""
+
+    framework: str
+    component: str
+    name: str
+    default: Any
+    type: str = "string"  # string | int | bool | float
+    help: str = ""
+    enum: dict[str, int] | None = None  # e.g. {"ring": 4, "rdbl": 3}
+    read_only: bool = False
+
+    value: Any = field(init=False, default=None)
+    source: str = field(init=False, default=SOURCE_DEFAULT)
+    source_detail: str = field(init=False, default="")
+
+    @property
+    def full_name(self) -> str:
+        return full_var_name(self.framework, self.component, self.name)
+
+    def enum_name(self) -> str | None:
+        """Reverse-map an int value to its enum name (for info dumps)."""
+        if self.enum is None:
+            return None
+        for k, v in self.enum.items():
+            if v == self.value:
+                return k
+        return None
+
+
+class VarStore:
+    """Registry + resolver for MCA variables.
+
+    One global instance lives on the MCA context (``ompi_tpu.core.mca``);
+    tests construct private stores.
+    """
+
+    def __init__(
+        self,
+        cmdline: dict[str, str] | None = None,
+        env: dict[str, str] | None = None,
+        param_files: Iterable[str] | None = None,
+    ):
+        self._vars: dict[str, Var] = {}
+        self._cmdline = dict(cmdline or {})
+        self._env = env  # None → live os.environ
+        self._file_values: dict[str, tuple[str, str]] = {}  # name -> (value, path)
+        self._files_loaded = False
+        self._param_files = list(param_files) if param_files is not None else None
+        # Deprecated-name aliases: alias -> canonical (for renamed vars).
+        self._aliases: dict[str, str] = {}
+
+    # -- param files ---------------------------------------------------
+
+    def _load_files(self) -> None:
+        if self._files_loaded:
+            return
+        self._files_loaded = True
+        files = self._param_files if self._param_files is not None else default_param_files()
+        # Later files must NOT override earlier ones (user file wins over
+        # system file) — first hit sticks, like mca_base_var_cache_files.
+        for path in files:
+            try:
+                with open(path, "r") as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            for line in lines:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "=" not in line:
+                    continue
+                k, _, v = line.partition("=")
+                k, v = k.strip(), v.strip()
+                if k and k not in self._file_values:
+                    self._file_values[k] = (v, path)
+
+    # -- registration --------------------------------------------------
+
+    def register(
+        self,
+        framework: str,
+        component: str,
+        name: str,
+        default: Any,
+        type: str | None = None,
+        help: str = "",
+        enum: dict[str, int] | None = None,
+        read_only: bool = False,
+        aliases: Iterable[str] = (),
+    ) -> Var:
+        """Register (or re-fetch) a variable and resolve its value.
+
+        Idempotent: re-registering an existing full name returns the
+        existing Var (matching mca_base_var_register's dedup behavior).
+        """
+        if type is None:
+            if isinstance(default, bool):
+                type = "bool"
+            elif isinstance(default, int):
+                type = "int"
+            elif isinstance(default, float):
+                type = "float"
+            else:
+                type = "string"
+        var = Var(framework, component, name, default, type, help, enum, read_only)
+        existing = self._vars.get(var.full_name)
+        if existing is not None:
+            return existing
+        self._vars[var.full_name] = var
+        for a in aliases:
+            self._aliases[a] = var.full_name
+        self._resolve(var)
+        return var
+
+    # -- resolution ----------------------------------------------------
+
+    def _lookup_raw(self, full_name: str) -> tuple[Any, str, str] | None:
+        """Find the highest-precedence raw value for a name.
+
+        Returns (raw_value, source, source_detail) or None.
+        """
+        names = [full_name] + [a for a, c in self._aliases.items() if c == full_name]
+        for n in names:
+            if n in self._cmdline:
+                return self._cmdline[n], SOURCE_CMDLINE, "--mca"
+        env = self._env if self._env is not None else os.environ
+        for n in names:
+            for prefix in ENV_PREFIXES:
+                key = prefix + n
+                if key in env:
+                    return env[key], SOURCE_ENV, key
+        self._load_files()
+        for n in names:
+            if n in self._file_values:
+                v, path = self._file_values[n]
+                return v, SOURCE_FILE, path
+        return None
+
+    def _resolve(self, var: Var) -> None:
+        hit = self._lookup_raw(var.full_name)
+        if hit is None:
+            var.value = var.default
+            var.source = SOURCE_DEFAULT
+            var.source_detail = ""
+            return
+        raw, source, detail = hit
+        if var.read_only:
+            # Read-only vars ignore external settings (INFORMATION-level
+            # vars in the reference); keep the default.
+            var.value = var.default
+            var.source = SOURCE_DEFAULT
+            var.source_detail = ""
+            return
+        var.value = _convert(raw, var.type, var.enum)
+        var.source = source
+        var.source_detail = detail
+
+    # -- access --------------------------------------------------------
+
+    def get(self, full_name: str, default: Any = None) -> Any:
+        full_name = self._aliases.get(full_name, full_name)
+        var = self._vars.get(full_name)
+        if var is None:
+            return default
+        return var.value
+
+    def get_var(self, full_name: str) -> Var | None:
+        full_name = self._aliases.get(full_name, full_name)
+        return self._vars.get(full_name)
+
+    def lookup_unregistered(self, full_name: str) -> str | None:
+        """Peek at the configured raw value for a name that may not be
+        registered yet (used by framework selection before components
+        register, like mca_base_var_find + the component include lists)."""
+        hit = self._lookup_raw(full_name)
+        return None if hit is None else str(hit[0])
+
+    def set(self, full_name: str, value: Any, source: str = SOURCE_SET) -> None:
+        """API-level override (highest precedence)."""
+        full_name = self._aliases.get(full_name, full_name)
+        var = self._vars.get(full_name)
+        if var is None:
+            # Stash as cmdline-equivalent so a later register() sees it.
+            self._cmdline[full_name] = str(value)
+            return
+        if var.read_only:
+            raise VarConversionError(f"{full_name} is read-only")
+        if _SOURCE_RANK[source] >= _SOURCE_RANK[var.source]:
+            var.value = _convert(value, var.type, var.enum)
+            var.source = source
+            var.source_detail = ""
+
+    def set_cmdline(self, params: dict[str, str]) -> None:
+        """Install ``--mca k v`` pairs and re-resolve affected vars.
+
+        API-level set() values outrank cmdline (SET is the highest
+        precedence source) and are therefore left untouched."""
+        self._cmdline.update(params)
+        for k in params:
+            canonical = self._aliases.get(k, k)
+            var = self._vars.get(canonical)
+            if var is not None and _SOURCE_RANK[var.source] <= _SOURCE_RANK[SOURCE_CMDLINE]:
+                self._resolve(var)
+
+    def all_vars(self) -> list[Var]:
+        return sorted(self._vars.values(), key=lambda v: v.full_name)
+
+    # -- env propagation (≈ mca_base_var_build_env) --------------------
+
+    def build_env(self, only_non_default: bool = True) -> dict[str, str]:
+        """Serialize current values to OMPI_MCA_* env vars, so spawned
+        child processes (tpurun → workers) inherit the resolved config."""
+        out: dict[str, str] = {}
+        for var in self._vars.values():
+            if only_non_default and var.source == SOURCE_DEFAULT:
+                continue
+            val = var.value
+            if isinstance(val, bool):
+                val = "1" if val else "0"
+            out[ENV_PREFIXES[0] + var.full_name] = str(val)
+        for k, v in self._cmdline.items():
+            out.setdefault(ENV_PREFIXES[0] + k, v)
+        return out

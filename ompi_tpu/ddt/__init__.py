@@ -1,0 +1,25 @@
+"""Datatype layer (≈ opal/datatype + ompi/datatype, SURVEY.md §2.1)."""
+
+from .datatype import (  # noqa: F401
+    BFLOAT16,
+    BYTE,
+    CHAR,
+    DOUBLE,
+    DOUBLE_INT,
+    FLOAT,
+    FLOAT_INT,
+    INT,
+    INT32_T,
+    INT64_T,
+    LONG,
+    LONG_INT,
+    PREDEFINED,
+    SHORT,
+    SHORT_INT,
+    TWO_INT,
+    UNSIGNED,
+    Datatype,
+    create_struct,
+    from_numpy_dtype,
+)
+from .convertor import Convertor, pack, packed_to_typed, typed_to_packed, unpack  # noqa: F401

@@ -1,0 +1,186 @@
+"""Pack/unpack convertor — wire (de)serialization of datatype buffers.
+
+TPU-native re-design of ``opal/datatype/opal_convertor.c`` (symbols
+``opal_convertor_pack/unpack/prepare_for_send/prepare_for_recv/
+set_position_nocheck`` [bin], SURVEY.md §2.1, §3.3).
+
+Where the reference walks the datatype description with a stack machine
+doing per-segment memcpy, this convertor executes the committed iovec
+program **vectorized**: one fused numpy gather (pack) or scatter (unpack)
+over a precomputed byte-index array.  That is the idiomatic shape for the
+TPU staging path too — on device the same index array drives a single XLA
+``take``/``scatter`` instead of many small copies (HBM prefers one big
+gather).  The reference's *partial pack* contract is preserved: pack/
+unpack accept a byte budget and can resume mid-element
+(``set_position``), which the p2p fragmentation layer depends on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIArgError, MPITruncateError
+from .datatype import Datatype
+
+
+def _as_byte_view(buf) -> np.ndarray:
+    """View any writable/readable buffer as a flat uint8 numpy array
+    without copying."""
+    if isinstance(buf, np.ndarray):
+        if not buf.flags.c_contiguous:
+            raise MPIArgError(
+                "buffer must be C-contiguous at the byte level; use "
+                "derived datatypes to describe strided layouts"
+            )
+        return buf.view(np.uint8).reshape(-1)
+    return np.frombuffer(buf, dtype=np.uint8)
+
+
+class Convertor:
+    """One pack or unpack stream over (buffer, datatype, count).
+
+    ≈ ``opal_convertor_t`` prepared with prepare_for_send/recv. Position
+    is measured in PACKED bytes (0 .. packed_size), exactly like the
+    reference, so fragmentation logic ports over unchanged.
+    """
+
+    def __init__(self, buffer, datatype: Datatype, count: int, origin: int = 0):
+        """``origin``: byte offset of the MPI "buffer pointer" within the
+        python buffer. Datatypes with negative lb/displacements (legal in
+        MPI) address bytes BEFORE the pointer; pass an origin >= -true_lb
+        so those land inside the buffer (numpy buffers cannot address
+        before their start, so origin 0 + negative offsets is an error,
+        never a silent wrap)."""
+        if count < 0:
+            raise MPIArgError("negative count")
+        self.datatype = datatype
+        self.count = count
+        self.buf = _as_byte_view(buffer)
+        # Byte-index program: indices into buf, in pack order.
+        self.indices = datatype.element_index_array(count)
+        if origin:
+            self.indices = self.indices + origin
+        self.packed_size = int(self.indices.size)
+        if count and self.packed_size:
+            # exact bounds from the index program (robust to negative
+            # strides/extents)
+            lo = int(self.indices.min())
+            hi = int(self.indices.max()) + 1
+            if lo < 0:
+                raise MPIArgError(
+                    f"datatype addresses byte {lo} before the buffer start; "
+                    f"pass origin >= {origin - lo} for negative-lb types"
+                )
+            if hi > self.buf.size:
+                raise MPITruncateError(
+                    f"buffer too small: {self.buf.size} bytes < {hi} required "
+                    f"for {count} x {datatype.name or 'datatype'}"
+                )
+        self.position = 0
+
+    # -- position (≈ opal_convertor_set_position) ----------------------
+
+    def set_position(self, position: int) -> None:
+        if not 0 <= position <= self.packed_size:
+            raise MPIArgError(f"position {position} outside [0, {self.packed_size}]")
+        self.position = position
+
+    @property
+    def done(self) -> bool:
+        return self.position >= self.packed_size
+
+    # -- pack / unpack -------------------------------------------------
+
+    def pack(self, max_bytes: int | None = None) -> np.ndarray:
+        """Produce the next <= max_bytes packed bytes (uint8 array).
+
+        ≈ opal_convertor_pack with an iovec budget; advances position.
+        """
+        remaining = self.packed_size - self.position
+        n = remaining if max_bytes is None else min(max_bytes, remaining)
+        if n <= 0:
+            return np.empty(0, np.uint8)
+        sel = self.indices[self.position : self.position + n]
+        out = self.buf[sel]  # fused gather
+        self.position += n
+        return out
+
+    def unpack(self, data) -> int:
+        """Consume packed bytes into the user buffer; returns bytes
+        consumed.  ≈ opal_convertor_unpack."""
+        src = _as_byte_view(data)
+        n = min(src.size, self.packed_size - self.position)
+        if n < src.size:
+            raise MPITruncateError(
+                f"unpack overflow: got {src.size} bytes, room for {n}"
+            )
+        if n == 0:
+            return 0
+        sel = self.indices[self.position : self.position + n]
+        self.buf[sel] = src[:n]  # fused scatter
+        self.position += n
+        return n
+
+
+# -- convenience one-shot API (hot path helpers) -----------------------
+
+
+def pack(buffer, datatype: Datatype, count: int, origin: int = 0) -> np.ndarray:
+    """One-shot full pack → contiguous uint8 array.
+
+    Contiguous datatypes short-circuit to a zero-work slice view
+    (the reference's opal_convertor homogeneous fast path) with the same
+    bounds validation as the general path.
+    """
+    if datatype.is_contiguous and datatype.lb + origin >= 0:
+        buf = _as_byte_view(buffer)
+        start = datatype.lb + origin
+        end = start + count * datatype.extent
+        if end > buf.size:
+            raise MPITruncateError(
+                f"buffer too small: {buf.size} bytes < {end} required "
+                f"for {count} x {datatype.name or 'datatype'}"
+            )
+        return buf[start:end]
+    return Convertor(buffer, datatype, count, origin).pack()
+
+
+def unpack(buffer, datatype: Datatype, count: int, data, origin: int = 0) -> None:
+    """One-shot full unpack of ``data`` into ``buffer``."""
+    if datatype.is_contiguous and datatype.lb + origin >= 0:
+        buf = _as_byte_view(buffer)
+        src = _as_byte_view(data)
+        start = datatype.lb + origin
+        if src.size != count * datatype.extent:
+            raise MPITruncateError(
+                f"expected {count * datatype.extent} packed bytes, got {src.size}"
+            )
+        if start + src.size > buf.size:
+            raise MPITruncateError(
+                f"buffer too small: {buf.size} bytes < {start + src.size} required"
+            )
+        buf[start : start + src.size] = src
+        return
+    c = Convertor(buffer, datatype, count, origin)
+    c.unpack(data)
+    if not c.done:
+        raise MPITruncateError(
+            f"short unpack: {c.position}/{c.packed_size} bytes"
+        )
+
+
+def packed_to_typed(packed: np.ndarray, datatype: Datatype, count: int) -> np.ndarray:
+    """Reinterpret a packed byte stream as the datatype's uniform leaf
+    dtype — the bridge from wire format to reduction kernels / XLA.
+
+    Only valid for uniform-leaf datatypes (all predefined numeric types
+    and any derived type built from one of them)."""
+    if datatype.uniform_leaf is None:
+        raise MPIArgError(
+            f"datatype {datatype.name} has mixed leaves; cannot view typed"
+        )
+    return packed.view(datatype.uniform_leaf)
+
+
+def typed_to_packed(arr: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)

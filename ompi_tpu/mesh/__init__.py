@@ -1,0 +1,3 @@
+"""Mesh layer (≈ opal/mca/accelerator + mpool/rcache, SURVEY.md §7.3)."""
+
+from .mesh import AXIS, CommMesh, TpuAcceleratorComponent, world_mesh  # noqa: F401

@@ -1,0 +1,24 @@
+"""Reduction op layer (≈ ompi/op + ompi/mca/op, SURVEY.md §2.2)."""
+
+from .op import (  # noqa: F401
+    BAND,
+    BOR,
+    BXOR,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MAXLOC,
+    MIN,
+    MINLOC,
+    NO_OP,
+    PREDEFINED_OPS,
+    PROD,
+    REPLACE,
+    SUM,
+    Op,
+    create_op,
+    ordered_reduce_jax,
+    ordered_reduce_np,
+    pairwise_tree_reduce_jax,
+)

@@ -1,0 +1,242 @@
+"""MPI reduction operations (MPI_Op) and their kernels.
+
+TPU-native re-design of ``ompi/op/`` + ``ompi/mca/op/`` (SURVEY.md §2.2
+"op — reduction kernels"; [bin] ``mca_op_avx_component``).  The reference
+provides a C-loop kernel per (op × datatype) with an AVX component
+selected by CPUID; here each op carries
+
+* a **jax kernel** (elementwise monoid ``f(a, b)``) — XLA fuses it into
+  the collective; the MXU/VPU replaces the AVX unit;
+* a **numpy kernel** — host/golden-reference path, also what a CPU-only
+  install of the reference would execute, so bit-parity is checked
+  against it;
+* optionally a **direct lax collective** name (``psum``/``pmax``/
+  ``pmin``) enabling the fused single-dispatch fast path.
+
+Bit-exactness: ``ordered_reduce`` applies a fixed rank-sequential left
+fold ``((r0 ⊕ r1) ⊕ r2) …`` — the order the reference's linear/basic
+reduction uses and what ``mca_coll_han_allreduce_reproducible`` pins —
+implemented with ``lax.fori_loop`` on device and a python loop on host,
+yielding identical fp32 results (IEEE ops are deterministic given order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu.core.errors import MPIOpError
+from ompi_tpu.ddt.datatype import Datatype
+
+
+def _dtype_kind(d: np.dtype) -> str:
+    """numpy kind, with ml_dtypes extension floats (bfloat16 etc., which
+    numpy reports as kind 'V') normalized to 'f'. numpy's finfo rejects
+    extension dtypes, so probe via ml_dtypes' finfo."""
+    d = np.dtype(d)
+    if d.kind == "V":
+        try:
+            import ml_dtypes
+
+            ml_dtypes.finfo(d)
+            return "f"
+        except (ValueError, TypeError, ImportError):
+            return "V"
+    return d.kind
+
+
+@dataclass(frozen=True)
+class Op:
+    """An MPI reduction operation."""
+
+    name: str
+    jax_fn: Callable[[Any, Any], Any] | None
+    np_fn: Callable[[Any, Any], Any] | None
+    commutative: bool = True
+    #: name of the fused lax collective for the direct path, if any
+    lax_collective: str | None = None
+    #: dtype-kind gate per MPI's op/type compatibility table
+    kinds: tuple[str, ...] = ("i", "u", "f", "c", "b")
+    #: True for MAXLOC/MINLOC — operates on (value, index) pair datatypes
+    is_loc: bool = False
+    #: identity element factory (dtype -> scalar), for padding/degenerate cases
+    identity: Callable[[np.dtype], Any] | None = None
+
+    def allowed_on(self, dt: Datatype) -> bool:
+        if self.is_loc:
+            # pair types: exactly two leaves, second is the index
+            return len(dt.typemap) == 2
+        leaf = dt.uniform_leaf
+        if leaf is None:
+            return False
+        return _dtype_kind(leaf) in self.kinds
+
+    def check(self, dt: Datatype) -> None:
+        if self.np_fn is None and self.jax_fn is None:
+            raise MPIOpError(f"{self.name} is not a reducing op")
+        if not self.allowed_on(dt):
+            raise MPIOpError(
+                f"op {self.name} not defined for datatype {dt.name or dt}"
+            )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<MPI_Op {self.name}>"
+
+
+# Logical-op kernels are polymorphic over numpy/jax arrays, so one
+# definition serves both the np_fn and jax_fn slots.
+def _land(a, b):
+    return ((a != 0) & (b != 0)).astype(a.dtype)
+
+
+def _lor(a, b):
+    return ((a != 0) | (b != 0)).astype(a.dtype)
+
+
+def _lxor(a, b):
+    return ((a != 0) ^ (b != 0)).astype(a.dtype)
+
+
+SUM = Op(
+    "MPI_SUM",
+    jax_fn=lambda a, b: a + b,
+    np_fn=lambda a, b: a + b,
+    lax_collective="psum",
+    kinds=("i", "u", "f", "c"),
+    identity=lambda dt: np.zeros((), dt),
+)
+PROD = Op(
+    "MPI_PROD",
+    jax_fn=lambda a, b: a * b,
+    np_fn=lambda a, b: a * b,
+    kinds=("i", "u", "f", "c"),
+    identity=lambda dt: np.ones((), dt),
+)
+MAX = Op(
+    "MPI_MAX",
+    jax_fn=jnp.maximum,
+    np_fn=np.maximum,
+    lax_collective="pmax",
+    kinds=("i", "u", "f"),
+    identity=lambda dt: (
+        np.array(-np.inf, dt) if _dtype_kind(dt) == "f" else np.iinfo(dt).min
+    ),
+)
+MIN = Op(
+    "MPI_MIN",
+    jax_fn=jnp.minimum,
+    np_fn=np.minimum,
+    lax_collective="pmin",
+    kinds=("i", "u", "f"),
+    identity=lambda dt: (
+        np.array(np.inf, dt) if _dtype_kind(dt) == "f" else np.iinfo(dt).max
+    ),
+)
+LAND = Op("MPI_LAND", jax_fn=_land, np_fn=_land, kinds=("i", "u", "b"))
+LOR = Op("MPI_LOR", jax_fn=_lor, np_fn=_lor, kinds=("i", "u", "b"))
+LXOR = Op("MPI_LXOR", jax_fn=_lxor, np_fn=_lxor, kinds=("i", "u", "b"))
+BAND = Op("MPI_BAND", jax_fn=lambda a, b: a & b, np_fn=np.bitwise_and, kinds=("i", "u", "b"))
+BOR = Op("MPI_BOR", jax_fn=lambda a, b: a | b, np_fn=np.bitwise_or, kinds=("i", "u", "b"))
+BXOR = Op("MPI_BXOR", jax_fn=lambda a, b: a ^ b, np_fn=np.bitwise_xor, kinds=("i", "u", "b"))
+
+# MAXLOC/MINLOC: value+index pairs; MPI tie-break = lower index wins.
+def _maxloc_np(a, b):
+    val_a, idx_a = a
+    val_b, idx_b = b
+    take_a = (val_a > val_b) | ((val_a == val_b) & (idx_a <= idx_b))
+    return np.where(take_a, val_a, val_b), np.where(take_a, idx_a, idx_b)
+
+
+def _minloc_np(a, b):
+    val_a, idx_a = a
+    val_b, idx_b = b
+    take_a = (val_a < val_b) | ((val_a == val_b) & (idx_a <= idx_b))
+    return np.where(take_a, val_a, val_b), np.where(take_a, idx_a, idx_b)
+
+
+def _maxloc_jax(a, b):
+    val_a, idx_a = a
+    val_b, idx_b = b
+    take_a = (val_a > val_b) | ((val_a == val_b) & (idx_a <= idx_b))
+    return jnp.where(take_a, val_a, val_b), jnp.where(take_a, idx_a, idx_b)
+
+
+def _minloc_jax(a, b):
+    val_a, idx_a = a
+    val_b, idx_b = b
+    take_a = (val_a < val_b) | ((val_a == val_b) & (idx_a <= idx_b))
+    return jnp.where(take_a, val_a, val_b), jnp.where(take_a, idx_a, idx_b)
+
+
+MAXLOC = Op("MPI_MAXLOC", jax_fn=_maxloc_jax, np_fn=_maxloc_np, is_loc=True)
+MINLOC = Op("MPI_MINLOC", jax_fn=_minloc_jax, np_fn=_minloc_np, is_loc=True)
+
+#: RMA accumulate ops (no reduction semantics of their own)
+REPLACE = Op("MPI_REPLACE", jax_fn=lambda a, b: b, np_fn=lambda a, b: b)
+NO_OP = Op("MPI_NO_OP", jax_fn=lambda a, b: a, np_fn=lambda a, b: a)
+
+PREDEFINED_OPS = {
+    op.name: op
+    for op in [SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, MAXLOC, MINLOC, REPLACE, NO_OP]
+}
+
+
+def create_op(fn: Callable[[Any, Any], Any], commute: bool = True, name: str = "user_op") -> Op:
+    """MPI_Op_create: user-defined reduction.
+
+    ``fn(a, b) -> c`` must be elementwise over arrays; it is used for
+    both host (numpy in) and device (traced jax in) execution, matching
+    the single user-function model of the reference (the user function
+    there receives raw buffers; here it receives arrays).
+    """
+    return Op(name, jax_fn=fn, np_fn=fn, commutative=commute, kinds=("i", "u", "f", "c", "b"))
+
+
+# -- ordered (bit-exact) reduction kernels -----------------------------
+
+
+def ordered_reduce_np(stacked: np.ndarray, op: Op) -> np.ndarray:
+    """Rank-sequential left fold on host: ((r0 ⊕ r1) ⊕ r2) …
+
+    ``stacked``: (nranks, ...) array. This IS the golden order the
+    reference's basic linear reduce applies (ompi/mca/coll/base
+    coll_base_reduce.c accumulates rank-by-rank in ascending order for
+    the in-order path / MPI_Op application order), so fp32 results here
+    define bit-parity.
+    """
+    acc = stacked[0]
+    for r in range(1, stacked.shape[0]):
+        acc = op.np_fn(acc, stacked[r])
+    return acc
+
+
+def ordered_reduce_jax(stacked, op: Op):
+    """Same fold under jit: lax.fori_loop keeps the order data-independent
+    and identical to the host fold (IEEE determinism given fixed order)."""
+    n = stacked.shape[0]
+
+    def body(i, acc):
+        return op.jax_fn(acc, stacked[i])
+
+    return jax.lax.fori_loop(1, n, body, stacked[0])
+
+
+def pairwise_tree_reduce_jax(stacked, op: Op):
+    """Fixed-shape binary-tree fold — the deterministic *fast* order for
+    non-commutative-sensitive cases that don't need CPU parity (fewer
+    serial steps than the left fold: log2(n) depth)."""
+    n = stacked.shape[0]
+    while n > 1:
+        half = n // 2
+        a = stacked[: half * 2 : 2]
+        b = stacked[1 : half * 2 : 2]
+        merged = op.jax_fn(a, b)
+        if n % 2:
+            merged = jnp.concatenate([merged, stacked[n - 1 : n]], axis=0)
+        stacked = merged
+        n = merged.shape[0]
+    return stacked[0]

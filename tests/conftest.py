@@ -1,0 +1,33 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+The reference tests distributed behavior single-node with
+``mpirun --oversubscribe`` over loopback BTLs (SURVEY.md §4); the
+TPU-native analog is an N-device virtual CPU mesh via
+``--xla_force_host_platform_device_count``. This must be configured
+before jax initializes a backend; the axon TPU plugin registers itself
+via sitecustomize, so we ALSO set jax_platforms programmatically — the
+env var alone is not honored once the plugin is loaded.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# MPI_DOUBLE / MPI_INT64_T are first-class; without x64 JAX silently
+# truncates them to 32-bit, which breaks datatype/op bit-parity.
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,253 @@
+"""Algorithm-library tests: every ppermute schedule vs numpy golden.
+
+Mirrors the role of the reference's coll algorithm validation (external
+suites + OSU, SURVEY.md §4): each algorithm in ompi_tpu/coll/base.py is
+run under shard_map on the 8-device virtual CPU mesh and compared to the
+per-rank golden computed with numpy. The ordered variants are compared
+BIT-exactly against the rank-sequential left fold.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ompi_tpu.coll import base as cb
+from ompi_tpu.mesh import AXIS
+from ompi_tpu.op import MAX, MIN, PROD, SUM, ordered_reduce_np
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def run_spmd(mesh, fn, x, out_ranked=True):
+    """Run fn(per_device_block) over the mesh; x is rank-major (N, ...)."""
+    shard = shard_map(
+        lambda v: fn(v[0])[None],
+        mesh=mesh,
+        in_specs=P(AXIS),
+        out_specs=P(AXIS),
+    )
+    return np.asarray(jax.jit(shard)(x))
+
+
+def rank_data(shape=(41,), dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.dtype(dtype).kind in "iu":
+        return rng.randint(-50, 50, (N,) + shape).astype(dtype)
+    return (rng.randn(N, *shape) * 10.0 ** rng.randint(-3, 4, (N,) + shape)).astype(
+        dtype
+    )
+
+
+ALLREDUCE_ALGOS = [
+    cb.allreduce_psum,
+    cb.allreduce_ordered_linear,
+    cb.allreduce_ring,
+    cb.allreduce_recursive_doubling,
+    cb.allreduce_rabenseifner,
+    lambda x, op, n: cb.allreduce_ring_segmented(x, op, n, segcount=7),
+]
+
+
+@pytest.mark.parametrize("algo", ALLREDUCE_ALGOS, ids=lambda a: getattr(a, "__name__", "ring_seg"))
+def test_allreduce_algorithms_sum_fp64(mesh, algo):
+    """fp64 keeps all orders equal to the golden within exact equality of
+    integer-valued data — use integer-valued doubles so every order is
+    exact and comparison is strict."""
+    x = rank_data(dtype=np.int64).astype(np.float64)
+    out = run_spmd(mesh, lambda v: algo(v, SUM, N), x)
+    golden = x.sum(0)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], golden)
+
+
+@pytest.mark.parametrize("op,npop", [(MAX, np.max), (MIN, np.min)])
+def test_allreduce_ring_minmax(mesh, op, npop):
+    x = rank_data(dtype=np.float32, seed=3)
+    out = run_spmd(mesh, lambda v: cb.allreduce_ring(v, op, N), x)
+    golden = npop(x, axis=0)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], golden)
+
+
+def test_allreduce_ordered_linear_bit_exact_fp32(mesh):
+    """The north-star parity property: ordered_linear == numpy left fold,
+    bit for bit, on cancellation-prone fp32 data."""
+    x = rank_data(dtype=np.float32, seed=7)
+    out = run_spmd(mesh, lambda v: cb.allreduce_ordered_linear(v, SUM, N), x)
+    golden = ordered_reduce_np(x, SUM)
+    for r in range(N):
+        assert np.array_equal(
+            out[r].view(np.uint8), golden.view(np.uint8)
+        ), f"rank {r} not bit-exact"
+
+
+def test_allreduce_nonpow2_recursive_doubling(devices):
+    """Non-power-of-two comm: rd pre-folds extra ranks (n=6 over a
+    6-device submesh)."""
+    sub = Mesh(np.array(devices[:6]), (AXIS,))
+    x = rank_data(dtype=np.float64)[:6]
+    x = np.round(x)  # integer-valued → order-insensitive exact sums
+    shard = shard_map(
+        lambda v: cb.allreduce_recursive_doubling(v[0], SUM, 6)[None],
+        mesh=sub,
+        in_specs=P(AXIS),
+        out_specs=P(AXIS),
+    )
+    out = np.asarray(jax.jit(shard)(x))
+    for r in range(6):
+        np.testing.assert_array_equal(out[r], x.sum(0))
+
+
+def test_allreduce_ring_odd_size_and_padding(devices):
+    """n=5 submesh with a length not divisible by n exercises padding."""
+    sub = Mesh(np.array(devices[:5]), (AXIS,))
+    x = np.round(rank_data((13,), np.float64)[:5])
+    shard = shard_map(
+        lambda v: cb.allreduce_ring(v[0], SUM, 5)[None],
+        mesh=sub,
+        in_specs=P(AXIS),
+        out_specs=P(AXIS),
+    )
+    out = np.asarray(jax.jit(shard)(x))
+    for r in range(5):
+        np.testing.assert_array_equal(out[r], x.sum(0))
+
+
+def test_rabenseifner_rejects_nonpow2():
+    with pytest.raises(ValueError):
+        cb.allreduce_rabenseifner(jnp.zeros(4), SUM, 6)
+
+
+# -- allgather ---------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "algo", [cb.allgather_direct, cb.allgather_ring, cb.allgather_bruck]
+)
+def test_allgather_algorithms(mesh, algo):
+    x = rank_data((5,), np.int32)
+    out = run_spmd(mesh, lambda v: algo(v, N).reshape(-1), x)
+    golden = x.reshape(-1)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r].reshape(N, 5), x)
+
+
+# -- bcast -------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "algo",
+    [
+        cb.bcast_direct,
+        cb.bcast_binomial,
+        lambda x, n, root: cb.bcast_pipeline(x, n, root, segcount=9),
+    ],
+    ids=["direct", "binomial", "pipeline"],
+)
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_bcast_algorithms(mesh, algo, root):
+    x = rank_data((21,), np.float32, seed=root)
+    out = run_spmd(mesh, lambda v: algo(v, N, root), x)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], x[root])
+
+
+# -- reduce ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_reduce_binomial(mesh, root):
+    x = np.round(rank_data((9,), np.float64))
+    out = run_spmd(mesh, lambda v: cb.reduce_binomial(v, SUM, N, root), x)
+    np.testing.assert_array_equal(out[root], x.sum(0))
+
+
+# -- reduce_scatter ----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "algo", [cb.reduce_scatter_direct, cb.reduce_scatter_ring]
+)
+def test_reduce_scatter(mesh, algo):
+    # each rank contributes (N, k); rank r receives sum over ranks of block r
+    k = 6
+    x = np.round(rank_data((N, k), np.float64))
+    shard = shard_map(
+        lambda v: algo(v[0], SUM, N)[None],
+        mesh=mesh,
+        in_specs=P(AXIS),
+        out_specs=P(AXIS),
+    )
+    out = np.asarray(jax.jit(shard)(x))
+    golden = x.sum(0)  # (N, k): block r
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], golden[r])
+
+
+def test_reduce_scatter_prod(mesh):
+    x = np.full((N, N, 3), 1.0, np.float64)
+    x[2] = 2.0
+    out = run_spmd(mesh, lambda v: cb.reduce_scatter_ring(v, PROD, N), x)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], np.full(3, 2.0))
+
+
+# -- alltoall ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", [cb.alltoall_direct, cb.alltoall_pairwise])
+def test_alltoall(mesh, algo):
+    k = 4
+    x = rank_data((N, k), np.int32)
+    shard = shard_map(
+        lambda v: algo(v[0], N)[None],
+        mesh=mesh,
+        in_specs=P(AXIS),
+        out_specs=P(AXIS),
+    )
+    out = np.asarray(jax.jit(shard)(x))
+    for r in range(N):
+        for j in range(N):
+            np.testing.assert_array_equal(out[r, j], x[j, r])
+
+
+# -- barrier / scan ----------------------------------------------------
+
+
+def test_barriers_complete(mesh):
+    out = run_spmd(
+        mesh, lambda v: cb.barrier_allreduce(N).astype(np.int32).reshape(1) + v[:1].astype(np.int32) * 0, np.zeros((N, 1), np.int32)
+    )
+    assert (out == N).all()
+    out = run_spmd(
+        mesh,
+        lambda v: cb.barrier_dissemination(N).reshape(1) + v[:1].astype(np.int32) * 0,
+        np.zeros((N, 1), np.int32),
+    )
+    assert (out > 0).all()
+
+
+def test_scan_inclusive_bit_exact(mesh):
+    x = rank_data((17,), np.float32, seed=11)
+    out = run_spmd(mesh, lambda v: cb.scan_ordered(v, SUM, N), x)
+    acc = x[0].copy()
+    assert np.array_equal(out[0].view(np.uint8), acc.view(np.uint8))
+    for r in range(1, N):
+        acc = acc + x[r]
+        assert np.array_equal(out[r].view(np.uint8), acc.view(np.uint8))
+
+
+def test_exscan(mesh):
+    x = np.round(rank_data((5,), np.float64))
+    out = run_spmd(mesh, lambda v: cb.scan_ordered(v, SUM, N, exclusive=True), x)
+    np.testing.assert_array_equal(out[0], np.zeros(5))
+    for r in range(1, N):
+        np.testing.assert_array_equal(out[r], x[:r].sum(0))

@@ -1,0 +1,291 @@
+"""Datatype engine + convertor tests.
+
+Ports the SHAPE of the reference's ``test/datatype`` corpus
+(``ddt_test.c``, ``ddt_raw.c`` — SURVEY.md §4: pack/unpack round-trips of
+derived datatypes, partial-pack restart, overlapping/strided layouts)
+against the TPU-native gather/scatter convertor.
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.errors import MPIArgError, MPITruncateError
+from ompi_tpu import ddt
+from ompi_tpu.ddt import Convertor, Datatype, create_struct, pack, unpack
+from ompi_tpu.ddt.datatype import DOUBLE, FLOAT, INT, BYTE
+
+
+def roundtrip(dt, count, src_bytes):
+    """pack src → unpack into zeroed dst → return dst (for golden compare)."""
+    packed = pack(src_bytes, dt, count)
+    dst = np.zeros_like(src_bytes)
+    unpack(dst, dt, count, packed)
+    return packed, dst
+
+
+# -- predefined basics -------------------------------------------------
+
+
+def test_predefined_properties():
+    assert FLOAT.size == 4 and FLOAT.extent == 4 and FLOAT.is_contiguous
+    assert DOUBLE.size == 8
+    assert INT.uniform_leaf == np.dtype(np.int32)
+    assert ddt.FLOAT_INT.size == 8  # float + int
+    assert ddt.FLOAT_INT.extent == 8
+
+
+def test_from_numpy_dtype():
+    assert ddt.from_numpy_dtype(np.float32) is FLOAT
+    assert ddt.from_numpy_dtype("int32") is INT
+    import ml_dtypes
+
+    assert ddt.from_numpy_dtype(ml_dtypes.bfloat16) is ddt.BFLOAT16
+
+
+def test_contiguous_pack_is_view():
+    src = np.arange(16, dtype=np.float32)
+    packed = pack(src, FLOAT, 16)
+    assert packed.size == 64
+    assert np.array_equal(packed.view(np.float32), src)
+
+
+# -- vector / hvector (ddt_test: strided columns) ----------------------
+
+
+def test_vector_pack_matches_numpy_stride():
+    # 4x6 float32 matrix; vector of one column: count=4, blocklen=1, stride=6
+    m = np.arange(24, dtype=np.float32).reshape(4, 6)
+    col = FLOAT.create_vector(4, 1, 6).commit()
+    assert col.size == 16
+    assert not col.is_contiguous
+    packed = pack(m, col, 1)
+    assert np.array_equal(packed.view(np.float32), m[:, 0])
+
+
+def test_vector_count_gt_one_interleaves_by_extent():
+    # count=2 of a 2-block vector; extent spans to end of last block
+    base = FLOAT.create_vector(2, 2, 4)  # blocks at elem 0-1 and 4-5, extent: lb..ub
+    src = np.arange(32, dtype=np.float32)
+    packed = pack(src, base, 2)
+    v = packed.view(np.float32)
+    # element 0: elems [0,1,4,5]; element 1 starts at extent bytes
+    ext_elems = base.extent // 4
+    expect = np.concatenate([src[[0, 1, 4, 5]], src[[0, 1, 4, 5]] + ext_elems])
+    assert np.array_equal(v, expect)
+
+
+def test_negative_stride_hvector():
+    dt = FLOAT.create_hvector(3, 1, -8)  # walk backwards every other float
+    assert dt.lb == -16
+    src = np.arange(8, dtype=np.float32)
+    # negative-lb types address bytes before the MPI buffer pointer: the
+    # caller passes an origin so those land inside the python buffer
+    with pytest.raises(MPIArgError):
+        Convertor(src, dt, 1)
+    packed = pack(src, dt, 1, origin=16)
+    assert np.array_equal(packed.view(np.float32), src[[4, 2, 0]])
+
+
+# -- indexed / hindexed (ddt_test: scattered blocks) -------------------
+
+
+def test_indexed_blocks():
+    dt = INT.create_indexed([2, 1, 3], [0, 4, 8]).commit()
+    src = np.arange(16, dtype=np.int32)
+    packed, dst = roundtrip(dt, 1, src)
+    assert np.array_equal(packed.view(np.int32), src[[0, 1, 4, 8, 9, 10]])
+    expect = np.zeros(16, np.int32)
+    expect[[0, 1, 4, 8, 9, 10]] = src[[0, 1, 4, 8, 9, 10]]
+    assert np.array_equal(dst, expect)
+
+
+def test_indexed_block_helper():
+    dt = FLOAT.create_indexed_block(2, [0, 4, 8])
+    src = np.arange(12, dtype=np.float32)
+    packed = pack(src, dt, 1)
+    assert np.array_equal(packed.view(np.float32), src[[0, 1, 4, 5, 8, 9]])
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(MPIArgError):
+        INT.create_indexed([1, 2], [0])
+
+
+# -- struct (ddt_test: mixed-type struct with padding) -----------------
+
+
+def test_struct_layout_and_roundtrip():
+    # struct { int a; double b; } — C layout: b at offset 8, extent 16
+    dt = create_struct([1, 1], [0, 8], [INT, DOUBLE]).commit()
+    assert dt.size == 12
+    assert dt.extent == 16  # padded to double alignment
+    raw = np.zeros(32, np.uint8)
+    raw[0:4] = np.array([7], np.int32).view(np.uint8)
+    raw[8:16] = np.array([3.5], np.float64).view(np.uint8)
+    raw[16:20] = np.array([9], np.int32).view(np.uint8)
+    raw[24:32] = np.array([-1.25], np.float64).view(np.uint8)
+    packed, dst = roundtrip(dt, 2, raw)
+    assert packed.size == 24
+    assert np.array_equal(packed[:4].view(np.int32), [7])
+    assert np.array_equal(packed[4:12].view(np.float64), [3.5])
+    assert np.array_equal(packed[12:16].view(np.int32), [9])
+    # unpack restored exactly the data bytes (gaps stay zero)
+    assert np.array_equal(dst[0:4], raw[0:4])
+    assert np.array_equal(dst[8:16], raw[8:16])
+    assert np.array_equal(dst[4:8], np.zeros(4, np.uint8))
+
+
+def test_struct_of_vectors():
+    inner = FLOAT.create_vector(2, 1, 3)
+    dt = create_struct([1, 1], [0, 64], [inner, INT]).commit()
+    src = np.zeros(128, np.uint8)
+    fsrc = src[:64].view(np.float32)
+    fsrc[:] = np.arange(16)
+    src[64:68] = np.array([42], np.int32).view(np.uint8)
+    packed = pack(src, dt, 1)
+    assert np.array_equal(packed[:8].view(np.float32), [0.0, 3.0])
+    assert np.array_equal(packed[8:12].view(np.int32), [42])
+
+
+# -- subarray (ddt corpus: 2D tile) ------------------------------------
+
+
+def test_subarray_c_order():
+    dt = INT.create_subarray([4, 5], [2, 3], [1, 1], order="C").commit()
+    m = np.arange(20, dtype=np.int32).reshape(4, 5)
+    packed = pack(m, dt, 1)
+    assert np.array_equal(packed.view(np.int32).reshape(2, 3), m[1:3, 1:4])
+    assert dt.extent == 20 * 4  # spans full array
+
+
+def test_subarray_f_order():
+    dt = INT.create_subarray([4, 5], [2, 3], [1, 1], order="F").commit()
+    # F order: first dim varies fastest; sizes[0]=4 rows stored col-major
+    m = np.arange(20, dtype=np.int32).reshape(5, 4).T.copy(order="C")
+    # build an F-layout buffer: element (i,j) at i + j*4
+    buf = np.zeros(20, np.int32)
+    for i in range(4):
+        for j in range(5):
+            buf[i + j * 4] = 100 * i + j
+    packed = pack(buf, dt, 1).view(np.int32)
+    expect = [100 * i + j for j in range(1, 4) for i in range(1, 3)]
+    assert np.array_equal(packed, expect)
+
+
+def test_subarray_bounds_check():
+    with pytest.raises(MPIArgError):
+        INT.create_subarray([4], [3], [2])
+
+
+# -- resized / extent semantics ----------------------------------------
+
+
+def test_resized_changes_stride():
+    dt = FLOAT.create_resized(0, 12).commit()  # one float every 12 bytes
+    src = np.arange(9, dtype=np.float32)
+    packed = pack(src, dt, 3)
+    assert np.array_equal(packed.view(np.float32), src[[0, 3, 6]])
+    assert dt.span(3) == 2 * 12 + 4
+
+
+def test_contiguous_of_resized():
+    dt = FLOAT.create_resized(0, 8).create_contiguous(3).commit()
+    src = np.arange(8, dtype=np.float32)
+    packed = pack(src, dt, 1)
+    assert np.array_equal(packed.view(np.float32), src[[0, 2, 4]])
+
+
+# -- partial pack / set_position (ddt_raw-style restart) ---------------
+
+
+def test_partial_pack_restart_mid_element():
+    dt = INT.create_indexed([2, 2], [0, 4]).commit()  # 16 bytes/elem packed
+    src = np.arange(24, dtype=np.int32)
+    c = Convertor(src, dt, 3)
+    assert c.packed_size == 48
+    chunks = []
+    # odd chunk size to split inside elements AND inside leaves
+    while not c.done:
+        chunks.append(c.pack(7))
+    whole = np.concatenate(chunks)
+    assert np.array_equal(whole, pack(src, dt, 3))
+
+    # restart from arbitrary position reproduces the suffix
+    c2 = Convertor(src, dt, 3)
+    c2.set_position(13)
+    assert np.array_equal(c2.pack(), whole[13:])
+
+
+def test_partial_unpack_stream():
+    dt = FLOAT.create_vector(4, 1, 2).commit()
+    src = np.arange(8, dtype=np.float32)
+    packed = pack(src, dt, 1)
+    dst = np.zeros(8, np.float32)
+    c = Convertor(dst, dt, 1)
+    for i in range(0, packed.size, 5):
+        c.unpack(packed[i : i + 5])
+    assert c.done
+    assert np.array_equal(dst[[0, 2, 4, 6]], src[[0, 2, 4, 6]])
+    assert np.array_equal(dst[[1, 3, 5, 7]], np.zeros(4, np.float32))
+
+
+def test_buffer_too_small_raises():
+    with pytest.raises(MPITruncateError):
+        Convertor(np.zeros(3, np.float32), FLOAT, 4)
+
+
+def test_unpack_overflow_raises():
+    dst = np.zeros(4, np.float32)
+    c = Convertor(dst, FLOAT, 4)
+    with pytest.raises(MPITruncateError):
+        c.unpack(np.zeros(17, np.uint8))
+
+
+# -- pack order is typemap order, not offset order ---------------------
+
+
+def test_pack_order_follows_typemap():
+    dt = INT.create_hindexed([1, 1], [8, 0]).commit()  # second block first
+    src = np.arange(4, dtype=np.int32)
+    packed = pack(src, dt, 1)
+    assert np.array_equal(packed.view(np.int32), [2, 0])
+
+
+# -- size/extent invariants across constructors ------------------------
+
+
+@pytest.mark.parametrize(
+    "dt,size,extent",
+    [
+        (FLOAT.create_contiguous(5), 20, 20),
+        (FLOAT.create_vector(3, 2, 4), 24, (2 * 4 + 2) * 4),
+        (INT.create_indexed([1, 2], [3, 0]), 12, 16),
+        (BYTE.create_contiguous(0), 0, 0),
+    ],
+)
+def test_size_extent(dt, size, extent):
+    assert dt.size == size
+    assert dt.extent == extent
+
+
+def test_negative_displacement_requires_origin():
+    """Negative lb types must error without origin (no silent wrap) and
+    pack correctly with one — regression."""
+    dt = ddt.DOUBLE.create_hindexed([1], [-8]).commit()
+    src = np.arange(4, dtype=np.float64)
+    with pytest.raises(MPIArgError):
+        pack(src, dt, 1)
+    packed = pack(src, dt, 1, origin=16)
+    assert np.array_equal(packed.view(np.float64), [1.0])
+    dst = np.zeros(4, np.float64)
+    unpack(dst, dt, 1, packed, origin=16)
+    assert dst[1] == 1.0 and dst.sum() == 1.0
+
+
+def test_contiguous_fast_path_validates_size():
+    """Contiguous pack/unpack must bounds-check like the general path —
+    regression (previously returned a silent short pack)."""
+    with pytest.raises(MPITruncateError):
+        pack(np.zeros(5, np.int32), ddt.INT, 100)
+    with pytest.raises(MPITruncateError):
+        unpack(np.zeros(2, np.int32), ddt.INT, 4, np.zeros(16, np.uint8))

