@@ -1,0 +1,122 @@
+"""OSU-style allreduce benchmark: framework vs raw ``lax.psum``.
+
+The BASELINE.json metric: ``osu_allreduce`` bus bandwidth across message
+sizes must reach ≥0.8× the RAW ``lax.psum`` bandwidth on the same mesh
+(the reference publishes no numbers of its own; the OSU suite is the
+conventional harness — SURVEY.md §6).  This driver measures, per message
+size, the latency of
+
+* the full framework path: ``COMM_WORLD.allreduce`` on pre-staged
+  device buffers — MCA table lookup + compiled-program cache + dispatch
+  (what OSU measures for the reference: MPI_Allreduce call overhead +
+  transport), and
+* raw ``jax.jit(shard_map(lax.psum))`` on the same buffers (the fabric
+  floor),
+
+and prints ONE json line with the geomean bandwidth ratio.
+``vs_baseline`` is value/0.8 (≥1.0 beats the north-star target).
+
+Runs on whatever fabric jax exposes: the real TPU chip (driver) or the
+virtual CPU mesh (local).  Message sizes are fp32 elements per rank,
+8 B – 4 MB by default (OSU's sweep, capped for wall-clock; override
+with --max-bytes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _best_time(fn, warmup: int = 2, iters: int = 10) -> float:
+    """Minimum wall time of fn() over iters runs (OSU reports averages;
+    min is more robust to tunnel jitter on this rig)."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run(max_bytes: int = 4 << 20, iters: int = 10) -> dict:
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import ompi_tpu.api as api
+    from ompi_tpu.mesh import AXIS
+    from ompi_tpu.op import SUM
+
+    world = api.init()
+    n = world.size
+    mesh = world.mesh.mesh
+
+    raw_psum = jax.jit(
+        shard_map(
+            lambda v: jax.lax.psum(v, AXIS),
+            mesh=mesh,
+            in_specs=P(AXIS),
+            out_specs=P(AXIS),
+        )
+    )
+
+    sizes = []
+    b = 8
+    while b <= max_bytes:
+        sizes.append(b)
+        b *= 8
+    results = []
+    for nbytes in sizes:
+        count = max(1, nbytes // 4)
+        x = world.mesh.stage_in(
+            np.random.RandomState(0).randn(n, count).astype(np.float32)
+        )
+        t_fw = _best_time(lambda: world.allreduce(x, SUM), iters=iters)
+        t_raw = _best_time(lambda: raw_psum(x), iters=iters)
+        # OSU bus bandwidth model for allreduce: 2*(n-1)/n * bytes / t
+        ratio = t_raw / t_fw if t_fw > 0 else 0.0
+        results.append(
+            {
+                "bytes": nbytes,
+                "t_framework_us": t_fw * 1e6,
+                "t_raw_psum_us": t_raw * 1e6,
+                "bw_ratio": ratio,
+            }
+        )
+    geomean = float(np.exp(np.mean([np.log(max(r["bw_ratio"], 1e-9)) for r in results])))
+    return {
+        "metric": "osu_allreduce_bw_ratio_vs_raw_psum",
+        "value": round(geomean, 4),
+        "unit": "ratio",
+        "vs_baseline": round(geomean / 0.8, 4),
+        "detail": results,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--max-bytes", type=int, default=4 << 20)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--detail", action="store_true", help="include per-size rows")
+    args = p.parse_args()
+    out = run(args.max_bytes, args.iters)
+    detail = out.pop("detail")
+    if args.detail:
+        for row in detail:
+            print(
+                f"# {row['bytes']:>10} B  fw {row['t_framework_us']:9.1f} us  "
+                f"raw {row['t_raw_psum_us']:9.1f} us  ratio {row['bw_ratio']:.3f}"
+            )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

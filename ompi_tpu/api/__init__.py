@@ -1,0 +1,74 @@
+"""MPI API layer (≈ ompi/mpi/c + ompi/runtime, SURVEY.md §3.2).
+
+``init()`` ≈ MPI_Init: builds the MCA context from ``--mca``-style
+params, brings up the persistent world mesh, and constructs COMM_WORLD
+(+ COMM_SELF). ``finalize()`` ≈ MPI_Finalize.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ompi_tpu.core import mca
+from ompi_tpu.core.errors import MPICommError
+from .comm import COLOR_UNDEFINED, Comm
+from .group import Group, UNDEFINED  # noqa: F401
+
+_world: Comm | None = None
+_self_comm: Comm | None = None
+_initialized = False
+
+
+def init(mca_params: dict[str, str] | None = None) -> Comm:
+    """MPI_Init: returns COMM_WORLD.
+
+    ``mca_params`` are ``--mca key value`` pairs (highest precedence,
+    like the mpirun command line). Idempotent once initialized (matching
+    MPI-4 sessions' tolerant init), but params only apply on the first
+    call.
+    """
+    global _world, _self_comm, _initialized
+    if _initialized and _world is not None:
+        return _world
+    # MPI_DOUBLE / 64-bit ints are first-class datatypes.
+    jax.config.update("jax_enable_x64", True)
+    if mca_params:
+        mca.init(mca_params)
+    ctx = mca.default_context()
+    ctx.open_all()
+    from ompi_tpu.mesh.mesh import world_mesh
+
+    wm = world_mesh()
+    _world = Comm(Group(range(wm.size)), wm, name="MPI_COMM_WORLD")
+    _self_comm = Comm(Group([0]), wm.submesh([0]), name="MPI_COMM_SELF")
+    _initialized = True
+    return _world
+
+
+def initialized() -> bool:
+    return _initialized
+
+
+def comm_world() -> Comm:
+    if _world is None:
+        raise MPICommError("call ompi_tpu.api.init() first")
+    return _world
+
+
+def comm_self() -> Comm:
+    if _self_comm is None:
+        raise MPICommError("call ompi_tpu.api.init() first")
+    return _self_comm
+
+
+def finalize() -> None:
+    """MPI_Finalize: free the world objects and close frameworks."""
+    global _world, _self_comm, _initialized
+    if _world is not None:
+        _world.free()
+        _world = None
+    if _self_comm is not None:
+        _self_comm.free()
+        _self_comm = None
+    _initialized = False
+    mca.reset()

@@ -1,0 +1,415 @@
+"""Communicators — the MPI object model over mesh + coll stack.
+
+≈ ``ompi/communicator/`` (``ompi_comm_*`` [bin]: create/dup/split, CID
+allocation, per-comm coll table; SURVEY.md §2.1, §3.2-"coll selection").
+
+Single-controller adaptation: one Python process drives every rank, so
+a ``Comm`` is the whole communicator, not one rank's view.  Buffers are
+**rank-major**: leading axis indexes the communicator rank.  Each comm
+owns a sub-``CommMesh`` (its ranks' devices) and a coll table stacked
+from the selected coll components (xla → fabric, basic → host/jagged),
+rebuilt per communicator exactly like comm_select in the reference.
+
+Buffer flavors: numpy in → numpy out (staged through the mesh — the
+accelerator H2D/D2H path); jax array in → jax array out (stays on
+fabric).  Datatype-typed byte buffers go through the ``*_ddt`` entry
+points, which run the convertor (pack → fabric op on leaf dtype →
+unpack), the analog of ob1's convertor staging in SURVEY.md §3.3.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from ompi_tpu.core import mca
+from ompi_tpu.core.errors import (
+    MPIArgError,
+    MPICommError,
+    MPIKeyvalError,
+    MPIRankError,
+    MPIRootError,
+    MPITypeError,
+)
+from ompi_tpu.coll.module import CollTable, select_coll_modules
+from ompi_tpu.ddt.convertor import pack as ddt_pack, unpack as ddt_unpack
+from ompi_tpu.ddt.datatype import Datatype
+from ompi_tpu.mesh.mesh import CommMesh
+from ompi_tpu.op.op import SUM, Op
+from ompi_tpu.request import Request
+from .group import Group, UNDEFINED
+
+#: MPI_Comm_split color for "give me no communicator"
+COLOR_UNDEFINED = UNDEFINED
+
+_cid_counter = itertools.count(0)
+_cid_lock = threading.Lock()
+
+
+def _next_cid() -> int:
+    """CID allocation (≈ ompi_comm_nextcid; trivially collision-free in
+    a single controller)."""
+    with _cid_lock:
+        return next(_cid_counter)
+
+
+class Comm:
+    """An intra-communicator."""
+
+    def __init__(self, group: Group, mesh: CommMesh, name: str = ""):
+        if group.size != mesh.size:
+            raise MPICommError(
+                f"group size {group.size} != mesh size {mesh.size}"
+            )
+        self.group = group
+        self.mesh = mesh
+        self.cid = _next_cid()
+        self.name = name or f"comm#{self.cid}"
+        self._coll: CollTable | None = None
+        self._attrs: dict[int, Any] = {}
+        self._freed = False
+
+    # -- basics --------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def _check(self):
+        if self._freed:
+            raise MPICommError(f"{self.name} has been freed")
+
+    @property
+    def coll(self) -> CollTable:
+        """Per-comm coll table, built on first use (≈ comm_select at
+        comm construction; lazy keeps comm creation cheap)."""
+        self._check()
+        if self._coll is None:
+            ctx = mca.default_context()
+            self._coll = select_coll_modules(self, ctx.framework("coll"))
+        return self._coll
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    # -- attribute caching (MPI_Comm_set_attr family) -------------------
+
+    def set_attr(self, keyval: int, value: Any) -> None:
+        self._check()
+        self._attrs[keyval] = value
+
+    def get_attr(self, keyval: int) -> Any:
+        self._check()
+        if keyval not in self._attrs:
+            raise MPIKeyvalError(f"no attribute {keyval}")
+        return self._attrs[keyval]
+
+    def delete_attr(self, keyval: int) -> None:
+        self._check()
+        self._attrs.pop(keyval, None)
+
+    # -- construction (dup/split/create) --------------------------------
+
+    def dup(self, name: str = "") -> "Comm":
+        self._check()
+        return Comm(Group(self.group.ranks), self.mesh, name or f"{self.name}.dup")
+
+    def create_group(self, group: Group, name: str = "") -> "Comm | None":
+        """MPI_Comm_create_group: new comm over a subset of this comm's
+        ranks (group ranks are THIS comm's ranks)."""
+        self._check()
+        for r in group.ranks:
+            if not 0 <= r < self.size:
+                raise MPIRankError(f"rank {r} outside {self.name}")
+        if group.size == 0:
+            return None
+        sub = self.mesh.submesh(group.ranks)
+        world_ranks = [self.group.ranks[r] for r in group.ranks]
+        return Comm(Group(world_ranks), sub, name)
+
+    def split(self, colors: Sequence[int], keys: Sequence[int] | None = None) -> list["Comm | None"]:
+        """MPI_Comm_split, whole-communicator view: ``colors[r]`` /
+        ``keys[r]`` are rank r's arguments; returns per-rank comms
+        (ranks sharing a color share the object; COLOR_UNDEFINED → None).
+        Rank order within a color: (key, old rank), per the standard."""
+        self._check()
+        if len(colors) != self.size:
+            raise MPIArgError("colors length != comm size")
+        if keys is None:
+            keys = [0] * self.size
+        if len(keys) != self.size:
+            raise MPIArgError("keys length != comm size")
+        by_color: dict[int, list[int]] = {}
+        for r, c in enumerate(colors):
+            if c == COLOR_UNDEFINED:
+                continue
+            if c < 0:
+                raise MPIArgError(f"negative color {c}")
+            by_color.setdefault(c, []).append(r)
+        out: list[Comm | None] = [None] * self.size
+        for c, members in sorted(by_color.items()):
+            members.sort(key=lambda r: (keys[r], r))
+            comm = self.create_group(Group(members), name=f"{self.name}.split({c})")
+            for r in members:
+                out[r] = comm
+        return out
+
+    def split_type_shared(self) -> "Comm":
+        """MPI_Comm_split_type(MPI_COMM_TYPE_SHARED): single-host/
+        single-slice → everything is one shared domain."""
+        return self.dup(name=f"{self.name}.shared")
+
+    def free(self) -> None:
+        self._check()
+        if self._coll is not None:
+            for m in self._coll.modules:
+                m.disable()
+        self._coll = None
+        self._freed = True
+
+    # -- buffer staging -------------------------------------------------
+
+    def _stage(self, x, depth_expected: int):
+        """Normalize a rank-major input; returns (device_array, was_host)."""
+        if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+            return x, False
+        arr = np.asarray(x)
+        if arr.ndim < depth_expected or arr.shape[0] != self.size:
+            raise MPIArgError(
+                f"rank-major buffer must have shape ({self.size}, ...); got {arr.shape}"
+            )
+        return self.mesh.stage_in(arr), True
+
+    def _unstage(self, out, was_host: bool):
+        return self.mesh.stage_out(out) if was_host else out
+
+    def _check_root(self, root: int) -> None:
+        if not 0 <= root < self.size:
+            raise MPIRootError(f"root {root} not in [0, {self.size})")
+
+    def _check_op(self, op: Op, x) -> None:
+        """Arg-check layer (≈ ompi/mpi/c/<coll>.c): reject op × dtype
+        combinations the standard forbids BEFORE they reach XLA tracing."""
+        if not isinstance(op, Op):
+            raise MPIArgError(f"op must be an ompi_tpu Op, got {type(op)}")
+        dtype = getattr(x, "dtype", None)
+        if dtype is not None:
+            from ompi_tpu.ddt.datatype import from_numpy_dtype
+
+            op.check(from_numpy_dtype(dtype))
+
+    # -- collectives (ndarray API) --------------------------------------
+    # Each entry point: arg-check (≈ ompi/mpi/c/<coll>.c) then dispatch
+    # through the comm's coll table (≈ comm->c_coll->coll_<op>).
+
+    def allreduce(self, x, op: Op = SUM):
+        self._check_op(op, x)
+        xd, host = self._stage(x, 1)
+        return self._unstage(self.coll.lookup("allreduce")(xd, op), host)
+
+    def iallreduce(self, x, op: Op = SUM) -> Request:
+        self._check_op(op, x)
+        xd, host = self._stage(x, 1)
+        req = self.coll.lookup("iallreduce")(xd, op)
+        return _wrap_unstage(req, self, host)
+
+    def allreduce_init(self, x, op: Op = SUM) -> Request:
+        xd, _ = self._stage(x, 1)
+        return self.coll.lookup("allreduce_init")(xd, op)
+
+    def bcast(self, x, root: int = 0):
+        self._check_root(root)
+        xd, host = self._stage(x, 1)
+        return self._unstage(self.coll.lookup("bcast")(xd, root), host)
+
+    def ibcast(self, x, root: int = 0) -> Request:
+        self._check_root(root)
+        xd, host = self._stage(x, 1)
+        return _wrap_unstage(self.coll.lookup("ibcast")(xd, root), self, host)
+
+    def reduce(self, x, op: Op = SUM, root: int = 0):
+        """Returns the reduced array (the standard says only root's
+        recvbuf is defined; single-controller returns it once)."""
+        self._check_op(op, x)
+        self._check_root(root)
+        xd, host = self._stage(x, 1)
+        out = self.coll.lookup("reduce")(xd, op, root)
+        out = self._unstage(out, host)
+        return out[root] if hasattr(out, "__getitem__") else out
+
+    def allgather(self, x):
+        xd, host = self._stage(x, 1)
+        return self._unstage(self.coll.lookup("allgather")(xd), host)
+
+    def iallgather(self, x) -> Request:
+        xd, host = self._stage(x, 1)
+        return _wrap_unstage(self.coll.lookup("iallgather")(xd), self, host)
+
+    def gather(self, x, root: int = 0):
+        """Returns root's recvbuf: (n, *s) gathered blocks."""
+        self._check_root(root)
+        xd, host = self._stage(x, 1)
+        out = self.coll.lookup("gather")(xd, root)
+        out = self._unstage(out, host)
+        return out[root]
+
+    def scatter(self, x, root: int = 0):
+        """x: root's sendbuf (n, *s); returns (n, *s) rank-major (row r
+        is rank r's recvbuf)."""
+        self._check_root(root)
+        xd, host = self._stage(x, 1)
+        return self._unstage(self.coll.lookup("scatter")(xd, root), host)
+
+    def reduce_scatter_block(self, x, op: Op = SUM):
+        self._check_op(op, x)
+        xd, host = self._stage(x, 2)
+        return self._unstage(self.coll.lookup("reduce_scatter_block")(xd, op), host)
+
+    def reduce_scatter(self, x, op: Op = SUM, counts: Sequence[int] | None = None):
+        """MPI_Reduce_scatter. ``counts`` per-rank receive counts:
+        jagged → host path (list results); equal counts c → each rank's
+        (n*c, *tail) sendbuf is reshaped to blocks and reduced on the
+        fabric, returning (n, c, *tail); counts=None → x is already in
+        block form (n, n, *s)."""
+        self._check_op(op, x)
+        if counts is not None:
+            if len(counts) != self.size:
+                raise MPIArgError("reduce_scatter counts length != comm size")
+            if len(set(counts)) > 1:
+                # jagged → host path via the table (lists)
+                return self.coll.lookup("reduce_scatter")(np.asarray(x), op, counts)
+            c = counts[0]
+            arr = np.asarray(x) if not isinstance(x, jax.Array) else x
+            if arr.shape[1] != self.size * c:
+                raise MPIArgError(
+                    f"reduce_scatter sendbuf dim1 {arr.shape[1]} != n*count "
+                    f"{self.size * c}"
+                )
+            blocks = arr.reshape((self.size, self.size, c) + arr.shape[2:])
+            xd, host = self._stage(blocks, 2)
+            out = self.coll.lookup("reduce_scatter_block")(xd, op)
+            return self._unstage(out, host)
+        xd, host = self._stage(x, 2)
+        return self._unstage(self.coll.lookup("reduce_scatter")(xd, op, None), host)
+
+    def alltoall(self, x):
+        xd, host = self._stage(x, 2)
+        return self._unstage(self.coll.lookup("alltoall")(xd), host)
+
+    def ialltoall(self, x) -> Request:
+        xd, host = self._stage(x, 2)
+        return _wrap_unstage(self.coll.lookup("ialltoall")(xd), self, host)
+
+    def scan(self, x, op: Op = SUM):
+        self._check_op(op, x)
+        xd, host = self._stage(x, 1)
+        return self._unstage(self.coll.lookup("scan")(xd, op), host)
+
+    def exscan(self, x, op: Op = SUM):
+        self._check_op(op, x)
+        xd, host = self._stage(x, 1)
+        return self._unstage(self.coll.lookup("exscan")(xd, op), host)
+
+    def barrier(self) -> None:
+        self.coll.lookup("barrier")()
+
+    def ibarrier(self) -> Request:
+        return self.coll.lookup("ibarrier")()
+
+    # jagged variants (host path)
+    def allgatherv(self, blocks: Sequence[np.ndarray]):
+        if len(blocks) != self.size:
+            raise MPIArgError("allgatherv needs one block per rank")
+        return self.coll.lookup("allgatherv")(blocks)
+
+    def alltoallv(self, matrix: Sequence[Sequence[np.ndarray]]):
+        if len(matrix) != self.size:
+            raise MPIArgError("alltoallv needs n rows")
+        return self.coll.lookup("alltoallv")(matrix)
+
+    def gatherv(self, blocks: Sequence[np.ndarray], root: int = 0):
+        self._check_root(root)
+        return self.coll.lookup("gatherv")(blocks, root)
+
+    def scatterv(self, blocks: Sequence[np.ndarray], root: int = 0):
+        self._check_root(root)
+        return self.coll.lookup("scatterv")(blocks, root)
+
+    # -- datatype (convertor) entry points ------------------------------
+
+    def allreduce_ddt(
+        self,
+        sendbufs: Sequence[Any],
+        count: int,
+        datatype: Datatype,
+        op: Op = SUM,
+        recvbufs: Sequence[Any] | None = None,
+    ):
+        """MPI_Allreduce over typed byte buffers: per-rank buffers are
+        packed via the convertor (derived datatypes → gather), reduced
+        on the fabric in leaf dtype, and unpacked into ``recvbufs``
+        (or fresh packed arrays are returned).
+
+        ≈ SURVEY.md §3.3: convertor_pack → transport → op → unpack, with
+        the transport collapsed into the fabric collective."""
+        op.check(datatype)
+        if len(sendbufs) != self.size:
+            raise MPIArgError("one send buffer per rank required")
+        if datatype.uniform_leaf is None:
+            raise MPITypeError("reductions need a uniform-leaf datatype")
+        leaf = datatype.uniform_leaf
+        packed = [
+            ddt_pack(b, datatype, count).view(leaf) for b in sendbufs
+        ]
+        stacked = np.stack(packed)  # (n, count*leaves)
+        red = self.allreduce(stacked, op)
+        red = np.asarray(red)
+        if recvbufs is not None:
+            if len(recvbufs) != self.size:
+                raise MPIArgError("one recv buffer per rank required")
+            for r in range(self.size):
+                ddt_unpack(
+                    recvbufs[r], datatype, count,
+                    np.ascontiguousarray(red[r]).view(np.uint8),
+                )
+            return recvbufs
+        return red
+
+    def bcast_ddt(self, buf, count: int, datatype: Datatype, root: int = 0):
+        """Typed bcast: packs root's buffer, broadcasts, returns per-rank
+        unpacked byte buffers."""
+        self._check_root(root)
+        packed = ddt_pack(buf, datatype, count)
+        stacked = np.stack([packed] * self.size)
+        out = np.asarray(self.bcast(stacked, root))
+        bufs = []
+        for r in range(self.size):
+            dst = np.zeros(datatype.lb + datatype.span(count), np.uint8)
+            ddt_unpack(dst, datatype, count, np.ascontiguousarray(out[r]))
+            bufs.append(dst)
+        return bufs
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<Comm {self.name} size={self.size} cid={self.cid}>"
+
+
+def _wrap_unstage(req: Request, comm: Comm, was_host: bool) -> Request:
+    """Chain a D2H unstage onto a device request for host callers."""
+    if not was_host:
+        return req
+
+    class _Unstage(Request):
+        def _poll(self):
+            return req.test()
+
+        def _block(self):
+            req.wait()
+
+        def _finalize(self):
+            return comm.mesh.stage_out(req.wait())
+
+    return _Unstage()

@@ -1,0 +1,109 @@
+"""MPI groups (≈ ompi/group/ [src], SURVEY.md §2.1).
+
+A group is an ordered set of world ranks; communicators are built from
+groups. All MPI group set-algebra operations are provided; results
+preserve MPI's ordering rules (operations order elements by their rank
+in the FIRST group, then remaining from the second).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from ompi_tpu.core.errors import MPIArgError, MPIRankError
+
+#: MPI_UNDEFINED for translate_ranks misses
+UNDEFINED = -32766
+
+# MPI_Group_compare results
+IDENT = 0
+SIMILAR = 1
+UNEQUAL = 2
+
+
+class Group:
+    __slots__ = ("ranks",)
+
+    def __init__(self, ranks: Sequence[int]):
+        if len(set(ranks)) != len(ranks):
+            raise MPIArgError("group ranks must be distinct")
+        self.ranks = tuple(int(r) for r in ranks)
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank_of(self, world_rank: int) -> int:
+        """Group rank of a world rank, or UNDEFINED."""
+        try:
+            return self.ranks.index(world_rank)
+        except ValueError:
+            return UNDEFINED
+
+    # -- MPI_Group_* operations ----------------------------------------
+
+    def translate_ranks(self, ranks: Iterable[int], other: "Group") -> list[int]:
+        out = []
+        for r in ranks:
+            if not 0 <= r < self.size:
+                raise MPIRankError(f"rank {r} not in group of size {self.size}")
+            out.append(other.rank_of(self.ranks[r]))
+        return out
+
+    def compare(self, other: "Group") -> int:
+        if self.ranks == other.ranks:
+            return IDENT
+        if set(self.ranks) == set(other.ranks):
+            return SIMILAR
+        return UNEQUAL
+
+    def union(self, other: "Group") -> "Group":
+        seen = list(self.ranks)
+        for r in other.ranks:
+            if r not in self.ranks:
+                seen.append(r)
+        return Group(seen)
+
+    def intersection(self, other: "Group") -> "Group":
+        return Group([r for r in self.ranks if r in other.ranks])
+
+    def difference(self, other: "Group") -> "Group":
+        return Group([r for r in self.ranks if r not in other.ranks])
+
+    def incl(self, ranks: Sequence[int]) -> "Group":
+        for r in ranks:
+            if not 0 <= r < self.size:
+                raise MPIRankError(f"rank {r} not in group of size {self.size}")
+        return Group([self.ranks[r] for r in ranks])
+
+    def excl(self, ranks: Sequence[int]) -> "Group":
+        drop = set(ranks)
+        for r in drop:
+            if not 0 <= r < self.size:
+                raise MPIRankError(f"rank {r} not in group of size {self.size}")
+        return Group([wr for i, wr in enumerate(self.ranks) if i not in drop])
+
+    def range_incl(self, ranges: Sequence[tuple[int, int, int]]) -> "Group":
+        sel: list[int] = []
+        for first, last, stride in ranges:
+            if stride == 0:
+                raise MPIArgError("zero stride")
+            r = first
+            while (stride > 0 and r <= last) or (stride < 0 and r >= last):
+                sel.append(r)
+                r += stride
+        return self.incl(sel)
+
+    def range_excl(self, ranges: Sequence[tuple[int, int, int]]) -> "Group":
+        sel: list[int] = []
+        for first, last, stride in ranges:
+            if stride == 0:
+                raise MPIArgError("zero stride")
+            r = first
+            while (stride > 0 and r <= last) or (stride < 0 and r >= last):
+                sel.append(r)
+                r += stride
+        return self.excl(sel)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Group{self.ranks}"

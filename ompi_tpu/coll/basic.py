@@ -1,0 +1,169 @@
+"""``coll/basic`` — always-available host-path fallback collectives.
+
+≈ the reference's ``coll/basic`` (naive linear algorithms, the fallback
+every communicator can rely on, SURVEY.md §2.2).  Runs on host numpy in
+rank-sequential order — which makes it simultaneously:
+
+* the lowest-priority fallback for anything ``coll/xla`` does not serve
+  (jagged v-variants, exotic datatypes),
+* the in-tree golden reference for bit-exactness (its fold order IS the
+  parity order the CPU reference produces).
+
+Inputs are rank-major like the device path; jax arrays are pulled to
+host. i-variants complete eagerly (legal MPI semantics: non-blocking
+calls may complete at any time).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from ompi_tpu.core.registry import Component, register_component
+from ompi_tpu.core.errors import MPIArgError
+from ompi_tpu.op.op import Op, ordered_reduce_np
+from ompi_tpu.request import CompletedRequest, PersistentRequest, Request
+from .module import COLL_OPS, CollModule
+
+
+def _host(x):
+    if isinstance(x, np.ndarray):
+        return x
+    if isinstance(x, (list, tuple)):
+        return [_host(e) for e in x]
+    return np.asarray(x)
+
+
+class BasicCollModule(CollModule):
+    """Rank-sequential host implementations of every collective."""
+
+    # -- reductions ----------------------------------------------------
+
+    def allreduce(self, x, op: Op):
+        x = _host(x)
+        red = ordered_reduce_np(x, op)
+        return np.broadcast_to(red, x.shape).copy()
+
+    def reduce(self, x, op: Op, root: int = 0):
+        return self.allreduce(x, op)
+
+    def reduce_scatter_block(self, x, op: Op):
+        x = _host(x)  # (n, n, *s)
+        red = ordered_reduce_np(x, op)  # (n, *s)
+        return red
+
+    def reduce_scatter(self, x, op: Op, counts: Sequence[int] | None = None):
+        x = _host(x)
+        if counts is None:
+            return self.reduce_scatter_block(x, op)
+        n = len(x)
+        if len(counts) != n:
+            raise MPIArgError("reduce_scatter counts length != comm size")
+        # x[r]: flat (sum(counts), *tail); rank j receives its segment
+        red = ordered_reduce_np(x, op)
+        out, off = [], 0
+        for c in counts:
+            out.append(red[off : off + c])
+            off += c
+        return out
+
+    def scan(self, x, op: Op):
+        x = _host(x)
+        out = np.empty_like(x)
+        acc = x[0].copy()
+        out[0] = acc
+        for r in range(1, x.shape[0]):
+            acc = op.np_fn(acc, x[r])
+            out[r] = acc
+        return out
+
+    def exscan(self, x, op: Op):
+        x = _host(x)
+        out = np.zeros_like(x)
+        if x.shape[0] > 1:
+            acc = x[0].copy()
+            out[1] = acc
+            for r in range(2, x.shape[0]):
+                acc = op.np_fn(acc, x[r - 1])
+                out[r] = acc
+        return out
+
+    # -- data movement -------------------------------------------------
+
+    def bcast(self, x, root: int = 0):
+        x = _host(x)
+        return np.broadcast_to(x[root], x.shape).copy()
+
+    def allgather(self, x):
+        x = _host(x)  # (n, *s)
+        return np.broadcast_to(x[None], (x.shape[0],) + x.shape).copy()
+
+    def gather(self, x, root: int = 0):
+        return self.allgather(x)
+
+    def scatter(self, x, root: int = 0):
+        return _host(x).copy()
+
+    def alltoall(self, x):
+        x = _host(x)  # (n, n, *s)
+        return np.swapaxes(x, 0, 1).copy()
+
+    def barrier(self):
+        return None
+
+    # -- jagged v-variants (lists of per-rank arrays) -------------------
+
+    def allgatherv(self, blocks: Sequence[np.ndarray]):
+        """blocks[r]: rank r's contribution (any per-rank length);
+        returns the gathered list (identical on every rank)."""
+        return [_host(b).copy() for b in blocks]
+
+    def gatherv(self, blocks: Sequence[np.ndarray], root: int = 0):
+        return self.allgatherv(blocks)
+
+    def scatterv(self, blocks: Sequence[np.ndarray], root: int = 0):
+        return [_host(b).copy() for b in blocks]
+
+    def alltoallv(self, matrix: Sequence[Sequence[np.ndarray]]):
+        """matrix[r][j]: block from rank r to rank j (jagged);
+        returns out with out[j][r] = matrix[r][j]."""
+        n = len(matrix)
+        for row in matrix:
+            if len(row) != n:
+                raise MPIArgError("alltoallv matrix must be n x n")
+        return [[_host(matrix[r][j]).copy() for r in range(n)] for j in range(n)]
+
+    # -- derived non-blocking / persistent slots ------------------------
+
+    def __getattr__(self, name: str):
+        # i<op> → eager completion; <op>_init → persistent wrapper.
+        if name.startswith("i") and name[1:] in COLL_OPS:
+            blocking = getattr(self, name[1:])
+
+            def ivariant(*a, **k) -> Request:
+                return CompletedRequest(blocking(*a, **k))
+
+            return ivariant
+        if name.endswith("_init") and name[: -len("_init")] in COLL_OPS:
+            blocking = getattr(self, name[: -len("_init")])
+
+            def init_variant(*a, **k) -> PersistentRequest:
+                return PersistentRequest(
+                    lambda: CompletedRequest(blocking(*a, **k))
+                )
+
+            return init_variant
+        raise AttributeError(name)
+
+
+@register_component
+class BasicCollComponent(Component):
+    """``coll/basic`` MCA component — priority 10, always usable."""
+
+    FRAMEWORK = "coll"
+    NAME = "basic"
+    PRIORITY = 10
+
+    def query(self, comm) -> BasicCollModule | None:
+        return BasicCollModule(comm)

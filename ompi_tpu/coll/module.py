@@ -1,0 +1,133 @@
+"""Collective module interface + per-communicator selection/stacking.
+
+TPU-native re-design of ``mca_coll_base_module_t`` (``ompi/mca/coll/
+coll.h`` [src]) and the stacking selection of
+``ompi/mca/coll/base/coll_base_comm_select.c`` (SURVEY.md §2.2):
+
+* each coll component's ``query(comm)`` returns a **module** (or None if
+  it cannot serve this communicator);
+* a module provides any SUBSET of the collective operations;
+* modules are applied in ascending priority order, each overwriting the
+  slots it provides — so the highest-priority provider of each op wins,
+  and e.g. ``coll/xla`` can supply the fabric collectives while
+  ``coll/basic`` backfills the jagged v-variants, exactly how tuned+
+  libnbc+basic stack in the reference.
+
+Data convention (rank-major, device path): every buffer argument is a
+jax array whose leading axis is the communicator rank —
+``allreduce: (n,*s)→(n,*s)`` (identical rows), ``allgather: (n,*s)→
+(n,n,*s)``, ``scatter/reduce_scatter_block: (n,n,*s)→(n,*s)``,
+``alltoall: (n,n,*s)→(n,n,*s)``.  Root-only semantics (which rank's
+row is meaningful) live in the API layer; keeping the rank axis makes
+every op a pure SPMD function over the comm's mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ompi_tpu.core.errors import MPIInternalError
+
+#: every collective operation slot (blocking form). i-variants and
+#: persistent *_init variants are derived slots: "i"+name, name+"_init".
+COLL_OPS = (
+    "allreduce",
+    "bcast",
+    "reduce",
+    "allgather",
+    "allgatherv",
+    "gather",
+    "gatherv",
+    "scatter",
+    "scatterv",
+    "reduce_scatter",
+    "reduce_scatter_block",
+    "alltoall",
+    "alltoallv",
+    "barrier",
+    "scan",
+    "exscan",
+)
+
+
+def all_slots() -> list[str]:
+    slots = []
+    for op in COLL_OPS:
+        slots.append(op)
+        slots.append("i" + op)
+        slots.append(op + "_init")
+    return slots
+
+
+class CollModule:
+    """One component's per-communicator module (≈ mca_coll_base_module_t).
+
+    Subclasses implement some subset of the slot names from
+    :func:`all_slots` as methods; ``provided()`` reports which.
+    """
+
+    def __init__(self, comm):
+        self.comm = comm
+
+    def enable(self) -> None:
+        """Called once the module won ≥1 slot (≈ coll_module_enable)."""
+
+    def disable(self) -> None:
+        pass
+
+    def provided(self) -> dict[str, Callable[..., Any]]:
+        out = {}
+        for slot in all_slots():
+            fn = getattr(self, slot, None)
+            if callable(fn):
+                out[slot] = fn
+        return out
+
+
+class CollTable:
+    """The per-communicator function-pointer table (≈ comm->c_coll)."""
+
+    def __init__(self):
+        self.slots: dict[str, Callable[..., Any]] = {}
+        self.providers: dict[str, str] = {}  # slot -> component name
+        self.modules: list[CollModule] = []
+
+    def lookup(self, slot: str):
+        fn = self.slots.get(slot)
+        if fn is None:
+            raise MPIInternalError(
+                f"no coll component provides {slot!r} on this communicator"
+            )
+        return fn
+
+
+def select_coll_modules(comm, framework) -> CollTable:
+    """Build the comm's coll table by stacking module slots.
+
+    ≈ mca_coll_base_comm_select: query every opened component, sort by
+    priority ASCENDING, overwrite slots so the highest priority wins.
+    Raises if any op ends up unserved (the reference aborts with
+    "no available collective components" show_help).
+    """
+    table = CollTable()
+    comps = sorted(framework.selectable(), key=lambda c: (c.priority, c.NAME))
+    for comp in comps:
+        query = getattr(comp, "query", None)
+        if query is None:
+            continue
+        module = query(comm)
+        if module is None:
+            continue
+        table.modules.append(module)
+        for slot, fn in module.provided().items():
+            table.slots[slot] = fn
+            table.providers[slot] = comp.NAME
+    missing = [op for op in COLL_OPS if op not in table.slots]
+    if missing:
+        raise MPIInternalError(
+            f"no coll component provides {missing} for this communicator "
+            f"(components queried: {[c.NAME for c in comps]})"
+        )
+    for m in table.modules:
+        m.enable()
+    return table

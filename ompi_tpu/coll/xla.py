@@ -1,0 +1,526 @@
+"""``coll/xla`` — the TPU-fabric collective component (the centerpiece).
+
+This is the component the north star names: the
+``mca_coll_base_module_t`` entry points for Allreduce/Bcast/Allgather/
+Reduce_scatter/Alltoall dispatching to ``jax.lax`` collectives executed
+over the communicator's persistent mesh (BASELINE.json; reference peers:
+``coll/tuned`` decision layer + ``coll/base`` algorithms +
+``coll/libnbc`` non-blocking, SURVEY.md §2.2).
+
+Design:
+
+* every collective is a **jitted shard_map program** over the comm's
+  mesh, built once per (op, algorithm, shape, dtype) and cached — the
+  analog of tuned's per-comm decision table plus XLA's compiled
+  executables; re-dispatch is O(1) Python overhead;
+* the **algorithm registry** mirrors tuned's per-collective algorithm
+  enums ([bin] ``coll_tuned_<coll>_algorithms``) as MCA enum vars, e.g.
+  ``--mca coll_xla_allreduce_algorithm ring``;
+* ``auto`` applies a tuned-style decision: fused fabric primitive
+  (psum/pmax/pmin/all_gather/all_to_all/psum_scatter) when the op
+  allows, ordered fallback otherwise;
+* ``--mca coll_xla_reproducible 1`` forces the bit-exact rank-ordered
+  paths (≈ ``mca_coll_han_allreduce_reproducible``);
+* non-blocking i-variants return :class:`ArrayRequest` wrapping the
+  async XLA dispatch (libnbc schedule ↔ XLA program, request ↔ future);
+  persistent ``*_init`` return :class:`PersistentRequest`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ompi_tpu.core.registry import Component, register_component
+from ompi_tpu.core.errors import MPIOpError
+from ompi_tpu.mesh import AXIS
+from ompi_tpu.op.op import Op
+from ompi_tpu.request import ArrayRequest, PersistentRequest, Request
+from . import base as algos
+from .module import CollModule
+
+# Algorithm enums (names follow coll_tuned_*_algorithm_count conventions).
+ALLREDUCE_ALGOS = {
+    "auto": 0,
+    "psum": 1,
+    "ring": 2,
+    "ring_segmented": 3,
+    "recursive_doubling": 4,
+    "rabenseifner": 5,
+    "ordered_linear": 6,
+}
+BCAST_ALGOS = {"auto": 0, "direct": 1, "binomial": 2, "pipeline": 3}
+ALLGATHER_ALGOS = {"auto": 0, "direct": 1, "ring": 2, "bruck": 3}
+ALLTOALL_ALGOS = {"auto": 0, "direct": 1, "pairwise": 2}
+REDUCE_SCATTER_ALGOS = {"auto": 0, "direct": 1, "ring": 2}
+REDUCE_ALGOS = {"auto": 0, "binomial": 1, "ordered": 2}
+BARRIER_ALGOS = {"auto": 0, "allreduce": 1, "dissemination": 2}
+
+
+class XlaCollModule(CollModule):
+    """Per-communicator module: compiled-collective cache over the mesh."""
+
+    def __init__(self, comm, component: "XlaCollComponent"):
+        super().__init__(comm)
+        self.component = component
+        self._cache: dict[tuple, Callable] = {}
+
+    # -- compiled-program factory ---------------------------------------
+
+    def _compiled(self, key: tuple, builder: Callable[[], Callable]) -> Callable:
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._cache[key] = fn
+        return fn
+
+    def _spmd(self, per_device_fn, nin: int = 1):
+        """jit(shard_map(...)) over the comm mesh: each input/output is
+        rank-major with leading axis = comm size."""
+        mesh = self.comm.mesh.mesh
+        specs = [P(AXIS)] * nin
+        f = shard_map(
+            per_device_fn,
+            mesh=mesh,
+            in_specs=tuple(specs) if nin > 1 else specs[0],
+            out_specs=P(AXIS),
+        )
+        return jax.jit(f)
+
+    def _n(self) -> int:
+        return self.comm.size
+
+    def _algo(self, var: str, enum: dict[str, int], default: str = "auto") -> int:
+        store = self.component.store
+        v = store.get(f"coll_xla_{var}", enum[default])
+        return v
+
+    def _reproducible(self) -> bool:
+        return bool(self.component.store.get("coll_xla_reproducible", False))
+
+    def _segcount(self) -> int:
+        return int(self.component.store.get("coll_xla_segcount", 1 << 16))
+
+    # ==================================================================
+    # allreduce
+    # ==================================================================
+
+    def _allreduce_fn(self, x, op: Op):
+        n = self._n()
+        algo = self._algo("allreduce_algorithm", ALLREDUCE_ALGOS)
+        if self._reproducible():
+            algo = ALLREDUCE_ALGOS["ordered_linear"]
+        if algo == ALLREDUCE_ALGOS["auto"]:
+            if op.lax_collective is not None and op.commutative:
+                algo = ALLREDUCE_ALGOS["psum"]
+            else:
+                algo = ALLREDUCE_ALGOS["ordered_linear"]
+        if algo == ALLREDUCE_ALGOS["psum"] and op.lax_collective is None:
+            algo = ALLREDUCE_ALGOS["ring"]
+        if algo == ALLREDUCE_ALGOS["rabenseifner"] and (n & (n - 1)):
+            algo = ALLREDUCE_ALGOS["ring"]  # tuned-style fallback
+        seg = self._segcount()
+        key = ("allreduce", algo, x.shape, str(x.dtype), op.name, seg)
+
+        def build():
+            impl = {
+                ALLREDUCE_ALGOS["psum"]: lambda v: algos.allreduce_psum(v, op, n),
+                ALLREDUCE_ALGOS["ring"]: lambda v: algos.allreduce_ring(v, op, n),
+                ALLREDUCE_ALGOS["ring_segmented"]: lambda v: algos.allreduce_ring_segmented(v, op, n, seg),
+                ALLREDUCE_ALGOS["recursive_doubling"]: lambda v: algos.allreduce_recursive_doubling(v, op, n),
+                ALLREDUCE_ALGOS["rabenseifner"]: lambda v: algos.allreduce_rabenseifner(v, op, n),
+                ALLREDUCE_ALGOS["ordered_linear"]: lambda v: algos.allreduce_ordered_linear(v, op, n),
+            }[algo]
+            return self._spmd(lambda v: impl(v[0])[None])
+
+        return self._compiled(key, build)
+
+    def allreduce(self, x, op: Op):
+        return self._allreduce_fn(x, op)(x)
+
+    def iallreduce(self, x, op: Op) -> Request:
+        return ArrayRequest(self._allreduce_fn(x, op)(x))
+
+    def allreduce_init(self, x, op: Op) -> PersistentRequest:
+        fn = self._allreduce_fn(x, op)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # ==================================================================
+    # bcast
+    # ==================================================================
+
+    def _bcast_fn(self, x, root: int):
+        n = self._n()
+        algo = self._algo("bcast_algorithm", BCAST_ALGOS)
+        if algo == BCAST_ALGOS["auto"]:
+            algo = BCAST_ALGOS["direct"]
+        seg = self._segcount()
+        key = ("bcast", algo, x.shape, str(x.dtype), root, seg)
+
+        def build():
+            impl = {
+                BCAST_ALGOS["direct"]: lambda v: algos.bcast_direct(v, n, root),
+                BCAST_ALGOS["binomial"]: lambda v: algos.bcast_binomial(v, n, root),
+                BCAST_ALGOS["pipeline"]: lambda v: algos.bcast_pipeline(v, n, root, seg),
+            }[algo]
+            return self._spmd(lambda v: impl(v[0])[None])
+
+        return self._compiled(key, build)
+
+    def bcast(self, x, root: int = 0):
+        return self._bcast_fn(x, root)(x)
+
+    def ibcast(self, x, root: int = 0) -> Request:
+        return ArrayRequest(self._bcast_fn(x, root)(x))
+
+    def bcast_init(self, x, root: int = 0) -> PersistentRequest:
+        fn = self._bcast_fn(x, root)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # ==================================================================
+    # reduce
+    # ==================================================================
+
+    def _reduce_fn(self, x, op: Op, root: int):
+        n = self._n()
+        algo = self._algo("reduce_algorithm", REDUCE_ALGOS)
+        if self._reproducible():
+            algo = REDUCE_ALGOS["ordered"]
+        if algo == REDUCE_ALGOS["auto"]:
+            algo = REDUCE_ALGOS["ordered"] if not op.commutative else REDUCE_ALGOS["binomial"]
+        key = ("reduce", algo, x.shape, str(x.dtype), op.name, root)
+
+        def build():
+            impl = {
+                REDUCE_ALGOS["binomial"]: lambda v: algos.reduce_binomial(v, op, n, root),
+                REDUCE_ALGOS["ordered"]: lambda v: algos.reduce_ordered(v, op, n, root),
+            }[algo]
+            return self._spmd(lambda v: impl(v[0])[None])
+
+        return self._compiled(key, build)
+
+    def reduce(self, x, op: Op, root: int = 0):
+        return self._reduce_fn(x, op, root)(x)
+
+    def ireduce(self, x, op: Op, root: int = 0) -> Request:
+        return ArrayRequest(self._reduce_fn(x, op, root)(x))
+
+    def reduce_init(self, x, op: Op, root: int = 0) -> PersistentRequest:
+        fn = self._reduce_fn(x, op, root)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # ==================================================================
+    # allgather / gather
+    # ==================================================================
+
+    def _allgather_fn(self, x):
+        n = self._n()
+        algo = self._algo("allgather_algorithm", ALLGATHER_ALGOS)
+        if algo == ALLGATHER_ALGOS["auto"]:
+            algo = ALLGATHER_ALGOS["direct"]
+        key = ("allgather", algo, x.shape, str(x.dtype))
+
+        def build():
+            impl = {
+                ALLGATHER_ALGOS["direct"]: lambda v: algos.allgather_direct(v, n),
+                ALLGATHER_ALGOS["ring"]: lambda v: algos.allgather_ring(v, n),
+                ALLGATHER_ALGOS["bruck"]: lambda v: algos.allgather_bruck(v, n),
+            }[algo]
+            return self._spmd(lambda v: impl(v[0])[None])
+
+        return self._compiled(key, build)
+
+    def allgather(self, x):
+        """(n, *s) → (n, n, *s): row r of the middle axis is rank r's
+        contribution; leading axis is the receiving rank (rows equal)."""
+        return self._allgather_fn(x)(x)
+
+    def iallgather(self, x) -> Request:
+        return ArrayRequest(self._allgather_fn(x)(x))
+
+    def allgather_init(self, x) -> PersistentRequest:
+        fn = self._allgather_fn(x)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    def gather(self, x, root: int = 0):
+        """Device-side gather == allgather; the API layer extracts the
+        root row (tuned similarly reuses allgather for small gathers)."""
+        return self.allgather(x)
+
+    def igather(self, x, root: int = 0) -> Request:
+        return ArrayRequest(self._allgather_fn(x)(x))
+
+    def gather_init(self, x, root: int = 0) -> PersistentRequest:
+        fn = self._allgather_fn(x)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # ==================================================================
+    # scatter  (root's (n,*s) rows → rank r gets row r)
+    # ==================================================================
+
+    def _scatter_fn(self, x, root: int):
+        # Rank-major staging already placed row r on device r, so the
+        # device-side scatter is the identity program: the *resharding*
+        # (stage_in / jit placement) is the scatter, which is exactly
+        # how a single-controller fabric does it — XLA moves root's rows
+        # during layout assignment, not via an explicit collective.
+        key = ("scatter", 0, x.shape, str(x.dtype), root)
+        return self._compiled(key, lambda: self._spmd(lambda v: v))
+
+    def scatter(self, x, root: int = 0):
+        """x: (n, *s) rank-major where row layout is root's sendbuf;
+        returns (n, *s) with row r resident on rank r (identity values,
+        distribution is the semantic)."""
+        return self._scatter_fn(x, root)(x)
+
+    def iscatter(self, x, root: int = 0) -> Request:
+        return ArrayRequest(self._scatter_fn(x, root)(x))
+
+    def scatter_init(self, x, root: int = 0) -> PersistentRequest:
+        fn = self._scatter_fn(x, root)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # ==================================================================
+    # reduce_scatter_block / reduce_scatter
+    # ==================================================================
+
+    def _reduce_scatter_block_fn(self, x, op: Op):
+        n = self._n()
+        algo = self._algo("reduce_scatter_algorithm", REDUCE_SCATTER_ALGOS)
+        if self._reproducible():
+            algo = REDUCE_SCATTER_ALGOS["ring"]  # deterministic chain order
+        if algo == REDUCE_SCATTER_ALGOS["auto"]:
+            algo = (
+                REDUCE_SCATTER_ALGOS["direct"]
+                if op.lax_collective == "psum"
+                else REDUCE_SCATTER_ALGOS["ring"]
+            )
+        if algo == REDUCE_SCATTER_ALGOS["direct"] and op.lax_collective != "psum":
+            algo = REDUCE_SCATTER_ALGOS["ring"]
+        key = ("reduce_scatter_block", algo, x.shape, str(x.dtype), op.name)
+
+        def build():
+            if algo == REDUCE_SCATTER_ALGOS["direct"]:
+                per_dev = lambda v: jax.lax.psum_scatter(
+                    v[0], AXIS, scatter_dimension=0, tiled=True
+                )
+            else:
+                per_dev = lambda v: algos.reduce_scatter_ring(v[0], op, n)[None]
+            return self._spmd(per_dev)
+
+        return self._compiled(key, build)
+
+    def reduce_scatter_block(self, x, op: Op):
+        """x: (n, n, *s) — x[r, j] is rank r's contribution to rank j;
+        returns (n, *s): row j = reduction of x[:, j] resident on rank j."""
+        return self._reduce_scatter_block_fn(x, op)(x)
+
+    def ireduce_scatter_block(self, x, op: Op) -> Request:
+        return ArrayRequest(self._reduce_scatter_block_fn(x, op)(x))
+
+    def reduce_scatter_block_init(self, x, op: Op) -> PersistentRequest:
+        fn = self._reduce_scatter_block_fn(x, op)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # MPI_Reduce_scatter: equal counts arrive pre-blocked from the API
+    # layer; jagged counts fall back to the host path through the comm's
+    # selected basic module (a module must serve every case of a slot it
+    # provides — the reference's tuned → basic fallback dance).
+    def _host_fallback(self):
+        from .basic import BasicCollModule
+
+        for m in self.comm.coll.modules:
+            if isinstance(m, BasicCollModule):
+                return m
+        return BasicCollModule(self.comm)
+
+    def reduce_scatter(self, x, op: Op, counts=None):
+        if counts is not None and len(set(counts)) != 1:
+            return self._host_fallback().reduce_scatter(np.asarray(x), op, counts)
+        return self.reduce_scatter_block(x, op)
+
+    def ireduce_scatter(self, x, op: Op, counts=None) -> Request:
+        if counts is not None and len(set(counts)) != 1:
+            from ompi_tpu.request import CompletedRequest
+
+            return CompletedRequest(self.reduce_scatter(x, op, counts))
+        return ArrayRequest(self.reduce_scatter(x, op, counts))
+
+    def reduce_scatter_init(self, x, op: Op, counts=None) -> PersistentRequest:
+        return PersistentRequest(lambda: self.ireduce_scatter(x, op, counts))
+
+    # ==================================================================
+    # alltoall
+    # ==================================================================
+
+    def _alltoall_fn(self, x):
+        n = self._n()
+        algo = self._algo("alltoall_algorithm", ALLTOALL_ALGOS)
+        if algo == ALLTOALL_ALGOS["auto"]:
+            algo = ALLTOALL_ALGOS["direct"]
+        key = ("alltoall", algo, x.shape, str(x.dtype))
+
+        def build():
+            impl = {
+                ALLTOALL_ALGOS["direct"]: lambda v: algos.alltoall_direct(v, n),
+                ALLTOALL_ALGOS["pairwise"]: lambda v: algos.alltoall_pairwise(v, n),
+            }[algo]
+            return self._spmd(lambda v: impl(v[0])[None])
+
+        return self._compiled(key, build)
+
+    def alltoall(self, x):
+        """x: (n, n, *s) — x[r, j] goes from rank r to rank j; returns
+        (n, n, *s) with out[j, r] = x[r, j] (row j on rank j)."""
+        return self._alltoall_fn(x)(x)
+
+    def ialltoall(self, x) -> Request:
+        return ArrayRequest(self._alltoall_fn(x)(x))
+
+    def alltoall_init(self, x) -> PersistentRequest:
+        fn = self._alltoall_fn(x)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    # ==================================================================
+    # barrier
+    # ==================================================================
+
+    def _barrier_fn(self):
+        n = self._n()
+        algo = self._algo("barrier_algorithm", BARRIER_ALGOS)
+        if algo == BARRIER_ALGOS["auto"]:
+            algo = BARRIER_ALGOS["allreduce"]
+        key = ("barrier", algo)
+
+        def build():
+            impl = (
+                (lambda v: (algos.barrier_allreduce(n) + 0 * v[0])[None])
+                if algo == BARRIER_ALGOS["allreduce"]
+                else (lambda v: (algos.barrier_dissemination(n) + 0 * v[0])[None])
+            )
+            return self._spmd(impl)
+
+        return self._compiled(key, build)
+
+    def barrier(self):
+        token = np.zeros((self._n(),), np.int32)
+        jax.block_until_ready(self._barrier_fn()(self.comm.mesh.stage_in(token)))
+
+    def ibarrier(self) -> Request:
+        token = np.zeros((self._n(),), np.int32)
+        return ArrayRequest(self._barrier_fn()(self.comm.mesh.stage_in(token)))
+
+    def barrier_init(self) -> PersistentRequest:
+        return PersistentRequest(lambda: self.ibarrier())
+
+    # ==================================================================
+    # scan / exscan
+    # ==================================================================
+
+    def _scan_fn(self, x, op: Op, exclusive: bool):
+        n = self._n()
+        key = ("scan", exclusive, x.shape, str(x.dtype), op.name)
+
+        def build():
+            return self._spmd(
+                lambda v: algos.scan_ordered(v[0], op, n, exclusive=exclusive)[None]
+            )
+
+        return self._compiled(key, build)
+
+    def scan(self, x, op: Op):
+        return self._scan_fn(x, op, False)(x)
+
+    def iscan(self, x, op: Op) -> Request:
+        return ArrayRequest(self._scan_fn(x, op, False)(x))
+
+    def scan_init(self, x, op: Op) -> PersistentRequest:
+        fn = self._scan_fn(x, op, False)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+    def exscan(self, x, op: Op):
+        return self._scan_fn(x, op, True)(x)
+
+    def iexscan(self, x, op: Op) -> Request:
+        return ArrayRequest(self._scan_fn(x, op, True)(x))
+
+    def exscan_init(self, x, op: Op) -> PersistentRequest:
+        fn = self._scan_fn(x, op, True)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
+
+
+@register_component
+class XlaCollComponent(Component):
+    """``coll/xla`` MCA component (peer of tuned/han/basic in the
+    reference's coll framework; SURVEY.md §2.2)."""
+
+    FRAMEWORK = "coll"
+    NAME = "xla"
+    PRIORITY = 90  # above basic (10), below a future han-equivalent (?)
+
+    def __init__(self):
+        super().__init__()
+        self.store = None
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self.store = store
+        store.register(
+            "coll", "xla", "allreduce_algorithm", 0, type="int",
+            enum=ALLREDUCE_ALGOS,
+            help="Allreduce algorithm (auto: psum for fabric-reducible "
+            "ops, ordered_linear otherwise)",
+        )
+        store.register(
+            "coll", "xla", "bcast_algorithm", 0, type="int", enum=BCAST_ALGOS,
+            help="Bcast algorithm",
+        )
+        store.register(
+            "coll", "xla", "allgather_algorithm", 0, type="int",
+            enum=ALLGATHER_ALGOS, help="Allgather algorithm",
+        )
+        store.register(
+            "coll", "xla", "alltoall_algorithm", 0, type="int",
+            enum=ALLTOALL_ALGOS, help="Alltoall algorithm",
+        )
+        store.register(
+            "coll", "xla", "reduce_scatter_algorithm", 0, type="int",
+            enum=REDUCE_SCATTER_ALGOS, help="Reduce_scatter algorithm",
+        )
+        store.register(
+            "coll", "xla", "reduce_algorithm", 0, type="int",
+            enum=REDUCE_ALGOS, help="Reduce algorithm",
+        )
+        store.register(
+            "coll", "xla", "barrier_algorithm", 0, type="int",
+            enum=BARRIER_ALGOS, help="Barrier algorithm",
+        )
+        store.register(
+            "coll", "xla", "reproducible", False,
+            help="Force bit-exact rank-ordered reductions "
+            "(≈ coll_han reproducible mode)",
+        )
+        store.register(
+            "coll", "xla", "segcount", 1 << 16, type="int",
+            help="Segment element count for segmented/pipelined algorithms "
+            "(≈ coll_tuned_*_segmentsize)",
+        )
+
+    def open(self, store) -> bool:
+        try:
+            import jax as _jax
+
+            return len(_jax.devices()) > 0
+        except Exception:
+            return False
+
+    def query(self, comm) -> XlaCollModule | None:
+        # Serve any communicator whose mesh spans ≥1 device.
+        if comm.size < 1:
+            return None
+        return XlaCollModule(comm, self)

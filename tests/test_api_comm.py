@@ -1,0 +1,425 @@
+"""API-layer tests: MPI_Init→collectives→Finalize on the virtual mesh.
+
+The single-node full-stack exercise mirroring the reference's
+``mpirun --oversubscribe`` loopback runs (SURVEY.md §4): every
+collective goes through arg-check → comm coll table → coll/xla compiled
+program (or basic host path) → staging, plus group algebra, comm
+split/dup, non-blocking requests, persistent requests, and the
+datatype (convertor) entry points.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu import ddt
+from ompi_tpu.api.comm import COLOR_UNDEFINED
+from ompi_tpu.api.group import Group, IDENT, SIMILAR, UNEQUAL, UNDEFINED
+from ompi_tpu.core.errors import (
+    MPIArgError,
+    MPICommError,
+    MPIOpError,
+    MPIRankError,
+    MPIRootError,
+)
+from ompi_tpu.op import MAX, MIN, PROD, SUM, ordered_reduce_np
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    w = api.init()
+    yield w
+    # do not finalize between modules; session teardown is fine
+
+
+N = 8
+
+
+def rank_data(shape=(33,), dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.dtype(dtype).kind in "iu":
+        return rng.randint(-40, 40, (N,) + shape).astype(dtype)
+    return (rng.randn(N, *shape) * 10.0 ** rng.randint(-2, 3, (N,) + shape)).astype(dtype)
+
+
+# -- init basics -------------------------------------------------------
+
+
+def test_world_shape(world):
+    assert world.size == N
+    assert world.name == "MPI_COMM_WORLD"
+    assert api.initialized()
+    assert api.comm_self().size == 1
+
+
+def test_coll_table_providers(world):
+    t = world.coll
+    assert t.providers["allreduce"] == "xla"
+    assert t.providers["allgatherv"] == "basic"  # backfilled by basic
+
+
+# -- groups ------------------------------------------------------------
+
+
+def test_group_algebra():
+    g = Group(range(8))
+    sub = g.incl([1, 3, 5])
+    assert sub.ranks == (1, 3, 5)
+    assert sub.rank_of(3) == 1
+    assert sub.rank_of(2) == UNDEFINED
+    assert g.excl([0, 7]).ranks == tuple(range(1, 7))
+    assert sub.union(g.incl([0, 1])).ranks == (1, 3, 5, 0)
+    assert sub.intersection(g.incl([3, 4])).ranks == (3,)
+    assert sub.difference(g.incl([3])).ranks == (1, 5)
+    assert g.range_incl([(0, 6, 2)]).ranks == (0, 2, 4, 6)
+    assert g.range_excl([(0, 6, 2)]).ranks == (1, 3, 5, 7)
+    assert sub.compare(Group([1, 3, 5])) == IDENT
+    assert sub.compare(Group([5, 3, 1])) == SIMILAR
+    assert sub.compare(Group([1, 3])) == UNEQUAL
+    assert sub.translate_ranks([0, 2], g) == [1, 5]
+    with pytest.raises(MPIRankError):
+        g.incl([8])
+
+
+# -- blocking collectives ----------------------------------------------
+
+
+def test_allreduce_numpy_roundtrip(world):
+    x = rank_data()
+    out = world.allreduce(x, SUM)
+    assert isinstance(out, np.ndarray)
+    assert out.shape == x.shape
+    golden = x.sum(0, dtype=np.float64).astype(np.float32)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], x.sum(0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,fn", [(MAX, np.max), (MIN, np.min), (PROD, np.prod)])
+def test_allreduce_other_ops(world, op, fn):
+    x = rank_data((7,), np.int64, seed=4)
+    if op is PROD:
+        x = (np.abs(x) % 3 - 1).astype(np.int64)
+    out = world.allreduce(x, op)
+    np.testing.assert_array_equal(out[0], fn(x, axis=0))
+
+
+def test_bcast_roots(world):
+    x = rank_data((12,), np.int32, seed=2)
+    for root in (0, 5):
+        out = world.bcast(x, root)
+        for r in range(N):
+            np.testing.assert_array_equal(out[r], x[root])
+
+
+def test_reduce_returns_root_row(world):
+    x = np.round(rank_data((6,), np.float64))
+    out = world.reduce(x, SUM, root=3)
+    np.testing.assert_array_equal(out, x.sum(0))
+
+
+def test_allgather_gather(world):
+    x = rank_data((4,), np.int32, seed=5)
+    ag = world.allgather(x)
+    assert ag.shape == (N, N, 4)
+    for r in range(N):
+        np.testing.assert_array_equal(ag[r], x)
+    g = world.gather(x, root=2)
+    assert g.shape == (N, 4)
+    np.testing.assert_array_equal(g, x)
+
+
+def test_scatter(world):
+    x = rank_data((3,), np.float32, seed=6)
+    out = world.scatter(x, root=1)
+    np.testing.assert_array_equal(out, x)  # values identity; placement semantic
+
+
+def test_reduce_scatter_block(world):
+    x = np.round(rank_data((N, 5), np.float64, seed=7))
+    out = world.reduce_scatter_block(x, SUM)
+    np.testing.assert_array_equal(out, x.sum(0))
+
+
+def test_alltoall(world):
+    x = rank_data((N, 2), np.int32, seed=8)
+    out = world.alltoall(x)
+    for r in range(N):
+        for j in range(N):
+            np.testing.assert_array_equal(out[r, j], x[j, r])
+
+
+def test_scan_exscan(world):
+    x = np.round(rank_data((4,), np.float64, seed=9))
+    s = world.scan(x, SUM)
+    e = world.exscan(x, SUM)
+    for r in range(N):
+        np.testing.assert_array_equal(s[r], x[: r + 1].sum(0))
+    np.testing.assert_array_equal(e[0], np.zeros(4))
+    for r in range(1, N):
+        np.testing.assert_array_equal(e[r], x[:r].sum(0))
+
+
+def test_barrier(world):
+    world.barrier()  # completes
+
+
+def test_bit_exact_reproducible_mode(devices):
+    """--mca coll_xla_reproducible 1 → fp32 SUM bit-equal to the host
+    golden fold through the FULL api path (stage→fabric→unstage)."""
+    from ompi_tpu.core import mca as mca_mod
+
+    ctx = mca_mod.default_context()
+    ctx.store.set("coll_xla_reproducible", True)
+    try:
+        w = api.comm_world()
+        x = rank_data((257,), np.float32, seed=13)
+        out = w.allreduce(x, SUM)
+        golden = ordered_reduce_np(x, SUM)
+        for r in range(N):
+            assert np.array_equal(out[r].view(np.uint8), golden.view(np.uint8))
+    finally:
+        ctx.store.set("coll_xla_reproducible", False)
+
+
+# -- jax-array flavor --------------------------------------------------
+
+
+def test_jax_array_in_jax_array_out(world):
+    import jax
+    import jax.numpy as jnp
+
+    x = world.mesh.stage_in(np.round(rank_data((5,), np.float64, seed=3)))
+    out = world.allreduce(x, SUM)
+    assert isinstance(out, jax.Array) and not isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(
+        np.asarray(out)[0], np.asarray(x).sum(0)
+    )
+
+
+# -- non-blocking / persistent -----------------------------------------
+
+
+def test_iallreduce_request(world):
+    x = np.round(rank_data((9,), np.float64, seed=10))
+    req = world.iallreduce(x, SUM)
+    out = req.wait()
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out[0], x.sum(0))
+    assert req.test()
+
+
+def test_request_families(world):
+    from ompi_tpu.request import testall, waitall, waitany
+
+    xs = [np.round(rank_data((4,), np.float64, seed=s)) for s in range(3)]
+    reqs = [world.iallreduce(x, SUM) for x in xs]
+    outs = waitall(reqs)
+    for x, o in zip(xs, outs):
+        np.testing.assert_array_equal(o[0], x.sum(0))
+    assert testall(reqs)
+
+
+def test_persistent_allreduce(world):
+    x = np.round(rank_data((6,), np.float64, seed=11))
+    preq = world.allreduce_init(x, SUM)
+    for _ in range(3):
+        preq.start()
+        out = np.asarray(preq.wait())
+        np.testing.assert_array_equal(out[0], x.sum(0))
+
+
+def test_ibarrier(world):
+    req = world.ibarrier()
+    req.wait()
+    assert req.completed
+
+
+# -- jagged v-variants -------------------------------------------------
+
+
+def test_allgatherv(world):
+    blocks = [np.arange(r + 1, dtype=np.int32) for r in range(N)]
+    out = world.allgatherv(blocks)
+    assert len(out) == N
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], blocks[r])
+
+
+def test_alltoallv(world):
+    matrix = [
+        [np.full(j + 1, 10 * r + j, np.int32) for j in range(N)] for r in range(N)
+    ]
+    out = world.alltoallv(matrix)
+    for j in range(N):
+        for r in range(N):
+            np.testing.assert_array_equal(out[j][r], matrix[r][j])
+
+
+def test_reduce_scatter_uneven_counts(world):
+    counts = [1, 2, 1, 2, 1, 2, 1, 2]
+    total = sum(counts)
+    x = np.ones((N, total), np.float64)
+    out = world.reduce_scatter(x, SUM, counts)
+    assert [len(o) for o in out] == counts
+    for o in out:
+        np.testing.assert_array_equal(o, np.full(len(o), N))
+
+
+# -- dup / split / free ------------------------------------------------
+
+
+def test_dup_and_split(world):
+    d = world.dup()
+    assert d.size == N and d.cid != world.cid
+    x = np.round(rank_data((3,), np.float64, seed=12))
+    np.testing.assert_array_equal(d.allreduce(x, SUM)[0], x.sum(0))
+
+    colors = [r % 2 for r in range(N)]
+    keys = [N - r for r in range(N)]  # reverse order inside each color
+    comms = world.split(colors, keys)
+    evens = comms[0]
+    odds = comms[1]
+    assert evens is comms[2] is comms[4] is comms[6]
+    assert odds is comms[1] is comms[3]
+    assert evens.size == 4 and odds.size == 4
+    # reverse key order: world rank 6 is rank 0 of evens
+    assert evens.group.ranks == (6, 4, 2, 0)
+
+    sx = np.round(rank_data((4,), np.float64, seed=14))[:4]
+    out = evens.allreduce(sx, SUM)
+    np.testing.assert_array_equal(out[0], sx.sum(0))
+
+
+def test_split_undefined_color(world):
+    colors = [0] * (N - 1) + [COLOR_UNDEFINED]
+    comms = world.split(colors)
+    assert comms[-1] is None
+    assert comms[0].size == N - 1
+
+
+def test_free_semantics(world):
+    d = world.dup()
+    d.free()
+    with pytest.raises(MPICommError):
+        d.allreduce(np.zeros((N, 1), np.float32))
+
+
+# -- error paths -------------------------------------------------------
+
+
+def test_bad_root(world):
+    with pytest.raises(MPIRootError):
+        world.bcast(np.zeros((N, 2), np.float32), root=99)
+
+
+def test_bad_shape(world):
+    with pytest.raises(MPIArgError):
+        world.allreduce(np.zeros((3, 2), np.float32))
+
+
+def test_op_type_gate(world):
+    from ompi_tpu.op import BAND
+
+    with pytest.raises(MPIOpError):
+        world.allreduce_ddt(
+            [np.zeros(4, np.float32)] * N, 4, ddt.FLOAT, BAND
+        )
+
+
+# -- datatype entry points ---------------------------------------------
+
+
+def test_allreduce_ddt_contiguous(world):
+    bufs = [np.full(16, float(r), np.float32) for r in range(N)]
+    out = world.allreduce_ddt(bufs, 16, ddt.FLOAT, SUM)
+    expect = sum(range(N))
+    np.testing.assert_array_equal(out[0], np.full(16, expect, np.float32))
+
+
+def test_allreduce_ddt_strided_with_recv(world):
+    # vector: every other float of 8 → 4 reduced elements land back strided
+    dt = ddt.FLOAT.create_vector(4, 1, 2).commit()
+    sendbufs = [np.arange(8, dtype=np.float32) + r for r in range(N)]
+    recvbufs = [np.zeros(8, np.float32) for _ in range(N)]
+    world.allreduce_ddt(sendbufs, 1, dt, SUM, recvbufs)
+    expect = np.stack(sendbufs)[:, [0, 2, 4, 6]].sum(0)
+    for r in range(N):
+        np.testing.assert_array_equal(recvbufs[r][[0, 2, 4, 6]], expect)
+        np.testing.assert_array_equal(recvbufs[r][[1, 3, 5, 7]], np.zeros(4))
+
+
+def test_bcast_ddt(world):
+    dt = ddt.INT.create_contiguous(5).commit()
+    buf = np.arange(5, dtype=np.int32)
+    outs = world.bcast_ddt(buf, 1, dt, root=0)
+    for r in range(N):
+        np.testing.assert_array_equal(outs[r].view(np.int32), buf)
+
+
+def test_reduce_scatter_equal_counts(world):
+    """Equal counts > 1 must produce per-rank segments, incl. for ops
+    without a psum fast path and under reproducible mode — regression."""
+    c = 2
+    x = np.round(rank_data((N * c,), np.float64, seed=21))
+    out = world.reduce_scatter(x, SUM, [c] * N)
+    assert out.shape == (N, c)
+    golden = x.sum(0).reshape(N, c)
+    np.testing.assert_array_equal(np.asarray(out), golden)
+
+    xm = rank_data((N * c,), np.float32, seed=22)
+    outm = world.reduce_scatter(xm, MAX, [c] * N)
+    np.testing.assert_array_equal(np.asarray(outm), xm.max(0).reshape(N, c))
+
+    from ompi_tpu.core import mca as mca_mod
+
+    store = mca_mod.default_context().store
+    store.set("coll_xla_reproducible", True)
+    try:
+        outr = world.reduce_scatter(x, SUM, [c] * N)
+        np.testing.assert_array_equal(np.asarray(outr), golden)
+    finally:
+        store.set("coll_xla_reproducible", False)
+
+
+def test_ireduce_scatter_jagged_request(world):
+    """Non-blocking jagged reduce_scatter must return a working request
+    (regression: ArrayRequest crashed on numpy lists)."""
+    counts = [1, 2] * (N // 2)
+    x = np.ones((N, sum(counts)), np.float64)
+    req = world.coll.lookup("ireduce_scatter")(x, SUM, counts)
+    out = req.wait()
+    assert [len(o) for o in out] == counts
+
+
+def test_allreduce_op_dtype_argcheck(world):
+    """BAND on float32 must raise MPIOpError at the API layer, not a
+    raw JAX tracer error — regression."""
+    from ompi_tpu.op import BAND, MAXLOC
+
+    with pytest.raises(MPIOpError):
+        world.allreduce(np.zeros((N, 4), np.float32), BAND)
+    with pytest.raises(MPIOpError):
+        world.allreduce(np.zeros((N, 4), np.float32), MAXLOC)
+
+
+def test_segcount_change_takes_effect(world):
+    """Changing coll_xla_segcount must rebuild segmented programs
+    (regression: stale cache key)."""
+    from ompi_tpu.core import mca as mca_mod
+
+    store = mca_mod.default_context().store
+    x = np.round(rank_data((64,), np.float64, seed=23))
+    store.set("coll_xla_allreduce_algorithm", "ring_segmented")
+    try:
+        store.set("coll_xla_segcount", 64)
+        out1 = world.allreduce(x, SUM)
+        store.set("coll_xla_segcount", 7)
+        out2 = world.allreduce(x, SUM)
+        np.testing.assert_array_equal(out1[0], x.sum(0))
+        np.testing.assert_array_equal(out2[0], x.sum(0))
+        mod = [m for m in world.coll.modules if type(m).__name__ == "XlaCollModule"][0]
+        seg_keys = {k[-1] for k in mod._cache if k[0] == "allreduce" and k[1] == 3}
+        assert {64, 7} <= seg_keys
+    finally:
+        store.set("coll_xla_segcount", 1 << 16)
+        store.set("coll_xla_allreduce_algorithm", "auto")
