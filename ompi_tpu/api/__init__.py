@@ -39,8 +39,22 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     from ompi_tpu.mesh.mesh import world_mesh
 
     wm = world_mesh()
-    _world = Comm(Group(range(wm.size)), wm, name="MPI_COMM_WORLD")
-    _self_comm = Comm(Group([0]), wm.submesh([0]), name="MPI_COMM_SELF")
+    from ompi_tpu.boot.proc import launched_by_tpurun
+
+    if launched_by_tpurun():
+        # multi-process job (tpurun): this process owns a slice; the
+        # world spans every process via the DCN (SURVEY.md §2.7)
+        from ompi_tpu.boot.proc import ProcContext
+        from .multiproc import MultiProcComm
+
+        pc = ProcContext()
+        _world = MultiProcComm(pc, wm, name="MPI_COMM_WORLD")
+        _self_comm = Comm(
+            Group([_world.local_offset]), wm.submesh([0]), name="MPI_COMM_SELF"
+        )
+    else:
+        _world = Comm(Group(range(wm.size)), wm, name="MPI_COMM_WORLD")
+        _self_comm = Comm(Group([0]), wm.submesh([0]), name="MPI_COMM_SELF")
     _initialized = True
     return _world
 
@@ -65,6 +79,10 @@ def finalize() -> None:
     """MPI_Finalize: free the world objects and close frameworks."""
     global _world, _self_comm, _initialized
     if _world is not None:
+        pc = getattr(_world, "procctx", None)
+        if pc is not None:
+            pc.fence("finalize")  # all procs reach finalize before teardown
+            pc.close()
         _world.free()
         _world = None
     if _self_comm is not None:

@@ -70,6 +70,7 @@ class Comm:
         self.cid = _next_cid()
         self.name = name or f"comm#{self.cid}"
         self._coll: CollTable | None = None
+        self._pml = None
         self._attrs: dict[int, Any] = {}
         self._freed = False
 
@@ -95,6 +96,17 @@ class Comm:
 
     def set_name(self, name: str) -> None:
         self.name = name
+
+    @property
+    def pml(self):
+        """Per-comm matching engine from the selected pml component
+        (≈ ob1's per-comm match tables; one pml per job)."""
+        self._check()
+        if self._pml is None:
+            ctx = mca.default_context()
+            comp = ctx.framework("pml").select_one()
+            self._pml = comp.make_engine(self.size)
+        return self._pml
 
     # -- attribute caching (MPI_Comm_set_attr family) -------------------
 
@@ -338,6 +350,70 @@ class Comm:
     def scatterv(self, blocks: Sequence[np.ndarray], root: int = 0):
         self._check_root(root)
         return self.coll.lookup("scatterv")(blocks, root)
+
+    # -- point-to-point (pml) -------------------------------------------
+
+    def send(self, buf, source: int, dest: int, tag: int = 0) -> None:
+        """MPI_Send from rank ``source`` to ``dest`` (single-controller
+        form names both endpoints). Eager-buffered: returns immediately,
+        sender's buffer reusable."""
+        dest_dev = (
+            self.mesh.devices[dest]
+            if isinstance(buf, jax.Array) and 0 <= dest < self.size
+            else None
+        )
+        self.pml.send(source, dest, buf, tag, dest_dev)
+
+    def isend(self, buf, source: int, dest: int, tag: int = 0) -> Request:
+        from ompi_tpu.request import CompletedRequest
+
+        self.send(buf, source, dest, tag)
+        return CompletedRequest()  # eager send completes locally
+
+    def irecv(self, dest: int, source: int | None = None, tag: int | None = None) -> Request:
+        from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG
+
+        return self.pml.irecv(
+            dest,
+            ANY_SOURCE if source is None else source,
+            ANY_TAG if tag is None else tag,
+        )
+
+    def recv(self, dest: int, source: int | None = None, tag: int | None = None):
+        """MPI_Recv at rank ``dest``; returns (payload, Status)."""
+        req = self.irecv(dest, source, tag)
+        payload = req.wait()
+        return payload, req.status
+
+    def sendrecv(
+        self, sendbuf, source: int, dest: int, recv_source: int,
+        sendtag: int = 0, recvtag: int | None = None,
+    ):
+        """MPI_Sendrecv at rank ``source``: send to ``dest``, receive
+        from ``recv_source``. Deadlock-free by eager buffering."""
+        self.send(sendbuf, source, dest, sendtag)
+        return self.recv(source, recv_source, recvtag)
+
+    def probe(self, dest: int, source: int | None = None, tag: int | None = None):
+        """MPI_Probe (blocking): wait for a matching envelope."""
+        import time as _time
+
+        sleep = 0.0
+        while True:
+            st = self.iprobe(dest, source, tag)
+            if st is not None:
+                return st
+            _time.sleep(sleep)
+            sleep = min(max(sleep * 2, 50e-6), 1e-3)
+
+    def iprobe(self, dest: int, source: int | None = None, tag: int | None = None):
+        from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG
+
+        return self.pml.iprobe(
+            dest,
+            ANY_SOURCE if source is None else source,
+            ANY_TAG if tag is None else tag,
+        )
 
     # -- datatype (convertor) entry points ------------------------------
 

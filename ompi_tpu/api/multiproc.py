@@ -1,0 +1,220 @@
+"""Multi-process (multi-slice) communicators.
+
+The distributed execution model (SURVEY.md §2.7): a ``tpurun`` job is P
+worker processes, each owning a slice of the fabric (its local jax
+devices).  Global rank space is the ordered concatenation of each
+process's local ranks.  Collectives go through the MCA coll selection
+exactly like single-process comms — ``coll/han`` (priority 95) wins on
+these communicators and composes intra-slice fabric collectives with
+inter-slice DCN traffic; ``coll/xla``/``coll/basic`` decline (they
+cannot see remote ranks).
+
+p2p: the local matching engine holds this process's posted/unexpected
+queues (keyed by GLOBAL ranks); sends to remote ranks travel as DCN
+frames and are injected into the destination's engine by the receiver
+thread — the btl_tcp → ob1 callback path of SURVEY.md §3.3.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+import threading
+
+from ompi_tpu.boot.proc import ProcContext
+from ompi_tpu.core import mca
+from ompi_tpu.core.errors import MPIArgError, MPICommError, MPIRankError
+from ompi_tpu.coll.module import CollTable, select_coll_modules
+from ompi_tpu.mesh.mesh import CommMesh
+from ompi_tpu.op.op import SUM, Op
+from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG, MatchingEngine
+from ompi_tpu.request import Request
+from .comm import _next_cid
+from .group import Group
+
+
+class MultiProcComm:
+    """A communicator spanning every process of the job (round 1: the
+    world and full-width duplicates; arbitrary sub-process groups come
+    with the sub-engine work, next round)."""
+
+    def __init__(self, ctx: ProcContext, local_mesh: CommMesh, name: str = "MPI_COMM_WORLD"):
+        self.procctx = ctx
+        self.proc = ctx.proc
+        self.nprocs = ctx.nprocs
+        self.dcn = ctx.engine
+        self.local_mesh = local_mesh
+        self.cid = _next_cid()
+        self.name = name
+        self._freed = False
+
+        # modex: exchange local sizes → global rank layout
+        sizes = self.dcn.allgather(np.array([local_mesh.size], np.int64), self.cid)
+        self.proc_sizes = [int(s[0]) for s in sizes]
+        self.offsets = np.cumsum([0] + self.proc_sizes).tolist()
+        self.local_size = local_mesh.size
+        self.local_offset = self.offsets[self.proc]
+        self.size = self.offsets[-1]
+        self.group = Group(range(self.size))
+
+        # intra-slice communicator (the han low_comm)
+        from .comm import Comm
+
+        self.local = Comm(
+            Group(range(self.local_offset, self.local_offset + self.local_size)),
+            local_mesh,
+            name=f"{name}.local{self.proc}",
+        )
+
+        self._coll: CollTable | None = None
+        self._pml: MatchingEngine | None = None
+        self._pml_lock = threading.Lock()
+        self.dcn.register_p2p(self.cid, self._on_p2p_frame)
+
+    # -- rank geometry ---------------------------------------------------
+
+    def locate(self, global_rank: int) -> tuple[int, int]:
+        """(owning process, local index) of a global rank."""
+        if not 0 <= global_rank < self.size:
+            raise MPIRankError(f"rank {global_rank} outside [0, {self.size})")
+        for p in range(self.nprocs):
+            if global_rank < self.offsets[p + 1]:
+                return p, global_rank - self.offsets[p]
+        raise MPIRankError(str(global_rank))  # pragma: no cover
+
+    def proc_range(self, p: int) -> tuple[int, int]:
+        return self.offsets[p], self.offsets[p + 1]
+
+    def _check(self):
+        if self._freed:
+            raise MPICommError(f"{self.name} has been freed")
+
+    # -- coll table ------------------------------------------------------
+
+    @property
+    def coll(self) -> CollTable:
+        self._check()
+        if self._coll is None:
+            self._coll = select_coll_modules(self, mca.default_context().framework("coll"))
+        return self._coll
+
+    @property
+    def mesh(self) -> CommMesh:
+        return self.local_mesh
+
+    # -- collectives (local rank-major buffers (local_n, ...)) ----------
+
+    def allreduce(self, x, op: Op = SUM):
+        return self.coll.lookup("allreduce")(x, op)
+
+    def iallreduce(self, x, op: Op = SUM) -> Request:
+        return self.coll.lookup("iallreduce")(x, op)
+
+    def bcast(self, x, root: int = 0):
+        return self.coll.lookup("bcast")(x, root)
+
+    def reduce(self, x, op: Op = SUM, root: int = 0):
+        return self.coll.lookup("reduce")(x, op, root)
+
+    def allgather(self, x):
+        return self.coll.lookup("allgather")(x)
+
+    def gather(self, x, root: int = 0):
+        out = self.coll.lookup("gather")(x, root)
+        return out[0] if out.ndim and out.shape[0] == self.local_size else out
+
+    def scatter(self, x, root: int = 0):
+        return self.coll.lookup("scatter")(x, root)
+
+    def reduce_scatter_block(self, x, op: Op = SUM):
+        return self.coll.lookup("reduce_scatter_block")(x, op)
+
+    def alltoall(self, x):
+        return self.coll.lookup("alltoall")(x)
+
+    def scan(self, x, op: Op = SUM):
+        return self.coll.lookup("scan")(x, op)
+
+    def exscan(self, x, op: Op = SUM):
+        return self.coll.lookup("exscan")(x, op)
+
+    def barrier(self) -> None:
+        self.coll.lookup("barrier")()
+
+    def allgatherv(self, blocks: Sequence[np.ndarray]):
+        return self.coll.lookup("allgatherv")(blocks)
+
+    # -- p2p -------------------------------------------------------------
+
+    @property
+    def pml(self) -> MatchingEngine:
+        self._check()
+        if self._pml is None:
+            # raced by the TCP receiver thread (first inbound frame) vs
+            # the main thread's first recv — double-checked lock
+            with self._pml_lock:
+                if self._pml is None:
+                    comp = mca.default_context().framework("pml").select_one()
+                    self._pml = comp.make_engine(self.size)
+        return self._pml
+
+    def _on_p2p_frame(self, env: dict, payload: np.ndarray) -> None:
+        self.pml.send(env["src"], env["dst"], payload, env["tag"])
+
+    def send(self, buf, source: int, dest: int, tag: int = 0) -> None:
+        """Send from a LOCAL global rank ``source`` to any global rank."""
+        sproc, _ = self.locate(source)
+        if sproc != self.proc:
+            raise MPIRankError(
+                f"rank {source} is owned by process {sproc}, not {self.proc}"
+            )
+        dproc, _ = self.locate(dest)
+        if dproc == self.proc:
+            self.pml.send(source, dest, buf, tag)
+        else:
+            self.dcn.send_p2p(
+                dproc,
+                {"cid": self.cid, "src": source, "dst": dest, "tag": tag},
+                np.asarray(buf),
+            )
+
+    def irecv(self, dest: int, source: int | None = None, tag: int | None = None) -> Request:
+        dproc, _ = self.locate(dest)
+        if dproc != self.proc:
+            raise MPIRankError(f"rank {dest} not owned by process {self.proc}")
+        return self.pml.irecv(
+            dest,
+            ANY_SOURCE if source is None else source,
+            ANY_TAG if tag is None else tag,
+        )
+
+    def recv(self, dest: int, source: int | None = None, tag: int | None = None):
+        req = self.irecv(dest, source, tag)
+        return req.wait(), req.status
+
+    # -- lifecycle -------------------------------------------------------
+
+    def dup(self, name: str = "") -> "MultiProcComm":
+        c = MultiProcComm.__new__(MultiProcComm)
+        c.__dict__.update(self.__dict__)
+        c.cid = _next_cid()
+        c.name = name or f"{self.name}.dup"
+        c._coll = None
+        c._pml = None
+        c._pml_lock = threading.Lock()
+        c._freed = False
+        c.dcn.register_p2p(c.cid, c._on_p2p_frame)
+        return c
+
+    def free(self) -> None:
+        self.dcn.unregister_p2p(self.cid)
+        self._freed = True
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"<MultiProcComm {self.name} size={self.size} "
+            f"proc={self.proc}/{self.nprocs} local={self.local_size}>"
+        )

@@ -1,0 +1,47 @@
+"""Per-process bootstrap — rank/size/rendezvous from the environment.
+
+≈ ``ess`` (environment-specific services) + the PMIx client init +
+modex of SURVEY.md §3.2: a worker launched by ``tpurun`` reads its
+process index and the coordinator address from env vars, connects the
+KVS, publishes its DCN endpoint (``PMIx_Put`` + ``PMIx_Commit``),
+fences, and collects peer endpoints (lazy ``PMIx_Get`` collapsed to an
+eager exchange — process counts are small).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ompi_tpu.dcn.collops import DcnCollEngine
+from .kvs import KVSClient
+
+ENV_PROC = "OMPI_TPU_PROC"
+ENV_NPROCS = "OMPI_TPU_NPROCS"
+ENV_KVS = "OMPI_TPU_KVS_ADDR"
+
+
+def launched_by_tpurun() -> bool:
+    return ENV_PROC in os.environ
+
+
+class ProcContext:
+    """This process's place in a tpurun job."""
+
+    def __init__(self):
+        self.proc = int(os.environ[ENV_PROC])
+        self.nprocs = int(os.environ[ENV_NPROCS])
+        self.kvs = KVSClient(os.environ[ENV_KVS])
+        # modex: publish DCN endpoint, fence, gather peers
+        self.engine = DcnCollEngine(self.proc, self.nprocs)
+        self.kvs.put(f"dcn.{self.proc}", self.engine.transport.address)
+        self.kvs.fence("modex", self.proc, self.nprocs)
+        self.engine.set_addresses(
+            [self.kvs.get(f"dcn.{p}") for p in range(self.nprocs)]
+        )
+
+    def fence(self, name: str) -> None:
+        self.kvs.fence(name, self.proc, self.nprocs)
+
+    def close(self) -> None:
+        self.engine.close()
+        self.kvs.close()

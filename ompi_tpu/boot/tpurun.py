@@ -1,0 +1,144 @@
+"""``tpurun`` — the job launcher (mpirun/prterun-equivalent).
+
+≈ the reference's launch path (SURVEY.md §3.1): ``mpirun`` parses the
+schizo/ompi CLI (``-np``, ``--mca k v``), hosts the PMIx server, maps
+ranks, forks workers, forwards their stdio, tracks job state, and kills
+the job on first failure (errmgr default).  Here:
+
+* KVS server in the launcher process (≈ mpirun's embedded PMIx server);
+* local fork of N worker processes (``plm`` ≈ odls fork/exec; remote
+  nodes would add an ssh leg — single-host in this environment);
+* ``--mca`` params propagated via ``OMPI_MCA_*`` env
+  (≈ mca_base_var_build_env);
+* stdio forwarding with ``[rank]`` prefixes (≈ iof);
+* first nonzero exit → terminate the job, propagate the code.
+
+Usage::
+
+    python -m ompi_tpu run -np 4 [--mca k v ...] [--cpu-devices K] script.py [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+from .kvs import KVSServer
+from .proc import ENV_KVS, ENV_NPROCS, ENV_PROC
+
+
+def _forward(stream, prefix: str, out) -> None:
+    for line in iter(stream.readline, b""):
+        out.write(f"[{prefix}] ".encode() + line)
+        out.flush()
+
+
+def run_job(
+    np_: int,
+    argv: list[str],
+    mca: dict[str, str] | None = None,
+    cpu_devices: int | None = None,
+    extra_env: dict[str, str] | None = None,
+) -> int:
+    server = KVSServer()
+    procs: list[subprocess.Popen] = []
+    threads: list[threading.Thread] = []
+    # workers must find the framework regardless of script location
+    # (≈ mpirun's LD_LIBRARY_PATH forwarding for libmpi)
+    import ompi_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ompi_tpu.__file__)))
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_root + (
+                ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            )
+            env[ENV_PROC] = str(rank)
+            env[ENV_NPROCS] = str(np_)
+            env[ENV_KVS] = server.address
+            for k, v in (mca or {}).items():
+                env[f"OMPI_MCA_{k}"] = v
+            if cpu_devices is not None:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={cpu_devices}"
+                ).strip()
+                # CPU-only workers must not touch TPU plugin site hooks:
+                # some PJRT plugin sitecustomize modules dial the device
+                # service at interpreter start regardless of JAX_PLATFORMS
+                # and can block the whole job on a wedged fabric.
+                env["PYTHONPATH"] = ":".join(
+                    p for p in env["PYTHONPATH"].split(":")
+                    if p and "axon" not in p
+                )
+            env.update(extra_env or {})
+            p = subprocess.Popen(
+                [sys.executable] + argv,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            procs.append(p)
+            t = threading.Thread(
+                target=_forward, args=(p.stdout, str(rank), sys.stdout.buffer), daemon=True
+            )
+            t.start()
+            threads.append(t)
+
+        # job state machine: poll ALL children so a failure anywhere
+        # kills the job even while other ranks block (errmgr default)
+        exit_code = 0
+        live = set(range(np_))
+        import time as _time
+
+        while live:
+            for i in sorted(live):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                live.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+            if live:
+                _time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=2)
+        return exit_code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpurun", description="Launch an ompi_tpu job (mpirun-equivalent)"
+    )
+    parser.add_argument("-np", type=int, required=True, help="number of processes")
+    parser.add_argument(
+        "--mca", nargs=2, action="append", default=[], metavar=("KEY", "VALUE"),
+        help="MCA parameter (repeatable), e.g. --mca coll xla",
+    )
+    parser.add_argument(
+        "--cpu-devices", type=int, default=None,
+        help="per-process virtual CPU device count (testing without TPU)",
+    )
+    parser.add_argument("script", help="python script to run")
+    parser.add_argument("args", nargs=argparse.REMAINDER)
+    ns = parser.parse_args(argv)
+    mca = {k: v for k, v in ns.mca}
+    return run_job(ns.np, [ns.script] + ns.args, mca, ns.cpu_devices)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,4 +166,8 @@ class BasicCollComponent(Component):
     PRIORITY = 10
 
     def query(self, comm) -> BasicCollModule | None:
+        # host fold over LOCAL rank-major rows: wrong on comms that span
+        # processes (remote ranks invisible) — decline those (han serves)
+        if getattr(comm, "dcn", None) is not None:
+            return None
         return BasicCollModule(comm)

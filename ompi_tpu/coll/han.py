@@ -1,0 +1,260 @@
+"""``coll/han`` — hierarchical collectives: fabric intra-slice + DCN
+inter-slice.
+
+≈ the reference's ``coll/han`` ([bin] ``mca_coll_han_comm_create``,
+``mca_coll_han_topo_init``, ``mca_coll_han_allreduce_reproducible``;
+SURVEY.md §2.2): split the communicator into a low (intra-node → here:
+intra-slice ICI mesh) and an up (inter-node → here: inter-process DCN)
+level and compose per-level collectives.
+
+Composition per collective (the han *_intra_simple shapes):
+
+* allreduce: local fabric allreduce → one row D2H → DCN allreduce
+  (process-ordered fold — reproducible by construction) → H2D bcast;
+* bcast: root slice DCN-bcasts the root row → local fabric bcast;
+* allgather: local allgather → DCN allgather → ordered concat;
+* reduce_scatter_block / alltoall: DCN exchange of slice blocks +
+  local fabric redistribution;
+* barrier: local fabric barrier + DCN token.
+
+The module serves :class:`ompi_tpu.api.multiproc.MultiProcComm`
+communicators (``comm.dcn`` present); on single-process communicators
+``query`` declines, so han never shadows coll/xla there — the same
+"am I applicable" gate han's comm_query performs in the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.core.registry import Component, register_component
+from ompi_tpu.op.op import Op
+from ompi_tpu.request import CompletedRequest, PersistentRequest, Request
+from .module import COLL_OPS, CollModule
+
+
+class HanCollModule(CollModule):
+    """Two-level collective module for multi-process communicators."""
+
+    def __init__(self, comm, component: "HanCollComponent"):
+        super().__init__(comm)
+        self.component = component
+
+    # comm contract: comm.local (intra-slice Comm over this process's
+    # mesh), comm.dcn (DcnCollEngine), comm.cid, comm.local_size,
+    # comm.nprocs, comm.proc
+
+    # -- allreduce ------------------------------------------------------
+
+    def allreduce(self, x, op: Op):
+        """Two-level fold: slice-local fabric reduce, then the
+        process-ordered DCN fold. Deterministic bracketing
+        ((slice0)(slice1)…) — the han-reproducible guarantee is
+        run-to-run determinism of this fixed tree, not equality with
+        the flat rank-order fold (same contract as the reference's
+        reproducible mode). Set coll_xla_reproducible=1 to also pin the
+        intra-slice order."""
+        comm = self.comm
+        x = np.asarray(x)
+        local = np.asarray(comm.local.allreduce(x, op))  # (ln, *s), equal rows
+        partial = local[0]
+        combined = comm.dcn.allreduce(partial, op, comm.cid)
+        return np.broadcast_to(combined, x.shape).copy()
+
+    def reduce(self, x, op: Op, root: int = 0):
+        return self.allreduce(x, op)
+
+    # -- bcast ----------------------------------------------------------
+
+    def bcast(self, x, root: int = 0):
+        comm = self.comm
+        x = np.asarray(x)
+        root_proc, root_local = comm.locate(root)
+        if comm.proc == root_proc:
+            row = np.asarray(x[root_local])
+        else:
+            row = np.zeros(x.shape[1:], x.dtype)
+        row = comm.dcn.bcast(row, root_proc, comm.cid)
+        return np.broadcast_to(row, x.shape).copy()
+
+    # -- allgather -------------------------------------------------------
+
+    def allgather(self, x):
+        comm = self.comm
+        x = np.asarray(x)  # (ln, *s): this process's ranks' rows
+        slices = comm.dcn.allgather(x, comm.cid)  # [per-proc (ln_p, *s)]
+        full = np.concatenate(slices, axis=0)  # (global_n, *s)
+        out = np.broadcast_to(full[None], (x.shape[0],) + full.shape)
+        return out.copy()
+
+    def gather(self, x, root: int = 0):
+        return self.allgather(x)
+
+    def scatter(self, x, root: int = 0):
+        comm = self.comm
+        x = np.asarray(x)  # (global_n, *s) meaningful on root's process
+        root_proc, _ = comm.locate(root)
+        # per-destination slices: O(global bytes) on the DCN, not O(P x)
+        blocks = None
+        if comm.proc == root_proc:
+            blocks = [
+                np.ascontiguousarray(x[comm.offsets[p] : comm.offsets[p + 1]])
+                for p in range(comm.nprocs)
+            ]
+        return comm.dcn.scatter(blocks, root_proc, comm.cid).copy()
+
+    # -- reduce_scatter_block / alltoall --------------------------------
+
+    def reduce_scatter_block(self, x, op: Op):
+        comm = self.comm
+        x = np.asarray(x)  # (ln, global_n, *s)
+        red = self.allreduce_rows(x, op)  # (global_n, *s) combined
+        lo = comm.local_offset
+        return red[lo : lo + comm.local_size].copy()
+
+    def allreduce_rows(self, x, op: Op):
+        comm = self.comm
+        local = np.asarray(comm.local.allreduce(x, op))[0]  # (global_n, *s)
+        return comm.dcn.allreduce(local, op, comm.cid)
+
+    def reduce_scatter(self, x, op: Op, counts=None):
+        if counts is not None and len(set(counts)) != 1:
+            raise NotImplementedError(
+                "jagged reduce_scatter on multi-process comms: next round"
+            )
+        return self.reduce_scatter_block(x, op)
+
+    def alltoall(self, x):
+        comm = self.comm
+        x = np.asarray(x)  # (ln, global_n, *s): row r→ global dest j
+        # group columns by destination process, DCN-exchange, reassemble
+        blocks = []
+        for p in range(comm.nprocs):
+            lo, hi = comm.proc_range(p)
+            blocks.append(np.ascontiguousarray(x[:, lo:hi]))  # (ln, ln_p, *s)
+        got = comm.dcn.alltoall(blocks, comm.cid)  # got[p]: (ln_p, ln, *s)
+        # out[local j, global src] = x_src_proc[src_local, global j]
+        cols = [np.moveaxis(g, 0, 1) for g in got]  # (ln, ln_p, *s) per p
+        return np.concatenate(cols, axis=1)  # (ln, global_n, *s)
+
+    # -- barrier / scan -------------------------------------------------
+
+    def barrier(self):
+        self.comm.local.barrier()
+        self.comm.dcn.barrier(self.comm.cid)
+
+    def scan(self, x, op: Op):
+        comm = self.comm
+        x = np.asarray(x)
+        slices = comm.dcn.allgather(x, comm.cid)
+        full = np.concatenate(slices, axis=0)
+        out = np.empty_like(full)
+        acc = full[0].copy()
+        out[0] = acc
+        for r in range(1, full.shape[0]):
+            acc = op.np_fn(acc, full[r])
+            out[r] = acc
+        lo = comm.local_offset
+        return out[lo : lo + comm.local_size].copy()
+
+    def exscan(self, x, op: Op):
+        comm = self.comm
+        x = np.asarray(x)
+        slices = comm.dcn.allgather(x, comm.cid)
+        full = np.concatenate(slices, axis=0)
+        out = np.zeros_like(full)
+        if full.shape[0] > 1:
+            acc = full[0].copy()
+            out[1] = acc
+            for r in range(2, full.shape[0]):
+                acc = op.np_fn(acc, full[r - 1])
+                out[r] = acc
+        lo = comm.local_offset
+        return out[lo : lo + comm.local_size].copy()
+
+    # -- jagged variants -------------------------------------------------
+
+    def allgatherv(self, blocks):
+        """Jagged allgather preserving each block's shape and dtype:
+        per-process payload is one uint8 byte stream; shapes/dtypes ride
+        the envelope metadata."""
+        comm = self.comm
+        arrs = [np.ascontiguousarray(b) for b in blocks]
+        meta = [{"shape": list(a.shape), "dtype": a.dtype.str} for a in arrs]
+        payload = (
+            np.concatenate([a.view(np.uint8).reshape(-1) for a in arrs])
+            if arrs
+            else np.zeros(0, np.uint8)
+        )
+        datas = comm.dcn.allgather(payload, comm.cid)
+        metas = comm.dcn.allgather_obj(meta, comm.cid)
+        out = []
+        for data, ms in zip(datas, metas):
+            data = data.view(np.uint8)
+            off = 0
+            for m in ms:
+                dt = np.dtype(m["dtype"])
+                nbytes = dt.itemsize * int(np.prod(m["shape"], dtype=np.int64))
+                out.append(
+                    data[off : off + nbytes].view(dt).reshape(m["shape"]).copy()
+                )
+                off += nbytes
+        return out
+
+    def gatherv(self, blocks, root: int = 0):
+        return self.allgatherv(blocks)
+
+    def scatterv(self, blocks, root: int = 0):
+        raise NotImplementedError("scatterv on multi-process comms: next round")
+
+    def alltoallv(self, matrix):
+        raise NotImplementedError("alltoallv on multi-process comms: next round")
+
+    # -- non-blocking / persistent derivation ---------------------------
+
+    def __getattr__(self, name: str):
+        if name.startswith("i") and name[1:] in COLL_OPS:
+            blocking = getattr(self, name[1:])
+
+            def ivariant(*a, **k) -> Request:
+                return CompletedRequest(blocking(*a, **k))
+
+            return ivariant
+        if name.endswith("_init") and name[: -len("_init")] in COLL_OPS:
+            blocking = getattr(self, name[: -len("_init")])
+
+            def init_variant(*a, **k) -> PersistentRequest:
+                return PersistentRequest(lambda: CompletedRequest(blocking(*a, **k)))
+
+            return init_variant
+        raise AttributeError(name)
+
+
+@register_component
+class HanCollComponent(Component):
+    """``coll/han`` MCA component — hierarchical two-level collectives.
+
+    Priority above xla: on communicators where it applies (multi-process)
+    it must win; on single-process comms query() declines."""
+
+    FRAMEWORK = "coll"
+    NAME = "han"
+    PRIORITY = 95
+
+    def __init__(self):
+        super().__init__()
+        self.store = None
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self.store = store
+        store.register(
+            "coll", "han", "reproducible", False,
+            help="Force deterministic process-ordered inter-slice folds "
+            "(≈ mca_coll_han_allreduce_reproducible)",
+        )
+
+    def query(self, comm) -> HanCollModule | None:
+        if getattr(comm, "dcn", None) is None:
+            return None
+        return HanCollModule(comm, self)

@@ -520,7 +520,9 @@ class XlaCollComponent(Component):
             return False
 
     def query(self, comm) -> XlaCollModule | None:
-        # Serve any communicator whose mesh spans ≥1 device.
-        if comm.size < 1:
+        # Serve single-process communicators whose mesh spans ≥1 device;
+        # multi-process comms are han's (remote ranks are not on this
+        # process's fabric).
+        if comm.size < 1 or getattr(comm, "dcn", None) is not None:
             return None
         return XlaCollModule(comm, self)

@@ -13,18 +13,36 @@ from .registry import MCAContext, load_external_components
 
 _default: MCAContext | None = None
 
+#: modules whose import registers the in-tree components (≈ the
+#: component .so files mca_base scans at startup)
+_BUILTIN_COMPONENT_MODULES = (
+    "ompi_tpu.mesh.mesh",
+    "ompi_tpu.coll",
+    "ompi_tpu.p2p.component",
+)
+
+
+def _load_builtin_components() -> None:
+    import importlib
+
+    for mod in _BUILTIN_COMPONENT_MODULES:
+        importlib.import_module(mod)
+
 
 def default_context() -> MCAContext:
     global _default
     if _default is None:
+        _load_builtin_components()
         load_external_components()
         _default = MCAContext()
+    _default.refresh_components()
     return _default
 
 
 def init(cmdline: dict[str, str] | None = None) -> MCAContext:
     """(Re)create the default context with command-line ``--mca`` params."""
     global _default
+    _load_builtin_components()
     load_external_components()
     _default = MCAContext(cmdline=cmdline)
     return _default

@@ -1,0 +1,221 @@
+"""Inter-process collectives over the DCN TCP transport.
+
+The host-side half of the han composition (SURVEY.md §2.7): these run
+BETWEEN worker processes ("slices"), on numpy arrays that have already
+been reduced/gathered on each process's local fabric.  Process count is
+small (one per slice), so the algorithms favor determinism and
+simplicity over asymptotics:
+
+* ``allreduce``: gather-to-root with **process-ordered fold** (proc 0,
+  1, 2, … — the deterministic order that keeps the multi-slice result
+  reproducible) then broadcast;
+* ``allgather``/``alltoall``: direct exchanges;
+* ``barrier``: token allreduce.
+
+Message matching: every collective on a (cid) stream carries a
+monotonically increasing sequence number; SPMD discipline (all
+processes issue collectives in the same order per communicator — the
+same requirement MPI imposes) guarantees frames pair up.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ompi_tpu.op.op import Op
+from .tcp import TcpTransport
+
+
+class DcnCollEngine:
+    """Per-process engine: transport + peer addresses + frame routing.
+
+    Two-phase bring-up matching the modex: construct (opens the listen
+    socket, so ``address`` can be published), then ``set_addresses``
+    with every peer's endpoint after the fence."""
+
+    def __init__(self, proc: int, nprocs: int, addresses: Sequence[str] | None = None):
+        self.proc = proc
+        self.nprocs = nprocs
+        self.addresses: list[str] = list(addresses) if addresses else []
+        self._queues: dict[tuple, queue.Queue] = {}
+        self._qlock = threading.Lock()
+        self._seq: dict[int, int] = {}
+        #: cid → handler: p2p frames are routed per-communicator so
+        #: dup'd comms keep isolated matching (MPI comm isolation)
+        self._p2p_handlers: dict[int, Callable] = {}
+        self.transport = TcpTransport(self._on_frame)
+
+    def set_addresses(self, addresses: Sequence[str]) -> None:
+        if len(addresses) != self.nprocs:
+            raise ValueError("address count != nprocs")
+        self.addresses = list(addresses)
+
+    @property
+    def address(self) -> str:
+        return self.transport.address
+
+    def register_p2p(self, cid: int, fn: Callable[[dict, np.ndarray], None]) -> None:
+        """Route kind='p2p' frames carrying this cid to the given
+        communicator's matching engine (the BTL→pml callback path)."""
+        self._p2p_handlers[cid] = fn
+
+    def unregister_p2p(self, cid: int) -> None:
+        self._p2p_handlers.pop(cid, None)
+
+    # -- frame routing ---------------------------------------------------
+
+    def _queue(self, key: tuple) -> queue.Queue:
+        with self._qlock:
+            q = self._queues.get(key)
+            if q is None:
+                q = queue.Queue()
+                self._queues[key] = q
+            return q
+
+    def _on_frame(self, env: dict, payload: np.ndarray) -> None:
+        if env.get("kind") == "p2p":
+            fn = self._p2p_handlers.get(env.get("cid"))
+            if fn is not None:
+                fn(env, payload)
+            return
+        key = (env["cid"], env["seq"], env["src"])
+        self._queue(key).put((env, payload))
+
+    def _next_seq(self, cid: int) -> int:
+        s = self._seq.get(cid, 0)
+        self._seq[cid] = s + 1
+        return s
+
+    def _send(self, dst: int, cid: int, seq: int, payload: np.ndarray, meta=None) -> None:
+        env = {"kind": "coll", "cid": cid, "seq": seq, "src": self.proc}
+        if meta is not None:
+            env["meta"] = meta
+        self.transport.send(self.addresses[dst], env, payload)
+
+    def _recv(self, src: int, cid: int, seq: int, timeout: float = 120.0) -> np.ndarray:
+        return self._recv_full(src, cid, seq, timeout)[1]
+
+    def _recv_full(self, src: int, cid: int, seq: int, timeout: float = 120.0):
+        try:
+            return self._queue((cid, seq, src)).get(timeout=timeout)
+        except queue.Empty:
+            from ompi_tpu.core.errors import MPIInternalError
+
+            raise MPIInternalError(
+                f"DCN recv timeout after {timeout}s: proc {self.proc} waiting "
+                f"for proc {src} (cid={cid}, seq={seq}) — peer dead or "
+                f"collective order mismatch"
+            ) from None
+
+    def send_p2p(self, dst_proc: int, envelope: dict, payload: np.ndarray) -> None:
+        envelope = dict(envelope)
+        envelope["kind"] = "p2p"
+        self.transport.send(self.addresses[dst_proc], envelope, payload)
+
+    # -- collectives -----------------------------------------------------
+
+    def allreduce(self, x: np.ndarray, op: Op, cid: int) -> np.ndarray:
+        """Process-ordered fold at proc 0, then broadcast (deterministic
+        multi-slice order for reproducibility)."""
+        if self.nprocs == 1:
+            return x
+        seq_gather = self._next_seq(cid)
+        seq_bcast = self._next_seq(cid)
+        if self.proc == 0:
+            acc = x
+            for p in range(1, self.nprocs):
+                acc = op.np_fn(acc, self._recv(p, cid, seq_gather))
+            for p in range(1, self.nprocs):
+                self._send(p, cid, seq_bcast, acc)
+            return np.asarray(acc)
+        self._send(0, cid, seq_gather, x)
+        return self._recv(0, cid, seq_bcast)
+
+    def bcast(self, x: np.ndarray, root: int, cid: int) -> np.ndarray:
+        if self.nprocs == 1:
+            return x
+        seq = self._next_seq(cid)
+        if self.proc == root:
+            for p in range(self.nprocs):
+                if p != root:
+                    self._send(p, cid, seq, x)
+            return x
+        return self._recv(root, cid, seq)
+
+    def allgather(self, x: np.ndarray, cid: int) -> list[np.ndarray]:
+        """Returns [proc 0's x, proc 1's x, …] on every process."""
+        if self.nprocs == 1:
+            return [x]
+        seq = self._next_seq(cid)
+        for p in range(self.nprocs):
+            if p != self.proc:
+                self._send(p, cid, seq, x)
+        out = []
+        for p in range(self.nprocs):
+            out.append(x if p == self.proc else self._recv(p, cid, seq))
+        return out
+
+    def alltoall(self, blocks: Sequence[np.ndarray], cid: int) -> list[np.ndarray]:
+        """blocks[p] goes to process p; returns what each process sent us."""
+        if self.nprocs == 1:
+            return [np.asarray(blocks[0])]
+        seq = self._next_seq(cid)
+        for p in range(self.nprocs):
+            if p != self.proc:
+                self._send(p, cid, seq, np.asarray(blocks[p]))
+        out = []
+        for p in range(self.nprocs):
+            out.append(
+                np.asarray(blocks[self.proc]) if p == self.proc else self._recv(p, cid, seq)
+            )
+        return out
+
+    def allgather_obj(self, obj, cid: int) -> list:
+        """Allgather of a small JSON-serializable object (rides the
+        frame envelope; control metadata only, e.g. jagged shapes)."""
+        if self.nprocs == 1:
+            return [obj]
+        seq = self._next_seq(cid)
+        empty = np.zeros(0, np.uint8)
+        for p in range(self.nprocs):
+            if p != self.proc:
+                self._send(p, cid, seq, empty, meta=obj)
+        out = []
+        for p in range(self.nprocs):
+            if p == self.proc:
+                out.append(obj)
+            else:
+                env, _ = self._recv_full(p, cid, seq)
+                out.append(env.get("meta"))
+        return out
+
+    def scatter(self, blocks_by_proc: Sequence[np.ndarray] | None, root: int, cid: int) -> np.ndarray:
+        """Root sends block p to process p (O(N) wire bytes); others
+        receive their block. ``blocks_by_proc`` meaningful on root."""
+        if self.nprocs == 1:
+            return np.asarray(blocks_by_proc[0])
+        seq = self._next_seq(cid)
+        if self.proc == root:
+            for p in range(self.nprocs):
+                if p != root:
+                    self._send(p, cid, seq, np.asarray(blocks_by_proc[p]))
+            return np.asarray(blocks_by_proc[root])
+        return self._recv(root, cid, seq)
+
+    def barrier(self, cid: int) -> None:
+        self.allreduce(np.zeros(1, np.int32), _SUM_TOKEN, cid)
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+class _TokenSum:
+    name = "token_sum"
+    np_fn = staticmethod(lambda a, b: a + b)
+
+
+_SUM_TOKEN = _TokenSum()

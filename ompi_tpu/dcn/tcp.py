@@ -1,0 +1,145 @@
+"""DCN TCP transport — inter-process byte movement (btl/tcp-equivalent).
+
+≈ ``opal/mca/btl/tcp`` (``mca_btl_tcp_endpoint_send``,
+``mca_btl_tcp_add_procs`` [bin], SURVEY.md §2.3/§2.7): the host-NIC
+transport carrying traffic the fabric cannot — here, inter-slice (DCN)
+segments between worker processes.  Faithful behaviors:
+
+* **lazy connect** (add_procs): a peer connection is dialed on first
+  send, using the endpoint address published in the KVS modex;
+* framed messages with a (cid, src, dst, tag) envelope — the BTL
+  header that lets the receiver route into the right matching engine;
+* a receiver thread per process (≈ the libevent progress loop)
+  delivering frames to registered handlers.
+
+Payloads are numpy-native (dtype/shape header + raw bytes): no pickle
+on the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable
+
+import numpy as np
+
+_HDR = struct.Struct("!I")  # frame length
+
+
+def _pack_array(arr: np.ndarray) -> tuple[bytes, bytes]:
+    arr = np.ascontiguousarray(arr)
+    meta = json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)}).encode()
+    return meta, arr.tobytes()
+
+
+def _unpack_array(meta: bytes, raw: bytes) -> np.ndarray:
+    m = json.loads(meta.decode())
+    return np.frombuffer(raw, dtype=np.dtype(m["dtype"])).reshape(m["shape"]).copy()
+
+
+def _send_msg(sock: socket.socket, lock: threading.Lock, envelope: dict, payload: np.ndarray) -> None:
+    meta, raw = _pack_array(payload)
+    env = json.dumps(envelope).encode()
+    header = struct.pack("!III", len(env), len(meta), len(raw))
+    with lock:  # frames from concurrent senders must not interleave
+        sock.sendall(header + env + meta + raw)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("dcn peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[dict, np.ndarray]:
+    elen, mlen, rlen = struct.unpack("!III", _recv_exact(sock, 12))
+    env = json.loads(_recv_exact(sock, elen).decode())
+    meta = _recv_exact(sock, mlen)
+    raw = _recv_exact(sock, rlen) if rlen else b""
+    return env, _unpack_array(meta, raw)
+
+
+class TcpTransport:
+    """One per process: listen socket + lazy peer connections +
+    receiver threads delivering to a handler."""
+
+    def __init__(self, handler: Callable[[dict, np.ndarray], None], host: str = "127.0.0.1"):
+        self._handler = handler
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, 0))
+        self._listen.listen(64)
+        self.address = "%s:%d" % self._listen.getsockname()
+        self._peers: dict[str, tuple[socket.socket, threading.Lock]] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # -- receive side ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._recv_loop, args=(conn,), daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        import sys
+
+        try:
+            while self._running:
+                env, payload = _recv_msg(conn)
+                try:
+                    self._handler(env, payload)
+                except Exception as e:  # a bad frame must not kill the
+                    # receiver thread — later frames from this peer
+                    # (other communicators!) still need delivery
+                    print(
+                        f"[ompi_tpu dcn] handler error for frame {env}: "
+                        f"{type(e).__name__}: {e}",
+                        file=sys.stderr,
+                    )
+        except (ConnectionError, OSError):
+            return
+
+    # -- send side (lazy connect ≈ add_procs) ---------------------------
+
+    def _peer(self, address: str) -> tuple[socket.socket, threading.Lock]:
+        with self._lock:
+            entry = self._peers.get(address)
+            if entry is None:
+                host, port = address.rsplit(":", 1)
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock.connect((host, int(port)))
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                entry = (sock, threading.Lock())
+                self._peers[address] = entry
+            return entry
+
+    def send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
+        sock, lock = self._peer(address)
+        _send_msg(sock, lock, envelope, payload)
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s, _ in self._peers.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._peers.clear()

@@ -1,0 +1,11 @@
+"""Point-to-point layer (≈ ompi/mca/pml, SURVEY.md §2.2)."""
+
+from .pml import (  # noqa: F401
+    ANY_SOURCE,
+    ANY_TAG,
+    PROC_NULL,
+    MatchingEngine,
+    RecvRequest,
+    Status,
+)
+from .component import EagerPmlComponent  # noqa: F401

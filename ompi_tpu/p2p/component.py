@@ -1,0 +1,28 @@
+"""``pml/eager`` MCA component — matching-engine provider.
+
+≈ the pml framework's component slot (ob1/cm/ucx in the reference);
+one pml is selected per job (SURVEY.md §2.2 "One pml is selected per
+job"), enforced here via Framework.select_one().
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.core.registry import Component, register_component
+from .pml import MatchingEngine
+
+
+@register_component
+class EagerPmlComponent(Component):
+    FRAMEWORK = "pml"
+    NAME = "eager"
+    PRIORITY = 50
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "pml", "eager", "max_pending", 1 << 20, type="int",
+            help="Soft cap on unexpected-queue length before warnings",
+        )
+
+    def make_engine(self, comm_size: int) -> MatchingEngine:
+        return MatchingEngine(comm_size)

@@ -1,0 +1,200 @@
+"""p2p matching engine + topology tests.
+
+Matching semantics mirror the ob1 contracts (SURVEY.md §2.2): wildcard
+matching, non-overtaking order, probe, PROC_NULL; cart/graph mirror the
+MPI_Cart_*/Graph_* surface + MPI_Dims_create (topo/basic).
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.api.topo import CartComm, GraphComm, dims_create
+from ompi_tpu.core.errors import MPIArgError, MPIDimsError, MPIRankError
+from ompi_tpu.op import SUM
+from ompi_tpu.p2p import ANY_SOURCE, ANY_TAG, PROC_NULL
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+N = 8
+
+
+# -- p2p ---------------------------------------------------------------
+
+
+def test_send_then_recv(world):
+    data = np.arange(5, dtype=np.float32)
+    world.send(data, source=0, dest=3, tag=7)
+    payload, status = world.recv(dest=3, source=0, tag=7)
+    np.testing.assert_array_equal(payload, data)
+    assert (status.source, status.tag, status.count) == (0, 7, 5)
+
+
+def test_recv_posted_before_send(world):
+    req = world.irecv(dest=2, source=1, tag=9)
+    assert not req.test()
+    world.send(np.int32(42), source=1, dest=2, tag=9)
+    assert req.test()
+    assert req.wait() == 42
+    assert req.status.source == 1
+
+
+def test_eager_send_buffer_reuse(world):
+    buf = np.zeros(4, np.float32)
+    world.send(buf, source=0, dest=1, tag=1)
+    buf[:] = 99.0  # mutate after send: receiver must see the old value
+    payload, _ = world.recv(dest=1, source=0, tag=1)
+    np.testing.assert_array_equal(payload, np.zeros(4))
+
+
+def test_wildcards(world):
+    world.send(np.int32(1), source=4, dest=5, tag=11)
+    payload, st = world.recv(dest=5, source=None, tag=None)  # ANY/ANY
+    assert payload == 1 and st.source == 4 and st.tag == 11
+
+
+def test_non_overtaking_order(world):
+    for i in range(3):
+        world.send(np.int32(i), source=0, dest=6, tag=5)
+    got = [world.recv(dest=6, source=0, tag=5)[0] for _ in range(3)]
+    assert got == [0, 1, 2]
+
+
+def test_tag_selectivity(world):
+    world.send(np.int32(10), source=0, dest=7, tag=1)
+    world.send(np.int32(20), source=0, dest=7, tag=2)
+    p2, _ = world.recv(dest=7, source=0, tag=2)
+    p1, _ = world.recv(dest=7, source=0, tag=1)
+    assert (p1, p2) == (10, 20)
+
+
+def test_proc_null(world):
+    world.send(np.int32(1), source=0, dest=PROC_NULL)  # no-op
+    payload, st = world.recv(dest=0, source=PROC_NULL)
+    assert payload is None and st.source == PROC_NULL and st.count == 0
+
+
+def test_probe(world):
+    assert world.iprobe(dest=4) is None
+    world.send(np.arange(3), source=2, dest=4, tag=3)
+    st = world.iprobe(dest=4)
+    assert st is not None and st.source == 2 and st.count == 3
+    # probe does not consume
+    st2 = world.probe(dest=4, source=2, tag=3)
+    assert st2.count == 3
+    world.recv(dest=4)
+
+
+def test_sendrecv_ring(world):
+    """Classic ring rotation via sendrecv — the MPI_Cart_shift+Sendrecv
+    pattern (SURVEY.md §5 long-context mapping)."""
+    vals = [np.int64(100 + r) for r in range(N)]
+    # everyone sends right, receives from left
+    for r in range(N):
+        world.send(vals[r], source=r, dest=(r + 1) % N, tag=0)
+    got = [world.recv(dest=r, source=(r - 1) % N, tag=0)[0] for r in range(N)]
+    assert got == [100 + (r - 1) % N for r in range(N)]
+
+
+def test_send_bad_rank(world):
+    with pytest.raises(MPIRankError):
+        world.send(np.int32(0), source=0, dest=99)
+
+
+def test_negative_send_tag(world):
+    with pytest.raises(MPIArgError):
+        world.send(np.int32(0), source=0, dest=1, tag=-3)
+
+
+def test_device_array_p2p(world):
+    import jax
+
+    x = jax.numpy.arange(4.0)
+    world.send(x, source=0, dest=2, tag=8)
+    payload, st = world.recv(dest=2, source=0, tag=8)
+    assert isinstance(payload, jax.Array)
+    np.testing.assert_array_equal(np.asarray(payload), np.arange(4.0))
+    # eagerly moved to the receiver's device
+    assert list(payload.devices())[0] == world.mesh.devices[2]
+
+
+# -- dims_create -------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "nnodes,ndims,expect",
+    [(8, 3, [2, 2, 2]), (12, 2, [4, 3]), (6, 2, [3, 2]), (7, 1, [7]), (16, 2, [4, 4])],
+)
+def test_dims_create(nnodes, ndims, expect):
+    assert dims_create(nnodes, ndims) == expect
+
+
+def test_dims_create_fixed():
+    assert dims_create(12, 2, [0, 3]) == [4, 3]
+    with pytest.raises(MPIDimsError):
+        dims_create(7, 2, [2, 0])
+
+
+# -- cartesian ---------------------------------------------------------
+
+
+def test_cart_create_and_coords(world):
+    cart = CartComm(world, [2, 4], [True, False])
+    assert cart.size == 8
+    assert cart.cart_coords(5) == [1, 1]
+    assert cart.cart_rank([1, 1]) == 5
+    assert cart.cart_rank([3, 1]) == 5  # periodic dim 0 wraps
+    with pytest.raises(MPIArgError):
+        cart.cart_rank([0, 4])  # non-periodic dim 1 out of range
+
+
+def test_cart_shift(world):
+    cart = CartComm(world, [2, 4], [True, False])
+    src, dst = cart.cart_shift(0, 1, rank=1)  # dim0 periodic
+    assert (src, dst) == (5, 5)
+    src, dst = cart.cart_shift(1, 1, rank=3)  # coords [0,3], edge
+    assert src == 2 and dst == PROC_NULL
+
+
+def test_cart_collective(world):
+    cart = CartComm(world, [2, 4], [True, True])
+    x = np.round(np.random.RandomState(0).randn(8, 5))
+    out = cart.allreduce(x, SUM)
+    np.testing.assert_array_equal(np.asarray(out)[0], x.sum(0))
+
+
+def test_cart_sub(world):
+    cart = CartComm(world, [2, 4], [True, True])
+    subs = cart.cart_sub([False, True])  # keep columns → 2 row-comms
+    assert subs[0].size == 4
+    assert subs[0] is subs[1] is subs[2] is subs[3]
+    assert subs[4] is subs[5] and subs[4] is not subs[0]
+    assert subs[0].dims == (4,)
+    x = np.arange(4.0)[:, None]
+    out = subs[0].allreduce(x, SUM)
+    np.testing.assert_array_equal(np.asarray(out)[0], [6.0])
+
+
+def test_cart_too_big(world):
+    from ompi_tpu.core.errors import MPITopologyError
+
+    with pytest.raises(MPITopologyError):
+        CartComm(world, [3, 4], [True, True])
+
+
+# -- graph -------------------------------------------------------------
+
+
+def test_graph_comm(world):
+    # 4-node ring: neighbors of r are (r±1)%4
+    index = [2, 4, 6, 8]
+    edges = [1, 3, 2, 0, 3, 1, 0, 2]
+    g = GraphComm(world, index, edges)
+    assert g.size == 4
+    assert g.graph_neighbors(0) == [1, 3]
+    assert g.graph_neighbors(2) == [3, 1]
+    assert g.graph_neighbors_count(1) == 2

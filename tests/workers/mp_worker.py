@@ -1,0 +1,121 @@
+"""tpurun worker: exercises the multi-process world end-to-end.
+
+Launched by test_multiproc.py via the tpurun launcher with per-process
+virtual CPU devices. SPMD: every process runs this same script
+(the reference's `mpirun -np N ./a.out` shape, SURVEY.md §3.1).
+Prints one OK line per check; the test asserts on forwarded output.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import MAX, SUM
+
+world = api.init()
+p = world.proc
+ln = world.local_size
+n = world.size
+assert world.coll.providers["allreduce"] == "han", world.coll.providers["allreduce"]
+
+# deterministic per-rank data: global rank r holds r+1
+local_ranks = np.arange(world.local_offset, world.local_offset + ln)
+x = (local_ranks[:, None] + 1).astype(np.float64) * np.ones((ln, 4))
+
+out = world.allreduce(x, SUM)
+expect = n * (n + 1) / 2
+assert out.shape == (ln, 4), out.shape
+assert np.array_equal(out, np.full((ln, 4), expect)), out
+print(f"OK allreduce proc={p}")
+
+mx = world.allreduce(x, MAX)
+assert np.array_equal(mx, np.full((ln, 4), n)), mx
+print(f"OK allreduce_max proc={p}")
+
+b = world.bcast(x, root=n - 1)
+assert np.array_equal(b, np.full((ln, 4), n)), b
+print(f"OK bcast proc={p}")
+
+ag = world.allgather(x)
+assert ag.shape == (ln, n, 4), ag.shape
+assert np.array_equal(ag[0, :, 0], np.arange(1, n + 1)), ag[0, :, 0]
+print(f"OK allgather proc={p}")
+
+# reduce_scatter_block: rank-major (ln, n, k)
+blocks = np.ones((ln, n, 2), np.float64)
+rs = world.reduce_scatter_block(blocks, SUM)
+assert rs.shape == (ln, 2), rs.shape
+assert np.array_equal(rs, np.full((ln, 2), n)), rs
+print(f"OK reduce_scatter proc={p}")
+
+# alltoall: x[l, j] = 100*global_rank(l) + j
+a2a_in = np.stack(
+    [100 * (world.local_offset + l) + np.arange(n, dtype=np.float64) for l in range(ln)]
+)[..., None]
+a2a = world.alltoall(a2a_in)
+for l in range(ln):
+    gr = world.local_offset + l
+    expect_row = 100 * np.arange(n, dtype=np.float64) + gr
+    assert np.array_equal(a2a[l, :, 0], expect_row), (gr, a2a[l, :, 0])
+print(f"OK alltoall proc={p}")
+
+s = world.scan(x, SUM)
+for l in range(ln):
+    gr = world.local_offset + l
+    assert np.array_equal(s[l], np.full(4, (gr + 1) * (gr + 2) / 2)), s[l]
+print(f"OK scan proc={p}")
+
+world.barrier()
+print(f"OK barrier proc={p}")
+
+# cross-process p2p: global rank 0 sends to the LAST global rank
+if world.local_offset == 0:
+    world.send(np.arange(3, dtype=np.float64) + 7, source=0, dest=n - 1, tag=42)
+if world.local_offset + ln == n:
+    payload, st = world.recv(dest=n - 1, source=0, tag=42)
+    assert np.array_equal(payload, np.arange(3, dtype=np.float64) + 7)
+    assert st.source == 0 and st.tag == 42
+    print(f"OK p2p proc={p}")
+
+# jagged allgatherv across processes — shaped + mixed-dtype blocks
+blocks_v = [
+    np.full((2, world.local_offset + l + 1), world.local_offset + l,
+            np.int32 if (world.local_offset + l) % 2 == 0 else np.float64)
+    for l in range(ln)
+]
+gv = world.allgatherv(blocks_v)
+assert len(gv) == n
+for r in range(n):
+    want_dt = np.int32 if r % 2 == 0 else np.float64
+    assert gv[r].shape == (2, r + 1), (r, gv[r].shape)
+    assert gv[r].dtype == want_dt, (r, gv[r].dtype)
+    assert np.array_equal(gv[r], np.full((2, r + 1), r, want_dt)), (r, gv[r])
+print(f"OK allgatherv proc={p}")
+
+# scatter: root's (n, 3) rows → each process its slice
+sc_in = (np.arange(n)[:, None] * np.ones(3)).astype(np.float64)
+sc = world.scatter(sc_in, root=0)
+assert sc.shape == (ln, 3), sc.shape
+assert np.array_equal(sc[:, 0], np.arange(world.local_offset, world.local_offset + ln)), sc
+print(f"OK scatter proc={p}")
+
+# dup'd comm p2p isolation: messages on w2 must not leak into world
+w2 = world.dup()
+if world.local_offset == 0:
+    w2.send(np.int64(777), source=0, dest=n - 1, tag=5)
+    world.send(np.int64(111), source=0, dest=n - 1, tag=5)
+if world.local_offset + ln == n:
+    pay_w, _ = world.recv(dest=n - 1, source=0, tag=5)
+    pay_2, _ = w2.recv(dest=n - 1, source=0, tag=5)
+    assert pay_w == 111 and pay_2 == 777, (pay_w, pay_2)
+    print(f"OK dup_p2p_isolation proc={p}")
+w2.free()
+
+api.finalize()
+print(f"OK finalize proc={p}")
