@@ -47,6 +47,14 @@ class DcnCollEngine:
         #: cid → handler: p2p frames are routed per-communicator so
         #: dup'd comms keep isolated matching (MPI comm isolation)
         self._p2p_handlers: dict[int, Callable] = {}
+        #: frames that arrived before their cid was registered — a peer
+        #: can send on a freshly dup'd comm before we finish dup() (the
+        #: ob1 "unexpected message" problem at the transport layer)
+        self._p2p_pending: dict[int, list] = {}
+        #: cids explicitly freed: late frames for them are dropped, not
+        #: buffered forever (cids are never reused — comm.py counter)
+        self._p2p_closed: set[int] = set()
+        self._p2p_lock = threading.Lock()
         self.transport = TcpTransport(self._on_frame)
 
     def set_addresses(self, addresses: Sequence[str]) -> None:
@@ -60,11 +68,20 @@ class DcnCollEngine:
 
     def register_p2p(self, cid: int, fn: Callable[[dict, np.ndarray], None]) -> None:
         """Route kind='p2p' frames carrying this cid to the given
-        communicator's matching engine (the BTL→pml callback path)."""
-        self._p2p_handlers[cid] = fn
+        communicator's matching engine (the BTL→pml callback path).
+        Frames that beat the registration are drained in arrival order;
+        the drain and direct delivery share ``_p2p_lock`` so a frame
+        arriving mid-drain cannot overtake buffered predecessors."""
+        with self._p2p_lock:
+            self._p2p_handlers[cid] = fn
+            for env, payload in self._p2p_pending.pop(cid, []):
+                fn(env, payload)
 
     def unregister_p2p(self, cid: int) -> None:
-        self._p2p_handlers.pop(cid, None)
+        with self._p2p_lock:
+            self._p2p_handlers.pop(cid, None)
+            self._p2p_pending.pop(cid, None)
+            self._p2p_closed.add(cid)
 
     # -- frame routing ---------------------------------------------------
 
@@ -78,9 +95,13 @@ class DcnCollEngine:
 
     def _on_frame(self, env: dict, payload: np.ndarray) -> None:
         if env.get("kind") == "p2p":
-            fn = self._p2p_handlers.get(env.get("cid"))
-            if fn is not None:
-                fn(env, payload)
+            cid = env.get("cid")
+            with self._p2p_lock:
+                fn = self._p2p_handlers.get(cid)
+                if fn is not None:
+                    fn(env, payload)
+                elif cid not in self._p2p_closed:
+                    self._p2p_pending.setdefault(cid, []).append((env, payload))
             return
         key = (env["cid"], env["seq"], env["src"])
         self._queue(key).put((env, payload))
