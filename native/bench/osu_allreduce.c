@@ -1,0 +1,61 @@
+/* OSU-micro-benchmark-style MPI_Allreduce latency sweep.
+ *
+ * Same measurement shape as OSU's osu_allreduce.c (the harness the
+ * reference is conventionally measured with, SURVEY.md §6): per message
+ * size, warmup + timed iterations of MPI_Allreduce(MPI_FLOAT, MPI_SUM)
+ * with a barrier between batches; prints avg latency in us.
+ *
+ * Usage: osu_allreduce [max_bytes] [iterations]
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  long max_bytes = argc > 1 ? atol(argv[1]) : (1L << 20);
+  int iters = argc > 2 ? atoi(argv[2]) : 100;
+  int warmup = iters / 10 + 1;
+
+  if (rank == 0) {
+    printf("# OSU-style MPI Allreduce Latency Test (tpumpi)\n");
+    printf("# ranks: %d\n", size);
+    printf("%-12s%-14s\n", "# Size", "Avg Latency(us)");
+  }
+
+  long max_count = max_bytes / (long)sizeof(float);
+  float *sbuf = (float *)malloc(max_count * sizeof(float));
+  float *rbuf = (float *)malloc(max_count * sizeof(float));
+  for (long i = 0; i < max_count; i++) sbuf[i] = (float)(rank + 1);
+
+  for (long count = 1; count <= max_count; count *= 4) {
+    for (int i = 0; i < warmup; i++)
+      MPI_Allreduce(sbuf, rbuf, (int)count, MPI_FLOAT, MPI_SUM,
+                    MPI_COMM_WORLD);
+    MPI_Barrier(MPI_COMM_WORLD);
+    double t0 = MPI_Wtime();
+    for (int i = 0; i < iters; i++)
+      MPI_Allreduce(sbuf, rbuf, (int)count, MPI_FLOAT, MPI_SUM,
+                    MPI_COMM_WORLD);
+    double t1 = MPI_Wtime();
+    /* correctness alongside timing: sum of (rank+1) */
+    float expect = (float)(size * (size + 1) / 2);
+    if (rbuf[count - 1] != expect) {
+      fprintf(stderr, "VALIDATION FAILED at %ld floats: %g != %g\n", count,
+              rbuf[count - 1], expect);
+      MPI_Abort(MPI_COMM_WORLD, 3);
+    }
+    if (rank == 0)
+      printf("%-12ld%-14.2f\n", count * (long)sizeof(float),
+             (t1 - t0) * 1e6 / iters);
+  }
+
+  free(sbuf);
+  free(rbuf);
+  MPI_Finalize();
+  return 0;
+}

@@ -1,0 +1,200 @@
+/* C API conformance suite — one "OK <check>" line per feature, mirrored
+ * by tests/test_native_capi.py (the shape of the reference's in-tree
+ * test/ programs + examples, SURVEY.md §4). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int rank, size;
+
+#define CHECK(cond, name)                                         \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      fprintf(stderr, "FAIL %s rank=%d\n", name, rank);           \
+      MPI_Abort(MPI_COMM_WORLD, 7);                               \
+    }                                                             \
+    printf("OK %s rank=%d\n", name, rank);                        \
+  } while (0)
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  /* allreduce SUM double */
+  double xd = rank + 1.0, sd = 0.0;
+  MPI_Allreduce(&xd, &sd, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+  CHECK(sd == size * (size + 1) / 2.0, "allreduce_sum_double");
+
+  /* allreduce MAX int */
+  int xi = 10 * (rank + 1), mi = 0;
+  MPI_Allreduce(&xi, &mi, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+  CHECK(mi == 10 * size, "allreduce_max_int");
+
+  /* allreduce IN_PLACE float */
+  float xf[4];
+  for (int i = 0; i < 4; i++) xf[i] = (float)(rank + 1);
+  MPI_Allreduce(MPI_IN_PLACE, xf, 4, MPI_FLOAT, MPI_SUM, MPI_COMM_WORLD);
+  CHECK(xf[0] == (float)(size * (size + 1) / 2), "allreduce_in_place");
+
+  /* bcast */
+  long lb[2] = {0, 0};
+  if (rank == 0) { lb[0] = 42; lb[1] = -7; }
+  MPI_Bcast(lb, 2, MPI_LONG, 0, MPI_COMM_WORLD);
+  CHECK(lb[0] == 42 && lb[1] == -7, "bcast");
+
+  /* allgather */
+  int *ag = (int *)malloc(sizeof(int) * size);
+  int me = rank * 100;
+  MPI_Allgather(&me, 1, MPI_INT, ag, 1, MPI_INT, MPI_COMM_WORLD);
+  int ok = 1;
+  for (int r = 0; r < size; r++) ok &= (ag[r] == r * 100);
+  CHECK(ok, "allgather");
+  free(ag);
+
+  /* alltoall: send r*size+dest to dest */
+  int *sa = (int *)malloc(sizeof(int) * size);
+  int *ra = (int *)malloc(sizeof(int) * size);
+  for (int d = 0; d < size; d++) sa[d] = rank * size + d;
+  MPI_Alltoall(sa, 1, MPI_INT, ra, 1, MPI_INT, MPI_COMM_WORLD);
+  ok = 1;
+  for (int s = 0; s < size; s++) ok &= (ra[s] == s * size + rank);
+  CHECK(ok, "alltoall");
+  free(sa);
+  free(ra);
+
+  /* reduce to root */
+  double rsum = 0.0;
+  MPI_Reduce(&xd, &rsum, 1, MPI_DOUBLE, MPI_SUM, 0, MPI_COMM_WORLD);
+  if (rank == 0) CHECK(rsum == size * (size + 1) / 2.0, "reduce_root");
+  else printf("OK reduce_root rank=%d\n", rank);
+
+  /* reduce_scatter_block */
+  double *rs_in = (double *)malloc(sizeof(double) * size);
+  double rs_out = 0.0;
+  for (int d = 0; d < size; d++) rs_in[d] = d + 1.0;
+  MPI_Reduce_scatter_block(rs_in, &rs_out, 1, MPI_DOUBLE, MPI_SUM,
+                           MPI_COMM_WORLD);
+  CHECK(rs_out == (rank + 1.0) * size, "reduce_scatter_block");
+  free(rs_in);
+
+  /* scan */
+  int sc = rank + 1, sco = 0;
+  MPI_Scan(&sc, &sco, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+  CHECK(sco == (rank + 1) * (rank + 2) / 2, "scan");
+
+  /* scatter from last rank */
+  int root = size - 1;
+  int *sg = NULL;
+  if (rank == root) {
+    sg = (int *)malloc(sizeof(int) * size);
+    for (int d = 0; d < size; d++) sg[d] = 7 * d;
+  }
+  int got = -1;
+  MPI_Scatter(sg, 1, MPI_INT, &got, 1, MPI_INT, root, MPI_COMM_WORLD);
+  CHECK(got == 7 * rank, "scatter");
+  if (sg) free(sg);
+
+  /* gather to 0 */
+  int *gb = NULL;
+  if (rank == 0) gb = (int *)malloc(sizeof(int) * size);
+  int gv = rank + 5;
+  MPI_Gather(&gv, 1, MPI_INT, gb, 1, MPI_INT, 0, MPI_COMM_WORLD);
+  if (rank == 0) {
+    ok = 1;
+    for (int r = 0; r < size; r++) ok &= (gb[r] == r + 5);
+    CHECK(ok, "gather");
+    free(gb);
+  } else printf("OK gather rank=%d\n", rank);
+
+  /* alltoall with MPI_IN_PLACE */
+  int *ip = (int *)malloc(sizeof(int) * size);
+  for (int d = 0; d < size; d++) ip[d] = rank * size + d;
+  MPI_Alltoall(MPI_IN_PLACE, 1, MPI_INT, ip, 1, MPI_INT, MPI_COMM_WORLD);
+  ok = 1;
+  for (int s = 0; s < size; s++) ok &= (ip[s] == s * size + rank);
+  CHECK(ok, "alltoall_in_place");
+  free(ip);
+
+  /* gather with MPI_IN_PLACE at root */
+  int *gip = (int *)malloc(sizeof(int) * size);
+  if (rank == 0) {
+    gip[0] = 500; /* root's contribution pre-placed */
+    MPI_Gather(MPI_IN_PLACE, 1, MPI_INT, gip, 1, MPI_INT, 0, MPI_COMM_WORLD);
+    ok = (gip[0] == 500);
+    for (int r = 1; r < size; r++) ok &= (gip[r] == r + 500);
+    CHECK(ok, "gather_in_place");
+  } else {
+    int mine = rank + 500;
+    MPI_Gather(&mine, 1, MPI_INT, NULL, 1, MPI_INT, 0, MPI_COMM_WORLD);
+    printf("OK gather_in_place rank=%d\n", rank);
+  }
+  free(gip);
+
+  /* sendrecv ring shift */
+  int next = (rank + 1) % size, prev = (rank + size - 1) % size;
+  int sv = rank, rv = -1;
+  MPI_Status st;
+  MPI_Sendrecv(&sv, 1, MPI_INT, next, 9, &rv, 1, MPI_INT, prev, 9,
+               MPI_COMM_WORLD, &st);
+  CHECK(rv == prev && st.MPI_SOURCE == prev && st.MPI_TAG == 9, "sendrecv");
+
+  /* isend/irecv + wait + get_count */
+  if (size >= 2) {
+    if (rank == 0) {
+      double payload[3] = {1.5, 2.5, 3.5};
+      MPI_Request q;
+      MPI_Isend(payload, 3, MPI_DOUBLE, 1, 11, MPI_COMM_WORLD, &q);
+      MPI_Wait(&q, MPI_STATUS_IGNORE);
+    } else if (rank == 1) {
+      double in[3] = {0, 0, 0};
+      MPI_Request q;
+      MPI_Irecv(in, 3, MPI_DOUBLE, 0, 11, MPI_COMM_WORLD, &q);
+      MPI_Status s2;
+      MPI_Wait(&q, &s2);
+      int cnt = 0;
+      MPI_Get_count(&s2, MPI_DOUBLE, &cnt);
+      CHECK(in[2] == 3.5 && cnt == 3 && s2.MPI_SOURCE == 0, "isend_irecv");
+    }
+  }
+  if (rank != 1) printf("OK isend_irecv rank=%d\n", rank);
+
+  /* iallreduce */
+  double ia = rank + 1.0, iao = 0.0;
+  MPI_Request rq;
+  MPI_Iallreduce(&ia, &iao, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD, &rq);
+  MPI_Wait(&rq, MPI_STATUS_IGNORE);
+  CHECK(iao == size * (size + 1) / 2.0, "iallreduce");
+
+  /* comm dup isolation + free */
+  MPI_Comm dup;
+  MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+  int dr, ds;
+  MPI_Comm_rank(dup, &dr);
+  MPI_Comm_size(dup, &ds);
+  CHECK(dr == rank && ds == size, "comm_dup");
+  double dx = 1.0, dsum = 0.0;
+  MPI_Allreduce(&dx, &dsum, 1, MPI_DOUBLE, MPI_SUM, dup);
+  CHECK(dsum == (double)size, "dup_allreduce");
+  MPI_Comm_free(&dup);
+  CHECK(dup == MPI_COMM_NULL, "comm_free");
+
+  /* type_size / wtime / version / processor name */
+  int tsz = 0;
+  MPI_Type_size(MPI_DOUBLE, &tsz);
+  CHECK(tsz == 8, "type_size");
+  double t0 = MPI_Wtime();
+  double t1 = MPI_Wtime();
+  CHECK(t1 >= t0, "wtime");
+  int ver, sub;
+  MPI_Get_version(&ver, &sub);
+  CHECK(ver >= 3, "version");
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("OK barrier rank=%d\n", rank);
+
+  printf("CSUITE PASS rank=%d size=%d\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}

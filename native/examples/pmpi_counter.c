@@ -1,0 +1,31 @@
+/* PMPI interposition check — the universal MPI tracing hook
+ * (SURVEY.md §5: every MPI_* is a weak symbol over PMPI_*).  This tool
+ * defines a STRONG MPI_Allreduce that counts calls and forwards to
+ * PMPI_Allreduce; if the weak-alias convention holds, the application's
+ * MPI_Allreduce calls land here. */
+#include <mpi.h>
+#include <stdio.h>
+
+static int g_allreduce_calls = 0;
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  g_allreduce_calls++;
+  return PMPI_Allreduce(sendbuf, recvbuf, count, datatype, op, comm);
+}
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  double x = 1.0, s = 0.0;
+  for (int i = 0; i < 5; i++)
+    MPI_Allreduce(&x, &s, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+
+  printf("PMPI counter rank=%d calls=%d sum=%g\n", rank, g_allreduce_calls,
+         s);
+  MPI_Finalize();
+  return 0;
+}

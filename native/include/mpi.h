@@ -1,0 +1,258 @@
+/* mpi.h — C API subset of the TPU-native MPI framework (libtpumpi).
+ *
+ * ABI-compatible-in-spirit with the reference's ompi/include/mpi.h
+ * (432 MPI_* entry points, SURVEY.md §2.1): handles are small integers,
+ * MPI_Status is a plain struct, every MPI_* symbol is a weak alias of
+ * its PMPI_* implementation so profiling tools interpose exactly as
+ * they do on the reference (SURVEY.md §5 "PMPI").  Stock MPI C programs
+ * (OSU-style benchmarks, hello/ring examples) compile unmodified
+ * against this header and link with -ltpumpi.
+ */
+#ifndef TPUMPI_MPI_H
+#define TPUMPI_MPI_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* -- version ------------------------------------------------------- */
+#define MPI_VERSION 3
+#define MPI_SUBVERSION 1
+#define TPUMPI 1
+
+/* -- handles -------------------------------------------------------- */
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+typedef int MPI_Request;
+typedef int MPI_Errhandler;
+typedef int MPI_Group;
+typedef long long MPI_Aint;
+typedef long long MPI_Offset;
+typedef long long MPI_Count;
+
+typedef struct MPI_Status {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+  int _count; /* internal: received element count */
+} MPI_Status;
+
+#define MPI_STATUS_IGNORE ((MPI_Status *)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+
+/* -- communicators -------------------------------------------------- */
+#define MPI_COMM_NULL ((MPI_Comm)0)
+#define MPI_COMM_WORLD ((MPI_Comm)1)
+#define MPI_COMM_SELF ((MPI_Comm)2)
+
+#define MPI_GROUP_NULL ((MPI_Group)0)
+#define MPI_GROUP_EMPTY ((MPI_Group)1)
+
+#define MPI_REQUEST_NULL ((MPI_Request)0)
+
+/* -- wildcards / sentinels ------------------------------------------ */
+#define MPI_ANY_SOURCE (-1)
+#define MPI_ANY_TAG (-1)
+#define MPI_PROC_NULL (-2)
+#define MPI_ROOT (-3)
+#define MPI_UNDEFINED (-32766)
+#define MPI_IN_PLACE ((void *)-1)
+#define MPI_BOTTOM ((void *)0)
+#define MPI_MAX_PROCESSOR_NAME 256
+#define MPI_MAX_ERROR_STRING 256
+#define MPI_MAX_OBJECT_NAME 64
+
+/* -- datatypes (codes mirrored in ompi_tpu/capi.py) ----------------- */
+#define MPI_DATATYPE_NULL ((MPI_Datatype)0)
+#define MPI_CHAR ((MPI_Datatype)1)
+#define MPI_SIGNED_CHAR ((MPI_Datatype)2)
+#define MPI_UNSIGNED_CHAR ((MPI_Datatype)3)
+#define MPI_BYTE ((MPI_Datatype)4)
+#define MPI_SHORT ((MPI_Datatype)5)
+#define MPI_UNSIGNED_SHORT ((MPI_Datatype)6)
+#define MPI_INT ((MPI_Datatype)7)
+#define MPI_UNSIGNED ((MPI_Datatype)8)
+#define MPI_LONG ((MPI_Datatype)9)
+#define MPI_UNSIGNED_LONG ((MPI_Datatype)10)
+#define MPI_LONG_LONG_INT ((MPI_Datatype)11)
+#define MPI_LONG_LONG MPI_LONG_LONG_INT
+#define MPI_UNSIGNED_LONG_LONG ((MPI_Datatype)12)
+#define MPI_FLOAT ((MPI_Datatype)13)
+#define MPI_DOUBLE ((MPI_Datatype)14)
+#define MPI_C_BOOL ((MPI_Datatype)16)
+#define MPI_INT8_T ((MPI_Datatype)17)
+#define MPI_INT16_T ((MPI_Datatype)18)
+#define MPI_INT32_T ((MPI_Datatype)19)
+#define MPI_INT64_T ((MPI_Datatype)20)
+#define MPI_UINT8_T ((MPI_Datatype)21)
+#define MPI_UINT16_T ((MPI_Datatype)22)
+#define MPI_UINT32_T ((MPI_Datatype)23)
+#define MPI_UINT64_T ((MPI_Datatype)24)
+#define MPI_C_FLOAT_COMPLEX ((MPI_Datatype)25)
+#define MPI_C_DOUBLE_COMPLEX ((MPI_Datatype)26)
+#define MPI_WCHAR ((MPI_Datatype)27)
+#define MPI_AINT ((MPI_Datatype)20) /* int64 */
+#define MPI_OFFSET ((MPI_Datatype)20)
+#define MPI_COUNT ((MPI_Datatype)20)
+/* pair types for MAXLOC/MINLOC */
+#define MPI_FLOAT_INT ((MPI_Datatype)28)
+#define MPI_DOUBLE_INT ((MPI_Datatype)29)
+#define MPI_LONG_INT ((MPI_Datatype)30)
+#define MPI_2INT ((MPI_Datatype)31)
+#define MPI_SHORT_INT ((MPI_Datatype)32)
+
+/* -- ops (codes mirrored in ompi_tpu/capi.py) ----------------------- */
+#define MPI_OP_NULL ((MPI_Op)0)
+#define MPI_SUM ((MPI_Op)1)
+#define MPI_MAX ((MPI_Op)2)
+#define MPI_MIN ((MPI_Op)3)
+#define MPI_PROD ((MPI_Op)4)
+#define MPI_LAND ((MPI_Op)5)
+#define MPI_LOR ((MPI_Op)6)
+#define MPI_LXOR ((MPI_Op)7)
+#define MPI_BAND ((MPI_Op)8)
+#define MPI_BOR ((MPI_Op)9)
+#define MPI_BXOR ((MPI_Op)10)
+#define MPI_MAXLOC ((MPI_Op)11)
+#define MPI_MINLOC ((MPI_Op)12)
+#define MPI_REPLACE ((MPI_Op)13)
+#define MPI_NO_OP ((MPI_Op)14)
+
+/* -- error classes --------------------------------------------------- */
+#define MPI_SUCCESS 0
+#define MPI_ERR_BUFFER 1
+#define MPI_ERR_COUNT 2
+#define MPI_ERR_TYPE 3
+#define MPI_ERR_TAG 4
+#define MPI_ERR_COMM 5
+#define MPI_ERR_RANK 6
+#define MPI_ERR_REQUEST 7
+#define MPI_ERR_ROOT 8
+#define MPI_ERR_OP 9
+#define MPI_ERR_ARG 12
+#define MPI_ERR_UNKNOWN 13
+#define MPI_ERR_TRUNCATE 14
+#define MPI_ERR_OTHER 15
+#define MPI_ERR_INTERN 16
+#define MPI_ERR_LASTCODE 92
+
+#define MPI_ERRORS_ARE_FATAL ((MPI_Errhandler)1)
+#define MPI_ERRORS_RETURN ((MPI_Errhandler)2)
+
+#define MPI_THREAD_SINGLE 0
+#define MPI_THREAD_FUNNELED 1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE 3
+
+/* -- prototypes: every MPI_* has a PMPI_* twin ---------------------- */
+#define TPUMPI_PROTO(ret, name, args) \
+  ret MPI_##name args;                \
+  ret PMPI_##name args;
+
+TPUMPI_PROTO(int, Init, (int *argc, char ***argv))
+TPUMPI_PROTO(int, Init_thread,
+             (int *argc, char ***argv, int required, int *provided))
+TPUMPI_PROTO(int, Finalize, (void))
+TPUMPI_PROTO(int, Initialized, (int *flag))
+TPUMPI_PROTO(int, Finalized, (int *flag))
+TPUMPI_PROTO(int, Abort, (MPI_Comm comm, int errorcode))
+TPUMPI_PROTO(int, Comm_size, (MPI_Comm comm, int *size))
+TPUMPI_PROTO(int, Comm_rank, (MPI_Comm comm, int *rank))
+TPUMPI_PROTO(int, Comm_dup, (MPI_Comm comm, MPI_Comm *newcomm))
+TPUMPI_PROTO(int, Comm_split,
+             (MPI_Comm comm, int color, int key, MPI_Comm *newcomm))
+TPUMPI_PROTO(int, Comm_free, (MPI_Comm *comm))
+TPUMPI_PROTO(int, Comm_set_name, (MPI_Comm comm, const char *name))
+TPUMPI_PROTO(int, Get_processor_name, (char *name, int *resultlen))
+TPUMPI_PROTO(int, Get_version, (int *version, int *subversion))
+TPUMPI_PROTO(int, Error_string, (int errorcode, char *string, int *resultlen))
+TPUMPI_PROTO(int, Type_size, (MPI_Datatype datatype, int *size))
+TPUMPI_PROTO(int, Get_count,
+             (const MPI_Status *status, MPI_Datatype datatype, int *count))
+TPUMPI_PROTO(double, Wtime, (void))
+TPUMPI_PROTO(double, Wtick, (void))
+
+/* pt2pt */
+TPUMPI_PROTO(int, Send, (const void *buf, int count, MPI_Datatype datatype,
+                         int dest, int tag, MPI_Comm comm))
+TPUMPI_PROTO(int, Recv, (void *buf, int count, MPI_Datatype datatype,
+                         int source, int tag, MPI_Comm comm,
+                         MPI_Status *status))
+TPUMPI_PROTO(int, Isend, (const void *buf, int count, MPI_Datatype datatype,
+                          int dest, int tag, MPI_Comm comm,
+                          MPI_Request *request))
+TPUMPI_PROTO(int, Irecv, (void *buf, int count, MPI_Datatype datatype,
+                          int source, int tag, MPI_Comm comm,
+                          MPI_Request *request))
+TPUMPI_PROTO(int, Sendrecv,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              int dest, int sendtag, void *recvbuf, int recvcount,
+              MPI_Datatype recvtype, int source, int recvtag, MPI_Comm comm,
+              MPI_Status *status))
+
+/* requests */
+TPUMPI_PROTO(int, Wait, (MPI_Request *request, MPI_Status *status))
+TPUMPI_PROTO(int, Waitall,
+             (int count, MPI_Request requests[], MPI_Status statuses[]))
+TPUMPI_PROTO(int, Test, (MPI_Request *request, int *flag, MPI_Status *status))
+
+/* collectives: blocking */
+TPUMPI_PROTO(int, Barrier, (MPI_Comm comm))
+TPUMPI_PROTO(int, Bcast, (void *buffer, int count, MPI_Datatype datatype,
+                          int root, MPI_Comm comm))
+TPUMPI_PROTO(int, Reduce,
+             (const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm))
+TPUMPI_PROTO(int, Allreduce,
+             (const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm))
+TPUMPI_PROTO(int, Allgather,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, int recvcount, MPI_Datatype recvtype,
+              MPI_Comm comm))
+TPUMPI_PROTO(int, Gather,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+              MPI_Comm comm))
+TPUMPI_PROTO(int, Scatter,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+              MPI_Comm comm))
+TPUMPI_PROTO(int, Alltoall,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, int recvcount, MPI_Datatype recvtype,
+              MPI_Comm comm))
+TPUMPI_PROTO(int, Reduce_scatter_block,
+             (const void *sendbuf, void *recvbuf, int recvcount,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm))
+TPUMPI_PROTO(int, Scan,
+             (const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm))
+TPUMPI_PROTO(int, Exscan,
+             (const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm))
+
+/* collectives: non-blocking */
+TPUMPI_PROTO(int, Ibarrier, (MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO(int, Ibcast, (void *buffer, int count, MPI_Datatype datatype,
+                           int root, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO(int, Iallreduce,
+             (const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+              MPI_Request *request))
+TPUMPI_PROTO(int, Iallgather,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, int recvcount, MPI_Datatype recvtype,
+              MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO(int, Ialltoall,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, int recvcount, MPI_Datatype recvtype,
+              MPI_Comm comm, MPI_Request *request))
+
+#undef TPUMPI_PROTO
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPUMPI_MPI_H */

@@ -1,0 +1,536 @@
+/* libtpumpi shim — the native mpi.h ABI over the TPU framework runtime.
+ *
+ * ≈ the reference's ompi/mpi/c layer (SURVEY.md §2.1: one thin
+ * arg-marshalling file per MPI function over the internal engine) with
+ * the PMPI profiling convention preserved: every PMPI_* here is the
+ * strong implementation and MPI_* is a weak alias
+ * (SURVEY.md §5: [bin] symbols typed W in libmpi.so).
+ *
+ * The engine is the embedded CPython runtime hosting ompi_tpu: PMPI
+ * entry points marshal raw C buffers (as addresses) into
+ * ompi_tpu.capi, which wraps them as numpy views and drives the same
+ * communicator/coll/pml machinery the Python API uses.  The GIL is
+ * released between MPI calls so the framework's DCN receiver threads
+ * keep progressing while the application computes (the analog of the
+ * reference's libevent progress thread staying live).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "mpi.h"
+
+static PyObject *g_capi = NULL; /* ompi_tpu.capi module */
+static int g_initialized = 0;
+static int g_finalized = 0;
+
+#define PTR(p) ((unsigned long long)(uintptr_t)(p))
+
+/* Integer results marshalled out of a capi tuple before the GIL drops. */
+typedef struct {
+  long v[6];
+  int n;
+} capi_ret;
+
+static int capi_boot(void) {
+  if (g_capi) return MPI_SUCCESS;
+  if (!Py_IsInitialized()) {
+    /* Inherit PYTHONPATH/env: tpurun exports the package root and the
+     * OMPI_TPU_* rank variables. */
+    Py_InitializeEx(0);
+    /* Drop the GIL so framework threads can run; every call below
+     * re-acquires via PyGILState_Ensure. */
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  /* Make the framework importable without PYTHONPATH: append the
+   * package root (baked in at build time, overridable via env). */
+  const char *root = getenv("TPUMPI_PKG_ROOT");
+#ifdef TPUMPI_PKG_ROOT
+  if (!root) root = TPUMPI_PKG_ROOT;
+#endif
+  if (root) {
+    PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
+    PyObject *s = PyUnicode_FromString(root);
+    if (sys_path && s && !PySequence_Contains(sys_path, s))
+      PyList_Append(sys_path, s);
+    Py_XDECREF(s);
+  }
+  PyObject *m = PyImport_ImportModule("ompi_tpu.capi");
+  if (!m) {
+    fprintf(stderr, "tpumpi: failed to import ompi_tpu.capi "
+                    "(set TPUMPI_PKG_ROOT or PYTHONPATH):\n");
+    PyErr_Print();
+    PyGILState_Release(g);
+    return MPI_ERR_INTERN;
+  }
+  g_capi = m;
+  PyGILState_Release(g);
+  return MPI_SUCCESS;
+}
+
+/* Call capi.<fn>(...); the callee returns an int error class or a tuple
+ * (err, i0, i1, ...) whose integers are copied into *out. The GIL is
+ * held only for the duration of the call. */
+static int capi_call(const char *fn, capi_ret *out, const char *fmt, ...) {
+  if (out) out->n = 0;
+  if (!g_capi) {
+    fprintf(stderr, "tpumpi: MPI call before MPI_Init\n");
+    return MPI_ERR_OTHER;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  int err = MPI_ERR_INTERN;
+  if (args) {
+    PyObject *f = PyObject_GetAttrString(g_capi, fn);
+    if (f) {
+      PyObject *r = PyObject_CallObject(f, args);
+      Py_DECREF(f);
+      if (r) {
+        if (PyTuple_Check(r)) {
+          err = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+          if (out) {
+            Py_ssize_t sz = PyTuple_Size(r);
+            for (Py_ssize_t i = 1; i < sz && out->n < 6; i++)
+              out->v[out->n++] = PyLong_AsLong(PyTuple_GetItem(r, i));
+          }
+        } else {
+          err = (int)PyLong_AsLong(r);
+        }
+        Py_DECREF(r);
+      }
+    }
+    Py_DECREF(args);
+  }
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    err = MPI_ERR_OTHER;
+  }
+  PyGILState_Release(g);
+  return err;
+}
+
+static void fill_status(MPI_Status *status, const capi_ret *r, int base) {
+  if (status && r->n >= base + 3) {
+    status->MPI_SOURCE = (int)r->v[base];
+    status->MPI_TAG = (int)r->v[base + 1];
+    status->MPI_ERROR = MPI_SUCCESS;
+    status->_count = (int)r->v[base + 2];
+  }
+}
+
+/* ---- init / finalize ---------------------------------------------- */
+
+int PMPI_Init(int *argc, char ***argv) {
+  (void)argc;
+  (void)argv;
+  int rc = capi_boot();
+  if (rc != MPI_SUCCESS) return rc;
+  rc = capi_call("init", NULL, "()");
+  if (rc == MPI_SUCCESS) g_initialized = 1;
+  return rc;
+}
+
+int PMPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
+  if (provided) *provided = MPI_THREAD_SERIALIZED;
+  (void)required;
+  return PMPI_Init(argc, argv);
+}
+
+int PMPI_Finalize(void) {
+  int rc = capi_call("finalize", NULL, "()");
+  g_finalized = 1;
+  g_initialized = 0;
+  return rc;
+}
+
+int PMPI_Initialized(int *flag) {
+  *flag = g_initialized;
+  return MPI_SUCCESS;
+}
+
+int PMPI_Finalized(int *flag) {
+  *flag = g_finalized;
+  return MPI_SUCCESS;
+}
+
+int PMPI_Abort(MPI_Comm comm, int errorcode) {
+  (void)comm;
+  fprintf(stderr, "tpumpi: MPI_Abort(%d)\n", errorcode);
+  exit(errorcode ? errorcode : 1);
+}
+
+/* ---- env ----------------------------------------------------------- */
+
+int PMPI_Comm_size(MPI_Comm comm, int *size) {
+  capi_ret r;
+  int rc = capi_call("comm_size", &r, "(i)", comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *size = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_rank(MPI_Comm comm, int *rank) {
+  capi_ret r;
+  int rc = capi_call("comm_rank", &r, "(i)", comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *rank = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
+  capi_ret r;
+  int rc = capi_call("comm_dup", &r, "(i)", comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newcomm = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
+  capi_ret r;
+  int rc = capi_call("comm_split", &r, "(iii)", comm, color, key);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newcomm = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_free(MPI_Comm *comm) {
+  int rc = capi_call("comm_free", NULL, "(i)", *comm);
+  *comm = MPI_COMM_NULL;
+  return rc;
+}
+
+int PMPI_Comm_set_name(MPI_Comm comm, const char *name) {
+  return capi_call("comm_set_name", NULL, "(is)", comm, name);
+}
+
+int PMPI_Get_processor_name(char *name, int *resultlen) {
+  if (gethostname(name, MPI_MAX_PROCESSOR_NAME) != 0)
+    strncpy(name, "unknown", MPI_MAX_PROCESSOR_NAME);
+  name[MPI_MAX_PROCESSOR_NAME - 1] = 0;
+  *resultlen = (int)strlen(name);
+  return MPI_SUCCESS;
+}
+
+int PMPI_Get_version(int *version, int *subversion) {
+  *version = MPI_VERSION;
+  *subversion = MPI_SUBVERSION;
+  return MPI_SUCCESS;
+}
+
+int PMPI_Error_string(int errorcode, char *string, int *resultlen) {
+  snprintf(string, MPI_MAX_ERROR_STRING, "MPI error class %d", errorcode);
+  *resultlen = (int)strlen(string);
+  return MPI_SUCCESS;
+}
+
+int PMPI_Type_size(MPI_Datatype datatype, int *size) {
+  capi_ret r;
+  int rc = capi_call("type_size", &r, "(i)", datatype);
+  if (rc == MPI_SUCCESS && r.n >= 1) *size = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
+                   int *count) {
+  (void)datatype;
+  *count = status ? status->_count : 0;
+  return MPI_SUCCESS;
+}
+
+double PMPI_Wtime(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+double PMPI_Wtick(void) { return 1e-9; }
+
+/* ---- pt2pt --------------------------------------------------------- */
+
+int PMPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm) {
+  return capi_call("send", NULL, "(Kiiiii)", PTR(buf), count, (int)datatype,
+                   dest, tag, (int)comm);
+}
+
+int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
+              MPI_Comm comm, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("recv", &r, "(Kiiiii)", PTR(buf), count, (int)datatype,
+                     source, tag, (int)comm);
+  if (rc == MPI_SUCCESS) fill_status(status, &r, 0);
+  return rc;
+}
+
+int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("isend", &r, "(Kiiiii)", PTR(buf), count, (int)datatype,
+                     dest, tag, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
+               int tag, MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("irecv", &r, "(Kiiiii)", PTR(buf), count, (int)datatype,
+                     source, tag, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  int dest, int sendtag, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, int source, int recvtag,
+                  MPI_Comm comm, MPI_Status *status) {
+  MPI_Request rreq;
+  int rc = PMPI_Irecv(recvbuf, recvcount, recvtype, source, recvtag, comm,
+                      &rreq);
+  if (rc != MPI_SUCCESS) return rc;
+  rc = PMPI_Send(sendbuf, sendcount, sendtype, dest, sendtag, comm);
+  if (rc != MPI_SUCCESS) return rc;
+  return PMPI_Wait(&rreq, status);
+}
+
+/* ---- requests ------------------------------------------------------ */
+
+int PMPI_Wait(MPI_Request *request, MPI_Status *status) {
+  if (*request == MPI_REQUEST_NULL) return MPI_SUCCESS;
+  capi_ret r;
+  int rc = capi_call("wait", &r, "(i)", *request);
+  if (rc == MPI_SUCCESS) fill_status(status, &r, 0);
+  *request = MPI_REQUEST_NULL;
+  return rc;
+}
+
+int PMPI_Waitall(int count, MPI_Request requests[], MPI_Status statuses[]) {
+  for (int i = 0; i < count; i++) {
+    int rc = PMPI_Wait(&requests[i],
+                       statuses ? &statuses[i] : MPI_STATUS_IGNORE);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
+  if (*request == MPI_REQUEST_NULL) {
+    *flag = 1;
+    return MPI_SUCCESS;
+  }
+  capi_ret r;
+  int rc = capi_call("test", &r, "(i)", *request);
+  if (rc == MPI_SUCCESS && r.n >= 1) {
+    *flag = (int)r.v[0];
+    if (*flag) fill_status(status, &r, 1);
+  }
+  if (rc == MPI_SUCCESS && *flag) *request = MPI_REQUEST_NULL;
+  return rc;
+}
+
+/* ---- collectives: blocking ---------------------------------------- */
+
+int PMPI_Barrier(MPI_Comm comm) {
+  return capi_call("barrier", NULL, "(i)", (int)comm);
+}
+
+int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+               MPI_Comm comm) {
+  return capi_call("bcast", NULL, "(Kiiii)", PTR(buffer), count,
+                   (int)datatype, root, (int)comm);
+}
+
+int PMPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm) {
+  return capi_call("reduce", NULL, "(KKiiiii)", PTR(sendbuf), PTR(recvbuf),
+                   count, (int)datatype, (int)op, root, (int)comm);
+}
+
+int PMPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                   MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  return capi_call("allreduce", NULL, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
+                   count, (int)datatype, (int)op, (int)comm);
+}
+
+int PMPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                   void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                   MPI_Comm comm) {
+  return capi_call("allgather", NULL, "(KiiKiii)", PTR(sendbuf), sendcount,
+                   (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                   (int)comm);
+}
+
+int PMPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+                MPI_Comm comm) {
+  return capi_call("gather", NULL, "(KiiKiiii)", PTR(sendbuf), sendcount,
+                   (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                   root, (int)comm);
+}
+
+int PMPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm) {
+  return capi_call("scatter", NULL, "(KiiKiiii)", PTR(sendbuf), sendcount,
+                   (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                   root, (int)comm);
+}
+
+int PMPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm) {
+  return capi_call("alltoall", NULL, "(KiiKiii)", PTR(sendbuf), sendcount,
+                   (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                   (int)comm);
+}
+
+int PMPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                              int recvcount, MPI_Datatype datatype, MPI_Op op,
+                              MPI_Comm comm) {
+  return capi_call("reduce_scatter_block", NULL, "(KKiiii)", PTR(sendbuf),
+                   PTR(recvbuf), recvcount, (int)datatype, (int)op,
+                   (int)comm);
+}
+
+int PMPI_Scan(const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  return capi_call("scan", NULL, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
+                   count, (int)datatype, (int)op, (int)comm);
+}
+
+int PMPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  return capi_call("exscan", NULL, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
+                   count, (int)datatype, (int)op, (int)comm);
+}
+
+/* ---- collectives: non-blocking ------------------------------------ */
+
+int PMPI_Ibarrier(MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("ibarrier", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
+                MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("ibcast", &r, "(Kiiii)", PTR(buffer), count,
+                     (int)datatype, root, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
+                    MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                    MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("iallreduce", &r, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
+                     count, (int)datatype, (int)op, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                    void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                    MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("iallgather", &r, "(KiiKiii)", PTR(sendbuf), sendcount,
+                     (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                     (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Ialltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                   void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                   MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("ialltoall", &r, "(KiiKiii)", PTR(sendbuf), sendcount,
+                     (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                     (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+/* ---- MPI_* weak aliases over PMPI_* (profiling interposition) ----- */
+
+#define TPUMPI_WEAK(ret, name, args) \
+  ret MPI_##name args __attribute__((weak, alias("PMPI_" #name)));
+
+TPUMPI_WEAK(int, Init, (int *, char ***))
+TPUMPI_WEAK(int, Init_thread, (int *, char ***, int, int *))
+TPUMPI_WEAK(int, Finalize, (void))
+TPUMPI_WEAK(int, Initialized, (int *))
+TPUMPI_WEAK(int, Finalized, (int *))
+TPUMPI_WEAK(int, Abort, (MPI_Comm, int))
+TPUMPI_WEAK(int, Comm_size, (MPI_Comm, int *))
+TPUMPI_WEAK(int, Comm_rank, (MPI_Comm, int *))
+TPUMPI_WEAK(int, Comm_dup, (MPI_Comm, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_split, (MPI_Comm, int, int, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_free, (MPI_Comm *))
+TPUMPI_WEAK(int, Comm_set_name, (MPI_Comm, const char *))
+TPUMPI_WEAK(int, Get_processor_name, (char *, int *))
+TPUMPI_WEAK(int, Get_version, (int *, int *))
+TPUMPI_WEAK(int, Error_string, (int, char *, int *))
+TPUMPI_WEAK(int, Type_size, (MPI_Datatype, int *))
+TPUMPI_WEAK(int, Get_count, (const MPI_Status *, MPI_Datatype, int *))
+TPUMPI_WEAK(double, Wtime, (void))
+TPUMPI_WEAK(double, Wtick, (void))
+TPUMPI_WEAK(int, Send, (const void *, int, MPI_Datatype, int, int, MPI_Comm))
+TPUMPI_WEAK(int, Recv,
+            (void *, int, MPI_Datatype, int, int, MPI_Comm, MPI_Status *))
+TPUMPI_WEAK(int, Isend,
+            (const void *, int, MPI_Datatype, int, int, MPI_Comm,
+             MPI_Request *))
+TPUMPI_WEAK(int, Irecv,
+            (void *, int, MPI_Datatype, int, int, MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Sendrecv,
+            (const void *, int, MPI_Datatype, int, int, void *, int,
+             MPI_Datatype, int, int, MPI_Comm, MPI_Status *))
+TPUMPI_WEAK(int, Wait, (MPI_Request *, MPI_Status *))
+TPUMPI_WEAK(int, Waitall, (int, MPI_Request[], MPI_Status[]))
+TPUMPI_WEAK(int, Test, (MPI_Request *, int *, MPI_Status *))
+TPUMPI_WEAK(int, Barrier, (MPI_Comm))
+TPUMPI_WEAK(int, Bcast, (void *, int, MPI_Datatype, int, MPI_Comm))
+TPUMPI_WEAK(int, Reduce,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, int, MPI_Comm))
+TPUMPI_WEAK(int, Allreduce,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, MPI_Comm))
+TPUMPI_WEAK(int, Allgather,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+             MPI_Comm))
+TPUMPI_WEAK(int, Gather,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype, int,
+             MPI_Comm))
+TPUMPI_WEAK(int, Scatter,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype, int,
+             MPI_Comm))
+TPUMPI_WEAK(int, Alltoall,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+             MPI_Comm))
+TPUMPI_WEAK(int, Reduce_scatter_block,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, MPI_Comm))
+TPUMPI_WEAK(int, Scan,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, MPI_Comm))
+TPUMPI_WEAK(int, Exscan,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, MPI_Comm))
+TPUMPI_WEAK(int, Ibarrier, (MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Ibcast,
+            (void *, int, MPI_Datatype, int, MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Iallreduce,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, MPI_Comm,
+             MPI_Request *))
+TPUMPI_WEAK(int, Iallgather,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+             MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Ialltoall,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+             MPI_Comm, MPI_Request *))

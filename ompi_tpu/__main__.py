@@ -3,6 +3,7 @@
 Commands (≈ the reference's tool surface):
   info    — frameworks/components/vars dump (≈ ompi_info)
   run     — job launcher (≈ mpirun); see ``run --help``
+  mpicc   — compile a stock MPI C program against libtpumpi
 """
 
 from __future__ import annotations
@@ -23,7 +24,11 @@ def main() -> int:
         from ompi_tpu.boot.tpurun import main as run_main
 
         return run_main(rest)
-    print(f"unknown command {cmd!r}; try 'info' or 'run'", file=sys.stderr)
+    if cmd == "mpicc":
+        from ompi_tpu.native import mpicc_main
+
+        return mpicc_main(rest)
+    print(f"unknown command {cmd!r}; try 'info', 'run', or 'mpicc'", file=sys.stderr)
     return 2
 
 

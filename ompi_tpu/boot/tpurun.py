@@ -78,8 +78,19 @@ def run_job(
                     if p and "axon" not in p
                 )
             env.update(extra_env or {})
+            # native executables (compiled against libtpumpi) run
+            # directly; .py scripts go through the interpreter
+            first = argv[0]
+            if first.endswith(".py") or not (
+                os.path.isfile(first) and os.access(first, os.X_OK)
+            ):
+                cmd = [sys.executable] + argv
+            else:
+                # absolute path: a bare filename would hit execvp PATH
+                # lookup instead of the file we just stat'ed
+                cmd = [os.path.abspath(first)] + argv[1:]
             p = subprocess.Popen(
-                [sys.executable] + argv,
+                cmd,
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,

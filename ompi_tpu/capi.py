@@ -1,0 +1,602 @@
+"""C-API bridge — the Python half of libtpumpi (native/src/shim.c).
+
+≈ the internal engine under the reference's ``ompi/mpi/c`` bindings:
+the shim marshals raw C buffer addresses + handle/datatype/op codes
+into these functions, which wrap the memory as numpy views (zero-copy)
+and drive the same communicator/coll/pml machinery as the Python API.
+
+Execution model: **one OS process = one MPI rank** (the mpirun model,
+SURVEY.md §3.1).  Under ``tpurun`` each process must own exactly one
+local device (``--cpu-devices 1`` or the single real TPU chip);
+standalone C programs get a size-1 world.  Constants here mirror
+``native/include/mpi.h`` — keep the two in sync.
+
+Every entry point returns an int MPI error class, or a tuple whose
+first element is the error class (the shim copies the remaining ints
+out before releasing the GIL).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import traceback
+
+import numpy as np
+
+from ompi_tpu.core import errors as err
+from ompi_tpu.op import op as opmod
+from ompi_tpu.request import CompletedRequest, Request
+
+# -- error classes (mpi.h) ---------------------------------------------
+MPI_SUCCESS = 0
+MPI_ERR_COUNT = 2
+MPI_ERR_TYPE = 3
+MPI_ERR_TAG = 4
+MPI_ERR_COMM = 5
+MPI_ERR_RANK = 6
+MPI_ERR_REQUEST = 7
+MPI_ERR_ROOT = 8
+MPI_ERR_OP = 9
+MPI_ERR_ARG = 12
+MPI_ERR_TRUNCATE = 14
+MPI_ERR_OTHER = 15
+MPI_ERR_INTERN = 16
+
+_IN_PLACE = (1 << 64) - 1  # (void*)-1 seen as unsigned long long
+
+# -- datatype codes (mpi.h) --------------------------------------------
+DTYPES: dict[int, np.dtype] = {
+    1: np.dtype(np.int8),      # MPI_CHAR
+    2: np.dtype(np.int8),      # MPI_SIGNED_CHAR
+    3: np.dtype(np.uint8),     # MPI_UNSIGNED_CHAR
+    4: np.dtype(np.uint8),     # MPI_BYTE
+    5: np.dtype(np.int16),     # MPI_SHORT
+    6: np.dtype(np.uint16),    # MPI_UNSIGNED_SHORT
+    7: np.dtype(np.int32),     # MPI_INT
+    8: np.dtype(np.uint32),    # MPI_UNSIGNED
+    9: np.dtype(np.int64),     # MPI_LONG (LP64)
+    10: np.dtype(np.uint64),   # MPI_UNSIGNED_LONG
+    11: np.dtype(np.int64),    # MPI_LONG_LONG
+    12: np.dtype(np.uint64),   # MPI_UNSIGNED_LONG_LONG
+    13: np.dtype(np.float32),  # MPI_FLOAT
+    14: np.dtype(np.float64),  # MPI_DOUBLE
+    16: np.dtype(np.bool_),    # MPI_C_BOOL
+    17: np.dtype(np.int8),
+    18: np.dtype(np.int16),
+    19: np.dtype(np.int32),
+    20: np.dtype(np.int64),
+    21: np.dtype(np.uint8),
+    22: np.dtype(np.uint16),
+    23: np.dtype(np.uint32),
+    24: np.dtype(np.uint64),
+    25: np.dtype(np.complex64),   # MPI_C_FLOAT_COMPLEX
+    26: np.dtype(np.complex128),  # MPI_C_DOUBLE_COMPLEX
+    27: np.dtype(np.int32),       # MPI_WCHAR
+}
+
+# -- op codes (mpi.h) ---------------------------------------------------
+OPS: dict[int, opmod.Op] = {
+    1: opmod.SUM,
+    2: opmod.MAX,
+    3: opmod.MIN,
+    4: opmod.PROD,
+    5: opmod.LAND,
+    6: opmod.LOR,
+    7: opmod.LXOR,
+    8: opmod.BAND,
+    9: opmod.BOR,
+    10: opmod.BXOR,
+    11: opmod.MAXLOC,
+    12: opmod.MINLOC,
+    13: opmod.REPLACE,
+    14: opmod.NO_OP,
+}
+
+_comms: dict[int, object] = {}
+_requests: dict[int, tuple] = {}
+_next_handle = 3  # 1 = MPI_COMM_WORLD, 2 = MPI_COMM_SELF
+_next_req = 1
+_rank = 0
+_size = 1
+
+
+def _fail(e: BaseException) -> int:
+    """Map a framework exception to an MPI error class (printing the
+    traceback — the C caller only sees the class, ≈ MPI_ERRORS_RETURN)."""
+    if isinstance(e, err.MPIError):
+        return int(e.error_class)
+    traceback.print_exc()
+    return MPI_ERR_OTHER
+
+
+def _view(ptr: int, count: int, dtcode: int) -> np.ndarray:
+    """Zero-copy numpy view over a raw C buffer."""
+    dt = DTYPES.get(dtcode)
+    if dt is None:
+        raise err.MPIArgError(f"unsupported C datatype code {dtcode}")
+    nbytes = count * dt.itemsize
+    if nbytes == 0:
+        return np.empty(0, dt)
+    raw = (ctypes.c_ubyte * nbytes).from_address(ptr)
+    return np.frombuffer(raw, dtype=dt)
+
+
+def _comm(h: int):
+    c = _comms.get(h)
+    if c is None:
+        raise err.MPICommError(f"invalid communicator handle {h}")
+    return c
+
+
+def _store_comm(c) -> int:
+    global _next_handle
+    h = _next_handle
+    _next_handle += 1
+    _comms[h] = c
+    return h
+
+
+def _store_req(entry: tuple) -> int:
+    global _next_req
+    h = _next_req
+    _next_req += 1
+    _requests[h] = entry
+    return h
+
+
+# -- init / finalize ----------------------------------------------------
+
+
+def init() -> int:
+    global _rank, _size
+    try:
+        import os
+
+        import jax
+
+        # honor JAX_PLATFORMS in the embedded interpreter: some PJRT
+        # plugins (axon) register regardless of the env var, so the
+        # config must be set explicitly before first device use
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:  # noqa: BLE001 — already-initialized backends
+                pass
+
+        import ompi_tpu.api as api
+        from ompi_tpu.boot.proc import launched_by_tpurun
+
+        world = api.init()
+        if launched_by_tpurun():
+            if world.local_size != 1:
+                raise err.MPIArgError(
+                    "the C API maps one process to one MPI rank; launch "
+                    "with exactly one local device per process "
+                    "(tpurun --cpu-devices 1, or one TPU chip)"
+                )
+            _comms[1] = world
+            _rank = world.local_offset
+            _size = world.size
+        else:
+            # standalone C program: a size-1 world (the mpirun -np 1 case)
+            _comms[1] = api.comm_self()
+            _rank, _size = 0, 1
+        _comms[2] = api.comm_self()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001 — C boundary
+        return _fail(e)
+
+
+def finalize() -> int:
+    try:
+        import ompi_tpu.api as api
+
+        _comms.clear()
+        _requests.clear()
+        api.finalize()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+# -- env ----------------------------------------------------------------
+
+
+def comm_size(h: int):
+    try:
+        c = _comm(h)
+        return (MPI_SUCCESS, int(getattr(c, "size", 1)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def comm_rank(h: int):
+    try:
+        c = _comm(h)
+        if h == 2 or getattr(c, "size", 1) == 1:
+            return (MPI_SUCCESS, 0)
+        return (MPI_SUCCESS, int(getattr(c, "local_offset", 0)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def comm_dup(h: int):
+    try:
+        return (MPI_SUCCESS, _store_comm(_comm(h).dup()))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def comm_split(h: int, color: int, key: int):
+    try:
+        c = _comm(h)
+        if not hasattr(c, "split"):
+            # MultiProcComm split lands with cross-process sub-groups
+            import sys
+
+            print("tpumpi: MPI_Comm_split on a multi-process communicator "
+                  "is not yet supported", file=sys.stderr)
+            return (MPI_ERR_OTHER, 0)
+        # Comm.split takes per-local-rank color/key sequences; with the
+        # C process=rank model each process contributes exactly one.
+        sub = c.split([color], [key])
+        if isinstance(sub, list):
+            sub = sub[0]
+        if sub is None:  # MPI_UNDEFINED color → MPI_COMM_NULL
+            return (MPI_SUCCESS, 0)
+        return (MPI_SUCCESS, _store_comm(sub))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def comm_free(h: int) -> int:
+    try:
+        if h > 2:  # WORLD/SELF are persistent
+            _comm(h).free()
+            _comms.pop(h, None)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def comm_set_name(h: int, name: str) -> int:
+    try:
+        _comm(h).name = name
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def type_size(dtcode: int):
+    dt = DTYPES.get(dtcode)
+    if dt is None:
+        return (MPI_ERR_TYPE, 0)
+    return (MPI_SUCCESS, int(dt.itemsize))
+
+
+# -- collectives --------------------------------------------------------
+
+
+def _coll_in(sptr: int, rptr: int, count: int, dtcode: int) -> np.ndarray:
+    """Sendbuf view honoring MPI_IN_PLACE (input taken from recvbuf)."""
+    if sptr == _IN_PLACE:
+        return _view(rptr, count, dtcode)
+    return _view(sptr, count, dtcode)
+
+
+def allreduce(sptr, rptr, count, dtcode, opcode, h) -> int:
+    try:
+        c = _comm(h)
+        x = _coll_in(sptr, rptr, count, dtcode)[None, :]  # (1 local rank, n)
+        out = np.asarray(c.allreduce(x, OPS[opcode]))
+        _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def reduce(sptr, rptr, count, dtcode, opcode, root, h) -> int:
+    try:
+        c = _comm(h)
+        x = _coll_in(sptr, rptr, count, dtcode)[None, :]
+        out = np.asarray(c.reduce(x, OPS[opcode], root=root))
+        me = comm_rank(h)[1]
+        if me == root and rptr not in (0, _IN_PLACE):
+            _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def bcast(ptr, count, dtcode, root, h) -> int:
+    try:
+        c = _comm(h)
+        buf = _view(ptr, count, dtcode)
+        out = np.asarray(c.bcast(buf[None, :], root=root))
+        buf[:] = out.reshape(-1)[:count]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def allgather(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        if sptr == _IN_PLACE:
+            # input is this rank's block of recvbuf
+            me = comm_rank(h)[1]
+            full = _view(rptr, rcount * n, rdt)
+            x = full[me * rcount : (me + 1) * rcount].copy()
+            scount, sdt = rcount, rdt
+        else:
+            x = _view(sptr, scount, sdt)
+        out = np.asarray(c.allgather(x[None, :]))  # (1, n, scount)
+        _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def gather(sptr, scount, sdt, rptr, rcount, rdt, root, h) -> int:
+    # rooted gather rides the allgather path (wire cost is acceptable on
+    # the fabric; the dedicated rooted schedule is a coll/base variant)
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        me = comm_rank(h)[1]
+        if sptr == _IN_PLACE:
+            # root's contribution is already in place in recvbuf
+            full = _view(rptr, rcount * n, rdt)
+            x = full[me * rcount : (me + 1) * rcount].copy()
+            scount, sdt = rcount, rdt
+        else:
+            x = _view(sptr, scount, sdt)
+        out = np.asarray(c.allgather(x[None, :]))
+        if me == root:
+            _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def scatter(sptr, scount, sdt, rptr, rcount, rdt, root, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        me = comm_rank(h)[1]
+        if me == root:
+            full = _view(sptr, scount * n, sdt).reshape(n, scount)
+            if rptr == _IN_PLACE:
+                # MPI_IN_PLACE recvbuf at root: its block stays in sendbuf
+                rcount = 0
+        else:
+            full = np.zeros((n, max(scount, rcount)), DTYPES[rdt])
+        out = np.asarray(c.scatter(full, root=root))
+        if rcount:
+            _view(rptr, rcount, rdt)[:] = out.reshape(-1)[:rcount]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def alltoall(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        if sptr == _IN_PLACE:
+            x = _view(rptr, rcount * n, rdt).reshape(1, n, rcount).copy()
+        else:
+            x = _view(sptr, scount * n, sdt).reshape(1, n, scount)
+        out = np.asarray(c.alltoall(x))
+        _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def reduce_scatter_block(sptr, rptr, rcount, dtcode, opcode, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        if sptr == _IN_PLACE:
+            x = _view(rptr, rcount * n, dtcode).reshape(1, n, rcount).copy()
+        else:
+            x = _view(sptr, rcount * n, dtcode).reshape(1, n, rcount)
+        out = np.asarray(c.reduce_scatter_block(x, OPS[opcode]))
+        _view(rptr, rcount, dtcode)[:] = out.reshape(-1)[:rcount]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def scan(sptr, rptr, count, dtcode, opcode, h) -> int:
+    try:
+        c = _comm(h)
+        x = _coll_in(sptr, rptr, count, dtcode)[None, :]
+        out = np.asarray(c.scan(x, OPS[opcode]))
+        _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def exscan(sptr, rptr, count, dtcode, opcode, h) -> int:
+    try:
+        c = _comm(h)
+        x = _coll_in(sptr, rptr, count, dtcode)[None, :]
+        out = np.asarray(c.exscan(x, OPS[opcode]))
+        me = comm_rank(h)[1]
+        if me != 0:  # rank 0's recvbuf is undefined in MPI_Exscan
+            _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def barrier(h) -> int:
+    try:
+        _comm(h).barrier()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+# -- pt2pt --------------------------------------------------------------
+
+
+def send(ptr, count, dtcode, dest, tag, h) -> int:
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        payload = _view(ptr, count, dtcode).copy()
+        c.send(payload, source=me, dest=dest, tag=tag)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def recv(ptr, count, dtcode, source, tag, h):
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        payload, st = c.recv(
+            dest=me,
+            source=None if source == -1 else source,
+            tag=None if tag == -1 else tag,
+        )
+        flat = np.asarray(payload).reshape(-1).view(DTYPES[dtcode])
+        got = min(flat.size, count)
+        _view(ptr, got, dtcode)[:] = flat[:got]
+        return (MPI_SUCCESS, int(st.source), int(st.tag), got)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), -1, -1, 0)
+
+
+def isend(ptr, count, dtcode, dest, tag, h):
+    # sends are buffered-eager (pml): local completion is immediate
+    rc = send(ptr, count, dtcode, dest, tag, h)
+    if rc != MPI_SUCCESS:
+        return (rc, 0)
+    return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, 0))))
+
+
+def irecv(ptr, count, dtcode, source, tag, h):
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        req = c.irecv(
+            dest=me,
+            source=None if source == -1 else source,
+            tag=None if tag == -1 else tag,
+        )
+        return (MPI_SUCCESS, _store_req(("recv", req, ptr, count, dtcode)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+# -- requests -----------------------------------------------------------
+
+
+def _complete(entry) -> tuple[int, int, int]:
+    """Finish a request entry; returns (source, tag, count)."""
+    kind, req, ptr, count, dtcode = entry
+    if kind == "done":
+        return entry[4] if isinstance(entry[4], tuple) else (0, 0, 0)
+    if kind == "recv":
+        payload = req.wait()
+        st = req.status
+        flat = np.asarray(payload).reshape(-1).view(DTYPES[dtcode])
+        got = min(flat.size, count)
+        _view(ptr, got, dtcode)[:] = flat[:got]
+        return (int(st.source), int(st.tag), got)
+    if kind == "coll":
+        out = req.wait()
+        if ptr not in (0, _IN_PLACE) and count:
+            flat = np.asarray(out).reshape(-1)[:count]
+            _view(ptr, count, dtcode)[:] = flat
+        return (0, 0, count)
+    raise err.MPIInternalError(f"bad request kind {kind}")
+
+
+def wait(rh: int):
+    try:
+        entry = _requests.pop(rh, None)
+        if entry is None:
+            raise err.MPIArgError(f"invalid request handle {rh}")
+        source, tag, count = _complete(entry)
+        return (MPI_SUCCESS, source, tag, count)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), -1, -1, 0)
+
+
+def test(rh: int):
+    try:
+        entry = _requests.get(rh)
+        if entry is None:
+            raise err.MPIArgError(f"invalid request handle {rh}")
+        kind, req = entry[0], entry[1]
+        ready = kind == "done" or (req is not None and req.test())
+        if not ready:
+            return (MPI_SUCCESS, 0, -1, -1, 0)
+        _requests.pop(rh, None)
+        source, tag, count = _complete(entry)
+        return (MPI_SUCCESS, 1, source, tag, count)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0, -1, -1, 0)
+
+
+# -- non-blocking collectives ------------------------------------------
+
+
+def iallreduce(sptr, rptr, count, dtcode, opcode, h):
+    try:
+        c = _comm(h)
+        x = _coll_in(sptr, rptr, count, dtcode)[None, :].copy()
+        req = c.iallreduce(x, OPS[opcode])
+        return (MPI_SUCCESS, _store_req(("coll", req, rptr, count, dtcode)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def _eager_coll(fn) -> tuple[int, int]:
+    """Blocking execution + completed handle: MPI-legal (completion at
+    wait is a superset of completion before wait); overlap comes from
+    the fabric-side async dispatch underneath where available."""
+    rc = fn()
+    if rc not in (None, MPI_SUCCESS):
+        return (int(rc), 0)
+    return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, 0))))
+
+
+def ibarrier(h):
+    try:
+        return _eager_coll(lambda: _comm(h).barrier())
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def ibcast(ptr, count, dtcode, root, h):
+    try:
+        return _eager_coll(lambda: bcast(ptr, count, dtcode, root, h))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def iallgather(sptr, scount, sdt, rptr, rcount, rdt, h):
+    try:
+        return _eager_coll(
+            lambda: allgather(sptr, scount, sdt, rptr, rcount, rdt, h)
+        )
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def ialltoall(sptr, scount, sdt, rptr, rcount, rdt, h):
+    try:
+        return _eager_coll(
+            lambda: alltoall(sptr, scount, sdt, rptr, rcount, rdt, h)
+        )
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)

@@ -122,6 +122,107 @@ class Convertor:
         return n
 
 
+# -- native (C++) fast path --------------------------------------------
+#
+# libtpuconvertor (native/src/convertor.cc) runs the committed iovec
+# program with per-block memcpy — the shape of the reference's native
+# opal_convertor inner loops.  Selected for host-resident numpy buffers
+# via the ``ddt_convertor_native`` MCA var; the numpy gather/scatter
+# path remains for partial pack streams and as the universal fallback.
+
+
+_native_var_cache: tuple[int, object] | None = None
+
+
+def _native_enabled() -> bool:
+    # hot path: cache the registered Var per store (register() walks the
+    # dedup table); re-fetch only if the MCA context was reset
+    global _native_var_cache
+    from ompi_tpu.core import mca
+
+    store = mca.default_context().store
+    if _native_var_cache is None or _native_var_cache[0] != id(store):
+        var = store.register(
+            "ddt", None, "convertor_native", True,
+            help="use the libtpuconvertor C++ pack/unpack kernels "
+                 "for host buffers when available",
+        )
+        _native_var_cache = (id(store), var)
+    return bool(_native_var_cache[1].value)
+
+
+def _native_bounds_check(dt: Datatype, count: int, origin: int, bufsize: int):
+    """Validate + return the iovec program for the native kernels.
+
+    Returns (offsets, lengths, packed_bytes) or raises like the numpy
+    path (same error surface either way)."""
+    iov = dt.iovec()
+    offs = np.array([o for o, _ in iov], np.int64)
+    lens = np.array([n for _, n in iov], np.int64)
+    lo_e = int(offs.min())
+    hi_e = int((offs + lens).max())
+    if dt.extent >= 0:
+        lo, hi = lo_e, hi_e + (count - 1) * dt.extent
+    else:
+        lo, hi = lo_e + (count - 1) * dt.extent, hi_e
+    if origin + lo < 0:
+        raise MPIArgError(
+            f"datatype addresses byte {origin + lo} before the buffer "
+            f"start; pass origin >= {-lo} for negative-lb types"
+        )
+    if origin + hi > bufsize:
+        raise MPITruncateError(
+            f"buffer too small: {bufsize} bytes < {origin + hi} required "
+            f"for {count} x {dt.name or 'datatype'}"
+        )
+    return offs, lens, count * int(lens.sum())
+
+
+def _native_pack(buf: np.ndarray, dt: Datatype, count: int, origin: int):
+    from ompi_tpu import native
+
+    lib = native.load_convertor()
+    if lib is None or not dt.iovec():
+        return None  # zero-size datatypes take the numpy path
+    import ctypes as _ct
+
+    offs, lens, nbytes = _native_bounds_check(dt, count, origin, buf.size)
+    out = np.empty(nbytes, np.uint8)
+    lib.tpuconv_pack(
+        buf.ctypes.data + origin, out.ctypes.data,
+        offs.ctypes.data_as(_ct.POINTER(_ct.c_int64)),
+        lens.ctypes.data_as(_ct.POINTER(_ct.c_int64)),
+        len(offs), count, dt.extent,
+    )
+    return out
+
+
+def _native_unpack(buf: np.ndarray, dt: Datatype, count: int, data, origin: int) -> bool:
+    from ompi_tpu import native
+
+    lib = native.load_convertor()
+    if lib is None or not dt.iovec() or not buf.flags.writeable:
+        # read-only buffers take the numpy path, which raises the same
+        # error the caller would see without the native lib
+        return False
+    src = _as_byte_view(data)
+    offs, lens, nbytes = _native_bounds_check(dt, count, origin, buf.size)
+    if src.size != nbytes:
+        raise MPITruncateError(
+            f"expected {nbytes} packed bytes, got {src.size}"
+        )
+    src = np.ascontiguousarray(src)
+    import ctypes as _ct
+
+    lib.tpuconv_unpack(
+        buf.ctypes.data + origin, src.ctypes.data,
+        offs.ctypes.data_as(_ct.POINTER(_ct.c_int64)),
+        lens.ctypes.data_as(_ct.POINTER(_ct.c_int64)),
+        len(offs), count, dt.extent,
+    )
+    return True
+
+
 # -- convenience one-shot API (hot path helpers) -----------------------
 
 
@@ -142,6 +243,10 @@ def pack(buffer, datatype: Datatype, count: int, origin: int = 0) -> np.ndarray:
                 f"for {count} x {datatype.name or 'datatype'}"
             )
         return buf[start:end]
+    if isinstance(buffer, np.ndarray) and count and _native_enabled():
+        out = _native_pack(_as_byte_view(buffer), datatype, count, origin)
+        if out is not None:
+            return out
     return Convertor(buffer, datatype, count, origin).pack()
 
 
@@ -161,6 +266,9 @@ def unpack(buffer, datatype: Datatype, count: int, data, origin: int = 0) -> Non
             )
         buf[start : start + src.size] = src
         return
+    if isinstance(buffer, np.ndarray) and count and _native_enabled():
+        if _native_unpack(_as_byte_view(buffer), datatype, count, data, origin):
+            return
     c = Convertor(buffer, datatype, count, origin)
     c.unpack(data)
     if not c.done:

@@ -1,0 +1,119 @@
+"""libtpuconvertor (C++ pack/unpack kernels) vs the numpy convertor.
+
+The shape of the reference's test/datatype corpus (SURVEY.md §4) run
+twice: the native path must be bit-identical to the vectorized-numpy
+path on every derived-type layout.
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.ddt import convertor, datatype as ddt
+
+
+@pytest.fixture(scope="module")
+def lib():
+    from ompi_tpu import native
+
+    if not native.toolchain_available():
+        pytest.skip("no C toolchain")
+    lib = native.load_convertor()
+    if lib is None:
+        pytest.skip("libtpuconvertor unavailable")
+    return lib
+
+
+def _layouts():
+    d = ddt.DOUBLE
+    yield "vector", d.create_vector(4, 2, 5), 3
+    yield "hvector", d.create_hvector(3, 2, 40), 2
+    yield "indexed", d.create_indexed([2, 1, 3], [0, 4, 9]), 2
+    yield "contig_of_vector", d.create_vector(2, 1, 3).create_contiguous(2), 2
+    yield "resized", d.create_resized(0, 24), 4
+    yield "subarray", d.create_subarray([4, 6], [2, 3], [1, 2]), 1
+
+
+@pytest.mark.parametrize("name,dt,count", list(_layouts()),
+                         ids=[n for n, _, _ in _layouts()])
+def test_native_pack_matches_numpy(lib, name, dt, count):
+    dt = dt.commit()
+    span = dt.span(count) + max(0, dt.lb)
+    rng = np.random.RandomState(7)
+    buf = rng.bytes(span + 64)
+    arr = np.frombuffer(buf, np.uint8).copy()
+
+    golden = convertor.Convertor(arr, dt, count).pack()
+    native_out = convertor._native_pack(arr, dt, count, 0)
+    assert native_out is not None
+    np.testing.assert_array_equal(native_out, golden)
+
+
+@pytest.mark.parametrize("name,dt,count", list(_layouts()),
+                         ids=[n for n, _, _ in _layouts()])
+def test_native_unpack_roundtrip(lib, name, dt, count):
+    dt = dt.commit()
+    span = dt.span(count) + max(0, dt.lb)
+    rng = np.random.RandomState(3)
+    src = np.frombuffer(rng.bytes(span + 64), np.uint8).copy()
+
+    packed = convertor.Convertor(src, dt, count).pack()
+    dst_native = np.zeros(src.size, np.uint8)
+    ok = convertor._native_unpack(dst_native, dt, count, packed, 0)
+    assert ok
+
+    dst_numpy = np.zeros(src.size, np.uint8)
+    c = convertor.Convertor(dst_numpy, dt, count)
+    c.unpack(packed)
+    np.testing.assert_array_equal(dst_native, dst_numpy)
+
+
+def test_one_shot_api_uses_native_and_matches(lib):
+    """pack()/unpack() dispatch to the native kernels for numpy buffers
+    and agree with the pure path under the MCA kill-switch."""
+    from ompi_tpu.core import mca
+
+    d = ddt.FLOAT.create_vector(8, 3, 7).commit()
+    count = 4
+    span = d.span(count)
+    arr = np.frombuffer(np.random.RandomState(0).bytes(span + 16), np.uint8).copy()
+
+    p_native = convertor.pack(arr, d, count)
+    store = mca.default_context().store
+    store.register("ddt", None, "convertor_native", True, help="")
+    store.set("ddt_convertor_native", False)
+    try:
+        p_pure = convertor.pack(arr, d, count)
+    finally:
+        store.set("ddt_convertor_native", True)
+    np.testing.assert_array_equal(p_native, p_pure)
+
+    out = np.zeros_like(arr)
+    convertor.unpack(out, d, count, p_native)
+    out2 = np.zeros_like(arr)
+    store.set("ddt_convertor_native", False)
+    try:
+        convertor.unpack(out2, d, count, p_native)
+    finally:
+        store.set("ddt_convertor_native", True)
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_native_bounds_errors(lib):
+    d = ddt.DOUBLE.create_vector(4, 2, 5).commit()
+    small = np.zeros(8, np.uint8)
+    from ompi_tpu.core.errors import MPITruncateError
+
+    with pytest.raises(MPITruncateError):
+        convertor.pack(small, d, 2)
+
+
+def test_strided_copy_kernel(lib):
+    import ctypes
+
+    src = np.arange(64, dtype=np.uint8)
+    dst = np.zeros(64, np.uint8)
+    # 4 blocks of 8 bytes: src stride 16 -> dst stride 8 (compaction)
+    lib.tpuconv_copy_strided(src.ctypes.data, dst.ctypes.data, 4, 8, 16, 8)
+    expect = np.concatenate([src[i * 16 : i * 16 + 8] for i in range(4)])
+    np.testing.assert_array_equal(dst[:32], expect)
+    assert not dst[32:].any()
