@@ -175,6 +175,29 @@ class Comm:
         single-slice → everything is one shared domain."""
         return self.dup(name=f"{self.name}.shared")
 
+    # -- one-sided windows (MPI_Win_* constructors; ≈ osc selection at
+    # window creation, SURVEY.md §3.5) --------------------------------
+
+    def _osc(self):
+        return mca.default_context().framework("osc").select_one()
+
+    def win_create(self, bases, name: str = ""):
+        """MPI_Win_create: expose per-rank 1-D buffers for RMA."""
+        self._check()
+        return self._osc().win_create(self, bases, name=name)
+
+    def win_allocate(self, size: int, dtype=np.float32, name: str = ""):
+        self._check()
+        return self._osc().win_allocate(self, size, dtype, name=name)
+
+    def win_allocate_shared(self, size: int, dtype=np.float32, name: str = ""):
+        self._check()
+        return self._osc().win_allocate_shared(self, size, dtype, name=name)
+
+    def win_create_dynamic(self, dtype=np.float32, name: str = ""):
+        self._check()
+        return self._osc().win_create_dynamic(self, dtype, name=name)
+
     def free(self) -> None:
         self._check()
         if self._coll is not None:

@@ -34,6 +34,25 @@ MPI_ERR_IN_STATUS = 18
 MPI_ERR_PENDING = 19
 MPI_ERR_KEYVAL = 36
 MPI_ERR_NO_MEM = 34
+# RMA / window error classes (MPI-3 one-sided)
+MPI_ERR_WIN = 53
+MPI_ERR_ASSERT = 22
+MPI_ERR_LOCKTYPE = 37
+MPI_ERR_DISP = 26
+MPI_ERR_RMA_CONFLICT = 46
+MPI_ERR_RMA_SYNC = 47
+MPI_ERR_RMA_RANGE = 55
+MPI_ERR_RMA_ATTACH = 56
+MPI_ERR_RMA_FLAVOR = 58
+# MPI-IO error classes
+MPI_ERR_FILE = 30
+MPI_ERR_ACCESS = 20
+MPI_ERR_AMODE = 21
+MPI_ERR_NO_SUCH_FILE = 42
+MPI_ERR_FILE_EXISTS = 28
+MPI_ERR_FILE_IN_USE = 29
+MPI_ERR_READ_ONLY = 45
+MPI_ERR_IO = 35
 
 
 class MPIError(Exception):
@@ -109,6 +128,42 @@ class MPIPendingError(MPIError):
 
 class MPIInStatusError(MPIError):
     error_class = MPI_ERR_IN_STATUS
+
+
+class MPIWinError(MPIError):
+    error_class = MPI_ERR_WIN
+
+
+class MPILockError(MPIError):
+    error_class = MPI_ERR_LOCKTYPE
+
+
+class MPIRMASyncError(MPIError):
+    error_class = MPI_ERR_RMA_SYNC
+
+
+class MPIRMAConflictError(MPIError):
+    error_class = MPI_ERR_RMA_CONFLICT
+
+
+class MPIRMARangeError(MPIError):
+    error_class = MPI_ERR_RMA_RANGE
+
+
+class MPIRMAAttachError(MPIError):
+    error_class = MPI_ERR_RMA_ATTACH
+
+
+class MPIFileError(MPIError):
+    error_class = MPI_ERR_FILE
+
+
+class MPIAmodeError(MPIError):
+    error_class = MPI_ERR_AMODE
+
+
+class MPIIOError(MPIError):
+    error_class = MPI_ERR_IO
 
 
 def error_string(error_class: int) -> str:

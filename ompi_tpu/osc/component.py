@@ -1,0 +1,39 @@
+"""``osc/arena`` MCA component — window factory.
+
+≈ the osc framework component slot (``ompi/mca/osc/``: rdma/sm/ucx in
+the reference, selected per window at MPI_Win_create).  One TPU-native
+component serves every flavor; the framework stays pluggable so a
+future true-remote-DMA component can outbid it.
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.core.registry import Component, register_component
+from .win import Win
+
+
+@register_component
+class ArenaOscComponent(Component):
+    FRAMEWORK = "osc"
+    NAME = "arena"
+    PRIORITY = 50
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "osc", "arena", "max_pending", 1 << 20, type="int",
+            help="Soft cap on queued RMA descriptors per window",
+        )
+
+    # factory methods mirror the four MPI window constructors
+    def win_create(self, comm, bases, name=""):
+        return Win.create(comm, bases, name=name)
+
+    def win_allocate(self, comm, size, dtype, name=""):
+        return Win.allocate(comm, size, dtype, name=name)
+
+    def win_allocate_shared(self, comm, size, dtype, name=""):
+        return Win.allocate_shared(comm, size, dtype, name=name)
+
+    def win_create_dynamic(self, comm, dtype, name=""):
+        return Win.create_dynamic(comm, dtype, name=name)

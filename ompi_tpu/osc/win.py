@@ -1,0 +1,671 @@
+"""One-sided communication (RMA): windows, synchronization epochs, atomics.
+
+TPU-native re-design of the osc framework (``ompi/mca/osc/`` — SURVEY.md
+§2.2 "osc — one-sided (RMA)"; reference components ``rdma``/``sm``/``ucx``
+with [bin] symbols ``ompi_osc_rdma_put/get/accumulate/lock_atomic/
+flush*``; call stack SURVEY.md §3.5).
+
+Design.  On the NIC fabrics the reference targets, RMA is hardware remote
+DMA: the origin posts a descriptor, the target's NIC moves bytes without
+target CPU involvement, and the MPI synchronization calls (fence / PSCW /
+lock-unlock) delimit when transfers are *observable*.  The TPU fabric
+exposes no user-level remote-DMA primitive — ICI moves data only inside
+XLA collectives — so the honest TPU-native mapping (SURVEY.md §7 step 9:
+"osc … where exposed / emulation") keeps the reference's *deferred
+completion* model and turns each synchronization call into the moment a
+batched **epoch program** is applied to window memory:
+
+* a ``Win`` is per-rank arena memory (host-pinned staging region, the
+  ``accelerator/tpu`` arena of SURVEY.md §2.3) addressed in elements of
+  its datatype, ``disp_unit`` semantics preserved;
+* ``put/get/accumulate/get_accumulate/fetch_and_op/compare_and_swap``
+  queue **descriptors** (exactly what ``ompi_osc_rdma_put`` builds for
+  the BTL) and complete at the next synchronization boundary;
+* the epoch close applies descriptors in a single deterministic pass in
+  global issue order — this serialization IS the conflict resolution MPI
+  leaves undefined, and makes every run reproducible (stronger than, but
+  conforming to, the standard's accumulate-ordering default ``rar,war,
+  raw,waw``);
+* accumulates use the op framework's numpy kernels — the same kernels
+  the bit-exactness suite validates against the reference's C loops;
+* ``device_view()`` stages the whole window onto the mesh (rank-major,
+  one rank's region per device) for fabric compute between epochs.
+
+Synchronization surface implemented (MPI-3 complete): collective
+``fence``; PSCW ``start/complete/post/wait/test``; passive-target
+``lock/lock_all/unlock/unlock_all/flush/flush_all/flush_local{,_all}/
+sync``; request-returning ``rput/rget/raccumulate/rget_accumulate``.
+Window flavors: create / allocate / allocate_shared (+``shared_query``) /
+create_dynamic (+``attach/detach``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import numpy as np
+
+from ompi_tpu.core.errors import (
+    MPIArgError,
+    MPIRankError,
+    MPIRMAAttachError,
+    MPIRMAConflictError,
+    MPIRMARangeError,
+    MPIRMASyncError,
+    MPIWinError,
+)
+from ompi_tpu.op.op import NO_OP, REPLACE, SUM, Op
+from ompi_tpu.request import Request
+
+# lock types (values match the reference's mpi.h)
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+# window create flavors (MPI_WIN_FLAVOR_*)
+FLAVOR_CREATE = 1
+FLAVOR_ALLOCATE = 2
+FLAVOR_DYNAMIC = 3
+FLAVOR_SHARED = 4
+
+# memory model: single address space ⇒ the strong MPI_WIN_UNIFIED model
+MODEL_UNIFIED = 1
+
+# assertion bits for fence/post/start (accepted, used as hints only —
+# the reference likewise treats most as optional optimization hints)
+MODE_NOCHECK = 1
+MODE_NOSTORE = 2
+MODE_NOPUT = 4
+MODE_NOPRECEDE = 8
+MODE_NOSUCCEED = 16
+
+
+class RMARequest(Request):
+    """Request returned by r-variants / fetch ops; completed (and its
+    value delivered) when the enclosing epoch or flush applies the
+    descriptor batch."""
+
+    def __init__(self):
+        super().__init__()
+        self._event = threading.Event()
+        self._value: Any = None
+
+    def _deliver(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+
+    def _poll(self) -> bool:
+        return self._event.is_set()
+
+    def _block(self) -> None:
+        # descriptors are applied by the controlling thread at epoch
+        # close; a bare wait() before any sync call is an epoch error.
+        if not self._event.is_set():
+            raise MPIRMASyncError(
+                "RMA request waited on before its epoch was completed "
+                "(call fence/flush/unlock/complete first)"
+            )
+
+    def _finalize(self) -> Any:
+        return self._value
+
+
+@dataclass
+class _Descriptor:
+    """One queued RMA operation (≈ the osc/rdma pending-frag entry)."""
+
+    kind: str  # put | get | acc | get_acc | fop | cas
+    origin: int
+    target: int
+    disp: int
+    count: int
+    seq: int
+    data: np.ndarray | None = None
+    op: Op | None = None
+    compare: np.ndarray | None = None
+    request: RMARequest | None = None
+    local_done: bool = False  # origin buffer reusable (flush_local)
+
+
+class _Epoch:
+    """Per-window synchronization state machine."""
+
+    def __init__(self, nranks: int):
+        self.fence_active = False
+        # PSCW: per-origin access set, per-target exposure set
+        self.access: dict[int, set[int]] = {}
+        self.exposure: dict[int, set[int]] = {}
+        # passive: target -> {origin: lock_type}
+        self.locks: dict[int, dict[int, int]] = {r: {} for r in range(nranks)}
+        self.lock_all: set[int] = set()  # origins holding lock_all
+
+
+class Win:
+    """An MPI window over per-rank arena regions.
+
+    Addressing is in **elements** of ``dtype`` (≈ ``disp_unit =
+    itemsize``); per-rank region sizes may differ (as in MPI, where each
+    rank passes its own ``size`` to ``MPI_Win_create``).
+    """
+
+    _name_counter = itertools.count(0)
+
+    def __init__(
+        self,
+        comm,
+        sizes: Sequence[int],
+        dtype: Any = np.float32,
+        flavor: int = FLAVOR_CREATE,
+        bases: Sequence[np.ndarray] | None = None,
+        name: str = "",
+    ):
+        n = comm.size
+        if len(sizes) != n:
+            raise MPIWinError(f"need {n} per-rank sizes, got {len(sizes)}")
+        self.comm = comm
+        self.dtype = np.dtype(dtype)
+        self.flavor = flavor
+        self.model = MODEL_UNIFIED
+        self.name = name or f"win#{next(Win._name_counter)}"
+        if bases is not None:
+            if len(bases) != n:
+                raise MPIWinError("bases/sizes length mismatch")
+            for b, s in zip(bases, sizes):
+                if b.ndim != 1 or b.shape[0] != s or b.dtype != self.dtype:
+                    raise MPIWinError(
+                        "window base must be 1-D of the declared size/dtype"
+                    )
+            self._mem = [np.ascontiguousarray(b) for b in bases]
+        else:
+            self._mem = [np.zeros(s, self.dtype) for s in sizes]
+        self.sizes = tuple(int(s) for s in sizes)
+        self._attrs: dict[int, Any] = {}
+        self._freed = False
+        self._seq = itertools.count(0)
+        self._pending: list[_Descriptor] = []
+        # soft cap on queued descriptors (osc_arena_max_pending MCA var)
+        from ompi_tpu.core import mca as _mca
+
+        self._max_pending = int(
+            _mca.default_context().store.get("osc_arena_max_pending", 1 << 20)
+        )
+        self._epoch = _Epoch(n)
+        # dynamic windows: per-rank {addr: array} attachments
+        self._dynamic: list[dict[int, np.ndarray]] = [dict() for _ in range(n)]
+
+    # -- constructors ---------------------------------------------------
+
+    @classmethod
+    def create(cls, comm, bases: Sequence[np.ndarray], name: str = "") -> "Win":
+        """MPI_Win_create: expose caller-owned per-rank buffers."""
+        bases = [np.asarray(b) for b in bases]
+        if not bases:
+            raise MPIWinError("empty bases")
+        dt = bases[0].dtype
+        return cls(
+            comm, [b.shape[0] for b in bases], dt,
+            flavor=FLAVOR_CREATE, bases=bases, name=name,
+        )
+
+    @classmethod
+    def allocate(cls, comm, size: int, dtype: Any = np.float32, name: str = "") -> "Win":
+        """MPI_Win_allocate: the window owns its (arena) memory."""
+        return cls(comm, [size] * comm.size, dtype, flavor=FLAVOR_ALLOCATE, name=name)
+
+    @classmethod
+    def allocate_shared(cls, comm, size: int, dtype: Any = np.float32, name: str = "") -> "Win":
+        """MPI_Win_allocate_shared: contiguous cross-rank layout, load/
+        store access via shared_query."""
+        win = cls(comm, [0] * comm.size, dtype, flavor=FLAVOR_SHARED, name=name)
+        # one contiguous block, per-rank views — the sm segment layout
+        block = np.zeros(size * comm.size, win.dtype)
+        win._shared_block = block
+        win._mem = [block[r * size:(r + 1) * size] for r in range(comm.size)]
+        win.sizes = (size,) * comm.size
+        return win
+
+    @classmethod
+    def create_dynamic(cls, comm, dtype: Any = np.float32, name: str = "") -> "Win":
+        """MPI_Win_create_dynamic: zero-size window; memory is attached
+        later with :meth:`attach` and addressed by attachment address."""
+        return cls(comm, [0] * comm.size, dtype, flavor=FLAVOR_DYNAMIC, name=name)
+
+    # -- dynamic attach/detach -----------------------------------------
+
+    def attach(self, rank: int, addr: int, array: np.ndarray) -> None:
+        self._check_flavor_dynamic()
+        self._check_rank(rank)
+        array = np.asarray(array)
+        if array.dtype != self.dtype or array.ndim != 1:
+            raise MPIRMAAttachError(
+                f"attachment must be 1-D {self.dtype} (got {array.dtype} "
+                f"ndim={array.ndim}); a dtype-converting copy would detach "
+                "RMA from the caller's memory"
+            )
+        if addr in self._dynamic[rank]:
+            raise MPIRMAAttachError(f"address {addr} already attached on rank {rank}")
+        for a, arr in self._dynamic[rank].items():
+            if a < addr + array.shape[0] and addr < a + arr.shape[0]:
+                raise MPIRMAAttachError(
+                    f"attachment [{addr},{addr+array.shape[0]}) overlaps "
+                    f"existing [{a},{a+arr.shape[0]}) on rank {rank}"
+                )
+        self._dynamic[rank][addr] = array
+
+    def detach(self, rank: int, addr: int) -> None:
+        self._check_flavor_dynamic()
+        self._check_rank(rank)
+        if addr not in self._dynamic[rank]:
+            raise MPIRMAAttachError(f"address {addr} not attached on rank {rank}")
+        del self._dynamic[rank][addr]
+
+    def _check_flavor_dynamic(self):
+        if self.flavor != FLAVOR_DYNAMIC:
+            raise MPIWinError("attach/detach only valid on dynamic windows")
+
+    # -- shared query ---------------------------------------------------
+
+    def shared_query(self, rank: int) -> tuple[int, np.ndarray]:
+        """(size, direct load/store view of rank's region) — MPI_Win_
+        shared_query; valid for the shared flavor only."""
+        if self.flavor != FLAVOR_SHARED:
+            raise MPIWinError("shared_query requires allocate_shared window")
+        self._check_rank(rank)
+        return self.sizes[rank], self._mem[rank]
+
+    # -- attributes / introspection ------------------------------------
+
+    @property
+    def group(self):
+        return self.comm.group
+
+    def set_attr(self, key: int, value: Any) -> None:
+        self._attrs[key] = value
+
+    def get_attr(self, key: int) -> Any:
+        return self._attrs.get(key)
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def memory(self, rank: int) -> np.ndarray:
+        """Local load/store access to rank's region (the "base pointer").
+        Reading it mid-epoch is the user's race, exactly as in MPI."""
+        self._check()
+        self._check_rank(rank)
+        return self._mem[rank]
+
+    def device_view(self):
+        """Stage the full window onto the comm's mesh rank-major:
+        (n, maxsize) device array, rank r's region on device r (short
+        regions zero-padded).  The fabric-compute bridge."""
+        n = self.comm.size
+        width = max(self.sizes) if self.sizes else 0
+        host = np.zeros((n, width), self.dtype)
+        for r in range(n):
+            host[r, : self.sizes[r]] = self._mem[r]
+        return self.comm.mesh.stage_in(host)
+
+    def free(self) -> None:
+        if self._pending:
+            raise MPIRMASyncError(
+                f"{len(self._pending)} RMA operations pending at win free"
+            )
+        self._freed = True
+
+    # -- bounds/validation ---------------------------------------------
+
+    def _check_rank(self, r: int) -> None:
+        if not 0 <= r < self.comm.size:
+            raise MPIRankError(f"rank {r} outside [0, {self.comm.size})")
+
+    def _check(self):
+        if self._freed:
+            raise MPIWinError(f"{self.name} has been freed")
+
+    def _region(self, target: int, disp: int, count: int) -> np.ndarray:
+        """Resolve (target, disp, count) to the backing slice."""
+        if count < 0 or disp < 0:
+            raise MPIRMARangeError(f"negative disp/count ({disp}, {count})")
+        if self.flavor == FLAVOR_DYNAMIC:
+            for addr, arr in self._dynamic[target].items():
+                if addr <= disp and disp + count <= addr + arr.shape[0]:
+                    return arr[disp - addr : disp - addr + count]
+            raise MPIRMARangeError(
+                f"[{disp},{disp+count}) not within any attachment on rank {target}"
+            )
+        if disp < 0 or disp + count > self.sizes[target]:
+            raise MPIRMARangeError(
+                f"[{disp},{disp+count}) outside window of size "
+                f"{self.sizes[target]} on rank {target}"
+            )
+        return self._mem[target][disp : disp + count]
+
+    def _check_epoch(self, origin: int, target: int) -> None:
+        """An RMA op needs an active access epoch at the origin covering
+        the target: fence, a PSCW access group containing target, a held
+        lock, or lock_all."""
+        e = self._epoch
+        if e.fence_active:
+            return
+        if target in e.access.get(origin, ()):  # PSCW
+            return
+        if origin in e.locks[target] or origin in e.lock_all:
+            return
+        raise MPIRMASyncError(
+            f"rank {origin} has no access epoch for target {target} "
+            "(need fence / start / lock / lock_all)"
+        )
+
+    # -- descriptor queueing (the RMA verbs) ---------------------------
+
+    def _queue(self, d: _Descriptor) -> None:
+        if len(self._pending) >= self._max_pending:
+            raise MPIRMASyncError(
+                f"{len(self._pending)} queued RMA descriptors exceed "
+                "osc_arena_max_pending; synchronize (fence/flush) first"
+            )
+        self._pending.append(d)
+
+    def put(self, origin: int, target: int, data, target_disp: int = 0) -> None:
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        self._check_epoch(origin, target)
+        data = np.ravel(np.asarray(data, self.dtype)).copy()
+        # validate range eagerly (the reference faults on descriptor build)
+        self._region(target, target_disp, data.shape[0])
+        self._queue(_Descriptor(
+            "put", origin, target, target_disp, data.shape[0],
+            next(self._seq), data=data,
+        ))
+
+    def get(self, origin: int, target: int, count: int, target_disp: int = 0) -> RMARequest:
+        """Queue a get; the request's value materializes at epoch close.
+        (MPI_Get has no return value — the value IS the request payload
+        here because the single controller has no origin buffer aliasing
+        to write into; MPI_Rget semantics.)"""
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        self._check_epoch(origin, target)
+        self._region(target, target_disp, count)
+        req = RMARequest()
+        self._queue(_Descriptor(
+            "get", origin, target, target_disp, count, next(self._seq),
+            request=req,
+        ))
+        return req
+
+    def accumulate(self, origin: int, target: int, data, target_disp: int = 0,
+                   op: Op = SUM) -> None:
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        self._check_epoch(origin, target)
+        if op.np_fn is None:
+            raise MPIArgError(f"{op.name} has no host kernel")
+        data = np.ravel(np.asarray(data, self.dtype)).copy()
+        self._region(target, target_disp, data.shape[0])
+        self._queue(_Descriptor(
+            "acc", origin, target, target_disp, data.shape[0],
+            next(self._seq), data=data, op=op,
+        ))
+
+    def get_accumulate(self, origin: int, target: int, data, target_disp: int = 0,
+                       op: Op = SUM) -> RMARequest:
+        """Atomic read-modify-write; request delivers the pre-op value.
+        ``op=NO_OP`` is the atomic get."""
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        self._check_epoch(origin, target)
+        if op is not NO_OP and op.np_fn is None:
+            raise MPIArgError(f"{op.name} has no host kernel")
+        data = np.ravel(np.asarray(data, self.dtype)).copy()
+        self._region(target, target_disp, data.shape[0])
+        req = RMARequest()
+        self._queue(_Descriptor(
+            "get_acc", origin, target, target_disp, data.shape[0],
+            next(self._seq), data=data, op=op, request=req,
+        ))
+        return req
+
+    def fetch_and_op(self, origin: int, target: int, value, target_disp: int = 0,
+                     op: Op = SUM) -> RMARequest:
+        """Single-element get_accumulate (the hot atomic: ≈ ompi_osc_
+        rdma_lock_atomic's fetch-add path)."""
+        return self.get_accumulate(
+            origin, target, np.asarray([value], self.dtype), target_disp, op
+        )
+
+    def compare_and_swap(self, origin: int, target: int, value, compare,
+                         target_disp: int = 0) -> RMARequest:
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        self._check_epoch(origin, target)
+        self._region(target, target_disp, 1)
+        req = RMARequest()
+        self._queue(_Descriptor(
+            "cas", origin, target, target_disp, 1, next(self._seq),
+            data=np.asarray([value], self.dtype),
+            compare=np.asarray([compare], self.dtype), request=req,
+        ))
+        return req
+
+    # r-variants: same queueing; the returned request completes at the
+    # next flush/sync covering it (for put/acc the payload is None).
+
+    def rput(self, origin: int, target: int, data, target_disp: int = 0) -> RMARequest:
+        self.put(origin, target, data, target_disp)
+        req = RMARequest()
+        self._pending[-1].request = req
+        return req
+
+    def rget(self, origin: int, target: int, count: int, target_disp: int = 0) -> RMARequest:
+        return self.get(origin, target, count, target_disp)
+
+    def raccumulate(self, origin: int, target: int, data, target_disp: int = 0,
+                    op: Op = SUM) -> RMARequest:
+        self.accumulate(origin, target, data, target_disp, op)
+        req = RMARequest()
+        self._pending[-1].request = req
+        return req
+
+    def rget_accumulate(self, origin: int, target: int, data, target_disp: int = 0,
+                        op: Op = SUM) -> RMARequest:
+        return self.get_accumulate(origin, target, data, target_disp, op)
+
+    # -- descriptor application (the epoch program) --------------------
+
+    def _apply(self, descs: list[_Descriptor]) -> None:
+        """Apply descriptors in global issue order — one deterministic
+        serialization pass (see module docstring)."""
+        for d in sorted(descs, key=lambda d: d.seq):
+            if d.kind == "put":
+                self._region(d.target, d.disp, d.count)[:] = d.data
+            elif d.kind == "get":
+                d.request._deliver(self._region(d.target, d.disp, d.count).copy())
+            elif d.kind == "acc":
+                r = self._region(d.target, d.disp, d.count)
+                r[:] = d.op.np_fn(r, d.data) if d.op is not REPLACE else d.data
+            elif d.kind == "get_acc":
+                r = self._region(d.target, d.disp, d.count)
+                old = r.copy()
+                if d.op is not NO_OP:
+                    r[:] = d.op.np_fn(r, d.data) if d.op is not REPLACE else d.data
+                d.request._deliver(old)
+            elif d.kind == "cas":
+                r = self._region(d.target, d.disp, 1)
+                old = r.copy()
+                if old[0] == d.compare[0]:
+                    r[:] = d.data
+                d.request._deliver(old[0])
+            if d.request is not None and not d.request._event.is_set():
+                d.request._deliver(None)
+
+    def _drain(self, pred) -> None:
+        hit = [d for d in self._pending if pred(d)]
+        if hit:
+            self._pending = [d for d in self._pending if not pred(d)]
+            self._apply(hit)
+
+    # -- synchronization: fence ----------------------------------------
+
+    def fence(self, assertion: int = 0) -> None:
+        """Collective fence: closes the previous fence epoch (applying
+        every queued descriptor) and opens the next one."""
+        self._check()
+        e = self._epoch
+        if e.access or any(e.locks[r] for r in e.locks) or e.lock_all:
+            raise MPIRMASyncError("fence while PSCW/lock epoch active")
+        self._drain(lambda d: True)
+        self.comm.barrier()
+        e.fence_active = not (assertion & MODE_NOSUCCEED)
+
+    # -- synchronization: PSCW -----------------------------------------
+
+    def start(self, origin: int, targets: Sequence[int], assertion: int = 0) -> None:
+        """MPI_Win_start: open an access epoch at origin for targets."""
+        self._check()
+        self._check_rank(origin)
+        if origin in self._epoch.access:
+            raise MPIRMASyncError(f"rank {origin} already in an access epoch")
+        for t in targets:
+            self._check_rank(t)
+        self._epoch.access[origin] = set(targets)
+
+    def post(self, target: int, origins: Sequence[int], assertion: int = 0) -> None:
+        """MPI_Win_post: open an exposure epoch at target for origins."""
+        self._check()
+        self._check_rank(target)
+        if target in self._epoch.exposure:
+            raise MPIRMASyncError(f"rank {target} already in an exposure epoch")
+        for o in origins:
+            self._check_rank(o)
+        self._epoch.exposure[target] = set(origins)
+
+    def complete(self, origin: int) -> None:
+        """MPI_Win_complete: close origin's access epoch, applying its
+        descriptors."""
+        self._check()
+        if origin not in self._epoch.access:
+            raise MPIRMASyncError(f"rank {origin} has no access epoch")
+        targets = self._epoch.access.pop(origin)
+        self._drain(lambda d: d.origin == origin and d.target in targets)
+
+    def wait(self, target: int) -> None:
+        """MPI_Win_wait: close target's exposure epoch.  All origins in
+        the exposure group must have completed (their descriptors are
+        applied synchronously in complete(), so any remaining pending op
+        into this target from a still-open access epoch is the error MPI
+        would deadlock on)."""
+        self._check()
+        if target not in self._epoch.exposure:
+            raise MPIRMASyncError(f"rank {target} has no exposure epoch")
+        origins = self._epoch.exposure[target]
+        still_open = [o for o in origins if o in self._epoch.access
+                      and target in self._epoch.access[o]]
+        if still_open:
+            raise MPIRMASyncError(
+                f"win_wait({target}) would deadlock: origins {still_open} "
+                "have not called complete()"
+            )
+        del self._epoch.exposure[target]
+
+    def test(self, target: int) -> bool:
+        """MPI_Win_test: non-blocking wait."""
+        self._check()
+        if target not in self._epoch.exposure:
+            raise MPIRMASyncError(f"rank {target} has no exposure epoch")
+        origins = self._epoch.exposure[target]
+        if any(o in self._epoch.access and target in self._epoch.access[o]
+               for o in origins):
+            return False
+        del self._epoch.exposure[target]
+        return True
+
+    # -- synchronization: passive target -------------------------------
+
+    def lock(self, origin: int, target: int, lock_type: int = LOCK_SHARED,
+             assertion: int = 0) -> None:
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        if lock_type not in (LOCK_SHARED, LOCK_EXCLUSIVE):
+            raise MPIArgError(f"bad lock type {lock_type}")
+        held = self._epoch.locks[target]
+        if origin in held:
+            raise MPIRMASyncError(f"rank {origin} already holds a lock on {target}")
+        if lock_type == LOCK_EXCLUSIVE and held:
+            raise MPIRMAConflictError(
+                f"exclusive lock on {target} conflicts with holders {sorted(held)}"
+            )
+        if any(t == LOCK_EXCLUSIVE for t in held.values()):
+            raise MPIRMAConflictError(
+                f"rank {target} is exclusively locked"
+            )
+        held[origin] = lock_type
+
+    def unlock(self, origin: int, target: int) -> None:
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        if origin not in self._epoch.locks[target]:
+            raise MPIRMASyncError(f"rank {origin} holds no lock on {target}")
+        self._drain(lambda d: d.origin == origin and d.target == target)
+        del self._epoch.locks[target][origin]
+
+    def lock_all(self, origin: int, assertion: int = 0) -> None:
+        self._check()
+        self._check_rank(origin)
+        if origin in self._epoch.lock_all:
+            raise MPIRMASyncError(f"rank {origin} already holds lock_all")
+        self._epoch.lock_all.add(origin)
+
+    def unlock_all(self, origin: int) -> None:
+        self._check()
+        if origin not in self._epoch.lock_all:
+            raise MPIRMASyncError(f"rank {origin} holds no lock_all")
+        self._drain(lambda d: d.origin == origin)
+        self._epoch.lock_all.discard(origin)
+
+    def flush(self, origin: int, target: int) -> None:
+        """Complete all ops from origin to target (lock epoch stays open)."""
+        self._check()
+        self._check_rank(origin)
+        self._check_rank(target)
+        if origin not in self._epoch.locks[target] and origin not in self._epoch.lock_all:
+            raise MPIRMASyncError("flush outside a passive-target epoch")
+        self._drain(lambda d: d.origin == origin and d.target == target)
+
+    def flush_all(self, origin: int) -> None:
+        self._check()
+        if origin not in self._epoch.lock_all and not any(
+            origin in self._epoch.locks[t] for t in self._epoch.locks
+        ):
+            raise MPIRMASyncError("flush_all outside a passive-target epoch")
+        self._drain(lambda d: d.origin == origin)
+
+    def flush_local(self, origin: int, target: int) -> None:
+        """Origin-local completion: with eager descriptor copies the
+        origin buffer is always already reusable, so this is flush()
+        minus nothing — kept as the API point (≈ osc/rdma, where eager
+        copies also make flush_local ≡ no-op for small frags)."""
+        self.flush(origin, target)
+
+    def flush_local_all(self, origin: int) -> None:
+        self.flush_all(origin)
+
+    def sync(self, rank: int) -> None:
+        """MPI_Win_sync: memory barrier between private/public copies —
+        unified model + single address space make it a no-op."""
+        self._check()
+        self._check_rank(rank)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"<Win {self.name} flavor={self.flavor} sizes={self.sizes} "
+                f"dtype={self.dtype} pending={len(self._pending)}>")

@@ -1,0 +1,374 @@
+"""One-sided (RMA) tests: windows, epochs, atomics.
+
+Models the coverage the reference gets from the external one-sided
+suites (mpi4py test_rma / ompi-tests onesided — SURVEY.md §4): every
+window flavor, every sync mode (fence / PSCW / lock / lock_all), every
+RMA verb including atomics, plus the epoch-discipline error cases.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.core.errors import (
+    MPIRMAAttachError,
+    MPIRMAConflictError,
+    MPIRMARangeError,
+    MPIRMASyncError,
+    MPIWinError,
+)
+from ompi_tpu.op import MAX, NO_OP, PROD, REPLACE, SUM
+from ompi_tpu.osc import LOCK_EXCLUSIVE, LOCK_SHARED, Win
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+N = 8
+
+
+# -- construction ------------------------------------------------------
+
+
+def test_allocate_shapes(world):
+    win = world.win_allocate(16, np.float64)
+    assert win.sizes == (16,) * N
+    assert win.dtype == np.float64
+    win.free()
+
+
+def test_create_wraps_user_buffers(world):
+    bases = [np.full(4, float(r), np.float32) for r in range(N)]
+    win = world.win_create(bases)
+    # window memory IS the user buffer (load/store access)
+    win.memory(3)[0] = 99.0
+    assert bases[3][0] == 99.0
+    win.free()
+
+
+def test_allocate_shared_query(world):
+    win = world.win_allocate_shared(4, np.int32)
+    size, view = win.shared_query(5)
+    assert size == 4
+    view[:] = 7
+    assert np.all(win.memory(5) == 7)
+    # contiguous block layout: rank r at offset r*size
+    assert win._shared_block[5 * 4] == 7
+    win.free()
+
+
+# -- fence epochs ------------------------------------------------------
+
+
+def test_put_get_fence(world):
+    win = world.win_allocate(8, np.float32)
+    win.fence()
+    data = np.arange(8, dtype=np.float32)
+    win.put(origin=0, target=3, data=data)
+    req = win.get(origin=1, target=3, count=8)
+    win.fence()
+    np.testing.assert_array_equal(win.memory(3), data)
+    # get was queued before... ordering: put seq < get seq -> get sees put
+    np.testing.assert_array_equal(req.wait(), data)
+    win.fence()
+    win.free()
+
+
+def test_accumulate_sum_fence(world):
+    win = world.win_allocate(4, np.float32)
+    win.fence()
+    for origin in range(N):
+        win.accumulate(origin, target=0, data=np.ones(4, np.float32), op=SUM)
+    win.fence()
+    np.testing.assert_array_equal(win.memory(0), np.full(4, N, np.float32))
+    win.fence()
+    win.free()
+
+
+def test_accumulate_ops(world):
+    win = world.win_allocate(2, np.int64)
+    win.memory(1)[:] = [3, 5]
+    win.fence()
+    win.accumulate(0, 1, np.array([10, 2], np.int64), op=MAX)
+    win.accumulate(2, 1, np.array([4, 4], np.int64), op=PROD)
+    win.fence()
+    # issue order: max([3,5],[10,2]) = [10,5]; then *[4,4] = [40,20]
+    np.testing.assert_array_equal(win.memory(1), [40, 20])
+    win.fence()
+    win.accumulate(0, 1, np.array([1, 1], np.int64), op=REPLACE)
+    win.fence()
+    np.testing.assert_array_equal(win.memory(1), [1, 1])
+    win.fence()
+    win.free()
+
+
+def test_rma_requires_epoch(world):
+    win = world.win_allocate(4)
+    with pytest.raises(MPIRMASyncError):
+        win.put(0, 1, np.zeros(4, np.float32))
+    win.free()
+
+
+def test_put_out_of_range(world):
+    win = world.win_allocate(4)
+    win.fence()
+    with pytest.raises(MPIRMARangeError):
+        win.put(0, 1, np.zeros(8, np.float32))
+    with pytest.raises(MPIRMARangeError):
+        win.get(0, 1, count=2, target_disp=3)
+    win.fence()
+    win.free()
+
+
+def test_free_with_pending_raises(world):
+    win = world.win_allocate(4)
+    win.fence()
+    win.put(0, 1, np.zeros(4, np.float32))
+    with pytest.raises(MPIRMASyncError):
+        win.free()
+    win.fence()
+    win.free()
+    with pytest.raises(MPIWinError):
+        win.memory(0)
+
+
+# -- PSCW --------------------------------------------------------------
+
+
+def test_pscw_put(world):
+    win = world.win_allocate(4, np.float32)
+    win.post(target=2, origins=[0, 1])
+    win.start(origin=0, targets=[2])
+    win.start(origin=1, targets=[2])
+    win.put(0, 2, np.full(2, 1.0, np.float32), target_disp=0)
+    win.put(1, 2, np.full(2, 2.0, np.float32), target_disp=2)
+    assert not win.test(2)  # origins still open
+    win.complete(0)
+    win.complete(1)
+    win.wait(2)
+    np.testing.assert_array_equal(win.memory(2), [1, 1, 2, 2])
+    win.free()
+
+
+def test_pscw_access_epoch_scoping(world):
+    win = world.win_allocate(4)
+    win.start(origin=0, targets=[1])
+    with pytest.raises(MPIRMASyncError):
+        win.put(0, 2, np.zeros(4, np.float32))  # 2 not in access group
+    with pytest.raises(MPIRMASyncError):
+        win.start(0, [3])  # nested access epoch
+    win.complete(0)
+    with pytest.raises(MPIRMASyncError):
+        win.complete(0)
+    win.free()
+
+
+def test_pscw_wait_deadlock_detected(world):
+    win = world.win_allocate(4)
+    win.post(target=1, origins=[0])
+    win.start(origin=0, targets=[1])
+    with pytest.raises(MPIRMASyncError):
+        win.wait(1)
+    win.complete(0)
+    win.wait(1)
+    win.free()
+
+
+# -- passive target ----------------------------------------------------
+
+
+def test_lock_unlock_put(world):
+    win = world.win_allocate(4, np.float32)
+    win.lock(origin=0, target=1, lock_type=LOCK_EXCLUSIVE)
+    win.put(0, 1, np.full(4, 5.0, np.float32))
+    win.unlock(0, 1)
+    np.testing.assert_array_equal(win.memory(1), np.full(4, 5.0))
+    win.free()
+
+
+def test_lock_conflicts(world):
+    win = world.win_allocate(4)
+    win.lock(0, 1, LOCK_EXCLUSIVE)
+    with pytest.raises(MPIRMAConflictError):
+        win.lock(2, 1, LOCK_SHARED)
+    win.unlock(0, 1)
+    win.lock(0, 1, LOCK_SHARED)
+    win.lock(2, 1, LOCK_SHARED)  # shared locks coexist
+    with pytest.raises(MPIRMAConflictError):
+        win.lock(3, 1, LOCK_EXCLUSIVE)
+    win.unlock(0, 1)
+    win.unlock(2, 1)
+    with pytest.raises(MPIRMASyncError):
+        win.unlock(2, 1)
+    win.free()
+
+
+def test_flush_completes_without_unlock(world):
+    win = world.win_allocate(1, np.float32)
+    win.lock(0, 1, LOCK_SHARED)
+    win.put(0, 1, [2.5])
+    win.flush(0, 1)
+    assert win.memory(1)[0] == 2.5
+    win.put(0, 1, [3.5])
+    win.flush_local(0, 1)
+    assert win.memory(1)[0] == 3.5
+    win.unlock(0, 1)
+    win.free()
+
+
+def test_lock_all_flush_all(world):
+    win = world.win_allocate(1, np.float32)
+    win.lock_all(origin=0)
+    for t in range(N):
+        win.put(0, t, [float(t)])
+    win.flush_all(0)
+    for t in range(N):
+        assert win.memory(t)[0] == float(t)
+    win.unlock_all(0)
+    with pytest.raises(MPIRMASyncError):
+        win.unlock_all(0)
+    win.free()
+
+
+def test_fence_rejects_mixed_epoch(world):
+    win = world.win_allocate(1)
+    win.lock(0, 1)
+    with pytest.raises(MPIRMASyncError):
+        win.fence()
+    win.unlock(0, 1)
+    win.free()
+
+
+# -- atomics -----------------------------------------------------------
+
+
+def test_fetch_and_op_serialized(world):
+    win = world.win_allocate(1, np.int64)
+    win.lock_all(0)
+    reqs = [win.fetch_and_op(0, 0, 1, op=SUM) for _ in range(10)]
+    win.flush_all(0)
+    olds = sorted(int(r.wait()) for r in reqs)
+    # atomic fetch-add: each sees a distinct pre-value 0..9
+    assert olds == list(range(10))
+    assert win.memory(0)[0] == 10
+    win.unlock_all(0)
+    win.free()
+
+
+def test_get_accumulate_no_op_is_atomic_get(world):
+    win = world.win_allocate(2, np.float32)
+    win.memory(4)[:] = [1.0, 2.0]
+    win.lock(0, 4)
+    req = win.get_accumulate(0, 4, np.zeros(2, np.float32), op=NO_OP)
+    win.unlock(0, 4)
+    np.testing.assert_array_equal(req.wait(), [1.0, 2.0])
+    np.testing.assert_array_equal(win.memory(4), [1.0, 2.0])
+    win.free()
+
+
+def test_compare_and_swap(world):
+    win = world.win_allocate(1, np.int32)
+    win.memory(2)[0] = 7
+    win.lock_all(0)
+    r1 = win.compare_and_swap(0, 2, value=9, compare=7)
+    r2 = win.compare_and_swap(0, 2, value=11, compare=7)  # loses the race
+    win.flush_all(0)
+    assert int(r1.wait()) == 7
+    assert int(r2.wait()) == 9  # saw r1's update, compare failed
+    assert win.memory(2)[0] == 9
+    win.unlock_all(0)
+    win.free()
+
+
+def test_rput_request_completion(world):
+    win = world.win_allocate(1, np.float32)
+    win.fence()
+    req = win.rput(0, 1, [4.0])
+    with pytest.raises(MPIRMASyncError):
+        req.wait()  # not completed until sync
+    win.fence()
+    assert req.wait() is None
+    assert win.memory(1)[0] == 4.0
+    win.fence()
+    win.free()
+
+
+# -- dynamic windows ---------------------------------------------------
+
+
+def test_dynamic_attach_rma(world):
+    win = world.win_create_dynamic(np.float64)
+    seg = np.zeros(4, np.float64)
+    win.attach(rank=1, addr=1000, array=seg)
+    win.fence()
+    win.put(0, 1, np.ones(4, np.float64), target_disp=1000)
+    win.fence()
+    np.testing.assert_array_equal(seg, np.ones(4))
+    win.fence()
+    with pytest.raises(MPIRMARangeError):
+        win.put(0, 1, np.ones(1), target_disp=2000)
+    win.fence()
+    with pytest.raises(MPIRMAAttachError):
+        win.attach(1, 1002, np.zeros(4, np.float64))  # overlap
+    win.detach(1, 1000)
+    with pytest.raises(MPIRMAAttachError):
+        win.detach(1, 1000)
+    win.free()
+
+
+# -- device staging ----------------------------------------------------
+
+
+def test_device_view_rank_major(world):
+    win = world.win_allocate(4, np.float32)
+    for r in range(N):
+        win.memory(r)[:] = r
+    dv = win.device_view()
+    assert dv.shape == (N, 4)
+    np.testing.assert_array_equal(
+        np.asarray(dv), np.repeat(np.arange(N, dtype=np.float32)[:, None], 4, axis=1)
+    )
+    win.free()
+
+
+def test_attach_rejects_dtype_mismatch(world):
+    win = world.win_create_dynamic(np.float64)
+    with pytest.raises(MPIRMAAttachError):
+        win.attach(1, 0, np.zeros(4, np.float32))  # hidden copy would detach RMA
+    with pytest.raises(Exception):
+        win.attach(-1, 0, np.zeros(4, np.float64))
+    win.free()
+
+
+def test_negative_count_rejected(world):
+    win = world.win_allocate(4)
+    win.fence()
+    with pytest.raises(MPIRMARangeError):
+        win.get(0, 1, count=-1)
+    with pytest.raises(MPIRMARangeError):
+        win.get(0, 1, count=1, target_disp=-2)
+    win.fence()
+    win.free()
+
+
+def test_get_accumulate_validates_eagerly(world):
+    win = world.win_allocate(4)
+    win.fence()
+    with pytest.raises(MPIRMARangeError):
+        win.get_accumulate(0, 1, np.zeros(100, np.float32))
+    with pytest.raises(MPIRMARangeError):
+        win.compare_and_swap(0, 1, 1.0, 2.0, target_disp=99)
+    win.fence()
+    win.free()
+
+
+def test_group_and_attrs(world):
+    win = world.win_allocate(2)
+    assert win.group.size == N
+    win.set_attr(7, "x")
+    assert win.get_attr(7) == "x"
+    win.set_name("mywin")
+    assert win.name == "mywin"
+    win.free()
