@@ -1,13 +1,15 @@
 """Collective framework (≈ ompi/mca/coll, SURVEY.md §2.2).
 
 Components: ``xla`` (fabric collectives over the mesh — the north-star
-component), ``basic`` (host fallback + jagged v-variants). The shared
-algorithm library lives in :mod:`ompi_tpu.coll.base`; per-communicator
-module stacking in :mod:`ompi_tpu.coll.module`.
+component), ``tuned`` (the per-call algorithm decision layer with fixed
++ dynamic-file rules), ``basic`` (host fallback + jagged v-variants).
+The shared algorithm library lives in :mod:`ompi_tpu.coll.base`;
+per-communicator module stacking in :mod:`ompi_tpu.coll.module`.
 """
 
 from . import base  # noqa: F401
 from .basic import BasicCollComponent, BasicCollModule  # noqa: F401
 from .han import HanCollComponent, HanCollModule  # noqa: F401
 from .module import COLL_OPS, CollModule, CollTable, select_coll_modules  # noqa: F401
+from .tuned import TunedCollComponent, TunedCollModule  # noqa: F401
 from .xla import XlaCollComponent, XlaCollModule  # noqa: F401

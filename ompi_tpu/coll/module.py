@@ -115,7 +115,13 @@ def select_coll_modules(comm, framework) -> CollTable:
         query = getattr(comp, "query", None)
         if query is None:
             continue
-        module = query(comm)
+        # decision-layer components (tuned) see the partially built
+        # table so they can wrap lower-priority modules — the analog of
+        # comm->c_coll being visible to later modules in comm_select
+        if getattr(query, "wants_table", False):
+            module = query(comm, table)
+        else:
+            module = query(comm)
         if module is None:
             continue
         table.modules.append(module)

@@ -28,6 +28,7 @@ Design:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from functools import partial
 from typing import Any, Callable
 
@@ -69,6 +70,19 @@ class XlaCollModule(CollModule):
         super().__init__(comm)
         self.component = component
         self._cache: dict[tuple, Callable] = {}
+        #: per-call var overrides installed by a decision layer (the
+        #: coll/tuned module forces its chosen algorithm through here)
+        self._forced: dict[str, int] = {}
+
+    @contextmanager
+    def forced(self, **overrides):
+        """Temporarily force algorithm/segcount vars (tuned's decision)."""
+        prev = self._forced
+        self._forced = {k: v for k, v in overrides.items() if v is not None}
+        try:
+            yield
+        finally:
+            self._forced = prev
 
     # -- compiled-program factory ---------------------------------------
 
@@ -96,6 +110,8 @@ class XlaCollModule(CollModule):
         return self.comm.size
 
     def _algo(self, var: str, enum: dict[str, int], default: str = "auto") -> int:
+        if var in self._forced:
+            return int(self._forced[var])
         store = self.component.store
         v = store.get(f"coll_xla_{var}", enum[default])
         return v
@@ -104,6 +120,8 @@ class XlaCollModule(CollModule):
         return bool(self.component.store.get("coll_xla_reproducible", False))
 
     def _segcount(self) -> int:
+        if "segcount" in self._forced:
+            return int(self._forced["segcount"])
         return int(self.component.store.get("coll_xla_segcount", 1 << 16))
 
     # ==================================================================
@@ -352,7 +370,11 @@ class XlaCollModule(CollModule):
         return ArrayRequest(self.reduce_scatter(x, op, counts))
 
     def reduce_scatter_init(self, x, op: Op, counts=None) -> PersistentRequest:
-        return PersistentRequest(lambda: self.ireduce_scatter(x, op, counts))
+        if counts is not None and len(set(counts)) != 1:
+            return PersistentRequest(lambda: self.ireduce_scatter(x, op, counts))
+        # compile now so a decision layer's forced() choice is captured
+        fn = self._reduce_scatter_block_fn(x, op)
+        return PersistentRequest(lambda: ArrayRequest(fn(x)))
 
     # ==================================================================
     # alltoall
@@ -416,7 +438,11 @@ class XlaCollModule(CollModule):
         return ArrayRequest(self._barrier_fn()(self.comm.mesh.stage_in(token)))
 
     def barrier_init(self) -> PersistentRequest:
-        return PersistentRequest(lambda: self.ibarrier())
+        # compile now so a decision layer's forced() choice is captured
+        fn = self._barrier_fn()
+        token = np.zeros((self._n(),), np.int32)
+        staged = self.comm.mesh.stage_in(token)
+        return PersistentRequest(lambda: ArrayRequest(fn(staged)))
 
     # ==================================================================
     # scan / exscan

@@ -136,6 +136,7 @@ class _Epoch:
         self.fence_active = False
         # PSCW: per-origin access set, per-target exposure set
         self.access: dict[int, set[int]] = {}
+        self.access_nocheck: set[int] = set()  # origins started w/ NOCHECK
         self.exposure: dict[int, set[int]] = {}
         # passive: target -> {origin: lock_type}
         self.locks: dict[int, dict[int, int]] = {r: {} for r in range(nranks)}
@@ -351,7 +352,14 @@ class Win:
         if e.fence_active:
             return
         if target in e.access.get(origin, ()):  # PSCW
-            return
+            # ops may not proceed past start() until the matching
+            # post() — unless start was given MODE_NOCHECK
+            if origin in e.access_nocheck or origin in e.exposure.get(target, ()):
+                return
+            raise MPIRMASyncError(
+                f"rank {origin} started an access epoch for {target} but "
+                f"{target} has not posted a matching exposure epoch"
+            )
         if origin in e.locks[target] or origin in e.lock_all:
             return
         raise MPIRMASyncError(
@@ -537,6 +545,8 @@ class Win:
         for t in targets:
             self._check_rank(t)
         self._epoch.access[origin] = set(targets)
+        if assertion & MODE_NOCHECK:
+            self._epoch.access_nocheck.add(origin)
 
     def post(self, target: int, origins: Sequence[int], assertion: int = 0) -> None:
         """MPI_Win_post: open an exposure epoch at target for origins."""
@@ -555,6 +565,7 @@ class Win:
         if origin not in self._epoch.access:
             raise MPIRMASyncError(f"rank {origin} has no access epoch")
         targets = self._epoch.access.pop(origin)
+        self._epoch.access_nocheck.discard(origin)
         self._drain(lambda d: d.origin == origin and d.target in targets)
 
     def wait(self, target: int) -> None:
@@ -600,9 +611,12 @@ class Win:
         held = self._epoch.locks[target]
         if origin in held:
             raise MPIRMASyncError(f"rank {origin} already holds a lock on {target}")
-        if lock_type == LOCK_EXCLUSIVE and held:
+        if lock_type == LOCK_EXCLUSIVE and (held or self._epoch.lock_all):
+            # lock_all is a shared lock on every target, so it conflicts
+            # with any exclusive request
             raise MPIRMAConflictError(
-                f"exclusive lock on {target} conflicts with holders {sorted(held)}"
+                f"exclusive lock on {target} conflicts with holders "
+                f"{sorted(held) or sorted(self._epoch.lock_all)}"
             )
         if any(t == LOCK_EXCLUSIVE for t in held.values()):
             raise MPIRMAConflictError(
@@ -624,6 +638,14 @@ class Win:
         self._check_rank(origin)
         if origin in self._epoch.lock_all:
             raise MPIRMASyncError(f"rank {origin} already holds lock_all")
+        excl = [
+            t for t, held in self._epoch.locks.items()
+            if any(ty == LOCK_EXCLUSIVE for ty in held.values())
+        ]
+        if excl:
+            raise MPIRMAConflictError(
+                f"lock_all conflicts with exclusive locks on ranks {excl}"
+            )
         self._epoch.lock_all.add(origin)
 
     def unlock_all(self, origin: int) -> None:

@@ -1,0 +1,210 @@
+"""coll/tuned decision-layer tests: fixed rules, dynamic rule files,
+stacking above coll/xla (≈ the reference's tuned-over-basic selection,
+SURVEY.md §2.2/§3.3 `ompi_coll_tuned_allreduce_intra_dec_fixed`)."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.coll.tuned import (
+    COLL_IDS,
+    RuleSet,
+    TunedCollComponent,
+    TunedCollModule,
+    fixed_decision,
+    parse_rules_file,
+)
+from ompi_tpu.coll.xla import (
+    ALLGATHER_ALGOS,
+    ALLREDUCE_ALGOS,
+    ALLTOALL_ALGOS,
+    BARRIER_ALGOS,
+    BCAST_ALGOS,
+    REDUCE_ALGOS,
+    REDUCE_SCATTER_ALGOS,
+)
+from ompi_tpu.core.errors import MPIArgError
+from ompi_tpu.op import MAX, PROD, SUM, create_op
+
+N = 8
+LARGE = 1 << 20
+HUGE = 64 << 20
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+# -- fixed decision tables ---------------------------------------------
+
+
+def test_fixed_allreduce_fabric_op():
+    alg, _ = fixed_decision("allreduce", N, 1024, SUM, LARGE, HUGE)
+    assert alg == ALLREDUCE_ALGOS["psum"]
+    alg, _ = fixed_decision("allreduce", N, HUGE * 2, MAX, LARGE, HUGE)
+    assert alg == ALLREDUCE_ALGOS["psum"]  # pmax is fabric too
+
+
+def test_fixed_allreduce_software_op_size_ladder():
+    # PROD: commutative but no fused lax collective
+    small, _ = fixed_decision("allreduce", N, 1024, PROD, LARGE, HUGE)
+    large, _ = fixed_decision("allreduce", N, LARGE, PROD, LARGE, HUGE)
+    huge, _ = fixed_decision("allreduce", N, HUGE, PROD, LARGE, HUGE)
+    assert small == ALLREDUCE_ALGOS["recursive_doubling"]
+    assert large == ALLREDUCE_ALGOS["rabenseifner"]
+    assert huge == ALLREDUCE_ALGOS["ring_segmented"]
+
+
+def test_fixed_allreduce_noncommutative_is_ordered():
+    nc = create_op(lambda a, b: a + b, commute=False)
+    alg, _ = fixed_decision("allreduce", N, 10, nc, LARGE, HUGE)
+    assert alg == ALLREDUCE_ALGOS["ordered_linear"]
+
+
+def test_fixed_misc_tables():
+    assert fixed_decision("bcast", N, 64, None, LARGE, HUGE)[0] == BCAST_ALGOS["direct"]
+    assert fixed_decision("bcast", N, HUGE, None, LARGE, HUGE)[0] == BCAST_ALGOS["pipeline"]
+    assert fixed_decision("allgather", N, HUGE, None, LARGE, HUGE)[0] == ALLGATHER_ALGOS["ring"]
+    assert fixed_decision("alltoall", N, 64, None, LARGE, HUGE)[0] == ALLTOALL_ALGOS["direct"]
+    assert fixed_decision("reduce_scatter", N, 64, SUM, LARGE, HUGE)[0] == REDUCE_SCATTER_ALGOS["direct"]
+    assert fixed_decision("reduce_scatter", N, 64, PROD, LARGE, HUGE)[0] == REDUCE_SCATTER_ALGOS["ring"]
+    assert fixed_decision("barrier", 32, 0, None, LARGE, HUGE)[0] == BARRIER_ALGOS["dissemination"]
+    assert fixed_decision("barrier", 8, 0, None, LARGE, HUGE)[0] == BARRIER_ALGOS["allreduce"]
+    assert fixed_decision("scan", N, 64, SUM, LARGE, HUGE) == (None, None)
+
+
+# -- dynamic rules file ------------------------------------------------
+
+RULES = """
+# tuned dynamic rules (reference format)
+1          # one collective
+2          # ALLREDUCE
+2          # two comm-size brackets
+4          # comm size 4
+1          # one rule
+0 4 0 0    # from 0 bytes: algorithm 4 (recursive_doubling)
+8          # comm size 8
+2
+0 2 0 0        # from 0 bytes: ring
+4096 3 0 65536 # from 4 KiB: ring_segmented, segsize 64 KiB
+"""
+
+
+def test_parse_and_lookup():
+    rs = parse_rules_file(RULES)
+    # comm of 8: msg 100 → ring; msg 8192 → ring_segmented + segsize
+    assert rs.lookup("allreduce", 8, 100) == (2, 0)
+    assert rs.lookup("allreduce", 8, 8192) == (3, 65536)
+    # comm of 5 matches the size-4 bracket (largest ≤ actual)
+    assert rs.lookup("allreduce", 5, 100) == (4, 0)
+    # comm of 3: no bracket ≤ 3
+    assert rs.lookup("allreduce", 3, 100) is None
+    # other collectives unaffected
+    assert rs.lookup("bcast", 8, 100) is None
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(MPIArgError):
+        parse_rules_file("1 2 nope")
+    with pytest.raises(MPIArgError):
+        parse_rules_file("1 2 1 8")  # truncated
+
+
+def test_rule_algorithm_zero_falls_back():
+    rs = parse_rules_file("1\n2\n1\n2\n1\n0 0 0 0\n")
+    assert rs.lookup("allreduce", 8, 100) is None
+
+
+# -- integration: stacking + end-to-end --------------------------------
+
+
+def test_tuned_wins_slots(world):
+    assert world.coll.providers["allreduce"] == "tuned"
+    assert world.coll.providers["iallreduce"] == "tuned"
+    assert world.coll.providers["bcast"] == "tuned"
+    # jagged v-variants stay with basic (xla/tuned don't provide them)
+    assert world.coll.providers["allgatherv"] == "basic"
+
+
+def test_tuned_allreduce_correct(world):
+    x = np.arange(N * 16, dtype=np.float64).reshape(N, 16)
+    out = np.asarray(world.allreduce(x, SUM))
+    np.testing.assert_allclose(out[0], x.sum(axis=0))
+
+
+def test_tuned_forces_chosen_algorithm(world):
+    """The decision must actually reach the xla compiled-program cache."""
+    comm = world.dup("tuned-probe")
+    table = comm.coll
+    tuned = next(m for m in table.modules if isinstance(m, TunedCollModule))
+    inner = tuned.inner
+    # PROD small → recursive_doubling per fixed rules
+    x = np.ones((N, 4), np.float64)
+    comm.allreduce(x, PROD)
+    assert any(
+        k[0] == "allreduce" and k[1] == ALLREDUCE_ALGOS["recursive_doubling"]
+        for k in inner._cache
+    ), list(inner._cache)
+    comm.free()
+
+
+def test_dynamic_rules_drive_dispatch(world, tmp_path):
+    path = tmp_path / "rules.conf"
+    path.write_text("1\n2\n1\n2\n1\n0 2 0 0\n")  # allreduce → ring everywhere ≥2 ranks
+    comm = world.dup("rules-probe")
+    table = comm.coll
+    tuned = next(m for m in table.modules if isinstance(m, TunedCollModule))
+    comp = tuned.component
+    store = comp.store
+    # simulate --mca coll_tuned_use_dynamic_rules 1 (set + re-open)
+    from ompi_tpu.coll.tuned import parse_rules_file as _p
+
+    comp.ruleset = _p(path.read_text())
+    try:
+        x = np.ones((N, 4), np.float64)
+        out = np.asarray(comm.allreduce(x, SUM))
+        np.testing.assert_allclose(out[0], np.full(4, N))
+        inner = tuned.inner
+        assert any(
+            k[0] == "allreduce" and k[1] == ALLREDUCE_ALGOS["ring"]
+            for k in inner._cache
+        ), list(inner._cache)
+    finally:
+        comp.ruleset = None
+        comm.free()
+
+
+def test_rules_file_bad_algorithm_id():
+    # invalid algorithm ids are rejected at parse time, not first use
+    with pytest.raises(MPIArgError):
+        parse_rules_file("1\n2\n1\n2\n1\n0 99 0 0\n")
+
+
+def test_component_open_parses_file(tmp_path):
+    from ompi_tpu.core.var import VarStore
+
+    path = tmp_path / "r.conf"
+    path.write_text(RULES)
+    comp = TunedCollComponent()
+    store = VarStore(cmdline={
+        "coll_tuned_use_dynamic_rules": "1",
+        "coll_tuned_dynamic_rules_filename": str(path),
+    })
+    comp.register_params(store)
+    assert comp.open(store)
+    assert comp.ruleset is not None
+    assert comp.ruleset.lookup("allreduce", 8, 8192) == (3, 65536)
+
+
+def test_component_open_missing_file(tmp_path):
+    from ompi_tpu.core.var import VarStore
+
+    comp = TunedCollComponent()
+    store = VarStore(cmdline={
+        "coll_tuned_use_dynamic_rules": "1",
+        "coll_tuned_dynamic_rules_filename": str(tmp_path / "absent.conf"),
+    })
+    comp.register_params(store)
+    with pytest.raises(MPIArgError):
+        comp.open(store)

@@ -152,8 +152,39 @@ def test_pscw_put(world):
     win.free()
 
 
+def test_pscw_requires_matching_post(world):
+    win = world.win_allocate(4)
+    win.start(origin=0, targets=[1])
+    with pytest.raises(MPIRMASyncError):
+        win.put(0, 1, np.zeros(4, np.float32))  # 1 never posted
+    win.complete(0)
+    # MODE_NOCHECK waives the post requirement (user asserts the match)
+    from ompi_tpu.osc import MODE_NOCHECK
+
+    win.start(origin=0, targets=[1], assertion=MODE_NOCHECK)
+    win.put(0, 1, np.zeros(4, np.float32))
+    win.complete(0)
+    win.free()
+
+
+def test_lock_all_vs_exclusive_conflicts(world):
+    win = world.win_allocate(1)
+    win.lock_all(0)
+    with pytest.raises(MPIRMAConflictError):
+        win.lock(1, 2, LOCK_EXCLUSIVE)  # lock_all holds shared everywhere
+    win.lock(1, 2, LOCK_SHARED)  # shared+shared fine
+    win.unlock(1, 2)
+    win.unlock_all(0)
+    win.lock(1, 2, LOCK_EXCLUSIVE)
+    with pytest.raises(MPIRMAConflictError):
+        win.lock_all(0)
+    win.unlock(1, 2)
+    win.free()
+
+
 def test_pscw_access_epoch_scoping(world):
     win = world.win_allocate(4)
+    win.post(target=1, origins=[0])
     win.start(origin=0, targets=[1])
     with pytest.raises(MPIRMASyncError):
         win.put(0, 2, np.zeros(4, np.float32))  # 2 not in access group
