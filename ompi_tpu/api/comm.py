@@ -198,6 +198,15 @@ class Comm:
         self._check()
         return self._osc().win_create_dynamic(self, dtype, name=name)
 
+    # -- MPI-IO (MPI_File_open; ≈ io framework selection) --------------
+
+    def file_open(self, path: str, amode: int):
+        """MPI_File_open: collective open through the selected io
+        component (io/ompio)."""
+        self._check()
+        comp = mca.default_context().framework("io").select_one()
+        return comp.file_open(self, path, amode)
+
     def free(self) -> None:
         self._check()
         if self._coll is not None:

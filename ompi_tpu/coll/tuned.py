@@ -352,8 +352,19 @@ class TunedCollComponent(Component):
                 try:
                     with open(path) as f:
                         self.ruleset = parse_rules_file(f.read())
-                except OSError as e:
-                    raise MPIArgError(f"cannot read rules file {path}: {e}") from e
+                except (OSError, MPIArgError) as e:
+                    # the reference warns and continues on fixed decisions
+                    # (a raise here would silently drop the whole component:
+                    # Framework.open treats component exceptions as
+                    # "unusable")
+                    import warnings
+
+                    warnings.warn(
+                        f"coll/tuned: ignoring dynamic rules file {path}: {e}",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    self.ruleset = None
         return True
 
     def query(self, comm, table=None) -> TunedCollModule | None:

@@ -20,6 +20,7 @@ _BUILTIN_COMPONENT_MODULES = (
     "ompi_tpu.coll",
     "ompi_tpu.p2p.component",
     "ompi_tpu.osc.component",
+    "ompi_tpu.io.component",
 )
 
 

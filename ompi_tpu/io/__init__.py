@@ -1,0 +1,18 @@
+"""MPI-IO stack (io/fcoll/fbtl/fs frameworks) + checkpoint helper."""
+
+from .file import (  # noqa: F401
+    File,
+    MODE_APPEND,
+    MODE_CREATE,
+    MODE_DELETE_ON_CLOSE,
+    MODE_EXCL,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_SEQUENTIAL,
+    MODE_UNIQUE_OPEN,
+    MODE_WRONLY,
+    SEEK_CUR,
+    SEEK_END,
+    SEEK_SET,
+)
+from . import checkpoint  # noqa: F401

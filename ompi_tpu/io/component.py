@@ -1,0 +1,190 @@
+"""IO-stack MCA components: io/ompio, fcoll/{two_phase,individual},
+fbtl/posix, fs/ufs.
+
+≈ the reference's five IO frameworks (SURVEY.md §2.2); the sharedfp
+framework's counter semantics live inside File (single address space —
+the ``sm`` shared-offset segment degenerates to a lock + int).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIFileError, MPIIOError
+from ompi_tpu.core.registry import Component, register_component
+from .fcoll import IndividualFcoll, TwoPhaseFcoll
+from .file import (
+    File,
+    MODE_APPEND,
+    MODE_CREATE,
+    MODE_EXCL,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+)
+
+
+@register_component
+class UfsFsComponent(Component):
+    """fs/ufs: POSIX filesystem driver (open/resize/delete)."""
+
+    FRAMEWORK = "fs"
+    NAME = "ufs"
+    PRIORITY = 50
+
+    def open(self, store) -> bool:
+        return True
+
+    def fs_open(self, path: str, amode: int) -> int:
+        flags = 0
+        if amode & MODE_RDONLY:
+            flags |= os.O_RDONLY
+        elif amode & MODE_WRONLY:
+            flags |= os.O_WRONLY
+        elif amode & MODE_RDWR:
+            flags |= os.O_RDWR
+        if amode & MODE_CREATE:
+            flags |= os.O_CREAT
+        if amode & MODE_EXCL:
+            flags |= os.O_EXCL
+        try:
+            return os.open(path, flags, 0o644)
+        except OSError as e:
+            raise MPIFileError(f"cannot open {path}: {e}") from e
+
+    def fs_close(self, fd: int) -> None:
+        try:
+            os.close(fd)
+        except OSError as e:
+            raise MPIIOError(f"close failed: {e}") from e
+
+    def fs_size(self, fd: int) -> int:
+        return os.fstat(fd).st_size
+
+    def fs_resize(self, fd: int, size: int) -> None:
+        os.ftruncate(fd, size)
+
+    def fs_sync(self, fd: int) -> None:
+        os.fsync(fd)
+
+    def fs_delete(self, path: str) -> None:
+        try:
+            os.unlink(path)
+        except FileNotFoundError as e:
+            raise MPIFileError(f"delete: {path} does not exist") from e
+
+
+@register_component
+class PosixFbtlComponent(Component):
+    """fbtl/posix: blocking positioned IO primitives (pread/pwrite)."""
+
+    FRAMEWORK = "fbtl"
+    NAME = "posix"
+    PRIORITY = 50
+
+    def open(self, store) -> bool:
+        return True
+
+    @staticmethod
+    def pwritev(fd: int, runs: Sequence[tuple[int, int, int]], data: np.ndarray) -> None:
+        """Write contiguous runs [(file_off, data_off, length)]."""
+        mv = memoryview(np.ascontiguousarray(data)).cast("B")
+        for file_off, data_off, length in runs:
+            written = os.pwrite(fd, mv[data_off:data_off + length], file_off)
+            if written != length:
+                raise MPIIOError(
+                    f"short write at {file_off}: {written}/{length} B"
+                )
+
+    @staticmethod
+    def preadv(fd: int, runs: Sequence[tuple[int, int, int]], nbytes: int) -> np.ndarray:
+        """Read contiguous runs into one data buffer; bytes beyond EOF
+        read as zero (MPI reads past EOF return reduced counts; the
+        engine layers count handling above)."""
+        out = np.zeros(nbytes, np.uint8)
+        for file_off, data_off, length in runs:
+            chunk = os.pread(fd, length, file_off)
+            out[data_off:data_off + len(chunk)] = np.frombuffer(chunk, np.uint8)
+        return out
+
+
+class _FsFacade:
+    """Adapter giving File a flat fs interface from the component."""
+
+    def __init__(self, comp: UfsFsComponent):
+        self._c = comp
+
+    def open(self, path, amode):
+        return self._c.fs_open(path, amode)
+
+    def close(self, fd):
+        self._c.fs_close(fd)
+
+    def size(self, fd):
+        return self._c.fs_size(fd)
+
+    def resize(self, fd, size):
+        self._c.fs_resize(fd, size)
+
+    def sync(self, fd):
+        self._c.fs_sync(fd)
+
+    def delete(self, path):
+        self._c.fs_delete(path)
+
+
+@register_component
+class OmpioIoComponent(Component):
+    """io/ompio: the MPI-IO engine, composing fs + fbtl + fcoll."""
+
+    FRAMEWORK = "io"
+    NAME = "ompio"
+    PRIORITY = 50
+
+    def __init__(self):
+        super().__init__()
+        self.store = None
+        self.fs = None
+        self.fbtl = None
+        self.fcoll = None
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self.store = store
+        store.register(
+            "io", "ompio", "fcoll", "two_phase", type="string",
+            help="Collective-buffering strategy: two_phase | individual",
+        )
+
+    def open(self, store) -> bool:
+        # real framework selection for the sub-stacks (so --mca fs/fbtl
+        # behave and external components can outbid the builtins)
+        from ompi_tpu.core import mca
+
+        ctx = mca.default_context()
+        self.fs = _FsFacade(ctx.framework("fs").select_one())
+        self.fbtl = ctx.framework("fbtl").select_one()
+        name = str(store.get("io_ompio_fcoll", "two_phase"))
+        self.fcoll = {"two_phase": TwoPhaseFcoll, "individual": IndividualFcoll}.get(
+            name, TwoPhaseFcoll
+        )()
+        return True
+
+    def file_open(self, comm, path: str, amode: int) -> File:
+        if self.fs is None:
+            self.open(self.store or _null_store())
+        return File(comm, path, amode, self)
+
+    def file_delete(self, path: str) -> None:
+        if self.fs is None:
+            self.open(self.store or _null_store())
+        self.fs.delete(path)
+
+
+def _null_store():
+    from ompi_tpu.core.var import VarStore
+
+    return VarStore()

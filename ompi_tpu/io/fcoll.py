@@ -1,0 +1,158 @@
+"""fcoll — collective-buffering strategies for MPI-IO.
+
+≈ ``ompi/mca/fcoll/`` (SURVEY.md §2.2: pluggable collective-buffering
+strategies ``dynamic/dynamic_gen2/individual/two_phase/vulcan`` [bin]).
+
+``two_phase`` is the classic ROMIO algorithm: phase 1 exchanges data so
+each aggregator owns a contiguous file region, phase 2 issues large IO
+ops.  In the single-controller model phase 1's network exchange is an
+in-memory merge — but the aggregation itself (coalescing every rank's
+scattered view runs into few large pread/pwrite calls) is exactly the
+optimization the reference buys, and it's what the strategy implements
+here.  ``individual`` skips aggregation: each rank's runs are issued
+directly (the fallback the reference uses when the exchange would cost
+more than it saves).
+
+All interfaces are **run-granular**: a rank's request arrives as
+``[(file_offset, data_offset, length)]`` runs plus the flat data/byte
+count — a contiguous GB-scale shard is a single run, never a per-byte
+index array.  Only genuinely overlapping writes fall back to byte-level
+resolution (MPI makes overlapping collective writes erroneous without
+atomic mode; the fallback keeps them deterministic: later rank wins).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+Runs = Sequence[tuple[int, int, int]]  # (file_offset, data_offset, length)
+
+
+def _intervals(per_rank: Sequence[tuple[Runs, np.ndarray]]):
+    """Flatten to (file_start, file_end, rank_index, data_slice) rows in
+    queue order."""
+    out = []
+    for ri, (runs, raw) in enumerate(per_rank):
+        for file_off, data_off, length in runs:
+            out.append((file_off, file_off + length, ri,
+                        raw[data_off:data_off + length]))
+    return out
+
+
+def _has_overlap(rows) -> bool:
+    srt = sorted(rows, key=lambda r: r[0])
+    end = -1
+    for s, e, _, _ in srt:
+        if s < end:
+            return True
+        end = max(end, e)
+    return False
+
+
+class IndividualFcoll:
+    """Each rank's runs issued as-is (≈ fcoll/individual)."""
+
+    NAME = "individual"
+
+    @staticmethod
+    def write_all(fbtl, fd, per_rank: Sequence[tuple[Runs, np.ndarray]]) -> None:
+        for runs, raw in per_rank:
+            fbtl.pwritev(fd, runs, raw)
+
+    @staticmethod
+    def read_all(fbtl, fd, requests: Sequence[tuple[Runs, int]]) -> list[np.ndarray]:
+        return [fbtl.preadv(fd, runs, nbytes) for runs, nbytes in requests]
+
+
+class TwoPhaseFcoll:
+    """Cross-rank run aggregation (≈ fcoll/two_phase).
+
+    Writes: merge every rank's runs into one offset-sorted stream and
+    coalesce adjacent runs into large pwrites.  Disjoint runs (the
+    normal collective pattern — each rank owns its region) never touch
+    per-byte indices; overlapping writes take the byte-resolution
+    fallback where the later-queued rank deterministically wins.
+    Reads: merge all requested intervals, read each merged extent once,
+    scatter slices back to every requesting rank (a byte read by many
+    ranks is fetched once).
+    """
+
+    NAME = "two_phase"
+
+    @staticmethod
+    def write_all(fbtl, fd, per_rank: Sequence[tuple[Runs, np.ndarray]]) -> None:
+        rows = _intervals(per_rank)
+        if not rows:
+            return
+        if _has_overlap(rows):
+            TwoPhaseFcoll._write_overlapping(fbtl, fd, rows)
+            return
+        rows.sort(key=lambda r: r[0])
+        # coalesce adjacent runs into single large writes
+        group: list = [rows[0]]
+        for row in rows[1:]:
+            if row[0] == group[-1][1]:
+                group.append(row)
+            else:
+                TwoPhaseFcoll._flush_group(fbtl, fd, group)
+                group = [row]
+        TwoPhaseFcoll._flush_group(fbtl, fd, group)
+
+    @staticmethod
+    def _flush_group(fbtl, fd, group) -> None:
+        data = group[0][3] if len(group) == 1 else np.concatenate(
+            [g[3] for g in group]
+        )
+        fbtl.pwritev(fd, [(group[0][0], 0, data.nbytes)], data)
+
+    @staticmethod
+    def _write_overlapping(fbtl, fd, rows) -> None:
+        """Byte-level resolution: later-queued rank wins (deterministic
+        serialization of what MPI leaves undefined w/o atomic mode)."""
+        idx_parts = [np.arange(s, e, dtype=np.int64) for s, e, _, _ in rows]
+        all_idx = np.concatenate(idx_parts)
+        all_data = np.concatenate([d for _, _, _, d in rows])
+        order = np.argsort(all_idx, kind="stable")
+        sorted_idx = all_idx[order]
+        sorted_data = all_data[order]
+        uniq, first_pos = np.unique(sorted_idx, return_index=True)
+        last_pos = np.concatenate((first_pos[1:], [sorted_idx.size])) - 1
+        from .file import runs_of
+
+        fbtl.pwritev(fd, runs_of(uniq), sorted_data[last_pos])
+
+    @staticmethod
+    def read_all(fbtl, fd, requests: Sequence[tuple[Runs, int]]) -> list[np.ndarray]:
+        # merge all requested extents (union, overlap-tolerant)
+        extents: list[list[int]] = []
+        for runs, _ in requests:
+            for file_off, _, length in runs:
+                extents.append([file_off, file_off + length])
+        if not extents:
+            return [np.empty(0, np.uint8) for _ in requests]
+        extents.sort()
+        merged: list[list[int]] = [extents[0][:]]
+        for s, e in extents[1:]:
+            if s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        # phase 1: one large read per merged extent
+        starts = np.array([m[0] for m in merged], dtype=np.int64)
+        buffers = [
+            fbtl.preadv(fd, [(s, 0, e - s)], e - s) for s, e in merged
+        ]
+        # phase 2: scatter slices back to each rank
+        out = []
+        for runs, nbytes in requests:
+            raw = np.empty(nbytes, np.uint8)
+            for file_off, data_off, length in runs:
+                mi = int(np.searchsorted(starts, file_off, side="right")) - 1
+                base = merged[mi][0]
+                raw[data_off:data_off + length] = (
+                    buffers[mi][file_off - base:file_off - base + length]
+                )
+            out.append(raw)
+        return out

@@ -1,0 +1,338 @@
+"""MPI-IO tests: views, individual/shared/collective access, two-phase
+aggregation, checkpoint/restore.
+
+Coverage modeled on the reference's IO validation (ompio + ROMIO test
+shape — SURVEY.md §2.2 io stack, §5 checkpoint): amode discipline,
+file views with derived datatypes (the convertor-on-files machinery),
+shared/ordered pointers, collective aggregation equivalence between
+fcoll strategies, and the arena checkpoint round trip.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu import ddt
+from ompi_tpu.core.errors import MPIAmodeError, MPIArgError, MPIFileError
+from ompi_tpu.io import (
+    MODE_CREATE,
+    MODE_DELETE_ON_CLOSE,
+    MODE_EXCL,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+    SEEK_CUR,
+    SEEK_END,
+    SEEK_SET,
+    checkpoint,
+)
+from ompi_tpu.io.fcoll import IndividualFcoll, TwoPhaseFcoll
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+@pytest.fixture
+def path(tmp_path):
+    return str(tmp_path / "data.bin")
+
+
+# -- open/amode --------------------------------------------------------
+
+
+def test_amode_validation(world, path):
+    with pytest.raises(MPIAmodeError):
+        world.file_open(path, MODE_CREATE)  # no access bit
+    with pytest.raises(MPIAmodeError):
+        world.file_open(path, MODE_RDONLY | MODE_WRONLY)
+    with pytest.raises(MPIAmodeError):
+        world.file_open(path, MODE_RDONLY | MODE_CREATE)
+    with pytest.raises(MPIFileError):
+        world.file_open(path, MODE_RDONLY)  # does not exist
+
+
+def test_create_write_read_roundtrip(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    data = np.arange(64, dtype=np.float64)
+    assert f.write_at(0, 0, data) == data.nbytes  # etype BYTE default
+    out = f.read_at(1, 0, data.nbytes, np.float64)
+    np.testing.assert_array_equal(out, data)
+    assert f.get_size() == data.nbytes
+    f.close()
+    # closed handle rejected
+    with pytest.raises(MPIFileError):
+        f.read_at(0, 0, 1)
+
+
+def test_wronly_rdonly_enforced(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_WRONLY)
+    f.write_at(0, 0, np.zeros(4, np.uint8))
+    with pytest.raises(MPIAmodeError):
+        f.read_at(0, 0, 4)
+    f.close()
+    f = world.file_open(path, MODE_RDONLY)
+    with pytest.raises(MPIAmodeError):
+        f.write_at(0, 0, np.zeros(4, np.uint8))
+    f.close()
+
+
+def test_excl_and_delete_on_close(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_WRONLY | MODE_DELETE_ON_CLOSE)
+    f.close()
+    with pytest.raises(MPIFileError):
+        world.file_open(path, MODE_RDONLY)  # deleted on close
+    f = world.file_open(path, MODE_CREATE | MODE_WRONLY)
+    f.close()
+    with pytest.raises(MPIFileError):
+        world.file_open(path, MODE_CREATE | MODE_EXCL | MODE_WRONLY)
+
+
+# -- individual pointers / seek ----------------------------------------
+
+
+def test_individual_pointers_are_per_rank(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    f.write(0, np.array([1, 2], np.uint8))   # rank 0 ptr → 2
+    f.write(1, np.array([9], np.uint8))      # rank 1 ptr → 1, overwrote byte 0... no:
+    # rank 1's own pointer started at 0, so it wrote at offset 0
+    assert f.get_position(0) == 2
+    assert f.get_position(1) == 1
+    out = f.read_at(2, 0, 2, np.uint8)
+    np.testing.assert_array_equal(out, [9, 2])
+    f.seek(0, -1, SEEK_CUR)
+    assert f.get_position(0) == 1
+    f.seek(0, 0, SEEK_END)
+    assert f.get_position(0) == f.get_size()
+    with pytest.raises(MPIArgError):
+        f.seek(0, -100, SEEK_CUR)
+    f.close()
+
+
+# -- file views with derived datatypes ---------------------------------
+
+
+def test_strided_view_write(world, path):
+    """Rank r's view = every Nth float64 (vector filetype): the classic
+    row-cyclic distribution; validates the index-map convertor."""
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    dbl = ddt.DOUBLE
+    # filetype: 1 double of data, extent N doubles (cyclic stride)
+    ft = dbl.create_resized(0, N * dbl.extent).commit()
+    for r in range(N):
+        f.set_view(r, disp=r * dbl.extent, etype=dbl, filetype=ft)
+    per = 5
+    for r in range(N):
+        f.write_at(r, 0, np.full(per, float(r)))
+    f.close()
+    # raw file: interleaved r0 r1 ... r7 r0 r1 ...
+    raw = np.fromfile(path, np.float64)
+    expect = np.tile(np.arange(N, dtype=np.float64), per)
+    np.testing.assert_array_equal(raw, expect)
+    # read back through the views
+    f = world.file_open(path, MODE_RDONLY)
+    for r in range(N):
+        f.set_view(r, disp=r * dbl.extent, etype=dbl, filetype=ft)
+        np.testing.assert_array_equal(
+            f.read_at(r, 0, per, np.float64), np.full(per, float(r))
+        )
+    f.close()
+
+
+def test_view_byte_offset_and_validation(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    dbl = ddt.DOUBLE
+    ft = dbl.create_resized(0, 4 * dbl.extent).commit()
+    f.set_view(2, disp=16, etype=dbl, filetype=ft)
+    assert f.get_byte_offset(2, 0) == 16
+    assert f.get_byte_offset(2, 1) == 16 + 32
+    d, e, t = f.get_view(2)
+    assert d == 16 and e is dbl and t is ft
+    with pytest.raises(MPIArgError):
+        # etype bigger than filetype data: size not a multiple
+        f.set_view(0, 0, etype=dbl, filetype=ddt.FLOAT)
+    with pytest.raises(MPIArgError):
+        f.write_at(2, 0, np.zeros(3, np.uint8))  # partial etype
+    f.close()
+
+
+def test_subarray_view_collective(world, path):
+    """2-D block-row decomposition via subarray filetypes — the
+    canonical HDF5-style collective pattern."""
+    rows, cols = N, 6
+    dbl = ddt.DOUBLE
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    for r in range(N):
+        ft = dbl.create_subarray([rows, cols], [1, cols], [r, 0]).commit()
+        f.set_view(r, 0, dbl, ft)
+    matrix = np.arange(rows * cols, dtype=np.float64).reshape(rows, cols)
+    f.write_at_all([0] * N, [matrix[r] for r in range(N)])
+    f.close()
+    raw = np.fromfile(path, np.float64).reshape(rows, cols)
+    np.testing.assert_array_equal(raw, matrix)
+
+
+# -- shared / ordered pointers -----------------------------------------
+
+
+def test_shared_pointer_fetch_add(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    n0 = f.write_shared(3, np.array([3, 3], np.uint8))
+    n1 = f.write_shared(5, np.array([5], np.uint8))
+    assert (n0, n1) == (2, 1)
+    assert f.get_position_shared() == 3
+    raw = f.read_at(0, 0, 3, np.uint8)
+    np.testing.assert_array_equal(raw, [3, 3, 5])
+    f.seek_shared(0, SEEK_SET)
+    out = f.read_shared(1, 3)
+    np.testing.assert_array_equal(out, [3, 3, 5])
+    f.close()
+
+
+def test_write_ordered_rank_order(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    blocks = [np.full(2, r, np.uint8) for r in range(N)]
+    f.write_ordered(blocks)
+    raw = np.fromfile(path, np.uint8)
+    np.testing.assert_array_equal(raw, np.repeat(np.arange(N, dtype=np.uint8), 2))
+    f.seek_shared(0)
+    outs = f.read_ordered([2] * N)
+    for r, o in enumerate(outs):
+        np.testing.assert_array_equal(o, [r, r])
+    f.close()
+
+
+# -- collective (fcoll strategies) -------------------------------------
+
+
+@pytest.mark.parametrize("strategy", [TwoPhaseFcoll, IndividualFcoll])
+def test_collective_write_strategies_equivalent(world, path, strategy):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    saved = f.component.fcoll
+    f.component.fcoll = strategy()
+    try:
+        # each rank writes a disjoint block at interleaved offsets
+        blocks = [np.full(4, r, np.uint8) for r in range(N)]
+        offsets = [(N - 1 - r) * 4 for r in range(N)]  # reversed placement
+        f.write_at_all(offsets, blocks)
+        raw = np.fromfile(path, np.uint8)
+        expect = np.repeat(np.arange(N - 1, -1, -1, dtype=np.uint8), 4)
+        np.testing.assert_array_equal(raw, expect)
+        outs = f.read_at_all(offsets, [4] * N)
+        for r, o in enumerate(outs):
+            np.testing.assert_array_equal(o, blocks[r])
+    finally:
+        f.component.fcoll = saved  # io component is process-global
+        f.close()
+
+
+def test_collective_with_none_participant(world, path):
+    """Zero-count participation (a rank with nothing to write) is legal
+    in MPI collectives."""
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    blocks = [np.full(2, r, np.uint8) if r % 2 == 0 else None for r in range(N)]
+    counts = f.write_at_all([r * 2 for r in range(N)], blocks)
+    assert counts == [2 if r % 2 == 0 else 0 for r in range(N)]
+    f.close()
+
+
+def test_read_all_overlapping_requests(world, path):
+    """Two-phase read: every rank reads the SAME region — each byte is
+    fetched once and scattered to all."""
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    data = np.arange(16, dtype=np.uint8)
+    f.write_at(0, 0, data)
+    outs = f.read_at_all([0] * N, [16] * N)
+    for o in outs:
+        np.testing.assert_array_equal(o, data)
+    f.close()
+
+
+def test_write_all_advances_pointers(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    f.write_all([np.full(3, r, np.uint8) for r in range(N)])
+    # all ranks started at ptr 0 → overlapping writes, last rank wins
+    assert all(f.get_position(r) == 3 for r in range(N))
+    raw = np.fromfile(path, np.uint8)
+    np.testing.assert_array_equal(raw, [N - 1] * 3)
+    f.close()
+
+
+# -- size management ---------------------------------------------------
+
+
+def test_set_size_preallocate(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    f.set_size(100)
+    assert f.get_size() == 100
+    f.preallocate(50)  # no shrink
+    assert f.get_size() == 100
+    f.preallocate(200)
+    assert f.get_size() == 200
+    f.set_size(10)
+    assert f.get_size() == 10
+    f.sync()
+    f.close()
+
+
+def test_read_past_eof_zero_filled(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    f.write_at(0, 0, np.array([7], np.uint8))
+    out = f.read_at(0, 0, 4, np.uint8)
+    np.testing.assert_array_equal(out, [7, 0, 0, 0])
+    f.close()
+
+
+def test_atomicity_flag(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    assert f.get_atomicity() is False
+    f.set_atomicity(True)
+    assert f.get_atomicity() is True
+    f.close()
+
+
+# -- nonblocking -------------------------------------------------------
+
+
+def test_nonblocking_complete_eagerly(world, path):
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    req = f.iwrite_at(0, 0, np.arange(8, dtype=np.uint8))
+    assert req.test()
+    assert req.wait() == 8
+    req2 = f.iread_at(0, 0, 8, np.uint8)
+    np.testing.assert_array_equal(req2.wait(), np.arange(8))
+    f.close()
+
+
+# -- checkpoint/restore ------------------------------------------------
+
+
+def test_checkpoint_roundtrip(world, tmp_path):
+    path = str(tmp_path / "ckpt.bin")
+    arr = np.random.RandomState(3).randn(N, 16).astype(np.float32)
+    checkpoint.save(world, path, arr, {"step": 7})
+    restored, manifest = checkpoint.restore(world, path)
+    assert manifest["step"] == 7
+    np.testing.assert_array_equal(np.asarray(restored), arr)
+    # device-resident: sharded over the mesh
+    assert restored.shape == (N, 16)
+
+
+def test_checkpoint_async(world, tmp_path):
+    path = str(tmp_path / "ckpt_async.bin")
+    arr = np.random.RandomState(4).randn(N, 8)
+    req = checkpoint.save_async(world, path, arr)
+    req.wait()
+    restored, _ = checkpoint.restore(world, path, stage=False)
+    np.testing.assert_array_equal(restored, arr)
+
+
+def test_checkpoint_rank_mismatch(world, tmp_path):
+    path = str(tmp_path / "ckpt_bad.bin")
+    with pytest.raises(MPIFileError):
+        checkpoint.save(world, path, np.zeros((N + 1, 4)))
+    with pytest.raises(MPIFileError):
+        checkpoint.restore(world, str(tmp_path / "absent.bin"))
