@@ -78,6 +78,16 @@ def comm_self() -> Comm:
 def finalize() -> None:
     """MPI_Finalize: free the world objects and close frameworks."""
     global _world, _self_comm, _initialized
+    # monitoring dump at finalize (≈ mca_pml_monitoring_dump via
+    # common/monitoring when an output path is configured)
+    try:
+        out = mca.default_context().store.get("monitoring_base_output", "")
+        if out:
+            from ompi_tpu.tool import monitoring as _mon
+
+            _mon.dump(str(out))
+    except Exception:
+        pass  # accounting must never break finalize
     if _world is not None:
         pc = getattr(_world, "procctx", None)
         if pc is not None:

@@ -105,7 +105,7 @@ class Comm:
         if self._pml is None:
             ctx = mca.default_context()
             comp = ctx.framework("pml").select_one()
-            self._pml = comp.make_engine(self.size)
+            self._pml = comp.make_engine(self.size, self.name)
         return self._pml
 
     # -- attribute caching (MPI_Comm_set_attr family) -------------------

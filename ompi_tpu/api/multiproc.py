@@ -158,11 +158,13 @@ class MultiProcComm:
             with self._pml_lock:
                 if self._pml is None:
                     comp = mca.default_context().framework("pml").select_one()
-                    self._pml = comp.make_engine(self.size)
+                    self._pml = comp.make_engine(self.size, self.name)
         return self._pml
 
     def _on_p2p_frame(self, env: dict, payload: np.ndarray) -> None:
-        self.pml.send(env["src"], env["dst"], payload, env["tag"])
+        # relayed delivery: already accounted on the sending process
+        self.pml.send(env["src"], env["dst"], payload, env["tag"],
+                      _account=False)
 
     def send(self, buf, source: int, dest: int, tag: int = 0) -> None:
         """Send from a LOCAL global rank ``source`` to any global rank."""
@@ -175,6 +177,15 @@ class MultiProcComm:
         if dproc == self.proc:
             self.pml.send(source, dest, buf, tag)
         else:
+            # sender-side accounting (the local pml never sees this send)
+            from ompi_tpu.tool import monitoring as _mon, spc as _spc
+
+            if _spc.attached():
+                _spc.inc("send")
+                _spc.inc("send_bytes", _spc.payload_nbytes(buf))
+            if isinstance(self.pml, _mon.MonitoredEngine):
+                _mon.account_p2p(self.name, self.size, source, dest,
+                                 _spc.payload_nbytes(buf))
             self.dcn.send_p2p(
                 dproc,
                 {"cid": self.cid, "src": source, "dst": dest, "tag": tag},

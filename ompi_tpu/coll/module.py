@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from ompi_tpu.core.errors import MPIInternalError
+from ompi_tpu.tool import spc
 
 #: every collective operation slot (blocking form). i-variants and
 #: persistent *_init variants are derived slots: "i"+name, name+"_init".
@@ -98,6 +99,7 @@ class CollTable:
             raise MPIInternalError(
                 f"no coll component provides {slot!r} on this communicator"
             )
+        spc.inc(slot)  # SPC: per-collective call counters (§5(d))
         return fn
 
 

@@ -21,6 +21,7 @@ _BUILTIN_COMPONENT_MODULES = (
     "ompi_tpu.p2p.component",
     "ompi_tpu.osc.component",
     "ompi_tpu.io.component",
+    "ompi_tpu.tool.monitoring",
 )
 
 

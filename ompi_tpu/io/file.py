@@ -52,6 +52,7 @@ from ompi_tpu.core.errors import (
 )
 from ompi_tpu.ddt.datatype import BYTE, Datatype
 from ompi_tpu.request import CompletedRequest, Request
+from ompi_tpu.tool import spc
 
 # amode bits (values match the reference's mpi.h)
 MODE_CREATE = 1
@@ -295,6 +296,7 @@ class File:
             )
         runs = v.map_runs(offset * v.etype.size, raw.nbytes)
         self.component.fbtl.pwritev(self._fd, runs, raw)
+        spc.inc("file_write_bytes", raw.nbytes)
         return raw.nbytes // v.etype.size
 
     def read_at(self, rank: int, offset: int, count: int,
@@ -306,6 +308,7 @@ class File:
         nbytes = self._etype_count_bytes(rank, count)
         runs = v.map_runs(offset * v.etype.size, nbytes)
         raw = self.component.fbtl.preadv(self._fd, runs, nbytes)
+        spc.inc("file_read_bytes", nbytes)
         return raw.view(np.dtype(dtype))
 
     def write(self, rank: int, data) -> int:

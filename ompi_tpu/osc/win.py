@@ -59,6 +59,7 @@ from ompi_tpu.core.errors import (
 )
 from ompi_tpu.op.op import NO_OP, REPLACE, SUM, Op
 from ompi_tpu.request import Request
+from ompi_tpu.tool import spc
 
 # lock types (values match the reference's mpi.h)
 LOCK_EXCLUSIVE = 1
@@ -375,6 +376,16 @@ class Win:
                 f"{len(self._pending)} queued RMA descriptors exceed "
                 "osc_arena_max_pending; synchronize (fence/flush) first"
             )
+        if spc.attached():  # SPC RMA counters (§5(d))
+            spc.inc(
+                {"put": "put", "get": "get", "acc": "accumulate",
+                 "get_acc": "accumulate", "fop": "accumulate",
+                 "cas": "accumulate"}[d.kind]
+            )
+            if d.kind == "put" and d.data is not None:
+                spc.inc("put_bytes", d.data.nbytes)
+            elif d.kind == "get":
+                spc.inc("get_bytes", d.count * self.dtype.itemsize)
         self._pending.append(d)
 
     def put(self, origin: int, target: int, data, target_disp: int = 0) -> None:

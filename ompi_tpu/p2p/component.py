@@ -24,5 +24,5 @@ class EagerPmlComponent(Component):
             help="Soft cap on unexpected-queue length before warnings",
         )
 
-    def make_engine(self, comm_size: int) -> MatchingEngine:
+    def make_engine(self, comm_size: int, comm_name: str = "?") -> MatchingEngine:
         return MatchingEngine(comm_size)

@@ -34,6 +34,7 @@ import numpy as np
 
 from ompi_tpu.core.errors import MPIArgError, MPIRankError
 from ompi_tpu.request import Request
+from ompi_tpu.tool import spc
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -143,13 +144,19 @@ class MatchingEngine:
 
     # -- send ----------------------------------------------------------
 
-    def send(self, source: int, dest: int, payload: Any, tag: int, dest_device=None) -> None:
+    def send(self, source: int, dest: int, payload: Any, tag: int,
+             dest_device=None, _account: bool = True) -> None:
+        """_account=False marks a relayed delivery (DCN frame already
+        accounted on the SENDING process) — SPC counts stay sender-side."""
         self._check_rank(source)
         self._check_rank(dest)
         if dest == PROC_NULL:
             return
         if tag < 0:
             raise MPIArgError(f"send tag must be >= 0, got {tag}")
+        if _account and spc.attached():
+            spc.inc("send")
+            spc.inc("send_bytes", spc.payload_nbytes(payload))
         data = _copy_payload(payload, dest_device)
         with self._lock:
             seq = self._next_seq()
@@ -168,6 +175,7 @@ class MatchingEngine:
     def irecv(self, dest: int, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> RecvRequest:
         self._check_rank(dest)
         self._check_rank(source, wild_ok=True)
+        spc.inc("irecv")
         req = RecvRequest()
         if source == PROC_NULL:
             req._deliver(None, Status.null())

@@ -1,0 +1,10 @@
+"""Tool/observability layer: SPC counters, MPI_T, monitoring.
+
+≈ SURVEY.md §5 "Tracing / profiling": PMPI interposition lives in the
+native shim (mpi.h weak symbols); this package holds the Python-side
+surface — :mod:`spc` (software performance counters), :mod:`mpit`
+(MPI_T cvar/pvar introspection), :mod:`monitoring` (per-peer traffic
+matrices at the pml/coll module layer).
+"""
+
+from . import monitoring, mpit, spc  # noqa: F401

@@ -1,0 +1,210 @@
+"""Monitoring — per-peer traffic accounting at the MCA module layer.
+
+≈ the reference's monitoring components ([bin] ``mca_pml_monitoring.so``,
+``mca_coll_monitoring.so``, ``mca_osc_monitoring.so``; SURVEY.md §5(c)):
+interpose at the module layer, count messages/bytes per peer per class,
+dump matrices at finalize (``mca_pml_monitoring_dump``).
+
+Two interposers:
+
+* :class:`MonitoredEngine` wraps a pml matching engine: every ``send``
+  adds (1 message, payload bytes) to the ``(source, dest)`` cell of the
+  pt2pt matrix;
+* :class:`MonitoringCollComponent` is a coll component at the TOP of
+  the stack (priority 99, ``wants_table``) whose module wraps every
+  already-stacked slot with a counting shim — the exact stacking trick
+  ``coll/monitoring`` uses (provide every op, delegate to the module
+  below, account on the way through).
+
+Both are enabled with ``--mca monitoring_enable 1``; matrices are
+fetched with :func:`flush` (and dumped to the path in
+``monitoring_output`` at finalize, the ``common/monitoring`` behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+import numpy as np
+
+from ompi_tpu.core.registry import Component, register_component
+
+_lock = threading.Lock()
+#: (class, comm_name) → size×size [messages, bytes] matrices
+_matrices: dict[tuple[str, str], dict[str, np.ndarray]] = {}
+#: coll op counts: (comm_name, op) → [calls, bytes]
+_coll_counts: dict[tuple[str, str], list[int]] = {}
+
+
+def _matrix(cls: str, comm_name: str, size: int) -> dict[str, np.ndarray]:
+    key = (cls, comm_name)
+    with _lock:
+        m = _matrices.get(key)
+        if m is None:
+            m = {
+                "messages": np.zeros((size, size), np.int64),
+                "bytes": np.zeros((size, size), np.int64),
+            }
+            _matrices[key] = m
+        return m
+
+
+def account_p2p(comm_name: str, size: int, source: int, dest: int, nbytes: int) -> None:
+    m = _matrix("pml", comm_name, size)
+    with _lock:
+        m["messages"][source, dest] += 1
+        m["bytes"][source, dest] += nbytes
+
+
+def account_coll(comm_name: str, op: str, nbytes: int) -> None:
+    key = (comm_name, op)
+    with _lock:
+        cell = _coll_counts.setdefault(key, [0, 0])
+        cell[0] += 1
+        cell[1] += nbytes
+
+
+def flush() -> dict[str, Any]:
+    """All accumulated accounting, JSON-shaped (≈ the dump matrices)."""
+    with _lock:
+        return {
+            "p2p": {
+                f"{cls}:{comm}": {k: v.tolist() for k, v in m.items()}
+                for (cls, comm), m in _matrices.items()
+            },
+            "coll": {
+                f"{comm}:{op}": {"calls": c, "bytes": b}
+                for (comm, op), (c, b) in _coll_counts.items()
+            },
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _matrices.clear()
+        _coll_counts.clear()
+
+
+def dump(path: str) -> None:
+    """Write the matrices (finalize-time behavior of common/monitoring)."""
+    with open(path, "w") as f:
+        json.dump(flush(), f, indent=1)
+
+
+def _register_vars(store) -> None:
+    """Shared var registration: either interposer (pml or coll) may open
+    first, so both register the common monitoring vars (idempotent)."""
+    store.register(
+        "monitoring", "base", "enable", False,
+        help="Account per-peer pt2pt/coll traffic (≈ --mca pml monitoring)",
+    )
+    store.register(
+        "monitoring", "base", "output", "", type="string",
+        help="Path to dump accounting matrices at finalize",
+    )
+
+
+class MonitoredEngine:
+    """pml/monitoring: proxy around a matching engine, accounting sends."""
+
+    def __init__(self, inner, comm_name: str, comm_size: int):
+        self._inner = inner
+        self._comm_name = comm_name
+        self._comm_size = comm_size
+
+    def send(self, source: int, dest: int, payload, tag: int,
+             dest_device=None, _account: bool = True) -> None:
+        from .spc import payload_nbytes
+
+        # deliver first: the engine validates ranks/tag; only a message
+        # that was actually sent is accounted
+        self._inner.send(source, dest, payload, tag, dest_device,
+                         _account=_account)
+        if _account and 0 <= dest < self._comm_size:
+            account_p2p(self._comm_name, self._comm_size, source, dest,
+                        payload_nbytes(payload))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@register_component
+class MonitoringPmlComponent(Component):
+    """pml/monitoring: outbids pml/eager when enabled, returning a
+    counting proxy over the engine it builds underneath (the reference's
+    monitoring pml is exactly this shim over the real pml)."""
+
+    FRAMEWORK = "pml"
+    NAME = "monitoring"
+    PRIORITY = 80  # above eager (50); open() gates on the enable var
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self._store = store
+        _register_vars(store)
+
+    def open(self, store) -> bool:
+        self._store = store
+        return bool(store.get("monitoring_base_enable", False))
+
+    def make_engine(self, comm_size: int, comm_name: str = "?"):
+        from ompi_tpu.p2p.pml import MatchingEngine
+
+        return MonitoredEngine(MatchingEngine(comm_size), comm_name, comm_size)
+
+
+class MonitoringCollModule:
+    """coll/monitoring's module: wraps every stacked slot."""
+
+    def __init__(self, comm, table):
+        self.comm = comm
+        self._table = table
+
+    def enable(self) -> None:
+        pass
+
+    def disable(self) -> None:
+        pass
+
+    def provided(self) -> dict[str, Any]:
+        out = {}
+        for slot, fn in self._table.slots.items():
+            out[slot] = self._wrap(slot, fn)
+        return out
+
+    def _wrap(self, slot: str, fn):
+        comm_name = self.comm.name
+
+        def shim(*args, **kwargs):
+            from .spc import payload_nbytes
+
+            account_coll(comm_name, slot, payload_nbytes(args[0]) if args else 0)
+            return fn(*args, **kwargs)
+
+        shim.__name__ = f"monitored_{slot}"
+        return shim
+
+
+@register_component
+class MonitoringCollComponent(Component):
+    FRAMEWORK = "coll"
+    NAME = "monitoring"
+    PRIORITY = 99  # top of the stack: wraps tuned/xla/basic slots
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self._store = store
+        _register_vars(store)  # either framework may open first
+
+    def open(self, store) -> bool:
+        self._store = store
+        return bool(store.get("monitoring_base_enable", False))
+
+    def query(self, comm, table=None):
+        if table is None or not table.slots:
+            return None
+        return MonitoringCollModule(comm, table)
+
+    query.wants_table = True

@@ -1,0 +1,202 @@
+"""MPI_T — the MPI tool information interface.
+
+≈ ``ompi/mpi/tool/`` (31 ``MPI_T_*`` syms [bin]; SURVEY.md §5(b)):
+every MCA var surfaces as a **control variable** (cvar), every SPC /
+monitoring counter as a **performance variable** (pvar).  The surface
+is the MPI_T session model reduced to its semantic core:
+
+* ``init_thread() / finalize()`` — refcounted tool sessions;
+* cvars: ``cvar_get_num / cvar_get_info / cvar_read / cvar_write`` —
+  directly over the default context's VarStore (the same uniform var
+  system §5-config demands);
+* pvars: ``pvar_get_num / pvar_get_info / pvar_read / pvar_reset`` —
+  over the SPC counter set (plus monitoring totals);
+* categories: ``category_get_num / category_get_info`` — one category
+  per framework, as ``ompi_info``'s grouping does.
+
+Handles are plain indices into stable snapshots, matching the MPI_T
+index-based C API closely enough that the native shim can bind 1:1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ompi_tpu.core.errors import MPIArgError, MPIError
+from . import spc
+
+# MPI_T verbosity / scope / class constants (values: reference mpi.h)
+VERBOSITY_USER_BASIC = 221
+SCOPE_ALL_EQ = 60
+PVAR_CLASS_COUNTER = 243
+
+_sessions = 0
+
+
+class MPITNotInitialized(MPIError):
+    pass
+
+
+def init_thread() -> int:
+    """MPI_T_init_thread: returns the session nesting level."""
+    global _sessions
+    _sessions += 1
+    return _sessions
+
+
+def finalize() -> int:
+    global _sessions
+    if _sessions == 0:
+        raise MPITNotInitialized("MPI_T_finalize without init")
+    _sessions -= 1
+    return _sessions
+
+
+def _check():
+    if _sessions == 0:
+        raise MPITNotInitialized("call MPI_T init_thread first")
+
+
+def _store():
+    from ompi_tpu.core import mca
+
+    return mca.default_context().store
+
+
+# -- control variables (cvars) -----------------------------------------
+
+
+@dataclass
+class CvarInfo:
+    name: str
+    type: str
+    default: Any
+    help: str
+    scope: int = SCOPE_ALL_EQ
+    verbosity: int = VERBOSITY_USER_BASIC
+
+
+def _cvar_names() -> list[str]:
+    return [v.full_name for v in _store().all_vars()]
+
+
+def cvar_get_num() -> int:
+    _check()
+    return len(_cvar_names())
+
+
+def cvar_get_info(index: int) -> CvarInfo:
+    _check()
+    names = _cvar_names()
+    if not 0 <= index < len(names):
+        raise MPIArgError(f"cvar index {index} out of range")
+    v = _store().get_var(names[index])
+    return CvarInfo(v.full_name, v.type, v.default, v.help)
+
+
+def cvar_index(name: str) -> int:
+    """MPI_T_cvar_get_index: name → index."""
+    _check()
+    try:
+        return _cvar_names().index(name)
+    except ValueError:
+        raise MPIArgError(f"no cvar named {name}") from None
+
+
+def _at(names: list[str], index: int, kind: str) -> str:
+    if not 0 <= index < len(names):
+        raise MPIArgError(f"{kind} index {index} out of range")
+    return names[index]
+
+
+def cvar_read(index: int) -> Any:
+    _check()
+    return _store().get(_at(_cvar_names(), index, "cvar"))
+
+
+def cvar_write(index: int, value: Any) -> None:
+    _check()
+    _store().set(_at(_cvar_names(), index, "cvar"), value)
+
+
+# -- performance variables (pvars) -------------------------------------
+
+
+@dataclass
+class PvarInfo:
+    name: str
+    var_class: int
+    help: str
+
+
+def _pvar_names() -> list[str]:
+    return ["spc_" + k for k in spc.known()]
+
+
+def pvar_get_num() -> int:
+    _check()
+    return len(_pvar_names())
+
+
+def pvar_get_info(index: int) -> PvarInfo:
+    _check()
+    names = _pvar_names()
+    if not 0 <= index < len(names):
+        raise MPIArgError(f"pvar index {index} out of range")
+    return PvarInfo(names[index], PVAR_CLASS_COUNTER,
+                    f"SPC counter {names[index][4:]}")
+
+
+def pvar_index(name: str) -> int:
+    _check()
+    try:
+        return _pvar_names().index(name)
+    except ValueError:
+        raise MPIArgError(f"no pvar named {name}") from None
+
+
+def pvar_read(index: int) -> int:
+    _check()
+    return spc.get(_at(_pvar_names(), index, "pvar")[4:])
+
+
+def pvar_reset() -> None:
+    _check()
+    spc.reset()
+
+
+def pvar_start() -> None:
+    """MPI_T_pvar_start: attach the SPC counters."""
+    _check()
+    spc.attach(True)
+
+
+def pvar_stop() -> None:
+    _check()
+    spc.attach(False)
+
+
+# -- categories --------------------------------------------------------
+
+
+def category_get_num() -> int:
+    _check()
+    return len(_categories())
+
+
+def category_get_info(index: int) -> tuple[str, int]:
+    """(framework name, number of cvars in it)."""
+    _check()
+    cats = _categories()
+    if not 0 <= index < len(cats):
+        raise MPIArgError(f"category index {index} out of range")
+    return cats[index]
+
+
+def _categories() -> list[tuple[str, int]]:
+    counts: dict[str, int] = {}
+    for name in _cvar_names():
+        fw = name.split("_", 1)[0]
+        counts[fw] = counts.get(fw, 0) + 1
+    return sorted(counts.items())

@@ -1,0 +1,90 @@
+"""SPC — software performance counters.
+
+≈ ``ompi/runtime/ompi_spc.c`` (SURVEY.md §5(d): "cheap in-path counters
+exposed via MPI_T pvars", present since 4.0).  The reference counts at
+the MPI API layer (MPI_Allreduce increments ``OMPI_SPC_ALLREDUCE``);
+here the api/comm entry points call :func:`inc` the same way.  Counters
+cost one dict update when attached and one boolean check when not (the
+reference's compile-time gate becomes a runtime flag — ``--mca
+runtime_spc_attach all`` ≈ the ``mpi_spc_attach_all`` var).
+
+Every counter surfaces as an MPI_T pvar through
+:mod:`ompi_tpu.tool.mpit`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_counters: dict[str, int] = {}
+_attached = False
+
+#: non-collective counter names (the reference's OMPI_SPC_* set trimmed
+#: to events this framework actually increments; collective counters are
+#: one per coll-table slot, appended by :func:`known`)
+_BASE_KNOWN = (
+    "send", "send_bytes", "irecv",
+    "put", "put_bytes", "get", "get_bytes", "accumulate",
+    "file_write_bytes", "file_read_bytes",
+)
+
+_known_cache: tuple[str, ...] | None = None
+
+
+def known() -> tuple[str, ...]:
+    """Every counter name this build can increment — the MPI_T pvar
+    namespace.  Collective names are the coll-table slots (allreduce,
+    iallreduce, allreduce_init, …), incremented by CollTable.lookup."""
+    global _known_cache
+    if _known_cache is None:
+        from ompi_tpu.coll.module import all_slots  # lazy: import cycle
+
+        _known_cache = tuple(all_slots()) + _BASE_KNOWN
+    return _known_cache
+
+
+def payload_nbytes(p) -> int:
+    """Byte size of a send/collective payload (shared accounting helper)."""
+    nb = getattr(p, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    try:
+        import numpy as _np
+
+        return int(_np.asarray(p).nbytes)
+    except Exception:
+        return 0
+
+
+def attach(flag: bool = True) -> None:
+    """Enable/disable counting (≈ mpi_spc_attach_all)."""
+    global _attached
+    _attached = flag
+
+
+def attached() -> bool:
+    return _attached
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Hot-path increment: one flag check when detached."""
+    if not _attached:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()

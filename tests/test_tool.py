@@ -1,0 +1,223 @@
+"""Tool-layer tests: SPC counters, MPI_T cvar/pvar, monitoring
+interposers (≈ SURVEY.md §5 tracing/profiling: ompi_spc, MPI_T,
+mca_{pml,coll}_monitoring)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.core import mca
+from ompi_tpu.core.errors import MPIArgError
+from ompi_tpu.op import SUM
+from ompi_tpu.tool import monitoring, mpit, spc
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+@pytest.fixture(autouse=True)
+def clean_counters():
+    spc.reset()
+    spc.attach(False)
+    monitoring.reset()
+    yield
+    spc.reset()
+    spc.attach(False)
+    monitoring.reset()
+
+
+# -- SPC ---------------------------------------------------------------
+
+
+def test_spc_detached_is_noop(world):
+    spc.inc("allreduce")
+    assert spc.get("allreduce") == 0
+
+
+def test_spc_counts_collectives(world):
+    spc.attach(True)
+    x = np.ones((N, 4), np.float32)
+    world.allreduce(x, SUM)
+    world.allreduce(x, SUM)
+    world.bcast(x)
+    assert spc.get("allreduce") == 2
+    assert spc.get("bcast") == 1
+    snap = spc.snapshot()
+    assert snap["allreduce"] == 2
+    spc.reset()
+    assert spc.get("allreduce") == 0
+
+
+def test_spc_counts_p2p_bytes(world):
+    spc.attach(True)
+    payload = np.arange(10, dtype=np.float64)
+    world.send(payload, source=0, dest=1, tag=5)
+    out, status = world.recv(dest=1, source=0, tag=5)
+    np.testing.assert_array_equal(out, payload)
+    assert spc.get("send") == 1
+    assert spc.get("send_bytes") == payload.nbytes
+    assert spc.get("irecv") == 1
+
+
+def test_spc_counts_rma_and_io(world, tmp_path):
+    spc.attach(True)
+    win = world.win_allocate(4, np.float32)
+    win.fence()
+    win.put(0, 1, np.ones(4, np.float32))
+    win.get(0, 1, 4)
+    win.accumulate(0, 1, np.ones(4, np.float32), op=SUM)
+    win.fence()
+    win.free()
+    assert spc.get("put") == 1
+    assert spc.get("put_bytes") == 16
+    assert spc.get("get") == 1
+    assert spc.get("accumulate") == 1
+    from ompi_tpu.io import MODE_CREATE, MODE_RDWR
+
+    f = world.file_open(str(tmp_path / "x.bin"), MODE_CREATE | MODE_RDWR)
+    f.write_at(0, 0, np.zeros(8, np.uint8))
+    f.read_at(0, 0, 8)
+    f.close()
+    assert spc.get("file_write_bytes") == 8
+    assert spc.get("file_read_bytes") == 8
+
+
+# -- MPI_T -------------------------------------------------------------
+
+
+def test_mpit_requires_init():
+    with pytest.raises(mpit.MPITNotInitialized):
+        mpit.cvar_get_num()
+    mpit.init_thread()
+    try:
+        assert mpit.cvar_get_num() > 0
+    finally:
+        mpit.finalize()
+    with pytest.raises(mpit.MPITNotInitialized):
+        mpit.finalize()
+
+
+def test_mpit_cvar_roundtrip(world):
+    mpit.init_thread()
+    try:
+        i = mpit.cvar_index("coll_xla_segcount")
+        info = mpit.cvar_get_info(i)
+        assert info.name == "coll_xla_segcount"
+        assert info.type == "int"
+        old = mpit.cvar_read(i)
+        mpit.cvar_write(i, 123)
+        assert mpit.cvar_read(i) == 123
+        mpit.cvar_write(i, old)
+        with pytest.raises(MPIArgError):
+            mpit.cvar_index("no_such_var_xyz")
+        with pytest.raises(MPIArgError):
+            mpit.cvar_get_info(10 ** 9)
+    finally:
+        mpit.finalize()
+
+
+def test_mpit_pvar_reads_spc(world):
+    mpit.init_thread()
+    try:
+        mpit.pvar_start()  # attaches SPC
+        x = np.ones((N, 2), np.float32)
+        world.allreduce(x, SUM)
+        i = mpit.pvar_index("spc_allreduce")
+        assert mpit.pvar_read(i) == 1
+        info = mpit.pvar_get_info(i)
+        assert info.var_class == mpit.PVAR_CLASS_COUNTER
+        mpit.pvar_reset()
+        assert mpit.pvar_read(i) == 0
+        mpit.pvar_stop()
+    finally:
+        mpit.finalize()
+
+
+def test_mpit_categories(world):
+    mpit.init_thread()
+    try:
+        ncat = mpit.category_get_num()
+        assert ncat > 0
+        names = [mpit.category_get_info(i)[0] for i in range(ncat)]
+        assert "coll" in names
+        total = sum(mpit.category_get_info(i)[1] for i in range(ncat))
+        assert total == mpit.cvar_get_num()
+    finally:
+        mpit.finalize()
+
+
+# -- monitoring --------------------------------------------------------
+
+
+def test_monitoring_p2p_matrix(world):
+    """Direct accounting API (the engine proxy calls exactly this)."""
+    eng = monitoring.MonitoredEngine(world.pml, world.name, world.size)
+    payload = np.arange(6, dtype=np.float32)
+    eng.send(2, 5, payload, tag=1)
+    st = eng.irecv(5, source=2, tag=1).wait()
+    data = monitoring.flush()
+    m = data["p2p"][f"pml:{world.name}"]
+    assert m["messages"][2][5] == 1
+    assert m["bytes"][2][5] == payload.nbytes
+    assert m["messages"][0][0] == 0
+
+
+def test_monitoring_coll_component_stacks(world):
+    """With monitoring_base_enable, the coll stack gets the counting
+    module on top and accounts every collective."""
+    ctx = mca.default_context()
+    store = ctx.store
+    store.set("monitoring_base_enable", True)
+    ctx.framework("coll").close()  # re-open re-evaluates the gate
+    try:
+        comm = world.dup("monitored")
+        table = comm.coll
+        assert table.providers["allreduce"] == "monitoring"
+        x = np.ones((N, 4), np.float64)
+        comm.allreduce(x, SUM)
+        comm.barrier()
+        data = monitoring.flush()
+        key = f"{comm.name}:allreduce"
+        assert data["coll"][key]["calls"] == 1
+        assert data["coll"][key]["bytes"] == x.nbytes
+        assert f"{comm.name}:barrier" in data["coll"]
+        comm.free()
+    finally:
+        store.set("monitoring_base_enable", False)
+        ctx.framework("coll").close()
+
+
+def test_monitoring_pml_component_selected(world):
+    """pml/monitoring outbids eager when enabled; eager wins otherwise."""
+    ctx = mca.default_context()
+    store = ctx.store
+    fw = ctx.framework("pml")
+    assert fw.select_one().NAME == "eager"
+    store.set("monitoring_base_enable", True)
+    fw.close()
+    try:
+        comp = fw.select_one()
+        assert comp.NAME == "monitoring"
+        eng = comp.make_engine(N, "probe-comm")
+        eng.send(1, 2, np.arange(3, dtype=np.float32), tag=0)
+        m = monitoring.flush()["p2p"]["pml:probe-comm"]
+        assert m["messages"][1][2] == 1 and m["bytes"][1][2] == 12
+    finally:
+        store.set("monitoring_base_enable", False)
+        fw.close()
+        assert fw.select_one().NAME == "eager"
+
+
+def test_monitoring_dump(world, tmp_path):
+    monitoring.account_coll("c", "bcast", 100)
+    path = str(tmp_path / "mon.json")
+    monitoring.dump(path)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["coll"]["c:bcast"] == {"calls": 1, "bytes": 100}
