@@ -44,6 +44,10 @@ MPI_ERR_RMA_SYNC = 47
 MPI_ERR_RMA_RANGE = 55
 MPI_ERR_RMA_ATTACH = 56
 MPI_ERR_RMA_FLAVOR = 58
+# ULFM fault-tolerance error classes (MPIX_*, the --with-ft=ulfm ext)
+MPIX_ERR_PROC_FAILED = 75
+MPIX_ERR_PROC_FAILED_PENDING = 76
+MPIX_ERR_REVOKED = 77
 # MPI-IO error classes
 MPI_ERR_FILE = 30
 MPI_ERR_ACCESS = 20
@@ -152,6 +156,22 @@ class MPIRMARangeError(MPIError):
 
 class MPIRMAAttachError(MPIError):
     error_class = MPI_ERR_RMA_ATTACH
+
+
+class MPIProcFailedError(MPIError):
+    """MPIX_ERR_PROC_FAILED: operation touched a failed process."""
+
+    error_class = MPIX_ERR_PROC_FAILED
+
+    def __init__(self, msg: str, failed: tuple[int, ...] = ()):  # noqa: D401
+        super().__init__(msg)
+        self.failed = tuple(failed)
+
+
+class MPIRevokedError(MPIError):
+    """MPIX_ERR_REVOKED: communicator was revoked."""
+
+    error_class = MPIX_ERR_REVOKED
 
 
 class MPIFileError(MPIError):

@@ -1,0 +1,165 @@
+"""ULFM — user-level failure mitigation (revoke / shrink / agree).
+
+TPU-native re-design of the reference's fault-tolerance story
+(SURVEY.md §5 "Failure detection / elastic recovery": v5's ULFM ext
+``ompi/mpiext/ftmpi`` built with ``--with-ft=ulfm`` — ``MPIX_Comm_
+revoke/shrink/agree/is_revoked``, ``coll/ftagree`` early-returning
+agreement, failure detection via daemon heartbeats + in-band errors).
+
+Semantics preserved:
+
+* a failure is **detected**, not fatal: operations that would involve a
+  failed rank raise :class:`MPIProcFailedError` (MPIX_ERR_PROC_FAILED);
+  operations among live ranks continue — MPI_ERRORS_RETURN survival;
+* ``revoke()`` poisons the communicator for every rank ("an
+  out-of-band broadcast beats the failure news to everyone"): all
+  subsequent operations raise :class:`MPIRevokedError` EXCEPT the
+  recovery trio shrink / agree / failure introspection;
+* ``shrink()`` builds a fresh communicator over the live ranks — on
+  TPU this is the mesh-shrink path: the new comm's CommMesh spans the
+  surviving devices, the group renumbers contiguously;
+* ``agree(flags)`` is the ftagree fault-tolerant agreement: bitwise
+  AND over live ranks' contributions, deciding consistently even with
+  failed participants (the reference's early-returning consensus);
+* ``get_failed()/ack_failed()`` ≈ MPIX_Comm_get_failed /
+  MPIX_Comm_ack_failed: introspect and acknowledge, so ANY_SOURCE
+  receives can be re-enabled after acknowledgement.
+
+Failure *injection* has no reference equivalent in-tree (ULFM tests
+kill ranks externally); :func:`inject_failure` is the single-controller
+analog of the external kill, and the DCN heartbeat detector
+(detector.py) is the daemon-heartbeat analog for multi-process jobs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ompi_tpu.core.errors import MPIProcFailedError, MPIRankError, MPIRevokedError
+
+
+@dataclass
+class FTState:
+    """Per-communicator fault-tolerance state (lazy, zero-cost until a
+    failure appears)."""
+
+    failed: set[int] = field(default_factory=set)
+    acked: set[int] = field(default_factory=set)
+    revoked: bool = False
+
+
+def state(comm) -> FTState:
+    st = getattr(comm, "_ft", None)
+    if st is None:
+        st = FTState()
+        comm._ft = st
+    return st
+
+
+def peek(comm) -> FTState | None:
+    """State if any FT event ever touched this comm, else None — the
+    fast path for per-call guards."""
+    return getattr(comm, "_ft", None)
+
+
+def inject_failure(comm, rank: int) -> None:
+    """Mark ``rank`` failed on this communicator (the external-kill
+    analog; the heartbeat detector calls exactly this on timeout)."""
+    if not 0 <= rank < comm.size:
+        raise MPIRankError(f"rank {rank} outside [0, {comm.size})")
+    state(comm).failed.add(rank)
+
+
+def check(comm, peer: int | None = None, collective: bool = False) -> None:
+    """The per-operation guard (≈ the in-band error checks ob1/coll do
+    under ULFM builds).
+
+    * revoked comm → MPIRevokedError, always;
+    * collective ops → fail if ANY unacknowledged failure exists
+      (collectives involve every rank);
+    * pt2pt → fail only if the named peer failed.
+    """
+    st = peek(comm)
+    if st is None:
+        return
+    if st.revoked:
+        raise MPIRevokedError(f"{comm.name} has been revoked")
+    if collective:
+        bad = st.failed - st.acked
+        if bad:
+            raise MPIProcFailedError(
+                f"collective on {comm.name} with failed ranks "
+                f"{sorted(bad)} (revoke+shrink to recover)",
+                failed=tuple(sorted(bad)),
+            )
+    elif peer is not None and peer in st.failed:
+        raise MPIProcFailedError(
+            f"rank {peer} on {comm.name} has failed", failed=(peer,)
+        )
+
+
+def revoke(comm) -> None:
+    """MPIX_Comm_revoke."""
+    state(comm).revoked = True
+
+
+def is_revoked(comm) -> bool:
+    st = peek(comm)
+    return st is not None and st.revoked
+
+
+def get_failed(comm) -> list[int]:
+    """MPIX_Comm_get_failed: global ranks known failed (sorted)."""
+    st = peek(comm)
+    return sorted(st.failed) if st else []
+
+
+def ack_failed(comm) -> int:
+    """MPIX_Comm_ack_failed: acknowledge every known failure; returns
+    the acknowledged count.  Acknowledged failures no longer poison
+    collectives-with-failures checks for pt2pt/ANY_SOURCE — but a
+    collective still cannot complete with a failed member, so
+    collectives keep raising until shrink (matching ULFM: ack re-arms
+    ANY_SOURCE, it does not resurrect collectives)."""
+    st = state(comm)
+    st.acked = set(st.failed)
+    return len(st.acked)
+
+
+def shrink(comm, name: str = ""):
+    """MPIX_Comm_shrink: new communicator over the live ranks.
+
+    Works on revoked comms (that's its purpose).  The surviving ranks
+    renumber contiguously; the new comm's mesh spans their devices
+    (the TPU mesh-shrink of SURVEY.md §5: "slice-failure → shrink mesh
+    → re-form")."""
+    st = peek(comm)
+    dead = st.failed if st else set()
+    live = [r for r in range(comm.size) if r not in dead]
+    if not live:
+        raise MPIProcFailedError("cannot shrink: every rank has failed",
+                                 failed=tuple(sorted(dead)))
+    sub = comm._shrink_to(live, name or f"{comm.name}.shrunk")
+    return sub
+
+
+def agree(comm, flags: int, contributions: dict[int, int] | None = None) -> int:
+    """MPIX_Comm_agree: fault-tolerant agreement — bitwise AND of the
+    live ranks' flag words.  ``flags`` is the calling rank's word; in
+    the single-controller model all live ranks' contributions are
+    supplied at once (default: every live rank contributes ``flags``).
+    Completes despite failed ranks (their contribution is dropped, and
+    the result notes nothing of them — callers learn about failures
+    from get_failed), exactly the ftagree contract.  Works on revoked
+    communicators (agreement is how ranks coordinate after revoke)."""
+    st = peek(comm)
+    dead = st.failed if st else set()
+    live = [r for r in range(comm.size) if r not in dead]
+    if not live:
+        raise MPIProcFailedError("agree with no live ranks",
+                                 failed=tuple(sorted(dead)))
+    out = ~0
+    for r in live:
+        word = contributions.get(r, flags) if contributions else flags
+        out &= int(word)
+    return out
