@@ -31,7 +31,7 @@ import time
 import numpy as np
 
 
-def _best_time(fn, warmup: int = 2, iters: int = 10) -> float:
+def _best_time(fn, warmup: int = 4, iters: int = 60) -> float:
     """Minimum wall time of fn() over iters runs (OSU reports averages;
     min is more robust to tunnel jitter on this rig)."""
     import jax
@@ -46,7 +46,7 @@ def _best_time(fn, warmup: int = 2, iters: int = 10) -> float:
     return best
 
 
-def run(max_bytes: int = 4 << 20, iters: int = 10) -> dict:
+def run(max_bytes: int = 4 << 20, iters: int = 60) -> dict:
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -104,7 +104,7 @@ def run(max_bytes: int = 4 << 20, iters: int = 10) -> dict:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--max-bytes", type=int, default=4 << 20)
-    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--iters", type=int, default=60)
     p.add_argument("--detail", action="store_true", help="include per-size rows")
     args = p.parse_args()
     out = run(args.max_bytes, args.iters)

@@ -123,8 +123,9 @@ class MultiProcComm:
         return self.coll.lookup("allgather")(x)
 
     def gather(self, x, root: int = 0):
-        out = self.coll.lookup("gather")(x, root)
-        return out[0] if out.ndim and out.shape[0] == self.local_size else out
+        """Root's recvbuf (global_n, *s) — the gather slot's contract
+        (no shape heuristics: han returns the fan-in result directly)."""
+        return self.coll.lookup("gather")(x, root)
 
     def scatter(self, x, root: int = 0):
         return self.coll.lookup("scatter")(x, root)

@@ -392,6 +392,21 @@ def reduce_scatter_ring(x, op: Op, n: int):
     return cur
 
 
+def reduce_scatter_ordered(x, op: Op, n: int):
+    """Rank-ordered reduce_scatter for non-commutative (or bit-exact)
+    reduction: transpose contributions with one ``all_to_all`` so device
+    j holds x[r, j] for every r in source-rank order, then fold locally
+    in ascending rank order — the MPI non-commutative contract the ring
+    variant (chain order starting at (b+1)%n) cannot honor."""
+    if n == 1:
+        return x[0]
+    # (n, *blk) rows → row r lands on device r's partner slot: device j
+    # receives x[r, j] stacked along axis 0 in source-rank order
+    y = lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True)
+    y = y.reshape((n,) + x.shape[1:])
+    return ordered_reduce_jax(y, op)
+
+
 def alltoall_direct(x, n: int):
     """x: (n, *blk) per device; row j goes to device j → returns (n, *blk)
     where row j is what device j sent us. One fused XLA all_to_all."""

@@ -100,7 +100,9 @@ class BasicCollModule(CollModule):
         return np.broadcast_to(x[None], (x.shape[0],) + x.shape).copy()
 
     def gather(self, x, root: int = 0):
-        return self.allgather(x)
+        """Root's recvbuf: the rank-major (n, *s) buffer IS the
+        gathered concatenation of every rank's sendbuf."""
+        return _host(x).copy()
 
     def scatter(self, x, root: int = 0):
         return _host(x).copy()

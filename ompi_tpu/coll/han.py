@@ -88,7 +88,12 @@ class HanCollModule(CollModule):
         return out.copy()
 
     def gather(self, x, root: int = 0):
-        return self.allgather(x)
+        """Root's recvbuf (global_n, *s): fan-in over DCN (each process
+        contributes its slice once — no n× allgather blowup)."""
+        comm = self.comm
+        x = np.asarray(x)
+        slices = comm.dcn.allgather(x, comm.cid)
+        return np.concatenate(slices, axis=0)
 
     def scatter(self, x, root: int = 0):
         comm = self.comm

@@ -91,6 +91,7 @@ class CollTable:
     def __init__(self):
         self.slots: dict[str, Callable[..., Any]] = {}
         self.providers: dict[str, str] = {}  # slot -> component name
+        self.owners: dict[str, Any] = {}  # slot -> winning CollModule
         self.modules: list[CollModule] = []
 
     def lookup(self, slot: str):
@@ -130,6 +131,7 @@ def select_coll_modules(comm, framework) -> CollTable:
         for slot, fn in module.provided().items():
             table.slots[slot] = fn
             table.providers[slot] = comp.NAME
+            table.owners[slot] = module
     missing = [op for op in COLL_OPS if op not in table.slots]
     if missing:
         raise MPIInternalError(

@@ -17,9 +17,11 @@ size), through two sources:
   points; ours encode the TPU fabric's: the fused XLA primitive
   (psum/all_gather/…) is optimal at virtually every size because ICI
   collectives are hardware-routed, so the fixed rules pick the direct
-  path whenever the op allows and fall to ordered / segmented schedules
-  only where semantics (non-commutative ops, bit-exact mode) or HBM
-  staging (very large buffers) demand;
+  path UNCONDITIONALLY whenever the op allows (no size cutover — a
+  hardware-routed collective beats any software schedule), and fall to
+  ordered / segmented schedules only where semantics (non-commutative
+  ops; bit-exact mode is enforced inside coll/xla itself) or HBM
+  staging of very large software-op buffers demand;
 * **dynamic rules** (``--mca coll_tuned_use_dynamic_rules 1`` +
   ``coll_tuned_dynamic_rules_filename``): the reference's rule-file
   format, parsed by :func:`parse_rules_file` — per collective id, per
@@ -187,6 +189,13 @@ def fixed_decision(coll: str, comm_size: int, msg_bytes: int, op: Op | None,
     ``coll_tuned_large_msg`` / ``coll_tuned_huge_msg`` vars.
     """
     if coll == "allreduce":
+        # Fabric-reducible commutative ops take the fused primitive at
+        # EVERY size: a hardware-routed psum/pmax cannot be beaten by a
+        # software ppermute schedule, so (unlike the reference's TCP
+        # crossovers) there is no large-message cutover for them — the
+        # size ladder below applies to software ops only.  Bit-exact
+        # mode needs no branch here: coll_xla_reproducible overrides any
+        # forced algorithm inside the xla module itself.
         assert op is not None
         if op.lax_collective is not None and op.commutative:
             return ALLREDUCE_ALGOS["psum"], None
@@ -217,6 +226,8 @@ def fixed_decision(coll: str, comm_size: int, msg_bytes: int, op: Op | None,
     if coll in ("reduce_scatter", "reduce_scatter_block"):
         if op is not None and op.lax_collective == "psum":
             return REDUCE_SCATTER_ALGOS["direct"], None
+        if op is not None and not op.commutative:
+            return REDUCE_SCATTER_ALGOS["ordered"], None
         return REDUCE_SCATTER_ALGOS["ring"], None
     if coll == "barrier":
         return (BARRIER_ALGOS["dissemination"] if comm_size > 16
@@ -262,6 +273,16 @@ class TunedCollModule(CollModule):
 
         wrapper.__name__ = f"tuned_{slot}"
         return wrapper
+
+    def resolve(self, base: str, *args):
+        """Fast-path resolution: run the decision once for this call
+        signature, then hand the forced choice to the inner module's
+        resolver.  The compiled callable the api layer caches therefore
+        BAKES IN tuned's decision — valid until the var store changes
+        (the cache keys on the store version)."""
+        overrides = self._decide(base, args, {})
+        with self.inner.forced(**overrides):
+            return self.inner.resolve(base, *args)
 
     def _decide(self, coll: str, args, kwargs) -> dict[str, int]:
         var_enum = _ALGO_VAR.get(coll)

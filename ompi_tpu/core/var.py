@@ -151,6 +151,11 @@ class VarStore:
         param_files: Iterable[str] | None = None,
     ):
         self._vars: dict[str, Var] = {}
+        #: mutation generation — bumped on any change that can alter a
+        #: resolved value.  Fast-path dispatch caches (api/comm) key on
+        #: this to stay coherent with --mca/set() reconfiguration
+        #: without re-reading vars per call.
+        self.version = 0
         self._cmdline = dict(cmdline or {})
         self._env = env  # None → live os.environ
         self._file_values: dict[str, tuple[str, str]] = {}  # name -> (value, path)
@@ -221,6 +226,7 @@ class VarStore:
         for a in aliases:
             self._aliases[a] = var.full_name
         self._resolve(var)
+        self.version += 1
         return var
 
     # -- resolution ----------------------------------------------------
@@ -293,6 +299,7 @@ class VarStore:
         if var is None:
             # Stash as cmdline-equivalent so a later register() sees it.
             self._cmdline[full_name] = str(value)
+            self.version += 1
             return
         if var.read_only:
             raise VarConversionError(f"{full_name} is read-only")
@@ -300,6 +307,7 @@ class VarStore:
             var.value = _convert(value, var.type, var.enum)
             var.source = source
             var.source_detail = ""
+            self.version += 1
 
     def set_cmdline(self, params: dict[str, str]) -> None:
         """Install ``--mca k v`` pairs and re-resolve affected vars.
@@ -307,6 +315,7 @@ class VarStore:
         API-level set() values outrank cmdline (SET is the highest
         precedence source) and are therefore left untouched."""
         self._cmdline.update(params)
+        self.version += 1
         for k in params:
             canonical = self._aliases.get(k, k)
             var = self._vars.get(canonical)

