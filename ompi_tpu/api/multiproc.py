@@ -123,8 +123,8 @@ class MultiProcComm:
         return self.coll.lookup("allgather")(x)
 
     def gather(self, x, root: int = 0):
-        """Root's recvbuf (global_n, *s) — the gather slot's contract
-        (no shape heuristics: han returns the fan-in result directly)."""
+        """Root's recvbuf (global_n, *s) on the process owning ``root``;
+        None elsewhere (MPI: recvbuf significant only at root)."""
         return self.coll.lookup("gather")(x, root)
 
     def scatter(self, x, root: int = 0):

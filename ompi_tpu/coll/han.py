@@ -88,11 +88,16 @@ class HanCollModule(CollModule):
         return out.copy()
 
     def gather(self, x, root: int = 0):
-        """Root's recvbuf (global_n, *s): fan-in over DCN (each process
-        contributes its slice once — no n× allgather blowup)."""
+        """Root's recvbuf (global_n, *s) on root's process: fan-in over
+        DCN (each process sends its slice to root once — no allgather
+        blowup).  Non-root processes return None (MPI: recvbuf is
+        significant only at root)."""
         comm = self.comm
         x = np.asarray(x)
-        slices = comm.dcn.allgather(x, comm.cid)
+        root_proc, _ = comm.locate(root)
+        slices = comm.dcn.gather(x, root_proc, comm.cid)
+        if slices is None:
+            return None
         return np.concatenate(slices, axis=0)
 
     def scatter(self, x, root: int = 0):

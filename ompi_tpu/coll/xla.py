@@ -176,7 +176,9 @@ class XlaCollModule(CollModule):
         if algo == ALLREDUCE_ALGOS["rabenseifner"] and (n & (n - 1)):
             algo = ALLREDUCE_ALGOS["ring"]  # tuned-style fallback
         seg = self._segcount()
-        key = ("allreduce", algo, x.shape, str(x.dtype), op.name, seg)
+        # op keyed by IDENTITY (Op is identity-hashed): two user ops may
+        # share a name but carry different kernels
+        key = ("allreduce", algo, x.shape, str(x.dtype), op, seg)
 
         def build():
             impl = {
@@ -244,7 +246,7 @@ class XlaCollModule(CollModule):
             algo = REDUCE_ALGOS["ordered"]
         if algo == REDUCE_ALGOS["auto"]:
             algo = REDUCE_ALGOS["ordered"] if not op.commutative else REDUCE_ALGOS["binomial"]
-        key = ("reduce", algo, x.shape, str(x.dtype), op.name, root)
+        key = ("reduce", algo, x.shape, str(x.dtype), op, root)
 
         def build():
             impl = {
@@ -368,7 +370,7 @@ class XlaCollModule(CollModule):
             # ring's chain order starts at (b+1)%n — wrong result for
             # non-commutative ops; promote to the rank-ordered path
             algo = REDUCE_SCATTER_ALGOS["ordered"]
-        key = ("reduce_scatter_block", algo, x.shape, str(x.dtype), op.name)
+        key = ("reduce_scatter_block", algo, x.shape, str(x.dtype), op)
 
         def build():
             if algo == REDUCE_SCATTER_ALGOS["direct"]:
@@ -500,7 +502,7 @@ class XlaCollModule(CollModule):
 
     def _scan_fn(self, x, op: Op, exclusive: bool):
         n = self._n()
-        key = ("scan", exclusive, x.shape, str(x.dtype), op.name)
+        key = ("scan", exclusive, x.shape, str(x.dtype), op)
 
         def build():
             return self._spmd(

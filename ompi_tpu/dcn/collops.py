@@ -227,6 +227,22 @@ class DcnCollEngine:
             return np.asarray(blocks_by_proc[root])
         return self._recv(root, cid, seq)
 
+    def gather(self, x: np.ndarray, root: int, cid: int) -> list[np.ndarray] | None:
+        """True fan-in: every non-root process sends its block to root
+        ONCE; root returns [proc 0's x, …], others return None (MPI:
+        recvbuf significant only at root). O(total bytes) DCN ingress at
+        root — vs allgather's P× aggregate."""
+        if self.nprocs == 1:
+            return [x]
+        seq = self._next_seq(cid)
+        if self.proc != root:
+            self._send(root, cid, seq, x)
+            return None
+        return [
+            x if p == root else self._recv(p, cid, seq)
+            for p in range(self.nprocs)
+        ]
+
     def barrier(self, cid: int) -> None:
         self.allreduce(np.zeros(1, np.int32), _SUM_TOKEN, cid)
 

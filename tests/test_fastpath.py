@@ -120,6 +120,18 @@ def test_gather_host_path(world):
     np.testing.assert_array_equal(out, x)
 
 
+def test_user_ops_sharing_default_name_do_not_collide(world):
+    """Two create_op handles with the same name are distinct cache keys
+    (op identity, not op.name) at every cache layer."""
+    a = create_op(lambda p, q: p - q, commute=False)
+    b = create_op(lambda p, q: p + 2 * q, commute=False)
+    x = np.round(rank_data((5,), np.float64, seed=13) * 4)
+    out_a = np.asarray(world.allreduce(x, a))
+    out_b = np.asarray(world.allreduce(x, b))
+    np.testing.assert_array_equal(out_a[0], ordered_reduce_np(x, a))
+    np.testing.assert_array_equal(out_b[0], ordered_reduce_np(x, b))
+
+
 def test_ivariant_shares_cache_and_works(world):
     x = rank_data((8,), np.float32, seed=7)
     req = world.iallreduce(x, MAX)

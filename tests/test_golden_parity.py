@@ -1,0 +1,108 @@
+"""Bit-parity vs the installed reference's reduction kernels.
+
+The golden file ``tests/golden/reduce_local.json`` holds fold results
+captured from Open MPI 4.1.4's ``MPI_Reduce_local`` (the op kernels
+every collective's reduction step calls — ``ompi/mca/op``; see
+``tools/golden_capture.py``).  These tests bit-compare this framework's
+ordered reduction paths against those vectors:
+
+* ``ordered_reduce_np`` — the host/golden kernel;
+* ``ordered_reduce_jax`` under jit — the device kernel the reproducible
+  collectives use;
+* the full ``allreduce`` with ``coll_xla_reproducible=1`` — the
+  north-star "bit-exact MPI_SUM" config end to end.
+
+BASELINE.md first milestone / SURVEY.md §2.2 op ("this is what MPI_SUM
+bit-exactness is measured against").
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.core import mca
+from ompi_tpu.op import MAX, MIN, PROD, SUM
+from ompi_tpu.op.op import ordered_reduce_jax, ordered_reduce_np
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "reduce_local.json")
+
+OPS = {"MPI_SUM": SUM, "MPI_MAX": MAX, "MPI_MIN": MIN, "MPI_PROD": PROD}
+DTYPES = {"float32": np.float32, "float64": np.float64, "int32": np.int32}
+
+
+def _cases():
+    with open(GOLDEN) as f:
+        data = json.load(f)
+    for name, c in sorted(data["cases"].items()):
+        dt = np.dtype(DTYPES[c["dtype"]])
+        x = np.frombuffer(bytes.fromhex(c["input_hex"]), dt).reshape(
+            c["n_ranks"], c["count"]
+        )
+        ref = np.frombuffer(bytes.fromhex(c["result_hex"]), dt)
+        yield name, OPS[c["op"]], x, ref
+
+
+CASES = list(_cases())
+IDS = [c[0] for c in CASES]
+
+
+@pytest.mark.parametrize("name,op,x,ref", CASES, ids=IDS)
+def test_ordered_reduce_np_bit_matches_reference(name, op, x, ref):
+    got = ordered_reduce_np(x, op)
+    assert got.tobytes() == ref.tobytes()
+
+
+@pytest.mark.parametrize("name,op,x,ref", CASES, ids=IDS)
+def test_ordered_reduce_jax_bit_matches_reference(name, op, x, ref):
+    got = np.asarray(jax.jit(lambda v: ordered_reduce_jax(v, op))(x))
+    assert got.tobytes() == ref.tobytes()
+
+
+def test_reproducible_allreduce_bit_matches_reference(devices):
+    """End to end: the bit-exact collective path reproduces the
+    reference kernel's fp32 MPI_SUM fold over the comm."""
+    world = api.init()
+    store = mca.default_context().store
+    by_name = {name: (op, x, ref) for name, op, x, ref in CASES}
+    op, x, ref = by_name["MPI_SUM:float32"]
+    assert x.shape[0] == world.size
+    store.set("coll_xla_reproducible", 1)
+    try:
+        out = np.asarray(world.allreduce(x, op))
+    finally:
+        store.set("coll_xla_reproducible", 0)
+    for r in range(world.size):
+        assert out[r].tobytes() == ref.tobytes()
+
+
+def test_capture_tool_is_rerunnable_if_reference_present():
+    """Self-check of provenance: when libmpi is loadable, re-capturing
+    MPI_SUM:float32 must reproduce the committed golden bytes (guards
+    against a stale or hand-edited golden file)."""
+    from tools.golden_capture import LIBMPI
+
+    if not os.path.exists(LIBMPI):
+        pytest.skip("reference libmpi not installed")
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "g.json")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "golden_capture.py"), "--out", out],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr
+        with open(out) as f:
+            fresh = json.load(f)
+        with open(GOLDEN) as f:
+            committed = json.load(f)
+        assert (fresh["cases"]["MPI_SUM:float32"]["result_hex"]
+                == committed["cases"]["MPI_SUM:float32"]["result_hex"])

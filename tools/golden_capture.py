@@ -1,0 +1,140 @@
+"""Capture CPU-golden reduction vectors from the installed reference.
+
+BASELINE.md's first measurement milestone: freeze golden fold results
+from the real Open MPI 4.1.4 (`libmpi.so.40.30.4`) so this framework's
+ordered/bit-exact reduction paths are validated against the *reference's
+kernel order*, not merely against our own numpy fold (VERDICT r1
+missing #1).
+
+No ``mpirun`` exists on this machine, so the capture is single-process:
+``MPI_Init`` singleton + ``MPI_Reduce_local`` (the exact op kernels —
+``ompi/mca/op/base`` C loops, AVX component if selected by CPUID — that
+every collective's reduction step calls; SURVEY.md §2.2 op) applied as
+a rank-sequential left fold acc = op(acc, rank_r), r = 1..n-1 — the
+order of the reference's linear/in-order reduction and of our
+``ordered_reduce_np/jax``.
+
+Usage:  python tools/golden_capture.py [--out tests/golden/reduce_local.json]
+
+Writes a JSON file with hex-encoded input and output byte vectors per
+(op × dtype) case.  Commit the file; tests/test_golden_parity.py
+bit-compares against it without needing libmpi at test time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+
+import numpy as np
+
+LIBMPI = "/usr/lib/x86_64-linux-gnu/libmpi.so.40.30.4"
+
+#: predefined handle data symbols in libmpi (MPI_Op = &ompi_mpi_op_<x>,
+#: MPI_Datatype = &ompi_mpi_<t>) — the standard Open MPI ABI layout
+OPS = {
+    "MPI_SUM": "ompi_mpi_op_sum",
+    "MPI_MAX": "ompi_mpi_op_max",
+    "MPI_MIN": "ompi_mpi_op_min",
+    "MPI_PROD": "ompi_mpi_op_prod",
+}
+DTYPES = {
+    "float32": ("ompi_mpi_float", np.float32),
+    "float64": ("ompi_mpi_double", np.float64),
+    "int32": ("ompi_mpi_int32_t", np.int32),
+}
+
+N_RANKS = 8
+COUNT = 257  # odd length: exercises any vector-kernel tail path
+
+
+def _handle(lib: ctypes.CDLL, symbol: str) -> ctypes.c_void_p:
+    """Address of a predefined-object data symbol = the MPI handle."""
+    return ctypes.c_void_p(
+        ctypes.addressof(ctypes.c_char.in_dll(lib, symbol))
+    )
+
+
+def make_inputs(dtype: type, seed: int = 1234) -> np.ndarray:
+    """Deterministic (N_RANKS, COUNT) rank-major inputs; values chosen so
+    fp folds are order-sensitive (mixed magnitudes) and int folds don't
+    overflow."""
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(dtype, np.integer):
+        return rng.randint(-1000, 1000, size=(N_RANKS, COUNT)).astype(dtype)
+    mags = rng.choice([1e-4, 1.0, 1e4], size=(N_RANKS, COUNT))
+    return (rng.randn(N_RANKS, COUNT) * mags).astype(dtype)
+
+
+def capture() -> dict:
+    # no mpirun/orted on this machine: isolated singleton skips the
+    # orted fork in ess/singleton (same code path `--mca ess singleton`
+    # + isolated option takes)
+    os.environ.setdefault("OMPI_MCA_ess_singleton_isolated", "1")
+    mode = ctypes.RTLD_GLOBAL | ctypes.DEFAULT_MODE
+    lib = ctypes.CDLL(LIBMPI, mode=mode)
+    if lib.MPI_Init(None, None) != 0:
+        raise RuntimeError("MPI_Init failed")
+    try:
+        reduce_local = lib.MPI_Reduce_local
+        reduce_local.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        cases = {}
+        for opname, opsym in OPS.items():
+            op = _handle(lib, opsym)
+            for dtname, (dtsym, dt) in DTYPES.items():
+                mpidt = _handle(lib, dtsym)
+                x = make_inputs(dt)
+                acc = np.ascontiguousarray(x[0].copy())
+                for r in range(1, N_RANKS):
+                    # inoutbuf = inbuf op inoutbuf; all four captured ops
+                    # are commutative (bitwise identical either way), so
+                    # this realizes acc = op(acc, x[r]) in the reference's
+                    # kernel
+                    inbuf = np.ascontiguousarray(x[r])
+                    rc = reduce_local(
+                        inbuf.ctypes.data_as(ctypes.c_void_p),
+                        acc.ctypes.data_as(ctypes.c_void_p),
+                        COUNT, mpidt, op,
+                    )
+                    if rc != 0:
+                        raise RuntimeError(f"MPI_Reduce_local rc={rc}")
+                cases[f"{opname}:{dtname}"] = {
+                    "op": opname,
+                    "dtype": dtname,
+                    "n_ranks": N_RANKS,
+                    "count": COUNT,
+                    "input_hex": x.tobytes().hex(),
+                    "result_hex": acc.tobytes().hex(),
+                }
+        return {
+            "provenance": {
+                "library": LIBMPI,
+                "captured_with": "MPI_Reduce_local left fold acc=op(acc, r)",
+                "seed": 1234,
+            },
+            "cases": cases,
+        }
+    finally:
+        lib.MPI_Finalize()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "tests", "golden",
+        "reduce_local.json"))
+    args = p.parse_args()
+    data = capture()
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+    print(f"wrote {len(data['cases'])} cases to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
