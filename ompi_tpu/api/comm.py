@@ -37,6 +37,7 @@ from ompi_tpu.core.errors import (
 )
 from ompi_tpu.coll.module import CollTable, select_coll_modules
 from ompi_tpu.ddt.convertor import pack as ddt_pack, unpack as ddt_unpack
+from ompi_tpu.ft import ulfm
 from ompi_tpu.ddt.datatype import Datatype, from_numpy_dtype
 from ompi_tpu.mesh.mesh import CommMesh
 from ompi_tpu.op.op import SUM, Op
@@ -80,6 +81,9 @@ class Comm:
         self._pml = None
         self._attrs: dict[int, Any] = {}
         self._freed = False
+        #: ULFM fault-tolerance state; None until a failure/revoke event
+        #: touches this comm (zero-cost fast path: one attribute test)
+        self._ft = None
         #: fast-path dispatch cache: (slot, op, shape, dtype, …) →
         #: (mca context, store version, compiled callable)
         self._fast: dict[tuple, tuple] = {}
@@ -179,6 +183,41 @@ class Comm:
             for r in members:
                 out[r] = comm
         return out
+
+    def _shrink_to(self, live: Sequence[int], name: str = "") -> "Comm":
+        """ULFM shrink substrate: a fresh communicator over the live rank
+        subset, renumbered contiguously, mesh shrunk to their devices
+        (SURVEY.md §5: "slice-failure → shrink mesh → re-form").  Unlike
+        create_group this works on revoked comms — shrink IS the
+        recovery path — so no FT guard here."""
+        self._check()
+        sub = self.mesh.submesh(list(live))
+        world_ranks = [self.group.ranks[r] for r in live]
+        return Comm(Group(world_ranks), sub, name or f"{self.name}.shrunk")
+
+    def revoke(self) -> None:
+        """MPIX_Comm_revoke."""
+        ulfm.revoke(self)
+
+    def shrink(self, name: str = "") -> "Comm":
+        """MPIX_Comm_shrink."""
+        return ulfm.shrink(self, name)
+
+    def agree(self, flags: int, contributions=None) -> int:
+        """MPIX_Comm_agree."""
+        return ulfm.agree(self, flags, contributions)
+
+    def get_failed(self) -> list[int]:
+        """MPIX_Comm_get_failed."""
+        return ulfm.get_failed(self)
+
+    def ack_failed(self) -> int:
+        """MPIX_Comm_ack_failed."""
+        return ulfm.ack_failed(self)
+
+    def is_revoked(self) -> bool:
+        """MPIX_Comm_is_revoked."""
+        return ulfm.is_revoked(self)
 
     def split_type_shared(self) -> "Comm":
         """MPI_Comm_split_type(MPI_COMM_TYPE_SHARED): single-host/
@@ -297,7 +336,17 @@ class Comm:
         spc.inc(slot)
         return fn
 
+    def _lookup(self, slot: str):
+        """FT-guarded coll-table lookup — the single choke point every
+        collective entry goes through (directly or via _dispatch), so
+        ULFM guards are structural, not per-call-site."""
+        if self._ft is not None:
+            ulfm.check(self, collective=True)
+        return self.coll.lookup(slot)
+
     def _dispatch(self, slot: str, key: tuple, args: tuple, host: bool):
+        if self._ft is not None:
+            ulfm.check(self, collective=True)
         fn = self._fast_fn(slot, slot, key, args)
         out = fn(args[0]) if fn is not None else self.coll.lookup(slot)(*args)
         return self.mesh.stage_out(out) if host else out
@@ -307,9 +356,11 @@ class Comm:
         """Non-blocking twin: the cached program is the SAME compiled
         callable as the blocking slot (shared key), wrapped in an
         ArrayRequest (async XLA dispatch ↔ libnbc schedule)."""
+        if self._ft is not None:
+            ulfm.check(self, collective=True)
         fn = self._fast_fn(slot, base, key, args)
         req = (ArrayRequest(fn(args[0])) if fn is not None
-               else self.coll.lookup(slot)(*args))
+               else self._lookup(slot)(*args))
         return _wrap_unstage(req, self, host)
 
     def allreduce(self, x, op: Op = SUM):
@@ -329,7 +380,7 @@ class Comm:
 
     def allreduce_init(self, x, op: Op = SUM) -> Request:
         xd, _ = self._stage(x, 1)
-        return self.coll.lookup("allreduce_init")(xd, op)
+        return self._lookup("allreduce_init")(xd, op)
 
     def bcast(self, x, root: int = 0):
         self._check_root(root)
@@ -409,7 +460,7 @@ class Comm:
                 raise MPIArgError("reduce_scatter counts length != comm size")
             if len(set(counts)) > 1:
                 # jagged → host path via the table (lists)
-                return self.coll.lookup("reduce_scatter")(np.asarray(x), op, counts)
+                return self._lookup("reduce_scatter")(np.asarray(x), op, counts)
             c = counts[0]
             arr = np.asarray(x) if not isinstance(x, jax.Array) else x
             if arr.shape[1] != self.size * c:
@@ -419,10 +470,10 @@ class Comm:
                 )
             blocks = arr.reshape((self.size, self.size, c) + arr.shape[2:])
             xd, host = self._stage(blocks, 2)
-            out = self.coll.lookup("reduce_scatter_block")(xd, op)
+            out = self._lookup("reduce_scatter_block")(xd, op)
             return self._unstage(out, host)
         xd, host = self._stage(x, 2)
-        return self._unstage(self.coll.lookup("reduce_scatter")(xd, op, None), host)
+        return self._unstage(self._lookup("reduce_scatter")(xd, op, None), host)
 
     def alltoall(self, x):
         xd, host = self._stage(x, 2)
@@ -452,29 +503,29 @@ class Comm:
         )
 
     def barrier(self) -> None:
-        self.coll.lookup("barrier")()
+        self._lookup("barrier")()
 
     def ibarrier(self) -> Request:
-        return self.coll.lookup("ibarrier")()
+        return self._lookup("ibarrier")()
 
     # jagged variants (host path)
     def allgatherv(self, blocks: Sequence[np.ndarray]):
         if len(blocks) != self.size:
             raise MPIArgError("allgatherv needs one block per rank")
-        return self.coll.lookup("allgatherv")(blocks)
+        return self._lookup("allgatherv")(blocks)
 
     def alltoallv(self, matrix: Sequence[Sequence[np.ndarray]]):
         if len(matrix) != self.size:
             raise MPIArgError("alltoallv needs n rows")
-        return self.coll.lookup("alltoallv")(matrix)
+        return self._lookup("alltoallv")(matrix)
 
     def gatherv(self, blocks: Sequence[np.ndarray], root: int = 0):
         self._check_root(root)
-        return self.coll.lookup("gatherv")(blocks, root)
+        return self._lookup("gatherv")(blocks, root)
 
     def scatterv(self, blocks: Sequence[np.ndarray], root: int = 0):
         self._check_root(root)
-        return self.coll.lookup("scatterv")(blocks, root)
+        return self._lookup("scatterv")(blocks, root)
 
     # -- point-to-point (pml) -------------------------------------------
 
@@ -482,6 +533,8 @@ class Comm:
         """MPI_Send from rank ``source`` to ``dest`` (single-controller
         form names both endpoints). Eager-buffered: returns immediately,
         sender's buffer reusable."""
+        if self._ft is not None:
+            ulfm.check(self, peer=dest)
         dest_dev = (
             self.mesh.devices[dest]
             if isinstance(buf, jax.Array) and 0 <= dest < self.size
@@ -498,6 +551,8 @@ class Comm:
     def irecv(self, dest: int, source: int | None = None, tag: int | None = None) -> Request:
         from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG
 
+        if self._ft is not None:
+            ulfm.check(self, peer=source, any_source=source is None)
         return self.pml.irecv(
             dest,
             ANY_SOURCE if source is None else source,
@@ -534,6 +589,10 @@ class Comm:
     def iprobe(self, dest: int, source: int | None = None, tag: int | None = None):
         from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG
 
+        if self._ft is not None:
+            # guard here (not just irecv) so blocking probe raises
+            # instead of spinning forever on a revoked comm / dead peer
+            ulfm.check(self, peer=source, any_source=source is None)
         return self.pml.iprobe(
             dest,
             ANY_SOURCE if source is None else source,

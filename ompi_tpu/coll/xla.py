@@ -89,6 +89,8 @@ class XlaCollModule(CollModule):
     def _compiled(self, key: tuple, builder: Callable[[], Callable]) -> Callable:
         fn = self._cache.get(key)
         if fn is None:
+            if len(self._cache) > 4096:  # user-op churn backstop (ops key
+                self._cache.clear()      # by identity; see Comm._fast)
             fn = builder()
             self._cache[key] = fn
         return fn

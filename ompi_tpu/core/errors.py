@@ -168,6 +168,18 @@ class MPIProcFailedError(MPIError):
         self.failed = tuple(failed)
 
 
+class MPIProcFailedPendingError(MPIError):
+    """MPIX_ERR_PROC_FAILED_PENDING: a potential matching sender failed
+    while an ANY_SOURCE receive was outstanding; the receive cannot be
+    satisfied until the failure is acknowledged (MPIX_Comm_ack_failed)."""
+
+    error_class = MPIX_ERR_PROC_FAILED_PENDING
+
+    def __init__(self, msg: str, failed: tuple[int, ...] = ()):
+        super().__init__(msg)
+        self.failed = tuple(failed)
+
+
 class MPIRevokedError(MPIError):
     """MPIX_ERR_REVOKED: communicator was revoked."""
 

@@ -35,7 +35,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ompi_tpu.core.errors import MPIProcFailedError, MPIRankError, MPIRevokedError
+from ompi_tpu.core.errors import (
+    MPIProcFailedError,
+    MPIProcFailedPendingError,
+    MPIRankError,
+    MPIRevokedError,
+)
 
 
 @dataclass
@@ -70,13 +75,22 @@ def inject_failure(comm, rank: int) -> None:
     state(comm).failed.add(rank)
 
 
-def check(comm, peer: int | None = None, collective: bool = False) -> None:
+def check(
+    comm,
+    peer: int | None = None,
+    collective: bool = False,
+    any_source: bool = False,
+) -> None:
     """The per-operation guard (≈ the in-band error checks ob1/coll do
     under ULFM builds).
 
     * revoked comm → MPIRevokedError, always;
-    * collective ops → fail if ANY unacknowledged failure exists
-      (collectives involve every rank);
+    * collective ops → fail if ANY failure exists — acknowledged or not
+      (collectives involve every rank; a collective can never complete
+      with a failed member until shrink rebuilds the membership);
+    * ANY_SOURCE receives → MPIX_ERR_PROC_FAILED_PENDING while an
+      *unacknowledged* failure exists (ack_failed re-arms them — this
+      is the only place the acked set matters);
     * pt2pt → fail only if the named peer failed.
     """
     st = peek(comm)
@@ -85,12 +99,19 @@ def check(comm, peer: int | None = None, collective: bool = False) -> None:
     if st.revoked:
         raise MPIRevokedError(f"{comm.name} has been revoked")
     if collective:
-        bad = st.failed - st.acked
-        if bad:
+        if st.failed:
             raise MPIProcFailedError(
                 f"collective on {comm.name} with failed ranks "
-                f"{sorted(bad)} (revoke+shrink to recover)",
-                failed=tuple(sorted(bad)),
+                f"{sorted(st.failed)} (revoke+shrink to recover)",
+                failed=tuple(sorted(st.failed)),
+            )
+    elif any_source:
+        pending = st.failed - st.acked
+        if pending:
+            raise MPIProcFailedPendingError(
+                f"ANY_SOURCE receive on {comm.name} with unacknowledged "
+                f"failed ranks {sorted(pending)} (ack_failed to re-arm)",
+                failed=tuple(sorted(pending)),
             )
     elif peer is not None and peer in st.failed:
         raise MPIProcFailedError(

@@ -227,7 +227,8 @@ class File:
         filetype = filetype or etype
         self._ranks[rank].view = _View(disp, etype, filetype)
         self._ranks[rank].ptr = 0
-        self._shared_ptr = 0
+        with self._shared_lock:
+            self._shared_ptr = 0
 
     def get_view(self, rank: int) -> tuple[int, Datatype, Datatype]:
         self._check(rank=rank)
@@ -343,6 +344,13 @@ class File:
         self._check(writing=True, rank=rank)
         v = self._ranks[rank].view
         raw = self._as_bytes(data)
+        if raw.nbytes % v.etype.size:
+            # validate BEFORE the fetch-add: a partial-etype write must
+            # not permanently advance the shared pointer
+            raise MPIArgError(
+                f"shared write of {raw.nbytes} B is not a whole number "
+                f"of etype ({v.etype.size} B) elements"
+            )
         n = raw.nbytes // v.etype.size
         with self._shared_lock:
             pos = self._shared_ptr

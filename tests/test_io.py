@@ -192,6 +192,20 @@ def test_shared_pointer_fetch_add(world, path):
     f.close()
 
 
+def test_shared_write_partial_etype_rejected_before_advance(world, path):
+    """ADVICE r1: a partial-etype shared write must raise WITHOUT
+    advancing the shared pointer."""
+    f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+    for r in range(N):
+        f.set_view(r, 0, ddt.FLOAT)  # etype = 4 bytes
+    with pytest.raises(MPIArgError):
+        f.write_shared(0, np.array([1, 2, 3], np.uint8))  # 3 B: partial
+    assert f.get_position_shared() == 0
+    assert f.write_shared(0, np.array([1.0], np.float32)) == 1
+    assert f.get_position_shared() == 1
+    f.close()
+
+
 def test_write_ordered_rank_order(world, path):
     f = world.file_open(path, MODE_CREATE | MODE_RDWR)
     blocks = [np.full(2, r, np.uint8) for r in range(N)]

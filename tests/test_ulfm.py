@@ -1,0 +1,199 @@
+"""ULFM fault-tolerance tests: inject → detect → revoke → shrink → agree.
+
+Mirrors the reference's ULFM contract (SURVEY.md §5: ``MPIX_Comm_
+revoke/shrink/agree``, ``coll/ftagree``; failure detection is external
+— tests inject failures the way ULFM test suites kill ranks):
+
+* operations touching a failed rank raise MPIX_ERR_PROC_FAILED;
+* ANY_SOURCE receives raise MPIX_ERR_PROC_FAILED_PENDING until
+  ``ack_failed`` re-arms them — but collectives keep raising until
+  shrink (ack does NOT resurrect collectives);
+* ``revoke`` poisons everything except the recovery trio;
+* ``shrink`` yields a working communicator over the survivors;
+* ``agree`` decides consistently despite failed participants.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.core.errors import (
+    MPIProcFailedError,
+    MPIProcFailedPendingError,
+    MPIRankError,
+    MPIRevokedError,
+)
+from ompi_tpu.ft import ulfm
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+@pytest.fixture
+def comm(world):
+    """A fresh dup per test so FT state never leaks across tests."""
+    c = world.dup(name="ft_test")
+    yield c
+    c.free()
+
+
+N = 8
+
+
+def test_no_ft_state_is_free(comm):
+    # the fast path: no FT event → no state object, collectives work
+    assert ulfm.peek(comm) is None
+    out = comm.allreduce(np.ones((N, 4), np.float32))
+    np.testing.assert_array_equal(np.asarray(out)[0], np.full(4, N, np.float32))
+    assert ulfm.get_failed(comm) == []
+    assert not comm.is_revoked()
+
+
+def test_inject_bounds(comm):
+    with pytest.raises(MPIRankError):
+        ulfm.inject_failure(comm, N)
+    with pytest.raises(MPIRankError):
+        ulfm.inject_failure(comm, -1)
+
+
+def test_collective_raises_on_failure(comm):
+    ulfm.inject_failure(comm, 3)
+    with pytest.raises(MPIProcFailedError) as ei:
+        comm.allreduce(np.ones((N, 4), np.float32))
+    assert ei.value.failed == (3,)
+    with pytest.raises(MPIProcFailedError):
+        comm.barrier()
+    with pytest.raises(MPIProcFailedError):
+        comm.ibcast(np.ones((N, 4), np.float32), root=0)
+    with pytest.raises(MPIProcFailedError):
+        comm.gatherv([np.ones(i + 1, np.float32) for i in range(N)], root=0)
+
+
+def test_collective_raises_even_after_ack(comm):
+    """ack_failed re-arms ANY_SOURCE only; collectives stay poisoned
+    until shrink (the ADVICE r1 semantics fix)."""
+    ulfm.inject_failure(comm, 2)
+    comm.ack_failed()
+    with pytest.raises(MPIProcFailedError):
+        comm.allreduce(np.ones((N, 2), np.float32))
+
+
+def test_pt2pt_failed_peer_only(comm):
+    ulfm.inject_failure(comm, 5)
+    # send/recv between live ranks still works — MPI_ERRORS_RETURN survival
+    comm.send(np.arange(3.0), source=0, dest=1, tag=9)
+    payload, st = comm.recv(1, source=0, tag=9)
+    np.testing.assert_array_equal(payload, np.arange(3.0))
+    # naming the dead peer raises
+    with pytest.raises(MPIProcFailedError):
+        comm.send(np.arange(3.0), source=0, dest=5)
+    with pytest.raises(MPIProcFailedError):
+        comm.irecv(1, source=5)
+
+
+def test_any_source_pending_until_ack(comm):
+    ulfm.inject_failure(comm, 4)
+    with pytest.raises(MPIProcFailedPendingError) as ei:
+        comm.irecv(0, source=None)
+    assert ei.value.failed == (4,)
+    assert comm.get_failed() == [4]
+    assert comm.ack_failed() == 1
+    # re-armed: ANY_SOURCE matches a live sender again
+    comm.send(np.float64(7.0), source=2, dest=0, tag=1)
+    payload, st = comm.recv(0, source=None, tag=1)
+    assert float(payload) == 7.0
+    assert st.source == 2
+
+
+def test_revoke_poisons_everything_but_recovery(comm):
+    ulfm.inject_failure(comm, 1)
+    comm.revoke()
+    assert comm.is_revoked()
+    with pytest.raises(MPIRevokedError):
+        comm.allreduce(np.ones((N, 2), np.float32))
+    with pytest.raises(MPIRevokedError):
+        comm.send(np.ones(2), source=0, dest=2)
+    with pytest.raises(MPIRevokedError):
+        comm.irecv(2, source=0)
+    # the recovery trio still works on a revoked comm
+    assert comm.get_failed() == [1]
+    assert comm.agree(0b1011) == 0b1011
+    sub = comm.shrink()
+    assert sub.size == N - 1
+
+
+def test_shrink_produces_working_comm(comm):
+    ulfm.inject_failure(comm, 0)
+    ulfm.inject_failure(comm, 6)
+    sub = comm.shrink(name="survivors")
+    assert sub.size == N - 2
+    assert sub.name == "survivors"
+    # survivors renumber contiguously over world ranks {1,2,3,4,5,7}
+    assert list(sub.group.ranks) == [1, 2, 3, 4, 5, 7]
+    # fresh FT state: collectives run again
+    out = np.asarray(sub.allreduce(np.ones((sub.size, 3), np.float32)))
+    np.testing.assert_array_equal(out[0], np.full(3, sub.size, np.float32))
+    assert ulfm.peek(sub) is None
+    sub.free()
+
+
+def test_shrink_everyone_dead(comm):
+    for r in range(N):
+        ulfm.inject_failure(comm, r)
+    with pytest.raises(MPIProcFailedError):
+        comm.shrink()
+
+
+def test_agree_drops_failed_contributions(comm):
+    ulfm.inject_failure(comm, 7)
+    contrib = {r: 0b1111 for r in range(N)}
+    contrib[3] = 0b0110
+    contrib[7] = 0b0000  # dead rank's word must NOT affect the result
+    assert comm.agree(0b1111, contrib) == 0b0110
+    with_live_only = comm.agree(0b1010)
+    assert with_live_only == 0b1010
+
+
+def test_agree_no_live_ranks(comm):
+    for r in range(N):
+        ulfm.inject_failure(comm, r)
+    with pytest.raises(MPIProcFailedError):
+        comm.agree(1)
+
+
+def test_every_collective_entry_is_guarded(comm):
+    """Review r2: reduce_scatter/allreduce_init/probe previously bypassed
+    the FT guard (direct coll.lookup) — the guard is structural now."""
+    ulfm.inject_failure(comm, 3)
+    with pytest.raises(MPIProcFailedError):
+        comm.reduce_scatter(np.ones((N, N, 2), np.float32))
+    with pytest.raises(MPIProcFailedError):
+        comm.reduce_scatter(np.ones((N, N * 2), np.float32), counts=[2] * N)
+    with pytest.raises(MPIProcFailedError):
+        comm.reduce_scatter([np.ones(N + 3, np.float32)] * N,
+                            counts=list(range(1, N + 1)))
+    with pytest.raises(MPIProcFailedError):
+        comm.allreduce_init(np.ones((N, 2), np.float32))
+    # probe: raises rather than spinning forever on the dead peer
+    with pytest.raises(MPIProcFailedError):
+        comm.probe(0, source=3)
+    with pytest.raises(MPIProcFailedPendingError):
+        comm.iprobe(0, source=None)
+    comm.revoke()
+    with pytest.raises(MPIRevokedError):
+        comm.probe(0, source=1)
+
+
+def test_shrink_of_subcomm(world):
+    """shrink composes with comm_split: failure in a split comm shrinks
+    within that comm's rank space."""
+    comms = world.split([r % 2 for r in range(N)])
+    odd = comms[1]
+    ulfm.inject_failure(odd, 1)  # odd-comm rank 1 == world rank 3
+    sub = ulfm.shrink(odd)
+    assert sub.size == 3
+    assert list(sub.group.ranks) == [1, 5, 7]
+    out = np.asarray(sub.allreduce(np.ones((3, 2), np.float32)))
+    np.testing.assert_array_equal(out[0], np.full(2, 3, np.float32))
