@@ -336,17 +336,22 @@ class Comm:
         spc.inc(slot)
         return fn
 
-    def _lookup(self, slot: str):
-        """FT-guarded coll-table lookup — the single choke point every
-        collective entry goes through (directly or via _dispatch), so
-        ULFM guards are structural, not per-call-site."""
+    def _ft_guard(self) -> None:
+        """The ULFM collective guard. Exactly three call sites —
+        _dispatch, _dispatch_i (which bypass the table on their compiled
+        fast path) and _lookup (every table-path entry) — so every
+        collective entry is guarded structurally, never per-call-site."""
         if self._ft is not None:
             ulfm.check(self, collective=True)
+
+    def _lookup(self, slot: str):
+        """FT-guarded coll-table lookup: the choke point for every
+        collective entry that does not go through _dispatch/_dispatch_i."""
+        self._ft_guard()
         return self.coll.lookup(slot)
 
     def _dispatch(self, slot: str, key: tuple, args: tuple, host: bool):
-        if self._ft is not None:
-            ulfm.check(self, collective=True)
+        self._ft_guard()
         fn = self._fast_fn(slot, slot, key, args)
         out = fn(args[0]) if fn is not None else self.coll.lookup(slot)(*args)
         return self.mesh.stage_out(out) if host else out
@@ -356,11 +361,10 @@ class Comm:
         """Non-blocking twin: the cached program is the SAME compiled
         callable as the blocking slot (shared key), wrapped in an
         ArrayRequest (async XLA dispatch ↔ libnbc schedule)."""
-        if self._ft is not None:
-            ulfm.check(self, collective=True)
+        self._ft_guard()
         fn = self._fast_fn(slot, base, key, args)
         req = (ArrayRequest(fn(args[0])) if fn is not None
-               else self._lookup(slot)(*args))
+               else self.coll.lookup(slot)(*args))
         return _wrap_unstage(req, self, host)
 
     def allreduce(self, x, op: Op = SUM):

@@ -31,8 +31,22 @@ class ProcContext:
         self.proc = int(os.environ[ENV_PROC])
         self.nprocs = int(os.environ[ENV_NPROCS])
         self.kvs = KVSClient(os.environ[ENV_KVS])
-        # modex: publish DCN endpoint, fence, gather peers
-        self.engine = DcnCollEngine(self.proc, self.nprocs)
+        # modex: publish DCN endpoint, fence, gather peers. Transport
+        # tunables come from the btl/tcp component's MCA vars (so
+        # --mca btl_tcp_eager_limit etc. behave as in the reference).
+        from ompi_tpu.core import mca
+        from ompi_tpu.core.registry import ComponentError
+
+        ctx = mca.default_context()
+        try:
+            comp = ctx.framework("btl").select_one()
+        except ComponentError:
+            params = {}  # btl excluded (^tcp) → transport defaults
+        else:
+            # bad --mca btl_tcp_* values propagate (the reference
+            # aborts on unparseable MCA values; so do we)
+            params = comp.params(ctx.store)
+        self.engine = DcnCollEngine(self.proc, self.nprocs, **params)
         self.kvs.put(f"dcn.{self.proc}", self.engine.transport.address)
         self.kvs.fence("modex", self.proc, self.nprocs)
         self.engine.set_addresses(

@@ -58,8 +58,15 @@ class HanCollModule(CollModule):
         x = np.asarray(x)
         local = np.asarray(comm.local.allreduce(x, op))  # (ln, *s), equal rows
         partial = local[0]
-        combined = comm.dcn.allreduce(partial, op, comm.cid)
+        combined = comm.dcn.allreduce(partial, op, comm.cid,
+                                      ordered=self._ordered())
         return np.broadcast_to(combined, x.shape).copy()
+
+    def _ordered(self) -> bool:
+        """Reproducible mode forces the process-ordered DCN fold even
+        for large commutative payloads (ring re-brackets the fold)."""
+        st = self.component.store
+        return bool(st.get("coll_han_reproducible")) if st is not None else False
 
     def reduce(self, x, op: Op, root: int = 0):
         return self.allreduce(x, op)
@@ -125,7 +132,7 @@ class HanCollModule(CollModule):
     def allreduce_rows(self, x, op: Op):
         comm = self.comm
         local = np.asarray(comm.local.allreduce(x, op))[0]  # (global_n, *s)
-        return comm.dcn.allreduce(local, op, comm.cid)
+        return comm.dcn.allreduce(local, op, comm.cid, ordered=self._ordered())
 
     def reduce_scatter(self, x, op: Op, counts=None):
         if counts is not None and len(set(counts)) != 1:

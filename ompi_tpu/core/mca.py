@@ -19,6 +19,7 @@ _BUILTIN_COMPONENT_MODULES = (
     "ompi_tpu.mesh.mesh",
     "ompi_tpu.coll",
     "ompi_tpu.p2p.component",
+    "ompi_tpu.dcn.component",
     "ompi_tpu.osc.component",
     "ompi_tpu.io.component",
     "ompi_tpu.tool.monitoring",

@@ -1,0 +1,56 @@
+"""``btl/tcp`` MCA component — the DCN transport's tunables.
+
+≈ ``opal/mca/btl/tcp``'s component registration (SURVEY.md §2.3: the
+btl framework row — "the slot where a DCN transport goes in the
+rebuild").  The transport itself is :mod:`ompi_tpu.dcn.tcp`; this
+component owns its MCA variables, mirroring the reference's
+``btl_tcp_eager_limit`` / ``btl_tcp_max_send_size`` knob family and
+the pml-level eager↔rendezvous switch (SURVEY.md §2.2 pml ob1).
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.core.registry import Component, register_component
+
+
+@register_component
+class DcnTcpComponent(Component):
+    FRAMEWORK = "btl"
+    NAME = "tcp"
+    PRIORITY = 50
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "btl", "tcp", "eager_limit", 4 << 20, type="int",
+            help="Largest payload (bytes) sent as a single EAGER frame; "
+            "larger transfers use the RTS/CTS rendezvous protocol "
+            "(≈ btl_tcp_eager_limit + ob1's rendezvous switch)",
+        )
+        store.register(
+            "btl", "tcp", "frag_size", 8 << 20, type="int",
+            help="Fragment size (bytes) for rendezvous streaming "
+            "(≈ btl_tcp_max_send_size)",
+        )
+        store.register(
+            "btl", "tcp", "max_rndv", 4, type="int",
+            help="Max concurrent inbound rendezvous transfers a process "
+            "grants CTS for (flow control on DCN ingress memory)",
+        )
+        store.register(
+            "btl", "tcp", "ring_threshold", 64 << 10, type="int",
+            help="Payload size (bytes) at which DCN allreduce switches "
+            "from the ordered gather-to-root fold to the bandwidth-"
+            "optimal ring reduce-scatter + allgather schedule "
+            "(commutative ops only; ordered fold is kept for "
+            "non-commutative/reproducible reductions)",
+        )
+
+    def params(self, store) -> dict:
+        self.register_params(store)
+        return {
+            "eager_limit": store.get("btl_tcp_eager_limit"),
+            "frag_size": store.get("btl_tcp_frag_size"),
+            "max_rndv": store.get("btl_tcp_max_rndv"),
+            "ring_threshold": store.get("btl_tcp_ring_threshold"),
+        }

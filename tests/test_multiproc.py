@@ -133,10 +133,10 @@ def test_kvs_fence_timeout():
 # -- DCN engine unit tests (two engines in one process) ----------------
 
 
-def _make_engines(n):
+def _make_engines(n, **kw):
     from ompi_tpu.dcn.collops import DcnCollEngine
 
-    engines = [DcnCollEngine(p, n) for p in range(n)]
+    engines = [DcnCollEngine(p, n, **kw) for p in range(n)]
     addrs = [e.transport.address for e in engines]
     for e in engines:
         e.set_addresses(addrs)
@@ -183,6 +183,162 @@ def test_dcn_ordered_fold_is_proc_ordered():
         np.testing.assert_array_equal(r, [221.0])
     for e in engines:
         e.close()
+
+
+def _run_all(engines, work):
+    ts = [threading.Thread(target=work, args=(p,)) for p in range(len(engines))]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    for t in ts:
+        assert not t.is_alive(), "engine thread hung"
+
+
+def test_dcn_ring_allreduce():
+    """Protocol v2: payloads over ring_threshold take the ring
+    reduce-scatter + allgather schedule; result matches the sum even
+    with chunk sizes that don't divide evenly."""
+    from ompi_tpu.op import SUM
+
+    n, size = 4, 4 * 37 + 3  # non-divisible → uneven chunks
+    engines = _make_engines(n, ring_threshold=0)
+    results = [None] * n
+
+    def work(p):
+        x = np.arange(size, dtype=np.float64) + 1000.0 * p
+        results[p] = engines[p].allreduce(x, SUM, cid=1)
+
+    _run_all(engines, work)
+    expect = sum(np.arange(size, dtype=np.float64) + 1000.0 * p for p in range(n))
+    for r in results:
+        np.testing.assert_array_equal(r, expect)
+    for e in engines:
+        e.close()
+
+
+def test_dcn_ring_respects_ordered_and_noncommutative():
+    """Large non-commutative folds must keep the process-ordered
+    bracket, never the ring's."""
+    from ompi_tpu.op import create_op
+
+    o = create_op(lambda a, b: a + 2 * b, commute=False)
+    n = 3
+    engines = _make_engines(n, ring_threshold=0)
+    results = [None] * n
+
+    def work(p):
+        x = np.full(1024, 10.0 ** p)
+        results[p] = engines[p].allreduce(x, o, cid=1)
+
+    _run_all(engines, work)
+    for r in results:
+        np.testing.assert_array_equal(r, np.full(1024, 221.0))
+    for e in engines:
+        e.close()
+
+
+def test_dcn_rendezvous_fragmentation():
+    """Payloads above eager_limit move via RTS→CTS + fragments and
+    reassemble bit-exactly (64-bit lengths, preallocated landing)."""
+    from ompi_tpu.op import SUM
+
+    n = 2
+    engines = _make_engines(n, eager_limit=1 << 10, frag_size=3 << 10,
+                            max_rndv=1, ring_threshold=1 << 30)
+    rng = np.random.RandomState(7)
+    payload = rng.randn(3 * (1 << 15) + 11)  # ~786 KB, odd size
+    results = [None] * n
+
+    def work(p):
+        results[p] = engines[p].allreduce(payload + p, SUM, cid=2)
+
+    _run_all(engines, work)
+    expect = (payload + 0) + (payload + 1)  # the fold's exact bracket
+    for r in results:
+        np.testing.assert_array_equal(r, expect)
+    for e in engines:
+        e.close()
+
+
+def test_dcn_ring_with_rendezvous_chunks():
+    """Ring schedule whose per-chunk transfers themselves exceed the
+    eager limit — the two protocol layers compose."""
+    from ompi_tpu.op import SUM
+
+    n = 3
+    engines = _make_engines(n, eager_limit=1 << 12, frag_size=1 << 12,
+                            ring_threshold=0)
+    size = 3 * (1 << 13) + 5
+    results = [None] * n
+
+    def work(p):
+        results[p] = engines[p].allreduce(
+            np.full(size, float(p + 1)), SUM, cid=3
+        )
+
+    _run_all(engines, work)
+    for r in results:
+        np.testing.assert_array_equal(r, np.full(size, 6.0))
+    for e in engines:
+        e.close()
+
+
+def test_dcn_abandoned_rndv_releases_slot():
+    """A sender that dies between CTS grant and fragment completion must
+    not leak its max_rndv slot (review r2: leaked slots eventually
+    starve every future rendezvous on the process)."""
+    import json
+    import socket as sk
+
+    from ompi_tpu.dcn.tcp import TcpTransport, _HDR, _RTS
+
+    got = []
+    t2 = TcpTransport(lambda env, arr: got.append((env, arr)),
+                      eager_limit=8, max_rndv=1)
+    # a listener standing in for the dead sender's CTS return address
+    lst = sk.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    ra = "%s:%d" % lst.getsockname()
+    try:
+        host, port = t2.address.rsplit(":", 1)
+        s = sk.socket()
+        s.connect((host, int(port)))
+        meta = json.dumps({"dtype": "<f8", "shape": [100]}).encode()
+        env = json.dumps({"xid": 1, "ra": ra, "env": {"k": 1}}).encode()
+        s.sendall(_HDR.pack(_RTS, len(env), len(meta), 800) + env + meta)
+        deadline = time.time() + 10  # wait until the CTS grant lands
+        while time.time() < deadline:
+            lst.settimeout(0.2)
+            try:
+                c, _ = lst.accept()
+                c.close()
+                break
+            except sk.timeout:
+                continue
+        s.close()  # sender dies before streaming a single fragment
+        # the only slot must come back: a fresh large transfer completes
+        t1 = TcpTransport(lambda e, a: None, eager_limit=8, frag_size=64)
+        t1.send(t2.address, {"tag": 9}, np.arange(1000.0))
+        deadline = time.time() + 15
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got, "rendezvous slot was leaked by the abandoned transfer"
+        assert got[0][0]["tag"] == 9
+        np.testing.assert_array_equal(got[0][1], np.arange(1000.0))
+        t1.close()
+    finally:
+        lst.close()
+        t2.close()
+
+
+def test_dcn_frame_header_is_64bit():
+    """The v2 wire header carries payload lengths past 4 GiB (v1's !I
+    capped frames — VERDICT r1)."""
+    from ompi_tpu.dcn.tcp import _HDR
+
+    five_gib = 5 << 30
+    t, e, m, r = _HDR.unpack(_HDR.pack(0, 1, 2, five_gib))
+    assert r == five_gib
 
 
 def test_dcn_alltoall_and_allgather():
