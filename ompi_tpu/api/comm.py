@@ -19,7 +19,6 @@ unpack), the analog of ob1's convertor staging in SURVEY.md §3.3.
 
 from __future__ import annotations
 
-import itertools
 import threading
 from typing import Any, Sequence
 
@@ -54,15 +53,37 @@ _OP_CHECK_OK: set[tuple] = set()
 #: MPI_Comm_split color for "give me no communicator"
 COLOR_UNDEFINED = UNDEFINED
 
-_cid_counter = itertools.count(0)
+_cid_next = 0
 _cid_lock = threading.Lock()
 
 
 def _next_cid() -> int:
     """CID allocation (≈ ompi_comm_nextcid; trivially collision-free in
     a single controller)."""
+    global _cid_next
     with _cid_lock:
-        return next(_cid_counter)
+        c = _cid_next
+        _cid_next += 1
+        return c
+
+
+def _peek_cid() -> int:
+    """The next CID this process would hand out — the proposal each
+    process contributes to the multi-process CID agreement."""
+    with _cid_lock:
+        return _cid_next
+
+
+def _reserve_cid_block(floor: int, n: int) -> int:
+    """Multi-process CID agreement commit (≈ ompi_comm_nextcid's
+    MAX-allreduce): having agreed ``floor`` = max over processes of
+    ``_peek_cid()``, every participant reserves the identical block
+    ``[floor, floor + n)`` and jumps its local counter past it —
+    re-syncing any divergence from process-local comm construction."""
+    global _cid_next
+    with _cid_lock:
+        _cid_next = max(_cid_next, floor + n)
+        return floor
 
 
 class Comm:

@@ -32,14 +32,15 @@ from ompi_tpu.mesh.mesh import CommMesh
 from ompi_tpu.op.op import SUM, Op
 from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG, MatchingEngine
 from ompi_tpu.request import Request
-from .comm import _next_cid
+from .comm import COLOR_UNDEFINED, _next_cid, _peek_cid, _reserve_cid_block
 from .group import Group
 
 
 class MultiProcComm:
-    """A communicator spanning every process of the job (round 1: the
-    world and full-width duplicates; arbitrary sub-process groups come
-    with the sub-engine work, next round)."""
+    """A communicator spanning processes of the job: the world (built by
+    ``init`` via the modex) or any cross-process subset produced by
+    :meth:`split` — sub-comms ride a :class:`~ompi_tpu.dcn.collops.
+    DcnSubEngine` over the shared transport with a globally agreed CID."""
 
     def __init__(self, ctx: ProcContext, local_mesh: CommMesh, name: str = "MPI_COMM_WORLD"):
         self.procctx = ctx
@@ -209,15 +210,155 @@ class MultiProcComm:
 
     # -- lifecycle -------------------------------------------------------
 
+    def _agree_cids(self, n: int) -> int:
+        """Multi-process CID agreement (≈ ompi_comm_nextcid): every
+        member proposes its local next-cid, the MAX wins, and all
+        members reserve the identical block ``[max, max+n)``.  Keeps
+        per-process counters from diverging once splits create comms on
+        only some processes."""
+        proposals = self.dcn.allgather_obj(_peek_cid(), self.cid)
+        return _reserve_cid_block(max(int(p) for p in proposals), n)
+
     def dup(self, name: str = "") -> "MultiProcComm":
+        self._check()
         c = MultiProcComm.__new__(MultiProcComm)
         c.__dict__.update(self.__dict__)
-        c.cid = _next_cid()
+        c.cid = self._agree_cids(1)
         c.name = name or f"{self.name}.dup"
         c._coll = None
         c._pml = None
         c._pml_lock = threading.Lock()
         c._freed = False
+        c.dcn.register_p2p(c.cid, c._on_p2p_frame)
+        return c
+
+    def split(
+        self, colors: Sequence[int], keys: Sequence[int] | None = None
+    ) -> list["MultiProcComm | None"]:
+        """MPI_Comm_split across processes (VERDICT r1 missing #3).
+
+        Distributed SPMD view: ``colors[l]`` / ``keys[l]`` are the
+        arguments of this process's l-th LOCAL rank (every process
+        supplies its own ranks' colors, as in real MPI).  Returns one
+        entry per local rank: the sub-communicator its color landed in
+        (ranks sharing a color on this process share the object;
+        ``COLOR_UNDEFINED`` → None).
+
+        Each sub-comm gets a globally agreed CID (block reservation over
+        the parent stream), a :class:`DcnSubEngine` over the member
+        processes, a submesh of the local fabric for its local ranks,
+        and fresh han coll selection — the CID + comm_select path of
+        SURVEY.md §3.2 on the distributed substrate.
+
+        Rank order within a color is (key, parent rank).  Orderings
+        that interleave the ranks of different processes are rejected
+        (sub-comm rank space must stay process-contiguous — the same
+        slice-major layout the world uses)."""
+        self._check()
+        if len(colors) != self.local_size:
+            raise MPIArgError(
+                f"colors length {len(colors)} != local size {self.local_size}"
+            )
+        keys = [0] * self.local_size if keys is None else list(keys)
+        if len(keys) != self.local_size:
+            raise MPIArgError("keys length != local size")
+
+        # one exchange: every process's (colors, keys, cid proposal)
+        infos = self.dcn.allgather_obj(
+            {
+                "colors": [int(c) for c in colors],
+                "keys": [int(k) for k in keys],
+                "cid": _peek_cid(),
+            },
+            self.cid,
+        )
+        gcolors: list[int] = []
+        gkeys: list[int] = []
+        for it in infos:
+            gcolors.extend(it["colors"])
+            gkeys.extend(it["keys"])
+
+        by_color: dict[int, list[int]] = {}
+        for r, c in enumerate(gcolors):
+            if c == COLOR_UNDEFINED:
+                continue
+            if c < 0:
+                raise MPIArgError(f"negative color {c}")
+            by_color.setdefault(c, []).append(r)
+
+        # validate EVERY color before any construction: a failure must
+        # leave no half-registered sub-comms or burned CIDs behind
+        plans = []
+        for c, members in sorted(by_color.items()):
+            members.sort(key=lambda r: (gkeys[r], r))
+            owners = [self.locate(r)[0] for r in members]
+            member_procs: list[int] = []
+            for p in owners:
+                if member_procs and member_procs[-1] == p:
+                    continue
+                if p in member_procs:
+                    raise MPIArgError(
+                        f"split color {c}: key ordering interleaves the "
+                        "ranks of different processes — sub-comm rank "
+                        "space must stay process-contiguous"
+                    )
+                member_procs.append(p)
+            plans.append((c, members, owners, member_procs))
+
+        base = _reserve_cid_block(
+            max(int(it["cid"]) for it in infos), len(by_color)
+        )
+
+        out: list[MultiProcComm | None] = [None] * self.local_size
+        for i, (c, members, owners, member_procs) in enumerate(plans):
+            if self.proc not in member_procs:
+                continue
+            sub = self._make_sub(c, base + i, members, owners, member_procs)
+            for r, p in zip(members, owners):
+                if p == self.proc:
+                    out[self.locate(r)[1]] = sub
+        return out
+
+    def _make_sub(
+        self,
+        color: int,
+        cid: int,
+        members: Sequence[int],
+        owners: Sequence[int],
+        member_procs: Sequence[int],
+    ) -> "MultiProcComm":
+        """Construct one split result (members/owners in sub-rank
+        order; ``member_procs`` = owning processes in first-appearance
+        order, this process among them)."""
+        from ompi_tpu.dcn.collops import DcnSubEngine
+        from .comm import Comm
+
+        c = MultiProcComm.__new__(MultiProcComm)
+        c.procctx = self.procctx
+        c.nprocs = len(member_procs)
+        c.proc = member_procs.index(self.proc)
+        c.dcn = DcnSubEngine(self.dcn, member_procs)
+        c.cid = cid
+        c.name = f"{self.name}.split({color})"
+        c._freed = False
+        c.proc_sizes = [owners.count(p) for p in member_procs]
+        c.offsets = np.cumsum([0] + c.proc_sizes).tolist()
+        c.local_size = c.proc_sizes[c.proc]
+        c.local_offset = c.offsets[c.proc]
+        c.size = len(members)
+        c.group = Group(list(members))  # parent-global ranks, sub order
+        my_local = [
+            self.locate(r)[1] for r, p in zip(members, owners) if p == self.proc
+        ]
+        c.local_mesh = self.local_mesh.submesh(my_local)
+        c.local = Comm(
+            Group(range(c.local_offset, c.local_offset + c.local_size)),
+            c.local_mesh,
+            name=f"{c.name}.local{c.proc}",
+        )
+        c._coll = None
+        c._pml = None
+        c._pml_lock = threading.Lock()
         c.dcn.register_p2p(c.cid, c._on_p2p_frame)
         return c
 

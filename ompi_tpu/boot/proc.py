@@ -38,8 +38,13 @@ class ProcContext:
         from ompi_tpu.core.registry import ComponentError
 
         ctx = mca.default_context()
+        fw = ctx.framework("btl")
+        # open() first: a mistyped explicit include (--mca btl tpc) must
+        # abort here, as the reference does — only AFTER a clean open is
+        # "no component" a legitimate state (^tcp exclusion)
+        fw.open()
         try:
-            comp = ctx.framework("btl").select_one()
+            comp = fw.select_one()
         except ComponentError:
             params = {}  # btl excluded (^tcp) → transport defaults
         else:

@@ -197,8 +197,11 @@ class TcpTransport:
                         if st.received >= st.total:
                             with self._rndv_lock:
                                 self._rndv.pop(key, None)
+                                owned = st.granted
+                                st.granted = False
                             conn_keys.discard(key)
-                            self._rndv_slots.release()
+                            if owned:
+                                self._rndv_slots.release()
                             self._deliver(st.env, st.arr)
                     else:
                         raise KeyError(f"bad dcn frame type {ftype}")
@@ -232,11 +235,15 @@ class TcpTransport:
                 st = self._rndv.pop(key, None)
                 if st is None:
                     continue
-                # cancelled/granted flip under the same lock grant()
-                # checks them under: exactly one side releases the slot
+                # ``granted`` means "slot held and not yet returned";
+                # whoever returns it clears the flag under this lock, so
+                # exactly one of _abandon / grant's error path /
+                # completion releases (double-release would corrupt the
+                # BoundedSemaphore or phantom-widen max_rndv)
                 st.cancelled = True
-                granted = st.granted
-            if granted:
+                owned = st.granted
+                st.granted = False
+            if owned:
                 self._rndv_slots.release()
 
     def _on_rts(self, env: dict, meta: bytes, total: int) -> tuple[str, int]:
@@ -264,7 +271,10 @@ class TcpTransport:
                 with self._rndv_lock:
                     self._rndv.pop(key, None)
                     st.cancelled = True
-                self._rndv_slots.release()
+                    owned = st.granted
+                    st.granted = False
+                if owned:
+                    self._rndv_slots.release()
 
         threading.Thread(target=grant, daemon=True).start()
         return key
@@ -321,10 +331,7 @@ class TcpTransport:
                     _HDR.pack(_RTS, len(rts_env), len(meta), arr.nbytes)
                     + rts_env + meta
                 )
-            if not ev.wait(timeout=600.0):
-                raise ConnectionError(
-                    f"dcn rendezvous: no CTS from {address} within 600s"
-                )
+            self._await_cts(ev, sock, address)
         finally:
             with self._cts_lock:
                 self._cts_events.pop(xid, None)
@@ -337,8 +344,47 @@ class TcpTransport:
                 sock.sendall(_HDR.pack(_FRAG, len(env_b), 0, len(chunk)) + env_b)
                 sock.sendall(chunk)
 
+    def _await_cts(self, ev: threading.Event, sock: socket.socket,
+                   address: str, timeout: float = 600.0) -> None:
+        """Block until the peer's CTS lands, but stay sensitive to the
+        two conditions that mean it never will: transport close (close()
+        wakes every waiter) and peer death (the never-read outbound
+        socket turning readable means EOF/reset — this surfaces a dead
+        peer in ~1s instead of the full grant timeout, keeping failure
+        detection latency comparable to the eager/recv paths)."""
+        import select
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not ev.wait(timeout=1.0):
+            if not self._running:
+                raise ConnectionError(
+                    "dcn rendezvous: transport closed while awaiting CTS"
+                )
+            readable, _, _ = select.select([sock], [], [], 0)
+            if readable:
+                try:
+                    dead = sock.recv(1, socket.MSG_PEEK) == b""
+                except OSError:
+                    dead = True
+                if dead:
+                    raise ConnectionError(
+                        f"dcn rendezvous: peer {address} died before CTS"
+                    )
+            if time.monotonic() > deadline:
+                raise ConnectionError(
+                    f"dcn rendezvous: no CTS from {address} within {timeout}s"
+                )
+        if not self._running:
+            raise ConnectionError(
+                "dcn rendezvous: transport closed while awaiting CTS"
+            )
+
     def close(self) -> None:
         self._running = False
+        with self._cts_lock:
+            for ev in self._cts_events.values():
+                ev.set()
         try:
             self._listen.close()
         except OSError:

@@ -1,0 +1,99 @@
+"""tpurun worker: cross-process comm_split (VERDICT r1 missing #3).
+
+Launched with -np 3 x 2 local devices = 6 global ranks.  Exercises:
+odd/even split (every process contributes one rank to each sub-comm),
+collectives + p2p on the sub-comms, COLOR_UNDEFINED exclusion of a
+whole process, dup of a sub-comm (CID agreement on the sub-engine),
+and a chained split (sub-comm of a sub-comm).
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.api.group import UNDEFINED
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+ln = world.local_size
+n = world.size
+assert n == 6 and ln == 2, (n, ln)
+
+# -- odd/even split ----------------------------------------------------
+# proc p owns global ranks 2p, 2p+1 -> local rank 0 is even, 1 is odd
+colors = [(world.local_offset + l) % 2 for l in range(ln)]
+subs = world.split(colors)
+even, odd = subs[0], subs[1]
+assert even is not None and odd is not None and even is not odd
+assert even.size == 3 and odd.size == 3, (even.size, odd.size)
+assert even.local_size == 1 and odd.local_size == 1
+assert even.nprocs == 3 and even.proc == p, (even.nprocs, even.proc)
+assert even.coll.providers["allreduce"] == "han", even.coll.providers
+
+# global rank r holds r+1; even members 0,2,4 -> 9; odd 1,3,5 -> 12
+xe = np.full((1, 4), world.local_offset + 1, np.float64)
+xo = np.full((1, 4), world.local_offset + 2, np.float64)
+assert np.array_equal(even.allreduce(xe, SUM), np.full((1, 4), 9.0))
+assert np.array_equal(odd.allreduce(xo, SUM), np.full((1, 4), 12.0))
+print(f"OK split_allreduce proc={p}")
+
+# bcast on the odd sub-comm from sub-rank 2 (global rank 5, proc 2)
+b = odd.bcast(np.full((1, 3), float(world.local_offset + 2)), root=2)
+assert np.array_equal(b, np.full((1, 3), 6.0)), b
+print(f"OK split_bcast proc={p}")
+
+# allgather: even sub-ranks in (key, parent-rank) order -> 1, 3, 5
+ag = even.allgather(np.full((1, 2), float(world.local_offset + 1)))
+assert ag.shape == (1, 3, 2), ag.shape
+assert np.array_equal(ag[0, :, 0], [1.0, 3.0, 5.0]), ag[0, :, 0]
+print(f"OK split_allgather proc={p}")
+
+# alltoall on the even sub-comm: x[0, j] = 10*me + j
+me = even.local_offset
+a2a = even.alltoall((10.0 * me + np.arange(3.0))[None, :, None])
+assert np.array_equal(a2a[0, :, 0], 10.0 * np.arange(3.0) + me), a2a
+print(f"OK split_alltoall proc={p}")
+
+# p2p on a sub-comm crosses processes with sub-rank addressing
+if even.proc == 0:
+    even.send(np.arange(4.0) + 50, source=0, dest=2, tag=9)
+if even.proc == 2:
+    pay, st = even.recv(dest=2, source=0, tag=9)
+    assert np.array_equal(pay, np.arange(4.0) + 50)
+    assert st.source == 0 and st.tag == 9
+    print(f"OK split_p2p proc={p}")
+
+# -- COLOR_UNDEFINED excludes a whole process --------------------------
+colors2 = [0 if p < 2 else UNDEFINED] * ln
+subs2 = world.split(colors2)
+if p < 2:
+    sub = subs2[0]
+    assert sub is subs2[1] and sub.size == 4 and sub.nprocs == 2
+    out = sub.allreduce(np.ones((2, 2)), SUM)
+    assert np.array_equal(out, np.full((2, 2), 4.0)), out
+    sub2 = sub.dup()  # CID agreement over the sub-engine
+    assert np.array_equal(sub2.allreduce(np.ones((2, 1)), SUM),
+                          np.full((2, 1), 4.0))
+    # chained split: halve the 4-rank sub-comm into pairs by process
+    pair = sub.split([sub.proc] * 2)[0]
+    assert pair.size == 2 and pair.nprocs == 1
+    assert np.array_equal(pair.allreduce(np.ones((2, 1)), SUM),
+                          np.full((2, 1), 2.0))
+    sub2.free()
+else:
+    assert subs2 == [None, None], subs2
+print(f"OK split_undefined proc={p}")
+
+# the world still works after splits (CID isolation held)
+w = world.allreduce(np.ones((ln, 2)), SUM)
+assert np.array_equal(w, np.full((ln, 2), 6.0)), w
+print(f"OK split_world_after proc={p}")
+
+api.finalize()
+print(f"OK finalize proc={p}")
