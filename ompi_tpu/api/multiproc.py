@@ -73,7 +73,18 @@ class MultiProcComm:
         self._coll: CollTable | None = None
         self._pml: MatchingEngine | None = None
         self._pml_lock = threading.Lock()
+        self._nbc_count = 0
+        self._nbc_lock = threading.Lock()
         self.dcn.register_p2p(self.cid, self._on_p2p_frame)
+
+    def _next_nbc(self) -> int:
+        """Per-comm non-blocking-collective issue counter: identical on
+        every process by MPI's same-issue-order rule, it names each
+        i-collective's private DCN stream (``<cid>#nbc<k>``)."""
+        with self._nbc_lock:
+            k = self._nbc_count
+            self._nbc_count += 1
+            return k
 
     # -- rank geometry ---------------------------------------------------
 
@@ -145,6 +156,24 @@ class MultiProcComm:
 
     def barrier(self) -> None:
         self.coll.lookup("barrier")()
+
+    def __getattr__(self, name: str):
+        """Non-blocking (i*) and persistent (*_init) variants of every
+        collective, served from the coll table like their blocking
+        counterparts (the same derivation Comm gets from coll/xla)."""
+        from ompi_tpu.coll.module import COLL_OPS
+
+        if (name.startswith("i") and name[1:] in COLL_OPS) or (
+            name.endswith("_init") and name[: -len("_init")] in COLL_OPS
+        ):
+            try:
+                return self.coll.lookup(name)
+            except Exception as e:
+                # __getattr__ must surface failures (freed comm, coll
+                # selection) as AttributeError so hasattr/getattr
+                # probes keep their Python contract
+                raise AttributeError(name) from e
+        raise AttributeError(name)
 
     def allgatherv(self, blocks: Sequence[np.ndarray]):
         return self.coll.lookup("allgatherv")(blocks)
@@ -228,6 +257,8 @@ class MultiProcComm:
         c._coll = None
         c._pml = None
         c._pml_lock = threading.Lock()
+        c._nbc_count = 0
+        c._nbc_lock = threading.Lock()
         c._freed = False
         c.dcn.register_p2p(c.cid, c._on_p2p_frame)
         return c
@@ -359,6 +390,8 @@ class MultiProcComm:
         c._coll = None
         c._pml = None
         c._pml_lock = threading.Lock()
+        c._nbc_count = 0
+        c._nbc_lock = threading.Lock()
         c.dcn.register_p2p(c.cid, c._on_p2p_frame)
         return c
 

@@ -352,7 +352,7 @@ class TcpTransport:
         socket turning readable means EOF/reset — this surfaces a dead
         peer in ~1s instead of the full grant timeout, keeping failure
         detection latency comparable to the eager/recv paths)."""
-        import select
+        import selectors
         import time
 
         deadline = time.monotonic() + timeout
@@ -361,7 +361,11 @@ class TcpTransport:
                 raise ConnectionError(
                     "dcn rendezvous: transport closed while awaiting CTS"
                 )
-            readable, _, _ = select.select([sock], [], [], 0)
+            # selectors (epoll/poll), not select(): fds >= FD_SETSIZE
+            # would make select() raise in fd-heavy processes
+            with selectors.DefaultSelector() as sel:
+                sel.register(sock, selectors.EVENT_READ)
+                readable = sel.select(timeout=0)
             if readable:
                 try:
                     dead = sock.recv(1, socket.MSG_PEEK) == b""

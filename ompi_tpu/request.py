@@ -119,6 +119,28 @@ class ArrayRequest(Request):
         return self._arrays
 
 
+class FutureRequest(Request):
+    """Request over work progressing on a background thread — the
+    libnbc model (SURVEY.md §3.4): the host/DCN half of a hierarchical
+    collective runs off the caller's thread, so caller compute overlaps
+    communication.  Wraps a ``concurrent.futures.Future``; a failure in
+    the background collective re-raises at wait()/test() completion,
+    matching the reference's error-on-completion semantics."""
+
+    def __init__(self, future):
+        super().__init__()
+        self._future = future
+
+    def _poll(self) -> bool:
+        return self._future.done()
+
+    def _block(self) -> None:
+        self._future.exception()  # waits without raising; _finalize raises
+
+    def _finalize(self) -> Any:
+        return self._future.result()
+
+
 class PersistentRequest(Request):
     """MPI persistent collective (MPI_Allreduce_init → MPI_Start →
     MPI_Wait, repeatable).  Holds the compiled dispatcher; ``start()``

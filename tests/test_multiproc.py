@@ -95,6 +95,20 @@ def test_tpurun_comm_split():
         assert len(hits) == count, f"{check}: {hits}\n{out}"
 
 
+def test_tpurun_nonblocking_progress():
+    """i-collectives must return before the collective completes
+    (background DCN progress): proc 1 joins the allreduce only after a
+    p2p token proc 0 sends AFTER issuing — blocking-wrapped i-variants
+    deadlock (VERDICT r1 missing #4)."""
+    res = run_tpurun(2, REPO / "tests" / "workers" / "mp_nbc_worker.py",
+                     cpu_devices=2, timeout=150)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("nbc_progress", "nbc_multi", "nbc_persistent", "finalize"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 2, f"{check}: {hits}\n{out}"
+
+
 def test_tpurun_bad_btl_include_aborts(tmp_path):
     """--mca btl <typo> must abort the job (reference behavior), not
     silently boot with transport defaults (review r2)."""

@@ -1,0 +1,68 @@
+"""tpurun worker: REAL non-blocking collectives over DCN (VERDICT r1
+missing #4).
+
+The discriminator: proc 0 issues iallreduce and must return BEFORE the
+collective can complete (proc 1 only joins it after receiving a p2p
+token that proc 0 sends post-issue).  A blocking-wrapped "i"-variant
+deadlocks here — the classic MPI nonblocking-progress litmus.
+
+Also: multiple outstanding i-collectives on private streams, a blocking
+collective interleaved between issue and wait, and reverse-order waits.
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+ln = world.local_size
+n = world.size
+assert world.nprocs == 2
+
+x = np.full((ln, 8), float(world.local_offset + 1))
+
+# -- issue-before-peer-joins: blocking ivariants deadlock here ---------
+if p == 0:
+    r = world.iallreduce(x, SUM)
+    assert not isinstance(r, type(None))
+    world.send(np.array([1.0]), source=0, dest=n - 1, tag=77)
+    out = r.wait()
+else:
+    tok, _ = world.recv(dest=n - 1, source=0, tag=77)
+    assert tok[0] == 1.0
+    out = world.iallreduce(x, SUM).wait()
+expect = sum(
+    world.proc_sizes[q] * float(world.offsets[q] + 1) for q in range(2)
+)
+assert np.array_equal(out, np.full((ln, 8), expect)), out
+print(f"OK nbc_progress proc={p}")
+
+# -- multiple outstanding + interleaved blocking + reverse-order wait --
+r1 = world.iallreduce(np.ones((ln, 4)), SUM)
+r2 = world.iallgather(np.full((ln, 2), float(p)))
+b = world.bcast(np.full((ln, 3), float(world.local_offset)), root=0)
+assert np.array_equal(b, np.zeros((ln, 3))), b
+g = r2.wait()  # reverse order: r2 before r1
+assert g.shape == (ln, n, 2), g.shape
+s = r1.wait()
+assert np.array_equal(s, np.full((ln, 4), float(n))), s
+assert r1.test() and r2.test()
+print(f"OK nbc_multi proc={p}")
+
+# -- persistent init/start over the NBC path ---------------------------
+pr = world.coll.lookup("allreduce_init")(np.ones((ln, 2)), SUM)
+for _ in range(2):
+    got = pr.start().wait()
+    assert np.array_equal(got, np.full((ln, 2), float(n))), got
+print(f"OK nbc_persistent proc={p}")
+
+api.finalize()
+print(f"OK finalize proc={p}")
