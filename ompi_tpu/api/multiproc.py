@@ -122,9 +122,6 @@ class MultiProcComm:
     def allreduce(self, x, op: Op = SUM):
         return self.coll.lookup("allreduce")(x, op)
 
-    def iallreduce(self, x, op: Op = SUM) -> Request:
-        return self.coll.lookup("iallreduce")(x, op)
-
     def bcast(self, x, root: int = 0):
         return self.coll.lookup("bcast")(x, root)
 
@@ -166,12 +163,15 @@ class MultiProcComm:
         if (name.startswith("i") and name[1:] in COLL_OPS) or (
             name.endswith("_init") and name[: -len("_init")] in COLL_OPS
         ):
+            from ompi_tpu.core.errors import MPIInternalError
+
             try:
                 return self.coll.lookup(name)
-            except Exception as e:
-                # __getattr__ must surface failures (freed comm, coll
-                # selection) as AttributeError so hasattr/getattr
-                # probes keep their Python contract
+            except MPIInternalError as e:
+                # slot genuinely unserved → AttributeError keeps the
+                # hasattr/getattr probe contract; anything else (freed
+                # comm, selection failure) propagates like the blocking
+                # entry points' errors do
                 raise AttributeError(name) from e
         raise AttributeError(name)
 

@@ -274,7 +274,7 @@ class TunedCollModule(CollModule):
         wrapper.__name__ = f"tuned_{slot}"
         return wrapper
 
-    def resolve(self, base: str, *args):
+    def resolve(self, base: str, *args, donate: bool = False):
         """Fast-path resolution: run the decision once for this call
         signature, then hand the forced choice to the inner module's
         resolver.  The compiled callable the api layer caches therefore
@@ -282,7 +282,7 @@ class TunedCollModule(CollModule):
         (the cache keys on the store version)."""
         overrides = self._decide(base, args, {})
         with self.inner.forced(**overrides):
-            return self.inner.resolve(base, *args)
+            return self.inner.resolve(base, *args, donate=donate)
 
     def _decide(self, coll: str, args, kwargs) -> dict[str, int]:
         var_enum = _ALGO_VAR.get(coll)

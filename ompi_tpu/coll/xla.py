@@ -95,9 +95,14 @@ class XlaCollModule(CollModule):
             self._cache[key] = fn
         return fn
 
-    def _spmd(self, per_device_fn, nin: int = 1):
+    def _spmd(self, per_device_fn, nin: int = 1, donate: bool = False):
         """jit(shard_map(...)) over the comm mesh: each input/output is
-        rank-major with leading axis = comm size."""
+        rank-major with leading axis = comm size.
+
+        ``donate=True`` builds the arena variant (donate_argnums=0):
+        XLA writes the output into the staged input's HBM allocation —
+        only used for shape-preserving ops on framework-owned staged
+        buffers (never user arrays; MPI preserves sendbuf)."""
         mesh = self.comm.mesh.mesh
         specs = [P(AXIS)] * nin
         f = shard_map(
@@ -106,6 +111,9 @@ class XlaCollModule(CollModule):
             in_specs=tuple(specs) if nin > 1 else specs[0],
             out_specs=P(AXIS),
         )
+        if donate:
+            self.comm.mesh.arena.note_donation()
+            return jax.jit(f, donate_argnums=0)
         return jax.jit(f)
 
     def _n(self) -> int:
@@ -135,35 +143,37 @@ class XlaCollModule(CollModule):
     # construction) happens ONCE per distinct call signature, matching
     # the reference's zero-setup hot loop (SURVEY.md §3.3).
 
-    def resolve(self, base: str, *args):
+    def resolve(self, base: str, *args, donate: bool = False):
         if base == "allreduce":
-            return self._allreduce_fn(args[0], args[1])
+            return self._allreduce_fn(args[0], args[1], donate)
         if base == "bcast":
-            return self._bcast_fn(args[0], args[1] if len(args) > 1 else 0)
+            return self._bcast_fn(args[0], args[1] if len(args) > 1 else 0,
+                                  donate)
         if base == "reduce":
             return self._reduce_fn(args[0], args[1],
-                                   args[2] if len(args) > 2 else 0)
+                                   args[2] if len(args) > 2 else 0, donate)
         if base == "allgather":
             return self._allgather_fn(args[0])
         if base == "gather":
             return self._gather_fn(args[0], args[1] if len(args) > 1 else 0)
         if base == "scatter":
-            return self._scatter_fn(args[0], args[1] if len(args) > 1 else 0)
+            return self._scatter_fn(args[0], args[1] if len(args) > 1 else 0,
+                                    donate)
         if base == "reduce_scatter_block":
             return self._reduce_scatter_block_fn(args[0], args[1])
         if base == "alltoall":
-            return self._alltoall_fn(args[0])
+            return self._alltoall_fn(args[0], donate)
         if base == "scan":
-            return self._scan_fn(args[0], args[1], False)
+            return self._scan_fn(args[0], args[1], False, donate)
         if base == "exscan":
-            return self._scan_fn(args[0], args[1], True)
+            return self._scan_fn(args[0], args[1], True, donate)
         return None
 
     # ==================================================================
     # allreduce
     # ==================================================================
 
-    def _allreduce_fn(self, x, op: Op):
+    def _allreduce_fn(self, x, op: Op, donate: bool = False):
         n = self._n()
         algo = self._algo("allreduce_algorithm", ALLREDUCE_ALGOS)
         if self._reproducible():
@@ -180,7 +190,7 @@ class XlaCollModule(CollModule):
         seg = self._segcount()
         # op keyed by IDENTITY (Op is identity-hashed): two user ops may
         # share a name but carry different kernels
-        key = ("allreduce", algo, x.shape, str(x.dtype), op, seg)
+        key = ("allreduce", algo, x.shape, str(x.dtype), op, seg, donate)
 
         def build():
             impl = {
@@ -191,7 +201,7 @@ class XlaCollModule(CollModule):
                 ALLREDUCE_ALGOS["rabenseifner"]: lambda v: algos.allreduce_rabenseifner(v, op, n),
                 ALLREDUCE_ALGOS["ordered_linear"]: lambda v: algos.allreduce_ordered_linear(v, op, n),
             }[algo]
-            return self._spmd(lambda v: impl(v[0])[None])
+            return self._spmd(lambda v: impl(v[0])[None], donate=donate)
 
         return self._compiled(key, build)
 
@@ -209,13 +219,13 @@ class XlaCollModule(CollModule):
     # bcast
     # ==================================================================
 
-    def _bcast_fn(self, x, root: int):
+    def _bcast_fn(self, x, root: int, donate: bool = False):
         n = self._n()
         algo = self._algo("bcast_algorithm", BCAST_ALGOS)
         if algo == BCAST_ALGOS["auto"]:
             algo = BCAST_ALGOS["direct"]
         seg = self._segcount()
-        key = ("bcast", algo, x.shape, str(x.dtype), root, seg)
+        key = ("bcast", algo, x.shape, str(x.dtype), root, seg, donate)
 
         def build():
             impl = {
@@ -223,7 +233,7 @@ class XlaCollModule(CollModule):
                 BCAST_ALGOS["binomial"]: lambda v: algos.bcast_binomial(v, n, root),
                 BCAST_ALGOS["pipeline"]: lambda v: algos.bcast_pipeline(v, n, root, seg),
             }[algo]
-            return self._spmd(lambda v: impl(v[0])[None])
+            return self._spmd(lambda v: impl(v[0])[None], donate=donate)
 
         return self._compiled(key, build)
 
@@ -241,21 +251,21 @@ class XlaCollModule(CollModule):
     # reduce
     # ==================================================================
 
-    def _reduce_fn(self, x, op: Op, root: int):
+    def _reduce_fn(self, x, op: Op, root: int, donate: bool = False):
         n = self._n()
         algo = self._algo("reduce_algorithm", REDUCE_ALGOS)
         if self._reproducible():
             algo = REDUCE_ALGOS["ordered"]
         if algo == REDUCE_ALGOS["auto"]:
             algo = REDUCE_ALGOS["ordered"] if not op.commutative else REDUCE_ALGOS["binomial"]
-        key = ("reduce", algo, x.shape, str(x.dtype), op, root)
+        key = ("reduce", algo, x.shape, str(x.dtype), op, root, donate)
 
         def build():
             impl = {
                 REDUCE_ALGOS["binomial"]: lambda v: algos.reduce_binomial(v, op, n, root),
                 REDUCE_ALGOS["ordered"]: lambda v: algos.reduce_ordered(v, op, n, root),
             }[algo]
-            return self._spmd(lambda v: impl(v[0])[None])
+            return self._spmd(lambda v: impl(v[0])[None], donate=donate)
 
         return self._compiled(key, build)
 
@@ -328,14 +338,16 @@ class XlaCollModule(CollModule):
     # scatter  (root's (n,*s) rows → rank r gets row r)
     # ==================================================================
 
-    def _scatter_fn(self, x, root: int):
+    def _scatter_fn(self, x, root: int, donate: bool = False):
         # Rank-major staging already placed row r on device r, so the
         # device-side scatter is the identity program: the *resharding*
         # (stage_in / jit placement) is the scatter, which is exactly
         # how a single-controller fabric does it — XLA moves root's rows
         # during layout assignment, not via an explicit collective.
-        key = ("scatter", 0, x.shape, str(x.dtype), root)
-        return self._compiled(key, lambda: self._spmd(lambda v: v))
+        key = ("scatter", 0, x.shape, str(x.dtype), root, donate)
+        return self._compiled(
+            key, lambda: self._spmd(lambda v: v, donate=donate)
+        )
 
     def scatter(self, x, root: int = 0):
         """x: (n, *s) rank-major where row layout is root's sendbuf;
@@ -434,19 +446,19 @@ class XlaCollModule(CollModule):
     # alltoall
     # ==================================================================
 
-    def _alltoall_fn(self, x):
+    def _alltoall_fn(self, x, donate: bool = False):
         n = self._n()
         algo = self._algo("alltoall_algorithm", ALLTOALL_ALGOS)
         if algo == ALLTOALL_ALGOS["auto"]:
             algo = ALLTOALL_ALGOS["direct"]
-        key = ("alltoall", algo, x.shape, str(x.dtype))
+        key = ("alltoall", algo, x.shape, str(x.dtype), donate)
 
         def build():
             impl = {
                 ALLTOALL_ALGOS["direct"]: lambda v: algos.alltoall_direct(v, n),
                 ALLTOALL_ALGOS["pairwise"]: lambda v: algos.alltoall_pairwise(v, n),
             }[algo]
-            return self._spmd(lambda v: impl(v[0])[None])
+            return self._spmd(lambda v: impl(v[0])[None], donate=donate)
 
         return self._compiled(key, build)
 
@@ -502,13 +514,14 @@ class XlaCollModule(CollModule):
     # scan / exscan
     # ==================================================================
 
-    def _scan_fn(self, x, op: Op, exclusive: bool):
+    def _scan_fn(self, x, op: Op, exclusive: bool, donate: bool = False):
         n = self._n()
-        key = ("scan", exclusive, x.shape, str(x.dtype), op)
+        key = ("scan", exclusive, x.shape, str(x.dtype), op, donate)
 
         def build():
             return self._spmd(
-                lambda v: algos.scan_ordered(v[0], op, n, exclusive=exclusive)[None]
+                lambda v: algos.scan_ordered(v[0], op, n, exclusive=exclusive)[None],
+                donate=donate,
             )
 
         return self._compiled(key, build)

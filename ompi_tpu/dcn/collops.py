@@ -109,6 +109,10 @@ class DcnCollEngine:
                 self._queues[key] = q
             return q
 
+    def _drop_queue(self, key: tuple) -> None:
+        with self._qlock:
+            self._queues.pop(key, None)
+
     def _on_frame(self, env: dict, payload: np.ndarray) -> None:
         if env.get("kind") == "p2p":
             cid = env.get("cid")
@@ -137,8 +141,9 @@ class DcnCollEngine:
         return self._recv_full(src, cid, seq, timeout)[1]
 
     def _recv_full(self, src: int, cid: int, seq: int, timeout: float = 120.0):
+        key = (cid, seq, src)
         try:
-            return self._queue((cid, seq, src)).get(timeout=timeout)
+            got = self._queue(key).get(timeout=timeout)
         except queue.Empty:
             from ompi_tpu.core.errors import MPIInternalError
 
@@ -147,6 +152,12 @@ class DcnCollEngine:
                 f"for proc {src} (cid={cid}, seq={seq}) — peer dead or "
                 f"collective order mismatch"
             ) from None
+        # (cid, seq, src) keys are single-use (seqs are monotonic per
+        # stream), and the producer's put necessarily preceded this get
+        # — drop the queue so long-running jobs (and the per-instance
+        # NBC streams) don't grow the dict without bound
+        self._drop_queue(key)
+        return got
 
     def send_p2p(self, dst_proc: int, envelope: dict, payload: np.ndarray) -> None:
         envelope = dict(envelope)
@@ -353,6 +364,9 @@ class DcnSubEngine(DcnCollEngine):
 
     def _queue(self, key: tuple) -> queue.Queue:
         return self.parent._queue(key)
+
+    def _drop_queue(self, key: tuple) -> None:
+        self.parent._drop_queue(key)
 
     def register_p2p(self, cid: int, fn: Callable) -> None:
         self.parent.register_p2p(cid, fn)

@@ -44,6 +44,10 @@ class CommMesh:
         self.device_set = frozenset(self.devices)
         self.mesh = Mesh(np.array(self.devices, dtype=object), (AXIS,))
         self._sharding_cache: dict[tuple, NamedSharding] = {}
+        from .arena import HbmArena
+
+        #: staging manager (mpool/rcache analog — SURVEY.md §2.3)
+        self.arena = HbmArena()
 
     @property
     def size(self) -> int:
@@ -71,13 +75,13 @@ class CommMesh:
 
     def stage_in(self, host_array: np.ndarray) -> jax.Array:
         """Host rank-major (n, ...) buffer → device array sharded one
-        rank per device."""
+        rank per device, staged through the HBM arena."""
         if host_array.shape[0] != self.size:
             raise MPIArgError(
                 f"rank-major buffer leading dim {host_array.shape[0]} != "
                 f"comm size {self.size}"
             )
-        return jax.device_put(host_array, self.rank_sharding())
+        return self.arena.stage_in(host_array, self.rank_sharding())
 
     def stage_out(self, device_array: jax.Array) -> np.ndarray:
         return np.asarray(jax.device_get(device_array))
@@ -121,6 +125,13 @@ class TpuAcceleratorComponent(Component):
             "enumeration order, ICI-contiguous on TPU) or 'id' (sort by id)",
             enum=None,
         ).value
+        store.register(
+            "accelerator", "tpu", "donate_staged", True,
+            help="Donate framework-staged input buffers to shape-"
+            "preserving compiled collectives so XLA writes results into "
+            "the same HBM allocation (mpool-style reuse; user jax "
+            "arrays are never donated)",
+        )
 
     def open(self, store) -> bool:
         try:

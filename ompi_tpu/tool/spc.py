@@ -27,6 +27,7 @@ _BASE_KNOWN = (
     "send", "send_bytes", "irecv",
     "put", "put_bytes", "get", "get_bytes", "accumulate",
     "file_write_bytes", "file_read_bytes",
+    "arena_stage_in", "arena_stage_bytes", "arena_donations",
 )
 
 _known_cache: tuple[str, ...] | None = None

@@ -418,7 +418,8 @@ def test_segcount_change_takes_effect(world):
         np.testing.assert_array_equal(out1[0], x.sum(0))
         np.testing.assert_array_equal(out2[0], x.sum(0))
         mod = [m for m in world.coll.modules if type(m).__name__ == "XlaCollModule"][0]
-        seg_keys = {k[-1] for k in mod._cache if k[0] == "allreduce" and k[1] == 3}
+        # key tail is (..., seg, donate) since the arena variants landed
+        seg_keys = {k[-2] for k in mod._cache if k[0] == "allreduce" and k[1] == 3}
         assert {64, 7} <= seg_keys
     finally:
         store.set("coll_xla_segcount", 1 << 16)

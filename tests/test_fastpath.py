@@ -32,7 +32,9 @@ def rank_data(shape, dtype, seed=0):
 def test_fast_path_caches_and_reuses(world):
     x = rank_data((16,), np.float32)
     out1 = world.allreduce(x, SUM)
-    assert ("allreduce", SUM, (N, 16), np.dtype(np.float32)) in world._fast
+    # host-staged signature (trailing True = framework-owned buffer →
+    # arena donation variant)
+    assert ("allreduce", SUM, (N, 16), np.dtype(np.float32), True) in world._fast
     out2 = world.allreduce(x, SUM)
     np.testing.assert_allclose(out1, out2)
 
