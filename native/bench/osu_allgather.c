@@ -1,0 +1,46 @@
+/* OSU-style MPI_Allgather latency sweep (original implementation). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  long max_bytes = argc > 1 ? atol(argv[1]) : (1 << 18);
+  int iters = argc > 2 ? atoi(argv[2]) : 50, warmup = 5;
+  char *sb = (char *)malloc((size_t)max_bytes);
+  char *rb = (char *)malloc((size_t)max_bytes * (size_t)size);
+
+  if (rank == 0) printf("# OSU-style allgather: bytes  us\n");
+  for (long nbytes = 1; nbytes <= max_bytes; nbytes *= 8) {
+    for (long i = 0; i < nbytes; i++) sb[i] = (char)((rank + i) & 0x7f);
+    for (int i = 0; i < warmup; i++)
+      MPI_Allgather(sb, (int)nbytes, MPI_BYTE, rb, (int)nbytes, MPI_BYTE,
+                    MPI_COMM_WORLD);
+    MPI_Barrier(MPI_COMM_WORLD);
+    double t0 = MPI_Wtime();
+    for (int i = 0; i < iters; i++)
+      MPI_Allgather(sb, (int)nbytes, MPI_BYTE, rb, (int)nbytes, MPI_BYTE,
+                    MPI_COMM_WORLD);
+    double local = (MPI_Wtime() - t0) / iters * 1e6, worst = 0.0;
+    MPI_Reduce(&local, &worst, 1, MPI_DOUBLE, MPI_MAX, 0, MPI_COMM_WORLD);
+    if (rank == 0) printf("%10ld %12.2f\n", nbytes, worst);
+    /* correctness backstop on the final size */
+    int ok = 1;
+    for (int r = 0; r < size; r++)
+      for (long i = 0; i < nbytes && i < 32; i++)
+        ok &= (rb[(long)r * nbytes + i] == (char)((r + i) & 0x7f));
+    if (!ok) {
+      fprintf(stderr, "ALLGATHER DATA MISMATCH rank=%d\n", rank);
+      MPI_Abort(MPI_COMM_WORLD, 9);
+    }
+  }
+  printf("OSU_ALLGATHER_DONE rank=%d\n", rank);
+  free(sb);
+  free(rb);
+  MPI_Finalize();
+  return 0;
+}

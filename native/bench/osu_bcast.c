@@ -1,0 +1,43 @@
+/* OSU-style MPI_Bcast latency sweep (original implementation following
+ * the conventional OSU measurement shape: warmup + timed iterations per
+ * size, max latency across ranks reported at root). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  long max_bytes = argc > 1 ? atol(argv[1]) : (1 << 20);
+  int iters = argc > 2 ? atoi(argv[2]) : 50, warmup = 5;
+  char *buf = (char *)malloc((size_t)max_bytes);
+
+  if (rank == 0) printf("# OSU-style bcast: bytes  us\n");
+  for (long nbytes = 1; nbytes <= max_bytes; nbytes *= 8) {
+    for (long i = 0; i < nbytes; i++) buf[i] = (char)(i & 0x7f);
+    for (int i = 0; i < warmup; i++)
+      MPI_Bcast(buf, (int)nbytes, MPI_BYTE, 0, MPI_COMM_WORLD);
+    MPI_Barrier(MPI_COMM_WORLD);
+    double t0 = MPI_Wtime();
+    for (int i = 0; i < iters; i++)
+      MPI_Bcast(buf, (int)nbytes, MPI_BYTE, 0, MPI_COMM_WORLD);
+    double local = (MPI_Wtime() - t0) / iters * 1e6, worst = 0.0;
+    MPI_Reduce(&local, &worst, 1, MPI_DOUBLE, MPI_MAX, 0, MPI_COMM_WORLD);
+    if (rank == 0) printf("%10ld %12.2f\n", nbytes, worst);
+  }
+  /* correctness backstop: everyone ends with root's bytes */
+  int ok = 1;
+  for (long i = 0; i < max_bytes && i < 64; i++)
+    ok &= (buf[i] == (char)(i & 0x7f));
+  if (!ok) {
+    fprintf(stderr, "BCAST DATA MISMATCH rank=%d\n", rank);
+    MPI_Abort(MPI_COMM_WORLD, 9);
+  }
+  printf("OSU_BCAST_DONE rank=%d\n", rank);
+  free(buf);
+  MPI_Finalize();
+  return 0;
+}

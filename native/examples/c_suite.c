@@ -194,6 +194,175 @@ int main(int argc, char **argv) {
   MPI_Barrier(MPI_COMM_WORLD);
   printf("OK barrier rank=%d\n", rank);
 
+  /* groups: comm_group + incl/excl + union + translate + compare */
+  MPI_Group wg, gsub, rest, uni;
+  MPI_Comm_group(MPI_COMM_WORLD, &wg);
+  int gsz = 0, grk = -1;
+  MPI_Group_size(wg, &gsz);
+  MPI_Group_rank(wg, &grk);
+  CHECK(gsz == size && grk == rank, "group_basic");
+  int first[1] = {0};
+  MPI_Group_incl(wg, 1, first, &gsub);
+  MPI_Group_excl(wg, 1, first, &rest);
+  int ssz = 0, rsz = 0;
+  MPI_Group_size(gsub, &ssz);
+  MPI_Group_size(rest, &rsz);
+  CHECK(ssz == 1 && rsz == size - 1, "group_incl_excl");
+  MPI_Group_union(gsub, rest, &uni);
+  int usz = 0, cmp = -1;
+  MPI_Group_size(uni, &usz);
+  MPI_Group_compare(uni, wg, &cmp);
+  CHECK(usz == size && (cmp == MPI_IDENT || cmp == MPI_SIMILAR),
+        "group_union_compare");
+  int tr_in[1] = {0}, tr_out[1] = {-5};
+  MPI_Group_translate_ranks(gsub, 1, tr_in, wg, tr_out);
+  CHECK(tr_out[0] == 0, "group_translate");
+  MPI_Group_free(&gsub);
+  MPI_Group_free(&rest);
+  MPI_Group_free(&uni);
+
+  /* comm_create over the even-rank group */
+  MPI_Group evens;
+  int *er = (int *)malloc(sizeof(int) * (size_t)((size + 1) / 2));
+  int ne = 0;
+  for (int r = 0; r < size; r += 2) er[ne++] = r;
+  MPI_Group_incl(wg, ne, er, &evens);
+  MPI_Comm ec;
+  MPI_Comm_create(MPI_COMM_WORLD, evens, &ec);
+  if (rank % 2 == 0) {
+    int esz = 0, erk = -1;
+    CHECK(ec != MPI_COMM_NULL, "comm_create_member");
+    MPI_Comm_size(ec, &esz);
+    MPI_Comm_rank(ec, &erk);
+    CHECK(esz == ne && erk == rank / 2, "comm_create_geometry");
+    double ev = 1.0, es = 0.0;
+    MPI_Allreduce(&ev, &es, 1, MPI_DOUBLE, MPI_SUM, ec);
+    CHECK(es == (double)ne, "comm_create_allreduce");
+    MPI_Comm_free(&ec);
+  } else {
+    CHECK(ec == MPI_COMM_NULL, "comm_create_member");
+    printf("OK comm_create_geometry rank=%d\n", rank);
+    printf("OK comm_create_allreduce rank=%d\n", rank);
+  }
+  MPI_Group_free(&evens);
+  MPI_Group_free(&wg);
+  free(er);
+
+  /* errhandler get/set */
+  MPI_Errhandler eh = MPI_ERRHANDLER_NULL;
+  MPI_Comm_get_errhandler(MPI_COMM_WORLD, &eh);
+  CHECK(eh == MPI_ERRORS_ARE_FATAL, "errhandler_default");
+  MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+  MPI_Comm_get_errhandler(MPI_COMM_WORLD, &eh);
+  CHECK(eh == MPI_ERRORS_RETURN, "errhandler_set");
+  /* with ERRORS_RETURN an invalid root comes back as a class, no abort */
+  double bad = 0.0;
+  int erc = MPI_Reduce(&bad, NULL, 1, MPI_DOUBLE, MPI_SUM, size + 7,
+                       MPI_COMM_WORLD);
+  CHECK(erc != MPI_SUCCESS, "errhandler_return_class");
+  MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_ARE_FATAL);
+
+  /* allgatherv: rank r contributes r+1 ints */
+  {
+    int *cnts = (int *)malloc(sizeof(int) * size);
+    int *disp = (int *)malloc(sizeof(int) * size);
+    int tot = 0;
+    for (int r = 0; r < size; r++) {
+      cnts[r] = r + 1;
+      disp[r] = tot;
+      tot += r + 1;
+    }
+    int *vin = (int *)malloc(sizeof(int) * (rank + 1));
+    for (int i = 0; i <= rank; i++) vin[i] = 100 * rank + i;
+    int *vout = (int *)malloc(sizeof(int) * tot);
+    MPI_Allgatherv(vin, rank + 1, MPI_INT, vout, cnts, disp, MPI_INT,
+                   MPI_COMM_WORLD);
+    ok = 1;
+    for (int r = 0; r < size; r++)
+      for (int i = 0; i <= r; i++) ok &= (vout[disp[r] + i] == 100 * r + i);
+    CHECK(ok, "allgatherv");
+
+    /* gatherv to last rank */
+    int groot = size - 1;
+    int *gout = (rank == groot) ? (int *)malloc(sizeof(int) * tot) : NULL;
+    MPI_Gatherv(vin, rank + 1, MPI_INT, gout, cnts, disp, MPI_INT, groot,
+                MPI_COMM_WORLD);
+    if (rank == groot) {
+      ok = 1;
+      for (int r = 0; r < size; r++)
+        for (int i = 0; i <= r; i++) ok &= (gout[disp[r] + i] == 100 * r + i);
+      CHECK(ok, "gatherv");
+      free(gout);
+    } else printf("OK gatherv rank=%d\n", rank);
+
+    /* scatterv from rank 0: rank r receives r+1 ints */
+    int *sv_in = NULL;
+    if (rank == 0) {
+      sv_in = (int *)malloc(sizeof(int) * tot);
+      for (int r = 0; r < size; r++)
+        for (int i = 0; i <= r; i++) sv_in[disp[r] + i] = 1000 * r + i;
+    }
+    int *sv_out = (int *)malloc(sizeof(int) * (rank + 1));
+    MPI_Scatterv(sv_in, cnts, disp, MPI_INT, sv_out, rank + 1, MPI_INT, 0,
+                 MPI_COMM_WORLD);
+    ok = 1;
+    for (int i = 0; i <= rank; i++) ok &= (sv_out[i] == 1000 * rank + i);
+    CHECK(ok, "scatterv");
+    if (sv_in) free(sv_in);
+    free(sv_out);
+    free(vin);
+    free(vout);
+    free(cnts);
+    free(disp);
+  }
+
+  /* derived datatype: vector of every-other double over p2p */
+  if (size >= 2) {
+    MPI_Datatype vec;
+    MPI_Type_vector(3, 1, 2, MPI_DOUBLE, &vec);
+    MPI_Type_commit(&vec);
+    int vsz = 0;
+    MPI_Type_size(vec, &vsz);
+    CHECK(vsz == 3 * 8, "type_vector_size");
+    MPI_Aint lb = -1, ext = -1;
+    MPI_Type_get_extent(vec, &lb, &ext);
+    CHECK(lb == 0 && ext == 5 * 8, "type_get_extent");
+    if (rank == 0) {
+      double strided[6] = {1, -1, 2, -1, 3, -1};
+      MPI_Send(strided, 1, vec, 1, 21, MPI_COMM_WORLD);
+    } else if (rank == 1) {
+      double landing[6] = {0, 9, 0, 9, 0, 9};
+      MPI_Recv(landing, 1, vec, 0, 21, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+      CHECK(landing[0] == 1 && landing[2] == 2 && landing[4] == 3 &&
+                landing[1] == 9 && landing[3] == 9 && landing[5] == 9,
+            "type_vector_p2p");
+    }
+    MPI_Type_free(&vec);
+  }
+  if (rank != 1) printf("OK type_vector_p2p rank=%d\n", rank);
+
+  /* waitany over two irecvs (completion order independent) */
+  if (size >= 2) {
+    if (rank == 0) {
+      int a = -1, b = -1;
+      MPI_Request qs[2];
+      MPI_Irecv(&a, 1, MPI_INT, 1, 31, MPI_COMM_WORLD, &qs[0]);
+      MPI_Irecv(&b, 1, MPI_INT, 1, 32, MPI_COMM_WORLD, &qs[1]);
+      int idx1 = -1, idx2 = -1;
+      MPI_Status w1, w2;
+      MPI_Waitany(2, qs, &idx1, &w1);
+      MPI_Waitany(2, qs, &idx2, &w2);
+      CHECK(idx1 != idx2 && a == 71 && b == 72 &&
+                qs[0] == MPI_REQUEST_NULL && qs[1] == MPI_REQUEST_NULL,
+            "waitany");
+    } else if (rank == 1) {
+      int va = 71, vb = 72;
+      MPI_Send(&va, 1, MPI_INT, 0, 31, MPI_COMM_WORLD);
+      MPI_Send(&vb, 1, MPI_INT, 0, 32, MPI_COMM_WORLD);
+    }
+  }
+  if (rank != 0) printf("OK waitany rank=%d\n", rank);
+
   printf("CSUITE PASS rank=%d size=%d\n", rank, size);
   MPI_Finalize();
   return 0;

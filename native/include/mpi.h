@@ -137,8 +137,15 @@ typedef struct MPI_Status {
 #define MPI_ERR_INTERN 16
 #define MPI_ERR_LASTCODE 92
 
+#define MPI_ERRHANDLER_NULL ((MPI_Errhandler)0)
 #define MPI_ERRORS_ARE_FATAL ((MPI_Errhandler)1)
 #define MPI_ERRORS_RETURN ((MPI_Errhandler)2)
+
+/* comm/group comparison results */
+#define MPI_IDENT 0
+#define MPI_CONGRUENT 1
+#define MPI_SIMILAR 2
+#define MPI_UNEQUAL 3
 
 #define MPI_THREAD_SINGLE 0
 #define MPI_THREAD_FUNNELED 1
@@ -196,6 +203,63 @@ TPUMPI_PROTO(int, Wait, (MPI_Request *request, MPI_Status *status))
 TPUMPI_PROTO(int, Waitall,
              (int count, MPI_Request requests[], MPI_Status statuses[]))
 TPUMPI_PROTO(int, Test, (MPI_Request *request, int *flag, MPI_Status *status))
+TPUMPI_PROTO(int, Testall, (int count, MPI_Request requests[], int *flag,
+                            MPI_Status statuses[]))
+TPUMPI_PROTO(int, Waitany, (int count, MPI_Request requests[], int *index,
+                            MPI_Status *status))
+TPUMPI_PROTO(int, Testany, (int count, MPI_Request requests[], int *index,
+                            int *flag, MPI_Status *status))
+TPUMPI_PROTO(int, Waitsome,
+             (int incount, MPI_Request requests[], int *outcount,
+              int indices[], MPI_Status statuses[]))
+
+/* groups + comm construction */
+TPUMPI_PROTO(int, Comm_group, (MPI_Comm comm, MPI_Group *group))
+TPUMPI_PROTO(int, Group_size, (MPI_Group group, int *size))
+TPUMPI_PROTO(int, Group_rank, (MPI_Group group, int *rank))
+TPUMPI_PROTO(int, Group_free, (MPI_Group *group))
+TPUMPI_PROTO(int, Group_incl,
+             (MPI_Group group, int n, const int ranks[], MPI_Group *newgroup))
+TPUMPI_PROTO(int, Group_excl,
+             (MPI_Group group, int n, const int ranks[], MPI_Group *newgroup))
+TPUMPI_PROTO(int, Group_union,
+             (MPI_Group group1, MPI_Group group2, MPI_Group *newgroup))
+TPUMPI_PROTO(int, Group_intersection,
+             (MPI_Group group1, MPI_Group group2, MPI_Group *newgroup))
+TPUMPI_PROTO(int, Group_difference,
+             (MPI_Group group1, MPI_Group group2, MPI_Group *newgroup))
+TPUMPI_PROTO(int, Group_translate_ranks,
+             (MPI_Group group1, int n, const int ranks1[], MPI_Group group2,
+              int ranks2[]))
+TPUMPI_PROTO(int, Group_compare,
+             (MPI_Group group1, MPI_Group group2, int *result))
+TPUMPI_PROTO(int, Comm_create,
+             (MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm))
+TPUMPI_PROTO(int, Comm_create_group,
+             (MPI_Comm comm, MPI_Group group, int tag, MPI_Comm *newcomm))
+TPUMPI_PROTO(int, Comm_compare,
+             (MPI_Comm comm1, MPI_Comm comm2, int *result))
+
+/* errhandlers */
+TPUMPI_PROTO(int, Comm_set_errhandler,
+             (MPI_Comm comm, MPI_Errhandler errhandler))
+TPUMPI_PROTO(int, Comm_get_errhandler,
+             (MPI_Comm comm, MPI_Errhandler *errhandler))
+TPUMPI_PROTO(int, Errhandler_free, (MPI_Errhandler *errhandler))
+
+/* derived datatypes */
+TPUMPI_PROTO(int, Type_contiguous,
+             (int count, MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO(int, Type_vector,
+             (int count, int blocklength, int stride, MPI_Datatype oldtype,
+              MPI_Datatype *newtype))
+TPUMPI_PROTO(int, Type_indexed,
+             (int count, const int blocklengths[], const int displacements[],
+              MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO(int, Type_commit, (MPI_Datatype *datatype))
+TPUMPI_PROTO(int, Type_free, (MPI_Datatype *datatype))
+TPUMPI_PROTO(int, Type_get_extent,
+             (MPI_Datatype datatype, MPI_Aint *lb, MPI_Aint *extent))
 
 /* collectives: blocking */
 TPUMPI_PROTO(int, Barrier, (MPI_Comm comm))
@@ -232,6 +296,18 @@ TPUMPI_PROTO(int, Scan,
 TPUMPI_PROTO(int, Exscan,
              (const void *sendbuf, void *recvbuf, int count,
               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm))
+TPUMPI_PROTO(int, Allgatherv,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, const int recvcounts[], const int displs[],
+              MPI_Datatype recvtype, MPI_Comm comm))
+TPUMPI_PROTO(int, Gatherv,
+             (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+              void *recvbuf, const int recvcounts[], const int displs[],
+              MPI_Datatype recvtype, int root, MPI_Comm comm))
+TPUMPI_PROTO(int, Scatterv,
+             (const void *sendbuf, const int sendcounts[], const int displs[],
+              MPI_Datatype sendtype, void *recvbuf, int recvcount,
+              MPI_Datatype recvtype, int root, MPI_Comm comm))
 
 /* collectives: non-blocking */
 TPUMPI_PROTO(int, Ibarrier, (MPI_Comm comm, MPI_Request *request))

@@ -301,8 +301,21 @@ int PMPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
 
 /* ---- requests ------------------------------------------------------ */
 
+/* The standard's "empty" status for null/inactive requests. */
+static void empty_status(MPI_Status *status) {
+  if (status) {
+    status->MPI_SOURCE = MPI_PROC_NULL;
+    status->MPI_TAG = MPI_ANY_TAG;
+    status->MPI_ERROR = MPI_SUCCESS;
+    status->_count = 0;
+  }
+}
+
 int PMPI_Wait(MPI_Request *request, MPI_Status *status) {
-  if (*request == MPI_REQUEST_NULL) return MPI_SUCCESS;
+  if (*request == MPI_REQUEST_NULL) {
+    empty_status(status);
+    return MPI_SUCCESS;
+  }
   capi_ret r;
   int rc = capi_call("wait", &r, "(i)", *request);
   if (rc == MPI_SUCCESS) fill_status(status, &r, 0);
@@ -322,6 +335,7 @@ int PMPI_Waitall(int count, MPI_Request requests[], MPI_Status statuses[]) {
 int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
   if (*request == MPI_REQUEST_NULL) {
     *flag = 1;
+    empty_status(status);
     return MPI_SUCCESS;
   }
   capi_ret r;
@@ -408,6 +422,267 @@ int PMPI_Exscan(const void *sendbuf, void *recvbuf, int count,
                 MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
   return capi_call("exscan", NULL, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
                    count, (int)datatype, (int)op, (int)comm);
+}
+
+int PMPI_Testall(int count, MPI_Request requests[], int *flag,
+                 MPI_Status statuses[]) {
+  int all = 1;
+  for (int i = 0; i < count; i++) {
+    int f = 0;
+    int rc = PMPI_Test(&requests[i], &f,
+                       statuses ? &statuses[i] : MPI_STATUS_IGNORE);
+    if (rc != MPI_SUCCESS) return rc;
+    all = all && f;
+  }
+  *flag = all;
+  return MPI_SUCCESS;
+}
+
+int PMPI_Testany(int count, MPI_Request requests[], int *index, int *flag,
+                 MPI_Status *status) {
+  *flag = 0;
+  *index = MPI_UNDEFINED;
+  int live = 0;
+  for (int i = 0; i < count; i++) {
+    if (requests[i] == MPI_REQUEST_NULL) continue;
+    live = 1;
+    int f = 0;
+    int rc = PMPI_Test(&requests[i], &f, status);
+    if (rc != MPI_SUCCESS) return rc;
+    if (f) {
+      *flag = 1;
+      *index = i;
+      return MPI_SUCCESS;
+    }
+  }
+  if (!live) *flag = 1; /* all null → (true, MPI_UNDEFINED) per standard */
+  return MPI_SUCCESS;
+}
+
+int PMPI_Waitany(int count, MPI_Request requests[], int *index,
+                 MPI_Status *status) {
+  struct timespec ts = {0, 200000}; /* 200 us poll */
+  for (;;) {
+    int flag = 0;
+    int rc = PMPI_Testany(count, requests, index, &flag, status);
+    if (rc != MPI_SUCCESS) return rc;
+    if (flag) return MPI_SUCCESS;
+    nanosleep(&ts, NULL);
+  }
+}
+
+int PMPI_Waitsome(int incount, MPI_Request requests[], int *outcount,
+                  int indices[], MPI_Status statuses[]) {
+  struct timespec ts = {0, 200000};
+  int live = 0;
+  for (int i = 0; i < incount; i++)
+    if (requests[i] != MPI_REQUEST_NULL) live = 1;
+  if (!live) {
+    *outcount = MPI_UNDEFINED;
+    return MPI_SUCCESS;
+  }
+  for (;;) {
+    int n = 0;
+    for (int i = 0; i < incount; i++) {
+      if (requests[i] == MPI_REQUEST_NULL) continue;
+      int f = 0;
+      int rc = PMPI_Test(&requests[i], &f,
+                         statuses ? &statuses[n] : MPI_STATUS_IGNORE);
+      if (rc != MPI_SUCCESS) return rc;
+      if (f) indices[n++] = i;
+    }
+    if (n) {
+      *outcount = n;
+      return MPI_SUCCESS;
+    }
+    nanosleep(&ts, NULL);
+  }
+}
+
+/* ---- groups + comm construction ------------------------------------ */
+
+int PMPI_Comm_group(MPI_Comm comm, MPI_Group *group) {
+  capi_ret r;
+  int rc = capi_call("comm_group", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *group = (MPI_Group)r.v[0];
+  return rc;
+}
+
+int PMPI_Group_size(MPI_Group group, int *size) {
+  capi_ret r;
+  int rc = capi_call("group_size", &r, "(i)", (int)group);
+  if (rc == MPI_SUCCESS && r.n >= 1) *size = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Group_rank(MPI_Group group, int *rank) {
+  capi_ret r;
+  int rc = capi_call("group_rank", &r, "(i)", (int)group);
+  if (rc == MPI_SUCCESS && r.n >= 1) *rank = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Group_free(MPI_Group *group) {
+  int rc = capi_call("group_free", NULL, "(i)", (int)*group);
+  *group = MPI_GROUP_NULL;
+  return rc;
+}
+
+int PMPI_Group_incl(MPI_Group group, int n, const int ranks[],
+                    MPI_Group *newgroup) {
+  capi_ret r;
+  int rc = capi_call("group_incl", &r, "(iKi)", (int)group, PTR(ranks), n);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newgroup = (MPI_Group)r.v[0];
+  return rc;
+}
+
+int PMPI_Group_excl(MPI_Group group, int n, const int ranks[],
+                    MPI_Group *newgroup) {
+  capi_ret r;
+  int rc = capi_call("group_excl", &r, "(iKi)", (int)group, PTR(ranks), n);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newgroup = (MPI_Group)r.v[0];
+  return rc;
+}
+
+#define TPUMPI_GROUP_BINOP(cname, pyname)                              \
+  int PMPI_##cname(MPI_Group g1, MPI_Group g2, MPI_Group *out) {       \
+    capi_ret r;                                                        \
+    int rc = capi_call(pyname, &r, "(ii)", (int)g1, (int)g2);          \
+    if (rc == MPI_SUCCESS && r.n >= 1) *out = (MPI_Group)r.v[0];       \
+    return rc;                                                         \
+  }
+
+TPUMPI_GROUP_BINOP(Group_union, "group_union")
+TPUMPI_GROUP_BINOP(Group_intersection, "group_intersection")
+TPUMPI_GROUP_BINOP(Group_difference, "group_difference")
+
+int PMPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
+                               MPI_Group group2, int ranks2[]) {
+  return capi_call("group_translate_ranks", NULL, "(iiKiK)", (int)group1, n,
+                   PTR(ranks1), (int)group2, PTR(ranks2));
+}
+
+int PMPI_Group_compare(MPI_Group group1, MPI_Group group2, int *result) {
+  capi_ret r;
+  int rc = capi_call("group_compare", &r, "(ii)", (int)group1, (int)group2);
+  if (rc == MPI_SUCCESS && r.n >= 1) *result = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
+  capi_ret r;
+  int rc = capi_call("comm_create", &r, "(ii)", (int)comm, (int)group);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newcomm = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                           MPI_Comm *newcomm) {
+  (void)tag;
+  return PMPI_Comm_create(comm, group, newcomm);
+}
+
+int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
+  capi_ret r;
+  int rc = capi_call("comm_compare", &r, "(ii)", (int)comm1, (int)comm2);
+  if (rc == MPI_SUCCESS && r.n >= 1) *result = (int)r.v[0];
+  return rc;
+}
+
+/* ---- errhandlers ---------------------------------------------------- */
+
+int PMPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
+  return capi_call("comm_set_errhandler", NULL, "(ii)", (int)comm,
+                   (int)errhandler);
+}
+
+int PMPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler) {
+  capi_ret r;
+  int rc = capi_call("comm_get_errhandler", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *errhandler = (MPI_Errhandler)r.v[0];
+  return rc;
+}
+
+int PMPI_Errhandler_free(MPI_Errhandler *errhandler) {
+  *errhandler = MPI_ERRHANDLER_NULL;
+  return MPI_SUCCESS;
+}
+
+/* ---- derived datatypes ---------------------------------------------- */
+
+int PMPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                         MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_contiguous", &r, "(ii)", count, (int)oldtype);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_vector(int count, int blocklength, int stride,
+                     MPI_Datatype oldtype, MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_vector", &r, "(iiii)", count, blocklength, stride,
+                     (int)oldtype);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_indexed(int count, const int blocklengths[],
+                      const int displacements[], MPI_Datatype oldtype,
+                      MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_indexed", &r, "(iKKi)", count, PTR(blocklengths),
+                     PTR(displacements), (int)oldtype);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_commit(MPI_Datatype *datatype) {
+  return capi_call("type_commit", NULL, "(i)", (int)*datatype);
+}
+
+int PMPI_Type_free(MPI_Datatype *datatype) {
+  int rc = capi_call("type_free", NULL, "(i)", (int)*datatype);
+  *datatype = MPI_DATATYPE_NULL;
+  return rc;
+}
+
+int PMPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
+                         MPI_Aint *extent) {
+  capi_ret r;
+  int rc = capi_call("type_get_extent", &r, "(i)", (int)datatype);
+  if (rc == MPI_SUCCESS && r.n >= 2) {
+    *lb = (MPI_Aint)r.v[0];
+    *extent = (MPI_Aint)r.v[1];
+  }
+  return rc;
+}
+
+/* ---- v-collectives -------------------------------------------------- */
+
+int PMPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                    void *recvbuf, const int recvcounts[], const int displs[],
+                    MPI_Datatype recvtype, MPI_Comm comm) {
+  return capi_call("allgatherv", NULL, "(KiiKKKii)", PTR(sendbuf), sendcount,
+                   (int)sendtype, PTR(recvbuf), PTR(recvcounts), PTR(displs),
+                   (int)recvtype, (int)comm);
+}
+
+int PMPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, const int recvcounts[], const int displs[],
+                 MPI_Datatype recvtype, int root, MPI_Comm comm) {
+  return capi_call("gatherv", NULL, "(KiiKKKiii)", PTR(sendbuf), sendcount,
+                   (int)sendtype, PTR(recvbuf), PTR(recvcounts), PTR(displs),
+                   (int)recvtype, root, (int)comm);
+}
+
+int PMPI_Scatterv(const void *sendbuf, const int sendcounts[],
+                  const int displs[], MPI_Datatype sendtype, void *recvbuf,
+                  int recvcount, MPI_Datatype recvtype, int root,
+                  MPI_Comm comm) {
+  return capi_call("scatterv", NULL, "(KKKiKiiii)", PTR(sendbuf),
+                   PTR(sendcounts), PTR(displs), (int)sendtype, PTR(recvbuf),
+                   recvcount, (int)recvtype, root, (int)comm);
 }
 
 /* ---- collectives: non-blocking ------------------------------------ */
@@ -534,3 +809,41 @@ TPUMPI_WEAK(int, Iallgather,
 TPUMPI_WEAK(int, Ialltoall,
             (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
              MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Testall, (int, MPI_Request[], int *, MPI_Status[]))
+TPUMPI_WEAK(int, Testany, (int, MPI_Request[], int *, int *, MPI_Status *))
+TPUMPI_WEAK(int, Waitany, (int, MPI_Request[], int *, MPI_Status *))
+TPUMPI_WEAK(int, Waitsome, (int, MPI_Request[], int *, int[], MPI_Status[]))
+TPUMPI_WEAK(int, Comm_group, (MPI_Comm, MPI_Group *))
+TPUMPI_WEAK(int, Group_size, (MPI_Group, int *))
+TPUMPI_WEAK(int, Group_rank, (MPI_Group, int *))
+TPUMPI_WEAK(int, Group_free, (MPI_Group *))
+TPUMPI_WEAK(int, Group_incl, (MPI_Group, int, const int[], MPI_Group *))
+TPUMPI_WEAK(int, Group_excl, (MPI_Group, int, const int[], MPI_Group *))
+TPUMPI_WEAK(int, Group_union, (MPI_Group, MPI_Group, MPI_Group *))
+TPUMPI_WEAK(int, Group_intersection, (MPI_Group, MPI_Group, MPI_Group *))
+TPUMPI_WEAK(int, Group_difference, (MPI_Group, MPI_Group, MPI_Group *))
+TPUMPI_WEAK(int, Group_translate_ranks,
+            (MPI_Group, int, const int[], MPI_Group, int[]))
+TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
+TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, Comm_set_errhandler, (MPI_Comm, MPI_Errhandler))
+TPUMPI_WEAK(int, Comm_get_errhandler, (MPI_Comm, MPI_Errhandler *))
+TPUMPI_WEAK(int, Errhandler_free, (MPI_Errhandler *))
+TPUMPI_WEAK(int, Type_contiguous, (int, MPI_Datatype, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_vector, (int, int, int, MPI_Datatype, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_indexed,
+            (int, const int[], const int[], MPI_Datatype, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_commit, (MPI_Datatype *))
+TPUMPI_WEAK(int, Type_free, (MPI_Datatype *))
+TPUMPI_WEAK(int, Type_get_extent, (MPI_Datatype, MPI_Aint *, MPI_Aint *))
+TPUMPI_WEAK(int, Allgatherv,
+            (const void *, int, MPI_Datatype, void *, const int[],
+             const int[], MPI_Datatype, MPI_Comm))
+TPUMPI_WEAK(int, Gatherv,
+            (const void *, int, MPI_Datatype, void *, const int[],
+             const int[], MPI_Datatype, int, MPI_Comm))
+TPUMPI_WEAK(int, Scatterv,
+            (const void *, const int[], const int[], MPI_Datatype, void *,
+             int, MPI_Datatype, int, MPI_Comm))

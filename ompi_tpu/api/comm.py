@@ -143,6 +143,26 @@ class Comm:
             self._pml = comp.make_engine(self.size, self.name)
         return self._pml
 
+    # -- errhandlers (MPI_Comm_set_errhandler family) -------------------
+
+    def set_errhandler(self, errhandler) -> None:
+        """MPI_Comm_set_errhandler.  The Python surface always raises
+        typed exceptions (≈ ERRORS_RETURN); ERRORS_ARE_FATAL makes the
+        C ABI abort on error, and a create_errhandler callback fires
+        before either action."""
+        from ompi_tpu.core.errors import Errhandler
+
+        if not isinstance(errhandler, Errhandler):
+            raise MPIArgError(f"not an Errhandler: {errhandler!r}")
+        self._errhandler = errhandler
+
+    def get_errhandler(self):
+        """MPI_Comm_get_errhandler (default: ERRORS_RETURN — the
+        exception-raising Python surface)."""
+        from ompi_tpu.core import errors as _err
+
+        return getattr(self, "_errhandler", _err.ERRORS_RETURN)
+
     # -- attribute caching (MPI_Comm_set_attr family) -------------------
 
     def set_attr(self, keyval: int, value: Any) -> None:

@@ -126,6 +126,7 @@ class MultiProcComm:
         return self.coll.lookup("bcast")(x, root)
 
     def reduce(self, x, op: Op = SUM, root: int = 0):
+        self.locate(root)  # MPI_ERR_RANK/ROOT before any traffic
         return self.coll.lookup("reduce")(x, op, root)
 
     def allgather(self, x):
@@ -154,6 +155,18 @@ class MultiProcComm:
     def barrier(self) -> None:
         self.coll.lookup("barrier")()
 
+    def set_errhandler(self, errhandler) -> None:
+        from ompi_tpu.core.errors import Errhandler
+
+        if not isinstance(errhandler, Errhandler):
+            raise MPIArgError(f"not an Errhandler: {errhandler!r}")
+        self._errhandler = errhandler
+
+    def get_errhandler(self):
+        from ompi_tpu.core import errors as _err
+
+        return getattr(self, "_errhandler", _err.ERRORS_RETURN)
+
     def __getattr__(self, name: str):
         """Non-blocking (i*) and persistent (*_init) variants of every
         collective, served from the coll table like their blocking
@@ -177,6 +190,19 @@ class MultiProcComm:
 
     def allgatherv(self, blocks: Sequence[np.ndarray]):
         return self.coll.lookup("allgatherv")(blocks)
+
+    def gatherv(self, blocks: Sequence[np.ndarray], root: int = 0):
+        return self.coll.lookup("gatherv")(blocks, root)
+
+    def scatterv(self, blocks: Sequence[np.ndarray] | None, root: int = 0):
+        """blocks: one array per GLOBAL rank, meaningful on root's
+        process; returns this process's local ranks' blocks."""
+        return self.coll.lookup("scatterv")(blocks, root)
+
+    def alltoallv(self, matrix: Sequence[Sequence[np.ndarray]]):
+        """matrix[l][j]: block from local rank l to global rank j;
+        returns out[l][src] = block global rank src sent to l."""
+        return self.coll.lookup("alltoallv")(matrix)
 
     # -- p2p -------------------------------------------------------------
 

@@ -94,19 +94,40 @@ OPS: dict[int, opmod.Op] = {
 
 _comms: dict[int, object] = {}
 _requests: dict[int, tuple] = {}
+_groups: dict[int, object] = {}
+_dtypes: dict[int, object] = {}  # derived datatype handle → ddt.Datatype
+_errhandlers: dict[int, int] = {}  # comm handle → 1 (FATAL) | 2 (RETURN)
 _next_handle = 3  # 1 = MPI_COMM_WORLD, 2 = MPI_COMM_SELF
 _next_req = 1
+_next_group = 2   # 1 = MPI_GROUP_EMPTY
+_next_dtype = 64  # predefined codes stay below
 _rank = 0
 _size = 1
 
+ERRH_FATAL, ERRH_RETURN = 1, 2
 
-def _fail(e: BaseException) -> int:
-    """Map a framework exception to an MPI error class (printing the
-    traceback — the C caller only sees the class, ≈ MPI_ERRORS_RETURN)."""
+
+def _fail(e: BaseException, h: int | None = None) -> int:
+    """Map a framework exception to an MPI error class.  Honors the
+    communicator's errhandler: MPI_ERRORS_ARE_FATAL (the standard's
+    default for conforming C programs) aborts the process; otherwise
+    the class is returned to the caller (MPI_ERRORS_RETURN)."""
     if isinstance(e, err.MPIError):
-        return int(e.error_class)
-    traceback.print_exc()
-    return MPI_ERR_OTHER
+        cls = int(e.error_class)
+    else:
+        traceback.print_exc()
+        cls = MPI_ERR_OTHER
+    # errors not attached to a communicator use WORLD's errhandler
+    eh = _errhandlers.get(h if h is not None else 1, ERRH_FATAL)
+    if eh == ERRH_FATAL:
+        import os
+        import sys
+
+        print(f"tpumpi: MPI_ERRORS_ARE_FATAL: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(cls if 0 < cls < 126 else 1)
+    return cls
 
 
 def _view(ptr: int, count: int, dtcode: int) -> np.ndarray:
@@ -128,11 +149,14 @@ def _comm(h: int):
     return c
 
 
-def _store_comm(c) -> int:
+def _store_comm(c, parent_h: int | None = None) -> int:
     global _next_handle
     h = _next_handle
     _next_handle += 1
     _comms[h] = c
+    if parent_h is not None:
+        # MPI: dup/split/create propagate the parent's errhandler
+        _errhandlers[h] = _errhandlers.get(parent_h, ERRH_FATAL)
     return h
 
 
@@ -223,7 +247,7 @@ def comm_rank(h: int):
 
 def comm_dup(h: int):
     try:
-        return (MPI_SUCCESS, _store_comm(_comm(h).dup()))
+        return (MPI_SUCCESS, _store_comm(_comm(h).dup(), h))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -231,23 +255,21 @@ def comm_dup(h: int):
 def comm_split(h: int, color: int, key: int):
     try:
         c = _comm(h)
-        if not hasattr(c, "split"):
-            # MultiProcComm split lands with cross-process sub-groups
-            import sys
-
-            print("tpumpi: MPI_Comm_split on a multi-process communicator "
-                  "is not yet supported", file=sys.stderr)
-            return (MPI_ERR_OTHER, 0)
-        # Comm.split takes per-local-rank color/key sequences; with the
-        # C process=rank model each process contributes exactly one.
-        sub = c.split([color], [key])
-        if isinstance(sub, list):
-            sub = sub[0]
+        # Comm.split / MultiProcComm.split take per-local-rank color/key
+        # sequences; with the C process=rank model each process (or the
+        # single-controller comm's ranks — handled by the length) gives
+        # exactly one.  Cross-process sub-comms ride DcnSubEngine.
+        if _is_single_controller(c):
+            colors = [color] * c.size
+            keys = [key] * c.size
+            sub = c.split(colors, keys)[0]
+        else:
+            sub = c.split([color], [key])[0]
         if sub is None:  # MPI_UNDEFINED color → MPI_COMM_NULL
             return (MPI_SUCCESS, 0)
-        return (MPI_SUCCESS, _store_comm(sub))
+        return (MPI_SUCCESS, _store_comm(sub, h))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_fail(e, h), 0)
 
 
 def comm_free(h: int) -> int:
@@ -268,7 +290,16 @@ def comm_set_name(h: int, name: str) -> int:
         return _fail(e)
 
 
+def _is_single_controller(c) -> bool:
+    """True for single-process Comm objects (one Python process drives
+    every rank — the standalone / COMM_SELF case)."""
+    return getattr(c, "dcn", None) is None
+
+
 def type_size(dtcode: int):
+    d = _dtypes.get(dtcode)
+    if d is not None:
+        return (MPI_SUCCESS, int(d.size))
     dt = DTYPES.get(dtcode)
     if dt is None:
         return (MPI_ERR_TYPE, 0)
@@ -293,7 +324,7 @@ def allreduce(sptr, rptr, count, dtcode, opcode, h) -> int:
         _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def reduce(sptr, rptr, count, dtcode, opcode, root, h) -> int:
@@ -306,18 +337,23 @@ def reduce(sptr, rptr, count, dtcode, opcode, root, h) -> int:
             _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def bcast(ptr, count, dtcode, root, h) -> int:
     try:
         c = _comm(h)
+        if dtcode in _dtypes:  # derived: pack → bcast bytes → unpack
+            x = _pack_from(ptr, count, dtcode)
+            out = np.asarray(c.bcast(np.asarray(x)[None, :], root=root))
+            _unpack_into(ptr, count, dtcode, out[0])
+            return MPI_SUCCESS
         buf = _view(ptr, count, dtcode)
         out = np.asarray(c.bcast(buf[None, :], root=root))
         buf[:] = out.reshape(-1)[:count]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def allgather(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
@@ -336,7 +372,7 @@ def allgather(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
         _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def gather(sptr, scount, sdt, rptr, rcount, rdt, root, h) -> int:
@@ -358,7 +394,7 @@ def gather(sptr, scount, sdt, rptr, rcount, rdt, root, h) -> int:
             _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def scatter(sptr, scount, sdt, rptr, rcount, rdt, root, h) -> int:
@@ -378,7 +414,7 @@ def scatter(sptr, scount, sdt, rptr, rcount, rdt, root, h) -> int:
             _view(rptr, rcount, rdt)[:] = out.reshape(-1)[:rcount]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def alltoall(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
@@ -393,7 +429,7 @@ def alltoall(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
         _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def reduce_scatter_block(sptr, rptr, rcount, dtcode, opcode, h) -> int:
@@ -408,7 +444,7 @@ def reduce_scatter_block(sptr, rptr, rcount, dtcode, opcode, h) -> int:
         _view(rptr, rcount, dtcode)[:] = out.reshape(-1)[:rcount]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def scan(sptr, rptr, count, dtcode, opcode, h) -> int:
@@ -419,7 +455,7 @@ def scan(sptr, rptr, count, dtcode, opcode, h) -> int:
         _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def exscan(sptr, rptr, count, dtcode, opcode, h) -> int:
@@ -432,7 +468,7 @@ def exscan(sptr, rptr, count, dtcode, opcode, h) -> int:
             _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def barrier(h) -> int:
@@ -440,7 +476,7 @@ def barrier(h) -> int:
         _comm(h).barrier()
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 # -- pt2pt --------------------------------------------------------------
@@ -450,11 +486,13 @@ def send(ptr, count, dtcode, dest, tag, h) -> int:
     try:
         c = _comm(h)
         me = comm_rank(h)[1]
-        payload = _view(ptr, count, dtcode).copy()
+        # derived datatypes go through the convertor pack (SURVEY §3.3);
+        # predefined ones are a zero-copy view + copy
+        payload = _pack_from(ptr, count, dtcode)
         c.send(payload, source=me, dest=dest, tag=tag)
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _fail(e, h)
 
 
 def recv(ptr, count, dtcode, source, tag, h):
@@ -466,12 +504,10 @@ def recv(ptr, count, dtcode, source, tag, h):
             source=None if source == -1 else source,
             tag=None if tag == -1 else tag,
         )
-        flat = np.asarray(payload).reshape(-1).view(DTYPES[dtcode])
-        got = min(flat.size, count)
-        _view(ptr, got, dtcode)[:] = flat[:got]
+        got = _unpack_into(ptr, count, dtcode, payload)
         return (MPI_SUCCESS, int(st.source), int(st.tag), got)
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), -1, -1, 0)
+        return (_fail(e, h), -1, -1, 0)
 
 
 def isend(ptr, count, dtcode, dest, tag, h):
@@ -493,7 +529,7 @@ def irecv(ptr, count, dtcode, source, tag, h):
         )
         return (MPI_SUCCESS, _store_req(("recv", req, ptr, count, dtcode)))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_fail(e, h), 0)
 
 
 # -- requests -----------------------------------------------------------
@@ -507,9 +543,7 @@ def _complete(entry) -> tuple[int, int, int]:
     if kind == "recv":
         payload = req.wait()
         st = req.status
-        flat = np.asarray(payload).reshape(-1).view(DTYPES[dtcode])
-        got = min(flat.size, count)
-        _view(ptr, got, dtcode)[:] = flat[:got]
+        got = _unpack_into(ptr, count, dtcode, payload)
         return (int(st.source), int(st.tag), got)
     if kind == "coll":
         out = req.wait()
@@ -557,7 +591,7 @@ def iallreduce(sptr, rptr, count, dtcode, opcode, h):
         req = c.iallreduce(x, OPS[opcode])
         return (MPI_SUCCESS, _store_req(("coll", req, rptr, count, dtcode)))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_fail(e, h), 0)
 
 
 def _eager_coll(fn) -> tuple[int, int]:
@@ -600,3 +634,386 @@ def ialltoall(sptr, scount, sdt, rptr, rcount, rdt, h):
         )
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
+
+
+# -- groups (MPI_Comm_group + group algebra; ≈ ompi/group/) --------------
+
+
+def _group(gh: int):
+    if gh == 1:
+        from ompi_tpu.api.group import Group
+
+        return Group([])
+    g = _groups.get(gh)
+    if g is None:
+        raise err.MPIGroupError(f"invalid group handle {gh}")
+    return g
+
+
+def _store_group(g) -> int:
+    global _next_group
+    if g.size == 0:
+        return 1  # MPI_GROUP_EMPTY
+    _next_group += 1
+    _groups[_next_group] = g
+    return _next_group
+
+
+def comm_group(h: int):
+    """MPI_Comm_group.  Groups carry WORLD ranks (the comm's ``group``
+    attribute), so group algebra and rank lookups compose across groups
+    taken from different communicators."""
+    try:
+        from ompi_tpu.api.group import Group
+
+        c = _comm(h)
+        g = getattr(c, "group", None)
+        ranks = list(g.ranks) if g is not None else range(getattr(c, "size", 1))
+        return (MPI_SUCCESS, _store_group(Group(ranks)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_size(gh: int):
+    try:
+        return (MPI_SUCCESS, _group(gh).size)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_rank(gh: int):
+    """Rank of the calling process in the group (MPI_UNDEFINED=-32766
+    if absent)."""
+    try:
+        g = _group(gh)
+        me = comm_rank(1)[1]
+        return (MPI_SUCCESS, int(g.rank_of(me)))  # UNDEFINED if absent
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_free(gh: int) -> int:
+    _groups.pop(gh, None)
+    return MPI_SUCCESS
+
+
+def group_incl(gh: int, ranks_ptr: int, n: int):
+    try:
+        ranks = [int(v) for v in _view(ranks_ptr, n, 7)] if n else []
+        return (MPI_SUCCESS, _store_group(_group(gh).incl(ranks)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_excl(gh: int, ranks_ptr: int, n: int):
+    try:
+        ranks = [int(v) for v in _view(ranks_ptr, n, 7)] if n else []
+        return (MPI_SUCCESS, _store_group(_group(gh).excl(ranks)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_union(ga: int, gb: int):
+    try:
+        return (MPI_SUCCESS, _store_group(_group(ga).union(_group(gb))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_intersection(ga: int, gb: int):
+    try:
+        return (MPI_SUCCESS, _store_group(_group(ga).intersection(_group(gb))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_difference(ga: int, gb: int):
+    try:
+        return (MPI_SUCCESS, _store_group(_group(ga).difference(_group(gb))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def group_translate_ranks(ga: int, n: int, ranks_ptr: int, gb: int,
+                          out_ptr: int) -> int:
+    try:
+        ga_, gb_ = _group(ga), _group(gb)
+        ranks = [int(v) for v in _view(ranks_ptr, n, 7)]
+        out = ga_.translate_ranks(ranks, gb_)
+        _view(out_ptr, n, 7)[:] = [int(r) for r in out]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def group_compare(ga: int, gb: int):
+    """Maps the internal IDENT(0)/SIMILAR(1)/UNEQUAL(2) to the C
+    header's MPI_IDENT(0)/MPI_SIMILAR(2)/MPI_UNEQUAL(3)."""
+    try:
+        v = int(_group(ga).compare(_group(gb)))
+        return (MPI_SUCCESS, {0: 0, 1: 2, 2: 3}[v])
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def comm_create(h: int, gh: int):
+    """MPI_Comm_create (and _group): new comm over the group's ranks,
+    ordered by group rank.  Cross-process membership routes through
+    comm_split with key = position in the group."""
+    try:
+        c = _comm(h)
+        g = _group(gh)
+        if g.size == 0:
+            return (MPI_SUCCESS, 0)
+        if _is_single_controller(c):
+            sub = c.create_group(g)
+            return (MPI_SUCCESS,
+                    _store_comm(sub, h) if sub is not None else 0)
+        me = comm_rank(h)[1]
+        pos = int(g.rank_of(me))
+        if pos == -32766:  # UNDEFINED: participate in the split collective
+            c.split([-32766], [0])
+            return (MPI_SUCCESS, 0)
+        sub = c.split([0], [pos])[0]
+        return (MPI_SUCCESS, _store_comm(sub, h))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def comm_compare(ha: int, hb: int):
+    """MPI_Comm_compare: IDENT(0)/CONGRUENT(1)/SIMILAR(2)/UNEQUAL(3)."""
+    try:
+        ca, cb = _comm(ha), _comm(hb)
+        if ca is cb:
+            return (MPI_SUCCESS, 0)
+        ra = list(getattr(ca, "group").ranks)
+        rb = list(getattr(cb, "group").ranks)
+        if ra == rb:
+            return (MPI_SUCCESS, 1)
+        if sorted(ra) == sorted(rb):
+            return (MPI_SUCCESS, 2)
+        return (MPI_SUCCESS, 3)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+# -- errhandlers ----------------------------------------------------------
+
+
+def comm_set_errhandler(h: int, eh: int) -> int:
+    try:
+        c = _comm(h)
+        if eh not in (ERRH_FATAL, ERRH_RETURN):
+            raise err.MPIArgError(f"invalid errhandler handle {eh}")
+        _errhandlers[h] = eh
+        from ompi_tpu.core import errors as _err
+
+        c.set_errhandler(
+            _err.ERRORS_ARE_FATAL if eh == ERRH_FATAL else _err.ERRORS_RETURN
+        )
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def comm_get_errhandler(h: int):
+    try:
+        _comm(h)
+        return (MPI_SUCCESS, _errhandlers.get(h, ERRH_FATAL))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+# -- derived datatypes (≈ ompi/datatype constructors over ddt/) -----------
+
+
+def _ddt(dtcode: int):
+    """Datatype for a C handle: derived registry, or predefined leaf."""
+    d = _dtypes.get(dtcode)
+    if d is not None:
+        return d
+    from ompi_tpu.ddt.datatype import from_numpy_dtype
+
+    dt = DTYPES.get(dtcode)
+    if dt is None:
+        raise err.MPITypeError(f"unsupported C datatype code {dtcode}")
+    return from_numpy_dtype(dt)
+
+
+def _store_dtype(d) -> int:
+    global _next_dtype
+    _next_dtype += 1
+    _dtypes[_next_dtype] = d
+    return _next_dtype
+
+
+def type_contiguous(count: int, base: int):
+    try:
+        return (MPI_SUCCESS, _store_dtype(_ddt(base).create_contiguous(count)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def type_vector(count: int, blocklength: int, stride: int, base: int):
+    try:
+        d = _ddt(base).create_vector(count, blocklength, stride)
+        return (MPI_SUCCESS, _store_dtype(d))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def type_indexed(count: int, bl_ptr: int, disp_ptr: int, base: int):
+    try:
+        bls = [int(v) for v in _view(bl_ptr, count, 7)]
+        disps = [int(v) for v in _view(disp_ptr, count, 7)]
+        d = _ddt(base).create_indexed(bls, disps)
+        return (MPI_SUCCESS, _store_dtype(d))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def type_commit(dtcode: int) -> int:
+    try:
+        d = _dtypes.get(dtcode)
+        if d is not None:
+            d.commit()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def type_free(dtcode: int) -> int:
+    _dtypes.pop(dtcode, None)
+    return MPI_SUCCESS
+
+
+def type_get_extent(dtcode: int):
+    try:
+        d = _ddt(dtcode)
+        return (MPI_SUCCESS, int(d.lb), int(d.extent))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0, 0)
+
+
+def _pack_from(ptr: int, count: int, dtcode: int) -> np.ndarray:
+    """Read `count` elements of a (possibly derived) datatype from a C
+    buffer into a packed contiguous array (leaf-typed when uniform) —
+    the convertor's pack path (SURVEY.md §3.3)."""
+    d = _dtypes.get(dtcode)
+    if d is None:
+        return _view(ptr, count, dtcode).copy()
+    from ompi_tpu.ddt.convertor import pack, packed_to_typed
+
+    span = d.lb + d.extent * count
+    raw = (ctypes.c_ubyte * max(span, 1)).from_address(ptr)
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    packed = pack(buf, d, count)
+    if d.uniform_leaf is not None:
+        return packed_to_typed(packed, d, count)
+    return packed
+
+
+def _unpack_into(ptr: int, count: int, dtcode: int, data: np.ndarray) -> int:
+    """Write packed/typed data into a C buffer laid out as `count`
+    elements of a (possibly derived) datatype; returns elements written."""
+    d = _dtypes.get(dtcode)
+    if d is None:
+        flat = np.asarray(data).reshape(-1).view(DTYPES[dtcode])
+        got = min(flat.size, count)
+        _view(ptr, got, dtcode)[:] = flat[:got]
+        return got
+    from ompi_tpu.ddt.convertor import unpack
+
+    span = d.lb + d.extent * count
+    raw = (ctypes.c_ubyte * max(span, 1)).from_address(ptr)
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    payload = np.asarray(data).reshape(-1).view(np.uint8)
+    n_elems = min(count, payload.nbytes // max(d.size, 1))
+    unpack(buf, d, n_elems, payload[: n_elems * d.size])
+    return n_elems
+
+
+# -- v-collectives (jagged counts/displacements) --------------------------
+
+
+def _vparams(ptr_counts: int, ptr_displs: int, n: int):
+    counts = [int(v) for v in _view(ptr_counts, n, 7)]
+    displs = [int(v) for v in _view(ptr_displs, n, 7)]
+    return counts, displs
+
+
+def allgatherv(sptr, scount, sdt, rptr, rcounts_ptr, displs_ptr, rdt, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        counts, displs = _vparams(rcounts_ptr, displs_ptr, n)
+        me = comm_rank(h)[1]
+        if sptr == _IN_PLACE:
+            base = _view(rptr, displs[me] + counts[me], rdt)
+            x = base[displs[me] : displs[me] + counts[me]].copy()
+        else:
+            x = _view(sptr, scount, sdt).copy()
+        if _is_single_controller(c):
+            blocks = c.allgatherv([x] * n) if n > 1 else [x]
+        else:
+            blocks = c.allgatherv([x])
+        item = DTYPES[rdt].itemsize
+        for r in range(n):
+            dst = _view(rptr + displs[r] * item, counts[r], rdt)
+            dst[:] = np.asarray(blocks[r]).reshape(-1).view(DTYPES[rdt])[: counts[r]]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def gatherv(sptr, scount, sdt, rptr, rcounts_ptr, displs_ptr, rdt, root, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        me = comm_rank(h)[1]
+        if sptr == _IN_PLACE:  # root's block already in recvbuf
+            counts, displs = _vparams(rcounts_ptr, displs_ptr, n)
+            item = DTYPES[rdt].itemsize
+            x = _view(rptr + displs[me] * item, counts[me], rdt).copy()
+        else:
+            x = _view(sptr, scount, sdt).copy()
+        if _is_single_controller(c):
+            blocks = c.gatherv([x] * n if n > 1 else [x], root)
+        else:
+            blocks = c.gatherv([x], root)
+        if me == root:
+            counts, displs = _vparams(rcounts_ptr, displs_ptr, n)
+            item = DTYPES[rdt].itemsize
+            for r in range(n):
+                dst = _view(rptr + displs[r] * item, counts[r], rdt)
+                dst[:] = (
+                    np.asarray(blocks[r]).reshape(-1).view(DTYPES[rdt])[: counts[r]]
+                )
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def scatterv(sptr, scounts_ptr, displs_ptr, sdt, rptr, rcount, rdt, root, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        me = comm_rank(h)[1]
+        blocks = None
+        if me == root:
+            counts, displs = _vparams(scounts_ptr, displs_ptr, n)
+            item = DTYPES[sdt].itemsize
+            blocks = [
+                _view(sptr + displs[r] * item, counts[r], sdt).copy()
+                for r in range(n)
+            ]
+        out = c.scatterv(blocks, root)
+        mine = out[0] if not _is_single_controller(c) else out[me]
+        got = min(rcount, np.asarray(mine).size)
+        if rptr != _IN_PLACE and got:
+            _view(rptr, got, rdt)[:] = (
+                np.asarray(mine).reshape(-1).view(DTYPES[rdt])[:got]
+            )
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)

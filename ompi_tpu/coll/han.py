@@ -238,10 +238,106 @@ class HanCollModule(CollModule):
         return self.allgatherv(blocks, _cid=_cid)
 
     def scatterv(self, blocks, root: int = 0, _cid=None):
-        raise NotImplementedError("scatterv on multi-process comms: next round")
+        """Jagged scatter: ``blocks`` = one array per GLOBAL rank
+        (meaningful on root's process; others may pass None).  Returns
+        this process's local ranks' blocks, shapes/dtypes preserved.
+        Wire shape: one uint8 byte-stream per destination process +
+        shape/dtype metadata on the envelope (same design as
+        allgatherv)."""
+        comm = self.comm
+        cid = comm.cid if _cid is None else _cid
+        root_proc, _ = comm.locate(root)
+        payloads = None
+        meta = None
+        if comm.proc == root_proc:
+            if blocks is None or len(blocks) != comm.size:
+                from ompi_tpu.core.errors import MPIArgError
+
+                raise MPIArgError(
+                    f"scatterv root needs one block per global rank "
+                    f"({comm.size}); got "
+                    f"{None if blocks is None else len(blocks)}"
+                )
+            arrs = [np.ascontiguousarray(b) for b in blocks]
+            payloads, meta = [], []
+            for p in range(comm.nprocs):
+                lo, hi = comm.proc_range(p)
+                chunk = arrs[lo:hi]
+                meta.append(
+                    [{"shape": list(a.shape), "dtype": a.dtype.str}
+                     for a in chunk]
+                )
+                payloads.append(
+                    np.concatenate(
+                        [a.view(np.uint8).reshape(-1) for a in chunk]
+                    ) if chunk else np.zeros(0, np.uint8)
+                )
+        metas = comm.dcn.allgather_obj(meta, cid)[root_proc]
+        data = comm.dcn.scatter(payloads, root_proc, cid).view(np.uint8)
+        out, off = [], 0
+        for m in metas[comm.proc]:
+            dt = np.dtype(m["dtype"])
+            nbytes = dt.itemsize * int(np.prod(m["shape"], dtype=np.int64))
+            out.append(
+                data[off : off + nbytes].view(dt).reshape(m["shape"]).copy()
+            )
+            off += nbytes
+        return out
 
     def alltoallv(self, matrix, _cid=None):
-        raise NotImplementedError("alltoallv on multi-process comms: next round")
+        """Jagged all-to-all: ``matrix[l][j]`` = block from this
+        process's local rank l to GLOBAL rank j (local_size × global_n,
+        jagged shapes/dtypes).  Returns ``out[l][src]`` = block sent by
+        global rank src to local rank l.  Per-destination-process byte
+        streams + metadata envelopes, unpacked by (sender local rank,
+        dest local rank) order."""
+        comm = self.comm
+        cid = comm.cid if _cid is None else _cid
+        ln = comm.local_size
+        if len(matrix) != ln or any(len(row) != comm.size for row in matrix):
+            from ompi_tpu.core.errors import MPIArgError
+
+            raise MPIArgError(
+                f"alltoallv matrix must be local_size x global_n "
+                f"({ln} x {comm.size})"
+            )
+        rows = [[np.ascontiguousarray(b) for b in row] for row in matrix]
+        payloads, meta = [], []
+        for p in range(comm.nprocs):
+            lo, hi = comm.proc_range(p)
+            chunk = [rows[l][j] for l in range(ln) for j in range(lo, hi)]
+            meta.append(
+                [{"shape": list(a.shape), "dtype": a.dtype.str}
+                 for a in chunk]
+            )
+            payloads.append(
+                np.concatenate([a.view(np.uint8).reshape(-1) for a in chunk])
+                if chunk else np.zeros(0, np.uint8)
+            )
+        metas = comm.dcn.allgather_obj(meta, cid)  # [src proc][dst proc]
+        datas = comm.dcn.alltoall(payloads, cid)   # [src proc] bytes for us
+        out = [[None] * comm.size for _ in range(ln)]
+        for q in range(comm.nprocs):
+            qlo, qhi = comm.proc_range(q)
+            qln = qhi - qlo
+            data = datas[q].view(np.uint8)
+            ms = metas[q][comm.proc]
+            off = i = 0
+            # sender q packed in (its local rank, our local rank) order
+            for sl in range(qln):
+                for dl in range(ln):
+                    m = ms[i]
+                    i += 1
+                    dt = np.dtype(m["dtype"])
+                    nbytes = dt.itemsize * int(
+                        np.prod(m["shape"], dtype=np.int64)
+                    )
+                    out[dl][qlo + sl] = (
+                        data[off : off + nbytes].view(dt)
+                        .reshape(m["shape"]).copy()
+                    )
+                    off += nbytes
+        return out
 
     # -- non-blocking / persistent derivation ---------------------------
     #

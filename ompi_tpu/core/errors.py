@@ -114,6 +114,10 @@ class MPIBufferError(MPIError):
     error_class = MPI_ERR_BUFFER
 
 
+class MPIGroupError(MPIError):
+    error_class = MPI_ERR_GROUP
+
+
 class MPITopologyError(MPIError):
     error_class = MPI_ERR_TOPOLOGY
 
@@ -202,3 +206,35 @@ def error_string(error_class: int) -> str:
     """MPI_Error_string equivalent."""
     names = {v: k for k, v in globals().items() if k.startswith("MPI_ERR") or k == "MPI_SUCCESS"}
     return names.get(error_class, f"MPI error class {error_class}")
+
+
+class Errhandler:
+    """An MPI errhandler object (≈ ompi/errhandler/errhandler.h).
+
+    The Python surface raises typed exceptions for every error — the
+    idiomatic form of MPI_ERRORS_RETURN — so ERRORS_RETURN is the
+    default on Python-created communicators.  ERRORS_ARE_FATAL aborts
+    the process (the standard's default, honored by the C ABI where
+    conforming programs expect it).  ``fn`` supports
+    MPI_Comm_create_errhandler-style user callbacks: called with
+    (comm, error_class) before the fatal/return action."""
+
+    __slots__ = ("name", "fatal", "fn")
+
+    def __init__(self, name: str, fatal: bool, fn=None):
+        self.name = name
+        self.fatal = bool(fatal)
+        self.fn = fn
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<Errhandler {self.name}>"
+
+
+ERRORS_ARE_FATAL = Errhandler("MPI_ERRORS_ARE_FATAL", fatal=True)
+ERRORS_RETURN = Errhandler("MPI_ERRORS_RETURN", fatal=False)
+
+
+def create_errhandler(fn) -> Errhandler:
+    """MPI_Comm_create_errhandler: wrap a user callback."""
+    return Errhandler(getattr(fn, "__name__", "user_errhandler"),
+                      fatal=False, fn=fn)
