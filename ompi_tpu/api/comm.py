@@ -40,6 +40,7 @@ from ompi_tpu.ft import ulfm
 from ompi_tpu.ddt.datatype import Datatype, from_numpy_dtype
 from ompi_tpu.mesh.mesh import CommMesh
 from ompi_tpu.op.op import SUM, Op
+from ompi_tpu.p2p.part import PersistentP2PMixin
 from ompi_tpu.request import ArrayRequest, Request
 from ompi_tpu.tool import spc
 from .group import Group, UNDEFINED
@@ -86,7 +87,7 @@ def _reserve_cid_block(floor: int, n: int) -> int:
         return floor
 
 
-class Comm:
+class Comm(PersistentP2PMixin):
     """An intra-communicator."""
 
     def __init__(self, group: Group, mesh: CommMesh, name: str = ""):
@@ -181,9 +182,18 @@ class Comm:
 
     # -- construction (dup/split/create) --------------------------------
 
+    def _inherit(self, c: "Comm") -> "Comm":
+        """Derived-comm property propagation (MPI-4 §9.5: errhandler is
+        inherited by dup/create/split)."""
+        if hasattr(self, "_errhandler"):
+            c._errhandler = self._errhandler
+        return c
+
     def dup(self, name: str = "") -> "Comm":
         self._check()
-        return Comm(Group(self.group.ranks), self.mesh, name or f"{self.name}.dup")
+        return self._inherit(
+            Comm(Group(self.group.ranks), self.mesh, name or f"{self.name}.dup")
+        )
 
     def create_group(self, group: Group, name: str = "") -> "Comm | None":
         """MPI_Comm_create_group: new comm over a subset of this comm's
@@ -196,7 +206,7 @@ class Comm:
             return None
         sub = self.mesh.submesh(group.ranks)
         world_ranks = [self.group.ranks[r] for r in group.ranks]
-        return Comm(Group(world_ranks), sub, name)
+        return self._inherit(Comm(Group(world_ranks), sub, name))
 
     def split(self, colors: Sequence[int], keys: Sequence[int] | None = None) -> list["Comm | None"]:
         """MPI_Comm_split, whole-communicator view: ``colors[r]`` /

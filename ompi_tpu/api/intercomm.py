@@ -1,0 +1,203 @@
+"""Intercommunicators — two disjoint groups communicating.
+
+≈ ``ompi/communicator/intercomm_create`` + the coll/inter component
+(SURVEY.md §2.1 object model, §2.2 coll aux row).  Single-controller
+form: one Python process drives BOTH groups, so an ``Intercomm`` holds
+the two intra-communicators and its API takes/returns a rank-major
+buffer per side.  MPI intercomm collective semantics are preserved:
+
+* ``allreduce(xa, xb)``: group A receives the reduction of group B's
+  contributions and vice versa (the standard's crossed delivery);
+* ``bcast``: the root's row lands on every rank of the OTHER group;
+* ``allgather``: each group receives the other group's blocks;
+* ``merge``: MPI_Intercomm_merge → an intracommunicator over the
+  union, low group first.
+
+p2p addresses the remote group: ``send(buf, source, dest)`` sends from
+local-group rank ``source`` to REMOTE-group rank ``dest`` (the
+intercomm addressing rule).  Tags ride the parent-disjoint merged comm
+so intercomm traffic never collides with either intracomm's.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIArgError, MPIRankError, MPIRootError
+from ompi_tpu.mesh.mesh import CommMesh
+from ompi_tpu.op.op import SUM, Op
+from .comm import Comm, _next_cid
+from .group import Group
+
+#: MPI_ROOT / MPI_PROC_NULL for the rooted intercomm collectives
+ROOT = -3
+PROC_NULL = -2
+
+
+def create_intercomm(parent: Comm, local_ranks, remote_ranks,
+                     name: str = "") -> "Intercomm":
+    """MPI_Intercomm_create, single-controller form: both leaders are
+    visible, so the handshake collapses to constructing the pair of
+    intracomms over disjoint rank sets of ``parent``."""
+    a = list(local_ranks)
+    b = list(remote_ranks)
+    if not a or not b:
+        raise MPIArgError("intercomm groups must be non-empty")
+    if set(a) & set(b):
+        raise MPIArgError("intercomm groups must be disjoint")
+    comm_a = parent.create_group(Group(a), name=f"{name or 'inter'}.A")
+    comm_b = parent.create_group(Group(b), name=f"{name or 'inter'}.B")
+    return Intercomm(parent, comm_a, comm_b, name, a, b)
+
+
+class Intercomm:
+    """An intercommunicator over (group A, group B)."""
+
+    def __init__(self, parent: Comm, comm_a: Comm, comm_b: Comm,
+                 name: str = "", a_parent_ranks=None, b_parent_ranks=None):
+        self.parent = parent
+        self.local = comm_a   # "local" group from A's perspective
+        self.remote = comm_b
+        #: each side's ranks IN THE PARENT's numbering (p2p rides the
+        #: parent's matching engine, which addresses parent-local ranks
+        #: — comm.group.ranks would be world ranks and misroute when
+        #: the parent is itself a sub-communicator)
+        self._a_parent = list(a_parent_ranks if a_parent_ranks is not None
+                              else range(comm_a.size))
+        self._b_parent = list(b_parent_ranks if b_parent_ranks is not None
+                              else range(comm_b.size))
+        self.cid = _next_cid()
+        self.name = name or f"intercomm#{self.cid}"
+        self.is_inter = True
+        # intercomm p2p rides the parent's matching engine with a
+        # tag-space offset derived from the cid (comm isolation);
+        # user tags must fit the 16-bit window — see _check_tag
+        self._tag_base = (self.cid + 1) << 16
+
+    # -- geometry -------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        """Local group size (MPI_Comm_size on an intercomm)."""
+        return self.local.size
+
+    @property
+    def remote_size(self) -> int:
+        """MPI_Comm_remote_size."""
+        return self.remote.size
+
+    def remote_group(self) -> Group:
+        """MPI_Comm_remote_group (parent-rank view)."""
+        return Group(self.remote.group.ranks)
+
+    # -- p2p: source is a LOCAL-group rank, dest a REMOTE-group rank ---
+
+    def _side(self, remote_first: bool):
+        if remote_first:
+            return (self.remote, self._b_parent), (self.local, self._a_parent)
+        return (self.local, self._a_parent), (self.remote, self._b_parent)
+
+    @staticmethod
+    def _parent_rank(side, r: int) -> int:
+        comm, pranks = side
+        if not 0 <= r < comm.size:
+            raise MPIRankError(f"rank {r} outside group of {comm.size}")
+        return pranks[r]
+
+    def _check_tag(self, tag: int) -> int:
+        if not 0 <= tag < (1 << 16):
+            raise MPIArgError(
+                f"intercomm tag {tag} outside [0, 65536) — the per-"
+                f"intercomm tag window on the parent's matching engine"
+            )
+        return tag
+
+    def send(self, buf, source: int, dest: int, tag: int = 0,
+             from_remote: bool = False) -> None:
+        """Send from group-A rank ``source`` to group-B rank ``dest``
+        (``from_remote=True`` for the B→A direction)."""
+        src_side, dst_side = self._side(from_remote)
+        ps = self._parent_rank(src_side, source)
+        pd = self._parent_rank(dst_side, dest)
+        self.parent.send(buf, ps, pd, self._tag_base + self._check_tag(tag))
+
+    def recv(self, dest: int, source: int | None = None, tag: int = 0,
+             at_remote: bool = False):
+        """Receive at group-A rank ``dest`` from group-B rank
+        ``source`` (``at_remote=True`` for B receiving from A).  A
+        concrete tag is required: ANY_TAG on the parent engine would
+        wildcard-match traffic outside this intercomm's tag window."""
+        dst_side, src_side = self._side(at_remote)
+        pd = self._parent_rank(dst_side, dest)
+        ps = (None if source is None
+              else self._parent_rank(src_side, source))
+        payload, st = self.parent.recv(
+            pd, ps, self._tag_base + self._check_tag(tag)
+        )
+        # translate the status back to sender-group rank / user tag
+        st.source = src_side[1].index(st.source)
+        st.tag = st.tag - self._tag_base
+        return payload, st
+
+    # -- collectives (rank-major per side) ------------------------------
+
+    def allreduce(self, xa, xb, op: Op = SUM) -> tuple[Any, Any]:
+        """Intercomm allreduce: A's rows receive reduce(B), B's rows
+        receive reduce(A) — the crossed delivery of MPI 5.8."""
+        ra = np.asarray(self.local.allreduce(np.asarray(xa), op))[0]
+        rb = np.asarray(self.remote.allreduce(np.asarray(xb), op))[0]
+        ya = np.broadcast_to(rb, np.shape(xa)).copy()
+        yb = np.broadcast_to(ra, np.shape(xb)).copy()
+        return ya, yb
+
+    def bcast(self, x, root: int, root_in_local: bool = True):
+        """Rooted intercomm bcast: the root's row is delivered to every
+        rank of the OTHER group; returns that group's rank-major buffer
+        (the root group's ranks pass MPI_PROC_NULL in the standard —
+        single-controller returns only the receiving side)."""
+        src_comm, dst_comm = (
+            (self.local, self.remote) if root_in_local else (self.remote, self.local)
+        )
+        if not 0 <= root < src_comm.size:
+            raise MPIRootError(f"root {root} not in [0, {src_comm.size})")
+        row = np.asarray(x)[root]
+        return np.broadcast_to(row, (dst_comm.size,) + row.shape).copy()
+
+    def allgather(self, xa, xb) -> tuple[Any, Any]:
+        """Each group receives the other group's blocks: A's result rows
+        hold B's (remote_size, ...) blocks and vice versa."""
+        a = np.asarray(xa)
+        b = np.asarray(xb)
+        ya = np.broadcast_to(b[None], (a.shape[0],) + b.shape).copy()
+        yb = np.broadcast_to(a[None], (b.shape[0],) + a.shape).copy()
+        return ya, yb
+
+    def barrier(self) -> None:
+        self.local.barrier()
+        self.remote.barrier()
+
+    # -- merge ----------------------------------------------------------
+
+    def merge(self, high_group_local: bool = False) -> Comm:
+        """MPI_Intercomm_merge: intracomm over the union; the group
+        passing high=false is ordered first (here: local first unless
+        ``high_group_local``)."""
+        first, second = (
+            (self.remote, self.local) if high_group_local
+            else (self.local, self.remote)
+        )
+        ranks = list(first.group.ranks) + list(second.group.ranks)
+        mesh = CommMesh(
+            [d for c in (first, second) for d in c.mesh.devices]
+        )
+        return Comm(Group(ranks), mesh, name=f"{self.name}.merged")
+
+    def free(self) -> None:
+        self.local.free()
+        self.remote.free()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"<Intercomm {self.name} local={self.local.size} "
+                f"remote={self.remote.size}>")

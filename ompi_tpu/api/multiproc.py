@@ -30,13 +30,14 @@ from ompi_tpu.core.errors import MPIArgError, MPICommError, MPIRankError
 from ompi_tpu.coll.module import CollTable, select_coll_modules
 from ompi_tpu.mesh.mesh import CommMesh
 from ompi_tpu.op.op import SUM, Op
+from ompi_tpu.p2p.part import PersistentP2PMixin
 from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG, MatchingEngine
 from ompi_tpu.request import Request
 from .comm import COLOR_UNDEFINED, _next_cid, _peek_cid, _reserve_cid_block
 from .group import Group
 
 
-class MultiProcComm:
+class MultiProcComm(PersistentP2PMixin):
     """A communicator spanning processes of the job: the world (built by
     ``init`` via the modex) or any cross-process subset produced by
     :meth:`split` — sub-comms ride a :class:`~ompi_tpu.dcn.collops.

@@ -1,0 +1,162 @@
+"""Partitioned point-to-point (MPI-4 ``MPI_Psend_init``/``MPI_Precv_init``).
+
+≈ the reference's ``mca/part/persist`` component (SURVEY.md §2.2 part
+row, ≥5.0): a persistent channel whose send buffer is filled in
+partitions, each marked ready with ``pready(i)``; the transfer may
+complete partition-by-partition or aggregated — this implementation
+aggregates (a conforming choice the reference's persist component also
+makes for small partition counts): the message is handed to the pml
+when the LAST partition is marked ready, so partially-ready starts
+never publish stale bytes.
+
+Receiver side: ``parrived(i)`` reports per-partition arrival; with
+aggregated transfer all partitions arrive together, which conforms
+(arrival may be observed late, never early).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIArgError, MPIRequestError
+from ompi_tpu.request import Request
+
+
+class PersistentP2PMixin:
+    """Persistent (Send_init/Recv_init) and partitioned (Psend/Precv)
+    channel constructors over any communicator exposing ``send`` /
+    ``irecv`` — shared by Comm and MultiProcComm."""
+
+    def send_init(self, buf, source: int, dest: int, tag: int = 0):
+        """MPI_Send_init: persistent send channel.  ``buf`` is held by
+        reference — each ``start()`` sends its CURRENT contents, the
+        standard's refill-between-starts contract."""
+        from ompi_tpu.request import CompletedRequest, PersistentRequest
+
+        def dispatch():
+            self.send(buf, source, dest, tag)
+            return CompletedRequest()
+
+        return PersistentRequest(dispatch)
+
+    def recv_init(self, dest: int, source: int | None = None,
+                  tag: int | None = None):
+        """MPI_Recv_init: persistent receive channel."""
+        from ompi_tpu.request import PersistentRequest
+
+        return PersistentRequest(lambda: self.irecv(dest, source, tag))
+
+    def psend_init(self, buf, partitions: int, source: int, dest: int,
+                   tag: int = 0):
+        """MPI_Psend_init (partitioned send — see module docstring)."""
+        return PsendRequest(self, buf, partitions, source, dest, tag)
+
+    def precv_init(self, partitions: int, dest: int,
+                   source: int | None = None, tag: int | None = None):
+        """MPI_Precv_init."""
+        return PrecvRequest(self, partitions, dest, source, tag)
+
+
+class PsendRequest(Request):
+    """Partitioned send channel (MPI_Psend_init → Start → Pready*)."""
+
+    def __init__(self, comm, buf, partitions: int, source: int, dest: int,
+                 tag: int):
+        super().__init__()
+        if partitions < 1:
+            raise MPIArgError(f"partitions must be >= 1, got {partitions}")
+        arr = np.asarray(buf)
+        if arr.shape[0] % partitions:
+            raise MPIArgError(
+                f"leading dim {arr.shape[0]} not divisible into "
+                f"{partitions} partitions"
+            )
+        self.comm = comm
+        self.buf = arr  # by reference: Start() reads current contents
+        self.partitions = partitions
+        self.source, self.dest, self.tag = source, dest, tag
+        self._active = False
+        self._ready: set[int] = set()
+        self._complete = True  # inactive persistent requests are complete
+
+    def start(self) -> "PsendRequest":
+        if self._active:
+            raise MPIRequestError("partitioned send started while active")
+        self._active = True
+        self._ready.clear()
+        self._complete = False
+        return self
+
+    def pready(self, partition: int) -> None:
+        """MPI_Pready: partition may be sent.  On the last one the
+        aggregated message goes to the matching engine."""
+        if not self._active:
+            raise MPIRequestError("pready before start")
+        if not 0 <= partition < self.partitions:
+            raise MPIArgError(f"partition {partition} out of range")
+        if partition in self._ready:
+            raise MPIRequestError(f"partition {partition} already ready")
+        self._ready.add(partition)
+        if len(self._ready) == self.partitions:
+            self.comm.send(np.asarray(self.buf).copy(), source=self.source,
+                           dest=self.dest, tag=self.tag)
+            self._active = False
+            self._complete = True
+
+    def pready_range(self, lo: int, hi: int) -> None:
+        for p in range(lo, hi + 1):
+            self.pready(p)
+
+    def _poll(self) -> bool:
+        return not self._active
+
+    def _block(self) -> None:
+        if self._active:
+            raise MPIRequestError(
+                f"wait on partitioned send with only {len(self._ready)}/"
+                f"{self.partitions} partitions ready — mark all with pready"
+            )
+
+
+class PrecvRequest(Request):
+    """Partitioned receive channel (MPI_Precv_init → Start → Parrived)."""
+
+    def __init__(self, comm, partitions: int, dest: int, source: int,
+                 tag: int):
+        super().__init__()
+        if partitions < 1:
+            raise MPIArgError(f"partitions must be >= 1, got {partitions}")
+        self.comm = comm
+        self.partitions = partitions
+        self.dest, self.source, self.tag = dest, source, tag
+        self._inner = None
+        self._complete = True
+
+    def start(self) -> "PrecvRequest":
+        if self._inner is not None and not self._inner.test():
+            raise MPIRequestError("partitioned recv started while active")
+        self._inner = self.comm.irecv(self.dest, self.source, self.tag)
+        self._complete = False
+        return self
+
+    def parrived(self, partition: int) -> bool:
+        """MPI_Parrived: has this partition's data arrived?"""
+        if not 0 <= partition < self.partitions:
+            raise MPIArgError(f"partition {partition} out of range")
+        if self._inner is None:
+            raise MPIRequestError("parrived before start")
+        return self._inner.test()
+
+    @property
+    def status(self):
+        return None if self._inner is None else self._inner.status
+
+    def _poll(self) -> bool:
+        return self._inner is None or self._inner.test()
+
+    def _block(self) -> None:
+        if self._inner is not None:
+            self._inner.wait()
+
+    def _finalize(self):
+        return None if self._inner is None else self._inner.wait()

@@ -169,6 +169,11 @@ class PersistentRequest(Request):
     def _finalize(self) -> Any:
         return None if self._active is None else self._active.wait()
 
+    @property
+    def status(self):
+        """Envelope of the most recent round (persistent recv)."""
+        return getattr(self._active, "status", None)
+
 
 # -- wait/test families (MPI_Waitall etc.) -----------------------------
 
