@@ -51,12 +51,23 @@ def _iters_for(nbytes: int, iters: int) -> tuple[int, int]:
     return 4, iters
 
 
-def _row(nbytes: int, n: int, t_fw: list[float], t_raw: list[float]) -> dict:
+#: OSU bus-bandwidth factors by collective (bytes-on-the-wire models)
+_BUS_FACTOR = {
+    "allreduce": lambda n: 2.0 * (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "allgather": lambda n: (n - 1) / n,
+    "alltoall": lambda n: (n - 1) / n,
+    "bcast": lambda n: 1.0,
+}
+
+
+def _row(nbytes: int, n: int, t_fw: list[float], t_raw: list[float],
+         coll: str = "allreduce") -> dict:
     fw_min, raw_min = min(t_fw), min(t_raw)
     fw_p50 = float(np.median(t_fw))
     raw_p50 = float(np.median(t_raw))
     alg = nbytes / fw_min / 1e9 if fw_min > 0 else 0.0
-    bus = 2.0 * (n - 1) / n * alg  # OSU allreduce bus-bandwidth model
+    bus = _BUS_FACTOR[coll](n) * alg
     return {
         "bytes": nbytes,
         "fw_us_min": round(fw_min * 1e6, 2),
@@ -154,7 +165,7 @@ def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
             w, it = _iters_for(nb, iters)
             t_fw = _times(fw, w, it)
             t_raw = _times(lambda: raw[name](x), w, it)
-            out.append(_row(nb, n, t_fw, t_raw))
+            out.append(_row(nb, n, t_fw, t_raw, coll=name))
             del x
         colls[name] = out
 

@@ -15,8 +15,10 @@ buffer per side.  MPI intercomm collective semantics are preserved:
 
 p2p addresses the remote group: ``send(buf, source, dest)`` sends from
 local-group rank ``source`` to REMOTE-group rank ``dest`` (the
-intercomm addressing rule).  Tags ride the parent-disjoint merged comm
-so intercomm traffic never collides with either intracomm's.
+intercomm addressing rule).  p2p rides a DEDICATED internal channel
+communicator over the union of both groups (its own matching engine),
+so intercomm traffic cannot collide with the parent's own p2p or with
+other intercomms — full comm isolation, unrestricted MPI tags.
 """
 
 from __future__ import annotations
@@ -71,10 +73,13 @@ class Intercomm:
         self.cid = _next_cid()
         self.name = name or f"intercomm#{self.cid}"
         self.is_inter = True
-        # intercomm p2p rides the parent's matching engine with a
-        # tag-space offset derived from the cid (comm isolation);
-        # user tags must fit the 16-bit window — see _check_tag
-        self._tag_base = (self.cid + 1) << 16
+        # dedicated p2p channel over the union (A then B): its own
+        # matching engine isolates intercomm traffic completely
+        self._chan = Comm(
+            Group(list(comm_a.group.ranks) + list(comm_b.group.ranks)),
+            CommMesh(list(comm_a.mesh.devices) + list(comm_b.mesh.devices)),
+            name=f"{self.name}.chan",
+        )
 
     # -- geometry -------------------------------------------------------
 
@@ -94,51 +99,37 @@ class Intercomm:
 
     # -- p2p: source is a LOCAL-group rank, dest a REMOTE-group rank ---
 
-    def _side(self, remote_first: bool):
-        if remote_first:
-            return (self.remote, self._b_parent), (self.local, self._a_parent)
-        return (self.local, self._a_parent), (self.remote, self._b_parent)
-
-    @staticmethod
-    def _parent_rank(side, r: int) -> int:
-        comm, pranks = side
+    def _chan_rank(self, comm: Comm, offset: int, r: int) -> int:
+        """Channel rank of side-local rank ``r`` (A occupies
+        [0, |A|), B occupies [|A|, |A|+|B|))."""
         if not 0 <= r < comm.size:
             raise MPIRankError(f"rank {r} outside group of {comm.size}")
-        return pranks[r]
+        return offset + r
 
-    def _check_tag(self, tag: int) -> int:
-        if not 0 <= tag < (1 << 16):
-            raise MPIArgError(
-                f"intercomm tag {tag} outside [0, 65536) — the per-"
-                f"intercomm tag window on the parent's matching engine"
-            )
-        return tag
+    def _sides(self, remote_first: bool):
+        a = (self.local, 0)
+        b = (self.remote, self.local.size)
+        return (b, a) if remote_first else (a, b)
 
     def send(self, buf, source: int, dest: int, tag: int = 0,
              from_remote: bool = False) -> None:
         """Send from group-A rank ``source`` to group-B rank ``dest``
         (``from_remote=True`` for the B→A direction)."""
-        src_side, dst_side = self._side(from_remote)
-        ps = self._parent_rank(src_side, source)
-        pd = self._parent_rank(dst_side, dest)
-        self.parent.send(buf, ps, pd, self._tag_base + self._check_tag(tag))
+        (sc, so), (dc, do) = self._sides(from_remote)
+        self._chan.send(buf, self._chan_rank(sc, so, source),
+                        self._chan_rank(dc, do, dest), tag)
 
-    def recv(self, dest: int, source: int | None = None, tag: int = 0,
-             at_remote: bool = False):
+    def recv(self, dest: int, source: int | None = None,
+             tag: int | None = None, at_remote: bool = False):
         """Receive at group-A rank ``dest`` from group-B rank
-        ``source`` (``at_remote=True`` for B receiving from A).  A
-        concrete tag is required: ANY_TAG on the parent engine would
-        wildcard-match traffic outside this intercomm's tag window."""
-        dst_side, src_side = self._side(at_remote)
-        pd = self._parent_rank(dst_side, dest)
-        ps = (None if source is None
-              else self._parent_rank(src_side, source))
-        payload, st = self.parent.recv(
-            pd, ps, self._tag_base + self._check_tag(tag)
-        )
-        # translate the status back to sender-group rank / user tag
-        st.source = src_side[1].index(st.source)
-        st.tag = st.tag - self._tag_base
+        ``source`` (``at_remote=True`` for B receiving from A).
+        Wildcards (source/tag None) are safe: the channel's matching
+        engine carries only this intercomm's traffic."""
+        (dc, do), (sc, so) = self._sides(at_remote)
+        pd = self._chan_rank(dc, do, dest)
+        ps = None if source is None else self._chan_rank(sc, so, source)
+        payload, st = self._chan.recv(pd, ps, tag)
+        st.source = st.source - so  # back to sender-group rank
         return payload, st
 
     # -- collectives (rank-major per side) ------------------------------
@@ -195,6 +186,7 @@ class Intercomm:
         return Comm(Group(ranks), mesh, name=f"{self.name}.merged")
 
     def free(self) -> None:
+        self._chan.free()
         self.local.free()
         self.remote.free()
 

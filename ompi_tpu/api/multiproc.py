@@ -71,12 +71,23 @@ class MultiProcComm(PersistentP2PMixin):
             name=f"{name}.local{self.proc}",
         )
 
-        self._coll: CollTable | None = None
-        self._pml: MatchingEngine | None = None
+        self._wire()
+
+    def _wire(self) -> None:
+        """Per-comm runtime wiring — ONE path shared by __init__ /
+        dup / _make_sub: fresh coll/pml/NBC/FT state, frame routing,
+        and failure fan-out registration."""
+        self._coll = None
+        self._pml = None
         self._pml_lock = threading.Lock()
         self._nbc_count = 0
         self._nbc_lock = threading.Lock()
+        self._ft = None
+        self._shrink_count = 0
+        self._freed = False
         self.dcn.register_p2p(self.cid, self._on_p2p_frame)
+        self.dcn.register_comm(self.cid, self)
+        self.procctx.register_comm(self)
 
     def _next_nbc(self) -> int:
         """Per-comm non-blocking-collective issue counter: identical on
@@ -120,41 +131,51 @@ class MultiProcComm(PersistentP2PMixin):
 
     # -- collectives (local rank-major buffers (local_n, ...)) ----------
 
+    def _lookup(self, slot: str):
+        """FT-guarded coll-table lookup — the same structural choke
+        point Comm has, so multi-process collectives honor ULFM state
+        (revoked comm / failed member raises before any traffic)."""
+        if self._ft is not None:
+            from ompi_tpu.ft import ulfm
+
+            ulfm.check(self, collective=True)
+        return self.coll.lookup(slot)
+
     def allreduce(self, x, op: Op = SUM):
-        return self.coll.lookup("allreduce")(x, op)
+        return self._lookup("allreduce")(x, op)
 
     def bcast(self, x, root: int = 0):
-        return self.coll.lookup("bcast")(x, root)
+        return self._lookup("bcast")(x, root)
 
     def reduce(self, x, op: Op = SUM, root: int = 0):
         self.locate(root)  # MPI_ERR_RANK/ROOT before any traffic
-        return self.coll.lookup("reduce")(x, op, root)
+        return self._lookup("reduce")(x, op, root)
 
     def allgather(self, x):
-        return self.coll.lookup("allgather")(x)
+        return self._lookup("allgather")(x)
 
     def gather(self, x, root: int = 0):
         """Root's recvbuf (global_n, *s) on the process owning ``root``;
         None elsewhere (MPI: recvbuf significant only at root)."""
-        return self.coll.lookup("gather")(x, root)
+        return self._lookup("gather")(x, root)
 
     def scatter(self, x, root: int = 0):
-        return self.coll.lookup("scatter")(x, root)
+        return self._lookup("scatter")(x, root)
 
     def reduce_scatter_block(self, x, op: Op = SUM):
-        return self.coll.lookup("reduce_scatter_block")(x, op)
+        return self._lookup("reduce_scatter_block")(x, op)
 
     def alltoall(self, x):
-        return self.coll.lookup("alltoall")(x)
+        return self._lookup("alltoall")(x)
 
     def scan(self, x, op: Op = SUM):
-        return self.coll.lookup("scan")(x, op)
+        return self._lookup("scan")(x, op)
 
     def exscan(self, x, op: Op = SUM):
-        return self.coll.lookup("exscan")(x, op)
+        return self._lookup("exscan")(x, op)
 
     def barrier(self) -> None:
-        self.coll.lookup("barrier")()
+        self._lookup("barrier")()
 
     def set_errhandler(self, errhandler) -> None:
         from ompi_tpu.core.errors import Errhandler
@@ -180,30 +201,43 @@ class MultiProcComm(PersistentP2PMixin):
             from ompi_tpu.core.errors import MPIInternalError
 
             try:
-                return self.coll.lookup(name)
+                fn = self.coll.lookup(name)
             except MPIInternalError as e:
                 # slot genuinely unserved → AttributeError keeps the
                 # hasattr/getattr probe contract; anything else (freed
                 # comm, selection failure) propagates like the blocking
                 # entry points' errors do
                 raise AttributeError(name) from e
+
+            def guarded(*a, **k):
+                # FT guard at CALL time (same choke as _lookup): i*/
+                # _init variants must honor revoke/failure like their
+                # blocking twins, while attr probes stay side-effect
+                # free
+                if self._ft is not None:
+                    from ompi_tpu.ft import ulfm
+
+                    ulfm.check(self, collective=True)
+                return fn(*a, **k)
+
+            return guarded
         raise AttributeError(name)
 
     def allgatherv(self, blocks: Sequence[np.ndarray]):
-        return self.coll.lookup("allgatherv")(blocks)
+        return self._lookup("allgatherv")(blocks)
 
     def gatherv(self, blocks: Sequence[np.ndarray], root: int = 0):
-        return self.coll.lookup("gatherv")(blocks, root)
+        return self._lookup("gatherv")(blocks, root)
 
     def scatterv(self, blocks: Sequence[np.ndarray] | None, root: int = 0):
         """blocks: one array per GLOBAL rank, meaningful on root's
         process; returns this process's local ranks' blocks."""
-        return self.coll.lookup("scatterv")(blocks, root)
+        return self._lookup("scatterv")(blocks, root)
 
     def alltoallv(self, matrix: Sequence[Sequence[np.ndarray]]):
         """matrix[l][j]: block from local rank l to global rank j;
         returns out[l][src] = block global rank src sent to l."""
-        return self.coll.lookup("alltoallv")(matrix)
+        return self._lookup("alltoallv")(matrix)
 
     # -- p2p -------------------------------------------------------------
 
@@ -226,6 +260,10 @@ class MultiProcComm(PersistentP2PMixin):
 
     def send(self, buf, source: int, dest: int, tag: int = 0) -> None:
         """Send from a LOCAL global rank ``source`` to any global rank."""
+        if self._ft is not None:
+            from ompi_tpu.ft import ulfm
+
+            ulfm.check(self, peer=dest)
         sproc, _ = self.locate(source)
         if sproc != self.proc:
             raise MPIRankError(
@@ -251,6 +289,10 @@ class MultiProcComm(PersistentP2PMixin):
             )
 
     def irecv(self, dest: int, source: int | None = None, tag: int | None = None) -> Request:
+        if self._ft is not None:
+            from ompi_tpu.ft import ulfm
+
+            ulfm.check(self, peer=source, any_source=source is None)
         dproc, _ = self.locate(dest)
         if dproc != self.proc:
             raise MPIRankError(f"rank {dest} not owned by process {self.proc}")
@@ -263,6 +305,120 @@ class MultiProcComm(PersistentP2PMixin):
     def recv(self, dest: int, source: int | None = None, tag: int | None = None):
         req = self.irecv(dest, source, tag)
         return req.wait(), req.status
+
+    # -- fault tolerance (ULFM over DCN — SURVEY.md §5) ------------------
+
+    def _on_proc_failed(self, root_proc: int) -> None:
+        """Detector fan-out: mark the dead process's global ranks failed
+        on this comm (no-op if the proc isn't a member)."""
+        from ompi_tpu.ft import ulfm
+
+        lp = self.dcn.local_proc_of(root_proc)
+        if lp is None:
+            return
+        lo, hi = self.proc_range(lp)
+        ulfm.state(self).failed.update(range(lo, hi))
+
+    def revoke(self) -> None:
+        """MPIX_Comm_revoke: poison this comm everywhere — the local
+        mark plus a ``rvk`` control frame to every member process (the
+        out-of-band broadcast that beats the failure news)."""
+        from ompi_tpu.ft import ulfm
+
+        ulfm.state(self).revoked = True
+        for p in range(self.nprocs):
+            if p != self.proc and not self.dcn.proc_failed(p):
+                try:
+                    self.dcn.send_ctrl(p, {"kind": "rvk", "cid": self.cid})
+                except Exception:  # noqa: BLE001 — peer may be dying
+                    pass
+
+    def is_revoked(self) -> bool:
+        from ompi_tpu.ft import ulfm
+
+        return ulfm.is_revoked(self)
+
+    def get_failed(self) -> list[int]:
+        from ompi_tpu.ft import ulfm
+
+        return ulfm.get_failed(self)
+
+    def ack_failed(self) -> int:
+        from ompi_tpu.ft import ulfm
+
+        return ulfm.ack_failed(self)
+
+    def agree(self, flags: int) -> int:
+        """MPIX_Comm_agree over the surviving processes: bitwise-AND
+        allreduce on a shrink-style survivor stream (works on revoked
+        comms — agreement is how ranks coordinate after revoke)."""
+        live = self._live_procs()
+        from ompi_tpu.dcn.collops import DcnSubEngine
+        from ompi_tpu.op import BAND
+
+        eng = self.dcn if len(live) == self.nprocs else DcnSubEngine(
+            self.dcn, live
+        )
+        k = self._next_shrink()
+        out = eng.allreduce(np.array([int(flags)], np.int64), BAND,
+                            f"{self.cid}#agree{k}", ordered=True)
+        return int(out[0])
+
+    def _live_procs(self) -> list[int]:
+        from ompi_tpu.ft import ulfm
+
+        st = ulfm.peek(self)
+        dead_ranks = st.failed if st else set()
+        dead_procs = {
+            p for p in range(self.nprocs)
+            if set(range(*self.proc_range(p))) & dead_ranks
+        }
+        live = [p for p in range(self.nprocs) if p not in dead_procs]
+        if self.proc not in live:
+            raise MPICommError("calling process is marked failed")
+        return live
+
+    def _next_shrink(self) -> int:
+        k = self._shrink_count
+        self._shrink_count += 1
+        return k
+
+    def shrink(self, name: str = "") -> "MultiProcComm":
+        """MPIX_Comm_shrink: rebuild membership over the surviving
+        processes.  Survivors exchange their failed-set view + CID
+        proposals on a derived stream; the union decides membership and
+        the MAX decides the new CID (works on revoked comms — shrink IS
+        the recovery path).
+
+        Convergence requirement (ftagree's job in the reference): every
+        survivor must already know the same failed set — heartbeat
+        gossip converges within one period, so call shrink after
+        ``get_failed`` reflects the failure on every survivor."""
+        from ompi_tpu.dcn.collops import DcnSubEngine
+
+        live = self._live_procs()
+        eng = DcnSubEngine(self.dcn, live) if len(live) < self.nprocs else self.dcn
+        k = self._next_shrink()
+        infos = eng.allgather_obj(
+            {"cid": _peek_cid(),
+             "dead": sorted(set(range(self.nprocs)) - set(live))},
+            f"{self.cid}#shrink{k}",
+        )
+        all_dead: set[int] = set()
+        for it in infos:
+            all_dead.update(it["dead"])
+        if all_dead & set(live):
+            raise MPICommError(
+                "shrink: survivors disagree on the failed set "
+                f"(late detections {sorted(all_dead & set(live))}); "
+                "wait for detection to converge and retry"
+            )
+        cid = _reserve_cid_block(max(int(it["cid"]) for it in infos), 1)
+        members = [r for p in live for r in range(*self.proc_range(p))]
+        owners = [p for p in live for _ in range(self.proc_sizes[p])]
+        sub = self._make_sub("shrunk", cid, members, owners, live)
+        sub.name = name or f"{self.name}.shrunk"
+        return sub
 
     # -- lifecycle -------------------------------------------------------
 
@@ -281,13 +437,7 @@ class MultiProcComm(PersistentP2PMixin):
         c.__dict__.update(self.__dict__)
         c.cid = self._agree_cids(1)
         c.name = name or f"{self.name}.dup"
-        c._coll = None
-        c._pml = None
-        c._pml_lock = threading.Lock()
-        c._nbc_count = 0
-        c._nbc_lock = threading.Lock()
-        c._freed = False
-        c.dcn.register_p2p(c.cid, c._on_p2p_frame)
+        c._wire()
         return c
 
     def split(
@@ -414,16 +564,12 @@ class MultiProcComm(PersistentP2PMixin):
             c.local_mesh,
             name=f"{c.name}.local{c.proc}",
         )
-        c._coll = None
-        c._pml = None
-        c._pml_lock = threading.Lock()
-        c._nbc_count = 0
-        c._nbc_lock = threading.Lock()
-        c.dcn.register_p2p(c.cid, c._on_p2p_frame)
+        c._wire()
         return c
 
     def free(self) -> None:
         self.dcn.unregister_p2p(self.cid)
+        self.dcn.unregister_comm(self.cid)
         self._freed = True
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -57,10 +57,44 @@ class ProcContext:
         self.engine.set_addresses(
             [self.kvs.get(f"dcn.{p}") for p in range(self.nprocs)]
         )
+        # failure detector (tpurun --ft / --mca ft_detector_enable 1):
+        # heartbeats + gossip; detections fan out to every registered
+        # communicator's ULFM state (SURVEY.md §5 failure detection)
+        import threading
+        import weakref
+
+        self._ft_comms: "weakref.WeakSet" = weakref.WeakSet()
+        self._ft_lock = threading.Lock()
+        self.detector = None
+        from ompi_tpu.ft.detector import FtDetectorComponent, HeartbeatDetector
+
+        ftp = FtDetectorComponent().params(ctx.store)
+        if ftp["enable"] and self.nprocs > 1:
+            self.detector = HeartbeatDetector(
+                self.engine, period=ftp["period"], timeout=ftp["timeout"]
+            )
+            self.detector.on_failure(self._fan_out_failure)
+
+    def _fan_out_failure(self, root_proc: int) -> None:
+        with self._ft_lock:  # registration races the detector thread
+            comms = list(self._ft_comms)
+        for comm in comms:
+            comm._on_proc_failed(root_proc)
+
+    def register_comm(self, comm) -> None:
+        """Track a MultiProcComm for failure fan-out; replay known
+        failures so comms created post-failure start consistent."""
+        with self._ft_lock:
+            self._ft_comms.add(comm)
+        if self.detector is not None:
+            for p in self.detector.failed():
+                comm._on_proc_failed(p)
 
     def fence(self, name: str) -> None:
         self.kvs.fence(name, self.proc, self.nprocs)
 
     def close(self) -> None:
+        if self.detector is not None:
+            self.detector.close()
         self.engine.close()
         self.kvs.close()

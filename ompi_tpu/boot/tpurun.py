@@ -43,7 +43,14 @@ def run_job(
     mca: dict[str, str] | None = None,
     cpu_devices: int | None = None,
     extra_env: dict[str, str] | None = None,
+    ft: bool = False,
 ) -> int:
+    """``ft=True`` ≈ ``mpirun --with-ft ulfm``: worker death does NOT
+    kill the job (survivors run ULFM recovery); the heartbeat detector
+    is enabled in every worker and the job's exit code is rank 0's."""
+    if ft:
+        mca = dict(mca or {})
+        mca.setdefault("ft_detector_enable", "1")
     server = KVSServer()
     procs: list[subprocess.Popen] = []
     threads: list[threading.Thread] = []
@@ -103,7 +110,9 @@ def run_job(
             threads.append(t)
 
         # job state machine: poll ALL children so a failure anywhere
-        # kills the job even while other ranks block (errmgr default)
+        # kills the job even while other ranks block (errmgr default);
+        # under --ft, deaths are survivable events the workers' ULFM
+        # machinery handles, so only record them
         exit_code = 0
         live = set(range(np_))
         import time as _time
@@ -114,13 +123,15 @@ def run_job(
                 if rc is None:
                     continue
                 live.discard(i)
-                if rc != 0 and exit_code == 0:
+                if rc != 0 and exit_code == 0 and not ft:
                     exit_code = rc
                     for q in procs:
                         if q.poll() is None:
                             q.send_signal(signal.SIGTERM)
             if live:
                 _time.sleep(0.05)
+        if ft:
+            exit_code = procs[0].returncode or 0
         for t in threads:
             t.join(timeout=2)
         return exit_code
@@ -144,11 +155,17 @@ def main(argv: list[str] | None = None) -> int:
         "--cpu-devices", type=int, default=None,
         help="per-process virtual CPU device count (testing without TPU)",
     )
+    parser.add_argument(
+        "--ft", action="store_true",
+        help="fault-tolerant job: worker death does not kill the job; "
+        "heartbeat failure detection + ULFM recovery in the workers",
+    )
     parser.add_argument("script", help="python script to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(argv)
     mca = {k: v for k, v in ns.mca}
-    return run_job(ns.np, [ns.script] + ns.args, mca, ns.cpu_devices)
+    return run_job(ns.np, [ns.script] + ns.args, mca, ns.cpu_devices,
+                   ft=ns.ft)
 
 
 if __name__ == "__main__":

@@ -23,6 +23,7 @@ _BUILTIN_COMPONENT_MODULES = (
     "ompi_tpu.osc.component",
     "ompi_tpu.io.component",
     "ompi_tpu.tool.monitoring",
+    "ompi_tpu.ft.detector",
 )
 
 

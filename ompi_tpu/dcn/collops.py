@@ -55,6 +55,11 @@ class DcnCollEngine:
         self._queues: dict[tuple, queue.Queue] = {}
         self._qlock = threading.Lock()
         self._seq: dict[int, int] = {}
+        #: failure-detection state (ft/detector.py): procs known dead,
+        #: the attached detector, and cid → comm for revoke delivery
+        self._failed_procs: set[int] = set()
+        self._detector = None
+        self._comms: dict = {}  # cid → weakref to MultiProcComm
         #: cid → handler: p2p frames are routed per-communicator so
         #: dup'd comms keep isolated matching (MPI comm isolation)
         self._p2p_handlers: dict[int, Callable] = {}
@@ -113,7 +118,50 @@ class DcnCollEngine:
         with self._qlock:
             self._queues.pop(key, None)
 
+    # -- failure detection / revoke hooks (ft/detector.py) ---------------
+
+    def attach_detector(self, detector) -> None:
+        self._detector = detector
+
+    def note_proc_failed(self, proc: int) -> None:
+        """Mark a ROOT-engine proc index dead: pending and future
+        ``_recv`` calls naming it raise instead of timing out."""
+        self._failed_procs.add(proc)
+
+    def proc_failed(self, local_proc: int) -> bool:
+        return local_proc in self._failed_procs
+
+    def send_ctrl(self, dst: int, envelope: dict) -> None:
+        """Small control frame (heartbeat / failure gossip / revoke)."""
+        self.transport.send(self.addresses[dst], dict(envelope),
+                            np.zeros(0, np.uint8))
+
+    def register_comm(self, cid, comm) -> None:
+        import weakref
+
+        self._comms[cid] = weakref.ref(comm)
+
+    def unregister_comm(self, cid) -> None:
+        self._comms.pop(cid, None)
+
     def _on_frame(self, env: dict, payload: np.ndarray) -> None:
+        kind = env.get("kind")
+        if kind == "hb":
+            if self._detector is not None:
+                self._detector.on_heartbeat(env["src"])
+            return
+        if kind == "flr":
+            if self._detector is not None:
+                self._detector.mark_failed(env["proc"], gossip=False)
+            return
+        if kind == "rvk":
+            ref = self._comms.get(env["cid"])
+            comm = ref() if ref is not None else None
+            if comm is not None:
+                from ompi_tpu.ft import ulfm
+
+                ulfm.state(comm).revoked = True
+            return
         if env.get("kind") == "p2p":
             cid = env.get("cid")
             with self._p2p_lock:
@@ -141,17 +189,35 @@ class DcnCollEngine:
         return self._recv_full(src, cid, seq, timeout)[1]
 
     def _recv_full(self, src: int, cid: int, seq: int, timeout: float = 120.0):
-        key = (cid, seq, src)
-        try:
-            got = self._queue(key).get(timeout=timeout)
-        except queue.Empty:
-            from ompi_tpu.core.errors import MPIInternalError
+        import time as _time
 
-            raise MPIInternalError(
-                f"DCN recv timeout after {timeout}s: proc {self.proc} waiting "
-                f"for proc {src} (cid={cid}, seq={seq}) — peer dead or "
-                f"collective order mismatch"
-            ) from None
+        key = (cid, seq, src)
+        q = self._queue(key)
+        deadline = _time.monotonic() + timeout
+        while True:
+            # short slices keep the wait sensitive to failure detection:
+            # a peer declared dead mid-collective raises promptly (ULFM
+            # in-band error) instead of waiting out the full timeout
+            try:
+                got = q.get(timeout=0.25)
+                break
+            except queue.Empty:
+                if self.proc_failed(src):
+                    from ompi_tpu.core.errors import MPIProcFailedError
+
+                    raise MPIProcFailedError(
+                        f"DCN recv: peer proc {src} failed "
+                        f"(cid={cid}, seq={seq})", failed=(src,)
+                    ) from None
+                if _time.monotonic() > deadline:
+                    from ompi_tpu.core.errors import MPIInternalError
+
+                    raise MPIInternalError(
+                        f"DCN recv timeout after {timeout}s: proc "
+                        f"{self.proc} waiting for proc {src} (cid={cid}, "
+                        f"seq={seq}) — peer dead or collective order "
+                        f"mismatch"
+                    ) from None
         # (cid, seq, src) keys are single-use (seqs are monotonic per
         # stream), and the producer's put necessarily preceded this get
         # — drop the queue so long-running jobs (and the per-instance
@@ -163,6 +229,10 @@ class DcnCollEngine:
         envelope = dict(envelope)
         envelope["kind"] = "p2p"
         self.transport.send(self.addresses[dst_proc], envelope, payload)
+
+    def local_proc_of(self, root_proc: int):
+        """Root engine: proc indices ARE root indices."""
+        return root_proc if 0 <= root_proc < self.nprocs else None
 
     # -- collectives -----------------------------------------------------
 
@@ -376,6 +446,24 @@ class DcnSubEngine(DcnCollEngine):
 
     def send_p2p(self, dst_proc: int, envelope: dict, payload: np.ndarray) -> None:
         self.parent.send_p2p(self.procs[dst_proc], envelope, payload)
+
+    def proc_failed(self, local_proc: int) -> bool:
+        return self.parent.proc_failed(self.procs[local_proc])
+
+    def send_ctrl(self, dst: int, envelope: dict) -> None:
+        self.parent.send_ctrl(self.procs[dst], envelope)
+
+    def register_comm(self, cid, comm) -> None:
+        self.parent.register_comm(cid, comm)
+
+    def unregister_comm(self, cid) -> None:
+        self.parent.unregister_comm(cid)
+
+    def local_proc_of(self, root_proc: int):
+        pl = self.parent.local_proc_of(root_proc)
+        if pl is None or pl not in self.procs:
+            return None
+        return self.procs.index(pl)
 
     def close(self) -> None:
         """Lifecycle is owned by the root engine; freeing a sub-comm

@@ -1,0 +1,150 @@
+"""Multi-process failure detection — the daemon-heartbeat analog.
+
+≈ the reference's PRRTE daemon heartbeats + in-band BTL error callbacks
+(SURVEY.md §5 "failure detection via PRRTE daemon heartbeats + in-band
+BTL errors"): each worker process runs a :class:`HeartbeatDetector`
+that
+
+* sends a small ``hb`` frame to every peer each ``period`` seconds
+  (in-band: a send to a dead peer raises immediately — detection
+  faster than the timeout);
+* declares a peer failed when its heartbeats stop for ``timeout``
+  seconds;
+* **gossips** detections (``flr`` frames) so survivor knowledge
+  converges within one period instead of each waiting out its own
+  timeout — the errmgr propagation role;
+* fires registered callbacks, which mark the failed process's global
+  ranks on every registered communicator (the ULFM state the per-op
+  guards in :mod:`ompi_tpu.ft.ulfm` read) and wake DCN receives
+  blocked on the dead peer (:meth:`DcnCollEngine.note_proc_failed`).
+
+Enabled by ``--mca ft_detector_enable 1`` (``tpurun --ft`` sets it):
+non-FT jobs pay zero heartbeat traffic, like non ``--with-ft`` builds
+of the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ompi_tpu.core.registry import Component, register_component
+
+
+class HeartbeatDetector:
+    """Per-process failure detector over the DCN engine's peer set."""
+
+    def __init__(self, engine, period: float = 0.25, timeout: float = 2.0):
+        self.engine = engine
+        self.period = float(period)
+        self.timeout = float(timeout)
+        self._peers = [p for p in range(engine.nprocs) if p != engine.proc]
+        now = time.monotonic()
+        self._last = {p: now for p in self._peers}
+        self._failed: set[int] = set()
+        self._cbs: list[Callable[[int], None]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        engine.attach_detector(self)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ompi-ft-detector"
+        )
+        self._thread.start()
+
+    # -- inbound events (engine receiver thread) ------------------------
+
+    def on_heartbeat(self, src: int) -> None:
+        with self._lock:
+            self._last[src] = time.monotonic()
+
+    def on_failure(self, cb: Callable[[int], None]) -> None:
+        """Register a callback(proc) fired once per detected failure;
+        immediately replayed for already-known failures."""
+        with self._lock:
+            known = set(self._failed)
+            self._cbs.append(cb)
+        for p in known:
+            cb(p)
+
+    def failed(self) -> set[int]:
+        with self._lock:
+            return set(self._failed)
+
+    def mark_failed(self, proc: int, gossip: bool = True) -> None:
+        """Declare ``proc`` dead (timeout, in-band error, or gossip)."""
+        with self._lock:
+            if proc in self._failed or proc == self.engine.proc:
+                return
+            self._failed.add(proc)
+            cbs = list(self._cbs)
+        self.engine.note_proc_failed(proc)
+        for cb in cbs:
+            try:
+                cb(proc)
+            except Exception:  # noqa: BLE001 — a bad callback must not
+                import traceback  # kill the detector thread
+
+                traceback.print_exc()
+        if gossip:
+            for p in self._peers:
+                if p not in self.failed():
+                    try:
+                        self.engine.send_ctrl(p, {"kind": "flr", "proc": proc})
+                    except Exception:  # noqa: BLE001 — peer may be dead too
+                        pass
+
+    # -- heartbeat loop --------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            for p in self._peers:
+                if p in self._failed:
+                    continue
+                try:
+                    self.engine.send_ctrl(p, {"kind": "hb",
+                                              "src": self.engine.proc})
+                except Exception:  # noqa: BLE001 — in-band detection
+                    self.mark_failed(p)
+            now = time.monotonic()
+            with self._lock:
+                late = [p for p, t in self._last.items()
+                        if p not in self._failed and now - t > self.timeout]
+            for p in late:
+                self.mark_failed(p)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+@register_component
+class FtDetectorComponent(Component):
+    """``ft/detector`` MCA component — owns the detector's tunables."""
+
+    FRAMEWORK = "ft"
+    NAME = "detector"
+    PRIORITY = 50
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "ft", "detector", "enable", False,
+            help="Run the DCN heartbeat failure detector (tpurun --ft "
+            "sets this; ≈ building the reference --with-ft=ulfm)",
+        )
+        store.register(
+            "ft", "detector", "period", 0.25, type="float",
+            help="Heartbeat send interval, seconds",
+        )
+        store.register(
+            "ft", "detector", "timeout", 2.0, type="float",
+            help="Silence after which a peer is declared failed, seconds",
+        )
+
+    def params(self, store) -> dict:
+        self.register_params(store)
+        return {
+            "enable": bool(store.get("ft_detector_enable")),
+            "period": float(store.get("ft_detector_period")),
+            "timeout": float(store.get("ft_detector_timeout")),
+        }

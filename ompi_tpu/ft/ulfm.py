@@ -33,6 +33,7 @@ analog of the external kill, and the DCN heartbeat detector
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 from ompi_tpu.core.errors import (
@@ -53,11 +54,20 @@ class FTState:
     revoked: bool = False
 
 
+_state_lock = threading.Lock()
+
+
 def state(comm) -> FTState:
     st = getattr(comm, "_ft", None)
     if st is None:
-        st = FTState()
-        comm._ft = st
+        # three threads can race to lazily create (main, DCN receiver
+        # handling a rvk frame, detector fan-out) — losing one side's
+        # writes would drop a revoke or a failure
+        with _state_lock:
+            st = getattr(comm, "_ft", None)
+            if st is None:
+                st = FTState()
+                comm._ft = st
     return st
 
 

@@ -109,6 +109,32 @@ def test_tpurun_nonblocking_progress():
         assert len(hits) == 2, f"{check}: {hits}\n{out}"
 
 
+def test_tpurun_ft_kill_one_of_three():
+    """ULFM end-to-end across processes (VERDICT r1 #7): rank 1 dies
+    abruptly; survivors detect via heartbeats, guards raise, agreement
+    works, revoke propagates, shrink rebuilds a working 2-proc comm."""
+    from ompi_tpu.boot import tpurun
+
+    cmd = [
+        sys.executable, "-m", "ompi_tpu", "run", "-np", "3", "--ft",
+        "--cpu-devices", "1",
+        str(REPO / "tests" / "workers" / "mp_ft_worker.py"),
+    ]
+    env = dict(**__import__("os").environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run(cmd, capture_output=True, timeout=240, env=env,
+                         cwd=str(REPO))
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"ft job failed:\n{out}\n{res.stderr.decode()}"
+    for check, count in (
+        ("ft_healthy", 3), ("ft_detected", 2), ("ft_guard", 2),
+        ("ft_agree", 2), ("ft_revoked", 2), ("ft_shrunk", 2), ("ft_done", 2),
+    ):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == count, f"{check}: {hits}\n{out}"
+
+
 def test_tpurun_bad_btl_include_aborts(tmp_path):
     """--mca btl <typo> must abort the job (reference behavior), not
     silently boot with transport defaults (review r2)."""

@@ -228,7 +228,13 @@ def test_intercomm_over_subcomm_parent(world):
     ya, yb = ic.allreduce(np.ones((2, 1)), np.full((2, 1), 5.0))
     np.testing.assert_array_equal(ya, np.full((2, 1), 10.0))
     np.testing.assert_array_equal(yb, np.full((2, 1), 2.0))
-    with pytest.raises(MPIArgError):
-        ic.send(np.zeros(1), source=0, dest=1, tag=1 << 16)  # tag window
+    # unrestricted tags + isolation from the parent's own p2p: a
+    # wildcard parent recv must NOT steal the intercomm's message
+    ic.send(np.array([8.0]), source=0, dest=0, tag=1 << 20)
+    parent.send(np.array([1.0]), source=0, dest=2, tag=3)
+    ppay, pst = parent.recv(2, None, None)  # parent wildcard
+    assert ppay[0] == 1.0 and pst.tag == 3
+    ipay, ist = ic.recv(dest=0, source=0, tag=None, at_remote=True)
+    assert ipay[0] == 8.0 and ist.source == 0 and ist.tag == 1 << 20
     ic.free()
     parent.free()

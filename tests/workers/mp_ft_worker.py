@@ -1,0 +1,95 @@
+"""tpurun --ft worker: kill-one-of-three survival (VERDICT r1 #7).
+
+Rank 1 exits abruptly after the first collective.  Survivors must:
+detect the failure via DCN heartbeats (+ in-band errors + gossip),
+see it in get_failed(), have collectives raise MPIProcFailedError,
+revoke + shrink to a 2-process communicator, and complete an
+allreduce + p2p there.  Also: revoke propagation reaches the peer.
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.core.errors import MPIProcFailedError, MPIRevokedError
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+n = world.size
+assert world.nprocs == 3 and world.local_size == 1
+
+# healthy phase
+out = world.allreduce(np.ones((1, 4)), SUM)
+assert np.array_equal(out, np.full((1, 4), float(n))), out
+print(f"OK ft_healthy proc={p}", flush=True)
+
+if p == 1:
+    os._exit(0)  # abrupt death — no finalize, no goodbye
+
+# survivors: wait for detection (heartbeat timeout is 2 s)
+deadline = time.time() + 30
+while time.time() < deadline and 1 not in world.get_failed():
+    time.sleep(0.1)
+assert 1 in world.get_failed(), world.get_failed()
+print(f"OK ft_detected proc={p}", flush=True)
+
+# collectives on the broken world raise, don't hang
+try:
+    world.allreduce(np.ones((1, 2)), SUM)
+    raise AssertionError("collective succeeded with a failed member")
+except MPIProcFailedError:
+    pass
+print(f"OK ft_guard proc={p}", flush=True)
+
+# p2p to the dead rank raises too
+try:
+    world.send(np.zeros(1), source=p * 1, dest=1, tag=1)
+    raise AssertionError("send to failed rank succeeded")
+except MPIProcFailedError:
+    pass
+
+# agreement among survivors (works on the broken comm)
+flags = world.agree(0b1011 if p == 0 else 0b1110)
+assert flags == 0b1010, bin(flags)
+print(f"OK ft_agree proc={p}", flush=True)
+
+# revoke propagates: proc 0 revokes, proc 2 observes without acting
+world.revoke() if p == 0 else None
+deadline = time.time() + 15
+while time.time() < deadline and not world.is_revoked():
+    time.sleep(0.05)
+assert world.is_revoked()
+try:
+    world.allreduce(np.ones((1, 1)), SUM)
+    raise AssertionError("collective on revoked comm succeeded")
+except MPIRevokedError:
+    pass
+print(f"OK ft_revoked proc={p}", flush=True)
+
+# shrink: survivors rebuild and work
+small = world.shrink()
+assert small.size == 2 and small.nprocs == 2, (small.size, small.nprocs)
+out = small.allreduce(np.full((1, 3), float(p + 1)), SUM)
+assert np.array_equal(out, np.full((1, 3), 4.0)), out  # procs 0 and 2
+if small.proc == 0:
+    small.send(np.array([9.0]), source=0, dest=1, tag=2)
+else:
+    pay, st = small.recv(dest=1, source=0, tag=2)
+    assert pay[0] == 9.0 and st.source == 0
+b = small.bcast(np.full((1, 2), float(small.local_offset + 1)), root=1)
+assert np.array_equal(b, np.full((1, 2), 2.0)), b
+print(f"OK ft_shrunk proc={p}", flush=True)
+
+# NOTE: no api.finalize() — the world still references the dead peer;
+# survivors exit cleanly after recovery (the reference's FT examples
+# end the same way after MPIX_Comm_shrink demos)
+print(f"OK ft_done proc={p}", flush=True)
+os._exit(0)
