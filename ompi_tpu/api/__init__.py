@@ -34,10 +34,14 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
         return _world
     # MPI_DOUBLE / 64-bit ints are first-class datatypes.
     jax.config.update("jax_enable_x64", True)
+    from ompi_tpu.core import hooks, output
+
+    hooks.fire("mpi_init_top")
     if mca_params:
         mca.init(mca_params)
     ctx = mca.default_context()
     ctx.open_all()
+    output.register_verbose_var(ctx.store, "runtime")
     from ompi_tpu.mesh.mesh import world_mesh
 
     wm = world_mesh()
@@ -58,6 +62,9 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
         _world = Comm(Group(range(wm.size)), wm, name="MPI_COMM_WORLD")
         _self_comm = Comm(Group([0]), wm.submesh([0]), name="MPI_COMM_SELF")
     _initialized = True
+    output.verbose(1, "runtime", "MPI_Init complete: world size %d (%s)",
+                   _world.size, type(_world).__name__)
+    hooks.fire("mpi_init_bottom", world=_world)
     return _world
 
 
@@ -80,6 +87,9 @@ def comm_self() -> Comm:
 def finalize() -> None:
     """MPI_Finalize: free the world objects and close frameworks."""
     global _world, _self_comm, _initialized
+    from ompi_tpu.core import hooks
+
+    hooks.fire("mpi_finalize_top", world=_world)
     # monitoring dump at finalize (≈ mca_pml_monitoring_dump via
     # common/monitoring when an output path is configured)
     try:
@@ -102,3 +112,4 @@ def finalize() -> None:
         _self_comm = None
     _initialized = False
     mca.reset()
+    hooks.fire("mpi_finalize_bottom")

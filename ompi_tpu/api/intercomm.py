@@ -51,25 +51,17 @@ def create_intercomm(parent: Comm, local_ranks, remote_ranks,
         raise MPIArgError("intercomm groups must be disjoint")
     comm_a = parent.create_group(Group(a), name=f"{name or 'inter'}.A")
     comm_b = parent.create_group(Group(b), name=f"{name or 'inter'}.B")
-    return Intercomm(parent, comm_a, comm_b, name, a, b)
+    return Intercomm(parent, comm_a, comm_b, name)
 
 
 class Intercomm:
     """An intercommunicator over (group A, group B)."""
 
     def __init__(self, parent: Comm, comm_a: Comm, comm_b: Comm,
-                 name: str = "", a_parent_ranks=None, b_parent_ranks=None):
+                 name: str = ""):
         self.parent = parent
         self.local = comm_a   # "local" group from A's perspective
         self.remote = comm_b
-        #: each side's ranks IN THE PARENT's numbering (p2p rides the
-        #: parent's matching engine, which addresses parent-local ranks
-        #: — comm.group.ranks would be world ranks and misroute when
-        #: the parent is itself a sub-communicator)
-        self._a_parent = list(a_parent_ranks if a_parent_ranks is not None
-                              else range(comm_a.size))
-        self._b_parent = list(b_parent_ranks if b_parent_ranks is not None
-                              else range(comm_b.size))
         self.cid = _next_cid()
         self.name = name or f"intercomm#{self.cid}"
         self.is_inter = True

@@ -9,6 +9,7 @@ per-communicator module stacking in :mod:`ompi_tpu.coll.module`.
 
 from . import base  # noqa: F401
 from .basic import BasicCollComponent, BasicCollModule  # noqa: F401
+from .sync import SyncCollComponent, SyncCollModule  # noqa: F401
 from .han import HanCollComponent, HanCollModule  # noqa: F401
 from .module import COLL_OPS, CollModule, CollTable, select_coll_modules  # noqa: F401
 from .tuned import TunedCollComponent, TunedCollModule  # noqa: F401

@@ -128,12 +128,25 @@ def select_coll_modules(comm, framework) -> CollTable:
         if module is None:
             continue
         table.modules.append(module)
-        for slot, fn in module.provided().items():
+        provided = module.provided()
+        from ompi_tpu.core import output
+
+        output.verbose(1, "coll", "comm %s: component %s provides %d slots",
+                       getattr(comm, "name", "?"), comp.NAME, len(provided))
+        for slot, fn in provided.items():
             table.slots[slot] = fn
             table.providers[slot] = comp.NAME
             table.owners[slot] = module
     missing = [op for op in COLL_OPS if op not in table.slots]
     if missing:
+        from ompi_tpu.core import output
+
+        output.show_help(
+            "coll-select", "no-collective-module",
+            "No collective component provides %s for communicator %s.\n"
+            "Components queried: %s.  Check --mca coll selection lists.",
+            missing, getattr(comm, "name", "?"), [c.NAME for c in comps],
+        )
         raise MPIInternalError(
             f"no coll component provides {missing} for this communicator "
             f"(components queried: {[c.NAME for c in comps]})"

@@ -1,0 +1,97 @@
+"""``coll/sync`` — the collective-ordering debug component.
+
+≈ the reference's ``ompi/mca/coll/sync`` (SURVEY.md §2.2 coll aux row,
+§5 race detection): when enabled, a barrier is injected before every
+Nth collective on the communicator.  A program whose ranks issue
+collectives in different orders (the classic SPMD race: one rank's
+bcast pairs with another's allreduce) deadlocks AT the injected
+barrier, localizing the mismatch instead of corrupting data or hanging
+far downstream — exactly the reference's debugging use.
+
+Enable with ``--mca coll_sync_barrier_before N`` (0 = off, the
+default; 1 = barrier before every collective).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ompi_tpu.core import output
+from ompi_tpu.core.registry import Component, register_component
+
+
+class SyncCollModule:
+    """Wraps every stacked slot with the barrier-injection shim."""
+
+    def __init__(self, comm, table, every: int):
+        self.comm = comm
+        self._table = table
+        self._every = max(1, int(every))
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        pass
+
+    def disable(self) -> None:
+        pass
+
+    def provided(self) -> dict[str, Any]:
+        out = {}
+        for slot, fn in self._table.slots.items():
+            # barrier itself is the probe — wrapping it would recurse
+            if slot.endswith("barrier") or slot.endswith("barrier_init"):
+                out[slot] = fn
+            else:
+                out[slot] = self._wrap(slot, fn)
+        return out
+
+    def _wrap(self, slot: str, fn):
+        def shim(*args, **kwargs):
+            with self._lock:
+                self._count += 1
+                fire = self._count % self._every == 0
+            if fire:
+                output.verbose(10, "coll",
+                               "coll/sync: barrier before %s #%d on %s",
+                               slot, self._count, self.comm.name)
+                # through the table: SPC/monitoring account the
+                # injected barrier like any other collective
+                self._table.lookup("barrier")()
+            return fn(*args, **kwargs)
+
+        shim.__name__ = f"sync_{slot}"
+        return shim
+
+
+@register_component
+class SyncCollComponent(Component):
+    """coll/sync — interposes at the very top of the coll stack."""
+
+    FRAMEWORK = "coll"
+    NAME = "sync"
+    PRIORITY = 100  # above monitoring (99): sync sees the user's call order
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self._store = store
+        store.register(
+            "coll", "sync", "barrier_before", 0, type="int",
+            help="Inject a barrier before every Nth collective "
+            "(0 = off; ≈ coll_sync_barrier_before) — localizes "
+            "collective-order mismatches at the injection point",
+        )
+
+    def open(self, store) -> bool:
+        self._store = store
+        return int(store.get("coll_sync_barrier_before", 0)) > 0
+
+    def query(self, comm, table=None):
+        if table is None or not table.slots:
+            return None
+        return SyncCollModule(
+            comm, table, int(self._store.get("coll_sync_barrier_before", 0))
+        )
+
+    query.wants_table = True

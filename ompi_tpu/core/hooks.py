@@ -1,0 +1,64 @@
+"""Hook framework — callbacks around MPI lifecycle events.
+
+≈ ``ompi/mca/hook`` (SURVEY.md §2.2 hook row): components register
+functions fired at the top and bottom of MPI_Init and MPI_Finalize
+(the reference's ``mpi_init_top/mpi_init_bottom/mpi_finalize_top/
+mpi_finalize_bottom`` hook slots).  Used for tool attach points,
+environment validation, and the demo hook the reference ships.
+
+``register(event, fn)`` from anywhere (a component's ``open()``, user
+code, a sitecustomize); :func:`fire` is invoked by ``api.init`` /
+``api.finalize``.  Hook errors are contained — a broken tool hook must
+not take down the job (reference behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ompi_tpu.core.errors import MPIArgError
+
+EVENTS = (
+    "mpi_init_top",
+    "mpi_init_bottom",
+    "mpi_finalize_top",
+    "mpi_finalize_bottom",
+)
+
+_lock = threading.Lock()
+_hooks: dict[str, list[Callable]] = {e: [] for e in EVENTS}
+
+
+def register(event: str, fn: Callable) -> None:
+    if event not in _hooks:
+        raise MPIArgError(f"unknown hook event {event!r} (know {EVENTS})")
+    with _lock:
+        _hooks[event].append(fn)
+
+
+def unregister(event: str, fn: Callable) -> None:
+    with _lock:
+        try:
+            _hooks[event].remove(fn)
+        except (KeyError, ValueError):
+            pass
+
+
+def fire(event: str, **kw) -> None:
+    with _lock:
+        fns = list(_hooks.get(event, ()))
+    for fn in fns:
+        try:
+            fn(**kw)
+        except Exception:  # noqa: BLE001 — tool hooks must not kill the job
+            import traceback
+
+            traceback.print_exc()
+
+
+def reset() -> None:
+    """Test hook."""
+    with _lock:
+        for e in EVENTS:
+            _hooks[e].clear()

@@ -24,6 +24,7 @@ _BUILTIN_COMPONENT_MODULES = (
     "ompi_tpu.io.component",
     "ompi_tpu.tool.monitoring",
     "ompi_tpu.ft.detector",
+    "ompi_tpu.p2p.vprotocol",
 )
 
 

@@ -1,0 +1,100 @@
+"""Verbose-stream output + show_help — the opal util layer.
+
+≈ ``opal/util/output`` + ``opal/util/show_help`` (SURVEY.md §2.1 "opal
+util" row, §5): every framework gets a numbered output stream whose
+verbosity is an MCA var (``--mca coll_base_verbose 10``), and operator-
+facing diagnostics go through :func:`show_help` — a formatted, DEDUPED
+message block (the reference aggregates identical help messages across
+ranks; per-process dedup is the single-host analog).
+
+Usage (framework code)::
+
+    from ompi_tpu.core import output
+    output.verbose(1, "coll", "comm %s selected module %s", name, mod)
+
+Levels follow the reference's convention: 0 = silent, 1 = component
+selection, 10 = per-call tracing, 100 = firehose.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+_lock = threading.Lock()
+_levels: dict[str, int] = {}
+_shown: set[tuple] = set()
+
+
+def set_verbosity(framework: str, level: int) -> None:
+    with _lock:
+        _levels[framework] = int(level)
+
+
+def _level(framework: str) -> int:
+    with _lock:
+        lvl = _levels.get(framework)
+    if lvl is not None:
+        return lvl
+    # lazily resolve <framework>_base_verbose from the MCA store
+    lvl = 0
+    try:
+        from ompi_tpu.core import mca
+
+        ctx = mca._default
+        if ctx is not None:
+            try:
+                lvl = int(ctx.store.get(f"{framework}_base_verbose", 0))
+            except Exception:  # noqa: BLE001 — unregistered var
+                lvl = 0
+    except Exception:  # noqa: BLE001 — before mca init
+        lvl = 0
+    with _lock:
+        _levels[framework] = lvl
+    return lvl
+
+
+def register_verbose_var(store, framework: str) -> None:
+    """Register ``<framework>_base_verbose`` (frameworks call this from
+    a component's register_params, matching mca_base_framework_open's
+    automatic verbose var)."""
+    store.register(
+        framework, "base", "verbose", 0, type="int",
+        help=f"Verbosity for the {framework} framework's output stream "
+        f"(0 silent, 1 selection, 10 per-call, 100 firehose)",
+    )
+    with _lock:
+        _levels.pop(framework, None)  # re-resolve from the store
+
+
+def verbose(level: int, framework: str, fmt: str, *args) -> None:
+    """opal_output_verbose: emit when the framework's stream is at or
+    above ``level``.  Zero-cost when silent (one dict hit)."""
+    if _level(framework) < level:
+        return
+    msg = fmt % args if args else fmt
+    sys.stderr.write(f"[ompi_tpu:{framework}] {msg}\n")
+    sys.stderr.flush()
+
+
+def show_help(topic: str, key: str, fmt: str, *args, dedup: bool = True) -> None:
+    """opal_show_help: operator-facing diagnostic block, deduped by
+    (topic, key) so repeated causes print once (the aggregation role)."""
+    if dedup:
+        with _lock:
+            if (topic, key) in _shown:
+                return
+            _shown.add((topic, key))
+    msg = fmt % args if args else fmt
+    bar = "-" * 64
+    sys.stderr.write(
+        f"{bar}\n[ompi_tpu] {topic}: {key}\n\n{msg}\n{bar}\n"
+    )
+    sys.stderr.flush()
+
+
+def reset() -> None:
+    """Test hook: clear cached levels and dedup state."""
+    with _lock:
+        _levels.clear()
+        _shown.clear()

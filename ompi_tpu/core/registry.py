@@ -155,6 +155,11 @@ class Framework:
             help=f"Component selection list for the {self.name} framework "
             f'("a,b" include list, "^a,b" exclude list)',
         )
+        # every framework gets its verbose-stream var (mca_base_framework
+        # _open registers <fw>_base_verbose the same way)
+        from ompi_tpu.core import output as _output
+
+        _output.register_verbose_var(self.store, self.name)
         exclude, names = parse_selection(raw)
         requested: list[str] = []
         for comp_name, cls in sorted(self._component_classes.items()):

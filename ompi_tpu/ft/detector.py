@@ -114,7 +114,12 @@ class HeartbeatDetector:
                 self.mark_failed(p)
 
     def close(self) -> None:
+        """Stop AND join: the transport is torn down right after, and a
+        mid-iteration heartbeat hitting the closing socket would
+        spuriously mark live peers failed (and gossip it)."""
         self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2 * self.period + 1.0)
 
 
 @register_component

@@ -1,0 +1,130 @@
+"""``vprotocol`` — message event logging for deterministic replay.
+
+≈ the reference's ``ompi/mca/vprotocol/pessimist`` (SURVEY.md §2.2
+vprotocol row): a pml interposer that records every point-to-point
+event — and, crucially, the SOURCE each wildcard (ANY_SOURCE) receive
+actually matched, which is the nondeterminism a pessimist protocol
+must pin down for replay.  Events go to a per-process JSONL file
+(``--mca vprotocol_pessimist_log PATH``; rank substituted for ``%r``).
+
+The log is the replay substrate: :func:`load_log` returns the event
+stream, and a harness re-running the application can force each
+ANY_SOURCE receive to its logged source (event ``match``).  Matching
+the reference's scope split: logging here, orchestration in the
+replay driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+from ompi_tpu.core.registry import Component, register_component
+
+
+class LoggedEngine:
+    """Proxy over a matching engine, journaling p2p events."""
+
+    def __init__(self, inner, comm_name: str, path: str):
+        self._inner = inner
+        self._comm_name = comm_name
+        self._path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1)
+
+    def _log(self, event: str, **kw) -> None:
+        rec = {"event": event, "comm": self._comm_name, **kw}
+        with self._lock:
+            self._fh.write(json.dumps(rec) + "\n")
+
+    def send(self, source: int, dest: int, payload, tag: int,
+             dest_device=None, _account: bool = True) -> None:
+        from ompi_tpu.tool.spc import payload_nbytes
+
+        self._inner.send(source, dest, payload, tag, dest_device,
+                         _account=_account)
+        self._log("send", src=source, dst=dest, tag=tag,
+                  nbytes=payload_nbytes(payload))
+
+    def irecv(self, dest: int, source: int = -1, tag: int = -1):
+        req = self._inner.irecv(dest, source, tag)
+        self._log("post", dst=dest, src=source, tag=tag)
+        wildcard = source == -1
+        log = self._log
+        once = threading.Lock()
+        done = [False]
+
+        def log_match(status):
+            # exactly ONE match record per receive: the wrapped deliver
+            # and the already-completed branch below can race when the
+            # engine delivers between the swap and the test()
+            with once:
+                if done[0]:
+                    return
+                done[0] = True
+            log("match", dst=dest, src=int(status.source),
+                tag=int(status.tag), wildcard=wildcard)
+
+        orig_deliver = req._deliver
+
+        def deliver(payload, status):
+            orig_deliver(payload, status)
+            log_match(status)
+
+        req._deliver = deliver
+        # already-completed (unexpected-queue hit): _deliver already ran
+        if req.test():
+            log_match(req.status)
+        return req
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def load_log(path: str) -> list[dict[str, Any]]:
+    """The journaled event stream (replay-driver input)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+@register_component
+class VprotocolPmlComponent(Component):
+    """pml/vprotocol — outbids the plain pml when a log path is set."""
+
+    FRAMEWORK = "pml"
+    NAME = "vprotocol"
+    PRIORITY = 85  # above monitoring (80): logging wraps accounting
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        self._store = store
+        store.register(
+            "vprotocol", "pessimist", "log", "", type="string",
+            help="Per-process p2p event-log path ('%%r' -> rank) — "
+            "enables message logging (≈ vprotocol/pessimist)",
+        )
+
+    def open(self, store) -> bool:
+        self._store = store
+        return bool(store.get("vprotocol_pessimist_log", ""))
+
+    def make_engine(self, comm_size: int, comm_name: str = "?"):
+        from ompi_tpu.p2p.pml import MatchingEngine
+
+        inner = MatchingEngine(comm_size)
+        # compose with monitoring when both are enabled (the stacked
+        # pml shims of the reference)
+        if bool(self._store.get("monitoring_base_enable", False)):
+            from ompi_tpu.tool.monitoring import MonitoredEngine
+
+            inner = MonitoredEngine(inner, comm_name, comm_size)
+        path = str(self._store.get("vprotocol_pessimist_log"))
+        path = path.replace("%r", os.environ.get("OMPI_TPU_PROC", "0"))
+        return LoggedEngine(inner, comm_name, path)
