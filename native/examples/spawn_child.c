@@ -1,0 +1,34 @@
+/* Spawned child half of the dynamic process management demo. */
+#include <mpi.h>
+#include <stdio.h>
+
+int main(int argc, char **argv) {
+  int rank;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm parent;
+  MPI_Comm_get_parent(&parent);
+  if (parent == MPI_COMM_NULL) {
+    fprintf(stderr, "child has no parent\n");
+    MPI_Abort(MPI_COMM_WORLD, 7);
+  }
+  int rs = 0;
+  MPI_Comm_remote_size(parent, &rs);
+  if (rs != 2) MPI_Abort(MPI_COMM_WORLD, 8);
+
+  if (rank == 0) {
+    double tok = 0.0;
+    MPI_Recv(&tok, 1, MPI_DOUBLE, 0, 5, parent, MPI_STATUS_IGNORE);
+    tok *= 2.0;
+    MPI_Send(&tok, 1, MPI_DOUBLE, 0, 6, parent); /* back to parent 0 */
+  }
+
+  MPI_Comm all;
+  MPI_Intercomm_merge(parent, 1, &all);
+  double one = 1.0, tot = 0.0;
+  MPI_Allreduce(&one, &tot, 1, MPI_DOUBLE, MPI_SUM, all);
+  if (tot != 4.0) MPI_Abort(MPI_COMM_WORLD, 9);
+  printf("SPAWN_CHILD_OK rank=%d\n", rank);
+  MPI_Finalize();
+  return 0;
+}

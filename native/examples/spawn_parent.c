@@ -1,0 +1,49 @@
+/* Dynamic process management demo: spawn children, exchange, merge.
+ * Run under tpurun; the child binary path is argv[1]. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (argc < 2) {
+    fprintf(stderr, "usage: spawn_parent <child-binary>\n");
+    MPI_Abort(MPI_COMM_WORLD, 2);
+  }
+  MPI_Comm parent;
+  MPI_Comm_get_parent(&parent);
+  if (parent != MPI_COMM_NULL) {
+    fprintf(stderr, "parent binary was itself spawned?\n");
+    MPI_Abort(MPI_COMM_WORLD, 3);
+  }
+
+  MPI_Comm inter;
+  MPI_Comm_spawn(argv[1], MPI_ARGV_NULL, 2, MPI_INFO_NULL, 0,
+                 MPI_COMM_WORLD, &inter, MPI_ERRCODES_IGNORE);
+  int rs = 0;
+  MPI_Comm_remote_size(inter, &rs);
+  if (rs != 2) MPI_Abort(MPI_COMM_WORLD, 4);
+
+  if (rank == 0) {
+    double tok = 11.5;
+    MPI_Send(&tok, 1, MPI_DOUBLE, 0, 5, inter); /* to child 0 */
+    double back = 0.0;
+    MPI_Recv(&back, 1, MPI_DOUBLE, 0, 6, inter, MPI_STATUS_IGNORE);
+    if (back != 23.0) MPI_Abort(MPI_COMM_WORLD, 5);
+  }
+
+  MPI_Comm all;
+  MPI_Intercomm_merge(inter, 0, &all);
+  int asz = 0, ark = -1;
+  MPI_Comm_size(all, &asz);
+  MPI_Comm_rank(all, &ark);
+  double one = 1.0, tot = 0.0;
+  MPI_Allreduce(&one, &tot, 1, MPI_DOUBLE, MPI_SUM, all);
+  if (asz != 4 || tot != 4.0) MPI_Abort(MPI_COMM_WORLD, 6);
+  printf("SPAWN_PARENT_OK rank=%d merged=%d\n", rank, asz);
+  MPI_Finalize();
+  return 0;
+}

@@ -26,6 +26,7 @@ typedef int MPI_Datatype;
 typedef int MPI_Op;
 typedef int MPI_Request;
 typedef int MPI_Errhandler;
+typedef int MPI_Info;
 typedef int MPI_Group;
 typedef long long MPI_Aint;
 typedef long long MPI_Offset;
@@ -239,6 +240,19 @@ TPUMPI_PROTO(int, Comm_create_group,
              (MPI_Comm comm, MPI_Group group, int tag, MPI_Comm *newcomm))
 TPUMPI_PROTO(int, Comm_compare,
              (MPI_Comm comm1, MPI_Comm comm2, int *result))
+
+/* dynamic process management */
+#define MPI_INFO_NULL ((MPI_Info)0)
+#define MPI_ARGV_NULL ((char **)0)
+#define MPI_ERRCODES_IGNORE ((int *)0)
+TPUMPI_PROTO(int, Comm_spawn,
+             (const char *command, char *argv[], int maxprocs, MPI_Info info,
+              int root, MPI_Comm comm, MPI_Comm *intercomm,
+              int array_of_errcodes[]))
+TPUMPI_PROTO(int, Comm_get_parent, (MPI_Comm *parent))
+TPUMPI_PROTO(int, Intercomm_merge,
+             (MPI_Comm intercomm, int high, MPI_Comm *newintracomm))
+TPUMPI_PROTO(int, Comm_remote_size, (MPI_Comm comm, int *size))
 
 /* errhandlers */
 TPUMPI_PROTO(int, Comm_set_errhandler,

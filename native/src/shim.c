@@ -589,6 +589,62 @@ int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
   return rc;
 }
 
+/* ---- dynamic process management ------------------------------------ */
+
+int PMPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
+                    MPI_Info info, int root, MPI_Comm comm,
+                    MPI_Comm *intercomm, int array_of_errcodes[]) {
+  (void)info;
+  /* marshal argv as one \x1f-joined string (NULL-terminated array) */
+  size_t total = 1;
+  if (argv)
+    for (char **a = argv; *a; ++a) total += strlen(*a) + 1;
+  char *packed = (char *)malloc(total);
+  packed[0] = 0;
+  if (argv) {
+    char *w = packed;
+    for (char **a = argv; *a; ++a) {
+      size_t n = strlen(*a);
+      memcpy(w, *a, n);
+      w += n;
+      *w++ = '\x1f';
+    }
+    if (w > packed) w[-1] = 0; else *w = 0;
+  }
+  capi_ret r;
+  int rc = capi_call("comm_spawn", &r, "(ssiii)", command, packed, maxprocs,
+                     root, (int)comm);
+  free(packed);
+  if (rc == MPI_SUCCESS && r.n >= 1) {
+    *intercomm = (MPI_Comm)r.v[0];
+    if (array_of_errcodes)
+      for (int i = 0; i < maxprocs; i++) array_of_errcodes[i] = MPI_SUCCESS;
+  }
+  return rc;
+}
+
+int PMPI_Comm_get_parent(MPI_Comm *parent) {
+  capi_ret r;
+  int rc = capi_call("comm_get_parent", &r, "()");
+  if (rc == MPI_SUCCESS && r.n >= 1) *parent = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Intercomm_merge(MPI_Comm intercomm, int high,
+                         MPI_Comm *newintracomm) {
+  capi_ret r;
+  int rc = capi_call("intercomm_merge", &r, "(ii)", (int)intercomm, high);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newintracomm = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Comm_remote_size(MPI_Comm comm, int *size) {
+  capi_ret r;
+  int rc = capi_call("comm_remote_size", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *size = (int)r.v[0];
+  return rc;
+}
+
 /* ---- errhandlers ---------------------------------------------------- */
 
 int PMPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
@@ -828,6 +884,12 @@ TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
 TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, Comm_spawn,
+            (const char *, char *[], int, MPI_Info, int, MPI_Comm,
+             MPI_Comm *, int[]))
+TPUMPI_WEAK(int, Comm_get_parent, (MPI_Comm *))
+TPUMPI_WEAK(int, Intercomm_merge, (MPI_Comm, int, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_remote_size, (MPI_Comm, int *))
 TPUMPI_WEAK(int, Comm_set_errhandler, (MPI_Comm, MPI_Errhandler))
 TPUMPI_WEAK(int, Comm_get_errhandler, (MPI_Comm, MPI_Errhandler *))
 TPUMPI_WEAK(int, Errhandler_free, (MPI_Errhandler *))

@@ -15,6 +15,7 @@ from .comm import COLOR_UNDEFINED, Comm
 from .group import Group, UNDEFINED  # noqa: F401
 from .info import INFO_NULL, Info, info_env  # noqa: F401
 from .intercomm import Intercomm, create_intercomm  # noqa: F401
+from .spawn import get_parent, spawn  # noqa: F401
 
 _world: Comm | None = None
 _self_comm: Comm | None = None
@@ -90,6 +91,11 @@ def finalize() -> None:
     from ompi_tpu.core import hooks
 
     hooks.fire("mpi_finalize_top", world=_world)
+    # spawned children: wait them out + drain their output while the
+    # interpreter is fully alive (atexit alone races thread teardown)
+    from .spawn import _reap
+
+    _reap()
     # monitoring dump at finalize (≈ mca_pml_monitoring_dump via
     # common/monitoring when an output path is configured)
     try:

@@ -84,10 +84,18 @@ class MultiProcComm(PersistentP2PMixin):
         self._nbc_lock = threading.Lock()
         self._ft = None
         self._shrink_count = 0
+        self._spawn_count = 0
         self._freed = False
         self.dcn.register_p2p(self.cid, self._on_p2p_frame)
         self.dcn.register_comm(self.cid, self)
         self.procctx.register_comm(self)
+
+    def _next_spawn(self) -> int:
+        """Per-comm spawn counter (SPMD-agreed, names the child world's
+        KVS namespace)."""
+        k = self._spawn_count
+        self._spawn_count += 1
+        return k
 
     def _next_nbc(self) -> int:
         """Per-comm non-blocking-collective issue counter: identical on

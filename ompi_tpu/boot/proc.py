@@ -18,6 +18,9 @@ from .kvs import KVSClient
 ENV_PROC = "OMPI_TPU_PROC"
 ENV_NPROCS = "OMPI_TPU_NPROCS"
 ENV_KVS = "OMPI_TPU_KVS_ADDR"
+#: KVS key namespace — spawned child worlds share the job's KVS server
+#: but live under their own prefix (dynamic process management)
+ENV_NS = "OMPI_TPU_KVS_NS"
 
 
 def launched_by_tpurun() -> bool:
@@ -30,6 +33,7 @@ class ProcContext:
     def __init__(self):
         self.proc = int(os.environ[ENV_PROC])
         self.nprocs = int(os.environ[ENV_NPROCS])
+        self.ns = os.environ.get(ENV_NS, "")
         self.kvs = KVSClient(os.environ[ENV_KVS])
         # modex: publish DCN endpoint, fence, gather peers. Transport
         # tunables come from the btl/tcp component's MCA vars (so
@@ -52,10 +56,10 @@ class ProcContext:
             # aborts on unparseable MCA values; so do we)
             params = comp.params(ctx.store)
         self.engine = DcnCollEngine(self.proc, self.nprocs, **params)
-        self.kvs.put(f"dcn.{self.proc}", self.engine.transport.address)
-        self.kvs.fence("modex", self.proc, self.nprocs)
+        self.kvs.put(f"{self.ns}dcn.{self.proc}", self.engine.transport.address)
+        self.kvs.fence(f"{self.ns}modex", self.proc, self.nprocs)
         self.engine.set_addresses(
-            [self.kvs.get(f"dcn.{p}") for p in range(self.nprocs)]
+            [self.kvs.get(f"{self.ns}dcn.{p}") for p in range(self.nprocs)]
         )
         # failure detector (tpurun --ft / --mca ft_detector_enable 1):
         # heartbeats + gossip; detections fan out to every registered
@@ -91,7 +95,7 @@ class ProcContext:
                 comm._on_proc_failed(p)
 
     def fence(self, name: str) -> None:
-        self.kvs.fence(name, self.proc, self.nprocs)
+        self.kvs.fence(f"{self.ns}{name}", self.proc, self.nprocs)
 
     def close(self) -> None:
         if self.detector is not None:

@@ -133,7 +133,9 @@ def run_job(
         if ft:
             exit_code = procs[0].returncode or 0
         for t in threads:
-            t.join(timeout=2)
+            # every writer is dead → readline hits EOF; the join bound
+            # only guards against pathological scheduler starvation
+            t.join(timeout=10)
         return exit_code
     finally:
         for p in procs:

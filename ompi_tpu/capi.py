@@ -1017,3 +1017,56 @@ def scatterv(sptr, scounts_ptr, displs_ptr, sdt, rptr, rcount, rdt, root, h) -> 
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e, h)
+
+
+# -- dynamic process management (MPI_Comm_spawn family) -------------------
+
+
+def comm_spawn(cmd: str, argv_packed: str, maxprocs: int, root: int,
+               h: int):
+    try:
+        c = _comm(h)
+        if c is not _comms.get(1):
+            # spawn's rendezvous is collective over the whole world
+            # (every world proc joins the merged space); sub-comm spawn
+            # would deadlock the procs outside it — reject loudly
+            raise err.MPICommError(
+                "MPI_Comm_spawn is supported on MPI_COMM_WORLD only"
+            )
+        from ompi_tpu.api.spawn import spawn
+
+        args = [a for a in argv_packed.split("\x1f") if a]
+        ic = spawn([cmd] + args, maxprocs, root)
+        return (MPI_SUCCESS, _store_comm(ic, h))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def comm_get_parent():
+    try:
+        from ompi_tpu.api.spawn import get_parent
+
+        p = get_parent()
+        return (MPI_SUCCESS, _store_comm(p) if p is not None else 0)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def intercomm_merge(h: int, high: int):
+    try:
+        ic = _comm(h)
+        merged = ic.merge(bool(high))
+        return (MPI_SUCCESS, _store_comm(merged, h))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def comm_remote_size(h: int):
+    try:
+        c = _comm(h)
+        rs = getattr(c, "remote_size", None)
+        if rs is None:
+            raise err.MPICommError(f"handle {h} is not an intercommunicator")
+        return (MPI_SUCCESS, int(rs))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)

@@ -470,6 +470,67 @@ class DcnSubEngine(DcnCollEngine):
         must not tear down the job's transport."""
 
 
+class DcnJoinEngine(DcnCollEngine):
+    """A JOINED view over two worlds' processes (MPI_Comm_spawn /
+    MPI_Intercomm_merge across jobs): the address list spans both
+    worlds, indices are global-in-the-union, and the local transport +
+    delivery queues are shared with this process's own engine.  Stream
+    isolation comes from spawn-scoped string cids (``sp<k>#...``),
+    which neither world's integer cids can collide with."""
+
+    def __init__(self, local: DcnCollEngine, addresses: Sequence[str],
+                 proc: int):
+        self.parent = local
+        self._addresses = list(addresses)
+        self.proc = proc
+        self.nprocs = len(self._addresses)
+        self.ring_threshold = local.ring_threshold
+        self._seq = {}
+
+    @property
+    def addresses(self) -> list[str]:
+        return self._addresses
+
+    @property
+    def transport(self) -> TcpTransport:
+        return self.parent.transport
+
+    def set_addresses(self, addresses) -> None:  # pragma: no cover
+        raise RuntimeError("join engines are constructed with addresses")
+
+    def _queue(self, key: tuple) -> queue.Queue:
+        return self.parent._queue(key)
+
+    def _drop_queue(self, key: tuple) -> None:
+        self.parent._drop_queue(key)
+
+    def register_p2p(self, cid, fn: Callable) -> None:
+        self.parent.register_p2p(cid, fn)
+
+    def unregister_p2p(self, cid) -> None:
+        self.parent.unregister_p2p(cid)
+
+    def register_comm(self, cid, comm) -> None:
+        self.parent.register_comm(cid, comm)
+
+    def unregister_comm(self, cid) -> None:
+        self.parent.unregister_comm(cid)
+
+    # send_p2p/send_ctrl: inherited — the base implementations read
+    # self.addresses/self.transport, which these properties redirect
+
+    def proc_failed(self, local_proc: int) -> bool:
+        # FT does not span spawn worlds (each world runs its own
+        # detector over its own index space)
+        return False
+
+    def local_proc_of(self, root_proc: int):
+        return None  # detector fan-out stays within each world
+
+    def close(self) -> None:
+        """Transport owned by the process's own engine."""
+
+
 class _TokenSum:
     name = "token_sum"
     np_fn = staticmethod(lambda a, b: a + b)

@@ -36,6 +36,8 @@ def native_bins():
         ("osu_bcast", "bench/osu_bcast.c"),
         ("osu_allgather", "bench/osu_allgather.c"),
         ("osu_alltoall", "bench/osu_alltoall.c"),
+        ("spawn_parent", "examples/spawn_parent.c"),
+        ("spawn_child", "examples/spawn_child.c"),
     ]:
         bins[name] = native.compile_mpi_program(
             REPO / "native" / src, BUILD / name
@@ -122,3 +124,14 @@ def test_osu_suite_runs_and_validates(native_bins, bench, marker):
     assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
     assert "MISMATCH" not in out + res.stderr.decode()
     assert sum(marker in l for l in out.splitlines()) == 2
+
+
+def test_c_comm_spawn(native_bins):
+    """MPI_Comm_spawn from a C program: children launched, p2p across
+    the intercomm, Intercomm_merge + allreduce over the union."""
+    res = tpurun(2, native_bins["spawn_parent"],
+                 args=[native_bins["spawn_child"]])
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert sum("SPAWN_PARENT_OK" in l for l in out.splitlines()) == 2
+    assert sum("SPAWN_CHILD_OK" in l for l in out.splitlines()) == 2

@@ -1,0 +1,48 @@
+"""Spawned child: connect to the parent, exchange, participate in the
+merged world."""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+parent = api.get_parent()
+assert parent is not None
+assert parent.remote_size == 2, parent.remote_size  # 2 parent procs
+assert parent.size == world.size
+
+# child 0 receives a token from parent rank 0 and replies
+if world.proc == 0:
+    pay, st = parent.recv(dest=0, source=0, tag=7)
+    assert float(pay[0]) == 123.0 and st.source == 0
+    parent.send(np.array([321.0]), source=0, dest=1, tag=8)
+
+# merged-world collective: every rank contributes 1
+m = parent.merge()
+out = m.allreduce(np.ones((m.local_size, 2)), SUM)
+assert np.array_equal(out, np.full((m.local_size, 2), float(m.size))), out
+
+# mirror the parents' dup + bcast (collectives over the union)
+d = m.dup()
+got = d.bcast(np.full((d.local_size, 3), float(d.local_offset + 1)), root=3)
+assert np.array_equal(got, np.full((d.local_size, 3), 4.0)), got
+d.free()
+
+m2 = parent.merge(high=False)  # parents high -> children first
+assert m2.local_offset == world.proc, m2.local_offset
+out = m2.allreduce(np.full((1, 1), 1.0), SUM)
+assert float(out[0, 0]) == 4.0
+
+parent.free()
+out = m.allreduce(np.ones((1, 1)), SUM)
+assert float(out[0, 0]) == 4.0
+
+print(f"OK spawn_child proc={world.proc} merged={m.size}", flush=True)
+api.finalize()

@@ -1,0 +1,57 @@
+"""tpurun parent worker: MPI_Comm_spawn two children, p2p both
+directions, merged-world collectives (dynamic process management)."""
+
+import os
+import sys
+from pathlib import Path
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+assert world.nprocs == 2
+assert api.get_parent() is None  # we were not spawned
+
+child = Path(__file__).parent / "mp_spawn_child.py"
+ic = api.spawn([str(child)], maxprocs=2)
+assert ic.size == 2 and ic.remote_size == 2
+
+# parent rank 0 sends a token to child 0; parent rank 1 receives a reply
+if world.proc == 0:
+    ic.send(np.array([123.0]), source=0, dest=0, tag=7)
+if world.proc == 1:
+    pay, st = ic.recv(dest=1, source=0, tag=8)
+    assert float(pay[0]) == 321.0 and st.source == 0
+
+m = ic.merge()
+assert m.size == 4 and m.nprocs == 4 and m.proc == world.proc
+out = m.allreduce(np.ones((m.local_size, 2)), SUM)
+assert np.array_equal(out, np.full((m.local_size, 2), 4.0)), out
+
+# the merged comm supports the full surface: dup with cross-world CID
+# agreement, then a collective on the dup
+d = m.dup()
+got = d.bcast(np.full((d.local_size, 3), float(d.local_offset + 1)), root=3)
+assert np.array_equal(got, np.full((d.local_size, 3), 4.0)), got
+d.free()
+
+# high-flag ordering: parents pass high=True, children False ->
+# children ranked first (the standard's mandate)
+m2 = ic.merge(high=True)
+assert m2.local_offset == 2 + world.proc, m2.local_offset
+out = m2.allreduce(np.full((1, 1), 1.0), SUM)
+assert float(out[0, 0]) == 4.0
+
+# freeing the intercomm must not touch merged comms (independence)
+ic.free()
+out = m.allreduce(np.ones((1, 1)), SUM)
+assert float(out[0, 0]) == 4.0
+
+print(f"OK spawn_parent proc={world.proc}", flush=True)
+api.finalize()
