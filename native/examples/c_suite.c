@@ -363,7 +363,82 @@ int main(int argc, char **argv) {
   }
   if (rank != 0) printf("OK waitany rank=%d\n", rank);
 
+  /* MPI_Op_create: user max-magnitude over doubles */
+  {
+    void mag_op(void *in, void *io, int *len, MPI_Datatype *dt);
+    MPI_Op mop;
+    MPI_Op_create((MPI_User_function *)mag_op, 1, &mop);
+    /* rank 0's magnitude strictly dominates at ANY comm size */
+    double v = (rank == 0) ? -(double)(size + 7) : (double)rank, o = 0.0;
+    MPI_Allreduce(&v, &o, 1, MPI_DOUBLE, mop, MPI_COMM_WORLD);
+    CHECK(o == -(double)(size + 7), "op_create_allreduce");
+    MPI_Op_free(&mop);
+    CHECK(mop == MPI_OP_NULL, "op_free");
+  }
+
+  /* comm_split_type SHARED: single host → everyone */
+  {
+    MPI_Comm shared;
+    MPI_Comm_split_type(MPI_COMM_WORLD, MPI_COMM_TYPE_SHARED, 0,
+                        MPI_INFO_NULL, &shared);
+    int ssz2 = 0;
+    MPI_Comm_size(shared, &ssz2);
+    CHECK(ssz2 == size, "comm_split_type_shared");
+    MPI_Comm_free(&shared);
+  }
+
+  /* struct datatype: {double, int} exchanged over p2p */
+  if (size >= 2) {
+    struct pair { double d; int i; };
+    int bls[2] = {1, 1};
+    MPI_Aint disps[2] = {0, (MPI_Aint)sizeof(double)};
+    MPI_Datatype types[2] = {MPI_DOUBLE, MPI_INT}, pt;
+    MPI_Type_create_struct(2, bls, disps, types, &pt);
+    MPI_Type_commit(&pt);
+    int psz = 0;
+    MPI_Type_size(pt, &psz);
+    CHECK(psz == (int)(sizeof(double) + sizeof(int)), "type_struct_size");
+    if (rank == 0) {
+      struct pair p = {2.5, 77};
+      MPI_Send(&p, 1, pt, 1, 41, MPI_COMM_WORLD);
+    } else if (rank == 1) {
+      struct pair p = {0, 0};
+      MPI_Recv(&p, 1, pt, 0, 41, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+      CHECK(p.d == 2.5 && p.i == 77, "type_struct_p2p");
+    }
+    MPI_Type_free(&pt);
+  }
+  if (rank != 1) printf("OK type_struct_p2p rank=%d\n", rank);
+
+  /* jagged MPI_Reduce_scatter: rank r receives r+1 elements */
+  {
+    int *cnts2 = (int *)malloc(sizeof(int) * size);
+    int tot2 = 0;
+    for (int r2 = 0; r2 < size; r2++) { cnts2[r2] = r2 + 1; tot2 += r2 + 1; }
+    double *sb2 = (double *)malloc(sizeof(double) * tot2);
+    for (int i = 0; i < tot2; i++) sb2[i] = (double)i;
+    double *rb2 = (double *)malloc(sizeof(double) * (rank + 1));
+    MPI_Reduce_scatter(sb2, rb2, cnts2, MPI_DOUBLE, MPI_SUM,
+                       MPI_COMM_WORLD);
+    int off2 = 0;
+    for (int r2 = 0; r2 < rank; r2++) off2 += r2 + 1;
+    ok = 1;
+    for (int i = 0; i <= rank; i++)
+      ok &= (rb2[i] == (double)size * (off2 + i));
+    CHECK(ok, "reduce_scatter_jagged");
+    free(sb2); free(rb2); free(cnts2);
+  }
+
   printf("CSUITE PASS rank=%d size=%d\n", rank, size);
   MPI_Finalize();
   return 0;
+}
+
+/* user op for the op_create check: keep whichever value has the
+ * larger magnitude */
+void mag_op(void *in, void *io, int *len, MPI_Datatype *dt) {
+  (void)dt;
+  double *a = (double *)in, *b = (double *)io;
+  for (int i = 0; i < *len; i++)
+    if ((a[i] < 0 ? -a[i] : a[i]) > (b[i] < 0 ? -b[i] : b[i])) b[i] = a[i];
 }

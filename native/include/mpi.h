@@ -241,6 +241,24 @@ TPUMPI_PROTO(int, Comm_create_group,
 TPUMPI_PROTO(int, Comm_compare,
              (MPI_Comm comm1, MPI_Comm comm2, int *result))
 
+/* user-defined reduction operations */
+typedef void(MPI_User_function)(void *invec, void *inoutvec, int *len,
+                                MPI_Datatype *datatype);
+#define MPI_COMM_TYPE_SHARED 1
+TPUMPI_PROTO(int, Op_create,
+             (MPI_User_function * user_fn, int commute, MPI_Op *op))
+TPUMPI_PROTO(int, Op_free, (MPI_Op * op))
+TPUMPI_PROTO(int, Comm_split_type,
+             (MPI_Comm comm, int split_type, int key, MPI_Info info,
+              MPI_Comm *newcomm))
+TPUMPI_PROTO(int, Type_create_struct,
+             (int count, const int blocklengths[],
+              const MPI_Aint displacements[], const MPI_Datatype types[],
+              MPI_Datatype *newtype))
+TPUMPI_PROTO(int, Reduce_scatter,
+             (const void *sendbuf, void *recvbuf, const int recvcounts[],
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm))
+
 /* dynamic process management */
 #define MPI_INFO_NULL ((MPI_Info)0)
 #define MPI_ARGV_NULL ((char **)0)

@@ -589,6 +589,50 @@ int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
   return rc;
 }
 
+/* ---- user ops / split_type / struct type / reduce_scatter ---------- */
+
+int PMPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op) {
+  capi_ret r;
+  int rc = capi_call("op_create", &r, "(Ki)", PTR(user_fn), commute);
+  if (rc == MPI_SUCCESS && r.n >= 1) *op = (MPI_Op)r.v[0];
+  return rc;
+}
+
+int PMPI_Op_free(MPI_Op *op) {
+  int rc = capi_call("op_free", NULL, "(i)", (int)*op);
+  *op = MPI_OP_NULL;
+  return rc;
+}
+
+int PMPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                         MPI_Info info, MPI_Comm *newcomm) {
+  (void)info;
+  capi_ret r;
+  int rc = capi_call("comm_split_type", &r, "(iii)", (int)comm, split_type,
+                     key);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newcomm = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_create_struct(int count, const int blocklengths[],
+                            const MPI_Aint displacements[],
+                            const MPI_Datatype types[],
+                            MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_create_struct", &r, "(iKKK)", count,
+                     PTR(blocklengths), PTR(displacements), PTR(types));
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
+                        const int recvcounts[], MPI_Datatype datatype,
+                        MPI_Op op, MPI_Comm comm) {
+  return capi_call("reduce_scatter", NULL, "(KKKiii)", PTR(sendbuf),
+                   PTR(recvbuf), PTR(recvcounts), (int)datatype, (int)op,
+                   (int)comm);
+}
+
 /* ---- dynamic process management ------------------------------------ */
 
 int PMPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
@@ -884,6 +928,15 @@ TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
 TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, Op_create, (MPI_User_function *, int, MPI_Op *))
+TPUMPI_WEAK(int, Op_free, (MPI_Op *))
+TPUMPI_WEAK(int, Comm_split_type, (MPI_Comm, int, int, MPI_Info, MPI_Comm *))
+TPUMPI_WEAK(int, Type_create_struct,
+            (int, const int[], const MPI_Aint[], const MPI_Datatype[],
+             MPI_Datatype *))
+TPUMPI_WEAK(int, Reduce_scatter,
+            (const void *, void *, const int[], MPI_Datatype, MPI_Op,
+             MPI_Comm))
 TPUMPI_WEAK(int, Comm_spawn,
             (const char *, char *[], int, MPI_Info, int, MPI_Comm,
              MPI_Comm *, int[]))

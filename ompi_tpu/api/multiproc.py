@@ -173,6 +173,11 @@ class MultiProcComm(PersistentP2PMixin):
     def reduce_scatter_block(self, x, op: Op = SUM):
         return self._lookup("reduce_scatter_block")(x, op)
 
+    def reduce_scatter(self, x, op: Op = SUM, counts=None):
+        """Jagged counts: x is each local rank's flat (sum(counts), …)
+        contribution; returns this process's local ranks' segments."""
+        return self._lookup("reduce_scatter")(x, op, counts)
+
     def alltoall(self, x):
         return self._lookup("alltoall")(x)
 

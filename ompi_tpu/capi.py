@@ -1070,3 +1070,134 @@ def comm_remote_size(h: int):
         return (MPI_SUCCESS, int(rs))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
+
+
+# -- user-defined ops (MPI_Op_create over a C callback) -------------------
+
+#: reverse map: numpy dtype → a representative C datatype code
+_DT_CODE = {}
+for _code, _dt in DTYPES.items():
+    _DT_CODE.setdefault(_dt, _code)
+
+_next_op = 64  # predefined op codes stay below (OPS is the registry)
+
+
+def op_create(fnptr: int, commute: int):
+    """MPI_Op_create: wrap the C user function
+    ``void fn(void *invec, void *inoutvec, int *len, MPI_Datatype *dt)``
+    as an Op whose host kernel invokes it per fold step (invec = left
+    operand, inoutvec = accumulator, per the reference's
+    ompi_op_reduce convention)."""
+    global _next_op
+    try:
+        UFN = ctypes.CFUNCTYPE(
+            None, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        )
+        cfn = UFN(fnptr)
+        # the np_fn closure holds cfn — the trampoline lives exactly as
+        # long as the Op it powers
+
+        def np_fn(a, b):
+            a = np.ascontiguousarray(a)
+            out = np.array(b, copy=True)
+            code = _DT_CODE.get(out.dtype)
+            if code is None:
+                raise err.MPITypeError(
+                    f"user op: unsupported dtype {out.dtype}"
+                )
+            n = ctypes.c_int(out.size)
+            dt = ctypes.c_int(code)
+            cfn(a.ctypes.data, out.ctypes.data,
+                ctypes.byref(n), ctypes.byref(dt))
+            return out
+
+        op = opmod.Op(
+            f"user_op_{_next_op}", jax_fn=None, np_fn=np_fn,
+            commutative=bool(commute),
+        )
+        handle = _next_op
+        _next_op += 1
+        OPS[handle] = op
+        return (MPI_SUCCESS, handle)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def op_free(handle: int) -> int:
+    if handle >= 64:  # predefined ops are permanent
+        OPS.pop(handle, None)
+    return MPI_SUCCESS
+
+
+# -- comm_split_type / struct datatype / jagged reduce_scatter ------------
+
+
+def comm_split_type(h: int, split_type: int, key: int):
+    """MPI_Comm_split_type.  Rides the collective comm_split machinery
+    (so SHARED/UNDEFINED mixes across ranks pair up and ``key``
+    orders ranks per the standard).  SHARED (1) resolves to one domain
+    spanning the comm: the RTE is single-host, so every process shares
+    the host — a multi-host RTE would key the color by hostname from
+    the modex."""
+    try:
+        if split_type == -32766:  # MPI_UNDEFINED
+            return comm_split(h, -32766, key)
+        if split_type != 1:  # MPI_COMM_TYPE_SHARED
+            raise err.MPIArgError(f"unknown split_type {split_type}")
+        return comm_split(h, 0, key)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def type_create_struct(count: int, bl_ptr: int, disp_ptr: int,
+                       types_ptr: int):
+    try:
+        from ompi_tpu.ddt.datatype import create_struct
+
+        bls = [int(v) for v in _view(bl_ptr, count, 7)]
+        disps = [int(v) for v in _view(disp_ptr, count, 20)]  # MPI_Aint
+        codes = [int(v) for v in _view(types_ptr, count, 7)]
+        d = create_struct(bls, disps, [_ddt(c) for c in codes])
+        return (MPI_SUCCESS, _store_dtype(d))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def reduce_scatter(sptr, rptr, counts_ptr, dtcode, opcode, h) -> int:
+    """MPI_Reduce_scatter with per-rank counts (jagged allowed).
+    Equal counts route through the block path (fabric); jagged through
+    the ordered host fold."""
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        counts = [int(v) for v in _view(counts_ptr, n, 7)]
+        total = sum(counts)
+        me = comm_rank(h)[1]
+        src = (_view(rptr, total, dtcode) if sptr == _IN_PLACE
+               else _view(sptr, total, dtcode))
+        if len(set(counts)) == 1:
+            x = src.reshape(1, n, counts[0]).copy()
+            out = c.reduce_scatter_block(x, OPS[opcode])
+            mine = np.asarray(out)[me if _is_single_controller(c) else 0]
+        else:
+            x = src[None, :].copy()
+            if _is_single_controller(c):
+                # Comm.reduce_scatter validates op/dtype + counts and
+                # takes the (n, total) whole-comm shape
+                out = c.reduce_scatter(
+                    np.broadcast_to(x[0], (n,) + x[0].shape).copy(),
+                    OPS[opcode], counts,
+                )
+                mine = out[me]
+            else:
+                out = c.reduce_scatter(x, OPS[opcode], counts)
+                mine = out[0]
+        got = min(counts[me], int(np.asarray(mine).size))
+        if got:
+            _view(rptr, got, dtcode)[:] = (
+                np.asarray(mine).reshape(-1).view(DTYPES[dtcode])[:got]
+            )
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)

@@ -147,11 +147,28 @@ class HanCollModule(CollModule):
         return comm.dcn.allreduce(local, op, cid, ordered=self._ordered())
 
     def reduce_scatter(self, x, op: Op, counts=None, _cid=None):
-        if counts is not None and len(set(counts)) != 1:
-            raise NotImplementedError(
-                "jagged reduce_scatter on multi-process comms: next round"
+        """Equal counts → block path; jagged counts: every rank
+        contributes a flat (sum(counts), *tail) buffer, rank j receives
+        its counts[j] reduced segment — this process returns its local
+        ranks' segments as a list (the distributed shape of
+        coll/basic's jagged contract)."""
+        if counts is None or len(set(counts)) == 1:
+            return self.reduce_scatter_block(x, op, _cid=_cid)
+        comm = self.comm
+        if len(counts) != comm.size:
+            from ompi_tpu.core.errors import MPIArgError
+
+            raise MPIArgError(
+                f"reduce_scatter counts length {len(counts)} != comm "
+                f"size {comm.size}"
             )
-        return self.reduce_scatter_block(x, op, _cid=_cid)
+        red = self.allreduce_rows(np.asarray(x), op, _cid=_cid)
+        offs = np.cumsum([0] + list(counts)).tolist()
+        lo = comm.local_offset
+        return [
+            red[offs[lo + l] : offs[lo + l + 1]].copy()
+            for l in range(comm.local_size)
+        ]
 
     def alltoall(self, x, _cid=None):
         comm = self.comm
