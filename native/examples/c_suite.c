@@ -429,6 +429,37 @@ int main(int argc, char **argv) {
     free(sb2); free(rb2); free(cnts2);
   }
 
+  /* one-sided: fence-epoch put + accumulate + get + fetch_and_op */
+  {
+    double wbuf[4] = {0, 0, 0, (double)rank};
+    MPI_Win w;
+    MPI_Win_create(wbuf, sizeof(wbuf), sizeof(double), MPI_INFO_NULL,
+                   MPI_COMM_WORLD, &w);
+    MPI_Win_fence(0, w);
+    double pv = 50.0 + rank;
+    MPI_Put(&pv, 1, MPI_DOUBLE, (rank + 1) % size, 0, 1, MPI_DOUBLE, w);
+    double av = 1.0;
+    MPI_Accumulate(&av, 1, MPI_DOUBLE, (rank + 1) % size, 1, 1, MPI_DOUBLE,
+                   MPI_SUM, w);
+    MPI_Win_fence(0, w);
+    int left = (rank + size - 1) % size;
+    CHECK(wbuf[0] == 50.0 + left && wbuf[1] == 1.0, "win_put_acc");
+    /* get my right neighbor's slot 3 (its rank) */
+    double gv = -1.0;
+    MPI_Get(&gv, 1, MPI_DOUBLE, (rank + 1) % size, 3, 1, MPI_DOUBLE, w);
+    CHECK(gv == (double)((rank + 1) % size), "win_get");
+    /* passive atomics: everyone fetch-adds 2.0 into rank 0 slot 2 */
+    MPI_Win_lock(MPI_LOCK_SHARED, 0, 0, w);
+    double inc = 2.0, old = -1.0;
+    MPI_Fetch_and_op(&inc, &old, MPI_DOUBLE, 0, 2, MPI_SUM, w);
+    MPI_Win_unlock(0, w);
+    MPI_Barrier(MPI_COMM_WORLD);
+    if (rank == 0) CHECK(wbuf[2] == 2.0 * size, "win_fetch_and_op");
+    else printf("OK win_fetch_and_op rank=%d\n", rank);
+    MPI_Win_free(&w);
+    CHECK(w == MPI_WIN_NULL, "win_free");
+  }
+
   printf("CSUITE PASS rank=%d size=%d\n", rank, size);
   MPI_Finalize();
   return 0;

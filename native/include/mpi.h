@@ -28,6 +28,7 @@ typedef int MPI_Request;
 typedef int MPI_Errhandler;
 typedef int MPI_Info;
 typedef int MPI_Group;
+typedef int MPI_Win;
 typedef long long MPI_Aint;
 typedef long long MPI_Offset;
 typedef long long MPI_Count;
@@ -240,6 +241,40 @@ TPUMPI_PROTO(int, Comm_create_group,
              (MPI_Comm comm, MPI_Group group, int tag, MPI_Comm *newcomm))
 TPUMPI_PROTO(int, Comm_compare,
              (MPI_Comm comm1, MPI_Comm comm2, int *result))
+
+/* one-sided (RMA) */
+#define MPI_WIN_NULL ((MPI_Win)0)
+#define MPI_LOCK_SHARED 1
+#define MPI_LOCK_EXCLUSIVE 2
+#define MPI_MODE_NOCHECK 1024
+TPUMPI_PROTO(int, Win_create,
+             (void *base, MPI_Aint size, int disp_unit, MPI_Info info,
+              MPI_Comm comm, MPI_Win *win))
+TPUMPI_PROTO(int, Win_free, (MPI_Win * win))
+TPUMPI_PROTO(int, Win_fence, (int assertion, MPI_Win win))
+TPUMPI_PROTO(int, Put,
+             (const void *origin_addr, int origin_count,
+              MPI_Datatype origin_datatype, int target_rank,
+              MPI_Aint target_disp, int target_count,
+              MPI_Datatype target_datatype, MPI_Win win))
+TPUMPI_PROTO(int, Get,
+             (void *origin_addr, int origin_count,
+              MPI_Datatype origin_datatype, int target_rank,
+              MPI_Aint target_disp, int target_count,
+              MPI_Datatype target_datatype, MPI_Win win))
+TPUMPI_PROTO(int, Accumulate,
+             (const void *origin_addr, int origin_count,
+              MPI_Datatype origin_datatype, int target_rank,
+              MPI_Aint target_disp, int target_count,
+              MPI_Datatype target_datatype, MPI_Op op, MPI_Win win))
+TPUMPI_PROTO(int, Fetch_and_op,
+             (const void *origin_addr, void *result_addr,
+              MPI_Datatype datatype, int target_rank, MPI_Aint target_disp,
+              MPI_Op op, MPI_Win win))
+TPUMPI_PROTO(int, Win_lock,
+             (int lock_type, int rank, int assertion, MPI_Win win))
+TPUMPI_PROTO(int, Win_unlock, (int rank, MPI_Win win))
+TPUMPI_PROTO(int, Win_flush, (int rank, MPI_Win win))
 
 /* user-defined reduction operations */
 typedef void(MPI_User_function)(void *invec, void *inoutvec, int *len,

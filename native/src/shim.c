@@ -589,6 +589,82 @@ int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
   return rc;
 }
 
+/* ---- one-sided (RMA windows) --------------------------------------- */
+
+int PMPI_Win_create(void *base, MPI_Aint size, int disp_unit, MPI_Info info,
+                    MPI_Comm comm, MPI_Win *win) {
+  (void)info;
+  capi_ret r;
+  int rc = capi_call("win_create", &r, "(KLii)", PTR(base), (long long)size,
+                     disp_unit, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *win = (MPI_Win)r.v[0];
+  return rc;
+}
+
+int PMPI_Win_free(MPI_Win *win) {
+  int rc = capi_call("win_free", NULL, "(i)", (int)*win);
+  *win = MPI_WIN_NULL;
+  return rc;
+}
+
+int PMPI_Win_fence(int assertion, MPI_Win win) {
+  return capi_call("win_fence", NULL, "(ii)", (int)win, assertion);
+}
+
+int PMPI_Put(const void *origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win) {
+  if (origin_count != target_count || origin_datatype != target_datatype)
+    return capi_call("win_type_error", NULL, "()");
+  return capi_call("win_put", NULL, "(iKiiiL)", (int)win, PTR(origin_addr),
+                   origin_count, (int)origin_datatype, target_rank,
+                   (long long)target_disp);
+}
+
+int PMPI_Get(void *origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win) {
+  if (origin_count != target_count || origin_datatype != target_datatype)
+    return capi_call("win_type_error", NULL, "()");
+  return capi_call("win_get", NULL, "(iKiiiL)", (int)win, PTR(origin_addr),
+                   origin_count, (int)origin_datatype, target_rank,
+                   (long long)target_disp);
+}
+
+int PMPI_Accumulate(const void *origin_addr, int origin_count,
+                    MPI_Datatype origin_datatype, int target_rank,
+                    MPI_Aint target_disp, int target_count,
+                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win) {
+  if (origin_count != target_count || origin_datatype != target_datatype)
+    return capi_call("win_type_error", NULL, "()");
+  return capi_call("win_accumulate", NULL, "(iKiiiLi)", (int)win,
+                   PTR(origin_addr), origin_count, (int)origin_datatype,
+                   target_rank, (long long)target_disp, (int)op);
+}
+
+int PMPI_Fetch_and_op(const void *origin_addr, void *result_addr,
+                      MPI_Datatype datatype, int target_rank,
+                      MPI_Aint target_disp, MPI_Op op, MPI_Win win) {
+  return capi_call("win_fetch_and_op", NULL, "(iKKiiLi)", (int)win,
+                   PTR(origin_addr), PTR(result_addr), (int)datatype,
+                   target_rank, (long long)target_disp, (int)op);
+}
+
+int PMPI_Win_lock(int lock_type, int rank, int assertion, MPI_Win win) {
+  return capi_call("win_lock", NULL, "(iiii)", (int)win, lock_type, rank,
+                   assertion);
+}
+
+int PMPI_Win_unlock(int rank, MPI_Win win) {
+  return capi_call("win_unlock", NULL, "(ii)", (int)win, rank);
+}
+
+int PMPI_Win_flush(int rank, MPI_Win win) {
+  return capi_call("win_flush", NULL, "(ii)", (int)win, rank);
+}
+
 /* ---- user ops / split_type / struct type / reduce_scatter ---------- */
 
 int PMPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op) {
@@ -928,6 +1004,25 @@ TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
 TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, Win_create,
+            (void *, MPI_Aint, int, MPI_Info, MPI_Comm, MPI_Win *))
+TPUMPI_WEAK(int, Win_free, (MPI_Win *))
+TPUMPI_WEAK(int, Win_fence, (int, MPI_Win))
+TPUMPI_WEAK(int, Put,
+            (const void *, int, MPI_Datatype, int, MPI_Aint, int,
+             MPI_Datatype, MPI_Win))
+TPUMPI_WEAK(int, Get,
+            (void *, int, MPI_Datatype, int, MPI_Aint, int, MPI_Datatype,
+             MPI_Win))
+TPUMPI_WEAK(int, Accumulate,
+            (const void *, int, MPI_Datatype, int, MPI_Aint, int,
+             MPI_Datatype, MPI_Op, MPI_Win))
+TPUMPI_WEAK(int, Fetch_and_op,
+            (const void *, void *, MPI_Datatype, int, MPI_Aint, MPI_Op,
+             MPI_Win))
+TPUMPI_WEAK(int, Win_lock, (int, int, int, MPI_Win))
+TPUMPI_WEAK(int, Win_unlock, (int, MPI_Win))
+TPUMPI_WEAK(int, Win_flush, (int, MPI_Win))
 TPUMPI_WEAK(int, Op_create, (MPI_User_function *, int, MPI_Op *))
 TPUMPI_WEAK(int, Op_free, (MPI_Op *))
 TPUMPI_WEAK(int, Comm_split_type, (MPI_Comm, int, int, MPI_Info, MPI_Comm *))

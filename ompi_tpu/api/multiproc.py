@@ -85,10 +85,24 @@ class MultiProcComm(PersistentP2PMixin):
         self._ft = None
         self._shrink_count = 0
         self._spawn_count = 0
+        self._win_count = 0
         self._freed = False
         self.dcn.register_p2p(self.cid, self._on_p2p_frame)
         self.dcn.register_comm(self.cid, self)
         self.procctx.register_comm(self)
+
+    def _next_win(self) -> int:
+        """Per-comm window counter (SPMD — window creation is
+        collective)."""
+        k = self._win_count
+        self._win_count += 1
+        return k
+
+    def win_create(self, bases, name: str = ""):
+        """MPI_Win_create over the DCN (one 1-D base per local rank)."""
+        from ompi_tpu.osc.dcn import MultiProcWin
+
+        return MultiProcWin(self, bases, name)
 
     def _next_spawn(self) -> int:
         """Per-comm spawn counter (SPMD-agreed, names the child world's

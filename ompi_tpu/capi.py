@@ -1201,3 +1201,205 @@ def reduce_scatter(sptr, rptr, counts_ptr, dtcode, opcode, h) -> int:
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e, h)
+
+
+# -- one-sided (MPI_Win_* over the DCN osc / single-controller osc) -------
+
+_wins: dict[int, object] = {}
+_next_win_h = 1
+
+
+def _win(h: int):
+    w = _wins.get(h)
+    if w is None:
+        raise err.MPIWinError(f"invalid window handle {h}")
+    return w
+
+
+def win_create(base_ptr: int, size_bytes: int, disp_unit: int, h: int):
+    """MPI_Win_create: expose `size_bytes` of caller memory.  The
+    window views the C memory zero-copy (puts land in the C array)."""
+    global _next_win_h
+    try:
+        c = _comm(h)
+        nbytes = int(size_bytes)
+        if nbytes > 0:
+            raw = (ctypes.c_ubyte * nbytes).from_address(base_ptr)
+            base = np.frombuffer(raw, dtype=np.uint8)
+        else:
+            base = np.zeros(0, np.uint8)
+        if _is_single_controller(c):
+            from ompi_tpu.osc.win import Win
+
+            # standalone: a size-1 world — per-rank bases is just ours
+            w = Win.create(c, [base])
+        else:
+            w = c.win_create([base])
+        w._disp_unit = max(1, int(disp_unit))
+        handle = _next_win_h
+        _next_win_h += 1
+        _wins[handle] = w
+        return (MPI_SUCCESS, handle)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def win_free(wh: int) -> int:
+    try:
+        w = _wins.pop(wh, None)
+        if w is not None:
+            w.free()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_fence(wh: int, assertion: int) -> int:
+    try:
+        _win(wh).fence(assertion)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def _is_dist_win(w) -> bool:
+    """MultiProcWin (DCN windows) vs the single-controller Win."""
+    return not _is_single_controller(w.comm)
+
+
+def _win_elem_disp(w, tdisp: int, dt) -> int:
+    byte_disp = int(tdisp) * w._disp_unit
+    if byte_disp % dt.itemsize:
+        raise err.MPIWinError(
+            f"displacement {tdisp} (x{w._disp_unit}B) not aligned to "
+            f"{dt.itemsize}-byte elements"
+        )
+    return byte_disp // dt.itemsize
+
+
+def win_type_error() -> int:
+    """Shim helper: asymmetric origin/target type signatures are
+    unsupported — raised HERE so the comm errhandler applies (the
+    default ARE_FATAL aborts instead of silently skipping the op)."""
+    return _fail(err.MPITypeError(
+        "RMA origin and target type/count must match in this "
+        "implementation"
+    ), 1)
+
+
+def win_put(wh: int, optr: int, count: int, dtcode: int, target: int,
+            tdisp: int) -> int:
+    try:
+        w = _win(wh)
+        dt = DTYPES[dtcode]
+        data = _view(optr, count, dtcode).copy()
+        e0 = _win_elem_disp(w, tdisp, dt)
+        if _is_dist_win(w):
+            w.put(target, data, disp=e0, dt=dt)
+        else:
+            w.memory(target).view(dt)[e0 : e0 + count] = data
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_get(wh: int, optr: int, count: int, dtcode: int, target: int,
+            tdisp: int) -> int:
+    try:
+        w = _win(wh)
+        dt = DTYPES[dtcode]
+        e0 = _win_elem_disp(w, tdisp, dt)
+        if _is_dist_win(w):
+            out = w.get(target, count, disp=e0, dt=dt)
+        else:
+            out = w.memory(target).view(dt)[e0 : e0 + count]
+        _view(optr, count, dtcode)[:] = np.asarray(out).reshape(-1)[:count]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_accumulate(wh: int, optr: int, count: int, dtcode: int,
+                   target: int, tdisp: int, opcode: int) -> int:
+    try:
+        w = _win(wh)
+        dt = DTYPES[dtcode]
+        data = _view(optr, count, dtcode).copy()
+        op = OPS[opcode]
+        e0 = _win_elem_disp(w, tdisp, dt)
+        if _is_dist_win(w):
+            w.accumulate(target, data, disp=e0, op=op, dt=dt)
+        else:
+            seg = w.memory(target).view(dt)[e0 : e0 + count]
+            seg[:] = data if op is opmod.REPLACE else op.np_fn(seg, data)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_fetch_and_op(wh: int, optr: int, rptr: int, dtcode: int,
+                     target: int, tdisp: int, opcode: int) -> int:
+    try:
+        w = _win(wh)
+        dt = DTYPES[dtcode]
+        op = OPS[opcode]
+        # MPI_NO_OP: origin buffer is irrelevant and may be NULL —
+        # never dereference it (a read would segfault the interpreter)
+        val = (dt.type(0) if op is opmod.NO_OP or optr == 0
+               else _view(optr, 1, dtcode)[0])
+        e0 = _win_elem_disp(w, tdisp, dt)
+        if _is_dist_win(w):
+            old = w.fetch_and_op(target, val, disp=e0, op=op, dt=dt)
+        else:
+            mem = w.memory(target).view(dt)
+            old = mem[e0].copy()
+            if op is opmod.REPLACE:
+                mem[e0] = val
+            elif op is not opmod.NO_OP:
+                mem[e0] = op.np_fn(np.asarray(mem[e0]), np.asarray(val))
+        _view(rptr, 1, dtcode)[0] = old
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_lock(wh: int, lock_type: int, target: int, assertion: int) -> int:
+    try:
+        w = _win(wh)
+        if _is_dist_win(w):
+            w.lock(target, lock_type)
+        else:
+            from ompi_tpu.osc import win as _oscwin
+
+            # mpi.h: SHARED=1, EXCLUSIVE=2 — osc/win.py's constants
+            # differ, so translate rather than forward the raw value
+            lt = (_oscwin.LOCK_SHARED if lock_type == 1
+                  else _oscwin.LOCK_EXCLUSIVE)
+            w.lock(0, target, lt, assertion)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_unlock(wh: int, target: int) -> int:
+    try:
+        w = _win(wh)
+        if _is_dist_win(w):
+            w.unlock(target)
+        else:
+            w.unlock(0, target)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_flush(wh: int, target: int) -> int:
+    try:
+        w = _win(wh)
+        if _is_dist_win(w):
+            w.flush(target)
+        else:
+            w.flush(0, target)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)

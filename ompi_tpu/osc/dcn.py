@@ -1,0 +1,329 @@
+"""Distributed one-sided windows over the DCN — osc for multi-process.
+
+≈ the reference's ``osc/rdma``+``osc/pt2pt`` pair reduced to the DCN
+transport (SURVEY.md §2.2 osc row, §3.5): each GLOBAL rank exposes a
+1-D numpy buffer; origins issue Put/Get/Accumulate as ``rma`` frames
+the target process's receiver thread applies atomically (per-window
+target-side lock — the passive-target atomicity the standard's
+UNIFIED model needs).
+
+Completion model (the osc "sync" machinery):
+
+* **fence**: counts outgoing ops per target process; at the fence an
+  alltoall of sent-counts tells every process how many inbound ops to
+  wait for, it spins until its applied-counter matches, then a
+  barrier closes the epoch — the reference's fence-with-counters.
+* **get / fetch_and_op / compare_and_swap**: request/reply frames
+  (origin blocks on the reply) — inherently complete when they
+  return.
+* **flush(target)**: a sync ping/ack round to the target process —
+  all previously issued ops to that target are applied when it
+  returns (frames are FIFO per connection pair).
+* **lock/unlock (passive)**: per-op target-side atomicity makes a
+  LOCK_SHARED epoch a no-op bracket; unlock = flush.  LOCK_EXCLUSIVE
+  is serviced with the same per-op atomicity (documented relaxation:
+  multi-op critical sections should use fetch_and_op/CAS).
+
+Window ids ride the comm's CID namespace (``w<cid>#<k>``, k = the
+comm's SPMD window counter), so streams never collide across windows
+or comms.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIWinError
+from ompi_tpu.op import op as opmod
+
+_REPLY_OPS = ("get", "fao", "cas", "sync")
+
+
+class MultiProcWin:
+    """A window spanning the processes of a MultiProcComm."""
+
+    def __init__(self, comm, bases: Sequence[np.ndarray], name: str = ""):
+        """``bases``: one 1-D buffer per LOCAL rank of this process
+        (collective; every process contributes its local ranks')."""
+        if len(bases) != comm.local_size:
+            raise MPIWinError(
+                f"need {comm.local_size} local base buffers, got {len(bases)}"
+            )
+        self.comm = comm
+        self._mem = [np.ascontiguousarray(b) for b in bases]
+        for b in self._mem:
+            if b.ndim != 1:
+                raise MPIWinError("window bases must be 1-D")
+        k = comm._next_win()
+        self.win_id = f"w{comm.cid}#{k}"
+        self.name = name or self.win_id
+        self._freed = False
+        self._lock = threading.Lock()      # target-side atomicity
+        self._applied = 0                  # inbound ops applied
+        self._sent = [0] * comm.nprocs     # outbound ops per target proc
+        self._replies: dict[int, tuple[threading.Event, list]] = {}
+        self._next_req = 0
+        self._req_lock = threading.Lock()
+        comm.dcn.register_p2p(self.win_id, self._on_frame)
+        # window geometry: exchange per-rank sizes (collective)
+        sizes = [int(b.shape[0]) for b in self._mem]
+        dts = [b.dtype.str for b in self._mem]
+        infos = comm.dcn.allgather_obj({"sizes": sizes, "dtypes": dts},
+                                       f"{self.win_id}#modex")
+        self.sizes = [s for it in infos for s in it["sizes"]]
+        self.dtypes = [np.dtype(d) for it in infos for d in it["dtypes"]]
+
+    # -- geometry -------------------------------------------------------
+
+    def _local_index(self, rank: int):
+        p, li = self.comm.locate(rank)
+        return (li if p == self.comm.proc else None), p
+
+    def memory(self, rank: int) -> np.ndarray:
+        li, p = self._local_index(rank)
+        if li is None:
+            raise MPIWinError(f"rank {rank} is not local to this process")
+        return self._mem[li]
+
+    # -- inbound application (receiver thread) --------------------------
+
+    def _on_frame(self, env: dict, payload: np.ndarray) -> None:
+        kind = env["rma"]
+        if kind == "reply":
+            with self._req_lock:
+                ent = self._replies.get(env["req"])
+            if ent is not None:
+                ent[1].append(payload)
+                ent[0].set()
+            return
+        self._apply(env, payload, inbound=True)
+
+    @staticmethod
+    def _acc_op(name: str) -> opmod.Op:
+        """Accumulate requires a PREDEFINED op (MPI 12.3.4)."""
+        op = getattr(opmod, name.split("_", 1)[1], None) if name.startswith(
+            "MPI_") else None
+        if not isinstance(op, opmod.Op):
+            raise MPIWinError(f"accumulate requires a predefined op; got "
+                              f"{name!r}")
+        return op
+
+    def _apply(self, env: dict, payload: np.ndarray,
+               inbound: bool) -> None:
+        kind = env["rma"]
+        li, _ = self._local_index(env["target"])
+        if li is None:  # misrouted — drop loudly
+            import sys
+
+            print(f"[ompi_tpu osc/dcn] frame for non-local rank "
+                  f"{env['target']} on {self.name}", file=sys.stderr)
+            return
+        mem = self._mem[li]
+        # C-ABI windows are byte-typed: ops may carry their element
+        # dtype ("dt") and address in elements of it
+        if "dt" in env:
+            mem = mem.view(np.dtype(env["dt"]))
+        disp = int(env.get("disp", 0))
+        reply = None
+        with self._lock:
+            if kind == "put":
+                data = payload.view(mem.dtype)
+                mem[disp : disp + data.size] = data
+            elif kind == "acc":
+                data = payload.view(mem.dtype)
+                op = self._acc_op(env["op"])
+                seg = mem[disp : disp + data.size]
+                if op is opmod.REPLACE:
+                    seg[:] = data
+                else:
+                    seg[:] = op.np_fn(seg, data)
+            elif kind == "get":
+                count = int(env["count"])
+                reply = mem[disp : disp + count].copy()
+            elif kind == "fao":
+                op = self._acc_op(env["op"])
+                old = mem[disp].copy()
+                val = payload.view(mem.dtype)[0]
+                if op is opmod.REPLACE:
+                    mem[disp] = val
+                elif op is not opmod.NO_OP:
+                    mem[disp] = op.np_fn(
+                        np.asarray(mem[disp]), np.asarray(val)
+                    )
+                reply = np.asarray([old], mem.dtype)
+            elif kind == "cas":
+                pair = payload.view(mem.dtype)  # [value, compare]
+                old = mem[disp].copy()
+                if old == pair[1]:
+                    mem[disp] = pair[0]
+                reply = np.asarray([old], mem.dtype)
+            elif kind == "sync":
+                reply = np.zeros(0, np.uint8)
+            if inbound:
+                # fence counts REMOTE inbound only; locally-applied ops
+                # are complete by construction
+                self._applied += 1
+        if reply is not None:
+            if env["origin_proc"] == self.comm.proc:
+                with self._req_lock:
+                    ent = self._replies.get(env["req"])
+                if ent is not None:
+                    ent[1].append(reply)
+                    ent[0].set()
+            else:
+                self.comm.dcn.send_p2p(
+                    env["origin_proc"],
+                    {"cid": self.win_id, "rma": "reply", "req": env["req"]},
+                    reply,
+                )
+
+    # -- origin-side issue ----------------------------------------------
+
+    def _check(self):
+        if self._freed:
+            raise MPIWinError(f"{self.name} has been freed")
+
+    def _issue(self, target: int, env: dict, payload: np.ndarray,
+               reply: bool = False):
+        self._check()
+        li, p = self._local_index(target)
+        env = {"cid": self.win_id, "target": target,
+               "origin_proc": self.comm.proc, **env}
+        if reply:
+            with self._req_lock:
+                rid = self._next_req
+                self._next_req += 1
+                ev: tuple = (threading.Event(), [])
+                self._replies[rid] = ev
+            env["req"] = rid
+        if li is not None:
+            # local target: apply directly (atomicity via the shared
+            # lock; not counted as inbound — see fence)
+            self._apply(env, payload, inbound=False)
+        else:
+            self._sent[p] += 1
+            self.comm.dcn.send_p2p(p, env, payload)
+        if reply:
+            try:
+                if not ev[0].wait(timeout=120):
+                    raise MPIWinError(
+                        f"RMA reply timeout from rank {target} on "
+                        f"{self.name}"
+                    )
+            finally:
+                with self._req_lock:
+                    self._replies.pop(env["req"], None)
+            return ev[1][0]
+        return None
+
+    def put(self, target: int, data, disp: int = 0, dt=None) -> None:
+        data = np.ascontiguousarray(data)
+        env = {"rma": "put", "disp": int(disp)}
+        if dt is not None:
+            env["dt"] = np.dtype(dt).str
+        self._issue(target, env, data.view(np.uint8).reshape(-1))
+
+    def get(self, target: int, count: int, disp: int = 0,
+            dt=None) -> np.ndarray:
+        env = {"rma": "get", "disp": int(disp), "count": int(count)}
+        if dt is not None:
+            env["dt"] = np.dtype(dt).str
+        out = self._issue(target, env, np.zeros(0, np.uint8), reply=True)
+        return np.asarray(out).view(
+            np.dtype(dt) if dt is not None else self.dtypes[target]
+        )
+
+    def accumulate(self, target: int, data, disp: int = 0,
+                   op: opmod.Op = opmod.SUM, dt=None) -> None:
+        data = np.ascontiguousarray(data)
+        env = {"rma": "acc", "disp": int(disp), "op": op.name}
+        if dt is not None:
+            env["dt"] = np.dtype(dt).str
+        self._issue(target, env, data.view(np.uint8).reshape(-1))
+
+    def fetch_and_op(self, target: int, value, disp: int = 0,
+                     op: opmod.Op = opmod.SUM, dt=None) -> np.ndarray:
+        d = np.dtype(dt) if dt is not None else self.dtypes[target]
+        v = np.asarray([value], d)
+        env = {"rma": "fao", "disp": int(disp), "op": op.name}
+        if dt is not None:
+            env["dt"] = d.str
+        out = self._issue(target, env, v.view(np.uint8).reshape(-1),
+                          reply=True)
+        return np.asarray(out).view(d)[0]
+
+    def compare_and_swap(self, target: int, value, compare,
+                         disp: int = 0, dt=None) -> np.ndarray:
+        d = np.dtype(dt) if dt is not None else self.dtypes[target]
+        pair = np.asarray([value, compare], d)
+        env = {"rma": "cas", "disp": int(disp)}
+        if dt is not None:
+            env["dt"] = d.str
+        out = self._issue(target, env, pair.view(np.uint8).reshape(-1),
+                          reply=True)
+        return np.asarray(out).view(d)[0]
+
+    # -- synchronization -------------------------------------------------
+
+    def fence(self, assertion: int = 0) -> None:
+        """Fence epoch close: counters + barrier (see module doc)."""
+        del assertion
+        self._check()
+        comm = self.comm
+        # per-target-proc sent counts → every proc's expected inbound
+        sent = [np.asarray([c], np.int64) for c in self._sent]
+        got = comm.dcn.alltoall(sent, f"{self.win_id}#fence")
+        expected = int(sum(int(g[0]) for i, g in enumerate(got)
+                           if i != comm.proc))
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        while True:
+            with self._lock:
+                applied = self._applied
+            if applied >= expected:
+                break
+            if _time.monotonic() > deadline:
+                raise MPIWinError(
+                    f"fence timeout: {applied}/{expected} inbound "
+                    f"ops applied on {self.name}"
+                )
+            _time.sleep(0.0005)
+        self._sent = [0] * comm.nprocs
+        with self._lock:
+            self._applied -= expected
+        comm.dcn.barrier(f"{self.win_id}#fencebar")
+
+    def flush(self, target: int) -> None:
+        """All previously issued ops to ``target``'s process are applied
+        (FIFO per connection + a sync round-trip)."""
+        li, _ = self._local_index(target)
+        if li is not None:
+            return
+        self._issue(target, {"rma": "sync"}, np.zeros(0, np.uint8),
+                    reply=True)
+
+    def lock(self, target: int, lock_type: int = 0) -> None:
+        """Passive epoch open (per-op atomicity services both lock
+        kinds — see module doc)."""
+        self._check()
+        del target, lock_type
+
+    def unlock(self, target: int) -> None:
+        self.flush(target)
+
+    def lock_all(self) -> None:
+        self._check()
+
+    def unlock_all(self) -> None:
+        for p in range(self.comm.nprocs):
+            lo, _hi = self.comm.proc_range(p)
+            if p != self.comm.proc:
+                self.flush(lo)
+
+    def free(self) -> None:
+        self.comm.dcn.unregister_p2p(self.win_id)
+        self._freed = True

@@ -109,6 +109,19 @@ def test_tpurun_nonblocking_progress():
         assert len(hits) == 2, f"{check}: {hits}\n{out}"
 
 
+def test_tpurun_rma_windows():
+    """Distributed one-sided windows over DCN: fence-epoch put/
+    accumulate, get, fetch_and_op, compare_and_swap, passive flush."""
+    res = run_tpurun(3, REPO / "tests" / "workers" / "mp_rma_worker.py",
+                     cpu_devices=1, timeout=240)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("rma_fence", "rma_get", "rma_fao", "rma_cas",
+                  "rma_passive", "rma_done"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 3, f"{check}: {hits}\n{out}"
+
+
 def test_tpurun_comm_spawn():
     """Dynamic process management: a 2-proc job spawns 2 children;
     p2p crosses the worlds both ways, the merged 4-proc comm runs
