@@ -460,6 +460,39 @@ int main(int argc, char **argv) {
     CHECK(w == MPI_WIN_NULL, "win_free");
   }
 
+  /* MPI-IO: per-rank write_at, collective read back, seek/read */
+  {
+    MPI_File fh;
+    int rc = MPI_File_open(MPI_COMM_WORLD, "csuite_io.bin",
+                           MPI_MODE_CREATE | MPI_MODE_RDWR |
+                               MPI_MODE_DELETE_ON_CLOSE,
+                           MPI_INFO_NULL, &fh);
+    CHECK(rc == MPI_SUCCESS, "file_open");
+    double mine[2] = {rank * 1.5, rank + 0.25};
+    MPI_Status fst;
+    MPI_File_write_at_all(fh, rank * (MPI_Offset)sizeof(mine), mine, 2,
+                          MPI_DOUBLE, &fst);
+    int wcnt = 0;
+    MPI_Get_count(&fst, MPI_DOUBLE, &wcnt);
+    CHECK(wcnt == 2, "file_write_at_all");
+    /* read the RIGHT neighbor's block (written by another process) */
+    int nb = (rank + 1) % size;
+    double theirs[2] = {0, 0};
+    MPI_File_read_at_all(fh, nb * (MPI_Offset)sizeof(mine), theirs, 2,
+                         MPI_DOUBLE, MPI_STATUS_IGNORE);
+    CHECK(theirs[0] == nb * 1.5 && theirs[1] == nb + 0.25, "file_read_at");
+    MPI_Offset fsz = 0;
+    MPI_File_get_size(fh, &fsz);
+    CHECK(fsz == (MPI_Offset)(size * sizeof(mine)), "file_get_size");
+    /* individual pointer: seek to own block and read it */
+    MPI_File_seek(fh, rank * (MPI_Offset)sizeof(mine), MPI_SEEK_SET);
+    double back[2] = {0, 0};
+    MPI_File_read(fh, back, 2, MPI_DOUBLE, MPI_STATUS_IGNORE);
+    CHECK(back[0] == rank * 1.5 && back[1] == rank + 0.25, "file_seek_read");
+    MPI_File_close(&fh);
+    CHECK(fh == MPI_FILE_NULL, "file_close");
+  }
+
   printf("CSUITE PASS rank=%d size=%d\n", rank, size);
   MPI_Finalize();
   return 0;

@@ -29,6 +29,7 @@ typedef int MPI_Errhandler;
 typedef int MPI_Info;
 typedef int MPI_Group;
 typedef int MPI_Win;
+typedef int MPI_File;
 typedef long long MPI_Aint;
 typedef long long MPI_Offset;
 typedef long long MPI_Count;
@@ -241,6 +242,48 @@ TPUMPI_PROTO(int, Comm_create_group,
              (MPI_Comm comm, MPI_Group group, int tag, MPI_Comm *newcomm))
 TPUMPI_PROTO(int, Comm_compare,
              (MPI_Comm comm1, MPI_Comm comm2, int *result))
+
+/* MPI-IO */
+#define MPI_FILE_NULL ((MPI_File)0)
+#define MPI_MODE_CREATE 1
+#define MPI_MODE_RDONLY 2
+#define MPI_MODE_WRONLY 4
+#define MPI_MODE_RDWR 8
+#define MPI_MODE_DELETE_ON_CLOSE 16
+#define MPI_MODE_UNIQUE_OPEN 32
+#define MPI_MODE_EXCL 64
+#define MPI_MODE_APPEND 128
+#define MPI_MODE_SEQUENTIAL 256
+#define MPI_SEEK_SET 600
+#define MPI_SEEK_CUR 602
+#define MPI_SEEK_END 604
+TPUMPI_PROTO(int, File_open,
+             (MPI_Comm comm, const char *filename, int amode, MPI_Info info,
+              MPI_File *fh))
+TPUMPI_PROTO(int, File_close, (MPI_File * fh))
+TPUMPI_PROTO(int, File_get_size, (MPI_File fh, MPI_Offset *size))
+TPUMPI_PROTO(int, File_set_size, (MPI_File fh, MPI_Offset size))
+TPUMPI_PROTO(int, File_seek, (MPI_File fh, MPI_Offset offset, int whence))
+TPUMPI_PROTO(int, File_write_at,
+             (MPI_File fh, MPI_Offset offset, const void *buf, int count,
+              MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO(int, File_read_at,
+             (MPI_File fh, MPI_Offset offset, void *buf, int count,
+              MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO(int, File_write,
+             (MPI_File fh, const void *buf, int count, MPI_Datatype datatype,
+              MPI_Status *status))
+TPUMPI_PROTO(int, File_read, (MPI_File fh, void *buf, int count,
+                              MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO(int, File_write_at_all,
+             (MPI_File fh, MPI_Offset offset, const void *buf, int count,
+              MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO(int, File_read_at_all,
+             (MPI_File fh, MPI_Offset offset, void *buf, int count,
+              MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO(int, File_set_view,
+             (MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
+              MPI_Datatype filetype, const char *datarep, MPI_Info info))
 
 /* one-sided (RMA) */
 #define MPI_WIN_NULL ((MPI_Win)0)

@@ -589,6 +589,113 @@ int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
   return rc;
 }
 
+/* ---- MPI-IO --------------------------------------------------------- */
+
+int PMPI_File_open(MPI_Comm comm, const char *filename, int amode,
+                   MPI_Info info, MPI_File *fh) {
+  (void)info;
+  capi_ret r;
+  int rc = capi_call("file_open", &r, "(isi)", (int)comm, filename, amode);
+  if (rc == MPI_SUCCESS && r.n >= 1) *fh = (MPI_File)r.v[0];
+  return rc;
+}
+
+int PMPI_File_close(MPI_File *fh) {
+  int rc = capi_call("file_close", NULL, "(i)", (int)*fh);
+  *fh = MPI_FILE_NULL;
+  return rc;
+}
+
+int PMPI_File_get_size(MPI_File fh, MPI_Offset *size) {
+  capi_ret r;
+  int rc = capi_call("file_get_size", &r, "(i)", (int)fh);
+  if (rc == MPI_SUCCESS && r.n >= 1) *size = (MPI_Offset)r.v[0];
+  return rc;
+}
+
+int PMPI_File_set_size(MPI_File fh, MPI_Offset size) {
+  return capi_call("file_set_size", NULL, "(iL)", (int)fh, (long long)size);
+}
+
+int PMPI_File_seek(MPI_File fh, MPI_Offset offset, int whence) {
+  return capi_call("file_seek", NULL, "(iLi)", (int)fh, (long long)offset,
+                   whence);
+}
+
+static void io_status(MPI_Status *status, const capi_ret *r) {
+  if (status && r->n >= 1) {
+    status->MPI_SOURCE = 0;
+    status->MPI_TAG = 0;
+    status->MPI_ERROR = MPI_SUCCESS;
+    status->_count = (int)r->v[0];
+  }
+}
+
+int PMPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                       int count, MPI_Datatype datatype, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_write_at", &r, "(iLKii)", (int)fh,
+                     (long long)offset, PTR(buf), count, (int)datatype);
+  if (rc == MPI_SUCCESS) io_status(status, &r);
+  return rc;
+}
+
+int PMPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf, int count,
+                      MPI_Datatype datatype, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_read_at", &r, "(iLKii)", (int)fh,
+                     (long long)offset, PTR(buf), count, (int)datatype);
+  if (rc == MPI_SUCCESS) io_status(status, &r);
+  return rc;
+}
+
+int PMPI_File_write(MPI_File fh, const void *buf, int count,
+                    MPI_Datatype datatype, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_write", &r, "(iKii)", (int)fh, PTR(buf), count,
+                     (int)datatype);
+  if (rc == MPI_SUCCESS) io_status(status, &r);
+  return rc;
+}
+
+int PMPI_File_read(MPI_File fh, void *buf, int count, MPI_Datatype datatype,
+                   MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_read", &r, "(iKii)", (int)fh, PTR(buf), count,
+                     (int)datatype);
+  if (rc == MPI_SUCCESS) io_status(status, &r);
+  return rc;
+}
+
+int PMPI_File_write_at_all(MPI_File fh, MPI_Offset offset, const void *buf,
+                           int count, MPI_Datatype datatype,
+                           MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_write_at_all", &r, "(iLKii)", (int)fh,
+                     (long long)offset, PTR(buf), count, (int)datatype);
+  if (rc == MPI_SUCCESS) io_status(status, &r);
+  return rc;
+}
+
+int PMPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                          int count, MPI_Datatype datatype,
+                          MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_read_at_all", &r, "(iLKii)", (int)fh,
+                     (long long)offset, PTR(buf), count, (int)datatype);
+  if (rc == MPI_SUCCESS) io_status(status, &r);
+  return rc;
+}
+
+int PMPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
+                       MPI_Datatype filetype, const char *datarep,
+                       MPI_Info info) {
+  (void)datarep;
+  (void)info;
+  return capi_call("file_set_view", NULL, "(iLii)", (int)fh,
+                   (long long)disp, (int)etype, (int)filetype);
+}
+
 /* ---- one-sided (RMA windows) --------------------------------------- */
 
 int PMPI_Win_create(void *base, MPI_Aint size, int disp_unit, MPI_Info info,
@@ -1004,6 +1111,28 @@ TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
 TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, File_open, (MPI_Comm, const char *, int, MPI_Info, MPI_File *))
+TPUMPI_WEAK(int, File_close, (MPI_File *))
+TPUMPI_WEAK(int, File_get_size, (MPI_File, MPI_Offset *))
+TPUMPI_WEAK(int, File_set_size, (MPI_File, MPI_Offset))
+TPUMPI_WEAK(int, File_seek, (MPI_File, MPI_Offset, int))
+TPUMPI_WEAK(int, File_write_at,
+            (MPI_File, MPI_Offset, const void *, int, MPI_Datatype,
+             MPI_Status *))
+TPUMPI_WEAK(int, File_read_at,
+            (MPI_File, MPI_Offset, void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_WEAK(int, File_write,
+            (MPI_File, const void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_WEAK(int, File_read,
+            (MPI_File, void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_WEAK(int, File_write_at_all,
+            (MPI_File, MPI_Offset, const void *, int, MPI_Datatype,
+             MPI_Status *))
+TPUMPI_WEAK(int, File_read_at_all,
+            (MPI_File, MPI_Offset, void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_WEAK(int, File_set_view,
+            (MPI_File, MPI_Offset, MPI_Datatype, MPI_Datatype, const char *,
+             MPI_Info))
 TPUMPI_WEAK(int, Win_create,
             (void *, MPI_Aint, int, MPI_Info, MPI_Comm, MPI_Win *))
 TPUMPI_WEAK(int, Win_free, (MPI_Win *))

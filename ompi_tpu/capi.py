@@ -1403,3 +1403,224 @@ def win_flush(wh: int, target: int) -> int:
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e)
+
+
+# -- MPI-IO (MPI_File_* over the ompio stack) -----------------------------
+
+_files: dict[int, object] = {}
+_next_file_h = 1
+
+
+def _file(fh: int):
+    f = _files.get(fh)
+    if f is None:
+        raise err.MPIFileError(f"invalid file handle {fh}")
+    return f
+
+
+def file_open(h: int, path: str, amode: int):
+    """MPI_File_open (collective).  Multi-process jobs open the file
+    per-process over the LOCAL comm (the shared filesystem is the
+    coupling, as in fs/ufs); collective completion is a comm barrier.
+    Shared-file-pointer ops are therefore single-process only."""
+    global _next_file_h
+    try:
+        c = _comm(h)
+        if _is_single_controller(c):
+            f = c.file_open(path, amode)
+            ent = (f, False, 0, c)
+        else:
+            from ompi_tpu.io.file import MODE_DELETE_ON_CLOSE
+            from ompi_tpu.op import MIN as _MIN
+
+            # per-process open over the shared filesystem: exactly one
+            # process (proc 0) carries DELETE_ON_CLOSE, so the first
+            # close cannot delete the file out from under the others
+            amode_local = amode
+            if (amode & MODE_DELETE_ON_CLOSE) and c.proc != 0:
+                amode_local &= ~MODE_DELETE_ON_CLOSE
+            f = exc = None
+            try:
+                f = c.local.file_open(path, amode_local)
+            except err.MPIError as e2:
+                exc = e2
+            # collective success agreement: a one-sided failure must
+            # not leave the successful openers stuck in a barrier
+            ok = c.allreduce(
+                np.full((c.local_size, 1), 0.0 if exc else 1.0), _MIN
+            )
+            if float(np.asarray(ok).min()) < 1.0:
+                if f is not None:
+                    f.close()
+                raise exc if exc is not None else err.MPIFileError(
+                    f"collective open of {path!r} failed on a peer process"
+                )
+            ent = (f, True, 0, c)
+        handle = _next_file_h
+        _next_file_h += 1
+        _files[handle] = ent
+        return (MPI_SUCCESS, handle)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def file_close(fh: int) -> int:
+    """Collective close: multi-process files barrier first so the
+    DELETE_ON_CLOSE holder (proc 0) deletes only after every process
+    finished its IO."""
+    try:
+        ent = _files.get(fh)
+        if ent is not None:
+            if ent[1]:
+                ent[3].barrier()
+            ent[0].close()
+            _files.pop(fh, None)  # only a completed close releases
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def file_get_size(fh: int):
+    try:
+        return (MPI_SUCCESS, int(_file(fh)[0].get_size()))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_set_size(fh: int, size: int) -> int:
+    try:
+        _file(fh)[0].set_size(int(size))
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def file_seek(fh: int, offset: int, whence: int) -> int:
+    try:
+        f, multi, _r, _c = _file(fh)
+        f.seek(0, int(offset), int(whence))
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def _dense_read_clamp(f, byte_start: int, count: int, itemsize: int) -> int:
+    """MPI requires a reduced count at EOF.  For dense views (filetype
+    == etype: the byte-stream default) the available bytes are exactly
+    file size − start; exotic filetype maps keep the requested count
+    (the io engine zero-fills holes by design)."""
+    disp, etype, filetype = f.get_view(0)
+    if filetype.size != etype.size:
+        return count
+    avail = max(0, f.get_size() - (disp + byte_start))
+    return min(count, avail // max(1, itemsize))
+
+
+def _etype_units(f, nbytes: int) -> int:
+    """C counts are datatype elements; the io layer counts etypes of
+    the current view — convert (must divide exactly)."""
+    esize = f.get_view(0)[1].size
+    if nbytes % max(1, esize):
+        raise err.MPIArgError(
+            f"{nbytes} B is not a whole number of view etypes ({esize} B)"
+        )
+    return nbytes // max(1, esize)
+
+
+def file_write_at(fh: int, offset: int, ptr: int, count: int,
+                  dtcode: int):
+    try:
+        f = _file(fh)[0]
+        data = _pack_from(ptr, count, dtcode)
+        dt_size = (_dtypes[dtcode].size if dtcode in _dtypes
+                   else DTYPES[dtcode].itemsize)
+        written = f.write_at(0, int(offset), np.asarray(data))
+        esize = f.get_view(0)[1].size
+        return (MPI_SUCCESS, written * esize // max(1, dt_size))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_read_at(fh: int, offset: int, ptr: int, count: int, dtcode: int):
+    try:
+        f = _file(fh)[0]
+        dt = DTYPES.get(dtcode)
+        if dt is None:
+            raise err.MPITypeError(f"unsupported datatype {dtcode}")
+        esize = f.get_view(0)[1].size
+        count = _dense_read_clamp(f, int(offset) * esize, count, dt.itemsize)
+        units = _etype_units(f, count * dt.itemsize)
+        out = f.read_at(0, int(offset), units, dtype=dt)
+        got = int(np.asarray(out).size)
+        if got:
+            _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
+        return (MPI_SUCCESS, got)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_write(fh: int, ptr: int, count: int, dtcode: int):
+    try:
+        f = _file(fh)[0]
+        data = _pack_from(ptr, count, dtcode)
+        written = f.write(0, np.asarray(data))
+        esize = f.get_view(0)[1].size
+        dt_size = (_dtypes[dtcode].size if dtcode in _dtypes
+                   else DTYPES[dtcode].itemsize)
+        return (MPI_SUCCESS, written * esize // max(1, dt_size))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_read(fh: int, ptr: int, count: int, dtcode: int):
+    try:
+        f = _file(fh)[0]
+        dt = DTYPES.get(dtcode)
+        if dt is None:
+            raise err.MPITypeError(f"unsupported datatype {dtcode}")
+        esize = f.get_view(0)[1].size
+        count = _dense_read_clamp(f, f.get_position(0) * esize, count,
+                                  dt.itemsize)
+        out = f.read(0, _etype_units(f, count * dt.itemsize), dtype=dt)
+        got = int(np.asarray(out).size)
+        if got:
+            _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
+        return (MPI_SUCCESS, got)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_write_at_all(fh: int, offset: int, ptr: int, count: int,
+                      dtcode: int):
+    """Collective write: independent data movement + completion
+    barrier (the fcoll two-phase optimization applies in the
+    single-controller engine; across processes the filesystem is the
+    aggregator)."""
+    try:
+        ent = _file(fh)
+        rc = file_write_at(fh, offset, ptr, count, dtcode)
+        if ent[1]:
+            ent[3].barrier()
+        return rc
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_read_at_all(fh: int, offset: int, ptr: int, count: int,
+                     dtcode: int):
+    try:
+        ent = _file(fh)
+        if ent[1]:
+            ent[3].barrier()  # writers before us have completed
+        return file_read_at(fh, offset, ptr, count, dtcode)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_set_view(fh: int, disp: int, etype_code: int, filetype_code: int):
+    try:
+        f = _file(fh)[0]
+        f.set_view(0, int(disp), _ddt(etype_code), _ddt(filetype_code))
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
