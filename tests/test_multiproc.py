@@ -109,6 +109,18 @@ def test_tpurun_nonblocking_progress():
         assert len(hits) == 2, f"{check}: {hits}\n{out}"
 
 
+def test_tpurun_stress_soak():
+    """25 mixed-feature iterations (collectives, NBC, split comms, p2p,
+    RMA, dup churn) + end-state hygiene: delivery queues drained,
+    handler registry stable — the leak/race net."""
+    res = run_tpurun(3, REPO / "tests" / "workers" / "mp_stress_worker.py",
+                     cpu_devices=2, timeout=300)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    assert sum("OK stress " in l for l in out.splitlines()) == 3
+    assert sum("OK stress_done " in l for l in out.splitlines()) == 3
+
+
 def test_tpurun_rma_windows():
     """Distributed one-sided windows over DCN: fence-epoch put/
     accumulate, get, fetch_and_op, compare_and_swap, passive flush."""
