@@ -493,6 +493,53 @@ int main(int argc, char **argv) {
     CHECK(fh == MPI_FILE_NULL, "file_close");
   }
 
+  /* probe/iprobe + bsend + names + error class */
+  if (size >= 2) {
+    if (rank == 0) {
+      double pv2[3] = {1, 2, 3};
+      MPI_Bsend(pv2, 3, MPI_DOUBLE, 1, 55, MPI_COMM_WORLD);
+    } else if (rank == 1) {
+      MPI_Status pst;
+      MPI_Probe(0, 55, MPI_COMM_WORLD, &pst);
+      int pcnt = 0;
+      MPI_Get_count(&pst, MPI_DOUBLE, &pcnt);
+      CHECK(pst.MPI_SOURCE == 0 && pcnt == 3, "probe_envelope");
+      int pflag = 0;
+      MPI_Iprobe(0, 55, MPI_COMM_WORLD, &pflag, MPI_STATUS_IGNORE);
+      CHECK(pflag == 1, "iprobe_flag");
+      double pin[3];
+      MPI_Recv(pin, 3, MPI_DOUBLE, 0, 55, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+      CHECK(pin[2] == 3.0, "probe_then_recv");
+      MPI_Iprobe(0, 55, MPI_COMM_WORLD, &pflag, MPI_STATUS_IGNORE);
+      CHECK(pflag == 0, "iprobe_consumed");
+    }
+  }
+  if (rank != 1) {
+    printf("OK probe_envelope rank=%d\n", rank);
+    printf("OK iprobe_flag rank=%d\n", rank);
+    printf("OK probe_then_recv rank=%d\n", rank);
+    printf("OK iprobe_consumed rank=%d\n", rank);
+  }
+  {
+    char cname[MPI_MAX_OBJECT_NAME];
+    int clen = 0;
+    MPI_Comm_get_name(MPI_COMM_WORLD, cname, &clen);
+    CHECK(clen > 0, "comm_get_name");
+    int ecls = -1;
+    MPI_Error_class(MPI_ERR_RANK, &ecls);
+    CHECK(ecls == MPI_ERR_RANK, "error_class");
+    char lver[MPI_MAX_LIBRARY_VERSION_STRING];
+    int lvlen = 0;
+    MPI_Get_library_version(lver, &lvlen);
+    CHECK(lvlen > 0, "library_version");
+    MPI_Datatype ddup;
+    MPI_Type_dup(MPI_DOUBLE, &ddup);
+    int dsz = 0;
+    MPI_Type_size(ddup, &dsz);
+    CHECK(dsz == 8, "type_dup");
+    MPI_Type_free(&ddup);
+  }
+
   printf("CSUITE PASS rank=%d size=%d\n", rank, size);
   MPI_Finalize();
   return 0;

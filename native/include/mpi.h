@@ -65,6 +65,7 @@ typedef struct MPI_Status {
 #define MPI_MAX_PROCESSOR_NAME 256
 #define MPI_MAX_ERROR_STRING 256
 #define MPI_MAX_OBJECT_NAME 64
+#define MPI_MAX_LIBRARY_VERSION_STRING 256
 
 /* -- datatypes (codes mirrored in ompi_tpu/capi.py) ----------------- */
 #define MPI_DATATYPE_NULL ((MPI_Datatype)0)
@@ -183,7 +184,25 @@ TPUMPI_PROTO(int, Get_count,
 TPUMPI_PROTO(double, Wtime, (void))
 TPUMPI_PROTO(double, Wtick, (void))
 
+TPUMPI_PROTO(int, Comm_get_name,
+             (MPI_Comm comm, char *comm_name, int *resultlen))
+TPUMPI_PROTO(int, Error_class, (int errorcode, int *errorclass))
+TPUMPI_PROTO(int, Get_library_version, (char *version, int *resultlen))
+TPUMPI_PROTO(int, Get_address, (const void *location, MPI_Aint *address))
+
 /* pt2pt */
+TPUMPI_PROTO(int, Probe, (int source, int tag, MPI_Comm comm,
+                          MPI_Status *status))
+TPUMPI_PROTO(int, Iprobe, (int source, int tag, MPI_Comm comm, int *flag,
+                           MPI_Status *status))
+TPUMPI_PROTO(int, Bsend, (const void *buf, int count, MPI_Datatype datatype,
+                          int dest, int tag, MPI_Comm comm))
+TPUMPI_PROTO(int, Rsend, (const void *buf, int count, MPI_Datatype datatype,
+                          int dest, int tag, MPI_Comm comm))
+TPUMPI_PROTO(int, Buffer_attach, (void *buffer, int size))
+TPUMPI_PROTO(int, Buffer_detach, (void *buffer_addr, int *size))
+TPUMPI_PROTO(int, Type_dup, (MPI_Datatype oldtype, MPI_Datatype *newtype))
+
 TPUMPI_PROTO(int, Send, (const void *buf, int count, MPI_Datatype datatype,
                          int dest, int tag, MPI_Comm comm))
 TPUMPI_PROTO(int, Recv, (void *buf, int count, MPI_Datatype datatype,

@@ -119,6 +119,41 @@ static int capi_call(const char *fn, capi_ret *out, const char *fmt, ...) {
   return err;
 }
 
+/* Call capi.<fn> expecting (err, str): copies the string into buf. */
+static int capi_call_str(const char *fn, char *buf, int bufsz, int *outlen,
+                         const char *fmt, ...) {
+  if (!g_capi) return MPI_ERR_OTHER;
+  PyGILState_STATE g = PyGILState_Ensure();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  int rc = MPI_ERR_INTERN;
+  if (args) {
+    PyObject *f = PyObject_GetAttrString(g_capi, fn);
+    if (f) {
+      PyObject *r = PyObject_CallObject(f, args);
+      Py_DECREF(f);
+      if (r && PyTuple_Check(r) && PyTuple_Size(r) >= 2) {
+        rc = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+        if (s) {
+          snprintf(buf, (size_t)bufsz, "%s", s);
+          if (outlen) *outlen = (int)strlen(buf);
+        }
+      }
+      Py_XDECREF(r);
+    }
+    Py_DECREF(args);
+  }
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    rc = MPI_ERR_OTHER;
+  }
+  PyGILState_Release(g);
+  return rc;
+}
+
 static void fill_status(MPI_Status *status, const capi_ret *r, int base) {
   if (status && r->n >= base + 3) {
     status->MPI_SOURCE = (int)r->v[base];
@@ -297,6 +332,75 @@ int PMPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
   rc = PMPI_Send(sendbuf, sendcount, sendtype, dest, sendtag, comm);
   if (rc != MPI_SUCCESS) return rc;
   return PMPI_Wait(&rreq, status);
+}
+
+int PMPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("probe", &r, "(iii)", source, tag, (int)comm);
+  if (rc == MPI_SUCCESS) fill_status(status, &r, 0);
+  return rc;
+}
+
+int PMPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+                MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("iprobe", &r, "(iii)", source, tag, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) {
+    *flag = (int)r.v[0];
+    if (*flag) fill_status(status, &r, 1);
+  }
+  return rc;
+}
+
+/* Buffered / ready sends: the pml is eager-buffered, which satisfies
+ * both modes' completion contracts (Bsend: local completion via
+ * buffering; Rsend: erroneous unless a recv is posted — eager is a
+ * legal implementation that simply always succeeds). */
+int PMPI_Bsend(const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm) {
+  return PMPI_Send(buf, count, datatype, dest, tag, comm);
+}
+
+int PMPI_Rsend(const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm) {
+  return PMPI_Send(buf, count, datatype, dest, tag, comm);
+}
+
+int PMPI_Buffer_attach(void *buffer, int size) {
+  (void)buffer; (void)size;  /* pml buffers internally */
+  return MPI_SUCCESS;
+}
+
+int PMPI_Buffer_detach(void *buffer_addr, int *size) {
+  if (size) *size = 0;
+  (void)buffer_addr;
+  return MPI_SUCCESS;
+}
+
+int PMPI_Comm_get_name(MPI_Comm comm, char *comm_name, int *resultlen) {
+  return capi_call_str("comm_get_name", comm_name, MPI_MAX_OBJECT_NAME,
+                       resultlen, "(i)", (int)comm);
+}
+
+int PMPI_Error_class(int errorcode, int *errorclass) {
+  *errorclass = errorcode;  /* codes ARE classes in this implementation */
+  return MPI_SUCCESS;
+}
+
+int PMPI_Get_library_version(char *version, int *resultlen) {
+  snprintf(version, MPI_MAX_LIBRARY_VERSION_STRING,
+           "ompi_tpu (TPU-native MPI) %d.%d", MPI_VERSION, MPI_SUBVERSION);
+  *resultlen = (int)strlen(version);
+  return MPI_SUCCESS;
+}
+
+int PMPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype) {
+  return PMPI_Type_contiguous(1, oldtype, newtype);
+}
+
+int PMPI_Get_address(const void *location, MPI_Aint *address) {
+  *address = (MPI_Aint)(uintptr_t)location;
+  return MPI_SUCCESS;
 }
 
 /* ---- requests ------------------------------------------------------ */
@@ -1092,6 +1196,17 @@ TPUMPI_WEAK(int, Iallgather,
 TPUMPI_WEAK(int, Ialltoall,
             (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
              MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Probe, (int, int, MPI_Comm, MPI_Status *))
+TPUMPI_WEAK(int, Iprobe, (int, int, MPI_Comm, int *, MPI_Status *))
+TPUMPI_WEAK(int, Bsend, (const void *, int, MPI_Datatype, int, int, MPI_Comm))
+TPUMPI_WEAK(int, Rsend, (const void *, int, MPI_Datatype, int, int, MPI_Comm))
+TPUMPI_WEAK(int, Buffer_attach, (void *, int))
+TPUMPI_WEAK(int, Buffer_detach, (void *, int *))
+TPUMPI_WEAK(int, Comm_get_name, (MPI_Comm, char *, int *))
+TPUMPI_WEAK(int, Error_class, (int, int *))
+TPUMPI_WEAK(int, Get_library_version, (char *, int *))
+TPUMPI_WEAK(int, Type_dup, (MPI_Datatype, MPI_Datatype *))
+TPUMPI_WEAK(int, Get_address, (const void *, MPI_Aint *))
 TPUMPI_WEAK(int, Testall, (int, MPI_Request[], int *, MPI_Status[]))
 TPUMPI_WEAK(int, Testany, (int, MPI_Request[], int *, int *, MPI_Status *))
 TPUMPI_WEAK(int, Waitany, (int, MPI_Request[], int *, MPI_Status *))

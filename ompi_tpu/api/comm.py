@@ -644,15 +644,14 @@ class Comm(PersistentP2PMixin):
 
     def probe(self, dest: int, source: int | None = None, tag: int | None = None):
         """MPI_Probe (blocking): wait for a matching envelope."""
-        import time as _time
+        from ompi_tpu.request import _poll_backoff
 
         sleep = 0.0
         while True:
             st = self.iprobe(dest, source, tag)
             if st is not None:
                 return st
-            _time.sleep(sleep)
-            sleep = min(max(sleep * 2, 50e-6), 1e-3)
+            sleep = _poll_backoff(sleep)
 
     def iprobe(self, dest: int, source: int | None = None, tag: int | None = None):
         from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG

@@ -333,6 +333,37 @@ class MultiProcComm(PersistentP2PMixin):
         req = self.irecv(dest, source, tag)
         return req.wait(), req.status
 
+    def iprobe(self, dest: int, source: int | None = None,
+               tag: int | None = None):
+        """MPI_Iprobe on the local matching engine (remote sends are
+        injected there by the receiver thread, so probing is local).
+        ``dest`` must be a locally-owned rank, like irecv."""
+        from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG
+
+        dproc, _ = self.locate(dest)
+        if dproc != self.proc:
+            raise MPIRankError(f"rank {dest} not owned by process {self.proc}")
+        if self._ft is not None:
+            from ompi_tpu.ft import ulfm
+
+            ulfm.check(self, peer=source, any_source=source is None)
+        return self.pml.iprobe(
+            dest,
+            ANY_SOURCE if source is None else source,
+            ANY_TAG if tag is None else tag,
+        )
+
+    def probe(self, dest: int, source: int | None = None,
+              tag: int | None = None):
+        from ompi_tpu.request import _poll_backoff
+
+        sleep = 0.0
+        while True:
+            st = self.iprobe(dest, source, tag)
+            if st is not None:
+                return st
+            sleep = _poll_backoff(sleep)
+
     # -- fault tolerance (ULFM over DCN — SURVEY.md §5) ------------------
 
     def _on_proc_failed(self, root_proc: int) -> None:

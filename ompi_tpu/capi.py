@@ -1624,3 +1624,40 @@ def file_set_view(fh: int, disp: int, etype_code: int, filetype_code: int):
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e)
+
+
+# -- probe / name / error utilities --------------------------------------
+
+
+def iprobe(source: int, tag: int, h: int):
+    """MPI_Iprobe: (flag, source, tag, count) — count in ELEMENTS of
+    the payload's dtype (what PMPI_Get_count reports verbatim)."""
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        st = c.iprobe(me, None if source == -1 else source,
+                      None if tag == -1 else tag)
+        if st is None:
+            return (MPI_SUCCESS, 0, -1, -1, 0)
+        return (MPI_SUCCESS, 1, int(st.source), int(st.tag), int(st.count))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0, -1, -1, 0)
+
+
+def probe(source: int, tag: int, h: int):
+    """MPI_Probe (blocking)."""
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        st = c.probe(me, None if source == -1 else source,
+                     None if tag == -1 else tag)
+        return (MPI_SUCCESS, int(st.source), int(st.tag), int(st.count))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), -1, -1, 0)
+
+
+def comm_get_name(h: int):
+    try:
+        return (MPI_SUCCESS, str(_comm(h).name))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), "")

@@ -198,9 +198,12 @@ class MatchingEngine:
 
     def iprobe(self, dest: int, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Status | None:
         """Non-blocking probe: envelope of the first matching unexpected
-        message, without consuming it."""
+        message, without consuming it.  PROC_NULL probes "match"
+        immediately with the null status (MPI 3.8.2)."""
         self._check_rank(dest)
         self._check_rank(source, wild_ok=True)
+        if source == PROC_NULL:
+            return Status.null()
         with self._lock:
             best = None
             for m in self._unexpected[dest]:
