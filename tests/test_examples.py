@@ -1,0 +1,96 @@
+"""The worked examples: ring attention (long-context sequence
+parallelism) and DDP training — the "switch from the reference"
+workflows, exact against dense/host oracles.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+import ompi_tpu.api as api
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+def test_ring_attention_matches_dense(world):
+    from ring_attention import reference_attention, ring_attention
+
+    rng = np.random.RandomState(3)
+    n, block, heads, dh = world.size, 5, 2, 4
+    q = rng.randn(n, block, heads, dh).astype(np.float32)
+    k = rng.randn(n, block, heads, dh).astype(np.float32)
+    v = rng.randn(n, block, heads, dh).astype(np.float32)
+    out = ring_attention(world, q, k, v)
+    ref = reference_attention(q, k, v)
+    assert out.shape == (n, block, heads, dh)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_long_sequence(world):
+    """Bigger blocks: per-rank memory stays O(seq/n) while the result
+    covers the full sequence."""
+    from ring_attention import reference_attention, ring_attention
+
+    rng = np.random.RandomState(9)
+    n = world.size
+    q = rng.randn(n, 32, 1, 8).astype(np.float32)
+    k = rng.randn(n, 32, 1, 8).astype(np.float32)
+    v = rng.randn(n, 32, 1, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        ring_attention(world, q, k, v), reference_attention(q, k, v),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_ddp_host_step_descends_and_replicas_agree(world):
+    from ddp_training import init_params, train_step_host, _loss
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n = world.size
+    params = init_params(rng)
+    x = rng.randn(n, 16, 8).astype(np.float32)
+    y = (x.sum(axis=-1, keepdims=True) * 0.1).astype(np.float32)
+    xs, ys = x.reshape(-1, 8), y.reshape(-1, 1)
+    l0 = float(_loss(params, jnp.asarray(xs), jnp.asarray(ys)))
+    for _ in range(10):
+        params = train_step_host(world, params, x, y)
+    l1 = float(_loss(params, jnp.asarray(xs), jnp.asarray(ys)))
+    assert l1 < l0 * 0.9, (l0, l1)
+
+
+def test_ddp_fused_step_matches_host_math(world):
+    """The single-jitted ring-allreduce step computes the same update
+    as the host-API step (replicas stay bit-identical through the
+    compiled sync)."""
+    from ddp_training import (init_params, make_fused_step, replicate,
+                              train_step_host)
+
+    rng = np.random.RandomState(7)
+    n = world.size
+    params = init_params(rng)
+    x = rng.randn(n, 8, 8).astype(np.float32)
+    y = (x[..., :1] * 0.5).astype(np.float32)
+
+    host = train_step_host(world, dict(params), x, y)
+
+    step = make_fused_step(world.mesh.mesh, n)
+    rep = replicate(params, n)
+    dev = {k: world.mesh.stage_in(v) for k, v in rep.items()}
+    xd = world.mesh.stage_in(x)
+    yd = world.mesh.stage_in(y)
+    fused = step(dev, xd, yd)
+    for key in params:
+        got = np.asarray(fused[key])
+        # every replica row identical (the compiled sync is exact)
+        for r in range(1, n):
+            np.testing.assert_array_equal(got[0], got[r])
+        np.testing.assert_allclose(got[0], host[key], rtol=2e-5, atol=2e-6)
