@@ -86,8 +86,9 @@ def ring_attention_program(n: int):
     return per_device
 
 
-#: compiled-program cache: (mesh, n) → jitted ring program (jit's own
-#: cache then keys on shapes/dtypes — repeat calls dispatch, not retrace)
+#: compiled-program cache: mesh → jitted ring program (jit's own cache
+#: then keys on shapes/dtypes — repeat calls dispatch, not retrace);
+#: bounded like coll/xla's cache so comm churn can't pin meshes forever
 _compiled: dict = {}
 
 
@@ -96,9 +97,11 @@ def ring_attention(comm, q, k, v):
     communicator's ranks.  q/k/v: rank-major (n, block, heads, dh)."""
     n = comm.size
     mesh = comm.mesh.mesh
-    key = (mesh, n)
+    key = mesh
     fn = _compiled.get(key)
     if fn is None:
+        if len(_compiled) > 64:
+            _compiled.clear()
         fn = jax.jit(shard_map(
             ring_attention_program(n),
             mesh=mesh,
