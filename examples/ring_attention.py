@@ -97,7 +97,9 @@ def ring_attention(comm, q, k, v):
     communicator's ranks.  q/k/v: rank-major (n, block, heads, dh)."""
     n = comm.size
     mesh = comm.mesh.mesh
-    key = mesh
+    # n is NOT derivable from the mesh: a MultiProcComm's local mesh
+    # can serve comms of different global sizes — key on both
+    key = (mesh, n)
     fn = _compiled.get(key)
     if fn is None:
         if len(_compiled) > 64:

@@ -129,7 +129,7 @@ def test_tpurun_rma_windows():
     out = res.stdout.decode()
     assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
     for check in ("rma_fence", "rma_get", "rma_fao", "rma_cas",
-                  "rma_passive", "rma_done"):
+                  "rma_passive", "rma_subcomm", "rma_done"):
         hits = [l for l in out.splitlines() if f"OK {check} " in l]
         assert len(hits) == 3, f"{check}: {hits}\n{out}"
 

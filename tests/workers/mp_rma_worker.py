@@ -74,6 +74,18 @@ if p == 0:
     assert win.memory(0)[6] == 999.0, win.memory(0)[6]
 print(f"OK rma_passive proc={p}", flush=True)
 
+# windows over a SPLIT (sub-engine) communicator: procs {0,2}
+sub = world.split([0 if p != 1 else api.COLOR_UNDEFINED])[0]
+if sub is not None:
+    swin = sub.win_create([np.zeros(2)])
+    swin.fence()
+    swin.put(1 - sub.proc, np.array([float(10 + sub.proc)]), disp=0)
+    swin.fence()
+    assert swin.memory(sub.proc)[0] == float(10 + (1 - sub.proc))
+    swin.free()
+    sub.free()
+print(f"OK rma_subcomm proc={p}", flush=True)
+
 win.free()
 api.finalize()
 print(f"OK rma_done proc={p}", flush=True)

@@ -40,6 +40,15 @@ assert m2.local_offset == world.proc, m2.local_offset
 out = m2.allreduce(np.full((1, 1), 1.0), SUM)
 assert float(out[0, 0]) == 4.0
 
+mw = m.win_create([np.zeros(2) for _ in range(m.local_size)])
+mw.fence()
+mw.put((m.local_offset + 1) % m.size, np.array([float(m.local_offset)]),
+       disp=0)
+mw.fence()
+left = (m.local_offset - 1) % m.size
+assert mw.memory(m.local_offset)[0] == float(left), mw.memory(m.local_offset)
+mw.free()
+
 parent.free()
 out = m.allreduce(np.ones((1, 1)), SUM)
 assert float(out[0, 0]) == 4.0

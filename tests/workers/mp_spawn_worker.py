@@ -48,6 +48,16 @@ assert m2.local_offset == 2 + world.proc, m2.local_offset
 out = m2.allreduce(np.full((1, 1), 1.0), SUM)
 assert float(out[0, 0]) == 4.0
 
+# RMA window over the spawn-merged comm (join-engine routing)
+mw = m.win_create([np.zeros(2) for _ in range(m.local_size)])
+mw.fence()
+mw.put((m.local_offset + 1) % m.size, np.array([float(m.local_offset)]),
+       disp=0)
+mw.fence()
+left = (m.local_offset - 1) % m.size
+assert mw.memory(m.local_offset)[0] == float(left), mw.memory(m.local_offset)
+mw.free()
+
 # freeing the intercomm must not touch merged comms (independence)
 ic.free()
 out = m.allreduce(np.ones((1, 1)), SUM)
