@@ -43,11 +43,16 @@ def _times(fn, warmup: int, iters: int) -> list[float]:
 
 
 def _iters_for(nbytes: int, iters: int) -> tuple[int, int]:
-    """(warmup, iters) — fewer reps for giant buffers (wall-clock)."""
+    """(warmup, iters) — fewer reps for giant buffers (wall-clock),
+    MORE for tiny ones: per-call time there is tunnel-latency noise
+    (~25 us, heavy jitter), and the min over a larger sample keeps the
+    headline geomean stable run to run."""
     if nbytes >= 256 << 20:
         return 2, max(4, iters // 10)
     if nbytes >= 8 << 20:
         return 3, max(8, iters // 4)
+    if nbytes <= 1 << 20:
+        return 6, iters * 3
     return 4, iters
 
 
