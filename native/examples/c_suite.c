@@ -540,6 +540,43 @@ int main(int argc, char **argv) {
     MPI_Type_free(&ddup);
   }
 
+  /* MPI_T: enumerate cvars, read one by name, tick a pvar */
+  {
+    int prov = -1;
+    MPI_T_init_thread(MPI_THREAD_SINGLE, &prov);
+    int ncvar = 0, npvar = 0;
+    MPI_T_cvar_get_num(&ncvar);
+    MPI_T_pvar_get_num(&npvar);
+    CHECK(ncvar > 10 && npvar > 10, "mpit_enumerate");
+    int ci = -1, cval = -1;
+    MPI_T_cvar_get_index("btl_tcp_eager_limit", &ci);
+    MPI_T_cvar_read_int(ci, &cval);
+    CHECK(ci >= 0 && cval == (4 << 20), "mpit_cvar_read");
+    char cvn[MPI_MAX_OBJECT_NAME];
+    int cvl = MPI_MAX_OBJECT_NAME;
+    MPI_T_cvar_get_name(ci, cvn, &cvl);
+    CHECK(cvl > 0, "mpit_cvar_name");
+    int pi = -1;
+    long long before = -1, after = -1;
+    MPI_T_pvar_get_index("spc_allreduce", &pi);
+    MPI_T_pvar_session ps;
+    MPI_T_pvar_handle ph;
+    MPI_T_pvar_session_create(&ps);
+    MPI_T_pvar_handle_alloc(ps, pi, NULL, &ph, NULL);
+    MPI_T_pvar_start(ps, ph);  /* attaches the SPC counters */
+    MPI_T_pvar_read_int(pi, &before);
+    double tv = 1.0, to = 0.0;
+    MPI_Allreduce(&tv, &to, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+    MPI_T_pvar_read_int(pi, &after);
+    /* hierarchical comms tick per dispatch level (outer + intra-slice),
+     * so assert monotonic growth rather than an exact delta */
+    CHECK(after > before, "mpit_pvar_ticks");
+    MPI_T_pvar_stop(ps, ph);
+    MPI_T_pvar_handle_free(ps, &ph);
+    MPI_T_pvar_session_free(&ps);
+    MPI_T_finalize();
+  }
+
   printf("CSUITE PASS rank=%d size=%d\n", rank, size);
   MPI_Finalize();
   return 0;

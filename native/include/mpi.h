@@ -262,6 +262,31 @@ TPUMPI_PROTO(int, Comm_create_group,
 TPUMPI_PROTO(int, Comm_compare,
              (MPI_Comm comm1, MPI_Comm comm2, int *result))
 
+/* MPI_T tool interface (int-flavored subset: the cvar/pvar
+ * enumeration + read surface tools actually script against) */
+typedef int MPI_T_pvar_session;
+typedef int MPI_T_pvar_handle;
+TPUMPI_PROTO(int, T_pvar_session_create, (MPI_T_pvar_session * session))
+TPUMPI_PROTO(int, T_pvar_session_free, (MPI_T_pvar_session * session))
+TPUMPI_PROTO(int, T_pvar_handle_alloc,
+             (MPI_T_pvar_session session, int pvar_index, void *obj_handle,
+              MPI_T_pvar_handle *handle, int *count))
+TPUMPI_PROTO(int, T_pvar_handle_free,
+             (MPI_T_pvar_session session, MPI_T_pvar_handle *handle))
+TPUMPI_PROTO(int, T_pvar_start,
+             (MPI_T_pvar_session session, MPI_T_pvar_handle handle))
+TPUMPI_PROTO(int, T_pvar_stop,
+             (MPI_T_pvar_session session, MPI_T_pvar_handle handle))
+TPUMPI_PROTO(int, T_init_thread, (int required, int *provided))
+TPUMPI_PROTO(int, T_finalize, (void))
+TPUMPI_PROTO(int, T_cvar_get_num, (int *num_cvar))
+TPUMPI_PROTO(int, T_cvar_get_name, (int cvar_index, char *name, int *name_len))
+TPUMPI_PROTO(int, T_cvar_read_int, (int cvar_index, int *value))
+TPUMPI_PROTO(int, T_cvar_get_index, (const char *name, int *cvar_index))
+TPUMPI_PROTO(int, T_pvar_get_num, (int *num_pvar))
+TPUMPI_PROTO(int, T_pvar_read_int, (int pvar_index, long long *value))
+TPUMPI_PROTO(int, T_pvar_get_index, (const char *name, int *pvar_index))
+
 /* MPI-IO */
 #define MPI_FILE_NULL ((MPI_File)0)
 #define MPI_MODE_CREATE 1

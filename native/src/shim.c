@@ -693,6 +693,112 @@ int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
   return rc;
 }
 
+/* ---- MPI_T tool interface ------------------------------------------ */
+
+int PMPI_T_init_thread(int required, int *provided) {
+  (void)required;
+  if (provided) *provided = MPI_THREAD_SERIALIZED;
+  int rc = capi_boot();
+  if (rc != MPI_SUCCESS) return rc;
+  return capi_call("t_init", NULL, "()");
+}
+
+int PMPI_T_finalize(void) { return capi_call("t_finalize", NULL, "()"); }
+
+int PMPI_T_cvar_get_num(int *num_cvar) {
+  capi_ret r;
+  int rc = capi_call("t_cvar_get_num", &r, "()");
+  if (rc == MPI_SUCCESS && r.n >= 1) *num_cvar = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_cvar_get_name(int cvar_index, char *name, int *name_len) {
+  /* MPI_T length-query idiom: name==NULL or *name_len<=0 asks only for
+   * the required length — never write the caller's buffer then. */
+  char local[MPI_MAX_OBJECT_NAME];
+  int len = 0;
+  int rc = capi_call_str("t_cvar_get_name", local, (int)sizeof(local), &len,
+                         "(i)", cvar_index);
+  if (rc != MPI_SUCCESS) return rc;
+  if (name && name_len && *name_len > 0)
+    snprintf(name, (size_t)*name_len, "%s", local);
+  if (name_len) *name_len = len + 1; /* required size incl. NUL */
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_read_int(int cvar_index, int *value) {
+  capi_ret r;
+  int rc = capi_call("t_cvar_read", &r, "(i)", cvar_index);
+  if (rc == MPI_SUCCESS && r.n >= 1) *value = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_cvar_get_index(const char *name, int *cvar_index) {
+  capi_ret r;
+  int rc = capi_call("t_cvar_index", &r, "(s)", name);
+  if (rc == MPI_SUCCESS && r.n >= 1) *cvar_index = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_pvar_get_num(int *num_pvar) {
+  capi_ret r;
+  int rc = capi_call("t_pvar_get_num", &r, "()");
+  if (rc == MPI_SUCCESS && r.n >= 1) *num_pvar = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_pvar_read_int(int pvar_index, long long *value) {
+  capi_ret r;
+  int rc = capi_call("t_pvar_read", &r, "(i)", pvar_index);
+  if (rc == MPI_SUCCESS && r.n >= 1) *value = (long long)r.v[0];
+  return rc;
+}
+
+int PMPI_T_pvar_session_create(MPI_T_pvar_session *session) {
+  *session = 1;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_session_free(MPI_T_pvar_session *session) {
+  *session = 0;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_handle_alloc(MPI_T_pvar_session session, int pvar_index,
+                             void *obj_handle, MPI_T_pvar_handle *handle,
+                             int *count) {
+  (void)session; (void)obj_handle;
+  /* handle IS the pvar index: the read path accepts either, so there
+   * is no off-by-one trap between handle-based and index-based reads */
+  *handle = pvar_index;
+  if (count) *count = 1;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_handle_free(MPI_T_pvar_session session,
+                            MPI_T_pvar_handle *handle) {
+  (void)session;
+  *handle = -1;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_start(MPI_T_pvar_session session, MPI_T_pvar_handle handle) {
+  (void)session; (void)handle;
+  return capi_call("t_pvar_start", NULL, "()");
+}
+
+int PMPI_T_pvar_stop(MPI_T_pvar_session session, MPI_T_pvar_handle handle) {
+  (void)session; (void)handle;
+  return capi_call("t_pvar_stop", NULL, "()");
+}
+
+int PMPI_T_pvar_get_index(const char *name, int *pvar_index) {
+  capi_ret r;
+  int rc = capi_call("t_pvar_index", &r, "(s)", name);
+  if (rc == MPI_SUCCESS && r.n >= 1) *pvar_index = (int)r.v[0];
+  return rc;
+}
+
 /* ---- MPI-IO --------------------------------------------------------- */
 
 int PMPI_File_open(MPI_Comm comm, const char *filename, int amode,
@@ -1226,6 +1332,23 @@ TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
 TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, T_init_thread, (int, int *))
+TPUMPI_WEAK(int, T_finalize, (void))
+TPUMPI_WEAK(int, T_cvar_get_num, (int *))
+TPUMPI_WEAK(int, T_cvar_get_name, (int, char *, int *))
+TPUMPI_WEAK(int, T_cvar_read_int, (int, int *))
+TPUMPI_WEAK(int, T_cvar_get_index, (const char *, int *))
+TPUMPI_WEAK(int, T_pvar_get_num, (int *))
+TPUMPI_WEAK(int, T_pvar_session_create, (MPI_T_pvar_session *))
+TPUMPI_WEAK(int, T_pvar_session_free, (MPI_T_pvar_session *))
+TPUMPI_WEAK(int, T_pvar_handle_alloc,
+            (MPI_T_pvar_session, int, void *, MPI_T_pvar_handle *, int *))
+TPUMPI_WEAK(int, T_pvar_handle_free,
+            (MPI_T_pvar_session, MPI_T_pvar_handle *))
+TPUMPI_WEAK(int, T_pvar_start, (MPI_T_pvar_session, MPI_T_pvar_handle))
+TPUMPI_WEAK(int, T_pvar_stop, (MPI_T_pvar_session, MPI_T_pvar_handle))
+TPUMPI_WEAK(int, T_pvar_read_int, (int, long long *))
+TPUMPI_WEAK(int, T_pvar_get_index, (const char *, int *))
 TPUMPI_WEAK(int, File_open, (MPI_Comm, const char *, int, MPI_Info, MPI_File *))
 TPUMPI_WEAK(int, File_close, (MPI_File *))
 TPUMPI_WEAK(int, File_get_size, (MPI_File, MPI_Offset *))

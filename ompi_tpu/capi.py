@@ -1661,3 +1661,127 @@ def comm_get_name(h: int):
         return (MPI_SUCCESS, str(_comm(h).name))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), "")
+
+
+# -- MPI_T tool interface -------------------------------------------------
+
+
+def t_init() -> int:
+    try:
+        from ompi_tpu.tool import mpit
+
+        mpit.init_thread()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def t_finalize() -> int:
+    try:
+        from ompi_tpu.tool import mpit
+
+        mpit.finalize()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def t_cvar_get_num():
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, int(mpit.cvar_get_num()))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def t_cvar_get_name(index: int):
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, str(mpit.cvar_get_info(index).name))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), "")
+
+
+def t_cvar_read(index: int):
+    """Integer/bool cvars only (the C shim's _int reader): non-integer
+    cvars return an error instead of a fabricated value."""
+    try:
+        from ompi_tpu.tool import mpit
+
+        v = mpit.cvar_read(index)
+        if isinstance(v, bool) or isinstance(v, int):
+            return (MPI_SUCCESS, int(v))
+        raise err.MPIArgError(
+            f"cvar {index} is not integer-valued (use the string reader)"
+        )
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def t_cvar_index(name: str):
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, int(mpit.cvar_index(name)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), -1)
+
+
+def t_pvar_get_num():
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, int(mpit.pvar_get_num()))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def t_pvar_read(index: int):
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, int(mpit.pvar_read(index)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def t_pvar_index(name: str):
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, int(mpit.pvar_index(name)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), -1)
+
+
+_pvar_starts = 0
+
+
+def t_pvar_start() -> int:
+    """Refcounted: SPC attachment is process-global, so counting stays
+    on until the LAST started handle stops (stopping one handle must
+    not silently freeze another's counter)."""
+    global _pvar_starts
+    try:
+        from ompi_tpu.tool import mpit
+
+        mpit.pvar_start()
+        _pvar_starts += 1
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def t_pvar_stop() -> int:
+    global _pvar_starts
+    try:
+        from ompi_tpu.tool import mpit
+
+        _pvar_starts = max(0, _pvar_starts - 1)
+        if _pvar_starts == 0:
+            mpit.pvar_stop()
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
