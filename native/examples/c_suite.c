@@ -540,6 +540,34 @@ int main(int argc, char **argv) {
     MPI_Type_free(&ddup);
   }
 
+  /* cartesian topology: Dims_create + 1-D periodic ring halo */
+  {
+    int cdims[1] = {0}, cper[1] = {1};
+    MPI_Dims_create(size, 1, cdims);
+    CHECK(cdims[0] == size, "dims_create");
+    MPI_Comm cart;
+    MPI_Cart_create(MPI_COMM_WORLD, 1, cdims, cper, 0, &cart);
+    CHECK(cart != MPI_COMM_NULL, "cart_create");
+    int nd = 0;
+    MPI_Cartdim_get(cart, &nd);
+    CHECK(nd == 1, "cartdim_get");
+    int ccoords[1] = {-1};
+    MPI_Cart_coords(cart, rank, 1, ccoords);
+    CHECK(ccoords[0] == rank, "cart_coords");
+    int cr = -1;
+    MPI_Cart_rank(cart, ccoords, &cr);
+    CHECK(cr == rank, "cart_rank_roundtrip");
+    int csrc = -9, cdst = -9;
+    MPI_Cart_shift(cart, 0, 1, &csrc, &cdst);
+    CHECK(csrc == (rank + size - 1) % size && cdst == (rank + 1) % size,
+          "cart_shift");
+    double hv = 100.0 + rank, hin = -1.0;
+    MPI_Sendrecv(&hv, 1, MPI_DOUBLE, cdst, 77, &hin, 1, MPI_DOUBLE, csrc,
+                 77, cart, MPI_STATUS_IGNORE);
+    CHECK(hin == 100.0 + csrc, "cart_halo_sendrecv");
+    MPI_Comm_free(&cart);
+  }
+
   /* MPI_T: enumerate cvars, read one by name, tick a pvar */
   {
     int prov = -1;

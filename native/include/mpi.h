@@ -262,6 +262,20 @@ TPUMPI_PROTO(int, Comm_create_group,
 TPUMPI_PROTO(int, Comm_compare,
              (MPI_Comm comm1, MPI_Comm comm2, int *result))
 
+/* cartesian topology */
+TPUMPI_PROTO(int, Dims_create, (int nnodes, int ndims, int dims[]))
+TPUMPI_PROTO(int, Cart_create,
+             (MPI_Comm comm, int ndims, const int dims[], const int periods[],
+              int reorder, MPI_Comm *comm_cart))
+TPUMPI_PROTO(int, Cartdim_get, (MPI_Comm comm, int *ndims))
+TPUMPI_PROTO(int, Cart_get, (MPI_Comm comm, int maxdims, int dims[],
+                             int periods[], int coords[]))
+TPUMPI_PROTO(int, Cart_rank, (MPI_Comm comm, const int coords[], int *rank))
+TPUMPI_PROTO(int, Cart_coords,
+             (MPI_Comm comm, int rank, int maxdims, int coords[]))
+TPUMPI_PROTO(int, Cart_shift, (MPI_Comm comm, int direction, int disp,
+                               int *rank_source, int *rank_dest))
+
 /* MPI_T tool interface (int-flavored subset: the cvar/pvar
  * enumeration + read surface tools actually script against) */
 typedef int MPI_T_pvar_session;

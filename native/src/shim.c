@@ -693,6 +693,57 @@ int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
   return rc;
 }
 
+/* ---- cartesian topology -------------------------------------------- */
+
+int PMPI_Dims_create(int nnodes, int ndims, int dims[]) {
+  return capi_call("dims_create", NULL, "(iiK)", nnodes, ndims, PTR(dims));
+}
+
+int PMPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+                     const int periods[], int reorder, MPI_Comm *comm_cart) {
+  capi_ret r;
+  int rc = capi_call("cart_create", &r, "(iiKKi)", (int)comm, ndims,
+                     PTR(dims), PTR(periods), reorder);
+  if (rc == MPI_SUCCESS && r.n >= 1) *comm_cart = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Cartdim_get(MPI_Comm comm, int *ndims) {
+  capi_ret r;
+  int rc = capi_call("cartdim_get", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *ndims = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+                  int coords[]) {
+  return capi_call("cart_get", NULL, "(iiKKK)", (int)comm, maxdims,
+                   PTR(dims), PTR(periods), PTR(coords));
+}
+
+int PMPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank) {
+  capi_ret r;
+  int rc = capi_call("cart_rank", &r, "(iK)", (int)comm, PTR(coords));
+  if (rc == MPI_SUCCESS && r.n >= 1) *rank = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]) {
+  return capi_call("cart_coords", NULL, "(iiiK)", (int)comm, rank, maxdims,
+                   PTR(coords));
+}
+
+int PMPI_Cart_shift(MPI_Comm comm, int direction, int disp, int *rank_source,
+                    int *rank_dest) {
+  capi_ret r;
+  int rc = capi_call("cart_shift", &r, "(iii)", (int)comm, direction, disp);
+  if (rc == MPI_SUCCESS && r.n >= 2) {
+    *rank_source = (int)r.v[0];
+    *rank_dest = (int)r.v[1];
+  }
+  return rc;
+}
+
 /* ---- MPI_T tool interface ------------------------------------------ */
 
 int PMPI_T_init_thread(int required, int *provided) {
@@ -715,7 +766,8 @@ int PMPI_T_cvar_get_num(int *num_cvar) {
 int PMPI_T_cvar_get_name(int cvar_index, char *name, int *name_len) {
   /* MPI_T length-query idiom: name==NULL or *name_len<=0 asks only for
    * the required length — never write the caller's buffer then. */
-  char local[MPI_MAX_OBJECT_NAME];
+  char local[256]; /* > MPI_MAX_OBJECT_NAME: length query stays honest
+                      * for long names */
   int len = 0;
   int rc = capi_call_str("t_cvar_get_name", local, (int)sizeof(local), &len,
                          "(i)", cvar_index);
@@ -1332,6 +1384,14 @@ TPUMPI_WEAK(int, Group_compare, (MPI_Group, MPI_Group, int *))
 TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
+TPUMPI_WEAK(int, Dims_create, (int, int, int[]))
+TPUMPI_WEAK(int, Cart_create,
+            (MPI_Comm, int, const int[], const int[], int, MPI_Comm *))
+TPUMPI_WEAK(int, Cartdim_get, (MPI_Comm, int *))
+TPUMPI_WEAK(int, Cart_get, (MPI_Comm, int, int[], int[], int[]))
+TPUMPI_WEAK(int, Cart_rank, (MPI_Comm, const int[], int *))
+TPUMPI_WEAK(int, Cart_coords, (MPI_Comm, int, int, int[]))
+TPUMPI_WEAK(int, Cart_shift, (MPI_Comm, int, int, int *, int *))
 TPUMPI_WEAK(int, T_init_thread, (int, int *))
 TPUMPI_WEAK(int, T_finalize, (void))
 TPUMPI_WEAK(int, T_cvar_get_num, (int *))

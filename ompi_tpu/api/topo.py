@@ -68,6 +68,43 @@ def dims_create(nnodes: int, ndims: int, dims: Sequence[int] | None = None) -> l
     return out
 
 
+def cart_rank_of(dims: Sequence[int], periods: Sequence[int],
+                 coords: Sequence[int]) -> int:
+    """Row-major rank of ``coords`` (periodic wrap per dim); raises for
+    out-of-range coordinates on non-periodic dims.  Shared by CartComm
+    and the C-ABI bridge so the algebra cannot drift."""
+    if len(coords) != len(dims):
+        raise MPIArgError("coords length != ndims")
+    rank = 0
+    for c, d, per in zip(coords, dims, periods):
+        if per:
+            c = c % d
+        elif not 0 <= c < d:
+            raise MPIArgError(f"coordinate {c} out of [0,{d}) (non-periodic)")
+        rank = rank * d + c
+    return rank
+
+
+def cart_coords_of(dims: Sequence[int], rank: int) -> list[int]:
+    """Row-major coordinates of ``rank``; validates the range."""
+    import math
+
+    n = math.prod(dims)
+    if not 0 <= rank < n:
+        raise MPIArgError(f"rank {rank} out of range [0, {n})")
+    coords = []
+    for d in reversed(dims):
+        coords.append(rank % d)
+        rank //= d
+    return coords[::-1]
+
+
+def validate_dims(dims: Sequence[int]) -> None:
+    for d in dims:
+        if d < 1:
+            raise MPIDimsError(f"non-positive cartesian dim {d}")
+
+
 class CartComm(Comm):
     """Cartesian communicator (MPI_Cart_create result)."""
 
@@ -99,25 +136,10 @@ class CartComm(Comm):
     # -- coordinate algebra (MPI_Cart_rank / Cart_coords) ----------------
 
     def cart_rank(self, coords: Sequence[int]) -> int:
-        if len(coords) != self.ndims:
-            raise MPIArgError("coords length != ndims")
-        rank = 0
-        for c, d, per in zip(coords, self.dims, self.periods):
-            if per:
-                c = c % d
-            elif not 0 <= c < d:
-                raise MPIArgError(f"coordinate {c} out of [0,{d}) (non-periodic)")
-            rank = rank * d + c
-        return rank
+        return cart_rank_of(self.dims, self.periods, coords)
 
     def cart_coords(self, rank: int) -> list[int]:
-        if not 0 <= rank < self.size:
-            raise MPIArgError(f"rank {rank} out of range")
-        coords = []
-        for d in reversed(self.dims):
-            coords.append(rank % d)
-            rank //= d
-        return coords[::-1]
+        return cart_coords_of(self.dims, rank)
 
     def cart_shift(self, direction: int, disp: int, rank: int) -> tuple[int, int]:
         """MPI_Cart_shift at ``rank``: returns (source, dest); PROC_NULL

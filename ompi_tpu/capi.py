@@ -277,6 +277,8 @@ def comm_free(h: int) -> int:
         if h > 2:  # WORLD/SELF are persistent
             _comm(h).free()
             _comms.pop(h, None)
+            _carts.pop(h, None)
+            _errhandlers.pop(h, None)
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e)
@@ -1785,3 +1787,134 @@ def t_pvar_stop() -> int:
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e)
+
+
+# -- cartesian topology (MPI_Cart_* / MPI_Dims_create) --------------------
+
+_carts: dict[int, tuple[list[int], list[int]]] = {}  # comm handle → geometry
+
+
+def dims_create(nnodes: int, ndims: int, dims_ptr: int) -> int:
+    try:
+        from ompi_tpu.api.topo import dims_create as _dc
+
+        view = _view(dims_ptr, ndims, 7)
+        out = _dc(nnodes, ndims, [int(v) for v in view])
+        view[:] = out
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def cart_create(h: int, ndims: int, dims_ptr: int, periods_ptr: int,
+                reorder: int):
+    """MPI_Cart_create: geometry over the first prod(dims) ranks (ranks
+    beyond get MPI_COMM_NULL) — rides the collective comm_split."""
+    try:
+        import math
+
+        from ompi_tpu.api.topo import validate_dims
+
+        c = _comm(h)
+        dims = [int(v) for v in _view(dims_ptr, ndims, 7)]
+        periods = [int(v) for v in _view(periods_ptr, ndims, 7)]
+        validate_dims(dims)
+        del reorder  # rank order already ICI-contiguous (topo reorder
+        # is the accelerator component's device-order job)
+        nnodes = math.prod(dims)
+        if nnodes > getattr(c, "size", 1):
+            raise err.MPIDimsError(
+                f"cartesian grid {dims} needs {nnodes} ranks; comm has "
+                f"{c.size}"
+            )
+        me = comm_rank(h)[1]
+        color = 0 if me < nnodes else -32766
+        rc, ch = comm_split(h, color, 0)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        if ch:
+            _carts[ch] = (dims, periods)
+        return (MPI_SUCCESS, ch)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def _cart_geom(h: int):
+    _comm(h)  # liveness: freed comms lose their topology too
+    g = _carts.get(h)
+    if g is None:
+        raise err.MPITopologyError(f"comm {h} has no cartesian topology")
+    return g
+
+
+def cartdim_get(h: int):
+    try:
+        return (MPI_SUCCESS, len(_cart_geom(h)[0]))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def cart_get(h: int, maxdims: int, dims_ptr: int, periods_ptr: int,
+             coords_ptr: int) -> int:
+    try:
+        dims, periods = _cart_geom(h)
+        nd = min(maxdims, len(dims))
+        _view(dims_ptr, nd, 7)[:] = dims[:nd]
+        _view(periods_ptr, nd, 7)[:] = periods[:nd]
+        me = comm_rank(h)[1]
+        _view(coords_ptr, nd, 7)[:] = _coords_of(dims, me)[:nd]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def _coords_of(dims: list[int], rank: int) -> list[int]:
+    from ompi_tpu.api.topo import cart_coords_of
+
+    return cart_coords_of(dims, rank)
+
+
+def _rank_of(dims: list[int], periods: list[int], coords: list[int]) -> int:
+    from ompi_tpu.api.topo import cart_rank_of
+
+    return cart_rank_of(dims, periods, coords)
+
+
+def cart_rank(h: int, coords_ptr: int):
+    try:
+        dims, periods = _cart_geom(h)
+        coords = [int(v) for v in _view(coords_ptr, len(dims), 7)]
+        return (MPI_SUCCESS, _rank_of(dims, periods, coords))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def cart_coords(h: int, rank: int, maxdims: int, coords_ptr: int) -> int:
+    try:
+        dims, _ = _cart_geom(h)
+        nd = min(maxdims, len(dims))
+        _view(coords_ptr, nd, 7)[:] = _coords_of(dims, rank)[:nd]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def cart_shift(h: int, direction: int, disp: int):
+    """(rank_source, rank_dest); MPI_PROC_NULL (-2) off non-periodic
+    edges."""
+    try:
+        dims, periods = _cart_geom(h)
+        me = comm_rank(h)[1]
+        coords = _coords_of(dims, me)
+
+        def shifted(sign: int) -> int:
+            c2 = list(coords)
+            c2[direction] += sign * disp
+            try:
+                return _rank_of(dims, periods, c2)
+            except err.MPIArgError:
+                return -2  # MPI_PROC_NULL
+
+        return (MPI_SUCCESS, shifted(-1), shifted(+1))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), -2, -2)
