@@ -42,6 +42,27 @@ def _times(fn, warmup: int, iters: int) -> list[float]:
     return out
 
 
+def _times_paired(fa, fb, warmup: int, iters: int):
+    """Interleaved timing of two callables: alternating samples within
+    one window cancels the tunnel-latency drift that separate loops
+    (seconds apart) would bake into their ratio."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fa())
+        jax.block_until_ready(fb())
+    ta, tb = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fa())
+        t1 = time.perf_counter()
+        jax.block_until_ready(fb())
+        t2 = time.perf_counter()
+        ta.append(t1 - t0)
+        tb.append(t2 - t1)
+    return ta, tb
+
+
 def _iters_for(nbytes: int, iters: int) -> tuple[int, int]:
     """(warmup, iters) — fewer reps for giant buffers (wall-clock),
     MORE for tiny ones: per-call time there is tunnel-latency noise
@@ -137,8 +158,10 @@ def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
                 (n, count), dtype=np.float32)
         )
         w, it = _iters_for(nbytes, iters)
-        t_fw = _times(lambda: world.allreduce(x, SUM), w, it)
-        t_raw = _times(lambda: raw["allreduce"](x), w, it)
+        t_fw, t_raw = _times_paired(
+            lambda: world.allreduce(x, SUM), lambda: raw["allreduce"](x),
+            w, it,
+        )
         rows.append(_row(nbytes, n, t_fw, t_raw))
         del x
     geomean = _geomean([r["ratio"] for r in rows])
@@ -168,8 +191,7 @@ def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
                 "alltoall": lambda: world.alltoall(x),
             }[name]
             w, it = _iters_for(nb, iters)
-            t_fw = _times(fw, w, it)
-            t_raw = _times(lambda: raw[name](x), w, it)
+            t_fw, t_raw = _times_paired(fw, lambda: raw[name](x), w, it)
             out.append(_row(nb, n, t_fw, t_raw, coll=name))
             del x
         colls[name] = out
