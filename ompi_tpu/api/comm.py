@@ -109,6 +109,8 @@ class Comm(PersistentP2PMixin):
         #: fast-path dispatch cache: (slot, op, shape, dtype, …) →
         #: (mca context, store version, compiled callable)
         self._fast: dict[tuple, tuple] = {}
+        #: last sharding object accepted by _stage (identity fast path)
+        self._ok_sharding = None
 
     # -- basics --------------------------------------------------------
 
@@ -324,8 +326,15 @@ class Comm(PersistentP2PMixin):
             # An array committed to devices outside this comm's mesh
             # (e.g. a gather result living on root) must be resharded or
             # jit rejects it; mesh-resident arrays pass through untouched.
-            if x.sharding.device_set != self.mesh.device_set:
-                x = jax.device_put(x, self.mesh.rank_sharding())
+            # jax interns sharding objects per (mesh, spec): an identity
+            # hit on the last-accepted sharding skips the set compare
+            # on the hot loop
+            sh = x.sharding
+            if sh is not self._ok_sharding:
+                if sh.device_set != self.mesh.device_set:
+                    x = jax.device_put(x, self.mesh.rank_sharding())
+                else:
+                    self._ok_sharding = sh
             return x, False
         arr = np.asarray(x)
         if arr.ndim < depth_expected or arr.shape[0] != self.size:
@@ -378,7 +387,8 @@ class Comm(PersistentP2PMixin):
         ctx = mca._default
         ent = self._fast.get(key)
         if ent is not None and ent[0] is ctx and ent[1] == ctx.store.version:
-            spc.inc(slot)
+            if spc._attached:  # inlined flag test: this IS the hot loop
+                spc.inc(slot)
             return ent[2]
         if ctx is None:
             return None
