@@ -568,6 +568,33 @@ int main(int argc, char **argv) {
     MPI_Comm_free(&cart);
   }
 
+  /* graph topology: a ring expressed as a graph */
+  {
+    int *gidx = (int *)malloc(sizeof(int) * size);
+    int *gedg = (int *)malloc(sizeof(int) * 2 * size);
+    for (int r2 = 0; r2 < size; r2++) {
+      gidx[r2] = 2 * (r2 + 1);
+      gedg[2 * r2] = (r2 + size - 1) % size;
+      gedg[2 * r2 + 1] = (r2 + 1) % size;
+    }
+    MPI_Comm gc;
+    MPI_Graph_create(MPI_COMM_WORLD, size, gidx, gedg, 0, &gc);
+    CHECK(gc != MPI_COMM_NULL, "graph_create");
+    int gn = 0, ge = 0;
+    MPI_Graphdims_get(gc, &gn, &ge);
+    CHECK(gn == size && ge == 2 * size, "graphdims_get");
+    int nn = 0;
+    MPI_Graph_neighbors_count(gc, rank, &nn);
+    CHECK(nn == 2, "graph_neighbors_count");
+    int nb2[2] = {-1, -1};
+    MPI_Graph_neighbors(gc, rank, 2, nb2);
+    CHECK(nb2[0] == (rank + size - 1) % size && nb2[1] == (rank + 1) % size,
+          "graph_neighbors");
+    MPI_Comm_free(&gc);
+    free(gidx);
+    free(gedg);
+  }
+
   /* MPI_T: enumerate cvars, read one by name, tick a pvar */
   {
     int prov = -1;

@@ -276,6 +276,15 @@ TPUMPI_PROTO(int, Cart_coords,
 TPUMPI_PROTO(int, Cart_shift, (MPI_Comm comm, int direction, int disp,
                                int *rank_source, int *rank_dest))
 
+TPUMPI_PROTO(int, Graph_create,
+             (MPI_Comm comm, int nnodes, const int index[], const int edges[],
+              int reorder, MPI_Comm *comm_graph))
+TPUMPI_PROTO(int, Graphdims_get, (MPI_Comm comm, int *nnodes, int *nedges))
+TPUMPI_PROTO(int, Graph_neighbors_count,
+             (MPI_Comm comm, int rank, int *nneighbors))
+TPUMPI_PROTO(int, Graph_neighbors,
+             (MPI_Comm comm, int rank, int maxneighbors, int neighbors[]))
+
 /* MPI_T tool interface (int-flavored subset: the cvar/pvar
  * enumeration + read surface tools actually script against) */
 typedef int MPI_T_pvar_session;

@@ -744,6 +744,39 @@ int PMPI_Cart_shift(MPI_Comm comm, int direction, int disp, int *rank_source,
   return rc;
 }
 
+int PMPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
+                      const int edges[], int reorder,
+                      MPI_Comm *comm_graph) {
+  capi_ret r;
+  int rc = capi_call("graph_create", &r, "(iiKKi)", (int)comm, nnodes,
+                     PTR(index), PTR(edges), reorder);
+  if (rc == MPI_SUCCESS && r.n >= 1) *comm_graph = (MPI_Comm)r.v[0];
+  return rc;
+}
+
+int PMPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges) {
+  capi_ret r;
+  int rc = capi_call("graphdims_get", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 2) {
+    *nnodes = (int)r.v[0];
+    *nedges = (int)r.v[1];
+  }
+  return rc;
+}
+
+int PMPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors) {
+  capi_ret r;
+  int rc = capi_call("graph_neighbors_count", &r, "(ii)", (int)comm, rank);
+  if (rc == MPI_SUCCESS && r.n >= 1) *nneighbors = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                         int neighbors[]) {
+  return capi_call("graph_neighbors", NULL, "(iiiK)", (int)comm, rank,
+                   maxneighbors, PTR(neighbors));
+}
+
 /* ---- MPI_T tool interface ------------------------------------------ */
 
 int PMPI_T_init_thread(int required, int *provided) {
@@ -1385,6 +1418,11 @@ TPUMPI_WEAK(int, Comm_create, (MPI_Comm, MPI_Group, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_create_group, (MPI_Comm, MPI_Group, int, MPI_Comm *))
 TPUMPI_WEAK(int, Comm_compare, (MPI_Comm, MPI_Comm, int *))
 TPUMPI_WEAK(int, Dims_create, (int, int, int[]))
+TPUMPI_WEAK(int, Graph_create,
+            (MPI_Comm, int, const int[], const int[], int, MPI_Comm *))
+TPUMPI_WEAK(int, Graphdims_get, (MPI_Comm, int *, int *))
+TPUMPI_WEAK(int, Graph_neighbors_count, (MPI_Comm, int, int *))
+TPUMPI_WEAK(int, Graph_neighbors, (MPI_Comm, int, int, int[]))
 TPUMPI_WEAK(int, Cart_create,
             (MPI_Comm, int, const int[], const int[], int, MPI_Comm *))
 TPUMPI_WEAK(int, Cartdim_get, (MPI_Comm, int *))

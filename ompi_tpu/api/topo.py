@@ -193,6 +193,35 @@ class CartComm(Comm):
         return out
 
 
+def graph_neighbors_of(index: Sequence[int], edges: Sequence[int],
+                       rank: int) -> list[int]:
+    """Neighbors of ``rank`` in the (index, edges) CSR graph — shared
+    by GraphComm and the C-ABI bridge."""
+    if not 0 <= rank < len(index):
+        raise MPIArgError(f"rank {rank} out of graph range")
+    lo = index[rank - 1] if rank else 0
+    return list(edges[lo : index[rank]])
+
+
+def validate_graph(index: Sequence[int], edges: Sequence[int]) -> None:
+    """MPI_Graph_create argument checks: monotone non-negative index,
+    edge targets inside the node set."""
+    prev = 0
+    for i in index:
+        if i < prev:
+            raise MPIArgError(
+                f"graph index must be non-decreasing and >= 0; got {list(index)}"
+            )
+        prev = i
+    if index and index[-1] != len(edges):
+        raise MPIArgError(
+            f"graph index[-1] ({index[-1]}) != edge count ({len(edges)})"
+        )
+    for e in edges:
+        if not 0 <= e < len(index):
+            raise MPITopologyError(f"edge target {e} out of range")
+
+
 class GraphComm(Comm):
     """Graph topology communicator (MPI_Graph_create)."""
 
@@ -206,10 +235,7 @@ class GraphComm(Comm):
         self.edges = tuple(edges)
 
     def graph_neighbors(self, rank: int) -> list[int]:
-        if not 0 <= rank < len(self.index):
-            raise MPIArgError("rank out of range")
-        lo = self.index[rank - 1] if rank else 0
-        return list(self.edges[lo : self.index[rank]])
+        return graph_neighbors_of(self.index, self.edges, rank)
 
     def graph_neighbors_count(self, rank: int) -> int:
         return len(self.graph_neighbors(rank))

@@ -278,6 +278,7 @@ def comm_free(h: int) -> int:
             _comm(h).free()
             _comms.pop(h, None)
             _carts.pop(h, None)
+            _graphs.pop(h, None)
             _errhandlers.pop(h, None)
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
@@ -1827,9 +1828,7 @@ def cart_create(h: int, ndims: int, dims_ptr: int, periods_ptr: int,
                 f"cartesian grid {dims} needs {nnodes} ranks; comm has "
                 f"{c.size}"
             )
-        me = comm_rank(h)[1]
-        color = 0 if me < nnodes else -32766
-        rc, ch = comm_split(h, color, 0)
+        rc, ch = _split_prefix(h, nnodes)
         if rc != MPI_SUCCESS:
             return (rc, 0)
         if ch:
@@ -1837,6 +1836,20 @@ def cart_create(h: int, ndims: int, dims_ptr: int, periods_ptr: int,
         return (MPI_SUCCESS, ch)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), 0)
+
+
+def _split_prefix(h: int, nnodes: int):
+    """Collective split keeping the first ``nnodes`` ranks (others get
+    MPI_COMM_NULL) — correct in BOTH models: the single-controller
+    split takes per-rank colors; the distributed one this process's."""
+    c = _comm(h)
+    if _is_single_controller(c):
+        n = c.size
+        colors = [0] * nnodes + [-32766] * (n - nnodes)
+        sub = c.split(colors, [0] * n)[0] if nnodes else None
+        return (MPI_SUCCESS, _store_comm(sub, h) if sub is not None else 0)
+    me = comm_rank(h)[1]
+    return comm_split(h, 0 if me < nnodes else -32766, 0)
 
 
 def _cart_geom(h: int):
@@ -1851,7 +1864,7 @@ def cartdim_get(h: int):
     try:
         return (MPI_SUCCESS, len(_cart_geom(h)[0]))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_fail(e, h), 0)
 
 
 def cart_get(h: int, maxdims: int, dims_ptr: int, periods_ptr: int,
@@ -1918,3 +1931,76 @@ def cart_shift(h: int, direction: int, disp: int):
         return (MPI_SUCCESS, shifted(-1), shifted(+1))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), -2, -2)
+
+
+# -- graph topology (MPI_Graph_*) ----------------------------------------
+
+_graphs: dict[int, tuple[list[int], list[int]]] = {}  # handle → (index, edges)
+
+
+def graph_create(h: int, nnodes: int, index_ptr: int, edges_ptr: int,
+                 reorder: int):
+    """MPI_Graph_create over the collective comm_split (ranks beyond
+    nnodes get MPI_COMM_NULL)."""
+    try:
+        c = _comm(h)
+        from ompi_tpu.api.topo import validate_graph
+
+        index = [int(v) for v in _view(index_ptr, nnodes, 7)]
+        nedges = index[-1] if index else 0
+        if nedges < 0:
+            raise err.MPIArgError(f"negative edge count from index {index}")
+        edges = [int(v) for v in _view(edges_ptr, nedges, 7)]
+        del reorder
+        if nnodes > getattr(c, "size", 1):
+            raise err.MPITopologyError(
+                f"graph of {nnodes} nodes larger than comm ({c.size})"
+            )
+        validate_graph(index, edges)
+        rc, ch = _split_prefix(h, nnodes)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        if ch:
+            _graphs[ch] = (index, edges)
+        return (MPI_SUCCESS, ch)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def _graph_geom(h: int):
+    _comm(h)  # liveness
+    g = _graphs.get(h)
+    if g is None:
+        raise err.MPITopologyError(f"comm {h} has no graph topology")
+    return g
+
+
+def graphdims_get(h: int):
+    try:
+        index, edges = _graph_geom(h)
+        return (MPI_SUCCESS, len(index), len(edges))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0, 0)
+
+
+def graph_neighbors_count(h: int, rank: int):
+    try:
+        from ompi_tpu.api.topo import graph_neighbors_of
+
+        index, edges = _graph_geom(h)
+        return (MPI_SUCCESS, len(graph_neighbors_of(index, edges, rank)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def graph_neighbors(h: int, rank: int, maxn: int, out_ptr: int) -> int:
+    try:
+        from ompi_tpu.api.topo import graph_neighbors_of
+
+        index, edges = _graph_geom(h)
+        ns = graph_neighbors_of(index, edges, rank)[:maxn]
+        if ns:
+            _view(out_ptr, len(ns), 7)[:] = ns
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
