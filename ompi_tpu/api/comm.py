@@ -51,6 +51,11 @@ from .group import Group, UNDEFINED
 #: rebuild a Datatype per call — VERDICT r1 weak #1).
 _OP_CHECK_OK: set[tuple] = set()
 
+#: concrete runtime types known to be jax device arrays — a set lookup
+#: on type() is ~6× cheaper than isinstance() against the jax.Array ABC
+#: on the per-call hot path (SURVEY.md §3.3 zero-setup loop)
+_JAX_ARRAY_TYPES: set[type] = set()
+
 #: MPI_Comm_split color for "give me no communicator"
 COLOR_UNDEFINED = UNDEFINED
 
@@ -109,6 +114,11 @@ class Comm(PersistentP2PMixin):
         #: fast-path dispatch cache: (slot, op, shape, dtype, …) →
         #: (mca context, store version, compiled callable)
         self._fast: dict[tuple, tuple] = {}
+        #: per-slot last-signature identity cache in FRONT of _fast:
+        #: (op, shape, dtype, ctx, version, fn).  Hits when the caller
+        #: reuses the same buffer signature (training loops do), with
+        #: pure `is` compares — no tuple hash on the hot loop.
+        self._hot: dict[str, tuple] = {}
         #: last sharding object accepted by _stage (identity fast path)
         self._ok_sharding = None
 
@@ -316,13 +326,19 @@ class Comm(PersistentP2PMixin):
                 m.disable()
         self._coll = None
         self._fast.clear()
+        self._hot.clear()  # freed comms must not serve the hot path
         self._freed = True
 
     # -- buffer staging -------------------------------------------------
 
     def _stage(self, x, depth_expected: int):
         """Normalize a rank-major input; returns (device_array, was_host)."""
-        if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+        is_dev = type(x) in _JAX_ARRAY_TYPES
+        if not is_dev and isinstance(x, jax.Array) \
+                and not isinstance(x, np.ndarray):
+            _JAX_ARRAY_TYPES.add(type(x))  # learn the concrete type once
+            is_dev = True
+        if is_dev:
             # An array committed to devices outside this comm's mesh
             # (e.g. a gather result living on root) must be resharded or
             # jit rejects it; mesh-resident arrays pass through untouched.
@@ -385,11 +401,14 @@ class Comm(PersistentP2PMixin):
         (key carries the flag; store-version invalidation picks up
         --mca accelerator_tpu_donate_staged changes)."""
         ctx = mca._default
-        ent = self._fast.get(key)
-        if ent is not None and ent[0] is ctx and ent[1] == ctx.store.version:
-            if spc._attached:  # inlined flag test: this IS the hot loop
-                spc.inc(slot)
-            return ent[2]
+        try:
+            ent = self._fast[key]
+            if ent[0] is ctx and ent[1] == ctx.store.version:
+                if spc._attached:  # inlined flag test: this IS the hot loop
+                    spc.inc(slot)
+                return ent[2]
+        except KeyError:
+            pass
         if ctx is None:
             return None
         resolve = getattr(self.coll.owners.get(slot), "resolve", None)
@@ -441,19 +460,56 @@ class Comm(PersistentP2PMixin):
                else self.coll.lookup(slot)(*args))
         return _wrap_unstage(req, self, host)
 
+    def _coll_call(self, slot: str, x, depth: int, op: Op | None = None,
+              root: int | None = None):
+        """Common path for the five hot collectives: a per-slot
+        last-signature cache in FRONT of the keyed _fast cache.  On a
+        hot hit (same op identity / root / shape / dtype as the last
+        call on a mesh-resident buffer) the compiled callable is
+        returned without tuple hashing or arg checks — those are pure
+        functions of the signature and already passed once
+        (SURVEY.md §3.3 zero-setup hot loop).  The key is built ONCE
+        here, so _dispatch and the hot store can never diverge."""
+        if (
+            self._ft is None
+            and type(x) in _JAX_ARRAY_TYPES
+            and x.sharding is self._ok_sharding
+        ):
+            c = self._hot.get(slot)
+            if (
+                c is not None
+                and c[0] is op and c[1] == root
+                and c[2] == x.shape and c[3] == x.dtype
+                and c[4] is mca._default and c[5] == c[4].store.version
+            ):
+                if spc._attached:
+                    spc.inc(slot)
+                return c[6](x)
+        if op is not None:
+            self._check_op(op, x)
+        if root is not None:
+            self._check_root(root)
+        xd, host = self._stage(x, depth)
+        key = (slot, op, root, xd.shape, xd.dtype)
+        args = (xd,) + ((op,) if op is not None else ()) \
+            + ((root,) if root is not None else ())
+        out = self._dispatch(slot, key, args, host)
+        if not host:
+            ent = self._fast.get(key + (False,))
+            if ent is not None:
+                self._hot[slot] = (op, root, xd.shape, xd.dtype,
+                                   ent[0], ent[1], ent[2])
+        return out
+
     def allreduce(self, x, op: Op = SUM):
-        self._check_op(op, x)
-        xd, host = self._stage(x, 1)
-        return self._dispatch(
-            "allreduce", ("allreduce", op, xd.shape, xd.dtype), (xd, op), host
-        )
+        return self._coll_call("allreduce", x, 1, op=op)
 
     def iallreduce(self, x, op: Op = SUM) -> Request:
         self._check_op(op, x)
         xd, host = self._stage(x, 1)
         return self._dispatch_i(
             "iallreduce", "allreduce",
-            ("allreduce", op, xd.shape, xd.dtype), (xd, op), host,
+            ("allreduce", op, None, xd.shape, xd.dtype), (xd, op), host,
         )
 
     def allreduce_init(self, x, op: Op = SUM) -> Request:
@@ -461,17 +517,13 @@ class Comm(PersistentP2PMixin):
         return self._lookup("allreduce_init")(xd, op)
 
     def bcast(self, x, root: int = 0):
-        self._check_root(root)
-        xd, host = self._stage(x, 1)
-        return self._dispatch(
-            "bcast", ("bcast", xd.shape, xd.dtype, root), (xd, root), host
-        )
+        return self._coll_call("bcast", x, 1, root=root)
 
     def ibcast(self, x, root: int = 0) -> Request:
         self._check_root(root)
         xd, host = self._stage(x, 1)
         return self._dispatch_i(
-            "ibcast", "bcast", ("bcast", xd.shape, xd.dtype, root),
+            "ibcast", "bcast", ("bcast", None, root, xd.shape, xd.dtype),
             (xd, root), host,
         )
 
@@ -488,16 +540,13 @@ class Comm(PersistentP2PMixin):
         return out[root] if hasattr(out, "__getitem__") else out
 
     def allgather(self, x):
-        xd, host = self._stage(x, 1)
-        return self._dispatch(
-            "allgather", ("allgather", xd.shape, xd.dtype), (xd,), host
-        )
+        return self._coll_call("allgather", x, 1)
 
     def iallgather(self, x) -> Request:
         xd, host = self._stage(x, 1)
         return self._dispatch_i(
-            "iallgather", "allgather", ("allgather", xd.shape, xd.dtype),
-            (xd,), host,
+            "iallgather", "allgather",
+            ("allgather", None, None, xd.shape, xd.dtype), (xd,), host,
         )
 
     def gather(self, x, root: int = 0):
@@ -519,12 +568,7 @@ class Comm(PersistentP2PMixin):
         )
 
     def reduce_scatter_block(self, x, op: Op = SUM):
-        self._check_op(op, x)
-        xd, host = self._stage(x, 2)
-        return self._dispatch(
-            "reduce_scatter_block",
-            ("reduce_scatter_block", op, xd.shape, xd.dtype), (xd, op), host,
-        )
+        return self._coll_call("reduce_scatter_block", x, 2, op=op)
 
     def reduce_scatter(self, x, op: Op = SUM, counts: Sequence[int] | None = None):
         """MPI_Reduce_scatter. ``counts`` per-rank receive counts:
@@ -554,16 +598,13 @@ class Comm(PersistentP2PMixin):
         return self._unstage(self._lookup("reduce_scatter")(xd, op, None), host)
 
     def alltoall(self, x):
-        xd, host = self._stage(x, 2)
-        return self._dispatch(
-            "alltoall", ("alltoall", xd.shape, xd.dtype), (xd,), host
-        )
+        return self._coll_call("alltoall", x, 2)
 
     def ialltoall(self, x) -> Request:
         xd, host = self._stage(x, 2)
         return self._dispatch_i(
-            "ialltoall", "alltoall", ("alltoall", xd.shape, xd.dtype),
-            (xd,), host,
+            "ialltoall", "alltoall",
+            ("alltoall", None, None, xd.shape, xd.dtype), (xd,), host,
         )
 
     def scan(self, x, op: Op = SUM):

@@ -34,7 +34,8 @@ def test_fast_path_caches_and_reuses(world):
     out1 = world.allreduce(x, SUM)
     # host-staged signature (trailing True = framework-owned buffer →
     # arena donation variant)
-    assert ("allreduce", SUM, (N, 16), np.dtype(np.float32), True) in world._fast
+    assert ("allreduce", SUM, None, (N, 16), np.dtype(np.float32), True) \
+        in world._fast
     out2 = world.allreduce(x, SUM)
     np.testing.assert_allclose(out1, out2)
 
@@ -153,3 +154,39 @@ def test_fast_path_respects_forced_decision_layer(world):
     xp = (rank_data((4,), np.float64, seed=2) * 0 + 1.25).astype(np.float64)
     outp = np.asarray(world.allreduce(xp, PROD))
     np.testing.assert_allclose(outp[0], xp.prod(0))
+
+
+def test_hot_signature_cache_device_path(world):
+    """The per-slot last-signature identity cache (in front of _fast):
+    repeated same-signature device-path calls hit it, an op change
+    re-resolves instead of serving the stale program, and a var change
+    invalidates it (store-version check)."""
+    import jax
+
+    x = world.mesh.stage_in(rank_data((6,), np.float64, seed=21))
+    out1 = np.asarray(world.allreduce(x, SUM))
+    assert "allreduce" in world._hot
+    out2 = np.asarray(world.allreduce(x, SUM))  # hot hit
+    np.testing.assert_array_equal(out1, out2)
+    # op switch must not serve the cached SUM program
+    out_max = np.asarray(world.allreduce(x, MAX))
+    np.testing.assert_array_equal(
+        out_max, np.broadcast_to(np.asarray(x).max(0), out_max.shape))
+    # var change bumps the store version → hot entry is stale → re-check
+    store = mca.default_context().store
+    store.set("coll_xla_reproducible", 1)
+    try:
+        ordered = np.asarray(world.allreduce(x, SUM))
+        np.testing.assert_array_equal(ordered[0], ordered_reduce_np(np.asarray(x), SUM))
+    finally:
+        store.set("coll_xla_reproducible", 0)
+    # freed comms must not serve the hot path
+    d = world.dup()
+    xd = d.mesh.stage_in(rank_data((3,), np.float32, seed=22))
+    d.allreduce(xd, SUM)
+    d.free()
+    import pytest as _pytest
+    from ompi_tpu.core.errors import MPICommError
+
+    with _pytest.raises(MPICommError):
+        d.allreduce(xd, SUM)

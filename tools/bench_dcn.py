@@ -1,0 +1,83 @@
+"""tpurun np=2 worker: DCN hot-path measurements (VERDICT r2 item 5).
+
+Measures the Python DCN transport costs the driver-visible bench was
+missing: p2p ping-pong latency/bandwidth over the loopback DCN (the
+``btl/tcp`` analog) and han hierarchical allreduce latency at np=2.
+Proc 0 prints one line ``DCNBENCH {json}``.
+"""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+assert world.nprocs == 2
+
+P2P_SIZES = [64, 65536, 1 << 20, 4 << 20]
+COLL_SIZES = [64, 65536, 1 << 20]
+
+
+def pingpong(nbytes: int, iters: int) -> float:
+    """Round-trip/2 latency in seconds (OSU osu_latency shape)."""
+    buf = np.zeros(nbytes, np.uint8)
+    me, peer = (0, world.size - 1) if p == 0 else (world.size - 1, 0)
+    # warmup
+    for _ in range(max(2, iters // 10)):
+        if p == 0:
+            world.send(buf, source=me, dest=peer, tag=9)
+            world.recv(dest=me, source=peer, tag=9)
+        else:
+            world.recv(dest=me, source=peer, tag=9)
+            world.send(buf, source=me, dest=peer, tag=9)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        if p == 0:
+            world.send(buf, source=me, dest=peer, tag=9)
+            world.recv(dest=me, source=peer, tag=9)
+        else:
+            world.recv(dest=me, source=peer, tag=9)
+            world.send(buf, source=me, dest=peer, tag=9)
+    dt = time.perf_counter() - t0
+    return dt / iters / 2.0
+
+
+def coll_lat(nbytes: int, iters: int) -> float:
+    x = np.ones((world.local_size, max(1, nbytes // 4)), np.float32)
+    for _ in range(max(2, iters // 10)):
+        world.allreduce(x, SUM)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        world.allreduce(x, SUM)
+    return (time.perf_counter() - t0) / iters
+
+
+rows = []
+for nb in P2P_SIZES:
+    iters = 200 if nb <= 65536 else 30
+    lat = pingpong(nb, iters)
+    rows.append({
+        "bytes": nb,
+        "p2p_us": round(lat * 1e6, 2),
+        "p2p_MBs": round(nb / lat / 1e6, 1) if lat > 0 else 0.0,
+    })
+
+crows = []
+for nb in COLL_SIZES:
+    iters = 50 if nb <= 65536 else 15
+    lat = coll_lat(nb, iters)
+    crows.append({"bytes": nb, "han_allreduce_us": round(lat * 1e6, 2)})
+
+if p == 0:
+    import json
+
+    print("DCNBENCH " + json.dumps({"p2p": rows, "han": crows}), flush=True)
+api.finalize()

@@ -1,0 +1,44 @@
+"""tpurun np=1 worker: Python-API allreduce latency on the same CPU
+backend the C-ABI osu_allreduce row runs on, so the two rows differ only
+by the shim marshalling cost (VERDICT r2 item 5's C-ABI overhead row).
+
+Prints one line ``PYAPI {json}`` (avg latency per size, OSU shape).
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+max_bytes = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+iters = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+
+rows = []
+count = 1
+while count * 4 <= max_bytes:
+    # match the C harness: per-call host buffers through the full
+    # stage-in → coll → stage-out path
+    sbuf = np.full((world.local_size, count), float(world.proc + 1), np.float32)
+    for _ in range(iters // 10 + 1):
+        world.allreduce(sbuf, SUM)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        world.allreduce(sbuf, SUM)
+    dt = (time.perf_counter() - t0) / iters
+    rows.append({"bytes": count * 4, "py_us": round(dt * 1e6, 2)})
+    count *= 4
+
+if world.proc == 0:
+    import json
+
+    print("PYAPI " + json.dumps(rows), flush=True)
+api.finalize()
