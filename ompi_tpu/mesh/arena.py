@@ -3,20 +3,35 @@
 ≈ ``opal/mca/mpool`` + ``opal/mca/rcache`` (SURVEY.md §2.3): the
 reference preallocates registered host memory so NIC DMA never pays
 per-call registration; the TPU analog is HBM staging for buffers that
-enter through the host (numpy) API.  Two mechanisms:
+enter through the host (numpy) API.
 
-* **staging accounting** — every H2D stage flows through the arena and
-  is counted (SPC counters ``arena_stage_in`` / ``arena_stage_bytes``,
-  surfaced as MPI_T pvars like every SPC), giving the rcache-style
-  visibility into staging traffic;
+**Why there is no literal "H2D into a pooled buffer" path.**  Under
+PJRT/IFRT a host→device transfer *always* materializes a new logical
+buffer — there is no public API to overwrite an existing device
+allocation with host bytes (and on the axon tunnel even
+``unsafe_buffer_pointer`` is unimplemented).  The mpool free-list
+therefore lives at three levels, all of which this class owns or
+accounts:
+
+* **runtime allocator recycling** — successive ``stage_in`` calls of
+  the same signature land on XLA's BFC free list, so steady-state
+  staging reuses the same HBM *addresses*.  Where the backend exposes
+  buffer pointers this is measured per signature
+  (``addr_reuse``/``addr_new``); on backends without pointer access
+  the counters report -1 (unobservable, not zero).
 * **buffer donation** — compiled collectives for shape-preserving ops
   are built with ``donate_argnums`` when their input is the
   framework-owned staged buffer, so XLA writes the result into the
   SAME HBM allocation: steady state is ONE buffer per in-flight
-  collective instead of two (mpool free-list reuse, expressed the XLA
-  way), halving per-call HBM footprint and allocator traffic — which
-  is what raises the largest benchable message size.  User-provided
-  jax arrays are NEVER donated (MPI semantics: sendbuf is preserved).
+  collective instead of two.  User jax arrays are NEVER donated
+  (MPI semantics: sendbuf is preserved).
+* **device-buffer free list** — ``acquire``/``release`` pool
+  framework-internal device temporaries (barrier tokens, schedule
+  scratch) keyed by (shape, dtype): after warm-up every acquisition
+  is a pool hit, no allocation, no H2D.  The zero-per-call-alloc path
+  for *user* payloads is the persistent-request family
+  (``allreduce_init`` …): buffer staged once, program compiled once,
+  each ``start()`` re-dispatches on the same allocation.
 
 Donation is controlled by ``--mca accelerator_tpu_donate_staged`` (the
 compiled-callable caches key on the var-store version, so toggling it
@@ -32,21 +47,78 @@ import numpy as np
 
 from ompi_tpu.tool import spc
 
+#: free-list depth per (shape, dtype) signature — temporaries are tiny
+#: (tokens/scratch); deeper lists would just pin HBM
+_POOL_CAP = 4
+
+#: per-signature cap on remembered addresses (bounds _addrs growth)
+_ADDR_CAP = 64
+
 
 class HbmArena:
-    """Per-mesh staging manager: counts H2D traffic and donation
+    """Per-mesh staging manager: free-lists device temporaries, counts
+    H2D traffic, allocator-level address reuse, and donation
     resolutions.  Cheap by construction — the per-call cost is one
     attribute test plus integer adds; everything signature-level
     (donation) is accounted at resolution time, not per call."""
 
-    __slots__ = ("stage_calls", "stage_bytes", "donate_signatures", "_lock")
+    __slots__ = (
+        "stage_calls", "stage_bytes", "donate_signatures",
+        "pool_hits", "pool_allocs", "addr_reuse", "addr_new",
+        "_lock", "_free", "_addrs", "_ptr_ok", "_addr_overflow",
+        "_addr_sample",
+    )
 
     def __init__(self):
         self.stage_calls = 0
         self.stage_bytes = 0
         #: call signatures resolved to a donating compiled program
         self.donate_signatures = 0
+        self.pool_hits = 0
+        self.pool_allocs = 0
+        self.addr_reuse = 0
+        self.addr_new = 0
         self._lock = threading.Lock()
+        #: (shape, dtype str) → free device buffers
+        self._free: dict[tuple, list] = {}
+        #: (shape, dtype str) → HBM addresses previously handed out
+        self._addrs: dict[tuple, set] = {}
+        #: backend exposes unsafe_buffer_pointer (axon tunnel: no)
+        self._ptr_ok = True
+        #: a signature overflowed _ADDR_CAP — reuse counts undercount
+        self._addr_overflow = False
+        #: stage_in calls seen by the address sampler
+        self._addr_sample = 0
+
+    # -- staging accounting --------------------------------------------
+
+    def _note_addr(self, d: jax.Array, key: tuple) -> None:
+        """Track whether the runtime allocator recycled an address we
+        have staged to before (the BFC free list acting as the mpool).
+        Pointer extraction costs tens of us, so stage_in SAMPLES it
+        (first 8 calls, then 1-in-8) — the counters are a recycling
+        indicator, not an exact census."""
+        try:
+            shards = d.addressable_shards
+            p = shards[0].data.unsafe_buffer_pointer() if shards \
+                else d.unsafe_buffer_pointer()
+        except Exception:
+            self._ptr_ok = False
+            return
+        with self._lock:
+            if len(self._addrs) > 512:  # unbounded-signature backstop
+                self._addrs.clear()
+            seen = self._addrs.setdefault(key, set())
+            if p in seen:
+                self.addr_reuse += 1
+            else:
+                if len(seen) < _ADDR_CAP:
+                    seen.add(p)
+                else:
+                    # can no longer distinguish recycled from fresh for
+                    # this signature — flag it instead of lying
+                    self._addr_overflow = True
+                self.addr_new += 1
 
     def stage_in(self, host_array: np.ndarray, sharding) -> jax.Array:
         with self._lock:
@@ -55,7 +127,12 @@ class HbmArena:
         if spc.attached():
             spc.inc("arena_stage_in")
             spc.inc("arena_stage_bytes", host_array.nbytes)
-        return jax.device_put(host_array, sharding)
+        d = jax.device_put(host_array, sharding)
+        if self._ptr_ok:
+            self._addr_sample += 1
+            if self._addr_sample <= 8 or (self._addr_sample & 7) == 0:
+                self._note_addr(d, (host_array.shape, host_array.dtype.str))
+        return d
 
     def note_donation(self) -> None:
         """A collective signature resolved to a donating program."""
@@ -64,10 +141,51 @@ class HbmArena:
         if spc.attached():
             spc.inc("arena_donations")
 
+    # -- device-temporary free list (mpool free list proper) -----------
+
+    def acquire(self, shape: tuple, dtype, sharding,
+                fill: float = 0) -> jax.Array:
+        """A pooled device buffer of the given signature: pool hit when
+        one is free, fresh allocation otherwise.  Contents are
+        unspecified on a hit (callers use these as tokens/scratch).
+        The sharding is part of the pool key — a replicated token is
+        never served where a rank-sharded one was asked for."""
+        key = (tuple(shape), np.dtype(dtype).str, sharding)
+        with self._lock:
+            lst = self._free.get(key)
+            while lst:
+                buf = lst.pop()
+                if not buf.is_deleted():
+                    self.pool_hits += 1
+                    return buf
+            self.pool_allocs += 1
+        if spc.attached():
+            spc.inc("arena_pool_alloc")
+        return jax.device_put(
+            np.full(shape, fill, np.dtype(dtype)), sharding)
+
+    def release(self, buf: jax.Array) -> None:
+        """Return a buffer to the free list (drops it when full or when
+        XLA already consumed it through donation)."""
+        if buf is None or buf.is_deleted():
+            return
+        key = (tuple(buf.shape), buf.dtype.str, buf.sharding)
+        with self._lock:
+            if len(self._free) > 256:  # unbounded-signature backstop:
+                self._free.clear()     # drop pooled HBM, keep counters
+            lst = self._free.setdefault(key, [])
+            if len(lst) < _POOL_CAP:
+                lst.append(buf)
+
     def stats(self) -> dict:
         with self._lock:
             return {
                 "stage_calls": self.stage_calls,
                 "stage_bytes": self.stage_bytes,
                 "donate_signatures": self.donate_signatures,
+                "pool_hits": self.pool_hits,
+                "pool_allocs": self.pool_allocs,
+                "addr_reuse": self.addr_reuse if self._ptr_ok else -1,
+                "addr_new": self.addr_new if self._ptr_ok else -1,
+                "addr_overflow": self._addr_overflow,
             }
