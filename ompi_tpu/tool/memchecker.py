@@ -1,0 +1,116 @@
+"""Memchecker-lite — API-level buffer-state validation.
+
+≈ ``opal/mca/memchecker/valgrind`` (SURVEY.md §5b): the reference marks
+user buffers defined/undefined across MPI calls under Valgrind so that
+races like *mutating a sendbuf owned by an in-flight nonblocking
+operation* surface as diagnostics instead of silent corruption.  The
+TPU-native analog guards host (numpy) buffers handed to asynchronous
+operations whose implementation reads them over a window of time — the
+DCN-level i-collectives and partitioned sends (single-controller
+i-collectives copy to HBM synchronously at issue, so there is no
+mutation window to guard):
+
+* **write-protect** — the buffer's ``writeable`` flag is cleared for
+  the in-flight window, so a mutation raises ``ValueError`` AT THE
+  MUTATION SITE (the valgrind-style early report).  Restored on
+  completion (only if the guard cleared it — a buffer the user already
+  made read-only stays read-only).
+* **checksum** — an adler32 snapshot at issue, re-verified at
+  completion: catches mutations that bypass the flag (a second view of
+  the same memory, ``writeable`` flipped back by the user) and raises
+  :class:`MPIBufferError` with the operation name.
+
+Opt-in like the reference (``--enable-memchecker``): enable with
+``--mca memchecker_base_enable 1`` /
+``OMPI_MCA_memchecker_base_enable=1`` or programmatically via
+:func:`attach`.  Off = literally zero work (one module-flag test per
+issue).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIInternalError
+
+
+class MPIBufferError(MPIInternalError):
+    """A buffer owned by an in-flight operation was mutated."""
+
+
+_attached = False
+
+
+def attach(flag: bool = True) -> None:
+    global _attached
+    _attached = flag
+
+
+def attached() -> bool:
+    return _attached
+
+
+def register_var(store) -> None:
+    store.register(
+        "memchecker", "base", "enable", False,
+        help="Guard host buffers owned by in-flight nonblocking "
+        "operations: write-protect for the in-flight window and "
+        "checksum-verify at completion (≈ --enable-memchecker)",
+    )
+
+
+def sync_from_store(store) -> None:
+    attach(bool(store.get("memchecker_base_enable", False)))
+
+
+def checksum(arr: np.ndarray) -> int:
+    """The snapshot checksum every guard uses (one definition, so the
+    i-collective and partitioned-send guards can never diverge)."""
+    return zlib.adler32(
+        np.ascontiguousarray(arr).view(np.uint8).reshape(-1))
+
+
+class Guard:
+    """One in-flight buffer guard; ``release()`` exactly once."""
+
+    __slots__ = ("buf", "opname", "checksum", "_cleared_flag")
+
+    def __init__(self, buf: np.ndarray, opname: str):
+        self.buf = buf
+        self.opname = opname
+        self.checksum = checksum(buf)
+        self._cleared_flag = False
+        if buf.flags.writeable:
+            try:
+                buf.flags.writeable = False
+                self._cleared_flag = True
+            except ValueError:
+                pass  # view of a non-owning base: checksum still guards
+
+    def abandon(self) -> None:
+        """Restore writeability without verifying (operation failed —
+        its own exception is the diagnostic)."""
+        if self._cleared_flag:
+            try:
+                self.buf.flags.writeable = True
+            except ValueError:
+                pass
+
+    def release(self) -> None:
+        self.abandon()
+        if checksum(self.buf) != self.checksum:
+            raise MPIBufferError(
+                f"buffer owned by in-flight {self.opname} was mutated "
+                f"before completion (MPI forbids touching a pending "
+                f"operation's buffer; enable-memchecker diagnostic)"
+            )
+
+
+def guard(buf, opname: str) -> Guard | None:
+    """Guard ``buf`` for an in-flight window; None when detached or the
+    buffer is not host memory."""
+    if not _attached or not isinstance(buf, np.ndarray):
+        return None
+    return Guard(buf, opname)

@@ -164,3 +164,102 @@ def test_vprotocol_off_without_path(world):
     assert not isinstance(d.pml, LoggedEngine)
     d.recv(1, 0)
     d.free()
+
+
+def test_spawn_pool_reuses_and_overflows():
+    """SpawnPool: sequential tasks reuse one warm worker; concurrent
+    blocked tasks overflow to fresh threads (liveness = thread-per-task)."""
+    import threading
+    import time
+
+    from ompi_tpu.core.threads import SpawnPool
+
+    pool = SpawnPool("test-pool", idle_ttl=5.0)
+    done = threading.Event()
+
+    def quick():
+        done.set()
+
+    for _ in range(20):
+        done.clear()
+        pool.submit(quick)
+        assert done.wait(5)
+        time.sleep(0.005)  # let the worker park again
+    s = pool.stats()
+    assert s["spawned"] <= 3, s
+    assert s["reused"] >= 17, s
+
+    # liveness: a blocked task must not park later submissions
+    gate = threading.Event()
+    started = threading.Event()
+    second = threading.Event()
+
+    def blocker():
+        started.set()
+        gate.wait(10)
+
+    pool.submit(blocker)
+    assert started.wait(5)
+    pool.submit(second.set)  # must run on a NEW thread, not queue
+    assert second.wait(5), "submission queued behind a blocked worker"
+    gate.set()
+
+
+def test_memchecker_guard_protects_and_checksums():
+    from ompi_tpu.tool import memchecker
+
+    memchecker.attach(True)
+    try:
+        buf = np.arange(8, dtype=np.float64)
+        g = memchecker.guard(buf, "iallreduce")
+        # write-protect: mutation raises at the mutation site
+        with pytest.raises(ValueError):
+            buf[0] = 99.0
+        g.release()  # clean completion restores writeability
+        buf[0] = 99.0  # writable again
+
+        # checksum path: mutate through a pre-existing view (bypasses
+        # the flag) → release() raises the diagnostic
+        base = np.arange(8, dtype=np.float64)
+        view = base[:]
+        g = memchecker.guard(base, "ibcast")
+        view[3] = -1.0
+        with pytest.raises(memchecker.MPIBufferError):
+            g.release()
+        # abandon() restores the flag without verifying
+        g2 = memchecker.guard(base, "ibcast")
+        view[4] = -2.0
+        g2.abandon()
+        assert base.flags.writeable
+    finally:
+        memchecker.attach(False)
+
+
+def test_memchecker_detached_is_noop():
+    from ompi_tpu.tool import memchecker
+
+    memchecker.attach(False)
+    buf = np.ones(4)
+    assert memchecker.guard(buf, "x") is None
+    buf[0] = 2.0  # untouched
+
+
+def test_memchecker_partitioned_pready_guard(world):
+    """A partition mutated AFTER its pready (but before the transfer
+    dispatches) raises instead of publishing torn bytes; filling before
+    pready stays legal."""
+    from ompi_tpu.tool import memchecker
+
+    memchecker.attach(True)
+    try:
+        buf = np.zeros((4, 3))
+        req = world.psend_init(buf, partitions=2, source=0, dest=1, tag=5)
+        req.start()
+        buf[0] = 1.0        # legal: partition 0 not yet ready
+        req.pready(0)
+        buf[2] = 2.0        # legal: partition 1 not yet ready
+        with pytest.raises(memchecker.MPIBufferError):
+            buf[1] = 9.0    # ILLEGAL: partition 0 already ready
+            req.pready(1)   # last pready verifies and raises
+    finally:
+        memchecker.attach(False)

@@ -487,3 +487,44 @@ def test_dcn_alltoall_and_allgather():
         assert [int(a[0]) for a in res_a2a[p]] == [p, 100 + p]
     for e in engines:
         e.close()
+
+
+def test_tpurun_asymptotics_reduce_scan():
+    """han reduce is a fan-in (root sends nothing; non-root sends one
+    partial row) and scan/exscan move one process-sum row instead of
+    allgathering the buffer — asserted via the transport byte meter
+    inside the worker, plus non-commutative-op bracketing checks."""
+    res = run_tpurun(2, REPO / "tests" / "workers" / "mp_asym_worker.py",
+                     cpu_devices=2)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("reduce_fanin", "reduce_root_last", "scan_prefix",
+                  "exscan_prefix", "scan_noncommutative", "finalize"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 2, f"{check}: {hits}\n{out}"
+
+
+def test_tpurun_thread_hygiene_soak():
+    """1000 i-collectives + rendezvous transfers with bounded thread
+    creation (SpawnPool reuse): the soak assertion lives in the worker."""
+    res = run_tpurun(2, REPO / "tests" / "workers" / "mp_threads_worker.py",
+                     cpu_devices=1, timeout=300)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("soak_sequential", "soak_burst", "soak_rndv", "finalize"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 2, f"{check}: {hits}\n{out}"
+
+
+def test_tpurun_memchecker_inflight_mutation():
+    """--mca memchecker_base_enable 1: mutating a buffer owned by an
+    in-flight i-collective raises (write-protect at the mutation site;
+    checksum at wait() for flag-bypassing views)."""
+    res = run_tpurun(2, REPO / "tests" / "workers" / "mp_memchk_worker.py",
+                     cpu_devices=1, mca={"memchecker_base_enable": "1"})
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("memchk_writeprotect", "memchk_checksum",
+                  "memchk_restored", "memchk_clean", "finalize"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 2, f"{check}: {hits}\n{out}"

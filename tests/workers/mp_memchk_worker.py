@@ -1,0 +1,67 @@
+"""tpurun worker: memchecker-lite catches mutation of a buffer owned
+by an in-flight i-collective (VERDICT r2 missing #7).
+
+Proc 0 issues an iallreduce that CANNOT complete until proc 1 joins
+(proc 1 waits for a p2p token sent after the issue) — a guaranteed
+in-flight window.  Mutating the buffer in that window must raise:
+directly (write-protect) or at wait() (checksum, via a view).
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.tool import memchecker
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+ln = world.local_size
+n = world.size
+assert memchecker.attached(), "memchecker var did not reach attach()"
+
+base = np.full((ln, 4), float(p + 1))
+view = base[:]  # pre-guard view: bypasses the write-protect flag
+
+if p == 0:
+    r = world.iallreduce(base, SUM)
+    # in-flight window: proc 1 has not joined yet
+    try:
+        base[0, 0] = 99.0
+        raise SystemExit("write-protect did not fire")
+    except ValueError:
+        pass
+    print(f"OK memchk_writeprotect proc={p}")
+    view[0, 0] = 42.0  # bypass the flag → checksum must catch at wait
+    world.send(np.array([1.0]), source=0, dest=n - 1, tag=7)
+    try:
+        r.wait()
+        raise SystemExit("checksum did not fire")
+    except memchecker.MPIBufferError:
+        pass
+    print(f"OK memchk_checksum proc={p}")
+    view[0, 0] = 1.0  # restore so the peer's result matches
+else:
+    tok, _ = world.recv(dest=n - 1, source=0, tag=7)
+    out = world.iallreduce(base, SUM).wait()
+    # proc 0's contribution had the mutated cell when the collective
+    # actually ran; just check completion and writability restoration
+    assert out.shape == (ln, 4)
+    print(f"OK memchk_writeprotect proc={p}")
+    print(f"OK memchk_checksum proc={p}")
+
+assert base.flags.writeable, "writeability not restored"
+print(f"OK memchk_restored proc={p}")
+
+# clean issue with no mutation completes without diagnostics
+out = world.iallreduce(np.ones((ln, 4)), SUM).wait()
+assert out is not None
+print(f"OK memchk_clean proc={p}")
+
+api.finalize()
+print(f"OK finalize proc={p}")
