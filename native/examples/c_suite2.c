@@ -1,0 +1,367 @@
+/* Conformance smoke suite #2 — the round-3 C ABI breadth:
+ * pack/unpack, reduce_local, alltoallv, attributes/keyvals, Info,
+ * persistent p2p, sendrecv_replace, testsome, mprobe/mrecv, topology
+ * (cart_sub/topo_test), RMA (lock_all/flush_all/get_accumulate/CAS),
+ * MPI-IO (shared pointers, write_all), datatype breadth, error
+ * classes, handle conversions.  Runs at any np >= 2.
+ */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int rank, size;
+
+#define CHECK(cond, name)                                       \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      fprintf(stderr, "FAIL %s rank=%d\n", name, rank);         \
+      MPI_Abort(MPI_COMM_WORLD, 2);                             \
+    } else {                                                    \
+      printf("OK %s rank=%d\n", name, rank);                    \
+    }                                                           \
+  } while (0)
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  /* -- pack/unpack ---------------------------------------------- */
+  {
+    double in[4] = {1.5, 2.5, 3.5, 4.5}, out[4] = {0};
+    char buf[64];
+    int pos = 0, sz = 0;
+    MPI_Pack_size(4, MPI_DOUBLE, MPI_COMM_WORLD, &sz);
+    CHECK(sz == 32, "pack_size");
+    MPI_Pack(in, 4, MPI_DOUBLE, buf, sizeof buf, &pos, MPI_COMM_WORLD);
+    CHECK(pos == 32, "pack_position");
+    pos = 0;
+    MPI_Unpack(buf, sizeof buf, &pos, out, 4, MPI_DOUBLE, MPI_COMM_WORLD);
+    CHECK(out[0] == 1.5 && out[3] == 4.5, "unpack_roundtrip");
+  }
+
+  /* -- reduce_local / op_commutative ----------------------------- */
+  {
+    double a[3] = {1, 2, 3}, b[3] = {10, 20, 30};
+    MPI_Reduce_local(a, b, 3, MPI_DOUBLE, MPI_SUM);
+    CHECK(b[0] == 11 && b[2] == 33, "reduce_local");
+    int comm_flag = -1;
+    MPI_Op_commutative(MPI_SUM, &comm_flag);
+    CHECK(comm_flag == 1, "op_commutative");
+  }
+
+  /* -- alltoallv ------------------------------------------------- */
+  {
+    int *scounts = malloc(sizeof(int) * size);
+    int *sdispls = malloc(sizeof(int) * size);
+    int *rcounts = malloc(sizeof(int) * size);
+    int *rdispls = malloc(sizeof(int) * size);
+    /* rank r sends (j+1) ints to rank j, value = 100*r + j */
+    int stotal = 0, rtotal = 0;
+    for (int j = 0; j < size; j++) {
+      scounts[j] = j + 1;
+      sdispls[j] = stotal;
+      stotal += scounts[j];
+      rcounts[j] = rank + 1;
+      rdispls[j] = rtotal;
+      rtotal += rcounts[j];
+    }
+    int *sbuf = malloc(sizeof(int) * stotal);
+    int *rbuf = malloc(sizeof(int) * rtotal);
+    for (int j = 0; j < size; j++)
+      for (int k = 0; k < scounts[j]; k++)
+        sbuf[sdispls[j] + k] = 100 * rank + j;
+    MPI_Alltoallv(sbuf, scounts, sdispls, MPI_INT, rbuf, rcounts, rdispls,
+                  MPI_INT, MPI_COMM_WORLD);
+    int ok = 1;
+    for (int j = 0; j < size; j++)
+      for (int k = 0; k < rcounts[j]; k++)
+        if (rbuf[rdispls[j] + k] != 100 * j + rank) ok = 0;
+    CHECK(ok, "alltoallv");
+    free(sbuf); free(rbuf);
+    free(scounts); free(sdispls); free(rcounts); free(rdispls);
+  }
+
+  /* -- attributes / keyvals -------------------------------------- */
+  {
+    void *val = NULL;
+    int flag = 0;
+    MPI_Comm_get_attr(MPI_COMM_WORLD, MPI_TAG_UB, &val, &flag);
+    CHECK(flag && *(long long *)val > 30000, "tag_ub");
+    int kv;
+    MPI_Comm_create_keyval(MPI_COMM_DUP_FN, MPI_COMM_NULL_DELETE_FN, &kv,
+                           NULL);
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv, (void *)(uintptr_t)4242);
+    MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &val, &flag);
+    CHECK(flag && (uintptr_t)val == 4242, "attr_roundtrip");
+    MPI_Comm dup2;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup2);
+    MPI_Comm_get_attr(dup2, kv, &val, &flag);
+    CHECK(flag && (uintptr_t)val == 4242, "attr_dup_fn_copied");
+    MPI_Comm_delete_attr(MPI_COMM_WORLD, kv);
+    MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &val, &flag);
+    CHECK(!flag, "attr_deleted");
+    MPI_Comm_free_keyval(&kv);
+    MPI_Comm_free(&dup2);
+  }
+
+  /* -- Info ------------------------------------------------------- */
+  {
+    MPI_Info info;
+    MPI_Info_create(&info);
+    MPI_Info_set(info, "alpha", "one");
+    MPI_Info_set(info, "beta", "two");
+    int nkeys = 0, flag = 0, vlen = 0;
+    char value[64], key[MPI_MAX_INFO_KEY];
+    MPI_Info_get_nkeys(info, &nkeys);
+    CHECK(nkeys == 2, "info_nkeys");
+    MPI_Info_get_valuelen(info, "beta", &vlen, &flag);
+    CHECK(flag && vlen == 3, "info_valuelen");
+    MPI_Info_get(info, "alpha", 63, value, &flag);
+    CHECK(flag && strcmp(value, "one") == 0, "info_get");
+    MPI_Info_get_nthkey(info, 0, key);
+    CHECK(strlen(key) > 0, "info_nthkey");
+    MPI_Info info2;
+    MPI_Info_dup(info, &info2);
+    MPI_Info_delete(info, "alpha");
+    MPI_Info_get(info, "alpha", 63, value, &flag);
+    CHECK(!flag, "info_delete");
+    MPI_Info_get(info2, "alpha", 63, value, &flag);
+    CHECK(flag, "info_dup_independent");
+    MPI_Info_free(&info);
+    MPI_Info_free(&info2);
+  }
+
+  /* -- persistent p2p + sendrecv_replace + testsome --------------- */
+  {
+    int peer = (rank + 1) % size;
+    int prev = (rank - 1 + size) % size;
+    double sval = rank * 1.0, rval = -1;
+    MPI_Request reqs[2];
+    MPI_Send_init(&sval, 1, MPI_DOUBLE, peer, 11, MPI_COMM_WORLD, &reqs[0]);
+    MPI_Recv_init(&rval, 1, MPI_DOUBLE, prev, 11, MPI_COMM_WORLD, &reqs[1]);
+    for (int round = 0; round < 2; round++) {
+      sval = rank * 10.0 + round;
+      MPI_Startall(2, reqs);
+      MPI_Status sts[2];
+      MPI_Waitall(2, reqs, sts);
+      CHECK(rval == prev * 10.0 + round, "persistent_roundtrip");
+      CHECK(reqs[0] != MPI_REQUEST_NULL && reqs[1] != MPI_REQUEST_NULL,
+            "persistent_survives_wait");
+    }
+    MPI_Request_free(&reqs[0]);
+    MPI_Request_free(&reqs[1]);
+
+    double rr = rank * 100.0;
+    MPI_Status st;
+    MPI_Sendrecv_replace(&rr, 1, MPI_DOUBLE, peer, 21, prev, 21,
+                         MPI_COMM_WORLD, &st);
+    CHECK(rr == prev * 100.0, "sendrecv_replace");
+
+    /* testsome over eager isends */
+    MPI_Request r2[3];
+    double payload[3] = {1, 2, 3};
+    for (int i = 0; i < 3; i++)
+      MPI_Isend(&payload[i], 1, MPI_DOUBLE, peer, 30 + i, MPI_COMM_WORLD,
+                &r2[i]);
+    int outcount = 0, indices[3];
+    MPI_Status sts[3];
+    int spin = 0;
+    while (outcount < 3 && spin++ < 1000) {
+      int oc = 0;
+      MPI_Testsome(3, r2, &oc, indices, sts);
+      if (oc > 0 && oc != MPI_UNDEFINED) outcount += oc;
+    }
+    CHECK(outcount == 3, "testsome");
+    for (int i = 0; i < 3; i++) {
+      double got;
+      MPI_Recv(&got, 1, MPI_DOUBLE, prev, 30 + i, MPI_COMM_WORLD,
+               MPI_STATUS_IGNORE);
+    }
+  }
+
+  /* -- mprobe/mrecv ---------------------------------------------- */
+  {
+    int peer = (rank + 1) % size;
+    int prev = (rank - 1 + size) % size;
+    int tok = rank + 77;
+    MPI_Send(&tok, 1, MPI_INT, peer, 41, MPI_COMM_WORLD);
+    MPI_Message msg;
+    MPI_Status st;
+    MPI_Mprobe(prev, 41, MPI_COMM_WORLD, &msg, &st);
+    int got = -1;
+    MPI_Mrecv(&got, 1, MPI_INT, &msg, &st);
+    CHECK(got == prev + 77 && msg == MPI_MESSAGE_NULL, "mprobe_mrecv");
+  }
+
+  /* -- topology: cart_sub + topo_test ----------------------------- */
+  if (size >= 2) {
+    int dims[2] = {size, 1}, periods[2] = {1, 0};
+    MPI_Comm cart;
+    MPI_Cart_create(MPI_COMM_WORLD, 2, dims, periods, 0, &cart);
+    if (cart != MPI_COMM_NULL) {
+      int topo = -1;
+      MPI_Topo_test(cart, &topo);
+      CHECK(topo == MPI_CART, "topo_test_cart");
+      int remain[2] = {1, 0};
+      MPI_Comm sub;
+      MPI_Cart_sub(cart, remain, &sub);
+      int subsize = 0;
+      MPI_Comm_size(sub, &subsize);
+      CHECK(subsize == size, "cart_sub_size");
+      MPI_Topo_test(sub, &topo);
+      CHECK(topo == MPI_CART, "cart_sub_is_cart");
+      MPI_Comm_free(&sub);
+      MPI_Comm_free(&cart);
+    }
+    int t2 = -1;
+    MPI_Topo_test(MPI_COMM_WORLD, &t2);
+    CHECK(t2 == MPI_UNDEFINED, "topo_test_flat");
+  }
+
+  /* -- RMA breadth ------------------------------------------------ */
+  {
+    double local = rank * 1.0;
+    MPI_Win win;
+    MPI_Win_create(&local, sizeof(double), sizeof(double), MPI_INFO_NULL,
+                   MPI_COMM_WORLD, &win);
+    MPI_Win_lock_all(0, win);
+    double got = -1;
+    MPI_Get(&got, 1, MPI_DOUBLE, (rank + 1) % size, 0, 1, MPI_DOUBLE, win);
+    MPI_Win_flush_all(win);
+    CHECK(got == ((rank + 1) % size) * 1.0, "lock_all_get");
+    MPI_Win_unlock_all(win);
+    MPI_Win_fence(0, win);
+    /* get_accumulate on self */
+    double add = 5.0, old = -1;
+    MPI_Win_lock(MPI_LOCK_EXCLUSIVE, rank, 0, win);
+    MPI_Get_accumulate(&add, 1, MPI_DOUBLE, &old, 1, MPI_DOUBLE, rank, 0, 1,
+                       MPI_DOUBLE, MPI_SUM, win);
+    MPI_Win_flush(rank, win);
+    MPI_Win_unlock(rank, win);
+    CHECK(old == rank * 1.0 && local == rank + 5.0, "get_accumulate");
+    /* compare_and_swap on self */
+    double cmp = rank + 5.0, swap = 99.0, res = -1;
+    MPI_Win_lock(MPI_LOCK_EXCLUSIVE, rank, 0, win);
+    MPI_Compare_and_swap(&swap, &cmp, &res, MPI_DOUBLE, rank, 0, win);
+    MPI_Win_unlock(rank, win);
+    CHECK(res == rank + 5.0 && local == 99.0, "compare_and_swap");
+    MPI_Win_fence(0, win);
+    MPI_Win_free(&win);
+  }
+
+  /* -- Win_allocate + predefined attrs ---------------------------- */
+  {
+    MPI_Win win;
+    void *base = NULL;
+    MPI_Win_allocate(8 * sizeof(double), sizeof(double), MPI_INFO_NULL,
+                     MPI_COMM_WORLD, &base, &win);
+    CHECK(base != NULL, "win_allocate_base");
+    ((double *)base)[0] = 3.25;
+    void *attr = NULL;
+    int flag = 0;
+    MPI_Win_get_attr(win, MPI_WIN_BASE, &attr, &flag);
+    CHECK(flag && attr == base, "win_base_attr");
+    MPI_Win_free(&win);
+  }
+
+  /* -- datatype breadth ------------------------------------------- */
+  {
+    MPI_Datatype resized;
+    MPI_Type_create_resized(MPI_INT, 0, 8, &resized);
+    MPI_Type_commit(&resized);
+    MPI_Aint lb = -1, ext = -1, tlb = -1, text = -1;
+    MPI_Type_get_extent(resized, &lb, &ext);
+    CHECK(lb == 0 && ext == 8, "type_resized_extent");
+    MPI_Type_get_true_extent(resized, &tlb, &text);
+    CHECK(text == 4, "type_true_extent");
+    MPI_Type_set_name(resized, "my_resized");
+    char tname[MPI_MAX_OBJECT_NAME];
+    int rl = 0;
+    MPI_Type_get_name(resized, tname, &rl);
+    CHECK(strcmp(tname, "my_resized") == 0, "type_name");
+    MPI_Type_free(&resized);
+
+    int sizes2[2] = {4, 4}, subs[2] = {2, 2}, starts[2] = {1, 1};
+    MPI_Datatype sub;
+    MPI_Type_create_subarray(2, sizes2, subs, starts, MPI_ORDER_C, MPI_INT,
+                             &sub);
+    MPI_Type_commit(&sub);
+    int tsz = 0;
+    MPI_Type_size(sub, &tsz);
+    CHECK(tsz == 16, "type_subarray_size");
+    /* pack a subarray: center 2x2 block of a 4x4 */
+    int mat[16], outbuf[4] = {0}, pos = 0;
+    char packed[64];
+    for (int i = 0; i < 16; i++) mat[i] = i;
+    MPI_Pack(mat, 1, sub, packed, sizeof packed, &pos, MPI_COMM_WORLD);
+    pos = 0;
+    MPI_Unpack(packed, sizeof packed, &pos, outbuf, 4, MPI_INT,
+               MPI_COMM_WORLD);
+    CHECK(outbuf[0] == 5 && outbuf[1] == 6 && outbuf[2] == 9 &&
+          outbuf[3] == 10, "type_subarray_pack");
+    MPI_Type_free(&sub);
+  }
+
+  /* -- error classes + handle conversions ------------------------- */
+  {
+    int ec = 0, code = 0;
+    MPI_Add_error_class(&ec);
+    MPI_Add_error_code(ec, &code);
+    MPI_Add_error_string(code, "custom failure");
+    char es[MPI_MAX_ERROR_STRING];
+    int el = 0;
+    MPI_Error_string(code, es, &el);
+    CHECK(strstr(es, "custom failure") != NULL, "add_error_string");
+    CHECK(MPI_Comm_c2f(MPI_COMM_WORLD) == 1 &&
+              MPI_Comm_f2c(1) == MPI_COMM_WORLD,
+          "comm_c2f_f2c");
+    MPI_Status cst = {3, 5, 0, 7};
+    int fst[4];
+    MPI_Status_c2f(&cst, fst);
+    MPI_Status cst2;
+    MPI_Status_f2c(fst, &cst2);
+    CHECK(cst2.MPI_SOURCE == 3 && cst2.MPI_TAG == 5, "status_c2f_f2c");
+  }
+
+  /* -- misc locals ------------------------------------------------- */
+  {
+    void *mem = NULL;
+    MPI_Alloc_mem(128, MPI_INFO_NULL, &mem);
+    CHECK(mem != NULL, "alloc_mem");
+    memset(mem, 0, 128);
+    MPI_Free_mem(mem);
+    int mainthread = 0, provided = -1;
+    MPI_Is_thread_main(&mainthread);
+    MPI_Query_thread(&provided);
+    CHECK(mainthread == 1 && provided == MPI_THREAD_SERIALIZED,
+          "thread_queries");
+    CHECK(MPI_Aint_add(40, 2) == 42 && MPI_Aint_diff(40, 2) == 38,
+          "aint_arith");
+    MPI_Pcontrol(1);
+    printf("OK pcontrol rank=%d\n", rank);
+  }
+
+  /* -- i-variant collectives (eager completion) -------------------- */
+  {
+    double v = rank + 1.0, out = 0;
+    MPI_Request rq;
+    MPI_Ireduce(&v, &out, 1, MPI_DOUBLE, MPI_SUM, 0, MPI_COMM_WORLD, &rq);
+    MPI_Wait(&rq, MPI_STATUS_IGNORE);
+    if (rank == 0)
+      CHECK(out == size * (size + 1) / 2.0, "ireduce");
+    else
+      printf("OK ireduce rank=%d\n", rank);
+    int sv = rank + 1, so = 0;
+    MPI_Iscan(&sv, &so, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD, &rq);
+    MPI_Wait(&rq, MPI_STATUS_IGNORE);
+    CHECK(so == (rank + 1) * (rank + 2) / 2, "iscan");
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("SUITE2 COMPLETE\n");
+  MPI_Finalize();
+  return 0;
+}

@@ -503,6 +503,536 @@ TPUMPI_PROTO(int, Ialltoall,
               void *recvbuf, int recvcount, MPI_Datatype recvtype,
               MPI_Comm comm, MPI_Request *request))
 
+/* ================================================================== */
+/* Round-3 breadth: pack/unpack, attributes, Info, persistent p2p,     */
+/* matched probe, topology, RMA/IO extensions (conformance surface).   */
+/* ================================================================== */
+
+typedef int MPI_Message;
+#define MPI_MESSAGE_NULL ((MPI_Message)0)
+#define MPI_MESSAGE_NO_PROC ((MPI_Message)-1)
+
+/* predefined attribute keyvals (values mirrored in capi.py) */
+#define MPI_KEYVAL_INVALID (-1)
+#define MPI_TAG_UB 1
+#define MPI_HOST 2
+#define MPI_IO 3
+#define MPI_WTIME_IS_GLOBAL 4
+#define MPI_WIN_BASE 5
+#define MPI_WIN_SIZE 6
+#define MPI_WIN_DISP_UNIT 7
+#define MPI_UNIVERSE_SIZE 9
+#define MPI_APPNUM 11
+
+#define MPI_MAX_INFO_KEY 256
+#define MPI_MAX_INFO_VAL 1024
+#define MPI_MAX_PORT_NAME 256
+#define MPI_BSEND_OVERHEAD 128
+
+#define MPI_ORDER_C 56
+#define MPI_ORDER_FORTRAN 57
+
+/* topology types (MPI_Topo_test) */
+#define MPI_GRAPH 1
+#define MPI_CART 2
+#define MPI_DIST_GRAPH 3
+
+#define MPI_UNWEIGHTED ((int *)2)
+#define MPI_WEIGHTS_EMPTY ((int *)3)
+
+/* attribute copy/delete callback types + predefined functions */
+typedef int(MPI_Comm_copy_attr_function)(MPI_Comm, int, void *, void *,
+                                         void *, int *);
+typedef int(MPI_Comm_delete_attr_function)(MPI_Comm, int, void *, void *);
+typedef MPI_Comm_copy_attr_function MPI_Copy_function;
+typedef MPI_Comm_delete_attr_function MPI_Delete_function;
+typedef int(MPI_Type_copy_attr_function)(MPI_Datatype, int, void *, void *,
+                                         void *, int *);
+typedef int(MPI_Type_delete_attr_function)(MPI_Datatype, int, void *, void *);
+typedef int(MPI_Win_copy_attr_function)(MPI_Win, int, void *, void *,
+                                        void *, int *);
+typedef int(MPI_Win_delete_attr_function)(MPI_Win, int, void *, void *);
+typedef int(MPI_Grequest_query_function)(void *, MPI_Status *);
+typedef int(MPI_Grequest_free_function)(void *);
+typedef int(MPI_Grequest_cancel_function)(void *, int);
+
+/* predefined copy/delete fns: sentinel addresses the shim recognizes */
+#define MPI_COMM_NULL_COPY_FN ((MPI_Comm_copy_attr_function *)0)
+#define MPI_COMM_DUP_FN ((MPI_Comm_copy_attr_function *)1)
+#define MPI_COMM_NULL_DELETE_FN ((MPI_Comm_delete_attr_function *)0)
+#define MPI_NULL_COPY_FN MPI_COMM_NULL_COPY_FN
+#define MPI_DUP_FN MPI_COMM_DUP_FN
+#define MPI_NULL_DELETE_FN MPI_COMM_NULL_DELETE_FN
+#define MPI_TYPE_NULL_COPY_FN ((MPI_Type_copy_attr_function *)0)
+#define MPI_TYPE_DUP_FN ((MPI_Type_copy_attr_function *)1)
+#define MPI_TYPE_NULL_DELETE_FN ((MPI_Type_delete_attr_function *)0)
+#define MPI_WIN_NULL_COPY_FN ((MPI_Win_copy_attr_function *)0)
+#define MPI_WIN_DUP_FN ((MPI_Win_copy_attr_function *)1)
+#define MPI_WIN_NULL_DELETE_FN ((MPI_Win_delete_attr_function *)0)
+
+#define TPUMPI_PROTO2(ret, name, args) \
+  ret MPI_##name args;                 \
+  ret PMPI_##name args;
+
+/* pack/unpack */
+TPUMPI_PROTO2(int, Pack,
+              (const void *inbuf, int incount, MPI_Datatype datatype,
+               void *outbuf, int outsize, int *position, MPI_Comm comm))
+TPUMPI_PROTO2(int, Unpack,
+              (const void *inbuf, int insize, int *position, void *outbuf,
+               int outcount, MPI_Datatype datatype, MPI_Comm comm))
+TPUMPI_PROTO2(int, Pack_size, (int incount, MPI_Datatype datatype,
+                               MPI_Comm comm, int *size))
+TPUMPI_PROTO2(int, Pack_external,
+              (const char *datarep, const void *inbuf, int incount,
+               MPI_Datatype datatype, void *outbuf, MPI_Aint outsize,
+               MPI_Aint *position))
+TPUMPI_PROTO2(int, Unpack_external,
+              (const char *datarep, const void *inbuf, MPI_Aint insize,
+               MPI_Aint *position, void *outbuf, int outcount,
+               MPI_Datatype datatype))
+TPUMPI_PROTO2(int, Pack_external_size,
+              (const char *datarep, int incount, MPI_Datatype datatype,
+               MPI_Aint *size))
+
+/* local reduction + op introspection */
+TPUMPI_PROTO2(int, Reduce_local,
+              (const void *inbuf, void *inoutbuf, int count,
+               MPI_Datatype datatype, MPI_Op op))
+TPUMPI_PROTO2(int, Op_commutative, (MPI_Op op, int *commute))
+
+/* p2p breadth */
+TPUMPI_PROTO2(int, Sendrecv_replace,
+              (void *buf, int count, MPI_Datatype datatype, int dest,
+               int sendtag, int source, int recvtag, MPI_Comm comm,
+               MPI_Status *status))
+TPUMPI_PROTO2(int, Ssend, (const void *buf, int count, MPI_Datatype datatype,
+                           int dest, int tag, MPI_Comm comm))
+TPUMPI_PROTO2(int, Ibsend, (const void *buf, int count,
+                            MPI_Datatype datatype, int dest, int tag,
+                            MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Irsend, (const void *buf, int count,
+                            MPI_Datatype datatype, int dest, int tag,
+                            MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Issend, (const void *buf, int count,
+                            MPI_Datatype datatype, int dest, int tag,
+                            MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Testsome,
+              (int incount, MPI_Request requests[], int *outcount,
+               int indices[], MPI_Status statuses[]))
+TPUMPI_PROTO2(int, Cancel, (MPI_Request *request))
+TPUMPI_PROTO2(int, Test_cancelled, (const MPI_Status *status, int *flag))
+TPUMPI_PROTO2(int, Request_free, (MPI_Request *request))
+TPUMPI_PROTO2(int, Request_get_status,
+              (MPI_Request request, int *flag, MPI_Status *status))
+
+/* persistent p2p */
+TPUMPI_PROTO2(int, Send_init,
+              (const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Bsend_init,
+              (const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Rsend_init,
+              (const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Ssend_init,
+              (const void *buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Recv_init,
+              (void *buf, int count, MPI_Datatype datatype, int source,
+               int tag, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Start, (MPI_Request *request))
+TPUMPI_PROTO2(int, Startall, (int count, MPI_Request requests[]))
+
+/* matched probe */
+TPUMPI_PROTO2(int, Mprobe, (int source, int tag, MPI_Comm comm,
+                            MPI_Message *message, MPI_Status *status))
+TPUMPI_PROTO2(int, Improbe, (int source, int tag, MPI_Comm comm, int *flag,
+                             MPI_Message *message, MPI_Status *status))
+TPUMPI_PROTO2(int, Mrecv, (void *buf, int count, MPI_Datatype datatype,
+                           MPI_Message *message, MPI_Status *status))
+TPUMPI_PROTO2(int, Imrecv, (void *buf, int count, MPI_Datatype datatype,
+                            MPI_Message *message, MPI_Request *request))
+
+/* v/i collectives */
+TPUMPI_PROTO2(int, Alltoallv,
+              (const void *sendbuf, const int sendcounts[],
+               const int sdispls[], MPI_Datatype sendtype, void *recvbuf,
+               const int recvcounts[], const int rdispls[],
+               MPI_Datatype recvtype, MPI_Comm comm))
+TPUMPI_PROTO2(int, Ireduce,
+              (const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Iscan,
+              (const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Iexscan,
+              (const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Igather,
+              (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+               MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Iscatter,
+              (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+               MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Igatherv,
+              (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, const int recvcounts[], const int displs[],
+               MPI_Datatype recvtype, int root, MPI_Comm comm,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Iscatterv,
+              (const void *sendbuf, const int sendcounts[],
+               const int displs[], MPI_Datatype sendtype, void *recvbuf,
+               int recvcount, MPI_Datatype recvtype, int root, MPI_Comm comm,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Iallgatherv,
+              (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, const int recvcounts[], const int displs[],
+               MPI_Datatype recvtype, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Ialltoallv,
+              (const void *sendbuf, const int sendcounts[],
+               const int sdispls[], MPI_Datatype sendtype, void *recvbuf,
+               const int recvcounts[], const int rdispls[],
+               MPI_Datatype recvtype, MPI_Comm comm, MPI_Request *request))
+TPUMPI_PROTO2(int, Ireduce_scatter,
+              (const void *sendbuf, void *recvbuf, const int recvcounts[],
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Ireduce_scatter_block,
+              (const void *sendbuf, void *recvbuf, int recvcount,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+               MPI_Request *request))
+
+/* attributes / keyvals */
+TPUMPI_PROTO2(int, Comm_create_keyval,
+              (MPI_Comm_copy_attr_function *comm_copy_attr_fn,
+               MPI_Comm_delete_attr_function *comm_delete_attr_fn,
+               int *comm_keyval, void *extra_state))
+TPUMPI_PROTO2(int, Comm_free_keyval, (int *comm_keyval))
+TPUMPI_PROTO2(int, Comm_set_attr, (MPI_Comm comm, int comm_keyval,
+                                   void *attribute_val))
+TPUMPI_PROTO2(int, Comm_get_attr, (MPI_Comm comm, int comm_keyval,
+                                   void *attribute_val, int *flag))
+TPUMPI_PROTO2(int, Comm_delete_attr, (MPI_Comm comm, int comm_keyval))
+TPUMPI_PROTO2(int, Keyval_create,
+              (MPI_Copy_function *copy_fn, MPI_Delete_function *delete_fn,
+               int *keyval, void *extra_state))
+TPUMPI_PROTO2(int, Keyval_free, (int *keyval))
+TPUMPI_PROTO2(int, Attr_put, (MPI_Comm comm, int keyval, void *attribute_val))
+TPUMPI_PROTO2(int, Attr_get, (MPI_Comm comm, int keyval, void *attribute_val,
+                              int *flag))
+TPUMPI_PROTO2(int, Attr_delete, (MPI_Comm comm, int keyval))
+TPUMPI_PROTO2(int, Type_create_keyval,
+              (MPI_Type_copy_attr_function *type_copy_attr_fn,
+               MPI_Type_delete_attr_function *type_delete_attr_fn,
+               int *type_keyval, void *extra_state))
+TPUMPI_PROTO2(int, Type_free_keyval, (int *type_keyval))
+TPUMPI_PROTO2(int, Type_set_attr, (MPI_Datatype datatype, int type_keyval,
+                                   void *attribute_val))
+TPUMPI_PROTO2(int, Type_get_attr, (MPI_Datatype datatype, int type_keyval,
+                                   void *attribute_val, int *flag))
+TPUMPI_PROTO2(int, Type_delete_attr, (MPI_Datatype datatype, int type_keyval))
+TPUMPI_PROTO2(int, Win_create_keyval,
+              (MPI_Win_copy_attr_function *win_copy_attr_fn,
+               MPI_Win_delete_attr_function *win_delete_attr_fn,
+               int *win_keyval, void *extra_state))
+TPUMPI_PROTO2(int, Win_free_keyval, (int *win_keyval))
+TPUMPI_PROTO2(int, Win_set_attr, (MPI_Win win, int win_keyval,
+                                  void *attribute_val))
+TPUMPI_PROTO2(int, Win_get_attr, (MPI_Win win, int win_keyval,
+                                  void *attribute_val, int *flag))
+TPUMPI_PROTO2(int, Win_delete_attr, (MPI_Win win, int win_keyval))
+
+/* Info objects */
+TPUMPI_PROTO2(int, Info_create, (MPI_Info * info))
+TPUMPI_PROTO2(int, Info_set, (MPI_Info info, const char *key,
+                              const char *value))
+TPUMPI_PROTO2(int, Info_get, (MPI_Info info, const char *key, int valuelen,
+                              char *value, int *flag))
+TPUMPI_PROTO2(int, Info_get_valuelen, (MPI_Info info, const char *key,
+                                       int *valuelen, int *flag))
+TPUMPI_PROTO2(int, Info_delete, (MPI_Info info, const char *key))
+TPUMPI_PROTO2(int, Info_dup, (MPI_Info info, MPI_Info *newinfo))
+TPUMPI_PROTO2(int, Info_free, (MPI_Info * info))
+TPUMPI_PROTO2(int, Info_get_nkeys, (MPI_Info info, int *nkeys))
+TPUMPI_PROTO2(int, Info_get_nthkey, (MPI_Info info, int n, char *key))
+
+/* error classes/codes */
+TPUMPI_PROTO2(int, Add_error_class, (int *errorclass))
+TPUMPI_PROTO2(int, Add_error_code, (int errorclass, int *errorcode))
+TPUMPI_PROTO2(int, Add_error_string, (int errorcode, const char *string))
+TPUMPI_PROTO2(int, Comm_call_errhandler, (MPI_Comm comm, int errorcode))
+TPUMPI_PROTO2(int, Win_call_errhandler, (MPI_Win win, int errorcode))
+TPUMPI_PROTO2(int, File_call_errhandler, (MPI_File fh, int errorcode))
+TPUMPI_PROTO2(int, Comm_create_errhandler,
+              (void (*comm_errhandler_fn)(MPI_Comm *, int *, ...),
+               MPI_Errhandler *errhandler))
+TPUMPI_PROTO2(int, Win_create_errhandler,
+              (void (*win_errhandler_fn)(MPI_Win *, int *, ...),
+               MPI_Errhandler *errhandler))
+TPUMPI_PROTO2(int, File_create_errhandler,
+              (void (*file_errhandler_fn)(MPI_File *, int *, ...),
+               MPI_Errhandler *errhandler))
+TPUMPI_PROTO2(int, Win_set_errhandler, (MPI_Win win,
+                                        MPI_Errhandler errhandler))
+TPUMPI_PROTO2(int, Win_get_errhandler, (MPI_Win win,
+                                        MPI_Errhandler *errhandler))
+TPUMPI_PROTO2(int, File_set_errhandler, (MPI_File fh,
+                                         MPI_Errhandler errhandler))
+TPUMPI_PROTO2(int, File_get_errhandler, (MPI_File fh,
+                                         MPI_Errhandler *errhandler))
+
+/* deprecated-but-exported (MPI-1 names the reference still carries) */
+TPUMPI_PROTO2(int, Address, (void *location, MPI_Aint *address))
+TPUMPI_PROTO2(int, Type_extent, (MPI_Datatype datatype, MPI_Aint *extent))
+TPUMPI_PROTO2(int, Type_lb, (MPI_Datatype datatype, MPI_Aint *lb))
+TPUMPI_PROTO2(int, Type_ub, (MPI_Datatype datatype, MPI_Aint *ub))
+TPUMPI_PROTO2(int, Type_hvector,
+              (int count, int blocklength, MPI_Aint stride,
+               MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_hindexed,
+              (int count, int blocklengths[], MPI_Aint displacements[],
+               MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_struct,
+              (int count, int blocklengths[], MPI_Aint displacements[],
+               MPI_Datatype types[], MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Errhandler_create,
+              (void (*fn)(MPI_Comm *, int *, ...),
+               MPI_Errhandler *errhandler))
+TPUMPI_PROTO2(int, Errhandler_set, (MPI_Comm comm, MPI_Errhandler errhandler))
+TPUMPI_PROTO2(int, Errhandler_get, (MPI_Comm comm,
+                                    MPI_Errhandler *errhandler))
+
+/* datatype breadth */
+TPUMPI_PROTO2(int, Type_create_hvector,
+              (int count, int blocklength, MPI_Aint stride,
+               MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_create_hindexed,
+              (int count, const int blocklengths[],
+               const MPI_Aint displacements[], MPI_Datatype oldtype,
+               MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_create_hindexed_block,
+              (int count, int blocklength, const MPI_Aint displacements[],
+               MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_create_indexed_block,
+              (int count, int blocklength, const int displacements[],
+               MPI_Datatype oldtype, MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_create_resized,
+              (MPI_Datatype oldtype, MPI_Aint lb, MPI_Aint extent,
+               MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_create_subarray,
+              (int ndims, const int sizes[], const int subsizes[],
+               const int starts[], int order, MPI_Datatype oldtype,
+               MPI_Datatype *newtype))
+TPUMPI_PROTO2(int, Type_get_true_extent,
+              (MPI_Datatype datatype, MPI_Aint *true_lb,
+               MPI_Aint *true_extent))
+TPUMPI_PROTO2(int, Type_get_true_extent_x,
+              (MPI_Datatype datatype, MPI_Count *true_lb,
+               MPI_Count *true_extent))
+TPUMPI_PROTO2(int, Type_get_extent_x,
+              (MPI_Datatype datatype, MPI_Count *lb, MPI_Count *extent))
+TPUMPI_PROTO2(int, Type_size_x, (MPI_Datatype datatype, MPI_Count *size))
+TPUMPI_PROTO2(int, Type_set_name, (MPI_Datatype datatype,
+                                   const char *type_name))
+TPUMPI_PROTO2(int, Type_get_name, (MPI_Datatype datatype, char *type_name,
+                                   int *resultlen))
+TPUMPI_PROTO2(int, Get_elements, (const MPI_Status *status,
+                                  MPI_Datatype datatype, int *count))
+TPUMPI_PROTO2(int, Get_elements_x, (const MPI_Status *status,
+                                    MPI_Datatype datatype, MPI_Count *count))
+TPUMPI_PROTO2(int, Status_set_elements,
+              (MPI_Status * status, MPI_Datatype datatype, int count))
+TPUMPI_PROTO2(int, Status_set_elements_x,
+              (MPI_Status * status, MPI_Datatype datatype, MPI_Count count))
+TPUMPI_PROTO2(int, Status_set_cancelled, (MPI_Status * status, int flag))
+
+/* comm/group breadth */
+TPUMPI_PROTO2(int, Comm_test_inter, (MPI_Comm comm, int *flag))
+TPUMPI_PROTO2(int, Comm_remote_group, (MPI_Comm comm, MPI_Group *group))
+TPUMPI_PROTO2(int, Intercomm_create,
+              (MPI_Comm local_comm, int local_leader, MPI_Comm peer_comm,
+               int remote_leader, int tag, MPI_Comm *newintercomm))
+TPUMPI_PROTO2(int, Comm_dup_with_info,
+              (MPI_Comm comm, MPI_Info info, MPI_Comm *newcomm))
+TPUMPI_PROTO2(int, Comm_idup, (MPI_Comm comm, MPI_Comm *newcomm,
+                               MPI_Request *request))
+TPUMPI_PROTO2(int, Comm_set_info, (MPI_Comm comm, MPI_Info info))
+TPUMPI_PROTO2(int, Comm_get_info, (MPI_Comm comm, MPI_Info *info_used))
+TPUMPI_PROTO2(int, Group_range_incl,
+              (MPI_Group group, int n, int ranges[][3], MPI_Group *newgroup))
+TPUMPI_PROTO2(int, Group_range_excl,
+              (MPI_Group group, int n, int ranges[][3], MPI_Group *newgroup))
+TPUMPI_PROTO2(int, Comm_disconnect, (MPI_Comm * comm))
+
+/* handle conversions (handles ARE ints; identity maps) */
+TPUMPI_PROTO2(MPI_Comm, Comm_f2c, (int comm))
+TPUMPI_PROTO2(int, Comm_c2f, (MPI_Comm comm))
+TPUMPI_PROTO2(MPI_Datatype, Type_f2c, (int datatype))
+TPUMPI_PROTO2(int, Type_c2f, (MPI_Datatype datatype))
+TPUMPI_PROTO2(MPI_Group, Group_f2c, (int group))
+TPUMPI_PROTO2(int, Group_c2f, (MPI_Group group))
+TPUMPI_PROTO2(MPI_Op, Op_f2c, (int op))
+TPUMPI_PROTO2(int, Op_c2f, (MPI_Op op))
+TPUMPI_PROTO2(MPI_Request, Request_f2c, (int request))
+TPUMPI_PROTO2(int, Request_c2f, (MPI_Request request))
+TPUMPI_PROTO2(MPI_Win, Win_f2c, (int win))
+TPUMPI_PROTO2(int, Win_c2f, (MPI_Win win))
+TPUMPI_PROTO2(MPI_File, File_f2c, (int file))
+TPUMPI_PROTO2(int, File_c2f, (MPI_File file))
+TPUMPI_PROTO2(MPI_Info, Info_f2c, (int info))
+TPUMPI_PROTO2(int, Info_c2f, (MPI_Info info))
+TPUMPI_PROTO2(MPI_Errhandler, Errhandler_f2c, (int errhandler))
+TPUMPI_PROTO2(int, Errhandler_c2f, (MPI_Errhandler errhandler))
+TPUMPI_PROTO2(MPI_Message, Message_f2c, (int message))
+TPUMPI_PROTO2(int, Message_c2f, (MPI_Message message))
+TPUMPI_PROTO2(int, Status_f2c, (const int *f_status, MPI_Status *c_status))
+TPUMPI_PROTO2(int, Status_c2f, (const MPI_Status *c_status, int *f_status))
+
+/* misc locals */
+TPUMPI_PROTO2(int, Alloc_mem, (MPI_Aint size, MPI_Info info, void *baseptr))
+TPUMPI_PROTO2(int, Free_mem, (void *base))
+TPUMPI_PROTO2(int, Pcontrol, (const int level, ...))
+TPUMPI_PROTO2(int, Is_thread_main, (int *flag))
+TPUMPI_PROTO2(int, Query_thread, (int *provided))
+TPUMPI_PROTO2(MPI_Aint, Aint_add, (MPI_Aint base, MPI_Aint disp))
+TPUMPI_PROTO2(MPI_Aint, Aint_diff, (MPI_Aint addr1, MPI_Aint addr2))
+
+/* topology breadth */
+TPUMPI_PROTO2(int, Cart_sub, (MPI_Comm comm, const int remain_dims[],
+                              MPI_Comm *newcomm))
+TPUMPI_PROTO2(int, Topo_test, (MPI_Comm comm, int *status))
+TPUMPI_PROTO2(int, Cart_map, (MPI_Comm comm, int ndims, const int dims[],
+                              const int periods[], int *newrank))
+TPUMPI_PROTO2(int, Graph_map, (MPI_Comm comm, int nnodes, const int index[],
+                               const int edges[], int *newrank))
+TPUMPI_PROTO2(int, Graph_get, (MPI_Comm comm, int maxindex, int maxedges,
+                               int index[], int edges[]))
+TPUMPI_PROTO2(int, Dist_graph_create_adjacent,
+              (MPI_Comm comm_old, int indegree, const int sources[],
+               const int sourceweights[], int outdegree,
+               const int destinations[], const int destweights[],
+               MPI_Info info, int reorder, MPI_Comm *comm_dist_graph))
+TPUMPI_PROTO2(int, Dist_graph_create,
+              (MPI_Comm comm_old, int n, const int sources[],
+               const int degrees[], const int destinations[],
+               const int weights[], MPI_Info info, int reorder,
+               MPI_Comm *comm_dist_graph))
+TPUMPI_PROTO2(int, Dist_graph_neighbors_count,
+              (MPI_Comm comm, int *indegree, int *outdegree, int *weighted))
+TPUMPI_PROTO2(int, Dist_graph_neighbors,
+              (MPI_Comm comm, int maxindegree, int sources[],
+               int sourceweights[], int maxoutdegree, int destinations[],
+               int destweights[]))
+
+/* RMA breadth */
+TPUMPI_PROTO2(int, Win_lock_all, (int assertion, MPI_Win win))
+TPUMPI_PROTO2(int, Win_unlock_all, (MPI_Win win))
+TPUMPI_PROTO2(int, Win_flush_all, (MPI_Win win))
+TPUMPI_PROTO2(int, Win_flush_local, (int rank, MPI_Win win))
+TPUMPI_PROTO2(int, Win_flush_local_all, (MPI_Win win))
+TPUMPI_PROTO2(int, Win_sync, (MPI_Win win))
+TPUMPI_PROTO2(int, Win_post, (MPI_Group group, int assertion, MPI_Win win))
+TPUMPI_PROTO2(int, Win_start, (MPI_Group group, int assertion, MPI_Win win))
+TPUMPI_PROTO2(int, Win_complete, (MPI_Win win))
+TPUMPI_PROTO2(int, Win_wait, (MPI_Win win))
+TPUMPI_PROTO2(int, Win_test, (MPI_Win win, int *flag))
+TPUMPI_PROTO2(int, Win_get_group, (MPI_Win win, MPI_Group *group))
+TPUMPI_PROTO2(int, Win_set_name, (MPI_Win win, const char *win_name))
+TPUMPI_PROTO2(int, Win_get_name, (MPI_Win win, char *win_name,
+                                  int *resultlen))
+TPUMPI_PROTO2(int, Win_allocate,
+              (MPI_Aint size, int disp_unit, MPI_Info info, MPI_Comm comm,
+               void *baseptr, MPI_Win *win))
+TPUMPI_PROTO2(int, Get_accumulate,
+              (const void *origin_addr, int origin_count,
+               MPI_Datatype origin_datatype, void *result_addr,
+               int result_count, MPI_Datatype result_datatype,
+               int target_rank, MPI_Aint target_disp, int target_count,
+               MPI_Datatype target_datatype, MPI_Op op, MPI_Win win))
+TPUMPI_PROTO2(int, Compare_and_swap,
+              (const void *origin_addr, const void *compare_addr,
+               void *result_addr, MPI_Datatype datatype, int target_rank,
+               MPI_Aint target_disp, MPI_Win win))
+TPUMPI_PROTO2(int, Rput,
+              (const void *origin_addr, int origin_count,
+               MPI_Datatype origin_datatype, int target_rank,
+               MPI_Aint target_disp, int target_count,
+               MPI_Datatype target_datatype, MPI_Win win,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Rget,
+              (void *origin_addr, int origin_count,
+               MPI_Datatype origin_datatype, int target_rank,
+               MPI_Aint target_disp, int target_count,
+               MPI_Datatype target_datatype, MPI_Win win,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Raccumulate,
+              (const void *origin_addr, int origin_count,
+               MPI_Datatype origin_datatype, int target_rank,
+               MPI_Aint target_disp, int target_count,
+               MPI_Datatype target_datatype, MPI_Op op, MPI_Win win,
+               MPI_Request *request))
+TPUMPI_PROTO2(int, Rget_accumulate,
+              (const void *origin_addr, int origin_count,
+               MPI_Datatype origin_datatype, void *result_addr,
+               int result_count, MPI_Datatype result_datatype,
+               int target_rank, MPI_Aint target_disp, int target_count,
+               MPI_Datatype target_datatype, MPI_Op op, MPI_Win win,
+               MPI_Request *request))
+
+/* MPI-IO breadth */
+TPUMPI_PROTO2(int, File_delete, (const char *filename, MPI_Info info))
+TPUMPI_PROTO2(int, File_sync, (MPI_File fh))
+TPUMPI_PROTO2(int, File_preallocate, (MPI_File fh, MPI_Offset size))
+TPUMPI_PROTO2(int, File_get_amode, (MPI_File fh, int *amode))
+TPUMPI_PROTO2(int, File_set_atomicity, (MPI_File fh, int flag))
+TPUMPI_PROTO2(int, File_get_atomicity, (MPI_File fh, int *flag))
+TPUMPI_PROTO2(int, File_get_position, (MPI_File fh, MPI_Offset *offset))
+TPUMPI_PROTO2(int, File_get_byte_offset,
+              (MPI_File fh, MPI_Offset offset, MPI_Offset *disp))
+TPUMPI_PROTO2(int, File_get_type_extent,
+              (MPI_File fh, MPI_Datatype datatype, MPI_Aint *extent))
+TPUMPI_PROTO2(int, File_write_all,
+              (MPI_File fh, const void *buf, int count,
+               MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO2(int, File_read_all, (MPI_File fh, void *buf, int count,
+                                   MPI_Datatype datatype,
+                                   MPI_Status *status))
+TPUMPI_PROTO2(int, File_write_shared,
+              (MPI_File fh, const void *buf, int count,
+               MPI_Datatype datatype, MPI_Status *status))
+TPUMPI_PROTO2(int, File_read_shared,
+              (MPI_File fh, void *buf, int count, MPI_Datatype datatype,
+               MPI_Status *status))
+TPUMPI_PROTO2(int, File_seek_shared,
+              (MPI_File fh, MPI_Offset offset, int whence))
+TPUMPI_PROTO2(int, File_get_position_shared,
+              (MPI_File fh, MPI_Offset *offset))
+TPUMPI_PROTO2(int, File_iwrite_at,
+              (MPI_File fh, MPI_Offset offset, const void *buf, int count,
+               MPI_Datatype datatype, MPI_Request *request))
+TPUMPI_PROTO2(int, File_iread_at,
+              (MPI_File fh, MPI_Offset offset, void *buf, int count,
+               MPI_Datatype datatype, MPI_Request *request))
+TPUMPI_PROTO2(int, File_iwrite, (MPI_File fh, const void *buf, int count,
+                                 MPI_Datatype datatype,
+                                 MPI_Request *request))
+TPUMPI_PROTO2(int, File_iread, (MPI_File fh, void *buf, int count,
+                                MPI_Datatype datatype, MPI_Request *request))
+TPUMPI_PROTO2(int, File_get_group, (MPI_File fh, MPI_Group *group))
+TPUMPI_PROTO2(int, File_set_info, (MPI_File fh, MPI_Info info))
+TPUMPI_PROTO2(int, File_get_info, (MPI_File fh, MPI_Info *info_used))
+TPUMPI_PROTO2(int, File_get_view,
+              (MPI_File fh, MPI_Offset *disp, MPI_Datatype *etype,
+               MPI_Datatype *filetype, char *datarep))
+
+#undef TPUMPI_PROTO2
 #undef TPUMPI_PROTO
 
 #ifdef __cplusplus

@@ -104,6 +104,13 @@ class MultiProcComm(PersistentP2PMixin):
 
         return MultiProcWin(self, bases, name)
 
+    def win_allocate(self, size: int, dtype=np.float32, name: str = ""):
+        """MPI_Win_allocate: the window owns its memory (one buffer per
+        local rank), exposed over the DCN like win_create."""
+        bases = [np.zeros(max(int(size), 1), dtype)
+                 for _ in range(self.local_size)]
+        return self.win_create(bases, name)
+
     def _next_spawn(self) -> int:
         """Per-comm spawn counter (SPMD-agreed, names the child world's
         KVS namespace)."""

@@ -30,6 +30,7 @@ def native_bins():
     bins = {}
     for name, src in [
         ("c_suite", "examples/c_suite.c"),
+        ("c_suite2", "examples/c_suite2.c"),
         ("hello_ring", "examples/hello_ring.c"),
         ("pmpi_counter", "examples/pmpi_counter.c"),
         ("osu_allreduce", "bench/osu_allreduce.c"),
@@ -135,3 +136,32 @@ def test_c_comm_spawn(native_bins):
     assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
     assert sum("SPAWN_PARENT_OK" in l for l in out.splitlines()) == 2
     assert sum("SPAWN_CHILD_OK" in l for l in out.splitlines()) == 2
+
+
+@pytest.mark.parametrize("nprocs", [2, 3])
+def test_c_suite2_round3_breadth(native_bins, nprocs):
+    """Round-3 C ABI breadth: pack/unpack, alltoallv, attrs/keyvals,
+    Info, persistent p2p, sendrecv_replace, testsome, mprobe/mrecv,
+    cart_sub/topo_test, lock_all/get_accumulate/CAS, win_allocate,
+    resized/subarray datatypes, error classes, handle conversions."""
+    res = tpurun(nprocs, native_bins["c_suite2"])
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert sum("SUITE2 COMPLETE" in l for l in out.splitlines()) == 1
+    assert "FAIL" not in out
+
+
+def test_symbol_count_geq_250(native_bins):
+    """SURVEY 2.1 row 1: the conformance-relevant C ABI surface.
+    The reference exports 432 MPI_* weak symbols; VERDICT r2 set the
+    round-3 bar at >= 250."""
+    import subprocess
+
+    out = subprocess.run(
+        ["nm", "-D", "--defined-only",
+         str(REPO / "native" / "build" / "libtpumpi.so")],
+        capture_output=True, text=True, check=True).stdout
+    syms = {l.split()[2] for l in out.splitlines()
+            if len(l.split()) == 3 and l.split()[1] == "W"
+            and l.split()[2].startswith("MPI_")}
+    assert len(syms) >= 250, f"only {len(syms)} MPI_* weak symbols"
