@@ -338,9 +338,12 @@ def _tpurun_env() -> dict:
 
 
 def _run_tpurun(np_: int, target: str, args: list[str] | None = None,
-                timeout: int = 300) -> str:
+                timeout: int = 300, mca: dict | None = None) -> str:
     cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
-           "--cpu-devices", "1", target] + [str(a) for a in (args or [])]
+           "--cpu-devices", "1"]
+    for k, v in (mca or {}).items():
+        cmd += ["--mca", k, str(v)]
+    cmd += [target] + [str(a) for a in (args or [])]
     res = subprocess.run(cmd, capture_output=True, timeout=timeout,
                          env=_tpurun_env(), cwd=str(REPO))
     if res.returncode != 0:
@@ -352,11 +355,18 @@ def _run_tpurun(np_: int, target: str, args: list[str] | None = None,
 
 
 def dcn_rows() -> dict:
-    out = _run_tpurun(2, str(REPO / "tools" / "bench_dcn.py"))
-    for line in out.splitlines():
-        if "DCNBENCH " in line:
-            return json.loads(line.split("DCNBENCH ", 1)[1])
-    raise RuntimeError(f"no DCNBENCH line in output:\n{out[-2000:]}")
+    """np=2 loopback rows for BOTH transports: btl/tcp (default) and
+    btl/sm (unix sockets + single-copy shared-memory payloads)."""
+    out = {}
+    for name, mca in (("tcp", None), ("sm", {"btl": "sm"})):
+        text = _run_tpurun(2, str(REPO / "tools" / "bench_dcn.py"), mca=mca)
+        for line in text.splitlines():
+            if "DCNBENCH " in line:
+                out[name] = json.loads(line.split("DCNBENCH ", 1)[1])
+                break
+        else:
+            raise RuntimeError(f"no DCNBENCH line ({name}):\n{text[-2000:]}")
+    return out
 
 
 def capi_rows(max_bytes: int = 4096, iters: int = 400) -> dict:

@@ -47,6 +47,8 @@ class DcnCollEngine:
         frag_size: int = tcp_mod.FRAG_SIZE,
         max_rndv: int = tcp_mod.MAX_RNDV,
         ring_threshold: int = 64 << 10,
+        transport: str = "tcp",
+        shm_threshold: int = 2 << 20,
     ):
         self.proc = proc
         self.nprocs = nprocs
@@ -71,12 +73,22 @@ class DcnCollEngine:
         #: buffered forever (cids are never reused — comm.py counter)
         self._p2p_closed: set[int] = set()
         self._p2p_lock = threading.Lock()
-        self.transport = TcpTransport(
-            self._on_frame,
-            eager_limit=eager_limit,
-            frag_size=frag_size,
-            max_rndv=max_rndv,
-        )
+        if transport == "sm":
+            # btl/sm: unix-socket framing + single-copy shm payloads
+            self.transport = tcp_mod.ShmTransport(
+                self._on_frame,
+                eager_limit=eager_limit,
+                frag_size=frag_size,
+                max_rndv=max_rndv,
+                shm_threshold=shm_threshold,
+            )
+        else:
+            self.transport = TcpTransport(
+                self._on_frame,
+                eager_limit=eager_limit,
+                frag_size=frag_size,
+                max_rndv=max_rndv,
+            )
 
     def set_addresses(self, addresses: Sequence[str]) -> None:
         if len(addresses) != self.nprocs:

@@ -54,3 +54,33 @@ class DcnTcpComponent(Component):
             "max_rndv": store.get("btl_tcp_max_rndv"),
             "ring_threshold": store.get("btl_tcp_ring_threshold"),
         }
+
+
+@register_component
+class DcnShmComponent(DcnTcpComponent):
+    """``btl/sm`` — same-host shared-memory transport (single-copy bulk
+    payloads over /dev/shm, abstract unix sockets for framing).
+
+    Priority below tcp: the modex address only resolves on one host, so
+    the reference's reachability logic collapses to explicit selection
+    (``--mca btl sm``) until the multi-host launch leg exists.
+    Inherits the tcp knob family; adds the copy-in threshold.
+    """
+
+    NAME = "sm"
+    PRIORITY = 40
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "btl", "sm", "shm_threshold", 2 << 20, type="int",
+            help="Smallest payload (bytes) moved through the shared-"
+            "memory ring instead of inline on the unix socket (measured "
+            "crossover: kernel socket copies win below ~2 MiB)",
+        )
+
+    def params(self, store) -> dict:
+        p = super().params(store)
+        p["transport"] = "sm"
+        p["shm_threshold"] = store.get("btl_sm_shm_threshold")
+        return p

@@ -41,7 +41,7 @@ import numpy as np
 #: raw length is 64-bit — protocol v2.
 _HDR = struct.Struct("!BIIQ")
 
-_EAGER, _RTS, _CTS, _FRAG = 0, 1, 2, 3
+_EAGER, _RTS, _CTS, _FRAG, _SHMF = 0, 1, 2, 3, 4
 
 #: defaults; overridable per-transport (MCA vars btl_tcp_*)
 EAGER_LIMIT = 4 << 20
@@ -125,11 +125,7 @@ class TcpTransport:
         #: payload bytes pushed through send() — the wire-cost meter the
         #: asymptotic regression tests (han reduce/scan) assert against
         self.bytes_sent = 0
-        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listen.bind((host, 0))
-        self._listen.listen(64)
-        self.address = "%s:%d" % self._listen.getsockname()
+        self._listen, self.address = self._make_listen(host)
         self._peers: dict[str, tuple[socket.socket, threading.Lock]] = {}
         self._lock = threading.Lock()
         self._running = True
@@ -143,6 +139,26 @@ class TcpTransport:
         self._rndv_slots = threading.BoundedSemaphore(max(1, int(max_rndv)))
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
+    def _make_listen(self, host: str):
+        """Bind the listen endpoint; subclasses pick the socket family
+        (≈ the btl component choosing its wire)."""
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind((host, 0))
+        lst.listen(64)
+        return lst, "%s:%d" % lst.getsockname()
+
+    def _connect(self, address: str) -> socket.socket:
+        if address.startswith("unix:@"):
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect("\0" + address[len("unix:@"):])
+            return sock
+        host, port = address.rsplit(":", 1)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.connect((host, int(port)))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
     # -- receive side ---------------------------------------------------
 
     def _accept_loop(self) -> None:
@@ -151,8 +167,12 @@ class TcpTransport:
                 conn, _ = self._listen.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if conn.family == socket.AF_INET:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._recv_loop, args=(conn,), daemon=True).start()
+
+    def _recv_shm(self, env: dict, meta: bytes, rlen: int) -> np.ndarray:
+        raise KeyError("SHMF frame on a transport without shared memory")
 
     def _deliver(self, env: dict, payload: np.ndarray) -> None:
         import sys
@@ -183,6 +203,8 @@ class TcpTransport:
                         if rlen:
                             _recv_into(conn, memoryview(arr).cast("B"))
                         self._deliver(env, arr)
+                    elif ftype == _SHMF:
+                        self._deliver(env, self._recv_shm(env, meta, rlen))
                     elif ftype == _RTS:
                         conn_keys.add(self._on_rts(env, meta, rlen))
                     elif ftype == _CTS:
@@ -290,11 +312,7 @@ class TcpTransport:
         with self._lock:
             entry = self._peers.get(address)
             if entry is None:
-                host, port = address.rsplit(":", 1)
-                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                sock.connect((host, int(port)))
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                entry = (sock, threading.Lock())
+                entry = (self._connect(address), threading.Lock())
                 self._peers[address] = entry
             return entry
 
@@ -308,6 +326,8 @@ class TcpTransport:
         sock, lock = self._peer(address)
         arr = np.ascontiguousarray(payload)
         self.bytes_sent += arr.nbytes  # benign race: diagnostic counter
+        if self._send_shm(sock, lock, address, envelope, arr):
+            return
         meta = _meta_bytes(arr)
         raw = memoryview(arr).cast("B") if arr.nbytes else memoryview(b"")
         if arr.nbytes <= self.eager_limit:
@@ -349,6 +369,11 @@ class TcpTransport:
             with lock:
                 sock.sendall(_HDR.pack(_FRAG, len(env_b), 0, len(chunk)) + env_b)
                 sock.sendall(chunk)
+
+    def _send_shm(self, sock, lock, address: str, envelope: dict,
+                  arr: np.ndarray) -> bool:
+        """Shared-memory bulk path hook; the TCP transport has none."""
+        return False
 
     def _await_cts(self, ev: threading.Event, sock: socket.socket,
                    address: str, timeout: float = 600.0) -> None:
@@ -406,3 +431,192 @@ class TcpTransport:
                 except OSError:
                     pass
             self._peers.clear()
+
+
+def _untrack_shm(name: str) -> None:
+    """Detach a segment from this process's resource tracker: segment
+    lifetime is protocol-owned (the receiver unlinks its inbound rings
+    at close), so the tracker must not also unlink at exit."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+
+
+class _ShmRing:
+    """One-directional byte ring in a POSIX shared-memory segment —
+    the mmap FIFO at the heart of the reference's btl/sm: the sender
+    memcpys payloads in at ``head``, the receiver memcpys out and
+    publishes ``tail``; the unix-socket control frame that references
+    a ring extent is the happens-before edge (a syscall on both sides)
+    that makes the plain int64 head/tail counters safe.
+
+    Layout: [0:8) tail (receiver-owned), [8:16) head (sender-owned,
+    diagnostic), [16:) payload bytes.
+    """
+
+    HDR = 16
+
+    def __init__(self, name: str, size: int, create: bool):
+        from multiprocessing import shared_memory
+
+        self.seg = shared_memory.SharedMemory(
+            name=name, create=create, size=size + self.HDR if create else 0)
+        _untrack_shm(name)
+        self.size = self.seg.size - self.HDR
+        self._ctr = np.frombuffer(self.seg.buf, np.int64, count=2)
+        self._data = np.frombuffer(self.seg.buf, np.uint8,
+                                   offset=self.HDR)
+        if create:
+            self._ctr[:] = 0
+        self.head = int(self._ctr[1])  # sender-local cursor
+
+    # -- sender side ----------------------------------------------------
+
+    def write(self, raw: memoryview, timeout: float = 600.0) -> int:
+        """Copy ``raw`` in at the current head; returns the start
+        offset (absolute byte count, receiver takes it modulo size).
+        Blocks while the ring lacks space (receiver lagging)."""
+        import time as _time
+
+        n = len(raw)
+        deadline = _time.monotonic() + timeout
+        sleep = 0.0
+        while self.size - (self.head - int(self._ctr[0])) < n:
+            if _time.monotonic() > deadline:
+                raise ConnectionError("shm ring full: receiver stalled")
+            _time.sleep(sleep)
+            sleep = min(0.001, sleep + 0.00005)
+        start = self.head
+        pos = start % self.size
+        first = min(n, self.size - pos)
+        self._data[pos : pos + first] = np.frombuffer(raw[:first], np.uint8)
+        if first < n:
+            self._data[: n - first] = np.frombuffer(raw[first:], np.uint8)
+        self.head = start + n
+        self._ctr[1] = self.head
+        return start
+
+    # -- receiver side --------------------------------------------------
+
+    def read(self, start: int, n: int, out: memoryview) -> None:
+        """Copy ``n`` bytes beginning at absolute offset ``start`` into
+        ``out`` and retire them (publish tail)."""
+        pos = start % self.size
+        first = min(n, self.size - pos)
+        np.frombuffer(out[:first], np.uint8)[:] = self._data[pos:pos + first]
+        if first < n:
+            np.frombuffer(out[first:], np.uint8)[:] = self._data[: n - first]
+        self._ctr[0] = start + n
+
+    def close(self, unlink: bool = False) -> None:
+        """Remove the segment NAME (frees /dev/shm on last detach); the
+        mapping itself stays valid until process exit — recv threads
+        may still be mid-read during transport shutdown, and POSIX
+        keeps unlinked mappings usable, so tearing down the views here
+        would turn a clean close into a reader race for nothing."""
+        if unlink:
+            try:
+                self.seg.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class ShmTransport(TcpTransport):
+    """``btl/sm`` — same-host transport: abstract unix-domain sockets
+    for framing/control plus bulk payloads through persistent
+    per-connection shared-memory RINGS (one memcpy in, one out, no
+    kernel socket copies and no per-transfer segment churn).
+
+    ≈ ``opal/mca/btl/sm`` + ``smsc`` (SURVEY.md §2.3 rows 34/37): the
+    mmap FIFO data movement of the reference's shared-memory BTL.  The
+    frame protocol is unchanged (same envelopes, same matching), so
+    every pml/han/osc layer above works identically.  Payloads below
+    ``shm_threshold`` stay inline on the unix socket.
+
+    Selected via ``--mca btl sm`` (single-host jobs only — the modex
+    address is meaningless across hosts).
+    """
+
+    RING_SIZE = 32 << 20
+
+    def __init__(self, handler, host: str = "127.0.0.1",
+                 eager_limit: int = EAGER_LIMIT, frag_size: int = FRAG_SIZE,
+                 max_rndv: int = MAX_RNDV, shm_threshold: int = 2 << 20):
+        self.shm_threshold = int(shm_threshold)
+        #: sender side: peer address → _ShmRing (created on first bulk
+        #: send, announced to the receiver in the frame envelope)
+        self._tx_rings: dict[str, _ShmRing] = {}
+        #: receiver side: ring name → _ShmRing
+        self._rx_rings: dict[str, _ShmRing] = {}
+        self._ring_lock = threading.Lock()
+        super().__init__(handler, host=host, eager_limit=eager_limit,
+                         frag_size=frag_size, max_rndv=max_rndv)
+
+    def _make_listen(self, host: str):
+        import os
+
+        lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        name = f"ompi-tpu-{os.getpid()}-{id(self) & 0xffffff:x}"
+        lst.bind("\0" + name)  # abstract namespace: no fs cleanup
+        lst.listen(64)
+        return lst, "unix:@" + name
+
+    def _tx_ring(self, address: str) -> "_ShmRing":
+        import os
+
+        with self._ring_lock:
+            ring = self._tx_rings.get(address)
+            if ring is None:
+                name = (f"ompitpu-{os.getpid()}-"
+                        f"{len(self._tx_rings)}-{id(self) & 0xffff:x}")
+                ring = _ShmRing(name, self.RING_SIZE, create=True)
+                ring.name = name
+                self._tx_rings[address] = ring
+            return ring
+
+    def _send_shm(self, sock, lock, address: str, envelope: dict,
+                  arr: np.ndarray) -> bool:
+        if arr.nbytes < self.shm_threshold or arr.nbytes > self.RING_SIZE:
+            return False  # tiny: socket inline; giant: rendezvous path
+        ring = self._tx_ring(address)
+        raw = memoryview(np.ascontiguousarray(arr)).cast("B")
+        env = dict(envelope)
+        env["shm_ring"] = ring.name
+        with lock:  # ring order must match frame order on the socket
+            start = ring.write(raw)
+            env["shm_off"] = start
+            env_b = json.dumps(env).encode()
+            meta = _meta_bytes(arr)
+            sock.sendall(
+                _HDR.pack(_SHMF, len(env_b), len(meta), arr.nbytes)
+                + env_b + meta)
+        return True
+
+    def _recv_shm(self, env: dict, meta: bytes, rlen: int) -> np.ndarray:
+        name = env.pop("shm_ring")
+        start = env.pop("shm_off")
+        with self._ring_lock:
+            ring = self._rx_rings.get(name)
+            if ring is None:
+                ring = _ShmRing(name, 0, create=False)
+                self._rx_rings[name] = ring
+        arr = _alloc_from_meta(meta)
+        if rlen:
+            ring.read(start, rlen, memoryview(arr).cast("B"))
+        return arr
+
+    def close(self) -> None:
+        super().close()
+        with self._ring_lock:
+            # both sides unlink: POSIX keeps live mappings valid after
+            # unlink, and the double-unlink is caught — so segments die
+            # with the FIRST clean close even if the peer crashed.  The
+            # ring dicts are intentionally NOT cleared: recv threads
+            # drain in-flight frames against the still-mapped rings.
+            for ring in self._tx_rings.values():
+                ring.close(unlink=True)
+            for ring in self._rx_rings.values():
+                ring.close(unlink=True)

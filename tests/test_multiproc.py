@@ -528,3 +528,49 @@ def test_tpurun_memchecker_inflight_mutation():
                   "memchk_restored", "memchk_clean", "finalize"):
         hits = [l for l in out.splitlines() if f"OK {check} " in l]
         assert len(hits) == 2, f"{check}: {hits}\n{out}"
+
+
+def test_dcn_shm_transport_engines():
+    """btl/sm unit leg: unix-socket framing + shared-memory bulk
+    payloads between in-process engines (both below and above the shm
+    threshold, plus the ring-allreduce path riding on it)."""
+    from ompi_tpu.dcn.collops import DcnCollEngine
+    from ompi_tpu.op import SUM
+
+    n = 3
+    engines = [DcnCollEngine(p, n, transport="sm", shm_threshold=1024)
+               for p in range(n)]
+    try:
+        for e in engines:
+            e.set_addresses([x.address for x in engines])
+        assert engines[0].address.startswith("unix:@")
+        results = [None] * n
+
+        def work(p):
+            small = np.full(16, float(p + 1))           # below threshold
+            big = np.full(4096, float(p + 1))           # shm path
+            a = engines[p].allreduce(small, SUM, cid=1)
+            b = engines[p].allreduce(big, SUM, cid=1)
+            results[p] = (a, b)
+
+        ts = [threading.Thread(target=work, args=(p,)) for p in range(n)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        for r in results:
+            assert r is not None, "engine thread hung"
+            np.testing.assert_array_equal(r[0], np.full(16, 6.0))
+            np.testing.assert_array_equal(r[1], np.full(4096, 6.0))
+    finally:
+        for e in engines:
+            e.close()
+
+
+def test_tpurun_btl_sm_selected():
+    """--mca btl sm: the full multi-process stack over the shared-memory
+    transport (same worker as the TCP leg)."""
+    res = run_tpurun(2, WORKER, cpu_devices=1, mca={"btl": "sm"})
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("allreduce", "alltoall", "barrier", "finalize"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 2, f"{check}: {hits}\n{out}"
