@@ -37,6 +37,46 @@ def _forward(stream, prefix: str, out) -> None:
         out.flush()
 
 
+#: host names the plm treats as THIS machine (fork instead of rsh)
+_LOCAL_NAMES = {"localhost", "127.0.0.1"}
+
+
+def _is_local_host(name: str) -> bool:
+    import socket as _socket
+
+    return name in _LOCAL_NAMES or name == _socket.gethostname()
+
+
+def _remote_cmd(agent: str, host: str, env: dict, keys: list[str],
+                cmd: list[str]) -> list[str]:
+    """plm/rsh command line: the launch agent template (default
+    ``ssh {host} {cmd}``) wrapping an env-exporting sh -c payload —
+    the reference's rsh tree-launch collapsed to one level (no daemon
+    on the remote side; workers dial the KVS directly, exactly like
+    the local fork leg)."""
+    import shlex
+
+    exports = " ".join(
+        f"{k}={shlex.quote(env[k])}" for k in keys if k in env
+    )
+    payload = f"cd {shlex.quote(os.getcwd())} && env {exports} " + " ".join(
+        shlex.quote(c) for c in cmd
+    )
+    out = []
+    used_cmd = False
+    for tok in shlex.split(agent):
+        if tok == "{host}":
+            out.append(host)
+        elif tok == "{cmd}":
+            out.append(payload)
+            used_cmd = True
+        else:
+            out.append(tok)
+    if not used_cmd:
+        out.append(payload)
+    return out
+
+
 def run_job(
     np_: int,
     argv: list[str],
@@ -44,14 +84,44 @@ def run_job(
     cpu_devices: int | None = None,
     extra_env: dict[str, str] | None = None,
     ft: bool = False,
+    hosts: list[tuple[str, int]] | None = None,
+    map_by: str = "slot",
+    launch_agent: str = "ssh {host} {cmd}",
+    oversubscribe: bool = False,
+    display_map: bool = False,
+    kvs_host: str | None = None,
 ) -> int:
     """``ft=True`` ≈ ``mpirun --with-ft ulfm``: worker death does NOT
     kill the job (survivors run ULFM recovery); the heartbeat detector
-    is enabled in every worker and the job's exit code is rank 0's."""
+    is enabled in every worker and the job's exit code is rank 0's.
+
+    ``hosts`` engages the plm/rsh leg: ranks map onto the allocation
+    via the rmaps policy (``map_by``); non-local hosts launch through
+    ``launch_agent`` (``ssh {host} {cmd}``; any template works — e.g.
+    ``bash -c {cmd}`` exercises the full rsh path against this host).
+    ``kvs_host``: address the KVS server binds/advertises (must be
+    reachable from every host; default loopback is single-host only).
+    """
     if ft:
         mca = dict(mca or {})
         mca.setdefault("ft_detector_enable", "1")
-    server = KVSServer()
+    rank_host: list[str] | None = None
+    if hosts:
+        from .rmaps import map_ranks, render_map
+
+        rank_host = map_ranks(hosts, np_, policy=map_by,
+                              oversubscribe=oversubscribe)
+        if display_map:
+            print(render_map(rank_host), flush=True)
+        if kvs_host is None and any(
+            not _is_local_host(h) for h in rank_host
+        ):
+            raise SystemExit(
+                "tpurun: remote hosts in the map but no --kvs-host — the "
+                "rendezvous server would advertise 127.0.0.1, unreachable "
+                "from the remote side; pass --kvs-host <routable address>"
+            )
+    server = KVSServer(host=kvs_host or "127.0.0.1")
     procs: list[subprocess.Popen] = []
     threads: list[threading.Thread] = []
     # workers must find the framework regardless of script location
@@ -96,6 +166,15 @@ def run_job(
                 # absolute path: a bare filename would hit execvp PATH
                 # lookup instead of the file we just stat'ed
                 cmd = [os.path.abspath(first)] + argv[1:]
+            target = rank_host[rank] if rank_host else None
+            if target is not None and not _is_local_host(target):
+                # plm/rsh: reproduce the worker env on the remote host
+                keys = sorted(
+                    k for k in env
+                    if k.startswith(("OMPI_TPU_", "OMPI_MCA_"))
+                    or k in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
+                )
+                cmd = _remote_cmd(launch_agent, target, env, keys, cmd)
             p = subprocess.Popen(
                 cmd,
                 env=env,
@@ -162,12 +241,56 @@ def main(argv: list[str] | None = None) -> int:
         help="fault-tolerant job: worker death does not kill the job; "
         "heartbeat failure detection + ULFM recovery in the workers",
     )
+    parser.add_argument(
+        "--host", default=None, metavar="H1[:S],H2[:S],...",
+        help="host allocation (':S' = slots); engages the rsh launch leg "
+        "for non-local hosts",
+    )
+    parser.add_argument(
+        "--hostfile", default=None,
+        help="hostfile ('host [slots=N]' per line)",
+    )
+    parser.add_argument(
+        "--map-by", default="slot", metavar="slot|node|ppr:N|seq",
+        help="rank mapping policy over the allocation (rmaps)",
+    )
+    parser.add_argument(
+        "--launch-agent", default="ssh {host} {cmd}",
+        help="remote launch template; {host}/{cmd} substituted "
+        "(default 'ssh {host} {cmd}')",
+    )
+    parser.add_argument(
+        "--oversubscribe", action="store_true",
+        help="allow more ranks than allocated slots",
+    )
+    parser.add_argument(
+        "--display-map", action="store_true",
+        help="print the rank->host map before launching",
+    )
+    parser.add_argument(
+        "--kvs-host", default=None,
+        help="address the KVS/rendezvous server binds (must be reachable "
+        "from every host; default 127.0.0.1 is single-host)",
+    )
     parser.add_argument("script", help="python script to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(argv)
     mca = {k: v for k, v in ns.mca}
+    hosts = None
+    if ns.hostfile:
+        from .rmaps import parse_hostfile
+
+        with open(ns.hostfile) as f:
+            hosts = parse_hostfile(f.read())
+    elif ns.host:
+        from .rmaps import parse_host_list
+
+        hosts = parse_host_list(ns.host)
     return run_job(ns.np, [ns.script] + ns.args, mca, ns.cpu_devices,
-                   ft=ns.ft)
+                   ft=ns.ft, hosts=hosts, map_by=ns.map_by,
+                   launch_agent=ns.launch_agent,
+                   oversubscribe=ns.oversubscribe,
+                   display_map=ns.display_map, kvs_host=ns.kvs_host)
 
 
 if __name__ == "__main__":

@@ -106,3 +106,35 @@ def test_capture_tool_is_rerunnable_if_reference_present():
             committed = json.load(f)
         assert (fresh["cases"]["MPI_SUM:float32"]["result_hex"]
                 == committed["cases"]["MPI_SUM:float32"]["result_hex"])
+
+
+def _singleton_cases():
+    with open(GOLDEN) as f:
+        data = json.load(f)
+    for name, c in sorted(data.get("singleton_colls", {}).items()):
+        dt = np.dtype(DTYPES[c["dtype"]])
+        x = np.frombuffer(bytes.fromhex(c["input_hex"]), dt)
+        ref = np.frombuffer(bytes.fromhex(c["result_hex"]), dt)
+        yield name, c["coll"], OPS[c["op"]], x, ref
+
+
+_SINGLETON = list(_singleton_cases())
+
+
+@pytest.mark.parametrize(
+    "name,coll,op,x,ref", _SINGLETON, ids=[c[0] for c in _SINGLETON],
+)
+def test_singleton_collective_bit_parity(devices, name, coll, op, x, ref):
+    """np=1 collective goldens from the installed reference (mpirun is
+    absent on this host, so the 4-rank coll/tuned golden BASELINE.md
+    names cannot be captured — this is the honest substitute, running
+    the reference's full comm + coll-selection + op dispatch path;
+    multi-rank ORDER parity is covered by the Reduce_local folds)."""
+    world = api.init()
+    self_comm = api.comm_self()
+    fn = getattr(self_comm, coll)
+    out = np.asarray(fn(x[None, :].copy(), op))
+    np.testing.assert_array_equal(
+        out.reshape(-1).view(np.uint8), ref.view(np.uint8),
+        err_msg=f"bit mismatch vs reference singleton {name}",
+    )

@@ -1,0 +1,134 @@
+"""rmaps mapping policies + the plm/rsh launch leg.
+
+The mapper is tested as pure functions (the reference dry-runs mappers
+with ``prte --display map --do-not-launch`` — SURVEY.md §4); the rsh
+leg runs END TO END against this host through a local launch agent
+(``bash -c {cmd}``), exercising command templating, env reproduction,
+remote cwd, and the KVS dial-back — everything a real ssh leg does
+except the network hop.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ompi_tpu.boot.rmaps import (
+    map_ranks,
+    parse_host_list,
+    parse_hostfile,
+    render_map,
+)
+from ompi_tpu.core.errors import MPIArgError
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_parse_hostfile():
+    text = """
+    # cluster
+    nodeA slots=4
+    nodeB
+    nodeC slots=2  # trailing comment
+    """
+    assert parse_hostfile(text) == [("nodeA", 4), ("nodeB", 1), ("nodeC", 2)]
+
+
+def test_parse_host_list():
+    assert parse_host_list("a,b:4,c") == [("a", 1), ("b", 4), ("c", 1)]
+
+
+def test_map_byslot_fills_hosts_in_order():
+    hosts = [("a", 2), ("b", 2)]
+    assert map_ranks(hosts, 3, "slot") == ["a", "a", "b"]
+    assert map_ranks(hosts, 4, "slot") == ["a", "a", "b", "b"]
+
+
+def test_map_bynode_round_robins():
+    hosts = [("a", 2), ("b", 2)]
+    assert map_ranks(hosts, 4, "node") == ["a", "b", "a", "b"]
+
+
+def test_map_ppr():
+    hosts = [("a", 4), ("b", 4)]
+    assert map_ranks(hosts, 6, "ppr:2") == ["a", "a", "b", "b", "a", "a"]
+
+
+def test_map_seq():
+    hosts = [("x", 1), ("y", 1), ("x", 1)]
+    assert map_ranks(hosts, 3, "seq") == ["x", "y", "x"]
+
+
+def test_map_slot_bound_and_oversubscribe():
+    hosts = [("a", 1), ("b", 1)]
+    with pytest.raises(MPIArgError):
+        map_ranks(hosts, 3, "slot")
+    assert map_ranks(hosts, 3, "slot", oversubscribe=True) == ["a", "b", "a"]
+    with pytest.raises(MPIArgError):
+        map_ranks(hosts, 3, "node")
+    assert map_ranks(hosts, 4, "node", oversubscribe=True) == \
+        ["a", "b", "a", "b"]
+
+
+def test_map_policy_errors():
+    with pytest.raises(MPIArgError):
+        map_ranks([], 2)
+    with pytest.raises(MPIArgError):
+        map_ranks([("a", 4)], 2, "bogus")
+    with pytest.raises(MPIArgError):
+        map_ranks([("a", 4)], 2, "ppr:x")
+    with pytest.raises(MPIArgError):
+        map_ranks([("a", 1)], 2, "seq")
+
+
+def test_render_map():
+    text = render_map(["a", "a", "b"])
+    assert "host a: ranks 0,1" in text and "host b: ranks 2" in text
+
+
+def test_rsh_leg_end_to_end_with_local_agent():
+    """--host fake1,fake2 + --launch-agent 'bash -c {cmd}': the full
+    rsh command path (env exports, cwd, template substitution) runs
+    against this machine; workers dial back to the KVS and complete a
+    han allreduce exactly as a two-host job would."""
+    import os
+
+    worker = REPO / "tests" / "workers" / "mp_worker.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+         "--cpu-devices", "1",
+         "--host", "fakehost1,fakehost2",
+         "--launch-agent", "bash -c {cmd}",
+         "--kvs-host", "127.0.0.1",  # local agent: loopback IS reachable
+         "--map-by", "node", "--display-map",
+         str(worker)],
+        capture_output=True, timeout=180, env=env, cwd=str(REPO),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "host fakehost1: ranks 0" in out and \
+        "host fakehost2: ranks 1" in out, out
+    assert sum("OK allreduce " in l for l in out.splitlines()) == 2
+    assert sum("OK finalize " in l for l in out.splitlines()) == 2
+
+
+def test_rsh_leg_requires_kvs_host():
+    """Remote hosts without --kvs-host must hard-error at launch (the
+    loopback rendezvous address would be unreachable remotely)."""
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+         "--host", "fakehost1,fakehost2",
+         "--launch-agent", "bash -c {cmd}",
+         str(REPO / "tests" / "workers" / "mp_worker.py")],
+        capture_output=True, timeout=60, env=env, cwd=str(REPO),
+    )
+    assert res.returncode != 0
+    assert b"--kvs-host" in res.stdout + res.stderr

@@ -111,13 +111,50 @@ def capture() -> dict:
                     "input_hex": x.tobytes().hex(),
                     "result_hex": acc.tobytes().hex(),
                 }
+        # -- singleton (np=1) collective goldens -----------------------
+        # mpirun is absent on this machine, so the 4-rank coll/tuned
+        # osu_allreduce golden BASELINE.md names cannot be produced
+        # here; the honest substitute (VERDICT r2 missing #5) is the
+        # np=1 collective surface — it runs the reference's FULL comm
+        # construction + coll selection + op dispatch, and its outputs
+        # (identity folds) are bit-comparable.  Multi-rank order
+        # coverage comes from the Reduce_local fold above (the same
+        # op kernels every coll reduction step calls).
+        comm_world = _handle(lib, "ompi_mpi_comm_world")
+        allreduce = lib.MPI_Allreduce
+        allreduce.argtypes = [ctypes.c_void_p] * 2 + [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        scan = lib.MPI_Scan
+        scan.argtypes = allreduce.argtypes
+        singleton = {}
+        for opname, opsym in OPS.items():
+            op = _handle(lib, opsym)
+            for dtname, (dtsym, dt) in DTYPES.items():
+                mpidt = _handle(lib, dtsym)
+                x = np.ascontiguousarray(make_inputs(dt)[0])
+                for fname, fn in (("allreduce", allreduce), ("scan", scan)):
+                    out = np.zeros_like(x)
+                    rc = fn(x.ctypes.data_as(ctypes.c_void_p),
+                            out.ctypes.data_as(ctypes.c_void_p),
+                            COUNT, mpidt, op, comm_world)
+                    if rc != 0:
+                        raise RuntimeError(f"MPI_{fname} rc={rc}")
+                    singleton[f"{fname}:{opname}:{dtname}"] = {
+                        "coll": fname, "op": opname, "dtype": dtname,
+                        "count": COUNT,
+                        "input_hex": x.tobytes().hex(),
+                        "result_hex": out.tobytes().hex(),
+                    }
         return {
             "provenance": {
                 "library": LIBMPI,
-                "captured_with": "MPI_Reduce_local left fold acc=op(acc, r)",
+                "captured_with": "MPI_Reduce_local left fold acc=op(acc, r)"
+                                 " + np=1 singleton collectives (no mpirun"
+                                 " on this host; see BASELINE.md)",
                 "seed": 1234,
             },
             "cases": cases,
+            "singleton_colls": singleton,
         }
     finally:
         lib.MPI_Finalize()
