@@ -1,0 +1,267 @@
+/* Conformance smoke suite #3 — the batch-2 C ABI: neighbor
+ * collectives on a cartesian ring, alltoallw, type introspection
+ * (envelope/contents/darray/match_size), generalized requests, name
+ * service, dynamic/shared windows, ordered + split-phase MPI-IO, and
+ * the MPI_T handle/category surface.  Runs at any np >= 2.
+ */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int rank, size;
+
+#define CHECK(cond, name)                                       \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      fprintf(stderr, "FAIL %s rank=%d\n", name, rank);         \
+      MPI_Abort(MPI_COMM_WORLD, 2);                             \
+    } else {                                                    \
+      printf("OK %s rank=%d\n", name, rank);                    \
+    }                                                           \
+  } while (0)
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  /* -- neighbor collectives on a periodic 1-D cart ----------------- */
+  {
+    int dims[1] = {size}, periods[1] = {1};
+    MPI_Comm ring;
+    MPI_Cart_create(MPI_COMM_WORLD, 1, dims, periods, 0, &ring);
+    int rr;
+    MPI_Comm_rank(ring, &rr);
+    int left = (rr - 1 + size) % size, right = (rr + 1) % size;
+    /* allgather: one value to both neighbors; recv [left, right] */
+    int v = 100 + rr, got[2] = {-1, -1};
+    MPI_Neighbor_allgather(&v, 1, MPI_INT, got, 1, MPI_INT, ring);
+    CHECK(got[0] == 100 + left && got[1] == 100 + right,
+          "neighbor_allgather");
+    /* alltoall: distinct block per neighbor slot.  Slot 0 = -1
+     * direction, slot 1 = +1.  recv slot 0 (from left) must be the
+     * block left addressed to its +1 slot. */
+    int sb[2] = {1000 * rr + 1, 1000 * rr + 2}, rb[2] = {-1, -1};
+    MPI_Neighbor_alltoall(sb, 1, MPI_INT, rb, 1, MPI_INT, ring);
+    CHECK(rb[0] == 1000 * left + 2 && rb[1] == 1000 * right + 1,
+          "neighbor_alltoall_mirror");
+    MPI_Comm_free(&ring);
+  }
+
+  /* -- alltoallw (mixed datatypes per block) ------------------------ */
+  {
+    int *scounts = calloc(size, sizeof(int));
+    int *sdispls = calloc(size, sizeof(int));
+    int *rcounts = calloc(size, sizeof(int));
+    int *rdispls = calloc(size, sizeof(int));
+    MPI_Datatype *st = malloc(sizeof(MPI_Datatype) * size);
+    MPI_Datatype *rt = malloc(sizeof(MPI_Datatype) * size);
+    /* to even ranks send doubles, to odd ranks send ints */
+    char sbuf[1024], rbuf[1024];
+    int soff = 0;
+    for (int j = 0; j < size; j++) {
+      st[j] = (j % 2 == 0) ? MPI_DOUBLE : MPI_INT;
+      scounts[j] = 2;
+      sdispls[j] = soff;
+      if (j % 2 == 0) {
+        double *p = (double *)(sbuf + soff);
+        p[0] = rank + 0.25;
+        p[1] = j + 0.5;
+        soff += 2 * sizeof(double);
+      } else {
+        int *p = (int *)(sbuf + soff);
+        p[0] = rank * 10;
+        p[1] = j;
+        soff += 2 * sizeof(int);
+      }
+    }
+    int roff = 0;
+    for (int j = 0; j < size; j++) {
+      rt[j] = (rank % 2 == 0) ? MPI_DOUBLE : MPI_INT;
+      rcounts[j] = 2;
+      rdispls[j] = roff;
+      roff += 2 * ((rank % 2 == 0) ? sizeof(double) : sizeof(int));
+    }
+    MPI_Alltoallw(sbuf, scounts, sdispls, st, rbuf, rcounts, rdispls, rt,
+                  MPI_COMM_WORLD);
+    int ok = 1;
+    for (int j = 0; j < size; j++) {
+      if (rank % 2 == 0) {
+        double *p = (double *)(rbuf + rdispls[j]);
+        if (p[0] != j + 0.25 || p[1] != rank + 0.5) ok = 0;
+      } else {
+        int *p = (int *)(rbuf + rdispls[j]);
+        if (p[0] != j * 10 || p[1] != rank) ok = 0;
+      }
+    }
+    CHECK(ok, "alltoallw");
+    free(scounts); free(sdispls); free(rcounts); free(rdispls);
+    free(st); free(rt);
+  }
+
+  /* -- type introspection ------------------------------------------- */
+  {
+    MPI_Datatype vec;
+    MPI_Type_vector(3, 2, 4, MPI_INT, &vec);
+    MPI_Type_commit(&vec);
+    int ni, na, nd, comb;
+    MPI_Type_get_envelope(vec, &ni, &na, &nd, &comb);
+    CHECK(comb == MPI_COMBINER_VECTOR && ni == 3 && nd == 1,
+          "type_envelope");
+    int ints[3];
+    MPI_Aint aints[1];
+    MPI_Datatype types[1];
+    MPI_Type_get_contents(vec, 3, 0, 1, ints, aints, types);
+    CHECK(ints[0] == 3 && ints[1] == 2 && ints[2] == 4 &&
+          types[0] == MPI_INT, "type_contents");
+    MPI_Type_free(&vec);
+
+    MPI_Datatype m;
+    MPI_Type_match_size(MPI_TYPECLASS_REAL, 8, &m);
+    CHECK(m == MPI_DOUBLE, "type_match_size");
+    MPI_Type_create_f90_real(10, 0, &m);
+    CHECK(m == MPI_DOUBLE, "type_f90_real");
+
+    /* darray: 1-D block distribution over `size` processes */
+    int gsize[1] = {8 * size}, distribs[1] = {MPI_DISTRIBUTE_BLOCK};
+    int dargs[1] = {MPI_DISTRIBUTE_DFLT_DARG}, psizes[1] = {size};
+    MPI_Datatype da;
+    MPI_Type_create_darray(size, rank, 1, gsize, distribs, dargs, psizes,
+                           MPI_ORDER_C, MPI_INT, &da);
+    MPI_Type_commit(&da);
+    int dsz;
+    MPI_Type_size(da, &dsz);
+    CHECK(dsz == 8 * (int)sizeof(int), "type_darray_block_size");
+    MPI_Type_free(&da);
+  }
+
+  /* -- generalized requests ----------------------------------------- */
+  {
+    MPI_Request gr;
+    MPI_Grequest_start(NULL, NULL, NULL, NULL, &gr);
+    int flag = -1;
+    MPI_Status st;
+    MPI_Request_get_status(gr, &flag, &st);
+    CHECK(flag == 0, "grequest_pending");
+    MPI_Grequest_complete(gr);
+    MPI_Wait(&gr, &st);
+    CHECK(gr == MPI_REQUEST_NULL, "grequest_completed");
+  }
+
+  /* -- name service -------------------------------------------------- */
+  {
+    char port[MPI_MAX_PORT_NAME], looked[MPI_MAX_PORT_NAME];
+    MPI_Open_port(MPI_INFO_NULL, port);
+    CHECK(strlen(port) > 0, "open_port");
+    char svc[64];
+    snprintf(svc, sizeof svc, "svc-rank-%d", rank);
+    MPI_Publish_name(svc, MPI_INFO_NULL, port);
+    MPI_Barrier(MPI_COMM_WORLD);
+    char peer_svc[64];
+    snprintf(peer_svc, sizeof peer_svc, "svc-rank-%d", (rank + 1) % size);
+    int rc = MPI_Lookup_name(peer_svc, MPI_INFO_NULL, looked);
+    CHECK(rc == MPI_SUCCESS && strlen(looked) > 0, "publish_lookup");
+    /* everyone finishes looking up before anyone unpublishes */
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPI_Unpublish_name(svc, MPI_INFO_NULL, port);
+    MPI_Barrier(MPI_COMM_WORLD);
+    rc = MPI_Lookup_name(peer_svc, MPI_INFO_NULL, looked);
+    CHECK(rc != MPI_SUCCESS || strlen(looked) == 0, "unpublish_hides");
+    MPI_Close_port(port);
+  }
+
+  /* -- dynamic + shared windows -------------------------------------- */
+  {
+    MPI_Win dwin;
+    MPI_Win_create_dynamic(MPI_INFO_NULL, MPI_COMM_WORLD, &dwin);
+    double slab[4] = {0, 0, 0, 0};
+    MPI_Win_attach(dwin, slab, sizeof slab);
+    MPI_Win_fence(0, dwin);
+    MPI_Win_fence(0, dwin);
+    MPI_Win_detach(dwin, slab);
+    MPI_Win_free(&dwin);
+    printf("OK win_dynamic rank=%d\n", rank);
+
+    MPI_Win swin;
+    void *base = NULL;
+    MPI_Win_allocate_shared(32, 1, MPI_INFO_NULL, MPI_COMM_WORLD, &base,
+                            &swin);
+    CHECK(base != NULL, "win_allocate_shared");
+    MPI_Aint qsize = 0;
+    int qdisp = 0;
+    void *qbase = NULL;
+    MPI_Win_shared_query(swin, rank, &qsize, &qdisp, &qbase);
+    CHECK(qsize >= 32 && qbase != NULL, "win_shared_query");
+    MPI_Win_free(&swin);
+  }
+
+  /* -- MPI-IO: split-phase + ordered --------------------------------- */
+  {
+    char path[128];
+    snprintf(path, sizeof path, "/tmp/tpumpi_s3_%d.bin", rank);
+    MPI_File f;
+    MPI_File_open(MPI_COMM_SELF, path,
+                  MPI_MODE_CREATE | MPI_MODE_RDWR | MPI_MODE_DELETE_ON_CLOSE,
+                  MPI_INFO_NULL, &f);
+    double w[4] = {1.5, 2.5, 3.5, 4.5};
+    MPI_File_write_at_all_begin(f, 0, w, 4, MPI_DOUBLE);
+    MPI_Status st;
+    MPI_File_write_at_all_end(f, w, &st);
+    double r4[4] = {0};
+    MPI_File_read_at_all_begin(f, 0, r4, 4, MPI_DOUBLE);
+    MPI_File_read_at_all_end(f, r4, &st);
+    CHECK(r4[0] == 1.5 && r4[3] == 4.5, "file_split_phase");
+    /* ordered write at the shared pointer (np=1 scope per file) */
+    MPI_File_seek_shared(f, 0, MPI_SEEK_SET);
+    double w2[2] = {9.5, 10.5};
+    MPI_File_write_ordered(f, w2, 2, MPI_DOUBLE, &st);
+    double r2[2] = {0};
+    MPI_File_seek_shared(f, 0, MPI_SEEK_SET);
+    MPI_File_read_ordered(f, r2, 2, MPI_DOUBLE, &st);
+    CHECK(r2[0] == 9.5 && r2[1] == 10.5, "file_ordered");
+    MPI_File_close(&f);
+  }
+
+  /* -- MPI_T handles + categories ------------------------------------ */
+  {
+    int provided;
+    MPI_T_init_thread(MPI_THREAD_SINGLE, &provided);
+    int ncvar = 0;
+    MPI_T_cvar_get_num(&ncvar);
+    CHECK(ncvar > 10, "t_cvar_num");
+    char name[256];
+    int nl = sizeof name, verb, scope, binding, dl = 0;
+    MPI_Datatype dt;
+    MPI_T_cvar_get_info(0, name, &nl, &verb, &dt, NULL, NULL, &dl,
+                        &binding, &scope);
+    CHECK(nl > 0, "t_cvar_info");
+    MPI_T_cvar_handle ch;
+    int cnt;
+    MPI_T_cvar_handle_alloc(0, NULL, &ch, &cnt);
+    int val = -1;
+    MPI_T_cvar_read(ch, &val);
+    MPI_T_cvar_handle_free(&ch);
+    printf("OK t_cvar_handle rank=%d\n", rank);
+    int ncat = 0;
+    MPI_T_category_get_num(&ncat);
+    CHECK(ncat > 0, "t_category_num");
+    char cname[256];
+    int cnl = sizeof cname, ncv, npv, ncats;
+    MPI_T_category_get_info(0, cname, &cnl, NULL, &dl, &ncv, &npv, &ncats);
+    CHECK(cnl > 0 && ncv > 0, "t_category_info");
+    int idx = -1;
+    MPI_T_category_get_index(cname, &idx);
+    CHECK(idx == 0, "t_category_index");
+    int cvars[4];
+    MPI_T_category_get_cvars(0, 4, cvars);
+    printf("OK t_category_cvars rank=%d\n", rank);
+    MPI_T_finalize();
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("SUITE3 COMPLETE\n");
+  MPI_Finalize();
+  return 0;
+}

@@ -139,6 +139,7 @@ typedef struct MPI_Status {
 #define MPI_ERR_TRUNCATE 14
 #define MPI_ERR_OTHER 15
 #define MPI_ERR_INTERN 16
+#define MPI_ERR_UNSUPPORTED_OPERATION 52
 #define MPI_ERR_LASTCODE 92
 
 #define MPI_ERRHANDLER_NULL ((MPI_Errhandler)0)
@@ -288,6 +289,7 @@ TPUMPI_PROTO(int, Graph_neighbors,
 /* MPI_T tool interface (int-flavored subset: the cvar/pvar
  * enumeration + read surface tools actually script against) */
 typedef int MPI_T_pvar_session;
+typedef int MPI_T_cvar_handle;
 typedef int MPI_T_pvar_handle;
 TPUMPI_PROTO(int, T_pvar_session_create, (MPI_T_pvar_session * session))
 TPUMPI_PROTO(int, T_pvar_session_free, (MPI_T_pvar_session * session))
@@ -1031,6 +1033,208 @@ TPUMPI_PROTO2(int, File_get_info, (MPI_File fh, MPI_Info *info_used))
 TPUMPI_PROTO2(int, File_get_view,
               (MPI_File fh, MPI_Offset *disp, MPI_Datatype *etype,
                MPI_Datatype *filetype, char *datarep))
+
+
+/* batch-2 constants */
+#define MPI_COMBINER_NAMED 1
+#define MPI_COMBINER_DUP 2
+#define MPI_COMBINER_CONTIGUOUS 3
+#define MPI_COMBINER_VECTOR 4
+#define MPI_COMBINER_HVECTOR 5
+#define MPI_COMBINER_INDEXED 6
+#define MPI_COMBINER_HINDEXED 7
+#define MPI_COMBINER_INDEXED_BLOCK 8
+#define MPI_COMBINER_HINDEXED_BLOCK 9
+#define MPI_COMBINER_STRUCT 10
+#define MPI_COMBINER_SUBARRAY 11
+#define MPI_COMBINER_DARRAY 12
+#define MPI_COMBINER_RESIZED 13
+#define MPI_COMBINER_F90_REAL 14
+#define MPI_COMBINER_F90_COMPLEX 15
+#define MPI_COMBINER_F90_INTEGER 16
+#define MPI_DISTRIBUTE_BLOCK 121
+#define MPI_DISTRIBUTE_CYCLIC 122
+#define MPI_DISTRIBUTE_NONE 123
+#define MPI_DISTRIBUTE_DFLT_DARG (-1)
+#define MPI_TYPECLASS_INTEGER 1
+#define MPI_TYPECLASS_REAL 2
+#define MPI_TYPECLASS_COMPLEX 3
+#define MPI_MAX_DATAREP_STRING 128
+
+typedef int(MPI_Datarep_conversion_function)(void *, MPI_Datatype, int,
+                                             void *, MPI_Offset, void *);
+typedef int(MPI_Datarep_extent_function)(MPI_Datatype, MPI_Aint *, void *);
+#define MPI_CONVERSION_FN_NULL ((MPI_Datarep_conversion_function *)0)
+
+#define TPUMPI_PROTO3(ret, name, args) \
+  ret MPI_##name args;                 \
+  ret PMPI_##name args;
+
+/* neighbor collectives */
+TPUMPI_PROTO3(int, Neighbor_allgather,
+              (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+               MPI_Comm))
+TPUMPI_PROTO3(int, Neighbor_allgatherv,
+              (const void *, int, MPI_Datatype, void *, const int[],
+               const int[], MPI_Datatype, MPI_Comm))
+TPUMPI_PROTO3(int, Neighbor_alltoall,
+              (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+               MPI_Comm))
+TPUMPI_PROTO3(int, Neighbor_alltoallv,
+              (const void *, const int[], const int[], MPI_Datatype, void *,
+               const int[], const int[], MPI_Datatype, MPI_Comm))
+TPUMPI_PROTO3(int, Neighbor_alltoallw,
+              (const void *, const int[], const MPI_Aint[],
+               const MPI_Datatype[], void *, const int[], const MPI_Aint[],
+               const MPI_Datatype[], MPI_Comm))
+TPUMPI_PROTO3(int, Ineighbor_allgather,
+              (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+               MPI_Comm, MPI_Request *))
+TPUMPI_PROTO3(int, Ineighbor_allgatherv,
+              (const void *, int, MPI_Datatype, void *, const int[],
+               const int[], MPI_Datatype, MPI_Comm, MPI_Request *))
+TPUMPI_PROTO3(int, Ineighbor_alltoall,
+              (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+               MPI_Comm, MPI_Request *))
+TPUMPI_PROTO3(int, Ineighbor_alltoallv,
+              (const void *, const int[], const int[], MPI_Datatype, void *,
+               const int[], const int[], MPI_Datatype, MPI_Comm,
+               MPI_Request *))
+TPUMPI_PROTO3(int, Ineighbor_alltoallw,
+              (const void *, const int[], const MPI_Aint[],
+               const MPI_Datatype[], void *, const int[], const MPI_Aint[],
+               const MPI_Datatype[], MPI_Comm, MPI_Request *))
+TPUMPI_PROTO3(int, Alltoallw,
+              (const void *, const int[], const int[], const MPI_Datatype[],
+               void *, const int[], const int[], const MPI_Datatype[],
+               MPI_Comm))
+TPUMPI_PROTO3(int, Ialltoallw,
+              (const void *, const int[], const int[], const MPI_Datatype[],
+               void *, const int[], const int[], const MPI_Datatype[],
+               MPI_Comm, MPI_Request *))
+
+/* type introspection */
+TPUMPI_PROTO3(int, Type_get_envelope,
+              (MPI_Datatype, int *, int *, int *, int *))
+TPUMPI_PROTO3(int, Type_get_contents,
+              (MPI_Datatype, int, int, int, int[], MPI_Aint[],
+               MPI_Datatype[]))
+TPUMPI_PROTO3(int, Type_create_darray,
+              (int, int, int, const int[], const int[], const int[],
+               const int[], int, MPI_Datatype, MPI_Datatype *))
+TPUMPI_PROTO3(int, Type_match_size, (int, int, MPI_Datatype *))
+TPUMPI_PROTO3(int, Type_create_f90_real, (int, int, MPI_Datatype *))
+TPUMPI_PROTO3(int, Type_create_f90_complex, (int, int, MPI_Datatype *))
+TPUMPI_PROTO3(int, Type_create_f90_integer, (int, MPI_Datatype *))
+
+/* generalized requests */
+TPUMPI_PROTO3(int, Grequest_start,
+              (MPI_Grequest_query_function *, MPI_Grequest_free_function *,
+               MPI_Grequest_cancel_function *, void *, MPI_Request *))
+TPUMPI_PROTO3(int, Grequest_complete, (MPI_Request))
+
+/* name service / DPM remainder */
+TPUMPI_PROTO3(int, Open_port, (MPI_Info, char *))
+TPUMPI_PROTO3(int, Close_port, (const char *))
+TPUMPI_PROTO3(int, Publish_name, (const char *, MPI_Info, const char *))
+TPUMPI_PROTO3(int, Unpublish_name, (const char *, MPI_Info, const char *))
+TPUMPI_PROTO3(int, Lookup_name, (const char *, MPI_Info, char *))
+TPUMPI_PROTO3(int, Comm_accept,
+              (const char *, MPI_Info, int, MPI_Comm, MPI_Comm *))
+TPUMPI_PROTO3(int, Comm_connect,
+              (const char *, MPI_Info, int, MPI_Comm, MPI_Comm *))
+TPUMPI_PROTO3(int, Comm_join, (int, MPI_Comm *))
+TPUMPI_PROTO3(int, Comm_spawn_multiple,
+              (int, char *[], char **[], const int[], const MPI_Info[],
+               int, MPI_Comm, MPI_Comm *, int[]))
+
+/* windows remainder */
+TPUMPI_PROTO3(int, Win_allocate_shared,
+              (MPI_Aint, int, MPI_Info, MPI_Comm, void *, MPI_Win *))
+TPUMPI_PROTO3(int, Win_create_dynamic, (MPI_Info, MPI_Comm, MPI_Win *))
+TPUMPI_PROTO3(int, Win_attach, (MPI_Win, void *, MPI_Aint))
+TPUMPI_PROTO3(int, Win_detach, (MPI_Win, const void *))
+TPUMPI_PROTO3(int, Win_shared_query,
+              (MPI_Win, int, MPI_Aint *, int *, void *))
+TPUMPI_PROTO3(int, Win_set_info, (MPI_Win, MPI_Info))
+TPUMPI_PROTO3(int, Win_get_info, (MPI_Win, MPI_Info *))
+
+/* MPI-IO remainder */
+TPUMPI_PROTO3(int, File_write_ordered,
+              (MPI_File, const void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_PROTO3(int, File_read_ordered,
+              (MPI_File, void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_PROTO3(int, File_iwrite_shared,
+              (MPI_File, const void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_PROTO3(int, File_iread_shared,
+              (MPI_File, void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_PROTO3(int, File_iwrite_at_all,
+              (MPI_File, MPI_Offset, const void *, int, MPI_Datatype,
+               MPI_Request *))
+TPUMPI_PROTO3(int, File_iread_at_all,
+              (MPI_File, MPI_Offset, void *, int, MPI_Datatype,
+               MPI_Request *))
+TPUMPI_PROTO3(int, File_iwrite_all,
+              (MPI_File, const void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_PROTO3(int, File_iread_all,
+              (MPI_File, void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_PROTO3(int, File_write_all_begin,
+              (MPI_File, const void *, int, MPI_Datatype))
+TPUMPI_PROTO3(int, File_write_all_end,
+              (MPI_File, const void *, MPI_Status *))
+TPUMPI_PROTO3(int, File_read_all_begin, (MPI_File, void *, int,
+                                         MPI_Datatype))
+TPUMPI_PROTO3(int, File_read_all_end, (MPI_File, void *, MPI_Status *))
+TPUMPI_PROTO3(int, File_write_at_all_begin,
+              (MPI_File, MPI_Offset, const void *, int, MPI_Datatype))
+TPUMPI_PROTO3(int, File_write_at_all_end,
+              (MPI_File, const void *, MPI_Status *))
+TPUMPI_PROTO3(int, File_read_at_all_begin,
+              (MPI_File, MPI_Offset, void *, int, MPI_Datatype))
+TPUMPI_PROTO3(int, File_read_at_all_end, (MPI_File, void *, MPI_Status *))
+TPUMPI_PROTO3(int, File_write_ordered_begin,
+              (MPI_File, const void *, int, MPI_Datatype))
+TPUMPI_PROTO3(int, File_write_ordered_end,
+              (MPI_File, const void *, MPI_Status *))
+TPUMPI_PROTO3(int, File_read_ordered_begin, (MPI_File, void *, int,
+                                             MPI_Datatype))
+TPUMPI_PROTO3(int, File_read_ordered_end, (MPI_File, void *, MPI_Status *))
+TPUMPI_PROTO3(int, Register_datarep,
+              (const char *, MPI_Datarep_conversion_function *,
+               MPI_Datarep_conversion_function *,
+               MPI_Datarep_extent_function *, void *))
+
+/* MPI_T remainder */
+TPUMPI_PROTO3(int, T_cvar_get_info,
+              (int, char *, int *, int *, MPI_Datatype *, void *, char *,
+               int *, int *, int *))
+TPUMPI_PROTO3(int, T_cvar_handle_alloc,
+              (int, void *, MPI_T_cvar_handle *, int *))
+TPUMPI_PROTO3(int, T_cvar_handle_free, (MPI_T_cvar_handle *))
+TPUMPI_PROTO3(int, T_cvar_read, (MPI_T_cvar_handle, void *))
+TPUMPI_PROTO3(int, T_cvar_write, (MPI_T_cvar_handle, const void *))
+TPUMPI_PROTO3(int, T_pvar_get_info,
+              (int, char *, int *, int *, int *, MPI_Datatype *, void *,
+               char *, int *, int *, int *, int *, int *))
+TPUMPI_PROTO3(int, T_pvar_read,
+              (MPI_T_pvar_session, MPI_T_pvar_handle, void *))
+TPUMPI_PROTO3(int, T_pvar_write,
+              (MPI_T_pvar_session, MPI_T_pvar_handle, const void *))
+TPUMPI_PROTO3(int, T_pvar_reset, (MPI_T_pvar_session, MPI_T_pvar_handle))
+TPUMPI_PROTO3(int, T_pvar_readreset,
+              (MPI_T_pvar_session, MPI_T_pvar_handle, void *))
+TPUMPI_PROTO3(int, T_enum_get_info, (int, int *, char *, int *))
+TPUMPI_PROTO3(int, T_enum_get_item, (int, int, int *, char *, int *))
+TPUMPI_PROTO3(int, T_category_get_num, (int *))
+TPUMPI_PROTO3(int, T_category_get_info,
+              (int, char *, int *, char *, int *, int *, int *, int *))
+TPUMPI_PROTO3(int, T_category_get_index, (const char *, int *))
+TPUMPI_PROTO3(int, T_category_get_cvars, (int, int, int[]))
+TPUMPI_PROTO3(int, T_category_get_pvars, (int, int, int[]))
+TPUMPI_PROTO3(int, T_category_get_categories, (int, int, int[]))
+TPUMPI_PROTO3(int, T_category_changed, (int *))
+
+#undef TPUMPI_PROTO3
 
 #undef TPUMPI_PROTO2
 #undef TPUMPI_PROTO

@@ -2844,6 +2844,715 @@ int PMPI_File_get_view(MPI_File fh, MPI_Offset *disp, MPI_Datatype *etype,
   return rc;
 }
 
+
+/* ---- batch 2: neighbor collectives ---------------------------------- */
+
+int PMPI_Neighbor_allgather(const void *sendbuf, int sendcount,
+                            MPI_Datatype sendtype, void *recvbuf,
+                            int recvcount, MPI_Datatype recvtype,
+                            MPI_Comm comm) {
+  return capi_call("neighbor_allgather", NULL, "(KiiKiii)", PTR(sendbuf),
+                   sendcount, (int)sendtype, PTR(recvbuf), recvcount,
+                   (int)recvtype, (int)comm);
+}
+
+int PMPI_Neighbor_allgatherv(const void *sendbuf, int sendcount,
+                             MPI_Datatype sendtype, void *recvbuf,
+                             const int recvcounts[], const int displs[],
+                             MPI_Datatype recvtype, MPI_Comm comm) {
+  return capi_call("neighbor_allgatherv", NULL, "(KiiKKKii)", PTR(sendbuf),
+                   sendcount, (int)sendtype, PTR(recvbuf), PTR(recvcounts),
+                   PTR(displs), (int)recvtype, (int)comm);
+}
+
+int PMPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
+                           MPI_Datatype sendtype, void *recvbuf,
+                           int recvcount, MPI_Datatype recvtype,
+                           MPI_Comm comm) {
+  return capi_call("neighbor_alltoall", NULL, "(KiiKiii)", PTR(sendbuf),
+                   sendcount, (int)sendtype, PTR(recvbuf), recvcount,
+                   (int)recvtype, (int)comm);
+}
+
+int PMPI_Neighbor_alltoallv(const void *sendbuf, const int sendcounts[],
+                            const int sdispls[], MPI_Datatype sendtype,
+                            void *recvbuf, const int recvcounts[],
+                            const int rdispls[], MPI_Datatype recvtype,
+                            MPI_Comm comm) {
+  return capi_call("neighbor_alltoallv", NULL, "(KKKiKKKii)", PTR(sendbuf),
+                   PTR(sendcounts), PTR(sdispls), (int)sendtype,
+                   PTR(recvbuf), PTR(recvcounts), PTR(rdispls),
+                   (int)recvtype, (int)comm);
+}
+
+int PMPI_Neighbor_alltoallw(const void *sendbuf, const int sendcounts[],
+                            const MPI_Aint sdispls[],
+                            const MPI_Datatype sendtypes[], void *recvbuf,
+                            const int recvcounts[], const MPI_Aint rdispls[],
+                            const MPI_Datatype recvtypes[], MPI_Comm comm) {
+  (void)sendbuf; (void)sendcounts; (void)sdispls; (void)sendtypes;
+  (void)recvbuf; (void)recvcounts; (void)rdispls; (void)recvtypes;
+  (void)comm;
+  return MPI_ERR_UNSUPPORTED_OPERATION;
+}
+
+#define TPUMPI_INEIGH(pyname, fmt, ...)                        \
+  capi_ret r;                                                  \
+  int rc = capi_call("ineighbor", &r, fmt, pyname, __VA_ARGS__); \
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0]; \
+  return rc;
+
+int PMPI_Ineighbor_allgather(const void *sendbuf, int sendcount,
+                             MPI_Datatype sendtype, void *recvbuf,
+                             int recvcount, MPI_Datatype recvtype,
+                             MPI_Comm comm, MPI_Request *request) {
+  TPUMPI_INEIGH("neighbor_allgather", "(sKiiKiii)", PTR(sendbuf), sendcount,
+                (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                (int)comm)
+}
+
+int PMPI_Ineighbor_allgatherv(const void *sendbuf, int sendcount,
+                              MPI_Datatype sendtype, void *recvbuf,
+                              const int recvcounts[], const int displs[],
+                              MPI_Datatype recvtype, MPI_Comm comm,
+                              MPI_Request *request) {
+  TPUMPI_INEIGH("neighbor_allgatherv", "(sKiiKKKii)", PTR(sendbuf),
+                sendcount, (int)sendtype, PTR(recvbuf), PTR(recvcounts),
+                PTR(displs), (int)recvtype, (int)comm)
+}
+
+int PMPI_Ineighbor_alltoall(const void *sendbuf, int sendcount,
+                            MPI_Datatype sendtype, void *recvbuf,
+                            int recvcount, MPI_Datatype recvtype,
+                            MPI_Comm comm, MPI_Request *request) {
+  TPUMPI_INEIGH("neighbor_alltoall", "(sKiiKiii)", PTR(sendbuf), sendcount,
+                (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
+                (int)comm)
+}
+
+int PMPI_Ineighbor_alltoallv(const void *sendbuf, const int sendcounts[],
+                             const int sdispls[], MPI_Datatype sendtype,
+                             void *recvbuf, const int recvcounts[],
+                             const int rdispls[], MPI_Datatype recvtype,
+                             MPI_Comm comm, MPI_Request *request) {
+  TPUMPI_INEIGH("neighbor_alltoallv", "(sKKKiKKKii)", PTR(sendbuf),
+                PTR(sendcounts), PTR(sdispls), (int)sendtype, PTR(recvbuf),
+                PTR(recvcounts), PTR(rdispls), (int)recvtype, (int)comm)
+}
+
+int PMPI_Ineighbor_alltoallw(const void *sendbuf, const int sendcounts[],
+                             const MPI_Aint sdispls[],
+                             const MPI_Datatype sendtypes[], void *recvbuf,
+                             const int recvcounts[],
+                             const MPI_Aint rdispls[],
+                             const MPI_Datatype recvtypes[], MPI_Comm comm,
+                             MPI_Request *request) {
+  (void)sendbuf; (void)sendcounts; (void)sdispls; (void)sendtypes;
+  (void)recvbuf; (void)recvcounts; (void)rdispls; (void)recvtypes;
+  (void)comm; (void)request;
+  return MPI_ERR_UNSUPPORTED_OPERATION;
+}
+
+#undef TPUMPI_INEIGH
+
+int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], const MPI_Datatype sendtypes[],
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], const MPI_Datatype recvtypes[],
+                   MPI_Comm comm) {
+  return capi_call("alltoallw", NULL, "(KKKKKKKKi)", PTR(sendbuf),
+                   PTR(sendcounts), PTR(sdispls), PTR(sendtypes),
+                   PTR(recvbuf), PTR(recvcounts), PTR(rdispls),
+                   PTR(recvtypes), (int)comm);
+}
+
+int PMPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
+                    const int sdispls[], const MPI_Datatype sendtypes[],
+                    void *recvbuf, const int recvcounts[],
+                    const int rdispls[], const MPI_Datatype recvtypes[],
+                    MPI_Comm comm, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("ialltoallw", &r, "(KKKKKKKKi)", PTR(sendbuf),
+                     PTR(sendcounts), PTR(sdispls), PTR(sendtypes),
+                     PTR(recvbuf), PTR(recvcounts), PTR(rdispls),
+                     PTR(recvtypes), (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+/* ---- type introspection -------------------------------------------- */
+
+int PMPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
+                           int *num_addresses, int *num_datatypes,
+                           int *combiner) {
+  capi_ret r;
+  int rc = capi_call("type_get_envelope", &r, "(i)", (int)datatype);
+  if (rc == MPI_SUCCESS && r.n >= 4) {
+    *num_integers = (int)r.v[0];
+    *num_addresses = (int)r.v[1];
+    *num_datatypes = (int)r.v[2];
+    *combiner = (int)r.v[3];
+  }
+  return rc;
+}
+
+int PMPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                           int max_addresses, int max_datatypes,
+                           int array_of_integers[],
+                           MPI_Aint array_of_addresses[],
+                           MPI_Datatype array_of_datatypes[]) {
+  return capi_call("type_get_contents", NULL, "(iiiiKKK)", (int)datatype,
+                   max_integers, max_addresses, max_datatypes,
+                   PTR(array_of_integers), PTR(array_of_addresses),
+                   PTR(array_of_datatypes));
+}
+
+int PMPI_Type_create_darray(int size, int rank, int ndims,
+                            const int gsizes[], const int distribs[],
+                            const int dargs[], const int psizes[],
+                            int order, MPI_Datatype oldtype,
+                            MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_create_darray", &r, "(iiiKKKKii)", size, rank,
+                     ndims, PTR(gsizes), PTR(distribs), PTR(dargs),
+                     PTR(psizes), order, (int)oldtype);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_match_size(int typeclass, int size, MPI_Datatype *datatype) {
+  capi_ret r;
+  int rc = capi_call("type_match_size", &r, "(ii)", typeclass, size);
+  if (rc == MPI_SUCCESS && r.n >= 1) *datatype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_create_f90_real(int p, int r_, MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_create_f90", &r, "(sii)", "real", p, r_);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_create_f90_complex(int p, int r_, MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_create_f90", &r, "(sii)", "complex", p, r_);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+int PMPI_Type_create_f90_integer(int r_, MPI_Datatype *newtype) {
+  capi_ret r;
+  int rc = capi_call("type_create_f90", &r, "(sii)", "integer", 0, r_);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newtype = (MPI_Datatype)r.v[0];
+  return rc;
+}
+
+/* ---- generalized requests ------------------------------------------ */
+
+int PMPI_Grequest_start(MPI_Grequest_query_function *query_fn,
+                        MPI_Grequest_free_function *free_fn,
+                        MPI_Grequest_cancel_function *cancel_fn,
+                        void *extra_state, MPI_Request *request) {
+  capi_ret r;
+  int rc = capi_call("grequest_start", &r, "(KKKK)", PTR(query_fn),
+                     PTR(free_fn), PTR(cancel_fn), PTR(extra_state));
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Grequest_complete(MPI_Request request) {
+  return capi_call("grequest_complete", NULL, "(i)", (int)request);
+}
+
+/* ---- name service / DPM remainder ---------------------------------- */
+
+int PMPI_Open_port(MPI_Info info, char *port_name) {
+  (void)info;
+  return capi_call_str("open_port", port_name, MPI_MAX_PORT_NAME, NULL,
+                       "()");
+}
+
+int PMPI_Close_port(const char *port_name) {
+  return capi_call("close_port", NULL, "(s)", port_name);
+}
+
+int PMPI_Publish_name(const char *service_name, MPI_Info info,
+                      const char *port_name) {
+  (void)info;
+  return capi_call("publish_name", NULL, "(ss)", service_name, port_name);
+}
+
+int PMPI_Unpublish_name(const char *service_name, MPI_Info info,
+                        const char *port_name) {
+  (void)info;
+  (void)port_name;
+  return capi_call("unpublish_name", NULL, "(s)", service_name);
+}
+
+int PMPI_Lookup_name(const char *service_name, MPI_Info info,
+                     char *port_name) {
+  (void)info;
+  return capi_call_str("lookup_name", port_name, MPI_MAX_PORT_NAME, NULL,
+                       "(s)", service_name);
+}
+
+int PMPI_Comm_accept(const char *port_name, MPI_Info info, int root,
+                     MPI_Comm comm, MPI_Comm *newcomm) {
+  /* cross-JOB rendezvous needs the external server the reference's
+   * ompi-server provides; within a job, spawn/intercomms cover DPM.
+   * Honest error, same boundary as an unserved reference install. */
+  (void)port_name; (void)info; (void)root; (void)comm; (void)newcomm;
+  return MPI_ERR_UNSUPPORTED_OPERATION;
+}
+
+int PMPI_Comm_connect(const char *port_name, MPI_Info info, int root,
+                      MPI_Comm comm, MPI_Comm *newcomm) {
+  (void)port_name; (void)info; (void)root; (void)comm; (void)newcomm;
+  return MPI_ERR_UNSUPPORTED_OPERATION;
+}
+
+int PMPI_Comm_join(int fd, MPI_Comm *intercomm) {
+  (void)fd; (void)intercomm;
+  return MPI_ERR_UNSUPPORTED_OPERATION;
+}
+
+int PMPI_Comm_spawn_multiple(int count, char *array_of_commands[],
+                             char **array_of_argv[],
+                             const int array_of_maxprocs[],
+                             const MPI_Info array_of_info[], int root,
+                             MPI_Comm comm, MPI_Comm *intercomm,
+                             int array_of_errcodes[]) {
+  /* single-binary subset: spawn command 0 with the summed proc count
+   * (the common launcher usage; heterogeneous binaries would need
+   * per-command argv marshalling) */
+  if (count < 1) return MPI_ERR_ARG;
+  int total = 0;
+  for (int i = 0; i < count; i++) total += array_of_maxprocs[i];
+  (void)array_of_info;
+  return PMPI_Comm_spawn(array_of_commands[0],
+                         array_of_argv ? array_of_argv[0] : NULL, total,
+                         MPI_INFO_NULL, root, comm, intercomm,
+                         array_of_errcodes);
+}
+
+/* ---- windows remainder --------------------------------------------- */
+
+int PMPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                             MPI_Comm comm, void *baseptr, MPI_Win *win) {
+  (void)info;
+  capi_ret r;
+  int rc = capi_call("win_allocate_shared", &r, "(iLi)", (int)comm,
+                     (long long)size, disp_unit);
+  if (rc == MPI_SUCCESS && r.n >= 2) {
+    *win = (MPI_Win)r.v[0];
+    *(void **)baseptr = (void *)(uintptr_t)r.v[1];
+  }
+  return rc;
+}
+
+int PMPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win) {
+  (void)info;
+  capi_ret r;
+  int rc = capi_call("win_create_dynamic", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *win = (MPI_Win)r.v[0];
+  return rc;
+}
+
+int PMPI_Win_attach(MPI_Win win, void *base, MPI_Aint size) {
+  return capi_call("win_attach", NULL, "(iKL)", (int)win, PTR(base),
+                   (long long)size);
+}
+
+int PMPI_Win_detach(MPI_Win win, const void *base) {
+  return capi_call("win_detach", NULL, "(iK)", (int)win, PTR(base));
+}
+
+int PMPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                          int *disp_unit, void *baseptr) {
+  capi_ret r;
+  int rc = capi_call("win_shared_query", &r, "(ii)", (int)win, rank);
+  if (rc == MPI_SUCCESS && r.n >= 3) {
+    *size = (MPI_Aint)r.v[0];
+    *disp_unit = (int)r.v[1];
+    *(void **)baseptr = (void *)(uintptr_t)r.v[2];
+  }
+  return rc;
+}
+
+int PMPI_Win_set_info(MPI_Win win, MPI_Info info) {
+  /* stored per-window in the attribute table (keyval 0 is reserved
+   * for the info hint set) */
+  return capi_call("attr_set", NULL, "(siiK)", "wininfo", (int)win, 0,
+                   (unsigned long long)(int)info);
+}
+
+int PMPI_Win_get_info(MPI_Win win, MPI_Info *info_used) {
+  capi_ret r;
+  int rc = capi_call("attr_get", &r, "(sii)", "wininfo", (int)win, 0);
+  if (rc == MPI_SUCCESS && r.n >= 2 && r.v[0]) {
+    /* dup the stored info: the caller owns (and frees) the result */
+    capi_ret d;
+    rc = capi_call("info_dup", &d, "(i)", (int)r.v[1]);
+    if (rc == MPI_SUCCESS && d.n >= 1) *info_used = (MPI_Info)d.v[0];
+    return rc;
+  }
+  rc = capi_call("info_create", &r, "()");
+  if (rc == MPI_SUCCESS && r.n >= 1) *info_used = (MPI_Info)r.v[0];
+  return rc;
+}
+
+/* ---- MPI-IO remainder ---------------------------------------------- */
+
+int PMPI_File_write_ordered(MPI_File fh, const void *buf, int count,
+                            MPI_Datatype datatype, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_write_ordered", &r, "(iKii)", (int)fh, PTR(buf),
+                     count, (int)datatype);
+  if (rc == MPI_SUCCESS && r.n >= 1 && status) status->_count = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_File_read_ordered(MPI_File fh, void *buf, int count,
+                           MPI_Datatype datatype, MPI_Status *status) {
+  capi_ret r;
+  int rc = capi_call("file_read_ordered", &r, "(iKii)", (int)fh, PTR(buf),
+                     count, (int)datatype);
+  if (rc == MPI_SUCCESS && r.n >= 1 && status) status->_count = (int)r.v[0];
+  return rc;
+}
+
+#define TPUMPI_FILE_IREQ(pyname, fmt, ...)                      \
+  capi_ret r;                                                   \
+  int rc = capi_call(pyname, &r, fmt, __VA_ARGS__);             \
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0]; \
+  return rc;
+
+int PMPI_File_iwrite_shared(MPI_File fh, const void *buf, int count,
+                            MPI_Datatype datatype, MPI_Request *request) {
+  TPUMPI_FILE_IREQ("file_iwrite_shared", "(iKii)", (int)fh, PTR(buf),
+                   count, (int)datatype)
+}
+
+int PMPI_File_iread_shared(MPI_File fh, void *buf, int count,
+                           MPI_Datatype datatype, MPI_Request *request) {
+  TPUMPI_FILE_IREQ("file_iread_shared", "(iKii)", (int)fh, PTR(buf), count,
+                   (int)datatype)
+}
+
+int PMPI_File_iwrite_at_all(MPI_File fh, MPI_Offset offset, const void *buf,
+                            int count, MPI_Datatype datatype,
+                            MPI_Request *request) {
+  TPUMPI_FILE_IREQ("file_iwrite_at_all", "(iLKii)", (int)fh,
+                   (long long)offset, PTR(buf), count, (int)datatype)
+}
+
+int PMPI_File_iread_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                           int count, MPI_Datatype datatype,
+                           MPI_Request *request) {
+  TPUMPI_FILE_IREQ("file_iread_at_all", "(iLKii)", (int)fh,
+                   (long long)offset, PTR(buf), count, (int)datatype)
+}
+
+int PMPI_File_iwrite_all(MPI_File fh, const void *buf, int count,
+                         MPI_Datatype datatype, MPI_Request *request) {
+  TPUMPI_FILE_IREQ("file_iwrite_all", "(iKii)", (int)fh, PTR(buf), count,
+                   (int)datatype)
+}
+
+int PMPI_File_iread_all(MPI_File fh, void *buf, int count,
+                        MPI_Datatype datatype, MPI_Request *request) {
+  TPUMPI_FILE_IREQ("file_iread_all", "(iKii)", (int)fh, PTR(buf), count,
+                   (int)datatype)
+}
+
+#undef TPUMPI_FILE_IREQ
+
+int PMPI_File_write_all_begin(MPI_File fh, const void *buf, int count,
+                              MPI_Datatype datatype) {
+  return capi_call("file_split_begin", NULL, "(isLKii)", (int)fh, "write",
+                   0LL, PTR(buf), count, (int)datatype);
+}
+
+int PMPI_File_write_all_end(MPI_File fh, const void *buf,
+                            MPI_Status *status) {
+  (void)buf;
+  capi_ret r;
+  int rc = capi_call("file_split_end", &r, "(i)", (int)fh);
+  if (rc == MPI_SUCCESS && r.n >= 1 && status) status->_count = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_File_read_all_begin(MPI_File fh, void *buf, int count,
+                             MPI_Datatype datatype) {
+  return capi_call("file_split_begin", NULL, "(isLKii)", (int)fh, "read",
+                   0LL, PTR(buf), count, (int)datatype);
+}
+
+int PMPI_File_read_all_end(MPI_File fh, void *buf, MPI_Status *status) {
+  return PMPI_File_write_all_end(fh, buf, status);
+}
+
+int PMPI_File_write_at_all_begin(MPI_File fh, MPI_Offset offset,
+                                 const void *buf, int count,
+                                 MPI_Datatype datatype) {
+  return capi_call("file_split_begin", NULL, "(isLKii)", (int)fh,
+                   "write_at", (long long)offset, PTR(buf), count,
+                   (int)datatype);
+}
+
+int PMPI_File_write_at_all_end(MPI_File fh, const void *buf,
+                               MPI_Status *status) {
+  return PMPI_File_write_all_end(fh, buf, status);
+}
+
+int PMPI_File_read_at_all_begin(MPI_File fh, MPI_Offset offset, void *buf,
+                                int count, MPI_Datatype datatype) {
+  return capi_call("file_split_begin", NULL, "(isLKii)", (int)fh,
+                   "read_at", (long long)offset, PTR(buf), count,
+                   (int)datatype);
+}
+
+int PMPI_File_read_at_all_end(MPI_File fh, void *buf, MPI_Status *status) {
+  return PMPI_File_write_all_end(fh, buf, status);
+}
+
+int PMPI_File_write_ordered_begin(MPI_File fh, const void *buf, int count,
+                                  MPI_Datatype datatype) {
+  return capi_call("file_split_begin", NULL, "(isLKii)", (int)fh,
+                   "write_ordered", 0LL, PTR(buf), count, (int)datatype);
+}
+
+int PMPI_File_write_ordered_end(MPI_File fh, const void *buf,
+                                MPI_Status *status) {
+  return PMPI_File_write_all_end(fh, buf, status);
+}
+
+int PMPI_File_read_ordered_begin(MPI_File fh, void *buf, int count,
+                                 MPI_Datatype datatype) {
+  return capi_call("file_split_begin", NULL, "(isLKii)", (int)fh,
+                   "read_ordered", 0LL, PTR(buf), count, (int)datatype);
+}
+
+int PMPI_File_read_ordered_end(MPI_File fh, void *buf, MPI_Status *status) {
+  return PMPI_File_write_all_end(fh, buf, status);
+}
+
+int PMPI_Register_datarep(
+    const char *datarep,
+    MPI_Datarep_conversion_function *read_conversion_fn,
+    MPI_Datarep_conversion_function *write_conversion_fn,
+    MPI_Datarep_extent_function *dtype_file_extent_fn, void *extra_state) {
+  (void)read_conversion_fn;
+  (void)write_conversion_fn;
+  (void)dtype_file_extent_fn;
+  (void)extra_state;
+  return capi_call("register_datarep", NULL, "(s)", datarep);
+}
+
+/* ---- MPI_T remainder ----------------------------------------------- */
+
+static int tpumpi_split3(char *buf, char **a, char **b, char **c3) {
+  *a = buf;
+  char *p = strchr(buf, '|');
+  if (!p) return 0;
+  *p = 0;
+  *b = p + 1;
+  if (c3) {
+    p = strchr(*b, '|');
+    if (p) {
+      *p = 0;
+      *c3 = p + 1;
+    } else {
+      *c3 = NULL;
+    }
+  }
+  return 1;
+}
+
+int PMPI_T_cvar_get_info(int cvar_index, char *name, int *name_len,
+                         int *verbosity, MPI_Datatype *datatype,
+                         void *enumtype, char *desc, int *desc_len,
+                         int *binding, int *scope) {
+  char buf[1024];
+  int rc = capi_call_str("t_cvar_get_info", buf, sizeof buf, NULL, "(i)",
+                         cvar_index);
+  if (rc != MPI_SUCCESS) return rc;
+  char *nm, *verb, *scp;
+  if (!tpumpi_split3(buf, &nm, &verb, &scp)) return MPI_ERR_INTERN;
+  if (name) snprintf(name, name_len && *name_len > 0 ? (size_t)*name_len
+                                                     : 256, "%s", nm);
+  if (name_len) *name_len = (int)strlen(nm);
+  if (verbosity) *verbosity = atoi(verb);
+  if (scope && scp) *scope = atoi(scp);
+  if (datatype) *datatype = MPI_INT;
+  if (enumtype) *(void **)enumtype = NULL;
+  if (desc && desc_len && *desc_len > 0) desc[0] = 0;
+  if (desc_len) *desc_len = 0;
+  if (binding) *binding = 0; /* MPI_T_BIND_NO_OBJECT */
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_handle_alloc(int cvar_index, void *obj_handle,
+                             MPI_T_cvar_handle *handle, int *count) {
+  (void)obj_handle;
+  capi_ret r;
+  int rc = capi_call("t_cvar_handle_alloc", &r, "(i)", cvar_index);
+  if (rc == MPI_SUCCESS && r.n >= 1) {
+    *handle = (MPI_T_cvar_handle)r.v[0];
+    if (count) *count = 1;
+  }
+  return rc;
+}
+
+int PMPI_T_cvar_handle_free(MPI_T_cvar_handle *handle) {
+  *handle = 0;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf) {
+  capi_ret r;
+  int rc = capi_call("t_cvar_handle_read", &r, "(i)", (int)handle);
+  if (rc == MPI_SUCCESS && r.n >= 1) *(int *)buf = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_cvar_write(MPI_T_cvar_handle handle, const void *buf) {
+  return capi_call("t_cvar_handle_write", NULL, "(ii)", (int)handle,
+                   *(const int *)buf);
+}
+
+int PMPI_T_pvar_get_info(int pvar_index, char *name, int *name_len,
+                         int *verbosity, int *var_class,
+                         MPI_Datatype *datatype, void *enumtype, char *desc,
+                         int *desc_len, int *binding, int *readonly,
+                         int *continuous, int *atomic) {
+  char buf[1024];
+  int rc = capi_call_str("t_pvar_get_info", buf, sizeof buf, NULL, "(i)",
+                         pvar_index);
+  if (rc != MPI_SUCCESS) return rc;
+  char *nm, *cls, *rest;
+  if (!tpumpi_split3(buf, &nm, &cls, &rest)) return MPI_ERR_INTERN;
+  if (name) snprintf(name, name_len && *name_len > 0 ? (size_t)*name_len
+                                                     : 256, "%s", nm);
+  if (name_len) *name_len = (int)strlen(nm);
+  if (verbosity) *verbosity = 1;
+  if (var_class) *var_class = atoi(cls);
+  if (datatype) *datatype = MPI_UINT64_T;
+  if (enumtype) *(void **)enumtype = NULL;
+  if (desc && desc_len && *desc_len > 0) desc[0] = 0;
+  if (desc_len) *desc_len = 0;
+  if (binding) *binding = 0;
+  if (readonly) *readonly = 1;
+  if (continuous) *continuous = 1;
+  if (atomic) *atomic = 0;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_read(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                     void *buf) {
+  (void)session;
+  capi_ret r;
+  int rc = capi_call("t_pvar_read", &r, "(i)", (int)handle);
+  if (rc == MPI_SUCCESS && r.n >= 1) *(long long *)buf = r.v[0];
+  return rc;
+}
+
+int PMPI_T_pvar_write(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                      const void *buf) {
+  (void)session;
+  return capi_call("t_pvar_write", NULL, "(iL)", (int)handle,
+                   (long long)*(const long long *)buf);
+}
+
+int PMPI_T_pvar_reset(MPI_T_pvar_session session,
+                      MPI_T_pvar_handle handle) {
+  (void)session;
+  return capi_call("t_pvar_reset", NULL, "(i)", (int)handle);
+}
+
+int PMPI_T_pvar_readreset(MPI_T_pvar_session session,
+                          MPI_T_pvar_handle handle, void *buf) {
+  (void)session;
+  capi_ret r;
+  int rc = capi_call("t_pvar_readreset", &r, "(i)", (int)handle);
+  if (rc == MPI_SUCCESS && r.n >= 1) *(long long *)buf = r.v[0];
+  return rc;
+}
+
+int PMPI_T_enum_get_info(int enumtype, int *num, char *name,
+                         int *name_len) {
+  (void)enumtype;
+  (void)num;
+  (void)name;
+  (void)name_len;
+  return MPI_ERR_ARG; /* no enum objects exposed (valid configuration) */
+}
+
+int PMPI_T_enum_get_item(int enumtype, int index, int *value, char *name,
+                         int *name_len) {
+  (void)enumtype; (void)index; (void)value; (void)name; (void)name_len;
+  return MPI_ERR_ARG;
+}
+
+int PMPI_T_category_get_num(int *num_cat) {
+  capi_ret r;
+  int rc = capi_call("t_category_get_num", &r, "()");
+  if (rc == MPI_SUCCESS && r.n >= 1) *num_cat = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_category_get_info(int cat_index, char *name, int *name_len,
+                             char *desc, int *desc_len, int *num_cvars,
+                             int *num_pvars, int *num_categories) {
+  char buf[1024];
+  int rc = capi_call_str("t_category_get_info", buf, sizeof buf, NULL,
+                         "(i)", cat_index);
+  if (rc != MPI_SUCCESS) return rc;
+  char *nm, *ncv, *rest;
+  if (!tpumpi_split3(buf, &nm, &ncv, &rest)) return MPI_ERR_INTERN;
+  if (name) snprintf(name, name_len && *name_len > 0 ? (size_t)*name_len
+                                                     : 256, "%s", nm);
+  if (name_len) *name_len = (int)strlen(nm);
+  if (desc && desc_len && *desc_len > 0) desc[0] = 0;
+  if (desc_len) *desc_len = 0;
+  if (num_cvars) *num_cvars = atoi(ncv);
+  if (num_pvars) *num_pvars = 0;
+  if (num_categories) *num_categories = 0;
+  return MPI_SUCCESS;
+}
+
+int PMPI_T_category_get_index(const char *name, int *cat_index) {
+  capi_ret r;
+  int rc = capi_call("t_category_get_index", &r, "(s)", name);
+  if (rc == MPI_SUCCESS && r.n >= 1) *cat_index = (int)r.v[0];
+  return rc;
+}
+
+int PMPI_T_category_get_cvars(int cat_index, int len, int indices[]) {
+  return capi_call("t_category_get_cvars", NULL, "(iiK)", cat_index, len,
+                   PTR(indices));
+}
+
+int PMPI_T_category_get_pvars(int cat_index, int len, int indices[]) {
+  return capi_call("t_category_get_pvars", NULL, "(iiK)", cat_index, len,
+                   PTR(indices));
+}
+
+int PMPI_T_category_get_categories(int cat_index, int len, int indices[]) {
+  (void)cat_index;
+  (void)len;
+  (void)indices;
+  return MPI_SUCCESS; /* flat category space: no sub-categories */
+}
+
+int PMPI_T_category_changed(int *stamp) {
+  capi_ret r;
+  int rc = capi_call("t_category_changed", &r, "()");
+  if (rc == MPI_SUCCESS && r.n >= 1) *stamp = (int)r.v[0];
+  return rc;
+}
+
 /* ---- MPI_* weak aliases over PMPI_* (profiling interposition) ----- */
 
 #define TPUMPI_WEAK(ret, name, args) \
@@ -3257,3 +3966,82 @@ TPUMPI_WEAK(int, File_get_group, (MPI_File, MPI_Group *))
 TPUMPI_WEAK(int, File_set_info, (MPI_File, MPI_Info))
 TPUMPI_WEAK(int, File_get_info, (MPI_File, MPI_Info *))
 TPUMPI_WEAK(int, File_get_view, (MPI_File, MPI_Offset *, MPI_Datatype *, MPI_Datatype *, char *))
+
+/* batch-2 aliases */
+TPUMPI_WEAK(int, Neighbor_allgather, (const void *, int, MPI_Datatype, void *, int, MPI_Datatype, MPI_Comm))
+TPUMPI_WEAK(int, Neighbor_allgatherv, (const void *, int, MPI_Datatype, void *, const int[], const int[], MPI_Datatype, MPI_Comm))
+TPUMPI_WEAK(int, Neighbor_alltoall, (const void *, int, MPI_Datatype, void *, int, MPI_Datatype, MPI_Comm))
+TPUMPI_WEAK(int, Neighbor_alltoallv, (const void *, const int[], const int[], MPI_Datatype, void *, const int[], const int[], MPI_Datatype, MPI_Comm))
+TPUMPI_WEAK(int, Neighbor_alltoallw, (const void *, const int[], const MPI_Aint[], const MPI_Datatype[], void *, const int[], const MPI_Aint[], const MPI_Datatype[], MPI_Comm))
+TPUMPI_WEAK(int, Ineighbor_allgather, (const void *, int, MPI_Datatype, void *, int, MPI_Datatype, MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Ineighbor_allgatherv, (const void *, int, MPI_Datatype, void *, const int[], const int[], MPI_Datatype, MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Ineighbor_alltoall, (const void *, int, MPI_Datatype, void *, int, MPI_Datatype, MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Ineighbor_alltoallv, (const void *, const int[], const int[], MPI_Datatype, void *, const int[], const int[], MPI_Datatype, MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Ineighbor_alltoallw, (const void *, const int[], const MPI_Aint[], const MPI_Datatype[], void *, const int[], const MPI_Aint[], const MPI_Datatype[], MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Alltoallw, (const void *, const int[], const int[], const MPI_Datatype[], void *, const int[], const int[], const MPI_Datatype[], MPI_Comm))
+TPUMPI_WEAK(int, Ialltoallw, (const void *, const int[], const int[], const MPI_Datatype[], void *, const int[], const int[], const MPI_Datatype[], MPI_Comm, MPI_Request *))
+TPUMPI_WEAK(int, Type_get_envelope, (MPI_Datatype, int *, int *, int *, int *))
+TPUMPI_WEAK(int, Type_get_contents, (MPI_Datatype, int, int, int, int[], MPI_Aint[], MPI_Datatype[]))
+TPUMPI_WEAK(int, Type_create_darray, (int, int, int, const int[], const int[], const int[], const int[], int, MPI_Datatype, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_match_size, (int, int, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_create_f90_real, (int, int, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_create_f90_complex, (int, int, MPI_Datatype *))
+TPUMPI_WEAK(int, Type_create_f90_integer, (int, MPI_Datatype *))
+TPUMPI_WEAK(int, Grequest_start, (MPI_Grequest_query_function *, MPI_Grequest_free_function *, MPI_Grequest_cancel_function *, void *, MPI_Request *))
+TPUMPI_WEAK(int, Grequest_complete, (MPI_Request))
+TPUMPI_WEAK(int, Open_port, (MPI_Info, char *))
+TPUMPI_WEAK(int, Close_port, (const char *))
+TPUMPI_WEAK(int, Publish_name, (const char *, MPI_Info, const char *))
+TPUMPI_WEAK(int, Unpublish_name, (const char *, MPI_Info, const char *))
+TPUMPI_WEAK(int, Lookup_name, (const char *, MPI_Info, char *))
+TPUMPI_WEAK(int, Comm_accept, (const char *, MPI_Info, int, MPI_Comm, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_connect, (const char *, MPI_Info, int, MPI_Comm, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_join, (int, MPI_Comm *))
+TPUMPI_WEAK(int, Comm_spawn_multiple, (int, char *[], char **[], const int[], const MPI_Info[], int, MPI_Comm, MPI_Comm *, int[]))
+TPUMPI_WEAK(int, Win_allocate_shared, (MPI_Aint, int, MPI_Info, MPI_Comm, void *, MPI_Win *))
+TPUMPI_WEAK(int, Win_create_dynamic, (MPI_Info, MPI_Comm, MPI_Win *))
+TPUMPI_WEAK(int, Win_attach, (MPI_Win, void *, MPI_Aint))
+TPUMPI_WEAK(int, Win_detach, (MPI_Win, const void *))
+TPUMPI_WEAK(int, Win_shared_query, (MPI_Win, int, MPI_Aint *, int *, void *))
+TPUMPI_WEAK(int, Win_set_info, (MPI_Win, MPI_Info))
+TPUMPI_WEAK(int, Win_get_info, (MPI_Win, MPI_Info *))
+TPUMPI_WEAK(int, File_write_ordered, (MPI_File, const void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_WEAK(int, File_read_ordered, (MPI_File, void *, int, MPI_Datatype, MPI_Status *))
+TPUMPI_WEAK(int, File_iwrite_shared, (MPI_File, const void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_WEAK(int, File_iread_shared, (MPI_File, void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_WEAK(int, File_iwrite_at_all, (MPI_File, MPI_Offset, const void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_WEAK(int, File_iread_at_all, (MPI_File, MPI_Offset, void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_WEAK(int, File_iwrite_all, (MPI_File, const void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_WEAK(int, File_iread_all, (MPI_File, void *, int, MPI_Datatype, MPI_Request *))
+TPUMPI_WEAK(int, File_write_all_begin, (MPI_File, const void *, int, MPI_Datatype))
+TPUMPI_WEAK(int, File_write_all_end, (MPI_File, const void *, MPI_Status *))
+TPUMPI_WEAK(int, File_read_all_begin, (MPI_File, void *, int, MPI_Datatype))
+TPUMPI_WEAK(int, File_read_all_end, (MPI_File, void *, MPI_Status *))
+TPUMPI_WEAK(int, File_write_at_all_begin, (MPI_File, MPI_Offset, const void *, int, MPI_Datatype))
+TPUMPI_WEAK(int, File_write_at_all_end, (MPI_File, const void *, MPI_Status *))
+TPUMPI_WEAK(int, File_read_at_all_begin, (MPI_File, MPI_Offset, void *, int, MPI_Datatype))
+TPUMPI_WEAK(int, File_read_at_all_end, (MPI_File, void *, MPI_Status *))
+TPUMPI_WEAK(int, File_write_ordered_begin, (MPI_File, const void *, int, MPI_Datatype))
+TPUMPI_WEAK(int, File_write_ordered_end, (MPI_File, const void *, MPI_Status *))
+TPUMPI_WEAK(int, File_read_ordered_begin, (MPI_File, void *, int, MPI_Datatype))
+TPUMPI_WEAK(int, File_read_ordered_end, (MPI_File, void *, MPI_Status *))
+TPUMPI_WEAK(int, Register_datarep, (const char *, MPI_Datarep_conversion_function *, MPI_Datarep_conversion_function *, MPI_Datarep_extent_function *, void *))
+TPUMPI_WEAK(int, T_cvar_get_info, (int, char *, int *, int *, MPI_Datatype *, void *, char *, int *, int *, int *))
+TPUMPI_WEAK(int, T_cvar_handle_alloc, (int, void *, MPI_T_cvar_handle *, int *))
+TPUMPI_WEAK(int, T_cvar_handle_free, (MPI_T_cvar_handle *))
+TPUMPI_WEAK(int, T_cvar_read, (MPI_T_cvar_handle, void *))
+TPUMPI_WEAK(int, T_cvar_write, (MPI_T_cvar_handle, const void *))
+TPUMPI_WEAK(int, T_pvar_get_info, (int, char *, int *, int *, int *, MPI_Datatype *, void *, char *, int *, int *, int *, int *, int *))
+TPUMPI_WEAK(int, T_pvar_read, (MPI_T_pvar_session, MPI_T_pvar_handle, void *))
+TPUMPI_WEAK(int, T_pvar_write, (MPI_T_pvar_session, MPI_T_pvar_handle, const void *))
+TPUMPI_WEAK(int, T_pvar_reset, (MPI_T_pvar_session, MPI_T_pvar_handle))
+TPUMPI_WEAK(int, T_pvar_readreset, (MPI_T_pvar_session, MPI_T_pvar_handle, void *))
+TPUMPI_WEAK(int, T_enum_get_info, (int, int *, char *, int *))
+TPUMPI_WEAK(int, T_enum_get_item, (int, int, int *, char *, int *))
+TPUMPI_WEAK(int, T_category_get_num, (int *))
+TPUMPI_WEAK(int, T_category_get_info, (int, char *, int *, char *, int *, int *, int *, int *))
+TPUMPI_WEAK(int, T_category_get_index, (const char *, int *))
+TPUMPI_WEAK(int, T_category_get_cvars, (int, int, int[]))
+TPUMPI_WEAK(int, T_category_get_pvars, (int, int, int[]))
+TPUMPI_WEAK(int, T_category_get_categories, (int, int, int[]))
+TPUMPI_WEAK(int, T_category_changed, (int *))

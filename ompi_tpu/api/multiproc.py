@@ -111,6 +111,32 @@ class MultiProcComm(PersistentP2PMixin):
                  for _ in range(self.local_size)]
         return self.win_create(bases, name)
 
+    def win_allocate_shared(self, size: int, dtype=np.float32,
+                            name: str = ""):
+        """MPI_Win_allocate_shared: the multi-process job runs on ONE
+        host (a shared-memory domain), so allocation is win_allocate;
+        shared_query resolves local ranks' buffers directly."""
+        return self.win_allocate(size, dtype, name)
+
+    def win_create_dynamic(self, dtype=np.float32, name: str = ""):
+        """MPI_Win_create_dynamic over the DCN: starts empty; attach
+        publishes a local region as the rank's window memory."""
+        w = self.win_create(
+            [np.zeros(0, dtype) for _ in range(self.local_size)], name)
+        w._dynamic_regions = {}
+
+        def attach(rank_local, addr, array):
+            w._dynamic_regions[addr] = array
+            w._mem[rank_local] = np.ascontiguousarray(
+                array.view(np.uint8))
+
+        def detach(rank_local, addr):
+            w._dynamic_regions.pop(addr, None)
+
+        w.attach = attach
+        w.detach = detach
+        return w
+
     def _next_spawn(self) -> int:
         """Per-comm spawn counter (SPMD-agreed, names the child world's
         KVS namespace)."""

@@ -130,6 +130,16 @@ def _fail(e: BaseException, h: int | None = None) -> int:
     return cls
 
 
+def _t_fail(e: BaseException) -> int:
+    """MPI_T error mapping: the tools interface returns error codes and
+    NEVER invokes communicator error handlers (MPI-3 §14.3.4) — no
+    abort even under ERRORS_ARE_FATAL."""
+    if isinstance(e, err.MPIError):
+        return int(e.error_class)
+    traceback.print_exc()
+    return MPI_ERR_OTHER
+
+
 def _view(ptr: int, count: int, dtcode: int) -> np.ndarray:
     """Zero-copy numpy view over a raw C buffer."""
     dt = DTYPES.get(dtcode)
@@ -895,7 +905,9 @@ def _store_dtype(d) -> int:
 
 def type_contiguous(count: int, base: int):
     try:
-        return (MPI_SUCCESS, _store_dtype(_ddt(base).create_contiguous(count)))
+        code = _store_dtype(_ddt(base).create_contiguous(count))
+        _record_envelope(code, 3, [count], [], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -903,7 +915,9 @@ def type_contiguous(count: int, base: int):
 def type_vector(count: int, blocklength: int, stride: int, base: int):
     try:
         d = _ddt(base).create_vector(count, blocklength, stride)
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 4, [count, blocklength, stride], [], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -913,7 +927,9 @@ def type_indexed(count: int, bl_ptr: int, disp_ptr: int, base: int):
         bls = [int(v) for v in _view(bl_ptr, count, 7)]
         disps = [int(v) for v in _view(disp_ptr, count, 7)]
         d = _ddt(base).create_indexed(bls, disps)
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 6, [count] + bls + disps, [], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -1205,7 +1221,9 @@ def type_create_struct(count: int, bl_ptr: int, disp_ptr: int,
         disps = [int(v) for v in _view(disp_ptr, count, 20)]  # MPI_Aint
         codes = [int(v) for v in _view(types_ptr, count, 7)]
         d = create_struct(bls, disps, [_ddt(c) for c in codes])
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 10, [count] + bls, disps, codes)
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -1720,7 +1738,7 @@ def t_init() -> int:
         mpit.init_thread()
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _t_fail(e)
 
 
 def t_finalize() -> int:
@@ -1730,7 +1748,7 @@ def t_finalize() -> int:
         mpit.finalize()
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _t_fail(e)
 
 
 def t_cvar_get_num():
@@ -1739,7 +1757,7 @@ def t_cvar_get_num():
 
         return (MPI_SUCCESS, int(mpit.cvar_get_num()))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_t_fail(e), 0)
 
 
 def t_cvar_get_name(index: int):
@@ -1748,7 +1766,7 @@ def t_cvar_get_name(index: int):
 
         return (MPI_SUCCESS, str(mpit.cvar_get_info(index).name))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), "")
+        return (_t_fail(e), "")
 
 
 def t_cvar_read(index: int):
@@ -1764,7 +1782,7 @@ def t_cvar_read(index: int):
             f"cvar {index} is not integer-valued (use the string reader)"
         )
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_t_fail(e), 0)
 
 
 def t_cvar_index(name: str):
@@ -1773,7 +1791,7 @@ def t_cvar_index(name: str):
 
         return (MPI_SUCCESS, int(mpit.cvar_index(name)))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), -1)
+        return (_t_fail(e), -1)
 
 
 def t_pvar_get_num():
@@ -1782,7 +1800,7 @@ def t_pvar_get_num():
 
         return (MPI_SUCCESS, int(mpit.pvar_get_num()))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_t_fail(e), 0)
 
 
 def t_pvar_read(index: int):
@@ -1791,7 +1809,7 @@ def t_pvar_read(index: int):
 
         return (MPI_SUCCESS, int(mpit.pvar_read(index)))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), 0)
+        return (_t_fail(e), 0)
 
 
 def t_pvar_index(name: str):
@@ -1800,7 +1818,7 @@ def t_pvar_index(name: str):
 
         return (MPI_SUCCESS, int(mpit.pvar_index(name)))
     except BaseException as e:  # noqa: BLE001
-        return (_fail(e), -1)
+        return (_t_fail(e), -1)
 
 
 _pvar_starts = 0
@@ -1818,7 +1836,7 @@ def t_pvar_start() -> int:
         _pvar_starts += 1
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _t_fail(e)
 
 
 def t_pvar_stop() -> int:
@@ -1831,7 +1849,7 @@ def t_pvar_stop() -> int:
             mpit.pvar_stop()
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
-        return _fail(e)
+        return _t_fail(e)
 
 
 # -- cartesian topology (MPI_Cart_* / MPI_Dims_create) --------------------
@@ -3242,7 +3260,10 @@ def type_create_hvector(count: int, blocklength: int, stride_bytes: int,
                         base: int):
     try:
         d = _ddt(base).create_hvector(count, blocklength, stride_bytes)
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 5, [count, blocklength],
+                         [stride_bytes], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3252,7 +3273,9 @@ def type_create_hindexed(count: int, bl_ptr: int, disp_ptr: int, base: int):
         bls = [int(v) for v in _view(bl_ptr, count, 7)]
         disps = [int(v) for v in _view(disp_ptr, count, 20)]  # MPI_Aint
         d = _ddt(base).create_hindexed(bls, disps)
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 7, [count] + bls, disps, [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3262,7 +3285,9 @@ def type_create_hindexed_block(count: int, blocklength: int, disp_ptr: int,
     try:
         disps = [int(v) for v in _view(disp_ptr, count, 20)]
         d = _ddt(base).create_hindexed([blocklength] * count, disps)
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 9, [count, blocklength], disps, [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3272,7 +3297,9 @@ def type_create_indexed_block(count: int, blocklength: int, disp_ptr: int,
     try:
         disps = [int(v) for v in _view(disp_ptr, count, 7)]
         d = _ddt(base).create_indexed_block(blocklength, disps)
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 8, [count, blocklength] + disps, [], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3280,7 +3307,9 @@ def type_create_indexed_block(count: int, blocklength: int, disp_ptr: int,
 def type_create_resized(base: int, lb: int, extent: int):
     try:
         d = _ddt(base).create_resized(int(lb), int(extent))
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 13, [], [int(lb), int(extent)], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3294,7 +3323,11 @@ def type_create_subarray(ndims: int, sizes_ptr: int, subsizes_ptr: int,
         d = _ddt(base).create_subarray(
             sizes, subsizes, starts,
             order="F" if order == 57 else "C")  # 57 = MPI_ORDER_FORTRAN
-        return (MPI_SUCCESS, _store_dtype(d))
+        code = _store_dtype(d)
+        _record_envelope(code, 11,
+                         [ndims] + sizes + subsizes + starts + [order],
+                         [], [base])
+        return (MPI_SUCCESS, code)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3497,3 +3530,822 @@ def file_get_view_codes(fh: int):
         return (MPI_SUCCESS, int(disp), et, ft)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0, 4, 4)
+
+
+# ======================================================================
+# Round-3 C ABI batch 2: neighbor collectives, alltoallw, type
+# introspection (envelope/contents/darray/f90), MPI_T breadth,
+# generalized requests, name service, window/io remainder.
+# ======================================================================
+
+# -- datatype envelope/contents (MPI_Type_get_envelope) -----------------
+# combiner codes (mpi.h): NAMED=1, DUP=2, CONTIGUOUS=3, VECTOR=4,
+# HVECTOR=5, INDEXED=6, HINDEXED=7, INDEXED_BLOCK=8, HINDEXED_BLOCK=9,
+# STRUCT=10, SUBARRAY=11, DARRAY=12, RESIZED=13, F90_REAL=14,
+# F90_COMPLEX=15, F90_INTEGER=16
+
+_type_envelope: dict[int, tuple] = {}  # dtcode -> (combiner, ints, aints, types)
+
+
+def _record_envelope(dtcode: int, combiner: int, ints=(), aints=(),
+                     types=()) -> int:
+    _type_envelope[dtcode] = (combiner, list(ints), list(aints), list(types))
+    return dtcode
+
+
+def type_get_envelope(dtcode: int):
+    """(err, num_integers, num_addresses, num_datatypes, combiner)."""
+    env = _type_envelope.get(dtcode)
+    if env is None:
+        return (MPI_SUCCESS, 0, 0, 0, 1)  # MPI_COMBINER_NAMED
+    c, ints, aints, types = env
+    return (MPI_SUCCESS, len(ints), len(aints), len(types), c)
+
+
+def type_get_contents(dtcode: int, max_i: int, max_a: int, max_d: int,
+                      ints_ptr: int, aints_ptr: int, types_ptr: int) -> int:
+    try:
+        env = _type_envelope.get(dtcode)
+        if env is None:
+            raise err.MPITypeError(
+                f"MPI_Type_get_contents on a named datatype {dtcode}")
+        _, ints, aints, types = env
+        if len(ints) > max_i or len(aints) > max_a or len(types) > max_d:
+            raise err.MPIArgError("get_contents arrays too small")
+        if ints:
+            _view(ints_ptr, len(ints), 7)[:] = ints
+        if aints:
+            _view(aints_ptr, len(aints), 20)[:] = aints
+        if types:
+            _view(types_ptr, len(types), 7)[:] = types
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def type_create_darray(size: int, rank: int, ndims: int, gsizes_ptr: int,
+                       distribs_ptr: int, dargs_ptr: int, psizes_ptr: int,
+                       order: int, base: int):
+    """MPI_Type_create_darray, MPI_DISTRIBUTE_BLOCK subset (the HPF
+    block distribution ScaLAPACK-style decompositions use; CYCLIC
+    would need the full HPF machinery and raises)."""
+    try:
+        DISTRIBUTE_BLOCK, DISTRIBUTE_NONE = 121, 123
+        gsizes = [int(v) for v in _view(gsizes_ptr, ndims, 7)]
+        distribs = [int(v) for v in _view(distribs_ptr, ndims, 7)]
+        psizes = [int(v) for v in _view(psizes_ptr, ndims, 7)]
+        for d in distribs:
+            if d not in (DISTRIBUTE_BLOCK, DISTRIBUTE_NONE):
+                raise err.MPITypeError(
+                    "darray: only MPI_DISTRIBUTE_BLOCK/NONE supported")
+        # process coordinates in the process grid (C order)
+        coords = []
+        r = rank
+        for p in reversed(psizes):
+            coords.append(r % p)
+            r //= p
+        coords.reverse()
+        subsizes, starts = [], []
+        for i in range(ndims):
+            if distribs[i] == DISTRIBUTE_NONE or psizes[i] == 1:
+                subsizes.append(gsizes[i])
+                starts.append(0)
+            else:
+                block = -(-gsizes[i] // psizes[i])  # ceil
+                s = coords[i] * block
+                subsizes.append(max(0, min(block, gsizes[i] - s)))
+                starts.append(min(s, gsizes[i]))
+        d = _ddt(base).create_subarray(
+            gsizes, subsizes, starts, order="F" if order == 57 else "C")
+        code = _store_dtype(d)
+        _record_envelope(code, 12,
+                         [size, rank, ndims] + gsizes + distribs
+                         + [int(v) for v in _view(dargs_ptr, ndims, 7)]
+                         + psizes + [order],
+                         [], [base])
+        return (MPI_SUCCESS, code)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def type_match_size(typeclass: int, size: int):
+    """MPI_Type_match_size: TYPECLASS_{INTEGER=1,REAL=2,COMPLEX=3}."""
+    table = {
+        (1, 1): 17, (1, 2): 18, (1, 4): 19, (1, 8): 20,
+        (2, 4): 13, (2, 8): 14,
+        (3, 8): 25, (3, 16): 26,
+    }
+    code = table.get((typeclass, size))
+    if code is None:
+        return (MPI_ERR_ARG, 0)
+    return (MPI_SUCCESS, code)
+
+
+def type_create_f90(kind: str, p: int, r: int):
+    """F90 parameterized types resolve to the matching C types."""
+    if kind == "real":
+        return (MPI_SUCCESS, 14 if p > 6 else 13)
+    if kind == "complex":
+        return (MPI_SUCCESS, 26 if p > 6 else 25)
+    if kind == "integer":
+        if r <= 2:
+            return (MPI_SUCCESS, 17)
+        if r <= 4:
+            return (MPI_SUCCESS, 18)
+        if r <= 9:
+            return (MPI_SUCCESS, 19)
+        return (MPI_SUCCESS, 20)
+    return (MPI_ERR_ARG, 0)
+
+
+# -- neighbor collectives (over cart/graph/dist-graph topologies) -------
+
+
+#: reserved tag base for neighbor-collective internal traffic (user
+#: tags live below; TAG_UB is 2^30-1 so this range is addressable)
+_NEIGH_TAG = 1 << 29
+
+
+def _cart_mirror(h: int, i: int) -> int | None:
+    """For cartesian topologies, the SENDER's slot index that addresses
+    me when I receive at slot ``i``: dimension d's (-1, +1) pair is
+    mirrored (my -1 source used ITS +1 dest), i.e. i^1.  None for
+    graph topologies, where occurrence-order FIFO pairing is already
+    the adjacency-order semantics."""
+    return (i ^ 1) if h in _carts else None
+
+
+def _neighbors_of(h: int):
+    """(sources, destinations) global-rank lists for comm ``h``'s
+    topology (cart: shift neighbors in dimension order, the standard's
+    required ordering; graph: adjacency; dist_graph: stored edges)."""
+    me = comm_rank(h)[1]
+    if h in _carts:
+        dims, periods = _carts[h]
+        coords = _coords_of(dims, me)
+        ns = []
+        for d in range(len(dims)):
+            for disp in (-1, 1):
+                c = list(coords)
+                c[d] += disp
+                if periods[d]:
+                    c[d] %= dims[d]
+                elif not 0 <= c[d] < dims[d]:
+                    ns.append(-2)  # MPI_PROC_NULL
+                    continue
+                ns.append(_rank_of(dims, periods, c))
+        return ns, ns  # cartesian neighborhoods are symmetric
+    if h in _graphs:
+        from ompi_tpu.api.topo import graph_neighbors_of
+
+        index, edges = _graphs[h]
+        ns = graph_neighbors_of(index, edges, me)
+        return list(ns), list(ns)
+    if h in _dist_graphs:
+        s, d = _dist_graphs[h]
+        return list(s), list(d)
+    raise err.MPITopologyError(f"comm {h} has no topology")
+
+
+def neighbor_allgather(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
+    """Each process sends its block to every out-neighbor and receives
+    one block per in-neighbor (recvbuf order = neighbor order)."""
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        sources, dests = _neighbors_of(h)
+        x = _view(sptr, scount, sdt).copy()
+        cart = h in _carts
+        for j, d in enumerate(dests):
+            if d != -2:
+                c.send(x, me, d, tag=_NEIGH_TAG + 0 + (j if cart else 0))
+        item = DTYPES[rdt].itemsize
+        for i, s in enumerate(sources):
+            dst = _view(rptr + i * rcount * item, rcount, rdt)
+            if s == -2:
+                continue
+            j = _cart_mirror(h, i)
+            payload, _st = c.recv(me, s, _NEIGH_TAG + 0 if j is None else _NEIGH_TAG + 0 + j)
+            flat = np.asarray(payload).reshape(-1).view(DTYPES[rdt])
+            dst[:] = flat[:rcount]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def neighbor_allgatherv(sptr, scount, sdt, rptr, rcounts_ptr, displs_ptr,
+                        rdt, h) -> int:
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        sources, dests = _neighbors_of(h)
+        x = _view(sptr, scount, sdt).copy()
+        cart = h in _carts
+        for j, d in enumerate(dests):
+            if d != -2:
+                c.send(x, me, d, tag=_NEIGH_TAG + 64 + (j if cart else 0))
+        counts, displs = _vparams(rcounts_ptr, displs_ptr, len(sources))
+        item = DTYPES[rdt].itemsize
+        for i, s in enumerate(sources):
+            if s == -2:
+                continue
+            j = _cart_mirror(h, i)
+            payload, _st = c.recv(me, s, _NEIGH_TAG + 64 if j is None else _NEIGH_TAG + 64 + j)
+            flat = np.asarray(payload).reshape(-1).view(DTYPES[rdt])
+            dst = _view(rptr + displs[i] * item, counts[i], rdt)
+            dst[:] = flat[: counts[i]]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def neighbor_alltoall(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
+    """Distinct block per out-neighbor; one block per in-neighbor."""
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        sources, dests = _neighbors_of(h)
+        sitem = DTYPES[sdt].itemsize
+        cart = h in _carts
+        for j, d in enumerate(dests):
+            if d != -2:
+                blk = _view(sptr + j * scount * sitem, scount, sdt).copy()
+                c.send(blk, me, d, tag=_NEIGH_TAG + 128 + (j if cart else 0))
+        ritem = DTYPES[rdt].itemsize
+        for i, s in enumerate(sources):
+            if s == -2:
+                continue
+            j = _cart_mirror(h, i)
+            payload, _st = c.recv(me, s, _NEIGH_TAG + 128 if j is None else _NEIGH_TAG + 128 + j)
+            flat = np.asarray(payload).reshape(-1).view(DTYPES[rdt])
+            dst = _view(rptr + i * rcount * ritem, rcount, rdt)
+            dst[:] = flat[:rcount]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def neighbor_alltoallv(sptr, scounts_ptr, sdispls_ptr, sdt, rptr,
+                       rcounts_ptr, rdispls_ptr, rdt, h) -> int:
+    try:
+        c = _comm(h)
+        me = comm_rank(h)[1]
+        sources, dests = _neighbors_of(h)
+        scounts, sdispls = _vparams(scounts_ptr, sdispls_ptr, len(dests))
+        rcounts, rdispls = _vparams(rcounts_ptr, rdispls_ptr, len(sources))
+        sitem = DTYPES[sdt].itemsize
+        cart = h in _carts
+        for j, d in enumerate(dests):
+            if d != -2:
+                blk = _view(sptr + sdispls[j] * sitem, scounts[j], sdt).copy()
+                c.send(blk, me, d, tag=_NEIGH_TAG + 192 + (j if cart else 0))
+        ritem = DTYPES[rdt].itemsize
+        for i, s in enumerate(sources):
+            if s == -2:
+                continue
+            j = _cart_mirror(h, i)
+            payload, _st = c.recv(me, s, _NEIGH_TAG + 192 if j is None else _NEIGH_TAG + 192 + j)
+            flat = np.asarray(payload).reshape(-1).view(DTYPES[rdt])
+            dst = _view(rptr + rdispls[i] * ritem, rcounts[i], rdt)
+            dst[:] = flat[: rcounts[i]]
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def ineighbor(fn_name: str, *args):
+    try:
+        fn = globals()[fn_name]
+        return _eager_coll(lambda: fn(*args))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+# -- MPI_Alltoallw (per-block datatypes; counts in ELEMENTS, displs in
+# BYTES, per the standard) ---------------------------------------------
+
+
+def alltoallw(sptr, scounts_ptr, sdispls_ptr, stypes_ptr, rptr,
+              rcounts_ptr, rdispls_ptr, rtypes_ptr, h) -> int:
+    try:
+        c = _comm(h)
+        n = getattr(c, "size", 1)
+        me = comm_rank(h)[1]
+        scounts = [int(v) for v in _view(scounts_ptr, n, 7)]
+        sdispls = [int(v) for v in _view(sdispls_ptr, n, 7)]
+        stypes = [int(v) for v in _view(stypes_ptr, n, 7)]
+        rcounts = [int(v) for v in _view(rcounts_ptr, n, 7)]
+        rdispls = [int(v) for v in _view(rdispls_ptr, n, 7)]
+        rtypes = [int(v) for v in _view(rtypes_ptr, n, 7)]
+        # pack every outgoing block to bytes (the convertor handles
+        # derived types), jagged-exchange, unpack per-block
+        row = [
+            np.ascontiguousarray(
+                _pack_from(sptr + sdispls[j], scounts[j], stypes[j])
+            ).view(np.uint8).reshape(-1)
+            for j in range(n)
+        ]
+        if _is_single_controller(c):
+            out = c.alltoallv([row] * n if n > 1 else [row])[me]
+        else:
+            out = c.alltoallv([row])[0]
+        for j in range(n):
+            _unpack_into(rptr + rdispls[j], rcounts[j], rtypes[j],
+                         np.asarray(out[j]).view(np.uint8))
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e, h)
+
+
+def ialltoallw(*args):
+    try:
+        return _eager_coll(lambda: alltoallw(*args))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+# -- generalized requests (MPI_Grequest_start/complete) -----------------
+
+
+def grequest_start(query_fnptr: int, free_fnptr: int, cancel_fnptr: int,
+                   extra: int):
+    """The user drives completion (grequest_complete); at wait/test
+    completion the query callback fills the status, and the free
+    callback releases user state — the MPI-2 generalized request
+    lifecycle."""
+    try:
+        return (MPI_SUCCESS, _store_req(
+            ("grequest", None,
+             (query_fnptr, free_fnptr, cancel_fnptr, extra), 0, 0)))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def grequest_complete(rh: int) -> int:
+    try:
+        entry = _requests.get(rh)
+        if entry is None or entry[0] != "grequest":
+            raise err.MPIRequestError(f"not a generalized request: {rh}")
+        query_fnptr, free_fnptr, cancel_fnptr, extra = entry[2]
+        status = np.zeros(4, np.int32)  # MPI_Status layout (4 ints)
+        CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                              ctypes.c_void_p)
+        if query_fnptr:
+            CB(query_fnptr)(extra, status.ctypes.data)
+        if free_fnptr:
+            ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)(free_fnptr)(extra)
+        _requests[rh] = ("done", None, 0, 0,
+                         (int(status[0]), int(status[1]), int(status[3])))
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+# -- name service (MPI_Open_port / Publish_name family) -----------------
+# Port names resolve through the job KVS under tpurun (visible to every
+# process of the job) and a process-local registry standalone — the
+# reference's ompi-server plays this role; cross-JOB rendezvous needs
+# that external server there too, so the parity boundary is identical.
+
+_local_names: dict[str, str] = {}
+_next_port = 1
+
+
+def open_port():
+    global _next_port
+    _next_port += 1
+    return (MPI_SUCCESS, f"tpumpi-port-{_rank}-{_next_port}")
+
+
+def close_port(port: str) -> int:
+    del port
+    return MPI_SUCCESS
+
+
+def _kvs_or_none():
+    try:
+        from ompi_tpu.boot.proc import launched_by_tpurun
+
+        if not launched_by_tpurun():
+            return None
+        from ompi_tpu.api import comm_world
+
+        return getattr(comm_world(), "procctx", None)
+    except BaseException:  # noqa: BLE001
+        return None
+
+
+def publish_name(service: str, port: str) -> int:
+    ctx = _kvs_or_none()
+    if ctx is not None:
+        try:
+            ctx.kvs.put(f"svc:{service}", port)
+            return MPI_SUCCESS
+        except BaseException:  # noqa: BLE001
+            pass  # standalone / KVS gone: process-local registry below
+    _local_names[service] = port
+    return MPI_SUCCESS
+
+
+def unpublish_name(service: str) -> int:
+    ctx = _kvs_or_none()
+    if ctx is not None:
+        try:  # tombstone: the KVS has no delete; "" reads as absent
+            ctx.kvs.put(f"svc:{service}", "")
+        except BaseException:  # noqa: BLE001
+            pass
+    _local_names.pop(service, None)
+    return MPI_SUCCESS
+
+
+def lookup_name(service: str):
+    ctx = _kvs_or_none()
+    if ctx is not None:
+        try:
+            # a tombstoned ("") value reads as absent (unpublished)
+            port = ctx.kvs.get(f"svc:{service}", timeout=5.0)
+            if port:
+                return (MPI_SUCCESS, port)
+        except BaseException:  # noqa: BLE001
+            pass
+    port = _local_names.get(service)
+    if port is None:
+        return (MPI_ERR_ARG, "")
+    return (MPI_SUCCESS, port)
+
+
+# -- window remainder ---------------------------------------------------
+
+
+def win_allocate_shared(h: int, size_bytes: int, disp_unit: int):
+    try:
+        global _next_win_h
+        c = _comm(h)
+        w = (c.win_allocate_shared(max(size_bytes, 1), np.uint8)
+             if hasattr(c, "win_allocate_shared")
+             else c.win_allocate(max(size_bytes, 1), np.uint8))
+        w._disp_unit = disp_unit
+        _next_win_h += 1
+        _wins[_next_win_h] = w
+        me = (comm_rank(h)[1] if _is_single_controller(c)
+              else c.local_offset)
+        mem = w.memory(me)
+        addr = int(mem.ctypes.data) if hasattr(mem, "ctypes") else 0
+        return (MPI_SUCCESS, _next_win_h, addr)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0, 0)
+
+
+def win_create_dynamic(h: int):
+    try:
+        global _next_win_h
+        c = _comm(h)
+        w = c.win_create_dynamic(np.uint8)
+        w._disp_unit = 1
+        _next_win_h += 1
+        _wins[_next_win_h] = w
+        return (MPI_SUCCESS, _next_win_h)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def win_attach(wh: int, addr: int, size_bytes: int) -> int:
+    try:
+        w = _win(wh)
+        # the C model runs one rank per process → the caller is always
+        # its process's local rank 0 (single-controller ditto)
+        raw = (ctypes.c_ubyte * max(size_bytes, 1)).from_address(addr)
+        w.attach(0, addr, np.frombuffer(raw, np.uint8))
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_detach(wh: int, addr: int) -> int:
+    try:
+        w = _win(wh)
+        w.detach(0, addr)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def win_shared_query(wh: int, rank: int):
+    """(err, size, disp_unit, base address)."""
+    try:
+        w = _win(wh)
+        q = getattr(w, "shared_query", None)
+        if q is not None:
+            size, mem = q(rank)
+        else:
+            mem = w.memory(rank)
+            size = mem.nbytes
+        addr = int(mem.ctypes.data) if hasattr(mem, "ctypes") else 0
+        return (MPI_SUCCESS, int(size), int(getattr(w, "_disp_unit", 1)),
+                addr)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0, 0, 0)
+
+
+# -- MPI-IO split-phase / ordered / async shared ------------------------
+
+_file_split: dict[int, tuple] = {}  # fh -> ("read"/"write", data/count)
+
+
+def file_write_ordered(fh: int, ptr: int, count: int, dtcode: int):
+    """Rank-ordered write at the shared pointer.  Multi-process jobs:
+    the shared pointer is single-process-scoped (see file_open) — same
+    boundary, reported not silently corrupted."""
+    try:
+        f, multi = _file(fh)[0], _file(fh)[1]
+        if multi:
+            raise err.MPIFileError(
+                "shared-file-pointer ordered ops are single-process "
+                "scoped in this build (see MPI_File_open notes)")
+        data = _pack_from(ptr, count, dtcode)
+        written = f.write_ordered([np.asarray(data)])[0]
+        return (MPI_SUCCESS, int(written))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_read_ordered(fh: int, ptr: int, count: int, dtcode: int):
+    try:
+        f, multi = _file(fh)[0], _file(fh)[1]
+        if multi:
+            raise err.MPIFileError(
+                "shared-file-pointer ordered ops are single-process "
+                "scoped in this build (see MPI_File_open notes)")
+        dt = DTYPES.get(dtcode)
+        if dt is None:
+            raise err.MPITypeError(f"unsupported datatype {dtcode}")
+        units = _etype_units(f, count * dt.itemsize)
+        out = f.read_ordered([units], dtype=dt)[0]
+        got = int(np.asarray(out).size)
+        if got:
+            _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
+        return (MPI_SUCCESS, got)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_split_begin(fh: int, kind: str, offset: int, ptr: int, count: int,
+                     dtcode: int) -> int:
+    """Split-phase *_begin: the operation runs now; _end returns its
+    status (MPI allows completion any time inside the begin/end pair)."""
+    try:
+        if fh in _file_split:
+            raise err.MPIFileError("split collective already active")
+        if kind == "write_at":
+            rc, got = file_write_at_all(fh, offset, ptr, count, dtcode)
+        elif kind == "read_at":
+            rc, got = file_read_at_all(fh, offset, ptr, count, dtcode)
+        elif kind == "write":
+            rc, got = file_write_all(fh, ptr, count, dtcode)
+        elif kind == "read":
+            rc, got = file_read_all(fh, ptr, count, dtcode)
+        elif kind == "write_ordered":
+            rc, got = file_write_ordered(fh, ptr, count, dtcode)
+        elif kind == "read_ordered":
+            rc, got = file_read_ordered(fh, ptr, count, dtcode)
+        else:
+            raise err.MPIArgError(f"bad split kind {kind}")
+        if rc != MPI_SUCCESS:
+            return rc
+        _file_split[fh] = (kind, got)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def file_split_end(fh: int):
+    """(err, element count) for the active split collective."""
+    try:
+        ent = _file_split.pop(fh, None)
+        if ent is None:
+            raise err.MPIFileError("no split collective active")
+        return (MPI_SUCCESS, int(ent[1]))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_iwrite_shared(fh, ptr, count, dtcode):
+    try:
+        rc, got = file_write_shared(fh, ptr, count, dtcode)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, got))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_iread_shared(fh, ptr, count, dtcode):
+    try:
+        rc, got = file_read_shared(fh, ptr, count, dtcode)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, got))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_iwrite_at_all(fh, offset, ptr, count, dtcode):
+    try:
+        rc, got = file_write_at_all(fh, offset, ptr, count, dtcode)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, got))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_iread_at_all(fh, offset, ptr, count, dtcode):
+    try:
+        rc, got = file_read_at_all(fh, offset, ptr, count, dtcode)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, got))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_iwrite_all(fh, ptr, count, dtcode):
+    try:
+        rc, got = file_write_all(fh, ptr, count, dtcode)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, got))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+def file_iread_all(fh, ptr, count, dtcode):
+    try:
+        rc, got = file_read_all(fh, ptr, count, dtcode)
+        if rc != MPI_SUCCESS:
+            return (rc, 0)
+        return (MPI_SUCCESS, _store_req(("done", None, 0, 0, (0, 0, got))))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
+
+
+_datareps: set[str] = {"native", "internal", "external32"}
+
+
+def register_datarep(name: str) -> int:
+    """MPI_Register_datarep: user representations register by name;
+    conversion functions are not invoked (the io engine reads/writes
+    native byte order — external32 conversion lives in Pack_external)."""
+    _datareps.add(name)
+    return MPI_SUCCESS
+
+
+# -- MPI_T breadth -------------------------------------------------------
+
+
+def t_cvar_get_info(index: int):
+    """(err, name, verbosity, scope) via the str helper pattern:
+    returns (err, packed 'name|verbosity|scope') for the shim."""
+    try:
+        from ompi_tpu.tool import mpit
+
+        info = mpit.cvar_get_info(index)
+        return (MPI_SUCCESS, f"{info.name}|{info.verbosity}|{info.scope}")
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), "")
+
+
+def t_cvar_handle_alloc(index: int):
+    """cvar handles alias the index (no per-object binding needed)."""
+    try:
+        from ompi_tpu.tool import mpit
+
+        mpit.cvar_get_info(index)  # validates
+        return (MPI_SUCCESS, index + 1)  # 0 = invalid handle
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), 0)
+
+
+def t_cvar_handle_read(handle: int):
+    return t_cvar_read(handle - 1)
+
+
+def t_cvar_handle_write(handle: int, value: int) -> int:
+    try:
+        from ompi_tpu.tool import mpit
+
+        mpit.cvar_write(handle - 1, value)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _t_fail(e)
+
+
+def t_pvar_get_info(index: int):
+    try:
+        from ompi_tpu.tool import mpit
+
+        info = mpit.pvar_get_info(index)
+        return (MPI_SUCCESS, f"{info.name}|{info.var_class}")
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), "")
+
+
+def t_pvar_write(index: int, value: int) -> int:
+    """pvars here are monotonic counters — only reset-to-zero writes
+    are meaningful; MPI_T allows rejecting others."""
+    try:
+        if value != 0:
+            return MPI_ERR_ARG
+        return t_pvar_reset(index)
+    except BaseException as e:  # noqa: BLE001
+        return _t_fail(e)
+
+
+def t_pvar_reset(index: int) -> int:
+    try:
+        from ompi_tpu.tool import mpit, spc
+
+        spc.reset_one(mpit._pvar_names()[index])
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _t_fail(e)
+
+
+def t_pvar_readreset(index: int):
+    try:
+        rc = t_pvar_read(index)
+        if not isinstance(rc, tuple) or rc[0] != MPI_SUCCESS:
+            return rc if isinstance(rc, tuple) else (rc, 0)
+        t_pvar_reset(index)
+        return rc
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), 0)
+
+
+def t_enum_get_info(dtcode_unused: int):
+    """Our cvars expose plain int/bool/str types — no enum objects, so
+    there are zero enumerations (a valid MPI_T configuration)."""
+    del dtcode_unused
+    return (MPI_ERR_ARG, "", 0)
+
+
+def t_category_get_num():
+    try:
+        from ompi_tpu.tool import mpit
+
+        return (MPI_SUCCESS, mpit.category_get_num())
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), 0)
+
+
+def t_category_get_info(index: int):
+    """(err, 'name|num_cvars')."""
+    try:
+        from ompi_tpu.tool import mpit
+
+        name, ncvars = mpit.category_get_info(index)
+        return (MPI_SUCCESS, f"{name}|{ncvars}")
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), "")
+
+
+def t_category_get_index(name: str):
+    try:
+        from ompi_tpu.tool import mpit
+
+        cats = [c[0] for c in mpit._categories()]
+        return (MPI_SUCCESS, cats.index(name))
+    except ValueError:
+        return (MPI_ERR_ARG, 0)
+    except BaseException as e:  # noqa: BLE001
+        return (_t_fail(e), 0)
+
+
+def t_category_get_cvars(index: int, maxn: int, out_ptr: int) -> int:
+    try:
+        from ompi_tpu.tool import mpit
+
+        name, _ = mpit.category_get_info(index)
+        idxs = [i for i, v in enumerate(mpit._cvar_names())
+                if v.split("_", 1)[0] == name][:maxn]
+        if idxs:
+            _view(out_ptr, len(idxs), 7)[:] = idxs
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _t_fail(e)
+
+
+def t_category_get_pvars(index: int, maxn: int, out_ptr: int) -> int:
+    try:
+        from ompi_tpu.tool import mpit
+
+        del index  # pvars are uncategorized: every category reports none
+        del maxn, out_ptr
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _t_fail(e)
+
+
+def t_category_changed():
+    """Category layout is fixed after init: a constant stamp."""
+    return (MPI_SUCCESS, 1)

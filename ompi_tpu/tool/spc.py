@@ -90,3 +90,9 @@ def snapshot() -> dict[str, int]:
 def reset() -> None:
     with _lock:
         _counters.clear()
+
+
+def reset_one(name: str) -> None:
+    """Zero a single counter (MPI_T pvar_reset on one handle)."""
+    with _lock:
+        _counters.pop(name, None)
