@@ -3181,10 +3181,13 @@ int PMPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
 }
 
 int PMPI_Win_set_info(MPI_Win win, MPI_Info info) {
-  /* stored per-window in the attribute table (keyval 0 is reserved
-   * for the info hint set) */
+  /* copy-at-call semantics: dup the caller's info NOW (it may free
+   * its handle right after), store the dup per-window (keyval 0) */
+  capi_ret d;
+  int rc = capi_call("info_dup", &d, "(i)", (int)info);
+  if (rc != MPI_SUCCESS || d.n < 1) return rc ? rc : MPI_ERR_INTERN;
   return capi_call("attr_set", NULL, "(siiK)", "wininfo", (int)win, 0,
-                   (unsigned long long)(int)info);
+                   (unsigned long long)(int)d.v[0]);
 }
 
 int PMPI_Win_get_info(MPI_Win win, MPI_Info *info_used) {

@@ -602,6 +602,17 @@ def wait(rh: int):
         entry = _requests.get(rh)
         if entry is None:
             raise err.MPIArgError(f"invalid request handle {rh}")
+        if entry[0] == "grequest":
+            # generalized request: block until the user's worker calls
+            # MPI_Grequest_complete (which rewrites the entry to done)
+            from ompi_tpu.request import _poll_backoff
+
+            sleep = 0.0
+            while _requests.get(rh, ("done",))[0] == "grequest":
+                sleep = _poll_backoff(sleep)
+            entry = _requests.get(rh)
+            if entry is None:
+                return (MPI_SUCCESS, -1, -1, 0, 0)
         if entry[0].startswith("pers_"):
             pers = 1  # even on error the handle must survive (spec)
             source, tag, count = _complete_persistent(rh, entry)
@@ -4280,13 +4291,6 @@ def t_pvar_readreset(index: int):
         return rc
     except BaseException as e:  # noqa: BLE001
         return (_t_fail(e), 0)
-
-
-def t_enum_get_info(dtcode_unused: int):
-    """Our cvars expose plain int/bool/str types — no enum objects, so
-    there are zero enumerations (a valid MPI_T configuration)."""
-    del dtcode_unused
-    return (MPI_ERR_ARG, "", 0)
 
 
 def t_category_get_num():

@@ -73,7 +73,16 @@ class DcnCollEngine:
         #: buffered forever (cids are never reused — comm.py counter)
         self._p2p_closed: set[int] = set()
         self._p2p_lock = threading.Lock()
-        if transport == "sm":
+        if transport == "bml":
+            # bml/r2: per-peer leg selection (sm same-host, tcp remote)
+            self.transport = tcp_mod.BmlTransport(
+                self._on_frame,
+                eager_limit=eager_limit,
+                frag_size=frag_size,
+                max_rndv=max_rndv,
+                shm_threshold=shm_threshold,
+            )
+        elif transport == "sm":
             # btl/sm: unix-socket framing + single-copy shm payloads
             self.transport = tcp_mod.ShmTransport(
                 self._on_frame,

@@ -84,3 +84,20 @@ class DcnShmComponent(DcnTcpComponent):
         p["transport"] = "sm"
         p["shm_threshold"] = store.get("btl_sm_shm_threshold")
         return p
+
+
+@register_component
+class DcnBmlComponent(DcnShmComponent):
+    """``btl/bml`` — the r2-style per-peer multiplexer: shared-memory
+    rings for same-host peers, TCP for cross-host, chosen per SEND by
+    the peer's advertised host identity (SURVEY.md §2.3 bml row).
+    Select with ``--mca btl bml``; the default stays single-transport
+    until mixed-host jobs are routinely launched (the rsh leg)."""
+
+    NAME = "bml"
+    PRIORITY = 45
+
+    def params(self, store) -> dict:
+        p = super().params(store)
+        p["transport"] = "bml"
+        return p

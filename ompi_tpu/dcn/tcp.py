@@ -620,3 +620,86 @@ class ShmTransport(TcpTransport):
                 ring.close(unlink=True)
             for ring in self._rx_rings.values():
                 ring.close(unlink=True)
+
+
+class BmlTransport:
+    """``bml/r2`` — the per-peer transport multiplexer.
+
+    ≈ ``opal/mca/bml/r2`` (SURVEY.md §2.3 row 30): owns BOTH byte
+    transports and schedules each send onto the best one for that peer
+    — the shared-memory rings for peers on THIS host, TCP for everyone
+    else.  Both legs deliver inbound frames to the same engine handler
+    (frames carry src/cid, so the matching layer never knows which
+    wire a frame rode), and each leg runs its own rendezvous protocol.
+
+    The modex address is a composite ``bml:<host_id>|<tcp>|<sm>``;
+    ``send`` parses the peer's composite and picks the sm leg exactly
+    when the peer's host_id equals ours — the reachability test the
+    reference's bml performs per BTL module.
+    """
+
+    @staticmethod
+    def _default_host_id() -> str:
+        """Host identity for the reachability test: hostname alone is
+        not unique (cloned images, 'localhost'), so the kernel boot id
+        — identical for every process on a host, distinct across
+        hosts/boots — is appended when available."""
+        import socket as _socket
+
+        hid = _socket.gethostname()
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                hid += "/" + f.read().strip()
+        except OSError:
+            pass
+        return hid
+
+    def __init__(self, handler, host: str = "127.0.0.1",
+                 eager_limit: int = EAGER_LIMIT, frag_size: int = FRAG_SIZE,
+                 max_rndv: int = MAX_RNDV, shm_threshold: int = 2 << 20,
+                 host_id: str | None = None):
+        #: identity for the same-host reachability test (override for
+        #: tests that simulate cross-host peers)
+        self.host_id = host_id or self._default_host_id()
+        self.tcp = TcpTransport(handler, host=host,
+                                eager_limit=eager_limit,
+                                frag_size=frag_size, max_rndv=max_rndv)
+        self.sm = ShmTransport(handler, eager_limit=eager_limit,
+                               frag_size=frag_size, max_rndv=max_rndv,
+                               shm_threshold=shm_threshold)
+        self.eager_limit = int(eager_limit)
+        self.frag_size = max(1, int(frag_size))
+        self.address = f"bml:{self.host_id}|{self.tcp.address}|{self.sm.address}"
+
+    @property
+    def bytes_sent(self) -> int:
+        return self.tcp.bytes_sent + self.sm.bytes_sent
+
+    def _route(self, address: str):
+        """(leg, leg-address) for a peer's composite address."""
+        if address.startswith("bml:"):
+            host_id, tcp_addr, sm_addr = address[4:].split("|", 2)
+            if host_id == self.host_id:
+                return self.sm, sm_addr
+            return self.tcp, tcp_addr
+        # plain address (mixed job with a non-bml peer): scheme decides
+        if address.startswith("unix:@"):
+            return self.sm, address
+        return self.tcp, address
+
+    def send(self, address: str, envelope: dict, payload) -> None:
+        leg, addr = self._route(address)
+        leg.send(addr, envelope, payload)
+
+    def send_control(self, address: str, envelope: dict,
+                     ftype: int = _CTS) -> None:
+        leg, addr = self._route(address)
+        leg.send_control(addr, envelope, ftype)
+
+    def close(self) -> None:
+        self.tcp.close()
+        self.sm.close()
+
+    @property
+    def _running(self) -> bool:
+        return self.tcp._running
