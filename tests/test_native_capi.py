@@ -184,3 +184,20 @@ def test_symbol_count_geq_400(native_bins):
     250)."""
     syms = _weak_mpi_symbols()
     assert len(syms) >= 400, f"only {len(syms)} MPI_* weak symbols"
+
+
+@pytest.mark.parametrize("btl", ["sm", "bml"])
+def test_c_suite_over_alternate_transports(native_bins, btl):
+    """The full C conformance surface is transport-independent: the
+    same suite passes over the shared-memory rings and the bml
+    multiplexer (frames carry the envelope; byte movement is the only
+    thing a btl changes)."""
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+         "--cpu-devices", "1", "--mca", "btl", btl,
+         str(native_bins["c_suite2"])],
+        capture_output=True, timeout=300, cwd=str(REPO),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "SUITE2 COMPLETE" in out
