@@ -260,6 +260,85 @@ int main(int argc, char **argv) {
     MPI_T_finalize();
   }
 
+  /* -- win-attr stress: >21 live windows, exact-keyed slots --------- */
+  {
+    enum { NW = 28 }; /* past the old 64-slot hash's ~21-window limit */
+    MPI_Win wins[NW];
+    double bufs[NW][NW + 1];
+    int kv;
+    MPI_Win_create_keyval(MPI_WIN_DUP_FN, MPI_WIN_NULL_DELETE_FN, &kv,
+                          NULL);
+    for (int i = 0; i < NW; i++) {
+      /* distinct per-window size so MPI_WIN_SIZE aliasing is visible */
+      MPI_Win_create(bufs[i], (MPI_Aint)((i + 1) * sizeof(double)),
+                     sizeof(double), MPI_INFO_NULL, MPI_COMM_SELF,
+                     &wins[i]);
+      MPI_Win_set_attr(wins[i], kv, (void *)(uintptr_t)(7000 + i));
+    }
+    int ok = 1;
+    void *val;
+    int flag;
+    /* predefined MPI_WIN_SIZE returns a POINTER to the value; read
+     * every window's while all are live — slot aliasing would
+     * overwrite an earlier window's cell */
+    void *ptrs[NW];
+    for (int i = 0; i < NW; i++) {
+      MPI_Win_get_attr(wins[i], MPI_WIN_SIZE, &val, &flag);
+      ptrs[i] = val;
+      if (!flag || *(MPI_Aint *)val != (MPI_Aint)((i + 1) * sizeof(double)))
+        ok = 0;
+    }
+    /* returned addresses must stay valid and correct after later reads */
+    for (int i = 0; i < NW; i++)
+      if (*(MPI_Aint *)ptrs[i] != (MPI_Aint)((i + 1) * sizeof(double)))
+        ok = 0;
+    CHECK(ok, "win_attr_28_windows_no_alias");
+    /* user keyvals: the stored void* comes back VERBATIM (MPI 7.7.2) */
+    ok = 1;
+    for (int i = 0; i < NW; i++) {
+      MPI_Win_get_attr(wins[i], kv, &val, &flag);
+      if (!flag || val != (void *)(uintptr_t)(7000 + i)) ok = 0;
+    }
+    CHECK(ok, "win_attr_user_verbatim");
+    for (int i = 0; i < NW; i++) MPI_Win_free(&wins[i]);
+    MPI_Win_free_keyval(&kv);
+  }
+
+  /* -- Get_elements: basic leaf count for derived types ------------- */
+  {
+    MPI_Datatype pair;
+    MPI_Type_contiguous(3, MPI_DOUBLE, &pair);
+    MPI_Type_commit(&pair);
+    double sbuf[6] = {1, 2, 3, 4, 5, 6}, rbuf[6] = {0};
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+    MPI_Status st;
+    MPI_Sendrecv(sbuf, 2, pair, right, 77, rbuf, 2, pair, left, 77,
+                 MPI_COMM_WORLD, &st);
+    int cnt = -1, elems = -1;
+    MPI_Get_count(&st, pair, &cnt);
+    MPI_Get_elements(&st, pair, &elems);
+    CHECK(cnt == 2 && elems == 6, "get_elements_derived");
+    MPI_Count ex = -1;
+    MPI_Get_elements_x(&st, pair, &ex);
+    CHECK(ex == 6, "get_elements_x_derived");
+    MPI_Type_free(&pair);
+  }
+
+  /* -- predefined copy/delete fns are real callable symbols --------- */
+  {
+    int flag = -1;
+    void *out = NULL;
+    CHECK(MPI_COMM_NULL_COPY_FN(MPI_COMM_WORLD, 1, NULL, (void *)5, &out,
+                                &flag) == MPI_SUCCESS && flag == 0,
+          "null_copy_fn_symbol");
+    CHECK(MPI_COMM_DUP_FN(MPI_COMM_WORLD, 1, NULL, (void *)5, &out,
+                          &flag) == MPI_SUCCESS && flag == 1 &&
+              out == (void *)5,
+          "dup_fn_symbol");
+    CHECK(MPI_WIN_NULL_DELETE_FN(0, 1, NULL, NULL) == MPI_SUCCESS,
+          "null_delete_fn_symbol");
+  }
+
   MPI_Barrier(MPI_COMM_WORLD);
   if (rank == 0) printf("SUITE3 COMPLETE\n");
   MPI_Finalize();

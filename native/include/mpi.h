@@ -38,7 +38,8 @@ typedef struct MPI_Status {
   int MPI_SOURCE;
   int MPI_TAG;
   int MPI_ERROR;
-  int _count; /* internal: received element count */
+  long long _nbytes; /* internal: received byte count (Get_count
+                      * divides by the queried datatype's size) */
 } MPI_Status;
 
 #define MPI_STATUS_IGNORE ((MPI_Status *)0)
@@ -558,19 +559,23 @@ typedef int(MPI_Grequest_query_function)(void *, MPI_Status *);
 typedef int(MPI_Grequest_free_function)(void *);
 typedef int(MPI_Grequest_cancel_function)(void *, int);
 
-/* predefined copy/delete fns: sentinel addresses the shim recognizes */
-#define MPI_COMM_NULL_COPY_FN ((MPI_Comm_copy_attr_function *)0)
-#define MPI_COMM_DUP_FN ((MPI_Comm_copy_attr_function *)1)
-#define MPI_COMM_NULL_DELETE_FN ((MPI_Comm_delete_attr_function *)0)
-#define MPI_NULL_COPY_FN MPI_COMM_NULL_COPY_FN
-#define MPI_DUP_FN MPI_COMM_DUP_FN
-#define MPI_NULL_DELETE_FN MPI_COMM_NULL_DELETE_FN
-#define MPI_TYPE_NULL_COPY_FN ((MPI_Type_copy_attr_function *)0)
-#define MPI_TYPE_DUP_FN ((MPI_Type_copy_attr_function *)1)
-#define MPI_TYPE_NULL_DELETE_FN ((MPI_Type_delete_attr_function *)0)
-#define MPI_WIN_NULL_COPY_FN ((MPI_Win_copy_attr_function *)0)
-#define MPI_WIN_DUP_FN ((MPI_Win_copy_attr_function *)1)
-#define MPI_WIN_NULL_DELETE_FN ((MPI_Win_delete_attr_function *)0)
+/* predefined copy/delete fns: REAL exported symbols (the same 13 the
+ * reference libmpi exports; the shim also still honors the historical
+ * sentinel addresses 0/1 for binaries built against older headers). */
+extern int MPI_COMM_NULL_COPY_FN(MPI_Comm, int, void *, void *, void *,
+                                 int *);
+extern int MPI_COMM_DUP_FN(MPI_Comm, int, void *, void *, void *, int *);
+extern int MPI_COMM_NULL_DELETE_FN(MPI_Comm, int, void *, void *);
+extern int MPI_NULL_COPY_FN(MPI_Comm, int, void *, void *, void *, int *);
+extern int MPI_DUP_FN(MPI_Comm, int, void *, void *, void *, int *);
+extern int MPI_NULL_DELETE_FN(MPI_Comm, int, void *, void *);
+extern int MPI_TYPE_NULL_COPY_FN(MPI_Datatype, int, void *, void *, void *,
+                                 int *);
+extern int MPI_TYPE_DUP_FN(MPI_Datatype, int, void *, void *, void *, int *);
+extern int MPI_TYPE_NULL_DELETE_FN(MPI_Datatype, int, void *, void *);
+extern int MPI_WIN_NULL_COPY_FN(MPI_Win, int, void *, void *, void *, int *);
+extern int MPI_WIN_DUP_FN(MPI_Win, int, void *, void *, void *, int *);
+extern int MPI_WIN_NULL_DELETE_FN(MPI_Win, int, void *, void *);
 
 #define TPUMPI_PROTO2(ret, name, args) \
   ret MPI_##name args;                 \
@@ -897,6 +902,12 @@ TPUMPI_PROTO2(int, Message_c2f, (MPI_Message message))
 TPUMPI_PROTO2(int, Status_f2c, (const int *f_status, MPI_Status *c_status))
 TPUMPI_PROTO2(int, Status_c2f, (const MPI_Status *c_status, int *f_status))
 
+/* Fortran-interop status sentinels (exported data symbols, matching
+ * the reference libmpi's dynamic symbol table) */
+typedef int MPI_Fint;
+extern MPI_Fint *MPI_F_STATUS_IGNORE;
+extern MPI_Fint *MPI_F_STATUSES_IGNORE;
+
 /* misc locals */
 TPUMPI_PROTO2(int, Alloc_mem, (MPI_Aint size, MPI_Info info, void *baseptr))
 TPUMPI_PROTO2(int, Free_mem, (void *base))
@@ -1064,7 +1075,8 @@ TPUMPI_PROTO2(int, File_get_view,
 typedef int(MPI_Datarep_conversion_function)(void *, MPI_Datatype, int,
                                              void *, MPI_Offset, void *);
 typedef int(MPI_Datarep_extent_function)(MPI_Datatype, MPI_Aint *, void *);
-#define MPI_CONVERSION_FN_NULL ((MPI_Datarep_conversion_function *)0)
+extern int MPI_CONVERSION_FN_NULL(void *, MPI_Datatype, int, void *,
+                                  MPI_Offset, void *);
 
 #define TPUMPI_PROTO3(ret, name, args) \
   ret MPI_##name args;                 \

@@ -101,6 +101,22 @@ _next_handle = 3  # 1 = MPI_COMM_WORLD, 2 = MPI_COMM_SELF
 _next_req = 1
 _next_group = 2   # 1 = MPI_GROUP_EMPTY
 _next_dtype = 64  # predefined codes stay below
+
+# Predefined pair types (MAXLOC/MINLOC operands) are DERIVED-shaped:
+# register them as ddt Datatypes so size/extent/leaf-count/pack queries
+# see their 2-entry typemaps (MPI_Get_elements on MPI_DOUBLE_INT must
+# report 2 basic elements per pair).
+def _register_pair_types() -> None:
+    from ompi_tpu.ddt import datatype as _ddt
+
+    _dtypes[28] = _ddt.FLOAT_INT
+    _dtypes[29] = _ddt.DOUBLE_INT
+    _dtypes[30] = _ddt.LONG_INT
+    _dtypes[31] = _ddt.TWO_INT
+    _dtypes[32] = _ddt.SHORT_INT
+
+
+_register_pair_types()
 _rank = 0
 _size = 1
 
@@ -138,6 +154,17 @@ def _t_fail(e: BaseException) -> int:
         return int(e.error_class)
     traceback.print_exc()
     return MPI_ERR_OTHER
+
+
+def _unit_nbytes(dtcode: int) -> int:
+    """Packed byte size of ONE instance of a datatype code — the unit
+    the C status's byte count (``_nbytes``) is denominated in.  MPI
+    Get_count semantics divide by SIZE (packed), not extent."""
+    d = _dtypes.get(dtcode)
+    if d is not None:
+        return int(d.size)
+    dt = DTYPES.get(dtcode)
+    return int(dt.itemsize) if dt is not None else 1
 
 
 def _view(ptr: int, count: int, dtcode: int) -> np.ndarray:
@@ -228,6 +255,11 @@ def finalize() -> int:
 
         _comms.clear()
         _requests.clear()
+        # deliver any freed-but-completed requests before teardown;
+        # still-pending ones can never complete now (their peers are
+        # finalizing too) and are dropped per MPI's freed-handle liberty
+        _reap_freed_active()
+        _freed_active.clear()
         api.finalize()
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
@@ -325,6 +357,19 @@ def type_size(dtcode: int):
     if dt is None:
         return (MPI_ERR_TYPE, 0)
     return (MPI_SUCCESS, int(dt.itemsize))
+
+
+def type_leaf_count(dtcode: int):
+    """Basic (leaf) elements per datatype instance — what
+    MPI_Get_elements multiplies the type-unit count by (derived types:
+    typemap length; predefined scalars: 1; the predefined pair types
+    28-32 are registered in ``_dtypes`` with 2-entry typemaps)."""
+    d = _dtypes.get(dtcode)
+    if d is not None:
+        return (MPI_SUCCESS, max(1, len(d.typemap)))
+    if DTYPES.get(dtcode) is None:
+        return (MPI_ERR_TYPE, 0)
+    return (MPI_SUCCESS, 1)
 
 
 # -- collectives --------------------------------------------------------
@@ -526,7 +571,8 @@ def recv(ptr, count, dtcode, source, tag, h):
             tag=None if tag == -1 else tag,
         )
         got = _unpack_into(ptr, count, dtcode, payload)
-        return (MPI_SUCCESS, int(st.source), int(st.tag), got)
+        return (MPI_SUCCESS, int(st.source), int(st.tag),
+                got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), -1, -1, 0)
 
@@ -557,7 +603,9 @@ def irecv(ptr, count, dtcode, source, tag, h):
 
 
 def _complete(entry) -> tuple[int, int, int]:
-    """Finish a request entry; returns (source, tag, count)."""
+    """Finish a request entry; returns (source, tag, nbytes) — the
+    count slot is BYTES (what the C status carries; PMPI_Get_count
+    divides by the queried datatype's size)."""
     kind, req, ptr, count, dtcode = entry
     if kind == "done":
         return entry[4] if isinstance(entry[4], tuple) else (0, 0, 0)
@@ -565,13 +613,13 @@ def _complete(entry) -> tuple[int, int, int]:
         payload = req.wait()
         st = req.status
         got = _unpack_into(ptr, count, dtcode, payload)
-        return (int(st.source), int(st.tag), got)
+        return (int(st.source), int(st.tag), got * _unit_nbytes(dtcode))
     if kind == "coll":
         out = req.wait()
         if ptr not in (0, _IN_PLACE) and count:
             flat = np.asarray(out).reshape(-1)[:count]
             _view(ptr, count, dtcode)[:] = flat
-        return (0, 0, count)
+        return (0, 0, count * _unit_nbytes(dtcode))
     raise err.MPIInternalError(f"bad request kind {kind}")
 
 
@@ -588,7 +636,8 @@ def _complete_persistent(rh: int, entry) -> tuple[int, int, int]:
                 st = req.status
                 ptr, count, dtcode = params[0], params[1], params[2]
                 got = _unpack_into(ptr, count, dtcode, payload)
-                out = (int(st.source), int(st.tag), got)
+                out = (int(st.source), int(st.tag),
+                       got * _unit_nbytes(dtcode))
             else:
                 req.wait()
     finally:
@@ -1611,7 +1660,8 @@ def file_write_at(fh: int, offset: int, ptr: int, count: int,
                    else DTYPES[dtcode].itemsize)
         written = f.write_at(0, int(offset), np.asarray(data))
         esize = f.get_view(0)[1].size
-        return (MPI_SUCCESS, written * esize // max(1, dt_size))
+        return (MPI_SUCCESS,
+                (written * esize // max(1, dt_size)) * dt_size)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -1629,7 +1679,7 @@ def file_read_at(fh: int, offset: int, ptr: int, count: int, dtcode: int):
         got = int(np.asarray(out).size)
         if got:
             _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
-        return (MPI_SUCCESS, got)
+        return (MPI_SUCCESS, got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -1642,7 +1692,8 @@ def file_write(fh: int, ptr: int, count: int, dtcode: int):
         esize = f.get_view(0)[1].size
         dt_size = (_dtypes[dtcode].size if dtcode in _dtypes
                    else DTYPES[dtcode].itemsize)
-        return (MPI_SUCCESS, written * esize // max(1, dt_size))
+        return (MPI_SUCCESS,
+                (written * esize // max(1, dt_size)) * dt_size)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -1660,7 +1711,7 @@ def file_read(fh: int, ptr: int, count: int, dtcode: int):
         got = int(np.asarray(out).size)
         if got:
             _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
-        return (MPI_SUCCESS, got)
+        return (MPI_SUCCESS, got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -1706,8 +1757,8 @@ def file_set_view(fh: int, disp: int, etype_code: int, filetype_code: int):
 
 
 def iprobe(source: int, tag: int, h: int):
-    """MPI_Iprobe: (flag, source, tag, count) — count in ELEMENTS of
-    the payload's dtype (what PMPI_Get_count reports verbatim)."""
+    """MPI_Iprobe: (flag, source, tag, nbytes) — payload BYTES (the C
+    status unit; PMPI_Get_count divides by the queried type's size)."""
     try:
         c = _comm(h)
         me = comm_rank(h)[1]
@@ -1715,19 +1766,19 @@ def iprobe(source: int, tag: int, h: int):
                       None if tag == -1 else tag)
         if st is None:
             return (MPI_SUCCESS, 0, -1, -1, 0)
-        return (MPI_SUCCESS, 1, int(st.source), int(st.tag), int(st.count))
+        return (MPI_SUCCESS, 1, int(st.source), int(st.tag), int(st.nbytes))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), 0, -1, -1, 0)
 
 
 def probe(source: int, tag: int, h: int):
-    """MPI_Probe (blocking)."""
+    """MPI_Probe (blocking); count slot in payload BYTES."""
     try:
         c = _comm(h)
         me = comm_rank(h)[1]
         st = c.probe(me, None if source == -1 else source,
                      None if tag == -1 else tag)
-        return (MPI_SUCCESS, int(st.source), int(st.tag), int(st.count))
+        return (MPI_SUCCESS, int(st.source), int(st.tag), int(st.nbytes))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), -1, -1, 0)
 
@@ -2212,7 +2263,8 @@ def sendrecv_replace(ptr: int, count: int, dtcode: int, dest: int,
         payload = req.wait()
         st = req.status
         got = _unpack_into(ptr, count, dtcode, payload)
-        return (MPI_SUCCESS, int(st.source), int(st.tag), got)
+        return (MPI_SUCCESS, int(st.source), int(st.tag),
+                got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), -1, -1, 0)
 
@@ -2402,8 +2454,93 @@ def start(rh: int) -> int:
 
 
 def request_free(rh: int) -> int:
-    _requests.pop(rh, None)
-    return MPI_SUCCESS
+    """MPI_Request_free: the handle dies now, but an ACTIVE operation
+    must be allowed to run to completion (MPI 3.7.3) — including the
+    delivery of a freed irecv's payload into the user buffer (the
+    standard pattern: post irecv, free the handle, learn of completion
+    through a later barrier).  Live requests are detached — normalized
+    to a (kind, req, ptr, count, dtcode) completion record — onto a
+    background list reaped opportunistically (each free / finalize);
+    completion runs the same ``_complete`` delivery a wait would."""
+    try:
+        entry = _requests.pop(rh, None)
+        _reap_freed_active()
+        if entry is None:
+            return MPI_SUCCESS
+        kind, req = entry[0], entry[1]
+        if req is None or kind in ("done", "grequest"):
+            return MPI_SUCCESS
+        if kind == "pers_recv":
+            p = entry[2]
+            norm = ("recv", req, p[0], p[1], p[2])
+        elif kind == "pers_send":
+            norm = ("send", req, 0, 0, 0)
+        else:
+            norm = entry
+        if req.test():
+            _finish_freed(norm)
+        elif not _hook_freed_delivery(req, norm):
+            _freed_active.append(norm)
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+_freed_active: list = []  # detached live completion records
+
+
+def _hook_freed_delivery(req, norm) -> bool:
+    """Chain the request's ``_deliver`` so the user-buffer unpack runs
+    the moment the payload lands (on the delivering thread) — the
+    freed-irecv + barrier + read-buffer pattern must see the data
+    without any further MPI library call.  Returns False when the
+    request kind has no delivery hook (caller falls back to the reap
+    list)."""
+    orig = getattr(req, "_deliver", None)
+    if orig is None or not callable(orig):
+        return False
+    fired = []
+
+    def hooked(payload, status, _orig=orig):
+        _orig(payload, status)
+        fired.append(1)
+        _finish_freed(norm)
+
+    req._deliver = hooked
+    # raced: delivered between the test() above and the hook landing
+    if not fired and req.test():
+        _finish_freed(norm)
+    return True
+
+
+def _finish_freed(norm) -> None:
+    """Run a detached request's completion action (buffer delivery for
+    recv/coll kinds).  Errors are swallowed: the handle is gone, so
+    there is no request to report them through (MPI's liberty for
+    freed requests)."""
+    try:
+        if norm[0] in ("recv", "coll"):
+            _complete(norm)
+        else:
+            norm[1].wait()
+    except BaseException:  # noqa: BLE001
+        pass
+
+
+def _reap_freed_active() -> None:
+    if not _freed_active:
+        return
+    keep = []
+    for norm in _freed_active:
+        try:
+            done = norm[1].test()
+        except BaseException:  # noqa: BLE001
+            done = True  # errored in flight: nothing left to deliver
+        if done:
+            _finish_freed(norm)
+        else:
+            keep.append(norm)
+    _freed_active[:] = keep
 
 
 def request_get_status(rh: int):
@@ -3085,7 +3222,8 @@ def file_write_all(fh: int, ptr: int, count: int, dtcode: int):
                    else DTYPES[dtcode].itemsize)
         written = f.write_all([np.asarray(data)])[0]
         esize = f.get_view(0)[1].size
-        return (MPI_SUCCESS, written * esize // max(1, dt_size))
+        return (MPI_SUCCESS,
+                (written * esize // max(1, dt_size)) * dt_size)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3104,7 +3242,7 @@ def file_read_all(fh: int, ptr: int, count: int, dtcode: int):
         got = int(np.asarray(out).size)
         if got:
             _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
-        return (MPI_SUCCESS, got)
+        return (MPI_SUCCESS, got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3114,7 +3252,7 @@ def file_write_shared(fh: int, ptr: int, count: int, dtcode: int):
         f = _file(fh)[0]
         data = _pack_from(ptr, count, dtcode)
         written = f.write_shared(0, np.asarray(data))
-        return (MPI_SUCCESS, int(written))
+        return (MPI_SUCCESS, int(written) * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -3130,7 +3268,7 @@ def file_read_shared(fh: int, ptr: int, count: int, dtcode: int):
         got = int(np.asarray(out).size)
         if got:
             _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
-        return (MPI_SUCCESS, got)
+        return (MPI_SUCCESS, got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -4075,7 +4213,7 @@ def file_write_ordered(fh: int, ptr: int, count: int, dtcode: int):
                 "scoped in this build (see MPI_File_open notes)")
         data = _pack_from(ptr, count, dtcode)
         written = f.write_ordered([np.asarray(data)])[0]
-        return (MPI_SUCCESS, int(written))
+        return (MPI_SUCCESS, int(written) * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 
@@ -4095,7 +4233,7 @@ def file_read_ordered(fh: int, ptr: int, count: int, dtcode: int):
         got = int(np.asarray(out).size)
         if got:
             _view(ptr, got, dtcode)[:] = np.asarray(out).reshape(-1)
-        return (MPI_SUCCESS, got)
+        return (MPI_SUCCESS, got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), 0)
 

@@ -455,6 +455,15 @@ class _ShmRing:
 
     Layout: [0:8) tail (receiver-owned), [8:16) head (sender-owned,
     diagnostic), [16:) payload bytes.
+
+    Memory-ordering contract: the tail publish in :meth:`read` is a
+    plain int64 store after the copy-out loads.  That is safe on x86
+    (TSO: loads are not reordered past later stores) — the only
+    platform this transport targets (see the Linux/abstract-socket
+    gate in :class:`ShmTransport`).  A weakly-ordered host (ARM) would
+    need a release fence before the tail store; the unix-socket
+    control frame only orders sender→receiver, not this
+    receiver→sender edge.
     """
 
     HDR = 16
@@ -557,7 +566,20 @@ class ShmTransport(TcpTransport):
 
     def _make_listen(self, host: str):
         import os
+        import sys
 
+        import platform
+
+        machine = platform.machine().lower()
+        if sys.platform != "linux" or machine not in ("x86_64", "amd64"):
+            from ompi_tpu.core.errors import MPIInternalError
+
+            raise MPIInternalError(
+                "btl/sm requires Linux/x86-64 (abstract-namespace unix "
+                "sockets, /dev/shm rings, and the TSO ordering the ring "
+                "counters rely on — see _ShmRing); select --mca btl tcp "
+                f"on {sys.platform}/{machine}"
+            )
         lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         name = f"ompi-tpu-{os.getpid()}-{id(self) & 0xffffff:x}"
         lst.bind("\0" + name)  # abstract namespace: no fs cleanup

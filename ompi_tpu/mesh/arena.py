@@ -143,13 +143,15 @@ class HbmArena:
 
     # -- device-temporary free list (mpool free list proper) -----------
 
-    def acquire(self, shape: tuple, dtype, sharding,
-                fill: float = 0) -> jax.Array:
+    def acquire(self, shape: tuple, dtype, sharding) -> jax.Array:
         """A pooled device buffer of the given signature: pool hit when
         one is free, fresh allocation otherwise.  Contents are
-        unspecified on a hit (callers use these as tokens/scratch).
-        The sharding is part of the pool key — a replicated token is
-        never served where a rank-sharded one was asked for."""
+        **unspecified** (pool hits return stale bytes — callers use
+        these strictly as tokens/scratch whose values are never read;
+        there is deliberately no fill parameter so value-dependent use
+        cannot be expressed).  The sharding is part of the pool key — a
+        replicated token is never served where a rank-sharded one was
+        asked for."""
         key = (tuple(shape), np.dtype(dtype).str, sharding)
         with self._lock:
             lst = self._free.get(key)
@@ -162,7 +164,7 @@ class HbmArena:
         if spc.attached():
             spc.inc("arena_pool_alloc")
         return jax.device_put(
-            np.full(shape, fill, np.dtype(dtype)), sharding)
+            np.zeros(shape, np.dtype(dtype)), sharding)
 
     def release(self, buf: jax.Array) -> None:
         """Return a buffer to the free list (drops it when full or when

@@ -48,10 +48,11 @@ class Status:
     source: int
     tag: int
     count: int  # elements of the payload's dtype
+    nbytes: int = 0  # payload bytes (what the C ABI's status carries)
 
     @classmethod
     def null(cls) -> "Status":
-        return cls(PROC_NULL, ANY_TAG, 0)
+        return cls(PROC_NULL, ANY_TAG, 0, 0)
 
 
 def _copy_payload(buf, dest_device=None):
@@ -71,6 +72,16 @@ def _count_of(payload) -> int:
         return int(np.prod(np.shape(payload)))
     except Exception:
         return 0
+
+
+def _nbytes_of(payload) -> int:
+    try:
+        return int(payload.nbytes)
+    except AttributeError:
+        try:
+            return int(np.asarray(payload).nbytes)
+        except Exception:
+            return 0
 
 
 @dataclass
@@ -165,7 +176,8 @@ class MatchingEngine:
                 if (p.source in (ANY_SOURCE, source)) and (p.tag in (ANY_TAG, tag)):
                     posted.pop(i)
                     p.request._deliver(
-                        data, Status(source, tag, _count_of(data))
+                        data,
+                        Status(source, tag, _count_of(data), _nbytes_of(data)),
                     )
                     return
             self._unexpected[dest].append(_Unexpected(source, tag, data, seq))
@@ -189,7 +201,11 @@ class MatchingEngine:
                         best = i
             if best is not None:
                 m = uq.pop(best)
-                req._deliver(m.payload, Status(m.source, m.tag, _count_of(m.payload)))
+                req._deliver(
+                    m.payload,
+                    Status(m.source, m.tag, _count_of(m.payload),
+                           _nbytes_of(m.payload)),
+                )
                 return req
             self._posted[dest].append(_Posted(source, tag, req, self._next_seq()))
         return req
@@ -212,7 +228,8 @@ class MatchingEngine:
                         best = m
             if best is None:
                 return None
-            return Status(best.source, best.tag, _count_of(best.payload))
+            return Status(best.source, best.tag, _count_of(best.payload),
+                          _nbytes_of(best.payload))
 
     def pending_unexpected(self, dest: int) -> int:
         with self._lock:

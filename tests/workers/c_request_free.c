@@ -1,0 +1,61 @@
+/* Verify round-4 batch 1: Request_free payload delivery, Get_elements
+ * on derived + pair types, real predefined-fn symbols, win attrs. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdint.h>
+#include <string.h>
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  int fails = 0;
+
+  /* Request_free on an active irecv: payload must still land */
+  {
+    int buf[4] = {-1, -1, -1, -1};
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+    MPI_Request rr;
+    MPI_Irecv(buf, 4, MPI_INT, left, 5, MPI_COMM_WORLD, &rr);
+    MPI_Request_free(&rr);  /* legal: op must complete anyway */
+    int sbuf[4] = {rank, rank + 1, rank + 2, rank + 3};
+    MPI_Send(sbuf, 4, MPI_INT, right, 5, MPI_COMM_WORLD);
+    MPI_Barrier(MPI_COMM_WORLD);  /* completion learned out of band;
+                                   * NO further request calls: delivery
+                                   * must happen on arrival */
+    if (buf[0] != left || buf[3] != left + 3) {
+      fprintf(stderr, "FAIL request_free_delivery rank=%d got %d %d\n",
+              rank, buf[0], buf[3]);
+      fails++;
+    } else printf("OK request_free_delivery rank=%d\n", rank);
+  }
+
+  /* Get_elements with pair type */
+  {
+    struct { double v; int i; } pbuf[3];
+    MPI_Status st;
+    if (size >= 2) {
+      if (rank == 0) {
+        memset(pbuf, 0, sizeof pbuf);
+        MPI_Recv(pbuf, 3, MPI_DOUBLE_INT, 1, 9, MPI_COMM_WORLD, &st);
+        int elems = -1, cnt = -1;
+        MPI_Get_count(&st, MPI_DOUBLE_INT, &cnt);
+        MPI_Get_elements(&st, MPI_DOUBLE_INT, &elems);
+        if (cnt != 3 || elems != 6) {
+          fprintf(stderr, "FAIL pair_elements cnt=%d elems=%d\n", cnt, elems);
+          fails++;
+        } else printf("OK pair_elements rank=%d\n", rank);
+      } else if (rank == 1) {
+        for (int i = 0; i < 3; i++) { pbuf[i].v = i; pbuf[i].i = 10 + i; }
+        MPI_Send(pbuf, 3, MPI_DOUBLE_INT, 0, 9, MPI_COMM_WORLD);
+      }
+    }
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (fails) { MPI_Abort(MPI_COMM_WORLD, 3); }
+  if (rank == 0) printf("RFREE COMPLETE\n");
+  MPI_Finalize();
+  return 0;
+}
