@@ -1,0 +1,1760 @@
+// libtpudcn — the native host data plane (btl/sm + btl/tcp + bml/r2 +
+// the pml matching fast path, in C++).
+//
+// ≈ the reference's opal/mca/btl/{tcp,sm} + bml/r2 byte movers and the
+// hot half of pml/ob1's matching engine (SURVEY.md §2.2/§2.3: the
+// native-required rows — "shared-memory & TCP transports", progress
+// engine, request engine).  The Python side keeps the CONTROL plane
+// (MCA selection, rendezvous policy, communicator bookkeeping, ULFM
+// decisions); every byte and every matching decision on the critical
+// path happens here, so a blocked receiver sleeps in C on a condition
+// variable and is woken by the C receiver thread — zero Python (and
+// zero GIL) between wire and wakeup.
+//
+// Transports per peer (chosen by host identity, as bml/r2 does):
+//   * same host  — one shared-memory SPSC byte ring per direction
+//     (8-byte-aligned length-prefixed records, chunked streaming for
+//     payloads larger than the ring, futex doorbell wakeups): the
+//     mmap FIFO of the reference's btl/sm without its per-frame
+//     socket syscalls;
+//   * cross host — framed TCP with eager/rendezvous (RTS/CTS/FRAG)
+//     exactly like the Python transport, but framed/parsed natively.
+//
+// Delivery classes (the `kind` byte):
+//   COLL — (cid, seq, src)-keyed one-shot slots; tdcn_recv_coll blocks
+//          on the slot's condvar (the DCN collective schedules);
+//   P2P  — the native matching engine: per-(cid, dst-rank) posted /
+//          unexpected queues, ANY_SOURCE/ANY_TAG wildcards, strict
+//          arrival-order (non-overtaking) matching; local (same
+//          process) sends enter the same queues as handle references
+//          so wildcard matching is total-ordered across local+remote;
+//   PY   — JSON-enveloped frames for the Python dispatcher thread
+//          (heartbeats, ULFM gossip/revoke, OSC RMA envelopes, and
+//          any communicator whose pml is interposed by monitoring /
+//          vprotocol — full compatibility, lower priority).
+//
+// Cited reference behaviors: lazy connect on first send
+// (mca_btl_tcp_add_procs), receiver-thread delivery (the libevent
+// progress loop), eager↔rendezvous switch with CTS flow control
+// (pml/ob1 over btl_tcp), single-copy shared-memory rings (btl/sm +
+// smsc), per-peer transport scheduling (bml/r2).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <malloc.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstddef>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------------
+// wire format
+// ---------------------------------------------------------------------
+
+enum FrameType : uint8_t {
+  FT_EAGER = 0,
+  FT_RTS = 1,
+  FT_CTS = 2,
+  FT_FRAG = 3,
+  FT_SETUP = 4,  // announces the sender's shm ring (same-host peers)
+};
+
+enum FrameKind : uint8_t { FK_COLL = 0, FK_P2P = 1, FK_PY = 2 };
+
+static const uint32_t TDCN_MAGIC = 0x7444434eu;  // "tDCN"
+
+#pragma pack(push, 1)
+struct WireHdr {
+  uint32_t magic;
+  uint8_t type;
+  uint8_t kind;
+  uint8_t dtype_len;  // <= 15
+  uint8_t ndim;       // <= 8
+  int32_t src, dst, tag;
+  int32_t from_proc;  // sender's engine index (peer bookkeeping)
+  int64_t seq;        // coll sequence / rendezvous xid
+  uint64_t off;       // FRAG payload offset
+  uint64_t total;     // full payload bytes (RTS/FRAG reassembly)
+  uint64_t nbytes;    // payload bytes IN THIS FRAME
+  uint16_t cid_len;
+  uint16_t pad;
+  uint32_t meta_len;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(WireHdr) == 64, "wire header is 64 bytes");
+
+// The C <-> Python message record (ctypes mirror in dcn/native.py).
+#pragma pack(push, 1)
+struct TdcnMsg {
+  int32_t kind, src, dst, tag;
+  int64_t seq;
+  uint64_t pyhandle;  // nonzero: payload lives in the Python table
+  void *data;         // malloc'd payload (caller frees via tdcn_free)
+  uint64_t nbytes;
+  int64_t count;  // element count for pyhandle messages (status)
+  char dtype[16];
+  int32_t ndim;
+  int64_t shape[8];
+  char cid[128];
+  void *meta;  // malloc'd JSON bytes or NULL
+  uint32_t meta_len;
+};
+#pragma pack(pop)
+
+// ---------------------------------------------------------------------
+// small utilities
+// ---------------------------------------------------------------------
+
+static int futex_wait(std::atomic<uint32_t> *addr, uint32_t expect,
+                      const struct timespec *ts) {
+  return (int)syscall(SYS_futex, (uint32_t *)addr, FUTEX_WAIT, expect, ts,
+                      nullptr, 0);
+}
+
+static int futex_wake(std::atomic<uint32_t> *addr, int n) {
+  return (int)syscall(SYS_futex, (uint32_t *)addr, FUTEX_WAKE, n, nullptr,
+                      nullptr, 0);
+}
+
+static uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+static bool recv_exact(int fd, void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+static bool send_all(int fd, const void *buf, size_t n) {
+  const char *p = (const char *)buf;
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+static bool writev_all(int fd, struct iovec *iov, int cnt) {
+  while (cnt) {
+    ssize_t r = ::writev(fd, iov, cnt);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    size_t left = (size_t)r;
+    while (cnt && left >= iov->iov_len) {
+      left -= iov->iov_len;
+      ++iov;
+      --cnt;
+    }
+    if (cnt && left) {
+      iov->iov_base = (char *)iov->iov_base + left;
+      iov->iov_len -= left;
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------
+// shared-memory SPSC ring (one per direction per same-host peer pair)
+// ---------------------------------------------------------------------
+//
+// Layout: [Ctrl][data bytes].  Records are 8-aligned:
+//   u64 len | WireHdr | cid | dtype | shape | meta | payload
+// Producer owns head, consumer owns tail (both monotonic byte counts).
+// A record never wraps: if it would, the producer writes a PAD record
+// (len with high bit set = skip to ring start).  The doorbell is a
+// separate per-RECEIVER shm word every sender bumps (futex wake); the
+// receiver's poll thread futex-waits on it.
+
+struct ShmCtrl {
+  std::atomic<uint64_t> head;  // producer cursor
+  std::atomic<uint64_t> tail;  // consumer cursor
+  char pad[48];
+};
+
+static const uint64_t PAD_BIT = 1ull << 63;
+
+struct ShmRing {
+  ShmCtrl *ctrl = nullptr;
+  uint8_t *data = nullptr;
+  uint64_t size = 0;
+  std::string name;
+  int fd = -1;
+
+  bool create(const std::string &nm, uint64_t sz) {
+    name = nm;
+    fd = shm_open(nm.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return false;
+    if (ftruncate(fd, (off_t)(sizeof(ShmCtrl) + sz)) != 0) return false;
+    void *m = mmap(nullptr, sizeof(ShmCtrl) + sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) return false;
+    ctrl = (ShmCtrl *)m;
+    data = (uint8_t *)m + sizeof(ShmCtrl);
+    size = sz;
+    ctrl->head.store(0, std::memory_order_relaxed);
+    ctrl->tail.store(0, std::memory_order_relaxed);
+    return true;
+  }
+
+  bool open_existing(const std::string &nm) {
+    name = nm;
+    fd = shm_open(nm.c_str(), O_RDWR, 0600);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0) return false;
+    void *m = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) return false;
+    ctrl = (ShmCtrl *)m;
+    data = (uint8_t *)m + sizeof(ShmCtrl);
+    size = (uint64_t)st.st_size - sizeof(ShmCtrl);
+    return true;
+  }
+
+  uint64_t free_space() const {
+    return size - (ctrl->head.load(std::memory_order_relaxed) -
+                   ctrl->tail.load(std::memory_order_acquire));
+  }
+
+  // Reserve space for one contiguous record of `need` bytes (8-aligned,
+  // including the u64 length prefix).  Returns the write pointer or
+  // nullptr on timeout (receiver stalled).  Single producer: only the
+  // sender's per-peer lock holder calls this.
+  uint8_t *reserve(uint64_t need, uint64_t *rec_start,
+                   std::atomic<bool> *closing) {
+    need = (need + 7) & ~7ull;
+    uint64_t spin = 0;
+    for (;;) {
+      if (closing->load(std::memory_order_relaxed)) return nullptr;
+      uint64_t head = ctrl->head.load(std::memory_order_relaxed);
+      uint64_t pos = head % size;
+      uint64_t contig = size - pos;
+      uint64_t want = need;
+      bool pad = false;
+      if (contig < need) {  // must pad to ring start first
+        want = contig + need;
+        pad = true;
+      }
+      if (size - (head - ctrl->tail.load(std::memory_order_acquire)) >=
+          want) {
+        if (pad) {
+          *(uint64_t *)(data + pos) = PAD_BIT | contig;
+          head += contig;
+          pos = 0;
+        }
+        *rec_start = head;
+        return data + pos;
+      }
+      if (++spin < 2048) {
+        sched_yield();
+      } else {
+        struct timespec ts = {0, 200000};  // 200 us
+        nanosleep(&ts, nullptr);
+      }
+    }
+  }
+
+  void publish(uint64_t rec_start, uint64_t rec_len) {
+    // release: record bytes visible before head moves
+    ctrl->head.store(rec_start + ((rec_len + 7) & ~7ull),
+                     std::memory_order_release);
+  }
+
+  void destroy(bool unlink_name) {
+    if (ctrl) munmap((void *)ctrl, sizeof(ShmCtrl) + size);
+    if (fd >= 0) close(fd);
+    if (unlink_name && !name.empty()) shm_unlink(name.c_str());
+    ctrl = nullptr;
+  }
+};
+
+// doorbell segment: one futex word per receiver process
+struct Doorbell {
+  std::atomic<uint32_t> *word = nullptr;
+  std::string name;
+  int fd = -1;
+
+  bool create(const std::string &nm) {
+    name = nm;
+    fd = shm_open(nm.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return false;
+    if (ftruncate(fd, 4096) != 0) return false;
+    void *m = mmap(nullptr, 4096, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) return false;
+    word = (std::atomic<uint32_t> *)m;
+    word->store(0);
+    return true;
+  }
+
+  bool open_existing(const std::string &nm) {
+    name = nm;
+    fd = shm_open(nm.c_str(), O_RDWR, 0600);
+    if (fd < 0) return false;
+    void *m = mmap(nullptr, 4096, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) return false;
+    word = (std::atomic<uint32_t> *)m;
+    return true;
+  }
+
+  void ring() {
+    word->fetch_add(1, std::memory_order_release);
+    // wake everyone: inline-progress waiters AND the backstop poller
+    // race via try_lock; waking only one risks handing the frame to
+    // the poller and paying a second thread handoff to the waiter
+    futex_wake(word, 64);
+  }
+
+  void destroy(bool unlink_name) {
+    if (word) munmap((void *)word, 4096);
+    if (fd >= 0) close(fd);
+    if (unlink_name && !name.empty()) shm_unlink(name.c_str());
+    word = nullptr;
+  }
+};
+
+// ---------------------------------------------------------------------
+// engine data structures
+// ---------------------------------------------------------------------
+
+struct Env {
+  uint8_t kind;
+  int32_t src, dst, tag;
+  int64_t seq;
+  std::string cid;
+  std::string dtype;
+  int ndim = 0;
+  int64_t shape[8] = {0};
+  std::string meta;
+};
+
+struct OwnedMsg {
+  Env env;
+  void *data = nullptr;  // malloc'd
+  uint64_t nbytes = 0;
+  uint64_t pyhandle = 0;  // nonzero: Python-side payload
+  int64_t count = 0;      // element count when pyhandle != 0
+  uint64_t arrival = 0;   // matching order stamp
+};
+
+struct PostedReq {
+  uint64_t id;
+  int32_t src, tag;  // -1 wildcards
+  uint64_t order;
+};
+
+struct ReqState {
+  std::atomic<bool> completed{false};
+  bool cancelled = false;
+  OwnedMsg msg;
+  std::condition_variable cv;
+};
+
+struct CidQueues {
+  // keyed per destination rank
+  std::unordered_map<int32_t, std::deque<OwnedMsg>> unexpected;
+  std::unordered_map<int32_t, std::vector<PostedReq>> posted;
+};
+
+struct CollSlot {
+  std::atomic<bool> ready{false};
+  OwnedMsg msg;
+  std::condition_variable cv;
+  int waiters = 0;
+};
+
+struct Peer {
+  std::string address;   // composite published address
+  std::string host_id;   // same-host test
+  std::string tcp_host;  // host:port
+  std::string uds_name;  // abstract socket name (setup channel)
+  std::string db_name;   // doorbell shm name
+  int fd = -1;           // connected socket (tcp or uds)
+  bool same_host = false;
+  ShmRing tx_ring;  // our ring toward this peer (created lazily)
+  bool ring_announced = false;
+  Doorbell peer_db;  // peer's doorbell (mapped lazily)
+  std::mutex send_mu;
+  // sender-side rendezvous: xid -> CTS flag
+  std::mutex cts_mu;
+  std::condition_variable cts_cv;
+  std::map<int64_t, bool> cts;
+};
+
+// receiver-side in-flight rendezvous / chunked-ring reassembly
+struct Reassembly {
+  Env env;
+  uint8_t *buf = nullptr;
+  uint64_t total = 0;
+  uint64_t received = 0;
+  bool granted = false;  // holds a rndv slot
+};
+
+struct Engine {
+  int proc = 0, nprocs = 0;
+  std::string host_id;
+  std::string address;
+  std::vector<std::string> peer_addresses;
+  std::unordered_map<std::string, Peer *> peers;  // by composite address
+  std::mutex peers_mu;
+
+  int64_t eager_limit = 4 << 20;
+  int64_t frag_size = 8 << 20;
+  uint64_t ring_bytes = 64ull << 20;
+  int max_rndv = 4;
+
+  int tcp_listen_fd = -1, uds_listen_fd = -1;
+  std::string tcp_addr, uds_name, db_name;
+  Doorbell my_db;
+
+  // rx rings (one per announcing sender), guarded by rings_mu
+  std::mutex rings_mu;
+  std::vector<ShmRing *> rx_rings;
+  std::atomic<uint32_t> db_seen{0};
+  // arbitration between the poller thread and inline-progress waiters
+  std::mutex consume_mu;
+  std::atomic<int> waiters{0};  // inline-progress waiters present
+  int spin_iters = 0;  // doorbell spin before futex (0 on small hosts:
+                       // spinning starves the peer when cores are scarce)
+
+  // ---- unified delivery state (one mutex; np is small) ----
+  std::mutex mu;
+  std::unordered_map<std::string, CidQueues> p2p;  // native-matched cids
+  std::unordered_map<std::string, bool> py_cids;   // cids routed to PY queue
+  std::map<std::tuple<std::string, int64_t, int32_t>, CollSlot *> coll;
+  std::unordered_map<uint64_t, ReqState *> reqs;
+  uint64_t next_req = 1;
+  uint64_t arrival = 1;
+  std::deque<OwnedMsg> py_queue;  // PY-kind frames for the dispatcher
+  std::condition_variable py_cv;
+  std::vector<bool> failed;
+  std::condition_variable fail_cv;  // broadcast on failure marks
+
+  std::atomic<bool> closing{false};
+  std::atomic<uint64_t> bytes_sent{0};
+  // inbound rendezvous flow control
+  std::mutex rndv_mu;
+  std::condition_variable rndv_cv;
+  int rndv_active = 0;
+  std::map<std::pair<int, int64_t>, Reassembly *> reasm;  // (from, xid)
+
+  std::vector<std::thread> threads;
+};
+
+// ---------------------------------------------------------------------
+// frame serialization helpers
+// ---------------------------------------------------------------------
+
+static void fill_hdr(WireHdr *h, uint8_t type, const Env &e, int from_proc,
+                     uint64_t off, uint64_t total, uint64_t nbytes) {
+  memset(h, 0, sizeof(*h));
+  h->magic = TDCN_MAGIC;
+  h->type = type;
+  h->kind = e.kind;
+  h->dtype_len = (uint8_t)e.dtype.size();
+  h->ndim = (uint8_t)e.ndim;
+  h->src = e.src;
+  h->dst = e.dst;
+  h->tag = e.tag;
+  h->from_proc = from_proc;
+  h->seq = e.seq;
+  h->off = off;
+  h->total = total;
+  h->nbytes = nbytes;
+  h->cid_len = (uint16_t)e.cid.size();
+  h->meta_len = (uint32_t)e.meta.size();
+}
+
+// bytes following the header, excluding payload
+static size_t env_extra(const WireHdr &h) {
+  return h.cid_len + h.dtype_len + (size_t)h.ndim * 8 + h.meta_len;
+}
+
+static void write_extra(uint8_t *p, const Env &e) {
+  memcpy(p, e.cid.data(), e.cid.size());
+  p += e.cid.size();
+  memcpy(p, e.dtype.data(), e.dtype.size());
+  p += e.dtype.size();
+  memcpy(p, e.shape, (size_t)e.ndim * 8);
+  p += (size_t)e.ndim * 8;
+  memcpy(p, e.meta.data(), e.meta.size());
+}
+
+static void parse_extra(const WireHdr &h, const uint8_t *p, Env *e) {
+  e->kind = h.kind;
+  e->src = h.src;
+  e->dst = h.dst;
+  e->tag = h.tag;
+  e->seq = h.seq;
+  e->cid.assign((const char *)p, h.cid_len);
+  p += h.cid_len;
+  e->dtype.assign((const char *)p, h.dtype_len);
+  p += h.dtype_len;
+  e->ndim = h.ndim;
+  memcpy(e->shape, p, (size_t)h.ndim * 8);
+  p += (size_t)h.ndim * 8;
+  e->meta.assign((const char *)p, h.meta_len);
+}
+
+// ---------------------------------------------------------------------
+// delivery (engine mutex held)
+// ---------------------------------------------------------------------
+
+static void msg_into_tdcn(OwnedMsg &m, TdcnMsg *out) {
+  memset(out, 0, sizeof(*out));
+  out->kind = m.env.kind;
+  out->src = m.env.src;
+  out->dst = m.env.dst;
+  out->tag = m.env.tag;
+  out->seq = m.env.seq;
+  out->pyhandle = m.pyhandle;
+  out->data = m.data;
+  out->nbytes = m.nbytes;
+  out->count = m.count;
+  snprintf(out->dtype, sizeof(out->dtype), "%s", m.env.dtype.c_str());
+  out->ndim = m.env.ndim;
+  memcpy(out->shape, m.env.shape, sizeof(out->shape));
+  snprintf(out->cid, sizeof(out->cid), "%s", m.env.cid.c_str());
+  if (!m.env.meta.empty()) {
+    out->meta = malloc(m.env.meta.size());
+    memcpy(out->meta, m.env.meta.data(), m.env.meta.size());
+    out->meta_len = (uint32_t)m.env.meta.size();
+  }
+  m.data = nullptr;  // ownership moved
+}
+
+static bool env_match(const PostedReq &p, const OwnedMsg &m) {
+  return (p.src == -1 || p.src == m.env.src) &&
+         (p.tag == -1 || p.tag == m.env.tag);
+}
+
+// Wake inline-progress waiters (they futex-wait on OUR doorbell when
+// not consuming); completions from any transport ring it.
+static void wake_waiters(Engine *eng) {
+  eng->my_db.word->fetch_add(1, std::memory_order_release);
+  futex_wake(eng->my_db.word, 64);
+}
+
+// Deliver one complete inbound message.  Called with eng->mu HELD.
+static void deliver_locked(Engine *eng, OwnedMsg &&m) {
+  m.arrival = eng->arrival++;
+  if (m.env.kind == FK_COLL) {
+    auto key = std::make_tuple(m.env.cid, m.env.seq, m.env.src);
+    auto it = eng->coll.find(key);
+    CollSlot *slot;
+    if (it == eng->coll.end()) {
+      slot = new CollSlot();
+      eng->coll[key] = slot;
+    } else {
+      slot = it->second;
+    }
+    slot->msg = std::move(m);
+    slot->ready = true;
+    slot->cv.notify_all();
+    wake_waiters(eng);
+    return;
+  }
+  if (m.env.kind == FK_P2P) {
+    auto pit = eng->py_cids.find(m.env.cid);
+    if (pit == eng->py_cids.end()) {
+      // native matching
+      CidQueues &q = eng->p2p[m.env.cid];
+      auto &plist = q.posted[m.env.dst];
+      for (size_t i = 0; i < plist.size(); i++) {
+        if (env_match(plist[i], m)) {
+          uint64_t rid = plist[i].id;
+          plist.erase(plist.begin() + i);
+          auto rit = eng->reqs.find(rid);
+          if (rit != eng->reqs.end()) {
+            rit->second->msg = std::move(m);
+            rit->second->completed = true;
+            rit->second->cv.notify_all();
+          }
+          wake_waiters(eng);
+          return;
+        }
+      }
+      q.unexpected[m.env.dst].push_back(std::move(m));
+      return;
+    }
+    // registered for Python delivery: fall through to PY queue
+  }
+  eng->py_queue.push_back(std::move(m));
+  eng->py_cv.notify_one();
+}
+
+// ---------------------------------------------------------------------
+// inbound frame processing (shared by socket loops and ring poller)
+// ---------------------------------------------------------------------
+
+static void finish_reassembly(Engine *eng, const WireHdr &h,
+                              Reassembly *ra) {
+  OwnedMsg m;
+  m.env = std::move(ra->env);
+  m.data = ra->buf;
+  m.nbytes = ra->total;
+  bool granted = ra->granted;
+  {
+    std::lock_guard<std::mutex> g(eng->rndv_mu);
+    eng->reasm.erase({h.from_proc, h.seq});
+    if (granted) {
+      eng->rndv_active--;
+      eng->rndv_cv.notify_one();
+    }
+  }
+  delete ra;
+  std::lock_guard<std::mutex> g(eng->mu);
+  deliver_locked(eng, std::move(m));
+}
+
+static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
+                          const uint8_t *payload, int rx_fd) {
+  Env e;
+  parse_extra(h, extra, &e);
+  switch (h.type) {
+    case FT_EAGER: {
+      OwnedMsg m;
+      m.env = std::move(e);
+      m.nbytes = h.nbytes;
+      if (h.nbytes) {
+        m.data = malloc(h.nbytes);
+        memcpy(m.data, payload, h.nbytes);
+      }
+      std::lock_guard<std::mutex> g(eng->mu);
+      deliver_locked(eng, std::move(m));
+      return;
+    }
+    case FT_CTS: {
+      // sender side: release the waiting send
+      std::lock_guard<std::mutex> g(eng->peers_mu);
+      for (auto &kv : eng->peers) {
+        Peer *p = kv.second;
+        std::lock_guard<std::mutex> g2(p->cts_mu);
+        auto it = p->cts.find(h.seq);
+        if (it != p->cts.end()) {
+          it->second = true;
+          p->cts_cv.notify_all();
+          return;
+        }
+      }
+      return;
+    }
+    case FT_RTS: {
+      auto *ra = new Reassembly();
+      ra->env = std::move(e);
+      // the header seq is the reassembly xid; the TRUE envelope seq
+      // was stashed in h.off by the sender
+      ra->env.seq = (int64_t)h.off;
+      ra->total = h.total;
+      if (rx_fd < 0) {
+        // ring path: no CTS, no slot — the sender blocks on ring
+        // backpressure and sends one transfer at a time per peer, so
+        // ingress memory is bounded by the message itself
+        ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
+        std::lock_guard<std::mutex> g(eng->rndv_mu);
+        eng->reasm[{h.from_proc, h.seq}] = ra;
+        return;
+      }
+      // tcp path: acquire an inbound-rndv slot (bounds ingress
+      // memory), allocate only then, and grant CTS
+      {
+        std::unique_lock<std::mutex> g(eng->rndv_mu);
+        eng->rndv_cv.wait(g, [&] {
+          return eng->rndv_active < eng->max_rndv ||
+                 eng->closing.load(std::memory_order_relaxed);
+        });
+        if (eng->closing.load(std::memory_order_relaxed)) {
+          delete ra;
+          return;
+        }
+        eng->rndv_active++;
+        ra->granted = true;
+        ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
+        eng->reasm[{h.from_proc, h.seq}] = ra;
+      }
+      // CTS rides the same socket back (rx connections are duplex)
+      WireHdr cts;
+      Env ce;
+      ce.seq = h.seq;
+      fill_hdr(&cts, FT_CTS, ce, eng->proc, 0, 0, 0);
+      send_all(rx_fd, &cts, sizeof(cts));
+      return;
+    }
+    case FT_FRAG: {  // ring path (socket FRAGs are handled inline in
+                     // sock_recv_loop with a direct-to-buffer recv)
+      Reassembly *ra = nullptr;
+      {
+        std::lock_guard<std::mutex> g(eng->rndv_mu);
+        auto it = eng->reasm.find({h.from_proc, h.seq});
+        if (it != eng->reasm.end()) ra = it->second;
+      }
+      if (!ra || h.off + h.nbytes > ra->total) return;  // drop
+      memcpy(ra->buf + h.off, payload, h.nbytes);
+      ra->received += h.nbytes;
+      if (ra->received >= ra->total) finish_reassembly(eng, h, ra);
+      return;
+    }
+    default:
+      return;
+  }
+}
+
+// ---------------------------------------------------------------------
+// socket receive loop
+// ---------------------------------------------------------------------
+
+static void sock_recv_loop(Engine *eng, int fd) {
+  std::vector<uint8_t> extra;
+  while (!eng->closing.load(std::memory_order_relaxed)) {
+    WireHdr h;
+    if (!recv_exact(fd, &h, sizeof(h))) break;
+    if (h.magic != TDCN_MAGIC) break;
+    size_t ex = env_extra(h);
+    extra.resize(ex ? ex : 1);
+    if (ex && !recv_exact(fd, extra.data(), ex)) break;
+    if (h.type == FT_SETUP) {
+      // same-host sender announced its tx ring: map it for polling
+      std::string rname((const char *)extra.data(), h.cid_len);
+      auto *ring = new ShmRing();
+      if (ring->open_existing(rname)) {
+        std::lock_guard<std::mutex> g(eng->rings_mu);
+        eng->rx_rings.push_back(ring);
+        eng->my_db.word->fetch_add(1, std::memory_order_release);
+      } else {
+        delete ring;
+      }
+      continue;
+    }
+    if (h.type == FT_EAGER) {
+      // receive straight into the delivery buffer (single copy:
+      // kernel -> destination, like the reference's btl recv path)
+      void *buf = h.nbytes ? malloc(h.nbytes) : nullptr;
+      if (h.nbytes && !recv_exact(fd, buf, h.nbytes)) {
+        free(buf);
+        break;
+      }
+      Env e;
+      parse_extra(h, extra.data(), &e);
+      OwnedMsg m;
+      m.env = std::move(e);
+      m.data = buf;
+      m.nbytes = h.nbytes;
+      std::lock_guard<std::mutex> g(eng->mu);
+      deliver_locked(eng, std::move(m));
+      continue;
+    }
+    if (h.type == FT_FRAG) {
+      // stream straight into the reassembly buffer when it exists
+      Reassembly *ra = nullptr;
+      {
+        std::lock_guard<std::mutex> g(eng->rndv_mu);
+        auto it = eng->reasm.find({h.from_proc, h.seq});
+        if (it != eng->reasm.end()) ra = it->second;
+      }
+      if (ra && h.off + h.nbytes <= ra->total) {
+        if (h.nbytes && !recv_exact(fd, ra->buf + h.off, h.nbytes)) break;
+        ra->received += h.nbytes;
+        if (ra->received >= ra->total) finish_reassembly(eng, h, ra);
+      } else {
+        // unknown transfer: drain and drop
+        std::vector<uint8_t> sink(h.nbytes ? h.nbytes : 1);
+        if (h.nbytes && !recv_exact(fd, sink.data(), h.nbytes)) break;
+      }
+      continue;
+    }
+    process_frame(eng, h, extra.data(), nullptr, fd);
+  }
+  close(fd);
+}
+
+static void accept_loop(Engine *eng, int lfd) {
+  // poll + timeout: close() does NOT wake a blocked accept() on
+  // Linux, so a pure-blocking accept thread would never join
+  while (!eng->closing.load(std::memory_order_relaxed)) {
+    struct pollfd pf = {lfd, POLLIN, 0};
+    int pr = poll(&pf, 1, 100);
+    if (pr < 0 && errno != EINTR) return;
+    if (pr <= 0 || !(pf.revents & POLLIN)) continue;
+    int fd = accept(lfd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(sock_recv_loop, eng, fd).detach();
+  }
+}
+
+// ---------------------------------------------------------------------
+// shm ring consume loop (one thread per engine)
+// ---------------------------------------------------------------------
+
+static void consume_ring(Engine *eng, ShmRing *r) {
+  for (;;) {
+    uint64_t head = r->ctrl->head.load(std::memory_order_acquire);
+    uint64_t tail = r->ctrl->tail.load(std::memory_order_relaxed);
+    if (tail == head) return;
+    uint64_t pos = tail % r->size;
+    uint64_t rec = *(uint64_t *)(r->data + pos);
+    if (rec & PAD_BIT) {
+      r->ctrl->tail.store(tail + (rec & ~PAD_BIT),
+                          std::memory_order_release);
+      continue;
+    }
+    const uint8_t *p = r->data + pos + 8;
+    WireHdr h;
+    memcpy(&h, p, sizeof(h));
+    const uint8_t *extra = p + sizeof(h);
+    const uint8_t *payload = extra + env_extra(h);
+    process_frame(eng, h, extra, payload, -1);
+    r->ctrl->tail.store(tail + ((rec + 7) & ~7ull),
+                        std::memory_order_release);
+  }
+}
+
+// Drain every rx ring once (try-lock arbitrated between the poller
+// thread and inline-progress waiters).  Returns true when any record
+// was consumed.
+static bool try_consume_rings(Engine *eng) {
+  if (!eng->consume_mu.try_lock()) return false;
+  bool any = false;
+  {
+    std::lock_guard<std::mutex> g(eng->rings_mu);
+    for (ShmRing *r : eng->rx_rings) {
+      if (r->ctrl->head.load(std::memory_order_acquire) !=
+          r->ctrl->tail.load(std::memory_order_relaxed)) {
+        consume_ring(eng, r);
+        any = true;
+      }
+    }
+  }
+  eng->consume_mu.unlock();
+  return any;
+}
+
+// The blocked caller IS the progress engine (the reference's
+// opal_progress discipline): consume rings inline, spin briefly on
+// the doorbell, then futex-wait with a short timeout.  `done` is
+// checked with eng->mu held via the caller's lock `g`.
+template <typename Pred>
+static bool progress_wait(Engine *eng, std::unique_lock<std::mutex> &g,
+                          Pred done, double timeout_s) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  struct WaiterMark {  // parks the backstop poller while we drive
+    Engine *e;
+    WaiterMark(Engine *e) : e(e) { e->waiters.fetch_add(1); }
+    ~WaiterMark() { e->waiters.fetch_sub(1); }
+  } mark(eng);
+  while (!done()) {
+    g.unlock();
+    bool consumed = try_consume_rings(eng);
+    if (!consumed) {
+      uint32_t seen = eng->my_db.word->load(std::memory_order_acquire);
+      bool changed = false;
+      for (int i = 0; i < eng->spin_iters; i++) {
+        if (eng->my_db.word->load(std::memory_order_acquire) != seen) {
+          changed = true;
+          break;
+        }
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
+      }
+      if (!changed) {
+        struct timespec ts = {0, 2000000};  // 2 ms: deadline cadence
+        futex_wait(eng->my_db.word, seen, &ts);
+      }
+    }
+    g.lock();
+    if (done()) return true;
+    if (std::chrono::steady_clock::now() > deadline) return false;
+  }
+  return true;
+}
+
+static void ring_poll_loop(Engine *eng) {
+  // Backstop consumer: inline-progress waiters normally drain the
+  // rings themselves; this thread covers phases with no blocked
+  // waiter (unexpected messages, PY-queue traffic).
+  uint32_t seen = eng->my_db.word->load(std::memory_order_acquire);
+  while (!eng->closing.load(std::memory_order_relaxed)) {
+    if (eng->waiters.load(std::memory_order_relaxed) == 0 &&
+        try_consume_rings(eng)) {
+      seen = eng->my_db.word->load(std::memory_order_acquire);
+      continue;
+    }
+    uint32_t now = eng->my_db.word->load(std::memory_order_acquire);
+    if (now != seen &&
+        eng->waiters.load(std::memory_order_relaxed) == 0) {
+      seen = now;
+      continue;
+    }
+    seen = now;
+    struct timespec ts = {0, 50000000};  // 50 ms: close() sensitivity
+    futex_wait(eng->my_db.word, seen, &ts);
+    seen = eng->my_db.word->load(std::memory_order_acquire);
+  }
+}
+
+// ---------------------------------------------------------------------
+// address composition / peer setup
+// ---------------------------------------------------------------------
+
+// address: ntv:<host_id>|<tcp host:port>|<uds name>|<doorbell name>
+static std::string compose_address(Engine *eng) {
+  return "ntv:" + eng->host_id + "|" + eng->tcp_addr + "|" + eng->uds_name +
+         "|" + eng->db_name;
+}
+
+static bool parse_address(const std::string &a, Peer *p) {
+  if (a.rfind("ntv:", 0) != 0) return false;
+  std::string rest = a.substr(4);
+  size_t p1 = rest.find('|');
+  size_t p2 = rest.find('|', p1 + 1);
+  size_t p3 = rest.find('|', p2 + 1);
+  if (p1 == std::string::npos || p2 == std::string::npos ||
+      p3 == std::string::npos)
+    return false;
+  p->host_id = rest.substr(0, p1);
+  p->tcp_host = rest.substr(p1 + 1, p2 - p1 - 1);
+  p->uds_name = rest.substr(p2 + 1, p3 - p2 - 1);
+  p->db_name = rest.substr(p3 + 1);
+  return true;
+}
+
+static int connect_tcp(const std::string &hostport) {
+  size_t c = hostport.rfind(':');
+  if (c == std::string::npos) return -1;
+  std::string host = hostport.substr(0, c);
+  int port = atoi(hostport.c_str() + c + 1);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static int connect_uds(const std::string &name) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_un sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sun_family = AF_UNIX;
+  sa.sun_path[0] = '\0';
+  size_t n = name.size();
+  if (n > sizeof(sa.sun_path) - 2) n = sizeof(sa.sun_path) - 2;
+  memcpy(sa.sun_path + 1, name.data(), n);
+  socklen_t len = (socklen_t)(offsetof(struct sockaddr_un, sun_path) + 1 + n);
+  if (connect(fd, (struct sockaddr *)&sa, len) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+// get-or-create the peer for a composite address; lazily connect
+static Peer *get_peer(Engine *eng, const std::string &address) {
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    auto it = eng->peers.find(address);
+    if (it != eng->peers.end()) return it->second;
+  }
+  Peer *p = new Peer();
+  p->address = address;
+  if (!parse_address(address, p)) {
+    // plain host:port (mixed job with the Python tcp transport is NOT
+    // supported across engines — addresses must be ntv:)
+    p->tcp_host = address;
+  }
+  p->same_host = (!p->host_id.empty() && p->host_id == eng->host_id);
+  if (p->same_host && !p->uds_name.empty()) {
+    p->fd = connect_uds(p->uds_name);
+  }
+  if (p->fd < 0) p->fd = connect_tcp(p->tcp_host);
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    auto it = eng->peers.find(address);
+    if (it != eng->peers.end()) {  // raced: keep the first
+      if (p->fd >= 0) close(p->fd);
+      delete p;
+      return it->second;
+    }
+    eng->peers[address] = p;
+  }
+  // our inbound CTS for rndv rides the SAME socket (duplex): spawn a
+  // reader for it
+  if (p->fd >= 0) std::thread(sock_recv_loop, eng, dup(p->fd)).detach();
+  return p;
+}
+
+// ---------------------------------------------------------------------
+// send paths
+// ---------------------------------------------------------------------
+
+static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
+                             const Env &e, const void *payload) {
+  uint64_t need = 8 + sizeof(WireHdr) + env_extra(h) + h.nbytes;
+  uint64_t rec_start;
+  uint8_t *w = p->tx_ring.reserve(need, &rec_start, &eng->closing);
+  if (!w) return false;
+  *(uint64_t *)w = need;  // full record length (u64 prefix included)
+  uint8_t *q = w + 8;
+  memcpy(q, &h, sizeof(h));
+  q += sizeof(h);
+  write_extra(q, e);
+  q += env_extra(h);
+  if (h.nbytes) memcpy(q, payload, h.nbytes);
+  p->tx_ring.publish(rec_start, need);
+  p->peer_db.ring();
+  return true;
+}
+
+static bool ensure_ring(Engine *eng, Peer *p) {
+  if (p->tx_ring.ctrl) return true;
+  char nm[128];
+  snprintf(nm, sizeof(nm), "/tdcn-%d-%d-%llx", getpid(), eng->proc,
+           (unsigned long long)(uintptr_t)p & 0xffffff);
+  if (!p->tx_ring.create(nm, eng->ring_bytes)) return false;
+  if (!p->peer_db.open_existing(p->db_name)) {
+    p->tx_ring.destroy(true);
+    return false;
+  }
+  // announce over the socket; receiver maps it before any ring data
+  // (socket send happens-before our first doorbell)
+  WireHdr sh;
+  Env se;
+  se.kind = FK_COLL;
+  se.cid = nm;
+  fill_hdr(&sh, FT_SETUP, se, eng->proc, 0, 0, 0);
+  if (!send_all(p->fd, &sh, sizeof(sh)) ||
+      !send_all(p->fd, nm, strlen(nm))) {
+    p->tx_ring.destroy(true);
+    return false;
+  }
+  p->ring_announced = true;
+  return true;
+}
+
+// core send: route ring vs tcp, eager vs rndv (tcp) / chunked (ring)
+static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
+                            uint64_t nbytes);
+
+static int engine_send(Engine *eng, const std::string &address, Env &e,
+                       const void *data, uint64_t nbytes) {
+  Peer *p = get_peer(eng, address);
+  return engine_send_peer(eng, p, e, data, nbytes);
+}
+
+static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
+                            uint64_t nbytes) {
+  if (!p || p->fd < 0) return -1;
+  eng->bytes_sent.fetch_add(nbytes, std::memory_order_relaxed);
+
+  std::lock_guard<std::mutex> g(p->send_mu);
+  if (p->same_host && ensure_ring(eng, p)) {
+    // ring path: frames up to half the ring go as one record; larger
+    // payloads stream as FRAG records (ring backpressure = flow ctl)
+    uint64_t limit = eng->ring_bytes / 2;
+    if (nbytes + sizeof(WireHdr) + 256 <= limit) {
+      WireHdr h;
+      fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
+      if (send_record_ring(eng, p, h, e, data)) return 0;
+      return -1;
+    }
+    // chunked streaming: an RTS record (no CTS — ring backpressure is
+    // the flow control) announcing the transfer, then FRAG records.
+    // h.seq carries the reassembly xid; the TRUE envelope seq rides in
+    // h.off of the RTS (restored receiver-side).
+    uint64_t chunk = 4ull << 20;
+    int64_t xid = (int64_t)(now_ns() ^ ((uint64_t)eng->proc << 56));
+    Env rts_env = e;
+    rts_env.seq = xid;
+    WireHdr h2;
+    fill_hdr(&h2, FT_RTS, rts_env, eng->proc, (uint64_t)e.seq, nbytes, 0);
+    if (!send_record_ring(eng, p, h2, rts_env, nullptr)) return -1;
+    for (uint64_t off = 0; off < nbytes; off += chunk) {
+      uint64_t n = nbytes - off < chunk ? nbytes - off : chunk;
+      Env fe;
+      fe.kind = e.kind;
+      fe.seq = xid;
+      WireHdr fh;
+      fill_hdr(&fh, FT_FRAG, fe, eng->proc, off, nbytes, n);
+      if (!send_record_ring(eng, p, fh, fe, (const uint8_t *)data + off))
+        return -1;
+    }
+    return 0;
+  }
+
+  // tcp path
+  if ((int64_t)nbytes <= eng->eager_limit) {
+    WireHdr h;
+    fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
+    std::vector<uint8_t> extra(env_extra(h));
+    write_extra(extra.data(), e);
+    struct iovec iov[3] = {
+        {&h, sizeof(h)},
+        {extra.data(), extra.size()},
+        {(void *)data, (size_t)nbytes},
+    };
+    if (!writev_all(p->fd, iov, nbytes ? 3 : 2)) return -1;
+    return 0;
+  }
+  // rendezvous
+  int64_t xid = (int64_t)(now_ns() ^ ((uint64_t)eng->proc << 48));
+  {
+    std::lock_guard<std::mutex> g2(p->cts_mu);
+    p->cts[xid] = false;
+  }
+  Env rts_env = e;
+  rts_env.seq = xid;
+  WireHdr h;
+  fill_hdr(&h, FT_RTS, rts_env, eng->proc, (uint64_t)e.seq, nbytes, 0);
+  std::vector<uint8_t> extra(env_extra(h));
+  write_extra(extra.data(), rts_env);
+  struct iovec iov[2] = {{&h, sizeof(h)}, {extra.data(), extra.size()}};
+  if (!writev_all(p->fd, iov, 2)) return -1;
+  {
+    std::unique_lock<std::mutex> g2(p->cts_mu);
+    bool ok = p->cts_cv.wait_for(g2, std::chrono::seconds(600), [&] {
+      return p->cts[xid] || eng->closing.load(std::memory_order_relaxed);
+    });
+    p->cts.erase(xid);
+    if (!ok || eng->closing.load(std::memory_order_relaxed)) return -1;
+  }
+  for (uint64_t off = 0; off < nbytes; off += (uint64_t)eng->frag_size) {
+    uint64_t n = nbytes - off < (uint64_t)eng->frag_size
+                     ? nbytes - off
+                     : (uint64_t)eng->frag_size;
+    Env fe;
+    fe.kind = e.kind;
+    fe.seq = xid;
+    WireHdr fh;
+    fill_hdr(&fh, FT_FRAG, fe, eng->proc, off, nbytes, n);
+    struct iovec fiov[2] = {{&fh, sizeof(fh)},
+                            {(void *)((const uint8_t *)data + off),
+                             (size_t)n}};
+    if (!writev_all(p->fd, fiov, 2)) return -1;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------
+
+extern "C" {
+
+void *tdcn_create(int proc, int nprocs, const char *host_id,
+                  int64_t eager_limit, int64_t frag_size,
+                  uint64_t ring_bytes, int max_rndv) {
+  Engine *eng = new Engine();
+  eng->proc = proc;
+  eng->nprocs = nprocs;
+  eng->host_id = host_id ? host_id : "";
+  if (eager_limit > 0) eng->eager_limit = eager_limit;
+  if (frag_size > 0) eng->frag_size = frag_size;
+  if (ring_bytes > 0) eng->ring_bytes = ring_bytes;
+  if (max_rndv > 0) eng->max_rndv = max_rndv;
+  eng->failed.assign((size_t)(nprocs > 0 ? nprocs : 1) + 64, false);
+  long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+  eng->spin_iters = (ncpu > 2) ? 600 : 0;
+  // recycle large payload buffers through the heap instead of per-
+  // message mmap/munmap (glibc default M_MMAP_THRESHOLD is 128 KiB —
+  // every big message would pay fresh page faults on both copies)
+  mallopt(M_MMAP_THRESHOLD, 64 << 20);
+  mallopt(M_TRIM_THRESHOLD, 128 << 20);
+
+  // tcp listener
+  eng->tcp_listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(eng->tcp_listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+             sizeof(one));
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (const char *h = getenv("TDCN_BIND")) inet_pton(AF_INET, h, &sa.sin_addr);
+  bind(eng->tcp_listen_fd, (struct sockaddr *)&sa, sizeof(sa));
+  listen(eng->tcp_listen_fd, 64);
+  socklen_t slen = sizeof(sa);
+  getsockname(eng->tcp_listen_fd, (struct sockaddr *)&sa, &slen);
+  char tb[64];
+  char ip[32];
+  inet_ntop(AF_INET, &sa.sin_addr, ip, sizeof(ip));
+  snprintf(tb, sizeof(tb), "%s:%d", ip, (int)ntohs(sa.sin_port));
+  eng->tcp_addr = tb;
+
+  // abstract uds listener (same-host setup channel)
+  char un[96];
+  snprintf(un, sizeof(un), "tdcn-%d-%d-%llx", getpid(), proc,
+           (unsigned long long)now_ns() & 0xffffff);
+  eng->uds_name = un;
+  eng->uds_listen_fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  struct sockaddr_un ua;
+  memset(&ua, 0, sizeof(ua));
+  ua.sun_family = AF_UNIX;
+  memcpy(ua.sun_path + 1, un, strlen(un));
+  bind(eng->uds_listen_fd, (struct sockaddr *)&ua,
+       (socklen_t)(offsetof(struct sockaddr_un, sun_path) + 1 + strlen(un)));
+  listen(eng->uds_listen_fd, 64);
+
+  // doorbell
+  char db[96];
+  snprintf(db, sizeof(db), "/tdcn-db-%d-%d", getpid(), proc);
+  eng->db_name = db;
+  eng->my_db.create(db);
+
+  eng->address = compose_address(eng);
+  eng->threads.emplace_back(accept_loop, eng, eng->tcp_listen_fd);
+  eng->threads.emplace_back(accept_loop, eng, eng->uds_listen_fd);
+  eng->threads.emplace_back(ring_poll_loop, eng);
+  return eng;
+}
+
+const char *tdcn_address(void *h) {
+  return ((Engine *)h)->address.c_str();
+}
+
+int tdcn_set_addresses(void *h, const char *joined) {
+  Engine *eng = (Engine *)h;
+  eng->peer_addresses.clear();
+  std::string s(joined ? joined : "");
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t nl = s.find('\n', start);
+    if (nl == std::string::npos) {
+      eng->peer_addresses.push_back(s.substr(start));
+      break;
+    }
+    eng->peer_addresses.push_back(s.substr(start, nl - start));
+    start = nl + 1;
+  }
+  return 0;
+}
+
+int tdcn_send_addr(void *h, const char *address, int kind, const char *cid,
+                   int64_t seq, int src, int dst, int tag,
+                   const char *dtype, int ndim, const int64_t *shape,
+                   const void *meta, int meta_len, const void *data,
+                   uint64_t nbytes) {
+  Engine *eng = (Engine *)h;
+  Env e;
+  e.kind = (uint8_t)kind;
+  e.cid = cid ? cid : "";
+  e.seq = seq;
+  e.src = src;
+  e.dst = dst;
+  e.tag = tag;
+  e.dtype = dtype ? dtype : "";
+  e.ndim = ndim;
+  for (int i = 0; i < ndim && i < 8; i++) e.shape[i] = shape[i];
+  if (meta && meta_len) e.meta.assign((const char *)meta, (size_t)meta_len);
+  return engine_send(eng, address, e, data, nbytes);
+}
+
+int tdcn_send(void *h, int dst_proc, int kind, const char *cid, int64_t seq,
+              int src, int dst, int tag, const char *dtype, int ndim,
+              const int64_t *shape, const void *meta, int meta_len,
+              const void *data, uint64_t nbytes) {
+  Engine *eng = (Engine *)h;
+  if (dst_proc < 0 || (size_t)dst_proc >= eng->peer_addresses.size())
+    return -2;
+  return tdcn_send_addr(h, eng->peer_addresses[dst_proc].c_str(), kind, cid,
+                        seq, src, dst, tag, dtype, ndim, shape, meta,
+                        meta_len, data, nbytes);
+}
+
+// loopback delivery without a wire hop (self-sends and local ranks)
+int tdcn_send_local(void *h, int kind, const char *cid, int64_t seq, int src,
+                    int dst, int tag, uint64_t pyhandle, int64_t count,
+                    uint64_t nbytes) {
+  Engine *eng = (Engine *)h;
+  OwnedMsg m;
+  m.env.kind = (uint8_t)kind;
+  m.env.cid = cid ? cid : "";
+  m.env.seq = seq;
+  m.env.src = src;
+  m.env.dst = dst;
+  m.env.tag = tag;
+  m.pyhandle = pyhandle;
+  m.count = count;
+  m.nbytes = nbytes;
+  std::lock_guard<std::mutex> g(eng->mu);
+  deliver_locked(eng, std::move(m));
+  return 0;
+}
+
+int tdcn_recv_coll(void *h, const char *cid, int64_t seq, int src,
+                   int fail_proc, double timeout_s, TdcnMsg *out) {
+  // `src` keys the stream slot in the CALLER's index space (sub-comm
+  // engines use sub-local indices); `fail_proc` is the ROOT engine
+  // index to watch for failure (-1 = none, e.g. across spawn worlds).
+  Engine *eng = (Engine *)h;
+  auto key = std::make_tuple(std::string(cid ? cid : ""), seq, src);
+  std::unique_lock<std::mutex> g(eng->mu);
+  auto it = eng->coll.find(key);
+  CollSlot *slot;
+  if (it == eng->coll.end()) {
+    slot = new CollSlot();
+    eng->coll[key] = slot;
+  } else {
+    slot = it->second;
+  }
+  auto peer_failed = [&] {
+    return fail_proc >= 0 && (size_t)fail_proc < eng->failed.size() &&
+           eng->failed[fail_proc];
+  };
+  slot->waiters++;
+  bool ok = progress_wait(eng, g,
+                          [&] {
+                            return slot->ready.load() ||
+                                   eng->closing.load(
+                                       std::memory_order_relaxed) ||
+                                   peer_failed();
+                          },
+                          timeout_s);
+  slot->waiters--;
+  if (!ok || !slot->ready) {
+    int rc = 1;  // timeout
+    if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
+    else if (peer_failed())
+      rc = -2;  // peer failed
+    if (slot->waiters == 0 && !slot->ready) {
+      eng->coll.erase(key);
+      delete slot;
+    }
+    return rc;
+  }
+  msg_into_tdcn(slot->msg, out);
+  eng->coll.erase(key);
+  delete slot;
+  return 0;
+}
+
+uint64_t tdcn_post_recv(void *h, const char *cid, int dst, int src,
+                        int tag) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  CidQueues &q = eng->p2p[cid ? cid : ""];
+  // match earliest unexpected first (arrival order)
+  auto &uq = q.unexpected[dst];
+  for (auto it = uq.begin(); it != uq.end(); ++it) {
+    if ((src == -1 || src == it->env.src) &&
+        (tag == -1 || tag == it->env.tag)) {
+      uint64_t rid = eng->next_req++;
+      ReqState *st = new ReqState();
+      st->msg = std::move(*it);
+      st->completed = true;
+      uq.erase(it);
+      eng->reqs[rid] = st;
+      return rid;
+    }
+  }
+  uint64_t rid = eng->next_req++;
+  ReqState *st = new ReqState();
+  eng->reqs[rid] = st;
+  q.posted[dst].push_back(PostedReq{rid, src, tag, eng->arrival++});
+  return rid;
+}
+
+int tdcn_req_wait(void *h, uint64_t rid, double timeout_s, TdcnMsg *out) {
+  Engine *eng = (Engine *)h;
+  std::unique_lock<std::mutex> g(eng->mu);
+  auto it = eng->reqs.find(rid);
+  if (it == eng->reqs.end()) return -1;
+  ReqState *st = it->second;
+  bool ok = progress_wait(eng, g,
+                          [&] {
+                            return st->completed.load() ||
+                                   eng->closing.load(
+                                       std::memory_order_relaxed);
+                          },
+                          timeout_s);
+  if (!ok || !st->completed)
+    return eng->closing.load(std::memory_order_relaxed) ? -3 : 1;
+  msg_into_tdcn(st->msg, out);
+  eng->reqs.erase(rid);
+  delete st;
+  return 0;
+}
+
+int tdcn_req_test(void *h, uint64_t rid, TdcnMsg *out) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  auto it = eng->reqs.find(rid);
+  if (it == eng->reqs.end()) return -1;
+  if (!it->second->completed) return 1;
+  msg_into_tdcn(it->second->msg, out);
+  delete it->second;
+  eng->reqs.erase(it);
+  return 0;
+}
+
+int tdcn_req_cancel(void *h, uint64_t rid) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  auto it = eng->reqs.find(rid);
+  if (it == eng->reqs.end()) return -1;
+  if (it->second->completed) return 1;  // too late
+  // remove from every posted list it may sit in
+  for (auto &kv : eng->p2p) {
+    for (auto &pl : kv.second.posted) {
+      auto &v = pl.second;
+      for (size_t i = 0; i < v.size(); i++) {
+        if (v[i].id == rid) {
+          v.erase(v.begin() + i);
+          break;
+        }
+      }
+    }
+  }
+  delete it->second;
+  eng->reqs.erase(it);
+  return 0;
+}
+
+int tdcn_probe(void *h, const char *cid, int dst, int src, int tag,
+               TdcnMsg *out) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  auto qit = eng->p2p.find(cid ? cid : "");
+  if (qit == eng->p2p.end()) return 1;
+  auto uit = qit->second.unexpected.find(dst);
+  if (uit == qit->second.unexpected.end()) return 1;
+  for (auto &m : uit->second) {
+    if ((src == -1 || src == m.env.src) && (tag == -1 || tag == m.env.tag)) {
+      memset(out, 0, sizeof(*out));
+      out->src = m.env.src;
+      out->tag = m.env.tag;
+      out->nbytes = m.nbytes;
+      out->count = m.count;
+      out->pyhandle = m.pyhandle;
+      snprintf(out->dtype, sizeof(out->dtype), "%s", m.env.dtype.c_str());
+      out->ndim = m.env.ndim;
+      memcpy(out->shape, m.env.shape, sizeof(out->shape));
+      return 0;
+    }
+  }
+  return 1;
+}
+
+int tdcn_pending(void *h, const char *cid, int dst, int which) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  auto qit = eng->p2p.find(cid ? cid : "");
+  if (qit == eng->p2p.end()) return 0;
+  if (which == 0) {
+    auto it = qit->second.unexpected.find(dst);
+    return it == qit->second.unexpected.end() ? 0 : (int)it->second.size();
+  }
+  auto it = qit->second.posted.find(dst);
+  return it == qit->second.posted.end() ? 0 : (int)it->second.size();
+}
+
+int tdcn_register_pycid(void *h, const char *cid) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  eng->py_cids[cid ? cid : ""] = true;
+  // frames that raced into the native queues move to the PY queue
+  auto qit = eng->p2p.find(cid ? cid : "");
+  if (qit != eng->p2p.end()) {
+    for (auto &kv : qit->second.unexpected)
+      for (auto &m : kv.second) {
+        eng->py_queue.push_back(std::move(m));
+        eng->py_cv.notify_one();
+      }
+    eng->p2p.erase(qit);
+  }
+  return 0;
+}
+
+int tdcn_unregister_cid(void *h, const char *cid) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  eng->py_cids.erase(cid ? cid : "");
+  auto qit = eng->p2p.find(cid ? cid : "");
+  if (qit != eng->p2p.end()) {
+    for (auto &kv : qit->second.unexpected)
+      for (auto &m : kv.second) free(m.data);
+    eng->p2p.erase(qit);
+  }
+  return 0;
+}
+
+int tdcn_ctrl_next(void *h, double timeout_s, TdcnMsg *out) {
+  Engine *eng = (Engine *)h;
+  std::unique_lock<std::mutex> g(eng->mu);
+  bool ok = eng->py_cv.wait_for(g, std::chrono::duration<double>(timeout_s),
+                                [&] {
+                                  return !eng->py_queue.empty() ||
+                                         eng->closing.load(
+                                             std::memory_order_relaxed);
+                                });
+  if (!ok || eng->py_queue.empty())
+    return eng->closing.load(std::memory_order_relaxed) ? -3 : 1;
+  OwnedMsg m = std::move(eng->py_queue.front());
+  eng->py_queue.pop_front();
+  msg_into_tdcn(m, out);
+  return 0;
+}
+
+void tdcn_note_failed(void *h, int proc) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  if (proc >= 0 && (size_t)proc < eng->failed.size())
+    eng->failed[proc] = true;
+  // wake every waiter so failure-sensitive recvs re-check
+  for (auto &kv : eng->coll) kv.second->cv.notify_all();
+  for (auto &kv : eng->reqs) kv.second->cv.notify_all();
+}
+
+// ---- channel fast path ----------------------------------------------
+// A channel pins (peer, cid) once so the per-message call carries only
+// scalars — the per-call cost of the C ABI crossing is what separated
+// the Python transport's 80 µs floor from the native target.
+
+struct Chan {
+  Engine *eng;
+  Peer *peer;
+  std::string cid;
+};
+
+uint64_t tdcn_chan_open(void *h, const char *address, const char *cid) {
+  Engine *eng = (Engine *)h;
+  Peer *p = get_peer(eng, address ? address : "");
+  if (!p) return 0;
+  Chan *c = new Chan{eng, p, std::string(cid ? cid : "")};
+  return (uint64_t)(uintptr_t)c;
+}
+
+void tdcn_chan_close(void *h, uint64_t chan) {
+  (void)h;
+  delete (Chan *)(uintptr_t)chan;  // the Peer it references stays
+                                   // engine-owned
+}
+
+int tdcn_chan_send(void *h, uint64_t chan, int kind, int src, int dst,
+                   int tag, const char *dtype, int ndim,
+                   const int64_t *shape, const void *data,
+                   uint64_t nbytes) {
+  (void)h;
+  Chan *c = (Chan *)(uintptr_t)chan;
+  Env e;
+  e.kind = (uint8_t)kind;
+  e.cid = c->cid;
+  e.seq = 0;
+  e.src = src;
+  e.dst = dst;
+  e.tag = tag;
+  e.dtype = dtype ? dtype : "";
+  e.ndim = ndim;
+  for (int i = 0; i < ndim && i < 8; i++) e.shape[i] = shape[i];
+  return engine_send_peer(c->eng, c->peer, e, data, nbytes);
+}
+
+int tdcn_chan_send1(void *h, uint64_t chan, int kind, int src, int dst,
+                    int tag, const char *dtype, int64_t nelems,
+                    const void *data, uint64_t nbytes) {
+  // 1-D payload fast path: shape is (nelems,), no shape array to
+  // marshal — the dominant case under MPI_Send/Recv
+  (void)h;
+  Chan *c = (Chan *)(uintptr_t)chan;
+  Env e;
+  e.kind = (uint8_t)kind;
+  e.cid = c->cid;
+  e.seq = 0;
+  e.src = src;
+  e.dst = dst;
+  e.tag = tag;
+  e.dtype = dtype ? dtype : "";
+  e.ndim = 1;
+  e.shape[0] = nelems;
+  return engine_send_peer(c->eng, c->peer, e, data, nbytes);
+}
+
+int tdcn_precv(void *h, const char *cid, int dst, int src, int tag,
+               int fail_proc, double timeout_s, TdcnMsg *out) {
+  // blocking receive in ONE crossing: match-or-post, then sleep on the
+  // request's condvar until the C receiver thread completes it (or the
+  // watched root proc is marked failed / the engine closes)
+  Engine *eng = (Engine *)h;
+  std::unique_lock<std::mutex> g(eng->mu);
+  CidQueues &q = eng->p2p[cid ? cid : ""];
+  auto &uq = q.unexpected[dst];
+  for (auto it = uq.begin(); it != uq.end(); ++it) {
+    if ((src == -1 || src == it->env.src) &&
+        (tag == -1 || tag == it->env.tag)) {
+      msg_into_tdcn(*it, out);
+      uq.erase(it);
+      return 0;
+    }
+  }
+  uint64_t rid = eng->next_req++;
+  ReqState *st = new ReqState();
+  eng->reqs[rid] = st;
+  q.posted[dst].push_back(PostedReq{rid, src, tag, eng->arrival++});
+  auto failed = [&] {
+    return fail_proc >= 0 && (size_t)fail_proc < eng->failed.size() &&
+           eng->failed[fail_proc];
+  };
+  bool ok = progress_wait(eng, g,
+                          [&] {
+                            return st->completed.load() ||
+                                   eng->closing.load(
+                                       std::memory_order_relaxed) ||
+                                   failed();
+                          },
+                          timeout_s);
+  if (!ok || !st->completed) {
+    int rc = 1;
+    if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
+    else if (failed())
+      rc = -2;
+    // withdraw the posted entry (arrival order of others unchanged)
+    auto &pl = q.posted[dst];
+    for (size_t i = 0; i < pl.size(); i++) {
+      if (pl[i].id == rid) {
+        pl.erase(pl.begin() + i);
+        break;
+      }
+    }
+    eng->reqs.erase(rid);
+    delete st;
+    return rc;
+  }
+  msg_into_tdcn(st->msg, out);
+  eng->reqs.erase(rid);
+  delete st;
+  return 0;
+}
+
+int tdcn_is_failed(void *h, int proc) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  return (proc >= 0 && (size_t)proc < eng->failed.size() &&
+          eng->failed[proc])
+             ? 1
+             : 0;
+}
+
+uint64_t tdcn_bytes_sent(void *h) {
+  return ((Engine *)h)->bytes_sent.load(std::memory_order_relaxed);
+}
+
+void tdcn_free(void *p) { free(p); }
+
+void tdcn_close(void *h) {
+  Engine *eng = (Engine *)h;
+  eng->closing.store(true, std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> g(eng->mu);
+    for (auto &kv : eng->coll) kv.second->cv.notify_all();
+    for (auto &kv : eng->reqs) kv.second->cv.notify_all();
+    eng->py_cv.notify_all();
+  }
+  {
+    std::lock_guard<std::mutex> g(eng->rndv_mu);
+    eng->rndv_cv.notify_all();
+  }
+  eng->my_db.word->fetch_add(1, std::memory_order_release);
+  futex_wake(eng->my_db.word, 64);
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    for (auto &kv : eng->peers) {
+      Peer *p = kv.second;
+      std::lock_guard<std::mutex> g2(p->cts_mu);
+      p->cts_cv.notify_all();
+    }
+  }
+  // join the owned threads BEFORE tearing down the state they read
+  // (accept loops poll with a timeout; the ring poller futex-waits
+  // with a timeout — both re-check `closing` within ~100 ms)
+  for (auto &t : eng->threads)
+    if (t.joinable()) t.join();
+  if (eng->tcp_listen_fd >= 0) close(eng->tcp_listen_fd);
+  if (eng->uds_listen_fd >= 0) close(eng->uds_listen_fd);
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    for (auto &kv : eng->peers) {
+      Peer *p = kv.second;
+      if (p->fd >= 0) {
+        shutdown(p->fd, SHUT_RDWR);
+        close(p->fd);
+        p->fd = -1;
+      }
+      p->tx_ring.destroy(true);
+      p->peer_db.destroy(false);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(eng->rings_mu);
+    for (ShmRing *r : eng->rx_rings) r->destroy(true);
+  }
+  eng->my_db.destroy(true);
+  // NOTE: the Engine object is intentionally leaked at close (detached
+  // per-connection recv threads may still be draining); process
+  // teardown reclaims it.
+}
+
+}  // extern "C"

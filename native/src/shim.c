@@ -75,6 +75,38 @@ static int capi_boot(void) {
   return MPI_SUCCESS;
 }
 
+/* Bound-function cache for capi_call: `fn` is always a C string
+ * LITERAL, so its address is a stable per-call-site key — the first
+ * call does the getattr, every later one is a pointer-compare hit
+ * (VERDICT r3 next #6: the per-call attribute lookup was measurable
+ * on the hot entry points).  Open-addressed; entries are immortal
+ * (capi functions are module-level and never rebound). */
+#define TPUMPI_FN_CACHE 1024
+static struct {
+  const char *key;
+  PyObject *fnobj;
+} g_fn_cache[TPUMPI_FN_CACHE];
+
+/* Returns a BORROWED reference (cache entries are immortal; on the
+ * can't-happen full-table fallback the fresh reference is intentionally
+ * never released — function objects live for the process anyway). */
+static PyObject *capi_fn(const char *fn) { /* GIL held */
+  uintptr_t h = ((uintptr_t)fn >> 4) & (TPUMPI_FN_CACHE - 1);
+  for (unsigned probe = 0; probe < TPUMPI_FN_CACHE; probe++) {
+    unsigned i = (unsigned)((h + probe) & (TPUMPI_FN_CACHE - 1));
+    if (g_fn_cache[i].key == fn) return g_fn_cache[i].fnobj;
+    if (g_fn_cache[i].key == NULL) {
+      PyObject *f = PyObject_GetAttrString(g_capi, fn);
+      if (f) {
+        g_fn_cache[i].fnobj = f; /* keep the reference forever */
+        g_fn_cache[i].key = fn;
+      }
+      return f;
+    }
+  }
+  return PyObject_GetAttrString(g_capi, fn);
+}
+
 /* Call capi.<fn>(...); the callee returns an int error class or a tuple
  * (err, i0, i1, ...) whose integers are copied into *out. The GIL is
  * held only for the duration of the call. */
@@ -91,10 +123,9 @@ static int capi_call(const char *fn, capi_ret *out, const char *fmt, ...) {
   va_end(ap);
   int err = MPI_ERR_INTERN;
   if (args) {
-    PyObject *f = PyObject_GetAttrString(g_capi, fn);
+    PyObject *f = capi_fn(fn);
     if (f) {
       PyObject *r = PyObject_CallObject(f, args);
-      Py_DECREF(f);
       if (r) {
         if (PyTuple_Check(r)) {
           err = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
@@ -130,10 +161,9 @@ static int capi_call_str(const char *fn, char *buf, int bufsz, int *outlen,
   va_end(ap);
   int rc = MPI_ERR_INTERN;
   if (args) {
-    PyObject *f = PyObject_GetAttrString(g_capi, fn);
+    PyObject *f = capi_fn(fn);
     if (f) {
       PyObject *r = PyObject_CallObject(f, args);
-      Py_DECREF(f);
       if (r && PyTuple_Check(r) && PyTuple_Size(r) >= 2) {
         rc = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
         const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));

@@ -76,7 +76,13 @@ class MultiProcComm(PersistentP2PMixin):
     def _wire(self) -> None:
         """Per-comm runtime wiring — ONE path shared by __init__ /
         dup / _make_sub: fresh coll/pml/NBC/FT state, frame routing,
-        and failure fan-out registration."""
+        and failure fan-out registration.
+
+        p2p routing picks one of two planes: on a native DCN engine
+        with the default (``eager``) pml, frames go to the C matching
+        engine and receives block in C (the fast path); interposed
+        pmls (monitoring, vprotocol) keep Python delivery through the
+        dispatcher thread."""
         self._coll = None
         self._pml = None
         self._pml_lock = threading.Lock()
@@ -87,7 +93,17 @@ class MultiProcComm(PersistentP2PMixin):
         self._spawn_count = 0
         self._win_count = 0
         self._freed = False
-        self.dcn.register_p2p(self.cid, self._on_p2p_frame)
+        self._chans: dict[int, int] = {}
+        self._pml_native = False
+        if hasattr(self.dcn, "register_native_p2p"):
+            from ompi_tpu.p2p.component import EagerPmlComponent
+
+            comp = mca.default_context().framework("pml").select_one()
+            self._pml_native = type(comp) is EagerPmlComponent
+        if self._pml_native:
+            self.dcn.register_native_p2p(self.cid)
+        else:
+            self.dcn.register_p2p(self.cid, self._on_p2p_frame)
         self.dcn.register_comm(self.cid, self)
         self.procctx.register_comm(self)
 
@@ -309,14 +325,38 @@ class MultiProcComm(PersistentP2PMixin):
             # the main thread's first recv — double-checked lock
             with self._pml_lock:
                 if self._pml is None:
-                    comp = mca.default_context().framework("pml").select_one()
-                    self._pml = comp.make_engine(self.size, self.name)
+                    if self._pml_native:
+                        from ompi_tpu.p2p.pml_native import (
+                            NativeMatchingEngine,
+                        )
+
+                        self._pml = NativeMatchingEngine(
+                            self.dcn._native_root(), self.cid, self.size)
+                    else:
+                        comp = (mca.default_context().framework("pml")
+                                .select_one())
+                        self._pml = comp.make_engine(self.size, self.name)
         return self._pml
 
     def _on_p2p_frame(self, env: dict, payload: np.ndarray) -> None:
         # relayed delivery: already accounted on the sending process
         self.pml.send(env["src"], env["dst"], payload, env["tag"],
                       _account=False)
+
+    def _chan(self, dproc: int) -> int:
+        """Cached native channel to a member process (pins peer + cid
+        in C so the per-message crossing carries only scalars).  The
+        lock closes the check-then-insert race between concurrent
+        sender threads; channels are freed in :meth:`free`."""
+        ch = self._chans.get(dproc)
+        if ch is None:
+            with self._pml_lock:
+                ch = self._chans.get(dproc)
+                if ch is None:
+                    ch = self.dcn._native_root().chan_open(
+                        self.dcn.addresses[dproc], self.cid)
+                    self._chans[dproc] = ch
+        return ch
 
     def send(self, buf, source: int, dest: int, tag: int = 0) -> None:
         """Send from a LOCAL global rank ``source`` to any global rank."""
@@ -342,11 +382,19 @@ class MultiProcComm(PersistentP2PMixin):
             if isinstance(self.pml, _mon.MonitoredEngine):
                 _mon.account_p2p(self.name, self.size, source, dest,
                                  _spc.payload_nbytes(buf))
-            self.dcn.send_p2p(
-                dproc,
-                {"cid": self.cid, "src": source, "dst": dest, "tag": tag},
-                np.asarray(buf),
-            )
+            if self._pml_native:
+                from ompi_tpu.dcn.native import FK_P2P
+
+                arr = np.ascontiguousarray(np.asarray(buf))
+                self.dcn._native_root().chan_send(
+                    self._chan(dproc), FK_P2P, source, dest, tag, arr)
+            else:
+                self.dcn.send_p2p(
+                    dproc,
+                    {"cid": self.cid, "src": source, "dst": dest,
+                     "tag": tag},
+                    np.asarray(buf),
+                )
 
     def irecv(self, dest: int, source: int | None = None, tag: int | None = None) -> Request:
         if self._ft is not None:
@@ -363,6 +411,27 @@ class MultiProcComm(PersistentP2PMixin):
         )
 
     def recv(self, dest: int, source: int | None = None, tag: int | None = None):
+        if self._pml_native:
+            # one C crossing: match-or-post + sleep on the request's
+            # condvar; a watched specific source also wakes on failure
+            if self._ft is not None:
+                from ompi_tpu.ft import ulfm
+
+                ulfm.check(self, peer=source, any_source=source is None)
+            dproc, _ = self.locate(dest)
+            if dproc != self.proc:
+                raise MPIRankError(
+                    f"rank {dest} not owned by process {self.proc}")
+            fail_proc = -1
+            if source is not None and self._ft is not None:
+                fail_proc = self.dcn.root_proc_of(self.locate(source)[0])
+            payload, st = self.pml.recv_blocking(
+                dest,
+                ANY_SOURCE if source is None else source,
+                ANY_TAG if tag is None else tag,
+                fail_proc,
+            )
+            return payload, st
         req = self.irecv(dest, source, tag)
         return req.wait(), req.status
 
@@ -444,12 +513,9 @@ class MultiProcComm(PersistentP2PMixin):
         allreduce on a shrink-style survivor stream (works on revoked
         comms — agreement is how ranks coordinate after revoke)."""
         live = self._live_procs()
-        from ompi_tpu.dcn.collops import DcnSubEngine
         from ompi_tpu.op import BAND
 
-        eng = self.dcn if len(live) == self.nprocs else DcnSubEngine(
-            self.dcn, live
-        )
+        eng = self.dcn if len(live) == self.nprocs else self.dcn.sub(live)
         k = self._next_shrink()
         out = eng.allreduce(np.array([int(flags)], np.int64), BAND,
                             f"{self.cid}#agree{k}", ordered=True)
@@ -485,10 +551,8 @@ class MultiProcComm(PersistentP2PMixin):
         survivor must already know the same failed set — heartbeat
         gossip converges within one period, so call shrink after
         ``get_failed`` reflects the failure on every survivor."""
-        from ompi_tpu.dcn.collops import DcnSubEngine
-
         live = self._live_procs()
-        eng = DcnSubEngine(self.dcn, live) if len(live) < self.nprocs else self.dcn
+        eng = self.dcn.sub(live) if len(live) < self.nprocs else self.dcn
         k = self._next_shrink()
         infos = eng.allgather_obj(
             {"cid": _peek_cid(),
@@ -629,14 +693,13 @@ class MultiProcComm(PersistentP2PMixin):
         """Construct one split result (members/owners in sub-rank
         order; ``member_procs`` = owning processes in first-appearance
         order, this process among them)."""
-        from ompi_tpu.dcn.collops import DcnSubEngine
         from .comm import Comm
 
         c = MultiProcComm.__new__(MultiProcComm)
         c.procctx = self.procctx
         c.nprocs = len(member_procs)
         c.proc = member_procs.index(self.proc)
-        c.dcn = DcnSubEngine(self.dcn, member_procs)
+        c.dcn = self.dcn.sub(member_procs)
         c.cid = cid
         c.name = f"{self.name}.split({color})"
         c._freed = False
@@ -661,6 +724,12 @@ class MultiProcComm(PersistentP2PMixin):
     def free(self) -> None:
         self.dcn.unregister_p2p(self.cid)
         self.dcn.unregister_comm(self.cid)
+        if self._chans:
+            root = self.dcn._native_root()
+            with self._pml_lock:
+                for ch in self._chans.values():
+                    root.chan_close(ch)
+                self._chans.clear()
         self._freed = True
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -165,7 +165,7 @@ class SpawnIntercomm:
             sizes = list(psizes) + list(csizes)
             gproc = (ctx.proc if am_parent
                      else np_parents + ctx.proc)
-        join = DcnJoinEngine(ctx.engine, addrs, gproc)
+        join = ctx.engine.join(addrs, gproc)
         order = "cf" if children_first else "pf"
         return _join_world(self._world, join, ns, sizes,
                            cid=f"{ns}merged{j}_{order}")
@@ -277,7 +277,7 @@ def spawn(argv: Sequence[str], maxprocs: int, root: int = 0):
                    for i in range(maxprocs)]
     child_sizes = ctx.kvs.get(f"{ns}csizes", timeout=120)
     parent_addrs = list(ctx.engine.addresses)
-    join = DcnJoinEngine(ctx.engine, parent_addrs + child_addrs, ctx.proc)
+    join = ctx.engine.join(parent_addrs + child_addrs, ctx.proc)
     merged = _join_world(world, join, ns,
                          list(world.proc_sizes) + list(child_sizes))
     psize = int(sum(world.proc_sizes))
@@ -312,7 +312,7 @@ def get_parent():
                     for p in range(pn)]
     parent_sizes = ctx.kvs.get(f"{ns}psizes", timeout=120)
     child_addrs = list(ctx.engine.addresses)
-    join = DcnJoinEngine(ctx.engine, parent_addrs + child_addrs,
+    join = ctx.engine.join(parent_addrs + child_addrs,
                          pn + ctx.proc)
     merged = _join_world(world, join, ns,
                          list(parent_sizes) + list(world.proc_sizes))

@@ -55,12 +55,30 @@ class ProcContext:
             # bad --mca btl_tcp_* values propagate (the reference
             # aborts on unparseable MCA values; so do we)
             params = comp.params(ctx.store)
-        self.engine = DcnCollEngine(self.proc, self.nprocs, **params)
+        self.engine = self._make_engine(params)
         self.kvs.put(f"{self.ns}dcn.{self.proc}", self.engine.transport.address)
         self.kvs.fence(f"{self.ns}modex", self.proc, self.nprocs)
-        self.engine.set_addresses(
-            [self.kvs.get(f"{self.ns}dcn.{p}") for p in range(self.nprocs)]
-        )
+        addresses = [self.kvs.get(f"{self.ns}dcn.{p}")
+                     for p in range(self.nprocs)]
+        # wire-plane agreement: the published address reveals each
+        # peer's plane ("ntv:" = libtpudcn framing).  A mixed job (one
+        # host lacking the C++ toolchain, a per-process fallback) must
+        # abort HERE with a clear message — native frames against a
+        # Python endpoint would otherwise hang the first collective.
+        mine = addresses[self.proc].startswith("ntv:")
+        mixed = [p for p, a in enumerate(addresses)
+                 if a.startswith("ntv:") != mine]
+        if mixed:
+            from ompi_tpu.core.errors import MPIInternalError
+
+            raise MPIInternalError(
+                f"DCN wire-plane mismatch: proc {self.proc} uses the "
+                f"{'native' if mine else 'Python'} transport but procs "
+                f"{mixed} published the other plane (a host without "
+                f"the C++ toolchain?); force one with --mca btl "
+                f"tcp|sm|bml on every host"
+            )
+        self.engine.set_addresses(addresses)
         # failure detector (tpurun --ft / --mca ft_detector_enable 1):
         # heartbeats + gossip; detections fan out to every registered
         # communicator's ULFM state (SURVEY.md §5 failure detection)
@@ -78,6 +96,34 @@ class ProcContext:
                 self.engine, period=ftp["period"], timeout=ftp["timeout"]
             )
             self.detector.on_failure(self._fan_out_failure)
+
+    def _make_engine(self, params: dict):
+        """Engine selection: the native C++ data plane when the btl
+        picked it AND libtpudcn builds on this machine; otherwise the
+        Python transports (also the fallback when the toolchain is
+        absent — same graceful degradation as a reference build
+        without a btl's prerequisites)."""
+        params = dict(params)
+        if params.get("transport") == "native":
+            params.pop("transport")
+            try:
+                from ompi_tpu.dcn import native as dcn_native
+
+                if dcn_native.available():
+                    return dcn_native.NativeDcnEngine(
+                        self.proc, self.nprocs, **params)
+            except Exception as e:  # noqa: BLE001 — degrade, loudly
+                import sys
+
+                print(
+                    f"[ompi_tpu] native data plane unavailable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"Python bml transport", file=sys.stderr,
+                )
+            params.pop("ring_bytes", None)
+            params["transport"] = "bml"
+        params.pop("ring_bytes", None)
+        return DcnCollEngine(self.proc, self.nprocs, **params)
 
     def _fan_out_failure(self, root_proc: int) -> None:
         with self._ft_lock:  # registration races the detector thread

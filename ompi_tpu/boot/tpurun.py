@@ -260,6 +260,14 @@ def main(argv: list[str] | None = None) -> int:
         "(default 'ssh {host} {cmd}')",
     )
     parser.add_argument(
+        "--ras", default="auto",
+        choices=["auto", "slurm", "gridengine", "none"],
+        help="resource-allocation reader: adopt a SLURM/Grid Engine "
+        "allocation from the environment when no --host/--hostfile is "
+        "given ('auto' detects, 'slurm'/'gridengine' require one, "
+        "'none' disables adoption)",
+    )
+    parser.add_argument(
         "--oversubscribe", action="store_true",
         help="allow more ranks than allocated slots",
     )
@@ -286,6 +294,17 @@ def main(argv: list[str] | None = None) -> int:
         from .rmaps import parse_host_list
 
         hosts = parse_host_list(ns.host)
+    elif ns.ras != "none":
+        # ras: adopt a resource manager's allocation (SURVEY §2.4
+        # ras/slurm + ras/gridengine)
+        from . import ras as ras_mod
+
+        if ns.ras == "auto":
+            hosts = ras_mod.detect(os.environ)
+        elif ns.ras == "slurm":
+            hosts = ras_mod.read_slurm(os.environ)
+        else:  # argparse choices guarantees: gridengine
+            hosts = ras_mod.read_gridengine(os.environ)
     return run_job(ns.np, [ns.script] + ns.args, mca, ns.cpu_devices,
                    ft=ns.ft, hosts=hosts, map_by=ns.map_by,
                    launch_agent=ns.launch_agent,

@@ -46,6 +46,23 @@ class HanCollModule(CollModule):
 
     # -- allreduce ------------------------------------------------------
 
+
+    # -- single-rank local comms skip the fabric op entirely ------------
+    # (ln == 1 makes every intra-slice collective an identity; paying an
+    # XLA dispatch + D2H for it would dominate np-small DCN latency.
+    # The reference's han likewise short-circuits single-member
+    # subgroups.)
+
+    def _local_allreduce(self, x, op: Op) -> np.ndarray:
+        if self.comm.local_size == 1:
+            return np.asarray(x)
+        return np.asarray(self.comm.local.allreduce(x, op))
+
+    def _local_scan(self, x, op: Op) -> np.ndarray:
+        if self.comm.local_size == 1:
+            return np.asarray(x)
+        return np.asarray(self.comm.local.scan(x, op))
+
     def allreduce(self, x, op: Op, _cid=None):
         """Two-level fold: slice-local fabric reduce, then the
         process-ordered DCN fold. Deterministic bracketing
@@ -61,7 +78,7 @@ class HanCollModule(CollModule):
         comm = self.comm
         cid = comm.cid if _cid is None else _cid
         x = np.asarray(x)
-        local = np.asarray(comm.local.allreduce(x, op))  # (ln, *s), equal rows
+        local = self._local_allreduce(x, op)  # (ln, *s), equal rows
         partial = local[0]
         combined = comm.dcn.allreduce(partial, op, cid,
                                       ordered=self._ordered())
@@ -85,7 +102,7 @@ class HanCollModule(CollModule):
         cid = comm.cid if _cid is None else _cid
         x = np.asarray(x)
         root_proc, _ = comm.locate(root)
-        partial = np.asarray(comm.local.allreduce(x, op))[0]  # (*s)
+        partial = self._local_allreduce(x, op)[0]  # (*s)
         slices = comm.dcn.gather(partial[None], root_proc, cid)
         if slices is None:
             return None
@@ -159,7 +176,7 @@ class HanCollModule(CollModule):
     def allreduce_rows(self, x, op: Op, _cid=None):
         comm = self.comm
         cid = comm.cid if _cid is None else _cid
-        local = np.asarray(comm.local.allreduce(x, op))[0]  # (global_n, *s)
+        local = self._local_allreduce(x, op)[0]  # (global_n, *s)
         return comm.dcn.allreduce(local, op, cid, ordered=self._ordered())
 
     def reduce_scatter(self, x, op: Op, counts=None, _cid=None):
@@ -203,7 +220,8 @@ class HanCollModule(CollModule):
     # -- barrier / scan -------------------------------------------------
 
     def barrier(self, _cid=None):
-        self.comm.local.barrier()
+        if self.comm.local_size > 1:
+            self.comm.local.barrier()
         self.comm.dcn.barrier(self.comm.cid if _cid is None else _cid)
 
     # scan/exscan (VERDICT r2 weak #5): the DCN moves ONE row per
@@ -219,7 +237,7 @@ class HanCollModule(CollModule):
         cid = comm.cid if _cid is None else _cid
         x = np.asarray(x)
         # intra-slice inclusive scan on the fabric (rank-ordered)
-        local_incl = np.asarray(comm.local.scan(x, op))  # (ln, *s)
+        local_incl = self._local_scan(x, op)  # (ln, *s)
         proc_sum = local_incl[-1]
         sums = comm.dcn.allgather(np.ascontiguousarray(proc_sum)[None], cid)
         if comm.proc == 0:
@@ -235,7 +253,7 @@ class HanCollModule(CollModule):
         comm = self.comm
         cid = comm.cid if _cid is None else _cid
         x = np.asarray(x)
-        local_incl = np.asarray(comm.local.scan(x, op))  # (ln, *s)
+        local_incl = self._local_scan(x, op)  # (ln, *s)
         proc_sum = local_incl[-1]
         sums = comm.dcn.allgather(np.ascontiguousarray(proc_sum)[None], cid)
         out = np.zeros_like(local_incl)

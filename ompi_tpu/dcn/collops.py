@@ -414,6 +414,15 @@ class DcnCollEngine:
     def barrier(self, cid: int) -> None:
         self.allreduce(np.zeros(1, np.int32), _SUM_TOKEN, cid)
 
+    # -- view factories (overridden by the native engine so sub-comms
+    # and spawn joins stay on the same byte plane as their root) ------
+
+    def sub(self, procs: Sequence[int]) -> "DcnSubEngine":
+        return DcnSubEngine(self, procs)
+
+    def join(self, addresses: Sequence[str], proc: int) -> "DcnJoinEngine":
+        return DcnJoinEngine(self, addresses, proc)
+
     def close(self) -> None:
         self.transport.close()
 

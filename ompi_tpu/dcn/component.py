@@ -87,6 +87,34 @@ class DcnShmComponent(DcnTcpComponent):
 
 
 @register_component
+class DcnNativeComponent(DcnTcpComponent):
+    """``btl/native`` — the C++ host data plane (``libtpudcn.so``):
+    native framing, shared-memory rings + TCP per peer (the bml role),
+    and the C matching engine under blocked receives.  Highest
+    priority: selected by default when the library builds; ``--mca btl
+    tcp|sm|bml`` still forces a Python transport (the compat plane the
+    interposed pmls use anyway).  SURVEY.md §2 native-path rule."""
+
+    NAME = "native"
+    PRIORITY = 60
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "btl", "native", "ring_bytes", 64 << 20, type="int",
+            help="Per-peer-direction shared-memory ring capacity "
+            "(bytes); payloads beyond half of this stream as chunked "
+            "records through the ring",
+        )
+
+    def params(self, store) -> dict:
+        p = super().params(store)
+        p["transport"] = "native"
+        p["ring_bytes"] = store.get("btl_native_ring_bytes")
+        return p
+
+
+@register_component
 class DcnBmlComponent(DcnShmComponent):
     """``btl/bml`` — the r2-style per-peer multiplexer: shared-memory
     rings for same-host peers, TCP for cross-host, chosen per SEND by

@@ -1,0 +1,617 @@
+"""Native DCN engine — the C++ host data plane behind the Python
+control plane.
+
+≈ SURVEY.md §2's native-path rule ("shared-memory & TCP transports,
+progress engine, request engine … in C++"): :mod:`native/src/dcn.cc`
+(``libtpudcn.so``) owns framing, sockets, shared-memory rings, the
+coll-stream slots, and the p2p matching engine; this module is the
+ctypes control plane — connection bring-up via the modex address,
+rendezvous policy knobs, communicator bookkeeping, and the ULFM/
+monitoring integration points all stay Python.
+
+Blocked receives sleep INSIDE C (GIL released) on a condition variable
+the C receiver thread notifies — no Python thread handoff on the
+latency path.  Frames that need Python semantics (heartbeats, ULFM
+gossip/revoke, OSC RMA envelopes, communicators whose pml is wrapped
+by monitoring/vprotocol) arrive on a single dispatcher thread that
+blocks in ``tdcn_ctrl_next`` and feeds the same
+:meth:`DcnCollEngine._on_frame` router the Python transport used —
+full behavioral compatibility at control-plane rates.
+
+Engine classes mirror the Python trio: :class:`NativeDcnEngine` (root,
+owns the C engine), :class:`NativeSubEngine` (cross-process
+comm_split view), :class:`NativeJoinEngine` (spawn/join across
+worlds).  All three share the root's C engine; sub/join views only
+remap indices, exactly like their Python counterparts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import json
+import threading
+import weakref
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ompi_tpu.core.errors import (
+    MPIInternalError,
+    MPIProcFailedError,
+)
+from .collops import DcnCollEngine, DcnJoinEngine, DcnSubEngine
+
+FK_COLL, FK_P2P, FK_PY = 0, 1, 2
+
+_RC_TIMEOUT = 1
+_RC_FAILED = -2
+_RC_CLOSED = -3
+
+
+class TdcnMsg(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("src", ctypes.c_int32),
+        ("dst", ctypes.c_int32),
+        ("tag", ctypes.c_int32),
+        ("seq", ctypes.c_int64),
+        ("pyhandle", ctypes.c_uint64),
+        ("data", ctypes.c_void_p),
+        ("nbytes", ctypes.c_uint64),
+        ("count", ctypes.c_int64),
+        ("dtype", ctypes.c_char * 16),
+        ("ndim", ctypes.c_int32),
+        ("shape", ctypes.c_int64 * 8),
+        ("cid", ctypes.c_char * 128),
+        ("meta", ctypes.c_void_p),
+        ("meta_len", ctypes.c_uint32),
+    ]
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_library():
+    """Build (cached) and load libtpudcn.so with typed signatures."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from ompi_tpu import native as nat
+
+        nat.build()
+        path = nat.BUILD_DIR / "libtpudcn.so"
+        lib = ctypes.CDLL(str(path))
+        P, I, I64, U64, D, S = (ctypes.c_void_p, ctypes.c_int,
+                                ctypes.c_int64, ctypes.c_uint64,
+                                ctypes.c_double, ctypes.c_char_p)
+        MSG = ctypes.POINTER(TdcnMsg)
+        lib.tdcn_create.restype = P
+        lib.tdcn_create.argtypes = [I, I, S, I64, I64, U64, I]
+        lib.tdcn_address.restype = ctypes.c_char_p
+        lib.tdcn_address.argtypes = [P]
+        lib.tdcn_set_addresses.argtypes = [P, S]
+        lib.tdcn_send_addr.restype = I
+        lib.tdcn_send_addr.argtypes = [
+            P, S, I, S, I64, I, I, I, S, I,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p, I,
+            ctypes.c_void_p, U64]
+        lib.tdcn_send_local.restype = I
+        lib.tdcn_send_local.argtypes = [P, I, S, I64, I, I, I, U64, I64,
+                                        U64]
+        lib.tdcn_recv_coll.restype = I
+        lib.tdcn_recv_coll.argtypes = [P, S, I64, I, I, D, MSG]
+        lib.tdcn_post_recv.restype = U64
+        lib.tdcn_post_recv.argtypes = [P, S, I, I, I]
+        lib.tdcn_req_wait.restype = I
+        lib.tdcn_req_wait.argtypes = [P, U64, D, MSG]
+        lib.tdcn_req_test.restype = I
+        lib.tdcn_req_test.argtypes = [P, U64, MSG]
+        lib.tdcn_req_cancel.restype = I
+        lib.tdcn_req_cancel.argtypes = [P, U64]
+        lib.tdcn_probe.restype = I
+        lib.tdcn_probe.argtypes = [P, S, I, I, I, MSG]
+        lib.tdcn_pending.restype = I
+        lib.tdcn_pending.argtypes = [P, S, I, I]
+        lib.tdcn_register_pycid.argtypes = [P, S]
+        lib.tdcn_unregister_cid.argtypes = [P, S]
+        lib.tdcn_ctrl_next.restype = I
+        lib.tdcn_ctrl_next.argtypes = [P, D, MSG]
+        lib.tdcn_note_failed.argtypes = [P, I]
+        lib.tdcn_is_failed.restype = I
+        lib.tdcn_is_failed.argtypes = [P, I]
+        lib.tdcn_bytes_sent.restype = U64
+        lib.tdcn_bytes_sent.argtypes = [P]
+        lib.tdcn_free.argtypes = [ctypes.c_void_p]
+        lib.tdcn_close.argtypes = [P]
+        lib.tdcn_chan_open.restype = U64
+        lib.tdcn_chan_open.argtypes = [P, S, S]
+        lib.tdcn_chan_close.argtypes = [P, U64]
+        lib.tdcn_chan_send.restype = I
+        lib.tdcn_chan_send.argtypes = [
+            P, U64, I, I, I, I, S, I, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, U64]
+        lib.tdcn_precv.restype = I
+        lib.tdcn_precv.argtypes = [P, S, I, I, I, I, D, MSG]
+        lib.tdcn_chan_send1.restype = I
+        lib.tdcn_chan_send1.argtypes = [
+            P, U64, I, I, I, I, S, I64, ctypes.c_void_p, U64]
+        _lib = lib
+        return lib
+
+
+_tls = threading.local()
+
+
+def _tls_msg() -> TdcnMsg:
+    """Reusable per-thread TdcnMsg: safe because every consumer copies
+    or re-owns the payload before the next native call."""
+    m = getattr(_tls, "msg", None)
+    if m is None:
+        m = TdcnMsg()
+        _tls.msg = m
+        _tls.msg_ref = ctypes.byref(m)
+    return m
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:  # noqa: BLE001 — no toolchain / unsupported OS
+        return False
+
+
+_dtype_cache: dict[bytes, np.dtype] = {}
+_dtype_bytes: dict[object, bytes] = {}
+
+
+def _dt_of(code: bytes) -> np.dtype:
+    dt = _dtype_cache.get(code)
+    if dt is None:
+        dt = np.dtype(code.decode() or "u1")
+        _dtype_cache[code] = dt
+    return dt
+
+
+def _dt_bytes(dt: np.dtype) -> bytes:
+    b = _dtype_bytes.get(dt)
+    if b is None:
+        b = dt.str.encode()
+        _dtype_bytes[dt] = b
+    return b
+
+
+#: below this, copying into a fresh numpy buffer and freeing the C
+#: allocation immediately beats the zero-copy wrapper's finalizer cost
+_COPY_LIMIT = 64 << 10
+
+
+def _wrap_payload(lib, msg: TdcnMsg) -> np.ndarray:
+    """Numpy array over the C-owned payload: small payloads are copied
+    (and the native buffer freed now); large ones are wrapped zero-copy
+    with a finalizer freeing the native allocation at GC."""
+    dt = _dt_of(msg.dtype)
+    shape = tuple(msg.shape[i] for i in range(msg.ndim))
+    if not msg.nbytes:
+        return np.empty(shape if msg.ndim else (0,), dt)
+    if msg.nbytes <= _COPY_LIMIT:
+        src = np.frombuffer(
+            (ctypes.c_char * msg.nbytes).from_address(msg.data),
+            dtype=np.uint8)
+        arr = src.view(dt).reshape(shape).copy()
+        lib.tdcn_free(msg.data)
+        return arr
+    buf = (ctypes.c_char * msg.nbytes).from_address(msg.data)
+    weakref.finalize(buf, lib.tdcn_free, msg.data)
+    arr = np.frombuffer(buf, dtype=np.uint8).view(dt)
+    return arr.reshape(shape)
+
+
+def _meta_of(lib, msg: TdcnMsg):
+    if not msg.meta:
+        return None
+    raw = ctypes.string_at(msg.meta, msg.meta_len)
+    lib.tdcn_free(msg.meta)
+    msg.meta = None
+    try:
+        return json.loads(raw.decode())
+    except ValueError:
+        return None
+
+
+class _NativeTransportView:
+    """The ``engine.transport`` surface other layers read (address,
+    bytes_sent, liveness) mapped onto the C engine."""
+
+    def __init__(self, eng: "NativeDcnEngine"):
+        self._eng = eng
+
+    @property
+    def address(self) -> str:
+        return self._eng.address
+
+    @property
+    def bytes_sent(self) -> int:
+        return int(self._eng._lib.tdcn_bytes_sent(self._eng._h))
+
+    @property
+    def _running(self) -> bool:
+        return self._eng._running
+
+    def close(self) -> None:
+        self._eng.close()
+
+
+class _NativeOpsMixin:
+    """Byte-plane methods shared by root/sub/join native engines; all
+    route through the ROOT engine's C handle with address-mapped
+    peers (sub/join views only remap indices)."""
+
+    def _native_root(self) -> "NativeDcnEngine":
+        raise NotImplementedError
+
+    def root_proc_of(self, local: int) -> int:
+        """Map a LOCAL engine index to the root engine's proc index
+        (-1 = unmapped, e.g. across spawn worlds)."""
+        raise NotImplementedError
+
+    # -- coll streams ---------------------------------------------------
+
+    def _send(self, dst: int, cid, seq: int, payload: np.ndarray,
+              meta=None) -> None:
+        root = self._native_root()
+        arr = np.ascontiguousarray(payload)
+        meta_b = json.dumps(meta).encode() if meta is not None else None
+        rc = root._csend(
+            self.addresses[dst], FK_COLL, str(cid), seq, self.proc, 0, 0,
+            arr, meta_b)
+        if rc != 0:
+            raise ConnectionError(
+                f"native dcn send to proc {dst} failed (rc={rc})")
+
+    def _recv_full(self, src: int, cid, seq: int, timeout: float = 120.0):
+        root = self._native_root()
+        lib, h = root._lib, root._h
+        fail_idx = self.root_proc_of(src)
+        msg = TdcnMsg()
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            rc = lib.tdcn_recv_coll(h, str(cid).encode(), seq, src,
+                                    fail_idx, 0.25, ctypes.byref(msg))
+            if rc == 0:
+                break
+            if rc == _RC_CLOSED:
+                raise MPIInternalError("DCN recv: engine closed")
+            if (rc == _RC_FAILED or
+                    (fail_idx >= 0 and root.proc_failed(fail_idx))):
+                raise MPIProcFailedError(
+                    f"DCN recv: peer proc {src} failed (cid={cid}, "
+                    f"seq={seq})", failed=(src,))
+            if _time.monotonic() > deadline:
+                raise MPIInternalError(
+                    f"DCN recv timeout after {timeout}s: proc {self.proc} "
+                    f"waiting for proc {src} (cid={cid}, seq={seq}) — "
+                    f"peer dead or collective order mismatch")
+        env = {"cid": cid, "seq": seq, "src": src}
+        meta = _meta_of(lib, msg)
+        if meta is not None:
+            env["meta"] = meta
+        return env, _wrap_payload(lib, msg)
+
+    # -- p2p / control --------------------------------------------------
+
+    def send_p2p(self, dst_proc: int, envelope: dict, payload) -> None:
+        root = self._native_root()
+        arr = np.ascontiguousarray(np.asarray(payload))
+        keys = set(envelope)
+        cid = envelope.get("cid")
+        if keys == {"cid", "src", "dst", "tag"} and root.is_native_cid(cid):
+            rc = root._csend(
+                self.addresses[dst_proc], FK_P2P, str(cid), 0,
+                int(envelope["src"]), int(envelope["dst"]),
+                int(envelope["tag"]), arr, None)
+        else:
+            env = dict(envelope)
+            env["kind"] = "p2p"
+            rc = root._csend(
+                self.addresses[dst_proc], FK_PY, str(cid), 0, 0, 0, 0,
+                arr, json.dumps(env).encode())
+        if rc != 0:
+            raise ConnectionError(
+                f"native dcn p2p send to proc {dst_proc} failed (rc={rc})")
+
+    def send_ctrl(self, dst: int, envelope: dict) -> None:
+        root = self._native_root()
+        rc = root._csend(
+            self.addresses[dst], FK_PY, "", 0, 0, 0, 0,
+            np.zeros(0, np.uint8), json.dumps(dict(envelope)).encode())
+        if rc != 0:
+            raise ConnectionError(
+                f"native dcn ctrl send to proc {dst} failed (rc={rc})")
+
+    # -- engine views ---------------------------------------------------
+
+    def sub(self, procs: Sequence[int]) -> "NativeSubEngine":
+        return NativeSubEngine(self, procs)
+
+    def join(self, addresses: Sequence[str], proc: int) -> "NativeJoinEngine":
+        return NativeJoinEngine(self, addresses, proc)
+
+
+class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
+    """Root engine: owns the C engine, the dispatcher thread, and the
+    local-payload handle table."""
+
+    def __init__(
+        self,
+        proc: int,
+        nprocs: int,
+        addresses: Sequence[str] | None = None,
+        eager_limit: int = 4 << 20,
+        frag_size: int = 8 << 20,
+        max_rndv: int = 4,
+        ring_threshold: int = 64 << 10,
+        ring_bytes: int = 64 << 20,
+        **_ignored,
+    ):
+        # deliberately NOT calling DcnCollEngine.__init__ — no Python
+        # transport; replicate the control-plane state it set up
+        self.proc = proc
+        self.nprocs = nprocs
+        self.ring_threshold = int(ring_threshold)
+        self.addresses = list(addresses) if addresses else []
+        self._seq: dict = {}
+        self._failed_procs: set[int] = set()
+        self._detector = None
+        self._comms: dict = {}
+        self._p2p_handlers: dict[object, Callable] = {}
+        self._p2p_pending: dict = {}
+        self._p2p_closed: set = set()
+        self._p2p_lock = threading.Lock()
+        self._queues: dict = {}
+        self._qlock = threading.Lock()
+
+        self._lib = load_library()
+        host_id = self._host_id()
+        self._h = self._lib.tdcn_create(
+            proc, nprocs, host_id.encode(), int(eager_limit),
+            int(frag_size), int(ring_bytes), int(max_rndv))
+        if not self._h:
+            raise MPIInternalError("tdcn_create failed")
+        self._running = True
+        self.transport = _NativeTransportView(self)
+        #: local-send payload table: handle → (payload, nbytes)
+        self._handles: dict[int, object] = {}
+        self._hnext = itertools.count(1)
+        self._hlock = threading.Lock()
+        #: cids whose p2p frames the C matcher owns (native pml comms)
+        self._native_cids: set[str] = set()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="tdcn-dispatch")
+        self._dispatcher.start()
+
+    @staticmethod
+    def _host_id() -> str:
+        import socket as _socket
+
+        hid = _socket.gethostname()
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                hid += "/" + f.read().strip()
+        except OSError:
+            pass
+        return hid
+
+    # -- mixin hooks ----------------------------------------------------
+
+    def _native_root(self) -> "NativeDcnEngine":
+        return self
+
+    def root_proc_of(self, local: int) -> int:
+        return local if 0 <= local < self.nprocs else -1
+
+    # -- C helpers ------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        return self._lib.tdcn_address(self._h).decode()
+
+    def set_addresses(self, addresses: Sequence[str]) -> None:
+        if len(addresses) != self.nprocs:
+            raise ValueError("address count != nprocs")
+        self.addresses = list(addresses)
+        self._lib.tdcn_set_addresses(
+            self._h, "\n".join(self.addresses).encode())
+
+    def _csend(self, address: str, kind: int, cid: str, seq: int,
+               src: int, dst: int, tag: int, arr: np.ndarray,
+               meta_b: bytes | None) -> int:
+        shape = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (0,)))
+        data = arr.ctypes.data_as(ctypes.c_void_p) if arr.nbytes else None
+        return self._lib.tdcn_send_addr(
+            self._h, address.encode(), kind, cid.encode(), seq, src, dst,
+            tag, _dt_bytes(arr.dtype), arr.ndim, shape,
+            meta_b, len(meta_b) if meta_b else 0, data, arr.nbytes)
+
+    # -- channel fast path (per-(peer, cid), scalar-args-only sends) ----
+
+    def chan_open(self, address: str, cid) -> int:
+        chan = self._lib.tdcn_chan_open(
+            self._h, address.encode(), str(cid).encode())
+        if not chan:
+            raise MPIInternalError(
+                f"native dcn: cannot open channel to {address}")
+        return chan
+
+    def chan_close(self, chan: int) -> None:
+        self._lib.tdcn_chan_close(self._h, chan)
+
+    def chan_send(self, chan: int, kind: int, src: int, dst: int,
+                  tag: int, arr: np.ndarray) -> None:
+        if arr.ndim == 1:
+            rc = self._lib.tdcn_chan_send1(
+                self._h, chan, kind, src, dst, tag, _dt_bytes(arr.dtype),
+                arr.shape[0], arr.ctypes.data if arr.nbytes else None,
+                arr.nbytes)
+        else:
+            rc = self._lib.tdcn_chan_send(
+                self._h, chan, kind, src, dst, tag, _dt_bytes(arr.dtype),
+                arr.ndim,
+                arr.ctypes.shape_as(ctypes.c_int64) if arr.ndim else None,
+                arr.ctypes.data if arr.nbytes else None, arr.nbytes)
+        if rc != 0:
+            raise ConnectionError(
+                f"native dcn channel send failed (rc={rc})")
+
+    # -- p2p registration (native vs Python delivery) -------------------
+
+    def is_native_cid(self, cid) -> bool:
+        return str(cid) in self._native_cids
+
+    def register_native_p2p(self, cid) -> None:
+        """Route this cid's p2p frames through the C matching engine
+        (the fast path for comms with the default pml)."""
+        self._native_cids.add(str(cid))
+
+    def register_p2p(self, cid, fn: Callable) -> None:
+        """Python delivery for this cid (OSC windows, monitored/
+        logged pml): frames reach ``fn`` via the dispatcher thread."""
+        with self._p2p_lock:
+            self._p2p_handlers[cid] = fn
+        self._lib.tdcn_register_pycid(self._h, str(cid).encode())
+
+    def unregister_p2p(self, cid) -> None:
+        with self._p2p_lock:
+            self._p2p_handlers.pop(cid, None)
+            self._p2p_closed.add(cid)
+        self._native_cids.discard(str(cid))
+        self._lib.tdcn_unregister_cid(self._h, str(cid).encode())
+
+    # -- local (same-process) sends through the native matcher ----------
+
+    def local_send(self, cid, src: int, dst: int, tag: int,
+                   payload, count: int, nbytes: int) -> None:
+        with self._hlock:
+            h = next(self._hnext)
+            self._handles[h] = payload
+        rc = self._lib.tdcn_send_local(
+            self._h, FK_P2P, str(cid).encode(), 0, src, dst, tag, h,
+            count, nbytes)
+        if rc != 0:  # pragma: no cover — local enqueue cannot fail
+            with self._hlock:
+                self._handles.pop(h, None)
+            raise MPIInternalError("tdcn_send_local failed")
+
+    def take_handle(self, h: int):
+        with self._hlock:
+            return self._handles.pop(h)
+
+    # -- dispatcher (PY-kind frames → the Python frame router) ----------
+
+    def _dispatch_loop(self) -> None:
+        lib, h = self._lib, self._h
+        msg = TdcnMsg()
+        while self._running:
+            rc = lib.tdcn_ctrl_next(h, 0.5, ctypes.byref(msg))
+            if rc == _RC_CLOSED:
+                return
+            if rc != 0:
+                continue
+            env = _meta_of(lib, msg) or {}
+            if msg.kind == FK_P2P and "kind" not in env:
+                # raced: a native-matched cid was re-registered for
+                # Python delivery; reconstruct the p2p envelope
+                env = {"kind": "p2p", "cid": msg.cid.decode() or None,
+                       "src": msg.src, "dst": msg.dst, "tag": msg.tag}
+                try:
+                    env["cid"] = int(env["cid"])
+                except (TypeError, ValueError):
+                    pass
+            payload = (self.take_handle(msg.pyhandle) if msg.pyhandle
+                       else _wrap_payload(lib, msg))
+            try:
+                self._on_frame(env, payload)
+            except Exception as e:  # noqa: BLE001 — keep dispatching
+                import sys
+
+                print(f"[ompi_tpu tdcn] dispatcher error for {env}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    # -- failure integration --------------------------------------------
+
+    def note_proc_failed(self, proc: int) -> None:
+        self._failed_procs.add(proc)
+        self._lib.tdcn_note_failed(self._h, proc)
+
+    def close(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._lib.tdcn_close(self._h)
+        if self._dispatcher.is_alive():
+            self._dispatcher.join(timeout=2.0)
+
+
+class NativeSubEngine(_NativeOpsMixin, DcnSubEngine):
+    """Cross-process split view over a native engine (index remap only;
+    byte plane shared with the root)."""
+
+    def __init__(self, parent, procs: Sequence[int]):
+        DcnSubEngine.__init__(self, parent, procs)
+
+    def _native_root(self) -> NativeDcnEngine:
+        return self.parent._native_root()
+
+    def root_proc_of(self, local: int) -> int:
+        return self.parent.root_proc_of(self.procs[local])
+
+    def is_native_cid(self, cid) -> bool:
+        return self._native_root().is_native_cid(cid)
+
+    def register_native_p2p(self, cid) -> None:
+        self._native_root().register_native_p2p(cid)
+
+    def local_send(self, *a, **k) -> None:
+        self._native_root().local_send(*a, **k)
+
+    def take_handle(self, h: int):
+        return self._native_root().take_handle(h)
+
+    @property
+    def _lib(self):
+        return self._native_root()._lib
+
+    @property
+    def _h(self):
+        return self._native_root()._h
+
+
+class NativeJoinEngine(_NativeOpsMixin, DcnJoinEngine):
+    """Spawn/join view across worlds over the native byte plane."""
+
+    def __init__(self, local, addresses: Sequence[str], proc: int):
+        DcnJoinEngine.__init__(self, local, addresses, proc)
+
+    def _native_root(self) -> NativeDcnEngine:
+        return self.parent._native_root()
+
+    def root_proc_of(self, local: int) -> int:
+        return -1  # FT does not span spawn worlds
+
+    def is_native_cid(self, cid) -> bool:
+        return self._native_root().is_native_cid(cid)
+
+    def register_native_p2p(self, cid) -> None:
+        self._native_root().register_native_p2p(cid)
+
+    def local_send(self, *a, **k) -> None:
+        self._native_root().local_send(*a, **k)
+
+    def take_handle(self, h: int):
+        return self._native_root().take_handle(h)

@@ -1,0 +1,230 @@
+"""Native matching engine bridge — pml over libtpudcn's C matcher.
+
+≈ the hot half of ``ompi/mca/pml/ob1`` (SURVEY.md §2.2: the matching
+engine under MPI_Send/Recv) moved to C++: posted/unexpected queues,
+wildcard matching, and the non-overtaking rule all live in
+``native/src/dcn.cc``; a blocked ``recv`` sleeps on a C condition
+variable the C receiver thread signals — no Python between wire and
+wakeup.  This module is the thin Python face: argument checks, SPC
+accounting, the buffered-eager copy for local sends, and Request/
+Status materialization.
+
+Same-process sends enter the C matcher as HANDLE references (the
+payload object stays in a Python-side table), so ANY_SOURCE receives
+match local and remote senders in one total arrival order — the
+single-queue property ob1's matching relies on.
+
+Selected automatically for communicators whose pml is the default
+``eager`` component on a native DCN engine; monitored/logged pmls
+(monitoring, vprotocol) keep the Python engine via the dispatcher
+path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIArgError, MPIRankError
+from ompi_tpu.request import Request
+from ompi_tpu.tool import spc
+from .pml import (
+    ANY_SOURCE,
+    ANY_TAG,
+    PROC_NULL,
+    Status,
+    _copy_payload,
+    _count_of,
+    _nbytes_of,
+)
+
+
+class NativeRecvRequest(Request):
+    """Pending receive whose completion lives in the C engine."""
+
+    def __init__(self, root, rid: int):
+        super().__init__()
+        self._root = root
+        self._rid = rid
+        self._msg = None
+        self.status: Status | None = None
+        self._lock = threading.Lock()
+
+    def _take(self, msg) -> None:
+        from ompi_tpu.dcn.native import _wrap_payload
+
+        if msg.pyhandle:
+            payload = self._root.take_handle(msg.pyhandle)
+            count, nbytes = int(msg.count), int(msg.nbytes)
+        else:
+            payload = _wrap_payload(self._root._lib, msg)
+            count, nbytes = int(payload.size), int(payload.nbytes)
+        self._msg = payload
+        self.status = Status(int(msg.src), int(msg.tag), count, nbytes)
+
+    def _poll(self) -> bool:
+        from ompi_tpu.dcn.native import TdcnMsg
+
+        with self._lock:
+            if self._msg is not None:
+                return True
+            msg = TdcnMsg()
+            rc = self._root._lib.tdcn_req_test(
+                self._root._h, self._rid, ctypes.byref(msg))
+            if rc == 0:
+                self._take(msg)
+                return True
+            return False
+
+    def _block(self) -> None:
+        from ompi_tpu.dcn.native import TdcnMsg, _RC_CLOSED
+
+        with self._lock:
+            if self._msg is not None:
+                return
+            msg = TdcnMsg()
+            while True:
+                rc = self._root._lib.tdcn_req_wait(
+                    self._root._h, self._rid, 0.25, ctypes.byref(msg))
+                if rc == 0:
+                    self._take(msg)
+                    return
+                if rc == _RC_CLOSED or rc < 0:
+                    from ompi_tpu.core.errors import MPIInternalError
+
+                    raise MPIInternalError(
+                        f"native recv wait failed (rc={rc})")
+
+    def _finalize(self):
+        return self._msg
+
+
+class _NullRecvRequest(Request):
+    def __init__(self):
+        super().__init__()
+        self.status = Status.null()
+        self._complete = True
+        self._result = None
+
+
+class NativeMatchingEngine:
+    """Per-communicator matching facade over the root native engine.
+
+    Interface-compatible with :class:`ompi_tpu.p2p.pml.MatchingEngine`
+    (send/irecv/iprobe/pending_*) — everything the Comm layers and the
+    persistent/partitioned mixins call."""
+
+    def __init__(self, root, cid, comm_size: int):
+        self._root = root
+        self._cid = str(cid)
+        self._cid_b = self._cid.encode()
+        self.comm_size = comm_size
+
+    def _check_rank(self, r: int, wild_ok: bool = False) -> None:
+        if r == PROC_NULL or (wild_ok and r == ANY_SOURCE):
+            return
+        if not 0 <= r < self.comm_size:
+            raise MPIRankError(f"rank {r} outside [0, {self.comm_size})")
+
+    # -- send (local ranks only; remote riders use the DCN frame path) --
+
+    def send(self, source: int, dest: int, payload, tag: int,
+             dest_device=None, _account: bool = True) -> None:
+        self._check_rank(source)
+        self._check_rank(dest)
+        if dest == PROC_NULL:
+            return
+        if tag < 0:
+            raise MPIArgError(f"send tag must be >= 0, got {tag}")
+        if _account and spc.attached():
+            spc.inc("send")
+            spc.inc("send_bytes", spc.payload_nbytes(payload))
+        data = _copy_payload(payload, dest_device)
+        self._root.local_send(self._cid, source, dest, tag, data,
+                              _count_of(data), _nbytes_of(data))
+
+    # -- recv -----------------------------------------------------------
+
+    def irecv(self, dest: int, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> Request:
+        self._check_rank(dest)
+        self._check_rank(source, wild_ok=True)
+        spc.inc("irecv")
+        if source == PROC_NULL:
+            return _NullRecvRequest()
+        rid = self._root._lib.tdcn_post_recv(
+            self._root._h, self._cid_b, dest, source, tag)
+        return NativeRecvRequest(self._root, rid)
+
+    # -- probe ----------------------------------------------------------
+
+    def iprobe(self, dest: int, source: int = ANY_SOURCE,
+               tag: int = ANY_TAG) -> Status | None:
+        from ompi_tpu.dcn.native import TdcnMsg
+
+        self._check_rank(dest)
+        self._check_rank(source, wild_ok=True)
+        if source == PROC_NULL:
+            return Status.null()
+        msg = TdcnMsg()
+        rc = self._root._lib.tdcn_probe(
+            self._root._h, self._cid_b, dest, source, tag,
+            ctypes.byref(msg))
+        if rc != 0:
+            return None
+        if msg.pyhandle:
+            count, nbytes = int(msg.count), int(msg.nbytes)
+        else:
+            dt = np.dtype(msg.dtype.decode() or "u1")
+            count = int(msg.nbytes) // max(1, dt.itemsize)
+            nbytes = int(msg.nbytes)
+        return Status(int(msg.src), int(msg.tag), count, nbytes)
+
+    def recv_blocking(self, dest: int, source: int, tag: int,
+                      fail_proc: int = -1):
+        """Blocking receive in ONE C crossing (match-or-post + sleep on
+        the request condvar): the fast path under MPI_Recv.  Returns
+        (payload, Status); raises on engine close or watched-proc
+        failure."""
+        from ompi_tpu.dcn.native import _tls, _tls_msg, _wrap_payload
+
+        self._check_rank(dest)
+        self._check_rank(source, wild_ok=True)
+        spc.inc("irecv")
+        if source == PROC_NULL:
+            return None, Status.null()
+        root = self._root
+        msg = _tls_msg()
+        while True:
+            rc = root._lib.tdcn_precv(
+                root._h, self._cid_b, dest, source, tag, fail_proc,
+                120.0, _tls.msg_ref)
+            if rc == 0:
+                break
+            if rc == -2:
+                from ompi_tpu.core.errors import MPIProcFailedError
+
+                raise MPIProcFailedError(
+                    f"recv: peer rank {source} failed",
+                    failed=(source,))
+            if rc < 0:
+                from ompi_tpu.core.errors import MPIInternalError
+
+                raise MPIInternalError(f"native recv failed (rc={rc})")
+        if msg.pyhandle:
+            payload = root.take_handle(msg.pyhandle)
+            count, nbytes = int(msg.count), int(msg.nbytes)
+        else:
+            payload = _wrap_payload(root._lib, msg)
+            count, nbytes = int(payload.size), int(payload.nbytes)
+        return payload, Status(int(msg.src), int(msg.tag), count, nbytes)
+
+    def pending_unexpected(self, dest: int) -> int:
+        return int(self._root._lib.tdcn_pending(
+            self._root._h, self._cid_b, dest, 0))
+
+    def pending_posted(self, dest: int) -> int:
+        return int(self._root._lib.tdcn_pending(
+            self._root._h, self._cid_b, dest, 1))

@@ -1,0 +1,264 @@
+"""libtpudcn — the C++ host data plane (SURVEY §2 native-path rule).
+
+Covers the native engine's three delivery classes (coll slots, the C
+matching engine, the Python dispatcher queue), transport selection and
+fallback, wildcard/ordering semantics against the Python engine's
+contract, the shm-ring bulk path, and the latency criterion the
+round-3 verdict set (native p2p must beat the Python transport's
+measured floor).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    not (REPO / "native").is_dir(), reason="native/ missing"
+)
+
+
+def _native():
+    from ompi_tpu.dcn import native
+
+    if not native.available():
+        pytest.skip("no C++ toolchain for libtpudcn")
+    return native
+
+
+def run_tpurun(np_, script, cpu_devices=1, mca=(), timeout=240):
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--cpu-devices", str(cpu_devices)]
+    for k, v in mca:
+        cmd += ["--mca", k, v]
+    cmd.append(str(script))
+    return subprocess.run(cmd, capture_output=True, timeout=timeout,
+                          cwd=str(REPO))
+
+
+# -- in-process engine pair (loopback, no tpurun) ----------------------
+
+
+@pytest.fixture()
+def engine_pair():
+    native = _native()
+    a = native.NativeDcnEngine(0, 2)
+    b = native.NativeDcnEngine(1, 2)
+    addrs = [a.address, b.address]
+    a.set_addresses(addrs)
+    b.set_addresses(addrs)
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_coll_stream_roundtrip(engine_pair):
+    a, b = engine_pair
+    x = np.arange(16, dtype=np.float64).reshape(4, 4)
+    a._send(1, 7, 0, x)
+    env, got = b._recv_full(0, 7, 0)
+    assert np.array_equal(got, x) and got.dtype == x.dtype
+    assert env["src"] == 0 and env["seq"] == 0
+
+
+def test_coll_meta_rides_frames(engine_pair):
+    a, b = engine_pair
+    a._send(1, "s#x", 3, np.zeros(0, np.uint8), meta={"k": [1, 2]})
+    env, _ = b._recv_full(0, "s#x", 3)
+    assert env["meta"] == {"k": [1, 2]}
+
+
+def test_engine_collectives_over_native(engine_pair):
+    import threading
+
+    a, b = engine_pair
+    out = {}
+
+    def run(eng, x):
+        from ompi_tpu.op import SUM
+
+        out[eng.proc] = eng.allreduce(np.asarray(x), SUM, 42)
+
+    ta = threading.Thread(target=run, args=(a, [1.0, 2.0]))
+    tb = threading.Thread(target=run, args=(b, [10.0, 20.0]))
+    ta.start(); tb.start(); ta.join(30); tb.join(30)
+    assert np.allclose(out[0], [11.0, 22.0])
+    assert np.allclose(out[1], [11.0, 22.0])
+
+
+def test_matching_engine_wildcards_and_ordering(engine_pair):
+    """The C matcher honors the Python engine's contract: arrival
+    order per source, ANY_SOURCE/ANY_TAG wildcards, probe without
+    consuming, local (handle) and remote (wire) senders in ONE queue."""
+    from ompi_tpu.p2p.pml_native import NativeMatchingEngine
+
+    a, b = engine_pair
+    a.register_native_p2p(99)
+    b.register_native_p2p(99)  # SPMD: every proc wires the cid
+    ma = NativeMatchingEngine(a, 99, 4)
+    # remote frames from engine b (rank 2 -> rank 1)
+    b.send_p2p(0, {"cid": 99, "src": 2, "dst": 1, "tag": 5},
+               np.array([1.0]))
+    b.send_p2p(0, {"cid": 99, "src": 2, "dst": 1, "tag": 5},
+               np.array([2.0]))
+    # local send into the same queues (rank 0 -> rank 1)
+    ma.send(0, 1, np.array([3.0]), 5)
+    # wait for wire delivery, then probe sees the EARLIEST match
+    deadline = 100
+    while ma.pending_unexpected(1) < 3 and deadline:
+        import time
+
+        time.sleep(0.01)
+        deadline -= 1
+    assert ma.pending_unexpected(1) == 3
+    st = ma.iprobe(1)  # full wildcard: earliest ARRIVAL (local and
+    # remote sends race the wire; MPI only orders per source)
+    assert st.source in (0, 2) and st.count == 1
+    # per-source non-overtaking: first tag-5 from src 2 is 1.0
+    got = ma.irecv(1, 2, 5).wait()
+    assert got[0] == 1.0
+    # wildcard now matches the SECOND remote before the local? No —
+    # arrival order: remote#2 arrived before local iff wire beat the
+    # local enqueue; assert per-source order only (MPI's guarantee)
+    got2 = ma.irecv(1, 2, -1).wait()
+    assert got2[0] == 2.0
+    got3 = ma.irecv(1, -1, -1).wait()
+    assert got3[0] == 3.0
+
+
+def test_recv_blocking_fast_path(engine_pair):
+    from ompi_tpu.p2p.pml_native import NativeMatchingEngine
+
+    a, b = engine_pair
+    a.register_native_p2p(7)
+    b.register_native_p2p(7)
+    ma = NativeMatchingEngine(a, 7, 2)
+    b.send_p2p(0, {"cid": 7, "src": 1, "dst": 0, "tag": 9},
+               np.arange(5, dtype=np.int32))
+    payload, st = ma.recv_blocking(0, 1, 9)
+    assert np.array_equal(payload, np.arange(5, dtype=np.int32))
+    assert st.source == 1 and st.tag == 9 and st.count == 5
+    assert st.nbytes == 20
+
+
+def test_large_payload_ring_chunking(engine_pair):
+    """Payloads beyond half the ring stream as chunked records; bytes
+    must survive exactly (the r3 sm 4 MiB regression scenario)."""
+    a, b = engine_pair
+    rng = np.random.default_rng(7)
+    big = rng.integers(0, 255, size=40 << 20, dtype=np.uint8)  # 40 MiB
+    import threading
+
+    got = {}
+
+    def rx():
+        _, arr = b._recv_full(0, 11, 0, timeout=60.0)
+        got["x"] = arr
+
+    t = threading.Thread(target=rx)
+    t.start()
+    a._send(1, 11, 0, big)
+    t.join(60)
+    assert got["x"].nbytes == big.nbytes
+    assert np.array_equal(got["x"], big)
+
+
+def test_py_dispatcher_routes_ctrl_frames(engine_pair):
+    a, b = engine_pair
+    seen = {}
+
+    class Det:
+        def on_heartbeat(self, src):
+            seen["hb"] = src
+
+    b.attach_detector(Det())
+    a.send_ctrl(1, {"kind": "hb", "src": 0})
+    import time
+
+    deadline = time.monotonic() + 10
+    while "hb" not in seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen.get("hb") == 0
+
+
+def test_native_failure_wakes_coll_recv(engine_pair):
+    from ompi_tpu.core.errors import MPIProcFailedError
+
+    a, _ = engine_pair
+    a.note_proc_failed(1)
+    with pytest.raises(MPIProcFailedError):
+        a._recv_full(1, 5, 0, timeout=30.0)
+
+
+def test_transport_view_surface(engine_pair):
+    a, b = engine_pair
+    assert a.address.startswith("ntv:")
+    assert a.transport.address == a.address
+    before = a.transport.bytes_sent
+    a._send(1, 13, 0, np.zeros(1024, np.uint8))
+    assert a.transport.bytes_sent >= before + 1024
+    b._recv_full(0, 13, 0)
+
+
+def test_sub_engine_views_share_plane(engine_pair):
+    a, b = engine_pair
+    sa, sb = a.sub([0, 1]), b.sub([0, 1])
+    assert type(sa).__name__ == "NativeSubEngine"
+    sa._send(1, "sub1", 0, np.array([5], np.int64))
+    _, got = sb._recv_full(0, "sub1", 0)
+    assert got[0] == 5
+    ja = a.join([a.address, b.address], 0)
+    assert type(ja).__name__ == "NativeJoinEngine"
+
+
+def test_default_engine_is_native_under_tpurun():
+    _native()
+    worker = REPO / "tests" / "workers" / "native_probe_worker.py"
+    res = run_tpurun(2, worker)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert out.count("ENGINE NativeDcnEngine pml=NativeMatchingEngine") == 2
+
+
+def test_python_transport_still_selectable():
+    """--mca btl tcp forces the Python transport (compat plane)."""
+    worker = REPO / "tests" / "workers" / "native_probe_worker.py"
+    res = run_tpurun(2, worker, mca=[("btl", "tcp")])
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert out.count("ENGINE DcnCollEngine pml=MatchingEngine") == 2
+
+
+def test_monitoring_keeps_python_pml_over_native():
+    """Interposed pmls (monitoring) must keep Python delivery even on
+    the native engine — the dispatcher compat path."""
+    worker = REPO / "tests" / "workers" / "native_probe_worker.py"
+    res = run_tpurun(2, worker, mca=[("monitoring_base_enable", "1")])
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert out.count("ENGINE NativeDcnEngine pml=MonitoredEngine") == 2
+
+
+def test_native_latency_beats_python_floor():
+    """The round-3 verdict's criterion: the native plane must clearly
+    beat the Python transport's measured p2p floor on the same box.
+    Compare like-for-like in one run (absolute thresholds would be
+    hostage to the host's core count — this box may have ONE core)."""
+    _native()
+    worker = REPO / "tests" / "workers" / "native_latency_worker.py"
+    res = run_tpurun(2, worker, timeout=300)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    import json
+
+    line = [l for l in out.splitlines() if "LATCMP " in l][0]
+    r = json.loads(line.split("LATCMP ", 1)[1])
+    # native must win by a clear margin (r3 floor was 83-92 us; the
+    # python transport pays two Python thread handoffs per message)
+    assert r["native_us"] < r["python_us"], r
+    assert r["native_us"] < 60.0, r  # sanity ceiling, generous for CI

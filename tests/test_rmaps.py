@@ -132,3 +132,93 @@ def test_rsh_leg_requires_kvs_host():
     )
     assert res.returncode != 0
     assert b"--kvs-host" in res.stdout + res.stderr
+
+
+# -- ras: resource-manager allocation readers (SURVEY 2.4) ------------
+
+
+def test_slurm_nodelist_expansion():
+    from ompi_tpu.boot.ras import expand_nodelist
+
+    assert expand_nodelist("n[001-003,007],login1") == [
+        "n001", "n002", "n003", "n007", "login1"]
+    assert expand_nodelist("gpu[2,4-5]") == ["gpu2", "gpu4", "gpu5"]
+    assert expand_nodelist("single") == ["single"]
+    assert expand_nodelist("a,b,c") == ["a", "b", "c"]
+    # unpadded ranges stay unpadded
+    assert expand_nodelist("x[9-11]") == ["x9", "x10", "x11"]
+
+
+def test_slurm_tasks_per_node():
+    from ompi_tpu.boot.ras import expand_tasks_per_node
+
+    assert expand_tasks_per_node("2(x3),1") == [2, 2, 2, 1]
+    assert expand_tasks_per_node("4") == [4]
+    with pytest.raises(Exception):
+        expand_tasks_per_node("nope")
+
+
+def test_read_slurm_allocation():
+    from ompi_tpu.boot.ras import read_slurm
+
+    env = {"SLURM_JOB_NODELIST": "n[01-03]",
+           "SLURM_TASKS_PER_NODE": "2(x2),1"}
+    assert read_slurm(env) == [("n01", 2), ("n02", 2), ("n03", 1)]
+    # no tasks var -> one slot per node
+    assert read_slurm({"SLURM_JOB_NODELIST": "a,b"}) == [("a", 1), ("b", 1)]
+    with pytest.raises(Exception):
+        read_slurm({})
+
+
+def test_read_gridengine_allocation(tmp_path):
+    from ompi_tpu.boot.ras import read_gridengine
+
+    pe = tmp_path / "pe_hostfile"
+    pe.write_text("nodeA 4 all.q <NULL>\nnodeB 2 all.q <NULL>\n")
+    assert read_gridengine({"PE_HOSTFILE": str(pe)}) == [
+        ("nodeA", 4), ("nodeB", 2)]
+
+
+def test_ras_slurm_leg_end_to_end():
+    """tpurun --ras slurm with a fabricated SLURM allocation + local
+    launch agent: the adopted allocation drives rmaps and the job
+    completes — the reference's ras/slurm + plm dry-run technique."""
+    import os
+
+    worker = REPO / "tests" / "workers" / "mp_worker.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env["SLURM_JOB_NODELIST"] = "fake[1-2]"
+    env["SLURM_TASKS_PER_NODE"] = "1(x2)"
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+         "--cpu-devices", "1",
+         "--ras", "slurm",
+         "--launch-agent", "bash -c {cmd}",
+         "--kvs-host", "127.0.0.1",
+         "--map-by", "node", "--display-map",
+         str(worker)],
+        capture_output=True, timeout=180, env=env, cwd=str(REPO),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "host fake1: ranks 0" in out and "host fake2: ranks 1" in out
+    assert sum("OK allreduce " in l for l in out.splitlines()) == 2
+
+
+def test_ras_slurm_requires_allocation():
+    """--ras slurm outside a SLURM job is a hard, clear error."""
+    import os
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("SLURM_")}
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+         "--ras", "slurm",
+         str(REPO / "tests" / "workers" / "mp_worker.py")],
+        capture_output=True, timeout=60, env=env, cwd=str(REPO),
+    )
+    assert res.returncode != 0
+    assert b"SLURM" in res.stdout + res.stderr

@@ -1,0 +1,84 @@
+"""np=2 worker: measures p2p half-round-trip over the NATIVE plane and
+over the PYTHON tcp transport in the same job, like-for-like.
+
+The python leg builds a second, explicitly-Python DCN engine pair in
+the same processes (own listen sockets, own matching engines) so both
+legs run under identical load/scheduling; proc 0 prints one
+``LATCMP {json}`` line.
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import ompi_tpu.api as api
+
+world = api.init()
+p = world.proc
+assert world.nprocs == 2
+
+ITERS = 1500
+buf = np.zeros(64, np.uint8)
+me = world.local_offset
+peer = world.proc_range(1 - p)[0]
+
+
+def pingpong(send, recv, iters):
+    for _ in range(max(2, iters // 10)):
+        if p == 0:
+            send(buf)
+            recv()
+        else:
+            recv()
+            send(buf)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        if p == 0:
+            send(buf)
+            recv()
+        else:
+            recv()
+            send(buf)
+    return (time.perf_counter() - t0) / iters / 2.0
+
+
+# -- native leg (the job's own world comm) ----------------------------
+nat_us = pingpong(
+    lambda b: world.send(b, source=me, dest=peer, tag=9),
+    lambda: world.recv(dest=me, source=peer, tag=9),
+    ITERS,
+) * 1e6
+
+# -- python leg: a second engine pair over the Python tcp transport ---
+from ompi_tpu.dcn.collops import DcnCollEngine
+from ompi_tpu.p2p.pml import MatchingEngine
+
+pml = MatchingEngine(2)
+eng = DcnCollEngine(p, 2)
+eng.register_p2p(
+    777, lambda env, pay: pml.send(env["src"], env["dst"], pay,
+                                   env["tag"], _account=False))
+world.dcn.allgather_obj(None, "latcmp#sync0")  # both engines exist
+addr = eng.transport.address
+addrs = world.dcn.allgather_obj(addr, "latcmp#addr")
+eng.set_addresses(list(addrs))
+
+py_us = pingpong(
+    lambda b: eng.send_p2p(1 - p,
+                           {"cid": 777, "src": p, "dst": 1 - p, "tag": 9},
+                           b),
+    lambda: pml.irecv(p, 1 - p, 9).wait(),
+    ITERS,
+) * 1e6
+
+if p == 0:
+    print("LATCMP " + json.dumps(
+        {"native_us": round(nat_us, 2), "python_us": round(py_us, 2),
+         "iters": ITERS}), flush=True)
+eng.close()
+api.finalize()
+print(f"OK latcmp proc={p}", flush=True)

@@ -1,0 +1,36 @@
+"""Prints which engine + pml classes this job selected, then does one
+allreduce + p2p exchange so selection is exercised, not just reported."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+eng = type(world.dcn).__name__
+pml = type(world.pml).__name__
+print(f"ENGINE {eng} pml={pml}", flush=True)
+
+out = world.allreduce(np.ones((world.local_size, 2)), SUM)
+assert np.array_equal(out, np.full((world.local_size, 2), world.size))
+
+if world.nprocs == 2:
+    me = world.local_offset
+    peer_proc = 1 - p
+    peer = world.proc_range(peer_proc)[0]
+    if p == 0:
+        world.send(np.arange(8.0), source=me, dest=peer, tag=3)
+        pay, st = world.recv(dest=me, source=peer, tag=4)
+        assert np.array_equal(pay, np.arange(8.0) + 1)
+    else:
+        pay, st = world.recv(dest=me, source=peer, tag=3)
+        assert np.array_equal(pay, np.arange(8.0)), pay
+        world.send(np.arange(8.0) + 1, source=me, dest=peer, tag=4)
+
+api.finalize()
+print(f"OK native_probe proc={p}", flush=True)
