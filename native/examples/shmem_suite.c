@@ -1,0 +1,168 @@
+/* OpenSHMEM conformance smoke suite — exercises the core subset the
+ * tpushmem layer provides: symmetric heap symmetry, put/get (typed,
+ * sized, single-element), atomics (fetch_add/inc/swap/cswap/fetch),
+ * wait_until signaling, broadcast/collect/fcollect, reductions, and
+ * the barrier/quiet ordering contract.  Runs at any npes >= 2.
+ */
+#include <shmem.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int me, n;
+
+#define CHECK(cond, name)                                       \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      fprintf(stderr, "FAIL %s pe=%d\n", name, me);             \
+      shmem_global_exit(2);                                     \
+    } else {                                                    \
+      printf("OK %s pe=%d\n", name, me);                        \
+    }                                                           \
+  } while (0)
+
+int main(void) {
+  shmem_init();
+  me = shmem_my_pe();
+  n = shmem_n_pes();
+
+  { /* identity + info */
+    int maj, min;
+    char name[SHMEM_MAX_NAME_LEN];
+    shmem_info_get_version(&maj, &min);
+    shmem_info_get_name(name);
+    CHECK(me >= 0 && me < n && n >= 2, "pe_identity");
+    CHECK(maj == 1 && strlen(name) > 0, "info");
+    CHECK(_my_pe() == me && _num_pes() == n, "legacy_names");
+    CHECK(shmem_pe_accessible((me + 1) % n), "pe_accessible");
+  }
+
+  { /* symmetric heap symmetry: same allocation sequence -> peers see
+       each other's buffers at the same offsets */
+    long *a = (long *)shmem_malloc(8 * sizeof(long));
+    int *b = (int *)shmem_calloc(16, sizeof(int));
+    CHECK(a && b && shmem_addr_accessible(a, (me + 1) % n), "heap_alloc");
+    CHECK(((uintptr_t)a % 16 == 0) && ((uintptr_t)b % 16 == 0),
+          "heap_alignment");
+
+    /* ring put: write my rank into my right neighbor's a[me%8] */
+    int right = (me + 1) % n, left = (me - 1 + n) % n;
+    for (int i = 0; i < 8; i++) a[i] = -1;
+    shmem_barrier_all();
+    long v = 1000 + me;
+    shmem_long_put(&a[me % 8], &v, 1, right);
+    shmem_barrier_all();
+    CHECK(a[left % 8] == 1000 + left, "ring_put_long");
+
+    /* get back what we put */
+    long got = -1;
+    shmem_long_get(&got, &a[me % 8], 1, right);
+    CHECK(got == 1000 + me, "get_long");
+
+    /* single-element p/g */
+    shmem_int_p(&b[3], 77 + me, right);
+    shmem_barrier_all();
+    CHECK(b[3] == 77 + left, "int_p");
+    CHECK(shmem_int_g(&b[3], right) == 77 + me, "int_g");
+
+    /* putmem/getmem round trip */
+    char msg[32], back[32];
+    snprintf(msg, sizeof msg, "hello from %d", me);
+    char *box = (char *)shmem_malloc(32);
+    shmem_putmem(box, msg, sizeof msg, right);
+    shmem_barrier_all();
+    char expect[32];
+    snprintf(expect, sizeof expect, "hello from %d", left);
+    CHECK(strcmp(box, expect) == 0, "putmem");
+    shmem_getmem(back, box, sizeof back, me);
+    CHECK(strcmp(back, expect) == 0, "getmem_self");
+  }
+
+  { /* atomics: every PE increments a counter on PE 0 */
+    int *ctr = (int *)shmem_calloc(1, sizeof(int));
+    shmem_barrier_all();
+    int before = shmem_int_atomic_fetch_add(ctr, 1, 0);
+    CHECK(before >= 0 && before < n, "fetch_add_window");
+    shmem_barrier_all();
+    CHECK(shmem_int_atomic_fetch(ctr, 0) == n, "sum_of_incs");
+
+    /* cswap: exactly one PE wins the lock word */
+    int *lock = (int *)shmem_calloc(1, sizeof(int));
+    shmem_barrier_all();
+    int old = shmem_int_atomic_compare_swap(lock, 0, me + 1, 0);
+    int *wins = (int *)shmem_calloc(1, sizeof(int));
+    shmem_barrier_all();
+    if (old == 0) shmem_int_atomic_inc(wins, 0);
+    shmem_barrier_all();
+    CHECK(shmem_int_atomic_fetch(wins, 0) == 1, "cswap_one_winner");
+
+    /* swap + deprecated names */
+    long *cell = (long *)shmem_calloc(1, sizeof(long));
+    shmem_barrier_all();
+    if (me == 0) {
+      long prev = shmem_long_atomic_swap(cell, 42, (n > 1) ? 1 : 0);
+      CHECK(prev == 0, "swap_prev");
+    }
+    shmem_barrier_all();
+    if (me == 1) CHECK(cell[0] == 42, "swap_landed");
+    int *fcell = (int *)shmem_calloc(1, sizeof(int));
+    shmem_barrier_all();
+    (void)shmem_int_fadd(fcell, 2, 0);
+    shmem_barrier_all();
+    CHECK(shmem_int_atomic_fetch(fcell, 0) == 2 * n, "deprecated_fadd");
+  }
+
+  { /* wait_until: PE 0 releases everyone */
+    int *flag = (int *)shmem_calloc(1, sizeof(int));
+    shmem_barrier_all();
+    if (me == 0) {
+      for (int p = 0; p < n; p++) shmem_int_atomic_set(flag, 9, p);
+    }
+    shmem_int_wait_until(flag, SHMEM_CMP_EQ, 9);
+    CHECK(1, "wait_until_released");
+  }
+
+  { /* collectives */
+    static long pSync[SHMEM_BCAST_SYNC_SIZE];
+    long *src = (long *)shmem_malloc(4 * sizeof(long));
+    long *dst = (long *)shmem_malloc(4 * sizeof(long));
+    for (int i = 0; i < 4; i++) {
+      src[i] = 100 * me + i;
+      dst[i] = -1;
+    }
+    shmem_barrier_all();
+    shmem_broadcast64(dst, src, 4, 0, 0, 0, n, pSync);
+    shmem_barrier_all();
+    if (me != 0)
+      CHECK(dst[0] == 0 && dst[3] == 3, "broadcast64");
+    else
+      CHECK(dst[0] == -1, "broadcast64_root_untouched");
+
+    long *all = (long *)shmem_malloc(4 * (size_t)n * sizeof(long));
+    shmem_fcollect64(all, src, 4, 0, 0, n, pSync);
+    int ok = 1;
+    for (int p = 0; p < n; p++)
+      for (int i = 0; i < 4; i++)
+        if (all[p * 4 + i] != 100 * p + i) ok = 0;
+    CHECK(ok, "fcollect64");
+
+    int *ival = (int *)shmem_malloc(sizeof(int) * 2);
+    int *isum = (int *)shmem_malloc(sizeof(int) * 2);
+    ival[0] = me + 1;
+    ival[1] = 10 * (me + 1);
+    static long rSync[SHMEM_REDUCE_SYNC_SIZE];
+    static int wrk[SHMEM_REDUCE_MIN_WRKDATA_SIZE];
+    shmem_barrier_all();
+    shmem_int_sum_to_all(isum, ival, 2, 0, 0, n, wrk, rSync);
+    int expm = 0;
+    for (int p = 1; p <= n; p++) expm += p;
+    CHECK(isum[0] == expm && isum[1] == 10 * expm, "int_sum_to_all");
+    shmem_int_max_to_all(isum, ival, 2, 0, 0, n, wrk, rSync);
+    CHECK(isum[0] == n && isum[1] == 10 * n, "int_max_to_all");
+  }
+
+  shmem_barrier_all();
+  if (me == 0) printf("SHMEM SUITE COMPLETE\n");
+  shmem_finalize();
+  return 0;
+}

@@ -1,0 +1,168 @@
+/* shmem.h — OpenSHMEM 1.4 core subset over the TPU MPI framework.
+ *
+ * ≈ the reference's oshmem/include/shmem.h (SURVEY.md §2.5: liboshmem
+ * exports 838 shmem_* symbols layered over ompi).  This build layers
+ * the same way: libtpushmem.so implements the ~50 core entry points
+ * ON TOP of libtpumpi's MPI C ABI — symmetric heap as a byte window
+ * under passive lock_all, put/get as MPI_Put/MPI_Get + flush, atomics
+ * as MPI_Fetch_and_op / MPI_Compare_and_swap, collectives as their
+ * MPI twins — exactly oshmem's spml/scoll-over-ompi architecture.
+ */
+#ifndef TPUSHMEM_H
+#define TPUSHMEM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define SHMEM_MAJOR_VERSION 1
+#define SHMEM_MINOR_VERSION 4
+#define SHMEM_VENDOR_STRING "ompi_tpu"
+#define SHMEM_MAX_NAME_LEN 64
+
+/* library setup / query */
+void shmem_init(void);
+void shmem_finalize(void);
+int shmem_my_pe(void);
+int shmem_n_pes(void);
+void shmem_info_get_version(int *major, int *minor);
+void shmem_info_get_name(char *name);
+int shmem_pe_accessible(int pe);
+int shmem_addr_accessible(const void *addr, int pe);
+void shmem_global_exit(int status);
+/* legacy (SGI) names */
+void start_pes(int npes);
+int _my_pe(void);
+int _num_pes(void);
+
+/* symmetric heap */
+void *shmem_malloc(size_t size);
+void *shmem_calloc(size_t count, size_t size);
+void *shmem_align(size_t alignment, size_t size);
+void shmem_free(void *ptr);
+void *shmem_realloc(void *ptr, size_t size);
+void *shmem_ptr(const void *dest, int pe);
+
+/* memory ordering */
+void shmem_quiet(void);
+void shmem_fence(void);
+void shmem_barrier_all(void);
+void shmem_sync_all(void);
+
+/* RMA: contiguous put/get */
+void shmem_putmem(void *dest, const void *source, size_t nelems, int pe);
+void shmem_getmem(void *dest, const void *source, size_t nelems, int pe);
+void shmem_put8(void *dest, const void *source, size_t nelems, int pe);
+void shmem_put32(void *dest, const void *source, size_t nelems, int pe);
+void shmem_put64(void *dest, const void *source, size_t nelems, int pe);
+void shmem_get8(void *dest, const void *source, size_t nelems, int pe);
+void shmem_get32(void *dest, const void *source, size_t nelems, int pe);
+void shmem_get64(void *dest, const void *source, size_t nelems, int pe);
+void shmem_int_put(int *dest, const int *source, size_t nelems, int pe);
+void shmem_int_get(int *dest, const int *source, size_t nelems, int pe);
+void shmem_long_put(long *dest, const long *source, size_t nelems, int pe);
+void shmem_long_get(long *dest, const long *source, size_t nelems, int pe);
+void shmem_longlong_put(long long *dest, const long long *source,
+                        size_t nelems, int pe);
+void shmem_longlong_get(long long *dest, const long long *source,
+                        size_t nelems, int pe);
+void shmem_float_put(float *dest, const float *source, size_t nelems,
+                     int pe);
+void shmem_float_get(float *dest, const float *source, size_t nelems,
+                     int pe);
+void shmem_double_put(double *dest, const double *source, size_t nelems,
+                      int pe);
+void shmem_double_get(double *dest, const double *source, size_t nelems,
+                      int pe);
+
+/* single-element p/g */
+void shmem_int_p(int *dest, int value, int pe);
+void shmem_long_p(long *dest, long value, int pe);
+void shmem_double_p(double *dest, double value, int pe);
+int shmem_int_g(const int *source, int pe);
+long shmem_long_g(const long *source, int pe);
+double shmem_double_g(const double *source, int pe);
+
+/* atomics (int / long / longlong) */
+int shmem_int_atomic_fetch(const int *source, int pe);
+void shmem_int_atomic_set(int *dest, int value, int pe);
+int shmem_int_atomic_fetch_add(int *dest, int value, int pe);
+void shmem_int_atomic_add(int *dest, int value, int pe);
+int shmem_int_atomic_fetch_inc(int *dest, int pe);
+void shmem_int_atomic_inc(int *dest, int pe);
+int shmem_int_atomic_swap(int *dest, int value, int pe);
+int shmem_int_atomic_compare_swap(int *dest, int cond, int value, int pe);
+long shmem_long_atomic_fetch(const long *source, int pe);
+void shmem_long_atomic_set(long *dest, long value, int pe);
+long shmem_long_atomic_fetch_add(long *dest, long value, int pe);
+void shmem_long_atomic_add(long *dest, long value, int pe);
+long shmem_long_atomic_fetch_inc(long *dest, int pe);
+void shmem_long_atomic_inc(long *dest, int pe);
+long shmem_long_atomic_swap(long *dest, long value, int pe);
+long shmem_long_atomic_compare_swap(long *dest, long cond, long value,
+                                    int pe);
+/* deprecated pre-1.4 atomic names (still exported by the reference) */
+int shmem_int_fadd(int *dest, int value, int pe);
+int shmem_int_finc(int *dest, int pe);
+int shmem_int_cswap(int *dest, int cond, int value, int pe);
+int shmem_int_swap(int *dest, int value, int pe);
+long shmem_long_fadd(long *dest, long value, int pe);
+
+/* point synchronization */
+#define SHMEM_CMP_EQ 0
+#define SHMEM_CMP_NE 1
+#define SHMEM_CMP_GT 2
+#define SHMEM_CMP_LE 3
+#define SHMEM_CMP_LT 4
+#define SHMEM_CMP_GE 5
+void shmem_int_wait_until(int *ivar, int cmp, int value);
+void shmem_long_wait_until(long *ivar, int cmp, long value);
+
+/* collectives (active-set-free world forms) */
+void shmem_broadcast32(void *dest, const void *source, size_t nelems,
+                       int PE_root, int PE_start, int logPE_stride,
+                       int PE_size, long *pSync);
+void shmem_broadcast64(void *dest, const void *source, size_t nelems,
+                       int PE_root, int PE_start, int logPE_stride,
+                       int PE_size, long *pSync);
+void shmem_collect32(void *dest, const void *source, size_t nelems,
+                     int PE_start, int logPE_stride, int PE_size,
+                     long *pSync);
+void shmem_collect64(void *dest, const void *source, size_t nelems,
+                     int PE_start, int logPE_stride, int PE_size,
+                     long *pSync);
+void shmem_fcollect32(void *dest, const void *source, size_t nelems,
+                      int PE_start, int logPE_stride, int PE_size,
+                      long *pSync);
+void shmem_fcollect64(void *dest, const void *source, size_t nelems,
+                      int PE_start, int logPE_stride, int PE_size,
+                      long *pSync);
+void shmem_int_sum_to_all(int *dest, const int *source, int nreduce,
+                          int PE_start, int logPE_stride, int PE_size,
+                          int *pWrk, long *pSync);
+void shmem_int_max_to_all(int *dest, const int *source, int nreduce,
+                          int PE_start, int logPE_stride, int PE_size,
+                          int *pWrk, long *pSync);
+void shmem_long_sum_to_all(long *dest, const long *source, int nreduce,
+                           int PE_start, int logPE_stride, int PE_size,
+                           long *pWrk, long *pSync);
+void shmem_double_sum_to_all(double *dest, const double *source,
+                             int nreduce, int PE_start, int logPE_stride,
+                             int PE_size, double *pWrk, long *pSync);
+
+#define SHMEM_SYNC_SIZE 1
+#define SHMEM_BCAST_SYNC_SIZE 1
+#define SHMEM_COLLECT_SYNC_SIZE 1
+#define SHMEM_REDUCE_SYNC_SIZE 1
+#define SHMEM_BARRIER_SYNC_SIZE 1
+#define SHMEM_REDUCE_MIN_WRKDATA_SIZE 1
+#define SHMEM_SYNC_VALUE 0L
+#define _SHMEM_SYNC_VALUE 0L
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPUSHMEM_H */

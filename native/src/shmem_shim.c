@@ -1,0 +1,478 @@
+/* libtpushmem — OpenSHMEM core subset over the MPI C ABI.
+ *
+ * ≈ the reference's oshmem layering (SURVEY.md §2.5: liboshmem's
+ * spml/scoll/atomic/memheap components delegate to ompi's pml, coll
+ * and osc): every entry point here is a thin mapping onto libtpumpi —
+ *
+ *   memheap  → one malloc'd symmetric region per PE, exposed as a
+ *              byte MPI window (disp_unit 1) under passive
+ *              MPI_Win_lock_all for the whole run; SPMD lockstep
+ *              bump allocation keeps offsets symmetric (the memheap
+ *              contract);
+ *   spml     → shmem_put/get = MPI_Put/MPI_Get at (addr - heap_base),
+ *              quiet/fence = MPI_Win_flush_all;
+ *   atomic   → MPI_Fetch_and_op / MPI_Compare_and_swap;
+ *   scoll    → broadcast/collect/reductions = MPI collectives over
+ *              MPI_COMM_WORLD (active sets: the world forms used by
+ *              the conformance suite; strided subsets are rejected
+ *              loudly rather than silently miscomputed).
+ *
+ * PE numbering = MPI_COMM_WORLD rank.  Remote local-access
+ * (shmem_ptr) resolves only for the calling PE itself (no cross-
+ * process load/store sharing — same answer oshmem gives for
+ * non-shared-memory transports: NULL).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mpi.h"
+#include "shmem.h"
+
+static MPI_Win g_win = (MPI_Win)-1;
+static unsigned char *g_heap = NULL;
+static size_t g_heap_size = 0;
+static size_t g_brk = 0;       /* bump pointer (symmetric by SPMD) */
+static int g_pe = -1, g_npes = 0;
+static int g_inited = 0;
+
+#define HEAP_ALIGN 16
+
+static void die(const char *msg) {
+  fprintf(stderr, "tpushmem: %s\n", msg);
+  MPI_Abort(MPI_COMM_WORLD, 13);
+}
+
+static size_t heap_off(const void *p, const char *who) {
+  if (!g_inited) die("call before shmem_init");
+  if ((const unsigned char *)p < g_heap ||
+      (const unsigned char *)p >= g_heap + g_heap_size) {
+    fprintf(stderr, "tpushmem: %s: address %p outside the symmetric "
+                    "heap\n", who, p);
+    MPI_Abort(MPI_COMM_WORLD, 13);
+  }
+  return (size_t)((const unsigned char *)p - g_heap);
+}
+
+void shmem_init(void) {
+  if (g_inited) return;
+  int flag = 0;
+  MPI_Initialized(&flag);
+  if (!flag) MPI_Init(NULL, NULL);
+  MPI_Comm_rank(MPI_COMM_WORLD, &g_pe);
+  MPI_Comm_size(MPI_COMM_WORLD, &g_npes);
+  const char *sz = getenv("SHMEM_SYMMETRIC_SIZE");
+  g_heap_size = sz ? (size_t)strtoull(sz, NULL, 10) : (size_t)(64 << 20);
+  if (g_heap_size < (1 << 16)) g_heap_size = 1 << 16;
+  g_heap = (unsigned char *)calloc(1, g_heap_size);
+  if (!g_heap) die("symmetric heap allocation failed");
+  if (MPI_Win_create(g_heap, (MPI_Aint)g_heap_size, 1, MPI_INFO_NULL,
+                     MPI_COMM_WORLD, &g_win) != MPI_SUCCESS)
+    die("symmetric-heap window creation failed");
+  /* passive exposure for the whole run: OpenSHMEM has no epochs */
+  MPI_Win_lock_all(0, g_win);
+  g_brk = 0;
+  g_inited = 1;
+  MPI_Barrier(MPI_COMM_WORLD);
+}
+
+void shmem_finalize(void) {
+  if (!g_inited) return;
+  MPI_Win_flush_all(g_win);
+  MPI_Barrier(MPI_COMM_WORLD);
+  MPI_Win_unlock_all(g_win);
+  MPI_Win_free(&g_win);
+  free(g_heap);
+  g_heap = NULL;
+  g_inited = 0;
+  int fin = 0;
+  MPI_Finalized(&fin);
+  if (!fin) MPI_Finalize();
+}
+
+int shmem_my_pe(void) { return g_pe; }
+int shmem_n_pes(void) { return g_npes; }
+int _my_pe(void) { return g_pe; }
+int _num_pes(void) { return g_npes; }
+
+void start_pes(int npes) {
+  (void)npes;
+  shmem_init();
+}
+
+void shmem_info_get_version(int *major, int *minor) {
+  if (major) *major = SHMEM_MAJOR_VERSION;
+  if (minor) *minor = SHMEM_MINOR_VERSION;
+}
+
+void shmem_info_get_name(char *name) {
+  if (name) snprintf(name, SHMEM_MAX_NAME_LEN, "%s", SHMEM_VENDOR_STRING);
+}
+
+int shmem_pe_accessible(int pe) { return pe >= 0 && pe < g_npes; }
+
+int shmem_addr_accessible(const void *addr, int pe) {
+  return shmem_pe_accessible(pe) &&
+         (const unsigned char *)addr >= g_heap &&
+         (const unsigned char *)addr < g_heap + g_heap_size;
+}
+
+void shmem_global_exit(int status) { MPI_Abort(MPI_COMM_WORLD, status); }
+
+/* ---- memheap ------------------------------------------------------- */
+
+void *shmem_align(size_t alignment, size_t size) {
+  if (!g_inited) die("shmem_malloc before shmem_init");
+  if (alignment < HEAP_ALIGN) alignment = HEAP_ALIGN;
+  /* SPMD lockstep: every PE performs the same allocation sequence, so
+   * the bump pointer (and thus every offset) stays symmetric — the
+   * memheap invariant.  A barrier keeps call-site divergence loud. */
+  size_t off = (g_brk + alignment - 1) / alignment * alignment;
+  if (off + size > g_heap_size) die("symmetric heap exhausted "
+                                    "(set SHMEM_SYMMETRIC_SIZE)");
+  g_brk = off + size;
+  shmem_barrier_all();
+  return g_heap + off;
+}
+
+void *shmem_malloc(size_t size) { return shmem_align(HEAP_ALIGN, size); }
+
+void *shmem_calloc(size_t count, size_t size) {
+  void *p = shmem_malloc(count * size);
+  memset(p, 0, count * size);
+  return p;
+}
+
+void shmem_free(void *ptr) {
+  /* bump allocator: individual frees are a no-op (valid OpenSHMEM
+   * behavior for a region allocator); the heap dies at finalize */
+  if (ptr) heap_off(ptr, "shmem_free");
+  shmem_barrier_all();  /* shmem_free is collective per the spec */
+}
+
+void *shmem_realloc(void *ptr, size_t size) {
+  void *p = shmem_malloc(size);
+  if (ptr) {
+    size_t old_off = heap_off(ptr, "shmem_realloc");
+    size_t avail = g_heap_size - old_off;
+    memcpy(p, ptr, size < avail ? size : avail);
+  }
+  return p;
+}
+
+void *shmem_ptr(const void *dest, int pe) {
+  /* cross-process load/store sharing is not provided (separate
+   * address spaces); own-PE pointers resolve directly */
+  return pe == g_pe ? (void *)dest : NULL;
+}
+
+/* ---- ordering ------------------------------------------------------ */
+
+void shmem_quiet(void) {
+  if (g_inited) MPI_Win_flush_all(g_win);
+}
+
+void shmem_fence(void) { shmem_quiet(); }
+
+void shmem_barrier_all(void) {
+  shmem_quiet();
+  MPI_Barrier(MPI_COMM_WORLD);
+}
+
+void shmem_sync_all(void) { MPI_Barrier(MPI_COMM_WORLD); }
+
+/* ---- RMA ----------------------------------------------------------- */
+
+static void put_bytes(void *dest, const void *source, size_t nbytes,
+                      int pe) {
+  size_t off = heap_off(dest, "shmem_put");
+  if (!nbytes) return;
+  MPI_Put(source, (int)nbytes, MPI_BYTE, pe, (MPI_Aint)off, (int)nbytes,
+          MPI_BYTE, g_win);
+  /* spml/ucx completes puts at return for small payloads; we keep the
+   * stronger contract: remote completion at return (flush per op) —
+   * quiet/fence then cost nothing extra */
+  MPI_Win_flush(pe, g_win);
+}
+
+static void get_bytes(void *dest, const void *source, size_t nbytes,
+                      int pe) {
+  size_t off = heap_off((void *)source, "shmem_get");
+  if (!nbytes) return;
+  MPI_Get(dest, (int)nbytes, MPI_BYTE, pe, (MPI_Aint)off, (int)nbytes,
+          MPI_BYTE, g_win);
+  MPI_Win_flush(pe, g_win);
+}
+
+void shmem_putmem(void *d, const void *s, size_t n, int pe) {
+  put_bytes(d, s, n, pe);
+}
+void shmem_getmem(void *d, const void *s, size_t n, int pe) {
+  get_bytes(d, s, n, pe);
+}
+
+#define PUTGET(NAME, T)                                                   \
+  void shmem_##NAME##_put(T *d, const T *s, size_t n, int pe) {           \
+    put_bytes(d, s, n * sizeof(T), pe);                                   \
+  }                                                                       \
+  void shmem_##NAME##_get(T *d, const T *s, size_t n, int pe) {           \
+    get_bytes(d, (const void *)s, n * sizeof(T), pe);                     \
+  }
+
+PUTGET(int, int)
+PUTGET(long, long)
+PUTGET(longlong, long long)
+PUTGET(float, float)
+PUTGET(double, double)
+
+void shmem_put8(void *d, const void *s, size_t n, int pe) {
+  put_bytes(d, s, n, pe);
+}
+void shmem_get8(void *d, const void *s, size_t n, int pe) {
+  get_bytes(d, s, n, pe);
+}
+void shmem_put32(void *d, const void *s, size_t n, int pe) {
+  put_bytes(d, s, n * 4, pe);
+}
+void shmem_get32(void *d, const void *s, size_t n, int pe) {
+  get_bytes(d, s, n * 4, pe);
+}
+void shmem_put64(void *d, const void *s, size_t n, int pe) {
+  put_bytes(d, s, n * 8, pe);
+}
+void shmem_get64(void *d, const void *s, size_t n, int pe) {
+  get_bytes(d, s, n * 8, pe);
+}
+
+void shmem_int_p(int *d, int v, int pe) { put_bytes(d, &v, sizeof v, pe); }
+void shmem_long_p(long *d, long v, int pe) {
+  put_bytes(d, &v, sizeof v, pe);
+}
+void shmem_double_p(double *d, double v, int pe) {
+  put_bytes(d, &v, sizeof v, pe);
+}
+
+int shmem_int_g(const int *s, int pe) {
+  int v;
+  get_bytes(&v, s, sizeof v, pe);
+  return v;
+}
+long shmem_long_g(const long *s, int pe) {
+  long v;
+  get_bytes(&v, s, sizeof v, pe);
+  return v;
+}
+double shmem_double_g(const double *s, int pe) {
+  double v;
+  get_bytes(&v, s, sizeof v, pe);
+  return v;
+}
+
+/* ---- atomics ------------------------------------------------------- */
+
+#define ATOMICS(NAME, T, MPIT)                                            \
+  T shmem_##NAME##_atomic_fetch_add(T *dest, T value, int pe) {           \
+    size_t off = heap_off(dest, "atomic");                                \
+    T old;                                                                \
+    MPI_Fetch_and_op(&value, &old, MPIT, pe, (MPI_Aint)off, MPI_SUM,      \
+                     g_win);                                              \
+    MPI_Win_flush(pe, g_win);                                             \
+    return old;                                                           \
+  }                                                                       \
+  void shmem_##NAME##_atomic_add(T *dest, T value, int pe) {              \
+    (void)shmem_##NAME##_atomic_fetch_add(dest, value, pe);               \
+  }                                                                       \
+  T shmem_##NAME##_atomic_fetch_inc(T *dest, int pe) {                    \
+    return shmem_##NAME##_atomic_fetch_add(dest, (T)1, pe);               \
+  }                                                                       \
+  void shmem_##NAME##_atomic_inc(T *dest, int pe) {                       \
+    (void)shmem_##NAME##_atomic_fetch_add(dest, (T)1, pe);                \
+  }                                                                       \
+  T shmem_##NAME##_atomic_swap(T *dest, T value, int pe) {                \
+    size_t off = heap_off(dest, "atomic");                                \
+    T old;                                                                \
+    MPI_Fetch_and_op(&value, &old, MPIT, pe, (MPI_Aint)off, MPI_REPLACE,  \
+                     g_win);                                              \
+    MPI_Win_flush(pe, g_win);                                             \
+    return old;                                                           \
+  }                                                                       \
+  T shmem_##NAME##_atomic_compare_swap(T *dest, T cond, T value,          \
+                                       int pe) {                          \
+    size_t off = heap_off(dest, "atomic");                                \
+    T old;                                                                \
+    MPI_Compare_and_swap(&value, &cond, &old, MPIT, pe, (MPI_Aint)off,    \
+                         g_win);                                          \
+    MPI_Win_flush(pe, g_win);                                             \
+    return old;                                                           \
+  }                                                                       \
+  T shmem_##NAME##_atomic_fetch(const T *source, int pe) {                \
+    size_t off = heap_off((void *)source, "atomic");                      \
+    T old, dummy = 0;                                                     \
+    MPI_Fetch_and_op(&dummy, &old, MPIT, pe, (MPI_Aint)off, MPI_NO_OP,    \
+                     g_win);                                              \
+    MPI_Win_flush(pe, g_win);                                             \
+    return old;                                                           \
+  }                                                                       \
+  void shmem_##NAME##_atomic_set(T *dest, T value, int pe) {              \
+    (void)shmem_##NAME##_atomic_swap(dest, value, pe);                    \
+  }
+
+ATOMICS(int, int, MPI_INT)
+ATOMICS(long, long, MPI_LONG)
+
+/* deprecated pre-1.4 names map onto the 1.4 atomics */
+int shmem_int_fadd(int *d, int v, int pe) {
+  return shmem_int_atomic_fetch_add(d, v, pe);
+}
+int shmem_int_finc(int *d, int pe) {
+  return shmem_int_atomic_fetch_inc(d, pe);
+}
+int shmem_int_cswap(int *d, int c, int v, int pe) {
+  return shmem_int_atomic_compare_swap(d, c, v, pe);
+}
+int shmem_int_swap(int *d, int v, int pe) {
+  return shmem_int_atomic_swap(d, v, pe);
+}
+long shmem_long_fadd(long *d, long v, int pe) {
+  return shmem_long_atomic_fetch_add(d, v, pe);
+}
+
+/* ---- point synchronization ----------------------------------------- */
+
+#define WAIT_UNTIL(NAME, T)                                               \
+  void shmem_##NAME##_wait_until(T *ivar, int cmp, T value) {             \
+    heap_off(ivar, "wait_until");                                         \
+    for (;;) {                                                            \
+      /* progress + memory refresh: an atomic fetch of our OWN cell      \
+       * routes through the osc engine, which also applies queued        \
+       * inbound ops (the spml progress role) */                         \
+      T cur = shmem_##NAME##_atomic_fetch(ivar, g_pe);                    \
+      int ok = 0;                                                         \
+      switch (cmp) {                                                      \
+        case SHMEM_CMP_EQ: ok = cur == value; break;                      \
+        case SHMEM_CMP_NE: ok = cur != value; break;                      \
+        case SHMEM_CMP_GT: ok = cur > value; break;                       \
+        case SHMEM_CMP_LE: ok = cur <= value; break;                      \
+        case SHMEM_CMP_LT: ok = cur < value; break;                       \
+        case SHMEM_CMP_GE: ok = cur >= value; break;                      \
+        default: die("bad shmem_wait_until comparator");                  \
+      }                                                                   \
+      if (ok) return;                                                     \
+      struct timespec ts = {0, 200000};                                   \
+      nanosleep(&ts, NULL);                                               \
+    }                                                                     \
+  }
+
+#include <time.h>
+WAIT_UNTIL(int, int)
+WAIT_UNTIL(long, long)
+
+/* ---- collectives --------------------------------------------------- */
+
+static void check_world(int PE_start, int logPE_stride, int PE_size,
+                        const char *who) {
+  if (PE_start != 0 || logPE_stride != 0 || PE_size != g_npes) {
+    fprintf(stderr, "tpushmem: %s: only the world active set "
+                    "(start=0, stride=0, size=n_pes) is supported\n",
+            who);
+    MPI_Abort(MPI_COMM_WORLD, 13);
+  }
+}
+
+static void bcast_bytes(void *dest, const void *source, size_t nbytes,
+                        int root) {
+  /* OpenSHMEM: the root's dest is NOT written; others receive */
+  if (g_pe == root) {
+    MPI_Bcast((void *)source, (int)nbytes, MPI_BYTE, root,
+              MPI_COMM_WORLD);
+  } else {
+    MPI_Bcast(dest, (int)nbytes, MPI_BYTE, root, MPI_COMM_WORLD);
+  }
+}
+
+void shmem_broadcast32(void *dest, const void *source, size_t nelems,
+                       int PE_root, int PE_start, int logPE_stride,
+                       int PE_size, long *pSync) {
+  (void)pSync;
+  check_world(PE_start, logPE_stride, PE_size, "shmem_broadcast32");
+  bcast_bytes(dest, source, nelems * 4, PE_root);
+}
+
+void shmem_broadcast64(void *dest, const void *source, size_t nelems,
+                       int PE_root, int PE_start, int logPE_stride,
+                       int PE_size, long *pSync) {
+  (void)pSync;
+  check_world(PE_start, logPE_stride, PE_size, "shmem_broadcast64");
+  bcast_bytes(dest, source, nelems * 8, PE_root);
+}
+
+static void fcollect_bytes(void *dest, const void *source, size_t nbytes) {
+  MPI_Allgather((void *)source, (int)nbytes, MPI_BYTE, dest, (int)nbytes,
+                MPI_BYTE, MPI_COMM_WORLD);
+}
+
+void shmem_fcollect32(void *dest, const void *source, size_t nelems,
+                      int PE_start, int logPE_stride, int PE_size,
+                      long *pSync) {
+  (void)pSync;
+  check_world(PE_start, logPE_stride, PE_size, "shmem_fcollect32");
+  fcollect_bytes(dest, source, nelems * 4);
+}
+
+void shmem_fcollect64(void *dest, const void *source, size_t nelems,
+                      int PE_start, int logPE_stride, int PE_size,
+                      long *pSync) {
+  (void)pSync;
+  check_world(PE_start, logPE_stride, PE_size, "shmem_fcollect64");
+  fcollect_bytes(dest, source, nelems * 8);
+}
+
+static void collect_bytes(void *dest, const void *source, size_t nbytes) {
+  /* jagged: PEs may contribute different sizes */
+  int n = (int)nbytes;
+  int *counts = (int *)malloc(sizeof(int) * (size_t)g_npes);
+  int *displs = (int *)malloc(sizeof(int) * (size_t)g_npes);
+  MPI_Allgather(&n, 1, MPI_INT, counts, 1, MPI_INT, MPI_COMM_WORLD);
+  int off = 0;
+  for (int i = 0; i < g_npes; i++) {
+    displs[i] = off;
+    off += counts[i];
+  }
+  MPI_Allgatherv((void *)source, n, MPI_BYTE, dest, counts, displs,
+                 MPI_BYTE, MPI_COMM_WORLD);
+  free(counts);
+  free(displs);
+}
+
+void shmem_collect32(void *dest, const void *source, size_t nelems,
+                     int PE_start, int logPE_stride, int PE_size,
+                     long *pSync) {
+  (void)pSync;
+  check_world(PE_start, logPE_stride, PE_size, "shmem_collect32");
+  collect_bytes(dest, source, nelems * 4);
+}
+
+void shmem_collect64(void *dest, const void *source, size_t nelems,
+                     int PE_start, int logPE_stride, int PE_size,
+                     long *pSync) {
+  (void)pSync;
+  check_world(PE_start, logPE_stride, PE_size, "shmem_collect64");
+  collect_bytes(dest, source, nelems * 8);
+}
+
+#define TO_ALL(NAME, T, MPIT, MPIOP, OPTOKEN)                             \
+  void shmem_##NAME##_##OPTOKEN##_to_all(                                 \
+      T *dest, const T *source, int nreduce, int PE_start,                \
+      int logPE_stride, int PE_size, T *pWrk, long *pSync) {              \
+    (void)pWrk;                                                           \
+    (void)pSync;                                                          \
+    check_world(PE_start, logPE_stride, PE_size,                          \
+                "shmem_" #NAME "_" #OPTOKEN "_to_all");                   \
+    MPI_Allreduce((void *)source, dest, nreduce, MPIT, MPIOP,             \
+                  MPI_COMM_WORLD);                                        \
+  }
+
+TO_ALL(int, int, MPI_INT, MPI_SUM, sum)
+TO_ALL(int, int, MPI_INT, MPI_MAX, max)
+TO_ALL(long, long, MPI_LONG, MPI_SUM, sum)
+TO_ALL(double, double, MPI_DOUBLE, MPI_SUM, sum)

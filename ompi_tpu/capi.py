@@ -2982,8 +2982,7 @@ def win_flush_all(wh: int) -> int:
     try:
         w = _win(wh)
         if _is_dist_win(w):
-            for t in range(w.comm.size):
-                w.flush(t)
+            w.flush_all()  # one sync round-trip per PROCESS
         else:
             w.flush_all(0)
         return MPI_SUCCESS

@@ -315,15 +315,27 @@ class MultiProcWin:
     def unlock(self, target: int) -> None:
         self.flush(target)
 
-    def lock_all(self) -> None:
-        self._check()
-
-    def unlock_all(self) -> None:
+    def flush_all(self) -> None:
+        """All previously issued ops to every process are applied (one
+        sync round-trip per PROCESS, not per rank)."""
         for p in range(self.comm.nprocs):
             lo, _hi = self.comm.proc_range(p)
             if p != self.comm.proc:
                 self.flush(lo)
 
+    def lock_all(self) -> None:
+        self._check()
+
+    def unlock_all(self) -> None:
+        self.flush_all()
+
     def free(self) -> None:
+        """MPI_Win_free is COLLECTIVE: a barrier keeps every member's
+        outstanding passive-target traffic (e.g. a slow peer's
+        unlock_all sync round-trips) served before anyone unregisters
+        the window's frame routing — without it a fast process drops a
+        slow one's sync frame and deadlocks the epoch close."""
+        self._check()  # a double free must RAISE, not hang the barrier
+        self.comm.dcn.barrier(f"{self.win_id}#freebar")
         self.comm.dcn.unregister_p2p(self.win_id)
         self._freed = True

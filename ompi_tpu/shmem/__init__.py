@@ -1,0 +1,283 @@
+"""OpenSHMEM-style Python API over the framework's osc/coll substrate.
+
+≈ the reference's oshmem layering (SURVEY.md §2.5) seen from Python:
+PGAS symmetric-heap semantics built on exactly the components the C
+``libtpushmem`` uses — the symmetric heap is a byte window
+(:mod:`ompi_tpu.osc`) under a run-long passive epoch, put/get and
+atomics are window operations, and the collective forms ride the comm's
+coll table.  PE numbering follows the framework's GLOBAL RANK space
+(one PE per rank; with ``tpurun --cpu-devices 1`` that is one PE per
+process, the classic OpenSHMEM layout).
+
+The symmetric invariant is the memheap one: every PE performs the same
+``malloc`` sequence, so a :class:`SymmArray`'s heap offset is identical
+everywhere and remote addressing needs only (offset, pe).
+
+C programs get the same model from ``shmem.h`` + ``libtpushmem.so``
+(``native/src/shmem_shim.c``) over the MPI C ABI.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIArgError, MPIInternalError
+
+_state = None
+_lock = threading.Lock()
+
+
+class _ShmemState:
+    def __init__(self, heap_bytes: int):
+        import ompi_tpu.api as api
+
+        self.world = api.init()
+        self.heap_bytes = int(heap_bytes)
+        self.brk = 0
+        self.multi = hasattr(self.world, "procctx")
+        if self.multi:
+            self.local_pes = list(
+                range(self.world.local_offset,
+                      self.world.local_offset + self.world.local_size))
+            self.win = self.world.win_allocate(self.heap_bytes, np.uint8,
+                                               name="shmem_heap")
+        else:
+            from ompi_tpu.osc.win import Win
+
+            self.local_pes = list(range(self.world.size))
+            self.win = Win.allocate(self.world, self.heap_bytes, np.uint8,
+                                    name="shmem_heap")
+            for pe in self.local_pes:
+                self.win.lock_all(pe)
+
+    def heap(self, pe: int) -> np.ndarray:
+        """Local heap memory of a locally-owned PE (both window kinds
+        address memory() by GLOBAL rank)."""
+        return self.win.memory(pe)
+
+
+def init(heap_bytes: int | None = None):
+    """shmem_init: build (or adopt) the world and the symmetric heap.
+    ``SHMEM_SYMMETRIC_SIZE`` overrides the default 64 MiB."""
+    global _state
+    with _lock:
+        if _state is None:
+            hb = heap_bytes or int(
+                os.environ.get("SHMEM_SYMMETRIC_SIZE", 64 << 20))
+            _state = _ShmemState(hb)
+    return _state.world
+
+
+def finalize() -> None:
+    global _state
+    with _lock:
+        st, _state = _state, None
+    if st is not None:
+        if not st.multi:
+            for pe in st.local_pes:
+                st.win.unlock_all(pe)
+        st.win.free()
+
+
+def _st() -> _ShmemState:
+    if _state is None:
+        raise MPIInternalError("call shmem.init() first")
+    return _state
+
+
+def my_pe() -> int:
+    """First locally-owned PE (== the process index under the one-
+    rank-per-process layout the C shmem world uses)."""
+    return _st().local_pes[0]
+
+
+def n_pes() -> int:
+    return _st().world.size
+
+
+def local_pes() -> list[int]:
+    return list(_st().local_pes)
+
+
+class SymmArray:
+    """A symmetric allocation: same (offset, shape, dtype) on every PE.
+
+    Acts as a numpy array over the calling PE's own heap slice (PGAS
+    local view); remote access goes through :func:`put`/:func:`get`/
+    the atomics, addressed by (this object, pe)."""
+
+    def __init__(self, offset: int, shape, dtype):
+        self.offset = int(offset)
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.nbytes = int(np.prod(self.shape)) * self.dtype.itemsize
+
+    def view(self, pe: int | None = None) -> np.ndarray:
+        """Writable numpy view of a LOCALLY-OWNED pe's slice."""
+        st = _st()
+        pe = st.local_pes[0] if pe is None else int(pe)
+        if pe not in st.local_pes:
+            raise MPIArgError(
+                f"PE {pe} is not local; use shmem.get/put for remote "
+                f"access")
+        raw = st.heap(pe)[self.offset : self.offset + self.nbytes]
+        return raw.view(self.dtype).reshape(self.shape)
+
+    def __array__(self, dtype=None, copy=None):
+        v = self.view()
+        return v.astype(dtype) if dtype is not None else v
+
+
+def malloc(shape, dtype=np.float64, align: int = 16) -> SymmArray:
+    """Collective symmetric allocation (lockstep bump pointer — the
+    memheap invariant keeps offsets identical on every PE)."""
+    st = _st()
+    if np.isscalar(shape):
+        shape = (int(shape),)
+    else:
+        shape = tuple(int(s) for s in shape)
+    arr = SymmArray((st.brk + align - 1) // align * align, shape, dtype)
+    if arr.offset + arr.nbytes > st.heap_bytes:
+        raise MPIInternalError(
+            "symmetric heap exhausted (set SHMEM_SYMMETRIC_SIZE)")
+    st.brk = arr.offset + arr.nbytes
+    barrier_all()  # collective per the spec; keeps divergence loud
+    return arr
+
+
+def free(arr: SymmArray) -> None:
+    """Bump allocator: a collective no-op (region dies at finalize)."""
+    del arr
+    barrier_all()
+
+
+# -- RMA ----------------------------------------------------------------
+
+#: single-controller atomics: one address space, one mutation lock
+_atomic_mu = threading.Lock()
+
+
+def put(dest: SymmArray, source, pe: int) -> None:
+    """shmem_put: write ``source`` into ``dest``'s slice on ``pe``;
+    remotely complete at return (quiet-per-op, the strong contract)."""
+    st = _st()
+    src = np.ascontiguousarray(np.asarray(source, dest.dtype))
+    if src.nbytes > dest.nbytes:
+        raise MPIArgError(f"put of {src.nbytes} B into {dest.nbytes} B")
+    u8 = src.reshape(-1).view(np.uint8)
+    if st.multi:
+        st.win.put(pe, u8, disp=dest.offset)
+        st.win.flush(pe)
+    else:
+        me = st.local_pes[0]
+        st.win.put(me, pe, u8, target_disp=dest.offset)
+        st.win.flush(me, pe)  # remote completion at return
+
+
+def get(source: SymmArray, pe: int, count: int | None = None) -> np.ndarray:
+    """shmem_get: fetch ``source``'s slice from ``pe``."""
+    st = _st()
+    nbytes = (source.nbytes if count is None
+              else int(count) * source.dtype.itemsize)
+    if st.multi:
+        raw = st.win.get(pe, nbytes, disp=source.offset)
+    else:
+        me = st.local_pes[0]
+        req = st.win.get(me, pe, nbytes, target_disp=source.offset)
+        st.win.flush(me, pe)
+        raw = req.wait()
+    out = np.ascontiguousarray(np.asarray(raw, np.uint8)).view(source.dtype)
+    return out.reshape(source.shape) if count is None else out
+
+
+def _local_atomic(dest: SymmArray, pe: int, fn):
+    with _atomic_mu:
+        v = dest.view(pe).reshape(-1)
+        old = v[0].copy()
+        nxt = fn(old)
+        if nxt is not None:
+            v[0] = nxt
+        return old
+
+
+def atomic_fetch_add(dest: SymmArray, value, pe: int):
+    """shmem_atomic_fetch_add on element 0 of ``dest``."""
+    st = _st()
+    if st.multi:
+        from ompi_tpu.op import SUM
+
+        return st.win.fetch_and_op(
+            pe, value, disp=dest.offset // dest.dtype.itemsize, op=SUM,
+            dt=dest.dtype)
+    return _local_atomic(dest, pe, lambda old: old + value)
+
+
+def atomic_fetch(dest: SymmArray, pe: int):
+    st = _st()
+    if st.multi:
+        from ompi_tpu.op import NO_OP
+
+        return st.win.fetch_and_op(
+            pe, 0, disp=dest.offset // dest.dtype.itemsize, op=NO_OP,
+            dt=dest.dtype)
+    return _local_atomic(dest, pe, lambda old: None)
+
+
+def atomic_set(dest: SymmArray, value, pe: int):
+    st = _st()
+    if st.multi:
+        from ompi_tpu.op import REPLACE
+
+        st.win.fetch_and_op(
+            pe, value, disp=dest.offset // dest.dtype.itemsize,
+            op=REPLACE, dt=dest.dtype)
+        return None
+    _local_atomic(dest, pe, lambda old: value)
+
+
+def atomic_compare_swap(dest: SymmArray, cond, value, pe: int):
+    st = _st()
+    if st.multi:
+        return st.win.compare_and_swap(
+            pe, value, cond, disp=dest.offset // dest.dtype.itemsize,
+            dt=dest.dtype)
+    return _local_atomic(
+        dest, pe, lambda old: value if old == cond else None)
+
+
+# -- ordering / collectives --------------------------------------------
+
+
+def quiet() -> None:
+    st = _st()
+    if st.multi:
+        st.win.flush_all()
+
+
+def fence() -> None:
+    quiet()
+
+
+def barrier_all() -> None:
+    st = _st()
+    quiet()
+    st.world.barrier()
+
+
+def broadcast(x, root: int = 0):
+    """World broadcast of an array value (rank-major contract of the
+    framework's coll table)."""
+    return _st().world.bcast(np.asarray(x), root)
+
+
+def fcollect(x):
+    return _st().world.allgather(np.asarray(x))
+
+
+def sum_to_all(x):
+    from ompi_tpu.op import SUM
+
+    return _st().world.allreduce(np.asarray(x), SUM)

@@ -1,0 +1,133 @@
+"""OSHMEM layer — Python API + libtpushmem C ABI (SURVEY §2.5).
+
+The C suite is the conformance instrument (symmetric heap, put/get,
+atomics, wait_until, collectives over real processes); the Python tests
+cover the PGAS module's own semantics in the single-controller world
+and under tpurun.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "native" / "build"
+
+pytestmark = pytest.mark.skipif(
+    not (REPO / "native").is_dir(), reason="native/ missing"
+)
+
+
+# -- Python API, single-controller world -------------------------------
+
+
+def test_shmem_python_single_controller():
+    import ompi_tpu.shmem as shmem
+
+    shmem.init(heap_bytes=1 << 20)
+    try:
+        n = shmem.n_pes()
+        assert n >= 1 and shmem.my_pe() == 0
+        a = shmem.malloc(8, np.int64)
+        b = shmem.malloc((2, 3), np.float64)
+        # symmetric offsets: every PE's view lands at the same offset
+        assert a.offset % 16 == 0 and b.offset >= a.offset + a.nbytes
+        # local view is writable heap memory
+        av = a.view(0)
+        av[:] = np.arange(8)
+        assert np.array_equal(np.asarray(a), np.arange(8))
+        # put/get to a PE (self or the last PE)
+        pe = n - 1
+        shmem.put(b, np.full((2, 3), 7.5), pe)
+        got = shmem.get(b, pe)
+        assert np.array_equal(got, np.full((2, 3), 7.5))
+        # atomics on element 0
+        c = shmem.malloc(1, np.int64)
+        c.view(pe)[:] = 0
+        assert shmem.atomic_fetch_add(c, 5, pe) == 0
+        assert shmem.atomic_fetch(c, pe) == 5
+        old = shmem.atomic_compare_swap(c, 5, 9, pe)
+        assert old == 5 and shmem.atomic_fetch(c, pe) == 9
+        old = shmem.atomic_compare_swap(c, 5, 1, pe)  # cond mismatch
+        assert old == 9 and shmem.atomic_fetch(c, pe) == 9
+        # collectives
+        s = shmem.sum_to_all(np.ones((n, 2)))
+        assert np.array_equal(s, np.full((n, 2), n))
+        shmem.barrier_all()
+    finally:
+        shmem.finalize()
+
+
+def test_shmem_python_multiproc():
+    worker = REPO / "tests" / "workers" / "shmem_worker.py"
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "3",
+         "--cpu-devices", "1", str(worker)],
+        capture_output=True, timeout=240, cwd=str(REPO),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert sum("OK shmem_py " in l for l in out.splitlines()) == 3
+
+
+# -- C ABI --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shmem_suite_bin():
+    from ompi_tpu import native
+
+    if not native.toolchain_available():
+        pytest.skip("no C toolchain")
+    native.build()
+    return native.compile_mpi_program(
+        REPO / "native" / "examples" / "shmem_suite.c",
+        BUILD / "shmem_suite", extra_flags=["-ltpushmem"],
+    )
+
+
+@pytest.mark.parametrize("npes", [2, 3])
+def test_shmem_c_suite(shmem_suite_bin, npes):
+    """The OpenSHMEM conformance suite under tpurun: heap symmetry,
+    ring puts, p/g, atomics (fetch_add/cswap one-winner/swap),
+    wait_until signaling, broadcast/fcollect/reductions — the COVERAGE
+    row-16 criterion (VERDICT r3 next #3)."""
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", str(npes),
+         "--cpu-devices", "1", str(shmem_suite_bin)],
+        capture_output=True, timeout=300, cwd=str(REPO),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "SHMEM SUITE COMPLETE" in out
+    assert "FAIL" not in out
+
+
+def test_shmem_symbol_surface():
+    """libtpushmem exports the core shmem_* entry points (the ~50-name
+    subset of the reference's 838; SURVEY §2.5)."""
+    lib = BUILD / "libtpushmem.so"
+    if not lib.exists():
+        pytest.skip("libtpushmem not built")
+    out = subprocess.run(["nm", "-D", str(lib)], capture_output=True,
+                         text=True).stdout
+    syms = {l.split()[-1] for l in out.splitlines()
+            if " T " in l and "shmem_" in l}
+    required = {
+        "shmem_init", "shmem_finalize", "shmem_my_pe", "shmem_n_pes",
+        "shmem_malloc", "shmem_calloc", "shmem_align", "shmem_free",
+        "shmem_barrier_all", "shmem_quiet", "shmem_fence",
+        "shmem_putmem", "shmem_getmem", "shmem_int_put", "shmem_int_get",
+        "shmem_long_put", "shmem_double_put", "shmem_int_p",
+        "shmem_int_g", "shmem_int_atomic_fetch_add",
+        "shmem_int_atomic_compare_swap", "shmem_long_atomic_swap",
+        "shmem_int_wait_until", "shmem_broadcast64", "shmem_collect64",
+        "shmem_fcollect64", "shmem_int_sum_to_all",
+        "shmem_double_sum_to_all", "shmem_ptr", "shmem_pe_accessible",
+    }
+    missing = required - syms
+    assert not missing, f"missing shmem symbols: {sorted(missing)}"
+    assert len(syms) >= 50, f"only {len(syms)} shmem_* symbols"

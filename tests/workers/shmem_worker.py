@@ -1,0 +1,51 @@
+"""tpurun np=3 worker: the Python shmem API across real processes —
+heap symmetry, ring put, get-back, atomics on PE 0, collectives."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import ompi_tpu.shmem as shmem
+
+shmem.init(heap_bytes=1 << 20)
+me = shmem.my_pe()
+n = shmem.n_pes()
+assert n == 3
+
+a = shmem.malloc(8, np.int64)
+ctr = shmem.malloc(1, np.int64)
+a.view()[:] = -1
+ctr.view()[:] = 0
+shmem.barrier_all()
+
+# ring put: each PE writes a marker array into its RIGHT neighbor's
+# symmetric slice (each PE receives exactly one put — no write race)
+right = (me + 1) % n
+left = (me - 1 + n) % n
+marker = np.full(8, -1, np.int64)
+marker[me] = 1000 + me
+shmem.put(a, marker, right)
+shmem.barrier_all()
+mine = np.asarray(a)
+assert mine[left] == 1000 + left, mine
+
+got = shmem.get(a, right)
+assert got[me] == 1000 + me
+
+# atomics: everyone bumps PE 0's counter
+before = shmem.atomic_fetch_add(ctr, 1, 0)
+assert 0 <= before < n
+shmem.barrier_all()
+assert shmem.atomic_fetch(ctr, 0) == n
+
+# collectives
+s = shmem.sum_to_all(np.ones((1, 2)))
+assert np.array_equal(s, np.full((1, 2), 3.0))
+b = shmem.broadcast(np.full((1, 4), float(me)), 0)
+assert np.array_equal(np.asarray(b), np.zeros((1, 4)))
+
+shmem.barrier_all()
+shmem.finalize()
+print(f"OK shmem_py pe={me}", flush=True)
