@@ -376,6 +376,26 @@ def dcn_rows() -> dict:
     return out
 
 
+def algos_cpu8_rows() -> dict:
+    """coll/base algorithm family on the 8-device virtual CPU mesh:
+    RELATIVE timings (ring vs psum vs recursive-doubling vs
+    rabenseifner, small/large) — the n>1 algorithm-quality leg the
+    single-chip headline cannot measure (VERDICT r3 next #4)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "bench_algos_cpu8.py")],
+        capture_output=True, timeout=900, env=env, cwd=str(REPO))
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"algos_cpu8 rc={res.returncode}:\n"
+            f"{res.stdout.decode()[-2000:]}\n{res.stderr.decode()[-1000:]}")
+    for line in res.stdout.decode().splitlines():
+        if "ALGOS8 " in line:
+            return json.loads(line.split("ALGOS8 ", 1)[1])
+    raise RuntimeError("no ALGOS8 line")
+
+
 def capi_rows(max_bytes: int = 4096, iters: int = 400) -> dict:
     """C-ABI call overhead: native osu_allreduce (embedded-CPython shim)
     vs the Python API, same backend, same sizes, np=1."""
@@ -443,7 +463,8 @@ def main() -> None:
     detail = run(max_bytes, args.iters, args.suite_max, args.step)
 
     if not args.no_subproc:
-        for key, fn in (("dcn", dcn_rows), ("capi", capi_rows)):
+        for key, fn in (("dcn", dcn_rows), ("capi", capi_rows),
+                        ("algos_cpu8", algos_cpu8_rows)):
             try:
                 detail[key] = fn()
             except Exception as e:  # never lose the headline to a subrow
