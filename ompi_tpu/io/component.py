@@ -15,7 +15,12 @@ import numpy as np
 
 from ompi_tpu.core.errors import MPIFileError, MPIIOError
 from ompi_tpu.core.registry import Component, register_component
-from .fcoll import IndividualFcoll, TwoPhaseFcoll
+from .fcoll import (
+    DynamicGen2Fcoll,
+    IndividualFcoll,
+    TwoPhaseFcoll,
+    VulcanFcoll,
+)
 from .file import (
     File,
     MODE_APPEND,
@@ -156,7 +161,25 @@ class OmpioIoComponent(Component):
         self.store = store
         store.register(
             "io", "ompio", "fcoll", "two_phase", type="string",
-            help="Collective-buffering strategy: two_phase | individual",
+            help="Collective-buffering strategy: two_phase | individual "
+            "| dynamic_gen2 | vulcan (the reference's fcoll family)",
+        )
+        store.register(
+            "io", "ompio", "num_aggregators", 4, type="int",
+            help="fcoll/dynamic_gen2: contiguous file domains the "
+            "merged extent is split into (one coalesced IO stream per "
+            "domain)",
+        )
+        store.register(
+            "io", "ompio", "stripe_size", 1 << 20, type="int",
+            help="fcoll/vulcan: stripe alignment (bytes) for collective "
+            "writes",
+        )
+        store.register(
+            "io", "ompio", "sharedfp", "sm", type="string",
+            help="Shared-file-pointer strategy: sm (in-process) | "
+            "lockedfile (cross-process via <path>.shfp under flock) | "
+            "individual (private pointer)",
         )
 
     def open(self, store) -> bool:
@@ -167,21 +190,49 @@ class OmpioIoComponent(Component):
         ctx = mca.default_context()
         self.fs = _FsFacade(ctx.framework("fs").select_one())
         self.fbtl = ctx.framework("fbtl").select_one()
-        name = str(store.get("io_ompio_fcoll", "two_phase"))
-        self.fcoll = {"two_phase": TwoPhaseFcoll, "individual": IndividualFcoll}.get(
-            name, TwoPhaseFcoll
-        )()
+        self._refresh_policies(store)
         return True
+
+    def _refresh_policies(self, store) -> None:
+        """fcoll/sharedfp selection is PER file_open (the reference
+        selects fcoll at open time from hints/layout), so the vars are
+        re-read on every open, not frozen at framework open."""
+        name = str(store.get("io_ompio_fcoll", "two_phase"))
+        if name == "dynamic_gen2":
+            self.fcoll = DynamicGen2Fcoll(
+                int(store.get("io_ompio_num_aggregators", 4)))
+        elif name == "vulcan":
+            self.fcoll = VulcanFcoll(
+                int(store.get("io_ompio_stripe_size", 1 << 20)))
+        else:
+            self.fcoll = {
+                "two_phase": TwoPhaseFcoll,
+                "individual": IndividualFcoll,
+            }.get(name, TwoPhaseFcoll)()
+        self.sharedfp_name = str(store.get("io_ompio_sharedfp", "sm"))
+
+    def make_sharedfp(self, path: str):
+        from .sharedfp import SHAREDFP, SmSharedfp
+
+        if self.fs is None:
+            self.open(self.store or _null_store())
+        return SHAREDFP.get(self.sharedfp_name, SmSharedfp)(path)
 
     def file_open(self, comm, path: str, amode: int) -> File:
         if self.fs is None:
             self.open(self.store or _null_store())
+        elif self.store is not None:
+            self._refresh_policies(self.store)  # per-open selection
         return File(comm, path, amode, self)
 
     def file_delete(self, path: str) -> None:
         if self.fs is None:
             self.open(self.store or _null_store())
         self.fs.delete(path)
+        try:  # orphaned lockedfile pointer state goes with the file
+            os.unlink(path + ".shfp")
+        except OSError:
+            pass
 
 
 def _null_store():

@@ -161,23 +161,35 @@ class File:
         self._ranks = [
             _RankState(_View(*_DEFAULT_VIEW_ARGS)) for _ in range(comm.size)
         ]
-        self._shared_ptr = 0  # etype units of rank 0's etype (MPI: common view req.)
-        self._shared_lock = threading.Lock()
+        #: shared-pointer strategy (sharedfp component: sm in-process,
+        #: lockedfile cross-process via <path>.shfp, individual private)
+        self._sharedfp = component.make_sharedfp(path)
+        #: per-file fcoll snapshot (the reference selects the strategy
+        #: at open and stores it on the handle; later opens with a
+        #: different --mca io_ompio_fcoll must not retroactively change
+        #: THIS file's collective buffering)
+        self.fcoll = component.fcoll
         if amode & MODE_APPEND:
             end = self.get_size()
             for rs in self._ranks:
                 # position individual+shared pointers at end (in etype=BYTE units)
                 rs.ptr = end
-            self._shared_ptr = end
+            self._sharedfp.set(end)
+        else:
+            # fresh open: a stale persistent pointer (lockedfile .shfp
+            # from an earlier job on the same path) must not leak in
+            self._sharedfp.set(0)
 
     # -- lifecycle ------------------------------------------------------
 
     def close(self) -> None:
         if self._closed:
             return
+        self._sharedfp.close()
         self.component.fs.close(self._fd)
         self._closed = True
         if self.amode & MODE_DELETE_ON_CLOSE:
+            self._sharedfp.unlink()
             self.component.fs.delete(self.path)
 
     def _check(self, writing: bool | None = None, rank: int | None = None):
@@ -227,8 +239,7 @@ class File:
         filetype = filetype or etype
         self._ranks[rank].view = _View(disp, etype, filetype)
         self._ranks[rank].ptr = 0
-        with self._shared_lock:
-            self._shared_ptr = 0
+        self._sharedfp.set(0)
 
     def get_view(self, rank: int) -> tuple[int, Datatype, Datatype]:
         self._check(rank=rank)
@@ -352,37 +363,34 @@ class File:
                 f"of etype ({v.etype.size} B) elements"
             )
         n = raw.nbytes // v.etype.size
-        with self._shared_lock:
-            pos = self._shared_ptr
-            self._shared_ptr += n
+        pos = self._sharedfp.fetch_add(n)
         self.write_at(rank, pos, data)
         return n
 
     def read_shared(self, rank: int, count: int, dtype=np.uint8) -> np.ndarray:
         self._check(writing=False, rank=rank)
-        with self._shared_lock:
-            pos = self._shared_ptr
-            self._shared_ptr += count
+        pos = self._sharedfp.fetch_add(count)
         return self.read_at(rank, pos, count, dtype)
 
     def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
         self._check()
-        with self._shared_lock:
-            if whence == SEEK_SET:
-                new = offset
-            elif whence == SEEK_CUR:
-                new = self._shared_ptr + offset
-            elif whence == SEEK_END:
-                new = self._view_end_etypes(0) + offset
-            else:
-                raise MPIArgError(f"bad whence {whence}")
+        if whence not in (SEEK_SET, SEEK_CUR, SEEK_END):
+            raise MPIArgError(f"bad whence {whence}")
+        end = self._view_end_etypes(0) if whence == SEEK_END else 0
+
+        def move(cur: int) -> int:
+            new = (offset if whence == SEEK_SET
+                   else cur + offset if whence == SEEK_CUR
+                   else end + offset)
             if new < 0:
                 raise MPIArgError("shared pointer moved before start")
-            self._shared_ptr = new
+            return new
+
+        self._sharedfp.update(move)  # ONE lock acquisition: SEEK_CUR
+        # cannot lose a concurrent fetch-add (old single-lock contract)
 
     def get_position_shared(self) -> int:
-        with self._shared_lock:
-            return self._shared_ptr
+        return self._sharedfp.get()
 
     def write_ordered(self, blocks: Sequence[Any]) -> list[int]:
         """Collective: each rank writes its block at the shared pointer
@@ -420,7 +428,7 @@ class File:
             runs = v.map_runs(off * v.etype.size, raw.nbytes)
             per_rank.append((runs, raw))
             counts.append(raw.nbytes // v.etype.size)
-        self.component.fcoll.write_all(self.component.fbtl, self._fd, per_rank)
+        self.fcoll.write_all(self.component.fbtl, self._fd, per_rank)
         return counts
 
     def read_at_all(self, offsets: Sequence[int], counts: Sequence[int],
@@ -434,7 +442,7 @@ class File:
             v = self._ranks[r].view
             nbytes = cnt * v.etype.size
             reqs.append((v.map_runs(off * v.etype.size, nbytes), nbytes))
-        raws = self.component.fcoll.read_all(self.component.fbtl, self._fd, reqs)
+        raws = self.fcoll.read_all(self.component.fbtl, self._fd, reqs)
         return [raw.view(np.dtype(dtype)) for raw in raws]
 
     def write_all(self, blocks: Sequence[Any]) -> list[int]:

@@ -350,3 +350,143 @@ def test_checkpoint_rank_mismatch(world, tmp_path):
         checkpoint.save(world, path, np.zeros((N + 1, 4)))
     with pytest.raises(MPIFileError):
         checkpoint.restore(world, str(tmp_path / "absent.bin"))
+
+
+# -- fcoll strategy family (SURVEY §2.2: the reference's 5 components) --
+
+
+@pytest.mark.parametrize(
+    "fcoll_name", ["two_phase", "individual", "dynamic_gen2", "vulcan"])
+def test_fcoll_strategies_byte_identical(world, path, fcoll_name):
+    """Every fcoll strategy must produce the SAME file bytes for the
+    same collective write — they differ only in IO-op shape (global
+    coalescing vs aggregator domains vs stripe alignment)."""
+    from ompi_tpu.core import mca
+
+    store = mca.default_context().store
+    store.set("io_ompio_fcoll", fcoll_name)
+    try:
+        f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+        assert type(f.component.fcoll).NAME == fcoll_name
+        n = world.size
+        # scattered pattern: rank r owns bytes [r*48, (r+1)*48) plus a
+        # gap-separated tail block
+        offsets = [r * 48 for r in range(n)]
+        blocks = [np.full(48, r, np.uint8) for r in range(n)]
+        f.write_at_all(offsets, blocks)
+        tail_off = [n * 48 + 64 + r * 16 for r in range(n)]
+        tail = [np.full(16, 100 + r, np.uint8) for r in range(n)]
+        f.write_at_all(tail_off, tail)
+        out = f.read_at(0, 0, n * 48, np.uint8)
+        for r in range(n):
+            assert (out[r * 48:(r + 1) * 48] == r).all(), fcoll_name
+        t = f.read_at(0, tail_off[0], n * 16, np.uint8)
+        for r in range(n):
+            assert (t[r * 16:(r + 1) * 16] == 100 + r).all(), fcoll_name
+        # collective read through the same strategy
+        got = f.read_at_all(offsets, [48] * n)
+        for r in range(n):
+            assert (np.asarray(got[r]) == r).all(), fcoll_name
+        f.close()
+    finally:
+        store.set("io_ompio_fcoll", "two_phase")
+
+
+def test_fcoll_vulcan_stripe_alignment(world, path):
+    """vulcan re-chunks coalesced writes on stripe boundaries: with a
+    tiny stripe every pwritev is stripe-bounded (observable via a
+    recording fbtl)."""
+    from ompi_tpu.io.fcoll import VulcanFcoll
+
+    calls = []
+
+    class RecordingFbtl:
+        @staticmethod
+        def pwritev(fd, runs, raw):
+            calls.extend(runs)
+
+    v = VulcanFcoll(stripe_bytes=4096)
+    data = np.zeros(10000, np.uint8)
+    v.write_all(RecordingFbtl, None, [([(100, 0, 10000)], data)])
+    for off, _, length in calls:
+        assert length <= 4096
+        # no write crosses a stripe boundary
+        assert off // 4096 == (off + length - 1) // 4096, (off, length)
+
+
+def test_fcoll_dynamic_gen2_domains(world, path):
+    """dynamic_gen2 splits the touched extent into aggregator domains;
+    the file contents stay identical to two_phase's."""
+    from ompi_tpu.io.fcoll import DynamicGen2Fcoll
+
+    calls = []
+
+    class RecordingFbtl:
+        @staticmethod
+        def pwritev(fd, runs, raw):
+            calls.append((runs[0][0], runs[0][2]))
+
+    g = DynamicGen2Fcoll(num_aggregators=4)
+    data = np.arange(8192, dtype=np.uint8).astype(np.uint8)
+    g.write_all(RecordingFbtl, None, [([(0, 0, 8192)], data)])
+    assert len(calls) == 4  # one coalesced IO per domain
+    assert sorted(calls) == [(0, 2048), (2048, 2048), (4096, 2048),
+                             (6144, 2048)]
+
+
+# -- sharedfp strategy family ------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["sm", "lockedfile", "individual"])
+def test_sharedfp_strategies_fetch_add(world, path, name):
+    from ompi_tpu.core import mca
+
+    store = mca.default_context().store
+    store.set("io_ompio_sharedfp", name)
+    try:
+        f = world.file_open(path, MODE_CREATE | MODE_RDWR)
+        assert type(f._sharedfp).NAME == name
+        assert f.write_shared(0, np.full(8, 1, np.uint8)) == 8
+        assert f.write_shared(1, np.full(8, 2, np.uint8)) == 8
+        assert f.get_position_shared() == 16
+        out = f.read_at(0, 0, 16, np.uint8)
+        assert set(out[:8]) | set(out[8:]) == {1, 2}
+        f.seek_shared(0, SEEK_SET)
+        assert f.get_position_shared() == 0
+        f.close()
+    finally:
+        store.set("io_ompio_sharedfp", "sm")
+
+
+def test_sharedfp_lockedfile_across_processes(tmp_path):
+    """The lockedfile strategy's pointer is shared across PROCESSES —
+    the reason the reference ships it.  Two tpurun workers open the
+    same file with --mca io_ompio_sharedfp lockedfile and interleave
+    shared writes; every byte must land in a distinct region and the
+    final pointer equals the total."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    worker = repo / "tests" / "workers" / "sharedfp_worker.py"
+    target = tmp_path / "shared.bin"
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+         "--cpu-devices", "1",
+         "--mca", "io_ompio_sharedfp", "lockedfile",
+         str(worker), str(target)],
+        capture_output=True, timeout=240, cwd=str(repo),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert sum("OK sharedfp " in l for l in out.splitlines()) == 2
+    data = np.fromfile(target, np.uint8)
+    # 2 procs x 16 writes x 8 B, all distinct regions: every chunk is
+    # wholly one proc's fill value and both values appear 16 times
+    assert data.size == 2 * 16 * 8
+    chunks = data.reshape(-1, 8)
+    vals = [int(c[0]) for c in chunks]
+    assert all((c == c[0]).all() for c in chunks)
+    assert sorted(set(vals)) == [1, 2]
+    assert vals.count(1) == 16 and vals.count(2) == 16

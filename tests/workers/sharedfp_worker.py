@@ -1,0 +1,30 @@
+"""np=2 worker: interleaved MPI shared-pointer writes through the
+lockedfile sharedfp — each process fills 8-byte chunks with its id."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.core import mca
+from ompi_tpu.io import MODE_CREATE, MODE_RDWR
+
+world = api.init()
+p = world.proc
+target = sys.argv[1]
+
+ctx = mca.default_context()
+comp = ctx.framework("io").select_one()
+f = comp.file_open(world, target, MODE_CREATE | MODE_RDWR)
+assert type(f._sharedfp).NAME == "lockedfile", type(f._sharedfp).NAME
+world.barrier()
+for i in range(16):
+    f.write_shared(0, np.full(8, p + 1, np.uint8))
+world.barrier()
+assert f.get_position_shared() == 2 * 16 * 8
+f.close()
+api.finalize()
+print(f"OK sharedfp proc={p}", flush=True)
