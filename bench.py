@@ -393,6 +393,33 @@ def dcn_rows() -> dict:
     return out
 
 
+def _parse_osu_rows(text: str) -> list[dict]:
+    """Rows of an OSU-style table from tpurun stdout: strip the iof
+    '[rank] ' prefix, keep 2-token numeric lines (size, value)."""
+    out = []
+    for line in text.splitlines():
+        parts = line.split("] ", 1)[-1].split()
+        if len(parts) == 2 and parts[0].isdigit():
+            out.append({"bytes": int(parts[0]), "value": float(parts[1])})
+    return out
+
+
+def capi_p2p_rows() -> dict:
+    """np=2 C-path p2p: stock OSU osu_latency/osu_bw binaries through
+    the shim + libtpudcn — the full-native MPI_Send/Recv numbers the
+    reference is conventionally measured with."""
+    from ompi_tpu import native
+
+    rows = {}
+    for name, args in (("osu_latency", [65536, 400]),
+                       ("osu_bw", [4 << 20, 32])):
+        bin_path = REPO / "native" / "build" / name
+        native.compile_mpi_program(
+            REPO / "native" / "bench" / f"{name}.c", bin_path)
+        rows[name] = _parse_osu_rows(_run_tpurun(2, str(bin_path), args))
+    return rows
+
+
 def algos_cpu8_rows() -> dict:
     """coll/base algorithm family on the 8-device virtual CPU mesh:
     RELATIVE timings (ring vs psum vs recursive-doubling vs
@@ -423,12 +450,8 @@ def capi_rows(max_bytes: int = 4096, iters: int = 400) -> dict:
     native.compile_mpi_program(
         REPO / "native" / "bench" / "osu_allreduce.c", bin_path)
     out_c = _run_tpurun(1, str(bin_path), [max_bytes, iters])
-    c_rows = []
-    for line in out_c.splitlines():
-        line = line.split("] ", 1)[-1]  # strip iof [rank] prefix
-        parts = line.split()
-        if len(parts) == 2 and parts[0].isdigit():
-            c_rows.append({"bytes": int(parts[0]), "c_us": float(parts[1])})
+    c_rows = [{"bytes": r["bytes"], "c_us": r["value"]}
+              for r in _parse_osu_rows(out_c)]
     out_py = _run_tpurun(
         1, str(REPO / "tools" / "bench_pyapi.py"), [max_bytes, iters])
     py_rows = []
@@ -481,6 +504,7 @@ def main() -> None:
 
     if not args.no_subproc:
         for key, fn in (("dcn", dcn_rows), ("capi", capi_rows),
+                        ("capi_p2p", capi_p2p_rows),
                         ("algos_cpu8", algos_cpu8_rows)):
             try:
                 detail[key] = fn()

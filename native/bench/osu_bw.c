@@ -1,0 +1,75 @@
+/* OSU-micro-benchmark-style MPI p2p bandwidth test.
+ *
+ * Same shape as OSU's osu_bw.c (SURVEY.md §6): rank 0 streams a WINDOW
+ * of back-to-back nonblocking sends per batch; the last rank posts the
+ * matching irecvs and acks each batch with one small send.  Reports
+ * MB/s per message size — the unidirectional-stream number btl/sm and
+ * btl/tcp are conventionally compared with.
+ *
+ * Usage: osu_bw [max_bytes] [window]
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define MAX_WINDOW 64
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size < 2) {
+    fprintf(stderr, "osu_bw needs >= 2 ranks\n");
+    MPI_Abort(MPI_COMM_WORLD, 1);
+  }
+
+  long max_bytes = argc > 1 ? atol(argv[1]) : (4L << 20);
+  int window = argc > 2 ? atoi(argv[2]) : 32;
+  if (window > MAX_WINDOW) window = MAX_WINDOW;
+  int peer = size - 1;
+
+  if (rank == 0) {
+    printf("# OSU-style MPI Bandwidth Test (tpumpi)\n");
+    printf("%-12s%-14s\n", "# Size", "MB/s");
+  }
+
+  char *buf = (char *)malloc((size_t)max_bytes ? (size_t)max_bytes : 1);
+  char ack;
+  memset(buf, rank, (size_t)max_bytes);
+  MPI_Request reqs[MAX_WINDOW];
+
+  for (long nbytes = 1; nbytes <= max_bytes; nbytes *= 4) {
+    int batches = nbytes >= (1 << 20) ? 4 : 12;
+    int warm = 1;
+    MPI_Barrier(MPI_COMM_WORLD);
+    double t0 = 0;
+    if (rank == 0) {
+      for (int b = -warm; b < batches; b++) {
+        if (b == 0) t0 = MPI_Wtime();
+        for (int w = 0; w < window; w++)
+          MPI_Isend(buf, (int)nbytes, MPI_CHAR, peer, 7, MPI_COMM_WORLD,
+                    &reqs[w]);
+        MPI_Waitall(window, reqs, MPI_STATUSES_IGNORE);
+        MPI_Recv(&ack, 1, MPI_CHAR, peer, 8, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+      }
+      double dt = MPI_Wtime() - t0;
+      double mb = (double)nbytes * window * batches / 1e6;
+      printf("%-12ld%-14.2f\n", nbytes, mb / dt);
+    } else if (rank == peer) {
+      for (int b = -warm; b < batches; b++) {
+        for (int w = 0; w < window; w++)
+          MPI_Irecv(buf, (int)nbytes, MPI_CHAR, 0, 7, MPI_COMM_WORLD,
+                    &reqs[w]);
+        MPI_Waitall(window, reqs, MPI_STATUSES_IGNORE);
+        MPI_Send(&ack, 1, MPI_CHAR, 0, 8, MPI_COMM_WORLD);
+      }
+    }
+  }
+
+  free(buf);
+  MPI_Finalize();
+  return 0;
+}

@@ -1,0 +1,64 @@
+/* OSU-micro-benchmark-style MPI p2p latency test (ping-pong).
+ *
+ * Same measurement shape as OSU's osu_latency.c (SURVEY.md §6): rank 0
+ * and the last rank ping-pong a message per size; reported latency is
+ * half the round trip.  Exercises the FULL native path: the C shim's
+ * MPI_Send/MPI_Recv over libtpudcn's matching engine and shm rings.
+ *
+ * Usage: osu_latency [max_bytes] [iterations]
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size < 2) {
+    fprintf(stderr, "osu_latency needs >= 2 ranks\n");
+    MPI_Abort(MPI_COMM_WORLD, 1);
+  }
+
+  long max_bytes = argc > 1 ? atol(argv[1]) : (1L << 20);
+  int iters = argc > 2 ? atoi(argv[2]) : 200;
+  int peer = size - 1;
+  int me = rank == 0 ? 0 : (rank == peer ? peer : -1);
+
+  if (rank == 0) {
+    printf("# OSU-style MPI Latency Test (tpumpi)\n");
+    printf("%-12s%-14s\n", "# Size", "Latency(us)");
+  }
+
+  char *buf = (char *)malloc((size_t)max_bytes ? (size_t)max_bytes : 1);
+  memset(buf, rank, (size_t)max_bytes);
+
+  for (long nbytes = 1; nbytes <= max_bytes; nbytes *= 4) {
+    int it = nbytes >= (256 << 10) ? iters / 4 + 1 : iters;
+    int warmup = it / 10 + 1;
+    MPI_Barrier(MPI_COMM_WORLD);
+    double t0 = 0;
+    if (me == 0) {
+      for (int i = -warmup; i < it; i++) {
+        if (i == 0) t0 = MPI_Wtime();
+        MPI_Send(buf, (int)nbytes, MPI_CHAR, peer, 1, MPI_COMM_WORLD);
+        MPI_Recv(buf, (int)nbytes, MPI_CHAR, peer, 1, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+      }
+      printf("%-12ld%-14.2f\n", nbytes,
+             (MPI_Wtime() - t0) * 1e6 / it / 2.0);
+    } else if (me == peer) {
+      for (int i = -warmup; i < it; i++) {
+        MPI_Recv(buf, (int)nbytes, MPI_CHAR, 0, 1, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+        MPI_Send(buf, (int)nbytes, MPI_CHAR, 0, 1, MPI_COMM_WORLD);
+      }
+    }
+  }
+
+  free(buf);
+  MPI_Finalize();
+  return 0;
+}

@@ -237,3 +237,29 @@ def test_symbol_diff_vs_installed_reference_empty():
                 if l.split() and l.split()[-1].startswith("MPI_")}
     missing = syms(ref) - syms(ours)
     assert not missing, f"missing vs installed reference: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("name,args", [
+    ("osu_latency", ["16384", "60"]),
+    ("osu_bw", ["1048576", "8"]),
+])
+def test_osu_p2p_benches_run_and_validate(native_bins, name, args):
+    """Stock OSU p2p benchmarks (latency ping-pong, windowed bandwidth)
+    compile unmodified and run over the native data plane at np=2 —
+    the conventional measurement harness for btl/sm (SURVEY §6)."""
+    from ompi_tpu import native
+
+    binary = native.compile_mpi_program(
+        REPO / "native" / "bench" / f"{name}.c", BUILD / name)
+    res = tpurun(2, binary, args)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    import sys as _sys
+
+    if str(REPO) not in _sys.path:
+        _sys.path.insert(0, str(REPO))
+    from bench import _parse_osu_rows
+
+    rows = _parse_osu_rows(out)
+    assert len(rows) >= 5, out
+    assert all(r["value"] > 0 for r in rows)
