@@ -1298,6 +1298,7 @@ int tdcn_send_addr(void *h, const char *address, int kind, const char *cid,
                    const char *dtype, int ndim, const int64_t *shape,
                    const void *meta, int meta_len, const void *data,
                    uint64_t nbytes) {
+  if (ndim > 8) return -4;  // Env carries at most 8 dims
   Engine *eng = (Engine *)h;
   Env e;
   e.kind = (uint8_t)kind;
@@ -1340,6 +1341,36 @@ int tdcn_send_local(void *h, int kind, const char *cid, int64_t seq, int src,
   m.pyhandle = pyhandle;
   m.count = count;
   m.nbytes = nbytes;
+  std::lock_guard<std::mutex> g(eng->mu);
+  deliver_locked(eng, std::move(m));
+  return 0;
+}
+
+// loopback delivery carrying BYTES (the buffered-eager copy happens
+// here): consumable by both the C fast path and Python receivers —
+// pyhandle messages can only be consumed Python-side, so mixed-plane
+// comms (the C ABI's) must use this form for local ranks
+int tdcn_send_local_data(void *h, int kind, const char *cid, int64_t seq,
+                         int src, int dst, int tag, const char *dtype,
+                         int ndim, const int64_t *shape, const void *data,
+                         uint64_t nbytes) {
+  if (ndim > 8) return -4;  // Env carries at most 8 dims
+  Engine *eng = (Engine *)h;
+  OwnedMsg m;
+  m.env.kind = (uint8_t)kind;
+  m.env.cid = cid ? cid : "";
+  m.env.seq = seq;
+  m.env.src = src;
+  m.env.dst = dst;
+  m.env.tag = tag;
+  m.env.dtype = dtype ? dtype : "";
+  m.env.ndim = ndim;
+  for (int i = 0; i < ndim && i < 8; i++) m.env.shape[i] = shape[i];
+  m.nbytes = nbytes;
+  if (nbytes) {
+    m.data = malloc(nbytes);
+    memcpy(m.data, data, nbytes);
+  }
   std::lock_guard<std::mutex> g(eng->mu);
   deliver_locked(eng, std::move(m));
   return 0;
@@ -1448,6 +1479,25 @@ int tdcn_req_test(void *h, uint64_t rid, TdcnMsg *out) {
   msg_into_tdcn(it->second->msg, out);
   delete it->second;
   eng->reqs.erase(it);
+  return 0;
+}
+
+int tdcn_req_peek(void *h, uint64_t rid, TdcnMsg *out) {
+  // NON-destructive completion probe (MPI_Request_get_status): fills
+  // the envelope fields only; the payload stays owned by the request
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->mu);
+  auto it = eng->reqs.find(rid);
+  if (it == eng->reqs.end()) return -1;
+  if (!it->second->completed.load()) return 1;
+  OwnedMsg &m = it->second->msg;
+  memset(out, 0, sizeof(*out));
+  out->src = m.env.src;
+  out->tag = m.env.tag;
+  out->seq = m.env.seq;
+  out->nbytes = m.nbytes;
+  out->count = m.count;
+  out->pyhandle = m.pyhandle;
   return 0;
 }
 
@@ -1599,6 +1649,7 @@ int tdcn_chan_send(void *h, uint64_t chan, int kind, int src, int dst,
                    const int64_t *shape, const void *data,
                    uint64_t nbytes) {
   (void)h;
+  if (ndim > 8) return -4;  // Env carries at most 8 dims
   Chan *c = (Chan *)(uintptr_t)chan;
   Env e;
   e.kind = (uint8_t)kind;

@@ -264,7 +264,10 @@ int PMPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
   return rc;
 }
 
+static void fp_forget(int comm); /* fast-path cleanup (defined below) */
+
 int PMPI_Comm_free(MPI_Comm *comm) {
+  fp_forget((int)*comm);
   int rc = capi_call("comm_free", NULL, "(i)", *comm);
   *comm = MPI_COMM_NULL;
   return rc;
@@ -382,16 +385,346 @@ double PMPI_Wtime(void) {
 
 double PMPI_Wtick(void) { return 1e-9; }
 
-/* ---- pt2pt --------------------------------------------------------- */
+/* ---- pt2pt: C fast path over libtpudcn ------------------------------
+ *
+ * For multi-process comms whose p2p plane is the C matching engine
+ * (native transport + the default pml — capi native_fastpath_info
+ * returns the wiring), MPI_Send/Recv/Isend/Irecv run ENTIRELY in C:
+ * no embedded-Python crossing on the message path.  Everything else
+ * (wildcard comms with interposed pmls, derived datatypes, the
+ * single-controller worlds) falls through to the capi path below —
+ * both paths feed the SAME matching engine, so mixing them on one
+ * communicator preserves ordering.  "Thin must mean cheap": the last
+ * step of the SURVEY §2.1 bindings rule. */
+
+typedef struct {
+  int32_t kind, src, dst, tag;
+  int64_t seq;
+  uint64_t pyhandle;
+  void *data;
+  uint64_t nbytes;
+  int64_t count;
+  char dtype[16];
+  int32_t ndim;
+  int64_t shape[8];
+  char cid[128];
+  void *meta;
+  uint32_t meta_len;
+} __attribute__((packed)) tdcn_msg_t;
+
+extern int tdcn_chan_send1(void *, unsigned long long, int, int, int, int,
+                           const char *, long long, const void *,
+                           unsigned long long);
+extern unsigned long long tdcn_chan_open(void *, const char *, const char *);
+extern int tdcn_send_local_data(void *, int, const char *, long long, int,
+                                int, int, const char *, int,
+                                const long long *, const void *,
+                                unsigned long long);
+extern int tdcn_precv(void *, const char *, int, int, int, int, double,
+                      tdcn_msg_t *);
+extern unsigned long long tdcn_post_recv(void *, const char *, int, int,
+                                         int);
+extern int tdcn_req_wait(void *, unsigned long long, double, tdcn_msg_t *);
+extern int tdcn_req_test(void *, unsigned long long, tdcn_msg_t *);
+extern int tdcn_req_peek(void *, unsigned long long, tdcn_msg_t *);
+extern void tdcn_chan_close(void *, unsigned long long);
+extern void tdcn_free(void *);
+
+/* predefined CONTIGUOUS datatype codes 1..27 → (size, numpy str) */
+static const struct {
+  int size;
+  const char *np;
+} fp_dt[28] = {
+    {0, ""},      {1, "|i1"},  {1, "|i1"},  {1, "|u1"},  {1, "|u1"},
+    {2, "<i2"},   {2, "<u2"},  {4, "<i4"},  {4, "<u4"},  {8, "<i8"},
+    {8, "<u8"},   {8, "<i8"},  {8, "<u8"},  {4, "<f4"},  {8, "<f8"},
+    {0, ""},      {1, "|b1"},  {1, "|i1"},  {2, "<i2"},  {4, "<i4"},
+    {8, "<i8"},   {1, "|u1"},  {2, "<u2"},  {4, "<u4"},  {8, "<u8"},
+    {8, "<c8"},   {16, "<c16"}, {4, "<i4"}};
+
+typedef struct {
+  int comm;
+  int state; /* 0 unknown, 1 active, -1 disabled */
+  void *eng;
+  char cid[64];
+  int my_rank, nranks, nprocs, my_proc;
+  long long *offsets;        /* nprocs+1 */
+  char **addrs;              /* per proc */
+  unsigned long long *chans; /* per proc, 0 = unopened */
+} tpumpi_fp;
+
+#define FP_MAX 64
+static tpumpi_fp g_fp[FP_MAX];
+static int g_fp_n = 0;
+
+static tpumpi_fp *fp_get(MPI_Comm comm) {
+  for (int i = 0; i < g_fp_n; i++)
+    if (g_fp[i].comm == (int)comm)
+      return g_fp[i].state == 1 ? &g_fp[i] : NULL;
+  tpumpi_fp *fp = NULL;
+  for (int i = 0; i < g_fp_n; i++)
+    if (g_fp[i].comm == -1) { /* slot freed by fp_forget */
+      fp = &g_fp[i];
+      break;
+    }
+  if (!fp) {
+    if (g_fp_n >= FP_MAX) return NULL;
+    fp = &g_fp[g_fp_n++];
+  }
+  memset(fp, 0, sizeof(*fp));
+  fp->comm = (int)comm;
+  fp->state = -1;
+  char info[4096];
+  int len = 0;
+  if (capi_call_str("native_fastpath_info", info, sizeof(info), &len,
+                    "(i)", (int)comm) != MPI_SUCCESS ||
+      len == 0)
+    return NULL;
+  /* engine\x1f cid\x1f my_rank\x1f nranks\x1f offsets_csv\x1f
+   * addr0\x1e addr1... — ASCII unit/record separators: the composite
+   * transport addresses contain '|' and ';' themselves */
+  char *save = NULL;
+  char *tok = strtok_r(info, "\x1f", &save);
+  if (!tok) return NULL;
+  fp->eng = (void *)(uintptr_t)strtoull(tok, NULL, 10);
+  if (!(tok = strtok_r(NULL, "\x1f", &save))) return NULL;
+  snprintf(fp->cid, sizeof(fp->cid), "%s", tok);
+  if (!(tok = strtok_r(NULL, "\x1f", &save))) return NULL;
+  fp->my_rank = atoi(tok);
+  if (!(tok = strtok_r(NULL, "\x1f", &save))) return NULL;
+  fp->nranks = atoi(tok);
+  if (!(tok = strtok_r(NULL, "\x1f", &save))) return NULL;
+  {
+    long long tmp[1024];
+    int n = 0;
+    char *s2 = NULL;
+    for (char *o = strtok_r(tok, ",", &s2); o && n < 1024;
+         o = strtok_r(NULL, ",", &s2))
+      tmp[n++] = atoll(o);
+    fp->nprocs = n - 1;
+    if (fp->nprocs < 1) return NULL;
+    fp->offsets = (long long *)malloc(sizeof(long long) * (size_t)n);
+    memcpy(fp->offsets, tmp, sizeof(long long) * (size_t)n);
+  }
+  if (!(tok = strtok_r(NULL, "\x1f", &save))) return NULL;
+  fp->addrs = (char **)calloc((size_t)fp->nprocs, sizeof(char *));
+  fp->chans =
+      (unsigned long long *)calloc((size_t)fp->nprocs, sizeof(long long));
+  {
+    int n = 0;
+    char *s2 = NULL;
+    for (char *a = strtok_r(tok, "\x1e", &s2); a && n < fp->nprocs;
+         a = strtok_r(NULL, "\x1e", &s2))
+      fp->addrs[n++] = strdup(a);
+    if (n != fp->nprocs) return NULL;
+  }
+  for (int p = 0; p < fp->nprocs; p++)
+    if (fp->my_rank >= fp->offsets[p] && fp->my_rank < fp->offsets[p + 1])
+      fp->my_proc = p;
+  fp->state = 1;
+  if (getenv("TPUMPI_FP_DEBUG"))
+    fprintf(stderr, "tpumpi: fast path ACTIVE for comm %d (rank %d/%d, "
+                    "%d procs)\n",
+            fp->comm, fp->my_rank, fp->nranks, fp->nprocs);
+  return fp;
+}
+
+/* release a freed comm's fast-path wiring and compact the table so
+ * long-running comm-churn apps never exhaust the 64 slots (each freed
+ * comm's offsets/addresses/channels are reclaimed too) */
+static void fp_forget(int comm) {
+  for (int i = 0; i < g_fp_n; i++) {
+    if (g_fp[i].comm != comm) continue;
+    tpumpi_fp *fp = &g_fp[i];
+    if (fp->state == 1) {
+      for (int p = 0; p < fp->nprocs; p++) {
+        if (fp->chans && fp->chans[p])
+          tdcn_chan_close(fp->eng, fp->chans[p]);
+        if (fp->addrs && fp->addrs[p]) free(fp->addrs[p]);
+      }
+    }
+    free(fp->offsets);
+    free(fp->addrs);
+    free(fp->chans);
+    /* mark reusable IN PLACE: outstanding fast requests on OTHER
+     * comms hold tpumpi_fp pointers into this array — entries must
+     * never move */
+    memset(fp, 0, sizeof(*fp));
+    fp->comm = -1;
+    return;
+  }
+}
+
+static int fp_proc_of(const tpumpi_fp *fp, int rank) {
+  for (int p = 0; p < fp->nprocs; p++)
+    if (rank >= fp->offsets[p] && rank < fp->offsets[p + 1]) return p;
+  return -1;
+}
+
+static unsigned long long fp_chan(tpumpi_fp *fp, int proc) {
+  if (!fp->chans[proc])
+    fp->chans[proc] = tdcn_chan_open(fp->eng, fp->addrs[proc], fp->cid);
+  return fp->chans[proc];
+}
+
+/* fast-path request table: handles carry the 0x40000000 bit (capi's
+ * request counter never reaches it) */
+#define FP_REQ_BIT 0x40000000
+#define FP_REQ_MAX 1024
+typedef struct {
+  int used;
+  int is_send; /* eager: complete at issue */
+  int zombie;  /* freed while active: deliver on completion, no handle */
+  unsigned long long rid;
+  tpumpi_fp *fp;
+  void *buf;
+  long long cap;
+} fp_req_t;
+static fp_req_t g_fpreq[FP_REQ_MAX];
+static int g_fp_zombies = 0;
+
+static int fp_take(tdcn_msg_t *m, void *buf, long long cap,
+                   MPI_Status *status);
+
+/* freed-but-active receives drain opportunistically (the capi reap
+ * discipline): called from barrier and the p2p entry points so the
+ * canonical free-then-barrier-then-read pattern sees its bytes */
+static void fp_drain_zombies(void) {
+  if (!g_fp_zombies) return;
+  for (int i = 0; i < FP_REQ_MAX && g_fp_zombies; i++) {
+    if (!g_fpreq[i].used || !g_fpreq[i].zombie) continue;
+    tdcn_msg_t m;
+    if (tdcn_req_test(g_fpreq[i].fp->eng, g_fpreq[i].rid, &m) == 0) {
+      fp_take(&m, g_fpreq[i].buf, g_fpreq[i].cap, NULL);
+      g_fpreq[i].used = 0;
+      g_fpreq[i].zombie = 0;
+      g_fp_zombies--;
+    }
+  }
+}
+
+static int fp_req_alloc(void) {
+  fp_drain_zombies();
+  for (int i = 0; i < FP_REQ_MAX; i++)
+    if (!g_fpreq[i].used) {
+      g_fpreq[i].used = 1;
+      g_fpreq[i].zombie = 0;
+      return i;
+    }
+  return -1;
+}
+
+static void fp_fill_status(MPI_Status *status, const tdcn_msg_t *m) {
+  if (!status) return;
+  status->MPI_SOURCE = m->src;
+  status->MPI_TAG = m->tag;
+  status->MPI_ERROR = MPI_SUCCESS;
+  status->_nbytes = (long long)m->nbytes;
+}
+
+/* Route a fast-path error through the comm's errhandler semantics —
+ * the same _fail discipline the capi path applies (default
+ * MPI_ERRORS_ARE_FATAL aborts; MPI_ERRORS_RETURN hands the code back).
+ * Cold path only. */
+static int fp_error(int comm, int code) {
+  capi_ret r;
+  if (capi_call("fast_error", &r, "(ii)", comm, code) == MPI_SUCCESS &&
+      r.n >= 1)
+    return (int)r.v[0];
+  return code;
+}
+
+/* take a completed message into the user buffer; MPI_ERR_TRUNCATE when
+ * it doesn't fit (message still consumed, per MPI truncation rules) */
+static int fp_take(tdcn_msg_t *m, void *buf, long long cap,
+                   MPI_Status *status) {
+  int rc = MPI_SUCCESS;
+  if (m->pyhandle) {
+    /* cannot happen on capi-driven comms (Python local sends use the
+     * bytes form there) — but never lose a message silently */
+    fprintf(stderr, "tpumpi: fast recv matched a Python-handle payload; "
+                    "mixed-plane misuse\n");
+    return MPI_ERR_INTERN;
+  }
+  unsigned long long n = m->nbytes;
+  if ((long long)n > cap) {
+    n = (unsigned long long)cap;
+    rc = MPI_ERR_TRUNCATE;
+  }
+  if (n && buf) memcpy(buf, m->data, n);
+  fp_fill_status(status, m);
+  if (m->data) tdcn_free(m->data);
+  if (m->meta) tdcn_free(m->meta);
+  return rc;
+}
+
+static int fp_send(tpumpi_fp *fp, const void *buf, int count,
+                   MPI_Datatype datatype, int dest, int tag) {
+  int dt = (int)datatype;
+  int size = fp_dt[dt].size;
+  unsigned long long nbytes = (unsigned long long)count * (unsigned)size;
+  int dproc = fp_proc_of(fp, dest);
+  if (dproc < 0) return -1; /* bad rank: let capi raise the MPI error */
+  if (dproc == fp->my_proc) {
+    long long shape = count;
+    return tdcn_send_local_data(fp->eng, 1 /*FK_P2P*/, fp->cid, 0,
+                                fp->my_rank, dest, tag, fp_dt[dt].np, 1,
+                                &shape, buf, nbytes)
+               ? -1
+               : MPI_SUCCESS;
+  }
+  return tdcn_chan_send1(fp->eng, fp_chan(fp, dproc), 1 /*FK_P2P*/,
+                         fp->my_rank, dest, tag, fp_dt[dt].np, count, buf,
+                         nbytes)
+             ? -1
+             : MPI_SUCCESS;
+}
+
+static int fp_usable(tpumpi_fp **out, MPI_Comm comm, MPI_Datatype datatype,
+                     int peer, int tag, int wild_ok) {
+  int dt = (int)datatype;
+  if (dt < 1 || dt > 27 || fp_dt[dt].size == 0) return 0;
+  if (peer < (wild_ok ? MPI_ANY_SOURCE : 0)) return 0;
+  if (tag < (wild_ok ? MPI_ANY_TAG : 0)) return 0;
+  tpumpi_fp *fp = fp_get(comm);
+  if (!fp || peer >= fp->nranks) return 0;
+  *out = fp;
+  return 1;
+}
 
 int PMPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
               int tag, MPI_Comm comm) {
+  tpumpi_fp *fp;
+  if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (count >= 0 && fp_usable(&fp, comm, datatype, dest, tag, 0)) {
+    int rc = fp_send(fp, buf, count, datatype, dest, tag);
+    if (rc >= 0) return rc;
+  }
   return capi_call("send", NULL, "(Kiiiii)", PTR(buf), count, (int)datatype,
                    dest, tag, (int)comm);
 }
 
 int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
               MPI_Comm comm, MPI_Status *status) {
+  tpumpi_fp *fp;
+  if (source != MPI_PROC_NULL && count >= 0 &&
+      fp_usable(&fp, comm, datatype, source, tag, 1)) {
+    tdcn_msg_t m;
+    for (;;) {
+      int rc = tdcn_precv(fp->eng, fp->cid, fp->my_rank, source, tag, -1,
+                          120.0, &m);
+      if (rc == 0) break;
+      if (rc != 1) /* closed/failed: surface through the slow path */
+        goto slow;
+    }
+    {
+      int frc = fp_take(&m, buf,
+                        (long long)count * fp_dt[(int)datatype].size,
+                        status);
+      return frc == MPI_SUCCESS ? frc : fp_error((int)comm, frc);
+    }
+  }
+slow:;
   capi_ret r;
   int rc = capi_call("recv", &r, "(Kiiiii)", PTR(buf), count, (int)datatype,
                      source, tag, (int)comm);
@@ -401,6 +734,31 @@ int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
 
 int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
                int tag, MPI_Comm comm, MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (dest != MPI_PROC_NULL && count >= 0 &&
+      fp_usable(&fp, comm, datatype, dest, tag, 0)) {
+    int rc = fp_send(fp, buf, count, datatype, dest, tag);
+    if (rc == MPI_SUCCESS) {
+      int i = fp_req_alloc();
+      if (i >= 0) { /* eager: locally complete at issue */
+        g_fpreq[i].is_send = 1;
+        g_fpreq[i].fp = fp;
+        *request = (MPI_Request)(FP_REQ_BIT | i);
+        return MPI_SUCCESS;
+      }
+      /* table full: the send already happened; hand back a completed
+       * capi done-handle so Wait/Test still work */
+      capi_ret r2;
+      if (capi_call("isend_done_handle", &r2, "(iiL)", 0, 0, 0LL) ==
+              MPI_SUCCESS &&
+          r2.n >= 1) {
+        *request = (MPI_Request)r2.v[0];
+        return MPI_SUCCESS;
+      }
+      return MPI_ERR_INTERN;
+    }
+    if (rc > 0) return rc;
+  }
   capi_ret r;
   int rc = capi_call("isend", &r, "(Kiiiii)", PTR(buf), count, (int)datatype,
                      dest, tag, (int)comm);
@@ -410,11 +768,92 @@ int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
 
 int PMPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
                int tag, MPI_Comm comm, MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (source != MPI_PROC_NULL && count >= 0 &&
+      fp_usable(&fp, comm, datatype, source, tag, 1)) {
+    int i = fp_req_alloc();
+    if (i >= 0) {
+      g_fpreq[i].is_send = 0;
+      g_fpreq[i].fp = fp;
+      g_fpreq[i].buf = buf;
+      g_fpreq[i].cap = (long long)count * fp_dt[(int)datatype].size;
+      g_fpreq[i].rid = tdcn_post_recv(fp->eng, fp->cid, fp->my_rank,
+                                      source, tag);
+      *request = (MPI_Request)(FP_REQ_BIT | i);
+      return MPI_SUCCESS;
+    }
+  }
   capi_ret r;
   int rc = capi_call("irecv", &r, "(Kiiiii)", PTR(buf), count, (int)datatype,
                      source, tag, (int)comm);
   if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
   return rc;
+}
+
+/* completion hooks for the fast-request range (called from the Wait/
+ * Test entry points before they forward to capi) */
+static int fp_is_req(MPI_Request req) {
+  return ((int)req & FP_REQ_BIT) && ((int)req & ~FP_REQ_BIT) < FP_REQ_MAX;
+}
+
+static int fp_wait(MPI_Request *request, MPI_Status *status) {
+  fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
+  int rc = MPI_SUCCESS;
+  if (q->is_send) {
+    if (status) {
+      status->MPI_SOURCE = MPI_PROC_NULL;
+      status->MPI_TAG = MPI_ANY_TAG;
+      status->MPI_ERROR = MPI_SUCCESS;
+      status->_nbytes = 0;
+    }
+  } else {
+    tdcn_msg_t m;
+    for (;;) {
+      int w = tdcn_req_wait(q->fp->eng, q->rid, 120.0, &m);
+      if (w == 0) break;
+      if (w != 1) {
+        int comm = q->fp->comm;
+        q->used = 0;
+        *request = MPI_REQUEST_NULL;
+        return fp_error(comm, MPI_ERR_OTHER);
+      }
+    }
+    rc = fp_take(&m, q->buf, q->cap, status);
+  }
+  {
+    int comm = q->fp->comm;
+    q->used = 0;
+    *request = MPI_REQUEST_NULL;
+    return rc == MPI_SUCCESS ? rc : fp_error(comm, rc);
+  }
+}
+
+static int fp_test(MPI_Request *request, int *flag, MPI_Status *status) {
+  fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
+  if (q->is_send) {
+    *flag = 1;
+    return fp_wait(request, status);
+  }
+  tdcn_msg_t m;
+  int t = tdcn_req_test(q->fp->eng, q->rid, &m);
+  if (t == 1) {
+    *flag = 0;
+    return MPI_SUCCESS;
+  }
+  *flag = 1;
+  if (t != 0) {
+    int comm = q->fp->comm;
+    q->used = 0;
+    *request = MPI_REQUEST_NULL;
+    return fp_error(comm, MPI_ERR_OTHER);
+  }
+  int rc = fp_take(&m, q->buf, q->cap, status);
+  {
+    int comm = q->fp->comm;
+    q->used = 0;
+    *request = MPI_REQUEST_NULL;
+    return rc == MPI_SUCCESS ? rc : fp_error(comm, rc);
+  }
 }
 
 int PMPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
@@ -516,6 +955,7 @@ int PMPI_Wait(MPI_Request *request, MPI_Status *status) {
     empty_status(status);
     return MPI_SUCCESS;
   }
+  if (fp_is_req(*request)) return fp_wait(request, status);
   capi_ret r;
   int rc = capi_call("wait", &r, "(i)", *request);
   if (rc == MPI_SUCCESS) fill_status(status, &r, 0);
@@ -540,6 +980,7 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
     empty_status(status);
     return MPI_SUCCESS;
   }
+  if (fp_is_req(*request)) return fp_test(request, flag, status);
   capi_ret r;
   int rc = capi_call("test", &r, "(i)", *request);
   if (rc == MPI_SUCCESS && r.n >= 1) {
@@ -555,7 +996,11 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
 /* ---- collectives: blocking ---------------------------------------- */
 
 int PMPI_Barrier(MPI_Comm comm) {
-  return capi_call("barrier", NULL, "(i)", (int)comm);
+  int rc = capi_call("barrier", NULL, "(i)", (int)comm);
+  /* channel FIFO: a message sent before the peer's barrier entry has
+   * been matched by now — deliver freed-active receives (MPI 3.7.3) */
+  fp_drain_zombies();
+  return rc;
 }
 
 int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
@@ -1564,6 +2009,26 @@ int PMPI_Test_cancelled(const MPI_Status *status, int *flag) {
 }
 
 int PMPI_Request_free(MPI_Request *request) {
+  if (fp_is_req(*request)) {
+    fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
+    if (q->is_send) {
+      q->used = 0; /* eager send: already complete */
+    } else {
+      /* MPI 3.7.3: a freed ACTIVE receive still completes into the
+       * user buffer — drain now if done, else park as a zombie the
+       * drain hooks (barrier, later p2p calls) deliver */
+      tdcn_msg_t m;
+      if (tdcn_req_test(q->fp->eng, q->rid, &m) == 0) {
+        fp_take(&m, q->buf, q->cap, NULL);
+        q->used = 0;
+      } else {
+        q->zombie = 1;
+        g_fp_zombies++;
+      }
+    }
+    *request = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+  }
   if (*request != MPI_REQUEST_NULL)
     capi_call("request_free", NULL, "(i)", (int)*request);
   *request = MPI_REQUEST_NULL;
@@ -1575,6 +2040,19 @@ int PMPI_Request_get_status(MPI_Request request, int *flag,
   if (request == MPI_REQUEST_NULL) {
     *flag = 1;
     empty_status(status);
+    return MPI_SUCCESS;
+  }
+  if (fp_is_req(request)) { /* non-destructive completion probe */
+    fp_req_t *q = &g_fpreq[(int)request & ~FP_REQ_BIT];
+    if (q->is_send) {
+      *flag = 1;
+      empty_status(status);
+    } else {
+      tdcn_msg_t m;
+      int rc = tdcn_req_peek(q->fp->eng, q->rid, &m);
+      *flag = (rc == 0);
+      if (*flag) fp_fill_status(status, &m);
+    }
     return MPI_SUCCESS;
   }
   capi_ret r;

@@ -305,6 +305,56 @@ def comm_rank(h: int):
         return (_fail(e), 0)
 
 
+def fast_error(h: int, code: int):
+    """The shim's C fast path hit an MPI error (truncation, engine
+    failure): honor the communicator's errhandler exactly like
+    ``_fail`` — abort under MPI_ERRORS_ARE_FATAL (the conforming-C
+    default), hand the class back under MPI_ERRORS_RETURN."""
+    eh = _errhandlers.get(h, ERRH_FATAL)
+    if eh == ERRH_FATAL:
+        import os
+        import sys
+
+        print(f"tpumpi: MPI_ERRORS_ARE_FATAL: fast-path error class "
+              f"{int(code)}", file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(int(code) if 0 < int(code) < 126 else 1)
+    return (MPI_SUCCESS, int(code))
+
+
+def native_fastpath_info(h: int):
+    """(err, info_string) for the shim's C p2p fast path.
+
+    Non-empty only for multi-process comms whose p2p plane is the C
+    matching engine (native transport + the default ``eager`` pml);
+    the shim then drives MPI_Send/Recv straight into libtpudcn — no
+    embedded-Python crossing on the hot path.  Encoding: fields
+    ``engine_ptr, cid, my_rank, nranks, offsets_csv, addresses``
+    joined with ``\\x1f`` (addresses joined with ``\\x1e`` — the
+    composite transport addresses contain ``|`` and ``;``, so those
+    are not usable as separators; offsets = the comm's rank→process
+    boundaries)."""
+    try:
+        c = _comm(h)
+        if not getattr(c, "_pml_native", False):
+            return (MPI_SUCCESS, "")
+        root = c.dcn._native_root()
+        c.pml  # force native pml construction (keeps one engine owner)
+        # \x1f (unit sep) between fields, \x1e between addresses — the
+        # composite transport addresses themselves contain '|' and ';'
+        info = "\x1f".join([
+            str(int(root._h)),
+            str(c.cid),
+            str(int(getattr(c, "local_offset", 0))),
+            str(int(c.size)),
+            ",".join(str(int(o)) for o in c.offsets),
+            "\x1e".join(c.dcn.addresses),
+        ])
+        return (MPI_SUCCESS, info)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), "")
+
+
 def comm_dup(h: int):
     try:
         nh = _store_comm(_comm(h).dup(), h)

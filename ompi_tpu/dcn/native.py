@@ -102,6 +102,10 @@ def load_library():
         lib.tdcn_send_local.restype = I
         lib.tdcn_send_local.argtypes = [P, I, S, I64, I, I, I, U64, I64,
                                         U64]
+        lib.tdcn_send_local_data.restype = I
+        lib.tdcn_send_local_data.argtypes = [
+            P, I, S, I64, I, I, I, S, I,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, U64]
         lib.tdcn_recv_coll.restype = I
         lib.tdcn_recv_coll.argtypes = [P, S, I64, I, I, D, MSG]
         lib.tdcn_post_recv.restype = U64
@@ -398,8 +402,15 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
 
     @staticmethod
     def _host_id() -> str:
+        import os as _os
         import socket as _socket
 
+        # test/dev override: distinct ids force the framed-TCP leg
+        # (eager + RTS/CTS/FRAG rendezvous) between same-host peers —
+        # the only way CI can exercise the cross-host path
+        override = _os.environ.get("TDCN_HOST_ID")
+        if override:
+            return override
         hid = _socket.gethostname()
         try:
             with open("/proc/sys/kernel/random/boot_id") as f:
@@ -497,16 +508,30 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
 
     def local_send(self, cid, src: int, dst: int, tag: int,
                    payload, count: int, nbytes: int) -> None:
-        with self._hlock:
-            h = next(self._hnext)
-            self._handles[h] = payload
-        rc = self._lib.tdcn_send_local(
-            self._h, FK_P2P, str(cid).encode(), 0, src, dst, tag, h,
-            count, nbytes)
-        if rc != 0:  # pragma: no cover — local enqueue cannot fail
+        if (isinstance(payload, np.ndarray) and payload.ndim <= 8
+                and not payload.dtype.hasobject):
+            # bytes form: the C memcpy IS the buffered-eager copy, and
+            # the message stays consumable by the shim's C fast path
+            # (pyhandle messages can only be taken Python-side)
+            arr = np.ascontiguousarray(payload)
+            shape = (ctypes.c_int64 * max(arr.ndim, 1))(
+                *(arr.shape or (0,)))
+            rc = self._lib.tdcn_send_local_data(
+                self._h, FK_P2P, str(cid).encode(), 0, src, dst, tag,
+                _dt_bytes(arr.dtype), arr.ndim, shape,
+                arr.ctypes.data if arr.nbytes else None, arr.nbytes)
+        else:  # device arrays / objects: Python-side handle reference
             with self._hlock:
-                self._handles.pop(h, None)
-            raise MPIInternalError("tdcn_send_local failed")
+                h = next(self._hnext)
+                self._handles[h] = payload
+            rc = self._lib.tdcn_send_local(
+                self._h, FK_P2P, str(cid).encode(), 0, src, dst, tag, h,
+                count, nbytes)
+            if rc != 0:
+                with self._hlock:
+                    self._handles.pop(h, None)
+        if rc != 0:  # pragma: no cover — local enqueue cannot fail
+            raise MPIInternalError("tdcn local send failed")
 
     def take_handle(self, h: int):
         with self._hlock:

@@ -141,7 +141,13 @@ class NativeMatchingEngine:
         if _account and spc.attached():
             spc.inc("send")
             spc.inc("send_bytes", spc.payload_nbytes(payload))
-        data = _copy_payload(payload, dest_device)
+        if isinstance(payload, np.ndarray):
+            # the engine's local data path memcpys into C — that IS the
+            # buffered-eager copy; a Python-side copy first would be a
+            # second one
+            data = payload
+        else:
+            data = _copy_payload(payload, dest_device)
         self._root.local_send(self._cid, source, dest, tag, data,
                               _count_of(data), _nbytes_of(data))
 

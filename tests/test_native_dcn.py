@@ -262,3 +262,64 @@ def test_native_latency_beats_python_floor():
     # python transport pays two Python thread handoffs per message)
     assert r["native_us"] < r["python_us"], r
     assert r["native_us"] < 60.0, r  # sanity ceiling, generous for CI
+
+
+def test_tcp_leg_eager_and_rendezvous():
+    """The framed-TCP leg (cross-host path): distinct TDCN_HOST_IDs
+    force same-host peers onto sockets, exercising eager frames AND
+    the RTS/CTS/FRAG rendezvous (payload > eager_limit) that the ring
+    path never runs.  Bytes must survive both regimes."""
+    native = _native()
+    import os
+
+    a_env, b_env = "hostA", "hostB"
+    olds = os.environ.get("TDCN_HOST_ID")
+    try:
+        os.environ["TDCN_HOST_ID"] = a_env
+        a = native.NativeDcnEngine(0, 2, eager_limit=1 << 16)
+        os.environ["TDCN_HOST_ID"] = b_env
+        b = native.NativeDcnEngine(1, 2, eager_limit=1 << 16)
+    finally:
+        if olds is None:
+            os.environ.pop("TDCN_HOST_ID", None)
+        else:
+            os.environ["TDCN_HOST_ID"] = olds
+    try:
+        addrs = [a.address, b.address]
+        a.set_addresses(addrs)
+        b.set_addresses(addrs)
+        # eager regime (<= 64 KiB limit)
+        small = np.arange(1024, dtype=np.int32)
+        a._send(1, "tcp1", 0, small)
+        _, got = b._recv_full(0, "tcp1", 0)
+        assert np.array_equal(got, small)
+        # rendezvous regime: 8 MiB > 64 KiB eager limit -> RTS/CTS/FRAG
+        rng = np.random.default_rng(3)
+        big = rng.integers(0, 255, size=8 << 20, dtype=np.uint8)
+        import threading
+
+        out = {}
+
+        def rx():
+            _, arr = b._recv_full(0, "tcp2", 0, timeout=60.0)
+            out["x"] = arr
+
+        t = threading.Thread(target=rx)
+        t.start()
+        a._send(1, "tcp2", 0, big)
+        t.join(60)
+        assert np.array_equal(out["x"], big)
+        # p2p matching over the tcp leg too
+        a.register_native_p2p(55)
+        b.register_native_p2p(55)
+        from ompi_tpu.p2p.pml_native import NativeMatchingEngine
+
+        mb = NativeMatchingEngine(b, 55, 2)
+        a.send_p2p(1, {"cid": 55, "src": 0, "dst": 1, "tag": 4},
+                   np.full(3, 9.0))
+        payload, st = mb.recv_blocking(1, 0, 4)
+        assert np.array_equal(payload, np.full(3, 9.0))
+        assert st.nbytes == 24
+    finally:
+        a.close()
+        b.close()
