@@ -112,6 +112,21 @@ int main(void) {
     CHECK(shmem_int_atomic_fetch(fcell, 0) == 2 * n, "deprecated_fadd");
   }
 
+  { /* signaled put (1.5): data visible before the signal fires */
+    long *box = (long *)shmem_calloc(4, sizeof(long));
+    uint64_t *sig = (uint64_t *)shmem_calloc(1, sizeof(uint64_t));
+    shmem_barrier_all();
+    int right = (me + 1) % n;
+    long payload[4] = {me, me + 1, me + 2, me + 3};
+    shmem_putmem_signal(box, payload, sizeof payload, sig, 1,
+                        SHMEM_SIGNAL_ADD, right);
+    shmem_signal_wait_until(sig, SHMEM_CMP_GE, 1);
+    int left = (me - 1 + n) % n;
+    CHECK(box[0] == left && box[3] == left + 3, "putmem_signal_data");
+    CHECK(shmem_signal_fetch(sig) == 1, "signal_fetch");
+    shmem_barrier_all();
+  }
+
   { /* wait_until: PE 0 releases everyone */
     int *flag = (int *)shmem_calloc(1, sizeof(int));
     shmem_barrier_all();

@@ -111,6 +111,27 @@ int shmem_int_cswap(int *dest, int cond, int value, int pe);
 int shmem_int_swap(int *dest, int value, int pe);
 long shmem_long_fadd(long *dest, long value, int pe);
 
+/* signaled puts (OpenSHMEM 1.5): data put + remote signal update in
+ * one call, the producer/consumer overlap primitive */
+#define SHMEM_SIGNAL_SET 0
+#define SHMEM_SIGNAL_ADD 1
+void shmem_putmem_signal(void *dest, const void *source, size_t nelems,
+                         uint64_t *sig_addr, uint64_t signal, int sig_op,
+                         int pe);
+uint64_t shmem_signal_fetch(const uint64_t *sig_addr);
+/* uint64 atomics (standard typed family, also backing the signals) */
+uint64_t shmem_uint64_atomic_fetch(const uint64_t *source, int pe);
+void shmem_uint64_atomic_set(uint64_t *dest, uint64_t value, int pe);
+uint64_t shmem_uint64_atomic_fetch_add(uint64_t *dest, uint64_t value,
+                                       int pe);
+void shmem_uint64_atomic_add(uint64_t *dest, uint64_t value, int pe);
+uint64_t shmem_uint64_atomic_swap(uint64_t *dest, uint64_t value, int pe);
+uint64_t shmem_uint64_atomic_compare_swap(uint64_t *dest, uint64_t cond,
+                                          uint64_t value, int pe);
+void shmem_uint64_wait_until(uint64_t *ivar, int cmp, uint64_t value);
+void shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
+                             uint64_t cmp_value);
+
 /* point synchronization */
 #define SHMEM_CMP_EQ 0
 #define SHMEM_CMP_NE 1

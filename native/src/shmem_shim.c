@@ -26,6 +26,8 @@
 #include <stdlib.h>
 #include <string.h>
 
+#include <time.h>
+
 #include "mpi.h"
 #include "shmem.h"
 
@@ -363,9 +365,38 @@ long shmem_long_fadd(long *d, long v, int pe) {
     }                                                                     \
   }
 
-#include <time.h>
 WAIT_UNTIL(int, int)
 WAIT_UNTIL(long, long)
+
+/* ---- signaled puts (OpenSHMEM 1.5) --------------------------------- */
+/* the uint64 signal cell reuses the generic atomic/wait machinery */
+
+typedef uint64_t tpushmem_u64;
+ATOMICS(uint64, tpushmem_u64, MPI_UINT64_T)  /* standard names */
+WAIT_UNTIL(uint64, tpushmem_u64)
+
+void shmem_putmem_signal(void *dest, const void *source, size_t nelems,
+                         uint64_t *sig_addr, uint64_t signal, int sig_op,
+                         int pe) {
+  /* ordering contract: the signal must not become visible before the
+   * data — put_bytes flushes the data put before the signal op */
+  if (sig_op != SHMEM_SIGNAL_SET && sig_op != SHMEM_SIGNAL_ADD)
+    die("bad shmem_putmem_signal sig_op");
+  put_bytes(dest, source, nelems, pe);
+  if (sig_op == SHMEM_SIGNAL_ADD)
+    (void)shmem_uint64_atomic_fetch_add(sig_addr, signal, pe);
+  else
+    shmem_uint64_atomic_set(sig_addr, signal, pe);
+}
+
+uint64_t shmem_signal_fetch(const uint64_t *sig_addr) {
+  return shmem_uint64_atomic_fetch(sig_addr, g_pe);
+}
+
+void shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
+                             uint64_t cmp_value) {
+  shmem_uint64_wait_until(sig_addr, cmp, cmp_value);
+}
 
 /* ---- collectives --------------------------------------------------- */
 
