@@ -1,4 +1,5 @@
-/* shmem.h — OpenSHMEM 1.4 core subset over the TPU MPI framework.
+/* shmem.h — OpenSHMEM core subset (1.4 surface + the 1.5 signaled
+ * puts, hence version 1.5) over the TPU MPI framework.
  *
  * ≈ the reference's oshmem/include/shmem.h (SURVEY.md §2.5: liboshmem
  * exports 838 shmem_* symbols layered over ompi).  This build layers
@@ -19,7 +20,7 @@ extern "C" {
 #endif
 
 #define SHMEM_MAJOR_VERSION 1
-#define SHMEM_MINOR_VERSION 4
+#define SHMEM_MINOR_VERSION 5
 #define SHMEM_VENDOR_STRING "ompi_tpu"
 #define SHMEM_MAX_NAME_LEN 64
 
@@ -125,12 +126,14 @@ void shmem_uint64_atomic_set(uint64_t *dest, uint64_t value, int pe);
 uint64_t shmem_uint64_atomic_fetch_add(uint64_t *dest, uint64_t value,
                                        int pe);
 void shmem_uint64_atomic_add(uint64_t *dest, uint64_t value, int pe);
+uint64_t shmem_uint64_atomic_fetch_inc(uint64_t *dest, int pe);
+void shmem_uint64_atomic_inc(uint64_t *dest, int pe);
 uint64_t shmem_uint64_atomic_swap(uint64_t *dest, uint64_t value, int pe);
 uint64_t shmem_uint64_atomic_compare_swap(uint64_t *dest, uint64_t cond,
                                           uint64_t value, int pe);
 void shmem_uint64_wait_until(uint64_t *ivar, int cmp, uint64_t value);
-void shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
-                             uint64_t cmp_value);
+uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
+                                 uint64_t cmp_value);
 
 /* point synchronization */
 #define SHMEM_CMP_EQ 0

@@ -393,9 +393,28 @@ uint64_t shmem_signal_fetch(const uint64_t *sig_addr) {
   return shmem_uint64_atomic_fetch(sig_addr, g_pe);
 }
 
-void shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
-                             uint64_t cmp_value) {
-  shmem_uint64_wait_until(sig_addr, cmp, cmp_value);
+uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
+                                 uint64_t cmp_value) {
+  /* 1.5 contract: returns the sig_addr contents that SATISFIED the
+   * wait (a later fetch could see further updates, so the loop is
+   * explicit rather than reusing the void-returning wait macro) */
+  heap_off(sig_addr, "signal_wait_until");
+  for (;;) {
+    uint64_t cur = shmem_uint64_atomic_fetch(sig_addr, g_pe);
+    int ok = 0;
+    switch (cmp) {
+      case SHMEM_CMP_EQ: ok = cur == cmp_value; break;
+      case SHMEM_CMP_NE: ok = cur != cmp_value; break;
+      case SHMEM_CMP_GT: ok = cur > cmp_value; break;
+      case SHMEM_CMP_LE: ok = cur <= cmp_value; break;
+      case SHMEM_CMP_LT: ok = cur < cmp_value; break;
+      case SHMEM_CMP_GE: ok = cur >= cmp_value; break;
+      default: die("bad shmem_signal_wait_until comparator");
+    }
+    if (ok) return cur;
+    struct timespec ts = {0, 200000};
+    nanosleep(&ts, NULL);
+  }
 }
 
 /* ---- collectives --------------------------------------------------- */
