@@ -176,6 +176,30 @@ int main(void) {
     CHECK(isum[0] == n && isum[1] == 10 * n, "int_max_to_all");
   }
 
+  { /* teams (1.5 query subset): world identity, strided split,
+       cross-team PE translation */
+    CHECK(shmem_team_my_pe(SHMEM_TEAM_WORLD) == me &&
+              shmem_team_n_pes(SHMEM_TEAM_WORLD) == n,
+          "team_world_identity");
+    shmem_team_t evens;
+    int esize = (n + 1) / 2;
+    int rc = shmem_team_split_strided(SHMEM_TEAM_WORLD, 0, 2, esize,
+                                      NULL, 0, &evens);
+    CHECK(rc == 0, "team_split");
+    if (me % 2 == 0) { /* members get a handle... */
+      CHECK(evens != SHMEM_TEAM_INVALID, "team_member_handle");
+      CHECK(shmem_team_my_pe(evens) == me / 2, "team_member_index");
+      CHECK(shmem_team_translate_pe(evens, 0, SHMEM_TEAM_WORLD) == 0,
+            "team_translate");
+      if (esize > 1)
+        CHECK(shmem_team_translate_pe(evens, 1, SHMEM_TEAM_WORLD) == 2,
+              "team_translate_stride");
+      shmem_team_destroy(evens);
+    } else { /* ...nonmembers participate and get INVALID (1.5) */
+      CHECK(evens == SHMEM_TEAM_INVALID, "team_nonmember_invalid");
+    }
+  }
+
   shmem_barrier_all();
   if (me == 0) printf("SHMEM SUITE COMPLETE\n");
   shmem_finalize();

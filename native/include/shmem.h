@@ -145,6 +145,23 @@ uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
 void shmem_int_wait_until(int *ivar, int cmp, int value);
 void shmem_long_wait_until(long *ivar, int cmp, long value);
 
+/* teams (1.5 subset: descriptors + PE queries/translation; team
+ * COLLECTIVES are not provided — world active sets only) */
+typedef int shmem_team_t;
+#define SHMEM_TEAM_INVALID ((shmem_team_t)-1)
+#define SHMEM_TEAM_WORLD ((shmem_team_t)0)
+typedef struct {
+  int num_contexts;
+} shmem_team_config_t;
+int shmem_team_my_pe(shmem_team_t team);
+int shmem_team_n_pes(shmem_team_t team);
+int shmem_team_translate_pe(shmem_team_t src_team, int src_pe,
+                            shmem_team_t dest_team);
+int shmem_team_split_strided(shmem_team_t parent, int start, int stride,
+                             int size, const shmem_team_config_t *config,
+                             long config_mask, shmem_team_t *new_team);
+void shmem_team_destroy(shmem_team_t team);
+
 /* collectives (active-set-free world forms) */
 void shmem_broadcast32(void *dest, const void *source, size_t nelems,
                        int PE_root, int PE_start, int logPE_stride,

@@ -417,6 +417,93 @@ uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
   }
 }
 
+/* ---- teams (1.5 subset) ---------------------------------------------
+ * Descriptors + membership queries + PE translation over (start,
+ * stride, size) triples.  Team COLLECTIVES are not provided (the
+ * scoll layer here serves world active sets only — rejected loudly),
+ * which covers the common porting uses: rank arithmetic and
+ * addressing a strided subset with ordinary put/get/atomics. */
+
+typedef struct {
+  int used, start, stride, size;
+} tpushmem_team;
+
+#define TEAM_MAX 64
+static tpushmem_team g_teams[TEAM_MAX]; /* slot 0 = SHMEM_TEAM_WORLD */
+
+static tpushmem_team *team_of(shmem_team_t t) {
+  if (t == SHMEM_TEAM_WORLD) {
+    g_teams[0].used = 1;
+    g_teams[0].start = 0;
+    g_teams[0].stride = 1;
+    g_teams[0].size = g_npes;
+    return &g_teams[0];
+  }
+  if (t <= 0 || t >= TEAM_MAX || !g_teams[t].used) return NULL;
+  return &g_teams[t];
+}
+
+int shmem_team_my_pe(shmem_team_t team) {
+  tpushmem_team *tm = team_of(team);
+  if (!tm) return -1;
+  int off = g_pe - tm->start;
+  if (off < 0 || off % tm->stride || off / tm->stride >= tm->size)
+    return -1; /* not a member */
+  return off / tm->stride;
+}
+
+int shmem_team_n_pes(shmem_team_t team) {
+  tpushmem_team *tm = team_of(team);
+  return tm ? tm->size : -1;
+}
+
+int shmem_team_translate_pe(shmem_team_t src_team, int src_pe,
+                            shmem_team_t dest_team) {
+  tpushmem_team *s = team_of(src_team), *d = team_of(dest_team);
+  if (!s || !d || src_pe < 0 || src_pe >= s->size) return -1;
+  int world = s->start + src_pe * s->stride;
+  int off = world - d->start;
+  if (off < 0 || off % d->stride || off / d->stride >= d->size) return -1;
+  return off / d->stride;
+}
+
+int shmem_team_split_strided(shmem_team_t parent, int start, int stride,
+                             int size, const shmem_team_config_t *config,
+                             long config_mask, shmem_team_t *new_team) {
+  /* Pure local bookkeeping — descriptor arithmetic is SPMD-identical
+   * on every parent PE, so no synchronization is required (collective
+   * semantics hold without a barrier; a world barrier here would
+   * deadlock splits of non-world parents).  Per 1.5, NONMEMBER parent
+   * PEs participate and receive SHMEM_TEAM_INVALID. */
+  (void)config;
+  (void)config_mask;
+  if (new_team) *new_team = SHMEM_TEAM_INVALID;
+  tpushmem_team *p = team_of(parent);
+  if (!p || size < 1 || stride < 1 || start < 0 ||
+      start + (size - 1) * stride >= p->size)
+    return -1;
+  int wstart = p->start + start * p->stride;
+  int wstride = p->stride * stride;
+  int off = g_pe - wstart;
+  if (off < 0 || off % wstride || off / wstride >= size)
+    return 0; /* not a member: INVALID handle, successful call */
+  for (int i = 1; i < TEAM_MAX; i++) {
+    if (!g_teams[i].used) {
+      g_teams[i].used = 1;
+      g_teams[i].start = wstart;
+      g_teams[i].stride = wstride;
+      g_teams[i].size = size;
+      if (new_team) *new_team = (shmem_team_t)i;
+      return 0;
+    }
+  }
+  return -1; /* local table full */
+}
+
+void shmem_team_destroy(shmem_team_t team) {
+  if (team > 0 && team < TEAM_MAX) g_teams[team].used = 0;
+}
+
 /* ---- collectives --------------------------------------------------- */
 
 static void check_world(int PE_start, int logPE_stride, int PE_size,
