@@ -1,0 +1,139 @@
+/* Conformance/soak suite #4 — the fast-path communicator table:
+ *   (a) 200-communicator churn (dup → message → free) keeps the C
+ *       fast path active forever (no 64-slot exhaustion) and leaks
+ *       neither slots nor requests;
+ *   (b) >64 SIMULTANEOUSLY live communicators all carry messages
+ *       (the old fixed table silently dropped comm #65 to the slow
+ *       path);
+ *   (c) MPI 3.7.3 freed-comm semantics on the fast path: a
+ *       communicator freed with a pending receive still completes
+ *       that receive into the user buffer later (the round-4 advisor
+ *       scenario: fp_forget must not tear down wiring that
+ *       outstanding requests reference).
+ * Runs at np == 2.
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int rank, size;
+
+#define CHECK(cond, name)                                       \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      fprintf(stderr, "FAIL %s rank=%d\n", name, rank);         \
+      MPI_Abort(MPI_COMM_WORLD, 2);                             \
+    } else {                                                    \
+      printf("OK %s rank=%d\n", name, rank);                    \
+    }                                                           \
+  } while (0)
+
+/* libtpumpi introspection hook (test-only): live fast-path comm slots
+ * and in-flight fast requests */
+extern void tpumpi_fp_stats(int *live, int *reqs);
+
+int main(int argc, char **argv) {
+  (void)argc;
+  (void)argv;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size != 2) {
+    if (rank == 0) fprintf(stderr, "c_suite4 requires np=2\n");
+    MPI_Abort(MPI_COMM_WORLD, 3);
+  }
+  int peer = 1 - rank;
+
+  /* -- (a) 200-comm churn soak -------------------------------------- */
+  {
+    int live0 = -1, reqs0 = -1;
+    tpumpi_fp_stats(&live0, &reqs0);
+    for (int i = 0; i < 200; i++) {
+      MPI_Comm c;
+      MPI_Comm_dup(MPI_COMM_WORLD, &c);
+      int v = 10000 + i, got = -1;
+      MPI_Status st;
+      if (rank == 0) {
+        MPI_Send(&v, 1, MPI_INT, peer, i, c);
+        MPI_Recv(&got, 1, MPI_INT, peer, i, c, &st);
+      } else {
+        MPI_Recv(&got, 1, MPI_INT, peer, i, c, &st);
+        MPI_Send(&v, 1, MPI_INT, peer, i, c);
+      }
+      if (got != 10000 + i) {
+        fprintf(stderr, "FAIL churn payload i=%d got=%d\n", i, got);
+        MPI_Abort(MPI_COMM_WORLD, 4);
+      }
+      MPI_Comm_free(&c);
+    }
+    int live1 = -1, reqs1 = -1;
+    tpumpi_fp_stats(&live1, &reqs1);
+    /* every churned comm's slot reclaimed; no request leak */
+    CHECK(live1 <= live0 + 2 && reqs1 == 0, "fp_churn_200_no_leak");
+  }
+
+  /* -- (b) 100 simultaneously-live comms, all fast-pathed ----------- */
+  {
+    enum { N = 100 };
+    MPI_Comm cs[N];
+    for (int i = 0; i < N; i++) MPI_Comm_dup(MPI_COMM_WORLD, &cs[i]);
+    int ok = 1;
+    for (int i = 0; i < N; i++) {
+      int v = 500 + i, got = -1;
+      MPI_Status st;
+      if (rank == 0) {
+        MPI_Send(&v, 1, MPI_INT, peer, 7, cs[i]);
+        MPI_Recv(&got, 1, MPI_INT, peer, 7, cs[i], &st);
+      } else {
+        MPI_Recv(&got, 1, MPI_INT, peer, 7, cs[i], &st);
+        MPI_Send(&v, 1, MPI_INT, peer, 7, cs[i]);
+      }
+      if (got != 500 + i) ok = 0;
+    }
+    int live = -1;
+    tpumpi_fp_stats(&live, NULL);
+    /* all 100 concurrently wired (world + dups); the old FP_MAX=64
+     * table could hold at most 64 */
+    CHECK(ok && live >= N, "fp_100_simultaneous_comms");
+    for (int i = 0; i < N; i++) MPI_Comm_free(&cs[i]);
+  }
+
+  /* -- (c) freed comm completes its pending receive ------------------ */
+  {
+    MPI_Comm c;
+    MPI_Comm_dup(MPI_COMM_WORLD, &c);
+    double payload[64];
+    for (int i = 0; i < 64; i++) payload[i] = rank == 0 ? 1.5 * i : -1.0;
+    if (rank == 1) {
+      MPI_Request r;
+      MPI_Irecv(payload, 64, MPI_DOUBLE, 0, 42, c, &r);
+      MPI_Comm_free(&c); /* legal: pending op completes later */
+      int token = 1;
+      MPI_Send(&token, 1, MPI_INT, 0, 43, MPI_COMM_WORLD);
+      MPI_Status st;
+      MPI_Wait(&r, &st); /* advisor scenario: must not crash */
+      int good = 1;
+      for (int i = 0; i < 64; i++)
+        if (payload[i] != 1.5 * i) good = 0;
+      CHECK(good && st.MPI_SOURCE == 0 && st.MPI_TAG == 42,
+            "freed_comm_pending_recv_completes");
+    } else {
+      int token = 0;
+      MPI_Status st;
+      MPI_Recv(&token, 1, MPI_INT, 1, 43, MPI_COMM_WORLD, &st);
+      MPI_Send(payload, 64, MPI_DOUBLE, 1, 42, c);
+      MPI_Comm_free(&c);
+      CHECK(token == 1, "freed_comm_pending_recv_sender");
+    }
+    int reqs = -1;
+    MPI_Barrier(MPI_COMM_WORLD);
+    tpumpi_fp_stats(NULL, &reqs);
+    CHECK(reqs == 0, "freed_comm_no_request_leak");
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("SUITE4 COMPLETE\n");
+  MPI_Finalize();
+  return 0;
+}

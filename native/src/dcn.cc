@@ -279,7 +279,15 @@ struct ShmRing {
       uint64_t contig = size - pos;
       uint64_t want = need;
       bool pad = false;
-      if (contig < need) {  // must pad to ring start first
+      if (pos >= need &&
+          head == ctrl->tail.load(std::memory_order_acquire)) {
+        // ring is EMPTY: rebase to offset 0 via a PAD record so
+        // steady-state request/reply traffic reuses the same (cache-
+        // and TLB-warm) pages instead of marching cold through the
+        // whole segment once per lap
+        want = contig + need;
+        pad = true;
+      } else if (contig < need) {  // must pad to ring start first
         want = contig + need;
         pad = true;
       }
@@ -401,10 +409,21 @@ struct CidQueues {
   // keyed per destination rank
   std::unordered_map<int32_t, std::deque<OwnedMsg>> unexpected;
   std::unordered_map<int32_t, std::vector<PostedReq>> posted;
+  // comm freed with receives still pending (MPI 3.7.3: they must
+  // complete later): new unmatched arrivals are dropped, and the cid
+  // is reclaimed when the last posted entry matches
+  bool draining = false;
+
+  bool posted_empty() const {
+    for (auto &kv : posted)
+      if (!kv.second.empty()) return false;
+    return true;
+  }
 };
 
 struct CollSlot {
   std::atomic<bool> ready{false};
+  bool consumed = false;  // a waiter took the message (one-shot)
   OwnedMsg msg;
   std::condition_variable cv;
   int waiters = 0;
@@ -611,15 +630,22 @@ static void deliver_locked(Engine *eng, OwnedMsg &&m) {
         if (env_match(plist[i], m)) {
           uint64_t rid = plist[i].id;
           plist.erase(plist.begin() + i);
+          bool reclaim = q.draining && q.posted_empty();
+          std::string ckey = m.env.cid;  // m is moved below
           auto rit = eng->reqs.find(rid);
           if (rit != eng->reqs.end()) {
             rit->second->msg = std::move(m);
             rit->second->completed = true;
             rit->second->cv.notify_all();
           }
+          if (reclaim) eng->p2p.erase(ckey);
           wake_waiters(eng);
           return;
         }
+      }
+      if (q.draining) {
+        free(m.data);  // freed comm, no matching pending recv: drop
+        return;
       }
       q.unexpected[m.env.dst].push_back(std::move(m));
       return;
@@ -864,11 +890,13 @@ static void consume_ring(Engine *eng, ShmRing *r) {
 // thread and inline-progress waiters).  Returns true when any record
 // was consumed.
 static bool try_consume_rings(Engine *eng) {
+  if (eng->closing.load(std::memory_order_relaxed)) return false;
   if (!eng->consume_mu.try_lock()) return false;
   bool any = false;
   {
     std::lock_guard<std::mutex> g(eng->rings_mu);
     for (ShmRing *r : eng->rx_rings) {
+      if (!r->ctrl) continue;  // destroyed under rings_mu by close
       if (r->ctrl->head.load(std::memory_order_acquire) !=
           r->ctrl->tail.load(std::memory_order_relaxed)) {
         consume_ring(eng, r);
@@ -895,12 +923,18 @@ static bool progress_wait(Engine *eng, std::unique_lock<std::mutex> &g,
     ~WaiterMark() { e->waiters.fetch_sub(1); }
   } mark(eng);
   while (!done()) {
+    // Load the doorbell BEFORE dropping the lock and checking the
+    // rings: any completion or ring publish that lands after this
+    // load bumps the word, so the futex_wait below returns
+    // immediately instead of stalling out its full timeout (the
+    // lost-wakeup ordering: record seen -> check state -> wait(seen)).
+    uint32_t seen = eng->my_db.word->load(std::memory_order_acquire);
     g.unlock();
     bool consumed = try_consume_rings(eng);
     if (!consumed) {
-      uint32_t seen = eng->my_db.word->load(std::memory_order_acquire);
-      bool changed = false;
-      for (int i = 0; i < eng->spin_iters; i++) {
+      bool changed =
+          eng->my_db.word->load(std::memory_order_acquire) != seen;
+      for (int i = 0; !changed && i < eng->spin_iters; i++) {
         if (eng->my_db.word->load(std::memory_order_acquire) != seen) {
           changed = true;
           break;
@@ -1126,7 +1160,13 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
     // the flow control) announcing the transfer, then FRAG records.
     // h.seq carries the reassembly xid; the TRUE envelope seq rides in
     // h.off of the RTS (restored receiver-side).
+    // chunk must FIT the ring (reserve can never satisfy want > size):
+    // cap at half the ring minus record overhead so two chunks can be
+    // in flight and a PAD record always has room
     uint64_t chunk = 4ull << 20;
+    uint64_t cap = eng->ring_bytes / 2 > 4096 ? eng->ring_bytes / 2 - 4096
+                                              : 512;
+    if (chunk > cap) chunk = cap;
     int64_t xid = (int64_t)(now_ns() ^ ((uint64_t)eng->proc << 56));
     Env rts_env = e;
     rts_env.seq = xid;
@@ -1406,20 +1446,27 @@ int tdcn_recv_coll(void *h, const char *cid, int64_t seq, int src,
                           },
                           timeout_s);
   slot->waiters--;
-  if (!ok || !slot->ready) {
-    int rc = 1;  // timeout
+  if (!ok || !slot->ready.load() || slot->consumed) {
+    int rc = 1;  // timeout (or another waiter consumed the one-shot)
     if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
     else if (peer_failed())
       rc = -2;  // peer failed
-    if (slot->waiters == 0 && !slot->ready) {
-      eng->coll.erase(key);
-      delete slot;
+    if (slot->waiters == 0) {
+      // last one out reclaims; a ready-but-unconsumed slot stays
+      // registered for a later recv on the same key
+      if (slot->consumed) {
+        delete slot;  // key already erased by the consumer
+      } else if (!slot->ready.load()) {
+        eng->coll.erase(key);
+        delete slot;
+      }
     }
     return rc;
   }
   msg_into_tdcn(slot->msg, out);
+  slot->consumed = true;
   eng->coll.erase(key);
-  delete slot;
+  if (slot->waiters == 0) delete slot;
   return 0;
 }
 
@@ -1508,8 +1555,8 @@ int tdcn_req_cancel(void *h, uint64_t rid) {
   if (it == eng->reqs.end()) return -1;
   if (it->second->completed) return 1;  // too late
   // remove from every posted list it may sit in
-  for (auto &kv : eng->p2p) {
-    for (auto &pl : kv.second.posted) {
+  for (auto qit = eng->p2p.begin(); qit != eng->p2p.end();) {
+    for (auto &pl : qit->second.posted) {
       auto &v = pl.second;
       for (size_t i = 0; i < v.size(); i++) {
         if (v[i].id == rid) {
@@ -1518,6 +1565,10 @@ int tdcn_req_cancel(void *h, uint64_t rid) {
         }
       }
     }
+    if (qit->second.draining && qit->second.posted_empty())
+      qit = eng->p2p.erase(qit);
+    else
+      ++qit;
   }
   delete it->second;
   eng->reqs.erase(it);
@@ -1587,7 +1638,15 @@ int tdcn_unregister_cid(void *h, const char *cid) {
   if (qit != eng->p2p.end()) {
     for (auto &kv : qit->second.unexpected)
       for (auto &m : kv.second) free(m.data);
-    eng->p2p.erase(qit);
+    qit->second.unexpected.clear();
+    if (qit->second.posted_empty()) {
+      eng->p2p.erase(qit);
+    } else {
+      // pending receives survive the free (MPI 3.7.3): drain mode —
+      // they complete when their messages arrive; the slot is
+      // reclaimed on the last match (deliver_locked)
+      qit->second.draining = true;
+    }
   }
   return 0;
 }
@@ -1614,9 +1673,11 @@ void tdcn_note_failed(void *h, int proc) {
   std::lock_guard<std::mutex> g(eng->mu);
   if (proc >= 0 && (size_t)proc < eng->failed.size())
     eng->failed[proc] = true;
-  // wake every waiter so failure-sensitive recvs re-check
+  // wake every waiter so failure-sensitive recvs re-check; inline-
+  // progress waiters sleep on the doorbell futex, not the cvs
   for (auto &kv : eng->coll) kv.second->cv.notify_all();
   for (auto &kv : eng->reqs) kv.second->cv.notify_all();
+  wake_waiters(eng);
 }
 
 // ---- channel fast path ----------------------------------------------
@@ -1799,8 +1860,14 @@ void tdcn_close(void *h) {
     }
   }
   {
+    // destroy AND drop the ring objects under rings_mu so a straggler
+    // try_consume_rings sees an empty vector, not dangling ShmRing*
     std::lock_guard<std::mutex> g(eng->rings_mu);
-    for (ShmRing *r : eng->rx_rings) r->destroy(true);
+    for (ShmRing *r : eng->rx_rings) {
+      r->destroy(true);
+      delete r;
+    }
+    eng->rx_rings.clear();
   }
   eng->my_db.destroy(true);
   // NOTE: the Engine object is intentionally leaked at close (detached

@@ -444,7 +444,8 @@ static const struct {
 
 typedef struct {
   int comm;
-  int state; /* 0 unknown, 1 active, -1 disabled */
+  int state; /* 0 unknown, 1 active, -1 disabled, 2 condemned (freed
+                comm with outstanding fast-path requests) */
   void *eng;
   char cid[64];
   int my_rank, nranks, nprocs, my_proc;
@@ -453,27 +454,69 @@ typedef struct {
   unsigned long long *chans; /* per proc, 0 = unopened */
 } tpumpi_fp;
 
-#define FP_MAX 64
-static tpumpi_fp g_fp[FP_MAX];
-static int g_fp_n = 0;
+/* Individually-malloc'd slots (outstanding requests hold tpumpi_fp*,
+ * so entries must never move) behind an open-addressed hash keyed by
+ * comm id: O(1) per-message lookup, no fixed comm cap, freed slots
+ * fully reclaimed — long-running comm-churn apps keep the fast path
+ * forever. */
+#define FP_HASH 1024 /* power of two; backstop cap = FP_HASH/2 live */
+#define FP_TOMB ((tpumpi_fp *)1)
+static tpumpi_fp *g_fph[FP_HASH];
+static int g_fp_live = 0;
+
+static unsigned fp_hash(int comm) {
+  return ((unsigned)comm * 2654435761u) & (FP_HASH - 1);
+}
+
+static tpumpi_fp *fp_lookup(int comm) {
+  for (unsigned h = fp_hash(comm), n = 0; n < FP_HASH;
+       h = (h + 1) & (FP_HASH - 1), n++) {
+    if (!g_fph[h]) return NULL;
+    if (g_fph[h] != FP_TOMB && g_fph[h]->comm == comm) return g_fph[h];
+  }
+  return NULL;
+}
+
+static void fp_index_insert(tpumpi_fp *fp) {
+  for (unsigned h = fp_hash(fp->comm), n = 0; n < FP_HASH;
+       h = (h + 1) & (FP_HASH - 1), n++) {
+    if (!g_fph[h] || g_fph[h] == FP_TOMB) {
+      g_fph[h] = fp;
+      return;
+    }
+  }
+}
+
+static void fp_index_remove(int comm) {
+  for (unsigned h = fp_hash(comm), n = 0; n < FP_HASH;
+       h = (h + 1) & (FP_HASH - 1), n++) {
+    if (!g_fph[h]) return;
+    if (g_fph[h] != FP_TOMB && g_fph[h]->comm == comm) {
+      g_fph[h] = FP_TOMB;
+      /* keep tombstones bounded under unbounded comm churn: a TOMB
+       * run that ends right before a NULL terminates no probe chain,
+       * so it can revert to NULL (walk backwards through the run) */
+      if (!g_fph[(h + 1) & (FP_HASH - 1)]) {
+        while (g_fph[h] == FP_TOMB) {
+          g_fph[h] = NULL;
+          h = (h - 1) & (FP_HASH - 1);
+        }
+      }
+      return;
+    }
+  }
+}
 
 static tpumpi_fp *fp_get(MPI_Comm comm) {
-  for (int i = 0; i < g_fp_n; i++)
-    if (g_fp[i].comm == (int)comm)
-      return g_fp[i].state == 1 ? &g_fp[i] : NULL;
-  tpumpi_fp *fp = NULL;
-  for (int i = 0; i < g_fp_n; i++)
-    if (g_fp[i].comm == -1) { /* slot freed by fp_forget */
-      fp = &g_fp[i];
-      break;
-    }
-  if (!fp) {
-    if (g_fp_n >= FP_MAX) return NULL;
-    fp = &g_fp[g_fp_n++];
-  }
-  memset(fp, 0, sizeof(*fp));
+  tpumpi_fp *fp = fp_lookup((int)comm);
+  if (fp) return fp->state == 1 ? fp : NULL;
+  if (g_fp_live >= FP_HASH / 2) return NULL; /* table pressure: slow path */
+  fp = (tpumpi_fp *)calloc(1, sizeof(*fp));
+  if (!fp) return NULL;
+  g_fp_live++;
   fp->comm = (int)comm;
   fp->state = -1;
+  fp_index_insert(fp);
   char info[4096];
   int len = 0;
   if (capi_call_str("native_fastpath_info", info, sizeof(info), &len,
@@ -529,30 +572,43 @@ static tpumpi_fp *fp_get(MPI_Comm comm) {
   return fp;
 }
 
-/* release a freed comm's fast-path wiring and compact the table so
- * long-running comm-churn apps never exhaust the 64 slots (each freed
- * comm's offsets/addresses/channels are reclaimed too) */
-static void fp_forget(int comm) {
-  for (int i = 0; i < g_fp_n; i++) {
-    if (g_fp[i].comm != comm) continue;
-    tpumpi_fp *fp = &g_fp[i];
-    if (fp->state == 1) {
-      for (int p = 0; p < fp->nprocs; p++) {
-        if (fp->chans && fp->chans[p])
-          tdcn_chan_close(fp->eng, fp->chans[p]);
-        if (fp->addrs && fp->addrs[p]) free(fp->addrs[p]);
-      }
+static int fp_live_refs(const tpumpi_fp *fp); /* scans g_fpreq, below */
+
+/* tear down one slot's wiring and free it (index entry already gone) */
+static void fp_release(tpumpi_fp *fp) {
+  if (fp->state == 1 || fp->state == 2) {
+    for (int p = 0; p < fp->nprocs; p++) {
+      if (fp->chans && fp->chans[p])
+        tdcn_chan_close(fp->eng, fp->chans[p]);
+      if (fp->addrs && fp->addrs[p]) free(fp->addrs[p]);
     }
-    free(fp->offsets);
-    free(fp->addrs);
-    free(fp->chans);
-    /* mark reusable IN PLACE: outstanding fast requests on OTHER
-     * comms hold tpumpi_fp pointers into this array — entries must
-     * never move */
-    memset(fp, 0, sizeof(*fp));
-    fp->comm = -1;
+  }
+  free(fp->offsets);
+  free(fp->addrs);
+  free(fp->chans);
+  free(fp);
+  g_fp_live--;
+}
+
+/* comm freed: drop it from the index immediately (a recycled comm id
+ * must re-resolve fresh wiring), but keep the slot alive while any
+ * outstanding fast-path request still points at it — MPI allows
+ * freeing a communicator with pending operations and completing them
+ * later, so the engine/channel handles those requests hold must stay
+ * valid until the last one completes (fp_req_done reclaims then). */
+static void fp_forget(int comm) {
+  tpumpi_fp *fp = fp_lookup(comm);
+  if (!fp) return;
+  fp_index_remove(comm);
+  if (fp->state == 1 && fp_live_refs(fp) > 0) {
+    /* condemned: reclaimed by the last completion.  fp->comm keeps
+     * the original id (the slot is out of the index, so it can't
+     * shadow a recycled id) — late errors on pending requests still
+     * route to the right errhandler via fp_error(comm). */
+    fp->state = 2;
     return;
   }
+  fp_release(fp);
 }
 
 static int fp_proc_of(const tpumpi_fp *fp, int rank) {
@@ -583,6 +639,34 @@ typedef struct {
 static fp_req_t g_fpreq[FP_REQ_MAX];
 static int g_fp_zombies = 0;
 
+static int fp_live_refs(const tpumpi_fp *fp) {
+  int n = 0;
+  for (int i = 0; i < FP_REQ_MAX; i++)
+    if (g_fpreq[i].used && g_fpreq[i].fp == fp) n++;
+  return n;
+}
+
+/* retire one fast request; reclaims a condemned comm slot when this
+ * was the last request referencing it */
+static void fp_req_done(fp_req_t *q) {
+  tpumpi_fp *fp = q->fp;
+  q->used = 0;
+  q->zombie = 0;
+  q->fp = NULL;
+  if (fp && fp->state == 2 && fp_live_refs(fp) == 0) fp_release(fp);
+}
+
+/* test hook: live/condemned slot counts (soak tests pin no-leak) */
+void tpumpi_fp_stats(int *live, int *reqs) {
+  if (live) *live = g_fp_live;
+  if (reqs) {
+    int n = 0;
+    for (int i = 0; i < FP_REQ_MAX; i++)
+      if (g_fpreq[i].used) n++;
+    *reqs = n;
+  }
+}
+
 static int fp_take(tdcn_msg_t *m, void *buf, long long cap,
                    MPI_Status *status);
 
@@ -596,8 +680,7 @@ static void fp_drain_zombies(void) {
     tdcn_msg_t m;
     if (tdcn_req_test(g_fpreq[i].fp->eng, g_fpreq[i].rid, &m) == 0) {
       fp_take(&m, g_fpreq[i].buf, g_fpreq[i].cap, NULL);
-      g_fpreq[i].used = 0;
-      g_fpreq[i].zombie = 0;
+      fp_req_done(&g_fpreq[i]);
       g_fp_zombies--;
     }
   }
@@ -813,7 +896,7 @@ static int fp_wait(MPI_Request *request, MPI_Status *status) {
       if (w == 0) break;
       if (w != 1) {
         int comm = q->fp->comm;
-        q->used = 0;
+        fp_req_done(q);
         *request = MPI_REQUEST_NULL;
         return fp_error(comm, MPI_ERR_OTHER);
       }
@@ -822,7 +905,7 @@ static int fp_wait(MPI_Request *request, MPI_Status *status) {
   }
   {
     int comm = q->fp->comm;
-    q->used = 0;
+    fp_req_done(q);
     *request = MPI_REQUEST_NULL;
     return rc == MPI_SUCCESS ? rc : fp_error(comm, rc);
   }
@@ -843,14 +926,14 @@ static int fp_test(MPI_Request *request, int *flag, MPI_Status *status) {
   *flag = 1;
   if (t != 0) {
     int comm = q->fp->comm;
-    q->used = 0;
+    fp_req_done(q);
     *request = MPI_REQUEST_NULL;
     return fp_error(comm, MPI_ERR_OTHER);
   }
   int rc = fp_take(&m, q->buf, q->cap, status);
   {
     int comm = q->fp->comm;
-    q->used = 0;
+    fp_req_done(q);
     *request = MPI_REQUEST_NULL;
     return rc == MPI_SUCCESS ? rc : fp_error(comm, rc);
   }
@@ -2012,7 +2095,7 @@ int PMPI_Request_free(MPI_Request *request) {
   if (fp_is_req(*request)) {
     fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
     if (q->is_send) {
-      q->used = 0; /* eager send: already complete */
+      fp_req_done(q); /* eager send: already complete */
     } else {
       /* MPI 3.7.3: a freed ACTIVE receive still completes into the
        * user buffer — drain now if done, else park as a zombie the
@@ -2020,7 +2103,7 @@ int PMPI_Request_free(MPI_Request *request) {
       tdcn_msg_t m;
       if (tdcn_req_test(q->fp->eng, q->rid, &m) == 0) {
         fp_take(&m, q->buf, q->cap, NULL);
-        q->used = 0;
+        fp_req_done(q);
       } else {
         q->zombie = 1;
         g_fp_zombies++;

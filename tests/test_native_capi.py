@@ -32,6 +32,7 @@ def native_bins():
         ("c_suite", "examples/c_suite.c"),
         ("c_suite2", "examples/c_suite2.c"),
         ("c_suite3", "examples/c_suite3.c"),
+        ("c_suite4", "examples/c_suite4.c"),
         ("hello_ring", "examples/hello_ring.c"),
         ("pmpi_counter", "examples/pmpi_counter.c"),
         ("osu_allreduce", "bench/osu_allreduce.c"),
@@ -175,6 +176,18 @@ def test_c_suite3_batch2_breadth(native_bins, nprocs):
     out = res.stdout.decode()
     assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
     assert sum("SUITE3 COMPLETE" in l for l in out.splitlines()) == 1
+    assert "FAIL" not in out
+
+
+def test_c_suite4_fp_table_soak(native_bins):
+    """Fast-path comm table (VERDICT r4 next #7 + ADVICE r4 #1):
+    200-comm churn with no slot/request leak, 100 simultaneously-live
+    fast-pathed comms (old fixed table capped at 64), and a freed comm
+    whose pending Irecv still completes into the user buffer."""
+    res = tpurun(2, native_bins["c_suite4"])
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert sum("SUITE4 COMPLETE" in l for l in out.splitlines()) == 1
     assert "FAIL" not in out
 
 

@@ -1,9 +1,11 @@
-"""tpurun np=2 worker: DCN hot-path measurements (VERDICT r2 item 5).
+"""tpurun np=2 worker: DCN hot-path measurements (VERDICT r2 item 5,
+methodology hardened per VERDICT r4 weak #6).
 
-Measures the Python DCN transport costs the driver-visible bench was
-missing: p2p ping-pong latency/bandwidth over the loopback DCN (the
-``btl/tcp`` analog) and han hierarchical allreduce latency at np=2.
-Proc 0 prints one line ``DCNBENCH {json}``.
+Measures p2p ping-pong latency/bandwidth and han hierarchical allreduce
+at np=2 for whichever btl the launcher selected.  Every row is a MEDIAN
+over per-iteration samples (plus p90), so one scheduler preemption on a
+1-core box cannot poison a row the way single-shot totals did in the
+round-4 artifact.  Proc 0 prints one line ``DCNBENCH {json}``.
 """
 
 import os
@@ -26,58 +28,70 @@ P2P_SIZES = [64, 65536, 1 << 20, 4 << 20]
 COLL_SIZES = [64, 65536, 1 << 20]
 
 
-def pingpong(nbytes: int, iters: int) -> float:
-    """Round-trip/2 latency in seconds (OSU osu_latency shape)."""
+def pingpong(nbytes: int, iters: int):
+    """Per-iteration round-trip samples (seconds), OSU osu_latency
+    shape; the caller reduces to median/2."""
     buf = np.zeros(nbytes, np.uint8)
-    me, peer = (0, world.size - 1) if p == 0 else (world.size - 1, 0)
-    # warmup
-    for _ in range(max(2, iters // 10)):
+    me, peer = (0, 1) if p == 0 else (1, 0)
+
+    def once():
         if p == 0:
             world.send(buf, source=me, dest=peer, tag=9)
             world.recv(dest=me, source=peer, tag=9)
         else:
             world.recv(dest=me, source=peer, tag=9)
             world.send(buf, source=me, dest=peer, tag=9)
-    t0 = time.perf_counter()
+
+    for _ in range(max(4, iters // 10)):
+        once()
+    ts = []
     for _ in range(iters):
-        if p == 0:
-            world.send(buf, source=me, dest=peer, tag=9)
-            world.recv(dest=me, source=peer, tag=9)
-        else:
-            world.recv(dest=me, source=peer, tag=9)
-            world.send(buf, source=me, dest=peer, tag=9)
-    dt = time.perf_counter() - t0
-    return dt / iters / 2.0
+        t0 = time.perf_counter()
+        once()
+        ts.append(time.perf_counter() - t0)
+    return np.asarray(ts)
 
 
-def coll_lat(nbytes: int, iters: int) -> float:
+def coll_samples(nbytes: int, iters: int):
     x = np.ones((world.local_size, max(1, nbytes // 4)), np.float32)
     for _ in range(max(2, iters // 10)):
         world.allreduce(x, SUM)
-    t0 = time.perf_counter()
+    ts = []
     for _ in range(iters):
+        t0 = time.perf_counter()
         world.allreduce(x, SUM)
-    return (time.perf_counter() - t0) / iters
+        ts.append(time.perf_counter() - t0)
+    return np.asarray(ts)
 
 
 rows = []
 for nb in P2P_SIZES:
-    iters = 200 if nb <= 65536 else 30
-    lat = pingpong(nb, iters)
+    iters = 150 if nb <= 65536 else 40
+    rt = pingpong(nb, iters)
+    med = float(np.median(rt)) / 2.0  # half round trip, OSU convention
+    p90 = float(np.percentile(rt, 90)) / 2.0
     rows.append({
         "bytes": nb,
-        "p2p_us": round(lat * 1e6, 2),
-        "p2p_MBs": round(nb / lat / 1e6, 1) if lat > 0 else 0.0,
+        "p2p_us": round(med * 1e6, 2),
+        "p2p_p90_us": round(p90 * 1e6, 2),
+        "p2p_MBs": round(nb / med / 1e6, 1) if med > 0 else 0.0,
+        "iters": iters,
     })
 
 crows = []
 for nb in COLL_SIZES:
-    iters = 50 if nb <= 65536 else 15
-    lat = coll_lat(nb, iters)
-    crows.append({"bytes": nb, "han_allreduce_us": round(lat * 1e6, 2)})
+    iters = 50 if nb <= 65536 else 20
+    ts = coll_samples(nb, iters)
+    crows.append({
+        "bytes": nb,
+        "han_allreduce_us": round(float(np.median(ts)) * 1e6, 2),
+        "han_allreduce_p90_us": round(float(np.percentile(ts, 90)) * 1e6, 2),
+    })
 
 if p == 0:
     import json
 
-    print("DCNBENCH " + json.dumps({"p2p": rows, "han": crows}), flush=True)
+    print("DCNBENCH " + json.dumps(
+        {"p2p": rows, "han": crows, "estimator": "median-of-iterations"}),
+        flush=True)
 api.finalize()

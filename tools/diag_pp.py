@@ -1,0 +1,49 @@
+"""Diagnostic np=2 ping-pong: per-iteration latency distribution at one
+size (TPDIAG_BYTES, default 1 MiB) — bimodality at +2 ms multiples
+means doorbell wakeups are being missed (futex timeout cadence)."""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+
+world = api.init()
+p = world.proc
+nbytes = int(os.environ.get("TPDIAG_BYTES", 1 << 20))
+iters = int(os.environ.get("TPDIAG_ITERS", 60))
+buf = np.zeros(nbytes, np.uint8)
+
+for _ in range(5):
+    if p == 0:
+        world.send(buf, source=0, dest=1, tag=9)
+        world.recv(dest=0, source=1, tag=9)
+    else:
+        world.recv(dest=1, source=0, tag=9)
+        world.send(buf, source=1, dest=0, tag=9)
+
+ts = []
+for _ in range(iters):
+    t0 = time.perf_counter()
+    if p == 0:
+        world.send(buf, source=0, dest=1, tag=9)
+        world.recv(dest=0, source=1, tag=9)
+    else:
+        world.recv(dest=1, source=0, tag=9)
+        world.send(buf, source=1, dest=0, tag=9)
+    ts.append((time.perf_counter() - t0) * 1e6)
+
+if p == 0:
+    a = np.array(ts)
+    print("PPDIAG rt_us min=%.0f p25=%.0f med=%.0f p75=%.0f p90=%.0f max=%.0f"
+          % (a.min(), np.percentile(a, 25), np.median(a),
+             np.percentile(a, 75), np.percentile(a, 90), a.max()),
+          flush=True)
+    print("PPDIAG hist_ms " + " ".join("%.2f" % (x / 1e3) for x in sorted(a)),
+          flush=True)
+api.finalize()
