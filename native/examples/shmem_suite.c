@@ -4,10 +4,12 @@
  * wait_until signaling, broadcast/collect/fcollect, reductions, and
  * the barrier/quiet ordering contract.  Runs at any npes >= 2.
  */
+#include <complex.h>
 #include <shmem.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 static int me, n;
 
@@ -198,6 +200,264 @@ int main(void) {
     } else { /* ...nonmembers participate and get INVALID (1.5) */
       CHECK(evens == SHMEM_TEAM_INVALID, "team_nonmember_invalid");
     }
+  }
+
+  { /* distributed locks: mutual exclusion of a non-atomic RMW */
+    long *lk = (long *)shmem_calloc(1, sizeof(long));
+    long *cnt = (long *)shmem_calloc(1, sizeof(long));
+    for (int i = 0; i < 5; i++) {
+      shmem_set_lock(lk);
+      long cur = shmem_long_g(cnt, 0);
+      shmem_long_p(cnt, cur + 1, 0);
+      shmem_quiet();
+      shmem_clear_lock(lk);
+    }
+    shmem_barrier_all();
+    CHECK(shmem_long_g(cnt, 0) == 5L * n, "lock_mutual_exclusion");
+    /* test_lock: busy while held, acquirable after clear */
+    long *lk2 = (long *)shmem_calloc(1, sizeof(long));
+    if (me == 0) shmem_set_lock(lk2);
+    shmem_barrier_all();
+    if (me == 1) CHECK(shmem_test_lock(lk2) == 1, "test_lock_busy");
+    shmem_barrier_all();
+    if (me == 0) shmem_clear_lock(lk2);
+    shmem_barrier_all();
+    if (me == 1) {
+      CHECK(shmem_test_lock(lk2) == 0, "test_lock_acquires");
+      shmem_clear_lock(lk2);
+    }
+    shmem_barrier_all();
+  }
+
+  { /* test / wait_until families over an ivar array */
+    long *flags = (long *)shmem_calloc((size_t)n, sizeof(long));
+    for (int j = 0; j < n; j++) shmem_long_p(&flags[me], me + 1, j);
+    shmem_quiet();
+    shmem_long_wait_until_all(flags, (size_t)n, NULL, SHMEM_CMP_NE, 0);
+    CHECK(shmem_long_test_all(flags, (size_t)n, NULL, SHMEM_CMP_GT, 0),
+          "test_all");
+    CHECK(shmem_long_test(&flags[0], SHMEM_CMP_EQ, 1), "test_eq");
+    size_t any = shmem_long_test_any(flags, (size_t)n, NULL,
+                                     SHMEM_CMP_EQ, (long)n);
+    CHECK(any == (size_t)(n - 1), "test_any_index");
+    size_t idx[64];
+    size_t k = shmem_long_test_some(flags, (size_t)n, idx, NULL,
+                                    SHMEM_CMP_NE, 0);
+    CHECK(k == (size_t)n && idx[0] == 0, "test_some_count");
+    size_t w = shmem_long_wait_until_any(flags, (size_t)n, NULL,
+                                         SHMEM_CMP_EQ, 2);
+    CHECK(w == 1, "wait_until_any_index");
+    k = shmem_long_wait_until_some(flags, (size_t)n, idx, NULL,
+                                   SHMEM_CMP_GE, 1);
+    CHECK(k == (size_t)n, "wait_until_some_count");
+    /* exclusion mask: element 0 excluded */
+    int status[64] = {0};
+    status[0] = 1;
+    any = shmem_long_test_any(flags, (size_t)n, status, SHMEM_CMP_EQ, 1);
+    CHECK(any == (size_t)-1, "test_any_status_mask");
+  }
+
+  { /* non-blocking puts/gets complete at quiet */
+    int right = (me + 1) % n, left = (me - 1 + n) % n;
+    double *nb = (double *)shmem_calloc(4, sizeof(double));
+    double src[4] = {me + 0.5, me + 1.5, me + 2.5, me + 3.5};
+    shmem_double_put_nbi(nb, src, 4, right);
+    shmem_quiet(); /* local+remote completion */
+    shmem_barrier_all();
+    CHECK(nb[0] == left + 0.5 && nb[3] == left + 3.5, "put_nbi_quiet");
+    double back[4] = {0};
+    shmem_double_get_nbi(back, nb, 4, right);
+    shmem_quiet();
+    CHECK(back[0] == me + 0.5, "get_nbi_quiet");
+    shmem_barrier_all();
+  }
+
+  { /* strided iput/iget */
+    int right = (me + 1) % n, left = (me - 1 + n) % n;
+    int *sbuf = (int *)shmem_calloc(8, sizeof(int));
+    int *dbuf = (int *)shmem_calloc(8, sizeof(int));
+    for (int i = 0; i < 8; i++) sbuf[i] = 100 * me + i;
+    shmem_barrier_all();
+    /* every 2nd source element into every 2nd dest slot */
+    shmem_int_iput(dbuf, sbuf, 2, 2, 4, right);
+    shmem_barrier_all();
+    CHECK(dbuf[0] == 100 * left && dbuf[2] == 100 * left + 2 &&
+              dbuf[6] == 100 * left + 6 && dbuf[1] == 0,
+          "iput_strided");
+    int got[4] = {0};
+    shmem_int_iget(got, sbuf, 1, 2, 4, right);
+    CHECK(got[0] == 100 * right && got[3] == 100 * right + 6,
+          "iget_strided");
+    shmem_barrier_all();
+  }
+
+  { /* contexts: default + private, ctx-qualified RMA/AMO */
+    int *cc = (int *)shmem_calloc(2, sizeof(int));
+    shmem_ctx_t ctx;
+    CHECK(shmem_ctx_create(SHMEM_CTX_PRIVATE, &ctx) == 0, "ctx_create");
+    int right = (me + 1) % n, left = (me - 1 + n) % n;
+    shmem_ctx_int_put(ctx, &cc[0], &me, 1, right);
+    shmem_ctx_quiet(ctx);
+    shmem_barrier_all();
+    CHECK(cc[0] == left, "ctx_put");
+    CHECK(shmem_ctx_int_g(ctx, &cc[0], right) == me, "ctx_g");
+    (void)shmem_ctx_int_atomic_fetch_add(ctx, &cc[1], 3, 0);
+    shmem_barrier_all();
+    CHECK(shmem_ctx_int_atomic_fetch(SHMEM_CTX_DEFAULT, &cc[1], 0) ==
+              3 * n,
+          "ctx_amo");
+    shmem_ctx_destroy(ctx);
+    shmem_team_t whose = SHMEM_TEAM_INVALID;
+    CHECK(shmem_ctx_get_team(SHMEM_CTX_DEFAULT, &whose) == 0 &&
+              whose == SHMEM_TEAM_WORLD,
+          "ctx_get_team");
+    shmem_barrier_all();
+  }
+
+  { /* bitwise atomics: OR of per-PE bits */
+    uint32_t *bits = (uint32_t *)shmem_calloc(1, sizeof(uint32_t));
+    (void)shmem_uint32_atomic_fetch_or(bits, 1u << me, 0);
+    shmem_barrier_all();
+    uint32_t v = shmem_uint32_atomic_fetch(bits, 0);
+    CHECK(v == (n >= 32 ? 0xffffffffu : (1u << n) - 1u), "atomic_or_bits");
+    shmem_barrier_all(); /* every PE's read precedes the next mutation */
+    if (me == 0) (void)shmem_uint32_atomic_fetch_and(bits, ~1u, 0);
+    shmem_barrier_all();
+    CHECK((shmem_uint32_atomic_fetch(bits, 0) & 1u) == 0, "atomic_and");
+    shmem_barrier_all();
+    (void)shmem_uint32_atomic_fetch_xor(bits, 1u << me, 0);
+    shmem_barrier_all();
+  }
+
+  { /* reduction matrix breadth: float/min, short/and, complex sum —
+       the macro-generated families beyond int/sum */
+    float *fv = (float *)shmem_malloc(2 * sizeof(float));
+    float *fo = (float *)shmem_malloc(2 * sizeof(float));
+    float *fw = (float *)shmem_malloc(2 * sizeof(float));
+    long *rs = (long *)shmem_malloc(sizeof(long));
+    fv[0] = (float)(me + 1);
+    fv[1] = -(float)me;
+    shmem_barrier_all();
+    shmem_float_min_to_all(fo, fv, 2, 0, 0, n, fw, rs);
+    CHECK(fo[0] == 1.0f && fo[1] == -(float)(n - 1), "float_min_to_all");
+    short *sv = (short *)shmem_malloc(sizeof(short));
+    short *so = (short *)shmem_malloc(sizeof(short));
+    short *sw = (short *)shmem_malloc(sizeof(short));
+    *sv = (short)(0xff ^ (1 << me));
+    shmem_barrier_all();
+    shmem_short_and_to_all(so, sv, 1, 0, 0, n, sw, rs);
+    short expect = (short)0xff;
+    for (int j = 0; j < n && j < 8; j++) expect &= (short)(0xff ^ (1 << j));
+    CHECK(*so == expect, "short_and_to_all");
+    double _Complex *zv =
+        (double _Complex *)shmem_malloc(sizeof(double _Complex));
+    double _Complex *zo =
+        (double _Complex *)shmem_malloc(sizeof(double _Complex));
+    double _Complex *zw =
+        (double _Complex *)shmem_malloc(sizeof(double _Complex));
+    *zv = me + 1.0 + (me * 2.0) * _Complex_I;
+    shmem_barrier_all();
+    shmem_complexd_sum_to_all(zo, zv, 1, 0, 0, n, zw, rs);
+    double re = 0, im = 0;
+    for (int j = 0; j < n; j++) {
+      re += j + 1.0;
+      im += j * 2.0;
+    }
+    CHECK(__real__ *zo == re && __imag__ *zo == im, "complexd_sum_to_all");
+  }
+
+  { /* active-set collectives on a strided SUBSET (round-4 gap: the
+       world-only check is gone) — evens only */
+    int esize = (n + 1) / 2;
+    long *av = (long *)shmem_malloc(sizeof(long));
+    long *ao = (long *)shmem_malloc(sizeof(long));
+    long *aw = (long *)shmem_malloc(sizeof(long));
+    long *as = (long *)shmem_malloc(sizeof(long));
+    *av = me + 1;
+    shmem_barrier_all();
+    if (me % 2 == 0 && esize >= 1) {
+      shmem_long_sum_to_all(ao, av, 1, 0, 1, esize, aw, as);
+      long expect2 = 0;
+      for (int j = 0; j < n; j += 2) expect2 += j + 1;
+      CHECK(*ao == expect2, "subset_sum_to_all");
+      shmem_barrier(0, 1, esize, as);
+    }
+    shmem_barrier_all();
+  }
+
+  { /* team collectives: world + evens-subset teams */
+    int *tv = (int *)shmem_malloc(2 * sizeof(int));
+    int *to = (int *)shmem_malloc(2 * sizeof(int));
+    tv[0] = me + 1;
+    tv[1] = 10 * (me + 1);
+    shmem_barrier_all();
+    CHECK(shmem_int_sum_reduce(SHMEM_TEAM_WORLD, to, tv, 2) == 0,
+          "team_reduce_rc");
+    int expm = n * (n + 1) / 2;
+    CHECK(to[0] == expm && to[1] == 10 * expm, "team_sum_reduce");
+    /* 1.5 team broadcast updates dest on the ROOT as well */
+    long *bv = (long *)shmem_malloc(4 * sizeof(long));
+    long *bo = (long *)shmem_malloc(4 * sizeof(long));
+    for (int i = 0; i < 4; i++) {
+      bv[i] = me == 0 ? 500 + i : -1;
+      bo[i] = -7;
+    }
+    shmem_barrier_all();
+    shmem_long_broadcast(SHMEM_TEAM_WORLD, bo, bv, 4, 0);
+    CHECK(bo[0] == 500 && bo[3] == 503, "team_broadcast_all_dest");
+    /* fcollect + alltoall over the world team */
+    int *fc = (int *)shmem_malloc((size_t)n * sizeof(int));
+    shmem_int_fcollect(SHMEM_TEAM_WORLD, fc, &me, 1);
+    int okf = 1;
+    for (int j = 0; j < n; j++)
+      if (fc[j] != j) okf = 0;
+    CHECK(okf, "team_fcollect");
+    int *asrc = (int *)shmem_malloc((size_t)n * sizeof(int));
+    int *adst = (int *)shmem_malloc((size_t)n * sizeof(int));
+    for (int j = 0; j < n; j++) asrc[j] = 100 * me + j;
+    shmem_barrier_all();
+    shmem_int_alltoall(SHMEM_TEAM_WORLD, adst, asrc, 1);
+    int oka = 1;
+    for (int j = 0; j < n; j++)
+      if (adst[j] != 100 * j + me) oka = 0;
+    CHECK(oka, "team_alltoall");
+    /* strided team split with real collectives + sync */
+    shmem_team_t evens;
+    int esize = (n + 1) / 2;
+    CHECK(shmem_team_split_strided(SHMEM_TEAM_WORLD, 0, 2, esize, NULL, 0,
+                                   &evens) == 0,
+          "team_split2");
+    if (me % 2 == 0) {
+      CHECK(shmem_team_sync(evens) == 0, "team_sync");
+      int *ev = (int *)malloc(sizeof(int));
+      int *eo = (int *)malloc(sizeof(int));
+      *ev = me;
+      CHECK(shmem_int_max_reduce(evens, eo, ev, 1) == 0,
+            "subteam_reduce_rc");
+      int emax = ((n - 1) / 2) * 2;
+      CHECK(*eo == emax, "subteam_max_reduce");
+      free(ev);
+      free(eo);
+      shmem_team_destroy(evens);
+    }
+    shmem_barrier_all();
+  }
+
+  { /* sized 16/128-bit put/get */
+    uint16_t *h = (uint16_t *)shmem_calloc(4, sizeof(uint16_t));
+    uint16_t hs[4] = {(uint16_t)(40000 + me), 2, 3, 4};
+    int right = (me + 1) % n, left = (me - 1 + n) % n;
+    shmem_put16(h, hs, 4, right);
+    shmem_barrier_all();
+    CHECK(h[0] == 40000 + left && h[3] == 4, "put16");
+    struct q128 { uint64_t a, b; };
+    struct q128 *qq = (struct q128 *)shmem_calloc(1, sizeof(struct q128));
+    struct q128 qv = {me + 7ull, me + 9ull};
+    shmem_put128(qq, &qv, 1, right);
+    shmem_barrier_all();
+    CHECK(qq->a == (uint64_t)(left + 7) && qq->b == (uint64_t)(left + 9),
+          "put128");
+    shmem_barrier_all();
   }
 
   shmem_barrier_all();

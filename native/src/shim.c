@@ -1310,8 +1310,14 @@ int PMPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
 
 int PMPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
                            MPI_Comm *newcomm) {
-  (void)tag;
-  return PMPI_Comm_create(comm, group, newcomm);
+  /* MPI-3.0: collective over the GROUP members only — nonmembers do
+   * not call, so this cannot ride the full-comm split that backs
+   * MPI_Comm_create */
+  capi_ret r;
+  int rc = capi_call("comm_create_group", &r, "(iii)", (int)comm,
+                     (int)group, tag);
+  if (rc == MPI_SUCCESS && r.n >= 1) *newcomm = (MPI_Comm)r.v[0];
+  return rc;
 }
 
 int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {

@@ -1,4 +1,5 @@
-/* libtpushmem — OpenSHMEM core subset over the MPI C ABI.
+/* libtpushmem — OpenSHMEM 1.4 surface + 1.5 teams/contexts/signals
+ * over the MPI C ABI.
  *
  * ≈ the reference's oshmem layering (SURVEY.md §2.5: liboshmem's
  * spml/scoll/atomic/memheap components delegate to ompi's pml, coll
@@ -10,18 +11,31 @@
  *              bump allocation keeps offsets symmetric (the memheap
  *              contract);
  *   spml     → shmem_put/get = MPI_Put/MPI_Get at (addr - heap_base),
- *              quiet/fence = MPI_Win_flush_all;
- *   atomic   → MPI_Fetch_and_op / MPI_Compare_and_swap;
- *   scoll    → broadcast/collect/reductions = MPI collectives over
- *              MPI_COMM_WORLD (active sets: the world forms used by
- *              the conformance suite; strided subsets are rejected
- *              loudly rather than silently miscomputed).
+ *              quiet/fence = MPI_Win_flush_all; _nbi forms skip the
+ *              per-op flush (completion deferred to shmem_quiet);
+ *   atomic   → MPI_Fetch_and_op / MPI_Compare_and_swap (standard,
+ *              bitwise and extended-float AMO families);
+ *   scoll    → broadcast/collect/reductions/alltoall = MPI
+ *              collectives over a communicator derived from the
+ *              active set or team (MPI_Comm_create_group over the
+ *              member ranks — only members participate, exactly the
+ *              OpenSHMEM collective-participation contract);
+ *   teams    → (start, stride, size) descriptors + a real
+ *              communicator per team, so team collectives and
+ *              shmem_team_sync are first-class;
+ *   lock     → shmem_set_lock/test_lock/clear_lock via remote CAS on
+ *              the PE-0 copy of the symmetric lock word;
+ *   ctx      → contexts share the single heap window: every ctx op
+ *              is remote-complete at return, so per-ctx quiet/fence
+ *              are satisfied a fortiori (stronger ordering than the
+ *              spec requires, never weaker).
  *
- * PE numbering = MPI_COMM_WORLD rank.  Remote local-access
- * (shmem_ptr) resolves only for the calling PE itself (no cross-
- * process load/store sharing — same answer oshmem gives for
- * non-shared-memory transports: NULL).
+ * The wide type x op matrix is macro-generated from X-macro lists the
+ * same way the reference's oshmem/shmem/c sources are generated.
+ * PE numbering = MPI_COMM_WORLD rank.  longdouble variants are the
+ * one omitted family (no MPI_LONG_DOUBLE in the host ABI).
  */
+#include <complex.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -78,6 +92,18 @@ void shmem_init(void) {
   MPI_Barrier(MPI_COMM_WORLD);
 }
 
+int shmem_init_thread(int requested, int *provided) {
+  shmem_init();
+  if (provided) *provided = SHMEM_THREAD_SINGLE >= requested
+                                ? requested
+                                : SHMEM_THREAD_SINGLE;
+  return 0;
+}
+
+void shmem_query_thread(int *provided) {
+  if (provided) *provided = SHMEM_THREAD_SINGLE;
+}
+
 void shmem_finalize(void) {
   if (!g_inited) return;
   MPI_Win_flush_all(g_win);
@@ -123,25 +149,34 @@ void shmem_global_exit(int status) { MPI_Abort(MPI_COMM_WORLD, status); }
 
 /* ---- memheap ------------------------------------------------------- */
 
-void *shmem_align(size_t alignment, size_t size) {
+/* SPMD lockstep bump: every PE performs the same allocation sequence,
+ * so the bump pointer (and thus every offset) stays symmetric — the
+ * memheap invariant.  Callers add the one collective barrier AFTER any
+ * local initialization, so the barrier-on-return contract covers the
+ * initialized state (a peer's post-allocation put must never race a
+ * local memset). */
+static void *heap_alloc_nobarrier(size_t alignment, size_t size) {
   if (!g_inited) die("shmem_malloc before shmem_init");
   if (alignment < HEAP_ALIGN) alignment = HEAP_ALIGN;
-  /* SPMD lockstep: every PE performs the same allocation sequence, so
-   * the bump pointer (and thus every offset) stays symmetric — the
-   * memheap invariant.  A barrier keeps call-site divergence loud. */
   size_t off = (g_brk + alignment - 1) / alignment * alignment;
   if (off + size > g_heap_size) die("symmetric heap exhausted "
                                     "(set SHMEM_SYMMETRIC_SIZE)");
   g_brk = off + size;
-  shmem_barrier_all();
   return g_heap + off;
+}
+
+void *shmem_align(size_t alignment, size_t size) {
+  void *p = heap_alloc_nobarrier(alignment, size);
+  shmem_barrier_all();
+  return p;
 }
 
 void *shmem_malloc(size_t size) { return shmem_align(HEAP_ALIGN, size); }
 
 void *shmem_calloc(size_t count, size_t size) {
-  void *p = shmem_malloc(count * size);
+  void *p = heap_alloc_nobarrier(HEAP_ALIGN, count * size);
   memset(p, 0, count * size);
+  shmem_barrier_all();
   return p;
 }
 
@@ -161,6 +196,14 @@ void *shmem_realloc(void *ptr, size_t size) {
   }
   return p;
 }
+
+void *shmem_malloc_with_hints(size_t size, long hints) {
+  (void)hints; /* all heap memory has identical properties here */
+  return shmem_malloc(size);
+}
+
+/* test hook: current symmetric-heap bump offset (symmetry pinning) */
+size_t tpushmem_brk(void) { return g_brk; }
 
 void *shmem_ptr(const void *dest, int pe) {
   /* cross-process load/store sharing is not provided (separate
@@ -182,6 +225,46 @@ void shmem_barrier_all(void) {
 }
 
 void shmem_sync_all(void) { MPI_Barrier(MPI_COMM_WORLD); }
+
+/* ---- contexts (1.5) -------------------------------------------------
+ * All contexts share the single symmetric-heap window and every op is
+ * remote-complete at return, so shmem_ctx_quiet/fence hold a fortiori
+ * for any context (stronger than required, never weaker).  Context
+ * handles are real allocations so create/destroy pairing bugs in user
+ * code still surface under leak checkers. */
+
+int shmem_ctx_create(long options, shmem_ctx_t *ctx) {
+  (void)options; /* SERIALIZED/PRIVATE/NOSTORE are relaxations */
+  if (!ctx) return -1;
+  *ctx = (shmem_ctx_t)malloc(8);
+  return *ctx ? 0 : -1;
+}
+
+void shmem_ctx_destroy(shmem_ctx_t ctx) {
+  if (ctx != SHMEM_CTX_DEFAULT && ctx != SHMEM_CTX_INVALID) free(ctx);
+}
+
+void shmem_ctx_quiet(shmem_ctx_t ctx) {
+  (void)ctx;
+  shmem_quiet();
+}
+
+void shmem_ctx_fence(shmem_ctx_t ctx) {
+  (void)ctx;
+  shmem_quiet();
+}
+
+int shmem_team_create_ctx(shmem_team_t team, long options,
+                          shmem_ctx_t *ctx) {
+  (void)team;
+  return shmem_ctx_create(options, ctx);
+}
+
+int shmem_ctx_get_team(shmem_ctx_t ctx, shmem_team_t *team) {
+  (void)ctx;
+  if (team) *team = SHMEM_TEAM_WORLD;
+  return 0;
+}
 
 /* ---- RMA ----------------------------------------------------------- */
 
@@ -206,79 +289,234 @@ static void get_bytes(void *dest, const void *source, size_t nbytes,
   MPI_Win_flush(pe, g_win);
 }
 
+/* non-blocking: queue the transfer, complete at shmem_quiet */
+static void put_bytes_nbi(void *dest, const void *source, size_t nbytes,
+                          int pe) {
+  size_t off = heap_off(dest, "shmem_put_nbi");
+  if (!nbytes) return;
+  MPI_Put(source, (int)nbytes, MPI_BYTE, pe, (MPI_Aint)off, (int)nbytes,
+          MPI_BYTE, g_win);
+}
+
+static void get_bytes_nbi(void *dest, const void *source, size_t nbytes,
+                          int pe) {
+  size_t off = heap_off((void *)source, "shmem_get_nbi");
+  if (!nbytes) return;
+  MPI_Get(dest, (int)nbytes, MPI_BYTE, pe, (MPI_Aint)off, (int)nbytes,
+          MPI_BYTE, g_win);
+}
+
 void shmem_putmem(void *d, const void *s, size_t n, int pe) {
   put_bytes(d, s, n, pe);
 }
 void shmem_getmem(void *d, const void *s, size_t n, int pe) {
   get_bytes(d, s, n, pe);
 }
+void shmem_putmem_nbi(void *d, const void *s, size_t n, int pe) {
+  put_bytes_nbi(d, s, n, pe);
+}
+void shmem_getmem_nbi(void *d, const void *s, size_t n, int pe) {
+  get_bytes_nbi(d, s, n, pe);
+}
+void shmem_ctx_putmem(shmem_ctx_t c, void *d, const void *s, size_t n,
+                      int pe) {
+  (void)c;
+  put_bytes(d, s, n, pe);
+}
+void shmem_ctx_getmem(shmem_ctx_t c, void *d, const void *s, size_t n,
+                      int pe) {
+  (void)c;
+  get_bytes(d, s, n, pe);
+}
+void shmem_ctx_putmem_nbi(shmem_ctx_t c, void *d, const void *s, size_t n,
+                          int pe) {
+  (void)c;
+  put_bytes_nbi(d, s, n, pe);
+}
+void shmem_ctx_getmem_nbi(shmem_ctx_t c, void *d, const void *s, size_t n,
+                          int pe) {
+  (void)c;
+  get_bytes_nbi(d, s, n, pe);
+}
 
-#define PUTGET(NAME, T)                                                   \
+/* the standard RMA type table (OpenSHMEM 1.5 Table 5, minus
+ * longdouble: no MPI_LONG_DOUBLE in the host ABI) */
+#define SHMEM_RMA_TYPES(X)                                                \
+  X(char, char, MPI_CHAR)                                                 \
+  X(schar, signed char, MPI_SIGNED_CHAR)                                  \
+  X(short, short, MPI_SHORT)                                              \
+  X(int, int, MPI_INT)                                                    \
+  X(long, long, MPI_LONG)                                                 \
+  X(longlong, long long, MPI_LONG_LONG)                                   \
+  X(uchar, unsigned char, MPI_UNSIGNED_CHAR)                              \
+  X(ushort, unsigned short, MPI_UNSIGNED_SHORT)                           \
+  X(uint, unsigned int, MPI_UNSIGNED)                                     \
+  X(ulong, unsigned long, MPI_UNSIGNED_LONG)                              \
+  X(ulonglong, unsigned long long, MPI_UNSIGNED_LONG_LONG)                \
+  X(float, float, MPI_FLOAT)                                              \
+  X(double, double, MPI_DOUBLE)                                           \
+  X(int8, int8_t, MPI_INT8_T)                                             \
+  X(int16, int16_t, MPI_INT16_T)                                          \
+  X(int32, int32_t, MPI_INT32_T)                                          \
+  X(int64, int64_t, MPI_INT64_T)                                          \
+  X(uint8, uint8_t, MPI_UINT8_T)                                          \
+  X(uint16, uint16_t, MPI_UINT16_T)                                       \
+  X(uint32, uint32_t, MPI_UINT32_T)                                       \
+  X(uint64, uint64_t, MPI_UINT64_T)                                       \
+  X(size, size_t, MPI_UINT64_T)                                           \
+  X(ptrdiff, ptrdiff_t, MPI_INT64_T)
+
+#define GEN_PUTGET(NAME, T, MPIT)                                         \
   void shmem_##NAME##_put(T *d, const T *s, size_t n, int pe) {           \
     put_bytes(d, s, n * sizeof(T), pe);                                   \
   }                                                                       \
   void shmem_##NAME##_get(T *d, const T *s, size_t n, int pe) {           \
     get_bytes(d, (const void *)s, n * sizeof(T), pe);                     \
+  }                                                                       \
+  void shmem_##NAME##_put_nbi(T *d, const T *s, size_t n, int pe) {       \
+    put_bytes_nbi(d, s, n * sizeof(T), pe);                               \
+  }                                                                       \
+  void shmem_##NAME##_get_nbi(T *d, const T *s, size_t n, int pe) {       \
+    get_bytes_nbi(d, (const void *)s, n * sizeof(T), pe);                 \
+  }                                                                       \
+  void shmem_##NAME##_p(T *d, T v, int pe) {                              \
+    put_bytes(d, &v, sizeof(T), pe);                                      \
+  }                                                                       \
+  T shmem_##NAME##_g(const T *s, int pe) {                                \
+    T v;                                                                  \
+    get_bytes(&v, s, sizeof(T), pe);                                      \
+    return v;                                                             \
+  }                                                                       \
+  void shmem_##NAME##_iput(T *d, const T *s, ptrdiff_t dst,               \
+                           ptrdiff_t sst, size_t n, int pe) {             \
+    size_t off = heap_off(d, "shmem_iput");                               \
+    for (size_t i = 0; i < n; i++)                                        \
+      MPI_Put(s + i * sst, (int)sizeof(T), MPI_BYTE, pe,                  \
+              (MPI_Aint)(off + (size_t)(i * dst) * sizeof(T)),            \
+              (int)sizeof(T), MPI_BYTE, g_win);                           \
+    if (n) MPI_Win_flush(pe, g_win);                                      \
+  }                                                                       \
+  void shmem_##NAME##_iget(T *d, const T *s, ptrdiff_t dst,               \
+                           ptrdiff_t sst, size_t n, int pe) {             \
+    size_t off = heap_off((const void *)s, "shmem_iget");                 \
+    for (size_t i = 0; i < n; i++)                                        \
+      MPI_Get(d + i * dst, (int)sizeof(T), MPI_BYTE, pe,                  \
+              (MPI_Aint)(off + (size_t)(i * sst) * sizeof(T)),            \
+              (int)sizeof(T), MPI_BYTE, g_win);                           \
+    if (n) MPI_Win_flush(pe, g_win);                                      \
+  }                                                                       \
+  void shmem_ctx_##NAME##_put(shmem_ctx_t c, T *d, const T *s, size_t n,  \
+                              int pe) {                                   \
+    (void)c;                                                              \
+    put_bytes(d, s, n * sizeof(T), pe);                                   \
+  }                                                                       \
+  void shmem_ctx_##NAME##_get(shmem_ctx_t c, T *d, const T *s, size_t n,  \
+                              int pe) {                                   \
+    (void)c;                                                              \
+    get_bytes(d, (const void *)s, n * sizeof(T), pe);                     \
+  }                                                                       \
+  void shmem_ctx_##NAME##_put_nbi(shmem_ctx_t c, T *d, const T *s,        \
+                                  size_t n, int pe) {                     \
+    (void)c;                                                              \
+    put_bytes_nbi(d, s, n * sizeof(T), pe);                               \
+  }                                                                       \
+  void shmem_ctx_##NAME##_get_nbi(shmem_ctx_t c, T *d, const T *s,        \
+                                  size_t n, int pe) {                     \
+    (void)c;                                                              \
+    get_bytes_nbi(d, (const void *)s, n * sizeof(T), pe);                 \
+  }                                                                       \
+  void shmem_ctx_##NAME##_p(shmem_ctx_t c, T *d, T v, int pe) {           \
+    (void)c;                                                              \
+    put_bytes(d, &v, sizeof(T), pe);                                      \
+  }                                                                       \
+  T shmem_ctx_##NAME##_g(shmem_ctx_t c, const T *s, int pe) {             \
+    (void)c;                                                              \
+    T v;                                                                  \
+    get_bytes(&v, s, sizeof(T), pe);                                      \
+    return v;                                                             \
   }
 
-PUTGET(int, int)
-PUTGET(long, long)
-PUTGET(longlong, long long)
-PUTGET(float, float)
-PUTGET(double, double)
+SHMEM_RMA_TYPES(GEN_PUTGET)
 
-void shmem_put8(void *d, const void *s, size_t n, int pe) {
-  put_bytes(d, s, n, pe);
-}
-void shmem_get8(void *d, const void *s, size_t n, int pe) {
-  get_bytes(d, s, n, pe);
-}
-void shmem_put32(void *d, const void *s, size_t n, int pe) {
-  put_bytes(d, s, n * 4, pe);
-}
-void shmem_get32(void *d, const void *s, size_t n, int pe) {
-  get_bytes(d, s, n * 4, pe);
-}
-void shmem_put64(void *d, const void *s, size_t n, int pe) {
-  put_bytes(d, s, n * 8, pe);
-}
-void shmem_get64(void *d, const void *s, size_t n, int pe) {
-  get_bytes(d, s, n * 8, pe);
-}
+/* sized (bit-width) forms */
+#define GEN_SIZED(BITS, BYTES)                                            \
+  void shmem_put##BITS(void *d, const void *s, size_t n, int pe) {        \
+    put_bytes(d, s, n * (BYTES), pe);                                     \
+  }                                                                       \
+  void shmem_get##BITS(void *d, const void *s, size_t n, int pe) {        \
+    get_bytes(d, s, n * (BYTES), pe);                                     \
+  }                                                                       \
+  void shmem_put##BITS##_nbi(void *d, const void *s, size_t n, int pe) {  \
+    put_bytes_nbi(d, s, n * (BYTES), pe);                                 \
+  }                                                                       \
+  void shmem_get##BITS##_nbi(void *d, const void *s, size_t n, int pe) {  \
+    get_bytes_nbi(d, s, n * (BYTES), pe);                                 \
+  }                                                                       \
+  void shmem_iput##BITS(void *d, const void *s, ptrdiff_t dst,            \
+                        ptrdiff_t sst, size_t n, int pe) {                \
+    size_t off = heap_off(d, "shmem_iput" #BITS);                         \
+    for (size_t i = 0; i < n; i++)                                        \
+      MPI_Put((const unsigned char *)s + (size_t)(i * sst) * (BYTES),     \
+              (int)(BYTES), MPI_BYTE, pe,                                 \
+              (MPI_Aint)(off + (size_t)(i * dst) * (BYTES)),              \
+              (int)(BYTES), MPI_BYTE, g_win);                             \
+    if (n) MPI_Win_flush(pe, g_win);                                      \
+  }                                                                       \
+  void shmem_iget##BITS(void *d, const void *s, ptrdiff_t dst,            \
+                        ptrdiff_t sst, size_t n, int pe) {                \
+    size_t off = heap_off(s, "shmem_iget" #BITS);                         \
+    for (size_t i = 0; i < n; i++)                                        \
+      MPI_Get((unsigned char *)d + (size_t)(i * dst) * (BYTES),           \
+              (int)(BYTES), MPI_BYTE, pe,                                 \
+              (MPI_Aint)(off + (size_t)(i * sst) * (BYTES)),              \
+              (int)(BYTES), MPI_BYTE, g_win);                             \
+    if (n) MPI_Win_flush(pe, g_win);                                      \
+  }
 
-void shmem_int_p(int *d, int v, int pe) { put_bytes(d, &v, sizeof v, pe); }
-void shmem_long_p(long *d, long v, int pe) {
-  put_bytes(d, &v, sizeof v, pe);
-}
-void shmem_double_p(double *d, double v, int pe) {
-  put_bytes(d, &v, sizeof v, pe);
-}
-
-int shmem_int_g(const int *s, int pe) {
-  int v;
-  get_bytes(&v, s, sizeof v, pe);
-  return v;
-}
-long shmem_long_g(const long *s, int pe) {
-  long v;
-  get_bytes(&v, s, sizeof v, pe);
-  return v;
-}
-double shmem_double_g(const double *s, int pe) {
-  double v;
-  get_bytes(&v, s, sizeof v, pe);
-  return v;
-}
+GEN_SIZED(8, 1)
+GEN_SIZED(16, 2)
+GEN_SIZED(32, 4)
+GEN_SIZED(64, 8)
+GEN_SIZED(128, 16)
 
 /* ---- atomics ------------------------------------------------------- */
 
-#define ATOMICS(NAME, T, MPIT)                                            \
+/* standard AMO types (1.5 Table 6) */
+#define SHMEM_AMO_TYPES(X)                                                \
+  X(int, int, MPI_INT)                                                    \
+  X(long, long, MPI_LONG)                                                 \
+  X(longlong, long long, MPI_LONG_LONG)                                   \
+  X(uint, unsigned int, MPI_UNSIGNED)                                     \
+  X(ulong, unsigned long, MPI_UNSIGNED_LONG)                              \
+  X(ulonglong, unsigned long long, MPI_UNSIGNED_LONG_LONG)                \
+  X(int32, int32_t, MPI_INT32_T)                                          \
+  X(int64, int64_t, MPI_INT64_T)                                          \
+  X(uint32, uint32_t, MPI_UINT32_T)                                       \
+  X(uint64, uint64_t, MPI_UINT64_T)                                       \
+  X(size, size_t, MPI_UINT64_T)                                           \
+  X(ptrdiff, ptrdiff_t, MPI_INT64_T)
+
+/* bitwise AMO types (1.5 Table 7) */
+#define SHMEM_BITWISE_TYPES(X)                                            \
+  X(uint, unsigned int, MPI_UNSIGNED)                                     \
+  X(ulong, unsigned long, MPI_UNSIGNED_LONG)                              \
+  X(ulonglong, unsigned long long, MPI_UNSIGNED_LONG_LONG)                \
+  X(int32, int32_t, MPI_INT32_T)                                          \
+  X(int64, int64_t, MPI_INT64_T)                                          \
+  X(uint32, uint32_t, MPI_UINT32_T)                                       \
+  X(uint64, uint64_t, MPI_UINT64_T)
+
+static void amo_fop(const void *val, void *old, MPI_Datatype t, int pe,
+                    const void *dest, MPI_Op op, const char *who) {
+  size_t off = heap_off(dest, who);
+  MPI_Fetch_and_op(val, old, t, pe, (MPI_Aint)off, op, g_win);
+  MPI_Win_flush(pe, g_win);
+}
+
+#define GEN_AMO(NAME, T, MPIT)                                            \
   T shmem_##NAME##_atomic_fetch_add(T *dest, T value, int pe) {           \
-    size_t off = heap_off(dest, "atomic");                                \
     T old;                                                                \
-    MPI_Fetch_and_op(&value, &old, MPIT, pe, (MPI_Aint)off, MPI_SUM,      \
-                     g_win);                                              \
-    MPI_Win_flush(pe, g_win);                                             \
+    amo_fop(&value, &old, MPIT, pe, dest, MPI_SUM, "atomic");             \
     return old;                                                           \
   }                                                                       \
   void shmem_##NAME##_atomic_add(T *dest, T value, int pe) {              \
@@ -291,11 +529,8 @@ double shmem_double_g(const double *s, int pe) {
     (void)shmem_##NAME##_atomic_fetch_add(dest, (T)1, pe);                \
   }                                                                       \
   T shmem_##NAME##_atomic_swap(T *dest, T value, int pe) {                \
-    size_t off = heap_off(dest, "atomic");                                \
     T old;                                                                \
-    MPI_Fetch_and_op(&value, &old, MPIT, pe, (MPI_Aint)off, MPI_REPLACE,  \
-                     g_win);                                              \
-    MPI_Win_flush(pe, g_win);                                             \
+    amo_fop(&value, &old, MPIT, pe, dest, MPI_REPLACE, "atomic");         \
     return old;                                                           \
   }                                                                       \
   T shmem_##NAME##_atomic_compare_swap(T *dest, T cond, T value,          \
@@ -308,19 +543,93 @@ double shmem_double_g(const double *s, int pe) {
     return old;                                                           \
   }                                                                       \
   T shmem_##NAME##_atomic_fetch(const T *source, int pe) {                \
-    size_t off = heap_off((void *)source, "atomic");                      \
     T old, dummy = 0;                                                     \
-    MPI_Fetch_and_op(&dummy, &old, MPIT, pe, (MPI_Aint)off, MPI_NO_OP,    \
-                     g_win);                                              \
-    MPI_Win_flush(pe, g_win);                                             \
+    amo_fop(&dummy, &old, MPIT, pe, source, MPI_NO_OP, "atomic");         \
+    return old;                                                           \
+  }                                                                       \
+  void shmem_##NAME##_atomic_set(T *dest, T value, int pe) {              \
+    (void)shmem_##NAME##_atomic_swap(dest, value, pe);                    \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_fetch_add(shmem_ctx_t c, T *dest, T value,  \
+                                        int pe) {                         \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_fetch_add(dest, value, pe);              \
+  }                                                                       \
+  void shmem_ctx_##NAME##_atomic_add(shmem_ctx_t c, T *dest, T value,     \
+                                     int pe) {                            \
+    (void)c;                                                              \
+    shmem_##NAME##_atomic_add(dest, value, pe);                           \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_swap(shmem_ctx_t c, T *dest, T value,       \
+                                   int pe) {                              \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_swap(dest, value, pe);                   \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_compare_swap(shmem_ctx_t c, T *dest,        \
+                                           T cond, T value, int pe) {     \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_compare_swap(dest, cond, value, pe);     \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_fetch(shmem_ctx_t c, const T *source,       \
+                                    int pe) {                             \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_fetch(source, pe);                       \
+  }                                                                       \
+  void shmem_ctx_##NAME##_atomic_set(shmem_ctx_t c, T *dest, T value,     \
+                                     int pe) {                            \
+    (void)c;                                                              \
+    shmem_##NAME##_atomic_set(dest, value, pe);                           \
+  }
+
+SHMEM_AMO_TYPES(GEN_AMO)
+
+/* extended AMOs: float/double fetch/set/swap (1.5 Table 8) */
+#define GEN_AMO_EXT(NAME, T, MPIT)                                        \
+  T shmem_##NAME##_atomic_fetch(const T *source, int pe) {                \
+    T old, dummy = 0;                                                     \
+    amo_fop(&dummy, &old, MPIT, pe, source, MPI_NO_OP, "atomic");         \
+    return old;                                                           \
+  }                                                                       \
+  T shmem_##NAME##_atomic_swap(T *dest, T value, int pe) {                \
+    T old;                                                                \
+    amo_fop(&value, &old, MPIT, pe, dest, MPI_REPLACE, "atomic");         \
     return old;                                                           \
   }                                                                       \
   void shmem_##NAME##_atomic_set(T *dest, T value, int pe) {              \
     (void)shmem_##NAME##_atomic_swap(dest, value, pe);                    \
   }
 
-ATOMICS(int, int, MPI_INT)
-ATOMICS(long, long, MPI_LONG)
+GEN_AMO_EXT(float, float, MPI_FLOAT)
+GEN_AMO_EXT(double, double, MPI_DOUBLE)
+
+/* bitwise AMOs */
+#define GEN_AMO_BITWISE(NAME, T, MPIT)                                    \
+  T shmem_##NAME##_atomic_fetch_and(T *dest, T value, int pe) {           \
+    T old;                                                                \
+    amo_fop(&value, &old, MPIT, pe, dest, MPI_BAND, "atomic_and");        \
+    return old;                                                           \
+  }                                                                       \
+  void shmem_##NAME##_atomic_and(T *dest, T value, int pe) {              \
+    (void)shmem_##NAME##_atomic_fetch_and(dest, value, pe);               \
+  }                                                                       \
+  T shmem_##NAME##_atomic_fetch_or(T *dest, T value, int pe) {            \
+    T old;                                                                \
+    amo_fop(&value, &old, MPIT, pe, dest, MPI_BOR, "atomic_or");          \
+    return old;                                                           \
+  }                                                                       \
+  void shmem_##NAME##_atomic_or(T *dest, T value, int pe) {               \
+    (void)shmem_##NAME##_atomic_fetch_or(dest, value, pe);                \
+  }                                                                       \
+  T shmem_##NAME##_atomic_fetch_xor(T *dest, T value, int pe) {           \
+    T old;                                                                \
+    amo_fop(&value, &old, MPIT, pe, dest, MPI_BXOR, "atomic_xor");        \
+    return old;                                                           \
+  }                                                                       \
+  void shmem_##NAME##_atomic_xor(T *dest, T value, int pe) {              \
+    (void)shmem_##NAME##_atomic_fetch_xor(dest, value, pe);               \
+  }
+
+SHMEM_BITWISE_TYPES(GEN_AMO_BITWISE)
 
 /* deprecated pre-1.4 names map onto the 1.4 atomics */
 int shmem_int_fadd(int *d, int v, int pe) {
@@ -338,42 +647,182 @@ int shmem_int_swap(int *d, int v, int pe) {
 long shmem_long_fadd(long *d, long v, int pe) {
   return shmem_long_atomic_fetch_add(d, v, pe);
 }
+long shmem_long_finc(long *d, int pe) {
+  return shmem_long_atomic_fetch_inc(d, pe);
+}
+long shmem_long_cswap(long *d, long c, long v, int pe) {
+  return shmem_long_atomic_compare_swap(d, c, v, pe);
+}
+long shmem_long_swap(long *d, long v, int pe) {
+  return shmem_long_atomic_swap(d, v, pe);
+}
+long long shmem_longlong_fadd(long long *d, long long v, int pe) {
+  return shmem_longlong_atomic_fetch_add(d, v, pe);
+}
+long long shmem_longlong_finc(long long *d, int pe) {
+  return shmem_longlong_atomic_fetch_inc(d, pe);
+}
+float shmem_float_swap(float *d, float v, int pe) {
+  return shmem_float_atomic_swap(d, v, pe);
+}
+double shmem_double_swap(double *d, double v, int pe) {
+  return shmem_double_atomic_swap(d, v, pe);
+}
 
 /* ---- point synchronization ----------------------------------------- */
 
-#define WAIT_UNTIL(NAME, T)                                               \
+/* comparisons run in the ivar's NATIVE type (an unsigned 64-bit value
+ * >= 2^63 must not flip sign under a signed cast) */
+#define CMP_OK(cur, cmp, value, out)                                      \
+  do {                                                                    \
+    switch (cmp) {                                                        \
+      case SHMEM_CMP_EQ: (out) = (cur) == (value); break;                 \
+      case SHMEM_CMP_NE: (out) = (cur) != (value); break;                 \
+      case SHMEM_CMP_GT: (out) = (cur) > (value); break;                  \
+      case SHMEM_CMP_LE: (out) = (cur) <= (value); break;                 \
+      case SHMEM_CMP_LT: (out) = (cur) < (value); break;                  \
+      case SHMEM_CMP_GE: (out) = (cur) >= (value); break;                 \
+      default: die("bad shmem comparator"); (out) = 0;                    \
+    }                                                                     \
+  } while (0)
+
+static void sync_backoff(void) {
+  struct timespec ts = {0, 200000};
+  nanosleep(&ts, NULL);
+}
+
+/* The progress rule: an atomic fetch of our OWN cell routes through
+ * the osc engine, which also applies queued inbound ops (the spml
+ * progress role) — so every poll below fetches via the engine. */
+#define GEN_SYNC(NAME, T, MPIT)                                           \
+  int shmem_##NAME##_test(T *ivar, int cmp, T value) {                    \
+    heap_off(ivar, "test");                                               \
+    T cur = shmem_##NAME##_atomic_fetch(ivar, g_pe);                      \
+    int ok;                                                               \
+    CMP_OK(cur, cmp, value, ok);                                          \
+    return ok;                                                            \
+  }                                                                       \
   void shmem_##NAME##_wait_until(T *ivar, int cmp, T value) {             \
     heap_off(ivar, "wait_until");                                         \
+    while (!shmem_##NAME##_test(ivar, cmp, value)) sync_backoff();        \
+  }                                                                       \
+  int shmem_##NAME##_test_all(T *ivars, size_t n, const int *status,      \
+                              int cmp, T value) {                         \
+    for (size_t i = 0; i < n; i++) {                                      \
+      if (status && status[i]) continue;                                  \
+      if (!shmem_##NAME##_test(&ivars[i], cmp, value)) return 0;          \
+    }                                                                     \
+    return 1;                                                             \
+  }                                                                       \
+  size_t shmem_##NAME##_test_any(T *ivars, size_t n, const int *status,   \
+                                 int cmp, T value) {                      \
+    for (size_t i = 0; i < n; i++) {                                      \
+      if (status && status[i]) continue;                                  \
+      if (shmem_##NAME##_test(&ivars[i], cmp, value)) return i;           \
+    }                                                                     \
+    return (size_t)-1;                                                    \
+  }                                                                       \
+  size_t shmem_##NAME##_test_some(T *ivars, size_t n, size_t *indices,    \
+                                  const int *status, int cmp, T value) {  \
+    size_t k = 0;                                                         \
+    for (size_t i = 0; i < n; i++) {                                      \
+      if (status && status[i]) continue;                                  \
+      if (shmem_##NAME##_test(&ivars[i], cmp, value)) indices[k++] = i;   \
+    }                                                                     \
+    return k;                                                             \
+  }                                                                       \
+  void shmem_##NAME##_wait_until_all(T *ivars, size_t n,                  \
+                                     const int *status, int cmp,          \
+                                     T value) {                           \
+    for (size_t i = 0; i < n; i++) {                                      \
+      if (status && status[i]) continue;                                  \
+      shmem_##NAME##_wait_until(&ivars[i], cmp, value);                   \
+    }                                                                     \
+  }                                                                       \
+  size_t shmem_##NAME##_wait_until_any(T *ivars, size_t n,                \
+                                       const int *status, int cmp,        \
+                                       T value) {                         \
+    if (!n) return (size_t)-1;                                            \
+    int excluded_all = 1;                                                 \
+    for (size_t i = 0; i < n; i++)                                        \
+      if (!status || !status[i]) excluded_all = 0;                        \
+    if (excluded_all) return (size_t)-1;                                  \
     for (;;) {                                                            \
-      /* progress + memory refresh: an atomic fetch of our OWN cell      \
-       * routes through the osc engine, which also applies queued        \
-       * inbound ops (the spml progress role) */                         \
-      T cur = shmem_##NAME##_atomic_fetch(ivar, g_pe);                    \
-      int ok = 0;                                                         \
-      switch (cmp) {                                                      \
-        case SHMEM_CMP_EQ: ok = cur == value; break;                      \
-        case SHMEM_CMP_NE: ok = cur != value; break;                      \
-        case SHMEM_CMP_GT: ok = cur > value; break;                       \
-        case SHMEM_CMP_LE: ok = cur <= value; break;                      \
-        case SHMEM_CMP_LT: ok = cur < value; break;                       \
-        case SHMEM_CMP_GE: ok = cur >= value; break;                      \
-        default: die("bad shmem_wait_until comparator");                  \
-      }                                                                   \
-      if (ok) return;                                                     \
-      struct timespec ts = {0, 200000};                                   \
-      nanosleep(&ts, NULL);                                               \
+      size_t i = shmem_##NAME##_test_any(ivars, n, status, cmp, value);   \
+      if (i != (size_t)-1) return i;                                      \
+      sync_backoff();                                                     \
+    }                                                                     \
+  }                                                                       \
+  size_t shmem_##NAME##_wait_until_some(T *ivars, size_t n,               \
+                                        size_t *indices,                  \
+                                        const int *status, int cmp,       \
+                                        T value) {                        \
+    if (!n) return 0;                                                     \
+    int excluded_all = 1;                                                 \
+    for (size_t i = 0; i < n; i++)                                        \
+      if (!status || !status[i]) excluded_all = 0;                        \
+    if (excluded_all) return 0;                                           \
+    for (;;) {                                                            \
+      size_t k = shmem_##NAME##_test_some(ivars, n, indices, status,      \
+                                          cmp, value);                    \
+      if (k) return k;                                                    \
+      sync_backoff();                                                     \
     }                                                                     \
   }
 
-WAIT_UNTIL(int, int)
-WAIT_UNTIL(long, long)
+SHMEM_AMO_TYPES(GEN_SYNC)
+
+/* deprecated typed wait (until != value) */
+void shmem_int_wait(int *ivar, int value) {
+  shmem_int_wait_until(ivar, SHMEM_CMP_NE, value);
+}
+void shmem_long_wait(long *ivar, long value) {
+  shmem_long_wait_until(ivar, SHMEM_CMP_NE, value);
+}
+void shmem_longlong_wait(long long *ivar, long long value) {
+  shmem_longlong_wait_until(ivar, SHMEM_CMP_NE, value);
+}
+void shmem_short_wait(short *ivar, short value) {
+  heap_off(ivar, "wait");
+  while ((short)shmem_int_atomic_fetch((int *)(void *)ivar, g_pe) ==
+         value) {
+    /* shorts poll via a 2-byte local reread under the int fetch's
+     * progress side effect */
+    short cur;
+    memcpy(&cur, ivar, sizeof cur);
+    if (cur != value) break;
+    sync_backoff();
+  }
+}
+
+/* ---- distributed locks ---------------------------------------------
+ * The symmetric long lock word's PE-0 copy is the arbiter: value 0 =
+ * free, value pe+1 = held.  clear_lock flushes the critical section
+ * before release, so the next holder observes its writes (the
+ * reference's lock discipline over spml completion). */
+
+void shmem_set_lock(long *lock) {
+  heap_off(lock, "set_lock");
+  for (;;) {
+    long old = shmem_long_atomic_compare_swap(lock, 0L, (long)g_pe + 1, 0);
+    if (old == 0) return;
+    sync_backoff();
+  }
+}
+
+void shmem_clear_lock(long *lock) {
+  heap_off(lock, "clear_lock");
+  shmem_quiet(); /* critical-section writes complete before release */
+  (void)shmem_long_atomic_compare_swap(lock, (long)g_pe + 1, 0L, 0);
+}
+
+int shmem_test_lock(long *lock) {
+  heap_off(lock, "test_lock");
+  long old = shmem_long_atomic_compare_swap(lock, 0L, (long)g_pe + 1, 0);
+  return old == 0 ? 0 : 1;
+}
 
 /* ---- signaled puts (OpenSHMEM 1.5) --------------------------------- */
-/* the uint64 signal cell reuses the generic atomic/wait machinery */
-
-typedef uint64_t tpushmem_u64;
-ATOMICS(uint64, tpushmem_u64, MPI_UINT64_T)  /* standard names */
-WAIT_UNTIL(uint64, tpushmem_u64)
 
 void shmem_putmem_signal(void *dest, const void *source, size_t nelems,
                          uint64_t *sig_addr, uint64_t signal, int sig_op,
@@ -389,6 +838,14 @@ void shmem_putmem_signal(void *dest, const void *source, size_t nelems,
     shmem_uint64_atomic_set(sig_addr, signal, pe);
 }
 
+void shmem_putmem_signal_nbi(void *dest, const void *source, size_t nelems,
+                             uint64_t *sig_addr, uint64_t signal,
+                             int sig_op, int pe) {
+  /* data must still be signal-ordered: flush data, then signal — the
+   * "nbi" latitude is unused (correct, conservatively blocking) */
+  shmem_putmem_signal(dest, source, nelems, sig_addr, signal, sig_op, pe);
+}
+
 uint64_t shmem_signal_fetch(const uint64_t *sig_addr) {
   return shmem_uint64_atomic_fetch(sig_addr, g_pe);
 }
@@ -396,36 +853,26 @@ uint64_t shmem_signal_fetch(const uint64_t *sig_addr) {
 uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
                                  uint64_t cmp_value) {
   /* 1.5 contract: returns the sig_addr contents that SATISFIED the
-   * wait (a later fetch could see further updates, so the loop is
-   * explicit rather than reusing the void-returning wait macro) */
+   * wait (a later fetch could see further updates) */
   heap_off(sig_addr, "signal_wait_until");
   for (;;) {
     uint64_t cur = shmem_uint64_atomic_fetch(sig_addr, g_pe);
-    int ok = 0;
-    switch (cmp) {
-      case SHMEM_CMP_EQ: ok = cur == cmp_value; break;
-      case SHMEM_CMP_NE: ok = cur != cmp_value; break;
-      case SHMEM_CMP_GT: ok = cur > cmp_value; break;
-      case SHMEM_CMP_LE: ok = cur <= cmp_value; break;
-      case SHMEM_CMP_LT: ok = cur < cmp_value; break;
-      case SHMEM_CMP_GE: ok = cur >= cmp_value; break;
-      default: die("bad shmem_signal_wait_until comparator");
-    }
+    int ok;
+    CMP_OK(cur, cmp, cmp_value, ok);
     if (ok) return cur;
-    struct timespec ts = {0, 200000};
-    nanosleep(&ts, NULL);
+    sync_backoff();
   }
 }
 
-/* ---- teams (1.5 subset) ---------------------------------------------
- * Descriptors + membership queries + PE translation over (start,
- * stride, size) triples.  Team COLLECTIVES are not provided (the
- * scoll layer here serves world active sets only — rejected loudly),
- * which covers the common porting uses: rank arithmetic and
- * addressing a strided subset with ordinary put/get/atomics. */
+/* ---- teams (1.5) ----------------------------------------------------
+ * (start, stride, size) descriptors + a REAL communicator per team
+ * (MPI_Comm_create_group over the member world ranks — only members
+ * participate, matching split_strided's collective-over-parent
+ * contract), so team collectives and sync are first-class. */
 
 typedef struct {
   int used, start, stride, size;
+  MPI_Comm comm;
 } tpushmem_team;
 
 #define TEAM_MAX 64
@@ -437,10 +884,40 @@ static tpushmem_team *team_of(shmem_team_t t) {
     g_teams[0].start = 0;
     g_teams[0].stride = 1;
     g_teams[0].size = g_npes;
+    g_teams[0].comm = MPI_COMM_WORLD;
     return &g_teams[0];
   }
   if (t <= 0 || t >= TEAM_MAX || !g_teams[t].used) return NULL;
   return &g_teams[t];
+}
+
+/* build a communicator over (wstart + i*wstride, i < size): collective
+ * over the MEMBER PEs only (MPI_Comm_create_group semantics).  The
+ * tag MUST be a pure function of the member triple — a locally-chosen
+ * value (e.g. a cache-slot index) can differ across PEs whose
+ * team-creation histories differ, and mismatched tags deadlock the
+ * members-only CID agreement. */
+static int subset_tag(int wstart, int wstride, int size) {
+  unsigned h = 2166136261u;
+  h = (h ^ (unsigned)wstart) * 16777619u;
+  h = (h ^ (unsigned)wstride) * 16777619u;
+  h = (h ^ (unsigned)size) * 16777619u;
+  return (int)(h & 0x3fffffff);
+}
+
+static MPI_Comm subset_comm(int wstart, int wstride, int size, int tag) {
+  if (wstart == 0 && wstride == 1 && size == g_npes) return MPI_COMM_WORLD;
+  MPI_Group wg, sg;
+  MPI_Comm_group(MPI_COMM_WORLD, &wg);
+  int *ranks = (int *)malloc(sizeof(int) * (size_t)size);
+  for (int i = 0; i < size; i++) ranks[i] = wstart + i * wstride;
+  MPI_Group_incl(wg, size, ranks, &sg);
+  free(ranks);
+  MPI_Comm c = MPI_COMM_NULL;
+  MPI_Comm_create_group(MPI_COMM_WORLD, sg, tag, &c);
+  MPI_Group_free(&sg);
+  MPI_Group_free(&wg);
+  return c;
 }
 
 int shmem_team_my_pe(shmem_team_t team) {
@@ -467,14 +944,21 @@ int shmem_team_translate_pe(shmem_team_t src_team, int src_pe,
   return off / d->stride;
 }
 
+int shmem_team_get_config(shmem_team_t team, long config_mask,
+                          shmem_team_config_t *config) {
+  (void)config_mask;
+  if (!team_of(team)) return -1;
+  if (config) config->num_contexts = 0;
+  return 0;
+}
+
 int shmem_team_split_strided(shmem_team_t parent, int start, int stride,
                              int size, const shmem_team_config_t *config,
                              long config_mask, shmem_team_t *new_team) {
-  /* Pure local bookkeeping — descriptor arithmetic is SPMD-identical
-   * on every parent PE, so no synchronization is required (collective
-   * semantics hold without a barrier; a world barrier here would
-   * deadlock splits of non-world parents).  Per 1.5, NONMEMBER parent
-   * PEs participate and receive SHMEM_TEAM_INVALID. */
+  /* Collective over the PARENT team's PEs (1.5): members build the
+   * new team's communicator together via MPI_Comm_create_group;
+   * NONMEMBER parent PEs participate trivially and receive
+   * SHMEM_TEAM_INVALID. */
   (void)config;
   (void)config_mask;
   if (new_team) *new_team = SHMEM_TEAM_INVALID;
@@ -493,6 +977,9 @@ int shmem_team_split_strided(shmem_team_t parent, int start, int stride,
       g_teams[i].start = wstart;
       g_teams[i].stride = wstride;
       g_teams[i].size = size;
+      g_teams[i].comm =
+          subset_comm(wstart, wstride, size,
+                      subset_tag(wstart, wstride, size));
       if (new_team) *new_team = (shmem_team_t)i;
       return 0;
     }
@@ -501,115 +988,385 @@ int shmem_team_split_strided(shmem_team_t parent, int start, int stride,
 }
 
 void shmem_team_destroy(shmem_team_t team) {
-  if (team > 0 && team < TEAM_MAX) g_teams[team].used = 0;
+  if (team > 0 && team < TEAM_MAX && g_teams[team].used) {
+    if (g_teams[team].comm != MPI_COMM_NULL &&
+        g_teams[team].comm != MPI_COMM_WORLD)
+      MPI_Comm_free(&g_teams[team].comm);
+    g_teams[team].used = 0;
+  }
 }
 
-/* ---- collectives --------------------------------------------------- */
+int shmem_team_sync(shmem_team_t team) {
+  tpushmem_team *tm = team_of(team);
+  if (!tm) return -1;
+  MPI_Barrier(tm->comm);
+  return 0;
+}
 
-static void check_world(int PE_start, int logPE_stride, int PE_size,
-                        const char *who) {
-  if (PE_start != 0 || logPE_stride != 0 || PE_size != g_npes) {
-    fprintf(stderr, "tpushmem: %s: only the world active set "
-                    "(start=0, stride=0, size=n_pes) is supported\n",
-            who);
+/* ---- collectives ----------------------------------------------------
+ * Active sets map to cached communicators over (PE_start,
+ * 1<<logPE_stride, PE_size) — ANY strided subset works, not just the
+ * world (the round-4 check_world rejection is gone). */
+
+typedef struct {
+  int used, start, stride, size;
+  MPI_Comm comm;
+} asetcomm;
+#define ASET_MAX 64
+static asetcomm g_asets[ASET_MAX];
+
+static MPI_Comm aset_comm(int PE_start, int logPE_stride, int PE_size,
+                          const char *who) {
+  int stride = 1 << logPE_stride;
+  if (PE_start == 0 && stride == 1 && PE_size == g_npes)
+    return MPI_COMM_WORLD;
+  int off = g_pe - PE_start;
+  if (off < 0 || off % stride || off / stride >= PE_size) {
+    fprintf(stderr, "tpushmem: %s: calling PE %d is not in the active "
+                    "set (start=%d, logstride=%d, size=%d)\n",
+            who, g_pe, PE_start, logPE_stride, PE_size);
     MPI_Abort(MPI_COMM_WORLD, 13);
   }
+  for (int i = 0; i < ASET_MAX; i++)
+    if (g_asets[i].used && g_asets[i].start == PE_start &&
+        g_asets[i].stride == stride && g_asets[i].size == PE_size)
+      return g_asets[i].comm;
+  for (int i = 0; i < ASET_MAX; i++)
+    if (!g_asets[i].used) {
+      g_asets[i].used = 1;
+      g_asets[i].start = PE_start;
+      g_asets[i].stride = stride;
+      g_asets[i].size = PE_size;
+      g_asets[i].comm = subset_comm(PE_start, stride, PE_size,
+                                    subset_tag(PE_start, stride, PE_size));
+      return g_asets[i].comm;
+    }
+  die("active-set communicator cache full");
+  return MPI_COMM_NULL;
 }
 
-static void bcast_bytes(void *dest, const void *source, size_t nbytes,
-                        int root) {
-  /* OpenSHMEM: the root's dest is NOT written; others receive */
-  if (g_pe == root) {
-    MPI_Bcast((void *)source, (int)nbytes, MPI_BYTE, root,
-              MPI_COMM_WORLD);
+void shmem_barrier(int PE_start, int logPE_stride, int PE_size,
+                   long *pSync) {
+  (void)pSync;
+  shmem_quiet();
+  MPI_Barrier(aset_comm(PE_start, logPE_stride, PE_size, "shmem_barrier"));
+}
+
+void shmem_sync(int PE_start, int logPE_stride, int PE_size, long *pSync) {
+  (void)pSync;
+  MPI_Barrier(aset_comm(PE_start, logPE_stride, PE_size, "shmem_sync"));
+}
+
+static void bcast_bytes(MPI_Comm comm, void *dest, const void *source,
+                        size_t nbytes, int root_in_comm) {
+  /* active-set broadcast: the root's dest is NOT written (1.4
+   * semantics); others receive */
+  int me;
+  MPI_Comm_rank(comm, &me);
+  if (me == root_in_comm) {
+    MPI_Bcast((void *)source, (int)nbytes, MPI_BYTE, root_in_comm, comm);
   } else {
-    MPI_Bcast(dest, (int)nbytes, MPI_BYTE, root, MPI_COMM_WORLD);
+    MPI_Bcast(dest, (int)nbytes, MPI_BYTE, root_in_comm, comm);
   }
 }
 
-void shmem_broadcast32(void *dest, const void *source, size_t nelems,
-                       int PE_root, int PE_start, int logPE_stride,
-                       int PE_size, long *pSync) {
-  (void)pSync;
-  check_world(PE_start, logPE_stride, PE_size, "shmem_broadcast32");
-  bcast_bytes(dest, source, nelems * 4, PE_root);
-}
+#define GEN_BCAST_SIZED(BITS, BYTES)                                      \
+  void shmem_broadcast##BITS(void *dest, const void *source,              \
+                             size_t nelems, int PE_root, int PE_start,    \
+                             int logPE_stride, int PE_size,               \
+                             long *pSync) {                               \
+    (void)pSync;                                                          \
+    bcast_bytes(aset_comm(PE_start, logPE_stride, PE_size, "broadcast"),  \
+                dest, source, nelems * (BYTES), PE_root);                 \
+  }
 
-void shmem_broadcast64(void *dest, const void *source, size_t nelems,
-                       int PE_root, int PE_start, int logPE_stride,
-                       int PE_size, long *pSync) {
-  (void)pSync;
-  check_world(PE_start, logPE_stride, PE_size, "shmem_broadcast64");
-  bcast_bytes(dest, source, nelems * 8, PE_root);
-}
+GEN_BCAST_SIZED(32, 4)
+GEN_BCAST_SIZED(64, 8)
 
-static void fcollect_bytes(void *dest, const void *source, size_t nbytes) {
+static void fcollect_bytes(MPI_Comm comm, void *dest, const void *source,
+                           size_t nbytes) {
   MPI_Allgather((void *)source, (int)nbytes, MPI_BYTE, dest, (int)nbytes,
-                MPI_BYTE, MPI_COMM_WORLD);
+                MPI_BYTE, comm);
 }
 
-void shmem_fcollect32(void *dest, const void *source, size_t nelems,
-                      int PE_start, int logPE_stride, int PE_size,
-                      long *pSync) {
-  (void)pSync;
-  check_world(PE_start, logPE_stride, PE_size, "shmem_fcollect32");
-  fcollect_bytes(dest, source, nelems * 4);
-}
-
-void shmem_fcollect64(void *dest, const void *source, size_t nelems,
-                      int PE_start, int logPE_stride, int PE_size,
-                      long *pSync) {
-  (void)pSync;
-  check_world(PE_start, logPE_stride, PE_size, "shmem_fcollect64");
-  fcollect_bytes(dest, source, nelems * 8);
-}
-
-static void collect_bytes(void *dest, const void *source, size_t nbytes) {
+static void collect_bytes(MPI_Comm comm, void *dest, const void *source,
+                          size_t nbytes) {
   /* jagged: PEs may contribute different sizes */
+  int np;
+  MPI_Comm_size(comm, &np);
   int n = (int)nbytes;
-  int *counts = (int *)malloc(sizeof(int) * (size_t)g_npes);
-  int *displs = (int *)malloc(sizeof(int) * (size_t)g_npes);
-  MPI_Allgather(&n, 1, MPI_INT, counts, 1, MPI_INT, MPI_COMM_WORLD);
+  int *counts = (int *)malloc(sizeof(int) * (size_t)np);
+  int *displs = (int *)malloc(sizeof(int) * (size_t)np);
+  MPI_Allgather(&n, 1, MPI_INT, counts, 1, MPI_INT, comm);
   int off = 0;
-  for (int i = 0; i < g_npes; i++) {
+  for (int i = 0; i < np; i++) {
     displs[i] = off;
     off += counts[i];
   }
   MPI_Allgatherv((void *)source, n, MPI_BYTE, dest, counts, displs,
-                 MPI_BYTE, MPI_COMM_WORLD);
+                 MPI_BYTE, comm);
   free(counts);
   free(displs);
 }
 
-void shmem_collect32(void *dest, const void *source, size_t nelems,
-                     int PE_start, int logPE_stride, int PE_size,
-                     long *pSync) {
-  (void)pSync;
-  check_world(PE_start, logPE_stride, PE_size, "shmem_collect32");
-  collect_bytes(dest, source, nelems * 4);
+static void alltoall_bytes(MPI_Comm comm, void *dest, const void *source,
+                           size_t nbytes_per_pair) {
+  MPI_Alltoall((void *)source, (int)nbytes_per_pair, MPI_BYTE, dest,
+               (int)nbytes_per_pair, MPI_BYTE, comm);
 }
 
-void shmem_collect64(void *dest, const void *source, size_t nelems,
-                     int PE_start, int logPE_stride, int PE_size,
-                     long *pSync) {
-  (void)pSync;
-  check_world(PE_start, logPE_stride, PE_size, "shmem_collect64");
-  collect_bytes(dest, source, nelems * 8);
+/* strided alltoall: element k for/from peer j lives at index
+ * (j*nelems + k) * stride (in elements) */
+static void alltoalls_bytes(MPI_Comm comm, void *dest, const void *source,
+                            ptrdiff_t dst, ptrdiff_t sst, size_t nelems,
+                            size_t elem) {
+  int np;
+  MPI_Comm_size(comm, &np);
+  size_t total = (size_t)np * nelems * elem;
+  unsigned char *stmp = (unsigned char *)malloc(total ? total : 1);
+  unsigned char *rtmp = (unsigned char *)malloc(total ? total : 1);
+  for (size_t i = 0; i < (size_t)np * nelems; i++)
+    memcpy(stmp + i * elem,
+           (const unsigned char *)source + i * (size_t)sst * elem, elem);
+  MPI_Alltoall(stmp, (int)(nelems * elem), MPI_BYTE, rtmp,
+               (int)(nelems * elem), MPI_BYTE, comm);
+  for (size_t i = 0; i < (size_t)np * nelems; i++)
+    memcpy((unsigned char *)dest + i * (size_t)dst * elem,
+           rtmp + i * elem, elem);
+  free(stmp);
+  free(rtmp);
 }
 
-#define TO_ALL(NAME, T, MPIT, MPIOP, OPTOKEN)                             \
+#define GEN_COLLECT_SIZED(BITS, BYTES)                                    \
+  void shmem_collect##BITS(void *dest, const void *source, size_t nelems, \
+                           int PE_start, int logPE_stride, int PE_size,   \
+                           long *pSync) {                                 \
+    (void)pSync;                                                          \
+    collect_bytes(aset_comm(PE_start, logPE_stride, PE_size, "collect"),  \
+                  dest, source, nelems * (BYTES));                        \
+  }                                                                       \
+  void shmem_fcollect##BITS(void *dest, const void *source,               \
+                            size_t nelems, int PE_start,                  \
+                            int logPE_stride, int PE_size,                \
+                            long *pSync) {                                \
+    (void)pSync;                                                          \
+    fcollect_bytes(                                                       \
+        aset_comm(PE_start, logPE_stride, PE_size, "fcollect"), dest,     \
+        source, nelems * (BYTES));                                        \
+  }                                                                       \
+  void shmem_alltoall##BITS(void *dest, const void *source,               \
+                            size_t nelems, int PE_start,                  \
+                            int logPE_stride, int PE_size,                \
+                            long *pSync) {                                \
+    (void)pSync;                                                          \
+    alltoall_bytes(                                                       \
+        aset_comm(PE_start, logPE_stride, PE_size, "alltoall"), dest,     \
+        source, nelems * (BYTES));                                        \
+  }                                                                       \
+  void shmem_alltoalls##BITS(void *dest, const void *source,              \
+                             ptrdiff_t dst, ptrdiff_t sst, size_t nelems, \
+                             int PE_start, int logPE_stride, int PE_size, \
+                             long *pSync) {                               \
+    (void)pSync;                                                          \
+    alltoalls_bytes(                                                      \
+        aset_comm(PE_start, logPE_stride, PE_size, "alltoalls"), dest,    \
+        source, dst, sst, nelems, (BYTES));                               \
+  }
+
+GEN_COLLECT_SIZED(32, 4)
+GEN_COLLECT_SIZED(64, 8)
+
+/* ---- active-set reductions (1.4 matrix) ----------------------------- */
+
+#define GEN_TO_ALL(NAME, T, MPIT, MPIOP, OPTOKEN)                         \
   void shmem_##NAME##_##OPTOKEN##_to_all(                                 \
       T *dest, const T *source, int nreduce, int PE_start,                \
       int logPE_stride, int PE_size, T *pWrk, long *pSync) {              \
     (void)pWrk;                                                           \
     (void)pSync;                                                          \
-    check_world(PE_start, logPE_stride, PE_size,                          \
-                "shmem_" #NAME "_" #OPTOKEN "_to_all");                   \
     MPI_Allreduce((void *)source, dest, nreduce, MPIT, MPIOP,             \
-                  MPI_COMM_WORLD);                                        \
+                  aset_comm(PE_start, logPE_stride, PE_size,              \
+                            "shmem_" #NAME "_" #OPTOKEN "_to_all"));      \
   }
 
-TO_ALL(int, int, MPI_INT, MPI_SUM, sum)
-TO_ALL(int, int, MPI_INT, MPI_MAX, max)
-TO_ALL(long, long, MPI_LONG, MPI_SUM, sum)
-TO_ALL(double, double, MPI_DOUBLE, MPI_SUM, sum)
+/* integer types get the full op set */
+#define GEN_TO_ALL_INT(NAME, T, MPIT)                                     \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_BAND, and)                                \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_BOR, or)                                  \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_BXOR, xor)                                \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_MIN, min)                                 \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_MAX, max)                                 \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_SUM, sum)                                 \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_PROD, prod)
+
+#define GEN_TO_ALL_FP(NAME, T, MPIT)                                      \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_MIN, min)                                 \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_MAX, max)                                 \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_SUM, sum)                                 \
+  GEN_TO_ALL(NAME, T, MPIT, MPI_PROD, prod)
+
+GEN_TO_ALL_INT(short, short, MPI_SHORT)
+GEN_TO_ALL_INT(int, int, MPI_INT)
+GEN_TO_ALL_INT(long, long, MPI_LONG)
+GEN_TO_ALL_INT(longlong, long long, MPI_LONG_LONG)
+GEN_TO_ALL_FP(float, float, MPI_FLOAT)
+GEN_TO_ALL_FP(double, double, MPI_DOUBLE)
+GEN_TO_ALL(complexf, float _Complex, MPI_C_FLOAT_COMPLEX, MPI_SUM, sum)
+GEN_TO_ALL(complexf, float _Complex, MPI_C_FLOAT_COMPLEX, MPI_PROD, prod)
+GEN_TO_ALL(complexd, double _Complex, MPI_C_DOUBLE_COMPLEX, MPI_SUM, sum)
+GEN_TO_ALL(complexd, double _Complex, MPI_C_DOUBLE_COMPLEX, MPI_PROD,
+           prod)
+
+/* ---- team collectives (1.5) ----------------------------------------- */
+
+static MPI_Comm team_comm(shmem_team_t team, const char *who) {
+  tpushmem_team *tm = team_of(team);
+  if (!tm || tm->comm == MPI_COMM_NULL) {
+    fprintf(stderr, "tpushmem: %s: invalid team or non-member PE %d\n",
+            who, g_pe);
+    MPI_Abort(MPI_COMM_WORLD, 13);
+  }
+  return tm->comm;
+}
+
+int shmem_broadcastmem(shmem_team_t team, void *dest, const void *source,
+                       size_t nelems, int PE_root) {
+  MPI_Comm c = team_comm(team, "broadcastmem");
+  int me;
+  MPI_Comm_rank(c, &me);
+  /* 1.5 team broadcast: dest is updated on ALL team PEs incl. root */
+  if (me == PE_root) {
+    MPI_Bcast((void *)source, (int)nelems, MPI_BYTE, PE_root, c);
+    if (dest != source) memmove(dest, source, nelems);
+  } else {
+    MPI_Bcast(dest, (int)nelems, MPI_BYTE, PE_root, c);
+  }
+  return 0;
+}
+
+int shmem_collectmem(shmem_team_t team, void *dest, const void *source,
+                     size_t nelems) {
+  collect_bytes(team_comm(team, "collectmem"), dest, source, nelems);
+  return 0;
+}
+
+int shmem_fcollectmem(shmem_team_t team, void *dest, const void *source,
+                      size_t nelems) {
+  fcollect_bytes(team_comm(team, "fcollectmem"), dest, source, nelems);
+  return 0;
+}
+
+int shmem_alltoallmem(shmem_team_t team, void *dest, const void *source,
+                      size_t nelems) {
+  alltoall_bytes(team_comm(team, "alltoallmem"), dest, source, nelems);
+  return 0;
+}
+
+int shmem_alltoallsmem(shmem_team_t team, void *dest, const void *source,
+                       ptrdiff_t dst, ptrdiff_t sst, size_t nelems) {
+  alltoalls_bytes(team_comm(team, "alltoallsmem"), dest, source, dst, sst,
+                  nelems, 1);
+  return 0;
+}
+
+#define GEN_TEAM_COLL(NAME, T, MPIT)                                      \
+  int shmem_##NAME##_broadcast(shmem_team_t team, T *dest,                \
+                               const T *source, size_t nelems,            \
+                               int PE_root) {                             \
+    return shmem_broadcastmem(team, dest, source, nelems * sizeof(T),     \
+                              PE_root);                                   \
+  }                                                                       \
+  int shmem_##NAME##_collect(shmem_team_t team, T *dest, const T *source, \
+                             size_t nelems) {                             \
+    return shmem_collectmem(team, dest, source, nelems * sizeof(T));      \
+  }                                                                       \
+  int shmem_##NAME##_fcollect(shmem_team_t team, T *dest,                 \
+                              const T *source, size_t nelems) {           \
+    return shmem_fcollectmem(team, dest, source, nelems * sizeof(T));     \
+  }                                                                       \
+  int shmem_##NAME##_alltoall(shmem_team_t team, T *dest,                 \
+                              const T *source, size_t nelems) {           \
+    return shmem_alltoallmem(team, dest, source, nelems * sizeof(T));     \
+  }                                                                       \
+  int shmem_##NAME##_alltoalls(shmem_team_t team, T *dest,                \
+                               const T *source, ptrdiff_t dst,            \
+                               ptrdiff_t sst, size_t nelems) {            \
+    alltoalls_bytes(team_comm(team, "alltoalls"), dest, source, dst,      \
+                    sst, nelems, sizeof(T));                              \
+    return 0;                                                             \
+  }
+
+SHMEM_RMA_TYPES(GEN_TEAM_COLL)
+
+/* team reductions: {min,max,sum,prod} over the arithmetic types,
+ * {and,or,xor} over the bitwise-capable types (1.5 Table 10) */
+#define GEN_TEAM_REDUCE(NAME, T, MPIT, MPIOP, OPTOKEN)                    \
+  int shmem_##NAME##_##OPTOKEN##_reduce(shmem_team_t team, T *dest,       \
+                                        const T *source,                  \
+                                        size_t nreduce) {                 \
+    MPI_Allreduce((void *)source, dest, (int)nreduce, MPIT, MPIOP,        \
+                  team_comm(team, #OPTOKEN "_reduce"));                   \
+    return 0;                                                             \
+  }
+
+#define GEN_TEAM_REDUCE_ARITH(NAME, T, MPIT)                              \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_MIN, min)                            \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_MAX, max)                            \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_SUM, sum)                            \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_PROD, prod)
+
+#define GEN_TEAM_REDUCE_BITS(NAME, T, MPIT)                               \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_BAND, and)                           \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_BOR, or)                             \
+  GEN_TEAM_REDUCE(NAME, T, MPIT, MPI_BXOR, xor)
+
+/* arithmetic reduce types: the RMA list minus char/schar (spec gives
+ * min/max/sum/prod to the numeric types; char stays put/get-only) */
+#define SHMEM_REDUCE_ARITH_TYPES(X)                                       \
+  X(short, short, MPI_SHORT)                                              \
+  X(int, int, MPI_INT)                                                    \
+  X(long, long, MPI_LONG)                                                 \
+  X(longlong, long long, MPI_LONG_LONG)                                   \
+  X(ushort, unsigned short, MPI_UNSIGNED_SHORT)                           \
+  X(uint, unsigned int, MPI_UNSIGNED)                                     \
+  X(ulong, unsigned long, MPI_UNSIGNED_LONG)                              \
+  X(ulonglong, unsigned long long, MPI_UNSIGNED_LONG_LONG)                \
+  X(float, float, MPI_FLOAT)                                              \
+  X(double, double, MPI_DOUBLE)                                           \
+  X(int8, int8_t, MPI_INT8_T)                                             \
+  X(int16, int16_t, MPI_INT16_T)                                          \
+  X(int32, int32_t, MPI_INT32_T)                                          \
+  X(int64, int64_t, MPI_INT64_T)                                          \
+  X(uint8, uint8_t, MPI_UINT8_T)                                          \
+  X(uint16, uint16_t, MPI_UINT16_T)                                       \
+  X(uint32, uint32_t, MPI_UINT32_T)                                       \
+  X(uint64, uint64_t, MPI_UINT64_T)                                       \
+  X(size, size_t, MPI_UINT64_T)                                           \
+  X(ptrdiff, ptrdiff_t, MPI_INT64_T)
+
+#define SHMEM_REDUCE_BITS_TYPES(X)                                        \
+  X(uchar, unsigned char, MPI_UNSIGNED_CHAR)                              \
+  X(ushort, unsigned short, MPI_UNSIGNED_SHORT)                           \
+  X(uint, unsigned int, MPI_UNSIGNED)                                     \
+  X(ulong, unsigned long, MPI_UNSIGNED_LONG)                              \
+  X(ulonglong, unsigned long long, MPI_UNSIGNED_LONG_LONG)                \
+  X(int8, int8_t, MPI_INT8_T)                                             \
+  X(int16, int16_t, MPI_INT16_T)                                          \
+  X(int32, int32_t, MPI_INT32_T)                                          \
+  X(int64, int64_t, MPI_INT64_T)                                          \
+  X(uint8, uint8_t, MPI_UINT8_T)                                          \
+  X(uint16, uint16_t, MPI_UINT16_T)                                       \
+  X(uint32, uint32_t, MPI_UINT32_T)                                       \
+  X(uint64, uint64_t, MPI_UINT64_T)                                       \
+  X(size, size_t, MPI_UINT64_T)
+
+SHMEM_REDUCE_ARITH_TYPES(GEN_TEAM_REDUCE_ARITH)
+SHMEM_REDUCE_BITS_TYPES(GEN_TEAM_REDUCE_BITS)
+GEN_TEAM_REDUCE(complexf, float _Complex, MPI_C_FLOAT_COMPLEX, MPI_SUM,
+                sum)
+GEN_TEAM_REDUCE(complexf, float _Complex, MPI_C_FLOAT_COMPLEX, MPI_PROD,
+                prod)
+GEN_TEAM_REDUCE(complexd, double _Complex, MPI_C_DOUBLE_COMPLEX, MPI_SUM,
+                sum)
+GEN_TEAM_REDUCE(complexd, double _Complex, MPI_C_DOUBLE_COMPLEX, MPI_PROD,
+                prod)

@@ -682,6 +682,52 @@ class MultiProcComm(PersistentP2PMixin):
                     out[self.locate(r)[1]] = sub
         return out
 
+    def create_group_members(
+        self, members: Sequence[int], tag: int = 0
+    ) -> "MultiProcComm":
+        """MPI_Comm_create_group (MPI-3.0): collective over the GROUP
+        members ONLY — nonmember processes never call, so no full-comm
+        exchange is possible.  CID agreement runs over a temporary
+        sub-view of the member processes on a tag-scoped control
+        stream (the tag plays exactly its standard role: separating
+        concurrent group-creates).  Every member knows the full member
+        list, so the sub-comm wiring is deterministic from there."""
+        self._check()
+        members = [int(r) for r in members]
+        owners = [self.locate(r)[0] for r in members]
+        member_procs: list[int] = []
+        for p in owners:
+            if member_procs and member_procs[-1] == p:
+                continue
+            if p in member_procs:
+                raise MPIArgError(
+                    "create_group: member order interleaves the ranks of "
+                    "different processes — sub-comm rank space must stay "
+                    "process-contiguous"
+                )
+            member_procs.append(p)
+        if self.proc not in member_procs:
+            raise MPIArgError(
+                "MPI_Comm_create_group called by a process outside the "
+                "group (the call is collective over members only)"
+            )
+        # members-only CID agreement: each member process's counter is
+        # part of the max-reduce, so any process that later holds the
+        # new comm can never be handed the same CID twice.  The stream
+        # key hashes the FULL member list: two different groups sharing
+        # a process must never share an agreement stream (their
+        # per-stream sequence counters would desynchronize and hang).
+        import hashlib
+
+        agree = self.dcn.sub(member_procs)
+        digest = hashlib.md5(
+            f"{tag}:{members}".encode()
+        ).hexdigest()[:16]
+        key = f"cg.{digest}"
+        proposals = agree.allgather_obj(_peek_cid(), key)
+        cid = _reserve_cid_block(max(int(p) for p in proposals), 1)
+        return self._make_sub(int(tag), cid, members, owners, member_procs)
+
     def _make_sub(
         self,
         color: int,

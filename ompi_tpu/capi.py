@@ -970,6 +970,26 @@ def comm_create(h: int, gh: int):
         return (_fail(e, h), 0)
 
 
+def comm_create_group(h: int, gh: int, tag: int):
+    """MPI_Comm_create_group (MPI-3.0): collective over the GROUP
+    members only — routed to the members-only construction path (the
+    full-comm split behind comm_create would deadlock: nonmembers
+    never call)."""
+    try:
+        c = _comm(h)
+        g = _group(gh)
+        if g.size == 0:
+            return (MPI_SUCCESS, 0)
+        if _is_single_controller(c):
+            sub = c.create_group(g)
+            return (MPI_SUCCESS,
+                    _store_comm(sub, h) if sub is not None else 0)
+        sub = c.create_group_members(list(g.ranks), int(tag))
+        return (MPI_SUCCESS, _store_comm(sub, h))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
 def comm_compare(ha: int, hb: int):
     """MPI_Comm_compare: IDENT(0)/CONGRUENT(1)/SIMILAR(2)/UNEQUAL(3)."""
     try:
@@ -1626,6 +1646,14 @@ def file_open(h: int, path: str, amode: int):
         c = _comm(h)
         if _is_single_controller(c):
             f = c.file_open(path, amode)
+            # authoritative shared-pointer reset: a stale <path>.shfp
+            # left by an earlier job must not leak in (creator-only
+            # seeding inside File.__init__ deliberately skips existing
+            # side files; with one controlling process there are no
+            # unsynchronized peers to protect, so reset is safe here)
+            from ompi_tpu.io.file import MODE_APPEND
+
+            f._sharedfp.set(f.get_size() if amode & MODE_APPEND else 0)
             ent = (f, False, 0, c)
         else:
             from ompi_tpu.io.file import MODE_DELETE_ON_CLOSE
@@ -1653,6 +1681,19 @@ def file_open(h: int, path: str, amode: int):
                 raise exc if exc is not None else err.MPIFileError(
                     f"collective open of {path!r} failed on a peer process"
                 )
+            # shared-pointer epoch: every peer's open (and creator-only
+            # seed) is complete by the agreement above, so one
+            # designated process now authoritatively resets the
+            # cross-process pointer (a stale <path>.shfp from an
+            # earlier job on the same path must not leak in), and a
+            # second barrier orders that reset before any peer's
+            # write_shared/read_shared
+            if c.proc == 0:
+                from ompi_tpu.io.file import MODE_APPEND
+
+                f._sharedfp.set(f.get_size() if amode & MODE_APPEND
+                                else 0)
+            c.barrier()
             ent = (f, True, 0, c)
         handle = _next_file_h
         _next_file_h += 1

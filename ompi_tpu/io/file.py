@@ -174,11 +174,15 @@ class File:
             for rs in self._ranks:
                 # position individual+shared pointers at end (in etype=BYTE units)
                 rs.ptr = end
-            self._sharedfp.set(end)
+            self._sharedfp.seed(end)
         else:
-            # fresh open: a stale persistent pointer (lockedfile .shfp
-            # from an earlier job on the same path) must not leak in
-            self._sharedfp.set(0)
+            # fresh open: seed 0.  For the cross-process lockedfile
+            # strategy only the side file's CREATOR seeds here (a late
+            # unsynchronized opener must not clobber a pointer peers
+            # already advanced); the stale-.shfp-from-an-earlier-job
+            # case is handled by the designated-rank reset + barrier in
+            # capi.file_open's collective completion.
+            self._sharedfp.seed(0)
 
     # -- lifecycle ------------------------------------------------------
 

@@ -52,6 +52,13 @@ class SmSharedfp:
         with self._mu:
             self._pos = int(pos)
 
+    def seed(self, pos: int) -> None:
+        """Open-time initialization.  In-process strategies just set;
+        the cross-process lockedfile strategy overrides this so only
+        the CREATOR of the side file seeds — a late collective opener
+        must not clobber a shared pointer peers already advanced."""
+        self.set(pos)
+
     def update(self, fn) -> int:
         """Atomic read-modify-write: pos = fn(pos); returns the new
         value (seek_shared's SEEK_CUR needs the whole RMW under ONE
@@ -83,9 +90,16 @@ class LockedfileSharedfp:
 
     def __init__(self, path: str):
         self._side = path + ".shfp"
-        # O_CREAT without O_EXCL: every opener shares the same side
-        # file; the first one finds it empty and seeds 0
-        self._fd = os.open(self._side, os.O_RDWR | os.O_CREAT, 0o644)
+        # O_EXCL probe: exactly ONE opener (the creator) learns it owns
+        # seeding; late openers share the existing side file and must
+        # not reset a pointer peers may already have advanced
+        try:
+            self._fd = os.open(
+                self._side, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o644)
+            self.created = True
+        except FileExistsError:
+            self._fd = os.open(self._side, os.O_RDWR, 0o644)
+            self.created = False
 
     def _read_locked(self) -> int:
         os.lseek(self._fd, 0, os.SEEK_SET)
@@ -127,6 +141,13 @@ class LockedfileSharedfp:
             return new
         finally:
             fcntl.flock(self._fd, fcntl.LOCK_UN)
+
+    def seed(self, pos: int) -> None:
+        """Only the side file's creator seeds: a collective-but-
+        unsynchronized open must not reset a live pointer a faster
+        peer already advanced with write_shared/read_shared."""
+        if self.created:
+            self.set(pos)
 
     def close(self) -> None:
         try:

@@ -107,8 +107,10 @@ def test_shmem_c_suite(shmem_suite_bin, npes):
 
 
 def test_shmem_symbol_surface():
-    """libtpushmem exports the core shmem_* entry points (the ~50-name
-    subset of the reference's 838; SURVEY §2.5)."""
+    """libtpushmem exports the OpenSHMEM API families (VERDICT r4 next
+    #2/#8 gate: >= 250 symbols — the phase-2 build exports ~1030 vs the
+    reference liboshmem's 836; SURVEY §2.5).  One representative per
+    family is pinned by name so a macro-list regression is loud."""
     lib = BUILD / "libtpushmem.so"
     if not lib.exists():
         pytest.skip("libtpushmem not built")
@@ -117,17 +119,55 @@ def test_shmem_symbol_surface():
     syms = {l.split()[-1] for l in out.splitlines()
             if " T " in l and "shmem_" in l}
     required = {
+        # setup / heap / ordering
         "shmem_init", "shmem_finalize", "shmem_my_pe", "shmem_n_pes",
         "shmem_malloc", "shmem_calloc", "shmem_align", "shmem_free",
         "shmem_barrier_all", "shmem_quiet", "shmem_fence",
+        "shmem_ptr", "shmem_pe_accessible",
+        # RMA: typed, sized, mem, single-element, strided, non-blocking
         "shmem_putmem", "shmem_getmem", "shmem_int_put", "shmem_int_get",
         "shmem_long_put", "shmem_double_put", "shmem_int_p",
-        "shmem_int_g", "shmem_int_atomic_fetch_add",
-        "shmem_int_atomic_compare_swap", "shmem_long_atomic_swap",
-        "shmem_int_wait_until", "shmem_broadcast64", "shmem_collect64",
-        "shmem_fcollect64", "shmem_int_sum_to_all",
-        "shmem_double_sum_to_all", "shmem_ptr", "shmem_pe_accessible",
+        "shmem_int_g", "shmem_size_put", "shmem_ptrdiff_get",
+        "shmem_put128", "shmem_get16",
+        "shmem_putmem_nbi", "shmem_getmem_nbi", "shmem_double_put_nbi",
+        "shmem_uint64_get_nbi", "shmem_int_iput", "shmem_long_iget",
+        "shmem_iput64", "shmem_iget32",
+        # atomics: standard, bitwise, extended-float, deprecated
+        "shmem_int_atomic_fetch_add", "shmem_int_atomic_compare_swap",
+        "shmem_long_atomic_swap", "shmem_uint64_atomic_fetch_add",
+        "shmem_size_atomic_inc", "shmem_uint32_atomic_fetch_or",
+        "shmem_int64_atomic_fetch_xor", "shmem_ulonglong_atomic_and",
+        "shmem_float_atomic_swap", "shmem_double_atomic_fetch",
+        "shmem_int_fadd", "shmem_long_cswap",
+        # point synchronization
+        "shmem_int_wait_until", "shmem_long_wait_until_all",
+        "shmem_int64_wait_until_any", "shmem_size_wait_until_some",
+        "shmem_int_test", "shmem_long_test_all", "shmem_uint64_test_any",
+        "shmem_ptrdiff_test_some", "shmem_int_wait",
+        # locks
+        "shmem_set_lock", "shmem_clear_lock", "shmem_test_lock",
+        # signals
+        "shmem_putmem_signal", "shmem_signal_fetch",
+        "shmem_signal_wait_until",
+        # contexts
+        "shmem_ctx_create", "shmem_ctx_destroy", "shmem_ctx_quiet",
+        "shmem_ctx_fence", "shmem_ctx_int_put", "shmem_ctx_long_get",
+        "shmem_ctx_int_atomic_fetch_add", "shmem_ctx_get_team",
+        # teams
+        "shmem_team_split_strided", "shmem_team_my_pe",
+        "shmem_team_translate_pe", "shmem_team_sync",
+        "shmem_team_get_config", "shmem_team_destroy",
+        # collectives: active-set (incl. alltoall) + team-based
+        "shmem_broadcast64", "shmem_collect64", "shmem_fcollect64",
+        "shmem_alltoall32", "shmem_alltoalls64", "shmem_barrier",
+        "shmem_sync", "shmem_int_sum_to_all", "shmem_double_sum_to_all",
+        "shmem_float_min_to_all", "shmem_short_and_to_all",
+        "shmem_longlong_prod_to_all", "shmem_complexd_sum_to_all",
+        "shmem_broadcastmem", "shmem_alltoallmem", "shmem_int_broadcast",
+        "shmem_double_fcollect", "shmem_long_alltoall",
+        "shmem_int_sum_reduce", "shmem_uint64_max_reduce",
+        "shmem_size_and_reduce", "shmem_complexf_sum_reduce",
     }
     missing = required - syms
     assert not missing, f"missing shmem symbols: {sorted(missing)}"
-    assert len(syms) >= 50, f"only {len(syms)} shmem_* symbols"
+    assert len(syms) >= 250, f"only {len(syms)} shmem_* symbols"
