@@ -132,6 +132,51 @@ int main(int argc, char **argv) {
     CHECK(reqs == 0, "freed_comm_no_request_leak");
   }
 
+  /* -- (d) MPI_Comm_create_group: collective over MEMBERS ONLY ------- */
+  {
+    /* both ranks are members here, but the call must complete without
+     * any full-comm exchange; rank order {1,0} flips the leader */
+    MPI_Group wg, g;
+    MPI_Comm_group(MPI_COMM_WORLD, &wg);
+    int order[2] = {1, 0};
+    MPI_Group_incl(wg, 2, order, &g);
+    MPI_Comm gc = MPI_COMM_NULL;
+    int rc = MPI_Comm_create_group(MPI_COMM_WORLD, g, 17, &gc);
+    CHECK(rc == MPI_SUCCESS && gc != MPI_COMM_NULL, "create_group_rc");
+    int grank = -1, gsize = -1;
+    MPI_Comm_rank(gc, &grank);
+    MPI_Comm_size(gc, &gsize);
+    /* group order {1,0}: world rank 1 becomes rank 0 */
+    CHECK(gsize == 2 && grank == (rank == 1 ? 0 : 1),
+          "create_group_rank_order");
+    int v = 9100 + rank, got = -1;
+    MPI_Status st;
+    if (grank == 0) {
+      MPI_Send(&v, 1, MPI_INT, 1, 5, gc);
+      MPI_Recv(&got, 1, MPI_INT, 1, 5, gc, &st);
+      CHECK(got == 9100 + (1 - rank), "create_group_msg");
+    } else {
+      MPI_Recv(&got, 1, MPI_INT, 0, 5, gc, &st);
+      MPI_Send(&v, 1, MPI_INT, 0, 5, gc);
+      CHECK(got == 9100 + (1 - rank), "create_group_msg");
+    }
+    MPI_Comm_free(&gc);
+    /* singleton group: ONLY its member calls (the other rank does NOT
+     * participate at all — the members-only contract) */
+    MPI_Group sg;
+    int self[1] = {rank};
+    MPI_Group_incl(wg, 1, self, &sg);
+    MPI_Comm sc = MPI_COMM_NULL;
+    rc = MPI_Comm_create_group(MPI_COMM_WORLD, sg, 18 + rank, &sc);
+    int ssize = -1;
+    MPI_Comm_size(sc, &ssize);
+    CHECK(rc == MPI_SUCCESS && ssize == 1, "create_group_singleton");
+    MPI_Comm_free(&sc);
+    MPI_Group_free(&sg);
+    MPI_Group_free(&g);
+    MPI_Group_free(&wg);
+  }
+
   MPI_Barrier(MPI_COMM_WORLD);
   if (rank == 0) printf("SUITE4 COMPLETE\n");
   MPI_Finalize();

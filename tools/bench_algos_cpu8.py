@@ -48,6 +48,28 @@ ALLGATHER = {
     "ring": base.allgather_ring,
     "bruck": base.allgather_bruck,
 }
+BCAST = {
+    "direct": base.bcast_direct,
+    "binomial": base.bcast_binomial,
+    "pipeline": base.bcast_pipeline,
+}
+REDUCE = {
+    "binomial": base.reduce_binomial,
+    "ordered": base.reduce_ordered,
+}
+REDUCE_SCATTER = {
+    "direct": base.reduce_scatter_direct,
+    "ring": base.reduce_scatter_ring,
+    "ordered": base.reduce_scatter_ordered,
+}
+ALLTOALL = {
+    "direct": base.alltoall_direct,
+    "pairwise": base.alltoall_pairwise,
+}
+BARRIER = {
+    "allreduce": base.barrier_allreduce,
+    "dissemination": base.barrier_dissemination,
+}
 
 
 def timed(fn, x, iters, out_specs=None):
@@ -74,10 +96,19 @@ def timed(fn, x, iters, out_specs=None):
 
 
 def main() -> None:
-    out = {"n_devices": N, "allreduce": {}, "allgather": {}}
+    """All SEVEN coll/base algorithm families (VERDICT r4 next #5):
+    allreduce, allgather, bcast, reduce, reduce_scatter, alltoall,
+    barrier — each variant timed at a latency-regime and a
+    bandwidth-regime payload on the n=8 virtual mesh."""
+    P = jax.sharding.PartitionSpec
+    out = {"n_devices": N, "allreduce": {}, "allgather": {}, "bcast": {},
+           "reduce": {}, "reduce_scatter": {}, "alltoall": {},
+           "barrier": {}}
     for regime, elems, iters in (("small_us", 256, 30),
                                  ("large_us", 1 << 20, 5)):
         x = np.ones((N, elems), np.float32)
+        # (N, N, blk) layout for the block-distributed families
+        xb = np.ones((N, N, max(1, elems // N)), np.float32)
         for name, fn in ALLREDUCE.items():
             wrapped = (lambda f: lambda v: f(v, SUM, N))(fn)
             out["allreduce"].setdefault(name, {})[regime] = round(
@@ -85,8 +116,29 @@ def main() -> None:
         for name, fn in ALLGATHER.items():
             g = (lambda f: lambda v: f(v, N))(fn)
             out["allgather"].setdefault(name, {})[regime] = round(
-                timed(g, x, iters,
-                      out_specs=jax.sharding.PartitionSpec()), 1)
+                timed(g, x, iters, out_specs=P()), 1)
+        for name, fn in BCAST.items():
+            b = (lambda f: lambda v: f(v, N, 0))(fn)
+            out["bcast"].setdefault(name, {})[regime] = round(
+                timed(b, x, iters), 1)
+        for name, fn in REDUCE.items():
+            r = (lambda f: lambda v: f(v, SUM, N, 0))(fn)
+            out["reduce"].setdefault(name, {})[regime] = round(
+                timed(r, x, iters), 1)
+        for name, fn in REDUCE_SCATTER.items():
+            rs = (lambda f: lambda v: f(v[0], SUM, N))(fn)
+            out["reduce_scatter"].setdefault(name, {})[regime] = round(
+                timed(rs, xb, iters), 1)
+        for name, fn in ALLTOALL.items():
+            a2a = (lambda f: lambda v: f(v[0], N))(fn)
+            out["alltoall"].setdefault(name, {})[regime] = round(
+                timed(a2a, xb, iters), 1)
+        if regime == "small_us":  # barriers carry no payload
+            for name, fn in BARRIER.items():
+                bar = (lambda f: lambda v: v[0, :1] + f(N).astype(
+                    np.float32))(fn)
+                out["barrier"].setdefault(name, {})[regime] = round(
+                    timed(bar, x, iters), 1)
     print("ALGOS8 " + json.dumps(out), flush=True)
 
 

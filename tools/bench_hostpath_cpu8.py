@@ -1,0 +1,100 @@
+"""Host-path (stage-in → collective → stage-out) rows on the 8-device
+virtual CPU mesh — the D2H perf evidence the tunnel cannot provide
+(VERDICT r4 next #6).
+
+On the axon tunnel the first D2H of a computed result degrades the
+stream to ~100 ms/op process-wide (see BENCH_DETAIL hostpath_note), so
+the TPU-leg hostpath rows are poisoned by the environment.  Here D2H is
+real and cheap: numpy in, numpy out, every row a median over
+per-iteration samples with coherent GB/s.  Prints ONE line
+``HOSTPATH8 {json}``.
+"""
+
+import json
+import os
+import time
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", "")
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+
+def main() -> None:
+    world = api.init()
+    n = world.size
+    rows = []
+    arena0 = world.mesh.arena.stats()
+    for nb in (65536, 1 << 20, 16 << 20):
+        count = max(1, nb // 4)
+        hbuf = np.random.default_rng(3).standard_normal(
+            (n, count), dtype=np.float32)
+        iters = 24 if nb <= 1 << 20 else 10
+        # warmup compiles + pools the staging buffers
+        for _ in range(3):
+            out = world.allreduce(hbuf, SUM)
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = world.allreduce(hbuf, SUM)  # numpy in -> numpy out
+            ts.append(time.perf_counter() - t0)
+        assert isinstance(out, np.ndarray) or not hasattr(out, "device")
+        med = float(np.median(ts))
+        rows.append({
+            "bytes": nb,
+            "iters": iters,
+            "fw_us_p50": round(med * 1e6, 2),
+            "fw_us_min": round(min(ts) * 1e6, 2),
+            "fw_GBs": round(nb / med / 1e9, 3),
+        })
+    arena1 = world.mesh.arena.stats()
+    arena = {
+        k: (arena1[k] if isinstance(arena1[k], bool) or arena1[k] == -1
+            else arena1[k] - arena0.get(k, 0))
+        for k in arena1
+    }
+
+    # -- non-blocking overlap at n=8, where a collective costs real
+    # time (the n_ranks=1 TPU row can't show overlap: a single-chip
+    # allreduce is ~20 us, under the async machinery's own overhead).
+    # Shares bench.py's estimator — one calibrated interleaved window.
+    import bench
+
+    xo = world.mesh.stage_in(np.ones((n, 1 << 20), np.float32))
+    overlap8 = bench.measure_overlap(
+        lambda: jax.block_until_ready(world.allreduce(xo, SUM)),
+        lambda: world.iallreduce(xo, SUM),
+        iters=12,
+    )
+    overlap8["bytes"] = 4 << 20
+    overlap8["note"] = (
+        "on a 1-core host the XLA cpu collective and the numpy compute "
+        "share the core: overlap is bounded by async dispatch, not "
+        "parallel capacity — positive saving here means the dispatch "
+        "itself is non-blocking; the dispatch-level overlap contract is "
+        "separately pinned by test_tpurun_nonblocking_progress"
+    )
+    api.finalize()
+    print("HOSTPATH8 " + json.dumps({
+        "n_devices": n,
+        "rows": rows,
+        "arena": arena,
+        "overlap8": overlap8,
+        "note": "real D2H on the CPU backend: stage_in + collective + "
+                "stage_out per call, medians of per-iteration samples; "
+                "overlap8 = the n=8 non-blocking overlap evidence "
+                "(interleaved-window estimator, calibrated compute)",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
