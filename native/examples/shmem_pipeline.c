@@ -1,0 +1,117 @@
+/* OpenSHMEM 1.5 showcase — a flow-controlled producer/consumer
+ * pipeline built from the phase-2 API families working together:
+ *
+ *   signals   — producers hand blocks to their right neighbor with
+ *               shmem_putmem_signal (data-before-signal ordering);
+ *   wait      — consumers block in shmem_signal_wait_until; producers
+ *               block in shmem_uint64_wait_until on the ACK counter
+ *               (the back-pressure that stops round r+1 overwriting
+ *               the inbox while round r is still being summed);
+ *   contexts  — the ACK counter updates ride a private context
+ *               (shmem_ctx_uint64_atomic_fetch_add);
+ *   teams     — the even PEs form a compute team (split_strided) that
+ *               reduces partial results with a team collective;
+ *   locks     — a global result cell is guarded by shmem_set_lock;
+ *   _nbi      — the final gather uses non-blocking gets completed by
+ *               one shmem_quiet.
+ *
+ * Run:  python -m ompi_tpu run -np 4 native/examples/shmem_pipeline
+ * (any np >= 2 works; compile with mpicc-style wrapper + -ltpushmem)
+ */
+#include <shmem.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define BLOCK 1024
+#define ROUNDS 4
+
+int main(void) {
+  shmem_init();
+  int me = shmem_my_pe(), n = shmem_n_pes();
+  int right = (me + 1) % n, left = (me - 1 + n) % n;
+
+  double *inbox = (double *)shmem_calloc(BLOCK, sizeof(double));
+  uint64_t *sig = (uint64_t *)shmem_calloc(1, sizeof(uint64_t));
+  uint64_t *ack = (uint64_t *)shmem_calloc(1, sizeof(uint64_t));
+  long *lock = (long *)shmem_calloc(1, sizeof(long));
+  double *global_sum = (double *)shmem_calloc(1, sizeof(double));
+  double *partials = (double *)shmem_calloc(1, sizeof(double));
+
+  shmem_ctx_t ctx;
+  if (shmem_ctx_create(SHMEM_CTX_PRIVATE, &ctx) != 0) {
+    fprintf(stderr, "ctx_create failed\n");
+    shmem_global_exit(1);
+  }
+
+  double local_acc = 0.0;
+  double out[BLOCK];
+  for (int r = 0; r < ROUNDS; r++) {
+    /* back-pressure: wait until the consumer ACKed round r-1 (our ack
+     * counter counts rounds our RIGHT neighbor finished consuming) */
+    if (r > 0) shmem_uint64_wait_until(ack, SHMEM_CMP_GE, (uint64_t)r);
+    /* produce a block and signal it to the right neighbor: signal
+     * value r+1 doubles as the round tag */
+    for (int i = 0; i < BLOCK; i++)
+      out[i] = me + r * 0.001 + i * 1e-6;
+    shmem_putmem_signal(inbox, out, BLOCK * sizeof(double), sig,
+                        (uint64_t)(r + 1), SHMEM_SIGNAL_SET, right);
+    /* consume the block from the left neighbor once its signal fires */
+    (void)shmem_signal_wait_until(sig, SHMEM_CMP_GE, (uint64_t)(r + 1));
+    double s = 0.0;
+    for (int i = 0; i < BLOCK; i++) s += inbox[i];
+    local_acc += s;
+    /* ACK the producer (our LEFT neighbor) on the private context:
+     * it may now overwrite our inbox with round r+1 */
+    (void)shmem_ctx_uint64_atomic_fetch_add(ctx, ack, 1, left);
+  }
+  shmem_ctx_quiet(ctx);
+  shmem_ctx_destroy(ctx);
+
+  /* lock-guarded accumulation into PE 0's global cell (non-atomic RMW
+   * made safe by the distributed lock) */
+  shmem_set_lock(lock);
+  double cur = shmem_double_g(global_sum, 0);
+  shmem_double_p(global_sum, cur + local_acc, 0);
+  shmem_quiet();
+  shmem_clear_lock(lock);
+  shmem_barrier_all();
+
+  /* the even-PE compute team cross-checks with a team reduction */
+  *partials = local_acc;
+  shmem_barrier_all();
+  shmem_team_t evens;
+  int esize = (n + 1) / 2;
+  shmem_team_split_strided(SHMEM_TEAM_WORLD, 0, 2, esize, NULL, 0,
+                           &evens);
+  if (me % 2 == 0) {
+    double *team_sum = (double *)malloc(sizeof(double));
+    shmem_double_sum_reduce(evens, team_sum, partials, 1);
+    if (shmem_team_my_pe(evens) == 0)
+      printf("team(evens) partial-sum = %.6f over %d PEs\n", *team_sum,
+             shmem_team_n_pes(evens));
+    free(team_sum);
+    shmem_team_destroy(evens);
+  }
+  shmem_barrier_all();
+
+  /* final check on PE 0: non-blocking gets of every PE's partial,
+   * completed by ONE quiet */
+  if (me == 0) {
+    double *all = (double *)malloc(sizeof(double) * (size_t)n);
+    for (int p = 0; p < n; p++)
+      shmem_double_get_nbi(&all[p], partials, 1, p);
+    shmem_quiet();
+    double expect = 0.0;
+    for (int p = 0; p < n; p++) expect += all[p];
+    double got = shmem_double_g(global_sum, 0);
+    int ok = got > expect - 1e-6 && got < expect + 1e-6;
+    printf("pipeline %s: lock-accumulated %.6f vs nbi-gathered %.6f\n",
+           ok ? "OK" : "MISMATCH", got, expect);
+    free(all);
+    if (!ok) shmem_global_exit(2);
+  }
+  shmem_barrier_all();
+  shmem_finalize();
+  return 0;
+}

@@ -783,14 +783,18 @@ void shmem_longlong_wait(long long *ivar, long long value) {
   shmem_longlong_wait_until(ivar, SHMEM_CMP_NE, value);
 }
 void shmem_short_wait(short *ivar, short value) {
+  /* no 2-byte AMO exists, so the VALUE is read from the local mapping
+   * with a 2-byte memcpy (a 4-byte fetch through an int* would read
+   * past the cell).  The progress rule still applies: each backoff
+   * iteration performs a NO_OP engine fetch of heap offset 0 on self,
+   * which drives the osc engine exactly like the typed waits do. */
   heap_off(ivar, "wait");
-  while ((short)shmem_int_atomic_fetch((int *)(void *)ivar, g_pe) ==
-         value) {
-    /* shorts poll via a 2-byte local reread under the int fetch's
-     * progress side effect */
+  for (;;) {
     short cur;
     memcpy(&cur, ivar, sizeof cur);
-    if (cur != value) break;
+    if (cur != value) return;
+    uint64_t old, dummy = 0;
+    amo_fop(&dummy, &old, MPI_UINT64_T, g_pe, g_heap, MPI_NO_OP, "wait");
     sync_backoff();
   }
 }

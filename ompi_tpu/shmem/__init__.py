@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -281,3 +282,173 @@ def sum_to_all(x):
     from ompi_tpu.op import SUM
 
     return _st().world.allreduce(np.asarray(x), SUM)
+
+
+# -- point synchronization (1.4/1.5 wait/test) --------------------------
+
+CMP_EQ, CMP_NE, CMP_GT, CMP_LE, CMP_LT, CMP_GE = range(6)
+
+_CMP = {
+    CMP_EQ: lambda a, b: a == b,
+    CMP_NE: lambda a, b: a != b,
+    CMP_GT: lambda a, b: a > b,
+    CMP_LE: lambda a, b: a <= b,
+    CMP_LT: lambda a, b: a < b,
+    CMP_GE: lambda a, b: a >= b,
+}
+
+
+def test(ivar: SymmArray, cmp: int, value, pe: int | None = None) -> bool:
+    """shmem_test on element 0 of ``ivar`` (own PE by default)."""
+    target = my_pe() if pe is None else pe
+    return bool(_CMP[cmp](atomic_fetch(ivar, target), value))
+
+
+def wait_until(ivar: SymmArray, cmp: int, value) -> None:
+    """shmem_wait_until on the calling PE's copy of ``ivar``."""
+    me = my_pe()
+    while not _CMP[cmp](atomic_fetch(ivar, me), value):
+        time.sleep(0.0002)
+
+
+# -- distributed locks --------------------------------------------------
+# The PE-0 copy of the symmetric lock word is the arbiter (the same
+# discipline as libtpushmem): 0 = free, pe+1 = held.
+
+
+def set_lock(lock: SymmArray) -> None:
+    token = my_pe() + 1
+    while int(atomic_compare_swap(lock, 0, token, 0)) != 0:
+        time.sleep(0.0002)
+
+
+def clear_lock(lock: SymmArray) -> None:
+    quiet()  # critical-section writes complete before the release
+    atomic_compare_swap(lock, my_pe() + 1, 0, 0)
+
+
+def test_lock(lock: SymmArray) -> int:
+    """0 = acquired, 1 = busy (OpenSHMEM return convention)."""
+    return 0 if int(atomic_compare_swap(lock, 0, my_pe() + 1, 0)) == 0 \
+        else 1
+
+
+# -- signaled puts (1.5) ------------------------------------------------
+
+SIGNAL_SET = 0
+SIGNAL_ADD = 1
+
+
+def put_signal(dest: SymmArray, source, sig: SymmArray, signal: int,
+               pe: int, sig_op: int = SIGNAL_SET) -> None:
+    """shmem_putmem_signal: the data put completes remotely BEFORE the
+    signal update becomes visible (put() here is remote-complete at
+    return, so the ordering contract holds a fortiori)."""
+    put(dest, source, pe)
+    if sig_op == SIGNAL_ADD:
+        atomic_fetch_add(sig, signal, pe)
+    elif sig_op == SIGNAL_SET:
+        atomic_set(sig, signal, pe)
+    else:
+        raise MPIArgError(f"bad sig_op {sig_op}")
+
+
+def signal_wait_until(sig: SymmArray, cmp: int, value) -> int:
+    """Returns the signal value that satisfied the wait (1.5)."""
+    me = my_pe()
+    while True:
+        cur = atomic_fetch(sig, me)
+        if _CMP[cmp](cur, value):
+            return int(cur)
+        time.sleep(0.0002)
+
+
+# -- teams (1.5) --------------------------------------------------------
+
+
+class Team:
+    """A (start, stride, size) strided subset of the world with a REAL
+    sub-communicator, so team collectives and sync are first-class —
+    the Python face of the C layer's team objects."""
+
+    def __init__(self, comm, start: int, stride: int, size: int):
+        self._comm = comm
+        self.start = start
+        self.stride = stride
+        self.size = size
+
+    def my_pe(self) -> int:
+        off = my_pe() - self.start
+        if off < 0 or off % self.stride or off // self.stride >= self.size:
+            return -1
+        return off // self.stride
+
+    def n_pes(self) -> int:
+        return self.size
+
+    def translate_pe(self, src_pe: int, dest: "Team") -> int:
+        if src_pe < 0 or src_pe >= self.size:
+            return -1
+        world = self.start + src_pe * self.stride
+        off = world - dest.start
+        if off < 0 or off % dest.stride or off // dest.stride >= dest.size:
+            return -1
+        return off // dest.stride
+
+    def sync(self) -> None:
+        self._comm.barrier()
+
+    def sum_reduce(self, x):
+        from ompi_tpu.op import SUM
+
+        return self._comm.allreduce(np.asarray(x), SUM)
+
+    def max_reduce(self, x):
+        from ompi_tpu.op import MAX
+
+        return self._comm.allreduce(np.asarray(x), MAX)
+
+    def broadcast(self, x, root: int = 0):
+        return self._comm.bcast(np.asarray(x), root)
+
+    def destroy(self) -> None:
+        if self._comm is not None and self._comm is not _st().world:
+            self._comm.free()
+        self._comm = None
+
+
+def team_world() -> Team:
+    st = _st()
+    return Team(st.world, 0, 1, st.world.size)
+
+
+def team_split_strided(start: int, stride: int, size: int) -> Team | None:
+    """Collective over ALL world PEs (the parent team), per 1.5:
+    members receive a Team, nonmembers None.  The sub-communicator
+    comes from the comm layer's split (color by membership)."""
+    st = _st()
+    if size < 1 or stride < 1 or start < 0 \
+            or start + (size - 1) * stride >= st.world.size:
+        raise MPIArgError("invalid team triple")
+    member = {start + i * stride for i in range(size)}
+    if st.multi:
+        from ompi_tpu.api.multiproc import COLOR_UNDEFINED
+
+        colors = [0 if pe in member else COLOR_UNDEFINED
+                  for pe in st.local_pes]
+        keys = [pe for pe in st.local_pes]
+        subs = st.world.split(colors, keys)
+        # the calling identity is the PRIMARY local PE (my_pe() ==
+        # local_pes[0]): its membership decides Team-vs-None, never a
+        # secondary local rank's
+        sub = subs[0]
+    else:
+        if member == set(range(st.world.size)):
+            sub = st.world
+        else:
+            from ompi_tpu.api.group import Group
+
+            sub = st.world.create_group(Group(sorted(member)))
+    if sub is None:
+        return None
+    return Team(sub, start, stride, size)

@@ -73,6 +73,56 @@ def test_shmem_python_multiproc():
     assert sum("OK shmem_py " in l for l in out.splitlines()) == 3
 
 
+def test_shmem_python_phase2_single_controller():
+    """The Python twin's phase-2 families: locks, wait/test, signaled
+    puts, and teams with real sub-communicators."""
+    import ompi_tpu.shmem as shmem
+
+    shmem.init(heap_bytes=1 << 20)
+    try:
+        n = shmem.n_pes()
+        # locks: acquire marks the word with pe+1; test_lock sees busy
+        lk = shmem.malloc(1, np.int64)
+        lk.view()[:] = 0
+        shmem.set_lock(lk)
+        assert shmem.test_lock(lk) == 1  # held -> busy
+        shmem.clear_lock(lk)
+        assert shmem.test_lock(lk) == 0  # acquired
+        shmem.clear_lock(lk)
+        # wait/test
+        iv = shmem.malloc(1, np.int64)
+        iv.view()[:] = 0
+        assert not shmem.test(iv, shmem.CMP_NE, 0)
+        shmem.atomic_set(iv, 7, shmem.my_pe())
+        shmem.wait_until(iv, shmem.CMP_EQ, 7)
+        assert shmem.test(iv, shmem.CMP_GE, 7)
+        # signaled put: data visible before the signal fires
+        dest = shmem.malloc(4, np.float64)
+        sig = shmem.malloc(1, np.uint64)
+        sig.view()[:] = 0
+        pe = n - 1
+        shmem.put_signal(dest, np.arange(4, dtype=np.float64), sig, 1,
+                         pe, shmem.SIGNAL_ADD)
+        got = shmem.signal_wait_until(sig, shmem.CMP_GE, 1) \
+            if pe == shmem.my_pe() else 1
+        assert got >= 1
+        assert np.array_equal(shmem.get(dest, pe), np.arange(4.0))
+        # teams
+        tw = shmem.team_world()
+        assert tw.my_pe() == shmem.my_pe() and tw.n_pes() == n
+        esize = (n + 1) // 2
+        ev = shmem.team_split_strided(0, 2, esize)
+        if shmem.my_pe() % 2 == 0:
+            assert ev is not None and ev.my_pe() == shmem.my_pe() // 2
+            assert ev.translate_pe(0, tw) == 0
+            ev.sync()
+            if ev is not None and ev._comm is not None:
+                ev.destroy()
+        shmem.barrier_all()
+    finally:
+        shmem.finalize()
+
+
 # -- C ABI --------------------------------------------------------------
 
 
@@ -104,6 +154,31 @@ def test_shmem_c_suite(shmem_suite_bin, npes):
     assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
     assert "SHMEM SUITE COMPLETE" in out
     assert "FAIL" not in out
+
+
+@pytest.mark.parametrize("npes", [2, 4])
+def test_shmem_pipeline_example(npes):
+    """The 1.5 showcase example: teams + signals + locks + contexts +
+    _nbi composed into a producer/consumer pipeline (the families
+    working TOGETHER, not just per-family conformance)."""
+    from ompi_tpu import native
+
+    if not native.toolchain_available():
+        pytest.skip("no C toolchain")
+    native.build()
+    bin_path = native.compile_mpi_program(
+        REPO / "native" / "examples" / "shmem_pipeline.c",
+        BUILD / "shmem_pipeline", extra_flags=["-ltpushmem"],
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu", "run", "-np", str(npes),
+         "--cpu-devices", "1", str(bin_path)],
+        capture_output=True, timeout=300, cwd=str(REPO),
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "pipeline OK" in out
+    assert "MISMATCH" not in out
 
 
 def test_shmem_symbol_surface():

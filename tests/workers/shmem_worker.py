@@ -47,5 +47,45 @@ b = shmem.broadcast(np.full((1, 4), float(me)), 0)
 assert np.array_equal(np.asarray(b), np.zeros((1, 4)))
 
 shmem.barrier_all()
+
+# phase 2: distributed lock guards a non-atomic RMW on PE 0
+lk = shmem.malloc(1, np.int64)
+cell = shmem.malloc(1, np.int64)
+lk.view()[:] = 0
+cell.view()[:] = 0
+shmem.barrier_all()
+for _ in range(4):
+    shmem.set_lock(lk)
+    cur = int(shmem.get(cell, 0)[0])
+    shmem.put(cell, np.asarray([cur + 1], np.int64), 0)
+    shmem.quiet()
+    shmem.clear_lock(lk)
+shmem.barrier_all()
+assert int(shmem.get(cell, 0)[0]) == 4 * n
+
+# signaled put around the ring + signal_wait_until
+dest = shmem.malloc(2, np.float64)
+sig = shmem.malloc(1, np.uint64)
+sig.view()[:] = 0
+shmem.barrier_all()
+shmem.put_signal(dest, np.asarray([me + 0.25, me + 0.75]), sig, 1,
+                 right, shmem.SIGNAL_SET)
+assert shmem.signal_wait_until(sig, shmem.CMP_EQ, 1) == 1
+mine = np.asarray(dest)
+assert mine[0] == left + 0.25 and mine[1] == left + 0.75
+
+# team of the even PEs: sync + reduction over a REAL sub-communicator
+ev = shmem.team_split_strided(0, 2, (n + 1) // 2)
+if me % 2 == 0:
+    assert ev is not None and ev.my_pe() == me // 2
+    ev.sync()
+    s = ev.sum_reduce(np.asarray([[float(me)]]))
+    expect = float(sum(p for p in range(0, n, 2)))
+    assert float(np.asarray(s).ravel()[0]) == expect, s
+    ev.destroy()
+else:
+    assert ev is None
+
+shmem.barrier_all()
 shmem.finalize()
 print(f"OK shmem_py pe={me}", flush=True)
