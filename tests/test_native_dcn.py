@@ -244,6 +244,25 @@ def test_monitoring_keeps_python_pml_over_native():
     assert out.count("ENGINE NativeDcnEngine pml=MonitoredEngine") == 2
 
 
+@pytest.mark.parametrize("ring_kib", [None, 1024])
+def test_native_message_storm(ring_kib):
+    """Race catcher for the ring protocol and the C matching engine:
+    400 pseudo-random-size messages (1 B..1.5 MiB) between random peer
+    pairs at np=3 with full content verification, then a
+    wildcard-receive storm.  The default leg runs every message as one
+    EAGER ring record (rebase-on-empty, doorbell wakeups); the 1 MiB-
+    ring leg forces messages above ring/2 = 512 KiB through the
+    RTS/FRAG chunked-streaming path plus ring-full backpressure."""
+    _native()
+    worker = REPO / "tests" / "workers" / "native_storm_worker.py"
+    mca = [] if ring_kib is None else \
+        [("btl_native_ring_bytes", str(ring_kib * 1024))]
+    res = run_tpurun(3, worker, mca=mca, timeout=600)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out[-3000:]}\n{res.stderr.decode()[-1500:]}"
+    assert out.count("OK storm") == 3
+
+
 def test_native_latency_beats_python_floor():
     """The round-3 verdict's criterion: the native plane must clearly
     beat the Python transport's measured p2p floor on the same box.

@@ -1,0 +1,92 @@
+"""tpurun np=3 worker: message storm over the native plane — a race
+catcher for the ring protocol (rebase-on-empty, chunked streaming,
+doorbell wakeups) and the matching engine under randomized traffic.
+
+Every process sends SEQ messages of pseudo-random sizes (1 B..1.5 MiB)
+to pseudo-random peers with deterministic contents; receivers post a
+mix of directed and wildcard receives and verify every byte.  The
+(seed-derived) traffic pattern is identical on all processes, so each
+knows exactly what to expect.  Under the default 64 MiB ring every
+message is one EAGER record; the test's small-ring leg
+(--mca btl_native_ring_bytes 1 MiB) pushes the top size band through
+the RTS/FRAG chunked-streaming path and ring-full backpressure.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import ompi_tpu.api as api
+
+world = api.init()
+p = world.proc
+n = world.nprocs
+assert n == 3
+
+SEQ = 400
+rng = np.random.default_rng(1234)  # same schedule on every process
+# schedule[i] = (src, dst, nbytes): src sends message i to dst
+sizes = np.concatenate([
+    rng.integers(1, 256, SEQ // 2),
+    rng.integers(256, 65536, SEQ // 4),
+    rng.integers(65536, 3 << 19, SEQ - SEQ // 2 - SEQ // 4),
+])
+rng.shuffle(sizes)
+srcs = rng.integers(0, n, SEQ)
+dsts = (srcs + 1 + rng.integers(0, n - 1, SEQ)) % n  # never self
+
+
+def payload(i: int, nbytes: int) -> np.ndarray:
+    return (np.arange(nbytes, dtype=np.int64) % 251 + i % 97).astype(
+        np.uint8)
+
+
+def drain(pending: list) -> None:
+    for j in pending:
+        got, _ = world.recv(dest=p, source=int(srcs[j]), tag=j)
+        exp = payload(j, int(sizes[j]))
+        assert np.array_equal(np.asarray(got).view(np.uint8).ravel(),
+                              exp), f"msg {j} corrupt"
+    pending.clear()
+
+
+# Phase 1: directed tags — issue sends eagerly, receives in order.
+pending = []
+for i in range(SEQ):
+    nb = int(sizes[i])
+    if int(srcs[i]) == p:
+        world.send(payload(i, nb), source=p, dest=int(dsts[i]), tag=i)
+    if int(dsts[i]) == p:
+        pending.append(i)
+    # drain our inbox every few steps so unexpected queues stay bounded
+    if len(pending) >= 8:
+        drain(pending)
+drain(pending)
+world.barrier()
+
+# Phase 2: wildcard receives — each process sends K tagged messages to
+# its right neighbor; the receiver drains them with ANY_SOURCE/ANY_TAG
+# and reconstructs the set.
+K = 60
+right = (p + 1) % n
+for i in range(K):
+    nb = 64 + 997 * i % 4096
+    world.send(payload(1000 + i, nb), source=p, dest=right, tag=500 + i)
+seen = set()
+left = (p - 1 + n) % n
+for _ in range(K):
+    got, status = world.recv(dest=p, source=None, tag=None)
+    assert status.source == left
+    tag = status.tag
+    assert 500 <= tag < 500 + K
+    i = tag - 500
+    nb = 64 + 997 * i % 4096
+    exp = payload(1000 + i, nb)
+    assert np.array_equal(np.asarray(got).view(np.uint8).ravel(), exp)
+    seen.add(tag)
+assert len(seen) == K
+world.barrier()
+api.finalize()
+print(f"OK storm proc={p}", flush=True)
