@@ -443,6 +443,28 @@ int main(void) {
     shmem_barrier_all();
   }
 
+  { /* typed put_signal + ctx inc/bitwise variants */
+    int right = (me + 1) % n, left = (me - 1 + n) % n;
+    double *dbox = (double *)shmem_calloc(4, sizeof(double));
+    uint64_t *dsig = (uint64_t *)shmem_calloc(1, sizeof(uint64_t));
+    double vals[4] = {me + 0.1, me + 0.2, me + 0.3, me + 0.4};
+    shmem_double_put_signal(dbox, vals, 4, dsig, 7, SHMEM_SIGNAL_SET,
+                            right);
+    (void)shmem_signal_wait_until(dsig, SHMEM_CMP_EQ, 7);
+    CHECK(dbox[0] == left + 0.1 && dbox[3] == left + 0.4,
+          "typed_put_signal");
+    uint64_t *cc2 = (uint64_t *)shmem_calloc(1, sizeof(uint64_t));
+    shmem_ctx_t c2;
+    CHECK(shmem_ctx_create(0, &c2) == 0, "ctx_create2");
+    (void)shmem_ctx_uint64_atomic_fetch_inc(c2, cc2, 0);
+    (void)shmem_ctx_uint64_atomic_fetch_or(c2, cc2, 0, 0);
+    shmem_barrier_all();
+    CHECK(shmem_uint64_atomic_fetch(cc2, 0) == (uint64_t)n,
+          "ctx_fetch_inc");
+    shmem_ctx_destroy(c2);
+    shmem_barrier_all();
+  }
+
   { /* sized 16/128-bit put/get */
     uint16_t *h = (uint16_t *)shmem_calloc(4, sizeof(uint16_t));
     uint16_t hs[4] = {(uint16_t)(40000 + me), 2, 3, 4};

@@ -240,7 +240,10 @@ TPUSHMEM_DECL_SIZED(128)
   T shmem_ctx_##NAME##_atomic_swap(shmem_ctx_t ctx, T *dest, T value,     \
                                    int pe);                               \
   T shmem_ctx_##NAME##_atomic_compare_swap(shmem_ctx_t ctx, T *dest,      \
-                                           T cond, T value, int pe);
+                                           T cond, T value, int pe);      \
+  T shmem_ctx_##NAME##_atomic_fetch_inc(shmem_ctx_t ctx, T *dest,         \
+                                        int pe);                          \
+  void shmem_ctx_##NAME##_atomic_inc(shmem_ctx_t ctx, T *dest, int pe);
 
 TPUSHMEM_AMO_TYPES(TPUSHMEM_DECL_AMO)
 
@@ -259,7 +262,19 @@ double shmem_double_atomic_swap(double *dest, double value, int pe);
   T shmem_##NAME##_atomic_fetch_or(T *dest, T value, int pe);             \
   void shmem_##NAME##_atomic_or(T *dest, T value, int pe);                \
   T shmem_##NAME##_atomic_fetch_xor(T *dest, T value, int pe);            \
-  void shmem_##NAME##_atomic_xor(T *dest, T value, int pe);
+  void shmem_##NAME##_atomic_xor(T *dest, T value, int pe);               \
+  T shmem_ctx_##NAME##_atomic_fetch_and(shmem_ctx_t ctx, T *dest,         \
+                                        T value, int pe);                 \
+  void shmem_ctx_##NAME##_atomic_and(shmem_ctx_t ctx, T *dest, T value,   \
+                                     int pe);                             \
+  T shmem_ctx_##NAME##_atomic_fetch_or(shmem_ctx_t ctx, T *dest,          \
+                                       T value, int pe);                  \
+  void shmem_ctx_##NAME##_atomic_or(shmem_ctx_t ctx, T *dest, T value,    \
+                                    int pe);                              \
+  T shmem_ctx_##NAME##_atomic_fetch_xor(shmem_ctx_t ctx, T *dest,         \
+                                        T value, int pe);                 \
+  void shmem_ctx_##NAME##_atomic_xor(shmem_ctx_t ctx, T *dest, T value,   \
+                                     int pe);
 
 TPUSHMEM_BITWISE_TYPES(TPUSHMEM_DECL_AMO_BITS)
 
@@ -331,6 +346,32 @@ void shmem_putmem_signal_nbi(void *dest, const void *source,
 uint64_t shmem_signal_fetch(const uint64_t *sig_addr);
 uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
                                  uint64_t cmp_value);
+
+/* typed + sized put-with-signal */
+#define TPUSHMEM_DECL_PUT_SIGNAL(NAME, T)                                 \
+  void shmem_##NAME##_put_signal(T *dest, const T *source,                \
+                                 size_t nelems, uint64_t *sig_addr,       \
+                                 uint64_t signal, int sig_op, int pe);    \
+  void shmem_##NAME##_put_signal_nbi(T *dest, const T *source,            \
+                                     size_t nelems, uint64_t *sig_addr,   \
+                                     uint64_t signal, int sig_op,         \
+                                     int pe);
+
+TPUSHMEM_RMA_TYPES(TPUSHMEM_DECL_PUT_SIGNAL)
+
+#define TPUSHMEM_DECL_PUT_SIGNAL_SIZED(BITS)                              \
+  void shmem_put##BITS##_signal(void *dest, const void *source,           \
+                                size_t nelems, uint64_t *sig_addr,        \
+                                uint64_t signal, int sig_op, int pe);     \
+  void shmem_put##BITS##_signal_nbi(void *dest, const void *source,       \
+                                    size_t nelems, uint64_t *sig_addr,    \
+                                    uint64_t signal, int sig_op, int pe);
+
+TPUSHMEM_DECL_PUT_SIGNAL_SIZED(8)
+TPUSHMEM_DECL_PUT_SIGNAL_SIZED(16)
+TPUSHMEM_DECL_PUT_SIGNAL_SIZED(32)
+TPUSHMEM_DECL_PUT_SIGNAL_SIZED(64)
+TPUSHMEM_DECL_PUT_SIGNAL_SIZED(128)
 
 /* collectives: active-set forms (any strided subset) */
 void shmem_barrier(int PE_start, int logPE_stride, int PE_size,

@@ -579,6 +579,14 @@ static void amo_fop(const void *val, void *old, MPI_Datatype t, int pe,
                                      int pe) {                            \
     (void)c;                                                              \
     shmem_##NAME##_atomic_set(dest, value, pe);                           \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_fetch_inc(shmem_ctx_t c, T *dest, int pe) { \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_fetch_inc(dest, pe);                     \
+  }                                                                       \
+  void shmem_ctx_##NAME##_atomic_inc(shmem_ctx_t c, T *dest, int pe) {    \
+    (void)c;                                                              \
+    shmem_##NAME##_atomic_inc(dest, pe);                                  \
   }
 
 SHMEM_AMO_TYPES(GEN_AMO)
@@ -627,6 +635,36 @@ GEN_AMO_EXT(double, double, MPI_DOUBLE)
   }                                                                       \
   void shmem_##NAME##_atomic_xor(T *dest, T value, int pe) {              \
     (void)shmem_##NAME##_atomic_fetch_xor(dest, value, pe);               \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_fetch_and(shmem_ctx_t c, T *dest, T value,  \
+                                        int pe) {                         \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_fetch_and(dest, value, pe);              \
+  }                                                                       \
+  void shmem_ctx_##NAME##_atomic_and(shmem_ctx_t c, T *dest, T value,     \
+                                     int pe) {                            \
+    (void)c;                                                              \
+    shmem_##NAME##_atomic_and(dest, value, pe);                           \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_fetch_or(shmem_ctx_t c, T *dest, T value,   \
+                                       int pe) {                          \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_fetch_or(dest, value, pe);               \
+  }                                                                       \
+  void shmem_ctx_##NAME##_atomic_or(shmem_ctx_t c, T *dest, T value,      \
+                                    int pe) {                             \
+    (void)c;                                                              \
+    shmem_##NAME##_atomic_or(dest, value, pe);                            \
+  }                                                                       \
+  T shmem_ctx_##NAME##_atomic_fetch_xor(shmem_ctx_t c, T *dest, T value,  \
+                                        int pe) {                         \
+    (void)c;                                                              \
+    return shmem_##NAME##_atomic_fetch_xor(dest, value, pe);              \
+  }                                                                       \
+  void shmem_ctx_##NAME##_atomic_xor(shmem_ctx_t c, T *dest, T value,     \
+                                     int pe) {                            \
+    (void)c;                                                              \
+    shmem_##NAME##_atomic_xor(dest, value, pe);                           \
   }
 
 SHMEM_BITWISE_TYPES(GEN_AMO_BITWISE)
@@ -853,6 +891,46 @@ void shmem_putmem_signal_nbi(void *dest, const void *source, size_t nelems,
 uint64_t shmem_signal_fetch(const uint64_t *sig_addr) {
   return shmem_uint64_atomic_fetch(sig_addr, g_pe);
 }
+
+/* typed + sized put-with-signal (1.5): elementwise forms over the
+ * same data-before-signal machinery */
+#define GEN_PUT_SIGNAL(NAME, T, MPIT)                                     \
+  void shmem_##NAME##_put_signal(T *dest, const T *source, size_t n,      \
+                                 uint64_t *sig_addr, uint64_t signal,     \
+                                 int sig_op, int pe) {                    \
+    shmem_putmem_signal(dest, source, n * sizeof(T), sig_addr, signal,    \
+                        sig_op, pe);                                      \
+  }                                                                       \
+  void shmem_##NAME##_put_signal_nbi(T *dest, const T *source, size_t n,  \
+                                     uint64_t *sig_addr,                  \
+                                     uint64_t signal, int sig_op,         \
+                                     int pe) {                            \
+    shmem_putmem_signal_nbi(dest, source, n * sizeof(T), sig_addr,        \
+                            signal, sig_op, pe);                          \
+  }
+
+SHMEM_RMA_TYPES(GEN_PUT_SIGNAL)
+
+#define GEN_PUT_SIGNAL_SIZED(BITS, BYTES)                                 \
+  void shmem_put##BITS##_signal(void *dest, const void *source,           \
+                                size_t n, uint64_t *sig_addr,             \
+                                uint64_t signal, int sig_op, int pe) {    \
+    shmem_putmem_signal(dest, source, n * (BYTES), sig_addr, signal,      \
+                        sig_op, pe);                                      \
+  }                                                                       \
+  void shmem_put##BITS##_signal_nbi(void *dest, const void *source,       \
+                                    size_t n, uint64_t *sig_addr,         \
+                                    uint64_t signal, int sig_op,          \
+                                    int pe) {                             \
+    shmem_putmem_signal_nbi(dest, source, n * (BYTES), sig_addr,          \
+                            signal, sig_op, pe);                          \
+  }
+
+GEN_PUT_SIGNAL_SIZED(8, 1)
+GEN_PUT_SIGNAL_SIZED(16, 2)
+GEN_PUT_SIGNAL_SIZED(32, 4)
+GEN_PUT_SIGNAL_SIZED(64, 8)
+GEN_PUT_SIGNAL_SIZED(128, 16)
 
 uint64_t shmem_signal_wait_until(uint64_t *sig_addr, int cmp,
                                  uint64_t cmp_value) {
