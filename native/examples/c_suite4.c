@@ -177,6 +177,38 @@ int main(int argc, char **argv) {
     MPI_Group_free(&wg);
   }
 
+  /* -- (e) MPI_File_open info hints round-trip ----------------------- */
+  {
+    MPI_Info info, got;
+    MPI_Info_create(&info);
+    MPI_Info_set(info, "striping_factor", "4");
+    MPI_Info_set(info, "striping_unit", "65536");
+    MPI_File fh;
+    char path[256];
+    snprintf(path, sizeof path, "/tmp/tpumpi_hints_%d.bin", rank);
+    int rc = MPI_File_open(MPI_COMM_SELF, path,
+                           MPI_MODE_CREATE | MPI_MODE_RDWR, info, &fh);
+    CHECK(rc == MPI_SUCCESS, "file_open_with_info");
+    MPI_File_get_info(fh, &got);
+    char val[64];
+    int flag = 0;
+    MPI_Info_get(got, "striping_unit", sizeof val - 1, val, &flag);
+    CHECK(flag && strcmp(val, "65536") == 0, "file_info_striping_unit");
+    MPI_Info_get(got, "mca_fs", sizeof val - 1, val, &flag);
+    CHECK(flag && strlen(val) > 0, "file_info_fs_driver");
+    MPI_Info_free(&got);
+    /* set_info merges later hints onto the handle */
+    MPI_Info_set(info, "cb_buffer_size", "1048576");
+    MPI_File_set_info(fh, info);
+    MPI_File_get_info(fh, &got);
+    MPI_Info_get(got, "cb_buffer_size", sizeof val - 1, val, &flag);
+    CHECK(flag && strcmp(val, "1048576") == 0, "file_set_info_merges");
+    MPI_Info_free(&got);
+    MPI_Info_free(&info);
+    MPI_File_close(&fh);
+    MPI_File_delete(path, MPI_INFO_NULL);
+  }
+
   MPI_Barrier(MPI_COMM_WORLD);
   if (rank == 0) printf("SUITE4 COMPLETE\n");
   MPI_Finalize();

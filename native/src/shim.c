@@ -1522,9 +1522,9 @@ int PMPI_T_pvar_get_index(const char *name, int *pvar_index) {
 
 int PMPI_File_open(MPI_Comm comm, const char *filename, int amode,
                    MPI_Info info, MPI_File *fh) {
-  (void)info;
   capi_ret r;
-  int rc = capi_call("file_open", &r, "(isi)", (int)comm, filename, amode);
+  int rc = capi_call("file_open", &r, "(isii)", (int)comm, filename, amode,
+                     (int)info);
   if (rc == MPI_SUCCESS && r.n >= 1) *fh = (MPI_File)r.v[0];
   return rc;
 }
@@ -3610,15 +3610,12 @@ int PMPI_File_get_group(MPI_File fh, MPI_Group *group) {
 }
 
 int PMPI_File_set_info(MPI_File fh, MPI_Info info) {
-  (void)fh;
-  (void)info;
-  return MPI_SUCCESS;
+  return capi_call("file_set_info", NULL, "(ii)", (int)fh, (int)info);
 }
 
 int PMPI_File_get_info(MPI_File fh, MPI_Info *info_used) {
-  (void)fh;
   capi_ret r;
-  int rc = capi_call("info_create", &r, "()");
+  int rc = capi_call("file_get_info", &r, "(i)", (int)fh);
   if (rc == MPI_SUCCESS && r.n >= 1) *info_used = (MPI_Info)r.v[0];
   return rc;
 }

@@ -312,12 +312,13 @@ class Comm(PersistentP2PMixin):
 
     # -- MPI-IO (MPI_File_open; ≈ io framework selection) --------------
 
-    def file_open(self, path: str, amode: int):
+    def file_open(self, path: str, amode: int, hints: dict | None = None):
         """MPI_File_open: collective open through the selected io
-        component (io/ompio)."""
+        component (io/ompio).  ``hints`` = MPI_Info key/values
+        (striping_factor/striping_unit recognized)."""
         self._check()
         comp = mca.default_context().framework("io").select_one()
-        return comp.file_open(self, path, amode)
+        return comp.file_open(self, path, amode, hints=hints)
 
     def free(self) -> None:
         self._check()

@@ -1636,16 +1636,18 @@ def _file(fh: int):
     return f
 
 
-def file_open(h: int, path: str, amode: int):
+def file_open(h: int, path: str, amode: int, info_h: int = 0):
     """MPI_File_open (collective).  Multi-process jobs open the file
     per-process over the LOCAL comm (the shared filesystem is the
     coupling, as in fs/ufs); collective completion is a comm barrier.
-    Shared-file-pointer ops are therefore single-process only."""
+    Shared-file-pointer ops are therefore single-process only.
+    ``info_h``: MPI_Info handle whose hints attach to the handle."""
     global _next_file_h
     try:
         c = _comm(h)
+        hints = dict(_infos.get(info_h, {})) if info_h else None
         if _is_single_controller(c):
-            f = c.file_open(path, amode)
+            f = c.file_open(path, amode, hints=hints)
             # authoritative shared-pointer reset: a stale <path>.shfp
             # left by an earlier job must not leak in (creator-only
             # seeding inside File.__init__ deliberately skips existing
@@ -1667,7 +1669,7 @@ def file_open(h: int, path: str, amode: int):
                 amode_local &= ~MODE_DELETE_ON_CLOSE
             f = exc = None
             try:
-                f = c.local.file_open(path, amode_local)
+                f = c.local.file_open(path, amode_local, hints=hints)
             except err.MPIError as e2:
                 exc = e2
             # collective success agreement: a one-sided failure must
@@ -1701,6 +1703,37 @@ def file_open(h: int, path: str, amode: int):
         return (MPI_SUCCESS, handle)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e, h), 0)
+
+
+def file_set_info(fh: int, info_h: int) -> int:
+    """MPI_File_set_info: merge the info's hints onto the handle
+    (striping hints only matter at create time; later merges are
+    recorded and surfaced, per the reference's hint semantics)."""
+    try:
+        f = _file(fh)[0]  # invalid/closed handle -> MPI_ERR_FILE
+        if info_h:
+            f.hints.update(
+                {str(k): str(v) for k, v in _infos.get(info_h, {}).items()}
+            )
+        return MPI_SUCCESS
+    except BaseException as e:  # noqa: BLE001
+        return _fail(e)
+
+
+def file_get_info(fh: int):
+    """MPI_File_get_info: a NEW info carrying the handle's effective
+    hints plus the selected fs driver name."""
+    try:
+        f = _file(fh)[0]  # invalid/closed handle -> MPI_ERR_FILE
+        _, ih = info_create()
+        d = dict(f.hints)
+        fs = getattr(f.component, "fs", None)
+        if fs is not None and hasattr(fs, "fs_name"):
+            d.setdefault("mca_fs", fs.fs_name(f._fd))
+        _infos[ih] = d
+        return (MPI_SUCCESS, ih)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e), 0)
 
 
 def file_close(fh: int) -> int:

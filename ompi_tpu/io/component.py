@@ -82,6 +82,88 @@ class UfsFsComponent(Component):
             raise MPIFileError(f"delete: {path} does not exist") from e
 
 
+_libc_statfs = None  # (libc, struct-type), resolved once
+
+
+def _statfs_fn():
+    global _libc_statfs
+    if _libc_statfs is None:
+        import ctypes
+        import ctypes.util
+
+        class _Statfs(ctypes.Structure):
+            _fields_ = [("f_type", ctypes.c_long)] + [
+                (f"_pad{i}", ctypes.c_long) for i in range(1, 15)
+            ] + [("_spare", ctypes.c_long * 8)]
+
+        libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                           use_errno=True)
+        _libc_statfs = (libc, _Statfs, ctypes.byref)
+    return _libc_statfs
+
+
+def _statfs_magic(path: str) -> int:
+    """f_type of the filesystem holding ``path`` (statfs(2) via ctypes;
+    0 when undeterminable) — the reference's mca_fs_base_get_fstype.
+    libc/struct are resolved once; the per-call cost is one statfs(2)."""
+    try:
+        libc, stf, byref = _statfs_fn()
+        buf = stf()
+        probe = path
+        # walk to the nearest existing ancestor; the walk is bounded
+        # because dirname() reaches a fixed point ("/" or "." or "")
+        while probe and not os.path.exists(probe):
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+        if not probe or not os.path.exists(probe):
+            return 0
+        if libc.statfs(probe.encode(), byref(buf)) != 0:
+            return 0
+        return int(buf.f_type) & 0xFFFFFFFF
+    except Exception:  # noqa: BLE001 — detection is best-effort
+        return 0
+
+
+@register_component
+class LustreFsComponent(UfsFsComponent):
+    """fs/lustre: selected when the path lives on a Lustre mount (or is
+    forced with ``--mca fs lustre``).  Data operations are the POSIX
+    ones — Lustre IS POSIX at the syscall layer; what the reference's
+    fs/lustre adds on top is STRIPING control via the Lustre user
+    library, which does not exist on this image, so striping hints
+    (``striping_factor``/``striping_unit``) are recorded on the handle,
+    surfaced through ``MPI_File_get_info``, and ``striping_unit``
+    drives the fcoll/vulcan stripe alignment for collective writes —
+    the part of the striping story that matters for IO patterns."""
+
+    FRAMEWORK = "fs"
+    NAME = "lustre"
+    PRIORITY = 20  # below ufs: wins only by detection or force
+    FS_MAGIC = 0x0BD00BD0  # LL_SUPER_MAGIC
+
+    def register_params(self, store) -> None:
+        super().register_params(store)
+        store.register(
+            "fs", "lustre", "stripe_size", 1 << 20, type="int",
+            help="Default stripe size assumed for collective-write "
+            "alignment when the open carries no striping_unit hint",
+        )
+
+
+@register_component
+class GpfsFsComponent(UfsFsComponent):
+    """fs/gpfs: selected on GPFS mounts (or forced).  POSIX data ops;
+    the reference's gpfs_fcntl hint calls have no user library here,
+    so hints are recorded and surfaced, not issued."""
+
+    FRAMEWORK = "fs"
+    NAME = "gpfs"
+    PRIORITY = 20
+    FS_MAGIC = 0x47504653  # 'GPFS'
+
+
 @register_component
 class PosixFbtlComponent(Component):
     """fbtl/posix: blocking positioned IO primitives (pread/pwrite)."""
@@ -117,28 +199,52 @@ class PosixFbtlComponent(Component):
 
 
 class _FsFacade:
-    """Adapter giving File a flat fs interface from the component."""
+    """Adapter giving File a flat fs interface.  Driver selection is
+    PER PATH, as in the reference's fs framework: an explicit
+    ``--mca fs <name>`` wins, otherwise the statfs magic of the path's
+    filesystem picks lustre/gpfs, falling back to ufs.  Per-fd driver
+    bookkeeping keeps later ops on the fd's own driver."""
 
-    def __init__(self, comp: UfsFsComponent):
-        self._c = comp
+    def __init__(self, default: UfsFsComponent,
+                 candidates: list | None = None):
+        self._default = default
+        self._by_magic = {
+            getattr(c, "FS_MAGIC", None): c for c in (candidates or [])
+            if getattr(c, "FS_MAGIC", None)
+        }
+        self._fd_comp: dict[int, UfsFsComponent] = {}
+
+    def _pick(self, path: str) -> UfsFsComponent:
+        # ``--mca fs <name>`` already restricted the candidate set (and
+        # the default) at framework selection, so forcing needs no
+        # special case here; unforced, the path's statfs magic picks
+        # lustre/gpfs and anything else falls back to the default (ufs)
+        comp = self._by_magic.get(_statfs_magic(path))
+        return comp if comp is not None else self._default
+
+    def fs_name(self, fd: int) -> str:
+        return self._fd_comp.get(fd, self._default).NAME
 
     def open(self, path, amode):
-        return self._c.fs_open(path, amode)
+        comp = self._pick(path)
+        fd = comp.fs_open(path, amode)
+        self._fd_comp[fd] = comp
+        return fd
 
     def close(self, fd):
-        self._c.fs_close(fd)
+        self._fd_comp.pop(fd, self._default).fs_close(fd)
 
     def size(self, fd):
-        return self._c.fs_size(fd)
+        return self._fd_comp.get(fd, self._default).fs_size(fd)
 
     def resize(self, fd, size):
-        self._c.fs_resize(fd, size)
+        self._fd_comp.get(fd, self._default).fs_resize(fd, size)
 
     def sync(self, fd):
-        self._c.fs_sync(fd)
+        self._fd_comp.get(fd, self._default).fs_sync(fd)
 
     def delete(self, path):
-        self._c.fs_delete(path)
+        self._pick(path).fs_delete(path)
 
 
 @register_component
@@ -188,7 +294,8 @@ class OmpioIoComponent(Component):
         from ompi_tpu.core import mca
 
         ctx = mca.default_context()
-        self.fs = _FsFacade(ctx.framework("fs").select_one())
+        fw = ctx.framework("fs")
+        self.fs = _FsFacade(fw.select_one(), fw.selectable())
         self.fbtl = ctx.framework("fbtl").select_one()
         self._refresh_policies(store)
         return True
@@ -218,12 +325,13 @@ class OmpioIoComponent(Component):
             self.open(self.store or _null_store())
         return SHAREDFP.get(self.sharedfp_name, SmSharedfp)(path)
 
-    def file_open(self, comm, path: str, amode: int) -> File:
+    def file_open(self, comm, path: str, amode: int,
+                  hints: dict | None = None) -> File:
         if self.fs is None:
             self.open(self.store or _null_store())
         elif self.store is not None:
             self._refresh_policies(self.store)  # per-open selection
-        return File(comm, path, amode, self)
+        return File(comm, path, amode, self, hints=hints)
 
     def file_delete(self, path: str) -> None:
         if self.fs is None:

@@ -143,11 +143,18 @@ class File:
     surfaces.  Collective calls take a rank-indexed list.
     """
 
-    def __init__(self, comm, path: str, amode: int, component):
+    def __init__(self, comm, path: str, amode: int, component,
+                 hints: dict | None = None):
         self.comm = comm
         self.path = path
         self.amode = amode
         self.component = component  # io/ompio component (holds fcoll etc.)
+        #: MPI_Info hints attached at open (striping_factor /
+        #: striping_unit recorded; striping_unit drives the vulcan
+        #: stripe below) — surfaced via MPI_File_get_info
+        self.hints: dict[str, str] = {
+            str(k): str(v) for k, v in (hints or {}).items()
+        }
         self._atomicity = False
         self._closed = False
         if not (amode & (MODE_RDONLY | MODE_WRONLY | MODE_RDWR)):
@@ -167,8 +174,29 @@ class File:
         #: per-file fcoll snapshot (the reference selects the strategy
         #: at open and stores it on the handle; later opens with a
         #: different --mca io_ompio_fcoll must not retroactively change
-        #: THIS file's collective buffering)
+        #: THIS file's collective buffering).  A striping_unit hint
+        #: re-stripes the vulcan strategy for THIS file (the fs/lustre
+        #: hint → fcoll alignment coupling the reference implements
+        #: with the Lustre user library)
         self.fcoll = component.fcoll
+        from .fcoll import VulcanFcoll
+
+        if isinstance(component.fcoll, VulcanFcoll):
+            su = self.hints.get("striping_unit")
+            if not su:
+                # no hint: a lustre-selected file aligns to the
+                # fs_lustre_stripe_size default (the var's contract)
+                fs = getattr(component, "fs", None)
+                store = getattr(component, "store", None)
+                if (fs is not None and hasattr(fs, "fs_name")
+                        and fs.fs_name(self._fd) == "lustre"
+                        and store is not None):
+                    su = store.get("fs_lustre_stripe_size", None)
+            try:
+                if su:
+                    self.fcoll = VulcanFcoll(int(su))
+            except (TypeError, ValueError):
+                pass  # malformed hint: keep the framework default
         if amode & MODE_APPEND:
             end = self.get_size()
             for rs in self._ranks:

@@ -490,3 +490,80 @@ def test_sharedfp_lockedfile_across_processes(tmp_path):
     assert all((c == c[0]).all() for c in chunks)
     assert sorted(set(vals)) == [1, 2]
     assert vals.count(1) == 16 and vals.count(2) == 16
+
+
+# -- fs drivers: lustre/gpfs selection + striping hints ----------------
+
+
+def test_fs_driver_detection_and_hints(world, path, monkeypatch):
+    """fs selection is per path (statfs magic -> lustre/gpfs, else
+    ufs), and striping hints attach to the handle: striping_unit
+    re-stripes the vulcan fcoll for THAT file (the fs/lustre hint ->
+    collective-alignment coupling)."""
+    from ompi_tpu.core import mca
+    from ompi_tpu.io import component as iocomp
+    from ompi_tpu.io.fcoll import VulcanFcoll
+
+    comp = mca.default_context().framework("io").select_one()
+    # default: a tmp path is neither lustre nor gpfs -> ufs
+    f = comp.file_open(world, path, MODE_CREATE | MODE_RDWR)
+    assert comp.fs.fs_name(f._fd) == "ufs"
+    f.close()
+    # fake a Lustre superblock for this path -> fs/lustre picked
+    monkeypatch.setattr(iocomp, "_statfs_magic",
+                        lambda p: iocomp.LustreFsComponent.FS_MAGIC)
+    f = comp.file_open(world, path, MODE_RDWR)
+    assert comp.fs.fs_name(f._fd) == "lustre"
+    f.close()
+    monkeypatch.setattr(iocomp, "_statfs_magic",
+                        lambda p: iocomp.GpfsFsComponent.FS_MAGIC)
+    f = comp.file_open(world, path, MODE_RDWR)
+    assert comp.fs.fs_name(f._fd) == "gpfs"
+    f.close()
+    monkeypatch.undo()
+    # striping_unit hint re-stripes vulcan for this file only
+    store = comp.store
+    old = store.get("io_ompio_fcoll", "two_phase")
+    try:
+        store.set("io_ompio_fcoll", "vulcan")
+        f = comp.file_open(world, path, MODE_RDWR,
+                           hints={"striping_factor": "4",
+                                  "striping_unit": "65536"})
+        assert isinstance(f.fcoll, VulcanFcoll) and f.fcoll.stripe == 65536
+        assert f.hints["striping_factor"] == "4"
+        f.close()
+    finally:
+        store.set("io_ompio_fcoll", old)
+
+
+def test_fs_lustre_forced_and_byte_identity(world, path):
+    """--mca fs lustre forces the driver for every open (data ops are
+    POSIX — Lustre IS POSIX at the syscall layer); collective writes
+    stay byte-identical under the hinted stripe."""
+    from ompi_tpu.core.mca import MCAContext
+    from ompi_tpu.core import mca as mca_mod
+
+    prev = mca_mod.default_context()
+    ctx = MCAContext(cmdline={"fs": "lustre", "io_ompio_fcoll": "vulcan"})
+    mca_mod._default = ctx
+    try:
+        comp = ctx.framework("io").select_one()
+        f = comp.file_open(world, path, MODE_CREATE | MODE_RDWR,
+                           hints={"striping_unit": "8192"})
+        assert comp.fs.fs_name(f._fd) == "lustre"
+        assert f.fcoll.stripe == 8192
+        n = world.size
+        blocks = [np.full(4096, r, np.uint8) for r in range(n)]
+        f.write_at_all([r * 4096 for r in range(n)], blocks)
+        for r in range(n):
+            got = f.read_at(r, r * 4096, 4096)
+            assert np.array_equal(np.asarray(got).view(np.uint8),
+                                  blocks[r])
+        f.close()
+        # no hint: the lustre file aligns to fs_lustre_stripe_size
+        f2 = comp.file_open(world, path, MODE_RDWR)
+        assert f2.fcoll.stripe == ctx.store.get(
+            "fs_lustre_stripe_size", 1 << 20)
+        f2.close()
+    finally:
+        mca_mod._default = prev
