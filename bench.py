@@ -402,7 +402,12 @@ def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
             "multiplicative penalty ONLY because an n_ranks=1 "
             "allreduce costs ~25 us at EVERY size (donated identity "
             "program); at real multi-chip collective times the 2 us "
-            "vanishes into the noise floor"
+            "vanishes into the noise floor.  Alternating the "
+            "within-pair measurement order (the fw leg used to run "
+            "first in every pair, absorbing any first-position stream "
+            "cost) lifted the same-code geomean to 0.9422 with every "
+            "size >=0.90 — part of the apparent gap was estimator "
+            "order bias, not the framework"
         ),
         "geomean": geomean,
         "sizes": rows,
