@@ -405,9 +405,9 @@ def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
             "vanishes into the noise floor.  Alternating the "
             "within-pair measurement order (the fw leg used to run "
             "first in every pair, absorbing any first-position stream "
-            "cost) lifted the same-code geomean to 0.9422 with every "
-            "size >=0.90 — part of the apparent gap was estimator "
-            "order bias, not the framework"
+            "cost) measured same-code geomeans of 0.9231-0.9422 (best "
+            "run: every size >=0.90) — part of the apparent gap was "
+            "estimator order bias, not the framework"
         ),
         "geomean": geomean,
         "sizes": rows,
