@@ -24,7 +24,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: experimental namespace
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ompi_tpu.mesh import AXIS
@@ -60,9 +65,13 @@ def ring_attention_program(n: int):
         scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
         # fresh accumulators are device-varying state under shard_map's
         # manual-axes tracking (they'll differ per rank after step 1)
-        m0 = lax.pcast(jnp.full(q.shape[:-1], -jnp.inf, q.dtype),
-                       AXIS, to="varying")
-        l0 = lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), AXIS, to="varying")
+        # jax < 0.6 has no pcast and treats shard_map values as
+        # device-varying already — identity there, pcast where it exists
+        pcast = getattr(lax, "pcast", None)
+        to_varying = ((lambda a: pcast(a, AXIS, to="varying"))
+                      if pcast is not None else (lambda a: a))
+        m0 = to_varying(jnp.full(q.shape[:-1], -jnp.inf, q.dtype))
+        l0 = to_varying(jnp.zeros(q.shape[:-1], q.dtype))
         o0 = jnp.zeros_like(q)
         perm = [(i, (i + 1) % n) for i in range(n)]  # the ring
 

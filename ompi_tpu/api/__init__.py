@@ -47,6 +47,13 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
 
     memchecker.register_var(ctx.store)
     memchecker.sync_from_store(ctx.store)
+    # event tracing (--mca trace_enable 1): same register+sync shape as
+    # memchecker — must precede ProcContext so DCN engine construction
+    # is already on the timeline
+    from ompi_tpu.trace import core as trace_core
+
+    trace_core.register_vars(ctx.store)
+    trace_core.sync_from_store(ctx.store)
     from ompi_tpu.mesh.mesh import world_mesh
 
     wm = world_mesh()
@@ -110,6 +117,18 @@ def finalize() -> None:
             _mon.dump(str(out))
     except Exception:
         pass  # accounting must never break finalize
+    # trace dump at finalize (Chrome trace JSON; ≈ the monitoring dump
+    # above): every process writes <trace_output>.<proc>.json — merge
+    # with tools/trace_report.py --merge-out
+    try:
+        from ompi_tpu.trace import chrome as _tchrome, core as _tcore
+
+        tout = mca.default_context().store.get("trace_output", "")
+        if tout and _tcore.enabled():
+            proc = int(getattr(_world, "proc", 0))
+            _tchrome.dump(f"{tout}.{proc}.json", pid=proc)
+    except Exception:
+        pass  # tracing must never break finalize
     if _world is not None:
         pc = getattr(_world, "procctx", None)
         if pc is not None:

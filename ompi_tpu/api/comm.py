@@ -43,6 +43,7 @@ from ompi_tpu.op.op import SUM, Op
 from ompi_tpu.p2p.part import PersistentP2PMixin
 from ompi_tpu.request import ArrayRequest, Request
 from ompi_tpu.tool import spc
+from ompi_tpu.trace import core as _trace
 from .group import Group, UNDEFINED
 
 #: (op, dtype) pairs whose arg-check already passed — the check is a
@@ -439,15 +440,23 @@ class Comm(PersistentP2PMixin):
         """FT-guarded coll-table lookup: the choke point for every
         collective entry that does not go through _dispatch/_dispatch_i."""
         self._ft_guard()
-        return self.coll.lookup(slot)
+        fn = self.coll.lookup(slot)
+        if _trace._enabled:
+            return _trace.wrap_call("api", slot, fn, comm=self.name)
+        return fn
 
     def _dispatch(self, slot: str, key: tuple, args: tuple, host: bool):
         self._ft_guard()
+        t0 = _trace.now() if _trace._enabled else 0
         # host inputs were staged into a buffer this call owns → the
         # arena's donating program variant may consume it (key carries
         # the flag so host/device callers never share a cache entry)
         fn = self._fast_fn(slot, slot, key + (host,), args, donate=host)
         out = fn(args[0]) if fn is not None else self.coll.lookup(slot)(*args)
+        if t0:
+            _trace.complete("api", slot, t0, comm=self.name,
+                            seq=_trace.next_seq(self.name, slot),
+                            nbytes=spc.payload_nbytes(args[0]))
         return self.mesh.stage_out(out) if host else out
 
     def _dispatch_i(self, slot: str, base: str, key: tuple, args: tuple,
@@ -456,9 +465,14 @@ class Comm(PersistentP2PMixin):
         callable as the blocking slot (shared key), wrapped in an
         ArrayRequest (async XLA dispatch ↔ libnbc schedule)."""
         self._ft_guard()
+        t0 = _trace.now() if _trace._enabled else 0
         fn = self._fast_fn(slot, base, key + (host,), args, donate=host)
         req = (ArrayRequest(fn(args[0])) if fn is not None
                else self.coll.lookup(slot)(*args))
+        if t0:
+            _trace.complete("api", slot, t0, comm=self.name,
+                            seq=_trace.next_seq(self.name, slot),
+                            nbytes=spc.payload_nbytes(args[0]))
         return _wrap_unstage(req, self, host)
 
     def _coll_call(self, slot: str, x, depth: int, op: Op | None = None,
@@ -485,6 +499,13 @@ class Comm(PersistentP2PMixin):
             ):
                 if spc._attached:
                     spc.inc(slot)
+                if _trace._enabled:
+                    t0 = _trace.now()
+                    out = c[6](x)
+                    _trace.complete("api", slot, t0, comm=self.name,
+                                    seq=_trace.next_seq(self.name, slot),
+                                    nbytes=spc.payload_nbytes(x), hot=True)
+                    return out
                 return c[6](x)
         if op is not None:
             self._check_op(op, x)

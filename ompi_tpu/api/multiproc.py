@@ -33,6 +33,7 @@ from ompi_tpu.op.op import SUM, Op
 from ompi_tpu.p2p.part import PersistentP2PMixin
 from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG, MatchingEngine
 from ompi_tpu.request import Request
+from ompi_tpu.trace import core as _trace
 from .comm import COLOR_UNDEFINED, _next_cid, _peek_cid, _reserve_cid_block
 from .group import Group
 
@@ -210,7 +211,14 @@ class MultiProcComm(PersistentP2PMixin):
             from ompi_tpu.ft import ulfm
 
             ulfm.check(self, collective=True)
-        return self.coll.lookup(slot)
+        fn = self.coll.lookup(slot)
+        if _trace._enabled:
+            # api-layer span with the (comm, op, seq) merge key — the
+            # per-(comm, op) issue counter is identical on every
+            # process (MPI same-issue-order), so merged multi-process
+            # timelines align one collective's spans across ranks
+            return _trace.wrap_call("api", slot, fn, comm=self.name)
+        return fn
 
     def allreduce(self, x, op: Op = SUM):
         return self._lookup("allreduce")(x, op)
@@ -379,6 +387,10 @@ class MultiProcComm(PersistentP2PMixin):
             if _spc.attached():
                 _spc.inc("send")
                 _spc.inc("send_bytes", _spc.payload_nbytes(buf))
+            if _trace._enabled:
+                _trace.instant("p2p", "send_remote", comm=self.name,
+                               src=source, dst=dest, tag=tag,
+                               nbytes=_spc.payload_nbytes(buf))
             if isinstance(self.pml, _mon.MonitoredEngine):
                 _mon.account_p2p(self.name, self.size, source, dest,
                                  _spc.payload_nbytes(buf))

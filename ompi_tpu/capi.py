@@ -262,6 +262,10 @@ def init() -> int:
             _comms[1] = api.comm_self()
             _rank, _size = 0, 1
         _comms[2] = api.comm_self()
+        from ompi_tpu.trace import core as _trace
+
+        if _trace._enabled:
+            _trace.instant("api", "MPI_Init", rank=_rank, size=_size)
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001 — C boundary
         return _fail(e)
@@ -270,7 +274,10 @@ def init() -> int:
 def finalize() -> int:
     try:
         import ompi_tpu.api as api
+        from ompi_tpu.trace import core as _trace
 
+        if _trace._enabled:
+            _trace.instant("api", "MPI_Finalize", rank=_rank)
         _comms.clear()
         _requests.clear()
         # deliver any freed-but-completed requests before teardown;
@@ -2017,7 +2024,10 @@ def t_pvar_read(index: int):
     try:
         from ompi_tpu.tool import mpit
 
-        return (MPI_SUCCESS, int(mpit.pvar_read(index)))
+        v = mpit.pvar_read(index)
+        # array-valued pvars (trace latency histograms) collapse to
+        # their total through the scalar C surface
+        return (MPI_SUCCESS, int(sum(v) if isinstance(v, list) else v))
     except BaseException as e:  # noqa: BLE001
         return (_t_fail(e), 0)
 
@@ -4559,9 +4569,9 @@ def t_pvar_write(index: int, value: int) -> int:
 
 def t_pvar_reset(index: int) -> int:
     try:
-        from ompi_tpu.tool import mpit, spc
+        from ompi_tpu.tool import mpit
 
-        spc.reset_one(mpit._pvar_names()[index])
+        mpit.pvar_reset_one(index)
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _t_fail(e)
@@ -4572,7 +4582,12 @@ def t_pvar_readreset(index: int):
         rc = t_pvar_read(index)
         if not isinstance(rc, tuple) or rc[0] != MPI_SUCCESS:
             return rc if isinstance(rc, tuple) else (rc, 0)
-        t_pvar_reset(index)
+        reset_rc = t_pvar_reset(index)
+        if reset_rc != MPI_SUCCESS:
+            # a non-resettable pvar (trace_events watermark) must not
+            # report success while silently keeping its value — the
+            # caller's per-interval deltas would double-count forever
+            return (reset_rc, rc[1])
         return rc
     except BaseException as e:  # noqa: BLE001
         return (_t_fail(e), 0)

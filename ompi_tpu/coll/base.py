@@ -49,6 +49,17 @@ from jax import lax
 
 from ompi_tpu.mesh import AXIS
 from ompi_tpu.op.op import Op, ordered_reduce_jax
+from ompi_tpu.trace import core as _trace
+
+
+def _compile_event(algorithm: str, n: int) -> None:
+    """Timeline marker fired while jax TRACES a schedule — i.e. once
+    per compilation, not per dispatch (the function body only re-runs
+    when XLA builds a new program).  Shows up as a ``coll``-lane
+    instant, so a trace distinguishes compile stalls from steady-state
+    dispatch — the per-event account of the decision layer's choice."""
+    if _trace._enabled:
+        _trace.instant("coll", "compile", algorithm=algorithm, comm_size=n)
 
 
 def _ring_perm(n: int, shift: int = 1):
@@ -84,6 +95,7 @@ def allreduce_psum(x, op: Op, n: int):
 
     ≈ the decision function short-circuiting into the fabric primitive;
     only for ops with a lax collective."""
+    _compile_event("allreduce_psum", n)
     if op.lax_collective == "psum":
         return lax.psum(x, AXIS)
     if op.lax_collective == "pmax":
@@ -96,6 +108,7 @@ def allreduce_psum(x, op: Op, n: int):
 def allreduce_ordered_linear(x, op: Op, n: int):
     """all_gather + rank-sequential left fold — the bit-exact path
     matching the CPU golden order (han 'reproducible' equivalent)."""
+    _compile_event("allreduce_ordered_linear", n)
     g = lax.all_gather(x, AXIS)  # (n, ...) identical on every device
     return ordered_reduce_jax(g, op)
 
@@ -104,6 +117,7 @@ def allreduce_ring(x, op: Op, n: int):
     """Bandwidth-optimal ring: reduce-scatter phase (n-1 chunk steps)
     then allgather phase (n-1 steps). 2(n-1)/n · size bytes on the wire
     per device — the large-message workhorse."""
+    _compile_event("allreduce_ring", n)
     if n == 1:
         return x
     idx = lax.axis_index(AXIS)
@@ -135,6 +149,7 @@ def allreduce_ring_segmented(x, op: Op, n: int, segcount: int = 1 << 16):
     """Pipelined ring over ``segcount``-element segments (the
     coll_tuned_allreduce_segmentsize knob): each segment runs the ring
     independently; XLA overlaps the segments' ppermute chains."""
+    _compile_event("allreduce_ring_segmented", n)
     if n == 1:
         return x
     flat, size, shape = _pad_to(x, 1)
@@ -150,6 +165,7 @@ def allreduce_recursive_doubling(x, op: Op, n: int):
     """log2(n) full-vector partner exchanges; latency-optimal for small
     messages. Non-power-of-two sizes fold the tail ranks in/out exactly
     like the reference (extra ranks send to partners first)."""
+    _compile_event("allreduce_recursive_doubling", n)
     if n == 1:
         return x
     idx = lax.axis_index(AXIS)
@@ -186,6 +202,7 @@ def allreduce_rabenseifner(x, op: Op, n: int):
     """Rabenseifner: recursive-halving reduce-scatter + recursive-
     doubling allgather. Bandwidth-optimal like ring, latency log2(n);
     power-of-two comm sizes (the decision layer gates it)."""
+    _compile_event("allreduce_rabenseifner", n)
     if n == 1:
         return x
     if n & (n - 1):
@@ -237,6 +254,7 @@ def allgather_direct(x, n: int):
 
 def allgather_ring(x, n: int):
     """n-1 neighbor forwards; each step passes the newest block along."""
+    _compile_event("allgather_ring", n)
     if n == 1:
         return x[None]
     idx = lax.axis_index(AXIS)
@@ -254,6 +272,7 @@ def allgather_ring(x, n: int):
 def allgather_bruck(x, n: int):
     """Bruck: ceil(log2 n) rounds of doubling block exchanges — the
     latency-optimal small-message allgather."""
+    _compile_event("allgather_bruck", n)
     if n == 1:
         return x[None]
     idx = lax.axis_index(AXIS)
@@ -288,6 +307,7 @@ def bcast_direct(x, n: int, root: int = 0):
 
 def bcast_binomial(x, n: int, root: int = 0):
     """Binomial tree: round s, ranks rel<2^s forward to rel+2^s."""
+    _compile_event("bcast_binomial", n)
     if n == 1:
         return x
     idx = lax.axis_index(AXIS)
@@ -308,6 +328,7 @@ def bcast_binomial(x, n: int, root: int = 0):
 def bcast_pipeline(x, n: int, root: int = 0, segcount: int = 1 << 16):
     """Segmented chain (coll_base_bcast_intra_pipeline): the message
     flows down a rank chain segment by segment; XLA overlaps segments."""
+    _compile_event("bcast_pipeline", n)
     if n == 1:
         return x
     idx = lax.axis_index(AXIS)
@@ -333,6 +354,7 @@ def bcast_pipeline(x, n: int, root: int = 0, segcount: int = 1 << 16):
 
 def reduce_binomial(x, op: Op, n: int, root: int = 0):
     """Binomial fan-in tree; result valid on root (others: partial)."""
+    _compile_event("reduce_binomial", n)
     if n == 1:
         return x
     idx = lax.axis_index(AXIS)
@@ -377,6 +399,7 @@ def reduce_scatter_ring(x, op: Op, n: int):
     block b starts at rank (b+1)%n and accumulates contributions while
     traveling the ring until it reaches its owner b (chain op order, as
     in the reference's ring — commutative ops only)."""
+    _compile_event("reduce_scatter_ring", n)
     if n == 1:
         return x[0]
     idx = lax.axis_index(AXIS)
@@ -416,6 +439,7 @@ def alltoall_direct(x, n: int):
 def alltoall_pairwise(x, n: int):
     """n-1 ppermute rounds, step s exchanging with rank±s (the
     pairwise exchange algorithm; DCN-friendly ordering)."""
+    _compile_event("alltoall_pairwise", n)
     idx = lax.axis_index(AXIS)
     out = jnp.zeros_like(x)
     own = jnp.take(x, idx, axis=0)
@@ -442,6 +466,7 @@ def barrier_allreduce(n: int):
 def barrier_dissemination(n: int):
     """Dissemination barrier: ceil(log2 n) token rounds; the returned
     token data-depends on every round so XLA cannot elide them."""
+    _compile_event("barrier_dissemination", n)
     token = jnp.ones((), jnp.int32)
     s = 1
     while s < n:

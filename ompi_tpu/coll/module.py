@@ -28,6 +28,7 @@ from typing import Any, Callable
 
 from ompi_tpu.core.errors import MPIInternalError
 from ompi_tpu.tool import spc
+from ompi_tpu.trace import core as _trace
 
 #: every collective operation slot (blocking form). i-variants and
 #: persistent *_init variants are derived slots: "i"+name, name+"_init".
@@ -101,6 +102,11 @@ class CollTable:
                 f"no coll component provides {slot!r} on this communicator"
             )
         spc.inc(slot)  # SPC: per-collective call counters (§5(d))
+        if _trace._enabled:
+            # coll-layer span naming the winning component — nests
+            # inside the caller's api-layer span on the timeline
+            return _trace.wrap_call("coll", slot, fn,
+                                    provider=self.providers.get(slot, "?"))
         return fn
 
 

@@ -44,6 +44,7 @@ import numpy as np
 from ompi_tpu.core.errors import MPIArgError
 from ompi_tpu.core.registry import Component, register_component
 from ompi_tpu.op.op import Op
+from ompi_tpu.trace import core as _trace
 from .module import COLL_OPS, CollModule
 from .xla import (
     ALLGATHER_ALGOS,
@@ -321,6 +322,9 @@ class TunedCollModule(CollModule):
                     # reference's rule files are likewise written
                     # against an assumed datatype)
                     out["segcount"] = max(1, segsize // 4)
+                if _trace._enabled:
+                    self._trace_decision(coll, n, msg_bytes, enum, alg,
+                                         "dynamic")
                 return out
         large = int(store.get("coll_tuned_large_msg", 1 << 20))
         huge = int(store.get("coll_tuned_huge_msg", 64 << 20))
@@ -330,7 +334,19 @@ class TunedCollModule(CollModule):
             out[var] = alg
         if seg is not None:
             out["segcount"] = seg
+        if _trace._enabled and alg is not None:
+            self._trace_decision(coll, n, msg_bytes, enum, alg, "fixed")
         return out
+
+    @staticmethod
+    def _trace_decision(coll: str, n: int, msg_bytes: int, enum, alg: int,
+                        source: str) -> None:
+        """Timeline record of which algorithm this decision picked —
+        the per-call answer to "which schedule did tuned choose" that
+        aggregate counters cannot give."""
+        name = next((k for k, v in enum.items() if v == alg), str(alg))
+        _trace.instant("coll", "tuned_decision", coll=coll, comm_size=n,
+                       msg_bytes=msg_bytes, algorithm=name, source=source)
 
 
 @register_component

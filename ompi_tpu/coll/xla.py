@@ -34,7 +34,10 @@ from typing import Any, Callable
 
 import jax
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: experimental namespace (same signature)
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ompi_tpu.core.registry import Component, register_component

@@ -47,6 +47,19 @@ class DcnTcpComponent(Component):
         )
 
     def params(self, store) -> dict:
+        """Final knob dict for engine construction.  Subclasses extend
+        :meth:`_collect_params`, NOT this method: the trace marker must
+        fire once with the COMPLETE dict (shm_threshold/ring_bytes
+        included), so it lives here at the outermost call."""
+        p = self._collect_params(store)
+        from ompi_tpu.trace import core as _tr
+
+        if _tr._enabled:
+            _tr.instant("dcn", "transport_params",
+                        **dict(p, transport=self.NAME))
+        return p
+
+    def _collect_params(self, store) -> dict:
         self.register_params(store)
         return {
             "eager_limit": store.get("btl_tcp_eager_limit"),
@@ -79,8 +92,8 @@ class DcnShmComponent(DcnTcpComponent):
             "crossover: kernel socket copies win below ~2 MiB)",
         )
 
-    def params(self, store) -> dict:
-        p = super().params(store)
+    def _collect_params(self, store) -> dict:
+        p = super()._collect_params(store)
         p["transport"] = "sm"
         p["shm_threshold"] = store.get("btl_sm_shm_threshold")
         return p
@@ -107,8 +120,8 @@ class DcnNativeComponent(DcnTcpComponent):
             "records through the ring",
         )
 
-    def params(self, store) -> dict:
-        p = super().params(store)
+    def _collect_params(self, store) -> dict:
+        p = super()._collect_params(store)
         p["transport"] = "native"
         p["ring_bytes"] = store.get("btl_native_ring_bytes")
         return p
@@ -125,7 +138,7 @@ class DcnBmlComponent(DcnShmComponent):
     NAME = "bml"
     PRIORITY = 45
 
-    def params(self, store) -> dict:
-        p = super().params(store)
+    def _collect_params(self, store) -> dict:
+        p = super()._collect_params(store)
         p["transport"] = "bml"
         return p

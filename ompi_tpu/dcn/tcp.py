@@ -37,6 +37,8 @@ from typing import Callable
 
 import numpy as np
 
+from ompi_tpu.trace import core as _trace
+
 #: frame header: type byte, envelope len, meta len, raw (payload) len.
 #: raw length is 64-bit — protocol v2.
 _HDR = struct.Struct("!BIIQ")
@@ -323,6 +325,25 @@ class TcpTransport:
             sock.sendall(_HDR.pack(ftype, len(env), 0, 0) + env)
 
     def send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
+        if _trace._enabled:
+            t0 = _trace.now()
+            try:
+                self._send(address, envelope, payload)
+            finally:
+                nb = int(getattr(payload, "nbytes", 0) or 0)
+                _trace.complete("dcn", "send", t0, nbytes=nb, peer=address,
+                                proto=self._proto_of(nb),
+                                **({"cid": envelope["cid"]}
+                                   if "cid" in envelope else {}))
+            return
+        self._send(address, envelope, payload)
+
+    def _proto_of(self, nbytes: int) -> str:
+        """Which wire protocol a payload of this size takes (trace
+        annotation; mirrors the eager↔rendezvous switch in _send)."""
+        return "eager" if nbytes <= self.eager_limit else "rndv"
+
+    def _send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
         sock, lock = self._peer(address)
         arr = np.ascontiguousarray(payload)
         self.bytes_sent += arr.nbytes  # benign race: diagnostic counter
@@ -616,6 +637,11 @@ class ShmTransport(TcpTransport):
                 _HDR.pack(_SHMF, len(env_b), len(meta), arr.nbytes)
                 + env_b + meta)
         return True
+
+    def _proto_of(self, nbytes: int) -> str:
+        if self.shm_threshold <= nbytes <= self.RING_SIZE:
+            return "shm"
+        return super()._proto_of(nbytes)
 
     def _recv_shm(self, env: dict, meta: bytes, rlen: int) -> np.ndarray:
         name = env.pop("shm_ring")

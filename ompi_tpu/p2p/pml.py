@@ -35,6 +35,7 @@ import numpy as np
 from ompi_tpu.core.errors import MPIArgError, MPIRankError
 from ompi_tpu.request import Request
 from ompi_tpu.tool import spc
+from ompi_tpu.trace import core as _trace
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -168,6 +169,7 @@ class MatchingEngine:
         if _account and spc.attached():
             spc.inc("send")
             spc.inc("send_bytes", spc.payload_nbytes(payload))
+        t0 = _trace.now() if _trace._enabled else 0
         data = _copy_payload(payload, dest_device)
         with self._lock:
             seq = self._next_seq()
@@ -179,8 +181,15 @@ class MatchingEngine:
                         data,
                         Status(source, tag, _count_of(data), _nbytes_of(data)),
                     )
+                    if t0:
+                        _trace.complete("p2p", "send", t0, src=source,
+                                        dst=dest, tag=tag, matched=True,
+                                        nbytes=_nbytes_of(data))
                     return
             self._unexpected[dest].append(_Unexpected(source, tag, data, seq))
+        if t0:
+            _trace.complete("p2p", "send", t0, src=source, dst=dest, tag=tag,
+                            matched=False, nbytes=_nbytes_of(data))
 
     # -- recv ----------------------------------------------------------
 
@@ -188,6 +197,8 @@ class MatchingEngine:
         self._check_rank(dest)
         self._check_rank(source, wild_ok=True)
         spc.inc("irecv")
+        if _trace._enabled:
+            _trace.instant("p2p", "irecv", dst=dest, src=source, tag=tag)
         req = RecvRequest()
         if source == PROC_NULL:
             req._deliver(None, Status.null())

@@ -25,6 +25,7 @@ from typing import Any, Callable, Sequence
 import jax
 
 from ompi_tpu.core.errors import MPIRequestError
+from ompi_tpu.trace import core as _trace
 
 
 class Request:
@@ -59,9 +60,14 @@ class Request:
     def wait(self) -> Any:
         """MPI_Wait: block until complete, return the operation result."""
         if not self._complete:
+            t0 = _trace.now() if _trace._enabled else 0
             self._block()
             self._result = self._finalize()
             self._complete = True
+            if t0:
+                # the blocked-completion span: where caller time goes
+                # while the fabric/DCN works (straggler diagnosis)
+                _trace.complete("request", f"{type(self).__name__}.wait", t0)
         return self._result
 
     def _block(self) -> None:

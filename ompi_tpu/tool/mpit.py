@@ -30,6 +30,9 @@ from . import spc
 VERBOSITY_USER_BASIC = 221
 SCOPE_ALL_EQ = 60
 PVAR_CLASS_COUNTER = 243
+#: array-valued pvars (the trace latency histograms) — reads return a
+#: list of bucket counts, the MPI_T count>1 pvar shape
+PVAR_CLASS_AGGREGATE = 246
 
 _sessions = 0
 
@@ -131,7 +134,41 @@ class PvarInfo:
 
 
 def _pvar_names() -> list[str]:
-    return ["spc_" + k for k in spc.known()]
+    """spc counters first (stable indices), then the trace pvars —
+    fixed tracer totals plus one count + one latency-histogram pvar
+    per (layer, op) with recorded spans.  Trace names appear in
+    first-seen span order and the namespace only ever GROWS at the
+    tail while tracing runs (trace reset zeroes values in place), so
+    an index a tool cached in a pvar handle keeps naming the same
+    variable — the index-stability contract C-side handles rely on."""
+    from ompi_tpu.trace import core as trace
+
+    names = ["spc_" + k for k in spc.known()]
+    names += ["trace_events", "trace_dropped"]
+    for layer, op in trace.span_ops():
+        names.append(f"trace_span_{layer}_{op}_count")
+        names.append(f"trace_span_{layer}_{op}_hist")
+    return names
+
+
+def _trace_key(name: str) -> tuple[str, str]:
+    """trace_span_<layer>_<op> → (layer, op); layers never contain an
+    underscore, so the first split is unambiguous."""
+    layer, _, op = name[len("trace_span_"):].partition("_")
+    return layer, op
+
+
+def _trace_pvar_read(name: str):
+    from ompi_tpu.trace import core as trace
+
+    if name == "trace_events":
+        return trace.event_count()
+    if name == "trace_dropped":
+        return trace.dropped()
+    layer, op = _trace_key(name)
+    if op.endswith("_count"):
+        return trace.span_count(layer, op[: -len("_count")])
+    return trace.latency_histogram(layer, op[: -len("_hist")])
 
 
 def pvar_get_num() -> int:
@@ -144,8 +181,15 @@ def pvar_get_info(index: int) -> PvarInfo:
     names = _pvar_names()
     if not 0 <= index < len(names):
         raise MPIArgError(f"pvar index {index} out of range")
-    return PvarInfo(names[index], PVAR_CLASS_COUNTER,
-                    f"SPC counter {names[index][4:]}")
+    name = names[index]
+    if name.startswith("trace_"):
+        if name.endswith("_hist"):
+            layer, op = _trace_key(name)
+            return PvarInfo(name, PVAR_CLASS_AGGREGATE,
+                            f"trace span latency histogram (log2 µs "
+                            f"buckets) {layer}/{op[:-len('_hist')]}")
+        return PvarInfo(name, PVAR_CLASS_COUNTER, f"trace counter {name[6:]}")
+    return PvarInfo(name, PVAR_CLASS_COUNTER, f"SPC counter {name[4:]}")
 
 
 def pvar_index(name: str) -> int:
@@ -156,14 +200,51 @@ def pvar_index(name: str) -> int:
         raise MPIArgError(f"no pvar named {name}") from None
 
 
-def pvar_read(index: int) -> int:
+def pvar_read(index: int):
     _check()
-    return spc.get(_at(_pvar_names(), index, "pvar")[4:])
+    name = _at(_pvar_names(), index, "pvar")
+    if name.startswith("trace_"):
+        return _trace_pvar_read(name)
+    return spc.get(name[4:])
 
 
 def pvar_reset() -> None:
+    """Session-wide pvar reset: zero every counter.  Trace aggregates
+    zero in place; the event ring, seq counters, and pvar namespace
+    survive — resetting counters must not truncate the finalize-time
+    timeline, desync cross-rank merge keys, or shift cached indices."""
     _check()
     spc.reset()
+    from ompi_tpu.trace import core as trace
+
+    trace.zero_stats()
+
+
+def pvar_reset_one(index: int) -> None:
+    """MPI_T_pvar_reset on one handle: zero that variable only (the C
+    shim routes here — the namespace owner does the name surgery).
+
+    ``trace_events`` is a buffer watermark whose "reset" would discard
+    the recorded timeline (truncating the finalize-time Chrome trace)
+    — it is not resettable, like the reference's read-only pvars.  A
+    ``_count``/``_hist`` pair are two views of ONE aggregate and reset
+    together."""
+    _check()
+    name = _at(_pvar_names(), index, "pvar")
+    from ompi_tpu.trace import core as trace
+
+    if name == "trace_events":
+        raise MPIArgError(
+            "trace_events is a buffer watermark; resetting it would "
+            "discard the recorded timeline (use ompi_tpu.trace.reset())"
+        )
+    if name == "trace_dropped":
+        trace.reset_dropped()
+    elif name.startswith("trace_span_"):
+        layer, op = _trace_key(name)
+        trace.reset_span_stat(layer, op.rsplit("_", 1)[0])
+    else:
+        spc.reset_one(name[len("spc_"):])
 
 
 def pvar_start() -> None:

@@ -1,0 +1,90 @@
+"""Cross-rank trace merge — one timeline from per-process trace files.
+
+A ``tpurun`` job writes one Chrome trace per process
+(``<trace_output>.<proc>.json``).  This module folds them into a single
+timeline:
+
+* every event keeps its originating ``pid`` (tpurun process index), so
+  the viewer shows one process group per rank;
+* collective api-layer spans carry a ``(comm, op, seq)`` key recorded
+  at issue time; the merge stamps each with ``args.key =
+  "comm/op/seq"`` so one collective's spans across ALL ranks select
+  together in Perfetto — the cross-rank alignment the per-(comm, op)
+  sequence counter exists for;
+* events are sorted by timestamp (all processes share the host
+  wall-clock anchor, so ordering is meaningful on one host).
+
+:func:`collective_keys` extracts a rank's key sequence; ranks of one
+communicator must produce identical sequences (MPI same-issue-order),
+which the np=2 test asserts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+
+def load(path: str) -> dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace-event file")
+    return doc
+
+
+def span_key(ev: dict[str, Any]) -> str | None:
+    """The merge key of a collective span, or None for unkeyed events."""
+    args = ev.get("args") or {}
+    if ev.get("ph") == "X" and "seq" in args and "comm" in args:
+        return f"{args['comm']}/{ev['name']}/{args['seq']}"
+    return None
+
+
+def merge_chrome(docs: Iterable[dict[str, Any]]) -> dict[str, Any]:
+    """Merge loaded Chrome trace dicts into one timeline."""
+    events: list[dict[str, Any]] = []
+    dropped = 0
+    for doc in docs:
+        other = doc.get("otherData") or {}
+        dropped += int(other.get("dropped_events", 0))
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            key = span_key(ev)
+            if key is not None:
+                ev["args"] = dict(ev["args"], key=key)
+            events.append(ev)
+    # metadata (ph M) first, then by timestamp — Chrome tolerates any
+    # order but a sorted timeline diffs cleanly and streams to viewers
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged_processes": _pids(events),
+                      "dropped_events": dropped},
+    }
+
+
+def merge_files(paths: Iterable[str]) -> dict[str, Any]:
+    return merge_chrome(load(p) for p in paths)
+
+
+def _pids(events: list[dict[str, Any]]) -> list[int]:
+    return sorted({int(e.get("pid", 0)) for e in events})
+
+
+def collective_keys(doc: dict[str, Any], pid: int | None = None) -> list[tuple]:
+    """Ordered (comm, op, seq) keys of one process's collective spans
+    (``pid=None``: all processes).  Order is by seq within (comm, op)
+    issue order — i.e. by timestamp."""
+    out = []
+    for ev in sorted(
+        (e for e in doc["traceEvents"] if e.get("ph") == "X"),
+        key=lambda e: e.get("ts", 0),
+    ):
+        if pid is not None and int(ev.get("pid", 0)) != pid:
+            continue
+        args = ev.get("args") or {}
+        if "seq" in args and "comm" in args:
+            out.append((args["comm"], ev["name"], int(args["seq"])))
+    return out

@@ -170,14 +170,23 @@ def test_addr_reuse_accounting_on_cpu(world):
     free list acting as the mpool."""
     arena = world.mesh.arena
     n = world.size
-    # the sampler records 1-in-8 past warm-up; 64 stages guarantees
-    # several sampled observations of this signature
-    for _ in range(64):
-        x = world.mesh.stage_in(np.ones((n, 7), np.float32))
-        del x
-    s = arena.stats()
-    if s["addr_reuse"] == -1:
+    # the sampler records 1-in-8 past warm-up, and blocking before the
+    # drop is required — while the async dispatch still references a
+    # buffer the allocator cannot recycle its address.  WHERE the
+    # recycled address shows up depends on prior heap state (suite
+    # order), so stage in bounded batches until a sampled repeat lands
+    # rather than asserting a fixed iteration count.
+    base = arena.stats()
+    if base["addr_reuse"] == -1:
         import pytest as _pytest
 
         _pytest.skip("backend does not expose buffer pointers")
-    assert s["addr_reuse"] > 0
+    for _ in range(16):  # ≤ 4096 stages, typically one batch
+        for _ in range(256):
+            x = world.mesh.stage_in(np.ones((n, 7), np.float32))
+            x.block_until_ready()
+            del x
+        if arena.stats()["addr_reuse"] > base["addr_reuse"]:
+            break
+    s = arena.stats()
+    assert s["addr_reuse"] > base["addr_reuse"]

@@ -1,0 +1,308 @@
+"""Trace subsystem tests — the third observability leg (SPC counters,
+monitoring matrices, and now event timelines): ring-buffer recording,
+the zero-cost disabled path, Chrome export, cross-rank merge keyed by
+(comm, op, seq), MPI_T trace pvars, and the trace_report CLI."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+from ompi_tpu.tool import mpit
+from ompi_tpu.trace import chrome, core as trace, merge
+
+REPO = Path(__file__).resolve().parent.parent
+REPORT = REPO / "tools" / "trace_report.py"
+GOLDEN = REPO / "tests" / "golden" / "trace_fixture.json"
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world(devices):
+    return api.init()
+
+
+@pytest.fixture(autouse=True)
+def clean_trace():
+    trace.reset()
+    trace.enable(False)
+    yield
+    trace.reset()
+    trace.enable(False)
+
+
+# -- core recording ----------------------------------------------------
+
+
+def test_disabled_by_default_records_nothing(world):
+    """The satellite guarantee: with trace_enable off (the default),
+    every hook is a no-op — collectives, p2p, and direct record calls
+    leave the buffer empty."""
+    assert not trace.enabled()
+    trace.instant("api", "nope")
+    trace.complete("api", "nope", trace.now())
+    x = np.ones((N, 4), np.float32)
+    world.allreduce(x, SUM)
+    world.barrier()
+    world.send(np.arange(3.0), source=0, dest=1, tag=9)
+    world.recv(dest=1, source=0, tag=9)
+    assert trace.event_count() == 0
+    assert trace.dropped() == 0
+
+
+def test_enabled_records_api_and_coll_spans(world):
+    trace.enable(True)
+    x = np.ones((N, 4), np.float32)
+    world.allreduce(x, SUM)
+    world.allreduce(x, SUM)
+    world.barrier()
+    evs = trace.events()
+    spans = [(e[3], e[4], e[6]) for e in evs if e[0] == "X"]
+    # api-layer allreduce spans carry incrementing seq (the merge key)
+    ar = [s for s in spans if s[:2] == ("api", "allreduce")]
+    assert [s[2] for s in ar] == [0, 1], spans
+    assert ("api", "barrier", 0) in spans
+    # coll layer present (table-path barrier names its provider)
+    assert any(e[3] == "coll" for e in evs), evs
+    st = trace.span_stats()
+    assert st[("api", "allreduce")]["count"] == 2
+    assert sum(st[("api", "allreduce")]["hist"]) == 2
+
+
+def test_p2p_and_request_layers(world):
+    trace.enable(True)
+    world.send(np.arange(4.0), source=2, dest=3, tag=1)
+    out, st = world.recv(dest=3, source=2, tag=1)
+    np.testing.assert_array_equal(out, np.arange(4.0))
+    layers = {e[3] for e in trace.events()}
+    assert "p2p" in layers, layers
+    names = [e[4] for e in trace.events() if e[3] == "p2p"]
+    assert "send" in names and "irecv" in names, names
+
+
+def test_ring_buffer_bounded_and_counts_drops():
+    trace.enable(True, buffer_events=8)
+    for i in range(20):
+        trace.instant("api", f"e{i}")
+    assert trace.event_count() == 8
+    assert trace.dropped() == 12
+    # oldest dropped: the survivors are the last 8
+    assert [e[4] for e in trace.events()] == [f"e{i}" for i in range(12, 20)]
+    trace.enable(True, buffer_events=65536)
+
+
+def test_seq_counters_per_comm_op():
+    trace.enable(True)
+    assert trace.next_seq("c1", "allreduce") == 0
+    assert trace.next_seq("c1", "allreduce") == 1
+    assert trace.next_seq("c1", "bcast") == 0
+    assert trace.next_seq("c2", "allreduce") == 0
+    trace.reset()
+    assert trace.next_seq("c1", "allreduce") == 0
+
+
+# -- chrome export + merge ---------------------------------------------
+
+
+def _record_rank(ops=3):
+    for _ in range(ops):
+        t0 = trace.now()
+        trace.complete("coll", "allreduce", trace.now(), provider="han")
+        trace.complete("dcn", "send", trace.now(), nbytes=64, peer="x",
+                       proto="eager")
+        trace.complete("api", "allreduce", t0, comm="MPI_COMM_WORLD",
+                       seq=trace.next_seq("MPI_COMM_WORLD", "allreduce"),
+                       nbytes=64)
+
+
+def test_chrome_export_valid(tmp_path):
+    trace.enable(True)
+    _record_rank()
+    p = tmp_path / "t.json"
+    chrome.dump(str(p), pid=0)
+    doc = json.load(open(p))
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 9
+    for e in xs:
+        assert {"name", "cat", "pid", "tid", "ts", "dur"} <= set(e)
+    # thread metadata names the layers
+    lanes = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"api", "coll", "dcn"} <= lanes
+    assert doc["otherData"]["dropped_events"] == 0
+
+
+def test_merge_aligns_ranks(tmp_path):
+    paths = []
+    for rank in range(2):
+        trace.reset()
+        trace.enable(True)
+        _record_rank()
+        p = tmp_path / f"trace.{rank}.json"
+        chrome.dump(str(p), pid=rank)
+        paths.append(str(p))
+    merged = merge.merge_files(paths)
+    assert merged["otherData"]["merged_processes"] == [0, 1]
+    k0 = merge.collective_keys(merged, pid=0)
+    k1 = merge.collective_keys(merged, pid=1)
+    assert k0 == k1 == [("MPI_COMM_WORLD", "allreduce", i) for i in range(3)]
+    # keyed spans carry the cross-rank selection key
+    keyed = [e for e in merged["traceEvents"]
+             if (e.get("args") or {}).get("key")]
+    assert len(keyed) == 6  # 3 collectives × 2 ranks
+    # timestamps sorted in the merged timeline
+    ts = [e["ts"] for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+# -- MPI_T pvars -------------------------------------------------------
+
+
+def test_mpit_trace_pvars(world):
+    mpit.init_thread()
+    try:
+        trace.enable(True)
+        x = np.ones((N, 2), np.float32)
+        world.allreduce(x, SUM)
+        assert mpit.pvar_read(mpit.pvar_index("trace_events")) >= 1
+        assert mpit.pvar_read(mpit.pvar_index("trace_dropped")) == 0
+        # pvars key on (layer, op): p2p 'send' and dcn 'send' never merge
+        i = mpit.pvar_index("trace_span_api_allreduce_count")
+        assert mpit.pvar_read(i) == 1
+        h = mpit.pvar_index("trace_span_api_allreduce_hist")
+        buckets = mpit.pvar_read(h)
+        assert isinstance(buckets, list) and sum(buckets) == 1
+        assert mpit.pvar_get_info(h).var_class == mpit.PVAR_CLASS_AGGREGATE
+        # pvar_reset zeroes aggregates but PRESERVES the event ring
+        # (the finalize-time timeline must not be truncated by a
+        # counter reset), the seq counters, and the namespace (cached
+        # indices stay valid)
+        n_names = mpit.pvar_get_num()
+        ring = mpit.pvar_read(mpit.pvar_index("trace_events"))
+        before = trace.next_seq("MPI_COMM_WORLD", "allreduce")
+        mpit.pvar_reset()
+        assert mpit.pvar_read(mpit.pvar_index("trace_events")) == ring
+        assert mpit.pvar_read(i) == 0  # same handle, same variable
+        assert mpit.pvar_get_num() == n_names
+        assert trace.next_seq("MPI_COMM_WORLD", "allreduce") == before + 1
+        # single-handle reset (the C MPI_T_pvar_reset path): zeroes only
+        # that aggregate; other pvars and the event ring are untouched
+        world.allreduce(x, SUM)
+        assert mpit.pvar_read(i) == 1
+        ring_before = mpit.pvar_read(mpit.pvar_index("trace_events"))
+        mpit.pvar_reset_one(i)
+        assert mpit.pvar_read(i) == 0
+        assert mpit.pvar_read(mpit.pvar_index("trace_events")) == ring_before
+        # trace_events is a watermark: resetting it would truncate the
+        # finalize-time trace file, so it refuses
+        from ompi_tpu.core.errors import MPIArgError
+
+        with pytest.raises(MPIArgError):
+            mpit.pvar_reset_one(mpit.pvar_index("trace_events"))
+    finally:
+        mpit.finalize()
+
+
+# -- trace_report CLI --------------------------------------------------
+
+
+def test_trace_report_selftest():
+    """CI satellite: the CLI's built-in self-check must pass."""
+    res = subprocess.run([sys.executable, str(REPORT), "--selftest"],
+                         capture_output=True, timeout=60)
+    assert res.returncode == 0, res.stderr.decode()
+    assert b"selftest OK" in res.stdout
+
+
+def test_trace_report_golden_fixture(tmp_path):
+    """CI satellite: report + merge over the checked-in golden trace."""
+    out = tmp_path / "merged.json"
+    res = subprocess.run(
+        [sys.executable, str(REPORT), str(GOLDEN), "--merge-out", str(out)],
+        capture_output=True, timeout=60,
+    )
+    assert res.returncode == 0, res.stderr.decode()
+    text = res.stdout.decode()
+    assert "allreduce" in text and "p99" in text and "slowest" in text
+    doc = json.load(open(out))  # merged output is valid Chrome JSON
+    assert doc["otherData"]["merged_processes"] == [0, 1]
+    k0 = merge.collective_keys(doc, pid=0)
+    k1 = merge.collective_keys(doc, pid=1)
+    assert k0 == k1 != []
+
+
+# -- multi-process (tpurun) end-to-end ---------------------------------
+
+
+def test_tpurun_np2_trace_merge(tmp_path):
+    """The acceptance run: a 2-rank multiproc job with trace_enable on
+    writes per-rank Chrome traces whose merged timeline has the same
+    collective (comm, op, seq) sequence on both ranks, spans from ≥3
+    layers for the allreduces, monotonic per-rank timestamps, and a
+    trace_report summary."""
+    from tests.test_multiproc import run_tpurun
+
+    out_base = tmp_path / "trace"
+    res = run_tpurun(
+        2, REPO / "tests" / "workers" / "mp_trace_worker.py", cpu_devices=1,
+        mca={"trace_enable": "1", "trace_output": str(out_base),
+             "btl": "tcp"},
+    )
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"tpurun failed:\n{out}\n{res.stderr.decode()}"
+    for check in ("trace_allreduce", "trace_bcast_barrier", "trace_layers",
+                  "finalize"):
+        hits = [l for l in out.splitlines() if f"OK {check} " in l]
+        assert len(hits) == 2, f"{check}: {hits}\n{out}"
+
+    paths = [f"{out_base}.{p}.json" for p in range(2)]
+    for p in paths:
+        assert Path(p).exists(), f"missing per-rank trace {p}\n{out}"
+        json.load(open(p))  # each rank file is valid Chrome JSON
+    merged = merge.merge_files(paths)
+    assert merged["otherData"]["merged_processes"] == [0, 1]
+
+    # identical collective key sequences on both ranks, ≥3 allreduces
+    k0 = merge.collective_keys(merged, pid=0)
+    k1 = merge.collective_keys(merged, pid=1)
+    assert k0 == k1 != [], (k0, k1)
+    ar = [k for k in k0 if k[1] == "allreduce"]
+    assert [s for _, _, s in ar] == list(range(len(ar))) and len(ar) >= 3, k0
+
+    # spans from ≥3 distinct layers (api, coll, dcn/p2p)
+    cats = {e["cat"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert len(cats & {"api", "coll", "dcn", "p2p"}) >= 3, cats
+
+    # per-rank timestamps are monotonic in issue order
+    for pid in (0, 1):
+        ts = [e["ts"] for e in merged["traceEvents"]
+              if e.get("ph") == "X" and e["pid"] == pid
+              and e.get("cat") == "api" and e["name"] == "allreduce"]
+        assert ts == sorted(ts), ts
+
+    # the report renders a per-op latency summary from the merged run
+    rep = subprocess.run([sys.executable, str(REPORT)] + paths,
+                         capture_output=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr.decode()
+    assert "allreduce" in rep.stdout.decode()
+
+
+def test_tpurun_np2_trace_disabled_writes_nothing(tmp_path):
+    """trace_output without trace_enable: hooks stay off, no files."""
+    from tests.test_multiproc import run_tpurun
+
+    out_base = tmp_path / "trace"
+    res = run_tpurun(
+        2, REPO / "tests" / "workers" / "mp_worker.py", cpu_devices=1,
+        mca={"trace_output": str(out_base), "btl": "tcp"},
+    )
+    assert res.returncode == 0, res.stdout.decode() + res.stderr.decode()
+    assert not list(tmp_path.glob("trace.*.json"))

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""trace_report — summarize / merge ompi_tpu Chrome trace files.
+
+Usage::
+
+    # per-op latency summary + slowest spans from one or more rank files
+    python tools/trace_report.py trace.0.json trace.1.json [--top N]
+
+    # also write the merged single-timeline Chrome trace
+    python tools/trace_report.py trace.*.json --merge-out merged.json
+
+    # self-check (no input files): synthesizes a 2-rank trace through
+    # the real tracer/export/merge stack and validates the invariants
+    python tools/trace_report.py --selftest
+
+Input files are what ``--mca trace_enable 1 --mca trace_output
+<path>`` writes at finalize (``<path>.<proc>.json``).  Stdlib-only —
+no jax import, so it runs anywhere the trace files land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+# tools/ is not a package entry point for ompi_tpu; reach the repo root
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ompi_tpu.trace import chrome, core, merge  # noqa: E402
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def summarize(doc: dict[str, Any]) -> list[dict[str, Any]]:
+    """Per-(layer, op) latency rows from a Chrome trace dict."""
+    groups: dict[tuple[str, str], list[float]] = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        groups.setdefault((ev.get("cat", "?"), ev["name"]), []).append(
+            float(ev.get("dur", 0.0))
+        )
+    rows = []
+    for (cat, name), durs in sorted(groups.items()):
+        durs.sort()
+        rows.append({
+            "layer": cat, "op": name, "count": len(durs),
+            "p50_us": percentile(durs, 0.50),
+            "p99_us": percentile(durs, 0.99),
+            "max_us": durs[-1],
+            "total_ms": sum(durs) / 1000.0,
+        })
+    return rows
+
+
+def slowest(doc: dict[str, Any], top: int) -> list[dict[str, Any]]:
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    spans.sort(key=lambda e: -float(e.get("dur", 0.0)))
+    return spans[:top]
+
+
+def render(doc: dict[str, Any], top: int, out=sys.stdout) -> None:
+    rows = summarize(doc)
+    pids = sorted({int(e.get("pid", 0)) for e in doc["traceEvents"]})
+    n_ev = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"trace: {n_ev} events from {len(pids)} process(es) {pids}",
+          file=out)
+    print(f"{'layer':<9}{'op':<28}{'count':>7}{'p50 µs':>10}"
+          f"{'p99 µs':>10}{'max µs':>10}{'total ms':>10}", file=out)
+    for r in rows:
+        print(f"{r['layer']:<9}{r['op']:<28}{r['count']:>7}"
+              f"{r['p50_us']:>10.1f}{r['p99_us']:>10.1f}"
+              f"{r['max_us']:>10.1f}{r['total_ms']:>10.2f}", file=out)
+    sl = slowest(doc, top)
+    if sl:
+        print(f"\nslowest {len(sl)} spans:", file=out)
+        for e in sl:
+            args = e.get("args") or {}
+            key = args.get("key") or args.get("comm", "")
+            print(f"  {e.get('dur', 0.0):>10.1f} µs  pid={e.get('pid', 0)} "
+                  f"{e.get('cat', '?')}/{e['name']}  {key}", file=out)
+
+
+def selftest() -> int:
+    """Drive the real tracer → export → merge → report stack on
+    synthetic 2-rank data and assert the subsystem invariants."""
+    import os
+    import tempfile
+
+    was_enabled = core.enabled()
+    tmp = tempfile.mkdtemp(prefix="ompi_tpu_trace_selftest_")
+    paths = []
+    try:
+        for rank in range(2):
+            core.reset()
+            core.enable(True, buffer_events=1024)
+            for i in range(3):
+                t0 = core.now()
+                core.instant("coll", "tuned_decision", coll="allreduce",
+                             algorithm="psum")
+                t1 = core.now()
+                core.complete("dcn", "send", t1, nbytes=4096, peer="peer",
+                              proto="eager")
+                core.complete("coll", "allreduce", t1, provider="han")
+                core.complete("api", "allreduce", t0, comm="MPI_COMM_WORLD",
+                              seq=core.next_seq("MPI_COMM_WORLD", "allreduce"),
+                              nbytes=4096)
+            p = os.path.join(tmp, f"trace.{rank}.json")
+            chrome.dump(p, pid=rank)
+            paths.append(p)
+        merged = merge.merge_files(paths)
+        # merged doc is valid Chrome JSON
+        json.loads(json.dumps(merged))
+        assert merged["otherData"]["merged_processes"] == [0, 1], merged[
+            "otherData"]
+        # both ranks produced the SAME collective key sequence
+        k0 = merge.collective_keys(merged, pid=0)
+        k1 = merge.collective_keys(merged, pid=1)
+        assert k0 == k1 != [], (k0, k1)
+        assert k0 == [("MPI_COMM_WORLD", "allreduce", i) for i in range(3)]
+        # spans from ≥3 distinct layers survived the merge
+        cats = {e.get("cat") for e in merged["traceEvents"]
+                if e.get("ph") == "X"}
+        assert {"api", "coll", "dcn"} <= cats, cats
+        # timestamps are monotonic per rank
+        for pid in (0, 1):
+            ts = [e["ts"] for e in merged["traceEvents"]
+                  if e.get("ph") == "X" and e["pid"] == pid
+                  and e["name"] == "allreduce" and e.get("cat") == "api"]
+            assert ts == sorted(ts), ts
+        # the report renders non-trivially
+        import io
+
+        buf = io.StringIO()
+        render(merged, top=5, out=buf)
+        text = buf.getvalue()
+        assert "allreduce" in text and "p99" in text, text
+        print("selftest OK: 2 ranks, "
+              f"{len(merged['traceEvents'])} merged events, keys aligned")
+        return 0
+    finally:
+        core.reset()
+        core.enable(was_enabled)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="*", help="per-rank Chrome trace files")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest spans to list")
+    ap.add_argument("--merge-out", metavar="PATH",
+                    help="write the merged Chrome trace here")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in self-check and exit")
+    ns = ap.parse_args(argv)
+    if ns.selftest:
+        return selftest()
+    if not ns.traces:
+        ap.error("no trace files given (or use --selftest)")
+    doc = merge.merge_files(ns.traces)
+    render(doc, top=ns.top)
+    if ns.merge_out:
+        with open(ns.merge_out, "w") as f:
+            json.dump(doc, f)
+        print(f"\nmerged trace written to {ns.merge_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
