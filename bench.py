@@ -614,6 +614,13 @@ def main() -> None:
                 detail[key] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     detail["platform"] = platform
+    # stall-cause context for future BENCH_r*.json rounds: the native
+    # transport counter snapshot captured inside the np=2 DCN leg
+    # (ring backpressure vs rendezvous serialization vs doorbell
+    # traffic behind each bandwidth row — ompi_tpu/metrics/)
+    dcn = detail.get("dcn")
+    if isinstance(dcn, dict) and isinstance(dcn.get("native"), dict):
+        detail["native_counters"] = dcn["native"].get("native_counters", {})
     detail_path = REPO / "BENCH_DETAIL.json"
     detail_path.write_text(json.dumps(detail, indent=1))
 

@@ -152,6 +152,73 @@ static uint64_t now_ns() {
   return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
+// ---------------------------------------------------------------------
+// transport telemetry (the native half of ompi_tpu/metrics/)
+// ---------------------------------------------------------------------
+//
+// ≈ the reference's SPC counters (ompi_spc.c) applied to the transport
+// plane the Python tracer cannot see: every counter is one relaxed
+// atomic on the hot path, no syscalls, no locks.  The block is
+// versioned (slot 0) so the Python ctypes reader can validate layout,
+// and cache-line-aligned so the counters never false-share with the
+// engine's mutex-protected state.  Readers (tdcn_stats) copy the live
+// words — monotone but not mutually consistent, which is all a
+// telemetry snapshot needs.
+
+#define TDCN_STATS_VERSION 1
+
+enum TdcnStatIdx {
+  TS_VERSION = 0,        // layout version stamp (TDCN_STATS_VERSION)
+  TS_DOORBELLS,          // futex doorbell rings (tx ring + completion wakeups)
+  TS_STALL_NS,           // total send-side stall ns (ring + CTS + rndv slot)
+  TS_RING_STALL_NS,      // ns blocked in ShmRing::reserve on backpressure
+  TS_RING_STALLS,        // reserve() calls that could not satisfy first try
+  TS_RING_HWM,           // tx ring occupancy high-water (bytes)
+  TS_CTS_WAIT_NS,        // ns between RTS sent and CTS granted (tcp rndv)
+  TS_CTS_WAITS,          // rendezvous sends that waited for CTS
+  TS_RNDV_DEPTH,         // inbound rendezvous transfers in flight (gauge)
+  TS_RNDV_HWM,           // high-water of TS_RNDV_DEPTH
+  TS_SLOT_WAITS,         // inbound RTS that blocked on a full rndv slot table
+  TS_EAGER_MSGS,         // single-frame sends (ring records + tcp eager)
+  TS_EAGER_BYTES,
+  TS_CHUNKED_MSGS,       // ring chunked-streaming transfers (RTS + FRAGs)
+  TS_CHUNKED_BYTES,
+  TS_RNDV_MSGS,          // tcp rendezvous transfers (RTS/CTS/FRAG)
+  TS_RNDV_BYTES,
+  TS_DELIVERED,          // complete inbound messages handed to matching
+  TS_UNEXPECTED_HWM,     // unexpected-queue depth high-water (one cid+dst)
+  TS_COUNT
+};
+
+// index order above MUST match this list — the self-describing name
+// table the Python side (ompi_tpu/metrics/core.py) reads once
+static const char *TDCN_STAT_NAMES =
+    "version,doorbells,stall_ns,ring_stall_ns,ring_stalls,ring_hwm,"
+    "cts_wait_ns,cts_waits,rndv_depth,rndv_hwm,slot_waits,"
+    "eager_msgs,eager_bytes,chunked_msgs,chunked_bytes,"
+    "rndv_msgs,rndv_bytes,delivered,unexpected_hwm";
+
+struct alignas(64) TdcnStats {
+  std::atomic<uint64_t> v[TS_COUNT];
+  TdcnStats() {
+    for (int i = 0; i < TS_COUNT; i++)
+      v[i].store(0, std::memory_order_relaxed);
+    v[TS_VERSION].store(TDCN_STATS_VERSION, std::memory_order_relaxed);
+  }
+  void add(int idx, uint64_t n) {
+    v[idx].fetch_add(n, std::memory_order_relaxed);
+  }
+  void gauge(int idx, uint64_t n) {
+    v[idx].store(n, std::memory_order_relaxed);
+  }
+  void hwm(int idx, uint64_t n) {
+    uint64_t cur = v[idx].load(std::memory_order_relaxed);
+    while (cur < n &&
+           !v[idx].compare_exchange_weak(cur, n, std::memory_order_relaxed)) {
+    }
+  }
+};
+
 static bool recv_exact(int fd, void *buf, size_t n) {
   char *p = (char *)buf;
   while (n) {
@@ -267,11 +334,17 @@ struct ShmRing {
   // Reserve space for one contiguous record of `need` bytes (8-aligned,
   // including the u64 length prefix).  Returns the write pointer or
   // nullptr on timeout (receiver stalled).  Single producer: only the
-  // sender's per-peer lock holder calls this.
+  // sender's per-peer lock holder calls this.  `stats` (optional)
+  // accounts backpressure: a reserve that cannot be satisfied on its
+  // first pass counts one ring stall and the full blocked duration —
+  // the "per-chunk doorbell round-trips under backpressure" signal the
+  // osu_bw collapse investigation needs.  The happy path touches no
+  // clock and no stat.
   uint8_t *reserve(uint64_t need, uint64_t *rec_start,
-                   std::atomic<bool> *closing) {
+                   std::atomic<bool> *closing, TdcnStats *stats = nullptr) {
     need = (need + 7) & ~7ull;
     uint64_t spin = 0;
+    uint64_t stall_t0 = 0;
     for (;;) {
       if (closing->load(std::memory_order_relaxed)) return nullptr;
       uint64_t head = ctrl->head.load(std::memory_order_relaxed);
@@ -293,6 +366,11 @@ struct ShmRing {
       }
       if (size - (head - ctrl->tail.load(std::memory_order_acquire)) >=
           want) {
+        if (stall_t0 && stats) {
+          uint64_t d = now_ns() - stall_t0;
+          stats->add(TS_RING_STALL_NS, d);
+          stats->add(TS_STALL_NS, d);
+        }
         if (pad) {
           *(uint64_t *)(data + pos) = PAD_BIT | contig;
           head += contig;
@@ -300,6 +378,10 @@ struct ShmRing {
         }
         *rec_start = head;
         return data + pos;
+      }
+      if (!stall_t0 && stats) {
+        stall_t0 = now_ns();
+        stats->add(TS_RING_STALLS, 1);
       }
       if (++spin < 2048) {
         sched_yield();
@@ -498,6 +580,7 @@ struct Engine {
 
   std::atomic<bool> closing{false};
   std::atomic<uint64_t> bytes_sent{0};
+  TdcnStats stats;  // transport telemetry (tdcn_stats reads it)
   // inbound rendezvous flow control
   std::mutex rndv_mu;
   std::condition_variable rndv_cv;
@@ -597,6 +680,7 @@ static bool env_match(const PostedReq &p, const OwnedMsg &m) {
 // Wake inline-progress waiters (they futex-wait on OUR doorbell when
 // not consuming); completions from any transport ring it.
 static void wake_waiters(Engine *eng) {
+  eng->stats.add(TS_DOORBELLS, 1);
   eng->my_db.word->fetch_add(1, std::memory_order_release);
   futex_wake(eng->my_db.word, 64);
 }
@@ -604,6 +688,7 @@ static void wake_waiters(Engine *eng) {
 // Deliver one complete inbound message.  Called with eng->mu HELD.
 static void deliver_locked(Engine *eng, OwnedMsg &&m) {
   m.arrival = eng->arrival++;
+  eng->stats.add(TS_DELIVERED, 1);
   if (m.env.kind == FK_COLL) {
     auto key = std::make_tuple(m.env.cid, m.env.seq, m.env.src);
     auto it = eng->coll.find(key);
@@ -647,7 +732,9 @@ static void deliver_locked(Engine *eng, OwnedMsg &&m) {
         free(m.data);  // freed comm, no matching pending recv: drop
         return;
       }
-      q.unexpected[m.env.dst].push_back(std::move(m));
+      auto &uq = q.unexpected[m.env.dst];
+      uq.push_back(std::move(m));
+      eng->stats.hwm(TS_UNEXPECTED_HWM, uq.size());
       return;
     }
     // registered for Python delivery: fall through to PY queue
@@ -672,6 +759,7 @@ static void finish_reassembly(Engine *eng, const WireHdr &h,
     eng->reasm.erase({h.from_proc, h.seq});
     if (granted) {
       eng->rndv_active--;
+      eng->stats.gauge(TS_RNDV_DEPTH, (uint64_t)eng->rndv_active);
       eng->rndv_cv.notify_one();
     }
   }
@@ -732,6 +820,9 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
       // memory), allocate only then, and grant CTS
       {
         std::unique_lock<std::mutex> g(eng->rndv_mu);
+        if (eng->rndv_active >= eng->max_rndv)
+          eng->stats.add(TS_SLOT_WAITS, 1);  // sender's CTS delayed on
+                                             // slot reclaim
         eng->rndv_cv.wait(g, [&] {
           return eng->rndv_active < eng->max_rndv ||
                  eng->closing.load(std::memory_order_relaxed);
@@ -741,6 +832,8 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
           return;
         }
         eng->rndv_active++;
+        eng->stats.gauge(TS_RNDV_DEPTH, (uint64_t)eng->rndv_active);
+        eng->stats.hwm(TS_RNDV_HWM, (uint64_t)eng->rndv_active);
         ra->granted = true;
         ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
         eng->reasm[{h.from_proc, h.seq}] = ra;
@@ -1090,7 +1183,8 @@ static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
                              const Env &e, const void *payload) {
   uint64_t need = 8 + sizeof(WireHdr) + env_extra(h) + h.nbytes;
   uint64_t rec_start;
-  uint8_t *w = p->tx_ring.reserve(need, &rec_start, &eng->closing);
+  uint8_t *w = p->tx_ring.reserve(need, &rec_start, &eng->closing,
+                                  &eng->stats);
   if (!w) return false;
   *(uint64_t *)w = need;  // full record length (u64 prefix included)
   uint8_t *q = w + 8;
@@ -1100,6 +1194,13 @@ static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
   q += env_extra(h);
   if (h.nbytes) memcpy(q, payload, h.nbytes);
   p->tx_ring.publish(rec_start, need);
+  // occupancy after publish: producer cursor minus the consumer's last
+  // published tail — the high-water tells the perf PR how close the
+  // windowed burst came to the backpressure cliff
+  eng->stats.hwm(TS_RING_HWM,
+                 rec_start + ((need + 7) & ~7ull) -
+                     p->tx_ring.ctrl->tail.load(std::memory_order_relaxed));
+  eng->stats.add(TS_DOORBELLS, 1);
   p->peer_db.ring();
   return true;
 }
@@ -1153,7 +1254,11 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
     if (nbytes + sizeof(WireHdr) + 256 <= limit) {
       WireHdr h;
       fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
-      if (send_record_ring(eng, p, h, e, data)) return 0;
+      if (send_record_ring(eng, p, h, e, data)) {
+        eng->stats.add(TS_EAGER_MSGS, 1);
+        eng->stats.add(TS_EAGER_BYTES, nbytes);
+        return 0;
+      }
       return -1;
     }
     // chunked streaming: an RTS record (no CTS — ring backpressure is
@@ -1183,6 +1288,8 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
       if (!send_record_ring(eng, p, fh, fe, (const uint8_t *)data + off))
         return -1;
     }
+    eng->stats.add(TS_CHUNKED_MSGS, 1);
+    eng->stats.add(TS_CHUNKED_BYTES, nbytes);
     return 0;
   }
 
@@ -1198,6 +1305,8 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
         {(void *)data, (size_t)nbytes},
     };
     if (!writev_all(p->fd, iov, nbytes ? 3 : 2)) return -1;
+    eng->stats.add(TS_EAGER_MSGS, 1);
+    eng->stats.add(TS_EAGER_BYTES, nbytes);
     return 0;
   }
   // rendezvous
@@ -1215,11 +1324,19 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
   struct iovec iov[2] = {{&h, sizeof(h)}, {extra.data(), extra.size()}};
   if (!writev_all(p->fd, iov, 2)) return -1;
   {
+    // the RTS→CTS round trip is dead time the sender cannot pipeline —
+    // the "rendezvous serialization" suspect of the osu_bw collapse;
+    // account every wait so the stall breakdown can apportion it
+    uint64_t t0 = now_ns();
     std::unique_lock<std::mutex> g2(p->cts_mu);
     bool ok = p->cts_cv.wait_for(g2, std::chrono::seconds(600), [&] {
       return p->cts[xid] || eng->closing.load(std::memory_order_relaxed);
     });
     p->cts.erase(xid);
+    uint64_t d = now_ns() - t0;
+    eng->stats.add(TS_CTS_WAIT_NS, d);
+    eng->stats.add(TS_STALL_NS, d);
+    eng->stats.add(TS_CTS_WAITS, 1);
     if (!ok || eng->closing.load(std::memory_order_relaxed)) return -1;
   }
   for (uint64_t off = 0; off < nbytes; off += (uint64_t)eng->frag_size) {
@@ -1236,6 +1353,8 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
                              (size_t)n}};
     if (!writev_all(p->fd, fiov, 2)) return -1;
   }
+  eng->stats.add(TS_RNDV_MSGS, 1);
+  eng->stats.add(TS_RNDV_BYTES, nbytes);
   return 0;
 }
 
@@ -1813,6 +1932,23 @@ int tdcn_is_failed(void *h, int proc) {
 uint64_t tdcn_bytes_sent(void *h) {
   return ((Engine *)h)->bytes_sent.load(std::memory_order_relaxed);
 }
+
+// Copy the telemetry block into out[] (out[0] is the layout version).
+// Relaxed loads: monotone per counter, not mutually consistent — the
+// snapshot contract ompi_tpu/metrics/ documents.  Returns the number
+// of counters this build maintains; callers pass max_n = capacity.
+int tdcn_stats(void *h, uint64_t *out, int max_n) {
+  Engine *eng = (Engine *)h;
+  int n = TS_COUNT < max_n ? TS_COUNT : max_n;
+  for (int i = 0; i < n; i++)
+    out[i] = eng->stats.v[i].load(std::memory_order_relaxed);
+  return TS_COUNT;
+}
+
+// Self-describing index→name table (comma-separated, index order);
+// lets the Python reader and C tools agree on layout without
+// hardcoding, validated against out[0]'s version stamp.
+const char *tdcn_stats_names(void) { return TDCN_STAT_NAMES; }
 
 void tdcn_free(void *p) { free(p); }
 

@@ -656,6 +656,31 @@ static void fp_req_done(fp_req_t *q) {
   if (fp && fp->state == 2 && fp_live_refs(fp) == 0) fp_release(fp);
 }
 
+/* ---- transport telemetry re-export (ompi_tpu/metrics/ native plane)
+ *
+ * libtpudcn keeps a versioned per-engine counter block (doorbells,
+ * backpressure stall ns, ring high-water, eager/rndv/chunked traffic);
+ * C programs linked against libtpumpi read it here without knowing the
+ * engine handle — any live fast-path slot shares the process's one
+ * engine.  Zero syscalls; returns 0 when no native plane is wired
+ * (single-controller jobs, Python transports). */
+
+extern int tdcn_stats(void *, unsigned long long *, int);
+extern const char *tdcn_stats_names(void);
+
+int tpumpi_transport_stats(unsigned long long *out, int max_n) {
+  for (int h = 0; h < FP_HASH; h++) {
+    if (g_fph[h] && g_fph[h] != FP_TOMB && g_fph[h]->state == 1 &&
+        g_fph[h]->eng)
+      return tdcn_stats(g_fph[h]->eng, out, max_n);
+  }
+  return 0;
+}
+
+const char *tpumpi_transport_stats_names(void) {
+  return tdcn_stats_names();
+}
+
 /* test hook: live/condemned slot counts (soak tests pin no-leak) */
 void tpumpi_fp_stats(int *live, int *reqs) {
   if (live) *live = g_fp_live;

@@ -54,6 +54,12 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
 
     trace_core.register_vars(ctx.store)
     trace_core.sync_from_store(ctx.store)
+    # transport telemetry (--mca metrics_enable 1): the quantitative
+    # leg — native DCN counters + per-op histograms + flight recorder;
+    # synced before ProcContext so engine construction already counts
+    from ompi_tpu import metrics as _metrics
+
+    _metrics.sync_from_store(ctx.store)
     from ompi_tpu.mesh.mesh import world_mesh
 
     wm = world_mesh()
@@ -73,6 +79,9 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     else:
         _world = Comm(Group(range(wm.size)), wm, name="MPI_COMM_WORLD")
         _self_comm = Comm(Group([0]), wm.submesh([0]), name="MPI_COMM_SELF")
+    from ompi_tpu.metrics import flight as _flight
+
+    _flight.set_proc(int(getattr(_world, "proc", 0)))
     _initialized = True
     output.verbose(1, "runtime", "MPI_Init complete: world size %d (%s)",
                    _world.size, type(_world).__name__)
@@ -117,6 +126,20 @@ def finalize() -> None:
             _mon.dump(str(out))
     except Exception:
         pass  # accounting must never break finalize
+    # metrics export at finalize: every process writes
+    # <metrics_output>.<proc>.prom (Prometheus text format) and
+    # .jsonl (flight records + final snapshot) — analyze/correlate
+    # with tools/metrics_report.py
+    try:
+        from ompi_tpu import metrics as _metrics
+
+        mout = mca.default_context().store.get("metrics_output", "")
+        if mout and _metrics.enabled():
+            from ompi_tpu.metrics import export as _mexport
+
+            _mexport.write(str(mout), proc=int(getattr(_world, "proc", 0)))
+    except Exception:
+        pass  # telemetry must never break finalize
     # trace dump at finalize (Chrome trace JSON; ≈ the monitoring dump
     # above): every process writes <trace_output>.<proc>.json — merge
     # with tools/trace_report.py --merge-out

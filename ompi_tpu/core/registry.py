@@ -30,7 +30,7 @@ import importlib
 import os
 from typing import Any, Callable, Iterable, Type
 
-from .var import VarStore, full_var_name
+from .var import VarStore, full_var_name, register_observability_vars
 
 
 class ComponentError(Exception):
@@ -232,6 +232,10 @@ class MCAContext:
         param_files: Iterable[str] | None = None,
     ):
         self.store = VarStore(cmdline=cmdline, env=env, param_files=param_files)
+        # trace/metrics knobs register on EVERY store at construction so
+        # --mca-var listings (ompi_tpu.info, MPI_T cvars) show them even
+        # when the lazy trace/metrics subsystems were never imported
+        register_observability_vars(self.store)
         self.frameworks: dict[str, Framework] = {}
         self._register_builtin_components()
 

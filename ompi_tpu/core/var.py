@@ -341,3 +341,48 @@ class VarStore:
         for k, v in self._cmdline.items():
             out.setdefault(ENV_PREFIXES[0] + k, v)
         return out
+
+
+# -- observability variables (central registration) ---------------------
+#
+# The trace/metrics knobs are consumed by subsystems that only import
+# lazily (ompi_tpu.trace / ompi_tpu.metrics sync at MPI_Init), but the
+# vars must appear in every ``--mca``-var listing (``ompi_tpu.info``,
+# the MPI_T cvar surface) even before — and without — an init.  They
+# are therefore registered HERE, on every store at construction
+# (MCAContext.__init__), with the subsystems' register_vars functions
+# delegating to this table.  One source of truth for name, default,
+# type, and description.
+
+#: (framework, component, name, default, type, help)
+OBSERVABILITY_VARS = (
+    ("trace", "", "enable", False, "bool",
+     "Record cross-layer event spans into the trace ring buffer "
+     "(api/coll/p2p/dcn timelines; default off — zero-cost hooks)"),
+    ("trace", "", "buffer_events", 65536, "int",
+     "Trace ring-buffer capacity in events; the oldest events "
+     "are dropped (and counted) once full"),
+    ("trace", "", "output", "", "string",
+     "Chrome trace-event JSON path written at finalize; a "
+     "multi-process job writes <output>.<proc>.json per process "
+     "(merge with tools/trace_report.py)"),
+    ("metrics", "", "enable", False, "bool",
+     "Record transport telemetry (native-plane DCN counters, per-op "
+     "size/latency histograms, flight recorder); default off — one "
+     "boolean test per Python hook, one relaxed atomic per native "
+     "event"),
+    ("metrics", "", "output", "", "string",
+     "Telemetry export base path: finalize writes <output>.<proc>.prom "
+     "(Prometheus text format) and <output>.<proc>.jsonl (snapshots + "
+     "flight records; analyze with tools/metrics_report.py); flight "
+     "records also append live to <output>.flight.<proc>.jsonl"),
+    ("metrics", "", "flight_records", 64, "int",
+     "Flight-recorder ring capacity: how many counter snapshots "
+     "(timeouts, aborts, watermark crossings) are retained in memory"),
+)
+
+
+def register_observability_vars(store: "VarStore") -> None:
+    """Register the trace/metrics knobs on a store (idempotent)."""
+    for fw, comp, name, default, typ, help_ in OBSERVABILITY_VARS:
+        store.register(fw, comp, name, default, type=typ, help=help_)

@@ -40,6 +40,7 @@ from ompi_tpu.core.errors import (
     MPIInternalError,
     MPIProcFailedError,
 )
+from ompi_tpu.metrics import core as _metrics
 from .collops import DcnCollEngine, DcnJoinEngine, DcnSubEngine
 
 FK_COLL, FK_P2P, FK_PY = 0, 1, 2
@@ -129,6 +130,10 @@ def load_library():
         lib.tdcn_is_failed.argtypes = [P, I]
         lib.tdcn_bytes_sent.restype = U64
         lib.tdcn_bytes_sent.argtypes = [P]
+        lib.tdcn_stats.restype = I
+        lib.tdcn_stats.argtypes = [P, ctypes.POINTER(ctypes.c_uint64), I]
+        lib.tdcn_stats_names.restype = ctypes.c_char_p
+        lib.tdcn_stats_names.argtypes = []
         lib.tdcn_free.argtypes = [ctypes.c_void_p]
         lib.tdcn_close.argtypes = [P]
         lib.tdcn_chan_open.restype = U64
@@ -269,6 +274,11 @@ class _NativeOpsMixin:
               meta=None) -> None:
         root = self._native_root()
         arr = np.ascontiguousarray(payload)
+        if _metrics._enabled:
+            _metrics.observe_size("dcn_coll_send", arr.nbytes)
+            from ompi_tpu.metrics import flight as _flight
+
+            _flight.check_watermarks()
         meta_b = json.dumps(meta).encode() if meta is not None else None
         rc = root._csend(
             self.addresses[dst], FK_COLL, str(cid), seq, self.proc, 0, 0,
@@ -298,6 +308,13 @@ class _NativeOpsMixin:
                     f"DCN recv: peer proc {src} failed (cid={cid}, "
                     f"seq={seq})", failed=(src,))
             if _time.monotonic() > deadline:
+                # flight-record the ring/rendezvous state BEFORE the
+                # raise: a wedged windowed send dumps its counters
+                # instead of vanishing with the process
+                from ompi_tpu.metrics import flight as _flight
+
+                _flight.record("recv_timeout", cid=str(cid), seq=seq,
+                               src=src, timeout_s=timeout)
                 raise MPIInternalError(
                     f"DCN recv timeout after {timeout}s: proc {self.proc} "
                     f"waiting for proc {src} (cid={cid}, seq={seq}) — "
@@ -313,6 +330,8 @@ class _NativeOpsMixin:
     def send_p2p(self, dst_proc: int, envelope: dict, payload) -> None:
         root = self._native_root()
         arr = np.ascontiguousarray(np.asarray(payload))
+        if _metrics._enabled:
+            _metrics.observe_size("dcn_p2p_send", arr.nbytes)
         keys = set(envelope)
         cid = envelope.get("cid")
         if keys == {"cid", "src", "dst", "tag"} and root.is_native_cid(cid):
@@ -396,6 +415,14 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._hlock = threading.Lock()
         #: cids whose p2p frames the C matcher owns (native pml comms)
         self._native_cids: set[str] = set()
+        #: telemetry: the C engine's TdcnStats block, read via one
+        #: ctypes call (ompi_tpu.metrics merges it into snapshots/pvars)
+        self._stat_names = (
+            self._lib.tdcn_stats_names().decode().split(","))
+        self._stat_buf = (ctypes.c_uint64 * len(self._stat_names))()
+        from ompi_tpu import metrics as _metrics
+
+        _metrics.register_provider(self, self.stats_snapshot)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="tdcn-dispatch")
         self._dispatcher.start()
@@ -465,6 +492,11 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
 
     def chan_send(self, chan: int, kind: int, src: int, dst: int,
                   tag: int, arr: np.ndarray) -> None:
+        if _metrics._enabled:
+            _metrics.observe_size("dcn_p2p_send", arr.nbytes)
+            from ompi_tpu.metrics import flight as _flight
+
+            _flight.check_watermarks()
         if arr.ndim == 1:
             rc = self._lib.tdcn_chan_send1(
                 self._h, chan, kind, src, dst, tag, _dt_bytes(arr.dtype),
@@ -567,6 +599,22 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
 
                 print(f"[ompi_tpu tdcn] dispatcher error for {env}: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    # -- transport telemetry --------------------------------------------
+
+    def stats_snapshot(self) -> dict[str, int] | None:
+        """The C engine's telemetry block as {name: value} — relaxed
+        snapshot (monotone per counter, not mutually consistent).
+        Validates the layout version stamp; None once closed."""
+        if not self._running:
+            return None
+        n = self._lib.tdcn_stats(self._h, self._stat_buf,
+                                 len(self._stat_names))
+        vals = list(self._stat_buf[:min(n, len(self._stat_names))])
+        d = dict(zip(self._stat_names, vals))
+        if d.pop("version", 0) != 1:
+            return None  # layout drift: refuse to misattribute counters
+        return d
 
     # -- failure integration --------------------------------------------
 

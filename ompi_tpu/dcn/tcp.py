@@ -33,6 +33,7 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -127,6 +128,20 @@ class TcpTransport:
         #: payload bytes pushed through send() — the wire-cost meter the
         #: asymptotic regression tests (han reduce/scan) assert against
         self.bytes_sent = 0
+        #: transport telemetry on the NATIVE counter schema (subset the
+        #: Python plane can see), so --mca btl tcp|sm jobs export the
+        #: same names as libtpudcn.  Plain ints under benign races —
+        #: diagnostic counters, same discipline as bytes_sent.
+        self.stats: dict[str, int] = {
+            "eager_msgs": 0, "eager_bytes": 0,
+            "rndv_msgs": 0, "rndv_bytes": 0,
+            "chunked_msgs": 0, "chunked_bytes": 0,
+            "cts_waits": 0, "cts_wait_ns": 0, "stall_ns": 0,
+            "delivered": 0,
+        }
+        from ompi_tpu.metrics import core as _mcore
+
+        _mcore.register_provider(self, self._stats_snapshot)
         self._listen, self.address = self._make_listen(host)
         self._peers: dict[str, tuple[socket.socket, threading.Lock]] = {}
         self._lock = threading.Lock()
@@ -179,6 +194,7 @@ class TcpTransport:
     def _deliver(self, env: dict, payload: np.ndarray) -> None:
         import sys
 
+        self.stats["delivered"] += 1
         try:
             self._handler(env, payload)
         except Exception as e:  # a bad frame must not kill the receiver
@@ -343,6 +359,10 @@ class TcpTransport:
         annotation; mirrors the eager↔rendezvous switch in _send)."""
         return "eager" if nbytes <= self.eager_limit else "rndv"
 
+    def _stats_snapshot(self) -> dict[str, int] | None:
+        """Metrics provider hook (same schema as tdcn_stats)."""
+        return dict(self.stats) if self._running else None
+
     def _send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
         sock, lock = self._peer(address)
         arr = np.ascontiguousarray(payload)
@@ -360,6 +380,8 @@ class TcpTransport:
                 sock.sendall(head)
                 if arr.nbytes:
                     sock.sendall(raw)
+            self.stats["eager_msgs"] += 1
+            self.stats["eager_bytes"] += arr.nbytes
             return
         # rendezvous: RTS → (peer grants) CTS → stream fragments. Each
         # fragment takes the lock independently, so concurrent senders'
@@ -378,10 +400,19 @@ class TcpTransport:
                     _HDR.pack(_RTS, len(rts_env), len(meta), arr.nbytes)
                     + rts_env + meta
                 )
+            # RTS→CTS dead time — the same rendezvous-serialization
+            # stall the native plane accounts (TS_CTS_WAIT_NS)
+            t0 = time.perf_counter_ns()
             self._await_cts(ev, sock, address)
+            d = time.perf_counter_ns() - t0
+            self.stats["cts_waits"] += 1
+            self.stats["cts_wait_ns"] += d
+            self.stats["stall_ns"] += d
         finally:
             with self._cts_lock:
                 self._cts_events.pop(xid, None)
+        self.stats["rndv_msgs"] += 1
+        self.stats["rndv_bytes"] += arr.nbytes
         for off in range(0, arr.nbytes, self.frag_size):
             chunk = raw[off : off + self.frag_size]
             env_b = json.dumps(
@@ -636,6 +667,9 @@ class ShmTransport(TcpTransport):
             sock.sendall(
                 _HDR.pack(_SHMF, len(env_b), len(meta), arr.nbytes)
                 + env_b + meta)
+        # shm-ring bulk records ≈ the native plane's chunked class
+        self.stats["chunked_msgs"] += 1
+        self.stats["chunked_bytes"] += arr.nbytes
         return True
 
     def _proto_of(self, nbytes: int) -> str:

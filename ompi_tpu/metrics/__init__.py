@@ -1,0 +1,41 @@
+"""``ompi_tpu.metrics`` — transport telemetry (the quantitative leg of
+the observability stack; the PR-1 tracer is the qualitative leg).
+
+Four pieces:
+
+* :mod:`.core`   — counter/histogram aggregation over both planes
+  (native ``TdcnStats`` via ctypes + Python transport/op hooks);
+* :mod:`.export` — Prometheus text-format + JSONL snapshot writers
+  (``--mca metrics_output`` at finalize);
+* :mod:`.flight` — flight recorder: counter snapshots on
+  request-timeout/abort and stall-watermark crossings;
+* MPI_T pvars (``dcn_stall_ns``, ``dcn_doorbells``, ``dcn_ring_hwm``,
+  per-op ``metrics_size_<op>_hist``) through
+  :mod:`ompi_tpu.tool.mpit`.
+
+Enable with ``--mca metrics_enable 1``; analyze with
+``tools/metrics_report.py`` (``--correlate`` joins counter snapshots
+with PR-1 trace spans on the shared wall-clock timeline).
+"""
+
+from .core import (  # noqa: F401
+    GAUGES,
+    LAT_BUCKETS,
+    NATIVE_COUNTERS,
+    SIZE_BUCKETS,
+    enable,
+    enabled,
+    native_counters,
+    native_value,
+    observe,
+    observe_size,
+    op_stats,
+    register_provider,
+    register_vars,
+    reset,
+    size_histogram,
+    size_ops,
+    snapshot,
+    sync_from_store,
+    zero_stats,
+)

@@ -1,0 +1,331 @@
+"""Metrics core — quantitative transport telemetry spanning both planes.
+
+≈ the reference's SPC counter block (``ompi_spc.c``) plus the MPI_T
+pvar surface, extended down into the native data plane: ``libtpudcn``
+keeps a versioned, cache-line-aligned block of relaxed-atomic counters
+(doorbell rings, backpressure stall nanoseconds, ring occupancy
+high-water, eager/rendezvous/chunked traffic, rendezvous queue depth
+— ``native/src/dcn.cc`` ``TdcnStats``), and this module reads it
+through one ctypes call with zero effect on the hot path.  The Python
+transports (:mod:`ompi_tpu.dcn.tcp`) contribute the same counter
+names, so a ``--mca btl tcp`` job and a native job export one schema.
+
+Recording discipline (the trace/SPC pattern): every Python in-path
+hook is guarded by the module-level ``_enabled`` boolean — a disabled
+run pays exactly one attribute test per hook.  The native counters
+accumulate unconditionally (one relaxed atomic per event; the C plane
+cannot see the Python gate and does not need to — the cost is below
+measurement noise), but nothing reads them unless metrics are on.
+
+Aggregation model:
+
+* **native counters** — monotone totals merged from every registered
+  provider (live engines / transports), surfaced as ``dcn_*`` MPI_T
+  pvars with a reset-baseline so ``MPI_T_pvar_reset`` works without
+  touching the C plane;
+* **per-op histograms** — fixed-bucket log2 size (bytes) and latency
+  (µs) histograms per operation, grow-only key order (the pvar
+  index-stability contract :mod:`ompi_tpu.trace.core` established);
+* **snapshots** — one JSON-able dict combining both planes plus the
+  SPC counters, consumed by the Prometheus/JSONL exporter
+  (:mod:`ompi_tpu.metrics.export`), the flight recorder
+  (:mod:`ompi_tpu.metrics.flight`), and ``tools/metrics_report.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Callable
+
+#: the in-path gate — hooks read this attribute directly
+_enabled = False
+
+#: log2-bytes size buckets: bucket i holds 2**(i-1) < nbytes <= 2**i
+#: (bucket 0: zero/one byte); upper-INCLUSIVE so a power-of-two
+#: payload — the dominant case (osu sweeps, page-sized buffers) —
+#: lands AT its own edge, matching Prometheus's inclusive ``le``
+#: semantics.  The last bucket is open-ended (> 4 MiB lands in 23
+#: with 24 buckets — covers the osu sweep).
+SIZE_BUCKETS = 24
+#: log2-µs latency buckets — same bucket COUNT/scale as
+#: trace.HIST_BUCKETS, but upper-inclusive edges like the size buckets
+#: (the Prometheus ``le`` contract; the tracer's pvar histograms keep
+#: their original half-open convention)
+LAT_BUCKETS = 16
+
+#: native counter names, index order of the C block MINUS the version
+#: slot (``tdcn_stats_names``).  FIXED — these are the stable MPI_T
+#: pvar names (``dcn_<name>``); new counters append at the tail only.
+NATIVE_COUNTERS = (
+    "doorbells", "stall_ns", "ring_stall_ns", "ring_stalls", "ring_hwm",
+    "cts_wait_ns", "cts_waits", "rndv_depth", "rndv_hwm", "slot_waits",
+    "eager_msgs", "eager_bytes", "chunked_msgs", "chunked_bytes",
+    "rndv_msgs", "rndv_bytes", "delivered", "unexpected_hwm",
+)
+
+#: counters that are gauges (instantaneous), not monotone totals —
+#: excluded from monotonicity assertions and baseline subtraction
+GAUGES = frozenset({"rndv_depth"})
+
+NATIVE_STATS_VERSION = 1
+
+_lock = threading.Lock()
+#: per-op aggregates, insertion-ordered and grow-only while metrics
+#: run (reset zeroes in place — the pvar namespace must not shrink)
+_ops: dict[str, dict] = {}
+#: live native-counter providers: weakref → callable returning a
+#: dict[str, int] (or None when the provider is gone/closed)
+_providers: list = []
+#: MPI_T reset baselines for the native counters (reset = remember the
+#: current total; reads subtract — the C plane stays untouched)
+_native_base: dict[str, int] = {}
+#: wall-clock anchor captured at enable: (time_ns, perf_counter_ns) —
+#: snapshot timestamps join the trace timeline on this base
+_epoch: tuple[int, int] = (0, 0)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(flag: bool = True) -> None:
+    """Turn the Python-side hooks on/off (production jobs go through
+    ``--mca metrics_enable 1`` → :func:`sync_from_store`)."""
+    global _enabled, _epoch
+    if flag and not _enabled:
+        _epoch = (time.time_ns(), time.perf_counter_ns())
+    _enabled = flag
+
+
+def epoch() -> tuple[int, int]:
+    """(wall-clock ns, perf_counter ns) anchor captured at enable."""
+    return _epoch
+
+
+def size_bucket(nbytes: int) -> int:
+    """log2 bucket for a payload size (shared with the SPC byte-counter
+    routing — one bucket convention across the subsystem).  ``n-1``
+    before bit_length makes the bucket edge upper-inclusive: exactly
+    2**i counts under ``le="2**i"``, not in the bucket above it."""
+    return min(max(0, int(nbytes) - 1).bit_length(), SIZE_BUCKETS - 1)
+
+
+def lat_bucket(dur_ns: int) -> int:
+    return min(max(0, int(dur_ns) // 1000 - 1).bit_length(),
+               LAT_BUCKETS - 1)
+
+
+def observe(op: str, nbytes: int, dur_ns: int | None = None) -> None:
+    """Record one operation: size histogram always, latency histogram
+    when a duration is supplied.  Callers gate on ``_enabled``."""
+    if not _enabled:
+        return
+    with _lock:
+        st = _ops.get(op)
+        if st is None:
+            st = _ops[op] = {
+                "count": 0, "bytes": 0, "total_ns": 0, "max_ns": 0,
+                "size_hist": [0] * SIZE_BUCKETS,
+                "lat_hist": [0] * LAT_BUCKETS,
+            }
+        st["count"] += 1
+        st["bytes"] += int(nbytes)
+        st["size_hist"][size_bucket(nbytes)] += 1
+        if dur_ns is not None:
+            st["total_ns"] += int(dur_ns)
+            if dur_ns > st["max_ns"]:
+                st["max_ns"] = int(dur_ns)
+            st["lat_hist"][lat_bucket(dur_ns)] += 1
+
+
+def observe_size(op: str, nbytes: int) -> None:
+    """Size-only observation (the SPC payload-bytes routing)."""
+    observe(op, nbytes, None)
+
+
+# -- native counter providers ------------------------------------------
+
+
+def register_provider(obj, fn: Callable[[], dict | None]) -> None:
+    """Register a native-counter source (a live engine/transport).
+
+    ``obj`` anchors the registration lifetime: the provider drops out
+    when ``obj`` is collected, so closed engines never pin themselves
+    through the global list.  Bound methods are held weakly too — a
+    strong reference to ``obj.method`` would keep ``obj`` alive and
+    defeat the anchor."""
+    try:
+        wfn: Callable = weakref.WeakMethod(fn)  # type: ignore[assignment]
+    except TypeError:  # plain function/closure: no self to leak
+        wfn = (lambda f=fn: f)
+    with _lock:
+        _providers.append((weakref.ref(obj), wfn))
+
+
+def native_counters() -> dict[str, int]:
+    """Merged raw totals from every live provider (no baseline).
+
+    Totals sum across providers; gauges and ``*_hwm`` counters take
+    the max — summing high-waters across engines would fabricate an
+    occupancy no ring ever reached."""
+    out: dict[str, int] = {k: 0 for k in NATIVE_COUNTERS}
+    with _lock:
+        live = list(_providers)
+    dead = False
+    for ref, wfn in live:
+        fn = wfn()
+        if ref() is None or fn is None:
+            dead = True
+            continue
+        try:
+            d = fn()
+        except Exception:  # provider torn down mid-read
+            continue
+        if not d:
+            continue
+        for k, v in d.items():
+            if k not in out:
+                continue
+            if k in GAUGES or k.endswith("_hwm"):
+                out[k] = max(out[k], int(v))
+            else:
+                out[k] += int(v)
+    if dead:
+        with _lock:
+            _providers[:] = [(r, f) for r, f in _providers
+                             if r() is not None and f() is not None]
+    return out
+
+
+def native_value(name: str) -> int:
+    """One counter, baseline-adjusted — the MPI_T pvar read."""
+    raw = native_counters().get(name, 0)
+    if name in GAUGES or name.endswith("_hwm"):
+        return raw
+    return max(0, raw - _native_base.get(name, 0))
+
+
+def reset_native(name: str | None = None) -> None:
+    """MPI_T pvar_reset: remember current totals as the baseline (the
+    C block is append-only; Python owns reset semantics).  Gauges and
+    high-water marks are exempt — baselining ``ring_hwm`` would make a
+    still-pegged ring read 0 after a reset, the exact condition the
+    counter exists to expose."""
+    cur = native_counters()
+    with _lock:
+        for k in ([name] if name else NATIVE_COUNTERS):
+            if k in cur and k not in GAUGES and not k.endswith("_hwm"):
+                _native_base[k] = cur[k]
+
+
+# -- pvar namespace (grow-only, like trace.span_ops) -------------------
+
+
+def size_ops() -> list[str]:
+    """Op names with ≥1 observation, FIRST-SEEN order — the
+    ``metrics_size_<op>_hist`` pvar namespace.  Grow-only while
+    metrics run (reset zeroes in place), so cached pvar indices stay
+    valid — the same contract trace.span_ops keeps."""
+    return list(_ops)
+
+
+def size_histogram(op: str) -> list[int]:
+    st = _ops.get(op)
+    return list(st["size_hist"]) if st else [0] * SIZE_BUCKETS
+
+
+def op_stats() -> dict[str, dict]:
+    """Deep-copied per-op aggregates (report/export input)."""
+    with _lock:
+        return {
+            k: dict(v, size_hist=list(v["size_hist"]),
+                    lat_hist=list(v["lat_hist"]))
+            for k, v in _ops.items()
+        }
+
+
+def zero_stats() -> None:
+    """Zero every per-op aggregate IN PLACE (keys survive — cached
+    pvar indices keep naming the same variable) and re-baseline the
+    native counters — the session-wide MPI_T pvar_reset."""
+    with _lock:
+        for st in _ops.values():
+            st["count"] = 0
+            st["bytes"] = 0
+            st["total_ns"] = 0
+            st["max_ns"] = 0
+            st["size_hist"] = [0] * SIZE_BUCKETS
+            st["lat_hist"] = [0] * LAT_BUCKETS
+    reset_native()
+
+
+def reset_op(op: str) -> None:
+    """Zero ONE op aggregate in place (single-handle pvar_reset)."""
+    with _lock:
+        st = _ops.get(op)
+        if st is not None:
+            st["count"] = 0
+            st["bytes"] = 0
+            st["total_ns"] = 0
+            st["max_ns"] = 0
+            st["size_hist"] = [0] * SIZE_BUCKETS
+            st["lat_hist"] = [0] * LAT_BUCKETS
+
+
+def reset(full: bool = True) -> None:
+    """Test hook: drop all state (``full=False`` keeps providers)."""
+    global _enabled
+    with _lock:
+        _ops.clear()
+        _native_base.clear()
+        if full:
+            _providers.clear()
+            _enabled = False
+    from ompi_tpu.metrics import flight
+
+    flight.reset()
+
+
+# -- snapshots ---------------------------------------------------------
+
+
+def snapshot(reason: str = "periodic", proc: int | None = None) -> dict:
+    """One JSON-able view of both planes right now — the exporter,
+    flight-recorder, and report-tool input."""
+    return {
+        "ts_ns": time.time_ns(),
+        "reason": reason,
+        "proc": proc,
+        "native": native_counters(),
+        "ops": op_stats(),
+        "spc": _spc_snapshot(),
+    }
+
+
+def _spc_snapshot() -> dict[str, int]:
+    from ompi_tpu.tool import spc
+
+    return spc.snapshot()
+
+
+# -- MCA wiring --------------------------------------------------------
+
+
+def register_vars(store) -> None:
+    """Idempotent (the central registration in core.var already ran
+    for the default context; private test stores call this directly)."""
+    from ompi_tpu.core.var import register_observability_vars
+
+    register_observability_vars(store)
+
+
+def sync_from_store(store) -> None:
+    enable(bool(store.get("metrics_enable", False)))
+    from ompi_tpu.metrics import flight
+
+    flight.configure(
+        output=str(store.get("metrics_output", "") or ""),
+        max_records=int(store.get("metrics_flight_records", 64)),
+    )

@@ -1,0 +1,122 @@
+"""Snapshot exporters — Prometheus text format + JSONL.
+
+Written at finalize (``api.finalize`` → :func:`write`) when ``--mca
+metrics_enable 1`` is on and ``--mca metrics_output <path>`` names a
+base path; every process writes
+
+* ``<path>.<proc>.prom``  — Prometheus text exposition format
+  (``ompi_tpu_``-prefixed counters + cumulative ``_bucket{le=…}``
+  histograms), scrapeable by pointing a node-exporter textfile
+  collector at the directory;
+* ``<path>.<proc>.jsonl`` — one JSON object per line: every flight
+  record in order, then the final snapshot — the
+  ``tools/metrics_report.py`` input.
+
+Stdlib-only on purpose: the report tool imports this module on hosts
+with no jax.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ompi_tpu.metrics import core as _core
+from ompi_tpu.metrics import flight as _flight
+
+PREFIX = "ompi_tpu"
+
+
+def _size_bucket_edges() -> list[int]:
+    """Upper bucket edges in bytes: 1, 2, 4, … (last is +Inf)."""
+    return [1 << i for i in range(_core.SIZE_BUCKETS - 1)]
+
+
+def _lat_bucket_edges_us() -> list[int]:
+    return [1 << i for i in range(_core.LAT_BUCKETS - 1)]
+
+
+def _prom_hist(lines: list[str], name: str, labels: str, hist: list[int],
+               edges: list[int], total: int | None = None) -> None:
+    """Cumulative Prometheus _bucket series from a fixed-bucket log2
+    histogram (our buckets are disjoint; Prometheus wants cumulative)."""
+    cum = 0
+    for i, edge in enumerate(edges):
+        cum += hist[i] if i < len(hist) else 0
+        lines.append(f'{name}_bucket{{{labels}le="{edge}"}} {cum}')
+    cum += hist[len(edges)] if len(hist) > len(edges) else 0
+    lines.append(f'{name}_bucket{{{labels}le="+Inf"}} {cum}')
+    lines.append(f"{name}_count{{{labels.rstrip(',')}}} {cum}"
+                 if labels else f"{name}_count {cum}")
+    if total is not None:
+        lines.append(f"{name}_sum{{{labels.rstrip(',')}}} {total}"
+                     if labels else f"{name}_sum {total}")
+
+
+def to_prometheus(snap: dict) -> str:
+    """Render one snapshot as Prometheus text exposition format."""
+    proc = snap.get("proc")
+    plabel = f'proc="{proc}",' if proc is not None else ""
+    lines: list[str] = []
+    # native transport counters: each is its OWN metric family, so the
+    # TYPE line must name it (the exposition-format contract promtool
+    # enforces); gauges/high-waters are typed gauge — rate() over a
+    # decreasing rndv_depth would fabricate counter resets
+    for k, v in (snap.get("native") or {}).items():
+        gauge = k in _core.GAUGES or k.endswith("_hwm")
+        lines.append(f"# HELP {PREFIX}_dcn_{k} Native DCN transport "
+                     f"{'gauge' if gauge else 'counter'} {k} "
+                     "(libtpudcn TdcnStats block)")
+        lines.append(f"# TYPE {PREFIX}_dcn_{k} "
+                     f"{'gauge' if gauge else 'counter'}")
+        if plabel:
+            lines.append(f"{PREFIX}_dcn_{k}{{{plabel.rstrip(',')}}} {int(v)}")
+        else:
+            lines.append(f"{PREFIX}_dcn_{k} {int(v)}")
+    # per-op size/latency histograms
+    lines.append(f"# HELP {PREFIX}_op_size_bytes Per-op payload size "
+                 "histogram (log2 buckets)")
+    lines.append(f"# TYPE {PREFIX}_op_size_bytes histogram")
+    for op, st in (snap.get("ops") or {}).items():
+        labels = f'{plabel}op="{op}",'
+        _prom_hist(lines, f"{PREFIX}_op_size_bytes", labels,
+                   st["size_hist"], _size_bucket_edges(),
+                   total=st.get("bytes"))
+    lines.append(f"# HELP {PREFIX}_op_latency_us Per-op latency "
+                 "histogram (log2 µs buckets)")
+    lines.append(f"# TYPE {PREFIX}_op_latency_us histogram")
+    for op, st in (snap.get("ops") or {}).items():
+        if not any(st["lat_hist"]):
+            continue
+        labels = f'{plabel}op="{op}",'
+        _prom_hist(lines, f"{PREFIX}_op_latency_us", labels,
+                   st["lat_hist"], _lat_bucket_edges_us(),
+                   total=(st.get("total_ns", 0) + 999) // 1000)
+    # SPC counters ride along (one scrape = the whole tool stack)
+    spc = snap.get("spc") or {}
+    if spc:
+        lines.append(f"# HELP {PREFIX}_spc_total SPC software "
+                     "performance counters")
+        lines.append(f"# TYPE {PREFIX}_spc_total counter")
+        for k, v in sorted(spc.items()):
+            lines.append(f'{PREFIX}_spc_total{{{plabel}counter="{k}"}} '
+                         f"{int(v)}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write(path_base: str, proc: int = 0) -> list[str]:
+    """Export the final snapshot (+ accumulated flight records) for
+    one process.  Returns the paths written."""
+    snap = _core.snapshot(reason="finalize", proc=proc)
+    paths = []
+    prom_path = f"{path_base}.{proc}.prom"
+    with open(prom_path, "w") as f:
+        f.write(to_prometheus(snap))
+    paths.append(prom_path)
+    jsonl_path = f"{path_base}.{proc}.jsonl"
+    with open(jsonl_path, "w") as f:
+        for rec in _flight.records():
+            f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps(snap) + "\n")
+    paths.append(jsonl_path)
+    return paths

@@ -1,0 +1,129 @@
+"""Flight recorder — counter snapshots at the moment something wedges.
+
+The failure mode this exists for (ROADMAP open item): a windowed send
+collapses or hangs, the process is killed, and the ring/rendezvous
+state that explains it vanishes.  The recorder snapshots ALL counters
+(both planes) on the events that precede that outcome —
+
+* **request timeout / abort** — the DCN recv deadline expiring, a
+  transport-level connection failure surfacing;
+* **watermark crossings** — first time the native stall counters show
+  real backpressure (stall time, rendezvous slot exhaustion), checked
+  opportunistically from the Python hooks (cheap: every N events).
+
+Records land in a bounded in-memory ring AND — when ``--mca
+metrics_output`` is set — are appended immediately to
+``<output>.flight.<proc>.jsonl`` (one JSON object per line), so a
+process that dies mid-run still leaves its last ring state on disk.
+``tools/metrics_report.py`` folds flight records into the stall
+breakdown and the trace correlation.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+
+_lock = threading.Lock()
+_records: collections.deque = collections.deque(maxlen=64)
+_output = ""
+_proc: int | None = None
+#: watermark thresholds: (name, level) crossed-once latches
+_WATERMARKS = (
+    ("stall_ns", 1_000_000),      # ≥1 ms cumulative send-side stall
+    ("stall_ns", 1_000_000_000),  # ≥1 s — the wedge precursor
+    ("slot_waits", 1),            # rendezvous slot table saturated
+    ("ring_stalls", 1),           # first ring-backpressure block
+)
+_crossed: set = set()
+#: opportunistic check cadence (every Nth observe-side call)
+_CHECK_EVERY = 64
+_check_tick = 0
+
+
+def configure(output: str = "", max_records: int = 64,
+              proc: int | None = None) -> None:
+    global _output, _records, _proc
+    with _lock:
+        _output = output
+        if proc is not None:
+            _proc = proc
+        if max_records != _records.maxlen:
+            _records = collections.deque(_records,
+                                         maxlen=max(1, int(max_records)))
+
+
+def set_proc(proc: int) -> None:
+    global _proc
+    _proc = proc
+
+
+def reset() -> None:
+    global _check_tick
+    with _lock:
+        _records.clear()
+        _crossed.clear()
+        _check_tick = 0
+
+
+def records() -> list[dict]:
+    with _lock:
+        return list(_records)
+
+
+def record(reason: str, **extra) -> dict | None:
+    """Snapshot both planes now, tagged with why.  No-op when metrics
+    are disabled — the recorder must never add cost to an untelemetered
+    run."""
+    from ompi_tpu.metrics import core
+
+    if not core._enabled:
+        return None
+    snap = core.snapshot(reason=reason, proc=_proc)
+    if extra:
+        snap["detail"] = {k: v for k, v in extra.items()
+                         if isinstance(v, (str, int, float, bool))}
+    with _lock:
+        _records.append(snap)
+        out = _output
+    if out:
+        # append NOW (crash-robust), never raise into the caller's
+        # failure path — the recorder rides error handling
+        try:
+            path = f"{out}.flight.{_proc if _proc is not None else 0}.jsonl"
+            with open(path, "a") as f:
+                f.write(json.dumps(snap) + "\n")
+        except OSError:
+            pass
+    return snap
+
+
+def check_watermarks(force: bool = False) -> None:
+    """Opportunistic watermark check — called from in-path hooks every
+    ``_CHECK_EVERY`` events (one counter compare otherwise).  Each
+    (counter, level) threshold latches once per run: the latch set
+    mutates under the lock so two sender threads crossing a threshold
+    on the same tick cannot both record it (duplicates would evict
+    real records from the bounded ring); the snapshots themselves are
+    taken outside the lock — :func:`record` re-acquires it."""
+    global _check_tick
+    from ompi_tpu.metrics import core
+
+    if not core._enabled:
+        return
+    with _lock:
+        _check_tick += 1
+        if not force and _check_tick % _CHECK_EVERY:
+            return
+    native = core.native_counters()
+    claimed = []
+    with _lock:
+        for name, level in _WATERMARKS:
+            key = (name, level)
+            if key not in _crossed and native.get(name, 0) >= level:
+                _crossed.add(key)
+                claimed.append((name, level))
+    for name, level in claimed:
+        record("watermark", counter=name, level=level,
+               value=int(native.get(name, 0)))

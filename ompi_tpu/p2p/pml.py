@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from ompi_tpu.core.errors import MPIArgError, MPIRankError
+from ompi_tpu.metrics import core as _metrics
 from ompi_tpu.request import Request
 from ompi_tpu.tool import spc
 from ompi_tpu.trace import core as _trace
@@ -169,6 +170,8 @@ class MatchingEngine:
         if _account and spc.attached():
             spc.inc("send")
             spc.inc("send_bytes", spc.payload_nbytes(payload))
+        if _account and _metrics._enabled:
+            _metrics.observe_size("p2p_send", spc.payload_nbytes(payload))
         t0 = _trace.now() if _trace._enabled else 0
         data = _copy_payload(payload, dest_device)
         with self._lock:

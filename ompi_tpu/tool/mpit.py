@@ -136,18 +136,33 @@ class PvarInfo:
 def _pvar_names() -> list[str]:
     """spc counters first (stable indices), then the trace pvars —
     fixed tracer totals plus one count + one latency-histogram pvar
-    per (layer, op) with recorded spans.  Trace names appear in
-    first-seen span order and the namespace only ever GROWS at the
-    tail while tracing runs (trace reset zeroes values in place), so
+    per (layer, op) with recorded spans — then the metrics pvars:
+    the FIXED native transport counter set (``dcn_stall_ns``,
+    ``dcn_doorbells``, ``dcn_ring_hwm``, …) and one size-histogram
+    pvar per observed op.  Trace and metrics-op names appear in
+    first-seen order and each namespace segment only ever GROWS at
+    the tail while recording runs (resets zero values in place), so
     an index a tool cached in a pvar handle keeps naming the same
-    variable — the index-stability contract C-side handles rely on."""
+    variable — the index-stability contract C-side handles rely on.
+    Segment ORDER enforces that contract: the FIXED sets (spc, dcn)
+    come first so the growing tails can never shift them; the trace
+    segment precedes the metrics-size segment because it existed
+    first (cached trace indices predate metrics), and the size
+    segment carries the residual caveat that a trace (layer, op)
+    first seen AFTER a size op shifts the size indices — tools that
+    cache across warm-up re-resolve by name, as the reference's
+    MPI_T_pvar_get_index contract expects."""
+    from ompi_tpu import metrics
     from ompi_tpu.trace import core as trace
 
     names = ["spc_" + k for k in spc.known()]
+    names += ["dcn_" + k for k in metrics.NATIVE_COUNTERS]
     names += ["trace_events", "trace_dropped"]
     for layer, op in trace.span_ops():
         names.append(f"trace_span_{layer}_{op}_count")
         names.append(f"trace_span_{layer}_{op}_hist")
+    for op in metrics.size_ops():
+        names.append(f"metrics_size_{op}_hist")
     return names
 
 
@@ -182,6 +197,14 @@ def pvar_get_info(index: int) -> PvarInfo:
     if not 0 <= index < len(names):
         raise MPIArgError(f"pvar index {index} out of range")
     name = names[index]
+    if name.startswith("dcn_"):
+        return PvarInfo(name, PVAR_CLASS_COUNTER,
+                        f"native DCN transport counter {name[4:]} "
+                        "(libtpudcn telemetry block)")
+    if name.startswith("metrics_size_"):
+        op = name[len("metrics_size_"):-len("_hist")]
+        return PvarInfo(name, PVAR_CLASS_AGGREGATE,
+                        f"payload size histogram (log2 byte buckets) {op}")
     if name.startswith("trace_"):
         if name.endswith("_hist"):
             layer, op = _trace_key(name)
@@ -203,6 +226,15 @@ def pvar_index(name: str) -> int:
 def pvar_read(index: int):
     _check()
     name = _at(_pvar_names(), index, "pvar")
+    if name.startswith("dcn_"):
+        from ompi_tpu import metrics
+
+        return metrics.native_value(name[4:])
+    if name.startswith("metrics_size_"):
+        from ompi_tpu import metrics
+
+        return metrics.size_histogram(name[len("metrics_size_"):
+                                           -len("_hist")])
     if name.startswith("trace_"):
         return _trace_pvar_read(name)
     return spc.get(name[4:])
@@ -218,6 +250,9 @@ def pvar_reset() -> None:
     from ompi_tpu.trace import core as trace
 
     trace.zero_stats()
+    from ompi_tpu import metrics
+
+    metrics.zero_stats()
 
 
 def pvar_reset_one(index: int) -> None:
@@ -243,6 +278,16 @@ def pvar_reset_one(index: int) -> None:
     elif name.startswith("trace_span_"):
         layer, op = _trace_key(name)
         trace.reset_span_stat(layer, op.rsplit("_", 1)[0])
+    elif name.startswith("dcn_"):
+        # native counters are append-only in C; reset re-baselines the
+        # Python view (reads subtract) — the C plane stays untouched
+        from ompi_tpu.metrics import core as _metrics
+
+        _metrics.reset_native(name[4:])
+    elif name.startswith("metrics_size_"):
+        from ompi_tpu.metrics import core as _metrics
+
+        _metrics.reset_op(name[len("metrics_size_"):-len("_hist")])
     else:
         spc.reset_one(name[len("spc_"):])
 

@@ -10,11 +10,22 @@ runtime_spc_attach all`` ≈ the ``mpi_spc_attach_all`` var).
 
 Every counter surfaces as an MPI_T pvar through
 :mod:`ompi_tpu.tool.mpit`.
+
+Reset semantics follow the metrics core's grow-only pvar index rule
+(:mod:`ompi_tpu.metrics.core`): counters zero IN PLACE — a key once
+touched stays in :func:`snapshot` forever, so a tool diffing two
+snapshots across a reset never sees a name vanish, and cached pvar
+handles keep naming the same variable.  ``*_bytes`` increments also
+route their payload size through the metrics core's shared log2
+histogram buckets when metrics are enabled — one bucket convention
+across SPC, the per-op histograms, and the Prometheus export.
 """
 
 from __future__ import annotations
 
 import threading
+
+from ompi_tpu.metrics import core as _metrics
 
 _lock = threading.Lock()
 _counters: dict[str, int] = {}
@@ -75,6 +86,8 @@ def inc(name: str, n: int = 1) -> None:
         return
     with _lock:
         _counters[name] = _counters.get(name, 0) + n
+    if _metrics._enabled and name.endswith("_bytes"):
+        _metrics.observe_size("spc_" + name[:-len("_bytes")], n)
 
 
 def get(name: str) -> int:
@@ -88,11 +101,24 @@ def snapshot() -> dict[str, int]:
 
 
 def reset() -> None:
+    """Zero every counter IN PLACE — touched keys stay visible in
+    :func:`snapshot` (the grow-only index rule; dropping keys made
+    post-reset snapshot diffs silently lose names)."""
     with _lock:
-        _counters.clear()
+        for k in _counters:
+            _counters[k] = 0
 
 
 def reset_one(name: str) -> None:
-    """Zero a single counter (MPI_T pvar_reset on one handle)."""
+    """Zero a single counter (MPI_T pvar_reset on one handle); the key
+    stays registered — index/name stability across resets."""
     with _lock:
-        _counters.pop(name, None)
+        if name in _counters:
+            _counters[name] = 0
+
+
+def clear() -> None:
+    """Drop all counter STATE including keys (tests only — never a
+    pvar-reset path)."""
+    with _lock:
+        _counters.clear()

@@ -259,22 +259,12 @@ def reset_dropped() -> None:
 
 
 def register_vars(store) -> None:
-    store.register(
-        "trace", "", "enable", False,
-        help="Record cross-layer event spans into the trace ring buffer "
-        "(api/coll/p2p/dcn timelines; default off — zero-cost hooks)",
-    )
-    store.register(
-        "trace", "", "buffer_events", _DEFAULT_BUFFER, type="int",
-        help="Trace ring-buffer capacity in events; the oldest events "
-        "are dropped (and counted) once full",
-    )
-    store.register(
-        "trace", "", "output", "", type="string",
-        help="Chrome trace-event JSON path written at finalize; a "
-        "multi-process job writes <output>.<proc>.json per process "
-        "(merge with tools/trace_report.py)",
-    )
+    """Delegates to the central observability table (core.var) — one
+    source of truth for names/defaults/descriptions, and the vars show
+    in ``--mca``-var listings even before this module imports."""
+    from ompi_tpu.core.var import register_observability_vars
+
+    register_observability_vars(store)
 
 
 def sync_from_store(store) -> None:

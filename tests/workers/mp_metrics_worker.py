@@ -1,0 +1,105 @@
+"""tpurun worker: exercise transport telemetry in a multi-process job.
+
+Launched by test_metrics.py with ``--mca metrics_enable 1 --mca
+metrics_output <path> --mca trace_enable 1 --mca trace_output <path>
+--mca btl_tcp_eager_limit 32768``.  ``TDCN_HOST_ID`` is forced
+distinct per process BEFORE init so the native engine takes the
+framed-TCP leg (eager + RTS/CTS/FRAG rendezvous) between same-host
+peers — the only deterministic way to exercise the rendezvous
+serialization counters (``cts_wait_ns`` → ``stall_ns``) in CI: every
+rendezvous send pays a real RTS→CTS round trip.
+
+Rank 0 drives a windowed send burst of rendezvous-sized messages at
+rank 1 (two rounds, native counter snapshots between them — the
+monotonicity the satellite test asserts); both ranks run collectives
+so trace spans exist for the ``--correlate`` join, and flight-record a
+checkpoint so the exported JSONL carries mid-run ring state.
+"""
+
+import os
+
+proc_env = int(os.environ.get("OMPI_TPU_PROC", "0"))
+# BEFORE any engine exists: force the cross-host transport leg
+os.environ["TDCN_HOST_ID"] = f"metrics-host-{proc_env}"
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu import metrics
+from ompi_tpu.metrics import core as mcore, flight
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+n = world.size
+assert n == 2 and world.local_size == 1, (n, world.local_size)
+assert metrics.enabled(), "metrics_enable did not propagate to the worker"
+
+WINDOW = 16
+#: 64 KiB > the 32 KiB --mca eager limit → every send is rendezvous
+payload = np.ones(64 * 1024 // 8, np.float64)
+
+
+def burst(tag: int) -> None:
+    if p == 0:
+        for i in range(WINDOW):
+            world.send(payload * (i + 1), source=0, dest=1, tag=tag)
+        # window-complete ack: its matched delivery rings rank 0's
+        # completion doorbell — every counter the test asserts is then
+        # deterministically nonzero on both ranks
+        out, _st = world.recv(dest=0, source=1, tag=tag)
+        assert out.shape == (1,), out
+    else:
+        for i in range(WINDOW):
+            out, st = world.recv(dest=1, source=0, tag=tag)
+            assert st.nbytes == payload.nbytes, st
+            assert out[0] == i + 1, (out[0], i)
+        world.send(np.zeros(1), source=1, dest=0, tag=tag)
+
+
+burst(7)
+s1 = mcore.native_counters()
+burst(8)
+s2 = mcore.native_counters()
+
+if p == 0:
+    # the acceptance counters: rendezvous serialization stall + wire
+    # activity nonzero after a windowed send burst
+    assert s1["doorbells"] > 0, s1
+    assert s1["stall_ns"] > 0 and s1["cts_wait_ns"] > 0, s1
+    assert s1["cts_waits"] >= WINDOW, s1
+    assert s1["rndv_msgs"] >= WINDOW and s1["rndv_bytes"] > 0, s1
+    # monotone between snapshots (totals only; gauges/hwm exempt)
+    for k in mcore.NATIVE_COUNTERS:
+        if k in mcore.GAUGES or k.endswith("_hwm"):
+            continue
+        assert s2[k] >= s1[k], (k, s1[k], s2[k])
+    assert s2["rndv_msgs"] >= s1["rndv_msgs"] + WINDOW, (s1, s2)
+else:
+    # receiver side: deliveries + inbound rendezvous accounting
+    assert s2["delivered"] > 0, s2
+    assert s2["rndv_hwm"] >= 1, s2
+print(f"OK metrics_counters proc={p}")
+
+# collectives so the trace timeline has spans to correlate against
+x = np.ones((world.local_size, 8), np.float64)
+for i in range(3):
+    out = world.allreduce(x * (i + 1), SUM)
+    assert np.array_equal(out, np.full((world.local_size, 8),
+                                       n * (i + 1.0))), out
+world.barrier()
+print(f"OK metrics_coll proc={p}")
+
+# a mid-run flight snapshot: the exported JSONL must carry ring state
+# from DURING the run, not only the finalize total
+rec = flight.record("burst_complete", window=WINDOW,
+                    nbytes=int(payload.nbytes))
+assert rec is not None and rec["native"]["doorbells"] > 0, rec
+print(f"OK metrics_flight proc={p}")
+
+api.finalize()
+print(f"OK finalize proc={p}")

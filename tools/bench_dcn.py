@@ -91,7 +91,14 @@ for nb in COLL_SIZES:
 if p == 0:
     import json
 
+    # native transport counter snapshot rides along (stall-cause
+    # context for the BENCH_r*.json rounds: was this row's bandwidth
+    # limited by ring backpressure or rendezvous serialization?)
+    from ompi_tpu.metrics import core as _mcore
+
+    counters = _mcore.native_counters()
     print("DCNBENCH " + json.dumps(
-        {"p2p": rows, "han": crows, "estimator": "median-of-iterations"}),
+        {"p2p": rows, "han": crows, "estimator": "median-of-iterations",
+         "native_counters": {k: v for k, v in counters.items() if v}}),
         flush=True)
 api.finalize()
