@@ -187,6 +187,12 @@ enum TdcnStatIdx {
   TS_RNDV_BYTES,
   TS_DELIVERED,          // complete inbound messages handed to matching
   TS_UNEXPECTED_HWM,     // unexpected-queue depth high-water (one cid+dst)
+  // -- robustness tail (appended; version stays 1 — append-only) ------
+  TS_RECONNECTS,         // peer connections re-established after death
+  TS_RETRY_DIALS,        // backoff dial attempts beyond the first
+  TS_RETRY_SENDS,        // sends retried after invalidating a dead peer
+  TS_DEADLINE_EXPIRED,   // blocking waits that ran out their dcn_*_timeout
+  TS_INJECTED_FAULTS,    // faults the faultsim plane injected (this plane)
   TS_COUNT
 };
 
@@ -196,7 +202,8 @@ static const char *TDCN_STAT_NAMES =
     "version,doorbells,stall_ns,ring_stall_ns,ring_stalls,ring_hwm,"
     "cts_wait_ns,cts_waits,rndv_depth,rndv_hwm,slot_waits,"
     "eager_msgs,eager_bytes,chunked_msgs,chunked_bytes,"
-    "rndv_msgs,rndv_bytes,delivered,unexpected_hwm";
+    "rndv_msgs,rndv_bytes,delivered,unexpected_hwm,"
+    "reconnects,retry_dials,retry_sends,deadline_expired,injected_faults";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -218,6 +225,22 @@ struct alignas(64) TdcnStats {
     }
   }
 };
+
+// ---------------------------------------------------------------------
+// fault injection (the native leg of ompi_tpu/faultsim)
+// ---------------------------------------------------------------------
+//
+// Armed per process via tdcn_fault_set (the Python fault plane maps
+// its seeded plan's ring rules onto these knobs at engine creation).
+// Disabled cost is one relaxed load + branch per ring record — the
+// zero-hot-path-cost contract the faultsim subsystem documents.  The
+// event counter lives HERE (ring writes never reach Python), so ring
+// rules are scheduled by count (every/at), not by hashed probability.
+static std::atomic<uint32_t> g_fault_armed{0};
+static std::atomic<uint64_t> g_fault_stall_ns{0};
+static std::atomic<uint64_t> g_fault_stall_every{1};
+static std::atomic<int64_t> g_fault_fail_at{-1};
+static std::atomic<uint64_t> g_fault_events{0};
 
 static bool recv_exact(int fd, void *buf, size_t n) {
   char *p = (char *)buf;
@@ -333,18 +356,24 @@ struct ShmRing {
 
   // Reserve space for one contiguous record of `need` bytes (8-aligned,
   // including the u64 length prefix).  Returns the write pointer or
-  // nullptr on timeout (receiver stalled).  Single producer: only the
-  // sender's per-peer lock holder calls this.  `stats` (optional)
-  // accounts backpressure: a reserve that cannot be satisfied on its
-  // first pass counts one ring stall and the full blocked duration —
-  // the "per-chunk doorbell round-trips under backpressure" signal the
+  // nullptr on close or deadline expiry (receiver stalled/dead — a
+  // dead consumer freezes `tail`, and a rebase PAD can leave head a
+  // full lap above it, so an unbounded wait here wedges the sender
+  // forever; `timeout_ns` = 0 waits indefinitely, callers pass the
+  // dcn_ring_timeout policy).  Single producer: only the sender's
+  // per-peer lock holder calls this.  `stats` (optional) accounts
+  // backpressure: a reserve that cannot be satisfied on its first
+  // pass counts one ring stall and the full blocked duration — the
+  // "per-chunk doorbell round-trips under backpressure" signal the
   // osu_bw collapse investigation needs.  The happy path touches no
   // clock and no stat.
   uint8_t *reserve(uint64_t need, uint64_t *rec_start,
-                   std::atomic<bool> *closing, TdcnStats *stats = nullptr) {
+                   std::atomic<bool> *closing, TdcnStats *stats = nullptr,
+                   uint64_t timeout_ns = 0) {
     need = (need + 7) & ~7ull;
     uint64_t spin = 0;
     uint64_t stall_t0 = 0;
+    uint64_t give_up = 0;
     for (;;) {
       if (closing->load(std::memory_order_relaxed)) return nullptr;
       uint64_t head = ctrl->head.load(std::memory_order_relaxed);
@@ -379,9 +408,18 @@ struct ShmRing {
         *rec_start = head;
         return data + pos;
       }
-      if (!stall_t0 && stats) {
+      if (!stall_t0) {
         stall_t0 = now_ns();
-        stats->add(TS_RING_STALLS, 1);
+        if (stats) stats->add(TS_RING_STALLS, 1);
+        if (timeout_ns) give_up = stall_t0 + timeout_ns;
+      } else if (give_up && now_ns() > give_up) {
+        if (stats) {
+          uint64_t d = now_ns() - stall_t0;
+          stats->add(TS_RING_STALL_NS, d);
+          stats->add(TS_STALL_NS, d);
+          stats->add(TS_DEADLINE_EXPIRED, 1);
+        }
+        return nullptr;  // receiver wedged/dead: surface a send error
       }
       if (++spin < 2048) {
         sched_yield();
@@ -549,6 +587,10 @@ struct Engine {
   int64_t eager_limit = 4 << 20;
   int64_t frag_size = 8 << 20;
   uint64_t ring_bytes = 64ull << 20;
+  // ring-write deadline (dcn_ring_timeout; tdcn_set_ring_timeout):
+  // bounds reserve() so a dead/wedged consumer surfaces as a send
+  // error instead of an unbounded producer spin
+  std::atomic<uint64_t> ring_timeout_ns{600ull * 1000000000ull};
   int max_rndv = 4;
 
   int tcp_listen_fd = -1, uds_listen_fd = -1;
@@ -1179,12 +1221,44 @@ static Peer *get_peer(Engine *eng, const std::string &address) {
 // send paths
 // ---------------------------------------------------------------------
 
+// consult the armed fault plan before a ring write; returns false
+// when this write must FAIL (injected wedge — callers surface it as
+// the usual send error, which Python escalates ULFM-style)
+static bool fault_ring_ok(Engine *eng) {
+  if (!g_fault_armed.load(std::memory_order_relaxed)) return true;
+  uint64_t k = g_fault_events.fetch_add(1, std::memory_order_relaxed) + 1;
+  uint64_t stall = g_fault_stall_ns.load(std::memory_order_relaxed);
+  uint64_t every = g_fault_stall_every.load(std::memory_order_relaxed);
+  if (stall && every && k % every == 0) {
+    // injected backpressure: sleep AND account it as ring stall so the
+    // metrics stall breakdown shows the simulated wedge
+    eng->stats.add(TS_INJECTED_FAULTS, 1);
+    eng->stats.add(TS_RING_STALLS, 1);
+    eng->stats.add(TS_RING_STALL_NS, stall);
+    eng->stats.add(TS_STALL_NS, stall);
+    struct timespec ts = {(time_t)(stall / 1000000000ull),
+                          (long)(stall % 1000000000ull)};
+    nanosleep(&ts, nullptr);
+  }
+  int64_t fail_at = g_fault_fail_at.load(std::memory_order_relaxed);
+  if (fail_at >= 0 && (int64_t)k == fail_at) {
+    eng->stats.add(TS_INJECTED_FAULTS, 1);
+    return false;
+  }
+  return true;
+}
+
 static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
-                             const Env &e, const void *payload) {
+                             const Env &e, const void *payload,
+                             uint64_t timeout_ns, bool faultable) {
+  // control frames are exempt from injection (the faultsim contract:
+  // heartbeat/gossip traffic must not consume schedule events or be
+  // failed by the plan — detection must stay deterministic)
+  if (faultable && !fault_ring_ok(eng)) return false;
   uint64_t need = 8 + sizeof(WireHdr) + env_extra(h) + h.nbytes;
   uint64_t rec_start;
   uint8_t *w = p->tx_ring.reserve(need, &rec_start, &eng->closing,
-                                  &eng->stats);
+                                  &eng->stats, timeout_ns);
   if (!w) return false;
   *(uint64_t *)w = need;  // full record length (u64 prefix included)
   uint8_t *q = w + 8;
@@ -1248,13 +1322,24 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
 
   std::lock_guard<std::mutex> g(p->send_mu);
   if (p->same_host && ensure_ring(eng, p)) {
+    // ring writes are deadline-bounded (a frozen tail must surface as
+    // an error, not an infinite producer spin).  Control frames
+    // (FK_PY, no cid, no payload: heartbeats/gossip/revoke) get a
+    // tiny bound instead — the failure detector's own traffic must
+    // fail FAST into the in-band strike path when a peer's ring is
+    // wedged, not block out the full data deadline; losing one is
+    // harmless (heartbeats repeat, gossip is redundant)
+    bool ctrl = e.kind == FK_PY && e.cid.empty() && nbytes == 0;
+    uint64_t ring_tmo =
+        ctrl ? 2000000ull
+             : eng->ring_timeout_ns.load(std::memory_order_relaxed);
     // ring path: frames up to half the ring go as one record; larger
     // payloads stream as FRAG records (ring backpressure = flow ctl)
     uint64_t limit = eng->ring_bytes / 2;
     if (nbytes + sizeof(WireHdr) + 256 <= limit) {
       WireHdr h;
       fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
-      if (send_record_ring(eng, p, h, e, data)) {
+      if (send_record_ring(eng, p, h, e, data, ring_tmo, !ctrl)) {
         eng->stats.add(TS_EAGER_MSGS, 1);
         eng->stats.add(TS_EAGER_BYTES, nbytes);
         return 0;
@@ -1277,7 +1362,8 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
     rts_env.seq = xid;
     WireHdr h2;
     fill_hdr(&h2, FT_RTS, rts_env, eng->proc, (uint64_t)e.seq, nbytes, 0);
-    if (!send_record_ring(eng, p, h2, rts_env, nullptr)) return -1;
+    if (!send_record_ring(eng, p, h2, rts_env, nullptr, ring_tmo, true))
+      return -1;
     for (uint64_t off = 0; off < nbytes; off += chunk) {
       uint64_t n = nbytes - off < chunk ? nbytes - off : chunk;
       Env fe;
@@ -1285,7 +1371,8 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
       fe.seq = xid;
       WireHdr fh;
       fill_hdr(&fh, FT_FRAG, fe, eng->proc, off, nbytes, n);
-      if (!send_record_ring(eng, p, fh, fe, (const uint8_t *)data + off))
+      if (!send_record_ring(eng, p, fh, fe, (const uint8_t *)data + off,
+                            ring_tmo, true))
         return -1;
     }
     eng->stats.add(TS_CHUNKED_MSGS, 1);
@@ -1949,6 +2036,37 @@ int tdcn_stats(void *h, uint64_t *out, int max_n) {
 // lets the Python reader and C tools agree on layout without
 // hardcoding, validated against out[0]'s version stamp.
 const char *tdcn_stats_names(void) { return TDCN_STAT_NAMES; }
+
+// Arm/disarm the native fault-injection knobs (process-wide; see
+// fault_ring_ok).  stall_ns = injected backpressure per matching ring
+// write, stall_every = apply to every Nth write, fail_at = fail the
+// Nth write outright (-1 = never).  (0, anything, -1) disarms.  The
+// event counter restarts on every call so schedules are reproducible.
+void tdcn_fault_set(uint64_t stall_ns, uint64_t stall_every,
+                    int64_t fail_at) {
+  g_fault_stall_ns.store(stall_ns, std::memory_order_relaxed);
+  g_fault_stall_every.store(stall_every ? stall_every : 1,
+                            std::memory_order_relaxed);
+  g_fault_fail_at.store(fail_at, std::memory_order_relaxed);
+  g_fault_events.store(0, std::memory_order_relaxed);
+  g_fault_armed.store(stall_ns || fail_at >= 0 ? 1 : 0,
+                      std::memory_order_relaxed);
+}
+
+uint64_t tdcn_fault_events(void) {
+  return g_fault_events.load(std::memory_order_relaxed);
+}
+
+// Bound every ring write by `seconds` (the dcn_ring_timeout MCA var —
+// the Python control plane forwards it after engine creation); expiry
+// surfaces as a send error + TS_DEADLINE_EXPIRED.  <= 0 restores the
+// unbounded pre-deadline behavior.
+void tdcn_set_ring_timeout(void *h, double seconds) {
+  Engine *eng = (Engine *)h;
+  eng->ring_timeout_ns.store(
+      seconds > 0 ? (uint64_t)(seconds * 1e9) : 0,
+      std::memory_order_relaxed);
+}
 
 void tdcn_free(void *p) { free(p); }
 

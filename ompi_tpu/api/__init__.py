@@ -60,6 +60,12 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     from ompi_tpu import metrics as _metrics
 
     _metrics.sync_from_store(ctx.store)
+    # fault injection (--mca faultsim_enable 1): armed before
+    # ProcContext so engine bring-up (dials included) is already under
+    # the plan; vars are centrally registered (core.var)
+    from ompi_tpu import faultsim as _faultsim
+
+    _faultsim.sync_from_store(ctx.store)
     from ompi_tpu.mesh.mesh import world_mesh
 
     wm = world_mesh()

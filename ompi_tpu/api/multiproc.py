@@ -416,11 +416,49 @@ class MultiProcComm(PersistentP2PMixin):
         dproc, _ = self.locate(dest)
         if dproc != self.proc:
             raise MPIRankError(f"rank {dest} not owned by process {self.proc}")
-        return self.pml.irecv(
+        req = self.pml.irecv(
             dest,
             ANY_SOURCE if source is None else source,
             ANY_TAG if tag is None else tag,
         )
+        if source is not None and self.locate(source)[0] != self.proc:
+            # cross-process receive: converge on the shared deadline
+            # policy + in-band failure sensitivity (a remote receive
+            # must never hang; ANY_SOURCE and local receives keep
+            # plain MPI blocking semantics)
+            arm = getattr(req, "arm_remote_guard", None)
+            if arm is not None:
+                arm(*self._remote_recv_guard(source, tag))
+        return req
+
+    def _remote_recv_guard(self, source: int, tag):
+        """(timeout, check, escalate) for a blocked cross-process
+        receive — the same unified deadline + ULFM escalation the
+        coll/rendezvous waits use (core.var.Deadline policy)."""
+        from ompi_tpu.core.errors import MPIProcFailedError
+        from ompi_tpu.core.var import dcn_timeout
+
+        sproc = self.locate(source)[0]
+
+        def check() -> None:
+            from ompi_tpu.ft import ulfm
+
+            ulfm.check(self, peer=source)
+            if self.dcn.proc_failed(sproc):
+                raise MPIProcFailedError(
+                    f"recv: peer rank {source} failed", failed=(source,))
+
+        def escalate(timeout: float):
+            self.dcn._escalate_deadline(
+                "p2p_recv", timeout,
+                f"recv deadline (dcn_recv_timeout={timeout}s) expired "
+                f"on {self.name}: waiting for rank {source} (tag={tag})"
+                f" — peer dead, wedged, or send never issued",
+                failed_rank=source,
+                root_proc=self.dcn.root_proc_of(sproc),
+                comm=self.name, src=int(source))
+
+        return dcn_timeout("recv"), check, escalate
 
     def recv(self, dest: int, source: int | None = None, tag: int | None = None):
         if self._pml_native:
@@ -435,13 +473,23 @@ class MultiProcComm(PersistentP2PMixin):
                 raise MPIRankError(
                     f"rank {dest} not owned by process {self.proc}")
             fail_proc = -1
-            if source is not None and self._ft is not None:
-                fail_proc = self.dcn.root_proc_of(self.locate(source)[0])
+            remote = False
+            if source is not None:
+                sproc = self.locate(source)[0]
+                remote = sproc != self.proc
+                if remote:
+                    # watched regardless of FT: the C wait then wakes
+                    # on a marked failure AND the recv deadline can
+                    # name the proc it escalates.  Local sources are
+                    # never watched or deadlined — blocking on a
+                    # not-yet-posted local send is plain MPI semantics
+                    fail_proc = self.dcn.root_proc_of(sproc)
             payload, st = self.pml.recv_blocking(
                 dest,
                 ANY_SOURCE if source is None else source,
                 ANY_TAG if tag is None else tag,
                 fail_proc,
+                remote=remote,
             )
             return payload, st
         req = self.irecv(dest, source, tag)

@@ -162,6 +162,16 @@ class MPIRMAAttachError(MPIError):
     error_class = MPI_ERR_RMA_ATTACH
 
 
+class DeadlineExpiredError(MPIError):
+    """A blocking DCN wait ran out its registered ``dcn_*_timeout``
+    (the unified deadline policy in :mod:`ompi_tpu.core.var`).  An
+    internal signal: transport/engine layers catch it and escalate to
+    :class:`MPIProcFailedError` + detector notification — it should
+    never surface to MPI callers."""
+
+    error_class = MPI_ERR_INTERN
+
+
 class MPIProcFailedError(MPIError):
     """MPIX_ERR_PROC_FAILED: operation touched a failed process."""
 

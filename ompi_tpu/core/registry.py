@@ -30,7 +30,12 @@ import importlib
 import os
 from typing import Any, Callable, Iterable, Type
 
-from .var import VarStore, full_var_name, register_observability_vars
+from .var import (
+    VarStore,
+    full_var_name,
+    register_observability_vars,
+    register_robustness_vars,
+)
 
 
 class ComponentError(Exception):
@@ -234,8 +239,10 @@ class MCAContext:
         self.store = VarStore(cmdline=cmdline, env=env, param_files=param_files)
         # trace/metrics knobs register on EVERY store at construction so
         # --mca-var listings (ompi_tpu.info, MPI_T cvars) show them even
-        # when the lazy trace/metrics subsystems were never imported
+        # when the lazy trace/metrics subsystems were never imported;
+        # the dcn deadline + faultsim knobs follow the same rule
         register_observability_vars(self.store)
+        register_robustness_vars(self.store)
         self.frameworks: dict[str, Framework] = {}
         self._register_builtin_components()
 

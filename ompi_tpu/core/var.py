@@ -386,3 +386,114 @@ def register_observability_vars(store: "VarStore") -> None:
     """Register the trace/metrics knobs on a store (idempotent)."""
     for fw, comp, name, default, typ, help_ in OBSERVABILITY_VARS:
         store.register(fw, comp, name, default, type=typ, help=help_)
+
+
+# -- robustness variables (central registration, same pattern) -----------
+#
+# The DCN deadline family and the fault-injection knobs.  Like the
+# observability vars, these are consumed by lazily-imported subsystems
+# (the transports read timeouts per blocking wait; ompi_tpu.faultsim
+# syncs at MPI_Init) but must be introspectable on every store.
+
+#: (framework, component, name, default, type, help)
+ROBUSTNESS_VARS = (
+    ("dcn", "", "cts_timeout", 600.0, "float",
+     "Seconds a rendezvous sender waits for the peer's CTS grant "
+     "before escalating the peer as failed (MPIProcFailedError + "
+     "detector notification) — was a hard-coded 600 s RuntimeError"),
+    ("dcn", "", "ring_timeout", 600.0, "float",
+     "Seconds a shared-memory ring write blocks on backpressure "
+     "(receiver stalled) before escalating the peer as failed"),
+    ("dcn", "", "recv_timeout", 120.0, "float",
+     "Seconds a blocking DCN receive waits for the peer's frame "
+     "before escalating (peer dead or collective order mismatch); "
+     "expiry flight-records the transport counters first"),
+    ("dcn", "", "connect_timeout", 30.0, "float",
+     "Deadline for (re)dialing a peer, spanning every exponential-"
+     "backoff attempt; control frames (heartbeats) always fail fast "
+     "so in-band detection stays prompt"),
+    ("faultsim", "", "enable", False, "bool",
+     "Arm the deterministic fault-injection plane (default off — "
+     "every transport hook is one boolean test when disabled)"),
+    ("faultsim", "", "seed", 0, "int",
+     "Fault-plan seed: decisions are a pure function of (seed, proc, "
+     "site, event index), so one seed replays one fault schedule"),
+    ("faultsim", "", "plan", "", "string",
+     "Fault plan, e.g. 'drop:p=0.01,delay:ms=50,connkill:at=100,"
+     "stall:ms=200' — comma-separated <kind>[:k=v[;k=v]] rules "
+     "(kinds: drop delay dup trunc connkill stall ringfail dialfail)"),
+)
+
+
+def register_robustness_vars(store: "VarStore") -> None:
+    """Register the deadline/faultsim knobs on a store (idempotent)."""
+    for fw, comp, name, default, typ, help_ in ROBUSTNESS_VARS:
+        store.register(fw, comp, name, default, type=typ, help=help_)
+
+
+def dcn_timeout(name: str) -> float:
+    """Resolve one ``dcn_<name>_timeout`` against the default MCA
+    context — the single lookup every blocking DCN wait shares.  Falls
+    back to the table default when no context exists (bare transports
+    in unit tests)."""
+    full = f"dcn_{name}_timeout"
+    try:
+        from ompi_tpu.core import mca
+
+        v = mca.default_context().store.get(full)
+        if v is not None:
+            return float(v)
+    except Exception:  # noqa: BLE001 — pre-init / teardown: use default
+        pass
+    for fw, comp, vname, default, _typ, _h in ROBUSTNESS_VARS:
+        if full_var_name(fw, comp, vname) == full:
+            return float(default)
+    raise KeyError(f"unknown dcn timeout {name!r}")
+
+
+class Deadline:
+    """The one deadline policy every blocking DCN wait converges on
+    (CTS waits, ring writes, blocking receives, dial backoff).
+
+    Monotonic-clock based; ``slice()`` yields the poll quantum for
+    loops that must stay sensitive to failure detection between
+    checks; ``check()`` raises :class:`ompi_tpu.core.errors.
+    DeadlineExpiredError` — callers translate expiry into the ULFM
+    escalation (``MPIProcFailedError`` + detector notification)
+    appropriate to their layer."""
+
+    __slots__ = ("seconds", "_t0")
+
+    def __init__(self, seconds: float):
+        import time
+
+        self.seconds = float(seconds)
+        self._t0 = time.monotonic()
+
+    @classmethod
+    def for_timeout(cls, name: str) -> "Deadline":
+        return cls(dcn_timeout(name))
+
+    def elapsed(self) -> float:
+        import time
+
+        return time.monotonic() - self._t0
+
+    def remaining(self) -> float:
+        return max(0.0, self.seconds - self.elapsed())
+
+    def expired(self) -> bool:
+        return self.elapsed() > self.seconds
+
+    def slice(self, quantum: float = 0.25) -> float:
+        """Bounded wait quantum: never overshoots the deadline, never
+        returns a non-positive wait."""
+        return max(0.001, min(quantum, self.remaining()))
+
+    def check(self, what: str = "") -> None:
+        if self.expired():
+            from ompi_tpu.core.errors import DeadlineExpiredError
+
+            raise DeadlineExpiredError(
+                f"deadline expired after {self.seconds}s"
+                + (f": {what}" if what else ""))

@@ -98,6 +98,11 @@ class DcnCollEngine:
                 frag_size=frag_size,
                 max_rndv=max_rndv,
             )
+        # transport-level escalation (deadline expiry, send failure
+        # after the reconnect retry round) maps the peer address back
+        # to its proc and marks it failed before the transport raises
+        # MPIProcFailedError
+        self.transport.on_peer_failed = self._transport_peer_failed
 
     def set_addresses(self, addresses: Sequence[str]) -> None:
         if len(addresses) != self.nprocs:
@@ -152,6 +157,93 @@ class DcnCollEngine:
     def proc_failed(self, local_proc: int) -> bool:
         return local_proc in self._failed_procs
 
+    def _root_engine(self) -> "DcnCollEngine":
+        """The engine owning the transport/detector (sub/join views
+        chain to their parent)."""
+        return self
+
+    def root_proc_of(self, local: int) -> int:
+        """Map a LOCAL engine index to the root engine's proc index
+        (-1 = unmapped, e.g. across spawn worlds) — same surface the
+        native engines expose."""
+        return local if 0 <= local < self.nprocs else -1
+
+    def _transport_peer_failed(self, address: str) -> int | None:
+        """Transport escalation callback: peer address → ROOT proc,
+        marking it failed on the detector (gossiped, like an in-band
+        BTL error under ULFM) or the engine's failure set."""
+        root = self._root_engine()
+        proc = None
+        for p, a in enumerate(root.addresses):
+            if a == address or (a.startswith("bml:")
+                                and address in a.split("|")):
+                proc = p
+                break
+        if proc is not None:
+            det = root._detector
+            if det is not None:
+                det.mark_failed(proc)
+            else:
+                root.note_proc_failed(proc)
+        return proc
+
+    def _escalate_deadline(self, site: str, timeout: float, msg: str,
+                           failed_rank: int | None = None,
+                           root_proc: int | None = None,
+                           **detail) -> None:
+        """THE deadline-expiry escalation — every blocking wait that
+        runs out its ``dcn_*_timeout`` converges here: flight-record
+        the transport state, count ``dcn_deadline_expired``, mark the
+        peer failed (gossiping detector when attached, engine failure
+        set otherwise), and raise MPIProcFailedError — never a bare
+        RuntimeError.  ``failed_rank`` is the caller-space index named
+        in the error; ``root_proc`` the detector-space index to mark
+        (resolved via root_proc_of(failed_rank) when omitted)."""
+        from ompi_tpu.core.errors import MPIProcFailedError
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("deadline_expired", site=site,
+                       timeout_s=float(timeout), **detail)
+        root = self._root_engine()
+        tr = root.transport
+        st = getattr(tr, "stats", None)
+        if st is None:  # bml multiplexer: account on the tcp leg
+            st = getattr(getattr(tr, "tcp", None), "stats", None)
+        if st is not None:
+            st["deadline_expired"] += 1
+        else:
+            py = getattr(root, "_py_stats", None)
+            if py is not None:
+                py["deadline_expired"] += 1
+        rp = root_proc
+        if rp is None and failed_rank is not None:
+            rp = self.root_proc_of(failed_rank)
+        if rp is not None and rp >= 0 and rp != root.proc:
+            det = root._detector
+            if det is not None:
+                det.mark_failed(rp)
+            else:
+                root.note_proc_failed(rp)
+        raise MPIProcFailedError(
+            msg,
+            failed=((failed_rank,)
+                    if failed_rank is not None and failed_rank >= 0
+                    else ()))
+
+    def _note_peer_activity(self, src: int) -> None:
+        """Refresh the failure detector's liveness clock for a peer we
+        just received from: ANY inbound frame proves the process alive,
+        so a rank pinned in a long collective that cannot pump
+        heartbeats is not falsely declared dead."""
+        root = self._root_engine()
+        det = root._detector
+        if det is None:
+            return
+        rp = self.root_proc_of(src)
+        note = getattr(det, "note_activity", None)
+        if note is not None and rp is not None and rp >= 0:
+            note(rp)
+
     def send_ctrl(self, dst: int, envelope: dict) -> None:
         """Small control frame (heartbeat / failure gossip / revoke)."""
         self.transport.send(self.addresses[dst], dict(envelope),
@@ -171,6 +263,25 @@ class DcnCollEngine:
             if self._detector is not None:
                 self._detector.on_heartbeat(env["src"])
             return
+        if self._detector is not None and kind != "flr":
+            # any inbound frame refreshes the sender's liveness clock —
+            # not just heartbeats.  The frame's src is local to the
+            # engine its comm rides (sub-comm frames carry sub-local
+            # indices); map through the registered comm's engine.
+            src = env.get("src")
+            if isinstance(src, int):
+                ref = self._comms.get(env.get("cid"))
+                comm = ref() if ref is not None else None
+                eng = getattr(comm, "dcn", None) if comm is not None else None
+                try:
+                    rp = (eng.root_proc_of(src) if eng is not None
+                          else self.root_proc_of(src))
+                except Exception:  # noqa: BLE001 — stale comm mid-free
+                    rp = -1
+                if rp is not None and 0 <= rp < self.nprocs:
+                    note = getattr(self._detector, "note_activity", None)
+                    if note is not None:
+                        note(rp)
         if kind == "flr":
             if self._detector is not None:
                 self._detector.mark_failed(env["proc"], gossip=False)
@@ -206,21 +317,25 @@ class DcnCollEngine:
             env["meta"] = meta
         self.transport.send(self.addresses[dst], env, payload)
 
-    def _recv(self, src: int, cid: int, seq: int, timeout: float = 120.0) -> np.ndarray:
+    def _recv(self, src: int, cid: int, seq: int,
+              timeout: float | None = None) -> np.ndarray:
         return self._recv_full(src, cid, seq, timeout)[1]
 
-    def _recv_full(self, src: int, cid: int, seq: int, timeout: float = 120.0):
-        import time as _time
+    def _recv_full(self, src: int, cid: int, seq: int,
+                   timeout: float | None = None):
+        from ompi_tpu.core.var import Deadline, dcn_timeout
 
+        if timeout is None:
+            timeout = dcn_timeout("recv")
         key = (cid, seq, src)
         q = self._queue(key)
-        deadline = _time.monotonic() + timeout
+        dl = Deadline(timeout)
         while True:
             # short slices keep the wait sensitive to failure detection:
             # a peer declared dead mid-collective raises promptly (ULFM
-            # in-band error) instead of waiting out the full timeout
+            # in-band error) instead of waiting out the full deadline
             try:
-                got = q.get(timeout=0.25)
+                got = q.get(timeout=dl.slice(0.25))
                 break
             except queue.Empty:
                 if self.proc_failed(src):
@@ -230,15 +345,16 @@ class DcnCollEngine:
                         f"DCN recv: peer proc {src} failed "
                         f"(cid={cid}, seq={seq})", failed=(src,)
                     ) from None
-                if _time.monotonic() > deadline:
-                    from ompi_tpu.core.errors import MPIInternalError
-
-                    raise MPIInternalError(
-                        f"DCN recv timeout after {timeout}s: proc "
-                        f"{self.proc} waiting for proc {src} (cid={cid}, "
-                        f"seq={seq}) — peer dead or collective order "
-                        f"mismatch"
-                    ) from None
+                if dl.expired():
+                    self._escalate_deadline(
+                        "coll_recv", timeout,
+                        f"DCN recv deadline (dcn_recv_timeout={timeout}s)"
+                        f" expired: proc {self.proc} waiting for proc "
+                        f"{src} (cid={cid}, seq={seq}) — peer dead, "
+                        f"wedged, or collective order mismatch",
+                        failed_rank=src, cid=str(cid), seq=int(seq),
+                        src=int(src))
+        self._note_peer_activity(src)
         # (cid, seq, src) keys are single-use (seqs are monotonic per
         # stream), and the producer's put necessarily preceded this get
         # — drop the queue so long-running jobs (and the per-instance
@@ -480,6 +596,12 @@ class DcnSubEngine(DcnCollEngine):
     def proc_failed(self, local_proc: int) -> bool:
         return self.parent.proc_failed(self.procs[local_proc])
 
+    def _root_engine(self) -> DcnCollEngine:
+        return self.parent._root_engine()
+
+    def root_proc_of(self, local: int) -> int:
+        return self.parent.root_proc_of(self.procs[local])
+
     def send_ctrl(self, dst: int, envelope: dict) -> None:
         self.parent.send_ctrl(self.procs[dst], envelope)
 
@@ -553,6 +675,12 @@ class DcnJoinEngine(DcnCollEngine):
         # FT does not span spawn worlds (each world runs its own
         # detector over its own index space)
         return False
+
+    def _root_engine(self) -> DcnCollEngine:
+        return self.parent._root_engine()
+
+    def root_proc_of(self, local: int) -> int:
+        return -1  # FT does not span spawn worlds
 
     def local_proc_of(self, root_proc: int):
         return None  # detector fan-out stays within each world

@@ -40,6 +40,7 @@ from ompi_tpu.core.errors import (
     MPIInternalError,
     MPIProcFailedError,
 )
+from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.metrics import core as _metrics
 from .collops import DcnCollEngine, DcnJoinEngine, DcnSubEngine
 
@@ -134,6 +135,10 @@ def load_library():
         lib.tdcn_stats.argtypes = [P, ctypes.POINTER(ctypes.c_uint64), I]
         lib.tdcn_stats_names.restype = ctypes.c_char_p
         lib.tdcn_stats_names.argtypes = []
+        lib.tdcn_fault_set.argtypes = [U64, U64, I64]
+        lib.tdcn_fault_events.restype = U64
+        lib.tdcn_fault_events.argtypes = []
+        lib.tdcn_set_ring_timeout.argtypes = [P, D]
         lib.tdcn_free.argtypes = [ctypes.c_void_p]
         lib.tdcn_close.argtypes = [P]
         lib.tdcn_chan_open.restype = U64
@@ -270,10 +275,53 @@ class _NativeOpsMixin:
 
     # -- coll streams ---------------------------------------------------
 
+    def _fsim_drop(self) -> bool:
+        """Consult the fault plane for one native record-path send
+        (site ``send`` — the same schedule the Python transports use).
+        The native plane performs drop/delay only (connection faults
+        belong to the C layer's ring hook and the Python transports);
+        the kinds filter keeps unsupported rules out of the injected
+        counts.  True → the record is 'lost on the wire'."""
+        for act in _fsim.actions("send", kinds={"drop", "delay"}):
+            if act.kind == "delay":
+                _fsim.apply_delay(act)
+            elif act.kind == "drop":
+                return True
+        return False
+
+    def _raise_send_failed(self, dst: int, rc: int, what: str):
+        """Map a C-plane send failure onto ULFM escalation: mark the
+        peer failed (detector when attached) and raise
+        MPIProcFailedError — a dead native peer must surface exactly
+        like a dead Python-plane peer."""
+        root = self._native_root()
+        if rc == _RC_CLOSED or not root._running:
+            raise MPIInternalError(f"native dcn {what}: engine closed")
+        if rc != -1:  # addressing/shape misuse, not a transport fault
+            raise MPIInternalError(
+                f"native dcn {what} to proc {dst} failed (rc={rc})")
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("peer_escalation", proc=int(dst), what=what)
+        rp = self.root_proc_of(dst)
+        if rp is not None and rp >= 0:
+            det = root._detector
+            if det is not None:
+                det.mark_failed(rp)
+            else:
+                root.note_proc_failed(rp)
+            raise MPIProcFailedError(
+                f"native dcn {what}: peer proc {dst} failed (rc={rc})",
+                failed=(dst,))
+        raise ConnectionError(
+            f"native dcn {what} to proc {dst} failed (rc={rc})")
+
     def _send(self, dst: int, cid, seq: int, payload: np.ndarray,
               meta=None) -> None:
         root = self._native_root()
         arr = np.ascontiguousarray(payload)
+        if _fsim._enabled and self._fsim_drop():
+            return  # lost record: the receiver's deadline escalates
         if _metrics._enabled:
             _metrics.observe_size("dcn_coll_send", arr.nbytes)
             from ompi_tpu.metrics import flight as _flight
@@ -284,20 +332,23 @@ class _NativeOpsMixin:
             self.addresses[dst], FK_COLL, str(cid), seq, self.proc, 0, 0,
             arr, meta_b)
         if rc != 0:
-            raise ConnectionError(
-                f"native dcn send to proc {dst} failed (rc={rc})")
+            self._raise_send_failed(dst, rc, f"send (cid={cid}, seq={seq})")
 
-    def _recv_full(self, src: int, cid, seq: int, timeout: float = 120.0):
+    def _recv_full(self, src: int, cid, seq: int,
+                   timeout: float | None = None):
+        from ompi_tpu.core.var import Deadline, dcn_timeout
+
+        if timeout is None:
+            timeout = dcn_timeout("recv")
         root = self._native_root()
         lib, h = root._lib, root._h
         fail_idx = self.root_proc_of(src)
         msg = TdcnMsg()
-        import time as _time
-
-        deadline = _time.monotonic() + timeout
+        dl = Deadline(timeout)
         while True:
             rc = lib.tdcn_recv_coll(h, str(cid).encode(), seq, src,
-                                    fail_idx, 0.25, ctypes.byref(msg))
+                                    fail_idx, dl.slice(0.25),
+                                    ctypes.byref(msg))
             if rc == 0:
                 break
             if rc == _RC_CLOSED:
@@ -307,18 +358,29 @@ class _NativeOpsMixin:
                 raise MPIProcFailedError(
                     f"DCN recv: peer proc {src} failed (cid={cid}, "
                     f"seq={seq})", failed=(src,))
-            if _time.monotonic() > deadline:
+            if dl.expired():
                 # flight-record the ring/rendezvous state BEFORE the
-                # raise: a wedged windowed send dumps its counters
-                # instead of vanishing with the process
+                # raise (a wedged windowed send dumps its counters
+                # instead of vanishing with the process), then the one
+                # shared escalation: mark failed + MPIProcFailedError,
+                # never a bare internal error the job cannot survive
                 from ompi_tpu.metrics import flight as _flight
 
                 _flight.record("recv_timeout", cid=str(cid), seq=seq,
                                src=src, timeout_s=timeout)
-                raise MPIInternalError(
-                    f"DCN recv timeout after {timeout}s: proc {self.proc} "
-                    f"waiting for proc {src} (cid={cid}, seq={seq}) — "
-                    f"peer dead or collective order mismatch")
+                self._escalate_deadline(
+                    "coll_recv", timeout,
+                    f"DCN recv deadline (dcn_recv_timeout={timeout}s) "
+                    f"expired: proc {self.proc} waiting for proc {src} "
+                    f"(cid={cid}, seq={seq}) — peer dead, wedged, or "
+                    f"collective order mismatch",
+                    failed_rank=src, root_proc=fail_idx,
+                    cid=str(cid), seq=int(seq))
+        if fail_idx >= 0:
+            det = root._detector
+            note = getattr(det, "note_activity", None)
+            if note is not None:
+                note(fail_idx)  # a delivered frame proves the peer alive
         env = {"cid": cid, "seq": seq, "src": src}
         meta = _meta_of(lib, msg)
         if meta is not None:
@@ -330,6 +392,8 @@ class _NativeOpsMixin:
     def send_p2p(self, dst_proc: int, envelope: dict, payload) -> None:
         root = self._native_root()
         arr = np.ascontiguousarray(np.asarray(payload))
+        if _fsim._enabled and self._fsim_drop():
+            return
         if _metrics._enabled:
             _metrics.observe_size("dcn_p2p_send", arr.nbytes)
         keys = set(envelope)
@@ -346,10 +410,12 @@ class _NativeOpsMixin:
                 self.addresses[dst_proc], FK_PY, str(cid), 0, 0, 0, 0,
                 arr, json.dumps(env).encode())
         if rc != 0:
-            raise ConnectionError(
-                f"native dcn p2p send to proc {dst_proc} failed (rc={rc})")
+            self._raise_send_failed(dst_proc, rc, "p2p send")
 
     def send_ctrl(self, dst: int, envelope: dict) -> None:
+        # control traffic (heartbeats, gossip, revoke) is exempt from
+        # fault injection and escalates nowhere here: the detector owns
+        # interpreting its failures (in-band detection)
         root = self._native_root()
         rc = root._csend(
             self.addresses[dst], FK_PY, "", 0, 0, 0, 0,
@@ -420,9 +486,24 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._stat_names = (
             self._lib.tdcn_stats_names().decode().split(","))
         self._stat_buf = (ctypes.c_uint64 * len(self._stat_names))()
+        #: Python-plane robustness counters the C block cannot see
+        #: (deadline escalations happen above the C boundary); merged
+        #: over the C totals in stats_snapshot
+        self._py_stats: dict[str, int] = {"deadline_expired": 0}
+        # forward the unified ring deadline (dcn_ring_timeout) to the
+        # C writer: a dead consumer's frozen tail must surface as a
+        # send error, never an unbounded reserve() spin
+        from ompi_tpu.core.var import dcn_timeout
+
+        self._lib.tdcn_set_ring_timeout(self._h, float(dcn_timeout("ring")))
         from ompi_tpu import metrics as _metrics
 
         _metrics.register_provider(self, self.stats_snapshot)
+        if _fsim._enabled:
+            # arm the C ring-write fault hook from the seeded plan
+            stall_ns, every, fail_at = _fsim.native_ring_args()
+            if stall_ns or fail_at >= 0:
+                self._lib.tdcn_fault_set(stall_ns, every, fail_at)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="tdcn-dispatch")
         self._dispatcher.start()
@@ -614,6 +695,8 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         d = dict(zip(self._stat_names, vals))
         if d.pop("version", 0) != 1:
             return None  # layout drift: refuse to misattribute counters
+        for k, v in self._py_stats.items():
+            d[k] = d.get(k, 0) + v
         return d
 
     # -- failure integration --------------------------------------------

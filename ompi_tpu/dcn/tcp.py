@@ -24,6 +24,21 @@ segments between worker processes.  Faithful behaviors:
 Payloads are numpy-native (dtype/shape header + raw bytes): no pickle
 on the wire, and raw bytes move memoryview→socket / socket→buffer with
 no intermediate join copies.
+
+**Self-healing** (≈ the reference's btl error callbacks + PRRTE errmgr
+turning transport errors into survivable events): cached peer sockets
+are epoch-tagged; a send that fails invalidates its epoch's socket,
+redials with exponential backoff + jitter under ``dcn_connect_timeout``
+and retries ONCE (rendezvous restarts from a fresh RTS — the receiver
+abandoned the dead connection's half-transfer via ``_abandon``).  All
+blocking waits (CTS grants, shm ring writes, dial loops) share the
+:class:`ompi_tpu.core.var.Deadline` policy and their registered
+``dcn_*_timeout`` vars; expiry and unhealable failures escalate
+through ``on_peer_failed`` to ``MPIProcFailedError`` + the failure
+detector — never a bare RuntimeError, never a hang.  Heartbeat/gossip
+control frames bypass retry and backoff so in-band failure detection
+stays prompt.  The :mod:`ompi_tpu.faultsim` plane hooks the frame
+send/recv, dial, and ring choke points (one boolean test when off).
 """
 
 from __future__ import annotations
@@ -38,6 +53,7 @@ from typing import Callable
 
 import numpy as np
 
+from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.trace import core as _trace
 
 #: frame header: type byte, envelope len, meta len, raw (payload) len.
@@ -45,6 +61,11 @@ from ompi_tpu.trace import core as _trace
 _HDR = struct.Struct("!BIIQ")
 
 _EAGER, _RTS, _CTS, _FRAG, _SHMF = 0, 1, 2, 3, 4
+
+#: failure-detector control traffic: exempt from send retry/backoff
+#: (in-band detection must fail fast) and from fault injection (the
+#: chaos schedule must not depend on heartbeat timing)
+_CTRL_KINDS = frozenset({"hb", "flr"})
 
 #: defaults; overridable per-transport (MCA vars btl_tcp_*)
 EAGER_LIMIT = 4 << 20
@@ -110,6 +131,24 @@ class _Rndv:
         )
 
 
+class _Peer:
+    """One cached outbound connection.  ``epoch`` tags the socket
+    generation: a sender that saw epoch E fail invalidates only while
+    the entry still IS epoch E, so concurrent failures cannot tear
+    down a freshly redialed socket — and rendezvous state from a dead
+    epoch is never resumed (the retry restarts from RTS; the receiver
+    discarded the orphaned half-transfer via ``_abandon`` when the old
+    inbound connection died)."""
+
+    __slots__ = ("address", "sock", "lock", "epoch")
+
+    def __init__(self, address: str):
+        self.address = address
+        self.sock: socket.socket | None = None
+        self.lock = threading.Lock()
+        self.epoch = 0
+
+
 class TcpTransport:
     """One per process: listen socket + lazy peer connections +
     receiver threads delivering to a handler."""
@@ -138,12 +177,19 @@ class TcpTransport:
             "chunked_msgs": 0, "chunked_bytes": 0,
             "cts_waits": 0, "cts_wait_ns": 0, "stall_ns": 0,
             "delivered": 0,
+            "reconnects": 0, "retry_dials": 0, "retry_sends": 0,
+            "deadline_expired": 0,
         }
         from ompi_tpu.metrics import core as _mcore
 
         _mcore.register_provider(self, self._stats_snapshot)
+        #: escalation callback set by the owning engine: maps a peer
+        #: address to its root proc index, marking it failed on the
+        #: detector/engine on the way; None result → unmapped, the
+        #: escalation stays a ConnectionError
+        self.on_peer_failed: Callable[[str], int | None] | None = None
         self._listen, self.address = self._make_listen(host)
-        self._peers: dict[str, tuple[socket.socket, threading.Lock]] = {}
+        self._peers: dict[str, _Peer] = {}
         self._lock = threading.Lock()
         self._running = True
         # sender side: xid → Event set when the CTS lands
@@ -166,6 +212,8 @@ class TcpTransport:
         return lst, "%s:%d" % lst.getsockname()
 
     def _connect(self, address: str) -> socket.socket:
+        if _fsim._enabled:
+            _fsim.check_dial(address)
         if address.startswith("unix:@"):
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             sock.connect("\0" + address[len("unix:@"):])
@@ -215,12 +263,27 @@ class TcpTransport:
                 ftype, elen, mlen, rlen = _HDR.unpack(_recv_exact(conn, _HDR.size))
                 env = json.loads(_recv_exact(conn, elen).decode()) if elen else {}
                 meta = _recv_exact(conn, mlen) if mlen else b""
+                drop_in = False
+                if _fsim._enabled and env.get("kind") not in _CTRL_KINDS:
+                    # only eager frames are droppable here (other frame
+                    # types carry protocol state); the kinds filter
+                    # keeps undroppable hits out of the injected counts
+                    kinds = ({"delay", "drop"} if ftype == _EAGER
+                             else {"delay"})
+                    for act in _fsim.actions("recv", kinds=kinds):
+                        if act.kind == "delay":
+                            _fsim.apply_delay(act)
+                        elif act.kind == "drop":
+                            # inbound loss: the frame must still be
+                            # drained off the stream to keep framing
+                            drop_in = True
                 try:
                     if ftype == _EAGER:
                         arr = _alloc_from_meta(meta)
                         if rlen:
                             _recv_into(conn, memoryview(arr).cast("B"))
-                        self._deliver(env, arr)
+                        if not drop_in:
+                            self._deliver(env, arr)
                     elif ftype == _SHMF:
                         self._deliver(env, self._recv_shm(env, meta, rlen))
                     elif ftype == _RTS:
@@ -324,21 +387,130 @@ class TcpTransport:
         rts_pool.submit(grant)  # warm-worker reuse (VERDICT r2 weak #6)
         return key
 
-    # -- send side (lazy connect ≈ add_procs) ---------------------------
+    # -- send side (lazy connect ≈ add_procs, now with reconnect) -------
 
-    def _peer(self, address: str) -> tuple[socket.socket, threading.Lock]:
+    #: reconnect backoff: first retry after BACKOFF_BASE s, doubling
+    #: (with jitter) up to BACKOFF_CAP, under dcn_connect_timeout
+    BACKOFF_BASE = 0.05
+    BACKOFF_CAP = 1.0
+
+    def _peer(self, address: str, retry: bool = True) -> _Peer:
         with self._lock:
-            entry = self._peers.get(address)
-            if entry is None:
-                entry = (self._connect(address), threading.Lock())
-                self._peers[address] = entry
-            return entry
+            pr = self._peers.get(address)
+            if pr is None:
+                pr = _Peer(address)
+                self._peers[address] = pr
+        with pr.lock:
+            if pr.sock is None:
+                reconnect = pr.epoch > 0
+                t0 = _trace.now() if _trace._enabled else 0
+                pr.sock = self._dial_backoff(address, retry=retry)
+                pr.epoch += 1
+                if reconnect:
+                    self.stats["reconnects"] += 1
+                    if _trace._enabled:
+                        _trace.complete("dcn", "reconnect", t0,
+                                        peer=address, epoch=pr.epoch)
+        return pr
+
+    def _dial_backoff(self, address: str, retry: bool = True) -> socket.socket:
+        """Dial under the shared connect deadline: exponential backoff
+        with jitter between attempts (``retry=False`` — heartbeat/
+        gossip traffic — fails on the first refusal so in-band
+        detection stays prompt)."""
+        import random
+
+        from ompi_tpu.core.var import Deadline
+
+        dl = Deadline.for_timeout("connect")
+        delay = self.BACKOFF_BASE
+        attempts = 0
+        while True:
+            try:
+                return self._connect(address)
+            except OSError as e:
+                attempts += 1
+                if not retry or not self._running:
+                    raise
+                if dl.expired():
+                    self.stats["deadline_expired"] += 1
+                    self._peer_dead(
+                        address,
+                        f"connect deadline (dcn_connect_timeout="
+                        f"{dl.seconds}s) expired after {attempts} "
+                        f"dials: {e}")
+                self.stats["retry_dials"] += 1
+                time.sleep(min(delay * (0.5 + random.random()),
+                               max(dl.remaining(), 0.01)))
+                delay = min(delay * 2, self.BACKOFF_CAP)
+
+    def _invalidate_peer(self, pr: _Peer, epoch: int) -> None:
+        """Drop a dead cached socket — but only the generation the
+        caller actually saw fail (see :class:`_Peer`)."""
+        with pr.lock:
+            if pr.epoch != epoch or pr.sock is None:
+                return
+            try:
+                pr.sock.close()
+            except OSError:
+                pass
+            pr.sock = None
+
+    def _kill_peer(self, address: str) -> None:
+        """faultsim connkill: sever the cached connection in place (the
+        in-flight send then fails and exercises reconnect/backoff)."""
+        with self._lock:
+            pr = self._peers.get(address)
+        if pr is None:
+            return
+        with pr.lock:
+            if pr.sock is not None:
+                try:
+                    pr.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def _peer_dead(self, address: str, reason: str):
+        """ULFM-grade escalation: flight-record the transport state,
+        notify the owning engine (which marks the peer failed on the
+        detector / engine failure set), and raise MPIProcFailedError —
+        never a bare RuntimeError, never a silent hang."""
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("peer_escalation", peer=address, cause=reason)
+        proc = None
+        cb = self.on_peer_failed
+        if cb is not None:
+            try:
+                proc = cb(address)
+            except Exception:  # noqa: BLE001 — escalation must not mask
+                proc = None
+        from ompi_tpu.core.errors import MPIProcFailedError
+
+        if proc is not None:
+            raise MPIProcFailedError(
+                f"dcn peer proc {proc} ({address}) failed: {reason}",
+                failed=(proc,))
+        raise ConnectionError(f"dcn peer {address} failed: {reason}")
 
     def send_control(self, address: str, envelope: dict, ftype: int = _CTS) -> None:
-        sock, lock = self._peer(address)
         env = json.dumps(envelope).encode()
-        with lock:
-            sock.sendall(_HDR.pack(ftype, len(env), 0, 0) + env)
+        frame = _HDR.pack(ftype, len(env), 0, 0) + env
+        for attempt in (0, 1):
+            pr = self._peer(address)
+            epoch = pr.epoch  # refined under the lock below
+            try:
+                with pr.lock:
+                    epoch = pr.epoch  # the generation we actually use
+                    if pr.sock is None:
+                        raise ConnectionError("dcn peer socket invalidated")
+                    pr.sock.sendall(frame)
+                return
+            except (ConnectionError, OSError):
+                self._invalidate_peer(pr, epoch)
+                if attempt or not self._running:
+                    raise
+                self.stats["retry_sends"] += 1
 
     def send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
         if _trace._enabled:
@@ -364,29 +536,106 @@ class TcpTransport:
         return dict(self.stats) if self._running else None
 
     def _send(self, address: str, envelope: dict, payload: np.ndarray) -> None:
-        sock, lock = self._peer(address)
         arr = np.ascontiguousarray(payload)
         self.bytes_sent += arr.nbytes  # benign race: diagnostic counter
-        if self._send_shm(sock, lock, address, envelope, arr):
-            return
-        meta = _meta_bytes(arr)
-        raw = memoryview(arr).cast("B") if arr.nbytes else memoryview(b"")
-        if arr.nbytes <= self.eager_limit:
-            env = json.dumps(envelope).encode()
-            # one syscall for the small parts (TCP_NODELAY: each write
-            # pushes a segment), payload as its own write (zero-copy)
-            head = _HDR.pack(_EAGER, len(env), len(meta), arr.nbytes) + env + meta
-            with lock:  # frames from concurrent senders must not interleave
-                sock.sendall(head)
-                if arr.nbytes:
-                    sock.sendall(raw)
-            self.stats["eager_msgs"] += 1
-            self.stats["eager_bytes"] += arr.nbytes
-            return
+        ctrl = envelope.get("kind") in _CTRL_KINDS
+        dup = trunc = False
+        if _fsim._enabled and not ctrl:
+            for act in _fsim.actions("send"):
+                if act.kind == "delay":
+                    _fsim.apply_delay(act)
+                elif act.kind == "drop":
+                    return  # lost on the wire; the receiver's deadline
+                    # escalation is the recovery path, as for real loss
+                elif act.kind == "dup":
+                    dup = True
+                elif act.kind == "trunc":
+                    if arr.nbytes <= self.eager_limit:
+                        trunc = True
+                    else:  # rndv/shm records: degrade to link death
+                        self._kill_peer(address)
+                elif act.kind == "connkill":
+                    self._kill_peer(address)
+        last: Exception | None = None
+        for attempt in (0, 1):
+            try:
+                self._send_once(address, envelope, arr,
+                                trunc=trunc and attempt == 0,
+                                retry_dial=not ctrl)
+                if dup:
+                    dup = False
+                    self._send_once(address, envelope, arr,
+                                    retry_dial=not ctrl)
+                return
+            except (ConnectionError, OSError) as e:
+                last = e
+                if ctrl or not self._running:
+                    raise  # control traffic: in-band detection owns it
+                if attempt == 0:
+                    self.stats["retry_sends"] += 1
+        # one reconnect round exhausted → the ULFM escalation path
+        self._peer_dead(address,
+                        f"send failed after reconnect retry: {last}")
+
+    def _send_once(self, address: str, envelope: dict, arr: np.ndarray,
+                   trunc: bool = False, retry_dial: bool = True) -> None:
+        """One attempt at moving a message; connection-level failures
+        invalidate this attempt's socket epoch and propagate for the
+        caller's retry/escalation policy.  ``seen`` tracks the epoch
+        read TOGETHER with each socket use (under pr.lock), so the
+        invalidation always names the generation that actually failed
+        — a concurrent redial between our peer lookup and our send
+        cannot make us tear down (or spare) the wrong socket."""
+        pr = self._peer(address, retry=retry_dial)
+        seen = [pr.epoch]
+        try:
+            if self._send_shm(pr, address, envelope, arr, seen):
+                return
+            meta = _meta_bytes(arr)
+            raw = (memoryview(arr).cast("B") if arr.nbytes
+                   else memoryview(b""))
+            if arr.nbytes <= self.eager_limit:
+                env = json.dumps(envelope).encode()
+                # one syscall for the small parts (TCP_NODELAY: each
+                # write pushes a segment), payload as its own write
+                head = (_HDR.pack(_EAGER, len(env), len(meta), arr.nbytes)
+                        + env + meta)
+                with pr.lock:  # concurrent senders must not interleave
+                    sock = pr.sock
+                    seen[0] = pr.epoch
+                    if sock is None:
+                        raise ConnectionError("dcn peer socket invalidated")
+                    if trunc:
+                        # faultsim: partial frame, then sever — the peer
+                        # sees EOF mid-payload (a crash mid-frame)
+                        sock.sendall(head)
+                        if arr.nbytes:
+                            sock.sendall(raw[: max(1, arr.nbytes // 2)])
+                        try:
+                            sock.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        raise ConnectionError("faultsim: truncated frame")
+                    sock.sendall(head)
+                    if arr.nbytes:
+                        sock.sendall(raw)
+                self.stats["eager_msgs"] += 1
+                self.stats["eager_bytes"] += arr.nbytes
+                return
+            self._send_rndv(pr, address, envelope, arr, meta, raw, seen)
+        except (ConnectionError, OSError):
+            self._invalidate_peer(pr, seen[0])
+            raise
+
+    def _send_rndv(self, pr: _Peer, address: str, envelope: dict,
+                   arr: np.ndarray, meta: bytes, raw: memoryview,
+                   seen: list) -> None:
         # rendezvous: RTS → (peer grants) CTS → stream fragments. Each
         # fragment takes the lock independently, so concurrent senders'
         # frames interleave between frags instead of waiting out the
-        # whole transfer.
+        # whole transfer.  A retry after connection death restarts here
+        # with a FRESH xid: the receiver abandoned the old xid's state
+        # with the dead inbound connection (_abandon).
         xid = next(self._xids)
         ev = threading.Event()
         with self._cts_lock:
@@ -395,7 +644,11 @@ class TcpTransport:
             rts_env = json.dumps(
                 {"xid": xid, "ra": self.address, "env": envelope}
             ).encode()
-            with lock:
+            with pr.lock:
+                sock = pr.sock
+                seen[0] = pr.epoch
+                if sock is None:
+                    raise ConnectionError("dcn peer socket invalidated")
                 sock.sendall(
                     _HDR.pack(_RTS, len(rts_env), len(meta), arr.nbytes)
                     + rts_env + meta
@@ -418,37 +671,54 @@ class TcpTransport:
             env_b = json.dumps(
                 {"xid": xid, "ra": self.address, "off": off}
             ).encode()
-            with lock:
-                sock.sendall(_HDR.pack(_FRAG, len(env_b), 0, len(chunk)) + env_b)
+            with pr.lock:
+                sock = pr.sock
+                seen[0] = pr.epoch
+                if sock is None:
+                    raise ConnectionError("dcn peer socket invalidated")
+                sock.sendall(_HDR.pack(_FRAG, len(env_b), 0, len(chunk))
+                             + env_b)
                 sock.sendall(chunk)
 
-    def _send_shm(self, sock, lock, address: str, envelope: dict,
-                  arr: np.ndarray) -> bool:
+    def _send_shm(self, pr: _Peer, address: str, envelope: dict,
+                  arr: np.ndarray, seen: list) -> bool:
         """Shared-memory bulk path hook; the TCP transport has none."""
         return False
 
     def _await_cts(self, ev: threading.Event, sock: socket.socket,
-                   address: str, timeout: float = 600.0) -> None:
+                   address: str, timeout: float | None = None) -> None:
         """Block until the peer's CTS lands, but stay sensitive to the
         two conditions that mean it never will: transport close (close()
         wakes every waiter) and peer death (the never-read outbound
         socket turning readable means EOF/reset — this surfaces a dead
-        peer in ~1s instead of the full grant timeout, keeping failure
-        detection latency comparable to the eager/recv paths)."""
+        peer in ~1s instead of the full grant deadline, keeping failure
+        detection latency comparable to the eager/recv paths).  The
+        grant deadline is the registered ``dcn_cts_timeout`` (was a
+        hard-coded 600 s); expiry escalates via :meth:`_peer_dead`."""
         import selectors
-        import time
 
-        deadline = time.monotonic() + timeout
-        while not ev.wait(timeout=1.0):
+        from ompi_tpu.core.var import Deadline, dcn_timeout
+
+        if timeout is None:
+            timeout = dcn_timeout("cts")
+        dl = Deadline(timeout)
+        while not ev.wait(timeout=dl.slice(1.0)):
             if not self._running:
                 raise ConnectionError(
                     "dcn rendezvous: transport closed while awaiting CTS"
                 )
             # selectors (epoll/poll), not select(): fds >= FD_SETSIZE
-            # would make select() raise in fd-heavy processes
-            with selectors.DefaultSelector() as sel:
-                sel.register(sock, selectors.EVENT_READ)
-                readable = sel.select(timeout=0)
+            # would make select() raise in fd-heavy processes.
+            # ValueError = the socket was closed under us (a concurrent
+            # sender's _invalidate_peer) — same meaning as peer death
+            try:
+                with selectors.DefaultSelector() as sel:
+                    sel.register(sock, selectors.EVENT_READ)
+                    readable = sel.select(timeout=0)
+            except (ValueError, OSError):
+                raise ConnectionError(
+                    f"dcn rendezvous: connection to {address} "
+                    "invalidated while awaiting CTS") from None
             if readable:
                 try:
                     dead = sock.recv(1, socket.MSG_PEEK) == b""
@@ -458,10 +728,12 @@ class TcpTransport:
                     raise ConnectionError(
                         f"dcn rendezvous: peer {address} died before CTS"
                     )
-            if time.monotonic() > deadline:
-                raise ConnectionError(
-                    f"dcn rendezvous: no CTS from {address} within {timeout}s"
-                )
+            if dl.expired():
+                self.stats["deadline_expired"] += 1
+                self._peer_dead(
+                    address,
+                    f"no CTS within dcn_cts_timeout={timeout}s "
+                    "(rendezvous peer wedged or dead)")
         if not self._running:
             raise ConnectionError(
                 "dcn rendezvous: transport closed while awaiting CTS"
@@ -477,11 +749,13 @@ class TcpTransport:
         except OSError:
             pass
         with self._lock:
-            for s, _ in self._peers.values():
-                try:
-                    s.close()
-                except OSError:
-                    pass
+            for pr in self._peers.values():
+                if pr.sock is not None:
+                    try:
+                        pr.sock.close()
+                    except OSError:
+                        pass
+                    pr.sock = None
             self._peers.clear()
 
 
@@ -536,18 +810,24 @@ class _ShmRing:
 
     # -- sender side ----------------------------------------------------
 
-    def write(self, raw: memoryview, timeout: float = 600.0) -> int:
+    def write(self, raw: memoryview, deadline=None) -> int:
         """Copy ``raw`` in at the current head; returns the start
         offset (absolute byte count, receiver takes it modulo size).
-        Blocks while the ring lacks space (receiver lagging)."""
+        Blocks while the ring lacks space (receiver lagging) — up to
+        the shared ``dcn_ring_timeout`` deadline policy (was a
+        hard-coded 600 s ConnectionError); expiry raises
+        DeadlineExpiredError for the owning transport to escalate."""
         import time as _time
 
+        from ompi_tpu.core.var import Deadline
+
         n = len(raw)
-        deadline = _time.monotonic() + timeout
+        if deadline is None:
+            deadline = Deadline.for_timeout("ring")
         sleep = 0.0
         while self.size - (self.head - int(self._ctr[0])) < n:
-            if _time.monotonic() > deadline:
-                raise ConnectionError("shm ring full: receiver stalled")
+            deadline.check(
+                f"shm ring full for {n}-byte record: receiver stalled")
             _time.sleep(sleep)
             sleep = min(0.001, sleep + 0.00005)
         start = self.head
@@ -651,22 +931,38 @@ class ShmTransport(TcpTransport):
                 self._tx_rings[address] = ring
             return ring
 
-    def _send_shm(self, sock, lock, address: str, envelope: dict,
-                  arr: np.ndarray) -> bool:
+    def _send_shm(self, pr: _Peer, address: str, envelope: dict,
+                  arr: np.ndarray, seen: list) -> bool:
         if arr.nbytes < self.shm_threshold or arr.nbytes > self.RING_SIZE:
             return False  # tiny: socket inline; giant: rendezvous path
+        if _fsim._enabled:
+            for act in _fsim.actions("ring", kinds={"stall"}):
+                if act.kind == "stall":
+                    _fsim.apply_delay(act)  # injected ring backpressure
         ring = self._tx_ring(address)
         raw = memoryview(np.ascontiguousarray(arr)).cast("B")
         env = dict(envelope)
         env["shm_ring"] = ring.name
-        with lock:  # ring order must match frame order on the socket
-            start = ring.write(raw)
-            env["shm_off"] = start
-            env_b = json.dumps(env).encode()
-            meta = _meta_bytes(arr)
-            sock.sendall(
-                _HDR.pack(_SHMF, len(env_b), len(meta), arr.nbytes)
-                + env_b + meta)
+        from ompi_tpu.core.errors import DeadlineExpiredError
+
+        try:
+            with pr.lock:  # ring order must match frame order on socket
+                sock = pr.sock
+                seen[0] = pr.epoch
+                if sock is None:
+                    raise ConnectionError("dcn peer socket invalidated")
+                start = ring.write(raw)
+                env["shm_off"] = start
+                env_b = json.dumps(env).encode()
+                meta = _meta_bytes(arr)
+                sock.sendall(
+                    _HDR.pack(_SHMF, len(env_b), len(meta), arr.nbytes)
+                    + env_b + meta)
+        except DeadlineExpiredError as e:
+            # a wedged ring is a wedged RECEIVER — ULFM escalation, not
+            # a reconnect (redialing cannot unwedge the consumer)
+            self.stats["deadline_expired"] += 1
+            self._peer_dead(address, str(e))
         # shm-ring bulk records ≈ the native plane's chunked class
         self.stats["chunked_msgs"] += 1
         self.stats["chunked_bytes"] += arr.nbytes
@@ -756,6 +1052,16 @@ class BmlTransport:
     @property
     def bytes_sent(self) -> int:
         return self.tcp.bytes_sent + self.sm.bytes_sent
+
+    @property
+    def on_peer_failed(self):
+        return self.tcp.on_peer_failed
+
+    @on_peer_failed.setter
+    def on_peer_failed(self, cb) -> None:
+        # both legs escalate through the same engine callback
+        self.tcp.on_peer_failed = cb
+        self.sm.on_peer_failed = cb
 
     def _route(self, address: str):
         """(leg, leg-address) for a peer's composite address."""

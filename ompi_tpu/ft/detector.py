@@ -6,10 +6,14 @@ BTL errors"): each worker process runs a :class:`HeartbeatDetector`
 that
 
 * sends a small ``hb`` frame to every peer each ``period`` seconds
-  (in-band: a send to a dead peer raises immediately — detection
-  faster than the timeout);
+  (in-band: a send to a dead peer raises — and marks the peer after
+  one more failed period, i.e. only after the transport's reconnect/
+  backoff retry round had its chance, so a transient link drop the
+  self-healing layer can fix is never promoted to a process death);
 * declares a peer failed when its heartbeats stop for ``timeout``
-  seconds;
+  seconds — where "heartbeat" means ANY inbound frame from the peer
+  (:meth:`note_activity`): a rank pinned in a long native collective
+  that cannot pump ``hb`` frames but is still moving data is alive;
 * **gossips** detections (``flr`` frames) so survivor knowledge
   converges within one period instead of each waiting out its own
   timeout — the errmgr propagation role;
@@ -42,6 +46,10 @@ class HeartbeatDetector:
         self._peers = [p for p in range(engine.nprocs) if p != engine.proc]
         now = time.monotonic()
         self._last = {p: now for p in self._peers}
+        #: consecutive in-band send failures per peer; the second
+        #: strike marks (the first may be a transient the transport's
+        #: reconnect retry heals before the next period)
+        self._strikes = {p: 0 for p in self._peers}
         self._failed: set[int] = set()
         self._cbs: list[Callable[[int], None]] = []
         self._lock = threading.Lock()
@@ -55,8 +63,16 @@ class HeartbeatDetector:
     # -- inbound events (engine receiver thread) ------------------------
 
     def on_heartbeat(self, src: int) -> None:
+        self.note_activity(src)
+
+    def note_activity(self, src: int) -> None:
+        """Refresh a peer's liveness clock.  Called for ``hb`` frames
+        AND for every other inbound frame the engine routes (coll,
+        p2p, control) plus completed native-plane receives — proof of
+        life is proof of life, whatever frame carried it."""
         with self._lock:
-            self._last[src] = time.monotonic()
+            if src in self._last:
+                self._last[src] = time.monotonic()
 
     def on_failure(self, cb: Callable[[int], None]) -> None:
         """Register a callback(proc) fired once per detected failure;
@@ -104,8 +120,28 @@ class HeartbeatDetector:
                 try:
                     self.engine.send_ctrl(p, {"kind": "hb",
                                               "src": self.engine.proc})
+                    self._strikes[p] = 0
                 except Exception:  # noqa: BLE001 — in-band detection
-                    self.mark_failed(p)
+                    # two strikes: the first failure tolerates a link
+                    # blip the transport's reconnect/backoff round can
+                    # heal before the next heartbeat; the second (one
+                    # full period later, retry round exhausted) marks —
+                    # UNLESS the peer's inbound frames prove it alive
+                    # (a full ring backpressures our sends while the
+                    # busy peer keeps talking; proof of life outranks
+                    # a congested send path)
+                    self._strikes[p] += 1
+                    if self._strikes[p] >= 2:
+                        # two periods of inbound silence: a live
+                        # backpressured peer refreshes _last at least
+                        # every period (its own heartbeats), a dead
+                        # one cannot — so in-band marking stays far
+                        # faster than the full timeout without it
+                        with self._lock:
+                            quiet = (time.monotonic() - self._last[p]
+                                     > 2 * self.period)
+                        if quiet:
+                            self.mark_failed(p)
             now = time.monotonic()
             with self._lock:
                 late = [p for p, t in self._last.items()

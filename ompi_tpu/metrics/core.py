@@ -63,6 +63,10 @@ NATIVE_COUNTERS = (
     "cts_wait_ns", "cts_waits", "rndv_depth", "rndv_hwm", "slot_waits",
     "eager_msgs", "eager_bytes", "chunked_msgs", "chunked_bytes",
     "rndv_msgs", "rndv_bytes", "delivered", "unexpected_hwm",
+    # robustness tail (appended — cached pvar indices stay valid):
+    # transport self-healing activity and ULFM-grade escalations
+    "reconnects", "retry_dials", "retry_sends", "deadline_expired",
+    "injected_faults",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —
@@ -294,7 +298,7 @@ def reset(full: bool = True) -> None:
 def snapshot(reason: str = "periodic", proc: int | None = None) -> dict:
     """One JSON-able view of both planes right now — the exporter,
     flight-recorder, and report-tool input."""
-    return {
+    snap = {
         "ts_ns": time.time_ns(),
         "reason": reason,
         "proc": proc,
@@ -302,6 +306,11 @@ def snapshot(reason: str = "periodic", proc: int | None = None) -> dict:
         "ops": op_stats(),
         "spc": _spc_snapshot(),
     }
+    from ompi_tpu.faultsim import core as _fsim
+
+    if _fsim._enabled:
+        snap["faultsim"] = _fsim.counters()
+    return snap
 
 
 def _spc_snapshot() -> dict[str, int]:

@@ -110,17 +110,41 @@ class RecvRequest(Request):
         self._event = threading.Event()
         self.status: Status | None = None
         self._payload: Any = None
+        #: cross-process receives: (timeout_s, check, escalate) armed
+        #: by the comm layer — see :meth:`arm_remote_guard`
+        self._guard = None
 
     def _deliver(self, payload: Any, status: Status) -> None:
         self._payload = payload
         self.status = status
         self._event.set()
 
+    def arm_remote_guard(self, timeout: float, check, escalate) -> None:
+        """Make the blocking wait failure- and deadline-sensitive for a
+        receive whose sender lives in another process: ``check()``
+        raises once the watched peer is marked failed (ULFM in-band
+        error instead of waiting out the deadline), ``escalate(t)``
+        raises when the shared ``dcn_recv_timeout`` deadline expires —
+        a remote receive must never hang.  Local receives stay
+        unguarded: blocking on a not-yet-posted local send is plain
+        MPI semantics, not a transport fault."""
+        self._guard = (float(timeout), check, escalate)
+
     def _poll(self) -> bool:
         return self._event.is_set()
 
     def _block(self) -> None:
-        self._event.wait()
+        if self._guard is None:
+            self._event.wait()
+            return
+        from ompi_tpu.core.var import Deadline
+
+        timeout, check, escalate = self._guard
+        dl = Deadline(timeout)
+        while not self._event.wait(dl.slice(0.25)):
+            check()
+            if dl.expired():
+                escalate(timeout)
 
     def _finalize(self) -> Any:
         return self._payload

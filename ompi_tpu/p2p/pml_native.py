@@ -51,6 +51,12 @@ class NativeRecvRequest(Request):
         self._msg = None
         self.status: Status | None = None
         self._lock = threading.Lock()
+        #: cross-process receives: (timeout_s, check, escalate) — the
+        #: same remote-recv guard contract as pml.RecvRequest
+        self._guard = None
+
+    def arm_remote_guard(self, timeout: float, check, escalate) -> None:
+        self._guard = (float(timeout), check, escalate)
 
     def _take(self, msg) -> None:
         from ompi_tpu.dcn.native import _wrap_payload
@@ -81,6 +87,11 @@ class NativeRecvRequest(Request):
     def _block(self) -> None:
         from ompi_tpu.dcn.native import TdcnMsg, _RC_CLOSED
 
+        dl = None
+        if self._guard is not None:
+            from ompi_tpu.core.var import Deadline
+
+            dl = Deadline(self._guard[0])
         with self._lock:
             if self._msg is not None:
                 return
@@ -96,6 +107,11 @@ class NativeRecvRequest(Request):
 
                     raise MPIInternalError(
                         f"native recv wait failed (rc={rc})")
+                if dl is not None:
+                    _timeout, check, escalate = self._guard
+                    check()
+                    if dl.expired():
+                        escalate(_timeout)
 
     def _finalize(self):
         return self._msg
@@ -189,11 +205,15 @@ class NativeMatchingEngine:
         return Status(int(msg.src), int(msg.tag), count, nbytes)
 
     def recv_blocking(self, dest: int, source: int, tag: int,
-                      fail_proc: int = -1):
+                      fail_proc: int = -1, remote: bool = False):
         """Blocking receive in ONE C crossing (match-or-post + sleep on
         the request condvar): the fast path under MPI_Recv.  Returns
         (payload, Status); raises on engine close or watched-proc
-        failure."""
+        failure — and, for a SPECIFIC REMOTE source (``remote`` is the
+        comm layer's verdict), escalates after the shared
+        ``dcn_recv_timeout`` deadline instead of re-arming the C wait
+        forever.  ANY_SOURCE and local sources keep plain MPI blocking
+        semantics: there is no dead transport to escalate."""
         from ompi_tpu.dcn.native import _tls, _tls_msg, _wrap_payload
 
         self._check_rank(dest)
@@ -203,10 +223,15 @@ class NativeMatchingEngine:
             return None, Status.null()
         root = self._root
         msg = _tls_msg()
+        dl = None
+        if remote and source != ANY_SOURCE:
+            from ompi_tpu.core.var import Deadline
+
+            dl = Deadline.for_timeout("recv")
         while True:
             rc = root._lib.tdcn_precv(
                 root._h, self._cid_b, dest, source, tag, fail_proc,
-                120.0, _tls.msg_ref)
+                dl.slice(2.0) if dl is not None else 120.0, _tls.msg_ref)
             if rc == 0:
                 break
             if rc == -2:
@@ -219,6 +244,14 @@ class NativeMatchingEngine:
                 from ompi_tpu.core.errors import MPIInternalError
 
                 raise MPIInternalError(f"native recv failed (rc={rc})")
+            if dl is not None and dl.expired():
+                root._escalate_deadline(
+                    "p2p_recv", dl.seconds,
+                    f"recv deadline (dcn_recv_timeout={dl.seconds}s) "
+                    f"expired: rank {dest} waiting for rank {source} "
+                    f"(tag={tag}) — peer dead, wedged, or send never "
+                    f"issued", failed_rank=source, root_proc=fail_proc,
+                    src=int(source), tag=int(tag))
         if msg.pyhandle:
             payload = root.take_handle(msg.pyhandle)
             count, nbytes = int(msg.count), int(msg.nbytes)

@@ -152,11 +152,15 @@ def _pvar_names() -> list[str]:
     first seen AFTER a size op shifts the size indices — tools that
     cache across warm-up re-resolve by name, as the reference's
     MPI_T_pvar_get_index contract expects."""
-    from ompi_tpu import metrics
+    from ompi_tpu import faultsim, metrics
     from ompi_tpu.trace import core as trace
 
     names = ["spc_" + k for k in spc.known()]
     names += ["dcn_" + k for k in metrics.NATIVE_COUNTERS]
+    # faultsim injection counters: a FIXED set (kind catalog is
+    # static), placed with the other fixed segments so the growing
+    # tails can never shift it
+    names += ["faultsim_injected_" + k for k in faultsim.KINDS]
     names += ["trace_events", "trace_dropped"]
     for layer, op in trace.span_ops():
         names.append(f"trace_span_{layer}_{op}_count")
@@ -201,6 +205,10 @@ def pvar_get_info(index: int) -> PvarInfo:
         return PvarInfo(name, PVAR_CLASS_COUNTER,
                         f"native DCN transport counter {name[4:]} "
                         "(libtpudcn telemetry block)")
+    if name.startswith("faultsim_injected_"):
+        return PvarInfo(name, PVAR_CLASS_COUNTER,
+                        f"faults of kind {name[len('faultsim_injected_'):]}"
+                        " injected by the seeded fault plane")
     if name.startswith("metrics_size_"):
         op = name[len("metrics_size_"):-len("_hist")]
         return PvarInfo(name, PVAR_CLASS_AGGREGATE,
@@ -230,6 +238,10 @@ def pvar_read(index: int):
         from ompi_tpu import metrics
 
         return metrics.native_value(name[4:])
+    if name.startswith("faultsim_injected_"):
+        from ompi_tpu import faultsim
+
+        return faultsim.injected(name[len("faultsim_injected_"):])
     if name.startswith("metrics_size_"):
         from ompi_tpu import metrics
 
@@ -278,6 +290,11 @@ def pvar_reset_one(index: int) -> None:
     elif name.startswith("trace_span_"):
         layer, op = _trace_key(name)
         trace.reset_span_stat(layer, op.rsplit("_", 1)[0])
+    elif name.startswith("faultsim_injected_"):
+        raise MPIArgError(
+            f"{name} is injection evidence for the active fault plan; "
+            "it resets with the plan (faultsim.configure), not per pvar"
+        )
     elif name.startswith("dcn_"):
         # native counters are append-only in C; reset re-baselines the
         # Python view (reads subtract) — the C plane stays untouched

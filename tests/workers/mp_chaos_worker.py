@@ -1,0 +1,101 @@
+"""tpurun --ft chaos soak worker: collectives + rendezvous bursts
+under a seeded fault plan (launched by test_faultsim.py and
+tools/chaos.py).
+
+The driver passes ``--mca faultsim_enable 1 faultsim_seed N
+faultsim_plan <plan>`` plus short ``dcn_*_timeout`` values and a small
+``btl_tcp_eager_limit`` (so the p2p bursts take the RTS/CTS/FRAG
+rendezvous path) on the framed-TCP transport (``--mca btl tcp``).
+
+Contract asserted by the driver on this worker's output:
+
+* every rank EITHER completes all its operations among survivors OR
+  raises ``MPIProcFailedError``/``MPIRevokedError`` within the
+  configured deadlines — never a bare RuntimeError, never a hang (the
+  driver's subprocess timeout is the hang detector);
+* one ``CHAOS_TALLY <json>`` line per rank: per-kind injected-fault
+  counts (identical across runs of the same seed — the decisions are
+  counter-hashed, heartbeats exempt), transport self-healing counters
+  (reconnects / retry_dials / retry_sends / deadline_expired),
+  completed-op count, and the escalation class if any.
+
+Ranks always exit 0: an escalation is a *survived, reported* outcome,
+not a crash.  Escalated ranks leave via ``os._exit`` after the tally —
+their world is poisoned and a finalize barrier against a peer that
+already escalated could itself deadline out.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu import faultsim
+from ompi_tpu.core.errors import (
+    MPIProcFailedError,
+    MPIProcFailedPendingError,
+    MPIRevokedError,
+)
+from ompi_tpu.op import SUM
+
+OPS = int(os.environ.get("CHAOS_OPS", "24"))
+#: p2p burst payload — must exceed the driver's eager limit so the
+#: burst exercises the rendezvous (RTS/CTS/FRAG) protocol under faults
+RNDV_BYTES = int(os.environ.get("CHAOS_RNDV_BYTES", str(96 * 1024)))
+
+world = api.init()
+p, n = world.proc, world.size
+assert faultsim.enabled(), "faultsim_enable did not propagate"
+assert world.local_size == 1, world.local_size
+
+payload = np.ones(RNDV_BYTES // 8, np.float64)
+escalated = ""
+completed = 0
+try:
+    for i in range(OPS):
+        out = world.allreduce(np.full((1, 4), i + 1.0), SUM)
+        # among survivors the value is exact; after a silent drop the
+        # op raises before producing — never silently wrong
+        assert out.shape == (1, 4), out
+        if n == 2 and i % 3 == 0:
+            if p == 0:
+                world.send(payload * (i + 1), source=0, dest=1, tag=100 + i)
+                got, _st = world.recv(dest=0, source=1, tag=200 + i)
+            else:
+                got, _st = world.recv(dest=1, source=0, tag=100 + i)
+                assert got[0] == i + 1, (got[0], i)
+                world.send(payload * (i + 1), source=1, dest=0, tag=200 + i)
+        completed = i + 1
+except (MPIProcFailedError, MPIProcFailedPendingError,
+        MPIRevokedError) as e:
+    escalated = type(e).__name__
+    print(f"[chaos] proc {p} escalated after {completed} ops: {e}",
+          file=sys.stderr, flush=True)
+
+st = getattr(getattr(world.dcn, "transport", None), "stats", None) or {}
+tally = {
+    "proc": p,
+    "completed": completed,
+    "ops": OPS,
+    "escalated": escalated,
+    "injected": faultsim.counters(),
+    "reconnects": int(st.get("reconnects", 0)),
+    "retry_dials": int(st.get("retry_dials", 0)),
+    "retry_sends": int(st.get("retry_sends", 0)),
+    "deadline_expired": int(st.get("deadline_expired", 0)),
+}
+print("CHAOS_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
+
+if escalated:
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+api.finalize()
+print(f"OK chaos proc={p}", flush=True)
