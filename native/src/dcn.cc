@@ -70,6 +70,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <tuple>
@@ -193,6 +194,12 @@ enum TdcnStatIdx {
   TS_RETRY_SENDS,        // sends retried after invalidating a dead peer
   TS_DEADLINE_EXPIRED,   // blocking waits that ran out their dcn_*_timeout
   TS_INJECTED_FAULTS,    // faults the faultsim plane injected (this plane)
+  // -- elastic-recovery tail (appended; version stays 1) --------------
+  TS_DEDUP_DROPS,        // duplicate frames dropped by the rx seq filter
+  TS_RESPAWNS,           // peers restored by replace() after a respawn
+                         // (bumped Python-side via the _py_stats merge —
+                         // the slot exists so the name table stays the
+                         // single source of schema truth)
   TS_COUNT
 };
 
@@ -203,7 +210,8 @@ static const char *TDCN_STAT_NAMES =
     "cts_wait_ns,cts_waits,rndv_depth,rndv_hwm,slot_waits,"
     "eager_msgs,eager_bytes,chunked_msgs,chunked_bytes,"
     "rndv_msgs,rndv_bytes,delivered,unexpected_hwm,"
-    "reconnects,retry_dials,retry_sends,deadline_expired,injected_faults";
+    "reconnects,retry_dials,retry_sends,deadline_expired,injected_faults,"
+    "dedup_drops,respawns";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -241,6 +249,21 @@ static std::atomic<uint64_t> g_fault_stall_ns{0};
 static std::atomic<uint64_t> g_fault_stall_every{1};
 static std::atomic<int64_t> g_fault_fail_at{-1};
 static std::atomic<uint64_t> g_fault_events{0};
+// connection-kill knob for the tcp send path (connkill:at=N rules —
+// the native twin of the Python transport's _kill_peer site): the Nth
+// non-control send finds its socket severed and exercises the
+// redial+resend round.  Own event counter: send events never reach
+// Python on this plane.
+static std::atomic<int64_t> g_fault_conn_at{-1};
+static std::atomic<uint64_t> g_fault_conn_events{0};
+// receive-path delay knob (delay:ms=..;site=recv rules): injected
+// latency at the blocking-receive entry (tdcn_precv — the native pml
+// AND the C-ABI shim's MPI_Recv path).  Disabled cost: one relaxed
+// load per receive.
+static std::atomic<uint32_t> g_fault_recv_armed{0};
+static std::atomic<uint64_t> g_fault_recv_ns{0};
+static std::atomic<uint64_t> g_fault_recv_every{1};
+static std::atomic<uint64_t> g_fault_recv_events{0};
 
 static bool recv_exact(int fd, void *buf, size_t n) {
   char *p = (char *)buf;
@@ -556,6 +579,13 @@ struct Peer {
   std::string uds_name;  // abstract socket name (setup channel)
   std::string db_name;   // doorbell shm name
   int fd = -1;           // connected socket (tcp or uds)
+  uint64_t epoch = 0;    // socket generation (bumped per redial)
+  uint64_t tx_seq = 0;   // per-peer message seq for rx-side dedup
+  uint64_t nonce = 0;    // 40-bit sender-lineage tag carried with the
+                         // seq: rx dedup keys on (from_proc, nonce),
+                         // so engines from different worlds (spawn)
+                         // or incarnations sharing a proc index can
+                         // never collide on one watermark
   bool same_host = false;
   ShmRing tx_ring;  // our ring toward this peer (created lazily)
   bool ring_announced = false;
@@ -576,6 +606,25 @@ struct Reassembly {
   bool granted = false;  // holds a rndv slot
 };
 
+// receiver-side duplicate filter, one per sending proc: `low` is the
+// contiguous delivered watermark (every seq <= low seen), `seen` the
+// out-of-order tail.  A sender's redial+resend round (and injected
+// wire duplicates) reuse the original seq, so a second arrival tests
+// as a dup — the exactly-once contract across reconnects.
+struct DedupSeen {
+  uint64_t low = 0;
+  std::set<uint64_t> seen;
+  bool is_dup(uint64_t s) {
+    if (s <= low || seen.count(s)) return true;
+    seen.insert(s);
+    while (seen.count(low + 1)) {
+      seen.erase(low + 1);
+      low++;
+    }
+    return false;
+  }
+};
+
 struct Engine {
   int proc = 0, nprocs = 0;
   std::string host_id;
@@ -591,6 +640,11 @@ struct Engine {
   // bounds reserve() so a dead/wedged consumer surfaces as a send
   // error instead of an unbounded producer spin
   std::atomic<uint64_t> ring_timeout_ns{600ull * 1000000000ull};
+  // (re)dial deadline (dcn_connect_timeout; tdcn_set_connect_timeout —
+  // the ring-timeout hook's twin): bounds the exponential-backoff dial
+  // loop, so a dead peer surfaces as a send error while a restarting
+  // one heals
+  std::atomic<uint64_t> connect_timeout_ns{30ull * 1000000000ull};
   int max_rndv = 4;
 
   int tcp_listen_fd = -1, uds_listen_fd = -1;
@@ -623,6 +677,14 @@ struct Engine {
   std::atomic<bool> closing{false};
   std::atomic<uint64_t> bytes_sent{0};
   TdcnStats stats;  // transport telemetry (tdcn_stats reads it)
+  // rx duplicate filter, keyed by (sending proc, sender-lineage
+  // nonce) — tcp eager frames with a nonzero seq in WireHdr.off.  The
+  // nonce (fresh per sender Peer object) keeps distinct senders that
+  // share a proc index (spawn worlds, respawned incarnations) on
+  // separate watermarks; stale entries are pruned when a proc is
+  // marked failed / restored
+  std::mutex dedup_mu;
+  std::map<std::pair<int32_t, uint64_t>, DedupSeen> rx_seen;
   // inbound rendezvous flow control
   std::mutex rndv_mu;
   std::condition_variable rndv_cv;
@@ -911,8 +973,37 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
 // socket receive loop
 // ---------------------------------------------------------------------
 
+// Sender connection died: drop its incomplete rendezvous transfers
+// and return any slots they held (the C twin of the Python
+// transport's _abandon) — a broken transfer must never leak a
+// max_rndv slot, or a few severed connections would permanently
+// starve every future CTS grant on this engine.
+static void abandon_reassemblies(
+    Engine *eng, const std::set<std::pair<int, int64_t>> &keys) {
+  for (const auto &key : keys) {
+    Reassembly *ra = nullptr;
+    {
+      std::lock_guard<std::mutex> g(eng->rndv_mu);
+      auto it = eng->reasm.find(key);
+      if (it == eng->reasm.end()) continue;
+      ra = it->second;
+      eng->reasm.erase(it);
+      if (ra->granted) {
+        eng->rndv_active--;
+        eng->stats.gauge(TS_RNDV_DEPTH, (uint64_t)eng->rndv_active);
+        eng->rndv_cv.notify_one();
+      }
+    }
+    free(ra->buf);
+    delete ra;
+  }
+}
+
 static void sock_recv_loop(Engine *eng, int fd) {
   std::vector<uint8_t> extra;
+  // in-flight rendezvous transfers whose RTS arrived on THIS socket
+  // (their FRAGs ride the same connection); abandoned if it dies
+  std::set<std::pair<int, int64_t>> conn_keys;
   while (!eng->closing.load(std::memory_order_relaxed)) {
     WireHdr h;
     if (!recv_exact(fd, &h, sizeof(h))) break;
@@ -941,6 +1032,24 @@ static void sock_recv_loop(Engine *eng, int fd) {
         free(buf);
         break;
       }
+      if (h.off) {
+        // nonzero off on an eager frame = the sender's per-peer seq
+        // (+ lineage nonce, see tcp_send_once): drop the duplicate a
+        // redial+resend round (or an injected wire dup) can produce
+        // — exactly-once across reconnects
+        uint64_t xs = h.off & ((1ull << 40) - 1);
+        uint64_t nonce = ((h.off >> 40) << 16) | h.pad;
+        bool dup_frame;
+        {
+          std::lock_guard<std::mutex> g(eng->dedup_mu);
+          dup_frame = eng->rx_seen[{h.from_proc, nonce}].is_dup(xs);
+        }
+        if (dup_frame) {
+          eng->stats.add(TS_DEDUP_DROPS, 1);
+          free(buf);
+          continue;
+        }
+      }
       Env e;
       parse_extra(h, extra.data(), &e);
       OwnedMsg m;
@@ -962,7 +1071,10 @@ static void sock_recv_loop(Engine *eng, int fd) {
       if (ra && h.off + h.nbytes <= ra->total) {
         if (h.nbytes && !recv_exact(fd, ra->buf + h.off, h.nbytes)) break;
         ra->received += h.nbytes;
-        if (ra->received >= ra->total) finish_reassembly(eng, h, ra);
+        if (ra->received >= ra->total) {
+          finish_reassembly(eng, h, ra);
+          conn_keys.erase({h.from_proc, h.seq});
+        }
       } else {
         // unknown transfer: drain and drop
         std::vector<uint8_t> sink(h.nbytes ? h.nbytes : 1);
@@ -970,9 +1082,11 @@ static void sock_recv_loop(Engine *eng, int fd) {
       }
       continue;
     }
+    if (h.type == FT_RTS) conn_keys.insert({h.from_proc, h.seq});
     process_frame(eng, h, extra.data(), nullptr, fd);
   }
   close(fd);
+  abandon_reassemblies(eng, conn_keys);
 }
 
 static void accept_loop(Engine *eng, int lfd) {
@@ -1182,7 +1296,48 @@ static int connect_uds(const std::string &name) {
   return fd;
 }
 
-// get-or-create the peer for a composite address; lazily connect
+// one dial attempt on the peer's preferred wire (uds same-host, tcp
+// otherwise)
+static int dial_peer_once(Engine *eng, Peer *p) {
+  (void)eng;
+  int fd = -1;
+  if (p->same_host && !p->uds_name.empty()) fd = connect_uds(p->uds_name);
+  if (fd < 0) fd = connect_tcp(p->tcp_host);
+  return fd;
+}
+
+// Dial under the connect deadline (tdcn_set_connect_timeout — the
+// dcn_connect_timeout policy): exponential backoff between attempts,
+// matching the Python transport's _dial_backoff.  Returns the fd or
+// -1 once the deadline runs out / the engine closes.  Attempts beyond
+// the first count TS_RETRY_DIALS.
+static int dial_backoff(Engine *eng, Peer *p) {
+  uint64_t tmo = eng->connect_timeout_ns.load(std::memory_order_relaxed);
+  uint64_t give_up = tmo ? now_ns() + tmo : 0;
+  uint64_t delay_ns = 50ull * 1000 * 1000;           // 50 ms base
+  const uint64_t cap_ns = 1000ull * 1000 * 1000;     // 1 s cap
+  for (;;) {
+    if (eng->closing.load(std::memory_order_relaxed)) return -1;
+    int fd = dial_peer_once(eng, p);
+    if (fd >= 0) return fd;
+    eng->stats.add(TS_RETRY_DIALS, 1);
+    if (give_up && now_ns() + delay_ns > give_up) {
+      eng->stats.add(TS_DEADLINE_EXPIRED, 1);
+      return -1;
+    }
+    struct timespec ts = {(time_t)(delay_ns / 1000000000ull),
+                          (long)(delay_ns % 1000000000ull)};
+    nanosleep(&ts, nullptr);
+    delay_ns = delay_ns * 2 < cap_ns ? delay_ns * 2 : cap_ns;
+  }
+}
+
+// get-or-create the peer for a composite address; lazily connect with
+// ONE attempt — heartbeats/gossip ride this path too, and a blocked
+// backoff loop here would freeze the detector thread for the whole
+// connect deadline.  Data sends that find fd < 0 (or lose it) run the
+// backoff redial in engine_send_peer's retry round instead, where the
+// control-frame exemption applies.
 static Peer *get_peer(Engine *eng, const std::string &address) {
   {
     std::lock_guard<std::mutex> g(eng->peers_mu);
@@ -1197,10 +1352,12 @@ static Peer *get_peer(Engine *eng, const std::string &address) {
     p->tcp_host = address;
   }
   p->same_host = (!p->host_id.empty() && p->host_id == eng->host_id);
-  if (p->same_host && !p->uds_name.empty()) {
-    p->fd = connect_uds(p->uds_name);
-  }
-  if (p->fd < 0) p->fd = connect_tcp(p->tcp_host);
+  // sender-lineage tag for rx dedup (splitmix-style scramble of the
+  // creation time; 40 bits ride the wire — see tcp_send_once)
+  p->nonce = ((now_ns() ^ ((uint64_t)(uintptr_t)p << 17)) *
+              0x9E3779B97F4A7C15ull) >> 24 & ((1ull << 40) - 1);
+  p->fd = dial_peer_once(eng, p);
+  if (p->fd >= 0) p->epoch = 1;
   {
     std::lock_guard<std::mutex> g(eng->peers_mu);
     auto it = eng->peers.find(address);
@@ -1246,6 +1403,37 @@ static bool fault_ring_ok(Engine *eng) {
     return false;
   }
   return true;
+}
+
+// consult the armed connkill knob before a tcp send: the matching
+// event finds its socket severed in place, so the in-flight send
+// fails and exercises the redial+resend round (the same contract as
+// the Python transport's _kill_peer site)
+static void fault_conn_check(Engine *eng, Peer *p) {
+  int64_t at = g_fault_conn_at.load(std::memory_order_relaxed);
+  if (at < 0) return;
+  uint64_t k =
+      g_fault_conn_events.fetch_add(1, std::memory_order_relaxed) + 1;
+  if ((int64_t)k == at && p->fd >= 0) {
+    eng->stats.add(TS_INJECTED_FAULTS, 1);
+    shutdown(p->fd, SHUT_RDWR);
+  }
+}
+
+// injected latency at the blocking-receive entry (tdcn_precv: the
+// native pml recv AND the C-ABI shim's MPI_Recv ride it)
+static void fault_recv_check(Engine *eng) {
+  if (!g_fault_recv_armed.load(std::memory_order_relaxed)) return;
+  uint64_t k =
+      g_fault_recv_events.fetch_add(1, std::memory_order_relaxed) + 1;
+  uint64_t every = g_fault_recv_every.load(std::memory_order_relaxed);
+  uint64_t ns = g_fault_recv_ns.load(std::memory_order_relaxed);
+  if (ns && every && k % every == 0) {
+    eng->stats.add(TS_INJECTED_FAULTS, 1);
+    struct timespec ts = {(time_t)(ns / 1000000000ull),
+                          (long)(ns % 1000000000ull)};
+    nanosleep(&ts, nullptr);
+  }
 }
 
 static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
@@ -1315,21 +1503,38 @@ static int engine_send(Engine *eng, const std::string &address, Env &e,
   return engine_send_peer(eng, p, e, data, nbytes);
 }
 
+static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
+                         uint64_t nbytes, uint64_t xs);
+
 static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
                             uint64_t nbytes) {
-  if (!p || p->fd < 0) return -1;
+  if (!p) return -1;
   eng->bytes_sent.fetch_add(nbytes, std::memory_order_relaxed);
 
-  std::lock_guard<std::mutex> g(p->send_mu);
-  if (p->same_host && ensure_ring(eng, p)) {
+  // control frames: FK_PY, no cid, no payload (heartbeats / gossip /
+  // revoke) — exempt from fault injection, retry, and redial backoff
+  // so in-band failure detection stays prompt and deterministic
+  bool ctrl = e.kind == FK_PY && e.cid.empty() && nbytes == 0;
+  // ...and they must not QUEUE behind a data sender either: send_mu
+  // can be held across a redial-backoff round (or a CTS wait), and a
+  // detector thread blocked here would stall heartbeats to EVERY
+  // peer for the whole connect deadline — false-positive detection
+  // of the blocked sender.  try_lock: a busy send path just costs
+  // one droppable control frame (heartbeats repeat, gossip is
+  // redundant), and the two-strike + inbound-silence rules absorb it.
+  std::unique_lock<std::mutex> g(p->send_mu, std::defer_lock);
+  if (ctrl) {
+    if (!g.try_lock()) return -1;
+  } else {
+    g.lock();
+  }
+  if (p->fd >= 0 && p->same_host && ensure_ring(eng, p)) {
     // ring writes are deadline-bounded (a frozen tail must surface as
-    // an error, not an infinite producer spin).  Control frames
-    // (FK_PY, no cid, no payload: heartbeats/gossip/revoke) get a
+    // an error, not an infinite producer spin).  Control frames get a
     // tiny bound instead — the failure detector's own traffic must
     // fail FAST into the in-band strike path when a peer's ring is
     // wedged, not block out the full data deadline; losing one is
     // harmless (heartbeats repeat, gossip is redundant)
-    bool ctrl = e.kind == FK_PY && e.cid.empty() && nbytes == 0;
     uint64_t ring_tmo =
         ctrl ? 2000000ull
              : eng->ring_timeout_ns.load(std::memory_order_relaxed);
@@ -1380,10 +1585,61 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
     return 0;
   }
 
-  // tcp path
+  // tcp path — one redial+resend round (the epoch-tagged self-healing
+  // the Python tcp leg grew in the fault-plane PR): a send that fails
+  // invalidates its epoch's socket, redials with backoff under the
+  // connect deadline, and retries ONCE; only an unhealable failure
+  // surfaces as rc=-1 for the Python side's ULFM escalation.  The
+  // per-peer seq (carried in WireHdr.off on eager frames) lets the
+  // receiver drop the one frame a retry can duplicate — exactly-once
+  // across the reconnect.  Only EAGER frames consume a seq: the
+  // receiver's contiguous watermark would stall forever on a seq
+  // burned by a rendezvous transfer (whose RTS/FRAG frames never
+  // carry it — an incomplete FRAG stream is simply not delivered, so
+  // rndv needs no dedup).  send_mu serializes senders, so the epoch
+  // is generation bookkeeping, not a race guard.
+  uint64_t xs = (ctrl || (int64_t)nbytes > eng->eager_limit)
+                    ? 0
+                    : ++p->tx_seq;
+  if (!ctrl) fault_conn_check(eng, p);
+  for (int attempt = 0; attempt < 2; attempt++) {
+    if (p->fd < 0) {
+      if (ctrl || eng->closing.load(std::memory_order_relaxed)) return -1;
+      int fd = dial_backoff(eng, p);
+      if (fd < 0) return -1;  // connect deadline expired: unhealable
+      p->fd = fd;
+      p->epoch++;
+      eng->stats.add(TS_RECONNECTS, 1);
+      // duplex reader for CTS grants on the fresh socket
+      std::thread(sock_recv_loop, eng, dup(fd)).detach();
+    }
+    if (tcp_send_once(eng, p, e, data, nbytes, xs) == 0) return 0;
+    // connection-level failure: invalidate this epoch's socket; the
+    // next pass redials (control traffic fails fast instead — the
+    // detector's in-band strike path owns interpreting it)
+    shutdown(p->fd, SHUT_RDWR);
+    close(p->fd);
+    p->fd = -1;
+    if (ctrl || eng->closing.load(std::memory_order_relaxed)) return -1;
+    if (attempt == 0) eng->stats.add(TS_RETRY_SENDS, 1);
+  }
+  return -1;
+}
+
+// one attempt at moving a message over the peer's tcp/uds socket;
+// connection-level failures return -1 for the caller's retry round.
+// `xs` rides WireHdr.off on eager frames (rx dedup key; rendezvous
+// needs none — an incomplete FRAG stream is never delivered, and a
+// retry restarts from a fresh RTS).
+static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
+                         uint64_t nbytes, uint64_t xs) {
   if ((int64_t)nbytes <= eng->eager_limit) {
     WireHdr h;
-    fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
+    // seq'd frames pack (lineage nonce, seq) into off+pad: low 40
+    // bits of off = seq, high 24 bits of off + pad = the 40-bit nonce
+    uint64_t off = xs ? ((p->nonce >> 16) << 40) | xs : 0;
+    fill_hdr(&h, FT_EAGER, e, eng->proc, off, nbytes, nbytes);
+    if (xs) h.pad = (uint16_t)(p->nonce & 0xFFFF);
     std::vector<uint8_t> extra(env_extra(h));
     write_extra(extra.data(), e);
     struct iovec iov[3] = {
@@ -1874,16 +2130,49 @@ int tdcn_ctrl_next(void *h, double timeout_s, TdcnMsg *out) {
   return 0;
 }
 
+// Prune every dedup watermark a proc's senders left behind (all
+// lineage nonces).  Correctness does not depend on this — a reborn
+// incarnation's Peer carries a FRESH nonce, so it can never collide
+// with the corpse's state — it just bounds memory across recoveries.
+static void prune_dedup(Engine *eng, int proc) {
+  std::lock_guard<std::mutex> g(eng->dedup_mu);
+  for (auto it = eng->rx_seen.begin(); it != eng->rx_seen.end();) {
+    if (it->first.first == proc)
+      it = eng->rx_seen.erase(it);
+    else
+      ++it;
+  }
+}
+
+// Un-mark a failed proc (the replace() leg of elastic recovery: a
+// respawned incarnation re-published its endpoint, so sends/recvs
+// naming it must flow again).
+void tdcn_clear_failed(void *h, int proc) {
+  Engine *eng = (Engine *)h;
+  {
+    std::lock_guard<std::mutex> g(eng->mu);
+    if (proc >= 0 && (size_t)proc < eng->failed.size())
+      eng->failed[proc] = false;
+  }
+  prune_dedup(eng, proc);
+}
+
 void tdcn_note_failed(void *h, int proc) {
   Engine *eng = (Engine *)h;
-  std::lock_guard<std::mutex> g(eng->mu);
-  if (proc >= 0 && (size_t)proc < eng->failed.size())
-    eng->failed[proc] = true;
-  // wake every waiter so failure-sensitive recvs re-check; inline-
-  // progress waiters sleep on the doorbell futex, not the cvs
-  for (auto &kv : eng->coll) kv.second->cv.notify_all();
-  for (auto &kv : eng->reqs) kv.second->cv.notify_all();
-  wake_waiters(eng);
+  {
+    std::lock_guard<std::mutex> g(eng->mu);
+    if (proc >= 0 && (size_t)proc < eng->failed.size())
+      eng->failed[proc] = true;
+    // wake every waiter so failure-sensitive recvs re-check; inline-
+    // progress waiters sleep on the doorbell futex, not the cvs
+    for (auto &kv : eng->coll) kv.second->cv.notify_all();
+    for (auto &kv : eng->reqs) kv.second->cv.notify_all();
+    wake_waiters(eng);
+  }
+  // the dead incarnation's dedup watermarks are garbage now (its
+  // reborn successor gets a fresh lineage nonce, so there is no
+  // collision either way) — prune them to bound memory
+  prune_dedup(eng, proc);
 }
 
 // ---- channel fast path ----------------------------------------------
@@ -1957,6 +2246,7 @@ int tdcn_precv(void *h, const char *cid, int dst, int src, int tag,
   // request's condvar until the C receiver thread completes it (or the
   // watched root proc is marked failed / the engine closes)
   Engine *eng = (Engine *)h;
+  fault_recv_check(eng);  // faultsim recv site (one relaxed load off)
   std::unique_lock<std::mutex> g(eng->mu);
   CidQueues &q = eng->p2p[cid ? cid : ""];
   auto &uq = q.unexpected[dst];
@@ -2057,6 +2347,55 @@ uint64_t tdcn_fault_events(void) {
   return g_fault_events.load(std::memory_order_relaxed);
 }
 
+// Arm/disarm the tcp-send connection-kill knob (connkill:at=N rules on
+// the native plane): the Nth non-control send finds its cached socket
+// severed and must heal through the redial round.  -1 disarms; the
+// event counter restarts so schedules are reproducible.
+void tdcn_fault_set_conn(int64_t connkill_at) {
+  g_fault_conn_at.store(connkill_at, std::memory_order_relaxed);
+  g_fault_conn_events.store(0, std::memory_order_relaxed);
+}
+
+// Arm/disarm the blocking-receive delay knob (delay:ms=..;site=recv
+// rules): every Nth tdcn_precv entry sleeps delay_ns — the injected
+// latency covers the native pml fast path and the C-ABI shim's
+// MPI_Recv, which both ride tdcn_precv.  delay_ns = 0 disarms.
+void tdcn_fault_set_recv(uint64_t delay_ns, uint64_t every) {
+  g_fault_recv_ns.store(delay_ns, std::memory_order_relaxed);
+  g_fault_recv_every.store(every ? every : 1, std::memory_order_relaxed);
+  g_fault_recv_events.store(0, std::memory_order_relaxed);
+  g_fault_recv_armed.store(delay_ns ? 1 : 0, std::memory_order_relaxed);
+}
+
+// Sever a channel's cached peer connection in place (test/chaos
+// injection: the next send fails and exercises the native redial) —
+// the C twin of the Python transport's _kill_peer.  send_mu guards
+// the fd lifecycle (the retry path closes + reassigns it), so the
+// kill must hold it too or it could shutdown() a recycled descriptor
+// belonging to something else entirely.
+static void kill_peer_locked(Peer *p) {
+  std::lock_guard<std::mutex> g(p->send_mu);
+  if (p->fd >= 0) shutdown(p->fd, SHUT_RDWR);
+}
+
+void tdcn_chan_kill(void *h, uint64_t chan) {
+  (void)h;
+  Chan *c = (Chan *)(uintptr_t)chan;
+  if (c && c->peer) kill_peer_locked(c->peer);
+}
+
+// Same, addressed by the peer's composite address (engine-level sends).
+void tdcn_kill_peer(void *h, const char *address) {
+  Engine *eng = (Engine *)h;
+  Peer *p = nullptr;
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    auto it = eng->peers.find(address ? address : "");
+    if (it != eng->peers.end()) p = it->second;
+  }
+  if (p) kill_peer_locked(p);
+}
+
 // Bound every ring write by `seconds` (the dcn_ring_timeout MCA var —
 // the Python control plane forwards it after engine creation); expiry
 // surfaces as a send error + TS_DEADLINE_EXPIRED.  <= 0 restores the
@@ -2064,6 +2403,17 @@ uint64_t tdcn_fault_events(void) {
 void tdcn_set_ring_timeout(void *h, double seconds) {
   Engine *eng = (Engine *)h;
   eng->ring_timeout_ns.store(
+      seconds > 0 ? (uint64_t)(seconds * 1e9) : 0,
+      std::memory_order_relaxed);
+}
+
+// Bound every (re)dial by `seconds` (the dcn_connect_timeout MCA var —
+// the ring-timeout hook's twin); the exponential-backoff dial loop
+// gives up and surfaces a send error once it expires.  <= 0 removes
+// the bound (dial retries forever until close).
+void tdcn_set_connect_timeout(void *h, double seconds) {
+  Engine *eng = (Engine *)h;
+  eng->connect_timeout_ns.store(
       seconds > 0 ? (uint64_t)(seconds * 1e9) : 0,
       std::memory_order_relaxed);
 }
@@ -2123,7 +2473,13 @@ void tdcn_close(void *h) {
     }
     eng->rx_rings.clear();
   }
-  eng->my_db.destroy(true);
+  // The doorbell MAPPING stays alive (only the name is unlinked, so
+  // /dev/shm is reclaimed): detached per-connection readers can still
+  // deliver one straggler frame after close, and deliver_locked rings
+  // my_db.word — an munmap here would turn that into a use-after-free
+  // segfault at teardown.  Same rationale as leaking the Engine.
+  if (!eng->my_db.name.empty()) shm_unlink(eng->my_db.name.c_str());
+  if (eng->my_db.fd >= 0) close(eng->my_db.fd);
   // NOTE: the Engine object is intentionally leaked at close (detached
   // per-connection recv threads may still be draining); process
   // teardown reclaims it.

@@ -54,9 +54,22 @@ class MultiProcComm(PersistentP2PMixin):
         self.name = name
         self._freed = False
 
-        # modex: exchange local sizes → global rank layout
-        sizes = self.dcn.allgather(np.array([local_mesh.size], np.int64), self.cid)
-        self.proc_sizes = [int(s[0]) for s in sizes]
+        # modex: exchange local sizes → global rank layout.  Every
+        # first boot also publishes its size to the KVS so a respawned
+        # incarnation can rebuild the SAME layout without the live
+        # allgather — survivors are mid-job with world seq counters
+        # long past 0, so a reborn proc joining that stream would
+        # wedge it; the reborn proc reads the published layout here
+        # and meets the survivors on the replace() rendezvous instead.
+        ctx.kvs.put(f"{ctx.ns}wsize.{ctx.proc}", int(local_mesh.size))
+        if ctx.incarnation and not ctx.rejoined:
+            self.proc_sizes = [
+                int(ctx.kvs.get(f"{ctx.ns}wsize.{p}"))
+                for p in range(self.nprocs)]
+        else:
+            sizes = self.dcn.allgather(
+                np.array([local_mesh.size], np.int64), self.cid)
+            self.proc_sizes = [int(s[0]) for s in sizes]
         self.offsets = np.cumsum([0] + self.proc_sizes).tolist()
         self.local_size = local_mesh.size
         self.local_offset = self.offsets[self.proc]
@@ -429,6 +442,15 @@ class MultiProcComm(PersistentP2PMixin):
             arm = getattr(req, "arm_remote_guard", None)
             if arm is not None:
                 arm(*self._remote_recv_guard(source, tag))
+        elif source is None:
+            # opt-in bounded ANY_SOURCE wait (dcn_anysrc_timeout):
+            # escalates to a communicator-wide liveness check instead
+            # of blocking forever; off by default (plain MPI)
+            guard = self._anysrc_guard()
+            if guard is not None:
+                arm = getattr(req, "arm_remote_guard", None)
+                if arm is not None:
+                    arm(*guard)
         return req
 
     def _remote_recv_guard(self, source: int, tag):
@@ -460,6 +482,58 @@ class MultiProcComm(PersistentP2PMixin):
 
         return dcn_timeout("recv"), check, escalate
 
+    def _anysrc_guard(self):
+        """(timeout, check, escalate) for an opt-in bounded ANY_SOURCE
+        wait (``dcn_anysrc_timeout``; default 0 = off, unbounded
+        blocking — there is no single peer to escalate, ROADMAP item
+        e).  When armed, deadline expiry runs a communicator-wide
+        liveness check: any failed member raises
+        MPIProcFailedPendingError (the ULFM ANY_SOURCE error class —
+        ack_failed + shrink/replace recover); an all-alive membership
+        re-arms the wait, so a merely-slow sender never escalates."""
+        from ompi_tpu.core.var import dcn_timeout
+
+        t = float(dcn_timeout("anysrc"))
+        if t <= 0:
+            return None
+
+        def check() -> None:
+            if self._ft is not None:
+                from ompi_tpu.ft import ulfm
+
+                ulfm.check(self, any_source=True)
+
+        def escalate(timeout: float) -> None:
+            dead = [p for p in range(self.nprocs)
+                    if p != self.proc and self.dcn.proc_failed(p)]
+            if not dead:
+                return  # every member alive: keep blocking
+            # mirror ulfm.check's ANY_SOURCE contract: only
+            # UNACKNOWLEDGED failures escalate — ack_failed re-arms
+            # the receive, which must keep waiting for live senders
+            from ompi_tpu.ft import ulfm
+
+            st = ulfm.peek(self)
+            acked = st.acked if st is not None else set()
+            ranks = tuple(r for p in dead
+                          for r in range(*self.proc_range(p))
+                          if r not in acked)
+            if not ranks:
+                return  # every known failure acknowledged: keep waiting
+            from ompi_tpu.core.errors import MPIProcFailedPendingError
+            from ompi_tpu.metrics import flight as _flight
+
+            _flight.record("anysrc_liveness", comm=self.name,
+                           timeout_s=float(timeout),
+                           failed=sorted(ranks))
+            raise MPIProcFailedPendingError(
+                f"ANY_SOURCE receive on {self.name}: liveness check "
+                f"(dcn_anysrc_timeout={timeout}s) found failed ranks "
+                f"{sorted(ranks)} (ack_failed + shrink/replace to "
+                f"recover)", failed=ranks)
+
+        return t, check, escalate
+
     def recv(self, dest: int, source: int | None = None, tag: int | None = None):
         if self._pml_native:
             # one C crossing: match-or-post + sleep on the request's
@@ -490,6 +564,7 @@ class MultiProcComm(PersistentP2PMixin):
                 ANY_TAG if tag is None else tag,
                 fail_proc,
                 remote=remote,
+                guard=(self._anysrc_guard() if source is None else None),
             )
             return payload, st
         req = self.irecv(dest, source, tag)
@@ -527,6 +602,13 @@ class MultiProcComm(PersistentP2PMixin):
             sleep = _poll_backoff(sleep)
 
     # -- fault tolerance (ULFM over DCN — SURVEY.md §5) ------------------
+
+    @property
+    def respawned(self) -> bool:
+        """True on a reborn incarnation that has not rejoined yet —
+        the SPMD cue for worker code to call :meth:`replace` right
+        after init instead of entering the normal loop."""
+        return bool(self.procctx.incarnation) and not self.procctx.rejoined
 
     def _on_proc_failed(self, root_proc: int) -> None:
         """Detector fan-out: mark the dead process's global ranks failed
@@ -633,6 +715,154 @@ class MultiProcComm(PersistentP2PMixin):
         owners = [p for p in live for _ in range(self.proc_sizes[p])]
         sub = self._make_sub("shrunk", cid, members, owners, live)
         sub.name = name or f"{self.name}.shrunk"
+        return sub
+
+    # -- elastic recovery: replace (the PRRTE restart leg) ---------------
+
+    def replace(self, name: str = "") -> "MultiProcComm":
+        """Rebuild the communicator at FULL size after rank death —
+        shrink's two-legged sibling (≈ PRRTE restarting the failed
+        proc instead of the job contracting around it).
+
+        Under ``tpurun --ft --respawn`` the launcher relaunches a dead
+        rank with a bumped incarnation; the reborn process replays the
+        boot rendezvous, re-publishing its endpoint under
+        ``dcn.<proc>.i<k>``.  Survivors call ``replace()`` after
+        detection converges (typically revoke → replace): each failed
+        proc is awaited on the KVS, its new address installed on the
+        root engine, its failure marks cleared (detector + engine +
+        native C plane), and one CID-agreement round runs over the
+        restored membership on a fresh ``replace.<proc>.i<k>`` stream
+        — a string-cid stream both the mid-job survivors and the
+        fresh-booted reborn proc enter at seq 0.  The reborn process
+        itself calls ``replace()`` right after ``init()`` (it knows it
+        is a respawn from its incarnation) and joins the same round.
+
+        Returns the new full-membership communicator; the old one
+        stays revoked/poisoned.  Requires a communicator spanning
+        every job process in rank order (the restart leg is
+        job-level); use :meth:`shrink` on partial memberships."""
+        ctx = self.procctx
+        if self.nprocs != self.dcn._root_engine().nprocs or any(
+                self.dcn.root_proc_of(p) != p for p in range(self.nprocs)):
+            raise MPICommError(
+                "replace() requires a communicator spanning every job "
+                "process in rank order; use shrink() instead")
+        timeout = self._respawn_timeout()
+        t0 = _trace.now() if _trace._enabled else 0
+        if not ctx.rejoined:
+            cid = self._replace_rejoin(timeout)
+        else:
+            live = self._live_procs()
+            dead = sorted(set(range(self.nprocs)) - set(live))
+            if not dead:
+                # without a restoration round there is no agreement
+                # exchange, and per-process CID reservation would
+                # diverge — nothing to replace is an error, like
+                # MPIX semantics for recovery calls outside recovery
+                raise MPICommError(
+                    "replace: no failed ranks on this communicator")
+            proposals = self._replace_recover(sorted(live), dead, timeout)
+            cid = _reserve_cid_block(max(int(c) for c in proposals), 1)
+        sub = self._replace_build(cid, name)
+        if _trace._enabled:
+            _trace.complete("ft", "replace", t0, comm=self.name,
+                            cid=int(cid))
+        return sub
+
+    def _respawn_timeout(self) -> float:
+        store = mca.default_context().store
+        return float(store.get("ft_respawn_timeout", 60.0) or 60.0)
+
+    def _replace_recover(self, members: list[int], dead: list[int],
+                         timeout: float) -> list[int]:
+        """Process the dead procs one rendezvous round at a time; each
+        round's CID-agreement allgather spans the membership restored
+        SO FAR (earlier-reborn procs join later rounds — they learn
+        the remaining dead set from the round metadata the minimum
+        survivor published).  Returns the final round's proposals
+        (the full membership's, once ``dead`` drains)."""
+        ctx = self.procctx
+        proposals = [_peek_cid()]
+        dead = list(dead)
+        while dead:
+            p = dead.pop(0)
+            inc, addr = ctx.await_respawn(p, timeout)
+            members = sorted(members + [p])
+            self._integrate_respawn(p, inc, addr)
+            if self.proc == min(m for m in members if m != p):
+                # rendezvous beacon for the reborn proc: who is in its
+                # round, which procs it must help restore after, and
+                # the survivors' incarnation floors — a reborn proc
+                # boots with an EMPTY incarnation map, and without the
+                # floors it would accept a stale inc.<q> left in the
+                # KVS by an EARLIER recovery of q and join the wrong
+                # agreement round
+                ctx.kvs.put(f"{ctx.ns}replace.{p}.i{inc}",
+                            {"members": members, "dead": list(dead),
+                             "incs": {str(k): v for k, v
+                                      in ctx.incarnations.items()}})
+            proposals = self._replace_round(members, p, inc)
+        return proposals
+
+    def _replace_round(self, members: list[int], p: int,
+                       inc: int) -> list[int]:
+        """One CID-agreement allgather over ``members`` on the
+        (proc, incarnation)-scoped stream — fresh for every
+        participant, mid-job or fresh-booted."""
+        eng = (self.dcn if len(members) == self.nprocs
+               else self.dcn.sub(members))
+        infos = eng.allgather_obj(int(_peek_cid()), f"replace.{p}.i{inc}")
+        return [int(c) for c in infos]
+
+    def _integrate_respawn(self, p: int, inc: int, addr: str) -> None:
+        """Install a reborn incarnation on the root engine: refresh its
+        address, clear its failure marks everywhere (gossiping
+        detector, engine failure set, native C plane + rx dedup), and
+        account the restoration (``respawns`` counter, flight record,
+        trace instant)."""
+        root = self.dcn._root_engine()
+        addrs = list(root.addresses)
+        addrs[p] = addr
+        root.set_addresses(addrs)
+        root.note_proc_recovered(p)
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("respawn", proc=int(p), incarnation=int(inc))
+        if _trace._enabled:
+            _trace.instant("ft", "respawn", proc=int(p),
+                           incarnation=int(inc))
+
+    def _replace_rejoin(self, timeout: float) -> int:
+        """The reborn process's half of replace(): wait for the
+        survivors' rendezvous beacon, join this incarnation's
+        CID-agreement round, then help restore any procs still dead."""
+        ctx = self.procctx
+        inc = ctx.incarnation
+        info = ctx.kvs.get(f"{ctx.ns}replace.{self.proc}.i{inc}",
+                           timeout=timeout)
+        members = [int(m) for m in info["members"]]
+        dead = [int(d) for d in info["dead"]]
+        # adopt the survivors' incarnation floors (see the beacon
+        # publisher) before helping restore any remaining dead procs
+        for k, v in (info.get("incs") or {}).items():
+            ctx.incarnations[int(k)] = max(
+                int(v), ctx.incarnations.get(int(k), 0))
+        ctx.incarnations[self.proc] = inc
+        proposals = self._replace_round(members, self.proc, inc)
+        if dead:
+            proposals = self._replace_recover(members, dead, timeout)
+        ctx.rejoined = True
+        return _reserve_cid_block(max(int(c) for c in proposals), 1)
+
+    def _replace_build(self, cid: int, name: str) -> "MultiProcComm":
+        members = list(range(self.size))
+        owners = [p for p in range(self.nprocs)
+                  for _ in range(self.proc_sizes[p])]
+        member_procs = list(range(self.nprocs))
+        sub = self._make_sub("replaced", cid, members, owners,
+                             member_procs)
+        sub.name = name or f"{self.name}.replaced"
         return sub
 
     # -- lifecycle -------------------------------------------------------

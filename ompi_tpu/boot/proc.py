@@ -21,6 +21,10 @@ ENV_KVS = "OMPI_TPU_KVS_ADDR"
 #: KVS key namespace — spawned child worlds share the job's KVS server
 #: but live under their own prefix (dynamic process management)
 ENV_NS = "OMPI_TPU_KVS_NS"
+#: rebirth counter (tpurun --respawn): 0 on first launch; a respawned
+#: worker replays the boot rendezvous under a bumped incarnation so
+#: survivors can distinguish the reborn endpoint from the corpse's
+ENV_INCARNATION = "OMPI_TPU_INCARNATION"
 
 
 def launched_by_tpurun() -> bool:
@@ -34,6 +38,12 @@ class ProcContext:
         self.proc = int(os.environ[ENV_PROC])
         self.nprocs = int(os.environ[ENV_NPROCS])
         self.ns = os.environ.get(ENV_NS, "")
+        #: elastic recovery state: this process's rebirth count, the
+        #: highest incarnation we know per peer (replace() polls past
+        #: it), and whether a reborn process has rejoined the job yet
+        self.incarnation = int(os.environ.get(ENV_INCARNATION, "0"))
+        self.incarnations: dict[int, int] = {}
+        self.rejoined = self.incarnation == 0
         self.kvs = KVSClient(os.environ[ENV_KVS])
         # modex: publish DCN endpoint, fence, gather peers. Transport
         # tunables come from the btl/tcp component's MCA vars (so
@@ -57,6 +67,18 @@ class ProcContext:
             params = comp.params(ctx.store)
         self.engine = self._make_engine(params)
         self.kvs.put(f"{self.ns}dcn.{self.proc}", self.engine.transport.address)
+        if self.incarnation:
+            # rebirth rendezvous: the incarnation-suffixed address key
+            # plus the incarnation beacon survivors' replace() polls —
+            # the plain dcn.<proc> key still holds the CORPSE's address
+            # in their caches until replace() refreshes it
+            self.kvs.put(f"{self.ns}dcn.{self.proc}.i{self.incarnation}",
+                         self.engine.transport.address)
+            self.kvs.put(f"{self.ns}inc.{self.proc}", self.incarnation)
+        # the modex fence is idempotent for a reborn proc (the fence
+        # set already contains every rank), so this returns instantly
+        # on incarnation > 0 — by design: survivors are mid-job, not
+        # waiting at a barrier
         self.kvs.fence(f"{self.ns}modex", self.proc, self.nprocs)
         addresses = [self.kvs.get(f"{self.ns}dcn.{p}")
                      for p in range(self.nprocs)]
@@ -92,8 +114,17 @@ class ProcContext:
 
         ftp = FtDetectorComponent().params(ctx.store)
         if ftp["enable"] and self.nprocs > 1:
+            # a reborn proc's peers stay silent toward it until their
+            # replace() clears its failed mark — grace the first
+            # detection window so the rejoin isn't poisoned by its own
+            # detector declaring every survivor dead
+            grace = 0.0
+            if self.incarnation:
+                grace = float(
+                    ctx.store.get("ft_respawn_timeout", 60.0) or 60.0)
             self.detector = HeartbeatDetector(
-                self.engine, period=ftp["period"], timeout=ftp["timeout"]
+                self.engine, period=ftp["period"], timeout=ftp["timeout"],
+                grace=grace,
             )
             self.detector.on_failure(self._fan_out_failure)
 
@@ -139,6 +170,39 @@ class ProcContext:
         if self.detector is not None:
             for p in self.detector.failed():
                 comm._on_proc_failed(p)
+
+    def await_respawn(self, root_proc: int, timeout: float) -> tuple[int, str]:
+        """Block until a NEW incarnation of ``root_proc`` (> the last
+        one we integrated) has re-published its endpoint; returns
+        (incarnation, address).  The restart leg's rendezvous: tpurun
+        --respawn relaunches the rank, whose boot publishes
+        ``inc.<proc>`` and ``dcn.<proc>.i<k>`` (see __init__)."""
+        import time
+
+        last = self.incarnations.get(root_proc, 0)
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            try:
+                inc = int(self.kvs.get(f"{self.ns}inc.{root_proc}",
+                                       wait=False))
+            except KeyError:
+                inc = 0
+            if inc > last:
+                break
+            if time.monotonic() > deadline:
+                from ompi_tpu.core.errors import MPIProcFailedError
+
+                raise MPIProcFailedError(
+                    f"replace: no respawned incarnation of proc "
+                    f"{root_proc} within ft_respawn_timeout={timeout}s "
+                    f"(launched without tpurun --respawn, or the rank "
+                    f"exhausted --max-respawns?)")
+            time.sleep(0.05)
+        address = self.kvs.get(
+            f"{self.ns}dcn.{root_proc}.i{inc}",
+            timeout=max(1.0, deadline - time.monotonic()))
+        self.incarnations[root_proc] = inc
+        return inc, address
 
     def fence(self, name: str) -> None:
         self.kvs.fence(f"{self.ns}{name}", self.proc, self.nprocs)

@@ -28,13 +28,33 @@ import sys
 import threading
 
 from .kvs import KVSServer
-from .proc import ENV_KVS, ENV_NPROCS, ENV_PROC
+from .proc import ENV_INCARNATION, ENV_KVS, ENV_NPROCS, ENV_PROC
 
 
 def _forward(stream, prefix: str, out) -> None:
     for line in iter(stream.readline, b""):
         out.write(f"[{prefix}] ".encode() + line)
         out.flush()
+
+
+#: env keys reproduced on the remote side of an rsh launch
+_REMOTE_ENV_KEYS = ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def _final_cmd(launch_agent: str, cmd: list[str], env: dict,
+               target: str | None) -> list[str]:
+    """The command actually executed for one rank (re-evaluated on
+    every respawn: the rsh payload bakes the env exports into the
+    command string, so a reborn remote rank must rebuild it or lose
+    the bumped OMPI_TPU_INCARNATION)."""
+    if target is not None and not _is_local_host(target):
+        keys = sorted(
+            k for k in env
+            if k.startswith(("OMPI_TPU_", "OMPI_MCA_"))
+            or k in _REMOTE_ENV_KEYS
+        )
+        return _remote_cmd(launch_agent, target, env, keys, cmd)
+    return cmd
 
 
 #: host names the plm treats as THIS machine (fork instead of rsh)
@@ -90,10 +110,19 @@ def run_job(
     oversubscribe: bool = False,
     display_map: bool = False,
     kvs_host: str | None = None,
+    respawn: bool = False,
+    max_respawns: int = 2,
 ) -> int:
     """``ft=True`` ≈ ``mpirun --with-ft ulfm``: worker death does NOT
     kill the job (survivors run ULFM recovery); the heartbeat detector
     is enabled in every worker and the job's exit code is rank 0's.
+
+    ``respawn=True`` (requires ``ft``) adds the PRRTE restart leg: a
+    worker that dies is relaunched with the same rank and environment
+    under a bumped ``OMPI_TPU_INCARNATION`` (at most ``max_respawns``
+    times per rank).  The reborn process replays the boot rendezvous —
+    re-publishing its endpoint under the new incarnation — and the
+    survivors' ``replace()`` rebuilds the communicator at full size.
 
     ``hosts`` engages the plm/rsh leg: ranks map onto the allocation
     via the rmaps policy (``map_by``); non-local hosts launch through
@@ -105,6 +134,9 @@ def run_job(
     if ft:
         mca = dict(mca or {})
         mca.setdefault("ft_detector_enable", "1")
+    if respawn and not ft:
+        raise SystemExit("tpurun: --respawn requires --ft (a non-FT job "
+                         "kills the world on first failure)")
     rank_host: list[str] | None = None
     if hosts:
         from .rmaps import map_ranks, render_map
@@ -124,6 +156,26 @@ def run_job(
     server = KVSServer(host=kvs_host or "127.0.0.1")
     procs: list[subprocess.Popen] = []
     threads: list[threading.Thread] = []
+    #: per-rank (cmd, env, target host) for the --respawn restart leg
+    launch_specs: list[tuple[list[str], dict[str, str], str | None]] = []
+
+    def spawn_rank(rank: int, cmd: list[str], env: dict,
+                   target: str | None) -> subprocess.Popen:
+        """One rank's process + stdio-forward thread (shared by first
+        launch and the --respawn restart leg)."""
+        p = subprocess.Popen(
+            _final_cmd(launch_agent, cmd, env, target),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        t = threading.Thread(
+            target=_forward, args=(p.stdout, str(rank), sys.stdout.buffer),
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+        return p
     # workers must find the framework regardless of script location
     # (≈ mpirun's LD_LIBRARY_PATH forwarding for libmpi)
     import ompi_tpu
@@ -167,33 +219,19 @@ def run_job(
                 # lookup instead of the file we just stat'ed
                 cmd = [os.path.abspath(first)] + argv[1:]
             target = rank_host[rank] if rank_host else None
-            if target is not None and not _is_local_host(target):
-                # plm/rsh: reproduce the worker env on the remote host
-                keys = sorted(
-                    k for k in env
-                    if k.startswith(("OMPI_TPU_", "OMPI_MCA_"))
-                    or k in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
-                )
-                cmd = _remote_cmd(launch_agent, target, env, keys, cmd)
-            p = subprocess.Popen(
-                cmd,
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-            )
-            procs.append(p)
-            t = threading.Thread(
-                target=_forward, args=(p.stdout, str(rank), sys.stdout.buffer), daemon=True
-            )
-            t.start()
-            threads.append(t)
+            # plm/rsh: _final_cmd reproduces the worker env on the
+            # remote host (and is re-evaluated on every respawn)
+            launch_specs.append((cmd, env, target))
+            procs.append(spawn_rank(rank, cmd, env, target))
 
         # job state machine: poll ALL children so a failure anywhere
         # kills the job even while other ranks block (errmgr default);
         # under --ft, deaths are survivable events the workers' ULFM
-        # machinery handles, so only record them
+        # machinery handles (and under --respawn, the rank is reborn —
+        # the PRRTE restart-the-failed-proc leg)
         exit_code = 0
         live = set(range(np_))
+        incarnations = [0] * np_
         import time as _time
 
         while live:
@@ -202,6 +240,21 @@ def run_job(
                 if rc is None:
                     continue
                 live.discard(i)
+                if (ft and respawn and rc != 0
+                        and incarnations[i] < max_respawns):
+                    # restart leg: same rank, same env, bumped
+                    # incarnation — the reborn proc replays the boot
+                    # rendezvous and re-publishes its endpoint
+                    incarnations[i] += 1
+                    cmd_i, env_i, target_i = launch_specs[i]
+                    env_i = dict(env_i)
+                    env_i[ENV_INCARNATION] = str(incarnations[i])
+                    print(f"[tpurun] rank {i} died (rc={rc}); "
+                          f"respawning (incarnation {incarnations[i]})",
+                          flush=True)
+                    procs[i] = spawn_rank(i, cmd_i, env_i, target_i)
+                    live.add(i)
+                    continue
                 if rc != 0 and exit_code == 0 and not ft:
                     exit_code = rc
                     for q in procs:
@@ -240,6 +293,16 @@ def main(argv: list[str] | None = None) -> int:
         "--ft", action="store_true",
         help="fault-tolerant job: worker death does not kill the job; "
         "heartbeat failure detection + ULFM recovery in the workers",
+    )
+    parser.add_argument(
+        "--respawn", action="store_true",
+        help="with --ft: relaunch a dead worker with the same rank and "
+        "a bumped incarnation (the PRRTE restart leg); survivors' "
+        "replace() restores the communicator to full size",
+    )
+    parser.add_argument(
+        "--max-respawns", type=int, default=2,
+        help="respawn budget per rank (default 2)",
     )
     parser.add_argument(
         "--host", default=None, metavar="H1[:S],H2[:S],...",
@@ -309,7 +372,8 @@ def main(argv: list[str] | None = None) -> int:
                    ft=ns.ft, hosts=hosts, map_by=ns.map_by,
                    launch_agent=ns.launch_agent,
                    oversubscribe=ns.oversubscribe,
-                   display_map=ns.display_map, kvs_host=ns.kvs_host)
+                   display_map=ns.display_map, kvs_host=ns.kvs_host,
+                   respawn=ns.respawn, max_respawns=ns.max_respawns)
 
 
 if __name__ == "__main__":

@@ -410,8 +410,19 @@ ROBUSTNESS_VARS = (
      "expiry flight-records the transport counters first"),
     ("dcn", "", "connect_timeout", 30.0, "float",
      "Deadline for (re)dialing a peer, spanning every exponential-"
-     "backoff attempt; control frames (heartbeats) always fail fast "
-     "so in-band detection stays prompt"),
+     "backoff attempt (both planes: the Python transports and the "
+     "native C dialer via tdcn_set_connect_timeout); control frames "
+     "(heartbeats) always fail fast so in-band detection stays prompt"),
+    ("dcn", "", "anysrc_timeout", 0.0, "float",
+     "Opt-in (default 0 = unbounded, plain MPI blocking semantics): "
+     "seconds an ANY_SOURCE receive blocks before escalating to a "
+     "communicator-wide liveness check — a failed member raises "
+     "MPIProcFailedPendingError, an all-alive membership re-arms the "
+     "wait"),
+    ("ft", "", "respawn_timeout", 60.0, "float",
+     "Seconds replace() waits for a failed rank's respawned "
+     "incarnation to re-publish its endpoint (tpurun --respawn) "
+     "before giving up on restoration"),
     ("faultsim", "", "enable", False, "bool",
      "Arm the deterministic fault-injection plane (default off — "
      "every transport hook is one boolean test when disabled)"),

@@ -154,6 +154,29 @@ class DcnCollEngine:
         ``_recv`` calls naming it raise instead of timing out."""
         self._failed_procs.add(proc)
 
+    def note_proc_recovered(self, proc: int) -> None:
+        """The replace() leg of elastic recovery: a respawned
+        incarnation of ROOT proc ``proc`` re-published its endpoint —
+        clear the failure marks (engine set + gossiping detector) so
+        traffic naming it flows again, and count the restoration on
+        the ``respawns`` telemetry counter."""
+        self._failed_procs.discard(proc)
+        det = self._detector
+        if det is not None:
+            det.clear_failed(proc)
+        self._bump_stat("respawns")
+
+    def _bump_stat(self, name: str) -> None:
+        """Increment a Python-plane robustness counter on whatever
+        stats surface this engine exports (transport dict here; the
+        native engine overrides onto its _py_stats merge)."""
+        tr = self.transport
+        st = getattr(tr, "stats", None)
+        if st is None:  # bml multiplexer: account on the tcp leg
+            st = getattr(getattr(tr, "tcp", None), "stats", None)
+        if st is not None:
+            st[name] = st.get(name, 0) + 1
+
     def proc_failed(self, local_proc: int) -> bool:
         return local_proc in self._failed_procs
 

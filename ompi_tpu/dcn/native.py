@@ -138,7 +138,13 @@ def load_library():
         lib.tdcn_fault_set.argtypes = [U64, U64, I64]
         lib.tdcn_fault_events.restype = U64
         lib.tdcn_fault_events.argtypes = []
+        lib.tdcn_fault_set_conn.argtypes = [I64]
+        lib.tdcn_fault_set_recv.argtypes = [U64, U64]
+        lib.tdcn_chan_kill.argtypes = [P, U64]
+        lib.tdcn_kill_peer.argtypes = [P, S]
+        lib.tdcn_clear_failed.argtypes = [P, I]
         lib.tdcn_set_ring_timeout.argtypes = [P, D]
+        lib.tdcn_set_connect_timeout.argtypes = [P, D]
         lib.tdcn_free.argtypes = [ctypes.c_void_p]
         lib.tdcn_close.argtypes = [P]
         lib.tdcn_chan_open.restype = U64
@@ -492,18 +498,31 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._py_stats: dict[str, int] = {"deadline_expired": 0}
         # forward the unified ring deadline (dcn_ring_timeout) to the
         # C writer: a dead consumer's frozen tail must surface as a
-        # send error, never an unbounded reserve() spin
+        # send error, never an unbounded reserve() spin — and the
+        # connect deadline (dcn_connect_timeout) to the C dialer, so
+        # the redial+backoff round heals a restarting peer instead of
+        # escalating a single failed connect() to MPIProcFailedError
         from ompi_tpu.core.var import dcn_timeout
 
         self._lib.tdcn_set_ring_timeout(self._h, float(dcn_timeout("ring")))
+        self._lib.tdcn_set_connect_timeout(
+            self._h, float(dcn_timeout("connect")))
         from ompi_tpu import metrics as _metrics
 
         _metrics.register_provider(self, self.stats_snapshot)
         if _fsim._enabled:
-            # arm the C ring-write fault hook from the seeded plan
+            # arm the C fault hooks from the seeded plan: the ring
+            # writer, the tcp-send connkill site, and the blocking-
+            # receive delay site (native pml + C-ABI shim recv)
             stall_ns, every, fail_at = _fsim.native_ring_args()
             if stall_ns or fail_at >= 0:
                 self._lib.tdcn_fault_set(stall_ns, every, fail_at)
+            conn_at = _fsim.native_conn_args()
+            if conn_at >= 0:
+                self._lib.tdcn_fault_set_conn(conn_at)
+            recv_ns, recv_every = _fsim.native_recv_args()
+            if recv_ns:
+                self._lib.tdcn_fault_set_recv(recv_ns, recv_every)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="tdcn-dispatch")
         self._dispatcher.start()
@@ -573,6 +592,20 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
 
     def chan_send(self, chan: int, kind: int, src: int, dst: int,
                   tag: int, arr: np.ndarray) -> None:
+        if _fsim._enabled:
+            # pml fast-path injection site (ROADMAP item c): the same
+            # seeded "send" schedule the record path consumes; connkill
+            # severs the channel's cached socket so the C redial round
+            # is exercised from the fast path too
+            for act in _fsim.actions("send",
+                                     kinds={"drop", "delay", "connkill"}):
+                if act.kind == "delay":
+                    _fsim.apply_delay(act)
+                elif act.kind == "drop":
+                    return  # lost on the wire; the receiver's deadline
+                    # escalation is the recovery path
+                elif act.kind == "connkill":
+                    self._lib.tdcn_chan_kill(self._h, chan)
         if _metrics._enabled:
             _metrics.observe_size("dcn_p2p_send", arr.nbytes)
             from ompi_tpu.metrics import flight as _flight
@@ -704,6 +737,18 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
     def note_proc_failed(self, proc: int) -> None:
         self._failed_procs.add(proc)
         self._lib.tdcn_note_failed(self._h, proc)
+
+    def note_proc_recovered(self, proc: int) -> None:
+        """replace(): a respawned incarnation re-published its endpoint
+        — clear the C failure mark (blocked recvs naming it resume
+        waiting instead of raising) and the rx dedup watermark (the
+        reborn sender restarts its seq), then the shared Python-side
+        recovery (detector clear + respawn accounting)."""
+        self._lib.tdcn_clear_failed(self._h, proc)
+        super().note_proc_recovered(proc)
+
+    def _bump_stat(self, name: str) -> None:
+        self._py_stats[name] = self._py_stats.get(name, 0) + 1
 
     def close(self) -> None:
         if not self._running:

@@ -39,6 +39,15 @@ detector — never a bare RuntimeError, never a hang.  Heartbeat/gossip
 control frames bypass retry and backoff so in-band failure detection
 stays prompt.  The :mod:`ompi_tpu.faultsim` plane hooks the frame
 send/recv, dial, and ring choke points (one boolean test when off).
+
+**Exactly-once across reconnects**: every data message carries a
+per-peer sequence number (``sa``/``xs`` envelope fields) its retry —
+and any injected wire duplicate — reuses; receivers keep a per-sender
+watermark + out-of-order window and drop repeats (``dedup_drops``).
+Each (re)dial runs a HELLO → SEQACK handshake advertising the
+delivered watermark, so the resend round skips messages the peer
+already confirmed instead of relying on (cid, seq) tolerance
+downstream.
 """
 
 from __future__ import annotations
@@ -60,7 +69,7 @@ from ompi_tpu.trace import core as _trace
 #: raw length is 64-bit — protocol v2.
 _HDR = struct.Struct("!BIIQ")
 
-_EAGER, _RTS, _CTS, _FRAG, _SHMF = 0, 1, 2, 3, 4
+_EAGER, _RTS, _CTS, _FRAG, _SHMF, _HELLO, _SEQACK = 0, 1, 2, 3, 4, 5, 6
 
 #: failure-detector control traffic: exempt from send retry/backoff
 #: (in-band detection must fail fast) and from fault injection (the
@@ -138,15 +147,20 @@ class _Peer:
     down a freshly redialed socket — and rendezvous state from a dead
     epoch is never resumed (the retry restarts from RTS; the receiver
     discarded the orphaned half-transfer via ``_abandon`` when the old
-    inbound connection died)."""
+    inbound connection died).  ``last_ack`` is the peer's delivered
+    watermark learned from the connection handshake (HELLO → SEQACK):
+    every message seq <= last_ack was delivered, so the reconnect
+    resend round skips confirmed messages instead of re-shipping
+    them."""
 
-    __slots__ = ("address", "sock", "lock", "epoch")
+    __slots__ = ("address", "sock", "lock", "epoch", "last_ack")
 
     def __init__(self, address: str):
         self.address = address
         self.sock: socket.socket | None = None
         self.lock = threading.Lock()
         self.epoch = 0
+        self.last_ack = 0
 
 
 class TcpTransport:
@@ -178,8 +192,20 @@ class TcpTransport:
             "cts_waits": 0, "cts_wait_ns": 0, "stall_ns": 0,
             "delivered": 0,
             "reconnects": 0, "retry_dials": 0, "retry_sends": 0,
-            "deadline_expired": 0,
+            "deadline_expired": 0, "dedup_drops": 0, "respawns": 0,
         }
+        #: exactly-once machinery: per-peer outbound message seq (one
+        #: logical message = one seq, shared by the retry round and any
+        #: injected wire duplicate) and per-sender-identity inbound
+        #: seen-state [contiguous watermark, out-of-order tail] — a
+        #: second arrival of any seq is dropped (``dedup_drops``).
+        #: State is keyed by transport ADDRESS, so it survives
+        #: reconnects (the whole point) and naturally resets when a
+        #: respawned incarnation publishes a fresh endpoint.
+        self._tx_seqs: dict[str, int] = {}
+        self._tx_lock = threading.Lock()
+        self._rx_seen: dict[str, list] = {}
+        self._rx_lock = threading.Lock()
         from ompi_tpu.metrics import core as _mcore
 
         _mcore.register_provider(self, self._stats_snapshot)
@@ -239,9 +265,73 @@ class TcpTransport:
     def _recv_shm(self, env: dict, meta: bytes, rlen: int) -> np.ndarray:
         raise KeyError("SHMF frame on a transport without shared memory")
 
+    # -- exactly-once seq machinery -------------------------------------
+
+    def _next_xseq(self, address: str) -> int:
+        with self._tx_lock:
+            s = self._tx_seqs.get(address, 0) + 1
+            self._tx_seqs[address] = s
+            return s
+
+    def _seen_dup(self, sa: str, xs: int) -> bool:
+        """Record one inbound (sender, seq) observation; True when it
+        was already observed (duplicate — drop it).  The watermark
+        advances while the tail is contiguous, so memory stays O(out-
+        of-order window), not O(messages)."""
+        with self._rx_lock:
+            st = self._rx_seen.get(sa)
+            if st is None:
+                st = self._rx_seen[sa] = [0, set()]
+            if xs <= st[0] or xs in st[1]:
+                return True
+            st[1].add(xs)
+            while st[0] + 1 in st[1]:
+                st[0] += 1
+                st[1].discard(st[0])
+            return False
+
+    def _rx_watermark(self, sa: str) -> int:
+        """Contiguous delivered watermark for a sender identity — what
+        the SEQACK handshake reply advertises."""
+        with self._rx_lock:
+            st = self._rx_seen.get(sa)
+            return st[0] if st is not None else 0
+
+    def _hello(self, sock: socket.socket, timeout: float = 5.0) -> int:
+        """Connection handshake (sender side): announce our transport
+        identity, read back the peer's delivered watermark.  Runs once
+        per dial, before the socket is published — so a reconnect's
+        resend round knows exactly which in-doubt message the peer
+        already has.  Failures count as dial failures (the backoff
+        loop retries); the caller bounds ``timeout`` by the remaining
+        connect budget so a wedged accept cannot eat the deadline."""
+        env = json.dumps({"sa": self.address}).encode()
+        sock.settimeout(max(0.2, timeout))
+        try:
+            sock.sendall(_HDR.pack(_HELLO, len(env), 0, 0) + env)
+            ftype, elen, _mlen, _rlen = _HDR.unpack(
+                _recv_exact(sock, _HDR.size))
+            if ftype != _SEQACK:
+                raise ConnectionError(
+                    f"dcn handshake: expected SEQACK, got frame {ftype}")
+            renv = (json.loads(_recv_exact(sock, elen).decode())
+                    if elen else {})
+            return int(renv.get("ack", 0))
+        finally:
+            sock.settimeout(None)
+
     def _deliver(self, env: dict, payload: np.ndarray) -> None:
         import sys
 
+        # exactly-once filter: data frames carry the sender identity +
+        # per-peer seq; a second arrival (reconnect resend, injected
+        # wire dup) is dropped HERE — one choke point for every frame
+        # class (eager, shm ring, completed rendezvous)
+        sa = env.pop("sa", None)
+        xs = env.pop("xs", None)
+        if sa is not None and xs is not None and self._seen_dup(sa, int(xs)):
+            self.stats["dedup_drops"] += 1
+            return
         self.stats["delivered"] += 1
         try:
             self._handler(env, payload)
@@ -284,6 +374,21 @@ class TcpTransport:
                             _recv_into(conn, memoryview(arr).cast("B"))
                         if not drop_in:
                             self._deliver(env, arr)
+                        elif "sa" in env and "xs" in env:
+                            # injected inbound loss: consume the seq so
+                            # the dedup watermark doesn't stall on the
+                            # deliberately-lost frame
+                            self._seen_dup(env["sa"], int(env["xs"]))
+                    elif ftype == _HELLO:
+                        # reconnect handshake: advertise the delivered
+                        # watermark for this sender identity on the
+                        # same socket (the dialer blocks reading it
+                        # before publishing the connection)
+                        renv = json.dumps(
+                            {"ack": self._rx_watermark(env.get("sa", ""))}
+                        ).encode()
+                        conn.sendall(
+                            _HDR.pack(_SEQACK, len(renv), 0, 0) + renv)
                     elif ftype == _SHMF:
                         self._deliver(env, self._recv_shm(env, meta, rlen))
                     elif ftype == _RTS:
@@ -400,24 +505,54 @@ class TcpTransport:
             if pr is None:
                 pr = _Peer(address)
                 self._peers[address] = pr
-        with pr.lock:
+        # control traffic (retry=False: heartbeats/gossip) must not
+        # QUEUE behind a data sender holding pr.lock across a redial-
+        # backoff + handshake round — the single detector thread
+        # blocked here would stop heartbeating EVERY peer for up to
+        # the connect deadline, and the other ranks would mark THIS
+        # rank dead.  Fail fast instead: a dropped control frame costs
+        # nothing (heartbeats repeat, gossip is redundant), and the
+        # detector's strike rules absorb it.
+        if retry:
+            pr.lock.acquire()
+        elif not pr.lock.acquire(blocking=False):
+            raise ConnectionError(
+                f"dcn ctrl send: peer {address} busy (dial/redial in "
+                "progress); control traffic fails fast")
+        try:
             if pr.sock is None:
                 reconnect = pr.epoch > 0
                 t0 = _trace.now() if _trace._enabled else 0
-                pr.sock = self._dial_backoff(address, retry=retry)
+                pr.sock, ack = self._dial_backoff(address, retry=retry)
+                if ack is not None:
+                    # a control dial (retry=False) skips the handshake;
+                    # the prior epoch's ack stays — acks are monotone
+                    # per receiver, so a stale value is a safe lower
+                    # bound for the resend-skip decision
+                    pr.last_ack = ack
                 pr.epoch += 1
                 if reconnect:
                     self.stats["reconnects"] += 1
                     if _trace._enabled:
                         _trace.complete("dcn", "reconnect", t0,
-                                        peer=address, epoch=pr.epoch)
+                                        peer=address, epoch=pr.epoch,
+                                        ack=pr.last_ack)
+        finally:
+            pr.lock.release()
         return pr
 
-    def _dial_backoff(self, address: str, retry: bool = True) -> socket.socket:
+    def _dial_backoff(
+        self, address: str, retry: bool = True
+    ) -> tuple[socket.socket, int | None]:
         """Dial under the shared connect deadline: exponential backoff
         with jitter between attempts (``retry=False`` — heartbeat/
         gossip traffic — fails on the first refusal so in-band
-        detection stays prompt)."""
+        detection stays prompt).  Data dials run the HELLO → SEQACK
+        handshake and return (socket, peer's delivered watermark); a
+        handshake failure counts as a dial failure.  Control dials
+        skip the handshake round-trip entirely (its blocking read
+        would stall the detector against a wedged peer) and return
+        (socket, None)."""
         import random
 
         from ompi_tpu.core.var import Deadline
@@ -427,7 +562,18 @@ class TcpTransport:
         attempts = 0
         while True:
             try:
-                return self._connect(address)
+                sock = self._connect(address)
+                if not retry:
+                    return sock, None
+                try:
+                    return sock, self._hello(
+                        sock, timeout=min(5.0, max(dl.remaining(), 0.5)))
+                except OSError:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise
             except OSError as e:
                 attempts += 1
                 if not retry or not self._running:
@@ -556,9 +702,28 @@ class TcpTransport:
                         self._kill_peer(address)
                 elif act.kind == "connkill":
                     self._kill_peer(address)
+        xseq = None
+        if not ctrl:
+            # one logical message = one seq: the retry round and any
+            # injected duplicate reuse it, so the receiver's filter
+            # sees a dup for what it is.  Assigned AFTER the fault
+            # actions — a sender-side drop must not burn a seq (the
+            # receiver's watermark would stall on the gap forever).
+            xseq = self._next_xseq(address)
+            envelope = dict(envelope)
+            envelope["sa"] = self.address
+            envelope["xs"] = xseq
         last: Exception | None = None
         for attempt in (0, 1):
             try:
+                if attempt and xseq is not None:
+                    # the redial handshake told us the peer's delivered
+                    # watermark: if it covers this message, the failed
+                    # attempt's bytes DID land — resending would only
+                    # feed the dedup filter
+                    pr = self._peer(address)
+                    if pr.last_ack >= xseq:
+                        return
                 self._send_once(address, envelope, arr,
                                 trunc=trunc and attempt == 0,
                                 retry_dial=not ctrl)

@@ -235,14 +235,17 @@ def disable() -> None:
     global _enabled, _plan
     _enabled = False
     _plan = None
-    # the native ring knobs are process-wide C state armed at engine
-    # creation — disarm them too (only if the library is already
-    # loaded; never trigger a build from a teardown path)
+    # the native knobs (ring writer, tcp-send connkill, blocking-recv
+    # delay) are process-wide C state armed at engine creation — disarm
+    # them too (only if the library is already loaded; never trigger a
+    # build from a teardown path)
     try:
         from ompi_tpu.dcn import native as _native
 
         if _native._lib is not None:
             _native._lib.tdcn_fault_set(0, 1, -1)
+            _native._lib.tdcn_fault_set_conn(-1)
+            _native._lib.tdcn_fault_set_recv(0, 1)
     except Exception:  # noqa: BLE001 — teardown must not raise
         pass
 
@@ -313,6 +316,41 @@ def native_ring_args() -> tuple[int, int, int]:
         elif r.kind == "ringfail" and r.at is not None:
             fail_at = r.at
     return stall_ns, every, fail_at
+
+
+def native_conn_args() -> int:
+    """``connkill_at`` for ``tdcn_fault_set_conn`` — how the seeded
+    plan reaches the C tcp-send path (the native twin of the Python
+    transport's connkill site).  The C side keeps its own send-event
+    counter, so only ``at`` rules map; -1 = disarmed.  Like the ring
+    knobs, C-plane hits count only in the engine's merged
+    ``dcn_injected_faults``, not the per-kind Python counters."""
+    plan = _plan
+    if plan is None:
+        return -1
+    for r in plan.rules:
+        if r.kind == "connkill" and r.at is not None:
+            return r.at
+    return -1
+
+
+def native_recv_args() -> tuple[int, int]:
+    """(delay_ns, every) for ``tdcn_fault_set_recv`` — injected latency
+    at the C blocking-receive entry (``tdcn_precv``: the native pml
+    fast path AND the C-ABI shim's MPI_Recv).  Only periodic
+    (``every``) or unconditional ``delay;site=recv`` rules map — the
+    C side counts events itself, so ``p=``/``at=`` triggers cannot be
+    honored there and are skipped rather than silently widened to
+    every receive; the first matching rule wins (no mixing of one
+    rule's delay with another's period)."""
+    plan = _plan
+    if plan is None:
+        return 0, 1
+    for r in plan.rules:
+        if (r.kind == "delay" and r.site == "recv" and r.ms > 0
+                and r.at is None and not r.p):
+            return int(r.ms * 1e6), (r.every or 1)
+    return 0, 1
 
 
 def counters() -> dict[str, int]:

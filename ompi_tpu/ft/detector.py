@@ -39,12 +39,18 @@ from ompi_tpu.core.registry import Component, register_component
 class HeartbeatDetector:
     """Per-process failure detector over the DCN engine's peer set."""
 
-    def __init__(self, engine, period: float = 0.25, timeout: float = 2.0):
+    def __init__(self, engine, period: float = 0.25, timeout: float = 2.0,
+                 grace: float = 0.0):
+        """``grace`` extends the FIRST detection window: a respawned
+        worker boots while survivors may not resume heartbeating to it
+        until their replace() clears its failed mark — without the
+        grace its fresh detector would declare every silent survivor
+        dead within one plain timeout and poison the rejoin."""
         self.engine = engine
         self.period = float(period)
         self.timeout = float(timeout)
         self._peers = [p for p in range(engine.nprocs) if p != engine.proc]
-        now = time.monotonic()
+        now = time.monotonic() + max(0.0, float(grace))
         self._last = {p: now for p in self._peers}
         #: consecutive in-band send failures per peer; the second
         #: strike marks (the first may be a transient the transport's
@@ -86,6 +92,19 @@ class HeartbeatDetector:
     def failed(self) -> set[int]:
         with self._lock:
             return set(self._failed)
+
+    def clear_failed(self, proc: int) -> None:
+        """Elastic recovery (replace()): the failed proc respawned with
+        a new incarnation — un-mark it, restart its liveness clock, and
+        zero its strike count so heartbeats resume on the next period.
+        The engine's address table must already point at the reborn
+        incarnation's endpoint (the caller's job), or the resumed
+        heartbeats would re-detect the corpse."""
+        with self._lock:
+            self._failed.discard(proc)
+            if proc in self._last:
+                self._last[proc] = time.monotonic()
+                self._strikes[proc] = 0
 
     def mark_failed(self, proc: int, gossip: bool = True) -> None:
         """Declare ``proc`` dead (timeout, in-band error, or gossip)."""

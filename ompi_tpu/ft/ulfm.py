@@ -18,6 +18,10 @@ Semantics preserved:
 * ``shrink()`` builds a fresh communicator over the live ranks — on
   TPU this is the mesh-shrink path: the new comm's CommMesh spans the
   surviving devices, the group renumbers contiguously;
+* ``replace()`` is the second recovery leg (≈ PRRTE restarting the
+  failed proc): under ``tpurun --ft --respawn`` the launcher respawns
+  the dead rank and replace() rebuilds the communicator at FULL
+  size — multi-process comms only (see :mod:`ompi_tpu.api.multiproc`);
 * ``agree(flags)`` is the ftagree fault-tolerant agreement: bitwise
   AND over live ranks' contributions, deciding consistently even with
   failed participants (the reference's early-returning consensus);
@@ -172,6 +176,24 @@ def shrink(comm, name: str = ""):
                                  failed=tuple(sorted(dead)))
     sub = comm._shrink_to(live, name or f"{comm.name}.shrunk")
     return sub
+
+
+def replace(comm, name: str = ""):
+    """Shrink's second leg — the PRRTE restart-the-failed-proc path:
+    rebuild the communicator at FULL size after ``tpurun --respawn``
+    relaunched the dead rank(s).  Survivors install each reborn
+    incarnation's re-published endpoint, clear its failure marks, and
+    run a CID-agreement round the fresh-booted process joins; the
+    result spans the complete original membership (the job returns to
+    full strength instead of contracting).  Single-controller comms
+    have no launcher to respawn ranks — multi-process only."""
+    fn = getattr(comm, "replace", None)
+    if fn is None:
+        raise MPIProcFailedError(
+            "replace() needs a multi-process communicator under "
+            "tpurun --ft --respawn (single-controller comms have no "
+            "launcher to restart a rank); use shrink()")
+    return fn(name)
 
 
 def agree(comm, flags: int, contributions: dict[int, int] | None = None) -> int:

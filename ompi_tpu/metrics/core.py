@@ -67,6 +67,9 @@ NATIVE_COUNTERS = (
     # transport self-healing activity and ULFM-grade escalations
     "reconnects", "retry_dials", "retry_sends", "deadline_expired",
     "injected_faults",
+    # elastic-recovery tail: duplicates dropped by the exactly-once
+    # rx seq filter, and peers restored by replace() after a respawn
+    "dedup_drops", "respawns",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

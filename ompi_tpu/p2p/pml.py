@@ -145,6 +145,11 @@ class RecvRequest(Request):
             check()
             if dl.expired():
                 escalate(timeout)
+                # escalate returning (not raising) means it chose to
+                # keep waiting — the ANY_SOURCE liveness guard with
+                # every member alive; re-arm so the wait does not
+                # degenerate into a 1 ms busy spin on an expired clock
+                dl = Deadline(timeout)
 
     def _finalize(self) -> Any:
         return self._payload

@@ -112,6 +112,9 @@ class NativeRecvRequest(Request):
                     check()
                     if dl.expired():
                         escalate(_timeout)
+                        # escalate returning = keep waiting (anysrc
+                        # liveness guard, all members alive): re-arm
+                        dl = Deadline(_timeout)
 
     def _finalize(self):
         return self._msg
@@ -205,7 +208,8 @@ class NativeMatchingEngine:
         return Status(int(msg.src), int(msg.tag), count, nbytes)
 
     def recv_blocking(self, dest: int, source: int, tag: int,
-                      fail_proc: int = -1, remote: bool = False):
+                      fail_proc: int = -1, remote: bool = False,
+                      guard=None):
         """Blocking receive in ONE C crossing (match-or-post + sleep on
         the request condvar): the fast path under MPI_Recv.  Returns
         (payload, Status); raises on engine close or watched-proc
@@ -213,7 +217,10 @@ class NativeMatchingEngine:
         comm layer's verdict), escalates after the shared
         ``dcn_recv_timeout`` deadline instead of re-arming the C wait
         forever.  ANY_SOURCE and local sources keep plain MPI blocking
-        semantics: there is no dead transport to escalate."""
+        semantics: there is no dead transport to escalate — unless the
+        comm layer armed ``guard`` (the opt-in ``dcn_anysrc_timeout``
+        triple): then expiry runs the guard's communicator-wide
+        liveness check and RE-ARMS when every member is alive."""
         from ompi_tpu.dcn.native import _tls, _tls_msg, _wrap_payload
 
         self._check_rank(dest)
@@ -224,10 +231,16 @@ class NativeMatchingEngine:
         root = self._root
         msg = _tls_msg()
         dl = None
+        anysrc_guard = None
         if remote and source != ANY_SOURCE:
             from ompi_tpu.core.var import Deadline
 
             dl = Deadline.for_timeout("recv")
+        elif guard is not None and source == ANY_SOURCE:
+            from ompi_tpu.core.var import Deadline
+
+            anysrc_guard = guard
+            dl = Deadline(guard[0])
         while True:
             rc = root._lib.tdcn_precv(
                 root._h, self._cid_b, dest, source, tag, fail_proc,
@@ -245,6 +258,14 @@ class NativeMatchingEngine:
 
                 raise MPIInternalError(f"native recv failed (rc={rc})")
             if dl is not None and dl.expired():
+                if anysrc_guard is not None:
+                    from ompi_tpu.core.var import Deadline
+
+                    _t, g_check, g_escalate = anysrc_guard
+                    g_check()
+                    g_escalate(_t)
+                    dl = Deadline(_t)  # all alive: re-arm the wait
+                    continue
                 root._escalate_deadline(
                     "p2p_recv", dl.seconds,
                     f"recv deadline (dcn_recv_timeout={dl.seconds}s) "

@@ -533,3 +533,259 @@ def test_tpurun_np2_chaos_soak_deterministic(tmp_path):
             if line.strip():
                 reasons.add(json.loads(line)["reason"])
     assert "fault_injected" in reasons, reasons
+
+
+# -- elastic recovery plane (respawn / redial / exactly-once) ----------
+
+
+def test_recovery_vars_registered_and_resolved():
+    from ompi_tpu.core.var import full_var_name
+
+    names = {full_var_name(fw, c, n) for fw, c, n, *_ in ROBUSTNESS_VARS}
+    assert "dcn_anysrc_timeout" in names
+    assert "ft_respawn_timeout" in names
+    # defaults: ANY_SOURCE stays unbounded (plain MPI) unless opted in
+    assert dcn_timeout("anysrc") == 0.0
+    store = VarStore(cmdline={"dcn_anysrc_timeout": "2.5",
+                              "ft_respawn_timeout": "11"})
+    register_robustness_vars(store)
+    assert store.get("dcn_anysrc_timeout") == 2.5
+    assert store.get("ft_respawn_timeout") == 11.0
+
+
+def test_seq_dedup_watermark_unit():
+    from ompi_tpu.dcn.tcp import TcpTransport
+
+    tr = TcpTransport(lambda e, a: None)
+    try:
+        assert not tr._seen_dup("x", 1)
+        assert tr._seen_dup("x", 1)          # exact replay
+        assert not tr._seen_dup("x", 3)      # out-of-order arrival
+        assert not tr._seen_dup("x", 2)      # gap fill
+        assert tr._rx_watermark("x") == 3    # watermark advanced
+        assert tr._seen_dup("x", 2) and tr._seen_dup("x", 3)
+        assert tr._rx_watermark("y") == 0    # identities are isolated
+    finally:
+        tr.close()
+
+
+def test_seq_dedup_exactly_once_under_dup_injection():
+    """The dup-injection contract: every injected wire duplicate is
+    dropped by the receiver's seq filter (dedup_drops == injected dup
+    count) and every payload is delivered exactly once."""
+    import numpy as np
+
+    from ompi_tpu.dcn.tcp import TcpTransport
+
+    fsim.configure("dup:p=0.5", seed=3, proc=0)
+    got: list[int] = []
+    rx = TcpTransport(lambda env, arr: got.append(env["tag"]))
+    tx = TcpTransport(lambda env, arr: None)
+    try:
+        for tag in range(40):
+            tx.send(rx.address, {"tag": tag}, np.arange(16.0))
+        deadline = time.time() + 20
+        while len(got) < 40 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)  # a stray duplicate would land here
+        assert sorted(got) == list(range(40)), sorted(got)
+        dups = fsim.injected("dup")
+        assert dups > 0
+        assert rx.stats["dedup_drops"] == dups, (rx.stats, dups)
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_reconnect_handshake_advertises_watermark():
+    """A redialed connection's HELLO -> SEQACK handshake carries the
+    receiver's delivered watermark back to the sender (the resend
+    round's skip-if-delivered signal)."""
+    import numpy as np
+
+    from ompi_tpu.dcn.tcp import TcpTransport
+
+    got: list[int] = []
+    rx = TcpTransport(lambda env, arr: got.append(env["tag"]))
+    tx = TcpTransport(lambda env, arr: None)
+    try:
+        for tag in range(5):
+            tx.send(rx.address, {"tag": tag}, np.arange(4.0))
+        deadline = time.time() + 10
+        while len(got) < 5 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 5
+        # force a reconnect; the fresh handshake must learn ack == 5
+        tx._kill_peer(rx.address)
+        tx.send(rx.address, {"tag": 99}, np.arange(4.0))
+        pr = tx._peer(rx.address)
+        assert pr.last_ack >= 5, pr.last_ack
+        assert tx.stats["reconnects"] >= 1
+    finally:
+        tx.close()
+        rx.close()
+
+
+def _native_tcp_pair():
+    """Engine pair forced onto the framed-TCP leg (distinct host ids
+    — same-host peers would ride the shm rings, which have no dial)."""
+    import os
+
+    native = _native()
+    os.environ["TDCN_HOST_ID"] = "redial-host-a"
+    try:
+        a = native.NativeDcnEngine(0, 2)
+        os.environ["TDCN_HOST_ID"] = "redial-host-b"
+        b = native.NativeDcnEngine(1, 2)
+    finally:
+        os.environ.pop("TDCN_HOST_ID", None)
+    addrs = [a.address, b.address]
+    a.set_addresses(addrs)
+    b.set_addresses(addrs)
+    return a, b, addrs
+
+
+def test_native_redial_heals_killed_connection():
+    """ROADMAP item b: an injected connkill on the C plane with a LIVE
+    peer heals via the native redial+backoff round — reconnects and
+    retry_sends increment, and NO MPIProcFailedError escapes."""
+    import numpy as np
+
+    a, b, addrs = _native_tcp_pair()
+    try:
+        x = np.arange(8, dtype=np.float64)
+        a._send(1, "rd", 0, x)
+        _env, got = b._recv_full(0, "rd", 0, timeout=30)
+        assert np.array_equal(got, x)
+        a._lib.tdcn_kill_peer(a._h, addrs[1].encode())
+        a._send(1, "rd", 1, x * 2)  # must heal, not raise
+        _env, got = b._recv_full(0, "rd", 1, timeout=30)
+        assert np.array_equal(got, x * 2)
+        s = a.stats_snapshot()
+        assert s["reconnects"] >= 1, s
+        assert s["retry_sends"] >= 1, s
+        assert not a.proc_failed(1)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_native_connkill_knob_heals_from_plan():
+    """The seeded plan's connkill:at=N maps onto the C send path via
+    tdcn_fault_set_conn (native_conn_args) and the damage self-heals."""
+    import numpy as np
+
+    assert fsim.native_conn_args() == -1  # unarmed default
+    fsim.configure("connkill:at=2,delay:ms=1;site=recv", seed=9, proc=0)
+    assert fsim.native_conn_args() == 2
+    assert fsim.native_recv_args() == (1_000_000, 1)
+    a, b, _addrs = _native_tcp_pair()
+    lib = a._lib
+    try:
+        lib.tdcn_fault_set_conn(2)
+        x = np.arange(4, dtype=np.float64)
+        for seq in range(4):  # event 2 finds its socket severed
+            a._send(1, "ck", seq, x + seq)
+        for seq in range(4):
+            _env, got = b._recv_full(0, "ck", seq, timeout=30)
+            assert np.array_equal(got, x + seq)
+        s = a.stats_snapshot()
+        assert s["injected_faults"] >= 1, s
+        assert s["reconnects"] >= 1, s
+        assert not a.proc_failed(1)
+    finally:
+        lib.tdcn_fault_set_conn(-1)
+        a.close()
+        b.close()
+
+
+def test_native_recv_delay_knob():
+    """ROADMAP item c (second half): injected latency at the blocking-
+    receive entry (tdcn_precv — the native pml fast path and the C-ABI
+    shim's MPI_Recv both ride it)."""
+    import ctypes
+
+    import numpy as np
+
+    native = _native()
+    lib = native.load_library()
+    a = native.NativeDcnEngine(0, 1)
+    a.set_addresses([a.address])
+    try:
+        a.local_send("rv", 0, 0, 1, np.arange(3.0), 3, 24)
+        lib.tdcn_fault_set_recv(30_000_000, 1)  # 30 ms, every receive
+        msg = native.TdcnMsg()
+        t0 = time.monotonic()
+        rc = lib.tdcn_precv(a._h, b"rv", 0, -1, -1, -1, 10.0,
+                            ctypes.byref(msg))
+        dt = time.monotonic() - t0
+        assert rc == 0 and msg.tag == 1
+        if msg.data:
+            lib.tdcn_free(msg.data)
+        assert dt >= 0.025, dt
+        assert a.stats_snapshot()["injected_faults"] >= 1
+    finally:
+        lib.tdcn_fault_set_recv(0, 1)
+        a.close()
+
+
+def test_native_chan_send_fault_hook():
+    """ROADMAP item c (first half): the pml channel fast path consults
+    the seeded send-site schedule — a drop rule loses the message, the
+    next event flows (and the off state is one module-bool test)."""
+    import ctypes
+
+    import numpy as np
+
+    native = _native()
+    a = native.NativeDcnEngine(0, 2)
+    b = native.NativeDcnEngine(1, 2)
+    addrs = [a.address, b.address]
+    a.set_addresses(addrs)
+    b.set_addresses(addrs)
+    ch = a.chan_open(addrs[1], "9")
+    try:
+        fsim.configure("drop:at=1", seed=1, proc=0)
+        a.chan_send(ch, native.FK_P2P, 0, 1, 5, np.arange(4.0))  # lost
+        a.chan_send(ch, native.FK_P2P, 0, 1, 6, np.arange(4.0))
+        msg = native.TdcnMsg()
+        rc = b._lib.tdcn_precv(b._h, b"9", 1, -1, -1, -1, 15.0,
+                               ctypes.byref(msg))
+        assert rc == 0 and msg.tag == 6, (rc, msg.tag)
+        if msg.data:
+            b._lib.tdcn_free(msg.data)
+        assert fsim.injected("drop") == 1
+        # nothing else pending: the dropped tag-5 message never arrived
+        assert b._lib.tdcn_pending(b._h, b"9", 1, 0) == 0
+    finally:
+        a.chan_close(ch)
+        a.close()
+        b.close()
+
+
+def test_native_clear_failed_reopens_traffic():
+    """tdcn_clear_failed un-marks a proc (replace()'s C-plane leg): a
+    previously-failed peer's frames complete receives again."""
+    import numpy as np
+
+    native = _native()
+    a = native.NativeDcnEngine(0, 2)
+    b = native.NativeDcnEngine(1, 2)
+    addrs = [a.address, b.address]
+    a.set_addresses(addrs)
+    b.set_addresses(addrs)
+    try:
+        b.note_proc_failed(0)
+        assert b.proc_failed(0)
+        with pytest.raises(MPIProcFailedError):
+            b._recv_full(0, "cf2", 0, timeout=5)
+        b.note_proc_recovered(0)
+        assert not b.proc_failed(0)
+        x = np.arange(4, dtype=np.float64)
+        a._send(1, "cf2", 1, x)
+        _env, got = b._recv_full(0, "cf2", 1, timeout=30)
+        assert np.array_equal(got, x)
+        assert b.stats_snapshot()["respawns"] == 1
+    finally:
+        a.close()
+        b.close()

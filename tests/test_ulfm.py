@@ -197,3 +197,93 @@ def test_shrink_of_subcomm(world):
     assert list(sub.group.ranks) == [1, 5, 7]
     out = np.asarray(sub.allreduce(np.ones((3, 2), np.float32)))
     np.testing.assert_array_equal(out[0], np.full(2, 3, np.float32))
+
+
+# -- elastic recovery: replace() / rank respawn ------------------------
+
+
+def test_replace_requires_multiproc(comm):
+    """Single-controller comms have no launcher to respawn a rank:
+    ulfm.replace must refuse with the recovery-class error."""
+    with pytest.raises(MPIProcFailedError):
+        ulfm.replace(comm)
+
+
+def test_anysrc_guard_liveness():
+    """dcn_anysrc_timeout (opt-in): the guard triple re-arms while
+    every member is alive and escalates MPIProcFailedPendingError —
+    naming the dead ranks — once the membership has a failure."""
+    import types
+
+    from ompi_tpu.api.multiproc import MultiProcComm
+    from ompi_tpu.core import mca
+
+    comm = object.__new__(MultiProcComm)
+    comm.nprocs, comm.proc, comm.name = 2, 0, "guard_test"
+    comm._ft = None
+    comm.proc_sizes = [1, 1]
+    comm.offsets = [0, 1, 2]
+    failed: set[int] = set()
+    comm.dcn = types.SimpleNamespace(proc_failed=lambda p: p in failed)
+    store = mca.default_context().store
+    # default off: ANY_SOURCE keeps plain unbounded blocking semantics
+    assert comm._anysrc_guard() is None
+    store.set("dcn_anysrc_timeout", 1.5)
+    try:
+        g = comm._anysrc_guard()
+        assert g is not None and g[0] == 1.5
+        g[1]()      # check: no FT state, nothing to raise
+        g[2](1.5)   # escalate with every member alive: re-arm (returns)
+        failed.add(1)
+        with pytest.raises(MPIProcFailedPendingError) as ei:
+            g[2](1.5)
+        assert ei.value.failed == (1,)
+    finally:
+        store.set("dcn_anysrc_timeout", 0.0)
+
+
+def test_tpurun_respawn_replace_full_size():
+    """The restart leg end-to-end (np=2, tpurun --ft --respawn): rank 1
+    SIGKILLs itself mid-collective, the launcher respawns it with a
+    bumped incarnation, the survivor's revoke -> replace() installs the
+    reborn endpoint and clears the failure marks, the reborn rank
+    rejoins via replace() after init, and BOTH ranks finish a full
+    post-recovery phase on the restored size-2 communicator with exact
+    results."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    worker = repo / "tests" / "workers" / "mp_respawn_worker.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:" + env.get("PYTHONPATH", "")
+    env["RESPAWN_OPS"] = "6"
+    env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "2", "--ft",
+           "--respawn", "--cpu-devices", "1",
+           "--mca", "btl", "tcp",
+           "--mca", "dcn_recv_timeout", "8",
+           "--mca", "dcn_cts_timeout", "8",
+           "--mca", "dcn_connect_timeout", "4",
+           str(worker)]
+    res = subprocess.run(cmd, capture_output=True, timeout=240,
+                         cwd=str(repo), env=env)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "respawning (incarnation 1)" in out
+    tallies = sorted(
+        (json.loads(line.split("RESPAWN_TALLY ", 1)[1])
+         for line in out.splitlines() if "RESPAWN_TALLY" in line),
+        key=lambda t: t["proc"])
+    assert len(tallies) == 2, out
+    # full size restored, post-recovery phase completed everywhere
+    assert all(t["size"] == 2 and t["post"] == t["ops"]
+               for t in tallies), tallies
+    # the reborn incarnation rejoined (not a shrink-around)
+    assert any(t["incarnation"] == 1 and t["recovered"]
+               for t in tallies), tallies
+    # the survivor accounted the restoration
+    assert sum(t["respawns"] for t in tallies) >= 1, tallies

@@ -89,6 +89,7 @@ tally = {
     "retry_dials": int(st.get("retry_dials", 0)),
     "retry_sends": int(st.get("retry_sends", 0)),
     "deadline_expired": int(st.get("deadline_expired", 0)),
+    "dedup_drops": int(st.get("dedup_drops", 0)),
 }
 print("CHAOS_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
 

@@ -1,0 +1,101 @@
+"""tpurun --ft --respawn soak worker: rank death mid-job, full-size
+recovery (launched by test_ulfm.py and tools/chaos.py --respawn).
+
+Scenario (SPMD, deterministic):
+
+* phase 1: every rank runs ``RESPAWN_OPS`` allreduces; rank
+  ``RESPAWN_VICTIM`` SIGKILLs itself before op ``RESPAWN_KILL_AT`` on
+  its FIRST incarnation (the external-kill analog, mid-collective for
+  the survivors);
+* survivors catch ``MPIProcFailedError``, ``revoke()`` the world, and
+  call ``replace()`` — which awaits the launcher's respawn, installs
+  the reborn endpoint, clears the failure marks, and rebuilds the
+  communicator at FULL size;
+* the reborn incarnation sees ``world.respawned`` and calls
+  ``replace()`` right after init, joining the survivors' rendezvous;
+* phase 2: everyone runs ``RESPAWN_OPS`` more allreduces on the
+  replaced comm and asserts the results are exact at the restored
+  size — the golden check that the job really is back to full
+  strength, not shrunk.
+
+One ``RESPAWN_TALLY <json>`` line per surviving process (the victim's
+first incarnation dies tally-less by design): incarnation, phase
+completions, the replaced comm's size, the ``respawns`` transport
+counter, and the per-kind injected-fault counts (for the --runs N
+same-seed determinism diff when a fault plan is armed).
+"""
+
+import json
+import os
+import signal
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu import faultsim
+from ompi_tpu.core.errors import MPIProcFailedError, MPIRevokedError
+from ompi_tpu.op import SUM
+
+OPS = int(os.environ.get("RESPAWN_OPS", "8"))
+KILL_AT = int(os.environ.get("RESPAWN_KILL_AT", "4"))
+VICTIM = int(os.environ.get("RESPAWN_VICTIM", "1"))
+
+world = api.init()
+p, n = world.proc, world.size
+incarnation = world.procctx.incarnation
+assert world.local_size == 1, world.local_size
+
+comm = world
+completed = 0
+recovered = False
+if world.respawned:
+    # reborn leg: rejoin the survivors' rendezvous before any traffic
+    comm = world.replace()
+    recovered = True
+else:
+    try:
+        for i in range(OPS):
+            if p == VICTIM and incarnation == 0 and i == KILL_AT:
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+            out = comm.allreduce(np.full((1, 4), i + 1.0), SUM)
+            assert np.allclose(np.asarray(out), n * (i + 1.0)), out
+            completed = i + 1
+    except (MPIProcFailedError, MPIRevokedError) as e:
+        print(f"[respawn] proc {p} caught {type(e).__name__} after "
+              f"{completed} ops: {e}", file=sys.stderr, flush=True)
+        comm.revoke()
+        comm = comm.replace()
+        recovered = True
+
+# phase 2: the restored FULL-size membership must produce exact results
+post = 0
+for i in range(OPS):
+    out = comm.allreduce(np.full((1, 4), 100.0 + i), SUM)
+    assert np.allclose(np.asarray(out), comm.size * (100.0 + i)), out
+    post = i + 1
+
+st = getattr(getattr(world.dcn, "transport", None), "stats", None) or {}
+tally = {
+    "proc": p,
+    "incarnation": incarnation,
+    "completed": completed,
+    "post": post,
+    "ops": OPS,
+    "recovered": recovered,
+    "size": comm.size,
+    "respawns": int(st.get("respawns", 0)),
+    "dedup_drops": int(st.get("dedup_drops", 0)),
+    "reconnects": int(st.get("reconnects", 0)),
+    "injected": faultsim.counters() if faultsim.enabled() else {},
+}
+print("RESPAWN_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
+
+api.finalize()
+print(f"OK respawn proc={p} incarnation={incarnation}", flush=True)

@@ -38,8 +38,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 WORKER = os.path.join(REPO, "tests", "workers", "mp_chaos_worker.py")
+RESPAWN_WORKER = os.path.join(REPO, "tests", "workers",
+                              "mp_respawn_worker.py")
 
 DEFAULT_PLAN = "delay:ms=2;p=0.3,dup:p=0.15,connkill:at=9,drop:p=0.05"
+#: --respawn soak default: latency-only — rank death comes from the
+#: worker's deterministic self-kill, and a loss-free plan keeps the
+#: injected-event schedule identical across runs (the determinism diff)
+DEFAULT_RESPAWN_PLAN = "delay:ms=1;p=0.25"
 
 
 def run_soak(np_: int, seed: int, plan: str, ops: int, out: str | None,
@@ -101,19 +107,22 @@ def render(tallies: list[dict]) -> None:
     kinds = sorted({k for t in tallies for k in t["injected"]})
     print(f"{'rank':<6}{'outcome':<22}{'ops':>5}"
           + "".join(f"{k:>10}" for k in kinds)
-          + f"{'reconn':>8}{'redial':>8}{'resend':>8}{'deadl':>7}")
+          + f"{'reconn':>8}{'redial':>8}{'resend':>8}{'deadl':>7}"
+          f"{'dedup':>7}")
     for t in tallies:
         outcome = t["escalated"] or "survived"
         print(f"{t['proc']:<6}{outcome:<22}"
               f"{t['completed']:>2}/{t['ops']:<2}"
               + "".join(f"{t['injected'].get(k, 0):>10}" for k in kinds)
               + f"{t['reconnects']:>8}{t['retry_dials']:>8}"
-              f"{t['retry_sends']:>8}{t['deadline_expired']:>7}")
+              f"{t['retry_sends']:>8}{t['deadline_expired']:>7}"
+              f"{t.get('dedup_drops', 0):>7}")
     injected = sum(sum(t["injected"].values()) for t in tallies)
     survived = sum(1 for t in tallies if not t["escalated"])
     escalated = len(tallies) - survived
     print(f"totals: injected={injected} survived={survived} "
-          f"escalated={escalated}")
+          f"escalated={escalated} "
+          f"dedup_drops={sum(t.get('dedup_drops', 0) for t in tallies)}")
 
 
 def join_outputs(out: str) -> None:
@@ -144,6 +153,81 @@ def join_outputs(out: str) -> None:
                      if ev.get("name") == "reconnect")
     if spans:
         print(f"trace: {spans} reconnect span(s) recorded")
+
+
+def run_respawn_soak(np_: int, seed: int, plan: str, ops: int,
+                     extra_mca: list[str], timeout: float) -> list[dict]:
+    """One ``tpurun --ft --respawn`` soak: a worker SIGKILLs itself
+    mid-collective, the launcher respawns it, survivors' ``replace()``
+    restores full membership, and every rank must finish the
+    post-recovery phase at the ORIGINAL size with exact results."""
+    mca = {
+        "btl": "tcp",
+        "dcn_recv_timeout": "8",
+        "dcn_cts_timeout": "8",
+        "dcn_connect_timeout": "4",
+    }
+    if plan:
+        mca.update({"faultsim_enable": "1", "faultsim_seed": str(seed),
+                    "faultsim_plan": plan})
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        mca[k] = v
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--ft", "--respawn", "--cpu-devices", "1"]
+    for k, v in mca.items():
+        cmd += ["--mca", k, v]
+    cmd.append(RESPAWN_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env["RESPAWN_OPS"] = str(ops)
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    res = subprocess.run(cmd, capture_output=True, timeout=timeout,
+                         env=env, cwd=REPO)
+    out_text = res.stdout.decode(errors="replace")
+    if res.returncode != 0:
+        sys.stderr.write(out_text)
+        sys.stderr.write(res.stderr.decode(errors="replace"))
+        raise SystemExit(f"respawn soak failed (rc={res.returncode})")
+    tallies = []
+    for line in out_text.splitlines():
+        marker = "RESPAWN_TALLY "
+        if marker in line:
+            tallies.append(json.loads(line.split(marker, 1)[1]))
+    if len(tallies) != np_:
+        sys.stderr.write(out_text)
+        raise SystemExit(
+            f"expected {np_} RESPAWN_TALLY lines, got {len(tallies)}")
+    tallies.sort(key=lambda t: t["proc"])
+    # the contract: full size restored, every rank finished phase 2,
+    # at least one survivor accounted a restoration
+    bad = [t for t in tallies
+           if t["size"] != np_ or t["post"] != t["ops"]]
+    if bad:
+        raise SystemExit(f"respawn soak: incomplete recovery: {bad}")
+    if sum(t["respawns"] for t in tallies) < 1:
+        raise SystemExit(
+            f"respawn soak: no rank accounted respawns >= 1: {tallies}")
+    if not any(t["incarnation"] > 0 for t in tallies):
+        raise SystemExit(
+            f"respawn soak: no reborn incarnation completed: {tallies}")
+    print(f"respawn soak: np={np_} seed={seed} ops={ops} "
+          f"wall={time.time() - t0:.1f}s plan={plan!r}")
+    return tallies
+
+
+def render_respawn(tallies: list[dict]) -> None:
+    print(f"{'rank':<6}{'incarn':>7}{'phase1':>8}{'phase2':>8}"
+          f"{'size':>6}{'respawns':>9}{'dedup':>7}")
+    for t in tallies:
+        print(f"{t['proc']:<6}{t['incarnation']:>7}"
+              f"{t['completed']:>5}/{t['ops']:<2}"
+              f"{t['post']:>5}/{t['ops']:<2}"
+              f"{t['size']:>6}{t['respawns']:>9}{t['dedup_drops']:>7}")
+    print(f"totals: respawned={sum(t['respawns'] for t in tallies)} "
+          f"reborn={sum(1 for t in tallies if t['incarnation'] > 0)} "
+          f"full_size={all(t['size'] == len(tallies) for t in tallies)}")
 
 
 # -- selftest ----------------------------------------------------------
@@ -194,13 +278,63 @@ def selftest() -> int:
         rx.close()
         fsim.reset()
 
-    # 3. disabled path: hooks are a single module-bool test, no state
+    # 3. exactly-once delivery: injected wire duplicates must be
+    # dropped by the rx seq filter (dedup_drops) with every payload
+    # delivered exactly once — the golden comparison
+    fsim.configure("dup:p=0.5", seed=5, proc=0)
+    got2: list[int] = []
+    rx2 = TcpTransport(lambda env, arr: got2.append(env["tag"]))
+    tx2 = TcpTransport(lambda env, arr: None)
+    try:
+        for tag in range(32):
+            tx2.send(rx2.address, {"tag": tag}, np.arange(8.0))
+        deadline = time.time() + 20
+        while len(got2) < 32 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)  # any stray duplicate would land here
+        assert sorted(got2) == list(range(32)), (
+            f"duplicate or lost delivery: {sorted(got2)}")
+        dups = fsim.injected("dup")
+        assert dups > 0 and rx2.stats["dedup_drops"] == dups, (
+            rx2.stats["dedup_drops"], dups)
+    finally:
+        tx2.close()
+        rx2.close()
+        fsim.reset()
+
+    # 4. detector clear_failed — the replace() leg's detector contract
+    from ompi_tpu.ft.detector import HeartbeatDetector
+
+    class _Eng:
+        proc, nprocs = 0, 2
+
+        def attach_detector(self, d):
+            pass
+
+        def note_proc_failed(self, p):
+            pass
+
+        def send_ctrl(self, p, env):
+            pass
+
+    det = HeartbeatDetector(_Eng(), period=60.0, timeout=120.0)
+    try:
+        det.mark_failed(1, gossip=False)
+        assert det.failed() == {1}
+        det.clear_failed(1)
+        assert det.failed() == set() and det._strikes[1] == 0
+    finally:
+        det.close()
+
+    # 5. disabled path: hooks are a single module-bool test, no state
     assert not fsim.enabled() and fsim.actions("send") == ()
     assert sum(fsim.counters().values()) == 0
 
     print("selftest OK: plan grammar, seeded determinism (400-event "
           "streams), reconnect healing (8/8 delivered, "
-          f"{tx.stats['reconnects']} reconnect), disabled-path state")
+          f"{tx.stats['reconnects']} reconnect), exactly-once dedup "
+          f"(32/32 delivered, {dups} duplicates dropped), detector "
+          "clear_failed, disabled-path state")
     return 0
 
 
@@ -224,14 +358,26 @@ def main(argv: list[str] | None = None) -> int:
                     help="per-run hang deadline, seconds")
     ap.add_argument("--selftest", action="store_true",
                     help="in-process self-check (no tpurun)")
+    ap.add_argument("--respawn", action="store_true",
+                    help="elastic-recovery soak: a worker SIGKILLs "
+                    "itself mid-collective under tpurun --ft --respawn;"
+                    " the job must complete at FULL size (replace()) "
+                    "with respawns >= 1")
     ns = ap.parse_args(argv)
     if ns.selftest:
         return selftest()
     baseline = None
     for run in range(ns.runs):
-        tallies = run_soak(ns.np_, ns.seed, ns.plan, ns.ops,
-                           ns.out or None, ns.mca, ns.timeout)
-        render(tallies)
+        if ns.respawn:
+            plan = (DEFAULT_RESPAWN_PLAN if ns.plan == DEFAULT_PLAN
+                    else ns.plan)
+            tallies = run_respawn_soak(ns.np_, ns.seed, plan, ns.ops,
+                                       ns.mca, ns.timeout)
+            render_respawn(tallies)
+        else:
+            tallies = run_soak(ns.np_, ns.seed, ns.plan, ns.ops,
+                               ns.out or None, ns.mca, ns.timeout)
+            render(tallies)
         counts = [t["injected"] for t in tallies]
         if baseline is None:
             baseline = counts
@@ -242,7 +388,7 @@ def main(argv: list[str] | None = None) -> int:
         elif ns.runs > 1:
             print(f"run {run + 1}: injected-fault counts reproduce "
                   f"run 1 exactly (seed {ns.seed})")
-    if ns.out:
+    if ns.out and not ns.respawn:
         join_outputs(ns.out)
     return 0
 
