@@ -621,6 +621,9 @@ def main() -> None:
     dcn = detail.get("dcn")
     if isinstance(dcn, dict) and isinstance(dcn.get("native"), dict):
         detail["native_counters"] = dcn["native"].get("native_counters", {})
+        # per-op arrival-skew summary (collective straggler profiler):
+        # was a bandwidth row limited by one rank showing up late?
+        detail["arrival_skew"] = dcn["native"].get("arrival_skew", {})
     detail_path = REPO / "BENCH_DETAIL.json"
     detail_path.write_text(json.dumps(detail, indent=1))
 

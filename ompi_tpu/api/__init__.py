@@ -60,6 +60,12 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     from ompi_tpu import metrics as _metrics
 
     _metrics.sync_from_store(ctx.store)
+    # collective straggler profiler: armed with the metrics plane (or
+    # by telemetry_enable alone — the live endpoint's straggler table
+    # needs it even without a finalize export)
+    from ompi_tpu.metrics import straggler as _straggler
+
+    _straggler.sync_from_store(ctx.store)
     # fault injection (--mca faultsim_enable 1): armed before
     # ProcContext so engine bring-up (dials included) is already under
     # the plan; vars are centrally registered (core.var)
@@ -88,11 +94,48 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     from ompi_tpu.metrics import flight as _flight
 
     _flight.set_proc(int(getattr(_world, "proc", 0)))
+    # live telemetry: start this rank's frame pump when the launcher
+    # hosts an aggregator (tpurun sets OMPI_TPU_TELEMETRY_ADDR); a
+    # disabled run opens no socket and starts no thread
+    from ompi_tpu.metrics import live as _live
+
+    _live.start_publisher(_world, ctx.store)
+    # crash-path export: a rank that dies or aborts without reaching
+    # finalize still flushes its configured metrics/trace outputs
+    # (marked partial) — atexit covers aborts; the transports'
+    # escalation paths call export.crash_dump directly for deaths
+    # that bypass interpreter shutdown hooks
+    _register_crash_flush()
     _initialized = True
     output.verbose(1, "runtime", "MPI_Init complete: world size %d (%s)",
                    _world.size, type(_world).__name__)
     hooks.fire("mpi_init_bottom", world=_world)
     return _world
+
+
+_crash_flush_registered = False
+
+
+def _register_crash_flush() -> None:
+    """Register the atexit telemetry flush ONCE per interpreter: if
+    the process exits while still initialized (sys.exit mid-job, an
+    unhandled error, MPI_Abort-style teardown), the configured
+    metrics/trace outputs are written with ``partial: true`` instead
+    of vanishing with the rank.  A clean finalize leaves
+    ``_initialized`` False, making the hook a no-op."""
+    global _crash_flush_registered
+    if _crash_flush_registered:
+        return
+    _crash_flush_registered = True
+    import atexit
+
+    def _flush():
+        if _initialized:
+            from ompi_tpu.metrics import export as _mexport
+
+            _mexport.crash_dump("atexit")
+
+    atexit.register(_flush)
 
 
 def initialized() -> bool:
@@ -117,6 +160,14 @@ def finalize() -> None:
     from ompi_tpu.core import hooks
 
     hooks.fire("mpi_finalize_top", world=_world)
+    # live telemetry: stop the frame pump before teardown (it sends
+    # one final frame so the aggregator holds finalize-time counters)
+    try:
+        from ompi_tpu.metrics import live as _live
+
+        _live.stop_publisher()
+    except Exception:
+        pass  # telemetry must never break finalize
     # spawned children: wait them out + drain their output while the
     # interpreter is fully alive (atexit alone races thread teardown)
     from .spawn import _reap
@@ -169,5 +220,13 @@ def finalize() -> None:
         _self_comm.free()
         _self_comm = None
     _initialized = False
+    # a clean finalize wrote the real exports above — re-arm the
+    # crash-path latch so a later init/death cycle can flush again
+    try:
+        from ompi_tpu.metrics import export as _mexport
+
+        _mexport.reset_crash_latch()
+    except Exception:
+        pass
     mca.reset()
     hooks.fire("mpi_finalize_bottom")

@@ -32,6 +32,7 @@ from ompi_tpu.mesh.mesh import CommMesh
 from ompi_tpu.op.op import SUM, Op
 from ompi_tpu.p2p.part import PersistentP2PMixin
 from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG, MatchingEngine
+from ompi_tpu.metrics import straggler as _straggler
 from ompi_tpu.request import Request
 from ompi_tpu.trace import core as _trace
 from .comm import COLOR_UNDEFINED, _next_cid, _peek_cid, _reserve_cid_block
@@ -225,6 +226,12 @@ class MultiProcComm(PersistentP2PMixin):
 
             ulfm.check(self, collective=True)
         fn = self.coll.lookup(slot)
+        if _straggler._enabled:
+            # straggler profiler: wall-clock arrival/exit per call,
+            # keyed (comm, op, seq) like the trace merge key — the
+            # cross-rank join that names who showed up late.  Sits
+            # INSIDE the trace wrap so both see the same interval.
+            fn = _straggler.wrap_call(slot, fn, comm=self.name)
         if _trace._enabled:
             # api-layer span with the (comm, op, seq) merge key — the
             # per-(comm, op) issue counter is identical on every
@@ -750,6 +757,9 @@ class MultiProcComm(PersistentP2PMixin):
                 "process in rank order; use shrink() instead")
         timeout = self._respawn_timeout()
         t0 = _trace.now() if _trace._enabled else 0
+        import time as _time
+
+        tw0 = _time.monotonic()
         if not ctx.rejoined:
             cid = self._replace_rejoin(timeout)
         else:
@@ -768,6 +778,15 @@ class MultiProcComm(PersistentP2PMixin):
         if _trace._enabled:
             _trace.complete("ft", "replace", t0, comm=self.name,
                             cid=int(cid))
+        # recovery observability: the restoration's end-to-end heal
+        # latency, flight-recorded (→ telemetry event) on every
+        # participant — no-op unless metrics are enabled
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record(
+            "replace", comm=self.name, cid=int(cid),
+            incarnation=int(ctx.incarnation),
+            heal_ms=round((_time.monotonic() - tw0) * 1e3, 3))
         return sub
 
     def _respawn_timeout(self) -> float:
@@ -828,7 +847,17 @@ class MultiProcComm(PersistentP2PMixin):
         root.note_proc_recovered(p)
         from ompi_tpu.metrics import flight as _flight
 
-        _flight.record("respawn", proc=int(p), incarnation=int(inc))
+        # the delivered-seq watermark for the CORPSE's identity (the
+        # reborn endpoint starts a fresh one) — recovery observability
+        wm = 0
+        wm_fn = getattr(root.transport, "_rx_watermark", None)
+        if wm_fn is not None:
+            try:
+                wm = int(wm_fn(addr))
+            except Exception:  # noqa: BLE001 — diagnostic only
+                wm = 0
+        _flight.record("respawn", proc=int(p), incarnation=int(inc),
+                       seq_watermark=wm)
         if _trace._enabled:
             _trace.instant("ft", "respawn", proc=int(p),
                            incarnation=int(inc))

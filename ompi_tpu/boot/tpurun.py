@@ -57,6 +57,15 @@ def _final_cmd(launch_agent: str, cmd: list[str], env: dict,
     return cmd
 
 
+def _truthy(v) -> bool:
+    """MCA-style bool for launcher-side flags — the workers' VarStore
+    accepts exactly this string set, so the launcher-side gate cannot
+    drift from the worker-side parse."""
+    from ompi_tpu.core.var import _TRUE_STRINGS
+
+    return str(v or "").strip().lower() in _TRUE_STRINGS
+
+
 #: host names the plm treats as THIS machine (fork instead of rsh)
 _LOCAL_NAMES = {"localhost", "127.0.0.1"}
 
@@ -154,6 +163,28 @@ def run_job(
                 "from the remote side; pass --kvs-host <routable address>"
             )
     server = KVSServer(host=kvs_host or "127.0.0.1")
+    # live telemetry plane (--mca telemetry_enable 1): the launcher
+    # hosts the aggregator — workers stream counter/straggler frames
+    # to its ingest socket (address via env) and anything can scrape
+    # the job MID-RUN at the printed HTTP endpoint (≈ mpirun hosting
+    # the PMIx server, extended with a Prometheus shop window)
+    telemetry = None
+    env_all = os.environ
+    if _truthy((mca or {}).get("telemetry_enable")
+               or env_all.get("OMPI_MCA_telemetry_enable")):
+        from ompi_tpu.metrics.live import TelemetryAggregator
+
+        telemetry = TelemetryAggregator(
+            http_port=int((mca or {}).get("telemetry_port")
+                          or env_all.get("OMPI_MCA_telemetry_port")
+                          or 0),
+            history=int((mca or {}).get("telemetry_history")
+                        or env_all.get("OMPI_MCA_telemetry_history")
+                        or 256),
+        )
+        print(f"[tpurun] telemetry: {telemetry.url}/metrics "
+              f"(json: {telemetry.url}/json, watch: python tools/top.py "
+              f"--url {telemetry.url})", flush=True)
     procs: list[subprocess.Popen] = []
     threads: list[threading.Thread] = []
     #: per-rank (cmd, env, target host) for the --respawn restart leg
@@ -190,6 +221,10 @@ def run_job(
             env[ENV_PROC] = str(rank)
             env[ENV_NPROCS] = str(np_)
             env[ENV_KVS] = server.address
+            if telemetry is not None:
+                from ompi_tpu.metrics.live import ENV_TELEMETRY
+
+                env[ENV_TELEMETRY] = telemetry.ingest_address
             for k, v in (mca or {}).items():
                 env[f"OMPI_MCA_{k}"] = v
             if cpu_devices is not None:
@@ -273,6 +308,8 @@ def run_job(
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        if telemetry is not None:
+            telemetry.close()
         server.close()
 
 

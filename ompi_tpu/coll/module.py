@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from ompi_tpu.core.errors import MPIInternalError
+from ompi_tpu.metrics import straggler as _straggler
 from ompi_tpu.tool import spc
 from ompi_tpu.trace import core as _trace
 
@@ -102,6 +103,10 @@ class CollTable:
                 f"no coll component provides {slot!r} on this communicator"
             )
         spc.inc(slot)  # SPC: per-collective call counters (§5(d))
+        if _straggler._enabled:
+            # dispatch-time note: which component serves this op (the
+            # live dashboard shows the algorithm behind a slow op)
+            _straggler.note_provider(slot, self.providers.get(slot, "?"))
         if _trace._enabled:
             # coll-layer span naming the winning component — nests
             # inside the caller's api-layer span on the timeline

@@ -379,6 +379,22 @@ OBSERVABILITY_VARS = (
     ("metrics", "", "flight_records", 64, "int",
      "Flight-recorder ring capacity: how many counter snapshots "
      "(timeouts, aborts, watermark crossings) are retained in memory"),
+    ("telemetry", "", "enable", False, "bool",
+     "Live telemetry plane: every rank streams periodic counter/"
+     "straggler frames to an aggregator in tpurun, which serves a "
+     "mid-job Prometheus scrape endpoint (/metrics), a JSON state "
+     "feed (/json — the tools/top.py input), and a JSONL history "
+     "ring (/history); implies the metrics hooks.  Default off — no "
+     "socket, no thread, no frames"),
+    ("telemetry", "", "port", 0, "int",
+     "HTTP port the tpurun aggregator serves scrapes on (0 = pick an "
+     "ephemeral port and print the URL at launch)"),
+    ("telemetry", "", "interval_ms", 500, "int",
+     "Milliseconds between a rank's telemetry frames (each frame is "
+     "one counter snapshot + the collectives completed since the "
+     "last frame)"),
+    ("telemetry", "", "history", 256, "int",
+     "Frames retained in the aggregator's /history JSONL ring"),
 )
 
 
@@ -432,7 +448,9 @@ ROBUSTNESS_VARS = (
     ("faultsim", "", "plan", "", "string",
      "Fault plan, e.g. 'drop:p=0.01,delay:ms=50,connkill:at=100,"
      "stall:ms=200' — comma-separated <kind>[:k=v[;k=v]] rules "
-     "(kinds: drop delay dup trunc connkill stall ringfail dialfail)"),
+     "(kinds: drop delay dup trunc connkill stall ringfail dialfail; "
+     "'proc=N' restricts a rule to one rank, e.g. "
+     "'delay:ms=30;site=recv;proc=1' slows only rank 1)"),
 )
 
 

@@ -103,6 +103,12 @@ class DcnCollEngine:
         # to its proc and marks it failed before the transport raises
         # MPIProcFailedError
         self.transport.on_peer_failed = self._transport_peer_failed
+        # the transports' handshake clock samples, mapped to procs —
+        # the cross-rank merge's skew correction (metrics snapshots
+        # and telemetry frames carry the merged view)
+        from ompi_tpu.metrics import core as _mcore
+
+        _mcore.register_clock_provider(self, self.clock_offsets)
 
     def set_addresses(self, addresses: Sequence[str]) -> None:
         if len(addresses) != self.nprocs:
@@ -191,17 +197,44 @@ class DcnCollEngine:
         native engines expose."""
         return local if 0 <= local < self.nprocs else -1
 
+    def _addr_proc(self, address: str) -> int | None:
+        """ROOT proc index owning a transport leg address (composite
+        bml addresses match on any leg); None = unmapped."""
+        root = self._root_engine()
+        for p, a in enumerate(root.addresses):
+            if a == address or (a.startswith("bml:")
+                                and address in a.split("|")):
+                return p
+        return None
+
+    def clock_offsets(self) -> dict[int, tuple[int, int]]:
+        """Per-peer clock-offset estimates (root-proc keyed) from the
+        transports' HELLO→SEQACK handshake samples — smallest-RTT
+        sample wins when both legs measured a peer."""
+        root = self._root_engine()
+        tr = root.transport
+        legs = ([tr] if hasattr(tr, "clock_offsets")
+                else [leg for leg in (getattr(tr, "tcp", None),
+                                      getattr(tr, "sm", None))
+                      if leg is not None])
+        out: dict[int, tuple[int, int]] = {}
+        for leg in legs:
+            for addr, (off, rtt) in dict(
+                    getattr(leg, "clock_offsets", None) or {}).items():
+                p = self._addr_proc(addr)
+                if p is None:
+                    continue
+                cur = out.get(p)
+                if cur is None or rtt < cur[1]:
+                    out[p] = (int(off), int(rtt))
+        return out
+
     def _transport_peer_failed(self, address: str) -> int | None:
         """Transport escalation callback: peer address → ROOT proc,
         marking it failed on the detector (gossiped, like an in-band
         BTL error under ULFM) or the engine's failure set."""
         root = self._root_engine()
-        proc = None
-        for p, a in enumerate(root.addresses):
-            if a == address or (a.startswith("bml:")
-                                and address in a.split("|")):
-                proc = p
-                break
+        proc = self._addr_proc(address)
         if proc is not None:
             det = root._detector
             if det is not None:
@@ -223,10 +256,15 @@ class DcnCollEngine:
         in the error; ``root_proc`` the detector-space index to mark
         (resolved via root_proc_of(failed_rank) when omitted)."""
         from ompi_tpu.core.errors import MPIProcFailedError
+        from ompi_tpu.metrics import export as _mexport
         from ompi_tpu.metrics import flight as _flight
 
         _flight.record("deadline_expired", site=site,
                        timeout_s=float(timeout), **detail)
+        # crash-path export: a deadline escalation often precedes the
+        # rank aborting — flush configured telemetry now (once-latch),
+        # marked partial; a later clean finalize overwrites it
+        _mexport.crash_dump(f"deadline_{site}")
         root = self._root_engine()
         tr = root.transport
         st = getattr(tr, "stats", None)

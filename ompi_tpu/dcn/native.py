@@ -306,9 +306,13 @@ class _NativeOpsMixin:
         if rc != -1:  # addressing/shape misuse, not a transport fault
             raise MPIInternalError(
                 f"native dcn {what} to proc {dst} failed (rc={rc})")
+        from ompi_tpu.metrics import export as _mexport
         from ompi_tpu.metrics import flight as _flight
 
         _flight.record("peer_escalation", proc=int(dst), what=what)
+        # crash-path export (once-latch): the native plane's escalation
+        # must leave telemetry files behind like the Python plane's
+        _mexport.crash_dump("peer_escalation")
         rp = self.root_proc_of(dst)
         if rp is not None and rp >= 0:
             det = root._detector

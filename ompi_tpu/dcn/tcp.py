@@ -82,6 +82,19 @@ FRAG_SIZE = 8 << 20
 MAX_RNDV = 4
 
 
+def _clock_sample(t0: int, rt, t1: int) -> tuple[int | None, int]:
+    """One NTP-style clock sample from a handshake round trip: we sent
+    at ``t0``, the peer stamped its reply ``rt``, we received at
+    ``t1`` (all wall-clock ns).  Returns ``(offset_ns, rtt_ns)`` where
+    offset = peer_clock − my_clock (assuming a symmetric path — the
+    estimate's error is bounded by rtt/2), or ``(None, rtt)`` when the
+    peer predates the timestamped handshake."""
+    rtt = max(0, int(t1) - int(t0))
+    if rt is None:
+        return None, rtt
+    return int(rt) - (int(t0) + int(t1)) // 2, rtt
+
+
 def _meta_bytes(arr: np.ndarray) -> bytes:
     return json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)}).encode()
 
@@ -206,6 +219,12 @@ class TcpTransport:
         self._tx_lock = threading.Lock()
         self._rx_seen: dict[str, list] = {}
         self._rx_lock = threading.Lock()
+        #: per-peer clock-offset estimate from the HELLO→SEQACK
+        #: handshake: address → (offset_ns, rtt_ns) where offset =
+        #: peer_clock − my_clock (NTP single-sample).  Refreshed on
+        #: every (re)dial; the cross-rank trace/metrics merge uses it
+        #: so span alignment survives host clock skew.
+        self.clock_offsets: dict[str, tuple[int, int]] = {}
         from ompi_tpu.metrics import core as _mcore
 
         _mcore.register_provider(self, self._stats_snapshot)
@@ -297,15 +316,21 @@ class TcpTransport:
             st = self._rx_seen.get(sa)
             return st[0] if st is not None else 0
 
-    def _hello(self, sock: socket.socket, timeout: float = 5.0) -> int:
+    def _hello(self, sock: socket.socket,
+               timeout: float = 5.0) -> tuple[int, int | None, int]:
         """Connection handshake (sender side): announce our transport
-        identity, read back the peer's delivered watermark.  Runs once
-        per dial, before the socket is published — so a reconnect's
-        resend round knows exactly which in-doubt message the peer
-        already has.  Failures count as dial failures (the backoff
-        loop retries); the caller bounds ``timeout`` by the remaining
-        connect budget so a wedged accept cannot eat the deadline."""
-        env = json.dumps({"sa": self.address}).encode()
+        identity, read back the peer's delivered watermark — and take
+        one clock sample on the way (our send/receive times bracket
+        the peer's reply timestamp: the NTP single-sample offset the
+        cross-rank merge aligns timelines with).  Runs once per dial,
+        before the socket is published — so a reconnect's resend round
+        knows exactly which in-doubt message the peer already has.
+        Returns ``(ack, offset_ns | None, rtt_ns)``.  Failures count
+        as dial failures (the backoff loop retries); the caller bounds
+        ``timeout`` by the remaining connect budget so a wedged accept
+        cannot eat the deadline."""
+        t0 = time.time_ns()
+        env = json.dumps({"sa": self.address, "t0": t0}).encode()
         sock.settimeout(max(0.2, timeout))
         try:
             sock.sendall(_HDR.pack(_HELLO, len(env), 0, 0) + env)
@@ -316,7 +341,9 @@ class TcpTransport:
                     f"dcn handshake: expected SEQACK, got frame {ftype}")
             renv = (json.loads(_recv_exact(sock, elen).decode())
                     if elen else {})
-            return int(renv.get("ack", 0))
+            t1 = time.time_ns()
+            off, rtt = _clock_sample(t0, renv.get("rt"), t1)
+            return int(renv.get("ack", 0)), off, rtt
         finally:
             sock.settimeout(None)
 
@@ -383,9 +410,12 @@ class TcpTransport:
                         # reconnect handshake: advertise the delivered
                         # watermark for this sender identity on the
                         # same socket (the dialer blocks reading it
-                        # before publishing the connection)
+                        # before publishing the connection); "rt" is
+                        # the clock-offset sample the dialer brackets
+                        # between its t0/t1
                         renv = json.dumps(
-                            {"ack": self._rx_watermark(env.get("sa", ""))}
+                            {"ack": self._rx_watermark(env.get("sa", "")),
+                             "rt": time.time_ns()}
                         ).encode()
                         conn.sendall(
                             _HDR.pack(_SEQACK, len(renv), 0, 0) + renv)
@@ -523,6 +553,7 @@ class TcpTransport:
             if pr.sock is None:
                 reconnect = pr.epoch > 0
                 t0 = _trace.now() if _trace._enabled else 0
+                tw0 = time.monotonic()
                 pr.sock, ack = self._dial_backoff(address, retry=retry)
                 if ack is not None:
                     # a control dial (retry=False) skips the handshake;
@@ -537,6 +568,16 @@ class TcpTransport:
                         _trace.complete("dcn", "reconnect", t0,
                                         peer=address, epoch=pr.epoch,
                                         ack=pr.last_ack)
+                    # recovery observability: every redial leaves a
+                    # flight record (and thus a telemetry event) with
+                    # the new epoch, the confirmed seq watermark, and
+                    # the heal latency (no-op unless metrics are on)
+                    from ompi_tpu.metrics import flight as _flight
+
+                    _flight.record(
+                        "reconnect", peer=address, epoch=pr.epoch,
+                        ack_watermark=pr.last_ack,
+                        heal_ms=round((time.monotonic() - tw0) * 1e3, 3))
         finally:
             pr.lock.release()
         return pr
@@ -566,8 +607,11 @@ class TcpTransport:
                 if not retry:
                     return sock, None
                 try:
-                    return sock, self._hello(
+                    ack, off, rtt = self._hello(
                         sock, timeout=min(5.0, max(dl.remaining(), 0.5)))
+                    if off is not None:
+                        self.clock_offsets[address] = (off, rtt)
+                    return sock, ack
                 except OSError:
                     try:
                         sock.close()
@@ -621,9 +665,14 @@ class TcpTransport:
         notify the owning engine (which marks the peer failed on the
         detector / engine failure set), and raise MPIProcFailedError —
         never a bare RuntimeError, never a silent hang."""
+        from ompi_tpu.metrics import export as _mexport
         from ompi_tpu.metrics import flight as _flight
 
         _flight.record("peer_escalation", peer=address, cause=reason)
+        # crash-path export: the escalation usually precedes job death
+        # — flush configured telemetry now, marked partial (once-latch;
+        # a surviving rank's clean finalize overwrites it)
+        _mexport.crash_dump("peer_escalation")
         proc = None
         cb = self.on_peer_failed
         if cb is not None:

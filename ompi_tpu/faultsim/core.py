@@ -70,7 +70,9 @@ class Rule:
     """One parsed plan rule.  Exactly one trigger applies, checked in
     this order: ``at`` (1-based event index, one-shot), ``n`` (every
     event ≤ n — dialfail's "first n attempts"), ``every`` (periodic),
-    ``p`` (hashed probability), else unconditional."""
+    ``p`` (hashed probability), else unconditional.  ``proc`` (when
+    set) restricts the rule to that rank — the straggler-attribution
+    tests use it to slow exactly one rank deterministically."""
 
     kind: str
     site: str
@@ -79,6 +81,7 @@ class Rule:
     every: int | None = None
     n: int | None = None
     ms: float = 0.0
+    proc: int | None = None
 
     def hits(self, seed: int, proc: int, k: int, idx: int) -> bool:
         if self.at is not None:
@@ -134,7 +137,7 @@ def parse_plan(text: str) -> tuple[Rule, ...]:
             try:
                 if key == "p":
                     kw["p"] = float(val)
-                elif key in ("at", "every", "n"):
+                elif key in ("at", "every", "n", "proc"):
                     kw[key] = int(val)
                 elif key == "ms":
                     kw["ms"] = float(val)
@@ -187,6 +190,8 @@ class FaultPlan:
         for idx, r in rules:
             if kinds is not None and r.kind not in kinds:
                 continue
+            if r.proc is not None and r.proc != self.proc:
+                continue  # rank-targeted rule: other ranks never fire it
             if r.hits(self.seed, self.proc, k, idx):
                 with self._lock:
                     self.injected[r.kind] += 1
@@ -309,6 +314,8 @@ def native_ring_args() -> tuple[int, int, int]:
     if plan is None:
         return stall_ns, every, fail_at
     for r in plan.rules:
+        if r.proc is not None and r.proc != plan.proc:
+            continue
         if r.kind == "stall":
             stall_ns = int(r.ms * 1e6)
             if r.every:
@@ -329,6 +336,8 @@ def native_conn_args() -> int:
     if plan is None:
         return -1
     for r in plan.rules:
+        if r.proc is not None and r.proc != plan.proc:
+            continue
         if r.kind == "connkill" and r.at is not None:
             return r.at
     return -1
@@ -347,6 +356,8 @@ def native_recv_args() -> tuple[int, int]:
     if plan is None:
         return 0, 1
     for r in plan.rules:
+        if r.proc is not None and r.proc != plan.proc:
+            continue
         if (r.kind == "delay" and r.site == "recv" and r.ms > 0
                 and r.at is None and not r.p):
             return int(r.ms * 1e6), (r.every or 1)

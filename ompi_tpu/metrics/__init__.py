@@ -9,13 +9,21 @@ Four pieces:
   (``--mca metrics_output`` at finalize);
 * :mod:`.flight` — flight recorder: counter snapshots on
   request-timeout/abort and stall-watermark crossings;
+* :mod:`.straggler` — collective straggler profiler: per-rank
+  arrival/exit timestamps keyed ``(comm, op, seq)`` + the cross-rank
+  arrival-skew join;
+* :mod:`.live`   — the live telemetry plane: per-rank frame pump →
+  aggregator in ``tpurun`` serving a mid-job Prometheus scrape
+  endpoint, the ``tools/top.py`` JSON feed, and the straggler
+  attribution (``--mca telemetry_enable 1``);
 * MPI_T pvars (``dcn_stall_ns``, ``dcn_doorbells``, ``dcn_ring_hwm``,
-  per-op ``metrics_size_<op>_hist``) through
+  per-op ``metrics_size_<op>_hist`` and ``straggler_<op>_*``) through
   :mod:`ompi_tpu.tool.mpit`.
 
 Enable with ``--mca metrics_enable 1``; analyze with
 ``tools/metrics_report.py`` (``--correlate`` joins counter snapshots
-with PR-1 trace spans on the shared wall-clock timeline).
+with PR-1 trace spans on the shared wall-clock timeline) or watch a
+RUNNING job with ``tools/top.py`` over the live endpoint.
 """
 
 from .core import (  # noqa: F401

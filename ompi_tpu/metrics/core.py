@@ -91,6 +91,10 @@ _native_base: dict[str, int] = {}
 #: wall-clock anchor captured at enable: (time_ns, perf_counter_ns) —
 #: snapshot timestamps join the trace timeline on this base
 _epoch: tuple[int, int] = (0, 0)
+#: per-peer clock-offset providers (live engines): weakref → callable
+#: returning {root_proc: (offset_ns, rtt_ns)} — the HELLO→SEQACK
+#: handshake estimate the cross-rank merge aligns timelines with
+_clock_providers: list = []
 
 
 def enabled() -> bool:
@@ -206,6 +210,47 @@ def native_counters() -> dict[str, int]:
     return out
 
 
+def register_clock_provider(obj, fn: Callable[[], dict | None]) -> None:
+    """Register a clock-offset source (a live engine mapping peer
+    addresses to root procs).  Same weakref-anchored lifetime rules as
+    :func:`register_provider`."""
+    try:
+        wfn: Callable = weakref.WeakMethod(fn)  # type: ignore[assignment]
+    except TypeError:
+        wfn = (lambda f=fn: f)
+    with _lock:
+        _clock_providers.append((weakref.ref(obj), wfn))
+
+
+def clock_offsets() -> dict[int, tuple[int, int]]:
+    """Merged ``{root_proc: (offset_ns, rtt_ns)}`` across live engines
+    — offset is (peer_clock − my_clock), the NTP-style single-sample
+    estimate from the connection handshake; the smallest-RTT sample
+    wins when several transports measured the same peer."""
+    out: dict[int, tuple[int, int]] = {}
+    with _lock:
+        live = list(_clock_providers)
+    dead = False
+    for ref, wfn in live:
+        fn = wfn()
+        if ref() is None or fn is None:
+            dead = True
+            continue
+        try:
+            d = fn()
+        except Exception:  # provider torn down mid-read
+            continue
+        for p, (off, rtt) in (d or {}).items():
+            cur = out.get(int(p))
+            if cur is None or rtt < cur[1]:
+                out[int(p)] = (int(off), int(rtt))
+    if dead:
+        with _lock:
+            _clock_providers[:] = [(r, f) for r, f in _clock_providers
+                                   if r() is not None and f() is not None]
+    return out
+
+
 def native_value(name: str) -> int:
     """One counter, baseline-adjusted — the MPI_T pvar read."""
     raw = native_counters().get(name, 0)
@@ -289,10 +334,13 @@ def reset(full: bool = True) -> None:
         _native_base.clear()
         if full:
             _providers.clear()
+            _clock_providers.clear()
             _enabled = False
-    from ompi_tpu.metrics import flight
+    from ompi_tpu.metrics import flight, straggler
 
     flight.reset()
+    if full:
+        straggler.reset()
 
 
 # -- snapshots ---------------------------------------------------------
@@ -313,6 +361,15 @@ def snapshot(reason: str = "periodic", proc: int | None = None) -> dict:
 
     if _fsim._enabled:
         snap["faultsim"] = _fsim.counters()
+    from ompi_tpu.metrics import straggler as _straggler
+
+    if _straggler._enabled:
+        snap["straggler"] = _straggler.summary()
+    clock = clock_offsets()
+    if clock:
+        # {proc: [offset_ns, rtt_ns]} — the correlate/merge tools read
+        # this to align cross-rank timelines against host clock skew
+        snap["clock"] = {str(p): [o, r] for p, (o, r) in clock.items()}
     return snap
 
 
@@ -334,7 +391,10 @@ def register_vars(store) -> None:
 
 
 def sync_from_store(store) -> None:
-    enable(bool(store.get("metrics_enable", False)))
+    # telemetry_enable implies the metrics hooks: the live endpoint
+    # scrapes the same counters the finalize export writes
+    enable(bool(store.get("metrics_enable", False))
+           or bool(store.get("telemetry_enable", False)))
     from ompi_tpu.metrics import flight
 
     flight.configure(

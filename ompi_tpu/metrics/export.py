@@ -52,26 +52,39 @@ def _prom_hist(lines: list[str], name: str, labels: str, hist: list[int],
                      if labels else f"{name}_sum {total}")
 
 
+def dcn_kind(k: str) -> str:
+    """gauge-vs-counter classification for a ``dcn_<k>`` family —
+    ONE rule shared by the finalize ``.prom`` exporter and the live
+    endpoint, so both type a family identically (a family typed
+    counter on one and gauge on the other breaks ``rate()`` queries
+    spanning both; a gauge like a decreasing rndv_depth typed counter
+    would fabricate resets)."""
+    return "gauge" if k in _core.GAUGES or k.endswith("_hwm") else "counter"
+
+
+def dcn_family(lines: list[str], k: str, samples: list[tuple[str, int]],
+               origin: str = "Native", suffix: str = "") -> None:
+    """Append one ``{PREFIX}_dcn_<k>`` metric family: HELP/TYPE header
+    (each counter is its OWN family, so the TYPE line must name it —
+    the exposition-format contract promtool enforces) plus one sample
+    per ``(labels, value)`` row (``labels`` pre-rendered, may be '')."""
+    kind = dcn_kind(k)
+    lines.append(f"# HELP {PREFIX}_dcn_{k} {origin} DCN transport "
+                 f"{kind} {k}{suffix}")
+    lines.append(f"# TYPE {PREFIX}_dcn_{k} {kind}")
+    for labels, v in samples:
+        lines.append(f"{PREFIX}_dcn_{k}{labels} {int(v)}")
+
+
 def to_prometheus(snap: dict) -> str:
     """Render one snapshot as Prometheus text exposition format."""
     proc = snap.get("proc")
     plabel = f'proc="{proc}",' if proc is not None else ""
     lines: list[str] = []
-    # native transport counters: each is its OWN metric family, so the
-    # TYPE line must name it (the exposition-format contract promtool
-    # enforces); gauges/high-waters are typed gauge — rate() over a
-    # decreasing rndv_depth would fabricate counter resets
     for k, v in (snap.get("native") or {}).items():
-        gauge = k in _core.GAUGES or k.endswith("_hwm")
-        lines.append(f"# HELP {PREFIX}_dcn_{k} Native DCN transport "
-                     f"{'gauge' if gauge else 'counter'} {k} "
-                     "(libtpudcn TdcnStats block)")
-        lines.append(f"# TYPE {PREFIX}_dcn_{k} "
-                     f"{'gauge' if gauge else 'counter'}")
-        if plabel:
-            lines.append(f"{PREFIX}_dcn_{k}{{{plabel.rstrip(',')}}} {int(v)}")
-        else:
-            lines.append(f"{PREFIX}_dcn_{k} {int(v)}")
+        labels = f'{{{plabel.rstrip(",")}}}' if plabel else ""
+        dcn_family(lines, k, [(labels, int(v))],
+                   suffix=" (libtpudcn TdcnStats block)")
     # per-op size/latency histograms
     lines.append(f"# HELP {PREFIX}_op_size_bytes Per-op payload size "
                  "histogram (log2 buckets)")
@@ -91,6 +104,17 @@ def to_prometheus(snap: dict) -> str:
         _prom_hist(lines, f"{PREFIX}_op_latency_us", labels,
                    st["lat_hist"], _lat_bucket_edges_us(),
                    total=(st.get("total_ns", 0) + 999) // 1000)
+    # straggler profiler: per-op call/wait totals (the cross-rank skew
+    # attribution lives on the LIVE endpoint / merge tools — this is
+    # the rank-local leg)
+    strag = snap.get("straggler") or {}
+    if strag:
+        lines.append(f"# HELP {PREFIX}_coll_wait_ns_total In-collective "
+                     "wall time by op (arrival wait + wire)")
+        lines.append(f"# TYPE {PREFIX}_coll_wait_ns_total counter")
+        for op, st in strag.items():
+            lines.append(f'{PREFIX}_coll_wait_ns_total{{{plabel}op="{op}"'
+                         f'}} {int(st.get("wait_ns", 0))}')
     # SPC counters ride along (one scrape = the whole tool stack)
     spc = snap.get("spc") or {}
     if spc:
@@ -104,10 +128,17 @@ def to_prometheus(snap: dict) -> str:
     return "\n".join(lines)
 
 
-def write(path_base: str, proc: int = 0) -> list[str]:
+def write(path_base: str, proc: int = 0,
+          partial: bool = False) -> list[str]:
     """Export the final snapshot (+ accumulated flight records) for
-    one process.  Returns the paths written."""
-    snap = _core.snapshot(reason="finalize", proc=proc)
+    one process.  Returns the paths written.  ``partial=True`` marks a
+    crash-path dump (the rank died or aborted before finalize): the
+    snapshot carries ``"partial": true`` so report tools know the
+    counters stop mid-run rather than at a clean shutdown."""
+    snap = _core.snapshot(reason="crash" if partial else "finalize",
+                          proc=proc)
+    if partial:
+        snap["partial"] = True
     paths = []
     prom_path = f"{path_base}.{proc}.prom"
     with open(prom_path, "w") as f:
@@ -120,3 +151,51 @@ def write(path_base: str, proc: int = 0) -> list[str]:
         f.write(json.dumps(snap) + "\n")
     paths.append(jsonl_path)
     return paths
+
+
+#: crash-path once-latch: a dying rank flushes at most once — the
+#: escalation sites AND the atexit hook may both fire on one death
+_crashed = False
+
+
+def crash_dump(reason: str = "crash") -> list[str]:
+    """Crash-path export: flush whatever telemetry is configured RIGHT
+    NOW, marked ``partial: true`` — called from ULFM escalation paths
+    and the api-layer atexit hook so a dying or aborting rank still
+    leaves its metrics/trace files behind (a clean finalize later
+    simply overwrites them with the full export).  Never raises; no-op
+    when nothing is enabled, when no output path is configured, or on
+    a second call."""
+    global _crashed
+    if _crashed:
+        return []
+    paths: list[str] = []
+    try:
+        from ompi_tpu.core import mca
+
+        store = mca.default_context().store
+        import os
+
+        proc = int(os.environ.get("OMPI_TPU_PROC", "0"))
+        mout = store.get("metrics_output", "") if _core._enabled else ""
+        from ompi_tpu.trace import chrome as _tchrome, core as _tcore
+
+        tout = store.get("trace_output", "") if _tcore.enabled() else ""
+        if not mout and not tout:
+            return []  # nothing configured: do NOT burn the latch
+        _crashed = True
+        if mout:
+            _flight.record("crash_export", cause=reason)
+            paths += write(str(mout), proc=proc, partial=True)
+        if tout:
+            paths.append(_tchrome.dump(f"{tout}.{proc}.json", pid=proc,
+                                       partial=True))
+    except Exception:  # noqa: BLE001 — the dump rides failure paths
+        pass
+    return paths
+
+
+def reset_crash_latch() -> None:
+    """Test hook (and finalize): re-arm the crash-path once-latch."""
+    global _crashed
+    _crashed = False

@@ -1,0 +1,510 @@
+"""Live telemetry plane — mid-job observability, cluster-wide.
+
+PR 1 (trace) and PR 2 (metrics) export at finalize; a wedged or slow
+job is exactly the one you cannot inspect that way.  This module makes
+the telemetry observable *while the job runs*, the Prometheus/MPI_T-
+session shape:
+
+* every rank runs a :class:`TelemetryPublisher` thread that, each
+  ``telemetry_interval_ms``, snapshots its counters (native ``dcn_*``
+  via the PR-2 provider merge — ``tdcn_stats`` included — per-op
+  histogram aggregates, SPC, straggler records, clock offsets,
+  detector health) and ships ONE small JSON frame to the launcher.
+  Frames ride a dedicated control socket straight to ``tpurun`` —
+  never the DCN transports — so like heartbeat/gossip traffic they
+  are exempt from fault injection and cannot perturb the data plane;
+* ``tpurun`` hosts the :class:`TelemetryAggregator`: an ingest
+  socket (address handed to workers via ``OMPI_TPU_TELEMETRY_ADDR``)
+  plus an HTTP endpoint serving
+
+  - ``GET /metrics``  — live Prometheus text exposition (per-rank
+    ``dcn_*`` counters, op call/byte totals, arrival-skew and
+    straggler-score families),
+  - ``GET /json``     — the latest frame per rank + the cross-rank
+    straggler attribution (the ``tools/top.py`` feed),
+  - ``GET /history``  — the JSONL history ring (most recent
+    ``telemetry_history`` frames);
+
+* the aggregator joins each collective's per-rank arrival records by
+  their ``(comm, op, seq)`` key (clock-offset aligned) into the live
+  straggler attribution: per-rank rolling lateness score (EWMA),
+  times-slowest counts, per-op skew totals — "who showed up late, by
+  how much", continuously, next to the ``ring_stall_ns``/
+  ``cts_wait_ns`` transport-stall causes that answer "or was it the
+  wire".
+
+Everything is stdlib-only and gated by ``--mca telemetry_enable 1``
+(one bool at init); with the flag off no socket is opened, no thread
+started, no frame sent.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+from ompi_tpu.metrics import straggler as _straggler
+
+#: env var carrying the aggregator's ingest address to the workers
+ENV_TELEMETRY = "OMPI_TPU_TELEMETRY_ADDR"
+
+#: frame wire format: length-prefixed JSON (the KVS convention)
+_LEN = struct.Struct("!I")
+
+#: EWMA weight for the rolling straggler score (per joined instance)
+_EWMA = 0.2
+
+#: joined-instance staging bound: keys waiting for every rank's record
+_PENDING_CAP = 4096
+
+PREFIX = "ompi_tpu"
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("telemetry peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return json.loads(_recv_exact(sock, n).decode())
+
+
+# -- aggregator (lives in the tpurun process) ---------------------------
+
+
+class TelemetryAggregator:
+    """Frame sink + live scrape endpoint + straggler attribution."""
+
+    def __init__(self, http_port: int = 0, history: int = 256,
+                 host: str = "127.0.0.1"):
+        self._lock = threading.Lock()
+        self._running = True
+        #: latest frame per proc (the scrape source)
+        self._latest: dict[int, dict] = {}
+        #: JSONL history ring of every ingested frame
+        self._history: collections.deque = collections.deque(
+            maxlen=max(1, int(history)))
+        self.frames = 0
+        #: straggler state: key → {proc: arrive_ns}, insertion-bounded
+        self._pending: dict[str, dict[int, int]] = {}
+        self._pending_order: collections.deque = collections.deque()
+        self._pending_dropped = 0
+        #: per-proc rolling attribution
+        self._scores: dict[int, dict] = {}
+        #: per-op cross-rank skew totals
+        self._op_skew: dict[str, dict] = {}
+        #: clock offsets onto rank 0's timeline (peer_clock −
+        #: rank0_clock, ns).  Rank-0-measured samples win; a peer's own
+        #: measurement of rank 0 (sign-flipped) fills the gap when rank
+        #: 0 never dialed that peer — handshake samples are recorded on
+        #: the dialing side only, so either side may hold the pair's
+        #: sample
+        self._offsets: dict[int, int] = {}
+        self._offsets_direct: set[int] = set()
+        self._nprocs = 0
+        # ingest socket (workers dial it; address via ENV_TELEMETRY)
+        self._ingest = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._ingest.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ingest.bind((host, 0))
+        self._ingest.listen(64)
+        self.ingest_address = "%s:%d" % self._ingest.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="telemetry-ingest").start()
+        # HTTP scrape endpoint
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        agg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # scrapes must not spam stdio
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = agg.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/json"):
+                    body = json.dumps(agg.json_state()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/history"):
+                    with agg._lock:
+                        rows = list(agg._history)
+                    body = ("\n".join(json.dumps(r) for r in rows)
+                            + "\n").encode()
+                    ctype = "application/jsonl"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._http = ThreadingHTTPServer((host, int(http_port)), Handler)
+        self._http.daemon_threads = True
+        self.http_port = self._http.server_address[1]
+        self.url = f"http://{host}:{self.http_port}"
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="telemetry-http").start()
+
+    # -- ingest ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._ingest.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                self.ingest(_recv_frame(conn))
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def ingest(self, frame: dict) -> None:
+        """Fold one rank frame in (also the selftest entry point)."""
+        proc = int(frame.get("proc", 0))
+        with self._lock:
+            self.frames += 1
+            self._latest[proc] = frame
+            self._history.append(frame)
+            self._nprocs = max(self._nprocs,
+                               int(frame.get("nprocs", 0)), proc + 1)
+            for k, v in (frame.get("clock") or {}).items():
+                peer = int(k)
+                off = int(v[0] if isinstance(v, (list, tuple)) else v)
+                if proc == 0:
+                    self._offsets[peer] = off
+                    self._offsets_direct.add(peer)
+                elif peer == 0 and proc not in self._offsets_direct:
+                    # proc measured rank 0: rank0 − proc; flip the
+                    # sign to get proc's offset on rank 0's timeline
+                    self._offsets[proc] = -off
+            ready = self._stage_colls(proc, frame.get("colls") or ())
+        for key, arrivals in ready:
+            self._attribute(key, arrivals)
+
+    def _stage_colls(self, proc: int, rows) -> list[tuple[str, dict]]:
+        """Under the lock: stage arrival records, pop the keys now held
+        by every rank (returned for attribution outside the lock).
+        Arrivals are staged RAW and clock-corrected only when the
+        instance completes — offsets learned after a record was staged
+        (the bootstrap window before the offset-bearing frame lands)
+        still apply to it."""
+        ready = []
+        for row in rows:
+            key, a = str(row[0]), int(row[1])
+            st = self._pending.get(key)
+            if st is None:
+                st = self._pending[key] = {}
+                self._pending_order.append(key)
+                while len(self._pending_order) > _PENDING_CAP:
+                    old = self._pending_order.popleft()
+                    if self._pending.pop(old, None) is not None:
+                        self._pending_dropped += 1
+            st[proc] = a
+            if self._nprocs and len(st) >= self._nprocs:
+                self._pending.pop(key, None)
+                ready.append((key, {p: t - self._offsets.get(p, 0)
+                                    for p, t in st.items()}))
+        return ready
+
+    def _attribute(self, key: str, arrivals: dict[int, int]) -> None:
+        """One fully-joined collective instance → the rolling tables."""
+        slowest, skews = _straggler.instance_skew(arrivals)
+        op = key.split("/")[-2] if key.count("/") >= 2 else key
+        with self._lock:
+            ost = self._op_skew.setdefault(
+                op, {"n": 0, "skew_ns": 0, "max_skew_ns": 0,
+                     "slowest": {}})
+            ost["n"] += 1
+            worst = skews[slowest]
+            ost["skew_ns"] += worst
+            if worst > ost["max_skew_ns"]:
+                ost["max_skew_ns"] = worst
+            ost["slowest"][slowest] = ost["slowest"].get(slowest, 0) + 1
+            for p, s in skews.items():
+                sc = self._scores.setdefault(
+                    p, {"ewma_ns": 0.0, "slowest": 0, "n": 0,
+                        "skew_ns": 0})
+                sc["ewma_ns"] += _EWMA * (s - sc["ewma_ns"])
+                sc["skew_ns"] += s
+                sc["n"] += 1
+                if p == slowest:
+                    sc["slowest"] += 1
+
+    # -- render ---------------------------------------------------------
+
+    def json_state(self) -> dict:
+        with self._lock:
+            return {
+                "frames": self.frames,
+                "nprocs": self._nprocs,
+                "procs": {str(p): f for p, f in self._latest.items()},
+                "straggler": {
+                    "per_proc": {str(p): dict(s, ewma_ns=int(s["ewma_ns"]))
+                                 for p, s in self._scores.items()},
+                    "per_op": {op: dict(
+                        st, slowest={str(p): c
+                                     for p, c in st["slowest"].items()})
+                        for op, st in self._op_skew.items()},
+                    "pending": len(self._pending),
+                    "dropped": self._pending_dropped,
+                },
+                "clock_offsets_ns": {str(p): o
+                                     for p, o in self._offsets.items()},
+            }
+
+    def prometheus_text(self) -> str:
+        """One combined exposition: each family declared once, one
+        sample per rank — the mid-job twin of the finalize `.prom`."""
+        with self._lock:
+            latest = {p: f for p, f in self._latest.items()}
+            scores = {p: dict(s) for p, s in self._scores.items()}
+            op_skew = {op: dict(st) for op, st in self._op_skew.items()}
+            frames = self.frames
+        from ompi_tpu.metrics import core as _core
+
+        lines: list[str] = [
+            f"# HELP {PREFIX}_telemetry_frames_total Frames ingested "
+            "by the live aggregator",
+            f"# TYPE {PREFIX}_telemetry_frames_total counter",
+            f"{PREFIX}_telemetry_frames_total {frames}",
+        ]
+        # native transport counters, one family per counter — header
+        # rendering + gauge classification shared with the finalize
+        # exporter so live and .prom scrapes type families identically
+        from ompi_tpu.metrics import export as _export
+
+        names = [k for k in _core.NATIVE_COUNTERS
+                 if any((f.get("native") or {}).get(k)
+                        for f in latest.values())]
+        for k in names:
+            _export.dcn_family(
+                lines, k,
+                [(f'{{proc="{p}"}}',
+                  int((latest[p].get("native") or {}).get(k, 0)))
+                 for p in sorted(latest)],
+                origin="Live")
+        # per-op call/byte/wait totals from the rank-local aggregates
+        for fam, field, help_ in (
+            ("op_calls_total", "count", "collective calls by op"),
+            ("op_wait_ns_total", "wait_ns",
+             "in-collective wall time by op (arrival wait + wire)"),
+        ):
+            rows = []
+            for p in sorted(latest):
+                for op, st in (latest[p].get("straggler") or {}).items():
+                    if st.get(field):
+                        rows.append((p, op, int(st[field])))
+            if rows:
+                lines.append(f"# HELP {PREFIX}_{fam} {help_}")
+                lines.append(f"# TYPE {PREFIX}_{fam} counter")
+                for p, op, v in rows:
+                    lines.append(
+                        f'{PREFIX}_{fam}{{proc="{p}",op="{op}"}} {v}')
+        # cross-rank arrival-skew attribution
+        if op_skew:
+            lines.append(f"# HELP {PREFIX}_coll_arrival_skew_ns_total "
+                         "Cumulative worst arrival skew by op "
+                         "(slowest rank's lateness per instance)")
+            lines.append(f"# TYPE {PREFIX}_coll_arrival_skew_ns_total "
+                         "counter")
+            for op, st in sorted(op_skew.items()):
+                lines.append(f'{PREFIX}_coll_arrival_skew_ns_total'
+                             f'{{op="{op}"}} {int(st["skew_ns"])}')
+        if scores:
+            lines.append(f"# HELP {PREFIX}_straggler_score_ns Rolling "
+                         "(EWMA) arrival lateness per rank")
+            lines.append(f"# TYPE {PREFIX}_straggler_score_ns gauge")
+            for p in sorted(scores):
+                lines.append(f'{PREFIX}_straggler_score_ns{{proc="{p}"}}'
+                             f' {int(scores[p]["ewma_ns"])}')
+            lines.append(f"# HELP {PREFIX}_straggler_slowest_total "
+                         "Instances this rank arrived last")
+            lines.append(f"# TYPE {PREFIX}_straggler_slowest_total "
+                         "counter")
+            for p in sorted(scores):
+                lines.append(
+                    f'{PREFIX}_straggler_slowest_total{{proc="{p}"}} '
+                    f'{int(scores[p]["slowest"])}')
+        # detector health + recovery activity
+        rows = [(p, len(latest[p].get("failed") or ()))
+                for p in sorted(latest)]
+        if any(n for _, n in rows) or rows:
+            lines.append(f"# HELP {PREFIX}_detector_failed_peers Peers "
+                         "this rank currently marks failed")
+            lines.append(f"# TYPE {PREFIX}_detector_failed_peers gauge")
+            for p, n in rows:
+                lines.append(
+                    f'{PREFIX}_detector_failed_peers{{proc="{p}"}} {n}')
+        lines.append("")
+        return "\n".join(lines)
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._ingest.close()
+        except OSError:
+            pass
+        try:
+            self._http.shutdown()
+            self._http.server_close()
+        except OSError:
+            pass
+
+
+# -- publisher (one per rank) ------------------------------------------
+
+
+class TelemetryPublisher:
+    """Per-rank frame pump: snapshot → one JSON frame → the launcher.
+
+    Failures never propagate — a dead aggregator costs a reconnect
+    attempt per interval, nothing else; the data plane is untouched."""
+
+    def __init__(self, address: str, proc: int, nprocs: int,
+                 interval_ms: int = 500, detector=None):
+        self.address = address
+        self.proc = int(proc)
+        self.nprocs = int(nprocs)
+        self.interval = max(0.02, float(interval_ms) / 1000.0)
+        self._detector = detector
+        self._sock: socket.socket | None = None
+        self.sent = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="telemetry-pub")
+        self._thread.start()
+
+    def frame(self) -> dict:
+        from ompi_tpu.metrics import core as _core
+        from ompi_tpu.metrics import flight as _flight
+
+        f: dict[str, Any] = {
+            "proc": self.proc,
+            "nprocs": self.nprocs,
+            "ts_ns": time.time_ns(),
+            "native": _core.native_counters(),
+            "straggler": _straggler.summary(),
+            "colls": _straggler.drain_recent(),
+        }
+        clock = _core.clock_offsets()
+        if clock:
+            f["clock"] = {str(p): list(v) for p, v in clock.items()}
+        det = self._detector
+        if det is not None:
+            try:
+                f["failed"] = sorted(det.failed())
+            except Exception:  # noqa: BLE001 — detector mid-teardown
+                pass
+        recs = _flight.records()
+        if recs:
+            by_reason: dict[str, int] = {}
+            for r in recs:
+                by_reason[r.get("reason", "?")] = by_reason.get(
+                    r.get("reason", "?"), 0) + 1
+            f["flight"] = by_reason
+        return f
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.publish_once()
+        # final frame so a clean finalize leaves current counters
+        self.publish_once()
+
+    def publish_once(self) -> bool:
+        try:
+            if self._sock is None:
+                host, port = self.address.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)),
+                                             timeout=2.0)
+                s.settimeout(2.0)
+                self._sock = s
+            _send_frame(self._sock, self.frame())
+            self.sent += 1
+            return True
+        except (OSError, ValueError):
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2 * self.interval + 2.0)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+_publisher: TelemetryPublisher | None = None
+
+
+def publisher() -> TelemetryPublisher | None:
+    return _publisher
+
+
+def start_publisher(world, store) -> TelemetryPublisher | None:
+    """api.init hook: start this rank's frame pump when ``--mca
+    telemetry_enable 1`` AND the launcher advertised an ingest address
+    (``tpurun`` sets ``OMPI_TPU_TELEMETRY_ADDR`` when hosting the
+    aggregator).  Returns None — no socket, no thread — otherwise."""
+    global _publisher
+    import os
+
+    if not bool(store.get("telemetry_enable", False)):
+        return None
+    address = os.environ.get(ENV_TELEMETRY, "")
+    if not address:
+        return None
+    if _publisher is not None:
+        _publisher.stop()
+    pc = getattr(world, "procctx", None)
+    _publisher = TelemetryPublisher(
+        address,
+        proc=int(getattr(world, "proc", 0)),
+        nprocs=int(getattr(world, "nprocs", 1)),
+        interval_ms=int(store.get("telemetry_interval_ms", 500) or 500),
+        detector=getattr(pc, "detector", None) if pc is not None else None,
+    )
+    return _publisher
+
+
+def stop_publisher() -> None:
+    global _publisher
+    if _publisher is not None:
+        _publisher.stop()
+        _publisher = None

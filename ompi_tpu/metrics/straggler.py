@@ -1,0 +1,274 @@
+"""Collective straggler profiler — who showed up late, and why.
+
+The question the ROADMAP's osu_bw item and every production training
+stack ask first: *which rank is the straggler, and is it arrival skew
+or transport stall?*  This module records the per-rank half of the
+answer; the cross-rank half (joining one collective's records across
+all ranks) runs wherever records from every rank meet — the live
+telemetry aggregator (:mod:`ompi_tpu.metrics.live`), a bench worker's
+final allgather, or a post-mortem report.
+
+Per collective call (api dispatch, :meth:`MultiProcComm._lookup`),
+gated on the module ``_enabled`` bool (one test per call when off):
+
+* **arrival** — wall-clock ns at entry, BEFORE any traffic.  Keyed
+  ``(comm, op, seq)`` with a per-(comm, op) issue counter — identical
+  on every rank by MPI's same-issue-order rule, so one collective's
+  records align across ranks (the trace subsystem's merge key, reused);
+* **exit** — wall-clock ns at completion; ``exit - arrival`` is this
+  rank's total wait+wire time inside the op.
+
+The cross-rank join decomposes a rank's in-op wait into **arrival
+skew** (``last_arrival - my_arrival``: how long the early ranks idled
+for the stragglers — :func:`instance_skew` / :func:`join_skew`) vs
+**transport stall** (the metrics plane's ``ring_stall_ns`` /
+``cts_wait_ns`` deltas over the same window — PR 2's cause counters).
+A rolling per-rank straggler score (EWMA of arrival lateness) names
+the culprit; the live aggregator maintains it continuously.
+
+Aggregates follow the subsystem's grow-only pvar contract: per-op keys
+appear in first-seen order and only ever append (reset zeroes in
+place) — ``straggler_<op>_count`` / ``straggler_<op>_wait_ns`` MPI_T
+pvars index into them.
+
+Respawn/replace invariant: a reborn incarnation starts its per-
+(comm, op) counters at zero, which is safe because post-recovery
+collectives run on the freshly-named ``<comm>.replaced`` communicator
+(``MultiProcComm._replace_build`` derives the same name on survivors
+and the reborn rank), so EVERY participant's counter for the new comm
+starts at zero together — keys stay aligned.  The dead rank's
+unmatched pre-failure keys age out of the live aggregator's bounded
+pending window; they are never guessed at.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+#: the in-path gate — the api dispatch hook reads this directly
+_enabled = False
+
+#: recent completed-collective records awaiting publication:
+#: (key, arrive_wall_ns, exit_wall_ns).  Drained by the telemetry
+#: publisher each frame; bounded so an unscraped job cannot grow it.
+_RECENT_CAP = 512
+
+_lock = threading.Lock()
+_seqs: dict[tuple[str, str], int] = {}
+#: per-op aggregates, insertion-ordered and grow-only while profiling
+#: runs (reset zeroes in place — the pvar namespace must not shrink)
+_ops: dict[str, dict] = {}
+_recent: collections.deque = collections.deque(maxlen=_RECENT_CAP)
+#: op → winning coll component (CollTable dispatch notes it; the live
+#: dashboard shows which algorithm a slow op is running)
+_providers: dict[str, str] = {}
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(flag: bool = True) -> None:
+    global _enabled
+    _enabled = flag
+
+
+def sync_from_store(store) -> None:
+    """Armed by ``--mca metrics_enable 1`` OR ``--mca telemetry_enable
+    1`` — the profiler is part of the metrics plane, and the live
+    endpoint's straggler table needs it even when nobody asked for a
+    finalize export."""
+    enable(bool(store.get("metrics_enable", False))
+           or bool(store.get("telemetry_enable", False)))
+
+
+def reset() -> None:
+    """Test hook: drop all state."""
+    global _enabled
+    with _lock:
+        _seqs.clear()
+        _ops.clear()
+        _recent.clear()
+        _providers.clear()
+        _enabled = False
+
+
+def _next_seq(comm: str, op: str) -> int:
+    key = (comm, op)
+    with _lock:
+        s = _seqs.get(key, 0)
+        _seqs[key] = s + 1
+        return s
+
+
+def note_provider(op: str, provider: str) -> None:
+    """Coll dispatch tells us which component serves the op (one dict
+    store per lookup when enabled; callers gate on ``_enabled``)."""
+    _providers[op] = provider
+
+
+def record(comm: str, op: str, arrive_ns: int, exit_ns: int) -> None:
+    """One completed collective: fold into the per-op aggregate and
+    queue the instance record for the next telemetry frame."""
+    wait = max(0, exit_ns - arrive_ns)
+    key = f"{comm}/{op}/{_next_seq(comm, op)}"
+    with _lock:
+        st = _ops.get(op)
+        if st is None:
+            st = _ops[op] = {"count": 0, "wait_ns": 0, "max_wait_ns": 0}
+        st["count"] += 1
+        st["wait_ns"] += wait
+        if wait > st["max_wait_ns"]:
+            st["max_wait_ns"] = wait
+        _recent.append((key, int(arrive_ns), int(exit_ns)))
+
+
+def wrap_call(op: str, fn, comm: str = ""):
+    """Closure recording one collective around each call — the api
+    dispatch hook (sits INSIDE the trace wrap so trace spans cover
+    the same interval).  Timestamps are wall-clock ns: records from
+    different ranks must land on one comparable timeline (the clock-
+    offset estimate in the merge corrects residual host skew)."""
+
+    def profiled(*a, **k):
+        t0 = time.time_ns()
+        try:
+            return fn(*a, **k)
+        finally:
+            record(comm, op, t0, time.time_ns())
+
+    profiled.__name__ = f"straggler_{op}"
+    profiled.__wrapped__ = fn
+    return profiled
+
+
+# -- introspection (pvars, snapshots, frames) ---------------------------
+
+
+def ops() -> list[str]:
+    """Op names with ≥1 record, FIRST-SEEN order — the
+    ``straggler_<op>_*`` pvar namespace (grow-only while profiling
+    runs; reset zeroes in place)."""
+    return list(_ops)
+
+
+def op_count(op: str) -> int:
+    st = _ops.get(op)
+    return st["count"] if st else 0
+
+
+def op_wait_ns(op: str) -> int:
+    st = _ops.get(op)
+    return st["wait_ns"] if st else 0
+
+
+def summary() -> dict[str, dict]:
+    """Per-op aggregates (+ serving component when known) — the
+    snapshot/frame section."""
+    with _lock:
+        return {
+            op: dict(st, provider=_providers.get(op, ""))
+            for op, st in _ops.items()
+        }
+
+
+def drain_recent() -> list[list]:
+    """Pop every queued instance record (JSON-able ``[key, arrive_ns,
+    exit_ns]`` rows) — one consumer, the telemetry publisher."""
+    out = []
+    with _lock:
+        while _recent:
+            k, a, x = _recent.popleft()
+            out.append([k, a, x])
+    return out
+
+
+def recent() -> list[list]:
+    """Non-destructive view of the queued records (finalize export,
+    bench workers that join skew themselves)."""
+    with _lock:
+        return [[k, a, x] for k, a, x in _recent]
+
+
+def zero_stats() -> None:
+    """Session-wide pvar_reset: zero aggregates IN PLACE (keys and seq
+    counters survive — cross-rank keys must not desync mid-run)."""
+    with _lock:
+        for st in _ops.values():
+            st["count"] = 0
+            st["wait_ns"] = 0
+            st["max_wait_ns"] = 0
+
+
+def reset_op(op: str) -> None:
+    with _lock:
+        st = _ops.get(op)
+        if st is not None:
+            st["count"] = 0
+            st["wait_ns"] = 0
+            st["max_wait_ns"] = 0
+
+
+# -- cross-rank skew (pure helpers shared by aggregator/bench/report) ---
+
+
+def instance_skew(arrivals: dict[int, int]) -> tuple[int, dict[int, int]]:
+    """One collective instance across ranks: ``arrivals[proc] =
+    arrive_ns`` (clock-aligned).  Returns ``(slowest_proc, {proc:
+    lateness_ns})`` where lateness is the gap behind the FIRST
+    arrival — the time every earlier rank spent waiting for that
+    rank (0 for the first arrival)."""
+    first = min(arrivals.values())
+    skews = {p: a - first for p, a in arrivals.items()}
+    slowest = max(skews, key=lambda p: (skews[p], p))
+    return slowest, skews
+
+
+def join_skew(records_by_proc: dict[int, list],
+              offsets_ns: dict[int, int] | None = None) -> dict:
+    """Post-hoc join of per-rank instance records (``[key, arrive_ns,
+    exit_ns]`` rows, as :func:`drain_recent`/:func:`recent` emit).
+    ``offsets_ns[proc]`` (peer_clock − reference_clock, the handshake
+    estimate) aligns arrivals before comparison.  Returns::
+
+        {"instances": N,                      # keys seen on every rank
+         "per_op":  {op: {"n", "skew_ns", "max_skew_ns", "slowest": {proc: count}}},
+         "per_proc": {proc: {"skew_ns", "slowest", "n"}}}
+    """
+    offsets_ns = offsets_ns or {}
+    by_key: dict[str, dict[int, int]] = {}
+    for proc, rows in records_by_proc.items():
+        off = int(offsets_ns.get(proc, 0))
+        for key, a, _x in rows:
+            by_key.setdefault(key, {})[int(proc)] = int(a) - off
+    nprocs = len(records_by_proc)
+    per_op: dict[str, dict] = {}
+    per_proc: dict[int, dict] = {
+        int(p): {"skew_ns": 0, "slowest": 0, "n": 0}
+        for p in records_by_proc
+    }
+    instances = 0
+    for key, arrivals in by_key.items():
+        if len(arrivals) < nprocs:
+            continue  # a rank's record rolled off — skip, never guess
+        instances += 1
+        op = key.split("/")[-2] if key.count("/") >= 2 else key
+        slowest, skews = instance_skew(arrivals)
+        st = per_op.setdefault(
+            op, {"n": 0, "skew_ns": 0, "max_skew_ns": 0, "slowest": {}})
+        st["n"] += 1
+        worst = skews[slowest]
+        st["skew_ns"] += worst
+        if worst > st["max_skew_ns"]:
+            st["max_skew_ns"] = worst
+        st["slowest"][slowest] = st["slowest"].get(slowest, 0) + 1
+        for p, s in skews.items():
+            pp = per_proc[p]
+            pp["skew_ns"] += s
+            pp["n"] += 1
+            if p == slowest:
+                pp["slowest"] += 1
+    return {"instances": instances, "per_op": per_op,
+            "per_proc": per_proc}

@@ -167,6 +167,14 @@ def _pvar_names() -> list[str]:
         names.append(f"trace_span_{layer}_{op}_hist")
     for op in metrics.size_ops():
         names.append(f"metrics_size_{op}_hist")
+    # straggler profiler: per-op collective call/wait totals (the
+    # per-rank leg of arrival-skew attribution) — a grow-only tail
+    # like the segments above it
+    from ompi_tpu.metrics import straggler as _straggler
+
+    for op in _straggler.ops():
+        names.append(f"straggler_{op}_count")
+        names.append(f"straggler_{op}_wait_ns")
     return names
 
 
@@ -213,6 +221,13 @@ def pvar_get_info(index: int) -> PvarInfo:
         op = name[len("metrics_size_"):-len("_hist")]
         return PvarInfo(name, PVAR_CLASS_AGGREGATE,
                         f"payload size histogram (log2 byte buckets) {op}")
+    if name.startswith("straggler_"):
+        op, _, what = name[len("straggler_"):].rpartition("_")
+        if name.endswith("_wait_ns"):
+            op, what = name[len("straggler_"):-len("_wait_ns")], "wait_ns"
+        return PvarInfo(name, PVAR_CLASS_COUNTER,
+                        f"collective straggler profiler: {what} for {op} "
+                        "(in-op wall time; cross-rank skew joins live)")
     if name.startswith("trace_"):
         if name.endswith("_hist"):
             layer, op = _trace_key(name)
@@ -247,6 +262,13 @@ def pvar_read(index: int):
 
         return metrics.size_histogram(name[len("metrics_size_"):
                                            -len("_hist")])
+    if name.startswith("straggler_"):
+        from ompi_tpu.metrics import straggler as _straggler
+
+        if name.endswith("_wait_ns"):
+            return _straggler.op_wait_ns(
+                name[len("straggler_"):-len("_wait_ns")])
+        return _straggler.op_count(name[len("straggler_"):-len("_count")])
     if name.startswith("trace_"):
         return _trace_pvar_read(name)
     return spc.get(name[4:])
@@ -263,8 +285,10 @@ def pvar_reset() -> None:
 
     trace.zero_stats()
     from ompi_tpu import metrics
+    from ompi_tpu.metrics import straggler as _straggler
 
     metrics.zero_stats()
+    _straggler.zero_stats()
 
 
 def pvar_reset_one(index: int) -> None:
@@ -305,6 +329,14 @@ def pvar_reset_one(index: int) -> None:
         from ompi_tpu.metrics import core as _metrics
 
         _metrics.reset_op(name[len("metrics_size_"):-len("_hist")])
+    elif name.startswith("straggler_"):
+        # _count/_wait_ns are two views of ONE aggregate: reset together
+        from ompi_tpu.metrics import straggler as _straggler
+
+        op = (name[len("straggler_"):-len("_wait_ns")]
+              if name.endswith("_wait_ns")
+              else name[len("straggler_"):-len("_count")])
+        _straggler.reset_op(op)
     else:
         spc.reset_one(name[len("spc_"):])
 

@@ -41,36 +41,84 @@ def span_key(ev: dict[str, Any]) -> str | None:
     return None
 
 
-def merge_chrome(docs: Iterable[dict[str, Any]]) -> dict[str, Any]:
-    """Merge loaded Chrome trace dicts into one timeline."""
+def merge_chrome(docs: Iterable[dict[str, Any]],
+                 offsets_us: dict[int, float] | None = None
+                 ) -> dict[str, Any]:
+    """Merge loaded Chrome trace dicts into one timeline.
+
+    ``offsets_us`` is the per-process clock correction (pid → that
+    process's clock MINUS the reference clock, µs — the HELLO→SEQACK
+    handshake estimate each rank's metrics snapshot carries as
+    ``clock``): each event's ``ts`` is shifted onto the reference
+    timeline, so cross-rank span alignment survives host clock skew
+    instead of trusting raw wall clocks.  The applied corrections are
+    recorded in ``otherData.clock_offsets_us``."""
+    offsets_us = offsets_us or {}
     events: list[dict[str, Any]] = []
     dropped = 0
+    partial: list[int] = []
     for doc in docs:
         other = doc.get("otherData") or {}
         dropped += int(other.get("dropped_events", 0))
         for ev in doc["traceEvents"]:
             ev = dict(ev)
+            off = offsets_us.get(int(ev.get("pid", 0)), 0.0)
+            if off and "ts" in ev and ev.get("ph") != "M":
+                ev["ts"] = round(float(ev["ts"]) - off, 3)
             key = span_key(ev)
             if key is not None:
                 ev["args"] = dict(ev["args"], key=key)
             events.append(ev)
+        if other.get("partial"):
+            # the doc-level pid (chrome.dump records it) identifies a
+            # partial rank even when it crash-dumped with ZERO events;
+            # first-event pid is the fallback for older dumps
+            if "pid" in other:
+                partial.append(int(other["pid"]))
+            else:
+                partial += [int(e.get("pid", 0))
+                            for e in doc["traceEvents"][:1]]
     # metadata (ph M) first, then by timestamp — Chrome tolerates any
     # order but a sorted timeline diffs cleanly and streams to viewers
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    other_out: dict[str, Any] = {"merged_processes": _pids(events),
+                                 "dropped_events": dropped}
+    if offsets_us:
+        other_out["clock_offsets_us"] = {
+            str(p): round(float(o), 3) for p, o in offsets_us.items()}
+    if partial:
+        other_out["partial_processes"] = sorted(set(partial))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "otherData": {"merged_processes": _pids(events),
-                      "dropped_events": dropped},
+        "otherData": other_out,
     }
 
 
-def merge_files(paths: Iterable[str]) -> dict[str, Any]:
-    return merge_chrome(load(p) for p in paths)
+def merge_files(paths: Iterable[str],
+                offsets_us: dict[int, float] | None = None
+                ) -> dict[str, Any]:
+    return merge_chrome((load(p) for p in paths), offsets_us=offsets_us)
 
 
 def _pids(events: list[dict[str, Any]]) -> list[int]:
     return sorted({int(e.get("pid", 0)) for e in events})
+
+
+def offsets_from_snapshots(snaps: Iterable[dict]) -> dict[int, float]:
+    """``{pid: offset_us}`` from metrics JSONL snapshots: each rank-0
+    snapshot's ``clock`` section holds ``{proc: [offset_ns, rtt_ns]}``
+    measured from rank 0 (peer_clock − rank0_clock), so subtracting
+    the offset maps a peer's events onto rank 0's timeline.  Later
+    snapshots refine earlier ones; rank 0 itself stays at 0."""
+    out: dict[int, float] = {}
+    for s in snaps:
+        if int(s.get("proc") or 0) != 0:
+            continue
+        for p, v in (s.get("clock") or {}).items():
+            off = v[0] if isinstance(v, (list, tuple)) else v
+            out[int(p)] = float(off) / 1000.0
+    return out
 
 
 def collective_keys(doc: dict[str, Any], pid: int | None = None) -> list[tuple]:

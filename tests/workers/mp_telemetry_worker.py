@@ -1,0 +1,76 @@
+"""tpurun worker: drive the LIVE telemetry plane while the test
+process scrapes the aggregator mid-job.
+
+Launched by test_telemetry.py with ``--mca telemetry_enable 1 --mca
+telemetry_interval_ms 150 --mca btl tcp`` and a faultsim plan
+``delay:ms=30;site=recv;proc=1`` that injects 30 ms into every
+inbound frame on rank 1 ONLY — rank 1 therefore leaves each
+collective late and arrives at the next one late, which is exactly
+the arrival-skew signature the live straggler attribution must pin on
+rank 1 (the acceptance criterion).
+
+The loop runs collectives until ``TEL_RUN_SECS`` of wall clock have
+passed, using the allreduce result itself as the stop vote so every
+rank executes the same number of collectives (SPMD discipline); the
+test scrapes ``/metrics`` while this loop runs.
+
+In the DISABLED variant (``TEL_EXPECT=off``: telemetry_enable unset)
+the worker instead asserts the zero-cost path: no publisher object,
+no frames, straggler hooks dark.
+"""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.metrics import live, straggler
+from ompi_tpu.op import SUM
+
+RUN_SECS = float(os.environ.get("TEL_RUN_SECS", "8"))
+EXPECT = os.environ.get("TEL_EXPECT", "on")
+
+world = api.init()
+p = world.proc
+n = world.size
+assert n == 2 and world.local_size == 1, (n, world.local_size)
+
+if EXPECT == "off":
+    # the disabled path: no socket, no thread, no recording
+    assert live.publisher() is None, "publisher started while disabled"
+    assert not straggler.enabled(), "straggler armed while disabled"
+    world.allreduce(np.ones((1, 4)), SUM)
+    assert straggler.summary() == {}, straggler.summary()
+    print(f"OK telemetry_disabled proc={p} publisher=None", flush=True)
+    api.finalize()
+    raise SystemExit(0)
+
+pub = live.publisher()
+assert pub is not None, "telemetry_enable did not start the publisher"
+assert straggler.enabled(), "telemetry_enable must arm the profiler"
+
+t_end = time.monotonic() + RUN_SECS
+iters = 0
+while True:
+    vote = 1.0 if time.monotonic() < t_end else 0.0
+    out = world.allreduce(np.full((1, 4), vote), SUM)
+    iters += 1
+    if float(np.asarray(out)[0, 0]) < n:  # any rank voted stop
+        break
+
+summ = straggler.summary()
+assert summ.get("allreduce", {}).get("count", 0) >= iters, summ
+# frames flowed to the aggregator while the loop ran
+deadline = time.monotonic() + 5
+while pub.sent == 0 and time.monotonic() < deadline:
+    time.sleep(0.05)
+assert pub.sent > 0, "no telemetry frame reached the aggregator"
+print(f"OK telemetry proc={p} iters={iters} frames={pub.sent}",
+      flush=True)
+api.finalize()
+print(f"OK finalize proc={p}", flush=True)

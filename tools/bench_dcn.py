@@ -64,6 +64,14 @@ def coll_samples(nbytes: int, iters: int):
     return np.asarray(ts)
 
 
+# straggler profiler over the collective rows: per-op arrival-skew
+# summary rides into BENCH_DETAIL.json next to native_counters (was
+# one rank systematically late, or was the wire slow?)
+from ompi_tpu.metrics import straggler as _straggler  # noqa: E402
+
+_straggler.enable(True)
+
+
 rows = []
 for nb in P2P_SIZES:
     iters = 150 if nb <= 65536 else 40
@@ -88,6 +96,10 @@ for nb in COLL_SIZES:
         "han_allreduce_p90_us": round(float(np.percentile(ts, 90)) * 1e6, 2),
     })
 
+# cross-rank skew join: exchange the instance records (bounded ring,
+# JSON-able rows) and attribute arrival lateness on rank 0
+_skew_rows = world.dcn.allgather_obj(_straggler.recent(), "bench#skew")
+
 if p == 0:
     import json
 
@@ -97,8 +109,30 @@ if p == 0:
     from ompi_tpu.metrics import core as _mcore
 
     counters = _mcore.native_counters()
+    _offs = {}
+    try:
+        _offs = {pr: off for pr, (off, _rtt)
+                 in world.dcn.clock_offsets().items()}
+    except Exception:  # engine without handshake samples
+        _offs = {}
+    _join = _straggler.join_skew(
+        {i: r for i, r in enumerate(_skew_rows)}, offsets_ns=_offs)
+    arrival_skew = {
+        "instances": _join["instances"],
+        "per_op": {op: {
+            "n": st["n"],
+            "skew_ms": round(st["skew_ns"] / 1e6, 3),
+            "max_skew_ms": round(st["max_skew_ns"] / 1e6, 3),
+            "slowest": {str(k): v for k, v in st["slowest"].items()},
+        } for op, st in _join["per_op"].items()},
+        "per_proc": {str(pr): {
+            "skew_ms": round(st["skew_ns"] / 1e6, 3),
+            "slowest": st["slowest"],
+        } for pr, st in _join["per_proc"].items()},
+    }
     print("DCNBENCH " + json.dumps(
         {"p2p": rows, "han": crows, "estimator": "median-of-iterations",
-         "native_counters": {k: v for k, v in counters.items() if v}}),
+         "native_counters": {k: v for k, v in counters.items() if v},
+         "arrival_skew": arrival_skew}),
         flush=True)
 api.finalize()

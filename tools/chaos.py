@@ -156,17 +156,30 @@ def join_outputs(out: str) -> None:
 
 
 def run_respawn_soak(np_: int, seed: int, plan: str, ops: int,
-                     extra_mca: list[str], timeout: float) -> list[dict]:
+                     extra_mca: list[str], timeout: float,
+                     out: str | None = None) -> list[dict]:
     """One ``tpurun --ft --respawn`` soak: a worker SIGKILLs itself
     mid-collective, the launcher respawns it, survivors' ``replace()``
     restores full membership, and every rank must finish the
-    post-recovery phase at the ORIGINAL size with exact results."""
+    post-recovery phase at the ORIGINAL size with exact results.
+
+    With ``out`` set, metrics/trace exports are enabled: the run must
+    leave telemetry files for EVERY rank even though one incarnation
+    died by SIGKILL — the crash-path export contract (the victim's
+    live-appended flight file + its reborn incarnation's finalize
+    export; survivors' escalation paths dump ``partial: true``)."""
     mca = {
         "btl": "tcp",
         "dcn_recv_timeout": "8",
         "dcn_cts_timeout": "8",
         "dcn_connect_timeout": "4",
     }
+    if out:
+        os.makedirs(out, exist_ok=True)
+        mca["metrics_enable"] = "1"
+        mca["metrics_output"] = os.path.join(out, "chaos")
+        mca["trace_enable"] = "1"
+        mca["trace_output"] = os.path.join(out, "chaos.trace")
     if plan:
         mca.update({"faultsim_enable": "1", "faultsim_seed": str(seed),
                     "faultsim_plan": plan})
@@ -212,6 +225,25 @@ def run_respawn_soak(np_: int, seed: int, plan: str, ops: int,
     if not any(t["incarnation"] > 0 for t in tallies):
         raise SystemExit(
             f"respawn soak: no reborn incarnation completed: {tallies}")
+    if out:
+        # crash-path export contract: telemetry files for every rank
+        # despite the mid-run SIGKILL
+        missing = [p for p in range(np_)
+                   if not os.path.exists(
+                       os.path.join(out, f"chaos.{p}.jsonl"))]
+        if missing:
+            raise SystemExit(
+                f"respawn soak: no metrics export for ranks {missing} "
+                f"after the SIGKILL run (crash-path export broken?)")
+        partial = 0
+        for p in range(np_):
+            with open(os.path.join(out, f"chaos.{p}.jsonl")) as f:
+                rows = [json.loads(l) for l in f if l.strip()]
+            if rows and rows[-1].get("partial"):
+                partial += 1
+        flights = len(glob.glob(os.path.join(out, "*.flight.*.jsonl")))
+        print(f"exports: {np_}/{np_} rank jsonl files "
+              f"({partial} partial), {flights} live flight file(s)")
     print(f"respawn soak: np={np_} seed={seed} ops={ops} "
           f"wall={time.time() - t0:.1f}s plan={plan!r}")
     return tallies
@@ -219,14 +251,17 @@ def run_respawn_soak(np_: int, seed: int, plan: str, ops: int,
 
 def render_respawn(tallies: list[dict]) -> None:
     print(f"{'rank':<6}{'incarn':>7}{'phase1':>8}{'phase2':>8}"
-          f"{'size':>6}{'respawns':>9}{'dedup':>7}")
+          f"{'size':>6}{'respawns':>9}{'reconn':>8}{'dedup':>7}")
     for t in tallies:
         print(f"{t['proc']:<6}{t['incarnation']:>7}"
               f"{t['completed']:>5}/{t['ops']:<2}"
               f"{t['post']:>5}/{t['ops']:<2}"
-              f"{t['size']:>6}{t['respawns']:>9}{t['dedup_drops']:>7}")
+              f"{t['size']:>6}{t['respawns']:>9}"
+              f"{t.get('reconnects', 0):>8}{t['dedup_drops']:>7}")
     print(f"totals: respawned={sum(t['respawns'] for t in tallies)} "
           f"reborn={sum(1 for t in tallies if t['incarnation'] > 0)} "
+          f"reconnects={sum(t.get('reconnects', 0) for t in tallies)} "
+          f"dedup_drops={sum(t.get('dedup_drops', 0) for t in tallies)} "
           f"full_size={all(t['size'] == len(tallies) for t in tallies)}")
 
 
@@ -372,7 +407,8 @@ def main(argv: list[str] | None = None) -> int:
             plan = (DEFAULT_RESPAWN_PLAN if ns.plan == DEFAULT_PLAN
                     else ns.plan)
             tallies = run_respawn_soak(ns.np_, ns.seed, plan, ns.ops,
-                                       ns.mca, ns.timeout)
+                                       ns.mca, ns.timeout,
+                                       out=ns.out or None)
             render_respawn(tallies)
         else:
             tallies = run_soak(ns.np_, ns.seed, ns.plan, ns.ops,
@@ -388,7 +424,7 @@ def main(argv: list[str] | None = None) -> int:
         elif ns.runs > 1:
             print(f"run {run + 1}: injected-fault counts reproduce "
                   f"run 1 exactly (seed {ns.seed})")
-    if ns.out and not ns.respawn:
+    if ns.out:
         join_outputs(ns.out)
     return 0
 

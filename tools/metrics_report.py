@@ -143,6 +143,29 @@ def render_ops(by_proc: dict[int, dict], out=sys.stdout) -> None:
             file=out)
 
 
+def render_straggler(by_proc: dict[int, dict], out=sys.stdout) -> None:
+    """Per-op collective wait table (the straggler profiler's
+    rank-local leg; cross-rank skew attribution joins on the live
+    endpoint or via join_skew over the instance records)."""
+    rows = []
+    for p, snap in sorted(by_proc.items()):
+        for op, st in (snap.get("straggler") or {}).items():
+            rows.append((p, op, st))
+    if not rows:
+        return
+    print("\ncollective wait (straggler profiler, rank-local):",
+          file=out)
+    print(f"{'proc':<5}{'op':<24}{'provider':<10}{'count':>7}"
+          f"{'wait ms':>12}{'max ms':>10}{'mean ms':>10}", file=out)
+    for p, op, st in rows:
+        n = int(st.get("count", 0)) or 1
+        print(f"{p:<5}{op:<24}{str(st.get('provider', '')):<10}"
+              f"{st.get('count', 0):>7}"
+              f"{int(st.get('wait_ns', 0)) / 1e6:>12.3f}"
+              f"{int(st.get('max_wait_ns', 0)) / 1e6:>10.3f}"
+              f"{int(st.get('wait_ns', 0)) / n / 1e6:>10.3f}", file=out)
+
+
 def render_flight(snaps: list[dict], out=sys.stdout) -> None:
     recs = [s for s in snaps if s.get("reason") not in (None, "finalize")]
     if not recs:
@@ -174,18 +197,30 @@ def load_trace_spans(paths: list[str]) -> list[dict]:
 
 
 def correlate(snaps: list[dict], spans: list[dict], top: int = 5,
-              out=sys.stdout) -> int:
+              out=sys.stdout,
+              offsets_us: dict[int, float] | None = None) -> int:
     """Join snapshots to trace spans on the shared wall-clock base.
 
     For consecutive snapshots of one proc the window is [prev, cur];
     the first snapshot looks back 60 s (a run's worth).  Reports the
     stall delta across the window next to the slowest spans inside it
     — 'what was on the wire while the counters moved'.  Returns the
-    joined-window count."""
+    joined-window count.  ``offsets_us`` (pid → clock offset vs rank
+    0, from the handshake estimate each rank-0 snapshot carries)
+    aligns both spans and snapshot timestamps onto rank 0's clock
+    before joining, so the windows survive host clock skew."""
+    if offsets_us:
+        spans = [dict(e, ts=float(e.get("ts", 0.0))
+                      - offsets_us.get(int(e.get("pid", 0)), 0.0))
+                 for e in spans]
     joined = 0
     by_proc: dict[int, list[dict]] = {}
     for s in snaps:
-        by_proc.setdefault(int(s.get("proc") or 0), []).append(s)
+        p = int(s.get("proc") or 0)
+        if offsets_us and offsets_us.get(p):
+            s = dict(s, ts_ns=int(s.get("ts_ns", 0)
+                                  - offsets_us[p] * 1000.0))
+        by_proc.setdefault(p, []).append(s)
     for p, plist in sorted(by_proc.items()):
         prev_ts = None
         prev_stall = 0
@@ -327,6 +362,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="Chrome trace files to join by timestamp")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest spans listed per correlated window")
+    ap.add_argument("--no-clock-align", action="store_true",
+                    help="correlate on raw wall clocks (skip the "
+                    "handshake clock-offset correction)")
     ap.add_argument("--selftest", action="store_true",
                     help="run the built-in self-check and exit")
     ns = ap.parse_args(argv)
@@ -338,11 +376,20 @@ def main(argv: list[str] | None = None) -> int:
     by_proc = finals(snaps)
     render_native(by_proc)
     render_ops(by_proc)
+    render_straggler(by_proc)
     render_flight(snaps)
     if ns.correlate:
         print("\ntrace correlation:")
+        from ompi_tpu.trace import merge as _merge
+
+        offsets = (None if ns.no_clock_align
+                   else _merge.offsets_from_snapshots(snaps) or None)
+        if offsets:
+            print("clock-aligned via handshake offsets (µs): "
+                  + ", ".join(f"{p}={o:+.1f}"
+                              for p, o in sorted(offsets.items())))
         spans = load_trace_spans(ns.correlate)
-        correlate(snaps, spans, top=ns.top)
+        correlate(snaps, spans, top=ns.top, offsets_us=offsets)
     return 0
 
 

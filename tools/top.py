@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""top — refreshing terminal dashboard over the live telemetry endpoint.
+
+Usage::
+
+    # launch a job with the live plane on; tpurun prints the URL
+    python -m ompi_tpu run -np 4 --cpu-devices 1 \
+        --mca telemetry_enable 1 my_script.py
+    # [tpurun] telemetry: http://127.0.0.1:PORT/metrics ...
+
+    # watch it (refreshes every --interval seconds; q/Ctrl-C to stop)
+    python tools/top.py --url http://127.0.0.1:PORT
+
+    # one frame, no screen clearing (scripts, CI)
+    python tools/top.py --url http://127.0.0.1:PORT --once
+
+    # self-check (no job): drives a real in-process aggregator over
+    # real HTTP with synthetic 2-rank frames
+    python tools/top.py --selftest
+
+Reads the aggregator's ``/json`` feed (the same state ``/metrics``
+exposes as Prometheus text): per-rank transport bandwidth and message
+rates (computed from successive frames), the stall-cause breakdown
+(ring backpressure vs rendezvous CTS wait vs other — PR 2's
+decomposition, live), detector health, recovery activity
+(reconnects / respawns / dedup drops), and the cross-rank straggler
+attribution — rolling arrival-lateness score and times-slowest per
+rank, arrival-skew totals per op.  Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+#: wire-traffic counters summed into the bandwidth estimate
+_BYTES = ("eager_bytes", "chunked_bytes", "rndv_bytes")
+_MSGS = ("eager_msgs", "chunked_msgs", "rndv_msgs")
+
+
+def fetch(url: str, timeout: float = 3.0) -> dict:
+    with urllib.request.urlopen(url + "/json", timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _bar(share: float, width: int = 10) -> str:
+    n = max(0, min(width, round(share * width)))
+    return "█" * n + "·" * (width - n)
+
+
+def _rates(cur: dict, prev: dict | None) -> tuple[float, float]:
+    """(MB/s, msg/s) between two frames of one rank."""
+    if not prev:
+        return 0.0, 0.0
+    dt = (cur.get("ts_ns", 0) - prev.get("ts_ns", 0)) / 1e9
+    if dt <= 0:
+        return 0.0, 0.0
+    cn, pn = cur.get("native") or {}, prev.get("native") or {}
+    db = sum(int(cn.get(k, 0)) - int(pn.get(k, 0)) for k in _BYTES)
+    dm = sum(int(cn.get(k, 0)) - int(pn.get(k, 0)) for k in _MSGS)
+    return max(0.0, db / dt / 1e6), max(0.0, dm / dt)
+
+
+def render(state: dict, prev: dict | None = None, url: str = "",
+           out=sys.stdout) -> None:
+    procs = {int(p): f for p, f in (state.get("procs") or {}).items()}
+    prev_procs = {int(p): f for p, f in
+                  ((prev or {}).get("procs") or {}).items()}
+    print(f"ompi_tpu top — {url or 'live telemetry'}  "
+          f"frames={state.get('frames', 0)} "
+          f"nprocs={state.get('nprocs', len(procs))}  "
+          f"{time.strftime('%H:%M:%S')}", file=out)
+    print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
+          f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
+          f"{'failed':>7}  stall causes (ring/cts/other)", file=out)
+    for p in sorted(procs):
+        f = procs[p]
+        n = f.get("native") or {}
+        mbs, msgs = _rates(f, prev_procs.get(p))
+        stall = int(n.get("stall_ns", 0))
+        ring = int(n.get("ring_stall_ns", 0))
+        cts = int(n.get("cts_wait_ns", 0))
+        other = max(0, stall - ring - cts)
+        if stall:
+            causes = (f"ring {_bar(ring / stall, 6)} "
+                      f"cts {_bar(cts / stall, 6)} "
+                      f"other {other / stall:>4.0%} "
+                      f"({stall / 1e6:.1f} ms)")
+        else:
+            causes = "-"
+        failed = f.get("failed") or []
+        print(f"{p:<5}{mbs:>8.1f}{msgs:>8.0f}"
+              f"{int(n.get('delivered', 0)):>10}"
+              f"{int(n.get('reconnects', 0)):>7}"
+              f"{int(n.get('respawns', 0)):>7}"
+              f"{int(n.get('dedup_drops', 0)):>6}"
+              f"{int(n.get('deadline_expired', 0)):>6}"
+              f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
+              file=out)
+    strag = state.get("straggler") or {}
+    per_proc = {int(p): s for p, s in
+                (strag.get("per_proc") or {}).items()}
+    if per_proc:
+        print("\ntop stragglers (rolling arrival lateness):", file=out)
+        ranked = sorted(per_proc,
+                        key=lambda p: -per_proc[p].get("ewma_ns", 0))
+        for p in ranked[:4]:
+            s = per_proc[p]
+            n = max(1, int(s.get("n", 0)))
+            print(f"  rank {p}: ewma {int(s.get('ewma_ns', 0)) / 1e6:8.2f} ms"
+                  f"   slowest {int(s.get('slowest', 0))}/{n}"
+                  f" ({int(s.get('slowest', 0)) / n:.0%})"
+                  f"   total skew {int(s.get('skew_ns', 0)) / 1e9:.3f} s",
+                  file=out)
+    per_op = strag.get("per_op") or {}
+    if per_op:
+        print("\nper-op arrival skew (cross-rank joins):", file=out)
+        print(f"  {'op':<24}{'joins':>7}{'skew ms':>10}{'max ms':>9}"
+              f"  slowest rank (count)", file=out)
+        for op, st in sorted(per_op.items(),
+                             key=lambda kv: -kv[1].get("skew_ns", 0)):
+            slowest = st.get("slowest") or {}
+            worst = (max(slowest, key=lambda k: slowest[k])
+                     if slowest else "-")
+            print(f"  {op:<24}{int(st.get('n', 0)):>7}"
+                  f"{int(st.get('skew_ns', 0)) / 1e6:>10.2f}"
+                  f"{int(st.get('max_skew_ns', 0)) / 1e6:>9.2f}"
+                  f"  {worst} ({slowest.get(worst, 0) if slowest else 0})",
+                  file=out)
+    # rank-local per-op wait (from each rank's straggler summary)
+    waits = []
+    for p in sorted(procs):
+        for op, st in (procs[p].get("straggler") or {}).items():
+            if st.get("count"):
+                waits.append((p, op, st))
+    if waits:
+        print("\ncollective wait (rank-local):", file=out)
+        print(f"  {'rank':<5}{'op':<24}{'provider':<9}{'calls':>7}"
+              f"{'wait ms':>10}{'max ms':>9}", file=out)
+        for p, op, st in waits:
+            print(f"  {p:<5}{op:<24}{str(st.get('provider', '')):<9}"
+                  f"{int(st.get('count', 0)):>7}"
+                  f"{int(st.get('wait_ns', 0)) / 1e6:>10.2f}"
+                  f"{int(st.get('max_wait_ns', 0)) / 1e6:>9.2f}",
+                  file=out)
+    flights = {}
+    for p in sorted(procs):
+        for k, v in (procs[p].get("flight") or {}).items():
+            flights[k] = flights.get(k, 0) + int(v)
+    if flights:
+        print("\nflight records: "
+              + ", ".join(f"{k}={v}" for k, v in sorted(flights.items())),
+              file=out)
+
+
+def watch(url: str, interval: float) -> int:
+    prev = None
+    try:
+        while True:
+            try:
+                state = fetch(url)
+            except OSError as e:
+                print(f"top: endpoint unreachable ({e}); retrying",
+                      file=sys.stderr)
+                time.sleep(interval)
+                continue
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            render(state, prev, url=url)
+            sys.stdout.flush()
+            prev = state
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+# -- selftest ----------------------------------------------------------
+
+
+def selftest() -> int:
+    """Drive a REAL aggregator over REAL HTTP with synthetic 2-rank
+    frames: straggler join (rank 1 always late), rate computation,
+    Prometheus families, history ring, and the renderer."""
+    import io
+
+    from ompi_tpu.metrics.live import TelemetryAggregator
+
+    agg = TelemetryAggregator(http_port=0, history=16)
+    try:
+        base = time.time_ns()
+        for rnd in range(3):
+            for proc in (0, 1):
+                native = {"eager_bytes": 1_000_000 * (rnd + 1),
+                          "eager_msgs": 100 * (rnd + 1),
+                          "delivered": 50 * (rnd + 1),
+                          "stall_ns": 5_000_000 * (rnd + 1),
+                          "ring_stall_ns": 3_000_000 * (rnd + 1),
+                          "cts_wait_ns": 1_000_000 * (rnd + 1)}
+                # rank 1 arrives 25 ms late at every collective
+                late = 25_000_000 if proc == 1 else 0
+                colls = [[f"MPI_COMM_WORLD/allreduce/{rnd * 4 + i}",
+                          base + (rnd * 4 + i) * 50_000_000 + late,
+                          base + (rnd * 4 + i) * 50_000_000 + late
+                          + 1_000_000] for i in range(4)]
+                agg.ingest({
+                    "proc": proc, "nprocs": 2,
+                    "ts_ns": base + rnd * 500_000_000,
+                    "native": native,
+                    "straggler": {"allreduce": {
+                        "count": 4 * (rnd + 1),
+                        "wait_ns": 30_000_000 * (rnd + 1),
+                        "max_wait_ns": 9_000_000,
+                        "provider": "han"}},
+                    "colls": colls,
+                    "clock": {"1": [0, 1000]} if proc == 0 else {},
+                    "failed": [],
+                })
+        # real HTTP: Prometheus text with per-rank dcn counters and
+        # the straggler attribution naming rank 1
+        with urllib.request.urlopen(agg.url + "/metrics",
+                                    timeout=5) as r:
+            prom = r.read().decode()
+        assert 'ompi_tpu_dcn_delivered{proc="0"} 150' in prom, prom
+        assert 'ompi_tpu_dcn_delivered{proc="1"} 150' in prom, prom
+        assert "ompi_tpu_coll_arrival_skew_ns_total" in prom, prom
+        s0 = [l for l in prom.splitlines()
+              if l.startswith('ompi_tpu_straggler_score_ns{proc="0"}')]
+        s1 = [l for l in prom.splitlines()
+              if l.startswith('ompi_tpu_straggler_score_ns{proc="1"}')]
+        assert s0 and s1, prom
+        assert int(s1[0].rsplit(" ", 1)[1]) > int(s0[0].rsplit(" ", 1)[1])
+        slowest = [l for l in prom.splitlines() if l.startswith(
+            'ompi_tpu_straggler_slowest_total{proc="1"}')]
+        assert slowest and int(slowest[0].rsplit(" ", 1)[1]) == 12, prom
+        # /json + renderer: the dashboard names rank 1 the straggler
+        state = fetch(agg.url)
+        assert state["frames"] == 6, state["frames"]
+        pp = state["straggler"]["per_proc"]
+        assert pp["1"]["slowest"] == 12 and pp["0"]["slowest"] == 0, pp
+        assert abs(pp["1"]["skew_ns"] - 12 * 25_000_000) < 1_000, pp
+        buf = io.StringIO()
+        render(state, prev=None, url=agg.url, out=buf)
+        text = buf.getvalue()
+        assert "top stragglers" in text and "rank 1" in text, text
+        assert "allreduce" in text and "stall causes" in text, text
+        # /history serves the JSONL ring
+        with urllib.request.urlopen(agg.url + "/history",
+                                    timeout=5) as r:
+            hist = [json.loads(l) for l in r.read().decode().splitlines()]
+        assert len(hist) == 6 and hist[-1]["proc"] == 1, len(hist)
+        # rate computation between two frames
+        f0 = {"ts_ns": 0, "native": {"eager_bytes": 0, "eager_msgs": 0}}
+        f1 = {"ts_ns": 1_000_000_000,
+              "native": {"eager_bytes": 2_000_000, "eager_msgs": 10}}
+        mbs, msgs = _rates(f1, f0)
+        assert abs(mbs - 2.0) < 1e-6 and abs(msgs - 10.0) < 1e-6
+        print("selftest OK: 6 frames ingested over HTTP, 12 straggler "
+              "joins (rank 1 slowest 12/12), prometheus families, "
+              "history ring, renderer")
+        return 0
+    finally:
+        agg.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:9321",
+                    help="aggregator base URL (tpurun prints it)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period, seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in self-check and exit")
+    ns = ap.parse_args(argv)
+    if ns.selftest:
+        return selftest()
+    url = ns.url.rstrip("/")
+    if ns.once:
+        render(fetch(url), url=url)
+        return 0
+    return watch(url, ns.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

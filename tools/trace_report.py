@@ -161,6 +161,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="how many slowest spans to list")
     ap.add_argument("--merge-out", metavar="PATH",
                     help="write the merged Chrome trace here")
+    ap.add_argument("--clock-from", nargs="+", metavar="JSONL",
+                    help="metrics .jsonl snapshots carrying handshake "
+                    "clock offsets: align each rank's timeline before "
+                    "merging (survives host clock skew)")
+    ap.add_argument("--offset", action="append", default=[],
+                    metavar="PID=US",
+                    help="explicit per-rank clock offset in µs "
+                    "(that rank's clock minus the reference clock; "
+                    "repeatable, overrides --clock-from)")
     ap.add_argument("--selftest", action="store_true",
                     help="run the built-in self-check and exit")
     ns = ap.parse_args(argv)
@@ -168,7 +177,22 @@ def main(argv: list[str] | None = None) -> int:
         return selftest()
     if not ns.traces:
         ap.error("no trace files given (or use --selftest)")
-    doc = merge.merge_files(ns.traces)
+    offsets: dict[int, float] = {}
+    if ns.clock_from:
+        snaps = []
+        for p in ns.clock_from:
+            with open(p) as f:
+                snaps += [json.loads(l) for l in f if l.strip()]
+        snaps.sort(key=lambda s: s.get("ts_ns", 0))
+        offsets = merge.offsets_from_snapshots(snaps)
+    for kv in ns.offset:
+        pid, _, us = kv.partition("=")
+        offsets[int(pid)] = float(us)
+    if offsets:
+        print("clock offsets (µs, subtracted per rank): "
+              + ", ".join(f"{p}={o:+.1f}"
+                          for p, o in sorted(offsets.items())))
+    doc = merge.merge_files(ns.traces, offsets_us=offsets or None)
     render(doc, top=ns.top)
     if ns.merge_out:
         with open(ns.merge_out, "w") as f:
