@@ -571,6 +571,100 @@ def capi_rows(max_bytes: int = 4096, iters: int = 400) -> dict:
     return {"np": 1, "iters": iters, "rows": rows}
 
 
+def serve_rows(runs: int = 3) -> dict:
+    """Warm-vs-cold dispatch (the tpud daemon's reason to exist as a
+    measured number): job-submit→first-collective latency for a job
+    submitted to a resident ``tpud`` world vs a cold ``tpurun`` launch
+    of the SAME script (tools/bench_serve_job.py — each rank prints a
+    ``FIRSTCOLL ns=`` wall-clock stamp after its first allreduce;
+    both legs subtract the driver's submit/spawn stamp on the same
+    host clock).  The warm leg pays an HTTP submit + a directive poll;
+    the cold leg pays interpreter start, jax import, rendezvous, and
+    both planes' endpoint dials."""
+    import threading
+
+    job = str(REPO / "tools" / "bench_serve_job.py")
+    mca = {"btl": "tcp"}
+
+    def cold_once() -> float:
+        t0 = time.time_ns()
+        out = _run_tpurun(2, job, mca=mca)
+        ts = [int(l.split("ns=", 1)[1].split()[0])
+              for l in out.splitlines() if "FIRSTCOLL " in l]
+        if len(ts) != 2:
+            raise RuntimeError(f"cold leg: {out[-1000:]}")
+        return (max(ts) - t0) / 1e3
+
+    cold = [cold_once() for _ in range(runs)]
+
+    cmd = [sys.executable, str(REPO / "tools" / "tpud.py"), "-np", "2",
+           "--cpu-devices", "1"]
+    for k, v in mca.items():
+        cmd += ["--mca", k, v]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=_tpurun_env(),
+                            cwd=str(REPO))
+    lines: list[str] = []
+
+    def _reader():
+        for raw in iter(proc.stdout.readline, b""):
+            lines.append(raw.decode(errors="replace"))
+
+    threading.Thread(target=_reader, daemon=True).start()
+    warm = []
+    try:
+        url = None
+        deadline = time.monotonic() + 60
+        while url is None and time.monotonic() < deadline:
+            for l in list(lines):
+                if "[tpud] ops: " in l:
+                    url = l.split("[tpud] ops: ", 1)[1].split("/jobs")[0]
+            time.sleep(0.05)
+        if not url:
+            raise RuntimeError("tpud never printed its ops URL:\n"
+                               + "".join(lines)[-1000:])
+        from ompi_tpu.serve import client
+
+        def _stamps() -> list[int]:
+            return [int(l.split("ns=", 1)[1].split()[0])
+                    for l in list(lines) if "FIRSTCOLL " in l]
+
+        def warm_once() -> float:
+            seen = len(_stamps())
+            t0 = time.time_ns()
+            rec = client.wait(
+                url, client.submit(url, job)["id"], timeout=120)
+            if rec.get("state") != "done":
+                raise RuntimeError(f"warm job failed: {rec}")
+            deadline = time.monotonic() + 10
+            while (len(_stamps()) < seen + 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            ts = _stamps()[seen:seen + 2]
+            if len(ts) != 2:
+                raise RuntimeError("warm leg: FIRSTCOLL lines missing")
+            return (max(ts) - t0) / 1e3
+
+        warm_once()  # warm-up: the first submit overlaps worker boot
+        warm = [warm_once() for _ in range(runs)]
+        client.shutdown(url)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    cold_med = float(np.median(cold))
+    warm_med = float(np.median(warm))
+    return {
+        "np": 2, "runs": runs,
+        "cold_submit_to_first_coll_us": round(cold_med, 1),
+        "warm_submit_to_first_coll_us": round(warm_med, 1),
+        "cold_us_all": [round(c, 1) for c in cold],
+        "warm_us_all": [round(w, 1) for w in warm],
+        "warm_speedup": round(cold_med / max(warm_med, 1e-9), 2),
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--max-bytes", type=int, default=None,
@@ -607,7 +701,8 @@ def main() -> None:
         for key, fn in (("dcn", dcn_rows), ("capi", capi_rows),
                         ("capi_p2p", capi_p2p_rows),
                         ("algos_cpu8", algos_cpu8_rows),
-                        ("hostpath_cpu8", hostpath_cpu8_rows)):
+                        ("hostpath_cpu8", hostpath_cpu8_rows),
+                        ("serve", serve_rows)):
             try:
                 detail[key] = fn()
             except Exception as e:  # never lose the headline to a subrow

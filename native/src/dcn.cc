@@ -256,6 +256,13 @@ static std::atomic<uint64_t> g_fault_events{0};
 // Python on this plane.
 static std::atomic<int64_t> g_fault_conn_at{-1};
 static std::atomic<uint64_t> g_fault_conn_events{0};
+// wire-duplicate knob (dup:at=N rules on the native plane): the Nth
+// seq-carrying eager tcp send is transmitted TWICE with the same
+// (nonce, seq) — a genuine wire duplicate the receiver's dedup
+// watermark must absorb exactly-once, including across a failure-
+// mark/clear cycle (the watermark-continuity contract).
+static std::atomic<int64_t> g_fault_dup_at{-1};
+static std::atomic<uint64_t> g_fault_dup_events{0};
 // receive-path delay knob (delay:ms=..;site=recv rules): injected
 // latency at the blocking-receive entry (tdcn_precv — the native pml
 // AND the C-ABI shim's MPI_Recv path).  Disabled cost: one relaxed
@@ -1405,6 +1412,27 @@ static bool fault_ring_ok(Engine *eng) {
   return true;
 }
 
+static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
+                         uint64_t nbytes, uint64_t xs);
+
+// consult the armed wire-dup knob after a successful seq'd eager
+// send: the matching event re-transmits the identical frame (same
+// lineage nonce, same seq), handing the receiver a true wire
+// duplicate its dedup watermark must absorb
+static void fault_dup_check(Engine *eng, Peer *p, Env &e,
+                            const void *data, uint64_t nbytes,
+                            uint64_t xs) {
+  if (!xs) return;  // only seq'd eager frames participate in dedup
+  int64_t at = g_fault_dup_at.load(std::memory_order_relaxed);
+  if (at < 0) return;
+  uint64_t k =
+      g_fault_dup_events.fetch_add(1, std::memory_order_relaxed) + 1;
+  if ((int64_t)k == at) {
+    eng->stats.add(TS_INJECTED_FAULTS, 1);
+    tcp_send_once(eng, p, e, data, nbytes, xs);
+  }
+}
+
 // consult the armed connkill knob before a tcp send: the matching
 // event finds its socket severed in place, so the in-flight send
 // fails and exercises the redial+resend round (the same contract as
@@ -1613,7 +1641,10 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
       // duplex reader for CTS grants on the fresh socket
       std::thread(sock_recv_loop, eng, dup(fd)).detach();
     }
-    if (tcp_send_once(eng, p, e, data, nbytes, xs) == 0) return 0;
+    if (tcp_send_once(eng, p, e, data, nbytes, xs) == 0) {
+      fault_dup_check(eng, p, e, data, nbytes, xs);
+      return 0;
+    }
     // connection-level failure: invalidate this epoch's socket; the
     // next pass redials (control traffic fails fast instead — the
     // detector's in-band strike path owns interpreting it)
@@ -1707,6 +1738,8 @@ static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
 
 extern "C" {
 
+static void prune_dedup(Engine *eng, int proc);
+
 void *tdcn_create(int proc, int nprocs, const char *host_id,
                   int64_t eager_limit, int64_t frag_size,
                   uint64_t ring_bytes, int max_rndv) {
@@ -1780,7 +1813,8 @@ const char *tdcn_address(void *h) {
 
 int tdcn_set_addresses(void *h, const char *joined) {
   Engine *eng = (Engine *)h;
-  eng->peer_addresses.clear();
+  std::vector<std::string> old;
+  old.swap(eng->peer_addresses);
   std::string s(joined ? joined : "");
   size_t start = 0;
   while (start <= s.size()) {
@@ -1791,6 +1825,15 @@ int tdcn_set_addresses(void *h, const char *joined) {
     }
     eng->peer_addresses.push_back(s.substr(start, nl - start));
     start = nl + 1;
+  }
+  // an address CHANGE is the one proof a proc's old sender lineage is
+  // dead (replace() installing a reborn incarnation's endpoint) — the
+  // moment its stale dedup watermarks become garbage and can be
+  // pruned without ever regressing a live lineage's watermark
+  for (size_t p = 0; p < old.size() && p < eng->peer_addresses.size();
+       p++) {
+    if (!old[p].empty() && old[p] != eng->peer_addresses[p])
+      prune_dedup(eng, (int)p);
   }
   return 0;
 }
@@ -2134,6 +2177,13 @@ int tdcn_ctrl_next(void *h, double timeout_s, TdcnMsg *out) {
 // lineage nonces).  Correctness does not depend on this — a reborn
 // incarnation's Peer carries a FRESH nonce, so it can never collide
 // with the corpse's state — it just bounds memory across recoveries.
+// Call it ONLY when the proc's lineage is provably dead (its address
+// changed, i.e. a new incarnation was installed): pruning on a mere
+// failure mark, or on the mark's clear, REGRESSES the watermark of a
+// still-alive sender (false-positive detection, injected connkill),
+// and its next retry round would re-deliver an already-delivered
+// frame — the exactly-once contract broken exactly when recovery is
+// exercising it.
 static void prune_dedup(Engine *eng, int proc) {
   std::lock_guard<std::mutex> g(eng->dedup_mu);
   for (auto it = eng->rx_seen.begin(); it != eng->rx_seen.end();) {
@@ -2144,17 +2194,32 @@ static void prune_dedup(Engine *eng, int proc) {
   }
 }
 
+// The contiguous delivered watermark for a sending proc (max over its
+// lineage nonces; 0 = nothing seq'd delivered).  Introspection for
+// recovery observability + the watermark-continuity tests.
+uint64_t tdcn_rx_watermark(void *h, int proc) {
+  Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> g(eng->dedup_mu);
+  uint64_t low = 0;
+  for (auto &kv : eng->rx_seen)
+    if (kv.first.first == proc && kv.second.low > low)
+      low = kv.second.low;
+  return low;
+}
+
 // Un-mark a failed proc (the replace() leg of elastic recovery: a
 // respawned incarnation re-published its endpoint, so sends/recvs
-// naming it must flow again).
+// naming it must flow again).  Deliberately does NOT touch the rx
+// dedup watermarks: the mark may have been a false positive and the
+// same sender lineage may resend across the clear — the watermark is
+// what keeps that resend exactly-once.  Stale lineages are pruned
+// when the proc's ADDRESS changes (tdcn_set_addresses), the one
+// signal that a new incarnation really replaced it.
 void tdcn_clear_failed(void *h, int proc) {
   Engine *eng = (Engine *)h;
-  {
-    std::lock_guard<std::mutex> g(eng->mu);
-    if (proc >= 0 && (size_t)proc < eng->failed.size())
-      eng->failed[proc] = false;
-  }
-  prune_dedup(eng, proc);
+  std::lock_guard<std::mutex> g(eng->mu);
+  if (proc >= 0 && (size_t)proc < eng->failed.size())
+    eng->failed[proc] = false;
 }
 
 void tdcn_note_failed(void *h, int proc) {
@@ -2169,10 +2234,11 @@ void tdcn_note_failed(void *h, int proc) {
     for (auto &kv : eng->reqs) kv.second->cv.notify_all();
     wake_waiters(eng);
   }
-  // the dead incarnation's dedup watermarks are garbage now (its
-  // reborn successor gets a fresh lineage nonce, so there is no
-  // collision either way) — prune them to bound memory
-  prune_dedup(eng, proc);
+  // dedup watermarks survive the mark on purpose: a false-positive
+  // detection (peer actually alive) followed by clear_failed must not
+  // regress them, or the peer's next resend round re-delivers.  The
+  // genuinely-dead incarnation's entries are pruned when replace()
+  // installs its successor's address (tdcn_set_addresses).
 }
 
 // ---- channel fast path ----------------------------------------------
@@ -2354,6 +2420,15 @@ uint64_t tdcn_fault_events(void) {
 void tdcn_fault_set_conn(int64_t connkill_at) {
   g_fault_conn_at.store(connkill_at, std::memory_order_relaxed);
   g_fault_conn_events.store(0, std::memory_order_relaxed);
+}
+
+// Arm/disarm the wire-duplicate knob (dup:at=N rules on the native
+// plane): the Nth seq-carrying eager tcp send goes out twice — the
+// receiver must deliver exactly once via its dedup watermark.  -1
+// disarms; the event counter restarts so schedules are reproducible.
+void tdcn_fault_set_dup(int64_t dup_at) {
+  g_fault_dup_at.store(dup_at, std::memory_order_relaxed);
+  g_fault_dup_events.store(0, std::memory_order_relaxed);
 }
 
 // Arm/disarm the blocking-receive delay knob (delay:ms=..;site=recv

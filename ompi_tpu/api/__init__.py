@@ -20,6 +20,11 @@ from .spawn import get_parent, spawn  # noqa: F401
 _world: Comm | None = None
 _self_comm: Comm | None = None
 _initialized = False
+#: serve plane (tpud): saved resident worlds while a job world is
+#: pushed — ``init()`` inside a served job script returns the JOB's
+#: communicator, and ``finalize()`` pops the job scope instead of
+#: tearing the warm mesh down (job re-arm, not finalize-teardown)
+_world_stack: list[Comm] = []
 
 
 def init(mca_params: dict[str, str] | None = None) -> Comm:
@@ -142,6 +147,61 @@ def initialized() -> bool:
     return _initialized
 
 
+# -- serve plane (tpud attach path) -------------------------------------
+
+
+def push_world(comm) -> None:
+    """Enter a job scope: ``comm`` becomes COMM_WORLD for code that
+    calls :func:`init`/:func:`comm_world` until :func:`pop_world` —
+    how a tpud resident worker runs an unmodified worker script in a
+    warm mesh (the script's ``init()`` finds the job's communicator,
+    its ``finalize()`` ends the job, not the daemon)."""
+    global _world
+    if _world is None:
+        raise MPICommError("push_world before init")
+    _world_stack.append(_world)
+    _world = comm
+
+
+def pop_world():
+    """Leave the innermost job scope; returns the job comm that was
+    active (idempotence guard: None when no scope is pushed)."""
+    global _world
+    if not _world_stack:
+        return None
+    job, _world = _world, _world_stack.pop()
+    return job
+
+
+def in_job_scope() -> bool:
+    return bool(_world_stack)
+
+
+def set_world(comm) -> None:
+    """Replace the resident COMM_WORLD (the serve plane's repair path:
+    after ``replace()`` restores a full-size communicator, future jobs
+    must derive from the healed world, not the poisoned one)."""
+    global _world
+    if _world_stack:
+        _world_stack[0] = comm
+    else:
+        _world = comm
+
+
+def tpud_submit(url: str, script: str, args=(), tenant: str | None = None,
+                wait: bool = True, timeout: float = 600.0) -> dict:
+    """Attach-to-daemon client path: submit ``script`` to a running
+    ``tpud`` at ``url`` and (by default) wait for its completion
+    record — the warm-world sibling of launching a fresh ``tpurun``.
+    Thin convenience over :mod:`ompi_tpu.serve.client`."""
+    from ompi_tpu.serve import client as _client
+
+    job = _client.submit(url, script, args=args, tenant=tenant)
+    if wait:
+        return _client.wait(url, job["id"], timeout=timeout)
+    return job
+
+
 def comm_world() -> Comm:
     if _world is None:
         raise MPICommError("call ompi_tpu.api.init() first")
@@ -155,8 +215,17 @@ def comm_self() -> Comm:
 
 
 def finalize() -> None:
-    """MPI_Finalize: free the world objects and close frameworks."""
+    """MPI_Finalize: free the world objects and close frameworks.
+
+    Inside a tpud job scope (:func:`push_world`) this is the JOB's
+    finalize: the scope pops and the resident plane — mesh, engine
+    threads, DCN endpoints, KVS connection, telemetry publisher —
+    stays warm for the next job (the daemon's whole reason to exist).
+    The worker loop frees the job communicator itself."""
     global _world, _self_comm, _initialized
+    if _world_stack:
+        pop_world()
+        return
     from ompi_tpu.core import hooks
 
     hooks.fire("mpi_finalize_top", world=_world)

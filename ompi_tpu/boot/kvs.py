@@ -113,6 +113,20 @@ class KVSServer:
         except (ConnectionError, OSError):
             return
 
+    def peek(self, key: str, default: Any = None) -> Any:
+        """In-process non-blocking read (the launcher/daemon side owns
+        the server object, so it need not dial its own socket to poll
+        job-completion keys)."""
+        with self._cond:
+            return self._data.get(key, default)
+
+    def put_local(self, key: str, value: Any) -> None:
+        """In-process put (the daemon publishes job directives on the
+        same store the workers' KVSClients read)."""
+        with self._cond:
+            self._data[key] = value
+            self._cond.notify_all()
+
     def close(self) -> None:
         self._running = False
         try:

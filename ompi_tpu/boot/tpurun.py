@@ -66,6 +66,64 @@ def _truthy(v) -> bool:
     return str(v or "").strip().lower() in _TRUE_STRINGS
 
 
+def worker_cmd(argv: list[str]) -> list[str]:
+    """The per-rank exec vector: native executables (compiled against
+    libtpumpi) run directly; .py scripts go through the interpreter.
+    Absolute path for executables: a bare filename would hit execvp
+    PATH lookup instead of the file we just stat'ed."""
+    first = argv[0]
+    if first.endswith(".py") or not (
+        os.path.isfile(first) and os.access(first, os.X_OK)
+    ):
+        return [sys.executable] + argv
+    return [os.path.abspath(first)] + argv[1:]
+
+
+def worker_env(rank: int, np_: int, kvs_address: str,
+               mca: dict[str, str] | None = None,
+               cpu_devices: int | None = None,
+               extra_env: dict[str, str] | None = None,
+               telemetry_addr: str | None = None) -> dict[str, str]:
+    """One rank's environment (shared by ``run_job`` and the tpud
+    daemon's resident-worker spawn path): framework on PYTHONPATH
+    (≈ mpirun's LD_LIBRARY_PATH forwarding for libmpi), rank/size/
+    rendezvous coordinates, ``--mca`` params as ``OMPI_MCA_*``, and
+    the CPU-device virtualization for TPU-less testing."""
+    import ompi_tpu
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(ompi_tpu.__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env[ENV_PROC] = str(rank)
+    env[ENV_NPROCS] = str(np_)
+    env[ENV_KVS] = kvs_address
+    if telemetry_addr:
+        from ompi_tpu.metrics.live import ENV_TELEMETRY
+
+        env[ENV_TELEMETRY] = telemetry_addr
+    for k, v in (mca or {}).items():
+        env[f"OMPI_MCA_{k}"] = v
+    if cpu_devices is not None:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={cpu_devices}"
+        ).strip()
+        # CPU-only workers must not touch TPU plugin site hooks:
+        # some PJRT plugin sitecustomize modules dial the device
+        # service at interpreter start regardless of JAX_PLATFORMS
+        # and can block the whole job on a wedged fabric.
+        env["PYTHONPATH"] = ":".join(
+            p for p in env["PYTHONPATH"].split(":")
+            if p and "axon" not in p
+        )
+    env.update(extra_env or {})
+    return env
+
+
 #: host names the plm treats as THIS machine (fork instead of rsh)
 _LOCAL_NAMES = {"localhost", "127.0.0.1"}
 
@@ -207,52 +265,15 @@ def run_job(
         t.start()
         threads.append(t)
         return p
-    # workers must find the framework regardless of script location
-    # (≈ mpirun's LD_LIBRARY_PATH forwarding for libmpi)
-    import ompi_tpu
-
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ompi_tpu.__file__)))
     try:
         for rank in range(np_):
-            env = dict(os.environ)
-            env["PYTHONPATH"] = pkg_root + (
-                ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            env = worker_env(
+                rank, np_, server.address, mca=mca,
+                cpu_devices=cpu_devices, extra_env=extra_env,
+                telemetry_addr=(telemetry.ingest_address
+                                if telemetry is not None else None),
             )
-            env[ENV_PROC] = str(rank)
-            env[ENV_NPROCS] = str(np_)
-            env[ENV_KVS] = server.address
-            if telemetry is not None:
-                from ompi_tpu.metrics.live import ENV_TELEMETRY
-
-                env[ENV_TELEMETRY] = telemetry.ingest_address
-            for k, v in (mca or {}).items():
-                env[f"OMPI_MCA_{k}"] = v
-            if cpu_devices is not None:
-                env["JAX_PLATFORMS"] = "cpu"
-                env["XLA_FLAGS"] = (
-                    env.get("XLA_FLAGS", "")
-                    + f" --xla_force_host_platform_device_count={cpu_devices}"
-                ).strip()
-                # CPU-only workers must not touch TPU plugin site hooks:
-                # some PJRT plugin sitecustomize modules dial the device
-                # service at interpreter start regardless of JAX_PLATFORMS
-                # and can block the whole job on a wedged fabric.
-                env["PYTHONPATH"] = ":".join(
-                    p for p in env["PYTHONPATH"].split(":")
-                    if p and "axon" not in p
-                )
-            env.update(extra_env or {})
-            # native executables (compiled against libtpumpi) run
-            # directly; .py scripts go through the interpreter
-            first = argv[0]
-            if first.endswith(".py") or not (
-                os.path.isfile(first) and os.access(first, os.X_OK)
-            ):
-                cmd = [sys.executable] + argv
-            else:
-                # absolute path: a bare filename would hit execvp PATH
-                # lookup instead of the file we just stat'ed
-                cmd = [os.path.abspath(first)] + argv[1:]
+            cmd = worker_cmd(argv)
             target = rank_host[rank] if rank_host else None
             # plm/rsh: _final_cmd reproduces the worker env on the
             # remote host (and is re-evaluated on every respawn)
@@ -327,6 +348,14 @@ def main(argv: list[str] | None = None) -> int:
         help="per-process virtual CPU device count (testing without TPU)",
     )
     parser.add_argument(
+        "--daemon", action="store_true",
+        help="start a persistent serving daemon (tpud) instead of one "
+        "job: the rank workers, their DCN endpoints, and the boot KVS "
+        "stay warm across jobs submitted via tools/tpud_ctl.py or "
+        "ompi_tpu.api.tpud_submit (no script argument; see "
+        "ompi_tpu/serve/)",
+    )
+    parser.add_argument(
         "--ft", action="store_true",
         help="fault-tolerant job: worker death does not kill the job; "
         "heartbeat failure detection + ULFM recovery in the workers",
@@ -380,10 +409,34 @@ def main(argv: list[str] | None = None) -> int:
         help="address the KVS/rendezvous server binds (must be reachable "
         "from every host; default 127.0.0.1 is single-host)",
     )
-    parser.add_argument("script", help="python script to run")
+    parser.add_argument("script", nargs="?", default=None,
+                        help="python script to run (omitted with --daemon)")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(argv)
     mca = {k: v for k, v in ns.mca}
+    if ns.daemon:
+        # persistent serving plane: delegate to the tpud daemon (the
+        # one-shot path below stays byte-identical when --daemon is
+        # absent — no new threads, no new sockets)
+        from ompi_tpu.serve.daemon import run_daemon
+
+        if ns.script is not None:
+            parser.error("--daemon takes no script (submit jobs via "
+                         "tools/tpud_ctl.py)")
+        # flags the daemon path does not (yet) honor must fail loudly,
+        # not come up silently single-host/non-ft (--ft is implied:
+        # the daemon always runs the detector + respawn plane)
+        for flag, val in (("--hostfile", ns.hostfile), ("--host", ns.host),
+                          ("--kvs-host", ns.kvs_host),
+                          ("--ft", ns.ft), ("--respawn", ns.respawn)):
+            if val:
+                parser.error(f"{flag} is not supported with --daemon "
+                             "(single-host daemon; ft/respawn are "
+                             "built in)")
+        return run_daemon(ns.np, mca=mca, cpu_devices=ns.cpu_devices,
+                          max_respawns=ns.max_respawns)
+    if ns.script is None:
+        parser.error("the following arguments are required: script")
     hosts = None
     if ns.hostfile:
         from .rmaps import parse_hostfile

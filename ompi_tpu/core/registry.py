@@ -35,6 +35,7 @@ from .var import (
     full_var_name,
     register_observability_vars,
     register_robustness_vars,
+    register_serving_vars,
 )
 
 
@@ -243,6 +244,7 @@ class MCAContext:
         # the dcn deadline + faultsim knobs follow the same rule
         register_observability_vars(self.store)
         register_robustness_vars(self.store)
+        register_serving_vars(self.store)
         self.frameworks: dict[str, Framework] = {}
         self._register_builtin_components()
 

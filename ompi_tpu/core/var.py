@@ -460,6 +460,48 @@ def register_robustness_vars(store: "VarStore") -> None:
         store.register(fw, comp, name, default, type=typ, help=help_)
 
 
+# -- serving variables (central registration, same pattern) --------------
+#
+# The tpud persistent-serving plane's tenant quotas and daemon knobs.
+# Consumed by ompi_tpu.serve (lazily imported by tools/tpud.py and the
+# tpurun --daemon path) but introspectable on every store, exactly like
+# the observability/robustness sets.
+
+#: (framework, component, name, default, type, help)
+SERVING_VARS = (
+    ("serve", "", "max_pending", 8, "int",
+     "Per-tenant admission quota: a tpud submit is rejected (HTTP 429) "
+     "while the tenant already has this many jobs queued or running "
+     "(admission control; 0 = unlimited)"),
+    ("serve", "", "cid_block", 4096, "int",
+     "CID-space block reserved per served job: every job's communicator "
+     "world (and any comms it derives) lives in a disjoint "
+     "[base, base+block) CID range, so per-(comm, op) sequence counters "
+     "start clean without re-dialing anything"),
+    ("serve", "", "cid_base", 1 << 20, "int",
+     "First CID block handed to a served job (above anything the boot "
+     "rendezvous or a normal run allocates)"),
+    ("serve", "", "port", 0, "int",
+     "HTTP port the tpud ops/scrape endpoint serves on (0 = pick an "
+     "ephemeral port and print the URL at daemon start)"),
+    ("serve", "", "poll_ms", 50, "int",
+     "Milliseconds between a resident worker's polls of the job stream "
+     "while idle (the KVS long-poll quantum)"),
+    ("serve", "", "tenant", "default", "string",
+     "Default tenant name a tpud submit is accounted against when the "
+     "client names none"),
+    ("serve", "", "job_timeout", 0.0, "float",
+     "Seconds the daemon lets one job run before marking it failed and "
+     "freeing its rank-set (0 = unbounded)"),
+)
+
+
+def register_serving_vars(store: "VarStore") -> None:
+    """Register the tpud serving knobs on a store (idempotent)."""
+    for fw, comp, name, default, typ, help_ in SERVING_VARS:
+        store.register(fw, comp, name, default, type=typ, help=help_)
+
+
 def dcn_timeout(name: str) -> float:
     """Resolve one ``dcn_<name>_timeout`` against the default MCA
     context — the single lookup every blocking DCN wait shares.  Falls

@@ -139,7 +139,10 @@ def load_library():
         lib.tdcn_fault_events.restype = U64
         lib.tdcn_fault_events.argtypes = []
         lib.tdcn_fault_set_conn.argtypes = [I64]
+        lib.tdcn_fault_set_dup.argtypes = [I64]
         lib.tdcn_fault_set_recv.argtypes = [U64, U64]
+        lib.tdcn_rx_watermark.restype = U64
+        lib.tdcn_rx_watermark.argtypes = [P, I]
         lib.tdcn_chan_kill.argtypes = [P, U64]
         lib.tdcn_kill_peer.argtypes = [P, S]
         lib.tdcn_clear_failed.argtypes = [P, I]
@@ -524,6 +527,9 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
             conn_at = _fsim.native_conn_args()
             if conn_at >= 0:
                 self._lib.tdcn_fault_set_conn(conn_at)
+            dup_at = _fsim.native_dup_args()
+            if dup_at >= 0:
+                self._lib.tdcn_fault_set_dup(dup_at)
             recv_ns, recv_every = _fsim.native_recv_args()
             if recv_ns:
                 self._lib.tdcn_fault_set_recv(recv_ns, recv_every)
@@ -745,11 +751,21 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
     def note_proc_recovered(self, proc: int) -> None:
         """replace(): a respawned incarnation re-published its endpoint
         — clear the C failure mark (blocked recvs naming it resume
-        waiting instead of raising) and the rx dedup watermark (the
-        reborn sender restarts its seq), then the shared Python-side
-        recovery (detector clear + respawn accounting)."""
+        waiting instead of raising), then the shared Python-side
+        recovery (detector clear + respawn accounting).  The rx dedup
+        watermark deliberately SURVIVES the clear: a false-positive
+        mark's sender is still the same lineage, and regressing its
+        watermark would let a post-clear resend round re-deliver; the
+        genuinely-dead corpse's state is pruned when set_addresses
+        installs the reborn endpoint (address change = lineage proof)."""
         self._lib.tdcn_clear_failed(self._h, proc)
         super().note_proc_recovered(proc)
+
+    def rx_watermark(self, proc: int) -> int:
+        """Contiguous delivered-seq watermark for frames from ``proc``
+        (max over its sender lineages; recovery observability + the
+        watermark-continuity tests)."""
+        return int(self._lib.tdcn_rx_watermark(self._h, int(proc)))
 
     def _bump_stat(self, name: str) -> None:
         self._py_stats[name] = self._py_stats.get(name, 0) + 1

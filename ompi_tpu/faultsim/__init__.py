@@ -48,6 +48,7 @@ from .core import (  # noqa: F401
     disable,
     enabled,
     injected,
+    native_dup_args,
     native_ring_args,
     parse_plan,
     reset,

@@ -250,6 +250,7 @@ def disable() -> None:
         if _native._lib is not None:
             _native._lib.tdcn_fault_set(0, 1, -1)
             _native._lib.tdcn_fault_set_conn(-1)
+            _native._lib.tdcn_fault_set_dup(-1)
             _native._lib.tdcn_fault_set_recv(0, 1)
     except Exception:  # noqa: BLE001 — teardown must not raise
         pass
@@ -339,6 +340,23 @@ def native_conn_args() -> int:
         if r.proc is not None and r.proc != plan.proc:
             continue
         if r.kind == "connkill" and r.at is not None:
+            return r.at
+    return -1
+
+
+def native_dup_args() -> int:
+    """``dup_at`` for ``tdcn_fault_set_dup`` — the seeded plan's wire-
+    duplicate rule on the native plane: the Nth seq-carrying eager tcp
+    send is transmitted twice, so the receiver's dedup watermark must
+    absorb a true duplicate.  Only ``at`` rules map (the C side keeps
+    its own event counter); -1 = disarmed."""
+    plan = _plan
+    if plan is None:
+        return -1
+    for r in plan.rules:
+        if r.proc is not None and r.proc != plan.proc:
+            continue
+        if r.kind == "dup" and r.at is not None:
             return r.at
     return -1
 

@@ -95,6 +95,16 @@ class TelemetryAggregator:
                  host: str = "127.0.0.1"):
         self._lock = threading.Lock()
         self._running = True
+        #: extension routes (the tpud ops surface mounts here):
+        #: (method, path) → callable(body_bytes) -> (status, ctype, body)
+        self._routes: dict[tuple[str, str], Any] = {}
+        #: job scoping (serve plane): per-proc counter baselines keyed
+        #: by the job each proc is currently serving, so a second job's
+        #: scrape starts from zero instead of the first job's totals;
+        #: per-job frame bookkeeping feeds /jobs
+        self._job_baseline: dict[int, dict[str, int]] = {}
+        self._job_of: dict[int, str] = {}
+        self._jobs_seen: dict[str, dict] = {}
         #: latest frame per proc (the scrape source)
         self._latest: dict[int, dict] = {}
         #: JSONL history ring of every ingested frame
@@ -135,12 +145,50 @@ class TelemetryAggregator:
             def log_message(self, *a):  # scrapes must not spam stdio
                 pass
 
+            def _reply(self, status: int, ctype: str, body: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self, method: str, body: bytes) -> bool:
+                """Extension routes (the tpud ops surface) — matched on
+                the path component, longest prefix first, so a daemon
+                can override a built-in endpoint (e.g. /jobs)."""
+                path = self.path.split("?", 1)[0]
+                hits = [(p, fn) for (m, p), fn in agg._routes.items()
+                        if m == method
+                        and (path == p or path.startswith(p + "/"))]
+                if not hits:
+                    return False
+                _, fn = max(hits, key=lambda h: len(h[0]))
+                try:
+                    status, ctype, out = fn(path, body)
+                except Exception as e:  # noqa: BLE001 — ops must answer
+                    status, ctype = 500, "application/json"
+                    out = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                self._reply(status, ctype, out)
+                return True
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                if not self._route("POST", body):
+                    self.send_error(404)
+
             def do_GET(self):
+                if self._route("GET", b""):
+                    return
                 if self.path.startswith("/metrics"):
                     body = agg.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/json"):
                     body = json.dumps(agg.json_state()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/jobs"):
+                    body = json.dumps(agg.jobs_state()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/history"):
                     with agg._lock:
@@ -151,11 +199,7 @@ class TelemetryAggregator:
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reply(200, ctype, body)
 
         self._http = ThreadingHTTPServer((host, int(http_port)), Handler)
         self._http.daemon_threads = True
@@ -163,6 +207,62 @@ class TelemetryAggregator:
         self.url = f"http://{host}:{self.http_port}"
         threading.Thread(target=self._http.serve_forever, daemon=True,
                          name="telemetry-http").start()
+
+    # -- extension surface (the tpud ops endpoints mount here) ----------
+
+    def add_route(self, method: str, path: str, fn) -> None:
+        """Mount ``fn(path, body_bytes) -> (status, ctype, body_bytes)``
+        at ``(method, path)``; extension routes win over the built-in
+        endpoints, so a daemon can serve a richer ``/jobs``."""
+        self._routes[(method.upper(), path)] = fn
+
+    # -- job scoping (serve plane) --------------------------------------
+
+    def begin_job(self, job_id: str, procs=None) -> None:
+        """Start a job scope on ``procs`` (default: every known proc):
+        snapshot the procs' current native counters as the job's
+        baseline — the PR-5 counters are grow-only per PROCESS, so
+        without this a second job's scrape reads the first job's
+        totals — and reset the rolling straggler attribution IN PLACE
+        (keys survive zeroed, the spc.py reset contract), since
+        arrival-skew history from a finished job says nothing about
+        the next one's stragglers."""
+        with self._lock:
+            targets = (set(int(p) for p in procs) if procs is not None
+                       else set(self._latest) | set(range(self._nprocs)))
+            for p in targets:
+                f = self._latest.get(p) or {}
+                self._job_baseline[p] = {
+                    k: int(v) for k, v in (f.get("native") or {}).items()}
+                self._job_of[p] = str(job_id)
+            self._jobs_seen.setdefault(
+                str(job_id),
+                {"frames": 0, "procs": sorted(targets),
+                 "first_ts_ns": time.time_ns()})
+            # reset-in-place: zero every rolling value, keep every key
+            for st in self._op_skew.values():
+                st["n"] = 0
+                st["skew_ns"] = 0
+                st["max_skew_ns"] = 0
+                for k in st["slowest"]:
+                    st["slowest"][k] = 0
+            for sc in self._scores.values():
+                sc["ewma_ns"] = 0.0
+                sc["slowest"] = 0
+                sc["n"] = 0
+                sc["skew_ns"] = 0
+            self._pending.clear()
+            self._pending_order.clear()
+
+    def jobs_state(self) -> dict:
+        """The /jobs feed: every job id seen in frames or begun
+        explicitly, with frame counts and the procs currently scoped
+        to it."""
+        with self._lock:
+            return {
+                "jobs": {j: dict(st) for j, st in self._jobs_seen.items()},
+                "current": {str(p): j for p, j in self._job_of.items()},
+            }
 
     # -- ingest ---------------------------------------------------------
 
@@ -194,6 +294,15 @@ class TelemetryAggregator:
             self.frames += 1
             self._latest[proc] = frame
             self._history.append(frame)
+            job = frame.get("job")
+            if job is not None:
+                st = self._jobs_seen.setdefault(
+                    str(job), {"frames": 0, "procs": [],
+                               "first_ts_ns": time.time_ns()})
+                st["frames"] += 1
+                st["last_ts_ns"] = int(frame.get("ts_ns", 0))
+                if proc not in st["procs"]:
+                    st["procs"] = sorted(set(st["procs"]) | {proc})
             self._nprocs = max(self._nprocs,
                                int(frame.get("nprocs", 0)), proc + 1)
             for k, v in (frame.get("clock") or {}).items():
@@ -289,6 +398,8 @@ class TelemetryAggregator:
             scores = {p: dict(s) for p, s in self._scores.items()}
             op_skew = {op: dict(st) for op, st in self._op_skew.items()}
             frames = self.frames
+            baselines = {p: dict(b) for p, b in self._job_baseline.items()}
+            job_of = dict(self._job_of)
         from ompi_tpu.metrics import core as _core
 
         lines: list[str] = [
@@ -305,12 +416,24 @@ class TelemetryAggregator:
         names = [k for k in _core.NATIVE_COUNTERS
                  if any((f.get("native") or {}).get(k)
                         for f in latest.values())]
+
+        def _dcn_sample(p: int, k: str) -> tuple[str, int]:
+            """(label, value) for one proc's counter: under a job scope
+            (serve plane) the series carries a ``job`` label and reads
+            relative to the job's begin_job baseline, so the second job
+            on a warm mesh scrapes from zero; without one (plain
+            tpurun) it is the PR-5 raw process total, unlabeled."""
+            v = int((latest[p].get("native") or {}).get(k, 0))
+            job = job_of.get(p)
+            if job is None:
+                return f'{{proc="{p}"}}', v
+            base = int(baselines.get(p, {}).get(k, 0))
+            return (f'{{proc="{p}",job="{job}"}}', max(0, v - base))
+
         for k in names:
             _export.dcn_family(
                 lines, k,
-                [(f'{{proc="{p}"}}',
-                  int((latest[p].get("native") or {}).get(k, 0)))
-                 for p in sorted(latest)],
+                [_dcn_sample(p, k) for p in sorted(latest)],
                 origin="Live")
         # per-op call/byte/wait totals from the rank-local aggregates
         for fam, field, help_ in (
@@ -382,6 +505,22 @@ class TelemetryAggregator:
 
 # -- publisher (one per rank) ------------------------------------------
 
+#: serve plane: the job this rank is currently running (frames carry it
+#: so the aggregator can scope counters + /jobs per job); None outside
+#: a served job — the frame omits the field and nothing changes
+_job_label: str | None = None
+
+
+def set_job(job_id: str | None) -> None:
+    """Label this rank's telemetry frames with the job it is serving
+    (tpud worker loop); ``None`` clears the label between jobs."""
+    global _job_label
+    _job_label = None if job_id is None else str(job_id)
+
+
+def current_job() -> str | None:
+    return _job_label
+
 
 class TelemetryPublisher:
     """Per-rank frame pump: snapshot → one JSON frame → the launcher.
@@ -415,6 +554,8 @@ class TelemetryPublisher:
             "straggler": _straggler.summary(),
             "colls": _straggler.drain_recent(),
         }
+        if _job_label is not None:
+            f["job"] = _job_label
         clock = _core.clock_offsets()
         if clock:
             f["clock"] = {str(p): list(v) for p, v in clock.items()}

@@ -1,0 +1,49 @@
+"""Persistent serving plane — ``tpud`` (≈ orted/prted, SURVEY.md §3.1).
+
+The reference runtime keeps a daemon alive across jobs (PAPER.md §1:
+ORTE/PRRTE ``orted``/``prted`` — plm/odls/rmaps exist so launches reuse
+a standing infrastructure); our ``tpurun`` boots a full world per
+invocation — rendezvous, endpoint dials, engine threads — and tears it
+down at exit.  This package promotes that per-job world into a
+long-lived serving plane:
+
+* :mod:`~ompi_tpu.serve.daemon` — ``TpuDaemon``: hosts the boot KVS and
+  the live-telemetry aggregator (its HTTP endpoint doubles as the ops
+  surface: submit/status/drain/shutdown/scale), spawns N **resident**
+  rank workers, gang-schedules a multi-tenant job queue onto them
+  (FIFO + per-tenant round-robin; a job runs when its full rank-set is
+  free), enforces per-tenant admission quotas (``serve_max_pending``),
+  and fires the elastic plane itself — a dead worker is respawned and
+  restored via ``replace()`` (scale-up), ``/scale`` retires ranks
+  (scale-down) — instead of recovery running only on failure;
+* :mod:`~ompi_tpu.serve.worker` — the resident rank loop: boot once,
+  then serve jobs forever; each job gets a disjoint CID block and a
+  fresh ``MPI_COMM_WORLD``-equivalent carved from the warm mesh with
+  **zero traffic and zero re-dials**, runs its script in-process
+  (``api.init()`` inside the script returns the job world, its
+  ``finalize()`` re-arms instead of tearing down), and reports a
+  completion record;
+* :mod:`~ompi_tpu.serve.client` — the attach-to-daemon HTTP client
+  (``ompi_tpu.api.tpud_submit`` and ``tools/tpud_ctl.py`` ride it).
+
+Start one with ``tpurun --daemon -np N`` or ``python tools/tpud.py``;
+knobs live in the centrally registered ``SERVING_VARS``
+(``core/var.py``) like the observability/robustness sets.
+"""
+
+from __future__ import annotations
+
+#: the job record the resident worker is currently serving (None when
+#: idle) — job scripts can introspect it via :func:`current_job`
+_current: dict | None = None
+
+
+def current_job() -> dict | None:
+    """The job descriptor this process is serving right now (tenant,
+    id, cid_base, args) — None outside a served job."""
+    return _current
+
+
+def _set_current(job: dict | None) -> None:
+    global _current
+    _current = job

@@ -1,0 +1,500 @@
+"""``tpud`` — the persistent serving daemon (≈ orted/prted).
+
+One daemon process owns the standing infrastructure a ``tpurun`` job
+normally builds and discards per invocation:
+
+* the boot **KVS** (rendezvous server) — resident workers boot against
+  it once and then treat it as the job stream: the daemon publishes
+  numbered directives (``serve.job.<n>``), workers long-poll them and
+  answer with completion records (``serve.done.<n>.<proc>``);
+* the **live-telemetry aggregator** — always on; its HTTP endpoint is
+  the daemon's ops surface (``/submit``, ``/jobs``, ``/job/<id>``,
+  ``/drain``, ``/shutdown``, ``/scale`` mounted next to the PR-5
+  ``/metrics``/``/json``/``/history`` scrape endpoints), and its
+  queue-depth/health feeds drive admission and scheduling;
+* N **resident rank workers** (``ompi_tpu.serve.worker``) whose DCN
+  endpoints — both planes — engine threads, and compiled collective
+  state stay warm across jobs;
+* the **elastic plane, daemon-fired**: a dead worker is respawned
+  under a bumped incarnation and restored by a ``repair`` directive
+  (survivors run ``replace()``, the reborn rank rejoins — scale-up),
+  and ``/scale`` retires ranks (scale-down) or brings retirees back
+  through the same respawn+repair leg.
+
+Scheduling is **gang** FIFO with per-tenant round-robin fairness
+(:mod:`~ompi_tpu.serve.queue`): a job is published only when its full
+rank-set is free, and never while the mesh is unhealthy (dead worker,
+repair outstanding) — the telemetry plane's detector feed gating the
+job stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from ompi_tpu.boot.kvs import KVSServer
+from ompi_tpu.boot.proc import ENV_INCARNATION
+from ompi_tpu.boot.tpurun import _forward, worker_env
+from ompi_tpu.core.var import ENV_PREFIXES, SERVING_VARS, full_var_name
+from ompi_tpu.metrics.live import TelemetryAggregator
+from .queue import AdmissionError, JobQueue
+
+#: KVS key prefixes of the serve protocol (workers mirror these)
+K_JOB = "serve.job."        # + <n>            → directive JSON
+K_DONE = "serve.done."      # + <n>.<proc>     → completion record
+K_RESUME = "serve.resume."  # + <proc>.i<inc>  → reborn worker's cursor
+
+
+def serve_var(mca: dict | None, name: str):
+    """Resolve one ``serve_<name>`` knob daemon-side (no MCA context in
+    the launcher process, same as tpurun's telemetry gate): ``--mca``
+    dict → ``OMPI_MCA_*`` env → the SERVING_VARS default."""
+    full = f"serve_{name}"
+    if mca and full in mca:
+        return mca[full]
+    for prefix in ENV_PREFIXES:
+        v = os.environ.get(prefix + full)
+        if v is not None:
+            return v
+    for fw, comp, n, default, _typ, _h in SERVING_VARS:
+        if full_var_name(fw, comp, n) == full:
+            return default
+    raise KeyError(full)
+
+
+class TpuDaemon:
+    """The serving daemon.  ``spawn=False`` builds the full control
+    plane (KVS, aggregator, queue, ops routes) without resident
+    workers — the selftest/unit harness pumps the job stream itself."""
+
+    def __init__(self, np_: int, mca: dict[str, str] | None = None,
+                 cpu_devices: int | None = None, max_respawns: int = 2,
+                 http_port: int | None = None, spawn: bool = True):
+        self.np = int(np_)
+        self.mca = dict(mca or {})
+        self.cpu_devices = cpu_devices
+        self.max_respawns = int(max_respawns)
+        self._spawn_workers = spawn
+        self.cid_block = int(serve_var(self.mca, "cid_block"))
+        self.cid_next = int(serve_var(self.mca, "cid_base"))
+        self.job_timeout = float(serve_var(self.mca, "job_timeout"))
+        self._lock = threading.RLock()
+        self.server = KVSServer()
+        self.aggregator = TelemetryAggregator(
+            http_port=(int(serve_var(self.mca, "port"))
+                       if http_port is None else int(http_port)))
+        self.url = self.aggregator.url
+        self.queue = JobQueue(
+            self.np, max_pending=int(serve_var(self.mca, "max_pending")))
+        self._mount_routes()
+        #: next directive index (the job-stream cursor)
+        self.cursor = 0
+        #: directive index → bookkeeping ({kind, procs, job_id, done})
+        self._outstanding: dict[int, dict] = {}
+        #: per-proc worker state: process handle + incarnation + status
+        #: in {"active", "dead", "retired", "exited"}
+        self._procs: list[subprocess.Popen | None] = [None] * self.np
+        self._incarnation = [0] * self.np
+        self._status = ["active"] * self.np
+        self._threads: list[threading.Thread] = []
+        #: procs awaiting the repair directive (respawned, not yet
+        #: restored into the world by the survivors' replace())
+        self._repairing: set[int] = set()
+        self._repair_published = False
+        self.shutting_down = False
+        self._shutdown_published = False
+        self.exit_code = 0
+        if spawn:
+            for rank in range(self.np):
+                self._procs[rank] = self._spawn(rank)
+
+    # -- worker lifecycle ------------------------------------------------
+
+    def _worker_mca(self) -> dict[str, str]:
+        m = dict(self.mca)
+        # the serving plane is built ON the observability + elastic
+        # planes: frames feed the ops surface, the detector feeds
+        # repair — both non-negotiable for a daemon
+        m["telemetry_enable"] = "1"
+        m["ft_detector_enable"] = "1"
+        return m
+
+    def _spawn(self, rank: int) -> subprocess.Popen:
+        env = worker_env(
+            rank, self.np, self.server.address, mca=self._worker_mca(),
+            cpu_devices=self.cpu_devices,
+            telemetry_addr=self.aggregator.ingest_address)
+        if self._incarnation[rank]:
+            env[ENV_INCARNATION] = str(self._incarnation[rank])
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ompi_tpu.serve.worker"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        t = threading.Thread(
+            target=_forward, args=(p.stdout, str(rank), sys.stdout.buffer),
+            daemon=True)
+        t.start()
+        self._threads.append(t)
+        return p
+
+    # -- ops surface (mounted on the aggregator's HTTP endpoint) --------
+
+    def _mount_routes(self) -> None:
+        agg = self.aggregator
+        agg.add_route("POST", "/submit", self._r_submit)
+        agg.add_route("GET", "/jobs", self._r_jobs)
+        agg.add_route("GET", "/job", self._r_job)
+        agg.add_route("POST", "/drain", self._r_drain)
+        agg.add_route("POST", "/shutdown", self._r_shutdown)
+        agg.add_route("POST", "/scale", self._r_scale)
+
+    @staticmethod
+    def _json(status: int, obj) -> tuple[int, str, bytes]:
+        return status, "application/json", json.dumps(obj).encode()
+
+    def _r_submit(self, path, body):
+        try:
+            req = json.loads(body.decode() or "{}")
+        except ValueError:
+            return self._json(400, {"error": "bad JSON body"})
+        if not req.get("script"):
+            return self._json(400, {"error": "missing 'script'"})
+        tenant = req.get("tenant") or str(serve_var(self.mca, "tenant"))
+        try:
+            job = self.queue.submit(
+                req["script"], args=req.get("args") or (),
+                tenant=tenant, nprocs=req.get("nprocs"),
+                env=req.get("env"))
+        except AdmissionError as e:
+            return self._json(e.status, {"error": str(e)})
+        return self._json(200, job)
+
+    def _r_jobs(self, path, body):
+        st = self.queue.state()
+        with self._lock:
+            st["procs"] = {
+                str(r): {"status": self._status[r],
+                         "incarnation": self._incarnation[r]}
+                for r in range(self.np)}
+            st["healthy"] = self._healthy_locked()
+            st["cursor"] = self.cursor
+        st["telemetry"] = self.aggregator.jobs_state()
+        st["url"] = self.url
+        return self._json(200, st)
+
+    def _r_job(self, path, body):
+        job_id = path.rsplit("/", 1)[-1]
+        job = self.queue.get(job_id)
+        if job is None:
+            return self._json(404, {"error": f"no such job {job_id!r}"})
+        return self._json(200, job)
+
+    def _r_drain(self, path, body):
+        self.queue.draining = True
+        return self._json(200, {"draining": True})
+
+    def _r_shutdown(self, path, body):
+        self.queue.draining = True
+        self.shutting_down = True
+        return self._json(200, {"shutting_down": True})
+
+    def _r_scale(self, path, body):
+        try:
+            want = int(json.loads(body.decode() or "{}")["nprocs"])
+        except (ValueError, KeyError):
+            return self._json(400, {"error": "body must be "
+                                             '{"nprocs": <int>}'})
+        if not 0 < want <= self.np:
+            return self._json(400, {"error": f"nprocs must be in "
+                                             f"[1, {self.np}]"})
+        with self._lock:
+            active = [r for r in range(self.np)
+                      if self._status[r] == "active"]
+            if want < len(active):
+                retire = active[want:]
+                self._publish({"kind": "retire", "procs": active,
+                               "retire": retire})
+                for r in retire:
+                    self._status[r] = "retiring"
+                return self._json(200, {"retiring": retire})
+            grow = [r for r in range(self.np)
+                    if self._status[r] in ("retired", "dead")][
+                        :want - len(active)]
+            for r in grow:
+                self._respawn_locked(r)
+            return self._json(
+                200, {"restoring": grow} if grow else {"unchanged": True})
+
+    # -- directive stream ------------------------------------------------
+
+    def _publish(self, directive: dict) -> int:
+        """Append one directive to the job stream; workers consume
+        indices in order, so publication order IS execution order."""
+        with self._lock:
+            idx = self.cursor
+            self.cursor += 1
+            d = dict(directive)
+            d["idx"] = idx
+            self._outstanding[idx] = {
+                "kind": d.get("kind", "job"),
+                "procs": list(d.get("procs") or range(self.np)),
+                "job_id": d.get("id"),
+                "done": {},
+                "ts": time.monotonic(),
+            }
+            self.server.put_local(f"{K_JOB}{idx}", d)
+            return idx
+
+    def _publish_job(self, job: dict) -> None:
+        base = self.cid_next
+        self.cid_next += self.cid_block
+        job["cid_base"] = base
+        job["cid_span"] = self.cid_block
+        # job-scoped telemetry: frames from these procs now label this
+        # job and /metrics reads relative to this instant's baselines
+        self.aggregator.begin_job(job["id"], procs=job["procs"])
+        self._publish({"kind": "job", **{
+            k: job[k] for k in ("id", "tenant", "script", "args", "env",
+                                "procs", "cid_base", "cid_span")}})
+
+    # -- failure / elastic plane ----------------------------------------
+
+    def _respawn_locked(self, rank: int) -> None:
+        """Scale-up leg (shared by death recovery and /scale restore):
+        relaunch the rank under a bumped incarnation and queue the
+        repair that will ``replace()`` it back into the warm world."""
+        self._incarnation[rank] += 1
+        self._status[rank] = "respawning"
+        self._repairing.add(rank)
+        self._repair_published = False
+        self._procs[rank] = self._spawn(rank)
+
+    def _handle_death(self, rank: int, rc: int) -> None:
+        with self._lock:
+            if self._status[rank] == "retiring":
+                self._status[rank] = "retired"
+                return
+            if self.shutting_down and self._shutdown_published:
+                self._status[rank] = "exited"
+                return
+            # a died worker fails its directive's gang: synthesize its
+            # completion record so survivors' reports can close it out
+            for st in self._outstanding.values():
+                if rank in st["procs"] and rank not in st["done"]:
+                    st["done"][rank] = {"ok": False,
+                                        "error": f"rank died (rc={rc})"}
+            if self._incarnation[rank] >= self.max_respawns:
+                print(f"[tpud] rank {rank} died (rc={rc}); respawn "
+                      f"budget exhausted — marking it dead", flush=True)
+                self._status[rank] = "dead"
+                return
+            print(f"[tpud] rank {rank} died (rc={rc}); respawning "
+                  f"(incarnation {self._incarnation[rank] + 1})",
+                  flush=True)
+            self._respawn_locked(rank)
+
+    def _maybe_publish_repair(self) -> None:
+        """Publish ONE repair directive once every rank-set is free:
+        survivors run ``replace()`` (awaiting the reborn incarnations),
+        the reborn workers rejoin through the replace beacon and then
+        resume the stream AFTER this directive (their cursor is the
+        ``serve.resume`` key written here)."""
+        with self._lock:
+            if (not self._repairing or self._repair_published
+                    or any(st["kind"] != "repair"
+                           for st in self._outstanding.values())):
+                return
+            if any(self._status[r] == "respawning" and
+                   (self._procs[r] is None or
+                    self._procs[r].poll() is not None)
+                   for r in self._repairing):
+                return  # a respawn died before repair; death path re-arms
+            survivors = [r for r in range(self.np)
+                         if self._status[r] == "active"]
+            if not survivors:
+                return
+            idx = self._publish({
+                "kind": "repair", "procs": survivors,
+                "dead": sorted(self._repairing)})
+            for r in sorted(self._repairing):
+                self.server.put_local(
+                    f"{K_RESUME}{r}.i{self._incarnation[r]}", idx + 1)
+            self._repair_published = True
+
+    # -- monitor loop ----------------------------------------------------
+
+    def _healthy_locked(self) -> bool:
+        return not self._repairing and all(
+            s in ("active", "retired", "dead", "exited")
+            for s in self._status)
+
+    def _poll_workers(self) -> None:
+        for r in range(self.np):
+            p = self._procs[r]
+            if p is None or self._status[r] in ("retired", "dead",
+                                                "exited"):
+                continue
+            rc = p.poll()
+            if rc is not None:
+                self._handle_death(r, rc or 0)
+
+    def _collect_done(self) -> None:
+        done_idx = []
+        with self._lock:
+            for idx, st in self._outstanding.items():
+                for r in st["procs"]:
+                    if r in st["done"]:
+                        continue
+                    rec = self.server.peek(f"{K_DONE}{idx}.{r}")
+                    if rec is not None:
+                        st["done"][r] = rec
+                if len(st["done"]) >= len(st["procs"]):
+                    done_idx.append(idx)
+                elif (st["kind"] == "job" and self.job_timeout > 0
+                      and time.monotonic() - st["ts"] > self.job_timeout):
+                    # job overran its budget: reclaim the rank-set by
+                    # killing its members — the death path respawns and
+                    # repairs them (the elastic plane as the enforcer)
+                    print(f"[tpud] job {st['job_id']} exceeded "
+                          f"serve_job_timeout={self.job_timeout}s; "
+                          f"killing its ranks", flush=True)
+                    st["ts"] = float("inf")
+                    for r in st["procs"]:
+                        q = self._procs[r]
+                        if q is not None and q.poll() is None:
+                            q.terminate()
+        for idx in done_idx:
+            self._finish_directive(idx)
+
+    def _finish_directive(self, idx: int) -> None:
+        with self._lock:
+            st = self._outstanding.pop(idx)
+        if st["kind"] == "job":
+            bad = [f"rank {r}: {rec.get('error', '?')}"
+                   for r, rec in sorted(st["done"].items())
+                   if not rec.get("ok")]
+            job = self.queue.finish(st["job_id"], ok=not bad,
+                                    error="; ".join(bad),
+                                    ranks=st["done"])
+            if job is not None:
+                print(f"[tpud] job {job['id']} ({job['tenant']}) "
+                      f"{job['state']}", flush=True)
+        elif st["kind"] == "repair":
+            with self._lock:
+                for r in self._repairing:
+                    if self._status[r] == "respawning":
+                        self._status[r] = "active"
+                self._repairing.clear()
+                self._repair_published = False
+            print("[tpud] repair complete: mesh restored", flush=True)
+        elif st["kind"] == "retire":
+            with self._lock:
+                for r in range(self.np):
+                    if self._status[r] == "retiring":
+                        self._status[r] = "retired"
+
+    def _busy_procs(self) -> set[int]:
+        with self._lock:
+            return {r for st in self._outstanding.values()
+                    for r in st["procs"]}
+
+    def _schedule(self) -> None:
+        with self._lock:
+            if not self._healthy_locked() or self._shutdown_published:
+                return
+            active = {r for r in range(self.np)
+                      if self._status[r] == "active"}
+        free = active - self._busy_procs()
+        while True:
+            job = self.queue.next_runnable(free)
+            if job is None:
+                return
+            if job["nprocs"] > len(active):
+                self.queue.finish(
+                    job["id"], ok=False,
+                    error=f"needs {job['nprocs']} procs; only "
+                          f"{len(active)} active")
+                continue
+            self._publish_job(job)
+            free -= set(job["procs"])
+
+    def _maybe_shutdown(self) -> bool:
+        with self._lock:
+            if not self.shutting_down or self._shutdown_published:
+                return self._shutdown_published
+            if self._outstanding or not self.queue.idle():
+                return False
+            active = [r for r in range(self.np)
+                      if self._status[r] == "active"]
+            self._publish({"kind": "shutdown", "procs": active})
+            self._shutdown_published = True
+            return True
+
+    def step(self) -> None:
+        """One monitor tick (public so tests can drive the loop
+        deterministically)."""
+        self._poll_workers()
+        self._collect_done()
+        self._maybe_publish_repair()
+        self._schedule()
+        self._maybe_shutdown()
+
+    def run(self) -> int:
+        """Blocking monitor loop until shutdown completes."""
+        print(f"[tpud] ops: {self.url}/jobs (submit: python "
+              f"tools/tpud_ctl.py --url {self.url} submit <script>; "
+              f"scrape: {self.url}/metrics)", flush=True)
+        def _sigterm(*_):
+            # same contract as POST /shutdown: stop admitting AND stop
+            # serving — shutting_down alone would keep accepting jobs
+            # and never drain under continued submit traffic
+            self.queue.draining = True
+            self.shutting_down = True
+
+        try:
+            signal.signal(signal.SIGTERM, _sigterm)
+        except ValueError:
+            pass  # non-main thread (tests): SIGTERM stays default
+        try:
+            while True:
+                self.step()
+                if self._shutdown_published:
+                    live = [p for p in self._procs
+                            if p is not None and p.poll() is None]
+                    if not live:
+                        break
+                time.sleep(0.05)
+        except KeyboardInterrupt:
+            self.shutting_down = True
+            self.exit_code = 130
+        finally:
+            self.close()
+        return self.exit_code
+
+    def close(self) -> None:
+        self.queue.fail_queued("daemon shut down")
+        deadline = time.monotonic() + 10
+        for p in self._procs:
+            while (p is not None and p.poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            if p is not None and p.poll() is None:
+                p.kill()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.aggregator.close()
+        self.server.close()
+
+
+def run_daemon(np_: int, mca: dict[str, str] | None = None,
+               cpu_devices: int | None = None, max_respawns: int = 2,
+               http_port: int | None = None) -> int:
+    """The ``tpurun --daemon`` / ``tools/tpud.py`` entry."""
+    return TpuDaemon(np_, mca=mca, cpu_devices=cpu_devices,
+                     max_respawns=max_respawns,
+                     http_port=http_port).run()

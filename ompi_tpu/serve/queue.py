@@ -1,0 +1,188 @@
+"""Job queue + gang scheduler + per-tenant admission (daemon side).
+
+≈ the reference's plm job-state machinery collapsed to the piece a
+single-host serving daemon needs: a FIFO of submitted jobs, scheduled
+onto the resident rank-set **gang-style** — a job launches only when
+every proc it needs is free — with round-robin fairness across tenants
+(one tenant's burst cannot starve another's queue) and an admission
+quota per tenant (``serve_max_pending``).
+
+Pure bookkeeping: no sockets, no threads — the daemon drives it from
+its monitor loop, and tests drive it directly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any
+
+
+class AdmissionError(Exception):
+    """Submit rejected by admission control (HTTP 429/503 at the ops
+    surface); ``.status`` carries the HTTP code."""
+
+    def __init__(self, msg: str, status: int = 429):
+        super().__init__(msg)
+        self.status = status
+
+
+class JobQueue:
+    """Multi-tenant FIFO with gang scheduling over ``nprocs`` slots."""
+
+    def __init__(self, nprocs: int, max_pending: int = 8):
+        self.nprocs = int(nprocs)
+        self.max_pending = int(max_pending)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        #: submitted, not yet launched (submission order — FIFO spine)
+        self._queue: list[dict] = []
+        #: job id → record, running jobs
+        self._running: dict[str, dict] = {}
+        #: job id → record, completed jobs (done/failed), insertion order
+        self._done: dict[str, dict] = {}
+        #: tenant → monotonic pick counter (round-robin fairness state)
+        self._served: dict[str, int] = {}
+        self._pick = 0
+        self.draining = False
+
+    # -- admission -------------------------------------------------------
+
+    def submit(self, script: str, args=(), tenant: str = "default",
+               nprocs: int | None = None, env: dict | None = None) -> dict:
+        """Admission control + enqueue.  Raises :class:`AdmissionError`
+        when the daemon is draining (503) or the tenant already has
+        ``max_pending`` jobs queued or running (429) — the queue-depth
+        feed the ops surface reports per tenant."""
+        with self._lock:
+            if self.draining:
+                raise AdmissionError("daemon is draining: no new jobs",
+                                     status=503)
+            tenant = str(tenant or "default")
+            if self.max_pending > 0:
+                depth = self._tenant_depth(tenant)
+                if depth >= self.max_pending:
+                    raise AdmissionError(
+                        f"tenant {tenant!r} at serve_max_pending="
+                        f"{self.max_pending} (depth {depth}); retry "
+                        "after the queue drains", status=429)
+            want = int(nprocs or self.nprocs)
+            if not 0 < want <= self.nprocs:
+                raise AdmissionError(
+                    f"job wants {want} procs; the mesh has "
+                    f"{self.nprocs}", status=400)
+            job = {
+                "id": f"j{next(self._ids)}",
+                "tenant": tenant,
+                "script": str(script),
+                "args": [str(a) for a in (args or ())],
+                "env": {str(k): str(v) for k, v in (env or {}).items()},
+                "nprocs": want,
+                "state": "queued",
+                "submit_ns": time.time_ns(),
+            }
+            self._queue.append(job)
+            return dict(job)
+
+    def _tenant_depth(self, tenant: str) -> int:
+        return (sum(1 for j in self._queue if j["tenant"] == tenant)
+                + sum(1 for j in self._running.values()
+                      if j["tenant"] == tenant))
+
+    # -- gang scheduling -------------------------------------------------
+
+    def next_runnable(self, free_procs) -> dict | None:
+        """Pick the next job whose full rank-set fits in ``free_procs``
+        and assign it the lowest free procs.  Order: round-robin across
+        tenants (the tenant picked least recently goes first), FIFO
+        within a tenant — so ``submit`` order holds per tenant while a
+        burst from one tenant cannot monopolize the mesh."""
+        free = sorted(int(p) for p in free_procs)
+        with self._lock:
+            tenants: dict[str, dict] = {}
+            for j in self._queue:  # FIFO: first hit per tenant wins
+                tenants.setdefault(j["tenant"], j)
+            if not tenants:
+                return None
+            for tenant in sorted(
+                    tenants, key=lambda t: (self._served.get(t, -1), t)):
+                job = tenants[tenant]
+                if job["nprocs"] <= len(free):
+                    self._queue.remove(job)
+                    self._pick += 1
+                    self._served[tenant] = self._pick
+                    job["procs"] = free[:job["nprocs"]]
+                    job["state"] = "running"
+                    job["start_ns"] = time.time_ns()
+                    self._running[job["id"]] = job
+                    return dict(job)
+            return None
+
+    # -- completion ------------------------------------------------------
+
+    def finish(self, job_id: str, ok: bool, error: str = "",
+               ranks: dict | None = None) -> dict | None:
+        with self._lock:
+            job = self._running.pop(job_id, None)
+            if job is None:
+                return None
+            job["state"] = "done" if ok else "failed"
+            if error:
+                job["error"] = error[:2000]
+            if ranks:
+                # per-rank completion records (timings + transport dial
+                # counters): the warm-reuse proof the ops surface and
+                # the acceptance test read
+                job["ranks"] = {str(r): rec for r, rec in ranks.items()}
+            job["end_ns"] = time.time_ns()
+            self._done[job_id] = job
+            return dict(job)
+
+    def fail_queued(self, reason: str) -> None:
+        """Flush the queue as failed (daemon shutdown with jobs
+        pending)."""
+        with self._lock:
+            for job in self._queue:
+                job["state"] = "failed"
+                job["error"] = reason
+                job["end_ns"] = time.time_ns()
+                self._done[job["id"]] = job
+            self._queue.clear()
+
+    # -- introspection ---------------------------------------------------
+
+    def get(self, job_id: str) -> dict | None:
+        with self._lock:
+            for pool in (self._running, self._done):
+                if job_id in pool:
+                    return dict(pool[job_id])
+            for j in self._queue:
+                if j["id"] == job_id:
+                    return dict(j)
+            return None
+
+    def running(self) -> list[dict]:
+        with self._lock:
+            return [dict(j) for j in self._running.values()]
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._queue and not self._running
+
+    def state(self) -> dict[str, Any]:
+        """The ops-surface /jobs payload: queue depths per tenant (the
+        admission feed), queued/running/done records."""
+        with self._lock:
+            tenants = sorted(
+                {j["tenant"] for j in self._queue}
+                | {j["tenant"] for j in self._running.values()})
+            return {
+                "draining": self.draining,
+                "queued": [dict(j) for j in self._queue],
+                "running": [dict(j) for j in self._running.values()],
+                "done": {k: dict(v) for k, v in self._done.items()},
+                "tenant_depth": {t: self._tenant_depth(t)
+                                 for t in tenants},
+                "max_pending": self.max_pending,
+            }

@@ -1,0 +1,252 @@
+"""Resident rank worker — boot once, serve jobs until shutdown.
+
+Launched by the daemon as ``python -m ompi_tpu.serve.worker``: runs the
+normal boot rendezvous (``api.init`` → modex → DCN dials → engine
+threads) exactly once, then long-polls the daemon's job stream
+(``serve.job.<n>`` on the boot KVS) instead of running one script and
+finalizing — the **job re-arm** that replaces finalize-teardown.
+
+Per job directive:
+
+* a fresh ``MPI_COMM_WORLD``-equivalent is carved from the warm world
+  with **zero traffic**: the daemon assigned a disjoint CID block, so
+  every member deterministically builds the same sub-communicator
+  (``_make_sub``) at the block base — per-(comm, op) sequence counters
+  start clean, nothing re-dials, and concurrent tenants' comm worlds
+  can never collide in CID space;
+* the job script runs **in this process** via ``runpy`` under a pushed
+  world scope (``api.push_world``): the script's ``api.init()`` returns
+  the job world; its ``api.finalize()`` pops the scope and leaves the
+  mesh warm;
+* a completion record (timings + transport dial counters — the
+  warm-reuse proof) is published for the daemon.
+
+``repair`` directives fire the elastic plane on demand: survivors run
+``replace()`` (PR-4) to restore a respawned rank; a reborn worker
+rejoins through the replace beacon and resumes the stream at the
+cursor the daemon published for its incarnation.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+import time
+
+#: KVS keys (shared with the daemon — keep in sync with serve/daemon.py)
+K_JOB = "serve.job."
+K_DONE = "serve.done."
+K_RESUME = "serve.resume."
+
+#: transport counters proving warm reuse (flat across jobs = no
+#: re-dials) and the per-job delivery/dedup picture
+_DIAL_KEYS = ("reconnects", "retry_dials")
+_REPORT_KEYS = ("delivered", "reconnects", "retry_dials", "dedup_drops")
+
+
+def _kvs_wait(ctx, key: str, poll: float):
+    """Long-poll one KVS key; a dead daemon (connection loss) exits
+    the worker — the resident plane has nothing to serve without it."""
+    while True:
+        try:
+            return ctx.kvs.get(key, timeout=max(poll, 2.0))
+        except KeyError:
+            time.sleep(poll)
+        except (ConnectionError, OSError):
+            print("serve: daemon gone; exiting", flush=True)
+            raise SystemExit(0)
+
+
+def _report(ctx, idx: int, rec: dict) -> None:
+    rec = dict(rec)
+    rec["proc"] = ctx.proc
+    ctx.kvs.put(f"{K_DONE}{idx}.{ctx.proc}", rec)
+
+
+def _job_comm(world, jd: dict):
+    """Deterministic job-world construction at the assigned CID block:
+    every member reserves ``[base, base+1)`` (in-job derived comms draw
+    from ``base+1`` upward via the normal CID agreement, staying inside
+    the block) and builds the identical sub-communicator — no
+    allgather, no dial, no traffic."""
+    from ompi_tpu.api.comm import _reserve_cid_block
+
+    base = int(jd["cid_base"])
+    cid = _reserve_cid_block(base, 1)
+    procs = [int(p) for p in jd["procs"]]
+    members = [r for p in procs for r in range(*world.proc_range(p))]
+    owners = [p for p in procs for _ in range(world.proc_sizes[p])]
+    sub = world._make_sub(jd["id"], cid, members, owners, procs)
+    sub.name = f"world.{jd['id']}"
+    return sub
+
+
+def _exec_script(jd: dict) -> None:
+    """Run the job script in-process as ``__main__`` with its argv and
+    extra env, both restored afterwards (the warm process serves many
+    jobs; one job's argv/env must not leak into the next)."""
+    argv0, env0 = sys.argv, {}
+    sys.argv = [jd["script"]] + list(jd.get("args") or ())
+    try:
+        for k, v in (jd.get("env") or {}).items():
+            env0[k] = os.environ.get(k)
+            os.environ[k] = v
+        runpy.run_path(jd["script"], run_name="__main__")
+    finally:
+        sys.argv = argv0
+        for k, old in env0.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+def _run_job(api, world, ctx, jd: dict, idx: int) -> None:
+    import ompi_tpu.serve as serve
+    from ompi_tpu.metrics import core as mcore
+    from ompi_tpu.metrics import live
+
+    rec: dict = {"ok": True, "id": jd["id"], "cid_base": jd["cid_base"],
+                 "incarnation": ctx.incarnation}
+    before = mcore.native_counters()
+    rec["dials_before"] = {k: int(before.get(k, 0)) for k in _DIAL_KEYS}
+    job = None
+    rec["t_start_ns"] = time.time_ns()
+    try:
+        job = _job_comm(world, jd)
+        rec["cid"] = int(job.cid)
+        serve._set_current(dict(jd))
+        live.set_job(jd["id"])
+        api.push_world(job)
+        _exec_script(jd)
+    except SystemExit as e:
+        if e.code not in (0, None):
+            rec["ok"] = False
+            rec["error"] = f"job script exited rc={e.code}"
+    except BaseException as e:  # noqa: BLE001 — a job must never kill
+        # the resident worker; MPIProcFailedError lands here too (the
+        # daemon sees the dead rank and queues the repair directive)
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if api.in_job_scope():
+            api.pop_world()
+        live.set_job(None)
+        serve._set_current(None)
+        if job is not None:
+            try:
+                job.free()
+            except Exception:  # noqa: BLE001 — poisoned job comm
+                pass
+    rec["t_end_ns"] = time.time_ns()
+    after = mcore.native_counters()
+    rec["dials_after"] = {k: int(after.get(k, 0)) for k in _DIAL_KEYS}
+    rec["counters"] = {k: int(after.get(k, 0)) for k in _REPORT_KEYS}
+    _report(ctx, idx, rec)
+
+
+def _repair(api, world, ctx, jd: dict, idx: int, timeout: float):
+    """Survivor half of a repair directive: wait for the detector to
+    surface every dead proc (gossip converges within a period), then
+    ``replace()`` — the reborn incarnations rejoin through the beacon
+    inside it — and adopt the healed world for future jobs."""
+    dead = [int(d) for d in jd.get("dead", ())]
+    deadline = time.monotonic() + timeout
+    while True:
+        failed = set(world.get_failed())
+        missing = [p for p in dead
+                   if not (set(range(*world.proc_range(p))) & failed)]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            _report(ctx, idx, {
+                "ok": False,
+                "error": f"repair: procs {missing} never surfaced as "
+                         f"failed within {timeout}s"})
+            return world
+        time.sleep(0.05)
+    t0 = time.monotonic()
+    try:
+        healed = world.replace()
+    except BaseException as e:  # noqa: BLE001 — repair must report
+        _report(ctx, idx, {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"})
+        return world
+    api.set_world(healed)
+    _report(ctx, idx, {"ok": True,
+                       "heal_ms": round((time.monotonic() - t0) * 1e3, 3)})
+    print(f"serve: repaired world (dead={dead})", flush=True)
+    return healed
+
+
+def _teardown_resident(api, world) -> None:
+    """Raw teardown for a retired rank (or a shutdown with ranks
+    missing): no finalize fence — the remaining ranks are not
+    finalizing with us."""
+    from ompi_tpu.metrics import live
+
+    live.stop_publisher()
+    try:
+        world.procctx.close()
+    except Exception:  # noqa: BLE001 — exiting anyway
+        pass
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    import ompi_tpu.api as api
+    from ompi_tpu.core import mca
+
+    world = api.init()
+    ctx = world.procctx
+    store = mca.default_context().store
+    poll = max(0.02, int(store.get("serve_poll_ms", 50) or 50) / 1000.0)
+    respawn_timeout = float(store.get("ft_respawn_timeout", 60.0) or 60.0)
+    if getattr(world, "respawned", False):
+        # reborn incarnation: rejoin the warm world via the survivors'
+        # replace round, then resume the stream where the daemon says
+        world = world.replace()
+        api.set_world(world)
+        n = int(_kvs_wait(
+            ctx, f"{K_RESUME}{ctx.proc}.i{ctx.incarnation}", poll))
+        print(f"serve: incarnation {ctx.incarnation} rejoined; "
+              f"resuming at directive {n}", flush=True)
+    else:
+        n = 0
+        print(f"serve: resident worker up (proc {ctx.proc}/"
+              f"{ctx.nprocs})", flush=True)
+    while True:
+        jd = _kvs_wait(ctx, f"{K_JOB}{n}", poll)
+        idx, n = n, n + 1
+        kind = jd.get("kind", "job")
+        if kind == "shutdown":
+            if len(jd.get("procs", ())) == ctx.nprocs:
+                api.finalize()  # full house: the real fence + teardown
+            else:
+                _teardown_resident(api, world)
+            print("serve: shutdown", flush=True)
+            return 0
+        if kind == "repair":
+            if ctx.proc in jd.get("procs", ()):
+                world = _repair(api, world, ctx, jd, idx,
+                                respawn_timeout)
+            continue
+        if kind == "retire":
+            if ctx.proc in jd.get("retire", ()):
+                _report(ctx, idx, {"ok": True, "retired": True})
+                _teardown_resident(api, world)
+                print("serve: retired", flush=True)
+                return 0
+            if ctx.proc in jd.get("procs", ()):
+                _report(ctx, idx, {"ok": True})
+            continue
+        if ctx.proc in jd.get("procs", ()):
+            _run_job(api, world, ctx, jd, idx)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -670,6 +670,55 @@ def test_native_redial_heals_killed_connection():
         b.close()
 
 
+def test_native_clear_failed_keeps_dedup_watermark():
+    """ROADMAP deferred recovery edge (c): a failure-mark/clear cycle
+    (false-positive detection, injected connkill + replace) must NOT
+    regress the C-plane rx dedup watermark — the SAME sender lineage
+    resends across the clear, and a regressed watermark would
+    re-deliver.  Injects a true wire duplicate (tdcn_fault_set_dup)
+    AFTER the clear and asserts exactly-once; then proves the stale
+    lineage IS pruned on the one safe signal, an address change."""
+    import numpy as np
+
+    a, b, addrs = _native_tcp_pair()
+    try:
+        x = np.arange(8, dtype=np.float64)
+        for seq in range(3):
+            a._send(1, "wm", seq, x + seq)
+            _env, got = b._recv_full(0, "wm", seq, timeout=30)
+            assert np.array_equal(got, x + seq)
+        assert b.rx_watermark(0) == 3
+        # mark + clear: the watermark must survive both
+        b._lib.tdcn_note_failed(b._h, 0)
+        assert b.rx_watermark(0) == 3
+        b._lib.tdcn_clear_failed(b._h, 0)
+        assert b.rx_watermark(0) == 3
+        # injected dup across the clear: delivered exactly once
+        dd0 = b.stats_snapshot()["dedup_drops"]
+        a._lib.tdcn_fault_set_dup(1)  # next seq'd eager send goes twice
+        try:
+            a._send(1, "wm", 3, x * 9)
+            _env, got = b._recv_full(0, "wm", 3, timeout=30)
+            assert np.array_equal(got, x * 9)
+            deadline = time.time() + 10
+            while (b.stats_snapshot()["dedup_drops"] == dd0
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            s = b.stats_snapshot()
+            assert s["dedup_drops"] == dd0 + 1, s
+            assert a.stats_snapshot()["injected_faults"] >= 1
+        finally:
+            a._lib.tdcn_fault_set_dup(-1)
+        # a CHANGED address (a reborn incarnation's endpoint) is the
+        # one proof the old lineage is dead — only then is its
+        # watermark pruned
+        b.set_addresses(["ntv:reborn-endpoint", addrs[1]])
+        assert b.rx_watermark(0) == 0
+    finally:
+        a.close()
+        b.close()
+
+
 def test_native_connkill_knob_heals_from_plan():
     """The seeded plan's connkill:at=N maps onto the C send path via
     tdcn_fault_set_conn (native_conn_args) and the damage self-heals."""

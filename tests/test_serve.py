@@ -1,0 +1,364 @@
+"""Persistent serving plane (tpud) tests.
+
+* queue/scheduler units — gang scheduling, FIFO + per-tenant
+  round-robin fairness, admission quotas, drain;
+* aggregator job scoping — per-job counter baselines (reset-in-place,
+  keys survive), job-labeled series, /jobs bookkeeping;
+* api job scope — push_world/pop_world and the job-scoped finalize
+  that re-arms instead of tearing down;
+* ``tools/tpud_ctl.py --selftest`` in tier-1 (control plane over real
+  HTTP against a workerless daemon);
+* the np=2 acceptance runs: one daemon, sequential jobs from two
+  tenants reusing the warm mesh — disjoint CID blocks, clean seq
+  state (verified collectives), ZERO endpoint re-dials between jobs
+  (flat reconnect/dial counters), per-tenant quota rejection with
+  admission after the queue drains — and the elastic leg: SIGKILL one
+  rank mid-job, the daemon respawns + repairs, and the next job still
+  schedules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+JOB = REPO / "tests" / "workers" / "serve_job_worker.py"
+CTL = REPO / "tools" / "tpud_ctl.py"
+
+
+# -- queue / scheduler units -------------------------------------------
+
+
+def test_queue_gang_fifo_and_tenant_fairness():
+    from ompi_tpu.serve.queue import JobQueue
+
+    q = JobQueue(4, max_pending=0)
+    a1 = q.submit("a1.py", tenant="alice")
+    a2 = q.submit("a2.py", tenant="alice")
+    b1 = q.submit("b1.py", tenant="bob")
+    # alice submitted first → goes first; bob's single job must not
+    # wait behind alice's whole burst (round-robin across tenants)
+    first = q.next_runnable({0, 1, 2, 3})
+    assert first["id"] == a1["id"] and first["procs"] == [0, 1, 2, 3]
+    # gang: nothing fits while all procs are busy
+    assert q.next_runnable(set()) is None
+    q.finish(first["id"], ok=True)
+    second = q.next_runnable({0, 1, 2, 3})
+    assert second["id"] == b1["id"], "tenant fairness violated"
+    q.finish(second["id"], ok=True)
+    assert q.next_runnable({0, 1, 2, 3})["id"] == a2["id"]
+
+
+def test_queue_subset_jobs_gang_on_partial_mesh():
+    from ompi_tpu.serve.queue import JobQueue
+
+    q = JobQueue(4, max_pending=0)
+    j1 = q.submit("x.py", nprocs=2)
+    j2 = q.submit("y.py", nprocs=2)
+    r1 = q.next_runnable({0, 1, 2, 3})
+    assert r1["procs"] == [0, 1]
+    # the second 2-proc job fits on the remaining ranks concurrently
+    r2 = q.next_runnable({2, 3})
+    assert r2["id"] == j2["id"] and r2["procs"] == [2, 3]
+    assert q.next_runnable(set()) is None
+    assert {j["id"] for j in q.running()} == {j1["id"], j2["id"]}
+
+
+def test_queue_admission_quota_and_drain():
+    from ompi_tpu.serve.queue import AdmissionError, JobQueue
+
+    q = JobQueue(2, max_pending=2)
+    q.submit("1.py", tenant="t")
+    q.submit("2.py", tenant="t")
+    with pytest.raises(AdmissionError) as ei:
+        q.submit("3.py", tenant="t")
+    assert ei.value.status == 429
+    q.submit("other.py", tenant="u")  # quota is PER tenant
+    q.draining = True
+    with pytest.raises(AdmissionError) as ei:
+        q.submit("4.py", tenant="v")
+    assert ei.value.status == 503
+    st = q.state()
+    assert st["draining"] and st["tenant_depth"]["t"] == 2
+
+
+def test_serving_vars_centrally_registered():
+    """SERVING_VARS appear in every store's --mca var listing like the
+    observability/robustness sets (acceptance criterion)."""
+    from ompi_tpu.core.registry import MCAContext
+    from ompi_tpu.core.var import SERVING_VARS, full_var_name
+
+    store = MCAContext().store
+    names = {v.full_name for v in store.all_vars()}
+    for fw, comp, name, _d, _t, _h in SERVING_VARS:
+        assert full_var_name(fw, comp, name) in names
+    assert store.get("serve_max_pending") == 8
+    assert store.get("serve_cid_block") == 4096
+
+
+# -- aggregator job scoping --------------------------------------------
+
+
+def test_aggregator_begin_job_baselines_and_labels():
+    """The PR-5 fix: grow-only per-process counters are re-based per
+    job (label + baseline), so a second job's scrape starts at zero;
+    straggler tables reset IN PLACE (keys survive, spc.py contract)."""
+    from ompi_tpu.metrics.live import TelemetryAggregator
+
+    agg = TelemetryAggregator()
+    try:
+        agg.ingest({"proc": 0, "nprocs": 2,
+                    "native": {"delivered": 100, "reconnects": 2},
+                    "colls": [["w/allreduce/0", 1000]]})
+        agg.ingest({"proc": 1, "nprocs": 2,
+                    "native": {"delivered": 90},
+                    "colls": [["w/allreduce/0", 5000]]})
+        # the joined instance populated the rolling straggler tables
+        assert agg.json_state()["straggler"]["per_proc"]["1"]["n"] == 1
+        text = agg.prometheus_text()
+        assert 'ompi_tpu_dcn_delivered{proc="0"} 100' in text  # no job
+        agg.begin_job("j7")
+        # reset-in-place: keys survive zeroed
+        pp = agg.json_state()["straggler"]["per_proc"]
+        assert set(pp) == {"0", "1"}
+        assert all(s["n"] == 0 and s["slowest"] == 0
+                   for s in pp.values())
+        agg.ingest({"proc": 0, "nprocs": 2, "job": "j7",
+                    "native": {"delivered": 130, "reconnects": 2}})
+        text = agg.prometheus_text()
+        assert 'ompi_tpu_dcn_delivered{proc="0",job="j7"} 30' in text
+        assert 'ompi_tpu_dcn_reconnects{proc="0",job="j7"} 0' in text
+        jobs = agg.jobs_state()["jobs"]
+        assert jobs["j7"]["frames"] == 1 and 0 in jobs["j7"]["procs"]
+    finally:
+        agg.close()
+
+
+def test_publisher_frame_carries_job_label():
+    from ompi_tpu.metrics import live
+
+    live.set_job("jX")
+    try:
+        assert live.current_job() == "jX"
+        pub = live.TelemetryPublisher.__new__(live.TelemetryPublisher)
+        pub.proc, pub.nprocs, pub._detector = 0, 1, None
+        assert pub.frame()["job"] == "jX"
+    finally:
+        live.set_job(None)
+        assert "job" not in pub.frame()
+
+
+# -- api job scope ------------------------------------------------------
+
+
+def test_push_world_job_scope_and_job_finalize():
+    import ompi_tpu.api as api
+
+    world = api.init()
+    marker = object()
+    api.push_world(marker)
+    try:
+        assert api.in_job_scope()
+        assert api.init() is marker      # job scripts see the job world
+        assert api.comm_world() is marker
+        api.finalize()                    # JOB finalize: pops, re-arms
+        assert api.initialized()
+        assert api.comm_world() is world
+        assert not api.in_job_scope()
+        assert api.pop_world() is None    # idempotence guard
+    finally:
+        while api.in_job_scope():
+            api.pop_world()
+    assert api.comm_world() is world
+
+
+def test_serve_current_job_accessor():
+    from ompi_tpu import serve
+
+    assert serve.current_job() is None
+    serve._set_current({"id": "j1", "tenant": "t"})
+    try:
+        assert serve.current_job()["id"] == "j1"
+    finally:
+        serve._set_current(None)
+    assert serve.current_job() is None
+
+
+def test_tpud_ctl_selftest():
+    """Control-plane acceptance over real HTTP (tier-1 wiring, like
+    top.py/chaos.py)."""
+    res = subprocess.run([sys.executable, str(CTL), "--selftest"],
+                         capture_output=True, timeout=120,
+                         cwd=str(REPO))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert b"selftest OK" in res.stdout
+
+
+# -- np=2 daemon acceptance --------------------------------------------
+
+
+class _Tpud:
+    """Daemon-under-test: launch, URL discovery, log capture."""
+
+    def __init__(self, mca=(), np_=2):
+        cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+               "--daemon", "--cpu-devices", "1", "--mca", "btl", "tcp"]
+        for k, v in mca:
+            cmd += ["--mca", k, v]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+        env.pop("JAX_PLATFORMS", None)
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, env=env,
+                                     cwd=str(REPO))
+        self.lines: list[str] = []
+        self._t = threading.Thread(target=self._read, daemon=True)
+        self._t.start()
+        self.url = self._await_url()
+
+    def _read(self):
+        for raw in iter(self.proc.stdout.readline, b""):
+            self.lines.append(raw.decode(errors="replace"))
+
+    def _await_url(self) -> str:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and self.proc.poll() is None:
+            for l in list(self.lines):
+                if "[tpud] ops: " in l:
+                    return l.split("[tpud] ops: ", 1)[1].split("/jobs")[0]
+            time.sleep(0.05)
+        raise AssertionError("tpud never printed its ops URL:\n"
+                             + self.out())
+
+    def out(self) -> str:
+        return "".join(self.lines)
+
+    def close(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait(timeout=30)
+        self._t.join(timeout=10)
+
+
+def _scrape(url: str, path: str) -> str:
+    with urllib.request.urlopen(url + path, timeout=5) as r:
+        return r.read().decode()
+
+
+def test_tpud_np2_two_tenants_warm_reuse_quota_and_drain():
+    """THE acceptance run: two sequential jobs from different tenants
+    reuse one warm mesh — disjoint CID blocks, clean seq state
+    (verified collectives + p2p inside the job script), zero endpoint
+    re-dials between jobs (flat reconnects/retry_dials) — plus
+    per-tenant quota rejection with a resubmit admitted only after the
+    queue drains, job-labeled live metrics, and a clean shutdown."""
+    from ompi_tpu.serve import client
+
+    d = _Tpud(mca=[("serve_max_pending", "2")])
+    try:
+        j1 = client.submit(d.url, str(JOB), tenant="alice")
+        r1 = client.wait(d.url, j1["id"], timeout=120)
+        assert r1["state"] == "done", r1
+        j2 = client.submit(d.url, str(JOB), tenant="bob")
+        r2 = client.wait(d.url, j2["id"], timeout=60)
+        assert r2["state"] == "done", r2
+
+        # disjoint CID blocks, monotone (tenant isolation in CID space)
+        assert r2["ranks"]["0"]["cid_base"] >= (
+            r1["ranks"]["0"]["cid_base"] + 4096), (r1, r2)
+        for rec in list(r1["ranks"].values()) + list(r2["ranks"].values()):
+            assert rec["cid"] == rec["cid_base"], rec
+
+        # warm reuse: ZERO re-dials — flat reconnect/dial counters
+        # within each job AND across the two jobs
+        for r in (r1, r2):
+            for rec in r["ranks"].values():
+                assert rec["dials_before"] == rec["dials_after"], rec
+        for p in ("0", "1"):
+            assert (r1["ranks"][p]["dials_after"]
+                    == r2["ranks"][p]["dials_after"]), (r1, r2)
+
+        # per-tenant admission: carol floods her quota with slow jobs;
+        # the third submit is rejected, then admitted after the queue
+        # drains
+        c1 = client.submit(d.url, str(JOB), tenant="carol",
+                           env={"SERVE_SLEEP": "1.5"})
+        c2 = client.submit(d.url, str(JOB), tenant="carol",
+                           env={"SERVE_SLEEP": "0.2"})
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(d.url, str(JOB), tenant="carol")
+        assert ei.value.status == 429
+        rc1 = client.wait(d.url, c1["id"], timeout=60)
+        rc2 = client.wait(d.url, c2["id"], timeout=60)
+        assert rc1["state"] == "done" and rc2["state"] == "done"
+        # FIFO per tenant: c2 ran only after c1 finished (gang over the
+        # full rank-set serializes them)
+        assert rc2["start_ns"] >= rc1["end_ns"] - int(50e6), (rc1, rc2)
+        c3 = client.submit(d.url, str(JOB), tenant="carol")
+        assert client.wait(d.url, c3["id"], timeout=60)["state"] == "done"
+
+        # live scrape carries the job label (job-scoped aggregator)
+        text = _scrape(d.url, "/metrics")
+        assert f'job="{c3["id"]}"' in text, text[:2000]
+        jobs = client.status(d.url)
+        assert jobs["healthy"] and not jobs["running"], jobs
+        assert len(jobs["done"]) == 5
+
+        client.shutdown(d.url)
+        assert d.proc.wait(timeout=60) == 0, d.out()
+    finally:
+        d.close()
+    out = d.out()
+    # every job ran in-process on the two resident workers: 5 jobs × 2
+    # ranks of OK lines, and exactly 2 worker boots
+    assert len([l for l in out.splitlines()
+                if "OK SERVE_JOB" in l]) == 10, out
+    assert len([l for l in out.splitlines()
+                if "resident worker up" in l]) == 2, out
+
+
+def test_tpud_np2_kill_rank_mid_job_respawns_and_next_job_schedules():
+    """Elastic acceptance: SIGKILL rank 1 mid-job — the job fails, the
+    daemon respawns the rank (incarnation 1), fires the repair
+    directive (survivors replace(), the reborn rejoins), and the NEXT
+    job schedules and completes on the restored mesh."""
+    from ompi_tpu.serve import client
+
+    d = _Tpud(mca=[("dcn_recv_timeout", "8"), ("dcn_cts_timeout", "8"),
+                   ("dcn_connect_timeout", "4")])
+    try:
+        jk = client.submit(d.url, str(JOB), tenant="alice",
+                           env={"SERVE_KILL_RANK": "1"})
+        rk = client.wait(d.url, jk["id"], timeout=120)
+        assert rk["state"] == "failed", rk
+        # wait for the daemon-fired repair to complete
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = client.status(d.url)
+            if (st["healthy"]
+                    and st["procs"]["1"]["incarnation"] == 1
+                    and st["procs"]["1"]["status"] == "active"):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"mesh never healed: {st}\n{d.out()}")
+        j2 = client.submit(d.url, str(JOB), tenant="bob")
+        r2 = client.wait(d.url, j2["id"], timeout=120)
+        assert r2["state"] == "done", (r2, d.out())
+        client.shutdown(d.url)
+        assert d.proc.wait(timeout=60) == 0, d.out()
+    finally:
+        d.close()
+    out = d.out()
+    assert "respawning (incarnation 1)" in out, out
+    assert "repair complete" in out, out
+    assert "rejoined; resuming at directive" in out, out
+    assert len([l for l in out.splitlines()
+                if "OK SERVE_JOB" in l]) >= 2, out

@@ -1,0 +1,62 @@
+"""tpud job script — runs INSIDE a resident serve worker (also valid
+under a plain ``tpurun`` launch; the acceptance tests submit it to the
+daemon, the bench compares both paths).
+
+Exercises exactly what warm reuse must keep correct:
+
+* ``api.init()`` returns the JOB world (a fresh communicator on a
+  disjoint CID block) — verified collectives prove its per-(comm, op)
+  sequence state starts clean;
+* cross-process p2p on the warm endpoints (no re-dial);
+* ``api.finalize()`` ends the job, not the resident plane.
+
+Env knobs (set per job via the submit payload):
+  SERVE_ITERS      collectives to run (default 4)
+  SERVE_SLEEP      post-loop sleep seconds (queue-depth tests)
+  SERVE_KILL_RANK  job-world proc index that SIGKILLs itself at
+                   iteration 2 (elastic-plane acceptance; default off)
+"""
+
+import os
+import signal
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu import serve
+from ompi_tpu.op import SUM
+
+world = api.init()
+p, n = world.proc, world.size
+job = serve.current_job() or {}
+iters = int(os.environ.get("SERVE_ITERS", "4"))
+kill = int(os.environ.get("SERVE_KILL_RANK", "-1"))
+sleep_s = float(os.environ.get("SERVE_SLEEP", "0"))
+
+for i in range(iters):
+    if p == kill and i == 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+    out = world.allreduce(
+        np.full((world.local_size, 4), float(i + 1)), SUM)
+    assert float(np.asarray(out)[0][0]) == (i + 1) * n, (i, out)
+
+if world.nprocs >= 2 and kill < 0:
+    # cross-process p2p over the warm endpoints (global ranks: first
+    # local rank of procs 0 and 1 in the JOB world)
+    src, dst = world.proc_range(0)[0], world.proc_range(1)[0]
+    if p == 0:
+        world.send(np.arange(8.0), source=src, dest=dst, tag=7)
+    elif p == 1:
+        payload, _st = world.recv(dst, source=src, tag=7)
+        assert np.array_equal(np.asarray(payload), np.arange(8.0))
+
+if sleep_s:
+    time.sleep(sleep_s)
+print(f"OK SERVE_JOB proc={p} size={n} cid={world.cid} "
+      f"id={job.get('id', '?')}", flush=True)
+api.finalize()

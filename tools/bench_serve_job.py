@@ -1,0 +1,34 @@
+"""Serve-bench job: time launch→first-collective, the daemon's reason
+to exist as a measured number.
+
+Runs identically in both legs of the warm-vs-cold comparison:
+
+* **cold** — launched by a fresh ``tpurun`` (full boot: rendezvous,
+  endpoint dials, engine threads before the collective);
+* **warm** — submitted to a resident ``tpud`` world (``api.init()``
+  returns the job communicator carved from the already-dialed mesh).
+
+Prints one ``FIRSTCOLL ns=<wallclock>`` line per rank after the first
+allreduce completes; the driver subtracts its own submit/spawn
+timestamp (same host, same clock).
+"""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+out = world.allreduce(np.ones((world.local_size, 8)), SUM)
+t = time.time_ns()
+assert float(np.asarray(out)[0][0]) == float(world.size), out
+print(f"FIRSTCOLL ns={t} proc={world.proc} size={world.size}",
+      flush=True)
+api.finalize()

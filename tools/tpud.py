@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""tpud — start the persistent serving daemon (≈ orted/prted).
+
+Boots N resident rank workers whose mesh, DCN endpoints (both planes),
+and boot KVS stay warm across jobs; serves a multi-tenant gang-
+scheduled job queue with telemetry-driven admission control on the
+live aggregator's HTTP endpoint (printed at start).
+
+    python tools/tpud.py -np 2 --cpu-devices 1 --mca btl tcp
+    python tools/tpud_ctl.py --url http://... submit my_job.py
+    python tools/tpud_ctl.py --url http://... shutdown
+
+Equivalent to ``tpurun --daemon``; knobs are the ``serve_*`` MCA vars
+(``SERVING_VARS`` in core/var.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpud",
+        description="persistent ompi_tpu serving daemon (warm mesh, "
+                    "multi-tenant job queue)")
+    ap.add_argument("-np", type=int, required=True,
+                    help="resident rank-worker count")
+    ap.add_argument("--mca", nargs=2, action="append", default=[],
+                    metavar=("KEY", "VALUE"),
+                    help="MCA parameter (repeatable), e.g. --mca "
+                         "serve_max_pending 4")
+    ap.add_argument("--cpu-devices", type=int, default=None,
+                    help="per-worker virtual CPU device count "
+                         "(testing without TPU)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="ops/scrape HTTP port (default: serve_port "
+                         "var; 0 = ephemeral)")
+    ap.add_argument("--max-respawns", type=int, default=2,
+                    help="respawn budget per rank (elastic scale-up "
+                         "restore; default 2)")
+    ns = ap.parse_args(argv)
+    from ompi_tpu.serve.daemon import run_daemon
+
+    return run_daemon(ns.np, mca={k: v for k, v in ns.mca},
+                      cpu_devices=ns.cpu_devices,
+                      max_respawns=ns.max_respawns, http_port=ns.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""tpud_ctl — ops CLI for a running tpud daemon.
+
+Drives the daemon's HTTP ops surface (the live-telemetry aggregator
+endpoint with the serve routes mounted):
+
+    python tools/tpud_ctl.py --url http://127.0.0.1:PORT submit job.py \
+        --tenant alice --arg 100
+    python tools/tpud_ctl.py --url ... status [JOB_ID]
+    python tools/tpud_ctl.py --url ... drain
+    python tools/tpud_ctl.py --url ... scale 1
+    python tools/tpud_ctl.py --url ... shutdown
+    python tools/tpud_ctl.py --selftest
+
+``--url`` defaults to ``$TPUD_URL``.  ``--selftest`` exercises the
+whole control plane — submit/admission/fairness/drain/shutdown over
+real HTTP against a workerless daemon — and is wired into tier-1 like
+``top.py``/``chaos.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _out(obj) -> None:
+    print(json.dumps(obj, indent=1, sort_keys=True))
+
+
+def cmd_submit(url: str, ns) -> int:
+    from ompi_tpu.serve import client
+
+    env = dict(kv.split("=", 1) for kv in (ns.env or []))
+    try:
+        job = client.submit(url, ns.script, args=ns.arg or (),
+                            tenant=ns.tenant, nprocs=ns.nprocs,
+                            env=env or None)
+    except client.ServeError as e:
+        print(f"rejected ({e.status}): {e}", file=sys.stderr)
+        return 1
+    if ns.no_wait:
+        _out(job)
+        return 0
+    final = client.wait(url, job["id"], timeout=ns.timeout)
+    _out(final)
+    return 0 if final.get("state") == "done" else 1
+
+
+def cmd_status(url: str, ns) -> int:
+    from ompi_tpu.serve import client
+
+    _out(client.status(url, ns.job_id))
+    return 0
+
+
+def cmd_simple(url: str, fn_name: str, *args) -> int:
+    from ompi_tpu.serve import client
+
+    _out(getattr(client, fn_name)(url, *args))
+    return 0
+
+
+# -- selftest ----------------------------------------------------------
+
+
+def selftest() -> int:
+    """Control-plane acceptance over real HTTP: a workerless daemon
+    (full KVS + aggregator + ops routes, no rank processes) with a
+    pump thread standing in for the resident workers — every directive
+    published to the job stream is acknowledged with per-proc
+    completion records, exactly the worker contract."""
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve.daemon import K_DONE, K_JOB, TpuDaemon
+
+    d = TpuDaemon(2, mca={"serve_max_pending": "2"}, spawn=False)
+    stop = threading.Event()
+    served: list[dict] = []
+
+    def pump():
+        n = 0
+        while not stop.is_set():
+            jd = d.server.peek(f"{K_JOB}{n}")
+            if jd is None:
+                time.sleep(0.01)
+                continue
+            served.append(jd)
+            for p in jd.get("procs", ()):
+                d.server.put_local(f"{K_DONE}{n}.{p}",
+                                   {"ok": True, "proc": p})
+            n += 1
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        # per-tenant FIFO + round-robin fairness: alice floods first,
+        # bob's single job must not sit behind her whole burst
+        a1 = client.submit(d.url, "a1.py", tenant="alice")
+        a2 = client.submit(d.url, "a2.py", tenant="alice")
+        b1 = client.submit(d.url, "b1.py", tenant="bob")
+        # admission: alice is at serve_max_pending=2
+        try:
+            client.submit(d.url, "a3.py", tenant="alice")
+            raise AssertionError("quota breach admitted")
+        except client.ServeError as e:
+            assert e.status == 429, e.status
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            d.step()
+            st = client.status(d.url)
+            if len(st["done"]) == 3:
+                break
+            time.sleep(0.02)
+        st = client.status(d.url)
+        assert len(st["done"]) == 3, st
+        assert all(j["state"] == "done" for j in st["done"].values()), st
+        order = [jd["id"] for jd in served if jd.get("kind") == "job"]
+        assert order == [a1["id"], b1["id"], a2["id"]], (
+            f"fairness violated: {order}")
+        # disjoint CID blocks, monotone
+        bases = [jd["cid_base"] for jd in served]
+        spans = [jd["cid_span"] for jd in served]
+        assert all(b2 >= b1_ + s for b1_, b2, s
+                   in zip(bases, bases[1:], spans)), bases
+        # job-scoped telemetry: the aggregator saw every job begin
+        tj = client.status(d.url)["telemetry"]["jobs"]
+        assert set(tj) == {a1["id"], a2["id"], b1["id"]}, tj
+        # single-job status endpoint
+        one = client.status(d.url, b1["id"])
+        assert one["state"] == "done" and one["tenant"] == "bob", one
+        # drain: no new admissions, then shutdown completes the loop
+        client.drain(d.url)
+        try:
+            client.submit(d.url, "x.py")
+            raise AssertionError("draining admitted a job")
+        except client.ServeError as e:
+            assert e.status == 503, e.status
+        client.shutdown(d.url)
+        deadline = time.monotonic() + 10
+        while (not d._shutdown_published
+               and time.monotonic() < deadline):
+            d.step()
+            time.sleep(0.02)
+        assert d._shutdown_published
+        sd = d.server.peek(f"{K_JOB}{d.cursor - 1}")
+        assert sd and sd["kind"] == "shutdown", sd
+        print("tpud_ctl selftest OK (submit/admission/fairness/"
+              "cid-blocks/drain/shutdown)")
+        return 0
+    finally:
+        stop.set()
+        d.aggregator.close()
+        d.server.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpud_ctl",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=os.environ.get("TPUD_URL", ""),
+                    help="daemon ops URL (default $TPUD_URL)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="control-plane acceptance against a "
+                         "workerless in-process daemon")
+    sub = ap.add_subparsers(dest="cmd")
+    s = sub.add_parser("submit", help="run a worker script in the warm mesh")
+    s.add_argument("script")
+    s.add_argument("--arg", action="append", help="script argv entry "
+                   "(repeatable)")
+    s.add_argument("--tenant", default=None)
+    s.add_argument("--nprocs", type=int, default=None)
+    s.add_argument("--env", action="append", metavar="K=V",
+                   help="extra env for the job script (repeatable)")
+    s.add_argument("--no-wait", action="store_true",
+                   help="print the job record and return immediately")
+    s.add_argument("--timeout", type=float, default=600.0)
+    st = sub.add_parser("status", help="queue/job state")
+    st.add_argument("job_id", nargs="?", default=None)
+    sub.add_parser("drain", help="stop admitting; let the queue finish")
+    sub.add_parser("shutdown", help="drain, then stop the daemon")
+    sc = sub.add_parser("scale", help="resize the active rank-set")
+    sc.add_argument("nprocs", type=int)
+    ns = ap.parse_args(argv)
+    if ns.selftest:
+        return selftest()
+    if not ns.cmd:
+        ap.error("a command (or --selftest) is required")
+    if not ns.url:
+        ap.error("--url (or $TPUD_URL) is required")
+    if ns.cmd == "submit":
+        return cmd_submit(ns.url, ns)
+    if ns.cmd == "status":
+        return cmd_status(ns.url, ns)
+    if ns.cmd == "drain":
+        return cmd_simple(ns.url, "drain")
+    if ns.cmd == "shutdown":
+        return cmd_simple(ns.url, "shutdown")
+    return cmd_simple(ns.url, "scale", ns.nprocs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
