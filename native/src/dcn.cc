@@ -153,6 +153,29 @@ static uint64_t now_ns() {
   return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
+// Timed cv wait that stays TSan-visible.  libstdc++'s steady-clock
+// wait_for compiles to pthread_cond_clockwait, which gcc-10's libtsan
+// does NOT intercept — TSan then misses the unlock inside the wait
+// and reports phantom double-locks/inversions/races on everything the
+// mutex guards.  Under -fsanitize=thread, wait on the system clock
+// instead (pthread_cond_timedwait, intercepted); elsewhere keep the
+// monotonic wait (immune to wall-clock jumps).
+template <class Pred>
+static bool cv_wait_for(std::condition_variable &cv,
+                        std::unique_lock<std::mutex> &lk, double seconds,
+                        Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(
+      lk,
+      std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::system_clock::duration>(
+              std::chrono::duration<double>(seconds)),
+      pred);
+#else
+  return cv.wait_for(lk, std::chrono::duration<double>(seconds), pred);
+#endif
+}
+
 // ---------------------------------------------------------------------
 // transport telemetry (the native half of ompi_tpu/metrics/)
 // ---------------------------------------------------------------------
@@ -467,10 +490,14 @@ struct ShmRing {
   }
 
   void destroy(bool unlink_name) {
+    // idempotent: close-then-destroy re-enters (tdcn_destroy after a
+    // tdcn_close); a stale fd number may have been recycled by then
     if (ctrl) munmap((void *)ctrl, sizeof(ShmCtrl) + size);
     if (fd >= 0) close(fd);
     if (unlink_name && !name.empty()) shm_unlink(name.c_str());
     ctrl = nullptr;
+    fd = -1;
+    name.clear();
   }
 };
 
@@ -511,10 +538,13 @@ struct Doorbell {
   }
 
   void destroy(bool unlink_name) {
+    // idempotent, same rationale as ShmRing::destroy
     if (word) munmap((void *)word, 4096);
     if (fd >= 0) close(fd);
     if (unlink_name && !name.empty()) shm_unlink(name.c_str());
     word = nullptr;
+    fd = -1;
+    name.clear();
   }
 };
 
@@ -682,6 +712,15 @@ struct Engine {
   std::condition_variable fail_cv;  // broadcast on failure marks
 
   std::atomic<bool> closing{false};
+  // live detached per-connection readers (sock_recv_entry): counted
+  // at spawn, decremented at exit, so tdcn_destroy can wait for the
+  // last one before freeing the Engine they read.  Their open fds are
+  // tracked so close() can shutdown() them — an accept-side reader
+  // otherwise blocks in recv until the REMOTE engine dies, leaking
+  // the thread+fd on every engine close in a long-lived host (tpud)
+  std::atomic<int> readers{0};
+  std::mutex reader_mu;
+  std::set<int> reader_fds;
   std::atomic<uint64_t> bytes_sent{0};
   TdcnStats stats;  // transport telemetry (tdcn_stats reads it)
   // rx duplicate filter, keyed by (sending proc, sender-lineage
@@ -897,10 +936,20 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
       return;
     }
     case FT_CTS: {
-      // sender side: release the waiting send
-      std::lock_guard<std::mutex> g(eng->peers_mu);
-      for (auto &kv : eng->peers) {
-        Peer *p = kv.second;
+      // sender side: release the waiting send.  Snapshot the peer set
+      // first so cts_mu is never taken under peers_mu — the reverse
+      // nesting exists on the send path (cts bookkeeping under
+      // send_mu after get_peer), and holding both here completes a
+      // lock-order cycle (TSan-reported).  Peer objects are stable:
+      // they are only freed by tdcn_destroy after every reader (this
+      // thread included) has exited.
+      std::vector<Peer *> snapshot;
+      {
+        std::lock_guard<std::mutex> g(eng->peers_mu);
+        snapshot.reserve(eng->peers.size());
+        for (auto &kv : eng->peers) snapshot.push_back(kv.second);
+      }
+      for (Peer *p : snapshot) {
         std::lock_guard<std::mutex> g2(p->cts_mu);
         auto it = p->cts.find(h.seq);
         if (it != p->cts.end()) {
@@ -1092,8 +1141,34 @@ static void sock_recv_loop(Engine *eng, int fd) {
     if (h.type == FT_RTS) conn_keys.insert({h.from_proc, h.seq});
     process_frame(eng, h, extra.data(), nullptr, fd);
   }
-  close(fd);
+  // NOTE: fd is closed by sock_recv_entry (under reader_mu)
   abandon_reassemblies(eng, conn_keys);
+}
+
+// every detached reader goes through this pair: the count is bumped
+// BEFORE the thread exists (no spawn→entry gap) and dropped as the
+// thread's last touch of the Engine, so readers == 0 after close
+// means no detached thread can dereference eng again.  The fd is
+// erased and closed under reader_mu — the same lock close() holds
+// while shutdown()ing — so a close-time shutdown can never hit a
+// recycled descriptor number.
+static void sock_recv_entry(Engine *eng, int fd) {
+  sock_recv_loop(eng, fd);
+  {
+    std::lock_guard<std::mutex> g(eng->reader_mu);
+    eng->reader_fds.erase(fd);
+    close(fd);
+  }
+  eng->readers.fetch_sub(1, std::memory_order_release);
+}
+
+static void spawn_reader(Engine *eng, int fd) {
+  {
+    std::lock_guard<std::mutex> g(eng->reader_mu);
+    eng->reader_fds.insert(fd);
+  }
+  eng->readers.fetch_add(1, std::memory_order_relaxed);
+  std::thread(sock_recv_entry, eng, fd).detach();
 }
 
 static void accept_loop(Engine *eng, int lfd) {
@@ -1111,7 +1186,7 @@ static void accept_loop(Engine *eng, int lfd) {
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::thread(sock_recv_loop, eng, fd).detach();
+    spawn_reader(eng, fd);
   }
 }
 
@@ -1377,7 +1452,7 @@ static Peer *get_peer(Engine *eng, const std::string &address) {
   }
   // our inbound CTS for rndv rides the SAME socket (duplex): spawn a
   // reader for it
-  if (p->fd >= 0) std::thread(sock_recv_loop, eng, dup(p->fd)).detach();
+  if (p->fd >= 0) spawn_reader(eng, dup(p->fd));
   return p;
 }
 
@@ -1639,7 +1714,7 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
       p->epoch++;
       eng->stats.add(TS_RECONNECTS, 1);
       // duplex reader for CTS grants on the fresh socket
-      std::thread(sock_recv_loop, eng, dup(fd)).detach();
+      spawn_reader(eng, dup(fd));
     }
     if (tcp_send_once(eng, p, e, data, nbytes, xs) == 0) {
       fault_dup_check(eng, p, e, data, nbytes, xs);
@@ -1703,8 +1778,12 @@ static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
     // account every wait so the stall breakdown can apportion it
     uint64_t t0 = now_ns();
     std::unique_lock<std::mutex> g2(p->cts_mu);
-    bool ok = p->cts_cv.wait_for(g2, std::chrono::seconds(600), [&] {
-      return p->cts[xid] || eng->closing.load(std::memory_order_relaxed);
+    bool ok = cv_wait_for(p->cts_cv, g2, 600.0, [&] {
+      // find, not operator[]: the predicate must not mutate the map
+      // (an insert rebalances nodes the FT_CTS scan may be touching)
+      auto it = p->cts.find(xid);
+      return (it != p->cts.end() && it->second) ||
+             eng->closing.load(std::memory_order_relaxed);
     });
     p->cts.erase(xid);
     uint64_t d = now_ns() - t0;
@@ -2159,12 +2238,10 @@ int tdcn_unregister_cid(void *h, const char *cid) {
 int tdcn_ctrl_next(void *h, double timeout_s, TdcnMsg *out) {
   Engine *eng = (Engine *)h;
   std::unique_lock<std::mutex> g(eng->mu);
-  bool ok = eng->py_cv.wait_for(g, std::chrono::duration<double>(timeout_s),
-                                [&] {
-                                  return !eng->py_queue.empty() ||
-                                         eng->closing.load(
-                                             std::memory_order_relaxed);
-                                });
+  bool ok = cv_wait_for(eng->py_cv, g, timeout_s, [&] {
+    return !eng->py_queue.empty() ||
+           eng->closing.load(std::memory_order_relaxed);
+  });
   if (!ok || eng->py_queue.empty())
     return eng->closing.load(std::memory_order_relaxed) ? -3 : 1;
   OwnedMsg m = std::move(eng->py_queue.front());
@@ -2511,9 +2588,15 @@ void tdcn_close(void *h) {
   eng->my_db.word->fetch_add(1, std::memory_order_release);
   futex_wake(eng->my_db.word, 64);
   {
-    std::lock_guard<std::mutex> g(eng->peers_mu);
-    for (auto &kv : eng->peers) {
-      Peer *p = kv.second;
+    // same peers_mu→cts_mu discipline as the FT_CTS handler: snapshot
+    // first, never hold both (the send path nests the other way)
+    std::vector<Peer *> snapshot;
+    {
+      std::lock_guard<std::mutex> g(eng->peers_mu);
+      snapshot.reserve(eng->peers.size());
+      for (auto &kv : eng->peers) snapshot.push_back(kv.second);
+    }
+    for (Peer *p : snapshot) {
       std::lock_guard<std::mutex> g2(p->cts_mu);
       p->cts_cv.notify_all();
     }
@@ -2525,6 +2608,16 @@ void tdcn_close(void *h) {
     if (t.joinable()) t.join();
   if (eng->tcp_listen_fd >= 0) close(eng->tcp_listen_fd);
   if (eng->uds_listen_fd >= 0) close(eng->uds_listen_fd);
+  eng->tcp_listen_fd = eng->uds_listen_fd = -1;  // close is idempotent
+                                                 // (tdcn_destroy re-enters)
+  {
+    // unblock the detached readers: an accept-side reader otherwise
+    // sits in recv until the REMOTE engine closes its end.  Under
+    // reader_mu, so no fd here can have been recycled (readers close
+    // their fd under the same lock).
+    std::lock_guard<std::mutex> g(eng->reader_mu);
+    for (int rfd : eng->reader_fds) shutdown(rfd, SHUT_RDWR);
+  }
   {
     std::lock_guard<std::mutex> g(eng->peers_mu);
     for (auto &kv : eng->peers) {
@@ -2555,9 +2648,64 @@ void tdcn_close(void *h) {
   // segfault at teardown.  Same rationale as leaking the Engine.
   if (!eng->my_db.name.empty()) shm_unlink(eng->my_db.name.c_str());
   if (eng->my_db.fd >= 0) close(eng->my_db.fd);
+  eng->my_db.fd = -1;
+  eng->my_db.name.clear();
   // NOTE: the Engine object is intentionally leaked at close (detached
   // per-connection recv threads may still be draining); process
-  // teardown reclaims it.
+  // teardown reclaims it.  tdcn_destroy below is the full-teardown
+  // variant for hosts that outlive many engines (tpud, the sanitizer
+  // soak): it waits for the reader count to drain and then frees.
+}
+
+// Full teardown: close, wait (bounded) for the detached readers to
+// exit, then free every engine-owned allocation.  If a reader is
+// still draining after the grace window the engine falls back to the
+// documented close() behavior — leaked, never freed in use.
+void tdcn_destroy(void *h) {
+  Engine *eng = (Engine *)h;
+  tdcn_close(h);
+  for (int i = 0; i < 2000; i++) {  // <= ~2 s grace
+    if (eng->readers.load(std::memory_order_acquire) == 0) break;
+    struct timespec ts = {0, 1000000};
+    nanosleep(&ts, nullptr);
+  }
+  if (eng->readers.load(std::memory_order_acquire) != 0) return;
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    for (auto &kv : eng->peers) delete kv.second;
+    eng->peers.clear();
+  }
+  {
+    std::lock_guard<std::mutex> g(eng->mu);
+    for (auto &kv : eng->coll) {
+      if (kv.second->msg.data) free(kv.second->msg.data);
+      delete kv.second;
+    }
+    eng->coll.clear();
+    for (auto &kv : eng->reqs) {
+      if (kv.second->msg.data) free(kv.second->msg.data);
+      delete kv.second;
+    }
+    eng->reqs.clear();
+    for (auto &kv : eng->p2p)
+      for (auto &q : kv.second.unexpected)
+        for (auto &m : q.second)
+          if (m.data) free(m.data);
+    eng->p2p.clear();
+    for (auto &m : eng->py_queue)
+      if (m.data) free(m.data);
+    eng->py_queue.clear();
+  }
+  {
+    std::lock_guard<std::mutex> g(eng->rndv_mu);
+    for (auto &kv : eng->reasm) {
+      if (kv.second->buf) free(kv.second->buf);
+      delete kv.second;
+    }
+    eng->reasm.clear();
+  }
+  eng->my_db.destroy(false);
+  delete eng;
 }
 
 }  // extern "C"

@@ -1,0 +1,218 @@
+// Standalone sanity/soak driver for libtpudcn — the target the
+// sanitizer legs (tools/check.py --sanitize) build and run.
+//
+// Deliberately embeds NO Python: ASan/UBSan/TSan reports then point
+// at pure dcn.cc behavior instead of CPython internals.  Coverage is
+// the transport matrix the Python test suite drives through ctypes:
+//
+//   * same-host pair  → shm-ring records (eager + chunked)
+//   * cross-"host" pair (distinct host ids) → framed tcp, eager and
+//     RTS/CTS rendezvous with fragmentation
+//   * the coll stream (tdcn_recv_coll slots) and the p2p matcher
+//     (tdcn_post_recv/tdcn_req_wait), both directions
+//   * concurrent senders on separate threads (the TSan leg's food)
+//   * stats read-back and clean close
+//
+// Exit 0 on success; any check failure prints and exits nonzero.
+// Sanitizer reports abort the process on their own (halt_on_error).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// extern "C" surface of libtpudcn (kept in sync with dcn.cc — the
+// tpucheck abidrift pass checks these declarations' arity too)
+#pragma pack(push, 1)
+struct TdcnMsg {
+  int32_t kind, src, dst, tag;
+  int64_t seq;
+  uint64_t pyhandle;
+  void *data;
+  uint64_t nbytes;
+  int64_t count;
+  char dtype[16];
+  int32_t ndim;
+  int64_t shape[8];
+  char cid[128];
+  void *meta;
+  uint32_t meta_len;
+};
+#pragma pack(pop)
+
+extern "C" {
+extern void *tdcn_create(int, int, const char *, int64_t, int64_t,
+                         uint64_t, int);
+extern const char *tdcn_address(void *);
+extern int tdcn_set_addresses(void *, const char *);
+extern int tdcn_send(void *, int, int, const char *, int64_t, int, int, int,
+                     const char *, int, const int64_t *, const void *, int,
+                     const void *, uint64_t);
+extern int tdcn_recv_coll(void *, const char *, int64_t, int, int, double,
+                          TdcnMsg *);
+extern uint64_t tdcn_post_recv(void *, const char *, int, int, int);
+extern int tdcn_req_wait(void *, uint64_t, double, TdcnMsg *);
+extern int tdcn_stats(void *, uint64_t *, int);
+extern const char *tdcn_stats_names(void);
+extern void tdcn_set_ring_timeout(void *, double);
+extern void tdcn_free(void *);
+extern void tdcn_close(void *);
+extern void tdcn_destroy(void *);
+}
+
+enum { FK_COLL = 0, FK_P2P = 1 };
+
+static int g_fail = 0;
+
+#define CHECK(cond, ...)                                      \
+  do {                                                        \
+    if (!(cond)) {                                            \
+      fprintf(stderr, "dcn_sanity FAIL %s:%d: ", __FILE__, __LINE__); \
+      fprintf(stderr, __VA_ARGS__);                           \
+      fprintf(stderr, "\n");                                  \
+      g_fail = 1;                                             \
+    }                                                         \
+  } while (0)
+
+// (proc, nprocs, host_id, eager_limit, frag_size, ring_bytes,
+// max_rndv) — small eager/frag limits so modest payloads exercise the
+// chunked and rendezvous paths without large allocations under ASan
+static void *create_engine(int proc, int nprocs, const char *host) {
+  return tdcn_create(proc, nprocs, host, 4096, 8192, 1u << 20, 4);
+}
+
+static void free_msg(TdcnMsg *m) {
+  if (m->data) tdcn_free(m->data);
+  if (m->meta) tdcn_free(m->meta);
+  m->data = m->meta = nullptr;
+}
+
+// one direction of p2p traffic: src sends `count` messages of `nbytes`
+// to dst_proc; receiver posts+waits and verifies the payload pattern
+static void send_burst(void *eng, int dst_proc, int src_rank, int dst_rank,
+                       const char *cid, int count, uint64_t nbytes,
+                       int tag_base) {
+  std::vector<uint8_t> payload(nbytes);
+  for (uint64_t i = 0; i < nbytes; i++)
+    payload[i] = (uint8_t)(i * 131 + 7);
+  int64_t shape[1] = {(int64_t)nbytes};
+  for (int i = 0; i < count; i++) {
+    int rc = tdcn_send(eng, dst_proc, FK_P2P, cid, 0, src_rank, dst_rank,
+                       tag_base + i, "u1", 1, shape, nullptr, 0,
+                       payload.data(), nbytes);
+    CHECK(rc == 0, "send %d (nbytes=%llu) rc=%d", i,
+          (unsigned long long)nbytes, rc);
+  }
+}
+
+static void recv_burst(void *eng, int self_rank, int from_rank,
+                       const char *cid, int count, uint64_t nbytes,
+                       int tag_base) {
+  for (int i = 0; i < count; i++) {
+    uint64_t rid = tdcn_post_recv(eng, cid, self_rank, from_rank,
+                                  tag_base + i);
+    TdcnMsg m;
+    memset(&m, 0, sizeof(m));
+    int rc = tdcn_req_wait(eng, rid, 30.0, &m);
+    CHECK(rc == 0, "req_wait tag=%d rc=%d", tag_base + i, rc);
+    if (rc == 0) {
+      CHECK(m.nbytes == nbytes, "nbytes %llu != %llu",
+            (unsigned long long)m.nbytes, (unsigned long long)nbytes);
+      if (m.data && m.nbytes == nbytes) {
+        const uint8_t *p = (const uint8_t *)m.data;
+        for (uint64_t k = 0; k < nbytes; k += 997)
+          CHECK(p[k] == (uint8_t)(k * 131 + 7),
+                "payload corrupt at %llu", (unsigned long long)k);
+      }
+      free_msg(&m);
+    }
+  }
+}
+
+// drive one engine pair through eager + chunked + rendezvous p2p in
+// both directions concurrently, then the coll stream
+static void exercise_pair(void *a, void *b, const char *label) {
+  const uint64_t sizes[] = {64, 4096 + 32, 65536};  // eager/chunk/rndv
+  int tag = 1000;
+  for (uint64_t nb : sizes) {
+    std::thread t_send_a([&] { send_burst(a, 1, 0, 1, "san", 8, nb, tag); });
+    std::thread t_send_b([&] {
+      send_burst(b, 0, 1, 0, "san", 8, nb, tag + 500);
+    });
+    std::thread t_recv_b([&] { recv_burst(b, 1, 0, "san", 8, nb, tag); });
+    recv_burst(a, 0, 1, "san", 8, nb, tag + 500);
+    t_send_a.join();
+    t_send_b.join();
+    t_recv_b.join();
+    tag += 1000;
+  }
+  // coll stream: one exchange each way on the slot matcher
+  int64_t shape[1] = {8};
+  uint8_t cbuf[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+  int rc = tdcn_send(a, 1, FK_COLL, "csan", 42, 0, 1, 0, "u1", 1, shape,
+                     nullptr, 0, cbuf, sizeof(cbuf));
+  CHECK(rc == 0, "%s coll send rc=%d", label, rc);
+  TdcnMsg m;
+  memset(&m, 0, sizeof(m));
+  rc = tdcn_recv_coll(b, "csan", 42, 0, -1, 30.0, &m);
+  CHECK(rc == 0, "%s recv_coll rc=%d", label, rc);
+  if (rc == 0) {
+    CHECK(m.nbytes == sizeof(cbuf), "%s coll nbytes=%llu", label,
+          (unsigned long long)m.nbytes);
+    free_msg(&m);
+  }
+  // stats must read back without overrun and stay self-describing
+  const char *names = tdcn_stats_names();
+  int n = 1;
+  for (const char *p = names; *p; p++) n += (*p == ',');
+  std::vector<uint64_t> stats((size_t)n + 8, 0);
+  int got = tdcn_stats(a, stats.data(), n);
+  CHECK(got == n, "%s stats count %d != %d", label, got, n);
+  CHECK(stats[0] == 1, "%s stats version %llu", label,
+        (unsigned long long)stats[0]);
+}
+
+int main() {
+  // pair 1: same host id → shared-memory rings
+  void *a = create_engine(0, 2, "sanhost");
+  void *b = create_engine(1, 2, "sanhost");
+  CHECK(a && b, "create shm pair");
+  {
+    std::string joined = std::string(tdcn_address(a)) + "\n" +
+                         tdcn_address(b);
+    tdcn_set_addresses(a, joined.c_str());
+    tdcn_set_addresses(b, joined.c_str());
+  }
+  tdcn_set_ring_timeout(a, 30.0);
+  tdcn_set_ring_timeout(b, 30.0);
+  exercise_pair(a, b, "shm");
+  // full teardown (close + reader drain + free) so the ASan leg's
+  // leak check sees only REAL lost allocations, not the documented
+  // intentional close()-time engine leak
+  tdcn_destroy(a);
+  tdcn_destroy(b);
+
+  // pair 2: distinct host ids → framed tcp (eager + RTS/CTS rndv)
+  void *c = create_engine(0, 2, "sanhostA");
+  void *d = create_engine(1, 2, "sanhostB");
+  CHECK(c && d, "create tcp pair");
+  {
+    std::string joined = std::string(tdcn_address(c)) + "\n" +
+                         tdcn_address(d);
+    tdcn_set_addresses(c, joined.c_str());
+    tdcn_set_addresses(d, joined.c_str());
+  }
+  exercise_pair(c, d, "tcp");
+  tdcn_destroy(c);
+  tdcn_destroy(d);
+
+  if (g_fail) {
+    fprintf(stderr, "dcn_sanity: FAILED\n");
+    return 1;
+  }
+  printf("dcn_sanity: OK\n");
+  return 0;
+}

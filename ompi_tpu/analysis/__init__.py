@@ -1,0 +1,76 @@
+"""tpucheck — repo-native static analysis (the machine-checked contracts).
+
+PRs 1–6 established cross-cutting invariants that, until this
+subsystem, lived only in reviewer memory:
+
+* every blocking DCN wait converges on :class:`ompi_tpu.core.var.
+  Deadline` (no hard-coded timeouts, no unbounded spin loops);
+* every ``--mca`` knob referenced anywhere (code, tests, docs) is
+  centrally registered, and every central registration is alive;
+* observability/robustness hooks are one-boolean off-path;
+* transport escalation raises the typed ULFM errors, never a bare
+  ``RuntimeError`` (and never hangs);
+* the ``TdcnStats``/``NATIVE_COUNTERS`` schema and the ``tdcn_*``
+  ctypes surface stay append-only/in-sync across the C ABI.
+
+Four passes enforce them (in the spirit of MPI correctness tools like
+MUST, and of TSan/lockdep-style order checking):
+
+==========  ===========================================================
+pass        checks
+==========  ===========================================================
+invariants  AST linter over ``ompi_tpu/``: Deadline discipline,
+            MCA-var registration drift, hook gating, typed escalation
+lockorder   static lock-acquisition graph across the threaded planes:
+            cycles + lock-held-across-blocking-call sites; plus the
+            opt-in runtime witness mode (:mod:`.lockdep`)
+abidrift    C↔Python ABI: ``TDCN_STAT_NAMES`` vs ``NATIVE_COUNTERS``
+            (names/order/append-only), exported ``tdcn_*`` symbols vs
+            the ctypes declarations, README knob/endpoint catalogs vs
+            the registered var/route sets
+sanitize    native plane built under ASan/UBSan (TSan where the
+            toolchain allows) and soaked via the Python-free
+            ``native/src/dcn_sanity.cc`` harness, plus cppcheck when
+            installed; skips log a reason, never silently pass
+==========  ===========================================================
+
+Driver: ``tools/check.py`` (``--selftest`` joins tier-1).  Intentional
+exceptions live in the reviewed waiver file ``waivers.toml`` next to
+this package — every waiver carries a one-line justification, so the
+repo-wide contract is "zero unexplained findings".
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    Report,
+    Waiver,
+    apply_waivers,
+    load_waivers,
+)
+
+#: pass name → callable(root: Path) -> list[Finding]; importers pull the
+#: pass modules lazily so ``import ompi_tpu.analysis`` stays light
+PASS_NAMES = ("invariants", "lockorder", "abidrift", "sanitize")
+
+
+def run_pass(name: str, root, **kw):
+    """Run one named pass against a repo root; returns list[Finding]."""
+    if name == "invariants":
+        from ompi_tpu.analysis import invariants
+
+        return invariants.run(root, **kw)
+    if name == "lockorder":
+        from ompi_tpu.analysis import lockorder
+
+        return lockorder.run(root, **kw)
+    if name == "abidrift":
+        from ompi_tpu.analysis import abidrift
+
+        return abidrift.run(root, **kw)
+    if name == "sanitize":
+        from ompi_tpu.analysis import sanitize
+
+        return sanitize.run(root, **kw)
+    raise KeyError(f"unknown analysis pass {name!r}")

@@ -1,0 +1,503 @@
+"""Pass 3 — C↔Python ABI drift checking.
+
+The native plane and the Python control plane share three contracts
+that drift silently because no compiler sees both sides:
+
+``stat-names-drift`` / ``stat-append-only``
+    ``native/src/dcn.cc:TDCN_STAT_NAMES`` (the self-describing counter
+    name table the C block exports) must equal ``"version"`` + the
+    Python schema ``ompi_tpu/metrics/core.py:NATIVE_COUNTERS`` — same
+    names, same order.  The v1 prefix (everything PR 2 shipped) is
+    FROZEN: those names are live MPI_T pvar names and cached pvar
+    indices; new counters append at the tail only, and the C version
+    slot stays 1 while the schema is append-only.
+
+``abi-missing-symbol`` / ``abi-arity`` / ``abi-type``
+    Every ``lib.tdcn_*`` ctypes signature declared in
+    ``ompi_tpu/dcn/native.py`` must match the ``extern "C"``
+    definition in ``dcn.cc``: the symbol exists, the parameter count
+    agrees, and each parameter/return slot agrees at machine-width
+    granularity (ptr / int32 / int64 / uint64 / double).  A silent
+    int-vs-int64 mismatch truncates on the call boundary — the
+    classic ctypes failure mode.
+
+``abi-undeclared-call``
+    A ``tdcn_*`` symbol referenced from Python with NO ``argtypes``
+    declaration — ctypes falls back to int-width guessing, which
+    breaks on 64-bit handles and doubles.
+
+``abi-shim-decl``
+    ``native/src/shim.c`` re-declares a ``tdcn_*`` extern with a
+    parameter count that disagrees with ``dcn.cc`` — C has no cross-TU
+    checking for this; the linker happily binds the wrong arity.
+
+``catalog-drift``
+    The README operator surface: every centrally registered MCA var
+    (the ``OBSERVABILITY_VARS``/``ROBUSTNESS_VARS``/``SERVING_VARS``
+    tables), every ``NATIVE_COUNTERS`` entry, and every ops HTTP route
+    (``add_route`` literals + the aggregator's built-in endpoints)
+    must appear in README.md — and every ``/endpoint`` row in the
+    README ops table must exist in code.
+
+Everything is parsed statically (AST for Python, regex over the
+``extern "C"`` block for C) — the pass never imports or builds the
+modules it is judging.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from ompi_tpu.analysis.findings import SEV_ERROR, SEV_WARN, Finding
+from ompi_tpu.analysis.repo import central_var_tables, parse_py, rel
+
+PASS = "abidrift"
+
+DCN_CC = "native/src/dcn.cc"
+SHIM_C = "native/src/shim.c"
+NATIVE_PY = "ompi_tpu/dcn/native.py"
+METRICS_CORE = "ompi_tpu/metrics/core.py"
+README = "README.md"
+
+#: the frozen v1 counter prefix (PR 2's shipped schema, version slot
+#: excluded).  These are live MPI_T pvar names with cached indices —
+#: renaming or reordering ANY of them is an ABI break even though the
+#: tails behind them may grow.
+V1_FROZEN_PREFIX = (
+    "doorbells", "stall_ns", "ring_stall_ns", "ring_stalls", "ring_hwm",
+    "cts_wait_ns", "cts_waits", "rndv_depth", "rndv_hwm", "slot_waits",
+    "eager_msgs", "eager_bytes", "chunked_msgs", "chunked_bytes",
+    "rndv_msgs", "rndv_bytes", "delivered", "unexpected_hwm",
+)
+
+
+# -- the two counter name tables ----------------------------------------
+
+def c_stat_names(root: Path) -> tuple[list[str], int]:
+    """(names, line) parsed from the TDCN_STAT_NAMES concatenated
+    string literal in dcn.cc; ([], 0) when unparseable."""
+    src = root / DCN_CC
+    try:
+        text = src.read_text()
+    except OSError:
+        return [], 0
+    m = re.search(
+        r"TDCN_STAT_NAMES\s*=\s*((?:\s*\"[^\"]*\")+)\s*;", text)
+    if not m:
+        return [], 0
+    line = text[:m.start()].count("\n") + 1
+    joined = "".join(re.findall(r'"([^"]*)"', m.group(1)))
+    return [n for n in joined.split(",") if n], line
+
+
+def py_native_counters(root: Path) -> tuple[list[str], int]:
+    """(names, line) of metrics/core.py NATIVE_COUNTERS."""
+    tree = parse_py(root / METRICS_CORE)
+    if tree is None:
+        return [], 0
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "NATIVE_COUNTERS"
+                and isinstance(node.value, ast.Tuple)):
+            names = [e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)]
+            return names, node.lineno
+    return [], 0
+
+
+def check_stat_names(root: Path) -> list[Finding]:
+    out: list[Finding] = []
+    c_names, c_line = c_stat_names(root)
+    py_names, py_line = py_native_counters(root)
+    if not c_names:
+        out.append(Finding(
+            PASS, "stat-names-drift", DCN_CC, 0, "TDCN_STAT_NAMES",
+            "cannot parse TDCN_STAT_NAMES from dcn.cc — the checker "
+            "(and the Python schema reader) need the concatenated "
+            "string-literal form", SEV_ERROR))
+        return out
+    if not py_names:
+        out.append(Finding(
+            PASS, "stat-names-drift", METRICS_CORE, 0, "NATIVE_COUNTERS",
+            "cannot parse NATIVE_COUNTERS tuple from metrics/core.py",
+            SEV_ERROR))
+        return out
+    expect = ["version"] + py_names
+    if c_names != expect:
+        # localize the first divergence for the message
+        detail = ""
+        for i, (a, b) in enumerate(zip(c_names, expect)):
+            if a != b:
+                detail = (f"first divergence at index {i}: "
+                          f"C has {a!r}, Python has {b!r}")
+                break
+        else:
+            longer = "C" if len(c_names) > len(expect) else "Python"
+            extra = (c_names[len(expect):] if longer == "C"
+                     else expect[len(c_names):])
+            detail = f"{longer} side has extra tail entries {extra}"
+        out.append(Finding(
+            PASS, "stat-names-drift", DCN_CC, c_line, "TDCN_STAT_NAMES",
+            "TDCN_STAT_NAMES != ['version'] + NATIVE_COUNTERS "
+            f"(metrics/core.py:{py_line}) — {detail}; the name table "
+            "is the single source of schema truth and both sides must "
+            "agree exactly (names AND order)", SEV_ERROR))
+    # append-only: the frozen v1 prefix must open both tables
+    for side, names, f, ln in (("C", c_names[1:], DCN_CC, c_line),
+                               ("Python", py_names, METRICS_CORE, py_line)):
+        prefix = tuple(names[:len(V1_FROZEN_PREFIX)])
+        if prefix != V1_FROZEN_PREFIX:
+            bad = next((i for i, (a, b) in enumerate(
+                zip(prefix, V1_FROZEN_PREFIX)) if a != b),
+                len(prefix))
+            out.append(Finding(
+                PASS, "stat-append-only", f, ln,
+                "TDCN_STAT_NAMES" if side == "C" else "NATIVE_COUNTERS",
+                f"{side} counter table breaks the frozen v1 prefix at "
+                f"index {bad} (have {list(prefix[bad:bad + 2])!r}, "
+                f"frozen {list(V1_FROZEN_PREFIX[bad:bad + 2])!r}) — "
+                "these are live MPI_T pvar names; the schema is "
+                "append-only (new counters go at the tail, version "
+                "stays 1)", SEV_ERROR))
+    return out
+
+
+# -- C prototypes vs ctypes signatures ----------------------------------
+
+#: machine-width classes both sides collapse to
+_C_TYPE_CLASS = (
+    (re.compile(r"\*"), "ptr"),
+    (re.compile(r"\bdouble\b"), "double"),
+    (re.compile(r"\buint64_t\b|\bunsigned long long\b"), "uint64"),
+    (re.compile(r"\bint64_t\b|\blong long\b"), "int64"),
+    (re.compile(r"\buint32_t\b"), "uint32"),
+    (re.compile(r"\bint\b"), "int32"),
+    (re.compile(r"\bvoid\b"), "void"),
+)
+
+_CTYPES_CLASS = {
+    "c_void_p": "ptr", "c_char_p": "ptr", "POINTER": "ptr",
+    "c_double": "double", "c_uint64": "uint64", "c_int64": "int64",
+    "c_uint32": "uint32", "c_int": "int32",
+}
+
+
+def _c_class(decl: str) -> str:
+    for rx, cls in _C_TYPE_CLASS:
+        if rx.search(decl):
+            return cls
+    return "unknown"
+
+
+_C_FN_RE = re.compile(
+    r"^[ \t]*((?:const[ \t]+)?[A-Za-z_][A-Za-z0-9_]*(?:[ \t]+[A-Za-z_]"
+    r"[A-Za-z0-9_]*)?[ \t*]*?)\b(tdcn_[A-Za-z0-9_]*)\s*\(([^;{]*?)\)\s*\{",
+    re.M | re.S)
+
+
+def c_functions(text: str) -> dict[str, tuple[int, str, list[str]]]:
+    """name → (line, return_decl, [param_decl]) for every tdcn_*
+    definition in a C/C++ source blob."""
+    out: dict[str, tuple[int, str, list[str]]] = {}
+    for m in _C_FN_RE.finditer(text):
+        ret, name, params = m.group(1), m.group(2), m.group(3)
+        line = text[:m.start()].count("\n") + 1
+        params = re.sub(r"\s+", " ", params).strip()
+        plist = ([] if params in ("", "void")
+                 else [p.strip() for p in params.split(",")])
+        out[name] = (line, ret.strip(), plist)
+    return out
+
+
+_C_EXTERN_RE = re.compile(
+    r"^[ \t]*extern[ \t]+((?:const[ \t]+)?[A-Za-z_][A-Za-z0-9_ ]*?[ \t*]+)"
+    r"(tdcn_[A-Za-z0-9_]*)\s*\(([^;{]*?)\)\s*;",
+    re.M | re.S)
+
+
+def c_extern_decls(text: str) -> dict[str, tuple[int, list[str]]]:
+    """name → (line, [param_decl]) for tdcn_* extern declarations."""
+    out: dict[str, tuple[int, list[str]]] = {}
+    for m in _C_EXTERN_RE.finditer(text):
+        params = re.sub(r"\s+", " ", m.group(3)).strip()
+        plist = ([] if params in ("", "void")
+                 else [p.strip() for p in params.split(",")])
+        out[m.group(2)] = (text[:m.start()].count("\n") + 1, plist)
+    return out
+
+
+def _ctypes_expr_class(node: ast.AST, aliases: dict[str, str]) -> str:
+    """Collapse a ctypes expression (Name alias, ctypes.c_*, POINTER(…),
+    c_T * N arrays) to a machine-width class."""
+    if isinstance(node, ast.Name):
+        base = aliases.get(node.id)
+        if base is not None:
+            return _CTYPES_CLASS.get(base, "unknown")
+        return _CTYPES_CLASS.get(node.id, "unknown")
+    if isinstance(node, ast.Attribute):
+        return _CTYPES_CLASS.get(node.attr, "unknown")
+    if isinstance(node, ast.Call):
+        fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                 else node.func.id if isinstance(node.func, ast.Name)
+                 else "")
+        if fname == "POINTER":
+            return "ptr"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        return "ptr"  # ctypes array types decay to pointers at the ABI
+    return "unknown"
+
+
+class _CtypesDecls(ast.NodeVisitor):
+    """Collect lib.tdcn_*.argtypes/.restype declarations, ctypes
+    aliases, and every tdcn_* attribute reference in native.py."""
+
+    def __init__(self) -> None:
+        self.aliases: dict[str, str] = {}   # P -> c_void_p, MSG -> POINTER
+        self.argtypes: dict[str, tuple[int, list[str]]] = {}
+        self.restype: dict[str, tuple[int, str]] = {}
+        self.referenced: dict[str, int] = {}
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # tuple-unpacked aliases: P, I, … = (ctypes.c_void_p, …)
+        if (len(node.targets) == 1 and isinstance(node.targets[0], ast.Tuple)
+                and isinstance(node.value, ast.Tuple)
+                and len(node.targets[0].elts) == len(node.value.elts)):
+            for t, v in zip(node.targets[0].elts, node.value.elts):
+                if isinstance(t, ast.Name) and isinstance(v, ast.Attribute):
+                    self.aliases[t.id] = v.attr
+        # single alias: MSG = ctypes.POINTER(TdcnMsg)
+        if (len(node.targets) == 1 and isinstance(node.targets[0], ast.Name)):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr.startswith("c_"):
+                self.aliases[node.targets[0].id] = v.attr
+            elif (isinstance(v, ast.Call)
+                  and isinstance(v.func, (ast.Attribute, ast.Name))
+                  and (v.func.attr if isinstance(v.func, ast.Attribute)
+                       else v.func.id) == "POINTER"):
+                self.aliases[node.targets[0].id] = "POINTER"
+        # lib.tdcn_X.argtypes / .restype
+        tgt = node.targets[0] if len(node.targets) == 1 else None
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Attribute)
+                and tgt.value.attr.startswith("tdcn_")):
+            sym = tgt.value.attr
+            if tgt.attr == "argtypes" and isinstance(node.value, ast.List):
+                self.argtypes[sym] = (node.lineno, list(
+                    map(ast.dump, node.value.elts)))
+                self._argtype_nodes = getattr(self, "_argtype_nodes", {})
+                self._argtype_nodes[sym] = (node.lineno, node.value.elts)
+            elif tgt.attr == "restype":
+                self.restype[sym] = (node.lineno, ast.dump(node.value))
+                self._restype_nodes = getattr(self, "_restype_nodes", {})
+                self._restype_nodes[sym] = (node.lineno, node.value)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr.startswith("tdcn_"):
+            self.referenced.setdefault(node.attr, node.lineno)
+        self.generic_visit(node)
+
+
+def check_ctypes(root: Path) -> list[Finding]:
+    out: list[Finding] = []
+    try:
+        c_text = (root / DCN_CC).read_text()
+    except OSError:
+        return [Finding(PASS, "abi-missing-symbol", DCN_CC, 0, "",
+                        "cannot read dcn.cc", SEV_ERROR)]
+    cdefs = c_functions(c_text)
+    tree = parse_py(root / NATIVE_PY)
+    if tree is None:
+        return [Finding(PASS, "abi-undeclared-call", NATIVE_PY, 0, "",
+                        "cannot parse dcn/native.py", SEV_ERROR)]
+    decls = _CtypesDecls()
+    decls.visit(tree)
+    argtype_nodes = getattr(decls, "_argtype_nodes", {})
+    restype_nodes = getattr(decls, "_restype_nodes", {})
+
+    for sym, (line, elts) in sorted(argtype_nodes.items()):
+        if sym not in cdefs:
+            out.append(Finding(
+                PASS, "abi-missing-symbol", NATIVE_PY, line, sym,
+                f"ctypes declares {sym} but dcn.cc exports no such "
+                "function — renamed or removed on the C side",
+                SEV_ERROR))
+            continue
+        c_line, c_ret, c_params = cdefs[sym]
+        if len(elts) != len(c_params):
+            out.append(Finding(
+                PASS, "abi-arity", NATIVE_PY, line, sym,
+                f"argtypes declares {len(elts)} parameters but "
+                f"{DCN_CC}:{c_line} defines {len(c_params)} — ctypes "
+                "will mis-marshal every call", SEV_ERROR))
+            continue
+        for i, (el, cp) in enumerate(zip(elts, c_params)):
+            py_cls = _ctypes_expr_class(el, decls.aliases)
+            c_cls = _c_class(cp)
+            if py_cls == "unknown" or c_cls == "unknown":
+                continue  # conservatively skip what we cannot classify
+            if py_cls != c_cls and not (
+                    # int32 passed for uint32 flags is ABI-identical
+                    {py_cls, c_cls} == {"int32", "uint32"}):
+                out.append(Finding(
+                    PASS, "abi-type", NATIVE_PY, line, sym,
+                    f"argtypes[{i}] is {py_cls} but the C parameter "
+                    f"({cp!r} at {DCN_CC}:{c_line}) is {c_cls} — "
+                    "width mismatch truncates/garbles at the call "
+                    "boundary", SEV_ERROR))
+    for sym, (line, node) in sorted(restype_nodes.items()):
+        if sym not in cdefs:
+            continue  # missing-symbol already reported via argtypes
+        c_line, c_ret, _ = cdefs[sym]
+        py_cls = _ctypes_expr_class(node, decls.aliases)
+        c_cls = _c_class(c_ret)
+        if py_cls in ("unknown",) or c_cls in ("unknown", "void"):
+            continue
+        if py_cls != c_cls and {py_cls, c_cls} != {"int32", "uint32"}:
+            out.append(Finding(
+                PASS, "abi-type", NATIVE_PY, line, sym,
+                f"restype is {py_cls} but {sym} returns {c_ret!r} "
+                f"({c_cls}) at {DCN_CC}:{c_line}", SEV_ERROR))
+    # referenced but never given argtypes → ctypes guesses int widths
+    for sym, line in sorted(decls.referenced.items()):
+        if sym in argtype_nodes:
+            continue
+        if sym not in cdefs:
+            out.append(Finding(
+                PASS, "abi-missing-symbol", NATIVE_PY, line, sym,
+                f"{sym} is referenced but dcn.cc exports no such "
+                "function", SEV_ERROR))
+            continue
+        c_line, _ret, c_params = cdefs[sym]
+        out.append(Finding(
+            PASS, "abi-undeclared-call", NATIVE_PY, line, sym,
+            f"{sym} ({DCN_CC}:{c_line}, {len(c_params)} params) is "
+            "called with no argtypes declaration — ctypes falls back "
+            "to int-width guessing, which breaks 64-bit handles and "
+            "doubles", SEV_ERROR))
+    # C-side extern re-declarations must agree on arity: shim.c (the
+    # C ABI) and dcn_sanity.cc (the sanitizer soak) both restate the
+    # tdcn_* prototypes, and C has no cross-TU checking — the linker
+    # binds a wrong arity silently
+    for c_rel in (SHIM_C, "native/src/dcn_sanity.cc"):
+        try:
+            c_decl_text = (root / c_rel).read_text()
+        except OSError:
+            c_decl_text = ""
+        for sym, (line, plist) in sorted(c_extern_decls(c_decl_text).items()):
+            if sym not in cdefs:
+                out.append(Finding(
+                    PASS, "abi-shim-decl", c_rel, line, sym,
+                    f"{c_rel} declares extern {sym} but dcn.cc exports "
+                    "no such function", SEV_ERROR))
+                continue
+            c_line, _ret, c_params = cdefs[sym]
+            if len(plist) != len(c_params):
+                out.append(Finding(
+                    PASS, "abi-shim-decl", c_rel, line, sym,
+                    f"{c_rel} extern declares {len(plist)} parameters "
+                    f"but {DCN_CC}:{c_line} defines {len(c_params)} — "
+                    "the linker binds this silently at the wrong arity",
+                    SEV_ERROR))
+    return out
+
+
+# -- README operator-surface catalogs -----------------------------------
+
+def _served_routes(root: Path) -> dict[str, tuple[str, int]]:
+    """route path → (file, line): add_route string literals plus the
+    aggregator's built-in endpoints."""
+    routes: dict[str, tuple[str, int]] = {}
+    for relpath in ("ompi_tpu/serve/daemon.py", "ompi_tpu/metrics/live.py"):
+        tree = parse_py(root / relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_route"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                routes[node.args[1].value] = (relpath, node.lineno)
+    # built-in aggregator endpoints: the literal `self.path.startswith`
+    # dispatch in live.py
+    live = root / "ompi_tpu/metrics/live.py"
+    try:
+        for lineno, line in enumerate(live.read_text().splitlines(), 1):
+            m = re.search(r"self\.path\.startswith\(\"(/[a-z]+)\"\)", line)
+            if m:
+                routes.setdefault(m.group(1),
+                                  ("ompi_tpu/metrics/live.py", lineno))
+    except OSError:
+        pass
+    return routes
+
+
+def check_catalogs(root: Path) -> list[Finding]:
+    out: list[Finding] = []
+    try:
+        readme = (root / README).read_text()
+    except OSError:
+        return [Finding(PASS, "catalog-drift", README, 0, "",
+                        "README.md missing", SEV_ERROR)]
+    # every centrally registered var must appear in the README
+    for table, names in central_var_tables(root).items():
+        for name in names:
+            if name not in readme:
+                out.append(Finding(
+                    PASS, "catalog-drift", README, 0, table,
+                    f"centrally registered var {name!r} ({table}) is "
+                    "not documented anywhere in README.md — operators "
+                    "discover knobs there", SEV_ERROR))
+    # every native counter must appear (the catalog merges families as
+    # `eager_msgs/bytes`, so accept the family row form too)
+    counters, _ = py_native_counters(root)
+    for name in counters:
+        ok = name in readme
+        if not ok and name.endswith("_bytes"):
+            ok = name[:-len("_bytes")] + "_msgs/bytes" in readme
+        if not ok and name.endswith("_stalls"):
+            ok = name[:-len("_stalls")] + "_stall_ns` / `" \
+                + name in readme or f"/ `{name}`" in readme
+        if not ok:
+            out.append(Finding(
+                PASS, "catalog-drift", README, 0, "NATIVE_COUNTERS",
+                f"native counter {name!r} is missing from the README "
+                "counter catalog — the catalog promises the full "
+                "schema (MPI_T pvar names dcn_<name>)", SEV_ERROR))
+    # ops endpoints: code routes ⊆ README (table row or backticked
+    # prose both count as documentation) and table rows ⊆ code
+    routes = _served_routes(root)
+    doc_rows = set(re.findall(r"^\|\s*`(/[a-z]+)", readme, re.M))
+    doc_any = doc_rows | {m.split("/<", 1)[0] for m in
+                          re.findall(r"`(/[a-z]+)[^`]*`", readme)}
+    for path, (f, ln) in sorted(routes.items()):
+        if path not in doc_any and path.rstrip("/") not in doc_any:
+            out.append(Finding(
+                PASS, "catalog-drift", f, ln, path,
+                f"ops endpoint {path!r} is served but documented "
+                "nowhere in README (endpoint table or prose)",
+                SEV_ERROR))
+    for path in sorted(doc_rows):
+        if path not in routes:
+            out.append(Finding(
+                PASS, "catalog-drift", README, 0, path,
+                f"README endpoint table documents {path!r} but no "
+                "add_route/dispatch site serves it", SEV_WARN))
+    return out
+
+
+def run(root: str | Path, files=None) -> list[Finding]:
+    """Run the ABI drift pass.  ``files`` is accepted for driver
+    symmetry; the pass's inputs are the fixed contract files."""
+    root = Path(root)
+    out: list[Finding] = []
+    out += check_stat_names(root)
+    out += check_ctypes(root)
+    out += check_catalogs(root)
+    return out

@@ -1,0 +1,175 @@
+"""Pass 4 — native plane under sanitizers (ASan/UBSan, TSan, cppcheck).
+
+Builds ``native/src/dcn_sanity.cc`` — a standalone, Python-free soak
+driver covering the shm-ring and framed-tcp transports (eager /
+chunked / rendezvous, concurrent senders, the coll stream, the p2p
+matcher, stats read-back) — against ``libtpudcn`` with the sanitizer
+flags appended (``make SAN=… BUILD=build-<leg>``), then runs it.
+
+Legs:
+
+``asan``   ``-fsanitize=address,undefined`` — heap/stack corruption,
+           UB (misaligned loads, signed overflow) in the ring codecs.
+``tsan``   ``-fsanitize=thread`` — the lock/atomic discipline of the
+           multi-threaded engine (reader thread + senders).  Not every
+           toolchain ships libtsan; a missing one is a **logged skip**
+           (an ``info`` finding with the reason), never a silent pass.
+``cppcheck`` static C analysis of ``dcn.cc``/``shim.c`` when the
+           ``cppcheck`` binary exists (config: ``native/cppcheck.cfg``
+           suppressions); otherwise a logged skip.  The clang-tidy
+           config (``native/.clang-tidy``) rides along for dev boxes
+           with clang — tidy is NOT run here (needs a compile DB).
+
+Findings: a failed build or a sanitizer report is ``error``; an
+unavailable toolchain leg is ``info`` (visible in the report and the
+human output, excluded from the pass/fail verdict).  Each leg's skip
+reason quotes the probe failure so "it skipped" is diagnosable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+
+from ompi_tpu.analysis.findings import SEV_ERROR, SEV_INFO, Finding
+
+PASS = "sanitize"
+
+#: leg name → SAN flags handed to the Makefile
+LEGS = (
+    ("asan", "-fsanitize=address,undefined -fno-sanitize-recover=all"),
+    ("tsan", "-fsanitize=thread"),
+)
+
+#: sanitizer runtime knobs: abort on first report, no odr noise from
+#: the duplicate-register probe pattern
+_RUN_ENV = {
+    "ASAN_OPTIONS": "halt_on_error=1:abort_on_error=0:exitcode=99",
+    "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+    "TSAN_OPTIONS": "halt_on_error=1:exitcode=99:second_deadlock_stack=1",
+}
+
+
+def _run(cmd: list[str], cwd: Path, timeout: float,
+         env: dict | None = None) -> tuple[int, str]:
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        p = subprocess.run(cmd, cwd=str(cwd), env=e, timeout=timeout,
+                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                           text=True, errors="replace")
+        return p.returncode, p.stdout or ""
+    except subprocess.TimeoutExpired as te:
+        out = te.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out + f"\n[timeout after {timeout}s]"
+    except FileNotFoundError as fe:
+        return 127, str(fe)
+
+
+def _probe_flag(cxx: str, flags: str, tmpdir: Path) -> tuple[bool, str]:
+    """Can this toolchain compile AND link a trivial program with the
+    sanitizer flags?  (The compile succeeds but the link fails when the
+    runtime lib — e.g. libtsan — is not installed.)"""
+    probe = tmpdir / "san_probe.cc"
+    probe.write_text("int main() { return 0; }\n")
+    rc, out = _run([cxx, *flags.split(), "-o", str(tmpdir / "san_probe"),
+                    str(probe)], tmpdir, timeout=60)
+    if rc != 0:
+        tail = "; ".join(out.strip().splitlines()[-2:]) or f"rc={rc}"
+        return False, tail
+    return True, ""
+
+
+def _excerpt(out: str, limit: int = 700) -> str:
+    """The interesting tail of a sanitizer/build log: from the first
+    ERROR/WARNING marker if present, else the last lines."""
+    m = re.search(r"(==\d+==\s*(ERROR|WARNING).*|runtime error:.*|"
+                  r"dcn_sanity FAIL.*)", out)
+    text = out[m.start():] if m else out
+    text = text.strip()
+    return text[-limit:] if len(text) > limit else text
+
+
+def run(root: str | Path, files=None, legs=LEGS,
+        timeout: float = 420.0) -> list[Finding]:
+    """Build+run the sanitizer legs.  ``files`` accepted for driver
+    symmetry.  Returns error findings for real failures and info
+    findings for logged skips — a toolchain hole must be visible."""
+    root = Path(root)
+    native = root / "native"
+    out: list[Finding] = []
+    if not (native / "src" / "dcn_sanity.cc").exists():
+        return [Finding(PASS, "sanitize-setup", "native/src/dcn_sanity.cc",
+                        0, "", "sanity driver source missing", SEV_ERROR)]
+    cxx = os.environ.get("CXX", "c++")
+    make = shutil.which("make")
+    if make is None:
+        return [Finding(PASS, "sanitize-skip", "native/Makefile", 0, "",
+                        "skipped: no `make` on PATH — cannot drive the "
+                        "sanitizer builds", SEV_INFO)]
+    build_root = native
+    probe_dir = Path(tempfile.mkdtemp(prefix="tpucheck_san_"))
+    for leg, flags in legs:
+        build = f"build-{leg}"
+        ok, why = _probe_flag(cxx, flags, probe_dir)
+        if not ok:
+            out.append(Finding(
+                PASS, "sanitize-skip", "native/Makefile", 0, leg,
+                f"{leg} leg skipped: toolchain cannot link {flags!r} "
+                f"({why})", SEV_INFO))
+            continue
+        rc, log = _run([make, f"BUILD={build}", f"SAN={flags}",
+                        f"{build}/dcn_sanity"], build_root, timeout)
+        if rc != 0:
+            out.append(Finding(
+                PASS, "sanitize-build", "native/src/dcn.cc", 0, leg,
+                f"{leg} build failed (rc={rc}): {_excerpt(log)}",
+                SEV_ERROR))
+            continue
+        rc, log = _run([str(native / build / "dcn_sanity")], native,
+                       timeout, env=_RUN_ENV)
+        if rc != 0:
+            out.append(Finding(
+                PASS, "sanitize-report", "native/src/dcn.cc", 0, leg,
+                f"{leg} run failed (rc={rc}): {_excerpt(log)}",
+                SEV_ERROR))
+        else:
+            out.append(Finding(
+                PASS, "sanitize-ok", "native/src/dcn.cc", 0, leg,
+                f"{leg} leg clean ({flags}): dcn_sanity OK", SEV_INFO))
+    # cppcheck leg (static, no build needed)
+    cppcheck = shutil.which("cppcheck")
+    if cppcheck is None:
+        out.append(Finding(
+            PASS, "sanitize-skip", "native/src/dcn.cc", 0, "cppcheck",
+            "cppcheck leg skipped: no `cppcheck` binary on PATH",
+            SEV_INFO))
+    else:
+        cfg = native / "cppcheck.cfg"
+        cmd = [cppcheck, "--std=c++17", "--language=c++", "--quiet",
+               "--enable=warning,portability",
+               "--inline-suppr", "--error-exitcode=2",
+               f"-I{native / 'include'}",
+               str(native / "src" / "dcn.cc"),
+               str(native / "src" / "shim.c")]
+        if cfg.exists():
+            cmd.insert(1, f"--suppressions-list={cfg}")
+        rc, log = _run(cmd, native, timeout)
+        if rc != 0:
+            out.append(Finding(
+                PASS, "sanitize-cppcheck", "native/src/dcn.cc", 0,
+                "cppcheck", f"cppcheck reported (rc={rc}): {_excerpt(log)}",
+                SEV_ERROR))
+        else:
+            out.append(Finding(
+                PASS, "sanitize-ok", "native/src/dcn.cc", 0, "cppcheck",
+                "cppcheck leg clean (warning,portability)", SEV_INFO))
+    shutil.rmtree(probe_dir, ignore_errors=True)
+    return out

@@ -1,0 +1,321 @@
+"""tpucheck --selftest: seeded fixtures + the live-repo contract gate.
+
+Two halves, mirroring ``tools/chaos.py --selftest``/``top.py
+--selftest``:
+
+1. **Seeded fixtures** — a throwaway mini-tree per pass carrying one
+   known violation (unbounded spin without Deadline, an unregistered
+   ``--mca`` var, a two-lock order cycle, a renamed
+   ``TDCN_STAT_NAMES`` counter) next to a clean twin; each pass must
+   flag exactly the seeded site and stay quiet on the twin.  The
+   waiver round-trip (a matching waiver suppresses the finding; a
+   stale waiver is itself reported) and the runtime lockdep witness
+   (an observed order inversion raises) prove the reporting plumbing.
+2. **The live repo** — the three static passes run against the real
+   tree with the reviewed waivers applied; any unwaived error fails
+   the selftest.  This is the line that makes tier-1 enforce the
+   PR 1–6 contracts from PR 7 onward.
+
+The fixture builders are importable (``tests/test_analysis.py`` uses
+them directly); :func:`run_selftest` is the driver entry.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+from pathlib import Path
+
+from ompi_tpu.analysis import findings as F
+from ompi_tpu.analysis import invariants, lockorder, abidrift, lockdep
+
+# -- fixture builders ----------------------------------------------------
+
+_FIXTURE_VAR_PY = '''\
+OBSERVABILITY_VARS = (
+    ("trace", "", "enable", False, "fixture knob"),
+)
+ROBUSTNESS_VARS = ()
+SERVING_VARS = ()
+'''
+
+_FIXTURE_SPIN_BAD = '''\
+import time
+
+
+def pump(ring):
+    """Seeded violation: unbounded spin with no wait policy."""
+    while True:
+        if ring.poll():
+            return ring.take()
+        time.sleep(0.01)
+'''
+
+_FIXTURE_SPIN_GOOD = '''\
+import time
+
+
+def pump_bounded(ring, deadline):
+    """Clean twin: the enclosing function consults a Deadline."""
+    while True:
+        if ring.poll():
+            return ring.take()
+        deadline.check()
+        time.sleep(0.01)
+'''
+
+_FIXTURE_LOCK_CYCLE = '''\
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def fwd(self):
+        with self.lock_a:
+            with self.lock_b:
+                return 1
+
+    def rev(self):
+        with self.lock_b:
+            with self.lock_a:
+                return 2
+'''
+
+_FIXTURE_LOCK_CLEAN = '''\
+import threading
+
+
+class Orderly:
+    def __init__(self):
+        self.lock_x = threading.Lock()
+        self.lock_y = threading.Lock()
+
+    def one(self):
+        with self.lock_x:
+            with self.lock_y:
+                return 1
+
+    def two(self):
+        with self.lock_x:
+            with self.lock_y:
+                return 2
+'''
+
+#: the real v1 counter tail (metrics/core.py order) — fixtures carry
+#: the full frozen prefix so append-only checks behave as on head
+_COUNTERS = ("doorbells", "stall_ns", "ring_stall_ns", "ring_stalls",
+             "ring_hwm", "cts_wait_ns", "cts_waits", "rndv_depth",
+             "rndv_hwm", "slot_waits", "eager_msgs", "eager_bytes",
+             "chunked_msgs", "chunked_bytes", "rndv_msgs", "rndv_bytes",
+             "delivered", "unexpected_hwm")
+
+
+def _fixture_dcn_cc(names: tuple[str, ...]) -> str:
+    joined = ",".join(("version",) + names)
+    quoted = "\n    ".join(f'"{part},"' for part in joined.split(",")[:-1])
+    return (f'static const char *TDCN_STAT_NAMES =\n    {quoted}\n'
+            f'    "{joined.rsplit(",", 1)[1]}";\n')
+
+
+def _fixture_metrics_core(names: tuple[str, ...]) -> str:
+    rows = "\n".join(f'    "{n}",' for n in names)
+    return f"NATIVE_COUNTERS = (\n{rows}\n)\n"
+
+
+def build_fixture_tree(root: Path, *, spin: str = "bad",
+                       mca_ref: str = "trace_enable",
+                       locks: str = "cycle",
+                       rename_counter: str | None = None) -> Path:
+    """Materialize a seeded mini-repo under ``root``.  Knobs select the
+    violation (or its clean twin) per pass:
+
+    * ``spin``: "bad" → unbounded spin in dcn scope; "good" → Deadline.
+    * ``mca_ref``: the var name the fixture README references.
+    * ``locks``: "cycle" → opposite-order pair; "clean" → same order.
+    * ``rename_counter``: rename this NATIVE_COUNTERS name on the C
+      side only (ABI drift); None → both sides agree.
+    """
+    (root / "ompi_tpu" / "core").mkdir(parents=True, exist_ok=True)
+    (root / "ompi_tpu" / "dcn").mkdir(parents=True, exist_ok=True)
+    (root / "ompi_tpu" / "metrics").mkdir(parents=True, exist_ok=True)
+    (root / "native" / "src").mkdir(parents=True, exist_ok=True)
+    (root / "ompi_tpu" / "core" / "var.py").write_text(_FIXTURE_VAR_PY)
+    (root / "ompi_tpu" / "dcn" / "pump.py").write_text(
+        _FIXTURE_SPIN_BAD if spin == "bad" else _FIXTURE_SPIN_GOOD)
+    (root / "ompi_tpu" / "dcn" / "tcp.py").write_text(
+        _FIXTURE_LOCK_CYCLE if locks == "cycle" else _FIXTURE_LOCK_CLEAN)
+    (root / "ompi_tpu" / "metrics" / "core.py").write_text(
+        _fixture_metrics_core(_COUNTERS))
+    c_names = _COUNTERS
+    if rename_counter:
+        c_names = tuple(f"{n}_v2" if n == rename_counter else n
+                        for n in _COUNTERS)
+    (root / "native" / "src" / "dcn.cc").write_text(_fixture_dcn_cc(c_names))
+    (root / "README.md").write_text(
+        f"Fixture repo.  Enable with ``--mca {mca_ref} 1``.\n"
+        "Counters: " + ", ".join(f"`{n}`" for n in _COUNTERS) + "\n")
+    return root
+
+
+# -- selftest legs -------------------------------------------------------
+
+def _expect(log: list[str], ok, what: str) -> bool:
+    ok = bool(ok)
+    log.append(f"  {'ok' if ok else 'FAIL'}: {what}")
+    return ok
+
+
+def _leg_invariants(tmp: Path, log: list[str]) -> bool:
+    bad = build_fixture_tree(tmp / "inv_bad")
+    fs = invariants.run(bad)
+    rules = {f.rule for f in fs}
+    ok = _expect(log, "unbounded-spin" in rules,
+                 "seeded Deadline-less spin detected")
+    spin = [f for f in fs if f.rule == "unbounded-spin"]
+    ok &= _expect(log, any(f.file == "ompi_tpu/dcn/pump.py"
+                           and f.symbol == "pump" for f in spin),
+                  "spin finding anchored at pump()")
+    good = build_fixture_tree(tmp / "inv_good", spin="good")
+    fs2 = invariants.run(good)
+    ok &= _expect(log, not any(f.rule == "unbounded-spin" for f in fs2),
+                  "Deadline twin stays clean")
+    mca = build_fixture_tree(tmp / "inv_mca", spin="good",
+                             mca_ref="bogus_fixture_knob")
+    fs3 = invariants.run(mca)
+    ok &= _expect(log,
+                  any(f.rule == "mca-unregistered"
+                      and "bogus_fixture_knob" in f.message for f in fs3),
+                  "unregistered --mca reference detected")
+    return ok
+
+
+def _leg_lockorder(tmp: Path, log: list[str]) -> bool:
+    bad = build_fixture_tree(tmp / "lk_bad", spin="good")
+    fs = lockorder.run(bad)
+    cyc = [f for f in fs if f.rule == "lock-cycle"]
+    ok = _expect(log, len(cyc) == 1, "seeded two-lock cycle detected")
+    if cyc:
+        ok &= _expect(log, "Engine.lock_a" in cyc[0].symbol
+                      and "Engine.lock_b" in cyc[0].symbol,
+                      "cycle names both lock classes")
+    clean = build_fixture_tree(tmp / "lk_clean", spin="good",
+                               locks="clean")
+    fs2 = lockorder.run(clean)
+    ok &= _expect(log, not any(f.rule == "lock-cycle" for f in fs2),
+                  "consistent-order twin stays clean")
+    return ok
+
+
+def _leg_abidrift(tmp: Path, log: list[str]) -> bool:
+    bad = build_fixture_tree(tmp / "abi_bad", spin="good",
+                             rename_counter="delivered")
+    fs = abidrift.check_stat_names(bad)
+    rules = {f.rule for f in fs}
+    ok = _expect(log, "stat-names-drift" in rules,
+                 "renamed TDCN_STAT_NAMES entry detected as drift")
+    ok &= _expect(log, "stat-append-only" in rules,
+                  "rename inside the frozen v1 prefix flagged append-only")
+    good = build_fixture_tree(tmp / "abi_good", spin="good")
+    fs2 = abidrift.check_stat_names(good)
+    ok &= _expect(log, not fs2, "agreeing tables stay clean")
+    return ok
+
+
+def _leg_waivers(tmp: Path, log: list[str]) -> bool:
+    bad = build_fixture_tree(tmp / "wv", )
+    fs = invariants.run(bad)
+    wv_text = (
+        '[[waiver]]\npass = "invariants"\nrule = "unbounded-spin"\n'
+        'file = "ompi_tpu/dcn/pump.py"\nreason = "fixture: waived"\n\n'
+        '[[waiver]]\npass = "invariants"\nrule = "hardcoded-timeout"\n'
+        'file = "ompi_tpu/dcn/nothere.py"\nreason = "fixture: stale"\n')
+    wpath = tmp / "wv" / "waivers.toml"
+    wpath.write_text(wv_text)
+    waivers = F.load_waivers(wpath)
+    merged = F.apply_waivers(fs, waivers)
+    spin = [f for f in merged if f.rule == "unbounded-spin"]
+    ok = _expect(log, spin and all(f.waived for f in spin),
+                 "matching waiver suppresses the finding")
+    ok &= _expect(log,
+                  any(f.rule == "stale-waiver" for f in merged),
+                  "no-match waiver reported stale")
+    return ok
+
+
+def _leg_lockdep(log: list[str]) -> bool:
+    lockdep.enable()
+    try:
+        lockdep.reset()
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        vs = lockdep.violations()
+        ok = _expect(log, any(v.kind == "inversion" for v in vs),
+                     "runtime witness records the AB/BA inversion")
+        raised = False
+        try:
+            lockdep.assert_clean()
+        except lockdep.LockOrderInversion:
+            raised = True
+        ok &= _expect(log, raised, "assert_clean raises on inversion")
+        lockdep.reset()
+        with a:
+            with b:
+                pass
+        with a:
+            with b:
+                pass
+        ok &= _expect(log, not lockdep.violations(),
+                      "consistent order stays clean")
+    finally:
+        lockdep.disable()
+        lockdep.reset()
+    return ok
+
+
+def _leg_live_repo(repo: Path, log: list[str]) -> bool:
+    report = F.Report(str(repo))
+    for name in ("invariants", "lockorder", "abidrift"):
+        mod = {"invariants": invariants, "lockorder": lockorder,
+               "abidrift": abidrift}[name]
+        report.extend(name, mod.run(repo))
+    waivers = F.load_waivers(repo / "ompi_tpu" / "analysis" / "waivers.toml")
+    report.findings = F.apply_waivers(report.findings, waivers)
+    bad = report.unwaived(F.SEV_ERROR)
+    ok = _expect(log, not bad,
+                 f"live repo: 3 static passes, {len(report.findings)} "
+                 f"findings, {sum(1 for f in report.findings if f.waived)} "
+                 "waived, 0 unwaived errors")
+    for f in bad[:10]:
+        log.append("    " + f.render()[:160])
+    return ok
+
+
+def run_selftest(repo_root: str | Path) -> tuple[bool, list[str]]:
+    """All selftest legs; returns (ok, human-readable log lines)."""
+    repo = Path(repo_root)
+    log: list[str] = []
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="tpucheck_selftest_") as td:
+        tmp = Path(td)
+        log.append("fixture: invariant linter")
+        ok &= _leg_invariants(tmp, log)
+        log.append("fixture: lock-order analyzer")
+        ok &= _leg_lockorder(tmp, log)
+        log.append("fixture: ABI drift checker")
+        ok &= _leg_abidrift(tmp, log)
+        log.append("fixture: waiver round-trip")
+        ok &= _leg_waivers(tmp, log)
+        log.append("runtime: lockdep witness")
+        ok &= _leg_lockdep(log)
+        log.append("live repo: contract gate")
+        ok &= _leg_live_repo(repo, log)
+    return ok, log

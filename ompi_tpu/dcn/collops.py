@@ -637,7 +637,8 @@ class DcnSubEngine(DcnCollEngine):
         return self.parent.transport
 
     def set_addresses(self, addresses) -> None:  # pragma: no cover
-        raise RuntimeError("sub-engines inherit the parent's addresses")
+        raise NotImplementedError(
+            "sub-engines inherit the parent's addresses")
 
     def _queue(self, key: tuple) -> queue.Queue:
         return self.parent._queue(key)
@@ -709,7 +710,8 @@ class DcnJoinEngine(DcnCollEngine):
         return self.parent.transport
 
     def set_addresses(self, addresses) -> None:  # pragma: no cover
-        raise RuntimeError("join engines are constructed with addresses")
+        raise NotImplementedError(
+            "join engines are constructed with addresses")
 
     def _queue(self, key: tuple) -> queue.Queue:
         return self.parent._queue(key)

@@ -16,9 +16,10 @@ Two interposers:
   ``coll/monitoring`` uses (provide every op, delegate to the module
   below, account on the way through).
 
-Both are enabled with ``--mca monitoring_enable 1``; matrices are
+Both are enabled with ``--mca monitoring_base_enable 1``; matrices are
 fetched with :func:`flush` (and dumped to the path in
-``monitoring_output`` at finalize, the ``common/monitoring`` behavior).
+``monitoring_base_output`` at finalize, the ``common/monitoring``
+behavior).
 """
 
 from __future__ import annotations

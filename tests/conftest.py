@@ -31,3 +31,26 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+# Opt-in runtime lockdep (tpucheck's dynamic witness): under
+# OMPI_TPU_LOCKDEP=1 every lock allocated DURING the test session is
+# order-witnessed, and an observed AB/BA inversion fails the session
+# at teardown.  Off by default — the witness costs a dict update per
+# acquire and belongs in targeted runs, not every tier-1 pass.
+from ompi_tpu.core.var import _TRUE_STRINGS  # noqa: E402
+
+if os.environ.get("OMPI_TPU_LOCKDEP", "").strip().lower() in _TRUE_STRINGS:
+
+    @pytest.fixture(scope="session", autouse=True)
+    def _lockdep_witness():
+        from ompi_tpu.analysis import lockdep
+
+        lockdep.enable()
+        lockdep.reset()
+        yield
+        try:
+            lockdep.assert_clean()
+        finally:
+            lockdep.disable()
+            lockdep.reset()

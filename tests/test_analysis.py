@@ -1,0 +1,509 @@
+"""tpucheck (ompi_tpu/analysis/) — the machine-checked contracts.
+
+Covers every pass against seeded fixture trees with one known
+violation each (the ISSUE's acceptance set: missing Deadline,
+unregistered --mca var, lock cycle, renamed TDCN_STAT_NAMES counter),
+the clean twins, the waiver round-trip (matching waiver suppresses /
+stale waiver reported), the runtime lockdep witness (AB/BA inversion
+→ test failure), the live-repo contract gate (head must be clean
+modulo reviewed waivers — the ABI pass "passes on head" criterion),
+and the tier-1 ``tools/check.py --selftest`` CLI like chaos.py/top.py.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+CHECK = REPO / "tools" / "check.py"
+
+from ompi_tpu.analysis import abidrift, findings as F, invariants, lockorder
+from ompi_tpu.analysis import lockdep
+from ompi_tpu.analysis.selftest import build_fixture_tree
+
+
+# -- pass 1: invariant linter ------------------------------------------
+
+
+def test_spin_fixture_detected(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="bad")
+    fs = invariants.run(root)
+    spin = [f for f in fs if f.rule == "unbounded-spin"]
+    assert len(spin) == 1
+    assert spin[0].file == "ompi_tpu/dcn/pump.py"
+    assert spin[0].symbol == "pump"
+    assert spin[0].severity == F.SEV_ERROR
+
+
+def test_spin_deadline_twin_clean(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    assert not [f for f in invariants.run(root)
+                if f.rule == "unbounded-spin"]
+
+
+def test_mca_unregistered_fixture(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good",
+                              mca_ref="bogus_fixture_knob")
+    fs = invariants.run(root)
+    hits = [f for f in fs if f.rule == "mca-unregistered"]
+    assert any("bogus_fixture_knob" in f.message for f in hits)
+
+
+def test_mca_registered_reference_clean(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good",
+                              mca_ref="trace_enable")
+    assert not [f for f in invariants.run(root)
+                if f.rule == "mca-unregistered"]
+
+
+def test_mca_dead_registration(tmp_path):
+    # fixture README references trace_enable; drop the reference and
+    # the central registration becomes a dead knob
+    root = build_fixture_tree(tmp_path, spin="good", mca_ref="trace_enable")
+    (root / "README.md").write_text("no knob references here\n")
+    fs = invariants.run(root)
+    dead = [f for f in fs if f.rule == "mca-dead-registration"]
+    assert any("trace_enable" in f.message for f in dead)
+
+
+def test_hardcoded_timeout_rule(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "ompi_tpu" / "dcn" / "waits.py").write_text(
+        "import socket\n\n\n"
+        "def dial(sock):\n"
+        "    sock.settimeout(600)\n"
+        "    return sock\n")
+    fs = invariants.run(root)
+    hits = [f for f in fs if f.rule == "hardcoded-timeout"]
+    assert len(hits) == 1 and "600" in hits[0].message
+
+
+def test_untyped_escalation_rule(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "ompi_tpu" / "dcn" / "tcp.py").write_text(
+        "def escalate(peer):\n"
+        "    raise RuntimeError(f'peer {peer} failed')\n")
+    fs = invariants.run(root)
+    hits = [f for f in fs if f.rule == "untyped-escalation"]
+    assert len(hits) == 1 and hits[0].file == "ompi_tpu/dcn/tcp.py"
+
+
+def test_t0_latch_idiom_is_gated(tmp_path):
+    """The hot-path `t0 = now() if _trace._enabled else 0` +
+    `if t0:` idiom counts as gated — no ungated-hook finding."""
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "ompi_tpu" / "trace").mkdir(parents=True)
+    (root / "ompi_tpu" / "trace" / "core.py").write_text(
+        "_enabled = False\n\n\n"
+        "def now():\n    return 1\n\n\n"
+        "def complete(kind):\n    pass\n")
+    (root / "ompi_tpu" / "api").mkdir(parents=True)
+    (root / "ompi_tpu" / "api" / "comm.py").write_text(
+        "from ompi_tpu.trace import core as _trace\n\n\n"
+        "def dispatch(op):\n"
+        "    t0 = _trace.now() if _trace._enabled else 0\n"
+        "    result = op()\n"
+        "    if t0:\n"
+        "        _trace.complete('api')\n"
+        "    return result\n\n\n"
+        "def dispatch_ungated(op):\n"
+        "    _trace.complete('api')\n"
+        "    return op()\n")
+    fs = invariants.run(root)
+    hits = [f for f in fs if f.rule == "ungated-hook"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "dispatch_ungated"
+
+
+# -- pass 2: lock-order analyzer ---------------------------------------
+
+
+def test_lock_cycle_fixture_detected(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good", locks="cycle")
+    fs = lockorder.run(root)
+    cyc = [f for f in fs if f.rule == "lock-cycle"]
+    assert len(cyc) == 1
+    assert "Engine.lock_a" in cyc[0].symbol
+    assert "Engine.lock_b" in cyc[0].symbol
+
+
+def test_lock_order_consistent_clean(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good", locks="clean")
+    assert not [f for f in lockorder.run(root) if f.rule == "lock-cycle"]
+
+
+def test_lock_cycle_through_call_chain(tmp_path):
+    """Interprocedural: A held while CALLING a function that takes B,
+    plus the direct B→A nesting elsewhere, closes the cycle."""
+    root = build_fixture_tree(tmp_path, spin="good", locks="clean")
+    (root / "ompi_tpu" / "dcn" / "tcp.py").write_text(
+        "import threading\n\n\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.lock_a = threading.Lock()\n"
+        "        self.lock_b = threading.Lock()\n\n"
+        "    def take_b(self):\n"
+        "        with self.lock_b:\n"
+        "            return 1\n\n"
+        "    def fwd(self):\n"
+        "        with self.lock_a:\n"
+        "            return self.take_b()\n\n"
+        "    def rev(self):\n"
+        "        with self.lock_b:\n"
+        "            with self.lock_a:\n"
+        "                return 2\n")
+    fs = lockorder.run(root)
+    assert [f for f in fs if f.rule == "lock-cycle"]
+
+
+def test_lock_held_blocking_detected(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good", locks="clean")
+    (root / "ompi_tpu" / "dcn" / "tcp.py").write_text(
+        "import threading\n\n\n"
+        "class Pump:\n"
+        "    def __init__(self, sock):\n"
+        "        self.lock = threading.Lock()\n"
+        "        self.sock = sock\n\n"
+        "    def fwd(self):\n"
+        "        with self.lock:\n"
+        "            self.sock.recv(1024)\n")
+    fs = lockorder.run(root)
+    hits = [f for f in fs if f.rule == "lock-held-blocking"]
+    assert len(hits) == 1 and "recv" in hits[0].message
+
+
+def test_lock_self_cycle_detected(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good", locks="clean")
+    (root / "ompi_tpu" / "dcn" / "tcp.py").write_text(
+        "import threading\n\n\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.Lock()\n\n"
+        "    def inner(self):\n"
+        "        with self.lock:\n"
+        "            return 1\n\n"
+        "    def outer(self):\n"
+        "        with self.lock:\n"
+        "            return self.inner()\n")
+    fs = lockorder.run(root)
+    assert [f for f in fs if f.rule == "lock-self-cycle"]
+
+
+# -- pass 3: ABI drift checker -----------------------------------------
+
+
+def test_renamed_counter_detected(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good",
+                              rename_counter="delivered")
+    fs = abidrift.check_stat_names(root)
+    rules = {f.rule for f in fs}
+    assert "stat-names-drift" in rules
+    assert "stat-append-only" in rules
+
+
+def test_counter_tables_agree_clean(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    assert not abidrift.check_stat_names(root)
+
+
+def test_abi_pass_clean_on_head():
+    """Acceptance: the ABI pass passes on the real repo head."""
+    fs = [f for f in abidrift.run(REPO) if f.severity == F.SEV_ERROR]
+    assert not fs, "\n".join(f.render() for f in fs)
+
+
+def test_ctypes_arity_drift(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "native" / "src" / "dcn.cc").write_text(
+        (root / "native" / "src" / "dcn.cc").read_text()
+        + '\nint tdcn_ping(void *h, int x) { return x; }\n')
+    (root / "ompi_tpu" / "dcn" / "native.py").write_text(
+        "import ctypes\n\n"
+        "def bind(lib):\n"
+        "    lib.tdcn_ping.argtypes = [ctypes.c_void_p]\n"
+        "    lib.tdcn_ping.restype = ctypes.c_int\n")
+    fs = abidrift.check_ctypes(root)
+    assert any(f.rule == "abi-arity" for f in fs)
+
+
+def test_ctypes_width_drift(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "native" / "src" / "dcn.cc").write_text(
+        (root / "native" / "src" / "dcn.cc").read_text()
+        + '\nint tdcn_ping(void *h, int64_t x) { return (int)x; }\n')
+    (root / "ompi_tpu" / "dcn" / "native.py").write_text(
+        "import ctypes\n\n"
+        "def bind(lib):\n"
+        "    lib.tdcn_ping.argtypes = [ctypes.c_void_p, ctypes.c_int]\n"
+        "    lib.tdcn_ping.restype = ctypes.c_int\n")
+    fs = abidrift.check_ctypes(root)
+    hits = [f for f in fs if f.rule == "abi-type"]
+    assert hits and "int64" in hits[0].message
+
+
+def test_ctypes_undeclared_call(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "native" / "src" / "dcn.cc").write_text(
+        (root / "native" / "src" / "dcn.cc").read_text()
+        + '\nint tdcn_ping(void *h) { return 0; }\n')
+    (root / "ompi_tpu" / "dcn" / "native.py").write_text(
+        "def poke(lib):\n    return lib.tdcn_ping(None)\n")
+    fs = abidrift.check_ctypes(root)
+    assert any(f.rule == "abi-undeclared-call" for f in fs)
+
+
+def test_extern_redecl_arity_drift(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="good")
+    (root / "native" / "src" / "dcn.cc").write_text(
+        (root / "native" / "src" / "dcn.cc").read_text()
+        + '\nint tdcn_ping(void *h, int x) { return x; }\n')
+    (root / "native" / "src" / "shim.c").write_text(
+        'extern int tdcn_ping(void *h);\n')
+    (root / "ompi_tpu" / "dcn" / "native.py").write_text("")
+    fs = abidrift.check_ctypes(root)
+    hits = [f for f in fs if f.rule == "abi-shim-decl"]
+    assert hits and "1 parameters" in hits[0].message
+
+
+# -- waivers -----------------------------------------------------------
+
+
+def test_waiver_round_trip(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="bad")
+    fs = invariants.run(root)
+    wpath = tmp_path / "waivers.toml"
+    wpath.write_text(
+        '[[waiver]]\n'
+        'pass = "invariants"\n'
+        'rule = "unbounded-spin"\n'
+        'file = "ompi_tpu/dcn/pump.py"\n'
+        'reason = "fixture exception"\n')
+    merged = F.apply_waivers(fs, F.load_waivers(wpath))
+    spin = [f for f in merged if f.rule == "unbounded-spin"]
+    assert spin and all(f.waived for f in spin)
+    assert spin[0].waiver_reason == "fixture exception"
+    assert not [f for f in merged if f.rule == "stale-waiver"]
+
+
+def test_stale_waiver_reported(tmp_path):
+    wpath = tmp_path / "waivers.toml"
+    wpath.write_text(
+        '[[waiver]]\n'
+        'pass = "invariants"\n'
+        'rule = "unbounded-spin"\n'
+        'file = "ompi_tpu/dcn/nothere.py"\n'
+        'reason = "points at nothing"\n')
+    merged = F.apply_waivers([], F.load_waivers(wpath),
+                             passes_run=["invariants"])
+    assert [f for f in merged if f.rule == "stale-waiver"]
+    # ...but not when the waiver's pass did not run this invocation
+    merged = F.apply_waivers([], F.load_waivers(wpath),
+                             passes_run=["abidrift"])
+    assert not merged
+
+
+def test_waiver_requires_reason(tmp_path):
+    wpath = tmp_path / "waivers.toml"
+    wpath.write_text(
+        '[[waiver]]\npass = "invariants"\nrule = "unbounded-spin"\n'
+        'file = "x.py"\n')
+    with pytest.raises(ValueError, match="reason"):
+        F.load_waivers(wpath)
+
+
+def test_toml_subset_rejects_unknown_tables(tmp_path):
+    with pytest.raises(ValueError, match="waiver"):
+        F.parse_toml_tables("[[other]]\nx = 1\n")
+
+
+def test_report_json_schema(tmp_path):
+    root = build_fixture_tree(tmp_path, spin="bad")
+    rep = F.Report(str(root))
+    rep.extend("invariants", invariants.run(root))
+    out = tmp_path / "report.json"
+    rep.write_json(out)
+    d = json.loads(out.read_text())
+    assert d["version"] == 1
+    assert d["summary"]["unwaived_errors"] >= 1
+    assert d["summary"]["by_pass"].get("invariants", 0) >= 1
+    assert all({"pass_name", "rule", "file", "line", "severity"}
+               <= set(f) for f in d["findings"])
+
+
+# -- runtime lockdep witness -------------------------------------------
+
+
+@pytest.fixture
+def witness():
+    lockdep.enable()
+    lockdep.reset()
+    yield lockdep
+    lockdep.disable()
+    lockdep.reset()
+
+
+def test_lockdep_inversion_detected(witness):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    with pytest.raises(lockdep.LockOrderInversion, match="inversion"):
+        lockdep.assert_clean()
+
+
+def test_lockdep_consistent_order_clean(witness):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    lockdep.assert_clean()
+
+
+def test_lockdep_cross_thread_inversion(witness):
+    """The order graph is global: thread 1 records A→B, thread 2's
+    B→A completes the inversion even though neither deadlocks."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+
+    def other():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert any(v.kind == "inversion" for v in lockdep.violations())
+
+
+def test_lockdep_trylock_is_not_held(witness):
+    """A failed try-acquire must not enter the held stack (else every
+    subsequent acquire fabricates edges), and a same-object try-lock
+    is not reported as self-deadlock — it cannot wedge."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        assert not a.acquire(blocking=False)
+        with b:
+            pass
+    with b:  # b→a would invert ONLY if the failed try-lock leaked
+        pass
+    assert not lockdep.violations()
+
+
+def test_lockdep_trylock_records_no_edge(witness):
+    """A SUCCESSFUL try-acquire must not record an order edge either —
+    hold-A + trylock-B is the fail-fast idiom used precisely to avoid
+    deadlock (Linux lockdep excludes trylocks the same way), so a
+    blocking B→A elsewhere is not an inversion."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        assert b.acquire(blocking=False)  # would record a→b if counted
+        b.release()
+    with b:
+        with a:  # blocking b→a: clean, the trylock edge must not exist
+            pass
+    lockdep.assert_clean()
+
+
+def test_lockdep_condition_wait_releases(witness):
+    """Condition.wait must drop the held entry — no phantom edges from
+    the wait-side."""
+    cv = threading.Condition()
+    done = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with cv:
+        cv.notify_all()
+    t.join()
+    assert done
+    lockdep.assert_clean()
+
+
+@pytest.mark.skipif(
+    bool(__import__("os").environ.get("OMPI_TPU_LOCKDEP")),
+    reason="session-wide witness armed (OMPI_TPU_LOCKDEP)")
+def test_lockdep_disabled_is_transparent():
+    assert not lockdep.enabled()
+    lk = threading.Lock()
+    assert type(lk).__module__ == "_thread"  # the real factory
+    with lk:
+        pass
+    assert not lockdep.violations()
+
+
+def test_lockdep_enable_nests():
+    """A test-local witness must not disarm an outer one (the
+    session-wide OMPI_TPU_LOCKDEP=1 fixture): enable/disable are
+    refcounted, only the outermost disable restores the factories."""
+    was_enabled = lockdep.enabled()
+    lockdep.enable()
+    lockdep.enable()
+    lockdep.disable()
+    assert lockdep.enabled()
+    lockdep.disable()
+    assert lockdep.enabled() == was_enabled
+
+
+# -- live repo contract gate + CLI -------------------------------------
+
+
+def test_live_repo_static_passes_clean_with_waivers():
+    """The PR 1–6 contracts hold on head modulo the reviewed waiver
+    file — the tier-1 gate the selftest also enforces."""
+    rep = F.Report(str(REPO))
+    rep.extend("invariants", invariants.run(REPO))
+    rep.extend("lockorder", lockorder.run(REPO))
+    rep.extend("abidrift", abidrift.run(REPO))
+    waivers = F.load_waivers(REPO / "ompi_tpu" / "analysis" / "waivers.toml")
+    rep.findings = F.apply_waivers(rep.findings, waivers,
+                                   passes_run=rep.passes_run)
+    bad = rep.unwaived(F.SEV_ERROR)
+    assert not bad, "\n".join(f.render() for f in bad)
+    # and the reviewed waiver file itself is not stale
+    stale = [f for f in rep.findings if f.rule == "stale-waiver"]
+    assert not stale, "\n".join(f.render() for f in stale)
+
+
+def test_check_selftest_cli():
+    """CI satellite: tools/check.py --selftest in tier-1 like
+    chaos.py/top.py — every pass detects its seeded violation and the
+    live tree is clean."""
+    res = subprocess.run([sys.executable, str(CHECK), "--selftest"],
+                         capture_output=True, timeout=300)
+    assert res.returncode == 0, (res.stdout.decode()
+                                 + res.stderr.decode())
+    assert b"selftest OK" in res.stdout
+    assert b"FAIL" not in res.stdout
+
+
+def test_check_fast_cli():
+    """The --fast pre-commit target exits 0 on head."""
+    res = subprocess.run([sys.executable, str(CHECK), "--fast"],
+                         capture_output=True, timeout=300)
+    assert res.returncode == 0, (res.stdout.decode()
+                                 + res.stderr.decode())
