@@ -218,7 +218,10 @@ def _geomean(ratios) -> float:
 
 def run(max_bytes: int, iters: int, suite_max: int, step: int) -> dict:
     import jax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5: experimental namespace, same sig
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     import ompi_tpu.api as api
@@ -507,6 +510,41 @@ def capi_p2p_rows() -> dict:
     return rows
 
 
+def osu_bw_sweep_rows() -> dict:
+    """np=2 C-path windowed-vs-unwindowed bandwidth sweep
+    (64 KiB–16 MiB) with per-(size, window) sender-side
+    ``native_counters`` deltas — the osu_bw-collapse regression leg:
+    the windowed rate must stay monotone non-decreasing and never fall
+    below the unwindowed rate at the same size, and the doorbell /
+    ring-stall deltas show WHY a row moved run-over-run."""
+    from ompi_tpu import native
+
+    bin_path = REPO / "native" / "build" / "osu_bw_sweep"
+    native.compile_mpi_program(
+        REPO / "native" / "bench" / "osu_bw_sweep.c", bin_path)
+    text = _run_tpurun(2, str(bin_path), [16 << 20, 64, 4], timeout=600)
+    for line in text.splitlines():
+        if "SWEEP " in line:
+            out = json.loads(line.split("SWEEP ", 1)[1])
+            break
+    else:
+        raise RuntimeError(f"no SWEEP line:\n{text[-2000:]}")
+    rows = out.get("rows", [])
+    for r in rows:
+        uw = r.get("unwin_MBs") or 0.0
+        r["win_over_unwin"] = (round(r["win_MBs"] / uw, 3) if uw else None)
+        wc = r.get("win_counters", {})
+        total_mib = max(1e-9, r["bytes"] * out.get("window", 64) *
+                        out.get("batches", 4) / (1 << 20))
+        r["win_doorbells_per_MiB"] = round(
+            wc.get("doorbells", 0) / total_mib, 3)
+        db = wc.get("doorbells", 0) + wc.get("doorbells_suppressed", 0)
+        r["win_doorbell_suppression"] = (
+            round(wc.get("doorbells_suppressed", 0) / db, 4) if db
+            else None)
+    return out
+
+
 def _tool_rows(script: str, marker: str, timeout: int = 900) -> dict:
     """Run a tools/ bench script in a subprocess and parse its single
     ``MARKER {json}`` stdout line (the shared contract of the cpu8
@@ -700,6 +738,7 @@ def main() -> None:
     if not args.no_subproc:
         for key, fn in (("dcn", dcn_rows), ("capi", capi_rows),
                         ("capi_p2p", capi_p2p_rows),
+                        ("osu_bw_sweep", osu_bw_sweep_rows),
                         ("algos_cpu8", algos_cpu8_rows),
                         ("hostpath_cpu8", hostpath_cpu8_rows),
                         ("serve", serve_rows)):

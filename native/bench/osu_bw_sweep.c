@@ -1,0 +1,161 @@
+/* Windowed vs unwindowed bandwidth sweep with native-counter deltas.
+ *
+ * The osu_bw collapse regression harness: for each size in
+ * 64 KiB..16 MiB, measure osu_bw-style bandwidth twice — window=W
+ * (nonblocking burst + Waitall, the pattern that used to collapse
+ * 4.4x below the serial rate) and window=1 (the unwindowed baseline
+ * the windowed rate must never fall below) — and record the sender's
+ * tpumpi_transport_stats delta per (size, window) row, so the bench
+ * history shows WHY a rate moved (doorbells vs suppressed wakes, ring
+ * stall ns, streamed vs eager bytes), not just that it moved.
+ *
+ * Rank 0 prints one line:  SWEEP {json}
+ *
+ * Usage: osu_bw_sweep [max_bytes] [window] [batches]
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define MAX_WINDOW 64
+#define MAX_STATS 64
+
+extern int tpumpi_transport_stats(unsigned long long *, int);
+extern const char *tpumpi_transport_stats_names(void);
+
+/* the per-row counter deltas worth recording (monotone counters only:
+ * gauges/hwms are snapshots, not per-phase work) */
+static const char *DELTA_KEYS[] = {
+    "doorbells",     "doorbells_suppressed", "ring_stalls",
+    "ring_stall_ns", "stream_msgs",          "stream_bytes",
+    "chunk_shrinks", "sender_yields",        "enqueue_waits",
+    "eager_bytes",   "chunked_bytes",
+};
+#define N_DELTA (int)(sizeof(DELTA_KEYS) / sizeof(DELTA_KEYS[0]))
+
+static int g_nstat = 0;
+static int g_map[N_DELTA]; /* DELTA_KEYS index -> stats slot (-1 none) */
+
+static void map_stats(void) {
+  const char *names = tpumpi_transport_stats_names();
+  for (int k = 0; k < N_DELTA; k++) g_map[k] = -1;
+  int slot = 0;
+  const char *p = names;
+  while (p && *p) {
+    const char *c = strchr(p, ',');
+    size_t len = c ? (size_t)(c - p) : strlen(p);
+    for (int k = 0; k < N_DELTA; k++)
+      if (strlen(DELTA_KEYS[k]) == len && !strncmp(DELTA_KEYS[k], p, len))
+        g_map[k] = slot;
+    slot++;
+    p = c ? c + 1 : NULL;
+  }
+  g_nstat = slot;
+}
+
+static void snap(unsigned long long *out) {
+  memset(out, 0, sizeof(unsigned long long) * MAX_STATS);
+  tpumpi_transport_stats(out, MAX_STATS);
+}
+
+static double run_one(int rank, int peer, long nbytes, int window,
+                      int batches, char *buf, char *rbuf) {
+  MPI_Request reqs[MAX_WINDOW];
+  char ack;
+  int warm = 1;
+  double t0 = 0, dt = 0;
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) {
+    for (int b = -warm; b < batches; b++) {
+      if (b == 0) t0 = MPI_Wtime();
+      for (int w = 0; w < window; w++)
+        MPI_Isend(buf, (int)nbytes, MPI_CHAR, peer, 7, MPI_COMM_WORLD,
+                  &reqs[w]);
+      MPI_Waitall(window, reqs, MPI_STATUSES_IGNORE);
+      MPI_Recv(&ack, 1, MPI_CHAR, peer, 8, MPI_COMM_WORLD,
+               MPI_STATUS_IGNORE);
+    }
+    dt = MPI_Wtime() - t0;
+    return (double)nbytes * window * batches / 1e6 / dt;
+  }
+  if (rank == peer) {
+    for (int b = -warm; b < batches; b++) {
+      for (int w = 0; w < window; w++)
+        MPI_Irecv(rbuf, (int)nbytes, MPI_CHAR, 0, 7, MPI_COMM_WORLD,
+                  &reqs[w]);
+      MPI_Waitall(window, reqs, MPI_STATUSES_IGNORE);
+      MPI_Send(&ack, 1, MPI_CHAR, 0, 8, MPI_COMM_WORLD);
+    }
+  }
+  return 0.0;
+}
+
+int main(int argc, char **argv) {
+  int rank, size;
+  MPI_Init(&argc, &argv);
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size < 2) {
+    fprintf(stderr, "osu_bw_sweep needs >= 2 ranks\n");
+    MPI_Abort(MPI_COMM_WORLD, 1);
+  }
+  long max_bytes = argc > 1 ? atol(argv[1]) : (16L << 20);
+  int window = argc > 2 ? atoi(argv[2]) : 64;
+  int batches = argc > 3 ? atoi(argv[3]) : 4;
+  if (window > MAX_WINDOW) window = MAX_WINDOW;
+  int peer = size - 1;
+
+  char *buf = (char *)malloc((size_t)max_bytes);
+  char *rbuf = (char *)malloc((size_t)max_bytes);
+  memset(buf, rank + 1, (size_t)max_bytes);
+  map_stats();
+
+  unsigned long long s0[MAX_STATS], s1[MAX_STATS];
+  char rows[8192];
+  size_t off = 0;
+  rows[0] = 0;
+
+  for (long nbytes = 64 << 10; nbytes <= max_bytes; nbytes *= 4) {
+    double win_mbs = 0, uw_mbs = 0;
+    unsigned long long dwin[N_DELTA], duw[N_DELTA];
+    /* windowed leg */
+    snap(s0);
+    win_mbs = run_one(rank, peer, nbytes, window, batches, buf, rbuf);
+    snap(s1);
+    for (int k = 0; k < N_DELTA; k++)
+      dwin[k] = g_map[k] >= 0 ? s1[g_map[k]] - s0[g_map[k]] : 0;
+    /* unwindowed leg: same total bytes so the deltas compare 1:1 */
+    snap(s0);
+    uw_mbs = run_one(rank, peer, nbytes, 1, batches * window, buf, rbuf);
+    snap(s1);
+    for (int k = 0; k < N_DELTA; k++)
+      duw[k] = g_map[k] >= 0 ? s1[g_map[k]] - s0[g_map[k]] : 0;
+    if (rank == 0) {
+      off += (size_t)snprintf(
+          rows + off, sizeof(rows) - off,
+          "%s{\"bytes\":%ld,\"win_MBs\":%.1f,\"unwin_MBs\":%.1f,"
+          "\"win_counters\":{",
+          off ? "," : "", nbytes, win_mbs, uw_mbs);
+      for (int k = 0; k < N_DELTA; k++)
+        off += (size_t)snprintf(rows + off, sizeof(rows) - off,
+                                "%s\"%s\":%llu", k ? "," : "",
+                                DELTA_KEYS[k], dwin[k]);
+      off += (size_t)snprintf(rows + off, sizeof(rows) - off,
+                              "},\"unwin_counters\":{");
+      for (int k = 0; k < N_DELTA; k++)
+        off += (size_t)snprintf(rows + off, sizeof(rows) - off,
+                                "%s\"%s\":%llu", k ? "," : "",
+                                DELTA_KEYS[k], duw[k]);
+      off += (size_t)snprintf(rows + off, sizeof(rows) - off, "}}");
+    }
+  }
+  if (rank == 0)
+    printf("SWEEP {\"window\":%d,\"batches\":%d,\"rows\":[%s]}\n", window,
+           batches, rows);
+
+  free(buf);
+  free(rbuf);
+  MPI_Finalize();
+  return 0;
+}

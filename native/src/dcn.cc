@@ -106,13 +106,17 @@ struct WireHdr {
   uint64_t off;       // FRAG payload offset
   uint64_t total;     // full payload bytes (RTS/FRAG reassembly)
   uint64_t nbytes;    // payload bytes IN THIS FRAME
+  uint64_t order;     // ring-path ordered-delivery tag (streaming send
+                      // engine): nonzero on records whose DELIVERY must
+                      // respect per-peer issue order even though the
+                      // sender thread interleaves their FRAGs
   uint16_t cid_len;
   uint16_t pad;
   uint32_t meta_len;
 };
 #pragma pack(pop)
 
-static_assert(sizeof(WireHdr) == 64, "wire header is 64 bytes");
+static_assert(sizeof(WireHdr) == 72, "wire header is 72 bytes");
 
 // The C <-> Python message record (ctypes mirror in dcn/native.py).
 #pragma pack(push, 1)
@@ -223,6 +227,18 @@ enum TdcnStatIdx {
                          // (bumped Python-side via the _py_stats merge —
                          // the slot exists so the name table stays the
                          // single source of schema truth)
+  // -- streaming-send-engine tail (appended; version stays 1) ---------
+  TS_DOORBELLS_SUPPRESSED,  // futex wakes skipped: no waiter was parked
+                            // (TS_DOORBELLS + this = every publish)
+  TS_STREAM_MSGS,        // messages routed through the pipelined sender
+  TS_STREAM_BYTES,
+  TS_STREAM_DEPTH,       // gauge: in-flight stream descriptors (all peers)
+  TS_STREAM_DEPTH_HWM,
+  TS_STREAM_INFLIGHT,    // gauge: queued-unsent stream bytes (all peers)
+  TS_STREAM_INFLIGHT_HWM,
+  TS_CHUNK_SHRINKS,      // adaptive chunk halvings under ring stall
+  TS_SENDER_YIELDS,      // full-ring turns yielded to other peers' work
+  TS_ENQUEUE_WAITS,      // enqueues that blocked on dcn_inflight_limit
   TS_COUNT
 };
 
@@ -234,7 +250,10 @@ static const char *TDCN_STAT_NAMES =
     "eager_msgs,eager_bytes,chunked_msgs,chunked_bytes,"
     "rndv_msgs,rndv_bytes,delivered,unexpected_hwm,"
     "reconnects,retry_dials,retry_sends,deadline_expired,injected_faults,"
-    "dedup_drops,respawns";
+    "dedup_drops,respawns,"
+    "doorbells_suppressed,stream_msgs,stream_bytes,"
+    "stream_depth,stream_depth_hwm,stream_inflight,stream_inflight_hwm,"
+    "chunk_shrinks,sender_yields,enqueue_waits";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -359,7 +378,17 @@ static bool writev_all(int fd, struct iovec *iov, int cnt) {
 struct ShmCtrl {
   std::atomic<uint64_t> head;  // producer cursor
   std::atomic<uint64_t> tail;  // consumer cursor
-  char pad[48];
+  // consumer→producer space doorbell: a backpressured producer parks
+  // on `space_seq` (futex) instead of burning a core in sched_yield —
+  // on a 2-core box that spin DIRECTLY starves the consumer it is
+  // waiting for, the mechanism behind the windowed osu_bw collapse.
+  // `prod_waiting` is the Dekker flag: the consumer pays one relaxed
+  // load per record while nobody waits, and only bumps/wakes when a
+  // producer declared itself parked (store-load ordering via seq_cst
+  // fences on both sides; a 2 ms futex timeout backstops any race).
+  std::atomic<uint32_t> space_seq;
+  std::atomic<uint32_t> prod_waiting;
+  char pad[40];
 };
 
 static const uint64_t PAD_BIT = 1ull << 63;
@@ -384,6 +413,8 @@ struct ShmRing {
     size = sz;
     ctrl->head.store(0, std::memory_order_relaxed);
     ctrl->tail.store(0, std::memory_order_relaxed);
+    ctrl->space_seq.store(0, std::memory_order_relaxed);
+    ctrl->prod_waiting.store(0, std::memory_order_relaxed);
     return true;
   }
 
@@ -407,59 +438,102 @@ struct ShmRing {
                    ctrl->tail.load(std::memory_order_acquire));
   }
 
-  // Reserve space for one contiguous record of `need` bytes (8-aligned,
-  // including the u64 length prefix).  Returns the write pointer or
-  // nullptr on close or deadline expiry (receiver stalled/dead — a
-  // dead consumer freezes `tail`, and a rebase PAD can leave head a
-  // full lap above it, so an unbounded wait here wedges the sender
-  // forever; `timeout_ns` = 0 waits indefinitely, callers pass the
+  // One placement attempt for a record of `need` bytes (8-aligned,
+  // u64 length prefix included).  On success returns the write
+  // pointer and sets *rec_start; on backpressure returns nullptr
+  // without waiting or accounting anything — the streaming sender's
+  // yield-don't-spin primitive.
+  uint8_t *try_reserve(uint64_t need, uint64_t *rec_start) {
+    need = (need + 7) & ~7ull;
+    uint64_t head = ctrl->head.load(std::memory_order_relaxed);
+    uint64_t pos = head % size;
+    uint64_t contig = size - pos;
+    uint64_t want = need;
+    bool pad = false;
+    if (pos >= need &&
+        head == ctrl->tail.load(std::memory_order_acquire)) {
+      // ring is EMPTY: rebase to offset 0 via a PAD record so
+      // steady-state request/reply traffic reuses the same (cache-
+      // and TLB-warm) pages instead of marching cold through the
+      // whole segment once per lap
+      want = contig + need;
+      pad = true;
+    } else if (contig < need) {  // must pad to ring start first
+      want = contig + need;
+      pad = true;
+    }
+    if (size - (head - ctrl->tail.load(std::memory_order_acquire)) <
+        want)
+      return nullptr;
+    if (pad) {
+      *(uint64_t *)(data + pos) = PAD_BIT | contig;
+      head += contig;
+      pos = 0;
+    }
+    *rec_start = head;
+    return data + pos;
+  }
+
+  // Park until the consumer frees space (or `wait_ns` elapses): declare
+  // the producer parked, then futex-wait on the space doorbell the
+  // consumer bumps after advancing tail.  Replaces the old sched_yield
+  // storm — on small hosts that spin competed with the very consumer
+  // it was waiting on.  `seen_tail` is the tail value the caller's
+  // failed placement attempt observed: if tail has already moved past
+  // it the wait is skipped (the Dekker pairing with wake_producer —
+  // flag store → tail read here, tail store → flag read there — makes
+  // a lost wakeup impossible; a 2 ms-scale timeout backstops anyway).
+  void space_wait(uint64_t seen_tail, uint64_t wait_ns) {
+    ctrl->prod_waiting.fetch_add(1, std::memory_order_seq_cst);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    uint32_t seen = ctrl->space_seq.load(std::memory_order_acquire);
+    if (ctrl->tail.load(std::memory_order_acquire) == seen_tail) {
+      struct timespec ts = {(time_t)(wait_ns / 1000000000ull),
+                            (long)(wait_ns % 1000000000ull)};
+      futex_wait(&ctrl->space_seq, seen, &ts);
+    }
+    ctrl->prod_waiting.fetch_sub(1, std::memory_order_relaxed);
+  }
+
+  // Consumer side of the space doorbell: call after advancing tail.
+  // One relaxed load when no producer is parked.
+  void wake_producer() {
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    if (ctrl->prod_waiting.load(std::memory_order_relaxed)) {
+      ctrl->space_seq.fetch_add(1, std::memory_order_release);
+      futex_wake(&ctrl->space_seq, 4);
+    }
+  }
+
+  // Blocking reserve.  Returns the write pointer or nullptr on close
+  // or deadline expiry (receiver stalled/dead — a dead consumer
+  // freezes `tail`, and a rebase PAD can leave head a full lap above
+  // it, so an unbounded wait here wedges the sender forever;
+  // `timeout_ns` = 0 waits indefinitely, callers pass the
   // dcn_ring_timeout policy).  Single producer: only the sender's
   // per-peer lock holder calls this.  `stats` (optional) accounts
   // backpressure: a reserve that cannot be satisfied on its first
   // pass counts one ring stall and the full blocked duration — the
   // "per-chunk doorbell round-trips under backpressure" signal the
-  // osu_bw collapse investigation needs.  The happy path touches no
+  // osu_bw collapse investigation needed.  The happy path touches no
   // clock and no stat.
   uint8_t *reserve(uint64_t need, uint64_t *rec_start,
                    std::atomic<bool> *closing, TdcnStats *stats = nullptr,
                    uint64_t timeout_ns = 0) {
-    need = (need + 7) & ~7ull;
     uint64_t spin = 0;
     uint64_t stall_t0 = 0;
     uint64_t give_up = 0;
     for (;;) {
       if (closing->load(std::memory_order_relaxed)) return nullptr;
-      uint64_t head = ctrl->head.load(std::memory_order_relaxed);
-      uint64_t pos = head % size;
-      uint64_t contig = size - pos;
-      uint64_t want = need;
-      bool pad = false;
-      if (pos >= need &&
-          head == ctrl->tail.load(std::memory_order_acquire)) {
-        // ring is EMPTY: rebase to offset 0 via a PAD record so
-        // steady-state request/reply traffic reuses the same (cache-
-        // and TLB-warm) pages instead of marching cold through the
-        // whole segment once per lap
-        want = contig + need;
-        pad = true;
-      } else if (contig < need) {  // must pad to ring start first
-        want = contig + need;
-        pad = true;
-      }
-      if (size - (head - ctrl->tail.load(std::memory_order_acquire)) >=
-          want) {
+      uint64_t tail0 = ctrl->tail.load(std::memory_order_acquire);
+      uint8_t *w = try_reserve(need, rec_start);
+      if (w) {
         if (stall_t0 && stats) {
           uint64_t d = now_ns() - stall_t0;
           stats->add(TS_RING_STALL_NS, d);
           stats->add(TS_STALL_NS, d);
         }
-        if (pad) {
-          *(uint64_t *)(data + pos) = PAD_BIT | contig;
-          head += contig;
-          pos = 0;
-        }
-        *rec_start = head;
-        return data + pos;
+        return w;
       }
       if (!stall_t0) {
         stall_t0 = now_ns();
@@ -474,11 +548,13 @@ struct ShmRing {
         }
         return nullptr;  // receiver wedged/dead: surface a send error
       }
-      if (++spin < 2048) {
-        sched_yield();
+      if (++spin < 64) {
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
       } else {
-        struct timespec ts = {0, 200000};  // 200 us
-        nanosleep(&ts, nullptr);
+        // 2 ms backstop; the consumer's space doorbell wakes us sooner
+        space_wait(tail0, 2000000ull);
       }
     }
   }
@@ -501,9 +577,18 @@ struct ShmRing {
   }
 };
 
-// doorbell segment: one futex word per receiver process
+// Doorbell segment: one futex word per receiver process (word[0]),
+// plus a parked-waiter count (word[1]) every futex sleeper on word[0]
+// increments before waiting.  Senders ALWAYS bump word[0] (one atomic
+// — any waiter that loaded its `seen` value earlier now returns from
+// futex_wait immediately), but pay the futex_wake SYSCALL only when a
+// waiter is actually parked: under a windowed burst the consumer is
+// busy draining, nobody is parked, and the per-record wake syscalls
+// that serialized the old send path collapse into
+// TS_DOORBELLS_SUPPRESSED bumps.
 struct Doorbell {
   std::atomic<uint32_t> *word = nullptr;
+  std::atomic<uint32_t> *parked = nullptr;
   std::string name;
   int fd = -1;
 
@@ -515,7 +600,9 @@ struct Doorbell {
     void *m = mmap(nullptr, 4096, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
     if (m == MAP_FAILED) return false;
     word = (std::atomic<uint32_t> *)m;
+    parked = word + 1;
     word->store(0);
+    parked->store(0);
     return true;
   }
 
@@ -526,15 +613,24 @@ struct Doorbell {
     void *m = mmap(nullptr, 4096, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
     if (m == MAP_FAILED) return false;
     word = (std::atomic<uint32_t> *)m;
+    parked = word + 1;
     return true;
   }
 
-  void ring() {
+  // `coalesce` off restores the unconditional wake (the
+  // dcn_doorbell_coalesce escape hatch); `stats` may be null.
+  void ring(TdcnStats *stats = nullptr, bool coalesce = true) {
     word->fetch_add(1, std::memory_order_release);
-    // wake everyone: inline-progress waiters AND the backstop poller
-    // race via try_lock; waking only one risks handing the frame to
-    // the poller and paying a second thread handoff to the waiter
-    futex_wake(word, 64);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    if (!coalesce || parked->load(std::memory_order_relaxed)) {
+      if (stats) stats->add(TS_DOORBELLS, 1);
+      // wake everyone: inline-progress waiters AND the backstop poller
+      // race via try_lock; waking only one risks handing the frame to
+      // the poller and paying a second thread handoff to the waiter
+      futex_wake(word, 64);
+    } else if (stats) {
+      stats->add(TS_DOORBELLS_SUPPRESSED, 1);
+    }
   }
 
   void destroy(bool unlink_name) {
@@ -581,6 +677,21 @@ struct PostedReq {
 struct ReqState {
   std::atomic<bool> completed{false};
   bool cancelled = false;
+  // in-place rendezvous placement (tdcn_post_recv_into): the receive
+  // was posted WITH its destination buffer, so an in-order streaming
+  // RTS can reserve the request and land its FRAGs straight in the
+  // user buffer — no reassembly malloc, no delivery copy.  While
+  // `in_fill` is set the request is matched-but-incomplete and can no
+  // longer be cancelled.
+  void *user_buf = nullptr;
+  uint64_t user_cap = 0;
+  bool in_fill = false;   // FRAGs land in user_buf (payload is the
+                          // user's memory — never engine-freed)
+  bool reserved = false;  // matched at RTS time (cancel refuses);
+                          // set for buffered AND copy-path matches so
+                          // the order gate advances at the MATCH, and
+                          // a copy-path message in a stream chain
+                          // cannot stall the in-place ones behind it
   OwnedMsg msg;
   std::condition_variable cv;
 };
@@ -609,6 +720,37 @@ struct CollSlot {
   int waiters = 0;
 };
 
+// One in-flight send owned by the streaming engine (the pipelined
+// large-message path): `isend` enqueues a descriptor instead of
+// holding the peer's send path for the whole message, and the
+// engine's sender thread interleaves FRAG records from every queued
+// descriptor round-robin.  `order` is the per-peer issue-order tag the
+// receiver's delivery gate re-sequences completions with (round-robin
+// chunking can finish a short message before an earlier long one).
+struct Peer;
+
+struct StreamDesc {
+  Env env;
+  Peer *owner = nullptr;          // the peer whose queue holds it (the
+                                  // stream_mu/cv a waiter sleeps on)
+  const uint8_t *data = nullptr;  // send source (owned or borrowed)
+  uint8_t *owned = nullptr;       // engine-owned copy: freed at completion
+  uint64_t nbytes = 0, sent = 0;
+  int64_t xid = 0;
+  uint64_t order = 0;
+  bool rts_sent = false;
+  bool eager = false;     // fits one record: emitted as ONE ordered
+                          // eager record when its turn comes
+  bool detached = false;  // no waiter — the engine deletes the
+                          // descriptor (and frees `owned`) at
+                          // completion; zero-copy isends are NOT
+                          // detached: the MPI request's Wait/Test is
+                          // the waiter, and the user buffer stays
+                          // borrowed until it collects the descriptor
+  bool done = false;
+  int rc = 0;  // valid once done
+};
+
 struct Peer {
   std::string address;   // composite published address
   std::string host_id;   // same-host test
@@ -626,12 +768,36 @@ struct Peer {
   bool same_host = false;
   ShmRing tx_ring;  // our ring toward this peer (created lazily)
   bool ring_announced = false;
+  // lock-free "ring exists" hint for the isend fast path: set (under
+  // send_mu) once ensure_ring announced the ring; a stale false just
+  // routes one send through the locked slow path
+  std::atomic<bool> ring_ready{false};
   Doorbell peer_db;  // peer's doorbell (mapped lazily)
   std::mutex send_mu;
   // sender-side rendezvous: xid -> CTS flag
   std::mutex cts_mu;
   std::condition_variable cts_cv;
   std::map<int64_t, bool> cts;
+  // ---- streaming send engine (ring path) ----------------------------
+  // stream_mu guards the descriptor queue and its accounting; ring
+  // RECORD writes stay serialized by send_mu (the sender thread
+  // try_locks it per turn, so a blocked direct sender never wedges
+  // other peers' streams).  stream_cv wakes blocking senders waiting
+  // for completion and enqueuers waiting under dcn_inflight_limit.
+  std::mutex stream_mu;
+  std::condition_variable stream_cv;
+  std::deque<StreamDesc *> streams;
+  uint64_t stream_inflight = 0;    // queued-unsent payload bytes
+  uint64_t next_order = 1;         // ordered-delivery tag source
+  size_t stream_rr = 0;            // round-robin cursor
+  uint64_t chunk_now = 0;          // adaptive chunk (0 = engine knob)
+  uint64_t chunk_ok = 0;           // consecutive stall-free chunks
+  // ring-timeout watchdog base: written by enqueuers (stream_mu) and
+  // the sender thread (send_mu), read lock-free by the watchdog —
+  // atomic, not a plain word
+  std::atomic<uint64_t> last_progress_ns{0};
+  int cap_waiters = 0;             // enqueuers parked on inflight_limit
+  bool stream_failed = false;      // poisoned: a descriptor timed out
 };
 
 // receiver-side in-flight rendezvous / chunked-ring reassembly
@@ -640,7 +806,17 @@ struct Reassembly {
   uint8_t *buf = nullptr;
   uint64_t total = 0;
   uint64_t received = 0;
-  bool granted = false;  // holds a rndv slot
+  bool granted = false;   // holds a rndv slot
+  uint64_t order = 0;     // nonzero: release through the per-sender
+                          // ordered-delivery gate (ring streaming)
+  uint16_t okey = 0;      // gate sub-key (sender-lineage nonce low
+                          // bits): distinct senders sharing a proc
+                          // index (join worlds) never share a gate
+  uint64_t fill_rid = 0;   // nonzero: matched to a posted recv at RTS
+                           // time — completed via the req, not the
+                           // delivery queues
+  bool fill_user = false;  // `buf` IS the user's posted buffer
+                           // (in-place placement): never freed here
 };
 
 // receiver-side duplicate filter, one per sending proc: `low` is the
@@ -673,6 +849,30 @@ struct Engine {
   int64_t eager_limit = 4 << 20;
   int64_t frag_size = 8 << 20;
   uint64_t ring_bytes = 64ull << 20;
+  // ---- streaming send engine knobs (dcn_chunk_bytes /
+  // dcn_inflight_limit / dcn_doorbell_coalesce MCA vars) -------------
+  // chunk_bytes: ring FRAG granularity AND the streaming threshold —
+  // payloads above it leave the caller's thread via a descriptor and
+  // stream cooperatively; at-or-below go as one direct eager record.
+  std::atomic<uint64_t> chunk_bytes{512ull << 10};
+  // inflight_limit: cap on queued-unsent stream bytes per peer; an
+  // enqueue over it blocks (bounded by dcn_ring_timeout) — graceful
+  // backpressure instead of unbounded buffering.  0 = unlimited.
+  std::atomic<uint64_t> inflight_limit{32ull << 20};
+  std::atomic<uint32_t> db_coalesce{1};
+  // engine-wide stream gauges (TS_STREAM_DEPTH / TS_STREAM_INFLIGHT):
+  // mutated under per-peer stream_mu but reported engine-wide
+  std::atomic<uint64_t> stream_depth_now{0};
+  std::atomic<uint64_t> stream_inflight_now{0};
+  // collision-free xid source for chunked/rendezvous reassembly keys
+  // (was now_ns() ^ proc<<56 — two same-nanosecond large sends to one
+  // peer could collide and cross-corrupt reassembly); high byte still
+  // carries the proc for log readability
+  std::atomic<uint64_t> next_xid{1};
+  // sender-thread wakeup: enqueues bump stream_gen and notify
+  std::mutex sender_mu;
+  std::condition_variable sender_cv;
+  uint64_t stream_gen = 0;
   // ring-write deadline (dcn_ring_timeout; tdcn_set_ring_timeout):
   // bounds reserve() so a dead/wedged consumer surfaces as a send
   // error instead of an unbounded producer spin
@@ -731,6 +931,17 @@ struct Engine {
   // marked failed / restored
   std::mutex dedup_mu;
   std::map<std::pair<int32_t, uint64_t>, DedupSeen> rx_seen;
+  // receiver-side ordered-delivery gates for the streaming engine
+  // (under eng->mu): completed ring-path items from one sender are
+  // released in their issue order even though round-robin chunking
+  // can complete them out of order.  Keyed by sending proc; pruned
+  // with the dedup watermarks when the proc's address changes (a new
+  // incarnation restarts its order counter at 1).
+  struct OrderGate {
+    uint64_t next = 1;
+    std::map<uint64_t, OwnedMsg> parked;
+  };
+  std::map<std::pair<int32_t, uint16_t>, OrderGate> order_gates;
   // inbound rendezvous flow control
   std::mutex rndv_mu;
   std::condition_variable rndv_cv;
@@ -828,11 +1039,11 @@ static bool env_match(const PostedReq &p, const OwnedMsg &m) {
 }
 
 // Wake inline-progress waiters (they futex-wait on OUR doorbell when
-// not consuming); completions from any transport ring it.
+// not consuming); completions from any transport ring it.  Coalesced:
+// the futex syscall is paid only when a waiter is actually parked.
 static void wake_waiters(Engine *eng) {
-  eng->stats.add(TS_DOORBELLS, 1);
-  eng->my_db.word->fetch_add(1, std::memory_order_release);
-  futex_wake(eng->my_db.word, 64);
+  eng->my_db.ring(&eng->stats,
+                  eng->db_coalesce.load(std::memory_order_relaxed) != 0);
 }
 
 // Deliver one complete inbound message.  Called with eng->mu HELD.
@@ -893,9 +1104,105 @@ static void deliver_locked(Engine *eng, OwnedMsg &&m) {
   eng->py_cv.notify_one();
 }
 
+// Release a completed ring-path item through the sender's issue-order
+// gate: deliver it (and any consecutively parked successors) when its
+// order is next, park it otherwise.  Round-robin chunking completes
+// short messages before earlier long ones; MPI's non-overtaking
+// matching needs them re-sequenced.
+static void deliver_ordered(Engine *eng, int from_proc, uint16_t okey,
+                            uint64_t order, OwnedMsg &&m) {
+  std::lock_guard<std::mutex> g(eng->mu);
+  Engine::OrderGate &gt = eng->order_gates[{from_proc, okey}];
+  if (order != gt.next) {
+    gt.parked.emplace(order, std::move(m));
+    return;
+  }
+  deliver_locked(eng, std::move(m));
+  gt.next++;
+  for (auto it = gt.parked.begin();
+       it != gt.parked.end() && it->first == gt.next;
+       it = gt.parked.erase(it)) {
+    deliver_locked(eng, std::move(it->second));
+    gt.next++;
+  }
+}
+
 // ---------------------------------------------------------------------
 // inbound frame processing (shared by socket loops and ring poller)
 // ---------------------------------------------------------------------
+
+// Try to reserve an in-place posted recv for an inbound ring-path P2P
+// message (eng->mu HELD): when a posted receive carrying a buffer
+// (tdcn_post_recv_into) with enough capacity matches — oldest first,
+// MPI post order — it is erased from the posted list, marked in_fill,
+// and its order-gate slot is consumed (the reservation IS the MPI
+// match; completion may lag later deliveries, which MPI permits).
+// Returns the rid and sets *buf_out, or 0 for the copy path.
+static uint64_t fill_reserve_locked(Engine *eng, const Env &e,
+                                    uint64_t total, uint64_t order,
+                                    uint16_t okey, int from_proc,
+                                    uint8_t **buf_out,
+                                    bool allow_unbuffered) {
+  *buf_out = nullptr;
+  if (eng->py_cids.find(e.cid) != eng->py_cids.end()) return 0;
+  Engine::OrderGate *gt = nullptr;
+  if (order) {
+    gt = &eng->order_gates[{from_proc, okey}];
+    if (order != gt->next || !gt->parked.empty()) return 0;
+  }
+  auto qit = eng->p2p.find(e.cid);
+  if (qit == eng->p2p.end() || qit->second.draining) return 0;
+  auto pit = qit->second.posted.find(e.dst);
+  if (pit == qit->second.posted.end()) return 0;
+  auto &plist = pit->second;
+  for (size_t i = 0; i < plist.size(); i++) {
+    if ((plist[i].src != -1 && plist[i].src != e.src) ||
+        (plist[i].tag != -1 && plist[i].tag != e.tag))
+      continue;
+    auto rit = eng->reqs.find(plist[i].id);
+    if (rit == eng->reqs.end()) return 0;
+    ReqState *st = rit->second;
+    bool placed = st->user_buf && st->user_cap >= total;
+    if (!placed && !allow_unbuffered)
+      return 0;  // eager caller: the normal delivery path is
+                 // equivalent (the frame is already complete)
+    uint64_t rid = plist[i].id;
+    // a buffer-less (or too-small — MPI truncation keeps the copy
+    // path) match still RESERVES: the order-gate slot is consumed at
+    // the MATCH, so a copy-path message in a stream chain cannot
+    // stall the in-place placements queued behind it
+    if (placed) {
+      *buf_out = (uint8_t *)st->user_buf;
+      st->in_fill = true;
+    }
+    st->reserved = true;  // cancel now refuses (MPI: the reservation
+                          // IS the match, and a matched receive is
+                          // not cancellable)
+    plist.erase(plist.begin() + i);
+    if (gt) gt->next++;
+    return rid;
+  }
+  return 0;
+}
+
+// Complete a reserved in-place request: the user buffer already holds
+// the payload, so delivery is a request completion, not a copy.
+static void fill_complete(Engine *eng, uint64_t rid, Env &&env,
+                          uint8_t *buf, uint64_t nbytes) {
+  std::lock_guard<std::mutex> g(eng->mu);
+  eng->stats.add(TS_DELIVERED, 1);
+  auto rit = eng->reqs.find(rid);
+  if (rit != eng->reqs.end()) {
+    ReqState *st = rit->second;
+    st->msg.env = std::move(env);
+    st->msg.data = buf;
+    st->msg.nbytes = nbytes;
+    st->msg.arrival = eng->arrival++;
+    st->completed = true;
+    st->cv.notify_all();
+  }
+  wake_waiters(eng);
+}
 
 static void finish_reassembly(Engine *eng, const WireHdr &h,
                               Reassembly *ra) {
@@ -904,6 +1211,9 @@ static void finish_reassembly(Engine *eng, const WireHdr &h,
   m.data = ra->buf;
   m.nbytes = ra->total;
   bool granted = ra->granted;
+  uint64_t order = ra->order;
+  uint16_t okey = ra->okey;
+  uint64_t fill_rid = ra->fill_rid;
   {
     std::lock_guard<std::mutex> g(eng->rndv_mu);
     eng->reasm.erase({h.from_proc, h.seq});
@@ -914,6 +1224,17 @@ static void finish_reassembly(Engine *eng, const WireHdr &h,
     }
   }
   delete ra;
+  if (fill_rid) {
+    // in-place rendezvous: matched at RTS time (the order slot was
+    // consumed there); the user buffer already holds the payload
+    fill_complete(eng, fill_rid, std::move(m.env), (uint8_t *)m.data,
+                  m.nbytes);
+    return;
+  }
+  if (order) {  // ring streaming: re-sequence to sender issue order
+    deliver_ordered(eng, h.from_proc, okey, order, std::move(m));
+    return;
+  }
   std::lock_guard<std::mutex> g(eng->mu);
   deliver_locked(eng, std::move(m));
 }
@@ -924,12 +1245,35 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
   parse_extra(h, extra, &e);
   switch (h.type) {
     case FT_EAGER: {
+      // ring records only reach here (the socket loop handles its
+      // eager frames inline).  A posted recv that carries a buffer
+      // takes the in-place path: one memcpy ring → user buffer, no
+      // intermediate allocation — the same placement the streaming
+      // RTS path gets, applied to single-record messages.
+      if (e.kind == FK_P2P && h.nbytes) {
+        uint8_t *ubuf = nullptr;
+        uint64_t rid = 0;
+        {
+          std::lock_guard<std::mutex> g(eng->mu);
+          rid = fill_reserve_locked(eng, e, h.nbytes, h.order, h.pad,
+                                    h.from_proc, &ubuf, false);
+        }
+        if (rid && ubuf) {
+          memcpy(ubuf, payload, h.nbytes);
+          fill_complete(eng, rid, std::move(e), ubuf, h.nbytes);
+          return;
+        }
+      }
       OwnedMsg m;
       m.env = std::move(e);
       m.nbytes = h.nbytes;
       if (h.nbytes) {
         m.data = malloc(h.nbytes);
         memcpy(m.data, payload, h.nbytes);
+      }
+      if (h.order) {  // queued behind a stream: keep issue order
+        deliver_ordered(eng, h.from_proc, h.pad, h.order, std::move(m));
+        return;
       }
       std::lock_guard<std::mutex> g(eng->mu);
       deliver_locked(eng, std::move(m));
@@ -968,11 +1312,34 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
       ra->env.seq = (int64_t)h.off;
       ra->total = h.total;
       if (rx_fd < 0) {
-        // ring path: no CTS, no slot — the sender blocks on ring
-        // backpressure and sends one transfer at a time per peer, so
-        // ingress memory is bounded by the message itself
-        ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
-        std::lock_guard<std::mutex> g(eng->rndv_mu);
+        // ring path: no CTS, no slot — the sender's streaming engine
+        // caps in-flight bytes (dcn_inflight_limit) and ring
+        // backpressure is the flow control; the issue-order tag rides
+        // the RTS so completion re-sequences through the gate
+        ra->order = h.order;
+        ra->okey = h.pad;
+        // In-place rendezvous placement (the reference pml's recv
+        // side): an IN-ORDER streaming RTS that finds a matching
+        // posted recv with capacity reserves it and lands its FRAGs
+        // straight in the user buffer — no reassembly malloc, no
+        // delivery copy, and a windowed burst stops dragging a second
+        // window-sized working set through the cache.  The match
+        // consumes the order-gate slot NOW (this IS the MPI match;
+        // completion may lag later deliveries, which MPI permits).
+        if (h.order && ra->env.kind == FK_P2P) {
+          uint8_t *ubuf = nullptr;
+          std::lock_guard<std::mutex> g(eng->mu);
+          ra->fill_rid = fill_reserve_locked(eng, ra->env, ra->total,
+                                             h.order, h.pad,
+                                             h.from_proc, &ubuf, true);
+          if (ubuf) {
+            ra->buf = ubuf;
+            ra->fill_user = true;
+          }
+        }
+        if (!ra->buf)
+          ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
+        std::lock_guard<std::mutex> g2(eng->rndv_mu);
         eng->reasm[{h.from_proc, h.seq}] = ra;
         return;
       }
@@ -1050,7 +1417,8 @@ static void abandon_reassemblies(
         eng->rndv_cv.notify_one();
       }
     }
-    free(ra->buf);
+    if (!ra->fill_user) free(ra->buf);  // in-place: the buffer is
+                                        // the user's, never engine-owned
     delete ra;
   }
 }
@@ -1204,6 +1572,7 @@ static void consume_ring(Engine *eng, ShmRing *r) {
     if (rec & PAD_BIT) {
       r->ctrl->tail.store(tail + (rec & ~PAD_BIT),
                           std::memory_order_release);
+      r->wake_producer();
       continue;
     }
     const uint8_t *p = r->data + pos + 8;
@@ -1214,6 +1583,10 @@ static void consume_ring(Engine *eng, ShmRing *r) {
     process_frame(eng, h, extra, payload, -1);
     r->ctrl->tail.store(tail + ((rec + 7) & ~7ull),
                         std::memory_order_release);
+    // space doorbell: a producer parked on ring backpressure (the
+    // streaming sender's yield path) wakes as soon as bytes free up —
+    // one relaxed load here while nobody waits
+    r->wake_producer();
   }
 }
 
@@ -1276,7 +1649,9 @@ static bool progress_wait(Engine *eng, std::unique_lock<std::mutex> &g,
       }
       if (!changed) {
         struct timespec ts = {0, 2000000};  // 2 ms: deadline cadence
+        eng->my_db.parked->fetch_add(1, std::memory_order_seq_cst);
         futex_wait(eng->my_db.word, seen, &ts);
+        eng->my_db.parked->fetch_sub(1, std::memory_order_relaxed);
       }
     }
     g.lock();
@@ -1304,8 +1679,24 @@ static void ring_poll_loop(Engine *eng) {
       continue;
     }
     seen = now;
-    struct timespec ts = {0, 50000000};  // 50 ms: close() sensitivity
-    futex_wait(eng->my_db.word, seen, &ts);
+    if (eng->waiters.load(std::memory_order_relaxed) == 0) {
+      // nobody else is listening: the poller is the one consumer a
+      // publish must wake, so it registers as parked (senders pay the
+      // futex_wake) and sleeps the long backstop quantum
+      struct timespec ts = {0, 50000000};  // 50 ms: close() sensitivity
+      eng->my_db.parked->fetch_add(1, std::memory_order_seq_cst);
+      futex_wait(eng->my_db.word, seen, &ts);
+      eng->my_db.parked->fetch_sub(1, std::memory_order_relaxed);
+    } else {
+      // an inline-progress waiter is driving: it parks itself when it
+      // runs dry, so the poller sleeps UNREGISTERED — under a windowed
+      // burst the consumer is busy, nobody is parked, and every
+      // per-record futex_wake the old path paid becomes a suppressed
+      // doorbell.  Short quantum: if the waiter exits mid-sleep the
+      // poller resumes backstop duty within ~4 ms.
+      struct timespec ts = {0, 4000000};
+      futex_wait(eng->my_db.word, seen, &ts);
+    }
     seen = eng->my_db.word->load(std::memory_order_acquire);
   }
 }
@@ -1539,6 +1930,31 @@ static void fault_recv_check(Engine *eng) {
   }
 }
 
+// Fill + publish one reserved ring record, account occupancy, and
+// ring the (coalesced) doorbell — the shared tail of both the
+// blocking and the streaming sender's record writes.
+static void ring_put_record(Engine *eng, Peer *p, uint8_t *w,
+                            uint64_t rec_start, uint64_t need,
+                            const WireHdr &h, const Env &e,
+                            const void *payload) {
+  *(uint64_t *)w = need;  // full record length (u64 prefix included)
+  uint8_t *q = w + 8;
+  memcpy(q, &h, sizeof(h));
+  q += sizeof(h);
+  write_extra(q, e);
+  q += env_extra(h);
+  if (h.nbytes) memcpy(q, payload, h.nbytes);
+  p->tx_ring.publish(rec_start, need);
+  // occupancy after publish: producer cursor minus the consumer's last
+  // published tail — the high-water tells the perf rounds how close
+  // the windowed burst came to the backpressure cliff
+  eng->stats.hwm(TS_RING_HWM,
+                 rec_start + ((need + 7) & ~7ull) -
+                     p->tx_ring.ctrl->tail.load(std::memory_order_relaxed));
+  p->peer_db.ring(&eng->stats,
+                  eng->db_coalesce.load(std::memory_order_relaxed) != 0);
+}
+
 static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
                              const Env &e, const void *payload,
                              uint64_t timeout_ns, bool faultable) {
@@ -1551,23 +1967,26 @@ static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
   uint8_t *w = p->tx_ring.reserve(need, &rec_start, &eng->closing,
                                   &eng->stats, timeout_ns);
   if (!w) return false;
-  *(uint64_t *)w = need;  // full record length (u64 prefix included)
-  uint8_t *q = w + 8;
-  memcpy(q, &h, sizeof(h));
-  q += sizeof(h);
-  write_extra(q, e);
-  q += env_extra(h);
-  if (h.nbytes) memcpy(q, payload, h.nbytes);
-  p->tx_ring.publish(rec_start, need);
-  // occupancy after publish: producer cursor minus the consumer's last
-  // published tail — the high-water tells the perf PR how close the
-  // windowed burst came to the backpressure cliff
-  eng->stats.hwm(TS_RING_HWM,
-                 rec_start + ((need + 7) & ~7ull) -
-                     p->tx_ring.ctrl->tail.load(std::memory_order_relaxed));
-  eng->stats.add(TS_DOORBELLS, 1);
-  p->peer_db.ring();
+  ring_put_record(eng, p, w, rec_start, need, h, e, payload);
   return true;
+}
+
+// Non-blocking record placement for the streaming sender: 1 =
+// published, 0 = ring backpressure (the caller's turn yields to other
+// peers' work instead of spinning in reserve), -1 = injected
+// failure / engine closing.  The fault plan is consulted only AFTER a
+// successful placement so backpressure retries never consume schedule
+// events (the per-record determinism faultsim documents).
+static int try_send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
+                                const Env &e, const void *payload) {
+  if (eng->closing.load(std::memory_order_relaxed)) return -1;
+  uint64_t need = 8 + sizeof(WireHdr) + env_extra(h) + h.nbytes;
+  uint64_t rec_start;
+  uint8_t *w = p->tx_ring.try_reserve(need, &rec_start);
+  if (!w) return 0;
+  if (!fault_ring_ok(eng)) return -1;  // record never published
+  ring_put_record(eng, p, w, rec_start, need, h, e, payload);
+  return 1;
 }
 
 static bool ensure_ring(Engine *eng, Peer *p) {
@@ -1593,7 +2012,408 @@ static bool ensure_ring(Engine *eng, Peer *p) {
     return false;
   }
   p->ring_announced = true;
+  p->ring_ready.store(true, std::memory_order_release);
   return true;
+}
+
+// ---------------------------------------------------------------------
+// streaming send engine (the pipelined large-message ring path)
+// ---------------------------------------------------------------------
+//
+// A larger-than-chunk (or queued-behind-one) payload enqueues a
+// StreamDesc instead of looping over FRAGs while holding p->send_mu
+// for the whole message; the per-engine sender thread (sender_loop)
+// services every peer's queue round-robin, one record per descriptor
+// per pass, so 64 windowed 4 MiB sends stream cooperatively instead of
+// head-of-line blocking each other.  A full ring ends the peer's turn
+// (TS_SENDER_YIELDS) and the loop parks on the consumer's space
+// doorbell instead of spinning against the consumer it waits for.
+// Blocking sends ride the same queue (borrowed buffer + completion
+// wait) whenever ordering requires it; the small-message direct path
+// is untouched while the queue is empty.
+
+static const uint64_t STREAM_CHUNK_MIN = 64ull << 10;
+
+// effective FRAG granularity for one peer: the adaptive override when
+// backpressure shrank it, else the dcn_chunk_bytes knob; always fits
+// the ring with record headroom.  Mutated only by the sender thread
+// under p->send_mu.
+static uint64_t stream_chunk(Engine *eng, Peer *p) {
+  uint64_t c = p->chunk_now
+                   ? p->chunk_now
+                   : eng->chunk_bytes.load(std::memory_order_relaxed);
+  uint64_t cap =
+      eng->ring_bytes / 2 > 4096 ? eng->ring_bytes / 2 - 4096 : 512;
+  if (c > cap) c = cap;
+  if (c < 4096) c = 4096;
+  return c;
+}
+
+// Mark every queued descriptor failed (ring deadline expired, injected
+// wedge, or engine close) and poison the peer's stream path — the
+// Python side escalates the peer ULFM-style on the next rc, exactly
+// like a failed direct send.  Caller holds NOTHING.
+static void stream_fail_peer(Engine *eng, Peer *p, int rc) {
+  // detached descriptors have no waiter — the engine owns their
+  // memory.  Partition UNDER the lock: once `done` is published a
+  // waiter (or tdcn_send_forget) may free the others concurrently.
+  std::vector<StreamDesc *> reclaim;
+  {
+    std::lock_guard<std::mutex> sg(p->stream_mu);
+    if (p->streams.empty()) return;
+    std::deque<StreamDesc *> dead;
+    dead.swap(p->streams);
+    p->stream_failed = true;
+    p->stream_rr = 0;
+    eng->stream_inflight_now.fetch_sub(p->stream_inflight,
+                                       std::memory_order_relaxed);
+    p->stream_inflight = 0;
+    eng->stream_depth_now.fetch_sub(dead.size(),
+                                    std::memory_order_relaxed);
+    eng->stats.gauge(TS_STREAM_DEPTH, eng->stream_depth_now.load(
+                                          std::memory_order_relaxed));
+    eng->stats.gauge(TS_STREAM_INFLIGHT,
+                     eng->stream_inflight_now.load(
+                         std::memory_order_relaxed));
+    for (StreamDesc *d : dead) {
+      d->rc = rc;
+      if (d->detached) {
+        reclaim.push_back(d);
+      } else {
+        d->done = true;
+      }
+    }
+    p->stream_cv.notify_all();
+  }
+  for (StreamDesc *d : reclaim) {
+    free(d->owned);
+    delete d;
+  }
+}
+
+// Service ONE record of descriptor `d` (p->send_mu HELD by the sender
+// thread's turn).  Returns 2 = published the descriptor's final
+// record, 1 = published a non-final record, 0 = ring backpressure,
+// -1 = injected failure / closing.
+static int stream_service_one(Engine *eng, Peer *p, StreamDesc *d) {
+  if (d->eager) {
+    // fits one record: emitted as ONE ordered eager record when its
+    // turn comes — it queued only to keep issue order behind a stream
+    WireHdr h;
+    fill_hdr(&h, FT_EAGER, d->env, eng->proc, 0, d->nbytes, d->nbytes);
+    h.order = d->order;
+    h.pad = (uint16_t)(p->nonce & 0xFFFF);
+    int rc = try_send_record_ring(eng, p, h, d->env, d->data);
+    if (rc <= 0) return rc;
+    d->sent = d->nbytes;
+    eng->stats.add(TS_EAGER_MSGS, 1);
+    eng->stats.add(TS_EAGER_BYTES, d->nbytes);
+    return 2;
+  }
+  if (!d->rts_sent) {
+    // RTS announces the transfer (no CTS — the in-flight cap plus ring
+    // backpressure are the flow control); the issue-order tag rides it
+    // so the receiver's gate re-sequences the completion.  h.seq
+    // carries the reassembly xid; the TRUE envelope seq rides in h.off
+    // (restored receiver-side), exactly like the old chunked path.
+    Env rts_env = d->env;
+    rts_env.seq = d->xid;
+    WireHdr h;
+    fill_hdr(&h, FT_RTS, rts_env, eng->proc, (uint64_t)d->env.seq,
+             d->nbytes, 0);
+    h.order = d->order;
+    h.pad = (uint16_t)(p->nonce & 0xFFFF);
+    int rc = try_send_record_ring(eng, p, h, rts_env, nullptr);
+    if (rc <= 0) return rc;
+    d->rts_sent = true;
+    return 1;
+  }
+  uint64_t chunk = stream_chunk(eng, p);
+  uint64_t left = d->nbytes - d->sent;
+  uint64_t n = left < chunk ? left : chunk;
+  Env fe;
+  fe.kind = d->env.kind;
+  fe.seq = d->xid;
+  WireHdr fh;
+  fill_hdr(&fh, FT_FRAG, fe, eng->proc, d->sent, d->nbytes, n);
+  int rc = try_send_record_ring(eng, p, fh, fe, d->data + d->sent);
+  if (rc <= 0) return rc;
+  d->sent += n;
+  return d->sent >= d->nbytes ? 2 : 1;
+}
+
+// One bounded service turn for a peer: round-robin across its queued
+// descriptors, up to `burst` records, never blocking.  Returns records
+// published; *blocked reports a turn ended on ring backpressure,
+// *had_work that descriptors were queued at all.  Caller holds
+// NOTHING.
+static int stream_turn(Engine *eng, Peer *p, bool *blocked,
+                       bool *had_work) {
+  {
+    std::lock_guard<std::mutex> sg(p->stream_mu);
+    if (p->streams.empty()) return 0;
+  }
+  *had_work = true;
+  std::unique_lock<std::mutex> g(p->send_mu, std::try_to_lock);
+  if (!g.owns_lock()) return 0;  // a direct sender is driving this
+                                 // peer; its release re-opens the turn
+  int published = 0;
+  const int burst = 8;
+  bool rotated = false;
+  while (published < burst) {
+    StreamDesc *d;
+    {
+      std::lock_guard<std::mutex> sg(p->stream_mu);
+      if (p->streams.empty()) break;
+      if (p->stream_rr >= p->streams.size()) p->stream_rr = 0;
+      d = p->streams[p->stream_rr];
+    }
+    // ring-aware flow control: never run the producer more than
+    // dcn_inflight_limit bytes ahead of the consumer.  The consumer is
+    // the bottleneck under a windowed burst — running further ahead
+    // only drags the whole ring through the cache cold; a bounded
+    // occupancy window keeps the transfer working set hot and the
+    // stream servicing at the unwindowed rate.
+    uint64_t occ_cap =
+        eng->inflight_limit.load(std::memory_order_relaxed);
+    if (occ_cap && p->tx_ring.ctrl) {
+      uint64_t occ =
+          p->tx_ring.ctrl->head.load(std::memory_order_relaxed) -
+          p->tx_ring.ctrl->tail.load(std::memory_order_acquire);
+      if (occ >= occ_cap) {
+        *blocked = true;
+        break;
+      }
+    }
+    uint64_t before = d->sent;
+    int rc = stream_service_one(eng, p, d);
+    if (rc == 0) {
+      *blocked = true;
+      // adaptive chunk sizing: sustained backpressure shrinks the
+      // FRAG granularity (once per blocked turn, floor 64 KiB) so
+      // freed ring space becomes usable sooner and the consumer
+      // interleaves at a finer quantum
+      uint64_t cur = stream_chunk(eng, p);
+      if (cur > STREAM_CHUNK_MIN) {
+        p->chunk_now =
+            cur / 2 > STREAM_CHUNK_MIN ? cur / 2 : STREAM_CHUNK_MIN;
+        p->chunk_ok = 0;
+        eng->stats.add(TS_CHUNK_SHRINKS, 1);
+      }
+      break;
+    }
+    if (rc < 0) {
+      g.unlock();
+      stream_fail_peer(eng, p, -1);
+      return published;
+    }
+    published++;
+    p->last_progress_ns.store(now_ns(), std::memory_order_relaxed);
+    // stall-free progress grows the chunk back toward the knob
+    if (p->chunk_now && ++p->chunk_ok >= 64) {
+      uint64_t knob = eng->chunk_bytes.load(std::memory_order_relaxed);
+      p->chunk_now *= 2;
+      if (p->chunk_now >= knob) p->chunk_now = 0;  // knob restored
+      p->chunk_ok = 0;
+    }
+    uint64_t sent_now = d->sent - before;
+    bool complete = rc == 2;
+    bool det = false, eager = false;
+    uint64_t bytes = 0;
+    uint8_t *owned = nullptr;
+    {
+      std::lock_guard<std::mutex> sg(p->stream_mu);
+      // capture under the lock: tdcn_send_forget may flip `detached`
+      // concurrently, and once `done` is published a waiter may free d
+      det = d->detached;
+      eager = d->eager;
+      bytes = d->nbytes;
+      owned = d->owned;
+      if (sent_now) {
+        p->stream_inflight -=
+            sent_now <= p->stream_inflight ? sent_now : p->stream_inflight;
+        eng->stream_inflight_now.fetch_sub(sent_now,
+                                           std::memory_order_relaxed);
+        eng->stats.gauge(TS_STREAM_INFLIGHT,
+                         eng->stream_inflight_now.load(
+                             std::memory_order_relaxed));
+      }
+      if (complete) {
+        // only this thread removes; enqueuers only push_back, so the
+        // cursor still names d
+        p->streams.erase(p->streams.begin() + (long)p->stream_rr);
+        if (p->stream_rr >= p->streams.size()) p->stream_rr = 0;
+        rotated = true;
+        eng->stream_depth_now.fetch_sub(1, std::memory_order_relaxed);
+        eng->stats.gauge(TS_STREAM_DEPTH, eng->stream_depth_now.load(
+                                              std::memory_order_relaxed));
+        d->rc = 0;
+        d->done = true;  // a blocking waiter may delete d from here on
+      }
+      if (complete || p->cap_waiters) p->stream_cv.notify_all();
+    }
+    if (complete) {
+      if (!eager) {
+        eng->stats.add(TS_CHUNKED_MSGS, 1);
+        eng->stats.add(TS_CHUNKED_BYTES, bytes);
+      }
+      if (det) {
+        free(owned);
+        delete d;
+      }
+    }
+  }
+  // round-robin at TURN granularity, not per record: a descriptor
+  // keeps the cursor for one whole burst so the receiver reassembles
+  // MB-scale sequential runs (per-record interleave thrashed its TLB
+  // across the whole window's buffers), and every other in-flight
+  // message still gets a turn every burst
+  if (published && !rotated) {
+    std::lock_guard<std::mutex> sg(p->stream_mu);
+    if (p->streams.size() > 1)
+      p->stream_rr = (p->stream_rr + 1) % p->streams.size();
+  }
+  return published;
+}
+
+// The per-engine sender progress thread: round-robin over every
+// peer's stream queue; a full ring yields the peer's turn, and a
+// whole pass with queued work but zero progress parks on the blocked
+// consumer's space doorbell (accounted as ring stall) — never a
+// sched_yield spin against the consumer it waits for.
+static void sender_loop(Engine *eng) {
+  uint64_t last_gen = 0;
+  bool was_blocked = false;
+  for (;;) {
+    if (eng->closing.load(std::memory_order_relaxed)) break;
+    std::vector<Peer *> ps;
+    {
+      std::lock_guard<std::mutex> g(eng->peers_mu);
+      ps.reserve(eng->peers.size());
+      for (auto &kv : eng->peers) ps.push_back(kv.second);
+    }
+    bool any_work = false;
+    int progressed = 0;
+    Peer *bp = nullptr;
+    for (Peer *p : ps) {
+      bool blocked = false, had_work = false;
+      progressed += stream_turn(eng, p, &blocked, &had_work);
+      any_work |= had_work;
+      if (blocked) {
+        bp = p;
+        eng->stats.add(TS_SENDER_YIELDS, 1);
+        // ring-timeout watchdog: a consumer that stopped draining
+        // must surface as a send failure, not a wedged engine
+        uint64_t tmo =
+            eng->ring_timeout_ns.load(std::memory_order_relaxed);
+        uint64_t prog =
+            p->last_progress_ns.load(std::memory_order_relaxed);
+        if (tmo && prog && now_ns() - prog > tmo) {
+          eng->stats.add(TS_DEADLINE_EXPIRED, 1);
+          stream_fail_peer(eng, p, -1);
+        }
+      }
+    }
+    if (progressed) {
+      was_blocked = false;
+      continue;
+    }
+    if (!any_work) {
+      was_blocked = false;
+      std::unique_lock<std::mutex> lk(eng->sender_mu);
+      cv_wait_for(eng->sender_cv, lk, 0.05, [&] {
+        return eng->stream_gen != last_gen ||
+               eng->closing.load(std::memory_order_relaxed);
+      });
+      last_gen = eng->stream_gen;
+      continue;
+    }
+    // queued work, zero progress: every ring is full (or a direct
+    // sender owns send_mu).  Park bounded on the blocked consumer's
+    // space doorbell and account the dead time as ring stall so the
+    // stall-cause decomposition stays truthful.
+    if (!was_blocked) {
+      eng->stats.add(TS_RING_STALLS, 1);
+      was_blocked = true;
+    }
+    uint64_t t0 = now_ns();
+    if (bp && bp->tx_ring.ctrl) {
+      bp->tx_ring.space_wait(
+          bp->tx_ring.ctrl->tail.load(std::memory_order_acquire),
+          2000000ull);
+    } else {
+      struct timespec ts = {0, 200000};  // 200 us: send_mu handoff
+      nanosleep(&ts, nullptr);
+    }
+    uint64_t dns = now_ns() - t0;
+    eng->stats.add(TS_RING_STALL_NS, dns);
+    eng->stats.add(TS_STALL_NS, dns);
+  }
+  // drain at close: every remaining descriptor fails with the closed
+  // rc so blocking waiters wake and detached buffers are reclaimed
+  std::vector<Peer *> ps;
+  {
+    std::lock_guard<std::mutex> g(eng->peers_mu);
+    ps.reserve(eng->peers.size());
+    for (auto &kv : eng->peers) ps.push_back(kv.second);
+  }
+  for (Peer *p : ps) stream_fail_peer(eng, p, -3);
+}
+
+// Enqueue one descriptor on a peer's stream queue.  p->send_mu AND
+// p->stream_mu HELD (the caller's routing decision and the push must
+// be one atomic step against the sender thread's queue-empty
+// transitions).  Returns the descriptor, or nullptr when the engine
+// is closing / the peer's stream path is poisoned.
+static StreamDesc *stream_enqueue_locked(Engine *eng, Peer *p, Env &e,
+                                         const uint8_t *data,
+                                         uint8_t *owned, uint64_t nbytes,
+                                         bool eager, bool detached) {
+  if (p->stream_failed || eng->closing.load(std::memory_order_relaxed))
+    return nullptr;
+  StreamDesc *d = new StreamDesc();
+  d->env = e;
+  d->owner = p;
+  d->data = data;
+  d->owned = owned;
+  d->nbytes = nbytes;
+  d->detached = detached;
+  d->eager = eager;
+  d->order = p->next_order++;
+  if (!eager) {
+    // collision-free reassembly xid (was now_ns() ^ proc<<56, which
+    // could collide for two same-nanosecond large sends to one peer
+    // and cross-corrupt reassembly); the high byte still carries the
+    // proc for log readability
+    d->xid = (int64_t)(eng->next_xid.fetch_add(
+                           1, std::memory_order_relaxed) |
+                       ((uint64_t)(uint32_t)eng->proc << 56));
+  }
+  if (p->streams.empty())
+    p->last_progress_ns.store(now_ns(), std::memory_order_relaxed);
+  p->streams.push_back(d);
+  p->stream_inflight += nbytes;
+  eng->stats.add(TS_STREAM_MSGS, 1);
+  eng->stats.add(TS_STREAM_BYTES, nbytes);
+  uint64_t depth =
+      eng->stream_depth_now.fetch_add(1, std::memory_order_relaxed) + 1;
+  eng->stats.gauge(TS_STREAM_DEPTH, depth);
+  eng->stats.hwm(TS_STREAM_DEPTH_HWM, depth);
+  uint64_t infl = eng->stream_inflight_now.fetch_add(
+                      nbytes, std::memory_order_relaxed) +
+                  nbytes;
+  eng->stats.gauge(TS_STREAM_INFLIGHT, infl);
+  eng->stats.hwm(TS_STREAM_INFLIGHT_HWM, infl);
+  return d;
+}
+
+// wake the sender thread after an enqueue (no locks held)
+static void stream_kick(Engine *eng) {
+  {
+    std::lock_guard<std::mutex> lk(eng->sender_mu);
+    eng->stream_gen++;
+  }
+  eng->sender_cv.notify_one();
 }
 
 // core send: route ring vs tcp, eager vs rndv (tcp) / chunked (ring)
@@ -1641,51 +2461,48 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
     uint64_t ring_tmo =
         ctrl ? 2000000ull
              : eng->ring_timeout_ns.load(std::memory_order_relaxed);
-    // ring path: frames up to half the ring go as one record; larger
-    // payloads stream as FRAG records (ring backpressure = flow ctl)
+    // routing: frames up to half the ring CAN go as one record, but a
+    // record published from this thread while streams are queued
+    // would overtake them (MPI non-overtaking), so the direct path is
+    // taken only while the peer's stream queue is empty.  Control
+    // frames are always direct: PY control traffic has no ordering
+    // contract and must never queue behind a data stream.  Everything
+    // else — larger-than-ring payloads, and any send that found
+    // streams in flight — enqueues a descriptor and waits for the
+    // sender thread's completion signal (borrowed buffer: the wait
+    // keeps it alive).
     uint64_t limit = eng->ring_bytes / 2;
-    if (nbytes + sizeof(WireHdr) + 256 <= limit) {
-      WireHdr h;
-      fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
-      if (send_record_ring(eng, p, h, e, data, ring_tmo, !ctrl)) {
-        eng->stats.add(TS_EAGER_MSGS, 1);
-        eng->stats.add(TS_EAGER_BYTES, nbytes);
-        return 0;
+    bool fits = nbytes + sizeof(WireHdr) + 256 <= limit;
+    bool small =
+        fits && nbytes <= eng->chunk_bytes.load(std::memory_order_relaxed);
+    StreamDesc *d = nullptr;
+    if (!ctrl) {
+      std::lock_guard<std::mutex> sg(p->stream_mu);
+      if (p->stream_failed) return -1;  // poisoned lineage: escalate
+      if (!(fits && p->streams.empty())) {
+        d = stream_enqueue_locked(eng, p, e, (const uint8_t *)data,
+                                  nullptr, nbytes, small, false);
+        if (!d) return -1;
       }
-      return -1;
     }
-    // chunked streaming: an RTS record (no CTS — ring backpressure is
-    // the flow control) announcing the transfer, then FRAG records.
-    // h.seq carries the reassembly xid; the TRUE envelope seq rides in
-    // h.off of the RTS (restored receiver-side).
-    // chunk must FIT the ring (reserve can never satisfy want > size):
-    // cap at half the ring minus record overhead so two chunks can be
-    // in flight and a PAD record always has room
-    uint64_t chunk = 4ull << 20;
-    uint64_t cap = eng->ring_bytes / 2 > 4096 ? eng->ring_bytes / 2 - 4096
-                                              : 512;
-    if (chunk > cap) chunk = cap;
-    int64_t xid = (int64_t)(now_ns() ^ ((uint64_t)eng->proc << 56));
-    Env rts_env = e;
-    rts_env.seq = xid;
-    WireHdr h2;
-    fill_hdr(&h2, FT_RTS, rts_env, eng->proc, (uint64_t)e.seq, nbytes, 0);
-    if (!send_record_ring(eng, p, h2, rts_env, nullptr, ring_tmo, true))
-      return -1;
-    for (uint64_t off = 0; off < nbytes; off += chunk) {
-      uint64_t n = nbytes - off < chunk ? nbytes - off : chunk;
-      Env fe;
-      fe.kind = e.kind;
-      fe.seq = xid;
-      WireHdr fh;
-      fill_hdr(&fh, FT_FRAG, fe, eng->proc, off, nbytes, n);
-      if (!send_record_ring(eng, p, fh, fe, (const uint8_t *)data + off,
-                            ring_tmo, true))
-        return -1;
+    if (d) {
+      g.unlock();  // the sender thread needs send_mu to make progress
+      stream_kick(eng);
+      std::unique_lock<std::mutex> sl(p->stream_mu);
+      p->stream_cv.wait(sl, [&] { return d->done; });
+      int rc = d->rc;
+      sl.unlock();
+      delete d;
+      return rc;
     }
-    eng->stats.add(TS_CHUNKED_MSGS, 1);
-    eng->stats.add(TS_CHUNKED_BYTES, nbytes);
-    return 0;
+    WireHdr h;
+    fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
+    if (send_record_ring(eng, p, h, e, data, ring_tmo, !ctrl)) {
+      eng->stats.add(TS_EAGER_MSGS, 1);
+      eng->stats.add(TS_EAGER_BYTES, nbytes);
+      return 0;
+    }
+    return -1;
   }
 
   // tcp path — one redial+resend round (the epoch-tagged self-healing
@@ -1730,6 +2547,165 @@ static int engine_send_peer(Engine *eng, Peer *p, Env &e, const void *data,
     if (attempt == 0) eng->stats.add(TS_RETRY_SENDS, 1);
   }
   return -1;
+}
+
+// Nonblocking send — the MPI_Isend fast path: enqueue on the
+// streaming engine and return immediately.  Two modes:
+//   copy != 0 — buffered: the engine owns a COPY and the send is
+//     locally complete at enqueue (the Python chan_isend convenience
+//     path, where the caller cannot pin the buffer);
+//   copy == 0 — zero-copy: the caller's buffer is BORROWED until the
+//     returned descriptor handle is collected through tdcn_send_wait/
+//     tdcn_send_test (the MPI semantics: the buffer is off-limits
+//     until MPI_Wait) — no third memcpy on the bandwidth path.
+// Returns <0 on error, 0 when locally complete (direct record or
+// buffered enqueue), or a positive descriptor handle (borrow mode).
+// Falls back to the blocking path off-ring (tcp peers), where the
+// windowed ring collapse this engine exists for cannot occur.
+static int64_t engine_isend_peer(Engine *eng, Peer *p, Env &e,
+                                 const void *data, uint64_t nbytes,
+                                 int copy) {
+  if (!p) return -1;
+  if (!(p->fd >= 0 && p->same_host))
+    return engine_send_peer(eng, p, e, data, nbytes);
+  // backpressure-graceful admission (buffered mode only — a borrowed
+  // buffer consumes no engine memory, and the caller's own Waitall is
+  // its backpressure): over dcn_inflight_limit the enqueue BLOCKS
+  // (bounded by dcn_ring_timeout) until the sender drains below the
+  // cap — bounded buffering that degrades to the ring's service rate
+  // instead of unbounded copy growth under a windowed burst
+  uint64_t lim = eng->inflight_limit.load(std::memory_order_relaxed);
+  if (copy && lim) {
+    std::unique_lock<std::mutex> sl(p->stream_mu);
+    if (p->stream_inflight + nbytes > lim && !p->streams.empty()) {
+      eng->stats.add(TS_ENQUEUE_WAITS, 1);
+      uint64_t tmo = eng->ring_timeout_ns.load(std::memory_order_relaxed);
+      double secs = tmo ? (double)tmo / 1e9 : 3600.0;
+      p->cap_waiters++;
+      bool ok = cv_wait_for(p->stream_cv, sl, secs, [&] {
+        return p->stream_inflight + nbytes <= lim ||
+               p->streams.empty() || p->stream_failed ||
+               eng->closing.load(std::memory_order_relaxed);
+      });
+      p->cap_waiters--;
+      if (!ok) {
+        eng->stats.add(TS_DEADLINE_EXPIRED, 1);
+        return -1;
+      }
+      if (p->stream_failed ||
+          eng->closing.load(std::memory_order_relaxed))
+        return -1;
+    }
+  }
+  // ring bring-up still needs send_mu (create + socket announce); once
+  // the ring_ready hint is set, the detached path below never touches
+  // the lock the sender thread's turns contend
+  if (!p->ring_ready.load(std::memory_order_acquire)) {
+    std::unique_lock<std::mutex> g(p->send_mu);
+    if (!ensure_ring(eng, p)) {
+      g.unlock();
+      return engine_send_peer(eng, p, e, data, nbytes);
+    }
+  }
+  eng->bytes_sent.fetch_add(nbytes, std::memory_order_relaxed);
+  uint64_t limit = eng->ring_bytes / 2;
+  bool fits = nbytes + sizeof(WireHdr) + 256 <= limit;
+  bool small =
+      fits && nbytes <= eng->chunk_bytes.load(std::memory_order_relaxed);
+  if (small) {
+    // small isend: direct record while the queue is empty (no copy,
+    // no handoff — the latency path stays what it was).  The queue
+    // state is PEEKED first so the buffered copy of a queued-behind
+    // send happens before any lock (a memcpy under send_mu — the lock
+    // the sender thread's turns contend — would stall the streaming
+    // engine); the direct route re-checks under send_mu + stream_mu,
+    // so the ordering decision stays atomic.
+    uint8_t *owned = nullptr;
+    bool peek_pending;
+    {
+      std::lock_guard<std::mutex> sg(p->stream_mu);
+      if (p->stream_failed ||
+          eng->closing.load(std::memory_order_relaxed))
+        return -1;
+      peek_pending = !p->streams.empty();
+    }
+    if (peek_pending && copy) {
+      owned = (uint8_t *)malloc(nbytes ? nbytes : 1);
+      if (!owned) return -1;
+      memcpy(owned, data, nbytes);
+    }
+    std::unique_lock<std::mutex> g(p->send_mu);
+    StreamDesc *d = nullptr;
+    {
+      std::lock_guard<std::mutex> sg(p->stream_mu);
+      if (p->stream_failed ||
+          eng->closing.load(std::memory_order_relaxed)) {
+        free(owned);
+        return -1;
+      }
+      if (!p->streams.empty()) {
+        const uint8_t *src = (const uint8_t *)data;
+        if (copy && !owned) {  // raced empty->pending: rare, copy here
+          owned = (uint8_t *)malloc(nbytes ? nbytes : 1);
+          if (!owned) return -1;
+          memcpy(owned, data, nbytes);
+        }
+        if (copy) src = owned;
+        d = stream_enqueue_locked(eng, p, e, src, owned, nbytes, true,
+                                  copy != 0);
+        if (!d) {
+          free(owned);
+          return -1;
+        }
+      }
+    }
+    if (!d) {
+      free(owned);  // drained while we copied: direct record instead
+      WireHdr h;
+      fill_hdr(&h, FT_EAGER, e, eng->proc, 0, nbytes, nbytes);
+      if (send_record_ring(eng, p, h, e, data,
+                           eng->ring_timeout_ns.load(
+                               std::memory_order_relaxed),
+                           true)) {
+        eng->stats.add(TS_EAGER_MSGS, 1);
+        eng->stats.add(TS_EAGER_BYTES, nbytes);
+        return 0;
+      }
+      return -1;
+    }
+    g.unlock();
+    stream_kick(eng);
+    return copy ? 0 : (int64_t)(uintptr_t)d;
+  }
+  // large isend: in buffered mode, copy OUTSIDE every lock (a
+  // multi-MiB memcpy under send_mu would stall the sender thread's
+  // turns); zero-copy mode borrows the caller's buffer outright.
+  // Either way the enqueue takes stream_mu alone — the descriptor
+  // queue is the ordering point, so the caller never contends the
+  // record-write lock the sender thread holds during its turns.
+  uint8_t *owned = nullptr;
+  const uint8_t *src = (const uint8_t *)data;
+  if (copy) {
+    owned = (uint8_t *)malloc(nbytes ? nbytes : 1);
+    if (!owned) return -1;
+    memcpy(owned, data, nbytes);
+    src = owned;
+  }
+  StreamDesc *d;
+  {
+    std::lock_guard<std::mutex> sg(p->stream_mu);
+    d = (p->stream_failed ||
+         eng->closing.load(std::memory_order_relaxed))
+            ? nullptr
+            : stream_enqueue_locked(eng, p, e, src, owned, nbytes, false,
+                                    copy != 0);
+    if (!d) {
+      free(owned);
+      return -1;
+    }
+  }
+  stream_kick(eng);
+  return copy ? 0 : (int64_t)(uintptr_t)d;
 }
 
 // one attempt at moving a message over the peer's tcp/uds socket;
@@ -1883,6 +2859,7 @@ void *tdcn_create(int proc, int nprocs, const char *host_id,
   eng->threads.emplace_back(accept_loop, eng, eng->tcp_listen_fd);
   eng->threads.emplace_back(accept_loop, eng, eng->uds_listen_fd);
   eng->threads.emplace_back(ring_poll_loop, eng);
+  eng->threads.emplace_back(sender_loop, eng);
   return eng;
 }
 
@@ -1911,8 +2888,35 @@ int tdcn_set_addresses(void *h, const char *joined) {
   // pruned without ever regressing a live lineage's watermark
   for (size_t p = 0; p < old.size() && p < eng->peer_addresses.size();
        p++) {
-    if (!old[p].empty() && old[p] != eng->peer_addresses[p])
+    if (!old[p].empty() && old[p] != eng->peer_addresses[p]) {
       prune_dedup(eng, (int)p);
+      // NOTE: the corpse lineage's in-flight reassemblies are
+      // deliberately NOT reclaimed here — a consumer thread may be
+      // mid-memcpy into one with no lock held (the FRAG hot path),
+      // so freeing from this control-plane thread would race it.
+      // They are bounded garbage reclaimed at destroy; a recv that
+      // was reserved-at-RTS by the dead stream stays matched (MPI:
+      // cancel of a MATCHED receive fails, and elastic recovery
+      // resumes on the fresh `.replaced` comm, not on the corpse's
+      // half-streamed transfers — the same wedge semantics a
+      // mid-stream sender death always had on the ring path).
+      //
+      // The reborn incarnation's issue-order counter restarts at 1:
+      // drop the corpse lineage's ordered-delivery gates (any parked
+      // payloads are fully-delivered messages the gate owns — freed
+      // under eng->mu, the same lock every gate access holds)
+      std::lock_guard<std::mutex> g(eng->mu);
+      for (auto it = eng->order_gates.begin();
+           it != eng->order_gates.end();) {
+        if (it->first.first == (int32_t)p) {
+          for (auto &pm : it->second.parked)
+            if (pm.second.data) free(pm.second.data);
+          it = eng->order_gates.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
   }
   return 0;
 }
@@ -2054,8 +3058,14 @@ int tdcn_recv_coll(void *h, const char *cid, int64_t seq, int src,
   return 0;
 }
 
-uint64_t tdcn_post_recv(void *h, const char *cid, int dst, int src,
-                        int tag) {
+// Post a receive that CARRIES its destination buffer: an in-order
+// streaming RTS that matches it streams FRAGs straight into `buf`
+// (in-place rendezvous placement — delivery then has data == buf and
+// the consumer skips its copy).  buf = NULL degrades to the plain
+// copy path; `cap` guards truncation (a too-small buffer falls back
+// to a reassembly allocation so MPI truncation semantics survive).
+uint64_t tdcn_post_recv_into(void *h, const char *cid, int dst, int src,
+                             int tag, void *buf, uint64_t cap) {
   Engine *eng = (Engine *)h;
   std::lock_guard<std::mutex> g(eng->mu);
   CidQueues &q = eng->p2p[cid ? cid : ""];
@@ -2075,9 +3085,16 @@ uint64_t tdcn_post_recv(void *h, const char *cid, int dst, int src,
   }
   uint64_t rid = eng->next_req++;
   ReqState *st = new ReqState();
+  st->user_buf = buf;
+  st->user_cap = cap;
   eng->reqs[rid] = st;
   q.posted[dst].push_back(PostedReq{rid, src, tag, eng->arrival++});
   return rid;
+}
+
+uint64_t tdcn_post_recv(void *h, const char *cid, int dst, int src,
+                        int tag) {
+  return tdcn_post_recv_into(h, cid, dst, src, tag, nullptr, 0);
 }
 
 int tdcn_req_wait(void *h, uint64_t rid, double timeout_s, TdcnMsg *out) {
@@ -2138,6 +3155,8 @@ int tdcn_req_cancel(void *h, uint64_t rid) {
   auto it = eng->reqs.find(rid);
   if (it == eng->reqs.end()) return -1;
   if (it->second->completed) return 1;  // too late
+  if (it->second->in_fill || it->second->reserved)
+    return 1;  // matched at RTS: the transfer is already in flight
   // remove from every posted list it may sit in
   for (auto qit = eng->p2p.begin(); qit != eng->p2p.end();) {
     for (auto &pl : qit->second.posted) {
@@ -2363,6 +3382,98 @@ int tdcn_chan_send(void *h, uint64_t chan, int kind, int src, int dst,
   return engine_send_peer(c->eng, c->peer, e, data, nbytes);
 }
 
+// Nonblocking 1-D isend — the MPI_Isend fast path: a larger-than-chunk
+// payload enqueues a send descriptor on the streaming engine and
+// returns immediately, so 64 windowed 4 MiB isends pipeline through
+// the ring instead of serializing the caller behind 64 blocking
+// backpressured transfers.  copy != 0: buffered (engine-owned copy,
+// locally complete, returns 0).  copy == 0: zero-copy — the buffer is
+// BORROWED and the returned positive handle must be collected via
+// tdcn_send_wait / tdcn_send_test before the buffer is reused (the
+// MPI_Wait contract).  Returns <0 on error.
+int64_t tdcn_chan_isend1(void *h, uint64_t chan, int kind, int src,
+                         int dst, int tag, const char *dtype,
+                         int64_t nelems, const void *data,
+                         uint64_t nbytes, int copy) {
+  (void)h;
+  Chan *c = (Chan *)(uintptr_t)chan;
+  Env e;
+  e.kind = (uint8_t)kind;
+  e.cid = c->cid;
+  e.seq = 0;
+  e.src = src;
+  e.dst = dst;
+  e.tag = tag;
+  e.dtype = dtype ? dtype : "";
+  e.ndim = 1;
+  e.shape[0] = nelems;
+  return engine_isend_peer(c->eng, c->peer, e, data, nbytes, copy);
+}
+
+// Collect a zero-copy send descriptor (blocking, `timeout_s` bounded).
+// Returns 0 = sent (descriptor freed), 1 = still in flight (call
+// again), <0 = failed (descriptor freed; -1 peer failure, -3 engine
+// closed).  After any terminal return the handle is dead and the
+// borrowed buffer is the caller's again.
+int tdcn_send_wait(void *h, int64_t sreq, double timeout_s) {
+  (void)h;
+  StreamDesc *d = (StreamDesc *)(uintptr_t)sreq;
+  if (!d || !d->owner) return -2;
+  Peer *p = d->owner;
+  {
+    std::unique_lock<std::mutex> sl(p->stream_mu);
+    if (!cv_wait_for(p->stream_cv, sl, timeout_s,
+                     [&] { return d->done; }))
+      return 1;
+  }
+  int rc = d->rc;
+  delete d;
+  return rc;
+}
+
+// Nonblocking collect: 0 = sent (freed), 1 = in flight, <0 = failed
+// (freed).
+int tdcn_send_test(void *h, int64_t sreq) {
+  (void)h;
+  StreamDesc *d = (StreamDesc *)(uintptr_t)sreq;
+  if (!d || !d->owner) return -2;
+  {
+    std::lock_guard<std::mutex> sl(d->owner->stream_mu);
+    if (!d->done) return 1;
+  }
+  int rc = d->rc;
+  delete d;
+  return rc;
+}
+
+// Non-destructive completion probe (MPI_Request_get_status): 1 = done
+// (the handle stays live — collect it with wait/test), 0 = in flight.
+int tdcn_send_done(void *h, int64_t sreq) {
+  (void)h;
+  StreamDesc *d = (StreamDesc *)(uintptr_t)sreq;
+  if (!d || !d->owner) return 0;
+  std::lock_guard<std::mutex> sl(d->owner->stream_mu);
+  return d->done ? 1 : 0;
+}
+
+// Abandon a zero-copy handle (MPI_Request_free on an active send):
+// the engine completes the transfer in the background and deletes the
+// descriptor itself — per MPI, the caller must not touch the buffer
+// until it knows the send finished by other means.
+void tdcn_send_forget(void *h, int64_t sreq) {
+  (void)h;
+  StreamDesc *d = (StreamDesc *)(uintptr_t)sreq;
+  if (!d || !d->owner) return;
+  Peer *p = d->owner;
+  bool dead;
+  {
+    std::lock_guard<std::mutex> sl(p->stream_mu);
+    dead = d->done;
+    if (!dead) d->detached = true;  // sender thread reclaims it
+  }
+  if (dead) delete d;
+}
+
 int tdcn_chan_send1(void *h, uint64_t chan, int kind, int src, int dst,
                     int tag, const char *dtype, int64_t nelems,
                     const void *data, uint64_t nbytes) {
@@ -2570,11 +3681,55 @@ void tdcn_set_connect_timeout(void *h, double seconds) {
       std::memory_order_relaxed);
 }
 
+// Streaming-engine knobs (the dcn_chunk_bytes / dcn_inflight_limit /
+// dcn_doorbell_coalesce MCA vars — the Python control plane forwards
+// them after engine creation).  chunk_bytes = 0 keeps the built-in
+// default; inflight_limit = 0 removes the per-peer cap on queued
+// stream bytes; doorbell_coalesce = 0 restores the unconditional
+// per-record futex wake (the escape hatch).
+void tdcn_set_stream(void *h, uint64_t chunk_bytes,
+                     uint64_t inflight_limit, int doorbell_coalesce) {
+  Engine *eng = (Engine *)h;
+  if (chunk_bytes)
+    eng->chunk_bytes.store(chunk_bytes, std::memory_order_relaxed);
+  eng->inflight_limit.store(inflight_limit, std::memory_order_relaxed);
+  eng->db_coalesce.store(doorbell_coalesce ? 1 : 0,
+                         std::memory_order_relaxed);
+}
+
 void tdcn_free(void *p) { free(p); }
 
 void tdcn_close(void *h) {
   Engine *eng = (Engine *)h;
+  // graceful stream drain (bounded): buffered isends accepted before
+  // close must reach the wire — MPI_Finalize rides this path.  A
+  // wedged consumer cannot extend the bound much: the sender watchdog
+  // fails its descriptors on the ring deadline, emptying the queues.
+  if (!eng->closing.load(std::memory_order_relaxed)) {
+    for (int i = 0; i < 2000; i++) {  // <= ~2 s grace
+      bool empty = true;
+      {
+        std::lock_guard<std::mutex> g(eng->peers_mu);
+        for (auto &kv : eng->peers) {
+          std::lock_guard<std::mutex> sg(kv.second->stream_mu);
+          if (!kv.second->streams.empty()) {
+            empty = false;
+            break;
+          }
+        }
+      }
+      if (empty) break;
+      struct timespec ts = {0, 1000000};
+      nanosleep(&ts, nullptr);
+    }
+  }
   eng->closing.store(true, std::memory_order_relaxed);
+  {
+    // wake the sender thread so it runs its close-drain and exits
+    std::lock_guard<std::mutex> lk(eng->sender_mu);
+    eng->stream_gen++;
+  }
+  eng->sender_cv.notify_all();
   {
     std::lock_guard<std::mutex> g(eng->mu);
     for (auto &kv : eng->coll) kv.second->cv.notify_all();
@@ -2683,7 +3838,9 @@ void tdcn_destroy(void *h) {
     }
     eng->coll.clear();
     for (auto &kv : eng->reqs) {
-      if (kv.second->msg.data) free(kv.second->msg.data);
+      // an in-place-completed request's payload IS the user buffer
+      if (kv.second->msg.data && !kv.second->in_fill)
+        free(kv.second->msg.data);
       delete kv.second;
     }
     eng->reqs.clear();
@@ -2695,11 +3852,15 @@ void tdcn_destroy(void *h) {
     for (auto &m : eng->py_queue)
       if (m.data) free(m.data);
     eng->py_queue.clear();
+    for (auto &kv : eng->order_gates)
+      for (auto &pm : kv.second.parked)
+        if (pm.second.data) free(pm.second.data);
+    eng->order_gates.clear();
   }
   {
     std::lock_guard<std::mutex> g(eng->rndv_mu);
     for (auto &kv : eng->reasm) {
-      if (kv.second->buf) free(kv.second->buf);
+      if (kv.second->buf && !kv.second->fill_user) free(kv.second->buf);
       delete kv.second;
     }
     eng->reasm.clear();

@@ -415,6 +415,13 @@ typedef struct {
 extern int tdcn_chan_send1(void *, unsigned long long, int, int, int, int,
                            const char *, long long, const void *,
                            unsigned long long);
+extern long long tdcn_chan_isend1(void *, unsigned long long, int, int,
+                                  int, int, const char *, long long,
+                                  const void *, unsigned long long, int);
+extern int tdcn_send_wait(void *, long long, double);
+extern int tdcn_send_test(void *, long long);
+extern int tdcn_send_done(void *, long long);
+extern void tdcn_send_forget(void *, long long);
 extern unsigned long long tdcn_chan_open(void *, const char *, const char *);
 extern int tdcn_send_local_data(void *, int, const char *, long long, int,
                                 int, int, const char *, int,
@@ -424,6 +431,9 @@ extern int tdcn_precv(void *, const char *, int, int, int, int, double,
                       tdcn_msg_t *);
 extern unsigned long long tdcn_post_recv(void *, const char *, int, int,
                                          int);
+extern unsigned long long tdcn_post_recv_into(void *, const char *, int,
+                                              int, int, void *,
+                                              unsigned long long);
 extern int tdcn_req_wait(void *, unsigned long long, double, tdcn_msg_t *);
 extern int tdcn_req_test(void *, unsigned long long, tdcn_msg_t *);
 extern int tdcn_req_peek(void *, unsigned long long, tdcn_msg_t *);
@@ -632,6 +642,10 @@ typedef struct {
   int is_send; /* eager: complete at issue */
   int zombie;  /* freed while active: deliver on completion, no handle */
   unsigned long long rid;
+  long long sreq; /* nonzero: zero-copy streaming-send descriptor —
+                   * the send completes at Wait/Test (tdcn_send_wait),
+                   * not at issue; the user buffer stays borrowed by
+                   * the engine until then (MPI_Isend semantics) */
   tpumpi_fp *fp;
   void *buf;
   long long cap;
@@ -652,6 +666,7 @@ static void fp_req_done(fp_req_t *q) {
   tpumpi_fp *fp = q->fp;
   q->used = 0;
   q->zombie = 0;
+  q->sreq = 0;
   q->fp = NULL;
   if (fp && fp->state == 2 && fp_live_refs(fp) == 0) fp_release(fp);
 }
@@ -717,6 +732,7 @@ static int fp_req_alloc(void) {
     if (!g_fpreq[i].used) {
       g_fpreq[i].used = 1;
       g_fpreq[i].zombie = 0;
+      g_fpreq[i].sreq = 0;
       return i;
     }
   return -1;
@@ -747,6 +763,14 @@ static int fp_error(int comm, int code) {
 static int fp_take(tdcn_msg_t *m, void *buf, long long cap,
                    MPI_Status *status) {
   int rc = MPI_SUCCESS;
+  if (m->data && m->data == buf) {
+    /* in-place rendezvous placement: the engine streamed the payload
+     * straight into the posted buffer (tdcn_post_recv_into) — nothing
+     * to copy, nothing to free */
+    fp_fill_status(status, m);
+    if (m->meta) tdcn_free(m->meta);
+    return MPI_SUCCESS;
+  }
   if (m->pyhandle) {
     /* cannot happen on capi-driven comms (Python local sends use the
      * bytes form there) — but never lose a message silently */
@@ -786,6 +810,38 @@ static int fp_send(tpumpi_fp *fp, const void *buf, int count,
                          nbytes)
              ? -1
              : MPI_SUCCESS;
+}
+
+/* nonblocking variant for MPI_Isend: the streaming engine pipelines
+ * the transfer off-thread (zero-copy — the user buffer is borrowed
+ * until MPI_Wait collects *sreq), so a windowed burst of large isends
+ * streams cooperatively through the ring instead of serializing the
+ * caller behind one blocking backpressured transfer per request (the
+ * osu_bw collapse).  *sreq = 0 means locally complete at issue (small
+ * direct record / local rank / tcp fallback). */
+static int fp_isend(tpumpi_fp *fp, const void *buf, int count,
+                    MPI_Datatype datatype, int dest, int tag,
+                    long long *sreq) {
+  int dt = (int)datatype;
+  int size = fp_dt[dt].size;
+  unsigned long long nbytes = (unsigned long long)count * (unsigned)size;
+  int dproc = fp_proc_of(fp, dest);
+  *sreq = 0;
+  if (dproc < 0) return -1; /* bad rank: let capi raise the MPI error */
+  if (dproc == fp->my_proc) {
+    long long shape = count;
+    return tdcn_send_local_data(fp->eng, 1 /*FK_P2P*/, fp->cid, 0,
+                                fp->my_rank, dest, tag, fp_dt[dt].np, 1,
+                                &shape, buf, nbytes)
+               ? -1
+               : MPI_SUCCESS;
+  }
+  long long h = tdcn_chan_isend1(fp->eng, fp_chan(fp, dproc), 1 /*FK_P2P*/,
+                                 fp->my_rank, dest, tag, fp_dt[dt].np,
+                                 count, buf, nbytes, 0 /* zero-copy */);
+  if (h < 0) return -1;
+  *sreq = h;
+  return MPI_SUCCESS;
 }
 
 static int fp_usable(tpumpi_fp **out, MPI_Comm comm, MPI_Datatype datatype,
@@ -845,17 +901,27 @@ int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
   tpumpi_fp *fp;
   if (dest != MPI_PROC_NULL && count >= 0 &&
       fp_usable(&fp, comm, datatype, dest, tag, 0)) {
-    int rc = fp_send(fp, buf, count, datatype, dest, tag);
+    long long sreq = 0;
+    int rc = fp_isend(fp, buf, count, datatype, dest, tag, &sreq);
     if (rc == MPI_SUCCESS) {
       int i = fp_req_alloc();
-      if (i >= 0) { /* eager: locally complete at issue */
+      if (i >= 0) {
         g_fpreq[i].is_send = 1;
+        g_fpreq[i].sreq = sreq; /* 0: complete at issue; else the
+                                 * streaming descriptor Wait collects */
         g_fpreq[i].fp = fp;
         *request = (MPI_Request)(FP_REQ_BIT | i);
         return MPI_SUCCESS;
       }
-      /* table full: the send already happened; hand back a completed
-       * capi done-handle so Wait/Test still work */
+      /* table full: collect the in-flight stream now (blocking), then
+       * hand back a completed capi done-handle so Wait/Test work */
+      if (sreq) {
+        int w;
+        do {
+          w = tdcn_send_wait(fp->eng, sreq, 120.0);
+        } while (w == 1);
+        if (w != 0) return fp_error((int)comm, MPI_ERR_OTHER);
+      }
       capi_ret r2;
       if (capi_call("isend_done_handle", &r2, "(iiL)", 0, 0, 0LL) ==
               MPI_SUCCESS &&
@@ -885,8 +951,13 @@ int PMPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
       g_fpreq[i].fp = fp;
       g_fpreq[i].buf = buf;
       g_fpreq[i].cap = (long long)count * fp_dt[(int)datatype].size;
-      g_fpreq[i].rid = tdcn_post_recv(fp->eng, fp->cid, fp->my_rank,
-                                      source, tag);
+      /* the post carries its buffer: a large streamed message that
+       * finds this recv already posted lands in `buf` directly (no
+       * reassembly malloc, no delivery copy — fp_take sees the
+       * pointer-equal payload and skips both) */
+      g_fpreq[i].rid = tdcn_post_recv_into(
+          fp->eng, fp->cid, fp->my_rank, source, tag, buf,
+          (unsigned long long)g_fpreq[i].cap);
       *request = (MPI_Request)(FP_REQ_BIT | i);
       return MPI_SUCCESS;
     }
@@ -908,6 +979,19 @@ static int fp_wait(MPI_Request *request, MPI_Status *status) {
   fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
   int rc = MPI_SUCCESS;
   if (q->is_send) {
+    if (q->sreq) { /* zero-copy stream: completion happens HERE */
+      int w;
+      do {
+        w = tdcn_send_wait(q->fp->eng, q->sreq, 120.0);
+      } while (w == 1);
+      q->sreq = 0; /* terminal: the descriptor is freed either way */
+      if (w != 0) {
+        int comm = q->fp->comm;
+        fp_req_done(q);
+        *request = MPI_REQUEST_NULL;
+        return fp_error(comm, MPI_ERR_OTHER);
+      }
+    }
     if (status) {
       status->MPI_SOURCE = MPI_PROC_NULL;
       status->MPI_TAG = MPI_ANY_TAG;
@@ -939,6 +1023,21 @@ static int fp_wait(MPI_Request *request, MPI_Status *status) {
 static int fp_test(MPI_Request *request, int *flag, MPI_Status *status) {
   fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
   if (q->is_send) {
+    if (q->sreq) {
+      int t = tdcn_send_test(q->fp->eng, q->sreq);
+      if (t == 1) {
+        *flag = 0;
+        return MPI_SUCCESS;
+      }
+      q->sreq = 0; /* terminal: the descriptor is freed either way */
+      if (t != 0) {
+        int comm = q->fp->comm;
+        fp_req_done(q);
+        *request = MPI_REQUEST_NULL;
+        *flag = 1;
+        return fp_error(comm, MPI_ERR_OTHER);
+      }
+    }
     *flag = 1;
     return fp_wait(request, status);
   }
@@ -2126,7 +2225,12 @@ int PMPI_Request_free(MPI_Request *request) {
   if (fp_is_req(*request)) {
     fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
     if (q->is_send) {
-      fp_req_done(q); /* eager send: already complete */
+      /* an active zero-copy stream is handed to the engine: it
+       * completes in the background and reclaims the descriptor (the
+       * caller must not reuse the buffer until it knows the send
+       * finished by other means — the MPI_Request_free contract) */
+      if (q->sreq) tdcn_send_forget(q->fp->eng, q->sreq);
+      fp_req_done(q);
     } else {
       /* MPI 3.7.3: a freed ACTIVE receive still completes into the
        * user buffer — drain now if done, else park as a zombie the
@@ -2159,8 +2263,8 @@ int PMPI_Request_get_status(MPI_Request request, int *flag,
   if (fp_is_req(request)) { /* non-destructive completion probe */
     fp_req_t *q = &g_fpreq[(int)request & ~FP_REQ_BIT];
     if (q->is_send) {
-      *flag = 1;
-      empty_status(status);
+      *flag = q->sreq ? tdcn_send_done(q->fp->eng, q->sreq) : 1;
+      if (*flag) empty_status(status);
     } else {
       tdcn_msg_t m;
       int rc = tdcn_req_peek(q->fp->eng, q->rid, &m);

@@ -502,6 +502,44 @@ def register_serving_vars(store: "VarStore") -> None:
         store.register(fw, comp, name, default, type=typ, help=help_)
 
 
+# -- transport tuning variables (central registration, same pattern) -----
+#
+# The native streaming send engine's knobs (the large-message ring
+# path: pipelined chunking, per-peer in-flight caps, doorbell
+# coalescing).  Consumed by ompi_tpu.dcn.native at engine creation
+# (forwarded to the C engine via tdcn_set_stream) but introspectable
+# on every store like the other central sets.
+
+#: (framework, component, name, default, type, help)
+TRANSPORT_VARS = (
+    ("dcn", "", "chunk_bytes", 512 << 10, "int",
+     "Streaming-engine FRAG granularity AND streaming threshold on the "
+     "shared-memory ring path: payloads above it leave the caller's "
+     "thread as a send descriptor and stream cooperatively through the "
+     "per-engine sender thread; the adaptive controller shrinks the "
+     "effective chunk (floor 64 KiB) under sustained ring backpressure "
+     "and grows it back when the stall clears"),
+    ("dcn", "", "inflight_limit", 32 << 20, "int",
+     "Per-peer cap on queued-unsent streaming bytes: an isend enqueue "
+     "over the cap blocks (bounded by dcn_ring_timeout) until the "
+     "sender thread drains below it — graceful backpressure instead of "
+     "unbounded buffered-send memory growth (0 = unlimited)"),
+    ("dcn", "", "doorbell_coalesce", True, "bool",
+     "Pay the ring-doorbell futex_wake syscall only when a consumer is "
+     "actually parked (the doorbell word is still bumped every record, "
+     "so no wakeup is ever lost); suppressed wakes are counted in "
+     "doorbells_suppressed.  Off restores the unconditional per-record "
+     "wake"),
+)
+
+
+def register_transport_vars(store: "VarStore") -> None:
+    """Register the streaming-send-engine knobs on a store
+    (idempotent)."""
+    for fw, comp, name, default, typ, help_ in TRANSPORT_VARS:
+        store.register(fw, comp, name, default, type=typ, help=help_)
+
+
 def dcn_timeout(name: str) -> float:
     """Resolve one ``dcn_<name>_timeout`` against the default MCA
     context — the single lookup every blocking DCN wait shares.  Falls

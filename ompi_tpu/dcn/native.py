@@ -112,6 +112,9 @@ def load_library():
         lib.tdcn_recv_coll.argtypes = [P, S, I64, I, I, D, MSG]
         lib.tdcn_post_recv.restype = U64
         lib.tdcn_post_recv.argtypes = [P, S, I, I, I]
+        lib.tdcn_post_recv_into.restype = U64
+        lib.tdcn_post_recv_into.argtypes = [P, S, I, I, I,
+                                            ctypes.c_void_p, U64]
         lib.tdcn_req_wait.restype = I
         lib.tdcn_req_wait.argtypes = [P, U64, D, MSG]
         lib.tdcn_req_test.restype = I
@@ -150,6 +153,7 @@ def load_library():
         lib.tdcn_set_connect_timeout.argtypes = [P, D]
         lib.tdcn_free.argtypes = [ctypes.c_void_p]
         lib.tdcn_close.argtypes = [P]
+        lib.tdcn_destroy.argtypes = [P]
         lib.tdcn_chan_open.restype = U64
         lib.tdcn_chan_open.argtypes = [P, S, S]
         lib.tdcn_chan_close.argtypes = [P, U64]
@@ -162,6 +166,17 @@ def load_library():
         lib.tdcn_chan_send1.restype = I
         lib.tdcn_chan_send1.argtypes = [
             P, U64, I, I, I, I, S, I64, ctypes.c_void_p, U64]
+        lib.tdcn_chan_isend1.restype = I64
+        lib.tdcn_chan_isend1.argtypes = [
+            P, U64, I, I, I, I, S, I64, ctypes.c_void_p, U64, I]
+        lib.tdcn_send_wait.restype = I
+        lib.tdcn_send_wait.argtypes = [P, I64, D]
+        lib.tdcn_send_test.restype = I
+        lib.tdcn_send_test.argtypes = [P, I64]
+        lib.tdcn_send_done.restype = I
+        lib.tdcn_send_done.argtypes = [P, I64]
+        lib.tdcn_send_forget.argtypes = [P, I64]
+        lib.tdcn_set_stream.argtypes = [P, U64, U64, I]
         _lib = lib
         return lib
 
@@ -186,6 +201,32 @@ def available() -> bool:
         return True
     except Exception:  # noqa: BLE001 — no toolchain / unsupported OS
         return False
+
+
+def transport_tuning() -> tuple[int, int, bool]:
+    """Resolve the streaming-send-engine knobs (``dcn_chunk_bytes``,
+    ``dcn_inflight_limit``, ``dcn_doorbell_coalesce``) against the
+    default MCA context, falling back to the central TRANSPORT_VARS
+    defaults (bare engines in unit tests)."""
+    from ompi_tpu.core.var import TRANSPORT_VARS, full_var_name
+
+    vals: dict[str, object] = {
+        full_var_name(fw, comp, name): default
+        for fw, comp, name, default, _typ, _h in TRANSPORT_VARS
+    }
+    try:
+        from ompi_tpu.core import mca
+
+        store = mca.default_context().store
+        for full in vals:
+            v = store.get(full)
+            if v is not None:
+                vals[full] = v
+    except Exception:  # noqa: BLE001 — pre-init / teardown: defaults
+        pass
+    return (int(vals["dcn_chunk_bytes"]),
+            int(vals["dcn_inflight_limit"]),
+            bool(vals["dcn_doorbell_coalesce"]))
 
 
 _dtype_cache: dict[bytes, np.dtype] = {}
@@ -514,6 +555,13 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._lib.tdcn_set_ring_timeout(self._h, float(dcn_timeout("ring")))
         self._lib.tdcn_set_connect_timeout(
             self._h, float(dcn_timeout("connect")))
+        # streaming send engine knobs (TRANSPORT_VARS): pipelined chunk
+        # granularity, the per-peer queued-bytes cap, and the doorbell
+        # coalescing escape hatch — forwarded once; the C engine reads
+        # them with relaxed atomics per send
+        chunk, inflight, coalesce = transport_tuning()
+        self._lib.tdcn_set_stream(self._h, chunk, inflight,
+                                  1 if coalesce else 0)
         from ompi_tpu import metrics as _metrics
 
         _metrics.register_provider(self, self.stats_snapshot)
@@ -635,6 +683,40 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         if rc != 0:
             raise ConnectionError(
                 f"native dcn channel send failed (rc={rc})")
+
+    def chan_isend(self, chan: int, kind: int, src: int, dst: int,
+                   tag: int, arr: np.ndarray) -> None:
+        """Detached (buffered) channel send — the streaming engine's
+        isend fast path: larger-than-chunk payloads enqueue a send
+        descriptor (the C engine owns a copy) and return immediately,
+        so windowed bursts pipeline instead of serializing.  1-D
+        contiguous payloads only (the MPI_Isend-dominant case); other
+        shapes fall back to the blocking channel send."""
+        if arr.ndim != 1:
+            return self.chan_send(chan, kind, src, dst, tag, arr)
+        if _fsim._enabled:
+            # same seeded "send" schedule + connkill site as chan_send:
+            # the pipelined path must not dodge the fault plane
+            for act in _fsim.actions("send",
+                                     kinds={"drop", "delay", "connkill"}):
+                if act.kind == "delay":
+                    _fsim.apply_delay(act)
+                elif act.kind == "drop":
+                    return
+                elif act.kind == "connkill":
+                    self._lib.tdcn_chan_kill(self._h, chan)
+        if _metrics._enabled:
+            _metrics.observe_size("dcn_p2p_send", arr.nbytes)
+            from ompi_tpu.metrics import flight as _flight
+
+            _flight.check_watermarks()
+        rc = self._lib.tdcn_chan_isend1(
+            self._h, chan, kind, src, dst, tag, _dt_bytes(arr.dtype),
+            arr.shape[0], arr.ctypes.data if arr.nbytes else None,
+            arr.nbytes, 1)  # buffered: numpy lifetimes can't be pinned
+        if rc != 0:
+            raise ConnectionError(
+                f"native dcn channel isend failed (rc={rc})")
 
     # -- p2p registration (native vs Python delivery) -------------------
 

@@ -70,11 +70,22 @@ NATIVE_COUNTERS = (
     # elastic-recovery tail: duplicates dropped by the exactly-once
     # rx seq filter, and peers restored by replace() after a respawn
     "dedup_drops", "respawns",
+    # streaming-send-engine tail: doorbell wakes skipped because no
+    # consumer was parked (doorbells + doorbells_suppressed = every
+    # record published), messages/bytes routed through the pipelined
+    # sender, its live depth / queued-unsent-bytes gauges (+ HWMs),
+    # adaptive chunk halvings under ring stall, full-ring turns the
+    # sender yielded to other peers' work, and enqueues that blocked
+    # on dcn_inflight_limit
+    "doorbells_suppressed", "stream_msgs", "stream_bytes",
+    "stream_depth", "stream_depth_hwm", "stream_inflight",
+    "stream_inflight_hwm", "chunk_shrinks", "sender_yields",
+    "enqueue_waits",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —
 #: excluded from monotonicity assertions and baseline subtraction
-GAUGES = frozenset({"rndv_depth"})
+GAUGES = frozenset({"rndv_depth", "stream_depth", "stream_inflight"})
 
 NATIVE_STATS_VERSION = 1
 

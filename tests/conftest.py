@@ -26,6 +26,13 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow': timing-sensitive acceptance
+    # tests (the streaming-engine bandwidth shape) opt out of CI noise
+    config.addinivalue_line(
+        "markers", "slow: timing-sensitive; excluded from tier-1")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

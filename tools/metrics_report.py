@@ -116,6 +116,24 @@ def render_native(by_proc: dict[int, dict], out=sys.stdout) -> None:
         for label, ns, share in stall_breakdown(native):
             print(f"    {label:<22}{ns / 1e6:>12.3f} ms {share:>7.1%}",
                   file=out)
+        # streaming send engine: how much of the doorbell traffic the
+        # coalescing removed, and how deep the pipelined queue ran —
+        # the osu_bw-collapse fix's live signature
+        db = int(native.get("doorbells", 0))
+        supp = int(native.get("doorbells_suppressed", 0))
+        if db + supp:
+            print(f"    doorbell coalescing   {supp}/{db + supp} wakes "
+                  f"suppressed ({supp / (db + supp):>6.1%})", file=out)
+        if int(native.get("stream_msgs", 0)):
+            print(f"    streaming sender      "
+                  f"{int(native.get('stream_msgs', 0))} msgs, "
+                  f"depth hwm {int(native.get('stream_depth_hwm', 0))}, "
+                  f"inflight hwm "
+                  f"{int(native.get('stream_inflight_hwm', 0)) / 2**20:.1f}"
+                  f" MiB, {int(native.get('chunk_shrinks', 0))} chunk "
+                  f"shrinks, {int(native.get('sender_yields', 0))} "
+                  f"yields, {int(native.get('enqueue_waits', 0))} "
+                  f"enqueue waits", file=out)
 
 
 def render_ops(by_proc: dict[int, dict], out=sys.stdout) -> None:

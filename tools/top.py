@@ -77,6 +77,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
           f"{time.strftime('%H:%M:%S')}", file=out)
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
+          f"{'sdep':>5}{'coal':>6}"
           f"{'failed':>7}  stall causes (ring/cts/other)", file=out)
     for p in sorted(procs):
         f = procs[p]
@@ -93,6 +94,11 @@ def render(state: dict, prev: dict | None = None, url: str = "",
                       f"({stall / 1e6:.1f} ms)")
         else:
             causes = "-"
+        # streaming-engine live signature: per-peer pipelined depth
+        # and the share of doorbell wakes the coalescing suppressed
+        db = int(n.get("doorbells", 0))
+        supp = int(n.get("doorbells_suppressed", 0))
+        coal = f"{supp / (db + supp):>5.0%}" if (db + supp) else "    -"
         failed = f.get("failed") or []
         print(f"{p:<5}{mbs:>8.1f}{msgs:>8.0f}"
               f"{int(n.get('delivered', 0)):>10}"
@@ -100,6 +106,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               f"{int(n.get('respawns', 0)):>7}"
               f"{int(n.get('dedup_drops', 0)):>6}"
               f"{int(n.get('deadline_expired', 0)):>6}"
+              f"{int(n.get('stream_depth', 0)):>5}{coal:>6}"
               f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
               file=out)
     strag = state.get("straggler") or {}
