@@ -54,6 +54,12 @@ class MultiProcComm(PersistentP2PMixin):
         self.cid = _next_cid()
         self.name = name
         self._freed = False
+        #: False only on the world built by init(): derived comms
+        #: (split/shrink/replace results) repair via the PARTIAL
+        #: replace leg even when they span every proc — their rank
+        #: space is not the world's, so the world-level rejoin beacon
+        #: would rebuild the wrong communicator
+        self._derived = False
 
         # modex: exchange local sizes → global rank layout.  Every
         # first boot also publishes its size to the KVS so a respawned
@@ -746,16 +752,20 @@ class MultiProcComm(PersistentP2PMixin):
         is a respawn from its incarnation) and joins the same round.
 
         Returns the new full-membership communicator; the old one
-        stays revoked/poisoned.  Requires a communicator spanning
-        every job process in rank order (the restart leg is
-        job-level); use :meth:`shrink` on partial memberships."""
+        stays revoked/poisoned.  A communicator that does NOT span the
+        job (a split/sub comm, or any derived comm) repairs through
+        the PARTIAL leg (:meth:`_replace_partial`): only the member
+        procs participate, on comm-scoped beacon/agreement streams —
+        non-members are undisturbed."""
         ctx = self.procctx
-        if self.nprocs != self.dcn._root_engine().nprocs or any(
-                self.dcn.root_proc_of(p) != p for p in range(self.nprocs)):
-            raise MPICommError(
-                "replace() requires a communicator spanning every job "
-                "process in rank order; use shrink() instead")
         timeout = self._respawn_timeout()
+        world_shaped = (
+            not getattr(self, "_derived", False)
+            and self.nprocs == self.dcn._root_engine().nprocs
+            and all(self.dcn.root_proc_of(p) == p
+                    for p in range(self.nprocs)))
+        if not world_shaped:
+            return self._replace_partial(name, timeout)
         t0 = _trace.now() if _trace._enabled else 0
         import time as _time
 
@@ -790,8 +800,188 @@ class MultiProcComm(PersistentP2PMixin):
         return sub
 
     def _respawn_timeout(self) -> float:
-        store = mca.default_context().store
-        return float(store.get("ft_respawn_timeout", 60.0) or 60.0)
+        from ompi_tpu.boot.proc import respawn_timeout
+
+        return respawn_timeout(mca.default_context().store)
+
+    # -- partial replace (split/sub comms — deferred recovery edge a) ----
+
+    def _replace_partial(self, name: str, timeout: float) -> "MultiProcComm":
+        """``replace()`` on a communicator that does not span the job:
+        repair ONLY the member ranks.  Survivor members restore each
+        dead member proc at the root level (await its respawned
+        incarnation, install the endpoint, clear the marks) unless a
+        world-level replace already did; the minimum survivor
+        publishes a comm-scoped beacon (``replace.sub.<proc>.i<k>``)
+        carrying the repaired comm's world-coordinate recipe, and a
+        CID round runs per restored proc on the comm-scoped stream
+        (``replace.c<cid>.<proc>.i<k>``) that the reborn process joins
+        via :meth:`replace_partial` on its fresh world.  Non-member
+        procs never participate, never hear of the repair, and keep
+        their own comms/state untouched (their view of the old
+        incarnation stays failed — correct until a repair of their
+        own).
+
+        Scope (recorded in ROADMAP): comms split directly from the
+        world (a nested split's group ranks are parent-relative, not
+        world-relative), one pending partial repair per reborn
+        incarnation (the beacon key is (proc, incarnation)-scoped)."""
+        ctx = self.procctx
+        if not ctx.rejoined:
+            raise MPICommError(
+                "partial replace is the survivors' call; a reborn "
+                "incarnation rejoins via world.replace_partial()")
+        import time as _time
+
+        tw0 = _time.monotonic()
+        t0 = _trace.now() if _trace._enabled else 0
+        live = self._live_procs()
+        dead = sorted(set(range(self.nprocs)) - set(live))
+        if not dead:
+            raise MPICommError(
+                "replace: no failed ranks on this communicator")
+        recipe = self._partial_recipe(name)
+        live_roots = [self.dcn.root_proc_of(p) for p in live]
+        dead_roots = [self.dcn.root_proc_of(p) for p in dead]
+        proposals = self._partial_rounds(live_roots, dead_roots,
+                                         timeout, recipe)
+        cid = _reserve_cid_block(max(int(c) for c in proposals), 1)
+        sub = self._make_sub(
+            "replaced", cid, list(range(self.size)),
+            [p for p in range(self.nprocs)
+             for _ in range(self.proc_sizes[p])],
+            list(range(self.nprocs)))
+        sub.name = recipe["name"]
+        # metadata in WORLD coordinates, matching the reborn side's
+        # recipe-built comm: _make_sub relative to the OLD sub yields
+        # a [0..size) group, and a SECOND partial repair would publish
+        # those sub-local ranks as a "world-coordinate" recipe — wrong
+        # membership whenever the sub's ranks aren't [0..size)
+        sub.group = Group(list(self.group.ranks))
+        if _trace._enabled:
+            _trace.complete("ft", "replace", t0, comm=self.name,
+                            cid=int(cid))
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record(
+            "replace", comm=self.name, cid=int(cid), partial=True,
+            heal_ms=round((_time.monotonic() - tw0) * 1e3, 3))
+        return sub
+
+    def _partial_recipe(self, name: str = "") -> dict:
+        """The repaired communicator's structure in WORLD coordinates —
+        everything a reborn proc (holding only its fresh world) needs
+        to build the identical comm: member ranks, owning procs (root
+        ids, comm order), the comm-scoped stream prefix, the name."""
+        return {
+            "members": [int(r) for r in self.group.ranks],
+            "procs": [int(self.dcn.root_proc_of(p))
+                      for p in range(self.nprocs)],
+            "skey": f"replace.c{int(self.cid)}",
+            "name": name or f"{self.name}.replaced",
+        }
+
+    def _partial_rounds(self, members: list[int], dead: list[int],
+                        timeout: float, recipe: dict) -> list[int]:
+        """Comm-scoped twin of :meth:`_replace_recover`: one
+        rendezvous round per dead member proc (ROOT ids throughout),
+        CID agreement over the membership restored so far; the minimum
+        survivor publishes the beacon each reborn proc reads.  Shared
+        by the survivor leg (on the sub-comm) and the reborn leg (on
+        the world, for procs still dead after its own round)."""
+        ctx = self.procctx
+        root = self.dcn._root_engine()
+        members = sorted(members)
+        dead = list(dead)
+        proposals = [_peek_cid()]
+        while dead:
+            r = dead.pop(0)
+            if root.proc_failed(r) or r not in ctx.incarnations:
+                inc, addr = ctx.await_respawn(r, timeout)
+                self._integrate_respawn(r, inc, addr)
+            else:
+                # a world-level replace already restored this proc at
+                # the root — only the comm-scoped agreement remains
+                inc = ctx.incarnations[r]
+            members = sorted(members + [r])
+            stream = f"{recipe['skey']}.{r}.i{inc}"
+            if root.proc == min(m for m in members if m != r):
+                ctx.kvs.put(
+                    f"{ctx.ns}replace.sub.{r}.i{inc}",
+                    dict(recipe, stream=stream, round=members,
+                         dead=list(dead),
+                         incs={str(k): v
+                               for k, v in ctx.incarnations.items()}))
+            proposals = [int(c) for c in
+                         root.sub(members).allgather_obj(
+                             int(_peek_cid()), stream)]
+        return proposals
+
+    def replace_partial(self, name: str = "") -> "MultiProcComm":
+        """The reborn-incarnation half of a PARTIAL replace: called on
+        the fresh world right after ``init()`` (``world.respawned`` is
+        the SPMD cue) when the communicator being repaired did not
+        span the job — the survivors called ``replace()`` on the
+        sub-comm, so there is no world round to rejoin.  Reads the
+        comm-scoped beacon addressed to this incarnation, joins its
+        CID round (helping restore any procs still dead after it),
+        rebuilds the member communicator from the world-coordinate
+        recipe, and retires non-member procs from the failure detector
+        — this process has no live relationship with them, so their
+        (correct) heartbeat silence toward it must not read as death.
+
+        Callable whether or not the world-level rejoin already ran:
+        a reborn proc that healed the WORLD first (survivors'
+        world.replace + its own) still holds no sub-comm object, so
+        the sub-comms it was a member of repair through this same
+        beacon — survivors' ``replace()`` on the sub skips the root
+        integration (already healed) and publishes the comm-scoped
+        round this call joins."""
+        ctx = self.procctx
+        if not ctx.incarnation:
+            raise MPICommError(
+                "replace_partial: not a reborn incarnation (survivors "
+                "repair a partial communicator with replace() on it)")
+        timeout = self._respawn_timeout()
+        inc = ctx.incarnation
+        info = ctx.kvs.get(f"{ctx.ns}replace.sub.{self.proc}.i{inc}",
+                           timeout=timeout)
+        for k, v in (info.get("incs") or {}).items():
+            ctx.incarnations[int(k)] = max(
+                int(v), ctx.incarnations.get(int(k), 0))
+        ctx.incarnations[self.proc] = inc
+        members_round = sorted(int(m) for m in info["round"])
+        proposals = [int(c) for c in
+                     self.dcn.sub(members_round).allgather_obj(
+                         int(_peek_cid()), str(info["stream"]))]
+        recipe = {k: info[k] for k in ("members", "procs", "skey",
+                                       "name")}
+        dead = [int(d) for d in info.get("dead", ())]
+        if dead:
+            proposals = self._partial_rounds(members_round, dead,
+                                             timeout, recipe)
+        cid = _reserve_cid_block(max(int(c) for c in proposals), 1)
+        members = [int(r) for r in recipe["members"]]
+        member_procs = [int(p) for p in recipe["procs"]]
+        owners = [self.locate(r)[0] for r in members]
+        sub = self._make_sub("replaced", cid, members, owners,
+                             member_procs)
+        sub.name = str(recipe["name"])
+        first_rejoin = not ctx.rejoined
+        ctx.rejoined = True
+        det = ctx.detector
+        if det is not None and first_rejoin:
+            # only when this call IS the rejoin: a world-level rejoin
+            # that already ran restored live relationships with every
+            # proc — they must stay watched
+            for p in range(self.nprocs):
+                if p != self.proc and p not in member_procs:
+                    det.retire_peer(p)
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("replace", comm=sub.name, cid=int(cid),
+                       partial=True, incarnation=int(inc))
+        return sub
 
     def _replace_recover(self, members: list[int], dead: list[int],
                          timeout: float) -> list[int]:
@@ -892,6 +1082,11 @@ class MultiProcComm(PersistentP2PMixin):
         sub = self._make_sub("replaced", cid, members, owners,
                              member_procs)
         sub.name = name or f"{self.name}.replaced"
+        # only reachable from the world leg: the healed comm spans the
+        # job in rank order, so a LATER death must repair it through
+        # the world leg again (a derived mark would mis-route the
+        # second repair down the partial path)
+        sub._derived = False
         return sub
 
     # -- lifecycle -------------------------------------------------------
@@ -1068,6 +1263,7 @@ class MultiProcComm(PersistentP2PMixin):
         c.cid = cid
         c.name = f"{self.name}.split({color})"
         c._freed = False
+        c._derived = True
         c.proc_sizes = [owners.count(p) for p in member_procs]
         c.offsets = np.cumsum([0] + c.proc_sizes).tolist()
         c.local_size = c.proc_sizes[c.proc]

@@ -127,6 +127,16 @@ class KVSServer:
             self._data[key] = value
             self._cond.notify_all()
 
+    def seed_fence(self, name: str, ranks) -> None:
+        """Pre-populate a fence set (daemon restart recovery): the
+        original boot's fences died with the crashed daemon's server,
+        but a future respawned rank still replays them — seeding the
+        full rank set keeps those replays instant instead of a
+        120-second timeout against an empty set."""
+        with self._cond:
+            self._fences.setdefault(name, set()).update(int(r) for r in ranks)
+            self._cond.notify_all()
+
     def close(self) -> None:
         self._running = False
         try:
@@ -139,10 +149,26 @@ class KVSClient:
     """Worker-side handle (≈ the PMIx client)."""
 
     def __init__(self, address: str):
+        self._lock = threading.Lock()
+        self._dial(address)
+
+    def _dial(self, address: str) -> None:
         host, port = address.rsplit(":", 1)
+        self.address = address
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.connect((host, int(port)))
-        self._lock = threading.Lock()
+
+    def reconnect(self, address: str) -> None:
+        """Re-point this client at a NEW server (tpud restart
+        re-adoption: the reborn daemon's KVS lives at a fresh port).
+        Raises like a normal dial on failure; the old socket is closed
+        either way."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._dial(address)
 
     def _call(self, msg: Any) -> Any:
         with self._lock:

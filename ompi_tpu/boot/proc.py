@@ -25,6 +25,21 @@ ENV_NS = "OMPI_TPU_KVS_NS"
 #: worker replays the boot rendezvous under a bumped incarnation so
 #: survivors can distinguish the reborn endpoint from the corpse's
 ENV_INCARNATION = "OMPI_TPU_INCARNATION"
+#: set by tpurun when the job maps ranks onto remote hosts (the
+#: plm/rsh leg): a remote respawn pays the launch-agent round-trip on
+#: top of the boot, so every await-respawn deadline switches from
+#: ft_respawn_timeout to ft_remote_respawn_timeout
+ENV_RSH = "OMPI_TPU_RSH"
+
+
+def respawn_timeout(store) -> float:
+    """The await-respawn deadline (replace(), the reborn rejoin grace,
+    the serve repair wait): ``ft_remote_respawn_timeout`` on the rsh
+    leg (:data:`ENV_RSH`), ``ft_respawn_timeout`` locally."""
+    if os.environ.get(ENV_RSH):
+        return float(
+            store.get("ft_remote_respawn_timeout", 120.0) or 120.0)
+    return float(store.get("ft_respawn_timeout", 60.0) or 60.0)
 
 
 def launched_by_tpurun() -> bool:
@@ -120,8 +135,7 @@ class ProcContext:
             # detector declaring every survivor dead
             grace = 0.0
             if self.incarnation:
-                grace = float(
-                    ctx.store.get("ft_respawn_timeout", 60.0) or 60.0)
+                grace = respawn_timeout(ctx.store)
             self.detector = HeartbeatDetector(
                 self.engine, period=ftp["period"], timeout=ftp["timeout"],
                 grace=grace,

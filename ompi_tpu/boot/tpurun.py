@@ -28,7 +28,7 @@ import sys
 import threading
 
 from .kvs import KVSServer
-from .proc import ENV_INCARNATION, ENV_KVS, ENV_NPROCS, ENV_PROC
+from .proc import ENV_INCARNATION, ENV_KVS, ENV_NPROCS, ENV_PROC, ENV_RSH
 
 
 def _forward(stream, prefix: str, out) -> None:
@@ -265,6 +265,12 @@ def run_job(
         t.start()
         threads.append(t)
         return p
+    # rsh leg marker: ranks mapped onto remote hosts switch every
+    # await-respawn deadline to ft_remote_respawn_timeout (a remote
+    # relaunch pays the launch-agent round-trip; the env key is
+    # OMPI_TPU_-prefixed, so _remote_cmd bakes it into the payload)
+    rsh_job = bool(rank_host) and any(
+        not _is_local_host(h) for h in rank_host)
     try:
         for rank in range(np_):
             env = worker_env(
@@ -273,6 +279,8 @@ def run_job(
                 telemetry_addr=(telemetry.ingest_address
                                 if telemetry is not None else None),
             )
+            if rsh_job:
+                env[ENV_RSH] = "1"
             cmd = worker_cmd(argv)
             target = rank_host[rank] if rank_host else None
             # plm/rsh: _final_cmd reproduces the worker env on the

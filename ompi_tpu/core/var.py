@@ -439,6 +439,13 @@ ROBUSTNESS_VARS = (
      "Seconds replace() waits for a failed rank's respawned "
      "incarnation to re-publish its endpoint (tpurun --respawn) "
      "before giving up on restoration"),
+    ("ft", "", "remote_respawn_timeout", 120.0, "float",
+     "The rsh-leg twin of ft_respawn_timeout: the await-respawn "
+     "deadline replace() (and a reborn worker's rejoin grace) uses "
+     "when the job was launched over the plm/rsh leg (tpurun marks "
+     "workers with OMPI_TPU_RSH) — a remote relaunch pays the launch-"
+     "agent round-trip on top of the boot, so the local deadline is "
+     "too tight"),
     ("faultsim", "", "enable", False, "bool",
      "Arm the deterministic fault-injection plane (default off — "
      "every transport hook is one boolean test when disabled)"),
@@ -448,7 +455,8 @@ ROBUSTNESS_VARS = (
     ("faultsim", "", "plan", "", "string",
      "Fault plan, e.g. 'drop:p=0.01,delay:ms=50,connkill:at=100,"
      "stall:ms=200' — comma-separated <kind>[:k=v[;k=v]] rules "
-     "(kinds: drop delay dup trunc connkill stall ringfail dialfail; "
+     "(kinds: drop delay dup trunc connkill stall ringfail dialfail "
+     "daemonkill; "
      "'proc=N' restricts a rule to one rank, e.g. "
      "'delay:ms=30;site=recv;proc=1' slows only rank 1)"),
 )
@@ -493,6 +501,26 @@ SERVING_VARS = (
     ("serve", "", "job_timeout", 0.0, "float",
      "Seconds the daemon lets one job run before marking it failed and "
      "freeing its rank-set (0 = unbounded)"),
+    ("serve", "", "pidfile", "", "string",
+     "Path of the tpud pidfile — arms the crash-safe control plane: "
+     "the daemon records its pid/KVS/ops addresses there (stale locks "
+     "from a SIGKILLed daemon are reaped and taken over), journals "
+     "the job stream next to it, and resident workers use it to find "
+     "a restarted daemon and re-attach instead of orphaning (empty = "
+     "off, the pre-PR-10 one-shot daemon lifecycle)"),
+    ("serve", "", "journal", "", "string",
+     "Job-stream journal path (append-only JSONL): submissions, "
+     "published directives, completions, and worker pids — replayed "
+     "by a restarted daemon so queued and in-flight jobs survive a "
+     "daemon SIGKILL and execute exactly once (empty = "
+     "<serve_pidfile>.journal when a pidfile is configured)"),
+    ("serve", "", "reattach_timeout", 30.0, "float",
+     "Crash-safe control plane window, both sides: how long a "
+     "resident worker that lost its daemon parks and polls the "
+     "pidfile for a restarted one before self-terminating with full "
+     "teardown (no orphans), and how long the restarted daemon waits "
+     "for a live worker's re-adoption record before treating the "
+     "rank as dead and respawning it"),
 )
 
 

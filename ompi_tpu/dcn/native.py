@@ -528,6 +528,7 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         if not self._h:
             raise MPIInternalError("tdcn_create failed")
         self._running = True
+        self._destroyed = False
         self.transport = _NativeTransportView(self)
         #: local-send payload table: handle → (payload, nbytes)
         self._handles: dict[int, object] = {}
@@ -859,6 +860,20 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._lib.tdcn_close(self._h)
         if self._dispatcher.is_alive():
             self._dispatcher.join(timeout=2.0)
+
+    def destroy(self) -> None:
+        """FULL engine teardown (``tdcn_destroy``): close, then wait
+        for the reader threads to drain and free every engine-owned
+        allocation — the leak-free exit a resident worker's SIGTERM/
+        orphan path takes so an operator ``kill`` never leaks shm
+        rings or readers (the sanitizer soak's contract).  Terminal:
+        the handle is gone afterwards; only call on the way out of the
+        process."""
+        if self._destroyed:
+            return
+        self.close()
+        self._destroyed = True
+        self._lib.tdcn_destroy(self._h)
 
 
 class NativeSubEngine(_NativeOpsMixin, DcnSubEngine):

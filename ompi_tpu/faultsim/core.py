@@ -47,15 +47,22 @@ _enabled = False
 #:               (site ring; armed into libtpudcn via tdcn_fault_set);
 #: ``ringfail``  fail the ``at``-th native ring write outright;
 #: ``dialfail``  refuse the first ``n`` connect() attempts (site dial
-#:               — exercises the exponential-backoff dial loop).
+#:               — exercises the exponential-backoff dial loop);
+#: ``daemonkill`` SIGKILL the tpud serving daemon at the ``at``-th
+#:               directive-publish attempt (site daemon — the control-
+#:               plane hook in serve/daemon.py; drives the restart-
+#:               hygiene soak deterministically from one seed).
+#:
+#: The tuple is grow-only: the ``faultsim_injected_<kind>`` MPI_T pvar
+#: namespace is derived from it in order.
 KINDS = ("drop", "delay", "dup", "trunc", "connkill", "stall",
-         "ringfail", "dialfail")
+         "ringfail", "dialfail", "daemonkill")
 
 #: default hook site per kind (rules may override with ``site=``)
 _DEFAULT_SITE = {
     "drop": "send", "delay": "send", "dup": "send", "trunc": "send",
     "connkill": "send", "stall": "ring", "ringfail": "ring",
-    "dialfail": "dial",
+    "dialfail": "dial", "daemonkill": "daemon",
 }
 
 _M64 = (1 << 64) - 1

@@ -106,6 +106,20 @@ class HeartbeatDetector:
                 self._last[proc] = time.monotonic()
                 self._strikes[proc] = 0
 
+    def retire_peer(self, proc: int) -> None:
+        """Stop watching a peer entirely (partial-communicator rejoin,
+        scale-down): this process has NO live relationship with it —
+        under a partial ``replace()`` the non-member procs rightly
+        never resume heartbeating to a reborn incarnation, and their
+        correct silence must not be re-detected as THEIR death.  The
+        heartbeat loop iterates a rebound list, so removal is safe
+        against the detector thread."""
+        with self._lock:
+            self._peers = [p for p in self._peers if p != proc]
+            self._last.pop(proc, None)
+            self._strikes.pop(proc, None)
+            self._failed.discard(proc)
+
     def mark_failed(self, proc: int, gossip: bool = True) -> None:
         """Declare ``proc`` dead (timeout, in-band error, or gossip)."""
         with self._lock:
@@ -133,9 +147,9 @@ class HeartbeatDetector:
 
     def _run(self) -> None:
         while not self._stop.wait(self.period):
-            for p in self._peers:
-                if p in self._failed:
-                    continue
+            for p in list(self._peers):
+                if p in self._failed or p not in self._strikes:
+                    continue  # failed, or retired mid-iteration
                 try:
                     self.engine.send_ctrl(p, {"kind": "hb",
                                               "src": self.engine.proc})
@@ -149,7 +163,7 @@ class HeartbeatDetector:
                     # (a full ring backpressures our sends while the
                     # busy peer keeps talking; proof of life outranks
                     # a congested send path)
-                    self._strikes[p] += 1
+                    self._strikes[p] = self._strikes.get(p, 0) + 1
                     if self._strikes[p] >= 2:
                         # two periods of inbound silence: a live
                         # backpressured peer refreshes _last at least
@@ -157,7 +171,8 @@ class HeartbeatDetector:
                         # one cannot — so in-band marking stays far
                         # faster than the full timeout without it
                         with self._lock:
-                            quiet = (time.monotonic() - self._last[p]
+                            quiet = (time.monotonic()
+                                     - self._last.get(p, time.monotonic())
                                      > 2 * self.period)
                         if quiet:
                             self.mark_failed(p)

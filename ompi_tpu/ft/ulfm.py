@@ -185,8 +185,12 @@ def replace(comm, name: str = ""):
     incarnation's re-published endpoint, clear its failure marks, and
     run a CID-agreement round the fresh-booted process joins; the
     result spans the complete original membership (the job returns to
-    full strength instead of contracting).  Single-controller comms
-    have no launcher to respawn ranks — multi-process only."""
+    full strength instead of contracting).  On a split/sub
+    communicator this repairs ONLY the member ranks, on comm-scoped
+    beacon streams — non-members are undisturbed, and the reborn
+    process joins via ``world.replace_partial()`` instead of the
+    world-level rejoin.  Single-controller comms have no launcher to
+    respawn ranks — multi-process only."""
     fn = getattr(comm, "replace", None)
     if fn is None:
         raise MPIProcFailedError(

@@ -95,6 +95,10 @@ class TelemetryAggregator:
                  host: str = "127.0.0.1"):
         self._lock = threading.Lock()
         self._running = True
+        #: host-process extension (the tpud daemon): a callable whose
+        #: dict is merged into /json state — how daemon liveness and
+        #: journal depth reach tools/top.py without a second endpoint
+        self.extra_state = None
         #: extension routes (the tpud ops surface mounts here):
         #: (method, path) → callable(body_bytes) -> (status, ctype, body)
         self._routes: dict[tuple[str, str], Any] = {}
@@ -371,8 +375,16 @@ class TelemetryAggregator:
     # -- render ---------------------------------------------------------
 
     def json_state(self) -> dict:
+        extra = {}
+        fn = self.extra_state
+        if fn is not None:
+            try:
+                extra = dict(fn())
+            except Exception:  # noqa: BLE001 — scrapes must answer
+                extra = {}
         with self._lock:
             return {
+                **extra,
                 "frames": self.frames,
                 "nprocs": self._nprocs,
                 "procs": {str(p): f for p, f in self._latest.items()},
@@ -649,3 +661,22 @@ def stop_publisher() -> None:
     if _publisher is not None:
         _publisher.stop()
         _publisher = None
+
+
+def repoint_publisher(address: str) -> None:
+    """Re-aim this rank's frame pump at a NEW aggregator (tpud restart
+    re-adoption: the reborn daemon's ingest socket lives at a fresh
+    port).  The publisher thread keeps running; its cached socket is
+    dropped so the next tick dials the new address — a benign race
+    with an in-flight publish costs at most one failed frame."""
+    pub = _publisher
+    pump_enabled = pub is not None  # telemetry_enable armed a pump
+    if not pump_enabled or not address:
+        return
+    pub.address = address
+    sock, pub._sock = pub._sock, None
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass

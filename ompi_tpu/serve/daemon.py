@@ -26,6 +26,20 @@ Scheduling is **gang** FIFO with per-tenant round-robin fairness
 rank-set is free, and never while the mesh is unhealthy (dead worker,
 repair outstanding) — the telemetry plane's detector feed gating the
 job stream.
+
+**Crash safety** (``serve_pidfile`` arms it, :mod:`~ompi_tpu.serve.
+state` holds the substrate): the daemon takes a pidfile lock with
+stale-lock takeover and journals the job stream (append-only JSONL)
+so a daemon SIGKILL loses nothing durable — a restarted daemon
+replays the journal (queued jobs restored, in-flight directives
+re-published at their original indices; workers dedup by cursor so a
+replayed directive executes exactly once) and **re-adopts** the
+still-live resident workers through the warm KVS: workers that lost
+their daemon park on the pidfile, re-dial the new KVS, re-publish
+their modex keys, and offer ``serve.adopt.<r>`` records the daemon
+acks — their mesh, DCN endpoints, and warm CIDs never went away.
+Only a rank whose process actually died goes down the respawn+repair
+leg.
 """
 
 from __future__ import annotations
@@ -40,15 +54,24 @@ import time
 
 from ompi_tpu.boot.kvs import KVSServer
 from ompi_tpu.boot.proc import ENV_INCARNATION
-from ompi_tpu.boot.tpurun import _forward, worker_env
+from ompi_tpu.boot.tpurun import _forward, _truthy, worker_env
 from ompi_tpu.core.var import ENV_PREFIXES, SERVING_VARS, full_var_name
+from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.metrics.live import TelemetryAggregator
+from . import state as _state
 from .queue import AdmissionError, JobQueue
 
 #: KVS key prefixes of the serve protocol (workers mirror these)
 K_JOB = "serve.job."        # + <n>            → directive JSON
 K_DONE = "serve.done."      # + <n>.<proc>     → completion record
 K_RESUME = "serve.resume."  # + <proc>.i<inc>  → reborn worker's cursor
+K_ADOPT = "serve.adopt."    # + <proc>         → worker re-adoption offer
+K_ADOPTED = "serve.adopted."  # + <proc>       → daemon's adoption ack
+K_START = "serve.start."    # + <proc>         → fresh worker's cursor
+
+#: env var carrying the pidfile path to resident workers (their
+#: re-attach rendezvous after a daemon crash)
+ENV_SERVE_PIDFILE = "OMPI_TPU_SERVE_PIDFILE"
 
 
 def serve_var(mca: dict | None, name: str):
@@ -84,11 +107,42 @@ class TpuDaemon:
         self.cid_block = int(serve_var(self.mca, "cid_block"))
         self.cid_next = int(serve_var(self.mca, "cid_base"))
         self.job_timeout = float(serve_var(self.mca, "job_timeout"))
+        self.reattach_timeout = float(
+            serve_var(self.mca, "reattach_timeout"))
         self._lock = threading.RLock()
+        # crash-safe control plane (serve_pidfile arms it): stale-lock
+        # takeover + journal replay happen BEFORE any socket exists so
+        # a refused second daemon leaves no trace
+        self.pidfile = str(serve_var(self.mca, "pidfile") or "")
+        self.journal_path = str(serve_var(self.mca, "journal") or "")
+        if not self.journal_path and self.pidfile:
+            self.journal_path = self.pidfile + ".journal"
+        self.generation = 1
+        self._journal: _state.Journal | None = None
+        recovered: dict | None = None
+        if self.pidfile:
+            stale = _state.acquire_pidfile(self.pidfile)  # may raise
+            if stale is not None:
+                print(f"[tpud] reaped stale pidfile {self.pidfile} "
+                      f"(pid {stale.get('pid')} dead)", flush=True)
+            replay = _state.Journal.replay(self.journal_path)
+            self.generation = max(
+                replay["generation"],
+                int((stale or {}).get("generation", 0))) + 1
+            if replay["events"] and not replay["clean"]:
+                recovered = replay
+        # deterministic chaos (daemonkill): the daemon itself runs
+        # under the seeded fault plane when the mca/env arm it — rank
+        # workers get the same plan via OMPI_MCA_* inheritance
+        if _truthy(self._opt("faultsim_enable")):
+            _fsim.configure(str(self._opt("faultsim_plan") or ""),
+                            seed=int(self._opt("faultsim_seed") or 0),
+                            proc=-1)
         self.server = KVSServer()
         self.aggregator = TelemetryAggregator(
             http_port=(int(serve_var(self.mca, "port"))
                        if http_port is None else int(http_port)))
+        self.aggregator.extra_state = self._top_state
         self.url = self.aggregator.url
         self.queue = JobQueue(
             self.np, max_pending=int(serve_var(self.mca, "max_pending")))
@@ -98,8 +152,9 @@ class TpuDaemon:
         #: directive index → bookkeeping ({kind, procs, job_id, done})
         self._outstanding: dict[int, dict] = {}
         #: per-proc worker state: process handle + incarnation + status
-        #: in {"active", "dead", "retired", "exited"}
-        self._procs: list[subprocess.Popen | None] = [None] * self.np
+        #: in {"active", "adopting", "dead", "retired", "exited"}
+        self._procs: list[subprocess.Popen | _AdoptedProc | None] = (
+            [None] * self.np)
         self._incarnation = [0] * self.np
         self._status = ["active"] * self.np
         self._threads: list[threading.Thread] = []
@@ -107,12 +162,207 @@ class TpuDaemon:
         #: restored into the world by the survivors' replace())
         self._repairing: set[int] = set()
         self._repair_published = False
+        #: re-adoption window state (restart recovery)
+        self._adopt_deadline = 0.0
+        self._adopt_pids: dict[int, int] = {}
         self.shutting_down = False
         self._shutdown_published = False
         self.exit_code = 0
-        if spawn:
+        if self.pidfile:
+            _state.write_pidfile(self.pidfile, {
+                "pid": os.getpid(), "generation": self.generation,
+                "np": self.np, "kvs": self.server.address,
+                "url": self.url,
+                "ingest": self.aggregator.ingest_address,
+                "ts_ns": time.time_ns()})
+            self._journal = _state.Journal(self.journal_path)
+        if recovered is not None:
+            self._recover(recovered)
+        elif spawn:
             for rank in range(self.np):
                 self._procs[rank] = self._spawn(rank)
+
+    def _opt(self, name: str, default: str = "") -> str:
+        """Resolve a NON-serve var daemon-side (``--mca`` dict → env →
+        default) — the faultsim knobs ride the same launcher-process
+        resolution serve_var gives the serve_* set."""
+        if name in self.mca:
+            return str(self.mca[name])
+        for prefix in ENV_PREFIXES:
+            v = os.environ.get(prefix + name)
+            if v is not None:
+                return v
+        return default
+
+    def _journal_ev(self, ev: str, **fields) -> None:
+        if self._journal is not None:
+            self._journal.append(ev, **fields)
+
+    # -- restart recovery (journal replay + worker re-adoption) ---------
+
+    def _recover(self, replay: dict) -> None:
+        """Rebuild the control plane a SIGKILLed predecessor dropped:
+        restore the queue (queued jobs re-admitted, running jobs
+        re-entered), the stream cursor and CID high-water mark,
+        re-publish every outstanding directive at its ORIGINAL index
+        into the fresh KVS (consumers dedup by cursor — a directive a
+        worker already executed is skipped, one it never saw runs:
+        exactly once either way), seed the boot fences the old server
+        took with it, and open the re-adoption window for the still-
+        live resident workers."""
+        self._journal_ev("takeover", generation=self.generation,
+                         recovered_events=replay["events"])
+        # running jobs from the journal lack nothing — the published
+        # directive carries procs/cid; merge directive fields over the
+        # submit record so queue bookkeeping matches pre-crash state
+        by_id = {d.get("id"): d for d in replay["outstanding"].values()
+                 if d.get("kind", "job") == "job"}
+        running = [dict(job, **{k: by_id[job["id"]][k]
+                                for k in ("procs", "cid_base", "cid_span")
+                                if k in by_id[job["id"]]})
+                   for job in replay["running"] if job["id"] in by_id]
+        self.queue.restore(queued=replay["queued"], running=running,
+                           done=replay["done"])
+        self.cursor = int(replay["cursor"])
+        if replay["cid_next"] is not None:
+            self.cid_next = max(self.cid_next, int(replay["cid_next"]))
+        # the WHOLE stream is re-created at its original indices — NOT
+        # via _publish (the cursor must not advance; nothing may be
+        # re-journaled or re-counted by the fault plane).  Finished
+        # directives are re-published too: workers consume strictly in
+        # order, so a hole below a finished index would wedge any
+        # worker whose cursor is still beneath it — and re-publication
+        # cannot double-execute (a finished directive's whole gang
+        # reported, so their cursors are past it; everyone else skips
+        # non-member directives by construction)
+        for idx in sorted(replay["published"]):
+            d = replay["published"][idx]
+            if idx in replay["outstanding"]:
+                self._outstanding[idx] = {
+                    "kind": d.get("kind", "job"),
+                    "procs": list(d.get("procs") or range(self.np)),
+                    "job_id": d.get("id"), "done": {},
+                    "ts": time.monotonic(),
+                }
+            self.server.put_local(f"{K_JOB}{idx}", d)
+        # the boot-time fences died with the old KVS; a future
+        # respawned rank still replays them idempotently
+        self.server.seed_fence("modex", range(self.np))
+        self._adopt_pids = {r: int(st.get("pid", 0))
+                            for r, st in replay["pids"].items()}
+        for r, st in replay["pids"].items():
+            if 0 <= int(r) < self.np:
+                self._incarnation[int(r)] = int(st.get("incarnation", 0))
+        self._status = ["adopting"] * self.np
+        for r in replay["retired"]:
+            # an operator's /scale-down outlives the crash: a retired
+            # rank's dead pid is NOT a crashed worker to respawn
+            if 0 <= int(r) < self.np:
+                self._status[int(r)] = "retired"
+                self._adopt_pids.pop(int(r), None)
+        if replay["draining"]:
+            self.queue.draining = True  # the drain outlives the crash
+        self._adopt_deadline = time.monotonic() + self.reattach_timeout
+        print(f"[tpud] restart recovery (generation {self.generation}): "
+              f"{len(replay['outstanding'])} in-flight directive(s) "
+              f"re-published, {len(replay['queued'])} queued job(s) "
+              f"restored, awaiting re-adoption of {self.np} worker(s)",
+              flush=True)
+
+    def _poll_adoption(self) -> None:
+        """One monitor-tick look at the re-adoption window: a live
+        worker that found the new pidfile publishes ``serve.adopt.<r>``
+        — verify its pid, take it over (no Popen handle: an
+        :class:`_AdoptedProc` wraps the pid), and ack so the worker
+        resumes its stream.  A rank whose last known pid is dead is
+        respawned once every live rank has re-attached (the reborn
+        boot needs the survivors' re-published modex keys)."""
+        with self._lock:
+            pending = [r for r in range(self.np)
+                       if self._status[r] == "adopting"]
+            if not pending:
+                return
+            for r in pending:
+                offer = self.server.peek(f"{K_ADOPT}{r}")
+                if (offer and int(offer.get("generation", 0))
+                        == self.generation
+                        and _state.pid_alive(int(offer.get("pid", 0)))):
+                    pid = int(offer["pid"])
+                    self._procs[r] = _AdoptedProc(pid)
+                    self._incarnation[r] = int(
+                        offer.get("incarnation", 0))
+                    self._status[r] = "active"
+                    self._adopt_pids.pop(r, None)
+                    self.server.put_local(
+                        f"{K_ADOPTED}{r}",
+                        {"pid": pid, "generation": self.generation})
+                    self._journal_ev(
+                        "spawn", rank=r, pid=pid, adopted=True,
+                        incarnation=self._incarnation[r])
+                    print(f"[tpud] re-adopted rank {r} (pid {pid}, "
+                          f"cursor {offer.get('cursor')})", flush=True)
+            # ranks whose recorded worker died while the daemon was
+            # down (or that never re-attach) go down the respawn leg —
+            # but only after every live-pid rank resolved, so the
+            # reborn boot finds re-published wsize/dcn keys
+            live_waiting = [
+                r for r in range(self.np)
+                if self._status[r] == "adopting"
+                and _state.pid_alive(self._adopt_pids.get(r, 0))]
+            expired = time.monotonic() > self._adopt_deadline
+            if live_waiting and not expired:
+                return
+            still = [r for r in range(self.np)
+                     if self._status[r] == "adopting"]
+            if (still and not live_waiting
+                    and not any(s == "active" for s in self._status)):
+                # the whole mesh died with (or after) the daemon:
+                # nothing warm survives to repair against — cold-boot
+                # fresh workers; journal-restored queued jobs still
+                # run, in-flight ones fail honestly
+                print("[tpud] no resident workers survived the "
+                      "restart; cold-booting the mesh", flush=True)
+                for st in self._outstanding.values():
+                    for r in st["procs"]:
+                        st["done"].setdefault(r, {
+                            "ok": False,
+                            "error": "mesh lost across daemon restart"})
+                for r in still:
+                    self._adopt_pids.pop(r, None)
+                    self._incarnation[r] = 0
+                    self._status[r] = "active"
+                    # fresh incarnation-0 workers must NOT replay the
+                    # pre-crash stream (their predecessors' directives
+                    # are re-published at indices 0..cursor): the
+                    # start beacon skips them past it — journal-
+                    # restored QUEUED jobs publish at >= cursor
+                    self.server.put_local(f"{K_START}{r}", self.cursor)
+                    self._procs[r] = (self._spawn(r)
+                                      if self._spawn_workers else None)
+                return
+            for r in still:
+                if _state.pid_alive(self._adopt_pids.get(r, 0)):
+                    if not expired:
+                        continue
+                    # window over with the pid alive: a worker wedged
+                    # mid-job attaches when it next polls — keep
+                    # waiting (unhealthy, visible on /jobs) rather
+                    # than double-spawning the rank
+                    print(f"[tpud] rank {r} (pid "
+                          f"{self._adopt_pids.get(r)}) alive but not "
+                          "re-attached; holding the rank", flush=True)
+                    continue
+                print(f"[tpud] rank {r} did not re-attach (worker "
+                      "dead); respawning", flush=True)
+                # the dead rank fails any gang it was part of, exactly
+                # like a mid-job death the daemon witnessed
+                for st in self._outstanding.values():
+                    if r in st["procs"] and r not in st["done"]:
+                        st["done"][r] = {
+                            "ok": False,
+                            "error": "rank died during daemon restart"}
+                self._adopt_pids.pop(r, None)
+                self._respawn_locked(r)
 
     # -- worker lifecycle ------------------------------------------------
 
@@ -126,9 +376,11 @@ class TpuDaemon:
         return m
 
     def _spawn(self, rank: int) -> subprocess.Popen:
+        extra = ({ENV_SERVE_PIDFILE: self.pidfile} if self.pidfile
+                 else None)
         env = worker_env(
             rank, self.np, self.server.address, mca=self._worker_mca(),
-            cpu_devices=self.cpu_devices,
+            cpu_devices=self.cpu_devices, extra_env=extra,
             telemetry_addr=self.aggregator.ingest_address)
         if self._incarnation[rank]:
             env[ENV_INCARNATION] = str(self._incarnation[rank])
@@ -140,6 +392,8 @@ class TpuDaemon:
             daemon=True)
         t.start()
         self._threads.append(t)
+        self._journal_ev("spawn", rank=rank, pid=p.pid,
+                         incarnation=self._incarnation[rank])
         return p
 
     # -- ops surface (mounted on the aggregator's HTTP endpoint) --------
@@ -172,6 +426,7 @@ class TpuDaemon:
                 env=req.get("env"))
         except AdmissionError as e:
             return self._json(e.status, {"error": str(e)})
+        self._journal_ev("submit", job=job)
         return self._json(200, job)
 
     def _r_jobs(self, path, body):
@@ -179,13 +434,41 @@ class TpuDaemon:
         with self._lock:
             st["procs"] = {
                 str(r): {"status": self._status[r],
-                         "incarnation": self._incarnation[r]}
+                         "incarnation": self._incarnation[r],
+                         "pid": self._proc_pid(r)}
                 for r in range(self.np)}
             st["healthy"] = self._healthy_locked()
             st["cursor"] = self.cursor
+            st["generation"] = self.generation
         st["telemetry"] = self.aggregator.jobs_state()
         st["url"] = self.url
         return self._json(200, st)
+
+    def _proc_pid(self, r: int) -> int | None:
+        p = self._procs[r]
+        pid = getattr(p, "pid", None)
+        return (int(pid) if pid is not None
+                else self._adopt_pids.get(r))
+
+    def _top_state(self) -> dict:
+        """The aggregator /json extension (tools/top.py's daemon line):
+        liveness identity, journal depth, and the re-adoption picture —
+        an operator watching top sees a restarted daemon re-adopt."""
+        qs = self.queue.state()
+        with self._lock:
+            return {"daemon": {
+                "pid": os.getpid(),
+                "generation": self.generation,
+                "crash_safe": bool(self.pidfile),
+                "queued": len(qs["queued"]),
+                "outstanding": len(self._outstanding),
+                "journal_depth": len(qs["queued"]) + len(self._outstanding),
+                "adopting": [r for r in range(self.np)
+                             if self._status[r] == "adopting"],
+                "procs": {str(r): self._status[r]
+                          for r in range(self.np)},
+                "draining": self.queue.draining,
+            }}
 
     def _r_job(self, path, body):
         job_id = path.rsplit("/", 1)[-1]
@@ -196,10 +479,12 @@ class TpuDaemon:
 
     def _r_drain(self, path, body):
         self.queue.draining = True
+        self._journal_ev("drain")  # a restart must stay draining
         return self._json(200, {"draining": True})
 
     def _r_shutdown(self, path, body):
         self.queue.draining = True
+        self._journal_ev("drain")
         self.shutting_down = True
         return self._json(200, {"shutting_down": True})
 
@@ -234,7 +519,19 @@ class TpuDaemon:
 
     def _publish(self, directive: dict) -> int:
         """Append one directive to the job stream; workers consume
-        indices in order, so publication order IS execution order."""
+        indices in order, so publication order IS execution order.
+        Journaled BEFORE it becomes visible — a crash between the two
+        re-publishes it on recovery; consumers dedup by cursor."""
+        if _fsim._enabled:
+            # chaos (daemonkill:at=N): the Nth publish attempt kills
+            # the daemon dead, BEFORE the directive is journaled or
+            # visible — the deterministic SIGKILL the restart-hygiene
+            # soak replays from one seed
+            for _r in _fsim.actions("daemon", kinds={"daemonkill"}):
+                print("[tpud] faultsim: injected daemon kill "
+                      "(daemonkill)", flush=True)
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
         with self._lock:
             idx = self.cursor
             self.cursor += 1
@@ -247,6 +544,7 @@ class TpuDaemon:
                 "done": {},
                 "ts": time.monotonic(),
             }
+            self._journal_ev("publish", d=d)
             self.server.put_local(f"{K_JOB}{idx}", d)
             return idx
 
@@ -272,12 +570,14 @@ class TpuDaemon:
         self._status[rank] = "respawning"
         self._repairing.add(rank)
         self._repair_published = False
-        self._procs[rank] = self._spawn(rank)
+        self._procs[rank] = (self._spawn(rank) if self._spawn_workers
+                             else None)
 
     def _handle_death(self, rank: int, rc: int) -> None:
         with self._lock:
             if self._status[rank] == "retiring":
                 self._status[rank] = "retired"
+                self._journal_ev("retire", ranks=[rank])
                 return
             if self.shutting_down and self._shutdown_published:
                 self._status[rank] = "exited"
@@ -381,6 +681,7 @@ class TpuDaemon:
             job = self.queue.finish(st["job_id"], ok=not bad,
                                     error="; ".join(bad),
                                     ranks=st["done"])
+            self._journal_ev("finish", idx=idx, kind="job", job=job)
             if job is not None:
                 print(f"[tpud] job {job['id']} ({job['tenant']}) "
                       f"{job['state']}", flush=True)
@@ -391,17 +692,36 @@ class TpuDaemon:
                         self._status[r] = "active"
                 self._repairing.clear()
                 self._repair_published = False
+            self._journal_ev("finish", idx=idx, kind="repair")
             print("[tpud] repair complete: mesh restored", flush=True)
         elif st["kind"] == "retire":
             with self._lock:
-                for r in range(self.np):
-                    if self._status[r] == "retiring":
-                        self._status[r] = "retired"
+                done = [r for r in range(self.np)
+                        if self._status[r] == "retiring"]
+                for r in done:
+                    self._status[r] = "retired"
+            if done:
+                self._journal_ev("retire", ranks=done)
+            self._journal_ev("finish", idx=idx, kind="retire")
 
     def _busy_procs(self) -> set[int]:
         with self._lock:
             return {r for st in self._outstanding.values()
                     for r in st["procs"]}
+
+    def _booted(self) -> bool:
+        """Mesh boot gate: a rank worker's ``wsize.<r>`` modex publish
+        is its I-am-up beacon — scheduling (and therefore the
+        daemonkill directive counter) must not run ahead of workers
+        that are still importing.  Without this, a daemon crash in the
+        boot window strands directives no worker ever saw AND kills
+        the workers at their first KVS dial (found by the
+        --daemon-restart soak's own race)."""
+        if not self._spawn_workers:
+            return True  # workerless harness pumps the stream itself
+        return all(self.server.peek(f"wsize.{r}") is not None
+                   for r in range(self.np)
+                   if self._status[r] == "active")
 
     def _schedule(self) -> None:
         with self._lock:
@@ -409,6 +729,8 @@ class TpuDaemon:
                 return
             active = {r for r in range(self.np)
                       if self._status[r] == "active"}
+        if not self._booted():
+            return
         free = active - self._busy_procs()
         while True:
             job = self.queue.next_runnable(free)
@@ -438,6 +760,7 @@ class TpuDaemon:
     def step(self) -> None:
         """One monitor tick (public so tests can drive the loop
         deterministically)."""
+        self._poll_adoption()
         self._poll_workers()
         self._collect_done()
         self._maybe_publish_repair()
@@ -489,12 +812,73 @@ class TpuDaemon:
             t.join(timeout=5)
         self.aggregator.close()
         self.server.close()
+        # clean release: the journal is REMOVED (nothing durable
+        # remains to recover, and an append-only file reused across
+        # many daemon lifetimes would grow without bound) and the
+        # pidfile lifts — the next daemon starts fresh instead of
+        # "recovering" a shutdown it misreads as a crash.  The
+        # shutdown event is still written first: if the unlink loses a
+        # race (or the operator copies the journal mid-shutdown), the
+        # tail says clean.
+        if self._journal is not None:
+            self._journal_ev("shutdown", generation=self.generation)
+            self._journal.close()
+            self._journal = None
+            try:
+                os.unlink(self.journal_path)
+            except OSError:
+                pass
+        if self.pidfile:
+            _state.remove_pidfile(self.pidfile)
+
+
+class _AdoptedProc:
+    """A re-adopted resident worker: not our child, so no Popen — a
+    pid wrapper with the Popen surface the monitor loop touches.
+    ``poll()`` can only report liveness (the real exit code reaps to
+    init), so death reads as a synthetic rc 1 — enough for the
+    respawn machinery, which only branches on nonzero."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self.returncode: int | None = None
+
+    def poll(self) -> int | None:
+        if self.returncode is None and not _state.pid_alive(self.pid):
+            self.returncode = 1
+        return self.returncode
+
+    def _signal(self, sig: int) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except OSError:
+            pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = time.monotonic() + (timeout or 0)
+        while self.poll() is None:
+            if timeout is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("adopted", timeout)
+            time.sleep(0.05)
+        return self.returncode  # type: ignore[return-value]
 
 
 def run_daemon(np_: int, mca: dict[str, str] | None = None,
                cpu_devices: int | None = None, max_respawns: int = 2,
                http_port: int | None = None) -> int:
     """The ``tpurun --daemon`` / ``tools/tpud.py`` entry."""
-    return TpuDaemon(np_, mca=mca, cpu_devices=cpu_devices,
-                     max_respawns=max_respawns,
-                     http_port=http_port).run()
+    try:
+        d = TpuDaemon(np_, mca=mca, cpu_devices=cpu_devices,
+                      max_respawns=max_respawns, http_port=http_port)
+    except _state.DaemonAlreadyRunning as e:
+        # idempotent start: a second `tpurun --daemon` against a live
+        # pidfile is a clean one-liner, not a traceback
+        print(f"tpud: {e}", flush=True)
+        return 1
+    return d.run()

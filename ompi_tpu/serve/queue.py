@@ -19,6 +19,14 @@ import time
 from typing import Any
 
 
+def _id_num(job_id: str) -> int:
+    """Numeric part of a ``j<N>`` id (0 for foreign ids)."""
+    try:
+        return int(str(job_id).lstrip("j"))
+    except ValueError:
+        return 0
+
+
 class AdmissionError(Exception):
     """Submit rejected by admission control (HTTP 429/503 at the ops
     surface); ``.status`` carries the HTTP code."""
@@ -138,6 +146,29 @@ class JobQueue:
             job["end_ns"] = time.time_ns()
             self._done[job_id] = job
             return dict(job)
+
+    # -- restart recovery (journal replay) -------------------------------
+
+    def restore(self, queued=(), running=(), done=()) -> None:
+        """Reload journal-replayed state into a FRESH queue (daemon
+        restart): queued jobs go back to the FIFO in submission order,
+        running jobs re-enter the running set (their re-published
+        directives are already outstanding), done jobs keep the ops
+        history.  The id counter resumes past every restored id so a
+        post-restart submit can never collide."""
+        with self._lock:
+            top = 0
+            for job in sorted(queued, key=lambda j: j.get("submit_ns", 0)):
+                self._queue.append(dict(job, state="queued"))
+                top = max(top, _id_num(job["id"]))
+            for job in running:
+                self._running[job["id"]] = dict(job, state="running")
+                top = max(top, _id_num(job["id"]))
+            for job in done:
+                self._done[job["id"]] = dict(job)
+                top = max(top, _id_num(job["id"]))
+            if top:
+                self._ids = itertools.count(top + 1)
 
     def fail_queued(self, reason: str) -> None:
         """Flush the queue as failed (daemon shutdown with jobs

@@ -1,0 +1,308 @@
+"""Durable daemon state — pidfile lock + job-stream journal.
+
+The tpud control plane's crash-safety substrate (ROADMAP tpud
+follow-up (d)): everything the daemon process holds only in memory —
+its identity, the job queue, the directive stream cursor, the worker
+pids — dies with a SIGKILL, and PR 6's daemon orphaned every resident
+worker when that happened.  Two small on-disk artifacts fix it:
+
+* the **pidfile** (``serve_pidfile``) is a JSON record of the live
+  daemon: pid, generation, and the three addresses a worker or
+  operator needs to find it (KVS, ops HTTP URL, telemetry ingest).
+  Acquisition implements *stale-lock takeover*: a pidfile whose pid is
+  dead is reaped and its generation continued; a pidfile whose pid is
+  alive refuses the second daemon.  Resident workers that lose their
+  daemon poll this file for a higher generation — the re-adoption
+  rendezvous;
+* the **journal** (``serve_journal``, append-only JSONL next to the
+  pidfile) records the job stream: submissions, published directives,
+  directive completions, worker spawns/adoptions, clean shutdowns.
+  :func:`Journal.replay` folds it back into the state a restarted
+  daemon needs — queued jobs to re-admit, in-flight directives to
+  re-publish at their ORIGINAL indices (workers dedup by cursor, so a
+  replayed directive executes exactly once), the stream cursor, the
+  CID-block high-water mark, and the last known pid per rank (the
+  liveness test that decides re-adopt vs respawn).
+
+Both are plain files, written atomically (tmp + rename) or
+appended+flushed per event; no daemon state outlives a clean
+shutdown (the pidfile is removed and a ``shutdown`` event resets the
+journal's replay state).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+def pid_alive(pid: int) -> bool:
+    """Best-effort liveness: signal 0 probes existence (EPERM counts
+    as alive — some other user's process holds the pid)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def read_pidfile(path: str) -> dict | None:
+    """Parse the pidfile; None when absent or corrupt (a torn write is
+    treated exactly like a stale lock — reaped on acquire)."""
+    try:
+        with open(path) as f:
+            info = json.loads(f.read() or "{}")
+    except (OSError, ValueError):
+        return None
+    return info if isinstance(info, dict) and "pid" in info else None
+
+
+def write_pidfile(path: str, info: dict) -> None:
+    """Atomic publish (tmp + rename): a reader never sees a torn
+    record, and the rename is the commit point workers poll for."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(info, sort_keys=True))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class DaemonAlreadyRunning(RuntimeError):
+    """A live daemon owns the pidfile; ``.info`` is its record."""
+
+    def __init__(self, info: dict):
+        super().__init__(
+            f"tpud already running (pid {info.get('pid')}, ops "
+            f"{info.get('url', '?')}) — pidfile {info.get('path', '')!r}")
+        self.info = info
+
+
+def acquire_pidfile(path: str) -> dict | None:
+    """Take the pidfile lock.  Returns the STALE record we reaped
+    (the restart-recovery cue, generation included) or None for a
+    fresh start; raises :class:`DaemonAlreadyRunning` when the
+    recorded pid is alive — including the loser of a concurrent
+    takeover race: after reaping a stale record, the lock is CLAIMED
+    with an ``O_CREAT|O_EXCL`` create (a provisional record carrying
+    our live pid), so two simultaneously restarted daemons cannot
+    both believe they own it.  The caller overwrites the claim with
+    its full record once its sockets exist (addresses are part of the
+    record)."""
+    info = read_pidfile(path)
+    if info is not None and pid_alive(int(info.get("pid", 0))):
+        raise DaemonAlreadyRunning(dict(info, path=path))
+    if info is not None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        # a racing daemon claimed between our unlink and create
+        raise DaemonAlreadyRunning(
+            dict(read_pidfile(path) or {"pid": -1}, path=path))
+    with os.fdopen(fd, "w") as f:
+        f.write(json.dumps({"pid": os.getpid(), "claiming": True,
+                            "generation": int((info or {})
+                                              .get("generation", 0))}))
+        f.flush()
+        os.fsync(f.fileno())
+    return info
+
+
+def remove_pidfile(path: str) -> None:
+    """Release on clean shutdown — only if we still own it (a newer
+    generation may have taken over a lock we wrongly held)."""
+    info = read_pidfile(path)
+    if info is not None and int(info.get("pid", -1)) != os.getpid():
+        return
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class Journal:
+    """Append-only JSONL event log of the job stream.
+
+    Events (one JSON object per line, ``ev`` discriminates):
+
+    ``submit``    a job admitted to the queue (full record)
+    ``publish``   a directive appended to the stream (full directive,
+                  ``idx`` inside)
+    ``finish``    a directive completed (``idx``; job directives also
+                  carry the final job record)
+    ``spawn``     a worker process launched or re-adopted
+                  (``rank``/``pid``/``incarnation``/``adopted``) —
+                  also un-retires the rank (a /scale restore)
+    ``retire``    ranks scaled down (``ranks``) — a restart must not
+                  resurrect an operator's scale-down
+    ``drain``     admission stopped — a restart must stay draining
+    ``takeover``  a restarted daemon recovered this journal
+    ``shutdown``  clean daemon shutdown — replay state resets here
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        # a SIGKILLed writer can leave a torn final line; terminate it
+        # before appending, or the first post-takeover event glues to
+        # the torn tail and BOTH lines are lost to replay
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except (OSError, ValueError):
+            torn = False
+        self._f = open(path, "a")
+        if torn:
+            self._f.write("\n")
+            self._f.flush()
+
+    def append(self, ev: str, **fields: Any) -> None:
+        rec = {"ev": ev, "ts_ns": time.time_ns(), **fields}
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def replay(path: str) -> dict:
+        """Fold the journal into restart state (empty state when the
+        file is absent, unparseable lines skipped — a torn final line
+        from the crash instant must not poison recovery):
+
+        ``queued``       job records admitted but never published
+        ``running``      job records whose directive is outstanding
+        ``done``         finished job records (ops-surface history)
+        ``published``    idx → directive, EVERY publish (finished
+                         included — the restart must re-create the
+                         whole stream: workers consume strictly in
+                         order, so a hole below a finished index
+                         would wedge any worker still beneath it)
+        ``outstanding``  idx → directive, published but not finished
+        ``cursor``       next directive index
+        ``cid_next``     first CID block not yet handed out
+        ``pids``         rank → {pid, incarnation} (last spawn/adopt)
+        ``retired``      ranks scaled down and not since restored
+        ``draining``     True when admission was stopped pre-crash
+        ``generation``   takeover count recorded so far
+        ``clean``        True when the tail is a clean shutdown
+        """
+        jobs: dict[str, dict] = {}
+        published: dict[int, dict] = {}
+        finished: dict[int, dict] = {}
+        pids: dict[int, dict] = {}
+        retired: set[int] = set()
+        draining = False
+        generation = 0
+        clean = True
+
+        def _reset() -> None:
+            nonlocal draining
+            jobs.clear()
+            published.clear()
+            finished.clear()
+            pids.clear()
+            retired.clear()
+            draining = False
+
+        try:
+            f = open(path)
+        except OSError:
+            return {"queued": [], "running": [], "done": [],
+                    "published": {}, "outstanding": {}, "cursor": 0,
+                    "cid_next": None, "pids": {}, "retired": [],
+                    "draining": False, "generation": 0,
+                    "clean": True, "events": 0}
+        events = 0
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line from the crash instant
+                events += 1
+                ev = rec.get("ev")
+                if ev == "submit":
+                    job = rec.get("job") or {}
+                    if job.get("id"):
+                        jobs[job["id"]] = job
+                    clean = False
+                elif ev == "publish":
+                    d = rec.get("d") or {}
+                    if "idx" in d:
+                        published[int(d["idx"])] = d
+                    clean = False
+                elif ev == "finish":
+                    idx = int(rec.get("idx", -1))
+                    finished[idx] = rec
+                    job = rec.get("job")
+                    if job and job.get("id"):
+                        jobs[job["id"]] = job
+                elif ev == "spawn":
+                    rank = int(rec.get("rank", -1))
+                    pids[rank] = {
+                        "pid": int(rec.get("pid", 0)),
+                        "incarnation": int(rec.get("incarnation", 0))}
+                    retired.discard(rank)  # /scale restore
+                    clean = False
+                elif ev == "retire":
+                    retired.update(int(r) for r in rec.get("ranks", ()))
+                    clean = False
+                elif ev == "drain":
+                    draining = True
+                    clean = False
+                elif ev == "takeover":
+                    generation = max(generation,
+                                     int(rec.get("generation", 0)))
+                elif ev == "shutdown":
+                    _reset()
+                    clean = True
+        outstanding = {i: d for i, d in published.items()
+                       if i not in finished}
+        published_job_ids = {d.get("id") for d in published.values()
+                             if d.get("kind", "job") == "job"}
+        queued, running, done = [], [], []
+        for job in jobs.values():
+            if job.get("state") in ("done", "failed"):
+                done.append(job)
+            elif job["id"] in {d.get("id") for d in outstanding.values()}:
+                running.append(job)
+            elif job["id"] in published_job_ids:
+                # published AND finished but the finish event lost its
+                # job payload — count it done with what we have
+                done.append(dict(job, state=job.get("state", "done")))
+            else:
+                queued.append(job)
+        cid_next = None
+        for d in published.values():
+            if "cid_base" in d:
+                top = int(d["cid_base"]) + int(d.get("cid_span", 0))
+                cid_next = top if cid_next is None else max(cid_next, top)
+        return {
+            "queued": queued, "running": running, "done": done,
+            "published": dict(published), "outstanding": outstanding,
+            "cursor": (max(published) + 1) if published else 0,
+            "cid_next": cid_next, "pids": pids,
+            "retired": sorted(retired), "draining": draining,
+            "generation": generation, "clean": clean,
+            "events": events,
+        }

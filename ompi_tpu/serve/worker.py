@@ -25,43 +25,256 @@ Per job directive:
 ``replace()`` (PR-4) to restore a respawned rank; a reborn worker
 rejoins through the replace beacon and resumes the stream at the
 cursor the daemon published for its incarnation.
+
+**Daemon crash-safety** (the worker half): every KVS interaction goes
+through :class:`DaemonLink`.  When the daemon dies (connection loss on
+the long-poll or a failed completion put), the worker keeps serving
+its in-flight job — the data plane is worker-to-worker — and **parks**
+in a bounded re-attach window (``serve_reattach_timeout``), polling
+the pidfile (``OMPI_TPU_SERVE_PIDFILE``) for a restarted daemon at a
+higher generation.  Found one: re-dial its KVS, re-publish the modex
+keys the old server took down, offer a ``serve.adopt.<r>`` record,
+await the ack, re-put any completion records the crash orphaned, and
+resume the stream at the local cursor (replayed directives dedup —
+exactly once).  No daemon within the window (or no pidfile at all):
+self-terminate with the full exit hygiene — crash-path telemetry
+export, flight record, ``tdcn_destroy`` engine teardown.  **No
+orphans, ever** — the same path a daemon-initiated SIGTERM stop takes.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import runpy
+import signal
 import sys
 import time
+
+from . import state as _state
 
 #: KVS keys (shared with the daemon — keep in sync with serve/daemon.py)
 K_JOB = "serve.job."
 K_DONE = "serve.done."
 K_RESUME = "serve.resume."
+K_ADOPT = "serve.adopt."
+K_ADOPTED = "serve.adopted."
+K_START = "serve.start."
+ENV_SERVE_PIDFILE = "OMPI_TPU_SERVE_PIDFILE"
 
 #: transport counters proving warm reuse (flat across jobs = no
 #: re-dials) and the per-job delivery/dedup picture
 _DIAL_KEYS = ("reconnects", "retry_dials")
 _REPORT_KEYS = ("delivered", "reconnects", "retry_dials", "dedup_drops")
 
+#: completion records kept for re-publication after a daemon restart
+_DONE_CACHE = 256
 
-def _kvs_wait(ctx, key: str, poll: float):
-    """Long-poll one KVS key; a dead daemon (connection loss) exits
-    the worker — the resident plane has nothing to serve without it."""
-    while True:
+
+class _Stop(BaseException):
+    """SIGTERM carrier — BaseException so the job scope's catch-all
+    (a job must never kill the worker) cannot swallow a
+    daemon-initiated stop."""
+
+
+def _sigterm(signum, frame):  # pragma: no cover - signal delivery
+    raise _Stop()
+
+
+class _PipeSafe:
+    """Stdio guard for the resident plane: the worker's stdout is a
+    pipe into the daemon, and a SIGKILLed daemon turns every print —
+    including the in-flight job script's — into BrokenPipeError.  The
+    in-flight job must keep running through the daemon outage, so
+    writes degrade to no-ops instead of raising (output during the
+    outage is lost; the completion record is the durable artifact)."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def write(self, s):
         try:
-            return ctx.kvs.get(key, timeout=max(poll, 2.0))
-        except KeyError:
-            time.sleep(poll)
+            return self._f.write(s)
+        except (OSError, ValueError):
+            return len(s)
+
+    def flush(self):
+        try:
+            self._f.flush()
+        except (OSError, ValueError):
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+class DaemonLink:
+    """The worker's resilient handle on the daemon: job-stream cursor,
+    completion-record cache, and the crash→re-attach state machine."""
+
+    def __init__(self, ctx, wsize: int, poll: float, window: float):
+        self.ctx = ctx
+        self.wsize = int(wsize)
+        self.poll = poll
+        self.window = float(window)
+        self.pidfile = os.environ.get(ENV_SERVE_PIDFILE, "")
+        info = (_state.read_pidfile(self.pidfile)
+                if self.pidfile else None)
+        #: the generation we booted under; re-attach requires a HIGHER
+        #: one (a live daemon at our own generation is the one whose
+        #: socket just broke — dial it again, don't adopt)
+        self.generation = int((info or {}).get("generation", 0))
+        #: next directive index to consume
+        self.cursor = 0
+        self._done: collections.OrderedDict[int, dict] = (
+            collections.OrderedDict())
+        #: main() installs the teardown closure (needs api + world)
+        self.teardown = None
+
+    # -- stream consumption ---------------------------------------------
+
+    def wait_directive(self) -> tuple[int, dict]:
+        """Long-poll the next directive; a dead daemon routes through
+        the re-attach window (which either restores the link or exits
+        the process — this loop never spins against a corpse)."""
+        while True:
+            try:
+                jd = self.ctx.kvs.get(f"{K_JOB}{self.cursor}",
+                                      timeout=max(self.poll, 2.0))
+            except KeyError:
+                time.sleep(self.poll)
+                continue
+            except (ConnectionError, OSError):
+                self.reattach()
+                continue
+            idx, self.cursor = self.cursor, self.cursor + 1
+            return idx, jd
+
+    def get(self, key: str):
+        """Resilient KVS read for non-stream keys (the reborn cursor
+        beacon): same re-attach healing as the stream poll."""
+        while True:
+            try:
+                return self.ctx.kvs.get(key, timeout=max(self.poll, 2.0))
+            except KeyError:
+                time.sleep(self.poll)
+            except (ConnectionError, OSError):
+                self.reattach()
+
+    def report(self, idx: int, rec: dict) -> None:
+        """Publish a completion record; cached regardless, so a record
+        the daemon never saw (crash between execute and collect) is
+        re-put on re-adoption — the daemon's collect is idempotent."""
+        rec = dict(rec)
+        rec["proc"] = self.ctx.proc
+        self._done[idx] = rec
+        while len(self._done) > _DONE_CACHE:
+            self._done.popitem(last=False)
+        try:
+            self.ctx.kvs.put(f"{K_DONE}{idx}.{self.ctx.proc}", rec)
         except (ConnectionError, OSError):
-            print("serve: daemon gone; exiting", flush=True)
-            raise SystemExit(0)
+            pass  # the re-attach path re-publishes the cache
 
+    # -- crash → re-attach ----------------------------------------------
 
-def _report(ctx, idx: int, rec: dict) -> None:
-    rec = dict(rec)
-    rec["proc"] = ctx.proc
-    ctx.kvs.put(f"{K_DONE}{idx}.{ctx.proc}", rec)
+    def reattach(self) -> None:
+        """The parked state: bounded poll of the pidfile for a
+        restarted daemon, adoption on success, full-teardown exit on
+        expiry.  Bounded by ``serve_reattach_timeout`` via the shared
+        Deadline policy."""
+        from ompi_tpu.core.errors import DeadlineExpiredError
+        from ompi_tpu.core.var import Deadline
+
+        if not self.pidfile:
+            self._orphan_exit("daemon gone and no pidfile to re-attach "
+                              "through (serve_pidfile off)")
+        deadline = Deadline(self.window)
+        print(f"serve: daemon lost; parking up to {self.window:.0f}s "
+              f"for a restarted daemon ({self.pidfile})", flush=True)
+        while True:
+            info = _state.read_pidfile(self.pidfile)
+            alive = bool(info) and _state.pid_alive(
+                int(info.get("pid", 0)))
+            gen = int((info or {}).get("generation", 0))
+            if alive and gen == self.generation:
+                # transient socket break against the SAME daemon (it
+                # never lost us): plain re-dial, no adoption handshake
+                try:
+                    self.ctx.kvs.reconnect(info["kvs"])
+                    print("serve: KVS link re-dialed (daemon alive)",
+                          flush=True)
+                    return
+                except OSError:
+                    pass  # it may be dying; keep polling
+            elif alive and gen > self.generation:
+                try:
+                    self._adopt(info, deadline)
+                    return
+                except (KeyError, OSError, TimeoutError,
+                        DeadlineExpiredError) as e:
+                    print(f"serve: re-attach attempt failed "
+                          f"({type(e).__name__}: {e}); retrying",
+                          flush=True)
+            if deadline.expired():
+                self._orphan_exit(
+                    "no restarted daemon within serve_reattach_timeout"
+                    f"={self.window:.0f}s")
+            time.sleep(min(0.25, max(self.poll, 0.05)))
+
+    def _adopt(self, info: dict, deadline) -> None:
+        """One adoption attempt against a candidate daemon: re-dial
+        its KVS, re-publish this rank's modex keys (the old server
+        died with them; future respawns/repairs read them), offer the
+        adopt record, await the ack, re-put cached completions."""
+        ctx = self.ctx
+        ctx.kvs.reconnect(info["kvs"])
+        addr = ctx.engine.transport.address
+        ctx.kvs.put(f"{ctx.ns}dcn.{ctx.proc}", addr)
+        ctx.kvs.put(f"{ctx.ns}wsize.{ctx.proc}", self.wsize)
+        if ctx.incarnation:
+            ctx.kvs.put(f"{ctx.ns}dcn.{ctx.proc}.i{ctx.incarnation}",
+                        addr)
+            ctx.kvs.put(f"{ctx.ns}inc.{ctx.proc}", ctx.incarnation)
+        gen = int(info["generation"])
+        ctx.kvs.put(f"{K_ADOPT}{ctx.proc}", {
+            "pid": os.getpid(), "incarnation": ctx.incarnation,
+            "cursor": self.cursor, "generation": gen})
+        while True:
+            try:
+                ack = ctx.kvs.get(f"{K_ADOPTED}{ctx.proc}",
+                                  timeout=deadline.slice(1.0))
+            except KeyError:
+                ack = None
+            if (ack and int(ack.get("pid", -1)) == os.getpid()
+                    and int(ack.get("generation", 0)) == gen):
+                break
+            deadline.check("re-adoption ack")
+            time.sleep(0.05)
+        for idx, rec in list(self._done.items()):
+            ctx.kvs.put(f"{K_DONE}{idx}.{ctx.proc}", rec)
+        self.generation = gen
+        from ompi_tpu.metrics import live
+
+        live.repoint_publisher(info.get("ingest") or "")
+        print(f"serve: re-attached to daemon generation {gen} "
+              f"(cursor {self.cursor})", flush=True)
+
+    def _orphan_exit(self, reason: str) -> None:
+        """The no-orphans guarantee: a worker that cannot find a
+        daemon terminates ITSELF with the full exit hygiene — partial
+        telemetry export, flight record, engine destroy — instead of
+        serving nothing forever."""
+        print(f"serve: {reason}; tearing down and exiting (no "
+              "orphans)", flush=True)
+        from ompi_tpu.metrics import export as _mexport
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("worker_orphaned", reason=reason,
+                       cursor=self.cursor)
+        _mexport.crash_dump("daemon_lost")
+        if self.teardown is not None:
+            self.teardown()
+        raise SystemExit(0)
 
 
 def _job_comm(world, jd: dict):
@@ -102,13 +315,13 @@ def _exec_script(jd: dict) -> None:
                 os.environ[k] = old
 
 
-def _run_job(api, world, ctx, jd: dict, idx: int) -> None:
+def _run_job(api, world, link: DaemonLink, jd: dict, idx: int) -> None:
     import ompi_tpu.serve as serve
     from ompi_tpu.metrics import core as mcore
     from ompi_tpu.metrics import live
 
     rec: dict = {"ok": True, "id": jd["id"], "cid_base": jd["cid_base"],
-                 "incarnation": ctx.incarnation}
+                 "incarnation": link.ctx.incarnation}
     before = mcore.native_counters()
     rec["dials_before"] = {k: int(before.get(k, 0)) for k in _DIAL_KEYS}
     job = None
@@ -124,6 +337,8 @@ def _run_job(api, world, ctx, jd: dict, idx: int) -> None:
         if e.code not in (0, None):
             rec["ok"] = False
             rec["error"] = f"job script exited rc={e.code}"
+    except _Stop:
+        raise  # daemon-initiated stop outranks the job guard
     except BaseException as e:  # noqa: BLE001 — a job must never kill
         # the resident worker; MPIProcFailedError lands here too (the
         # daemon sees the dead rank and queues the repair directive)
@@ -143,10 +358,11 @@ def _run_job(api, world, ctx, jd: dict, idx: int) -> None:
     after = mcore.native_counters()
     rec["dials_after"] = {k: int(after.get(k, 0)) for k in _DIAL_KEYS}
     rec["counters"] = {k: int(after.get(k, 0)) for k in _REPORT_KEYS}
-    _report(ctx, idx, rec)
+    link.report(idx, rec)
 
 
-def _repair(api, world, ctx, jd: dict, idx: int, timeout: float):
+def _repair(api, world, link: DaemonLink, jd: dict, idx: int,
+            timeout: float):
     """Survivor half of a repair directive: wait for the detector to
     surface every dead proc (gossip converges within a period), then
     ``replace()`` — the reborn incarnations rejoin through the beacon
@@ -160,7 +376,7 @@ def _repair(api, world, ctx, jd: dict, idx: int, timeout: float):
         if not missing:
             break
         if time.monotonic() > deadline:
-            _report(ctx, idx, {
+            link.report(idx, {
                 "ok": False,
                 "error": f"repair: procs {missing} never surfaced as "
                          f"failed within {timeout}s"})
@@ -170,25 +386,37 @@ def _repair(api, world, ctx, jd: dict, idx: int, timeout: float):
     try:
         healed = world.replace()
     except BaseException as e:  # noqa: BLE001 — repair must report
-        _report(ctx, idx, {"ok": False,
-                           "error": f"{type(e).__name__}: {e}"})
+        if isinstance(e, _Stop):
+            raise
+        link.report(idx, {"ok": False,
+                          "error": f"{type(e).__name__}: {e}"})
         return world
     api.set_world(healed)
-    _report(ctx, idx, {"ok": True,
-                       "heal_ms": round((time.monotonic() - t0) * 1e3, 3)})
+    link.report(idx, {"ok": True,
+                      "heal_ms": round((time.monotonic() - t0) * 1e3, 3)})
     print(f"serve: repaired world (dead={dead})", flush=True)
     return healed
 
 
 def _teardown_resident(api, world) -> None:
-    """Raw teardown for a retired rank (or a shutdown with ranks
-    missing): no finalize fence — the remaining ranks are not
-    finalizing with us."""
+    """Raw teardown for a retired/stopped/orphaned rank (no finalize
+    fence — the remaining ranks are not finalizing with us), ending in
+    the FULL native engine teardown: ``tdcn_destroy`` frees every
+    engine-owned allocation and joins the reader threads, so an
+    operator ``kill`` never leaks shm rings or readers (the ASan/TSan
+    ``--sanitize`` soak guards exactly this path in C)."""
     from ompi_tpu.metrics import live
 
     live.stop_publisher()
     try:
         world.procctx.close()
+    except Exception:  # noqa: BLE001 — exiting anyway
+        pass
+    try:
+        root = world.dcn._root_engine()
+        destroy = getattr(root, "destroy", None)
+        if destroy is not None:
+            destroy()
     except Exception:  # noqa: BLE001 — exiting anyway
         pass
 
@@ -198,30 +426,72 @@ def main() -> int:
 
     jax.config.update("jax_platforms",
                       os.environ.get("JAX_PLATFORMS", "cpu"))
+    # stdio through the daemon pipe must survive the daemon's death
+    sys.stdout = _PipeSafe(sys.stdout)
+    sys.stderr = _PipeSafe(sys.stderr)
     import ompi_tpu.api as api
     from ompi_tpu.core import mca
+
+    from ompi_tpu.boot.proc import respawn_timeout as _respawn_timeout
 
     world = api.init()
     ctx = world.procctx
     store = mca.default_context().store
     poll = max(0.02, int(store.get("serve_poll_ms", 50) or 50) / 1000.0)
-    respawn_timeout = float(store.get("ft_respawn_timeout", 60.0) or 60.0)
+    # rsh-aware (ft_remote_respawn_timeout under OMPI_TPU_RSH), like
+    # every other await-respawn deadline
+    respawn_timeout = _respawn_timeout(store)
+    link = DaemonLink(
+        ctx, wsize=world.local_size, poll=poll,
+        window=float(store.get("serve_reattach_timeout", 30.0) or 30.0))
+    current = {"world": world}
+    link.teardown = lambda: _teardown_resident(api, current["world"])
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:  # pragma: no cover - non-main thread (tests)
+        pass
+    try:
+        return _serve_loop(api, ctx, link, current, respawn_timeout)
+    except _Stop:
+        # operator/daemon SIGTERM: the same exit hygiene as job
+        # completion — partial export, flight record, engine destroy
+        print("serve: SIGTERM — crash-path export + full engine "
+              "teardown", flush=True)
+        from ompi_tpu.metrics import export as _mexport
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record("worker_sigterm", cursor=link.cursor)
+        _mexport.crash_dump("sigterm")
+        _teardown_resident(api, current["world"])
+        return 143
+
+
+def _serve_loop(api, ctx, link: DaemonLink, current: dict,
+                respawn_timeout: float) -> int:
+    world = current["world"]
     if getattr(world, "respawned", False):
         # reborn incarnation: rejoin the warm world via the survivors'
         # replace round, then resume the stream where the daemon says
         world = world.replace()
         api.set_world(world)
-        n = int(_kvs_wait(
-            ctx, f"{K_RESUME}{ctx.proc}.i{ctx.incarnation}", poll))
+        current["world"] = world
+        link.cursor = int(link.get(
+            f"{K_RESUME}{ctx.proc}.i{ctx.incarnation}"))
         print(f"serve: incarnation {ctx.incarnation} rejoined; "
-              f"resuming at directive {n}", flush=True)
+              f"resuming at directive {link.cursor}", flush=True)
     else:
-        n = 0
+        try:
+            # cold-boot after a daemon restart that lost the whole
+            # mesh: the daemon's start beacon skips this fresh worker
+            # past the re-published pre-crash stream
+            link.cursor = int(ctx.kvs.get(f"{K_START}{ctx.proc}",
+                                          wait=False))
+        except (KeyError, ConnectionError, OSError):
+            pass
         print(f"serve: resident worker up (proc {ctx.proc}/"
-              f"{ctx.nprocs})", flush=True)
+              f"{ctx.nprocs}, cursor {link.cursor})", flush=True)
     while True:
-        jd = _kvs_wait(ctx, f"{K_JOB}{n}", poll)
-        idx, n = n, n + 1
+        idx, jd = link.wait_directive()
         kind = jd.get("kind", "job")
         if kind == "shutdown":
             if len(jd.get("procs", ())) == ctx.nprocs:
@@ -232,20 +502,21 @@ def main() -> int:
             return 0
         if kind == "repair":
             if ctx.proc in jd.get("procs", ()):
-                world = _repair(api, world, ctx, jd, idx,
+                world = _repair(api, world, link, jd, idx,
                                 respawn_timeout)
+                current["world"] = world
             continue
         if kind == "retire":
             if ctx.proc in jd.get("retire", ()):
-                _report(ctx, idx, {"ok": True, "retired": True})
+                link.report(idx, {"ok": True, "retired": True})
                 _teardown_resident(api, world)
                 print("serve: retired", flush=True)
                 return 0
             if ctx.proc in jd.get("procs", ()):
-                _report(ctx, idx, {"ok": True})
+                link.report(idx, {"ok": True})
             continue
         if ctx.proc in jd.get("procs", ()):
-            _run_job(api, world, ctx, jd, idx)
+            _run_job(api, world, link, jd, idx)
 
 
 if __name__ == "__main__":

@@ -67,6 +67,28 @@ def test_plan_grammar():
     assert fsim.parse_plan("") == ()
 
 
+def test_plan_grammar_daemonkill():
+    """PR-10 control-plane chaos: ``daemonkill:at=N`` parses onto the
+    daemon site (the tpud directive-publish hook), counts under its
+    own kind, and fires exactly at the Nth site event — the
+    determinism the --daemon-restart soak replays from one seed."""
+    (rule,) = fsim.parse_plan("daemonkill:at=2")
+    assert rule.kind == "daemonkill" and rule.site == "daemon"
+    assert rule.at == 2
+    assert "daemonkill" in fsim.KINDS  # pvar namespace includes it
+    plan = fsim.FaultPlan(fsim.parse_plan("daemonkill:at=2"),
+                          seed=7, proc=-1)
+    hits = [tuple(r.kind for r in plan.decide("daemon",
+                                              kinds={"daemonkill"}))
+            for _ in range(4)]
+    assert hits == [(), ("daemonkill",), (), ()], hits
+    assert plan.injected["daemonkill"] == 1
+    # rank-targeted rules never fire on the daemon's proc=-1 stream
+    plan2 = fsim.FaultPlan(fsim.parse_plan("daemonkill:at=1;proc=0"),
+                           seed=7, proc=-1)
+    assert plan2.decide("daemon", kinds={"daemonkill"}) == ()
+
+
 def test_plan_grammar_rejects_garbage():
     with pytest.raises(fsim.FaultPlanError):
         fsim.parse_plan("fry:p=0.1")

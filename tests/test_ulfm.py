@@ -18,6 +18,7 @@ import pytest
 
 import ompi_tpu.api as api
 from ompi_tpu.core.errors import (
+    MPICommError,
     MPIProcFailedError,
     MPIProcFailedPendingError,
     MPIRankError,
@@ -287,3 +288,125 @@ def test_tpurun_respawn_replace_full_size():
                for t in tallies), tallies
     # the survivor accounted the restoration
     assert sum(t["respawns"] for t in tallies) >= 1, tallies
+
+
+def test_tpurun_rsh_shim_respawn_replace_full_size():
+    """The multi-host (plm/rsh) respawn leg, hermetically: a fake
+    non-local hostname forces every rank through the launch-agent
+    template, and the agent is an env-scrubbing local shell
+    (``env -i ... sh -c {cmd}``) — so the ranks ONLY get the env the
+    rsh payload baked in (rank/KVS coordinates, OMPI_MCA_*, the
+    OMPI_TPU_RSH marker, and on respawn the bumped
+    OMPI_TPU_INCARNATION).  Rank 1 SIGKILLs itself mid-collective; the
+    relaunch goes back through the agent with the incarnation baked
+    into the payload, and replace() restores full size end-to-end."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    worker = repo / "tests" / "workers" / "mp_respawn_worker.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    agent = (f"env -i PATH={os.environ.get('PATH', '/usr/bin:/bin')} "
+             f"HOME={os.path.expanduser('~')} /bin/sh -c {{cmd}}")
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "2", "--ft",
+           "--respawn", "--cpu-devices", "1",
+           "--host", "rsh-shim-host:2", "--kvs-host", "127.0.0.1",
+           "--launch-agent", agent,
+           "--mca", "btl", "tcp",
+           "--mca", "dcn_recv_timeout", "8",
+           "--mca", "dcn_cts_timeout", "8",
+           "--mca", "dcn_connect_timeout", "4",
+           str(worker)]
+    res = subprocess.run(cmd, capture_output=True, timeout=240,
+                         cwd=str(repo), env=env)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "respawning (incarnation 1)" in out
+    tallies = sorted(
+        (json.loads(line.split("RESPAWN_TALLY ", 1)[1])
+         for line in out.splitlines() if "RESPAWN_TALLY" in line),
+        key=lambda t: t["proc"])
+    assert len(tallies) == 2, out
+    # full size restored through the rsh relaunch, exact phase 2
+    assert all(t["size"] == 2 and t["post"] == t["ops"]
+               for t in tallies), tallies
+    assert any(t["incarnation"] == 1 and t["recovered"]
+               for t in tallies), tallies
+    assert sum(t["respawns"] for t in tallies) >= 1, tallies
+
+
+def test_tpurun_partial_replace_repairs_members_only():
+    """Partial-communicator replace() (deferred recovery edge a),
+    np=3: procs {0, 1} share a split sub-comm, proc 2 is a non-member
+    bystander.  Proc 1 dies mid-phase; the survivor repairs the
+    SUB-comm with replace() (comm-scoped beacon + CID stream), the
+    reborn proc rejoins via world.replace_partial(), and both members
+    finish an exact phase 2 at full sub size — while the non-member
+    shows zero reconnects/retry-dials/respawns and its world state
+    untouched."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    worker = repo / "tests" / "workers" / "mp_partial_replace_worker.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "3", "--ft",
+           "--respawn", "--cpu-devices", "1",
+           "--mca", "btl", "tcp",
+           "--mca", "dcn_recv_timeout", "8",
+           "--mca", "dcn_cts_timeout", "8",
+           "--mca", "dcn_connect_timeout", "4",
+           str(worker)]
+    res = subprocess.run(cmd, capture_output=True, timeout=240,
+                         cwd=str(repo), env=env)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    assert "respawning (incarnation 1)" in out
+    tallies = {t["proc"]: t for t in (
+        json.loads(line.split("PARTIAL_TALLY ", 1)[1])
+        for line in out.splitlines() if "PARTIAL_TALLY" in line)}
+    assert set(tallies) == {0, 1, 2}, out
+    # members repaired: full sub size, exact phase 2, survivor
+    # accounted the restoration, reborn rejoined at incarnation 1
+    for p in (0, 1):
+        t = tallies[p]
+        assert t["participated"] and t["sub_size"] == 2, t
+        assert t["post"] == t["ops"], t
+        assert t["sub_name"].endswith(".replaced"), t
+    assert tallies[0]["respawns"] >= 1, tallies[0]
+    assert tallies[1]["incarnation"] == 1, tallies[1]
+    # non-member undisturbed: no participation, no transport churn
+    t2 = tallies[2]
+    assert not t2["participated"] and t2["sub_size"] == 0, t2
+    assert t2["reconnects"] == 0 and t2["retry_dials"] == 0, t2
+    assert t2["respawns"] == 0, t2
+
+
+def test_replace_partial_guards():
+    """Dispatch guards: a survivor (rejoined context) cannot call
+    replace_partial — that is the reborn proc's rejoin — and a partial
+    comm with no failed member has nothing to replace."""
+    import types
+
+    from ompi_tpu.api.multiproc import MultiProcComm
+
+    comm = object.__new__(MultiProcComm)
+    comm.nprocs, comm.proc, comm.name = 2, 0, "pr_guard"
+    comm.procctx = types.SimpleNamespace(rejoined=True, incarnation=0)
+    with pytest.raises(MPICommError, match="replace_partial"):
+        comm.replace_partial()
+    # survivors-only guard on the partial leg: a not-yet-rejoined
+    # (reborn) context must be pointed at replace_partial instead
+    comm.procctx = types.SimpleNamespace(rejoined=False, incarnation=1)
+    with pytest.raises(MPICommError, match="replace_partial"):
+        comm._replace_partial("", 1.0)

@@ -1,0 +1,114 @@
+"""Partial-communicator ``replace()`` soak worker (np=3, ``tpurun
+--ft --respawn``) — deferred recovery edge (a) end-to-end.
+
+Topology: the world is split so procs {0, 1} share a 2-proc
+sub-communicator and proc 2 is a NON-MEMBER bystander (its color is
+undefined).  Scenario:
+
+* phase 1: the sub members run allreduces on the sub-comm; proc 1
+  SIGKILLs itself mid-phase on its first incarnation;
+* survivor proc 0 catches ``MPIProcFailedError`` and calls
+  ``replace()`` **on the sub-comm**: the partial leg awaits proc 1's
+  respawned incarnation, installs it at the root, and agrees a fresh
+  CID on the comm-scoped ``replace.c<cid>`` stream — proc 2 never
+  participates;
+* the reborn proc 1 sees ``world.respawned`` and calls
+  ``world.replace_partial()`` — the comm-scoped beacon gives it the
+  recipe; no world-level round ever runs;
+* phase 2: both members run exact allreduces on the repaired 2-proc
+  sub-comm at FULL sub size;
+* proc 2 meanwhile does nothing but wait — its tally must show ZERO
+  reconnects/retry-dials/respawns (undisturbed), with its view of the
+  old incarnation still failed (correct: nobody repaired *its* comms).
+
+One ``PARTIAL_TALLY <json>`` line per surviving process.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.api.comm import COLOR_UNDEFINED
+from ompi_tpu.core.errors import MPIProcFailedError, MPIRevokedError
+from ompi_tpu.op import SUM
+
+OPS = int(os.environ.get("PARTIAL_OPS", "6"))
+KILL_AT = int(os.environ.get("PARTIAL_KILL_AT", "2"))
+
+world = api.init()
+p = world.proc
+incarnation = world.procctx.incarnation
+assert world.nprocs == 3 and world.local_size == 1, (world.nprocs,
+                                                     world.local_size)
+
+completed = 0
+post = 0
+participated = False
+sub = None
+
+if world.respawned:
+    # reborn member: the comm-scoped rejoin — no world round exists
+    sub = world.replace_partial()
+    participated = True
+else:
+    subs = world.split([0] if p < 2 else [COLOR_UNDEFINED])
+    sub = subs[0]
+    if p < 2:
+        participated = True
+        assert sub is not None and sub.size == 2, sub
+        try:
+            for i in range(OPS):
+                if p == 1 and incarnation == 0 and i == KILL_AT:
+                    sys.stdout.flush()
+                    sys.stderr.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
+                out = sub.allreduce(np.full((1, 4), i + 1.0), SUM)
+                assert np.allclose(np.asarray(out), 2 * (i + 1.0)), out
+                completed = i + 1
+        except (MPIProcFailedError, MPIRevokedError) as e:
+            print(f"[partial] proc {p} caught {type(e).__name__} after "
+                  f"{completed} ops: {e}", file=sys.stderr, flush=True)
+            sub = sub.replace()
+    else:
+        assert sub is None  # non-member: undefined color
+        # bystander: idle until the members' recovery finishes (the
+        # finalize fence below is the real synchronization point)
+
+if participated:
+    # phase 2: the REPAIRED sub-comm must produce exact full-sub-size
+    # results with clean per-(comm, op) sequence state
+    for i in range(OPS):
+        out = sub.allreduce(np.full((1, 4), 100.0 + i), SUM)
+        assert np.allclose(np.asarray(out), sub.size * (100.0 + i)), out
+        post = i + 1
+    assert sub.size == 2 and sub.nprocs == 2, (sub.size, sub.nprocs)
+
+st = getattr(getattr(world.dcn, "transport", None), "stats", None) or {}
+det = world.procctx.detector
+tally = {
+    "proc": p,
+    "incarnation": incarnation,
+    "participated": participated,
+    "completed": completed,
+    "post": post,
+    "ops": OPS,
+    "sub_size": int(sub.size) if (participated and sub is not None) else 0,
+    "sub_name": (sub.name if (participated and sub is not None) else ""),
+    "respawns": int(st.get("respawns", 0)),
+    "reconnects": int(st.get("reconnects", 0)),
+    "retry_dials": int(st.get("retry_dials", 0)),
+    "detector_failed": sorted(det.failed()) if det is not None else [],
+}
+print("PARTIAL_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
+
+api.finalize()
+print(f"OK partial proc={p} incarnation={incarnation}", flush=True)

@@ -11,6 +11,13 @@ Usage::
     # reproduce exactly (the determinism contract)
     python tools/chaos.py --runs 2 --seed 7 --plan "drop:p=0.05,..."
 
+    # control-plane crash soak: tpud SIGKILLs itself at the Nth
+    # directive (faultsim daemonkill) mid-job; the restart must
+    # re-adopt every worker (zero re-dials), run the journal-recovered
+    # queued job exactly once, and leave zero orphans — same-seed
+    # --runs N must reproduce the tally exactly
+    python tools/chaos.py --daemon-restart --runs 2 --seed 7
+
     # self-check (no subprocesses): plan parsing, decision
     # determinism, transport self-healing, disabled-path state
     python tools/chaos.py --selftest
@@ -265,6 +272,199 @@ def render_respawn(tallies: list[dict]) -> None:
           f"full_size={all(t['size'] == len(tallies) for t in tallies)}")
 
 
+JOB_WORKER = os.path.join(REPO, "tests", "workers",
+                          "serve_job_worker.py")
+
+
+def _spawn_daemon(np_: int, mca: dict, timeout: float = 90.0):
+    """Launch ``tpurun --daemon`` and return (proc, lines, ops_url)."""
+    import threading
+
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--daemon", "--cpu-devices", "1"]
+    for k, v in mca.items():
+        cmd += ["--mca", k, str(v)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    lines: list[str] = []
+
+    def _read():
+        for raw in iter(proc.stdout.readline, b""):
+            lines.append(raw.decode(errors="replace"))
+
+    threading.Thread(target=_read, daemon=True).start()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for line in list(lines):
+            if "[tpud] ops: " in line:
+                url = line.split("[tpud] ops: ", 1)[1].split("/jobs")[0]
+                return proc, lines, url
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    sys.stderr.write("".join(lines))
+    raise SystemExit("daemon never printed its ops URL")
+
+
+def _journal_pids(journal: str) -> list[int]:
+    pids = {}
+    try:
+        with open(journal) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("ev") == "spawn":
+                    pids[int(rec.get("rank", -1))] = int(
+                        rec.get("pid", 0))
+                elif rec.get("ev") == "shutdown":
+                    pids.clear()
+    except OSError:
+        pass
+    return [p for p in pids.values() if p > 0]
+
+
+def run_daemon_restart_soak(np_: int, seed: int, kill_at: int,
+                            extra_mca: list[str],
+                            timeout: float) -> dict:
+    """The restart-hygiene headline, deterministically from one seed:
+    a tpud with ``daemonkill:at=N`` armed SIGKILLs itself at the Nth
+    directive-publish attempt — mid-job for the rank-1 submission, the
+    rank-0 job still queued in the journal.  The operator restart (no
+    fault plan) must re-adopt every resident worker with ZERO re-dials
+    (flat reconnect/retry_dials in the completion records, incarnation
+    0 — the warm CIDs never went away), run the journal-recovered
+    queued job exactly once (journal publish count per id == 1: the
+    cursor dedup, not luck), and leave zero orphaned processes after
+    the final shutdown."""
+    import tempfile
+
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve import state as _sstate
+
+    tmp = tempfile.mkdtemp(prefix="tpud-chaos-")
+    pidfile = os.path.join(tmp, "tpud.pid")
+    journal = pidfile + ".journal"
+    base_mca = {
+        "btl": "tcp",
+        "serve_pidfile": pidfile,
+        "serve_reattach_timeout": "30",
+        "dcn_recv_timeout": "8",
+        "dcn_cts_timeout": "8",
+        "dcn_connect_timeout": "4",
+    }
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        base_mca[k] = v
+    t0 = time.time()
+    d1 = d2 = None
+    lines1: list[str] = []
+    lines2: list[str] = []
+    try:
+        d1, lines1, url1 = _spawn_daemon(np_, {
+            **base_mca,
+            "faultsim_enable": "1",
+            "faultsim_seed": str(seed),
+            "faultsim_plan": f"daemonkill:at={kill_at}"})
+        # job A holds proc 0 mid-run across the crash; job B's publish
+        # is the Nth directive attempt that pulls the trigger
+        ja = client.submit(url1, JOB_WORKER, tenant="alice", nprocs=1,
+                           env={"SERVE_SLEEP": "6"})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if client.status(url1, ja["id"]).get("state") == "running":
+                break
+            time.sleep(0.1)
+        jb = client.submit(url1, JOB_WORKER, tenant="bob", nprocs=1)
+        d1.wait(timeout=60)
+        if d1.returncode == 0:
+            raise SystemExit(
+                "daemonkill never fired (daemon exited cleanly):\n"
+                + "".join(lines1))
+        worker_pids = _journal_pids(journal)
+        survivors = [p for p in worker_pids if _sstate.pid_alive(p)]
+        replay = _sstate.Journal.replay(journal)
+        d2, lines2, url2 = _spawn_daemon(np_, base_mca)
+        ra = client.wait(url2, ja["id"], timeout=90)
+        rb = client.wait(url2, jb["id"], timeout=90)
+        st = client.status(url2)
+        flat = all(
+            rec["dials_before"] == rec["dials_after"]
+            for r in (ra, rb) for rec in (r.get("ranks") or {}).values())
+        incs = [int(st["procs"][str(p)]["incarnation"])
+                for p in range(np_)]
+        adopted = sum(1 for line in lines2 if "re-adopted rank" in line)
+        pubs: dict[str, int] = {}
+        with open(journal) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (rec.get("ev") == "publish"
+                        and rec.get("d", {}).get("kind", "job") == "job"):
+                    jid = rec["d"].get("id", "?")
+                    pubs[jid] = pubs.get(jid, 0) + 1
+        client.shutdown(url2)
+        rc2 = d2.wait(timeout=60)
+        time.sleep(0.5)
+        orphans = [p for p in _journal_pids(journal) + worker_pids
+                   if _sstate.pid_alive(p)]
+        tally = {
+            "injected": {"daemonkill": 1},
+            "directives_before_kill": int(replay["cursor"]),
+            "queued_in_journal": len(replay["queued"]),
+            "survivors_at_restart": len(survivors),
+            "adopted": adopted,
+            "incarnations": incs,
+            "jobs": {ja["id"]: ra["state"], jb["id"]: rb["state"]},
+            "publishes": pubs,
+            "flat_dials": flat,
+            "restart_rc": rc2,
+            "orphans": len(orphans),
+        }
+        ok = (ra["state"] == "done" and rb["state"] == "done"
+              and flat and incs == [0] * np_ and adopted == np_
+              and all(n == 1 for n in pubs.values())
+              and rc2 == 0 and not orphans)
+        if not ok:
+            sys.stderr.write("".join(lines1))
+            sys.stderr.write("".join(lines2))
+            raise SystemExit(f"daemon-restart soak failed: {tally}")
+        print(f"daemon-restart soak: np={np_} seed={seed} "
+              f"kill_at={kill_at} wall={time.time() - t0:.1f}s")
+        return tally
+    finally:
+        for d in (d1, d2):
+            if d is not None and d.poll() is None:
+                d.kill()
+        for p in _journal_pids(journal):
+            if _sstate.pid_alive(p):
+                try:
+                    os.kill(p, 9)
+                except OSError:
+                    pass
+
+
+def render_daemon_restart(tally: dict) -> None:
+    print(f"  directives before kill: {tally['directives_before_kill']}"
+          f"   journal-queued: {tally['queued_in_journal']}"
+          f"   survivors: {tally['survivors_at_restart']}")
+    print(f"  re-adopted: {tally['adopted']}   incarnations: "
+          f"{tally['incarnations']}   flat dials: {tally['flat_dials']}")
+    print("  jobs: " + ", ".join(f"{j}={s}"
+                                 for j, s in sorted(tally["jobs"].items()))
+          + "   publishes: "
+          + ", ".join(f"{j}x{n}"
+                      for j, n in sorted(tally["publishes"].items())))
+    print(f"  final shutdown rc={tally['restart_rc']}   orphans: "
+          f"{tally['orphans']}")
+
+
 # -- selftest ----------------------------------------------------------
 
 
@@ -398,9 +598,36 @@ def main(argv: list[str] | None = None) -> int:
                     "itself mid-collective under tpurun --ft --respawn;"
                     " the job must complete at FULL size (replace()) "
                     "with respawns >= 1")
+    ap.add_argument("--daemon-restart", action="store_true",
+                    help="control-plane crash soak: a tpud armed with "
+                    "daemonkill:at=N SIGKILLs itself mid-job; the "
+                    "restart must re-adopt every worker (zero "
+                    "re-dials), run the journal-recovered job exactly "
+                    "once, and leave zero orphans")
+    ap.add_argument("--kill-at", type=int, default=2,
+                    help="daemonkill directive index for "
+                    "--daemon-restart (default 2: mid-job for the "
+                    "first submission)")
     ns = ap.parse_args(argv)
     if ns.selftest:
         return selftest()
+    if ns.daemon_restart:
+        baseline = None
+        for run in range(ns.runs):
+            tally = run_daemon_restart_soak(ns.np_, ns.seed, ns.kill_at,
+                                            ns.mca, ns.timeout)
+            render_daemon_restart(tally)
+            if baseline is None:
+                baseline = tally
+            elif tally != baseline:
+                raise SystemExit(
+                    f"DETERMINISM VIOLATION: run {run + 1} tallied "
+                    f"{tally} but run 1 tallied {baseline} "
+                    f"(same seed {ns.seed})")
+            elif ns.runs > 1:
+                print(f"run {run + 1}: restart tally reproduces run 1 "
+                      f"exactly (seed {ns.seed})")
+        return 0
     baseline = None
     for run in range(ns.runs):
         if ns.respawn:

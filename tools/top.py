@@ -75,6 +75,21 @@ def render(state: dict, prev: dict | None = None, url: str = "",
           f"frames={state.get('frames', 0)} "
           f"nprocs={state.get('nprocs', len(procs))}  "
           f"{time.strftime('%H:%M:%S')}", file=out)
+    daemon = state.get("daemon")
+    if daemon:
+        # tpud control-plane line: a restarted daemon shows a bumped
+        # generation, its journal depth draining, and the ranks still
+        # in the re-adoption window
+        adopting = daemon.get("adopting") or []
+        print(f"daemon: pid {daemon.get('pid')} "
+              f"gen {daemon.get('generation')} "
+              f"{'crash-safe' if daemon.get('crash_safe') else 'volatile'}"
+              f"  journal {daemon.get('journal_depth', 0)} "
+              f"(queued {daemon.get('queued', 0)} + in-flight "
+              f"{daemon.get('outstanding', 0)})"
+              + (f"  ADOPTING {adopting}" if adopting else "")
+              + ("  DRAINING" if daemon.get("draining") else ""),
+              file=out)
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
           f"{'sdep':>5}{'coal':>6}"
@@ -254,6 +269,22 @@ def selftest() -> int:
         text = buf.getvalue()
         assert "top stragglers" in text and "rank 1" in text, text
         assert "allreduce" in text and "stall causes" in text, text
+        # tpud extension: a daemon host publishes liveness + journal
+        # depth through extra_state; the renderer gives it a line
+        agg.extra_state = lambda: {"daemon": {
+            "pid": 4242, "generation": 2, "crash_safe": True,
+            "queued": 1, "outstanding": 2, "journal_depth": 3,
+            "adopting": [1], "procs": {"0": "active", "1": "adopting"},
+            "draining": False}}
+        dstate = fetch(agg.url)
+        assert dstate["daemon"]["generation"] == 2, dstate
+        buf = io.StringIO()
+        render(dstate, prev=None, url=agg.url, out=buf)
+        dtext = buf.getvalue()
+        assert ("daemon: pid 4242 gen 2 crash-safe" in dtext
+                and "journal 3" in dtext
+                and "ADOPTING [1]" in dtext), dtext
+        agg.extra_state = None
         # /history serves the JSONL ring
         with urllib.request.urlopen(agg.url + "/history",
                                     timeout=5) as r:

@@ -11,7 +11,13 @@ live aggregator's HTTP endpoint (printed at start).
     python tools/tpud_ctl.py --url http://... shutdown
 
 Equivalent to ``tpurun --daemon``; knobs are the ``serve_*`` MCA vars
-(``SERVING_VARS`` in core/var.py).
+(``SERVING_VARS`` in core/var.py).  ``--mca serve_pidfile <path>``
+arms the crash-safe control plane: stale-lock takeover, a journaled
+job stream, and worker re-adoption across daemon restarts — starting
+a second daemon against a LIVE pidfile is a clean one-line refusal.
+
+    python tools/tpud.py -np 2 --cpu-devices 1 --mca btl tcp \
+        --mca serve_pidfile /tmp/tpud.pid
 """
 
 from __future__ import annotations

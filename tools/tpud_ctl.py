@@ -10,9 +10,15 @@ endpoint with the serve routes mounted):
     python tools/tpud_ctl.py --url ... drain
     python tools/tpud_ctl.py --url ... scale 1
     python tools/tpud_ctl.py --url ... shutdown
+    python tools/tpud_ctl.py --pidfile /tmp/tpud.pid status
     python tools/tpud_ctl.py --selftest
 
-``--url`` defaults to ``$TPUD_URL``.  ``--selftest`` exercises the
+``--url`` defaults to ``$TPUD_URL``; ``--pidfile`` (or
+``$TPUD_PIDFILE``) resolves the URL from a live daemon's pidfile and
+reports + reaps a stale one.  Every command is restart-idempotent
+against a dead daemon: ``shutdown``/``drain`` are one-line no-ops
+(rc 0), the rest fail with one line (rc 1) — never a traceback.
+``--selftest`` exercises the
 whole control plane — submit/admission/fairness/drain/shutdown over
 real HTTP against a workerless daemon — and is wired into tier-1 like
 ``top.py``/``chaos.py``.
@@ -43,6 +49,8 @@ def cmd_submit(url: str, ns) -> int:
                             tenant=ns.tenant, nprocs=ns.nprocs,
                             env=env or None)
     except client.ServeError as e:
+        if e.status == 0:
+            raise  # unreachable daemon: the dispatcher's one-liner
         print(f"rejected ({e.status}): {e}", file=sys.stderr)
         return 1
     if ns.no_wait:
@@ -158,11 +166,51 @@ def selftest() -> int:
         d.server.close()
 
 
+def _pidfile_state(path: str) -> tuple[str, dict | None]:
+    """Classify a pidfile: ('live', info) when its daemon answers
+    signal 0, ('stale', info) when the pid is dead (the record is
+    returned for the reap message), ('absent', None) otherwise."""
+    from ompi_tpu.serve import state as _state
+
+    info = _state.read_pidfile(path)
+    if info is None:
+        return "absent", None
+    if _state.pid_alive(int(info.get("pid", 0))):
+        return "live", info
+    return "stale", info
+
+
+def _resolve_url(ns) -> str:
+    """--url wins; otherwise a live pidfile supplies it.  A stale
+    pidfile is reported and reaped HERE (the operator's `status`
+    against a dead daemon must say so in one line, not traceback)."""
+    if ns.url:
+        return ns.url
+    if not ns.pidfile:
+        return ""
+    kind, info = _pidfile_state(ns.pidfile)
+    if kind == "live":
+        return str(info.get("url", ""))
+    if kind == "stale":
+        print(f"tpud: stale pidfile {ns.pidfile} (pid "
+              f"{info.get('pid')} dead) — reaping it")
+        try:
+            os.unlink(ns.pidfile)
+        except OSError:
+            pass
+    return ""
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="tpud_ctl",
                                  description=__doc__.splitlines()[0])
     ap.add_argument("--url", default=os.environ.get("TPUD_URL", ""),
                     help="daemon ops URL (default $TPUD_URL)")
+    ap.add_argument("--pidfile",
+                    default=os.environ.get("TPUD_PIDFILE", ""),
+                    help="daemon pidfile (default $TPUD_PIDFILE): "
+                         "supplies --url from a live daemon's record; "
+                         "a stale pidfile is reported and reaped")
     ap.add_argument("--selftest", action="store_true",
                     help="control-plane acceptance against a "
                          "workerless in-process daemon")
@@ -189,17 +237,43 @@ def main(argv: list[str] | None = None) -> int:
         return selftest()
     if not ns.cmd:
         ap.error("a command (or --selftest) is required")
-    if not ns.url:
-        ap.error("--url (or $TPUD_URL) is required")
-    if ns.cmd == "submit":
-        return cmd_submit(ns.url, ns)
-    if ns.cmd == "status":
-        return cmd_status(ns.url, ns)
-    if ns.cmd == "drain":
-        return cmd_simple(ns.url, "drain")
-    if ns.cmd == "shutdown":
-        return cmd_simple(ns.url, "shutdown")
-    return cmd_simple(ns.url, "scale", ns.nprocs)
+    url = _resolve_url(ns)
+    if not url:
+        if ns.cmd == "shutdown" and (ns.pidfile or ns.url):
+            # idempotent stop: nothing is running — that IS the goal
+            print("tpud: no daemon running — shutdown is a no-op")
+            return 0
+        if ns.pidfile and not ns.url:
+            print(f"tpud: no daemon at pidfile {ns.pidfile}",
+                  file=sys.stderr)
+            return 1
+        ap.error("--url (or $TPUD_URL / a live --pidfile) is required")
+    from ompi_tpu.serve.client import ServeError
+
+    try:
+        if ns.cmd == "submit":
+            return cmd_submit(url, ns)
+        if ns.cmd == "status":
+            return cmd_status(url, ns)
+        if ns.cmd == "drain":
+            return cmd_simple(url, "drain")
+        if ns.cmd == "shutdown":
+            return cmd_simple(url, "shutdown")
+        return cmd_simple(url, "scale", ns.nprocs)
+    except ServeError as e:
+        if e.status != 0:
+            print(f"tpud: {e}", file=sys.stderr)
+            return 1
+        # unreachable daemon: one line, clean exit — `shutdown` (and
+        # `drain`) against an already-dead daemon is a no-op success,
+        # everything else reports and fails without a traceback
+        if ns.cmd in ("shutdown", "drain"):
+            print(f"tpud: daemon already down ({url}) — "
+                  f"{ns.cmd} is a no-op")
+            return 0
+        print(f"tpud: daemon unreachable at {url} ({e})",
+              file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":
