@@ -88,7 +88,7 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
         from ompi_tpu.boot.proc import ProcContext
         from .multiproc import MultiProcComm
 
-        pc = ProcContext()
+        pc = ProcContext(local_size=wm.size)
         _world = MultiProcComm(pc, wm, name="MPI_COMM_WORLD")
         _self_comm = Comm(
             Group([_world.local_offset]), wm.submesh([0]), name="MPI_COMM_SELF"

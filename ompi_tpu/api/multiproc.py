@@ -73,6 +73,12 @@ class MultiProcComm(PersistentP2PMixin):
             self.proc_sizes = [
                 int(ctx.kvs.get(f"{ctx.ns}wsize.{p}"))
                 for p in range(self.nprocs)]
+        elif (getattr(ctx, "wsizes", None) is not None
+              and len(ctx.wsizes) == self.nprocs):
+            # sharded modex already collected every rank's size through
+            # the group leader's one bulk scan — no boot collective at
+            # all (the instant-on path)
+            self.proc_sizes = [int(w) for w in ctx.wsizes]
         else:
             sizes = self.dcn.allgather(
                 np.array([local_mesh.size], np.int64), self.cid)
@@ -634,6 +640,25 @@ class MultiProcComm(PersistentP2PMixin):
         lo, hi = self.proc_range(lp)
         ulfm.state(self).failed.update(range(lo, hi))
 
+    def _on_proc_healed(self, root_proc: int) -> None:
+        """Detector heal fan-out: a FALSE-POSITIVE failure mark was
+        retracted (the proc's current incarnation is demonstrably
+        alive) — clear its ranks from this comm's ULFM state so
+        collectives/p2p stop raising about a peer that never died.
+        Revocation is sticky by design: a comm revoked over the false
+        alarm stays revoked (ULFM revoke has no undo)."""
+        from ompi_tpu.ft import ulfm
+
+        st = ulfm.peek(self)
+        if st is None:
+            return
+        lp = self.dcn.local_proc_of(root_proc)
+        if lp is None:
+            return
+        lo, hi = self.proc_range(lp)
+        st.failed.difference_update(range(lo, hi))
+        st.acked.difference_update(range(lo, hi))
+
     def revoke(self) -> None:
         """MPIX_Comm_revoke: poison this comm everywhere — the local
         mark plus a ``rvk`` control frame to every member process (the
@@ -913,7 +938,7 @@ class MultiProcComm(PersistentP2PMixin):
                          incs={str(k): v
                                for k, v in ctx.incarnations.items()}))
             proposals = [int(c) for c in
-                         root.sub(members).allgather_obj(
+                         root.sub(members).allgather_obj_hub(
                              int(_peek_cid()), stream)]
         return proposals
 
@@ -952,7 +977,7 @@ class MultiProcComm(PersistentP2PMixin):
         ctx.incarnations[self.proc] = inc
         members_round = sorted(int(m) for m in info["round"])
         proposals = [int(c) for c in
-                     self.dcn.sub(members_round).allgather_obj(
+                     self.dcn.sub(members_round).allgather_obj_hub(
                          int(_peek_cid()), str(info["stream"]))]
         recipe = {k: info[k] for k in ("members", "procs", "skey",
                                        "name")}
@@ -1021,7 +1046,11 @@ class MultiProcComm(PersistentP2PMixin):
         participant, mid-job or fresh-booted."""
         eng = (self.dcn if len(members) == self.nprocs
                else self.dcn.sub(members))
-        infos = eng.allgather_obj(int(_peek_cid()), f"replace.{p}.i{inc}")
+        # hub pattern: the round runs on a degraded mesh — 2(P−1)
+        # frames through the minimum member instead of a full-mesh
+        # dial storm (np≥16 cascade hazard)
+        infos = eng.allgather_obj_hub(int(_peek_cid()),
+                                      f"replace.{p}.i{inc}")
         return [int(c) for c in infos]
 
     def _integrate_respawn(self, p: int, inc: int, addr: str) -> None:
@@ -1031,10 +1060,10 @@ class MultiProcComm(PersistentP2PMixin):
         account the restoration (``respawns`` counter, flight record,
         trace instant)."""
         root = self.dcn._root_engine()
-        addrs = list(root.addresses)
-        addrs[p] = addr
-        root.set_addresses(addrs)
-        root.note_proc_recovered(p)
+        root.update_address(p, addr)
+        # the incarnation seeds the detector's versioned-gossip floor:
+        # late flr records about the corpse (inc < this) are stale
+        root.note_proc_recovered(p, incarnation=int(inc))
         from ompi_tpu.metrics import flight as _flight
 
         # the delivered-seq watermark for the CORPSE's identity (the

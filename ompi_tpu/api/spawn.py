@@ -276,7 +276,10 @@ def spawn(argv: Sequence[str], maxprocs: int, root: int = 0):
     child_addrs = [ctx.kvs.get(f"{ns}dcn.{i}", timeout=120)
                    for i in range(maxprocs)]
     child_sizes = ctx.kvs.get(f"{ns}csizes", timeout=120)
-    parent_addrs = list(ctx.engine.addresses)
+    # indexed access, not list(): a lazy AddressTable's unresolved
+    # slots are None under plain iteration — the join world needs
+    # every peer resolved (sharded modex, nprocs > ft_group_size)
+    parent_addrs = [ctx.engine.addresses[p] for p in range(ctx.nprocs)]
     join = ctx.engine.join(parent_addrs + child_addrs, ctx.proc)
     merged = _join_world(world, join, ns,
                          list(world.proc_sizes) + list(child_sizes))
@@ -311,7 +314,8 @@ def get_parent():
     parent_addrs = [ctx.kvs.get(f"{pns}dcn.{p}", timeout=120)
                     for p in range(pn)]
     parent_sizes = ctx.kvs.get(f"{ns}psizes", timeout=120)
-    child_addrs = list(ctx.engine.addresses)
+    # resolving indexed access (see parent_addrs above)
+    child_addrs = [ctx.engine.addresses[p] for p in range(ctx.nprocs)]
     join = ctx.engine.join(parent_addrs + child_addrs,
                          pn + ctx.proc)
     merged = _join_world(world, join, ns,

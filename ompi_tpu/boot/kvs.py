@@ -91,6 +91,15 @@ class KVSServer:
                         val = self._data.get(msg["key"])
                         found = msg["key"] in self._data
                     _send_frame(conn, {"ok": found, "value": val})
+                elif op == "get_prefix":
+                    # bulk scan (the sharded-modex leg: one group
+                    # leader pulls every 'dcn.' endpoint in ONE op
+                    # instead of P ranks each issuing P-1 gets)
+                    with self._cond:
+                        pfx = msg["prefix"]
+                        out = {k: v for k, v in self._data.items()
+                               if k.startswith(pfx)}
+                    _send_frame(conn, {"ok": True, "value": out})
                 elif op == "fence":
                     name, rank, size = msg["name"], msg["rank"], msg["size"]
                     deadline = time.monotonic() + msg.get("timeout", 120.0)
@@ -150,6 +159,10 @@ class KVSClient:
 
     def __init__(self, address: str):
         self._lock = threading.Lock()
+        #: per-op call counters — the boot-scaling signature the np≥16
+        #: scale soak asserts on (sharded modex: per-rank 'get' stays
+        #: O(1)+lazy instead of P−1)
+        self.ops: dict[str, int] = {}
         self._dial(address)
 
     def _dial(self, address: str) -> None:
@@ -172,6 +185,8 @@ class KVSClient:
 
     def _call(self, msg: Any) -> Any:
         with self._lock:
+            op = msg.get("op", "?")
+            self.ops[op] = self.ops.get(op, 0) + 1
             _send_frame(self._sock, msg)
             return _recv_frame(self._sock)
 
@@ -185,6 +200,15 @@ class KVSClient:
         if not r.get("ok"):
             raise KeyError(key)
         return r["value"]
+
+    def get_prefix(self, prefix: str) -> dict[str, Any]:
+        """Bulk non-blocking scan of every key under ``prefix`` (≈ the
+        PMIx "instant-on" rack-scale modex pull): one wire round-trip
+        however many keys match."""
+        r = self._call({"op": "get_prefix", "prefix": prefix})
+        if not r.get("ok"):
+            raise ConnectionError(f"kvs get_prefix failed: {r}")
+        return dict(r["value"] or {})
 
     def fence(self, name: str, rank: int, size: int, timeout: float = 120.0) -> None:
         """Collective barrier over all ranks (≈ PMIx_Fence)."""

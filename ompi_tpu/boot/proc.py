@@ -4,8 +4,20 @@
 modex of SURVEY.md §3.2: a worker launched by ``tpurun`` reads its
 process index and the coordinator address from env vars, connects the
 KVS, publishes its DCN endpoint (``PMIx_Put`` + ``PMIx_Commit``),
-fences, and collects peer endpoints (lazy ``PMIx_Get`` collapsed to an
-eager exchange — process counts are small).
+fences, and collects peer endpoints.
+
+The collection is **sharded and lazy** on the Python transports (the
+PMIx "instant-on" shape): ranks are partitioned into the same groups
+the hierarchical failure detector uses (host id when known, else
+``ft_group_size`` chunks); each group's *leader* pulls every endpoint
+with ONE ``get_prefix`` scan and publishes its group's slice as a
+bundle; members issue ONE get for the bundle and resolve any peer
+outside their group lazily on first send (one KVS get, cached).  Boot
+KVS traffic drops from O(P²) per-rank gets to O(P + groups·P), and
+the fence gates only the puts — never on every rank having pulled
+every address.  The native C plane (and any reborn incarnation, whose
+boot-time bundle may be stale for previously-reborn peers) keeps the
+eager per-peer gather.
 """
 
 from __future__ import annotations
@@ -30,6 +42,10 @@ ENV_INCARNATION = "OMPI_TPU_INCARNATION"
 #: top of the boot, so every await-respawn deadline switches from
 #: ft_respawn_timeout to ft_remote_respawn_timeout
 ENV_RSH = "OMPI_TPU_RSH"
+#: comma-separated host index per rank (tpurun publishes it whenever a
+#: host map exists): detector groups and the sharded modex partition
+#: by real host instead of ft_group_size chunks
+ENV_HOST_IDS = "OMPI_TPU_HOST_IDS"
 
 
 def respawn_timeout(store) -> float:
@@ -49,7 +65,7 @@ def launched_by_tpurun() -> bool:
 class ProcContext:
     """This process's place in a tpurun job."""
 
-    def __init__(self):
+    def __init__(self, local_size: int | None = None):
         self.proc = int(os.environ[ENV_PROC])
         self.nprocs = int(os.environ[ENV_NPROCS])
         self.ns = os.environ.get(ENV_NS, "")
@@ -81,53 +97,60 @@ class ProcContext:
             # aborts on unparseable MCA values; so do we)
             params = comp.params(ctx.store)
         self.engine = self._make_engine(params)
-        self.kvs.put(f"{self.ns}dcn.{self.proc}", self.engine.transport.address)
+        addr = self.engine.transport.address
+        self.kvs.put(f"{self.ns}dcn.{self.proc}", addr)
+        #: per-proc local-rank counts, filled by the sharded modex when
+        #: api.init passed ``local_size`` — lets MultiProcComm skip the
+        #: boot allgather entirely (no boot collective: instant-on)
+        self.wsizes: list[int] | None = None
+        if local_size is not None:
+            self.kvs.put(f"{self.ns}wsize.{self.proc}", int(local_size))
         if self.incarnation:
             # rebirth rendezvous: the incarnation-suffixed address key
             # plus the incarnation beacon survivors' replace() polls —
             # the plain dcn.<proc> key still holds the CORPSE's address
             # in their caches until replace() refreshes it
             self.kvs.put(f"{self.ns}dcn.{self.proc}.i{self.incarnation}",
-                         self.engine.transport.address)
+                         addr)
             self.kvs.put(f"{self.ns}inc.{self.proc}", self.incarnation)
         # the modex fence is idempotent for a reborn proc (the fence
         # set already contains every rank), so this returns instantly
         # on incarnation > 0 — by design: survivors are mid-job, not
-        # waiting at a barrier
+        # waiting at a barrier.  It gates only the PUTS above — never
+        # on any rank having pulled any address.
         self.kvs.fence(f"{self.ns}modex", self.proc, self.nprocs)
-        addresses = [self.kvs.get(f"{self.ns}dcn.{p}")
-                     for p in range(self.nprocs)]
-        # wire-plane agreement: the published address reveals each
-        # peer's plane ("ntv:" = libtpudcn framing).  A mixed job (one
-        # host lacking the C++ toolchain, a per-process fallback) must
-        # abort HERE with a clear message — native frames against a
-        # Python endpoint would otherwise hang the first collective.
-        mine = addresses[self.proc].startswith("ntv:")
-        mixed = [p for p, a in enumerate(addresses)
-                 if a.startswith("ntv:") != mine]
-        if mixed:
-            from ompi_tpu.core.errors import MPIInternalError
+        # detector-group topology (shared with the sharded modex and
+        # the telemetry relays): host ids when the launcher published
+        # a map, else ft_group_size chunks
+        from ompi_tpu.ft.detector import (FtDetectorComponent,
+                                          HeartbeatDetector,
+                                          compute_groups, parse_host_ids)
 
-            raise MPIInternalError(
-                f"DCN wire-plane mismatch: proc {self.proc} uses the "
-                f"{'native' if mine else 'Python'} transport but procs "
-                f"{mixed} published the other plane (a host without "
-                f"the C++ toolchain?); force one with --mca btl "
-                f"tcp|sm|bml on every host"
-            )
-        self.engine.set_addresses(addresses)
+        ftp = FtDetectorComponent().params(ctx.store)
+        self.hosts = parse_host_ids(os.environ.get(ENV_HOST_IDS, ""),
+                                    self.nprocs)
+        # mirror the detector's gate exactly (<= 0 collapses to ONE
+        # group): `or` alone would turn a negative into singleton
+        # groups and break the shared-topology invariant
+        gsz = ftp["group_size"] if ftp["group_size"] > 0 else self.nprocs
+        self.groups = compute_groups(self.nprocs, gsz, self.hosts)
+        self.group = next(g for g in self.groups if self.proc in g)
+        self._mine_native = addr.startswith("ntv:")
+        if (self._mine_native or self.nprocs == 1 or self.incarnation
+                or local_size is None):
+            self._modex_eager()
+        else:
+            self._modex_sharded(local_size)
         # failure detector (tpurun --ft / --mca ft_detector_enable 1):
-        # heartbeats + gossip; detections fan out to every registered
-        # communicator's ULFM state (SURVEY.md §5 failure detection)
+        # hierarchical heartbeats + versioned gossip; detections fan
+        # out to every registered communicator's ULFM state (SURVEY.md
+        # §5 failure detection)
         import threading
         import weakref
 
         self._ft_comms: "weakref.WeakSet" = weakref.WeakSet()
         self._ft_lock = threading.Lock()
         self.detector = None
-        from ompi_tpu.ft.detector import FtDetectorComponent, HeartbeatDetector
-
-        ftp = FtDetectorComponent().params(ctx.store)
         if ftp["enable"] and self.nprocs > 1:
             # a reborn proc's peers stay silent toward it until their
             # replace() clears its failed mark — grace the first
@@ -138,9 +161,98 @@ class ProcContext:
                 grace = respawn_timeout(ctx.store)
             self.detector = HeartbeatDetector(
                 self.engine, period=ftp["period"], timeout=ftp["timeout"],
-                grace=grace,
+                grace=grace, group_size=ftp["group_size"],
+                hosts=self.hosts, digest=ftp["digest"],
+                incarnation=self.incarnation,
             )
             self.detector.on_failure(self._fan_out_failure)
+            self.detector.on_heal(self._fan_out_heal)
+
+    # -- modex (eager + sharded legs) ------------------------------------
+
+    def _check_plane(self, pairs) -> None:
+        """Wire-plane agreement: the published address reveals each
+        peer's plane ("ntv:" = libtpudcn framing).  A mixed job (one
+        host lacking the C++ toolchain, a per-process fallback) must
+        abort with a clear message — native frames against a Python
+        endpoint would otherwise hang the first collective."""
+        mixed = sorted(p for p, a in pairs
+                       if a.startswith("ntv:") != self._mine_native)
+        if mixed:
+            from ompi_tpu.core.errors import MPIInternalError
+
+            raise MPIInternalError(
+                f"DCN wire-plane mismatch: proc {self.proc} uses the "
+                f"{'native' if self._mine_native else 'Python'} "
+                f"transport but procs {mixed} published the other "
+                f"plane (a host without the C++ toolchain?); force "
+                f"one with --mca btl tcp|sm|bml on every host"
+            )
+
+    def _modex_eager(self) -> None:
+        """The pre-hierarchical gather: P−1 gets per rank.  Kept for
+        the native C plane (tdcn_set_addresses needs the full table),
+        single-proc jobs, reborn incarnations (a boot-time bundle may
+        be stale for previously-reborn peers), and direct ProcContext
+        construction without a local size."""
+        addresses = [self.kvs.get(f"{self.ns}dcn.{p}")
+                     for p in range(self.nprocs)]
+        self._check_plane(enumerate(addresses))
+        self.engine.set_addresses(addresses)
+
+    def _resolve_addr(self, p: int) -> str:
+        """Lazy modex get — first send to an out-of-group peer."""
+        a = self.kvs.get(f"{self.ns}dcn.{p}")
+        self._check_plane([(p, a)])
+        return a
+
+    def _modex_sharded(self, local_size: int) -> None:
+        """The instant-on leg: the group leader's ONE ``get_prefix``
+        scan primes a per-group bundle (own-group addresses + every
+        rank's local size); members issue ONE get for it; everything
+        else resolves lazily on first send (:class:`~ompi_tpu.dcn.
+        collops.AddressTable`).  A leader that died at boot degrades
+        members to the eager gather after the bundle get times out."""
+        from ompi_tpu.dcn.collops import AddressTable
+
+        gi = self.groups.index(self.group)
+        key = f"{self.ns}modex.g{gi}"
+        primed: dict[int, str] = {}
+        if self.proc == self.group[0]:
+            scan = self.kvs.get_prefix(f"{self.ns}dcn.")
+            base = len(f"{self.ns}dcn.")
+            allmap = {int(k[base:]): v for k, v in scan.items()
+                      if k[base:].isdigit()}
+            wscan = self.kvs.get_prefix(f"{self.ns}wsize.")
+            wbase = len(f"{self.ns}wsize.")
+            wsizes = {int(k[wbase:]): int(v) for k, v in wscan.items()
+                      if k[wbase:].isdigit()}
+            self._check_plane(sorted(allmap.items()))
+            self.kvs.put(key, {
+                "addrs": {str(p): allmap[p] for p in self.group
+                          if p in allmap},
+                "wsizes": {str(p): wsizes[p] for p in sorted(wsizes)},
+            })
+            primed = allmap  # the leader paid for the full scan: keep it
+            self.wsizes = ([wsizes[p] for p in range(self.nprocs)]
+                           if len(wsizes) == self.nprocs else None)
+        else:
+            try:
+                bundle = self.kvs.get(key)
+                primed = {int(p): a
+                          for p, a in (bundle.get("addrs") or {}).items()}
+                ws = {int(p): int(w)
+                      for p, w in (bundle.get("wsizes") or {}).items()}
+                self.wsizes = ([ws[p] for p in range(self.nprocs)]
+                               if len(ws) == self.nprocs else None)
+                self._check_plane(sorted(primed.items()))
+            except (KeyError, ValueError):
+                # group leader never published (died at boot?): degrade
+                self._modex_eager()
+                return
+        primed[self.proc] = self.engine.transport.address
+        self.engine.set_addresses(
+            AddressTable(self.nprocs, self._resolve_addr, primed))
 
     def _make_engine(self, params: dict):
         """Engine selection: the native C++ data plane when the btl
@@ -175,6 +287,18 @@ class ProcContext:
             comms = list(self._ft_comms)
         for comm in comms:
             comm._on_proc_failed(root_proc)
+
+    def _fan_out_heal(self, root_proc: int) -> None:
+        """False-positive heal: the un-fail fan-out — every registered
+        communicator's ULFM failed marks for the proc's ranks clear,
+        so per-op guards stop raising about a peer that was never
+        actually dead."""
+        with self._ft_lock:
+            comms = list(self._ft_comms)
+        for comm in comms:
+            heal = getattr(comm, "_on_proc_healed", None)
+            if heal is not None:
+                heal(root_proc)
 
     def register_comm(self, comm) -> None:
         """Track a MultiProcComm for failure fan-out; replay known

@@ -28,7 +28,8 @@ import sys
 import threading
 
 from .kvs import KVSServer
-from .proc import ENV_INCARNATION, ENV_KVS, ENV_NPROCS, ENV_PROC, ENV_RSH
+from .proc import (ENV_HOST_IDS, ENV_INCARNATION, ENV_KVS, ENV_NPROCS,
+                   ENV_PROC, ENV_RSH)
 
 
 def _forward(stream, prefix: str, out) -> None:
@@ -271,6 +272,16 @@ def run_job(
     # OMPI_TPU_-prefixed, so _remote_cmd bakes it into the payload)
     rsh_job = bool(rank_host) and any(
         not _is_local_host(h) for h in rank_host)
+    # rank→host map for the workers: detector groups, the sharded
+    # modex, and the telemetry relays partition by REAL host when the
+    # launcher knows one (the env key is OMPI_TPU_-prefixed so the rsh
+    # payload carries it to remote ranks)
+    host_ids = ""
+    if rank_host:
+        order: dict[str, int] = {}
+        for h in rank_host:
+            order.setdefault(h, len(order))
+        host_ids = ",".join(str(order[h]) for h in rank_host)
     try:
         for rank in range(np_):
             env = worker_env(
@@ -281,6 +292,8 @@ def run_job(
             )
             if rsh_job:
                 env[ENV_RSH] = "1"
+            if host_ids:
+                env[ENV_HOST_IDS] = host_ids
             cmd = worker_cmd(argv)
             target = rank_host[rank] if rank_host else None
             # plm/rsh: _final_cmd reproduces the worker env on the

@@ -395,6 +395,13 @@ OBSERVABILITY_VARS = (
      "last frame)"),
     ("telemetry", "", "history", 256, "int",
      "Frames retained in the aggregator's /history JSONL ring"),
+    ("telemetry", "", "relay", False, "bool",
+     "Per-group telemetry relays (the np>=16 fan-in fix): each "
+     "detector group's leader rank hosts a batching relay; group "
+     "members ship their frames there and the relay forwards one "
+     "batched frame per interval to the root aggregator, so the "
+     "root's ingest socket sees O(groups) connections instead of "
+     "O(P).  Off (default): every rank dials the root directly"),
 )
 
 
@@ -446,6 +453,22 @@ ROBUSTNESS_VARS = (
      "workers with OMPI_TPU_RSH) — a remote relaunch pays the launch-"
      "agent round-trip on top of the boot, so the local deadline is "
      "too tight"),
+    ("ft", "", "group_size", 8, "int",
+     "Hierarchical failure-detection group width: ranks partition "
+     "into groups of this size (or by host id when the launcher "
+     "published OMPI_TPU_HOST_IDS); members heartbeat only their "
+     "group's leader + successor, leaders heartbeat each other — "
+     "per-process control traffic stays O(group + groups) instead of "
+     "O(P).  The same groups shard the boot modex and place the "
+     "telemetry relays.  <= 0 collapses to one group (full-mesh "
+     "heartbeats, the pre-hierarchical shape)"),
+    ("ft", "", "gossip_digest", True, "bool",
+     "Piggyback an anti-entropy digest of the versioned failure-"
+     "record set on leader<->leader heartbeats: a digest mismatch "
+     "triggers one flrsync record exchange, so survivor knowledge "
+     "converges in O(log groups) periods even when a gossip frame "
+     "was lost.  Off: convergence relies on the direct flr flood "
+     "alone"),
     ("faultsim", "", "enable", False, "bool",
      "Arm the deterministic fault-injection plane (default off — "
      "every transport hook is one boolean test when disabled)"),

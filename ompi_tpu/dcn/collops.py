@@ -31,12 +31,45 @@ from . import tcp as tcp_mod
 from .tcp import TcpTransport
 
 
+class AddressTable(list):
+    """Peer-address table with **lazy per-entry resolution** — the
+    sharded-modex substrate (≈ PMIx "instant-on" lazy ``PMIx_Get``):
+    boot primes only the local detector group's slice; a peer outside
+    it resolves through ``resolver(proc)`` (one KVS get) on FIRST use
+    and is cached.  List-compatible: plain iteration/``list()`` sees
+    the RAW slots (``None`` = unresolved) so passive consumers
+    (address→proc reverse lookups, diagnostics) never trigger KVS
+    traffic; only indexed access — the send path — resolves."""
+
+    def __init__(self, nprocs: int, resolver, primed: dict | None = None):
+        super().__init__([None] * int(nprocs))
+        self._resolver = resolver
+        #: entries resolved on demand (the lazy-modex op signature the
+        #: scale soak asserts on, next to the KVSClient op counters)
+        self.lazy_resolved = 0
+        for p, a in (primed or {}).items():
+            list.__setitem__(self, int(p), a)
+
+    def __getitem__(self, i):
+        v = list.__getitem__(self, i)
+        if v is None and isinstance(i, int) and 0 <= i < len(self):
+            v = self._resolver(i)
+            list.__setitem__(self, i, v)
+            self.lazy_resolved += 1
+        return v
+
+    def resolved(self, i: int) -> bool:
+        return list.__getitem__(self, i) is not None
+
+
 class DcnCollEngine:
     """Per-process engine: transport + peer addresses + frame routing.
 
     Two-phase bring-up matching the modex: construct (opens the listen
     socket, so ``address`` can be published), then ``set_addresses``
-    with every peer's endpoint after the fence."""
+    with every peer's endpoint after the fence — or, under the sharded
+    lazy modex, an :class:`AddressTable` that resolves cross-group
+    peers on first send."""
 
     def __init__(
         self,
@@ -113,7 +146,20 @@ class DcnCollEngine:
     def set_addresses(self, addresses: Sequence[str]) -> None:
         if len(addresses) != self.nprocs:
             raise ValueError("address count != nprocs")
-        self.addresses = list(addresses)
+        # an AddressTable keeps its resolver (copying through list()
+        # would freeze the unresolved holes as None forever)
+        self.addresses = (addresses if isinstance(addresses, AddressTable)
+                          else list(addresses))
+
+    def update_address(self, proc: int, address: str) -> None:
+        """Refresh ONE peer's endpoint in place (elastic recovery:
+        replace() installs a reborn incarnation) — works on plain
+        lists and lazy AddressTables alike, without collapsing the
+        table's unresolved holes the way list-copy-and-set would."""
+        if isinstance(self.addresses, AddressTable):
+            list.__setitem__(self.addresses, int(proc), address)
+        else:
+            self.addresses[int(proc)] = address
 
     @property
     def address(self) -> str:
@@ -160,17 +206,27 @@ class DcnCollEngine:
         ``_recv`` calls naming it raise instead of timing out."""
         self._failed_procs.add(proc)
 
-    def note_proc_recovered(self, proc: int) -> None:
+    def note_proc_recovered(self, proc: int,
+                            incarnation: int | None = None) -> None:
         """The replace() leg of elastic recovery: a respawned
         incarnation of ROOT proc ``proc`` re-published its endpoint —
         clear the failure marks (engine set + gossiping detector) so
         traffic naming it flows again, and count the restoration on
-        the ``respawns`` telemetry counter."""
+        the ``respawns`` telemetry counter.  ``incarnation`` feeds the
+        detector's versioned-gossip floor: a stale ``flr`` about the
+        corpse's incarnation can never re-mark the heal."""
         self._failed_procs.discard(proc)
         det = self._detector
         if det is not None:
-            det.clear_failed(proc)
+            det.clear_failed(proc, incarnation=incarnation)
         self._bump_stat("respawns")
+
+    def note_proc_healed(self, proc: int) -> None:
+        """The detector's false-positive heal: un-mark the proc on the
+        engine so blocked receives naming it resume waiting — no
+        respawn accounting (nothing was respawned; the mark was
+        wrong)."""
+        self._failed_procs.discard(proc)
 
     def _bump_stat(self, name: str) -> None:
         """Increment a Python-plane robustness counter on whatever
@@ -202,6 +258,8 @@ class DcnCollEngine:
         bml addresses match on any leg); None = unmapped."""
         root = self._root_engine()
         for p, a in enumerate(root.addresses):
+            if not a:
+                continue  # lazy table: never dialed → cannot match
             if a == address or (a.startswith("bml:")
                                 and address in a.split("|")):
                 return p
@@ -322,7 +380,17 @@ class DcnCollEngine:
         kind = env.get("kind")
         if kind == "hb":
             if self._detector is not None:
-                self._detector.on_heartbeat(env["src"])
+                # the envelope rides along: leader heartbeats carry the
+                # anti-entropy failure-set digest
+                self._detector.on_heartbeat(env["src"], env)
+            return
+        if kind == "flrsync":
+            if self._detector is not None:
+                self._detector.on_flrsync(env)
+            return
+        if kind == "flc":
+            if self._detector is not None:
+                self._detector.on_clear(env)
             return
         if self._detector is not None and kind != "flr":
             # any inbound frame refreshes the sender's liveness clock —
@@ -345,7 +413,10 @@ class DcnCollEngine:
                         note(rp)
         if kind == "flr":
             if self._detector is not None:
-                self._detector.mark_failed(env["proc"], gossip=False)
+                # versioned gossip: (proc, inc, epoch) validated against
+                # the heal floor; a leader relays accepted news into
+                # its group (hierarchical flood, not full-mesh)
+                self._detector.on_gossip(env)
             return
         if kind == "rvk":
             ref = self._comms.get(env["cid"])
@@ -406,6 +477,7 @@ class DcnCollEngine:
                         f"DCN recv: peer proc {src} failed "
                         f"(cid={cid}, seq={seq})", failed=(src,)
                     ) from None
+                self._check_revoked(cid, src, seq)
                 if dl.expired():
                     self._escalate_deadline(
                         "coll_recv", timeout,
@@ -422,6 +494,29 @@ class DcnCollEngine:
         # NBC streams) don't grow the dict without bound
         self._drop_queue(key)
         return got
+
+    def _check_revoked(self, cid, src, seq) -> None:
+        """Revoke interrupt for a BLOCKED collective receive (ULFM:
+        ``MPIX_Comm_revoke`` must wake every pending operation on the
+        comm, not just guard new ones).  Without it, a survivor parked
+        in a fold/bcast recv when another member aborts the collective
+        sits out the full recv deadline and then wrongly escalates the
+        LIVE peer it was waiting on — at np≥16 that false positive
+        poisons the whole recovery.  Recovery streams (replace/shrink/
+        agree string cids) are never registered comms, so they stay
+        uninterruptible by the old comm's revocation — by design."""
+        ref = self._root_engine()._comms.get(cid)
+        comm = ref() if ref is not None else None
+        if comm is None:
+            return
+        from ompi_tpu.ft import ulfm
+
+        if ulfm.is_revoked(comm):
+            from ompi_tpu.core.errors import MPIRevokedError
+
+            raise MPIRevokedError(
+                f"DCN recv: {comm.name} revoked while waiting for "
+                f"proc {src} (cid={cid}, seq={seq})")
 
     def send_p2p(self, dst_proc: int, envelope: dict, payload: np.ndarray) -> None:
         envelope = dict(envelope)
@@ -539,6 +634,34 @@ class DcnCollEngine:
                 np.asarray(blocks[self.proc]) if p == self.proc else self._recv(p, cid, seq)
             )
         return out
+
+    def allgather_obj_hub(self, obj, cid) -> list:
+        """Hub-pattern twin of :meth:`allgather_obj`: gather every
+        member's object at index 0 (the lowest member) and rebroadcast
+        the combined list — 2(P−1) frames through ONE well-connected
+        hub instead of the full-mesh P(P−1) exchange.  Recovery rounds
+        (replace/rejoin CID agreement) use this: they run while the
+        mesh is already degraded, and at np≥16 a full-mesh object
+        exchange is a thundering herd of simultaneous fresh dials that
+        can overwhelm a starved box into cascade failures — the hub's
+        connections already exist (it is the collective fold root or
+        the minimum survivor that published the beacon)."""
+        if self.nprocs == 1:
+            return [obj]
+        seq_gather = self._next_seq(cid)
+        seq_bcast = self._next_seq(cid)
+        empty = np.zeros(0, np.uint8)
+        if self.proc == 0:
+            out = [obj] * self.nprocs
+            for p in range(1, self.nprocs):
+                env, _ = self._recv_full(p, cid, seq_gather)
+                out[p] = env.get("meta")
+            for p in range(1, self.nprocs):
+                self._send(p, cid, seq_bcast, empty, meta=out)
+            return out
+        self._send(0, cid, seq_gather, empty, meta=obj)
+        env, _ = self._recv_full(0, cid, seq_bcast)
+        return list(env.get("meta") or [])
 
     def allgather_obj(self, obj, cid: int) -> list:
         """Allgather of a small JSON-serializable object (rides the

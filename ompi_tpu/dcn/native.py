@@ -412,6 +412,9 @@ class _NativeOpsMixin:
                 raise MPIProcFailedError(
                     f"DCN recv: peer proc {src} failed (cid={cid}, "
                     f"seq={seq})", failed=(src,))
+            # revoke interrupt between C wait slices (same contract as
+            # the Python plane's _check_revoked)
+            self._check_revoked(cid, src, seq)
             if dl.expired():
                 # flight-record the ring/rendezvous state BEFORE the
                 # raise (a wedged windowed send dumps its counters
@@ -626,6 +629,15 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._lib.tdcn_set_addresses(
             self._h, "\n".join(self.addresses).encode())
 
+    def update_address(self, proc: int, address: str) -> None:
+        """One-peer refresh (replace() installing a reborn endpoint):
+        the C plane holds the full table, so re-push it — lazy
+        resolution is a Python-transport affair (the C engine needs
+        every peer eagerly, exactly like the pre-sharded modex)."""
+        addrs = list(self.addresses)
+        addrs[int(proc)] = address
+        self.set_addresses(addrs)
+
     def _csend(self, address: str, kind: int, cid: str, seq: int,
                src: int, dst: int, tag: int, arr: np.ndarray,
                meta_b: bytes | None) -> int:
@@ -831,7 +843,8 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         self._failed_procs.add(proc)
         self._lib.tdcn_note_failed(self._h, proc)
 
-    def note_proc_recovered(self, proc: int) -> None:
+    def note_proc_recovered(self, proc: int,
+                            incarnation: int | None = None) -> None:
         """replace(): a respawned incarnation re-published its endpoint
         — clear the C failure mark (blocked recvs naming it resume
         waiting instead of raising), then the shared Python-side
@@ -842,7 +855,13 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         genuinely-dead corpse's state is pruned when set_addresses
         installs the reborn endpoint (address change = lineage proof)."""
         self._lib.tdcn_clear_failed(self._h, proc)
-        super().note_proc_recovered(proc)
+        super().note_proc_recovered(proc, incarnation)
+
+    def note_proc_healed(self, proc: int) -> None:
+        """False-positive heal (detector): same C-side clear, none of
+        the respawn accounting."""
+        self._lib.tdcn_clear_failed(self._h, proc)
+        super().note_proc_healed(proc)
 
     def rx_watermark(self, proc: int) -> int:
         """Contiguous delivered-seq watermark for frames from ``proc``

@@ -132,6 +132,10 @@ class TelemetryAggregator:
         self._offsets: dict[int, int] = {}
         self._offsets_direct: set[int] = set()
         self._nprocs = 0
+        #: relay plane: batched frames received + the group indices
+        #: whose relays have reported (the np≥16 fan-in signature)
+        self.batches = 0
+        self._relays: set[int] = set()
         # ingest socket (workers dial it; address via ENV_TELEMETRY)
         self._ingest = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._ingest.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -292,7 +296,17 @@ class TelemetryAggregator:
                 pass
 
     def ingest(self, frame: dict) -> None:
-        """Fold one rank frame in (also the selftest entry point)."""
+        """Fold one rank frame in (also the selftest entry point).
+        A relay's batched frame unwraps here: the group relays are
+        transparent to everything downstream of ingest."""
+        if "batch" in frame:
+            with self._lock:
+                self.batches += 1
+                if "relay" in frame:
+                    self._relays.add(int(frame["relay"]))
+            for f in frame.get("batch") or ():
+                self.ingest(f)
+            return
         proc = int(frame.get("proc", 0))
         with self._lock:
             self.frames += 1
@@ -400,6 +414,8 @@ class TelemetryAggregator:
                 },
                 "clock_offsets_ns": {str(p): o
                                      for p, o in self._offsets.items()},
+                "relays": {"batches": self.batches,
+                           "groups": sorted(self._relays)},
             }
 
     def prometheus_text(self) -> str:
@@ -515,6 +531,132 @@ class TelemetryAggregator:
             pass
 
 
+# -- group relay (np≥16 fan-in: one per detector group) ----------------
+
+
+class TelemetryRelay:
+    """Per-group frame concentrator: group members ship their frames
+    here (same wire format as the root ingest) and a pump thread
+    forwards ONE batched frame per interval upstream — the root
+    aggregator's single ingest socket sees O(groups) connections and
+    O(groups) frames per interval instead of O(P) of each, which is
+    what kept tpud's ops surface alive past two digits of ranks.
+
+    Hosted by the group-leader rank's process (``telemetry_relay``);
+    the leader publishes the relay address on the boot KVS
+    (``relay.g<i>``) and members dial it instead of the root.  A dead
+    relay degrades members to dropped frames (same contract as a dead
+    aggregator) — telemetry never touches the data plane."""
+
+    def __init__(self, upstream: str, group_index: int,
+                 interval_ms: int = 500, host: str = "127.0.0.1"):
+        self.upstream = upstream
+        self.group_index = int(group_index)
+        self.interval = max(0.02, float(interval_ms) / 1000.0)
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._up: socket.socket | None = None
+        self.forwarded = 0
+        self._running = True
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self.ingest_address = "%s:%d" % self._sock.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="telemetry-relay").start()
+        self._stop = threading.Event()
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
+                                      name="telemetry-relay-pump")
+        self._pump.start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                frame = _recv_frame(conn)
+                with self._lock:
+                    self._buf.append(frame)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def flush(self) -> bool:
+        """Forward the buffered frames as one batch (pump tick; public
+        for tests).  Frames are re-buffered on upstream failure so a
+        root-aggregator restart (tpud takeover + repoint) loses at
+        most the in-flight batch."""
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return True
+        frame = {"batch": batch, "relay": self.group_index}
+        try:
+            if self._up is None:
+                host, port = self.upstream.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)),
+                                             timeout=2.0)
+                s.settimeout(2.0)
+                self._up = s
+            _send_frame(self._up, frame)
+            self.forwarded += len(batch)
+            return True
+        except (OSError, ValueError):
+            if self._up is not None:
+                try:
+                    self._up.close()
+                except OSError:
+                    pass
+                self._up = None
+            with self._lock:
+                self._buf = batch + self._buf
+                # bound the park: a long root outage must not grow the
+                # buffer without limit (oldest frames age out first)
+                del self._buf[:-4 * 64]
+            return False
+
+    def _pump_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.flush()
+        self.flush()
+
+    def repoint(self, upstream: str) -> None:
+        """Re-aim at a restarted root aggregator (tpud takeover)."""
+        self.upstream = upstream
+        up, self._up = self._up, None
+        if up is not None:
+            try:
+                up.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._running = False
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pump.join(timeout=2 * self.interval + 2.0)
+        if self._up is not None:
+            try:
+                self._up.close()
+            except OSError:
+                pass
+
+
 # -- publisher (one per rank) ------------------------------------------
 
 #: serve plane: the job this rank is currently running (frames carry it
@@ -624,18 +766,33 @@ class TelemetryPublisher:
 
 
 _publisher: TelemetryPublisher | None = None
+_relay: TelemetryRelay | None = None
+#: True when THIS rank's publisher aims at its group relay (member
+#: role): a daemon-restart repoint must re-aim the RELAY's upstream,
+#: not bypass it
+_via_relay = False
 
 
 def publisher() -> TelemetryPublisher | None:
     return _publisher
 
 
+def relay() -> TelemetryRelay | None:
+    return _relay
+
+
 def start_publisher(world, store) -> TelemetryPublisher | None:
     """api.init hook: start this rank's frame pump when ``--mca
     telemetry_enable 1`` AND the launcher advertised an ingest address
     (``tpurun`` sets ``OMPI_TPU_TELEMETRY_ADDR`` when hosting the
-    aggregator).  Returns None — no socket, no thread — otherwise."""
-    global _publisher
+    aggregator).  Returns None — no socket, no thread — otherwise.
+
+    With ``telemetry_relay`` on and more than one detector group, the
+    group-leader rank additionally hosts a :class:`TelemetryRelay`
+    (address published on the boot KVS as ``relay.g<i>``) and group
+    members aim their pumps at it instead of the root — per-host
+    batching up the tree, the PRRTE-daemon fan-in shape."""
+    global _publisher, _relay, _via_relay
     import os
 
     if not bool(store.get("telemetry_enable", False)):
@@ -645,22 +802,47 @@ def start_publisher(world, store) -> TelemetryPublisher | None:
         return None
     if _publisher is not None:
         _publisher.stop()
+    if _relay is not None:
+        _relay.close()
+        _relay = None
+    _via_relay = False
     pc = getattr(world, "procctx", None)
+    interval = int(store.get("telemetry_interval_ms", 500) or 500)
+    groups = getattr(pc, "groups", None) if pc is not None else None
+    if (bool(store.get("telemetry_relay", False))
+            and groups and len(groups) > 1):
+        gi = groups.index(pc.group)
+        if pc.proc == pc.group[0]:
+            # leader: host the group relay, publish its address, and
+            # keep the OWN pump aimed at the root (fewest hops)
+            _relay = TelemetryRelay(address, gi, interval_ms=interval)
+            pc.kvs.put(f"{pc.ns}relay.g{gi}", _relay.ingest_address)
+        else:
+            try:
+                address = str(pc.kvs.get(f"{pc.ns}relay.g{gi}",
+                                         timeout=10.0))
+                _via_relay = True
+            except (KeyError, ConnectionError, OSError):
+                pass  # no relay came up: degrade to the root directly
     _publisher = TelemetryPublisher(
         address,
         proc=int(getattr(world, "proc", 0)),
         nprocs=int(getattr(world, "nprocs", 1)),
-        interval_ms=int(store.get("telemetry_interval_ms", 500) or 500),
+        interval_ms=interval,
         detector=getattr(pc, "detector", None) if pc is not None else None,
     )
     return _publisher
 
 
 def stop_publisher() -> None:
-    global _publisher
+    global _publisher, _relay, _via_relay
     if _publisher is not None:
         _publisher.stop()
         _publisher = None
+    if _relay is not None:
+        _relay.close()
+        _relay = None
+    _via_relay = False
 
 
 def repoint_publisher(address: str) -> None:
@@ -668,10 +850,16 @@ def repoint_publisher(address: str) -> None:
     re-adoption: the reborn daemon's ingest socket lives at a fresh
     port).  The publisher thread keeps running; its cached socket is
     dropped so the next tick dials the new address — a benign race
-    with an in-flight publish costs at most one failed frame."""
+    with an in-flight publish costs at most one failed frame.  A
+    group-relay leader re-aims the RELAY's upstream too; a relay
+    member's pump keeps pointing at its (still-live) relay."""
     pub = _publisher
-    pump_enabled = pub is not None  # telemetry_enable armed a pump
+    pump_enabled = pub is not None or _relay is not None
     if not pump_enabled or not address:
+        return  # telemetry off: no pump, no relay, nothing to re-aim
+    if _relay is not None:
+        _relay.repoint(address)
+    if pub is None or _via_relay:
         return
     pub.address = address
     sock, pub._sock = pub._sock, None

@@ -168,13 +168,26 @@ class TpuDaemon:
         self.shutting_down = False
         self._shutdown_published = False
         self.exit_code = 0
+        self.logdir = (self.pidfile + ".logs") if self.pidfile else ""
         if self.pidfile:
+            if self.logdir:
+                try:
+                    os.makedirs(self.logdir, exist_ok=True)
+                except OSError:
+                    self.logdir = ""
             _state.write_pidfile(self.pidfile, {
                 "pid": os.getpid(), "generation": self.generation,
                 "np": self.np, "kvs": self.server.address,
                 "url": self.url,
                 "ingest": self.aggregator.ingest_address,
+                "logs": self.logdir,
                 "ts_ns": time.time_ns()})
+            if recovered is not None:
+                # journal compaction (PR 10 deferred edge): takeover
+                # rewrites the journal to the live-state fixed point
+                # BEFORE appending, so repeated SIGKILL→restart cycles
+                # stop growing it without bound
+                _state.Journal.compact(self.journal_path, recovered)
             self._journal = _state.Journal(self.journal_path)
         if recovered is not None:
             self._recover(recovered)
@@ -253,6 +266,23 @@ class TpuDaemon:
         for r, st in replay["pids"].items():
             if 0 <= int(r) < self.np:
                 self._incarnation[int(r)] = int(st.get("incarnation", 0))
+        # crash-mid-repair replay (PR 10 deferred edge): a rank the
+        # predecessor respawned whose repair never FINISHED re-enters
+        # the repairing set — once adoption resolves the mesh view,
+        # the repair directive publishes (or a dead reborn goes down
+        # the respawn leg, which re-arms it); an outstanding repair
+        # directive also needs its reborn-cursor beacons re-seeded
+        # (they died with the old KVS)
+        for r in (replay.get("repairing") or {}):
+            if 0 <= int(r) < self.np:
+                self._repairing.add(int(r))
+        for idx, d in replay["outstanding"].items():
+            if d.get("kind") == "repair":
+                self._repair_published = True
+                for r in d.get("dead", ()):
+                    self.server.put_local(
+                        f"{K_RESUME}{int(r)}.i{self._incarnation[int(r)]}",
+                        int(idx) + 1)
         self._status = ["adopting"] * self.np
         for r in replay["retired"]:
             # an operator's /scale-down outlives the crash: a retired
@@ -435,7 +465,12 @@ class TpuDaemon:
             st["procs"] = {
                 str(r): {"status": self._status[r],
                          "incarnation": self._incarnation[r],
-                         "pid": self._proc_pid(r)}
+                         "pid": self._proc_pid(r),
+                         **({"log": os.path.join(
+                             self.logdir, f"worker.{r}.log")}
+                            if self.logdir
+                            and isinstance(self._procs[r], _AdoptedProc)
+                            else {})}
                 for r in range(self.np)}
             st["healthy"] = self._healthy_locked()
             st["cursor"] = self.cursor
@@ -526,8 +561,12 @@ class TpuDaemon:
             # chaos (daemonkill:at=N): the Nth publish attempt kills
             # the daemon dead, BEFORE the directive is journaled or
             # visible — the deterministic SIGKILL the restart-hygiene
-            # soak replays from one seed
-            for _r in _fsim.actions("daemon", kinds={"daemonkill"}):
+            # soak replays from one seed.  Repair publishes are their
+            # own site (daemon_repair) so a plan can land the kill
+            # precisely inside the repair window
+            site = ("daemon_repair" if directive.get("kind") == "repair"
+                    else "daemon")
+            for _r in _fsim.actions(site, kinds={"daemonkill"}):
                 print("[tpud] faultsim: injected daemon kill "
                       "(daemonkill)", flush=True)
                 sys.stdout.flush()
@@ -570,6 +609,12 @@ class TpuDaemon:
         self._status[rank] = "respawning"
         self._repairing.add(rank)
         self._repair_published = False
+        # journal the repair INTENT before anything is visible: a
+        # daemon SIGKILLed between this respawn and the replace()
+        # completion finishes the repair after restart instead of
+        # stranding the reborn worker (cleared by the repair finish)
+        self._journal_ev("repair_pending", rank=rank,
+                         incarnation=self._incarnation[rank])
         self._procs[rank] = (self._spawn(rank) if self._spawn_workers
                              else None)
 
@@ -606,6 +651,7 @@ class TpuDaemon:
         ``serve.resume`` key written here)."""
         with self._lock:
             if (not self._repairing or self._repair_published
+                    or any(s == "adopting" for s in self._status)
                     or any(st["kind"] != "repair"
                            for st in self._outstanding.values())):
                 return

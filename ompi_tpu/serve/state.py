@@ -145,11 +145,30 @@ class Journal:
     ``spawn``     a worker process launched or re-adopted
                   (``rank``/``pid``/``incarnation``/``adopted``) —
                   also un-retires the rank (a /scale restore)
+    ``repair_pending``  a rank was respawned and its repair directive
+                  is NOT yet finished (``rank``/``incarnation``) — a
+                  daemon SIGKILLed between the respawn and the
+                  replace() completion finishes the repair after
+                  restart instead of stranding the reborn worker;
+                  cleared by the repair directive's ``finish``
     ``retire``    ranks scaled down (``ranks``) — a restart must not
                   resurrect an operator's scale-down
     ``drain``     admission stopped — a restart must stay draining
     ``takeover``  a restarted daemon recovered this journal
+    ``compact``   the rewrite marker a takeover leaves after
+                  :meth:`compact` (carries the cursor/cid/generation
+                  floors the dropped events once established)
     ``shutdown``  clean daemon shutdown — replay state resets here
+
+    Repeated SIGKILL→restart cycles must not grow the journal without
+    bound: every takeover first **compacts** it — the file is
+    rewritten with only live state (queued/running jobs, the last
+    spawn per rank, retire/drain marks, pending repairs, done-job
+    history), and every FINISHED published directive collapses to a
+    constant-size ``noop`` index stub.  The stubs keep the stream's
+    index space contiguous — workers consume indices strictly in
+    order, so a hole below a still-lagging worker's cursor would
+    wedge it; a ``noop`` is consumed and ignored.
     """
 
     def __init__(self, path: str):
@@ -181,6 +200,50 @@ class Journal:
             pass
 
     @staticmethod
+    def compact(path: str, replay: dict) -> None:
+        """Rewrite the journal with only live state (takeover-time
+        dedup): one event per queued job, done-job record, last spawn
+        per rank, pending repair, retire/drain mark — and one
+        constant-size ``noop`` stub per FINISHED published directive
+        (index-space continuity, see the class docstring).  Repeated
+        crash→restart cycles re-derive this fixed point instead of
+        appending to an ever-growing history.  Atomic (tmp+rename):
+        a crash mid-compaction replays the old file."""
+        tmp = f"{path}.compact.{os.getpid()}"
+        with open(tmp, "w") as f:
+            def w(ev: str, **fields: Any) -> None:
+                f.write(json.dumps({"ev": ev, "ts_ns": time.time_ns(),
+                                    **fields}, sort_keys=True) + "\n")
+
+            w("compact", cursor=int(replay["cursor"]),
+              cid_next=replay["cid_next"],
+              generation=int(replay["generation"]))
+            for job in replay["queued"]:
+                w("submit", job=job)
+            for job in replay["done"]:
+                w("finish", idx=-1, kind="job", job=job)
+            for idx in sorted(replay["published"]):
+                d = replay["published"][idx]
+                if idx in replay["outstanding"]:
+                    w("publish", d=d)
+                else:
+                    w("publish", d={"kind": "noop", "idx": int(idx)})
+            for r in sorted(replay["pids"]):
+                st = replay["pids"][r]
+                w("spawn", rank=int(r), pid=int(st.get("pid", 0)),
+                  incarnation=int(st.get("incarnation", 0)))
+            for r in sorted(replay.get("repairing", {})):
+                w("repair_pending", rank=int(r),
+                  incarnation=int(replay["repairing"][r]))
+            if replay["retired"]:
+                w("retire", ranks=[int(r) for r in replay["retired"]])
+            if replay["draining"]:
+                w("drain")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @staticmethod
     def replay(path: str) -> dict:
         """Fold the journal into restart state (empty state when the
         file is absent, unparseable lines skipped — a torn final line
@@ -207,27 +270,33 @@ class Journal:
         published: dict[int, dict] = {}
         finished: dict[int, dict] = {}
         pids: dict[int, dict] = {}
+        repairing: dict[int, int] = {}
         retired: set[int] = set()
         draining = False
         generation = 0
+        cursor_floor = 0
+        cid_floor: int | None = None
         clean = True
 
         def _reset() -> None:
-            nonlocal draining
+            nonlocal draining, cursor_floor, cid_floor
             jobs.clear()
             published.clear()
             finished.clear()
             pids.clear()
+            repairing.clear()
             retired.clear()
             draining = False
+            cursor_floor = 0
+            cid_floor = None
 
         try:
             f = open(path)
         except OSError:
             return {"queued": [], "running": [], "done": [],
                     "published": {}, "outstanding": {}, "cursor": 0,
-                    "cid_next": None, "pids": {}, "retired": [],
-                    "draining": False, "generation": 0,
+                    "cid_next": None, "pids": {}, "repairing": {},
+                    "retired": [], "draining": False, "generation": 0,
                     "clean": True, "events": 0}
         events = 0
         with f:
@@ -254,9 +323,22 @@ class Journal:
                 elif ev == "finish":
                     idx = int(rec.get("idx", -1))
                     finished[idx] = rec
+                    if rec.get("kind") == "repair":
+                        repairing.clear()
                     job = rec.get("job")
                     if job and job.get("id"):
                         jobs[job["id"]] = job
+                elif ev == "repair_pending":
+                    repairing[int(rec.get("rank", -1))] = int(
+                        rec.get("incarnation", 0))
+                    clean = False
+                elif ev == "compact":
+                    cursor_floor = max(cursor_floor,
+                                       int(rec.get("cursor", 0)))
+                    if rec.get("cid_next") is not None:
+                        cid_floor = int(rec["cid_next"])
+                    generation = max(generation,
+                                     int(rec.get("generation", 0)))
                 elif ev == "spawn":
                     rank = int(rec.get("rank", -1))
                     pids[rank] = {
@@ -265,7 +347,9 @@ class Journal:
                     retired.discard(rank)  # /scale restore
                     clean = False
                 elif ev == "retire":
-                    retired.update(int(r) for r in rec.get("ranks", ()))
+                    for r in rec.get("ranks", ()):
+                        retired.add(int(r))
+                        repairing.pop(int(r), None)
                     clean = False
                 elif ev == "drain":
                     draining = True
@@ -277,7 +361,7 @@ class Journal:
                     _reset()
                     clean = True
         outstanding = {i: d for i, d in published.items()
-                       if i not in finished}
+                       if i not in finished and d.get("kind") != "noop"}
         published_job_ids = {d.get("id") for d in published.values()
                              if d.get("kind", "job") == "job"}
         queued, running, done = [], [], []
@@ -292,7 +376,7 @@ class Journal:
                 done.append(dict(job, state=job.get("state", "done")))
             else:
                 queued.append(job)
-        cid_next = None
+        cid_next = cid_floor
         for d in published.values():
             if "cid_base" in d:
                 top = int(d["cid_base"]) + int(d.get("cid_span", 0))
@@ -300,8 +384,10 @@ class Journal:
         return {
             "queued": queued, "running": running, "done": done,
             "published": dict(published), "outstanding": outstanding,
-            "cursor": (max(published) + 1) if published else 0,
+            "cursor": max(cursor_floor,
+                          (max(published) + 1) if published else 0),
             "cid_next": cid_next, "pids": pids,
+            "repairing": dict(repairing),
             "retired": sorted(retired), "draining": draining,
             "generation": generation, "clean": clean,
             "events": events,

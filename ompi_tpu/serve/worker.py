@@ -92,6 +92,13 @@ class _PipeSafe:
     def __init__(self, f):
         self._f = f
 
+    def retarget(self, f) -> None:
+        """Re-aim at a NEW sink (adopted-worker stdio re-attach): the
+        dead daemon's pipe is gone for good, so post-adoption output
+        goes to the per-worker log file named in the restarted
+        daemon's pidfile record instead of the bit bucket."""
+        self._f = f
+
     def write(self, s):
         try:
             return self._f.write(s)
@@ -256,6 +263,25 @@ class DaemonLink:
         from ompi_tpu.metrics import live
 
         live.repoint_publisher(info.get("ingest") or "")
+        # stdio re-attach (PR 10 deferred edge): this worker's stdout/
+        # stderr still point at the DEAD daemon's pipe (_PipeSafe
+        # swallowed the breakage); re-aim them at the per-worker log
+        # file the restarted daemon names in its pidfile record, so
+        # post-adoption output is durable instead of lost.  The path
+        # is surfaced on the daemon's /jobs procs table.
+        logdir = str(info.get("logs") or "")
+        if logdir:
+            try:
+                os.makedirs(logdir, exist_ok=True)
+                path = os.path.join(logdir, f"worker.{ctx.proc}.log")
+                logf = open(path, "a", buffering=1)
+                for stream in (sys.stdout, sys.stderr):
+                    rt = getattr(stream, "retarget", None)
+                    if rt is not None:
+                        rt(logf)
+                print(f"serve: stdio re-aimed at {path}", flush=True)
+            except OSError:
+                pass  # log dir unusable: keep swallowing, stay alive
         print(f"serve: re-attached to daemon generation {gen} "
               f"(cursor {self.cursor})", flush=True)
 

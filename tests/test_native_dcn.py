@@ -173,8 +173,11 @@ def test_py_dispatcher_routes_ctrl_frames(engine_pair):
     seen = {}
 
     class Det:
-        def on_heartbeat(self, src):
+        def on_heartbeat(self, src, env=None):
+            # the envelope rides along (incarnation stamp + the
+            # leader anti-entropy digest travel in hb frames)
             seen["hb"] = src
+            seen["env"] = env
 
     b.attach_detector(Det())
     a.send_ctrl(1, {"kind": "hb", "src": 0})
@@ -184,6 +187,7 @@ def test_py_dispatcher_routes_ctrl_frames(engine_pair):
     while "hb" not in seen and time.monotonic() < deadline:
         time.sleep(0.01)
     assert seen.get("hb") == 0
+    assert (seen.get("env") or {}).get("kind") == "hb"
 
 
 def test_native_failure_wakes_coll_recv(engine_pair):

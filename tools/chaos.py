@@ -18,8 +18,21 @@ Usage::
     # --runs N must reproduce the tally exactly
     python tools/chaos.py --daemon-restart --runs 2 --seed 7
 
+    # np>=16 hierarchical control-plane soak: sharded-modex boot
+    # (sub-quadratic KVS ops asserted), one SIGKILL per detector
+    # group mid-collective, gossip-convergence bound, full-size
+    # respawn+replace; --kill-groups N leaves bystander groups that
+    # must show zero reconnects; --relay adds the telemetry relays
+    python tools/chaos.py --scale --np 16 --runs 2
+
+    # crash-mid-repair: the daemonkill lands ON the repair publish
+    # (site daemon_repair) — the restart must finish the heal
+    python tools/chaos.py --kill-in-repair
+
     # self-check (no subprocesses): plan parsing, decision
-    # determinism, transport self-healing, disabled-path state
+    # determinism, transport self-healing, disabled-path state,
+    # hierarchical topology/takeover, versioned gossip, get_prefix +
+    # lazy AddressTable, relay batching
     python tools/chaos.py --selftest
 
 The soak launches ``tests/workers/mp_chaos_worker.py`` under ``tpurun
@@ -272,6 +285,157 @@ def render_respawn(tallies: list[dict]) -> None:
           f"full_size={all(t['size'] == len(tallies) for t in tallies)}")
 
 
+SCALE_WORKER = os.path.join(REPO, "tests", "workers",
+                            "mp_scale_worker.py")
+
+
+def run_scale_soak(np_: int, seed: int, ops: int, kill_at: int,
+                   group_size: int, period: float, kill_groups: int,
+                   relay: bool, plan: str, extra_mca: list[str],
+                   timeout: float) -> list[dict]:
+    """The hierarchical-control-plane headline at np≥16: boot rides
+    the sharded lazy modex (per-rank KVS ``get``s must be O(1)+lazy,
+    not P−1 — asserted from the workers' op counters), one rank per
+    targeted detector group SIGKILLs itself mid-collective, survivors
+    must converge on the full failure set within ``2 × period ×
+    ceil(log2(groups))`` (hierarchical gossip + anti-entropy digest),
+    and the respawn+replace leg must complete at FULL size with exact
+    phase-2 results.  With ``kill_groups`` below the group count, the
+    bystander groups' ranks must show ZERO reconnects/retry_dials —
+    the failure never perturbed them."""
+    import math
+
+    from ompi_tpu.ft.detector import compute_groups
+
+    groups = compute_groups(np_, group_size)
+    targets = groups[:kill_groups] if kill_groups > 0 else groups
+    victims = sorted(g[len(g) // 2] if len(g) > 2 else g[-1]
+                     for g in targets)
+    mca = {
+        "btl": "tcp",
+        "ft_group_size": str(group_size),
+        "ft_detector_period": str(period),
+        # generous silence timeout: np≥16 on an oversubscribed CPU box
+        # schedules heartbeat threads late, and a false timeout of a
+        # LIVE rank poisons the replace round.  Real deaths are still
+        # detected fast — the reborn incarnation's boot heartbeat (the
+        # rebirth rule) and the in-band strike path don't wait for it.
+        "ft_detector_timeout": str(max(6.0, 6 * period)),
+        "dcn_recv_timeout": "30",
+        "dcn_cts_timeout": "30",
+        "dcn_connect_timeout": "8",
+    }
+    if relay:
+        mca["telemetry_enable"] = "1"
+        mca["telemetry_relay"] = "1"
+    if plan:
+        mca.update({"faultsim_enable": "1", "faultsim_seed": str(seed),
+                    "faultsim_plan": plan})
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        mca[k] = v
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--ft", "--respawn", "--cpu-devices", "1"]
+    for k, v in mca.items():
+        cmd += ["--mca", k, v]
+    cmd.append(SCALE_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env["SCALE_OPS"] = str(ops)
+    env["SCALE_KILL_AT"] = str(kill_at)
+    env["SCALE_VICTIMS"] = ",".join(str(v) for v in victims)
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    res = subprocess.run(cmd, capture_output=True, timeout=timeout,
+                         env=env, cwd=REPO)
+    out_text = res.stdout.decode(errors="replace")
+    if res.returncode != 0:
+        sys.stderr.write(out_text)
+        sys.stderr.write(res.stderr.decode(errors="replace"))
+        raise SystemExit(f"scale soak failed (rc={res.returncode})")
+    tallies = []
+    for line in out_text.splitlines():
+        marker = "SCALE_TALLY "
+        if marker in line:
+            tallies.append(json.loads(line.split(marker, 1)[1]))
+    if len(tallies) != np_:
+        sys.stderr.write(out_text)
+        raise SystemExit(
+            f"expected {np_} SCALE_TALLY lines, got {len(tallies)}")
+    tallies.sort(key=lambda t: t["proc"])
+    # full-size exact completion
+    bad = [t["proc"] for t in tallies
+           if t["size"] != np_ or t["post"] != t["ops"]]
+    if bad:
+        raise SystemExit(f"scale soak: incomplete recovery on {bad}")
+    reborn = [t["proc"] for t in tallies if t["incarnation"] > 0]
+    if sorted(reborn) != victims:
+        raise SystemExit(
+            f"scale soak: reborn {reborn} != victims {victims}")
+    # sub-quadratic boot: per-rank modex gets O(1)+lazy, never P−1
+    for t in tallies:
+        if t["incarnation"]:
+            continue  # reborn incarnations take the eager path by design
+        gets = int(t["boot_kvs_ops"].get("get", 0))
+        if gets > 2 or gets >= np_ - 1:
+            raise SystemExit(
+                f"scale soak: rank {t['proc']} issued {gets} modex "
+                f"gets at boot (sharded modex should need <= 2)")
+        if int(t.get("boot_lazy", 0)) > 4:
+            raise SystemExit(
+                f"scale soak: rank {t['proc']} resolved "
+                f"{t['boot_lazy']} addresses during boot")
+    # convergence: survivors' full-failure-set instants within the
+    # hierarchical gossip bound
+    stamps = [t["t_detect_all"] for t in tallies
+              if t["incarnation"] == 0 and t["t_detect_all"] > 0]
+    spread = (max(stamps) - min(stamps)) if len(stamps) > 1 else 0.0
+    bound = 2 * period * max(1, math.ceil(math.log2(max(2, len(groups)))))
+    if stamps and spread > bound:
+        raise SystemExit(
+            f"scale soak: failure-set convergence spread {spread:.3f}s "
+            f"exceeds 2*period*ceil(log2(groups)) = {bound:.3f}s")
+    # bystander groups: untouched by the whole affair
+    if kill_groups > 0:
+        touched = {i for i, _ in enumerate(groups) if i < kill_groups}
+        noisy = [t["proc"] for t in tallies
+                 if t["group"] not in touched
+                 and (t["reconnects"] or t["retry_dials"])]
+        if noisy:
+            raise SystemExit(
+                f"scale soak: bystander-group ranks {noisy} show "
+                "reconnects/retry_dials")
+    print(f"scale soak: np={np_} groups={len(groups)} "
+          f"victims={victims} ops={ops} period={period} "
+          f"convergence={spread * 1e3:.1f} ms (bound "
+          f"{bound * 1e3:.0f} ms) wall={time.time() - t0:.1f}s")
+    return tallies
+
+
+def render_scale(tallies: list[dict]) -> None:
+    print(f"{'rank':<6}{'grp':>4}{'incarn':>7}{'phase1':>8}{'phase2':>8}"
+          f"{'size':>6}{'bgets':>6}{'lazy':>6}{'reconn':>8}{'redial':>8}"
+          f"{'stale':>7}")
+    for t in tallies:
+        det = t.get("detector") or {}
+        print(f"{t['proc']:<6}{t['group']:>4}{t['incarnation']:>7}"
+              f"{t['completed']:>5}/{t['ops']:<2}"
+              f"{t['post']:>5}/{t['ops']:<2}"
+              f"{t['size']:>6}"
+              f"{int(t['boot_kvs_ops'].get('get', 0)):>6}"
+              f"{t.get('lazy_resolved', 0):>6}"
+              f"{t['reconnects']:>8}{t['retry_dials']:>8}"
+              f"{det.get('stale_gossip_dropped', 0):>7}")
+    total_gets = sum(int(t["kvs_ops"].get("get", 0)) for t in tallies)
+    n = len(tallies)
+    print(f"totals: kvs_gets={total_gets} (quadratic would be "
+          f">= {n * (n - 1)}), lazy_resolved="
+          f"{sum(t.get('lazy_resolved', 0) for t in tallies)}, "
+          f"gossip_tx={sum((t.get('detector') or {}).get('gossip_tx', 0) for t in tallies)}, "
+          f"relayed={sum((t.get('detector') or {}).get('gossip_relayed', 0) for t in tallies)}, "
+          f"digest_syncs={sum((t.get('detector') or {}).get('digest_syncs', 0) for t in tallies)}")
+
+
 JOB_WORKER = os.path.join(REPO, "tests", "workers",
                           "serve_job_worker.py")
 
@@ -450,6 +614,178 @@ def run_daemon_restart_soak(np_: int, seed: int, kill_at: int,
                     pass
 
 
+def _journal_pid_map(journal: str) -> dict[int, int]:
+    """rank → last spawned pid, from the journal's spawn events."""
+    pids: dict[int, int] = {}
+    try:
+        with open(journal) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("ev") == "spawn":
+                    pids[int(rec.get("rank", -1))] = int(
+                        rec.get("pid", 0))
+    except OSError:
+        pass
+    return pids
+
+
+def run_repair_window_soak(np_: int, seed: int, extra_mca: list[str],
+                           timeout: float) -> dict:
+    """Crash-mid-repair replay (PR 10 deferred edge), deterministically
+    from one seed: ``daemonkill:at=1;site=daemon_repair`` lands the
+    SIGKILL exactly on the REPAIR directive's publish — after the
+    daemon respawned a dead rank (``repair_pending`` journaled),
+    before any survivor saw the directive.  The restarted daemon must
+    finish the repair instead of stranding the reborn worker: re-adopt
+    the survivors, respawn the (dead) reborn incarnation, publish the
+    journal-seeded repair, and end with a healthy full-size mesh —
+    rc-0 shutdown, zero orphans.  Also proves the adopted-worker
+    stdio re-attach: post-adoption survivor output must land in the
+    per-worker log files named in the pidfile record."""
+    import tempfile
+    import urllib.request
+
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve import state as _sstate
+
+    tmp = tempfile.mkdtemp(prefix="tpud-repair-")
+    pidfile = os.path.join(tmp, "tpud.pid")
+    journal = pidfile + ".journal"
+    base_mca = {
+        "btl": "tcp",
+        "serve_pidfile": pidfile,
+        "serve_reattach_timeout": "30",
+        "ft_respawn_timeout": "30",
+        "dcn_recv_timeout": "8",
+        "dcn_cts_timeout": "8",
+        "dcn_connect_timeout": "4",
+    }
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        base_mca[k] = v
+    t0 = time.time()
+    d1 = d2 = None
+    lines1: list[str] = []
+    lines2: list[str] = []
+    victim = 1
+    try:
+        d1, lines1, url1 = _spawn_daemon(np_, {
+            **base_mca,
+            "faultsim_enable": "1",
+            "faultsim_seed": str(seed),
+            "faultsim_plan": "daemonkill:at=1;site=daemon_repair"})
+        ja = client.submit(url1, JOB_WORKER, tenant="alice", nprocs=1)
+        ra1 = client.wait(url1, ja["id"], timeout=90)
+        if ra1.get("state") != "done":
+            raise SystemExit(f"repair soak: job A did not finish: {ra1}")
+        # kill the idle rank's worker: the daemon respawns it, journals
+        # repair_pending, and dies on the repair publish
+        pid_v = _journal_pid_map(journal).get(victim, 0)
+        if pid_v <= 0:
+            # os.kill(0, 9) would SIGKILL our own process group
+            raise SystemExit(
+                f"repair soak: no spawn record for rank {victim} in "
+                f"{journal}; cannot pick a victim pid")
+        os.kill(pid_v, 9)
+        d1.wait(timeout=90)
+        if d1.returncode == 0:
+            raise SystemExit(
+                "repair-window daemonkill never fired:\n" + "".join(lines1))
+        replay = _sstate.Journal.replay(journal)
+        if str(victim) not in {str(k) for k in replay["repairing"]} \
+                and victim not in replay["repairing"]:
+            raise SystemExit(
+                f"repair soak: no repair_pending for rank {victim} in "
+                f"the journal: {replay['repairing']}")
+        d2, lines2, url2 = _spawn_daemon(np_, base_mca)
+        # the restarted daemon must finish the repair on its own: poll
+        # /jobs until the mesh is healthy at full size again
+        deadline = time.time() + 120
+        healthy = False
+        st: dict = {}
+        while time.time() < deadline:
+            try:
+                st = client.status(url2)
+            except OSError:
+                time.sleep(0.3)
+                continue
+            procs = st.get("procs") or {}
+            healthy = bool(st.get("healthy")) and all(
+                procs.get(str(r), {}).get("status") == "active"
+                for r in range(np_))
+            if healthy and int(procs[str(victim)]["incarnation"]) >= 2:
+                break
+            time.sleep(0.3)
+        if not healthy:
+            sys.stderr.write("".join(lines1) + "".join(lines2))
+            raise SystemExit(f"repair soak: mesh never healed: {st}")
+        # the healed mesh must still serve: one more job end-to-end
+        jb = client.submit(url2, JOB_WORKER, tenant="bob", nprocs=np_)
+        rb = client.wait(url2, jb["id"], timeout=120)
+        # stdio re-attach: the adopted survivors' log files exist and
+        # carry post-adoption output; /jobs names the paths
+        logdir = pidfile + ".logs"
+        log0 = os.path.join(logdir, "worker.0.log")
+        with urllib.request.urlopen(url2 + "/jobs", timeout=5) as r:
+            jobs_doc = json.loads(r.read().decode())
+        log_in_jobs = jobs_doc["procs"]["0"].get("log")
+        client.shutdown(url2)
+        rc2 = d2.wait(timeout=60)
+        time.sleep(0.5)
+        orphans = [p for p in _journal_pid_map(journal).values()
+                   if p > 0 and _sstate.pid_alive(p)]
+        tally = {
+            "injected": {"daemonkill": 1},
+            "repair_pending_journaled": True,
+            "repaired": sum(1 for line in lines2
+                            if "repair complete" in line),
+            "victim_incarnation": int(
+                st["procs"][str(victim)]["incarnation"]),
+            "jobs": {ja["id"]: "done", jb["id"]: rb["state"]},
+            "log_reattached": bool(
+                os.path.exists(log0) and os.path.getsize(log0)),
+            "log_in_jobs": log_in_jobs == log0,
+            "restart_rc": rc2,
+            "orphans": len(orphans),
+        }
+        ok = (tally["repaired"] >= 1 and rb["state"] == "done"
+              and tally["victim_incarnation"] >= 2
+              and tally["log_reattached"] and tally["log_in_jobs"]
+              and rc2 == 0 and not orphans)
+        if not ok:
+            sys.stderr.write("".join(lines1) + "".join(lines2))
+            raise SystemExit(f"repair-window soak failed: {tally}")
+        print(f"repair-window soak: np={np_} seed={seed} "
+              f"wall={time.time() - t0:.1f}s")
+        return tally
+    finally:
+        for d in (d1, d2):
+            if d is not None and d.poll() is None:
+                d.kill()
+        for p in _journal_pid_map(journal).values():
+            if p > 0 and _sstate.pid_alive(p):
+                try:
+                    os.kill(p, 9)
+                except OSError:
+                    pass
+
+
+def render_repair_window(tally: dict) -> None:
+    print(f"  repair_pending journaled: "
+          f"{tally['repair_pending_journaled']}   repairs completed "
+          f"after restart: {tally['repaired']}   victim incarnation: "
+          f"{tally['victim_incarnation']}")
+    print("  jobs: " + ", ".join(f"{j}={s}"
+                                 for j, s in sorted(tally["jobs"].items()))
+          + f"   stdio re-attached: {tally['log_reattached']} "
+          f"(on /jobs: {tally['log_in_jobs']})")
+    print(f"  final shutdown rc={tally['restart_rc']}   orphans: "
+          f"{tally['orphans']}")
+
+
 def render_daemon_restart(tally: dict) -> None:
     print(f"  directives before kill: {tally['directives_before_kill']}"
           f"   journal-queued: {tally['queued_in_journal']}"
@@ -565,11 +901,103 @@ def selftest() -> int:
     assert not fsim.enabled() and fsim.actions("send") == ()
     assert sum(fsim.counters().values()) == 0
 
+    # 6. hierarchical topology math: grouping, deterministic leader/
+    # successor, rank-order takeover
+    from ompi_tpu.ft.detector import compute_groups
+
+    gs = compute_groups(16, 8)
+    assert gs == [list(range(8)), list(range(8, 16))], gs
+    assert compute_groups(6, 8) == [[0, 1, 2, 3, 4, 5]]
+    assert compute_groups(4, 2, hosts=[0, 1, 0, 1]) == [[0, 2], [1, 3]]
+
+    class _Eng16(_Eng):
+        proc, nprocs = 2, 16
+
+    det16 = HeartbeatDetector(_Eng16(), period=60.0, timeout=120.0,
+                              group_size=8)
+    try:
+        targets, watch, is_leader = det16._topology_locked()
+        assert targets == [0, 1] and watch == set() and not is_leader
+        det16.mark_failed(0, gossip=False)  # leader dies →
+        det16.mark_failed(1, gossip=False)  # successor dies →
+        targets, watch, is_leader = det16._topology_locked()
+        # rank 2 is now its group's leader: heartbeats the other
+        # group's leader + its own successor, watches members+leaders
+        assert is_leader and targets == [3, 8], targets
+        assert 8 in watch and 3 in watch, watch
+    finally:
+        det16.close()
+
+    # 7. versioned gossip: a stale flr about a healed incarnation is
+    # dropped; a fresh record re-marks
+    detv = HeartbeatDetector(_Eng16(), period=60.0, timeout=120.0,
+                             group_size=8)
+    try:
+        detv.on_gossip({"proc": 5, "inc": 0, "epoch": 0})
+        assert 5 in detv.failed()
+        detv.clear_failed(5, incarnation=1)  # replace() healed it
+        detv.on_gossip({"proc": 5, "inc": 0, "epoch": 0})  # late corpse
+        assert 5 not in detv.failed()
+        assert detv.counters["stale_gossip_dropped"] == 1
+        detv.on_gossip({"proc": 5, "inc": 1, "epoch": 1})  # fresh death
+        assert 5 in detv.failed()
+    finally:
+        detv.close()
+
+    # 8. sharded modex substrate: KVS prefix scan + lazy AddressTable
+    from ompi_tpu.boot.kvs import KVSClient, KVSServer
+    from ompi_tpu.dcn.collops import AddressTable
+
+    srv = KVSServer()
+    cli = KVSClient(srv.address)
+    try:
+        for pnum in range(4):
+            cli.put(f"dcn.{pnum}", f"addr{pnum}")
+        scan = cli.get_prefix("dcn.")
+        assert scan == {f"dcn.{i}": f"addr{i}" for i in range(4)}, scan
+        assert cli.ops["get_prefix"] == 1 and cli.ops["put"] == 4
+        tab = AddressTable(4, lambda i: cli.get(f"dcn.{i}"),
+                           primed={0: "addr0", 1: "addr1"})
+        assert list(tab) == ["addr0", "addr1", None, None]
+        assert tab[3] == "addr3" and tab.lazy_resolved == 1
+        assert cli.ops.get("get", 0) == 1  # exactly the one lazy get
+    finally:
+        cli.close()
+        srv.close()
+
+    # 9. telemetry relay: members' frames batch through the group
+    # relay and unwrap at the root aggregator
+    from ompi_tpu.metrics.live import (TelemetryAggregator,
+                                       TelemetryRelay, _send_frame)
+    import socket as _socket
+
+    agg = TelemetryAggregator(http_port=0)
+    rel = TelemetryRelay(agg.ingest_address, group_index=1,
+                         interval_ms=50)
+    try:
+        host, port = rel.ingest_address.rsplit(":", 1)
+        s = _socket.create_connection((host, int(port)), timeout=2)
+        for pnum in (8, 9):
+            _send_frame(s, {"proc": pnum, "nprocs": 16,
+                            "ts_ns": 1, "native": {}})
+        s.close()
+        deadline = time.time() + 10
+        while agg.frames < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        js = agg.json_state()
+        assert js["frames"] == 2 and js["relays"]["groups"] == [1], js
+        assert set(js["procs"]) == {"8", "9"}, js["procs"]
+    finally:
+        rel.close()
+        agg.close()
+
     print("selftest OK: plan grammar, seeded determinism (400-event "
           "streams), reconnect healing (8/8 delivered, "
           f"{tx.stats['reconnects']} reconnect), exactly-once dedup "
           f"(32/32 delivered, {dups} duplicates dropped), detector "
-          "clear_failed, disabled-path state")
+          "clear_failed, disabled-path state, hierarchical topology "
+          "+ takeover, versioned gossip (stale flr dropped), "
+          "get_prefix + lazy AddressTable, relay batching")
     return 0
 
 
@@ -608,9 +1036,76 @@ def main(argv: list[str] | None = None) -> int:
                     help="daemonkill directive index for "
                     "--daemon-restart (default 2: mid-job for the "
                     "first submission)")
+    ap.add_argument("--kill-in-repair", action="store_true",
+                    help="crash-mid-repair soak: the daemonkill lands "
+                    "on the REPAIR directive's publish (site "
+                    "daemon_repair) — the restart must finish the "
+                    "journal-seeded repair instead of stranding the "
+                    "reborn worker")
+    ap.add_argument("--scale", action="store_true",
+                    help="np>=16 hierarchical control-plane soak: "
+                    "sharded-modex boot (KVS op counts asserted "
+                    "sub-quadratic), one SIGKILL per targeted "
+                    "detector group mid-collective, gossip "
+                    "convergence bound, full-size replace()")
+    ap.add_argument("--group-size", type=int, default=8,
+                    help="--scale: ft_group_size (default 8)")
+    ap.add_argument("--period", type=float, default=1.0,
+                    help="--scale: detector heartbeat period, seconds "
+                    "(the convergence bound is 2*period*"
+                    "ceil(log2(groups)))")
+    ap.add_argument("--kill-groups", type=int, default=0,
+                    help="--scale: kill a rank in only the first N "
+                    "groups (0 = every group); with N < groups the "
+                    "bystander groups must stay at zero "
+                    "reconnects/retry_dials")
+    ap.add_argument("--relay", action="store_true",
+                    help="--scale: enable the per-group telemetry "
+                    "relays (telemetry_enable + telemetry_relay)")
     ns = ap.parse_args(argv)
     if ns.selftest:
         return selftest()
+    if ns.scale:
+        baseline = None
+        for run in range(ns.runs):
+            tallies = run_scale_soak(
+                ns.np_, ns.seed, ns.ops, ns.kill_at if ns.kill_at != 2
+                else 3, ns.group_size, ns.period, ns.kill_groups,
+                ns.relay, "" if ns.plan == DEFAULT_PLAN else ns.plan,
+                ns.mca, ns.timeout)
+            render_scale(tallies)
+            # the structural tally is the determinism contract (the
+            # convergence stamps are wall clock and excluded)
+            shape = [(t["proc"], t["incarnation"], t["completed"],
+                      t["post"], t["size"],
+                      int(t["boot_kvs_ops"].get("get", 0)),
+                      t["injected"]) for t in tallies]
+            if baseline is None:
+                baseline = shape
+            elif shape != baseline:
+                raise SystemExit(
+                    f"DETERMINISM VIOLATION: run {run + 1} shape "
+                    f"{shape} != run 1 {baseline} (seed {ns.seed})")
+            elif ns.runs > 1:
+                print(f"run {run + 1}: scale tally reproduces run 1 "
+                      f"exactly (seed {ns.seed})")
+        return 0
+    if ns.kill_in_repair:
+        baseline = None
+        for run in range(ns.runs):
+            tally = run_repair_window_soak(ns.np_, ns.seed, ns.mca,
+                                           ns.timeout)
+            render_repair_window(tally)
+            if baseline is None:
+                baseline = tally
+            elif tally != baseline:
+                raise SystemExit(
+                    f"DETERMINISM VIOLATION: run {run + 1} tallied "
+                    f"{tally} but run 1 tallied {baseline}")
+            elif ns.runs > 1:
+                print(f"run {run + 1}: repair-window tally reproduces "
+                      f"run 1 exactly (seed {ns.seed})")
+        return 0
     if ns.daemon_restart:
         baseline = None
         for run in range(ns.runs):

@@ -71,9 +71,14 @@ def render(state: dict, prev: dict | None = None, url: str = "",
     procs = {int(p): f for p, f in (state.get("procs") or {}).items()}
     prev_procs = {int(p): f for p, f in
                   ((prev or {}).get("procs") or {}).items()}
+    relays = state.get("relays") or {}
+    relay_note = (f"  relays={len(relays.get('groups') or ())}g/"
+                  f"{relays.get('batches', 0)}b"
+                  if relays.get("batches") else "")
     print(f"ompi_tpu top — {url or 'live telemetry'}  "
           f"frames={state.get('frames', 0)} "
-          f"nprocs={state.get('nprocs', len(procs))}  "
+          f"nprocs={state.get('nprocs', len(procs))}"
+          f"{relay_note}  "
           f"{time.strftime('%H:%M:%S')}", file=out)
     daemon = state.get("daemon")
     if daemon:
