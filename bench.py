@@ -609,6 +609,56 @@ def capi_rows(max_bytes: int = 4096, iters: int = 400) -> dict:
     return {"np": 1, "iters": iters, "rows": rows}
 
 
+def dispatch_floor_rows(iters: int = 2000, py_iters: int = 400) -> dict:
+    """Per-op C-ABI vs Python-API dispatch floor at small sizes (np=1
+    and np=2), plus the persistent-collective replay rate — the
+    regression leg for the C collective fast path: c_us should track
+    py_us within ~1.5x (the embedded-Python crossing is gone), and
+    ``Allreduce_init``+``Start`` should beat per-call ``MPI_Allreduce``
+    (``start_speedup`` > 1)."""
+    from ompi_tpu import native
+
+    bin_path = REPO / "native" / "build" / "dispatch_floor"
+    native.compile_mpi_program(
+        REPO / "native" / "bench" / "dispatch_floor.c", bin_path)
+    out: dict = {}
+    for np_ in (1, 2):
+        text = _run_tpurun(np_, str(bin_path), [iters], timeout=600)
+        for line in text.splitlines():
+            if "DISPATCH " in line:
+                c = json.loads(line.split("DISPATCH ", 1)[1])
+                break
+        else:
+            raise RuntimeError(f"no DISPATCH line (np={np_}):"
+                               f"\n{text[-2000:]}")
+        text = _run_tpurun(
+            np_, str(REPO / "tools" / "bench_dispatch_floor.py"),
+            [py_iters], timeout=600)
+        py_rows = []
+        for line in text.splitlines():
+            if "PYDISPATCH " in line:
+                py_rows = json.loads(line.split("PYDISPATCH ", 1)[1])
+                break
+        by_key = {(r["op"], r["bytes"]): r for r in py_rows}
+        ratios = []
+        for r in c["rows"]:
+            pyr = by_key.get((r["op"], r["bytes"]))
+            if pyr:
+                r["py_us"] = pyr["py_us"]
+                r["c_over_py"] = (round(r["c_us"] / pyr["py_us"], 3)
+                                  if pyr["py_us"] else None)
+                if r["c_over_py"] is not None:
+                    ratios.append(r["c_over_py"])
+        out[f"np{np_}"] = {
+            "rows": c["rows"],
+            "persistent": c.get("persistent"),
+            "c_over_py_max": max(ratios) if ratios else None,
+            "c_over_py_geomean": (round(_geomean(ratios), 3)
+                                  if ratios else None),
+        }
+    return out
+
+
 def serve_rows(runs: int = 3) -> dict:
     """Warm-vs-cold dispatch (the tpud daemon's reason to exist as a
     measured number): job-submit→first-collective latency for a job
@@ -739,6 +789,7 @@ def main() -> None:
         for key, fn in (("dcn", dcn_rows), ("capi", capi_rows),
                         ("capi_p2p", capi_p2p_rows),
                         ("osu_bw_sweep", osu_bw_sweep_rows),
+                        ("dispatch_floor", dispatch_floor_rows),
                         ("algos_cpu8", algos_cpu8_rows),
                         ("hostpath_cpu8", hostpath_cpu8_rows),
                         ("serve", serve_rows)):

@@ -1,0 +1,132 @@
+/* dispatch_floor — the per-op C-ABI dispatch-floor meter.
+ *
+ * Measures small-message per-call latency for the C-served collectives
+ * (allreduce/bcast/reduce/allgather/barrier) and the MPI-4 persistent
+ * replay rate (Allreduce_init + Start/Wait vs per-call MPI_Allreduce)
+ * — the numbers behind the "kill the per-op dispatch floor" leg: with
+ * the C collective fast path these calls never cross embedded Python,
+ * so c_us should sit within ~1.5x of py_us instead of the old
+ * ~1.8x / +3.9 us shim floor.
+ *
+ * Usage: dispatch_floor [iters]
+ * Rank 0 prints one line:  DISPATCH {json}
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static const int SIZES[] = {8, 64, 512, 4096}; /* bytes per rank */
+#define NSIZES ((int)(sizeof(SIZES) / sizeof(SIZES[0])))
+
+static double avg_us(double t0, double t1, int iters) {
+  return (t1 - t0) * 1e6 / iters;
+}
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank, np;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &np);
+  int iters = argc > 1 ? atoi(argv[1]) : 2000;
+  if (iters < 10) iters = 10;
+  int warm = iters / 10 + 5;
+
+  char json[8192];
+  int off = snprintf(json, sizeof json,
+                     "{\"np\": %d, \"iters\": %d, \"rows\": [", np, iters);
+  int first = 1;
+
+  float *sbuf = malloc(4096);
+  float *rbuf = malloc(4096 * (size_t)np);
+  for (int i = 0; i < 1024; i++) sbuf[i] = rank + 1.0f + i;
+
+#define ROW(opname, bytes, us)                                         \
+  off += snprintf(json + off, sizeof json - (size_t)off,               \
+                  "%s{\"op\": \"%s\", \"bytes\": %d, \"c_us\": %.3f}", \
+                  first ? "" : ", ", opname, bytes, us),               \
+      first = 0
+
+  for (int s = 0; s < NSIZES; s++) {
+    int count = SIZES[s] / 4;
+    for (int w = 0; w < warm; w++)
+      MPI_Allreduce(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM,
+                    MPI_COMM_WORLD);
+    double t0 = MPI_Wtime();
+    for (int w = 0; w < iters; w++)
+      MPI_Allreduce(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM,
+                    MPI_COMM_WORLD);
+    ROW("allreduce", SIZES[s], avg_us(t0, MPI_Wtime(), iters));
+
+    for (int w = 0; w < warm; w++)
+      MPI_Bcast(rbuf, count, MPI_FLOAT, 0, MPI_COMM_WORLD);
+    t0 = MPI_Wtime();
+    for (int w = 0; w < iters; w++)
+      MPI_Bcast(rbuf, count, MPI_FLOAT, 0, MPI_COMM_WORLD);
+    ROW("bcast", SIZES[s], avg_us(t0, MPI_Wtime(), iters));
+
+    for (int w = 0; w < warm; w++)
+      MPI_Reduce(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM, 0,
+                 MPI_COMM_WORLD);
+    t0 = MPI_Wtime();
+    for (int w = 0; w < iters; w++)
+      MPI_Reduce(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM, 0,
+                 MPI_COMM_WORLD);
+    ROW("reduce", SIZES[s], avg_us(t0, MPI_Wtime(), iters));
+
+    for (int w = 0; w < warm; w++)
+      MPI_Allgather(sbuf, count, MPI_FLOAT, rbuf, count, MPI_FLOAT,
+                    MPI_COMM_WORLD);
+    t0 = MPI_Wtime();
+    for (int w = 0; w < iters; w++)
+      MPI_Allgather(sbuf, count, MPI_FLOAT, rbuf, count, MPI_FLOAT,
+                    MPI_COMM_WORLD);
+    ROW("allgather", SIZES[s], avg_us(t0, MPI_Wtime(), iters));
+  }
+
+  for (int w = 0; w < warm; w++) MPI_Barrier(MPI_COMM_WORLD);
+  double t0 = MPI_Wtime();
+  for (int w = 0; w < iters; w++) MPI_Barrier(MPI_COMM_WORLD);
+  ROW("barrier", 0, avg_us(t0, MPI_Wtime(), iters));
+
+  /* persistent replay vs per-call dispatch at 64 B */
+  {
+    int count = 16;
+    for (int w = 0; w < warm; w++)
+      MPI_Allreduce(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM,
+                    MPI_COMM_WORLD);
+    t0 = MPI_Wtime();
+    for (int w = 0; w < iters; w++)
+      MPI_Allreduce(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM,
+                    MPI_COMM_WORLD);
+    double percall = avg_us(t0, MPI_Wtime(), iters);
+
+    MPI_Request pers;
+    MPI_Allreduce_init(sbuf, rbuf, count, MPI_FLOAT, MPI_SUM,
+                       MPI_COMM_WORLD, MPI_INFO_NULL, &pers);
+    for (int w = 0; w < warm; w++) {
+      MPI_Start(&pers);
+      MPI_Wait(&pers, MPI_STATUS_IGNORE);
+    }
+    t0 = MPI_Wtime();
+    for (int w = 0; w < iters; w++) {
+      MPI_Start(&pers);
+      MPI_Wait(&pers, MPI_STATUS_IGNORE);
+    }
+    double start_us = avg_us(t0, MPI_Wtime(), iters);
+    MPI_Request_free(&pers);
+    off += snprintf(json + off, sizeof json - (size_t)off,
+                    "], \"persistent\": {\"bytes\": %d, "
+                    "\"percall_us\": %.3f, \"start_us\": %.3f, "
+                    "\"start_speedup\": %.3f}}",
+                    count * 4, percall, start_us,
+                    start_us > 0 ? percall / start_us : 0.0);
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("DISPATCH %s\n", json);
+  free(sbuf);
+  free(rbuf);
+  MPI_Finalize();
+  return 0;
+}

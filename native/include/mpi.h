@@ -652,6 +652,26 @@ TPUMPI_PROTO2(int, Recv_init,
 TPUMPI_PROTO2(int, Start, (MPI_Request *request))
 TPUMPI_PROTO2(int, Startall, (int count, MPI_Request requests[]))
 
+/* MPI-4 persistent collectives (schedule compiled at init, replayed
+ * by MPI_Start with zero per-call planning) */
+TPUMPI_PROTO2(int, Allreduce_init,
+              (const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+               MPI_Info info, MPI_Request *request))
+TPUMPI_PROTO2(int, Bcast_init,
+              (void *buffer, int count, MPI_Datatype datatype, int root,
+               MPI_Comm comm, MPI_Info info, MPI_Request *request))
+TPUMPI_PROTO2(int, Allgather_init,
+              (const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype,
+               MPI_Comm comm, MPI_Info info, MPI_Request *request))
+TPUMPI_PROTO2(int, Reduce_init,
+              (const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm,
+               MPI_Info info, MPI_Request *request))
+TPUMPI_PROTO2(int, Barrier_init,
+              (MPI_Comm comm, MPI_Info info, MPI_Request *request))
+
 /* matched probe */
 TPUMPI_PROTO2(int, Mprobe, (int source, int tag, MPI_Comm comm,
                             MPI_Message *message, MPI_Status *status))

@@ -239,6 +239,12 @@ enum TdcnStatIdx {
   TS_CHUNK_SHRINKS,      // adaptive chunk halvings under ring stall
   TS_SENDER_YIELDS,      // full-ring turns yielded to other peers' work
   TS_ENQUEUE_WAITS,      // enqueues that blocked on dcn_inflight_limit
+  // -- dispatch-floor tail (appended; version stays 1) ----------------
+  TS_COLL_FASTPATH_OPS,  // collectives served entirely by the C path
+  TS_SCHED_CACHE_HITS,   // compiled-schedule cache hits (tdcn_coll_plan)
+  TS_SCHED_CACHE_MISSES, // ... and compiles (misses)
+  TS_RECV_INTO_PLACED,   // receives landed straight in a posted buffer
+                         // (in-place eager memcpy or streamed RTS fill)
   TS_COUNT
 };
 
@@ -253,7 +259,9 @@ static const char *TDCN_STAT_NAMES =
     "dedup_drops,respawns,"
     "doorbells_suppressed,stream_msgs,stream_bytes,"
     "stream_depth,stream_depth_hwm,stream_inflight,stream_inflight_hwm,"
-    "chunk_shrinks,sender_yields,enqueue_waits";
+    "chunk_shrinks,sender_yields,enqueue_waits,"
+    "coll_fastpath_ops,sched_cache_hits,sched_cache_misses,"
+    "recv_into_placed";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -1174,6 +1182,7 @@ static uint64_t fill_reserve_locked(Engine *eng, const Env &e,
     if (placed) {
       *buf_out = (uint8_t *)st->user_buf;
       st->in_fill = true;
+      eng->stats.add(TS_RECV_INTO_PLACED, 1);
     }
     st->reserved = true;  // cancel now refuses (MPI: the reservation
                           // IS the match, and a matched receive is
@@ -2788,6 +2797,511 @@ static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
 }
 
 // ---------------------------------------------------------------------
+// C collective fast path (the dispatch-floor leg)
+// ---------------------------------------------------------------------
+//
+// Collective schedules run ENTIRELY in C over the existing engine: the
+// frames are ordinary FK_COLL eager/chunk/rndv records on a private
+// per-communicator stream ("<cid>#cfp" — disjoint from the Python
+// plane's str(cid) stream and the "<cid>#nbc<k>" NBC streams, so the
+// two planes' seq counters can never desynchronize even when calls
+// alternate between the C path and the embedded-Python fallback).
+// Schedules mirror ompi_tpu/dcn/collops.py EXACTLY — the linear
+// process-ordered fold at index 0 (+ linear bcast) below the ring
+// threshold, the ring reduce-scatter + allgather above it, with the
+// identical chunk bounds and fold bracketing — so MPI_SUM results are
+// bit-exact with the Python path at every size (the han-reproducible
+// contract, now shared by both planes).
+//
+// The compiled-schedule cache (tdcn_coll_plan) is the libnbc analog
+// (SURVEY §3.4): a plan — algorithm choice, chunk bounds, kernel
+// binding, peer resolution — is compiled once per (kind, op, dtype,
+// count, root) signature and replayed by tdcn_coll_start with zero
+// per-call planning; MPI-4 persistent collectives (MPI_Allreduce_init
+// + MPI_Start) ride it, and the blocking entry points share the same
+// cache so their dispatch floor drops too.
+
+// Wait for one coll-stream message (engine-internal; the C collective
+// schedules ride it).  Same slot discipline as tdcn_recv_coll: 0 =
+// delivered (payload moved into *out), 1 = timeout, -2 = watched proc
+// failed, -3 = engine closing.
+static int coll_wait_msg(Engine *eng, const std::string &scid, int64_t seq,
+                         int src, int fail_proc, double timeout_s,
+                         OwnedMsg *out) {
+  auto key = std::make_tuple(scid, seq, src);
+  std::unique_lock<std::mutex> g(eng->mu);
+  auto it = eng->coll.find(key);
+  CollSlot *slot;
+  if (it == eng->coll.end()) {
+    slot = new CollSlot();
+    eng->coll[key] = slot;
+  } else {
+    slot = it->second;
+  }
+  auto peer_failed = [&] {
+    return fail_proc >= 0 && (size_t)fail_proc < eng->failed.size() &&
+           eng->failed[fail_proc];
+  };
+  slot->waiters++;
+  bool ok = progress_wait(eng, g,
+                          [&] {
+                            return slot->ready.load() ||
+                                   eng->closing.load(
+                                       std::memory_order_relaxed) ||
+                                   peer_failed();
+                          },
+                          timeout_s);
+  slot->waiters--;
+  if (!ok || !slot->ready.load() || slot->consumed) {
+    int rc = 1;
+    if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
+    else if (peer_failed())
+      rc = -2;
+    if (slot->waiters == 0) {
+      if (slot->consumed) {
+        delete slot;
+      } else if (!slot->ready.load()) {
+        eng->coll.erase(key);
+        delete slot;
+      }
+    }
+    return rc;
+  }
+  *out = std::move(slot->msg);
+  slot->consumed = true;
+  eng->coll.erase(key);
+  if (slot->waiters == 0) delete slot;
+  return 0;
+}
+
+// -- op kernels ---------------------------------------------------------
+// acc[i] = acc[i] OP in[i], elementwise — bit-exact with the numpy
+// kernels the Python fold uses (IEEE add/mul; NaN-propagating max/min
+// matching np.maximum/np.minimum).  Unsupported (op, dtype) combos
+// resolve to a null kernel and the caller falls back to the
+// embedded-Python path (derived datatypes, user ops, pair types,
+// logical ops with numpy bool-cast semantics).
+
+typedef void (*coll_kfn)(void *, const void *, int64_t);
+
+template <class T>
+static void k_sum(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++) x[i] = (T)(x[i] + y[i]);
+}
+
+template <class T>
+static void k_prod(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++) x[i] = (T)(x[i] * y[i]);
+}
+
+// complex multiply, naive formula — what numpy's complex prod uses.
+// `n` is in SCALAR components (2 per complex element) like every other
+// kernel's count — the plan's kcount doubling applies uniformly.
+template <class T>
+static void k_cprod(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i + 1 < n; i += 2) {
+    T re = x[i] * y[i] - x[i + 1] * y[i + 1];
+    T im = x[i] * y[i + 1] + x[i + 1] * y[i];
+    x[i] = re;
+    x[i + 1] = im;
+  }
+}
+
+// max/min keep NaN like np.maximum/np.minimum: any NaN operand wins
+template <class T>
+static void k_max(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++)
+    x[i] = (x[i] > y[i] || x[i] != x[i]) ? x[i] : y[i];
+}
+
+template <class T>
+static void k_min(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++)
+    x[i] = (x[i] < y[i] || x[i] != x[i]) ? x[i] : y[i];
+}
+
+template <class T>
+static void k_band(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++) x[i] = (T)(x[i] & y[i]);
+}
+
+template <class T>
+static void k_bor(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++) x[i] = (T)(x[i] | y[i]);
+}
+
+template <class T>
+static void k_bxor(void *a, const void *b, int64_t n) {
+  T *x = (T *)a;
+  const T *y = (const T *)b;
+  for (int64_t i = 0; i < n; i++) x[i] = (T)(x[i] ^ y[i]);
+}
+
+// predefined contiguous datatype codes 1..27 (mpi.h order; the shim's
+// fp_dt twin): element byte size, integral?, float?, complex?
+struct CollDt {
+  int size;
+  int cls;  // 0 unsupported, 1 signed int, 2 unsigned int, 3 float,
+            // 4 complex
+};
+static const CollDt coll_dt[28] = {
+    {0, 0},  {1, 1}, {1, 1}, {1, 2}, {1, 2}, {2, 1}, {2, 2},
+    {4, 1},  {4, 2}, {8, 1}, {8, 2}, {8, 1}, {8, 2}, {4, 3},
+    {8, 3},  {0, 0}, {0, 0},  // MPI_C_BOOL: numpy bool add is logical
+    {1, 1},  {2, 1}, {4, 1}, {8, 1}, {1, 2}, {2, 2}, {4, 2},
+    {8, 2},  {8, 4}, {16, 4}, {4, 1}};
+
+// op codes (mpi.h): 1 SUM, 2 MAX, 3 MIN, 4 PROD, 8 BAND, 9 BOR,
+// 10 BXOR are C-served; everything else (logical ops, MAXLOC/MINLOC,
+// REPLACE/NO_OP, user ops) falls back.
+template <class T>
+static coll_kfn pick_int_kernel(int opcode) {
+  switch (opcode) {
+    case 1: return k_sum<T>;
+    case 2: return k_max<T>;
+    case 3: return k_min<T>;
+    case 4: return k_prod<T>;
+    case 8: return k_band<T>;
+    case 9: return k_bor<T>;
+    case 10: return k_bxor<T>;
+  }
+  return nullptr;
+}
+
+template <class T>
+static coll_kfn pick_float_kernel(int opcode) {
+  switch (opcode) {
+    case 1: return k_sum<T>;
+    case 2: return k_max<T>;
+    case 3: return k_min<T>;
+    case 4: return k_prod<T>;
+  }
+  return nullptr;
+}
+
+static coll_kfn coll_kernel(int opcode, int dtcode) {
+  if (dtcode < 1 || dtcode > 27) return nullptr;
+  const CollDt &d = coll_dt[dtcode];
+  switch (d.cls) {
+    case 1:
+      switch (d.size) {
+        case 1: return pick_int_kernel<int8_t>(opcode);
+        case 2: return pick_int_kernel<int16_t>(opcode);
+        case 4: return pick_int_kernel<int32_t>(opcode);
+        case 8: return pick_int_kernel<int64_t>(opcode);
+      }
+      return nullptr;
+    case 2:
+      switch (d.size) {
+        case 1: return pick_int_kernel<uint8_t>(opcode);
+        case 2: return pick_int_kernel<uint16_t>(opcode);
+        case 4: return pick_int_kernel<uint32_t>(opcode);
+        case 8: return pick_int_kernel<uint64_t>(opcode);
+      }
+      return nullptr;
+    case 3:
+      return d.size == 4 ? pick_float_kernel<float>(opcode)
+                         : pick_float_kernel<double>(opcode);
+    case 4:  // complex: componentwise SUM; naive-formula PROD
+      if (opcode == 1)
+        return d.size == 8 ? k_sum<float> : k_sum<double>;
+      if (opcode == 4)
+        return d.size == 8 ? k_cprod<float> : k_cprod<double>;
+      return nullptr;
+  }
+  return nullptr;
+}
+
+// kind codes shared with the shim (and dcn_sanity.cc)
+enum CollKind {
+  CK_BARRIER = 0,
+  CK_BCAST = 1,
+  CK_REDUCE = 2,
+  CK_ALLREDUCE = 3,
+  CK_ALLGATHER = 4,
+};
+
+enum CollAlgo { CA_LINEAR = 0, CA_RING = 1 };
+
+struct CollCtx;
+
+// One compiled schedule: algorithm choice, chunk plan, kernel binding
+// — everything per-call planning would otherwise recompute.  Replayed
+// by tdcn_coll_start with the caller's buffers bound at start time
+// (the cache key deliberately excludes buffer addresses so persistent
+// requests and the blocking entry points share entries).
+struct CollPlan {
+  CollCtx *ctx = nullptr;
+  int kind = 0, opcode = 0, dtcode = 0, root = 0, algo = CA_LINEAR;
+  int64_t count = 0;
+  uint64_t nbytes = 0;  // per-rank payload bytes
+  int esize = 0;
+  coll_kfn kfn = nullptr;
+  // complex kernels fold component-wise: element count presented to
+  // the kernel (2x for complex SUM)
+  int64_t kcount = 0;
+  std::vector<uint64_t> bounds;  // ring chunk bounds, in elements
+};
+
+struct CollCtx {
+  Engine *eng = nullptr;
+  std::string cid;  // private stream: "<comm cid>#cfp"
+  int me = 0, nprocs = 0;
+  std::vector<std::string> addrs;
+  std::vector<Peer *> peers;   // resolved lazily (get_peer)
+  std::vector<int> fail_idx;   // root-engine proc per member (-1 none)
+  int64_t seq = 0;             // SPMD stream counter (same burn order
+                               // on every member by MPI issue order)
+  uint64_t ring_threshold = 64ull << 10;
+  std::mutex mu;  // plan cache (collective calls themselves are
+                  // serialized per comm by MPI semantics)
+  // keyed (kind, op, dtype, count, root, RESOLVED algo): the algo
+  // component keeps a forced/tuned/reproducible decision from being
+  // shadowed by an earlier same-signature plan that resolved the
+  // engine crossover differently
+  std::map<std::tuple<int, int, int, int64_t, int, int>, CollPlan *>
+      plans;
+};
+
+static Peer *cctx_peer(CollCtx *c, int p) {
+  Peer *pe = c->peers[p];
+  if (!pe) {
+    pe = get_peer(c->eng, c->addrs[p]);
+    c->peers[p] = pe;
+  }
+  return pe;
+}
+
+static int cctx_send(CollCtx *c, int dst, int64_t seq, const void *data,
+                     uint64_t nbytes) {
+  Env e;
+  e.kind = FK_COLL;
+  e.cid = c->cid;
+  e.seq = seq;
+  e.src = c->me;
+  e.dst = 0;
+  e.tag = 0;
+  return engine_send_peer(c->eng, cctx_peer(c, dst), e, data, nbytes);
+}
+
+// Receive one schedule message.  A C collective that already moved
+// frames cannot fall back mid-call, so timeouts retry — but not
+// forever: a watched member's death breaks out via -2 (fail_idx),
+// and a silent wedge (or an unwatched member, e.g. addresses that
+// never resolved against the root table) gives up after ~600 s with
+// -5, which the shim surfaces through the comm's errhandler — a loud
+// failure instead of an untraceable infinite hang.
+static int cctx_recv_msg(CollCtx *c, int64_t seq, int src, OwnedMsg *out) {
+  for (int tries = 0; tries < 5; tries++) {
+    int rc = coll_wait_msg(c->eng, c->cid, seq, src, c->fail_idx[src],
+                           120.0, out);
+    if (rc != 1) return rc;
+  }
+  c->eng->stats.add(TS_DEADLINE_EXPIRED, 1);
+  return -5;
+}
+
+static int cctx_recv_into(CollCtx *c, int64_t seq, int src, void *dst,
+                          uint64_t cap) {
+  OwnedMsg m;
+  int rc = cctx_recv_msg(c, seq, src, &m);
+  if (rc != 0) return rc;
+  uint64_t n = m.nbytes < cap ? m.nbytes : cap;
+  if (n && dst) memcpy(dst, m.data, n);
+  free(m.data);
+  return 0;
+}
+
+// -- schedule execution (the replay tdcn_coll_start drives) ------------
+
+static int plan_linear_fold(CollCtx *c, CollPlan *pl, int root,
+                            const void *sendbuf, void *recvbuf,
+                            int64_t seq) {
+  // process-ordered fold at `root`: contributions fold in ascending
+  // member order — the deterministic bracketing collops.allreduce /
+  // han.reduce document (bit-exact MPI_SUM contract)
+  if (c->me != root)
+    return cctx_send(c, root, seq, sendbuf, pl->nbytes);
+  // MPI_IN_PLACE at a non-first root: the fold writes recvbuf from
+  // member 0 upward, which would destroy the root's own (aliased)
+  // contribution before its turn in the order — snapshot it first
+  std::vector<uint8_t> own;
+  const uint8_t *self_src = (const uint8_t *)sendbuf;
+  if (root != 0 && sendbuf == recvbuf && pl->nbytes) {
+    own.assign((const uint8_t *)sendbuf,
+               (const uint8_t *)sendbuf + pl->nbytes);
+    self_src = own.data();
+  }
+  for (int p = 0; p < c->nprocs; p++) {
+    if (p == c->me) {
+      if (p == 0) {
+        if (recvbuf != self_src) memcpy(recvbuf, self_src, pl->nbytes);
+      } else {
+        pl->kfn(recvbuf, self_src, pl->kcount);
+      }
+      continue;
+    }
+    OwnedMsg m;
+    int rc = cctx_recv_msg(c, seq, p, &m);
+    if (rc != 0) return rc;
+    if (m.nbytes < pl->nbytes) {
+      free(m.data);
+      return -4;  // short frame: schedule mismatch, surface loudly
+    }
+    if (p == 0) {
+      memcpy(recvbuf, m.data, pl->nbytes);
+    } else {
+      pl->kfn(recvbuf, m.data, pl->kcount);
+    }
+    free(m.data);
+  }
+  return 0;
+}
+
+static int plan_ring_allreduce(CollCtx *c, CollPlan *pl,
+                               const void *sendbuf, void *recvbuf) {
+  // ring reduce-scatter + ring allgather, chunk bounds precompiled —
+  // the exact schedule (and fold bracketing: got OP acc, commutative
+  // ops only so the C acc-OP-got is bit-identical) of
+  // collops._allreduce_ring
+  int P = c->nprocs, me = c->me;
+  int right = (me + 1) % P, left = (me - 1 + P) % P;
+  uint8_t *acc = (uint8_t *)recvbuf;
+  if (recvbuf != sendbuf) memcpy(recvbuf, sendbuf, pl->nbytes);
+  int64_t seq0 = c->seq;
+  c->seq += 2 * (P - 1);
+  int es = pl->esize;
+  auto off = [&](int i) { return pl->bounds[i] * (uint64_t)es; };
+  auto len = [&](int i) {
+    return (pl->bounds[i + 1] - pl->bounds[i]) * (uint64_t)es;
+  };
+  auto elems = [&](int i) {
+    int64_t n = (int64_t)(pl->bounds[i + 1] - pl->bounds[i]);
+    // complex kernels fold componentwise (2 scalars per element)
+    return pl->kcount == pl->count ? n : 2 * n;
+  };
+  for (int s = 0; s < P - 1; s++) {
+    int send_i = ((me - s) % P + P) % P;
+    int recv_i = ((me - s - 1) % P + P) % P;
+    int rc = cctx_send(c, right, seq0 + s, acc + off(send_i), len(send_i));
+    if (rc != 0) return rc;
+    OwnedMsg m;
+    rc = cctx_recv_msg(c, seq0 + s, left, &m);
+    if (rc != 0) return rc;
+    if (m.nbytes < len(recv_i)) {
+      free(m.data);
+      return -4;
+    }
+    pl->kfn(acc + off(recv_i), m.data, elems(recv_i));
+    free(m.data);
+  }
+  for (int s = 0; s < P - 1; s++) {
+    int64_t seq = seq0 + (P - 1) + s;
+    int send_i = ((me + 1 - s) % P + P) % P;
+    int recv_i = ((me - s) % P + P) % P;
+    int rc = cctx_send(c, right, seq, acc + off(send_i), len(send_i));
+    if (rc != 0) return rc;
+    rc = cctx_recv_into(c, seq, left, acc + off(recv_i), len(recv_i));
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+static int plan_exec(CollCtx *c, CollPlan *pl, const void *sendbuf,
+                     void *recvbuf) {
+  Engine *eng = c->eng;
+  int P = c->nprocs, me = c->me;
+  if (P == 1) {
+    if (pl->kind != CK_BARRIER && recvbuf && sendbuf &&
+        recvbuf != sendbuf)
+      memcpy(recvbuf, sendbuf, pl->nbytes);
+    eng->stats.add(TS_COLL_FASTPATH_OPS, 1);
+    return 0;
+  }
+  int rc = 0;
+  switch (pl->kind) {
+    case CK_BARRIER: {
+      // linear fold + bcast of an empty token at index 0 — the same
+      // 2-seq shape as the Python barrier's token allreduce
+      int64_t sg = c->seq++, sb = c->seq++;
+      if (me == 0) {
+        for (int p = 1; p < P && rc == 0; p++)
+          rc = cctx_recv_into(c, sg, p, nullptr, 0);
+        for (int p = 1; p < P && rc == 0; p++)
+          rc = cctx_send(c, p, sb, nullptr, 0);
+      } else {
+        rc = cctx_send(c, 0, sg, nullptr, 0);
+        if (rc == 0) rc = cctx_recv_into(c, sb, 0, nullptr, 0);
+      }
+      break;
+    }
+    case CK_BCAST: {
+      int64_t seq = c->seq++;
+      if (me == pl->root) {
+        for (int p = 0; p < P && rc == 0; p++)
+          if (p != me) rc = cctx_send(c, p, seq, recvbuf, pl->nbytes);
+      } else {
+        rc = cctx_recv_into(c, seq, pl->root, recvbuf, pl->nbytes);
+      }
+      break;
+    }
+    case CK_REDUCE: {
+      int64_t seq = c->seq++;
+      rc = plan_linear_fold(c, pl, pl->root, sendbuf, recvbuf, seq);
+      break;
+    }
+    case CK_ALLREDUCE: {
+      if (pl->algo == CA_RING) {
+        rc = plan_ring_allreduce(c, pl, sendbuf, recvbuf);
+        break;
+      }
+      int64_t sg = c->seq++, sb = c->seq++;
+      rc = plan_linear_fold(c, pl, 0, sendbuf, recvbuf, sg);
+      if (rc == 0) {
+        if (me == 0) {
+          for (int p = 1; p < P && rc == 0; p++)
+            rc = cctx_send(c, p, sb, recvbuf, pl->nbytes);
+        } else {
+          rc = cctx_recv_into(c, sb, 0, recvbuf, pl->nbytes);
+        }
+      }
+      break;
+    }
+    case CK_ALLGATHER: {
+      int64_t seq = c->seq++;
+      uint8_t *out = (uint8_t *)recvbuf;
+      if (out + (uint64_t)me * pl->nbytes != sendbuf)
+        memcpy(out + (uint64_t)me * pl->nbytes, sendbuf, pl->nbytes);
+      for (int p = 0; p < P && rc == 0; p++)
+        if (p != me) rc = cctx_send(c, p, seq, sendbuf, pl->nbytes);
+      for (int p = 0; p < P && rc == 0; p++)
+        if (p != me)
+          rc = cctx_recv_into(c, seq, p, out + (uint64_t)p * pl->nbytes,
+                              pl->nbytes);
+      break;
+    }
+    default:
+      return -4;
+  }
+  if (rc == 0) eng->stats.add(TS_COLL_FASTPATH_OPS, 1);
+  return rc;
+}
+
+// ---------------------------------------------------------------------
 // C API
 // ---------------------------------------------------------------------
 
@@ -3010,52 +3524,142 @@ int tdcn_recv_coll(void *h, const char *cid, int64_t seq, int src,
   // engines use sub-local indices); `fail_proc` is the ROOT engine
   // index to watch for failure (-1 = none, e.g. across spawn worlds).
   Engine *eng = (Engine *)h;
-  auto key = std::make_tuple(std::string(cid ? cid : ""), seq, src);
-  std::unique_lock<std::mutex> g(eng->mu);
-  auto it = eng->coll.find(key);
-  CollSlot *slot;
-  if (it == eng->coll.end()) {
-    slot = new CollSlot();
-    eng->coll[key] = slot;
-  } else {
-    slot = it->second;
-  }
-  auto peer_failed = [&] {
-    return fail_proc >= 0 && (size_t)fail_proc < eng->failed.size() &&
-           eng->failed[fail_proc];
-  };
-  slot->waiters++;
-  bool ok = progress_wait(eng, g,
-                          [&] {
-                            return slot->ready.load() ||
-                                   eng->closing.load(
-                                       std::memory_order_relaxed) ||
-                                   peer_failed();
-                          },
-                          timeout_s);
-  slot->waiters--;
-  if (!ok || !slot->ready.load() || slot->consumed) {
-    int rc = 1;  // timeout (or another waiter consumed the one-shot)
-    if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
-    else if (peer_failed())
-      rc = -2;  // peer failed
-    if (slot->waiters == 0) {
-      // last one out reclaims; a ready-but-unconsumed slot stays
-      // registered for a later recv on the same key
-      if (slot->consumed) {
-        delete slot;  // key already erased by the consumer
-      } else if (!slot->ready.load()) {
-        eng->coll.erase(key);
-        delete slot;
+  OwnedMsg m;
+  int rc = coll_wait_msg(eng, std::string(cid ? cid : ""), seq, src,
+                         fail_proc, timeout_s, &m);
+  if (rc != 0) return rc;
+  msg_into_tdcn(m, out);
+  return 0;
+}
+
+// -- C collective fast path ---------------------------------------------
+
+// Open a per-communicator collective context: the member addresses
+// (comm order), this process's member index, and the private stream
+// ("<cid>#cfp") the C schedules run on.  `ring_threshold` mirrors the
+// engine's DCN ring crossover so the C decision matches the Python
+// plane's bit for bit.  Returns a handle (0 on failure).
+uint64_t tdcn_coll_open(void *h, const char *cid, int me, int nprocs,
+                        const char *const *addrs,
+                        uint64_t ring_threshold) {
+  Engine *eng = (Engine *)h;
+  if (!cid || me < 0 || nprocs < 1 || me >= nprocs) return 0;
+  CollCtx *c = new CollCtx();
+  c->eng = eng;
+  c->cid = std::string(cid) + "#cfp";
+  c->me = me;
+  c->nprocs = nprocs;
+  if (ring_threshold) c->ring_threshold = ring_threshold;
+  c->addrs.resize(nprocs);
+  c->peers.assign(nprocs, nullptr);
+  c->fail_idx.assign(nprocs, -1);
+  for (int p = 0; p < nprocs; p++) {
+    c->addrs[p] = addrs && addrs[p] ? addrs[p] : "";
+    for (size_t q = 0; q < eng->peer_addresses.size(); q++) {
+      if (!c->addrs[p].empty() && eng->peer_addresses[q] == c->addrs[p]) {
+        c->fail_idx[p] = (int)q;
+        break;
       }
     }
-    return rc;
   }
-  msg_into_tdcn(slot->msg, out);
-  slot->consumed = true;
-  eng->coll.erase(key);
-  if (slot->waiters == 0) delete slot;
-  return 0;
+  return (uint64_t)(uintptr_t)c;
+}
+
+void tdcn_coll_close(void *h, uint64_t cctx) {
+  (void)h;
+  CollCtx *c = (CollCtx *)(uintptr_t)cctx;
+  if (!c) return;
+  for (auto &kv : c->plans) delete kv.second;
+  delete c;
+}
+
+// Compile-or-fetch a schedule for one call signature.  `algo` -1 lets
+// the engine decide (the collops crossover: ring for >= ring_threshold
+// commutative allreduce, linear otherwise); >= 0 forces the caller's
+// choice (the coll/tuned decision a persistent init resolved through
+// embedded Python ONCE).  Returns the plan handle, or 0 when the
+// signature is not C-serviceable (caller falls back to the Python
+// path).  Cache keyed (kind, op, dtype, count, root) — hits replay
+// with zero planning (TS_SCHED_CACHE_HITS / _MISSES account it).
+uint64_t tdcn_coll_plan(void *h, uint64_t cctx, int kind, int opcode,
+                        int dtcode, int64_t count, int root, int algo) {
+  Engine *eng = (Engine *)h;
+  CollCtx *c = (CollCtx *)(uintptr_t)cctx;
+  if (!c || count < 0) return 0;
+  if (dtcode < 1 || dtcode > 27 || coll_dt[dtcode].cls == 0) return 0;
+  if (kind < CK_BARRIER || kind > CK_ALLGATHER) return 0;
+  if (root < 0 || root >= c->nprocs) return 0;
+  // resolve the algorithm BEFORE the cache lookup (part of the key):
+  // only allreduce has a ring variant; the caller's compiled decision
+  // wins, else the collops crossover (every C-served op is
+  // commutative, so the Python plane's commutativity gate is
+  // satisfied by construction)
+  uint64_t nbytes = (uint64_t)count * (uint64_t)coll_dt[dtcode].size;
+  int ralgo = CA_LINEAR;
+  if (kind == CK_ALLREDUCE)
+    ralgo = algo >= 0 ? algo
+                      : (nbytes >= c->ring_threshold && c->nprocs > 1
+                             ? CA_RING
+                             : CA_LINEAR);
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->plans.find({kind, opcode, dtcode, count, root, ralgo});
+    if (it != c->plans.end()) {
+      eng->stats.add(TS_SCHED_CACHE_HITS, 1);
+      return (uint64_t)(uintptr_t)it->second;
+    }
+  }
+  CollPlan *pl = new CollPlan();
+  pl->ctx = c;
+  pl->kind = kind;
+  pl->opcode = opcode;
+  pl->dtcode = dtcode;
+  pl->count = count;
+  pl->root = root;
+  pl->esize = coll_dt[dtcode].size;
+  pl->nbytes = (uint64_t)count * (uint64_t)pl->esize;
+  pl->kcount = coll_dt[dtcode].cls == 4 ? 2 * count : count;
+  if (kind == CK_REDUCE || kind == CK_ALLREDUCE) {
+    pl->kfn = coll_kernel(opcode, dtcode);
+    if (!pl->kfn) {
+      delete pl;
+      return 0;  // unsupported op x dtype: embedded-Python fallback
+    }
+  }
+  if (kind == CK_ALLREDUCE) {
+    pl->algo = ralgo;
+    if (pl->algo == CA_RING) {
+      // chunk plan (np.array_split bounds: sizes differ by <= 1)
+      int P = c->nprocs;
+      int64_t base = count / P, extra = count % P;
+      pl->bounds.resize(P + 1);
+      pl->bounds[0] = 0;
+      for (int i = 0; i < P; i++)
+        pl->bounds[i + 1] =
+            pl->bounds[i] + (uint64_t)(base + (i < extra ? 1 : 0));
+    }
+  }
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->plans.find({kind, opcode, dtcode, count, root, ralgo});
+  if (it != c->plans.end()) {  // raced compile: keep the first
+    delete pl;
+    eng->stats.add(TS_SCHED_CACHE_HITS, 1);
+    return (uint64_t)(uintptr_t)it->second;
+  }
+  eng->stats.add(TS_SCHED_CACHE_MISSES, 1);
+  c->plans[{kind, opcode, dtcode, count, root, ralgo}] = pl;
+  return (uint64_t)(uintptr_t)pl;
+}
+
+// Replay one compiled schedule with the caller's buffers.  0 = done,
+// -1 = transport failure (ULFM escalation path), -2 = watched member
+// failed, -3 = engine closing, -4 = schedule mismatch.
+int tdcn_coll_start(void *h, uint64_t plan, const void *sendbuf,
+                    void *recvbuf) {
+  (void)h;
+  CollPlan *pl = (CollPlan *)(uintptr_t)plan;
+  if (!pl || !pl->ctx) return -4;
+  return plan_exec(pl->ctx, pl, sendbuf, recvbuf);
 }
 
 // Post a receive that CARRIES its destination buffer: an in-order
@@ -3494,12 +4098,17 @@ int tdcn_chan_send1(void *h, uint64_t chan, int kind, int src, int dst,
   return engine_send_peer(c->eng, c->peer, e, data, nbytes);
 }
 
-int tdcn_precv(void *h, const char *cid, int dst, int src, int tag,
-               int fail_proc, double timeout_s, TdcnMsg *out) {
-  // blocking receive in ONE crossing: match-or-post, then sleep on the
-  // request's condvar until the C receiver thread completes it (or the
-  // watched root proc is marked failed / the engine closes)
-  Engine *eng = (Engine *)h;
+// Shared body of tdcn_precv / tdcn_precv_into: match-or-post (the
+// post CARRIES the destination buffer, so a racing in-order streaming
+// RTS reserves it and lands FRAGs straight in the user buffer — no
+// reassembly malloc, no delivery copy), then sleep on the request's
+// condvar.  On delivery through the copy path the payload is moved
+// into `buf` here (out->data == buf tells the caller nothing is left
+// to copy or free); oversized payloads stay engine-owned so MPI
+// truncation semantics survive at the caller.
+static int precv_impl(Engine *eng, const char *cid, int dst, int src,
+                      int tag, int fail_proc, double timeout_s, void *buf,
+                      uint64_t cap, TdcnMsg *out) {
   fault_recv_check(eng);  // faultsim recv site (one relaxed load off)
   std::unique_lock<std::mutex> g(eng->mu);
   CidQueues &q = eng->p2p[cid ? cid : ""];
@@ -3509,26 +4118,46 @@ int tdcn_precv(void *h, const char *cid, int dst, int src, int tag,
         (tag == -1 || tag == it->env.tag)) {
       msg_into_tdcn(*it, out);
       uq.erase(it);
+      g.unlock();  // the payload memcpy must not hold the engine lock
+      if (buf && !out->pyhandle && out->data && out->nbytes <= cap) {
+        if (out->nbytes) memcpy(buf, out->data, out->nbytes);
+        free(out->data);
+        out->data = buf;
+      }
       return 0;
     }
   }
   uint64_t rid = eng->next_req++;
   ReqState *st = new ReqState();
+  st->user_buf = buf;
+  st->user_cap = cap;
   eng->reqs[rid] = st;
   q.posted[dst].push_back(PostedReq{rid, src, tag, eng->arrival++});
   auto failed = [&] {
     return fail_proc >= 0 && (size_t)fail_proc < eng->failed.size() &&
            eng->failed[fail_proc];
   };
-  bool ok = progress_wait(eng, g,
-                          [&] {
-                            return st->completed.load() ||
-                                   eng->closing.load(
-                                       std::memory_order_relaxed) ||
-                                   failed();
-                          },
-                          timeout_s);
-  if (!ok || !st->completed) {
+  for (;;) {
+    bool ok = progress_wait(eng, g,
+                            [&] {
+                              return st->completed.load() ||
+                                     eng->closing.load(
+                                         std::memory_order_relaxed) ||
+                                     failed();
+                            },
+                            timeout_s);
+    if (ok && st->completed) break;
+    if (st->reserved && !eng->closing.load(std::memory_order_relaxed) &&
+        !failed()) {
+      // matched at RTS time (the MPI match happened and the sender's
+      // order-gate slot was consumed there): the request can no
+      // longer be withdrawn — a timeout-return here would orphan the
+      // in-flight transfer, lose the message, and wedge the caller's
+      // retry (and every ordered message queued behind it) forever —
+      // the PR 8 copy-path stall.  Keep waiting; failure and close
+      // still break out.
+      continue;
+    }
     int rc = 1;
     if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
     else if (failed())
@@ -3541,14 +4170,50 @@ int tdcn_precv(void *h, const char *cid, int dst, int src, int tag,
         break;
       }
     }
+    // a reserved request was already erased from the posted list by
+    // fill_reserve_locked; erasing the rid here makes the in-flight
+    // transfer's eventual fill_complete a lookup miss (its payload is
+    // dropped — the comm is failing anyway), and every ReqState access
+    // goes through the reqs map, so the delete cannot race the
+    // consumer thread (which only ever writes the user buffer)
     eng->reqs.erase(rid);
     delete st;
     return rc;
   }
+  bool in_fill = st->in_fill;
   msg_into_tdcn(st->msg, out);
   eng->reqs.erase(rid);
   delete st;
+  g.unlock();
+  if (!in_fill && buf && !out->pyhandle && out->data &&
+      out->nbytes <= cap) {
+    if (out->nbytes) memcpy(buf, out->data, out->nbytes);
+    free(out->data);
+    out->data = buf;  // caller contract: nothing to copy, nothing to free
+  }
   return 0;
+}
+
+int tdcn_precv(void *h, const char *cid, int dst, int src, int tag,
+               int fail_proc, double timeout_s, TdcnMsg *out) {
+  // blocking receive in ONE crossing: match-or-post, then sleep on the
+  // request's condvar until the C receiver thread completes it (or the
+  // watched root proc is marked failed / the engine closes)
+  return precv_impl((Engine *)h, cid, dst, src, tag, fail_proc, timeout_s,
+                    nullptr, 0, out);
+}
+
+// tdcn_precv with the destination buffer carried on the post: the
+// MPI_Recv fast path stops taking the copy path when it races the
+// sender's RTS — the receive side of the PR 8 in-place placement
+// story.  out->data == buf after return means the payload is already
+// in place (no copy, no free); an oversized payload is returned
+// engine-owned for the caller's truncation handling.
+int tdcn_precv_into(void *h, const char *cid, int dst, int src, int tag,
+                    int fail_proc, double timeout_s, void *buf,
+                    uint64_t cap, TdcnMsg *out) {
+  return precv_impl((Engine *)h, cid, dst, src, tag, fail_proc, timeout_s,
+                    buf, cap, out);
 }
 
 int tdcn_is_failed(void *h, int proc) {

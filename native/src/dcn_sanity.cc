@@ -71,6 +71,12 @@ extern uint64_t tdcn_post_recv_into(void *, const char *, int, int, int,
 extern void tdcn_free(void *);
 extern void tdcn_close(void *);
 extern void tdcn_destroy(void *);
+extern uint64_t tdcn_coll_open(void *, const char *, int, int,
+                               const char *const *, uint64_t);
+extern void tdcn_coll_close(void *, uint64_t);
+extern uint64_t tdcn_coll_plan(void *, uint64_t, int, int, int, int64_t,
+                               int, int);
+extern int tdcn_coll_start(void *, uint64_t, const void *, void *);
 }
 
 enum { FK_COLL = 0, FK_P2P = 1 };
@@ -318,6 +324,120 @@ static void exercise_stream(void *a, void *b) {
   tdcn_set_stream(a, 512u << 10, 32u << 20, 1);
 }
 
+// C collective fast path (the dispatch-floor leg): both members run
+// their compiled schedules concurrently — barrier, linear and ring
+// allreduce, rooted reduce/bcast, allgather, plan-cache identity, and
+// the persistent replay loop, all under the sanitizers.
+static void coll_side(void *eng, uint64_t cx, int me, const char *label) {
+  // barrier (kind 0)
+  uint64_t pl = tdcn_coll_plan(eng, cx, 0, 0, 7, 0, 0, -1);
+  CHECK(pl != 0, "%s coll barrier plan", label);
+  CHECK(tdcn_coll_start(eng, pl, nullptr, nullptr) == 0,
+        "%s coll barrier", label);
+
+  // small float SUM allreduce (linear fold) + plan-cache identity +
+  // persistent-style replay
+  enum { N = 33 };
+  float x[N], r[N];
+  uint64_t pa = tdcn_coll_plan(eng, cx, 3, 1, 13, N, 0, -1);
+  CHECK(pa != 0, "%s allreduce plan", label);
+  CHECK(tdcn_coll_plan(eng, cx, 3, 1, 13, N, 0, -1) == pa,
+        "%s plan cache identity", label);
+  for (int round = 0; round < 3; round++) {
+    for (int i = 0; i < N; i++) x[i] = (float)(me + 1 + round) + 0.5f * i;
+    int rc = tdcn_coll_start(eng, pa, x, r);
+    CHECK(rc == 0, "%s allreduce start rc=%d", label, rc);
+    for (int i = 0; i < N; i++) {
+      float e = ((float)(1 + round) + 0.5f * i) +
+                ((float)(2 + round) + 0.5f * i);
+      if (r[i] != e) {
+        CHECK(0, "%s allreduce round %d value @%d", label, round, i);
+        break;
+      }
+    }
+  }
+
+  // ring crossover: 64 KiB of floats over a 32 KiB threshold
+  {
+    const int64_t BIGN = 16384;
+    std::vector<float> bx(BIGN), br(BIGN);
+    for (int64_t i = 0; i < BIGN; i++)
+      bx[(size_t)i] = (float)(me + 1) + (float)(i & 255);
+    uint64_t pb = tdcn_coll_plan(eng, cx, 3, 1, 13, BIGN, 0, -1);
+    CHECK(pb != 0, "%s ring allreduce plan", label);
+    int rc = tdcn_coll_start(eng, pb, bx.data(), br.data());
+    CHECK(rc == 0, "%s ring allreduce rc=%d", label, rc);
+    for (int64_t i = 0; i < BIGN; i += 251) {
+      float e = (1.0f + (float)(i & 255)) + (2.0f + (float)(i & 255));
+      CHECK(br[(size_t)i] == e, "%s ring allreduce @%lld", label,
+            (long long)i);
+    }
+    // a FORCED algorithm (the tuned/reproducible decision) must not be
+    // shadowed by the cached crossover-resolved plan: same signature,
+    // forced linear → a DISTINCT plan that still computes the same sum
+    uint64_t plin = tdcn_coll_plan(eng, cx, 3, 1, 13, BIGN, 0, 0);
+    CHECK(plin != 0 && plin != pb, "%s forced-algo plan distinct",
+          label);
+    rc = tdcn_coll_start(eng, plin, bx.data(), br.data());
+    CHECK(rc == 0, "%s forced-linear allreduce rc=%d", label, rc);
+    for (int64_t i = 0; i < BIGN; i += 509) {
+      float e = (1.0f + (float)(i & 255)) + (2.0f + (float)(i & 255));
+      CHECK(br[(size_t)i] == e, "%s forced-linear @%lld", label,
+            (long long)i);
+    }
+  }
+
+  // rooted reduce (double SUM at root 1) and bcast (root 0)
+  {
+    double dx[3] = {0.5 + me, 1.25 * (me + 1), -2.0 * me};
+    double dr[3] = {0, 0, 0};
+    uint64_t pr = tdcn_coll_plan(eng, cx, 2, 1, 14, 3, 1, -1);
+    CHECK(pr != 0, "%s reduce plan", label);
+    CHECK(tdcn_coll_start(eng, pr, dx, dr) == 0, "%s reduce", label);
+    if (me == 1)
+      CHECK(dr[0] == 2.0 && dr[1] == 3.75 && dr[2] == -2.0,
+            "%s reduce values", label);
+    int32_t bv[4] = {0, 0, 0, 0};
+    if (me == 0)
+      for (int i = 0; i < 4; i++) bv[i] = 40 + i;
+    uint64_t pc = tdcn_coll_plan(eng, cx, 1, 0, 7, 4, 0, -1);
+    CHECK(pc != 0, "%s bcast plan", label);
+    CHECK(tdcn_coll_start(eng, pc, bv, bv) == 0, "%s bcast", label);
+    CHECK(bv[0] == 40 && bv[3] == 43, "%s bcast values", label);
+  }
+
+  // allgather
+  {
+    int32_t gv[2] = {me * 10, me * 10 + 1};
+    int32_t ga[4] = {0, 0, 0, 0};
+    uint64_t pg = tdcn_coll_plan(eng, cx, 4, 0, 7, 2, 0, -1);
+    CHECK(pg != 0, "%s allgather plan", label);
+    CHECK(tdcn_coll_start(eng, pg, gv, ga) == 0, "%s allgather", label);
+    CHECK(ga[0] == 0 && ga[1] == 1 && ga[2] == 10 && ga[3] == 11,
+          "%s allgather values", label);
+  }
+
+  // unsupported signatures must refuse a plan (fallback contract)
+  CHECK(tdcn_coll_plan(eng, cx, 3, 5 /* LAND */, 7, 4, 0, -1) == 0,
+        "%s LAND must not plan", label);
+  CHECK(tdcn_coll_plan(eng, cx, 3, 1, 16 /* bool */, 4, 0, -1) == 0,
+        "%s bool must not plan", label);
+}
+
+static void exercise_coll(void *a, void *b, const char *label) {
+  std::string aa = tdcn_address(a), bb = tdcn_address(b);
+  const char *addrs[2] = {aa.c_str(), bb.c_str()};
+  uint64_t ca = tdcn_coll_open(a, "csec", 0, 2, addrs, 32 * 1024);
+  uint64_t cb = tdcn_coll_open(b, "csec", 1, 2, addrs, 32 * 1024);
+  CHECK(ca != 0 && cb != 0, "%s coll_open", label);
+  if (!ca || !cb) return;
+  std::thread tb([&] { coll_side(b, cb, 1, label); });
+  coll_side(a, ca, 0, label);
+  tb.join();
+  tdcn_coll_close(a, ca);
+  tdcn_coll_close(b, cb);
+}
+
 int main() {
   // pair 1: same host id → shared-memory rings
   void *a = create_engine(0, 2, "sanhost");
@@ -333,6 +453,7 @@ int main() {
   tdcn_set_ring_timeout(b, 30.0);
   exercise_pair(a, b, "shm");
   exercise_stream(a, b);
+  exercise_coll(a, b, "shm");
   // full teardown (close + reader drain + free) so the ASan leg's
   // leak check sees only REAL lost allocations, not the documented
   // intentional close()-time engine leak
@@ -350,6 +471,7 @@ int main() {
     tdcn_set_addresses(d, joined.c_str());
   }
   exercise_pair(c, d, "tcp");
+  exercise_coll(c, d, "tcp");
   tdcn_destroy(c);
   tdcn_destroy(d);
 

@@ -429,6 +429,17 @@ extern int tdcn_send_local_data(void *, int, const char *, long long, int,
                                 unsigned long long);
 extern int tdcn_precv(void *, const char *, int, int, int, int, double,
                       tdcn_msg_t *);
+extern int tdcn_precv_into(void *, const char *, int, int, int, int,
+                           double, void *, unsigned long long,
+                           tdcn_msg_t *);
+extern unsigned long long tdcn_coll_open(void *, const char *, int, int,
+                                         const char *const *,
+                                         unsigned long long);
+extern void tdcn_coll_close(void *, unsigned long long);
+extern unsigned long long tdcn_coll_plan(void *, unsigned long long, int,
+                                         int, int, long long, int, int);
+extern int tdcn_coll_start(void *, unsigned long long, const void *,
+                           void *);
 extern unsigned long long tdcn_post_recv(void *, const char *, int, int,
                                          int);
 extern unsigned long long tdcn_post_recv_into(void *, const char *, int,
@@ -462,6 +473,10 @@ typedef struct {
   long long *offsets;        /* nprocs+1 */
   char **addrs;              /* per proc */
   unsigned long long *chans; /* per proc, 0 = unopened */
+  unsigned long long cctx;   /* C collective context (opened lazily) */
+  unsigned long long ring_thr; /* DCN ring-allreduce crossover bytes
+                                * (mirrors the Python plane's decision
+                                * so both paths pick one schedule) */
 } tpumpi_fp;
 
 /* Individually-malloc'd slots (outstanding requests hold tpumpi_fp*,
@@ -571,6 +586,11 @@ static tpumpi_fp *fp_get(MPI_Comm comm) {
       fp->addrs[n++] = strdup(a);
     if (n != fp->nprocs) return NULL;
   }
+  /* optional trailing field: the DCN ring-allreduce crossover bytes
+   * (absent on older info strings → the engine default) */
+  fp->ring_thr = 0;
+  if ((tok = strtok_r(NULL, "\x1f", &save)) != NULL)
+    fp->ring_thr = strtoull(tok, NULL, 10);
   for (int p = 0; p < fp->nprocs; p++)
     if (fp->my_rank >= fp->offsets[p] && fp->my_rank < fp->offsets[p + 1])
       fp->my_proc = p;
@@ -587,6 +607,7 @@ static int fp_live_refs(const tpumpi_fp *fp); /* scans g_fpreq, below */
 /* tear down one slot's wiring and free it (index entry already gone) */
 static void fp_release(tpumpi_fp *fp) {
   if (fp->state == 1 || fp->state == 2) {
+    if (fp->cctx) tdcn_coll_close(fp->eng, fp->cctx);
     for (int p = 0; p < fp->nprocs; p++) {
       if (fp->chans && fp->chans[p])
         tdcn_chan_close(fp->eng, fp->chans[p]);
@@ -641,11 +662,18 @@ typedef struct {
   int used;
   int is_send; /* eager: complete at issue */
   int zombie;  /* freed while active: deliver on completion, no handle */
+  int is_coll; /* MPI-4 persistent collective: the handle survives
+                * Wait/Test (inactive) and dies on MPI_Request_free;
+                * MPI_Start replays the compiled `plan` */
+  int ckind;   /* FP_CK_* of the persistent collective (SPC twin) */
   unsigned long long rid;
   long long sreq; /* nonzero: zero-copy streaming-send descriptor —
                    * the send completes at Wait/Test (tdcn_send_wait),
                    * not at issue; the user buffer stays borrowed by
                    * the engine until then (MPI_Isend semantics) */
+  unsigned long long plan; /* compiled-schedule handle (is_coll) */
+  const void *cbuf;        /* persistent-coll bound sendbuf */
+  void *crbuf;             /* persistent-coll bound recvbuf */
   tpumpi_fp *fp;
   void *buf;
   long long cap;
@@ -667,6 +695,8 @@ static void fp_req_done(fp_req_t *q) {
   q->used = 0;
   q->zombie = 0;
   q->sreq = 0;
+  q->is_coll = 0;
+  q->plan = 0;
   q->fp = NULL;
   if (fp && fp->state == 2 && fp_live_refs(fp) == 0) fp_release(fp);
 }
@@ -733,6 +763,8 @@ static int fp_req_alloc(void) {
       g_fpreq[i].used = 1;
       g_fpreq[i].zombie = 0;
       g_fpreq[i].sreq = 0;
+      g_fpreq[i].is_coll = 0;
+      g_fpreq[i].plan = 0;
       return i;
     }
   return -1;
@@ -856,6 +888,132 @@ static int fp_usable(tpumpi_fp **out, MPI_Comm comm, MPI_Datatype datatype,
   return 1;
 }
 
+/* ---- collectives: C fast path (the dispatch-floor leg) --------------
+ *
+ * Contiguous predefined-type collectives on fast-path comms run their
+ * whole schedule in C (native/src/dcn.cc tdcn_coll_*): no embedded-
+ * Python crossing per call — the ~3.9 us/op floor the capi rows
+ * measured becomes one plan-cache hit + the wire time.  Schedules
+ * mirror the Python plane's collops exactly (process-ordered linear
+ * fold / the ring crossover), so MPI_SUM stays bit-exact across the
+ * two paths.  Derived datatypes, pair types, user/logical ops, and
+ * non-fast-path comms fall through to capi — a routing decision that
+ * is a pure function of SPMD-identical arguments, so every member
+ * takes the same path. */
+
+/* kind codes shared with native/src/dcn.cc's CollKind */
+#define FP_CK_BARRIER 0
+#define FP_CK_BCAST 1
+#define FP_CK_REDUCE 2
+#define FP_CK_ALLREDUCE 3
+#define FP_CK_ALLGATHER 4
+#define FP_CK_COUNT 5
+
+/* Per-op SPC twin for the C-served collectives: these calls never
+ * cross embedded Python, so the Python SPC layer cannot see them —
+ * the counts accrue here (one add per op; MPI_THREAD_SERIALIZED) and
+ * ompi_tpu.tool.spc merges them at READ time via tpumpi_coll_spc, so
+ * MPI_T spc_* pvars keep ticking under stock C programs.  I-variants
+ * and persistent Starts count under their blocking op's name (the
+ * schedule that actually ran). */
+static long long g_fp_coll_spc[FP_CK_COUNT];
+
+void tpumpi_coll_spc(long long out[FP_CK_COUNT]) {
+  for (int i = 0; i < FP_CK_COUNT; i++) out[i] = g_fp_coll_spc[i];
+}
+
+static unsigned long long fp_cctx(tpumpi_fp *fp) {
+  if (!fp->cctx)
+    fp->cctx = tdcn_coll_open(fp->eng, fp->cid, fp->my_proc, fp->nprocs,
+                              (const char *const *)fp->addrs,
+                              fp->ring_thr);
+  return fp->cctx;
+}
+
+/* contiguous predefined datatype + one-rank-per-process comm on the C
+ * matching engine: the preconditions under which the C schedules are
+ * exactly the Python plane's (member index == rank).
+ *
+ * Envelope note: routing keys on the LOCAL datatype handle.  MPI only
+ * requires type-SIGNATURE equality across ranks, so a program where
+ * one rank passes MPI_INT and another a committed contiguous derived
+ * equivalent is legal but lands the two ranks on different planes
+ * (deadlock).  Handle-homogeneous calls — every real program in this
+ * repo's suites — are the supported envelope; the mixed-handle case
+ * is recorded in ROADMAP as a remaining edge. */
+static int fp_coll_usable(tpumpi_fp **out, MPI_Comm comm,
+                          MPI_Datatype datatype, long long count) {
+  int dt = (int)datatype;
+  if (count < 0) return 0;
+  if (dt < 1 || dt > 27 || fp_dt[dt].size == 0) return 0;
+  tpumpi_fp *fp = fp_get(comm);
+  if (!fp || fp->nranks != fp->nprocs) return 0;
+  if (!fp_cctx(fp)) return 0;
+  *out = fp;
+  return 1;
+}
+
+/* Run one C-served collective through the compiled-schedule cache.
+ * Returns 1 when handled (*rc_out carries the MPI result); 0 when the
+ * (kind, op, dtype) signature is not C-serviceable — the caller falls
+ * back BEFORE any frame moved.  A transport failure after frames
+ * moved cannot fall back (the stream already advanced): it surfaces
+ * through the comm's errhandler like any other transport death. */
+static int fp_coll_run(tpumpi_fp *fp, int kind, int opcode, int dtcode,
+                       long long count, int root, const void *sb, void *rb,
+                       int *rc_out) {
+  unsigned long long plan =
+      tdcn_coll_plan(fp->eng, fp->cctx, kind, opcode, dtcode, count, root,
+                     -1 /* engine decides: the collops crossover */);
+  if (!plan) return 0;
+  int rc = tdcn_coll_start(fp->eng, plan, sb, rb);
+  if (rc == 0) g_fp_coll_spc[kind]++;
+  *rc_out = rc == 0 ? MPI_SUCCESS : fp_error(fp->comm, MPI_ERR_OTHER);
+  return 1;
+}
+
+/* The coll/tuned algorithm decision for a persistent-collective plan,
+ * resolved through embedded Python ONCE at init time (the libnbc
+ * compile step; MPI_Start replays with zero planning).  -1 = decision
+ * unavailable → the C engine's built-in crossover rule. */
+static int fp_sched_algo(tpumpi_fp *fp, const char *coll, long long nbytes,
+                         int opcode) {
+  capi_ret r;
+  if (capi_call("coll_sched_decision", &r, "(isLi)", fp->comm, coll,
+                nbytes, opcode) == MPI_SUCCESS &&
+      r.n >= 1)
+    return (int)r.v[0];
+  return -1;
+}
+
+/* Park a completed fast-path request for the eager I*-collectives
+ * (completion-at-issue is MPI-legal and matches the capi i-variants).
+ * Called AFTER the C schedule ran: routing (C vs Python schedule) is
+ * a pure function of SPMD-identical arguments, and a full request
+ * table — per-rank state — must never flip it (one rank on the capi
+ * stream while its peers run "#cfp" deadlocks the comm and desyncs
+ * every later collective), so table exhaustion degrades to a
+ * completed capi done-handle: the REQUEST representation falls back,
+ * the schedule never does. */
+static int fp_coll_done_req(tpumpi_fp *fp, MPI_Request *request) {
+  int i = fp_req_alloc();
+  if (i >= 0) {
+    g_fpreq[i].is_send = 1; /* complete at issue */
+    g_fpreq[i].sreq = 0;
+    g_fpreq[i].fp = fp;
+    *request = (MPI_Request)(FP_REQ_BIT | i);
+    return MPI_SUCCESS;
+  }
+  capi_ret r;
+  if (capi_call("isend_done_handle", &r, "(iiL)", 0, 0, 0LL) ==
+          MPI_SUCCESS &&
+      r.n >= 1) {
+    *request = (MPI_Request)r.v[0];
+    return MPI_SUCCESS;
+  }
+  return MPI_ERR_INTERN;
+}
+
 int PMPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
               int tag, MPI_Comm comm) {
   tpumpi_fp *fp;
@@ -875,8 +1033,14 @@ int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
       fp_usable(&fp, comm, datatype, source, tag, 1)) {
     tdcn_msg_t m;
     for (;;) {
-      int rc = tdcn_precv(fp->eng, fp->cid, fp->my_rank, source, tag, -1,
-                          120.0, &m);
+      /* the post carries the destination buffer: a racing in-order
+       * streamed RTS (or ring eager record) lands the payload straight
+       * in `buf` — MPI_Recv stops taking the copy path it raced into
+       * before (fp_take sees data == buf and skips copy AND free) */
+      int rc = tdcn_precv_into(
+          fp->eng, fp->cid, fp->my_rank, source, tag, -1, 120.0, buf,
+          (unsigned long long)count * (unsigned)fp_dt[(int)datatype].size,
+          &m);
       if (rc == 0) break;
       if (rc != 1) /* closed/failed: surface through the slow path */
         goto slow;
@@ -978,6 +1142,18 @@ static int fp_is_req(MPI_Request req) {
 static int fp_wait(MPI_Request *request, MPI_Status *status) {
   fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
   int rc = MPI_SUCCESS;
+  if (q->is_coll) {
+    /* persistent collective: Start ran the schedule eagerly, so the
+     * round is complete; the handle goes INACTIVE but stays valid
+     * (MPI persistent lifecycle — it dies only on MPI_Request_free) */
+    if (status) {
+      status->MPI_SOURCE = MPI_PROC_NULL;
+      status->MPI_TAG = MPI_ANY_TAG;
+      status->MPI_ERROR = MPI_SUCCESS;
+      status->_nbytes = 0;
+    }
+    return MPI_SUCCESS;
+  }
   if (q->is_send) {
     if (q->sreq) { /* zero-copy stream: completion happens HERE */
       int w;
@@ -1022,6 +1198,10 @@ static int fp_wait(MPI_Request *request, MPI_Status *status) {
 
 static int fp_test(MPI_Request *request, int *flag, MPI_Status *status) {
   fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
+  if (q->is_coll) {
+    *flag = 1; /* inactive or eagerly-complete: done either way */
+    return fp_wait(request, status);
+  }
   if (q->is_send) {
     if (q->sreq) {
       int t = tdcn_send_test(q->fp->eng, q->sreq);
@@ -1203,7 +1383,15 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
 /* ---- collectives: blocking ---------------------------------------- */
 
 int PMPI_Barrier(MPI_Comm comm) {
-  int rc = capi_call("barrier", NULL, "(i)", (int)comm);
+  tpumpi_fp *fp;
+  int rc;
+  if (fp_coll_usable(&fp, comm, MPI_INT, 0) &&
+      fp_coll_run(fp, FP_CK_BARRIER, 0, (int)MPI_INT, 0, 0, NULL, NULL,
+                  &rc)) {
+    fp_drain_zombies();
+    return rc;
+  }
+  rc = capi_call("barrier", NULL, "(i)", (int)comm);
   /* channel FIFO: a message sent before the peer's barrier entry has
    * been matched by now — deliver freed-active receives (MPI 3.7.3) */
   fp_drain_zombies();
@@ -1212,18 +1400,45 @@ int PMPI_Barrier(MPI_Comm comm) {
 
 int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
                MPI_Comm comm) {
+  tpumpi_fp *fp;
+  if (buffer != MPI_IN_PLACE &&
+      fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
+      root < fp->nranks) {
+    int rc;
+    if (fp_coll_run(fp, FP_CK_BCAST, 0, (int)datatype, count, root,
+                    buffer, buffer, &rc))
+      return rc;
+  }
   return capi_call("bcast", NULL, "(Kiiii)", PTR(buffer), count,
                    (int)datatype, root, (int)comm);
 }
 
 int PMPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                 MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm) {
+  tpumpi_fp *fp;
+  if (fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
+      root < fp->nranks) {
+    const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+    int rc;
+    if ((fp->my_rank != root || recvbuf) && sb &&
+        fp_coll_run(fp, FP_CK_REDUCE, (int)op, (int)datatype, count, root,
+                    sb, recvbuf, &rc))
+      return rc;
+  }
   return capi_call("reduce", NULL, "(KKiiiii)", PTR(sendbuf), PTR(recvbuf),
                    count, (int)datatype, (int)op, root, (int)comm);
 }
 
 int PMPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                    MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  tpumpi_fp *fp;
+  if (recvbuf && fp_coll_usable(&fp, comm, datatype, count)) {
+    const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+    int rc;
+    if (sb && fp_coll_run(fp, FP_CK_ALLREDUCE, (int)op, (int)datatype,
+                          count, 0, sb, recvbuf, &rc))
+      return rc;
+  }
   return capi_call("allreduce", NULL, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
                    count, (int)datatype, (int)op, (int)comm);
 }
@@ -1231,6 +1446,22 @@ int PMPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
 int PMPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                    void *recvbuf, int recvcount, MPI_Datatype recvtype,
                    MPI_Comm comm) {
+  tpumpi_fp *fp;
+  if (recvbuf && fp_coll_usable(&fp, comm, recvtype, recvcount)) {
+    /* equal type/count signatures only (the dominant case); MPI's
+     * matching-but-different-signature latitude keeps the capi path */
+    const void *sb = NULL;
+    if (sendbuf == MPI_IN_PLACE)
+      sb = (const char *)recvbuf +
+           (long long)fp->my_rank * recvcount *
+               fp_dt[(int)recvtype].size;
+    else if ((int)sendtype == (int)recvtype && sendcount == recvcount)
+      sb = sendbuf;
+    int rc;
+    if (sb && fp_coll_run(fp, FP_CK_ALLGATHER, 0, (int)recvtype,
+                          recvcount, 0, sb, recvbuf, &rc))
+      return rc;
+  }
   return capi_call("allgather", NULL, "(KiiKiii)", PTR(sendbuf), sendcount,
                    (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
                    (int)comm);
@@ -2023,7 +2254,20 @@ int PMPI_Scatterv(const void *sendbuf, const int sendcounts[],
 
 /* ---- collectives: non-blocking ------------------------------------ */
 
+/* The I* variants of the C-served collectives run the schedule eagerly
+ * (completion-at-issue — the same MPI-legal strengthening the capi
+ * i-variants use) and park a completed C request: still zero embedded-
+ * Python crossings.  The request slot is claimed BEFORE the schedule
+ * runs so a full table falls back to capi without double-running. */
+
 int PMPI_Ibarrier(MPI_Comm comm, MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (fp_coll_usable(&fp, comm, MPI_INT, 0)) {
+    int rc;
+    if (fp_coll_run(fp, FP_CK_BARRIER, 0, (int)MPI_INT, 0, 0, NULL,
+                    NULL, &rc))
+      return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
+  }
   capi_ret r;
   int rc = capi_call("ibarrier", &r, "(i)", (int)comm);
   if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
@@ -2032,6 +2276,15 @@ int PMPI_Ibarrier(MPI_Comm comm, MPI_Request *request) {
 
 int PMPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
                 MPI_Comm comm, MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (buffer != MPI_IN_PLACE &&
+      fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
+      root < fp->nranks) {
+    int rc;
+    if (fp_coll_run(fp, FP_CK_BCAST, 0, (int)datatype, count, root,
+                    buffer, buffer, &rc))
+      return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
+  }
   capi_ret r;
   int rc = capi_call("ibcast", &r, "(Kiiii)", PTR(buffer), count,
                      (int)datatype, root, (int)comm);
@@ -2042,6 +2295,14 @@ int PMPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
 int PMPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                     MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
                     MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (recvbuf && fp_coll_usable(&fp, comm, datatype, count)) {
+    const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+    int rc;
+    if (sb && fp_coll_run(fp, FP_CK_ALLREDUCE, (int)op, (int)datatype,
+                          count, 0, sb, recvbuf, &rc))
+      return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
+  }
   capi_ret r;
   int rc = capi_call("iallreduce", &r, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
                      count, (int)datatype, (int)op, (int)comm);
@@ -2052,6 +2313,20 @@ int PMPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
 int PMPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                     void *recvbuf, int recvcount, MPI_Datatype recvtype,
                     MPI_Comm comm, MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (recvbuf && fp_coll_usable(&fp, comm, recvtype, recvcount)) {
+    const void *sb = NULL;
+    if (sendbuf == MPI_IN_PLACE)
+      sb = (const char *)recvbuf +
+           (long long)fp->my_rank * recvcount *
+               fp_dt[(int)recvtype].size;
+    else if ((int)sendtype == (int)recvtype && sendcount == recvcount)
+      sb = sendbuf;
+    int rc;
+    if (sb && fp_coll_run(fp, FP_CK_ALLGATHER, 0, (int)recvtype,
+                          recvcount, 0, sb, recvbuf, &rc))
+      return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
+  }
   capi_ret r;
   int rc = capi_call("iallgather", &r, "(KiiKiii)", PTR(sendbuf), sendcount,
                      (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
@@ -2224,6 +2499,14 @@ int PMPI_Test_cancelled(const MPI_Status *status, int *flag) {
 int PMPI_Request_free(MPI_Request *request) {
   if (fp_is_req(*request)) {
     fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
+    if (q->is_coll) {
+      /* persistent collective: inactive or eagerly complete — release
+       * the slot; the compiled schedule stays cached in the comm's
+       * coll context for the next *_init of the same signature */
+      fp_req_done(q);
+      *request = MPI_REQUEST_NULL;
+      return MPI_SUCCESS;
+    }
     if (q->is_send) {
       /* an active zero-copy stream is handed to the engine: it
        * completes in the background and reclaims the descriptor (the
@@ -2262,6 +2545,11 @@ int PMPI_Request_get_status(MPI_Request request, int *flag,
   }
   if (fp_is_req(request)) { /* non-destructive completion probe */
     fp_req_t *q = &g_fpreq[(int)request & ~FP_REQ_BIT];
+    if (q->is_coll) {
+      *flag = 1;
+      empty_status(status);
+      return MPI_SUCCESS;
+    }
     if (q->is_send) {
       *flag = q->sreq ? tdcn_send_done(q->fp->eng, q->sreq) : 1;
       if (*flag) empty_status(status);
@@ -2318,6 +2606,15 @@ int PMPI_Recv_init(void *buf, int count, MPI_Datatype datatype, int source,
 }
 
 int PMPI_Start(MPI_Request *request) {
+  if (fp_is_req(*request)) {
+    fp_req_t *q = &g_fpreq[(int)*request & ~FP_REQ_BIT];
+    if (!q->used || !q->is_coll) return MPI_ERR_REQUEST;
+    /* replay the compiled schedule: zero per-call planning — the
+     * algorithm/chunk/kernel decisions were baked at *_init */
+    int rc = tdcn_coll_start(q->fp->eng, q->plan, q->cbuf, q->crbuf);
+    if (rc == 0) g_fp_coll_spc[q->ckind]++;
+    return rc == 0 ? MPI_SUCCESS : fp_error(q->fp->comm, MPI_ERR_OTHER);
+  }
   return capi_call("start", NULL, "(i)", (int)*request);
 }
 
@@ -2327,6 +2624,164 @@ int PMPI_Startall(int count, MPI_Request requests[]) {
     if (rc != MPI_SUCCESS) return rc;
   }
   return MPI_SUCCESS;
+}
+
+/* ---- MPI-4 persistent collectives ----------------------------------
+ *
+ * The schedule — coll/tuned's algorithm choice (resolved through
+ * embedded Python ONCE here), chunk plan, op-kernel binding — is
+ * compiled at init and cached keyed (comm, op, dtype, count, root) in
+ * the comm's C collective context; MPI_Start replays it with zero
+ * per-call planning (the libnbc schedule-compile model, SURVEY §3.4).
+ * Non-C-serviceable signatures fall back to capi's persistent-
+ * collective entries (the same Python schedule cache underneath). */
+
+/* Bind one compiled persistent-collective plan to a fast-path request
+ * slot — the shared tail of the five *_init entry points.  The plan
+ * exists on every member (routing is SPMD); a full request table is
+ * per-rank state that must not reroute this rank onto the
+ * Python-plane schedule (stream desync), so exhaustion fails loudly
+ * through the comm's errhandler instead. */
+static int fp_coll_persist_req(tpumpi_fp *fp, int ckind,
+                               unsigned long long plan, const void *sb,
+                               void *rb, MPI_Request *request) {
+  int i = fp_req_alloc();
+  if (i < 0) return fp_error(fp->comm, MPI_ERR_OTHER);
+  g_fpreq[i].is_coll = 1;
+  g_fpreq[i].ckind = ckind;
+  g_fpreq[i].is_send = 1;
+  g_fpreq[i].plan = plan;
+  g_fpreq[i].cbuf = sb;
+  g_fpreq[i].crbuf = rb;
+  g_fpreq[i].fp = fp;
+  *request = (MPI_Request)(FP_REQ_BIT | i);
+  return MPI_SUCCESS;
+}
+
+int PMPI_Allreduce_init(const void *sendbuf, void *recvbuf, int count,
+                        MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                        MPI_Info info, MPI_Request *request) {
+  (void)info;
+  tpumpi_fp *fp;
+  if (recvbuf && fp_coll_usable(&fp, comm, datatype, count)) {
+    const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+    if (sb) {
+      int algo = fp_sched_algo(
+          fp, "allreduce",
+          (long long)count * fp_dt[(int)datatype].size, (int)op);
+      unsigned long long plan =
+          tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_ALLREDUCE, (int)op,
+                         (int)datatype, count, 0, algo);
+      if (plan)
+        return fp_coll_persist_req(fp, FP_CK_ALLREDUCE, plan, sb,
+                                   recvbuf, request);
+    }
+  }
+  capi_ret r;
+  int rc = capi_call("allreduce_init", &r, "(KKiiii)", PTR(sendbuf),
+                     PTR(recvbuf), count, (int)datatype, (int)op,
+                     (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Bcast_init(void *buffer, int count, MPI_Datatype datatype,
+                    int root, MPI_Comm comm, MPI_Info info,
+                    MPI_Request *request) {
+  (void)info;
+  tpumpi_fp *fp;
+  if (buffer != MPI_IN_PLACE &&
+      fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
+      root < fp->nranks) {
+    {
+      unsigned long long plan =
+          tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_BCAST, 0,
+                         (int)datatype, count, root, -1);
+      if (plan)
+        return fp_coll_persist_req(fp, FP_CK_BCAST, plan, buffer,
+                                   buffer, request);
+    }
+  }
+  capi_ret r;
+  int rc = capi_call("bcast_init", &r, "(Kiiii)", PTR(buffer), count,
+                     (int)datatype, root, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Allgather_init(const void *sendbuf, int sendcount,
+                        MPI_Datatype sendtype, void *recvbuf,
+                        int recvcount, MPI_Datatype recvtype,
+                        MPI_Comm comm, MPI_Info info,
+                        MPI_Request *request) {
+  (void)info;
+  tpumpi_fp *fp;
+  if (recvbuf && fp_coll_usable(&fp, comm, recvtype, recvcount)) {
+    const void *sb = NULL;
+    if (sendbuf == MPI_IN_PLACE)
+      sb = (const char *)recvbuf +
+           (long long)fp->my_rank * recvcount *
+               fp_dt[(int)recvtype].size;
+    else if ((int)sendtype == (int)recvtype && sendcount == recvcount)
+      sb = sendbuf;
+    if (sb) {
+      unsigned long long plan =
+          tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_ALLGATHER, 0,
+                         (int)recvtype, recvcount, 0, -1);
+      if (plan)
+        return fp_coll_persist_req(fp, FP_CK_ALLGATHER, plan, sb,
+                                   recvbuf, request);
+    }
+  }
+  capi_ret r;
+  int rc = capi_call("allgather_init", &r, "(KiiKiii)", PTR(sendbuf),
+                     sendcount, (int)sendtype, PTR(recvbuf), recvcount,
+                     (int)recvtype, (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Reduce_init(const void *sendbuf, void *recvbuf, int count,
+                     MPI_Datatype datatype, MPI_Op op, int root,
+                     MPI_Comm comm, MPI_Info info, MPI_Request *request) {
+  (void)info;
+  tpumpi_fp *fp;
+  if (fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
+      root < fp->nranks) {
+    const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+    if (sb && (fp->my_rank != root || recvbuf)) {
+      unsigned long long plan =
+          tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_REDUCE, (int)op,
+                         (int)datatype, count, root, -1);
+      if (plan)
+        return fp_coll_persist_req(fp, FP_CK_REDUCE, plan, sb,
+                                   recvbuf, request);
+    }
+  }
+  capi_ret r;
+  int rc = capi_call("reduce_init", &r, "(KKiiiii)", PTR(sendbuf),
+                     PTR(recvbuf), count, (int)datatype, (int)op, root,
+                     (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
+}
+
+int PMPI_Barrier_init(MPI_Comm comm, MPI_Info info, MPI_Request *request) {
+  (void)info;
+  tpumpi_fp *fp;
+  if (fp_coll_usable(&fp, comm, MPI_INT, 0)) {
+    {
+      unsigned long long plan = tdcn_coll_plan(
+          fp->eng, fp->cctx, FP_CK_BARRIER, 0, (int)MPI_INT, 0, 0, -1);
+      if (plan)
+        return fp_coll_persist_req(fp, FP_CK_BARRIER, plan, NULL,
+                                   NULL, request);
+    }
+  }
+  capi_ret r;
+  int rc = capi_call("barrier_init", &r, "(i)", (int)comm);
+  if (rc == MPI_SUCCESS && r.n >= 1) *request = (MPI_Request)r.v[0];
+  return rc;
 }
 
 /* ---- matched probe ------------------------------------------------- */
@@ -2402,6 +2857,16 @@ int PMPI_Alltoallv(const void *sendbuf, const int sendcounts[],
 int PMPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
                  MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm,
                  MPI_Request *request) {
+  tpumpi_fp *fp;
+  if (fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
+      root < fp->nranks) {
+    const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+    int rc;
+    if (sb && (fp->my_rank != root || recvbuf) &&
+        fp_coll_run(fp, FP_CK_REDUCE, (int)op, (int)datatype, count,
+                    root, sb, recvbuf, &rc))
+      return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
+  }
   TPUMPI_IREQ(capi_call("ireduce", &r, "(KKiiiii)", PTR(sendbuf),
                         PTR(recvbuf), count, (int)datatype, (int)op, root,
                         (int)comm))
@@ -4713,6 +5178,19 @@ TPUMPI_WEAK(int, Ssend_init, (const void *, int, MPI_Datatype, int, int, MPI_Com
 TPUMPI_WEAK(int, Recv_init, (void *, int, MPI_Datatype, int, int, MPI_Comm, MPI_Request *))
 TPUMPI_WEAK(int, Start, (MPI_Request *))
 TPUMPI_WEAK(int, Startall, (int, MPI_Request[]))
+TPUMPI_WEAK(int, Allreduce_init,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, MPI_Comm,
+             MPI_Info, MPI_Request *))
+TPUMPI_WEAK(int, Bcast_init,
+            (void *, int, MPI_Datatype, int, MPI_Comm, MPI_Info,
+             MPI_Request *))
+TPUMPI_WEAK(int, Allgather_init,
+            (const void *, int, MPI_Datatype, void *, int, MPI_Datatype,
+             MPI_Comm, MPI_Info, MPI_Request *))
+TPUMPI_WEAK(int, Reduce_init,
+            (const void *, void *, int, MPI_Datatype, MPI_Op, int,
+             MPI_Comm, MPI_Info, MPI_Request *))
+TPUMPI_WEAK(int, Barrier_init, (MPI_Comm, MPI_Info, MPI_Request *))
 TPUMPI_WEAK(int, Mprobe, (int, int, MPI_Comm, MPI_Message *, MPI_Status *))
 TPUMPI_WEAK(int, Improbe, (int, int, MPI_Comm, int *, MPI_Message *, MPI_Status *))
 TPUMPI_WEAK(int, Mrecv, (void *, int, MPI_Datatype, MPI_Message *, MPI_Status *))

@@ -534,9 +534,67 @@ class Comm(PersistentP2PMixin):
             ("allreduce", op, None, xd.shape, xd.dtype), (xd, op), host,
         )
 
+    def _sched_fn(self, base: str, args: tuple, op: Op | None = None,
+                  root: int | None = None):
+        """Persistent-collective plan from the PROCESS-WIDE compiled-
+        schedule cache (:mod:`ompi_tpu.coll.sched`): keyed by comm
+        SHAPE (mesh devices), not comm identity, so a fresh communicator
+        of the same shape — a dup, or the next job in a resident tpud
+        worker — replays the already-compiled program instead of
+        re-resolving and re-compiling it.  None when the winning module
+        exposes no resolver (host/monitoring modules) — the caller
+        takes the table path."""
+        ctx = mca._default
+        if ctx is None:
+            return None
+        owner = self.coll.owners.get(base)
+        resolve = getattr(owner, "resolve", None)
+        if resolve is None:
+            return None
+        from ompi_tpu.coll import sched as _sched
+
+        xd = args[0]
+        mesh_key = tuple(
+            (str(getattr(d, "platform", "")), int(getattr(d, "id", 0)))
+            for d in self.mesh.devices)
+        key = ("pers", base, mesh_key, op, root, xd.shape, str(xd.dtype),
+               ctx.store.version)
+        # donate stays False: a persistent request re-dispatches on the
+        # SAME staged buffer every start — donation would consume it
+        return _sched.lookup(key, lambda: resolve(base, *args))
+
+    def _pers_coll(self, base: str, args: tuple, op: Op | None = None,
+                   root: int | None = None) -> Request | None:
+        # the structural ULFM guard: the cached-plan path bypasses
+        # _lookup, so it must guard here like _dispatch/_dispatch_i do
+        self._ft_guard()
+        fn = self._sched_fn(base, args, op=op, root=root)
+        if fn is None:
+            return None
+        from ompi_tpu.request import ArrayRequest, PersistentRequest
+
+        xd = args[0]
+        return PersistentRequest(lambda: ArrayRequest(fn(xd)))
+
     def allreduce_init(self, x, op: Op = SUM) -> Request:
+        self._check_op(op, x)
         xd, _ = self._stage(x, 1)
-        return self._lookup("allreduce_init")(xd, op)
+        req = self._pers_coll("allreduce", (xd, op), op=op)
+        return req if req is not None \
+            else self._lookup("allreduce_init")(xd, op)
+
+    def bcast_init(self, x, root: int = 0) -> Request:
+        self._check_root(root)
+        xd, _ = self._stage(x, 1)
+        req = self._pers_coll("bcast", (xd, root), root=root)
+        return req if req is not None \
+            else self._lookup("bcast_init")(xd, root)
+
+    def allgather_init(self, x) -> Request:
+        xd, _ = self._stage(x, 1)
+        req = self._pers_coll("allgather", (xd,))
+        return req if req is not None \
+            else self._lookup("allgather_init")(xd)
 
     def bcast(self, x, root: int = 0):
         return self._coll_call("bcast", x, 1, root=root)

@@ -553,7 +553,13 @@ class MultiProcComm(PersistentP2PMixin):
 
         return t, check, escalate
 
-    def recv(self, dest: int, source: int | None = None, tag: int | None = None):
+    def recv(self, dest: int, source: int | None = None,
+             tag: int | None = None, out=None):
+        """``out``: optional contiguous destination ndarray for the
+        native plane's ``recv_into`` surface — the payload lands (or is
+        memcpy'd in C) straight in it, and the returned payload IS
+        ``out`` when that happened (identity check).  Ignored on the
+        Python-delivery planes."""
         if self._pml_native:
             # one C crossing: match-or-post + sleep on the request's
             # condvar; a watched specific source also wakes on failure
@@ -584,6 +590,7 @@ class MultiProcComm(PersistentP2PMixin):
                 fail_proc,
                 remote=remote,
                 guard=(self._anysrc_guard() if source is None else None),
+                into=out,
             )
             return payload, st
         req = self.irecv(dest, source, tag)

@@ -356,10 +356,61 @@ def native_fastpath_info(h: int):
             str(int(c.size)),
             ",".join(str(int(o)) for o in c.offsets),
             "\x1e".join(c.dcn.addresses),
+            # trailing field (appended — older parsers stop early): the
+            # DCN ring-allreduce crossover, so the shim's C collective
+            # schedules pick the SAME algorithm the Python plane would
+            # (bit-exact MPI_SUM across both paths); reproducible mode
+            # pins the process-ordered linear fold on both planes
+            str(_coll_ring_threshold(c)),
         ])
         return (MPI_SUCCESS, info)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), "")
+
+
+def _coll_ring_threshold(c) -> int:
+    """The comm's DCN ring-allreduce crossover in bytes; a huge
+    sentinel when ``coll_han_reproducible`` pins the ordered fold."""
+    from ompi_tpu.core import mca
+
+    store = mca.default_context().store
+    if bool(store.get("coll_han_reproducible", False)):
+        return 1 << 62  # never ring: ordered linear on both planes
+    return int(getattr(c.dcn, "ring_threshold", 64 << 10))
+
+
+def coll_sched_decision(h: int, coll: str, nbytes: int, opcode: int):
+    """(err, algo) — the algorithm a persistent collective's compiled
+    schedule should replay: 0 = process-ordered linear, 1 = ring.  The
+    decision layer's verdict resolved ONCE at ``*_init`` time (the
+    libnbc compile step) and memoized in the process-wide schedule
+    cache, so a resident worker's later inits of the same signature
+    never re-derive it."""
+    try:
+        from ompi_tpu.coll import sched as _sched
+        from ompi_tpu.coll.tuned import dcn_fixed_decision
+        from ompi_tpu.core import mca
+
+        c = _comm(h)
+        store = mca.default_context().store
+
+        def build() -> int:
+            return dcn_fixed_decision(
+                coll, int(getattr(c, "nprocs", 1)), int(nbytes),
+                OPS.get(opcode),
+                int(getattr(c.dcn, "ring_threshold", 64 << 10)),
+                reproducible=bool(
+                    store.get("coll_han_reproducible", False)))
+
+        algo = _sched.lookup(
+            ("capi_decision", int(getattr(c, "nprocs", 1)), coll,
+             int(opcode), int(nbytes),
+             store.version),  # var-change coherence
+            build,
+        )
+        return (MPI_SUCCESS, int(algo))
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
 
 
 def comm_dup(h: int):
@@ -457,12 +508,28 @@ def _coll_in(sptr: int, rptr: int, count: int, dtcode: int) -> np.ndarray:
     return _view(sptr, count, dtcode)
 
 
+def _reduce_in(sptr, rptr, count, dtcode) -> np.ndarray:
+    """Reduction input honoring MPI_IN_PLACE AND derived datatypes:
+    derived contributions go through the convertor pack onto their
+    uniform leaf dtype (MPI requires reducible derived types to be
+    leaf-uniform) — the fallback contract behind the shim's C fast
+    path, which only serves contiguous predefined types."""
+    src = rptr if sptr == _IN_PLACE else sptr
+    if dtcode in _dtypes:
+        d = _dtypes[dtcode]
+        if d.uniform_leaf is None:
+            raise err.MPITypeError(
+                "reductions need a uniform-leaf datatype")
+        return _pack_from(src, count, dtcode)
+    return _view(src, count, dtcode)
+
+
 def allreduce(sptr, rptr, count, dtcode, opcode, h) -> int:
     try:
         c = _comm(h)
-        x = _coll_in(sptr, rptr, count, dtcode)[None, :]  # (1 local rank, n)
+        x = _reduce_in(sptr, rptr, count, dtcode)[None, :]
         out = np.asarray(c.allreduce(x, OPS[opcode]))
-        _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
+        _unpack_into(rptr, count, dtcode, out[0])
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e, h)
@@ -471,11 +538,11 @@ def allreduce(sptr, rptr, count, dtcode, opcode, h) -> int:
 def reduce(sptr, rptr, count, dtcode, opcode, root, h) -> int:
     try:
         c = _comm(h)
-        x = _coll_in(sptr, rptr, count, dtcode)[None, :]
+        x = _reduce_in(sptr, rptr, count, dtcode)[None, :]
         out = np.asarray(c.reduce(x, OPS[opcode], root=root))
         me = comm_rank(h)[1]
         if me == root and rptr not in (0, _IN_PLACE):
-            _view(rptr, count, dtcode)[:] = out.reshape(-1)[:count]
+            _unpack_into(rptr, count, dtcode, out[0])
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e, h)
@@ -646,12 +713,27 @@ def recv(ptr, count, dtcode, source, tag, h):
     try:
         c = _comm(h)
         me = comm_rank(h)[1]
+        out = None
+        kw = {}
+        if (dtcode in DTYPES and dtcode not in _dtypes
+                and getattr(c, "_pml_native", False)):
+            # native plane + predefined contiguous dtype: post the
+            # user buffer itself (the ctypes recv_into surface) — a
+            # racing streamed RTS lands straight in it, and the copy
+            # path becomes one C-side memcpy, never a Python unpack
+            out = _view(ptr, count, dtcode)
+            kw["out"] = out
         payload, st = c.recv(
             dest=me,
             source=None if source == -1 else source,
             tag=None if tag == -1 else tag,
+            **kw,
         )
-        got = _unpack_into(ptr, count, dtcode, payload)
+        if out is not None and payload is out:
+            unit = _unit_nbytes(dtcode)
+            got = min(count, int(st.nbytes) // max(1, unit))
+        else:
+            got = _unpack_into(ptr, count, dtcode, payload)
         return (MPI_SUCCESS, int(st.source), int(st.tag),
                 got * _unit_nbytes(dtcode))
     except BaseException as e:  # noqa: BLE001
@@ -2582,12 +2664,129 @@ def recv_init(ptr: int, count: int, dtcode: int, source: int, tag: int,
         return (_fail(e, h), 0)
 
 
+# -- persistent collectives (MPI_Allreduce_init / MPI_Start) ------------
+# The embedded-Python fallback behind the shim's C plan cache (derived
+# datatypes, user/logical ops, non-fast-path comms, size-1 worlds):
+# entry kind "pers_coll" carries a plan dict whose ``run`` closure was
+# compiled ONCE at init — comm resolution, buffer views, op lookup,
+# IN_PLACE resolution all pre-bound — and MPI_Start replays it.
+
+
+def _pers_coll_req(plan: dict):
+    return (MPI_SUCCESS, _store_req(("pers_coll", None, plan, 0, 0)))
+
+
+def allreduce_init(sptr, rptr, count, dtcode, opcode, h):
+    try:
+        c = _comm(h)
+        if dtcode in _dtypes:
+            # derived datatype: the blocking path's convertor staging
+            # dominates — replay the whole entry point per start
+            return _pers_coll_req(
+                {"run": lambda: allreduce(sptr, rptr, count, dtcode,
+                                          opcode, h)})
+        op = OPS[opcode]
+        x = _coll_in(sptr, rptr, count, dtcode)
+        out_v = _view(rptr, count, dtcode)
+
+        def run() -> None:
+            res = np.asarray(c.allreduce(x[None, :], op))
+            out_v[:] = res.reshape(-1)[:count]
+
+        return _pers_coll_req({"run": run})
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def bcast_init(ptr, count, dtcode, root, h):
+    try:
+        c = _comm(h)
+        if dtcode in _dtypes:
+            return _pers_coll_req(
+                {"run": lambda: bcast(ptr, count, dtcode, root, h)})
+        buf = _view(ptr, count, dtcode)
+
+        def run() -> None:
+            res = np.asarray(c.bcast(buf[None, :], root=root))
+            buf[:] = res.reshape(-1)[:count]
+
+        return _pers_coll_req({"run": run})
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def allgather_init(sptr, scount, sdt, rptr, rcount, rdt, h):
+    try:
+        c = _comm(h)
+        if sdt in _dtypes or rdt in _dtypes:
+            return _pers_coll_req(
+                {"run": lambda: allgather(sptr, scount, sdt, rptr, rcount,
+                                          rdt, h)})
+        n = getattr(c, "size", 1)
+        out_v = _view(rptr, rcount * n, rdt)
+        if sptr == _IN_PLACE:
+            me = comm_rank(h)[1]
+
+            def run() -> None:
+                x = out_v[me * rcount:(me + 1) * rcount].copy()
+                res = np.asarray(c.allgather(x[None, :]))
+                out_v[:] = res.reshape(-1)[:rcount * n]
+        else:
+            x_in = _view(sptr, scount, sdt)
+
+            def run() -> None:
+                res = np.asarray(c.allgather(x_in[None, :]))
+                out_v[:] = res.reshape(-1)[:rcount * n]
+
+        return _pers_coll_req({"run": run})
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def reduce_init(sptr, rptr, count, dtcode, opcode, root, h):
+    try:
+        c = _comm(h)
+        if dtcode in _dtypes:
+            return _pers_coll_req(
+                {"run": lambda: reduce(sptr, rptr, count, dtcode, opcode,
+                                       root, h)})
+        op = OPS[opcode]
+        x = _coll_in(sptr, rptr, count, dtcode)
+        me = comm_rank(h)[1]
+        out_v = (_view(rptr, count, dtcode)
+                 if me == root and rptr not in (0, _IN_PLACE) else None)
+
+        def run() -> None:
+            res = np.asarray(c.reduce(x[None, :], op, root=root))
+            if out_v is not None:
+                out_v[:] = res.reshape(-1)[:count]
+
+        return _pers_coll_req({"run": run})
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
+def barrier_init(h):
+    try:
+        c = _comm(h)
+        return _pers_coll_req({"run": c.barrier})
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
 def start(rh: int) -> int:
     try:
         entry = _requests.get(rh)
         if entry is None:
             raise err.MPIRequestError(f"invalid request handle {rh}")
         kind = entry[0]
+        if kind == "pers_coll":
+            # replay the compiled plan (eager completion, like the
+            # blocking-underneath i-collectives — MPI-legal)
+            entry[2]["run"]()
+            _requests[rh] = ("pers_coll", CompletedRequest(), entry[2],
+                             0, 0)
+            return MPI_SUCCESS
         if kind == "pers_send":
             ptr, count, dtcode, dest, tag, h = entry[2]
             rc = send(ptr, count, dtcode, dest, tag, h)

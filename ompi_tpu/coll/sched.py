@@ -1,0 +1,131 @@
+"""Compiled-schedule cache for persistent collectives.
+
+≈ libnbc's schedule store (SURVEY.md §3.4): a persistent collective's
+plan — algorithm choice from ``coll/tuned``, chunk plan, compiled
+program / bound kernel — is built ONCE at ``*_init`` time and replayed
+by every ``MPI_Start`` with zero per-call planning.  This module owns
+the PROCESS-WIDE store behind that contract:
+
+* keys are comm-shape-based, never comm-identity-based, so a resident
+  ``tpud`` worker's cache survives across jobs exactly like the warm
+  mesh (ROADMAP serving item (b)) — job 2's ``MPI_Allreduce_init`` of
+  the same (shape, op, dtype, count) signature is a cache hit even
+  though its communicator object is fresh;
+* hit/miss counters merge into the native counter schema
+  (``sched_cache_hits`` / ``sched_cache_misses`` — the same names the
+  C plane's ``TdcnStats`` tail reports for its own plan cache), so
+  ``tools/metrics_report.py`` and ``tools/top.py`` show one schedule-
+  cache hit rate across both planes;
+* capacity is bounded (``coll_sched_cache_max``) with FIFO eviction —
+  plans are cheap to rebuild, unbounded growth in a month-resident
+  worker is not;
+* ``--mca coll_sched_cache_enable 0`` turns the store into a
+  pass-through (every lookup builds; nothing retained).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+
+def _var(name: str, default):
+    try:
+        from ompi_tpu.core import mca
+
+        v = mca.default_context().store.get(name)
+        return default if v is None else v
+    except Exception:  # noqa: BLE001 — pre-init / teardown: defaults
+        return default
+
+
+class ScheduleCache:
+    """Keyed plan store with hit/miss accounting.
+
+    ``lookup(key, builder)`` returns the cached plan or builds, caches,
+    and returns a fresh one.  Thread-safe; the builder runs OUTSIDE the
+    lock (it may compile XLA programs), so two racing builders of the
+    same key both build and the first insert wins — harmless, counted
+    as one miss each (the reference's comm_select races the same way).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._plans: OrderedDict[tuple, Any] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, key: tuple, builder: Callable[[], Any]) -> Any:
+        if not bool(_var("coll_sched_cache_enable", True)):
+            return builder()
+        with self._lock:
+            if key in self._plans:
+                self.hits += 1
+                return self._plans[key]
+            self.misses += 1
+        plan = builder()
+        cap = max(1, int(_var("coll_sched_cache_max", 256)))
+        with self._lock:
+            if key not in self._plans:
+                self._plans[key] = plan
+                while len(self._plans) > cap:
+                    self._plans.popitem(last=False)
+            return self._plans[key]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._plans)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "sched_cache_hits": self.hits,
+                "sched_cache_misses": self.misses,
+                "entries": len(self._plans),
+            }
+
+    def provider_stats(self) -> dict[str, int]:
+        """Native-counter-schema subset for the metrics provider merge
+        (entries is a size, not a counter — excluded)."""
+        with self._lock:
+            return {
+                "sched_cache_hits": self.hits,
+                "sched_cache_misses": self.misses,
+            }
+
+    def clear(self) -> None:
+        """Drop plans, KEEP counters (the pvar reset-in-place contract
+        is owned by metrics core's baselines, not here)."""
+        with self._lock:
+            self._plans.clear()
+
+
+#: the process-wide store — a tpud resident worker's warm schedule
+#: cache IS this object surviving across jobs
+CACHE = ScheduleCache()
+
+_registered = False
+_reg_lock = threading.Lock()
+
+
+def register_metrics_provider() -> None:
+    """Idempotently merge the cache's counters into the native counter
+    schema (called from the first lookup and from metrics enable)."""
+    global _registered
+    with _reg_lock:
+        if _registered:
+            return
+        try:
+            from ompi_tpu.metrics import core as _mcore
+
+            _mcore.register_provider(CACHE, CACHE.provider_stats)
+            _registered = True
+        except Exception:  # noqa: BLE001 — metrics plane absent
+            pass
+
+
+def lookup(key: tuple, builder: Callable[[], Any]) -> Any:
+    """Module-level convenience over :data:`CACHE`."""
+    register_metrics_provider()
+    return CACHE.lookup(key, builder)

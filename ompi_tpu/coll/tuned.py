@@ -236,6 +236,36 @@ def fixed_decision(coll: str, comm_size: int, msg_bytes: int, op: Op | None,
     return None, None
 
 
+#: DCN-plane schedule ids for the C collective fast path (shared with
+#: native/src/dcn.cc's CollAlgo and the shim's tdcn_coll_plan calls)
+DCN_LINEAR, DCN_RING = 0, 1
+
+
+def dcn_fixed_decision(coll: str, comm_size: int, msg_bytes: int,
+                       op: Op | None, ring_threshold: int,
+                       reproducible: bool = False) -> int:
+    """The decision layer's verdict for a DCN-plane (inter-process)
+    schedule — the fixed rules behind the C collective fast path's
+    compiled plans (tdcn_coll_plan's ``algo``), mirroring the
+    crossover ``dcn/collops`` applies per call so the two planes pick
+    one schedule bit-for-bit:
+
+    * only ``allreduce`` has a ring variant; every other C-served
+      collective is linear;
+    * reproducible mode (``coll_han_reproducible``) pins the
+      process-ordered linear fold;
+    * the ring needs a commutative op (its per-chunk fold order walks
+      the ring, not rank order) and ``msg_bytes`` at or above the
+      engine's ring crossover.
+    """
+    del comm_size  # the DCN crossover is size-in-bytes driven
+    if coll != "allreduce" or reproducible:
+        return DCN_LINEAR
+    if op is not None and not getattr(op, "commutative", False):
+        return DCN_LINEAR
+    return DCN_RING if msg_bytes >= ring_threshold else DCN_LINEAR
+
+
 class TunedCollModule(CollModule):
     """Per-communicator decision module: wraps the comm's coll/xla
     module and forces its per-call algorithm choice through

@@ -35,6 +35,7 @@ from .var import (
     full_var_name,
     register_observability_vars,
     register_robustness_vars,
+    register_schedule_vars,
     register_serving_vars,
     register_transport_vars,
 )
@@ -245,6 +246,7 @@ class MCAContext:
         # the dcn deadline + faultsim knobs follow the same rule
         register_observability_vars(self.store)
         register_robustness_vars(self.store)
+        register_schedule_vars(self.store)
         register_serving_vars(self.store)
         register_transport_vars(self.store)
         self.frameworks: dict[str, Framework] = {}

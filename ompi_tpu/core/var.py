@@ -591,6 +591,35 @@ def register_transport_vars(store: "VarStore") -> None:
         store.register(fw, comp, name, default, type=typ, help=help_)
 
 
+# -- compiled-schedule-cache variables (central registration) ------------
+#
+# The persistent-collective plan store (ompi_tpu/coll/sched.py + the C
+# plan cache in native/src/dcn.cc): schedules — algorithm choice, chunk
+# plan, compiled program — are built once at *_init and replayed by
+# MPI_Start; these knobs bound and gate the store.
+
+#: (framework, component, name, default, type, help)
+SCHEDULE_VARS = (
+    ("coll", "sched", "cache_enable", True, "bool",
+     "Cache compiled persistent-collective schedules (algorithm choice, "
+     "chunk plan, compiled program) keyed (comm shape, op, dtype, count, "
+     "root) and replay them on MPI_Start with zero per-call planning; "
+     "the process-wide store survives across jobs in a resident tpud "
+     "worker like the warm mesh.  Off = every lookup plans afresh"),
+    ("coll", "sched", "cache_max", 256, "int",
+     "Upper bound on cached compiled schedules (FIFO eviction): plans "
+     "are cheap to rebuild, unbounded growth in a month-resident worker "
+     "is not"),
+)
+
+
+def register_schedule_vars(store: "VarStore") -> None:
+    """Register the compiled-schedule-cache knobs on a store
+    (idempotent)."""
+    for fw, comp, name, default, typ, help_ in SCHEDULE_VARS:
+        store.register(fw, comp, name, default, type=typ, help=help_)
+
+
 def dcn_timeout(name: str) -> float:
     """Resolve one ``dcn_<name>_timeout`` against the default MCA
     context — the single lookup every blocking DCN wait shares.  Falls

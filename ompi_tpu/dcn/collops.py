@@ -450,43 +450,66 @@ class DcnCollEngine:
         self.transport.send(self.addresses[dst], env, payload)
 
     def _recv(self, src: int, cid: int, seq: int,
-              timeout: float | None = None) -> np.ndarray:
-        return self._recv_full(src, cid, seq, timeout)[1]
+              timeout: float | None = None, into=None) -> np.ndarray:
+        return self._recv_full(src, cid, seq, timeout, into=into)[1]
 
     def _recv_full(self, src: int, cid: int, seq: int,
-                   timeout: float | None = None):
+                   timeout: float | None = None, into=None):
+        """``into``: optional destination ndarray — posted on the
+        transport (recv_into-style delivery) so a matching inbound
+        payload lands straight in it; the caller detects placement by
+        identity (the returned payload IS ``into``) and skips its
+        copy.  Best-effort: a frame that raced ahead of the posting
+        simply delivers the copy-path array."""
         from ompi_tpu.core.var import Deadline, dcn_timeout
 
         if timeout is None:
             timeout = dcn_timeout("recv")
         key = (cid, seq, src)
+        posted = None
+        if into is not None:
+            post = getattr(self.transport, "post_recv_into", None)
+            if post is not None:
+                post(cid, seq, src, into)
+                posted = True
         q = self._queue(key)
         dl = Deadline(timeout)
-        while True:
-            # short slices keep the wait sensitive to failure detection:
-            # a peer declared dead mid-collective raises promptly (ULFM
-            # in-band error) instead of waiting out the full deadline
-            try:
-                got = q.get(timeout=dl.slice(0.25))
-                break
-            except queue.Empty:
-                if self.proc_failed(src):
-                    from ompi_tpu.core.errors import MPIProcFailedError
+        try:
+            while True:
+                # short slices keep the wait sensitive to failure
+                # detection: a peer declared dead mid-collective raises
+                # promptly (ULFM in-band error) instead of waiting out
+                # the full deadline
+                try:
+                    got = q.get(timeout=dl.slice(0.25))
+                    break
+                except queue.Empty:
+                    if self.proc_failed(src):
+                        from ompi_tpu.core.errors import (
+                            MPIProcFailedError,
+                        )
 
-                    raise MPIProcFailedError(
-                        f"DCN recv: peer proc {src} failed "
-                        f"(cid={cid}, seq={seq})", failed=(src,)
-                    ) from None
-                self._check_revoked(cid, src, seq)
-                if dl.expired():
-                    self._escalate_deadline(
-                        "coll_recv", timeout,
-                        f"DCN recv deadline (dcn_recv_timeout={timeout}s)"
-                        f" expired: proc {self.proc} waiting for proc "
-                        f"{src} (cid={cid}, seq={seq}) — peer dead, "
-                        f"wedged, or collective order mismatch",
-                        failed_rank=src, cid=str(cid), seq=int(seq),
-                        src=int(src))
+                        raise MPIProcFailedError(
+                            f"DCN recv: peer proc {src} failed "
+                            f"(cid={cid}, seq={seq})", failed=(src,)
+                        ) from None
+                    self._check_revoked(cid, src, seq)
+                    if dl.expired():
+                        self._escalate_deadline(
+                            "coll_recv", timeout,
+                            f"DCN recv deadline "
+                            f"(dcn_recv_timeout={timeout}s)"
+                            f" expired: proc {self.proc} waiting for "
+                            f"proc {src} (cid={cid}, seq={seq}) — peer "
+                            f"dead, wedged, or collective order "
+                            f"mismatch",
+                            failed_rank=src, cid=str(cid), seq=int(seq),
+                            src=int(src))
+        finally:
+            if posted:
+                # withdraw an unconsumed posting (frame raced ahead of
+                # the registration, or this wait errored out)
+                self.transport.discard_posted(cid, seq, src)
         self._note_peer_activity(src)
         # (cid, seq, src) keys are single-use (seqs are monotonic per
         # stream), and the producer's put necessarily preceded this get
@@ -593,7 +616,13 @@ class DcnCollEngine:
             send_i = (me + 1 - s) % P
             recv_i = (me - s) % P
             self._send(right, cid, seq, acc[chunk(send_i)])
-            np.copyto(acc[chunk(recv_i)], self._recv(left, cid, seq))
+            # allgather phase: post the destination chunk itself —
+            # recv_into-style delivery lands the neighbor's bytes
+            # straight in `acc` (identity confirms; else copy)
+            dst = acc[chunk(recv_i)]
+            got = self._recv(left, cid, seq, into=dst)
+            if got is not dst:
+                np.copyto(dst, got)
         return acc.reshape(x.shape)
 
     def bcast(self, x: np.ndarray, root: int, cid: int) -> np.ndarray:

@@ -163,6 +163,9 @@ def load_library():
             ctypes.c_void_p, U64]
         lib.tdcn_precv.restype = I
         lib.tdcn_precv.argtypes = [P, S, I, I, I, I, D, MSG]
+        lib.tdcn_precv_into.restype = I
+        lib.tdcn_precv_into.argtypes = [P, S, I, I, I, I, D,
+                                        ctypes.c_void_p, U64, MSG]
         lib.tdcn_chan_send1.restype = I
         lib.tdcn_chan_send1.argtypes = [
             P, U64, I, I, I, I, S, I64, ctypes.c_void_p, U64]
@@ -389,7 +392,10 @@ class _NativeOpsMixin:
             self._raise_send_failed(dst, rc, f"send (cid={cid}, seq={seq})")
 
     def _recv_full(self, src: int, cid, seq: int,
-                   timeout: float | None = None):
+                   timeout: float | None = None, into=None):
+        # `into` (the Python transports' recv_into posting) is accepted
+        # for interface parity but unused: the C coll-slot delivery owns
+        # its payload; callers fall back to their copy on non-identity
         from ompi_tpu.core.var import Deadline, dcn_timeout
 
         if timeout is None:

@@ -104,7 +104,16 @@ def _alloc_from_meta(meta: bytes) -> np.ndarray:
     return np.empty(m["shape"], dtype=np.dtype(m["dtype"]))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                into: memoryview | None = None) -> bytes | memoryview:
+    """Read exactly ``n`` bytes.  With ``into`` (a writable memoryview
+    of at least ``n`` bytes) the socket bytes stream straight into the
+    target — no intermediate bytearray, no final bytes() copy — and
+    the filled ``into[:n]`` view is returned."""
+    if into is not None:
+        view = into[:n]
+        _recv_into(sock, view)
+        return view
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -145,8 +154,12 @@ class _Rndv:
         self.granted = False    # slot acquired (must be released)
         self.cancelled = False  # sender connection died before completion
 
-    def alloc(self) -> None:
-        self.arr = _alloc_from_meta(self.meta)
+    def alloc(self, target: "np.ndarray | None" = None) -> None:
+        """``target``: a posted destination buffer — FRAGs then land
+        straight in the user-visible array (no reassembly allocation,
+        no delivery copy)."""
+        self.arr = target if target is not None \
+            else _alloc_from_meta(self.meta)
         self.view = (
             memoryview(self.arr).cast("B") if self.arr.nbytes
             else memoryview(b"")
@@ -206,7 +219,15 @@ class TcpTransport:
             "delivered": 0,
             "reconnects": 0, "retry_dials": 0, "retry_sends": 0,
             "deadline_expired": 0, "dedup_drops": 0, "respawns": 0,
+            "recv_into_placed": 0,
         }
+        #: posted destination buffers, (cid, seq, src) → ndarray: a
+        #: matching inbound eager payload or rendezvous landing buffer
+        #: is received STRAIGHT into the posted array (recv_into-style
+        #: delivery — the framed-TCP half of the in-place receive
+        #: story; consumers detect placement by identity)
+        self._posted_bufs: dict[tuple, np.ndarray] = {}
+        self._posted_lock = threading.Lock()
         #: exactly-once machinery: per-peer outbound message seq (one
         #: logical message = one seq, shared by the retry round and any
         #: injected wire duplicate) and per-sender-identity inbound
@@ -283,6 +304,46 @@ class TcpTransport:
 
     def _recv_shm(self, env: dict, meta: bytes, rlen: int) -> np.ndarray:
         raise KeyError("SHMF frame on a transport without shared memory")
+
+    # -- posted destination buffers (recv_into-style delivery) ----------
+
+    def post_recv_into(self, cid, seq: int, src: int, arr) -> None:
+        """Register a destination buffer for one expected coll-stream
+        message: the inbound payload is received straight into it
+        (eager frames via sock.recv_into; rendezvous FRAGs land in it
+        instead of a fresh reassembly allocation).  The consumer sees
+        the SAME array object delivered — identity confirms placement
+        and skips its copy."""
+        with self._posted_lock:
+            self._posted_bufs[(cid, int(seq), int(src))] = arr
+
+    def discard_posted(self, cid, seq: int, src: int) -> None:
+        """Withdraw an unconsumed posting (the waiter's cleanup when
+        the frame arrived before registration, or on its error path)."""
+        with self._posted_lock:
+            self._posted_bufs.pop((cid, int(seq), int(src)), None)
+
+    def _posted_target(self, env: dict, meta: bytes):
+        """The posted buffer matching this inbound frame's envelope —
+        consumed (popped) only when its shape/dtype agree with the
+        wire metadata, so a mismatched posting degrades to the copy
+        path instead of corrupting delivery."""
+        if not self._posted_bufs or env.get("kind") != "coll":
+            return None
+        key = (env.get("cid"), int(env.get("seq", -1)),
+               int(env.get("src", -1)))
+        with self._posted_lock:
+            arr = self._posted_bufs.get(key)
+            if arr is None:
+                return None
+            m = json.loads(meta.decode())
+            if (list(arr.shape) != list(m["shape"])
+                    or arr.dtype.str != m["dtype"]
+                    or not arr.flags["C_CONTIGUOUS"]):
+                return None
+            self._posted_bufs.pop(key, None)
+        self.stats["recv_into_placed"] += 1
+        return arr
 
     # -- exactly-once seq machinery -------------------------------------
 
@@ -375,9 +436,14 @@ class TcpTransport:
         import sys
 
         conn_keys: set[tuple[str, int]] = set()
+        # reusable header target: the per-frame header read streams
+        # into one buffer instead of allocating a bytearray + bytes
+        # per frame (the _recv_exact memoryview-target path)
+        hdr_view = memoryview(bytearray(_HDR.size))
         try:
             while self._running:
-                ftype, elen, mlen, rlen = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                ftype, elen, mlen, rlen = _HDR.unpack(
+                    _recv_exact(conn, _HDR.size, into=hdr_view))
                 env = json.loads(_recv_exact(conn, elen).decode()) if elen else {}
                 meta = _recv_exact(conn, mlen) if mlen else b""
                 drop_in = False
@@ -396,7 +462,13 @@ class TcpTransport:
                             drop_in = True
                 try:
                     if ftype == _EAGER:
-                        arr = _alloc_from_meta(meta)
+                        # recv_into-style delivery: a posted destination
+                        # buffer takes the payload straight off the
+                        # socket — no intermediate allocation, no copy
+                        tgt = (None if drop_in
+                               else self._posted_target(env, meta))
+                        arr = tgt if tgt is not None \
+                            else _alloc_from_meta(meta)
                         if rlen:
                             _recv_into(conn, memoryview(arr).cast("B"))
                         if not drop_in:
@@ -504,7 +576,7 @@ class TcpTransport:
                 if st.cancelled or not self._running:
                     self._rndv_slots.release()
                     return
-                st.alloc()
+                st.alloc(self._posted_target(st.env, st.meta))
                 st.granted = True
             try:
                 self.send_control(env["ra"], {"xid": env["xid"]}, _CTS)

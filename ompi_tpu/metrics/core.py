@@ -81,6 +81,13 @@ NATIVE_COUNTERS = (
     "stream_depth", "stream_depth_hwm", "stream_inflight",
     "stream_inflight_hwm", "chunk_shrinks", "sender_yields",
     "enqueue_waits",
+    # dispatch-floor tail: collectives served entirely by the C fast
+    # path, compiled-schedule cache hits/misses (the C plan cache AND
+    # the Python sched.CACHE merge into the same two names), and
+    # receives landed straight in a posted buffer (in-place eager
+    # memcpy or streamed RTS fill — either plane)
+    "coll_fastpath_ops", "sched_cache_hits", "sched_cache_misses",
+    "recv_into_placed",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

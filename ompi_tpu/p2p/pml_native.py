@@ -209,7 +209,7 @@ class NativeMatchingEngine:
 
     def recv_blocking(self, dest: int, source: int, tag: int,
                       fail_proc: int = -1, remote: bool = False,
-                      guard=None):
+                      guard=None, into=None):
         """Blocking receive in ONE C crossing (match-or-post + sleep on
         the request condvar): the fast path under MPI_Recv.  Returns
         (payload, Status); raises on engine close or watched-proc
@@ -220,7 +220,16 @@ class NativeMatchingEngine:
         semantics: there is no dead transport to escalate — unless the
         comm layer armed ``guard`` (the opt-in ``dcn_anysrc_timeout``
         triple): then expiry runs the guard's communicator-wide
-        liveness check and RE-ARMS when every member is alive."""
+        liveness check and RE-ARMS when every member is alive.
+
+        ``into``: optional contiguous destination ndarray — the ctypes
+        ``recv_into`` surface (tdcn_precv_into): the post carries the
+        buffer, so a racing in-order streamed RTS lands its FRAGs
+        straight in it and a copy-path delivery is memcpy'd into it in
+        C.  When placement/fill happened the returned payload IS
+        ``into`` (identity check — nothing left to copy); oversized
+        messages fall back to the engine-owned payload for the
+        caller's truncation handling."""
         from ompi_tpu.dcn.native import _tls, _tls_msg, _wrap_payload
 
         self._check_rank(dest)
@@ -230,6 +239,15 @@ class NativeMatchingEngine:
             return None, Status.null()
         root = self._root
         msg = _tls_msg()
+        into_ptr = 0
+        into_cap = 0
+        if into is not None:
+            if not (isinstance(into, np.ndarray)
+                    and into.flags["C_CONTIGUOUS"]):
+                into = None
+            else:
+                into_ptr = into.ctypes.data
+                into_cap = into.nbytes
         dl = None
         anysrc_guard = None
         if remote and source != ANY_SOURCE:
@@ -242,9 +260,16 @@ class NativeMatchingEngine:
             anysrc_guard = guard
             dl = Deadline(guard[0])
         while True:
-            rc = root._lib.tdcn_precv(
-                root._h, self._cid_b, dest, source, tag, fail_proc,
-                dl.slice(2.0) if dl is not None else 120.0, _tls.msg_ref)
+            if into is not None:
+                rc = root._lib.tdcn_precv_into(
+                    root._h, self._cid_b, dest, source, tag, fail_proc,
+                    dl.slice(2.0) if dl is not None else 120.0,
+                    into_ptr, into_cap, _tls.msg_ref)
+            else:
+                rc = root._lib.tdcn_precv(
+                    root._h, self._cid_b, dest, source, tag, fail_proc,
+                    dl.slice(2.0) if dl is not None else 120.0,
+                    _tls.msg_ref)
             if rc == 0:
                 break
             if rc == -2:
@@ -276,6 +301,15 @@ class NativeMatchingEngine:
         if msg.pyhandle:
             payload = root.take_handle(msg.pyhandle)
             count, nbytes = int(msg.count), int(msg.nbytes)
+        elif into is not None and msg.data == into_ptr:
+            # delivered in place (streamed RTS fill, ring eager
+            # placement, or the C-side memcpy): the payload IS the
+            # caller's buffer — identity tells the caller nothing is
+            # left to copy or free
+            payload = into
+            nbytes = int(msg.nbytes)
+            dt = np.dtype(msg.dtype.decode() or "u1")
+            count = nbytes // max(1, dt.itemsize)
         else:
             payload = _wrap_payload(root._lib, msg)
             count, nbytes = int(payload.size), int(payload.nbytes)

@@ -63,9 +63,13 @@ K_START = "serve.start."
 ENV_SERVE_PIDFILE = "OMPI_TPU_SERVE_PIDFILE"
 
 #: transport counters proving warm reuse (flat across jobs = no
-#: re-dials) and the per-job delivery/dedup picture
+#: re-dials) and the per-job delivery/dedup picture; the schedule-cache
+#: pair proves the OTHER warm asset — compiled persistent-collective
+#: plans surviving across jobs like the mesh (hits climbing while
+#: misses stay flat across same-signature jobs)
 _DIAL_KEYS = ("reconnects", "retry_dials")
-_REPORT_KEYS = ("delivered", "reconnects", "retry_dials", "dedup_drops")
+_REPORT_KEYS = ("delivered", "reconnects", "retry_dials", "dedup_drops",
+                "sched_cache_hits", "sched_cache_misses")
 
 #: completion records kept for re-publication after a daemon restart
 _DONE_CACHE = 256
@@ -462,6 +466,15 @@ def main() -> int:
 
     world = api.init()
     ctx = world.procctx
+    # warm compiled-schedule cache (ROADMAP serving item (b)): the
+    # process-wide plan store (ompi_tpu/coll/sched.CACHE) lives exactly
+    # as long as this resident worker — job 2's persistent collectives
+    # of a job-1 signature replay already-compiled schedules, and its
+    # hit/miss counters merge into the worker's native-counter exports
+    # (the per-job completion records + /metrics scrapes above)
+    from ompi_tpu.coll import sched as _sched
+
+    _sched.register_metrics_provider()
     store = mca.default_context().store
     poll = max(0.02, int(store.get("serve_poll_ms", 50) or 50) / 1000.0)
     # rsh-aware (ft_remote_respawn_timeout under OMPI_TPU_RSH), like

@@ -90,23 +90,74 @@ def inc(name: str, n: int = 1) -> None:
         _metrics.observe_size("spc_" + name[:-len("_bytes")], n)
 
 
+# -- C-ABI fast-path merge ----------------------------------------------
+# The shim's C collective fast path never crosses embedded Python, so
+# its MPI_Allreduce/Bcast/... calls cannot tick inc() — they accrue in
+# a C-side per-op array instead (shim.c g_fp_coll_spc) and merge here
+# at READ time: zero hot-path cost, and the spc_* pvars keep ticking
+# under stock C programs.  Outside a shim-hosted process the symbol
+# probe fails once and the merge is a no-op.
+
+_NATIVE_SLOTS = ("barrier", "bcast", "reduce", "allreduce", "allgather")
+_native_fn = None
+_native_probed = False
+_native_base: dict[str, int] = {}
+
+
+def _native_counts() -> dict[str, int]:
+    global _native_fn, _native_probed
+    if not _native_probed:
+        _native_probed = True
+        try:
+            import ctypes
+
+            lib = ctypes.CDLL(None)
+            fn = lib.tpumpi_coll_spc
+            fn.argtypes = [ctypes.c_longlong * len(_NATIVE_SLOTS)]
+            fn.restype = None
+            _native_fn = fn
+        except (OSError, AttributeError, TypeError):
+            _native_fn = None
+    if _native_fn is None:
+        return {}
+    import ctypes
+
+    buf = (ctypes.c_longlong * len(_NATIVE_SLOTS))()
+    _native_fn(buf)
+    return {n: int(buf[i]) for i, n in enumerate(_NATIVE_SLOTS)}
+
+
 def get(name: str) -> int:
+    nat = 0
+    if name in _NATIVE_SLOTS:
+        nc = _native_counts()
+        if nc:
+            nat = max(0, nc[name] - _native_base.get(name, 0))
     with _lock:
-        return _counters.get(name, 0)
+        return _counters.get(name, 0) + nat
 
 
 def snapshot() -> dict[str, int]:
     with _lock:
-        return dict(_counters)
+        out = dict(_counters)
+    nc = _native_counts()
+    for n, v in nc.items():
+        d = max(0, v - _native_base.get(n, 0))
+        if d or n in out:
+            out[n] = out.get(n, 0) + d
+    return out
 
 
 def reset() -> None:
     """Zero every counter IN PLACE — touched keys stay visible in
     :func:`snapshot` (the grow-only index rule; dropping keys made
-    post-reset snapshot diffs silently lose names)."""
+    post-reset snapshot diffs silently lose names).  The monotone
+    C-side counts are re-baselined (the C plane is never written)."""
     with _lock:
         for k in _counters:
             _counters[k] = 0
+    for n, v in _native_counts().items():
+        _native_base[n] = v
 
 
 def reset_one(name: str) -> None:
@@ -115,6 +166,10 @@ def reset_one(name: str) -> None:
     with _lock:
         if name in _counters:
             _counters[name] = 0
+    if name in _NATIVE_SLOTS:
+        nc = _native_counts()
+        if nc:
+            _native_base[name] = nc[name]
 
 
 def clear() -> None:

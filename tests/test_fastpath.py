@@ -190,3 +190,91 @@ def test_hot_signature_cache_device_path(world):
 
     with _pytest.raises(MPICommError):
         d.allreduce(xd, SUM)
+
+
+def test_persistent_schedule_cache_hits_across_dup(world):
+    """The process-wide compiled-schedule cache (coll/sched.CACHE): a
+    second *_init of the same (shape, op, dtype) signature is a cache
+    hit — including on a FRESH communicator of the same shape (dup ≈
+    the next job in a resident tpud worker) — and the replayed plan
+    computes the same result as the blocking collective."""
+    from ompi_tpu.coll import sched
+
+    x = rank_data((12,), np.float32, seed=31)
+    h0 = sched.CACHE.stats()
+    req = world.allreduce_init(x, SUM)
+    out = np.asarray(req.start().wait())
+    np.testing.assert_allclose(
+        out, np.broadcast_to(x.sum(0), x.shape), rtol=1e-5)
+    h1 = sched.CACHE.stats()
+    assert h1["sched_cache_misses"] > h0["sched_cache_misses"]
+    # same signature, same comm: hit
+    world.allreduce_init(x, SUM)
+    # same signature, FRESH comm of the same shape: still a hit
+    d = world.dup()
+    d.allreduce_init(x, SUM)
+    h2 = sched.CACHE.stats()
+    assert h2["sched_cache_hits"] >= h1["sched_cache_hits"] + 2
+    assert h2["sched_cache_misses"] == h1["sched_cache_misses"]
+    # a different signature misses (keying includes count/dtype)
+    d.allreduce_init(rank_data((5,), np.float64, seed=32), SUM)
+    assert sched.CACHE.stats()["sched_cache_misses"] \
+        == h2["sched_cache_misses"] + 1
+    d.free()
+
+
+def test_persistent_bcast_allgather_init_cached(world):
+    from ompi_tpu.coll import sched
+
+    x = rank_data((6,), np.float32, seed=33)
+    out = np.asarray(world.bcast_init(x, root=3).start().wait())
+    np.testing.assert_array_equal(out, np.broadcast_to(x[3], x.shape))
+    g = np.asarray(world.allgather_init(x).start().wait())
+    assert g.shape == (N, N, 6)
+    np.testing.assert_array_equal(g[0], x)
+    h = sched.CACHE.stats()
+    world.bcast_init(x, root=3)
+    world.allgather_init(x)
+    h2 = sched.CACHE.stats()
+    assert h2["sched_cache_hits"] >= h["sched_cache_hits"] + 2
+
+
+def test_schedule_cache_disable_var(world):
+    """--mca coll_sched_cache_enable 0 turns the store into a
+    pass-through: lookups build fresh, counters stay flat."""
+    from ompi_tpu.coll import sched
+    from ompi_tpu.core import mca
+
+    store = mca.default_context().store
+    x = rank_data((9,), np.float32, seed=34)
+    world.allreduce_init(x, SUM)  # prime (cached path)
+    store.set("coll_sched_cache_enable", 0)
+    try:
+        h0 = sched.CACHE.stats()
+        req = world.allreduce_init(x, SUM)
+        out = np.asarray(req.start().wait())
+        np.testing.assert_allclose(
+            out, np.broadcast_to(x.sum(0), x.shape), rtol=1e-5)
+        h1 = sched.CACHE.stats()
+        assert h1["sched_cache_hits"] == h0["sched_cache_hits"]
+        assert h1["sched_cache_misses"] == h0["sched_cache_misses"]
+    finally:
+        store.set("coll_sched_cache_enable", 1)
+
+
+def test_schedule_cache_capacity_bounded():
+    from ompi_tpu.coll.sched import ScheduleCache
+    from ompi_tpu.core import mca
+
+    store = mca.default_context().store
+    store.set("coll_sched_cache_max", 4)
+    try:
+        c = ScheduleCache()
+        for i in range(10):
+            c.lookup(("k", i), lambda i=i: i * 2)
+        assert len(c) <= 4
+        # FIFO eviction: the oldest keys rebuilt on re-lookup
+        assert c.lookup(("k", 0), lambda: -1) == -1
+        assert c.stats()["sched_cache_misses"] == 11
+    finally:
+        store.set("coll_sched_cache_max", 256)

@@ -94,6 +94,13 @@ _STREAM_TAIL = (
     "enqueue_waits",
 )
 
+#: dispatch-floor tail (PR 12): appended AFTER the streaming tail —
+#: the frozen prefix and the streaming tail stay byte-identical
+_DISPATCH_TAIL = (
+    "coll_fastpath_ops", "sched_cache_hits", "sched_cache_misses",
+    "recv_into_placed",
+)
+
 
 def test_stats_tail_appended_not_reordered():
     native = _native()
@@ -103,10 +110,12 @@ def test_stats_tail_appended_not_reordered():
     names = lib.tdcn_stats_names().decode().split(",")
     assert names[0] == "version"
     assert tuple(names[1:]) == mcore.NATIVE_COUNTERS
-    # append-only: the frozen prefix survives byte-for-byte, the
-    # streaming tail follows it, and the C version stamp stays 1
+    # append-only: the frozen prefix survives byte-for-byte, each later
+    # tail follows in order, and the C version stamp stays 1
     assert tuple(names[1:1 + len(_FROZEN_V1_PREFIX)]) == _FROZEN_V1_PREFIX
-    assert tuple(names[1 + len(_FROZEN_V1_PREFIX):]) == _STREAM_TAIL
+    n0 = 1 + len(_FROZEN_V1_PREFIX)
+    assert tuple(names[n0:n0 + len(_STREAM_TAIL)]) == _STREAM_TAIL
+    assert tuple(names[n0 + len(_STREAM_TAIL):]) == _DISPATCH_TAIL
     assert mcore.NATIVE_STATS_VERSION == 1
     # gauges classified so monotonicity checks skip them
     assert {"stream_depth", "stream_inflight"} <= set(mcore.GAUGES)
@@ -397,8 +406,18 @@ def test_np2_windowed_sweep_acceptance():
     0.22x), stays monotone-with-noise-margin through 4 MiB, and the
     doorbell coalescing provably suppressed wakes."""
     import json
+    import os
     import subprocess
     import sys
+
+    if (os.cpu_count() or 1) < 2:
+        # the windowed/serial comparison measures producer-consumer
+        # OVERLAP: with one core the threads timeshare and the windowed
+        # leg sits at the pre-fix ratio by construction (verified: the
+        # PR 8 baseline engine collapses identically on a 1-core
+        # window) — there is nothing to regress-test without a second
+        # core, exactly like the absolute native perf ceilings
+        pytest.skip("windowed-vs-serial overlap needs >= 2 cores")
 
     _native()
     from ompi_tpu import native as nat
@@ -476,3 +495,184 @@ def test_connkill_mid_stream_keeps_ring_exactly_once(engine_pair):
         while lib.tdcn_send_wait(a._h, r, 30.0) == 1:
             pass
     a.chan_close(chan)
+
+
+# -- dispatch-floor PR: order-gate + recv_into regressions --------------
+
+
+def test_bufferless_reservation_consumes_order_gate(engine_pair):
+    """PR 8's recorded stall risk: a BUFFER-LESS posted recv matched by
+    an in-order streaming RTS must still consume its order-gate slot —
+    otherwise the recv_into placement queued BEHIND it (whose
+    completion bypasses the gate via the fill path) parks forever and
+    the stream deadlocks."""
+    a, b = engine_pair
+    lib = a._lib
+    from ompi_tpu.dcn.native import TdcnMsg
+
+    lib.tdcn_set_stream(a._h, 64 << 10, 32 << 20, 1)  # force chunking
+    nbytes = 256 << 10
+    # post BOTH receives before any byte moves: rid1 buffer-less, rid2
+    # carrying its destination buffer
+    rid1 = lib.tdcn_post_recv(b._h, b"og", 1, 0, 1)
+    buf2 = np.zeros(nbytes, np.uint8)
+    rid2 = lib.tdcn_post_recv_into(
+        b._h, b"og", 1, 0, 2, buf2.ctypes.data_as(ctypes.c_void_p),
+        buf2.nbytes)
+    s0 = _stats(b)
+    chan = a.chan_open(b.address, "og")
+    m1 = np.full(nbytes, 7, np.uint8)
+    m2 = np.arange(nbytes, dtype=np.int64).astype(np.uint8)
+    for tag, arr in ((1, m1), (2, m2)):
+        r = lib.tdcn_chan_isend1(a._h, chan, 1, 0, 1, tag, b"|u1",
+                                 arr.nbytes,
+                                 arr.ctypes.data_as(ctypes.c_void_p),
+                                 arr.nbytes, 1)  # buffered
+        assert r == 0
+    msg = TdcnMsg()
+    rc = lib.tdcn_req_wait(b._h, rid1, 30.0, ctypes.byref(msg))
+    assert rc == 0
+    assert _payload_bytes(lib, msg) == bytes(m1)
+    msg2 = TdcnMsg()
+    rc = lib.tdcn_req_wait(b._h, rid2, 30.0, ctypes.byref(msg2))
+    assert rc == 0, "recv_into behind a buffer-less reservation wedged"
+    # in-place: the payload IS the posted buffer (no copy, no free)
+    assert msg2.data == buf2.ctypes.data
+    np.testing.assert_array_equal(buf2, m2)
+    s1 = _stats(b)
+    assert s1["recv_into_placed"] > s0.get("recv_into_placed", 0)
+    a.chan_close(chan)
+
+
+def test_precv_reserved_survives_timeout(engine_pair):
+    """The copy-path stall fix: a buffer-less tdcn_precv whose posted
+    recv was RESERVED by an in-order RTS (the MPI match happened; the
+    order-gate slot is consumed) must NOT withdraw on its timeout —
+    the old withdraw orphaned the in-flight transfer, lost the
+    message, and wedged the caller's retry forever."""
+    a, b = engine_pair
+    lib = a._lib
+    from ompi_tpu.dcn.native import TdcnMsg
+
+    lib.tdcn_set_stream(a._h, 128 << 10, 32 << 20, 1)
+    # stall every ring write 25 ms: the RTS lands (and reserves) well
+    # inside the precv's 100 ms timeout, the transfer completes well
+    # after it — the timeout deterministically fires mid-reservation
+    lib.tdcn_fault_set(25_000_000, 1, -1)
+    try:
+        nbytes = 1 << 20
+        arr = np.full(nbytes, 9, np.uint8)
+        res = {}
+
+        def rx():
+            msg = TdcnMsg()
+            rc = lib.tdcn_precv(b._h, b"pr", 1, 0, 7, -1, 0.1,
+                                ctypes.byref(msg))
+            res["rc"] = rc
+            if rc == 0:
+                res["data"] = _payload_bytes(lib, msg)
+
+        t = threading.Thread(target=rx)
+        t.start()
+        time.sleep(0.02)  # the recv is posted before the RTS arrives
+        chan = a.chan_open(b.address, "pr")
+        r = lib.tdcn_chan_isend1(a._h, chan, 1, 0, 1, 7, b"|u1",
+                                 arr.nbytes,
+                                 arr.ctypes.data_as(ctypes.c_void_p),
+                                 arr.nbytes, 1)
+        assert r == 0
+        t.join(timeout=30)
+        assert not t.is_alive(), "reserved precv never completed"
+        assert res["rc"] == 0, (
+            f"reserved precv returned rc={res['rc']} (message lost)")
+        assert res["data"] == bytes(arr)
+        a.chan_close(chan)
+    finally:
+        lib.tdcn_fault_set(0, 0, -1)
+
+
+def test_precv_into_copy_path_lands_in_buffer(engine_pair):
+    """tdcn_precv_into: the destination buffer rides the call — an
+    unexpected-queue match is memcpy'd into it in C (data == buf tells
+    the caller nothing is left to copy or free), and a too-small
+    buffer keeps the engine-owned payload for truncation handling."""
+    a, b = engine_pair
+    lib = a._lib
+    from ompi_tpu.dcn.native import TdcnMsg
+
+    chan = a.chan_open(b.address, "pi")
+    arr = np.arange(64, dtype=np.uint8)
+    assert lib.tdcn_chan_send1(a._h, chan, 1, 0, 1, 5, b"|u1", 64,
+                               arr.ctypes.data_as(ctypes.c_void_p),
+                               64) == 0
+    # wait for the unexpected arrival, then receive into a buffer
+    deadline = time.monotonic() + 10
+    while (lib.tdcn_pending(b._h, b"pi", 1, 0) == 0
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    dst = np.zeros(64, np.uint8)
+    msg = TdcnMsg()
+    rc = lib.tdcn_precv_into(b._h, b"pi", 1, 0, 5, -1, 10.0,
+                             dst.ctypes.data_as(ctypes.c_void_p),
+                             dst.nbytes, ctypes.byref(msg))
+    assert rc == 0
+    assert msg.data == dst.ctypes.data  # in-place contract
+    np.testing.assert_array_equal(dst, arr)
+    # truncation: a too-small destination keeps the copy path
+    assert lib.tdcn_chan_send1(a._h, chan, 1, 0, 1, 6, b"|u1", 64,
+                               arr.ctypes.data_as(ctypes.c_void_p),
+                               64) == 0
+    small = np.zeros(16, np.uint8)
+    msg2 = TdcnMsg()
+    rc = lib.tdcn_precv_into(b._h, b"pi", 1, 0, 6, -1, 10.0,
+                             small.ctypes.data_as(ctypes.c_void_p),
+                             small.nbytes, ctypes.byref(msg2))
+    assert rc == 0
+    assert msg2.data != small.ctypes.data  # engine-owned: caller copies
+    assert msg2.nbytes == 64
+    assert _payload_bytes(lib, msg2) == bytes(arr)
+    a.chan_close(chan)
+
+
+def test_tcp_posted_buffer_recv_into():
+    """The framed-TCP leg's recv_into delivery: a posted destination
+    buffer takes an eager payload straight off the socket, and a
+    rendezvous transfer lands its FRAGs in it (no reassembly
+    allocation) — the consumer sees the SAME array object."""
+    from ompi_tpu.dcn.tcp import TcpTransport
+
+    got = []
+    rx = TcpTransport(lambda env, arr: got.append((dict(env), arr)))
+    tx = TcpTransport(lambda env, arr: None)
+    try:
+        # eager leg
+        dst = np.zeros(128, np.float32)
+        rx.post_recv_into(9, 0, 1, dst)
+        payload = np.arange(128, dtype=np.float32)
+        tx.send(rx.address, {"kind": "coll", "cid": 9, "seq": 0,
+                             "src": 1}, payload)
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert got and got[0][1] is dst  # identity: placed, no copy
+        np.testing.assert_array_equal(dst, payload)
+        # rendezvous leg (payload above the eager limit)
+        big_n = (rx.eager_limit // 8) + 4096
+        big_dst = np.zeros(big_n, np.float64)
+        rx.post_recv_into(9, 1, 1, big_dst)
+        big = np.arange(big_n, dtype=np.float64)
+        tx.send(rx.address, {"kind": "coll", "cid": 9, "seq": 1,
+                             "src": 1}, big)
+        deadline = time.monotonic() + 20
+        while len(got) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(got) == 2 and got[1][1] is big_dst
+        np.testing.assert_array_equal(big_dst, big)
+        assert rx.stats["recv_into_placed"] == 2
+        # a stale posting is withdrawable (no leak, no misdelivery)
+        rx.post_recv_into(9, 2, 1, np.zeros(4, np.uint8))
+        rx.discard_posted(9, 2, 1)
+        assert not rx._posted_bufs
+    finally:
+        rx.close()
+        tx.close()

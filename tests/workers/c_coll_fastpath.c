@@ -1,0 +1,291 @@
+/* C collective fast-path acceptance (np=2): the dispatch-floor leg.
+ *
+ * Proves, from a stock MPI C program:
+ *   - contiguous predefined-type Bcast/Allreduce/Reduce/Allgather/
+ *     Barrier run on the C path (coll_fastpath_ops counter delta);
+ *   - MPI_SUM is BIT-EXACT with the embedded-Python path (the same
+ *     data reduced through a contiguous DERIVED datatype — which
+ *     falls back to capi — must compare equal byte for byte);
+ *   - derived datatypes and user ops route to the fallback (no
+ *     fastpath counter movement) and still compute correctly;
+ *   - MPI-4 persistent collectives (Allreduce_init/Bcast_init/
+ *     Allgather_init + Start/Startall) replay compiled schedules
+ *     (sched_cache hits climb) through the full lifecycle, including
+ *     MPI_Request_free before and after Start;
+ *   - plan caches are comm-scoped (dup/split get their own, results
+ *     stay correct).
+ *
+ * Prints "CFP COMPLETE" on rank 0 when every check passed.
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern int tpumpi_transport_stats(unsigned long long *, int);
+extern const char *tpumpi_transport_stats_names(void);
+
+static int g_fail = 0;
+#define CHECK(cond, msg)                                        \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      printf("FAIL: %s\n", msg);                                \
+      g_fail = 1;                                               \
+    }                                                           \
+  } while (0)
+
+#define NSTAT 64
+static char g_names[2048];
+
+static unsigned long long stat_of(const unsigned long long *v, int n,
+                                  const char *name) {
+  /* slot 0 is the version stamp; names[] includes it */
+  char *save = NULL;
+  char buf[2048];
+  snprintf(buf, sizeof buf, "%s", g_names);
+  int i = 0;
+  for (char *tok = strtok_r(buf, ",", &save); tok && i < n;
+       tok = strtok_r(NULL, ",", &save), i++)
+    if (strcmp(tok, name) == 0) return v[i];
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size != 2) {
+    printf("FAIL: need np=2, got %d\n", size);
+    MPI_Finalize();
+    return 1;
+  }
+  snprintf(g_names, sizeof g_names, "%s", tpumpi_transport_stats_names());
+  /* warm-up: the stats re-export needs a live fast-path slot, which
+   * the first fast-path collective creates */
+  MPI_Barrier(MPI_COMM_WORLD);
+  unsigned long long s0[NSTAT], s1[NSTAT];
+  int ns = tpumpi_transport_stats(s0, NSTAT);
+  CHECK(ns > 0, "transport stats available");
+
+  /* -- small float SUM: bit-exact with the rank-ordered fold -------- */
+  enum { N = 7 };
+  float x[N], got[N], expect[N];
+  for (int i = 0; i < N; i++) {
+    float x0 = 1e8f + 3.0f * i, x1 = 1.625f + 0.1f * i;
+    x[i] = rank == 0 ? x0 : x1;
+    expect[i] = x0 + x1; /* proc-0-rooted ordered fold at np=2 */
+  }
+  MPI_Allreduce(x, got, N, MPI_FLOAT, MPI_SUM, MPI_COMM_WORLD);
+  CHECK(memcmp(got, expect, sizeof got) == 0,
+        "small float SUM bit-exact vs ordered fold");
+
+  /* same data through a CONTIGUOUS DERIVED dtype: falls back to the
+   * embedded-Python path — results must match the C path byte for
+   * byte (the two planes run the same schedule) */
+  MPI_Datatype cf;
+  MPI_Type_contiguous(1, MPI_FLOAT, &cf);
+  MPI_Type_commit(&cf);
+  float got_py[N];
+  unsigned long long a0[NSTAT], a1[NSTAT];
+  tpumpi_transport_stats(a0, NSTAT);
+  MPI_Allreduce(x, got_py, N, cf, MPI_SUM, MPI_COMM_WORLD);
+  tpumpi_transport_stats(a1, NSTAT);
+  CHECK(memcmp(got, got_py, sizeof got) == 0,
+        "derived-dtype fallback bit-exact vs C fast path");
+  CHECK(stat_of(a1, ns, "coll_fastpath_ops") ==
+            stat_of(a0, ns, "coll_fastpath_ops"),
+        "derived dtype did NOT take the C fast path");
+  MPI_Type_free(&cf);
+
+  /* -- int MAX / double reduce / bcast / allgather / barrier -------- */
+  int iv = (rank + 1) * 37, imax = 0;
+  MPI_Allreduce(&iv, &imax, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+  CHECK(imax == 74, "int MAX allreduce");
+
+  double dv[3] = {0.5 + rank, 1.25 * (rank + 1), -2.0 * rank};
+  double dsum[3] = {0, 0, 0};
+  MPI_Reduce(dv, dsum, 3, MPI_DOUBLE, MPI_SUM, 1, MPI_COMM_WORLD);
+  if (rank == 1) {
+    CHECK(dsum[0] == 0.5 + 1.5 && dsum[1] == 1.25 + 2.5 &&
+              dsum[2] == -2.0,
+          "double SUM reduce at root 1");
+  }
+  /* MPI_IN_PLACE reduce at a NON-FIRST root: the root's aliased
+   * contribution must survive the member-0-first fold order (the
+   * review-found double-count bug) */
+  double dip[3];
+  for (int i = 0; i < 3; i++) dip[i] = dv[i];
+  if (rank == 1)
+    MPI_Reduce(MPI_IN_PLACE, dip, 3, MPI_DOUBLE, MPI_SUM, 1,
+               MPI_COMM_WORLD);
+  else
+    MPI_Reduce(dip, NULL, 3, MPI_DOUBLE, MPI_SUM, 1, MPI_COMM_WORLD);
+  if (rank == 1)
+    CHECK(dip[0] == 2.0 && dip[1] == 3.75 && dip[2] == -2.0,
+          "IN_PLACE reduce at root 1");
+
+  long bv[4] = {0, 0, 0, 0};
+  if (rank == 1)
+    for (int i = 0; i < 4; i++) bv[i] = 100 + i;
+  MPI_Bcast(bv, 4, MPI_LONG, 1, MPI_COMM_WORLD);
+  CHECK(bv[0] == 100 && bv[3] == 103, "bcast from root 1");
+
+  short sv[2] = {(short)(rank * 2), (short)(rank * 2 + 1)};
+  short ag[4] = {0, 0, 0, 0};
+  MPI_Allgather(sv, 2, MPI_SHORT, ag, 2, MPI_SHORT, MPI_COMM_WORLD);
+  CHECK(ag[0] == 0 && ag[1] == 1 && ag[2] == 2 && ag[3] == 3,
+        "allgather");
+  MPI_Barrier(MPI_COMM_WORLD);
+
+  /* -- large float SUM (ring crossover): still elementwise-exact ---- */
+  enum { BIG = 65536 }; /* 256 KiB > the 64 KiB ring threshold */
+  float *bx = malloc(BIG * sizeof(float));
+  float *bg = malloc(BIG * sizeof(float));
+  for (int i = 0; i < BIG; i++) bx[i] = (rank + 1) * 0.25f + (i & 1023);
+  MPI_Allreduce(bx, bg, BIG, MPI_FLOAT, MPI_SUM, MPI_COMM_WORLD);
+  int big_ok = 1;
+  for (int i = 0; i < BIG; i++) {
+    float e = (0.25f + (i & 1023)) + (0.5f + (i & 1023));
+    if (bg[i] != e) big_ok = 0;
+  }
+  CHECK(big_ok, "ring-path large float SUM elementwise exact");
+  free(bx);
+  free(bg);
+
+  /* -- complex PROD (the componentwise-kernel count contract) ------- */
+  {
+    double cz[6]; /* 3 complex elements as (re, im) pairs */
+    for (int i = 0; i < 3; i++) {
+      cz[2 * i] = rank == 0 ? 2.0 + i : 0.5;
+      cz[2 * i + 1] = rank == 0 ? 1.0 : -1.0 + i;
+    }
+    double cr[6];
+    MPI_Allreduce(cz, cr, 3, MPI_C_DOUBLE_COMPLEX, MPI_PROD,
+                  MPI_COMM_WORLD);
+    for (int i = 0; i < 3; i++) {
+      double a_re = 2.0 + i, a_im = 1.0;       /* rank 0's element */
+      double b_re = 0.5, b_im = -1.0 + i;      /* rank 1's element */
+      double e_re = a_re * b_re - a_im * b_im; /* naive formula, the */
+      double e_im = a_re * b_im + a_im * b_re; /* fold order a OP b  */
+      if (cr[2 * i] != e_re || cr[2 * i + 1] != e_im) {
+        CHECK(0, "complex PROD allreduce");
+        break;
+      }
+    }
+  }
+
+  /* -- user-op fallback --------------------------------------------- */
+  MPI_Op nc;
+  /* MPI_LAND is predefined but NOT C-served (numpy bool-cast
+   * semantics): it must route to the fallback and still be right */
+  int lv = rank == 0 ? 1 : 2, land = 0;
+  tpumpi_transport_stats(a0, NSTAT);
+  MPI_Allreduce(&lv, &land, 1, MPI_INT, MPI_LAND, MPI_COMM_WORLD);
+  tpumpi_transport_stats(a1, NSTAT);
+  CHECK(land == 1, "LAND fallback result");
+  CHECK(stat_of(a1, ns, "coll_fastpath_ops") ==
+            stat_of(a0, ns, "coll_fastpath_ops"),
+        "LAND did NOT take the C fast path");
+  (void)nc;
+
+  /* -- MPI-4 persistent collectives --------------------------------- */
+  float px[N], pr[N];
+  for (int i = 0; i < N; i++) px[i] = rank + i * 0.5f;
+  MPI_Request pers;
+  MPI_Allreduce_init(px, pr, N, MPI_FLOAT, MPI_SUM, MPI_COMM_WORLD,
+                     MPI_INFO_NULL, &pers);
+  for (int round = 0; round < 4; round++) {
+    for (int i = 0; i < N; i++) px[i] = rank + i * 0.5f + round;
+    MPI_Start(&pers);
+    MPI_Status st;
+    MPI_Wait(&pers, &st);
+    CHECK(pers != MPI_REQUEST_NULL, "persistent handle survives Wait");
+    for (int i = 0; i < N; i++) {
+      float e = (0 + i * 0.5f + round) + (1 + i * 0.5f + round);
+      if (pr[i] != e) {
+        CHECK(0, "persistent allreduce round result");
+        break;
+      }
+    }
+  }
+  /* a second init of the SAME signature must hit the plan cache */
+  unsigned long long h0[NSTAT], h1[NSTAT];
+  tpumpi_transport_stats(h0, NSTAT);
+  MPI_Request pers2;
+  MPI_Allreduce_init(px, pr, N, MPI_FLOAT, MPI_SUM, MPI_COMM_WORLD,
+                     MPI_INFO_NULL, &pers2);
+  tpumpi_transport_stats(h1, NSTAT);
+  CHECK(stat_of(h1, ns, "sched_cache_hits") >
+            stat_of(h0, ns, "sched_cache_hits"),
+        "second same-signature init hits the schedule cache");
+  /* free BEFORE any Start (inactive request) */
+  MPI_Request_free(&pers2);
+  CHECK(pers2 == MPI_REQUEST_NULL, "free of inactive persistent req");
+  /* free AFTER a Start (round completed) */
+  MPI_Start(&pers);
+  MPI_Wait(&pers, MPI_STATUS_IGNORE);
+  MPI_Request_free(&pers);
+  CHECK(pers == MPI_REQUEST_NULL, "free of started persistent req");
+
+  /* Startall over a mixed pair (allreduce + bcast) */
+  float qx[N], qr[N], qb[3] = {0, 0, 0};
+  for (int i = 0; i < N; i++) qx[i] = 2.0f * rank + i;
+  if (rank == 0)
+    for (int i = 0; i < 3; i++) qb[i] = 7.0f + i;
+  MPI_Request pair[2];
+  MPI_Allreduce_init(qx, qr, N, MPI_FLOAT, MPI_SUM, MPI_COMM_WORLD,
+                     MPI_INFO_NULL, &pair[0]);
+  MPI_Bcast_init(qb, 3, MPI_FLOAT, 0, MPI_COMM_WORLD, MPI_INFO_NULL,
+                 &pair[1]);
+  MPI_Startall(2, pair);
+  MPI_Waitall(2, pair, MPI_STATUSES_IGNORE);
+  CHECK(qr[0] == 2.0f && qb[2] == 9.0f, "Startall pair results");
+  MPI_Request_free(&pair[0]);
+  MPI_Request_free(&pair[1]);
+
+  /* persistent allgather */
+  int gv[2] = {rank * 10, rank * 10 + 1}, gall[4] = {0, 0, 0, 0};
+  MPI_Request pg;
+  MPI_Allgather_init(gv, 2, MPI_INT, gall, 2, MPI_INT, MPI_COMM_WORLD,
+                     MPI_INFO_NULL, &pg);
+  MPI_Start(&pg);
+  MPI_Wait(&pg, MPI_STATUS_IGNORE);
+  CHECK(gall[0] == 0 && gall[1] == 1 && gall[2] == 10 && gall[3] == 11,
+        "persistent allgather");
+  MPI_Request_free(&pg);
+
+  /* -- comm dup/split: plans are comm-scoped ------------------------ */
+  MPI_Comm dup;
+  MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+  float dgot[N];
+  MPI_Allreduce(x, dgot, N, MPI_FLOAT, MPI_SUM, dup);
+  CHECK(memcmp(dgot, expect, sizeof dgot) == 0, "allreduce on dup");
+  MPI_Comm split;
+  MPI_Comm_split(MPI_COMM_WORLD, 0, rank, &split); /* both in color 0 */
+  MPI_Allreduce(x, dgot, N, MPI_FLOAT, MPI_SUM, split);
+  CHECK(memcmp(dgot, expect, sizeof dgot) == 0, "allreduce on split");
+  MPI_Comm self_split;
+  MPI_Comm_split(MPI_COMM_WORLD, rank, 0, &self_split); /* size 1 */
+  MPI_Allreduce(x, dgot, N, MPI_FLOAT, MPI_SUM, self_split);
+  CHECK(memcmp(dgot, x, sizeof dgot) == 0, "size-1 split allreduce");
+  MPI_Comm_free(&dup);
+  MPI_Comm_free(&split);
+  MPI_Comm_free(&self_split);
+
+  /* -- the fast path actually engaged ------------------------------- */
+  tpumpi_transport_stats(s1, NSTAT);
+  unsigned long long ops = stat_of(s1, ns, "coll_fastpath_ops") -
+                           stat_of(s0, ns, "coll_fastpath_ops");
+  unsigned long long hits = stat_of(s1, ns, "sched_cache_hits") -
+                            stat_of(s0, ns, "sched_cache_hits");
+  CHECK(ops >= 10, "coll_fastpath_ops moved (C path engaged)");
+  CHECK(hits >= 1, "sched_cache_hits moved (plans replayed)");
+  printf("rank %d: coll_fastpath_ops=%llu sched_cache_hits=%llu\n",
+         rank, ops, hits);
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (!g_fail && rank == 0) printf("CFP COMPLETE\n");
+  MPI_Finalize();
+  return g_fail;
+}

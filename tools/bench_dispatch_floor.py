@@ -1,0 +1,54 @@
+"""tpurun worker: Python-API per-op latency twin of
+``native/bench/dispatch_floor.c`` — the same collectives at the same
+small sizes on the same backend, so the joined rows isolate the C-ABI
+dispatch floor (c_us vs py_us) per operation.
+
+Prints one line ``PYDISPATCH {json}`` from proc 0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+SIZES = (8, 64, 512, 4096)  # bytes per rank
+
+world = api.init()
+iters = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+ln = world.local_size
+rows = []
+
+
+def timed(op, nbytes, fn):
+    for _ in range(iters // 10 + 5):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    rows.append({
+        "op": op, "bytes": nbytes,
+        "py_us": round((time.perf_counter() - t0) * 1e6 / iters, 3),
+    })
+
+
+for nbytes in SIZES:
+    count = nbytes // 4
+    sbuf = np.full((ln, count), float(world.proc + 1), np.float32)
+    timed("allreduce", nbytes, lambda: world.allreduce(sbuf, SUM))
+    timed("bcast", nbytes, lambda: world.bcast(sbuf, 0))
+    timed("reduce", nbytes, lambda: world.reduce(sbuf, SUM, 0))
+    timed("allgather", nbytes, lambda: world.allgather(sbuf))
+timed("barrier", 0, world.barrier)
+
+if world.proc == 0:
+    print("PYDISPATCH " + json.dumps(rows), flush=True)
+api.finalize()

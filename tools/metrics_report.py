@@ -134,6 +134,23 @@ def render_native(by_proc: dict[int, dict], out=sys.stdout) -> None:
                   f"shrinks, {int(native.get('sender_yields', 0))} "
                   f"yields, {int(native.get('enqueue_waits', 0))} "
                   f"enqueue waits", file=out)
+        # dispatch-floor leg: how many collectives the C fast path
+        # served, the compiled-schedule cache hit rate (C plan cache +
+        # the Python sched store share the two counters), and receives
+        # landed straight in posted buffers
+        hits = int(native.get("sched_cache_hits", 0))
+        miss = int(native.get("sched_cache_misses", 0))
+        fpo = int(native.get("coll_fastpath_ops", 0))
+        if fpo or hits or miss:
+            rate = (f"{hits / (hits + miss):>6.1%}" if hits + miss
+                    else "     -")
+            print(f"    coll fast path        {fpo} C-served ops, "
+                  f"schedule cache {hits}/{hits + miss} hits ({rate})",
+                  file=out)
+        if int(native.get("recv_into_placed", 0)):
+            print(f"    recv_into placement   "
+                  f"{int(native.get('recv_into_placed', 0))} receives "
+                  f"landed in posted buffers", file=out)
 
 
 def render_ops(by_proc: dict[int, dict], out=sys.stdout) -> None:

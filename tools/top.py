@@ -97,7 +97,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               file=out)
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
-          f"{'sdep':>5}{'coal':>6}"
+          f"{'sdep':>5}{'coal':>6}{'sched':>6}"
           f"{'failed':>7}  stall causes (ring/cts/other)", file=out)
     for p in sorted(procs):
         f = procs[p]
@@ -119,6 +119,11 @@ def render(state: dict, prev: dict | None = None, url: str = "",
         db = int(n.get("doorbells", 0))
         supp = int(n.get("doorbells_suppressed", 0))
         coal = f"{supp / (db + supp):>5.0%}" if (db + supp) else "    -"
+        # dispatch-floor leg: compiled-schedule cache hit rate (the C
+        # plan cache + the Python sched store share these counters)
+        sh = int(n.get("sched_cache_hits", 0))
+        sm = int(n.get("sched_cache_misses", 0))
+        sched = f"{sh / (sh + sm):>5.0%}" if (sh + sm) else "    -"
         failed = f.get("failed") or []
         print(f"{p:<5}{mbs:>8.1f}{msgs:>8.0f}"
               f"{int(n.get('delivered', 0)):>10}"
@@ -126,7 +131,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               f"{int(n.get('respawns', 0)):>7}"
               f"{int(n.get('dedup_drops', 0)):>6}"
               f"{int(n.get('deadline_expired', 0)):>6}"
-              f"{int(n.get('stream_depth', 0)):>5}{coal:>6}"
+              f"{int(n.get('stream_depth', 0)):>5}{coal:>6}{sched:>6}"
               f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
               file=out)
     strag = state.get("straggler") or {}
