@@ -245,6 +245,11 @@ enum TdcnStatIdx {
   TS_SCHED_CACHE_MISSES, // ... and compiles (misses)
   TS_RECV_INTO_PLACED,   // receives landed straight in a posted buffer
                          // (in-place eager memcpy or streamed RTS fill)
+  // -- sharded-modex tail (appended; version stays 1) -----------------
+  TS_ADDR_INSTALLS,      // peer addresses installed eagerly (bulk
+                         // tdcn_set_addresses slots + replace updates)
+  TS_ADDR_LAZY,          // peer addresses resolved lazily on first use
+                         // (the AddressTable callback / C resolver)
   TS_COUNT
 };
 
@@ -261,7 +266,7 @@ static const char *TDCN_STAT_NAMES =
     "stream_depth,stream_depth_hwm,stream_inflight,stream_inflight_hwm,"
     "chunk_shrinks,sender_yields,enqueue_waits,"
     "coll_fastpath_ops,sched_cache_hits,sched_cache_misses,"
-    "recv_into_placed";
+    "recv_into_placed,addr_installs,addr_lazy_resolved";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -846,11 +851,25 @@ struct DedupSeen {
   }
 };
 
+struct CollCtx;
+
+// lazy-modex resolver callback (tdcn_set_resolver): the Python
+// AddressTable writes proc's address into the caller-provided buffer
+// and returns its length (-1 = unresolvable).  Buffer-writing shape on
+// purpose: a callback RETURNING a char* would hand back memory whose
+// Python-side owner may be collected before the C caller reads it.
+typedef int (*tdcn_resolve_fn)(int proc, char *out, int cap);
+
 struct Engine {
   int proc = 0, nprocs = 0;
   std::string host_id;
   std::string address;
   std::vector<std::string> peer_addresses;
+  // guards peer_addresses: bulk installs, one-slot installs, lazy
+  // resolves AND the tdcn_send-path slot reads (lazy resolution means
+  // installs happen mid-job from whichever thread sends first, so
+  // readers copy the slot out under the lock — engine_resolve_addr)
+  std::mutex addr_mu;
   std::unordered_map<std::string, Peer *> peers;  // by composite address
   std::mutex peers_mu;
 
@@ -955,6 +974,18 @@ struct Engine {
   std::condition_variable rndv_cv;
   int rndv_active = 0;
   std::map<std::pair<int, int64_t>, Reassembly *> reasm;  // (from, xid)
+
+  // ---- C coll fast path registry + lazy-modex resolver --------------
+  // live CollCtx views (tdcn_coll_open/close register them): an
+  // address change (replace() installing a reborn incarnation's
+  // endpoint) invalidates their cached peers + evicts their compiled
+  // plans, and tdcn_coll_revoke_cid finds them by comm cid
+  std::mutex cctx_mu;
+  std::set<CollCtx *> cctxs;
+  // sharded native modex: consulted when a send names a proc whose
+  // address slot is still empty (one Python-side KVS get, cached by
+  // the install the wrapper performs)
+  std::atomic<tdcn_resolve_fn> resolver{nullptr};
 
   std::vector<std::thread> threads;
 };
@@ -2824,10 +2855,17 @@ static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
 // Wait for one coll-stream message (engine-internal; the C collective
 // schedules ride it).  Same slot discipline as tdcn_recv_coll: 0 =
 // delivered (payload moved into *out), 1 = timeout, -2 = watched proc
-// failed, -3 = engine closing.
+// failed, -3 = engine closing, -6 = comm revoked.  ``revoked`` /
+// ``fail_members`` are the C fast path's ULFM interrupts (the Python
+// plane's _check_revoked twin): a parked schedule receive wakes the
+// moment tdcn_coll_revoke_cid poisons its comm or tdcn_note_failed
+// marks ANY member — not just the watched src — instead of waiting
+// out the ~600 s give-up.
 static int coll_wait_msg(Engine *eng, const std::string &scid, int64_t seq,
                          int src, int fail_proc, double timeout_s,
-                         OwnedMsg *out) {
+                         OwnedMsg *out,
+                         const std::atomic<int> *revoked = nullptr,
+                         const std::vector<int> *fail_members = nullptr) {
   auto key = std::make_tuple(scid, seq, src);
   std::unique_lock<std::mutex> g(eng->mu);
   auto it = eng->coll.find(key);
@@ -2842,13 +2880,27 @@ static int coll_wait_msg(Engine *eng, const std::string &scid, int64_t seq,
     return fail_proc >= 0 && (size_t)fail_proc < eng->failed.size() &&
            eng->failed[fail_proc];
   };
+  // extra abort causes (checked under eng->mu like peer_failed): the
+  // comm's revoke flag and the comm's FULL member list against the
+  // engine failure marks — a dead third member wedges the schedule
+  // just as surely as a dead src
+  auto aborted = [&]() -> int {
+    if (revoked && revoked->load(std::memory_order_relaxed)) return -6;
+    if (fail_members) {
+      for (int fp : *fail_members)
+        if (fp >= 0 && (size_t)fp < eng->failed.size() &&
+            eng->failed[fp])
+          return -2;
+    }
+    return 0;
+  };
   slot->waiters++;
   bool ok = progress_wait(eng, g,
                           [&] {
                             return slot->ready.load() ||
                                    eng->closing.load(
                                        std::memory_order_relaxed) ||
-                                   peer_failed();
+                                   peer_failed() || aborted() != 0;
                           },
                           timeout_s);
   slot->waiters--;
@@ -2857,6 +2909,8 @@ static int coll_wait_msg(Engine *eng, const std::string &scid, int64_t seq,
     if (eng->closing.load(std::memory_order_relaxed)) rc = -3;
     else if (peer_failed())
       rc = -2;
+    else if (int ab = aborted())
+      rc = ab;
     if (slot->waiters == 0) {
       if (slot->consumed) {
         delete slot;
@@ -3067,23 +3121,45 @@ struct CollCtx {
   int64_t seq = 0;             // SPMD stream counter (same burn order
                                // on every member by MPI issue order)
   uint64_t ring_threshold = 64ull << 10;
-  std::mutex mu;  // plan cache (collective calls themselves are
-                  // serialized per comm by MPI semantics)
+  // ULFM interrupt (tdcn_coll_revoke_cid): parked schedule receives
+  // wake immediately and the schedule aborts with -6
+  std::atomic<int> revoked{0};
+  std::mutex mu;  // plan cache + the addrs/peers slots (collective
+                  // calls themselves are serialized per comm by MPI
+                  // semantics, but engine_addr_changed writes the
+                  // slots from the control plane during replace())
   // keyed (kind, op, dtype, count, root, RESOLVED algo): the algo
   // component keeps a forced/tuned/reproducible decision from being
   // shadowed by an earlier same-signature plan that resolved the
   // engine crossover differently
   std::map<std::tuple<int, int, int, int64_t, int, int>, CollPlan *>
       plans;
+  // plans EVICTED by an address-change invalidation (replace(): the
+  // schedule was compiled against the dead lineage).  They cannot be
+  // freed — a persistent request may still hold the handle, and its
+  // replay stays memory-safe because execution resolves peers through
+  // the (refreshed) cctx at start time — so they park here until
+  // tdcn_coll_close frees everything
+  std::vector<CollPlan *> retired;
 };
 
 static Peer *cctx_peer(CollCtx *c, int p) {
-  Peer *pe = c->peers[p];
-  if (!pe) {
-    pe = get_peer(c->eng, c->addrs[p]);
-    c->peers[p] = pe;
+  // slot reads under c->mu: engine_addr_changed (replace installing a
+  // reborn endpoint) rewrites addrs[p]/peers[p] from the control
+  // plane, so the execution-side resolution can no longer be
+  // lock-free.  get_peer (which dials) runs OUTSIDE the lock; a
+  // racing invalidation between the resolve and the install wins —
+  // the stale Peer* is dropped and the next send re-resolves.
+  std::string addr;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (c->peers[p]) return c->peers[p];
+    addr = c->addrs[p];
   }
-  return pe;
+  Peer *pe = get_peer(c->eng, addr);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!c->peers[p] && addr == c->addrs[p]) c->peers[p] = pe;
+  return c->peers[p] ? c->peers[p] : pe;
 }
 
 static int cctx_send(CollCtx *c, int dst, int64_t seq, const void *data,
@@ -3100,15 +3176,19 @@ static int cctx_send(CollCtx *c, int dst, int64_t seq, const void *data,
 
 // Receive one schedule message.  A C collective that already moved
 // frames cannot fall back mid-call, so timeouts retry — but not
-// forever: a watched member's death breaks out via -2 (fail_idx),
-// and a silent wedge (or an unwatched member, e.g. addresses that
-// never resolved against the root table) gives up after ~600 s with
-// -5, which the shim surfaces through the comm's errhandler — a loud
-// failure instead of an untraceable infinite hang.
+// forever: ANY member's death breaks out via -2 (the full fail_idx
+// list is watched, so a wedge behind a dead third member fails as
+// fast as a dead src), a revoked comm breaks out via -6 the moment
+// tdcn_coll_revoke_cid fires (the Python plane's _check_revoked
+// mirrored into C), and a silent wedge (or an unwatched member, e.g.
+// addresses that never resolved against the root table) gives up
+// after ~600 s with -5, which the shim surfaces through the comm's
+// errhandler — a loud failure instead of an untraceable infinite
+// hang.
 static int cctx_recv_msg(CollCtx *c, int64_t seq, int src, OwnedMsg *out) {
   for (int tries = 0; tries < 5; tries++) {
     int rc = coll_wait_msg(c->eng, c->cid, seq, src, c->fail_idx[src],
-                           120.0, out);
+                           120.0, out, &c->revoked, &c->fail_idx);
     if (rc != 1) return rc;
   }
   c->eng->stats.add(TS_DEADLINE_EXPIRED, 1);
@@ -3381,8 +3461,66 @@ const char *tdcn_address(void *h) {
   return ((Engine *)h)->address.c_str();
 }
 
+// One proc's address CHANGED (replace() installing a reborn
+// incarnation's endpoint) — the one proof its old sender lineage is
+// dead.  Prune the corpse's rx state and invalidate every registered
+// C-coll view that resolved the dead address: cached Peer pointers
+// reset (execution re-resolves at next start), compiled plans evict
+// to the retired list (a repaired comm can't replay a schedule built
+// against the dead lineage), and the view's own address slot is
+// refreshed so re-resolution dials the reborn endpoint.
+static void engine_addr_changed(Engine *eng, int p,
+                                const std::string &old_addr,
+                                const std::string &new_addr) {
+  prune_dedup(eng, p);
+  // NOTE: the corpse lineage's in-flight reassemblies are
+  // deliberately NOT reclaimed here — a consumer thread may be
+  // mid-memcpy into one with no lock held (the FRAG hot path),
+  // so freeing from this control-plane thread would race it.
+  // They are bounded garbage reclaimed at destroy; a recv that
+  // was reserved-at-RTS by the dead stream stays matched (MPI:
+  // cancel of a MATCHED receive fails, and elastic recovery
+  // resumes on the fresh `.replaced` comm, not on the corpse's
+  // half-streamed transfers — the same wedge semantics a
+  // mid-stream sender death always had on the ring path).
+  {
+    // The reborn incarnation's issue-order counter restarts at 1:
+    // drop the corpse lineage's ordered-delivery gates (any parked
+    // payloads are fully-delivered messages the gate owns — freed
+    // under eng->mu, the same lock every gate access holds)
+    std::lock_guard<std::mutex> g(eng->mu);
+    for (auto it = eng->order_gates.begin();
+         it != eng->order_gates.end();) {
+      if (it->first.first == (int32_t)p) {
+        for (auto &pm : it->second.parked)
+          if (pm.second.data) free(pm.second.data);
+        it = eng->order_gates.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  std::lock_guard<std::mutex> g(eng->cctx_mu);
+  for (CollCtx *c : eng->cctxs) {
+    std::lock_guard<std::mutex> cg(c->mu);
+    bool member = false;
+    for (int i = 0; i < c->nprocs; i++) {
+      if (c->addrs[i] == old_addr) {
+        c->addrs[i] = new_addr;
+        c->peers[i] = nullptr;
+        member = true;
+      }
+    }
+    if (member && !c->plans.empty()) {
+      for (auto &kv : c->plans) c->retired.push_back(kv.second);
+      c->plans.clear();
+    }
+  }
+}
+
 int tdcn_set_addresses(void *h, const char *joined) {
   Engine *eng = (Engine *)h;
+  std::lock_guard<std::mutex> ag(eng->addr_mu);
   std::vector<std::string> old;
   old.swap(eng->peer_addresses);
   std::string s(joined ? joined : "");
@@ -3396,43 +3534,85 @@ int tdcn_set_addresses(void *h, const char *joined) {
     eng->peer_addresses.push_back(s.substr(start, nl - start));
     start = nl + 1;
   }
+  // eager-install accounting (the sharded-modex boot signature): only
+  // slots going empty→set or changing count, so re-pushing the same
+  // table is free — TS_ADDR_INSTALLS at np=16 reads <= group size on
+  // the sharded boot vs P−1 on the eager one
+  for (size_t p = 0; p < eng->peer_addresses.size(); p++) {
+    if ((int)p == eng->proc || eng->peer_addresses[p].empty()) continue;
+    if (p >= old.size() || old[p] != eng->peer_addresses[p])
+      eng->stats.add(TS_ADDR_INSTALLS, 1);
+  }
   // an address CHANGE is the one proof a proc's old sender lineage is
   // dead (replace() installing a reborn incarnation's endpoint) — the
   // moment its stale dedup watermarks become garbage and can be
   // pruned without ever regressing a live lineage's watermark
   for (size_t p = 0; p < old.size() && p < eng->peer_addresses.size();
        p++) {
-    if (!old[p].empty() && old[p] != eng->peer_addresses[p]) {
-      prune_dedup(eng, (int)p);
-      // NOTE: the corpse lineage's in-flight reassemblies are
-      // deliberately NOT reclaimed here — a consumer thread may be
-      // mid-memcpy into one with no lock held (the FRAG hot path),
-      // so freeing from this control-plane thread would race it.
-      // They are bounded garbage reclaimed at destroy; a recv that
-      // was reserved-at-RTS by the dead stream stays matched (MPI:
-      // cancel of a MATCHED receive fails, and elastic recovery
-      // resumes on the fresh `.replaced` comm, not on the corpse's
-      // half-streamed transfers — the same wedge semantics a
-      // mid-stream sender death always had on the ring path).
-      //
-      // The reborn incarnation's issue-order counter restarts at 1:
-      // drop the corpse lineage's ordered-delivery gates (any parked
-      // payloads are fully-delivered messages the gate owns — freed
-      // under eng->mu, the same lock every gate access holds)
-      std::lock_guard<std::mutex> g(eng->mu);
-      for (auto it = eng->order_gates.begin();
-           it != eng->order_gates.end();) {
-        if (it->first.first == (int32_t)p) {
-          for (auto &pm : it->second.parked)
-            if (pm.second.data) free(pm.second.data);
-          it = eng->order_gates.erase(it);
-        } else {
-          ++it;
-        }
-      }
-    }
+    if (!old[p].empty() && old[p] != eng->peer_addresses[p])
+      engine_addr_changed(eng, (int)p, old[p], eng->peer_addresses[p]);
   }
   return 0;
+}
+
+// Install ONE peer's address (incremental modex: the lazy
+// AddressTable resolving a cross-group peer on first send, and
+// replace() refreshing a reborn incarnation's endpoint) — the full-
+// table re-push is unnecessary and would collapse a sharded table's
+// unresolved holes.  ``lazy`` only picks the counter: 1 = resolved on
+// demand (TS_ADDR_LAZY), 0 = eager/replace install (TS_ADDR_INSTALLS).
+int tdcn_set_address_one(void *h, int proc, const char *address,
+                         int lazy) {
+  Engine *eng = (Engine *)h;
+  if (!eng || proc < 0 || proc >= eng->nprocs || !address) return -2;
+  std::lock_guard<std::mutex> ag(eng->addr_mu);
+  if ((size_t)proc >= eng->peer_addresses.size())
+    eng->peer_addresses.resize(eng->nprocs);
+  std::string old = eng->peer_addresses[proc];
+  std::string neu(address);
+  if (old == neu) return 0;
+  eng->peer_addresses[proc] = neu;
+  if (!neu.empty() && proc != eng->proc)
+    eng->stats.add(lazy ? TS_ADDR_LAZY : TS_ADDR_INSTALLS, 1);
+  if (!old.empty()) engine_addr_changed(eng, proc, old, neu);
+  return 0;
+}
+
+// Arm the lazy-modex resolver (sharded native boot): a send naming a
+// proc whose address slot is still empty consults the Python
+// AddressTable through this callback instead of failing.  NULL
+// disarms.
+void tdcn_set_resolver(void *h, tdcn_resolve_fn fn) {
+  Engine *eng = (Engine *)h;
+  if (eng) eng->resolver.store(fn, std::memory_order_relaxed);
+}
+
+// Resolve-or-fail for an address slot (tdcn_send's lazy leg).  By
+// VALUE, with the slot read under addr_mu: lazy resolution means
+// installs now happen mid-job from whichever thread sends first, so
+// another sender's lock-free slot read would race the writer's
+// std::string assignment (torn read), and a returned pointer could
+// dangle across a concurrent bulk re-push's swap.  Returns an empty
+// string when unresolvable (the caller's send then fails like an
+// empty address always did).
+static std::string engine_resolve_addr(Engine *eng, int proc) {
+  if (proc < 0 || proc >= eng->nprocs) return std::string();
+  {
+    std::lock_guard<std::mutex> g(eng->addr_mu);
+    if ((size_t)proc < eng->peer_addresses.size() &&
+        !eng->peer_addresses[proc].empty())
+      return eng->peer_addresses[proc];
+  }
+  tdcn_resolve_fn fn = eng->resolver.load(std::memory_order_relaxed);
+  if (!fn) return std::string();
+  char buf[512];
+  int n = fn(proc, buf, (int)sizeof(buf));
+  if (n <= 0 || n >= (int)sizeof(buf)) return std::string();
+  tdcn_set_address_one(eng, proc, buf, 1);
+  std::lock_guard<std::mutex> g(eng->addr_mu);
+  return (size_t)proc < eng->peer_addresses.size()
+             ? eng->peer_addresses[proc]
+             : std::string();
 }
 
 int tdcn_send_addr(void *h, const char *address, int kind, const char *cid,
@@ -3461,9 +3641,13 @@ int tdcn_send(void *h, int dst_proc, int kind, const char *cid, int64_t seq,
               const int64_t *shape, const void *meta, int meta_len,
               const void *data, uint64_t nbytes) {
   Engine *eng = (Engine *)h;
-  if (dst_proc < 0 || (size_t)dst_proc >= eng->peer_addresses.size())
-    return -2;
-  return tdcn_send_addr(h, eng->peer_addresses[dst_proc].c_str(), kind, cid,
+  if (dst_proc < 0 || dst_proc >= eng->nprocs) return -2;
+  // sharded native modex: an empty slot resolves through the armed
+  // Python AddressTable callback on first send (one KVS get, cached
+  // by the install) instead of failing; the slot is copied out under
+  // addr_mu (installs race concurrent senders now)
+  std::string addr = engine_resolve_addr(eng, dst_proc);
+  return tdcn_send_addr(h, addr.c_str(), kind, cid,
                         seq, src, dst, tag, dtype, ndim, shape, meta,
                         meta_len, data, nbytes);
 }
@@ -3553,24 +3737,66 @@ uint64_t tdcn_coll_open(void *h, const char *cid, int me, int nprocs,
   c->addrs.resize(nprocs);
   c->peers.assign(nprocs, nullptr);
   c->fail_idx.assign(nprocs, -1);
-  for (int p = 0; p < nprocs; p++) {
-    c->addrs[p] = addrs && addrs[p] ? addrs[p] : "";
-    for (size_t q = 0; q < eng->peer_addresses.size(); q++) {
-      if (!c->addrs[p].empty() && eng->peer_addresses[q] == c->addrs[p]) {
-        c->fail_idx[p] = (int)q;
-        break;
+  {
+    // fail-index mapping under addr_mu: lazy-modex installs mutate
+    // peer_addresses from whichever thread sends first, so the slot
+    // comparisons can no longer be lock-free
+    std::lock_guard<std::mutex> ag(eng->addr_mu);
+    for (int p = 0; p < nprocs; p++) {
+      c->addrs[p] = addrs && addrs[p] ? addrs[p] : "";
+      for (size_t q = 0; q < eng->peer_addresses.size(); q++) {
+        if (!c->addrs[p].empty() &&
+            eng->peer_addresses[q] == c->addrs[p]) {
+          c->fail_idx[p] = (int)q;
+          break;
+        }
       }
     }
+  }
+  {
+    // registry: address-change invalidation and revoke-by-cid find
+    // live views here
+    std::lock_guard<std::mutex> g(eng->cctx_mu);
+    eng->cctxs.insert(c);
   }
   return (uint64_t)(uintptr_t)c;
 }
 
 void tdcn_coll_close(void *h, uint64_t cctx) {
-  (void)h;
+  Engine *eng = (Engine *)h;
   CollCtx *c = (CollCtx *)(uintptr_t)cctx;
   if (!c) return;
+  if (eng) {
+    std::lock_guard<std::mutex> g(eng->cctx_mu);
+    eng->cctxs.erase(c);
+  }
   for (auto &kv : c->plans) delete kv.second;
+  for (CollPlan *pl : c->retired) delete pl;
   delete c;
+}
+
+// Poison one comm's C fast path (ULFM revoke, the Python plane's rvk
+// fan-out crossing into C): every registered CollCtx whose private
+// stream belongs to ``cid`` wakes its parked schedule receives (-6)
+// and refuses new schedules until closed.
+void tdcn_coll_revoke_cid(void *h, const char *cid) {
+  Engine *eng = (Engine *)h;
+  if (!eng || !cid) return;
+  std::string scid = std::string(cid) + "#cfp";
+  bool hit = false;
+  {
+    std::lock_guard<std::mutex> g(eng->cctx_mu);
+    for (CollCtx *c : eng->cctxs) {
+      if (c->cid == scid) {
+        c->revoked.store(1, std::memory_order_relaxed);
+        hit = true;
+      }
+    }
+  }
+  if (!hit) return;
+  std::lock_guard<std::mutex> g(eng->mu);
+  for (auto &kv : eng->coll) kv.second->cv.notify_all();
+  wake_waiters(eng);
 }
 
 // Compile-or-fetch a schedule for one call signature.  `algo` -1 lets
@@ -3659,6 +3885,8 @@ int tdcn_coll_start(void *h, uint64_t plan, const void *sendbuf,
   (void)h;
   CollPlan *pl = (CollPlan *)(uintptr_t)plan;
   if (!pl || !pl->ctx) return -4;
+  if (pl->ctx->revoked.load(std::memory_order_relaxed))
+    return -6;  // revoked comm: refuse before any frame moves
   return plan_exec(pl->ctx, pl, sendbuf, recvbuf);
 }
 

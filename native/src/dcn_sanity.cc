@@ -77,6 +77,10 @@ extern void tdcn_coll_close(void *, uint64_t);
 extern uint64_t tdcn_coll_plan(void *, uint64_t, int, int, int, int64_t,
                                int, int);
 extern int tdcn_coll_start(void *, uint64_t, const void *, void *);
+extern void tdcn_coll_revoke_cid(void *, const char *);
+extern int tdcn_set_address_one(void *, int, const char *, int);
+typedef int (*tdcn_resolve_fn)(int, char *, int);
+extern void tdcn_set_resolver(void *, tdcn_resolve_fn);
 }
 
 enum { FK_COLL = 0, FK_P2P = 1 };
@@ -424,6 +428,68 @@ static void coll_side(void *eng, uint64_t cx, int me, const char *label) {
         "%s bool must not plan", label);
 }
 
+// ULFM revoke wake + replace invalidation on the C coll path, under
+// the sanitizers: a schedule receive parked on a peer that never
+// answers must wake promptly when the comm is revoked (-6, not the
+// ~600 s give-up), a revoked view refuses new starts, and an address
+// change (a reborn incarnation's endpoint) evicts the view's
+// compiled plans so the repaired comm re-plans.
+static void exercise_coll_revoke(void *a, void *b, const char *label) {
+  std::string aa = tdcn_address(a), bb = tdcn_address(b);
+  const char *addrs[2] = {aa.c_str(), bb.c_str()};
+  uint64_t ca = tdcn_coll_open(a, "crev", 0, 2, addrs, 32 * 1024);
+  CHECK(ca != 0, "%s revoke coll_open", label);
+  if (!ca) return;
+  uint64_t pl = tdcn_coll_plan(a, ca, 0, 0, 7, 0, 0, -1);  // barrier
+  CHECK(pl != 0, "%s revoke barrier plan", label);
+  int rc = -100;
+  std::thread park([&] { rc = tdcn_coll_start(a, pl, nullptr, nullptr); });
+  struct timespec ts = {0, 300 * 1000000};
+  nanosleep(&ts, nullptr);  // let it park (rank 1 never calls)
+  tdcn_coll_revoke_cid(a, "crev");
+  park.join();
+  CHECK(rc == -6, "%s revoke wake rc=%d", label, rc);
+  CHECK(tdcn_coll_start(a, pl, nullptr, nullptr) == -6,
+        "%s revoked view refuses new starts", label);
+  tdcn_coll_close(a, ca);
+
+  // invalidation: an address change for a member evicts cached plans
+  uint64_t ci = tdcn_coll_open(a, "cinv", 0, 2, addrs, 32 * 1024);
+  CHECK(ci != 0, "%s invalidate coll_open", label);
+  uint64_t p1 = tdcn_coll_plan(a, ci, 3, 1, 13, 16, 0, -1);
+  CHECK(p1 != 0 && tdcn_coll_plan(a, ci, 3, 1, 13, 16, 0, -1) == p1,
+        "%s invalidate warm plan", label);
+  std::string reborn = bb + "#reborn";
+  CHECK(tdcn_set_address_one(a, 1, reborn.c_str(), 0) == 0,
+        "%s set_address_one", label);
+  uint64_t p2 = tdcn_coll_plan(a, ci, 3, 1, 13, 16, 0, -1);
+  CHECK(p2 != 0 && p2 != p1, "%s plan evicted on address change",
+        label);
+  // restore the real address so later sections keep talking
+  CHECK(tdcn_set_address_one(a, 1, bb.c_str(), 0) == 0,
+        "%s address restore", label);
+  tdcn_coll_close(a, ci);
+
+  // lazy resolver: an empty slot resolves through the callback on
+  // first send (the sharded native modex's C half)
+  static std::string g_resolved;
+  g_resolved = bb;
+  tdcn_set_addresses(a, (aa + "\n").c_str());  // hole for proc 1
+  tdcn_set_resolver(a, [](int proc, char *out, int cap) -> int {
+    if (proc != 1 || (int)g_resolved.size() + 1 > cap) return -1;
+    memcpy(out, g_resolved.c_str(), g_resolved.size() + 1);
+    return (int)g_resolved.size();
+  });
+  int32_t payload[4] = {1, 2, 3, 4};
+  int64_t shape[1] = {4};
+  CHECK(tdcn_send(a, 1, FK_P2P, "9", 0, 0, 1, 5, "<i4", 1, shape,
+                  nullptr, 0, payload, sizeof(payload)) == 0,
+        "%s lazy-resolved send", label);
+  tdcn_set_resolver(a, nullptr);
+  // restore the full table for any later section
+  tdcn_set_addresses(a, (aa + "\n" + bb).c_str());
+}
+
 static void exercise_coll(void *a, void *b, const char *label) {
   std::string aa = tdcn_address(a), bb = tdcn_address(b);
   const char *addrs[2] = {aa.c_str(), bb.c_str()};
@@ -454,6 +520,7 @@ int main() {
   exercise_pair(a, b, "shm");
   exercise_stream(a, b);
   exercise_coll(a, b, "shm");
+  exercise_coll_revoke(a, b, "shm");
   // full teardown (close + reader drain + free) so the ASan leg's
   // leak check sees only REAL lost allocations, not the documented
   // intentional close()-time engine leak
@@ -472,6 +539,7 @@ int main() {
   }
   exercise_pair(c, d, "tcp");
   exercise_coll(c, d, "tcp");
+  exercise_coll_revoke(c, d, "tcp");
   tdcn_destroy(c);
   tdcn_destroy(d);
 

@@ -673,6 +673,10 @@ class MultiProcComm(PersistentP2PMixin):
         from ompi_tpu.ft import ulfm
 
         ulfm.state(self).revoked = True
+        # local C fast-path wake first: a schedule this process parked
+        # on the comm's #cfp stream must abort promptly, not wait out
+        # the C give-up deadline
+        self.dcn._root_engine().coll_revoke(self.cid)
         for p in range(self.nprocs):
             if p != self.proc and not self.dcn.proc_failed(p):
                 try:
@@ -854,10 +858,15 @@ class MultiProcComm(PersistentP2PMixin):
         incarnation stays failed — correct until a repair of their
         own).
 
-        Scope (recorded in ROADMAP): comms split directly from the
-        world (a nested split's group ranks are parent-relative, not
-        world-relative), one pending partial repair per reborn
-        incarnation (the beacon key is (proc, incarnation)-scoped)."""
+        Any derived comm repairs here — nested splits included: the
+        recipe carries **comm-relative (proc, local-index) coordinate
+        pairs** rather than group ranks (a split-of-a-split's group
+        ranks are PARENT-relative and would rebuild the wrong members
+        from the reborn's world), and the beacon key is scoped
+        (proc, incarnation, cid), so several sub-comms' repairs queue
+        side by side and a reborn rank heals every one of them from a
+        single death (:meth:`replace_partial` consumes them in
+        ascending-cid order)."""
         ctx = self.procctx
         if not ctx.rejoined:
             raise MPICommError(
@@ -901,17 +910,36 @@ class MultiProcComm(PersistentP2PMixin):
         return sub
 
     def _partial_recipe(self, name: str = "") -> dict:
-        """The repaired communicator's structure in WORLD coordinates —
-        everything a reborn proc (holding only its fresh world) needs
-        to build the identical comm: member ranks, owning procs (root
-        ids, comm order), the comm-scoped stream prefix, the name."""
+        """The repaired communicator's structure in COMM-RELATIVE
+        coordinates — everything a reborn proc (holding only its fresh
+        world) needs to build the identical comm: one (root proc,
+        local index) pair per member rank in comm order, the owning
+        procs (root ids, comm order), the old comm's cid (the queued-
+        beacon discriminator), the comm-scoped stream prefix, and the
+        name.  Coordinate pairs on purpose: ``group.ranks`` are
+        PARENT-relative, so a split-of-a-split's ranks are meaningless
+        against the reborn's fresh world — (proc, local-index) is the
+        one addressing every nesting level and the world agree on."""
         return {
-            "members": [int(r) for r in self.group.ranks],
+            "coords": [[int(a), int(b)] for a, b in
+                       (self._coord_of(r) for r in range(self.size))],
             "procs": [int(self.dcn.root_proc_of(p))
                       for p in range(self.nprocs)],
+            "cid": int(self.cid),
             "skey": f"replace.c{int(self.cid)}",
             "name": name or f"{self.name}.replaced",
         }
+
+    def _coord_of(self, r: int) -> tuple[int, int]:
+        """Member rank ``r`` as a (root proc, proc-local index) pair —
+        the nesting-independent address ``_make_sub`` threads down the
+        split chain (``_world_coords``); computed directly on the
+        world, where comm-local IS world-local."""
+        wc = getattr(self, "_world_coords", None)
+        if wc is not None:
+            return wc[r]
+        p, li = self.locate(r)
+        return (int(self.dcn.root_proc_of(p)), int(li))
 
     def _partial_rounds(self, members: list[int], dead: list[int],
                         timeout: float, recipe: dict) -> list[int]:
@@ -938,8 +966,13 @@ class MultiProcComm(PersistentP2PMixin):
             members = sorted(members + [r])
             stream = f"{recipe['skey']}.{r}.i{inc}"
             if root.proc == min(m for m in members if m != r):
+                # beacon keyed (proc, incarnation, CID): each sub-comm
+                # the dead proc belonged to queues its OWN recipe, so
+                # one death can heal several sub-comms — the reborn
+                # consumes the queue in ascending-cid order
                 ctx.kvs.put(
-                    f"{ctx.ns}replace.sub.{r}.i{inc}",
+                    f"{ctx.ns}replace.sub.{r}.i{inc}"
+                    f".c{int(recipe['cid'])}",
                     dict(recipe, stream=stream, round=members,
                          dead=list(dead),
                          incs={str(k): v
@@ -949,18 +982,26 @@ class MultiProcComm(PersistentP2PMixin):
                              int(_peek_cid()), stream)]
         return proposals
 
-    def replace_partial(self, name: str = "") -> "MultiProcComm":
+    def replace_partial(self, name: str = "",
+                        cid: int | None = None) -> "MultiProcComm":
         """The reborn-incarnation half of a PARTIAL replace: called on
         the fresh world right after ``init()`` (``world.respawned`` is
         the SPMD cue) when the communicator being repaired did not
         span the job — the survivors called ``replace()`` on the
-        sub-comm, so there is no world round to rejoin.  Reads the
-        comm-scoped beacon addressed to this incarnation, joins its
-        CID round (helping restore any procs still dead after it),
-        rebuilds the member communicator from the world-coordinate
-        recipe, and retires non-member procs from the failure detector
-        — this process has no live relationship with them, so their
-        (correct) heartbeat silence toward it must not read as death.
+        sub-comm, so there is no world round to rejoin.  Scans the
+        (proc, incarnation, cid)-keyed beacon QUEUE addressed to this
+        incarnation — one entry per sub-comm the death poisoned —
+        consumes the lowest-cid pending recipe (or exactly ``cid``
+        when given), joins its CID round (helping restore any procs
+        still dead after it), rebuilds the member communicator from
+        the comm-relative (proc, local-index) coordinate recipe (the
+        addressing that survives nested splits — parent-relative group
+        ranks do not), and retires non-member procs from the failure
+        detector — this process has no live relationship with them,
+        so their (correct) heartbeat silence toward it must not read
+        as death.  Call it once per poisoned sub-comm, in the same
+        ascending-cid order the survivors repair them, to heal several
+        sub-comms from one death.
 
         Callable whether or not the world-level rejoin already ran:
         a reborn proc that healed the WORLD first (survivors'
@@ -976,29 +1017,32 @@ class MultiProcComm(PersistentP2PMixin):
                 "repair a partial communicator with replace() on it)")
         timeout = self._respawn_timeout()
         inc = ctx.incarnation
-        info = ctx.kvs.get(f"{ctx.ns}replace.sub.{self.proc}.i{inc}",
-                           timeout=timeout)
-        for k, v in (info.get("incs") or {}).items():
-            ctx.incarnations[int(k)] = max(
-                int(v), ctx.incarnations.get(int(k), 0))
+        info, beacon_key = self._next_partial_recipe(cid, timeout)
+        ctx.adopt_incarnation_floors(info.get("incs"))
         ctx.incarnations[self.proc] = inc
         members_round = sorted(int(m) for m in info["round"])
         proposals = [int(c) for c in
                      self.dcn.sub(members_round).allgather_obj_hub(
                          int(_peek_cid()), str(info["stream"]))]
-        recipe = {k: info[k] for k in ("members", "procs", "skey",
-                                       "name")}
+        recipe = {k: info[k] for k in ("coords", "procs", "skey",
+                                       "name", "cid")}
         dead = [int(d) for d in info.get("dead", ())]
         if dead:
             proposals = self._partial_rounds(members_round, dead,
                                              timeout, recipe)
-        cid = _reserve_cid_block(max(int(c) for c in proposals), 1)
-        members = [int(r) for r in recipe["members"]]
+        new_cid = _reserve_cid_block(max(int(c) for c in proposals), 1)
+        members = [self.proc_range(int(rp))[0] + int(li)
+                   for rp, li in recipe["coords"]]
         member_procs = [int(p) for p in recipe["procs"]]
         owners = [self.locate(r)[0] for r in members]
-        sub = self._make_sub("replaced", cid, members, owners,
+        sub = self._make_sub("replaced", new_cid, members, owners,
                              member_procs)
         sub.name = str(recipe["name"])
+        # consume the beacon only now: a heal that failed mid-round
+        # (second death, transient KVS loss) must leave the recipe
+        # discoverable for a retry, not poll the timeout out against
+        # an "empty" queue
+        ctx.healed_partials.add(beacon_key)
         first_rejoin = not ctx.rejoined
         ctx.rejoined = True
         det = ctx.detector
@@ -1011,9 +1055,48 @@ class MultiProcComm(PersistentP2PMixin):
                     det.retire_peer(p)
         from ompi_tpu.metrics import flight as _flight
 
-        _flight.record("replace", comm=sub.name, cid=int(cid),
+        _flight.record("replace", comm=sub.name, cid=int(new_cid),
                        partial=True, incarnation=int(inc))
         return sub
+
+    def _next_partial_recipe(self, cid: int | None,
+                             timeout: float) -> tuple[dict, str]:
+        """Poll the reborn's (proc, incarnation)-scoped beacon queue
+        for the next UNCONSUMED repair recipe: lowest cid first (the
+        order survivors — running their program-order repairs — queue
+        them in), or exactly ``cid`` when the caller targets one comm.
+        Returns (recipe, beacon key); the CALLER marks the key
+        consumed (``ctx.healed_partials``) once the heal succeeds, so
+        a failed attempt leaves the recipe retryable."""
+        import time as _time
+
+        ctx = self.procctx
+        prefix = (f"{ctx.ns}replace.sub.{self.proc}"
+                  f".i{ctx.incarnation}.c")
+        seen = ctx.healed_partials
+        deadline = _time.monotonic() + float(timeout)
+        while True:
+            try:
+                scan = ctx.kvs.get_prefix(prefix)
+            except (ConnectionError, OSError):
+                scan = {}
+            pending = sorted(
+                (int(k[len(prefix):]), k) for k in scan
+                if k not in seen and k[len(prefix):].isdigit())
+            if cid is not None:
+                pending = [(c, k) for c, k in pending if c == int(cid)]
+            if pending:
+                _c, key = pending[0]
+                return scan[key], key
+            if _time.monotonic() > deadline:
+                from ompi_tpu.core.errors import MPIProcFailedError
+
+                raise MPIProcFailedError(
+                    f"replace_partial: no pending repair recipe for "
+                    f"proc {self.proc} incarnation {ctx.incarnation}"
+                    + (f" cid {cid}" if cid is not None else "")
+                    + f" within {timeout}s")
+            _time.sleep(0.05)
 
     def _replace_recover(self, members: list[int], dead: list[int],
                          timeout: float) -> list[int]:
@@ -1099,10 +1182,10 @@ class MultiProcComm(PersistentP2PMixin):
         members = [int(m) for m in info["members"]]
         dead = [int(d) for d in info["dead"]]
         # adopt the survivors' incarnation floors (see the beacon
-        # publisher) before helping restore any remaining dead procs
-        for k, v in (info.get("incs") or {}).items():
-            ctx.incarnations[int(k)] = max(
-                int(v), ctx.incarnations.get(int(k), 0))
+        # publisher) before helping restore any remaining dead procs —
+        # detector floors included, so a FELLOW reborn peer's
+        # heartbeats are liveness, not a rebirth detection
+        ctx.adopt_incarnation_floors(info.get("incs"))
         ctx.incarnations[self.proc] = inc
         proposals = self._replace_round(members, self.proc, inc)
         if dead:
@@ -1306,6 +1389,11 @@ class MultiProcComm(PersistentP2PMixin):
         c.local_offset = c.offsets[c.proc]
         c.size = len(members)
         c.group = Group(list(members))  # parent-global ranks, sub order
+        #: members as (root proc, proc-local index) pairs — the
+        #: nesting-independent addressing a partial-replace recipe
+        #: publishes (a nested split's group.ranks are only PARENT-
+        #: relative; these chain through every level to the world)
+        c._world_coords = [self._coord_of(m) for m in members]
         my_local = [
             self.locate(r)[1] for r, p in zip(members, owners) if p == self.proc
         ]

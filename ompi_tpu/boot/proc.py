@@ -75,6 +75,9 @@ class ProcContext:
         self.incarnation = int(os.environ.get(ENV_INCARNATION, "0"))
         self.incarnations: dict[int, int] = {}
         self.rejoined = self.incarnation == 0
+        #: partial-replace beacon keys this reborn incarnation already
+        #: consumed — replace_partial walks the (proc, inc, cid) queue
+        self.healed_partials: set[str] = set()
         self.kvs = KVSClient(os.environ[ENV_KVS])
         # modex: publish DCN endpoint, fence, gather peers. Transport
         # tunables come from the btl/tcp component's MCA vars (so
@@ -136,10 +139,18 @@ class ProcContext:
         self.groups = compute_groups(self.nprocs, gsz, self.hosts)
         self.group = next(g for g in self.groups if self.proc in g)
         self._mine_native = addr.startswith("ntv:")
-        if (self._mine_native or self.nprocs == 1 or self.incarnation
-                or local_size is None):
+        if (self.nprocs == 1 or self.incarnation or local_size is None):
+            # reborn incarnations keep the eager gather (a boot-time
+            # bundle may be stale for previously-reborn peers); direct
+            # construction without a local size has no wsize beacons
             self._modex_eager()
         else:
+            # BOTH planes ride the sharded lazy modex now: the native
+            # engine accepts an AddressTable too (primed slots install
+            # eagerly via tdcn_set_addresses — <= group size of them —
+            # and cross-group peers resolve through the table's one
+            # KVS get on first send, mirrored into the C table by
+            # tdcn_set_address_one / the tdcn_set_resolver callback)
             self._modex_sharded(local_size)
         # failure detector (tpurun --ft / --mca ft_detector_enable 1):
         # hierarchical heartbeats + versioned gossip; detections fan
@@ -191,10 +202,11 @@ class ProcContext:
 
     def _modex_eager(self) -> None:
         """The pre-hierarchical gather: P−1 gets per rank.  Kept for
-        the native C plane (tdcn_set_addresses needs the full table),
         single-proc jobs, reborn incarnations (a boot-time bundle may
         be stale for previously-reborn peers), and direct ProcContext
-        construction without a local size."""
+        construction without a local size.  (The native C plane rides
+        the sharded leg since the incremental-install surface —
+        tdcn_set_address_one + the lazy-resolver callback — landed.)"""
         addresses = [self.kvs.get(f"{self.ns}dcn.{p}")
                      for p in range(self.nprocs)]
         self._check_plane(enumerate(addresses))
@@ -218,6 +230,12 @@ class ProcContext:
         gi = self.groups.index(self.group)
         key = f"{self.ns}modex.g{gi}"
         primed: dict[int, str] = {}
+        #: native leader only: cross-group addresses from the scan,
+        #: cached into the table AFTER the engine install so the C
+        #: plane's eager-install count stays <= group size without
+        #: re-paying a KVS get per cross-group peer (the C-side lazy
+        #: resolver reads the cached slot instead)
+        cache_after: dict[int, str] = {}
         if self.proc == self.group[0]:
             scan = self.kvs.get_prefix(f"{self.ns}dcn.")
             base = len(f"{self.ns}dcn.")
@@ -233,7 +251,19 @@ class ProcContext:
                           if p in allmap},
                 "wsizes": {str(p): wsizes[p] for p in sorted(wsizes)},
             })
-            primed = allmap  # the leader paid for the full scan: keep it
+            if self._mine_native:
+                # native plane: install only the group slice eagerly,
+                # so the C engine's addr_installs counter reads
+                # <= group size on EVERY rank; the scan's cross-group
+                # addresses are NOT discarded — they cache into the
+                # table after the install, where the C lazy resolver
+                # finds them without re-paying a KVS get
+                primed = {p: allmap[p] for p in self.group
+                          if p in allmap}
+                cache_after = {p: a for p, a in allmap.items()
+                               if p not in primed}
+            else:
+                primed = allmap  # the leader paid for the scan: keep it
             self.wsizes = ([wsizes[p] for p in range(self.nprocs)]
                            if len(wsizes) == self.nprocs else None)
         else:
@@ -251,8 +281,13 @@ class ProcContext:
                 self._modex_eager()
                 return
         primed[self.proc] = self.engine.transport.address
-        self.engine.set_addresses(
-            AddressTable(self.nprocs, self._resolve_addr, primed))
+        table = AddressTable(self.nprocs, self._resolve_addr, primed)
+        self.engine.set_addresses(table)
+        for p, a in cache_after.items():
+            # cached slots read like primed ones (no resolver call,
+            # no KVS get) but were never eagerly installed in C — the
+            # engine's lazy-resolver callback pulls them on demand
+            list.__setitem__(table, p, a)
 
     def _make_engine(self, params: dict):
         """Engine selection: the native C++ data plane when the btl
@@ -308,6 +343,45 @@ class ProcContext:
         if self.detector is not None:
             for p in self.detector.failed():
                 comm._on_proc_failed(p)
+
+    def adopt_incarnation_floors(self, incs) -> None:
+        """Fold a recovery beacon's incarnation floors in: the
+        ``incarnations`` map (await_respawn polls past them) AND the
+        detector's rebirth floor — a reborn process boots with both
+        empty, and without the detector half a fellow reborn peer's
+        current-incarnation heartbeats would read as a rebirth
+        detection and falsely re-mark it (the multi-victim case a
+        whole-host kill produces).  A proc the beacon names restored
+        that THIS process currently marks failed was marked against
+        the corpse (the reborn fellows boot in parallel, and an early
+        send can hit a corpse address and strike before the floors
+        arrive) — clear the mark everywhere, or it replays into every
+        comm registered afterwards (a plain member receives no
+        heartbeats from the proc, so the live-heartbeat self-heal
+        never fires for it)."""
+        for k, v in (incs or {}).items():
+            k, v = int(k), int(v)
+            self.incarnations[k] = max(v, self.incarnations.get(k, 0))
+            if k == self.proc:
+                continue
+            if v > 0:
+                # the boot's eager gather raced the fellow reborn's
+                # re-publish: refresh from the incarnation-suffixed
+                # key (authoritative for the reborn lineage) so sends
+                # stop dialing the corpse endpoint
+                try:
+                    addr = self.kvs.get(f"{self.ns}dcn.{k}.i{v}",
+                                        wait=False)
+                    if addr:
+                        self.engine.update_address(k, addr)
+                except (KeyError, ConnectionError, OSError):
+                    pass
+            if self.detector is None:
+                continue
+            if k in self.detector.failed() or self.engine.proc_failed(k):
+                self.engine.note_proc_recovered(k, incarnation=v)
+            else:
+                self.detector.note_incarnation(k, v)
 
     def await_respawn(self, root_proc: int, timeout: float) -> tuple[int, str]:
         """Block until a NEW incarnation of ``root_proc`` (> the last

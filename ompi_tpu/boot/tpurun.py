@@ -444,18 +444,38 @@ def main(argv: list[str] | None = None) -> int:
         if ns.script is not None:
             parser.error("--daemon takes no script (submit jobs via "
                          "tools/tpud_ctl.py)")
-        # flags the daemon path does not (yet) honor must fail loudly,
-        # not come up silently single-host/non-ft (--ft is implied:
-        # the daemon always runs the detector + respawn plane)
-        for flag, val in (("--hostfile", ns.hostfile), ("--host", ns.host),
-                          ("--kvs-host", ns.kvs_host),
-                          ("--ft", ns.ft), ("--respawn", ns.respawn)):
+        # flags the daemon path does not honor must fail loudly, not
+        # come up silently non-ft (--ft is implied: the daemon always
+        # runs the detector + respawn plane).  A host map IS honored:
+        # the daemon becomes a DVM — one launch agent per remote host
+        # over the rsh leg owns that host's worker spawn/respawn/
+        # pid-liveness (serve/agent.py)
+        for flag, val in (("--ft", ns.ft), ("--respawn", ns.respawn)):
             if val:
                 parser.error(f"{flag} is not supported with --daemon "
-                             "(single-host daemon; ft/respawn are "
-                             "built in)")
+                             "(ft/respawn are built in)")
+        hosts = None
+        if ns.hostfile:
+            from .rmaps import parse_hostfile
+
+            with open(ns.hostfile) as f:
+                hosts = parse_hostfile(f.read())
+        elif ns.host:
+            from .rmaps import parse_host_list
+
+            hosts = parse_host_list(ns.host)
+        if hosts and ns.kvs_host is None and any(
+                not _is_local_host(h) for h, _slots in hosts):
+            parser.error(
+                "--daemon with remote hosts needs --kvs-host <routable "
+                "address> (the control plane binds it; 127.0.0.1 is "
+                "unreachable from the remote side)")
         return run_daemon(ns.np, mca=mca, cpu_devices=ns.cpu_devices,
-                          max_respawns=ns.max_respawns)
+                          max_respawns=ns.max_respawns, hosts=hosts,
+                          map_by=ns.map_by,
+                          launch_agent=ns.launch_agent,
+                          kvs_host=ns.kvs_host,
+                          oversubscribe=ns.oversubscribe)
     if ns.script is None:
         parser.error("the following arguments are required: script")
     hosts = None

@@ -355,7 +355,10 @@ def native_fastpath_info(h: int):
             str(int(getattr(c, "local_offset", 0))),
             str(int(c.size)),
             ",".join(str(int(o)) for o in c.offsets),
-            "\x1e".join(c.dcn.addresses),
+            # indexed access on purpose: a sharded-modex AddressTable
+            # resolves its holes here — the C-ABI fast path's cctx is
+            # eager by design (fail_idx mapping needs every address)
+            "\x1e".join(_fp_addrs(c.dcn)),
             # trailing field (appended — older parsers stop early): the
             # DCN ring-allreduce crossover, so the shim's C collective
             # schedules pick the SAME algorithm the Python plane would
@@ -366,6 +369,15 @@ def native_fastpath_info(h: int):
         return (MPI_SUCCESS, info)
     except BaseException as e:  # noqa: BLE001
         return (_fail(e), "")
+
+
+def _fp_addrs(eng) -> list[str]:
+    """The engine's member addresses, fully resolved: indexed access
+    forces a sharded-modex AddressTable to fill its lazy holes (the
+    C-ABI fast path needs every address eagerly for its fail-index
+    mapping; sub-engine address views resolve through the parent)."""
+    addrs = eng.addresses
+    return [addrs[i] for i in range(len(addrs))]
 
 
 def _coll_ring_threshold(c) -> int:

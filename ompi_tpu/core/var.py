@@ -537,6 +537,21 @@ SERVING_VARS = (
      "by a restarted daemon so queued and in-flight jobs survive a "
      "daemon SIGKILL and execute exactly once (empty = "
      "<serve_pidfile>.journal when a pidfile is configured)"),
+    ("serve", "", "agent_poll_ms", 50, "int",
+     "Milliseconds between a per-host launch agent's polls of its "
+     "command stream while idle (the multi-host DVM leg: tpurun "
+     "--daemon with a host map runs one agent per remote host)"),
+    ("serve", "", "agent_hb_ms", 500, "int",
+     "Milliseconds between a launch agent's heartbeat records "
+     "(serve.agent.hb.<hid>: agent pid + per-worker pid/liveness "
+     "table — the daemon's remote view of a host it shares no pid "
+     "namespace with)"),
+    ("serve", "", "agent_timeout", 10.0, "float",
+     "Seconds of agent-heartbeat silence (with the agent's launch "
+     "process also gone) after which the daemon declares the agent "
+     "dead and respawns it over the rsh leg — the reborn agent "
+     "re-adopts still-live workers from the last-known pid table and "
+     "reports the dead ones for the normal respawn+repair leg"),
     ("serve", "", "reattach_timeout", 30.0, "float",
      "Crash-safe control plane window, both sides: how long a "
      "resident worker that lost its daemon parks and polls the "

@@ -228,6 +228,12 @@ class DcnCollEngine:
         wrong)."""
         self._failed_procs.discard(proc)
 
+    def coll_revoke(self, cid) -> None:
+        """Revoke fan-out into an engine-resident collective fast path
+        — a no-op on the Python plane (blocked receives poll
+        ``_check_revoked`` between wait slices); the native engine
+        overrides it to wake parked C schedule waits."""
+
     def _bump_stat(self, name: str) -> None:
         """Increment a Python-plane robustness counter on whatever
         stats surface this engine exports (transport dict here; the
@@ -425,6 +431,10 @@ class DcnCollEngine:
                 from ompi_tpu.ft import ulfm
 
                 ulfm.state(comm).revoked = True
+                # wake any C fast-path schedule parked on this comm's
+                # private stream (the Python plane's _check_revoked
+                # mirrored into cctx_recv_msg)
+                self._root_engine().coll_revoke(env["cid"])
             return
         if env.get("kind") == "p2p":
             cid = env.get("cid")

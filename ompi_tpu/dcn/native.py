@@ -75,6 +75,15 @@ class TdcnMsg(ctypes.Structure):
 _lib = None
 _lib_lock = threading.Lock()
 
+#: lazy-modex resolver callback shape (tdcn_set_resolver): C hands a
+#: writable buffer and the Python side copies the NUL-terminated
+#: address in, returning its length (-1 = unresolvable) — a
+#: char*-returning callback would hand C memory whose Python owner can
+#: be collected before the engine reads it
+RESOLVER_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_char),
+                               ctypes.c_int)
+
 
 def load_library():
     """Build (cached) and load libtpudcn.so with typed signatures."""
@@ -149,6 +158,10 @@ def load_library():
         lib.tdcn_chan_kill.argtypes = [P, U64]
         lib.tdcn_kill_peer.argtypes = [P, S]
         lib.tdcn_clear_failed.argtypes = [P, I]
+        lib.tdcn_set_address_one.restype = I
+        lib.tdcn_set_address_one.argtypes = [P, I, S, I]
+        lib.tdcn_set_resolver.argtypes = [P, RESOLVER_FN]
+        lib.tdcn_coll_revoke_cid.argtypes = [P, S]
         lib.tdcn_set_ring_timeout.argtypes = [P, D]
         lib.tdcn_set_connect_timeout.argtypes = [P, D]
         lib.tdcn_free.argtypes = [ctypes.c_void_p]
@@ -631,18 +644,82 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
     def set_addresses(self, addresses: Sequence[str]) -> None:
         if len(addresses) != self.nprocs:
             raise ValueError("address count != nprocs")
+        from .collops import AddressTable
+
+        if isinstance(addresses, AddressTable):
+            # sharded native modex (PR 11's instant-on leg, now on the
+            # C plane): install only the PRIMED slots eagerly (<= group
+            # size), wrap the table's resolver so every lazy resolution
+            # also lands in the C table (tdcn_set_address_one), and arm
+            # the C-side resolver callback so a C-initiated send to an
+            # unresolved peer pulls through the same table instead of
+            # failing — np>=16 native boot does <= group-size eager
+            # installs instead of P-1 (TS_ADDR_INSTALLS/TS_ADDR_LAZY
+            # account it)
+            inner = addresses._resolver
+
+            def _resolve_install(p: int, _inner=inner) -> str:
+                a = _inner(p)
+                if a:
+                    self._lib.tdcn_set_address_one(
+                        self._h, int(p), str(a).encode(), 1)
+                return a
+
+            addresses._resolver = _resolve_install
+            self.addresses = addresses
+            joined = "\n".join(
+                (list.__getitem__(addresses, i) or "")
+                for i in range(self.nprocs))
+            self._lib.tdcn_set_addresses(self._h, joined.encode())
+            self._arm_resolver()
+            return
         self.addresses = list(addresses)
         self._lib.tdcn_set_addresses(
             self._h, "\n".join(self.addresses).encode())
 
+    def _arm_resolver(self) -> None:
+        """C-side lazy-modex callback: writes the table-resolved
+        address into the engine-provided buffer (NUL-terminated).  The
+        CFUNCTYPE object is pinned on the engine — ctypes callbacks
+        die with their last Python reference."""
+
+        def _cb(proc: int, out, cap: int) -> int:
+            try:
+                a = self.addresses[int(proc)]  # resolves + installs
+                b = str(a or "").encode()
+                if not b or len(b) + 1 > int(cap):
+                    return -1
+                ctypes.memmove(out, b, len(b))
+                out[len(b)] = b"\x00"
+                return len(b)
+            except Exception:  # noqa: BLE001 — C cannot unwind Python
+                return -1
+
+        self._resolver_cb = RESOLVER_FN(_cb)
+        self._lib.tdcn_set_resolver(self._h, self._resolver_cb)
+
     def update_address(self, proc: int, address: str) -> None:
         """One-peer refresh (replace() installing a reborn endpoint):
-        the C plane holds the full table, so re-push it — lazy
-        resolution is a Python-transport affair (the C engine needs
-        every peer eagerly, exactly like the pre-sharded modex)."""
-        addrs = list(self.addresses)
-        addrs[int(proc)] = address
-        self.set_addresses(addrs)
+        ``tdcn_set_address_one`` updates exactly that slot — the C
+        engine prunes the corpse lineage's rx state and invalidates
+        any C-coll views that resolved the dead address — without
+        collapsing a sharded table's unresolved holes the way a
+        full-table re-push would."""
+        from .collops import AddressTable
+
+        if isinstance(self.addresses, AddressTable):
+            list.__setitem__(self.addresses, int(proc), address)
+        else:
+            self.addresses[int(proc)] = address
+        self._lib.tdcn_set_address_one(
+            self._h, int(proc), str(address).encode(), 0)
+
+    def coll_revoke(self, cid) -> None:
+        """ULFM revoke crossing into the C fast path: wake any parked
+        ``cctx_recv_msg`` waits on this comm's private ``#cfp`` stream
+        (they abort with the revoked code instead of waiting out the
+        ~600 s give-up) and refuse new C schedules for it."""
+        self._lib.tdcn_coll_revoke_cid(self._h, str(cid).encode())
 
     def _csend(self, address: str, kind: int, cid: str, seq: int,
                src: int, dst: int, tag: int, arr: np.ndarray,

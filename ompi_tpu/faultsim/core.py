@@ -51,18 +51,22 @@ _enabled = False
 #: ``daemonkill`` SIGKILL the tpud serving daemon at the ``at``-th
 #:               directive-publish attempt (site daemon — the control-
 #:               plane hook in serve/daemon.py; drives the restart-
-#:               hygiene soak deterministically from one seed).
+#:               hygiene soak deterministically from one seed);
+#: ``agentkill`` SIGKILL a per-host launch agent at the ``at``-th
+#:               command it executes (site agent — the hook in
+#:               serve/agent.py; the multi-host chaos harness's
+#:               deterministic agent-death lever).
 #:
 #: The tuple is grow-only: the ``faultsim_injected_<kind>`` MPI_T pvar
 #: namespace is derived from it in order.
 KINDS = ("drop", "delay", "dup", "trunc", "connkill", "stall",
-         "ringfail", "dialfail", "daemonkill")
+         "ringfail", "dialfail", "daemonkill", "agentkill")
 
 #: default hook site per kind (rules may override with ``site=``)
 _DEFAULT_SITE = {
     "drop": "send", "delay": "send", "dup": "send", "trunc": "send",
     "connkill": "send", "stall": "ring", "ringfail": "ring",
-    "dialfail": "dial", "daemonkill": "daemon",
+    "dialfail": "dial", "daemonkill": "daemon", "agentkill": "agent",
 }
 
 _M64 = (1 << 64) - 1

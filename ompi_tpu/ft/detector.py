@@ -157,6 +157,12 @@ class HeartbeatDetector:
                          "rebirth_detects": 0, "false_positive_heals": 0}
         self._cbs: list[Callable[[int], None]] = []
         self._heal_cbs: list[Callable[[int], None]] = []
+        #: leadership-transition callbacks (telemetry relay failover):
+        #: fired with the new is-leader bool when this process's role
+        #: changes — the successor that outlives its group leader
+        #: learns it is now the leader within one heartbeat period
+        self._lead_cbs: list[Callable[[bool], None]] = []
+        self._was_leader: bool | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         engine.attach_detector(self)
@@ -418,6 +424,20 @@ class HeartbeatDetector:
         with self._lock:
             self._heal_cbs.append(cb)
 
+    def on_leadership(self, cb: Callable[[bool], None]) -> None:
+        """Register a callback(is_leader) fired when THIS process's
+        group role flips (deterministic takeover: the successor that
+        outlives its leader computes itself leader on the next period).
+        The telemetry plane uses it to promote the successor's relay
+        (:mod:`~ompi_tpu.metrics.live` re-registers ``relay.g<i>``) so
+        a dead group-leader relay degrades members for at most a few
+        publish intervals instead of for the rest of the job."""
+        with self._lock:
+            self._lead_cbs.append(cb)
+            if self._was_leader is None:
+                self._was_leader = (self._leader_of(self._group)
+                                    == self.engine.proc)
+
     def failed(self) -> set[int]:
         with self._lock:
             return set(self._failed)
@@ -426,6 +446,19 @@ class HeartbeatDetector:
         """The proc's current heal epoch (0 = never healed)."""
         with self._lock:
             return self._epoch.get(proc, 0)
+
+    def note_incarnation(self, proc: int, incarnation: int) -> None:
+        """Adopt an incarnation floor for a peer WITHOUT touching its
+        failure mark: a reborn process seeds its fresh detector from
+        the recovery beacon's floors so a FELLOW reborn peer's
+        current-incarnation heartbeats read as liveness, not as a
+        rebirth detection.  (Found by the multi-host chaos harness: a
+        whole-host kill rebirths several co-grouped ranks at once, and
+        without the floors each reborn detector 'rebirth-detected' the
+        other and poisoned the healed mesh's next collective.)"""
+        with self._lock:
+            if int(incarnation) > self._inc.get(proc, 0):
+                self._inc[proc] = int(incarnation)
 
     def clear_failed(self, proc: int, incarnation: int | None = None) -> None:
         """Elastic recovery (replace()): the failed proc respawned with
@@ -525,6 +558,18 @@ class HeartbeatDetector:
                 targets, watch, is_leader = self._topology_locked()
                 dg = (self._digest_locked()
                       if is_leader and self.digest_enabled else None)
+                flipped = (self._lead_cbs and self._was_leader is not None
+                           and is_leader != self._was_leader)
+                if self._was_leader is not None or self._lead_cbs:
+                    self._was_leader = is_leader
+                lead_cbs = list(self._lead_cbs) if flipped else []
+            for cb in lead_cbs:
+                try:
+                    cb(is_leader)
+                except Exception:  # noqa: BLE001 — a bad callback must
+                    import traceback  # not kill the heartbeat loop
+
+                    traceback.print_exc()
             for p in targets:
                 if p in self._failed or p not in self._strikes:
                     continue  # failed, or retired mid-iteration

@@ -88,6 +88,11 @@ NATIVE_COUNTERS = (
     # memcpy or streamed RTS fill — either plane)
     "coll_fastpath_ops", "sched_cache_hits", "sched_cache_misses",
     "recv_into_placed",
+    # sharded-modex tail: peer addresses installed eagerly (bulk boot
+    # installs + replace() refreshes) vs resolved lazily on first use
+    # (the AddressTable resolver, either plane) — the np>=16 native
+    # boot proof reads addr_installs <= group size instead of P-1
+    "addr_installs", "addr_lazy_resolved",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

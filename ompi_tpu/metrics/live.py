@@ -680,15 +680,29 @@ class TelemetryPublisher:
     """Per-rank frame pump: snapshot → one JSON frame → the launcher.
 
     Failures never propagate — a dead aggregator costs a reconnect
-    attempt per interval, nothing else; the data plane is untouched."""
+    attempt per interval, nothing else; the data plane is untouched.
+    ``refresh``, when given, is consulted after a failed publish: it
+    returns a (possibly new) ingest address — the relay-failover hook
+    by which a group member whose leader relay died re-dials the
+    deterministically re-elected successor's relay (the address the
+    new leader re-registered under ``relay.g<i>``)."""
 
     def __init__(self, address: str, proc: int, nprocs: int,
-                 interval_ms: int = 500, detector=None):
+                 interval_ms: int = 500, detector=None, refresh=None):
         self.address = address
         self.proc = int(proc)
         self.nprocs = int(nprocs)
         self.interval = max(0.02, float(interval_ms) / 1000.0)
         self._detector = detector
+        self._refresh = refresh
+        #: relay-failover observability: successful re-aims after a
+        #: publish failure (the regression test's convergence signal)
+        self.refreshes = 0
+        #: cross-thread re-aim request (reaim()): consumed by the
+        #: publisher thread itself at the next tick — another thread
+        #: closing/overwriting ``_sock`` mid-send would leak a freshly
+        #: dialed descriptor or kill an in-flight frame
+        self._reaim_addr: str | None = None
         self._sock: socket.socket | None = None
         self.sent = 0
         self._stop = threading.Event()
@@ -734,7 +748,42 @@ class TelemetryPublisher:
         # final frame so a clean finalize leaves current counters
         self.publish_once()
 
+    def reaim(self, address: str) -> None:
+        """Request a re-aim from ANOTHER thread (daemon-restart
+        repoint, relay-failover promotion): the publisher thread swaps
+        its own socket at the next tick — see ``_reaim_addr``."""
+        self._reaim_addr = str(address)
+
     def publish_once(self) -> bool:
+        new = self._reaim_addr
+        if new is not None:
+            self._reaim_addr = None
+            if new != self.address:
+                self.address = new
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+        if self._try_send():
+            return True
+        # relay failover: a failed publish against a dead relay
+        # re-reads the registration (the promoted successor overwrote
+        # ``relay.g<i>``) and retries ONCE within the same tick, so
+        # the handoff costs at most the frames of the detection window
+        if self._refresh is not None:
+            try:
+                new = self._refresh()
+            except Exception:  # noqa: BLE001 — pump must never die
+                new = None
+            if new and new != self.address:
+                self.address = str(new)
+                self.refreshes += 1
+                return self._try_send()
+        return False
+
+    def _try_send(self) -> bool:
         try:
             if self._sock is None:
                 host, port = self.address.rsplit(":", 1)
@@ -809,6 +858,8 @@ def start_publisher(world, store) -> TelemetryPublisher | None:
     pc = getattr(world, "procctx", None)
     interval = int(store.get("telemetry_interval_ms", 500) or 500)
     groups = getattr(pc, "groups", None) if pc is not None else None
+    root_address = address
+    refresh = None
     if (bool(store.get("telemetry_relay", False))
             and groups and len(groups) > 1):
         gi = groups.index(pc.group)
@@ -824,14 +875,74 @@ def start_publisher(world, store) -> TelemetryPublisher | None:
                 _via_relay = True
             except (KeyError, ConnectionError, OSError):
                 pass  # no relay came up: degrade to the root directly
+
+            def _refresh_relay(_pc=pc, _gi=gi) -> str | None:
+                # relay failover, member half: re-read the (possibly
+                # re-registered) relay address — the successor the
+                # detector promoted overwrote ``relay.g<i>`` with its
+                # replacement relay's ingest socket
+                try:
+                    return str(_pc.kvs.get(f"{_pc.ns}relay.g{_gi}",
+                                           wait=False))
+                except (KeyError, ConnectionError, OSError):
+                    return None
+
+            refresh = _refresh_relay
+        det = getattr(pc, "detector", None)
+        if det is not None:
+            # relay failover, successor half: promotion (the PR-11
+            # deterministic takeover rule) hosts a replacement relay
+            # and re-registers it, within one heartbeat period of the
+            # leader's death
+            det.on_leadership(
+                lambda lead, _pc=pc, _gi=gi: _promote_relay(
+                    lead, _pc, _gi, root_address, interval))
     _publisher = TelemetryPublisher(
         address,
         proc=int(getattr(world, "proc", 0)),
         nprocs=int(getattr(world, "nprocs", 1)),
         interval_ms=interval,
         detector=getattr(pc, "detector", None) if pc is not None else None,
+        refresh=refresh,
     )
     return _publisher
+
+
+def _promote_relay(is_leader: bool, pc, gi: int, root_address: str,
+                   interval_ms: int) -> None:
+    """Detector leadership-transition hook (relay failover): the
+    member the deterministic successor rule just promoted hosts a
+    replacement :class:`TelemetryRelay`, re-registers ``relay.g<i>``
+    on the boot KVS (members' pumps re-dial it through their refresh
+    hook on the next failed publish), and re-aims its OWN pump at the
+    root — the shape the original leader had.  Demotions are ignored:
+    closing a live relay mid-handoff would drop the members that
+    still point at it."""
+    global _relay, _via_relay
+    if not is_leader or _relay is not None:
+        return
+    try:
+        relay = TelemetryRelay(root_address, gi, interval_ms=interval_ms)
+    except OSError:
+        return  # no socket: members degrade to dropped frames
+    try:
+        pc.kvs.put(f"{pc.ns}relay.g{gi}", relay.ingest_address)
+    except (OSError, ConnectionError):
+        # registration failed (KVS hiccup): don't leak the relay's
+        # thread+sockets — members degrade to dropped frames
+        try:
+            relay.close()
+        except OSError:
+            pass
+        return
+    _relay = relay
+    _via_relay = False
+    pub = _publisher
+    if pub is not None:
+        # re-aim request, consumed by the publisher's own thread — a
+        # cross-thread socket close here could kill an in-flight frame
+        # or leak the descriptor the pump just dialed
+        pub.reaim(root_address)
 
 
 def stop_publisher() -> None:
@@ -848,9 +959,9 @@ def stop_publisher() -> None:
 def repoint_publisher(address: str) -> None:
     """Re-aim this rank's frame pump at a NEW aggregator (tpud restart
     re-adoption: the reborn daemon's ingest socket lives at a fresh
-    port).  The publisher thread keeps running; its cached socket is
-    dropped so the next tick dials the new address — a benign race
-    with an in-flight publish costs at most one failed frame.  A
+    port).  The publisher thread keeps running; it consumes the
+    re-aim request itself at its next tick (``reaim`` — a cross-
+    thread socket swap could leak a freshly dialed descriptor).  A
     group-relay leader re-aims the RELAY's upstream too; a relay
     member's pump keeps pointing at its (still-live) relay."""
     pub = _publisher
@@ -861,10 +972,4 @@ def repoint_publisher(address: str) -> None:
         _relay.repoint(address)
     if pub is None or _via_relay:
         return
-    pub.address = address
-    sock, pub._sock = pub._sock, None
-    if sock is not None:
-        try:
-            sock.close()
-        except OSError:
-            pass
+    pub.reaim(address)

@@ -53,11 +53,13 @@ import threading
 import time
 
 from ompi_tpu.boot.kvs import KVSServer
-from ompi_tpu.boot.proc import ENV_INCARNATION
-from ompi_tpu.boot.tpurun import _forward, _truthy, worker_env
+from ompi_tpu.boot.proc import ENV_HOST_IDS, ENV_INCARNATION, ENV_PROC
+from ompi_tpu.boot.tpurun import (_final_cmd, _forward, _is_local_host,
+                                  _truthy, worker_env)
 from ompi_tpu.core.var import ENV_PREFIXES, SERVING_VARS, full_var_name
 from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.metrics.live import TelemetryAggregator
+from . import agent as _agent
 from . import state as _state
 from .queue import AdmissionError, JobQueue
 
@@ -98,12 +100,50 @@ class TpuDaemon:
 
     def __init__(self, np_: int, mca: dict[str, str] | None = None,
                  cpu_devices: int | None = None, max_respawns: int = 2,
-                 http_port: int | None = None, spawn: bool = True):
+                 http_port: int | None = None, spawn: bool = True,
+                 hosts: list[tuple[str, int]] | None = None,
+                 map_by: str = "slot",
+                 launch_agent: str = "ssh {host} {cmd}",
+                 kvs_host: str | None = None,
+                 oversubscribe: bool = False):
         self.np = int(np_)
         self.mca = dict(mca or {})
         self.cpu_devices = cpu_devices
         self.max_respawns = int(max_respawns)
         self._spawn_workers = spawn
+        self.launch_agent = launch_agent
+        # multi-host DVM (the prte shape): map ranks onto the host
+        # allocation; each NON-local host gets one launch agent over
+        # the rsh leg that owns its ranks' spawn/respawn/pid-liveness
+        # — the daemon's `kill 0`-style probes cannot cross hosts
+        self._rank_hid: list[int | None] = [None] * self.np
+        self._host_names: dict[int, str] = {}
+        self._host_ids_env = ""
+        self._agents: dict[int, dict] = {}
+        if hosts:
+            from ompi_tpu.boot.rmaps import map_ranks
+
+            rank_host = map_ranks(hosts, self.np, policy=map_by,
+                                  oversubscribe=oversubscribe)
+            order: dict[str, int] = {}
+            for hname in rank_host:
+                order.setdefault(hname, len(order))
+            self._host_ids_env = ",".join(
+                str(order[hname]) for hname in rank_host)
+            for r, hname in enumerate(rank_host):
+                hid = order[hname]
+                self._host_names[hid] = hname
+                if not _is_local_host(hname):
+                    self._rank_hid[r] = hid
+            for hid, hname in sorted(self._host_names.items()):
+                ranks = [r for r in range(self.np)
+                         if self._rank_hid[r] == hid]
+                if ranks:
+                    self._agents[hid] = {
+                        "name": hname, "ranks": ranks, "proc": None,
+                        "session": "", "cursor": 0, "pending": {},
+                        "hb": None, "spawns": 0, "status": "down",
+                        "worker_pids": {}}
         self.cid_block = int(serve_var(self.mca, "cid_block"))
         self.cid_next = int(serve_var(self.mca, "cid_base"))
         self.job_timeout = float(serve_var(self.mca, "job_timeout"))
@@ -138,7 +178,7 @@ class TpuDaemon:
             _fsim.configure(str(self._opt("faultsim_plan") or ""),
                             seed=int(self._opt("faultsim_seed") or 0),
                             proc=-1)
-        self.server = KVSServer()
+        self.server = KVSServer(host=kvs_host or "127.0.0.1")
         self.aggregator = TelemetryAggregator(
             http_port=(int(serve_var(self.mca, "port"))
                        if http_port is None else int(http_port)))
@@ -192,6 +232,8 @@ class TpuDaemon:
         if recovered is not None:
             self._recover(recovered)
         elif spawn:
+            for hid in sorted(self._agents):
+                self._boot_agent(hid)
             for rank in range(self.np):
                 self._procs[rank] = self._spawn(rank)
 
@@ -266,6 +308,21 @@ class TpuDaemon:
         for r, st in replay["pids"].items():
             if 0 <= int(r) < self.np:
                 self._incarnation[int(r)] = int(st.get("incarnation", 0))
+        # multi-host: the journal's host placement tells the restarted
+        # daemon which agents to await — each parks on the pidfile
+        # like a worker and offers serve.agent.adopt.<hid>; one that
+        # never re-attaches (it died with the daemon) is respawned
+        # over rsh with the journaled worker-pid table, so ITS reborn
+        # agent re-adopts the still-live workers
+        for hid, ag in self._agents.items():
+            ag["status"] = "adopting"
+            ag["hb_mono"] = time.monotonic()
+            for r, st in replay["pids"].items():
+                if (0 <= int(r) < self.np
+                        and self._rank_hid[int(r)] == hid
+                        and int(st.get("pid", 0))):
+                    ag["worker_pids"][int(r)] = (
+                        int(st["pid"]), int(st.get("incarnation", 0)))
         # crash-mid-repair replay (PR 10 deferred edge): a rank the
         # predecessor respawned whose repair never FINISHED re-enters
         # the repairing set — once adoption resolves the mesh view,
@@ -314,13 +371,24 @@ class TpuDaemon:
                 return
             for r in pending:
                 offer = self.server.peek(f"{K_ADOPT}{r}")
+                # a remote rank's offer IS its proof of life (the
+                # local pid probe cannot cross hosts; the worker just
+                # published under our generation)
+                pid_ok = (self._rank_hid[r] is not None
+                          or _state.pid_alive(int(offer.get("pid", 0)))
+                          ) if offer else False
                 if (offer and int(offer.get("generation", 0))
-                        == self.generation
-                        and _state.pid_alive(int(offer.get("pid", 0)))):
+                        == self.generation and pid_ok):
                     pid = int(offer["pid"])
-                    self._procs[r] = _AdoptedProc(pid)
                     self._incarnation[r] = int(
                         offer.get("incarnation", 0))
+                    if self._rank_hid[r] is not None:
+                        rp = _RemoteProc(self, r, self._rank_hid[r],
+                                         self._incarnation[r])
+                        rp.pid = pid
+                        self._procs[r] = rp
+                    else:
+                        self._procs[r] = _AdoptedProc(pid)
                     self._status[r] = "active"
                     self._adopt_pids.pop(r, None)
                     self.server.put_local(
@@ -328,7 +396,9 @@ class TpuDaemon:
                         {"pid": pid, "generation": self.generation})
                     self._journal_ev(
                         "spawn", rank=r, pid=pid, adopted=True,
-                        incarnation=self._incarnation[r])
+                        incarnation=self._incarnation[r],
+                        **({"host": self._rank_hid[r]}
+                           if self._rank_hid[r] is not None else {}))
                     print(f"[tpud] re-adopted rank {r} (pid {pid}, "
                           f"cursor {offer.get('cursor')})", flush=True)
             # ranks whose recorded worker died while the daemon was
@@ -338,7 +408,7 @@ class TpuDaemon:
             live_waiting = [
                 r for r in range(self.np)
                 if self._status[r] == "adopting"
-                and _state.pid_alive(self._adopt_pids.get(r, 0))]
+                and self._rank_alive(r, self._adopt_pids.get(r, 0))]
             expired = time.monotonic() > self._adopt_deadline
             if live_waiting and not expired:
                 return
@@ -357,6 +427,13 @@ class TpuDaemon:
                         st["done"].setdefault(r, {
                             "ok": False,
                             "error": "mesh lost across daemon restart"})
+                # multi-host: a cold boot needs live agents with real
+                # command sessions BEFORE any remote spawn publishes —
+                # an agent still marked adopting never offered itself
+                # (it died with the mesh), so relaunch it now
+                for hid, ag in self._agents.items():
+                    if ag["status"] != "active":
+                        self._boot_agent(hid)
                 for r in still:
                     self._adopt_pids.pop(r, None)
                     self._incarnation[r] = 0
@@ -371,7 +448,7 @@ class TpuDaemon:
                                       if self._spawn_workers else None)
                 return
             for r in still:
-                if _state.pid_alive(self._adopt_pids.get(r, 0)):
+                if self._rank_alive(r, self._adopt_pids.get(r, 0)):
                     if not expired:
                         continue
                     # window over with the pid alive: a worker wedged
@@ -381,6 +458,15 @@ class TpuDaemon:
                     print(f"[tpud] rank {r} (pid "
                           f"{self._adopt_pids.get(r)}) alive but not "
                           "re-attached; holding the rank", flush=True)
+                    continue
+                hid = self._rank_hid[r]
+                if (hid is not None
+                        and self._agents[hid]["status"] != "active"):
+                    # a remote rank cannot respawn without its agent:
+                    # publishing the command now would land in a dead
+                    # or not-yet-acked session and be lost when the
+                    # agent resolves — hold the rank; the agent's own
+                    # adoption/respawn (_poll_agents) unblocks it
                     continue
                 print(f"[tpud] rank {r} did not re-attach (worker "
                       "dead); respawning", flush=True)
@@ -405,12 +491,30 @@ class TpuDaemon:
         m["ft_detector_enable"] = "1"
         return m
 
-    def _spawn(self, rank: int) -> subprocess.Popen:
-        extra = ({ENV_SERVE_PIDFILE: self.pidfile} if self.pidfile
-                 else None)
+    def _spawn(self, rank: int):
+        hid = self._rank_hid[rank]
+        if hid is not None:
+            # remote rank: the owning host's launch agent executes the
+            # spawn (the daemon shares no pid namespace with it); the
+            # journal records placement now and the real pid when the
+            # agent's ack arrives
+            inc = self._incarnation[rank]
+            self._agent_cmd(hid, {
+                "kind": "spawn", "rank": rank, "incarnation": inc,
+                # the CURRENT ingest address rides the command: the
+                # agent's inherited env may still name a dead
+                # predecessor's aggregator after a daemon restart
+                "telemetry": self.aggregator.ingest_address})
+            self._journal_ev("spawn", rank=rank, pid=0,
+                             incarnation=inc, host=hid)
+            return _RemoteProc(self, rank, hid, inc)
+        extra = dict({ENV_SERVE_PIDFILE: self.pidfile}
+                     if self.pidfile else {})
+        if self._host_ids_env:
+            extra[ENV_HOST_IDS] = self._host_ids_env
         env = worker_env(
             rank, self.np, self.server.address, mca=self._worker_mca(),
-            cpu_devices=self.cpu_devices, extra_env=extra,
+            cpu_devices=self.cpu_devices, extra_env=extra or None,
             telemetry_addr=self.aggregator.ingest_address)
         if self._incarnation[rank]:
             env[ENV_INCARNATION] = str(self._incarnation[rank])
@@ -425,6 +529,243 @@ class TpuDaemon:
         self._journal_ev("spawn", rank=rank, pid=p.pid,
                          incarnation=self._incarnation[rank])
         return p
+
+    # -- per-host launch agents (the multi-host DVM leg) ----------------
+
+    def _agent_var(self, name: str, default: float) -> float:
+        try:
+            return float(serve_var(self.mca, name))
+        except (KeyError, ValueError):
+            return float(default)
+
+    def _boot_agent(self, hid: int,
+                    adopt: dict[int, tuple[int, int]] | None = None
+                    ) -> None:
+        """(Re)launch one host's agent over the rsh leg.  ``adopt``
+        hands the reborn agent the last-known worker table (rank →
+        (pid, incarnation)) so an agent-only death re-adopts the
+        still-live workers instead of double-spawning the host."""
+        ag = self._agents[hid]
+        ag["session"] = f"g{self.generation}s{ag['spawns']}"
+        ag["spawns"] += 1
+        ag["cursor"] = 0
+        # old-session indices are dead with the session: the respawn
+        # caller re-issues what it captured, and a stale entry left
+        # here would be re-issued AGAIN on every later respawn
+        # (double-spawning a rank that is already alive)
+        ag["pending"] = {}
+        ag["hb"] = None
+        ag["hb_mono"] = time.monotonic()
+        ag["status"] = "active"
+        extra = dict({ENV_SERVE_PIDFILE: self.pidfile}
+                     if self.pidfile else {})
+        extra[_agent.ENV_AGENT_HOST] = str(hid)
+        extra[_agent.ENV_AGENT_RANKS] = ",".join(
+            str(r) for r in ag["ranks"])
+        extra[_agent.ENV_AGENT_SESSION] = ag["session"]
+        if adopt:
+            extra[_agent.ENV_AGENT_ADOPT] = ",".join(
+                f"{r}:{pid}:{inc}" for r, (pid, inc) in sorted(
+                    adopt.items()))
+        if self._host_ids_env:
+            extra[ENV_HOST_IDS] = self._host_ids_env
+        env = worker_env(
+            0, self.np, self.server.address, mca=self._worker_mca(),
+            cpu_devices=self.cpu_devices, extra_env=extra,
+            telemetry_addr=self.aggregator.ingest_address)
+        env.pop(ENV_PROC, None)  # the agent is not a rank
+        cmd = [sys.executable, "-m", "ompi_tpu.serve.agent"]
+        p = subprocess.Popen(
+            _final_cmd(self.launch_agent, cmd, env, ag["name"]),
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        t = threading.Thread(
+            target=_forward,
+            args=(p.stdout, f"h{hid}", sys.stdout.buffer), daemon=True)
+        t.start()
+        self._threads.append(t)
+        ag["proc"] = p
+        # supersession fence: the CURRENT session, visible to a
+        # predecessor agent that wedged past serve_agent_timeout and
+        # later un-wedges — it reads the mismatch at heartbeat cadence
+        # and exits instead of executing its stale session's commands
+        self.server.put_local(f"{_agent.K_ASESSION}{hid}",
+                              ag["session"])
+        self._journal_ev("agent", host=hid, session=ag["session"],
+                         rsh_pid=p.pid)
+        print(f"[tpud] launch agent h{hid} ({ag['name']}) spawned "
+              f"(session {ag['session']}, ranks {ag['ranks']})",
+              flush=True)
+
+    def _agent_cmd(self, hid: int, cmd: dict) -> int:
+        """Publish one command on the agent's current session stream;
+        spawn commands are tracked until their ack (the real worker
+        pid) arrives — an agent respawn re-issues unacked ones into
+        the fresh session.  Under ``self._lock`` (re-entrant): HTTP
+        handlers (/scale) and the monitor thread both publish, and an
+        unlocked read-increment of the cursor could hand two commands
+        the same stream index (the later put overwrites the earlier —
+        a silently lost spawn/kill)."""
+        with self._lock:
+            ag = self._agents[hid]
+            idx = ag["cursor"]
+            ag["cursor"] += 1
+            d = dict(cmd)
+            self.server.put_local(
+                f"{_agent.K_ACMD}{ag['session']}.{hid}.{idx}", d)
+            if d.get("kind") in ("spawn", "adopt"):
+                ag["pending"][idx] = d
+            return idx
+
+    def _agent_worker_state(self, hid: int, rank: int) -> dict | None:
+        ag = self._agents.get(hid)
+        hb = (ag or {}).get("hb") or {}
+        return (hb.get("workers") or {}).get(str(rank))
+
+    def _agent_kill(self, hid: int, rank: int, sig: int) -> None:
+        try:
+            self._agent_cmd(hid, {"kind": "kill", "rank": rank,
+                                  "sig": int(sig)})
+        except KeyError:
+            pass
+
+    def _rank_alive(self, rank: int, pid: int) -> bool:
+        """Liveness probe that respects host placement: local ranks
+        use the pid; remote ranks route through the owning agent's
+        heartbeat table (``kill 0`` cannot cross hosts).  An agent
+        that has not reported yet falls back to the pid probe — exact
+        on the emulated-host harness (shared pid namespace), best-
+        effort on real remote hosts until the heartbeat lands."""
+        hid = self._rank_hid[rank]
+        if hid is not None:
+            st = self._agent_worker_state(hid, rank)
+            if st is not None:
+                return bool(st.get("alive"))
+        return _state.pid_alive(pid)
+
+    def _poll_agents(self) -> None:
+        """One monitor-tick look at every launch agent: fold in fresh
+        heartbeats, collect spawn acks (journal the real pid),
+        re-adopt agents offering themselves to a restarted daemon, and
+        respawn agents whose launch process died or whose heartbeats
+        went silent — the reborn agent re-adopts still-live workers
+        from the last-known pid table.  Runs under ``self._lock``
+        (re-entrant) like every other mutator of the per-agent
+        session/cursor/pending state — an HTTP-thread /scale racing a
+        session rotation must not split a command across sessions."""
+        if not self._agents:
+            return
+        now = time.monotonic()
+        timeout = self._agent_var("agent_timeout", 10.0)
+        with self._lock:
+            self._poll_agents_locked(now, timeout)
+
+    def _poll_agents_locked(self, now: float, timeout: float) -> None:
+        for hid, ag in self._agents.items():
+            hb = self.server.peek(f"{_agent.K_AHB}{hid}")
+            if hb and hb.get("session") == ag["session"]:
+                if hb is not ag["hb"]:
+                    prev = ag["hb"] or {}
+                    if hb.get("ts_ns") != prev.get("ts_ns"):
+                        ag["hb_mono"] = now
+                    ag["hb"] = hb
+                for r, st in (hb.get("workers") or {}).items():
+                    if int(st.get("pid", 0)):
+                        ag["worker_pids"][int(r)] = (
+                            int(st["pid"]), int(st.get("incarnation", 0)))
+            # adoption offer from an agent that outlived a daemon crash
+            offer = self.server.peek(f"{_agent.K_AADOPT}{hid}")
+            if (ag["status"] == "adopting" and offer
+                    and int(offer.get("generation", 0))
+                    == self.generation):
+                ag["session"] = f"g{self.generation}s0"
+                # the adoption claims the s0 session name — a later
+                # agent RESPAWN must take s1+, not collide with the
+                # adopted stream's consumed indices
+                ag["spawns"] = max(ag["spawns"], 1)
+                ag["cursor"] = 0
+                ag["pending"] = {}
+                ag["status"] = "active"
+                ag["proc"] = None  # not our child: liveness via hb
+                ag["hb"] = {"pid": offer.get("pid"),
+                            "session": ag["session"],
+                            "workers": offer.get("workers") or {}}
+                ag["hb_mono"] = now
+                for r, st in (offer.get("workers") or {}).items():
+                    if int(st.get("pid", 0)):
+                        ag["worker_pids"][int(r)] = (
+                            int(st["pid"]), int(st.get("incarnation", 0)))
+                self.server.put_local(f"{_agent.K_ASESSION}{hid}",
+                                      ag["session"])
+                self.server.put_local(f"{_agent.K_AADOPTED}{hid}", {
+                    "pid": offer.get("pid"),
+                    "generation": self.generation,
+                    "session": ag["session"]})
+                self._journal_ev("agent", host=hid,
+                                 session=ag["session"], adopted=True)
+                print(f"[tpud] re-adopted agent h{hid} (pid "
+                      f"{offer.get('pid')})", flush=True)
+            # spawn/adopt acks → the real worker pid, journaled; a
+            # FAILED spawn (fork error on the remote host) routes the
+            # rank down the normal death leg so the bounded respawn
+            # budget retries it instead of wedging it "alive" forever
+            for idx in sorted(list(ag["pending"])):
+                ack = self.server.peek(
+                    f"{_agent.K_AACK}{ag['session']}.{hid}.{idx}")
+                if ack is None:
+                    continue
+                d = ag["pending"].pop(idx)
+                r = int(d.get("rank", -1))
+                pid = int(ack.get("pid", 0))
+                if r >= 0 and not ack.get("ok", True):
+                    print(f"[tpud] agent h{hid} could not spawn rank "
+                          f"{r}: {ack.get('error', '?')}", flush=True)
+                    self._handle_death(r, 1)
+                    continue
+                if r >= 0 and pid:
+                    ag["worker_pids"][r] = (
+                        pid, int(d.get("incarnation", 0)))
+                    self._journal_ev(
+                        "spawn", rank=r, pid=pid, host=hid,
+                        incarnation=int(d.get("incarnation", 0)))
+            # a restart window that expires with no adoption offer:
+            # the agent died WITH the daemon (host failure) — respawn
+            # it; the reborn agent re-adopts any still-live workers
+            # from the journaled pid table and reports the dead ones
+            if ag["status"] == "adopting":
+                if (now > self._adopt_deadline
+                        and not self.shutting_down):
+                    print(f"[tpud] agent h{hid} did not re-attach; "
+                          "respawning it", flush=True)
+                    self._boot_agent(hid,
+                                     adopt=dict(ag["worker_pids"]))
+                continue
+            # agent death: launch process gone, or heartbeats silent
+            if ag["status"] != "active":
+                continue
+            rsh_dead = (ag["proc"] is not None
+                        and ag["proc"].poll() is not None)
+            # heartbeat silence since boot/adoption/last hb — a fresh
+            # agent that wedges BEFORE its first heartbeat (KVS
+            # unreachable, hung boot) with the rsh transport still
+            # connected must be declared dead too, not held forever
+            silent = now - ag.get("hb_mono", now) > timeout
+            if ((rsh_dead or silent)
+                    and not self.shutting_down):
+                if ag["spawns"] > self.max_respawns + 1:
+                    print(f"[tpud] agent h{hid} died; respawn budget "
+                          "exhausted — host marked down", flush=True)
+                    ag["status"] = "down"
+                    continue
+                print(f"[tpud] agent h{hid} "
+                      f"{'exited' if rsh_dead else 'silent'}; "
+                      "respawning it (live workers will be "
+                      "re-adopted)", flush=True)
+                pending = [ag["pending"][i]
+                           for i in sorted(ag["pending"])]
+                adopt = {r: pi for r, pi in ag["worker_pids"].items()}
+                self._boot_agent(hid, adopt=adopt)
+                for d in pending:  # unacked work survives the respawn
+                    self._agent_cmd(hid, d)
 
     # -- ops surface (mounted on the aggregator's HTTP endpoint) --------
 
@@ -490,7 +831,24 @@ class TpuDaemon:
         liveness identity, journal depth, and the re-adoption picture —
         an operator watching top sees a restarted daemon re-adopt."""
         qs = self.queue.state()
+        now = time.monotonic()
         with self._lock:
+            agents = {}
+            for hid, ag in self._agents.items():
+                workers = ((ag.get("hb") or {}).get("workers") or {})
+                agents[str(hid)] = {
+                    "host": ag["name"],
+                    "status": ag["status"],
+                    "session": ag["session"],
+                    "ranks": list(ag["ranks"]),
+                    "pid": int((ag.get("hb") or {}).get("pid", 0)),
+                    "hb_age_ms": round(
+                        (now - ag.get("hb_mono", now)) * 1e3, 1),
+                    "alive_workers": sum(
+                        1 for st in workers.values()
+                        if st.get("alive")),
+                    "spawns": ag["spawns"],
+                }
             return {"daemon": {
                 "pid": os.getpid(),
                 "generation": self.generation,
@@ -503,6 +861,7 @@ class TpuDaemon:
                 "procs": {str(r): self._status[r]
                           for r in range(self.np)},
                 "draining": self.queue.draining,
+                **({"agents": agents} if agents else {}),
             }}
 
     def _r_job(self, path, body):
@@ -806,6 +1165,7 @@ class TpuDaemon:
     def step(self) -> None:
         """One monitor tick (public so tests can drive the loop
         deterministically)."""
+        self._poll_agents()
         self._poll_adoption()
         self._poll_workers()
         self._collect_done()
@@ -854,6 +1214,36 @@ class TpuDaemon:
                 time.sleep(0.05)
             if p is not None and p.poll() is None:
                 p.kill()
+        # stop the launch agents (their workers are already down):
+        # each acks the stop, sweeps any leftover worker on its host,
+        # and exits — taking the rsh leg down with it
+        for hid, ag in self._agents.items():
+            if ag["status"] in ("down",):
+                continue
+            try:
+                self._agent_cmd(hid, {"kind": "stop"})
+            except Exception:  # noqa: BLE001 — exiting anyway
+                pass
+        adeadline = time.monotonic() + 10
+        for hid, ag in self._agents.items():
+            p = ag.get("proc")
+            while (p is not None and p.poll() is None
+                   and time.monotonic() < adeadline):
+                time.sleep(0.05)
+            if p is not None and p.poll() is None:
+                p.kill()
+            if p is None:
+                # adopted agent (not our child): best-effort local
+                # signal sweep — exact on the emulated-host harness
+                pid = int((ag.get("hb") or {}).get("pid", 0))
+                while (pid and _state.pid_alive(pid)
+                       and time.monotonic() < adeadline):
+                    time.sleep(0.05)
+                if pid and _state.pid_alive(pid):
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
         for t in self._threads:
             t.join(timeout=5)
         self.aggregator.close()
@@ -876,6 +1266,53 @@ class TpuDaemon:
                 pass
         if self.pidfile:
             _state.remove_pidfile(self.pidfile)
+
+
+class _RemoteProc:
+    """A rank owned by a per-host launch agent: the Popen surface the
+    monitor loop touches, with liveness routed through the owning
+    agent's heartbeat table — the daemon shares no pid namespace with
+    the worker, so ``poll()`` reads the agent's report instead of a
+    local wait/kill-0, and ``terminate``/``kill`` publish agent
+    commands.  A table entry for a PRIOR incarnation is ignored
+    (stale: the respawn command is still in flight)."""
+
+    def __init__(self, daemon: "TpuDaemon", rank: int, hid: int,
+                 incarnation: int):
+        self._d = daemon
+        self.rank = int(rank)
+        self.hid = int(hid)
+        self.incarnation = int(incarnation)
+        self.pid: int | None = None
+        self.returncode: int | None = None
+
+    def poll(self) -> int | None:
+        if self.returncode is not None:
+            return self.returncode
+        st = self._d._agent_worker_state(self.hid, self.rank)
+        if st is None:
+            return None  # agent has not reported this rank yet
+        if int(st.get("incarnation", -1)) != self.incarnation:
+            return None  # stale table: the spawn is still in flight
+        if int(st.get("pid", 0)):
+            self.pid = int(st["pid"])
+        if not st.get("alive", True):
+            self.returncode = int(st.get("rc", 1))
+        return self.returncode
+
+    def terminate(self) -> None:
+        self._d._agent_kill(self.hid, self.rank, signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._d._agent_kill(self.hid, self.rank, signal.SIGKILL)
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = time.monotonic() + (timeout or 0)
+        while self.poll() is None:
+            if timeout is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("remote", timeout)
+            time.sleep(0.05)
+        return self.returncode  # type: ignore[return-value]
 
 
 class _AdoptedProc:
@@ -917,11 +1354,19 @@ class _AdoptedProc:
 
 def run_daemon(np_: int, mca: dict[str, str] | None = None,
                cpu_devices: int | None = None, max_respawns: int = 2,
-               http_port: int | None = None) -> int:
+               http_port: int | None = None,
+               hosts: list[tuple[str, int]] | None = None,
+               map_by: str = "slot",
+               launch_agent: str = "ssh {host} {cmd}",
+               kvs_host: str | None = None,
+               oversubscribe: bool = False) -> int:
     """The ``tpurun --daemon`` / ``tools/tpud.py`` entry."""
     try:
         d = TpuDaemon(np_, mca=mca, cpu_devices=cpu_devices,
-                      max_respawns=max_respawns, http_port=http_port)
+                      max_respawns=max_respawns, http_port=http_port,
+                      hosts=hosts, map_by=map_by,
+                      launch_agent=launch_agent, kvs_host=kvs_host,
+                      oversubscribe=oversubscribe)
     except _state.DaemonAlreadyRunning as e:
         # idempotent start: a second `tpurun --daemon` against a live
         # pidfile is a clean one-liner, not a traceback

@@ -40,7 +40,10 @@ from typing import Any
 
 def pid_alive(pid: int) -> bool:
     """Best-effort liveness: signal 0 probes existence (EPERM counts
-    as alive — some other user's process holds the pid)."""
+    as alive — some other user's process holds the pid).  A ZOMBIE is
+    dead: a SIGKILLed worker whose reaper is slow still answers
+    kill-0, and a launch agent adopting it as 'alive' would hold a
+    corpse's rank forever (found by the whole-host-kill soak)."""
     if pid <= 0:
         return False
     try:
@@ -51,6 +54,15 @@ def pid_alive(pid: int) -> bool:
         return True
     except OSError:
         return False
+    try:
+        with open(f"/proc/{int(pid)}/stat") as f:
+            # field 3 (after the parenthesized comm, which may itself
+            # contain spaces) is the state letter
+            state = f.read().rsplit(")", 1)[-1].split()
+        if state and state[0] == "Z":
+            return False
+    except (OSError, IndexError, ValueError):
+        pass  # no procfs: keep the kill-0 answer
     return True
 
 
@@ -143,8 +155,14 @@ class Journal:
     ``finish``    a directive completed (``idx``; job directives also
                   carry the final job record)
     ``spawn``     a worker process launched or re-adopted
-                  (``rank``/``pid``/``incarnation``/``adopted``) —
+                  (``rank``/``pid``/``incarnation``/``adopted``;
+                  ``host`` names the owning launch agent's host index
+                  on the multi-host DVM leg — the placement a
+                  restarted daemon routes liveness/respawn through) —
                   also un-retires the rank (a /scale restore)
+    ``agent``     a per-host launch agent spawned or re-adopted
+                  (``host``/``session``; informational — agent
+                  liveness is heartbeat-driven, not replayed)
     ``repair_pending``  a rank was respawned and its repair directive
                   is NOT yet finished (``rank``/``incarnation``) — a
                   daemon SIGKILLed between the respawn and the
@@ -231,7 +249,9 @@ class Journal:
             for r in sorted(replay["pids"]):
                 st = replay["pids"][r]
                 w("spawn", rank=int(r), pid=int(st.get("pid", 0)),
-                  incarnation=int(st.get("incarnation", 0)))
+                  incarnation=int(st.get("incarnation", 0)),
+                  **({"host": int(st["host"])}
+                     if st.get("host") is not None else {}))
             for r in sorted(replay.get("repairing", {})):
                 w("repair_pending", rank=int(r),
                   incarnation=int(replay["repairing"][r]))
@@ -344,6 +364,12 @@ class Journal:
                     pids[rank] = {
                         "pid": int(rec.get("pid", 0)),
                         "incarnation": int(rec.get("incarnation", 0))}
+                    if rec.get("host") is not None:
+                        # multi-host placement: the owning launch
+                        # agent's host index — a restarted daemon
+                        # routes this rank's liveness/respawn through
+                        # that agent instead of a local pid probe
+                        pids[rank]["host"] = int(rec["host"])
                     retired.discard(rank)  # /scale restore
                     clean = False
                 elif ev == "retire":

@@ -345,6 +345,21 @@ def _exec_script(jd: dict) -> None:
                 os.environ[k] = old
 
 
+def _revoke_quietly(job) -> None:
+    """ULFM hygiene for EVERY abort path (exception or nonzero
+    sys.exit): poison the aborted job comm so fellow members parked in
+    its collectives wake with the truth (MPIRevokedError) instead of
+    timing out their recv deadlines and falsely escalating a LIVE peer
+    — the false positive that wedged the multi-host repair behind a
+    60 s wait for a "respawn" of a rank that never died."""
+    if job is None:
+        return
+    try:
+        job.revoke()
+    except Exception:  # noqa: BLE001 — poisoned comm already
+        pass
+
+
 def _run_job(api, world, link: DaemonLink, jd: dict, idx: int) -> None:
     import ompi_tpu.serve as serve
     from ompi_tpu.metrics import core as mcore
@@ -367,6 +382,7 @@ def _run_job(api, world, link: DaemonLink, jd: dict, idx: int) -> None:
         if e.code not in (0, None):
             rec["ok"] = False
             rec["error"] = f"job script exited rc={e.code}"
+            _revoke_quietly(job)  # a nonzero exit aborts the gang too
     except _Stop:
         raise  # daemon-initiated stop outranks the job guard
     except BaseException as e:  # noqa: BLE001 — a job must never kill
@@ -374,6 +390,7 @@ def _run_job(api, world, link: DaemonLink, jd: dict, idx: int) -> None:
         # daemon sees the dead rank and queues the repair directive)
         rec["ok"] = False
         rec["error"] = f"{type(e).__name__}: {e}"
+        _revoke_quietly(job)
     finally:
         if api.in_job_scope():
             api.pop_world()
@@ -398,14 +415,22 @@ def _repair(api, world, link: DaemonLink, jd: dict, idx: int,
     ``replace()`` — the reborn incarnations rejoin through the beacon
     inside it — and adopt the healed world for future jobs."""
     dead = [int(d) for d in jd.get("dead", ())]
+    dead_ranks = {r for p in dead for r in range(*world.proc_range(p))}
     deadline = time.monotonic() + timeout
     while True:
         failed = set(world.get_failed())
         missing = [p for p in dead
                    if not (set(range(*world.proc_range(p))) & failed)]
-        if not missing:
+        # wait for the failed set to SETTLE to exactly the directive's
+        # dead procs: a false-positive mark on a live survivor (an
+        # aborted job's recv-deadline escalation) self-heals within
+        # about a heartbeat period, and entering replace() while it
+        # stands would await a respawn that never comes
+        if not missing and failed <= dead_ranks:
             break
         if time.monotonic() > deadline:
+            if not missing:
+                break  # extras never healed: best-effort repair
             link.report(idx, {
                 "ok": False,
                 "error": f"repair: procs {missing} never surfaced as "

@@ -539,6 +539,277 @@ def test_revoke_wakes_blocked_collective_recv():
         eng.close()
 
 
+# -- leadership transitions + relay failover ---------------------------
+
+
+def test_leadership_callback_fires_on_takeover():
+    """The successor that outlives its leader learns it IS the leader
+    within one heartbeat period — the on_leadership hook the telemetry
+    relay failover promotes through."""
+    eng = _StubEngine(proc=1, nprocs=4)
+    det = HeartbeatDetector(eng, period=0.05, timeout=120.0,
+                            group_size=4)
+    fired: list[bool] = []
+    try:
+        det.on_leadership(fired.append)
+        time.sleep(0.2)
+        assert fired == []  # rank 0 leads; no transition yet
+        det.mark_failed(0, gossip=False)
+        deadline = time.monotonic() + 5
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired == [True], fired
+        # the heal demotes: rank 0 comes back, leadership returns
+        det.clear_failed(0)
+        deadline = time.monotonic() + 5
+        while len(fired) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired == [True, False], fired
+    finally:
+        det.close()
+
+
+def test_note_incarnation_floor_prevents_fellow_reborn_remark():
+    """Multi-victim regression (found by the whole-host-kill soak): a
+    reborn detector seeded with a FELLOW reborn peer's incarnation
+    floor must read its current-incarnation heartbeats as liveness —
+    without the floor they look like a rebirth announcement and
+    falsely re-mark the peer."""
+    eng, det = _quiet_detector(proc=2, nprocs=4, group_size=4)
+    try:
+        # un-seeded: inc=1 heartbeat from peer 3 IS a rebirth detection
+        det.on_heartbeat(3, {"kind": "hb", "src": 3, "inc": 1})
+        assert 3 in det.failed()
+    finally:
+        det.close()
+    eng2, det2 = _quiet_detector(proc=2, nprocs=4, group_size=4)
+    try:
+        det2.note_incarnation(3, 1)  # the recovery beacon's floor
+        det2.on_heartbeat(3, {"kind": "hb", "src": 3, "inc": 1})
+        assert 3 not in det2.failed()
+        assert det2.counters["rebirth_detects"] == 0
+        # the floor does not mask a REAL later rebirth
+        det2.on_heartbeat(3, {"kind": "hb", "src": 3, "inc": 2})
+        assert 3 in det2.failed()
+    finally:
+        det2.close()
+
+
+def test_relay_failover_reregisters_and_member_refreshes():
+    """Relay failover end to end, in process, over a real KVS: the
+    leader's relay dies; the promoted successor re-registers
+    ``relay.g<i>`` (via live._promote_relay); the member publisher's
+    refresh hook re-reads the key on its next failed publish and
+    frames resume at the root — the handoff the old plane could not
+    make (members degraded to dropped frames for the rest of the
+    job)."""
+    from ompi_tpu.boot.kvs import KVSClient, KVSServer
+    from ompi_tpu.metrics import live
+
+    srv = KVSServer()
+    cli = KVSClient(srv.address)
+    agg = live.TelemetryAggregator(http_port=0)
+    rel1 = live.TelemetryRelay(agg.ingest_address, group_index=0,
+                               interval_ms=30)
+    cli.put("relay.g0", rel1.ingest_address)
+
+    def refresh():
+        try:
+            return str(cli.get("relay.g0", wait=False))
+        except (KeyError, ConnectionError, OSError):
+            return None
+
+    pub = live.TelemetryPublisher(rel1.ingest_address, proc=3,
+                                  nprocs=4, interval_ms=30,
+                                  refresh=refresh)
+
+    class _PC:  # the slice of ProcContext _promote_relay touches
+        pass
+
+    pc = _PC()
+    pc.kvs = cli
+    pc.ns = ""
+    old_relay = live._relay
+    try:
+        deadline = time.monotonic() + 10
+        while agg.frames < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agg.frames >= 2
+        rel1.close()  # the leader dies, relay with it
+        live._relay = None
+        live._promote_relay(True, pc, 0, agg.ingest_address, 30)
+        assert live._relay is not None
+        assert cli.get("relay.g0", wait=False) == \
+            live._relay.ingest_address  # re-registered
+        before = agg.frames
+        deadline = time.monotonic() + 10
+        while (agg.frames < before + 3 or not pub.refreshes) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert pub.refreshes >= 1 and agg.frames >= before + 3
+    finally:
+        pub.stop()
+        if live._relay is not None:
+            live._relay.close()
+        live._relay = old_relay
+        live._via_relay = False
+        agg.close()
+        cli.close()
+        srv.close()
+
+
+# -- native-plane sharded modex ----------------------------------------
+
+
+def test_native_sharded_modex_install_counters():
+    """np=4 native boot on ft_group_size=2 groups: every rank's eager
+    address installs (the new ``addr_installs`` counter) read <= group
+    size instead of P-1 — primed slots install at boot, cross-group
+    peers resolve lazily on first send (``addr_lazy_resolved`` /
+    the AddressTable's ``lazy_resolved``), and the collectives still
+    produce exact results (the worker asserts them)."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    from ompi_tpu.dcn import native as dcn_native
+
+    if not dcn_native.available():
+        pytest.skip("native toolchain unavailable")
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run(
+        [_sys.executable, "-m", "ompi_tpu", "run", "-np", "4",
+         "--cpu-devices", "1", "--mca", "btl", "native",
+         "--mca", "ft_group_size", "2",
+         str(repo / "tests" / "workers" / "mp_modex_worker.py")],
+        capture_output=True, timeout=240, cwd=str(repo), env=env)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    tallies = [json.loads(line.split("MODEX_TALLY ", 1)[1])
+               for line in out.splitlines() if "MODEX_TALLY" in line]
+    assert len(tallies) == 4, out
+    assert all(t["plane"] == "native" for t in tallies), tallies
+    for t in tallies:
+        assert t["addr_installs"] <= 2, t  # group size, never P-1=3
+    # somebody resolved a cross-group peer lazily
+    assert sum(t["addr_lazy_resolved"] for t in tallies) >= 1, tallies
+
+
+def test_c_revoke_wakes_parked_schedule_and_refuses_new():
+    """The C fast path's _check_revoked twin: a schedule receive
+    parked in cctx_recv_msg wakes the moment tdcn_coll_revoke_cid
+    poisons its comm (instead of waiting out the ~600 s give-up), and
+    new starts on the revoked view refuse before any frame moves."""
+    import ctypes
+    import threading
+
+    from ompi_tpu.dcn import native as dcn_native
+
+    if not dcn_native.available():
+        pytest.skip("native toolchain unavailable")
+    lib = dcn_native.load_library()
+    P, I, U64, S = (ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+                    ctypes.c_char_p)
+    lib.tdcn_coll_open.restype = U64
+    lib.tdcn_coll_open.argtypes = [P, S, I, I,
+                                   ctypes.POINTER(ctypes.c_char_p), U64]
+    lib.tdcn_coll_plan.restype = U64
+    lib.tdcn_coll_plan.argtypes = [P, U64, I, I, I, ctypes.c_int64,
+                                   I, I]
+    lib.tdcn_coll_start.restype = I
+    lib.tdcn_coll_start.argtypes = [P, U64, P, P]
+    lib.tdcn_coll_close.argtypes = [P, U64]
+    a = lib.tdcn_create(0, 2, b"hA", 0, 0, 0, 0)
+    b = lib.tdcn_create(1, 2, b"hB", 0, 0, 0, 0)
+    try:
+        aa = lib.tdcn_address(a)
+        ab = lib.tdcn_address(b)
+        lib.tdcn_set_addresses(a, aa + b"\n" + ab)
+        addrs = (ctypes.c_char_p * 2)(aa, ab)
+        cx = lib.tdcn_coll_open(a, b"4242", 0, 2, addrs, 0)
+        pl = lib.tdcn_coll_plan(a, cx, 0, 0, 7, 0, 0, -1)  # barrier
+        assert cx and pl
+        out: dict = {}
+
+        def park():
+            t0 = time.monotonic()
+            out["rc"] = lib.tdcn_coll_start(a, pl, None, None)
+            out["dt"] = time.monotonic() - t0
+
+        t = threading.Thread(target=park)
+        t.start()
+        time.sleep(0.3)  # let it park waiting on rank 1 (never comes)
+        lib.tdcn_coll_revoke_cid(a, b"4242")
+        t.join(timeout=15)
+        assert not t.is_alive(), "revoke did not wake the wait"
+        assert out["rc"] == -6 and out["dt"] < 10, out
+        # a revoked view refuses new starts before any frame moves
+        assert lib.tdcn_coll_start(a, pl, None, None) == -6
+        lib.tdcn_coll_close(a, cx)
+    finally:
+        lib.tdcn_close(a)
+        lib.tdcn_close(b)
+
+
+def test_c_address_change_invalidates_plans():
+    """replace()/incarnation bump: an address change for a C-coll
+    member evicts the view's compiled plans (a repaired comm cannot
+    replay a schedule built against the dead lineage) — the next plan
+    lookup re-compiles (sched_cache_misses ticks) instead of hitting
+    the stale entry."""
+    import ctypes
+
+    from ompi_tpu.dcn import native as dcn_native
+
+    if not dcn_native.available():
+        pytest.skip("native toolchain unavailable")
+    lib = dcn_native.load_library()
+    P, I, U64, S = (ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+                    ctypes.c_char_p)
+    lib.tdcn_coll_open.restype = U64
+    lib.tdcn_coll_open.argtypes = [P, S, I, I,
+                                   ctypes.POINTER(ctypes.c_char_p), U64]
+    lib.tdcn_coll_plan.restype = U64
+    lib.tdcn_coll_plan.argtypes = [P, U64, I, I, I, ctypes.c_int64,
+                                   I, I]
+    lib.tdcn_coll_close.argtypes = [P, U64]
+
+    def stats(h):
+        names = lib.tdcn_stats_names().decode().split(",")
+        buf = (ctypes.c_uint64 * len(names))()
+        n = lib.tdcn_stats(h, buf, len(names))
+        return dict(zip(names, list(buf[:n])))
+
+    a = lib.tdcn_create(0, 2, b"hA", 0, 0, 0, 0)
+    b = lib.tdcn_create(1, 2, b"hB", 0, 0, 0, 0)
+    try:
+        aa, ab = lib.tdcn_address(a), lib.tdcn_address(b)
+        lib.tdcn_set_addresses(a, aa + b"\n" + ab)
+        addrs = (ctypes.c_char_p * 2)(aa, ab)
+        cx = lib.tdcn_coll_open(a, b"77", 0, 2, addrs, 0)
+        pl1 = lib.tdcn_coll_plan(a, cx, 3, 1, 13, 32, 0, -1)
+        assert pl1
+        assert lib.tdcn_coll_plan(a, cx, 3, 1, 13, 32, 0, -1) == pl1
+        misses0 = stats(a)["sched_cache_misses"]
+        # proc 1's address changes (a reborn incarnation's endpoint —
+        # a synthetic string: the invalidation only compares, never
+        # dials, and a second proc-1 engine in ONE test process would
+        # collide on the (pid, proc)-named shm doorbell)
+        lib.tdcn_set_address_one(a, 1, ab + b"#reborn", 0)
+        pl2 = lib.tdcn_coll_plan(a, cx, 3, 1, 13, 32, 0, -1)
+        assert pl2 and pl2 != pl1, "stale plan survived the repair"
+        assert stats(a)["sched_cache_misses"] == misses0 + 1
+        lib.tdcn_coll_close(a, cx)
+    finally:
+        lib.tdcn_close(a)
+        lib.tdcn_close(b)
+
+
 # -- np=16 integration soak (slow; tier-1 runs the in-process units) --
 
 

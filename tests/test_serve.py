@@ -452,9 +452,10 @@ def test_tpud_ctl_dead_daemon_is_clean(tmp_path, capsys):
 class _Tpud:
     """Daemon-under-test: launch, URL discovery, log capture."""
 
-    def __init__(self, mca=(), np_=2):
+    def __init__(self, mca=(), np_=2, extra=()):
         cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
                "--daemon", "--cpu-devices", "1", "--mca", "btl", "tcp"]
+        cmd += list(extra)
         for k, v in mca:
             cmd += ["--mca", k, v]
         env = dict(os.environ)
@@ -863,3 +864,178 @@ def test_pipesafe_retarget_reaims_stdio():
     ps.write("found\n")
     ps.flush()
     assert sink.getvalue() == "found\n"
+
+
+# -- multi-host DVM (per-host launch agents over the rsh shim) ---------
+
+
+def test_journal_spawn_host_placement_roundtrip(tmp_path):
+    """Multi-host placement survives the journal: spawn events carry
+    the owning agent's host index, replay keeps it (the restarted
+    daemon's re-adopt-vs-respawn routing), and compaction re-emits
+    it."""
+    from ompi_tpu.serve.state import Journal
+
+    path = str(tmp_path / "j")
+    j = Journal(path)
+    j.append("spawn", rank=0, pid=111, incarnation=0)
+    j.append("spawn", rank=2, pid=222, incarnation=1, host=1)
+    j.close()
+    replay = Journal.replay(path)
+    assert "host" not in replay["pids"][0]
+    assert replay["pids"][2] == {"pid": 222, "incarnation": 1,
+                                 "host": 1}
+    Journal.compact(path, replay)
+    replay2 = Journal.replay(path)
+    assert replay2["pids"][2]["host"] == 1
+    assert "host" not in replay2["pids"][0]
+
+
+def test_tpud_2x2_emulated_hosts_restart_adoption_and_hostkill(tmp_path):
+    """The multi-host DVM acceptance, np=2x2 emulated hosts (hermetic
+    ``/bin/sh -c {cmd}`` rsh shim + fake hostnames — every rank is
+    REMOTE, owned by a per-host launch agent):
+
+    1. agents spawn the workers over the rsh leg and a 4-rank job
+       completes on the warm mesh;
+    2. daemon SIGKILL mid-job → the restarted daemon re-adopts the
+       AGENTS (serve.agent.adopt) and the workers (serve.adopt), the
+       in-flight job finishes across the crash, incarnations stay 0,
+       dials stay flat (nothing warm was lost);
+    3. whole-host kill (host 1's workers AND agent, SIGKILL) → the
+       daemon respawns the agent over rsh, the reborn agent reports
+       the corpses and spawns incarnation 1, the repair restores the
+       mesh, and a full-size job produces exact results while host
+       0's workers stay at zero reconnects/retry_dials;
+    4. clean shutdown: rc 0, no orphaned worker or agent processes.
+    """
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve import state as sstate
+
+    pidfile = str(tmp_path / "tpud.pid")
+    mca = (("serve_pidfile", pidfile),
+           ("serve_reattach_timeout", "30"),
+           ("serve_agent_timeout", "4"),
+           ("dcn_recv_timeout", "8"),
+           ("dcn_cts_timeout", "8"),
+           ("dcn_connect_timeout", "4"))
+    extra = ("--host", "fakehostA:2,fakehostB:2",
+             "--kvs-host", "127.0.0.1",
+             "--launch-agent", "/bin/sh -c {cmd}")
+
+    def _journal_pids(host=None):
+        # Journal.replay is the one decoder of the journal format —
+        # it already folds spawns to the last {pid, incarnation, host}
+        # per rank
+        from ompi_tpu.serve.state import Journal
+
+        return {int(r): int(st["pid"])
+                for r, st in Journal.replay(
+                    pidfile + ".journal")["pids"].items()
+                if int(st.get("pid", 0))
+                and (host is None or st.get("host") == host)}
+
+    d1 = _Tpud(mca=mca, np_=4, extra=extra)
+    d2 = None
+    all_pids: set[int] = set()
+    try:
+        # 1. agents own the spawns; a plain 4-rank job completes
+        ja = client.submit(d1.url, str(JOB), tenant="a", nprocs=4)
+        ra = client.wait(d1.url, ja["id"], timeout=150)
+        assert ra["state"] == "done", ra
+        assert "launch agent h0" in d1.out()
+        assert "launch agent h1" in d1.out()
+        all_pids |= set(_journal_pids().values())
+
+        # 2. SIGKILL the daemon mid-job; restart re-adopts agents AND
+        # workers and the in-flight job completes exactly once
+        jb = client.submit(d1.url, str(JOB), tenant="a", nprocs=4,
+                           env={"SERVE_SLEEP": "6"})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if client.status(d1.url, jb["id"]).get("state") == "running":
+                break
+            time.sleep(0.1)
+        os.kill(d1.proc.pid, 9)
+        d1.proc.wait(timeout=30)
+        d2 = _Tpud(mca=mca, np_=4, extra=extra)
+        rb = client.wait(d2.url, jb["id"], timeout=150)
+        assert rb["state"] == "done", rb
+        st = client.status(d2.url)
+        assert [int(st["procs"][str(r)]["incarnation"])
+                for r in range(4)] == [0, 0, 0, 0], st
+        assert sum(1 for l in d2.lines
+                   if "re-adopted agent" in l) == 2, d2.out()
+        assert sum(1 for l in d2.lines
+                   if "re-adopted rank" in l) == 4, d2.out()
+        assert all(rec["dials_before"] == rec["dials_after"]
+                   for rec in (rb.get("ranks") or {}).values()), rb
+
+        # 3. whole-host kill: a 2-rank gang job runs ON host 0 (ranks
+        # 0-1); SIGKILL host 0's agent + workers mid-collective — host
+        # 1 is a TRUE bystander (not in the gang, not killed)
+        jc = client.submit(d2.url, str(JOB), tenant="a", nprocs=2,
+                           env={"SERVE_ITERS": "4000"})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if client.status(d2.url, jc["id"]).get("state") == "running":
+                break
+            time.sleep(0.1)
+        time.sleep(1.0)  # land the kill mid-collective
+        js = json.loads(_scrape(d2.url, "/json"))
+        agent_pid = int(js["daemon"]["agents"]["0"]["pid"])
+        victims = _journal_pids(host=0)
+        # the hb-derived agent pid reads 0 before the first heartbeat
+        # folds — os.kill(0, 9) would SIGKILL this test's process group
+        assert agent_pid > 0 and len(victims) == 2, (agent_pid, victims)
+        all_pids |= set(_journal_pids().values()) | {agent_pid}
+        for p in list(victims.values()) + [agent_pid]:
+            try:
+                os.kill(p, 9)
+            except OSError:
+                pass
+        client.wait(d2.url, jc["id"], timeout=90)  # gang job fails
+        deadline = time.monotonic() + 150
+        healed = False
+        while time.monotonic() < deadline:
+            st = client.status(d2.url)
+            procs = st.get("procs") or {}
+            healed = bool(st.get("healthy")) and all(
+                procs.get(str(r), {}).get("status") == "active"
+                for r in range(4))
+            if healed:
+                break
+            time.sleep(0.3)
+        assert healed, (st, d2.out()[-3000:])
+        assert [int(st["procs"][str(r)]["incarnation"])
+                for r in range(4)] == [1, 1, 0, 0], st
+        assert any("respawning it" in l for l in d2.lines), d2.out()
+        jd = client.submit(d2.url, str(JOB), tenant="a", nprocs=4)
+        rd = client.wait(d2.url, jd["id"], timeout=150)
+        assert rd["state"] == "done", (rd, d2.out()[-3000:])
+        # bystander host 1: zero reconnects/retry_dials, ever — the
+        # host kill (and the repair) never perturbed its workers
+        for rec in (rd.get("ranks") or {}).values():
+            if int(rec.get("proc", -1)) >= 2:
+                c = rec.get("counters") or {}
+                assert int(c.get("reconnects", 0)) == 0, rec
+                assert int(c.get("retry_dials", 0)) == 0, rec
+        all_pids |= set(_journal_pids().values())
+
+        # 4. clean shutdown: rc 0, zero orphans
+        client.shutdown(d2.url)
+        rc = d2.proc.wait(timeout=90)
+        assert rc == 0, d2.out()[-2000:]
+        time.sleep(0.5)
+        orphans = [p for p in all_pids if sstate.pid_alive(p)]
+        assert not orphans, orphans
+    finally:
+        for d in (d1, d2):
+            if d is not None:
+                d.close()
+        for p in all_pids:
+            if sstate.pid_alive(p):
+                try:
+                    os.kill(p, 9)
+                except OSError:
+                    pass

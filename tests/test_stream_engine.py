@@ -101,6 +101,10 @@ _DISPATCH_TAIL = (
     "recv_into_placed",
 )
 
+#: PR 13 tail: sharded-modex address-install accounting (the np>=16
+#: native-boot proof reads addr_installs <= group size, not P-1)
+_MODEX_TAIL = ("addr_installs", "addr_lazy_resolved")
+
 
 def test_stats_tail_appended_not_reordered():
     native = _native()
@@ -115,7 +119,9 @@ def test_stats_tail_appended_not_reordered():
     assert tuple(names[1:1 + len(_FROZEN_V1_PREFIX)]) == _FROZEN_V1_PREFIX
     n0 = 1 + len(_FROZEN_V1_PREFIX)
     assert tuple(names[n0:n0 + len(_STREAM_TAIL)]) == _STREAM_TAIL
-    assert tuple(names[n0 + len(_STREAM_TAIL):]) == _DISPATCH_TAIL
+    n1 = n0 + len(_STREAM_TAIL)
+    assert tuple(names[n1:n1 + len(_DISPATCH_TAIL)]) == _DISPATCH_TAIL
+    assert tuple(names[n1 + len(_DISPATCH_TAIL):]) == _MODEX_TAIL
     assert mcore.NATIVE_STATS_VERSION == 1
     # gauges classified so monotonicity checks skip them
     assert {"stream_depth", "stream_inflight"} <= set(mcore.GAUGES)

@@ -392,6 +392,58 @@ def test_tpurun_partial_replace_repairs_members_only():
     assert t2["respawns"] == 0, t2
 
 
+def test_tpurun_nested_split_replace_queued_repairs():
+    """PR 11's two recorded partial-replace edges, np=3: the repaired
+    comm is a split OF a split (its group ranks are parent-relative —
+    only the comm-relative (proc, local-index) coordinate recipe can
+    rebuild it from the reborn's world), and ONE death poisons BOTH
+    the split and its nested child — the survivor queues two
+    (proc, incarnation, cid)-keyed repair recipes and the reborn rank
+    heals both via two ``replace_partial()`` calls.  Regression: the
+    old world-rank recipe rebuilt the wrong members for the nested
+    comm, and the old single-slot beacon key could only hold one
+    pending repair per reborn incarnation."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    worker = repo / "tests" / "workers" / "mp_nested_replace_worker.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "3", "--ft",
+           "--respawn", "--cpu-devices", "1",
+           "--mca", "btl", "tcp",
+           "--mca", "dcn_recv_timeout", "8",
+           "--mca", "dcn_cts_timeout", "8",
+           "--mca", "dcn_connect_timeout", "4",
+           str(worker)]
+    res = subprocess.run(cmd, capture_output=True, timeout=240,
+                         cwd=str(repo), env=env)
+    out = res.stdout.decode()
+    assert res.returncode == 0, f"{out}\n{res.stderr.decode()}"
+    tallies = {t["proc"]: t for t in (
+        json.loads(line.split("NESTED_TALLY ", 1)[1])
+        for line in out.splitlines() if "NESTED_TALLY" in line)}
+    assert set(tallies) == {0, 1, 2}, out
+    for p in (1, 2):
+        t = tallies[p]
+        assert t["participated"], t
+        # BOTH healed comms served exact phase-2 results — the nested
+        # child fully, the parent the queued second repair's proof
+        assert t["post_b"] == t["ops"] and t["post_a"] == 1, t
+        assert all(n.endswith(".replaced") for n in t["names"]), t
+    assert tallies[2]["incarnation"] == 1, tallies[2]
+    assert tallies[1]["respawns"] >= 1, tallies[1]
+    # the bystander proc never participated, never dialed anything
+    t0 = tallies[0]
+    assert not t0["participated"], t0
+    assert t0["reconnects"] == 0 and t0["retry_dials"] == 0, t0
+
+
 def test_replace_partial_guards():
     """Dispatch guards: a survivor (rejoined context) cannot call
     replace_partial — that is the reborn proc's rejoin — and a partial

@@ -1,0 +1,51 @@
+"""Native-plane sharded-modex boot worker (``tools/chaos.py --hosts``
+modex leg and the np=4 in-tier acceptance).
+
+Boots on whatever transport the ``btl`` var picked, runs two
+allreduces (first sends force lazy cross-group address resolution),
+and prints one ``MODEX_TALLY <json>`` line carrying the new
+``addr_installs`` / ``addr_lazy_resolved`` native counters plus the
+Python-side AddressTable signature — the proof that a native boot now
+does ≤ group-size eager installs instead of P−1.
+"""
+
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.metrics import core as mcore
+from ompi_tpu.op import SUM
+
+world = api.init()
+p, n = world.proc, world.size
+ctx = world.procctx
+table = world.dcn._root_engine().addresses
+boot_installs = {k: int(v) for k, v in (mcore.native_counters()
+                                        or {}).items()
+                 if k in ("addr_installs", "addr_lazy_resolved")}
+
+for i in range(2):
+    out = world.allreduce(np.full((world.local_size, 2), i + 1.0), SUM)
+    assert float(np.asarray(out)[0][0]) == (i + 1) * n, (i, out)
+
+counters = mcore.native_counters() or {}
+tally = {
+    "proc": p,
+    "nprocs": world.nprocs,
+    "plane": ("native" if world.dcn._root_engine().address.startswith(
+        "ntv:") else "python"),
+    "addr_installs": int(boot_installs.get("addr_installs", 0)),
+    "addr_lazy_resolved": int(counters.get("addr_lazy_resolved", 0)),
+    "table_lazy": int(getattr(table, "lazy_resolved", 0)),
+    "kvs_gets": int(ctx.kvs.ops.get("get", 0)),
+}
+print("MODEX_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
+
+api.finalize()
+print(f"OK modex proc={p}", flush=True)

@@ -1,0 +1,118 @@
+"""Nested-split partial-replace soak worker (np=3, ``tpurun --ft
+--respawn``) — the two PR 11/PR 10 recorded edges together:
+
+* **nested recipes**: the repaired communicator is a split OF a split
+  (``subB = subA.split(...)``), whose ``group.ranks`` are PARENT-
+  relative — the old world-rank recipe would rebuild the wrong
+  members; the comm-relative (proc, local-index) coordinates must
+  rebuild the right ones;
+* **queued repairs**: ONE death poisons BOTH ``subA`` and ``subB``;
+  the survivor repairs them in ascending-cid order and the reborn
+  rank heals both through two ``world.replace_partial()`` calls — the
+  (proc, incarnation, cid)-keyed beacon queue the old single-slot key
+  could not hold.
+
+Topology: world {0, 1, 2}; proc 0 is a NON-MEMBER bystander.
+``subA`` = procs {1, 2} (split color), ``subB`` = subA.split → the
+nested comm whose parent-relative ranks [0, 1] differ from its world
+ranks [1, 2].  Proc 2 SIGKILLs itself mid-phase on subB; survivor 1
+repairs subA then subB via ``replace()``; reborn 2 heals both via
+``replace_partial()`` twice; phase 2 runs exact allreduces on BOTH
+healed comms.  One ``NESTED_TALLY <json>`` line per survivor.
+"""
+
+import json
+import os
+import signal
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.api.comm import COLOR_UNDEFINED
+from ompi_tpu.core.errors import MPIProcFailedError, MPIRevokedError
+from ompi_tpu.op import SUM
+
+OPS = int(os.environ.get("NESTED_OPS", "4"))
+KILL_AT = int(os.environ.get("NESTED_KILL_AT", "2"))
+
+world = api.init()
+p = world.proc
+incarnation = world.procctx.incarnation
+assert world.nprocs == 3 and world.local_size == 1
+
+completed = 0
+post_a = post_b = 0
+participated = False
+sub_a = sub_b = None
+
+if world.respawned:
+    # reborn member: heal BOTH queued sub-comm repairs, in the
+    # ascending-cid order the survivor publishes them (subA first)
+    sub_a = world.replace_partial()
+    sub_b = world.replace_partial()
+    participated = True
+else:
+    subs = world.split([COLOR_UNDEFINED] if p == 0 else [0])
+    sub_a = subs[0]
+    if p >= 1:
+        participated = True
+        assert sub_a is not None and sub_a.size == 2, sub_a
+        # the NESTED split: subB's group.ranks are subA-relative
+        # ([0, 1]), NOT world ranks ([1, 2]) — the recipe regression
+        sub_b = sub_a.split([0])[0]
+        assert sub_b is not None and sub_b.size == 2
+        assert list(sub_b.group.ranks) == [0, 1], sub_b.group.ranks
+        assert [tuple(c) for c in sub_b._world_coords] == \
+            [(1, 0), (2, 0)], sub_b._world_coords
+        try:
+            for i in range(OPS):
+                if p == 2 and incarnation == 0 and i == KILL_AT:
+                    sys.stdout.flush()
+                    sys.stderr.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
+                out = sub_b.allreduce(np.full((1, 4), i + 1.0), SUM)
+                assert np.allclose(np.asarray(out), 2 * (i + 1.0)), out
+                completed = i + 1
+        except (MPIProcFailedError, MPIRevokedError) as e:
+            print(f"[nested] proc {p} caught {type(e).__name__} after "
+                  f"{completed} ops: {e}", file=sys.stderr, flush=True)
+            # one death, two poisoned sub-comms: repair in ascending
+            # cid order (creation order) — subA first, then subB
+            sub_a = sub_a.replace()
+            sub_b = sub_b.replace()
+    # p == 0: bystander — no membership, no participation, no traffic
+
+if participated:
+    for i in range(OPS):
+        out = sub_b.allreduce(np.full((1, 4), 100.0 + i), SUM)
+        assert np.allclose(np.asarray(out), 2 * (100.0 + i)), out
+        post_b = i + 1
+    # the OTHER healed comm must serve too (the queued second repair)
+    out = sub_a.allreduce(np.full((1, 4), 7.0), SUM)
+    assert np.allclose(np.asarray(out), 14.0), out
+    post_a = 1
+    assert sub_a.size == 2 and sub_b.size == 2
+
+st = getattr(getattr(world.dcn, "transport", None), "stats", None) or {}
+tally = {
+    "proc": p,
+    "incarnation": incarnation,
+    "participated": participated,
+    "completed": completed,
+    "post_a": post_a,
+    "post_b": post_b,
+    "ops": OPS,
+    "names": [getattr(sub_a, "name", ""), getattr(sub_b, "name", "")],
+    "respawns": int(st.get("respawns", 0)),
+    "reconnects": int(st.get("reconnects", 0)),
+    "retry_dials": int(st.get("retry_dials", 0)),
+}
+print("NESTED_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
+
+api.finalize()
+print(f"OK nested proc={p} incarnation={incarnation}", flush=True)

@@ -42,6 +42,10 @@ from ompi_tpu.op import SUM
 
 OPS = int(os.environ.get("SCALE_OPS", "6"))
 KILL_AT = int(os.environ.get("SCALE_KILL_AT", "3"))
+#: post-phase-2 idle seconds before finalize (the relay-failover leg
+#: scrapes the aggregator mid-job and needs the healed mesh to live
+#: long enough for post-failover telemetry frames to accumulate)
+LINGER = float(os.environ.get("SCALE_LINGER", "0"))
 VICTIMS = sorted(int(v) for v in
                  os.environ.get("SCALE_VICTIMS", "").split(",") if v)
 
@@ -126,5 +130,7 @@ tally = {
 }
 print("SCALE_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
 
+if LINGER > 0:
+    time.sleep(LINGER)
 api.finalize()
 print(f"OK scale proc={p} incarnation={incarnation}", flush=True)

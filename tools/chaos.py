@@ -440,12 +440,14 @@ JOB_WORKER = os.path.join(REPO, "tests", "workers",
                           "serve_job_worker.py")
 
 
-def _spawn_daemon(np_: int, mca: dict, timeout: float = 90.0):
+def _spawn_daemon(np_: int, mca: dict, timeout: float = 90.0,
+                  extra_args: list[str] | None = None):
     """Launch ``tpurun --daemon`` and return (proc, lines, ops_url)."""
     import threading
 
     cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
            "--daemon", "--cpu-devices", "1"]
+    cmd += list(extra_args or ())
     for k, v in mca.items():
         cmd += ["--mca", k, str(v)]
     env = dict(os.environ)
@@ -773,6 +775,365 @@ def run_repair_window_soak(np_: int, seed: int, extra_mca: list[str],
                     pass
 
 
+def run_hosts_soak(np_: int, hosts_n: int, seed: int,
+                   extra_mca: list[str], timeout: float,
+                   relay: bool = True, modex: bool = True) -> dict:
+    """The multi-host DVM headline: a tpud with an emulated host map
+    (the hermetic ``/bin/sh -c {cmd}`` rsh shim + fake hostnames
+    partitions the ranks into ``hosts_n`` fake hosts, one launch
+    agent each), a gang job running collectives across hosts 0–1, and
+    a SIGKILL of host 0 — workers AND agent — mid-collective.  The
+    heal must be agent-driven end to end: the daemon respawns the
+    agent over rsh, the reborn agent reports the corpses and spawns
+    the bumped incarnations, the repair directive restores the mesh,
+    and a full-size phase-2 job completes with exact results while
+    the BYSTANDER hosts' workers show zero reconnects/retry_dials.
+    ``relay`` adds the relay-failover leg (group-leader SIGKILL under
+    ``tpurun --ft --respawn`` with per-group telemetry relays: member
+    frames must keep flowing within the PR 11 detection bound);
+    ``modex`` adds the np≥16 native-plane sharded-boot leg (per-rank
+    eager ``addr_installs`` ≤ group size, vs P−1 before the
+    incremental-install surface)."""
+    import tempfile
+    import urllib.request
+
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve import state as _sstate
+
+    if np_ % hosts_n:
+        raise SystemExit(f"--hosts: np={np_} not divisible by "
+                         f"{hosts_n} hosts")
+    per = np_ // hosts_n
+    if hosts_n < 3:
+        raise SystemExit("--hosts needs >= 3 emulated hosts (kill one, "
+                         "gang a second, leave bystanders)")
+    tmp = tempfile.mkdtemp(prefix="tpud-hosts-")
+    pidfile = os.path.join(tmp, "tpud.pid")
+    host_arg = ",".join(f"fakehost{h}:{per}" for h in range(hosts_n))
+    mca = {
+        "btl": "tcp",
+        "serve_pidfile": pidfile,
+        "serve_agent_timeout": "4",
+        # generous deadlines, same reasoning as the --scale soak: an
+        # oversubscribed CPU box schedules 16+ resident workers late,
+        # and a recovery round's hub gather must outlive the slowest
+        # survivor's escape from the aborted gang collective — a tight
+        # recv deadline turns scheduler lag into cascade escalations
+        "ft_detector_timeout": "8",
+        "dcn_recv_timeout": "30",
+        "dcn_cts_timeout": "30",
+        "dcn_connect_timeout": "8",
+    }
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        mca[k] = v
+    t0 = time.time()
+    d = None
+    lines: list[str] = []
+    gang = list(range(2 * per))           # hosts 0 + 1
+    bystanders = list(range(2 * per, np_))  # hosts 2..N-1
+    try:
+        d, lines, url = _spawn_daemon(
+            np_, mca, timeout=120.0,
+            extra_args=["--host", host_arg, "--kvs-host", "127.0.0.1",
+                        "--launch-agent", "/bin/sh -c {cmd}"])
+        # phase 1: a long collective job ganged across hosts 0-1
+        ja = client.submit(url, JOB_WORKER, tenant="alice",
+                           nprocs=len(gang),
+                           env={"SERVE_ITERS": "4000"})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if client.status(url, ja["id"]).get("state") == "running":
+                break
+            time.sleep(0.1)
+        time.sleep(1.0)  # land the kill mid-collective, not mid-boot
+        with urllib.request.urlopen(url + "/json", timeout=5) as r:
+            js = json.loads(r.read().decode())
+        agents0 = js["daemon"]["agents"]
+        victim_pids = [int(agents0["0"]["pid"])]
+        victim_pids += [
+            int(st["pid"]) for st in _sstate.Journal.replay(
+                pidfile + ".journal")["pids"].values()
+            if st.get("host") == 0 and int(st.get("pid", 0))]
+        # the agent pid reads 0 until its first heartbeat folds, and
+        # os.kill(0, 9) would SIGKILL this soak's whole process group
+        victim_pids = [p for p in victim_pids if p > 0]
+        if len(victim_pids) < 1 + per:
+            raise SystemExit(
+                f"hosts soak: host-0 victim table incomplete "
+                f"({victim_pids}); agent heartbeat not folded yet?")
+        for p in victim_pids:
+            try:
+                os.kill(p, 9)
+            except OSError:
+                pass
+        print(f"hosts soak: SIGKILLed host 0 (agent + "
+              f"{len(victim_pids) - 1} workers) mid-collective",
+              flush=True)
+        ra = client.wait(url, ja["id"], timeout=90)
+        # heal: every rank active again, host-0 ranks at incarnation 1
+        # — and SETTLED (healthy with an idle queue on consecutive
+        # polls: a reborn worker that dies right after the first
+        # repair re-arms another respawn+repair cycle, and submitting
+        # into that window parks the job behind a busy mesh)
+        deadline = time.time() + 240
+        settled = 0
+        st: dict = {}
+        while time.time() < deadline:
+            st = client.status(url)
+            procs = st.get("procs") or {}
+            ok_now = (bool(st.get("healthy"))
+                      and not st.get("running")
+                      and all(procs.get(str(r), {}).get("status")
+                              == "active" for r in range(np_)))
+            settled = settled + 1 if ok_now else 0
+            if settled >= 4:
+                break
+            time.sleep(0.3)
+        if settled < 4:
+            sys.stderr.write("".join(lines[-80:]))
+            raise SystemExit(f"hosts soak: mesh never healed: "
+                             f"{st.get('procs')}")
+        incs = [int(st["procs"][str(r)]["incarnation"])
+                for r in range(np_)]
+        # phase 2: EXACT full-size results on the healed mesh (the
+        # job worker asserts every allreduce value internally)
+        jb = client.submit(url, JOB_WORKER, tenant="bob", nprocs=np_)
+        rb = client.wait(url, jb["id"], timeout=240)
+        # bystander hosts: their workers' process-lifetime transport
+        # counters must read ZERO reconnects/retry_dials — the host
+        # kill never perturbed them
+        noisy = []
+        for r, rec in (rb.get("ranks") or {}).items():
+            if int(rec.get("proc", -1)) in bystanders:
+                c = rec.get("counters") or {}
+                if int(c.get("reconnects", 0)) or int(
+                        c.get("retry_dials", 0)):
+                    noisy.append(int(rec["proc"]))
+        client.shutdown(url)
+        rc = d.wait(timeout=90)
+        time.sleep(0.5)
+        orphans = [p for p in victim_pids[1:] if _sstate.pid_alive(p)]
+        tally = {
+            "np": np_, "hosts": hosts_n, "killed_host": 0,
+            "agent_respawned": sum(
+                1 for line in lines if "respawning it" in line),
+            "incarnations": incs,
+            "jobs": {"gang": ra["state"], "full": rb["state"]},
+            "bystanders_noisy": sorted(noisy),
+            "shutdown_rc": rc,
+            "orphans": len(orphans),
+        }
+        ok = (tally["agent_respawned"] >= 1 and rb["state"] == "done"
+              and incs == [1] * per + [0] * (np_ - per)
+              and not noisy and rc == 0 and not orphans)
+        if not ok:
+            sys.stderr.write("".join(lines[-120:]))
+            errs = {r: rec.get("error") for r, rec in
+                    (rb.get("ranks") or {}).items()
+                    if not rec.get("ok")}
+            raise SystemExit(f"hosts soak failed: {tally}\n"
+                             f"phase-2 errors: {errs}")
+        print(f"hosts soak: np={np_} hosts={hosts_n} "
+              f"wall={time.time() - t0:.1f}s")
+    finally:
+        if d is not None and d.poll() is None:
+            d.kill()
+    if relay:
+        tally["relay_failover"] = run_relay_failover_leg(
+            max(8, 2 * per), seed, extra_mca, timeout)
+    if modex:
+        tally["modex"] = run_native_modex_leg(np_, per, timeout)
+    return tally
+
+
+def run_relay_failover_leg(np_: int, seed: int, extra_mca: list[str],
+                           timeout: float) -> dict:
+    """Relay failover under real process death: ``tpurun --ft
+    --respawn`` with per-group telemetry relays, SIGKILL of a group
+    LEADER mid-job (its relay dies with it).  The group's members
+    must re-dial the deterministically promoted successor's relay —
+    asserted from the aggregator: the bystander member's frames keep
+    arriving with a bounded gap (PR 11 detection bound + a few
+    publish intervals), and batched relay traffic resumes."""
+    import math
+    import re
+    import threading
+    import urllib.request
+
+    period = 0.25
+    group = max(2, np_ // 2)
+    groups = math.ceil(np_ / group)
+    victim = group  # leader of group 1 (rank 0 carries the exit code)
+    mca = {
+        "btl": "tcp",
+        "ft_group_size": str(group),
+        "ft_detector_period": str(period),
+        "ft_detector_timeout": str(max(6.0, 24 * period)),
+        "telemetry_enable": "1",
+        "telemetry_relay": "1",
+        "telemetry_interval_ms": "200",
+        "dcn_recv_timeout": "30",
+        "dcn_cts_timeout": "30",
+        "dcn_connect_timeout": "8",
+    }
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        mca[k] = v
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--ft", "--respawn", "--cpu-devices", "1"]
+    for k, v in mca.items():
+        cmd += ["--mca", k, v]
+    cmd.append(SCALE_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env["SCALE_OPS"] = "8"
+    env["SCALE_KILL_AT"] = "4"
+    env["SCALE_VICTIMS"] = str(victim)
+    # keep the healed mesh alive so post-failover frames accumulate
+    # (the scrape loop measures the member's inter-frame gaps)
+    env["SCALE_LINGER"] = "8"
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    lines: list[str] = []
+
+    def _rd():
+        for raw in iter(proc.stdout.readline, b""):
+            lines.append(raw.decode(errors="replace"))
+
+    threading.Thread(target=_rd, daemon=True).start()
+    url = None
+    deadline = time.time() + 90
+    while time.time() < deadline and url is None:
+        for line in list(lines):
+            m = re.search(r"telemetry: (http://[^/]+)/metrics", line)
+            if m:
+                url = m.group(1)
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    if url is None:
+        sys.stderr.write("".join(lines))
+        raise SystemExit("relay leg: tpurun never printed the "
+                         "telemetry URL")
+    # scrape /json continuously; record the bystander member's frame
+    # timestamps (a member of the victim's group that must fail over).
+    # Bounded by the caller's timeout — a wedged job (exactly the
+    # regression class this leg hunts) must fail loudly, not hang the
+    # soak
+    member = victim + 1
+    stamps: list[int] = []
+    batches: list[int] = []
+    scrape_deadline = time.time() + float(timeout)
+    while proc.poll() is None:
+        if time.time() > scrape_deadline:
+            proc.kill()
+            sys.stderr.write("".join(lines[-80:]))
+            raise SystemExit(
+                f"relay leg: job still running after {timeout}s")
+        try:
+            with urllib.request.urlopen(url + "/json", timeout=2) as r:
+                js = json.loads(r.read().decode())
+            f = (js.get("procs") or {}).get(str(member))
+            if f and (not stamps or f["ts_ns"] != stamps[-1]):
+                stamps.append(int(f["ts_ns"]))
+            batches.append(int((js.get("relays") or {})
+                               .get("batches", 0)))
+        except OSError:
+            pass
+        time.sleep(0.1)
+    rc = proc.wait()
+    if rc != 0:
+        sys.stderr.write("".join(lines))
+        raise SystemExit(f"relay leg: job failed rc={rc}")
+    gaps = [(b - a) / 1e9 for a, b in zip(stamps, stamps[1:])]
+    worst = max(gaps) if gaps else 0.0
+    # bound: detection (2·period·ceil(log2 groups)) + respawn/boot
+    # noise + a few publish intervals — generous but catches the old
+    # behavior (members degrade to dropped frames for the REST OF THE
+    # JOB, a gap bounded only by job length)
+    bound = (2 * period * max(1, math.ceil(math.log2(max(2, groups))))
+             + 15.0)
+    tally = {"np": np_, "victim": victim, "member_frames": len(stamps),
+             "worst_gap_s": round(worst, 3), "bound_s": bound,
+             "batches": batches[-1] if batches else 0}
+    if len(stamps) < 4 or worst > bound:
+        sys.stderr.write("".join(lines[-60:]))
+        raise SystemExit(f"relay-failover leg failed: {tally}")
+    print(f"relay failover: member {member} frames kept flowing "
+          f"across the leader kill (worst gap {worst:.2f}s, bound "
+          f"{bound:.1f}s) wall={time.time() - t0:.1f}s")
+    return tally
+
+
+MODEX_WORKER = os.path.join(REPO, "tests", "workers",
+                            "mp_modex_worker.py")
+
+
+def run_native_modex_leg(np_: int, group: int, timeout: float) -> dict:
+    """np≥16 native-plane sharded boot: every rank's eager address
+    installs (the new ``addr_installs`` counter) must be ≤ its group
+    size — the tdcn_set_addresses incremental-install surface — where
+    the old full-table eager push did P−1."""
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--cpu-devices", "1",
+           "--mca", "btl", "native",
+           "--mca", "ft_group_size", str(group)]
+    cmd.append(MODEX_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    res = subprocess.run(cmd, capture_output=True, timeout=timeout,
+                         env=env, cwd=REPO)
+    out_text = res.stdout.decode(errors="replace")
+    if res.returncode != 0:
+        sys.stderr.write(out_text)
+        sys.stderr.write(res.stderr.decode(errors="replace"))
+        raise SystemExit(f"modex leg failed (rc={res.returncode})")
+    tallies = []
+    for line in out_text.splitlines():
+        if "MODEX_TALLY " in line:
+            tallies.append(json.loads(line.split("MODEX_TALLY ", 1)[1]))
+    if len(tallies) != np_:
+        sys.stderr.write(out_text)
+        raise SystemExit(f"modex leg: {len(tallies)}/{np_} tallies")
+    bad = [t for t in tallies
+           if t["plane"] == "native" and t["addr_installs"] > group]
+    if bad:
+        raise SystemExit(
+            f"modex leg: eager installs exceed group size: {bad}")
+    installs = [t["addr_installs"] for t in tallies]
+    print(f"native modex: np={np_} group={group} per-rank eager "
+          f"installs max={max(installs)} (<= {group}; eager would be "
+          f"{np_ - 1}) lazy="
+          f"{sum(t['addr_lazy_resolved'] for t in tallies)} "
+          f"wall={time.time() - t0:.1f}s")
+    return {"max_installs": max(installs),
+            "lazy": sum(t["addr_lazy_resolved"] for t in tallies)}
+
+
+def render_hosts(tally: dict) -> None:
+    print(f"  agent respawns: {tally['agent_respawned']}   "
+          f"incarnations: {tally['incarnations']}")
+    print("  jobs: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(tally["jobs"].items()))
+        + f"   bystanders noisy: {tally['bystanders_noisy'] or 'none'}")
+    print(f"  shutdown rc={tally['shutdown_rc']}   orphans: "
+          f"{tally['orphans']}")
+    if "relay_failover" in tally:
+        rf = tally["relay_failover"]
+        print(f"  relay failover: worst member gap "
+              f"{rf['worst_gap_s']}s (bound {rf['bound_s']:.1f}s), "
+              f"{rf['member_frames']} frames, {rf['batches']} batches")
+    if "modex" in tally:
+        mx = tally["modex"]
+        print(f"  native modex: max eager installs "
+              f"{mx['max_installs']}, lazy resolves {mx['lazy']}")
+
+
 def render_repair_window(tally: dict) -> None:
     print(f"  repair_pending journaled: "
           f"{tally['repair_pending_journaled']}   repairs completed "
@@ -991,13 +1352,92 @@ def selftest() -> int:
         rel.close()
         agg.close()
 
+    # 10. agent protocol units: adopt-table parsing, the agentkill
+    # grammar (site agent, deterministic per seed), the zombie rule
+    # (a SIGKILLed worker mid-reap must read DEAD or an agent would
+    # adopt a corpse), and the daemon-side stale-incarnation guard
+    from ompi_tpu.serve.agent import _parse_adopt
+    from ompi_tpu.serve import state as sstate
+
+    assert _parse_adopt("2:123:1,3:456:0") == {2: (123, 1),
+                                               3: (456, 0)}
+    assert _parse_adopt("garbage") == {} and _parse_adopt("") == {}
+    rules = fsim.parse_plan("agentkill:at=2")
+    assert rules[0].kind == "agentkill" and rules[0].site == "agent"
+    pa = fsim.FaultPlan(rules, seed=9, proc=1001)
+    hits = [bool(pa.decide("agent")) for _ in range(4)]
+    assert hits == [False, True, False, False], hits
+    assert not sstate.pid_alive(0) and not sstate.pid_alive(-1)
+    from ompi_tpu.serve.daemon import _RemoteProc
+
+    class _StubDaemon:
+        def __init__(self):
+            self.state = None
+            self.killed = []
+
+        def _agent_worker_state(self, hid, rank):
+            return self.state
+
+        def _agent_kill(self, hid, rank, sig):
+            self.killed.append((rank, sig))
+
+    sd = _StubDaemon()
+    rp = _RemoteProc(sd, 2, 0, incarnation=1)
+    assert rp.poll() is None            # agent has not reported yet
+    sd.state = {"pid": 99, "incarnation": 0, "alive": False, "rc": 1}
+    assert rp.poll() is None            # stale table: prior lineage
+    sd.state = {"pid": 101, "incarnation": 1, "alive": True, "rc": 0}
+    assert rp.poll() is None and rp.pid == 101
+    sd.state = {"pid": 101, "incarnation": 1, "alive": False, "rc": 7}
+    assert rp.poll() == 7
+    rp.terminate()
+    assert sd.killed and sd.killed[0][0] == 2
+
+    # 11. relay failover in-process: the leader relay dies mid-flight;
+    # the promoted successor registers a replacement and the member's
+    # pump re-aims through its refresh hook — frames keep arriving
+    from ompi_tpu.metrics.live import TelemetryPublisher
+
+    agg2 = TelemetryAggregator(http_port=0)
+    rel1 = TelemetryRelay(agg2.ingest_address, group_index=0,
+                          interval_ms=30)
+    registry = {"addr": rel1.ingest_address}
+    pub = TelemetryPublisher(rel1.ingest_address, proc=5, nprocs=8,
+                             interval_ms=30,
+                             refresh=lambda: registry["addr"])
+    rel2 = None
+    try:
+        deadline = time.time() + 10
+        while agg2.frames < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert agg2.frames >= 2, agg2.frames
+        rel1.close()  # the leader (and its relay) dies
+        rel2 = TelemetryRelay(agg2.ingest_address, group_index=0,
+                              interval_ms=30)
+        registry["addr"] = rel2.ingest_address  # the re-registration
+        before = agg2.frames
+        deadline = time.time() + 10
+        while (agg2.frames < before + 3 or not pub.refreshes) \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert pub.refreshes >= 1, pub.refreshes
+        assert agg2.frames >= before + 3, (before, agg2.frames)
+    finally:
+        pub.stop()
+        if rel2 is not None:
+            rel2.close()
+        agg2.close()
+
     print("selftest OK: plan grammar, seeded determinism (400-event "
           "streams), reconnect healing (8/8 delivered, "
           f"{tx.stats['reconnects']} reconnect), exactly-once dedup "
           f"(32/32 delivered, {dups} duplicates dropped), detector "
           "clear_failed, disabled-path state, hierarchical topology "
           "+ takeover, versioned gossip (stale flr dropped), "
-          "get_prefix + lazy AddressTable, relay batching")
+          "get_prefix + lazy AddressTable, relay batching, agent "
+          "protocol (adopt parse, agentkill schedule, zombie rule, "
+          "stale-incarnation guard), relay failover (member re-dialed "
+          f"the successor's relay after {pub.refreshes} refresh)")
     return 0
 
 
@@ -1036,6 +1476,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="daemonkill directive index for "
                     "--daemon-restart (default 2: mid-job for the "
                     "first submission)")
+    ap.add_argument("--hosts", type=int, default=0, metavar="N",
+                    help="multi-host DVM soak: a tpud with N emulated "
+                    "hosts (hermetic rsh shim + fake hostnames, one "
+                    "launch agent each), SIGKILL of one whole host "
+                    "(workers + agent) mid-collective, agent-driven "
+                    "respawn + replace, exact full-size phase-2, "
+                    "bystander hosts at zero reconnects/dials; plus "
+                    "the relay-failover and np>=16 native sharded-"
+                    "modex legs")
+    ap.add_argument("--no-relay-leg", action="store_true",
+                    help="--hosts: skip the relay-failover leg")
+    ap.add_argument("--no-modex-leg", action="store_true",
+                    help="--hosts: skip the native sharded-modex leg")
     ap.add_argument("--kill-in-repair", action="store_true",
                     help="crash-mid-repair soak: the daemonkill lands "
                     "on the REPAIR directive's publish (site "
@@ -1065,6 +1518,30 @@ def main(argv: list[str] | None = None) -> int:
     ns = ap.parse_args(argv)
     if ns.selftest:
         return selftest()
+    if ns.hosts:
+        baseline = None
+        for run in range(ns.runs):
+            tally = run_hosts_soak(
+                ns.np_, ns.hosts, ns.seed, ns.mca, ns.timeout,
+                relay=not ns.no_relay_leg and run == 0,
+                modex=not ns.no_modex_leg and run == 0)
+            render_hosts(tally)
+            # the structural tally is the determinism contract (the
+            # relay/modex legs carry wall-clock and run once)
+            shape = {k: tally[k] for k in
+                     ("np", "hosts", "killed_host", "incarnations",
+                      "jobs", "bystanders_noisy", "shutdown_rc",
+                      "orphans")}
+            if baseline is None:
+                baseline = shape
+            elif shape != baseline:
+                raise SystemExit(
+                    f"DETERMINISM VIOLATION: run {run + 1} shape "
+                    f"{shape} != run 1 {baseline} (seed {ns.seed})")
+            elif ns.runs > 1:
+                print(f"run {run + 1}: hosts tally reproduces run 1 "
+                      f"exactly (seed {ns.seed})")
+        return 0
     if ns.scale:
         baseline = None
         for run in range(ns.runs):

@@ -95,6 +95,22 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               + (f"  ADOPTING {adopting}" if adopting else "")
               + ("  DRAINING" if daemon.get("draining") else ""),
               file=out)
+        agents = daemon.get("agents") or {}
+        if agents:
+            # multi-host DVM line: one launch agent per remote host —
+            # its health (heartbeat age), session, and how many of
+            # its workers it currently reports alive
+            parts = []
+            for hid in sorted(agents, key=int):
+                ag = agents[hid]
+                n = len(ag.get("ranks") or ())
+                parts.append(
+                    f"h{hid}({ag.get('host', '?')}) "
+                    f"{ag.get('status', '?')} "
+                    f"{ag.get('alive_workers', 0)}/{n}w "
+                    f"hb {ag.get('hb_age_ms', 0):.0f}ms "
+                    f"{ag.get('session', '')}")
+            print("agents: " + "   ".join(parts), file=out)
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
           f"{'sdep':>5}{'coal':>6}{'sched':>6}"
@@ -285,7 +301,11 @@ def selftest() -> int:
             "pid": 4242, "generation": 2, "crash_safe": True,
             "queued": 1, "outstanding": 2, "journal_depth": 3,
             "adopting": [1], "procs": {"0": "active", "1": "adopting"},
-            "draining": False}}
+            "draining": False,
+            "agents": {"1": {"host": "fakehostB", "status": "active",
+                             "session": "g2s1", "ranks": [2, 3],
+                             "pid": 777, "hb_age_ms": 321.0,
+                             "alive_workers": 2, "spawns": 2}}}}
         dstate = fetch(agg.url)
         assert dstate["daemon"]["generation"] == 2, dstate
         buf = io.StringIO()
@@ -294,6 +314,9 @@ def selftest() -> int:
         assert ("daemon: pid 4242 gen 2 crash-safe" in dtext
                 and "journal 3" in dtext
                 and "ADOPTING [1]" in dtext), dtext
+        # multi-host DVM: the per-host agent-health line
+        assert ("agents: h1(fakehostB) active 2/2w hb 321ms g2s1"
+                in dtext), dtext
         agg.extra_state = None
         # /history serves the JSONL ring
         with urllib.request.urlopen(agg.url + "/history",
