@@ -545,6 +545,73 @@ def osu_bw_sweep_rows() -> dict:
     return out
 
 
+def device_plane_rows() -> dict:
+    """The third-DCN-plane leg: osu_bw / osu_allreduce sweeps with the
+    device-resident zero-copy plane ON vs OFF (tools/
+    bench_device_plane.py, np=2 over the Python btl so both the p2p
+    and coll arbitration sites run).  On the CPU-emulation path this
+    proves END-TO-END operation and the plane-arbitration counters
+    (large contiguous sends took the device plane at >= 1 MiB; small
+    and non-contiguous traffic stayed host-side); the real gate —
+    device beats the host ring at >= 1 MiB for both osu_bw and
+    osu_allreduce — is TPU-only and recorded as skipped on CPU."""
+    import jax as _jax
+
+    script = str(REPO / "tools" / "bench_device_plane.py")
+    legs = {}
+    for mode, mca in (("device", {"btl": "tcp"}),
+                      ("host", {"btl": "tcp", "dcn_device_enable": "0"})):
+        text = _run_tpurun(2, script, mca=mca, timeout=600)
+        for line in text.splitlines():
+            if "DEVBENCH " in line and "DEVBENCH_PEER" not in line:
+                legs[mode] = json.loads(line.split("DEVBENCH ", 1)[1])
+                break
+        else:
+            raise RuntimeError(f"no DEVBENCH line ({mode}):\n{text[-2000:]}")
+    dev, host = legs["device"], legs["host"]
+    st = dev.get("stats") or {}
+    min_size = int(dev.get("min_size") or (1 << 20))
+    # CPU-emulation acceptance: arbitration proven by counters
+    arb_ok = (st.get("device_sends", 0) >= 1
+              and st.get("device_bytes_placed", 0) >= min_size
+              and st.get("device_arb_device", 0) >= 1
+              and st.get("device_arb_host", 0) >= 1)
+    if not arb_ok:
+        raise RuntimeError(f"device-plane arbitration counters missing "
+                           f"or wrong: {st}")
+    if host.get("stats"):
+        raise RuntimeError(f"host leg ran with the plane armed: "
+                           f"{host.get('stats')}")
+    host_by = {r["bytes"]: r for r in host.get("rows", [])}
+    rows = []
+    for r in dev.get("rows", []):
+        h = host_by.get(r["bytes"], {})
+        row = dict(r)
+        if h.get("bw_MBs"):
+            row["bw_vs_host"] = round(r["bw_MBs"] / h["bw_MBs"], 3)
+        if h.get("allreduce_us") and r.get("allreduce_us"):
+            row["allreduce_vs_host"] = round(
+                h["allreduce_us"] / r["allreduce_us"], 3)
+        rows.append(row)
+    try:
+        platform = _jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        platform = "cpu"
+    on_tpu = platform == "tpu"
+    gate = {"criterion": "device >= host ring for osu_bw AND "
+                         "osu_allreduce at >= 1 MiB",
+            "skipped": not on_tpu, "passed": None}
+    if on_tpu:
+        big = [r for r in rows if r["bytes"] >= (1 << 20)]
+        gate["passed"] = bool(big) and all(
+            r.get("bw_vs_host", 0) >= 1.0
+            and r.get("allreduce_vs_host", 0) >= 1.0 for r in big)
+        if not gate["passed"]:
+            raise RuntimeError(f"device-plane TPU gate failed: {rows}")
+    return {"np": 2, "min_size": min_size, "rows": rows,
+            "device_counters": st, "tpu_gate": gate}
+
+
 def _tool_rows(script: str, marker: str, timeout: int = 900) -> dict:
     """Run a tools/ bench script in a subprocess and parse its single
     ``MARKER {json}`` stdout line (the shared contract of the cpu8
@@ -790,6 +857,7 @@ def main() -> None:
                         ("capi_p2p", capi_p2p_rows),
                         ("osu_bw_sweep", osu_bw_sweep_rows),
                         ("dispatch_floor", dispatch_floor_rows),
+                        ("device_plane", device_plane_rows),
                         ("algos_cpu8", algos_cpu8_rows),
                         ("hostpath_cpu8", hostpath_cpu8_rows),
                         ("serve", serve_rows)):

@@ -1,0 +1,42 @@
+/* devsum — deterministic MPI_SUM allreduce digest (the cross-plane
+ * bit-exactness probe).  Every rank fills a double buffer from an
+ * integer-derived formula (exact in IEEE double, so C and numpy agree
+ * bit-for-bit), allreduces with MPI_SUM, and prints an order-
+ * independent content digest (xor + wrapping sum of the uint64 words).
+ * The Python-plane twin (tests/workers/mp_device_worker.py) computes
+ * the same inputs and digest: equal lines prove the C fast path, the
+ * Python host plane, and the device plane produce bit-identical
+ * MPI_SUM results.
+ *
+ * usage: devsum [count]   (default 262144 doubles = 2 MiB)
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  long long count = argc > 1 ? atoll(argv[1]) : 262144;
+  double *x = (double *)malloc((size_t)count * sizeof(double));
+  double *out = (double *)malloc((size_t)count * sizeof(double));
+  for (long long i = 0; i < count; i++)
+    x[i] = (double)((i * 2654435761ll + 7919ll * (rank + 1)) % 1000003ll)
+           * 0.5;
+  MPI_Allreduce(x, out, (int)count, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+  unsigned long long xo = 0, su = 0;
+  unsigned long long w;
+  for (long long i = 0; i < count; i++) {
+    memcpy(&w, &out[i], 8);
+    xo ^= w;
+    su += w;
+  }
+  printf("DEVSUM rank=%d size=%d xor=%llx sum=%llx\n", rank, size, xo, su);
+  free(x);
+  free(out);
+  MPI_Finalize();
+  return 0;
+}

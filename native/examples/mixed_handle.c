@@ -1,0 +1,81 @@
+/* mixed_handle — the handle-heterogeneous collective regression.
+ *
+ * MPI only requires type-SIGNATURE equality across ranks: rank 0
+ * passes the predefined MPI_DOUBLE handle while every other rank
+ * passes a committed contiguous derived equivalent
+ * (MPI_Type_contiguous(1, MPI_DOUBLE)).  Routing keys on the LOCAL
+ * handle, so without the schedule-build agreement rank 0 would take
+ * the C fast path while its peers run the Python plane — a silent
+ * plane split that deadlocks the communicator.  With the guard, the
+ * agreement forces EVERY rank onto the Python plane and the program
+ * completes with exact results.
+ */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  const int count = 4096;
+  double x[4096], out[4096];
+  for (int i = 0; i < count; i++) x[i] = (double)(rank + 1);
+  MPI_Datatype dt = MPI_DOUBLE;
+  if (rank != 0) {
+    MPI_Type_contiguous(1, MPI_DOUBLE, &dt);
+    MPI_Type_commit(&dt);
+  }
+  MPI_Allreduce(x, out, count, dt, MPI_SUM, MPI_COMM_WORLD);
+  double want = (double)size * (double)(size + 1) / 2.0;
+  int ok = 1;
+  for (int i = 0; i < count; i++)
+    if (out[i] != want) ok = 0;
+  /* a second mixed-handle collective reuses the cached verdict */
+  MPI_Allreduce(x, out, count, dt, MPI_SUM, MPI_COMM_WORLD);
+  for (int i = 0; i < count; i++)
+    if (out[i] != want) ok = 0;
+  /* homogeneous-handle traffic on the same comm keeps working (and,
+   * at a different signature, may still take the C plane) */
+  double y = (double)rank, ysum = 0.0;
+  MPI_Allreduce(&y, &ysum, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+  if (ysum != (double)(size * (size - 1)) / 2.0) ok = 0;
+  /* nonblocking mixed-handle: the I* fallback paths publish their
+   * plane class too (a fresh signature — the count differs — forces
+   * a fresh agreement; without the publish, rank 0 parks in the
+   * schedule-build wait for the full recv deadline).  The wall-clock
+   * bound is what fails when a publisher goes missing: the program
+   * still completes, just deadline-paced. */
+  MPI_Request req;
+  double t0 = MPI_Wtime();
+  MPI_Iallreduce(x, out, count / 2, dt, MPI_SUM, MPI_COMM_WORLD, &req);
+  MPI_Wait(&req, MPI_STATUS_IGNORE);
+  for (int i = 0; i < count / 2; i++)
+    if (out[i] != want) ok = 0;
+  if (MPI_Wtime() - t0 > 60.0) ok = 0;
+  if (rank != 0) MPI_Type_free(&dt);
+  /* asymmetric fallback REASON at one signature: every rank's
+   * RECVTYPE is the predefined MPI_DOUBLE (fast-path-eligible), but
+   * ranks != 0 pass a derived SENDTYPE — a legal matching-signature
+   * call that keeps them on the capi plane for a reason other than a
+   * derived recv handle.  They must still publish, or rank 0 stalls
+   * in the agreement. */
+  if (size <= 64) {
+    double ag_in = (double)(rank + 1), ag_out[64];
+    MPI_Datatype sdt = MPI_DOUBLE;
+    if (rank != 0) {
+      MPI_Type_contiguous(1, MPI_DOUBLE, &sdt);
+      MPI_Type_commit(&sdt);
+    }
+    t0 = MPI_Wtime();
+    MPI_Allgather(&ag_in, 1, sdt, ag_out, 1, MPI_DOUBLE, MPI_COMM_WORLD);
+    for (int p = 0; p < size; p++)
+      if (ag_out[p] != (double)(p + 1)) ok = 0;
+    if (MPI_Wtime() - t0 > 60.0) ok = 0;
+    if (rank != 0) MPI_Type_free(&sdt);
+  }
+  printf("MIXED %s rank=%d size=%d\n", ok ? "PASS" : "FAIL", rank, size);
+  MPI_Finalize();
+  return ok ? 0 : 1;
+}

@@ -250,6 +250,20 @@ enum TdcnStatIdx {
                          // tdcn_set_addresses slots + replace updates)
   TS_ADDR_LAZY,          // peer addresses resolved lazily on first use
                          // (the AddressTable callback / C resolver)
+  // -- device-plane tail (appended; version stays 1) ------------------
+  // The device-resident zero-copy DCN plane lives in Python
+  // (ompi_tpu/dcn/device.py) and maintains these through its own
+  // metrics provider; the C block carries zeroed slots so
+  // TDCN_STAT_NAMES stays the single source of schema truth
+  // (abidrift: stat-names-drift).
+  TS_DEVICE_SENDS,
+  TS_DEVICE_RECVS,
+  TS_DEVICE_BYTES_PLACED,
+  TS_DEVICE_DMA_WAITS,
+  TS_DEVICE_DMA_WAIT_NS,
+  TS_DEVICE_ARB_DEVICE,
+  TS_DEVICE_ARB_HOST,
+  TS_DEVICE_FALLBACKS,
   TS_COUNT
 };
 
@@ -266,7 +280,10 @@ static const char *TDCN_STAT_NAMES =
     "stream_depth,stream_depth_hwm,stream_inflight,stream_inflight_hwm,"
     "chunk_shrinks,sender_yields,enqueue_waits,"
     "coll_fastpath_ops,sched_cache_hits,sched_cache_misses,"
-    "recv_into_placed,addr_installs,addr_lazy_resolved";
+    "recv_into_placed,addr_installs,addr_lazy_resolved,"
+    "device_sends,device_recvs,device_bytes_placed,"
+    "device_dma_waits,device_dma_wait_ns,"
+    "device_arb_device,device_arb_host,device_fallbacks";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -674,11 +691,15 @@ struct Env {
 
 struct OwnedMsg {
   Env env;
-  void *data = nullptr;  // malloc'd
+  void *data = nullptr;  // malloc'd — unless noown
   uint64_t nbytes = 0;
   uint64_t pyhandle = 0;  // nonzero: Python-side payload
   int64_t count = 0;      // element count when pyhandle != 0
   uint64_t arrival = 0;   // matching order stamp
+  bool noown = false;     // data IS a posted coll destination buffer
+                          // (coll recv_into placement): never freed
+                          // by the engine, and the waiter that posted
+                          // it skips its copy on pointer identity
 };
 
 struct PostedReq {
@@ -830,6 +851,13 @@ struct Reassembly {
                            // delivery queues
   bool fill_user = false;  // `buf` IS the user's posted buffer
                            // (in-place placement): never freed here
+  bool dead = false;       // aborted coll recv_into: the waiter gave
+                           // `buf` back to the caller — writers must
+                           // drop the rest of the stream (set and
+                           // read under rndv_mu)
+  std::atomic<uint64_t> busy{0};  // a FRAG write into `buf` is in
+                                  // flight (set under rndv_mu at
+                                  // lookup, cleared after the write)
 };
 
 // receiver-side duplicate filter, one per sending proc: `low` is the
@@ -930,6 +958,48 @@ struct Engine {
   std::unordered_map<std::string, CidQueues> p2p;  // native-matched cids
   std::unordered_map<std::string, bool> py_cids;   // cids routed to PY queue
   std::map<std::tuple<std::string, int64_t, int32_t>, CollSlot *> coll;
+  // posted coll-stream destination buffers (the coll recv_into
+  // surface, PR 12's recorded edge): (cid, seq, src) → (buf, cap).
+  // A matching inbound FK_COLL payload lands straight in the buffer
+  // — socket reads target it, ring records memcpy once into it, and
+  // a streaming/tcp RTS binds it as the reassembly target — instead
+  // of staging through a malloc the waiter re-copies (the C
+  // allgather's one-staging-copy-per-peer-block cost).  Reservation
+  // POPS the entry under eng->mu; the waiter erases leftovers on
+  // abort (the in-flight-fill-after-abort discipline mirrors the
+  // p2p precv_into path: the consumer only ever writes the user
+  // buffer, and the orphaned delivery is dropped via noown).
+  struct CollInto {
+    void *buf;
+    uint64_t cap;
+  };
+  std::map<std::tuple<std::string, int64_t, int32_t>, CollInto> coll_into;
+  // into-claims: a consumed posting's destination stays here from the
+  // moment coll_into_reserve_locked pops it until the writer either
+  // finished its write (ring memcpy / eager socket read) or inserted
+  // the reassembly into eng->reasm (RTS paths) — the windows in which
+  // the buffer can be written yet the waiter's abort-time reasm scan
+  // cannot see it.  cctx_recv_into's abort path waits for the claim
+  // to clear BEFORE scanning reasm, so it can never return (letting
+  // the caller free the buffer) while an un-scannable write is still
+  // in flight.  Guarded by eng->mu; into_cv broadcast on release.
+  std::set<void *> into_busy;
+  std::condition_variable into_cv;
+  // per-op timing for C-fast-path collectives (PR 12's observability
+  // edge): indexed by CollKind; log2-µs histogram buckets matching
+  // the Python plane's metrics.LAT_BUCKETS convention.  Relaxed
+  // atomics, read by tdcn_coll_optime — the Python side merges the
+  // rows into the straggler_<op> pvar/prom surfaces, which otherwise
+  // only see merged SPC counts for C-served collectives.
+  static const int OPTIME_KINDS = 5, OPTIME_BUCKETS = 16;
+  struct CollOpTime {
+    std::atomic<uint64_t> count{0}, total_ns{0}, max_ns{0};
+    std::atomic<uint64_t> hist[16];
+    CollOpTime() {
+      for (auto &h : hist) h.store(0, std::memory_order_relaxed);
+    }
+  };
+  CollOpTime coll_optime[5];
   std::unordered_map<uint64_t, ReqState *> reqs;
   uint64_t next_req = 1;
   uint64_t arrival = 1;
@@ -1083,6 +1153,36 @@ static bool env_match(const PostedReq &p, const OwnedMsg &m) {
 static void wake_waiters(Engine *eng) {
   eng->my_db.ring(&eng->stats,
                   eng->db_coalesce.load(std::memory_order_relaxed) != 0);
+}
+
+// Reserve a posted coll-stream destination buffer for an inbound
+// FK_COLL payload (eng->mu HELD).  Pops the posting — a posting only
+// exists while no message for its key has arrived (the waiter checks
+// slot readiness before posting), so at most one arrival can claim
+// it; oversized payloads fall back to the staging path for the
+// waiter's truncation handling.
+static void *coll_into_reserve_locked(Engine *eng, const Env &e,
+                                      uint64_t nbytes) {
+  if (e.kind != FK_COLL || eng->coll_into.empty()) return nullptr;
+  auto it = eng->coll_into.find(std::make_tuple(e.cid, e.seq, e.src));
+  if (it == eng->coll_into.end() || nbytes > it->second.cap)
+    return nullptr;
+  void *buf = it->second.buf;
+  eng->coll_into.erase(it);
+  eng->into_busy.insert(buf);  // claimed until write done / reasm bound
+  return buf;
+}
+
+// Release a reserved coll-into claim: the write into the buffer is
+// complete (ring memcpy / eager socket read), or the reassembly that
+// owns it is now in eng->reasm where the abort-time scan can reach
+// it.  Must NOT be called holding rndv_mu (eng->mu never nests inside
+// it).
+static void coll_into_release(Engine *eng, void *buf) {
+  if (!buf) return;
+  std::lock_guard<std::mutex> g(eng->mu);
+  eng->into_busy.erase(buf);
+  eng->into_cv.notify_all();
 }
 
 // Deliver one complete inbound message.  Called with eng->mu HELD.
@@ -1250,6 +1350,11 @@ static void finish_reassembly(Engine *eng, const WireHdr &h,
   m.env = std::move(ra->env);
   m.data = ra->buf;
   m.nbytes = ra->total;
+  // coll recv_into: the buffer is the waiter's posted destination
+  // (p2p fills complete via fill_rid below instead) — flag it so no
+  // delivery/cleanup path ever frees it and the waiter skips its copy
+  m.noown = ra->fill_user && !ra->fill_rid;
+  if (m.noown) eng->stats.add(TS_RECV_INTO_PLACED, 1);
   bool granted = ra->granted;
   uint64_t order = ra->order;
   uint16_t okey = ra->okey;
@@ -1304,10 +1409,26 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
           return;
         }
       }
+      // coll recv_into: a posted coll destination takes the ring
+      // payload with ONE memcpy ring → user buffer (the staging
+      // malloc + the waiter's re-copy both disappear); issue-order
+      // gating is unchanged — placement and sequencing are
+      // orthogonal (the gate releases the same slot either way)
+      void *cbuf = nullptr;
+      if (e.kind == FK_COLL && h.nbytes) {
+        std::lock_guard<std::mutex> g(eng->mu);
+        cbuf = coll_into_reserve_locked(eng, e, h.nbytes);
+      }
       OwnedMsg m;
       m.env = std::move(e);
       m.nbytes = h.nbytes;
-      if (h.nbytes) {
+      if (cbuf) {
+        memcpy(cbuf, payload, h.nbytes);
+        coll_into_release(eng, cbuf);  // write complete: scannable now
+        m.data = cbuf;
+        m.noown = true;
+        eng->stats.add(TS_RECV_INTO_PLACED, 1);
+      } else if (h.nbytes) {
         m.data = malloc(h.nbytes);
         memcpy(m.data, payload, h.nbytes);
       }
@@ -1377,15 +1498,49 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
             ra->fill_user = true;
           }
         }
+        void *ccbuf = nullptr;
+        if (!ra->buf && ra->env.kind == FK_COLL) {
+          // coll recv_into, streaming leg: bind the posted coll
+          // destination as the reassembly target — FRAGs stream
+          // straight into the user buffer, no staging malloc
+          std::lock_guard<std::mutex> g(eng->mu);
+          ccbuf = coll_into_reserve_locked(eng, ra->env, ra->total);
+          if (ccbuf) {
+            ra->buf = (uint8_t *)ccbuf;
+            ra->fill_user = true;
+          }
+        }
         if (!ra->buf)
           ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
-        std::lock_guard<std::mutex> g2(eng->rndv_mu);
-        eng->reasm[{h.from_proc, h.seq}] = ra;
+        {
+          std::lock_guard<std::mutex> g2(eng->rndv_mu);
+          eng->reasm[{h.from_proc, h.seq}] = ra;
+        }
+        coll_into_release(eng, ccbuf);  // in reasm: scannable now
         return;
       }
       // tcp path: acquire an inbound-rndv slot (bounds ingress
-      // memory), allocate only then, and grant CTS
+      // memory), allocate only then, and grant CTS.  A posted coll
+      // destination binds as the reassembly target FIRST (reserved
+      // outside rndv_mu — eng->mu must not nest inside it): the user
+      // buffer replaces the staging malloc and counts no engine
+      // ingress memory, but the slot protocol is unchanged.
+      void *ccbuf = nullptr;
+      if (ra->env.kind == FK_COLL) {
+        std::lock_guard<std::mutex> g(eng->mu);
+        ccbuf = coll_into_reserve_locked(eng, ra->env, ra->total);
+        if (ccbuf) {
+          ra->buf = (uint8_t *)ccbuf;
+          ra->fill_user = true;
+        }
+      }
       {
+        // the into-claim spans this slot wait: no FRAG can target the
+        // bound buffer until the CTS below, but an aborting waiter
+        // must not return (and let the caller free it) while the
+        // binding is invisible to its reasm scan.  Forward progress:
+        // slots free as other transfers complete/abandon, and closing
+        // breaks the wait.
         std::unique_lock<std::mutex> g(eng->rndv_mu);
         if (eng->rndv_active >= eng->max_rndv)
           eng->stats.add(TS_SLOT_WAITS, 1);  // sender's CTS delayed on
@@ -1395,16 +1550,20 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
                  eng->closing.load(std::memory_order_relaxed);
         });
         if (eng->closing.load(std::memory_order_relaxed)) {
-          delete ra;
+          delete ra;  // fill_user buf is the waiter's: nothing to free
+          g.unlock();
+          coll_into_release(eng, ccbuf);
           return;
         }
         eng->rndv_active++;
         eng->stats.gauge(TS_RNDV_DEPTH, (uint64_t)eng->rndv_active);
         eng->stats.hwm(TS_RNDV_HWM, (uint64_t)eng->rndv_active);
         ra->granted = true;
-        ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
+        if (!ra->buf)
+          ra->buf = (uint8_t *)malloc(ra->total ? ra->total : 1);
         eng->reasm[{h.from_proc, h.seq}] = ra;
       }
+      coll_into_release(eng, ccbuf);  // in reasm: scannable now
       // CTS rides the same socket back (rx connections are duplex)
       WireHdr cts;
       Env ce;
@@ -1419,11 +1578,32 @@ static void process_frame(Engine *eng, const WireHdr &h, const uint8_t *extra,
       {
         std::lock_guard<std::mutex> g(eng->rndv_mu);
         auto it = eng->reasm.find({h.from_proc, h.seq});
-        if (it != eng->reasm.end()) ra = it->second;
+        if (it != eng->reasm.end()) {
+          ra = it->second;
+          if (ra->dead) {
+            // aborted coll recv_into: the waiter returned an error
+            // and the caller owns `buf` again — drop the transfer
+            // (later FRAGs hit the unknown-transfer drop path)
+            eng->reasm.erase(it);
+            if (ra->granted) {
+              eng->rndv_active--;
+              eng->stats.gauge(TS_RNDV_DEPTH, (uint64_t)eng->rndv_active);
+              eng->rndv_cv.notify_one();
+            }
+            delete ra;  // fill_user buf is the caller's: never freed
+            return;
+          }
+          ra->busy.store(1, std::memory_order_relaxed);
+        }
       }
-      if (!ra || h.off + h.nbytes > ra->total) return;  // drop
+      if (!ra) return;  // drop
+      if (h.off + h.nbytes > ra->total) {
+        ra->busy.store(0, std::memory_order_release);
+        return;  // drop
+      }
       memcpy(ra->buf + h.off, payload, h.nbytes);
       ra->received += h.nbytes;
+      ra->busy.store(0, std::memory_order_release);
       if (ra->received >= ra->total) finish_reassembly(eng, h, ra);
       return;
     }
@@ -1490,12 +1670,27 @@ static void sock_recv_loop(Engine *eng, int fd) {
     }
     if (h.type == FT_EAGER) {
       // receive straight into the delivery buffer (single copy:
-      // kernel -> destination, like the reference's btl recv path)
-      void *buf = h.nbytes ? malloc(h.nbytes) : nullptr;
+      // kernel -> destination, like the reference's btl recv path) —
+      // or straight into a POSTED coll destination (coll recv_into:
+      // kernel -> user buffer, zero staging).  The envelope parses
+      // from `extra`, already read, so the posting lookup precedes
+      // the payload read; a posting only exists while no message for
+      // its key arrived, so a dedup-dropped duplicate can never have
+      // claimed one (the authentic delivery consumed it first).
+      Env e;
+      parse_extra(h, extra.data(), &e);
+      void *cbuf = nullptr;
+      if (e.kind == FK_COLL && h.nbytes) {
+        std::lock_guard<std::mutex> g(eng->mu);
+        cbuf = coll_into_reserve_locked(eng, e, h.nbytes);
+      }
+      void *buf = cbuf ? cbuf : (h.nbytes ? malloc(h.nbytes) : nullptr);
       if (h.nbytes && !recv_exact(fd, buf, h.nbytes)) {
-        free(buf);
+        coll_into_release(eng, cbuf);
+        if (!cbuf) free(buf);
         break;
       }
+      coll_into_release(eng, cbuf);  // socket read done: scannable now
       if (h.off) {
         // nonzero off on an eager frame = the sender's per-peer seq
         // (+ lineage nonce, see tcp_send_once): drop the duplicate a
@@ -1510,16 +1705,16 @@ static void sock_recv_loop(Engine *eng, int fd) {
         }
         if (dup_frame) {
           eng->stats.add(TS_DEDUP_DROPS, 1);
-          free(buf);
+          if (!cbuf) free(buf);
           continue;
         }
       }
-      Env e;
-      parse_extra(h, extra.data(), &e);
       OwnedMsg m;
       m.env = std::move(e);
       m.data = buf;
       m.nbytes = h.nbytes;
+      m.noown = cbuf != nullptr;
+      if (cbuf) eng->stats.add(TS_RECV_INTO_PLACED, 1);
       std::lock_guard<std::mutex> g(eng->mu);
       deliver_locked(eng, std::move(m));
       continue;
@@ -1530,16 +1725,35 @@ static void sock_recv_loop(Engine *eng, int fd) {
       {
         std::lock_guard<std::mutex> g(eng->rndv_mu);
         auto it = eng->reasm.find({h.from_proc, h.seq});
-        if (it != eng->reasm.end()) ra = it->second;
+        if (it != eng->reasm.end()) {
+          ra = it->second;
+          if (ra->dead) {
+            // aborted coll recv_into: the caller owns `buf` again —
+            // drop the transfer, drain this FRAG off the wire below
+            eng->reasm.erase(it);
+            if (ra->granted) {
+              eng->rndv_active--;
+              eng->stats.gauge(TS_RNDV_DEPTH, (uint64_t)eng->rndv_active);
+              eng->rndv_cv.notify_one();
+            }
+            delete ra;  // fill_user buf is the caller's: never freed
+            ra = nullptr;
+          } else {
+            ra->busy.store(1, std::memory_order_relaxed);
+          }
+        }
       }
       if (ra && h.off + h.nbytes <= ra->total) {
-        if (h.nbytes && !recv_exact(fd, ra->buf + h.off, h.nbytes)) break;
-        ra->received += h.nbytes;
+        bool ok = !h.nbytes || recv_exact(fd, ra->buf + h.off, h.nbytes);
+        if (ok) ra->received += h.nbytes;
+        ra->busy.store(0, std::memory_order_release);
+        if (!ok) break;
         if (ra->received >= ra->total) {
           finish_reassembly(eng, h, ra);
           conn_keys.erase({h.from_proc, h.seq});
         }
       } else {
+        if (ra) ra->busy.store(0, std::memory_order_release);
         // unknown transfer: drain and drop
         std::vector<uint8_t> sink(h.nbytes ? h.nbytes : 1);
         if (h.nbytes && !recv_exact(fd, sink.data(), h.nbytes)) break;
@@ -3197,12 +3411,92 @@ static int cctx_recv_msg(CollCtx *c, int64_t seq, int src, OwnedMsg *out) {
 
 static int cctx_recv_into(CollCtx *c, int64_t seq, int src, void *dst,
                           uint64_t cap) {
+  // The coll recv_into surface (PR 12's recorded edge): post the
+  // destination buffer BEFORE waiting, so the inbound payload lands
+  // straight in it — socket reads target it, ring records memcpy once
+  // into it, streaming/tcp RTS binds it as the reassembly target —
+  // and the one-staging-copy-per-peer-block the C allgather used to
+  // pay disappears.  Posting is skipped when the message already
+  // arrived (plain copy path handles it).
+  Engine *eng = c->eng;
+  bool posted = false;
+  if (dst && cap) {
+    std::lock_guard<std::mutex> g(eng->mu);
+    auto key = std::make_tuple(c->cid, seq, (int32_t)src);
+    auto it = eng->coll.find(key);
+    if (it == eng->coll.end() || !it->second->ready.load()) {
+      eng->coll_into[key] = Engine::CollInto{dst, cap};
+      posted = true;
+    }
+  }
   OwnedMsg m;
   int rc = cctx_recv_msg(c, seq, src, &m);
+  if (posted) {
+    // withdraw a leftover posting (delivery consumed it on the
+    // placement path; an abort leaves it behind)
+    bool consumed;
+    {
+      std::lock_guard<std::mutex> g(eng->mu);
+      consumed = eng->coll_into.erase(
+                     std::make_tuple(c->cid, seq, (int32_t)src)) == 0;
+    }
+    if (rc != 0 && consumed) {
+      // ABORTED (revoke / deadline / member failure) after the
+      // posting was consumed: either a completed delivery (its
+      // orphaned noown message sits in the queues, harmless) or an
+      // in-flight RTS reservation whose FRAG stream targets the
+      // caller's buffer.  The caller will treat `dst` as its own the
+      // moment we return an error (MPI lets it free the buffer after
+      // a failed collective), so the fill must be STOPPED first:
+      // mark the reassembly dead — writers drop the remainder of the
+      // stream — and wait out any single FRAG write already in
+      // flight.  The wait is bounded by that one write: a stalled
+      // sender mid-FRAG holds it until failure detection severs the
+      // connection (recv_exact fails → abandon erases the entry),
+      // the same failure/close break-out the reserved-precv
+      // discipline documents.
+      //
+      // FIRST wait out any live into-claim on `dst`: the consumer
+      // holds it across the windows the reasm scan below cannot see —
+      // the eager socket read, the ring memcpy, and the RTS gap
+      // between popping the posting and inserting the reassembly
+      // (including the tcp rndv-slot wait).  Claims release on write
+      // completion or reasm insertion, and a dead sender's socket
+      // failure releases too, so this wait shares the scan's bound.
+      {
+        std::unique_lock<std::mutex> g(eng->mu);
+        while (eng->into_busy.count(dst))
+          eng->into_cv.wait_for(g, std::chrono::milliseconds(20));
+      }
+      for (;;) {
+        bool live = false, writing = false;
+        {
+          std::lock_guard<std::mutex> g(eng->rndv_mu);
+          // mark EVERY entry bound to dst (no first-match break): a
+          // lingering dead reassembly from an earlier abort of a
+          // reused buffer must not shadow a live binding — the
+          // shadowed transfer would keep streaming into memory the
+          // caller reclaims on return
+          for (auto &kv : eng->reasm) {
+            Reassembly *ra = kv.second;
+            if (ra->buf == (uint8_t *)dst) {
+              ra->dead = true;
+              live = true;
+              if (ra->busy.load(std::memory_order_acquire) != 0)
+                writing = true;
+            }
+          }
+        }
+        if (!live || !writing) break;
+        std::this_thread::sleep_for(std::chrono::microseconds(200));
+      }
+    }
+  }
   if (rc != 0) return rc;
+  if (m.noown && m.data == dst) return 0;  // placed: nothing to copy/free
   uint64_t n = m.nbytes < cap ? m.nbytes : cap;
   if (n && dst) memcpy(dst, m.data, n);
-  free(m.data);
+  if (!m.noown) free(m.data);
   return 0;
 }
 
@@ -3887,7 +4181,50 @@ int tdcn_coll_start(void *h, uint64_t plan, const void *sendbuf,
   if (!pl || !pl->ctx) return -4;
   if (pl->ctx->revoked.load(std::memory_order_relaxed))
     return -6;  // revoked comm: refuse before any frame moves
-  return plan_exec(pl->ctx, pl, sendbuf, recvbuf);
+  // per-op timing (the straggler merge's C rows): one clock pair per
+  // C-served collective — two vdso calls against schedules that move
+  // frames; below measurement noise on the np=1 dispatch floor too
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  int rc = plan_exec(pl->ctx, pl, sendbuf, recvbuf);
+  if (rc == 0 && pl->kind >= 0 && pl->kind < Engine::OPTIME_KINDS) {
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    uint64_t ns = (uint64_t)(t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                  (uint64_t)(t1.tv_nsec - t0.tv_nsec);
+    auto &ot = pl->ctx->eng->coll_optime[pl->kind];
+    ot.count.fetch_add(1, std::memory_order_relaxed);
+    ot.total_ns.fetch_add(ns, std::memory_order_relaxed);
+    uint64_t cur = ot.max_ns.load(std::memory_order_relaxed);
+    while (cur < ns && !ot.max_ns.compare_exchange_weak(
+                           cur, ns, std::memory_order_relaxed)) {
+    }
+    // log2-µs bucket, upper-inclusive edges (metrics.lat_bucket twin)
+    uint64_t us = ns / 1000;
+    int b = 0;
+    while (us > 1 && b < Engine::OPTIME_BUCKETS - 1) {
+      us = (us + 1) >> 1;  // ceil halving == bit_length of (us-1)
+      b++;
+    }
+    ot.hist[b].fetch_add(1, std::memory_order_relaxed);
+  }
+  return rc;
+}
+
+// Per-op timing rows for one C-served collective kind (CK_* index):
+// out = [count, total_ns, max_ns, hist[16 log2-µs buckets]].  Returns
+// the number of slots written (0 for an unknown kind / tiny buffer).
+int tdcn_coll_optime(void *h, int kind, uint64_t *out, int max_n) {
+  Engine *eng = (Engine *)h;
+  if (kind < 0 || kind >= Engine::OPTIME_KINDS) return 0;
+  int need = 3 + Engine::OPTIME_BUCKETS;
+  if (max_n < need) return 0;
+  auto &ot = eng->coll_optime[kind];
+  out[0] = ot.count.load(std::memory_order_relaxed);
+  out[1] = ot.total_ns.load(std::memory_order_relaxed);
+  out[2] = ot.max_ns.load(std::memory_order_relaxed);
+  for (int i = 0; i < Engine::OPTIME_BUCKETS; i++)
+    out[3 + i] = ot.hist[i].load(std::memory_order_relaxed);
+  return need;
 }
 
 // Post a receive that CARRIES its destination buffer: an in-order
@@ -4726,10 +5063,15 @@ void tdcn_destroy(void *h) {
   {
     std::lock_guard<std::mutex> g(eng->mu);
     for (auto &kv : eng->coll) {
-      if (kv.second->msg.data) free(kv.second->msg.data);
+      // noown payloads are posted user buffers (coll recv_into) —
+      // never engine-freed
+      if (kv.second->msg.data && !kv.second->msg.noown)
+        free(kv.second->msg.data);
       delete kv.second;
     }
     eng->coll.clear();
+    eng->coll_into.clear();
+    eng->into_busy.clear();  // readers drained: no claim can be live
     for (auto &kv : eng->reqs) {
       // an in-place-completed request's payload IS the user buffer
       if (kv.second->msg.data && !kv.second->in_fill)

@@ -477,6 +477,26 @@ typedef struct {
   unsigned long long ring_thr; /* DCN ring-allreduce crossover bytes
                                 * (mirrors the Python plane's decision
                                 * so both paths pick one schedule) */
+  /* handle-homogeneity agreements (the schedule-build guard): C-plane
+   * routing keys on the LOCAL datatype handle, and MPI only requires
+   * SIGNATURE equality across ranks — a predefined handle on one rank
+   * with a same-signature derived handle on another would silently
+   * split the ranks across planes (deadlock).  The first collective
+   * per (kind, root, nbytes) runs a KVS agreement of every rank's
+   * handle class (capi coll_handle_agree); verdict 0 forces ALL ranks
+   * onto the Python plane.  Bounded cache; overflow re-agrees.  Sized
+   * so a per-layer-sized training loop (dozens of distinct gradient
+   * sizes per step) fits without cycling: an evicted signature pays a
+   * full blocking KVS round on EVERY call, re-installing the dispatch
+   * latency floor this cache exists to flatten (~5 KiB per comm). */
+#define FP_HAGREE_CAP 256
+  struct {
+    int kind[FP_HAGREE_CAP], root[FP_HAGREE_CAP];
+    long long nbytes[FP_HAGREE_CAP];
+    int verdict[FP_HAGREE_CAP];
+    int n;  /* filled slots, <= FP_HAGREE_CAP */
+    int rr; /* rotation cursor once full */
+  } hagree;
 } tpumpi_fp;
 
 /* Individually-malloc'd slots (outstanding requests hold tpumpi_fp*,
@@ -937,10 +957,13 @@ static unsigned long long fp_cctx(tpumpi_fp *fp) {
  * Envelope note: routing keys on the LOCAL datatype handle.  MPI only
  * requires type-SIGNATURE equality across ranks, so a program where
  * one rank passes MPI_INT and another a committed contiguous derived
- * equivalent is legal but lands the two ranks on different planes
- * (deadlock).  Handle-homogeneous calls — every real program in this
- * repo's suites — are the supported envelope; the mixed-handle case
- * is recorded in ROADMAP as a remaining edge. */
+ * equivalent is legal yet would land the two ranks on different
+ * planes (deadlock) — fp_coll_agree below (the schedule-build KVS
+ * agreement, run at the top of fp_coll_run, fallback half published
+ * by fp_coll_agree_fallback) detects that case and degrades EVERY
+ * rank to the Python plane.  The verdict is cached per signature: a
+ * signature must keep a consistent per-rank handle class across the
+ * program (the ROADMAP envelope note). */
 static int fp_coll_usable(tpumpi_fp **out, MPI_Comm comm,
                           MPI_Datatype datatype, long long count) {
   int dt = (int)datatype;
@@ -953,6 +976,67 @@ static int fp_coll_usable(tpumpi_fp **out, MPI_Comm comm,
   return 1;
 }
 
+/* Schedule-build guard: agree (once per (kind, root, nbytes)
+ * signature, cached) that every rank's datatype handle is in the
+ * same class.  `pre` is this rank's class (1 = predefined handle).
+ * A predefined rank publishes and WAITS for all peers (the build is
+ * rare; the verdict is cached); a derived rank publishes only — it
+ * already knows it keeps the Python plane.  Returns 1 when the C
+ * plane is allowed.  Barriers carry no datatype: always allowed. */
+static int fp_coll_agree(tpumpi_fp *fp, int kind, int root,
+                         long long nbytes, int pre) {
+  if (fp->nprocs <= 1 || kind == FP_CK_BARRIER) return pre;
+  for (int i = 0; i < fp->hagree.n; i++)
+    if (fp->hagree.kind[i] == kind && fp->hagree.root[i] == root &&
+        fp->hagree.nbytes[i] == nbytes)
+      return fp->hagree.verdict[i];
+  capi_ret r;
+  int verdict = 0;
+  if (capi_call("coll_handle_agree", &r, "(iiiLi)", fp->comm, kind, root,
+                nbytes, pre) == MPI_SUCCESS &&
+      r.n >= 1)
+    verdict = (int)r.v[0];
+  /* rotating replacement: a full cache evicts round-robin instead of
+   * refusing — otherwise signature 33+ would pay the blocking KVS
+   * round on EVERY call (a re-agreement after eviction is consistent:
+   * the verdict is a pure function of the published key set). */
+  int i;
+  if (fp->hagree.n < FP_HAGREE_CAP) {
+    i = fp->hagree.n++;
+  } else {
+    i = fp->hagree.rr;
+    fp->hagree.rr = (fp->hagree.rr + 1) % FP_HAGREE_CAP;
+  }
+  fp->hagree.kind[i] = kind;
+  fp->hagree.root[i] = root;
+  fp->hagree.nbytes[i] = nbytes;
+  fp->hagree.verdict[i] = verdict;
+  return verdict;
+}
+
+/* The fallback rank's half of the agreement, called from the capi-
+ * fallback path of each typed collective: publish our plane class so
+ * fast-path peers' schedule-build agreement sees us instead of
+ * stalling out the recv deadline.  ANY fallback reason counts — a
+ * derived datatype handle, an allgather whose sendtype/sendcount
+ * differ from the recv side, a failed cctx open — because whatever
+ * put THIS rank on the Python plane, same-signature peers that
+ * published "p" are parked waiting for our key.  A rank whose
+ * fast-path attempt already ran the agreement (fp_coll_run returned
+ * 0 on a missing plan) hits the shim-side verdict cache here and
+ * publishes nothing.  No-op unless the comm is fast-path-capable. */
+static void fp_coll_agree_fallback(MPI_Comm comm, int kind, int root,
+                                   MPI_Datatype datatype, long long count) {
+  int dt = (int)datatype;
+  tpumpi_fp *fp = fp_get(comm);
+  if (!fp || fp->nprocs <= 1 || fp->nranks != fp->nprocs) return;
+  long long sz = (dt >= 1 && dt <= 27 && fp_dt[dt].size)
+                     ? (long long)fp_dt[dt].size
+                     : tpumpi_type_size(datatype);
+  if (sz <= 0 || count < 0) return;
+  fp_coll_agree(fp, kind, root, count * sz, 0);
+}
+
 /* Run one C-served collective through the compiled-schedule cache.
  * Returns 1 when handled (*rc_out carries the MPI result); 0 when the
  * (kind, op, dtype) signature is not C-serviceable — the caller falls
@@ -962,6 +1046,9 @@ static int fp_coll_usable(tpumpi_fp **out, MPI_Comm comm,
 static int fp_coll_run(tpumpi_fp *fp, int kind, int opcode, int dtcode,
                        long long count, int root, const void *sb, void *rb,
                        int *rc_out) {
+  if (!fp_coll_agree(fp, kind, root,
+                     count * (long long)fp_dt[dtcode].size, 1))
+    return 0; /* mixed handles somewhere: every rank keeps Python */
   unsigned long long plan =
       tdcn_coll_plan(fp->eng, fp->cctx, kind, opcode, dtcode, count, root,
                      -1 /* engine decides: the collops crossover */);
@@ -1409,6 +1496,7 @@ int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
                     buffer, buffer, &rc))
       return rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_BCAST, root, datatype, count);
   return capi_call("bcast", NULL, "(Kiiii)", PTR(buffer), count,
                    (int)datatype, root, (int)comm);
 }
@@ -1425,6 +1513,7 @@ int PMPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                     sb, recvbuf, &rc))
       return rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_REDUCE, root, datatype, count);
   return capi_call("reduce", NULL, "(KKiiiii)", PTR(sendbuf), PTR(recvbuf),
                    count, (int)datatype, (int)op, root, (int)comm);
 }
@@ -1439,6 +1528,7 @@ int PMPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                           count, 0, sb, recvbuf, &rc))
       return rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_ALLREDUCE, 0, datatype, count);
   return capi_call("allreduce", NULL, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
                    count, (int)datatype, (int)op, (int)comm);
 }
@@ -1462,6 +1552,7 @@ int PMPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                           recvcount, 0, sb, recvbuf, &rc))
       return rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_ALLGATHER, 0, recvtype, recvcount);
   return capi_call("allgather", NULL, "(KiiKiii)", PTR(sendbuf), sendcount,
                    (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
                    (int)comm);
@@ -2285,6 +2376,7 @@ int PMPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
                     buffer, buffer, &rc))
       return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_BCAST, root, datatype, count);
   capi_ret r;
   int rc = capi_call("ibcast", &r, "(Kiiii)", PTR(buffer), count,
                      (int)datatype, root, (int)comm);
@@ -2303,6 +2395,7 @@ int PMPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                           count, 0, sb, recvbuf, &rc))
       return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_ALLREDUCE, 0, datatype, count);
   capi_ret r;
   int rc = capi_call("iallreduce", &r, "(KKiiii)", PTR(sendbuf), PTR(recvbuf),
                      count, (int)datatype, (int)op, (int)comm);
@@ -2327,6 +2420,7 @@ int PMPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                           recvcount, 0, sb, recvbuf, &rc))
       return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_ALLGATHER, 0, recvtype, recvcount);
   capi_ret r;
   int rc = capi_call("iallgather", &r, "(KiiKiii)", PTR(sendbuf), sendcount,
                      (int)sendtype, PTR(recvbuf), recvcount, (int)recvtype,
@@ -2665,7 +2759,9 @@ int PMPI_Allreduce_init(const void *sendbuf, void *recvbuf, int count,
   tpumpi_fp *fp;
   if (recvbuf && fp_coll_usable(&fp, comm, datatype, count)) {
     const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
-    if (sb) {
+    if (sb && fp_coll_agree(fp, FP_CK_ALLREDUCE, 0,
+                            (long long)count * fp_dt[(int)datatype].size,
+                            1)) {
       int algo = fp_sched_algo(
           fp, "allreduce",
           (long long)count * fp_dt[(int)datatype].size, (int)op);
@@ -2677,6 +2773,7 @@ int PMPI_Allreduce_init(const void *sendbuf, void *recvbuf, int count,
                                    recvbuf, request);
     }
   }
+  fp_coll_agree_fallback(comm, FP_CK_ALLREDUCE, 0, datatype, count);
   capi_ret r;
   int rc = capi_call("allreduce_init", &r, "(KKiiii)", PTR(sendbuf),
                      PTR(recvbuf), count, (int)datatype, (int)op,
@@ -2693,7 +2790,8 @@ int PMPI_Bcast_init(void *buffer, int count, MPI_Datatype datatype,
   if (buffer != MPI_IN_PLACE &&
       fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
       root < fp->nranks) {
-    {
+    if (fp_coll_agree(fp, FP_CK_BCAST, root,
+                      (long long)count * fp_dt[(int)datatype].size, 1)) {
       unsigned long long plan =
           tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_BCAST, 0,
                          (int)datatype, count, root, -1);
@@ -2702,6 +2800,7 @@ int PMPI_Bcast_init(void *buffer, int count, MPI_Datatype datatype,
                                    buffer, request);
     }
   }
+  fp_coll_agree_fallback(comm, FP_CK_BCAST, root, datatype, count);
   capi_ret r;
   int rc = capi_call("bcast_init", &r, "(Kiiii)", PTR(buffer), count,
                      (int)datatype, root, (int)comm);
@@ -2724,7 +2823,9 @@ int PMPI_Allgather_init(const void *sendbuf, int sendcount,
                fp_dt[(int)recvtype].size;
     else if ((int)sendtype == (int)recvtype && sendcount == recvcount)
       sb = sendbuf;
-    if (sb) {
+    if (sb && fp_coll_agree(
+                  fp, FP_CK_ALLGATHER, 0,
+                  (long long)recvcount * fp_dt[(int)recvtype].size, 1)) {
       unsigned long long plan =
           tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_ALLGATHER, 0,
                          (int)recvtype, recvcount, 0, -1);
@@ -2733,6 +2834,7 @@ int PMPI_Allgather_init(const void *sendbuf, int sendcount,
                                    recvbuf, request);
     }
   }
+  fp_coll_agree_fallback(comm, FP_CK_ALLGATHER, 0, recvtype, recvcount);
   capi_ret r;
   int rc = capi_call("allgather_init", &r, "(KiiKiii)", PTR(sendbuf),
                      sendcount, (int)sendtype, PTR(recvbuf), recvcount,
@@ -2749,7 +2851,9 @@ int PMPI_Reduce_init(const void *sendbuf, void *recvbuf, int count,
   if (fp_coll_usable(&fp, comm, datatype, count) && root >= 0 &&
       root < fp->nranks) {
     const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
-    if (sb && (fp->my_rank != root || recvbuf)) {
+    if (sb && (fp->my_rank != root || recvbuf) &&
+        fp_coll_agree(fp, FP_CK_REDUCE, root,
+                      (long long)count * fp_dt[(int)datatype].size, 1)) {
       unsigned long long plan =
           tdcn_coll_plan(fp->eng, fp->cctx, FP_CK_REDUCE, (int)op,
                          (int)datatype, count, root, -1);
@@ -2758,6 +2862,7 @@ int PMPI_Reduce_init(const void *sendbuf, void *recvbuf, int count,
                                    recvbuf, request);
     }
   }
+  fp_coll_agree_fallback(comm, FP_CK_REDUCE, root, datatype, count);
   capi_ret r;
   int rc = capi_call("reduce_init", &r, "(KKiiiii)", PTR(sendbuf),
                      PTR(recvbuf), count, (int)datatype, (int)op, root,
@@ -2867,6 +2972,7 @@ int PMPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
                     root, sb, recvbuf, &rc))
       return rc == MPI_SUCCESS ? fp_coll_done_req(fp, request) : rc;
   }
+  fp_coll_agree_fallback(comm, FP_CK_REDUCE, root, datatype, count);
   TPUMPI_IREQ(capi_call("ireduce", &r, "(KKiiiii)", PTR(sendbuf),
                         PTR(recvbuf), count, (int)datatype, (int)op, root,
                         (int)comm))

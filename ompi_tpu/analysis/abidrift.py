@@ -406,6 +406,69 @@ def check_ctypes(root: Path) -> list[Finding]:
     return out
 
 
+# -- transport counters vs the provider merge ---------------------------
+
+def _counter_keys(tree: ast.Module) -> list[tuple[str, int]]:
+    """(key, line) for every counter name a transport initializes:
+    string keys of ``…stats = { … }`` dict literals (Assign or
+    AnnAssign) and elements of ``STATS_KEYS`` tuples."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        tgt = None
+        val = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt, val = node.target, node.value
+        if tgt is None:
+            continue
+        name = (tgt.attr if isinstance(tgt, ast.Attribute)
+                else tgt.id if isinstance(tgt, ast.Name) else "")
+        if name == "stats" and isinstance(val, ast.Dict):
+            out += [(k.value, node.lineno) for k in val.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+        elif name == "STATS_KEYS" and isinstance(val, ast.Tuple):
+            out += [(e.value, node.lineno) for e in val.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return out
+
+
+def check_provider_merge(root: Path) -> list[Finding]:
+    """``provider-merge-drift``: every counter key a DCN transport or
+    plane initializes (the dicts its metrics provider snapshots) must
+    appear in ``NATIVE_COUNTERS`` — a key outside the schema is
+    silently DROPPED by the provider merge (``native_counters`` only
+    sums known names), so the counter would exist in code yet never
+    reach a pvar, the Prometheus export, the live scrape, or
+    ``tools/top.py``."""
+    counters, _ = py_native_counters(root)
+    if not counters:
+        return []  # stat-names-drift already reports the parse failure
+    cset = set(counters)
+    out: list[Finding] = []
+    dcn_dir = root / "ompi_tpu" / "dcn"
+    if not dcn_dir.is_dir():
+        return []
+    for path in sorted(dcn_dir.glob("*.py")):
+        tree = parse_py(path)
+        if tree is None:
+            continue
+        rel_p = rel(root, path)
+        for key, line in _counter_keys(tree):
+            if key not in cset:
+                out.append(Finding(
+                    PASS, "provider-merge-drift", rel_p, line, key,
+                    f"transport counter {key!r} is initialized here but "
+                    "missing from metrics/core.py NATIVE_COUNTERS — the "
+                    "provider merge drops unknown names, so this counter "
+                    "would never surface as a dcn_* pvar, in the "
+                    "finalize/live exports, or in tools/top.py",
+                    SEV_ERROR))
+    return out
+
+
 # -- README operator-surface catalogs -----------------------------------
 
 def _served_routes(root: Path) -> dict[str, tuple[str, int]]:
@@ -499,5 +562,6 @@ def run(root: str | Path, files=None) -> list[Finding]:
     out: list[Finding] = []
     out += check_stat_names(root)
     out += check_ctypes(root)
+    out += check_provider_merge(root)
     out += check_catalogs(root)
     return out

@@ -81,7 +81,7 @@ def full_var_name(fw: str, comp: str, name: str) -> str:
 
 #: the central registration tables in core/var.py the contracts name
 CENTRAL_TABLES = ("OBSERVABILITY_VARS", "ROBUSTNESS_VARS", "SERVING_VARS",
-                  "TRANSPORT_VARS", "SCHEDULE_VARS")
+                  "TRANSPORT_VARS", "SCHEDULE_VARS", "DEVICE_VARS")
 
 
 def central_var_tables(root: Path) -> dict[str, list[str]]:

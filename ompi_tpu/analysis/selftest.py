@@ -128,7 +128,8 @@ def _fixture_metrics_core(names: tuple[str, ...]) -> str:
 def build_fixture_tree(root: Path, *, spin: str = "bad",
                        mca_ref: str = "trace_enable",
                        locks: str = "cycle",
-                       rename_counter: str | None = None) -> Path:
+                       rename_counter: str | None = None,
+                       stats_key: str | None = None) -> Path:
     """Materialize a seeded mini-repo under ``root``.  Knobs select the
     violation (or its clean twin) per pass:
 
@@ -137,6 +138,9 @@ def build_fixture_tree(root: Path, *, spin: str = "bad",
     * ``locks``: "cycle" → opposite-order pair; "clean" → same order.
     * ``rename_counter``: rename this NATIVE_COUNTERS name on the C
       side only (ABI drift); None → both sides agree.
+    * ``stats_key``: write a dcn/device.py whose STATS_KEYS carries
+      this counter name (provider-merge-drift when it is not in
+      NATIVE_COUNTERS); None → no device.py.
     """
     (root / "ompi_tpu" / "core").mkdir(parents=True, exist_ok=True)
     (root / "ompi_tpu" / "dcn").mkdir(parents=True, exist_ok=True)
@@ -154,6 +158,12 @@ def build_fixture_tree(root: Path, *, spin: str = "bad",
         c_names = tuple(f"{n}_v2" if n == rename_counter else n
                         for n in _COUNTERS)
     (root / "native" / "src" / "dcn.cc").write_text(_fixture_dcn_cc(c_names))
+    if stats_key is not None:
+        (root / "ompi_tpu" / "dcn" / "device.py").write_text(
+            f'STATS_KEYS = ("{stats_key}",)\n\n\n'
+            "class Plane:\n"
+            "    def __init__(self):\n"
+            "        self.stats = {k: 0 for k in STATS_KEYS}\n")
     (root / "README.md").write_text(
         f"Fixture repo.  Enable with ``--mca {mca_ref} 1``.\n"
         "Counters: " + ", ".join(f"`{n}`" for n in _COUNTERS) + "\n")
@@ -221,6 +231,19 @@ def _leg_abidrift(tmp: Path, log: list[str]) -> bool:
     good = build_fixture_tree(tmp / "abi_good", spin="good")
     fs2 = abidrift.check_stat_names(good)
     ok &= _expect(log, not fs2, "agreeing tables stay clean")
+    # provider-merge drift: a transport counter outside NATIVE_COUNTERS
+    # would be silently dropped by the merge — seeded bad + clean twin
+    pm_bad = build_fixture_tree(tmp / "abi_pm_bad", spin="good",
+                                stats_key="bogus_counter")
+    fs3 = abidrift.check_provider_merge(pm_bad)
+    ok &= _expect(log,
+                  any(f.rule == "provider-merge-drift"
+                      and f.symbol == "bogus_counter" for f in fs3),
+                  "unmerged transport counter detected")
+    pm_good = build_fixture_tree(tmp / "abi_pm_good", spin="good",
+                                 stats_key="delivered")
+    fs4 = abidrift.check_provider_merge(pm_good)
+    ok &= _expect(log, not fs4, "schema-covered counter stays clean")
     return ok
 
 

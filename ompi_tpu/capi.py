@@ -19,6 +19,7 @@ out before releasing the GIL).
 from __future__ import annotations
 
 import ctypes
+import time
 import traceback
 
 import numpy as np
@@ -425,6 +426,100 @@ def coll_sched_decision(h: int, coll: str, nbytes: int, opcode: int):
         return (_fail(e, h), 0)
 
 
+def coll_handle_agree(h: int, kind: int, root: int, nbytes: int,
+                      pre: int):
+    """(err, verdict) — the schedule-build handle-homogeneity guard
+    for the C collective fast path.  Routing keys on the LOCAL
+    datatype handle, but MPI only requires SIGNATURE equality across
+    ranks: a predefined handle on one rank with a same-signature
+    derived handle on another is legal yet would silently split the
+    ranks across planes (deadlock).  At schedule-build time every
+    rank publishes its handle class for the (comm, kind, root,
+    nbytes) signature on the job KVS; predefined ranks wait for all
+    peers and the verdict (1 = all predefined → C plane allowed,
+    0 = mixed → every rank keeps the Python plane) is cached shim-
+    side, so the KVS round is paid once per signature.  Derived ranks
+    publish and return immediately — they already know their plane.
+    Supported envelope note: a signature must keep a consistent
+    handle class per rank across the program (re-agreement is cached
+    by signature, not per call)."""
+    try:
+        c = _comm(h)
+        eng = getattr(c, "dcn", None)
+        ctx = getattr(c, "procctx", None)
+        if (eng is None or ctx is None
+                or int(getattr(eng, "nprocs", 1)) <= 1):
+            return (MPI_SUCCESS, 1 if pre else 0)
+        from ompi_tpu.core.var import Deadline
+
+        kvs = ctx.kvs
+        ns = getattr(ctx, "ns", "")
+        key = (f"{ns}hagree.{c.cid}.{int(kind)}.{int(root)}."
+               f"{int(nbytes)}")
+
+        def _poisoned() -> bool:
+            try:
+                kvs.get(f"{key}.verdict0", wait=False)
+                return True
+            except KeyError:
+                return False
+
+        # verdict-0 marker first: a peer that already degraded this
+        # signature (derived handle, or a timeout) binds EVERY later
+        # arrival to the same Python-plane verdict — without it, a
+        # rank whose wait expired would cache 0 while a late-arriving
+        # rank reads the complete all-"p" key set and caches 1: the
+        # exact cross-rank plane split the guard exists to prevent
+        if _poisoned():
+            kvs.put(f"{key}.{int(eng.proc)}", "d")
+            return (MPI_SUCCESS, 0)
+        kvs.put(f"{key}.{int(eng.proc)}", "p" if pre else "d")
+        if not pre:
+            kvs.put(f"{key}.verdict0", 1)
+            return (MPI_SUCCESS, 0)
+        dl = Deadline.for_timeout("recv")
+        verdict = 1
+        for p in range(int(eng.nprocs)):
+            if p == int(eng.proc):
+                continue
+            v = None
+            while v is None:
+                try:
+                    v = kvs.get(f"{key}.{p}", timeout=dl.slice(1.0))
+                except KeyError:
+                    if dl.expired():
+                        break  # silent peer: conservative Python plane
+                except OSError:
+                    # transient KVS hiccup: retry inside the same
+                    # deadline rather than raising — the raise path
+                    # would cache verdict 0 on THIS rank while peers
+                    # holding our published "p" complete an all-"p"
+                    # read and cache 1: the cross-plane split the
+                    # guard exists to prevent.  A dead KVS ends in
+                    # the deadline degrade below like a silent peer.
+                    if dl.expired():
+                        break
+                    time.sleep(0.05)
+            if v != "p":
+                verdict = 0
+                break
+        if verdict == 0:
+            # publish the degradation (and flip our own class key) so
+            # peers arriving after our deadline converge on 0 instead
+            # of reading a complete "p" set.  The residual race — a
+            # peer completing its all-"p" read in the same instant
+            # this marker lands — needs the skew to hit the deadline
+            # within the marker-write window; the supported envelope
+            # (consistent handle classes per signature) is unaffected.
+            kvs.put(f"{key}.verdict0", 1)
+            kvs.put(f"{key}.{int(eng.proc)}", "d")
+        elif _poisoned():
+            verdict = 0  # a peer degraded while we were reading keys
+        return (MPI_SUCCESS, verdict)
+    except BaseException as e:  # noqa: BLE001
+        return (_fail(e, h), 0)
+
+
 def comm_dup(h: int):
     try:
         nh = _store_comm(_comm(h).dup(), h)
@@ -577,19 +672,33 @@ def bcast(ptr, count, dtcode, root, h) -> int:
 
 
 def allgather(sptr, scount, sdt, rptr, rcount, rdt, h) -> int:
+    # Derived send/recv handles ride the convertor pack/unpack (like
+    # bcast): matching signatures pack to identical leaf-typed (or
+    # raw-byte) blocks, so a derived-sendtype rank interoperates with
+    # predefined-handle peers — the capi fallback must serve every
+    # legal call the shim's agreement routes here (a derived handle
+    # ANYWHERE forces all ranks onto this plane).
     try:
         c = _comm(h)
         n = getattr(c, "size", 1)
         if sptr == _IN_PLACE:
             # input is this rank's block of recvbuf
             me = comm_rank(h)[1]
-            full = _view(rptr, rcount * n, rdt)
-            x = full[me * rcount : (me + 1) * rcount].copy()
-            scount, sdt = rcount, rdt
+            d = _dtypes.get(rdt)
+            if d is not None:
+                x = _pack_from(rptr + me * rcount * d.extent, rcount, rdt)
+            else:
+                full = _view(rptr, rcount * n, rdt)
+                x = full[me * rcount : (me + 1) * rcount].copy()
+        elif sdt in _dtypes:
+            x = _pack_from(sptr, scount, sdt)
         else:
             x = _view(sptr, scount, sdt)
-        out = np.asarray(c.allgather(x[None, :]))  # (1, n, scount)
-        _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
+        out = np.asarray(c.allgather(x[None, :]))  # (1, n, per-rank)
+        if rdt in _dtypes:
+            _unpack_into(rptr, rcount * n, rdt, out[0])
+        else:
+            _view(rptr, rcount * n, rdt)[:] = out.reshape(-1)[: rcount * n]
         return MPI_SUCCESS
     except BaseException as e:  # noqa: BLE001
         return _fail(e, h)
@@ -792,8 +901,10 @@ def _complete(entry) -> tuple[int, int, int]:
     if kind == "coll":
         out = req.wait()
         if ptr not in (0, _IN_PLACE) and count:
-            flat = np.asarray(out).reshape(-1)[:count]
-            _view(ptr, count, dtcode)[:] = flat
+            # _unpack_into: predefined lands as the plain flat view,
+            # derived goes through the convertor (iallreduce's
+            # mixed-handle fallback leg)
+            _unpack_into(ptr, count, dtcode, np.asarray(out))
         return (0, 0, count * _unit_nbytes(dtcode))
     raise err.MPIInternalError(f"bad request kind {kind}")
 
@@ -878,7 +989,10 @@ def test(rh: int):
 def iallreduce(sptr, rptr, count, dtcode, opcode, h):
     try:
         c = _comm(h)
-        x = _coll_in(sptr, rptr, count, dtcode)[None, :].copy()
+        # _reduce_in (not _coll_in): derived handles pack onto their
+        # uniform leaf like the blocking allreduce — the agreement
+        # guard routes every mixed-handle I*-collective here
+        x = _reduce_in(sptr, rptr, count, dtcode)[None, :].copy()
         req = c.iallreduce(x, OPS[opcode])
         return (MPI_SUCCESS, _store_req(("coll", req, rptr, count, dtcode)))
     except BaseException as e:  # noqa: BLE001

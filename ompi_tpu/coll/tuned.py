@@ -203,6 +203,14 @@ def fixed_decision(coll: str, comm_size: int, msg_bytes: int, op: Op | None,
         if not op.commutative:
             return ALLREDUCE_ALGOS["ordered_linear"], None
         if msg_bytes >= huge:
+            # software-op huge messages: the device-DMA ring keeps the
+            # chunk rotation in HBM with explicit semaphores — chosen
+            # when the Pallas leg can actually lower (TPU backend);
+            # the segmented host ring stays the CPU/GPU answer
+            from . import pallas_kernels as _pk
+
+            if _pk.dma_available():
+                return ALLREDUCE_ALGOS["pallas_ring"], None
             return ALLREDUCE_ALGOS["ring_segmented"], None
         if msg_bytes >= large:
             # Rabenseifner needs pow2 (xla falls back to ring otherwise)

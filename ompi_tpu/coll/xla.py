@@ -49,6 +49,12 @@ from . import base as algos
 from .module import CollModule
 
 # Algorithm enums (names follow coll_tuned_*_algorithm_count conventions).
+# ``pallas_ring`` is the device-DMA schedule family (coll/
+# pallas_kernels.py): the same ring chunk rotation as ``ring``, with
+# every hop an explicit Pallas async-remote-copy kernel on TPU and the
+# structured ring-permute emulation elsewhere — selectable per
+# (op, size bucket) through the tuned fixed/dynamic tables like any
+# other family.
 ALLREDUCE_ALGOS = {
     "auto": 0,
     "psum": 1,
@@ -57,11 +63,14 @@ ALLREDUCE_ALGOS = {
     "recursive_doubling": 4,
     "rabenseifner": 5,
     "ordered_linear": 6,
+    "pallas_ring": 7,
 }
 BCAST_ALGOS = {"auto": 0, "direct": 1, "binomial": 2, "pipeline": 3}
-ALLGATHER_ALGOS = {"auto": 0, "direct": 1, "ring": 2, "bruck": 3}
+ALLGATHER_ALGOS = {"auto": 0, "direct": 1, "ring": 2, "bruck": 3,
+                   "pallas_ring": 4}
 ALLTOALL_ALGOS = {"auto": 0, "direct": 1, "pairwise": 2}
-REDUCE_SCATTER_ALGOS = {"auto": 0, "direct": 1, "ring": 2, "ordered": 3}
+REDUCE_SCATTER_ALGOS = {"auto": 0, "direct": 1, "ring": 2, "ordered": 3,
+                        "pallas_ring": 4}
 REDUCE_ALGOS = {"auto": 0, "binomial": 1, "ordered": 2}
 BARRIER_ALGOS = {"auto": 0, "allreduce": 1, "dissemination": 2}
 
@@ -98,21 +107,40 @@ class XlaCollModule(CollModule):
             self._cache[key] = fn
         return fn
 
-    def _spmd(self, per_device_fn, nin: int = 1, donate: bool = False):
+    def _spmd(self, per_device_fn, nin: int = 1, donate: bool = False,
+              pallas: bool = False):
         """jit(shard_map(...)) over the comm mesh: each input/output is
         rank-major with leading axis = comm size.
 
         ``donate=True`` builds the arena variant (donate_argnums=0):
         XLA writes the output into the staged input's HBM allocation —
         only used for shape-preserving ops on framework-owned staged
-        buffers (never user arrays; MPI preserves sendbuf)."""
+        buffers (never user arrays; MPI preserves sendbuf).
+
+        ``pallas=True`` disables shard_map's replication checking —
+        ``pallas_call`` has no replication rule, so the Pallas ring
+        family cannot trace under it (the kwarg name drifted across
+        jax versions: check_rep → check_vma; detect, don't guess)."""
         mesh = self.comm.mesh.mesh
         specs = [P(AXIS)] * nin
+        kwargs = {}
+        if pallas:
+            import inspect
+
+            try:
+                params = inspect.signature(shard_map).parameters
+            except (TypeError, ValueError):
+                params = {}
+            for kw in ("check_rep", "check_vma"):
+                if kw in params:
+                    kwargs[kw] = False
+                    break
         f = shard_map(
             per_device_fn,
             mesh=mesh,
             in_specs=tuple(specs) if nin > 1 else specs[0],
             out_specs=P(AXIS),
+            **kwargs,
         )
         if donate:
             self.comm.mesh.arena.note_donation()
@@ -190,12 +218,18 @@ class XlaCollModule(CollModule):
             algo = ALLREDUCE_ALGOS["ring"]
         if algo == ALLREDUCE_ALGOS["rabenseifner"] and (n & (n - 1)):
             algo = ALLREDUCE_ALGOS["ring"]  # tuned-style fallback
+        if algo == ALLREDUCE_ALGOS["pallas_ring"] and not op.commutative:
+            # ring chain order != rank order: promote like the other
+            # rings do for non-commutative ops
+            algo = ALLREDUCE_ALGOS["ordered_linear"]
         seg = self._segcount()
         # op keyed by IDENTITY (Op is identity-hashed): two user ops may
         # share a name but carry different kernels
         key = ("allreduce", algo, x.shape, str(x.dtype), op, seg, donate)
 
         def build():
+            from . import pallas_kernels as pk
+
             impl = {
                 ALLREDUCE_ALGOS["psum"]: lambda v: algos.allreduce_psum(v, op, n),
                 ALLREDUCE_ALGOS["ring"]: lambda v: algos.allreduce_ring(v, op, n),
@@ -203,8 +237,11 @@ class XlaCollModule(CollModule):
                 ALLREDUCE_ALGOS["recursive_doubling"]: lambda v: algos.allreduce_recursive_doubling(v, op, n),
                 ALLREDUCE_ALGOS["rabenseifner"]: lambda v: algos.allreduce_rabenseifner(v, op, n),
                 ALLREDUCE_ALGOS["ordered_linear"]: lambda v: algos.allreduce_ordered_linear(v, op, n),
+                ALLREDUCE_ALGOS["pallas_ring"]: lambda v: pk.ring_allreduce(v, op, n),
             }[algo]
-            return self._spmd(lambda v: impl(v[0])[None], donate=donate)
+            return self._spmd(
+                lambda v: impl(v[0])[None], donate=donate,
+                pallas=algo == ALLREDUCE_ALGOS["pallas_ring"])
 
         return self._compiled(key, build)
 
@@ -294,12 +331,17 @@ class XlaCollModule(CollModule):
         key = ("allgather", algo, x.shape, str(x.dtype))
 
         def build():
+            from . import pallas_kernels as pk
+
             impl = {
                 ALLGATHER_ALGOS["direct"]: lambda v: algos.allgather_direct(v, n),
                 ALLGATHER_ALGOS["ring"]: lambda v: algos.allgather_ring(v, n),
                 ALLGATHER_ALGOS["bruck"]: lambda v: algos.allgather_bruck(v, n),
+                ALLGATHER_ALGOS["pallas_ring"]: lambda v: pk.ring_allgather(v, n),
             }[algo]
-            return self._spmd(lambda v: impl(v[0])[None])
+            return self._spmd(
+                lambda v: impl(v[0])[None],
+                pallas=algo == ALLGATHER_ALGOS["pallas_ring"])
 
         return self._compiled(key, build)
 
@@ -392,22 +434,30 @@ class XlaCollModule(CollModule):
                 algo = REDUCE_SCATTER_ALGOS["ordered"]
         if algo == REDUCE_SCATTER_ALGOS["direct"] and op.lax_collective != "psum":
             algo = REDUCE_SCATTER_ALGOS["ring"]
-        if algo == REDUCE_SCATTER_ALGOS["ring"] and not op.commutative:
+        if algo in (REDUCE_SCATTER_ALGOS["ring"],
+                    REDUCE_SCATTER_ALGOS["pallas_ring"]) \
+                and not op.commutative:
             # ring's chain order starts at (b+1)%n — wrong result for
             # non-commutative ops; promote to the rank-ordered path
             algo = REDUCE_SCATTER_ALGOS["ordered"]
         key = ("reduce_scatter_block", algo, x.shape, str(x.dtype), op)
 
         def build():
+            from . import pallas_kernels as pk
+
             if algo == REDUCE_SCATTER_ALGOS["direct"]:
                 per_dev = lambda v: jax.lax.psum_scatter(
                     v[0], AXIS, scatter_dimension=0, tiled=True
                 )
             elif algo == REDUCE_SCATTER_ALGOS["ordered"]:
                 per_dev = lambda v: algos.reduce_scatter_ordered(v[0], op, n)[None]
+            elif algo == REDUCE_SCATTER_ALGOS["pallas_ring"]:
+                per_dev = lambda v: pk.ring_reduce_scatter(v[0], op, n)[None]
             else:
                 per_dev = lambda v: algos.reduce_scatter_ring(v[0], op, n)[None]
-            return self._spmd(per_dev)
+            return self._spmd(
+                per_dev,
+                pallas=algo == REDUCE_SCATTER_ALGOS["pallas_ring"])
 
         return self._compiled(key, build)
 

@@ -33,6 +33,7 @@ from typing import Any, Callable, Iterable, Type
 from .var import (
     VarStore,
     full_var_name,
+    register_device_vars,
     register_observability_vars,
     register_robustness_vars,
     register_schedule_vars,
@@ -249,6 +250,7 @@ class MCAContext:
         register_schedule_vars(self.store)
         register_serving_vars(self.store)
         register_transport_vars(self.store)
+        register_device_vars(self.store)
         self.frameworks: dict[str, Framework] = {}
         self._register_builtin_components()
 

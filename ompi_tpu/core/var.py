@@ -606,6 +606,46 @@ def register_transport_vars(store: "VarStore") -> None:
         store.register(fw, comp, name, default, type=typ, help=help_)
 
 
+# -- device-plane variables (central registration, same pattern) ----------
+#
+# The third DCN plane: the device-resident zero-copy transport
+# (ompi_tpu/dcn/device.py).  Large contiguous payloads stay in device
+# memory end-to-end (HBM→HBM DMA windows on TPU; deterministic
+# shared-memory window emulation on CPU so tier-1 exercises the
+# RTS/CTS↔semaphore protocol and the plane arbitration), while the
+# host planes keep carrying control frames and non-contiguous
+# datatypes.  Consumed by the DCN engines at creation but
+# introspectable on every store like the other central sets.
+
+#: (framework, component, name, default, type, help)
+DEVICE_VARS = (
+    ("dcn", "device", "enable", True, "bool",
+     "Arm the device-resident zero-copy DCN plane: payloads at or "
+     "above dcn_device_min_size that are contiguous and device-"
+     "stageable move through per-transfer device windows (HBM→HBM "
+     "DMA on TPU; shared-memory window emulation on CPU) while the "
+     "host plane carries only the RTS/fin control frames.  Off: "
+     "every byte keeps the host shm/tcp rings"),
+    ("dcn", "device", "min_size", 1 << 20, "int",
+     "Smallest payload (bytes) the plane arbitration routes onto the "
+     "device plane (the btl-priority/reachability analog: below it "
+     "the host ring's lower setup cost wins; at or above it the "
+     "zero-copy window wins).  Non-contiguous or object-dtype "
+     "payloads stay on the host plane at every size"),
+    ("dcn", "device", "interpret", False, "bool",
+     "Force the Pallas ring-collective kernels through interpret "
+     "mode (CPU-debuggable execution of the same kernel bodies); "
+     "default off — real TPU lowering on TPU, the structured "
+     "ring-permute emulation elsewhere"),
+)
+
+
+def register_device_vars(store: "VarStore") -> None:
+    """Register the device-plane knobs on a store (idempotent)."""
+    for fw, comp, name, default, typ, help_ in DEVICE_VARS:
+        store.register(fw, comp, name, default, type=typ, help=help_)
+
+
 # -- compiled-schedule-cache variables (central registration) ------------
 #
 # The persistent-collective plan store (ompi_tpu/coll/sched.py + the C

@@ -136,6 +136,13 @@ class DcnCollEngine:
         # to its proc and marks it failed before the transport raises
         # MPIProcFailedError
         self.transport.on_peer_failed = self._transport_peer_failed
+        # the device-resident zero-copy plane (dcn/device.py): large
+        # contiguous payloads move through device windows while this
+        # transport carries only their descriptor control frames; None
+        # when disabled/unsupported — one attribute test per send
+        from . import device as _device
+
+        self._device_plane = _device.maybe_create(proc, nprocs)
         # the transports' handshake clock samples, mapped to procs —
         # the cross-rank merge's skew correction (metrics snapshots
         # and telemetry frames carry the merged view)
@@ -437,6 +444,15 @@ class DcnCollEngine:
                 self._root_engine().coll_revoke(env["cid"])
             return
         if env.get("kind") == "p2p":
+            desc = env.pop("dev", None)
+            if desc is not None:
+                # device-plane p2p: the frame carried only the window
+                # descriptor — materialize before matching (the recv-
+                # semaphore wait runs on the delivery thread; bounded
+                # by the shared recv deadline)
+                from . import device as _device
+
+                payload = _device.materialize(self._root_engine(), desc)
             cid = env.get("cid")
             with self._p2p_lock:
                 fn = self._p2p_handlers.get(cid)
@@ -457,6 +473,18 @@ class DcnCollEngine:
         env = {"kind": "coll", "cid": cid, "seq": seq, "src": self.proc}
         if meta is not None:
             env["meta"] = meta
+        # plane arbitration (size / layout / reachability): a large
+        # contiguous payload rides a device window and the host plane
+        # carries only its descriptor — the RTS of the DMA protocol
+        from . import device as _device
+
+        desc = _device.try_stage(self._root_engine(), payload,
+                                 self.root_proc_of(dst))
+        if desc is not None:
+            env[_device.DESC_KEY] = desc
+            self.transport.send(self.addresses[dst], env,
+                                np.zeros(0, np.uint8))
+            return
         self.transport.send(self.addresses[dst], env, payload)
 
     def _recv(self, src: int, cid: int, seq: int,
@@ -520,6 +548,17 @@ class DcnCollEngine:
                 # withdraw an unconsumed posting (frame raced ahead of
                 # the registration, or this wait errored out)
                 self.transport.discard_posted(cid, seq, src)
+        env, payload = got
+        desc = env.pop("dev", None)
+        if desc is not None:
+            # device-plane delivery: the frame was only the window
+            # descriptor — run the recv-semaphore wait and materialize
+            # (straight into the posted buffer when one matches)
+            from . import device as _device
+
+            payload = _device.materialize(self._root_engine(), desc,
+                                          into=into)
+            got = (env, payload)
         self._note_peer_activity(src)
         # (cid, seq, src) keys are single-use (seqs are monotonic per
         # stream), and the producer's put necessarily preceded this get
@@ -554,6 +593,15 @@ class DcnCollEngine:
     def send_p2p(self, dst_proc: int, envelope: dict, payload: np.ndarray) -> None:
         envelope = dict(envelope)
         envelope["kind"] = "p2p"
+        from . import device as _device
+
+        desc = _device.try_stage(self._root_engine(), payload,
+                                 self.root_proc_of(dst_proc))
+        if desc is not None:
+            envelope[_device.DESC_KEY] = desc
+            self.transport.send(self.addresses[dst_proc], envelope,
+                                np.zeros(0, np.uint8))
+            return
         self.transport.send(self.addresses[dst_proc], envelope, payload)
 
     def local_proc_of(self, root_proc: int):
@@ -763,6 +811,8 @@ class DcnCollEngine:
         return DcnJoinEngine(self, addresses, proc)
 
     def close(self) -> None:
+        if getattr(self, "_device_plane", None) is not None:
+            self._device_plane.close()
         self.transport.close()
 
 

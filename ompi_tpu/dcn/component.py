@@ -6,6 +6,18 @@ rebuild").  The transport itself is :mod:`ompi_tpu.dcn.tcp`; this
 component owns its MCA variables, mirroring the reference's
 ``btl_tcp_eager_limit`` / ``btl_tcp_max_send_size`` knob family and
 the pml-level eager↔rendezvous switch (SURVEY.md §2.2 pml ob1).
+
+Plane arbitration note: whichever host btl this framework selects,
+the engines layer the **device-resident zero-copy plane**
+(:mod:`ompi_tpu.dcn.device`) above it — the rendezvous protocol picks
+the plane per message from (``dcn_device_min_size``, dtype
+contiguity, host reachability), mirroring the reference's btl
+priority/reachability selection across sm/tcp/ofi.  The device plane
+is an overlay, not a btl of its own: it is never selected by
+``--mca btl`` (its descriptor control frames always ride the selected
+host transport), and its knobs live in the central ``DEVICE_VARS``
+table (``core/var.py``) because both the Python and native engines
+consume them.
 """
 
 from __future__ import annotations

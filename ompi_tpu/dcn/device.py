@@ -1,0 +1,452 @@
+"""Device-resident zero-copy DCN plane — the third transport.
+
+The host planes (``btl/tcp``, ``btl/sm``, ``btl/native``) move every
+inter-rank byte through host shm/tcp rings: the ring is the bandwidth
+ceiling and the host hop sets the latency floor.  This plane keeps
+large contiguous payloads in *device* memory end-to-end, the way
+``pltpu.make_async_remote_copy`` issues an RDMA-style HBM→HBM DMA
+between devices with send/recv semaphores (SNIPPETS.md [1]); the host
+plane keeps carrying control frames and non-contiguous datatypes, and
+the rendezvous protocol picks the plane per message — the same
+priority/reachability arbitration the reference's btl framework runs
+across sm/tcp/ofi (SURVEY §2.3).
+
+Protocol mapping (RTS/CTS ↔ DMA semaphores):
+
+* **RTS** — the sender opens a per-transfer device window, issues the
+  DMA (``start()``), and ships a control frame carrying the window
+  descriptor over the host plane.  The descriptor frame IS the
+  send-semaphore start: it may arrive before the DMA lands.
+* **recv-semaphore wait** — the receiver attaches the window and
+  waits on the window's semaphore word until the DMA completion
+  signal (``SEM_DATA``) is visible; only then is the payload read.
+  ``device_dma_waits`` / ``device_dma_wait_ns`` count the waits that
+  actually blocked (the semaphore-ordering half of the protocol).
+* **CTS / send-semaphore wait** — the receiver signals
+  ``SEM_CONSUMED`` after materializing; the sender's *reap* collects
+  consumed windows (the send-semaphore wait) and retires them.
+
+Degradation: tier-1 runs under ``JAX_PLATFORMS=cpu``, so the DMA leg
+is **emulated deterministically**: the device window is a POSIX
+shared-memory segment whose header carries the semaphore word, the
+"DMA" is one memcpy into the window, and the completion signal is the
+same plain-int64 store the host ``_ShmRing`` counters use (x86 TSO;
+see that class's memory-ordering note).  The protocol, semaphore
+ordering, arbitration logic, and counters are identical to the real
+leg — only the copy engine differs — so tests exercise the whole
+plane on CPU while the real-DMA path stays gated behind the TPU-only
+bench leg (``bench.py`` ``device_plane``).
+
+Reachability: device windows (like HBM DMA) only span a host/fabric;
+a peer on another host (``OMPI_TPU_HOST_IDS``) stays on the host
+plane — the reference's reachability half of btl selection.
+
+Counters (``dcn_device_*`` MPI_T pvars via the PR-2 provider merge):
+``device_sends``/``device_recvs``, ``device_bytes_placed`` (bytes a
+DMA placed into a window), ``device_dma_waits``/``device_dma_wait_ns``
+(recv-semaphore waits that blocked), ``device_arb_device``/
+``device_arb_host`` (plane-arbitration decisions), and
+``device_fallbacks`` (eligible sends that degraded to the host plane
+because the window could not be opened).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+#: semaphore word states (window header slot 0)
+SEM_EMPTY, SEM_DATA, SEM_CONSUMED = 0, 1, 2
+
+#: window header: [0:8) semaphore word, [8:16) payload length
+_HDR = 16
+
+#: counter schema — every key appears in the native counter merge
+#: (metrics.core.NATIVE_COUNTERS tail) so the plane surfaces as
+#: ``dcn_device_*`` pvars next to the host planes' counters
+STATS_KEYS = (
+    "device_sends", "device_recvs", "device_bytes_placed",
+    "device_dma_waits", "device_dma_wait_ns",
+    "device_arb_device", "device_arb_host", "device_fallbacks",
+)
+
+#: descriptor key the control frame carries (collops attaches it to
+#: the coll envelope as ``dev``; the native plane rides the meta JSON
+#: under the same key)
+DESC_KEY = "dev"
+
+
+def _untrack_shm(name: str) -> None:
+    """Detach from the resource tracker: window lifetime is protocol-
+    owned (sender reaps after the consumed signal)."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+
+
+def device_tuning() -> tuple[bool, int, bool]:
+    """Resolve (enable, min_size, interpret) against the default MCA
+    context, falling back to the central DEVICE_VARS defaults (bare
+    engines in unit tests) — the transport_tuning() pattern."""
+    from ompi_tpu.core.var import DEVICE_VARS, full_var_name
+
+    vals: dict[str, object] = {
+        full_var_name(fw, comp, name): default
+        for fw, comp, name, default, _typ, _h in DEVICE_VARS
+    }
+    try:
+        from ompi_tpu.core import mca
+
+        store = mca.default_context().store
+        for full in vals:
+            v = store.get(full)
+            if v is not None:
+                vals[full] = v
+    except Exception:  # noqa: BLE001 — pre-init / teardown: defaults
+        pass
+    return (bool(vals["dcn_device_enable"]),
+            int(vals["dcn_device_min_size"]),
+            bool(vals["dcn_device_interpret"]))
+
+
+class DeviceWindow:
+    """One per-transfer device window — the emulated HBM exposure.
+
+    Layout: ``[0:8)`` semaphore word (int64; SEM_* states), ``[8:16)``
+    payload length, ``[16:)`` payload bytes.  The semaphore publish is
+    a plain int64 store after the payload copy — safe on x86 TSO, the
+    same contract ``_ShmRing`` documents (the host-plane control frame
+    orders sender→receiver; the word orders DMA→read)."""
+
+    def __init__(self, name: str, size: int, create: bool):
+        from multiprocessing import shared_memory
+
+        self.seg = shared_memory.SharedMemory(
+            name=name, create=create, size=size + _HDR if create else 0)
+        _untrack_shm(name)
+        self.name = name
+        self._ctr = np.frombuffer(self.seg.buf, np.int64, count=2)
+        self._data = np.frombuffer(self.seg.buf, np.uint8, offset=_HDR)
+        if create:
+            self._ctr[0] = SEM_EMPTY
+            self._ctr[1] = 0
+
+    # -- sender side (the DMA) ------------------------------------------
+
+    def place(self, raw: memoryview) -> None:
+        """The emulated HBM→HBM DMA: one copy into the window, then
+        the completion signal (recv-semaphore value) publishes."""
+        n = len(raw)
+        if n:
+            self._data[:n] = np.frombuffer(raw, np.uint8)
+        self._ctr[1] = n
+        self._ctr[0] = SEM_DATA  # publish AFTER the payload (TSO)
+
+    # -- receiver side (semaphore wait + read) --------------------------
+
+    def sem(self) -> int:
+        return int(self._ctr[0])
+
+    def wait_data(self, deadline) -> None:
+        """The recv-semaphore wait: spin (with backoff) until the DMA
+        completion signal is visible, bounded by the shared DCN
+        deadline policy."""
+        sleep = 0.0
+        while int(self._ctr[0]) < SEM_DATA:
+            deadline.check("device window: DMA completion signal "
+                           "not visible (sender stalled or dead)")
+            time.sleep(sleep)
+            sleep = min(0.001, sleep + 0.00005)
+
+    def read_into(self, out: memoryview, n: int) -> None:
+        np.frombuffer(out[:n], np.uint8)[:] = self._data[:n]
+
+    def consume(self) -> None:
+        """The CTS analog: signal the sender's send-semaphore wait
+        (reap) that this window can be retired."""
+        self._ctr[0] = SEM_CONSUMED
+
+    def close(self, unlink: bool = False) -> None:
+        if unlink:
+            # raw shm_unlink, NOT SharedMemory.unlink(): creation
+            # already detached the segment from the resource tracker
+            # (protocol-owned lifetime), and the stdlib unlink would
+            # unregister a second time — the tracker process logs a
+            # KeyError traceback for every window otherwise
+            try:
+                import _posixshmem
+
+                _posixshmem.shm_unlink("/" + self.name)
+            except FileNotFoundError:
+                pass
+            except (ImportError, OSError):
+                try:
+                    self.seg.unlink()
+                except FileNotFoundError:
+                    pass
+        # release the numpy views BEFORE the mapping: they export
+        # pointers from seg.buf, and SharedMemory.close() (here or in
+        # the destructor) raises BufferError while exports exist
+        self._ctr = None
+        self._data = None
+        try:
+            self.seg.close()
+        except BufferError:  # a payload view escaped: the destructor
+            pass             # retries after GC drops it
+
+
+class DevicePlane:
+    """Per-engine device-plane state: arbitration, window lifecycle,
+    and the ``dcn_device_*`` counter block (a metrics provider like
+    the host transports)."""
+
+    def __init__(self, proc: int, min_size: int | None = None,
+                 hosts: list[int] | None = None):
+        self.proc = int(proc)
+        if min_size is None:  # every real caller resolved tuning already
+            min_size = device_tuning()[1]
+        self.min_size = int(min_size)
+        #: per-rank host index when the launcher published a host map
+        #: (reachability: device windows only span one host)
+        self.hosts = hosts
+        self.stats: dict[str, int] = {k: 0 for k in STATS_KEYS}
+        self._wids = itertools.count(1)
+        #: sender-owned windows awaiting the consumed signal (reap)
+        self._tx: dict[int, DeviceWindow] = {}
+        #: receiver-attached windows (closed on materialize)
+        self._lock = threading.Lock()
+        self._running = True
+        from ompi_tpu.metrics import core as _mcore
+
+        _mcore.register_provider(self, self._stats_snapshot)
+
+    # -- plane arbitration (the btl priority/reachability pick) ---------
+
+    def reachable(self, dst_root_proc: int | None) -> bool:
+        """Device windows span one host: a peer with a DIFFERENT host
+        index in the launcher's map is unreachable on this plane."""
+        if self.hosts is None or dst_root_proc is None:
+            return True
+        if not 0 <= dst_root_proc < len(self.hosts):
+            return False
+        return self.hosts[dst_root_proc] == self.hosts[self.proc]
+
+    def eligible(self, payload) -> bool:
+        """Size/layout half of the arbitration — no counters (callers
+        that only probe use this; :meth:`arbitrate` counts)."""
+        if not isinstance(payload, np.ndarray):
+            return False
+        if payload.nbytes < self.min_size:
+            return False
+        if payload.dtype.hasobject:
+            return False
+        return bool(payload.flags["C_CONTIGUOUS"])
+
+    def arbitrate(self, payload, dst_root_proc: int | None = None) -> bool:
+        """THE per-message plane decision: True routes the payload
+        onto the device plane.  Every decision is counted
+        (``device_arb_device`` / ``device_arb_host``)."""
+        take = (self._running and self.eligible(payload)
+                and self.reachable(dst_root_proc))
+        self.stats["device_arb_device" if take else
+                   "device_arb_host"] += 1
+        return take
+
+    # -- sender: stage (DMA start) + reap (send-semaphore wait) ---------
+
+    def stage(self, arr: np.ndarray) -> dict | None:
+        """Open a window, ship the descriptor, ISSUE the DMA:
+        returns the descriptor the host-plane control frame carries,
+        or None when the window cannot be opened (the caller degrades
+        to the host plane and counts ``device_fallbacks``).
+
+        Ordering note: the window is created with SEM_EMPTY and the
+        descriptor may be read by the receiver BEFORE ``place()``
+        publishes the completion signal — the receiver's semaphore
+        wait (not frame order) is what orders the read after the DMA,
+        exactly like the real send/recv DMA semaphore pair."""
+        self.reap()
+        wid = next(self._wids)
+        name = f"tpudev-{os.getpid()}-{wid}-{id(self) & 0xffff:x}"
+        try:
+            win = DeviceWindow(name, arr.nbytes, create=True)
+        except OSError:
+            self.stats["device_fallbacks"] += 1
+            return None
+        with self._lock:
+            self._tx[wid] = win
+        desc = {
+            "w": name, "n": int(arr.nbytes),
+            "dt": arr.dtype.str, "sh": list(arr.shape),
+        }
+        # the DMA: on TPU this is make_async_remote_copy start();
+        # the emulation is one memcpy + the semaphore publish
+        win.place(memoryview(arr).cast("B") if arr.nbytes
+                  else memoryview(b""))
+        self.stats["device_sends"] += 1
+        self.stats["device_bytes_placed"] += int(arr.nbytes)
+        return desc
+
+    def reap(self) -> int:
+        """Send-semaphore wait, non-blocking form: retire every window
+        the receiver has signalled consumed.  Returns the number
+        retired (close() sweeps the rest)."""
+        done = []
+        with self._lock:
+            for wid, win in list(self._tx.items()):
+                if win.sem() >= SEM_CONSUMED:
+                    done.append(win)
+                    del self._tx[wid]
+        for win in done:
+            win.close(unlink=True)
+        return len(done)
+
+    def pending_windows(self) -> int:
+        with self._lock:
+            return len(self._tx)
+
+    # -- receiver: recv-semaphore wait + materialize --------------------
+
+    def receive(self, desc: dict, into: np.ndarray | None = None):
+        """Materialize one device-plane payload from its descriptor:
+        attach the window, run the recv-semaphore wait, then land the
+        bytes.  With a matching posted ``into`` buffer the window
+        bytes go straight to it (on the real leg the DMA would target
+        it; identity tells the caller nothing is left to copy).
+        """
+        return receive(desc, into=into, stats=self.stats)
+
+    # -- provider / lifecycle -------------------------------------------
+
+    # (module-level receive() below is the plane-less twin — a rank
+    # whose plane is disabled can still materialize a misconfigured
+    # peer's descriptor frames instead of delivering empty payloads)
+
+    def _stats_snapshot(self) -> dict[str, int] | None:
+        return dict(self.stats) if self._running else None
+
+    def close(self) -> None:
+        self._running = False
+        with self._lock:
+            wins = list(self._tx.values())
+            self._tx.clear()
+        for win in wins:
+            win.close(unlink=True)
+
+
+def try_stage(root_engine, payload, dst_root_proc):
+    """Sender-side plane pick, shared by every send site (both
+    engines' coll streams and the p2p path): arbitrate, then stage
+    through the engine's plane.  Returns the window descriptor the
+    host-plane control frame carries, or None when the payload stays
+    on the host plane (no plane armed, arbitration said host, or the
+    window could not open — ``device_fallbacks`` counted by stage)."""
+    dp = getattr(root_engine, "_device_plane", None)
+    if dp is None or not isinstance(payload, np.ndarray):
+        return None
+    if not dp.arbitrate(payload, dst_root_proc):
+        return None
+    return dp.stage(payload)
+
+
+def materialize(root_engine, desc: dict,
+                into: np.ndarray | None = None):
+    """Receiver-side plane pick, shared by every delivery site (both
+    engines' coll streams and the p2p path): materialize through the
+    engine's plane when one is armed (counters tick), else the
+    plane-less twin — a rank whose plane is disabled can still land a
+    misconfigured peer's descriptor frames."""
+    dp = getattr(root_engine, "_device_plane", None)
+    return (dp.receive(desc, into=into) if dp is not None
+            else receive(desc, into=into))
+
+
+def receive(desc: dict, into: np.ndarray | None = None,
+            stats: dict | None = None):
+    """Receiver half of the device protocol: attach the descriptor's
+    window, run the recv-semaphore wait (counted when it actually
+    blocked), land the bytes (straight into a matching posted buffer
+    when given), signal consumed, detach."""
+    from ompi_tpu.core.var import Deadline
+
+    name, nbytes = str(desc["w"]), int(desc["n"])
+    dt = np.dtype(str(desc.get("dt", "u1")))
+    shape = tuple(desc.get("sh") or (0,))
+    win = DeviceWindow(name, 0, create=False)
+    try:
+        if win.sem() < SEM_DATA:
+            # the descriptor outran the DMA: this IS the semaphore
+            # wait the protocol exists for — count it
+            t0 = time.perf_counter_ns()
+            win.wait_data(Deadline.for_timeout("recv"))
+            if stats is not None:
+                stats["device_dma_waits"] += 1
+                stats["device_dma_wait_ns"] += (
+                    time.perf_counter_ns() - t0)
+        if (into is not None and isinstance(into, np.ndarray)
+                and into.flags["C_CONTIGUOUS"]
+                and into.dtype == dt
+                and tuple(into.shape) == shape
+                and into.nbytes == nbytes):
+            if nbytes:
+                win.read_into(memoryview(into).cast("B"), nbytes)
+            out = into
+        else:
+            out = np.empty(shape, dt)
+            if nbytes:
+                win.read_into(memoryview(out).cast("B"), nbytes)
+        win.consume()
+        if stats is not None:
+            stats["device_recvs"] += 1
+        return out
+    finally:
+        # consumer-side unlink: the window name dies with consumption
+        # (POSIX keeps both mappings valid), so cleanup is prompt even
+        # when the sender never sends again; the sender's reap/close
+        # tolerates the already-unlinked name
+        win.close(unlink=True)
+
+
+def maybe_create(proc: int, nprocs: int) -> DevicePlane | None:
+    """Engine hook: a DevicePlane when ``dcn_device_enable`` is on
+    (the default), else None — one attribute test per send after.
+    Parses the launcher's host map (``OMPI_TPU_HOST_IDS``) for the
+    reachability half of the arbitration."""
+    en, msize, _interp = device_tuning()
+    if not en:
+        return None
+    import platform
+    import sys
+
+    if sys.platform != "linux" or \
+            platform.machine().lower() not in ("x86_64", "amd64"):
+        # the emulated windows lean on the same abstract-shm + TSO
+        # contract as btl/sm; elsewhere the plane silently stays off
+        return None
+    hosts: list[int] | None = None
+    raw = os.environ.get("OMPI_TPU_HOST_IDS", "")
+    if raw:
+        # a PRESENT host map that cannot be trusted (unparseable, or
+        # its length no longer matches this world — e.g. a resized
+        # job's inherited env) means the topology is UNKNOWN: fail
+        # closed and keep every byte on the host plane.  Treating it
+        # as "all same-host" would ship shm-window descriptors to a
+        # peer on another machine, which drops the message and
+        # deadline-escalates a live sender.  Absent map = single-host
+        # launch (tpurun only publishes the env when it has a host
+        # map), where same-host is a fact, not a guess.
+        try:
+            parsed = [int(x) for x in raw.split(",") if x.strip() != ""]
+        except ValueError:
+            return None
+        if len(parsed) != int(nprocs):
+            return None
+        hosts = parsed
+    return DevicePlane(proc, min_size=msize, hosts=hosts)

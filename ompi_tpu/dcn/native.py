@@ -162,6 +162,23 @@ def load_library():
         lib.tdcn_set_address_one.argtypes = [P, I, S, I]
         lib.tdcn_set_resolver.argtypes = [P, RESOLVER_FN]
         lib.tdcn_coll_revoke_cid.argtypes = [P, S]
+        lib.tdcn_coll_optime.restype = I
+        lib.tdcn_coll_optime.argtypes = [P, I,
+                                         ctypes.POINTER(ctypes.c_uint64),
+                                         I]
+        # the C collective fast-path surface (normally driven by the
+        # shim; declared here so in-process tests/tools can exercise
+        # the coll recv_into + per-op timing legs with correct widths)
+        lib.tdcn_coll_open.restype = U64
+        lib.tdcn_coll_open.argtypes = [P, S, I, I,
+                                       ctypes.POINTER(ctypes.c_char_p),
+                                       U64]
+        lib.tdcn_coll_plan.restype = U64
+        lib.tdcn_coll_plan.argtypes = [P, U64, I, I, I, I64, I, I]
+        lib.tdcn_coll_start.restype = I
+        lib.tdcn_coll_start.argtypes = [P, U64, ctypes.c_void_p,
+                                        ctypes.c_void_p]
+        lib.tdcn_coll_close.argtypes = [P, U64]
         lib.tdcn_set_ring_timeout.argtypes = [P, D]
         lib.tdcn_set_connect_timeout.argtypes = [P, D]
         lib.tdcn_free.argtypes = [ctypes.c_void_p]
@@ -389,11 +406,30 @@ class _NativeOpsMixin:
     def _send(self, dst: int, cid, seq: int, payload: np.ndarray,
               meta=None) -> None:
         root = self._native_root()
-        arr = np.ascontiguousarray(payload)
         if _fsim._enabled and self._fsim_drop():
             return  # lost record: the receiver's deadline escalates
+        # plane arbitration (dcn/device.py): a large contiguous payload
+        # rides a device window; the C host plane carries only its
+        # descriptor (in the meta JSON) — same protocol as the Python
+        # engine, so mixed-size schedules interleave planes freely
+        from . import device as _device
+
+        msg_nbytes = payload.nbytes if isinstance(payload, np.ndarray) \
+            else None
+        desc = (_device.try_stage(root, payload, self.root_proc_of(dst))
+                if meta is None or isinstance(meta, dict) else None)
+        if desc is not None:
+            meta = dict(meta) if meta else {}
+            meta[_device.DESC_KEY] = desc
+            payload = np.zeros(0, np.uint8)
+        arr = np.ascontiguousarray(payload)
         if _metrics._enabled:
-            _metrics.observe_size("dcn_coll_send", arr.nbytes)
+            # sample the MESSAGE size, not the wire record's: a
+            # device-routed payload ships an empty descriptor frame
+            # but the op still moved msg_nbytes
+            _metrics.observe_size(
+                "dcn_coll_send",
+                msg_nbytes if msg_nbytes is not None else arr.nbytes)
             from ompi_tpu.metrics import flight as _flight
 
             _flight.check_watermarks()
@@ -406,9 +442,10 @@ class _NativeOpsMixin:
 
     def _recv_full(self, src: int, cid, seq: int,
                    timeout: float | None = None, into=None):
-        # `into` (the Python transports' recv_into posting) is accepted
-        # for interface parity but unused: the C coll-slot delivery owns
-        # its payload; callers fall back to their copy on non-identity
+        # `into` (the Python transports' recv_into posting): the C
+        # coll-slot delivery owns its payload (callers fall back to
+        # their copy on non-identity), but a DEVICE-plane descriptor
+        # frame materializes straight into it below
         from ompi_tpu.core.var import Deadline, dcn_timeout
 
         if timeout is None:
@@ -459,9 +496,20 @@ class _NativeOpsMixin:
                 note(fail_idx)  # a delivered frame proves the peer alive
         env = {"cid": cid, "seq": seq, "src": src}
         meta = _meta_of(lib, msg)
+        payload = _wrap_payload(lib, msg)
+        if isinstance(meta, dict) and "dev" in meta:
+            # device-plane delivery: the C frame carried only the
+            # window descriptor — recv-semaphore wait + materialize
+            # (straight into the posted buffer when one matches)
+            from . import device as _device
+
+            desc = meta.pop("dev")
+            payload = _device.materialize(root, desc, into=into)
+            if not meta:
+                meta = None
         if meta is not None:
             env["meta"] = meta
-        return env, _wrap_payload(lib, msg)
+        return env, payload
 
     # -- p2p / control --------------------------------------------------
 
@@ -585,9 +633,20 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         chunk, inflight, coalesce = transport_tuning()
         self._lib.tdcn_set_stream(self._h, chunk, inflight,
                                   1 if coalesce else 0)
+        # the device-resident zero-copy plane (dcn/device.py): coll-
+        # stream payloads arbitrate onto device windows exactly like
+        # the Python engine's; the C p2p channel path keeps the host
+        # plane (the streaming engine owns those lifetimes)
+        from . import device as _device
+
+        self._device_plane = _device.maybe_create(proc, nprocs)
         from ompi_tpu import metrics as _metrics
 
         _metrics.register_provider(self, self.stats_snapshot)
+        # C-fast-path per-op timing rows → the straggler_<op> surfaces
+        from ompi_tpu.metrics import straggler as _straggler
+
+        _straggler.register_native(self, self.coll_optimes)
         if _fsim._enabled:
             # arm the C fault hooks from the seeded plan: the ring
             # writer, the tcp-send connkill site, and the blocking-
@@ -904,6 +963,32 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
 
     # -- transport telemetry --------------------------------------------
 
+    #: CK_* kind index → the straggler/pvar op name (shim CollKind)
+    _COLL_KINDS = ("barrier", "bcast", "reduce", "allreduce",
+                   "allgather")
+
+    def coll_optimes(self) -> dict[str, dict] | None:
+        """Per-op timing rows for the C collective fast path (PR 12's
+        observability edge): {op: {count, wait_ns, max_wait_ns,
+        lat_hist}} — merged by :mod:`ompi_tpu.metrics.straggler` into
+        the ``straggler_<op>`` pvar/prom surfaces, which otherwise
+        only see these collectives through the merged SPC counts."""
+        if not self._running:
+            return None
+        buf = (ctypes.c_uint64 * 19)()
+        out: dict[str, dict] = {}
+        for kind, op in enumerate(self._COLL_KINDS):
+            n = self._lib.tdcn_coll_optime(self._h, kind, buf, len(buf))
+            if n < 3 or not buf[0]:
+                continue
+            out[op] = {
+                "count": int(buf[0]),
+                "wait_ns": int(buf[1]),
+                "max_wait_ns": int(buf[2]),
+                "lat_hist": [int(v) for v in buf[3:n]],
+            }
+        return out
+
     def stats_snapshot(self) -> dict[str, int] | None:
         """The C engine's telemetry block as {name: value} — relaxed
         snapshot (monotone per counter, not mutually consistent).
@@ -959,6 +1044,8 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         if not self._running:
             return
         self._running = False
+        if self._device_plane is not None:
+            self._device_plane.close()
         self._lib.tdcn_close(self._h)
         if self._dispatcher.is_alive():
             self._dispatcher.join(timeout=2.0)

@@ -93,6 +93,17 @@ NATIVE_COUNTERS = (
     # (the AddressTable resolver, either plane) — the np>=16 native
     # boot proof reads addr_installs <= group size instead of P-1
     "addr_installs", "addr_lazy_resolved",
+    # device-plane tail (the third DCN plane, dcn/device.py — the
+    # ``dcn_device_*`` pvar family): transfers sent/received through
+    # device windows, bytes a DMA placed, recv-semaphore waits that
+    # actually blocked (+ their ns), per-message plane-arbitration
+    # decisions, and eligible sends that degraded to the host plane.
+    # Maintained by the Python DevicePlane provider on every engine;
+    # the C block keeps zeroed slots so the two name tables stay the
+    # single source of schema truth
+    "device_sends", "device_recvs", "device_bytes_placed",
+    "device_dma_waits", "device_dma_wait_ns",
+    "device_arb_device", "device_arb_host", "device_fallbacks",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

@@ -64,6 +64,24 @@ _recent: collections.deque = collections.deque(maxlen=_RECENT_CAP)
 #: op → winning coll component (CollTable dispatch notes it; the live
 #: dashboard shows which algorithm a slow op is running)
 _providers: dict[str, str] = {}
+#: native per-op timing sources (the C collective fast path, PR 12's
+#: observability edge): weakref → callable returning {op: {count,
+#: wait_ns, max_wait_ns, lat_hist}} — C-served collectives never
+#: cross Python, so without this merge the straggler_<op> pvar/prom
+#: surfaces only see their merged SPC counts.  Same weakref-anchored
+#: lifetime rules as metrics.core.register_provider.
+_native_providers: list = []
+#: MPI_T reset baselines for the native rows, keyed PER PROVIDER
+#: (id(weakref) → {op: totals}): the C block is append-only so Python
+#: owns reset semantics, and a per-op global baseline would let a
+#: dead engine's lifetime totals suppress a respawned engine's fresh
+#: counts after a pvar_reset — baselines must die with their source.
+#: max_wait_ns stays raw, like the *_hwm counters in the metrics core.
+_native_base: dict[int, dict[str, dict]] = {}
+#: native op names ever observed, first-seen order — the grow-only
+#: pvar-namespace contract holds even after the engine that produced
+#: a row closes (its counts read 0; the NAME never disappears)
+_native_ops_seen: list[str] = []
 
 
 def enabled() -> bool:
@@ -92,7 +110,100 @@ def reset() -> None:
         _ops.clear()
         _recent.clear()
         _providers.clear()
+        _native_providers.clear()
+        _native_base.clear()
+        _native_ops_seen.clear()
         _enabled = False
+
+
+# -- native per-op timing merge (the C collective fast path) ------------
+
+
+def register_native(obj, fn) -> None:
+    """Register a native per-op timing source (a live C engine).
+    ``obj`` anchors the registration lifetime (weakref, like
+    metrics.core.register_provider); closed engines drop out."""
+    import weakref
+
+    try:
+        wfn = weakref.WeakMethod(fn)
+    except TypeError:  # plain function/closure
+        wfn = (lambda f=fn: f)
+    with _lock:
+        _native_providers.append((weakref.ref(obj), wfn))
+
+
+def _provider_rows() -> list[tuple[int, dict[str, dict]]]:
+    """(id(weakref), raw rows) per LIVE native source — the shared
+    sweep the merge and the reset both run; prunes dead
+    registrations (and their baselines — a respawned engine must not
+    inherit its dead predecessor's reset baseline)."""
+    with _lock:
+        live = list(_native_providers)
+    out: list[tuple[int, dict[str, dict]]] = []
+    dead = False
+    for ref, wfn in live:
+        fn = wfn()
+        if ref() is None or fn is None:
+            dead = True
+            continue
+        try:
+            rows = fn()
+        except Exception:  # engine torn down mid-read
+            continue
+        if rows:
+            out.append((id(ref), rows))
+    if dead:
+        with _lock:
+            gone = [id(r) for r, f in _native_providers
+                    if r() is None or f() is None]
+            _native_providers[:] = [
+                (r, f) for r, f in _native_providers
+                if r() is not None and f() is not None]
+            for k in gone:  # baselines die with their source
+                _native_base.pop(k, None)
+    return out
+
+
+def _native_rows() -> dict[str, dict]:
+    """Merged {op: {count, wait_ns, max_wait_ns, lat_hist}} across
+    live native sources, baseline-adjusted PER PROVIDER (reset
+    semantics live here; the C block only grows, and a per-op global
+    baseline would let a dead engine's lifetime totals suppress a
+    respawned engine's fresh counts after a pvar_reset)."""
+    out: dict[str, dict] = {}
+    with _lock:
+        base = {k: {op: dict(v) for op, v in b.items()}
+                for k, b in _native_base.items()}
+    for key, rows in _provider_rows():
+        pb = base.get(key, {})
+        for op, st in rows.items():
+            b = pb.get(op, {})
+            count = max(0, int(st.get("count", 0))
+                        - int(b.get("count", 0)))
+            if not count:
+                continue
+            cur = out.setdefault(op, {"count": 0, "wait_ns": 0,
+                                      "max_wait_ns": 0, "lat_hist": []})
+            cur["count"] += count
+            cur["wait_ns"] += max(0, int(st.get("wait_ns", 0))
+                                  - int(b.get("wait_ns", 0)))
+            cur["max_wait_ns"] = max(cur["max_wait_ns"],
+                                     int(st.get("max_wait_ns", 0)))
+            hist = [int(v) for v in st.get("lat_hist") or []]
+            bh = b.get("lat_hist") or []
+            for i, v in enumerate(hist):
+                hist[i] = max(0, v - (bh[i] if i < len(bh) else 0))
+            if len(hist) > len(cur["lat_hist"]):
+                cur["lat_hist"] += [0] * (len(hist)
+                                          - len(cur["lat_hist"]))
+            for i, v in enumerate(hist):
+                cur["lat_hist"][i] += v
+    with _lock:
+        for op in out:
+            if op not in _native_ops_seen:
+                _native_ops_seen.append(op)
+    return out
 
 
 def _next_seq(comm: str, op: str) -> int:
@@ -147,31 +258,67 @@ def wrap_call(op: str, fn, comm: str = ""):
 # -- introspection (pvars, snapshots, frames) ---------------------------
 
 
-def ops() -> list[str]:
+def ops(refresh: bool = True) -> list[str]:
     """Op names with ≥1 record, FIRST-SEEN order — the
     ``straggler_<op>_*`` pvar namespace (grow-only while profiling
-    runs; reset zeroes in place)."""
-    return list(_ops)
+    runs; reset zeroes in place).  C-fast-path ops append after the
+    Python-recorded ones; once seen they never drop out (a closed
+    engine's counts read 0, but cached pvar indices stay valid).
+
+    ``refresh=False`` skips the native-provider sweep and lists only
+    already-seen ops — the pvar READ path uses it (name→index lookup
+    per read must not pay a ctypes sweep per live engine; discovery
+    entry points like ``pvar_get_num`` refresh)."""
+    if refresh:
+        _native_rows()  # refresh the grow-only seen list
+    out = list(_ops)
+    for op in _native_ops_seen:
+        if op not in out:
+            out.append(op)
+    return out
 
 
-def op_count(op: str) -> int:
+def native_rows() -> dict[str, dict]:
+    """One merged native sweep — pass to the per-op accessors below
+    to read many ops from a single snapshot."""
+    return _native_rows()
+
+
+def op_count(op: str, rows: dict | None = None) -> int:
     st = _ops.get(op)
-    return st["count"] if st else 0
+    n = st["count"] if st else 0
+    nat = (rows if rows is not None else _native_rows()).get(op)
+    return n + (nat["count"] if nat else 0)
 
 
-def op_wait_ns(op: str) -> int:
+def op_wait_ns(op: str, rows: dict | None = None) -> int:
     st = _ops.get(op)
-    return st["wait_ns"] if st else 0
+    n = st["wait_ns"] if st else 0
+    nat = (rows if rows is not None else _native_rows()).get(op)
+    return n + (nat["wait_ns"] if nat else 0)
 
 
 def summary() -> dict[str, dict]:
     """Per-op aggregates (+ serving component when known) — the
-    snapshot/frame section."""
+    snapshot/frame section.  C-fast-path rows (per-op duration
+    emitted from tdcn_coll_start) merge in under the same op keys,
+    carrying their log2-µs latency histogram; a row served by BOTH
+    planes sums counts/waits and keeps the max."""
     with _lock:
-        return {
+        out = {
             op: dict(st, provider=_providers.get(op, ""))
             for op, st in _ops.items()
         }
+    for op, nat in _native_rows().items():
+        st = out.get(op)
+        if st is None:
+            out[op] = dict(nat, provider="cfp")
+            continue
+        st["count"] += nat["count"]
+        st["wait_ns"] += nat["wait_ns"]
+        st["max_wait_ns"] = max(st["max_wait_ns"], nat["max_wait_ns"])
+        st["lat_hist"] = list(nat.get("lat_hist") or [])
+    return out
 
 
 def drain_recent() -> list[list]:
@@ -194,21 +341,54 @@ def recent() -> list[list]:
 
 def zero_stats() -> None:
     """Session-wide pvar_reset: zero aggregates IN PLACE (keys and seq
-    counters survive — cross-rank keys must not desync mid-run)."""
+    counters survive — cross-rank keys must not desync mid-run).  The
+    native C rows re-baseline (the C block only grows; max_wait_ns
+    stays raw, the *_hwm convention)."""
     with _lock:
         for st in _ops.values():
             st["count"] = 0
             st["wait_ns"] = 0
             st["max_wait_ns"] = 0
+    # native rows re-baseline PER PROVIDER: the baseline is a raw-
+    # total snapshot keyed by the provider registration, so it dies
+    # with its engine and can never suppress a respawned successor
+    snaps = _provider_rows()
+    with _lock:
+        for key, rows in snaps:
+            pb = _native_base.setdefault(key, {})
+            for op, st in rows.items():
+                pb[op] = {
+                    "count": int(st.get("count", 0)),
+                    "wait_ns": int(st.get("wait_ns", 0)),
+                    "lat_hist": [int(v)
+                                 for v in st.get("lat_hist") or []],
+                }
 
 
 def reset_op(op: str) -> None:
+    """Per-handle pvar_reset: zero ONE op — including its native
+    C-fast-path rows, which re-baseline per provider exactly like
+    :func:`zero_stats` (the session-wide path), so a per-handle
+    MPI_T_pvar_reset honors the same reset contract."""
     with _lock:
         st = _ops.get(op)
         if st is not None:
             st["count"] = 0
             st["wait_ns"] = 0
             st["max_wait_ns"] = 0
+    snaps = _provider_rows()
+    with _lock:
+        for key, rows in snaps:
+            row = rows.get(op)
+            if row is None:
+                continue
+            pb = _native_base.setdefault(key, {})
+            pb[op] = {
+                "count": int(row.get("count", 0)),
+                "wait_ns": int(row.get("wait_ns", 0)),
+                "lat_hist": [int(v)
+                             for v in row.get("lat_hist") or []],
+            }
 
 
 # -- cross-rank skew (pure helpers shared by aggregator/bench/report) ---

@@ -53,7 +53,7 @@ from ompi_tpu.boot.kvs import KVSClient
 from ompi_tpu.boot.proc import ENV_INCARNATION, ENV_KVS, ENV_NPROCS
 from ompi_tpu.faultsim import core as _fsim
 from . import state as _state
-from .worker import ENV_SERVE_PIDFILE, _PipeSafe
+from .worker import ENV_SERVE_PIDFILE, _PipeSafe, reaim_stdio
 
 #: KVS key prefixes of the agent protocol (daemon mirrors these)
 K_AHB = "serve.agent.hb."        # + <hid>               → heartbeat
@@ -329,6 +329,14 @@ class LaunchAgent:
 
     # -- crash → re-attach (daemon restart) ------------------------------
 
+    def _reaim_logs(self, info: dict) -> None:
+        """Per-agent stdio re-aim (the PR 13 recorded edge): the
+        worker's re-attach protocol, aimed at the per-agent log file
+        named by the restarted daemon's pidfile record, so post-
+        reattach spawn/heartbeat/adoption output is durable."""
+        reaim_stdio(str((info or {}).get("logs") or ""),
+                    f"agent.h{self.hid}.log", f"agent h{self.hid}")
+
     def _reattach(self) -> None:
         if not self.pidfile:
             print(f"agent h{self.hid}: daemon gone and no pidfile; "
@@ -375,6 +383,9 @@ class LaunchAgent:
                             self.session = str(
                                 ack.get("session", f"g{gen}s0"))
                             self.cursor = 0
+                            # the predecessor's rsh pipe died with it:
+                            # make post-adoption output durable
+                            self._reaim_logs(info)
                             print(f"agent h{self.hid}: re-attached to "
                                   f"daemon generation {gen} (session "
                                   f"{self.session})", flush=True)

@@ -119,6 +119,29 @@ class _PipeSafe:
         return getattr(self._f, name)
 
 
+def reaim_stdio(logdir: str, filename: str, banner: str) -> None:
+    """The shared half of the worker/agent stdio re-attach protocol
+    after a daemon crash: both processes' stdout/stderr still point at
+    the DEAD daemon's pipe (_PipeSafe swallowed the breakage) — re-aim
+    them at a per-process log file under the restarted daemon's logs
+    dir so post-reattach output is durable instead of lost.  No-op on
+    an empty logdir; an unusable one keeps the swallowing streams
+    (staying alive outranks durable logs)."""
+    if not logdir:
+        return
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        path = os.path.join(logdir, filename)
+        logf = open(path, "a", buffering=1)
+        for stream in (sys.stdout, sys.stderr):
+            rt = getattr(stream, "retarget", None)
+            if rt is not None:
+                rt(logf)
+        print(f"{banner}: stdio re-aimed at {path}", flush=True)
+    except OSError:
+        pass  # log dir unusable: keep swallowing, stay alive
+
+
 class DaemonLink:
     """The worker's resilient handle on the daemon: job-stream cursor,
     completion-record cache, and the crash→re-attach state machine."""
@@ -273,19 +296,8 @@ class DaemonLink:
         # file the restarted daemon names in its pidfile record, so
         # post-adoption output is durable instead of lost.  The path
         # is surfaced on the daemon's /jobs procs table.
-        logdir = str(info.get("logs") or "")
-        if logdir:
-            try:
-                os.makedirs(logdir, exist_ok=True)
-                path = os.path.join(logdir, f"worker.{ctx.proc}.log")
-                logf = open(path, "a", buffering=1)
-                for stream in (sys.stdout, sys.stderr):
-                    rt = getattr(stream, "retarget", None)
-                    if rt is not None:
-                        rt(logf)
-                print(f"serve: stdio re-aimed at {path}", flush=True)
-            except OSError:
-                pass  # log dir unusable: keep swallowing, stay alive
+        reaim_stdio(str(info.get("logs") or ""),
+                    f"worker.{ctx.proc}.log", "serve")
         print(f"serve: re-attached to daemon generation {gen} "
               f"(cursor {self.cursor})", flush=True)
 

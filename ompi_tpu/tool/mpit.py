@@ -133,7 +133,7 @@ class PvarInfo:
     help: str
 
 
-def _pvar_names() -> list[str]:
+def _pvar_names(refresh: bool = False) -> list[str]:
     """spc counters first (stable indices), then the trace pvars —
     fixed tracer totals plus one count + one latency-histogram pvar
     per (layer, op) with recorded spans — then the metrics pvars:
@@ -172,7 +172,10 @@ def _pvar_names() -> list[str]:
     # like the segments above it
     from ompi_tpu.metrics import straggler as _straggler
 
-    for op in _straggler.ops():
+    # refresh=True runs one native-provider sweep to DISCOVER new
+    # C-fast-path ops; the per-read name lookups pass False so a
+    # cached-index pvar_read never pays a sweep per live engine
+    for op in _straggler.ops(refresh=refresh):
         names.append(f"straggler_{op}_count")
         names.append(f"straggler_{op}_wait_ns")
     return names
@@ -200,7 +203,7 @@ def _trace_pvar_read(name: str):
 
 def pvar_get_num() -> int:
     _check()
-    return len(_pvar_names())
+    return len(_pvar_names(refresh=True))
 
 
 def pvar_get_info(index: int) -> PvarInfo:
@@ -241,7 +244,7 @@ def pvar_get_info(index: int) -> PvarInfo:
 def pvar_index(name: str) -> int:
     _check()
     try:
-        return _pvar_names().index(name)
+        return _pvar_names(refresh=True).index(name)
     except ValueError:
         raise MPIArgError(f"no pvar named {name}") from None
 

@@ -421,11 +421,13 @@ def test_tpurun_np2_metrics_export_and_correlate(tmp_path):
     rtext = rep.stdout.decode()
     assert "stall-cause breakdown" in rtext
     assert "trace correlation:" in rtext
-    # at least one window on each proc joined real spans
+    # at least one window on each proc joined real spans (" 0 trace"
+    # with the leading space: a bare "0 trace span(s)" substring also
+    # matches "10 trace span(s)" and silently discards real joins)
     for p in range(2):
         joined = [l for l in rtext.splitlines()
                   if l.startswith(f"proc {p} snapshot") and
-                  "0 trace span(s)" not in l]
+                  " 0 trace span(s)" not in l]
         assert joined, rtext
 
 

@@ -576,14 +576,19 @@ def test_tpurun_btl_sm_selected():
         assert len(hits) == 2, f"{check}: {hits}\n{out}"
 
 
-def test_bml_routes_same_host_to_sm_and_remote_to_tcp():
+def test_bml_routes_same_host_to_sm_and_remote_to_tcp(monkeypatch):
     """bml/r2 leg selection: peers advertising our host_id ride the
     shared-memory leg; a peer claiming another host rides TCP — and
-    traffic still flows either way (loopback serves as 'remote')."""
+    traffic still flows either way (loopback serves as 'remote').
+    The device-plane overlay is disabled here: this test asserts the
+    HOST legs' byte routing, and the zero-copy plane would otherwise
+    take the >= 1 MiB payload off both of them."""
+    from ompi_tpu.dcn import device as _device
     from ompi_tpu.dcn.collops import DcnCollEngine
     from ompi_tpu.dcn.tcp import BmlTransport
     from ompi_tpu.op import SUM
 
+    monkeypatch.setattr(_device, "maybe_create", lambda *a, **k: None)
     n = 2
     engines = [DcnCollEngine(p, n, transport="bml") for p in range(n)]
     try:

@@ -91,6 +91,82 @@ def test_engine_collectives_over_native(engine_pair):
     assert np.allclose(out[1], [11.0, 22.0])
 
 
+def test_c_coll_recv_into_and_per_op_timing(engine_pair):
+    """PR 12's two recorded C-fast-path edges, closed:
+
+    * **coll recv_into** — a staggered allgather posts the late
+      rank's peer-block destination before the early rank's block
+      arrives, so the payload lands straight in the user buffer
+      (``recv_into_placed`` counts it; the staging copy per peer
+      block is gone);
+    * **per-op timing** — tdcn_coll_start emits per-kind durations;
+      ``coll_optimes()`` reads the rows and the straggler merge
+      surfaces them under ``straggler_<op>`` with a latency
+      histogram (MPI_T sessions used to see only merged SPC
+      counts)."""
+    import ctypes
+    import threading
+    import time as _time
+
+    a, b = engine_pair
+    CK_ALLGATHER = 4
+    DT_DOUBLE = 14
+    count = 1 << 15  # 256 KiB blocks: single ring records
+    addrs = (ctypes.c_char_p * 2)(a.address.encode(), b.address.encode())
+    placed0 = (a.stats_snapshot() or {}).get("recv_into_placed", 0)
+    out = {}
+
+    def run(eng, delay):
+        _time.sleep(delay)
+        cc = eng._lib.tdcn_coll_open(
+            eng._h, b"ri", eng.proc, 2, addrs, 0)
+        plan = eng._lib.tdcn_coll_plan(
+            eng._h, cc, CK_ALLGATHER, 0, DT_DOUBLE, count, 0, -1)
+        sb = np.full(count, float(eng.proc + 1), np.float64)
+        rb = np.zeros(2 * count, np.float64)
+        rc = eng._lib.tdcn_coll_start(
+            eng._h, plan,
+            sb.ctypes.data_as(ctypes.c_void_p),
+            rb.ctypes.data_as(ctypes.c_void_p))
+        out[eng.proc] = (rc, rb, cc)
+
+    # rank 0 sends its block and POSTS its receive first; rank 1's
+    # block arrives against the live posting — deterministic placement
+    ta = threading.Thread(target=run, args=(a, 0.0))
+    tb = threading.Thread(target=run, args=(b, 0.4))
+    ta.start(); tb.start(); ta.join(60); tb.join(60)
+    for p in (0, 1):
+        rc, rb, _cc = out[p]
+        assert rc == 0
+        assert np.all(rb[:count] == 1.0) and np.all(rb[count:] == 2.0)
+    placed = (a.stats_snapshot() or {}).get("recv_into_placed", 0)
+    assert placed >= placed0 + 1, (placed0, placed)
+    # per-op timing rows on both engines
+    for eng in (a, b):
+        ot = eng.coll_optimes()
+        assert ot and "allgather" in ot, ot
+        row = ot["allgather"]
+        assert row["count"] == 1
+        assert row["wait_ns"] > 0
+        assert row["max_wait_ns"] >= row["wait_ns"] // row["count"]
+        assert sum(row["lat_hist"]) == 1
+    # the straggler merge: C rows surface under the same pvar names
+    from ompi_tpu.metrics import straggler
+
+    assert "allgather" in straggler.ops()
+    assert straggler.op_count("allgather") >= 2  # both engines
+    assert straggler.op_wait_ns("allgather") > 0
+    summ = straggler.summary()
+    assert summ["allgather"]["provider"] == "cfp"
+    assert sum(summ["allgather"]["lat_hist"]) >= 2
+    # zero_stats re-baselines the C rows (reset-in-place contract)
+    straggler.zero_stats()
+    assert straggler.op_count("allgather") == 0
+    for _p, (_rc, _rb, cc) in out.items():
+        eng = a if _p == 0 else b
+        eng._lib.tdcn_coll_close(eng._h, cc)
+
+
 def test_matching_engine_wildcards_and_ordering(engine_pair):
     """The C matcher honors the Python engine's contract: arrival
     order per source, ANY_SOURCE/ANY_TAG wildcards, probe without

@@ -866,6 +866,52 @@ def test_pipesafe_retarget_reaims_stdio():
     assert sink.getvalue() == "found\n"
 
 
+def test_agent_reaim_logs_after_daemon_crash(tmp_path):
+    """Per-agent log re-aim (the PR 13 recorded edge): an agent whose
+    daemon died re-aims its _PipeSafe stdio at the per-agent log file
+    named by the restarted daemon's pidfile record — post-adoption
+    output is durable instead of swallowed.  An empty/unusable logs
+    field keeps swallowing without raising."""
+    import sys
+
+    from ompi_tpu.serve.agent import LaunchAgent
+    from ompi_tpu.serve.worker import _PipeSafe
+
+    class _Broken:
+        def write(self, s):
+            raise OSError("broken pipe")
+
+        def flush(self):
+            raise OSError("broken pipe")
+
+    ag = LaunchAgent.__new__(LaunchAgent)
+    ag.hid = 1
+    old_out, old_err = sys.stdout, sys.stderr
+    sys.stdout, sys.stderr = _PipeSafe(_Broken()), _PipeSafe(_Broken())
+    try:
+        print("lost to the dead daemon's pipe")  # swallowed, no raise
+        ag._reaim_logs({"logs": str(tmp_path / "logs")})
+        print("post-adoption line")
+        sys.stdout.flush()
+    finally:
+        sys.stdout, sys.stderr = old_out, old_err
+    path = tmp_path / "logs" / "agent.h1.log"
+    assert path.exists()
+    content = path.read_text()
+    assert "stdio re-aimed" in content
+    assert "post-adoption line" in content
+    assert "lost to the dead" not in content
+    # no logs dir in the pidfile record: stays a silent no-op
+    ag2 = LaunchAgent.__new__(LaunchAgent)
+    ag2.hid = 2
+    sys.stdout = _PipeSafe(_Broken())
+    try:
+        ag2._reaim_logs({})
+        ag2._reaim_logs(None)
+    finally:
+        sys.stdout = old_out
+
+
 # -- multi-host DVM (per-host launch agents over the rsh shim) ---------
 
 
@@ -970,6 +1016,23 @@ def test_tpud_2x2_emulated_hosts_restart_adoption_and_hostkill(tmp_path):
                    if "re-adopted rank" in l) == 4, d2.out()
         assert all(rec["dials_before"] == rec["dials_after"]
                    for rec in (rb.get("ranks") or {}).values()), rb
+        # per-agent log re-aim (the PR 13 recorded edge): the dead
+        # daemon's rsh pipes are gone — every re-attached agent must
+        # have re-aimed its stdio at its per-agent log file in the
+        # restarted daemon's logs dir, so adoption output is durable
+        deadline = time.monotonic() + 20
+        logdir = pidfile + ".logs"
+        while time.monotonic() < deadline:
+            logs = [f for f in (os.listdir(logdir)
+                                if os.path.isdir(logdir) else [])
+                    if f.startswith("agent.h")]
+            if len(logs) >= 2:
+                break
+            time.sleep(0.2)
+        assert sorted(logs) == ["agent.h0.log", "agent.h1.log"], logs
+        for f in logs:
+            assert "stdio re-aimed" in open(
+                os.path.join(logdir, f)).read()
 
         # 3. whole-host kill: a 2-rank gang job runs ON host 0 (ranks
         # 0-1); SIGKILL host 0's agent + workers mid-collective — host

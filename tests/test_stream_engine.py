@@ -105,6 +105,15 @@ _DISPATCH_TAIL = (
 #: native-boot proof reads addr_installs <= group size, not P-1)
 _MODEX_TAIL = ("addr_installs", "addr_lazy_resolved")
 
+#: PR 14 tail: the device-resident zero-copy plane's counters
+#: (maintained by the Python DevicePlane provider; the C block keeps
+#: zeroed slots so the name table stays the single schema truth)
+_DEVICE_TAIL = (
+    "device_sends", "device_recvs", "device_bytes_placed",
+    "device_dma_waits", "device_dma_wait_ns",
+    "device_arb_device", "device_arb_host", "device_fallbacks",
+)
+
 
 def test_stats_tail_appended_not_reordered():
     native = _native()
@@ -121,7 +130,9 @@ def test_stats_tail_appended_not_reordered():
     assert tuple(names[n0:n0 + len(_STREAM_TAIL)]) == _STREAM_TAIL
     n1 = n0 + len(_STREAM_TAIL)
     assert tuple(names[n1:n1 + len(_DISPATCH_TAIL)]) == _DISPATCH_TAIL
-    assert tuple(names[n1 + len(_DISPATCH_TAIL):]) == _MODEX_TAIL
+    n2 = n1 + len(_DISPATCH_TAIL)
+    assert tuple(names[n2:n2 + len(_MODEX_TAIL)]) == _MODEX_TAIL
+    assert tuple(names[n2 + len(_MODEX_TAIL):]) == _DEVICE_TAIL
     assert mcore.NATIVE_STATS_VERSION == 1
     # gauges classified so monotonicity checks skip them
     assert {"stream_depth", "stream_inflight"} <= set(mcore.GAUGES)

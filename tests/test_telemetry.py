@@ -104,6 +104,36 @@ def test_straggler_record_aggregates_and_pvars():
     assert straggler.drain_recent() == []
 
 
+def test_straggler_reset_op_rebaselines_native_rows():
+    """Per-handle MPI_T_pvar_reset must re-baseline the C-fast-path
+    rows exactly like the session-wide zero_stats path — only the
+    targeted op, and per provider (a respawned engine never inherits
+    a dead predecessor's baseline)."""
+    straggler.enable(True)
+
+    class Src:
+        rows = {"allgather": {"count": 5, "wait_ns": 9000,
+                              "max_wait_ns": 4000, "lat_hist": [0, 5]},
+                "bcast": {"count": 2, "wait_ns": 100,
+                          "max_wait_ns": 60, "lat_hist": [2]}}
+
+        def optimes(self):
+            return {op: dict(st) for op, st in self.rows.items()}
+
+    src = Src()
+    straggler.register_native(src, src.optimes)
+    assert straggler.op_count("allgather") == 5
+    straggler.reset_op("allgather")
+    assert straggler.op_count("allgather") == 0
+    assert straggler.op_wait_ns("allgather") == 0
+    assert straggler.op_count("bcast") == 2      # untouched
+    # growth after the reset surfaces as the delta
+    src.rows["allgather"]["count"] = 7
+    src.rows["allgather"]["wait_ns"] = 9500
+    assert straggler.op_count("allgather") == 2
+    assert straggler.op_wait_ns("allgather") == 500
+
+
 def test_straggler_skew_join_with_offsets():
     # rank 1's clock runs 10 ms ahead AND it arrives 25 ms late
     base = 1_000_000_000

@@ -1,0 +1,63 @@
+"""tpurun worker: device-plane arbitration + cross-plane bit-exactness.
+
+Runs one large (>= 1 MiB/rank, device-eligible) and one small MPI_SUM
+allreduce on deterministic integer-derived doubles (exact in IEEE
+double — the same formula native/examples/devsum.c uses), prints the
+order-independent content digest of the large result plus this
+process's device-plane counters as one ``DEVPLANE {json}`` line.
+
+The driver compares digests across btl selections and
+``dcn_device_enable`` values (bit-exact MPI_SUM across host-plane and
+device-plane schedules) and against the C fast-path program's DEVSUM
+digest, and asserts the arbitration counters: large contiguous sends
+took the device plane, small traffic stayed on the host plane.
+"""
+
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+COUNT = int(os.environ.get("DEV_COUNT", str(1 << 18)))  # 2 MiB doubles
+
+world = api.init()
+p, n = world.proc, world.nprocs
+assert world.local_size == 1, "driver launches with --cpu-devices 1"
+
+# devsum.c's exact formula: integer-derived halves, exact in double
+i = np.arange(COUNT, dtype=np.int64)
+x = (((i * 2654435761 + 7919 * (p + 1)) % 1000003).astype(np.float64)
+     * 0.5)
+big = np.asarray(world.allreduce(x[None], SUM))[0]
+w = big.view(np.uint64)
+xor = int(np.bitwise_xor.reduce(w))
+with np.errstate(over="ignore"):
+    sm = int(np.sum(w, dtype=np.uint64))
+
+small = np.asarray(world.allreduce(
+    np.full((1, 16), float(p + 1), np.float64), SUM))
+assert np.all(small == n * (n + 1) / 2), small
+
+eng = world.dcn
+dp = eng._root_engine()._device_plane
+if dp is not None:
+    # non-contiguous payloads are never device-eligible; their
+    # contiguous twin is exactly when it clears the threshold
+    full = np.ones((1 << 11, 1 << 8), np.float64)
+    nc = full[:, ::2]
+    assert not dp.eligible(nc)
+    assert dp.eligible(full) == (full.nbytes >= dp.min_size)
+
+print("DEVPLANE " + json.dumps({
+    "proc": int(p),
+    "xor": f"{xor:x}",
+    "sum": f"{sm:x}",
+    "stats": dict(dp.stats) if dp is not None else None,
+}), flush=True)

@@ -46,13 +46,16 @@ payload = np.ones(64 * 1024 // 8, np.float64)
 
 def burst(tag: int) -> None:
     if p == 0:
+        # window-complete ack, POSTED BEFORE the burst: its matched
+        # delivery rings rank 0's completion doorbell — posting first
+        # makes the match (and thus the doorbell publish) independent
+        # of whether the ack outraces the post under suite load (an
+        # unexpected-queue arrival wakes nobody and rings nothing)
+        req = world.irecv(dest=0, source=1, tag=tag)
         for i in range(WINDOW):
             world.send(payload * (i + 1), source=0, dest=1, tag=tag)
-        # window-complete ack: its matched delivery rings rank 0's
-        # completion doorbell — every counter the test asserts is then
-        # deterministically nonzero on both ranks
-        out, _st = world.recv(dest=0, source=1, tag=tag)
-        assert out.shape == (1,), out
+        out = req.wait()
+        assert np.asarray(out).shape == (1,), out
     else:
         for i in range(WINDOW):
             out, st = world.recv(dest=1, source=0, tag=tag)

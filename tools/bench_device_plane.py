@@ -1,0 +1,93 @@
+"""tpurun np=2 worker: device-plane osu_bw / osu_allreduce legs.
+
+Runs OSU-shaped p2p bandwidth (windowed) and allreduce latency sweeps
+at sizes bracketing ``dcn_device_min_size`` on whichever btl +
+``dcn_device_enable`` the launcher selected, then reports per-size
+medians plus this rank's ``dcn_device_*`` counters — the plane-
+arbitration proof (large contiguous traffic took the device plane,
+small and non-contiguous stayed host-side).  Proc 0 prints one
+``DEVBENCH {json}`` line; the bench.py ``device_plane`` leg runs this
+twice (plane on/off) and encodes the TPU-only ≥ 1 MiB
+device-beats-host-ring gate.
+"""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import json
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.op import SUM
+
+world = api.init()
+p = world.proc
+assert world.nprocs == 2
+
+SIZES = [64 << 10, 256 << 10, 1 << 20, 4 << 20]
+ITERS = int(os.environ.get("DEVBENCH_ITERS", "12"))
+WINDOW = 8
+
+
+def bw_row(nbytes: int) -> float:
+    """osu_bw shape: a window of sends, one ack; returns MB/s median
+    over iterations."""
+    buf = np.zeros(nbytes, np.uint8)
+    ack = np.zeros(1, np.uint8)
+    rates = []
+    for _ in range(ITERS):
+        if p == 0:
+            t0 = time.perf_counter()
+            for _w in range(WINDOW):
+                world.send(buf, source=0, dest=1, tag=7)
+            world.recv(dest=0, source=1, tag=8)
+            dt = time.perf_counter() - t0
+            rates.append(nbytes * WINDOW / dt / 1e6)
+        else:
+            for _w in range(WINDOW):
+                world.recv(dest=1, source=0, tag=7)
+            world.send(ack, source=1, dest=0, tag=8)
+    return float(np.median(rates)) if rates else 0.0
+
+
+def allreduce_row(nbytes: int) -> float:
+    """osu_allreduce shape: µs median per call."""
+    x = np.zeros((world.local_size, nbytes // 8), np.float64)
+    world.allreduce(x, SUM)  # warm the schedule
+    ts = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        world.allreduce(x, SUM)
+        ts.append((time.perf_counter() - t0) * 1e6)
+    return float(np.median(ts))
+
+
+rows = []
+for nbytes in SIZES:
+    rows.append({"bytes": nbytes,
+                 "bw_MBs": round(bw_row(nbytes), 1),
+                 "allreduce_us": round(allreduce_row(nbytes), 1)})
+
+eng = world.dcn
+dp = eng._root_engine()._device_plane
+stats = None
+if dp is not None:
+    # the layout half of the arbitration, counted: a non-contiguous
+    # payload of device-plane size still goes host
+    nc = np.ones((1 << 9, 1 << 9), np.float64)[:, ::2]
+    assert not dp.arbitrate(nc, 1)
+    stats = dict(dp.stats)
+
+if p == 0:
+    print("DEVBENCH " + json.dumps({
+        "np": 2, "iters": ITERS, "window": WINDOW,
+        "min_size": dp.min_size if dp is not None else None,
+        "rows": rows, "stats": stats,
+    }), flush=True)
+else:
+    print("DEVBENCH_PEER " + json.dumps({"stats": stats}), flush=True)

@@ -113,7 +113,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
             print("agents: " + "   ".join(parts), file=out)
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
-          f"{'sdep':>5}{'coal':>6}{'sched':>6}"
+          f"{'sdep':>5}{'coal':>6}{'sched':>6}{'dev%':>6}"
           f"{'failed':>7}  stall causes (ring/cts/other)", file=out)
     for p in sorted(procs):
         f = procs[p]
@@ -140,6 +140,13 @@ def render(state: dict, prev: dict | None = None, url: str = "",
         sh = int(n.get("sched_cache_hits", 0))
         sm = int(n.get("sched_cache_misses", 0))
         sched = f"{sh / (sh + sm):>5.0%}" if (sh + sm) else "    -"
+        # device-plane leg: share of data-plane bytes that stayed
+        # device-resident (dcn_device_bytes_placed vs the host wire
+        # families) — the zero-copy plane's live signature
+        devb = int(n.get("device_bytes_placed", 0))
+        hostb = sum(int(n.get(k, 0)) for k in _BYTES)
+        dev = (f"{devb / (devb + hostb):>5.0%}" if (devb + hostb)
+               else "    -")
         failed = f.get("failed") or []
         print(f"{p:<5}{mbs:>8.1f}{msgs:>8.0f}"
               f"{int(n.get('delivered', 0)):>10}"
@@ -148,6 +155,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               f"{int(n.get('dedup_drops', 0)):>6}"
               f"{int(n.get('deadline_expired', 0)):>6}"
               f"{int(n.get('stream_depth', 0)):>5}{coal:>6}{sched:>6}"
+              f"{dev:>6}"
               f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
               file=out)
     strag = state.get("straggler") or {}
