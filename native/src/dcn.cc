@@ -118,6 +118,22 @@ struct WireHdr {
 
 static_assert(sizeof(WireHdr) == 72, "wire header is 72 bytes");
 
+// Causal-tracing wire context (ompi_tpu/trace/causal.py): a compact
+// versioned tuple [v, comm, op, seq, hop] stamped per collective
+// frame when `--mca trace_causal 1` is armed.  On this plane it rides
+// the frame's META region (the same vehicle as the device-plane
+// window descriptor), so WireHdr stays frozen at 72 bytes and a
+// DISABLED run's frames are byte-identical to a build without causal
+// tracing — the zero-wire-bytes contract.  The field table below is
+// the C mirror of trace/causal.py:CTX_FIELDS; tpucheck's
+// wire-ctx-drift pass holds both sides equal, append-only, with the
+// v1 prefix frozen (the TDCN_STAT_NAMES contract applied to the
+// wire context).
+#define TDCN_TRACE_CTX_VERSION 1
+static const char *TDCN_TRACE_CTX_FIELDS =
+    "v,comm,op,"
+    "seq,hop";
+
 // The C <-> Python message record (ctypes mirror in dcn/native.py).
 #pragma pack(push, 1)
 struct TdcnMsg {
@@ -264,6 +280,9 @@ enum TdcnStatIdx {
   TS_DEVICE_ARB_DEVICE,
   TS_DEVICE_ARB_HOST,
   TS_DEVICE_FALLBACKS,
+  TS_DEVICE_WINDOW_RECLAIMED,  // windows force-retired on a peer-
+                               // failure mark (RTS-to-consume leak
+                               // edge; Python-side provider)
   TS_COUNT
 };
 
@@ -283,7 +302,8 @@ static const char *TDCN_STAT_NAMES =
     "recv_into_placed,addr_installs,addr_lazy_resolved,"
     "device_sends,device_recvs,device_bytes_placed,"
     "device_dma_waits,device_dma_wait_ns,"
-    "device_arb_device,device_arb_host,device_fallbacks";
+    "device_arb_device,device_arb_host,device_fallbacks,"
+    "device_window_reclaimed";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -4810,6 +4830,13 @@ int tdcn_stats(void *h, uint64_t *out, int max_n) {
 // lets the Python reader and C tools agree on layout without
 // hardcoding, validated against out[0]'s version stamp.
 const char *tdcn_stats_names(void) { return TDCN_STAT_NAMES; }
+
+// Self-describing causal wire-context schema (version, then the
+// comma-joined field table) — the Python side validates its
+// CTX_VERSION/CTX_FIELDS against this at test time, the same
+// single-source-of-truth read tdcn_stats_names serves for counters.
+int tdcn_trace_ctx_version(void) { return TDCN_TRACE_CTX_VERSION; }
+const char *tdcn_trace_ctx_fields(void) { return TDCN_TRACE_CTX_FIELDS; }
 
 // Arm/disarm the native fault-injection knobs (process-wide; see
 // fault_ring_ok).  stall_ns = injected backpressure per matching ring

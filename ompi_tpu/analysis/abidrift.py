@@ -39,6 +39,22 @@ that drift silently because no compiler sees both sides:
     must appear in README.md — and every ``/endpoint`` row in the
     README ops table must exist in code.
 
+``wire-ctx-drift`` / ``wire-ctx-append-only``
+    The causal-tracing wire context (``trace/causal.py:CTX_FIELDS``)
+    vs its C mirror (``dcn.cc:TDCN_TRACE_CTX_FIELDS``): same fields,
+    same order, both sides — and the v1 prefix is FROZEN with new
+    fields appended at the tail only (the TdcnStats contract applied
+    to the wire: peers parse contexts by position, so a reorder or
+    rename inside the frozen prefix silently mis-decodes every frame
+    between mixed builds).
+
+``pvar-name-lint``
+    The ``trace_causal_*`` pvar family (``causal.PVARS``): every name
+    is a well-formed lowercase identifier, collides with no other
+    trace-pvar namespace segment (``trace_span_`` would shadow the
+    layer parser), and its full ``trace_causal_<name>`` form is
+    documented in the README counter catalog.
+
 Everything is parsed statically (AST for Python, regex over the
 ``extern "C"`` block for C) — the pass never imports or builds the
 modules it is judging.
@@ -406,6 +422,148 @@ def check_ctypes(root: Path) -> list[Finding]:
     return out
 
 
+# -- causal wire-context field table (C mirror) --------------------------
+
+CAUSAL_PY = "ompi_tpu/trace/causal.py"
+
+#: the frozen v1 wire-context prefix — live positional wire fields;
+#: renaming or reordering ANY of them mis-decodes frames between
+#: mixed builds even though the tail may grow
+CTX_V1_FROZEN = ("v", "comm", "op", "seq", "hop")
+
+
+def _py_tuple_of(root: Path, relpath: str,
+                 name: str) -> tuple[list[str], int]:
+    """(string elements, line) of a module-level tuple assignment."""
+    tree = parse_py(root / relpath)
+    if tree is None:
+        return [], 0
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Tuple)):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)], node.lineno
+    return [], 0
+
+
+def c_trace_ctx_fields(root: Path) -> tuple[list[str], int]:
+    """(fields, line) parsed from the TDCN_TRACE_CTX_FIELDS
+    concatenated string literal in dcn.cc; ([], 0) when absent."""
+    try:
+        text = (root / DCN_CC).read_text()
+    except OSError:
+        return [], 0
+    m = re.search(
+        r"TDCN_TRACE_CTX_FIELDS\s*=\s*((?:\s*\"[^\"]*\")+)\s*;", text)
+    if not m:
+        return [], 0
+    line = text[:m.start()].count("\n") + 1
+    joined = "".join(re.findall(r'"([^"]*)"', m.group(1)))
+    return [n for n in joined.split(",") if n], line
+
+
+def check_trace_ctx(root: Path) -> list[Finding]:
+    """``wire-ctx-drift``/``wire-ctx-append-only`` (docstring)."""
+    c_fields, c_line = c_trace_ctx_fields(root)
+    py_fields, py_line = _py_tuple_of(root, CAUSAL_PY, "CTX_FIELDS")
+    if not c_fields and not py_fields:
+        return []  # neither side exists (fixture trees): nothing owed
+    out: list[Finding] = []
+    if not c_fields:
+        return [Finding(
+            PASS, "wire-ctx-drift", DCN_CC, 0, "TDCN_TRACE_CTX_FIELDS",
+            "trace/causal.py declares CTX_FIELDS but dcn.cc carries no "
+            "TDCN_TRACE_CTX_FIELDS mirror — the wire-context schema "
+            "needs both sides (single-source-of-truth contract)",
+            SEV_ERROR)]
+    if not py_fields:
+        return [Finding(
+            PASS, "wire-ctx-drift", CAUSAL_PY, 0, "CTX_FIELDS",
+            "dcn.cc carries TDCN_TRACE_CTX_FIELDS but trace/causal.py "
+            "declares no CTX_FIELDS tuple", SEV_ERROR)]
+    if c_fields != py_fields:
+        detail = ""
+        for i, (a, b) in enumerate(zip(c_fields, py_fields)):
+            if a != b:
+                detail = (f"first divergence at index {i}: C has {a!r}, "
+                          f"Python has {b!r}")
+                break
+        else:
+            longer = "C" if len(c_fields) > len(py_fields) else "Python"
+            extra = (c_fields[len(py_fields):] if longer == "C"
+                     else py_fields[len(c_fields):])
+            detail = f"{longer} side has extra tail entries {extra}"
+        out.append(Finding(
+            PASS, "wire-ctx-drift", DCN_CC, c_line,
+            "TDCN_TRACE_CTX_FIELDS",
+            "TDCN_TRACE_CTX_FIELDS != trace/causal.py CTX_FIELDS "
+            f"({CAUSAL_PY}:{py_line}) — {detail}; contexts are parsed "
+            "by position, so both sides must agree exactly",
+            SEV_ERROR))
+    for side, fields, f, ln in ((
+            "C", c_fields, DCN_CC, c_line),
+            ("Python", py_fields, CAUSAL_PY, py_line)):
+        prefix = tuple(fields[:len(CTX_V1_FROZEN)])
+        if prefix != CTX_V1_FROZEN:
+            bad = next((i for i, (a, b) in enumerate(
+                zip(prefix, CTX_V1_FROZEN)) if a != b), len(prefix))
+            out.append(Finding(
+                PASS, "wire-ctx-append-only", f, ln,
+                "TDCN_TRACE_CTX_FIELDS" if side == "C" else "CTX_FIELDS",
+                f"{side} wire-context table breaks the frozen v1 "
+                f"prefix at index {bad} (have "
+                f"{list(prefix[bad:bad + 2])!r}, frozen "
+                f"{list(CTX_V1_FROZEN[bad:bad + 2])!r}) — fields are "
+                "positional on the wire; the schema is append-only "
+                "(new fields at the tail, version stays 1)", SEV_ERROR))
+    return out
+
+
+_PVAR_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def check_causal_pvars(root: Path) -> list[Finding]:
+    """``pvar-name-lint`` over the ``trace_causal_*`` family."""
+    names, line = _py_tuple_of(root, CAUSAL_PY, "PVARS")
+    if not names:
+        return []  # no causal module (fixture trees): nothing owed
+    out: list[Finding] = []
+    try:
+        readme = (root / README).read_text()
+    except OSError:
+        readme = ""
+    seen: set[str] = set()
+    for n in names:
+        full = f"trace_causal_{n}"
+        if not _PVAR_NAME_RE.match(n):
+            out.append(Finding(
+                PASS, "pvar-name-lint", CAUSAL_PY, line, full,
+                f"causal pvar segment {n!r} is not a lowercase "
+                "identifier — prom/MPI_T names derive from it verbatim",
+                SEV_ERROR))
+        if n in seen:
+            out.append(Finding(
+                PASS, "pvar-name-lint", CAUSAL_PY, line, full,
+                f"duplicate causal pvar segment {n!r}", SEV_ERROR))
+        seen.add(n)
+        if n.startswith("span_"):
+            out.append(Finding(
+                PASS, "pvar-name-lint", CAUSAL_PY, line, full,
+                "causal pvar segment must not start with 'span_' — "
+                "trace_span_* is the per-(layer, op) namespace and the "
+                "name parser would shadow it", SEV_ERROR))
+        if readme and full not in readme:
+            out.append(Finding(
+                PASS, "pvar-name-lint", README, 0, full,
+                f"causal pvar {full!r} is missing from the README "
+                "counter catalog — the catalog promises the full "
+                "observability schema", SEV_ERROR))
+    return out
+
+
 # -- transport counters vs the provider merge ---------------------------
 
 def _counter_keys(tree: ast.Module) -> list[tuple[str, int]]:
@@ -563,5 +721,7 @@ def run(root: str | Path, files=None) -> list[Finding]:
     out += check_stat_names(root)
     out += check_ctypes(root)
     out += check_provider_merge(root)
+    out += check_trace_ctx(root)
+    out += check_causal_pvars(root)
     out += check_catalogs(root)
     return out

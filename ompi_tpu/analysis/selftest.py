@@ -125,11 +125,32 @@ def _fixture_metrics_core(names: tuple[str, ...]) -> str:
     return f"NATIVE_COUNTERS = (\n{rows}\n)\n"
 
 
+#: the real v1 wire-context field table (trace/causal.py order)
+_CTX_FIELDS = ("v", "comm", "op", "seq", "hop")
+
+
+def _fixture_causal_py(fields: tuple[str, ...],
+                       pvars: tuple[str, ...]) -> str:
+    frows = ", ".join(f'"{f}"' for f in fields)
+    prows = ", ".join(f'"{p}"' for p in pvars)
+    return (f"CTX_VERSION = 1\nCTX_FIELDS = ({frows})\n"
+            f"PVARS = ({prows})\n")
+
+
+def _fixture_ctx_cc(fields: tuple[str, ...]) -> str:
+    joined = ",".join(fields)
+    return ('static const char *TDCN_TRACE_CTX_FIELDS =\n'
+            f'    "{joined}";\n')
+
+
 def build_fixture_tree(root: Path, *, spin: str = "bad",
                        mca_ref: str = "trace_enable",
                        locks: str = "cycle",
                        rename_counter: str | None = None,
-                       stats_key: str | None = None) -> Path:
+                       stats_key: str | None = None,
+                       ctx_fields: tuple[str, ...] | None = None,
+                       ctx_c_fields: tuple[str, ...] | None = None,
+                       causal_pvars: tuple[str, ...] | None = None) -> Path:
     """Materialize a seeded mini-repo under ``root``.  Knobs select the
     violation (or its clean twin) per pass:
 
@@ -141,6 +162,12 @@ def build_fixture_tree(root: Path, *, spin: str = "bad",
     * ``stats_key``: write a dcn/device.py whose STATS_KEYS carries
       this counter name (provider-merge-drift when it is not in
       NATIVE_COUNTERS); None → no device.py.
+    * ``ctx_fields``/``ctx_c_fields``: write a trace/causal.py (and a
+      TDCN_TRACE_CTX_FIELDS block in the fixture dcn.cc) carrying
+      these wire-context field tables — disagree/reorder to seed
+      wire-ctx-drift/append-only; None → no causal fixture.
+    * ``causal_pvars``: PVARS tuple for the causal fixture (the
+      pvar-name-lint input); defaults to a clean set.
     """
     (root / "ompi_tpu" / "core").mkdir(parents=True, exist_ok=True)
     (root / "ompi_tpu" / "dcn").mkdir(parents=True, exist_ok=True)
@@ -157,16 +184,30 @@ def build_fixture_tree(root: Path, *, spin: str = "bad",
     if rename_counter:
         c_names = tuple(f"{n}_v2" if n == rename_counter else n
                         for n in _COUNTERS)
-    (root / "native" / "src" / "dcn.cc").write_text(_fixture_dcn_cc(c_names))
+    cc_text = _fixture_dcn_cc(c_names)
+    if ctx_fields is not None or ctx_c_fields is not None:
+        cc_text += _fixture_ctx_cc(ctx_c_fields or ctx_fields
+                                   or _CTX_FIELDS)
+        (root / "ompi_tpu" / "trace").mkdir(parents=True, exist_ok=True)
+        (root / "ompi_tpu" / "trace" / "causal.py").write_text(
+            _fixture_causal_py(ctx_fields or _CTX_FIELDS,
+                               causal_pvars or ("records", "sends")))
+    (root / "native" / "src" / "dcn.cc").write_text(cc_text)
     if stats_key is not None:
         (root / "ompi_tpu" / "dcn" / "device.py").write_text(
             f'STATS_KEYS = ("{stats_key}",)\n\n\n'
             "class Plane:\n"
             "    def __init__(self):\n"
             "        self.stats = {k: 0 for k in STATS_KEYS}\n")
-    (root / "README.md").write_text(
-        f"Fixture repo.  Enable with ``--mca {mca_ref} 1``.\n"
-        "Counters: " + ", ".join(f"`{n}`" for n in _COUNTERS) + "\n")
+    readme = (f"Fixture repo.  Enable with ``--mca {mca_ref} 1``.\n"
+              "Counters: " + ", ".join(f"`{n}`" for n in _COUNTERS)
+              + "\n")
+    if ctx_fields is not None or ctx_c_fields is not None:
+        # document the DEFAULT pvar set so only a seeded odd name
+        # trips the README half of pvar-name-lint
+        readme += ("Causal pvars: `trace_causal_records`, "
+                   "`trace_causal_sends`\n")
+    (root / "README.md").write_text(readme)
     return root
 
 
@@ -244,6 +285,43 @@ def _leg_abidrift(tmp: Path, log: list[str]) -> bool:
                                  stats_key="delivered")
     fs4 = abidrift.check_provider_merge(pm_good)
     ok &= _expect(log, not fs4, "schema-covered counter stays clean")
+    # causal wire-context mirror: a field renamed on the C side only
+    # is drift; a reorder inside the frozen v1 prefix is append-only
+    # breakage; agreeing tables stay clean
+    cx_bad = build_fixture_tree(
+        tmp / "abi_cx_bad", spin="good",
+        ctx_fields=("v", "comm", "op", "seq", "hop"),
+        ctx_c_fields=("v", "comm", "op", "seq", "hopidx"))
+    fs5 = abidrift.check_trace_ctx(cx_bad)
+    rules5 = {f.rule for f in fs5}
+    ok &= _expect(log, "wire-ctx-drift" in rules5,
+                  "renamed C ctx field detected as wire-ctx drift")
+    ok &= _expect(log, "wire-ctx-append-only" in rules5,
+                  "rename inside the frozen ctx prefix flagged "
+                  "append-only")
+    cx_good = build_fixture_tree(
+        tmp / "abi_cx_good", spin="good",
+        ctx_fields=("v", "comm", "op", "seq", "hop", "extra"),
+        ctx_c_fields=("v", "comm", "op", "seq", "hop", "extra"))
+    fs6 = abidrift.check_trace_ctx(cx_good)
+    ok &= _expect(log, not fs6,
+                  "agreeing ctx tables (appended tail) stay clean")
+    # pvar name lint: a malformed causal pvar segment + one missing
+    # from the README catalog; the default set stays clean
+    pv_bad = build_fixture_tree(
+        tmp / "abi_pv_bad", spin="good",
+        ctx_fields=("v", "comm", "op", "seq", "hop"),
+        causal_pvars=("records", "Bad-Name"))
+    fs7 = abidrift.check_causal_pvars(pv_bad)
+    ok &= _expect(log,
+                  any(f.rule == "pvar-name-lint"
+                      and "Bad-Name" in f.symbol for f in fs7),
+                  "malformed trace_causal_* pvar name flagged")
+    pv_good = build_fixture_tree(
+        tmp / "abi_pv_good", spin="good",
+        ctx_fields=("v", "comm", "op", "seq", "hop"))
+    fs8 = abidrift.check_causal_pvars(pv_good)
+    ok &= _expect(log, not fs8, "default causal pvar set stays clean")
     return ok
 
 

@@ -59,6 +59,12 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
 
     trace_core.register_vars(ctx.store)
     trace_core.sync_from_store(ctx.store)
+    # cross-rank causal tracing (--mca trace_causal 1): wire-context
+    # stamping + per-collective causal records; implies the tracer so
+    # the offline critical-path report has events to read
+    from ompi_tpu.trace import causal as _causal
+
+    _causal.sync_from_store(ctx.store)
     # transport telemetry (--mca metrics_enable 1): the quantitative
     # leg — native DCN counters + per-op histograms + flight recorder;
     # synced before ProcContext so engine construction already counts

@@ -34,6 +34,7 @@ from ompi_tpu.p2p.part import PersistentP2PMixin
 from ompi_tpu.p2p.pml import ANY_SOURCE, ANY_TAG, MatchingEngine
 from ompi_tpu.metrics import straggler as _straggler
 from ompi_tpu.request import Request
+from ompi_tpu.trace import causal as _causal
 from ompi_tpu.trace import core as _trace
 from .comm import COLOR_UNDEFINED, _next_cid, _peek_cid, _reserve_cid_block
 from .group import Group
@@ -238,6 +239,11 @@ class MultiProcComm(PersistentP2PMixin):
 
             ulfm.check(self, collective=True)
         fn = self.coll.lookup(slot)
+        if _causal._enabled:
+            # causal tracing: open the thread-local op context every
+            # in-op send/recv stamps its wire context from — innermost
+            # wrap, so its arrival is the closest to first traffic
+            fn = _causal.wrap_call(slot, fn, comm=self.name)
         if _straggler._enabled:
             # straggler profiler: wall-clock arrival/exit per call,
             # keyed (comm, op, seq) like the trace merge key — the

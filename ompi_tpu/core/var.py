@@ -366,6 +366,15 @@ OBSERVABILITY_VARS = (
      "Chrome trace-event JSON path written at finalize; a "
      "multi-process job writes <output>.<proc>.json per process "
      "(merge with tools/trace_report.py)"),
+    ("trace", "", "causal", False, "bool",
+     "Cross-rank causal tracing: stamp a compact versioned context "
+     "(comm/op/seq + hop) onto collective frames on all three DCN "
+     "planes, record per-collective causal records (schedule "
+     "sends/recvs with measured waits + stall-cause deltas), and "
+     "feed the critical-path/blame surfaces (/critical, "
+     "trace_report.py --critical-path, the finalize causal export).  "
+     "Implies trace_enable.  Default off — zero wire bytes, zero "
+     "hot-path work"),
     ("metrics", "", "enable", False, "bool",
      "Record transport telemetry (native-plane DCN counters, per-op "
      "size/latency histograms, flight recorder); default off — one "

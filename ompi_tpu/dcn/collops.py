@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Sequence
 
 import numpy as np
 
 from ompi_tpu.op.op import Op
+from ompi_tpu.trace import causal as _causal
 from . import tcp as tcp_mod
 from .tcp import TcpTransport
 
@@ -210,8 +212,15 @@ class DcnCollEngine:
 
     def note_proc_failed(self, proc: int) -> None:
         """Mark a ROOT-engine proc index dead: pending and future
-        ``_recv`` calls naming it raise instead of timing out."""
+        ``_recv`` calls naming it raise instead of timing out.  Device
+        windows staged toward the corpse are reclaimed HERE — the dead
+        receiver can never signal consumed, and without the reclaim
+        each such transfer's shm segment leaks until the sender's
+        close sweep (the PR-14 recorded edge)."""
         self._failed_procs.add(proc)
+        dp = getattr(self, "_device_plane", None)
+        if dp is not None:
+            dp.reclaim_failed(proc)
 
     def note_proc_recovered(self, proc: int,
                             incarnation: int | None = None) -> None:
@@ -226,6 +235,9 @@ class DcnCollEngine:
         det = self._detector
         if det is not None:
             det.clear_failed(proc, incarnation=incarnation)
+        dp = getattr(self, "_device_plane", None)
+        if dp is not None:
+            dp.clear_failed(proc)
         self._bump_stat("respawns")
 
     def note_proc_healed(self, proc: int) -> None:
@@ -234,6 +246,9 @@ class DcnCollEngine:
         respawn accounting (nothing was respawned; the mark was
         wrong)."""
         self._failed_procs.discard(proc)
+        dp = getattr(self, "_device_plane", None)
+        if dp is not None:
+            dp.clear_failed(proc)
 
     def coll_revoke(self, cid) -> None:
         """Revoke fan-out into an engine-resident collective fast path
@@ -473,6 +488,12 @@ class DcnCollEngine:
         env = {"kind": "coll", "cid": cid, "seq": seq, "src": self.proc}
         if meta is not None:
             env["meta"] = meta
+        if _causal._enabled:
+            # causal wire context: root span id + hop index, riding
+            # the frame envelope (zero wire bytes when disabled)
+            tc = _causal.note_send(self.root_proc_of(dst))
+            if tc is not None:
+                env["tc"] = tc
         # plane arbitration (size / layout / reachability): a large
         # contiguous payload rides a device window and the host plane
         # carries only its descriptor — the RTS of the DMA protocol
@@ -503,6 +524,7 @@ class DcnCollEngine:
 
         if timeout is None:
             timeout = dcn_timeout("recv")
+        tw0 = time.perf_counter_ns() if _causal._enabled else 0
         key = (cid, seq, src)
         posted = None
         if into is not None:
@@ -559,6 +581,17 @@ class DcnCollEngine:
             payload = _device.materialize(self._root_engine(), desc,
                                           into=into)
             got = (env, payload)
+        # "tc" is a reserved envelope key: popped whether or not THIS
+        # rank records (a causal-enabled peer's frame must never leak
+        # a foreign field to envelope consumers — the native plane's
+        # meta pop enforces the same contract)
+        tc = env.pop("tc", None)
+        if tw0:
+            # causal edge head: the frame's wire context + this recv's
+            # measured wait (device materialization included — the DMA
+            # wait is part of what the receiver paid)
+            _causal.note_recv(self.root_proc_of(src), tc,
+                              time.perf_counter_ns() - tw0)
         self._note_peer_activity(src)
         # (cid, seq, src) keys are single-use (seqs are monotonic per
         # stream), and the producer's put necessarily preceded this get

@@ -72,6 +72,10 @@ STATS_KEYS = (
     "device_sends", "device_recvs", "device_bytes_placed",
     "device_dma_waits", "device_dma_wait_ns",
     "device_arb_device", "device_arb_host", "device_fallbacks",
+    # windows force-retired because their receiver was marked failed
+    # between RTS and consume (the reclaim that plugs the PR-14
+    # recorded leak; each one is flight-recorded)
+    "device_window_reclaimed",
 )
 
 #: descriptor key the control frame carries (collops attaches it to
@@ -218,8 +222,19 @@ class DevicePlane:
         self.hosts = hosts
         self.stats: dict[str, int] = {k: 0 for k in STATS_KEYS}
         self._wids = itertools.count(1)
-        #: sender-owned windows awaiting the consumed signal (reap)
-        self._tx: dict[int, DeviceWindow] = {}
+        #: sender-owned windows awaiting the consumed signal (reap):
+        #: wid → (window, dst root proc, staging op key) — the dst is
+        #: what lets a peer-failure mark reclaim exactly the transfers
+        #: that can no longer be consumed; the op key (causal tracing)
+        #: names the collective that opened the window
+        self._tx: dict[int, tuple] = {}
+        #: procs whose windows are reclaimed on sight: a failure mark
+        #: that lands while a stage() is in flight (or before one)
+        #: must not let that window slip past the reclaim scan —
+        #: stage() consults this set after publishing.  Cleared on
+        #: recover/heal so a replaced or false-positive peer gets
+        #: device windows again.
+        self._failed: set[int] = set()
         #: receiver-attached windows (closed on materialize)
         self._lock = threading.Lock()
         self._running = True
@@ -261,11 +276,14 @@ class DevicePlane:
 
     # -- sender: stage (DMA start) + reap (send-semaphore wait) ---------
 
-    def stage(self, arr: np.ndarray) -> dict | None:
+    def stage(self, arr: np.ndarray,
+              dst_proc: int | None = None) -> dict | None:
         """Open a window, ship the descriptor, ISSUE the DMA:
         returns the descriptor the host-plane control frame carries,
         or None when the window cannot be opened (the caller degrades
         to the host plane and counts ``device_fallbacks``).
+        ``dst_proc`` (root index) is remembered with the window so a
+        peer-failure mark can reclaim it (:meth:`reclaim_failed`).
 
         Ordering note: the window is created with SEM_EMPTY and the
         descriptor may be read by the receiver BEFORE ``place()``
@@ -273,6 +291,12 @@ class DevicePlane:
         wait (not frame order) is what orders the read after the DMA,
         exactly like the real send/recv DMA semaphore pair."""
         self.reap()
+        if dst_proc is not None and dst_proc in self._failed:
+            # the peer is already marked dead: an eligible send
+            # degrades to the host plane (where the failure surfaces
+            # through the normal escalation paths)
+            self.stats["device_fallbacks"] += 1
+            return None
         wid = next(self._wids)
         name = f"tpudev-{os.getpid()}-{wid}-{id(self) & 0xffff:x}"
         try:
@@ -280,16 +304,42 @@ class DevicePlane:
         except OSError:
             self.stats["device_fallbacks"] += 1
             return None
+        from ompi_tpu.trace import causal as _causal
+
+        okey = _causal.current_key() if _causal._enabled else None
+        # the DMA: on TPU this is make_async_remote_copy start(); the
+        # emulation is one memcpy + the semaphore publish.  It runs
+        # BEFORE the window is published into _tx: a concurrent
+        # peer-failure reclaim may close any _tx window at any moment,
+        # and closing this one mid-place would tear the views out from
+        # under the copy.  No receiver can race either way — the
+        # descriptor frame (the only path to the window name) is sent
+        # by the caller after stage() returns.
+        try:
+            win.place(memoryview(arr).cast("B") if arr.nbytes
+                      else memoryview(b""))
+        except Exception:
+            # a failed staging copy must not strand the window in no
+            # table (leaked segment): retire it and degrade to the
+            # host plane, like a window that failed to open
+            win.close(unlink=True)
+            self.stats["device_fallbacks"] += 1
+            return None
         with self._lock:
-            self._tx[wid] = win
+            self._tx[wid] = (win, dst_proc, okey)
+        if dst_proc is not None and dst_proc in self._failed:
+            # the failure mark landed while we were staging: the
+            # reclaim scan ran before our publish and would never see
+            # this window — retire it ourselves and fall back (counted
+            # like every other degrade, so arbitration outcomes stay
+            # accounted: arb_device = sends + fallbacks)
+            self.reclaim_failed(dst_proc)
+            self.stats["device_fallbacks"] += 1
+            return None
         desc = {
             "w": name, "n": int(arr.nbytes),
             "dt": arr.dtype.str, "sh": list(arr.shape),
         }
-        # the DMA: on TPU this is make_async_remote_copy start();
-        # the emulation is one memcpy + the semaphore publish
-        win.place(memoryview(arr).cast("B") if arr.nbytes
-                  else memoryview(b""))
         self.stats["device_sends"] += 1
         self.stats["device_bytes_placed"] += int(arr.nbytes)
         return desc
@@ -300,13 +350,50 @@ class DevicePlane:
         retired (close() sweeps the rest)."""
         done = []
         with self._lock:
-            for wid, win in list(self._tx.items()):
+            for wid, (win, _dst, _k) in list(self._tx.items()):
                 if win.sem() >= SEM_CONSUMED:
                     done.append(win)
                     del self._tx[wid]
         for win in done:
             win.close(unlink=True)
         return len(done)
+
+    def reclaim_failed(self, dst_proc: int) -> int:
+        """Peer-failure reclaim (the engine ``note_proc_failed`` path):
+        force-retire every window staged toward ``dst_proc`` — a dead
+        receiver can never signal consumed, so between its RTS and the
+        failure mark each such transfer's segment would otherwise leak
+        until the sender's close sweep.  Counted
+        (``dcn_device_window_reclaimed``) and flight-recorded per
+        window, with the staging collective named when causal tracing
+        captured it."""
+        victims = []
+        with self._lock:
+            # remember the mark: a stage() racing this scan re-checks
+            # the set after its publish and retires its own window
+            self._failed.add(int(dst_proc))
+            for wid, (win, dst, okey) in list(self._tx.items()):
+                if dst is not None and int(dst) == int(dst_proc):
+                    victims.append((win, okey))
+                    del self._tx[wid]
+        if not victims:
+            return 0
+        from ompi_tpu.metrics import flight as _flight
+
+        for win, okey in victims:
+            self.stats["device_window_reclaimed"] += 1
+            _flight.record("device_window_reclaimed",
+                           proc=int(dst_proc), window=win.name,
+                           **({"op": okey} if okey else {}))
+            win.close(unlink=True)
+        return len(victims)
+
+    def clear_failed(self, dst_proc: int) -> None:
+        """Recover/heal: the peer is back (replace() installed a
+        reborn incarnation, or the mark was a false positive) — new
+        device windows toward it are welcome again."""
+        with self._lock:
+            self._failed.discard(int(dst_proc))
 
     def pending_windows(self) -> int:
         with self._lock:
@@ -335,7 +422,7 @@ class DevicePlane:
     def close(self) -> None:
         self._running = False
         with self._lock:
-            wins = list(self._tx.values())
+            wins = [w for w, _dst, _k in self._tx.values()]
             self._tx.clear()
         for win in wins:
             win.close(unlink=True)
@@ -353,7 +440,7 @@ def try_stage(root_engine, payload, dst_root_proc):
         return None
     if not dp.arbitrate(payload, dst_root_proc):
         return None
-    return dp.stage(payload)
+    return dp.stage(payload, dst_proc=dst_root_proc)
 
 
 def materialize(root_engine, desc: dict,

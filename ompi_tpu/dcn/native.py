@@ -31,6 +31,7 @@ import ctypes
 import itertools
 import json
 import threading
+import time
 import weakref
 from typing import Callable, Sequence
 
@@ -42,6 +43,7 @@ from ompi_tpu.core.errors import (
 )
 from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.metrics import core as _metrics
+from ompi_tpu.trace import causal as _causal
 from .collops import DcnCollEngine, DcnJoinEngine, DcnSubEngine
 
 FK_COLL, FK_P2P, FK_PY = 0, 1, 2
@@ -147,6 +149,10 @@ def load_library():
         lib.tdcn_stats.argtypes = [P, ctypes.POINTER(ctypes.c_uint64), I]
         lib.tdcn_stats_names.restype = ctypes.c_char_p
         lib.tdcn_stats_names.argtypes = []
+        lib.tdcn_trace_ctx_version.restype = I
+        lib.tdcn_trace_ctx_version.argtypes = []
+        lib.tdcn_trace_ctx_fields.restype = ctypes.c_char_p
+        lib.tdcn_trace_ctx_fields.argtypes = []
         lib.tdcn_fault_set.argtypes = [U64, U64, I64]
         lib.tdcn_fault_events.restype = U64
         lib.tdcn_fault_events.argtypes = []
@@ -433,6 +439,16 @@ class _NativeOpsMixin:
             from ompi_tpu.metrics import flight as _flight
 
             _flight.check_watermarks()
+        if _causal._enabled and (meta is None or isinstance(meta, dict)):
+            # causal wire context on the native plane: rides the
+            # frame's meta-JSON region (the device descriptor's
+            # vehicle) — WireHdr stays frozen, disabled frames stay
+            # byte-identical; TDCN_TRACE_CTX_FIELDS in dcn.cc mirrors
+            # the field table (tpucheck wire-ctx-drift)
+            tc = _causal.note_send(self.root_proc_of(dst))
+            if tc is not None:
+                meta = dict(meta) if meta else {}
+                meta["tc"] = tc
         meta_b = json.dumps(meta).encode() if meta is not None else None
         rc = root._csend(
             self.addresses[dst], FK_COLL, str(cid), seq, self.proc, 0, 0,
@@ -450,6 +466,7 @@ class _NativeOpsMixin:
 
         if timeout is None:
             timeout = dcn_timeout("recv")
+        tw0 = time.perf_counter_ns() if _causal._enabled else 0
         root = self._native_root()
         lib, h = root._lib, root._h
         fail_idx = self.root_proc_of(src)
@@ -505,8 +522,17 @@ class _NativeOpsMixin:
 
             desc = meta.pop("dev")
             payload = _device.materialize(root, desc, into=into)
+        tc = None
+        if isinstance(meta, dict):
+            # "tc" is a reserved meta key like "dev": popped here
+            # whether or not THIS rank records, so a consumer's meta
+            # never grows a foreign field
+            tc = meta.pop("tc", None)
             if not meta:
                 meta = None
+        if tw0:
+            _causal.note_recv(self.root_proc_of(src), tc,
+                              time.perf_counter_ns() - tw0)
         if meta is not None:
             env["meta"] = meta
         return env, payload
@@ -1008,8 +1034,9 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
     # -- failure integration --------------------------------------------
 
     def note_proc_failed(self, proc: int) -> None:
-        self._failed_procs.add(proc)
         self._lib.tdcn_note_failed(self._h, proc)
+        # the shared Python-side mark + device-window reclaim
+        super().note_proc_failed(proc)
 
     def note_proc_recovered(self, proc: int,
                             incarnation: int | None = None) -> None:

@@ -447,7 +447,17 @@ class TcpTransport:
                 env = json.loads(_recv_exact(conn, elen).decode()) if elen else {}
                 meta = _recv_exact(conn, mlen) if mlen else b""
                 drop_in = False
-                if _fsim._enabled and env.get("kind") not in _CTRL_KINDS:
+                if (_fsim._enabled and ftype != _HELLO
+                        and env.get("kind") not in _CTRL_KINDS):
+                    # the HELLO handshake is exempt like hb/flr: it is
+                    # dial-time connection protocol (dial faults have
+                    # their own knob) AND the clock sample every
+                    # cross-rank observability join aligns timestamps
+                    # with — an injected asymmetric delay would not
+                    # emulate data loss, it would poison the shared
+                    # clock (a 30 ms recv delay skews the offset
+                    # estimate by ~15 ms, silently corrupting skew and
+                    # critical-path attribution for the whole job)
                     # only eager frames are droppable here (other frame
                     # types carry protocol state); the kinds filter
                     # keeps undroppable hits out of the injected counts

@@ -104,6 +104,10 @@ NATIVE_COUNTERS = (
     "device_sends", "device_recvs", "device_bytes_placed",
     "device_dma_waits", "device_dma_wait_ns",
     "device_arb_device", "device_arb_host", "device_fallbacks",
+    # device-window reclaim tail: windows force-retired because the
+    # receiver was marked failed between RTS and consume (the PR-14
+    # leak edge, closed) — each reclaim is also flight-recorded
+    "device_window_reclaimed",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

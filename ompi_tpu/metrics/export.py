@@ -115,6 +115,18 @@ def to_prometheus(snap: dict) -> str:
         for op, st in strag.items():
             lines.append(f'{PREFIX}_coll_wait_ns_total{{{plabel}op="{op}"'
                          f'}} {int(st.get("wait_ns", 0))}')
+    # causal-tracing counters (trace_causal_* pvar twins): rank-local
+    # record/edge totals — the cross-rank blame itself lives in the
+    # snapshot's "causal" records (joined offline) and on /critical
+    causal_c = snap.get("causal_counters") or {}
+    if causal_c:
+        for k in sorted(causal_c):
+            lines.append(f"# HELP {PREFIX}_trace_causal_{k} causal "
+                         f"tracing {k} (trace/causal.py)")
+            lines.append(f"# TYPE {PREFIX}_trace_causal_{k} counter")
+            labels = f'{{{plabel.rstrip(",")}}}' if plabel else ""
+            lines.append(f"{PREFIX}_trace_causal_{k}{labels} "
+                         f"{int(causal_c[k])}")
     # SPC counters ride along (one scrape = the whole tool stack)
     spc = snap.get("spc") or {}
     if spc:
@@ -139,6 +151,14 @@ def write(path_base: str, proc: int = 0,
                           proc=proc)
     if partial:
         snap["partial"] = True
+    from ompi_tpu.trace import causal as _causal
+
+    if _causal.enabled():
+        # the finalize causal export: this rank's recent causal
+        # records (the offline cross-rank join's per-rank input — the
+        # adaptive-selection item's training data) + the pvar counters
+        snap["causal"] = _causal.recent()
+        snap["causal_counters"] = _causal.counters_snapshot()
     paths = []
     prom_path = f"{path_base}.{proc}.prom"
     with open(prom_path, "w") as f:

@@ -123,6 +123,15 @@ class TelemetryAggregator:
         self._scores: dict[int, dict] = {}
         #: per-op cross-rank skew totals
         self._op_skew: dict[str, dict] = {}
+        #: causal-tracing join (trace/causal.py): staged per-rank
+        #: causal records awaiting every rank, the rolling per-job
+        #: blame state (/critical), and the top-N slowest solved
+        #: collectives.  Jobs key the tables ('' = plain tpurun) so a
+        #: tpud daemon serves per-job blame.
+        self._c_pending: dict[tuple, dict[int, list]] = {}
+        self._c_order: collections.deque = collections.deque()
+        self._c_dropped = 0
+        self._critical: dict[str, dict] = {}
         #: clock offsets onto rank 0's timeline (peer_clock −
         #: rank0_clock, ns).  Rank-0-measured samples win; a peer's own
         #: measurement of rank 0 (sign-flipped) fills the gap when rank
@@ -195,6 +204,9 @@ class TelemetryAggregator:
                 elif self.path.startswith("/json"):
                     body = json.dumps(agg.json_state()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/critical"):
+                    body = json.dumps(agg.critical_state()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/jobs"):
                     body = json.dumps(agg.jobs_state()).encode()
                     ctype = "application/json"
@@ -261,6 +273,10 @@ class TelemetryAggregator:
                 sc["skew_ns"] = 0
             self._pending.clear()
             self._pending_order.clear()
+            # causal join: a new job's blame starts clean (the per-job
+            # keyed tables keep finished jobs' results for /critical)
+            self._c_pending.clear()
+            self._c_order.clear()
 
     def jobs_state(self) -> dict:
         """The /jobs feed: every job id seen in frames or begun
@@ -334,8 +350,12 @@ class TelemetryAggregator:
                     # sign to get proc's offset on rank 0's timeline
                     self._offsets[proc] = -off
             ready = self._stage_colls(proc, frame.get("colls") or ())
+            cready = self._stage_causal(proc, frame.get("causal") or (),
+                                        str(frame.get("job") or ""))
         for key, arrivals in ready:
             self._attribute(key, arrivals)
+        for job, rows_by_proc in cready:
+            self._solve_causal(job, rows_by_proc)
 
     def _stage_colls(self, proc: int, rows) -> list[tuple[str, dict]]:
         """Under the lock: stage arrival records, pop the keys now held
@@ -361,6 +381,119 @@ class TelemetryAggregator:
                 ready.append((key, {p: t - self._offsets.get(p, 0)
                                     for p, t in st.items()}))
         return ready
+
+    #: solved-collective ring bound per job (/critical top-N source)
+    _CRIT_TOP = 16
+
+    def _stage_causal(self, proc: int, rows,
+                      job: str) -> list[tuple[str, dict[int, list]]]:
+        """Under the lock: stage per-rank causal records, pop the
+        instances now held by every rank (solved outside the lock).
+        Same bounded-pending discipline as the straggler join."""
+        ready: list[tuple[str, dict[int, list]]] = []
+        for row in rows:
+            key = (job, str(row[0]))
+            st = self._c_pending.get(key)
+            if st is None:
+                st = self._c_pending[key] = {}
+                self._c_order.append(key)
+                while len(self._c_order) > _PENDING_CAP:
+                    old = self._c_order.popleft()
+                    if self._c_pending.pop(old, None) is not None:
+                        self._c_dropped += 1
+            st[proc] = row
+            if self._nprocs and len(st) >= self._nprocs:
+                self._c_pending.pop(key, None)
+                ready.append((job, dict(st)))
+        return ready
+
+    def _solve_causal(self, job: str,
+                      rows_by_proc: dict[int, list]) -> None:
+        """One fully-joined causal instance → critical path → the
+        rolling per-job blame tables behind ``/critical``."""
+        from ompi_tpu.trace import causal as _causal
+
+        with self._lock:
+            offsets = dict(self._offsets)
+        insts = _causal.instances_from_records(
+            {p: [row] for p, row in rows_by_proc.items()},
+            offsets_ns=offsets)
+        if not insts:
+            return
+        cp = _causal.critical_path(next(iter(insts.values())))
+        if cp is None:
+            return
+        with self._lock:
+            st = self._critical.setdefault(
+                job, {"instances": 0, "per_rank": {}, "profile": {},
+                      "top": []})
+            st["instances"] += 1
+            for r, buckets in cp["per_rank"].items():
+                agg = st["per_rank"].setdefault(int(r), {})
+                for c, ns in buckets.items():
+                    agg[c] = agg.get(c, 0) + int(ns)
+            pkey = f"{cp['op']}/{cp['alg'] or '?'}"
+            prof = st["profile"].setdefault(
+                pkey, {"n": 0, "makespan_ns": 0, "causes": {}})
+            prof["n"] += 1
+            prof["makespan_ns"] += cp["makespan_ns"]
+            for buckets in cp["per_rank"].values():
+                for c, ns in buckets.items():
+                    prof["causes"][c] = prof["causes"].get(c, 0) + int(ns)
+            top = st["top"]
+            top.append({"key": cp["key"], "op": cp["op"],
+                        "alg": cp["alg"],
+                        "makespan_ns": cp["makespan_ns"],
+                        "dominant": cp["dominant"], "path": cp["path"]})
+            top.sort(key=lambda e: -e["makespan_ns"])
+            del top[self._CRIT_TOP:]
+
+    @staticmethod
+    def _merge_blame(job_states) -> tuple[int, dict[int, dict]]:
+        """Cross-job merge of per-rank blame buckets — ONE accumulator
+        shared by /critical and the /json brief, so the two surfaces
+        can never disagree on merge semantics."""
+        merged: dict[int, dict] = {}
+        total = 0
+        for st in job_states:
+            total += st["instances"]
+            for r, b in st["per_rank"].items():
+                agg = merged.setdefault(int(r), {})
+                for c, ns in b.items():
+                    agg[c] = agg.get(c, 0) + int(ns)
+        return total, merged
+
+    def critical_state(self) -> dict:
+        """The ``/critical`` feed: per-job blame tables (slowest
+        collectives with their critical paths, per-rank cause totals,
+        per-algorithm profiles) plus a cross-job merge for the plain
+        single-job case."""
+        from ompi_tpu.trace import causal as _causal
+
+        with self._lock:
+            jobs = {
+                j: {"instances": st["instances"],
+                    "per_rank": {str(r): dict(b)
+                                 for r, b in st["per_rank"].items()},
+                    "profile": {k: {"n": p["n"],
+                                    "makespan_ns": p["makespan_ns"],
+                                    "causes": dict(p["causes"])}
+                                for k, p in st["profile"].items()},
+                    "top": [dict(e) for e in st["top"]],
+                    "dominant": _causal.dominant_of(st["per_rank"])}
+                for j, st in self._critical.items()
+            }
+            pending = len(self._c_pending)
+            dropped = self._c_dropped
+        total, merged = self._merge_blame(jobs.values())
+        return {
+            "instances": total,
+            "per_rank": {str(r): b for r, b in merged.items()},
+            "dominant": _causal.dominant_of(merged),
+            "jobs": jobs,
+            "pending": pending,
+            "dropped": dropped,
+        }
 
     def _attribute(self, key: str, arrivals: dict[int, int]) -> None:
         """One fully-joined collective instance → the rolling tables."""
@@ -416,7 +549,22 @@ class TelemetryAggregator:
                                      for p, o in self._offsets.items()},
                 "relays": {"batches": self.batches,
                            "groups": sorted(self._relays)},
+                "critical": self._critical_brief(),
             }
+
+    def _critical_brief(self) -> dict:
+        """Under the lock: the /json-sized causal summary — per-rank
+        dominant blame cause + on-path totals (the tools/top.py blame
+        column feed; /critical serves the full paths)."""
+        from ompi_tpu.trace import causal as _causal
+
+        total, merged = self._merge_blame(self._critical.values())
+        per_rank = {}
+        for r, b in merged.items():
+            dom = _causal.dominant_of({r: b})
+            per_rank[str(r)] = {"cause": dom["cause"], "ns": dom["ns"],
+                                "total_ns": sum(b.values())}
+        return {"instances": total, "per_rank": per_rank}
 
     def prometheus_text(self) -> str:
         """One combined exposition: each family declared once, one
@@ -724,6 +872,12 @@ class TelemetryPublisher:
         }
         if _job_label is not None:
             f["job"] = _job_label
+        from ompi_tpu.trace import causal as _causal
+
+        if _causal._enabled:
+            rows = _causal.drain_recent()
+            if rows:
+                f["causal"] = rows
         clock = _core.clock_offsets()
         if clock:
             f["clock"] = {str(p): list(v) for p, v in clock.items()}

@@ -162,6 +162,11 @@ def _pvar_names(refresh: bool = False) -> list[str]:
     # tails can never shift it
     names += ["faultsim_injected_" + k for k in faultsim.KINDS]
     names += ["trace_events", "trace_dropped"]
+    # causal-tracing counters: a FIXED set (PVARS is static), placed
+    # with the tracer's fixed pair so the growing tails never shift it
+    from ompi_tpu.trace import causal as _tcausal
+
+    names += [f"trace_causal_{k}" for k in _tcausal.PVARS]
     for layer, op in trace.span_ops():
         names.append(f"trace_span_{layer}_{op}_count")
         names.append(f"trace_span_{layer}_{op}_hist")
@@ -195,6 +200,10 @@ def _trace_pvar_read(name: str):
         return trace.event_count()
     if name == "trace_dropped":
         return trace.dropped()
+    if name.startswith("trace_causal_"):
+        from ompi_tpu.trace import causal as _tcausal
+
+        return _tcausal.counter(name[len("trace_causal_"):])
     layer, op = _trace_key(name)
     if op.endswith("_count"):
         return trace.span_count(layer, op[: -len("_count")])
@@ -231,6 +240,11 @@ def pvar_get_info(index: int) -> PvarInfo:
         return PvarInfo(name, PVAR_CLASS_COUNTER,
                         f"collective straggler profiler: {what} for {op} "
                         "(in-op wall time; cross-rank skew joins live)")
+    if name.startswith("trace_causal_"):
+        return PvarInfo(name, PVAR_CLASS_COUNTER,
+                        f"causal tracing {name[len('trace_causal_'):]} "
+                        "(per-collective causal records / wire-context "
+                        "edges; trace/causal.py)")
     if name.startswith("trace_"):
         if name.endswith("_hist"):
             layer, op = _trace_key(name)
@@ -284,9 +298,11 @@ def pvar_reset() -> None:
     timeline, desync cross-rank merge keys, or shift cached indices."""
     _check()
     spc.reset()
+    from ompi_tpu.trace import causal as _tcausal
     from ompi_tpu.trace import core as trace
 
     trace.zero_stats()
+    _tcausal.zero_counters()
     from ompi_tpu import metrics
     from ompi_tpu.metrics import straggler as _straggler
 
@@ -314,6 +330,10 @@ def pvar_reset_one(index: int) -> None:
         )
     if name == "trace_dropped":
         trace.reset_dropped()
+    elif name.startswith("trace_causal_"):
+        from ompi_tpu.trace import causal as _tcausal
+
+        _tcausal.reset_counter(name[len("trace_causal_"):])
     elif name.startswith("trace_span_"):
         layer, op = _trace_key(name)
         trace.reset_span_stat(layer, op.rsplit("_", 1)[0])

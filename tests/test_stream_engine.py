@@ -112,6 +112,9 @@ _DEVICE_TAIL = (
     "device_sends", "device_recvs", "device_bytes_placed",
     "device_dma_waits", "device_dma_wait_ns",
     "device_arb_device", "device_arb_host", "device_fallbacks",
+    # window-reclaim tail (appended; version stays 1): windows
+    # force-retired on a peer-failure mark (the RTS-to-consume leak)
+    "device_window_reclaimed",
 )
 
 

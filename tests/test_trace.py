@@ -14,7 +14,7 @@ import pytest
 import ompi_tpu.api as api
 from ompi_tpu.op import SUM
 from ompi_tpu.tool import mpit
-from ompi_tpu.trace import chrome, core as trace, merge
+from ompi_tpu.trace import causal, chrome, core as trace, merge
 
 REPO = Path(__file__).resolve().parent.parent
 REPORT = REPO / "tools" / "trace_report.py"
@@ -32,9 +32,11 @@ def world(devices):
 def clean_trace():
     trace.reset()
     trace.enable(False)
+    causal.reset()
     yield
     trace.reset()
     trace.enable(False)
+    causal.reset()
 
 
 # -- core recording ----------------------------------------------------
@@ -306,3 +308,412 @@ def test_tpurun_np2_trace_disabled_writes_nothing(tmp_path):
     )
     assert res.returncode == 0, res.stdout.decode() + res.stderr.decode()
     assert not list(tmp_path.glob("trace.*.json"))
+
+
+# -- causal tracing (cross-rank critical path) --------------------------
+
+MS = 1_000_000
+
+
+def _engine_pair():
+    from ompi_tpu.dcn.collops import DcnCollEngine
+
+    e0 = DcnCollEngine(0, 2)
+    e1 = DcnCollEngine(1, 2)
+    addrs = [e0.address, e1.address]
+    e0.set_addresses(addrs)
+    e1.set_addresses(addrs)
+    return e0, e1
+
+
+def _capture_envs(eng):
+    """Wrap the transport's send to record every envelope it ships."""
+    envs = []
+    orig = eng.transport.send
+
+    def spy(address, envelope, payload):
+        envs.append(dict(envelope))
+        return orig(address, envelope, payload)
+
+    eng.transport.send = spy
+    return envs
+
+
+def test_causal_disabled_zero_wire_bytes_zero_work():
+    """The acceptance's disabled half: with trace_causal off (the
+    default) the coll envelope carries NO context key — frames are
+    byte-identical to a build without the feature — and the causal
+    counters never move."""
+    assert not causal.enabled()
+    e0, e1 = _engine_pair()
+    envs = _capture_envs(e0)
+    try:
+        import threading
+
+        from ompi_tpu.op import SUM as _SUM
+
+        t = threading.Thread(
+            target=lambda: e1.allreduce(np.ones(4), _SUM, cid=11))
+        t.start()
+        e0.allreduce(np.ones(4), _SUM, cid=11)
+        t.join()
+        assert envs, "spy saw no frames"
+        for env in envs:
+            assert "tc" not in env, env
+            # the full envelope shape a pre-causal build ships
+            assert set(env) <= {"kind", "cid", "seq", "src", "meta"}, env
+        assert causal.counters_snapshot() == {
+            "records": 0, "sends": 0, "recvs": 0, "dropped": 0}
+        assert causal.recent() == []
+    finally:
+        e0.close()
+        e1.close()
+
+
+def test_causal_context_flows_on_python_plane():
+    """Enabled: every coll frame carries the versioned context, both
+    sides record edges, and the recv edges name the sender's hop."""
+    causal.enable(True)
+    e0, e1 = _engine_pair()
+    envs = _capture_envs(e0)
+    try:
+        import threading
+
+        from ompi_tpu.op import SUM as _SUM
+
+        def run(eng):
+            causal.begin_op("W", "allreduce", 0)
+            eng.allreduce(np.ones(4), _SUM, cid=12)
+            causal.end_op()
+
+        t = threading.Thread(target=run, args=(e1,))
+        t.start()
+        run(e0)
+        t.join()
+        assert envs and all("tc" in env for env in envs), envs
+        for env in envs:
+            v, comm, op, seq, hop = env["tc"]
+            assert (v, comm, op, seq) == (causal.CTX_VERSION, "W",
+                                          "allreduce", 0), env["tc"]
+        c = causal.counters_snapshot()
+        assert c["records"] == 2 and c["sends"] >= 2 and c["recvs"] >= 2, c
+        recs = causal.recent()
+        assert len(recs) == 2
+        for rec in recs:
+            assert rec[0] == "W/allreduce/0"
+            assert rec[4], "no send edges"     # sends
+            assert rec[5], "no recv edges"     # recvs
+            for _src, hop, _t, wait in rec[5]:
+                assert hop >= 0 and wait >= 0
+    finally:
+        e0.close()
+        e1.close()
+
+
+def test_causal_native_plane_meta_ride_and_c_mirror():
+    """Native plane: the context rides the frame's meta-JSON region
+    end-to-end (send → C wire → recv pops it before the meta reaches
+    consumers), and the C schema mirror agrees with CTX_FIELDS."""
+    from tests.test_faultsim import _native
+
+    native = _native()
+    lib = native.load_library()
+    assert lib.tdcn_trace_ctx_version() == causal.CTX_VERSION
+    assert (lib.tdcn_trace_ctx_fields().decode()
+            == ",".join(causal.CTX_FIELDS))
+    causal.enable(True)
+    a = native.NativeDcnEngine(0, 2)
+    b = native.NativeDcnEngine(1, 2)
+    addrs = [a.address, b.address]
+    a.set_addresses(addrs)
+    b.set_addresses(addrs)
+    try:
+        causal.begin_op("W", "bcast", 3)
+        a._send(1, "cx", 0, np.arange(8, dtype=np.float64),
+                meta={"user": 1})
+        causal.end_op()
+        causal.begin_op("W", "bcast", 3)
+        env, payload = b._recv_full(0, "cx", 0, timeout=30)
+        causal.end_op()
+        assert np.allclose(payload, np.arange(8.0))
+        # the user meta survives, the reserved tc key does not
+        assert env.get("meta") == {"user": 1}, env
+        recs = causal.recent()
+        recvs = [r[5] for r in recs if r[5]]
+        assert recvs and recvs[0][0][:2] == [0, 0], recs  # src 0, hop 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_causal_solver_critical_path_and_tie_preference():
+    """Solver semantics the golden fixture doesn't isolate: the
+    backward walk, the near-tie upstream preference, an outright
+    transport dominance, and the dma-wait carve."""
+    def inst(r0, r1):
+        return causal.instances_from_records({0: [r0], 1: [r1]})
+
+    k = "W/allreduce/0"
+    # (a) near-tie: rank 1 shows ~30 ms transport AND 30 ms skew —
+    # the upstream cause wins within TIE_FACTOR
+    r0 = [k, 0, 31 * MS, "x", [[0, 31 * MS, 1]],
+          [[1, 0, 30 * MS, 30 * MS]], {}]
+    r1 = [k, 30 * MS, 61 * MS, "x", [[0, 30 * MS, 0]],
+          [[0, 0, 61 * MS, 31 * MS]], {}]
+    cp = causal.critical_path(inst(r0, r1)[k])
+    assert cp["dominant"] == {"rank": 1, "cause": "arrival-skew",
+                              "ns": 30 * MS}, cp["dominant"]
+    assert cp["makespan_ns"] == 61 * MS
+    # (b) outright transport dominance (no skew): a 40 ms delivery
+    # stall with on-time arrivals blames the wire, not the rank entry
+    r0 = [k, 0, 41 * MS, "x", [[0, 1 * MS, 1]], [], {}]
+    r1 = [k, 0, 41 * MS, "x", [],
+          [[0, 0, 41 * MS, 40 * MS]], {}]
+    cp = causal.critical_path(inst(r0, r1)[k])
+    assert cp["dominant"]["cause"] == "transport", cp
+    assert cp["dominant"]["rank"] == 1
+    # (c) dma carve: the same wire wait with a measured 35 ms DMA wait
+    # reclassifies into dma-wait
+    r1c = [k, 0, 41 * MS, "x", [],
+           [[0, 0, 41 * MS, 40 * MS]], {"dma": 35 * MS}]
+    cp = causal.critical_path(inst(r0, r1c)[k])
+    assert cp["per_rank"][1]["dma-wait"] == 35 * MS, cp["per_rank"]
+    assert cp["dominant"]["cause"] == "dma-wait", cp["dominant"]
+    # (d) ring/cts carve comes out of the sending rank's local
+    # compute once the walk jumps to it (the recv waited for a send
+    # issued after the receiver was ready)
+    r0d = [k, 0, 50 * MS, "x", [[0, 49 * MS, 1]], [],
+           {"ring": 20 * MS, "cts": 5 * MS}]
+    r1d = [k, 0, 50 * MS, "x", [],
+           [[0, 0, 50 * MS, 5 * MS]], {}]
+    cp = causal.critical_path(inst(r0d, r1d)[k])
+    pr = cp["per_rank"]
+    assert pr[0].get("ring-backpressure") == 20 * MS, pr
+    assert pr[0].get("cts-wait") == 5 * MS, pr
+    # incomplete instances are skipped by solve() under nprocs
+    out = causal.solve(inst(r0, r1), nprocs=3)
+    assert out["instances"] == 0
+
+
+def test_tpurun_np2_causal_critical_path_tri_surface(tmp_path):
+    """THE acceptance run: trace_causal + telemetry + metrics on, a
+    faultsim ``delay:ms=30;site=recv;proc=1`` plan making rank 1 the
+    straggler.  The critical path's dominant segment must name
+    (rank 1, arrival-skew) IDENTICALLY on all three surfaces: the
+    live /critical scrape mid-job, the offline
+    ``trace_report.py --critical-path`` over the finalize trace
+    files, and the finalize metrics JSONL's causal export joined
+    through ``causal.profile_from_records``."""
+    import os
+    import threading
+    import time
+    import urllib.request
+
+    out_trace = tmp_path / "trace"
+    out_metrics = tmp_path / "m"
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+           "--cpu-devices", "1",
+           "--mca", "trace_causal", "1",
+           "--mca", "trace_output", str(out_trace),
+           "--mca", "metrics_enable", "1",
+           "--mca", "metrics_output", str(out_metrics),
+           "--mca", "telemetry_enable", "1",
+           "--mca", "telemetry_interval_ms", "150",
+           "--mca", "btl", "tcp",
+           "--mca", "faultsim_enable", "1",
+           "--mca", "faultsim_seed", "3",
+           "--mca", "faultsim_plan", "delay:ms=30;site=recv;proc=1",
+           str(REPO / "tests" / "workers" / "mp_causal_worker.py")]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    env["CAUSAL_RUN_SECS"] = "6"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env,
+                            cwd=str(REPO))
+    lines: list[str] = []
+
+    def _reader():
+        for raw in iter(proc.stdout.readline, b""):
+            lines.append(raw.decode(errors="replace"))
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+    live_state = None
+    try:
+        url = None
+        deadline = time.monotonic() + 60
+        while url is None and time.monotonic() < deadline:
+            for l in list(lines):
+                if "[tpurun] telemetry: " in l:
+                    url = (l.split("[tpurun] telemetry: ", 1)[1]
+                           .split("/metrics", 1)[0])
+                    break
+            time.sleep(0.05)
+        assert url, "tpurun never printed the telemetry endpoint:\n" \
+            + "".join(lines)
+
+        # surface 1 — LIVE: scrape /critical mid-job until enough
+        # instances joined for a stable aggregate (the first few
+        # instances are warmup: skew hasn't built yet, so their
+        # paths are transport-only — 24 joins ≈ 1 s into the run)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                with urllib.request.urlopen(url + "/critical",
+                                            timeout=3) as r:
+                    state = json.loads(r.read().decode())
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if state.get("instances", 0) >= 24:
+                live_state = state
+                break
+            time.sleep(0.2)
+        assert live_state is not None and proc.poll() is None, (
+            "no mid-job /critical scrape with joined instances:\n"
+            + "".join(lines))
+        assert live_state["dominant"]["rank"] == 1, live_state["dominant"]
+        assert live_state["dominant"]["cause"] == "arrival-skew", (
+            live_state["dominant"], live_state["per_rank"])
+        # rank 1's on-path time dominates rank 0's
+        pr = live_state["per_rank"]
+        assert (sum(pr["1"].values())
+                > 3 * sum(pr.get("0", {}).values())), pr
+        # the /json brief agrees (the top.py blame column feed)
+        with urllib.request.urlopen(url + "/json", timeout=3) as r:
+            jstate = json.loads(r.read().decode())
+        crit = jstate["critical"]["per_rank"]
+        assert crit["1"]["cause"] == "arrival-skew", crit
+        assert proc.wait(timeout=180) == 0, "".join(lines)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+        t.join(timeout=10)
+    out = "".join(lines)
+    assert len([l for l in out.splitlines()
+                if "OK causal proc=" in l]) == 2, out
+    assert len([l for l in out.splitlines() if "OK finalize" in l]) == 2
+
+    # surface 2 — OFFLINE: trace_report --critical-path over the
+    # finalize trace files names the same dominant segment
+    paths = [f"{out_trace}.{p}.json" for p in range(2)]
+    for p in paths:
+        assert Path(p).exists(), out
+    rep = subprocess.run(
+        [sys.executable, str(REPORT)] + paths + ["--critical-path"],
+        capture_output=True, timeout=120)
+    assert rep.returncode == 0, rep.stderr.decode()
+    rtext = rep.stdout.decode()
+    assert "causal critical path:" in rtext, rtext
+    assert "dominant: rank 1 cause=arrival-skew" in rtext, rtext
+
+    # surface 3 — FINALIZE EXPORT: join the per-rank causal sections
+    # from the metrics JSONL exports through the same solver
+    records_by_proc = {}
+    counters_by_proc = {}
+    for p in range(2):
+        rows = [json.loads(l) for l in
+                open(f"{out_metrics}.{p}.jsonl") if l.strip()]
+        snap = rows[-1]
+        assert snap.get("reason") == "finalize", snap.get("reason")
+        records_by_proc[p] = snap.get("causal") or []
+        counters_by_proc[p] = snap.get("causal_counters") or {}
+        assert records_by_proc[p], f"rank {p}: empty causal export"
+        assert counters_by_proc[p].get("records", 0) > 0
+        # the .prom twin renders the trace_causal_* family
+        prom = open(f"{out_metrics}.{p}.prom").read()
+        assert "ompi_tpu_trace_causal_records" in prom
+    offline = causal.profile_from_records(records_by_proc)
+    assert offline["instances"] >= 8, offline["instances"]
+    assert offline["dominant"]["rank"] == 1, offline["dominant"]
+    assert offline["dominant"]["cause"] == "arrival-skew", (
+        offline["dominant"], offline["per_rank"])
+
+
+def test_causal_pvars_and_reset(world):
+    """trace_causal_* pvars: fixed segment, readable, reset in place
+    (session-wide and per-handle)."""
+    mpit.init_thread()
+    try:
+        names = [mpit.pvar_get_info(i).name
+                 for i in range(mpit.pvar_get_num())]
+        for k in causal.PVARS:
+            assert f"trace_causal_{k}" in names, k
+        causal.enable(True)
+        causal.begin_op("W", "allreduce", 0)
+        causal.note_send(1)
+        causal.end_op()
+        idx = mpit.pvar_index("trace_causal_sends")
+        assert mpit.pvar_read(idx) == 1
+        mpit.pvar_reset_one(idx)
+        assert mpit.pvar_read(idx) == 0
+        assert mpit.pvar_read(
+            mpit.pvar_index("trace_causal_records")) == 1
+        mpit.pvar_reset()
+        assert mpit.pvar_read(
+            mpit.pvar_index("trace_causal_records")) == 0
+    finally:
+        mpit.finalize()
+
+
+def test_device_window_reclaim_on_peer_failure(tmp_path):
+    """Satellite: a receiver dying between RTS and consume no longer
+    leaks its window — note_proc_failed reclaims exactly the dead
+    peer's staged windows, counts dcn_device_window_reclaimed, and
+    flight-records each one (naming the staging op when causal
+    tracing captured it)."""
+    from multiprocessing import shared_memory
+
+    from ompi_tpu.dcn import device
+    from ompi_tpu.metrics import core as mcore, flight
+
+    mcore.enable(True)
+    causal.enable(True)
+    dp = device.DevicePlane(0, min_size=1)
+    try:
+        causal.begin_op("W", "bcast", 7)
+        d_dead = dp.stage(np.arange(32, dtype=np.float64), dst_proc=1)
+        d_live = dp.stage(np.arange(16, dtype=np.float64), dst_proc=2)
+        causal.end_op()
+        assert d_dead and d_live and dp.pending_windows() == 2
+        # the engine hook: marking proc 1 failed reclaims ITS window
+        from ompi_tpu.dcn.collops import DcnCollEngine
+
+        eng = DcnCollEngine.__new__(DcnCollEngine)
+        eng._failed_procs = set()
+        eng._device_plane = dp
+        DcnCollEngine.note_proc_failed(eng, 1)
+        assert dp.pending_windows() == 1
+        assert dp.stats["device_window_reclaimed"] == 1
+        # the dead peer's segment is gone; the live peer's survives
+        with pytest.raises(FileNotFoundError):
+            shared_memory.SharedMemory(name=d_dead["w"], create=False)
+        seg = shared_memory.SharedMemory(name=d_live["w"], create=False)
+        seg.close()
+        recs = [r for r in flight.records()
+                if r.get("reason") == "device_window_reclaimed"]
+        assert recs, flight.records()
+        detail = recs[0].get("detail") or {}
+        assert detail.get("proc") == 1, recs[0]
+        assert detail.get("op") == "W/bcast/7", recs[0]
+        # idempotent: a second mark finds nothing to reclaim
+        DcnCollEngine.note_proc_failed(eng, 1)
+        assert dp.stats["device_window_reclaimed"] == 1
+        # the mark is remembered: staging toward the corpse degrades
+        # to the host plane instead of opening a doomed window (closes
+        # the stage-vs-mark race both ways)
+        fb0 = dp.stats["device_fallbacks"]
+        assert dp.stage(np.arange(8, dtype=np.float64),
+                        dst_proc=1) is None
+        assert dp.stats["device_fallbacks"] == fb0 + 1
+        assert dp.pending_windows() == 1  # still only the live window
+        # recover/heal clears the mark: windows flow again
+        DcnCollEngine.note_proc_healed(eng, 1)
+        d_back = dp.stage(np.arange(8, dtype=np.float64), dst_proc=1)
+        assert d_back is not None and dp.pending_windows() == 2
+    finally:
+        dp.close()
+        mcore.enable(False)
+        flight.reset()

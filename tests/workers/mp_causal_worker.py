@@ -1,0 +1,67 @@
+"""tpurun worker: drive causal tracing under an injected straggler
+while the test scrapes /critical mid-job.
+
+Launched by test_trace.py with ``--mca trace_causal 1 --mca
+telemetry_enable 1 --mca metrics_enable 1 --mca btl tcp`` plus trace/
+metrics output paths and a faultsim plan ``delay:ms=30;site=recv;
+proc=1`` — every inbound frame on rank 1 is delayed 30 ms, so rank 1
+exits each collective late and ARRIVES at the next one late: the
+critical path of (nearly) every instance must run through rank 1's
+late entry, and the blame decomposition must name
+(rank 1, arrival-skew) dominant on all three surfaces (live
+/critical, offline trace_report --critical-path, the finalize causal
+export — the test asserts the three agree).
+
+The loop uses the allreduce result as the stop vote (SPMD: every rank
+runs the same number of collectives); payloads stay small so the
+DCN schedule is the fold+bcast shape.
+"""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu.metrics import live
+from ompi_tpu.op import SUM
+from ompi_tpu.trace import causal
+from ompi_tpu.trace import core as trace_core
+
+RUN_SECS = float(os.environ.get("CAUSAL_RUN_SECS", "6"))
+
+world = api.init()
+p = world.proc
+n = world.size
+assert n == 2 and world.local_size == 1, (n, world.local_size)
+
+assert causal.enabled(), "trace_causal did not arm the causal plane"
+assert trace_core.enabled(), "trace_causal must imply the tracer"
+pub = live.publisher()
+assert pub is not None, "telemetry_enable did not start the publisher"
+
+t_end = time.monotonic() + RUN_SECS
+iters = 0
+while True:
+    vote = 1.0 if time.monotonic() < t_end else 0.0
+    out = world.allreduce(np.full((1, 4), vote), SUM)
+    iters += 1
+    if float(np.asarray(out)[0, 0]) < n:  # any rank voted stop
+        break
+
+c = causal.counters_snapshot()
+assert c["records"] >= iters, (c, iters)
+assert c["sends"] >= 1 and c["recvs"] >= 1, c
+# the wire context flowed: rank 0 receives rank 1's contribution with
+# a context on the fold leg; rank 1 receives the bcast with one — so
+# BOTH ranks must have recorded context-bearing recv edges
+recs = causal.recent()
+assert any(r[5] for r in recs), "no recv edges recorded"
+print(f"OK causal proc={p} iters={iters} records={c['records']} "
+      f"sends={c['sends']} recvs={c['recvs']}", flush=True)
+api.finalize()
+print(f"OK finalize proc={p}", flush=True)

@@ -111,10 +111,17 @@ def render(state: dict, prev: dict | None = None, url: str = "",
                     f"hb {ag.get('hb_age_ms', 0):.0f}ms "
                     f"{ag.get('session', '')}")
             print("agents: " + "   ".join(parts), file=out)
+    #: causal blame causes → column abbreviations (/json "critical")
+    blame_abbr = {"arrival-skew": "skew", "dma-wait": "dma",
+                  "ring-backpressure": "ring", "cts-wait": "cts",
+                  "transport": "wire", "compute": "comp"}
+    crit = {str(p): b for p, b in
+            ((state.get("critical") or {}).get("per_rank") or {}).items()}
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
-          f"{'sdep':>5}{'coal':>6}{'sched':>6}{'dev%':>6}"
-          f"{'failed':>7}  stall causes (ring/cts/other)", file=out)
+          f"{'sdep':>5}{'coal':>6}{'sched':>6}{'dev%':>6}{'dmaw':>7}"
+          f"{'blame':>6}{'failed':>7}  stall causes (ring/cts/other)",
+          file=out)
     for p in sorted(procs):
         f = procs[p]
         n = f.get("native") or {}
@@ -147,6 +154,15 @@ def render(state: dict, prev: dict | None = None, url: str = "",
         hostb = sum(int(n.get(k, 0)) for k in _BYTES)
         dev = (f"{devb / (devb + hostb):>5.0%}" if (devb + hostb)
                else "    -")
+        # device-plane DMA-wait column: recv-semaphore time this rank
+        # spent blocked on remote-copy completion signals (ms)
+        dmaw_ns = int(n.get("device_dma_wait_ns", 0))
+        dmaw = f"{dmaw_ns / 1e6:>6.1f}" if dmaw_ns else "     -"
+        # causal blame column: this rank's dominant critical-path
+        # cause from the aggregator's /critical join
+        bl = crit.get(str(p)) or {}
+        blame = blame_abbr.get(bl.get("cause", ""), "-") \
+            if bl.get("total_ns") else "-"
         failed = f.get("failed") or []
         print(f"{p:<5}{mbs:>8.1f}{msgs:>8.0f}"
               f"{int(n.get('delivered', 0)):>10}"
@@ -155,7 +171,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               f"{int(n.get('dedup_drops', 0)):>6}"
               f"{int(n.get('deadline_expired', 0)):>6}"
               f"{int(n.get('stream_depth', 0)):>5}{coal:>6}{sched:>6}"
-              f"{dev:>6}"
+              f"{dev:>6}{dmaw:>7}{blame:>6}"
               f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
               file=out)
     strag = state.get("straggler") or {}
@@ -237,10 +253,16 @@ def watch(url: str, interval: float) -> int:
 # -- selftest ----------------------------------------------------------
 
 
+def _scrape_url(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
 def selftest() -> int:
     """Drive a REAL aggregator over REAL HTTP with synthetic 2-rank
-    frames: straggler join (rank 1 always late), rate computation,
-    Prometheus families, history ring, and the renderer."""
+    frames: straggler join (rank 1 always late), causal blame join
+    (/critical + the blame column), rate computation, Prometheus
+    families, history ring, and the renderer."""
     import io
 
     from ompi_tpu.metrics.live import TelemetryAggregator
@@ -255,13 +277,34 @@ def selftest() -> int:
                           "delivered": 50 * (rnd + 1),
                           "stall_ns": 5_000_000 * (rnd + 1),
                           "ring_stall_ns": 3_000_000 * (rnd + 1),
-                          "cts_wait_ns": 1_000_000 * (rnd + 1)}
+                          "cts_wait_ns": 1_000_000 * (rnd + 1),
+                          "device_dma_wait_ns": 2_000_000 * (rnd + 1)}
                 # rank 1 arrives 25 ms late at every collective
                 late = 25_000_000 if proc == 1 else 0
                 colls = [[f"MPI_COMM_WORLD/allreduce/{rnd * 4 + i}",
                           base + (rnd * 4 + i) * 50_000_000 + late,
                           base + (rnd * 4 + i) * 50_000_000 + late
                           + 1_000_000] for i in range(4)]
+                # causal records for the same instances (fold+bcast
+                # shape): rank 0 waits 25 ms for rank 1's late
+                # contribution — the /critical join must blame rank 1
+                # with arrival-skew
+                causal_rows = []
+                for i in range(4):
+                    t0 = base + (rnd * 4 + i) * 50_000_000
+                    key = f"MPI_COMM_WORLD/allreduce/{rnd * 4 + i}"
+                    if proc == 0:
+                        causal_rows.append(
+                            [key, t0, t0 + 25_600_000, "han",
+                             [[0, t0 + 25_500_000, 1]],
+                             [[1, 0, t0 + 25_200_000, 25_000_000]],
+                             {"ring": 0, "cts": 0, "dma": 0}])
+                    else:
+                        causal_rows.append(
+                            [key, t0 + 25_000_000, t0 + 26_500_000,
+                             "han", [[0, t0 + 25_100_000, 0]],
+                             [[0, 0, t0 + 26_400_000, 800_000]],
+                             {"ring": 0, "cts": 0, "dma": 0}])
                 agg.ingest({
                     "proc": proc, "nprocs": 2,
                     "ts_ns": base + rnd * 500_000_000,
@@ -272,6 +315,7 @@ def selftest() -> int:
                         "max_wait_ns": 9_000_000,
                         "provider": "han"}},
                     "colls": colls,
+                    "causal": causal_rows,
                     "clock": {"1": [0, 1000]} if proc == 0 else {},
                     "failed": [],
                 })
@@ -303,6 +347,25 @@ def selftest() -> int:
         text = buf.getvalue()
         assert "top stragglers" in text and "rank 1" in text, text
         assert "allreduce" in text and "stall causes" in text, text
+        # causal blame column: the aggregator joined 12 instances and
+        # the dashboard blames rank 1 with arrival-skew; rank 0's
+        # on-path share is sub-ms waits, so it shows a non-skew cause
+        crit = state.get("critical") or {}
+        assert crit["per_rank"]["1"]["cause"] == "arrival-skew", crit
+        assert crit["instances"] == 12, crit
+        row1 = [l for l in text.splitlines()
+                if l.startswith("1 ")][0]
+        assert "skew" in row1, row1
+        # device-plane DMA-wait column renders the latest frame's ms
+        assert "   6.0" in row1, row1
+        # /critical full endpoint: top paths + per-job state over HTTP
+        cstate = json.loads(_scrape_url(agg.url + "/critical"))
+        assert cstate["dominant"]["rank"] == 1, cstate["dominant"]
+        assert cstate["dominant"]["cause"] == "arrival-skew", cstate
+        top_rows = cstate["jobs"][""]["top"]
+        assert top_rows and top_rows[0]["path"], top_rows[:1]
+        prof = cstate["jobs"][""]["profile"]
+        assert "allreduce/han" in prof and prof["allreduce/han"]["n"] == 12
         # tpud extension: a daemon host publishes liveness + journal
         # depth through extra_state; the renderer gives it a line
         agg.extra_state = lambda: {"daemon": {

@@ -28,7 +28,7 @@ from typing import Any
 # tools/ is not a package entry point for ompi_tpu; reach the repo root
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from ompi_tpu.trace import chrome, core, merge  # noqa: E402
+from ompi_tpu.trace import causal, chrome, core, merge  # noqa: E402
 
 
 def percentile(sorted_vals: list[float], q: float) -> float:
@@ -89,6 +89,122 @@ def render(doc: dict[str, Any], top: int, out=sys.stdout) -> None:
                   f"{e.get('cat', '?')}/{e['name']}  {key}", file=out)
 
 
+def render_critical(summary: dict, top: int, out=sys.stdout) -> None:
+    """Render a causal blame summary (``causal.solve`` output): the
+    per-rank decomposition, the per-algorithm profile, and the top-N
+    slowest collectives with their critical paths."""
+    n = summary.get("instances", 0)
+    print(f"\ncausal critical path: {n} cross-rank instance(s) solved",
+          file=out)
+    if not n:
+        print("  (no causal events — run with --mca trace_causal 1)",
+              file=out)
+        return
+    print(f"  {'rank':<5}{'on-path ms':>11}  blame breakdown", file=out)
+    per_rank = summary.get("per_rank") or {}
+    for r in sorted(per_rank, key=int):
+        b = per_rank[r]
+        total = sum(b.values())
+        causes = "  ".join(
+            f"{c} {v / 1e6:.2f}ms"
+            for c, v in sorted(b.items(), key=lambda kv: -kv[1]))
+        print(f"  {r:<5}{total / 1e6:>11.2f}  {causes}", file=out)
+    dom = summary.get("dominant") or {}
+    print(f"  dominant: rank {dom.get('rank')} "
+          f"cause={dom.get('cause')} ({dom.get('ns', 0) / 1e6:.2f} ms)",
+          file=out)
+    prof = summary.get("profile") or {}
+    if prof:
+        print("\n  per-algorithm blame profile:", file=out)
+        print(f"  {'op/alg':<28}{'n':>5}{'avg ms':>9}  top causes",
+              file=out)
+        for key in sorted(prof):
+            p = prof[key]
+            avg = p["makespan_ns"] / max(1, p["n"]) / 1e6
+            causes = sorted(p.get("causes", {}).items(),
+                            key=lambda kv: -kv[1])[:3]
+            ctext = "  ".join(f"{c} {v / 1e6:.2f}ms" for c, v in causes)
+            print(f"  {key:<28}{p['n']:>5}{avg:>9.2f}  {ctext}", file=out)
+    rows = (summary.get("top") or [])[:top]
+    if rows:
+        print(f"\n  slowest {len(rows)} collective(s):", file=out)
+        for cp in rows:
+            d = cp.get("dominant") or {}
+            print(f"    {cp['makespan_ns'] / 1e6:>9.2f} ms  {cp['key']}"
+                  f"  [{cp.get('alg') or '?'}]  dominant: rank "
+                  f"{d.get('rank')} {d.get('cause')}", file=out)
+            for r, cause, ns in cp.get("path") or ():
+                print(f"        rank {r:<3}{cause:<18}"
+                      f"{ns / 1e6:>9.3f} ms", file=out)
+
+
+def _golden_causal_check() -> None:
+    """Solve the golden causal-DAG fixture and hold the answer — the
+    solver-regression half of the selftest (tier-1)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "tests", "golden", "causal_fixture.json")
+    with open(path) as f:
+        doc = json.load(f)
+    records = {int(p): rows
+               for p, rows in doc["records_by_proc"].items()}
+    out = causal.profile_from_records(records)
+    exp = doc["expect"]
+    assert out["instances"] == exp["instances"], (
+        out["instances"], exp["instances"])
+    assert out["dominant"]["rank"] == exp["rank"], out["dominant"]
+    assert out["dominant"]["cause"] == exp["cause"], out["dominant"]
+    for key, causes in (exp.get("per_rank") or {}).items():
+        got = out["per_rank"][int(key)]
+        for cause, ns in causes.items():
+            assert got.get(cause) == ns, (key, cause, got)
+    # render exercises the report path on the same data
+    import io
+
+    buf = io.StringIO()
+    render_critical(out, top=3, out=buf)
+    text = buf.getvalue()
+    assert "dominant: rank" in text and exp["cause"] in text, text
+
+
+def _causal_stack_check(tmp: str) -> dict:
+    """Drive the REAL causal hooks → Chrome export → merge →
+    instances_from_chrome → solver for two synthetic ranks; returns
+    the solved summary (plumbing half of the selftest)."""
+    import os
+
+    paths = []
+    for rank in range(2):
+        core.reset()
+        causal.reset()
+        core.enable(True, buffer_events=1024)
+        causal.enable(True)
+        for i in range(2):
+            causal.begin_op("MPI_COMM_WORLD", "allreduce", i)
+            causal.note_send(1 - rank)
+            causal.note_recv(1 - rank,
+                             [causal.CTX_VERSION, "MPI_COMM_WORLD",
+                              "allreduce", i, 0], 50_000)
+            causal.end_op(alg="basic")
+        assert causal.counter("records") == 2, causal.counters_snapshot()
+        assert causal.counter("sends") == 2 and causal.counter("recvs") == 2
+        p = os.path.join(tmp, f"causal.{rank}.json")
+        chrome.dump(p, pid=rank)
+        paths.append(p)
+    merged = merge.merge_files(paths)
+    insts = causal.instances_from_chrome(merged)
+    assert len(insts) == 2, sorted(insts)
+    for inst in insts.values():
+        assert sorted(inst["ranks"]) == [0, 1], inst["ranks"]
+        for st in inst["ranks"].values():
+            assert st["exit"] >= st["arrive"] and st["sends"] and st["recvs"]
+    summary = causal.solve(insts, nprocs=2)
+    assert summary["instances"] == 2, summary
+    assert summary["dominant"]["rank"] in (0, 1)
+    return summary
+
+
 def selftest() -> int:
     """Drive the real tracer → export → merge → report stack on
     synthetic 2-rank data and assert the subsystem invariants."""
@@ -143,12 +259,20 @@ def selftest() -> int:
         render(merged, top=5, out=buf)
         text = buf.getvalue()
         assert "allreduce" in text and "p99" in text, text
+        # causal-tracing legs: the golden DAG fixture pins the solver
+        # (dominant rank + cause + per-rank buckets), then the real
+        # hook → chrome → merge → solve stack proves the plumbing
+        _golden_causal_check()
+        summary = _causal_stack_check(tmp)
         print("selftest OK: 2 ranks, "
-              f"{len(merged['traceEvents'])} merged events, keys aligned")
+              f"{len(merged['traceEvents'])} merged events, keys "
+              f"aligned; causal golden + {summary['instances']} "
+              "stack-solved instances")
         return 0
     finally:
         core.reset()
         core.enable(was_enabled)
+        causal.reset()
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
@@ -170,6 +294,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="explicit per-rank clock offset in µs "
                     "(that rank's clock minus the reference clock; "
                     "repeatable, overrides --clock-from)")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="solve the cross-rank causal DAG (requires "
+                    "traces recorded with --mca trace_causal 1): "
+                    "per-collective critical paths, per-rank blame "
+                    "decomposition, per-algorithm profiles")
     ap.add_argument("--selftest", action="store_true",
                     help="run the built-in self-check and exit")
     ns = ap.parse_args(argv)
@@ -194,6 +323,13 @@ def main(argv: list[str] | None = None) -> int:
                           for p, o in sorted(offsets.items())))
     doc = merge.merge_files(ns.traces, offsets_us=offsets or None)
     render(doc, top=ns.top)
+    if ns.critical_path:
+        pids = {int(e.get("pid", 0)) for e in doc["traceEvents"]
+                if e.get("ph") != "M"}
+        render_critical(
+            causal.solve(causal.instances_from_chrome(doc),
+                         nprocs=len(pids) or None),
+            top=ns.top)
     if ns.merge_out:
         with open(ns.merge_out, "w") as f:
             json.dump(doc, f)
