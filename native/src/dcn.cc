@@ -283,6 +283,13 @@ enum TdcnStatIdx {
   TS_DEVICE_WINDOW_RECLAIMED,  // windows force-retired on a peer-
                                // failure mark (RTS-to-consume leak
                                // edge; Python-side provider)
+  // -- plane-health tail (appended; version stays 1) ------------------
+  // Per-(peer, plane) failover state machine (Python-side provider,
+  // ompi_tpu/dcn/device.py PlaneHealth); zeroed slots here keep
+  // TDCN_STAT_NAMES the single source of schema truth.
+  TS_PLANE_DEMOTIONS,    // peers demoted off a plane on strike-out
+  TS_PLANE_PROMOTIONS,   // peers promoted back after a heal probe
+  TS_PLANE_HEAL_PROBES,  // probe sends routed through a demoted plane
   TS_COUNT
 };
 
@@ -303,7 +310,8 @@ static const char *TDCN_STAT_NAMES =
     "device_sends,device_recvs,device_bytes_placed,"
     "device_dma_waits,device_dma_wait_ns,"
     "device_arb_device,device_arb_host,device_fallbacks,"
-    "device_window_reclaimed";
+    "device_window_reclaimed,"
+    "plane_demotions,plane_promotions,plane_heal_probes";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];

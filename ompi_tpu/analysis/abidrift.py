@@ -48,6 +48,15 @@ that drift silently because no compiler sees both sides:
     rename inside the frozen prefix silently mis-decodes every frame
     between mixed builds).
 
+``plane-catalog-drift``
+    The plane-health family (PR 18), both directions: the ``plane_*``
+    counters must agree between ``NATIVE_COUNTERS`` and the device
+    plane's ``STATS_KEYS`` (the provider merge silently drops a key
+    missing from either), every ``plane_*`` counter and ``dcn_plane_*``
+    knob must appear in the README plane-health catalog, and every
+    backticked ``plane_*`` name the README promises must exist in
+    code — stale catalog entries are drift too.
+
 ``pvar-name-lint``
     The ``trace_causal_*`` pvar family (``causal.PVARS``): every name
     is a well-formed lowercase identifier, collides with no other
@@ -713,6 +722,70 @@ def check_catalogs(root: Path) -> list[Finding]:
     return out
 
 
+DEVICE_PY = "ompi_tpu/dcn/device.py"
+
+
+def check_plane_catalog(root: Path) -> list[Finding]:
+    """``plane-catalog-drift``: the plane-health counter family
+    (``plane_*``) and knob family (``dcn_plane_*``) must agree across
+    code and the README "Plane health" catalog, BOTH directions —
+    a counter/knob the code carries but the README omits is an
+    undocumented operator surface; a name the README documents but
+    the code lacks is a stale promise (rename/removal drift).  The
+    code side is itself cross-checked: the device plane's STATS_KEYS
+    plane family must equal the NATIVE_COUNTERS plane family (the
+    provider merge would silently drop a key missing from either)."""
+    out: list[Finding] = []
+    native = [n for n in py_native_counters(root)[0]
+              if n.startswith("plane_")]
+    skeys, sline = _py_tuple_of(root, DEVICE_PY, "STATS_KEYS")
+    dev = [n for n in skeys if n.startswith("plane_")]
+    for name in native:
+        if name not in dev:
+            out.append(Finding(
+                PASS, "plane-catalog-drift", DEVICE_PY, sline, name,
+                f"plane-health counter {name!r} is in NATIVE_COUNTERS "
+                "but missing from the device plane's STATS_KEYS — the "
+                "provider would never populate it", SEV_ERROR))
+    for name in dev:
+        if name not in native:
+            out.append(Finding(
+                PASS, "plane-catalog-drift", DEVICE_PY, sline, name,
+                f"plane-health counter {name!r} is in STATS_KEYS but "
+                "missing from NATIVE_COUNTERS — the provider merge "
+                "drops unknown keys", SEV_ERROR))
+    try:
+        readme = (root / README).read_text()
+    except OSError:
+        return out
+    knobs = [n for names in central_var_tables(root).values()
+             for n in names if n.startswith("dcn_plane_")]
+    # code → README: every plane counter and knob is documented
+    for name in native:
+        if name not in readme:
+            out.append(Finding(
+                PASS, "plane-catalog-drift", README, 0, name,
+                f"plane-health counter {name!r} is missing from the "
+                "README plane-health catalog", SEV_ERROR))
+    for name in knobs:
+        if name not in readme:
+            out.append(Finding(
+                PASS, "plane-catalog-drift", README, 0, name,
+                f"plane-health knob {name!r} is missing from the "
+                "README plane-health catalog", SEV_ERROR))
+    # README → code: every plane_* token the README promises exists
+    # (dcn_plane_<x> resolves as a knob or the counter pvar form)
+    doc = set(re.findall(r"`(?:dcn_)?(plane_[a-z_]+)`", readme))
+    known = set(native) | {k[len("dcn_"):] for k in knobs}
+    for name in sorted(doc - known):
+        out.append(Finding(
+            PASS, "plane-catalog-drift", README, 0, name,
+            f"README documents plane-health name {name!r} but neither "
+            "a plane_* counter nor a dcn_plane_* knob carries it — "
+            "stale catalog entry", SEV_ERROR))
+    return out
+
+
 def run(root: str | Path, files=None) -> list[Finding]:
     """Run the ABI drift pass.  ``files`` is accepted for driver
     symmetry; the pass's inputs are the fixed contract files."""
@@ -724,4 +797,5 @@ def run(root: str | Path, files=None) -> list[Finding]:
     out += check_trace_ctx(root)
     out += check_causal_pvars(root)
     out += check_catalogs(root)
+    out += check_plane_catalog(root)
     return out

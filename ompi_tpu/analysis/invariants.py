@@ -73,10 +73,11 @@ TIMEOUT_SCOPE = ("ompi_tpu/dcn", "ompi_tpu/p2p")
 #: becomes a policy decision that belongs in a registered var
 LONG_WAIT_S = 60
 
-#: the named escalation paths (tentpole list)
+#: the named escalation paths (tentpole list) — device.py joined at
+#: PR 18 when its waits gained ULFM escalation (plane-health failover)
 ESCALATION_FILES = (
     "ompi_tpu/dcn/tcp.py", "ompi_tpu/dcn/native.py",
-    "ompi_tpu/dcn/collops.py",
+    "ompi_tpu/dcn/collops.py", "ompi_tpu/dcn/device.py",
 )
 
 #: hot-path packages whose calls into gated subsystems are checked

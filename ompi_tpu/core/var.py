@@ -490,7 +490,23 @@ ROBUSTNESS_VARS = (
      "(kinds: drop delay dup trunc connkill stall ringfail dialfail "
      "daemonkill; "
      "'proc=N' restricts a rule to one rank, e.g. "
-     "'delay:ms=30;site=recv;proc=1' slows only rank 1)"),
+     "'delay:ms=30;site=recv;proc=1' slows only rank 1; "
+     "'site=device'/'site=device_recv' target the device-window "
+     "stage / materialize paths for plane-failover drills)"),
+    ("dcn", "", "plane_strikes", 3, "int",
+     "Consecutive per-(peer, plane) failures (deadline escalation, "
+     "injected device fault, failed stage) before the plane-health "
+     "table demotes that peer's traffic off the plane — device-window "
+     "sends degrade to the host ring/TCP plane while demoted.  One "
+     "success resets the strike count (the btl exclude-and-reroute "
+     "rule, made per-peer)"),
+    ("dcn", "", "plane_heal_interval", 5.0, "float",
+     "Seconds after a demotion before the arbitration layer routes "
+     "ONE eligible send back through the demoted plane as a heal "
+     "probe: a consumed probe window re-promotes the (peer, plane) "
+     "pair, a failed one re-arms the interval.  <= 0 disables heal "
+     "probes (a demotion then sticks until replace()/respawn clears "
+     "the health marks)"),
 )
 
 
@@ -561,6 +577,24 @@ SERVING_VARS = (
      "dead and respawns it over the rsh leg — the reborn agent "
      "re-adopts still-live workers from the last-known pid table and "
      "reports the dead ones for the normal respawn+repair leg"),
+    ("serve", "", "journal_max_kb", 0, "int",
+     "Journal rotation size bound: once the crash journal grows past "
+     "this many KiB the daemon rewrites it in place as one compacted "
+     "snapshot line (current replayed state) plus an empty tail, so a "
+     "long-lived daemon's journal stops growing without bound "
+     "(0 = no size-triggered rotation)"),
+    ("serve", "", "journal_max_age_s", 0.0, "float",
+     "Journal rotation age bound: rotate (compact-in-place) once the "
+     "current journal segment is older than this many seconds, "
+     "regardless of size — bounds replay work after a crash even "
+     "under a slow event trickle (0 = no age-triggered rotation)"),
+    ("serve", "", "agent_hb_only", False, "bool",
+     "Judge launch-agent liveness by heartbeat staleness alone, "
+     "ignoring rsh-launcher exit: for backgrounding agent templates "
+     "(rsh wrappers that daemonize and exit immediately) the launch "
+     "process dying is normal, so only serve_agent_timeout seconds "
+     "of heartbeat silence declares the agent dead (default off: "
+     "either signal — rsh exit or hb silence — triggers respawn)"),
     ("serve", "", "reattach_timeout", 30.0, "float",
      "Crash-safe control plane window, both sides: how long a "
      "resident worker that lost its daemon parks and polls the "

@@ -464,10 +464,14 @@ class DcnCollEngine:
                 # device-plane p2p: the frame carried only the window
                 # descriptor — materialize before matching (the recv-
                 # semaphore wait runs on the delivery thread; bounded
-                # by the shared recv deadline)
+                # by the shared recv deadline, escalating with the
+                # sender struck on the plane-health table)
                 from . import device as _device
 
-                payload = _device.materialize(self._root_engine(), desc)
+                src = env.get("src")
+                payload = _device.materialize(
+                    self._root_engine(), desc,
+                    src_root=(int(src) if src is not None else None))
             cid = env.get("cid")
             with self._p2p_lock:
                 fn = self._p2p_handlers.get(cid)
@@ -578,8 +582,11 @@ class DcnCollEngine:
             # (straight into the posted buffer when one matches)
             from . import device as _device
 
+            rp = self.root_proc_of(src)
             payload = _device.materialize(self._root_engine(), desc,
-                                          into=into)
+                                          into=into,
+                                          src_root=(rp if rp >= 0
+                                                    else None))
             got = (env, payload)
         # "tc" is a reserved envelope key: popped whether or not THIS
         # rank records (a causal-enabled peer's frame must never leak
@@ -845,6 +852,10 @@ class DcnCollEngine:
 
     def close(self) -> None:
         if getattr(self, "_device_plane", None) is not None:
+            # drain-then-close (the tdcn_close discipline): the plane
+            # gives in-flight staged windows a bounded 2 s to be
+            # consumed before sweeping — an unconditional sweep here
+            # used to unlink segments a receiver was mid-materialize on
             self._device_plane.close()
         self.transport.close()
 

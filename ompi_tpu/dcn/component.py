@@ -18,6 +18,19 @@ is an overlay, not a btl of its own: it is never selected by
 host transport), and its knobs live in the central ``DEVICE_VARS``
 table (``core/var.py``) because both the Python and native engines
 consume them.
+
+Plane *health* note (the failover half of btl selection): the
+reference excludes a failing btl component and re-routes traffic to
+the next capable one; here the device plane carries a per-(peer,
+plane) health table (:class:`ompi_tpu.dcn.device.PlaneHealth`) —
+``dcn_plane_strikes`` consecutive failures demote a peer's traffic
+back onto the selected host btl mid-job, and a heal probe after
+``dcn_plane_heal_interval`` seconds re-promotes a recovered plane.
+Because a demoted stage never ships a descriptor, the payload rides
+the host btl's ordinary per-peer sequence space and the dedup
+watermark keeps delivery exactly-once across the demotion boundary.
+The ``dcn_plane_*`` knobs live in the central ``ROBUSTNESS_VARS``
+table next to the deadline family they extend.
 """
 
 from __future__ import annotations

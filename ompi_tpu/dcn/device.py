@@ -48,6 +48,34 @@ DMA placed into a window), ``device_dma_waits``/``device_dma_wait_ns``
 ``device_arb_host`` (plane-arbitration decisions), and
 ``device_fallbacks`` (eligible sends that degraded to the host plane
 because the window could not be opened).
+
+Plane health (the failover half of btl selection): size/layout/
+reachability say which plane *can* carry a message; the
+:class:`PlaneHealth` table says which plane currently *should*.  Each
+(peer, plane) pair accumulates consecutive strikes — a receiver-side
+deadline expiry or truncated DMA, an injected device fault, a failed
+heal probe — and at ``dcn_plane_strikes`` the pair demotes: eligible
+sends toward that peer degrade to the host ring/TCP plane.  Because a
+demoted (or dropped) stage never ships a descriptor, the payload goes
+out as an ordinary host-plane frame with its own per-peer seq, so the
+existing dedup watermark keeps delivery exactly-once across the
+demotion boundary — no replay protocol needed.  After
+``dcn_plane_heal_interval`` seconds the arbitration layer routes ONE
+eligible send back through the demoted plane as a heal probe: a
+consumed probe window promotes the pair back to healthy, a failed one
+re-arms the interval.  ``replace()``/respawn clears health marks
+alongside the failure marks (``clear_failed``).  Transitions are
+counted (``dcn_plane_demotions``/``plane_promotions``/
+``plane_heal_probes``), flight-recorded, and appended to an
+append-only transition log the chaos golden fixture replays.
+
+Fault injection: ``site=device`` hooks the stage path (``drop`` =
+simulated DMA failure → host-plane fallback + health strike;
+``trunc`` = short published DMA length the receiver detects;
+``delay``/``stall`` sleep before the RTS) and ``site=device_recv``
+hooks materialize (``delay``/``stall`` before the semaphore wait) —
+seeded-deterministic and gated by the same one module bool as every
+other transport hook.
 """
 
 from __future__ import annotations
@@ -58,6 +86,8 @@ import threading
 import time
 
 import numpy as np
+
+from ompi_tpu.faultsim import core as _fsim
 
 #: semaphore word states (window header slot 0)
 SEM_EMPTY, SEM_DATA, SEM_CONSUMED = 0, 1, 2
@@ -76,6 +106,10 @@ STATS_KEYS = (
     # between RTS and consume (the reclaim that plugs the PR-14
     # recorded leak; each one is flight-recorded)
     "device_window_reclaimed",
+    # plane-health transitions (PlaneHealth): peers demoted off the
+    # plane on strike-out, peers promoted back by a successful heal
+    # probe, and the probe sends routed through a demoted plane
+    "plane_demotions", "plane_promotions", "plane_heal_probes",
 )
 
 #: descriptor key the control frame carries (collops attaches it to
@@ -118,6 +152,216 @@ def device_tuning() -> tuple[bool, int, bool]:
     return (bool(vals["dcn_device_enable"]),
             int(vals["dcn_device_min_size"]),
             bool(vals["dcn_device_interpret"]))
+
+
+def plane_tuning() -> tuple[int, float]:
+    """Resolve (strikes, heal_interval) for the plane-health table
+    against the default MCA context, falling back to the central
+    ROBUSTNESS_VARS defaults — the :func:`device_tuning` pattern for
+    the ``dcn_plane_*`` knobs."""
+    from ompi_tpu.core.var import ROBUSTNESS_VARS, full_var_name
+
+    vals: dict[str, object] = {}
+    for fw, comp, name, default, _typ, _h in ROBUSTNESS_VARS:
+        full = full_var_name(fw, comp, name)
+        if full in ("dcn_plane_strikes", "dcn_plane_heal_interval"):
+            vals[full] = default
+    try:
+        from ompi_tpu.core import mca
+
+        store = mca.default_context().store
+        for full in vals:
+            v = store.get(full)
+            if v is not None:
+                vals[full] = v
+    except Exception:  # noqa: BLE001 — pre-init / teardown: defaults
+        pass
+    return (int(vals["dcn_plane_strikes"]),
+            float(vals["dcn_plane_heal_interval"]))
+
+
+class PlaneHealth:
+    """Per-(peer, plane) failover state machine — the health half of
+    btl selection (the reference excludes a failing component and
+    re-routes to the next capable one; we do it per peer, mid-job,
+    and reversibly).
+
+    States per peer::
+
+        healthy --strike x dcn_plane_strikes--> demoted
+        demoted --dcn_plane_heal_interval-----> probing (one send)
+        probing --probe consumed--------------> healthy  (promotion)
+        probing --probe failed----------------> demoted  (re-armed)
+
+    Strikes are CONSECUTIVE: any consumed window toward the peer
+    resets the count (one slow wait does not condemn a plane).  Every
+    demotion/probe/promotion is counted on the owning plane's stats
+    block, flight-recorded, and appended to :attr:`transitions` — the
+    append-only log the chaos golden fixture compares.  ``clear()``
+    (the replace()/respawn path) forgets a peer entirely, marks
+    included."""
+
+    def __init__(self, plane: str = "device",
+                 strikes: int | None = None,
+                 heal_interval: float | None = None,
+                 stats: dict | None = None):
+        if strikes is None or heal_interval is None:
+            s, h = plane_tuning()
+            strikes = s if strikes is None else strikes
+            heal_interval = h if heal_interval is None else heal_interval
+        self.plane = plane
+        self.max_strikes = max(1, int(strikes))
+        self.heal_interval = float(heal_interval)
+        self.stats = stats if stats is not None else {
+            "plane_demotions": 0, "plane_promotions": 0,
+            "plane_heal_probes": 0}
+        self._strikes: dict[int, int] = {}
+        #: proc → monotonic time of the demotion (or last failed
+        #: probe) — the heal-interval clock
+        self._demoted: dict[int, float] = {}
+        #: procs with one probe send in flight (at most one at a time)
+        self._probing: set[int] = set()
+        #: proc → monotonic probe-start time: a probe window that is
+        #: never consumed (plane still sick, escalation not yet back)
+        #: resolves failed after :meth:`probe_timeout` — the probe
+        #: slot must not wedge demoted-forever
+        self._probe_t: dict[int, float] = {}
+        #: append-only (event, proc, cause) transition log — the
+        #: golden-fixture surface; events: demote / probe / promote /
+        #: probe_fail / clear
+        self.transitions: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def _record(self, event: str, proc: int, cause: str) -> None:
+        # called under self._lock
+        self.transitions.append((event, int(proc), cause))
+        from ompi_tpu.metrics import flight as _flight
+
+        _flight.record(f"plane_{event}", plane=self.plane,
+                       proc=int(proc),
+                       **({"cause": cause} if cause else {}))
+
+    def ok(self, proc: int | None) -> bool:
+        """True while the (peer, plane) pair is not demoted (unknown
+        peers are healthy — nothing tracked, nothing held against)."""
+        if proc is None:
+            return True
+        with self._lock:
+            return int(proc) not in self._demoted
+
+    def strike(self, proc: int | None, cause: str) -> bool:
+        """One failure toward ``proc`` on this plane (deadline expiry,
+        truncated DMA, injected fault).  Returns True when this strike
+        crossed ``dcn_plane_strikes`` and demoted the pair."""
+        if proc is None:
+            return False
+        p = int(proc)
+        with self._lock:
+            if p in self._demoted:
+                return False  # already off the plane
+            n = self._strikes.get(p, 0) + 1
+            self._strikes[p] = n
+            if n < self.max_strikes:
+                return False
+            self._demoted[p] = time.monotonic()
+            self._probing.discard(p)
+            self._probe_t.pop(p, None)
+            self.stats["plane_demotions"] += 1
+            self._record("demote", p, cause)
+            return True
+
+    def success(self, proc: int | None) -> None:
+        """A consumed (non-probe) window toward ``proc``: strikes are
+        consecutive, so any success resets the count."""
+        if proc is None:
+            return
+        with self._lock:
+            self._strikes.pop(int(proc), None)
+
+    def allow_probe(self, proc: int | None) -> bool:
+        """Heal schedule: True routes THIS send through the demoted
+        plane as the probe — at most one in flight per peer, never
+        before ``dcn_plane_heal_interval`` has elapsed since the
+        demotion (or the last failed probe).  <= 0 disables probing
+        (the demotion sticks until :meth:`clear`)."""
+        if proc is None or self.heal_interval <= 0:
+            return False
+        p = int(proc)
+        now = time.monotonic()
+        with self._lock:
+            since = self._demoted.get(p)
+            if since is None:
+                return False
+            if p in self._probing:
+                if now - self._probe_t.get(p, since) > self.probe_timeout():
+                    # the probe window was never consumed (plane still
+                    # sick, its escalation not yet visible here):
+                    # resolve it failed and re-arm — the probe slot
+                    # must not stay wedged forever
+                    self._probing.discard(p)
+                    self._probe_t.pop(p, None)
+                    self._demoted[p] = now
+                    self._record("probe_fail", p, "probe_timeout")
+                return False
+            if now - since < self.heal_interval:
+                return False
+            self._probing.add(p)
+            self._probe_t[p] = now
+            self.stats["plane_heal_probes"] += 1
+            self._record("probe", p, "")
+            return True
+
+    def probe_timeout(self) -> float:
+        """Seconds an in-flight probe may stay unresolved before it is
+        declared failed (bounded by the heal cadence, never sub-second
+        — the consume signal rides the receiver's normal materialize,
+        which is itself Deadline-bounded)."""
+        return max(2.0 * self.heal_interval, 1.0)
+
+    def probing(self, proc: int | None) -> bool:
+        if proc is None:
+            return False
+        with self._lock:
+            return int(proc) in self._probing
+
+    def probe_outcome(self, proc: int | None, success: bool,
+                      cause: str = "") -> None:
+        """Resolve an in-flight probe: a consumed probe window
+        promotes the pair back to healthy; a failed one re-arms the
+        heal interval from now."""
+        if proc is None:
+            return
+        p = int(proc)
+        with self._lock:
+            if p not in self._probing:
+                return
+            self._probing.discard(p)
+            self._probe_t.pop(p, None)
+            if success:
+                self._demoted.pop(p, None)
+                self._strikes.pop(p, None)
+                self.stats["plane_promotions"] += 1
+                self._record("promote", p, "")
+            else:
+                self._demoted[p] = time.monotonic()
+                self._record("probe_fail", p, cause)
+
+    def clear(self, proc: int | None) -> None:
+        """Forget a peer's health state (replace()/respawn installed a
+        reborn incarnation, or the mark was a false positive) — the
+        health marks clear alongside the failure marks."""
+        if proc is None:
+            return
+        p = int(proc)
+        with self._lock:
+            had = (p in self._demoted or p in self._strikes
+                   or p in self._probing)
+            self._strikes.pop(p, None)
+            self._demoted.pop(p, None)
+            self._probing.discard(p)
+            self._probe_t.pop(p, None)
+            if had:
+                self._record("clear", p, "")
 
 
 class DeviceWindow:
@@ -238,6 +482,10 @@ class DevicePlane:
         #: receiver-attached windows (closed on materialize)
         self._lock = threading.Lock()
         self._running = True
+        #: the per-(peer, plane) failover state machine — shares this
+        #: plane's stats block so transitions surface as dcn_plane_*
+        #: pvars through the same provider merge
+        self.health = PlaneHealth(plane="device", stats=self.stats)
         from ompi_tpu.metrics import core as _mcore
 
         _mcore.register_provider(self, self._stats_snapshot)
@@ -266,10 +514,22 @@ class DevicePlane:
 
     def arbitrate(self, payload, dst_root_proc: int | None = None) -> bool:
         """THE per-message plane decision: True routes the payload
-        onto the device plane.  Every decision is counted
-        (``device_arb_device`` / ``device_arb_host``)."""
+        onto the device plane.  Size/layout/reachability say the plane
+        *can* carry it; the health table says it currently *should* —
+        a demoted peer's traffic stays host-side except for the one
+        send the heal schedule routes through as a probe.  Every
+        decision is counted (``device_arb_device`` /
+        ``device_arb_host``)."""
         take = (self._running and self.eligible(payload)
                 and self.reachable(dst_root_proc))
+        if take and not self.health.ok(dst_root_proc):
+            # a consumed probe window may be waiting to promote the
+            # peer: reap HERE, because a demoted peer's traffic never
+            # reaches stage() (reap's usual caller) — promotion must
+            # not wait for a device-plane send that will never happen
+            self.reap()
+            take = (self.health.ok(dst_root_proc)
+                    or self.health.allow_probe(dst_root_proc))
         self.stats["device_arb_device" if take else
                    "device_arb_host"] += 1
         return take
@@ -291,17 +551,48 @@ class DevicePlane:
         wait (not frame order) is what orders the read after the DMA,
         exactly like the real send/recv DMA semaphore pair."""
         self.reap()
+        #: is THIS send the heal probe arbitration routed through a
+        #: demoted plane?  Its window is tagged so reap/reclaim can
+        #: resolve the probe (promotion on consume, re-arm on failure)
+        probe = dst_proc is not None and self.health.probing(dst_proc)
         if dst_proc is not None and dst_proc in self._failed:
             # the peer is already marked dead: an eligible send
             # degrades to the host plane (where the failure surfaces
             # through the normal escalation paths)
+            if probe:
+                self.health.probe_outcome(dst_proc, False, "peer_failed")
             self.stats["device_fallbacks"] += 1
             return None
+        trunc = False
+        if _fsim._enabled:
+            for act in _fsim.actions("device",
+                                     kinds={"drop", "delay", "trunc",
+                                            "stall"}):
+                if act.kind in ("delay", "stall"):
+                    _fsim.apply_delay(act)
+                elif act.kind == "drop":
+                    # simulated DMA failure: the stage aborts BEFORE a
+                    # descriptor exists, so the caller re-issues the
+                    # payload as an ordinary host-plane frame — that
+                    # frame gets its own per-peer seq and the dedup
+                    # watermark keeps delivery exactly-once.  The
+                    # strike is what the plane-health table feeds on.
+                    if probe:
+                        self.health.probe_outcome(
+                            dst_proc, False, "injected_drop")
+                    else:
+                        self.health.strike(dst_proc, "injected_drop")
+                    self.stats["device_fallbacks"] += 1
+                    return None
+                elif act.kind == "trunc":
+                    trunc = True  # short DMA length published below
         wid = next(self._wids)
         name = f"tpudev-{os.getpid()}-{wid}-{id(self) & 0xffff:x}"
         try:
             win = DeviceWindow(name, arr.nbytes, create=True)
         except OSError:
+            if probe:
+                self.health.probe_outcome(dst_proc, False, "open_failed")
             self.stats["device_fallbacks"] += 1
             return None
         from ompi_tpu.trace import causal as _causal
@@ -318,15 +609,23 @@ class DevicePlane:
         try:
             win.place(memoryview(arr).cast("B") if arr.nbytes
                       else memoryview(b""))
+            if trunc and arr.nbytes:
+                # injected short DMA: publish a length the descriptor
+                # does not promise — the receiver's materialize checks
+                # the placed length and escalates (MPITruncateError →
+                # ULFM), striking the plane on its side
+                win._ctr[1] = int(arr.nbytes) - 1
         except Exception:
             # a failed staging copy must not strand the window in no
             # table (leaked segment): retire it and degrade to the
             # host plane, like a window that failed to open
             win.close(unlink=True)
+            if probe:
+                self.health.probe_outcome(dst_proc, False, "place_failed")
             self.stats["device_fallbacks"] += 1
             return None
         with self._lock:
-            self._tx[wid] = (win, dst_proc, okey)
+            self._tx[wid] = (win, dst_proc, okey, probe)
         if dst_proc is not None and dst_proc in self._failed:
             # the failure mark landed while we were staging: the
             # reclaim scan ran before our publish and would never see
@@ -334,6 +633,19 @@ class DevicePlane:
             # like every other degrade, so arbitration outcomes stay
             # accounted: arb_device = sends + fallbacks)
             self.reclaim_failed(dst_proc)
+            self.stats["device_fallbacks"] += 1
+            return None
+        if not self._running:
+            # close() raced our publish: its drain/sweep may have run
+            # before this window landed in _tx and would never retire
+            # it — do it ourselves and degrade (the caller's payload
+            # still rides the host plane, nothing is lost)
+            with self._lock:
+                gone = self._tx.pop(wid, None)
+            if gone is not None:
+                win.close(unlink=True)
+            if probe:
+                self.health.probe_outcome(dst_proc, False, "closing")
             self.stats["device_fallbacks"] += 1
             return None
         desc = {
@@ -350,12 +662,19 @@ class DevicePlane:
         retired (close() sweeps the rest)."""
         done = []
         with self._lock:
-            for wid, (win, _dst, _k) in list(self._tx.items()):
+            for wid, (win, dst, _k, probe) in list(self._tx.items()):
                 if win.sem() >= SEM_CONSUMED:
-                    done.append(win)
+                    done.append((win, dst, probe))
                     del self._tx[wid]
-        for win in done:
+        for win, dst, probe in done:
             win.close(unlink=True)
+            # a consumed window is the plane working: a probe resolves
+            # to a promotion, a normal transfer resets the peer's
+            # consecutive-strike count
+            if probe:
+                self.health.probe_outcome(dst, True)
+            else:
+                self.health.success(dst)
         return len(done)
 
     def reclaim_failed(self, dst_proc: int) -> int:
@@ -372,28 +691,33 @@ class DevicePlane:
             # remember the mark: a stage() racing this scan re-checks
             # the set after its publish and retires its own window
             self._failed.add(int(dst_proc))
-            for wid, (win, dst, okey) in list(self._tx.items()):
+            for wid, (win, dst, okey, probe) in list(self._tx.items()):
                 if dst is not None and int(dst) == int(dst_proc):
-                    victims.append((win, okey))
+                    victims.append((win, okey, probe))
                     del self._tx[wid]
         if not victims:
             return 0
         from ompi_tpu.metrics import flight as _flight
 
-        for win, okey in victims:
+        for win, okey, probe in victims:
             self.stats["device_window_reclaimed"] += 1
             _flight.record("device_window_reclaimed",
                            proc=int(dst_proc), window=win.name,
                            **({"op": okey} if okey else {}))
             win.close(unlink=True)
+            if probe:  # a reclaimed probe window can never be consumed
+                self.health.probe_outcome(dst_proc, False, "peer_failed")
         return len(victims)
 
     def clear_failed(self, dst_proc: int) -> None:
         """Recover/heal: the peer is back (replace() installed a
         reborn incarnation, or the mark was a false positive) — new
-        device windows toward it are welcome again."""
+        device windows toward it are welcome again, and the health
+        marks clear alongside the failure mark (a reborn incarnation
+        must not inherit its predecessor's strikes or demotion)."""
         with self._lock:
             self._failed.discard(int(dst_proc))
+        self.health.clear(int(dst_proc))
 
     def pending_windows(self) -> int:
         with self._lock:
@@ -419,10 +743,28 @@ class DevicePlane:
     def _stats_snapshot(self) -> dict[str, int] | None:
         return dict(self.stats) if self._running else None
 
-    def close(self) -> None:
+    def close(self, drain_timeout: float = 2.0) -> None:
+        """Drain-then-close, the ``tdcn_close`` discipline: stop
+        arbitration first (no new windows stage), then give in-flight
+        staged windows a bounded window to be consumed — a receiver
+        mid-materialize holds live mappings into these segments, and
+        the old unconditional sweep could unlink them out from under
+        it.  Whatever the deadline leaves unconsumed is swept anyway
+        (close never hangs on a dead receiver)."""
         self._running = False
+        if drain_timeout > 0 and self.pending_windows():
+            from ompi_tpu.core.var import Deadline
+
+            dl = Deadline(float(drain_timeout))
+            sleep = 0.0
+            while self.pending_windows():
+                self.reap()
+                if not self.pending_windows() or dl.expired():
+                    break
+                time.sleep(sleep)
+                sleep = min(0.002, sleep + 0.0001)
         with self._lock:
-            wins = [w for w, _dst, _k in self._tx.values()]
+            wins = [w for w, _dst, _k, _p in self._tx.values()]
             self._tx.clear()
         for win in wins:
             win.close(unlink=True)
@@ -444,15 +786,46 @@ def try_stage(root_engine, payload, dst_root_proc):
 
 
 def materialize(root_engine, desc: dict,
-                into: np.ndarray | None = None):
+                into: np.ndarray | None = None,
+                src_root: int | None = None):
     """Receiver-side plane pick, shared by every delivery site (both
     engines' coll streams and the p2p path): materialize through the
     engine's plane when one is armed (counters tick), else the
     plane-less twin — a rank whose plane is disabled can still land a
-    misconfigured peer's descriptor frames."""
+    misconfigured peer's descriptor frames.
+
+    Failure semantics (the ULFM half): an expired semaphore wait or a
+    truncated DMA with ``src_root`` known strikes the plane-health
+    table for the sender, reclaims every window WE have staged toward
+    it (a peer whose device plane just failed us cannot be trusted to
+    consume ours — the PR-15 reclaim, extended to the expired-wait
+    path), and converges on the engine's ``_escalate_deadline``
+    (flight record, counters, detector mark, ``MPIProcFailedError``)
+    — never a bare RuntimeError, never an unbounded spin."""
+    from ompi_tpu.core.errors import (DeadlineExpiredError,
+                                      MPITruncateError)
+
     dp = getattr(root_engine, "_device_plane", None)
-    return (dp.receive(desc, into=into) if dp is not None
-            else receive(desc, into=into))
+    try:
+        return (dp.receive(desc, into=into) if dp is not None
+                else receive(desc, into=into))
+    except (DeadlineExpiredError, MPITruncateError) as e:
+        cause = ("trunc" if isinstance(e, MPITruncateError)
+                 else "deadline")
+        if dp is not None and src_root is not None:
+            dp.health.strike(int(src_root), cause)
+            dp.reclaim_failed(int(src_root))
+        esc = getattr(root_engine, "_escalate_deadline", None)
+        if esc is None or src_root is None:
+            raise  # plane-less / peer-less: typed error, caller owns it
+        from ompi_tpu.core.var import dcn_timeout
+
+        esc("device_recv", dcn_timeout("recv"),
+            f"device window materialize from proc {int(src_root)} "
+            f"failed ({cause}): {e}",
+            failed_rank=int(src_root), root_proc=int(src_root),
+            window=str(desc.get("w")), cause=cause)
+        raise  # unreachable: _escalate_deadline raises
 
 
 def receive(desc: dict, into: np.ndarray | None = None,
@@ -466,6 +839,13 @@ def receive(desc: dict, into: np.ndarray | None = None,
     name, nbytes = str(desc["w"]), int(desc["n"])
     dt = np.dtype(str(desc.get("dt", "u1")))
     shape = tuple(desc.get("sh") or (0,))
+    if _fsim._enabled:
+        # receiver-side latency injection: sleeping BEFORE the
+        # semaphore wait drives the Deadline toward expiry — the
+        # deterministic lever the failover units use to manufacture a
+        # receiver-side deadline strike
+        for act in _fsim.actions("device_recv", kinds={"delay", "stall"}):
+            _fsim.apply_delay(act)
     win = DeviceWindow(name, 0, create=False)
     try:
         if win.sem() < SEM_DATA:
@@ -477,6 +857,17 @@ def receive(desc: dict, into: np.ndarray | None = None,
                 stats["device_dma_waits"] += 1
                 stats["device_dma_wait_ns"] += (
                     time.perf_counter_ns() - t0)
+        placed = int(win._ctr[1])
+        if placed != nbytes:
+            # the DMA placed fewer bytes than the descriptor promised
+            # (sender fault or injected trunc): typed error, never a
+            # partial read — materialize() escalates it to ULFM and
+            # strikes the plane for the sender
+            from ompi_tpu.core.errors import MPITruncateError
+
+            raise MPITruncateError(
+                f"device window {name}: DMA placed {placed} bytes, "
+                f"descriptor promised {nbytes}")
         if (into is not None and isinstance(into, np.ndarray)
                 and into.flags["C_CONTIGUOUS"]
                 and into.dtype == dt

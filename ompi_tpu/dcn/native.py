@@ -521,7 +521,9 @@ class _NativeOpsMixin:
             from . import device as _device
 
             desc = meta.pop("dev")
-            payload = _device.materialize(root, desc, into=into)
+            payload = _device.materialize(
+                root, desc, into=into,
+                src_root=(fail_idx if fail_idx >= 0 else None))
         tc = None
         if isinstance(meta, dict):
             # "tc" is a reserved meta key like "dev": popped here

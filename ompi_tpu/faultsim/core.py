@@ -57,6 +57,16 @@ _enabled = False
 #:               serve/agent.py; the multi-host chaos harness's
 #:               deterministic agent-death lever).
 #:
+#: Device-plane sites (rules opt in with ``site=device`` /
+#: ``site=device_recv`` — the hooks in dcn/device.py): at ``device``
+#: (the window stage path) ``drop`` aborts the stage as a simulated
+#: DMA failure (the send degrades to the host plane and strikes the
+#: plane-health table), ``trunc`` publishes a short DMA length the
+#: receiver detects and escalates, ``delay``/``stall`` sleep ``ms``
+#: before the RTS publish; at ``device_recv`` (materialize)
+#: ``delay``/``stall`` sleep before the semaphore wait, driving the
+#: receiver's Deadline toward expiry.
+#:
 #: The tuple is grow-only: the ``faultsim_injected_<kind>`` MPI_T pvar
 #: namespace is derived from it in order.
 KINDS = ("drop", "delay", "dup", "trunc", "connkill", "stall",

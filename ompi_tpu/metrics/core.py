@@ -108,6 +108,14 @@ NATIVE_COUNTERS = (
     # receiver was marked failed between RTS and consume (the PR-14
     # leak edge, closed) — each reclaim is also flight-recorded
     "device_window_reclaimed",
+    # plane-health tail: the per-(peer, plane) failover state machine
+    # (dcn/device.py PlaneHealth) — peers demoted off a sick plane
+    # after dcn_plane_strikes consecutive failures, peers promoted
+    # back after a successful heal probe, and the probe sends routed
+    # through a demoted plane to test it.  Every transition is also
+    # flight-recorded; the C block keeps zeroed slots (schema truth
+    # stays TDCN_STAT_NAMES)
+    "plane_demotions", "plane_promotions", "plane_heal_probes",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

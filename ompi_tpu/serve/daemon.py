@@ -228,7 +228,15 @@ class TpuDaemon:
                 # BEFORE appending, so repeated SIGKILL→restart cycles
                 # stop growing it without bound
                 _state.Journal.compact(self.journal_path, recovered)
-            self._journal = _state.Journal(self.journal_path)
+            # rotation bounds (the crash-free twin of takeover
+            # compaction): a month-resident daemon's journal compacts
+            # in place once it crosses the size/age knobs
+            self._journal = _state.Journal(
+                self.journal_path,
+                max_bytes=int(self._agent_var(
+                    "journal_max_kb", 0)) * 1024,
+                max_age_s=float(self._agent_var(
+                    "journal_max_age_s", 0.0)))
         if recovered is not None:
             self._recover(recovered)
         elif spawn:
@@ -656,10 +664,12 @@ class TpuDaemon:
             return
         now = time.monotonic()
         timeout = self._agent_var("agent_timeout", 10.0)
+        hb_only = bool(self._agent_var("agent_hb_only", 0.0))
         with self._lock:
-            self._poll_agents_locked(now, timeout)
+            self._poll_agents_locked(now, timeout, hb_only)
 
-    def _poll_agents_locked(self, now: float, timeout: float) -> None:
+    def _poll_agents_locked(self, now: float, timeout: float,
+                            hb_only: bool = False) -> None:
         for hid, ag in self._agents.items():
             hb = self.server.peek(f"{_agent.K_AHB}{hid}")
             if hb and hb.get("session") == ag["session"]:
@@ -749,15 +759,19 @@ class TpuDaemon:
             # unreachable, hung boot) with the rsh transport still
             # connected must be declared dead too, not held forever
             silent = now - ag.get("hb_mono", now) > timeout
-            if ((rsh_dead or silent)
-                    and not self.shutting_down):
+            # hb-only mode (serve_agent_hb_only): a backgrounding
+            # agent template's rsh wrapper daemonizes and exits
+            # immediately, so its launch process dying is normal —
+            # liveness is judged by heartbeat staleness alone
+            dead = silent if hb_only else (rsh_dead or silent)
+            if dead and not self.shutting_down:
                 if ag["spawns"] > self.max_respawns + 1:
                     print(f"[tpud] agent h{hid} died; respawn budget "
                           "exhausted — host marked down", flush=True)
                     ag["status"] = "down"
                     continue
                 print(f"[tpud] agent h{hid} "
-                      f"{'exited' if rsh_dead else 'silent'}; "
+                      f"{'exited' if rsh_dead and not hb_only else 'silent'}; "
                       "respawning it (live workers will be "
                       "re-adopted)", flush=True)
                 pending = [ag["pending"][i]

@@ -187,10 +187,30 @@ class Journal:
     index space contiguous — workers consume indices strictly in
     order, so a hole below a still-lagging worker's cursor would
     wedge it; a ``noop`` is consumed and ignored.
+
+    A *long-lived* daemon that never crashes never takes over, so the
+    takeover-time compaction alone still grows the file without
+    bound.  **Rotation** closes that edge: with ``max_bytes``/
+    ``max_age_s`` armed (``serve_journal_max_kb`` /
+    ``serve_journal_max_age_s``), :meth:`append` checks the bounds
+    after writing and, when crossed, rewrites the journal in place as
+    one compacted snapshot (the same ``compact`` fixed point — a
+    ``compact`` marker line plus live state) and starts a fresh tail.
+    Replay is unchanged: it already reads snapshot + tail, because a
+    rotated journal is byte-for-byte what a takeover compaction
+    leaves.  Rotation is atomic (tmp+rename) and crash-safe — a
+    SIGKILL mid-rotation replays either the old file or the complete
+    snapshot, never a half of each.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = 0,
+                 max_age_s: float = 0.0):
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.max_age_s = float(max_age_s)
+        #: rotation counter (tests / ops introspection)
+        self.rotations = 0
+        self._birth = time.monotonic()
         # a SIGKILLed writer can leave a torn final line; terminate it
         # before appending, or the first post-takeover event glues to
         # the torn tail and BOTH lines are lost to replay
@@ -210,6 +230,34 @@ class Journal:
         self._f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())
+        if self._should_rotate():
+            self.rotate()
+
+    def _should_rotate(self) -> bool:
+        if self.max_bytes > 0:
+            try:
+                if self._f.tell() > self.max_bytes:
+                    return True
+            except (OSError, ValueError):
+                return False
+        if self.max_age_s > 0:
+            return time.monotonic() - self._birth > self.max_age_s
+        return False
+
+    def rotate(self) -> None:
+        """Compact-in-place: fold the current file through
+        :meth:`replay`, rewrite it as the :meth:`compact` snapshot
+        (atomic tmp+rename), and reopen a fresh append tail.  The
+        size/age clocks reset; the snapshot IS a valid journal, so a
+        crash at any point replays cleanly."""
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        Journal.compact(self.path, Journal.replay(self.path))
+        self._f = open(self.path, "a")
+        self._birth = time.monotonic()
+        self.rotations += 1
 
     def close(self) -> None:
         try:

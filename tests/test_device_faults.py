@@ -1,0 +1,413 @@
+"""Device-plane fault parity + plane-health failover (ISSUE 18).
+
+Covers the tentpole's three legs as units plus the np=2 acceptance:
+
+* **fault parity** — the ``site=device`` / ``site=device_recv`` plan
+  grammar, seeded decision determinism at the device sites, and the
+  gating-off contract (one module-bool test: a disabled fault plane
+  never even consults the plan);
+* **failure semantics** — injected DMA drop degrades to the host
+  plane and strikes the health table, injected trunc surfaces as a
+  typed ``MPITruncateError`` the materialize path escalates through
+  ULFM (flight record + reclaim + ``MPIProcFailedError``), and an
+  expired semaphore wait does the same — never a bare RuntimeError;
+* **plane health** — consecutive-strike demotion, arbitration
+  refusing a demoted peer, heal-probe promotion, probe staleness
+  resolution, and ``clear_failed`` wiping the marks alongside the
+  failure mark;
+* **lifecycle** — drain-then-close retires consumed windows before
+  the sweep and stays bounded on an unconsumed one;
+* **np=2 acceptance** — the ``tools/chaos.py --planes`` soak:
+  demotion mid-allreduce, bit-exact completion across the boundary,
+  deterministic golden transition log across runs.
+"""
+
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.errors import (
+    DeadlineExpiredError,
+    MPIProcFailedError,
+    MPITruncateError,
+)
+from ompi_tpu.dcn import device as dev
+from ompi_tpu.faultsim import core as fsim
+
+REPO = Path(__file__).resolve().parent.parent
+CHAOS = REPO / "tools" / "chaos.py"
+
+
+@pytest.fixture(autouse=True)
+def clean_faultsim():
+    fsim.reset()
+    yield
+    fsim.reset()
+
+
+def _plane(min_size=64, proc=0, strikes=3, heal=0.05):
+    dp = dev.DevicePlane(proc, min_size=min_size)
+    dp.health.max_strikes = strikes
+    dp.health.heal_interval = heal
+    return dp
+
+
+# -- plan grammar + determinism at the device sites --------------------
+
+
+def test_plan_grammar_device_sites():
+    rules = fsim.parse_plan(
+        "drop:site=device;n=6;proc=0,trunc:site=device;at=3,"
+        "delay:site=device_recv;ms=5;every=2,stall:site=device;ms=1")
+    assert [r.site for r in rules] == ["device", "device",
+                                      "device_recv", "device"]
+    assert rules[0].kind == "drop" and rules[0].n == 6
+    assert rules[0].proc == 0
+    assert rules[1].at == 3
+    assert rules[2].ms == 5.0 and rules[2].every == 2
+
+
+def test_device_site_decisions_deterministic():
+    """Same seed, same device-site decision stream; ``proc=``-targeted
+    rules never fire on other ranks — the soak's event-indexed plan
+    relies on both."""
+    rules = fsim.parse_plan("drop:site=device;p=0.4")
+    a = fsim.FaultPlan(rules, seed=11, proc=0)
+    b = fsim.FaultPlan(rules, seed=11, proc=0)
+    c = fsim.FaultPlan(rules, seed=12, proc=0)
+    sa = [bool(a.decide("device")) for _ in range(200)]
+    sb = [bool(b.decide("device")) for _ in range(200)]
+    sc = [bool(c.decide("device")) for _ in range(200)]
+    assert sa == sb and sa != sc
+    targeted = fsim.FaultPlan(
+        fsim.parse_plan("drop:site=device;n=6;proc=0"), seed=1, proc=1)
+    assert not any(targeted.decide("device") for _ in range(10))
+
+
+def test_gating_off_never_consults_the_plan(monkeypatch):
+    """The faultsim-off device path is the PR-14 path: hooks are one
+    module-bool test, so a disabled plane must complete a full
+    stage→receive→reap round-trip without ever calling
+    ``actions()``."""
+    assert not fsim.enabled()
+
+    def _boom(*a, **kw):  # pragma: no cover - the assertion IS no call
+        raise AssertionError("faultsim consulted while disabled")
+
+    monkeypatch.setattr(fsim, "actions", _boom)
+    dp = _plane()
+    arr = np.arange(64, dtype=np.float64)
+    desc = dp.stage(arr, dst_proc=1)
+    assert desc is not None
+    out = dev.receive(desc)
+    assert np.array_equal(out, arr)
+    assert dp.reap() == 1
+    assert sum(fsim.counters().values()) == 0
+    dp.close()
+
+
+# -- injected DMA failures: drop / trunc -------------------------------
+
+
+def test_injected_drop_degrades_strikes_and_demotes():
+    """Each ``drop:site=device`` aborts the stage before a descriptor
+    exists (host-plane degrade, ``device_fallbacks`` counted) and
+    strikes the health table; the third consecutive strike demotes
+    and arbitration refuses the peer."""
+    fsim.configure("drop:site=device;n=3", seed=7, proc=0)
+    dp = _plane()
+    arr = np.zeros(64, np.uint8)
+    for i in range(3):
+        assert dp.arbitrate(arr, 1)
+        assert dp.stage(arr, dst_proc=1) is None
+        assert dp.stats["device_fallbacks"] == i + 1
+    assert not dp.health.ok(1)
+    assert dp.stats["plane_demotions"] == 1
+    assert not dp.arbitrate(arr, 1)          # demoted: host plane
+    assert fsim.injected("drop") == 3
+    assert [t[0] for t in dp.health.transitions] == ["demote"]
+    assert dp.health.transitions[0][2] == "injected_drop"
+    dp.close()
+
+
+def test_injected_drop_is_consecutive_not_cumulative():
+    """A consumed window between strikes resets the count — one slow
+    wait (or sporadic injected drop) does not condemn the plane."""
+    fsim.configure("drop:site=device;every=2", seed=7, proc=0)
+    dp = _plane()
+    arr = np.arange(32, dtype=np.float64)
+    for _ in range(6):  # events alternate ok, drop, ok, drop ...
+        desc = dp.stage(arr, dst_proc=1)
+        if desc is not None:
+            dev.receive(desc)
+            dp.reap()
+    assert dp.health.ok(1), "alternating drops must never demote"
+    assert dp.stats["plane_demotions"] == 0
+    dp.close()
+
+
+def test_injected_trunc_raises_typed_truncate():
+    """``trunc:site=device`` publishes a short DMA length; the
+    receiver detects placed != promised and raises the typed
+    MPITruncateError (never a partial read)."""
+    fsim.configure("trunc:site=device;at=1", seed=7, proc=0)
+    dp = _plane()
+    arr = np.arange(64, dtype=np.float64)
+    desc = dp.stage(arr, dst_proc=1)
+    assert desc is not None            # trunc ships, unlike drop
+    with pytest.raises(MPITruncateError, match="placed"):
+        dev.receive(desc)
+    dp.close()
+
+
+# -- materialize escalation (the ULFM half) ----------------------------
+
+
+class _StubEngine:
+    """root-engine shape materialize() needs: a plane + the escalation
+    hook (recorded, then raising like the real one)."""
+
+    def __init__(self, dp):
+        self._device_plane = dp
+        self.escalations = []
+
+    def _escalate_deadline(self, site, timeout, msg, failed_rank=None,
+                           root_proc=None, **detail):
+        self.escalations.append((site, failed_rank, detail))
+        raise MPIProcFailedError(msg, failed=(failed_rank,))
+
+
+def test_materialize_trunc_escalates_strikes_and_reclaims():
+    """A truncated DMA converges on ``_escalate_deadline`` (typed
+    MPIProcFailedError), strikes the plane for the sender, and
+    reclaims every window staged toward it — the PR-15 reclaim
+    extended to the failed-materialize path."""
+    fsim.configure("trunc:site=device;at=1", seed=7, proc=0)
+    dp = _plane(strikes=1)
+    eng = _StubEngine(dp)
+    bad = dp.stage(np.arange(64, dtype=np.float64), dst_proc=1)
+    fsim.disable()
+    staged = dp.stage(np.arange(64, dtype=np.float64), dst_proc=1)
+    assert bad is not None and staged is not None
+    assert dp.pending_windows() == 2
+    with pytest.raises(MPIProcFailedError):
+        dev.materialize(eng, bad, src_root=1)
+    (site, failed_rank, detail) = eng.escalations[0]
+    assert site == "device_recv" and failed_rank == 1
+    assert detail["cause"] == "trunc"
+    assert not dp.health.ok(1)                   # strikes=1 → demoted
+    assert dp.pending_windows() == 0             # both reclaimed
+    assert dp.stats["device_window_reclaimed"] == 2
+    dp.close()
+
+
+def test_materialize_deadline_escalates(monkeypatch):
+    """An expired semaphore wait (descriptor outran a DMA that never
+    completes) escalates the same way — Deadline-bounded, typed, with
+    the plane struck for the sender."""
+    from ompi_tpu.core import mca
+    from ompi_tpu.core.registry import MCAContext
+
+    ctx = MCAContext(cmdline={"dcn_recv_timeout": "0.1"})
+    monkeypatch.setattr(mca, "default_context", lambda: ctx)
+    dp = _plane(strikes=1)
+    eng = _StubEngine(dp)
+    win = dev.DeviceWindow("tpudev-test-dlmat", 64, create=True)
+    try:
+        desc = {"w": win.name, "n": 64, "dt": "<f8", "sh": [8]}
+        with pytest.raises(MPIProcFailedError):
+            dev.materialize(eng, desc, src_root=1)
+        assert eng.escalations[0][2]["cause"] == "deadline"
+        assert not dp.health.ok(1)
+    finally:
+        win.close(unlink=True)
+        dp.close()
+
+
+def test_materialize_without_engine_hook_raises_typed(monkeypatch):
+    """Plane-less / peer-less delivery still fails TYPED: no engine
+    escalation hook means the DeadlineExpiredError propagates as
+    itself, never a bare RuntimeError or a hang."""
+    from ompi_tpu.core import mca
+    from ompi_tpu.core.registry import MCAContext
+
+    ctx = MCAContext(cmdline={"dcn_recv_timeout": "0.1"})
+    monkeypatch.setattr(mca, "default_context", lambda: ctx)
+    win = dev.DeviceWindow("tpudev-test-dlbare", 64, create=True)
+    try:
+        desc = {"w": win.name, "n": 64, "dt": "<f8", "sh": [8]}
+        with pytest.raises(DeadlineExpiredError):
+            dev.materialize(object(), desc, src_root=None)
+    finally:
+        win.close(unlink=True)
+
+
+def test_device_recv_site_delays_before_the_wait(monkeypatch):
+    """``site=device_recv`` injects latency BEFORE the semaphore wait:
+    with a delay longer than the deadline the receive expires — the
+    deterministic lever for manufacturing receiver-side strikes."""
+    from ompi_tpu.core import mca
+    from ompi_tpu.core.registry import MCAContext
+
+    ctx = MCAContext(cmdline={"dcn_recv_timeout": "0.05"})
+    monkeypatch.setattr(mca, "default_context", lambda: ctx)
+    dp = _plane()
+    desc = dp.stage(np.arange(64, dtype=np.float64), dst_proc=1)
+    fsim.configure("delay:site=device_recv;ms=80", seed=7, proc=0)
+    t0 = time.monotonic()
+    out = dev.receive(desc)             # data already placed: no wait
+    assert time.monotonic() - t0 >= 0.08, "injected delay skipped"
+    assert out.shape == (64,)
+    assert fsim.injected("delay") == 1
+    dp.reap()
+    dp.close()
+
+
+# -- plane-health machine edges ----------------------------------------
+
+
+def test_probe_staleness_resolves_and_rearms():
+    """A probe window that is never consumed must not wedge the peer
+    demoted-forever: past ``probe_timeout()`` the next heal check
+    resolves it failed and re-arms the interval."""
+    h = dev.PlaneHealth(plane="device", strikes=1, heal_interval=0.02)
+    h.strike(1, "x")
+    time.sleep(0.03)
+    assert h.allow_probe(1)
+    h._probe_t[1] -= h.probe_timeout() + 0.01      # age it stale
+    assert not h.allow_probe(1)
+    assert not h.probing(1)
+    assert h.transitions[-1] == ("probe_fail", 1, "probe_timeout")
+    time.sleep(0.03)
+    assert h.allow_probe(1)                        # re-armed
+    assert h.stats["plane_heal_probes"] == 2
+
+
+def test_heal_interval_zero_disables_probes():
+    h = dev.PlaneHealth(plane="device", strikes=1, heal_interval=0.0)
+    h.strike(1, "x")
+    time.sleep(0.01)
+    assert not h.allow_probe(1)
+    assert not h.ok(1), "demotion sticks until clear()"
+
+
+def test_plane_tuning_mca_override(monkeypatch):
+    """``--mca dcn_plane_strikes/dcn_plane_heal_interval`` reach the
+    health table through the central ROBUSTNESS_VARS registration."""
+    from ompi_tpu.core import mca
+    from ompi_tpu.core.registry import MCAContext
+
+    assert dev.plane_tuning() == (3, 5.0)          # registered defaults
+    ctx = MCAContext(cmdline={"dcn_plane_strikes": "2",
+                              "dcn_plane_heal_interval": "0.5"})
+    monkeypatch.setattr(mca, "default_context", lambda: ctx)
+    assert dev.plane_tuning() == (2, 0.5)
+    h = dev.PlaneHealth()
+    assert h.max_strikes == 2 and h.heal_interval == 0.5
+
+
+def test_clear_failed_clears_health_marks():
+    """replace()/respawn heal: a reborn incarnation must not inherit
+    its predecessor's strikes or demotion."""
+    dp = _plane(strikes=1)
+    dp.health.strike(1, "deadline")
+    dp.reclaim_failed(1)
+    assert not dp.health.ok(1)
+    dp.clear_failed(1)
+    assert dp.health.ok(1)
+    assert 1 not in dp._failed
+    assert dp.health.transitions[-1][0] == "clear"
+    assert dp.arbitrate(np.zeros(64, np.uint8), 1)
+    dp.close()
+
+
+def test_probe_window_reclaim_resolves_probe_failed():
+    """A peer-failure mark landing while the heal probe is in flight
+    resolves the probe failed (its window can never be consumed) and
+    the reclaim counts it like any staged window."""
+    dp = _plane(strikes=1, heal=0.01)
+    dp.health.strike(1, "deadline")
+    time.sleep(0.02)
+    arr = np.zeros(64, np.uint8)
+    assert dp.arbitrate(arr, 1)                    # the probe send
+    assert dp.stage(arr, dst_proc=1) is not None
+    assert dp.health.probing(1)
+    assert dp.reclaim_failed(1) == 1
+    assert not dp.health.probing(1)
+    assert not dp.health.ok(1)                     # still demoted
+    assert dp.health.transitions[-1] == ("probe_fail", 1, "peer_failed")
+    dp.close()
+
+
+# -- drain-then-close --------------------------------------------------
+
+
+def test_close_drains_consumed_windows_before_sweep():
+    """A receiver mid-materialize holds live mappings: close() gives
+    in-flight windows a bounded drain so the consumed signal retires
+    them instead of the sweep unlinking them mid-read."""
+    dp = _plane()
+    arr = np.arange(512, dtype=np.float64)
+    desc = dp.stage(arr, dst_proc=1)
+    got = {}
+
+    def _consumer():
+        time.sleep(0.05)                 # close() arrives first
+        got["out"] = dev.receive(desc)
+
+    t = threading.Thread(target=_consumer)
+    t.start()
+    dp.close(drain_timeout=2.0)          # must wait for the consume
+    t.join(timeout=5)
+    assert np.array_equal(got["out"], arr)
+    assert dp.pending_windows() == 0
+    # the drain retired it as consumed (reap), not via the force sweep
+    assert dp.stats["device_recvs"] == 0  # receiver used module twin
+
+
+def test_close_bounded_on_unconsumed_window():
+    """No receiver ever consumes: the drain gives up at its deadline
+    and the sweep retires the window — close never hangs."""
+    dp = _plane()
+    dp.stage(np.zeros(4096, np.uint8), dst_proc=1)
+    t0 = time.monotonic()
+    dp.close(drain_timeout=0.1)
+    assert time.monotonic() - t0 < 1.5
+    assert dp.pending_windows() == 0
+
+
+def test_stage_after_close_degrades():
+    """The close()/stage() race: a stage that publishes after close's
+    sweep retires its own window and degrades to the host plane."""
+    dp = _plane()
+    dp.close(drain_timeout=0)
+    assert dp.stage(np.zeros(64, np.uint8), dst_proc=1) is None
+    assert dp.stats["device_fallbacks"] == 1
+    assert dp.pending_windows() == 0
+
+
+# -- np=2 acceptance: the --planes soak --------------------------------
+
+
+def test_tpurun_np2_planes_soak_deterministic():
+    """The acceptance drill: rank 0's device plane is killed
+    mid-allreduce (six event-indexed injected DMA failures) under
+    tpurun --ft.  Asserts (a) full bit-exact completion on both ranks
+    — a demotion re-routes, it never loses or corrupts work; (b) the
+    golden demote → (probe, probe_fail) x3 → probe → promote
+    transition log; (c) the same seed reproduces the structural tally
+    exactly (the tool runs twice and diffs); (d) bounded dedup_drops
+    (re-routed frames are new sends, not replays)."""
+    res = subprocess.run(
+        [sys.executable, str(CHAOS), "--planes", "--runs", "2",
+         "--ops", "50", "--timeout", "240"],
+        capture_output=True, timeout=540)
+    out = res.stdout.decode()
+    assert res.returncode == 0, out + res.stderr.decode()
+    assert "planes tally reproduces run 1 exactly" in out, out
+    assert "demote probe probe_fail" in out, out

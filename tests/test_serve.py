@@ -23,6 +23,7 @@ import subprocess
 import sys
 import threading
 import time
+import types
 import urllib.request
 from pathlib import Path
 
@@ -1102,3 +1103,154 @@ def test_tpud_2x2_emulated_hosts_restart_adoption_and_hostkill(tmp_path):
                     os.kill(p, 9)
                 except OSError:
                     pass
+
+
+# -- journal rotation + hb-only agent liveness (ISSUE 18) ---------------
+
+
+def test_journal_rotation_size_bound(tmp_path):
+    """A long-lived daemon that never crashes never takes over, so
+    takeover-time compaction alone still grows the file without
+    bound — the size bound rotates in place: the journal folds to a
+    compacted snapshot (a ``compact`` marker + live state) and replay
+    after rotation reconstructs exactly what an unrotated journal
+    would."""
+    from ompi_tpu.serve.state import Journal
+
+    path = str(tmp_path / "tpud.journal")
+    j = Journal(path, max_bytes=4096)
+    j.append("submit", job={"id": "j1", "tenant": "a",
+                            "state": "queued", "submit_ns": 1})
+    j.append("publish", d={"idx": 0, "kind": "job", "id": "j1",
+                           "procs": [0], "cid_base": 1 << 20,
+                           "cid_span": 4096})
+    j.append("submit", job={"id": "j2", "tenant": "a",
+                            "state": "queued", "submit_ns": 2})
+    # churn well past the byte bound: respawn cycles dominate real
+    # long-lived journals, and only the LAST spawn per rank is live
+    for inc in range(200):
+        j.append("spawn", rank=0, pid=1000 + inc, incarnation=inc)
+    assert j.rotations >= 1
+    assert os.path.getsize(path) < 8192, "rotation did not bound size"
+    with open(path) as f:
+        first = json.loads(f.readline())
+    assert first["ev"] == "compact"  # snapshot head, then the tail
+    st = Journal.replay(path)
+    # the compact fixed point: queued jobs keep their records, the
+    # in-flight directive survives outstanding (its record rides the
+    # directive itself, as on takeover), cursor/CID floors hold, and
+    # only the LAST spawn per rank remains
+    assert [q["id"] for q in st["queued"]] == ["j2"]
+    assert list(st["outstanding"]) == [0]
+    assert st["outstanding"][0]["id"] == "j1"
+    assert st["cursor"] == 1
+    assert st["cid_next"] == (1 << 20) + 4096
+    assert st["pids"][0] == {"pid": 1199, "incarnation": 199}
+    # the tail keeps appending normally after rotation
+    j.append("finish", idx=0, kind="job",
+             job={"id": "j1", "state": "done"})
+    j.close()
+    st = Journal.replay(path)
+    assert not st["outstanding"]
+    assert {d["id"] for d in st["done"]} == {"j1"}
+
+
+def test_journal_rotation_age_bound(tmp_path):
+    from ompi_tpu.serve.state import Journal
+
+    path = str(tmp_path / "tpud.journal")
+    j = Journal(path, max_age_s=0.05)
+    j.append("submit", job={"id": "j1", "tenant": "a",
+                            "state": "queued", "submit_ns": 1})
+    assert j.rotations == 0
+    time.sleep(0.06)
+    j.append("submit", job={"id": "j2", "tenant": "a",
+                            "state": "queued", "submit_ns": 2})
+    assert j.rotations == 1
+    j.close()
+    st = Journal.replay(path)
+    assert [q["id"] for q in st["queued"]] == ["j1", "j2"]
+
+
+def test_journal_rotation_knobs_reach_daemon(tmp_path):
+    """``serve_journal_max_kb`` / ``serve_journal_max_age_s`` wire the
+    bounds into the daemon's Journal through the central SERVING_VARS
+    registration (0 = unbounded, the default)."""
+    from ompi_tpu.serve.daemon import TpuDaemon
+
+    mca = {"serve_pidfile": str(tmp_path / "tpud.pid"),
+           "serve_journal_max_kb": "64",
+           "serve_journal_max_age_s": "30"}
+    d = TpuDaemon(1, mca=mca, spawn=False)
+    try:
+        assert d._journal.max_bytes == 64 * 1024
+        assert d._journal.max_age_s == 30.0
+    finally:
+        d.close()
+
+
+class _FakeAgentProc:
+    def __init__(self, rc):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+
+class _HbOnlyStub:
+    """The _poll_agents_locked surface: one active agent whose rsh
+    launch process has exited."""
+
+    def __init__(self, hb_age: float, now: float):
+        self.shutting_down = False
+        self.max_respawns = 3
+        self.booted = []
+        self._agents = {0: {
+            "status": "active", "session": "s0", "cursor": 0,
+            "proc": _FakeAgentProc(0),       # launch process exited
+            "hb": None, "hb_mono": now - hb_age,
+            "spawns": 1, "pending": {}, "worker_pids": {},
+        }}
+        self.server = types.SimpleNamespace(peek=lambda key: None)
+
+    def _boot_agent(self, hid, adopt=None):
+        self.booted.append(hid)
+
+    def _agent_cmd(self, hid, cmd):
+        pass
+
+
+def test_agent_hb_only_liveness(capsys):
+    """``serve_agent_hb_only``: a backgrounding agent template's rsh
+    wrapper daemonizes and exits immediately, so its launch process
+    dying is normal — liveness is judged by heartbeat staleness
+    alone.  Default mode still treats the rsh exit as agent death."""
+    from ompi_tpu.serve.daemon import TpuDaemon
+
+    now = time.monotonic()
+    # default: rsh exit → respawn even with fresh heartbeats
+    stub = _HbOnlyStub(hb_age=0.0, now=now)
+    TpuDaemon._poll_agents_locked(stub, now, timeout=10.0,
+                                  hb_only=False)
+    assert stub.booted == [0]
+    assert "exited" in capsys.readouterr().out
+    # hb-only: same rsh exit, fresh heartbeat → agent stays adopted
+    stub = _HbOnlyStub(hb_age=0.0, now=now)
+    TpuDaemon._poll_agents_locked(stub, now, timeout=10.0,
+                                  hb_only=True)
+    assert stub.booted == []
+    # hb-only: silence past the timeout is still death
+    stub = _HbOnlyStub(hb_age=99.0, now=now)
+    TpuDaemon._poll_agents_locked(stub, now, timeout=10.0,
+                                  hb_only=True)
+    assert stub.booted == [0]
+    assert "silent" in capsys.readouterr().out
+
+
+def test_agent_hb_only_var_registered():
+    from ompi_tpu.core.var import SERVING_VARS, full_var_name
+
+    names = {full_var_name(fw, c, n)
+             for fw, c, n, _d, _t, _h in SERVING_VARS}
+    assert {"serve_agent_hb_only", "serve_journal_max_kb",
+            "serve_journal_max_age_s"} <= names

@@ -117,6 +117,10 @@ _DEVICE_TAIL = (
     "device_window_reclaimed",
 )
 
+#: plane-health tail: the per-(peer, plane) failover state machine's
+#: demote/promote/heal-probe counts (dcn/device.py PlaneHealth)
+_PLANE_TAIL = ("plane_demotions", "plane_promotions", "plane_heal_probes")
+
 
 def test_stats_tail_appended_not_reordered():
     native = _native()
@@ -135,7 +139,9 @@ def test_stats_tail_appended_not_reordered():
     assert tuple(names[n1:n1 + len(_DISPATCH_TAIL)]) == _DISPATCH_TAIL
     n2 = n1 + len(_DISPATCH_TAIL)
     assert tuple(names[n2:n2 + len(_MODEX_TAIL)]) == _MODEX_TAIL
-    assert tuple(names[n2 + len(_MODEX_TAIL):]) == _DEVICE_TAIL
+    n3 = n2 + len(_MODEX_TAIL)
+    assert tuple(names[n3:n3 + len(_DEVICE_TAIL)]) == _DEVICE_TAIL
+    assert tuple(names[n3 + len(_DEVICE_TAIL):]) == _PLANE_TAIL
     assert mcore.NATIVE_STATS_VERSION == 1
     # gauges classified so monotonicity checks skip them
     assert {"stream_depth", "stream_inflight"} <= set(mcore.GAUGES)

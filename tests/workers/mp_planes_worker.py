@@ -1,0 +1,120 @@
+"""tpurun --ft plane-failover soak worker: allreduce under an
+event-indexed device-plane fault plan (launched by
+``tools/chaos.py --planes``).
+
+The driver arms ``drop:site=device;n=6;proc=0`` with a small
+``dcn_device_min_size`` and a short ``dcn_plane_heal_interval``, so on
+rank 0 the first six device-window stage attempts abort as simulated
+DMA failures.  With the default ``dcn_plane_strikes`` of 3 the plane's
+trajectory is fixed IN EVENT SPACE (the fault schedule indexes stage
+events, not wall clock):
+
+* stage events 1-3 drop → three consecutive strikes → (peer 1, device)
+  demoted mid-job; traffic re-routes to the host btl, where each
+  payload gets its own per-peer seq — the dedup watermark keeps
+  delivery exactly-once with no replay protocol;
+* heal probes are the ONLY stage events while demoted: events 4-6 drop
+  → three ``probe``/``probe_fail`` rounds re-arm the interval;
+* event 7 stages clean, the receiver consumes it, and the next
+  arbitration's reap promotes the pair back to healthy — the remaining
+  ops ride the device plane again.
+
+So rank 0's transition log is deterministically ``demote, (probe,
+probe_fail) x3, probe, promote`` regardless of scheduling jitter, and
+every op's MPI_SUM must be bit-exact against the locally computed
+golden (integer-derived halves, exact in IEEE double — the devsum.c
+formula) on BOTH sides of the demotion boundary.
+
+One ``PLANES_TALLY <json>`` line per rank carries completion, the
+injected-fault counts, the plane-health counters, the transition log,
+and the host-plane dedup count for the driver's assertions.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import ompi_tpu.api as api
+from ompi_tpu import faultsim
+from ompi_tpu.core.errors import (
+    MPIProcFailedError,
+    MPIProcFailedPendingError,
+    MPIRevokedError,
+)
+from ompi_tpu.op import SUM
+
+OPS = int(os.environ.get("PLANES_OPS", "70"))
+#: doubles per op — must clear the driver's lowered
+#: ``dcn_device_min_size`` so every allreduce is device-eligible
+COUNT = int(os.environ.get("PLANES_COUNT", "1024"))
+#: inter-op pacing: the heal interval is wall-clock, so ops must keep
+#: arriving while the plane is demoted for probes to be attempted
+SLEEP = float(os.environ.get("PLANES_SLEEP", "0.012"))
+
+world = api.init()
+p, n = world.proc, world.size
+assert n == 2, f"planes soak is an np=2 drill (got np={n})"
+assert faultsim.enabled(), "faultsim_enable did not propagate"
+assert world.local_size == 1, world.local_size
+
+dp = world.dcn._root_engine()._device_plane
+assert dp is not None, "device plane is not armed (dcn_device_enable?)"
+
+idx = np.arange(COUNT, dtype=np.int64)
+
+
+def rank_vec(op: int, proc: int) -> np.ndarray:
+    # devsum.c's shape: integer-derived halves, exact in IEEE double —
+    # so the expected MPI_SUM is computable locally and the comparison
+    # across the demotion boundary is bit-exact, not approximate
+    return (((idx * 2654435761 + 7919 * (proc + 1) + 104729 * (op + 1))
+             % 1000003).astype(np.float64) * 0.5)
+
+
+escalated = ""
+completed = 0
+try:
+    for i in range(OPS):
+        out = np.asarray(world.allreduce(rank_vec(i, p)[None], SUM))[0]
+        want = rank_vec(i, 0) + rank_vec(i, 1)
+        assert np.array_equal(out, want), (
+            f"op {i}: MPI_SUM not bit-exact across plane failover")
+        completed = i + 1
+        time.sleep(SLEEP)
+except (MPIProcFailedError, MPIProcFailedPendingError,
+        MPIRevokedError) as e:
+    escalated = type(e).__name__
+    print(f"[planes] proc {p} escalated after {completed} ops: {e}",
+          file=sys.stderr, flush=True)
+
+st = getattr(getattr(world.dcn, "transport", None), "stats", None) or {}
+plane = {k: int(dp.stats.get(k, 0)) for k in (
+    "device_sends", "device_fallbacks", "device_window_reclaimed",
+    "plane_demotions", "plane_promotions", "plane_heal_probes")}
+tally = {
+    "proc": p,
+    "completed": completed,
+    "ops": OPS,
+    "escalated": escalated,
+    "injected": faultsim.counters(),
+    "plane": plane,
+    "healthy": bool(dp.health.ok(1 - p)),
+    "transitions": [list(t) for t in dp.health.transitions],
+    "dedup_drops": int(st.get("dedup_drops", 0)),
+}
+print("PLANES_TALLY " + json.dumps(tally, sort_keys=True), flush=True)
+
+if escalated:
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+api.finalize()
+print(f"OK planes proc={p}", flush=True)

@@ -29,6 +29,13 @@ Usage::
     # (site daemon_repair) — the restart must finish the heal
     python tools/chaos.py --kill-in-repair
 
+    # plane-failover soak: kill rank 0's device plane mid-allreduce
+    # (six event-indexed injected DMA failures) — the job must finish
+    # bit-exact with the golden demote/probe/promote transition log,
+    # heal-probe re-promotion, and bounded dedup_drops; --runs 2
+    # verifies the trajectory reproduces exactly
+    python tools/chaos.py --planes --runs 2
+
     # self-check (no subprocesses): plan parsing, decision
     # determinism, transport self-healing, disabled-path state,
     # hierarchical topology/takeover, versioned gossip, get_prefix +
@@ -66,6 +73,20 @@ DEFAULT_PLAN = "delay:ms=2;p=0.3,dup:p=0.15,connkill:at=9,drop:p=0.05"
 #: worker's deterministic self-kill, and a loss-free plan keeps the
 #: injected-event schedule identical across runs (the determinism diff)
 DEFAULT_RESPAWN_PLAN = "delay:ms=1;p=0.25"
+
+PLANES_WORKER = os.path.join(REPO, "tests", "workers",
+                             "mp_planes_worker.py")
+#: --planes soak default: rank 0's first six device-window stage
+#: attempts abort as simulated DMA failures — event-indexed (``n=6``),
+#: so the plane-health trajectory is identical across runs and seeds
+DEFAULT_PLANES_PLAN = "drop:site=device;n=6;proc=0"
+#: the deterministic transition log the --planes soak AND the selftest
+#: golden fixture assert: 3 consecutive strikes demote, the only stage
+#: events while demoted are heal probes (events 4-6 still drop), the
+#: 4th probe stages clean and its consumption promotes
+GOLDEN_PLANE_TRANSITIONS = (
+    "demote", "probe", "probe_fail", "probe", "probe_fail",
+    "probe", "probe_fail", "probe", "promote")
 
 
 def run_soak(np_: int, seed: int, plan: str, ops: int, out: str | None,
@@ -143,6 +164,119 @@ def render(tallies: list[dict]) -> None:
     print(f"totals: injected={injected} survived={survived} "
           f"escalated={escalated} "
           f"dedup_drops={sum(t.get('dedup_drops', 0) for t in tallies)}")
+
+
+def run_planes_soak(np_: int, seed: int, plan: str, ops: int,
+                    extra_mca: list[str], timeout: float) -> list[dict]:
+    """One np=2 plane-failover soak: kill rank 0's device plane
+    mid-allreduce (six event-indexed injected DMA failures), assert
+    the demotion → heal-probe → promotion trajectory ran exactly the
+    golden transition sequence, every op completed bit-exact on both
+    sides of the demotion boundary, and the host-plane dedup watermark
+    absorbed the re-routed traffic without duplicate delivery."""
+    mca = {
+        "btl": "tcp",
+        "faultsim_enable": "1",
+        "faultsim_seed": str(seed),
+        "faultsim_plan": plan,
+        # a small threshold makes every soak allreduce device-eligible
+        # (including post-split chunks), so the fault plan's stage
+        # events line up with the op stream
+        "dcn_device_enable": "1",
+        "dcn_device_min_size": "2048",
+        # short heal cadence: demotion, three failed probes, and the
+        # promoting fourth all fit inside the op stream
+        "dcn_plane_heal_interval": "0.1",
+        "dcn_recv_timeout": "8",
+        "dcn_cts_timeout": "8",
+        "dcn_connect_timeout": "4",
+    }
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        mca[k] = v
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", str(np_),
+           "--ft", "--cpu-devices", "1"]
+    for k, v in mca.items():
+        cmd += ["--mca", k, v]
+    cmd.append(PLANES_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env["PLANES_OPS"] = str(ops)
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    res = subprocess.run(cmd, capture_output=True, timeout=timeout,
+                         env=env, cwd=REPO)
+    out_text = res.stdout.decode(errors="replace")
+    if res.returncode != 0:
+        sys.stderr.write(out_text)
+        sys.stderr.write(res.stderr.decode(errors="replace"))
+        raise SystemExit(f"planes soak failed (rc={res.returncode})")
+    tallies = []
+    for line in out_text.splitlines():
+        marker = "PLANES_TALLY "
+        if marker in line:
+            tallies.append(json.loads(line.split(marker, 1)[1]))
+    if len(tallies) != np_:
+        sys.stderr.write(out_text)
+        raise SystemExit(
+            f"expected {np_} PLANES_TALLY lines, got {len(tallies)}")
+    tallies.sort(key=lambda t: t["proc"])
+    # the contract: full completion on BOTH ranks (a demotion re-routes,
+    # it never loses work), the golden trajectory on the faulted rank,
+    # a quiet plane on the bystander, and a bounded dedup count (the
+    # re-routed frames are new sends with their own seqs, not replays)
+    bad = [t for t in tallies
+           if t["escalated"] or t["completed"] != t["ops"]]
+    if bad:
+        raise SystemExit(f"planes soak: incomplete ranks: {bad}")
+    t0w = tallies[0]
+    events = [tr[0] for tr in t0w["transitions"]]
+    if events != list(GOLDEN_PLANE_TRANSITIONS):
+        raise SystemExit(
+            f"planes soak: rank 0 transitions {events} != golden "
+            f"{list(GOLDEN_PLANE_TRANSITIONS)}")
+    pl = t0w["plane"]
+    if not (pl["plane_demotions"] >= 1 and pl["plane_promotions"] >= 1
+            and pl["plane_heal_probes"] >= pl["plane_promotions"]):
+        raise SystemExit(f"planes soak: rank 0 plane counters: {pl}")
+    if not t0w["healthy"]:
+        raise SystemExit(
+            "planes soak: rank 0 did not finish re-promoted (healthy)")
+    for t in tallies[1:]:
+        if t["plane"]["plane_demotions"] or t["transitions"]:
+            raise SystemExit(
+                f"planes soak: bystander rank {t['proc']} has "
+                f"plane-health churn: {t}")
+    if sum(t["dedup_drops"] for t in tallies) > 2:
+        raise SystemExit(
+            f"planes soak: dedup_drops not bounded: {tallies}")
+    print(f"planes soak: np={np_} seed={seed} ops={ops} "
+          f"wall={time.time() - t0:.1f}s plan={plan!r}")
+    return tallies
+
+
+def render_planes(tallies: list[dict]) -> None:
+    print(f"{'rank':<6}{'outcome':<12}{'ops':>7}{'drops':>7}"
+          f"{'demote':>8}{'probe':>7}{'promote':>9}{'dedup':>7}"
+          "  transitions")
+    for t in tallies:
+        pl = t["plane"]
+        ev = [tr[0] for tr in t["transitions"]]
+        print(f"{t['proc']:<6}{t['escalated'] or 'survived':<12}"
+              f"{t['completed']:>3}/{t['ops']:<3}"
+              f"{t['injected'].get('drop', 0):>7}"
+              f"{pl['plane_demotions']:>8}{pl['plane_heal_probes']:>7}"
+              f"{pl['plane_promotions']:>9}{t['dedup_drops']:>7}"
+              f"  {' '.join(ev) if ev else '-'}")
+    print(f"totals: demotions="
+          f"{sum(t['plane']['plane_demotions'] for t in tallies)} "
+          f"promotions="
+          f"{sum(t['plane']['plane_promotions'] for t in tallies)} "
+          f"device_sends="
+          f"{sum(t['plane']['device_sends'] for t in tallies)} "
+          f"fallbacks="
+          f"{sum(t['plane']['device_fallbacks'] for t in tallies)} "
+          f"dedup_drops={sum(t['dedup_drops'] for t in tallies)}")
 
 
 def join_outputs(out: str) -> None:
@@ -1428,6 +1562,38 @@ def selftest() -> int:
             rel2.close()
         agg2.close()
 
+    # 12. plane-health golden fixture: drive the PlaneHealth machine
+    # through the exact schedule the --planes soak injects (3 strikes,
+    # 3 failed probes, a promoting 4th) and hold its transition log to
+    # the golden sequence — the in-process twin of the np=2 soak's
+    # determinism contract.  The device-site grammar rides along.
+    rules = fsim.parse_plan(DEFAULT_PLANES_PLAN)
+    assert rules[0].kind == "drop" and rules[0].site == "device", rules
+    assert rules[0].n == 6 and rules[0].proc == 0, rules
+    pd = fsim.FaultPlan(rules, seed=3, proc=0)
+    hits = [bool(pd.decide("device", kinds={"drop"})) for _ in range(8)]
+    assert hits == [True] * 6 + [False] * 2, hits
+    assert not fsim.FaultPlan(rules, seed=3, proc=1).decide(
+        "device", kinds={"drop"}), "proc=0 rule fired on rank 1"
+    from ompi_tpu.dcn.device import PlaneHealth
+
+    ph = PlaneHealth(plane="device", strikes=3, heal_interval=0.005)
+    for _ in range(3):                       # stage events 1-3 drop
+        ph.strike(1, "injected_drop")
+    assert not ph.ok(1)
+    for _ in range(3):                       # probe events 4-6 drop
+        time.sleep(0.006)
+        assert ph.allow_probe(1)
+        ph.probe_outcome(1, False, "injected_drop")
+    time.sleep(0.006)
+    assert ph.allow_probe(1)                 # event 7 stages clean
+    ph.probe_outcome(1, True)                # consumed → promotion
+    assert ph.ok(1)
+    events = [t[0] for t in ph.transitions]
+    assert events == list(GOLDEN_PLANE_TRANSITIONS), events
+    assert ph.stats == {"plane_demotions": 1, "plane_promotions": 1,
+                        "plane_heal_probes": 4}, ph.stats
+
     print("selftest OK: plan grammar, seeded determinism (400-event "
           "streams), reconnect healing (8/8 delivered, "
           f"{tx.stats['reconnects']} reconnect), exactly-once dedup "
@@ -1437,7 +1603,9 @@ def selftest() -> int:
           "get_prefix + lazy AddressTable, relay batching, agent "
           "protocol (adopt parse, agentkill schedule, zombie rule, "
           "stale-incarnation guard), relay failover (member re-dialed "
-          f"the successor's relay after {pub.refreshes} refresh)")
+          f"the successor's relay after {pub.refreshes} refresh), "
+          "plane-health golden fixture (demote → 3 failed probes → "
+          "promote transition log)")
     return 0
 
 
@@ -1461,6 +1629,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="per-run hang deadline, seconds")
     ap.add_argument("--selftest", action="store_true",
                     help="in-process self-check (no tpurun)")
+    ap.add_argument("--planes", action="store_true",
+                    help="plane-failover soak: rank 0's device plane "
+                    "is killed mid-allreduce (event-indexed injected "
+                    "DMA failures); the job must complete bit-exact "
+                    "with the golden demote/probe/promote transition "
+                    "sequence and bounded dedup_drops")
     ap.add_argument("--respawn", action="store_true",
                     help="elastic-recovery soak: a worker SIGKILLs "
                     "itself mid-collective under tpurun --ft --respawn;"
@@ -1582,6 +1756,36 @@ def main(argv: list[str] | None = None) -> int:
             elif ns.runs > 1:
                 print(f"run {run + 1}: repair-window tally reproduces "
                       f"run 1 exactly (seed {ns.seed})")
+        return 0
+    if ns.planes:
+        plan = (DEFAULT_PLANES_PLAN if ns.plan == DEFAULT_PLAN
+                else ns.plan)
+        ops = ns.ops if ns.ops != 24 else 70
+        baseline = None
+        for run in range(ns.runs):
+            tallies = run_planes_soak(ns.np_, ns.seed, plan, ops,
+                                      ns.mca, ns.timeout)
+            render_planes(tallies)
+            # the structural tally is the determinism contract —
+            # wall-clock-shaped fields (device_sends, fallbacks: how
+            # many ops happened to fall inside the demotion window)
+            # are excluded, the event-indexed ones are not
+            shape = [(t["proc"], t["completed"], t["ops"],
+                      t["escalated"], t["injected"], t["healthy"],
+                      t["plane"]["plane_demotions"],
+                      t["plane"]["plane_promotions"],
+                      t["plane"]["plane_heal_probes"],
+                      [tuple(tr) for tr in t["transitions"]])
+                     for t in tallies]
+            if baseline is None:
+                baseline = shape
+            elif shape != baseline:
+                raise SystemExit(
+                    f"DETERMINISM VIOLATION: run {run + 1} shape "
+                    f"{shape} != run 1 {baseline} (seed {ns.seed})")
+            elif ns.runs > 1:
+                print(f"run {run + 1}: planes tally reproduces run 1 "
+                      f"exactly (seed {ns.seed})")
         return 0
     if ns.daemon_restart:
         baseline = None

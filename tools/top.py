@@ -120,7 +120,8 @@ def render(state: dict, prev: dict | None = None, url: str = "",
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
           f"{'sdep':>5}{'coal':>6}{'sched':>6}{'dev%':>6}{'dmaw':>7}"
-          f"{'blame':>6}{'failed':>7}  stall causes (ring/cts/other)",
+          f"{'plane':>7}{'blame':>6}{'failed':>7}"
+          "  stall causes (ring/cts/other)",
           file=out)
     for p in sorted(procs):
         f = procs[p]
@@ -158,6 +159,13 @@ def render(state: dict, prev: dict | None = None, url: str = "",
         # spent blocked on remote-copy completion signals (ms)
         dmaw_ns = int(n.get("device_dma_wait_ns", 0))
         dmaw = f"{dmaw_ns / 1e6:>6.1f}" if dmaw_ns else "     -"
+        # plane-health column: mid-job failover activity — peers this
+        # rank demoted off the device plane / promoted back after a
+        # heal probe (dcn_plane_demotions/promotions; "-" = the plane
+        # never had to fail over)
+        dem = int(n.get("plane_demotions", 0))
+        pro = int(n.get("plane_promotions", 0))
+        plane = f"{dem}v{pro}^" if (dem or pro) else "      -"
         # causal blame column: this rank's dominant critical-path
         # cause from the aggregator's /critical join
         bl = crit.get(str(p)) or {}
@@ -171,7 +179,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               f"{int(n.get('dedup_drops', 0)):>6}"
               f"{int(n.get('deadline_expired', 0)):>6}"
               f"{int(n.get('stream_depth', 0)):>5}{coal:>6}{sched:>6}"
-              f"{dev:>6}{dmaw:>7}{blame:>6}"
+              f"{dev:>6}{dmaw:>7}{plane:>7}{blame:>6}"
               f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
               file=out)
     strag = state.get("straggler") or {}
@@ -279,6 +287,12 @@ def selftest() -> int:
                           "ring_stall_ns": 3_000_000 * (rnd + 1),
                           "cts_wait_ns": 1_000_000 * (rnd + 1),
                           "device_dma_wait_ns": 2_000_000 * (rnd + 1)}
+                if proc == 0:
+                    # rank 0 demoted its peer off the device plane
+                    # once and a heal probe promoted it back — the
+                    # plane-health column must surface the transition
+                    native.update(plane_demotions=1, plane_promotions=1,
+                                  plane_heal_probes=1)
                 # rank 1 arrives 25 ms late at every collective
                 late = 25_000_000 if proc == 1 else 0
                 colls = [[f"MPI_COMM_WORLD/allreduce/{rnd * 4 + i}",
@@ -358,6 +372,11 @@ def selftest() -> int:
         assert "skew" in row1, row1
         # device-plane DMA-wait column renders the latest frame's ms
         assert "   6.0" in row1, row1
+        # plane-health column: rank 0 shows its demotion + promotion,
+        # rank 1 (no failover activity) stays "-"
+        row0 = [l for l in text.splitlines()
+                if l.startswith("0 ")][0]
+        assert "1v1^" in row0 and "1v1^" not in row1, (row0, row1)
         # /critical full endpoint: top paths + per-job state over HTTP
         cstate = json.loads(_scrape_url(agg.url + "/critical"))
         assert cstate["dominant"]["rank"] == 1, cstate["dominant"]
