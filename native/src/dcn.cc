@@ -290,6 +290,14 @@ enum TdcnStatIdx {
   TS_PLANE_DEMOTIONS,    // peers demoted off a plane on strike-out
   TS_PLANE_PROMOTIONS,   // peers promoted back after a heal probe
   TS_PLANE_HEAL_PROBES,  // probe sends routed through a demoted plane
+  // -- serving-plane tail (appended; version stays 1) -----------------
+  // tpud overload/concurrency counters (Python-side provider in the
+  // daemon process, ompi_tpu/serve/daemon.py); zeroed slots here keep
+  // TDCN_STAT_NAMES the single source of schema truth.
+  TS_JOBS_CONCURRENT_HWM,   // gang-concurrency high-water (max-merge)
+  TS_JOBS_SHED,             // submits 429-shed by admission control
+  TS_JOBS_DEADLINE_EXPIRED, // jobs revoked by serve_job_deadline_s
+  TS_JOBS_RETRIED,          // jobs re-enqueued by the repair retry budget
   TS_COUNT
 };
 
@@ -311,7 +319,8 @@ static const char *TDCN_STAT_NAMES =
     "device_dma_waits,device_dma_wait_ns,"
     "device_arb_device,device_arb_host,device_fallbacks,"
     "device_window_reclaimed,"
-    "plane_demotions,plane_promotions,plane_heal_probes";
+    "plane_demotions,plane_promotions,plane_heal_probes,"
+    "jobs_concurrent_hwm,jobs_shed,jobs_deadline_expired,jobs_retried";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];

@@ -59,6 +59,7 @@ DEFAULT_SCOPE = (
     "ompi_tpu/serve/daemon.py",
     "ompi_tpu/serve/worker.py",
     "ompi_tpu/serve/queue.py",
+    "ompi_tpu/serve/agent.py",
     "ompi_tpu/ft/detector.py",
 )
 

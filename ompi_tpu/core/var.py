@@ -602,6 +602,37 @@ SERVING_VARS = (
      "teardown (no orphans), and how long the restarted daemon waits "
      "for a live worker's re-adoption record before treating the "
      "rank as dead and respawning it"),
+    ("serve", "", "max_concurrent", 0, "int",
+     "Concurrency cap for the gang scheduler: at most this many jobs "
+     "run on the mesh at once even when disjoint rank-sets are free "
+     "(0 = unlimited — any job whose full rank-set is free launches)"),
+    ("serve", "", "admission_stall_ns", 0, "int",
+     "Telemetry-driven admission threshold (0 = off): when one daemon "
+     "monitor tick's summed ring/cts/DMA stall delta across the mesh "
+     "exceeds this many nanoseconds (or the detector reports the mesh "
+     "unhealthy), the scheduler queues instead of dispatching; "
+     "serve_shed_policy decides what SUSTAINED overload does to new "
+     "submits"),
+    ("serve", "", "shed_policy", "shed", "string",
+     "Graceful-degradation policy under sustained overload (three "
+     "consecutive over-threshold admission ticks): 'shed' rejects "
+     "submits from tenants that already have work queued or running "
+     "with HTTP 429 + a Retry-After hint (an idle tenant still gets "
+     "one job in — overload must not lock a tenant out entirely); "
+     "'queue' only holds dispatch and keeps admitting"),
+    ("serve", "", "job_deadline_s", 0.0, "float",
+     "Per-job wall deadline, Deadline-bounded (0 = none): an expired "
+     "job gets a revoke directive — its workers revoke the job "
+     "communicator ULFM-style, the job fails with a typed "
+     "DeadlineExpired error on /job/<id>, and concurrently running "
+     "disjoint gangs are untouched — instead of wedging its gang "
+     "(serve_job_timeout remains the harder kill-and-repair bound)"),
+    ("serve", "", "retry_budget", 0, "int",
+     "Automatic re-enqueues for a job killed by mesh repair (a rank "
+     "died under it; 0 = none): each retry is journaled as one atomic "
+     "record, so a daemon crash mid-retry replays to exactly one "
+     "re-run; budget exhaustion fails the job with a typed "
+     "RetryBudgetExhausted error on /job/<id>"),
 )
 
 

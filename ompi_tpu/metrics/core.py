@@ -116,6 +116,15 @@ NATIVE_COUNTERS = (
     # flight-recorded; the C block keeps zeroed slots (schema truth
     # stays TDCN_STAT_NAMES)
     "plane_demotions", "plane_promotions", "plane_heal_probes",
+    # serving-plane tail: tpud overload/concurrency counters — gang
+    # concurrency high-water (``_hwm`` suffix → max-merge, baseline
+    # exempt), submits shed 429 by the telemetry-driven admission
+    # controller, jobs whose Deadline expiry revoked their comm, and
+    # jobs re-enqueued by the repair retry budget.  Maintained by the
+    # daemon-process provider (serve/daemon.py); the C block keeps
+    # zeroed slots so TDCN_STAT_NAMES stays the single schema truth
+    "jobs_concurrent_hwm", "jobs_shed", "jobs_deadline_expired",
+    "jobs_retried",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —

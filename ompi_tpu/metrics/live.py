@@ -99,6 +99,12 @@ class TelemetryAggregator:
         #: dict is merged into /json state — how daemon liveness and
         #: journal depth reach tools/top.py without a second endpoint
         self.extra_state = None
+        #: host-process counter extension (the tpud daemon's serving
+        #: counters — jobs_shed, jobs_concurrent_hwm, …): a callable
+        #: returning NATIVE_COUNTERS-named totals owned by the HOST
+        #: process rather than any rank, rendered on /metrics as
+        #: ``proc="daemon"`` samples of the same families
+        self.extra_counters = None
         #: extension routes (the tpud ops surface mounts here):
         #: (method, path) → callable(body_bytes) -> (status, ctype, body)
         self._routes: dict[tuple[str, str], Any] = {}
@@ -162,10 +168,13 @@ class TelemetryAggregator:
             def log_message(self, *a):  # scrapes must not spam stdio
                 pass
 
-            def _reply(self, status: int, ctype: str, body: bytes):
+            def _reply(self, status: int, ctype: str, body: bytes,
+                       headers: dict | None = None):
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -180,13 +189,18 @@ class TelemetryAggregator:
                 if not hits:
                     return False
                 _, fn = max(hits, key=lambda h: len(h[0]))
+                hdrs: dict | None = None
                 try:
-                    status, ctype, out = fn(path, body)
+                    resp = fn(path, body)
+                    if len(resp) == 4:  # (status, ctype, body, headers)
+                        status, ctype, out, hdrs = resp
+                    else:
+                        status, ctype, out = resp
                 except Exception as e:  # noqa: BLE001 — ops must answer
                     status, ctype = 500, "application/json"
                     out = json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode()
-                self._reply(status, ctype, out)
+                self._reply(status, ctype, out, hdrs)
                 return True
 
             def do_POST(self):
@@ -232,6 +246,8 @@ class TelemetryAggregator:
 
     def add_route(self, method: str, path: str, fn) -> None:
         """Mount ``fn(path, body_bytes) -> (status, ctype, body_bytes)``
+        — or the 4-tuple form with a trailing ``headers`` dict (how the
+        admission controller's 429 carries a real ``Retry-After``) —
         at ``(method, path)``; extension routes win over the built-in
         endpoints, so a daemon can serve a richer ``/jobs``."""
         self._routes[(method.upper(), path)] = fn
@@ -463,6 +479,13 @@ class TelemetryAggregator:
                     agg[c] = agg.get(c, 0) + int(ns)
         return total, merged
 
+    def latest_frames(self) -> dict[int, dict]:
+        """Snapshot of the newest frame per proc — the daemon's
+        admission controller reads cumulative stall counters from it
+        once per monitor tick."""
+        with self._lock:
+            return {p: f for p, f in self._latest.items()}
+
     def critical_state(self) -> dict:
         """The ``/critical`` feed: per-job blame tables (slowest
         collectives with their critical paths, per-rank cause totals,
@@ -592,6 +615,16 @@ class TelemetryAggregator:
         names = [k for k in _core.NATIVE_COUNTERS
                  if any((f.get("native") or {}).get(k)
                         for f in latest.values())]
+        # host-process (daemon-owned) counters join the same families
+        # as ``proc="daemon"`` samples — no rank ever owns them
+        extra: dict[str, int] = {}
+        if self.extra_counters is not None:
+            try:
+                extra = {k: int(v)
+                         for k, v in (self.extra_counters() or {}).items()
+                         if k in _core.NATIVE_COUNTERS}
+            except Exception:  # noqa: BLE001 — scrape must answer
+                extra = {}
 
         def _dcn_sample(p: int, k: str) -> tuple[str, int]:
             """(label, value) for one proc's counter: under a job scope
@@ -609,8 +642,14 @@ class TelemetryAggregator:
         for k in names:
             _export.dcn_family(
                 lines, k,
-                [_dcn_sample(p, k) for p in sorted(latest)],
+                [_dcn_sample(p, k) for p in sorted(latest)]
+                + ([('{proc="daemon"}', extra[k])] if k in extra else []),
                 origin="Live")
+        for k in (k for k in _core.NATIVE_COUNTERS
+                  if k in extra and k not in names):
+            _export.dcn_family(lines, k,
+                               [('{proc="daemon"}', extra[k])],
+                               origin="Live")
         # per-op call/byte/wait totals from the rank-local aggregates
         for fam, field, help_ in (
             ("op_calls_total", "count", "collective calls by op"),

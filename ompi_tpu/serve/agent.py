@@ -61,6 +61,11 @@ K_ACMD = "serve.agent.cmd."      # + <session>.<hid>.<n> → command
 K_AACK = "serve.agent.ack."      # + <session>.<hid>.<n> → ack
 K_AADOPT = "serve.agent.adopt."  # + <hid>               → adoption offer
 K_AADOPTED = "serve.agent.adopted."  # + <hid>           → daemon's ack
+K_PIDFILE = "serve.pidfile."     # + <generation>  → pidfile-record
+#: beacon (keep in sync with serve/daemon.py): the daemon mirrors its
+#: pidfile record into the KVS so agents on hosts WITHOUT the daemon's
+#: filesystem can copy it to their local pidfile path — the real-remote
+#: re-attach channel (workers there poll the local copy as usual)
 K_ASESSION = "serve.agent.session."  # + <hid> → the daemon's CURRENT
 #: command session for the host — the supersession fence: an agent
 #: whose session no longer matches was given up on (wedged past
@@ -220,6 +225,46 @@ class LaunchAgent:
 
     # -- control channel -------------------------------------------------
 
+    def _beacon_gen(self) -> int:
+        """The generation this agent's command session was minted
+        under (``g<gen>s<n>``) — more reliable than the local pidfile
+        copy, which may not exist yet on a host that shares no
+        filesystem with the daemon."""
+        try:
+            return int(self.session.lstrip("g").split("s", 1)[0])
+        except ValueError:
+            return self.generation
+
+    def _mirror_beacon(self) -> None:
+        """Real-remote re-attach channel: copy the daemon's pidfile-
+        record beacon (``serve.pidfile.<generation>``) to THIS host's
+        pidfile path, so the workers here — and this agent itself —
+        re-attach through the ordinary local pidfile poll without ever
+        reading daemon-local disk.  A reborn agent (respawned over rsh
+        by a restarted daemon, new KVS address in its env) mirrors the
+        NEW record, which is how parked workers on the host learn the
+        restarted daemon's address.  Beacon absent (older daemon):
+        no-op — the plain pidfile poll stands.  On a shared
+        filesystem the mirror compares equal and never writes."""
+        if not self.pidfile:
+            return
+        gen = max(self._beacon_gen(), self.generation)
+        try:
+            rec = self.kvs.get(f"{K_PIDFILE}{gen}", wait=False)
+        except KeyError:
+            return
+        if not isinstance(rec, dict):
+            return
+        if _state.read_pidfile(self.pidfile) != rec:
+            try:
+                _state.write_pidfile(self.pidfile, dict(rec))
+                self.generation = int(rec.get("generation", gen))
+                print(f"agent h{self.hid}: mirrored daemon pidfile "
+                      f"beacon (generation {self.generation}) to "
+                      f"{self.pidfile}", flush=True)
+            except OSError:
+                pass  # unwritable path: the poll fallback stands
+
     def _hb(self) -> None:
         # supersession fence (checked at heartbeat cadence): a daemon
         # that rotated this host's session replaced us — a wedged
@@ -241,6 +286,9 @@ class LaunchAgent:
             "generation": self.generation, "session": self.session,
             "ts_ns": time.time_ns(), "cmds_done": self.cmds_done,
             "workers": self._worker_table()})
+        # heartbeat cadence keeps the local pidfile mirror fresh (a
+        # just-adopted agent re-mirrors under its new generation)
+        self._mirror_beacon()
 
     def _exec(self, cmd: dict) -> dict:
         if _fsim._enabled:
@@ -350,8 +398,12 @@ class LaunchAgent:
             info = _state.read_pidfile(self.pidfile)
             alive = bool(info) and _state.pid_alive(
                 int(info.get("pid", 0)))
+            # skip a restarting daemon's provisional claim record (no
+            # KVS yet, predecessor's generation) — same hazard as the
+            # worker's park loop: KeyError('kvs') killed the agent
+            ready = alive and _state.pidfile_ready(info)
             gen = int((info or {}).get("generation", 0))
-            if alive and gen == self.generation:
+            if ready and gen == self.generation:
                 try:
                     self.kvs.reconnect(info["kvs"])
                     self.kvs_addr = info["kvs"]
@@ -360,7 +412,7 @@ class LaunchAgent:
                     return
                 except OSError:
                     pass
-            elif alive and gen > self.generation:
+            elif ready and gen > self.generation:
                 try:
                     self.kvs.reconnect(info["kvs"])
                     self.kvs_addr = info["kvs"]

@@ -17,11 +17,15 @@ from typing import Any
 
 class ServeError(Exception):
     """Ops-endpoint error; ``.status`` carries the HTTP code (429 =
-    admission quota, 503 = draining)."""
+    admission quota/shed, 503 = draining) and ``.retry_after`` the
+    server's Retry-After hint in seconds (None when it sent none —
+    shed rejections under overload always carry one)."""
 
-    def __init__(self, msg: str, status: int = 0):
+    def __init__(self, msg: str, status: int = 0,
+                 retry_after: float | None = None):
         super().__init__(msg)
         self.status = status
+        self.retry_after = retry_after
 
 
 def _call(url: str, path: str, payload: Any | None = None,
@@ -38,11 +42,23 @@ def _call(url: str, path: str, payload: Any | None = None,
             return json.loads(r.read().decode() or "{}")
     except urllib.error.HTTPError as e:
         body = e.read().decode(errors="replace")
+        retry_after: float | None = None
         try:
-            msg = json.loads(body).get("error", body)
+            parsed = json.loads(body)
+            msg = parsed.get("error", body)
+            if parsed.get("retry_after") is not None:
+                retry_after = float(parsed["retry_after"])
         except ValueError:
             msg = body
-        raise ServeError(f"{path}: {msg}", status=e.code) from None
+        if retry_after is None:
+            hdr = e.headers.get("Retry-After") if e.headers else None
+            if hdr is not None:
+                try:
+                    retry_after = float(hdr)
+                except ValueError:
+                    pass
+        raise ServeError(f"{path}: {msg}", status=e.code,
+                         retry_after=retry_after) from None
     except OSError as e:
         raise ServeError(f"{path}: daemon unreachable ({e})") from None
 

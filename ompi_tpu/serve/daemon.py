@@ -61,7 +61,7 @@ from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.metrics.live import TelemetryAggregator
 from . import agent as _agent
 from . import state as _state
-from .queue import AdmissionError, JobQueue
+from .queue import AdmissionController, AdmissionError, JobQueue
 
 #: KVS key prefixes of the serve protocol (workers mirror these)
 K_JOB = "serve.job."        # + <n>            → directive JSON
@@ -70,6 +70,9 @@ K_RESUME = "serve.resume."  # + <proc>.i<inc>  → reborn worker's cursor
 K_ADOPT = "serve.adopt."    # + <proc>         → worker re-adoption offer
 K_ADOPTED = "serve.adopted."  # + <proc>       → daemon's adoption ack
 K_START = "serve.start."    # + <proc>         → fresh worker's cursor
+K_PIDFILE = "serve.pidfile."  # + <generation>  → pidfile-record beacon
+#                              (agents mirror it to hosts without the
+#                               daemon's filesystem — see serve/agent.py)
 
 #: env var carrying the pidfile path to resident workers (their
 #: re-attach rendezvous after a daemon crash)
@@ -147,6 +150,9 @@ class TpuDaemon:
         self.cid_block = int(serve_var(self.mca, "cid_block"))
         self.cid_next = int(serve_var(self.mca, "cid_base"))
         self.job_timeout = float(serve_var(self.mca, "job_timeout"))
+        #: softer bound than job_timeout: expiry revokes the job's comm
+        #: (typed failure, gang woken) instead of killing its ranks
+        self.job_deadline = float(serve_var(self.mca, "job_deadline_s"))
         self.reattach_timeout = float(
             serve_var(self.mca, "reattach_timeout"))
         self._lock = threading.RLock()
@@ -185,7 +191,26 @@ class TpuDaemon:
         self.aggregator.extra_state = self._top_state
         self.url = self.aggregator.url
         self.queue = JobQueue(
-            self.np, max_pending=int(serve_var(self.mca, "max_pending")))
+            self.np, max_pending=int(serve_var(self.mca, "max_pending")),
+            max_concurrent=int(serve_var(self.mca, "max_concurrent")),
+            retry_budget=int(serve_var(self.mca, "retry_budget")),
+            admission=AdmissionController(
+                stall_ns=int(serve_var(self.mca, "admission_stall_ns")),
+                policy=str(serve_var(self.mca, "shed_policy"))))
+        #: frame timestamps the admission controller already folded —
+        #: its streak must advance at telemetry cadence, not at the
+        #: much faster monitor-tick cadence (see _admission_update)
+        self._adm_seen: dict[int, int] = {}
+        # the daemon-owned serving counters (jobs_shed, …) ride the
+        # normal native-counter discipline: the in-process pvar surface
+        # via a provider anchored on the queue's lifetime, and /metrics
+        # via the aggregator's host-process extension (proc="daemon")
+        from ompi_tpu.metrics import core as _mcore
+
+        _mcore.register_provider(
+            self.queue, lambda q=self.queue: dict(q.counters))
+        self.aggregator.extra_counters = (
+            lambda q=self.queue: dict(q.counters))
         self._mount_routes()
         #: next directive index (the job-stream cursor)
         self.cursor = 0
@@ -215,13 +240,21 @@ class TpuDaemon:
                     os.makedirs(self.logdir, exist_ok=True)
                 except OSError:
                     self.logdir = ""
-            _state.write_pidfile(self.pidfile, {
+            record = {
                 "pid": os.getpid(), "generation": self.generation,
                 "np": self.np, "kvs": self.server.address,
                 "url": self.url,
                 "ingest": self.aggregator.ingest_address,
                 "logs": self.logdir,
-                "ts_ns": time.time_ns()})
+                "ts_ns": time.time_ns()}
+            _state.write_pidfile(self.pidfile, record)
+            # real-remote re-attach channel: mirror the pidfile record
+            # as a KVS beacon — launch agents copy it to THEIR host's
+            # pidfile path, so workers on hosts that share no
+            # filesystem with the daemon still find a restarted daemon
+            # through the ordinary pidfile poll
+            self.server.put_local(f"{K_PIDFILE}{self.generation}",
+                                  record)
             if recovered is not None:
                 # journal compaction (PR 10 deferred edge): takeover
                 # rewrites the journal to the live-state fixed point
@@ -810,7 +843,15 @@ class TpuDaemon:
                 tenant=tenant, nprocs=req.get("nprocs"),
                 env=req.get("env"))
         except AdmissionError as e:
-            return self._json(e.status, {"error": str(e)})
+            body: dict = {"error": str(e)}
+            if e.retry_after is not None:
+                # load-shed rejection: the Retry-After rides both the
+                # JSON body and a real HTTP header (RFC-compliant
+                # clients back off without parsing the body)
+                body["retry_after"] = e.retry_after
+                return (*self._json(e.status, body),
+                        {"Retry-After": str(int(e.retry_after))})
+            return self._json(e.status, body)
         self._journal_ev("submit", job=job)
         return self._json(200, job)
 
@@ -875,6 +916,9 @@ class TpuDaemon:
                 "procs": {str(r): self._status[r]
                           for r in range(self.np)},
                 "draining": self.queue.draining,
+                "jobs": {"running": len(qs["running"]),
+                         "counters": dict(qs["counters"]),
+                         "admission": qs["admission"]},
                 **({"agents": agents} if agents else {}),
             }}
 
@@ -1023,9 +1067,15 @@ class TpuDaemon:
         resume the stream AFTER this directive (their cursor is the
         ``serve.resume`` key written here)."""
         with self._lock:
+            # bystander-quiet gate: only a directive whose gang
+            # INTERSECTS the dead set blocks the repair (its members
+            # are failing on the dead rank right now and must close
+            # out first) — a concurrently running disjoint gang keeps
+            # its job while the survivors heal the base world under it
             if (not self._repairing or self._repair_published
                     or any(s == "adopting" for s in self._status)
                     or any(st["kind"] != "repair"
+                           and set(st["procs"]) & self._repairing
                            for st in self._outstanding.values())):
                 return
             if any(self._status[r] == "respawning" and
@@ -1047,6 +1097,43 @@ class TpuDaemon:
 
     # -- monitor loop ----------------------------------------------------
 
+    def _admission_update(self) -> None:
+        """Fold one tick of the daemon's OWN telemetry feeds into the
+        admission controller: per-proc cumulative stall sums
+        (ring + CTS + device-DMA wait, straight off the newest frames),
+        detector health, and the /critical dominant cause for the 429
+        message.  Ticks that saw no fresh frame are skipped while the
+        mesh is healthy — the controller's streak must advance at
+        telemetry cadence, not at the much faster monitor cadence, or
+        the zero-delta gap between frames would reset it every time."""
+        ctrl = self.queue.admission
+        if ctrl is None or not ctrl.enabled():
+            return
+        latest = self.aggregator.latest_frames()
+        fresh = False
+        stalls: dict[int, int] = {}
+        for p, frame in latest.items():
+            ts = int(frame.get("ts_ns", 0))
+            if ts != self._adm_seen.get(p):
+                fresh = True
+                self._adm_seen[p] = ts
+            nat = frame.get("native") or {}
+            stalls[p] = (int(nat.get("ring_stall_ns", 0))
+                         + int(nat.get("cts_wait_ns", 0))
+                         + int(nat.get("device_dma_wait_ns", 0)))
+        with self._lock:
+            healthy = self._healthy_locked()
+        if not fresh and healthy and not ctrl.unhealthy:
+            return
+        cause = ""
+        try:
+            dom = self.aggregator.critical_state().get("dominant")
+            cause = str((dom.get("cause") if isinstance(dom, dict)
+                         else dom) or "")
+        except Exception:  # noqa: BLE001 — admission over blame detail
+            pass
+        ctrl.update(stalls, healthy=healthy, cause=cause)
+
     def _healthy_locked(self) -> bool:
         return not self._repairing and all(
             s in ("active", "retired", "dead", "exited")
@@ -1064,6 +1151,7 @@ class TpuDaemon:
 
     def _collect_done(self) -> None:
         done_idx = []
+        revoke: list[tuple[str, list[int]]] = []
         with self._lock:
             for idx, st in self._outstanding.items():
                 for r in st["procs"]:
@@ -1074,8 +1162,28 @@ class TpuDaemon:
                         st["done"][r] = rec
                 if len(st["done"]) >= len(st["procs"]):
                     done_idx.append(idx)
-                elif (st["kind"] == "job" and self.job_timeout > 0
-                      and time.monotonic() - st["ts"] > self.job_timeout):
+                    continue
+                if st["kind"] != "job":
+                    continue
+                elapsed = time.monotonic() - st["ts"]
+                if (self.job_deadline > 0 and not st.get("revoked")
+                        and elapsed > self.job_deadline):
+                    # ULFM-grade deadline escalation: revoke exactly
+                    # this job's comm — its gang wakes out of any
+                    # parked collective with MPIRevokedError and
+                    # reports a typed failure; the ranks stay ALIVE
+                    # and concurrent disjoint gangs never notice
+                    # (serve_job_timeout below stays the harder,
+                    # rank-killing bound)
+                    print(f"[tpud] job {st['job_id']} exceeded "
+                          f"serve_job_deadline_s={self.job_deadline:g}"
+                          "s; revoking its comm", flush=True)
+                    st["revoked"] = True
+                    st["deadline_hit"] = True
+                    self.queue.counters["jobs_deadline_expired"] += 1
+                    revoke.append((st["job_id"], list(st["procs"])))
+                if (self.job_timeout > 0
+                        and elapsed > self.job_timeout):
                     # job overran its budget: reclaim the rank-set by
                     # killing its members — the death path respawns and
                     # repairs them (the elastic plane as the enforcer)
@@ -1087,6 +1195,9 @@ class TpuDaemon:
                         q = self._procs[r]
                         if q is not None and q.poll() is None:
                             q.terminate()
+        for job_id, procs in revoke:
+            self._publish({"kind": "revoke", "procs": procs,
+                           "id": job_id})
         for idx in done_idx:
             self._finish_directive(idx)
 
@@ -1097,8 +1208,36 @@ class TpuDaemon:
             bad = [f"rank {r}: {rec.get('error', '?')}"
                    for r, rec in sorted(st["done"].items())
                    if not rec.get("ok")]
+            error = "; ".join(bad)
+            died = any("rank died" in rec.get("error", "")
+                       or "mesh lost" in rec.get("error", "")
+                       for rec in st["done"].values()
+                       if not rec.get("ok"))
+            if bad and st.get("deadline_hit"):
+                # typed failure the client reads off /job/<id>; a
+                # deadline kill is policy, never retried
+                error = ("DeadlineExpired: exceeded "
+                         f"serve_job_deadline_s={self.job_deadline:g}s"
+                         f"; {error}")
+            elif bad and died:
+                # mesh repair killed the job, not the job itself:
+                # serve_retry_budget buys it automatic re-enqueues —
+                # the close-the-attempt + re-queue pair is ONE journal
+                # line, so a daemon crash on either side of it replays
+                # to exactly one more attempt (exactly-once)
+                job = self.queue.retry(st["job_id"])
+                if job is not None:
+                    self._journal_ev("retry", idx=idx, job=job)
+                    print(f"[tpud] job {job['id']} killed by mesh "
+                          f"repair; re-queued (retry {job['retries']}"
+                          f"/{self.queue.retry_budget})", flush=True)
+                    return
+                if self.queue.retry_budget > 0:
+                    error = ("RetryBudgetExhausted: serve_retry_budget"
+                             f"={self.queue.retry_budget} consumed; "
+                             f"{error}")
             job = self.queue.finish(st["job_id"], ok=not bad,
-                                    error="; ".join(bad),
+                                    error=error,
                                     ranks=st["done"])
             self._journal_ev("finish", idx=idx, kind="job", job=job)
             if job is not None:
@@ -1113,6 +1252,11 @@ class TpuDaemon:
                 self._repair_published = False
             self._journal_ev("finish", idx=idx, kind="repair")
             print("[tpud] repair complete: mesh restored", flush=True)
+        elif st["kind"] == "revoke":
+            # the revocation itself: members acked poisoning the comm;
+            # the JOB directive still closes separately (its gang's
+            # typed failure reports drive the branch above)
+            self._journal_ev("finish", idx=idx, kind="revoke")
         elif st["kind"] == "retire":
             with self._lock:
                 done = [r for r in range(self.np)
@@ -1184,6 +1328,7 @@ class TpuDaemon:
         self._poll_workers()
         self._collect_done()
         self._maybe_publish_repair()
+        self._admission_update()
         self._schedule()
         self._maybe_shutdown()
 

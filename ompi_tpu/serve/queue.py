@@ -7,6 +7,21 @@ every proc it needs is free — with round-robin fairness across tenants
 (one tenant's burst cannot starve another's queue) and an admission
 quota per tenant (``serve_max_pending``).
 
+Scheduling is **any-fit**, not head-of-line: within a tenant's FIFO
+the first job whose full rank-set fits the currently free procs
+launches, so a wide job parked at the head cannot starve narrow jobs
+behind it while disjoint ranks sit idle (``serve_max_concurrent``
+bounds how many gangs overlap; 0 = any fit).
+
+The :class:`AdmissionController` adds the telemetry-driven half: the
+daemon folds its own aggregator feeds (summed ring/cts/DMA stall
+deltas, detector health, the /critical dominant cause) into it once
+per monitor tick.  One over-threshold tick holds dispatch (jobs queue
+instead of landing on a stalled mesh); ``SUSTAIN`` consecutive ticks
+under ``serve_shed_policy=shed`` flips to load shedding — submits
+from tenants that already have work are rejected 429 with a
+Retry-After hint — and one clean tick restores admission.
+
 Pure bookkeeping: no sockets, no threads — the daemon drives it from
 its monitor loop, and tests drive it directly.
 """
@@ -29,19 +44,120 @@ def _id_num(job_id: str) -> int:
 
 class AdmissionError(Exception):
     """Submit rejected by admission control (HTTP 429/503 at the ops
-    surface); ``.status`` carries the HTTP code."""
+    surface); ``.status`` carries the HTTP code and ``.retry_after``
+    the Retry-After hint in seconds (None when the rejection is a
+    hard quota/drain, not a transient overload shed)."""
 
-    def __init__(self, msg: str, status: int = 429):
+    def __init__(self, msg: str, status: int = 429,
+                 retry_after: float | None = None):
         super().__init__(msg)
         self.status = status
+        self.retry_after = retry_after
+
+
+class AdmissionController:
+    """Telemetry-driven admission state machine (one per daemon).
+
+    ``update()`` folds one monitor tick: per-proc CUMULATIVE stall
+    sums (ring_stall_ns + cts_wait_ns + device_dma_wait_ns from the
+    aggregator's latest frames — deltas against the previous tick are
+    the overload signal, so a busy past never sheds forever) plus
+    detector health and the dominant /critical cause.  States:
+
+    * ``ok``       — dispatch and admit normally;
+    * ``stalled``  — the last tick crossed ``serve_admission_stall_ns``
+      (or the mesh is unhealthy): hold dispatch, keep admitting;
+    * ``shedding`` — ``SUSTAIN`` consecutive stalled ticks under
+      ``serve_shed_policy=shed``: tenants that already have work
+      queued or running get 429 + Retry-After; an idle tenant still
+      gets one job in (overload must not lock a tenant out).
+
+    One clean tick resets the streak — a healed mesh restores
+    admission immediately (the np=2 acceptance asserts the full
+    ok → shedding → ok round trip in event space).
+    """
+
+    #: consecutive over-threshold ticks before queue-hold escalates
+    #: to shedding (and the Retry-After hint, in poll-tick seconds)
+    SUSTAIN = 3
+
+    def __init__(self, stall_ns: int = 0, policy: str = "shed",
+                 sustain: int = SUSTAIN):
+        self.stall_ns = int(stall_ns)
+        self.policy = str(policy or "shed")
+        self.sustain = max(1, int(sustain))
+        self._streak = 0
+        #: proc → last cumulative stall sum (delta base)
+        self._last: dict[int, int] = {}
+        self.last_delta_ns = 0
+        self.cause = ""
+        self.unhealthy = False
+
+    def enabled(self) -> bool:
+        return self.stall_ns > 0
+
+    def update(self, stalls_by_proc: dict | None, healthy: bool = True,
+               cause: str = "") -> None:
+        """Fold one monitor tick (no-op while disabled)."""
+        if not self.enabled():
+            return
+        delta = 0
+        for p, v in (stalls_by_proc or {}).items():
+            p, v = int(p), int(v)
+            delta += max(0, v - self._last.get(p, v))
+            self._last[p] = v
+        self.last_delta_ns = delta
+        self.unhealthy = not healthy
+        over = delta > self.stall_ns or not healthy
+        self._streak = self._streak + 1 if over else 0
+        self.cause = str(cause or "") if over else ""
+
+    def overloaded(self) -> bool:
+        """Hold dispatch? (any over-threshold tick, until one clean)"""
+        return self.enabled() and self._streak >= 1
+
+    def shedding(self) -> bool:
+        return (self.enabled() and self.policy == "shed"
+                and self._streak >= self.sustain)
+
+    def retry_after_s(self) -> int:
+        """Retry-After hint: the shortest interval after which the
+        streak could have cleared (one sustain window of ticks)."""
+        return max(1, int(self.sustain))
+
+    def state(self) -> dict:
+        return {
+            "state": ("shedding" if self.shedding()
+                      else "stalled" if self.overloaded() else "ok"),
+            "enabled": self.enabled(),
+            "stall_ns": self.stall_ns,
+            "policy": self.policy,
+            "streak": self._streak,
+            "last_delta_ns": self.last_delta_ns,
+            "unhealthy": self.unhealthy,
+            "cause": self.cause,
+        }
 
 
 class JobQueue:
     """Multi-tenant FIFO with gang scheduling over ``nprocs`` slots."""
 
-    def __init__(self, nprocs: int, max_pending: int = 8):
+    def __init__(self, nprocs: int, max_pending: int = 8,
+                 max_concurrent: int = 0, retry_budget: int = 0,
+                 admission: AdmissionController | None = None):
         self.nprocs = int(nprocs)
         self.max_pending = int(max_pending)
+        #: gang-concurrency cap (serve_max_concurrent; 0 = unlimited)
+        self.max_concurrent = int(max_concurrent)
+        #: automatic re-enqueues per repair-killed job (serve_retry_budget)
+        self.retry_budget = int(retry_budget)
+        #: telemetry-driven admission (None/disabled = PR-10 behavior)
+        self.admission = admission
+        #: serving-plane NATIVE_COUNTERS slice (daemon provider feed);
+        #: jobs_concurrent_hwm is monotone here, max-merged downstream
+        self.counters: dict[str, int] = {
+            "jobs_concurrent_hwm": 0, "jobs_shed": 0,
+            "jobs_deadline_expired": 0, "jobs_retried": 0}
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         #: submitted, not yet launched (submission order — FIFO spine)
@@ -68,6 +184,19 @@ class JobQueue:
                 raise AdmissionError("daemon is draining: no new jobs",
                                      status=503)
             tenant = str(tenant or "default")
+            ctrl = self.admission
+            if (ctrl is not None and ctrl.shedding()
+                    and self._tenant_depth(tenant) >= 1):
+                # sustained overload: shed tenants that already have
+                # work in the system; a tenant with nothing queued or
+                # running still gets one job admitted (fairness floor)
+                self.counters["jobs_shed"] += 1
+                ra = ctrl.retry_after_s()
+                raise AdmissionError(
+                    "mesh overloaded (admission shedding"
+                    + (f", cause {ctrl.cause}" if ctrl.cause else "")
+                    + f"): retry after {ra}s", status=429,
+                    retry_after=ra)
             if self.max_pending > 0:
                 depth = self._tenant_depth(tenant)
                 if depth >= self.max_pending:
@@ -104,19 +233,29 @@ class JobQueue:
         """Pick the next job whose full rank-set fits in ``free_procs``
         and assign it the lowest free procs.  Order: round-robin across
         tenants (the tenant picked least recently goes first), FIFO
-        within a tenant — so ``submit`` order holds per tenant while a
-        burst from one tenant cannot monopolize the mesh."""
+        within a tenant — but **any-fit**, not head-of-line: within a
+        tenant's FIFO the first job that FITS the free set launches,
+        so a wide job parked at the head cannot idle disjoint ranks a
+        narrow job behind it could use.  Returns None while the
+        admission controller holds dispatch (over-threshold stall
+        tick) or ``serve_max_concurrent`` gangs already run."""
         free = sorted(int(p) for p in free_procs)
         with self._lock:
-            tenants: dict[str, dict] = {}
-            for j in self._queue:  # FIFO: first hit per tenant wins
-                tenants.setdefault(j["tenant"], j)
-            if not tenants:
+            if self.admission is not None and self.admission.overloaded():
+                return None  # queue instead of dispatch onto a stall
+            if (self.max_concurrent > 0
+                    and len(self._running) >= self.max_concurrent):
+                return None
+            by_tenant: dict[str, list[dict]] = {}
+            for j in self._queue:
+                by_tenant.setdefault(j["tenant"], []).append(j)
+            if not by_tenant:
                 return None
             for tenant in sorted(
-                    tenants, key=lambda t: (self._served.get(t, -1), t)):
-                job = tenants[tenant]
-                if job["nprocs"] <= len(free):
+                    by_tenant, key=lambda t: (self._served.get(t, -1), t)):
+                for job in by_tenant[tenant]:  # FIFO scan, first FIT
+                    if job["nprocs"] > len(free):
+                        continue
                     self._queue.remove(job)
                     self._pick += 1
                     self._served[tenant] = self._pick
@@ -124,6 +263,9 @@ class JobQueue:
                     job["state"] = "running"
                     job["start_ns"] = time.time_ns()
                     self._running[job["id"]] = job
+                    self.counters["jobs_concurrent_hwm"] = max(
+                        self.counters["jobs_concurrent_hwm"],
+                        len(self._running))
                     return dict(job)
             return None
 
@@ -145,6 +287,32 @@ class JobQueue:
                 job["ranks"] = {str(r): rec for r, rec in ranks.items()}
             job["end_ns"] = time.time_ns()
             self._done[job_id] = job
+            return dict(job)
+
+    def retry(self, job_id: str) -> dict | None:
+        """Re-enqueue a RUNNING job killed by mesh repair, consuming
+        one unit of ``serve_retry_budget``.  Returns the re-queued
+        record, or None when the budget is exhausted (the job stays
+        running; the caller finishes it failed with the typed
+        RetryBudgetExhausted error).  The daemon journals the returned
+        record as one atomic ``retry`` event — close-the-attempt +
+        re-queue in a single fsync'd line, the exactly-once hinge."""
+        with self._lock:
+            job = self._running.get(job_id)
+            if job is None:
+                return None
+            n = int(job.get("retries", 0))
+            if self.retry_budget <= 0 or n >= self.retry_budget:
+                return None
+            del self._running[job_id]
+            job["retries"] = n + 1
+            job["state"] = "queued"
+            job.pop("procs", None)
+            job.pop("start_ns", None)
+            job.pop("ranks", None)
+            job.pop("error", None)
+            self._queue.append(job)
+            self.counters["jobs_retried"] += 1
             return dict(job)
 
     # -- restart recovery (journal replay) -------------------------------
@@ -216,4 +384,10 @@ class JobQueue:
                 "tenant_depth": {t: self._tenant_depth(t)
                                  for t in tenants},
                 "max_pending": self.max_pending,
+                "max_concurrent": self.max_concurrent,
+                "retry_budget": self.retry_budget,
+                "counters": dict(self.counters),
+                "admission": (self.admission.state()
+                              if self.admission is not None
+                              else {"state": "ok", "enabled": False}),
             }

@@ -77,6 +77,19 @@ def read_pidfile(path: str) -> dict | None:
     return info if isinstance(info, dict) and "pid" in info else None
 
 
+def pidfile_ready(info: dict | None) -> bool:
+    """True for a FULL pidfile record a peer may re-attach through.
+    :func:`acquire_pidfile` publishes a provisional ``O_EXCL`` claim
+    (``{pid, claiming, <reaped generation>}``) before the daemon's
+    sockets exist; a parked worker/agent polling inside that window
+    must keep waiting for the full-record overwrite — the claim has
+    no KVS address to dial, and its generation is the DEAD
+    predecessor's, so a same-generation worker would mistake the
+    restarting daemon for its old one."""
+    return (bool(info) and not info.get("claiming")
+            and bool(info.get("kvs")))
+
+
 def write_pidfile(path: str, info: dict) -> None:
     """Atomic publish (tmp + rename): a reader never sees a torn
     record, and the rename is the commit point workers poll for."""
@@ -154,6 +167,15 @@ class Journal:
                   ``idx`` inside)
     ``finish``    a directive completed (``idx``; job directives also
                   carry the final job record)
+    ``retry``     a repair-killed job re-enqueued under its retry
+                  budget: ONE atomic record closes the failed
+                  attempt's directive (``idx``) AND re-queues the job
+                  (``job``, ``retries`` bumped) — a daemon crash on
+                  either side of this line replays to exactly one
+                  re-run (before: attempt still outstanding, closed
+                  again after restart by the workers' cached
+                  completion records, retry decision re-made once;
+                  after: job queued once, attempt closed)
     ``spawn``     a worker process launched or re-adopted
                   (``rank``/``pid``/``incarnation``/``adopted``;
                   ``host`` names the owning launch agent's host index
@@ -337,6 +359,10 @@ class Journal:
         jobs: dict[str, dict] = {}
         published: dict[int, dict] = {}
         finished: dict[int, dict] = {}
+        #: job ids whose LATEST record came from a ``retry`` event —
+        #: their queued state must win over the published-and-finished
+        #: done classification below
+        retried_ids: set[str] = set()
         pids: dict[int, dict] = {}
         repairing: dict[int, int] = {}
         retired: set[int] = set()
@@ -351,6 +377,7 @@ class Journal:
             jobs.clear()
             published.clear()
             finished.clear()
+            retried_ids.clear()
             pids.clear()
             repairing.clear()
             retired.clear()
@@ -396,6 +423,22 @@ class Journal:
                     job = rec.get("job")
                     if job and job.get("id"):
                         jobs[job["id"]] = job
+                elif ev == "retry":
+                    # one atomic record = close the failed attempt's
+                    # directive AND re-queue the job (retries bumped):
+                    # either the line exists (attempt closed, job
+                    # queued once) or it doesn't (attempt still
+                    # outstanding — re-published on restart, workers'
+                    # cached completion records close it again and the
+                    # retry decision re-runs once).  Exactly-once
+                    # either way.
+                    idx = int(rec.get("idx", -1))
+                    finished[idx] = rec
+                    job = rec.get("job")
+                    if job and job.get("id"):
+                        jobs[job["id"]] = job
+                        retried_ids.add(job["id"])
+                    clean = False
                 elif ev == "repair_pending":
                     repairing[int(rec.get("rank", -1))] = int(
                         rec.get("incarnation", 0))
@@ -444,9 +487,14 @@ class Journal:
                 done.append(job)
             elif job["id"] in {d.get("id") for d in outstanding.values()}:
                 running.append(job)
-            elif job["id"] in published_job_ids:
+            elif (job["id"] in published_job_ids
+                    and not (job.get("state") == "queued"
+                             and job["id"] in retried_ids)):
                 # published AND finished but the finish event lost its
-                # job payload — count it done with what we have
+                # job payload — count it done with what we have.  A
+                # job whose latest record is a retry re-queue is NOT
+                # done: its published history belongs to the closed
+                # attempt, and swallowing it here would eat the retry.
                 done.append(dict(job, state=job.get("state", "done")))
             else:
                 queued.append(job)

@@ -49,6 +49,7 @@ import os
 import runpy
 import signal
 import sys
+import threading
 import time
 
 from . import state as _state
@@ -91,29 +92,39 @@ class _PipeSafe:
     including the in-flight job script's — into BrokenPipeError.  The
     in-flight job must keep running through the daemon outage, so
     writes degrade to no-ops instead of raising (output during the
-    outage is lost; the completion record is the durable artifact)."""
+    outage is lost; the completion record is the durable artifact).
+
+    Writes are serialized by a lock: the concurrent serving plane
+    prints from the main loop (repair/revoke handling) and the
+    per-job thread at once, and an interleaved-mid-line ``[rank N]``
+    prefix would corrupt the daemon-side log forwarding the chaos
+    soak parses."""
 
     def __init__(self, f):
         self._f = f
+        self._wlock = threading.Lock()
 
     def retarget(self, f) -> None:
         """Re-aim at a NEW sink (adopted-worker stdio re-attach): the
         dead daemon's pipe is gone for good, so post-adoption output
         goes to the per-worker log file named in the restarted
         daemon's pidfile record instead of the bit bucket."""
-        self._f = f
+        with self._wlock:
+            self._f = f
 
     def write(self, s):
-        try:
-            return self._f.write(s)
-        except (OSError, ValueError):
-            return len(s)
+        with self._wlock:
+            try:
+                return self._f.write(s)
+            except (OSError, ValueError):
+                return len(s)
 
     def flush(self):
-        try:
-            self._f.flush()
-        except (OSError, ValueError):
-            pass
+        with self._wlock:
+            try:
+                self._f.flush()
+            except (OSError, ValueError):
+                pass
 
     def __getattr__(self, name):
         return getattr(self._f, name)
@@ -229,8 +240,15 @@ class DaemonLink:
             info = _state.read_pidfile(self.pidfile)
             alive = bool(info) and _state.pid_alive(
                 int(info.get("pid", 0)))
+            # a restarting daemon's provisional O_EXCL claim (live
+            # pid, no KVS address, the REAPED record's generation) is
+            # not re-attachable — keep parking for the full-record
+            # overwrite (found by the sigkill-restart soak: a worker
+            # polling inside the claim window died on KeyError('kvs')
+            # and the whole warm mesh cold-booted)
+            ready = alive and _state.pidfile_ready(info)
             gen = int((info or {}).get("generation", 0))
-            if alive and gen == self.generation:
+            if ready and gen == self.generation:
                 # transient socket break against the SAME daemon (it
                 # never lost us): plain re-dial, no adoption handshake
                 try:
@@ -240,7 +258,7 @@ class DaemonLink:
                     return
                 except OSError:
                     pass  # it may be dying; keep polling
-            elif alive and gen > self.generation:
+            elif ready and gen > self.generation:
                 try:
                     self._adopt(info, deadline)
                     return
@@ -372,7 +390,8 @@ def _revoke_quietly(job) -> None:
         pass
 
 
-def _run_job(api, world, link: DaemonLink, jd: dict, idx: int) -> None:
+def _run_job(api, world, link: DaemonLink, jd: dict, idx: int,
+             inflight: dict | None = None) -> None:
     import ompi_tpu.serve as serve
     from ompi_tpu.metrics import core as mcore
     from ompi_tpu.metrics import live
@@ -386,6 +405,10 @@ def _run_job(api, world, link: DaemonLink, jd: dict, idx: int) -> None:
     try:
         job = _job_comm(world, jd)
         rec["cid"] = int(job.cid)
+        if inflight is not None:
+            # expose the job comm to the main loop so a deadline
+            # ``revoke`` directive can poison it mid-collective
+            inflight["comm"] = job
         serve._set_current(dict(jd))
         live.set_job(jd["id"])
         api.push_world(job)
@@ -566,24 +589,80 @@ def _serve_loop(api, ctx, link: DaemonLink, current: dict,
             pass
         print(f"serve: resident worker up (proc {ctx.proc}/"
               f"{ctx.nprocs}, cursor {link.cursor})", flush=True)
+    # concurrent serving plane: each admitted job runs on its OWN
+    # thread so this loop keeps consuming directives mid-job — a
+    # deadline ``revoke`` for the running gang, a ``repair`` for a
+    # DISJOINT gang's dead rank (bystander-quiet: heals the base world
+    # without touching the in-flight job), retire/shutdown.  A worker
+    # proc is a member of at most one running gang at a time (the
+    # daemon's scheduler books whole procs), so one inflight slot is
+    # enough; the holder is written by both threads but every field
+    # update is a single dict store under the GIL and both readers
+    # tolerate staleness (a revoke for an already-finished job is a
+    # no-op, a join on a finished thread returns immediately).
+    inflight: dict = {"thread": None, "idx": None, "jd": None,
+                      "comm": None}
+
+    def _job_thread(jd: dict, idx: int, jworld) -> None:
+        try:
+            _run_job(api, jworld, link, jd, idx, inflight)
+        finally:
+            inflight["comm"] = None
+            inflight["jd"] = None
+
+    def _join_inflight() -> None:
+        # called with NO locks held (the lockorder pass treats an
+        # unbounded join under a lock as a blocking hazard)
+        t = inflight["thread"]
+        if t is not None:
+            t.join()
+        inflight["thread"] = None
+
     while True:
         idx, jd = link.wait_directive()
         kind = jd.get("kind", "job")
         if kind == "shutdown":
+            _join_inflight()  # full-house finalize fences all ranks
             if len(jd.get("procs", ())) == ctx.nprocs:
                 api.finalize()  # full house: the real fence + teardown
             else:
                 _teardown_resident(api, world)
             print("serve: shutdown", flush=True)
             return 0
+        if kind == "revoke":
+            # deadline escalation (serve_job_deadline_s): poison the
+            # named in-flight job's comm so its gang wakes out of any
+            # parked collective with MPIRevokedError — never a wedged
+            # gang — while concurrent disjoint gangs stay untouched
+            if ctx.proc in jd.get("procs", ()):
+                cur = inflight["jd"]
+                if cur is not None and cur.get("id") == jd.get("id"):
+                    print(f"serve: revoking job {jd.get('id')} "
+                          "(deadline)", flush=True)
+                    _revoke_quietly(inflight["comm"])
+                link.report(idx, {"ok": True, "revoked": jd.get("id")})
+            continue
         if kind == "repair":
             if ctx.proc in jd.get("procs", ()):
+                cur = inflight["jd"]
+                if cur is not None and (set(int(d) for d in
+                                            jd.get("dead", ()))
+                                        & set(int(p) for p in
+                                              cur.get("procs", ()))):
+                    # the in-flight gang lost a member: its script is
+                    # failing on the dead rank right now — let it close
+                    # out (revoke + completion record) before healing
+                    # the base world under it
+                    _join_inflight()
+                # bystander-quiet: a disjoint gang's job thread keeps
+                # running on its sub-comm while the base world heals
                 world = _repair(api, world, link, jd, idx,
                                 respawn_timeout)
                 current["world"] = world
             continue
         if kind == "retire":
             if ctx.proc in jd.get("retire", ()):
+                _join_inflight()
                 link.report(idx, {"ok": True, "retired": True})
                 _teardown_resident(api, world)
                 print("serve: retired", flush=True)
@@ -592,7 +671,16 @@ def _serve_loop(api, ctx, link: DaemonLink, current: dict,
                 link.report(idx, {"ok": True})
             continue
         if ctx.proc in jd.get("procs", ()):
-            _run_job(api, world, link, jd, idx)
+            _join_inflight()  # defensive: scheduler never double-books
+            world = current["world"]
+            inflight["idx"], inflight["jd"] = idx, jd
+            inflight["comm"] = None
+            t = threading.Thread(target=_job_thread,
+                                 args=(jd, idx, world),
+                                 name=f"serve-job-{jd['id']}",
+                                 daemon=True)
+            inflight["thread"] = t
+            t.start()
 
 
 if __name__ == "__main__":

@@ -522,6 +522,19 @@ def test_chaos_tool_selftest():
     assert b"selftest OK" in res.stdout
 
 
+def test_chaos_traffic_selftest():
+    """CI satellite (serving plane): the ``--traffic`` admission twin
+    — a workerless daemon driven through overlap, retry-budget replay,
+    deadline revoke, stall-ramp shedding and restore, all in-process —
+    must pass in tier-1."""
+    res = subprocess.run(
+        [sys.executable, str(CHAOS), "--traffic", "--selftest"],
+        capture_output=True, timeout=180, cwd=str(REPO))
+    assert res.returncode == 0, (res.stdout.decode(),
+                                 res.stderr.decode())
+    assert b"selftest OK" in res.stdout
+
+
 def test_tpurun_np2_chaos_soak_deterministic(tmp_path):
     """The acceptance soak: np=2 under tpurun --ft with a
     delay/dup/connkill/drop plan.  Asserts (a) no hang — the run

@@ -357,10 +357,15 @@ def test_native_latency_beats_python_floor():
 
     line = [l for l in out.splitlines() if "LATCMP " in l][0]
     r = json.loads(line.split("LATCMP ", 1)[1])
-    # native must win by a clear margin (r3 floor was 83-92 us; the
-    # python transport pays two Python thread handoffs per message)
-    assert r["native_us"] < r["python_us"], r
-    assert r["native_us"] < 60.0, r  # sanity ceiling, generous for CI
+    # native must win by a CLEAR margin (r3 floor was 83-92 us; the
+    # python transport pays two Python thread handoffs per message).
+    # Like-for-like only, per the docstring: the old absolute 60us
+    # ceiling was hostage to host drift — this shared-host box's
+    # native floor wanders 45-90us across hours, tripping the ceiling
+    # with the margin intact — so the criterion is the ratio, plus an
+    # order-of-magnitude insanity ceiling that no timeslice noise hits
+    assert r["native_us"] < 0.75 * r["python_us"], r
+    assert r["native_us"] < 500.0, r  # insanity ceiling only
 
 
 def test_tcp_leg_eager_and_rendezvous():

@@ -1,7 +1,13 @@
 """Persistent serving plane (tpud) tests.
 
 * queue/scheduler units — gang scheduling, FIFO + per-tenant
-  round-robin fairness, admission quotas, drain;
+  round-robin fairness, any-fit dispatch under ``serve_max_concurrent``,
+  admission quotas, drain, the telemetry-driven AdmissionController
+  (stall-delta streak → shed → one-clean-tick restore), retry budget;
+* admission edges over the REAL ops surface (workerless daemon,
+  step()-driven): shed 429 + Retry-After, drain 503 with the in-flight
+  job finishing, deadline revoke typed ``DeadlineExpired`` with a quiet
+  bystander, retry-budget exhaustion typed ``RetryBudgetExhausted``;
 * aggregator job scoping — per-job counter baselines (reset-in-place,
   keys survive), job-labeled series, /jobs bookkeeping;
 * api job scope — push_world/pop_world and the job-scoped finalize
@@ -88,6 +94,104 @@ def test_queue_admission_quota_and_drain():
     assert ei.value.status == 503
     st = q.state()
     assert st["draining"] and st["tenant_depth"]["t"] == 2
+
+
+def test_queue_anyfit_max_concurrent_and_hwm():
+    from ompi_tpu.serve.queue import JobQueue
+
+    q = JobQueue(4, max_pending=0)
+    wide = q.submit("wide.py", tenant="t", nprocs=4)
+    narrow = q.submit("narrow.py", tenant="t", nprocs=1)
+    # any-fit, not head-of-line: the 4-proc job parked at the head
+    # cannot start on 2 free procs, but the 1-proc job behind it can
+    r = q.next_runnable({2, 3})
+    assert r["id"] == narrow["id"] and r["procs"] == [2]
+    assert q.next_runnable({3}) is None  # nothing else fits
+    q.finish(narrow["id"], ok=True)
+    assert q.next_runnable({0, 1, 2, 3})["id"] == wide["id"]
+    q.finish(wide["id"], ok=True)
+    # hwm is a high-water mark: it survives the drain
+    assert q.counters["jobs_concurrent_hwm"] == 1 and q.idle()
+
+    # serve_max_concurrent bounds how many gangs overlap (0 = any fit)
+    q2 = JobQueue(4, max_pending=0, max_concurrent=1)
+    q2.submit("a.py", nprocs=1)
+    q2.submit("b.py", nprocs=1)
+    first = q2.next_runnable({0, 1, 2, 3})
+    assert first is not None
+    assert q2.next_runnable({1, 2, 3}) is None, "cap not enforced"
+    q2.finish(first["id"], ok=True)
+    assert q2.next_runnable({0, 1, 2, 3}) is not None
+    assert q2.counters["jobs_concurrent_hwm"] == 1
+
+
+def test_queue_retry_budget_and_exhaustion():
+    from ompi_tpu.serve.queue import JobQueue
+
+    q = JobQueue(2, max_pending=0, retry_budget=1)
+    j = q.submit("r.py", nprocs=2)
+    run = q.next_runnable({0, 1})
+    # repair-killed: one budget unit re-queues it, attempt state wiped
+    back = q.retry(run["id"])
+    assert back is not None and back["state"] == "queued"
+    assert back["retries"] == 1 and "procs" not in back
+    assert q.counters["jobs_retried"] == 1
+    run2 = q.next_runnable({0, 1})
+    assert run2["id"] == j["id"]
+    # budget consumed: the next repair kill is NOT re-queued — the
+    # caller finishes it failed with the typed error instead
+    assert q.retry(run2["id"]) is None
+    assert q.counters["jobs_retried"] == 1
+    assert q.retry("j999") is None  # unknown/not-running: no-op
+
+
+def test_admission_controller_streak_shed_and_restore():
+    from ompi_tpu.serve.queue import (AdmissionController, AdmissionError,
+                                      JobQueue)
+
+    # disabled (stall_ns=0): never trips, whatever the deltas
+    off = AdmissionController(stall_ns=0)
+    off.update({0: 10**12})
+    assert not off.overloaded() and not off.enabled()
+
+    ctrl = AdmissionController(stall_ns=1000)
+    q = JobQueue(2, max_pending=0, admission=ctrl)
+    j = q.submit("a.py", tenant="t", nprocs=1)
+    # the first sighting of a proc only establishes its delta baseline
+    ctrl.update({0: 10_000})
+    assert not ctrl.overloaded() and ctrl.state()["state"] == "ok"
+    # one over-threshold delta holds dispatch immediately (stalled)...
+    ctrl.update({0: 20_000}, cause="ring-stall")
+    assert ctrl.overloaded() and not ctrl.shedding()
+    assert q.next_runnable({0, 1}) is None, "dispatch not held"
+    st = ctrl.state()
+    assert st["state"] == "stalled" and st["cause"] == "ring-stall"
+    # ...and SUSTAIN consecutive ticks escalate to shedding
+    ctrl.update({0: 30_000}, cause="ring-stall")
+    ctrl.update({0: 40_000}, cause="ring-stall")
+    assert ctrl.shedding() and ctrl.state()["state"] == "shedding"
+    # tenants with work already in the system shed 429 + Retry-After
+    with pytest.raises(AdmissionError) as ei:
+        q.submit("b.py", tenant="t", nprocs=1)
+    assert ei.value.status == 429
+    assert ei.value.retry_after == ctrl.retry_after_s() == 3
+    assert "ring-stall" in str(ei.value)
+    assert q.counters["jobs_shed"] == 1
+    # ...but an idle tenant still gets one job in (fairness floor)
+    fresh = q.submit("c.py", tenant="fresh", nprocs=1)
+    with pytest.raises(AdmissionError):
+        q.submit("d.py", tenant="fresh", nprocs=1)
+    assert q.counters["jobs_shed"] == 2
+    # an unhealthy mesh counts as over-threshold even at zero delta
+    sick = AdmissionController(stall_ns=1000)
+    sick.update({}, healthy=False, cause="detector")
+    assert sick.overloaded() and sick.state()["unhealthy"]
+    # one clean (zero-delta, healthy) tick restores admission AND
+    # dispatch immediately; the held jobs go out
+    ctrl.update({0: 40_000})
+    assert not ctrl.overloaded() and ctrl.state()["state"] == "ok"
+    got = {q.next_runnable({0})["id"], q.next_runnable({1})["id"]}
+    assert got == {j["id"], fresh["id"]}
 
 
 def test_serving_vars_centrally_registered():
@@ -245,6 +349,119 @@ def test_pidfile_acquire_stale_reap_and_live_refusal(tmp_path):
     assert not os.path.exists(path)
 
 
+def test_worker_reattach_skips_restart_claim_window(tmp_path):
+    """Regression (found by the sigkill-restart soak): a parked worker
+    polling the pidfile while a restarting daemon holds only the
+    provisional O_EXCL claim ({pid, claiming, REAPED generation} — no
+    KVS address yet) must keep waiting for the full-record overwrite.
+    Pre-fix the claim matched the ``alive and gen == self.generation``
+    arm (its generation IS the dead predecessor's) and the worker died
+    on KeyError('kvs'), so the whole warm mesh cold-booted and the
+    in-flight job failed instead of surviving the restart."""
+    from ompi_tpu.serve import state as _state
+    from ompi_tpu.serve import worker as _worker
+
+    pidfile = str(tmp_path / "tpud.pid")
+    _state.write_pidfile(pidfile, {"pid": os.getpid(), "generation": 1,
+                                   "kvs": "gen1:0"})
+
+    class _KVS:
+        def __init__(self):
+            self.dials: list[str] = []
+            self.puts: dict[str, object] = {}
+
+        def reconnect(self, addr):
+            self.dials.append(addr)
+
+        def put(self, key, value):
+            self.puts[key] = value
+
+        def get(self, key, wait=True, timeout=30.0):
+            return {"pid": os.getpid(), "generation": 2}
+
+    class _Link(_worker.DaemonLink):
+        def _orphan_exit(self, reason):
+            raise SystemExit(reason)
+
+    ctx = types.SimpleNamespace(
+        kvs=_KVS(), proc=0, ns="t.", incarnation=0,
+        engine=types.SimpleNamespace(
+            transport=types.SimpleNamespace(address="w:1")))
+    os.environ[_worker.ENV_SERVE_PIDFILE] = pidfile
+    try:
+        link = _Link(ctx, wsize=1, poll=0.01, window=0.4)
+        assert link.generation == 1
+        # the restart claim: OUR live pid, claiming, the reaped
+        # record's generation, no kvs — never dialed, never fatal;
+        # with no overwrite the park window expires into orphan-exit
+        with open(pidfile, "w") as f:
+            f.write(json.dumps({"pid": os.getpid(), "claiming": True,
+                                "generation": 1}))
+        with pytest.raises(SystemExit) as ei:
+            link.reattach()
+        assert "serve_reattach_timeout" in str(ei.value)
+        assert ctx.kvs.dials == []
+        # claim overwritten mid-park by the full generation-2 record:
+        # the worker adopts it (re-dial + adopt offer + ack)
+        link = _Link(ctx, wsize=1, poll=0.01, window=10.0)
+
+        def _publish_full():
+            time.sleep(0.25)
+            _state.write_pidfile(pidfile, {"pid": os.getpid(),
+                                           "generation": 2,
+                                           "kvs": "gen2:0"})
+
+        t = threading.Thread(target=_publish_full)
+        t.start()
+        link.reattach()
+        t.join()
+        assert link.generation == 2
+        assert ctx.kvs.dials == ["gen2:0"]
+        offer = ctx.kvs.puts[f"{_worker.K_ADOPT}0"]
+        assert offer["pid"] == os.getpid() and offer["generation"] == 2
+    finally:
+        os.environ.pop(_worker.ENV_SERVE_PIDFILE, None)
+
+
+def test_agent_reattach_skips_restart_claim_window(tmp_path):
+    """The agent's park loop shares the claim-window hazard: its
+    same-generation arm dialed ``info['kvs']`` unguarded too."""
+    from ompi_tpu.serve import state as _state
+    from ompi_tpu.serve.agent import LaunchAgent
+
+    pidfile = str(tmp_path / "tpud.pid")
+
+    class _KVS:
+        def __init__(self):
+            self.dials: list[str] = []
+
+        def reconnect(self, addr):
+            self.dials.append(addr)
+
+    class _Agent:
+        _reattach = LaunchAgent._reattach
+        hid = 0
+        pidfile_ = None
+
+    ag = _Agent()
+    ag.pidfile = pidfile
+    ag.generation = 1
+    ag.window = 0.4
+    ag.kvs = _KVS()
+    # only the claim on disk: no dial, bounded exit at window expiry
+    with open(pidfile, "w") as f:
+        f.write(json.dumps({"pid": os.getpid(), "claiming": True,
+                            "generation": 1}))
+    with pytest.raises(SystemExit):
+        ag._reattach()
+    assert ag.kvs.dials == []
+    # full same-generation record: the plain re-dial arm takes it
+    _state.write_pidfile(pidfile, {"pid": os.getpid(), "generation": 1,
+                                   "kvs": "gen1:0"})
+    ag._reattach()
+    assert ag.kvs.dials == ["gen1:0"]
+
+
 def test_journal_replay_reconstructs_queue_cursor_and_cids(tmp_path):
     """The durable-job contract: submissions without a publish replay
     as queued, published-unfinished directives as outstanding (with
@@ -298,6 +515,111 @@ def test_journal_replay_reconstructs_queue_cursor_and_cids(tmp_path):
     j.close()
     st = Journal.replay(path)
     assert st["clean"] and not st["queued"] and st["cursor"] == 0
+
+
+def test_journal_replay_retry_event_exactly_once(tmp_path):
+    """The retry-budget hinge: ONE atomic ``retry`` line closes the
+    failed attempt's directive AND re-queues the job.  A crash BEFORE
+    the line replays the attempt as still outstanding (the retry
+    decision re-runs once after restart); a crash AFTER replays the
+    job queued exactly once — never misclassified done even though a
+    directive for it was both published and finished."""
+    from ompi_tpu.serve.state import Journal
+
+    path = str(tmp_path / "tpud.journal")
+    j = Journal(path)
+    job = {"id": "j1", "tenant": "t", "state": "queued", "submit_ns": 1}
+    j.append("submit", job=job)
+    j.append("publish", d={"idx": 0, "kind": "job", "id": "j1",
+                           "procs": [0, 1]})
+    # crash BEFORE the retry line: attempt outstanding, job running
+    st = Journal.replay(path)
+    assert [r["id"] for r in st["running"]] == ["j1"]
+    assert list(st["outstanding"]) == [0]
+    # the atomic retry line: directive closed + job re-queued
+    j.append("retry", idx=0, job=dict(job, retries=1))
+    st = Journal.replay(path)
+    assert not st["outstanding"] and not st["running"] and not st["done"]
+    assert [r["id"] for r in st["queued"]] == ["j1"]
+    assert st["queued"][0]["retries"] == 1
+    # compaction preserves the re-queued classification (the restart
+    # fixed point a SIGKILL-after-retry daemon recovers through)
+    Journal.compact(path, st)
+    st = Journal.replay(path)
+    assert [r["id"] for r in st["queued"]] == ["j1"] and not st["done"]
+    # the replayed attempt republishes at a new index and finishes
+    j = Journal(path)
+    j.append("publish", d={"idx": 1, "kind": "job", "id": "j1",
+                           "procs": [0, 1]})
+    j.append("finish", idx=1, kind="job",
+             job=dict(job, state="done", retries=1))
+    j.close()
+    st = Journal.replay(path)
+    assert [d["id"] for d in st["done"]] == ["j1"] and not st["queued"]
+
+
+def test_daemon_publishes_pidfile_beacon_kvs(tmp_path):
+    """Satellite: the daemon mirrors its pidfile record into the KVS
+    (``serve.pidfile.<generation>``) so agents on hosts WITHOUT the
+    daemon's filesystem can re-attach without reading daemon-local
+    disk."""
+    from ompi_tpu.serve import state as _state
+    from ompi_tpu.serve.daemon import K_PIDFILE, TpuDaemon
+
+    pidfile = str(tmp_path / "tpud.pid")
+    d = TpuDaemon(2, mca={"serve_pidfile": pidfile}, spawn=False)
+    try:
+        beacon = d.server.peek(f"{K_PIDFILE}{d.generation}")
+        assert beacon == _state.read_pidfile(pidfile), beacon
+        assert beacon["pid"] == os.getpid()
+        # the three addresses a re-attaching host needs
+        assert beacon["kvs"] and beacon["url"] and beacon["ingest"]
+    finally:
+        d.aggregator.close()
+        d.server.close()
+
+
+def test_agent_mirrors_pidfile_beacon(tmp_path):
+    """The agent half of the beacon: ``_mirror_beacon`` copies the KVS
+    record to the host-local pidfile path (workers there poll it as
+    usual), never rewrites an equal copy (shared filesystem), and
+    no-ops when the beacon is absent (older daemon) or no pidfile is
+    configured."""
+    from ompi_tpu.serve import state as _state
+    from ompi_tpu.serve.agent import LaunchAgent
+
+    rec = {"pid": 4242, "generation": 3, "url": "http://x", "kvs": "y"}
+    store = {"serve.pidfile.3": rec}
+
+    class _KVS:
+        def get(self, key, wait=False):
+            return store[key]  # raises KeyError when absent
+
+    class _Agent:
+        """Just the attributes ``_mirror_beacon`` reads."""
+
+        _beacon_gen = LaunchAgent._beacon_gen
+        _mirror_beacon = LaunchAgent._mirror_beacon
+
+        def __init__(self, pidfile, session, hid):
+            self.pidfile, self.session, self.hid = pidfile, session, hid
+            self.generation = 1
+            self.kvs = _KVS()
+
+    local = str(tmp_path / "mirror.pid")
+    ag = _Agent(local, "g3s1", 1)
+    ag._mirror_beacon()
+    assert _state.read_pidfile(local) == rec
+    assert ag.generation == 3  # adopts the beacon's generation
+    before = os.stat(local).st_mtime_ns
+    ag._mirror_beacon()  # equal copy: no rewrite
+    assert os.stat(local).st_mtime_ns == before
+    store.clear()  # beacon absent: the plain pidfile poll stands
+    ag2 = _Agent(str(tmp_path / "none.pid"), "g1s0", 2)
+    ag2._mirror_beacon()
+    assert not os.path.exists(ag2.pidfile)
+    ag3 = _Agent("", "g1s0", 3)
+    ag3._mirror_beacon()  # no pidfile configured: no-op
 
 
 def test_daemon_restart_recovery_and_readoption_in_process(tmp_path):
@@ -447,6 +769,160 @@ def test_tpud_ctl_dead_daemon_is_clean(tmp_path, capsys):
     assert "no-op" in capsys.readouterr().out
 
 
+# -- admission edges over the real ops surface (workerless daemon) -----
+
+
+def _pump_directives(d, stop):
+    """Resident-worker stand-in for a workerless daemon: per-proc
+    completion records for every published directive.  A job with
+    ``CHAOS_DIE=1`` dies with ``rank died`` records on EVERY attempt
+    (the retry-budget exhaustion path); ``CHAOS_HANG=1`` jobs answer
+    only their revoke (the deadline-expiry path)."""
+    from ompi_tpu.serve.daemon import K_DONE, K_JOB
+
+    hung: dict[str, tuple[int, list[int]]] = {}
+    n = 0
+    while not stop.is_set():
+        jd = d.server.peek(f"{K_JOB}{n}")
+        if jd is None:
+            time.sleep(0.005)
+            continue
+        kind = jd.get("kind", "job")
+        env = jd.get("env") or {}
+        if kind == "job" and env.get("CHAOS_DIE") == "1":
+            for p in jd.get("procs", ()):
+                d.server.put_local(f"{K_DONE}{n}.{p}",
+                                   {"ok": False, "proc": p,
+                                    "error": "rank died (injected)"})
+        elif kind == "job" and env.get("CHAOS_HANG") == "1":
+            hung[jd["id"]] = (n, list(jd.get("procs", ())))
+        elif kind == "revoke":
+            for p in jd.get("procs", ()):
+                d.server.put_local(f"{K_DONE}{n}.{p}",
+                                   {"ok": True, "proc": p,
+                                    "revoked": jd.get("id")})
+            hn, procs = hung.pop(jd.get("id"), (None, []))
+            if hn is not None:
+                for p in procs:
+                    d.server.put_local(
+                        f"{K_DONE}{hn}.{p}",
+                        {"ok": False, "proc": p,
+                         "error": "comm revoked mid-collective"})
+        else:
+            for p in jd.get("procs", ()):
+                d.server.put_local(f"{K_DONE}{n}.{p}",
+                                   {"ok": True, "proc": p})
+        n += 1
+
+
+def _steps_until(d, cond, what, deadline_s=20.0):
+    end = time.monotonic() + deadline_s
+    while not cond() and time.monotonic() < end:
+        d.step()
+        time.sleep(0.01)
+    assert cond(), f"daemon never converged: {what}"
+
+
+def test_daemon_shed_429_and_drain_503_in_process():
+    """Admission edges over the REAL ops HTTP surface (workerless
+    daemon, step()-driven): sustained stall ticks flip admission to
+    shedding — a busy tenant's submit is 429 with the Retry-After hint
+    surfaced by the client — dispatch is held while overloaded, one
+    clean tick restores, and ``/drain`` rejects NEW submits 503 while
+    the in-flight job still finishes."""
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve.daemon import K_DONE, K_JOB, TpuDaemon
+
+    d = TpuDaemon(2, mca={"serve_admission_stall_ns": "1000"},
+                  spawn=False)
+    try:
+        ctrl = d.queue.admission
+        j = client.submit(d.url, "a.py", tenant="t", nprocs=1)
+        # drive the controller the way _admission_update would: one
+        # baseline tick, then SUSTAIN over-threshold deltas
+        for k in range(4):
+            ctrl.update({0: (k + 1) * 10_000}, cause="arrival-skew")
+        assert ctrl.shedding()
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(d.url, "b.py", tenant="t", nprocs=1)
+        assert ei.value.status == 429
+        assert ei.value.retry_after == 3.0  # real Retry-After header
+        assert "arrival-skew" in str(ei.value)
+        st = client.status(d.url)
+        assert st["admission"]["state"] == "shedding", st["admission"]
+        assert st["counters"]["jobs_shed"] == 1
+        # dispatch held while overloaded: the queued job stays queued
+        d.step()
+        assert client.status(d.url, j["id"])["state"] == "queued"
+        # one clean tick restores; the held job dispatches
+        ctrl.update({0: 40_000})
+        assert client.status(d.url)["admission"]["state"] == "ok"
+        d.step()
+        jd = d.server.peek(K_JOB + "0")
+        assert jd["id"] == j["id"]
+        # drain while j is in flight: NEW submits refuse 503...
+        client.drain(d.url)
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(d.url, "c.py", tenant="u", nprocs=1)
+        assert ei.value.status == 503
+        # ...but the in-flight job still runs to completion
+        for p in jd["procs"]:
+            d.server.put_local(f"{K_DONE}0.{p}", {"ok": True, "proc": p})
+        _steps_until(
+            d, lambda: client.status(d.url, j["id"])["state"] == "done",
+            "in-flight job finishing under drain")
+    finally:
+        d.aggregator.close()
+        d.server.close()
+
+
+def test_daemon_deadline_revoke_and_retry_exhaustion_in_process():
+    """Deadline expiry revokes exactly the slow job — typed
+    ``DeadlineExpired`` on /job/<id>, the concurrently running
+    bystander job unperturbed — and a job repair-killed past its
+    retry budget fails with the typed ``RetryBudgetExhausted`` error
+    (never a wedged gang)."""
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve.daemon import TpuDaemon
+
+    d = TpuDaemon(2, mca={"serve_job_deadline_s": "0.3",
+                          "serve_retry_budget": "1"}, spawn=False)
+    stop = threading.Event()
+    threading.Thread(target=_pump_directives, args=(d, stop),
+                     daemon=True).start()
+    try:
+        jh = client.submit(d.url, "h.py", tenant="a", nprocs=1,
+                           env={"CHAOS_HANG": "1"})
+        jb = client.submit(d.url, "b.py", tenant="b", nprocs=1)
+        _steps_until(
+            d, lambda: client.status(d.url, jh["id"])["state"] == "failed",
+            "deadline expiry")
+        rec = client.status(d.url, jh["id"])
+        assert rec["error"].startswith("DeadlineExpired"), rec
+        assert "serve_job_deadline_s=0.3" in rec["error"], rec
+        # bystander quiet: the disjoint gang finished its job normally
+        assert client.status(d.url, jb["id"])["state"] == "done"
+        assert client.status(
+            d.url)["counters"]["jobs_deadline_expired"] == 1
+        # retry exhaustion: the job dies on BOTH attempts — one budget
+        # unit replays it, the second kill fails it typed
+        jr = client.submit(d.url, "r.py", tenant="a", nprocs=1,
+                           env={"CHAOS_DIE": "1"})
+        _steps_until(
+            d, lambda: client.status(d.url, jr["id"])["state"] == "failed",
+            "retry-budget exhaustion")
+        rec = client.status(d.url, jr["id"])
+        assert rec["error"].startswith("RetryBudgetExhausted"), rec
+        assert "rank died" in rec["error"], rec
+        assert int(rec.get("retries", 0)) == 1, rec
+        c = client.status(d.url)["counters"]
+        assert c["jobs_retried"] == 1 and c["jobs_deadline_expired"] == 1
+    finally:
+        stop.set()
+        d.aggregator.close()
+        d.server.close()
+
+
 # -- np=2 daemon acceptance --------------------------------------------
 
 
@@ -569,6 +1045,46 @@ def test_tpud_np2_two_tenants_warm_reuse_quota_and_drain():
                 if "OK SERVE_JOB" in l]) == 10, out
     assert len([l for l in out.splitlines()
                 if "resident worker up" in l]) == 2, out
+
+
+def test_tpud_np2_disjoint_tenant_jobs_overlap():
+    """The concurrency acceptance: two 1-proc jobs from different
+    tenants run AT THE SAME TIME on the warm np=2 mesh (any-fit gang
+    scheduling + per-job worker threads) — jobs_concurrent_hwm hits 2,
+    both complete bit-exact on disjoint ranks with flat dial counters
+    (isolation never re-dialed the transport), and /metrics exposes
+    the serving counters as ``proc="daemon"`` samples."""
+    from ompi_tpu.serve import client
+
+    d = _Tpud()
+    try:
+        ja = client.submit(d.url, str(JOB), tenant="alice", nprocs=1,
+                           env={"SERVE_SLEEP": "1.5"})
+        jb = client.submit(d.url, str(JOB), tenant="bob", nprocs=1,
+                           env={"SERVE_SLEEP": "1.5"})
+        ra = client.wait(d.url, ja["id"], timeout=120)
+        rb = client.wait(d.url, jb["id"], timeout=60)
+        assert ra["state"] == "done" and rb["state"] == "done", (ra, rb)
+        # truly concurrent: disjoint ranks, overlapping run windows,
+        # and the high-water mark proves both gangs were live at once
+        assert ra["procs"] != rb["procs"], (ra, rb)
+        assert (max(ra["start_ns"], rb["start_ns"])
+                < min(ra["end_ns"], rb["end_ns"])), (ra, rb)
+        st = client.status(d.url)
+        assert st["counters"]["jobs_concurrent_hwm"] == 2, st["counters"]
+        for r in (ra, rb):
+            for rec in r["ranks"].values():
+                assert rec["dials_before"] == rec["dials_after"], rec
+        text = _scrape(d.url, "/metrics")
+        assert "jobs_concurrent_hwm" in text, text[:2000]
+        assert 'proc="daemon"' in text, text[:2000]
+        client.shutdown(d.url)
+        assert d.proc.wait(timeout=60) == 0, d.out()
+    finally:
+        d.close()
+    out = d.out()
+    assert len([l for l in out.splitlines()
+                if "OK SERVE_JOB" in l]) == 2, out
 
 
 def test_tpud_np2_sigkill_daemon_restart_readopts_and_recovers(tmp_path):

@@ -121,6 +121,12 @@ _DEVICE_TAIL = (
 #: demote/promote/heal-probe counts (dcn/device.py PlaneHealth)
 _PLANE_TAIL = ("plane_demotions", "plane_promotions", "plane_heal_probes")
 
+#: serving-plane tail: the tpud daemon's job counters (serve/queue.py
+#: JobQueue; daemon-owned — the C block keeps zeroed slots so the name
+#: table stays the single schema truth; jobs_concurrent_hwm max-merges)
+_JOBS_TAIL = ("jobs_concurrent_hwm", "jobs_shed",
+              "jobs_deadline_expired", "jobs_retried")
+
 
 def test_stats_tail_appended_not_reordered():
     native = _native()
@@ -141,7 +147,9 @@ def test_stats_tail_appended_not_reordered():
     assert tuple(names[n2:n2 + len(_MODEX_TAIL)]) == _MODEX_TAIL
     n3 = n2 + len(_MODEX_TAIL)
     assert tuple(names[n3:n3 + len(_DEVICE_TAIL)]) == _DEVICE_TAIL
-    assert tuple(names[n3 + len(_DEVICE_TAIL):]) == _PLANE_TAIL
+    n4 = n3 + len(_DEVICE_TAIL)
+    assert tuple(names[n4:n4 + len(_PLANE_TAIL)]) == _PLANE_TAIL
+    assert tuple(names[n4 + len(_PLANE_TAIL):]) == _JOBS_TAIL
     assert mcore.NATIVE_STATS_VERSION == 1
     # gauges classified so monotonicity checks skip them
     assert {"stream_depth", "stream_inflight"} <= set(mcore.GAUGES)

@@ -11,10 +11,18 @@ Exercises exactly what warm reuse must keep correct:
 * ``api.finalize()`` ends the job, not the resident plane.
 
 Env knobs (set per job via the submit payload):
-  SERVE_ITERS      collectives to run (default 4)
-  SERVE_SLEEP      post-loop sleep seconds (queue-depth tests)
-  SERVE_KILL_RANK  job-world proc index that SIGKILLs itself at
-                   iteration 2 (elastic-plane acceptance; default off)
+  SERVE_ITERS       collectives to run (default 4)
+  SERVE_SLEEP       post-loop sleep seconds (queue-depth tests)
+  SERVE_ITER_SLEEP  per-iteration sleep seconds BEFORE each collective
+                    — a slow job that keeps re-entering the comm, so a
+                    deadline ``revoke`` lands on a live collective loop
+                    (not a terminal sleep it would never observe)
+  SERVE_KILL_RANK   job-world proc index that SIGKILLs itself at
+                    iteration 2 (elastic-plane acceptance; default off)
+  SERVE_KILL_FLAG   path making the kill one-shot: the proc touches
+                    the flag before dying, and a later attempt (the
+                    daemon's retry-budget replay of the same job spec,
+                    same env) sees it and runs clean
 """
 
 import os
@@ -36,11 +44,18 @@ p, n = world.proc, world.size
 job = serve.current_job() or {}
 iters = int(os.environ.get("SERVE_ITERS", "4"))
 kill = int(os.environ.get("SERVE_KILL_RANK", "-1"))
+kill_flag = os.environ.get("SERVE_KILL_FLAG", "")
 sleep_s = float(os.environ.get("SERVE_SLEEP", "0"))
+iter_sleep = float(os.environ.get("SERVE_ITER_SLEEP", "0"))
 
 for i in range(iters):
+    if iter_sleep:
+        time.sleep(iter_sleep)
     if p == kill and i == 2:
-        os.kill(os.getpid(), signal.SIGKILL)
+        if not (kill_flag and os.path.exists(kill_flag)):
+            if kill_flag:
+                open(kill_flag, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)
     out = world.allreduce(
         np.full((world.local_size, 4), float(i + 1)), SUM)
     assert float(np.asarray(out)[0][0]) == (i + 1) * n, (i, out)

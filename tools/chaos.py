@@ -36,6 +36,15 @@ Usage::
     # verifies the trajectory reproduces exactly
     python tools/chaos.py --planes --runs 2
 
+    # multi-tenant serving soak against one warm tpud: concurrent
+    # disjoint gangs (jobs_concurrent_hwm == np), p50/p99
+    # submit→first-collective, retry-budget replay of a repair-killed
+    # job (bystander dial-flat), deadline revoke, and a synthetic
+    # stall ramp flipping admission to shedding (429 + Retry-After)
+    # then restoring — the structural tally must reproduce across
+    # --runs; the serve_traffic leg lands in BENCH_DETAIL.json
+    python tools/chaos.py --traffic --runs 2
+
     # self-check (no subprocesses): plan parsing, decision
     # determinism, transport self-healing, disabled-path state,
     # hierarchical topology/takeover, versioned gossip, get_prefix +
@@ -750,6 +759,307 @@ def run_daemon_restart_soak(np_: int, seed: int, kill_at: int,
                     pass
 
 
+def _stall_injector(ingest: str, stop_evt):
+    """Feed a running daemon's OWN telemetry ingest a synthetic proc-9
+    stall ramp (+2 s of ring stall per frame, 5 ms cadence) — the
+    event-space analogue of a congested mesh.  The admission
+    controller folds it exactly like a real straggler feed, so the
+    soak trips shedding without slowing the real ranks."""
+    import socket as _socket
+    import threading
+
+    from ompi_tpu.metrics.live import _send_frame
+
+    def run():
+        try:
+            host, port = ingest.rsplit(":", 1)
+            s = _socket.create_connection((host, int(port)), timeout=2)
+        except (OSError, ValueError):
+            return
+        stall = 0
+        try:
+            while not stop_evt.is_set():
+                stall += 2_000_000_000
+                _send_frame(s, {"proc": 9, "nprocs": 2,
+                                "ts_ns": time.time_ns(),
+                                "native": {"ring_stall_ns": stall}})
+                time.sleep(0.005)
+        except OSError:
+            pass
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _bench_detail_merge(leg: str, payload: dict) -> None:
+    """Merge one leg into the repo-root BENCH_DETAIL.json (created on
+    first use; other legs are preserved)."""
+    path = os.path.join(REPO, "BENCH_DETAIL.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    if not isinstance(doc, dict):
+        doc = {}
+    doc[leg] = payload
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"BENCH_DETAIL.json: {leg} leg written")
+
+
+def run_traffic_soak(np_: int, seed: int, tenants: int, jobs_per: int,
+                     extra_mca: list[str], timeout: float) -> dict:
+    """The overload-safety headline under real multi-tenant traffic,
+    one warm mesh, four phases:
+
+    A. *overlap* — ``jobs_per`` nprocs=1 jobs per tenant in a seeded
+       interleave; the any-fit scheduler must run ``np`` of them
+       concurrently (``jobs_concurrent_hwm == np``) and every one
+       completes; p50/p99 submit→first-collective and the per-tenant
+       latency spread come from the job records' ``submit_ns`` /
+       per-rank ``t_start_ns`` stamps.
+    B. *churn* — a self-SIGKILLing job (one-shot via SERVE_KILL_FLAG)
+       is killed by mesh repair and replayed exactly once on the
+       retry budget while a concurrently running disjoint bystander
+       gang finishes dial-flat.
+    C. *deadline* — a slow collectives loop blows the per-job
+       deadline; the daemon revokes exactly its comm (typed
+       ``DeadlineExpired``) and the gang serves the next job at once.
+    D. *overload* — a synthetic stall ramp into the daemon's own
+       telemetry ingest flips admission to shedding: the idle-tenant
+       floor admits one probe, the next sheds (429 + Retry-After),
+       and cutting the ramp restores admission and drains the probes.
+
+    The structural tally (counts, states, booleans) is the
+    determinism contract under ``--runs``; latencies and the overlap
+    fraction are wall clock and reported only."""
+    import random
+    import tempfile
+    import threading
+
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve import state as _sstate
+
+    if tenants < 3:
+        raise SystemExit("--traffic needs --tenants >= 3")
+    tmp = tempfile.mkdtemp(prefix="tpud-traffic-")
+    pidfile = os.path.join(tmp, "tpud.pid")
+    journal = pidfile + ".journal"
+    names = [f"tenant{i}" for i in range(tenants)]
+    base_mca = {
+        "btl": "tcp",
+        "serve_pidfile": pidfile,
+        "serve_max_pending": "16",
+        # 0.5 s of fresh stall per monitor tick: far above anything
+        # the real nprocs=1 jobs can accrue, far below the ramp
+        "serve_admission_stall_ns": str(500_000_000),
+        "serve_job_deadline_s": "8",
+        "serve_retry_budget": "1",
+        "dcn_recv_timeout": "8",
+        "dcn_cts_timeout": "8",
+        "dcn_connect_timeout": "4",
+    }
+    for kv in extra_mca:
+        k, _, v = kv.partition("=")
+        base_mca[k] = v
+    t0 = time.time()
+    d = None
+    lines: list[str] = []
+    stop_inj = threading.Event()
+    try:
+        d, lines, url = _spawn_daemon(np_, base_mca)
+
+        def adm_state() -> str:
+            return str((client.status(url).get("admission") or {})
+                       .get("state", ""))
+
+        def start_ms(rec: dict) -> float:
+            return (min(int(rr["t_start_ns"])
+                        for rr in (rec.get("ranks") or {}).values())
+                    - int(rec.get("submit_ns", 0))) / 1e6
+
+        # -- phase A: overlap + latency --------------------------------
+        order = [(t, j) for j in range(jobs_per) for t in names]
+        random.Random(seed).shuffle(order)
+        submitted = [client.submit(url, JOB_WORKER, tenant=t, nprocs=1,
+                                   env={"SERVE_SLEEP": "1.0"})
+                     for t, _ in order]
+        stop_sample = threading.Event()
+        samples: list[int] = []
+
+        def sampler():
+            while not stop_sample.is_set():
+                try:
+                    samples.append(len(client.status(url)["running"]))
+                except client.ServeError:
+                    pass
+                time.sleep(0.05)
+
+        threading.Thread(target=sampler, daemon=True).start()
+        recs = [client.wait(url, j["id"], timeout=timeout)
+                for j in submitted]
+        stop_sample.set()
+        lat_ms = sorted(start_ms(rec) for rec in recs)
+        per_tenant_done = {t: 0 for t in names}
+        per_tenant_lat: dict[str, list[float]] = {t: [] for t in names}
+        for rec in recs:
+            per_tenant_done[rec["tenant"]] += int(rec["state"] == "done")
+            per_tenant_lat[rec["tenant"]].append(start_ms(rec))
+
+        # -- phase B: churn — repair-kill + retry, bystander flat ------
+        flag = os.path.join(tmp, "killed.flag")
+        by = client.submit(url, JOB_WORKER, tenant=names[1], nprocs=1,
+                           env={"SERVE_SLEEP": "3"})
+        jk = client.submit(url, JOB_WORKER, tenant=names[0], nprocs=1,
+                           env={"SERVE_KILL_RANK": "0",
+                                "SERVE_KILL_FLAG": flag})
+        rby = client.wait(url, by["id"], timeout=timeout)
+        rjk = client.wait(url, jk["id"], timeout=timeout)
+        bystander_flat = (rby["state"] == "done" and all(
+            rec["dials_before"] == rec["dials_after"]
+            for rec in rby["ranks"].values()))
+
+        # -- phase C: deadline expiry — revoke, typed, gang alive ------
+        jd_ = client.submit(url, JOB_WORKER, tenant=names[2], nprocs=1,
+                            env={"SERVE_ITERS": "200",
+                                 "SERVE_ITER_SLEEP": "0.4"})
+        rdead = client.wait(url, jd_["id"], timeout=timeout)
+        deadline_typed = str(rdead.get("error", "")).startswith(
+            "DeadlineExpired")
+        after = client.submit(url, JOB_WORKER, tenant=names[2],
+                              nprocs=1)
+        gang_alive = client.wait(
+            url, after["id"], timeout=timeout)["state"] == "done"
+
+        # -- phase D: overload — shed, then restore --------------------
+        info = _sstate.read_pidfile(pidfile) or {}
+        inj = _stall_injector(str(info.get("ingest", "")), stop_inj)
+        deadline_t = time.time() + 60
+        while adm_state() != "shedding" and time.time() < deadline_t:
+            time.sleep(0.05)
+        if adm_state() != "shedding":
+            sys.stderr.write("".join(lines))
+            raise SystemExit("stall ramp never tripped admission")
+        # the idle-tenant floor: one probe in, the next sheds.  A
+        # real zero-stall frame folding between two ramp frames can
+        # flicker the streak, so loop to the first true shed — every
+        # flicker-admitted probe must still drain after the restore
+        probes = [client.submit(url, JOB_WORKER, tenant="probe",
+                                nprocs=1)]
+        shed_err = None
+        deadline_t = time.time() + 60
+        while shed_err is None and time.time() < deadline_t:
+            try:
+                probes.append(client.submit(
+                    url, JOB_WORKER, tenant="probe", nprocs=1))
+                time.sleep(0.1)
+            except client.ServeError as e:
+                if e.status != 429 or e.retry_after is None:
+                    raise
+                shed_err = e
+        if shed_err is None:
+            sys.stderr.write("".join(lines))
+            raise SystemExit("shedding admission never returned 429")
+        stop_inj.set()
+        inj.join(timeout=5)
+        probe_recs = [client.wait(url, j["id"], timeout=timeout)
+                      for j in probes]
+        deadline_t = time.time() + 60
+        while adm_state() != "ok" and time.time() < deadline_t:
+            time.sleep(0.05)
+        restored = adm_state() == "ok"
+
+        st = client.status(url)
+        counters = {k: int(v)
+                    for k, v in (st.get("counters") or {}).items()}
+        client.shutdown(url)
+        rc = d.wait(timeout=60)
+        time.sleep(0.5)
+        orphans = [p for p in _journal_pids(journal)
+                   if _sstate.pid_alive(p)]
+        n_jobs = len(lat_ms)
+        fairness = {t: round(sum(v) / max(1, len(v)), 1)
+                    for t, v in per_tenant_lat.items()}
+        tally = {
+            # structural half — the determinism contract
+            "np": np_, "tenants": tenants,
+            "per_tenant_done": per_tenant_done,
+            "hwm": counters.get("jobs_concurrent_hwm", 0),
+            "bystander_flat": bystander_flat,
+            "retried": counters.get("jobs_retried", 0),
+            "retry_state": rjk["state"],
+            "retry_attempts": int(rjk.get("retries", 0)),
+            "deadline_expired": counters.get("jobs_deadline_expired",
+                                             0),
+            "deadline_state": rdead["state"],
+            "deadline_typed": deadline_typed,
+            "gang_alive_after_deadline": gang_alive,
+            "shed": counters.get("jobs_shed", 0),
+            "shed_429": shed_err.status == 429,
+            "shed_retry_after": float(shed_err.retry_after),
+            "probes_completed": all(r["state"] == "done"
+                                    for r in probe_recs),
+            "admission_restored": restored,
+            "shutdown_rc": rc, "orphans": len(orphans),
+            # wall-clock half — reported, excluded from the shape
+            "p50_ms": round(lat_ms[n_jobs // 2], 1),
+            "p99_ms": round(lat_ms[min(n_jobs - 1,
+                                       int(n_jobs * 0.99))], 1),
+            "overlap_frac": round(sum(1 for c in samples if c >= 2)
+                                  / max(1, len(samples)), 3),
+            "fairness_ms": fairness,
+            "probes": len(probes),
+            "wall_s": round(time.time() - t0, 1),
+        }
+        ok = (tally["hwm"] == min(np_, tenants * jobs_per)
+              and all(n == jobs_per
+                      for n in per_tenant_done.values())
+              and bystander_flat
+              and tally["retried"] == 1
+              and tally["retry_state"] == "done"
+              and tally["retry_attempts"] == 1
+              and tally["deadline_expired"] == 1
+              and tally["deadline_state"] == "failed"
+              and deadline_typed and gang_alive
+              and tally["shed"] == 1 and tally["probes_completed"]
+              and restored and rc == 0 and not orphans)
+        if not ok:
+            sys.stderr.write("".join(lines))
+            raise SystemExit(f"traffic soak failed: {tally}")
+        print(f"traffic soak: np={np_} tenants={tenants} "
+              f"jobs={len(recs) + len(probes) + 4} "
+              f"wall={time.time() - t0:.1f}s")
+        return tally
+    finally:
+        stop_inj.set()
+        if d is not None and d.poll() is None:
+            d.kill()
+        for p in _journal_pids(journal):
+            if _sstate.pid_alive(p):
+                try:
+                    os.kill(p, 9)
+                except OSError:
+                    pass
+
+
+#: the structural (event-space) half of the traffic tally — the
+#: --runs determinism contract; everything else is wall clock
+TRAFFIC_SHAPE_KEYS = (
+    "np", "tenants", "per_tenant_done", "hwm", "bystander_flat",
+    "retried", "retry_state", "retry_attempts", "deadline_expired",
+    "deadline_state", "deadline_typed", "gang_alive_after_deadline",
+    "shed", "shed_429", "shed_retry_after", "probes_completed",
+    "admission_restored", "shutdown_rc", "orphans")
+
+
 def _journal_pid_map(journal: str) -> dict[int, int]:
     """rank → last spawned pid, from the journal's spawn events."""
     pids: dict[int, int] = {}
@@ -1296,6 +1606,28 @@ def render_daemon_restart(tally: dict) -> None:
           f"{tally['orphans']}")
 
 
+def render_traffic(tally: dict) -> None:
+    print(f"  submit→start: p50 {tally['p50_ms']}ms "
+          f"p99 {tally['p99_ms']}ms   overlap: hwm {tally['hwm']} "
+          f"({tally['overlap_frac']:.0%} of phase-A samples with >=2 "
+          f"running)")
+    print("  per-tenant done: " + ", ".join(
+        f"{t}={n}" for t, n in sorted(tally["per_tenant_done"].items()))
+        + "   mean submit→start: " + ", ".join(
+        f"{t}={v}ms" for t, v in sorted(tally["fairness_ms"].items())))
+    print(f"  shed {tally['shed']} (429, retry-after "
+          f"{tally['shed_retry_after']}s; {tally['probes']} probes, "
+          f"all drained: {tally['probes_completed']})   retried "
+          f"{tally['retried']} -> {tally['retry_state']}   "
+          f"deadline-expired {tally['deadline_expired']} -> "
+          f"{tally['deadline_state']} (typed: {tally['deadline_typed']}"
+          f", gang alive: {tally['gang_alive_after_deadline']})")
+    print(f"  bystander flat dials: {tally['bystander_flat']}   "
+          f"admission restored: {tally['admission_restored']}   "
+          f"shutdown rc={tally['shutdown_rc']}   orphans: "
+          f"{tally['orphans']}")
+
+
 # -- selftest ----------------------------------------------------------
 
 
@@ -1609,6 +1941,176 @@ def selftest() -> int:
     return 0
 
 
+def traffic_selftest() -> int:
+    """In-process twin of ``--traffic`` (tier-1, no subprocesses): a
+    workerless daemon stepped by hand, a pump thread honoring the
+    worker contract (jobs acked per-proc; a CHAOS_DIE job dies once
+    with a ``rank died`` record; a CHAOS_HANG job answers only its
+    revoke), and the synthetic stall ramp through the REAL telemetry
+    ingest socket — overlap bookkeeping, retry-budget replay,
+    deadline revoke, shedding 429 + Retry-After over real HTTP, the
+    idle-tenant floor, and the one-clean-tick restore, all in
+    deterministic event space."""
+    import socket as _socket
+    import threading
+
+    from ompi_tpu.metrics.live import _send_frame
+    from ompi_tpu.serve import client
+    from ompi_tpu.serve.daemon import K_DONE, K_JOB, TpuDaemon
+
+    d = TpuDaemon(2, mca={"serve_admission_stall_ns": "1000000",
+                          "serve_retry_budget": "1",
+                          "serve_job_deadline_s": "0.3"},
+                  spawn=False)
+    stop = threading.Event()
+    died: set[str] = set()
+    hung: dict[str, tuple[int, list[int]]] = {}
+
+    def pump():
+        n = 0
+        while not stop.is_set():
+            jd = d.server.peek(f"{K_JOB}{n}")
+            if jd is None:
+                time.sleep(0.005)
+                continue
+            kind = jd.get("kind", "job")
+            env = jd.get("env") or {}
+            if (kind == "job" and env.get("CHAOS_DIE") == "1"
+                    and jd["id"] not in died):
+                died.add(jd["id"])
+                for p in jd.get("procs", ()):
+                    d.server.put_local(
+                        f"{K_DONE}{n}.{p}",
+                        {"ok": False, "proc": p,
+                         "error": "rank died (injected)"})
+            elif kind == "job" and env.get("CHAOS_HANG") == "1":
+                hung[jd["id"]] = (n, list(jd.get("procs", ())))
+            elif kind == "revoke":
+                for p in jd.get("procs", ()):
+                    d.server.put_local(
+                        f"{K_DONE}{n}.{p}",
+                        {"ok": True, "proc": p,
+                         "revoked": jd.get("id")})
+                hn, procs = hung.pop(jd.get("id"), (None, []))
+                if hn is not None:
+                    for p in procs:
+                        d.server.put_local(
+                            f"{K_DONE}{hn}.{p}",
+                            {"ok": False, "proc": p,
+                             "error": "comm revoked mid-collective"})
+            else:
+                for p in jd.get("procs", ()):
+                    d.server.put_local(f"{K_DONE}{n}.{p}",
+                                       {"ok": True, "proc": p})
+            n += 1
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def steps_until(cond, what: str, deadline_s: float = 20.0):
+        end = time.monotonic() + deadline_s
+        while not cond() and time.monotonic() < end:
+            d.step()
+            time.sleep(0.01)
+        assert cond(), f"selftest never converged: {what}"
+
+    def jstate(jid: str) -> str:
+        return str(client.status(d.url, jid)["state"])
+
+    try:
+        # 1. overlap: two disjoint nprocs=1 tenant jobs dispatch in
+        # the same any-fit pass — concurrency high-water hits np
+        ja = client.submit(d.url, "a.py", tenant="t0", nprocs=1)
+        jb = client.submit(d.url, "b.py", tenant="t1", nprocs=1)
+        steps_until(lambda: jstate(ja["id"]) == "done"
+                    and jstate(jb["id"]) == "done", "overlap jobs")
+        st = client.status(d.url)
+        assert st["counters"]["jobs_concurrent_hwm"] == 2, st["counters"]
+
+        # 2. retry budget: a died job is re-queued and replayed once
+        jr = client.submit(d.url, "r.py", tenant="t0", nprocs=1,
+                           env={"CHAOS_DIE": "1"})
+        steps_until(lambda: jstate(jr["id"]) == "done", "retried job")
+        one = client.status(d.url, jr["id"])
+        assert int(one.get("retries", 0)) == 1, one
+        assert client.status(
+            d.url)["counters"]["jobs_retried"] == 1
+
+        # 3. deadline: a hung job blows the 0.3 s deadline — revoke,
+        # typed DeadlineExpired; the concurrent bystander job finishes
+        jh = client.submit(d.url, "h.py", tenant="t2", nprocs=1,
+                           env={"CHAOS_HANG": "1"})
+        jq = client.submit(d.url, "q.py", tenant="t1", nprocs=1)
+        steps_until(lambda: jstate(jh["id"]) == "failed",
+                    "deadline expiry")
+        hrec = client.status(d.url, jh["id"])
+        assert str(hrec.get("error", "")).startswith(
+            "DeadlineExpired"), hrec
+        assert jstate(jq["id"]) == "done"
+        assert client.status(
+            d.url)["counters"]["jobs_deadline_expired"] == 1
+
+        # 4. overload: stall frames through the real ingest socket,
+        # one folded per hand-driven step — the first sighting of a
+        # proc only establishes its baseline (delta 0), then three
+        # over-threshold deltas sustain the streak into shedding
+        host, port = d.aggregator.ingest_address.rsplit(":", 1)
+        s = _socket.create_connection((host, int(port)), timeout=2)
+        stall = 0
+
+        def frame_landed(ts: int, val: int) -> bool:
+            f = d.aggregator.latest_frames().get(9) or {}
+            return (int(f.get("ts_ns", 0)) == ts and int(
+                (f.get("native") or {}).get("ring_stall_ns", 0)) == val)
+
+        for k in range(4):
+            stall += 1_000_000_000
+            _send_frame(s, {"proc": 9, "nprocs": 2, "ts_ns": k + 1,
+                            "native": {"ring_stall_ns": stall}})
+            end = time.monotonic() + 10
+            while (not frame_landed(k + 1, stall)
+                   and time.monotonic() < end):
+                time.sleep(0.005)
+            assert frame_landed(k + 1, stall), "ramp frame lost"
+            d.step()
+        st = client.status(d.url)
+        assert st["admission"]["state"] == "shedding", st["admission"]
+        # idle-tenant floor: a fresh tenant gets exactly one job in;
+        # the second sheds with the typed 429 + Retry-After
+        p1 = client.submit(d.url, "p1.py", tenant="fresh", nprocs=1)
+        try:
+            client.submit(d.url, "p2.py", tenant="fresh", nprocs=1)
+            raise AssertionError("shedding admitted a second job")
+        except client.ServeError as e:
+            assert e.status == 429 and e.retry_after == 3.0, (
+                e.status, e.retry_after)
+        assert client.status(d.url)["counters"]["jobs_shed"] == 1
+
+        # 5. restore: one clean (zero-delta) fresh frame re-opens
+        # admission; the held probe dispatches and drains
+        _send_frame(s, {"proc": 9, "nprocs": 2, "ts_ns": 99,
+                        "native": {"ring_stall_ns": stall}})
+        end = time.monotonic() + 10
+        while (not frame_landed(99, stall)
+               and time.monotonic() < end):
+            time.sleep(0.005)
+        d.step()
+        st = client.status(d.url)
+        assert st["admission"]["state"] == "ok", st["admission"]
+        steps_until(lambda: jstate(p1["id"]) == "done",
+                    "probe drain after restore")
+        s.close()
+        print("selftest OK: traffic admission twin — overlap hwm 2, "
+              "retry-budget replay (retries=1), deadline revoke "
+              "(typed DeadlineExpired, bystander done), stall-ramp "
+              "shedding (429 retry-after 3.0s, idle floor 1), "
+              "one-clean-tick restore + probe drained")
+        return 0
+    finally:
+        stop.set()
+        d.aggregator.close()
+        d.server.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--np", type=int, default=2, dest="np_")
@@ -1628,7 +2130,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="per-run hang deadline, seconds")
     ap.add_argument("--selftest", action="store_true",
-                    help="in-process self-check (no tpurun)")
+                    help="in-process self-check (no tpurun); with "
+                    "--traffic, the serving-plane admission twin")
+    ap.add_argument("--traffic", action="store_true",
+                    help="multi-tenant serving soak against one warm "
+                    "tpud: concurrent disjoint gangs (hwm == np), "
+                    "p50/p99 submit→first-collective, a repair-killed "
+                    "job replayed once on the retry budget (bystander "
+                    "gang dial-flat), a deadline expiry revoking "
+                    "exactly the slow job, and a synthetic stall ramp "
+                    "flipping admission to shedding (429+Retry-After) "
+                    "then restoring; writes the serve_traffic leg "
+                    "into BENCH_DETAIL.json")
+    ap.add_argument("--tenants", type=int, default=3,
+                    help="--traffic: tenant count (>= 3)")
+    ap.add_argument("--jobs", type=int, default=2,
+                    help="--traffic: overlap-phase jobs per tenant")
     ap.add_argument("--planes", action="store_true",
                     help="plane-failover soak: rank 0's device plane "
                     "is killed mid-allreduce (event-indexed injected "
@@ -1691,7 +2208,29 @@ def main(argv: list[str] | None = None) -> int:
                     "relays (telemetry_enable + telemetry_relay)")
     ns = ap.parse_args(argv)
     if ns.selftest:
-        return selftest()
+        return traffic_selftest() if ns.traffic else selftest()
+    if ns.traffic:
+        baseline = None
+        tally: dict = {}
+        for run in range(ns.runs):
+            tally = run_traffic_soak(ns.np_, ns.seed, ns.tenants,
+                                     ns.jobs, ns.mca, ns.timeout)
+            render_traffic(tally)
+            # the structural tally is the determinism contract
+            # (latencies and the overlap fraction are wall clock)
+            shape = {k: tally[k] for k in TRAFFIC_SHAPE_KEYS}
+            if baseline is None:
+                baseline = shape
+            elif shape != baseline:
+                raise SystemExit(
+                    f"DETERMINISM VIOLATION: run {run + 1} shape "
+                    f"{shape} != run 1 {baseline} (seed {ns.seed})")
+            elif ns.runs > 1:
+                print(f"run {run + 1}: traffic shed/retry/deadline "
+                      f"tally reproduces run 1 exactly "
+                      f"(seed {ns.seed})")
+        _bench_detail_merge("serve_traffic", tally)
+        return 0
     if ns.hosts:
         baseline = None
         for run in range(ns.runs):

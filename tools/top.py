@@ -95,6 +95,22 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               + (f"  ADOPTING {adopting}" if adopting else "")
               + ("  DRAINING" if daemon.get("draining") else ""),
               file=out)
+        jobs = daemon.get("jobs") or {}
+        if jobs:
+            # serving-plane line: gang concurrency (running now vs the
+            # high-water), the admission state with its blame cause,
+            # and the overload tallies (shed/retried/deadline-expired)
+            jc = jobs.get("counters") or {}
+            adm = jobs.get("admission") or {}
+            cause = adm.get("cause") or ""
+            print(f"jobs: running {jobs.get('running', 0)} "
+                  f"(hwm {jc.get('jobs_concurrent_hwm', 0)})  "
+                  f"admission {adm.get('state', 'ok')}"
+                  + (f" [{cause}]" if cause else "")
+                  + f"  shed {jc.get('jobs_shed', 0)} "
+                  f"retried {jc.get('jobs_retried', 0)} "
+                  f"deadline {jc.get('jobs_deadline_expired', 0)}",
+                  file=out)
         agents = daemon.get("agents") or {}
         if agents:
             # multi-host DVM line: one launch agent per remote host —
@@ -392,6 +408,12 @@ def selftest() -> int:
             "queued": 1, "outstanding": 2, "journal_depth": 3,
             "adopting": [1], "procs": {"0": "active", "1": "adopting"},
             "draining": False,
+            "jobs": {"running": 2,
+                     "counters": {"jobs_concurrent_hwm": 2,
+                                  "jobs_shed": 1, "jobs_retried": 1,
+                                  "jobs_deadline_expired": 0},
+                     "admission": {"state": "shedding",
+                                   "cause": "arrival-skew"}},
             "agents": {"1": {"host": "fakehostB", "status": "active",
                              "session": "g2s1", "ranks": [2, 3],
                              "pid": 777, "hb_age_ms": 321.0,
@@ -404,6 +426,10 @@ def selftest() -> int:
         assert ("daemon: pid 4242 gen 2 crash-safe" in dtext
                 and "journal 3" in dtext
                 and "ADOPTING [1]" in dtext), dtext
+        # serving-plane line: concurrency + admission + overload tallies
+        assert ("jobs: running 2 (hwm 2)  admission shedding "
+                "[arrival-skew]  shed 1 retried 1 deadline 0"
+                in dtext), dtext
         # multi-host DVM: the per-host agent-health line
         assert ("agents: h1(fakehostB) active 2/2w hb 321ms g2s1"
                 in dtext), dtext

@@ -64,7 +64,25 @@ def cmd_submit(url: str, ns) -> int:
 def cmd_status(url: str, ns) -> int:
     from ompi_tpu.serve import client
 
-    _out(client.status(url, ns.job_id))
+    st = client.status(url, ns.job_id)
+    if ns.job_id is None and "queued" in st:
+        # one-line ops summary ahead of the JSON: queue depth,
+        # per-tenant pending, concurrency high-water, overload tallies
+        c = st.get("counters") or {}
+        adm = st.get("admission") or {}
+        depth = st.get("tenant_depth") or {}
+        print(f"queue: {len(st.get('queued', []))} queued / "
+              f"{len(st.get('running', []))} running "
+              f"(concurrency hwm {c.get('jobs_concurrent_hwm', 0)}); "
+              f"admission {adm.get('state', 'ok')}: "
+              f"shed {c.get('jobs_shed', 0)}, "
+              f"retried {c.get('jobs_retried', 0)}, "
+              f"deadline-expired {c.get('jobs_deadline_expired', 0)}; "
+              "pending "
+              + (", ".join(f"{t}={n}"
+                           for t, n in sorted(depth.items()))
+                 or "none"))
+    _out(st)
     return 0
 
 
@@ -141,6 +159,19 @@ def selftest() -> int:
         # single-job status endpoint
         one = client.status(d.url, b1["id"])
         assert one["state"] == "done" and one["tenant"] == "bob", one
+        # ops-hygiene surface: the /jobs payload carries the serving
+        # counters and admission state, and `status` summarizes them
+        assert st["counters"]["jobs_concurrent_hwm"] >= 1, st["counters"]
+        assert st["admission"]["state"] == "ok", st["admission"]
+        import contextlib
+        import io
+        import types
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cmd_status(d.url, types.SimpleNamespace(job_id=None))
+        head = buf.getvalue().splitlines()[0]
+        assert "concurrency hwm" in head and "shed" in head, head
         # drain: no new admissions, then shutdown completes the loop
         client.drain(d.url)
         try:
