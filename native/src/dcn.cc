@@ -298,6 +298,12 @@ enum TdcnStatIdx {
   TS_JOBS_SHED,             // submits 429-shed by admission control
   TS_JOBS_DEADLINE_EXPIRED, // jobs revoked by serve_job_deadline_s
   TS_JOBS_RETRIED,          // jobs re-enqueued by the repair retry budget
+  // -- hang-diagnosis tail (appended; version stays 1) ----------------
+  // Mesh-doctor capture counters (Python-side provider,
+  // ompi_tpu/trace/waitgraph.py); zeroed slots here keep
+  // TDCN_STAT_NAMES the single source of schema truth.
+  TS_HANG_SNAPSHOTS,     // blocked-state snapshots taken (per rank)
+  TS_HANG_REPORTS,       // wait-graph reports solved/classified
   TS_COUNT
 };
 
@@ -320,7 +326,8 @@ static const char *TDCN_STAT_NAMES =
     "device_arb_device,device_arb_host,device_fallbacks,"
     "device_window_reclaimed,"
     "plane_demotions,plane_promotions,plane_heal_probes,"
-    "jobs_concurrent_hwm,jobs_shed,jobs_deadline_expired,jobs_retried";
+    "jobs_concurrent_hwm,jobs_shed,jobs_deadline_expired,jobs_retried,"
+    "hang_snapshots,hang_reports";
 
 struct alignas(64) TdcnStats {
   std::atomic<uint64_t> v[TS_COUNT];
@@ -342,6 +349,67 @@ struct alignas(64) TdcnStats {
     }
   }
 };
+
+// ---------------------------------------------------------------------
+// hang diagnosis: blocked-wait registry (the native half of
+// ompi_tpu/trace/waitgraph.py)
+// ---------------------------------------------------------------------
+//
+// Every C-side wait the Python planes cannot see — CTS grants, ring
+// backpressure, parked coll slots — registers itself here WHILE
+// blocked, so tdcn_waitinfo can mirror the engine's per-peer wait
+// state out on demand (≈ ORTE's report-state-on-timeout, applied to
+// the transport).  The registry is strictly cold-path: CTS and coll
+// waits register only once they are already in a condvar wait, and
+// ring reserve registers inside its first-failed-pass branch — the
+// happy path touches neither the gate nor the lock.  g_hang_diag
+// (tdcn_hang_diag, the hang_diag_enable MCA var) short-circuits
+// registration entirely when diagnosis is off.  Entries are keyed by
+// a token the waiter removes on every exit path, and carry the owning
+// engine as an opaque filter key so co-hosted engines (tpud) stay
+// separable.  g_hang_mu is a leaf lock: begin/end callers may hold
+// eng->mu or cts_mu, the reader resolves addresses only AFTER
+// releasing it.
+static std::atomic<uint32_t> g_hang_diag{1};
+
+enum HangWaitKind { HW_CTS = 0, HW_RING = 1, HW_COLL = 2 };
+static const char *HANG_KIND_NAMES[] = {"cts", "ring", "coll_recv"};
+
+struct HangWait {
+  int kind = 0;
+  std::string addr;  // awaited peer's composite address ("" if n/a)
+  int peer = -1;     // awaited ROOT proc index (-1: resolve from addr)
+  std::string cid;
+  int64_t seq = 0;
+  uint64_t t0 = 0;   // now_ns() at registration (monotonic)
+  void *eng = nullptr;
+};
+
+static std::mutex g_hang_mu;
+static std::map<uint64_t, HangWait> g_hang_waits;
+static uint64_t g_hang_next = 1;
+
+static uint64_t hang_wait_begin(void *eng, int kind, const char *addr,
+                                int peer, const char *cid, int64_t seq) {
+  if (!g_hang_diag.load(std::memory_order_relaxed) || !eng) return 0;
+  std::lock_guard<std::mutex> g(g_hang_mu);
+  uint64_t tok = g_hang_next++;
+  HangWait &w = g_hang_waits[tok];
+  w.kind = kind;
+  w.addr = addr ? addr : "";
+  w.peer = peer;
+  w.cid = cid ? cid : "";
+  w.seq = seq;
+  w.t0 = now_ns();
+  w.eng = eng;
+  return tok;
+}
+
+static void hang_wait_end(uint64_t tok) {
+  if (!tok) return;
+  std::lock_guard<std::mutex> g(g_hang_mu);
+  g_hang_waits.erase(tok);
+}
 
 // ---------------------------------------------------------------------
 // fault injection (the native leg of ompi_tpu/faultsim)
@@ -586,12 +654,17 @@ struct ShmRing {
   // clock and no stat.
   uint8_t *reserve(uint64_t need, uint64_t *rec_start,
                    std::atomic<bool> *closing, TdcnStats *stats = nullptr,
-                   uint64_t timeout_ns = 0) {
+                   uint64_t timeout_ns = 0, void *hang_eng = nullptr,
+                   const char *hang_addr = nullptr) {
     uint64_t spin = 0;
     uint64_t stall_t0 = 0;
     uint64_t give_up = 0;
+    uint64_t hang_tok = 0;
     for (;;) {
-      if (closing->load(std::memory_order_relaxed)) return nullptr;
+      if (closing->load(std::memory_order_relaxed)) {
+        hang_wait_end(hang_tok);
+        return nullptr;
+      }
       uint64_t tail0 = ctrl->tail.load(std::memory_order_acquire);
       uint8_t *w = try_reserve(need, rec_start);
       if (w) {
@@ -600,12 +673,17 @@ struct ShmRing {
           stats->add(TS_RING_STALL_NS, d);
           stats->add(TS_STALL_NS, d);
         }
+        hang_wait_end(hang_tok);
         return w;
       }
       if (!stall_t0) {
         stall_t0 = now_ns();
         if (stats) stats->add(TS_RING_STALLS, 1);
         if (timeout_ns) give_up = stall_t0 + timeout_ns;
+        // first failed pass = already the backpressure cold path:
+        // register the blocked wait for the mesh doctor
+        hang_tok = hang_wait_begin(hang_eng, HW_RING, hang_addr, -1,
+                                   nullptr, 0);
       } else if (give_up && now_ns() > give_up) {
         if (stats) {
           uint64_t d = now_ns() - stall_t0;
@@ -613,6 +691,7 @@ struct ShmRing {
           stats->add(TS_STALL_NS, d);
           stats->add(TS_DEADLINE_EXPIRED, 1);
         }
+        hang_wait_end(hang_tok);
         return nullptr;  // receiver wedged/dead: surface a send error
       }
       if (++spin < 64) {
@@ -2256,7 +2335,8 @@ static bool send_record_ring(Engine *eng, Peer *p, const WireHdr &h,
   uint64_t need = 8 + sizeof(WireHdr) + env_extra(h) + h.nbytes;
   uint64_t rec_start;
   uint8_t *w = p->tx_ring.reserve(need, &rec_start, &eng->closing,
-                                  &eng->stats, timeout_ns);
+                                  &eng->stats, timeout_ns, eng,
+                                  p->address.c_str());
   if (!w) return false;
   ring_put_record(eng, p, w, rec_start, need, h, e, payload);
   return true;
@@ -3044,6 +3124,11 @@ static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
     // the "rendezvous serialization" suspect of the osu_bw collapse;
     // account every wait so the stall breakdown can apportion it
     uint64_t t0 = now_ns();
+    // already the rendezvous dead-time path: register the blocked
+    // CTS wait (identity = peer address + op stream) for the mesh
+    // doctor before parking on the condvar
+    uint64_t htok = hang_wait_begin(eng, HW_CTS, p->address.c_str(), -1,
+                                    e.cid.c_str(), e.seq);
     std::unique_lock<std::mutex> g2(p->cts_mu);
     bool ok = cv_wait_for(p->cts_cv, g2, 600.0, [&] {
       // find, not operator[]: the predicate must not mutate the map
@@ -3053,6 +3138,7 @@ static int tcp_send_once(Engine *eng, Peer *p, Env &e, const void *data,
              eng->closing.load(std::memory_order_relaxed);
     });
     p->cts.erase(xid);
+    hang_wait_end(htok);
     uint64_t d = now_ns() - t0;
     eng->stats.add(TS_CTS_WAIT_NS, d);
     eng->stats.add(TS_STALL_NS, d);
@@ -3146,6 +3232,14 @@ static int coll_wait_msg(Engine *eng, const std::string &scid, int64_t seq,
     return 0;
   };
   slot->waiters++;
+  // mesh doctor: the message is not here yet — register the parked
+  // coll wait (the ready fast path above registers nothing).  The
+  // awaited peer is the watched root proc; `src` rides the seq/cid
+  // identity the Python solver keys edges on.
+  uint64_t htok = slot->ready.load()
+                      ? 0
+                      : hang_wait_begin(eng, HW_COLL, nullptr,
+                                        fail_proc, scid.c_str(), seq);
   bool ok = progress_wait(eng, g,
                           [&] {
                             return slot->ready.load() ||
@@ -3154,6 +3248,7 @@ static int coll_wait_msg(Engine *eng, const std::string &scid, int64_t seq,
                                    peer_failed() || aborted() != 0;
                           },
                           timeout_s);
+  hang_wait_end(htok);
   slot->waiters--;
   if (!ok || !slot->ready.load() || slot->consumed) {
     int rc = 1;
@@ -4847,6 +4942,67 @@ int tdcn_stats(void *h, uint64_t *out, int max_n) {
 // lets the Python reader and C tools agree on layout without
 // hardcoding, validated against out[0]'s version stamp.
 const char *tdcn_stats_names(void) { return TDCN_STAT_NAMES; }
+
+// Arm/disarm the hang-diagnosis wait registry (process-wide, mirrors
+// the hang_diag_enable MCA var; default on — registration is strictly
+// cold-path so a healthy run never reaches it).
+void tdcn_hang_diag(int on) {
+  g_hang_diag.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+// Mirror this engine's registered blocked waits out as a JSON array —
+// the introspection half of the mesh doctor (the TdcnStats snapshot
+// discipline applied to wait state: copy the live entries, no
+// quiescing).  Peer identity is resolved address→root-proc-index at
+// snapshot time (the addr table can gain entries after the wait
+// registered); unresolvable peers report -1 and the Python side keeps
+// the composite address.  Returns bytes written (0 = no waits or no
+// room); rows that do not fit in `cap` are dropped whole, never
+// truncated mid-object.
+int tdcn_waitinfo(void *h, char *out, int cap) {
+  Engine *eng = (Engine *)h;
+  if (!eng || !out || cap < 3) return 0;
+  std::vector<HangWait> rows;
+  {
+    std::lock_guard<std::mutex> g(g_hang_mu);
+    for (auto &kv : g_hang_waits)
+      if (kv.second.eng == (void *)eng) rows.push_back(kv.second);
+  }
+  if (rows.empty()) return 0;
+  uint64_t now = now_ns();
+  std::string s = "[";
+  for (const HangWait &w : rows) {
+    int peer = w.peer;
+    if (peer < 0 && !w.addr.empty()) {
+      std::lock_guard<std::mutex> g(eng->addr_mu);
+      for (size_t i = 0; i < eng->peer_addresses.size(); i++)
+        if (eng->peer_addresses[i] == w.addr) {
+          peer = (int)i;
+          break;
+        }
+    }
+    // cid strings are runtime-minted ("<cid>#cfp" etc.) but defend the
+    // JSON anyway: drop quote/backslash/control bytes
+    std::string cid;
+    for (char c : w.cid)
+      if (c >= 0x20 && c != '"' && c != '\\') cid.push_back(c);
+    char buf[320];
+    int n = snprintf(
+        buf, sizeof(buf),
+        "%s{\"site\":\"%s\",\"plane\":\"native\",\"peer\":%d,"
+        "\"cid\":\"%s\",\"seq\":%lld,\"age_ns\":%llu}",
+        s.size() > 1 ? "," : "", HANG_KIND_NAMES[w.kind], peer,
+        cid.c_str(), (long long)w.seq,
+        (unsigned long long)(now > w.t0 ? now - w.t0 : 0));
+    if (n <= 0 || n >= (int)sizeof(buf)) continue;
+    if ((int)(s.size() + n + 2) > cap) break;  // keep rows whole
+    s += buf;
+  }
+  s += "]";
+  if ((int)s.size() + 1 > cap || s.size() <= 2) return 0;
+  memcpy(out, s.c_str(), s.size() + 1);
+  return (int)s.size();
+}
 
 // Self-describing causal wire-context schema (version, then the
 // comma-joined field table) — the Python side validates its

@@ -57,6 +57,8 @@ extern uint64_t tdcn_post_recv(void *, const char *, int, int, int);
 extern int tdcn_req_wait(void *, uint64_t, double, TdcnMsg *);
 extern int tdcn_stats(void *, uint64_t *, int);
 extern const char *tdcn_stats_names(void);
+extern int tdcn_waitinfo(void *, char *, int);
+extern void tdcn_hang_diag(int);
 extern void tdcn_set_ring_timeout(void *, double);
 extern void tdcn_set_stream(void *, uint64_t, uint64_t, int);
 extern unsigned long long tdcn_chan_open(void *, const char *,
@@ -446,6 +448,18 @@ static void exercise_coll_revoke(void *a, void *b, const char *label) {
   std::thread park([&] { rc = tdcn_coll_start(a, pl, nullptr, nullptr); });
   struct timespec ts = {0, 300 * 1000000};
   nanosleep(&ts, nullptr);  // let it park (rank 1 never calls)
+  // blocked-state introspection smoke: the parked schedule receive
+  // must be visible to the mesh doctor while it waits (and the buffer
+  // contract — whole rows, NUL-terminated JSON — must hold under the
+  // sanitizers)
+  {
+    char winfo[2048];
+    int wn = tdcn_waitinfo(a, winfo, (int)sizeof(winfo));
+    CHECK(wn > 2 && winfo[0] == '[' && winfo[wn - 1] == ']',
+          "%s waitinfo shape n=%d", label, wn);
+    CHECK(wn <= 2 || strstr(winfo, "\"site\":\"coll_recv\"") != nullptr,
+          "%s waitinfo missing parked coll wait: %s", label, winfo);
+  }
   tdcn_coll_revoke_cid(a, "crev");
   park.join();
   CHECK(rc == -6, "%s revoke wake rc=%d", label, rc);

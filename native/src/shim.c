@@ -732,6 +732,7 @@ static void fp_req_done(fp_req_t *q) {
 
 extern int tdcn_stats(void *, unsigned long long *, int);
 extern const char *tdcn_stats_names(void);
+extern int tdcn_waitinfo(void *, char *, int);
 
 int tpumpi_transport_stats(unsigned long long *out, int max_n) {
   for (int h = 0; h < FP_HASH; h++) {
@@ -744,6 +745,18 @@ int tpumpi_transport_stats(unsigned long long *out, int max_n) {
 
 const char *tpumpi_transport_stats_names(void) {
   return tdcn_stats_names();
+}
+
+/* hang diagnosis re-export (the mesh doctor's C-ABI leg): mirror the
+ * process engine's registered blocked waits as JSON — same engine
+ * discovery as tpumpi_transport_stats, same no-plane → 0 contract. */
+int tpumpi_transport_waitinfo(char *out, int cap) {
+  for (int h = 0; h < FP_HASH; h++) {
+    if (g_fph[h] && g_fph[h] != FP_TOMB && g_fph[h]->state == 1 &&
+        g_fph[h]->eng)
+      return tdcn_waitinfo(g_fph[h]->eng, out, cap);
+  }
+  return 0;
 }
 
 /* test hook: live/condemned slot counts (soak tests pin no-leak) */

@@ -65,6 +65,12 @@ def init(mca_params: dict[str, str] | None = None) -> Comm:
     from ompi_tpu.trace import causal as _causal
 
     _causal.sync_from_store(ctx.store)
+    # hang diagnosis (--mca hang_diag_enable, default ON): arm the
+    # blocked-state registry before ProcContext so engine construction
+    # forwards the gate to the C wait registry (tdcn_hang_diag)
+    from ompi_tpu.trace import waitgraph as _waitgraph
+
+    _waitgraph.sync_from_store(ctx.store)
     # transport telemetry (--mca metrics_enable 1): the quantitative
     # leg — native DCN counters + per-op histograms + flight recorder;
     # synced before ProcContext so engine construction already counts

@@ -467,6 +467,10 @@ class MultiProcComm(PersistentP2PMixin):
             arm = getattr(req, "arm_remote_guard", None)
             if arm is not None:
                 arm(*self._remote_recv_guard(source, tag))
+                # hang diagnosis: tag the awaited peer's root proc so a
+                # blocked wait site can name it (waitgraph edge target)
+                req.wait_peer = self.dcn.root_proc_of(
+                    self.locate(source)[0])
         elif source is None:
             # opt-in bounded ANY_SOURCE wait (dcn_anysrc_timeout):
             # escalates to a communicator-wide liveness check instead

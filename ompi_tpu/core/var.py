@@ -411,6 +411,20 @@ OBSERVABILITY_VARS = (
      "batched frame per interval to the root aggregator, so the "
      "root's ingest socket sees O(groups) connections instead of "
      "O(P).  Off (default): every rank dials the root directly"),
+    ("hang", "", "diag_enable", True, "bool",
+     "Hang diagnosis (the mesh doctor): every Deadline-bounded wait "
+     "site registers its blocked identity (site, plane, awaited peer, "
+     "op key) lazily — only after a wait slice already expired — and "
+     "on-demand snapshots feed the cross-rank wait-graph solver "
+     "(GET /waitgraph, the tpud deadline hang report, trace_report.py "
+     "--hangs).  Default on: registration is cold-path only, so a "
+     "healthy run does zero extra work and ships zero extra wire "
+     "bytes; off drops even the slice-expiry bookkeeping"),
+    ("hang", "", "snapshot_timeout_ms", 2000, "int",
+     "Milliseconds the tpud deadline path waits for fresh per-rank "
+     "blocked-state snapshots (one telemetry interval usually "
+     "suffices) before assembling the pre-revoke hang report from "
+     "whatever frames it holds"),
 )
 
 

@@ -29,6 +29,7 @@ import numpy as np
 
 from ompi_tpu.op.op import Op
 from ompi_tpu.trace import causal as _causal
+from ompi_tpu.trace import waitgraph as _waitgraph
 from . import tcp as tcp_mod
 from .tcp import TcpTransport
 
@@ -151,6 +152,10 @@ class DcnCollEngine:
         from ompi_tpu.metrics import core as _mcore
 
         _mcore.register_clock_provider(self, self.clock_offsets)
+        # mesh doctor: transport-level waits (CTS grants, shm-ring
+        # backpressure) know only the peer's composite address — this
+        # resolver maps them back to root proc indices at snapshot time
+        _waitgraph.register_resolver(self, self._waitgraph_resolve)
 
     def set_addresses(self, addresses: Sequence[str]) -> None:
         if len(addresses) != self.nprocs:
@@ -173,6 +178,14 @@ class DcnCollEngine:
     @property
     def address(self) -> str:
         return self.transport.address
+
+    def _waitgraph_resolve(self, address: str) -> int | None:
+        """Composite address → root proc index for blocked-state
+        snapshots (None: not a peer of this engine — spawn worlds)."""
+        try:
+            return list.index(self.addresses, address)
+        except (ValueError, TypeError):
+            return None
 
     def register_p2p(self, cid: int, fn: Callable[[dict, np.ndarray], None]) -> None:
         """Route kind='p2p' frames carrying this cid to the given
@@ -538,6 +551,7 @@ class DcnCollEngine:
                 posted = True
         q = self._queue(key)
         dl = Deadline(timeout)
+        wtok = 0
         try:
             while True:
                 # short slices keep the wait sensitive to failure
@@ -548,6 +562,13 @@ class DcnCollEngine:
                     got = q.get(timeout=dl.slice(0.25))
                     break
                 except queue.Empty:
+                    # first missed slice = already blocked: register
+                    # the wait for the mesh doctor (lazy — a recv that
+                    # completes inside its first slice never pays)
+                    if not wtok and _waitgraph._enabled:
+                        wtok = _waitgraph.begin(
+                            "coll_recv", peer=self.root_proc_of(src),
+                            plane="host", cid=cid, seq=seq)
                     if self.proc_failed(src):
                         from ompi_tpu.core.errors import (
                             MPIProcFailedError,
@@ -570,6 +591,8 @@ class DcnCollEngine:
                             failed_rank=src, cid=str(cid), seq=int(seq),
                             src=int(src))
         finally:
+            if wtok:
+                _waitgraph.end(wtok)
             if posted:
                 # withdraw an unconsumed posting (frame raced ahead of
                 # the registration, or this wait errored out)

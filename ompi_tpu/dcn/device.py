@@ -88,6 +88,7 @@ import time
 import numpy as np
 
 from ompi_tpu.faultsim import core as _fsim
+from ompi_tpu.trace import waitgraph as _waitgraph
 
 #: semaphore word states (window header slot 0)
 SEM_EMPTY, SEM_DATA, SEM_CONSUMED = 0, 1, 2
@@ -725,14 +726,16 @@ class DevicePlane:
 
     # -- receiver: recv-semaphore wait + materialize --------------------
 
-    def receive(self, desc: dict, into: np.ndarray | None = None):
+    def receive(self, desc: dict, into: np.ndarray | None = None,
+                src_root: int | None = None):
         """Materialize one device-plane payload from its descriptor:
         attach the window, run the recv-semaphore wait, then land the
         bytes.  With a matching posted ``into`` buffer the window
         bytes go straight to it (on the real leg the DMA would target
         it; identity tells the caller nothing is left to copy).
         """
-        return receive(desc, into=into, stats=self.stats)
+        return receive(desc, into=into, stats=self.stats,
+                       src_root=src_root)
 
     # -- provider / lifecycle -------------------------------------------
 
@@ -807,8 +810,9 @@ def materialize(root_engine, desc: dict,
 
     dp = getattr(root_engine, "_device_plane", None)
     try:
-        return (dp.receive(desc, into=into) if dp is not None
-                else receive(desc, into=into))
+        return (dp.receive(desc, into=into, src_root=src_root)
+                if dp is not None
+                else receive(desc, into=into, src_root=src_root))
     except (DeadlineExpiredError, MPITruncateError) as e:
         cause = ("trunc" if isinstance(e, MPITruncateError)
                  else "deadline")
@@ -829,7 +833,7 @@ def materialize(root_engine, desc: dict,
 
 
 def receive(desc: dict, into: np.ndarray | None = None,
-            stats: dict | None = None):
+            stats: dict | None = None, src_root: int | None = None):
     """Receiver half of the device protocol: attach the descriptor's
     window, run the recv-semaphore wait (counted when it actually
     blocked), land the bytes (straight into a matching posted buffer
@@ -850,9 +854,18 @@ def receive(desc: dict, into: np.ndarray | None = None,
     try:
         if win.sem() < SEM_DATA:
             # the descriptor outran the DMA: this IS the semaphore
-            # wait the protocol exists for — count it
+            # wait the protocol exists for — count it (and register
+            # it with the mesh doctor: a stalled DMA is this plane's
+            # blocked-wait site)
             t0 = time.perf_counter_ns()
-            win.wait_data(Deadline.for_timeout("recv"))
+            wtok = (_waitgraph.begin("device_recv", peer=src_root,
+                                     plane="device", cid=name)
+                    if _waitgraph._enabled else 0)
+            try:
+                win.wait_data(Deadline.for_timeout("recv"))
+            finally:
+                if wtok:
+                    _waitgraph.end(wtok)
             if stats is not None:
                 stats["device_dma_waits"] += 1
                 stats["device_dma_wait_ns"] += (

@@ -149,6 +149,9 @@ def load_library():
         lib.tdcn_stats.argtypes = [P, ctypes.POINTER(ctypes.c_uint64), I]
         lib.tdcn_stats_names.restype = ctypes.c_char_p
         lib.tdcn_stats_names.argtypes = []
+        lib.tdcn_waitinfo.restype = I
+        lib.tdcn_waitinfo.argtypes = [P, ctypes.c_char_p, I]
+        lib.tdcn_hang_diag.argtypes = [I]
         lib.tdcn_trace_ctx_version.restype = I
         lib.tdcn_trace_ctx_version.argtypes = []
         lib.tdcn_trace_ctx_fields.restype = ctypes.c_char_p
@@ -675,6 +678,12 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         from ompi_tpu.metrics import straggler as _straggler
 
         _straggler.register_native(self, self.coll_optimes)
+        # mesh doctor: arm/disarm the C blocked-wait registry to match
+        # hang_diag_enable, and mirror it into blocked-state snapshots
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        self._lib.tdcn_hang_diag(1 if _waitgraph._enabled else 0)
+        _waitgraph.register_native(self, self.waitinfo)
         if _fsim._enabled:
             # arm the C fault hooks from the seeded plan: the ring
             # writer, the tcp-send connkill site, and the blocking-
@@ -1032,6 +1041,29 @@ class NativeDcnEngine(_NativeOpsMixin, DcnCollEngine):
         for k, v in self._py_stats.items():
             d[k] = d.get(k, 0) + v
         return d
+
+    def waitinfo(self) -> list[dict]:
+        """The C engine's registered blocked waits (tdcn_waitinfo),
+        decoded into blocked-state snapshot rows — same relaxed-copy
+        contract as stats_snapshot.  Empty when nothing is parked (the
+        overwhelmingly common case: one ctypes call, no allocation
+        C-side beyond the row scan)."""
+        if not self._running:
+            return []
+        buf = ctypes.create_string_buffer(16384)
+        n = self._lib.tdcn_waitinfo(self._h, buf, len(buf))
+        if n <= 2:
+            return []
+        try:
+            rows = json.loads(buf.value.decode("utf-8", "replace"))
+        except ValueError:
+            return []
+        for r in rows:
+            if r.get("peer", -1) is None or r.get("peer", -1) < 0:
+                r["peer"] = None
+            if not r.get("cid"):
+                r["cid"] = None
+        return rows
 
     # -- failure integration --------------------------------------------
 

@@ -64,6 +64,7 @@ import numpy as np
 
 from ompi_tpu.faultsim import core as _fsim
 from ompi_tpu.trace import core as _trace
+from ompi_tpu.trace import waitgraph as _waitgraph
 
 #: frame header: type byte, envelope len, meta len, raw (payload) len.
 #: raw length is 64-bit — protocol v2.
@@ -998,38 +999,53 @@ class TcpTransport:
         if timeout is None:
             timeout = dcn_timeout("cts")
         dl = Deadline(timeout)
-        while not ev.wait(timeout=dl.slice(1.0)):
-            if not self._running:
-                raise ConnectionError(
-                    "dcn rendezvous: transport closed while awaiting CTS"
-                )
-            # selectors (epoll/poll), not select(): fds >= FD_SETSIZE
-            # would make select() raise in fd-heavy processes.
-            # ValueError = the socket was closed under us (a concurrent
-            # sender's _invalidate_peer) — same meaning as peer death
-            try:
-                with selectors.DefaultSelector() as sel:
-                    sel.register(sock, selectors.EVENT_READ)
-                    readable = sel.select(timeout=0)
-            except (ValueError, OSError):
-                raise ConnectionError(
-                    f"dcn rendezvous: connection to {address} "
-                    "invalidated while awaiting CTS") from None
-            if readable:
-                try:
-                    dead = sock.recv(1, socket.MSG_PEEK) == b""
-                except OSError:
-                    dead = True
-                if dead:
+        wtok = 0
+        try:
+            while not ev.wait(timeout=dl.slice(1.0)):
+                if not wtok and _waitgraph._enabled:
+                    # one full slice without a grant = already the
+                    # rendezvous dead-time path: register the blocked
+                    # CTS wait for the mesh doctor (peer resolved from
+                    # the address at snapshot time)
+                    wtok = _waitgraph.begin("cts", addr=address,
+                                            plane="tcp")
+                if not self._running:
                     raise ConnectionError(
-                        f"dcn rendezvous: peer {address} died before CTS"
+                        "dcn rendezvous: transport closed while "
+                        "awaiting CTS"
                     )
-            if dl.expired():
-                self.stats["deadline_expired"] += 1
-                self._peer_dead(
-                    address,
-                    f"no CTS within dcn_cts_timeout={timeout}s "
-                    "(rendezvous peer wedged or dead)")
+                # selectors (epoll/poll), not select(): fds >=
+                # FD_SETSIZE would make select() raise in fd-heavy
+                # processes.  ValueError = the socket was closed under
+                # us (a concurrent sender's _invalidate_peer) — same
+                # meaning as peer death
+                try:
+                    with selectors.DefaultSelector() as sel:
+                        sel.register(sock, selectors.EVENT_READ)
+                        readable = sel.select(timeout=0)
+                except (ValueError, OSError):
+                    raise ConnectionError(
+                        f"dcn rendezvous: connection to {address} "
+                        "invalidated while awaiting CTS") from None
+                if readable:
+                    try:
+                        dead = sock.recv(1, socket.MSG_PEEK) == b""
+                    except OSError:
+                        dead = True
+                    if dead:
+                        raise ConnectionError(
+                            f"dcn rendezvous: peer {address} died "
+                            "before CTS"
+                        )
+                if dl.expired():
+                    self.stats["deadline_expired"] += 1
+                    self._peer_dead(
+                        address,
+                        f"no CTS within dcn_cts_timeout={timeout}s "
+                        "(rendezvous peer wedged or dead)")
+        finally:
+            if wtok:
+                _waitgraph.end(wtok)
         if not self._running:
             raise ConnectionError(
                 "dcn rendezvous: transport closed while awaiting CTS"
@@ -1121,11 +1137,24 @@ class _ShmRing:
         if deadline is None:
             deadline = Deadline.for_timeout("ring")
         sleep = 0.0
-        while self.size - (self.head - int(self._ctr[0])) < n:
-            deadline.check(
-                f"shm ring full for {n}-byte record: receiver stalled")
-            _time.sleep(sleep)
-            sleep = min(0.001, sleep + 0.00005)
+        wtok = 0
+        try:
+            while self.size - (self.head - int(self._ctr[0])) < n:
+                if not wtok and _waitgraph._enabled:
+                    # ring lacks space = already the backpressure cold
+                    # path: register the blocked wait for the mesh
+                    # doctor (peer_addr tagged by the owning transport)
+                    wtok = _waitgraph.begin(
+                        "ring", addr=getattr(self, "peer_addr", None),
+                        plane="shm")
+                deadline.check(
+                    f"shm ring full for {n}-byte record: receiver "
+                    f"stalled")
+                _time.sleep(sleep)
+                sleep = min(0.001, sleep + 0.00005)
+        finally:
+            if wtok:
+                _waitgraph.end(wtok)
         start = self.head
         pos = start % self.size
         first = min(n, self.size - pos)
@@ -1224,6 +1253,7 @@ class ShmTransport(TcpTransport):
                         f"{len(self._tx_rings)}-{id(self) & 0xffff:x}")
                 ring = _ShmRing(name, self.RING_SIZE, create=True)
                 ring.name = name
+                ring.peer_addr = address  # wait-identity tag (waitgraph)
                 self._tx_rings[address] = ring
             return ring
 

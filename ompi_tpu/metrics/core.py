@@ -125,6 +125,12 @@ NATIVE_COUNTERS = (
     # zeroed slots so TDCN_STAT_NAMES stays the single schema truth
     "jobs_concurrent_hwm", "jobs_shed", "jobs_deadline_expired",
     "jobs_retried",
+    # hang-diagnosis tail: blocked-state snapshots taken (on demand —
+    # telemetry frames, /waitgraph, crash exports) and cross-rank hang
+    # reports assembled by the wait-graph solver (trace/waitgraph.py,
+    # which owns the Python provider); the C block keeps zeroed slots
+    # so TDCN_STAT_NAMES stays the single schema truth
+    "hang_snapshots", "hang_reports",
 )
 
 #: counters that are gauges (instantaneous), not monotone totals —
@@ -425,6 +431,14 @@ def snapshot(reason: str = "periodic", proc: int | None = None) -> dict:
         # {proc: [offset_ns, rtt_ns]} — the correlate/merge tools read
         # this to align cross-rank timelines against host clock skew
         snap["clock"] = {str(p): [o, r] for p, (o, r) in clock.items()}
+    from ompi_tpu.trace import waitgraph as _waitgraph
+
+    if _waitgraph._enabled:
+        w = _waitgraph.snapshot(stacks=False)
+        if w.get("waits"):
+            # blocked-wait sites at snapshot time: crash exports carry
+            # them so trace_report --hangs can diagnose post-mortem
+            snap["waits"] = w["waits"]
     return snap
 
 

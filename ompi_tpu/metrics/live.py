@@ -127,6 +127,10 @@ class TelemetryAggregator:
         self._pending_dropped = 0
         #: per-proc rolling attribution
         self._scores: dict[int, dict] = {}
+        #: per-proc activity watermark: (native-counter total, ts_ns of
+        #: the last frame that CHANGED it) — the RUNNING/IDLE half of
+        #: the per-rank state brief (BLOCKED comes from ``waits``)
+        self._act: dict[int, tuple[int, int]] = {}
         #: per-op cross-rank skew totals
         self._op_skew: dict[str, dict] = {}
         #: causal-tracing join (trace/causal.py): staged per-rank
@@ -223,6 +227,9 @@ class TelemetryAggregator:
                     ctype = "application/json"
                 elif self.path.startswith("/jobs"):
                     body = json.dumps(agg.jobs_state()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/waitgraph"):
+                    body = json.dumps(agg.waitgraph_state()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/history"):
                     with agg._lock:
@@ -344,6 +351,10 @@ class TelemetryAggregator:
             self.frames += 1
             self._latest[proc] = frame
             self._history.append(frame)
+            tot = sum(int(v) for v in (frame.get("native") or {}).values())
+            prev = self._act.get(proc)
+            if prev is None or tot != prev[0]:
+                self._act[proc] = (tot, int(frame.get("ts_ns", 0)))
             job = frame.get("job")
             if job is not None:
                 st = self._jobs_seen.setdefault(
@@ -518,6 +529,53 @@ class TelemetryAggregator:
             "dropped": dropped,
         }
 
+    # -- hang diagnosis (trace/waitgraph.py solver over live frames) ----
+
+    def waitgraph_state(self) -> dict:
+        """The ``GET /waitgraph`` feed: cross-rank wait-for graph +
+        hang classification assembled from the latest per-rank
+        blocked-state snapshots (the frames' ``waits`` field), plus
+        the per-rank state brief tools/top.py renders."""
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        with self._lock:
+            latest = {p: f for p, f in self._latest.items()}
+            nprocs = self._nprocs
+            states = self._rank_states(latest)
+        failed: set[int] = set()
+        for f in latest.values():
+            failed.update(int(x) for x in (f.get("failed") or ()))
+        snaps = {p: f["waits"] for p, f in latest.items()
+                 if f.get("waits")}
+        graph = _waitgraph.build_graph(snaps, failed=sorted(failed))
+        return {
+            "nprocs": nprocs,
+            "reporting": sorted(snaps),
+            "states": states,
+            "graph": graph,
+            "verdict": _waitgraph.classify(graph),
+        }
+
+    def _rank_states(self, latest: dict[int, dict]) -> dict[str, str]:
+        """Under the lock: per-rank RUNNING / BLOCKED:site→peer / IDLE.
+        A frame carrying a blocked-state snapshot is BLOCKED on its
+        oldest wait; otherwise the activity watermark decides — native
+        counters that moved in the newest frame mean RUNNING, a frame
+        that changed nothing means IDLE."""
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        states: dict[str, str] = {}
+        for p, f in latest.items():
+            waits = (f.get("waits") or {}).get("waits")
+            if waits:
+                states[str(p)] = "BLOCKED:" + _waitgraph.wait_brief(waits)
+                continue
+            _tot, ts = self._act.get(p, (0, 0))
+            states[str(p)] = ("RUNNING"
+                              if ts and ts == int(f.get("ts_ns", 0))
+                              else "IDLE")
+        return states
+
     def _attribute(self, key: str, arrivals: dict[int, int]) -> None:
         """One fully-joined collective instance → the rolling tables."""
         slowest, skews = _straggler.instance_skew(arrivals)
@@ -573,6 +631,7 @@ class TelemetryAggregator:
                 "relays": {"batches": self.batches,
                            "groups": sorted(self._relays)},
                 "critical": self._critical_brief(),
+                "waitgraph": self._rank_states(self._latest),
             }
 
     def _critical_brief(self) -> dict:
@@ -933,6 +992,13 @@ class TelemetryPublisher:
                 by_reason[r.get("reason", "?")] = by_reason.get(
                     r.get("reason", "?"), 0) + 1
             f["flight"] = by_reason
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        if _waitgraph._enabled and _waitgraph.busy():
+            # blocked-state snapshot: only a rank that actually holds a
+            # registered wait adds the field — an idle or disabled rank
+            # ships zero extra wire bytes (the /waitgraph feed)
+            f["waits"] = _waitgraph.snapshot()
         return f
 
     def _run(self) -> None:

@@ -37,6 +37,7 @@ from ompi_tpu.metrics import core as _metrics
 from ompi_tpu.request import Request
 from ompi_tpu.tool import spc
 from ompi_tpu.trace import core as _trace
+from ompi_tpu.trace import waitgraph as _waitgraph
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -141,15 +142,27 @@ class RecvRequest(Request):
 
         timeout, check, escalate = self._guard
         dl = Deadline(timeout)
-        while not self._event.wait(dl.slice(0.25)):
-            check()
-            if dl.expired():
-                escalate(timeout)
-                # escalate returning (not raising) means it chose to
-                # keep waiting — the ANY_SOURCE liveness guard with
-                # every member alive; re-arm so the wait does not
-                # degenerate into a 1 ms busy spin on an expired clock
-                dl = Deadline(timeout)
+        wtok = 0
+        try:
+            while not self._event.wait(dl.slice(0.25)):
+                # hang diagnosis: one full slice without delivery is a
+                # blocked wait — register lazily (first failed slice)
+                if not wtok and _waitgraph._enabled:
+                    wtok = _waitgraph.begin(
+                        "p2p_recv",
+                        peer=getattr(self, "wait_peer", None),
+                        plane="host")
+                check()
+                if dl.expired():
+                    escalate(timeout)
+                    # escalate returning (not raising) means it chose to
+                    # keep waiting — the ANY_SOURCE liveness guard with
+                    # every member alive; re-arm so the wait does not
+                    # degenerate into a 1 ms busy spin on an expired clock
+                    dl = Deadline(timeout)
+        finally:
+            if wtok:
+                _waitgraph.end(wtok)
 
     def _finalize(self) -> Any:
         return self._payload

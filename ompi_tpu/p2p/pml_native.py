@@ -30,6 +30,7 @@ import numpy as np
 from ompi_tpu.core.errors import MPIArgError, MPIRankError
 from ompi_tpu.request import Request
 from ompi_tpu.tool import spc
+from ompi_tpu.trace import waitgraph as _waitgraph
 from .pml import (
     ANY_SOURCE,
     ANY_TAG,
@@ -96,25 +97,37 @@ class NativeRecvRequest(Request):
             if self._msg is not None:
                 return
             msg = TdcnMsg()
-            while True:
-                rc = self._root._lib.tdcn_req_wait(
-                    self._root._h, self._rid, 0.25, ctypes.byref(msg))
-                if rc == 0:
-                    self._take(msg)
-                    return
-                if rc == _RC_CLOSED or rc < 0:
-                    from ompi_tpu.core.errors import MPIInternalError
+            wtok = 0
+            try:
+                while True:
+                    rc = self._root._lib.tdcn_req_wait(
+                        self._root._h, self._rid, 0.25, ctypes.byref(msg))
+                    if rc == 0:
+                        self._take(msg)
+                        return
+                    if rc == _RC_CLOSED or rc < 0:
+                        from ompi_tpu.core.errors import MPIInternalError
 
-                    raise MPIInternalError(
-                        f"native recv wait failed (rc={rc})")
-                if dl is not None:
-                    _timeout, check, escalate = self._guard
-                    check()
-                    if dl.expired():
-                        escalate(_timeout)
-                        # escalate returning = keep waiting (anysrc
-                        # liveness guard, all members alive): re-arm
-                        dl = Deadline(_timeout)
+                        raise MPIInternalError(
+                            f"native recv wait failed (rc={rc})")
+                    # hang diagnosis: a timed-out wait slice means the
+                    # request is blocked — register lazily (once)
+                    if not wtok and _waitgraph._enabled:
+                        wtok = _waitgraph.begin(
+                            "p2p_recv",
+                            peer=getattr(self, "wait_peer", None),
+                            plane="native")
+                    if dl is not None:
+                        _timeout, check, escalate = self._guard
+                        check()
+                        if dl.expired():
+                            escalate(_timeout)
+                            # escalate returning = keep waiting (anysrc
+                            # liveness guard, all members alive): re-arm
+                            dl = Deadline(_timeout)
+            finally:
+                if wtok:
+                    _waitgraph.end(wtok)
 
     def _finalize(self):
         return self._msg
@@ -259,45 +272,60 @@ class NativeMatchingEngine:
 
             anysrc_guard = guard
             dl = Deadline(guard[0])
-        while True:
-            if into is not None:
-                rc = root._lib.tdcn_precv_into(
-                    root._h, self._cid_b, dest, source, tag, fail_proc,
-                    dl.slice(2.0) if dl is not None else 120.0,
-                    into_ptr, into_cap, _tls.msg_ref)
-            else:
-                rc = root._lib.tdcn_precv(
-                    root._h, self._cid_b, dest, source, tag, fail_proc,
-                    dl.slice(2.0) if dl is not None else 120.0,
-                    _tls.msg_ref)
-            if rc == 0:
-                break
-            if rc == -2:
-                from ompi_tpu.core.errors import MPIProcFailedError
+        wtok = 0
+        try:
+            while True:
+                if into is not None:
+                    rc = root._lib.tdcn_precv_into(
+                        root._h, self._cid_b, dest, source, tag, fail_proc,
+                        dl.slice(2.0) if dl is not None else 120.0,
+                        into_ptr, into_cap, _tls.msg_ref)
+                else:
+                    rc = root._lib.tdcn_precv(
+                        root._h, self._cid_b, dest, source, tag, fail_proc,
+                        dl.slice(2.0) if dl is not None else 120.0,
+                        _tls.msg_ref)
+                if rc == 0:
+                    break
+                if rc == -2:
+                    from ompi_tpu.core.errors import MPIProcFailedError
 
-                raise MPIProcFailedError(
-                    f"recv: peer rank {source} failed",
-                    failed=(source,))
-            if rc < 0:
-                from ompi_tpu.core.errors import MPIInternalError
+                    raise MPIProcFailedError(
+                        f"recv: peer rank {source} failed",
+                        failed=(source,))
+                if rc < 0:
+                    from ompi_tpu.core.errors import MPIInternalError
 
-                raise MPIInternalError(f"native recv failed (rc={rc})")
-            if dl is not None and dl.expired():
-                if anysrc_guard is not None:
-                    from ompi_tpu.core.var import Deadline
+                    raise MPIInternalError(f"native recv failed (rc={rc})")
+                # hang diagnosis: one expired C wait slice without a
+                # match — register the blocked site lazily (once).
+                # precv parks inside the C call, which does not hit the
+                # engine's own wait registry, so this is the only
+                # introspection point for the native p2p plane.
+                if not wtok and _waitgraph._enabled:
+                    wtok = _waitgraph.begin(
+                        "p2p_recv",
+                        peer=fail_proc if fail_proc >= 0 else None,
+                        plane="native", cid=self._cid)
+                if dl is not None and dl.expired():
+                    if anysrc_guard is not None:
+                        from ompi_tpu.core.var import Deadline
 
-                    _t, g_check, g_escalate = anysrc_guard
-                    g_check()
-                    g_escalate(_t)
-                    dl = Deadline(_t)  # all alive: re-arm the wait
-                    continue
-                root._escalate_deadline(
-                    "p2p_recv", dl.seconds,
-                    f"recv deadline (dcn_recv_timeout={dl.seconds}s) "
-                    f"expired: rank {dest} waiting for rank {source} "
-                    f"(tag={tag}) — peer dead, wedged, or send never "
-                    f"issued", failed_rank=source, root_proc=fail_proc,
-                    src=int(source), tag=int(tag))
+                        _t, g_check, g_escalate = anysrc_guard
+                        g_check()
+                        g_escalate(_t)
+                        dl = Deadline(_t)  # all alive: re-arm the wait
+                        continue
+                    root._escalate_deadline(
+                        "p2p_recv", dl.seconds,
+                        f"recv deadline (dcn_recv_timeout={dl.seconds}s) "
+                        f"expired: rank {dest} waiting for rank {source} "
+                        f"(tag={tag}) — peer dead, wedged, or send never "
+                        f"issued", failed_rank=source, root_proc=fail_proc,
+                        src=int(source), tag=int(tag))
+        finally:
+            if wtok:
+                _waitgraph.end(wtok)
         if msg.pyhandle:
             payload = root.take_handle(msg.pyhandle)
             count, nbytes = int(msg.count), int(msg.nbytes)

@@ -209,8 +209,17 @@ class TpuDaemon:
 
         _mcore.register_provider(
             self.queue, lambda q=self.queue: dict(q.counters))
-        self.aggregator.extra_counters = (
-            lambda q=self.queue: dict(q.counters))
+        self.aggregator.extra_counters = self._daemon_counters
+        # hang diagnosis in the DAEMON process (the pre-revoke report
+        # on the deadline path + the /metrics hang_* families): same
+        # launcher-process knob resolution the faultsim gate uses
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        hd = self._opt("hang_diag_enable")
+        _waitgraph.sync_from_store(
+            {"hang_diag_enable": True if hd == "" else _truthy(hd)})
+        self._hang_timeout_s = max(0.0, float(
+            self._opt("hang_snapshot_timeout_ms") or 2000) / 1000.0)
         self._mount_routes()
         #: next directive index (the job-stream cursor)
         self.cursor = 0
@@ -881,6 +890,49 @@ class TpuDaemon:
         return (int(pid) if pid is not None
                 else self._adopt_pids.get(r))
 
+    def _daemon_counters(self) -> dict:
+        """The aggregator's /metrics host-process extension
+        (``proc="daemon"`` samples): the queue's serving counters plus
+        the daemon-owned hang-diagnosis totals — the deadline path's
+        reports are captured HERE, not in any rank."""
+        c = dict(self.queue.counters)
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        if _waitgraph._enabled:
+            c.update(_waitgraph.counters_snapshot())
+        return c
+
+    def _capture_hang_report(self, job_id: str, procs) -> dict | None:
+        """Pre-revoke hang report: assemble the gang's cross-rank
+        wait-for graph from the newest telemetry frames while everyone
+        is still parked.  Bounded by ``hang_snapshot_timeout_ms``: the
+        capture waits that long for at least one blocked-state
+        snapshot from the gang (frames arrive at telemetry cadence),
+        then reports from whatever it holds — diagnosis must never
+        stall the revoke beyond its budget."""
+        from ompi_tpu.trace import waitgraph as _waitgraph
+
+        if not _waitgraph._enabled:
+            return None
+        gang = {int(p) for p in procs}
+        deadline = time.monotonic() + self._hang_timeout_s
+        while True:
+            frames = self.aggregator.latest_frames()
+            snaps = {p: f["waits"] for p, f in frames.items()
+                     if p in gang and f.get("waits")}
+            if snaps or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        failed: set[int] = set()
+        for p, f in frames.items():
+            if p in gang:
+                failed.update(int(x) for x in (f.get("failed") or ()))
+        try:
+            return _waitgraph.report(snaps, failed=sorted(failed),
+                                     reason=f"deadline:{job_id}")
+        except Exception:  # noqa: BLE001 — diagnosis never blocks revoke
+            return None
+
     def _top_state(self) -> dict:
         """The aggregator /json extension (tools/top.py's daemon line):
         liveness identity, journal depth, and the re-adoption picture —
@@ -1196,6 +1248,16 @@ class TpuDaemon:
                         if q is not None and q.poll() is None:
                             q.terminate()
         for job_id, procs in revoke:
+            # capture the hang report BEFORE the revoke wakes the gang:
+            # revoked waits unregister themselves, so the blocked-state
+            # evidence evaporates the moment the directive lands
+            hang = self._capture_hang_report(job_id, procs)
+            if hang is not None:
+                with self._lock:
+                    for st in self._outstanding.values():
+                        if (st["kind"] == "job"
+                                and st.get("job_id") == job_id):
+                            st["hang"] = hang
             self._publish({"kind": "revoke", "procs": procs,
                            "id": job_id})
         for idx in done_idx:
@@ -1238,7 +1300,8 @@ class TpuDaemon:
                              f"{error}")
             job = self.queue.finish(st["job_id"], ok=not bad,
                                     error=error,
-                                    ranks=st["done"])
+                                    ranks=st["done"],
+                                    hang=st.get("hang"))
             self._journal_ev("finish", idx=idx, kind="job", job=job)
             if job is not None:
                 print(f"[tpud] job {job['id']} ({job['tenant']}) "

@@ -272,7 +272,8 @@ class JobQueue:
     # -- completion ------------------------------------------------------
 
     def finish(self, job_id: str, ok: bool, error: str = "",
-               ranks: dict | None = None) -> dict | None:
+               ranks: dict | None = None,
+               hang: dict | None = None) -> dict | None:
         with self._lock:
             job = self._running.pop(job_id, None)
             if job is None:
@@ -285,6 +286,11 @@ class JobQueue:
                 # counters): the warm-reuse proof the ops surface and
                 # the acceptance test read
                 job["ranks"] = {str(r): rec for r, rec in ranks.items()}
+            if hang is not None:
+                # the pre-revoke hang report (deadline path): who was
+                # blocked on whom when the deadline fired — served off
+                # /job/<id> next to the DeadlineExpired error
+                job["hang"] = hang
             job["end_ns"] = time.time_ns()
             self._done[job_id] = job
             return dict(job)
@@ -311,6 +317,7 @@ class JobQueue:
             job.pop("start_ns", None)
             job.pop("ranks", None)
             job.pop("error", None)
+            job.pop("hang", None)
             self._queue.append(job)
             self.counters["jobs_retried"] += 1
             return dict(job)

@@ -636,11 +636,27 @@ def _serve_loop(api, ctx, link: DaemonLink, current: dict,
             # gang — while concurrent disjoint gangs stay untouched
             if ctx.proc in jd.get("procs", ()):
                 cur = inflight["jd"]
+                ack = {"ok": True, "revoked": jd.get("id")}
+                from ompi_tpu.trace import waitgraph as _waitgraph
+
+                if _waitgraph._enabled:
+                    if _waitgraph.busy():
+                        # last look at this rank's blocked state before
+                        # the poison wakes it (the waits unregister on
+                        # wake-up — evidence for the hang report)
+                        ack["waits"] = _waitgraph.snapshot()
+                        from ompi_tpu.metrics import export as _mexp
+
+                        # post-mortem leg: flush configured telemetry
+                        # NOW, blocked state included, so trace_report
+                        # --hangs can diagnose from the crash export
+                        # after the gang is gone
+                        _mexp.crash_dump("deadline_revoke")
                 if cur is not None and cur.get("id") == jd.get("id"):
                     print(f"serve: revoking job {jd.get('id')} "
                           "(deadline)", flush=True)
                     _revoke_quietly(inflight["comm"])
-                link.report(idx, {"ok": True, "revoked": jd.get("id")})
+                link.report(idx, ack)
             continue
         if kind == "repair":
             if ctx.proc in jd.get("procs", ()):

@@ -891,6 +891,17 @@ def test_daemon_deadline_revoke_and_retry_exhaustion_in_process():
     threading.Thread(target=_pump_directives, args=(d, stop),
                      daemon=True).start()
     try:
+        # blocked-state frames for BOTH procs (whichever the hung job's
+        # gang landed on): the deadline path must capture its hang
+        # report from these BEFORE publishing the revoke
+        t = time.time_ns()
+        for p in (0, 1):
+            d.aggregator.ingest({
+                "proc": p, "nprocs": 2, "ts_ns": t, "native": {},
+                "straggler": {}, "colls": [],
+                "waits": {"ts_ns": t, "waits": [
+                    {"site": "coll_recv", "plane": "host", "peer": 1 - p,
+                     "since_ns": t - 500_000_000}]}})
         jh = client.submit(d.url, "h.py", tenant="a", nprocs=1,
                            env={"CHAOS_HANG": "1"})
         jb = client.submit(d.url, "b.py", tenant="b", nprocs=1)
@@ -900,8 +911,20 @@ def test_daemon_deadline_revoke_and_retry_exhaustion_in_process():
         rec = client.status(d.url, jh["id"])
         assert rec["error"].startswith("DeadlineExpired"), rec
         assert "serve_job_deadline_s=0.3" in rec["error"], rec
+        # the attached hang report names the stalled gang's blocked
+        # wait (captured pre-revoke, keyed by this job's id)
+        hang = rec.get("hang")
+        assert hang, rec
+        assert hang["reason"] == f"deadline:{jh['id']}", hang
+        (gang_proc,) = [int(p) for p in rec["procs"]]
+        (e,) = hang["graph"]["edges"]
+        assert e["src"] == gang_proc and e["site"] == "coll_recv", hang
+        assert hang["verdict"]["kind"] == "straggler", hang
         # bystander quiet: the disjoint gang finished its job normally
-        assert client.status(d.url, jb["id"])["state"] == "done"
+        # — and its record carries NO hang report
+        brec = client.status(d.url, jb["id"])
+        assert brec["state"] == "done"
+        assert "hang" not in brec, brec
         assert client.status(
             d.url)["counters"]["jobs_deadline_expired"] == 1
         # retry exhaustion: the job dies on BOTH attempts — one budget

@@ -127,6 +127,10 @@ _PLANE_TAIL = ("plane_demotions", "plane_promotions", "plane_heal_probes")
 _JOBS_TAIL = ("jobs_concurrent_hwm", "jobs_shed",
               "jobs_deadline_expired", "jobs_retried")
 
+#: hang-diagnosis tail: the mesh doctor's capture counts
+#: (trace/waitgraph.py; Python-owned — the C block keeps zeroed slots)
+_HANG_TAIL = ("hang_snapshots", "hang_reports")
+
 
 def test_stats_tail_appended_not_reordered():
     native = _native()
@@ -149,7 +153,9 @@ def test_stats_tail_appended_not_reordered():
     assert tuple(names[n3:n3 + len(_DEVICE_TAIL)]) == _DEVICE_TAIL
     n4 = n3 + len(_DEVICE_TAIL)
     assert tuple(names[n4:n4 + len(_PLANE_TAIL)]) == _PLANE_TAIL
-    assert tuple(names[n4 + len(_PLANE_TAIL):]) == _JOBS_TAIL
+    n5 = n4 + len(_PLANE_TAIL)
+    assert tuple(names[n5:n5 + len(_JOBS_TAIL)]) == _JOBS_TAIL
+    assert tuple(names[n5 + len(_JOBS_TAIL):]) == _HANG_TAIL
     assert mcore.NATIVE_STATS_VERSION == 1
     # gauges classified so monotonicity checks skip them
     assert {"stream_depth", "stream_inflight"} <= set(mcore.GAUGES)

@@ -1,0 +1,461 @@
+"""Mesh doctor — blocked-state introspection + cross-rank wait-graph
+hang diagnosis.
+
+* registry — lazy begin/end tokens with full wait identity, the
+  disabled path (token 0, no entries, no ``waits`` frame field — zero
+  wire bytes), address→proc resolution, snapshot stacks;
+* solver — deadlock cycle with the exact edge set, straggler chain
+  root carrying the PR-15 blame vocabulary, failed-peer, compute;
+* counters — ``hang_snapshots``/``hang_reports`` ride the append-only
+  NATIVE_COUNTERS tail (provider merge + ``dcn_*`` pvar read) and
+  every report capture is flight-recorded;
+* surfaces — aggregator ``GET /waitgraph`` + the per-rank state brief
+  in ``/json``; ``trace_report.py --hangs`` over crash-export JSONL;
+* np=2 acceptance — a faultsim ``stall:ms=...;proc=1`` plan wedges
+  rank 1's shm-ring send under a tpud job deadline: the live
+  ``/waitgraph``, the revoked job's ``/job/<id>`` hang report, and
+  ``--hangs`` over the crash export all name the same
+  (rank 1, p2p_recv, peer 1) root; a seeded two-rank cross-recv
+  deadlock classifies as the exact 2-cycle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from ompi_tpu.metrics import core as mcore
+from ompi_tpu.metrics import flight as mflight
+from ompi_tpu.metrics import live
+from ompi_tpu.trace import waitgraph as wg
+
+REPO = Path(__file__).resolve().parent.parent
+DEADLOCK_WORKER = REPO / "tests" / "workers" / "mp_deadlock_worker.py"
+HANG_JOB = REPO / "tests" / "workers" / "serve_hang_job.py"
+TRACE_REPORT = REPO / "tools" / "trace_report.py"
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    wg.reset()
+    mcore.reset(full=True)
+    yield
+    wg.reset()
+    mcore.reset(full=True)
+
+
+# -- blocked-state registry --------------------------------------------
+
+
+def test_registry_tokens_identity_and_snapshot():
+    assert not wg.busy()
+    tok = wg.begin("coll_recv", peer=2, plane="host", cid="7", seq=5)
+    assert tok > 0 and wg.busy()
+    snap = wg.snapshot()
+    assert snap["ts_ns"] > 0
+    (w,) = snap["waits"]
+    assert w["site"] == "coll_recv" and w["peer"] == 2
+    assert w["plane"] == "host" and w["cid"] == "7" and w["seq"] == 5
+    assert w["thread"] == threading.current_thread().name
+    assert 0 < w["since_ns"] <= snap["ts_ns"]
+    # stacks tagged by thread role, innermost frames of THIS test
+    assert any("test_waitgraph" in "".join(rows)
+               for rows in snap["stacks"].values()), snap["stacks"]
+    wg.end(tok)
+    assert not wg.busy()
+    wg.end(0)  # the never-registered fast path is a no-op
+    assert wg.counters_snapshot()["hang_snapshots"] == 1
+
+
+def test_disabled_path_registers_nothing_and_ships_no_bytes():
+    wg.enable(False)
+    assert wg.begin("p2p_recv", peer=1) == 0
+    assert not wg.busy()
+    # the telemetry frame gate: disabled (or idle) publishers never
+    # attach a waits field — zero wire bytes
+    agg = live.TelemetryAggregator(http_port=0, history=4)
+    pub = live.TelemetryPublisher(agg.ingest_address, proc=0, nprocs=1,
+                                  interval_ms=40)
+    try:
+        deadline = time.monotonic() + 10
+        while agg.frames < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agg.frames >= 2
+        assert "waits" not in agg.latest_frames()[0]
+        # re-enable but stay idle: still no waits field (busy() gate)
+        wg.enable(True)
+        n = agg.frames
+        while agg.frames < n + 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "waits" not in agg.latest_frames()[0]
+        # a registered wait shows up on the next frame...
+        tok = wg.begin("cts", addr="host3:9", plane="tcp")
+        n = agg.frames
+        while agg.frames < n + 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        got = agg.latest_frames()[0]["waits"]
+        assert got["waits"][0]["site"] == "cts", got
+        # ...and unregistering drops it again
+        wg.end(tok)
+        n = agg.frames
+        while agg.frames < n + 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "waits" not in agg.latest_frames()[0]
+    finally:
+        pub.stop()
+        agg.close()
+
+
+def test_addr_resolver_names_the_peer():
+    class Eng:
+        def resolve(self, addr):
+            return 3 if addr == "hostX:2" else None
+
+    eng = Eng()
+    wg.register_resolver(eng, eng.resolve)
+    tok = wg.begin("ring", addr="hostX:2", plane="shm")
+    try:
+        (w,) = wg.snapshot(stacks=False)["waits"]
+        assert w["peer"] == 3 and w["addr"] == "hostX:2"
+    finally:
+        wg.end(tok)
+
+
+def test_native_provider_rows_merge_with_age_anchor():
+    class Eng:
+        def waitinfo(self):
+            return [{"site": "cts", "plane": "native", "peer": 1,
+                     "cid": "9", "seq": 2, "age_ns": 500_000_000}]
+
+    eng = Eng()
+    wg.register_native(eng, eng.waitinfo)
+    snap = wg.snapshot(stacks=False)
+    (w,) = snap["waits"]
+    assert w["site"] == "cts" and w["peer"] == 1
+    assert w["thread"] == "c-engine"
+    # monotonic age anchored onto this wall clock
+    assert abs((snap["ts_ns"] - w["since_ns"]) - 500_000_000) < 50e6
+
+
+# -- the solver --------------------------------------------------------
+
+
+def _snap(ts, *waits):
+    return {"ts_ns": ts, "waits": list(waits)}
+
+
+def _w(site, peer, plane="host", since=0, **kw):
+    return dict(site=site, peer=peer, plane=plane, since_ns=since, **kw)
+
+
+def test_classify_deadlock_exact_edge_pair():
+    g = wg.build_graph({
+        0: _snap(10_000, _w("p2p_recv", 1, "native", 4_000)),
+        1: _snap(10_000, _w("p2p_recv", 0, "native", 5_000)),
+    })
+    v = wg.classify(g)
+    assert v["kind"] == "deadlock"
+    assert sorted(v["cycle"]) == [0, 1]
+    assert sorted((e["src"], e["dst"]) for e in v["edges"]) \
+        == [(0, 1), (1, 0)]
+
+
+def test_classify_straggler_chain_and_cause_bucket():
+    g = wg.build_graph({
+        0: _snap(10_000, _w("coll_recv", 1, since=1_000)),
+        1: _snap(10_000, _w("cts", 2, "tcp", since=2_000)),
+        2: _snap(10_000, _w("ring", None, "shm", since=3_000)),
+    })
+    v = wg.classify(g)
+    assert v["kind"] == "straggler"
+    assert v["chain"] == [0, 1, 2]
+    r = v["root"]
+    assert r["rank"] == 2 and r["cause"] == "ring-backpressure"
+    assert r["site"] == "cts" and r["plane"] == "tcp"
+
+
+def test_classify_failed_peer_and_compute():
+    g = wg.build_graph(
+        {0: _snap(10_000, _w("coll_recv", 1, since=1_000))}, failed=[1])
+    v = wg.classify(g)
+    assert v["kind"] == "failed-peer" and v["rank"] == 1
+    assert v["site"] == "coll_recv"
+    v2 = wg.classify(wg.build_graph({0: _snap(10_000), 1: _snap(10_000)}))
+    assert v2["kind"] == "compute" and v2["edges"] == []
+
+
+# -- counters on the NATIVE_COUNTERS tail ------------------------------
+
+
+def test_hang_counters_ride_native_tail_and_flight_record():
+    from ompi_tpu import metrics
+
+    assert "hang_snapshots" in mcore.NATIVE_COUNTERS
+    assert "hang_reports" in mcore.NATIVE_COUNTERS
+    wg.snapshot(stacks=False)  # registers the provider, bumps once
+    assert mcore.native_counters()["hang_snapshots"] >= 1
+    assert mcore.native_value("hang_reports") == 0
+    metrics.enable(True)
+    rep = wg.report({0: _snap(10_000, _w("coll_recv", 1, since=1))},
+                    reason="unit")
+    assert rep["verdict"]["kind"] == "straggler"
+    assert rep["reason"] == "unit"
+    assert mcore.native_value("hang_reports") == 1
+    recs = [r for r in mflight.records()
+            if r.get("reason") == "hang_report"]
+    assert len(recs) == 1, mflight.records()
+    d = recs[0]["detail"]
+    assert d["kind"] == "straggler" and d["cause"] == "unit", d
+
+
+# -- aggregator surfaces -----------------------------------------------
+
+
+def _get(url, path=""):
+    with urllib.request.urlopen(url + path, timeout=5) as r:
+        return r.read().decode()
+
+
+def test_aggregator_waitgraph_endpoint_and_state_brief():
+    agg = live.TelemetryAggregator(http_port=0, history=8)
+    try:
+        t = time.time_ns()
+        agg.ingest({"proc": 0, "nprocs": 2, "ts_ns": t,
+                    "native": {"delivered": 5}, "straggler": {},
+                    "colls": [],
+                    "waits": _snap(t, _w("coll_recv", 1, since=t - int(3e9),
+                                         cid="4", seq=9))})
+        agg.ingest({"proc": 1, "nprocs": 2, "ts_ns": t,
+                    "native": {"delivered": 7}, "straggler": {},
+                    "colls": []})
+        st = json.loads(_get(agg.url, "/waitgraph"))
+        assert st["nprocs"] == 2 and st["reporting"] == [0]
+        (e,) = st["graph"]["edges"]
+        assert (e["src"], e["dst"], e["site"]) == (0, 1, "coll_recv")
+        assert e["cid"] == "4" and e["seq"] == 9
+        assert e["age_ns"] >= int(2.9e9)
+        v = st["verdict"]
+        assert v["kind"] == "straggler" and v["root"]["rank"] == 1
+        # the /json brief feeding tools/top.py: BLOCKED names the
+        # binding site→peer; the fresh active rank shows RUNNING
+        assert st["states"]["0"] == "BLOCKED:coll_recv→1"
+        assert st["states"]["1"] == "RUNNING"
+        js = json.loads(_get(agg.url, "/json"))
+        assert js["waitgraph"] == st["states"]
+        # a later frame with unchanged counters and no waits → IDLE
+        agg.ingest({"proc": 1, "nprocs": 2, "ts_ns": t + int(1e9),
+                    "native": {"delivered": 7}, "straggler": {},
+                    "colls": []})
+        js = json.loads(_get(agg.url, "/json"))
+        assert js["waitgraph"]["1"] == "IDLE"
+    finally:
+        agg.close()
+
+
+def test_aggregator_failed_set_feeds_failed_peer_verdict():
+    agg = live.TelemetryAggregator(http_port=0, history=8)
+    try:
+        t = time.time_ns()
+        agg.ingest({"proc": 0, "nprocs": 2, "ts_ns": t,
+                    "native": {}, "straggler": {}, "colls": [],
+                    "failed": [1],
+                    "waits": _snap(t, _w("p2p_recv", 1, "native",
+                                         since=t - int(1e9)))})
+        st = json.loads(_get(agg.url, "/waitgraph"))
+        assert st["verdict"]["kind"] == "failed-peer"
+        assert st["verdict"]["rank"] == 1
+    finally:
+        agg.close()
+
+
+# -- offline: trace_report --hangs over crash exports ------------------
+
+
+def test_trace_report_hangs_over_crash_export(tmp_path):
+    """The offline leg accepts BOTH on-disk shapes: a telemetry frame
+    (nested snapshot dict) and a crash-export final snapshot (flat
+    ``waits`` list + its own ts_ns), newest record per proc wins."""
+    t = time.time_ns()
+    f0 = tmp_path / "exp.0.jsonl"
+    f0.write_text(
+        json.dumps({"ev": "crash_export", "cause": "deadline_revoke"})
+        + "\n"
+        + json.dumps({"proc": 0, "ts_ns": t, "partial": True,
+                      "waits": [_w("p2p_recv", 1, since=t - int(2e9))]})
+        + "\n")
+    f1 = tmp_path / "exp.1.jsonl"
+    f1.write_text(json.dumps(
+        {"proc": 1, "ts_ns": t,
+         "waits": _snap(t, _w("p2p_recv", 0, since=t - int(2e9)))})
+        + "\n")
+    res = subprocess.run(
+        [sys.executable, str(TRACE_REPORT), "--hangs",
+         str(f0), str(f1)],
+        capture_output=True, timeout=60, cwd=str(REPO))
+    out = res.stdout.decode()
+    assert res.returncode == 0, res.stderr.decode()
+    assert "verdict: deadlock" in out, out
+    assert "rank 0" in out and "p2p_recv" in out, out
+
+
+# -- np=2 acceptance ---------------------------------------------------
+
+
+def _spawn_reader(proc):
+    lines: list[str] = []
+
+    def _r():
+        for raw in iter(proc.stdout.readline, b""):
+            lines.append(raw.decode(errors="replace"))
+
+    t = threading.Thread(target=_r, daemon=True)
+    t.start()
+    return lines, t
+
+
+def _await_line(lines, proc, marker, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and proc.poll() is None:
+        for l in list(lines):
+            if marker in l:
+                return l
+        time.sleep(0.05)
+    raise AssertionError(f"never saw {marker!r}:\n" + "".join(lines))
+
+
+def test_tpurun_np2_cross_recv_deadlock_classified_as_cycle():
+    """THE seeded-deadlock acceptance: both ranks park in a cross-recv
+    and the live ``/waitgraph`` names the cycle with the exact edge
+    pair — then the test kills the (genuinely hung) run."""
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+           "--cpu-devices", "1", "--mca", "btl", "tcp",
+           "--mca", "telemetry_enable", "1",
+           "--mca", "telemetry_interval_ms", "150",
+           "--mca", "dcn_recv_timeout", "120",
+           str(DEADLOCK_WORKER)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env,
+                            cwd=str(REPO))
+    lines, t = _spawn_reader(proc)
+    try:
+        l = _await_line(lines, proc, "[tpurun] telemetry: ")
+        url = l.split("[tpurun] telemetry: ", 1)[1].split("/metrics")[0]
+        verdict = None
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                st = json.loads(_get(url, "/waitgraph"))
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if st["verdict"]["kind"] == "deadlock":
+                verdict = st["verdict"]
+                break
+            time.sleep(0.2)
+        assert verdict is not None, "".join(lines)
+        assert sorted(verdict["cycle"]) == [0, 1], verdict
+        assert sorted((e["src"], e["dst"]) for e in verdict["edges"]) \
+            == [(0, 1), (1, 0)], verdict
+        # both edges are the p2p recv wait, each naming the other rank
+        assert all(e["site"] == "p2p_recv" for e in verdict["edges"])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+        t.join(timeout=10)
+
+
+def test_tpud_np2_stall_hang_three_surfaces_name_same_root(tmp_path):
+    """THE hang-diagnosis acceptance: a faultsim ``stall:ms;proc=1``
+    plan wedges rank 1's shm-ring send past ``serve_job_deadline_s``.
+    The live ``/waitgraph`` (mid-hang), the revoked job's ``/job/<id>``
+    hang report, and ``trace_report.py --hangs`` over the crash export
+    flushed by the revoke path must all name the SAME
+    (rank 1, p2p_recv, peer 1) root."""
+    from ompi_tpu.serve import client
+
+    mout = str(tmp_path / "hangexp")
+    cmd = [sys.executable, "-m", "ompi_tpu", "run", "-np", "2",
+           "--daemon", "--cpu-devices", "1",
+           "--mca", "btl", "sm",
+           "--mca", "btl_sm_shm_threshold", "4096",
+           "--mca", "telemetry_interval_ms", "150",
+           "--mca", "serve_job_deadline_s", "4",
+           "--mca", "dcn_recv_timeout", "120",
+           "--mca", "faultsim_enable", "1",
+           "--mca", "faultsim_seed", "7",
+           "--mca", "faultsim_plan", "stall:ms=9000;proc=1",
+           "--mca", "metrics_enable", "1",
+           "--mca", "metrics_output", mout]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env,
+                            cwd=str(REPO))
+    lines, t = _spawn_reader(proc)
+    try:
+        l = _await_line(lines, proc, "[tpud] ops: ")
+        url = l.split("[tpud] ops: ", 1)[1].split("/jobs")[0]
+        j = client.submit(url, str(HANG_JOB), tenant="doc", nprocs=2)
+        # surface 1 — LIVE, mid-hang: /waitgraph names the root while
+        # the gang is still parked (the deadline clears it at ~4 s)
+        live_root = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                st = json.loads(_get(url, "/waitgraph"))
+            except OSError:
+                time.sleep(0.1)
+                continue
+            v = st["verdict"]
+            if v["kind"] == "straggler":
+                live_root = v["root"]
+                assert st["states"].get("0", "").startswith(
+                    "BLOCKED:p2p_recv"), st["states"]
+                break
+            time.sleep(0.1)
+        assert live_root is not None, "".join(lines)
+        # surface 2 — POST-MORTEM FILE: the revoke path flushed rank
+        # 0's crash export with the blocked state still registered
+        exp = mout + ".0.jsonl"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if os.path.exists(exp) and "p2p_recv" in open(exp).read():
+                break
+            time.sleep(0.2)
+        res = subprocess.run(
+            [sys.executable, str(TRACE_REPORT), "--hangs", exp],
+            capture_output=True, timeout=60, cwd=str(REPO))
+        out = res.stdout.decode()
+        assert res.returncode == 0, res.stderr.decode()
+        assert "verdict: straggler — rank 1 holds the mesh" in out, out
+        assert "p2p_recv→1" in out, out
+        # surface 3 — the job record: DeadlineExpired with the hang
+        # report the daemon captured BEFORE publishing the revoke
+        rec = client.wait(url, j["id"], timeout=90)
+        assert rec["state"] == "failed", rec
+        assert rec["error"].startswith("DeadlineExpired"), rec
+        hang = rec.get("hang")
+        assert hang, rec
+        assert hang["reason"] == f"deadline:{j['id']}", hang
+        rep_root = hang["verdict"]["root"]
+        # all three surfaces agree on (rank, site, peer)
+        for root in (live_root, rep_root):
+            assert root["rank"] == 1, (live_root, rep_root)
+            assert root["site"] == "p2p_recv", (live_root, rep_root)
+            assert root["peer"] == 1, (live_root, rep_root)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+        t.join(timeout=10)

@@ -1,0 +1,29 @@
+"""tpurun worker: a SEEDED two-rank cross-recv deadlock.
+
+Each rank posts a blocking recv from the other and neither ever
+sends — the classic A-waits-B-waits-A hang.  With telemetry on, each
+rank's blocked-state snapshot (registered lazily after the first
+expired Deadline slice) rides its frames to the aggregator, and the
+test scrapes ``GET /waitgraph`` until the solver classifies the cycle
+with the exact edge pair (0,1),(1,0).  The test then kills the run:
+``dcn_recv_timeout`` is set long enough that neither rank escalates
+inside the scrape window (the hang must stay a *hang*, not become a
+peer-failure).
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import ompi_tpu.api as api
+
+world = api.init()
+p = world.proc
+assert world.nprocs == 2, world.nprocs
+me = world.proc_range(p)[0]
+peer = world.proc_range(1 - p)[0]
+print(f"DEADLOCK worker proc={p} entering cross-recv", flush=True)
+world.recv(me, source=peer, tag=9)  # never satisfied: the deadlock
+api.finalize()

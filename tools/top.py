@@ -133,11 +133,14 @@ def render(state: dict, prev: dict | None = None, url: str = "",
                   "transport": "wire", "compute": "comp"}
     crit = {str(p): b for p, b in
             ((state.get("critical") or {}).get("per_rank") or {}).items()}
+    #: hang-diagnosis per-rank state brief (/json "waitgraph"):
+    #: RUNNING / BLOCKED:site→peer / IDLE
+    wg = {str(p): s for p, s in (state.get("waitgraph") or {}).items()}
     print(f"{'rank':<5}{'MB/s':>8}{'msg/s':>8}{'delivered':>10}"
           f"{'reconn':>7}{'respwn':>7}{'dedup':>6}{'dlexp':>6}"
           f"{'sdep':>5}{'coal':>6}{'sched':>6}{'dev%':>6}{'dmaw':>7}"
-          f"{'plane':>7}{'blame':>6}{'failed':>7}"
-          "  stall causes (ring/cts/other)",
+          f"{'plane':>7}{'blame':>6}{'failed':>7}  {'state':<20}"
+          "stall causes (ring/cts/other)",
           file=out)
     for p in sorted(procs):
         f = procs[p]
@@ -188,6 +191,7 @@ def render(state: dict, prev: dict | None = None, url: str = "",
         blame = blame_abbr.get(bl.get("cause", ""), "-") \
             if bl.get("total_ns") else "-"
         failed = f.get("failed") or []
+        st_col = wg.get(str(p)) or "-"
         print(f"{p:<5}{mbs:>8.1f}{msgs:>8.0f}"
               f"{int(n.get('delivered', 0)):>10}"
               f"{int(n.get('reconnects', 0)):>7}"
@@ -196,7 +200,8 @@ def render(state: dict, prev: dict | None = None, url: str = "",
               f"{int(n.get('deadline_expired', 0)):>6}"
               f"{int(n.get('stream_depth', 0)):>5}{coal:>6}{sched:>6}"
               f"{dev:>6}{dmaw:>7}{plane:>7}{blame:>6}"
-              f"{(','.join(map(str, failed)) or '-'):>7}  {causes}",
+              f"{(','.join(map(str, failed)) or '-'):>7}  "
+              f"{st_col:<20}{causes}",
               file=out)
     strag = state.get("straggler") or {}
     per_proc = {int(p): s for p, s in
@@ -445,9 +450,43 @@ def selftest() -> int:
               "native": {"eager_bytes": 2_000_000, "eager_msgs": 10}}
         mbs, msgs = _rates(f1, f0)
         assert abs(mbs - 2.0) < 1e-6 and abs(msgs - 10.0) < 1e-6
+        # hang-diagnosis state column: one more frame pair — rank 0's
+        # counters stop moving (IDLE), rank 1 ships a blocked-state
+        # snapshot (BLOCKED:site→peer) — and /waitgraph walks the
+        # chain to the root
+        t4 = base + 3 * 500_000_000
+        nat0 = {"eager_bytes": 3_000_000, "eager_msgs": 300,
+                "delivered": 150, "stall_ns": 15_000_000,
+                "ring_stall_ns": 9_000_000, "cts_wait_ns": 3_000_000,
+                "device_dma_wait_ns": 6_000_000}
+        agg.ingest({"proc": 1, "nprocs": 2, "ts_ns": t4,
+                    "native": dict(nat0), "straggler": {}, "colls": [],
+                    "waits": {"ts_ns": t4, "waits": [
+                        {"site": "cts", "plane": "tcp", "peer": 0,
+                         "since_ns": t4 - 700_000_000}]}})
+        nat0.update(plane_demotions=1, plane_promotions=1,
+                    plane_heal_probes=1)
+        agg.ingest({"proc": 0, "nprocs": 2, "ts_ns": t4,
+                    "native": nat0, "straggler": {}, "colls": []})
+        wstate = fetch(agg.url)
+        assert wstate["waitgraph"] == {"0": "IDLE",
+                                       "1": "BLOCKED:cts→0"}, \
+            wstate["waitgraph"]
+        wg = json.loads(_scrape_url(agg.url + "/waitgraph"))
+        assert wg["verdict"]["kind"] == "straggler", wg["verdict"]
+        assert wg["verdict"]["root"]["rank"] == 0, wg["verdict"]
+        assert [(e["src"], e["dst"]) for e in
+                wg["graph"]["edges"]] == [(1, 0)], wg["graph"]
+        buf = io.StringIO()
+        render(wstate, prev=None, url=agg.url, out=buf)
+        wtext = buf.getvalue()
+        wrow1 = [l for l in wtext.splitlines() if l.startswith("1 ")][0]
+        wrow0 = [l for l in wtext.splitlines() if l.startswith("0 ")][0]
+        assert "BLOCKED:cts→0" in wrow1, wrow1
+        assert "IDLE" in wrow0 and "BLOCKED" not in wrow0, wrow0
         print("selftest OK: 6 frames ingested over HTTP, 12 straggler "
               "joins (rank 1 slowest 12/12), prometheus families, "
-              "history ring, renderer")
+              "history ring, renderer, waitgraph state column")
         return 0
     finally:
         agg.close()

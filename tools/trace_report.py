@@ -29,6 +29,7 @@ from typing import Any
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from ompi_tpu.trace import causal, chrome, core, merge  # noqa: E402
+from ompi_tpu.trace import waitgraph  # noqa: E402
 
 
 def percentile(sorted_vals: list[float], q: float) -> float:
@@ -136,6 +137,110 @@ def render_critical(summary: dict, top: int, out=sys.stdout) -> None:
             for r, cause, ns in cp.get("path") or ():
                 print(f"        rank {r:<3}{cause:<18}"
                       f"{ns / 1e6:>9.3f} ms", file=out)
+
+
+def hangs_from_jsonl(paths) -> tuple[dict[int, dict], set[int]]:
+    """Per-proc blocked-state snapshots from metrics/crash ``.jsonl``
+    exports: the newest record per proc carrying a ``waits`` section
+    wins (a crash export's final snapshot is the hang's last picture).
+    Accepts both shapes — finalize/crash snapshots hold the flat wait
+    list, telemetry-frame dumps nest the full snapshot dict."""
+    snaps: dict[int, dict] = {}
+    failed: set[int] = set()
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                for x in rec.get("failed") or ():
+                    failed.add(int(x))
+                w = rec.get("waits")
+                proc = rec.get("proc")
+                if proc is None or not w:
+                    continue
+                snap = (w if isinstance(w, dict)
+                        else {"ts_ns": rec.get("ts_ns", 0), "waits": w})
+                prev = snaps.get(int(proc))
+                if (prev is None
+                        or int(snap.get("ts_ns") or 0)
+                        >= int(prev.get("ts_ns") or 0)):
+                    snaps[int(proc)] = snap
+    return snaps, failed
+
+
+def render_hangs(snaps: dict[int, dict], failed=(),
+                 out=sys.stdout) -> dict:
+    """Offline hang diagnosis: wait-for graph + classification over
+    per-proc blocked-state snapshots (the ``--hangs`` mode body; also
+    exercised by the selftest).  Returns the verdict."""
+    graph = waitgraph.build_graph(snaps, failed=sorted(failed))
+    verdict = waitgraph.classify(graph)
+    print(f"hang diagnosis: {len(snaps)} rank(s) reporting blocked "
+          f"state, {len(graph['edges'])} wait edge(s)", file=out)
+    for e in graph["edges"]:
+        dst = "?" if e["dst"] is None else e["dst"]
+        ident = e.get("key") or (f"{e['cid']}/{e['seq']}"
+                                 if e.get("cid") else "")
+        print(f"  rank {e['src']:<4} {e['site']}→{dst:<4} "
+              f"[{e['plane']}]  age {e['age_ns'] / 1e6:.0f} ms"
+              + (f"  {ident}" if ident else ""), file=out)
+    kind = verdict["kind"]
+    if kind == "deadlock":
+        loop = "→".join(str(r) for r in
+                        verdict["cycle"] + verdict["cycle"][:1])
+        print(f"verdict: deadlock — cycle {loop}", file=out)
+    elif kind == "straggler":
+        root = verdict["root"]
+        chain = "→".join(str(r) for r in verdict["chain"])
+        print(f"verdict: straggler — rank {root['rank']} holds the "
+              f"mesh ({chain}); site={root['site']} "
+              f"plane={root['plane']} cause={root['cause']}", file=out)
+    elif kind == "failed-peer":
+        print(f"verdict: failed peer — rank {verdict['rank']} is dead/"
+              f"demoted; waiters parked in {verdict['site']} on the "
+              f"{verdict['plane']} plane", file=out)
+    else:
+        print("verdict: compute — no MPI wait edges; the application "
+              "is (or every rank was) computing", file=out)
+    return verdict
+
+
+def _golden_waitgraph_check() -> None:
+    """Classify the golden wait-graph fixture and hold the answers —
+    the hang-solver regression half of the selftest (tier-1)."""
+    import io
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "tests", "golden", "waitgraph_fixture.json")
+    with open(path) as f:
+        doc = json.load(f)
+    for name, case in doc["cases"].items():
+        snaps = {int(r): s for r, s in case["snaps_by_rank"].items()}
+        graph = waitgraph.build_graph(snaps,
+                                      failed=case.get("failed") or ())
+        v = waitgraph.classify(graph)
+        exp = case["expect"]
+        assert v["kind"] == exp["kind"], (name, v)
+        if "cycle_edges" in exp:
+            got = sorted((e["src"], e["dst"]) for e in v["edges"])
+            assert got == [tuple(e) for e in exp["cycle_edges"]], (name, v)
+            assert sorted(v["cycle"]) == exp["cycle_ranks"], (name, v)
+        if "root_rank" in exp:
+            assert v["root"]["rank"] == exp["root_rank"], (name, v)
+            assert v["root"]["cause"] == exp["cause"], (name, v)
+            assert v["root"]["site"] == exp["site"], (name, v)
+            assert v["root"]["plane"] == exp["plane"], (name, v)
+            assert v["chain"] == exp["chain"], (name, v)
+        # the offline renderer names the same verdict on the same data
+        buf = io.StringIO()
+        rv = render_hangs(snaps, case.get("failed") or (), out=buf)
+        assert rv["kind"] == exp["kind"], (name, buf.getvalue())
+        assert exp["kind"] in buf.getvalue(), buf.getvalue()
 
 
 def _golden_causal_check() -> None:
@@ -264,10 +369,14 @@ def selftest() -> int:
         # hook → chrome → merge → solve stack proves the plumbing
         _golden_causal_check()
         summary = _causal_stack_check(tmp)
+        # hang-diagnosis leg: the golden wait-graph fixture pins the
+        # deadlock-cycle and straggler-chain classifications (and the
+        # --hangs renderer) against the solver
+        _golden_waitgraph_check()
         print("selftest OK: 2 ranks, "
               f"{len(merged['traceEvents'])} merged events, keys "
               f"aligned; causal golden + {summary['instances']} "
-              "stack-solved instances")
+              "stack-solved instances; waitgraph golden held")
         return 0
     finally:
         core.reset()
@@ -299,6 +408,12 @@ def main(argv: list[str] | None = None) -> int:
                     "traces recorded with --mca trace_causal 1): "
                     "per-collective critical paths, per-rank blame "
                     "decomposition, per-algorithm profiles")
+    ap.add_argument("--hangs", action="store_true",
+                    help="hang diagnosis: treat the input files as "
+                    "metrics/crash .jsonl exports, assemble the "
+                    "cross-rank wait-for graph from their blocked-"
+                    "state snapshots, and name the hang (deadlock "
+                    "cycle / straggler root / failed peer / compute)")
     ap.add_argument("--selftest", action="store_true",
                     help="run the built-in self-check and exit")
     ns = ap.parse_args(argv)
@@ -306,6 +421,10 @@ def main(argv: list[str] | None = None) -> int:
         return selftest()
     if not ns.traces:
         ap.error("no trace files given (or use --selftest)")
+    if ns.hangs:
+        snaps, failed = hangs_from_jsonl(ns.traces)
+        render_hangs(snaps, failed)
+        return 0
     offsets: dict[int, float] = {}
     if ns.clock_from:
         snaps = []
